@@ -1,0 +1,54 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with MXNet 2.x capabilities.
+
+Import convention mirrors the reference (`python/mxnet/__init__.py:23-80`):
+
+    import mxnet_tpu as mx
+    x = mx.np.ones((2, 3), device=mx.tpu())
+    with mx.autograd.record():
+        y = (x * x).sum()
+    y.backward()
+
+Compute lowers to XLA on TPU via JAX; the runtime design is documented in
+SURVEY.md §7 — there is deliberately no dependency engine, stream manager or
+memory pool here (PjRt provides all three).
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .base import MXNetError  # noqa: F401
+from . import device  # noqa: F401
+from .device import (  # noqa: F401
+    Device, Context, cpu, gpu, tpu, cpu_pinned,
+    current_device, current_context, num_gpus, num_tpus, num_devices,
+)
+from . import _tape  # noqa: F401
+from . import ndarray  # noqa: F401
+from . import ndarray as nd  # noqa: F401
+from .ndarray.ndarray import NDArray  # noqa: F401
+from . import numpy  # noqa: F401
+from . import numpy as np  # noqa: F401
+from . import numpy_extension  # noqa: F401
+from . import numpy_extension as npx  # noqa: F401
+from . import autograd  # noqa: F401
+from . import random  # noqa: F401
+from . import initializer  # noqa: F401
+from . import initializer as init  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import engine  # noqa: F401
+from . import gluon  # noqa: F401
+from . import kvstore  # noqa: F401
+from . import kvstore as kv  # noqa: F401
+from . import parallel  # noqa: F401
+from . import profiler  # noqa: F401
+from . import amp  # noqa: F401
+from . import runtime  # noqa: F401
+from . import util  # noqa: F401
+from . import test_utils  # noqa: F401
+from . import recordio  # noqa: F401
+from . import io  # noqa: F401
+from . import image  # noqa: F401
+from . import ops  # noqa: F401
+from . import models  # noqa: F401
+
+device_module = device

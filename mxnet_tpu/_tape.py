@@ -1,0 +1,193 @@
+"""Eager autograd tape.
+
+TPU-native re-design of the reference's imperative autograd
+(`src/imperative/imperative.cc:49-140,235,438`; Python scopes
+`python/mxnet/autograd.py:121-180`). The reference records an NNVM graph and
+runs an `MXGradient` pass at `backward()`; here every recorded op eagerly
+captures its VJP via `jax.vjp` (forward work is identical — residuals are what
+the NNVM path would retain anyway), and `backward()` is a reverse topological
+walk calling the stored VJP closures. A hybridized block contributes a single
+tape node (parity: CachedOp registering one `_CachedOp` autograd node,
+`src/imperative/cached_op.cc:901`).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "TapeNode", "is_recording", "is_training", "set_recording", "set_training",
+    "record_node", "backward_on_heads",
+]
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+
+
+_state = _State()
+
+
+def is_recording() -> bool:
+    return _state.recording
+
+
+def is_training() -> bool:
+    return _state.training
+
+
+def set_recording(flag: bool) -> bool:
+    prev = _state.recording
+    _state.recording = flag
+    return prev
+
+
+def set_training(flag: bool) -> bool:
+    prev = _state.training
+    _state.training = flag
+    return prev
+
+
+class TapeNode:
+    """One recorded differentiable op.
+
+    vjp_fn: cotangents-of-outputs -> tuple of cotangents for `parents`.
+    parents: list of parent arrays (the differentiable ndarray inputs, by
+      the tape-ref they had at call time: (TapeNode|None, out_index, array)).
+    n_out: number of outputs of this node.
+    """
+
+    __slots__ = ("vjp_fn", "parents", "n_out", "name", "out_avals", "fwd_fn",
+                 "out_is_tuple")
+
+    def __init__(self, vjp_fn: Callable, parents: Sequence[Tuple[Optional["TapeNode"], int, Any]],
+                 n_out: int, name: str = "op", out_avals=None, fwd_fn=None):
+        self.out_is_tuple = n_out > 1
+        self.vjp_fn = vjp_fn
+        self.parents = list(parents)
+        self.n_out = n_out
+        self.name = name
+        self.out_avals = out_avals  # list of (shape, dtype) per output
+        # pure function of the parent values; kept for higher-order grad
+        # (tape replay under jax.grad — see autograd.grad(create_graph=True))
+        self.fwd_fn = fwd_fn
+
+
+def record_node(vjp_fn, parent_arrays, n_out, name="op", out_avals=None,
+                fwd_fn=None) -> TapeNode:
+    """parent_arrays: the ndarray objects that were differentiable inputs.
+
+    Captures each parent's *current* tape ref (node, index) plus the array
+    object itself (for leaf grad writes)."""
+    parents = []
+    for a in parent_arrays:
+        parents.append((a._ag_node, a._ag_out_index, a))
+    return TapeNode(vjp_fn, parents, n_out, name, out_avals, fwd_fn)
+
+
+def _toposort(heads: Sequence[TapeNode]) -> List[TapeNode]:
+    seen = set()
+    out: List[TapeNode] = []
+    stack2: List[Tuple[TapeNode, bool]] = [(h, False) for h in dict.fromkeys(heads)]
+    while stack2:
+        node, processed = stack2.pop()
+        if processed:
+            out.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack2.append((node, True))
+        for pnode, _, _ in node.parents:
+            if pnode is not None and id(pnode) not in seen:
+                stack2.append((pnode, False))
+    return out  # post-order: parents before children
+
+
+def backward_on_heads(heads, head_grads, retain_graph: bool = False,
+                      accumulate_into_leaves: bool = True):
+    """Run the reverse pass.
+
+    heads: list of ndarray whose gradient seeds are head_grads (jax values).
+    Writes leaf gradients into `arr.grad` per `arr._grad_req` and returns a
+    dict mapping id(leaf ndarray) -> cotangent for callers that want values
+    (autograd.grad style).
+    """
+    import jax.numpy as jnp
+
+    head_nodes = []
+    # cotangent accumulator keyed by (id(node), out_index)
+    cotangents: dict = {}
+    leaf_grads: dict = {}
+
+    def _acc(key, val):
+        cur = cotangents.get(key)
+        cotangents[key] = val if cur is None else cur + val
+
+    for h, g in zip(heads, head_grads):
+        node = h._ag_node
+        if node is None:
+            # head is itself a leaf variable
+            if h._grad_req != "null":
+                leaf_grads.setdefault(id(h), []).append((h, g))
+            continue
+        head_nodes.append(node)
+        _acc((id(node), h._ag_out_index), g)
+
+    order = _toposort(head_nodes)  # parents-before-children
+    for node in reversed(order):   # children first
+        outs = []
+        n_present = 0
+        for i in range(node.n_out):
+            c = cotangents.get((id(node), i))
+            outs.append(c)
+            if c is not None:
+                n_present += 1
+        if n_present == 0:
+            continue
+        if n_present < node.n_out:
+            # zeros-fill unused outputs (parity: grad graph feeds zero heads)
+            import numpy as _onp
+            import jax as _jax
+            for i, c in enumerate(outs):
+                if c is None:
+                    shape, dtype = node.out_avals[i]
+                    if jnp.issubdtype(dtype, jnp.inexact):
+                        outs[i] = jnp.zeros(shape, dtype)
+                    else:  # integer/bool outputs take float0 cotangents
+                        outs[i] = _onp.zeros(shape, _jax.dtypes.float0)
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f"backward through '{node.name}' a second time: the graph "
+                "has been freed. Pass retain_graph=True to backward() to "
+                "backward through it again.")
+        cot_in = node.vjp_fn(tuple(outs) if node.out_is_tuple else outs[0])
+        if not isinstance(cot_in, (tuple, list)):
+            cot_in = (cot_in,)
+        for (pnode, pidx, parr), c in zip(node.parents, cot_in):
+            if c is None:
+                continue
+            if pnode is None:
+                if parr._grad_req != "null":
+                    leaf_grads.setdefault(id(parr), []).append((parr, c))
+            else:
+                _acc((id(pnode), pidx), c)
+        if not retain_graph:
+            node.vjp_fn = None  # free residuals
+
+    # write into .grad
+    result = {}
+    for _, entries in leaf_grads.items():
+        arr = entries[0][0]
+        total = entries[0][1]
+        for _, c in entries[1:]:
+            total = total + c
+        result[id(arr)] = total
+        if accumulate_into_leaves and arr.grad is not None:
+            if arr._grad_req == "add":
+                arr.grad._data = arr.grad._data + total
+            else:  # write
+                arr.grad._data = jnp.broadcast_to(total, arr.grad.shape).astype(arr.grad.dtype)
+    return result

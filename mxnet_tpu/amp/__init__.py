@@ -1,0 +1,84 @@
+"""Automatic mixed precision (parity: `python/mxnet/amp/`).
+
+The reference monkey-patches op namespaces with `amp_cast` insertions driven
+by allow/deny lists (`amp/lists/symbol_fp16.py`) and scales losses
+(`amp/loss_scaler.py`). On TPU the native mixed-precision dtype is bfloat16,
+which needs no loss scaling; fp16 remains available with a dynamic scaler for
+parity. `convert_hybrid_block` re-casts a block's parameters and sets a
+compute dtype used at trace time (the XLA analog of the ReducePrecision pass
+`src/nnvm/low_precision_pass.cc`).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .loss_scaler import LossScaler
+from .lists import FP16_FP32_FUNCS, FP16_FUNCS, FP32_FUNCS
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale", "convert_hybrid_block",
+           "LossScaler", "mixed_precision_dtype"]
+
+_state = {"enabled": False, "dtype": jnp.bfloat16, "scaler": None}
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Enable AMP. target_dtype in {'bfloat16','float16'}."""
+    dt = jnp.bfloat16 if str(target_dtype) in ("bfloat16", "bf16") else jnp.float16
+    _state["enabled"] = True
+    _state["dtype"] = dt
+    if dt == jnp.float16:
+        _state["scaler"] = LossScaler()
+    from ..gluon import block as _block
+    _block._amp_dtype[0] = dt
+
+
+def mixed_precision_dtype():
+    return _state["dtype"] if _state["enabled"] else None
+
+
+def init_trainer(trainer):
+    """Attach dynamic loss scaling to a Trainer (fp16 only)."""
+    if _state.get("scaler") is not None:
+        trainer._amp_loss_scaler = _state["scaler"]
+
+
+class scale_loss:
+    """Context manager: `with amp.scale_loss(loss, trainer) as scaled:`."""
+
+    def __init__(self, loss, trainer):
+        self._loss = loss
+        self._trainer = trainer
+
+    def __enter__(self):
+        scaler = getattr(self._trainer, "_amp_loss_scaler", None)
+        if scaler is None:
+            return self._loss
+        if isinstance(self._loss, (list, tuple)):
+            return [l * scaler.loss_scale for l in self._loss]
+        return self._loss * scaler.loss_scale
+
+    def __exit__(self, *exc):
+        return False
+
+
+def unscale(trainer):
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        return
+    scale = 1.0 / scaler.loss_scale
+    for p in trainer._params:
+        if p.grad_req != "null" and p._grad is not None:
+            p._grad._data = p._grad._data * scale
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16", target_dtype_ops=None,
+                         fp32_ops=None, conditional_fp32_ops=None,
+                         excluded_sym_names=None, device=None,
+                         cast_params_offline=False):
+    """Cast a HybridBlock for reduced-precision inference/training."""
+    dt = jnp.bfloat16 if str(target_dtype) in ("bfloat16", "bf16") else jnp.float16
+    block.cast(dt)
+    return block

@@ -1,0 +1,26 @@
+"""AMP op lists (parity: `python/mxnet/amp/lists/symbol_fp16.py` /
+`symbol_bf16.py`). On XLA these inform which ops run in reduced precision when
+tracing with a compute dtype; matmul/conv-class ops benefit (MXU), while
+reductions and normalisation statistics stay fp32."""
+
+# ops that should run in fp16/bf16 (MXU-bound)
+FP16_FUNCS = [
+    "fully_connected", "convolution", "deconvolution", "matmul", "dot",
+    "einsum", "interleaved_matmul_selfatt_qk", "interleaved_matmul_selfatt_valatt",
+    "interleaved_matmul_encdec_qk", "interleaved_matmul_encdec_valatt",
+    "multi_head_attention", "rnn",
+]
+
+# ops that must stay fp32 (numerics)
+FP32_FUNCS = [
+    "softmax", "log_softmax", "masked_softmax", "batch_norm", "layer_norm",
+    "group_norm", "instance_norm", "l2_normalization", "norm", "mean", "sum",
+    "var", "std", "exp", "log", "erfinv", "ctc_loss",
+]
+
+# ops safe in either precision
+FP16_FP32_FUNCS = [
+    "relu", "sigmoid", "tanh", "add", "subtract", "multiply", "maximum",
+    "minimum", "clip", "concatenate", "stack", "reshape", "transpose",
+    "dropout", "pooling", "embedding", "one_hot", "where",
+]

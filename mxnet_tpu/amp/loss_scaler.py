@@ -1,0 +1,33 @@
+"""Dynamic loss scaler (parity: `python/mxnet/amp/loss_scaler.py`)."""
+from __future__ import annotations
+
+import numpy as _onp
+
+
+class LossScaler:
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000, tolerance=0.05):
+        self.loss_scale = init_scale
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, params) -> bool:
+        """Check gradients for inf/nan; returns True if the step must be skipped."""
+        for p in params:
+            if p._grad is None:
+                continue
+            g = p._grad.asnumpy()
+            if not _onp.isfinite(g).all():
+                return True
+        return False
+
+    def update_scale(self, overflow: bool):
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0

@@ -1,0 +1,265 @@
+"""Autograd public API.
+
+Parity with the reference `python/mxnet/autograd.py`:
+`record`/`pause` (:121,145), `train_mode`/`predict_mode` (:165,180),
+`mark_variables` (:196), `backward` (:245), `grad` (:272), custom
+`Function` (:369). Implemented over the eager VJP tape in
+`mxnet_tpu/_tape.py` instead of the C++ Imperative recorder.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from . import _tape
+from .base import MXNetError
+from .ndarray.ndarray import ndarray, apply_op
+
+__all__ = [
+    "record", "pause", "train_mode", "predict_mode", "is_recording",
+    "is_training", "set_recording", "set_training", "mark_variables",
+    "backward", "grad", "Function",
+]
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record: Optional[bool], train_mode: Optional[bool]):
+        self._enter_is_record = is_record
+        self._enter_train_mode = train_mode
+        self._prev_is_record = None
+        self._prev_train_mode = None
+
+    def __enter__(self):
+        if self._enter_is_record is not None:
+            self._prev_is_record = _tape.set_recording(self._enter_is_record)
+        if self._enter_train_mode is not None:
+            self._prev_train_mode = _tape.set_training(self._enter_train_mode)
+        return self
+
+    def __exit__(self, *exc):
+        if self._enter_is_record is not None:
+            _tape.set_recording(self._prev_is_record)
+        if self._enter_train_mode is not None:
+            _tape.set_training(self._prev_train_mode)
+        return False
+
+
+def record(train_mode: bool = True):
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode: bool = False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+def is_recording() -> bool:
+    return _tape.is_recording()
+
+
+def is_training() -> bool:
+    return _tape.is_training()
+
+
+def set_recording(flag: bool) -> bool:
+    return _tape.set_recording(flag)
+
+
+def set_training(flag: bool) -> bool:
+    return _tape.set_training(flag)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    if isinstance(variables, ndarray):
+        variables, gradients = [variables], [gradients]
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, r in zip(variables, gradients, grad_reqs):
+        v._grad_req = r
+        v._grad = g
+        v._ag_node = None
+        v._ag_out_index = 0
+
+
+def _head_grads(heads, head_grads):
+    if head_grads is None:
+        out = []
+        for h in heads:
+            if h.size != 1:
+                # parity: backward on non-scalar head defaults to ones
+                out.append(jnp.ones(h.shape, h._data.dtype))
+            else:
+                out.append(jnp.ones(h.shape, h._data.dtype))
+        return out
+    gs = []
+    for h, g in zip(heads, head_grads):
+        if g is None:
+            gs.append(jnp.ones(h.shape, h._data.dtype))
+        else:
+            gs.append(g._data if isinstance(g, ndarray) else jnp.asarray(g))
+    return gs
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of heads w.r.t. attached variables; write `.grad`."""
+    if isinstance(heads, ndarray):
+        heads = [heads]
+        if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+            head_grads = [head_grads]
+    gs = _head_grads(heads, head_grads)
+    _tape.backward_on_heads(heads, gs, retain_graph=retain_graph)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Return gradients of heads w.r.t. variables (does not touch `.grad`).
+
+    Parity: `python/mxnet/autograd.py:272`. `create_graph` (higher-order) is
+    supported by re-recording the backward pass.
+    """
+    single = isinstance(variables, ndarray)
+    if isinstance(heads, ndarray):
+        heads = [heads]
+    if single:
+        variables = [variables]
+    if retain_graph is None:
+        retain_graph = create_graph
+
+    gs = _head_grads(heads, head_grads)
+    if create_graph:
+        outs = _replay_grad(heads, gs, variables)
+        return outs[0] if single else list(outs)
+
+    # temporarily mark variables so the walk reaches them
+    saved = [(v._grad_req, v._grad) for v in variables]
+    for v in variables:
+        if v._grad_req == "null":
+            v._grad_req = "write"
+    try:
+        result = _tape.backward_on_heads(
+            heads, gs, retain_graph=retain_graph,
+            accumulate_into_leaves=False)
+    finally:
+        for v, (req, g) in zip(variables, saved):
+            v._grad_req, v._grad = req, g
+
+    outs = []
+    for v in variables:
+        c = result.get(id(v))
+        if c is None:
+            raise MXNetError("one of the variables does not participate in "
+                             "the graph of heads")
+        w = ndarray(c, v._device, _no_copy=True)
+        outs.append(w)
+    return outs[0] if single else outs
+
+
+def _replay_grad(heads, head_grads, variables):
+    """Higher-order path: rebuild the recorded computation as a pure jax
+    function of the variables and differentiate with `jax.grad` — the result
+    goes back through `apply_op`, so it is itself recorded and can be
+    differentiated again (parity: re-recording backward graphs,
+    `src/imperative/imperative.cc` create_graph)."""
+    head_nodes = [h._ag_node for h in heads if h._ag_node is not None]
+    order = _tape._toposort(head_nodes)  # parents before children
+    for node in order:
+        if node.fwd_fn is None:
+            raise MXNetError(f"create_graph through op '{node.name}' is not "
+                             "supported (no functional forward recorded)")
+    var_index = {id(v): i for i, v in enumerate(variables)}
+
+    def total(*var_vals):
+        memo = {}
+
+        def value_of(pnode, pidx, parr):
+            if pnode is None:
+                i = var_index.get(id(parr))
+                return var_vals[i] if i is not None else parr._data
+            return memo[(id(pnode), pidx)]
+
+        for node in order:
+            pv = [value_of(*p) for p in node.parents]
+            outs = node.fwd_fn(*pv)
+            if not isinstance(outs, (tuple, list)):
+                outs = [outs]
+            for i, o in enumerate(outs):
+                memo[(id(node), i)] = o
+        acc = None
+        for h, g in zip(heads, head_grads):
+            hv = memo[(id(h._ag_node), h._ag_out_index)] \
+                if h._ag_node is not None else value_of(None, 0, h)
+            term = jnp.sum(hv * g)
+            acc = term if acc is None else acc + term
+        return acc
+
+    grad_fn = jax.grad(total, argnums=tuple(range(len(variables))))
+    res = apply_op(lambda *vv: grad_fn(*vv), list(variables), {}, name="grad")
+    if not isinstance(res, tuple):
+        res = (res,)
+    return res
+
+
+class Function:
+    """Custom differentiable function (parity: `python/mxnet/autograd.py:369`).
+
+    Subclass and implement `forward(self, *inputs)` and
+    `backward(self, *output_grads)`; tensors are `ndarray`s.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        with pause():
+            outputs = self.forward(*inputs)
+        is_multi = isinstance(outputs, (tuple, list))
+        outs = list(outputs) if is_multi else [outputs]
+
+        if _tape.is_recording():
+            diff_inputs = [x for x in inputs if isinstance(x, ndarray)]
+
+            def vjp_fn(cotangents):
+                cots = cotangents if isinstance(cotangents, (tuple, list)) else (cotangents,)
+                cot_nd = [ndarray(c, outs[0]._device, _no_copy=True) for c in cots]
+                with pause():
+                    in_grads = self.backward(*cot_nd)
+                if not isinstance(in_grads, (tuple, list)):
+                    in_grads = (in_grads,)
+                jax_grads = []
+                it = iter(in_grads)
+                for x in inputs:
+                    if isinstance(x, ndarray):
+                        g = next(it)
+                        jax_grads.append(g._data if isinstance(g, ndarray) else g)
+                return tuple(jax_grads)
+
+            out_avals = [(o.shape, o._data.dtype) for o in outs]
+            node = _tape.record_node(vjp_fn, diff_inputs, len(outs),
+                                     name=type(self).__name__,
+                                     out_avals=out_avals)
+            for i, o in enumerate(outs):
+                o._ag_node = node
+                o._ag_out_index = i
+        return outputs

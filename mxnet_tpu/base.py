@@ -1,0 +1,89 @@
+"""Foundation utilities: errors, registries, env-flag config.
+
+TPU-native re-design of the reference's dmlc-core foundations
+(`/root/reference/3rdparty` dmlc logging/registry/env, `include/mxnet/base.h`):
+instead of a C++ registry + env lookups scattered at point of use, we keep one
+typed flags module (see `mxnet_tpu.utils.config`) and a simple Python registry.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Generic, Optional, Type, TypeVar
+
+__all__ = ["MXNetError", "Registry", "getenv_bool", "getenv_int", "classproperty"]
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (parity: dmlc::Error / MXNetError)."""
+
+
+T = TypeVar("T")
+
+
+class Registry(Generic[T]):
+    """Name -> object registry with decorator registration.
+
+    Parity: the reference registers operators, optimizers, initializers and
+    kvstores through dmlc registries (e.g. optimizer registry at
+    `python/mxnet/optimizer/optimizer.py`); this is the single Python-native
+    equivalent used across the package.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self._store: Dict[str, T] = {}
+
+    def register(self, obj: Optional[T] = None, name: Optional[str] = None, *, aliases=()):
+        def _do(o, nm):
+            key = (nm or getattr(o, "__name__", None) or str(o)).lower()
+            self._store[key] = o
+            for a in aliases:
+                self._store[a.lower()] = o
+            return o
+
+        if obj is None:
+            return lambda o: _do(o, name)
+        return _do(obj, name)
+
+    def get(self, name: str) -> T:
+        key = name.lower()
+        if key not in self._store:
+            raise MXNetError(
+                f"{self.name} '{name}' is not registered. "
+                f"Available: {sorted(self._store)}"
+            )
+        return self._store[key]
+
+    def find(self, name: str) -> Optional[T]:
+        return self._store.get(name.lower())
+
+    def list(self):
+        return sorted(self._store)
+
+    def __contains__(self, name: str) -> bool:
+        return name.lower() in self._store
+
+
+def getenv_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.lower() in ("1", "true", "yes", "on")
+
+
+def getenv_int(name: str, default: int = 0) -> int:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        return default
+
+
+class classproperty:
+    def __init__(self, fget: Callable[[Any], Any]):
+        self.fget = fget
+
+    def __get__(self, obj, owner):
+        return self.fget(owner)

@@ -1,0 +1,47 @@
+"""Execution-engine facade.
+
+The reference's dependency engine (`src/engine/threaded_engine.cc`,
+`include/mxnet/engine.h:253-437`) schedules every op asynchronously with
+read/write variable lists. On TPU, XLA/PjRt *is* the engine: dispatch is async,
+ordering is dataflow, exceptions surface at synchronisation. This module keeps
+the user-facing control surface (`waitall`, bulking knobs, engine-type query)
+as no-op/diagnostic parity.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from .utils.config import flags
+
+__all__ = ["waitall", "engine_type", "bulk", "set_bulk_size"]
+
+
+def waitall():
+    """Barrier over outstanding async work (parity: `Engine::WaitForAll`)."""
+    jax.effects_barrier()
+
+
+def engine_type() -> str:
+    return flags.engine_type  # 'xla'
+
+
+_bulk_size = [15]
+
+
+def set_bulk_size(size: int) -> int:
+    """Parity: `mx.engine.set_bulk_size` / MXNET_EXEC_BULK_EXEC_*; XLA fuses
+    at compile time so this only records the setting."""
+    prev = _bulk_size[0]
+    _bulk_size[0] = size
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size: int):
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)

@@ -1,0 +1,12 @@
+"""`mx.gluon` (parity: `python/mxnet/gluon/__init__.py`)."""
+from . import block
+from .block import Block, HybridBlock, SymbolBlock
+from .parameter import Parameter, Constant, DeferredInitializationError
+from . import nn
+from . import rnn
+from . import loss
+from . import metric
+from . import data
+from . import utils
+from .trainer import Trainer
+from . import model_zoo

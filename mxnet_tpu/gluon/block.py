@@ -1,0 +1,591 @@
+"""Gluon `Block` / `HybridBlock` (parity: `python/mxnet/gluon/block.py:202,1006`).
+
+Hybridization, TPU-native: the reference traces the user's `forward` under
+deferred compute into an NNVM graph and executes it through `CachedOp`
+(`block.py:1105,1231`; `src/imperative/cached_op.cc`). Here `hybridize()`
+traces the same `forward` under `jax.jit` — tracing *is* deferred compute —
+and the compiled XLA executable plays the role of CachedOp (fusion, static
+memory plan, async dispatch all come from XLA). Parity details:
+
+- first call after `hybridize()` runs eagerly (finishing deferred shape
+  inference, like `_build_cache`), subsequent calls hit the jit cache;
+- a hybridized block records ONE autograd tape node whose vjp is the vjp of
+  the whole compiled function (parity: `_CachedOp` backward);
+- in-place parameter mutations during forward (BatchNorm running stats) are
+  detected at trace time and returned as explicit aux outputs, then written
+  back — the XLA-side equivalent of the reference's mutable aux states;
+- `static_alloc`/`static_shape` map to XLA's static buffer planning (always
+  on) and are accepted for API compatibility.
+"""
+from __future__ import annotations
+
+import contextlib
+import re
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..device import Device, current_device
+from ..ndarray.ndarray import ndarray, from_jax, is_tracer
+from .. import _tape
+from .. import random as _rng
+from ..util import save_arrays, load_arrays
+from .parameter import Parameter, Constant, DeferredInitializationError
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "nn_block_doc"]
+
+_amp_dtype = [None]  # set by mxnet_tpu.amp.init()
+
+
+class _HookHandle:
+    def __init__(self, hooks: "OrderedDict", key: int):
+        self._hooks, self._key = hooks, key
+
+    def detach(self):
+        self._hooks.pop(self._key, None)
+
+
+class Block:
+    """Base class for all neural network layers and models."""
+
+    def __init__(self, prefix=None, params=None):
+        # NOTE: use object.__setattr__-safe ordering: these dicts must exist
+        # before any attribute assignment triggers registration
+        self.__dict__["_children"] = OrderedDict()
+        self.__dict__["_reg_params"] = OrderedDict()
+        self.__dict__["_forward_hooks"] = OrderedDict()
+        self.__dict__["_forward_pre_hooks"] = OrderedDict()
+
+    # -- registration --------------------------------------------------------
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            self._children[name] = value
+        elif isinstance(value, Parameter):
+            if value._name == "weight" and name != "weight":
+                value._name = name  # adopt the attribute name
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block: "Block", name: Optional[str] = None):
+        name = name or str(len(self._children))
+        self._children[name] = block
+        self.__dict__[name] = block
+
+    def register_block(self, name, block):
+        self.register_child(block, name)
+
+    # -- params --------------------------------------------------------------
+    @property
+    def params(self) -> Dict[str, Parameter]:
+        return dict(self._reg_params)
+
+    def collect_params(self, select: Optional[str] = None) -> Dict[str, Parameter]:
+        """Structure-named parameter dict (parity: Block.collect_params)."""
+        out: "OrderedDict[str, Parameter]" = OrderedDict()
+        self._collect(out, "")
+        if select is not None:
+            pat = re.compile(select)
+            out = OrderedDict((k, v) for k, v in out.items() if pat.search(k))
+        return out
+
+    def _collect(self, out, prefix):
+        for name, p in self._reg_params.items():
+            key = prefix + name
+            p._structure_key = key
+            out[key] = p
+        for cname, child in self._children.items():
+            child._collect(out, prefix + cname + ".")
+
+    def initialize(self, init=None, device=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        from .. import initializer as _init
+        device = device or ctx
+        default = _init.Uniform()
+        for name, p in self.collect_params().items():
+            p.initialize(init=None if p.init is not None else init,
+                         device=device, default_init=init or default,
+                         force_reinit=force_reinit)
+        return self
+
+    def cast(self, dtype):
+        for p in self.collect_params().values():
+            p.cast(dtype)
+        for child in self._children.values():
+            pass  # collect_params already recursed
+        self._on_cast(jnp.dtype(dtype))
+        return self
+
+    def _on_cast(self, dtype):
+        for c in self._children.values():
+            c._on_cast(dtype)
+
+    def zero_grad(self):
+        for p in self.collect_params().values():
+            p.zero_grad()
+
+    def reset_device(self, device):
+        for p in self.collect_params().values():
+            p.reset_device(device)
+
+    reset_ctx = reset_device
+
+    def apply(self, fn: Callable[["Block"], Any]):
+        for c in self._children.values():
+            c.apply(fn)
+        fn(self)
+        return self
+
+    def setattr(self, name, value):
+        for p in self.collect_params().values():
+            setattr(p, name, value)
+
+    def share_parameters(self, shared: Dict[str, Parameter]):
+        own = self.collect_params()
+        for k, v in shared.items():
+            if k in own:
+                tgt = own[k]
+                tgt._data = v._data
+                tgt._shape = v._shape
+        return self
+
+    # -- persistence ---------------------------------------------------------
+    def save_parameters(self, filename: str, deduplicate: bool = False):
+        """Parity: `gluon/block.py:340` (NDArray-dict format → .npz here)."""
+        arrays = {}
+        for name, p in self.collect_params().items():
+            if p._data is not None:
+                arrays[name] = p.data()
+        save_arrays(filename, arrays)
+
+    def load_parameters(self, filename: str, device=None, ctx=None,
+                        allow_missing=False, ignore_extra=False,
+                        cast_dtype=False, dtype_source="current"):
+        """Parity: `gluon/block.py:379`."""
+        loaded = load_arrays(filename)
+        params = self.collect_params()
+        for name, p in params.items():
+            if name not in loaded:
+                if not allow_missing:
+                    raise MXNetError(f"parameter {name} missing in {filename}")
+                continue
+            p.set_data(loaded[name])
+        if not ignore_extra:
+            extra = set(loaded) - set(params)
+            if extra:
+                raise MXNetError(f"file {filename} has extra parameters "
+                                 f"{sorted(extra)}")
+        self._invalidate_cache()
+        return self
+
+    def load_dict(self, param_dict, device=None, allow_missing=False,
+                  ignore_extra=False, cast_dtype=False):
+        params = self.collect_params()
+        for name, p in params.items():
+            if name in param_dict:
+                v = param_dict[name]
+                p.set_data(v.data() if isinstance(v, Parameter) else v)
+            elif not allow_missing:
+                raise MXNetError(f"parameter {name} missing")
+        self._invalidate_cache()
+        return self
+
+    def _invalidate_cache(self):
+        for c in self._children.values():
+            c._invalidate_cache()
+
+    # -- hooks ---------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        key = len(self._forward_pre_hooks)
+        self._forward_pre_hooks[key] = hook
+        return _HookHandle(self._forward_pre_hooks, key)
+
+    def register_forward_hook(self, hook):
+        key = len(self._forward_hooks)
+        self._forward_hooks[key] = hook
+        return _HookHandle(self._forward_hooks, key)
+
+    def register_op_hook(self, callback, monitor_all=False):
+        raise MXNetError("register_op_hook is not supported on the XLA "
+                         "runtime (per-op interception is fused away); use "
+                         "mx.profiler or eager mode debugging instead")
+
+    # -- call ----------------------------------------------------------------
+    def _maybe_infer_shapes(self, *args):
+        """Run this block's `infer_shape` if it still has deferred params."""
+        deferred = [p for p in self._reg_params.values()
+                    if p._deferred_init is not None]
+        if deferred:
+            if hasattr(self, "infer_shape"):
+                self.infer_shape(*args)
+                for p in deferred:
+                    p._finish_deferred_init()
+            else:
+                raise DeferredInitializationError(
+                    f"{type(self).__name__} has deferred parameters but no "
+                    "infer_shape method")
+
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            hook(self, args)
+        self._maybe_infer_shapes(*args)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks.values():
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    # -- misc ----------------------------------------------------------------
+    def hybridize(self, active=True, **kwargs):
+        for c in self._children.values():
+            c.hybridize(active, **kwargs)
+
+    def summary(self, *inputs):
+        lines = [f"{type(self).__name__}:"]
+        for name, p in self.collect_params().items():
+            lines.append(f"  {name}: {p.shape} {jnp.dtype(p.dtype).name}")
+        print("\n".join(lines))
+
+    def __repr__(self):
+        s = f"{type(self).__name__}("
+        for name, child in self._children.items():
+            s += f"\n  ({name}): {child!r}".replace("\n", "\n  ")
+        return s + ("\n)" if self._children else ")")
+
+
+def _flatten_args(args, kwargs):
+    """Split ndarray leaves (dynamic) from static structure."""
+    leaves = []
+
+    def strip(x):
+        if isinstance(x, ndarray):
+            leaves.append(x)
+            return _Slot(len(leaves) - 1)
+        if isinstance(x, (list, tuple)):
+            return type(x)(strip(i) for i in x)
+        if isinstance(x, dict):
+            return {k: strip(v) for k, v in x.items()}
+        return x
+
+    struct = strip((args, kwargs))
+    return leaves, struct
+
+
+class _Slot:
+    __slots__ = ("i",)
+
+    def __init__(self, i):
+        self.i = i
+
+    def __eq__(self, o):
+        return isinstance(o, _Slot) and o.i == self.i
+
+    def __hash__(self):
+        return hash(("_slot", self.i))
+
+
+def _rebuild_args(struct, leaves):
+    def fill(x):
+        if isinstance(x, _Slot):
+            return leaves[x.i]
+        if isinstance(x, (list, tuple)):
+            return type(x)(fill(i) for i in x)
+        if isinstance(x, dict):
+            return {k: fill(v) for k, v in x.items()}
+        return x
+    return fill(struct)
+
+
+def _struct_key(struct):
+    def freeze(x):
+        if isinstance(x, (list, tuple)):
+            return tuple(freeze(i) for i in x)
+        if isinstance(x, dict):
+            return tuple(sorted((k, freeze(v)) for k, v in x.items()))
+        return x
+    try:
+        return hash(freeze(struct))
+    except TypeError:
+        return None  # unhashable static arg: fall back to eager
+
+
+class HybridBlock(Block):
+    """A Block compilable to a single XLA computation."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self.__dict__["_active"] = False
+        self.__dict__["_jit_cache"] = {}
+        self.__dict__["_warmed_up"] = False
+        self.__dict__["_flags"] = {}
+
+    def hybridize(self, active=True, static_alloc=True, static_shape=True,
+                  backend=None, backend_opts=None, inline_limit=2,
+                  forward_bulk_size=None, backward_bulk_size=None, **kwargs):
+        """Parity: `gluon/block.py:1389`; flags map to XLA (always-static)."""
+        self.__dict__["_active"] = active
+        self.__dict__["_flags"] = {"static_alloc": static_alloc,
+                                   "static_shape": static_shape}
+        self._invalidate_cache()
+        for c in self._children.values():
+            if isinstance(c, HybridBlock):
+                # children run inside the parent's trace: deactivate their
+                # own caches (parity: inlined subgraphs)
+                c.hybridize(False, **kwargs)
+            else:
+                c.hybridize(active, **kwargs)
+        self.__dict__["_active"] = active
+        return self
+
+    def optimize_for(self, x, *args, backend=None, clear=True, **kwargs):
+        """Parity: `gluon/block.py:1282` — compile eagerly for given input."""
+        self.hybridize(True, **kwargs)
+        return self(x, *args)
+
+    def _invalidate_cache(self):
+        self.__dict__["_jit_cache"] = {}
+        self.__dict__["_warmed_up"] = False
+        super()._invalidate_cache()
+
+    # -- jit machinery -------------------------------------------------------
+    def _param_list(self) -> List[Tuple[str, Parameter]]:
+        return list(self.collect_params().items())
+
+    def _make_jit_fn(self, training: bool, struct, n_leaves: int,
+                     param_names: List[str], params: Dict[str, Parameter]):
+        block = self
+
+        def fn(key, pvals: Dict[str, Any], *leaf_vals):
+            saved = {}
+            for name in param_names:
+                p = params[name]
+                saved[name] = p._data._data
+                p._data._data = pvals[name]
+            prev_rec = _tape.set_recording(False)
+            prev_train = _tape.set_training(training)
+            try:
+                with _rng.key_scope(key):
+                    leaves = [from_jax(v, current_device()) for v in leaf_vals]
+                    args, kwargs = _rebuild_args(struct, leaves)
+                    out = block.forward(*args, **kwargs)
+                    aux = {}
+                    for name in param_names:
+                        cur = params[name]._data._data
+                        if cur is not pvals[name]:
+                            aux[name] = jax.lax.stop_gradient(cur)
+            finally:
+                for name in param_names:
+                    params[name]._data._data = saved[name]
+                _tape.set_recording(prev_rec)
+                _tape.set_training(prev_train)
+
+            out_leaves, out_def = jax.tree_util.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, ndarray))
+            out_vals = [o._data if isinstance(o, ndarray) else jnp.asarray(o)
+                        for o in out_leaves]
+            fn._out_def = out_def
+            return tuple(out_vals), aux
+
+        return jax.jit(fn), fn
+
+    def _call_cached_op(self, *args, **kwargs):
+        leaves, struct = _flatten_args(args, kwargs)
+        skey = _struct_key(struct)
+        training = _tape.is_training()
+        if skey is None:
+            return self.forward(*args, **kwargs)
+        cache_key = (training, skey, len(leaves))
+        entry = self._jit_cache.get(cache_key)
+        if entry is None:
+            all_params = dict(self._param_list())
+            params = {n: p for n, p in all_params.items()
+                      if p._data is not None}
+            pnames = list(params)
+            jitted, raw = self._make_jit_fn(training, struct, len(leaves),
+                                            pnames, params)
+            entry = {"jit": jitted, "raw": raw, "pnames": pnames,
+                     "params": params}
+            self._jit_cache[cache_key] = entry
+
+        pnames = entry["pnames"]
+        params = entry["params"]
+        pvals = {n: params[n]._data._data for n in pnames}
+        leaf_vals = [l._data for l in leaves]
+        key = _rng.next_key()
+        jitted = entry["jit"]
+
+        recording = _tape.is_recording()
+        diff_pnames = [n for n in pnames
+                       if params[n]._data._grad_req != "null"
+                       and jnp.issubdtype(jnp.result_type(pvals[n]), jnp.inexact)]
+        diff_leaf_idx = [i for i, l in enumerate(leaves)
+                         if (l._ag_node is not None or l._grad_req != "null")
+                         and jnp.issubdtype(jnp.result_type(l._data), jnp.inexact)]
+
+        if recording and (diff_pnames or diff_leaf_idx):
+            static_pvals = {n: v for n, v in pvals.items()
+                            if n not in diff_pnames}
+
+            def diff_fn(dvals, *dleaves):
+                pv = dict(static_pvals)
+                pv.update(dvals)
+                lv = list(leaf_vals)
+                for i, v in zip(diff_leaf_idx, dleaves):
+                    lv[i] = v
+                return jitted(key, pv, *lv)
+
+            dvals = {n: pvals[n] for n in diff_pnames}
+            dleaves = [leaf_vals[i] for i in diff_leaf_idx]
+            (out_vals, aux), vjp_fn = jax.vjp(diff_fn, dvals, *dleaves)
+
+            parent_arrays = [params[n]._data for n in diff_pnames] + \
+                [leaves[i] for i in diff_leaf_idx]
+
+            n_out = len(out_vals)
+            aux_items = sorted(aux.items())
+            flat_all = list(out_vals) + [v for _, v in aux_items]
+            out_avals = [(tuple(v.shape), v.dtype) for v in flat_all]
+
+            def node_vjp(cotangents):
+                cots = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+                cot_out = tuple(cots[:n_out])
+                cot_aux = {k: jnp.zeros(v.shape, v.dtype)
+                           for k, v in aux_items}
+                grads = vjp_fn((cot_out, cot_aux))
+                dparams = grads[0]
+                dleaves_ = grads[1:]
+                return tuple(dparams[n] for n in diff_pnames) + tuple(dleaves_)
+
+            node = _tape.record_node(node_vjp, parent_arrays,
+                                     len(flat_all), name=type(self).__name__,
+                                     out_avals=out_avals)
+            wrapped = []
+            for i, v in enumerate(out_vals):
+                w = from_jax(v, leaves[0]._device if leaves else current_device())
+                if jnp.issubdtype(v.dtype, jnp.inexact):
+                    w._ag_node = node
+                    w._ag_out_index = i
+                wrapped.append(w)
+        else:
+            out_vals, aux = jitted(key, pvals, *leaf_vals)
+            dev = leaves[0]._device if leaves else current_device()
+            wrapped = [from_jax(v, dev) for v in out_vals]
+
+        # write back aux (running stats) updates
+        for name, v in aux.items():
+            params[name]._data._data = v
+
+        out_def = entry["raw"]._out_def
+        out = jax.tree_util.tree_unflatten(out_def, wrapped)
+        return out
+
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            hook(self, args)
+        if args:
+            self.__dict__["_example_input"] = args
+        if self._active and not is_tracer(
+                args[0]._data if args and isinstance(args[0], ndarray) else None):
+            if not self._warmed_up:
+                # first call: eager pass finishes deferred init (parity:
+                # _build_cache's deferred shape inference)
+                out = self._eager_forward(*args, **kwargs)
+                self.__dict__["_warmed_up"] = True
+            else:
+                out = self._call_cached_op(*args, **kwargs)
+        else:
+            out = self._eager_forward(*args, **kwargs)
+        for hook in self._forward_hooks.values():
+            hook(self, args, out)
+        return out
+
+    def _eager_forward(self, *args, **kwargs):
+        self._maybe_infer_shapes(*args)
+        return self.forward(*args, **kwargs)
+
+    # -- export --------------------------------------------------------------
+    def export(self, path: str, epoch: int = 0, remove_amp_cast=True):
+        """Serialize compiled graph + params (parity: `gluon/block.py:1481`,
+        symbol-json+params → StableHLO + npz)."""
+        import jax.export as jexport
+
+        params = {n: p for n, p in self.collect_params().items()
+                  if p._data is not None}
+        pvals = {n: p._data._data for n, p in params.items()}
+        example = getattr(self, "_example_input", None)
+        if example is None:
+            raise MXNetError("export requires at least one prior forward "
+                             "call or set block._example_input")
+        leaves, struct = _flatten_args((example,), {}) \
+            if not isinstance(example, tuple) else _flatten_args(example, {})
+
+        def fn(pvals, *leaf_vals):
+            lv = [from_jax(v, current_device()) for v in leaf_vals]
+            args, kwargs = _rebuild_args(struct, lv)
+            out = self.forward(*args, **kwargs)
+            out_leaves, _ = jax.tree_util.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, ndarray))
+            return tuple(o._data for o in out_leaves)
+
+        exp = jexport.export(jax.jit(fn))(
+            pvals, *[l._data for l in leaves])
+        with open(f"{path}-symbol.stablehlo", "wb") as f:
+            f.write(exp.serialize())
+        save_arrays(f"{path}-{epoch:04d}.params",
+                    {n: p.data() for n, p in params.items()})
+        return f"{path}-symbol.stablehlo", f"{path}-{epoch:04d}.params"
+
+    def infer_shape(self, *args):
+        """Subclasses with deferred params override; default no-op."""
+        return
+
+    def _maybe_infer_shapes(self, *args):
+        deferred = [p for p in self._reg_params.values()
+                    if p._deferred_init is not None]
+        if deferred:
+            self.infer_shape(*args)
+            for p in deferred:
+                p._finish_deferred_init()
+
+
+class SymbolBlock(HybridBlock):
+    """Run a previously exported computation (parity: `gluon/block.py:1655`).
+
+    Construct with `SymbolBlock.imports(symbol_file, input_names, param_file)`.
+    """
+
+    def __init__(self, exported, param_arrays: Dict[str, ndarray]):
+        super().__init__()
+        self.__dict__["_exported"] = exported
+        self.__dict__["_param_order"] = list(param_arrays)
+        for n, a in param_arrays.items():
+            p = Parameter(name=n, shape=a.shape, dtype=a.dtype)
+            p.set_data(a)
+            self._reg_params[n.replace(".", "_")] = p
+            p._structure_key = n
+
+    @staticmethod
+    def imports(symbol_file: str, input_names=None, param_file: str = None,
+                device=None, ctx=None):
+        import jax.export as jexport
+        with open(symbol_file, "rb") as f:
+            exported = jexport.deserialize(f.read())
+        params = load_arrays(param_file) if param_file else {}
+        return SymbolBlock(exported, params)
+
+    def forward(self, *args):
+        params = {p._structure_key: p for p in self._reg_params.values()}
+        pvals = {n: params[n].data()._data for n in self._param_order}
+        leaf_vals = [a._data for a in args]
+        out = self._exported.call(pvals, *leaf_vals)
+        dev = args[0]._device if args else current_device()
+        outs = [from_jax(o, dev) for o in out]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def nn_block_doc(cls):
+    return cls

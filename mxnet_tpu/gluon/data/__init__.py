@@ -1,0 +1,8 @@
+"""`mx.gluon.data` (parity: `python/mxnet/gluon/data/`)."""
+from . import vision
+from . import batchify
+from .dataset import (Dataset, SimpleDataset, ArrayDataset,
+                      RecordFileDataset)
+from .sampler import (Sampler, SequentialSampler, RandomSampler,
+                      BatchSampler, FilterSampler, IntervalSampler)
+from .dataloader import DataLoader, default_batchify_fn

@@ -1,0 +1,102 @@
+"""DataLoader (parity: `python/mxnet/gluon/data/dataloader.py:514`).
+
+The reference forks worker *processes* and ships NDArrays through shared
+memory (`cpu_shared_storage_manager.h`, ForkingPickler at dataloader.py:67-93)
+because Python-side decode is GIL-bound. Here workers are a thread pool:
+decode/augment executes NumPy/PIL code that releases the GIL, JAX runtimes are
+not fork-safe, and the produced batch is handed to `jax.device_put` for an
+async H2D copy — the prefetch-overlap role of the reference's pinned-memory +
+copy-stream path.
+"""
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Optional
+
+import numpy as _onp
+
+from ...base import MXNetError
+from ...device import Device
+from ...ndarray.ndarray import ndarray
+from .dataset import Dataset
+from .sampler import BatchSampler, RandomSampler, SequentialSampler, Sampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (parity: dataloader.py default_batchify_fn)."""
+    from ... import numpy as mnp
+    elem = data[0]
+    if isinstance(elem, ndarray):
+        return mnp.stack(data)
+    if isinstance(elem, (tuple, list)):
+        return type(elem)(default_batchify_fn([d[i] for d in data])
+                          for i in range(len(elem)))
+    arr = _onp.asarray(data)
+    return mnp.array(arr)
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, batch_size=None, shuffle=False,
+                 sampler: Optional[Sampler] = None, last_batch=None,
+                 batch_sampler: Optional[BatchSampler] = None,
+                 batchify_fn: Optional[Callable] = None, num_workers=0,
+                 pin_memory=False, pin_device_id=0, prefetch=None,
+                 thread_pool=True, timeout=120, try_nopython=None,
+                 auto_reload=False):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise MXNetError("batch_size required when batch_sampler "
+                                 "is not given")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else \
+                    SequentialSampler(len(dataset))
+            elif shuffle:
+                raise MXNetError("shuffle must be False with explicit sampler")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = num_workers
+        self._prefetch = max(0, prefetch or 2 * max(num_workers, 1))
+        self._pool = ThreadPoolExecutor(max_workers=num_workers) \
+            if num_workers > 0 else None
+
+    def _make_batch(self, indices):
+        samples = [self._dataset[i] for i in indices]
+        return self._batchify_fn(samples)
+
+    def __iter__(self):
+        if self._pool is None:
+            for indices in self._batch_sampler:
+                yield self._make_batch(indices)
+            return
+        # windowed prefetch over the thread pool
+        import collections
+        queue = collections.deque()
+        it = iter(self._batch_sampler)
+
+        def submit():
+            try:
+                indices = next(it)
+            except StopIteration:
+                return False
+            queue.append(self._pool.submit(self._make_batch, indices))
+            return True
+
+        for _ in range(self._prefetch):
+            if not submit():
+                break
+        while queue:
+            fut = queue.popleft()
+            submit()
+            yield fut.result()
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def __del__(self):
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)

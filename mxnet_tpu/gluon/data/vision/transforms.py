@@ -1,0 +1,148 @@
+"""Vision transforms (parity: `python/mxnet/gluon/data/vision/transforms.py`).
+
+Transforms are HybridBlocks operating on HWC images (like the reference);
+`ToTensor` converts to CHW float32 scaled to [0,1].
+"""
+from __future__ import annotations
+
+import numpy as _onp
+
+from ....base import MXNetError
+from ....ndarray.ndarray import ndarray
+from .... import numpy as _np
+from ....image import (imresize, center_crop, random_crop, color_normalize,
+                       resize_short)
+from ...block import Block, HybridBlock
+from ...nn.basic_layers import Sequential, HybridSequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
+           "CropResize"]
+
+
+class Compose(Sequential):
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def forward(self, x):
+        return x.astype(self._dtype)
+
+
+class ToTensor(HybridBlock):
+    def forward(self, x):
+        x = x.astype("float32") / 255.0
+        if x.ndim == 3:
+            return x.transpose(2, 0, 1)
+        return x.transpose(0, 3, 1, 2)
+
+
+class Normalize(HybridBlock):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = mean
+        self._std = std
+
+    def forward(self, x):
+        mean = _onp.asarray(self._mean, dtype=_onp.float32)
+        std = _onp.asarray(self._std, dtype=_onp.float32)
+        shape = (-1, 1, 1) if x.ndim == 3 else (1, -1, 1, 1)
+        return (x - _np.array(mean.reshape(shape))) / \
+            _np.array(std.reshape(shape))
+
+
+class Resize(HybridBlock):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+        self._keep = keep_ratio
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        if isinstance(self._size, int):
+            if self._keep:
+                return resize_short(x, self._size, self._interpolation)
+            return imresize(x, self._size, self._size, self._interpolation)
+        w, h = self._size
+        return imresize(x, w, h, self._interpolation)
+
+
+class CenterCrop(HybridBlock):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size, size)
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        return center_crop(x, self._size, self._interpolation)[0]
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size, size)
+        self._scale = scale
+        self._ratio = ratio
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        h, w = x.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = _onp.random.uniform(*self._scale) * area
+            aspect = _onp.random.uniform(*self._ratio)
+            nw = int(round(_onp.sqrt(target_area * aspect)))
+            nh = int(round(_onp.sqrt(target_area / aspect)))
+            if nw <= w and nh <= h:
+                x0 = _onp.random.randint(0, w - nw + 1)
+                y0 = _onp.random.randint(0, h - nh + 1)
+                patch = x[y0:y0 + nh, x0:x0 + nw]
+                return imresize(patch, self._size[0], self._size[1],
+                                self._interpolation)
+        return center_crop(x, self._size, self._interpolation)[0]
+
+
+class RandomFlipLeftRight(Block):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        if _onp.random.rand() < self._p:
+            return _np.flip(x, axis=1)
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        if _onp.random.rand() < self._p:
+            return _np.flip(x, axis=0)
+        return x
+
+
+class CropResize(HybridBlock):
+    def __init__(self, x, y, width, height, size=None, interpolation=1):
+        super().__init__()
+        self._x, self._y = x, y
+        self._w, self._h = width, height
+        self._size = size
+        self._interpolation = interpolation
+
+    def forward(self, data):
+        out = data[self._y:self._y + self._h, self._x:self._x + self._w]
+        if self._size:
+            out = imresize(out, self._size[0], self._size[1],
+                           self._interpolation)
+        return out

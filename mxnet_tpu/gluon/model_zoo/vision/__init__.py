@@ -1,0 +1,27 @@
+"""`mx.gluon.model_zoo.vision` (parity:
+`python/mxnet/gluon/model_zoo/vision/__init__.py:91` `get_model`)."""
+from ....base import MXNetError
+from .resnet import *  # noqa: F401,F403
+from .resnet import __all__ as _resnet_all
+from .others import *  # noqa: F401,F403
+from .others import __all__ as _others_all
+
+from . import resnet as _resnet_mod
+from . import others as _others_mod
+
+_models = {}
+for _mod in (_resnet_mod, _others_mod):
+    for _name in _mod.__all__:
+        _obj = getattr(_mod, _name)
+        if callable(_obj) and _name[0].islower():
+            _models[_name] = _obj
+
+
+def get_model(name, **kwargs):
+    """Create a model by name (parity: vision/__init__.py:91)."""
+    name = name.lower()
+    if name not in _models:
+        raise MXNetError(
+            f"model {name!r} is not in the zoo; available: "
+            f"{sorted(_models)}")
+    return _models[name](**kwargs)

@@ -1,0 +1,17 @@
+"""`mx.gluon.nn` (parity: `python/mxnet/gluon/nn/`)."""
+from ..block import Block, HybridBlock, SymbolBlock
+from .basic_layers import (Sequential, HybridSequential, Dense, Dropout,
+                           Embedding, BatchNorm, SyncBatchNorm, LayerNorm,
+                           GroupNorm, InstanceNorm, Flatten, Lambda,
+                           HybridLambda, Concatenate, HybridConcatenate,
+                           Identity, Activation)
+from .conv_layers import (Conv1D, Conv2D, Conv3D, Conv1DTranspose,
+                          Conv2DTranspose, Conv3DTranspose, MaxPool1D,
+                          MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D,
+                          AvgPool3D, GlobalMaxPool1D, GlobalMaxPool2D,
+                          GlobalMaxPool3D, GlobalAvgPool1D, GlobalAvgPool2D,
+                          GlobalAvgPool3D, ReflectionPad2D, PixelShuffle1D,
+                          PixelShuffle2D, PixelShuffle3D,
+                          DeformableConvolution,
+                          ModulatedDeformableConvolution)
+from .activations import LeakyReLU, PReLU, ELU, SELU, GELU, Swish, SiLU

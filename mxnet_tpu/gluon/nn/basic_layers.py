@@ -1,0 +1,357 @@
+"""Basic Gluon layers (parity: `python/mxnet/gluon/nn/basic_layers.py`)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ...base import MXNetError
+from ...ndarray.ndarray import ndarray
+from ... import numpy_extension as npx
+from ... import numpy as _np
+from ..block import Block, HybridBlock
+from ..parameter import Parameter, Constant
+
+__all__ = [
+    "Sequential", "HybridSequential", "Dense", "Dropout", "Embedding",
+    "BatchNorm", "SyncBatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm",
+    "Flatten", "Lambda", "HybridLambda", "Concatenate", "HybridConcatenate",
+    "Identity", "Activation",
+]
+
+
+class _SequentialMixin:
+    """Shared add/forward/indexing for Sequential and HybridSequential."""
+
+    def _seq_init(self, blocks):
+        for b in blocks:
+            self.add(b)
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+        return self
+
+    def forward(self, x, *args):
+        for b in self._children.values():
+            x = b(x, *args)
+            args = ()
+        return x
+
+    def __getitem__(self, key):
+        items = list(self._children.values())
+        if isinstance(key, slice):
+            net = type(self)()
+            for b in items[key]:
+                net.add(b)
+            return net
+        return items[key]
+
+    def __len__(self):
+        return len(self._children)
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class Sequential(_SequentialMixin, Block):
+    """Stack of blocks (parity: basic_layers.py Sequential)."""
+
+    def __init__(self, *blocks):
+        super().__init__()
+        self._seq_init(blocks)
+
+
+class HybridSequential(_SequentialMixin, HybridBlock):
+    def __init__(self, *blocks):
+        super().__init__()
+        self._seq_init(blocks)
+
+
+class Dense(HybridBlock):
+    """Fully-connected layer (parity: basic_layers.py Dense over
+    `src/operator/nn/fully_connected.cc:251`); weight (units, in_units)."""
+
+    def __init__(self, units, activation=None, use_bias=True, flatten=True,
+                 dtype="float32", weight_initializer=None,
+                 bias_initializer="zeros", in_units=0, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._flatten = flatten
+        self._activation = activation
+        self.act = Activation(activation) if activation else None
+        self.weight = Parameter("weight", shape=(units, in_units),
+                                dtype=dtype, init=weight_initializer,
+                                allow_deferred_init=True)
+        self.bias = Parameter("bias", shape=(units,), dtype=dtype,
+                              init=bias_initializer,
+                              allow_deferred_init=True) if use_bias else None
+
+    def infer_shape(self, x, *args):
+        import numpy as _onp
+        in_units = x.shape[-1] if not self._flatten else \
+            int(_onp.prod(x.shape[1:]))
+        self.weight.shape = (self._units, in_units)
+        if self.bias is not None:
+            self.bias.shape = (self._units,)
+
+    def forward(self, x):
+        out = npx.fully_connected(x, self.weight.data(),
+                                  self.bias.data() if self.bias is not None
+                                  else None,
+                                  num_hidden=self._units,
+                                  no_bias=self.bias is None,
+                                  flatten=self._flatten)
+        if self.act is not None:
+            out = self.act(out)
+        return out
+
+    def __repr__(self):
+        return (f"Dense({self.weight.shape[1] or None} -> {self._units}, "
+                f"{self._activation})")
+
+
+class Activation(HybridBlock):
+    def __init__(self, activation, **kwargs):
+        super().__init__(**kwargs)
+        self._act = activation
+
+    def forward(self, x):
+        return npx.activation(x, act_type=self._act)
+
+    def __repr__(self):
+        return f"Activation({self._act})"
+
+
+class Dropout(HybridBlock):
+    def __init__(self, rate, axes=(), **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+        self._axes = axes
+
+    def forward(self, x):
+        if self._rate > 0:
+            return npx.dropout(x, p=self._rate, axes=self._axes)
+        return x
+
+    def __repr__(self):
+        return f"Dropout(p = {self._rate}, axes={self._axes})"
+
+
+class Embedding(HybridBlock):
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, sparse_grad=False, **kwargs):
+        super().__init__(**kwargs)
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        self.weight = Parameter("weight", shape=(input_dim, output_dim),
+                                dtype=dtype, init=weight_initializer)
+
+    def forward(self, x):
+        return npx.embedding(x, self.weight.data(),
+                             input_dim=self._input_dim,
+                             output_dim=self._output_dim)
+
+    def __repr__(self):
+        return f"Embedding({self._input_dim} -> {self._output_dim})"
+
+
+class BatchNorm(HybridBlock):
+    """Batch normalisation (parity: basic_layers.py BatchNorm over
+    `src/operator/nn/batch_norm.cc:582`)."""
+
+    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+                 scale=True, use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._center, self._scale = center, scale
+        self._use_global_stats = use_global_stats
+        shape = (in_channels,) if in_channels else (0,)
+        defer = not in_channels
+        self.gamma = Parameter("gamma", shape=shape, init=gamma_initializer,
+                               allow_deferred_init=defer,
+                               differentiable=scale)
+        self.beta = Parameter("beta", shape=shape, init=beta_initializer,
+                              allow_deferred_init=defer,
+                              differentiable=center)
+        self.running_mean = Parameter("running_mean", shape=shape,
+                                      init=running_mean_initializer,
+                                      allow_deferred_init=defer,
+                                      grad_req="null", differentiable=False)
+        self.running_var = Parameter("running_var", shape=shape,
+                                     init=running_variance_initializer,
+                                     allow_deferred_init=defer,
+                                     grad_req="null", differentiable=False)
+
+    def infer_shape(self, x, *args):
+        c = x.shape[self._axis % x.ndim]
+        for p in (self.gamma, self.beta, self.running_mean, self.running_var):
+            p.shape = (c,)
+
+    def forward(self, x):
+        return npx.batch_norm(
+            x, self.gamma.data(), self.beta.data(),
+            self.running_mean.data(), self.running_var.data(),
+            eps=self._epsilon, momentum=self._momentum,
+            fix_gamma=not self._scale,
+            use_global_stats=self._use_global_stats, axis=self._axis)
+
+    def __repr__(self):
+        return f"BatchNorm(axis={self._axis}, momentum={self._momentum})"
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device BatchNorm (parity: basic_layers.py SyncBatchNorm).
+
+    Under GSPMD the batch axis is sharded and XLA computes global batch
+    statistics automatically when the reduction spans the sharded axis, so
+    this is BatchNorm with a documented contract rather than a custom
+    NCCL kernel."""
+
+    def __init__(self, in_channels=0, num_devices=None, **kwargs):
+        super().__init__(in_channels=in_channels, **kwargs)
+
+
+class _SimpleNorm(HybridBlock):
+    def __init__(self, shape_defer, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        shape = (in_channels,) if in_channels else (0,)
+        defer = not in_channels
+        self.gamma = Parameter("gamma", shape=shape, init=gamma_initializer,
+                               allow_deferred_init=defer, differentiable=scale)
+        self.beta = Parameter("beta", shape=shape, init=beta_initializer,
+                              allow_deferred_init=defer, differentiable=center)
+
+
+class LayerNorm(_SimpleNorm):
+    def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(None, center, scale, beta_initializer,
+                         gamma_initializer, in_channels, **kwargs)
+        self._axis = axis
+        self._epsilon = epsilon
+
+    def infer_shape(self, x, *args):
+        c = x.shape[self._axis % x.ndim]
+        self.gamma.shape = (c,)
+        self.beta.shape = (c,)
+
+    def forward(self, x):
+        return npx.layer_norm(x, self.gamma.data(), self.beta.data(),
+                              axis=self._axis, eps=self._epsilon)
+
+    def __repr__(self):
+        return f"LayerNorm(axis={self._axis}, eps={self._epsilon})"
+
+
+class GroupNorm(_SimpleNorm):
+    def __init__(self, num_groups=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(None, center, scale, beta_initializer,
+                         gamma_initializer, in_channels, **kwargs)
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+
+    def infer_shape(self, x, *args):
+        self.gamma.shape = (x.shape[1],)
+        self.beta.shape = (x.shape[1],)
+
+    def forward(self, x):
+        return npx.group_norm(x, self.gamma.data(), self.beta.data(),
+                              num_groups=self._num_groups, eps=self._epsilon)
+
+
+class InstanceNorm(_SimpleNorm):
+    def __init__(self, axis=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(None, center, scale, beta_initializer,
+                         gamma_initializer, in_channels, **kwargs)
+        self._axis = axis
+        self._epsilon = epsilon
+
+    def infer_shape(self, x, *args):
+        c = x.shape[self._axis % x.ndim]
+        self.gamma.shape = (c,)
+        self.beta.shape = (c,)
+
+    def forward(self, x):
+        return npx.instance_norm(x, self.gamma.data(), self.beta.data(),
+                                 eps=self._epsilon)
+
+
+class Flatten(HybridBlock):
+    def forward(self, x):
+        return x.reshape((x.shape[0], -1))
+
+    def __repr__(self):
+        return "Flatten"
+
+
+class Lambda(Block):
+    def __init__(self, function, **kwargs):
+        super().__init__(**kwargs)
+        if isinstance(function, str):
+            if hasattr(_np, function):
+                self._func = getattr(_np, function)
+            elif hasattr(npx, function):
+                self._func = getattr(npx, function)
+            else:
+                raise MXNetError(f"unknown function {function}")
+            self._name = function
+        else:
+            self._func = function
+            self._name = getattr(function, "__name__", "lambda")
+
+    def forward(self, *args):
+        return self._func(*args)
+
+    def __repr__(self):
+        return f"Lambda({self._name})"
+
+
+class HybridLambda(HybridBlock):
+    def __init__(self, function, **kwargs):
+        super().__init__(**kwargs)
+        if isinstance(function, str):
+            self._func = getattr(_np, function, None) or getattr(npx, function)
+            self._name = function
+        else:
+            self._func = function
+            self._name = getattr(function, "__name__", "lambda")
+
+    def forward(self, *args):
+        return self._func(*args)
+
+
+class HybridConcatenate(HybridBlock):
+    def __init__(self, axis=-1, **kwargs):
+        super().__init__(**kwargs)
+        self.axis = axis
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+        return self
+
+    def forward(self, x):
+        outs = [b(x) for b in self._children.values()]
+        return _np.concatenate(outs, axis=self.axis)
+
+
+class Concatenate(HybridConcatenate):
+    pass
+
+
+class Identity(HybridBlock):
+    def forward(self, x):
+        return x

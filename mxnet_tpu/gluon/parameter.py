@@ -1,0 +1,209 @@
+"""Gluon `Parameter` (parity: `python/mxnet/gluon/parameter.py:47`).
+
+Differences from the reference, by TPU design:
+- no per-device copy list (`_data` list in the reference): one `ndarray`
+  whose underlying `jax.Array` may be GSPMD-sharded across the whole mesh;
+- `sharding` carries a `PartitionSpec`-style annotation consumed by
+  `mxnet_tpu.parallel.sharding` when a mesh is active.
+Deferred initialisation (unknown in_units) is preserved: `shape` may contain
+-1/0 entries until the owning block's `infer_shape` runs.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as _onp
+
+from ..base import MXNetError
+from ..device import Device, current_device
+from ..ndarray.ndarray import ndarray, from_jax
+from .. import initializer as _init
+
+__all__ = ["Parameter", "Constant", "DeferredInitializationError"]
+
+
+class DeferredInitializationError(MXNetError):
+    pass
+
+
+def _shape_known(shape) -> bool:
+    return shape is not None and all(isinstance(s, int) and s > 0
+                                     for s in shape)
+
+
+class Parameter:
+    def __init__(self, name: str = "weight", grad_req: str = "write",
+                 shape=None, dtype=jnp.float32, lr_mult: float = 1.0,
+                 wd_mult: float = 1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default",
+                 sharding=None):
+        self._name = name
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self.grad_req = grad_req if differentiable else "null"
+        self._differentiable = differentiable
+        self.sharding = sharding  # logical PartitionSpec-like annotation
+        self._data: Optional[ndarray] = None
+        self._deferred_init = None  # (init, device)
+        self._structure_key = None  # full path name once attached to a block
+
+    # -- identity -----------------------------------------------------------
+    @property
+    def name(self) -> str:
+        return self._structure_key or self._name
+
+    @name.setter
+    def name(self, v):
+        self._name = v
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if new_shape is None:
+            return
+        if self._shape is not None:
+            matched = len(self._shape) == len(new_shape) and all(
+                s in (0, -1) or s == n for s, n in zip(self._shape, new_shape))
+            if not matched and _shape_known(self._shape):
+                raise MXNetError(
+                    f"cannot reset shape of {self.name} from {self._shape} "
+                    f"to {new_shape}")
+        self._shape = tuple(new_shape)
+
+    @property
+    def grad_req_(self):
+        return self.grad_req
+
+    # -- init ---------------------------------------------------------------
+    def initialize(self, init=None, device=None, ctx=None,
+                   default_init=None, force_reinit=False):
+        if self._data is not None and not force_reinit:
+            return
+        device = device or ctx or current_device()
+        if isinstance(device, (list, tuple)):
+            # reference API took a device list for replication; GSPMD needs
+            # only one logical placement
+            device = device[0]
+        if not _shape_known(self._shape):
+            if not self.allow_deferred_init:
+                raise MXNetError(
+                    f"cannot initialize {self.name}: shape {self._shape} "
+                    "unknown and deferred init not allowed")
+            self._deferred_init = (init, device, default_init)
+            return
+        self._finish_init(init, device, default_init)
+
+    def _finish_init(self, init, device, default_init):
+        initializer = init or self.init or default_init or _init.Uniform()
+        initializer = _init.create(initializer) if isinstance(initializer, str) \
+            else initializer
+        data = from_jax(jnp.zeros(self._shape, self.dtype), device)
+        with jax.default_device(device.jax_device):
+            initializer(self._name, data)
+        self._data = data
+        self._data.attach_grad(self.grad_req) if self.grad_req != "null" \
+            else None
+        self._deferred_init = None
+
+    def _finish_deferred_init(self):
+        if self._deferred_init is None:
+            return
+        if not _shape_known(self._shape):
+            raise DeferredInitializationError(
+                f"shape of {self.name} still unknown: {self._shape}")
+        init, device, default_init = self._deferred_init
+        self._finish_init(init, device, default_init)
+
+    # -- access -------------------------------------------------------------
+    def data(self, device=None) -> ndarray:
+        if self._data is None:
+            if self._deferred_init is not None:
+                raise DeferredInitializationError(
+                    f"parameter {self.name} deferred; run a forward pass or "
+                    "call infer_shape first")
+            raise MXNetError(f"parameter {self.name} not initialized; call "
+                             ".initialize()")
+        return self._data
+
+    def list_data(self):
+        return [self.data()]
+
+    @property
+    def grad(self) -> Optional[ndarray]:
+        return self.data().grad
+
+    def list_grad(self):
+        return [self.grad]
+
+    def list_ctx(self):
+        return [self.data().device]
+
+    list_device = list_ctx
+
+    def set_data(self, data):
+        if isinstance(data, ndarray):
+            val = data._data
+        else:
+            val = jnp.asarray(data)
+        if self._data is None:
+            self.shape = tuple(val.shape)
+            if self._deferred_init is not None:
+                self._finish_deferred_init()
+            else:
+                self._data = from_jax(val.astype(self.dtype), current_device())
+                if self.grad_req != "null":
+                    self._data.attach_grad(self.grad_req)
+                return
+        self._data._data = val.astype(self._data._data.dtype)
+
+    def zero_grad(self):
+        if self._data is not None:
+            self._data.zero_grad()
+
+    def reset_device(self, device):
+        if self._data is not None:
+            d = self._data.to_device(device)
+            d._grad_req = self._data._grad_req
+            if self._data._grad is not None:
+                d._grad = self._data._grad.to_device(device)
+            self._data = d
+
+    reset_ctx = reset_device
+
+    def cast(self, dtype):
+        self.dtype = jnp.dtype(dtype)
+        if self._data is not None:
+            self._data._data = self._data._data.astype(dtype)
+            if self._data._grad is not None:
+                self._data._grad._data = \
+                    self._data._grad._data.astype(dtype)
+
+    def var(self):
+        return self.data()
+
+    def __repr__(self):
+        return (f"Parameter {self.name} (shape={self._shape}, "
+                f"dtype={jnp.dtype(self.dtype).name})")
+
+
+class Constant(Parameter):
+    """Non-learnable constant parameter (parity: gluon/parameter.py:724)."""
+
+    def __init__(self, value, name: str = "const"):
+        if isinstance(value, ndarray):
+            value = value.asnumpy()
+        self.value = _onp.asarray(value)
+        super().__init__(name=name, grad_req="null",
+                         shape=self.value.shape, dtype=self.value.dtype,
+                         init=_init.Constant(self.value),
+                         differentiable=False)

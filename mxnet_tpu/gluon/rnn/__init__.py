@@ -1,0 +1,5 @@
+"""`mx.gluon.rnn` (parity: `python/mxnet/gluon/rnn/`)."""
+from .rnn_cell import (RecurrentCell, HybridRecurrentCell, RNNCell, LSTMCell,
+                       GRUCell, SequentialRNNCell, DropoutCell,
+                       BidirectionalCell, ResidualCell, ZoneoutCell)
+from .rnn_layer import RNN, LSTM, GRU, rnn_cell_scan, _fused_rnn_op

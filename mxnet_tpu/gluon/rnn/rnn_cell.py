@@ -1,0 +1,333 @@
+"""Recurrent cells (parity: `python/mxnet/gluon/rnn/rnn_cell.py`).
+
+Gate orders follow the reference: LSTM [i, f, g, o]; GRU [r, z, n].
+`unroll` runs the python loop eagerly (or inside a hybrid trace, where the
+unrolled graph compiles to a single XLA computation — the reference's
+`foreach` use case)."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from ...base import MXNetError
+from ... import numpy as _np
+from ... import numpy_extension as npx
+from ...ndarray.ndarray import ndarray
+from ..block import Block, HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "DropoutCell", "BidirectionalCell",
+           "ResidualCell", "ZoneoutCell", "HybridRecurrentCell"]
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for c in self._children.values():
+            if isinstance(c, RecurrentCell):
+                c.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ... import numpy as mnp
+        states = []
+        for info in self.state_info(batch_size):
+            shape = info["shape"]
+            states.append(mnp.zeros(shape, **kwargs))
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        axis = layout.find("T")
+        batch_size = inputs.shape[layout.find("N")]
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size)
+        states = begin_state
+        outputs = []
+        for i in range(length):
+            step = inputs.slice_axis(axis, i, i + 1).squeeze(axis)
+            out, states = self(step, states)
+            outputs.append(out)
+        if merge_outputs is None or merge_outputs:
+            outputs = _np.stack(outputs, axis=axis)
+        if valid_length is not None:
+            outputs = npx.sequence_mask(outputs, valid_length,
+                                        use_sequence_length=True, axis=axis)
+        return outputs, states
+
+    def forward(self, inputs, states):
+        raise NotImplementedError
+
+
+HybridRecurrentCell = RecurrentCell
+
+
+class RNNCell(RecurrentCell):
+    def __init__(self, hidden_size, activation="tanh", input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self._input_size = input_size
+        self.i2h_weight = Parameter("i2h_weight",
+                                    shape=(hidden_size, input_size),
+                                    init=i2h_weight_initializer,
+                                    allow_deferred_init=not input_size)
+        self.h2h_weight = Parameter("h2h_weight",
+                                    shape=(hidden_size, hidden_size),
+                                    init=h2h_weight_initializer)
+        self.i2h_bias = Parameter("i2h_bias", shape=(hidden_size,),
+                                  init=i2h_bias_initializer)
+        self.h2h_bias = Parameter("h2h_bias", shape=(hidden_size,),
+                                  init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (self._hidden_size, x.shape[-1])
+
+    def forward(self, inputs, states):
+        i2h = npx.fully_connected(inputs, self.i2h_weight.data(),
+                                  self.i2h_bias.data(),
+                                  num_hidden=self._hidden_size, flatten=False)
+        h2h = npx.fully_connected(states[0], self.h2h_weight.data(),
+                                  self.h2h_bias.data(),
+                                  num_hidden=self._hidden_size, flatten=False)
+        out = npx.activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class LSTMCell(RecurrentCell):
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 activation="tanh", recurrent_activation="sigmoid", **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        self._activation = activation
+        self._recurrent_activation = recurrent_activation
+        self.i2h_weight = Parameter("i2h_weight",
+                                    shape=(4 * hidden_size, input_size),
+                                    init=i2h_weight_initializer,
+                                    allow_deferred_init=not input_size)
+        self.h2h_weight = Parameter("h2h_weight",
+                                    shape=(4 * hidden_size, hidden_size),
+                                    init=h2h_weight_initializer)
+        self.i2h_bias = Parameter("i2h_bias", shape=(4 * hidden_size,),
+                                  init=i2h_bias_initializer)
+        self.h2h_bias = Parameter("h2h_bias", shape=(4 * hidden_size,),
+                                  init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+
+    def forward(self, inputs, states):
+        h, c = states
+        i2h = npx.fully_connected(inputs, self.i2h_weight.data(),
+                                  self.i2h_bias.data(),
+                                  num_hidden=4 * self._hidden_size,
+                                  flatten=False)
+        h2h = npx.fully_connected(h, self.h2h_weight.data(),
+                                  self.h2h_bias.data(),
+                                  num_hidden=4 * self._hidden_size,
+                                  flatten=False)
+        gates = i2h + h2h
+        hs = self._hidden_size
+        i = npx.activation(gates[..., :hs], self._recurrent_activation)
+        f = npx.activation(gates[..., hs:2 * hs], self._recurrent_activation)
+        g = npx.activation(gates[..., 2 * hs:3 * hs], self._activation)
+        o = npx.activation(gates[..., 3 * hs:], self._recurrent_activation)
+        c_new = f * c + i * g
+        h_new = o * npx.activation(c_new, self._activation)
+        return h_new, [h_new, c_new]
+
+
+class GRUCell(RecurrentCell):
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        self.i2h_weight = Parameter("i2h_weight",
+                                    shape=(3 * hidden_size, input_size),
+                                    init=i2h_weight_initializer,
+                                    allow_deferred_init=not input_size)
+        self.h2h_weight = Parameter("h2h_weight",
+                                    shape=(3 * hidden_size, hidden_size),
+                                    init=h2h_weight_initializer)
+        self.i2h_bias = Parameter("i2h_bias", shape=(3 * hidden_size,),
+                                  init=i2h_bias_initializer)
+        self.h2h_bias = Parameter("h2h_bias", shape=(3 * hidden_size,),
+                                  init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (3 * self._hidden_size, x.shape[-1])
+
+    def forward(self, inputs, states):
+        h = states[0]
+        hs = self._hidden_size
+        i2h = npx.fully_connected(inputs, self.i2h_weight.data(),
+                                  self.i2h_bias.data(),
+                                  num_hidden=3 * hs, flatten=False)
+        h2h = npx.fully_connected(h, self.h2h_weight.data(),
+                                  self.h2h_bias.data(),
+                                  num_hidden=3 * hs, flatten=False)
+        i2h_r, i2h_z, i2h_n = (i2h[..., :hs], i2h[..., hs:2 * hs],
+                               i2h[..., 2 * hs:])
+        h2h_r, h2h_z, h2h_n = (h2h[..., :hs], h2h[..., hs:2 * hs],
+                               h2h[..., 2 * hs:])
+        r = npx.sigmoid(i2h_r + h2h_r)
+        z = npx.sigmoid(i2h_z + h2h_z)
+        n = _np.tanh(i2h_n + r * h2h_n)
+        h_new = (1 - z) * n + z * h
+        return h_new, [h_new]
+
+
+class SequentialRNNCell(RecurrentCell):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        out = []
+        for c in self._children.values():
+            out.extend(c.state_info(batch_size))
+        return out
+
+    def begin_state(self, batch_size=0, **kwargs):
+        out = []
+        for c in self._children.values():
+            out.extend(c.begin_state(batch_size, **kwargs))
+        return out
+
+    def forward(self, inputs, states):
+        next_states = []
+        p = 0
+        for c in self._children.values():
+            n = len(c.state_info())
+            inputs, st = c(inputs, states[p:p + n])
+            next_states.extend(st)
+            p += n
+        return inputs, next_states
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, axes=(), **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def forward(self, inputs, states):
+        if self._rate > 0:
+            inputs = npx.dropout(inputs, p=self._rate, axes=self._axes)
+        return inputs, states
+
+
+class ModifierCell(RecurrentCell):
+    def __init__(self, base_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return self.base_cell.begin_state(batch_size, **kwargs)
+
+
+class ZoneoutCell(ModifierCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0,
+                 **kwargs):
+        super().__init__(base_cell, **kwargs)
+        self._zoneout_outputs = zoneout_outputs
+        self._zoneout_states = zoneout_states
+
+    def forward(self, inputs, states):
+        out, next_states = self.base_cell(inputs, states)
+        from ... import _tape
+
+        def zone(new, old, p):
+            if p == 0.0 or not _tape.is_training():
+                return new
+            mask = npx.dropout(_np.ones_like(new), p=p) * (1 - p)  # 0/1 mask
+            return mask * new + (1 - mask) * old
+        if self._zoneout_states:
+            next_states = [zone(n, o, self._zoneout_states)
+                           for n, o in zip(next_states, states)]
+        if self._zoneout_outputs:
+            out = zone(out, inputs, self._zoneout_outputs)
+        return out, next_states
+
+
+class ResidualCell(ModifierCell):
+    def forward(self, inputs, states):
+        out, next_states = self.base_cell(inputs, states)
+        return out + inputs, next_states
+
+
+class BidirectionalCell(RecurrentCell):
+    def __init__(self, l_cell, r_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.l_cell = l_cell
+        self.r_cell = r_cell
+
+    def state_info(self, batch_size=0):
+        return self.l_cell.state_info(batch_size) + \
+            self.r_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return self.l_cell.begin_state(batch_size, **kwargs) + \
+            self.r_cell.begin_state(batch_size, **kwargs)
+
+    def forward(self, inputs, states):
+        raise MXNetError("BidirectionalCell supports only unroll()")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        axis = layout.find("T")
+        batch_size = inputs.shape[layout.find("N")]
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size)
+        nl = len(self.l_cell.state_info())
+        l_out, l_states = self.l_cell.unroll(
+            length, inputs, begin_state[:nl], layout, True, valid_length)
+        rev = _np.flip(inputs, axis=axis)
+        r_out, r_states = self.r_cell.unroll(
+            length, rev, begin_state[nl:], layout, True, valid_length)
+        r_out = _np.flip(r_out, axis=axis)
+        out = _np.concatenate([l_out, r_out], axis=-1)
+        return out, l_states + r_states

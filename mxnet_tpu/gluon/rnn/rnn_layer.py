@@ -1,0 +1,300 @@
+"""Fused multi-layer RNN/LSTM/GRU layers (parity:
+`python/mxnet/gluon/rnn/rnn_layer.py` over the fused op `src/operator/rnn.cc:306`).
+
+The reference dispatches to cuDNN's fused RNN; the TPU-native design runs the
+time loop with `lax.scan` (static trip count, single compiled kernel per
+layer) — large gate matmuls hit the MXU, and XLA pipelines the scan.
+Layout 'TNC' like the reference default.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...base import MXNetError
+from ... import numpy as _np
+from ...ndarray.ndarray import ndarray, apply_op
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["RNN", "LSTM", "GRU", "rnn_cell_scan"]
+
+
+def _rnn_step(mode, act):
+    def step_rnn(carry, x_t, wi, wh, bi, bh):
+        (h,) = carry
+        g = x_t @ wi.T + bi + h @ wh.T + bh
+        h_new = jnp.tanh(g) if act == "tanh" else jax.nn.relu(g)
+        return (h_new,), h_new
+
+    def step_lstm(carry, x_t, wi, wh, bi, bh):
+        h, c = carry
+        gates = x_t @ wi.T + bi + h @ wh.T + bh
+        hs = h.shape[-1]
+        i = jax.nn.sigmoid(gates[..., :hs])
+        f = jax.nn.sigmoid(gates[..., hs:2 * hs])
+        g = jnp.tanh(gates[..., 2 * hs:3 * hs])
+        o = jax.nn.sigmoid(gates[..., 3 * hs:])
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return (h_new, c_new), h_new
+
+    def step_gru(carry, x_t, wi, wh, bi, bh):
+        (h,) = carry
+        hs = h.shape[-1]
+        gi = x_t @ wi.T + bi
+        gh = h @ wh.T + bh
+        r = jax.nn.sigmoid(gi[..., :hs] + gh[..., :hs])
+        z = jax.nn.sigmoid(gi[..., hs:2 * hs] + gh[..., hs:2 * hs])
+        n = jnp.tanh(gi[..., 2 * hs:] + r * gh[..., 2 * hs:])
+        h_new = (1 - z) * n + z * h
+        return (h_new,), h_new
+
+    if mode == "lstm":
+        return step_lstm
+    if mode == "gru":
+        return step_gru
+    return step_rnn
+
+
+def rnn_cell_scan(x, h0, wi, wh, bi, bh, mode="lstm", act="tanh",
+                  reverse=False):
+    """Run one direction of one layer: x (T, N, I) -> (T, N, H).
+
+    h0: tuple of initial states (h,) or (h, c)."""
+    step = _rnn_step(mode, act)
+
+    def body(carry, x_t):
+        return step(carry, x_t, wi, wh, bi, bh)
+
+    xs = jnp.flip(x, 0) if reverse else x
+    final, ys = lax.scan(body, h0, xs)
+    if reverse:
+        ys = jnp.flip(ys, 0)
+    return ys, final
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, mode, hidden_size, num_layers=1, layout="TNC",
+                 dropout=0.0, bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 activation="tanh", projection_size=None, state_clip_min=None,
+                 state_clip_max=None, dtype="float32", use_sequence_length=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        if projection_size is not None:
+            raise MXNetError("projection_size is not supported")
+        if layout not in ("TNC", "NTC"):
+            raise MXNetError(f"invalid layout {layout}")
+        self._mode = mode
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._activation = activation
+        ng = {"rnn_tanh": 1, "rnn_relu": 1, "lstm": 4, "gru": 3}[mode]
+        self._gates = ng
+        for layer in range(num_layers):
+            for d in range(self._dir):
+                suffix = "_l" if d == 0 else "_r"
+                in_size = input_size if layer == 0 else \
+                    hidden_size * self._dir
+                pfx = f"{suffix}{layer}"
+                setattr(self, f"i2h{pfx}_weight", Parameter(
+                    f"i2h{pfx}_weight", shape=(ng * hidden_size, in_size),
+                    dtype=dtype, init=i2h_weight_initializer,
+                    allow_deferred_init=not in_size))
+                setattr(self, f"h2h{pfx}_weight", Parameter(
+                    f"h2h{pfx}_weight", shape=(ng * hidden_size, hidden_size),
+                    dtype=dtype, init=h2h_weight_initializer))
+                setattr(self, f"i2h{pfx}_bias", Parameter(
+                    f"i2h{pfx}_bias", shape=(ng * hidden_size,), dtype=dtype,
+                    init=i2h_bias_initializer))
+                setattr(self, f"h2h{pfx}_bias", Parameter(
+                    f"h2h{pfx}_bias", shape=(ng * hidden_size,), dtype=dtype,
+                    init=h2h_bias_initializer))
+
+    def state_info(self, batch_size=0):
+        ns = 2 if self._mode == "lstm" else 1
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size)} for _ in range(ns)]
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ... import numpy as mnp
+        return [mnp.zeros(info["shape"])
+                for info in self.state_info(batch_size)]
+
+    def infer_shape(self, x, *args):
+        in_size = x.shape[-1]
+        for layer in range(self._num_layers):
+            for d in range(self._dir):
+                suffix = "_l" if d == 0 else "_r"
+                pfx = f"{suffix}{layer}"
+                p = getattr(self, f"i2h{pfx}_weight")
+                cur = in_size if layer == 0 else self._hidden_size * self._dir
+                p.shape = (self._gates * self._hidden_size, cur)
+
+    def forward(self, inputs, states=None):
+        ntc = self._layout == "NTC"
+        x = inputs.swapaxes(0, 1) if ntc else inputs
+        batch = x.shape[1]
+        explicit_states = states is not None
+        if states is None:
+            states = self.begin_state(batch)
+        if isinstance(states, ndarray):
+            states = [states]
+
+        mode = self._mode
+        act = "relu" if mode == "rnn_relu" else "tanh"
+        core_mode = "rnn" if mode.startswith("rnn") else mode
+
+        weights = []
+        for layer in range(self._num_layers):
+            for d in range(self._dir):
+                suffix = "_l" if d == 0 else "_r"
+                pfx = f"{suffix}{layer}"
+                weights.append((
+                    getattr(self, f"i2h{pfx}_weight").data(),
+                    getattr(self, f"h2h{pfx}_weight").data(),
+                    getattr(self, f"i2h{pfx}_bias").data(),
+                    getattr(self, f"h2h{pfx}_bias").data()))
+
+        flat_w = [w for tup in weights for w in tup]
+        arrs = [x] + list(states) + flat_w
+        n_states = len(states)
+        num_layers, ndir, hs = self._num_layers, self._dir, self._hidden_size
+        dropout = self._dropout
+        from ... import _tape
+        training = _tape.is_training()
+        from ... import random as _rng
+        key = _rng.next_key() if (dropout > 0 and training) else None
+
+        def fn(xv, *rest):
+            st = rest[:n_states]
+            ws = rest[n_states:]
+            h_all = st[0]
+            c_all = st[1] if core_mode == "lstm" else None
+            outs = xv
+            h_finals, c_finals = [], []
+            for layer in range(num_layers):
+                layer_outs = []
+                for d in range(ndir):
+                    idx = layer * ndir + d
+                    wi, wh, bi, bh = ws[4 * idx:4 * idx + 4]
+                    h0 = h_all[idx]
+                    carry = (h0, c_all[idx]) if core_mode == "lstm" else (h0,)
+                    ys, final = rnn_cell_scan(outs, carry, wi, wh, bi, bh,
+                                              core_mode, act, reverse=d == 1)
+                    layer_outs.append(ys)
+                    h_finals.append(final[0])
+                    if core_mode == "lstm":
+                        c_finals.append(final[1])
+                outs = layer_outs[0] if ndir == 1 else \
+                    jnp.concatenate(layer_outs, axis=-1)
+                if dropout > 0 and training and layer < num_layers - 1:
+                    keep = jax.random.bernoulli(
+                        jax.random.fold_in(key, layer), 1 - dropout,
+                        outs.shape)
+                    outs = jnp.where(keep, outs / (1 - dropout), 0.0)
+            h_out = jnp.stack(h_finals)
+            if core_mode == "lstm":
+                return outs, h_out, jnp.stack(c_finals)
+            return outs, h_out
+
+        res = apply_op(fn, tuple(arrs), {}, name=f"rnn_{mode}")
+        out = res[0]
+        out_states = list(res[1:])
+        if ntc:
+            out = out.swapaxes(0, 1)
+        if explicit_states:
+            return out, out_states
+        return out
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._input_size or None} -> "
+                f"{self._hidden_size}, layers={self._num_layers}, "
+                f"bidirectional={self._dir == 2})")
+
+
+class RNN(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, activation="relu", **kwargs):
+        mode = "rnn_relu" if activation == "relu" else "rnn_tanh"
+        super().__init__(mode, hidden_size, num_layers, **kwargs)
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, **kwargs):
+        super().__init__("lstm", hidden_size, num_layers, **kwargs)
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, **kwargs):
+        super().__init__("gru", hidden_size, num_layers, **kwargs)
+
+
+def _fused_rnn_op(data, parameters, state, state_cell, mode, state_size,
+                  num_layers, bidirectional, p, state_outputs):
+    """npx.rnn parity: unpack the flat parameter vector.
+
+    Layout (documented; matches `rnn_param_concat`): per layer, per direction:
+    i2h_weight, h2h_weight; then per layer, per direction: i2h_bias, h2h_bias.
+    data: (T, N, I)."""
+    ng = {"rnn_tanh": 1, "rnn_relu": 1, "lstm": 4, "gru": 3}[mode]
+    ndir = 2 if bidirectional else 1
+    in_size = data.shape[-1]
+    hs = state_size
+    act = "relu" if mode == "rnn_relu" else "tanh"
+    core_mode = "rnn" if mode.startswith("rnn") else mode
+
+    arrs = [data, parameters, state] + \
+        ([state_cell] if state_cell is not None else [])
+
+    def fn(xv, pv, hv, *rest):
+        cv = rest[0] if rest else None
+        off = 0
+        ws = []
+        for layer in range(num_layers):
+            cur_in = in_size if layer == 0 else hs * ndir
+            for d in range(ndir):
+                wi = pv[off:off + ng * hs * cur_in].reshape(ng * hs, cur_in)
+                off += ng * hs * cur_in
+                wh = pv[off:off + ng * hs * hs].reshape(ng * hs, hs)
+                off += ng * hs * hs
+                ws.append([wi, wh])
+        for layer in range(num_layers):
+            for d in range(ndir):
+                bi = pv[off:off + ng * hs]
+                off += ng * hs
+                bh = pv[off:off + ng * hs]
+                off += ng * hs
+                ws[layer * ndir + d].extend([bi, bh])
+        outs = xv
+        h_finals, c_finals = [], []
+        for layer in range(num_layers):
+            louts = []
+            for d in range(ndir):
+                idx = layer * ndir + d
+                wi, wh, bi, bh = ws[idx]
+                carry = (hv[idx], cv[idx]) if core_mode == "lstm" else (hv[idx],)
+                ys, final = rnn_cell_scan(outs, carry, wi, wh, bi, bh,
+                                          core_mode, act, reverse=d == 1)
+                louts.append(ys)
+                h_finals.append(final[0])
+                if core_mode == "lstm":
+                    c_finals.append(final[1])
+            outs = louts[0] if ndir == 1 else jnp.concatenate(louts, -1)
+        res = [outs, jnp.stack(h_finals)]
+        if core_mode == "lstm":
+            res.append(jnp.stack(c_finals))
+        return tuple(res)
+
+    res = apply_op(fn, tuple(arrs), {}, name=f"rnn_fused_{mode}")
+    if state_outputs:
+        return res
+    return res[0]

@@ -1,0 +1,218 @@
+"""Gluon `Trainer` (parity: `python/mxnet/gluon/trainer.py`).
+
+The reference's step pipeline — per-parameter `kvstore.pushpull` of gradients
+then per-parameter fused optimizer kernels (`trainer.py:341,392-417,451`) —
+collapses on TPU into ONE jitted pytree update per step (all parameters, all
+optimizer states, donated buffers), the XLA analog of multi-tensor fused
+optimizers. Data-parallel gradient averaging is GSPMD's job (psum inserted by
+XLA when batch-sharded); the KVStore path is kept for API parity and for
+`update_on_kvstore=True` semantics (server-side updater).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import ndarray, from_jax
+from .. import optimizer as opt
+from ..kvstore import KVStore
+from ..ops.fused_optim import tree_apply_update
+from .parameter import Parameter
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore=None,
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict,)):
+            param_dict = dict(params)
+        elif isinstance(params, (list, tuple)):
+            param_dict = {getattr(p, "name", str(i)): p
+                          for i, p in enumerate(params)}
+        else:
+            raise MXNetError("params must be dict or list of Parameter")
+        for p in param_dict.values():
+            if not isinstance(p, Parameter):
+                raise MXNetError(f"expected Parameter, got {type(p)}")
+        self._param_dict = param_dict
+        self._params = [p for p in param_dict.values()
+                        if p.grad_req != "null"]
+        self._param_names = [p.name for p in param_dict.values()
+                             if p.grad_req != "null"]
+
+        optimizer_params = optimizer_params or {}
+        self._optimizer = opt.create(optimizer, param_idx2name={
+            i: n for i, n in enumerate(self._param_names)},
+            **optimizer_params)
+        self._states = {}
+        self._kvstore = None
+        self._kv_initialized = False
+        self._update_on_kvstore = update_on_kvstore
+        self._kvstore_arg = kvstore
+        self._compression_params = compression_params
+        self._scale = 1.0
+        self._fused_cache = None
+
+    # -- properties ----------------------------------------------------------
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    # -- kvstore init ---------------------------------------------------------
+    def _init_kvstore(self):
+        if self._kv_initialized:
+            return
+        arg = self._kvstore_arg
+        if arg is None or arg is False:
+            self._kvstore = None
+            self._update_on_kvstore = False
+        else:
+            kv = arg if isinstance(arg, KVStore) else \
+                __import__("mxnet_tpu.kvstore", fromlist=["create"]).create(
+                    arg if isinstance(arg, str) else "device")
+            self._kvstore = kv
+            if self._compression_params:
+                kv.set_gradient_compression(self._compression_params)
+            if self._update_on_kvstore is None:
+                self._update_on_kvstore = False
+            if self._update_on_kvstore:
+                self._optimizer.rescale_grad = self._scale
+                kv.set_optimizer(self._optimizer)
+            for i, p in enumerate(self._params):
+                kv.init(i, p.data())
+        self._kv_initialized = True
+
+    def _ensure_states(self):
+        for p in self._params:
+            if p.name not in self._states:
+                self._states[p.name] = \
+                    self._optimizer.create_state_multi_precision(
+                        p.name, p.data())
+
+    # -- main API -------------------------------------------------------------
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Grad-allreduce + optimizer update (parity: trainer.py:341)."""
+        self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        if self._kvstore is not None and not self._update_on_kvstore:
+            # with update_on_kvstore the push inside update() both
+            # aggregates and applies the optimizer — pushing here too would
+            # apply the update twice
+            self.allreduce_grads()
+        self.update(batch_size, ignore_stale_grad=ignore_stale_grad,
+                    _already_reduced=True)
+
+    def allreduce_grads(self):
+        """Parity: trainer.py:370. Single-process: kvstore aggregation."""
+        self._init_kvstore()
+        if self._kvstore is None:
+            return
+        for i, p in enumerate(self._params):
+            if p.grad_req != "null":
+                if self._update_on_kvstore:
+                    self._kvstore.push(i, p.grad)
+                else:
+                    self._kvstore.pushpull(i, p.grad, out=p.grad)
+
+    def update(self, batch_size, ignore_stale_grad=False,
+               _already_reduced=False):
+        self._init_kvstore()
+        if not _already_reduced:
+            self._optimizer.rescale_grad = self._scale / batch_size
+        if self._update_on_kvstore and self._kvstore is not None:
+            # server-side update: push grads, pull fresh weights
+            for i, p in enumerate(self._params):
+                self._kvstore.push(i, p.grad)
+                self._kvstore.pull(i, out=p.data())
+            return
+        self._ensure_states()
+        if getattr(self._optimizer, "fused_safe", True) and \
+                not self._optimizer.multi_precision and \
+                self._uniform_mults():
+            self._fused_update()
+        else:
+            for p in self._params:
+                self._optimizer.update_multi_precision(
+                    p.name, p.data(), p.grad, self._states[p.name])
+
+    def _uniform_mults(self):
+        o = self._optimizer
+        if o.lr_mult or o.wd_mult or o.param_dict:
+            return False  # optimizer-level multipliers need per-param rates
+        return all(p.lr_mult == 1.0 and p.wd_mult == 1.0
+                   for p in self._params)
+
+    # -- fused pytree update ---------------------------------------------------
+    def _fused_update(self):
+        o = self._optimizer
+        o.num_update += 1
+        t = o.num_update
+        for p in self._params:
+            o._index_update_count[p.name] = t
+
+        names = [p.name for p in self._params]
+        params_tree = {n: p.data()._data for n, p in zip(names, self._params)}
+        grads_tree = {n: p.grad._data for n, p in zip(names, self._params)}
+
+        from ..optimizer.optimizer import _state_values, _state_writeback
+        states_tree = {n: _state_values(self._states[n]) for n in names}
+
+        hp = {
+            "lr": jnp.asarray(o.learning_rate, jnp.float32),
+            "wd": jnp.asarray(o.wd, jnp.float32),
+            "rescale_grad": jnp.asarray(o.rescale_grad, jnp.float32),
+            "clip_gradient": o.clip_gradient,
+            "t": jnp.asarray(t, jnp.float32),
+        }
+
+        new_params, new_states = tree_apply_update(
+            _RuleAdapter(o), params_tree, grads_tree, states_tree, hp)
+        for n, p in zip(names, self._params):
+            p.data()._data = new_params[n]
+            _state_writeback(self._states[n], new_states[n])
+
+    # -- checkpointing ---------------------------------------------------------
+    def save_states(self, fname):
+        """Parity: trainer.py:510."""
+        from ..optimizer.updater import Updater
+        u = Updater(self._optimizer)
+        u.states = dict(self._states)
+        with open(fname, "wb") as f:
+            f.write(u.get_states(dump_optimizer=False))
+
+    def load_states(self, fname):
+        """Parity: trainer.py:537."""
+        from ..optimizer.updater import Updater
+        self._init_kvstore()
+        u = Updater(self._optimizer)
+        with open(fname, "rb") as f:
+            u.set_states(f.read())
+        self._states = dict(u.states)
+
+
+class _RuleAdapter:
+    """Hashable wrapper so jit caches on the optimizer identity + class."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+
+    def __call__(self, p, g, s, hp):
+        return self.optimizer._rule(p, g, s, hp)
+
+    def __hash__(self):
+        return hash((type(self.optimizer), id(self.optimizer)))
+
+    def __eq__(self, other):
+        return isinstance(other, _RuleAdapter) and \
+            other.optimizer is self.optimizer

@@ -1,0 +1,80 @@
+"""Gluon utilities (parity: `python/mxnet/gluon/utils.py`)."""
+from __future__ import annotations
+
+from typing import List
+
+from ..base import MXNetError
+from ..device import Device
+from ..ndarray.ndarray import ndarray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data: ndarray, num_slice: int, batch_axis=0, even_split=True):
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(f"cannot evenly split axis of size {size} into "
+                         f"{num_slice}")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(batch_axis, begin, end))
+    return slices
+
+
+def split_and_load(data, ctx_list=None, device_list=None, batch_axis=0,
+                   even_split=True):
+    """Parity: split a batch across devices. Under GSPMD a single sharded
+    array replaces per-device copies, but the API is preserved for ported
+    training loops."""
+    devices = device_list or ctx_list
+    from .. import numpy as mnp
+    if not isinstance(data, ndarray):
+        data = mnp.array(data)
+    if len(devices) == 1:
+        return [data.to_device(devices[0])]
+    slices = split_data(data, len(devices), batch_axis, even_split)
+    return [s.to_device(d) for s, d in zip(slices, devices)]
+
+
+def clip_global_norm(arrays: List[ndarray], max_norm: float,
+                     check_isfinite=True):
+    """Parity: gluon/utils.py clip_global_norm."""
+    import math
+
+    from .. import numpy as mnp
+    total = 0.0
+    for a in arrays:
+        n = float((a * a).sum().asnumpy())
+        total += n
+    norm = math.sqrt(total)
+    if check_isfinite and not math.isfinite(norm):
+        import warnings
+        warnings.warn("nan or inf in clip_global_norm")
+        return norm
+    scale = min(1.0, max_norm / (norm + 1e-8))
+    if scale < 1.0:
+        for a in arrays:
+            a *= scale
+    return norm
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    raise MXNetError("network egress is unavailable in this environment; "
+                     "place files locally and pass the path instead")

@@ -1,0 +1,160 @@
+"""`mx.image` — image ops (parity: `python/mxnet/image/` + `src/operator/image/`).
+
+Decode uses PIL if present (no OpenCV in this environment); the tensor-space
+transforms (resize/crop/normalize/flip) are pure XLA ops and run on device.
+Layout: HWC uint8/float like the reference's image namespace.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as _onp
+
+from ..base import MXNetError
+from ..device import current_device
+from ..ndarray.ndarray import ndarray, apply_op, from_jax
+from .. import random as _rng
+
+__all__ = ["imdecode", "imresize", "resize_short", "fixed_crop", "center_crop",
+           "random_crop", "color_normalize", "HorizontalFlipAug", "CastAug",
+           "ColorNormalizeAug", "ResizeAug", "CenterCropAug", "RandomCropAug"]
+
+
+def imdecode(buf, to_rgb=1, flag=1):
+    try:
+        import io as _io
+
+        from PIL import Image
+    except ImportError as e:
+        raise MXNetError("imdecode requires PIL (no OpenCV in TPU build)") from e
+    img = Image.open(_io.BytesIO(buf))
+    if flag == 0:
+        img = img.convert("L")
+    else:
+        img = img.convert("RGB")
+    arr = _onp.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return from_jax(jnp.asarray(arr), current_device())
+
+
+def imresize(src: ndarray, w: int, h: int, interp=1):
+    method = {0: "nearest", 1: "bilinear", 2: "cubic"}.get(interp, "bilinear")
+
+    def fn(x):
+        out = jax.image.resize(x.astype(jnp.float32), (h, w, x.shape[2]),
+                               method=method)
+        return out.astype(x.dtype) if jnp.issubdtype(x.dtype, jnp.integer) \
+            else out
+    return apply_op(fn, (src,), {}, name="imresize")
+
+
+def resize_short(src: ndarray, size: int, interp=2):
+    h, w = src.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(h * size / w)
+    else:
+        new_w, new_h = int(w * size / h), size
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src: ndarray, x0: int, y0: int, w: int, h: int,
+               size=None, interp=2):
+    out = src[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def center_crop(src: ndarray, size: Tuple[int, int], interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = size
+    x0 = max(0, (w - new_w) // 2)
+    y0 = max(0, (h - new_h) // 2)
+    out = fixed_crop(src, x0, y0, min(new_w, w), min(new_h, h), size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_crop(src: ndarray, size: Tuple[int, int], interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = size
+    x0 = int(_onp.random.randint(0, max(1, w - new_w + 1)))
+    y0 = int(_onp.random.randint(0, max(1, h - new_h + 1)))
+    out = fixed_crop(src, x0, y0, min(new_w, w), min(new_h, h), size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src: ndarray, mean, std=None):
+    def fn(x):
+        y = x.astype(jnp.float32) - jnp.asarray(mean, jnp.float32)
+        if std is not None:
+            y = y / jnp.asarray(std, jnp.float32)
+        return y
+    return apply_op(fn, (src,), {}, name="color_normalize")
+
+
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _onp.random.rand() < self.p:
+            return apply_op(lambda x: jnp.flip(x, axis=1), (src,), {},
+                            name="hflip")
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean, self.std = mean, std
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)

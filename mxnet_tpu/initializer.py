@@ -1,0 +1,226 @@
+"""Parameter initializers (parity: `python/mxnet/initializer.py`)."""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as _onp
+
+from .base import MXNetError, Registry
+from . import random as _rng
+from .ndarray.ndarray import ndarray
+
+__all__ = [
+    "Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
+    "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias", "register",
+    "create",
+]
+
+_registry: Registry = Registry("initializer")
+register = _registry.register
+
+
+class Initializer:
+    """Base initializer. Call with (name, ndarray) like the reference."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, name, arr: Optional[ndarray] = None):
+        if arr is None:
+            name, arr = "", name
+        if name.endswith("gamma"):
+            self._init_gamma(name, arr)
+        elif name.endswith("beta"):
+            self._init_beta(name, arr)
+        elif name.endswith("running_mean") or name.endswith("running_var") or \
+                name.endswith("moving_mean"):
+            self._init_zero(name, arr)
+        elif name.endswith("moving_var"):
+            self._init_one(name, arr)
+        elif name.endswith("bias"):
+            self._init_bias(name, arr)
+        else:
+            self._init_weight(name, arr)
+
+    def init_array(self, arr: ndarray):
+        self._init_weight("", arr)
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    def _init_bias(self, name, arr):
+        arr._data = jnp.zeros(arr.shape, arr._data.dtype)
+
+    def _init_gamma(self, name, arr):
+        arr._data = jnp.ones(arr.shape, arr._data.dtype)
+
+    def _init_beta(self, name, arr):
+        arr._data = jnp.zeros(arr.shape, arr._data.dtype)
+
+    def _init_zero(self, name, arr):
+        arr._data = jnp.zeros(arr.shape, arr._data.dtype)
+
+    def _init_one(self, name, arr):
+        arr._data = jnp.ones(arr.shape, arr._data.dtype)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+    def dumps(self):
+        import json
+        return json.dumps([type(self).__name__.lower(), self._kwargs])
+
+
+@register(aliases=["zeros"])
+class Zero(Initializer):
+    def _init_weight(self, name, arr):
+        arr._data = jnp.zeros(arr.shape, arr._data.dtype)
+
+
+@register(aliases=["ones"])
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        arr._data = jnp.ones(arr.shape, arr._data.dtype)
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        v = self.value
+        if isinstance(v, ndarray):
+            v = v._data
+        arr._data = jnp.broadcast_to(jnp.asarray(v, arr._data.dtype), arr.shape)
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        k = _rng.next_key()
+        arr._data = jax.random.uniform(k, arr.shape, arr._data.dtype,
+                                       -self.scale, self.scale)
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        k = _rng.next_key()
+        arr._data = (jax.random.normal(k, arr.shape, arr._data.dtype)
+                     * self.sigma)
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, arr):
+        k = _rng.next_key()
+        nout = arr.shape[0]
+        nin = int(_onp.prod(arr.shape[1:])) if len(arr.shape) > 1 else 1
+        if self.rand_type == "uniform":
+            tmp = jax.random.uniform(k, (nout, nin), jnp.float32, -1.0, 1.0)
+        else:
+            tmp = jax.random.normal(k, (nout, nin), jnp.float32)
+        u, _, v = jnp.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        arr._data = (self.scale * q).reshape(arr.shape).astype(arr._data.dtype)
+
+
+@register
+class Xavier(Initializer):
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise MXNetError(f"Xavier requires ndim>=2, got shape {shape} "
+                             f"for {name}")
+        if len(shape) > 2:
+            hw_scale = float(_onp.prod(shape[2:]))
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise MXNetError("invalid factor_type")
+        scale = math.sqrt(self.magnitude / factor)
+        k = _rng.next_key()
+        if self.rnd_type == "uniform":
+            arr._data = jax.random.uniform(k, shape, arr._data.dtype,
+                                           -scale, scale)
+        elif self.rnd_type == "gaussian":
+            arr._data = jax.random.normal(k, shape, arr._data.dtype) * scale
+        else:
+            raise MXNetError("invalid rnd_type")
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        weight = _onp.zeros(int(_onp.prod(shape)), dtype=_onp.float32)
+        f = _onp.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(_onp.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr._data = jnp.asarray(weight.reshape(shape), arr._data.dtype)
+
+
+@register
+class LSTMBias(Initializer):
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        b = _onp.zeros(arr.shape, dtype=_onp.float32)
+        num_hidden = int(arr.shape[0] / 4)
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        arr._data = jnp.asarray(b, arr._data.dtype)
+
+
+def create(initializer, **kwargs):
+    if initializer is None:
+        return Uniform()
+    if isinstance(initializer, Initializer):
+        return initializer
+    if isinstance(initializer, str):
+        cls = _registry.get(initializer)
+        return cls(**kwargs)
+    raise MXNetError(f"cannot create initializer from {initializer!r}")

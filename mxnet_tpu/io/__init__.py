@@ -1,0 +1,197 @@
+"""`mx.io` — legacy DataIter interface (parity: `python/mxnet/io/` over
+`src/io/`). The Gluon `DataLoader` is the primary pipeline; these iterators
+cover reference API users (NDArrayIter, CSVIter-style)."""
+from __future__ import annotations
+
+from collections import namedtuple
+from typing import List, Optional
+
+import numpy as _onp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import ndarray
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
+           "ResizeIter", "PrefetchingIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
+    def __new__(cls, name, shape, dtype=_onp.float32, layout="NCHW"):
+        return super().__new__(cls, name, shape, dtype, layout)
+
+
+class DataBatch:
+    def __init__(self, data, label=None, pad=0, index=None,
+                 provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        raise NotImplementedError
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        try:
+            self.next()
+            return True
+        except StopIteration:
+            return False
+
+
+class NDArrayIter(DataIter):
+    """Iterator over in-memory arrays (parity: `python/mxnet/io/io.py` NDArrayIter)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data", label_name="softmax_label"):
+        super().__init__(batch_size)
+        from ..numpy import array
+
+        def _norm(d, default_name):
+            if d is None:
+                return []
+            if isinstance(d, (ndarray, _onp.ndarray)):
+                return [(default_name, array(d) if isinstance(d, _onp.ndarray) else d)]
+            if isinstance(d, dict):
+                return [(k, array(v) if isinstance(v, _onp.ndarray) else v)
+                        for k, v in d.items()]
+            return [(f"{default_name}_{i}", array(v) if isinstance(v, _onp.ndarray) else v)
+                    for i, v in enumerate(d)]
+
+        self.data = _norm(data, data_name)
+        self.label = _norm(label, label_name)
+        self.num_data = self.data[0][1].shape[0]
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.cursor = -batch_size
+        self._order = _onp.arange(self.num_data)
+        if shuffle:
+            _onp.random.shuffle(self._order)
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+        if self.shuffle:
+            _onp.random.shuffle(self._order)
+
+    def next(self):
+        self.cursor += self.batch_size
+        if self.cursor >= self.num_data:
+            raise StopIteration
+        end = self.cursor + self.batch_size
+        pad = max(0, end - self.num_data)
+        if pad and self.last_batch_handle == "discard":
+            raise StopIteration
+        idx = self._order[self.cursor:min(end, self.num_data)]
+        if pad:
+            idx = _onp.concatenate([idx, self._order[:pad]])
+        from ..numpy import array
+        data = [array(v.asnumpy()[idx]) for _, v in self.data]
+        label = [array(v.asnumpy()[idx]) for _, v in self.label]
+        return DataBatch(data=data, label=label, pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+class CSVIter(DataIter):
+    """CSV iterator (parity: `src/io/iter_csv.cc`)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, **kwargs):
+        super().__init__(batch_size)
+        data = _onp.loadtxt(data_csv, delimiter=",", dtype=_onp.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = _onp.loadtxt(label_csv, delimiter=",", dtype=_onp.float32)
+            label = label.reshape((-1,) + tuple(label_shape))
+        self._inner = NDArrayIter(data, label, batch_size, **kwargs)
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to a fixed number of batches (parity io.py)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def next(self):
+        if self.cur == self.size:
+            raise StopIteration
+        try:
+            batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            batch = self.data_iter.next()
+        self.cur += 1
+        return batch
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch (parity: `src/io/iter_prefetcher.h`)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        if not isinstance(iters, list):
+            iters = [iters]
+        super().__init__(iters[0].batch_size)
+        self.iters = iters
+        import queue
+        import threading
+        self._q = queue.Queue(maxsize=4)
+        self._stop = threading.Event()
+
+        def _worker():
+            while not self._stop.is_set():
+                try:
+                    b = [it.next() for it in self.iters]
+                    self._q.put(b)
+                except StopIteration:
+                    self._q.put(None)
+                    return
+        self._thread = threading.Thread(target=_worker, daemon=True)
+        self._thread.start()
+
+    def next(self):
+        b = self._q.get()
+        if b is None:
+            raise StopIteration
+        return b[0] if len(b) == 1 else b

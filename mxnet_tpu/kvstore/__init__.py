@@ -1,0 +1,6 @@
+"""`mx.kv` — KVStore distributed parameter interface
+(parity: `python/mxnet/kvstore/`)."""
+from .base import KVStoreBase
+from .kvstore import KVStore, create
+
+__all__ = ["KVStoreBase", "KVStore", "create"]

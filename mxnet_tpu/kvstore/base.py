@@ -1,0 +1,66 @@
+"""KVStore plugin base + registry (parity: `python/mxnet/kvstore/base.py`).
+
+The reference's KVStore hierarchy (local comm trees, NCCL, ps-lite PS —
+`src/kvstore/`) collapses on TPU to XLA collectives under GSPMD; the
+`KVStoreBase` registry is retained so user code (`gluon.Trainer`,
+Horovod-style plugins) ports unchanged.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from ..base import MXNetError, Registry
+
+__all__ = ["KVStoreBase"]
+
+
+class KVStoreBase:
+    """Abstract KVStore interface (broadcast/pushpull/push/pull)."""
+
+    kv_registry: Registry = Registry("kvstore")
+
+    OPTIMIZER = "optimizer"
+
+    # -- interface ----------------------------------------------------------
+    def broadcast(self, key, value, out, priority=0):
+        raise NotImplementedError
+
+    def pushpull(self, key, value, out=None, priority=0):
+        raise NotImplementedError
+
+    def push(self, key, value, priority=0):
+        raise NotImplementedError
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        raise NotImplementedError
+
+    def set_optimizer(self, optimizer):
+        raise NotImplementedError
+
+    @staticmethod
+    def is_capable(capability: str) -> bool:
+        raise NotImplementedError
+
+    @property
+    def type(self) -> str:
+        raise NotImplementedError
+
+    @property
+    def rank(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def num_workers(self) -> int:
+        raise NotImplementedError
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        raise NotImplementedError
+
+    def load_optimizer_states(self, fname):
+        raise NotImplementedError
+
+    # -- registry -----------------------------------------------------------
+    @staticmethod
+    def register(klass):
+        KVStoreBase.kv_registry.register(klass)
+        return klass

@@ -1,0 +1,184 @@
+"""KVStore implementation (parity: `src/kvstore/kvstore_local.h:65`,
+`kvstore_dist.h:43`, Python `python/mxnet/kvstore/kvstore.py`).
+
+Semantics preserved from the reference:
+- `init/broadcast` seeds a per-key value; `push` aggregates (sums) a list of
+  device values into the store (running the optimizer updater server-side if
+  one is set, like `update_on_kvstore`); `pull` copies the stored value out;
+  `pushpull` fuses both.
+- `local`/`device` types are single-process. On multi-host deployments the
+  same API is driven by `jax.distributed` + GSPMD collectives — the
+  per-key ZMQ push/pull of the reference's PS (`ps::KVWorker`) has no TPU
+  analog and sync data-parallel is expressed as sharded computation instead
+  (SURVEY.md §2.4); `dist_sync`/`dist_device_sync` here alias to the local
+  aggregation + collective path so Trainer code runs unchanged.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import ndarray
+from ..optimizer import Optimizer, Updater, get_updater
+from .base import KVStoreBase
+
+__all__ = ["KVStore", "create"]
+
+
+@KVStoreBase.register
+class KVStore(KVStoreBase):
+    """Single-controller KVStore covering local/device/dist types."""
+
+    def __init__(self, kv_type: str = "local"):
+        self._type = kv_type
+        self._store: Dict[str, ndarray] = {}
+        self._updater: Optional[Updater] = None
+        self._optimizer: Optional[Optimizer] = None
+        self._barrier_count = 0
+
+    # -- identity -----------------------------------------------------------
+    @property
+    def type(self) -> str:
+        return self._type
+
+    @property
+    def rank(self) -> int:
+        try:
+            return jax.process_index()
+        except Exception:
+            return 0
+
+    @property
+    def num_workers(self) -> int:
+        if self._type.startswith("dist"):
+            try:
+                return jax.process_count()
+            except Exception:
+                return 1
+        return 1
+
+    @staticmethod
+    def is_capable(capability: str) -> bool:
+        return capability in ("optimizer",)
+
+    # -- core ops -----------------------------------------------------------
+    def _key(self, key) -> str:
+        return str(key)
+
+    def init(self, key, value):
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        values = value if isinstance(value, (list, tuple)) else [value]
+        for k, v in zip(keys, values):
+            self._store[self._key(k)] = v.copy()
+
+    def broadcast(self, key, value, out, priority=0):
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        values = value if isinstance(value, (list, tuple)) else [value]
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for k, v in zip(keys, values):
+            self._store[self._key(k)] = v.copy()
+        for k, o in zip(keys, outs):
+            olist = o if isinstance(o, (list, tuple)) else [o]
+            for oi in olist:
+                oi._data = jnp.asarray(self._store[self._key(k)]._data)
+
+    def _aggregate(self, vlist) -> jax.Array:
+        if isinstance(vlist, ndarray):
+            return vlist._data
+        if len(vlist) == 1:
+            return vlist[0]._data
+        acc = vlist[0]._data
+        for v in vlist[1:]:
+            acc = acc + v._data
+        return acc
+
+    def push(self, key, value, priority=0):
+        keys, values = _normalize(key, value)
+        for k, vlist in zip(keys, values):
+            kk = self._key(k)
+            agg = self._aggregate(vlist)
+            if kk not in self._store:
+                from ..ndarray.ndarray import from_jax
+                self._store[kk] = from_jax(jnp.zeros_like(agg))
+            stored = self._store[kk]
+            if self._updater is not None:
+                from ..ndarray.ndarray import from_jax
+                self._updater(kk, from_jax(agg, stored._device), stored)
+            else:
+                stored._data = agg
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = _normalize(key, out)
+        for k, olist in zip(keys, outs):
+            kk = self._key(k)
+            if kk not in self._store:
+                raise MXNetError(f"key {k} has not been initialised")
+            src = self._store[kk]._data
+            if isinstance(olist, ndarray):
+                olist = [olist]
+            for o in olist:
+                o._data = jnp.asarray(src)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    # -- optimizer (update_on_kvstore parity) --------------------------------
+    def set_optimizer(self, optimizer: Optimizer):
+        self._optimizer = optimizer
+        self._updater = get_updater(optimizer)
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    # -- distributed scaffolding --------------------------------------------
+    def barrier(self):
+        self._barrier_count += 1  # single-controller: no-op
+
+    def set_gradient_compression(self, compression_params):
+        # ICI is bandwidth-rich; 1/2-bit compression is a documented non-goal
+        # (SURVEY.md §2.4); accepted and ignored for API parity.
+        self._compression = compression_params
+
+
+def _normalize(key, value):
+    """Normalise (key, value) to parallel lists: keys -> list, value[i] ->
+    ndarray or list-of-ndarray (device copies). Mirrors the reference's
+    `_ctype_key_value` grouping rules."""
+    if isinstance(key, (list, tuple)):
+        return list(key), list(value)
+    return [key], [value]
+
+
+def create(name="local") -> KVStore:
+    """Create a KVStore (parity: `mx.kv.create`). Types: local, device,
+    dist_sync, dist_device_sync, dist_async (async degrades to sync), nccl
+    (alias of device on TPU), horovod/byteps if such plugins are registered."""
+    if not isinstance(name, str):
+        raise MXNetError("name must be str")
+    base = name.split("_")[0] if name.startswith("dist") else name
+    plugin = KVStoreBase.kv_registry.find(name)
+    if plugin is not None and plugin is not KVStore:
+        return plugin()
+    if name in ("local", "device", "nccl", "dist_sync", "dist_device_sync",
+                "dist_async", "dist", "p3"):
+        return KVStore(name)
+    raise MXNetError(f"unknown kvstore type {name!r}")

@@ -1,0 +1,7 @@
+"""Flagship model implementations (BERT, Transformer NMT, GPT-style LM).
+
+These are the benchmark/workload-parity models named in BASELINE.json's
+configs; vision classification models live in `gluon.model_zoo.vision`.
+"""
+from . import bert  # noqa: F401
+from .bert import BertModel, BertForPretraining, bert_base, bert_large  # noqa: F401

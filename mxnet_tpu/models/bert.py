@@ -1,0 +1,171 @@
+"""BERT (flagship benchmark model — BASELINE.json north-star config #3:
+"BERT-base pretraining (GluonNLP, KVStore data-parallel → ICI all-reduce)").
+
+Gluon-style HybridBlocks; attention lowers to the fused multi-head attention
+op (Pallas flash kernel on TPU, `mxnet_tpu/ops/attention.py`). Layer naming
+matches `parallel.sharding.default_tp_rules` so tensor parallelism works by
+annotation alone; sequence parallelism slots in by swapping the attention op
+for `parallel.ring_attention` (see `parallel/ring_attention.py`).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from .. import numpy as np
+from .. import numpy_extension as npx
+
+__all__ = ["BertConfig", "BertModel", "BertForPretraining", "bert_base",
+           "bert_large"]
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768, num_layers=12,
+                 num_heads=12, intermediate_size=3072, max_position=512,
+                 type_vocab_size=2, dropout=0.1, layer_norm_eps=1e-12,
+                 dtype="float32"):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size
+        self.max_position = max_position
+        self.type_vocab_size = type_vocab_size
+        self.dropout = dropout
+        self.layer_norm_eps = layer_norm_eps
+        self.dtype = dtype
+
+
+def bert_base(**kwargs):
+    return BertConfig(**kwargs)
+
+
+def bert_large(**kwargs):
+    cfg = dict(hidden_size=1024, num_layers=24, num_heads=16,
+               intermediate_size=4096)
+    cfg.update(kwargs)
+    return BertConfig(**cfg)
+
+
+class BertSelfAttention(HybridBlock):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.num_heads = cfg.num_heads
+        # single fused qkv projection: one big MXU matmul (column-parallel
+        # under TP: name matches the 'qkv' sharding rule)
+        self.attn_qkv = nn.Dense(3 * h, in_units=h, flatten=False,
+                                 dtype=cfg.dtype)
+        self.attn_proj = nn.Dense(h, in_units=h, flatten=False,
+                                  dtype=cfg.dtype)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, x, attn_mask=None):
+        qkv = self.attn_qkv(x)                      # (B, L, 3H)
+        h = qkv.shape[-1] // 3
+        q = qkv[..., :h]
+        k = qkv[..., h:2 * h]
+        v = qkv[..., 2 * h:]
+        ctx = npx.multi_head_attention(q, k, v, self.num_heads,
+                                       mask=attn_mask)
+        return self.dropout(self.attn_proj(ctx))
+
+
+class BertLayer(HybridBlock):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.attention = BertSelfAttention(cfg)
+        self.attn_norm = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                                      in_channels=cfg.hidden_size)
+        self.ffn_intermediate = nn.Dense(cfg.intermediate_size,
+                                         in_units=cfg.hidden_size,
+                                         flatten=False, dtype=cfg.dtype)
+        self.ffn_output = nn.Dense(cfg.hidden_size,
+                                   in_units=cfg.intermediate_size,
+                                   flatten=False, dtype=cfg.dtype)
+        self.ffn_norm = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                                     in_channels=cfg.hidden_size)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, x, attn_mask=None):
+        x = self.attn_norm(x + self.attention(x, attn_mask))
+        y = npx.gelu(self.ffn_intermediate(x))
+        y = self.dropout(self.ffn_output(y))
+        return self.ffn_norm(x + y)
+
+
+class BertModel(HybridBlock):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.word_embed = nn.Embedding(cfg.vocab_size, cfg.hidden_size,
+                                       dtype=cfg.dtype)
+        self.token_type_embed = nn.Embedding(cfg.type_vocab_size,
+                                             cfg.hidden_size, dtype=cfg.dtype)
+        self.position_embed = nn.Embedding(cfg.max_position, cfg.hidden_size,
+                                           dtype=cfg.dtype)
+        self.embed_norm = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                                       in_channels=cfg.hidden_size)
+        self.embed_dropout = nn.Dropout(cfg.dropout)
+        self.layers = nn.HybridSequential()
+        for _ in range(cfg.num_layers):
+            self.layers.add(BertLayer(cfg))
+        self.pooler = nn.Dense(cfg.hidden_size, in_units=cfg.hidden_size,
+                               activation="tanh", flatten=False,
+                               dtype=cfg.dtype)
+
+    def forward(self, input_ids, token_types=None, valid_length=None):
+        b, l = input_ids.shape
+        pos = npx.arange_like(input_ids, axis=1).astype("int32")
+        x = self.word_embed(input_ids)
+        x = x + self.position_embed(pos.reshape(1, l))
+        if token_types is not None:
+            x = x + self.token_type_embed(token_types)
+        x = self.embed_dropout(self.embed_norm(x))
+
+        mask = None
+        if valid_length is not None:
+            steps = npx.arange_like(input_ids, axis=1)
+            mask = (steps.reshape(1, 1, l) <
+                    valid_length.reshape(b, 1, 1)).astype("float32")
+            mask = mask.reshape(b, 1, 1, l)
+
+        for layer in self.layers:
+            x = layer(x, mask)
+        pooled = self.pooler(x[:, 0])
+        return x, pooled
+
+
+class BertForPretraining(HybridBlock):
+    """MLM + NSP heads (GluonNLP BERTForPretrain parity)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.mlm_dense = nn.Dense(cfg.hidden_size, in_units=cfg.hidden_size,
+                                  flatten=False, dtype=cfg.dtype)
+        self.mlm_norm = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                                     in_channels=cfg.hidden_size)
+        self.mlm_decoder = nn.Dense(cfg.vocab_size, in_units=cfg.hidden_size,
+                                    flatten=False, dtype=cfg.dtype)
+        self.nsp_classifier = nn.Dense(2, in_units=cfg.hidden_size,
+                                       dtype=cfg.dtype)
+
+    def forward(self, input_ids, token_types=None, valid_length=None):
+        seq, pooled = self.bert(input_ids, token_types, valid_length)
+        mlm = self.mlm_decoder(self.mlm_norm(npx.gelu(self.mlm_dense(seq))))
+        nsp = self.nsp_classifier(pooled)
+        return mlm, nsp
+
+    @staticmethod
+    def flops_per_token(cfg: BertConfig, seq_len: int) -> float:
+        """Training FLOPs/token (fwd+bwd ≈ 6·params + attention terms)."""
+        h, l, i = cfg.hidden_size, cfg.num_layers, cfg.intermediate_size
+        per_layer = 4 * h * h + 2 * h * i  # qkv+proj + ffn (matmul mults)
+        embed = 0  # lookups are bandwidth, not FLOPs
+        mlm = cfg.vocab_size * h + h * h
+        params_matmul = l * per_layer + mlm
+        attn = l * 2 * seq_len * h  # QK^T + PV per token
+        return 6.0 * (params_matmul + attn)

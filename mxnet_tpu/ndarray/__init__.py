@@ -1,0 +1,30 @@
+"""`mx.nd` — legacy ndarray namespace (parity: `python/mxnet/ndarray/`).
+
+In the reference this is a separate generated-op namespace with its own C++
+kernels; here it shares the `mx.np` implementation (the 2.x NumPy front end is
+primary; `mx.nd` is a compatibility surface).
+"""
+from .ndarray import NDArray, ndarray, apply_op, from_jax, as_jax, is_tracer
+
+
+def waitall():
+    """Block until all async computation is done (parity:
+    `python/mxnet/ndarray/ndarray.py:248`). PjRt orders everything by
+    dataflow; an explicit global barrier is only approximated by syncing
+    live arrays, so this is a no-op barrier on the default device."""
+    import jax
+    jax.effects_barrier()
+
+
+def _populate():
+    from .. import numpy as _mnp
+    g = globals()
+    for name in dir(_mnp):
+        if name.startswith("_"):
+            continue
+        if name not in g:
+            g[name] = getattr(_mnp, name)
+
+
+_populate()
+del _populate

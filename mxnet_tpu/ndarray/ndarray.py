@@ -1,0 +1,610 @@
+"""The `ndarray` tensor type: a mutable, device-placed handle over `jax.Array`.
+
+TPU-native re-design of the reference NDArray (`include/mxnet/ndarray.h:82`,
+`src/ndarray/ndarray.cc`, Python `python/mxnet/numpy/multiarray.py:275`).
+Key mappings (SURVEY.md §7):
+
+- async engine semantics  -> PjRt async dispatch; `wait_to_read()` ≈
+  `block_until_ready()`; there is no dependency engine to re-implement because
+  jax arrays already carry dataflow ordering.
+- mutability (`+=`, sliced assignment, optimizer in-place updates) -> the
+  Python handle is mutable: each mutating op rebinds `self._data` to a new
+  functional value (`x.at[idx].set(v)`); under `jax.jit` XLA recovers true
+  in-place updates via buffer aliasing/donation.
+- autograd entry (`AGInfo`, `friend class Imperative`) -> `_ag_node` tape ref
+  (see `mxnet_tpu/_tape.py`).
+- storage types: dense only; `row_sparse`/`csr` are a documented non-goal on
+  XLA (SURVEY.md §7 hard parts).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .. import _tape
+from ..base import MXNetError
+from ..device import Device, current_device
+
+__all__ = [
+    "ndarray", "NDArray", "apply_op", "from_jax", "as_jax", "wrap_like",
+    "is_tracer",
+]
+
+_float_types = (jnp.float32, jnp.float64, jnp.float16, jnp.bfloat16)
+
+
+def is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _is_inexact(x) -> bool:
+    try:
+        return jnp.issubdtype(x.dtype, jnp.inexact)
+    except Exception:
+        return False
+
+
+class ndarray:
+    """N-dimensional array on a device.
+
+    Wraps a `jax.Array` (or a tracer during `hybridize()` compilation). The
+    wrapper is *mutable*: in-place operators rebind the underlying value,
+    preserving the reference's NDArray API semantics.
+    """
+
+    __slots__ = ("_data", "_device", "_ag_node", "_ag_out_index", "_grad",
+                 "_grad_req", "__weakref__")
+
+    # make ndarray win against numpy scalars in binary ops
+    __array_priority__ = 1000.0
+
+    def __init__(self, data, device: Optional[Device] = None, _no_copy=False):
+        if isinstance(data, ndarray):
+            data = data._data
+        if not _no_copy and not isinstance(data, (jax.Array, jax.core.Tracer)):
+            data = jnp.asarray(data)
+        self._data = data
+        self._device = device or current_device()
+        self._ag_node = None
+        self._ag_out_index = 0
+        self._grad = None
+        self._grad_req = "null"
+
+    # ------------------------------------------------------------------
+    # basic properties
+    # ------------------------------------------------------------------
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._data.dtype)
+
+    @property
+    def size(self) -> int:
+        return int(_np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    @property
+    def device(self) -> Device:
+        return self._device
+
+    @property
+    def ctx(self) -> Device:  # legacy alias
+        return self._device
+
+    @property
+    def context(self) -> Device:  # legacy alias
+        return self._device
+
+    @property
+    def T(self) -> "ndarray":
+        return apply_op(jnp.transpose, (self,), {})
+
+    @property
+    def stype(self) -> str:
+        return "default"  # dense only
+
+    @property
+    def grad(self) -> Optional["ndarray"]:
+        return self._grad
+
+    # ------------------------------------------------------------------
+    # engine / async parity
+    # ------------------------------------------------------------------
+    def wait_to_read(self):
+        if not is_tracer(self._data):
+            self._data.block_until_ready()
+
+    def wait_to_write(self):
+        self.wait_to_read()
+
+    # ------------------------------------------------------------------
+    # conversion
+    # ------------------------------------------------------------------
+    def asnumpy(self) -> _np.ndarray:
+        if is_tracer(self._data):
+            raise MXNetError("cannot convert a traced (deferred-compute) "
+                             "ndarray to numpy inside jit")
+        return _np.asarray(self._data)
+
+    def asscalar(self):
+        return self.item()
+
+    def item(self, *args):
+        return self.asnumpy().item(*args)
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __dlpack__(self, **kwargs):
+        return self._data.__dlpack__(**kwargs)
+
+    def __dlpack_device__(self):
+        return self._data.__dlpack_device__()
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("The truth value of an ndarray with multiple "
+                             "elements is ambiguous.")
+        if is_tracer(self._data):
+            # allow python control flow on tracers to fail loudly
+            return bool(self._data)
+        return bool(self.item())
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self):
+        if is_tracer(self._data):
+            return f"ndarray(<traced> shape={self.shape}, dtype={self.dtype})"
+        return f"{self.asnumpy()!r}".replace("array", "ndarray", 1) + \
+            f" @{self._device}"
+
+    def __str__(self):
+        if is_tracer(self._data):
+            return self.__repr__()
+        return str(self.asnumpy())
+
+    def __hash__(self):
+        return id(self)
+
+    # ------------------------------------------------------------------
+    # device movement / copies
+    # ------------------------------------------------------------------
+    def to_device(self, device) -> "ndarray":
+        device = Device(device) if not isinstance(device, Device) else device
+        data = self._data
+        if not is_tracer(data):
+            data = jax.device_put(data, device.jax_device)
+        return ndarray(data, device, _no_copy=True)
+
+    def as_in_ctx(self, device) -> "ndarray":
+        return self.to_device(device)
+
+    as_in_context = as_in_ctx
+    copyto_device = to_device
+
+    def copy(self) -> "ndarray":
+        return apply_op(lambda x: x + 0, (self,), {}, name="copy")
+
+    def copyto(self, other) -> "ndarray":
+        if isinstance(other, Device):
+            return self.to_device(other)
+        if isinstance(other, ndarray):
+            other._data = jnp.broadcast_to(self._data, other.shape).astype(other.dtype)
+            if not is_tracer(other._data):
+                other._data = jax.device_put(other._data, other._device.jax_device)
+            return other
+        raise TypeError(f"copyto does not support {type(other)}")
+
+    def astype(self, dtype, copy=True) -> "ndarray":
+        if not copy and self.dtype == _np.dtype(dtype):
+            return self
+        return apply_op(lambda x: x.astype(dtype), (self,), {}, name="astype")
+
+    def as_np_ndarray(self):
+        return self
+
+    def as_nd_ndarray(self):
+        return self
+
+    # ------------------------------------------------------------------
+    # autograd API
+    # ------------------------------------------------------------------
+    def attach_grad(self, grad_req: str = "write", stype=None):
+        """Allocate gradient buffer and mark this array as a variable.
+
+        Parity: `autograd.mark_variables` / `python/mxnet/autograd.py:196`.
+        """
+        if grad_req not in ("write", "add", "null"):
+            raise MXNetError(f"invalid grad_req {grad_req!r}")
+        self._grad_req = grad_req
+        if grad_req == "null":
+            self._grad = None
+        else:
+            self._grad = ndarray(jnp.zeros(self.shape, self._data.dtype),
+                                 self._device, _no_copy=True)
+        # variable leaves detach from any previous graph
+        self._ag_node = None
+        self._ag_out_index = 0
+
+    def drop_grad(self):
+        self._grad = None
+        self._grad_req = "null"
+
+    def detach(self) -> "ndarray":
+        out = ndarray(self._data, self._device, _no_copy=True)
+        return out
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        from .. import autograd
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    def zero_grad(self):
+        if self._grad is not None:
+            self._grad._data = jnp.zeros_like(self._grad._data)
+
+    # ------------------------------------------------------------------
+    # indexing
+    # ------------------------------------------------------------------
+    def _index_to_jax(self, key):
+        if isinstance(key, ndarray):
+            return key._data
+        if isinstance(key, tuple):
+            return tuple(k._data if isinstance(k, ndarray) else k for k in key)
+        return key
+
+    def __getitem__(self, key):
+        jkey = self._index_to_jax(key)
+        if _is_boolean_index(jkey):
+            # data-dependent shape: block and compute on host (eager only)
+            if is_tracer(self._data):
+                raise MXNetError("boolean-mask indexing has a data-dependent "
+                                 "shape and cannot be traced under jit; use "
+                                 "npx.where or masked ops instead")
+            mask = _np.asarray(jkey) if not isinstance(jkey, tuple) else jkey
+            return ndarray(jnp.asarray(self.asnumpy()[_np.asarray(mask)]),
+                           self._device, _no_copy=True)
+        return apply_op(lambda x: x[jkey], (self,), {}, name="getitem")
+
+    def __setitem__(self, key, value):
+        jkey = self._index_to_jax(key)
+        if isinstance(value, ndarray):
+            val_args = (self, value)
+            fn = lambda x, v: x.at[jkey].set(v.astype(x.dtype))
+        else:
+            val_args = (self,)
+            vv = value
+            fn = lambda x: x.at[jkey].set(jnp.asarray(vv, x.dtype) if not _np.isscalar(vv) else vv)
+        out = apply_op(fn, val_args, {}, name="setitem")
+        self._rebind(out)
+
+    def _rebind(self, other: "ndarray"):
+        """Adopt another ndarray's value + tape ref (in-place op result)."""
+        self._data = other._data
+        self._ag_node = other._ag_node
+        self._ag_out_index = other._ag_out_index
+
+    # ------------------------------------------------------------------
+    # arithmetic operators
+    # ------------------------------------------------------------------
+    def _binary(self, other, fn, name, reflexive=False):
+        if isinstance(other, ndarray):
+            a, b = (other, self) if reflexive else (self, other)
+            return apply_op(fn, (a, b), {}, name=name)
+        if reflexive:
+            return apply_op(lambda x: fn(other, x), (self,), {}, name=name)
+        return apply_op(lambda x: fn(x, other), (self,), {}, name=name)
+
+    def __add__(self, o): return self._binary(o, jnp.add, "add")
+    def __radd__(self, o): return self._binary(o, jnp.add, "add", True)
+    def __sub__(self, o): return self._binary(o, jnp.subtract, "sub")
+    def __rsub__(self, o): return self._binary(o, jnp.subtract, "sub", True)
+    def __mul__(self, o): return self._binary(o, jnp.multiply, "mul")
+    def __rmul__(self, o): return self._binary(o, jnp.multiply, "mul", True)
+    def __truediv__(self, o): return self._binary(o, jnp.true_divide, "div")
+    def __rtruediv__(self, o): return self._binary(o, jnp.true_divide, "div", True)
+    def __floordiv__(self, o): return self._binary(o, jnp.floor_divide, "floordiv")
+    def __rfloordiv__(self, o): return self._binary(o, jnp.floor_divide, "floordiv", True)
+    def __mod__(self, o): return self._binary(o, jnp.mod, "mod")
+    def __rmod__(self, o): return self._binary(o, jnp.mod, "mod", True)
+    def __pow__(self, o): return self._binary(o, jnp.power, "pow")
+    def __rpow__(self, o): return self._binary(o, jnp.power, "pow", True)
+    def __matmul__(self, o): return self._binary(o, jnp.matmul, "matmul")
+    def __rmatmul__(self, o): return self._binary(o, jnp.matmul, "matmul", True)
+    def __neg__(self): return apply_op(jnp.negative, (self,), {}, name="neg")
+    def __pos__(self): return self
+    def __abs__(self): return apply_op(jnp.abs, (self,), {}, name="abs")
+
+    def __eq__(self, o): return self._binary(o, lambda a, b: a == b, "eq")
+    def __ne__(self, o): return self._binary(o, lambda a, b: a != b, "ne")
+    def __lt__(self, o): return self._binary(o, lambda a, b: a < b, "lt")
+    def __le__(self, o): return self._binary(o, lambda a, b: a <= b, "le")
+    def __gt__(self, o): return self._binary(o, lambda a, b: a > b, "gt")
+    def __ge__(self, o): return self._binary(o, lambda a, b: a >= b, "ge")
+
+    def __and__(self, o): return self._binary(o, jnp.bitwise_and, "and")
+    def __or__(self, o): return self._binary(o, jnp.bitwise_or, "or")
+    def __xor__(self, o): return self._binary(o, jnp.bitwise_xor, "xor")
+    def __rand__(self, o): return self._binary(o, jnp.bitwise_and, "and", True)
+    def __ror__(self, o): return self._binary(o, jnp.bitwise_or, "or", True)
+    def __rxor__(self, o): return self._binary(o, jnp.bitwise_xor, "xor", True)
+    def __invert__(self): return apply_op(jnp.invert, (self,), {}, name="invert")
+    def __lshift__(self, o): return self._binary(o, jnp.left_shift, "lshift")
+    def __rshift__(self, o): return self._binary(o, jnp.right_shift, "rshift")
+
+    # in-place: rebind handle (engine-ordered in reference; dataflow here)
+    def __iadd__(self, o):
+        self._rebind(self.__add__(o)); return self
+
+    def __isub__(self, o):
+        self._rebind(self.__sub__(o)); return self
+
+    def __imul__(self, o):
+        self._rebind(self.__mul__(o)); return self
+
+    def __itruediv__(self, o):
+        self._rebind(self.__truediv__(o)); return self
+
+    def __imod__(self, o):
+        self._rebind(self.__mod__(o)); return self
+
+    def __ipow__(self, o):
+        self._rebind(self.__pow__(o)); return self
+
+    # ------------------------------------------------------------------
+    # reductions / shape methods (numpy-style method surface)
+    # ------------------------------------------------------------------
+    def _method(self, fn, *args, **kwargs):
+        return apply_op(lambda x: fn(x, *args, **kwargs), (self,), {},
+                        name=getattr(fn, "__name__", "method"))
+
+    def sum(self, axis=None, dtype=None, out=None, keepdims=False):
+        r = self._method(jnp.sum, axis=axis, dtype=dtype, keepdims=keepdims)
+        return _write_out(r, out)
+
+    def mean(self, axis=None, dtype=None, out=None, keepdims=False):
+        r = self._method(jnp.mean, axis=axis, dtype=dtype, keepdims=keepdims)
+        return _write_out(r, out)
+
+    def max(self, axis=None, out=None, keepdims=False):
+        return _write_out(self._method(jnp.max, axis=axis, keepdims=keepdims), out)
+
+    def min(self, axis=None, out=None, keepdims=False):
+        return _write_out(self._method(jnp.min, axis=axis, keepdims=keepdims), out)
+
+    def prod(self, axis=None, dtype=None, out=None, keepdims=False):
+        return _write_out(self._method(jnp.prod, axis=axis, dtype=dtype,
+                                       keepdims=keepdims), out)
+
+    def std(self, axis=None, dtype=None, out=None, ddof=0, keepdims=False):
+        return _write_out(self._method(jnp.std, axis=axis, ddof=ddof,
+                                       keepdims=keepdims), out)
+
+    def var(self, axis=None, dtype=None, out=None, ddof=0, keepdims=False):
+        return _write_out(self._method(jnp.var, axis=axis, ddof=ddof,
+                                       keepdims=keepdims), out)
+
+    def argmax(self, axis=None, out=None, keepdims=False):
+        return _write_out(self._method(jnp.argmax, axis=axis, keepdims=keepdims), out)
+
+    def argmin(self, axis=None, out=None, keepdims=False):
+        return _write_out(self._method(jnp.argmin, axis=axis, keepdims=keepdims), out)
+
+    def cumsum(self, axis=None, dtype=None, out=None):
+        return _write_out(self._method(jnp.cumsum, axis=axis, dtype=dtype), out)
+
+    def clip(self, a_min=None, a_max=None, out=None):
+        return _write_out(self._method(jnp.clip, a_min, a_max), out)
+
+    def round(self, decimals=0, out=None):
+        return _write_out(self._method(jnp.round, decimals), out)
+
+    def abs(self): return self.__abs__()
+    def sqrt(self): return self._method(jnp.sqrt)
+    def exp(self): return self._method(jnp.exp)
+    def log(self): return self._method(jnp.log)
+    def sign(self): return self._method(jnp.sign)
+
+    def all(self, axis=None, out=None, keepdims=False):
+        return _write_out(self._method(jnp.all, axis=axis, keepdims=keepdims), out)
+
+    def any(self, axis=None, out=None, keepdims=False):
+        return _write_out(self._method(jnp.any, axis=axis, keepdims=keepdims), out)
+
+    def dot(self, b, out=None):
+        return _write_out(self._binary(b, jnp.dot, "dot"), out)
+
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        order = kwargs.get("order", "C")
+        return self._method(jnp.reshape, shape, order=order)
+
+    def reshape_like(self, other):
+        return self.reshape(other.shape)
+
+    def transpose(self, *axes):
+        if len(axes) == 0:
+            axes = None
+        elif len(axes) == 1 and isinstance(axes[0], (tuple, list, type(None))):
+            axes = axes[0]
+        return self._method(jnp.transpose, axes)
+
+    def swapaxes(self, a1, a2):
+        return self._method(jnp.swapaxes, a1, a2)
+
+    def flatten(self, order="C"):
+        return self.reshape((-1,))
+
+    def ravel(self, order="C"):
+        return self.reshape((-1,))
+
+    def squeeze(self, axis=None):
+        return self._method(jnp.squeeze, axis)
+
+    def expand_dims(self, axis):
+        return self._method(jnp.expand_dims, axis)
+
+    def repeat(self, repeats, axis=None):
+        return self._method(jnp.repeat, repeats, axis=axis)
+
+    def tile(self, reps):
+        return self._method(jnp.tile, reps)
+
+    def take(self, indices, axis=None, mode="clip"):
+        idx = indices._data if isinstance(indices, ndarray) else indices
+        return self._method(jnp.take, idx, axis=axis, mode=mode)
+
+    def broadcast_to(self, shape):
+        return self._method(jnp.broadcast_to, shape)
+
+    def broadcast_like(self, other):
+        return self.broadcast_to(other.shape)
+
+    def split(self, indices_or_sections, axis=0):
+        from .. import numpy as _mnp
+        return _mnp.split(self, indices_or_sections, axis=axis)
+
+    def slice_axis(self, axis, begin, end):
+        idx = [slice(None)] * self.ndim
+        idx[axis] = slice(begin, end)
+        return self[tuple(idx)]
+
+    def pad(self, pad_width, mode="constant", **kwargs):
+        return self._method(jnp.pad, pad_width, mode=mode, **kwargs)
+
+    def norm(self, ord=None, axis=None, keepdims=False):
+        return self._method(jnp.linalg.norm, ord=ord, axis=axis, keepdims=keepdims)
+
+    def tostype(self, stype):
+        if stype != "default":
+            raise MXNetError("sparse storage is not supported on TPU (dense only)")
+        return self
+
+    def full_like(self, fill_value):
+        return self._method(jnp.full_like, fill_value)
+
+
+NDArray = ndarray  # legacy alias (mx.nd.NDArray)
+
+
+def _is_boolean_index(jkey) -> bool:
+    def _b(k):
+        return (hasattr(k, "dtype") and _np.dtype(k.dtype) == _np.bool_
+                and getattr(k, "ndim", 0) > 0)
+    if isinstance(jkey, tuple):
+        return any(_b(k) for k in jkey)
+    return _b(jkey)
+
+
+def _write_out(result: ndarray, out: Optional[ndarray]):
+    if out is None:
+        return result
+    out._rebind(result)
+    return out
+
+
+def as_jax(x):
+    """Unwrap to a jax-compatible value."""
+    if isinstance(x, ndarray):
+        return x._data
+    return x
+
+
+def from_jax(data, device: Optional[Device] = None) -> ndarray:
+    return ndarray(data, device, _no_copy=True)
+
+
+def wrap_like(data, ref: ndarray) -> ndarray:
+    return ndarray(data, ref._device, _no_copy=True)
+
+
+# ----------------------------------------------------------------------
+# central op dispatch with autograd recording
+# ----------------------------------------------------------------------
+
+def apply_op(fn: Callable, array_args: Sequence[ndarray], kwargs: dict,
+             name: str = "op", n_out: int = 1):
+    """Execute `fn(*jax_values, **kwargs)`; record VJP if autograd is on.
+
+    Parity: `Imperative::Invoke` + `RecordOp`
+    (`src/imperative/imperative.cc:105,235`). `fn` must be a pure function of
+    its array arguments; `kwargs` are static.
+    """
+    vals = [a._data for a in array_args]
+    device = array_args[0]._device if array_args else current_device()
+
+    recording = _tape.is_recording()
+    diff_idx = []
+    if recording:
+        for i, a in enumerate(array_args):
+            if (a._ag_node is not None or a._grad_req != "null") and _is_inexact(a._data):
+                diff_idx.append(i)
+
+    if not diff_idx:
+        out = fn(*vals, **kwargs) if kwargs else fn(*vals)
+        return _wrap_outputs(out, device)
+
+    # differentiable path: capture vjp w.r.t. the tracked float inputs
+    const = list(vals)
+
+    def fn_of_diff(*diff_vals):
+        v = list(const)
+        for i, dv in zip(diff_idx, diff_vals):
+            v[i] = dv
+        return fn(*v, **kwargs) if kwargs else fn(*v)
+
+    diff_vals = [vals[i] for i in diff_idx]
+    out, vjp_fn = jax.vjp(fn_of_diff, *diff_vals)
+
+    is_multi = isinstance(out, (tuple, list))
+    outs = list(out) if is_multi else [out]
+    # only float outputs participate in the tape
+    out_avals = [(tuple(o.shape), o.dtype) for o in outs]
+    node = _tape.record_node(vjp_fn, [array_args[i] for i in diff_idx],
+                             len(outs), name=name, out_avals=out_avals,
+                             fwd_fn=fn_of_diff)
+    node.out_is_tuple = is_multi
+    wrapped = []
+    for i, o in enumerate(outs):
+        w = ndarray(o, device, _no_copy=True)
+        if jnp.issubdtype(o.dtype, jnp.inexact):
+            w._ag_node = node
+            w._ag_out_index = i
+        wrapped.append(w)
+    if not is_multi:
+        return wrapped[0]
+    return tuple(wrapped)
+
+
+def _wrap_outputs(out, device):
+    if isinstance(out, (tuple, list)):
+        return tuple(ndarray(o, device, _no_copy=True) for o in out)
+    return ndarray(out, device, _no_copy=True)

@@ -1,0 +1,78 @@
+"""Generic jnp->mx.np wrapper machinery.
+
+Replaces the reference's generated op bindings (`python/mxnet/numpy/` over the
+`_npi_*` C++ kernels, `src/operator/numpy/`, 47.7 kLoC of CUDA/C++): on TPU the
+kernel body *is* XLA, so a wrapper only needs to (1) unwrap `ndarray` handles,
+(2) route through `apply_op` so autograd records a VJP, (3) honor `out=` and
+device placement.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import numpy as _np
+
+from ..ndarray.ndarray import ndarray, apply_op, _write_out
+
+__all__ = ["wrap_fn", "scalar_or_array"]
+
+
+def _lift(fn_name, jfn, args, kwargs):
+    """Split ndarray leaves (diffable) from static args; run via apply_op."""
+    out = kwargs.pop("out", None)
+    arr_objs = []
+    arg_slots = []   # (kind, key) where kind in {'pos','kw','pos_list'}
+    conv_args = list(args)
+    conv_kwargs = dict(kwargs)
+
+    for i, a in enumerate(conv_args):
+        if isinstance(a, ndarray):
+            arg_slots.append(("pos", i, None))
+            arr_objs.append(a)
+        elif isinstance(a, (list, tuple)) and any(isinstance(x, ndarray) for x in a):
+            for j, x in enumerate(a):
+                if isinstance(x, ndarray):
+                    arg_slots.append(("pos_list", i, j))
+                    arr_objs.append(x)
+            conv_args[i] = list(a)
+    for k, a in list(conv_kwargs.items()):
+        if isinstance(a, ndarray):
+            arg_slots.append(("kw", k, None))
+            arr_objs.append(a)
+
+    def call(*avals):
+        cargs = [list(a) if isinstance(a, list) else a for a in conv_args]
+        ckw = dict(conv_kwargs)
+        for (kind, key, sub), v in zip(arg_slots, avals):
+            if kind == "pos":
+                cargs[key] = v
+            elif kind == "pos_list":
+                cargs[key][sub] = v
+            else:
+                ckw[key] = v
+        cargs = [tuple(a) if isinstance(a, list) else a for a in cargs]
+        return jfn(*cargs, **ckw)
+
+    r = apply_op(call, arr_objs, {}, name=fn_name)
+    return _write_out(r, out)
+
+
+def wrap_fn(jfn: Callable, name: Optional[str] = None) -> Callable:
+    fname = name or jfn.__name__
+
+    @functools.wraps(jfn)
+    def fn(*args, **kwargs):
+        return _lift(fname, jfn, args, kwargs)
+
+    fn.__name__ = fname
+    fn.__qualname__ = fname
+    return fn
+
+
+def scalar_or_array(x):
+    """Convert python/numpy input to something jnp accepts."""
+    if isinstance(x, ndarray):
+        return x._data
+    return x

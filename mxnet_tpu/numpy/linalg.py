@@ -1,0 +1,25 @@
+"""`mx.np.linalg` — linear algebra (parity: `src/operator/numpy/linalg/`).
+
+All kernels are XLA's native decompositions (MXNet used LAPACK/cuSOLVER).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._wrap import wrap_fn
+
+_NAMES = [
+    "norm", "inv", "det", "slogdet", "svd", "svdvals", "eig", "eigh",
+    "eigvals", "eigvalsh", "qr", "cholesky", "solve", "lstsq", "pinv",
+    "matrix_rank", "matrix_power", "multi_dot", "tensorinv", "tensorsolve",
+    "cond", "matrix_norm", "vector_norm", "cross", "diagonal", "outer",
+    "tensordot", "trace", "vecdot", "matmul",
+]
+
+_g = globals()
+for _name in _NAMES:
+    _j = getattr(jnp.linalg, _name, None)
+    if _j is not None:
+        _g[_name] = wrap_fn(_j, _name)
+
+__all__ = [n for n in _NAMES if n in _g]

@@ -1,0 +1,256 @@
+"""`mx.np.random` — stateful sampling API over JAX PRNG.
+
+Parity: `src/operator/numpy/random/` + `src/operator/random/` kernels and the
+`python/mxnet/numpy/random.py` surface. Each draw advances the global
+`mxnet_tpu.random.Generator`; inside a traced function the key comes from the
+active `key_scope` (see that module's docstring).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as _onp
+
+from .. import random as _rng
+from ..device import Device, current_device
+from ..ndarray.ndarray import ndarray, from_jax
+
+__all__ = [
+    "seed", "uniform", "normal", "randn", "rand", "randint", "choice",
+    "shuffle", "permutation", "gamma", "beta", "exponential", "poisson",
+    "multinomial", "bernoulli", "lognormal", "logistic", "gumbel", "laplace",
+    "rayleigh", "weibull", "pareto", "power", "chisquare", "f",
+    "multivariate_normal",
+]
+
+_DEFAULT_FLOAT = jnp.float32
+
+
+def seed(s):
+    _rng.seed(s)
+
+
+def _shape(size):
+    if size is None:
+        return ()
+    if isinstance(size, int):
+        return (size,)
+    return tuple(size)
+
+
+def _dev(device, ctx):
+    d = device or ctx
+    if d is None:
+        return current_device()
+    return Device(d) if not isinstance(d, Device) else d
+
+
+def _val(x):
+    return x._data if isinstance(x, ndarray) else x
+
+
+def _wrap(data, device, ctx):
+    return from_jax(data, _dev(device, ctx))
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=None, device=None, ctx=None, out=None):
+    k = _rng.next_key()
+    low, high = _val(low), _val(high)
+    shape = _shape(size) if size is not None else jnp.broadcast_shapes(
+        jnp.shape(low), jnp.shape(high))
+    r = jax.random.uniform(k, shape, dtype or _DEFAULT_FLOAT)
+    r = r * (high - low) + low
+    res = _wrap(r, device, ctx)
+    if out is not None:
+        out._rebind(res)
+        return out
+    return res
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype=None, device=None, ctx=None, out=None):
+    k = _rng.next_key()
+    loc, scale = _val(loc), _val(scale)
+    shape = _shape(size) if size is not None else jnp.broadcast_shapes(
+        jnp.shape(loc), jnp.shape(scale))
+    r = jax.random.normal(k, shape, dtype or _DEFAULT_FLOAT) * scale + loc
+    res = _wrap(r, device, ctx)
+    if out is not None:
+        out._rebind(res)
+        return out
+    return res
+
+
+def randn(*shape, dtype=None, device=None, ctx=None):
+    return normal(0.0, 1.0, size=shape or None, dtype=dtype, device=device, ctx=ctx)
+
+
+def rand(*shape, dtype=None, device=None, ctx=None):
+    return uniform(0.0, 1.0, size=shape or None, dtype=dtype, device=device, ctx=ctx)
+
+
+def randint(low, high=None, size=None, dtype=None, device=None, ctx=None, out=None):
+    if high is None:
+        low, high = 0, low
+    k = _rng.next_key()
+    r = jax.random.randint(k, _shape(size), low, high, dtype or jnp.int64
+                           if False else dtype or jnp.int32)
+    res = _wrap(r, device, ctx)
+    if out is not None:
+        out._rebind(res)
+        return out
+    return res
+
+
+def choice(a, size=None, replace=True, p=None, device=None, ctx=None, out=None):
+    k = _rng.next_key()
+    av = _val(a)
+    if isinstance(av, int):
+        av = jnp.arange(av)
+    pv = _val(p)
+    r = jax.random.choice(k, av, _shape(size), replace=replace, p=pv)
+    res = _wrap(r, device, ctx)
+    if out is not None:
+        out._rebind(res)
+        return out
+    return res
+
+
+def permutation(x, device=None, ctx=None):
+    k = _rng.next_key()
+    xv = _val(x)
+    if isinstance(xv, int):
+        xv = jnp.arange(xv)
+    return _wrap(jax.random.permutation(k, xv), device, ctx)
+
+
+def shuffle(x: ndarray):
+    k = _rng.next_key()
+    x._data = jax.random.permutation(k, x._data, axis=0)
+
+
+def gamma(shape, scale=1.0, size=None, dtype=None, device=None, ctx=None, out=None):
+    k = _rng.next_key()
+    a, scale = _val(shape), _val(scale)
+    sz = _shape(size) if size is not None else jnp.broadcast_shapes(
+        jnp.shape(a), jnp.shape(scale))
+    r = jax.random.gamma(k, jnp.asarray(a, _DEFAULT_FLOAT), sz,
+                         dtype or _DEFAULT_FLOAT) * scale
+    res = _wrap(r, device, ctx)
+    if out is not None:
+        out._rebind(res); return out
+    return res
+
+
+def beta(a, b, size=None, dtype=None, device=None, ctx=None):
+    k = _rng.next_key()
+    r = jax.random.beta(k, _val(a), _val(b), _shape(size), dtype or _DEFAULT_FLOAT)
+    return _wrap(r, device, ctx)
+
+
+def exponential(scale=1.0, size=None, dtype=None, device=None, ctx=None, out=None):
+    k = _rng.next_key()
+    r = jax.random.exponential(k, _shape(size), dtype or _DEFAULT_FLOAT) * _val(scale)
+    res = _wrap(r, device, ctx)
+    if out is not None:
+        out._rebind(res); return out
+    return res
+
+
+def poisson(lam=1.0, size=None, dtype=None, device=None, ctx=None):
+    k = _rng.next_key()
+    r = jax.random.poisson(k, _val(lam), _shape(size) or None)
+    return _wrap(r, device, ctx)
+
+
+def multinomial(n, pvals, size=None):
+    k = _rng.next_key()
+    pv = jnp.asarray(_val(pvals))
+    sz = _shape(size)
+    draws = jax.random.categorical(k, jnp.log(pv), shape=sz + (n,))
+    counts = jax.nn.one_hot(draws, pv.shape[-1], dtype=jnp.int64
+                            if False else jnp.int32).sum(axis=-2)
+    return _wrap(counts, None, None)
+
+
+def bernoulli(prob=None, logit=None, size=None, dtype=None, device=None, ctx=None):
+    k = _rng.next_key()
+    if prob is None:
+        prob = jax.nn.sigmoid(jnp.asarray(_val(logit)))
+    else:
+        prob = jnp.asarray(_val(prob))
+    sz = _shape(size) if size is not None else jnp.shape(prob)
+    r = jax.random.bernoulli(k, prob, sz)
+    return _wrap(r.astype(dtype or _DEFAULT_FLOAT), device, ctx)
+
+
+def lognormal(mean=0.0, sigma=1.0, size=None, dtype=None, device=None, ctx=None):
+    return normal(0.0, 1.0, size, dtype, device, ctx)._method_exp(mean, sigma) \
+        if False else _wrap(jnp.exp(jax.random.normal(_rng.next_key(), _shape(size),
+                            dtype or _DEFAULT_FLOAT) * _val(sigma) + _val(mean)),
+                            device, ctx)
+
+
+def logistic(loc=0.0, scale=1.0, size=None, dtype=None, device=None, ctx=None):
+    k = _rng.next_key()
+    r = jax.random.logistic(k, _shape(size), dtype or _DEFAULT_FLOAT)
+    return _wrap(r * _val(scale) + _val(loc), device, ctx)
+
+
+def gumbel(loc=0.0, scale=1.0, size=None, dtype=None, device=None, ctx=None):
+    k = _rng.next_key()
+    r = jax.random.gumbel(k, _shape(size), dtype or _DEFAULT_FLOAT)
+    return _wrap(r * _val(scale) + _val(loc), device, ctx)
+
+
+def laplace(loc=0.0, scale=1.0, size=None, dtype=None, device=None, ctx=None):
+    k = _rng.next_key()
+    r = jax.random.laplace(k, _shape(size), dtype or _DEFAULT_FLOAT)
+    return _wrap(r * _val(scale) + _val(loc), device, ctx)
+
+
+def rayleigh(scale=1.0, size=None, dtype=None, device=None, ctx=None):
+    k = _rng.next_key()
+    u = jax.random.uniform(k, _shape(size), dtype or _DEFAULT_FLOAT,
+                           minval=jnp.finfo(dtype or _DEFAULT_FLOAT).tiny)
+    return _wrap(_val(scale) * jnp.sqrt(-2.0 * jnp.log(u)), device, ctx)
+
+
+def weibull(a, size=None, dtype=None, device=None, ctx=None):
+    k = _rng.next_key()
+    u = jax.random.uniform(k, _shape(size), dtype or _DEFAULT_FLOAT,
+                           minval=jnp.finfo(dtype or _DEFAULT_FLOAT).tiny)
+    return _wrap(jnp.power(-jnp.log(u), 1.0 / jnp.asarray(_val(a))), device, ctx)
+
+
+def pareto(a, size=None, dtype=None, device=None, ctx=None):
+    k = _rng.next_key()
+    u = jax.random.uniform(k, _shape(size), dtype or _DEFAULT_FLOAT,
+                           minval=jnp.finfo(dtype or _DEFAULT_FLOAT).tiny)
+    return _wrap(jnp.power(u, -1.0 / jnp.asarray(_val(a))) - 1.0, device, ctx)
+
+
+def power(a, size=None, dtype=None, device=None, ctx=None):
+    k = _rng.next_key()
+    u = jax.random.uniform(k, _shape(size), dtype or _DEFAULT_FLOAT)
+    return _wrap(jnp.power(u, 1.0 / jnp.asarray(_val(a))), device, ctx)
+
+
+def chisquare(df, size=None, dtype=None, device=None, ctx=None):
+    return gamma(jnp.asarray(_val(df)) / 2.0, 2.0, size, dtype, device, ctx)
+
+
+def f(dfnum, dfden, size=None, dtype=None, device=None, ctx=None):
+    num = chisquare(dfnum, size, dtype, device, ctx)
+    den = chisquare(dfden, size, dtype, device, ctx)
+    return (num / _val(dfnum)) / (den / _val(dfden))
+
+
+def multivariate_normal(mean, cov, size=None, check_valid="warn", tol=1e-8,
+                        device=None, ctx=None):
+    k = _rng.next_key()
+    r = jax.random.multivariate_normal(k, jnp.asarray(_val(mean)),
+                                       jnp.asarray(_val(cov)),
+                                       _shape(size) or None)
+    return _wrap(r, device, ctx)

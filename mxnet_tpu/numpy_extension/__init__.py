@@ -1,0 +1,973 @@
+"""`mx.npx` — operator-level extension namespace.
+
+Parity: `python/mxnet/numpy_extension/` plus the dense NN op corpus
+(`src/operator/nn/`: convolution.cc:435, fully_connected.cc:251,
+batch_norm.cc:582, pooling, dropout, softmax, rnn.cc:306) and the contrib
+attention kernels (`src/operator/contrib/transformer.cc:675-1095`). Every op
+is a pure function over `ndarray`s lowering to XLA; layout is NCHW/NCW/NCDHW
+to match the reference's defaults, and the MXU-relevant ops (FC, conv,
+attention) are expressed as single large contractions so XLA tiles them onto
+the systolic array.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as _onp
+from jax import lax
+
+from ..base import MXNetError
+from ..device import current_device
+from ..ndarray.ndarray import ndarray, apply_op, from_jax, _write_out
+from .. import random as _rng
+from .. import _tape
+
+__all__ = [
+    "activation", "relu", "sigmoid", "tanh", "softrelu", "softsign", "gelu",
+    "silu", "leaky_relu", "elu", "selu", "prelu", "softmax", "log_softmax",
+    "masked_softmax", "masked_log_softmax", "fully_connected", "convolution",
+    "deconvolution", "pooling", "batch_norm", "layer_norm", "group_norm",
+    "instance_norm", "l2_normalization", "dropout", "embedding", "one_hot",
+    "pick", "topk", "sequence_mask", "arange_like", "shape_array",
+    "reshape_like", "broadcast_like", "gamma", "gammaln", "erf", "erfinv",
+    "smooth_l1", "gather_nd", "scatter_nd", "cast", "amp_cast", "amp_multicast",
+    "interleaved_matmul_selfatt_qk", "interleaved_matmul_selfatt_valatt",
+    "interleaved_matmul_encdec_qk", "interleaved_matmul_encdec_valatt",
+    "sldwin_atten_mask_like", "sldwin_atten_score", "sldwin_atten_context",
+    "multi_head_attention", "ctc_loss", "foreach", "while_loop", "cond",
+    "save", "load", "waitall", "set_np", "reset_np", "is_np_array",
+    "seed", "rnn", "intgemm_fully_connected",
+]
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+
+def _unary(fn, name):
+    def op(data, **kwargs):
+        return apply_op(lambda x: fn(x, **kwargs) if kwargs else fn(x),
+                        (data,), {}, name=name)
+    op.__name__ = name
+    return op
+
+
+relu = _unary(jax.nn.relu, "relu")
+sigmoid = _unary(jax.nn.sigmoid, "sigmoid")
+tanh = _unary(jnp.tanh, "tanh")
+softsign = _unary(jax.nn.soft_sign, "softsign")
+silu = _unary(jax.nn.silu, "silu")
+erf = _unary(jax.scipy.special.erf, "erf")
+erfinv = _unary(jax.scipy.special.erfinv, "erfinv")
+gammaln = _unary(jax.scipy.special.gammaln, "gammaln")
+gamma = _unary(lambda x: jnp.exp(jax.scipy.special.gammaln(x)), "gamma")
+
+
+def softrelu(data):
+    return apply_op(jax.nn.softplus, (data,), {}, name="softrelu")
+
+
+def gelu(data, approximation="erf"):
+    approximate = approximation in ("tanh", "fast")
+    return apply_op(lambda x: jax.nn.gelu(x, approximate=approximate), (data,),
+                    {}, name="gelu")
+
+
+def leaky_relu(data, gamma_=None, act_type="leaky", slope=0.25,
+               lower_bound=0.125, upper_bound=0.334, **kwargs):
+    if act_type == "leaky":
+        return apply_op(lambda x: jnp.where(x >= 0, x, slope * x), (data,), {},
+                        name="leaky_relu")
+    if act_type == "elu":
+        return apply_op(lambda x: jnp.where(x >= 0, x, slope * jnp.expm1(x)),
+                        (data,), {}, name="elu")
+    if act_type == "selu":
+        return apply_op(jax.nn.selu, (data,), {}, name="selu")
+    if act_type == "gelu":
+        return gelu(data)
+    if act_type == "prelu":
+        return apply_op(lambda x, g: jnp.where(x >= 0, x, g * x),
+                        (data, gamma_), {}, name="prelu")
+    if act_type == "rrelu":
+        # eval-mode rrelu: mean slope
+        s = (lower_bound + upper_bound) / 2.0
+        return apply_op(lambda x: jnp.where(x >= 0, x, s * x), (data,), {},
+                        name="rrelu")
+    raise MXNetError(f"unknown leaky_relu act_type {act_type}")
+
+
+def elu(data, alpha=1.0):
+    return apply_op(lambda x: jax.nn.elu(x, alpha), (data,), {}, name="elu")
+
+
+def selu(data):
+    return apply_op(jax.nn.selu, (data,), {}, name="selu")
+
+
+def prelu(data, gamma_):
+    return apply_op(lambda x, g: jnp.where(x >= 0, x, g * x), (data, gamma_),
+                    {}, name="prelu")
+
+
+_ACTS = {
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "log_sigmoid": jax.nn.log_sigmoid,
+    "tanh": jnp.tanh,
+    "softrelu": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+}
+
+
+def activation(data, act_type="relu", **kwargs):
+    if act_type not in _ACTS:
+        raise MXNetError(f"unknown activation {act_type!r}")
+    return apply_op(_ACTS[act_type], (data,), {}, name=act_type)
+
+
+# ---------------------------------------------------------------------------
+# softmax family
+# ---------------------------------------------------------------------------
+
+def softmax(data, length=None, axis=-1, temperature=None, use_length=False,
+            dtype=None):
+    t = temperature if temperature is not None else 1.0
+
+    if use_length and length is not None:
+        def fn(x, ln):
+            idx = jnp.arange(x.shape[axis])
+            shape = [1] * x.ndim
+            shape[axis] = x.shape[axis]
+            idx = idx.reshape(shape)
+            mask = idx < jnp.expand_dims(ln, axis=axis % x.ndim)
+            y = jax.nn.softmax(jnp.where(mask, x / t, -jnp.inf), axis=axis)
+            y = jnp.where(mask, y, 0.0)
+            return y.astype(dtype) if dtype else y
+        return apply_op(fn, (data, length), {}, name="softmax")
+
+    def fn(x):
+        y = jax.nn.softmax(x / t, axis=axis)
+        return y.astype(dtype) if dtype else y
+    return apply_op(fn, (data,), {}, name="softmax")
+
+
+def log_softmax(data, axis=-1, temperature=None, dtype=None, use_length=False,
+                length=None):
+    t = temperature if temperature is not None else 1.0
+
+    if use_length and length is not None:
+        def fn(x, ln):
+            idx = jnp.arange(x.shape[axis])
+            shape = [1] * x.ndim
+            shape[axis] = x.shape[axis]
+            idx = idx.reshape(shape)
+            mask = idx < jnp.expand_dims(ln, axis=axis % x.ndim)
+            y = jax.nn.log_softmax(jnp.where(mask, x / t, -jnp.inf), axis=axis)
+            y = jnp.where(mask, y, -jnp.inf)
+            return y.astype(dtype) if dtype else y
+        return apply_op(fn, (data, length), {}, name="log_softmax")
+
+    def fn(x):
+        y = jax.nn.log_softmax(x / t, axis=axis)
+        return y.astype(dtype) if dtype else y
+    return apply_op(fn, (data,), {}, name="log_softmax")
+
+
+def masked_softmax(data, mask=None, axis=-1, temperature=1.0, dtype=None):
+    if mask is None:
+        return softmax(data, axis=axis, temperature=temperature, dtype=dtype)
+
+    def fn(x, m):
+        y = jnp.where(m, x / temperature, -jnp.inf)
+        y = jax.nn.softmax(y, axis=axis)
+        y = jnp.where(m, y, 0.0)
+        return y.astype(dtype) if dtype else y
+    return apply_op(fn, (data, mask), {}, name="masked_softmax")
+
+
+def masked_log_softmax(data, mask=None, axis=-1, temperature=1.0, dtype=None):
+    if mask is None:
+        return log_softmax(data, axis=axis, temperature=temperature, dtype=dtype)
+
+    def fn(x, m):
+        y = jnp.where(m, x / temperature, -jnp.inf)
+        y = jax.nn.log_softmax(y, axis=axis)
+        y = jnp.where(m, y, -jnp.inf)
+        return y.astype(dtype) if dtype else y
+    return apply_op(fn, (data, mask), {}, name="masked_log_softmax")
+
+
+# ---------------------------------------------------------------------------
+# dense layers
+# ---------------------------------------------------------------------------
+
+def fully_connected(x, weight, bias=None, num_hidden=None, no_bias=False,
+                    flatten=True):
+    """y = x @ W^T + b (parity: `src/operator/nn/fully_connected.cc:251`).
+
+    weight is (num_hidden, in_units) like the reference. `flatten=True`
+    collapses all non-batch dims.
+    """
+    if no_bias or bias is None:
+        def fn(xv, wv):
+            xm = xv.reshape((xv.shape[0], -1)) if flatten else xv
+            return jnp.matmul(xm, wv.T)
+        return apply_op(fn, (x, weight), {}, name="fully_connected")
+
+    def fn(xv, wv, bv):
+        xm = xv.reshape((xv.shape[0], -1)) if flatten else xv
+        return jnp.matmul(xm, wv.T) + bv
+    return apply_op(fn, (x, weight, bias), {}, name="fully_connected")
+
+
+def _tuplize(v, n):
+    if v is None:
+        return (0,) * n if n else None
+    if isinstance(v, int):
+        return (v,) * n
+    t = tuple(v)
+    if len(t) == 1:
+        return t * n
+    return t
+
+
+def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
+                pad=None, num_filter=0, num_group=1, no_bias=False,
+                layout=None, **kwargs):
+    """N-D convolution, NC(D)HW layout (parity: `src/operator/nn/convolution.cc:435`).
+
+    weight layout: (num_filter, in_channels/num_group, *kernel) — identical to
+    the reference, mapped to `lax.conv_general_dilated` (MXU path on TPU).
+    """
+    nd = data.ndim - 2
+    stride = _tuplize(stride or 1, nd)
+    dilate = _tuplize(dilate or 1, nd)
+    pad = _tuplize(pad or 0, nd)
+    padding = [(p, p) for p in pad]
+    spatial = "".join("DHW"[3 - nd + i] for i in range(nd))
+    dn = lax.conv_dimension_numbers(
+        data.shape, weight.shape,
+        ("NC" + spatial, "OI" + spatial, "NC" + spatial))
+
+    if no_bias or bias is None:
+        def fn(x, w):
+            return lax.conv_general_dilated(
+                x, w, window_strides=stride, padding=padding,
+                rhs_dilation=dilate, dimension_numbers=dn,
+                feature_group_count=num_group)
+        return apply_op(fn, (data, weight), {}, name="convolution")
+
+    def fn(x, w, b):
+        y = lax.conv_general_dilated(
+            x, w, window_strides=stride, padding=padding,
+            rhs_dilation=dilate, dimension_numbers=dn,
+            feature_group_count=num_group)
+        return y + b.reshape((1, -1) + (1,) * nd)
+    return apply_op(fn, (data, weight, bias), {}, name="convolution")
+
+
+def deconvolution(data, weight, bias=None, kernel=None, stride=None,
+                  dilate=None, pad=None, adj=None, num_filter=0, num_group=1,
+                  no_bias=True, layout=None, target_shape=None, **kwargs):
+    """Transposed convolution (parity: `src/operator/nn/deconvolution.cc`).
+
+    Implemented as the gradient of convolution (lax.conv_transpose with
+    IOHW-style kernel flip), weight layout (in_channels, num_filter/group, *k).
+    """
+    nd = data.ndim - 2
+    stride = _tuplize(stride or 1, nd)
+    dilate = _tuplize(dilate or 1, nd)
+    pad = _tuplize(pad or 0, nd)
+    adj = _tuplize(adj or 0, nd)
+    spatial = "".join("DHW"[3 - nd + i] for i in range(nd))
+    dn = lax.conv_dimension_numbers(
+        data.shape, weight.shape,
+        ("NC" + spatial, "IO" + spatial, "NC" + spatial))
+    # output padding semantics: out = (in-1)*s - 2p + dilate*(k-1) + 1 + adj
+    padding = [(d * (k - 1) - p, d * (k - 1) - p + a)
+               for p, a, d, k in zip(pad, adj, dilate,
+                                     weight.shape[2:])]
+
+    def _deconv(x, w):
+        return lax.conv_general_dilated(
+            x, jnp.flip(w, axis=tuple(range(2, 2 + nd))),
+            window_strides=(1,) * nd, padding=padding,
+            lhs_dilation=stride, rhs_dilation=dilate,
+            dimension_numbers=dn, feature_group_count=num_group)
+
+    if no_bias or bias is None:
+        return apply_op(_deconv, (data, weight), {}, name="deconvolution")
+
+    def fn(x, w, b):
+        return _deconv(x, w) + b.reshape((1, -1) + (1,) * nd)
+    return apply_op(fn, (data, weight, bias), {}, name="deconvolution")
+
+
+def pooling(data, kernel=None, stride=None, pad=None, pool_type="max",
+            global_pool=False, pooling_convention="valid", count_include_pad=True,
+            p_value=2, layout=None, **kwargs):
+    """Pooling (parity: `src/operator/nn/pooling.cc`), NC* layout."""
+    nd = data.ndim - 2
+    if global_pool:
+        axes = tuple(range(2, 2 + nd))
+        if pool_type == "max":
+            fn = lambda x: jnp.max(x, axis=axes, keepdims=True)
+        elif pool_type == "avg":
+            fn = lambda x: jnp.mean(x, axis=axes, keepdims=True)
+        else:
+            fn = lambda x: jnp.power(
+                jnp.sum(jnp.power(jnp.abs(x), p_value), axis=axes,
+                        keepdims=True), 1.0 / p_value)
+        return apply_op(fn, (data,), {}, name="global_pool")
+
+    kernel = _tuplize(kernel, nd)
+    stride = _tuplize(stride or 1, nd)
+    pad = _tuplize(pad or 0, nd)
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if pooling_convention == "full":
+        # ceil-mode: extend padding on the right so the last window fits
+        extra = []
+        for i in range(nd):
+            in_sz = data.shape[2 + i]
+            out = math.ceil((in_sz + 2 * pad[i] - kernel[i]) / stride[i]) + 1
+            need = (out - 1) * stride[i] + kernel[i] - (in_sz + 2 * pad[i])
+            extra.append(max(0, need))
+        padding = ((0, 0), (0, 0)) + tuple(
+            (p, p + e) for p, e in zip(pad, extra))
+
+    if pool_type == "max":
+        init = -jnp.inf
+
+        def fn(x):
+            return lax.reduce_window(x, init, lax.max, window, strides, padding)
+        return apply_op(fn, (data,), {}, name="max_pool")
+
+    if pool_type in ("avg", "sum"):
+        def fn(x):
+            s = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+            if pool_type == "sum":
+                return s
+            if count_include_pad:
+                denom = float(_onp.prod(kernel))
+                return s / denom
+            ones_ = jnp.ones(x.shape, x.dtype)
+            cnt = lax.reduce_window(ones_, 0.0, lax.add, window, strides, padding)
+            return s / cnt
+        return apply_op(fn, (data,), {}, name="avg_pool")
+
+    if pool_type == "lp":
+        def fn(x):
+            s = lax.reduce_window(jnp.power(jnp.abs(x), p_value), 0.0, lax.add,
+                                  window, strides, padding)
+            return jnp.power(s, 1.0 / p_value)
+        return apply_op(fn, (data,), {}, name="lp_pool")
+    raise MXNetError(f"unknown pool_type {pool_type}")
+
+
+# ---------------------------------------------------------------------------
+# normalisation
+# ---------------------------------------------------------------------------
+
+def batch_norm(x, gamma_, beta, running_mean, running_var, eps=1e-5,
+               momentum=0.9, fix_gamma=False, use_global_stats=False,
+               output_mean_var=False, axis=1, min_calib_range=None,
+               max_calib_range=None):
+    """BatchNorm (parity: `src/operator/nn/batch_norm.cc:582`).
+
+    Training-mode selection follows autograd state like the reference
+    (train = autograd.is_training()); running stats are updated in-place on
+    the aux `ndarray`s when training.
+    """
+    training = _tape.is_training() and not use_global_stats
+    red_axes = tuple(i for i in range(x.ndim) if i != axis % x.ndim)
+    bshape = [1] * x.ndim
+    bshape[axis % x.ndim] = x.shape[axis % x.ndim]
+
+    if training:
+        def fn(xv, g, b):
+            mean = jnp.mean(xv, axis=red_axes)
+            var = jnp.var(xv, axis=red_axes)
+            g_ = jnp.ones_like(g) if fix_gamma else g
+            y = (xv - mean.reshape(bshape)) * jax.lax.rsqrt(
+                var.reshape(bshape) + eps)
+            y = y * g_.reshape(bshape) + b.reshape(bshape)
+            return y, mean, var
+        out, mean, var = apply_op(fn, (x, gamma_, beta), {}, name="batch_norm",
+                                  n_out=3)
+        # in-place running-stat update (aux state, outside autograd)
+        m = momentum
+        running_mean._data = m * running_mean._data + (1 - m) * mean._data
+        running_var._data = m * running_var._data + (1 - m) * var._data
+        if output_mean_var:
+            return out, mean, var
+        return out
+
+    def fn(xv, g, b, rm, rv):
+        g_ = jnp.ones_like(g) if fix_gamma else g
+        y = (xv - rm.reshape(bshape)) * jax.lax.rsqrt(rv.reshape(bshape) + eps)
+        return y * g_.reshape(bshape) + b.reshape(bshape)
+    out = apply_op(fn, (x, gamma_, beta, running_mean, running_var), {},
+                   name="batch_norm")
+    if output_mean_var:
+        return out, running_mean, running_var
+    return out
+
+
+def layer_norm(x, gamma_, beta, axis=-1, eps=1e-5):
+    """LayerNorm (parity: `src/operator/nn/layer_norm.cc`)."""
+    def fn(xv, g, b):
+        mean = jnp.mean(xv, axis=axis, keepdims=True)
+        var = jnp.var(xv, axis=axis, keepdims=True)
+        y = (xv - mean) * jax.lax.rsqrt(var + eps)
+        shape = [1] * xv.ndim
+        shape[axis % xv.ndim] = xv.shape[axis % xv.ndim]
+        return y * g.reshape(shape) + b.reshape(shape)
+    return apply_op(fn, (x, gamma_, beta), {}, name="layer_norm")
+
+
+def group_norm(x, gamma_, beta, num_groups=1, eps=1e-5):
+    """GroupNorm over NC+ layout (parity: `src/operator/nn/group_norm.cc`)."""
+    def fn(xv, g, b):
+        n, c = xv.shape[0], xv.shape[1]
+        rest = xv.shape[2:]
+        xg = xv.reshape((n, num_groups, c // num_groups) + rest)
+        axes = tuple(range(2, xg.ndim))
+        mean = jnp.mean(xg, axis=axes, keepdims=True)
+        var = jnp.var(xg, axis=axes, keepdims=True)
+        y = ((xg - mean) * jax.lax.rsqrt(var + eps)).reshape(xv.shape)
+        shape = (1, c) + (1,) * (xv.ndim - 2)
+        return y * g.reshape(shape) + b.reshape(shape)
+    return apply_op(fn, (x, gamma_, beta), {}, name="group_norm")
+
+
+def instance_norm(x, gamma_, beta, eps=1e-5):
+    def fn(xv, g, b):
+        axes = tuple(range(2, xv.ndim))
+        mean = jnp.mean(xv, axis=axes, keepdims=True)
+        var = jnp.var(xv, axis=axes, keepdims=True)
+        y = (xv - mean) * jax.lax.rsqrt(var + eps)
+        shape = (1, xv.shape[1]) + (1,) * (xv.ndim - 2)
+        return y * g.reshape(shape) + b.reshape(shape)
+    return apply_op(fn, (x, gamma_, beta), {}, name="instance_norm")
+
+
+def l2_normalization(data, eps=1e-10, mode="instance"):
+    def fn(x):
+        if mode == "instance":
+            axes = tuple(range(1, x.ndim))
+        elif mode == "channel":
+            axes = (1,)
+        else:  # spatial
+            axes = tuple(range(2, x.ndim))
+        n = jnp.sqrt(jnp.sum(x * x, axis=axes, keepdims=True) + eps)
+        return x / n
+    return apply_op(fn, (data,), {}, name="l2_normalization")
+
+
+# ---------------------------------------------------------------------------
+# dropout / embedding / misc
+# ---------------------------------------------------------------------------
+
+def dropout(data, p=0.5, mode="training", axes=(), cudnn_off=False):
+    """Dropout (parity: `src/operator/nn/dropout.cc`): active iff
+    autograd.is_training() or mode=='always'."""
+    active = (_tape.is_training() or mode == "always") and p > 0
+    if not active:
+        return data
+    key = _rng.next_key()
+
+    def fn(x):
+        shape = list(x.shape)
+        for ax in axes:
+            shape[ax] = 1
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+    return apply_op(fn, (data,), {}, name="dropout")
+
+
+def embedding(data, weight, input_dim=None, output_dim=None, dtype=None,
+              sparse_grad=False):
+    """Embedding lookup (parity: `src/operator/tensor/indexing_op.cc` Embedding)."""
+    def fn(idx, w):
+        out = jnp.take(w, idx.astype(jnp.int32), axis=0)
+        return out.astype(dtype) if dtype else out
+    return apply_op(fn, (data, weight), {}, name="embedding")
+
+
+def one_hot(data, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    def fn(idx):
+        oh = jax.nn.one_hot(idx.astype(jnp.int32), depth, dtype=jnp.dtype(dtype))
+        return oh * (on_value - off_value) + off_value
+    return apply_op(fn, (data,), {}, name="one_hot")
+
+
+def pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    def fn(x, idx):
+        idx = jnp.expand_dims(idx.astype(jnp.int32), axis=axis)
+        out = jnp.take_along_axis(x, idx, axis=axis, mode="clip")
+        if not keepdims:
+            out = jnp.squeeze(out, axis=axis)
+        return out
+    return apply_op(fn, (data, index), {}, name="pick")
+
+
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    def fn(x):
+        xs = jnp.moveaxis(x, axis, -1)
+        vals = -xs if is_ascend else xs
+        v, i = jax.lax.top_k(vals, k)
+        if is_ascend:
+            v = -v
+        v = jnp.moveaxis(v, -1, axis)
+        i = jnp.moveaxis(i, -1, axis)
+        if ret_typ == "value":
+            return v
+        if ret_typ == "both":
+            return v, i.astype(jnp.dtype(dtype))
+        if ret_typ == "mask":
+            raise MXNetError("topk ret_typ='mask' not supported")
+        return i.astype(jnp.dtype(dtype))
+    n_out = 2 if ret_typ == "both" else 1
+    return apply_op(fn, (data,), {}, name="topk", n_out=n_out)
+
+
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+
+    def fn(x, ln):
+        steps = jnp.arange(x.shape[axis])
+        shape = [1] * x.ndim
+        shape[axis] = x.shape[axis]
+        steps = steps.reshape(shape)
+        batch_axis = 1 - axis  # (T, N, ...) or (N, T, ...)
+        lshape = [1] * x.ndim
+        lshape[batch_axis] = x.shape[batch_axis]
+        mask = steps < ln.reshape(lshape)
+        return jnp.where(mask, x, value)
+    return apply_op(fn, (data, sequence_length), {}, name="sequence_mask")
+
+
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None, ctx=None):
+    def fn(x):
+        if axis is None:
+            n = x.size
+            out = start + step * jnp.arange(n)
+            return out.reshape(x.shape)
+        n = x.shape[axis]
+        return start + step * jnp.arange(n).astype(x.dtype)
+    return apply_op(fn, (data,), {}, name="arange_like")
+
+
+def shape_array(data):
+    return from_jax(jnp.asarray(data.shape, jnp.int64
+                                if jax.config.jax_enable_x64 else jnp.int32),
+                    data._device)
+
+
+def reshape_like(lhs, rhs):
+    return apply_op(lambda a, b: a.reshape(b.shape), (lhs, rhs), {},
+                    name="reshape_like")
+
+
+def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
+    return apply_op(lambda a, b: jnp.broadcast_to(a, b.shape), (lhs, rhs), {},
+                    name="broadcast_like")
+
+
+def smooth_l1(data, scalar=1.0):
+    s2 = scalar * scalar
+
+    def fn(x):
+        ax = jnp.abs(x)
+        return jnp.where(ax < 1.0 / s2, 0.5 * s2 * x * x, ax - 0.5 / s2)
+    return apply_op(fn, (data,), {}, name="smooth_l1")
+
+
+def gather_nd(data, indices):
+    def fn(x, idx):
+        idx = idx.astype(jnp.int32)
+        return x[tuple(idx[i] for i in range(idx.shape[0]))]
+    return apply_op(fn, (data, indices), {}, name="gather_nd")
+
+
+def scatter_nd(data, indices, shape):
+    def fn(d, idx):
+        idx = idx.astype(jnp.int32)
+        out = jnp.zeros(shape, d.dtype)
+        return out.at[tuple(idx[i] for i in range(idx.shape[0]))].set(d)
+    return apply_op(fn, (data, indices), {}, name="scatter_nd")
+
+
+def cast(data, dtype):
+    return data.astype(dtype)
+
+
+def amp_cast(data, dtype):
+    return data.astype(dtype)
+
+
+def amp_multicast(*data, num_outputs=None, cast_narrow=False):
+    arrays = list(data)
+    dtypes = [a.dtype for a in arrays]
+    widest = jnp.result_type(*dtypes)
+    target = min(dtypes, key=lambda d: jnp.finfo(d).bits) if cast_narrow \
+        else widest
+    return tuple(a.astype(target) for a in arrays)
+
+
+# ---------------------------------------------------------------------------
+# attention (parity: src/operator/contrib/transformer.cc:675-1095)
+# ---------------------------------------------------------------------------
+
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads=1):
+    """scores = Q K^T / sqrt(d) over interleaved (qlen, batch, 3*embed) input.
+
+    Parity: `_contrib_interleaved_matmul_selfatt_qk`
+    (`src/operator/contrib/transformer.cc:675`). Output
+    (batch*heads, qlen, qlen)."""
+    def fn(qkv):
+        qlen, bsz, e3 = qkv.shape
+        emb = e3 // 3
+        hd = emb // heads
+        x = qkv.reshape(qlen, bsz, heads, 3, hd)
+        q = x[:, :, :, 0]  # (L, B, H, D)
+        k = x[:, :, :, 1]
+        q = q.transpose(1, 2, 0, 3).reshape(bsz * heads, qlen, hd)
+        k = k.transpose(1, 2, 0, 3).reshape(bsz * heads, qlen, hd)
+        return jnp.einsum("bqd,bkd->bqk", q, k) / jnp.sqrt(
+            jnp.asarray(hd, q.dtype))
+    return apply_op(fn, (queries_keys_values,), {}, name="selfatt_qk")
+
+
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention, heads=1):
+    """context = softmax(scores) V (parity: transformer.cc:760)."""
+    def fn(qkv, att):
+        qlen, bsz, e3 = qkv.shape
+        emb = e3 // 3
+        hd = emb // heads
+        x = qkv.reshape(qlen, bsz, heads, 3, hd)
+        v = x[:, :, :, 2].transpose(1, 2, 0, 3).reshape(bsz * heads, qlen, hd)
+        ctx = jnp.einsum("bqk,bkd->bqd", att, v)
+        ctx = ctx.reshape(bsz, heads, qlen, hd).transpose(2, 0, 1, 3)
+        return ctx.reshape(qlen, bsz, emb)
+    return apply_op(fn, (queries_keys_values, attention), {}, name="selfatt_valatt")
+
+
+def interleaved_matmul_encdec_qk(queries, keys_values, heads=1):
+    """Parity: transformer.cc:820 — queries (qlen,B,E), kv (klen,B,2E)."""
+    def fn(q, kv):
+        qlen, bsz, emb = q.shape
+        klen = kv.shape[0]
+        hd = emb // heads
+        qh = q.reshape(qlen, bsz, heads, hd).transpose(1, 2, 0, 3)
+        qh = qh.reshape(bsz * heads, qlen, hd)
+        kvh = kv.reshape(klen, bsz, heads, 2, hd)
+        kh = kvh[:, :, :, 0].transpose(1, 2, 0, 3).reshape(bsz * heads, klen, hd)
+        return jnp.einsum("bqd,bkd->bqk", qh, kh) / jnp.sqrt(
+            jnp.asarray(hd, q.dtype))
+    return apply_op(fn, (queries, keys_values), {}, name="encdec_qk")
+
+
+def interleaved_matmul_encdec_valatt(keys_values, attention, heads=1):
+    def fn(kv, att):
+        klen, bsz, e2 = kv.shape
+        emb = e2 // 2
+        hd = emb // heads
+        kvh = kv.reshape(klen, bsz, heads, 2, hd)
+        v = kvh[:, :, :, 1].transpose(1, 2, 0, 3).reshape(bsz * heads, klen, hd)
+        qlen = att.shape[1]
+        ctx = jnp.einsum("bqk,bkd->bqd", att, v)
+        ctx = ctx.reshape(bsz, heads, qlen, hd).transpose(2, 0, 1, 3)
+        return ctx.reshape(qlen, bsz, emb)
+    return apply_op(fn, (keys_values, attention), {}, name="encdec_valatt")
+
+
+def sldwin_atten_mask_like(score, dilation, valid_length, num_heads=1,
+                           symmetric=True, w=1):
+    """Sliding-window attention mask (parity: transformer.cc:887)."""
+    def fn(s, vl):
+        bh, qlen, wlen = s.shape
+        i = jnp.arange(qlen)[:, None]
+        j = jnp.arange(wlen)[None, :]
+        # window offsets relative to i: j maps to absolute position
+        offs = (j - (wlen // 2)) * dilation
+        absj = i + offs
+        ok = (absj >= 0) & (absj < qlen)
+        if not symmetric:
+            ok = ok & (offs <= 0)
+        b = bh // num_heads
+        vl_ = jnp.repeat(vl, num_heads)
+        ok = ok[None] & (absj[None] < vl_[:, None, None]) & \
+            (i[None] < vl_[:, None, None])
+        return ok.astype(s.dtype)
+    return apply_op(fn, (score, valid_length), {}, name="sldwin_mask_like")
+
+
+def _sldwin_indices(qlen, w, dilation, symmetric):
+    wlen = (2 * w + 1) if symmetric else (w + 1)
+    i = jnp.arange(qlen)[:, None]
+    off = (jnp.arange(wlen)[None, :] - (w if symmetric else w)) * dilation
+    j = i + off
+    valid = (j >= 0) & (j < qlen)
+    return jnp.clip(j, 0, qlen - 1), valid, wlen
+
+
+def sldwin_atten_score(query, key, dilation, w=1, symmetric=True):
+    """Banded QK^T: out (B*H, L, W) (parity: transformer.cc:960).
+
+    query/key: (B*H, L, D). Computed by gathering the key window per
+    position — O(L*W*D), never materialising the (L,L) matrix."""
+    def fn(q, k):
+        bh, qlen, hd = q.shape
+        j, valid, wlen = _sldwin_indices(qlen, w, int(dilation), symmetric)
+        kg = k[:, j.reshape(-1), :].reshape(bh, qlen, wlen, hd)
+        s = jnp.einsum("bld,blwd->blw", q, kg) / jnp.sqrt(
+            jnp.asarray(hd, q.dtype))
+        return jnp.where(valid[None], s, s)
+    return apply_op(fn, (query, key), {}, name="sldwin_score")
+
+
+def sldwin_atten_context(score, value, dilation, w=1, symmetric=True):
+    """Banded attention context (parity: transformer.cc:1030)."""
+    def fn(s, v):
+        bh, qlen, wlen = s.shape
+        j, valid, _ = _sldwin_indices(qlen, w, int(dilation), symmetric)
+        vg = v[:, j.reshape(-1), :].reshape(bh, qlen, wlen, v.shape[-1])
+        return jnp.einsum("blw,blwd->bld", s, vg)
+    return apply_op(fn, (score, value), {}, name="sldwin_context")
+
+
+def multi_head_attention(query, key, value, num_heads, mask=None,
+                         dropout_p=0.0, causal=False, use_flash=True):
+    """Fused multi-head attention over (B, L, E) tensors.
+
+    New-capability op (the reference only has the interleaved primitives):
+    lowers to the Pallas flash-attention kernel on TPU when available,
+    otherwise a jnp reference path. See `mxnet_tpu.ops.attention`."""
+    from ..ops import attention as _att
+    return _att.multi_head_attention(query, key, value, num_heads, mask=mask,
+                                     dropout_p=dropout_p, causal=causal,
+                                     use_flash=use_flash)
+
+
+# ---------------------------------------------------------------------------
+# losses / sequence ops
+# ---------------------------------------------------------------------------
+
+def ctc_loss(data, label, data_lengths=None, label_lengths=None,
+             use_data_lengths=False, use_label_lengths=False, blank_label="first"):
+    """CTC loss (parity: `src/operator/nn/ctc_loss.cc`).
+
+    data: (T, B, C) alphabet scores (pre-softmax); label: (B, L) padded with
+    -1 (or 0 when blank_label='first' and labels are 1-based)."""
+    import optax
+
+    def fn(d, lbl, *rest):
+        t, b, c = d.shape
+        logits = jnp.transpose(d, (1, 0, 2))  # (B, T, C)
+        if use_data_lengths and rest:
+            dl = rest[0]
+            logit_pad = (jnp.arange(t)[None, :] >= dl[:, None]).astype(d.dtype)
+        else:
+            logit_pad = jnp.zeros((b, t), d.dtype)
+        lbl = lbl.astype(jnp.int32)
+        if blank_label == "first":
+            blank_id = 0
+        else:
+            blank_id = c - 1
+        if use_label_lengths and len(rest) == 2:
+            ll = rest[-1]
+            label_pad = (jnp.arange(lbl.shape[1])[None, :] >= ll[:, None])
+        else:
+            label_pad = lbl < 0
+        labels = jnp.where(label_pad, 0, lbl)
+        loss = optax.ctc_loss(logits, logit_pad, labels,
+                              label_pad.astype(d.dtype), blank_id=blank_id)
+        return loss
+
+    args = [data, label]
+    if use_data_lengths and data_lengths is not None:
+        args.append(data_lengths)
+    if use_label_lengths and label_lengths is not None:
+        args.append(label_lengths)
+    return apply_op(fn, tuple(args), {}, name="ctc_loss")
+
+
+# ---------------------------------------------------------------------------
+# control flow (parity: src/operator/control_flow.cc:1075,1134,1195)
+# ---------------------------------------------------------------------------
+
+def foreach(body, data, init_states):
+    """`lax.scan`-backed foreach. body(step_data, states) -> (out, states)."""
+    single_data = isinstance(data, ndarray)
+    single_state = isinstance(init_states, ndarray)
+    datas = [data] if single_data else list(data)
+    states = [init_states] if single_state else list(init_states)
+    dev = datas[0]._device
+
+    def step(carry, xs):
+        st = [from_jax(c, dev) for c in carry]
+        xv = [from_jax(x, dev) for x in xs]
+        out, new_st = body(xv[0] if single_data else xv,
+                           st[0] if single_state else st)
+        outs = [out] if isinstance(out, ndarray) else list(out)
+        new_states = [new_st] if isinstance(new_st, ndarray) else list(new_st)
+        return tuple(s._data for s in new_states), \
+            tuple(o._data for o in outs)
+
+    arrs = datas + states
+    nd_ = len(datas)
+
+    def fn(*vals):
+        xs = tuple(vals[:nd_])
+        init = tuple(vals[nd_:])
+        final, ys = lax.scan(step, init, xs)
+        return tuple(ys) + tuple(final)
+
+    res = apply_op(fn, tuple(arrs), {}, name="foreach",
+                   n_out=2)
+    res = list(res) if isinstance(res, tuple) else [res]
+    # partition: ys first, then final states — count from body signature
+    # run body once abstractly? simpler: scan returned len(ys)+len(final)
+    n_states = len(states)
+    outs = res[:-n_states] if n_states else res
+    fstates = res[-n_states:] if n_states else []
+    out = outs[0] if len(outs) == 1 else tuple(outs)
+    fst = fstates[0] if single_state else list(fstates)
+    return out, fst
+
+
+def while_loop(cond_fn, func, loop_vars, max_iterations=None):
+    """Bounded while loop (parity: `_while_loop`); max_iterations is required
+    under jit for fixed output shape; here state-only (no per-step outputs)."""
+    single = isinstance(loop_vars, ndarray)
+    lvs = [loop_vars] if single else list(loop_vars)
+    dev = lvs[0]._device
+
+    def jcond(carry):
+        st = [from_jax(c, dev) for c in carry]
+        r = cond_fn(st[0] if single else st)
+        return r._data.reshape(()) if isinstance(r, ndarray) else jnp.asarray(r)
+
+    def jbody(carry):
+        st = [from_jax(c, dev) for c in carry]
+        r = func(st[0] if single else st)
+        rl = [r] if isinstance(r, ndarray) else list(r)
+        return tuple(x._data for x in rl)
+
+    def fn(*vals):
+        return lax.while_loop(jcond, jbody, tuple(vals))
+
+    res = apply_op(fn, tuple(lvs), {}, name="while_loop")
+    if single:
+        return res if isinstance(res, ndarray) else res[0]
+    return list(res) if isinstance(res, tuple) else [res]
+
+
+def cond(pred, then_func, else_func, inputs=()):
+    """Conditional (parity: `_cond`)."""
+    single = isinstance(inputs, ndarray)
+    ins = [inputs] if single else list(inputs)
+    dev = ins[0]._device if ins else current_device()
+    pv = pred._data.reshape(()) if isinstance(pred, ndarray) else jnp.asarray(pred)
+
+    def branch(f):
+        def g(vals):
+            nd_ = [from_jax(v, dev) for v in vals]
+            r = f(*(nd_ if not single else nd_))
+            rl = [r] if isinstance(r, ndarray) else list(r)
+            return tuple(x._data for x in rl)
+        return g
+
+    def fn(*vals):
+        return lax.cond(pv.astype(bool), branch(then_func), branch(else_func),
+                        tuple(vals))
+
+    res = apply_op(fn, tuple(ins), {}, name="cond")
+    if isinstance(res, tuple) and len(res) == 1:
+        return res[0]
+    return res
+
+
+# ---------------------------------------------------------------------------
+# fused RNN op (parity: src/operator/rnn.cc:306) — see gluon.rnn for layers
+# ---------------------------------------------------------------------------
+
+def rnn(data, parameters, state, state_cell=None, mode="lstm", state_size=1,
+        num_layers=1, bidirectional=False, p=0.0, state_outputs=True,
+        projection_size=None, use_sequence_length=False, sequence_length=None,
+        **kwargs):
+    from ..gluon.rnn import _fused_rnn_op
+    return _fused_rnn_op(data, parameters, state, state_cell, mode, state_size,
+                         num_layers, bidirectional, p, state_outputs)
+
+
+def intgemm_fully_connected(data, weight, scaling=1.0, bias=None, **kwargs):
+    """int8 GEMM parity (`src/operator/contrib/intgemm/`): delegated to XLA
+    int8 dot with dequant scaling."""
+    def fn(x, w):
+        y = jnp.matmul(x.astype(jnp.int32), w.T.astype(jnp.int32))
+        return y.astype(jnp.float32) * scaling
+    if bias is None:
+        return apply_op(fn, (data, weight), {}, name="intgemm_fc")
+
+    def fnb(x, w, b):
+        y = jnp.matmul(x.astype(jnp.int32), w.T.astype(jnp.int32))
+        return y.astype(jnp.float32) * scaling + b
+    return apply_op(fnb, (data, weight, bias), {}, name="intgemm_fc")
+
+
+# ---------------------------------------------------------------------------
+# serialization / session utils
+# ---------------------------------------------------------------------------
+
+def save(fname, data):
+    """Save dict/list of ndarrays (parity: `mx.npx.save` / NDArray save in
+    `src/ndarray/ndarray.cc`). Uses `.npz` container (cnpy parity)."""
+    from ..util import save_arrays
+    save_arrays(fname, data)
+
+
+def load(fname):
+    from ..util import load_arrays
+    return load_arrays(fname)
+
+
+def waitall():
+    from ..ndarray import waitall as _w
+    _w()
+
+
+_np_active = [True]
+
+
+def set_np(shape=True, array=True, dtype=False):
+    _np_active[0] = True
+
+
+def reset_np():
+    _np_active[0] = True  # numpy semantics are always on in this framework
+
+
+def is_np_array():
+    return True
+
+
+def is_np_shape():
+    return True
+
+
+def is_np_default_dtype():
+    return False
+
+
+def seed(s):
+    _rng.seed(s)

@@ -1,0 +1,11 @@
+"""`mxnet_tpu.ops` — performance-critical op implementations.
+
+The reference backs its hot ops with hand-written CUDA (attention kernels in
+`src/operator/contrib/transformer.cc`, fused optimizers in
+`src/operator/contrib/multi_lamb.cc` etc.). Here the hot set is implemented as
+XLA-friendly jnp contractions plus Pallas TPU kernels where fusion alone is
+not enough (flash attention). See `attention.py`, `pallas/flash_attention.py`,
+`fused_optim.py`.
+"""
+from . import attention  # noqa: F401
+from . import fused_optim  # noqa: F401

@@ -1,0 +1,91 @@
+"""Attention ops: XLA reference path + Pallas flash-attention dispatch.
+
+Parity+: the reference has interleaved attention matmul kernels and
+sliding-window attention (`src/operator/contrib/transformer.cc:675-1095`) but
+no fused softmax(QK^T)V; this module provides a fused multi-head attention
+that lowers to a Pallas flash kernel on TPU (`pallas/flash_attention.py`) and
+an einsum+softmax reference path everywhere else. Ring attention for sequence
+parallelism builds on the same block kernel (`mxnet_tpu/parallel/ring_attention.py`).
+"""
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..base import getenv_bool
+from ..ndarray.ndarray import ndarray, apply_op
+
+__all__ = ["multi_head_attention", "dot_product_attention",
+           "reference_attention"]
+
+
+def reference_attention(q, k, v, mask=None, causal=False, scale=None,
+                        logits_dtype=jnp.float32):
+    """softmax(QK^T/sqrt(d)) V over (B, H, Lq, D)/(B, H, Lk, D) jax arrays.
+
+    Written so XLA fuses the softmax chain into the matmuls; accumulation in
+    fp32 (`logits_dtype`) for bf16 inputs (MXNET_SAFE_ACCUMULATION parity).
+    """
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / (d ** 0.5)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=logits_dtype) * s
+    if causal:
+        lq, lk = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((lq, lk), bool), k=lk - lq)
+        logits = jnp.where(cm, logits, -jnp.inf)
+    if mask is not None:
+        logits = jnp.where(mask.astype(bool), logits, -jnp.inf)
+    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _use_pallas() -> bool:
+    if getenv_bool("MXTPU_DISABLE_FLASH", False):
+        return False
+    try:
+        return jax.default_backend() not in ("cpu",)
+    except Exception:
+        return False
+
+
+def dot_product_attention(q, k, v, mask=None, causal=False, scale=None,
+                          use_flash=True):
+    """jax-level fused attention over (B, H, L, D)."""
+    if use_flash and mask is None and _use_pallas():
+        try:
+            from .pallas.flash_attention import flash_attention
+            return flash_attention(q, k, v, causal=causal, scale=scale)
+        except Exception:
+            pass
+    return reference_attention(q, k, v, mask=mask, causal=causal, scale=scale)
+
+
+def multi_head_attention(query: ndarray, key: ndarray, value: ndarray,
+                         num_heads: int, mask=None, dropout_p: float = 0.0,
+                         causal: bool = False, use_flash: bool = True):
+    """Multi-head attention over (B, L, E) `ndarray`s (already projected)."""
+    arrs = [query, key, value]
+    has_mask = isinstance(mask, ndarray)
+    if has_mask:
+        arrs.append(mask)
+
+    def fn(qv, kv, vv, *rest):
+        b, lq, e = qv.shape
+        lk = kv.shape[1]
+        hd = e // num_heads
+        qh = qv.reshape(b, lq, num_heads, hd).transpose(0, 2, 1, 3)
+        kh = kv.reshape(b, lk, num_heads, hd).transpose(0, 2, 1, 3)
+        vh = vv.reshape(b, lk, num_heads, hd).transpose(0, 2, 1, 3)
+        m = rest[0] if rest else None
+        if m is not None and m.ndim == 3:   # (B, Lq, Lk) -> (B, 1, Lq, Lk)
+            m = m[:, None]
+        out = dot_product_attention(qh, kh, vh, mask=m, causal=causal,
+                                    use_flash=use_flash and m is None)
+        return out.transpose(0, 2, 1, 3).reshape(b, lq, e)
+
+    return apply_op(fn, tuple(arrs), {}, name="multi_head_attention")

@@ -1,0 +1,41 @@
+"""Fused multi-tensor optimizer updates.
+
+Parity: the reference's multi-tensor kernels (`src/operator/contrib/
+multi_lamb.cc`, `multi_lans.cc`, `multi_sgd`, adamw) exist to amortise kernel
+launches over hundreds of parameters. On TPU the same effect comes from
+jitting ONE update over the whole parameter pytree — XLA fuses the elementwise
+math across tensors. These helpers implement that pattern; the per-optimizer
+math lives in `mxnet_tpu/optimizer/`.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(1, 3))
+def tree_apply_update(update_fn, params, grads, states, hparams):
+    """Apply `update_fn(param, grad, state, hparams) -> (new_param, new_state)`
+    across matching pytrees in one compiled computation (buffers donated)."""
+    flat_p, treedef = jax.tree_util.tree_flatten(params)
+    flat_g = treedef.flatten_up_to(grads)
+    flat_s = treedef.flatten_up_to(states)
+    out = [update_fn(p, g, s, hparams) for p, g, s in zip(flat_p, flat_g, flat_s)]
+    new_p = treedef.unflatten([o[0] for o in out])
+    new_s = treedef.unflatten([o[1] for o in out])
+    return new_p, new_s
+
+
+def global_norm(tree) -> jnp.ndarray:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                        for l in leaves))
+
+
+def clip_by_global_norm(tree, max_norm):
+    n = global_norm(tree)
+    scale = jnp.minimum(1.0, max_norm / (n + 1e-16))
+    return jax.tree_util.tree_map(lambda l: (l * scale).astype(l.dtype), tree), n

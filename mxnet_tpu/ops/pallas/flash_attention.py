@@ -1,0 +1,129 @@
+"""Pallas TPU flash-attention kernel.
+
+TPU-native replacement for the reference's fused attention CUDA kernels
+(`src/operator/contrib/transformer.cc:675-868`): blockwise online-softmax
+attention that never materialises the (L, L) score matrix, tiled to the MXU
+(128-aligned blocks) with fp32 accumulators in VMEM.
+
+Forward is a Pallas kernel; backward uses the standard recompute formulation
+via `jax.custom_vjp` with an XLA reference backward (flash backward kernel is
+a later optimisation — the forward kernel is what removes the HBM-bound
+(L,L) materialisation at inference and the fp32 logits at training).
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _attn_forward_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal,
+                         block_k, seq_k):
+    # grid: (batch*heads, q_blocks); refs are (block_q, d) / (seq_k, d)
+    block_q, d = q_ref.shape
+    q = q_ref[...].astype(jnp.float32) * scale
+    qi = pl.program_id(1)
+
+    m = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+    acc = jnp.zeros((block_q, d), jnp.float32)
+
+    n_kb = seq_k // block_k
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        s = q @ k.T  # (block_q, block_k)
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=1)
+        acc = acc * alpha[:, None] + p @ v
+        return m_new, l, acc
+
+    if causal:
+        # only iterate over blocks at or before the diagonal
+        last = (qi + 1) * block_q
+        n_needed = (last + block_k - 1) // block_k
+        m, l, acc = jax.lax.fori_loop(0, n_needed, body, (m, l, acc))
+    else:
+        m, l, acc = jax.lax.fori_loop(0, n_kb, body, (m, l, acc))
+
+    o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    bq = min(block_q, lq)
+    bk = min(block_k, lk)
+    assert lq % bq == 0 and lk % bk == 0, "seq len must divide block size"
+    qr = q.reshape(b * h, lq, d)
+    kr = k.reshape(b * h, lk, d)
+    vr = v.reshape(b * h, lk, d)
+    grid = (b * h, lq // bq)
+    out = pl.pallas_call(
+        functools.partial(_attn_forward_kernel, scale=scale, causal=causal,
+                          block_k=bk, seq_k=lk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, bq, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, lk, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, lk, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, bq, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
+    )(qr, kr, vr)
+    return out.reshape(b, h, lq, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, scale, causal, block_q, block_k):
+    return _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k):
+    return _flash_fwd(q, k, v, scale, causal, block_q, block_k), (q, k, v)
+
+
+def _flash_vjp_bwd(scale, causal, block_q, block_k, res, g):
+    q, k, v = res
+    from ..attention import reference_attention
+
+    def f(q, k, v):
+        return reference_attention(q, k, v, causal=causal, scale=scale)
+    _, vjp = jax.vjp(f, q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=256,
+                    block_k=256):
+    """Flash attention over (B, H, L, D) jax arrays."""
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    lq, lk = q.shape[2], k.shape[2]
+    bq, bk = block_q, block_k
+    while lq % bq:
+        bq //= 2
+    while lk % bk:
+        bk //= 2
+    if bq < 8 or bk < 8:
+        from ..attention import reference_attention
+        return reference_attention(q, k, v, causal=causal, scale=s)
+    return _flash(q, k, v, s, causal, bq, bk)

@@ -1,0 +1,19 @@
+"""`mx.optimizer` (parity: `python/mxnet/optimizer/`)."""
+from .optimizer import Optimizer, create, register
+from .sgd import SGD, NAG, Signum, SGLD, DCASGD, LARS
+from .adam import Adam, AdamW, AdaBelief, Adamax, Nadam, AdaDelta, FTML
+from .adagrad import AdaGrad, GroupAdaGrad, RMSProp, Ftrl, Test
+from .lamb import LAMB, LANS
+from .updater import Updater, get_updater
+from . import lr_scheduler
+from .lr_scheduler import (LRScheduler, FactorScheduler, MultiFactorScheduler,
+                           PolyScheduler, CosineScheduler)
+
+__all__ = [
+    "Optimizer", "create", "register", "SGD", "NAG", "Signum", "SGLD",
+    "DCASGD", "LARS", "Adam", "AdamW", "AdaBelief", "Adamax", "Nadam",
+    "AdaDelta", "FTML", "AdaGrad", "GroupAdaGrad", "RMSProp", "Ftrl", "Test",
+    "LAMB", "LANS", "Updater", "get_updater", "LRScheduler",
+    "FactorScheduler", "MultiFactorScheduler", "PolyScheduler",
+    "CosineScheduler", "lr_scheduler",
+]

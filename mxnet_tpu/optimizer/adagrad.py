@@ -1,0 +1,113 @@
+"""AdaGrad / RMSProp / Ftrl family (parity: `python/mxnet/optimizer/{adagrad,
+rmsprop,ftrl}.py`, GroupAdaGrad from contrib)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer, register
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, learning_rate=0.01, epsilon=1e-7, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.epsilon = epsilon
+
+    def create_state_jax(self, w):
+        return (jnp.zeros_like(w),)
+
+    def _rule(self, w, g, s, hp):
+        g = self._preprocess_grad(g, hp) + hp["wd"] * w
+        (hist,) = s
+        hist = hist + g * g
+        return w - hp["lr"] * g / (jnp.sqrt(hist) + self.epsilon), (hist,)
+
+
+@register
+class GroupAdaGrad(Optimizer):
+    """Row-wise AdaGrad (parity: contrib GroupAdaGrad): one accumulator per
+    embedding row rather than per element."""
+
+    def __init__(self, learning_rate=0.01, epsilon=1e-5, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.epsilon = epsilon
+
+    def create_state_jax(self, w):
+        return (jnp.zeros(w.shape[:1] + (1,) * (w.ndim - 1), w.dtype),)
+
+    def _rule(self, w, g, s, hp):
+        g = self._preprocess_grad(g, hp)
+        (hist,) = s
+        axes = tuple(range(1, g.ndim))
+        hist = hist + jnp.mean(g * g, axis=axes, keepdims=True)
+        return w - hp["lr"] * g / (jnp.sqrt(hist) + self.epsilon), (hist,)
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.9, momentum=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho, self.momentum = rho, momentum
+        self.epsilon, self.centered = epsilon, centered
+        self.clip_weights = clip_weights
+
+    def create_state_jax(self, w):
+        if self.centered:
+            return (jnp.zeros_like(w), jnp.zeros_like(w), jnp.zeros_like(w))
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def _rule(self, w, g, s, hp):
+        g = self._preprocess_grad(g, hp) + hp["wd"] * w
+        if self.centered:
+            n, gm, delta = s
+            n = self.rho * n + (1 - self.rho) * g * g
+            gm = self.rho * gm + (1 - self.rho) * g
+            delta = self.momentum * delta - hp["lr"] * g / \
+                jnp.sqrt(n - gm * gm + self.epsilon)
+            w = w + delta
+            if self.clip_weights:
+                w = jnp.clip(w, -self.clip_weights, self.clip_weights)
+            return w, (n, gm, delta)
+        n, mom = s
+        n = self.rho * n + (1 - self.rho) * g * g
+        mom = self.momentum * mom - hp["lr"] * g / jnp.sqrt(n + self.epsilon)
+        w = w + mom
+        if self.clip_weights:
+            w = jnp.clip(w, -self.clip_weights, self.clip_weights)
+        return w, (n, mom)
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, learning_rate=0.1, lamda1=0.01, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state_jax(self, w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w))  # (z, n)
+
+    def _rule(self, w, g, s, hp):
+        g = self._preprocess_grad(g, hp)
+        z, n = s
+        n_new = n + g * g
+        sigma = (jnp.sqrt(n_new) - jnp.sqrt(n)) / hp["lr"]
+        z = z + g - sigma * w
+        w = jnp.where(
+            jnp.abs(z) > self.lamda1,
+            -(z - jnp.sign(z) * self.lamda1) /
+            ((self.beta + jnp.sqrt(n_new)) / hp["lr"] + hp["wd"]),
+            0.0).astype(w.dtype)
+        return w, (z, n_new)
+
+
+@register
+class Test(Optimizer):
+    """Trivial optimizer used by tests (parity: optimizer/test.py)."""
+
+    def create_state_jax(self, w):
+        return (jnp.zeros_like(w),)
+
+    def _rule(self, w, g, s, hp):
+        g = self._preprocess_grad(g, hp) + hp["wd"] * w
+        return w - hp["lr"] * g, s

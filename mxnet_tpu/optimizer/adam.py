@@ -1,0 +1,168 @@
+"""Adam-family optimizers (parity: `python/mxnet/optimizer/{adam,adamax,nadam,
+adabelief,adadelta,ftml}.py` + adamw from contrib)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer, register
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=False, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state_jax(self, w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def _rule(self, w, g, s, hp):
+        g = self._preprocess_grad(g, hp) + hp["wd"] * w
+        m, v = s
+        t = hp["t"]
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        lr = hp["lr"] * jnp.sqrt(1 - self.beta2 ** t) / (1 - self.beta1 ** t)
+        w = w - lr * m / (jnp.sqrt(v) + self.epsilon)
+        return w, (m, v)
+
+
+@register
+class AdamW(Optimizer):
+    """Decoupled weight decay (parity: `python/mxnet/optimizer/adamw.py`)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, correct_bias=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.correct_bias = correct_bias
+
+    def create_state_jax(self, w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def _rule(self, w, g, s, hp):
+        g = self._preprocess_grad(g, hp)
+        m, v = s
+        t = hp["t"]
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        lr = hp["lr"]
+        if self.correct_bias:
+            lr = lr * jnp.sqrt(1 - self.beta2 ** t) / (1 - self.beta1 ** t)
+        w = w - lr * m / (jnp.sqrt(v) + self.epsilon) - \
+            hp["lr"] * hp["wd"] * w
+        return w, (m, v)
+
+
+@register
+class AdaBelief(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-16, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state_jax(self, w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def _rule(self, w, g, s, hp):
+        g = self._preprocess_grad(g, hp) + hp["wd"] * w
+        m, v = s
+        t = hp["t"]
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g - m) + self.epsilon
+        lr = hp["lr"] * jnp.sqrt(1 - self.beta2 ** t) / (1 - self.beta1 ** t)
+        return w - lr * m / (jnp.sqrt(v) + self.epsilon), (m, v)
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state_jax(self, w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def _rule(self, w, g, s, hp):
+        g = self._preprocess_grad(g, hp) + hp["wd"] * w
+        m, u = s
+        t = hp["t"]
+        m = self.beta1 * m + (1 - self.beta1) * g
+        u = jnp.maximum(self.beta2 * u, jnp.abs(g))
+        lr = hp["lr"] / (1 - self.beta1 ** t)
+        return w - lr * m / (u + 1e-8), (m, u)
+
+
+@register
+class Nadam(Optimizer):
+    fused_safe = False  # python-side m_schedule accumulator
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state_jax(self, w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def _rule(self, w, g, s, hp):
+        g = self._preprocess_grad(g, hp) + hp["wd"] * w
+        m, v = s
+        t = hp["t"]
+        momentum_t = self.beta1 * (1 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t1 = self.beta1 * (1 - 0.5 * 0.96 **
+                                    ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t1
+        g_prime = g / (1 - self.m_schedule)
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        m_prime = m / (1 - m_schedule_next)
+        v_prime = v / (1 - self.beta2 ** t)
+        m_bar = (1 - momentum_t) * g_prime + momentum_t1 * m_prime
+        return w - hp["lr"] * m_bar / (jnp.sqrt(v_prime) + self.epsilon), (m, v)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, learning_rate=1.0, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state_jax(self, w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def _rule(self, w, g, s, hp):
+        g = self._preprocess_grad(g, hp) + hp["wd"] * w
+        acc_g, acc_delta = s
+        acc_g = self.rho * acc_g + (1 - self.rho) * g * g
+        delta = jnp.sqrt(acc_delta + self.epsilon) / \
+            jnp.sqrt(acc_g + self.epsilon) * g
+        acc_delta = self.rho * acc_delta + (1 - self.rho) * delta * delta
+        return w - hp["lr"] * delta, (acc_g, acc_delta)
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state_jax(self, w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def _rule(self, w, g, s, hp):
+        g = self._preprocess_grad(g, hp) + hp["wd"] * w
+        d, v, z = s
+        t = hp["t"]
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        d_t = (1 - self.beta1 ** t) / hp["lr"] * \
+            (jnp.sqrt(v / (1 - self.beta2 ** t)) + self.epsilon)
+        sigma = d_t - self.beta1 * d
+        z = self.beta1 * z + (1 - self.beta1) * g - sigma * w
+        d = d_t
+        return -z / d, (d, v, z)

@@ -1,0 +1,87 @@
+"""LAMB / LANS large-batch optimizers (parity: `python/mxnet/optimizer/
+{lamb,lans}.py` + multi-tensor kernels `src/operator/contrib/multi_lamb.cc`,
+`multi_lans.cc`). The fused multi-tensor path on TPU is the jitted tree
+update in `gluon.Trainer` — one XLA computation across all parameters."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer, register
+
+
+@register
+class LAMB(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound, self.upper_bound = lower_bound, upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state_jax(self, w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def _rule(self, w, g, s, hp):
+        g = self._preprocess_grad(g, hp)
+        m, v = s
+        t = hp["t"]
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        if self.bias_correction:
+            mhat = m / (1 - self.beta1 ** t)
+            vhat = v / (1 - self.beta2 ** t)
+        else:
+            mhat, vhat = m, v
+        r = mhat / (jnp.sqrt(vhat) + self.epsilon) + hp["wd"] * w
+        w_norm = jnp.linalg.norm(w.astype(jnp.float32))
+        r_norm = jnp.linalg.norm(r.astype(jnp.float32))
+        if self.lower_bound is not None:
+            w_norm = jnp.maximum(w_norm, self.lower_bound)
+        if self.upper_bound is not None:
+            w_norm = jnp.minimum(w_norm, self.upper_bound)
+        ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return w - hp["lr"] * ratio.astype(w.dtype) * r, (m, v)
+
+
+@register
+class LANS(Optimizer):
+    """LANS: LAMB with normalized gradient + Nesterov (parity: lans.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound, self.upper_bound = lower_bound, upper_bound
+
+    def create_state_jax(self, w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def _rule(self, w, g, s, hp):
+        g = self._preprocess_grad(g, hp)
+        # gradient normalization (the LANS twist)
+        g_norm = jnp.linalg.norm(g.astype(jnp.float32)).astype(g.dtype)
+        g = jnp.where(g_norm > 0, g / g_norm, g)
+        m, v = s
+        t = hp["t"]
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        mhat = m / (1 - self.beta1 ** t)
+        vhat = v / (1 - self.beta2 ** t)
+        sq = jnp.sqrt(vhat) + self.epsilon
+
+        def trust(r):
+            w_norm = jnp.linalg.norm(w.astype(jnp.float32))
+            r_norm = jnp.linalg.norm(r.astype(jnp.float32))
+            wn = w_norm
+            if self.lower_bound is not None:
+                wn = jnp.maximum(wn, self.lower_bound)
+            if self.upper_bound is not None:
+                wn = jnp.minimum(wn, self.upper_bound)
+            return jnp.where((wn > 0) & (r_norm > 0), wn / r_norm, 1.0)
+
+        r1 = mhat / sq + hp["wd"] * w
+        r2 = g / sq + hp["wd"] * w
+        update = self.beta1 * trust(r1).astype(w.dtype) * r1 + \
+            (1 - self.beta1) * trust(r2).astype(w.dtype) * r2
+        return w - hp["lr"] * update, (m, v)

@@ -1,0 +1,208 @@
+"""Optimizer base class + registry.
+
+Parity: `python/mxnet/optimizer/optimizer.py`. Each optimizer defines a pure
+functional update rule `_rule(weight, grad, state_values, hp) ->
+(new_weight, new_state_values)` over jax arrays; the stateful `update()` API
+preserves the reference's in-place semantics by rebinding the weight/state
+`ndarray`s. `Trainer` can fuse the rule across all parameters in one jitted
+tree update (`mxnet_tpu/ops/fused_optim.py`) — the TPU-native analog of the
+reference's multi-tensor kernels (`src/operator/contrib/multi_lamb.cc` etc.).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, Registry
+from ..ndarray.ndarray import ndarray, from_jax
+
+__all__ = ["Optimizer", "register", "create"]
+
+_registry: Registry = Registry("optimizer")
+register = _registry.register
+
+
+def _state_values(state):
+    """Nested state of ndarrays -> same structure of jax arrays."""
+    if state is None:
+        return None
+    if isinstance(state, ndarray):
+        return state._data
+    if isinstance(state, (tuple, list)):
+        return tuple(_state_values(s) for s in state)
+    return state  # scalar
+
+
+def _state_writeback(state, new_values):
+    if state is None:
+        return
+    if isinstance(state, ndarray):
+        state._data = new_values
+        return
+    if isinstance(state, (tuple, list)):
+        for s, nv in zip(state, new_values):
+            _state_writeback(s, nv)
+
+
+class Optimizer:
+    """Base optimizer.
+
+    Subclasses implement `create_state_jax(weight_jax) -> nested tuple of jax
+    arrays` and the pure rule `_rule(weight, grad, state, hp)`; everything
+    else (lr schedule, wd, rescale, clipping, multi-precision) lives here.
+    """
+
+    # rules with python-side mutable state or host RNG can't run inside the
+    # fused jitted tree update; they override this to False
+    fused_safe = True
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=None, lr_scheduler=None,
+                 multi_precision=False, param_dict=None, aggregate_num=None,
+                 use_fused_step=True, **kwargs):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate if learning_rate is not None else 0.01
+        self.lr_scheduler = lr_scheduler
+        if self.lr_scheduler is not None and learning_rate is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.num_update = 0
+        self._index_update_count: Dict[int, int] = {}
+        self.param_dict = param_dict or {}
+        self.idx2name = param_idx2name or {}
+        self.lr_mult: Dict[Any, float] = {}
+        self.wd_mult: Dict[Any, float] = {}
+
+    # -- rates --------------------------------------------------------------
+    def _get_lr(self, index) -> float:
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        name = self.idx2name.get(index, index)
+        lr *= self.lr_mult.get(name, 1.0)
+        if index in self.param_dict:
+            lr *= getattr(self.param_dict[index], "lr_mult", 1.0)
+        return lr
+
+    def _get_wd(self, index) -> float:
+        wd = self.wd
+        name = self.idx2name.get(index, index)
+        wd *= self.wd_mult.get(name, 1.0)
+        if index in self.param_dict:
+            wd *= getattr(self.param_dict[index], "wd_mult", 1.0)
+        return wd
+
+    def set_learning_rate(self, lr: float):
+        self.lr = lr
+
+    @property
+    def learning_rate(self) -> float:
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_lr_mult(self, args_lr_mult: Dict[Any, float]):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult: Dict[Any, float]):
+        self.wd_mult = dict(args_wd_mult)
+
+    def _update_count(self, index):
+        cnt = self._index_update_count.get(index, 0) + 1
+        self._index_update_count[index] = cnt
+        self.num_update = max(self.num_update, cnt)
+        return cnt
+
+    # -- state --------------------------------------------------------------
+    def create_state(self, index, weight: ndarray):
+        jstate = self.create_state_jax(weight._data)
+        return self._wrap_state(jstate, weight)
+
+    def _wrap_state(self, jstate, ref: ndarray):
+        if jstate is None:
+            return None
+        if isinstance(jstate, tuple):
+            return tuple(self._wrap_state(s, ref) for s in jstate)
+        if isinstance(jstate, jax.Array):
+            return from_jax(jstate, ref._device)
+        return jstate
+
+    def create_state_jax(self, w):
+        return ()
+
+    def create_state_multi_precision(self, index, weight: ndarray):
+        if self.multi_precision and weight.dtype in (jnp.float16, jnp.bfloat16):
+            w32 = weight._data.astype(jnp.float32)
+            return (self._wrap_state(w32, weight),
+                    self._wrap_state(self.create_state_jax(w32), weight))
+        return self.create_state(index, weight)
+
+    # -- update -------------------------------------------------------------
+    def hparams(self, index) -> Dict[str, Any]:
+        return {
+            "lr": self._get_lr(index),
+            "wd": self._get_wd(index),
+            "rescale_grad": self.rescale_grad,
+            "clip_gradient": self.clip_gradient,
+            "t": self._index_update_count.get(index, 0),
+        }
+
+    @staticmethod
+    def _preprocess_grad(grad, hp):
+        g = grad * hp["rescale_grad"]
+        if hp.get("clip_gradient") is not None:
+            g = jnp.clip(g, -hp["clip_gradient"], hp["clip_gradient"])
+        return g
+
+    def _rule(self, weight, grad, state, hp):
+        raise NotImplementedError
+
+    def _is_mp_state(self, weight, state):
+        return (self.multi_precision and isinstance(state, tuple)
+                and len(state) == 2 and isinstance(state[0], ndarray)
+                and state[0].dtype == jnp.float32
+                and weight.dtype != jnp.float32)
+
+    def update(self, index, weight, grad, state):
+        """Stateful update; mutates weight (and state) in place."""
+        if not isinstance(index, (list, tuple)):
+            index, weight, grad, state = [index], [weight], [grad], [state]
+        for i, w, g, s in zip(index, weight, grad, state):
+            self._update_count(i)
+            hp = self.hparams(i)
+            sv = _state_values(s)
+            new_w, new_s = self._rule(w._data, g._data, sv, hp)
+            w._data = new_w
+            _state_writeback(s, new_s)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if not isinstance(index, (list, tuple)):
+            index, weight, grad, state = [index], [weight], [grad], [state]
+        for i, w, g, s in zip(index, weight, grad, state):
+            if self._is_mp_state(w, s):
+                w32, inner = s
+                self._update_count(i)
+                hp = self.hparams(i)
+                sv = _state_values(inner)
+                new_w32, new_inner = self._rule(
+                    w32._data, g._data.astype(jnp.float32), sv, hp)
+                w32._data = new_w32
+                w._data = new_w32.astype(w._data.dtype)
+                _state_writeback(inner, new_inner)
+            else:
+                self.update([i], [w], [g], [s])
+
+    def __repr__(self):
+        return f"{type(self).__name__}(lr={self.lr})"
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    cls = _registry.get(name)
+    return cls(**kwargs)

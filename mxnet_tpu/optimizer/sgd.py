@@ -1,0 +1,145 @@
+"""SGD-family optimizers (parity: `python/mxnet/optimizer/{sgd,nag,signum,
+sgld,dcasgd,lars}.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import random as _rng
+from .optimizer import Optimizer, register
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum and weight decay (grad += wd*w like the reference)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=False,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+
+    def create_state_jax(self, w):
+        if self.momentum != 0.0:
+            return (jnp.zeros_like(w),)
+        return ()
+
+    def _rule(self, w, g, s, hp):
+        g = self._preprocess_grad(g, hp) + hp["wd"] * w
+        if self.momentum != 0.0:
+            (mom,) = s
+            mom = self.momentum * mom - hp["lr"] * g
+            return w + mom, (mom,)
+        return w - hp["lr"] * g, ()
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated gradient."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, **kwargs):
+        super().__init__(learning_rate=learning_rate, momentum=momentum,
+                         **kwargs)
+
+    def _rule(self, w, g, s, hp):
+        g = self._preprocess_grad(g, hp) + hp["wd"] * w
+        (mom,) = s
+        mom = self.momentum * mom - hp["lr"] * g
+        return w + self.momentum * mom - hp["lr"] * g, (mom,)
+
+
+@register
+class Signum(Optimizer):
+    """signSGD with momentum (parity: signum.py)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state_jax(self, w):
+        if self.momentum != 0.0:
+            return (jnp.zeros_like(w),)
+        return ()
+
+    def _rule(self, w, g, s, hp):
+        g = self._preprocess_grad(g, hp)
+        if self.momentum != 0.0:
+            (mom,) = s
+            mom = self.momentum * mom - (1 - self.momentum) * (g + hp["wd"] * w)
+            w = (1 - hp["lr"] * self.wd_lh) * w + hp["lr"] * jnp.sign(mom)
+            return w, (mom,)
+        w = (1 - hp["lr"] * (self.wd_lh + hp["wd"])) * w - \
+            hp["lr"] * jnp.sign(g)
+        return w, ()
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (parity: sgld.py)."""
+
+    fused_safe = False  # draws host RNG keys per step
+
+    def __init__(self, learning_rate=0.01, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+
+    def _rule(self, w, g, s, hp):
+        g = self._preprocess_grad(g, hp) + hp["wd"] * w
+        noise = jax.random.normal(_rng.next_key(), w.shape, w.dtype) * \
+            jnp.sqrt(hp["lr"]).astype(w.dtype)
+        return w - 0.5 * hp["lr"] * g + noise, ()
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (parity: dcasgd.py)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state_jax(self, w):
+        mom = jnp.zeros_like(w) if self.momentum != 0.0 else jnp.zeros((), w.dtype)
+        return (mom, w)  # (momentum, previous_weight)
+
+    def _rule(self, w, g, s, hp):
+        g = self._preprocess_grad(g, hp) + hp["wd"] * w
+        mom, prev_w = s
+        comp = g + self.lamda * g * g * (w - prev_w)
+        if self.momentum != 0.0:
+            mom = self.momentum * mom - hp["lr"] * comp
+        else:
+            mom = -hp["lr"] * comp
+        return w + mom, (mom, w)
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise adaptive rate scaling (parity: lars.py)."""
+
+    def __init__(self, learning_rate=0.1, momentum=0.0, eta=0.001,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.eta = eta
+        self.epsilon = epsilon
+
+    def create_state_jax(self, w):
+        if self.momentum != 0.0:
+            return (jnp.zeros_like(w),)
+        return ()
+
+    def _rule(self, w, g, s, hp):
+        g = self._preprocess_grad(g, hp)
+        w_norm = jnp.linalg.norm(w.astype(jnp.float32))
+        g_norm = jnp.linalg.norm(g.astype(jnp.float32))
+        trust = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self.eta * w_norm / (g_norm + hp["wd"] * w_norm + self.epsilon),
+            1.0).astype(w.dtype)
+        g = g + hp["wd"] * w
+        if self.momentum != 0.0:
+            (mom,) = s
+            mom = self.momentum * mom + trust * hp["lr"] * g
+            return w - mom, (mom,)
+        return w - trust * hp["lr"] * g, ()

@@ -1,0 +1,69 @@
+"""Updater — per-key optimizer state management (parity:
+`python/mxnet/optimizer/updater.py`), used by KVStore server-side updates."""
+from __future__ import annotations
+
+import pickle
+from typing import Dict
+
+import numpy as _onp
+
+from ..ndarray.ndarray import ndarray
+from .optimizer import Optimizer, _state_values
+
+__all__ = ["Updater", "get_updater"]
+
+
+class Updater:
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states: Dict = {}
+        self.states_synced: Dict = {}
+
+    def __call__(self, index, grad, weight):
+        if not isinstance(index, (list, tuple)):
+            index, grad, weight = [index], [grad], [weight]
+        for i, g, w in zip(index, grad, weight):
+            if i not in self.states:
+                self.states[i] = \
+                    self.optimizer.create_state_multi_precision(i, w)
+                self.states_synced[i] = True
+            self.optimizer.update_multi_precision([i], [w], [g],
+                                                  [self.states[i]])
+
+    def get_states(self, dump_optimizer=False):
+        def to_np(s):
+            if s is None:
+                return None
+            if isinstance(s, ndarray):
+                return s.asnumpy()
+            if isinstance(s, tuple):
+                return tuple(to_np(x) for x in s)
+            return s
+        states = {k: to_np(v) for k, v in self.states.items()}
+        if dump_optimizer:
+            return pickle.dumps((states, self.optimizer))
+        return pickle.dumps(states)
+
+    def set_states(self, states_blob):
+        from ..numpy import array
+        data = pickle.loads(states_blob)
+        if isinstance(data, tuple) and len(data) == 2 and \
+                isinstance(data[1], Optimizer):
+            states, self.optimizer = data
+        else:
+            states = data
+
+        def to_nd(s):
+            if s is None:
+                return None
+            if isinstance(s, _onp.ndarray):
+                return array(s)
+            if isinstance(s, tuple):
+                return tuple(to_nd(x) for x in s)
+            return s
+        self.states = {k: to_nd(v) for k, v in states.items()}
+        self.states_synced = {k: False for k in self.states}
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)

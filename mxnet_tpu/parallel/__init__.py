@@ -1,0 +1,66 @@
+"""`mxnet_tpu.parallel` — meshes, shardings, collectives, sequence/tensor/
+pipeline/expert parallelism (SURVEY.md §2.4 checklist, rebuilt TPU-native).
+
+The reference's distributed story (KVStore over comm trees/NCCL/ps-lite) is
+replaced by GSPMD: pick a mesh, annotate shardings, let XLA insert ICI/DCN
+collectives. Multi-host bootstrap maps `tools/launch.py` env
+(`DMLC_PS_ROOT_URI` etc.) onto `jax.distributed.initialize`.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+from .mesh import make_mesh, auto_mesh, MeshConfig, Mesh, NamedSharding, PartitionSpec
+from .sharding import (ShardingRules, default_tp_rules, param_sharding,
+                       shard_parameter_tree, replicated)
+from . import collectives
+from .collectives import (allreduce, allgather, reduce_scatter, broadcast,
+                          ppermute_shift, all_to_all)
+from .ring_attention import ring_attention, ring_attention_sharded
+
+__all__ = [
+    "make_mesh", "auto_mesh", "MeshConfig", "Mesh", "NamedSharding",
+    "PartitionSpec", "ShardingRules", "default_tp_rules", "param_sharding",
+    "shard_parameter_tree", "replicated", "collectives", "allreduce",
+    "allgather", "reduce_scatter", "broadcast", "ppermute_shift", "all_to_all",
+    "ring_attention", "ring_attention_sharded", "initialize", "rank",
+    "num_workers",
+]
+
+
+def initialize(coordinator_address=None, num_processes=None, process_id=None):
+    """Multi-host bootstrap (parity: dmlc tracker env `DMLC_PS_ROOT_URI`/
+    `DMLC_NUM_WORKER`/`DMLC_WORKER_ID` from `tools/launch.py`)."""
+    coordinator_address = coordinator_address or os.environ.get(
+        "MXTPU_COORDINATOR") or _dmlc_coordinator()
+    if coordinator_address is None:
+        return  # single process
+    num_processes = num_processes or int(os.environ.get(
+        "MXTPU_NUM_WORKERS", os.environ.get("DMLC_NUM_WORKER", "1")))
+    process_id = process_id if process_id is not None else int(os.environ.get(
+        "MXTPU_WORKER_ID", os.environ.get("DMLC_WORKER_ID", "0")))
+    jax.distributed.initialize(coordinator_address, num_processes, process_id)
+
+
+def _dmlc_coordinator():
+    uri = os.environ.get("DMLC_PS_ROOT_URI")
+    port = os.environ.get("DMLC_PS_ROOT_PORT", "9000")
+    if uri:
+        return f"{uri}:{port}"
+    return None
+
+
+def rank() -> int:
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def num_workers() -> int:
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1

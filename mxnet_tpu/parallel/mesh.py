@@ -1,0 +1,70 @@
+"""Device-mesh construction for dp/tp/sp/pp/ep parallelism.
+
+New first-class capability (SURVEY.md §2.4): the reference scales only by data
+parallelism (KVStore comm trees / ps-lite); here every strategy is a named
+mesh axis consumed by `NamedSharding` rules and `shard_map` collectives:
+
+- 'dp' — data parallel (batch axis; gradient psum rides ICI)
+- 'tp' — tensor parallel (Dense/attention weight sharding)
+- 'sp' — sequence/context parallel (ring attention over `ppermute`)
+- 'pp' — pipeline stages (shard_map + collective_permute microbatching)
+- 'ep' — expert parallel (MoE all-to-all)
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as _onp
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..base import MXNetError
+
+__all__ = ["make_mesh", "auto_mesh", "MeshConfig", "Mesh", "NamedSharding",
+           "PartitionSpec"]
+
+AXES = ("dp", "sp", "tp", "pp", "ep")
+
+
+class MeshConfig:
+    def __init__(self, dp: int = 1, sp: int = 1, tp: int = 1, pp: int = 1,
+                 ep: int = 1):
+        self.sizes = {"dp": dp, "sp": sp, "tp": tp, "pp": pp, "ep": ep}
+
+    @property
+    def n_devices(self) -> int:
+        n = 1
+        for v in self.sizes.values():
+            n *= v
+        return n
+
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(a for a in AXES if self.sizes[a] > 1) or ("dp",)
+
+
+def make_mesh(axis_sizes: Dict[str, int], devices=None) -> Mesh:
+    """Build a Mesh with named axes from {'dp': 4, 'tp': 2, ...}."""
+    devices = list(devices if devices is not None else jax.devices())
+    names = [a for a in AXES if axis_sizes.get(a, 1) > 1]
+    if not names:
+        names = ["dp"]
+        axis_sizes = {"dp": len(devices)}
+    shape = [axis_sizes[a] for a in names]
+    total = int(_onp.prod(shape))
+    if total != len(devices):
+        raise MXNetError(f"mesh {dict(zip(names, shape))} needs {total} "
+                         f"devices, got {len(devices)}")
+    arr = _onp.array(devices).reshape(shape)
+    return Mesh(arr, tuple(names))
+
+
+def auto_mesh(n_devices: Optional[int] = None, tp: int = 1, sp: int = 1,
+              pp: int = 1, ep: int = 1, devices=None) -> Mesh:
+    """Mesh with dp absorbing whatever is left after tp/sp/pp/ep."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = n_devices or len(devices)
+    denom = tp * sp * pp * ep
+    if n % denom:
+        raise MXNetError(f"{n} devices not divisible by tp*sp*pp*ep={denom}")
+    return make_mesh({"dp": n // denom, "sp": sp, "tp": tp, "pp": pp,
+                      "ep": ep}, devices[:n])

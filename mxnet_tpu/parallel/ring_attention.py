@@ -1,0 +1,108 @@
+"""Ring attention — sequence/context parallelism over the ICI ring.
+
+Headline new capability (SURVEY.md §5.7: the reference has NO sequence
+parallelism; its longest-context artifact is sliding-window attention,
+`src/operator/contrib/transformer.cc:887-1095`). Design follows the public
+ring-attention recipe: shard the sequence axis over the 'sp' mesh axis; each
+device keeps its Q shard resident and streams K/V shards around the ring via
+`lax.ppermute`, accumulating blockwise online-softmax partial results, so the
+full (L, L) score matrix never exists and per-device memory is O(L/n · L/n).
+Communication overlaps compute (XLA schedules the ppermute alongside the
+block matmuls).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from jax import shard_map
+
+__all__ = ["ring_attention", "ring_attention_sharded"]
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, mask):
+    """Unnormalised blockwise attention: returns (acc, m, l).
+
+    q: (B,H,Lq,D); k,v: (B,H,Lk,D); mask broadcastable (B,H,Lq,Lk) or None."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32)
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1)                      # (B,H,Lq)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)                      # (B,H,Lq)
+    acc = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return acc, m, l
+
+
+def ring_attention_sharded(q, k, v, axis_name: str = "sp",
+                           causal: bool = False, scale: Optional[float] = None):
+    """Attention over sequence-sharded q/k/v — call INSIDE shard_map.
+
+    q, k, v: local shards (B, H, L_local, D); the sequence axis is sharded
+    over `axis_name`. Returns the local output shard (B, H, L_local, D).
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / (d ** 0.5)
+    q = (q * s).astype(q.dtype)
+    lq = q.shape[2]
+    b, h = q.shape[0], q.shape[1]
+
+    m0 = jnp.full((b, h, lq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, lq), jnp.float32)
+    acc0 = jnp.zeros(q.shape[:3] + (d,), jnp.float32)
+
+    def step(carry, t):
+        acc, m, l, kk, vv = carry
+        src = (my - t) % n  # which global shard kk currently holds
+        if causal:
+            qpos = my * lq + jnp.arange(lq)
+            kpos = src * kk.shape[2] + jnp.arange(kk.shape[2])
+            mask = qpos[:, None] >= kpos[None, :]
+            mask = mask[None, None]
+        else:
+            mask = None
+        a, bm, bl = _block_attn(q, kk, vv, mask)
+        m_new = jnp.maximum(m, bm)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(bm - m_new)
+        l = l * alpha + bl * beta
+        acc = acc * alpha[..., None] + a * beta[..., None]
+        # rotate k/v to the next device (skip the final rotate's result use,
+        # but keep it unconditional so the comm schedule is static)
+        kk = lax.ppermute(kk, axis_name, [(i, (i + 1) % n) for i in range(n)])
+        vv = lax.ppermute(vv, axis_name, [(i, (i + 1) % n) for i in range(n)])
+        return (acc, m, l, kk, vv), None
+
+    (acc, m, l, _, _), _ = lax.scan(step, (acc0, m0, l0, k, v),
+                                    jnp.arange(n))
+    out = acc / jnp.maximum(l[..., None], 1e-38)
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
+                   causal: bool = False, scale: Optional[float] = None,
+                   batch_axis: Optional[str] = "dp"):
+    """Top-level ring attention over (B, H, L, D) jax arrays.
+
+    Shards L over `axis_name` (and B over `batch_axis` if present in the
+    mesh) with shard_map; composes under jit/pjit.
+    """
+    axes = set(mesh.axis_names)
+    bspec = batch_axis if (batch_axis and batch_axis in axes) else None
+    spec = P(bspec, None, axis_name, None)
+
+    fn = functools.partial(ring_attention_sharded, axis_name=axis_name,
+                           causal=causal, scale=scale)
+    mapped = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
+    return mapped(q, k, v)

@@ -1,0 +1,88 @@
+"""Sharding rules: map parameter names/shapes to `PartitionSpec`s.
+
+The TP/SP design (SURVEY.md §5.7, §2.4): instead of the reference's per-key
+KVStore placement, parameters carry logical-axis annotations; a rule table
+resolves logical axes to mesh axes. Megatron-style defaults for transformer
+blocks: column-parallel qkv/ffn-in (shard output dim on 'tp'),
+row-parallel proj/ffn-out (shard input dim on 'tp'), embeddings sharded on
+vocab, everything replicated over 'dp'.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["ShardingRules", "default_tp_rules", "param_sharding",
+           "shard_parameter_tree", "replicated"]
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+class ShardingRules:
+    """Ordered (regex -> PartitionSpec) table over parameter names."""
+
+    def __init__(self, rules: Sequence[Tuple[str, PartitionSpec]],
+                 default: PartitionSpec = PartitionSpec()):
+        self.rules = [(re.compile(p), spec) for p, spec in rules]
+        self.default = default
+
+    def spec_for(self, name: str, shape=None) -> PartitionSpec:
+        for pat, spec in self.rules:
+            if pat.search(name):
+                if shape is not None and len(spec) > len(shape):
+                    continue
+                return spec
+        return self.default
+
+    def sharding_for(self, mesh: Mesh, name: str, shape=None) -> NamedSharding:
+        spec = self.spec_for(name, shape)
+        # drop axes not present in the mesh
+        names = set(mesh.axis_names)
+        clean = PartitionSpec(*[
+            (a if (a is None or (a if isinstance(a, str) else a[0]) in names)
+             else None) for a in spec])
+        return NamedSharding(mesh, clean)
+
+
+def default_tp_rules() -> ShardingRules:
+    """Megatron-style TP rules for this package's layer naming.
+
+    Weight layouts are (out, in) for Dense (reference FC layout), so
+    column-parallel layers shard dim 0 on 'tp' and row-parallel shard dim 1.
+    """
+    return ShardingRules([
+        # attention: qkv projections column-parallel, out proj row-parallel
+        (r"(attn|attention).*(query|key|value|qkv).*weight", PartitionSpec("tp", None)),
+        (r"(attn|attention).*(query|key|value|qkv).*bias", PartitionSpec("tp")),
+        (r"(attn|attention).*(proj|out).*weight", PartitionSpec(None, "tp")),
+        # mlp/ffn: in column-parallel, out row-parallel
+        (r"(ffn|mlp|intermediate|fc1|dense1).*weight", PartitionSpec("tp", None)),
+        (r"(ffn|mlp|intermediate|fc1|dense1).*bias", PartitionSpec("tp")),
+        (r"(ffn_out|output|fc2|dense2|proj).*weight", PartitionSpec(None, "tp")),
+        # embeddings: vocab-sharded
+        (r"(word_embed|embedding|embed).*weight", PartitionSpec("tp", None)),
+        # norms / scalars replicated
+        (r"(gamma|beta|norm)", PartitionSpec()),
+    ])
+
+
+def param_sharding(mesh: Mesh, name: str, shape, rules: Optional[ShardingRules]
+                   = None) -> NamedSharding:
+    rules = rules or default_tp_rules()
+    return rules.sharding_for(mesh, name, shape)
+
+
+def shard_parameter_tree(params: Dict[str, jax.Array], mesh: Mesh,
+                         rules: Optional[ShardingRules] = None):
+    """Device-put a {name: jax.Array} tree with rule-derived shardings."""
+    rules = rules or default_tp_rules()
+    out = {}
+    for name, v in params.items():
+        sh = rules.sharding_for(mesh, name, v.shape)
+        out[name] = jax.device_put(v, sh)
+    return out

@@ -1,0 +1,118 @@
+"""Profiler facade (parity: `python/mxnet/profiler.py:34,125,154` over
+`src/profiler/profiler.h:263`).
+
+The reference collects engine-op stats into chrome://tracing JSON; here the
+same `set_config/start/stop/dump` API drives `jax.profiler`, whose XPlane
+traces open in TensorBoard/Perfetto (chrome-trace parity for free). User
+scopes (`ProfileTask`/`scope`) map to `jax.profiler.TraceAnnotation`.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+import jax
+
+__all__ = [
+    "set_config", "start", "stop", "pause", "resume", "dump", "dumps",
+    "state", "scope", "Task", "Frame", "Event", "Counter", "Marker",
+]
+
+_config = {"profile_all": False, "filename": "profile_output",
+           "aggregate_stats": False, "running": False}
+
+
+def set_config(**kwargs):
+    _config.update(kwargs)
+
+
+def start():
+    out = _config.get("filename", "profile_output")
+    outdir = out if not out.endswith(".json") else out + "_dir"
+    os.makedirs(outdir, exist_ok=True)
+    jax.profiler.start_trace(outdir)
+    _config["running"] = True
+    _config["outdir"] = outdir
+
+
+def stop():
+    if _config.get("running"):
+        jax.profiler.stop_trace()
+        _config["running"] = False
+
+
+def pause(profile_process="worker"):
+    stop()
+
+
+def resume(profile_process="worker"):
+    start()
+
+
+def dump(finished=True, profile_process="worker"):
+    if _config.get("running"):
+        stop()
+
+
+def dumps(reset=False, format="table", sort_by="total", ascending=False):
+    return "(profiler stats are written as XPlane traces; open in TensorBoard)"
+
+
+def state():
+    return "RUNNING" if _config.get("running") else "STOPPED"
+
+
+class scope:
+    """Named profiling scope (parity: profiler scopes `profiler.h:772`)."""
+
+    def __init__(self, name="<unk>:"):
+        self._name = name
+        self._t = None
+
+    def __enter__(self):
+        self._t = jax.profiler.TraceAnnotation(self._name)
+        self._t.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._t.__exit__(*exc)
+        return False
+
+
+class Task(scope):
+    def __init__(self, name="task", domain=None):
+        super().__init__(name)
+        self.start_time = None
+
+    def start(self):
+        self.__enter__()
+
+    def stop(self):
+        self.__exit__(None, None, None)
+
+
+Frame = Task
+Event = Task
+
+
+class Counter:
+    def __init__(self, name="counter", domain=None, value=0):
+        self.name, self.value = name, value
+
+    def set_value(self, value):
+        self.value = value
+
+    def increment(self, delta=1):
+        self.value += delta
+
+    def decrement(self, delta=1):
+        self.value -= delta
+
+
+class Marker:
+    def __init__(self, name="marker", domain=None):
+        self.name = name
+
+    def mark(self, scope_="process"):
+        pass

@@ -1,0 +1,81 @@
+"""Stateful RNG facade over JAX threaded PRNG keys.
+
+Parity: the reference's per-device RNG resources
+(`src/common/random_generator.cu`, `src/operator/random/`, Python
+`mx.random.seed`). The stateful `seed()/uniform()/normal()` API is preserved;
+underneath, a global `Generator` advances a JAX PRNG key. Inside a traced
+(hybridized) function, a key must be threaded explicitly — `key_scope`
+provides that: consumers call `next_key()`, which folds a per-trace counter
+into the scoped key so each consumer gets an independent stream.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+
+__all__ = ["seed", "next_key", "key_scope", "Generator", "generator"]
+
+
+class Generator:
+    def __init__(self, seed_: int = 0):
+        self._lock = threading.Lock()
+        self._key = jax.random.PRNGKey(seed_)
+        self._scope = threading.local()
+
+    def seed(self, seed_: int):
+        with self._lock:
+            self._key = jax.random.PRNGKey(seed_)
+
+    # -- traced-key scope ---------------------------------------------------
+    def _scope_stack(self):
+        st = getattr(self._scope, "stack", None)
+        if st is None:
+            st = self._scope.stack = []
+        return st
+
+    class _KeyScope:
+        def __init__(self, gen, key):
+            self.gen, self.key, self.counter = gen, key, 0
+
+        def __enter__(self):
+            self.gen._scope_stack().append(self)
+            return self
+
+        def __exit__(self, *exc):
+            self.gen._scope_stack().pop()
+            return False
+
+    def key_scope(self, key):
+        """Use `key` (possibly a tracer) for all draws inside the scope."""
+        return Generator._KeyScope(self, key)
+
+    def next_key(self):
+        st = self._scope_stack()
+        if st:
+            scope = st[-1]
+            k = jax.random.fold_in(scope.key, scope.counter)
+            scope.counter += 1
+            return k
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+
+generator = Generator()
+
+
+def seed(seed_state: int, ctx=None):
+    generator.seed(int(seed_state))
+
+
+def next_key():
+    return generator.next_key()
+
+
+def key_scope(key):
+    return generator.key_scope(key)

@@ -1,0 +1,173 @@
+"""RecordIO file format (parity: `python/mxnet/recordio.py` over dmlc-core's
+recordio + `tools/im2rec`). Pure-Python reimplementation of the same binary
+format: records framed by a magic number + length, 4-byte aligned, with an
+optional `IRHeader` (label/id) prefix for packed datasets.
+
+A C++ accelerated indexer/reader is planned under `src/` (native data plane);
+the format here is compatible with files produced by the reference's
+`tools/im2rec`.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+from collections import namedtuple
+from typing import Optional
+
+import numpy as _onp
+
+from .base import MXNetError
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_MAGIC = 0xced7230a
+_LFLAG_BITS = 29
+
+
+class MXRecordIO:
+    """Sequential record reader/writer (dmlc recordio framing)."""
+
+    def __init__(self, uri: str, flag: str):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.handle = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.handle = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise MXNetError("flag must be 'r' or 'w'")
+
+    def close(self):
+        if self.handle is not None:
+            self.handle.close()
+            self.handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self) -> int:
+        return self.handle.tell()
+
+    def seek(self, pos: int):
+        assert not self.writable
+        self.handle.seek(pos)
+
+    def write(self, buf: bytes):
+        assert self.writable
+        # dmlc framing: [magic][lrec][data][pad to 4B]
+        lrec = len(buf)  # upper 3 bits: continuation flag (0 = complete)
+        self.handle.write(struct.pack("<II", _MAGIC, lrec))
+        self.handle.write(buf)
+        pad = (4 - (len(buf) % 4)) % 4
+        if pad:
+            self.handle.write(b"\x00" * pad)
+
+    def read(self) -> Optional[bytes]:
+        assert not self.writable
+        hdr = self.handle.read(8)
+        if len(hdr) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", hdr)
+        if magic != _MAGIC:
+            raise MXNetError("invalid record magic; corrupt file?")
+        length = lrec & ((1 << _LFLAG_BITS) - 1)
+        data = self.handle.read(length)
+        pad = (4 - (length % 4)) % 4
+        if pad:
+            self.handle.read(pad)
+        return data
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access records via a .idx sidecar (parity: recordio.py:IndexedRecordIO)."""
+
+    def __init__(self, idx_path: str, uri: str, flag: str, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+        if not self.writable and os.path.isfile(idx_path):
+            with open(idx_path) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) >= 2:
+                        k = key_type(parts[0])
+                        self.idx[k] = int(parts[1])
+                        self.keys.append(k)
+
+    def close(self):
+        if self.handle is not None and self.writable:
+            with open(self.idx_path, "w") as f:
+                for k in self.keys:
+                    f.write(f"{k}\t{self.idx[k]}\n")
+        super().close()
+
+    def read_idx(self, idx):
+        self.seek(self.idx[idx])
+        return self.read()
+
+    def write_idx(self, idx, buf: bytes):
+        pos = self.tell()
+        self.write(buf)
+        self.idx[idx] = pos
+        self.keys.append(idx)
+
+
+IRHeader = namedtuple("IRHeader", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header: IRHeader, s: bytes) -> bytes:
+    label = header.label
+    if isinstance(label, (list, tuple, _onp.ndarray)) or \
+            (hasattr(label, "size") and getattr(label, "size", 1) > 1):
+        label = _onp.asarray(label, dtype=_onp.float32)
+        header = header._replace(flag=label.size, label=0.0)
+        return struct.pack(_IR_FORMAT, *header) + label.tobytes() + s
+    return struct.pack(_IR_FORMAT, header.flag, float(label), header.id,
+                       header.id2) + s
+
+
+def unpack(s: bytes):
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = _onp.frombuffer(s[:header.flag * 4], dtype=_onp.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def pack_img(header: IRHeader, img, quality=95, img_fmt=".jpg"):
+    raise MXNetError("pack_img requires an image codec; encode with PIL and "
+                     "use pack() directly")
+
+
+def unpack_img(s: bytes, iscolor=1):
+    header, img_bytes = unpack(s)
+    from .image import imdecode
+    return header, imdecode(img_bytes)

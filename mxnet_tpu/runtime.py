@@ -1,0 +1,56 @@
+"""Runtime feature detection (parity: `python/mxnet/runtime.py` over
+`include/mxnet/libinfo.h:132-213`)."""
+from __future__ import annotations
+
+from collections import namedtuple
+
+import jax
+
+__all__ = ["Features", "feature_list", "libinfo_features"]
+
+Feature = namedtuple("Feature", ["name", "enabled"])
+
+_STATIC = {
+    "TPU": None,  # resolved lazily
+    "CPU": True,
+    "CUDA": False,
+    "CUDNN": False,
+    "NCCL": False,
+    "ONEDNN": False,
+    "XLA": True,
+    "PALLAS": None,
+    "BF16": True,
+    "INT64_TENSOR_SIZE": True,
+    "DIST_KVSTORE": True,
+    "OPENCV": False,
+    "BLAS_OPEN": False,
+    "SIGNAL_HANDLER": True,
+    "PROFILER": True,
+}
+
+
+def _resolve():
+    feats = dict(_STATIC)
+    platforms = {d.platform.lower() for d in jax.devices()}
+    feats["TPU"] = bool(platforms & {"tpu", "axon"})
+    try:
+        import jax.experimental.pallas  # noqa: F401
+        feats["PALLAS"] = True
+    except ImportError:
+        feats["PALLAS"] = False
+    return feats
+
+
+class Features(dict):
+    def __init__(self):
+        super().__init__({k: Feature(k, bool(v)) for k, v in _resolve().items()})
+
+    def is_enabled(self, name):
+        return self[name.upper()].enabled
+
+
+def feature_list():
+    return list(Features().values())
+
+
+libinfo_features = feature_list

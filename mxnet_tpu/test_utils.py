@@ -1,0 +1,143 @@
+"""Test utilities (parity: `python/mxnet/test_utils.py` — rich numeric asserts,
+random data generators, finite-difference gradient checking at :1044)."""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import numpy as _onp
+
+from .base import MXNetError
+from .device import Device, cpu, current_device
+from .ndarray.ndarray import ndarray
+
+__all__ = [
+    "assert_almost_equal", "almost_equal", "same", "rand_ndarray", "rand_shape_2d",
+    "rand_shape_3d", "rand_shape_nd", "check_numeric_gradient", "default_device",
+    "default_context", "effective_dtype", "environment",
+]
+
+
+def default_device() -> Device:
+    return current_device()
+
+
+default_context = default_device
+
+
+def _to_np(a):
+    if isinstance(a, ndarray):
+        return a.asnumpy()
+    return _onp.asarray(a)
+
+
+def same(a, b) -> bool:
+    return _onp.array_equal(_to_np(a), _to_np(b))
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-8, equal_nan=False) -> bool:
+    return _onp.allclose(_to_np(a), _to_np(b), rtol=rtol, atol=atol,
+                         equal_nan=equal_nan)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-8, names=("a", "b"),
+                        equal_nan=False):
+    a_np, b_np = _to_np(a), _to_np(b)
+    if a_np.dtype == _onp.dtype("V2") or str(a_np.dtype) == "bfloat16":
+        a_np = a_np.astype(_onp.float32)
+    if str(b_np.dtype) == "bfloat16":
+        b_np = b_np.astype(_onp.float32)
+    _onp.testing.assert_allclose(a_np, b_np, rtol=rtol, atol=atol,
+                                 equal_nan=equal_nan,
+                                 err_msg=f"{names[0]} != {names[1]}")
+
+
+def rand_ndarray(shape, dtype="float32", device=None, scale=1.0):
+    from .numpy import array
+    data = _onp.random.uniform(-scale, scale, size=shape).astype(dtype)
+    return array(data, device=device)
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return tuple(_onp.random.randint(1, d + 1) for d in (dim0, dim1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return tuple(_onp.random.randint(1, d + 1) for d in (dim0, dim1, dim2))
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(_onp.random.randint(1, dim + 1, size=ndim))
+
+
+def effective_dtype(x):
+    return _to_np(x).dtype
+
+
+def check_numeric_gradient(f: Callable, inputs: Sequence[ndarray],
+                           analytic_grads: Sequence[_onp.ndarray] = None,
+                           eps: float = 1e-4, rtol: float = 1e-2,
+                           atol: float = 1e-4):
+    """Finite-difference gradient check (parity: test_utils.py:1044).
+
+    `f` maps ndarrays -> scalar ndarray. If `analytic_grads` is None, they are
+    computed with autograd.
+    """
+    from . import autograd
+    from .numpy import array
+
+    if analytic_grads is None:
+        for x in inputs:
+            x.attach_grad()
+        with autograd.record():
+            y = f(*inputs)
+        y.backward()
+        analytic_grads = [x.grad.asnumpy() for x in inputs]
+
+    for xi, (x, g_ana) in enumerate(zip(inputs, analytic_grads)):
+        base = x.asnumpy().astype(_onp.float64)
+        g_num = _onp.zeros_like(base)
+        it = _onp.nditer(base, flags=["multi_index"])
+        while not it.finished:
+            idx = it.multi_index
+            xp = base.copy(); xp[idx] += eps
+            xm = base.copy(); xm[idx] -= eps
+            args_p = [array(xp.astype(x.dtype)) if j == xi else inputs[j]
+                      for j in range(len(inputs))]
+            args_m = [array(xm.astype(x.dtype)) if j == xi else inputs[j]
+                      for j in range(len(inputs))]
+            fp = float(f(*args_p).asnumpy())
+            fm = float(f(*args_m).asnumpy())
+            g_num[idx] = (fp - fm) / (2 * eps)
+            it.iternext()
+        _onp.testing.assert_allclose(g_ana, g_num, rtol=rtol, atol=atol,
+                                     err_msg=f"gradient mismatch on input {xi}")
+
+
+class environment:
+    """Scoped environment variables (parity: tests/.../common.py:163)."""
+
+    def __init__(self, *args):
+        import os
+        if len(args) == 2:
+            self._kwargs = {args[0]: args[1]}
+        else:
+            self._kwargs = args[0]
+        self._os = os
+        self._saved = {}
+
+    def __enter__(self):
+        for k, v in self._kwargs.items():
+            self._saved[k] = self._os.environ.get(k)
+            if v is None:
+                self._os.environ.pop(k, None)
+            else:
+                self._os.environ[k] = str(v)
+        return self
+
+    def __exit__(self, *exc):
+        for k, old in self._saved.items():
+            if old is None:
+                self._os.environ.pop(k, None)
+            else:
+                self._os.environ[k] = old
+        return False

@@ -1,0 +1,116 @@
+"""Utility helpers (parity: `python/mxnet/util.py` + ndarray save/load from
+`src/ndarray/ndarray.cc` and `.npz` support from `src/serialization/cnpy.cc`)."""
+from __future__ import annotations
+
+import functools
+import os
+import threading
+from typing import Dict, List, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as _onp
+
+from .base import MXNetError
+
+__all__ = [
+    "save_arrays", "load_arrays", "use_np", "use_np_shape", "use_np_array",
+    "is_np_array", "is_np_shape", "set_np", "reset_np", "np_shape", "np_array",
+    "getenv", "setenv", "default_array",
+]
+
+
+def save_arrays(fname: str, data):
+    """Save ndarray dict/list/single to `.npz` (or legacy param format)."""
+    from .ndarray.ndarray import ndarray
+    if isinstance(data, ndarray):
+        data = {"arr_0": data}
+    if isinstance(data, (list, tuple)):
+        data = {f"arr_{i}": a for i, a in enumerate(data)}
+    out = {}
+    for k, v in data.items():
+        arr = v.asnumpy() if isinstance(v, ndarray) else _onp.asarray(v)
+        if arr.dtype == jnp.bfloat16:
+            # npz has no bfloat16: store as uint16 view with name tag
+            out["__bf16__" + k] = arr.view(_onp.uint16)
+        else:
+            out[k] = arr
+    with open(fname, "wb") as f:
+        _onp.savez(f, **out)
+
+
+def load_arrays(fname: str):
+    from .numpy import array
+    out = {}
+    with _onp.load(fname, allow_pickle=False) as z:
+        for k in z.files:
+            v = z[k]
+            if k.startswith("__bf16__"):
+                out[k[len("__bf16__"):]] = array(v.view(jnp.bfloat16))
+            else:
+                out[k] = array(v)
+    return out
+
+
+# ---- numpy-semantics scopes: always-on in this framework (2.x behavior) ----
+
+def is_np_array():
+    return True
+
+
+def is_np_shape():
+    return True
+
+
+def set_np(shape=True, array=True, dtype=False):
+    pass
+
+
+def reset_np():
+    pass
+
+
+class _NoopScope:
+    def __call__(self, fn=None):
+        if fn is None:
+            return self
+        return fn
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+np_shape = _NoopScope()
+np_array = _NoopScope()
+
+
+def use_np(fn):
+    return fn
+
+
+def use_np_shape(fn):
+    return fn
+
+
+def use_np_array(fn):
+    return fn
+
+
+def use_np_default_dtype(fn):
+    return fn
+
+
+def getenv(name):
+    return os.environ.get(name)
+
+
+def setenv(name, value):
+    os.environ[name] = value
+
+
+def default_array(source_array, ctx=None, dtype=None):
+    from .numpy import array
+    return array(source_array, dtype=dtype, ctx=ctx)

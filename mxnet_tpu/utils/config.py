@@ -1,0 +1,45 @@
+"""Single typed flags module with env override.
+
+Replaces the reference's 115 scattered `MXNET_*` env lookups
+(`docs/.../env_var.md`, `dmlc::GetEnv` at point of use) with one declarative
+table; every flag is overridable via environment (`MXTPU_<NAME>`), and the
+legacy `MXNET_<NAME>` spelling is honored where a direct analog exists.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+def _env(name: str, legacy: Optional[str], default, typ):
+    for key in (f"MXTPU_{name}", legacy):
+        if key and key in os.environ:
+            v = os.environ[key]
+            if typ is bool:
+                return v.lower() in ("1", "true", "yes", "on")
+            return typ(v)
+    return default
+
+
+@dataclasses.dataclass
+class Flags:
+    # engine-parity knobs (most are no-ops on XLA; kept for API compat)
+    engine_type: str = _env("ENGINE_TYPE", "MXNET_ENGINE_TYPE", "xla", str)
+    # eager op jit cache
+    eager_jit: bool = _env("EAGER_JIT", None, False, bool)
+    # default matmul/conv precision on TPU ('default'|'high'|'highest')
+    matmul_precision: str = _env("MATMUL_PRECISION", None, "default", str)
+    # hybridize defaults
+    static_alloc: bool = _env("STATIC_ALLOC", None, True, bool)
+    # profiler output dir
+    profile_output: str = _env("PROFILE_OUTPUT", "MXNET_PROFILER_AUTOSTART",
+                               "profile_output", str)
+    # seed for reproducibility harness
+    seed: int = _env("SEED", "MXNET_SEED", 0, int)
+    # safe-accumulation parity (MXNET_SAFE_ACCUMULATION): accumulate in fp32
+    safe_accumulation: bool = _env("SAFE_ACCUMULATION",
+                                   "MXNET_SAFE_ACCUMULATION", True, bool)
+
+
+flags = Flags()

@@ -1,0 +1,39 @@
+"""Test fixtures (parity with the reference's root `conftest.py`: seeding +
+module isolation). Tests run on a virtual 8-device CPU mesh so multi-chip
+sharding paths are exercised without TPU hardware (SURVEY.md §4 implication:
+the `--launcher local` trick becomes `xla_force_host_platform_device_count`).
+"""
+import os
+
+# must be set before the first JAX backend initialisation
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = \
+        _flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
+import numpy as _onp  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def seed_rng(request):
+    """Reproducible seeding per test (parity: reference conftest.py:75-97)."""
+    seed = _onp.random.randint(0, 2 ** 31)
+    env_seed = os.environ.get("MXTPU_TEST_SEED")
+    if env_seed:
+        seed = int(env_seed)
+    _onp.random.seed(seed)
+    import mxnet_tpu as mx
+    mx.random.seed(seed)
+
+    def note():
+        return f"test seed: {seed} (set MXTPU_TEST_SEED={seed} to reproduce)"
+    request.node.user_properties.append(("seed", seed))
+    yield seed

@@ -1,0 +1,168 @@
+"""Autograd semantics (parity model: `tests/python/unittest/test_autograd.py`)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def test_simple_grad():
+    x = mx.np.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, [2.0, 4.0, 6.0])
+
+
+def test_chain():
+    x = mx.np.array([0.5, -0.5])
+    x.attach_grad()
+    with autograd.record():
+        y = mx.np.exp(x) * 2
+        z = y.sum()
+    z.backward()
+    assert_almost_equal(x.grad, 2 * onp.exp([0.5, -0.5]), rtol=1e-5)
+
+
+def test_grad_req_add():
+    x = mx.np.array([1.0, 1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = (2 * x).sum()
+        y.backward()
+    assert_almost_equal(x.grad, [6.0, 6.0])
+
+
+def test_grad_req_write_overwrites():
+    x = mx.np.array([1.0])
+    x.attach_grad()
+    for _ in range(2):
+        with autograd.record():
+            y = (3 * x).sum()
+        y.backward()
+    assert_almost_equal(x.grad, [3.0])
+
+
+def test_head_grad():
+    x = mx.np.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(mx.np.array([1.0, 10.0]))
+    assert_almost_equal(x.grad, [3.0, 30.0])
+
+
+def test_multi_input():
+    a = mx.np.array([2.0])
+    b = mx.np.array([3.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = (a * b + a).sum()
+    c.backward()
+    assert_almost_equal(a.grad, [4.0])
+    assert_almost_equal(b.grad, [2.0])
+
+
+def test_detach():
+    x = mx.np.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = (y.detach() * x).sum()
+    z.backward()
+    assert_almost_equal(x.grad, [4.0])  # only direct path
+
+
+def test_is_recording_training():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+    with autograd.predict_mode():
+        assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+
+
+def test_autograd_grad_api():
+    x = mx.np.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x * x).sum()
+    g = autograd.grad(y, x)
+    assert_almost_equal(g, 3 * onp.array([1.0, 4.0]))
+
+
+def test_higher_order():
+    x = mx.np.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x * x).sum()
+        g = autograd.grad(y, x, create_graph=True)
+        gg = autograd.grad(g[0] if isinstance(g, list) else g, x)
+    assert_almost_equal(gg, [12.0], rtol=1e-4)
+
+
+def test_mark_variables():
+    x = mx.np.array([5.0])
+    g = mx.np.zeros((1,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = (x * 4).sum()
+    y.backward()
+    assert_almost_equal(x.grad, [4.0])
+
+
+def test_custom_function():
+    class Square(autograd.Function):
+        def forward(self, x):
+            self.save_for_backward(x)
+            return x * x
+
+        def backward(self, dy):
+            (x,) = self.saved_tensors
+            return 2 * x * dy
+
+    sq = Square()
+    x = mx.np.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = sq(x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, [6.0])
+
+
+def test_numeric_gradient_matmul():
+    a = mx.np.array(onp.random.rand(3, 4).astype("float32"))
+
+    def f(x):
+        return (x @ mx.np.ones((4, 2))).sum()
+
+    check_numeric_gradient(f, [a], eps=1e-2, rtol=5e-2, atol=1e-2)
+
+
+def test_backward_through_setitem():
+    x = mx.np.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        y[0] = 0.0
+        z = y.sum()
+    z.backward()
+    assert_almost_equal(x.grad, [0.0, 2.0, 2.0])
+
+
+def test_multi_output_partial_use():
+    x = mx.np.array([1.0, 4.0, 9.0])
+    x.attach_grad()
+    with autograd.record():
+        parts = mx.np.split(x, 3)
+        z = (parts[0] * 5).sum()
+    z.backward()
+    assert_almost_equal(x.grad, [5.0, 0.0, 0.0])

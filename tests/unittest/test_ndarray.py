@@ -1,0 +1,141 @@
+"""ndarray core semantics (parity model: `tests/python/unittest/test_numpy_ndarray.py`)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    x = mx.np.zeros((2, 3))
+    assert x.shape == (2, 3)
+    assert x.dtype == onp.float32
+    y = mx.np.ones((4,), dtype="int32")
+    assert y.dtype == onp.int32
+    z = mx.np.array([[1, 2], [3, 4]], dtype="float32")
+    assert_almost_equal(z, onp.array([[1, 2], [3, 4]], onp.float32))
+    f = mx.np.full((2, 2), 7.0)
+    assert float(f.sum()) == 28.0
+    a = mx.np.arange(5)
+    assert a.tolist() == [0, 1, 2, 3, 4]
+    l = mx.np.linspace(0, 1, 5)
+    assert_almost_equal(l, onp.linspace(0, 1, 5, dtype=onp.float32))
+    e = mx.np.eye(3)
+    assert float(e.sum()) == 3.0
+
+
+def test_arithmetic():
+    a = mx.np.array([1.0, 2.0, 3.0])
+    b = mx.np.array([4.0, 5.0, 6.0])
+    assert_almost_equal(a + b, [5, 7, 9])
+    assert_almost_equal(a - b, [-3, -3, -3])
+    assert_almost_equal(a * b, [4, 10, 18])
+    assert_almost_equal(b / a, [4, 2.5, 2])
+    assert_almost_equal(a ** 2, [1, 4, 9])
+    assert_almost_equal(2 + a, [3, 4, 5])
+    assert_almost_equal(2 * a, [2, 4, 6])
+    assert_almost_equal(-a, [-1, -2, -3])
+    assert_almost_equal(abs(mx.np.array([-1.0, 2.0])), [1, 2])
+
+
+def test_inplace_ops():
+    a = mx.np.array([1.0, 2.0])
+    a += 1
+    assert_almost_equal(a, [2, 3])
+    a *= 2
+    assert_almost_equal(a, [4, 6])
+    a -= 1
+    a /= 2
+    assert_almost_equal(a, [1.5, 2.5])
+
+
+def test_comparison():
+    a = mx.np.array([1.0, 2.0, 3.0])
+    b = mx.np.array([3.0, 2.0, 1.0])
+    assert (a == b).tolist() == [False, True, False]
+    assert (a < b).tolist() == [True, False, False]
+    assert (a >= 2).tolist() == [False, True, True]
+
+
+def test_indexing():
+    x = mx.np.arange(12).reshape(3, 4)
+    assert float(x[1, 2]) == 6
+    assert x[1].tolist() == [4, 5, 6, 7]
+    assert x[:, 1].tolist() == [1, 5, 9]
+    assert x[1:3, 0].tolist() == [4, 8]
+    # negative / step
+    assert x[-1].tolist() == [8, 9, 10, 11]
+    assert x[::2, 0].tolist() == [0, 8]
+    # integer array indexing
+    idx = mx.np.array([0, 2], dtype="int32")
+    assert x[idx, 0].tolist() == [0.0, 8.0]
+
+
+def test_setitem():
+    x = mx.np.zeros((3, 3))
+    x[1, 1] = 5.0
+    assert float(x[1, 1]) == 5.0
+    x[0] = 1.0
+    assert x[0].tolist() == [1, 1, 1]
+    x[:, 2] = mx.np.array([7.0, 8.0, 9.0])
+    assert x[:, 2].tolist() == [7, 8, 9]
+
+
+def test_boolean_mask():
+    x = mx.np.array([1.0, -2.0, 3.0, -4.0])
+    m = x > 0
+    sel = x[m]
+    assert sel.tolist() == [1.0, 3.0]
+
+
+def test_reductions_and_methods():
+    x = mx.np.arange(6).reshape(2, 3).astype("float32")
+    assert float(x.sum()) == 15
+    assert x.sum(axis=0).tolist() == [3, 5, 7]
+    assert x.mean(axis=1).tolist() == [1, 4]
+    assert float(x.max()) == 5
+    assert float(x.min()) == 0
+    assert int(x.argmax()) == 5
+    assert x.T.shape == (3, 2)
+    assert x.reshape(3, 2).shape == (3, 2)
+    assert x.reshape((-1,)).shape == (6,)
+    assert x.flatten().shape == (6,)
+    assert x.transpose(1, 0).shape == (3, 2)
+
+
+def test_astype_copy_device():
+    x = mx.np.ones((2, 2))
+    y = x.astype("float16")
+    assert y.dtype == onp.float16
+    z = x.copy()
+    z[0, 0] = 9
+    assert float(x[0, 0]) == 1.0
+    d = x.to_device(mx.cpu())
+    assert d.device == mx.cpu()
+
+
+def test_waitall_and_async():
+    x = mx.np.ones((8, 8))
+    y = (x @ x).sum()
+    y.wait_to_read()
+    mx.nd.waitall()
+    assert float(y) == 512.0
+
+
+def test_size_ndim_len_iter():
+    x = mx.np.zeros((3, 4))
+    assert x.size == 12
+    assert x.ndim == 2
+    assert len(x) == 3
+    rows = list(x)
+    assert len(rows) == 3 and rows[0].shape == (4,)
+
+
+def test_conversion():
+    x = mx.np.array([3.5])
+    assert float(x) == 3.5
+    assert int(mx.np.array([3])) == 3
+    with pytest.raises(ValueError):
+        bool(mx.np.ones((2,)))
+    n = onp.asarray(mx.np.ones((2, 2)))
+    assert n.shape == (2, 2)
