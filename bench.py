@@ -1,0 +1,115 @@
+"""Benchmark: BERT-base pretraining train-step on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+North-star (BASELINE.json): BERT-base pretraining at >=40% MFU on v5p-32;
+vs_baseline = measured_MFU / 0.40. Also reports samples/sec/chip inside the
+JSON's extras.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import time
+
+import numpy as _onp
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    if "v5 lite" in kind or "v5e" in kind:
+        return 197e12  # bf16
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    if "v4" in kind:
+        return 275e12
+    if "v6" in kind or "trillium" in kind:
+        return 918e12
+    return 197e12  # conservative default
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu.models.bert import BertConfig, BertForPretraining
+    from mxnet_tpu.parallel import make_mesh, make_sharded_train_step
+
+    dev = jax.devices()[0]
+    on_accel = dev.platform.lower() != "cpu"
+
+    # BERT-base; bf16 weights/compute for the MXU, seq 128 (phase-1 pretrain)
+    if on_accel:
+        batch, seq = 32, 128
+        cfg = BertConfig(dtype="bfloat16")
+    else:  # CI/CPU smoke config
+        batch, seq = 4, 64
+        cfg = BertConfig(hidden_size=128, num_layers=2, num_heads=4,
+                         intermediate_size=512, vocab_size=1024)
+
+    model = BertForPretraining(cfg)
+    model.initialize()
+    rng = _onp.random.RandomState(0)
+    ids = mx.np.array(rng.randint(0, cfg.vocab_size, (batch, seq)),
+                      dtype="int32")
+    labels = mx.np.array(rng.randint(0, cfg.vocab_size, (batch, seq)),
+                         dtype="int32")
+    model(ids)  # deferred init
+
+    def loss_fn(out, input_ids, lbl):
+        mlm, nsp = out
+        logp = jax.nn.log_softmax(mlm.astype(jnp.float32), axis=-1)
+        ll = jnp.take_along_axis(logp, lbl[..., None].astype(jnp.int32),
+                                 axis=-1)
+        return -jnp.mean(ll)
+
+    mesh = make_mesh({"dp": 1}, jax.devices()[:1])
+    step = make_sharded_train_step(model, opt.Adam(learning_rate=1e-4),
+                                   loss_fn, mesh, num_model_args=1)
+
+    # warmup (compile)
+    for _ in range(2):
+        loss = step(ids, labels)
+    loss.block_until_ready()
+
+    n_iters = 20 if on_accel else 3
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        loss = step(ids, labels)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    step_time = dt / n_iters
+    samples_per_sec = batch / step_time
+
+    # train FLOPs per token: 3x forward; forward = matmul MACs * 2
+    h, l, i, V = (cfg.hidden_size, cfg.num_layers, cfg.intermediate_size,
+                  cfg.vocab_size)
+    fwd_per_token = 2 * (l * (4 * h * h + 2 * h * i) + h * h + h * V) \
+        + 4 * l * seq * h
+    flops_per_step = 3 * fwd_per_token * batch * seq
+    achieved = flops_per_step / step_time
+    mfu = achieved / _peak_flops(dev)
+
+    result = {
+        "metric": "bert_base_pretrain_mfu",
+        "value": round(mfu, 4),
+        "unit": "MFU_fraction",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "extras": {
+            "samples_per_sec_per_chip": round(samples_per_sec, 2),
+            "step_time_ms": round(step_time * 1e3, 2),
+            "achieved_tflops": round(achieved / 1e12, 2),
+            "batch": batch, "seq": seq,
+            "device": getattr(dev, "device_kind", str(dev)),
+            "loss": float(loss),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

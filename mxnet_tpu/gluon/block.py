@@ -587,5 +587,49 @@ class SymbolBlock(HybridBlock):
         return outs[0] if len(outs) == 1 else tuple(outs)
 
 
+def functional_call(block: Block, pvals: Dict[str, Any], *args,
+                    training: bool = False, rng_key=None):
+    """Run `block.forward` as a pure function of a {name: jax.Array} tree.
+
+    The functional bridge used by the sharded training step
+    (`mxnet_tpu.parallel.train`) and by export: parameter values are bound
+    into the block for the duration of the call (tracers are fine), any
+    in-place parameter mutation (BatchNorm running stats) is captured and
+    returned as an aux dict. Returns (out_jax_tree, aux_updates).
+    """
+    params = {n: p for n, p in block.collect_params().items()
+              if p._data is not None}
+    saved = {}
+    for name, val in pvals.items():
+        p = params[name]
+        saved[name] = p._data._data
+        p._data._data = val
+    prev_rec = _tape.set_recording(False)
+    prev_train = _tape.set_training(training)
+    try:
+        ctx = _rng.key_scope(rng_key) if rng_key is not None else \
+            contextlib.nullcontext()
+        with ctx:
+            wrapped = [from_jax(a, current_device())
+                       if isinstance(a, (jax.Array, jax.core.Tracer)) else a
+                       for a in args]
+            out = block.forward(*wrapped)
+            aux = {}
+            for name in pvals:
+                cur = params[name]._data._data
+                if cur is not pvals[name]:
+                    aux[name] = jax.lax.stop_gradient(cur)
+    finally:
+        for name, val in saved.items():
+            params[name]._data._data = val
+        _tape.set_recording(prev_rec)
+        _tape.set_training(prev_train)
+
+    out_jax = jax.tree_util.tree_map(
+        lambda o: o._data if isinstance(o, ndarray) else o, out,
+        is_leaf=lambda x: isinstance(x, ndarray))
+    return out_jax, aux
+
+
 def nn_block_doc(cls):
     return cls

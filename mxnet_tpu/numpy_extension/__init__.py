@@ -495,7 +495,9 @@ def embedding(data, weight, input_dim=None, output_dim=None, dtype=None,
               sparse_grad=False):
     """Embedding lookup (parity: `src/operator/tensor/indexing_op.cc` Embedding)."""
     def fn(idx, w):
-        out = jnp.take(w, idx.astype(jnp.int32), axis=0)
+        # mode='clip' matches the reference's index clipping and avoids
+        # XLA's NaN-fill for out-of-bounds gathers under jit
+        out = jnp.take(w, idx.astype(jnp.int32), axis=0, mode="clip")
         return out.astype(dtype) if dtype else out
     return apply_op(fn, (data, weight), {}, name="embedding")
 

@@ -19,14 +19,15 @@ from . import collectives
 from .collectives import (allreduce, allgather, reduce_scatter, broadcast,
                           ppermute_shift, all_to_all)
 from .ring_attention import ring_attention, ring_attention_sharded
+from .train import ShardedTrainStep, make_sharded_train_step
 
 __all__ = [
     "make_mesh", "auto_mesh", "MeshConfig", "Mesh", "NamedSharding",
     "PartitionSpec", "ShardingRules", "default_tp_rules", "param_sharding",
     "shard_parameter_tree", "replicated", "collectives", "allreduce",
     "allgather", "reduce_scatter", "broadcast", "ppermute_shift", "all_to_all",
-    "ring_attention", "ring_attention_sharded", "initialize", "rank",
-    "num_workers",
+    "ring_attention", "ring_attention_sharded", "ShardedTrainStep",
+    "make_sharded_train_step", "initialize", "rank", "num_workers",
 ]
 
 

@@ -1,0 +1,181 @@
+"""Sharded training step — the GSPMD replacement for the reference's
+KVStore data-parallel pipeline (`src/kvstore/`, `gluon/trainer.py` push/pull).
+
+One jitted function carries forward + backward + optimizer update for the
+whole model, with parameters/optimizer state laid out by `ShardingRules` over
+a named mesh (dp/tp/sp/...). XLA inserts the gradient psum over 'dp'
+(all-reduce riding ICI), TP collectives around row/column-parallel matmuls,
+and ring-attention ppermutes when sequence parallelism is active. Buffers are
+donated, so weights update in place — the `static_alloc` end-state.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+from ..gluon.block import Block, functional_call
+from ..gluon.parameter import Parameter
+from ..optimizer import Optimizer
+from .sharding import ShardingRules, default_tp_rules
+
+__all__ = ["ShardedTrainStep", "make_sharded_train_step"]
+
+
+class ShardedTrainStep:
+    """Compiled data/tensor/sequence-parallel training step for a Gluon block.
+
+    loss_fn(out, *batch_rest) -> scalar jax value, where `out` is the
+    block's (jax-valued) output tree.
+    """
+
+    def __init__(self, block: Block, optimizer: Optimizer,
+                 loss_fn: Callable, mesh: Mesh,
+                 rules: Optional[ShardingRules] = None,
+                 batch_specs: Optional[Tuple] = None,
+                 num_model_args: Optional[int] = None,
+                 grad_accum_dtype=jnp.float32):
+        self.block = block
+        # how many leading batch args feed block.forward; the rest (labels
+        # etc.) only reach loss_fn. None = all.
+        self.num_model_args = num_model_args
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.mesh = mesh
+        self.rules = rules or default_tp_rules()
+        self.batch_specs = batch_specs
+        self._step_fn = None
+        self._n_batch_args = None
+
+        params = {n: p for n, p in block.collect_params().items()
+                  if p._data is not None}
+        if not params:
+            raise MXNetError("block has no initialized parameters; call "
+                             "initialize() (and one forward for deferred "
+                             "shapes) first")
+        self.param_names = sorted(params)
+        self.params = params
+        self.diff_names = [n for n in self.param_names
+                           if params[n].grad_req != "null"]
+
+        # place parameters + optimizer state on the mesh
+        self.param_shardings = {
+            n: self.rules.sharding_for(mesh, n, params[n].shape)
+            for n in self.param_names}
+        self.pvals = {n: jax.device_put(params[n]._data._data,
+                                        self.param_shardings[n])
+                      for n in self.param_names}
+        self.opt_state = {
+            n: jax.tree_util.tree_map(
+                lambda s: jax.device_put(s, _like_sharding(
+                    self.param_shardings[n], s, params[n])),
+                optimizer.create_state_jax(self.pvals[n]))
+            for n in self.diff_names}
+        self._t = 0
+
+    # ------------------------------------------------------------------
+    def _build(self, batch_vals, rng_key):
+        mesh = self.mesh
+        if self.batch_specs is None:
+            # default: shard leading batch dim over 'dp' (+'sp' on axis 1 if
+            # the mesh has it and the arg is rank>=2)
+            axes = set(mesh.axis_names)
+            specs = []
+            for b in batch_vals:
+                spec = [None] * b.ndim
+                if b.ndim >= 1 and "dp" in axes:
+                    spec[0] = "dp"
+                if b.ndim >= 2 and "sp" in axes:
+                    spec[1] = "sp"
+                specs.append(P(*spec))
+            self.batch_specs = tuple(specs)
+        batch_shardings = tuple(NamedSharding(mesh, s)
+                                for s in self.batch_specs)
+        self._batch_shardings = batch_shardings
+
+        block, loss_fn, optimizer = self.block, self.loss_fn, self.optimizer
+        diff_names = self.diff_names
+
+        n_model = self.num_model_args
+
+        def step(pvals, opt_state, hp, key, *batch):
+            def compute_loss(diff_vals):
+                pv = dict(pvals)
+                pv.update(diff_vals)
+                model_args = batch if n_model is None else batch[:n_model]
+                out, aux = functional_call(block, pv, *model_args,
+                                           training=True, rng_key=key)
+                return loss_fn(out, *batch), aux
+
+            diff_vals = {n: pvals[n] for n in diff_names}
+            (loss, aux), grads = jax.value_and_grad(
+                compute_loss, has_aux=True)(diff_vals)
+            new_p = dict(pvals)
+            new_s = {}
+            for n in diff_names:
+                w, s = optimizer._rule(pvals[n], grads[n], opt_state[n], hp)
+                new_p[n] = w
+                new_s[n] = s
+            new_p.update(aux)  # running-stat writebacks
+            return new_p, new_s, loss
+
+        pspec = {n: self.param_shardings[n] for n in self.param_names}
+        sspec = {
+            n: jax.tree_util.tree_map(
+                lambda s: _like_sharding(self.param_shardings[n], s,
+                                         self.params[n]),
+                self.opt_state[n])
+            for n in self.diff_names}
+        repl = NamedSharding(mesh, P())
+        self._step_fn = jax.jit(
+            step,
+            in_shardings=(pspec, sspec, None, None) + batch_shardings,
+            out_shardings=(pspec, sspec, repl),
+            donate_argnums=(0, 1))
+
+    # ------------------------------------------------------------------
+    def __call__(self, *batch, rng_key=None):
+        """Run one step; returns the (replicated) scalar loss as jax array."""
+        from .. import random as _rng
+        batch_vals = [b._data if hasattr(b, "_data") else jnp.asarray(b)
+                      for b in batch]
+        if self._step_fn is None:
+            self._build(batch_vals, rng_key)
+        self._t += 1
+        o = self.optimizer
+        hp = {"lr": jnp.asarray(o.learning_rate, jnp.float32),
+              "wd": jnp.asarray(o.wd, jnp.float32),
+              "rescale_grad": jnp.asarray(o.rescale_grad, jnp.float32),
+              "clip_gradient": o.clip_gradient,
+              "t": jnp.asarray(self._t, jnp.float32)}
+        key = rng_key if rng_key is not None else _rng.next_key()
+        batch_vals = [jax.device_put(b, s)
+                      for b, s in zip(batch_vals, self._batch_shardings)]
+        self.pvals, self.opt_state, loss = self._step_fn(
+            self.pvals, self.opt_state, hp, key, *batch_vals)
+        return loss
+
+    def sync_params_to_block(self):
+        """Write the (sharded) trained values back into the Parameters."""
+        for n in self.param_names:
+            self.params[n]._data._data = self.pvals[n]
+
+
+def _like_sharding(param_sharding: NamedSharding, state_leaf, param):
+    """Optimizer state shards like its parameter when shapes match, else
+    replicated (e.g. row-wise accumulators)."""
+    if hasattr(state_leaf, "shape") and tuple(state_leaf.shape) == \
+            tuple(param.shape):
+        return param_sharding
+    return NamedSharding(param_sharding.mesh, P())
+
+
+def make_sharded_train_step(block, optimizer, loss_fn, mesh, rules=None,
+                            batch_specs=None,
+                            num_model_args=None) -> ShardedTrainStep:
+    return ShardedTrainStep(block, optimizer, loss_fn, mesh, rules,
+                            batch_specs, num_model_args)
