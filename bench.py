@@ -70,19 +70,24 @@ def main():
     step = make_sharded_train_step(model, opt.Adam(learning_rate=1e-4),
                                    loss_fn, mesh, num_model_args=1)
 
-    # warmup (compile)
+    # warmup (compile); sync via device_get — on tunneled backends
+    # block_until_ready can return before remote execution finishes
     for _ in range(2):
         loss = step(ids, labels)
-    loss.block_until_ready()
+    jax.device_get(loss)
 
-    n_iters = 20 if on_accel else 3
-    t0 = time.perf_counter()
-    for _ in range(n_iters):
-        loss = step(ids, labels)
-    loss.block_until_ready()
-    dt = time.perf_counter() - t0
+    def timed(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            loss = step(ids, labels)
+        jax.device_get(loss)
+        return time.perf_counter() - t0, loss
 
-    step_time = dt / n_iters
+    # two run lengths; slope removes the fixed dispatch/fetch overhead
+    n1, n2 = (10, 50) if on_accel else (1, 3)
+    t1, _ = timed(n1)
+    t2, loss = timed(n2)
+    step_time = max((t2 - t1) / (n2 - n1), 1e-9)
     samples_per_sec = batch / step_time
 
     # train FLOPs per token: 3x forward; forward = matmul MACs * 2

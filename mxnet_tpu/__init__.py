@@ -50,5 +50,6 @@ from . import io  # noqa: F401
 from . import image  # noqa: F401
 from . import ops  # noqa: F401
 from . import models  # noqa: F401
+from . import operator  # noqa: F401
 
 device_module = device

@@ -251,7 +251,7 @@ class PoissonNLLLoss(Loss):
                                  stirling)
             loss = loss + stirling
         loss = _apply_weighting(loss, self._weight, sample_weight)
-        return loss.mean()
+        return self._mean_nonbatch(loss)
 
 
 class CosineEmbeddingLoss(Loss):

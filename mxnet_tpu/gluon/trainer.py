@@ -189,7 +189,7 @@ class Trainer:
         u = Updater(self._optimizer)
         u.states = dict(self._states)
         with open(fname, "wb") as f:
-            f.write(u.get_states(dump_optimizer=False))
+            f.write(u.get_states(dump_optimizer=True))
 
     def load_states(self, fname):
         """Parity: trainer.py:537."""
@@ -199,6 +199,12 @@ class Trainer:
         with open(fname, "rb") as f:
             u.set_states(f.read())
         self._states = dict(u.states)
+        if u.optimizer is not self._optimizer:
+            # adopt the saved optimizer's step counters (num_update drives
+            # lr schedules and bias correction)
+            self._optimizer.num_update = u.optimizer.num_update
+            self._optimizer._index_update_count = \
+                dict(u.optimizer._index_update_count)
 
 
 class _RuleAdapter:

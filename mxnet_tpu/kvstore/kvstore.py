@@ -77,9 +77,11 @@ class KVStore(KVStoreBase):
             self._store[self._key(k)] = v.copy()
 
     def broadcast(self, key, value, out, priority=0):
-        keys = key if isinstance(key, (list, tuple)) else [key]
-        values = value if isinstance(value, (list, tuple)) else [value]
-        outs = out if isinstance(out, (list, tuple)) else [out]
+        if isinstance(key, (list, tuple)):
+            keys, values, outs = key, value, out
+        else:
+            # single key: `out` may be a list of device copies for that key
+            keys, values, outs = [key], [value], [out]
         for k, v in zip(keys, values):
             self._store[self._key(k)] = v.copy()
         for k, o in zip(keys, outs):

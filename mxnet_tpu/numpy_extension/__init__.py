@@ -39,7 +39,7 @@ __all__ = [
     "sldwin_atten_mask_like", "sldwin_atten_score", "sldwin_atten_context",
     "multi_head_attention", "ctc_loss", "foreach", "while_loop", "cond",
     "save", "load", "waitall", "set_np", "reset_np", "is_np_array",
-    "seed", "rnn", "intgemm_fully_connected",
+    "seed", "rnn", "intgemm_fully_connected", "custom",
 ]
 
 
@@ -973,3 +973,10 @@ def is_np_default_dtype():
 
 def seed(s):
     _rng.seed(s)
+
+
+def custom(*inputs, op_type, **kwargs):
+    """Invoke a registered `mx.operator.CustomOpProp` op (parity:
+    `mx.nd.Custom`, `src/operator/custom/custom.cc`)."""
+    from ..operator import custom as _custom
+    return _custom(*inputs, op_type=op_type, **kwargs)

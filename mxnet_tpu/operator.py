@@ -1,0 +1,173 @@
+"""Custom Python operators (parity: `python/mxnet/operator.py`,
+`src/operator/custom/custom.cc`).
+
+The reference executes user Python `CustomOp.forward/backward` on dedicated
+engine callback threads mid-graph. The TPU-native equivalent is
+`jax.pure_callback`: the custom op becomes a host callback embedded in the
+XLA program (works eagerly *and* under `jit`/hybridize), wrapped in
+`jax.custom_vjp` so `CustomOp.backward` drives the gradient. This is the
+documented slow path (host round-trip per call), same as the reference's
+GIL-bound custom ops.
+
+API surface kept from the reference:
+
+    @mx.operator.register("sigmoid")
+    class SigmoidProp(mx.operator.CustomOpProp):
+        def list_arguments(self): return ['data']
+        def list_outputs(self): return ['output']
+        def infer_shape(self, in_shape): return in_shape, [in_shape[0]]
+        def create_operator(self, ctx, shapes, dtypes): return Sigmoid()
+
+    y = mx.npx.custom(x, op_type="sigmoid")
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple, Type
+
+import numpy as _onp
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray.ndarray import ndarray, apply_op
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered",
+           "custom"]
+
+_registry: Dict[str, Type["CustomOpProp"]] = {}
+
+
+def register(reg_name: str):
+    """Class decorator registering a `CustomOpProp` under `reg_name`
+    (parity: `mx.operator.register`, `python/mxnet/operator.py`)."""
+    def do_register(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register() expects a CustomOpProp subclass")
+        prop_cls._op_type = reg_name
+        _registry[reg_name] = prop_cls
+        return prop_cls
+    return do_register
+
+
+def get_all_registered() -> List[str]:
+    return sorted(_registry)
+
+
+class CustomOp:
+    """User-defined operator body. Tensors arrive as numpy arrays on the
+    host (the pure_callback boundary); `assign` honours the write request
+    like the reference (`python/mxnet/operator.py` CustomOp.assign)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    @staticmethod
+    def assign(dst, req, src):
+        if req in ("write", "inplace", None):
+            dst[...] = src
+        elif req == "add":
+            dst[...] = dst[...] + src
+        elif req == "null":
+            pass
+        else:
+            raise MXNetError(f"unknown req {req}")
+
+
+class CustomOpProp:
+    """Shape/type inference + operator factory (parity: CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True, **kwargs):
+        self.need_top_grad_ = need_top_grad
+        self._kwargs = {k: str(v) for k, v in kwargs.items()}
+
+    # -- overridables --------------------------------------------------------
+    def list_arguments(self) -> List[str]:
+        return ["data"]
+
+    def list_outputs(self) -> List[str]:
+        return ["output"]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs())
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def create_operator(self, ctx, in_shapes, in_dtypes) -> CustomOp:
+        raise NotImplementedError
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+
+def custom(*inputs, op_type: str, **kwargs):
+    """Invoke a registered custom op (parity: `mx.nd.Custom`,
+    `MXCustomOp` dispatch in `src/operator/custom/custom.cc`)."""
+    if op_type not in _registry:
+        raise MXNetError(f"custom op '{op_type}' not registered; "
+                         f"known: {get_all_registered()}")
+    prop = _registry[op_type](**kwargs)
+
+    in_shapes = [tuple(x.shape) for x in inputs]
+    shp = prop.infer_shape([list(s) for s in in_shapes])
+    in_shapes2, out_shapes = shp[0], shp[1]
+    in_dtypes = [x.dtype for x in inputs]
+    out_dtypes = prop.infer_type(list(in_dtypes))[1]
+    n_out = len(out_shapes)
+
+    op = prop.create_operator(None, in_shapes2, in_dtypes)
+    out_avals = [jax.ShapeDtypeStruct(tuple(s), d)
+                 for s, d in zip(out_shapes, out_dtypes)]
+
+    def _host_forward(*in_vals):
+        ins = [_onp.asarray(v) for v in in_vals]
+        outs = [_onp.zeros(a.shape, a.dtype) for a in out_avals]
+        op.forward(is_train=True, req=["write"] * n_out, in_data=ins,
+                   out_data=outs, aux=[])
+        return tuple(outs)
+
+    def _host_backward(*vals):
+        n_in = len(inputs)
+        ograds = [_onp.asarray(v) for v in vals[:n_out]]
+        ins = [_onp.asarray(v) for v in vals[n_out:n_out + n_in]]
+        outs = [_onp.asarray(v) for v in vals[n_out + n_in:]]
+        igrads = [_onp.zeros(v.shape, v.dtype) for v in ins]
+        op.backward(req=["write"] * n_in, out_grad=ograds, in_data=ins,
+                    out_data=outs, in_grad=igrads, aux=[])
+        return tuple(igrads)
+
+    @jax.custom_vjp
+    def _fn(*in_vals):
+        res = jax.pure_callback(_host_forward, tuple(out_avals), *in_vals)
+        return res if n_out > 1 else res[0]
+
+    def _fn_fwd(*in_vals):
+        res = jax.pure_callback(_host_forward, tuple(out_avals), *in_vals)
+        out = res if n_out > 1 else res[0]
+        return out, (in_vals, res)
+
+    def _fn_bwd(saved, g):
+        in_vals, out_vals = saved
+        gs = g if n_out > 1 else (g,)
+        in_avals = tuple(jax.ShapeDtypeStruct(v.shape, v.dtype)
+                         for v in in_vals)
+        igrads = jax.pure_callback(_host_backward, in_avals,
+                                   *gs, *in_vals, *out_vals)
+        return tuple(igrads)
+
+    _fn.defvjp(_fn_fwd, _fn_bwd)
+
+    return apply_op(_fn, tuple(inputs), {}, name=f"custom[{op_type}]",
+                    n_out=n_out)

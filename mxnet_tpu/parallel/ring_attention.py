@@ -57,9 +57,12 @@ def ring_attention_sharded(q, k, v, axis_name: str = "sp",
     lq = q.shape[2]
     b, h = q.shape[0], q.shape[1]
 
-    m0 = jnp.full((b, h, lq), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, lq), jnp.float32)
-    acc0 = jnp.zeros(q.shape[:3] + (d,), jnp.float32)
+    # init carries as data-dependent on q so they carry the same
+    # varying-manual-axes ('sp') type as the scan body's outputs
+    zq = (q * 0).astype(jnp.float32)
+    m0 = zq[..., 0] + NEG_INF
+    l0 = zq[..., 0]
+    acc0 = zq
 
     def step(carry, t):
         acc, m, l, kk, vv = carry
@@ -77,6 +80,7 @@ def ring_attention_sharded(q, k, v, axis_name: str = "sp",
         beta = jnp.exp(bm - m_new)
         l = l * alpha + bl * beta
         acc = acc * alpha[..., None] + a * beta[..., None]
+        m = m_new
         # rotate k/v to the next device (skip the final rotate's result use,
         # but keep it unconditional so the comm schedule is static)
         kk = lax.ppermute(kk, axis_name, [(i, (i + 1) % n) for i in range(n)])

@@ -157,6 +157,9 @@ class ShardedTrainStep:
                       for b, s in zip(batch_vals, self._batch_shardings)]
         self.pvals, self.opt_state, loss = self._step_fn(
             self.pvals, self.opt_state, hp, key, *batch_vals)
+        # rebind block Parameters to the fresh (non-donated) buffers so
+        # eager reads (p.data()) stay valid — pointer update only
+        self.sync_params_to_block()
         return loss
 
     def sync_params_to_block(self):

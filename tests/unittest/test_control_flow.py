@@ -1,0 +1,76 @@
+"""Control-flow ops (parity: `tests/python/unittest/test_contrib_control_flow.py`)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_foreach_cumsum():
+    data = mx.np.array(onp.arange(5, dtype=onp.float32))
+    init = mx.np.zeros(())
+
+    def body(x, state):
+        new = state + x
+        return new, new
+
+    outs, final = mx.npx.foreach(body, data, init)
+    assert_almost_equal(outs, onp.array([0, 1, 3, 6, 10], onp.float32))
+    assert float(final) == 10.0
+
+
+def test_foreach_multiple_states():
+    data = mx.np.array(onp.ones((4, 2), onp.float32))
+    s1 = mx.np.zeros((2,))
+    s2 = mx.np.ones((2,))
+
+    def body(x, states):
+        a, b = states
+        return a + b, [a + x, b * 2]
+
+    outs, (fa, fb) = mx.npx.foreach(body, data, [s1, s2])
+    assert outs.shape == (4, 2)
+    assert_almost_equal(fb, onp.ones(2) * 16)
+
+
+def test_while_loop():
+    i = mx.np.zeros(())
+    total = mx.np.zeros(())
+
+    def cond(vals):
+        return vals[0] < 5
+
+    def body(vals):
+        i, t = vals
+        return [i + 1, t + i]
+
+    out = mx.npx.while_loop(cond, body, [i, total], max_iterations=100)
+    assert float(out[0]) == 5.0
+    assert float(out[1]) == 10.0  # 0+1+2+3+4
+
+
+def test_cond():
+    a = mx.np.array(2.0)
+    b = mx.np.array(3.0)
+    out = mx.npx.cond(a < b, lambda x, y: x + y, lambda x, y: x * y, [a, b])
+    assert float(out) == 5.0
+    out2 = mx.npx.cond(a > b, lambda x, y: x + y, lambda x, y: x * y, [a, b])
+    assert float(out2) == 6.0
+
+
+def test_foreach_grad():
+    data = mx.np.array(onp.array([1.0, 2.0, 3.0], onp.float32))
+    data.attach_grad()
+    init = mx.np.ones(())
+
+    def body(x, state):
+        new = state * x
+        return new, new
+
+    with mx.autograd.record():
+        outs, final = mx.npx.foreach(body, data, init)
+        loss = final
+    loss.backward()
+    # final = 1*1*2*3 = 6; d/dx_i = prod/x_i
+    assert_almost_equal(data.grad, onp.array([6.0, 3.0, 2.0]), rtol=1e-5,
+                        atol=1e-5)

@@ -1,0 +1,218 @@
+"""Gluon blocks/layers (parity model: `tests/python/unittest/test_gluon.py`)."""
+import os
+import tempfile
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _x(*shape):
+    return mx.np.array(onp.random.uniform(-1, 1, shape).astype(onp.float32))
+
+
+def test_dense():
+    layer = nn.Dense(8, in_units=4, activation="relu")
+    layer.initialize()
+    x = _x(2, 4)
+    y = layer(x)
+    assert y.shape == (2, 8)
+    w = onp.asarray(layer.weight.data())
+    b = onp.asarray(layer.bias.data())
+    want = onp.maximum(onp.asarray(x) @ w.T + b, 0)
+    assert_almost_equal(y, want, rtol=1e-5, atol=1e-5)
+
+
+def test_dense_deferred_init():
+    layer = nn.Dense(8)
+    layer.initialize()
+    y = layer(_x(2, 5))
+    assert y.shape == (2, 8)
+    assert layer.weight.shape == (8, 5)
+
+
+def test_sequential_and_children():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dropout(0.5), nn.Dense(4))
+    net.initialize()
+    y = net(_x(3, 8))
+    assert y.shape == (3, 4)
+    assert len(net) == 3
+    params = net.collect_params()
+    assert len(params) == 4  # 2 dense x (weight, bias)
+
+
+def test_hybridize_same_output():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="tanh"), nn.Dense(4))
+    net.initialize()
+    x = _x(2, 8)
+    y_eager = onp.asarray(net(x))
+    net.hybridize()
+    y_hyb = onp.asarray(net(x))
+    assert_almost_equal(y_eager, y_hyb, rtol=1e-5, atol=1e-6)
+    # second call hits the jit cache
+    assert_almost_equal(onp.asarray(net(x)), y_hyb, rtol=1e-5, atol=1e-6)
+
+
+def test_conv2d_shapes():
+    layer = nn.Conv2D(8, kernel_size=3, strides=2, padding=1, in_channels=3)
+    layer.initialize()
+    y = layer(_x(2, 3, 16, 16))
+    assert y.shape == (2, 8, 8, 8)
+
+
+def test_conv1d_conv3d():
+    c1 = nn.Conv1D(4, kernel_size=3, in_channels=2)
+    c1.initialize()
+    assert c1(_x(2, 2, 10)).shape == (2, 4, 8)
+    c3 = nn.Conv3D(4, kernel_size=3, in_channels=2)
+    c3.initialize()
+    assert c3(_x(1, 2, 6, 6, 6)).shape == (1, 4, 4, 4, 4)
+
+
+def test_conv_transpose():
+    ct = nn.Conv2DTranspose(4, kernel_size=3, strides=2, in_channels=2)
+    ct.initialize()
+    y = ct(_x(1, 2, 8, 8))
+    assert y.shape[1] == 4 and y.shape[2] > 8
+
+
+def test_pooling():
+    x = _x(1, 2, 8, 8)
+    assert nn.MaxPool2D(2)(x).shape == (1, 2, 4, 4)
+    assert nn.AvgPool2D(2)(x).shape == (1, 2, 4, 4)
+    assert nn.GlobalAvgPool2D()(x).shape == (1, 2, 1, 1)
+    assert nn.GlobalMaxPool2D()(x).shape == (1, 2, 1, 1)
+
+
+def test_batchnorm_train_vs_eval():
+    bn = nn.BatchNorm(in_channels=4)
+    bn.initialize()
+    x = _x(8, 4, 3, 3)
+    with mx.autograd.record():
+        y_train = bn(x)
+    xv = onp.asarray(x)
+    mean = xv.mean(axis=(0, 2, 3), keepdims=True)
+    var = xv.var(axis=(0, 2, 3), keepdims=True)
+    assert_almost_equal(y_train, (xv - mean) / onp.sqrt(var + 1e-5),
+                        rtol=1e-3, atol=1e-3)
+    # eval mode uses running stats (initialised to 0 mean / 1 var)
+    y_eval = bn(x)
+    assert not onp.allclose(onp.asarray(y_eval), onp.asarray(y_train))
+
+
+def test_layernorm_groupnorm_instancenorm():
+    x = _x(4, 6, 5)
+    ln = nn.LayerNorm(in_channels=5)
+    ln.initialize()
+    y = onp.asarray(ln(x))
+    assert abs(y.mean()) < 1e-4 and abs(y.std() - 1) < 1e-2
+    gn = nn.GroupNorm(num_groups=3, in_channels=6)
+    gn.initialize()
+    assert gn(_x(2, 6, 4, 4)).shape == (2, 6, 4, 4)
+    inorm = nn.InstanceNorm(in_channels=6)
+    inorm.initialize()
+    assert inorm(_x(2, 6, 4)).shape == (2, 6, 4)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    idx = mx.np.array([[1, 2], [3, 4]], dtype="int32")
+    y = emb(idx)
+    assert y.shape == (2, 2, 4)
+    w = onp.asarray(emb.weight.data())
+    assert_almost_equal(y, w[onp.asarray(idx)], rtol=1e-6, atol=1e-6)
+
+
+def test_dropout_train_eval():
+    do = nn.Dropout(0.5)
+    do.initialize()
+    x = mx.np.ones((100, 100))
+    y_eval = do(x)
+    assert_almost_equal(y_eval, onp.ones((100, 100)))
+    with mx.autograd.record():
+        y_train = onp.asarray(do(x))
+    frac_zero = (y_train == 0).mean()
+    assert 0.3 < frac_zero < 0.7
+
+
+def test_save_load_parameters():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, in_units=8), nn.Dense(4, in_units=16))
+    net.initialize()
+    x = _x(2, 8)
+    y0 = onp.asarray(net(x))
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "net.params")
+        net.save_parameters(path)
+        net2 = nn.HybridSequential()
+        net2.add(nn.Dense(16, in_units=8), nn.Dense(4, in_units=16))
+        net2.load_parameters(path)
+        assert_almost_equal(net2(x), y0, rtol=1e-6, atol=1e-6)
+
+
+def test_grad_through_block():
+    net = nn.Dense(1, in_units=3, use_bias=False)
+    net.initialize()
+    x = _x(4, 3)
+    with mx.autograd.record():
+        y = net(x).sum()
+    y.backward()
+    g = net.weight.grad
+    assert_almost_equal(g, onp.asarray(x).sum(axis=0, keepdims=True),
+                        rtol=1e-5, atol=1e-5)
+
+
+def test_setattr_child_registration():
+    class Net(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Dense(8, in_units=4)
+            self.fc2 = nn.Dense(2, in_units=8)
+
+        def forward(self, x):
+            return self.fc2(self.fc1(x))
+
+    net = Net()
+    net.initialize()
+    assert net(_x(2, 4)).shape == (2, 2)
+    assert len(net.collect_params()) == 4
+
+
+def test_block_hooks():
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    calls = []
+    h = net.register_forward_hook(lambda blk, inp, out: calls.append("f"))
+    net(_x(1, 2))
+    assert calls == ["f"]
+    h.detach()
+    net(_x(1, 2))
+    assert calls == ["f"]
+
+
+def test_activations():
+    x = _x(2, 5)
+    for act in ["relu", "sigmoid", "tanh", "softsign"]:
+        y = nn.Activation(act)(x)
+        assert y.shape == x.shape
+    assert nn.LeakyReLU(0.1)(x).shape == x.shape
+    for L in [nn.GELU, nn.SiLU, nn.ELU, nn.SELU, nn.Swish, nn.PReLU]:
+        layer = L()
+        layer.initialize()
+        assert layer(x).shape == x.shape
+
+
+def test_model_zoo_forward():
+    from mxnet_tpu.gluon.model_zoo import vision
+    for name in ["resnet18_v1", "mobilenet_v2_0_25", "squeezenet1_0"]:
+        net = vision.get_model(name, classes=10)
+        net.initialize()
+        y = net(_x(1, 3, 32, 32))
+        assert y.shape == (1, 10)

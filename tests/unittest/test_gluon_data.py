@@ -1,0 +1,127 @@
+"""Data pipeline (parity: `test_gluon_data.py`)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon.data import (ArrayDataset, SimpleDataset, DataLoader,
+                                  BatchSampler, SequentialSampler,
+                                  RandomSampler)
+
+
+def test_array_dataset_and_transform():
+    x = onp.arange(20).reshape(10, 2).astype(onp.float32)
+    y = onp.arange(10).astype(onp.float32)
+    ds = ArrayDataset(x, y)
+    assert len(ds) == 10
+    xi, yi = ds[3]
+    assert onp.allclose(onp.asarray(xi), x[3])
+    ds2 = ds.transform(lambda a, b: (a * 2, b))
+    xi2, yi2 = ds2[3]
+    assert onp.allclose(onp.asarray(xi2), x[3] * 2)
+    ds3 = SimpleDataset(list(range(5))).transform_first(lambda v: v + 1)
+    assert ds3[0] == 1
+
+
+def test_samplers():
+    seq = list(SequentialSampler(5))
+    assert seq == [0, 1, 2, 3, 4]
+    rnd = list(RandomSampler(100))
+    assert sorted(rnd) == list(range(100)) and rnd != list(range(100))
+    bs = list(BatchSampler(SequentialSampler(7), 3, last_batch="keep"))
+    assert bs == [[0, 1, 2], [3, 4, 5], [6]]
+    bs2 = list(BatchSampler(SequentialSampler(7), 3, last_batch="discard"))
+    assert len(bs2) == 2
+    bs3 = list(BatchSampler(SequentialSampler(7), 3, last_batch="rollover"))
+    assert len(bs3) == 2
+
+
+def test_dataloader_batches():
+    x = onp.random.uniform(size=(10, 3)).astype(onp.float32)
+    y = onp.arange(10).astype(onp.int32)
+    loader = DataLoader(ArrayDataset(x, y), batch_size=4, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == 3
+    bx, by = batches[0]
+    assert bx.shape == (4, 3)
+    assert onp.allclose(onp.asarray(bx), x[:4])
+    assert batches[-1][0].shape == (2, 3)
+
+
+def test_dataloader_shuffle_covers_all():
+    x = onp.arange(32).astype(onp.float32)
+    loader = DataLoader(SimpleDataset(list(x)), batch_size=8, shuffle=True)
+    seen = []
+    for b in loader:
+        seen.extend(onp.asarray(b).ravel().tolist())
+    assert sorted(seen) == list(x)
+
+
+def test_dataloader_num_workers():
+    x = onp.random.uniform(size=(12, 2)).astype(onp.float32)
+    loader = DataLoader(ArrayDataset(x, x.copy()), batch_size=4,
+                        num_workers=2)
+    n = 0
+    for bx, by in loader:
+        n += bx.shape[0]
+    assert n == 12
+
+
+def test_batchify_functions():
+    from mxnet_tpu.gluon.data import batchify
+    arrs = [onp.ones((3,), onp.float32), onp.zeros((3,), onp.float32)]
+    st = batchify.Stack()(arrs)
+    assert st.shape == (2, 3)
+    padded = batchify.Pad(val=-1)([onp.ones((2,)), onp.ones((4,))])
+    assert padded.shape == (2, 4)
+    assert float(onp.asarray(padded)[0, -1]) == -1
+    g = batchify.Group(batchify.Stack(), batchify.Pad())(
+        [(onp.ones((2,)), onp.ones((3,))), (onp.ones((2,)), onp.ones((5,)))])
+    assert g[0].shape == (2, 2) and g[1].shape == (2, 5)
+
+
+def test_vision_transforms():
+    from mxnet_tpu.gluon.data.vision import transforms
+    img = mx.np.array(onp.random.randint(0, 255, (8, 8, 3)).astype(onp.uint8))
+    t = transforms.ToTensor()(img)
+    assert t.shape == (3, 8, 8)
+    assert float(t.max()) <= 1.0
+    norm = transforms.Normalize(mean=0.5, std=0.5)(t)
+    assert norm.shape == (3, 8, 8)
+    comp = transforms.Compose([transforms.ToTensor(),
+                               transforms.Normalize(0.5, 0.5)])
+    assert comp(img).shape == (3, 8, 8)
+    r = transforms.Resize(4)(img)
+    assert r.shape == (4, 4, 3)
+
+
+def test_record_file_dataset(tmp_path):
+    from mxnet_tpu import recordio
+    path = str(tmp_path / "test.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        w.write(f"record-{i}".encode())
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    items = []
+    while True:
+        item = r.read()
+        if item is None:
+            break
+        items.append(item)
+    assert items == [f"record-{i}".encode() for i in range(5)]
+    r.close()
+
+
+def test_indexed_recordio(tmp_path):
+    from mxnet_tpu import recordio
+    rec = str(tmp_path / "t.rec")
+    idx = str(tmp_path / "t.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(4):
+        w.write_idx(i, f"payload{i}".encode())
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, rec, "r")
+    assert r.read_idx(2) == b"payload2"
+    assert r.read_idx(0) == b"payload0"
+    r.close()

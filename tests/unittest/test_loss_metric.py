@@ -1,0 +1,139 @@
+"""Losses & metrics (parity: `test_loss.py`, `test_metric.py`)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_l2_l1():
+    pred = mx.np.array([[1.0, 2.0], [3.0, 4.0]])
+    label = mx.np.array([[1.5, 2.0], [2.0, 5.0]])
+    l2 = gluon.loss.L2Loss()(pred, label)
+    want = 0.5 * ((onp.asarray(pred) - onp.asarray(label)) ** 2).mean(axis=1)
+    assert_almost_equal(l2, want, rtol=1e-5, atol=1e-6)
+    l1 = gluon.loss.L1Loss()(pred, label)
+    want1 = onp.abs(onp.asarray(pred) - onp.asarray(label)).mean(axis=1)
+    assert_almost_equal(l1, want1, rtol=1e-5, atol=1e-6)
+
+
+def test_softmax_ce_sparse_and_dense():
+    logits = onp.random.uniform(-1, 1, (4, 5)).astype(onp.float32)
+    labels = onp.array([0, 2, 4, 1])
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(mx.np.array(logits),
+                                             mx.np.array(labels))
+    p = onp.exp(logits) / onp.exp(logits).sum(1, keepdims=True)
+    want = -onp.log(p[onp.arange(4), labels])
+    assert_almost_equal(l, want, rtol=1e-4, atol=1e-5)
+    oh = onp.eye(5, dtype=onp.float32)[labels]
+    l2 = gluon.loss.SoftmaxCrossEntropyLoss(sparse_label=False)(
+        mx.np.array(logits), mx.np.array(oh))
+    assert_almost_equal(l2, want, rtol=1e-4, atol=1e-5)
+
+
+def test_sigmoid_bce():
+    pred = onp.random.uniform(-2, 2, (3, 4)).astype(onp.float32)
+    label = (onp.random.uniform(size=(3, 4)) > 0.5).astype(onp.float32)
+    l = gluon.loss.SigmoidBinaryCrossEntropyLoss()(mx.np.array(pred),
+                                                   mx.np.array(label))
+    s = 1 / (1 + onp.exp(-pred))
+    want = -(label * onp.log(s) + (1 - label) * onp.log(1 - s)).mean(axis=1)
+    assert_almost_equal(l, want, rtol=1e-4, atol=1e-5)
+
+
+def test_kl_huber_hinge_triplet_cosine():
+    a = mx.np.array(onp.random.uniform(0.1, 1, (3, 4)).astype(onp.float32))
+    b = mx.np.array(onp.random.uniform(0.1, 1, (3, 4)).astype(onp.float32))
+    assert gluon.loss.KLDivLoss(from_logits=False)(a, b).shape == (3,)
+    assert gluon.loss.HuberLoss()(a, b).shape == (3,)
+    assert gluon.loss.HingeLoss()(a, b).shape == (3,)
+    assert gluon.loss.SquaredHingeLoss()(a, b).shape == (3,)
+    c = mx.np.array(onp.random.uniform(0.1, 1, (3, 4)).astype(onp.float32))
+    assert gluon.loss.TripletLoss()(a, b, c).shape == (3,)
+    lbl = mx.np.array(onp.ones((3,), onp.float32))
+    assert gluon.loss.CosineEmbeddingLoss()(a, b, lbl).shape == (3,)
+    assert gluon.loss.PoissonNLLLoss()(a, b).shape == (3,)
+    sgn = mx.np.array(onp.sign(onp.random.uniform(-1, 1, (3, 4))
+                               ).astype(onp.float32))
+    assert gluon.loss.LogisticLoss()(a, sgn).shape == (3,)
+
+
+def test_ctc_loss_runs():
+    # (N, T, C) layout NTC
+    pred = mx.np.array(onp.random.uniform(-1, 1, (2, 10, 5)).astype(onp.float32))
+    label = mx.np.array(onp.array([[1, 2, 0, 0], [2, 3, 1, 0]], onp.float32))
+    l = gluon.loss.CTCLoss()(pred, label)
+    assert l.shape == (2,)
+    assert bool((l > 0).all())
+
+
+def test_loss_backward():
+    pred = mx.np.array(onp.random.uniform(-1, 1, (4, 3)).astype(onp.float32))
+    label = mx.np.array(onp.array([0, 1, 2, 0]))
+    pred.attach_grad()
+    with mx.autograd.record():
+        l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label).mean()
+    l.backward()
+    assert pred.grad.shape == pred.shape
+    assert float(abs(pred.grad).sum()) > 0
+
+
+def test_accuracy_topk():
+    m = gluon.metric.Accuracy()
+    pred = mx.np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    label = mx.np.array([1, 0, 0])
+    m.update(label, pred)
+    name, val = m.get()
+    assert abs(val - 2.0 / 3) < 1e-6
+    tk = gluon.metric.TopKAccuracy(top_k=2)
+    tk.update(mx.np.array([2, 1]),
+              mx.np.array([[0.1, 0.2, 0.7], [0.6, 0.3, 0.1]]))
+    assert tk.get()[1] == 1.0
+
+
+def test_mae_mse_rmse():
+    pred = mx.np.array([[1.0], [2.0]])
+    label = mx.np.array([[1.5], [1.0]])
+    for cls, want in [(gluon.metric.MAE, 0.75), (gluon.metric.MSE, 0.625)]:
+        m = cls()
+        m.update(label, pred)
+        assert abs(m.get()[1] - want) < 1e-6
+    m = gluon.metric.RMSE()
+    m.update(label, pred)
+    assert abs(m.get()[1] - 0.625 ** 0.5) < 1e-6
+
+
+def test_f1_mcc_composite():
+    pred = mx.np.array([[0.8, 0.2], [0.3, 0.7], [0.6, 0.4], [0.1, 0.9]])
+    label = mx.np.array([0, 1, 1, 1])
+    f1 = gluon.metric.F1()
+    f1.update(label, pred)
+    assert 0 < f1.get()[1] <= 1
+    mcc = gluon.metric.MCC()
+    mcc.update(label, pred)
+    assert -1 <= mcc.get()[1] <= 1
+    comp = gluon.metric.CompositeEvalMetric([gluon.metric.Accuracy(),
+                                             gluon.metric.TopKAccuracy(2)])
+    comp.update(label, pred)
+    names, vals = comp.get()
+    assert len(names) == 2
+
+
+def test_perplexity_crossentropy():
+    pred = mx.np.array([[0.25, 0.75], [0.5, 0.5]])
+    label = mx.np.array([1, 0])
+    ce = gluon.metric.CrossEntropy()
+    ce.update(label, pred)
+    want = -(onp.log(0.75) + onp.log(0.5)) / 2
+    assert abs(ce.get()[1] - want) < 1e-5
+    ppl = gluon.metric.Perplexity()
+    ppl.update(label, pred)
+    assert abs(ppl.get()[1] - onp.exp(want)) < 1e-4
+
+
+def test_metric_reset_and_create():
+    m = gluon.metric.Accuracy()
+    m.update(mx.np.array([1]), mx.np.array([[0.0, 1.0]]))
+    m.reset()
+    assert m.num_inst == 0

@@ -1,0 +1,189 @@
+"""NumPy op surface (parity model: `tests/python/unittest/test_numpy_op.py`).
+
+Checks numerics of the `mx.np` namespace against NumPy golden outputs and
+(via `mx.autograd`) against finite differences for a few representative ops.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _rand(*shape, dtype=onp.float32):
+    return onp.random.uniform(-1, 1, size=shape).astype(dtype)
+
+
+@pytest.mark.parametrize("name", [
+    "exp", "log", "sqrt", "sin", "cos", "tan", "tanh", "arctan", "abs",
+    "floor", "ceil", "sign", "square", "cbrt", "expm1", "log1p", "log2",
+    "log10", "sinh", "cosh", "arcsinh",
+])
+def test_unary(name):
+    x = _rand(3, 4) * 0.8 + 1.5  # keep in positive domain for log/sqrt
+    got = getattr(mx.np, name)(mx.np.array(x))
+    want = getattr(onp, name)(x)
+    assert_almost_equal(got, want, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("name", ["add", "subtract", "multiply", "divide",
+                                  "maximum", "minimum", "power",
+                                  "arctan2", "hypot"])
+def test_binary(name):
+    a, b = _rand(2, 3) + 1.5, _rand(2, 3) + 1.5
+    got = getattr(mx.np, name)(mx.np.array(a), mx.np.array(b))
+    assert_almost_equal(got, getattr(onp, name)(a, b), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("name,kw", [
+    ("sum", {}), ("mean", {}), ("max", {}), ("min", {}), ("prod", {}),
+    ("var", {}), ("std", {}),
+    ("sum", {"axis": 1}), ("mean", {"axis": 0}),
+    ("sum", {"axis": 1, "keepdims": True}),
+])
+def test_reduction(name, kw):
+    x = _rand(3, 5)
+    got = getattr(mx.np, name)(mx.np.array(x), **kw)
+    assert_almost_equal(got, getattr(onp, name)(x, **kw), rtol=1e-5, atol=1e-5)
+
+
+def test_argminmax_sort():
+    x = _rand(4, 6)
+    assert_almost_equal(mx.np.argmax(mx.np.array(x), axis=1),
+                        onp.argmax(x, axis=1))
+    assert_almost_equal(mx.np.argmin(mx.np.array(x), axis=0),
+                        onp.argmin(x, axis=0))
+    assert_almost_equal(mx.np.sort(mx.np.array(x), axis=1),
+                        onp.sort(x, axis=1))
+    assert_almost_equal(mx.np.argsort(mx.np.array(x), axis=1),
+                        onp.argsort(x, axis=1))
+
+
+def test_matmul_dot_einsum():
+    a, b = _rand(3, 4), _rand(4, 5)
+    assert_almost_equal(mx.np.matmul(mx.np.array(a), mx.np.array(b)),
+                        a @ b, rtol=1e-5, atol=1e-5)
+    assert_almost_equal(mx.np.dot(mx.np.array(a), mx.np.array(b)),
+                        onp.dot(a, b), rtol=1e-5, atol=1e-5)
+    x = _rand(2, 3, 4)
+    y = _rand(2, 4, 5)
+    assert_almost_equal(
+        mx.np.einsum("bij,bjk->bik", mx.np.array(x), mx.np.array(y)),
+        onp.einsum("bij,bjk->bik", x, y), rtol=1e-5, atol=1e-5)
+
+
+def test_shape_manipulation():
+    x = _rand(2, 3, 4)
+    mxx = mx.np.array(x)
+    assert mx.np.reshape(mxx, (6, 4)).shape == (6, 4)
+    assert mx.np.transpose(mxx, (2, 0, 1)).shape == (4, 2, 3)
+    assert mx.np.expand_dims(mxx, 1).shape == (2, 1, 3, 4)
+    assert mx.np.squeeze(mx.np.ones((1, 3, 1))).shape == (3,)
+    assert mx.np.swapaxes(mxx, 0, 2).shape == (4, 3, 2)
+    assert mx.np.moveaxis(mxx, 0, -1).shape == (3, 4, 2)
+    assert mx.np.concatenate([mxx, mxx], axis=1).shape == (2, 6, 4)
+    assert mx.np.stack([mxx, mxx]).shape == (2, 2, 3, 4)
+    s = mx.np.split(mx.np.arange(12).reshape(3, 4), 2, axis=1)
+    assert len(s) == 2 and s[0].shape == (3, 2)
+    assert mx.np.flip(mxx, axis=0).shape == x.shape
+    assert mx.np.tile(mx.np.ones((2,)), 3).shape == (6,)
+    assert mx.np.repeat(mx.np.ones((2, 2)), 2, axis=0).shape == (4, 2)
+    assert mx.np.roll(mxx, 1, axis=0).shape == x.shape
+
+
+def test_broadcasting_where_clip():
+    a = _rand(3, 1)
+    b = _rand(1, 4)
+    assert_almost_equal(mx.np.array(a) + mx.np.array(b), a + b)
+    c = _rand(3, 4)
+    assert_almost_equal(mx.np.where(mx.np.array(c) > 0, mx.np.array(c), 0.0),
+                        onp.where(c > 0, c, 0.0))
+    assert_almost_equal(mx.np.clip(mx.np.array(c), -0.5, 0.5),
+                        onp.clip(c, -0.5, 0.5))
+
+
+def test_indexing_ops():
+    x = _rand(5, 4)
+    idx = onp.array([0, 2, 4])
+    assert_almost_equal(mx.np.take(mx.np.array(x), mx.np.array(idx), axis=0),
+                        onp.take(x, idx, axis=0))
+    assert_almost_equal(
+        mx.np.take_along_axis(mx.np.array(x),
+                              mx.np.array(onp.argsort(x, axis=1)), axis=1),
+        onp.take_along_axis(x, onp.argsort(x, axis=1), axis=1))
+
+
+def test_cumsum_diff_pad():
+    x = _rand(3, 4)
+    assert_almost_equal(mx.np.cumsum(mx.np.array(x), axis=1),
+                        onp.cumsum(x, axis=1), rtol=1e-5, atol=1e-5)
+    assert_almost_equal(mx.np.diff(mx.np.array(x), axis=1),
+                        onp.diff(x, axis=1), rtol=1e-5, atol=1e-6)
+    assert_almost_equal(mx.np.pad(mx.np.array(x), ((1, 1), (0, 2))),
+                        onp.pad(x, ((1, 1), (0, 2))))
+
+
+def test_linalg():
+    a = _rand(4, 4) + 4 * onp.eye(4, dtype=onp.float32)
+    assert_almost_equal(mx.np.linalg.inv(mx.np.array(a)), onp.linalg.inv(a),
+                        rtol=1e-4, atol=1e-4)
+    assert_almost_equal(mx.np.linalg.norm(mx.np.array(a)), onp.linalg.norm(a),
+                        rtol=1e-5, atol=1e-5)
+    got = mx.np.linalg.svd(mx.np.array(a))
+    want = onp.linalg.svd(a)
+    assert_almost_equal(onp.abs(onp.asarray(got[1] if len(got) == 3 else got[0])),
+                        onp.abs(want[1]), rtol=1e-4, atol=1e-4)
+    sym = a @ a.T
+    got_l = mx.np.linalg.cholesky(mx.np.array(sym))
+    assert_almost_equal(got_l, onp.linalg.cholesky(sym), rtol=1e-4, atol=1e-4)
+
+
+def test_random_shapes_and_moments():
+    u = mx.np.random.uniform(0, 1, size=(2000,))
+    assert u.shape == (2000,)
+    assert 0.4 < float(u.mean()) < 0.6
+    n = mx.np.random.normal(0, 1, size=(2000,))
+    assert abs(float(n.mean())) < 0.15
+    assert 0.8 < float(n.std()) < 1.2
+    r = mx.np.random.randint(0, 10, size=(100,))
+    assert int(r.min()) >= 0 and int(r.max()) < 10
+    c = mx.np.random.choice(5, size=(50,))
+    assert int(c.max()) < 5
+
+
+def test_boolean_mask_nonzero():
+    x = onp.array([[1.0, -2.0], [-3.0, 4.0]], onp.float32)
+    mxx = mx.np.array(x)
+    # boolean indexing is data-dependent-shape: eager path reads back
+    got = mxx[mxx > 0]
+    assert sorted(got.tolist()) == [1.0, 4.0]
+
+
+def test_one_hot_topk_pick():
+    x = mx.np.array([[0.1, 0.9, 0.0], [0.7, 0.2, 0.1]])
+    oh = mx.npx.one_hot(mx.np.array([1, 0]), 3)
+    assert_almost_equal(oh, onp.eye(3, dtype=onp.float32)[[1, 0]])
+    val = mx.npx.pick(x, mx.np.array([1, 0]))
+    assert_almost_equal(val, [0.9, 0.7])
+
+
+def test_gradient_matches_finite_difference():
+    x0 = _rand(3, 3)
+
+    def f_np(x):
+        return onp.sum(onp.tanh(x) * x)
+
+    x = mx.np.array(x0)
+    x.attach_grad()
+    with mx.autograd.record():
+        y = (mx.np.tanh(x) * x).sum()
+    y.backward()
+    eps = 1e-3
+    fd = onp.zeros_like(x0)
+    for i in range(3):
+        for j in range(3):
+            xp, xm = x0.copy(), x0.copy()
+            xp[i, j] += eps
+            xm[i, j] -= eps
+            fd[i, j] = (f_np(xp) - f_np(xm)) / (2 * eps)
+    assert_almost_equal(x.grad, fd, rtol=1e-2, atol=1e-2)

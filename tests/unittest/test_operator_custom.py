@@ -1,0 +1,93 @@
+"""Custom Python operators (parity: `tests/python/unittest/test_operator.py`
+CustomOp sections; host-callback execution per `src/operator/custom/custom.cc`)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+@mx.operator.register("test_sigmoid")
+class SigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self, **kwargs):
+        super().__init__(need_top_grad=True, **kwargs)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]]
+
+    def create_operator(self, ctx, shapes, dtypes):
+        class Sigmoid(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                y = 1.0 / (1.0 + onp.exp(-in_data[0]))
+                self.assign(out_data[0], req[0], y)
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                y = out_data[0]
+                self.assign(in_grad[0], req[0], out_grad[0] * y * (1 - y))
+        return Sigmoid()
+
+
+def test_custom_forward():
+    x = onp.random.uniform(-2, 2, (3, 4)).astype(onp.float32)
+    y = mx.npx.custom(mx.np.array(x), op_type="test_sigmoid")
+    assert_almost_equal(y, 1 / (1 + onp.exp(-x)), rtol=1e-5, atol=1e-6)
+
+
+def test_custom_backward():
+    x = onp.random.uniform(-2, 2, (3, 4)).astype(onp.float32)
+    a = mx.np.array(x)
+    a.attach_grad()
+    with mx.autograd.record():
+        y = mx.npx.custom(a, op_type="test_sigmoid").sum()
+    y.backward()
+    s = 1 / (1 + onp.exp(-x))
+    assert_almost_equal(a.grad, s * (1 - s), rtol=1e-4, atol=1e-5)
+
+
+@mx.operator.register("test_addsub")
+class AddSubProp(mx.operator.CustomOpProp):
+    def list_arguments(self):
+        return ["a", "b"]
+
+    def list_outputs(self):
+        return ["sum", "diff"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0], in_shape[0]]
+
+    def create_operator(self, ctx, shapes, dtypes):
+        class AddSub(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                self.assign(out_data[0], req[0], in_data[0] + in_data[1])
+                self.assign(out_data[1], req[1], in_data[0] - in_data[1])
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                self.assign(in_grad[0], req[0], out_grad[0] + out_grad[1])
+                self.assign(in_grad[1], req[1], out_grad[0] - out_grad[1])
+        return AddSub()
+
+
+def test_custom_multi_output():
+    a = onp.random.uniform(size=(2, 3)).astype(onp.float32)
+    b = onp.random.uniform(size=(2, 3)).astype(onp.float32)
+    s, d = mx.npx.custom(mx.np.array(a), mx.np.array(b),
+                         op_type="test_addsub")
+    assert_almost_equal(s, a + b, rtol=1e-6, atol=1e-6)
+    assert_almost_equal(d, a - b, rtol=1e-6, atol=1e-6)
+
+
+def test_custom_unregistered_raises():
+    with pytest.raises(Exception):
+        mx.npx.custom(mx.np.ones((2,)), op_type="nope_not_registered")
+
+
+def test_custom_registry_listing():
+    assert "test_sigmoid" in mx.operator.get_all_registered()

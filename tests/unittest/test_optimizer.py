@@ -1,0 +1,128 @@
+"""Optimizer family (parity model: `tests/python/unittest/test_optimizer.py`)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu.test_utils import assert_almost_equal
+
+ALL_OPTS = ["SGD", "NAG", "Adam", "AdamW", "AdaBelief", "AdaDelta", "AdaGrad",
+            "GroupAdaGrad", "Adamax", "Nadam", "FTML", "Ftrl", "LAMB", "LANS",
+            "LARS", "RMSProp", "SGLD", "Signum", "DCASGD"]
+
+
+def _quadratic_steps(o, steps=60):
+    """Minimise ||w||^2 with the given optimizer; return final norm."""
+    w = mx.np.array(onp.array([5.0, -3.0, 2.0], onp.float32))
+    state = o.create_state(0, w)
+    for _ in range(steps):
+        g = 2.0 * w
+        o.update(0, w, g, state)
+    return float((w * w).sum())
+
+
+@pytest.mark.parametrize("name", ALL_OPTS)
+def test_optimizer_decreases_quadratic(name):
+    # AdaDelta's unit-free update and LARS's trust-ratio scaling move very
+    # slowly on a bare quadratic; give them room (reference tests tune
+    # per-optimizer hyperparameters similarly)
+    kwargs = {"learning_rate": 0.05}
+    steps = 60
+    if name == "AdaDelta":
+        steps = 600
+    if name == "LARS":
+        kwargs = {"learning_rate": 2.0, "eta": 0.1}
+    o = opt.create(name.lower(), **kwargs)
+    final = _quadratic_steps(o, steps=steps)
+    assert final < 38.0 * 0.8, f"{name} failed to reduce loss: {final}"
+
+
+def test_registry_create():
+    o = opt.create("sgd", learning_rate=0.1, momentum=0.9)
+    assert isinstance(o, opt.SGD)
+    assert o.learning_rate == 0.1
+    with pytest.raises(Exception):
+        opt.create("definitely_not_an_optimizer")
+
+
+def test_sgd_momentum_reference_formula():
+    lr, mom, wd = 0.1, 0.9, 0.01
+    o = opt.SGD(learning_rate=lr, momentum=mom, wd=wd)
+    w0 = onp.array([1.0, 2.0], onp.float32)
+    g0 = onp.array([0.5, -0.5], onp.float32)
+    w = mx.np.array(w0)
+    g = mx.np.array(g0)
+    state = o.create_state(0, w)
+    o.update(0, w, g, state)
+    grad = g0 + wd * w0
+    m = -lr * grad
+    assert_almost_equal(w, w0 + m, rtol=1e-6, atol=1e-6)
+    o.update(0, w, g, state)
+    w1 = w0 + m
+    grad1 = g0 + wd * w1
+    m1 = mom * m - lr * grad1
+    assert_almost_equal(w, w1 + m1, rtol=1e-6, atol=1e-6)
+
+
+def test_adam_reference_formula():
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+    o = opt.Adam(learning_rate=lr, beta1=b1, beta2=b2, epsilon=eps)
+    w0 = onp.array([1.0, -1.0], onp.float32)
+    g0 = onp.array([0.1, 0.2], onp.float32)
+    w = mx.np.array(w0)
+    state = o.create_state(0, w)
+    o.update(0, w, mx.np.array(g0), state)
+    m = (1 - b1) * g0
+    v = (1 - b2) * g0 * g0
+    lr_t = lr * onp.sqrt(1 - b2) / (1 - b1)
+    want = w0 - lr_t * m / (onp.sqrt(v) + eps)
+    assert_almost_equal(w, want, rtol=1e-5, atol=1e-6)
+
+
+def test_clip_gradient_and_rescale():
+    o = opt.SGD(learning_rate=1.0, rescale_grad=0.5, clip_gradient=0.1)
+    w = mx.np.array(onp.array([0.0], onp.float32))
+    g = mx.np.array(onp.array([10.0], onp.float32))
+    o.update(0, w, g, o.create_state(0, w))
+    # 10 * 0.5 = 5 -> clip to 0.1 -> w = -0.1
+    assert_almost_equal(w, [-0.1], rtol=1e-6, atol=1e-6)
+
+
+def test_multi_precision_bf16():
+    import jax.numpy as jnp
+    o = opt.SGD(learning_rate=0.1, momentum=0.9, multi_precision=True)
+    w = mx.np.array(onp.array([1.0, 2.0], onp.float32)).astype("bfloat16")
+    state = o.create_state_multi_precision(0, w)
+    g = mx.np.array(onp.array([0.5, 0.5], onp.float32)).astype("bfloat16")
+    o.update_multi_precision(0, w, g, state)
+    assert w.dtype == jnp.bfloat16
+    # master weight kept in fp32
+    assert state[0].dtype == jnp.float32
+
+
+def test_lr_scheduler():
+    from mxnet_tpu.optimizer import lr_scheduler as lrs
+    s = lrs.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(0) == 1.0
+    assert s(10) == 0.5
+    assert s(20) == 0.25
+    m = lrs.MultiFactorScheduler(step=[5, 15], factor=0.1, base_lr=1.0)
+    assert m(0) == 1.0
+    assert abs(m(6) - 0.1) < 1e-9
+    assert abs(m(16) - 0.01) < 1e-9
+    c = lrs.CosineScheduler(max_update=100, base_lr=1.0, final_lr=0.0)
+    assert abs(c(0) - 1.0) < 1e-6
+    assert c(50) < 1.0
+    p = lrs.PolyScheduler(max_update=100, base_lr=1.0)
+    assert p(100) <= p(1)
+
+
+def test_optimizer_with_scheduler_in_trainer_updates_num_update():
+    o = opt.SGD(learning_rate=1.0,
+                lr_scheduler=mx.optimizer.lr_scheduler.FactorScheduler(
+                    step=1, factor=0.5, base_lr=1.0))
+    w = mx.np.array(onp.array([1.0], onp.float32))
+    st = o.create_state(0, w)
+    o.update(0, w, mx.np.array(onp.array([0.0], onp.float32)), st)
+    o.update(0, w, mx.np.array(onp.array([0.0], onp.float32)), st)
+    assert o.num_update == 2

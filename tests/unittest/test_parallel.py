@@ -1,0 +1,148 @@
+"""Multi-chip sharding on the virtual 8-device CPU mesh (SURVEY.md §4:
+the TPU analog of the reference's `--launcher local` multi-process tests)."""
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import parallel
+from mxnet_tpu.parallel import (make_mesh, ring_attention, allreduce,
+                                make_sharded_train_step)
+from mxnet_tpu.parallel.sharding import default_tp_rules
+from mxnet_tpu.ops.attention import reference_attention
+from mxnet_tpu.test_utils import assert_almost_equal
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices("cpu")) < 8, reason="needs 8 virtual devices")
+
+
+def _cpu_devices(n):
+    return jax.devices("cpu")[:n]
+
+
+def test_make_mesh_axes():
+    mesh = make_mesh({"dp": 2, "tp": 4}, _cpu_devices(8))
+    assert mesh.axis_names == ("dp", "tp")
+    assert mesh.devices.shape == (2, 4)
+
+
+def test_auto_mesh():
+    mesh = parallel.auto_mesh(devices=_cpu_devices(8))
+    n = 1
+    for s in mesh.devices.shape:
+        n *= s
+    assert n == 8
+
+
+def test_ring_attention_matches_reference():
+    onp.random.seed(3)
+    b, h, l, d = 2, 2, 16, 8
+    q = jnp.asarray(onp.random.normal(size=(b, h, l, d)).astype(onp.float32))
+    k = jnp.asarray(onp.random.normal(size=(b, h, l, d)).astype(onp.float32))
+    v = jnp.asarray(onp.random.normal(size=(b, h, l, d)).astype(onp.float32))
+    mesh = make_mesh({"sp": 4}, _cpu_devices(4))
+    out = ring_attention(q, k, v, mesh, axis_name="sp")
+    want = reference_attention(q, k, v)
+    assert_almost_equal(onp.asarray(out), onp.asarray(want),
+                        rtol=1e-4, atol=1e-4)
+
+
+def test_ring_attention_causal():
+    onp.random.seed(4)
+    b, h, l, d = 1, 2, 16, 4
+    q = jnp.asarray(onp.random.normal(size=(b, h, l, d)).astype(onp.float32))
+    k = jnp.asarray(onp.random.normal(size=(b, h, l, d)).astype(onp.float32))
+    v = jnp.asarray(onp.random.normal(size=(b, h, l, d)).astype(onp.float32))
+    mesh = make_mesh({"sp": 4}, _cpu_devices(4))
+    out = ring_attention(q, k, v, mesh, axis_name="sp", causal=True)
+    want = reference_attention(q, k, v, causal=True)
+    assert_almost_equal(onp.asarray(out), onp.asarray(want),
+                        rtol=1e-4, atol=1e-4)
+
+
+def test_collectives_shard_map():
+    from jax.sharding import Mesh
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+    mesh = make_mesh({"dp": 8}, _cpu_devices(8))
+    x = jnp.arange(8.0)
+
+    def f(xs):
+        return parallel.collectives.allreduce(xs, "dp")
+
+    y = shard_map(f, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))(x)
+    assert_almost_equal(onp.asarray(y), onp.full((8,), 28.0))
+
+
+def test_sharded_train_step_dp_matches_single_device():
+    """Data-parallel sharded step must match the unsharded update."""
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu import optimizer as opt
+
+    onp.random.seed(0)
+    xs = onp.random.uniform(-1, 1, (8, 4)).astype(onp.float32)
+    ys = onp.random.uniform(-1, 1, (8, 1)).astype(onp.float32)
+
+    def build():
+        onp.random.seed(42)
+        net = nn.Dense(1, in_units=4, use_bias=False)
+        net.initialize()
+        net.weight.set_data(mx.np.array(
+            onp.random.uniform(-1, 1, (1, 4)).astype(onp.float32)))
+        return net
+
+    def loss_fn(out, x, y):
+        return jnp.mean((out - y) ** 2)
+
+    # single-device reference via autograd + SGD
+    net1 = build()
+    x1, y1 = mx.np.array(xs), mx.np.array(ys)
+    with mx.autograd.record():
+        l = ((net1(x1) - y1) ** 2).mean()
+    l.backward()
+    w_ref = onp.asarray(net1.weight.data()) - \
+        0.1 * onp.asarray(net1.weight.grad)
+
+    # 8-way dp sharded step
+    net2 = build()
+    mesh = make_mesh({"dp": 8}, _cpu_devices(8))
+    step = make_sharded_train_step(net2, opt.SGD(learning_rate=0.1),
+                                   loss_fn, mesh, num_model_args=1)
+    step(mx.np.array(xs), mx.np.array(ys))
+    w_dp = onp.asarray(net2.weight.data())
+    assert_almost_equal(w_dp, w_ref, rtol=1e-4, atol=1e-5)
+
+
+def test_sharded_train_step_tp_runs():
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu import optimizer as opt
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, in_units=8, activation="relu"),
+            nn.Dense(8, in_units=16))
+    net.initialize()
+
+    def loss_fn(out, x, y):
+        return jnp.mean((out - y) ** 2)
+
+    mesh = make_mesh({"dp": 2, "tp": 4}, _cpu_devices(8))
+    step = make_sharded_train_step(net, opt.Adam(learning_rate=1e-3),
+                                   loss_fn, mesh, rules=default_tp_rules(),
+                                   num_model_args=1)
+    x = mx.np.array(onp.random.uniform(-1, 1, (4, 8)).astype(onp.float32))
+    y = mx.np.array(onp.random.uniform(-1, 1, (4, 8)).astype(onp.float32))
+    l0 = float(step(x, y))
+    l1 = float(step(x, y))
+    assert onp.isfinite(l0) and onp.isfinite(l1)
+    assert l1 < l0 * 1.5
+
+
+def test_param_sharding_rules():
+    from mxnet_tpu.parallel.sharding import param_sharding
+    mesh = make_mesh({"dp": 2, "tp": 4}, _cpu_devices(8))
+    rules = default_tp_rules()
+    sh = param_sharding(mesh, "encoder.ffn.weight", (64, 32), rules)
+    assert sh is not None
+    assert sh.spec == parallel.PartitionSpec("tp", None)
