@@ -111,12 +111,28 @@ class Device:
         stack = getattr(Device._local, "stack", None)
         if stack:
             return stack[-1]
-        return _DEFAULT
+        return _default_device()
 
 
 # Context is the legacy alias (reference `python/mxnet/context.py`)
 Context = Device
-_DEFAULT = Device("cpu", 0)
+_DEFAULT: Optional[Device] = None
+
+
+def _default_device() -> Device:
+    """Default placement mirrors the JAX default backend: tpu(0) when an
+    accelerator platform is initialised, else cpu(0). Resolved lazily (and
+    cached) so importing the package never forces backend initialisation."""
+    global _DEFAULT
+    if _DEFAULT is None:
+        try:
+            plat = jax.devices()[0].platform.lower()
+        except Exception:
+            # backend not initialised yet (e.g. before
+            # jax.distributed.initialize on a pod): don't cache the fallback
+            return Device("cpu", 0)
+        _DEFAULT = Device("tpu" if plat in _ACCEL_TYPES else "cpu", 0)
+    return _DEFAULT
 
 
 def cpu(device_id: int = 0) -> Device:

@@ -10,3 +10,4 @@ from . import data
 from . import utils
 from .trainer import Trainer
 from . import model_zoo
+from . import probability

@@ -1,0 +1,14 @@
+"""`mx.gluon.probability` — probabilistic programming toolkit.
+
+Parity: `python/mxnet/gluon/probability/__init__.py` (distributions,
+transformations, StochasticBlock). TPU-native design: every density is a pure
+jnp computation (jit/vmap/grad-compatible); sampling draws threaded PRNG keys
+from `mxnet_tpu.random` so results are reproducible under `mx.random.seed`.
+"""
+from .distributions import *  # noqa: F401,F403
+from .transformation import *  # noqa: F401,F403
+from .block import *  # noqa: F401,F403
+
+from . import distributions, transformation, block  # noqa: F401
+
+__all__ = (distributions.__all__ + transformation.__all__ + block.__all__)

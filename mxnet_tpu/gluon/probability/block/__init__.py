@@ -1,0 +1,5 @@
+"""`mx.gluon.probability.block` (parity:
+`python/mxnet/gluon/probability/block/__init__.py`)."""
+from .stochastic_block import StochasticBlock, StochasticSequential
+
+__all__ = ["StochasticBlock", "StochasticSequential"]

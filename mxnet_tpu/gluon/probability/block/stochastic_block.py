@@ -1,0 +1,65 @@
+"""StochasticBlock (parity:
+`python/mxnet/gluon/probability/block/stochastic_block.py`).
+
+A Block whose forward can register auxiliary losses (e.g. KL terms in a VAE)
+via `add_loss`; collected losses are exposed on `.losses` after each call.
+"""
+from __future__ import annotations
+
+from ...block import HybridBlock
+
+__all__ = ["StochasticBlock", "StochasticSequential"]
+
+
+class StochasticBlock(HybridBlock):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._losses = []
+        self._losscache = []
+
+    def add_loss(self, loss):
+        self._losscache.append(loss)
+
+    @property
+    def losses(self):
+        return self._losses
+
+    def __call__(self, *args, **kwargs):
+        self._losscache = []
+        out = super().__call__(*args, **kwargs)
+        self._losses = self._losscache
+        self._losscache = []
+        return out
+
+
+class StochasticSequential(StochasticBlock):
+    """Sequential container that aggregates child StochasticBlock losses."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._layers = []
+
+    def add(self, *blocks):
+        for b in blocks:
+            self._layers.append(b)
+            setattr(self, f"_seq_{len(self._layers) - 1}", b)
+
+    def forward(self, x):
+        for layer in self._layers:
+            x = layer(x)
+        return x
+
+    def __call__(self, *args, **kwargs):
+        out = super().__call__(*args, **kwargs)
+        collected = list(self._losses)
+        for layer in self._layers:
+            if isinstance(layer, StochasticBlock):
+                collected.extend(layer.losses)
+        self._losses = collected
+        return out
+
+    def __getitem__(self, idx):
+        return self._layers[idx]
+
+    def __len__(self):
+        return len(self._layers)

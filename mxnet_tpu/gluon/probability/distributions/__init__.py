@@ -1,0 +1,46 @@
+"""`mx.gluon.probability.distributions` (parity:
+`python/mxnet/gluon/probability/distributions/__init__.py`)."""
+from . import constraint  # noqa: F401
+from .distribution import Distribution
+from .exp_family import ExponentialFamily
+from .normal import Normal
+from .laplace import Laplace
+from .cauchy import Cauchy
+from .gumbel import Gumbel
+from .gamma import Gamma
+from .chi2 import Chi2
+from .exponential import Exponential
+from .weibull import Weibull
+from .pareto import Pareto
+from .uniform import Uniform
+from .beta import Beta
+from .dirichlet import Dirichlet
+from .studentT import StudentT
+from .fishersnedecor import FisherSnedecor
+from .multivariate_normal import MultivariateNormal
+from .transformed_distribution import TransformedDistribution
+from .half_normal import HalfNormal
+from .half_cauchy import HalfCauchy
+from .bernoulli import Bernoulli
+from .binomial import Binomial
+from .geometric import Geometric
+from .negative_binomial import NegativeBinomial
+from .poisson import Poisson
+from .categorical import Categorical
+from .one_hot_categorical import OneHotCategorical
+from .multinomial import Multinomial
+from .relaxed_bernoulli import RelaxedBernoulli
+from .relaxed_one_hot_categorical import RelaxedOneHotCategorical
+from .independent import Independent
+from .divergence import register_kl, kl_divergence, empirical_kl
+
+__all__ = [
+    "Distribution", "ExponentialFamily", "Normal", "Laplace", "Cauchy",
+    "Gumbel", "Gamma", "Chi2", "Exponential", "Weibull", "Pareto", "Uniform",
+    "Beta", "Dirichlet", "StudentT", "FisherSnedecor", "MultivariateNormal",
+    "TransformedDistribution", "HalfNormal", "HalfCauchy", "Bernoulli",
+    "Binomial", "Geometric", "NegativeBinomial", "Poisson", "Categorical",
+    "OneHotCategorical", "Multinomial", "RelaxedBernoulli",
+    "RelaxedOneHotCategorical", "Independent", "register_kl", "kl_divergence",
+    "empirical_kl", "constraint",
+]

@@ -1,0 +1,88 @@
+"""Bernoulli distribution (parity:
+`python/mxnet/gluon/probability/distributions/bernoulli.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ....base import MXNetError
+from ....random import next_key
+from . import constraint
+from .exp_family import ExponentialFamily
+from .utils import (_j, _w, cached_property, logit2prob, prob2logit,
+                    sample_n_shape_converter)
+
+__all__ = ["Bernoulli"]
+
+
+class Bernoulli(ExponentialFamily):
+    has_enumerate_support = True
+    arg_constraints = {"prob": constraint.unit_interval,
+                       "logit": constraint.real}
+    support = constraint.boolean
+
+    def __init__(self, prob=None, logit=None, validate_args=None):
+        if (prob is None) == (logit is None):
+            raise MXNetError("Exactly one of `prob`, `logit` is required")
+        self._prob = _j(prob)
+        self._logit = _j(logit)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    @cached_property
+    def prob(self):
+        return self._prob if self._prob is not None \
+            else logit2prob(self._logit, True)
+
+    @cached_property
+    def logit(self):
+        return self._logit if self._logit is not None \
+            else prob2logit(self._prob, True)
+
+    @property
+    def _batch(self):
+        p = self._prob if self._prob is not None else self._logit
+        return jnp.shape(p)
+
+    def sample(self, size=None):
+        shape = sample_n_shape_converter(size) + self._batch
+        p = jnp.broadcast_to(self.prob, shape)
+        return _w(jax.random.bernoulli(next_key(), p, shape)
+                  .astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = self._validate_sample(_j(value))
+        lg = self.logit
+        # log p = v*logit - softplus(logit)
+        return _w(v * lg - jnp.logaddexp(0.0, lg))
+
+    def _mean(self):
+        return jnp.broadcast_to(self.prob, self._batch)
+
+    def _variance(self):
+        return jnp.broadcast_to(self.prob * (1 - self.prob), self._batch)
+
+    def entropy(self):
+        lg = self.logit
+        p = lax.logistic(lg)
+        return _w(jnp.broadcast_to(
+            jnp.logaddexp(0.0, lg) - p * lg, self._batch))
+
+    def enumerate_support(self):
+        vals = jnp.reshape(jnp.arange(2, dtype=jnp.float32),
+                           (2,) + (1,) * len(self._batch))
+        return _w(jnp.broadcast_to(vals, (2,) + self._batch))
+
+    def broadcast_to(self, batch_shape):
+        if self._logit is not None:
+            return Bernoulli(logit=jnp.broadcast_to(self._logit, batch_shape))
+        return Bernoulli(prob=jnp.broadcast_to(self._prob, batch_shape))
+
+    _mean_carrier_measure = 0
+
+    @property
+    def _natural_params(self):
+        return (self.logit,)
+
+    def _log_normalizer(self, x):
+        return jnp.logaddexp(0.0, x)

@@ -1,0 +1,59 @@
+"""Beta distribution (parity:
+`python/mxnet/gluon/probability/distributions/beta.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import betaln
+
+from ....random import next_key
+from . import constraint
+from .exp_family import ExponentialFamily
+from .utils import _j, _w, digamma, sample_n_shape_converter
+
+__all__ = ["Beta"]
+
+
+class Beta(ExponentialFamily):
+    has_grad = True
+    arg_constraints = {"alpha": constraint.positive,
+                       "beta": constraint.positive}
+    support = constraint.unit_interval
+
+    def __init__(self, alpha, beta, validate_args=None):
+        self.alpha = _j(alpha)
+        self.beta = _j(beta)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    @property
+    def _batch(self):
+        return jnp.broadcast_shapes(jnp.shape(self.alpha),
+                                    jnp.shape(self.beta))
+
+    def sample(self, size=None):
+        shape = sample_n_shape_converter(size) + self._batch
+        dtype = jnp.result_type(self.alpha, self.beta, jnp.float32)
+        a = jnp.broadcast_to(self.alpha, shape).astype(dtype)
+        b = jnp.broadcast_to(self.beta, shape).astype(dtype)
+        return _w(jax.random.beta(next_key(), a, b))
+
+    def log_prob(self, value):
+        v = self._validate_sample(_j(value))
+        a, b = self.alpha, self.beta
+        return _w((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+                  - betaln(a, b))
+
+    def _mean(self):
+        return jnp.broadcast_to(
+            self.alpha / (self.alpha + self.beta), self._batch)
+
+    def _variance(self):
+        a, b = self.alpha, self.beta
+        tot = a + b
+        return jnp.broadcast_to(a * b / (tot ** 2 * (tot + 1)), self._batch)
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        return _w(jnp.broadcast_to(
+            betaln(a, b) - (a - 1) * digamma(a) - (b - 1) * digamma(b)
+            + (a + b - 2) * digamma(a + b), self._batch))

@@ -1,0 +1,95 @@
+"""Categorical distribution (parity:
+`python/mxnet/gluon/probability/distributions/categorical.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import logsumexp
+
+from ....base import MXNetError
+from ....random import next_key
+from . import constraint
+from .distribution import Distribution
+from .utils import (_j, _w, cached_property, logit2prob, prob2logit,
+                    sample_n_shape_converter)
+
+__all__ = ["Categorical"]
+
+
+class Categorical(Distribution):
+    """Distribution over {0, ..., num_events-1} given `prob` or `logit`
+    (normalized along the last axis, which is a parameter axis — batch shape
+    excludes it)."""
+
+    has_enumerate_support = True
+    arg_constraints = {"prob": constraint.simplex, "logit": constraint.real}
+
+    def __init__(self, num_events=None, prob=None, logit=None,
+                 validate_args=None):
+        if (prob is None) == (logit is None):
+            raise MXNetError("Exactly one of `prob`, `logit` is required")
+        self._prob = _j(prob)
+        self._logit = _j(logit)
+        p = self._prob if self._prob is not None else self._logit
+        self.num_events = int(num_events) if num_events is not None \
+            else p.shape[-1]
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    @cached_property
+    def prob(self):
+        return self._prob if self._prob is not None \
+            else logit2prob(self._logit, False)
+
+    @cached_property
+    def logit(self):
+        if self._logit is not None:
+            return self._logit - logsumexp(self._logit, -1, keepdims=True)
+        return prob2logit(self._prob, False)
+
+    @property
+    def support(self):
+        return constraint.IntegerInterval(0, self.num_events - 1)
+
+    @property
+    def _batch(self):
+        p = self._prob if self._prob is not None else self._logit
+        return jnp.shape(p)[:-1]
+
+    def sample(self, size=None):
+        prefix = sample_n_shape_converter(size)
+        shape = prefix + self._batch
+        return _w(jax.random.categorical(
+            next_key(), jnp.broadcast_to(self.logit, shape + (self.num_events,)),
+            axis=-1).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = self._validate_sample(_j(value)).astype(jnp.int32)
+        lg = self.logit
+        bshape = jnp.broadcast_shapes(jnp.shape(v), lg.shape[:-1])
+        lg = jnp.broadcast_to(lg, bshape + (self.num_events,))
+        v = jnp.broadcast_to(v, bshape)
+        return _w(jnp.take_along_axis(lg, v[..., None], -1)[..., 0])
+
+    def _mean(self):
+        raise NotImplementedError("Categorical mean undefined")
+
+    def _variance(self):
+        raise NotImplementedError("Categorical variance undefined")
+
+    def entropy(self):
+        lg, p = self.logit, self.prob
+        return _w(-jnp.sum(jnp.where(p > 0, p * lg, 0.0), -1))
+
+    def enumerate_support(self):
+        vals = jnp.reshape(
+            jnp.arange(self.num_events, dtype=jnp.float32),
+            (self.num_events,) + (1,) * len(self._batch))
+        return _w(jnp.broadcast_to(vals, (self.num_events,) + self._batch))
+
+    def broadcast_to(self, batch_shape):
+        shape = tuple(batch_shape) + (self.num_events,)
+        if self._logit is not None:
+            return Categorical(self.num_events,
+                               logit=jnp.broadcast_to(self._logit, shape))
+        return Categorical(self.num_events,
+                           prob=jnp.broadcast_to(self._prob, shape))

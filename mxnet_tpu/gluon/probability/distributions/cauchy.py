@@ -1,0 +1,66 @@
+"""Cauchy distribution (parity:
+`python/mxnet/gluon/probability/distributions/cauchy.py`)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ....random import next_key
+from . import constraint
+from .distribution import Distribution
+from .utils import _j, _w, sample_n_shape_converter
+
+__all__ = ["Cauchy"]
+
+
+class Cauchy(Distribution):
+    has_grad = True
+    arg_constraints = {"loc": constraint.real, "scale": constraint.positive}
+    support = constraint.real
+
+    def __init__(self, loc=0.0, scale=1.0, validate_args=None):
+        self.loc = _j(loc)
+        self.scale = _j(scale)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    @property
+    def _batch(self):
+        return jnp.broadcast_shapes(jnp.shape(self.loc), jnp.shape(self.scale))
+
+    def sample(self, size=None):
+        shape = sample_n_shape_converter(size) + self._batch
+        dtype = jnp.result_type(self.loc, self.scale, jnp.float32)
+        eps = jax.random.cauchy(next_key(), shape, dtype)
+        return _w(self.loc + self.scale * eps)
+
+    def log_prob(self, value):
+        v = self._validate_sample(_j(value))
+        z = (v - self.loc) / self.scale
+        return _w(-math.log(math.pi) - jnp.log(self.scale) - jnp.log1p(z ** 2))
+
+    def cdf(self, value):
+        v = _j(value)
+        return _w(jnp.arctan((v - self.loc) / self.scale) / math.pi + 0.5)
+
+    def icdf(self, value):
+        p = _j(value)
+        return _w(self.loc + self.scale * jnp.tan(math.pi * (p - 0.5)))
+
+    def _mean(self):
+        return jnp.full(self._batch, jnp.nan)
+
+    def _variance(self):
+        return jnp.full(self._batch, jnp.nan)
+
+    def entropy(self):
+        return _w(jnp.broadcast_to(
+            math.log(4 * math.pi) + jnp.log(self.scale), self._batch))
+
+    def broadcast_to(self, batch_shape):
+        new = Cauchy.__new__(Cauchy)
+        new.loc = jnp.broadcast_to(self.loc, batch_shape)
+        new.scale = jnp.broadcast_to(self.scale, batch_shape)
+        Distribution.__init__(new, event_dim=0)
+        return new

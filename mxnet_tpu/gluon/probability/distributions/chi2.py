@@ -1,0 +1,21 @@
+"""Chi-squared distribution (parity:
+`python/mxnet/gluon/probability/distributions/chi2.py`)."""
+from __future__ import annotations
+
+from . import constraint
+from .gamma import Gamma
+from .utils import _j
+
+__all__ = ["Chi2"]
+
+
+class Chi2(Gamma):
+    arg_constraints = {"df": constraint.positive}
+
+    def __init__(self, df, validate_args=None):
+        df = _j(df)
+        super().__init__(shape=df / 2, scale=2.0, validate_args=validate_args)
+
+    @property
+    def df(self):
+        return self.shape_param * 2

@@ -1,0 +1,242 @@
+"""Parameter/support constraints (parity:
+`python/mxnet/gluon/probability/distributions/constraint.py`).
+
+A `Constraint` validates values (`check`) and describes a domain that
+`biject_to`/`transform_to` (transformation/domain_map.py) can map the reals
+onto. Checks are pure jnp predicates, so they compose with jit.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ....base import MXNetError
+from .utils import _j
+
+__all__ = [
+    "Constraint", "dependent", "real", "real_vector", "boolean", "nonnegative_integer",
+    "positive_integer", "integer_interval", "positive", "nonnegative", "greater_than",
+    "greater_than_eq", "less_than", "less_than_eq", "interval", "half_open_interval",
+    "unit_interval", "simplex", "lower_triangular", "lower_cholesky", "positive_definite",
+    "Real", "Positive", "GreaterThan", "GreaterThanEq", "LessThan", "LessThanEq",
+    "Interval", "HalfOpenInterval", "IntegerInterval", "Boolean", "Simplex",
+    "LowerTriangular", "LowerCholesky", "PositiveDefinite", "Cat", "Stack",
+]
+
+
+class Constraint:
+    is_discrete = False
+    event_dim = 0
+
+    def check(self, value):
+        raise NotImplementedError
+
+    def validate(self, value, name="value"):
+        ok = self.check(_j(value))
+        if not bool(jnp.all(ok)):
+            raise MXNetError(
+                f"Invalid {name}: does not satisfy constraint {self!r}")
+        return value
+
+    def __repr__(self):
+        return type(self).__name__
+
+
+class _Dependent(Constraint):
+    """Placeholder for constraints that depend on other parameters."""
+
+    def check(self, value):
+        raise MXNetError("Cannot determine validity of dependent constraint")
+
+
+class Real(Constraint):
+    def check(self, value):
+        return value == value  # not NaN
+
+
+class _RealVector(Real):
+    event_dim = 1
+
+
+class Boolean(Constraint):
+    is_discrete = True
+
+    def check(self, value):
+        return (value == 0) | (value == 1)
+
+
+class _NonNegativeInteger(Constraint):
+    is_discrete = True
+
+    def check(self, value):
+        return (value >= 0) & (value == jnp.floor(value))
+
+
+class _PositiveInteger(Constraint):
+    is_discrete = True
+
+    def check(self, value):
+        return (value >= 1) & (value == jnp.floor(value))
+
+
+class IntegerInterval(Constraint):
+    is_discrete = True
+
+    def __init__(self, lower_bound, upper_bound):
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+
+    def check(self, value):
+        return ((value >= self.lower_bound) & (value <= self.upper_bound)
+                & (value == jnp.floor(value)))
+
+    def __repr__(self):
+        return f"IntegerInterval({self.lower_bound}, {self.upper_bound})"
+
+
+class GreaterThan(Constraint):
+    def __init__(self, lower_bound):
+        self.lower_bound = lower_bound
+
+    def check(self, value):
+        return value > _j(self.lower_bound)
+
+    def __repr__(self):
+        return f"GreaterThan({self.lower_bound})"
+
+
+class GreaterThanEq(GreaterThan):
+    def check(self, value):
+        return value >= _j(self.lower_bound)
+
+
+class Positive(GreaterThan):
+    def __init__(self):
+        super().__init__(0.0)
+
+
+class _NonNegative(GreaterThanEq):
+    def __init__(self):
+        super().__init__(0.0)
+
+
+class LessThan(Constraint):
+    def __init__(self, upper_bound):
+        self.upper_bound = upper_bound
+
+    def check(self, value):
+        return value < _j(self.upper_bound)
+
+    def __repr__(self):
+        return f"LessThan({self.upper_bound})"
+
+
+class LessThanEq(LessThan):
+    def check(self, value):
+        return value <= _j(self.upper_bound)
+
+
+class Interval(Constraint):
+    def __init__(self, lower_bound, upper_bound):
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+
+    def check(self, value):
+        return (value >= _j(self.lower_bound)) & (value <= _j(self.upper_bound))
+
+    def __repr__(self):
+        return f"Interval({self.lower_bound}, {self.upper_bound})"
+
+
+class HalfOpenInterval(Interval):
+    def check(self, value):
+        return (value >= _j(self.lower_bound)) & (value < _j(self.upper_bound))
+
+
+class Simplex(Constraint):
+    event_dim = 1
+
+    def check(self, value):
+        return (jnp.all(value >= 0, axis=-1)
+                & (jnp.abs(value.sum(-1) - 1) < 1e-6))
+
+
+class LowerTriangular(Constraint):
+    event_dim = 2
+
+    def check(self, value):
+        tril = jnp.tril(value)
+        return jnp.all((tril == value).reshape(value.shape[:-2] + (-1,)), -1)
+
+
+class LowerCholesky(Constraint):
+    event_dim = 2
+
+    def check(self, value):
+        tril = jnp.tril(value)
+        is_tril = jnp.all((tril == value).reshape(value.shape[:-2] + (-1,)), -1)
+        pos_diag = jnp.all(jnp.diagonal(value, axis1=-2, axis2=-1) > 0, -1)
+        return is_tril & pos_diag
+
+
+class PositiveDefinite(Constraint):
+    event_dim = 2
+
+    def check(self, value):
+        sym = jnp.all(jnp.isclose(value, jnp.swapaxes(value, -1, -2))
+                      .reshape(value.shape[:-2] + (-1,)), -1)
+        # positive definiteness via Cholesky success proxy: all eigvals > 0
+        eig = jnp.linalg.eigvalsh((value + jnp.swapaxes(value, -1, -2)) / 2)
+        return sym & jnp.all(eig > 0, axis=-1)
+
+
+class Cat(Constraint):
+    """Concatenation of constraints along an axis."""
+
+    def __init__(self, constraints, axis=0, lengths=None):
+        self.constraints = list(constraints)
+        self.axis = axis
+        self.lengths = lengths or [1] * len(self.constraints)
+
+    def check(self, value):
+        pieces = []
+        start = 0
+        for c, ln in zip(self.constraints, self.lengths):
+            sl = [slice(None)] * value.ndim
+            sl[self.axis] = slice(start, start + ln)
+            pieces.append(c.check(value[tuple(sl)]))
+            start += ln
+        return jnp.concatenate(pieces, axis=self.axis)
+
+
+class Stack(Constraint):
+    def __init__(self, constraints, axis=0):
+        self.constraints = list(constraints)
+        self.axis = axis
+
+    def check(self, value):
+        vs = jnp.moveaxis(value, self.axis, 0)
+        checks = [c.check(v) for c, v in zip(self.constraints, vs)]
+        return jnp.stack(checks, axis=self.axis)
+
+
+# canonical instances (torch/numpyro-style lowercase aliases used throughout)
+dependent = _Dependent()
+real = Real()
+real_vector = _RealVector()
+boolean = Boolean()
+nonnegative_integer = _NonNegativeInteger()
+positive_integer = _PositiveInteger()
+integer_interval = IntegerInterval
+positive = Positive()
+nonnegative = _NonNegative()
+greater_than = GreaterThan
+greater_than_eq = GreaterThanEq
+less_than = LessThan
+less_than_eq = LessThanEq
+interval = Interval
+half_open_interval = HalfOpenInterval
+unit_interval = Interval(0.0, 1.0)
+simplex = Simplex()
+lower_triangular = LowerTriangular()
+lower_cholesky = LowerCholesky()
+positive_definite = PositiveDefinite()

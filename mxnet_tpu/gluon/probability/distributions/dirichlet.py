@@ -1,0 +1,58 @@
+"""Dirichlet distribution (parity:
+`python/mxnet/gluon/probability/distributions/dirichlet.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....random import next_key
+from . import constraint
+from .exp_family import ExponentialFamily
+from .utils import _j, _w, digamma, gammaln, sample_n_shape_converter
+
+__all__ = ["Dirichlet"]
+
+
+class Dirichlet(ExponentialFamily):
+    has_grad = True
+    arg_constraints = {"alpha": constraint.positive}
+    support = constraint.simplex
+
+    def __init__(self, alpha, validate_args=None):
+        self.alpha = _j(alpha)
+        super().__init__(event_dim=1, validate_args=validate_args)
+
+    @property
+    def _batch(self):
+        return jnp.shape(self.alpha)[:-1]
+
+    def sample(self, size=None):
+        prefix = sample_n_shape_converter(size)
+        dtype = jnp.result_type(self.alpha, jnp.float32)
+        a = jnp.broadcast_to(self.alpha,
+                             prefix + jnp.shape(self.alpha)).astype(dtype)
+        # dirichlet via normalized gammas (vectorized over batch dims)
+        g = jax.random.gamma(next_key(), a, dtype=dtype)
+        return _w(g / g.sum(-1, keepdims=True))
+
+    def log_prob(self, value):
+        v = self._validate_sample(_j(value))
+        a = self.alpha
+        return _w(jnp.sum((a - 1) * jnp.log(v), -1)
+                  + gammaln(a.sum(-1)) - jnp.sum(gammaln(a), -1))
+
+    def _mean(self):
+        return self.alpha / self.alpha.sum(-1, keepdims=True)
+
+    def _variance(self):
+        a0 = self.alpha.sum(-1, keepdims=True)
+        m = self.alpha / a0
+        return m * (1 - m) / (a0 + 1)
+
+    def entropy(self):
+        a = self.alpha
+        k = a.shape[-1]
+        a0 = a.sum(-1)
+        return _w(jnp.sum(gammaln(a), -1) - gammaln(a0)
+                  + (a0 - k) * digamma(a0)
+                  - jnp.sum((a - 1) * digamma(a), -1))

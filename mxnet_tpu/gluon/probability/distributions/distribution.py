@@ -1,0 +1,127 @@
+"""Base `Distribution` class (parity:
+`python/mxnet/gluon/probability/distributions/distribution.py`).
+
+Design: parameters are stored as jax arrays; every density method is a pure
+jnp computation so distributions compose with `jax.jit`/`vmap`/`grad`.
+Sampling threads PRNG keys from `mxnet_tpu.random.next_key()` which keeps the
+stateful `mx.random.seed` reproducibility contract of the reference. Public
+methods accept and return framework ndarrays.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ....base import MXNetError
+from .utils import _j, _w, sample_n_shape_converter
+from . import constraint as _c
+
+__all__ = ["Distribution"]
+
+
+class Distribution:
+    """Base class for probability distributions.
+
+    Attributes
+    ----------
+    has_grad : bool
+        Whether `sample` is reparameterized (gradients flow to parameters).
+    has_enumerate_support : bool
+        Whether `enumerate_support` is implemented.
+    event_dim : int
+        Number of rightmost dimensions that form one event.
+    arg_constraints : dict
+        Map of parameter name -> Constraint.
+    """
+
+    has_grad = False
+    has_enumerate_support = False
+    arg_constraints: dict = {}
+    _validate_args = False
+
+    def __init__(self, event_dim=0, validate_args=None):
+        self.event_dim = event_dim
+        if validate_args is not None:
+            self._validate_args = validate_args
+        if self._validate_args:
+            for name, constr in self.arg_constraints.items():
+                if isinstance(constr, _c._Dependent):
+                    continue
+                val = getattr(self, name, None)
+                if val is not None:
+                    constr.validate(val, name)
+
+    @staticmethod
+    def set_default_validate_args(value: bool):
+        Distribution._validate_args = bool(value)
+
+    # -- support / validation ------------------------------------------------
+    @property
+    def support(self):
+        raise NotImplementedError
+
+    def _validate_sample(self, value):
+        if self._validate_args:
+            self.support.validate(value, "sample value")
+        return value
+
+    # -- core API ------------------------------------------------------------
+    def sample(self, size=None):
+        """Draw a (detached-by-default-in-reference, differentiable here if
+        `has_grad`) sample of shape `size + batch_shape + event_shape`."""
+        raise NotImplementedError
+
+    def sample_n(self, n=None):
+        """Draw `n` i.i.d. samples stacked along a new leading axis."""
+        size = sample_n_shape_converter(n)
+        return self.sample(size)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _w(jnp.exp(_j(self.log_prob(value))))
+
+    def cdf(self, value):
+        raise NotImplementedError
+
+    def icdf(self, value):
+        raise NotImplementedError
+
+    @property
+    def mean(self):
+        return _w(self._mean())
+
+    @property
+    def variance(self):
+        return _w(self._variance())
+
+    @property
+    def stddev(self):
+        return _w(jnp.sqrt(self._variance()))
+
+    def _mean(self):
+        raise NotImplementedError
+
+    def _variance(self):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def perplexity(self):
+        return _w(jnp.exp(_j(self.entropy())))
+
+    def enumerate_support(self):
+        raise MXNetError(
+            f"{type(self).__name__} does not implement enumerate_support")
+
+    def broadcast_to(self, batch_shape):
+        """Return a copy with parameters broadcast to `batch_shape`."""
+        raise NotImplementedError
+
+    def __repr__(self):
+        names = list(self.arg_constraints)
+        args = ", ".join(
+            f"{n}={getattr(self, n, None)!r}" for n in names
+            if getattr(self, n, None) is not None)
+        return f"{type(self).__name__}({args})"

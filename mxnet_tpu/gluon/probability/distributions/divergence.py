@@ -1,0 +1,198 @@
+"""KL divergence registry (parity:
+`python/mxnet/gluon/probability/distributions/divergence.py`).
+
+`register_kl(P, Q)` registers an analytic KL(p||q); `kl_divergence`
+dispatches on the most-derived registered pair. `empirical_kl` is the
+Monte-Carlo fallback for unregistered reparameterized pairs.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.scipy.special import betaln, logsumexp
+
+from ....base import MXNetError
+from .bernoulli import Bernoulli
+from .beta import Beta
+from .categorical import Categorical
+from .dirichlet import Dirichlet
+from .exponential import Exponential
+from .gamma import Gamma
+from .geometric import Geometric
+from .half_normal import HalfNormal
+from .independent import Independent
+from .laplace import Laplace
+from .multivariate_normal import MultivariateNormal
+from .normal import Normal
+from .one_hot_categorical import OneHotCategorical
+from .poisson import Poisson
+from .uniform import Uniform
+from .utils import _j, _w, digamma, gammaln, sum_right_most
+
+__all__ = ["register_kl", "kl_divergence", "empirical_kl"]
+
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def _dispatch(p_cls, q_cls):
+    matches = [
+        (pc, qc) for (pc, qc) in _KL_REGISTRY
+        if issubclass(p_cls, pc) and issubclass(q_cls, qc)]
+    if not matches:
+        return None
+    # most-derived match wins
+    def _key(pair):
+        pc, qc = pair
+        return (p_cls.__mro__.index(pc), q_cls.__mro__.index(qc))
+    return _KL_REGISTRY[min(matches, key=_key)]
+
+
+def kl_divergence(p, q):
+    fn = _dispatch(type(p), type(q))
+    if fn is None:
+        raise MXNetError(
+            f"No KL(p||q) registered for ({type(p).__name__}, "
+            f"{type(q).__name__}); use empirical_kl for a Monte-Carlo "
+            "estimate")
+    return fn(p, q)
+
+
+def empirical_kl(p, q, num_samples=1):
+    """Monte-Carlo KL estimate E_p[log p(x) - log q(x)]."""
+    x = p.sample_n(num_samples)
+    lp = _j(p.log_prob(x)) - _j(q.log_prob(x))
+    return _w(jnp.mean(lp, 0))
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return _w(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    scale_ratio = p.scale / q.scale
+    loc_abs_diff = jnp.abs(p.loc - q.loc)
+    t1 = -jnp.log(scale_ratio)
+    t2 = loc_abs_diff / q.scale
+    t3 = scale_ratio * jnp.exp(-loc_abs_diff / p.scale)
+    return _w(t1 + t2 + t3 - 1)
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential_exponential(p, q):
+    # KL = log(λp/λq) + λq/λp - 1 with rate λ = 1/scale
+    scale_ratio = p.scale / q.scale
+    return _w(scale_ratio - 1 - jnp.log(scale_ratio))
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    a_p, b_p = p.shape_param, 1.0 / p.scale
+    a_q, b_q = q.shape_param, 1.0 / q.scale
+    t1 = a_q * (jnp.log(b_p) - jnp.log(b_q))
+    t2 = gammaln(a_q) - gammaln(a_p)
+    t3 = (a_p - a_q) * digamma(a_p)
+    t4 = (b_q - b_p) * (a_p / b_p)
+    return _w(t1 + t2 + t3 + t4)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    sum_p = p.alpha + p.beta
+    t1 = betaln(q.alpha, q.beta) - betaln(p.alpha, p.beta)
+    t2 = (p.alpha - q.alpha) * digamma(p.alpha)
+    t3 = (p.beta - q.beta) * digamma(p.beta)
+    t4 = (q.alpha - p.alpha + q.beta - p.beta) * digamma(sum_p)
+    return _w(t1 + t2 + t3 + t4)
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    a_p, a_q = p.alpha, q.alpha
+    sum_p = a_p.sum(-1)
+    t1 = gammaln(sum_p) - gammaln(a_q.sum(-1))
+    t2 = jnp.sum(gammaln(a_q) - gammaln(a_p), -1)
+    t3 = jnp.sum((a_p - a_q) * (digamma(a_p)
+                                - digamma(sum_p)[..., None]), -1)
+    return _w(t1 + t2 + t3)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    pp, pq = p.prob, q.prob
+    eps = jnp.finfo(jnp.float32).tiny
+    t1 = pp * (jnp.log(pp + eps) - jnp.log(pq + eps))
+    t2 = (1 - pp) * (jnp.log1p(-pp + eps) - jnp.log1p(-pq + eps))
+    return _w(t1 + t2)
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric_geometric(p, q):
+    pp, pq = p.prob, q.prob
+    return _w(((1 - pp) / pp) * (jnp.log1p(-pp) - jnp.log1p(-pq))
+              + jnp.log(pp) - jnp.log(pq))
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson_poisson(p, q):
+    return _w(p.rate * (jnp.log(p.rate) - jnp.log(q.rate))
+              - (p.rate - q.rate))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    t = p.prob * (p.logit - q.logit)
+    return _w(jnp.sum(jnp.where(p.prob > 0, t, 0.0), -1))
+
+
+@register_kl(OneHotCategorical, OneHotCategorical)
+def _kl_onehot_onehot(p, q):
+    return _kl_categorical_categorical(p._categorical, q._categorical)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    result = jnp.log((q.high - q.low) / (p.high - p.low))
+    outside = (q.low > p.low) | (q.high < p.high)
+    return _w(jnp.where(outside, jnp.inf, result))
+
+
+@register_kl(HalfNormal, HalfNormal)
+def _kl_halfnormal_halfnormal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    return _w(0.5 * (var_ratio - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn_mvn(p, q):
+    import jax
+    Lp, Lq = p._L, q._L
+    k = Lp.shape[-1]
+    half_log_det_p = jnp.sum(jnp.log(jnp.diagonal(Lp, axis1=-2, axis2=-1)), -1)
+    half_log_det_q = jnp.sum(jnp.log(jnp.diagonal(Lq, axis1=-2, axis2=-1)), -1)
+    # tr(Σq^-1 Σp) = |Lq^-1 Lp|_F^2
+    M = jax.scipy.linalg.solve_triangular(
+        jnp.broadcast_to(Lq, Lp.shape), Lp, lower=True)
+    tr = jnp.sum(M ** 2, (-2, -1))
+    diff = q.loc - p.loc
+    z = jax.scipy.linalg.solve_triangular(
+        Lq, diff[..., None], lower=True)[..., 0]
+    maha = jnp.sum(z ** 2, -1)
+    return _w(0.5 * (tr + maha - k) + half_log_det_q - half_log_det_p)
+
+
+@register_kl(Independent, Independent)
+def _kl_independent_independent(p, q):
+    if p.reinterpreted_batch_ndims != q.reinterpreted_batch_ndims:
+        raise MXNetError("Independent KL requires matching event reshapes")
+    inner = kl_divergence(p.base_dist, q.base_dist)
+    return _w(sum_right_most(_j(inner), p.reinterpreted_batch_ndims))

@@ -1,0 +1,51 @@
+"""Exponential-family base (parity:
+`python/mxnet/gluon/probability/distributions/exp_family.py`).
+
+Entropy is derived from the log-normalizer with `jax.grad` — the TPU-native
+replacement for the reference's autograd-based Bregman computation:
+H = F(θ) - <θ, ∇F(θ)> - E[h(x)] where F is the log normalizer.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution
+from .utils import _w
+
+__all__ = ["ExponentialFamily"]
+
+
+class ExponentialFamily(Distribution):
+    """Distributions of the form p(x|θ) = h(x) exp(<η(θ), t(x)> - F(θ)).
+
+    Subclasses may implement `_natural_params` (tuple of jax arrays),
+    `_log_normalizer(*nat_params)` and `_mean_carrier_measure` to get
+    `entropy()` for free via autodiff; most subclasses simply override
+    `entropy()` analytically.
+    """
+
+    @property
+    def _natural_params(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        raise NotImplementedError
+
+    def entropy(self):
+        nat = tuple(jnp.asarray(p, dtype=jnp.result_type(p, jnp.float32))
+                    for p in self._natural_params)
+        grads = jax.grad(
+            lambda *ps: jnp.sum(self._log_normalizer(*ps)),
+            argnums=tuple(range(len(nat))))(*nat)
+        # H = F(θ) - Σ θ_i ∘ ∇_i F(θ) - E[h(x)], elementwise over the batch
+        # (the log normalizer is elementwise, so grad-of-sum == per-element grad)
+        per_elem_F = self._log_normalizer(*nat)
+        ent = per_elem_F - self._mean_carrier_measure
+        for p, g in zip(nat, grads):
+            ent = ent - p * g
+        return _w(ent)

@@ -1,0 +1,67 @@
+"""Exponential distribution (parity:
+`python/mxnet/gluon/probability/distributions/exponential.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....random import next_key
+from . import constraint
+from .exp_family import ExponentialFamily
+from .utils import _j, _w, sample_n_shape_converter
+
+__all__ = ["Exponential"]
+
+
+class Exponential(ExponentialFamily):
+    has_grad = True
+    arg_constraints = {"scale": constraint.positive}
+    support = constraint.nonnegative
+
+    def __init__(self, scale=1.0, validate_args=None):
+        self.scale = _j(scale)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    @property
+    def _batch(self):
+        return jnp.shape(self.scale)
+
+    def sample(self, size=None):
+        shape = sample_n_shape_converter(size) + self._batch
+        dtype = jnp.result_type(self.scale, jnp.float32)
+        e = jax.random.exponential(next_key(), shape, dtype)
+        return _w(e * self.scale)
+
+    def log_prob(self, value):
+        v = self._validate_sample(_j(value))
+        return _w(-v / self.scale - jnp.log(self.scale))
+
+    def cdf(self, value):
+        return _w(-jnp.expm1(-_j(value) / self.scale))
+
+    def icdf(self, value):
+        return _w(-self.scale * jnp.log1p(-_j(value)))
+
+    def _mean(self):
+        return self.scale + jnp.zeros(self._batch)
+
+    def _variance(self):
+        return self.scale ** 2 + jnp.zeros(self._batch)
+
+    def entropy(self):
+        return _w(1 + jnp.log(self.scale) + jnp.zeros(self._batch))
+
+    def broadcast_to(self, batch_shape):
+        new = Exponential.__new__(Exponential)
+        new.scale = jnp.broadcast_to(self.scale, batch_shape)
+        ExponentialFamily.__init__(new, event_dim=0)
+        return new
+
+    _mean_carrier_measure = 0
+
+    @property
+    def _natural_params(self):
+        return (-1.0 / self.scale,)
+
+    def _log_normalizer(self, x):
+        return -jnp.log(-x)

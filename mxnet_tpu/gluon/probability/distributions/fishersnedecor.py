@@ -1,0 +1,58 @@
+"""Fisher–Snedecor (F) distribution (parity:
+`python/mxnet/gluon/probability/distributions/fishersnedecor.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import betaln
+
+from ....random import next_key
+from . import constraint
+from .distribution import Distribution
+from .utils import _j, _w, sample_n_shape_converter
+
+__all__ = ["FisherSnedecor"]
+
+
+class FisherSnedecor(Distribution):
+    has_grad = True
+    arg_constraints = {"df1": constraint.positive, "df2": constraint.positive}
+    support = constraint.positive
+
+    def __init__(self, df1, df2, validate_args=None):
+        self.df1 = _j(df1)
+        self.df2 = _j(df2)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    @property
+    def _batch(self):
+        return jnp.broadcast_shapes(jnp.shape(self.df1), jnp.shape(self.df2))
+
+    def sample(self, size=None):
+        shape = sample_n_shape_converter(size) + self._batch
+        dtype = jnp.result_type(self.df1, self.df2, jnp.float32)
+        d1 = jnp.broadcast_to(self.df1, shape).astype(dtype)
+        d2 = jnp.broadcast_to(self.df2, shape).astype(dtype)
+        # F = (X1/d1)/(X2/d2) with Xi ~ chi2(di), via gamma draws
+        g1 = jax.random.gamma(next_key(), d1 / 2, dtype=dtype) * 2
+        g2 = jax.random.gamma(next_key(), d2 / 2, dtype=dtype) * 2
+        return _w((g1 / d1) / (g2 / d2))
+
+    def log_prob(self, value):
+        v = self._validate_sample(_j(value))
+        d1, d2 = self.df1, self.df2
+        return _w(0.5 * d1 * jnp.log(d1 / d2) + (0.5 * d1 - 1) * jnp.log(v)
+                  - 0.5 * (d1 + d2) * jnp.log1p(d1 * v / d2)
+                  - betaln(d1 / 2, d2 / 2))
+
+    def _mean(self):
+        d2 = self.df2
+        return jnp.broadcast_to(
+            jnp.where(d2 > 2, d2 / (d2 - 2), jnp.nan), self._batch)
+
+    def _variance(self):
+        d1, d2 = self.df1, self.df2
+        num = 2 * d2 ** 2 * (d1 + d2 - 2)
+        den = d1 * (d2 - 2) ** 2 * (d2 - 4)
+        return jnp.broadcast_to(
+            jnp.where(d2 > 4, num / den, jnp.nan), self._batch)

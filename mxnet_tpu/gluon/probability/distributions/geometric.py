@@ -1,0 +1,72 @@
+"""Geometric distribution (parity:
+`python/mxnet/gluon/probability/distributions/geometric.py`).
+
+Counts failures before the first success: support {0, 1, 2, ...},
+pmf (1-p)^k p.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import xlog1py, xlogy
+
+from ....base import MXNetError
+from ....random import next_key
+from . import constraint
+from .distribution import Distribution
+from .utils import (_j, _w, cached_property, logit2prob, prob2logit,
+                    sample_n_shape_converter)
+
+__all__ = ["Geometric"]
+
+
+class Geometric(Distribution):
+    arg_constraints = {"prob": constraint.unit_interval,
+                       "logit": constraint.real}
+    support = constraint.nonnegative_integer
+
+    def __init__(self, prob=None, logit=None, validate_args=None):
+        if (prob is None) == (logit is None):
+            raise MXNetError("Exactly one of `prob`, `logit` is required")
+        self._prob = _j(prob)
+        self._logit = _j(logit)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    @cached_property
+    def prob(self):
+        return self._prob if self._prob is not None \
+            else logit2prob(self._logit, True)
+
+    @cached_property
+    def logit(self):
+        return self._logit if self._logit is not None \
+            else prob2logit(self._prob, True)
+
+    @property
+    def _batch(self):
+        p = self._prob if self._prob is not None else self._logit
+        return jnp.shape(p)
+
+    def sample(self, size=None):
+        shape = sample_n_shape_converter(size) + self._batch
+        u = jax.random.uniform(
+            next_key(), shape, jnp.float32,
+            minval=jnp.finfo(jnp.float32).tiny)
+        return _w(jnp.floor(jnp.log(u) / jnp.log1p(-self.prob)))
+
+    def log_prob(self, value):
+        v = self._validate_sample(_j(value))
+        p = self.prob
+        return _w(xlog1py(v, -p) + jnp.log(p))
+
+    def _mean(self):
+        return jnp.broadcast_to((1 - self.prob) / self.prob, self._batch)
+
+    def _variance(self):
+        return jnp.broadcast_to(
+            (1 - self.prob) / self.prob ** 2, self._batch)
+
+    def entropy(self):
+        p = self.prob
+        return _w(jnp.broadcast_to(
+            (-xlogy(p, p) - xlog1py(1 - p, -p)) / p, self._batch))

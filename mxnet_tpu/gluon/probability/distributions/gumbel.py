@@ -1,0 +1,69 @@
+"""Gumbel distribution (parity:
+`python/mxnet/gluon/probability/distributions/gumbel.py`)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ....random import next_key
+from . import constraint
+from .distribution import Distribution
+from .utils import _j, _w, sample_n_shape_converter
+
+__all__ = ["Gumbel"]
+
+_EULER = 0.5772156649015329
+
+
+class Gumbel(Distribution):
+    has_grad = True
+    arg_constraints = {"loc": constraint.real, "scale": constraint.positive}
+    support = constraint.real
+
+    def __init__(self, loc=0.0, scale=1.0, validate_args=None):
+        self.loc = _j(loc)
+        self.scale = _j(scale)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    @property
+    def _batch(self):
+        return jnp.broadcast_shapes(jnp.shape(self.loc), jnp.shape(self.scale))
+
+    def sample(self, size=None):
+        shape = sample_n_shape_converter(size) + self._batch
+        dtype = jnp.result_type(self.loc, self.scale, jnp.float32)
+        eps = jax.random.gumbel(next_key(), shape, dtype)
+        return _w(self.loc + self.scale * eps)
+
+    def log_prob(self, value):
+        v = self._validate_sample(_j(value))
+        z = (v - self.loc) / self.scale
+        return _w(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def cdf(self, value):
+        z = (_j(value) - self.loc) / self.scale
+        return _w(jnp.exp(-jnp.exp(-z)))
+
+    def icdf(self, value):
+        p = _j(value)
+        return _w(self.loc - self.scale * jnp.log(-jnp.log(p)))
+
+    def _mean(self):
+        return jnp.broadcast_to(self.loc + self.scale * _EULER, self._batch)
+
+    def _variance(self):
+        return jnp.broadcast_to(
+            (math.pi ** 2 / 6) * self.scale ** 2, self._batch)
+
+    def entropy(self):
+        return _w(jnp.broadcast_to(
+            jnp.log(self.scale) + 1 + _EULER, self._batch))
+
+    def broadcast_to(self, batch_shape):
+        new = Gumbel.__new__(Gumbel)
+        new.loc = jnp.broadcast_to(self.loc, batch_shape)
+        new.scale = jnp.broadcast_to(self.scale, batch_shape)
+        Distribution.__init__(new, event_dim=0)
+        return new

@@ -1,0 +1,46 @@
+"""Half-Cauchy distribution (parity:
+`python/mxnet/gluon/probability/distributions/half_cauchy.py`)."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from . import constraint
+from .cauchy import Cauchy
+from .transformed_distribution import TransformedDistribution
+from ..transformation import AbsTransform
+from .utils import _j, _w
+
+__all__ = ["HalfCauchy"]
+
+
+class HalfCauchy(TransformedDistribution):
+    has_grad = True
+    arg_constraints = {"scale": constraint.positive}
+    support = constraint.nonnegative
+
+    def __init__(self, scale=1.0, validate_args=None):
+        self.scale = _j(scale)
+        base = Cauchy(0.0, scale)
+        super().__init__(base, AbsTransform(), validate_args=validate_args)
+
+    def log_prob(self, value):
+        v = _j(value)
+        lp = _j(self._base_dist.log_prob(value)) + math.log(2)
+        return _w(jnp.where(v >= 0, lp, -jnp.inf))
+
+    def cdf(self, value):
+        return _w(2 * _j(self._base_dist.cdf(value)) - 1)
+
+    def icdf(self, value):
+        return self._base_dist.icdf(_w((_j(value) + 1) / 2))
+
+    def _mean(self):
+        return jnp.full(jnp.shape(self.scale), jnp.inf)
+
+    def _variance(self):
+        return jnp.full(jnp.shape(self.scale), jnp.inf)
+
+    def entropy(self):
+        return _w(jnp.log(2 * math.pi * self.scale) + jnp.zeros(()))

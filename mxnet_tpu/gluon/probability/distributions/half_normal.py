@@ -1,0 +1,48 @@
+"""Half-normal distribution (parity:
+`python/mxnet/gluon/probability/distributions/half_normal.py`)."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from . import constraint
+from .normal import Normal
+from .transformed_distribution import TransformedDistribution
+from ..transformation import AbsTransform
+from .utils import _j, _w
+
+__all__ = ["HalfNormal"]
+
+
+class HalfNormal(TransformedDistribution):
+    has_grad = True
+    arg_constraints = {"scale": constraint.positive}
+    support = constraint.nonnegative
+
+    def __init__(self, scale=1.0, validate_args=None):
+        self.scale = _j(scale)
+        base = Normal(0.0, scale)
+        super().__init__(base, AbsTransform(), validate_args=validate_args)
+
+    def log_prob(self, value):
+        v = _j(value)
+        lp = _j(self._base_dist.log_prob(value)) + math.log(2)
+        return _w(jnp.where(v >= 0, lp, -jnp.inf))
+
+    def cdf(self, value):
+        return _w(2 * _j(self._base_dist.cdf(value)) - 1)
+
+    def icdf(self, value):
+        return self._base_dist.icdf(_w((_j(value) + 1) / 2))
+
+    def _mean(self):
+        return self.scale * math.sqrt(2 / math.pi) \
+            + jnp.zeros(jnp.shape(self.scale))
+
+    def _variance(self):
+        return self.scale ** 2 * (1 - 2 / math.pi) \
+            + jnp.zeros(jnp.shape(self.scale))
+
+    def entropy(self):
+        return _w(0.5 * jnp.log(math.pi * self.scale ** 2 / 2) + 0.5)

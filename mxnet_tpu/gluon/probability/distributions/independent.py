@@ -1,0 +1,51 @@
+"""Independent distribution wrapper (parity:
+`python/mxnet/gluon/probability/distributions/independent.py`).
+
+Reinterprets the rightmost `reinterpreted_batch_ndims` batch dimensions of a
+base distribution as event dimensions (log_prob sums over them).
+"""
+from __future__ import annotations
+
+from .distribution import Distribution
+from .utils import _j, _w, sum_right_most
+
+__all__ = ["Independent"]
+
+
+class Independent(Distribution):
+    def __init__(self, base_distribution, reinterpreted_batch_ndims,
+                 validate_args=None):
+        self.base_dist = base_distribution
+        self.reinterpreted_batch_ndims = int(reinterpreted_batch_ndims)
+        super().__init__(
+            event_dim=base_distribution.event_dim
+            + self.reinterpreted_batch_ndims,
+            validate_args=validate_args)
+
+    @property
+    def has_grad(self):
+        return self.base_dist.has_grad
+
+    @property
+    def support(self):
+        return self.base_dist.support
+
+    def sample(self, size=None):
+        return self.base_dist.sample(size)
+
+    def sample_n(self, n=None):
+        return self.base_dist.sample_n(n)
+
+    def log_prob(self, value):
+        lp = _j(self.base_dist.log_prob(value))
+        return _w(sum_right_most(lp, self.reinterpreted_batch_ndims))
+
+    def _mean(self):
+        return _j(self.base_dist.mean)
+
+    def _variance(self):
+        return _j(self.base_dist.variance)
+
+    def entropy(self):
+        ent = _j(self.base_dist.entropy())
+        return _w(sum_right_most(ent, self.reinterpreted_batch_ndims))

@@ -1,0 +1,66 @@
+"""Laplace distribution (parity:
+`python/mxnet/gluon/probability/distributions/laplace.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....random import next_key
+from . import constraint
+from .distribution import Distribution
+from .utils import _j, _w, sample_n_shape_converter
+
+__all__ = ["Laplace"]
+
+
+class Laplace(Distribution):
+    has_grad = True
+    arg_constraints = {"loc": constraint.real, "scale": constraint.positive}
+    support = constraint.real
+
+    def __init__(self, loc=0.0, scale=1.0, validate_args=None):
+        self.loc = _j(loc)
+        self.scale = _j(scale)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    @property
+    def _batch(self):
+        return jnp.broadcast_shapes(jnp.shape(self.loc), jnp.shape(self.scale))
+
+    def sample(self, size=None):
+        shape = sample_n_shape_converter(size) + self._batch
+        dtype = jnp.result_type(self.loc, self.scale, jnp.float32)
+        eps = jax.random.laplace(next_key(), shape, dtype)
+        return _w(self.loc + self.scale * eps)
+
+    def log_prob(self, value):
+        v = self._validate_sample(_j(value))
+        return _w(-jnp.abs(v - self.loc) / self.scale
+                  - jnp.log(2 * self.scale))
+
+    def cdf(self, value):
+        v = _j(value)
+        z = (v - self.loc) / self.scale
+        return _w(0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z)))
+
+    def icdf(self, value):
+        p = _j(value)
+        term = p - 0.5
+        return _w(self.loc - self.scale * jnp.sign(term)
+                  * jnp.log1p(-2 * jnp.abs(term)))
+
+    def _mean(self):
+        return jnp.broadcast_to(self.loc, self._batch)
+
+    def _variance(self):
+        return jnp.broadcast_to(2 * self.scale ** 2, self._batch)
+
+    def entropy(self):
+        return _w(jnp.broadcast_to(1 + jnp.log(2 * self.scale), self._batch))
+
+    def broadcast_to(self, batch_shape):
+        new = Laplace.__new__(Laplace)
+        new.loc = jnp.broadcast_to(self.loc, batch_shape)
+        new.scale = jnp.broadcast_to(self.scale, batch_shape)
+        Distribution.__init__(new, event_dim=0)
+        return new

@@ -1,0 +1,65 @@
+"""Multinomial distribution (parity:
+`python/mxnet/gluon/probability/distributions/multinomial.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import xlogy
+
+from ....random import next_key
+from . import constraint
+from .categorical import Categorical
+from .distribution import Distribution
+from .utils import _j, _w, gammaln, sample_n_shape_converter
+
+__all__ = ["Multinomial"]
+
+
+class Multinomial(Distribution):
+    arg_constraints = {"prob": constraint.simplex, "logit": constraint.real}
+
+    def __init__(self, num_events=None, prob=None, logit=None, total_count=1,
+                 validate_args=None):
+        self._categorical = Categorical(num_events, prob=prob, logit=logit)
+        self.num_events = self._categorical.num_events
+        self.total_count = int(total_count)
+        super().__init__(event_dim=1, validate_args=validate_args)
+
+    @property
+    def prob(self):
+        return self._categorical.prob
+
+    @property
+    def logit(self):
+        return self._categorical.logit
+
+    @property
+    def _batch(self):
+        return self._categorical._batch
+
+    def sample(self, size=None):
+        prefix = sample_n_shape_converter(size)
+        shape = prefix + self._batch
+        lg = jnp.broadcast_to(self._categorical.logit,
+                              shape + (self.num_events,))
+        # draw total_count categoricals at once, then histogram via one-hot sum
+        idx = jax.random.categorical(
+            next_key(), lg[..., None, :],
+            axis=-1, shape=shape + (self.total_count,))
+        onehot = jax.nn.one_hot(idx, self.num_events, dtype=jnp.float32)
+        return _w(onehot.sum(-2))
+
+    def log_prob(self, value):
+        v = _j(value)
+        n = v.sum(-1)
+        log_coef = gammaln(n + 1) - jnp.sum(gammaln(v + 1), -1)
+        return _w(log_coef + jnp.sum(xlogy(v, self.prob), -1))
+
+    def _mean(self):
+        return jnp.broadcast_to(self.total_count * self.prob,
+                                self._batch + (self.num_events,))
+
+    def _variance(self):
+        p = self.prob
+        return jnp.broadcast_to(self.total_count * p * (1 - p),
+                                self._batch + (self.num_events,))

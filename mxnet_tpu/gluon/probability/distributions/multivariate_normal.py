@@ -1,0 +1,98 @@
+"""Multivariate normal distribution (parity:
+`python/mxnet/gluon/probability/distributions/multivariate_normal.py`).
+
+Accepts exactly one of `cov`, `precision`, `scale_tril`; densities are
+computed from the Cholesky factor (triangular solves — MXU-friendly, no
+explicit inverse).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ....base import MXNetError
+from ....random import next_key
+from . import constraint
+from .distribution import Distribution
+from .utils import _j, _w, cached_property, sample_n_shape_converter
+
+__all__ = ["MultivariateNormal"]
+
+
+class MultivariateNormal(Distribution):
+    has_grad = True
+    arg_constraints = {"loc": constraint.real_vector,
+                       "cov": constraint.positive_definite,
+                       "precision": constraint.positive_definite,
+                       "scale_tril": constraint.lower_cholesky}
+    support = constraint.real_vector
+
+    def __init__(self, loc, cov=None, precision=None, scale_tril=None,
+                 validate_args=None):
+        if sum(v is not None for v in (cov, precision, scale_tril)) != 1:
+            raise MXNetError(
+                "Exactly one of `cov`, `precision`, `scale_tril` is required")
+        self.loc = _j(loc)
+        self.cov = _j(cov)
+        self.precision = _j(precision)
+        self.scale_tril = _j(scale_tril)
+        super().__init__(event_dim=1, validate_args=validate_args)
+
+    @cached_property
+    def _L(self):
+        """Lower Cholesky factor of the covariance."""
+        if self.scale_tril is not None:
+            return self.scale_tril
+        if self.cov is not None:
+            return jnp.linalg.cholesky(self.cov)
+        prec_chol = jnp.linalg.cholesky(self.precision)
+        ident = jnp.eye(prec_chol.shape[-1], dtype=prec_chol.dtype)
+        # cov = P^-1 = (L_p L_p^T)^-1; chol(cov) = L_p^-T (up to triangularity)
+        inv = jax.scipy.linalg.solve_triangular(prec_chol, ident, lower=True)
+        return jnp.linalg.cholesky(jnp.swapaxes(inv, -1, -2) @ inv)
+
+    @property
+    def _batch(self):
+        return jnp.broadcast_shapes(jnp.shape(self.loc)[:-1],
+                                    jnp.shape(self._L)[:-2])
+
+    @property
+    def _event(self):
+        return jnp.shape(self.loc)[-1:]
+
+    def sample(self, size=None):
+        shape = sample_n_shape_converter(size) + self._batch + self._event
+        dtype = jnp.result_type(self.loc, jnp.float32)
+        eps = jax.random.normal(next_key(), shape, dtype)
+        return _w(self.loc + jnp.einsum("...ij,...j->...i", self._L, eps))
+
+    def log_prob(self, value):
+        v = _j(value)
+        diff = v - self.loc
+        L = self._L
+        # solve L z = diff; maha = |z|^2
+        z = jax.scipy.linalg.solve_triangular(
+            L, diff[..., None], lower=True)[..., 0]
+        maha = jnp.sum(z ** 2, -1)
+        half_log_det = jnp.sum(
+            jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), -1)
+        k = v.shape[-1]
+        return _w(-0.5 * (maha + k * math.log(2 * math.pi)) - half_log_det)
+
+    def _mean(self):
+        return jnp.broadcast_to(self.loc, self._batch + self._event)
+
+    def _variance(self):
+        L = self._L
+        var = jnp.sum(L ** 2, -1)
+        return jnp.broadcast_to(var, self._batch + self._event)
+
+    def entropy(self):
+        k = self._event[0]
+        half_log_det = jnp.sum(
+            jnp.log(jnp.diagonal(self._L, axis1=-2, axis2=-1)), -1)
+        return _w(jnp.broadcast_to(
+            0.5 * k * (1 + math.log(2 * math.pi)) + half_log_det,
+            self._batch))

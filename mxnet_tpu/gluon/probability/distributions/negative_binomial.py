@@ -1,0 +1,72 @@
+"""Negative binomial distribution (parity:
+`python/mxnet/gluon/probability/distributions/negative_binomial.py`).
+
+Counts failures before the `n`-th success with success probability `prob`;
+sampled as a gamma–Poisson mixture (both TPU-native samplers).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import xlog1py, xlogy
+
+from ....base import MXNetError
+from ....random import next_key
+from . import constraint
+from .distribution import Distribution
+from .utils import (_j, _w, cached_property, gammaln, logit2prob, prob2logit,
+                    sample_n_shape_converter)
+
+__all__ = ["NegativeBinomial"]
+
+
+class NegativeBinomial(Distribution):
+    arg_constraints = {"n": constraint.nonnegative_integer,
+                       "prob": constraint.unit_interval,
+                       "logit": constraint.real}
+    support = constraint.nonnegative_integer
+
+    def __init__(self, n, prob=None, logit=None, validate_args=None):
+        if (prob is None) == (logit is None):
+            raise MXNetError("Exactly one of `prob`, `logit` is required")
+        self.n = _j(n)
+        self._prob = _j(prob)
+        self._logit = _j(logit)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    @cached_property
+    def prob(self):
+        return self._prob if self._prob is not None \
+            else logit2prob(self._logit, True)
+
+    @cached_property
+    def logit(self):
+        return self._logit if self._logit is not None \
+            else prob2logit(self._prob, True)
+
+    @property
+    def _batch(self):
+        p = self._prob if self._prob is not None else self._logit
+        return jnp.broadcast_shapes(jnp.shape(self.n), jnp.shape(p))
+
+    def sample(self, size=None):
+        shape = sample_n_shape_converter(size) + self._batch
+        n = jnp.broadcast_to(self.n, shape).astype(jnp.float32)
+        p = jnp.broadcast_to(self.prob, shape).astype(jnp.float32)
+        lam = jax.random.gamma(next_key(), n) * (1 - p) / p
+        return _w(jax.random.poisson(next_key(), lam, shape)
+                  .astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = self._validate_sample(_j(value))
+        n, p = self.n, self.prob
+        log_comb = gammaln(v + n) - gammaln(v + 1) - gammaln(n)
+        return _w(log_comb + xlogy(n, p) + xlog1py(v, -p))
+
+    def _mean(self):
+        return jnp.broadcast_to(
+            self.n * (1 - self.prob) / self.prob, self._batch)
+
+    def _variance(self):
+        return jnp.broadcast_to(
+            self.n * (1 - self.prob) / self.prob ** 2, self._batch)

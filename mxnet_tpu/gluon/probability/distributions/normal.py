@@ -1,0 +1,80 @@
+"""Normal distribution (parity:
+`python/mxnet/gluon/probability/distributions/normal.py`)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ....random import next_key
+from . import constraint
+from .exp_family import ExponentialFamily
+from .utils import _j, _w, erf, erfinv
+
+__all__ = ["Normal"]
+
+_HALF_LOG_2PI = 0.5 * math.log(2 * math.pi)
+
+
+class Normal(ExponentialFamily):
+    has_grad = True
+    arg_constraints = {"loc": constraint.real, "scale": constraint.positive}
+    support = constraint.real
+
+    def __init__(self, loc=0.0, scale=1.0, validate_args=None):
+        self.loc = _j(loc)
+        self.scale = _j(scale)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    @property
+    def _batch(self):
+        return jnp.broadcast_shapes(jnp.shape(self.loc), jnp.shape(self.scale))
+
+    def sample(self, size=None):
+        from .utils import sample_n_shape_converter
+        shape = sample_n_shape_converter(size) + self._batch
+        dtype = jnp.result_type(self.loc, self.scale, jnp.float32)
+        eps = jax.random.normal(next_key(), shape, dtype)
+        return _w(self.loc + self.scale * eps)
+
+    def log_prob(self, value):
+        v = self._validate_sample(_j(value))
+        var = self.scale ** 2
+        return _w(-((v - self.loc) ** 2) / (2 * var)
+                  - jnp.log(self.scale) - _HALF_LOG_2PI)
+
+    def cdf(self, value):
+        v = _j(value)
+        return _w(0.5 * (1 + erf((v - self.loc) /
+                                 (self.scale * math.sqrt(2)))))
+
+    def icdf(self, value):
+        v = _j(value)
+        return _w(self.loc + self.scale * math.sqrt(2) * erfinv(2 * v - 1))
+
+    def _mean(self):
+        return jnp.broadcast_to(self.loc, self._batch)
+
+    def _variance(self):
+        return jnp.broadcast_to(self.scale ** 2, self._batch)
+
+    def entropy(self):
+        return _w(jnp.broadcast_to(
+            0.5 + _HALF_LOG_2PI + jnp.log(self.scale), self._batch))
+
+    def broadcast_to(self, batch_shape):
+        new = Normal.__new__(Normal)
+        new.loc = jnp.broadcast_to(self.loc, batch_shape)
+        new.scale = jnp.broadcast_to(self.scale, batch_shape)
+        super(Normal, new).__init__(event_dim=0)
+        return new
+
+    _mean_carrier_measure = 0
+
+    @property
+    def _natural_params(self):
+        return (self.loc / self.scale ** 2, -0.5 / self.scale ** 2)
+
+    def _log_normalizer(self, x, y):
+        return -0.25 * x ** 2 / y + 0.5 * jnp.log(-math.pi / y)

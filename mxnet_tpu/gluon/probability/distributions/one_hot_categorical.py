@@ -1,0 +1,63 @@
+"""One-hot categorical distribution (parity:
+`python/mxnet/gluon/probability/distributions/one_hot_categorical.py`)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import constraint
+from .categorical import Categorical
+from .distribution import Distribution
+from .utils import _j, _w
+
+__all__ = ["OneHotCategorical"]
+
+
+class OneHotCategorical(Distribution):
+    has_enumerate_support = True
+    arg_constraints = {"prob": constraint.simplex, "logit": constraint.real}
+    support = constraint.simplex
+
+    def __init__(self, num_events=None, prob=None, logit=None,
+                 validate_args=None):
+        self._categorical = Categorical(num_events, prob=prob, logit=logit)
+        self.num_events = self._categorical.num_events
+        super().__init__(event_dim=1, validate_args=validate_args)
+
+    @property
+    def prob(self):
+        return self._categorical.prob
+
+    @property
+    def logit(self):
+        return self._categorical.logit
+
+    @property
+    def _batch(self):
+        return self._categorical._batch
+
+    def sample(self, size=None):
+        idx = _j(self._categorical.sample(size)).astype(jnp.int32)
+        return _w(jnp.eye(self.num_events, dtype=jnp.float32)[idx])
+
+    def log_prob(self, value):
+        v = _j(value)
+        lg = self._categorical.logit
+        return _w(jnp.sum(v * lg, -1))
+
+    def _mean(self):
+        return jnp.broadcast_to(self.prob,
+                                self._batch + (self.num_events,))
+
+    def _variance(self):
+        p = self.prob
+        return jnp.broadcast_to(p * (1 - p),
+                                self._batch + (self.num_events,))
+
+    def entropy(self):
+        return self._categorical.entropy()
+
+    def enumerate_support(self):
+        n = self.num_events
+        eye = jnp.eye(n, dtype=jnp.float32)
+        eye = jnp.reshape(eye, (n,) + (1,) * len(self._batch) + (n,))
+        return _w(jnp.broadcast_to(eye, (n,) + self._batch + (n,)))

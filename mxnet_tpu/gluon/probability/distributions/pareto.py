@@ -1,0 +1,71 @@
+"""Pareto distribution (parity:
+`python/mxnet/gluon/probability/distributions/pareto.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....random import next_key
+from . import constraint
+from .distribution import Distribution
+from .utils import _j, _w, sample_n_shape_converter
+
+__all__ = ["Pareto"]
+
+
+class Pareto(Distribution):
+    has_grad = True
+    arg_constraints = {"alpha": constraint.positive,
+                       "scale": constraint.positive}
+
+    def __init__(self, alpha, scale=1.0, validate_args=None):
+        self.alpha = _j(alpha)
+        self.scale = _j(scale)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    @property
+    def support(self):
+        return constraint.GreaterThanEq(self.scale)
+
+    @property
+    def _batch(self):
+        return jnp.broadcast_shapes(jnp.shape(self.alpha),
+                                    jnp.shape(self.scale))
+
+    def sample(self, size=None):
+        shape = sample_n_shape_converter(size) + self._batch
+        dtype = jnp.result_type(self.alpha, self.scale, jnp.float32)
+        e = jax.random.exponential(next_key(), shape, dtype)
+        return _w(self.scale * jnp.exp(e / self.alpha))
+
+    def log_prob(self, value):
+        v = self._validate_sample(_j(value))
+        a = self.alpha
+        lp = jnp.log(a) + a * jnp.log(self.scale) - (a + 1) * jnp.log(v)
+        return _w(jnp.where(v >= self.scale, lp, -jnp.inf))
+
+    def cdf(self, value):
+        v = _j(value)
+        c = 1 - (self.scale / v) ** self.alpha
+        return _w(jnp.where(v >= self.scale, c, 0.0))
+
+    def icdf(self, value):
+        p = _j(value)
+        return _w(self.scale * (1 - p) ** (-1.0 / self.alpha))
+
+    def _mean(self):
+        a = self.alpha
+        m = jnp.where(a > 1, a * self.scale / (a - 1), jnp.inf)
+        return jnp.broadcast_to(m, self._batch)
+
+    def _variance(self):
+        a = self.alpha
+        v = jnp.where(a > 2,
+                      self.scale ** 2 * a / ((a - 1) ** 2 * (a - 2)),
+                      jnp.inf)
+        return jnp.broadcast_to(v, self._batch)
+
+    def entropy(self):
+        a = self.alpha
+        return _w(jnp.broadcast_to(
+            jnp.log(self.scale / a) + 1 + 1.0 / a, self._batch))

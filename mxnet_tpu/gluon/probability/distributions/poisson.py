@@ -1,0 +1,65 @@
+"""Poisson distribution (parity:
+`python/mxnet/gluon/probability/distributions/poisson.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import xlogy
+
+from ....random import next_key
+from . import constraint
+from .exp_family import ExponentialFamily
+from .utils import _j, _w, gammaln, sample_n_shape_converter
+
+__all__ = ["Poisson"]
+
+
+class Poisson(ExponentialFamily):
+    arg_constraints = {"rate": constraint.positive}
+    support = constraint.nonnegative_integer
+
+    def __init__(self, rate=1.0, validate_args=None):
+        self.rate = _j(rate)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    @property
+    def _batch(self):
+        return jnp.shape(self.rate)
+
+    def sample(self, size=None):
+        shape = sample_n_shape_converter(size) + self._batch
+        lam = jnp.broadcast_to(self.rate, shape).astype(jnp.float32)
+        return _w(jax.random.poisson(next_key(), lam, shape)
+                  .astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = self._validate_sample(_j(value))
+        return _w(xlogy(v, self.rate) - self.rate - gammaln(v + 1))
+
+    def _mean(self):
+        return jnp.broadcast_to(self.rate, self._batch)
+
+    def _variance(self):
+        return jnp.broadcast_to(self.rate, self._batch)
+
+    def entropy(self):
+        # H = λ(1 - log λ) + e^{-λ} Σ_k λ^k log(k!) / k!, truncated series
+        # (accurate to float32 for the practical λ range; no closed form)
+        lam = self.rate
+        k = jnp.arange(1.0, 64.0)
+        shape = (1,) * len(self._batch) + (-1,)
+        k = jnp.reshape(k, shape)
+        lam_b = jnp.asarray(lam)[..., None]
+        terms = jnp.exp(k * jnp.log(lam_b) - gammaln(k + 1) - lam_b) \
+            * gammaln(k + 1)
+        return _w(lam * (1 - jnp.log(lam)) + terms.sum(-1))
+
+    def broadcast_to(self, batch_shape):
+        return Poisson(jnp.broadcast_to(self.rate, batch_shape))
+
+    @property
+    def _natural_params(self):
+        return (jnp.log(self.rate),)
+
+    def _log_normalizer(self, x):
+        return jnp.exp(x)

@@ -1,0 +1,65 @@
+"""Relaxed Bernoulli / binary Concrete distribution (parity:
+`python/mxnet/gluon/probability/distributions/relaxed_bernoulli.py`).
+
+Gumbel-sigmoid relaxation: fully reparameterized, so gradients flow through
+samples — the discrete Bernoulli made trainable.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ....base import MXNetError
+from ....random import next_key
+from . import constraint
+from .distribution import Distribution
+from .utils import (_j, _w, cached_property, logit2prob, prob2logit,
+                    sample_n_shape_converter)
+
+__all__ = ["RelaxedBernoulli"]
+
+
+class RelaxedBernoulli(Distribution):
+    has_grad = True
+    arg_constraints = {"prob": constraint.unit_interval,
+                       "logit": constraint.real}
+    support = constraint.unit_interval
+
+    def __init__(self, T=1.0, prob=None, logit=None, validate_args=None):
+        if (prob is None) == (logit is None):
+            raise MXNetError("Exactly one of `prob`, `logit` is required")
+        self.T = _j(T)
+        self._prob = _j(prob)
+        self._logit = _j(logit)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    @cached_property
+    def prob(self):
+        return self._prob if self._prob is not None \
+            else logit2prob(self._logit, True)
+
+    @cached_property
+    def logit(self):
+        return self._logit if self._logit is not None \
+            else prob2logit(self._prob, True)
+
+    @property
+    def _batch(self):
+        p = self._prob if self._prob is not None else self._logit
+        return jnp.shape(p)
+
+    def sample(self, size=None):
+        shape = sample_n_shape_converter(size) + self._batch
+        u = jax.random.uniform(
+            next_key(), shape, jnp.float32,
+            minval=jnp.finfo(jnp.float32).tiny)
+        logistic = jnp.log(u) - jnp.log1p(-u)
+        return _w(lax.logistic((self.logit + logistic) / self.T))
+
+    def log_prob(self, value):
+        v = self._validate_sample(_j(value))
+        lg, T = self.logit, self.T
+        diff = lg - T * (jnp.log(v) - jnp.log1p(-v))
+        return _w(jnp.log(T) + diff - 2 * jnp.logaddexp(0.0, diff)
+                  - jnp.log(v) - jnp.log1p(-v))

@@ -1,0 +1,60 @@
+"""Relaxed one-hot categorical / Concrete distribution (parity:
+`python/mxnet/gluon/probability/distributions/relaxed_one_hot_categorical.py`).
+
+Gumbel-softmax relaxation with temperature `T`; reparameterized.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import logsumexp
+
+from ....random import next_key
+from . import constraint
+from .categorical import Categorical
+from .distribution import Distribution
+from .utils import _j, _w, gammaln, sample_n_shape_converter
+
+__all__ = ["RelaxedOneHotCategorical"]
+
+
+class RelaxedOneHotCategorical(Distribution):
+    has_grad = True
+    arg_constraints = {"prob": constraint.simplex, "logit": constraint.real}
+    support = constraint.simplex
+
+    def __init__(self, T=1.0, num_events=None, prob=None, logit=None,
+                 validate_args=None):
+        self.T = _j(T)
+        self._categorical = Categorical(num_events, prob=prob, logit=logit)
+        self.num_events = self._categorical.num_events
+        super().__init__(event_dim=1, validate_args=validate_args)
+
+    @property
+    def prob(self):
+        return self._categorical.prob
+
+    @property
+    def logit(self):
+        return self._categorical.logit
+
+    @property
+    def _batch(self):
+        return self._categorical._batch
+
+    def sample(self, size=None):
+        shape = sample_n_shape_converter(size) + self._batch \
+            + (self.num_events,)
+        g = jax.random.gumbel(next_key(), shape, jnp.float32)
+        z = (self.logit + g) / self.T
+        return _w(jnp.exp(z - logsumexp(z, -1, keepdims=True)))
+
+    def log_prob(self, value):
+        v = _j(value)
+        k = self.num_events
+        lg, T = self.logit, self.T
+        # density of the Concrete distribution (Maddison et al. 2017, eq. 6)
+        log_scale = gammaln(jnp.asarray(float(k))) + (k - 1) * jnp.log(T)
+        score = (lg - (T + 1) * jnp.log(v)).sum(-1) \
+            - k * logsumexp(lg - T * jnp.log(v), -1)
+        return _w(score + log_scale)

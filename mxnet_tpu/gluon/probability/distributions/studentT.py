@@ -1,0 +1,66 @@
+"""Student's t distribution (parity:
+`python/mxnet/gluon/probability/distributions/studentT.py`)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import betaln
+
+from ....random import next_key
+from . import constraint
+from .distribution import Distribution
+from .utils import _j, _w, digamma, sample_n_shape_converter
+
+__all__ = ["StudentT"]
+
+
+class StudentT(Distribution):
+    has_grad = True
+    arg_constraints = {"df": constraint.positive, "loc": constraint.real,
+                       "scale": constraint.positive}
+    support = constraint.real
+
+    def __init__(self, df, loc=0.0, scale=1.0, validate_args=None):
+        self.df = _j(df)
+        self.loc = _j(loc)
+        self.scale = _j(scale)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    @property
+    def _batch(self):
+        return jnp.broadcast_shapes(jnp.shape(self.df), jnp.shape(self.loc),
+                                    jnp.shape(self.scale))
+
+    def sample(self, size=None):
+        shape = sample_n_shape_converter(size) + self._batch
+        dtype = jnp.result_type(self.df, self.loc, self.scale, jnp.float32)
+        df = jnp.broadcast_to(self.df, shape).astype(dtype)
+        t = jax.random.t(next_key(), df, shape, dtype)
+        return _w(self.loc + self.scale * t)
+
+    def log_prob(self, value):
+        v = self._validate_sample(_j(value))
+        df = self.df
+        z = (v - self.loc) / self.scale
+        return _w(-0.5 * (df + 1) * jnp.log1p(z ** 2 / df)
+                  - betaln(0.5, df / 2) - 0.5 * jnp.log(df)
+                  - jnp.log(self.scale))
+
+    def _mean(self):
+        return jnp.broadcast_to(
+            jnp.where(self.df > 1, self.loc, jnp.nan), self._batch)
+
+    def _variance(self):
+        df = self.df
+        var = jnp.where(df > 2, self.scale ** 2 * df / (df - 2),
+                        jnp.where(df > 1, jnp.inf, jnp.nan))
+        return jnp.broadcast_to(var, self._batch)
+
+    def entropy(self):
+        df = self.df
+        return _w(jnp.broadcast_to(
+            0.5 * (df + 1) * (digamma(0.5 * (df + 1)) - digamma(0.5 * df))
+            + 0.5 * jnp.log(df) + betaln(0.5, df / 2)
+            + jnp.log(self.scale), self._batch))

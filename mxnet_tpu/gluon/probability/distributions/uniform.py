@@ -1,0 +1,66 @@
+"""Uniform distribution (parity:
+`python/mxnet/gluon/probability/distributions/uniform.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....random import next_key
+from . import constraint
+from .distribution import Distribution
+from .utils import _j, _w, sample_n_shape_converter
+
+__all__ = ["Uniform"]
+
+
+class Uniform(Distribution):
+    has_grad = True
+    arg_constraints = {"low": constraint.dependent,
+                       "high": constraint.dependent}
+
+    def __init__(self, low=0.0, high=1.0, validate_args=None):
+        self.low = _j(low)
+        self.high = _j(high)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    @property
+    def support(self):
+        return constraint.Interval(self.low, self.high)
+
+    @property
+    def _batch(self):
+        return jnp.broadcast_shapes(jnp.shape(self.low), jnp.shape(self.high))
+
+    def sample(self, size=None):
+        shape = sample_n_shape_converter(size) + self._batch
+        dtype = jnp.result_type(self.low, self.high, jnp.float32)
+        u = jax.random.uniform(next_key(), shape, dtype)
+        return _w(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        v = self._validate_sample(_j(value))
+        inside = (v >= self.low) & (v <= self.high)
+        return _w(jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf))
+
+    def cdf(self, value):
+        v = _j(value)
+        return _w(jnp.clip((v - self.low) / (self.high - self.low), 0.0, 1.0))
+
+    def icdf(self, value):
+        return _w(self.low + (self.high - self.low) * _j(value))
+
+    def _mean(self):
+        return jnp.broadcast_to((self.low + self.high) / 2, self._batch)
+
+    def _variance(self):
+        return jnp.broadcast_to((self.high - self.low) ** 2 / 12, self._batch)
+
+    def entropy(self):
+        return _w(jnp.broadcast_to(jnp.log(self.high - self.low), self._batch))
+
+    def broadcast_to(self, batch_shape):
+        new = Uniform.__new__(Uniform)
+        new.low = jnp.broadcast_to(self.low, batch_shape)
+        new.high = jnp.broadcast_to(self.high, batch_shape)
+        Distribution.__init__(new, event_dim=0)
+        return new

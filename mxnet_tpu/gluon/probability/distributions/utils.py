@@ -1,0 +1,72 @@
+"""Shared helpers for distributions (parity:
+`python/mxnet/gluon/probability/distributions/utils.py`)."""
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+from jax import lax
+from jax.scipy import special as jsp
+
+from ....ndarray.ndarray import as_jax, from_jax, ndarray
+
+__all__ = ["prob2logit", "logit2prob", "sum_right_most", "cached_property",
+           "sample_n_shape_converter", "gammaln", "digamma", "erf", "erfinv"]
+
+gammaln = jsp.gammaln
+digamma = jsp.digamma
+erf = jsp.erf
+erfinv = jsp.erfinv
+
+
+def _j(x):
+    """Coerce distribution parameters/values to a jax array (or tracer)."""
+    if x is None:
+        return None
+    x = as_jax(x)
+    if isinstance(x, (int, float, bool, list, tuple)):
+        x = jnp.asarray(x)
+    return x
+
+
+def _w(x):
+    """Wrap a jax array back into the framework ndarray."""
+    if isinstance(x, ndarray):
+        return x
+    return from_jax(jnp.asarray(x))
+
+
+def prob2logit(prob, binary=True):
+    """Convert probability to logit (log-odds for binary, log-prob otherwise)."""
+    p = _j(prob)
+    eps = jnp.finfo(jnp.result_type(p, jnp.float32)).tiny
+    p = jnp.clip(p, eps, 1.0 - eps if binary else 1.0)
+    if binary:
+        return jnp.log(p) - jnp.log1p(-p)
+    return jnp.log(p)
+
+
+def logit2prob(logit, binary=True):
+    lg = _j(logit)
+    if binary:
+        return lax.logistic(lg)
+    return jnp.exp(lg - jsp.logsumexp(lg, axis=-1, keepdims=True))
+
+
+def sum_right_most(x, ndim):
+    """Sum over the rightmost `ndim` axes (event-dim reduction)."""
+    if ndim == 0:
+        return x
+    return jnp.sum(x, axis=tuple(range(-ndim, 0)))
+
+
+def sample_n_shape_converter(size):
+    """Normalise a `size` argument into a tuple prefix shape."""
+    if size is None:
+        return ()
+    if isinstance(size, int):
+        return (size,)
+    return tuple(size)
+
+
+cached_property = functools.cached_property

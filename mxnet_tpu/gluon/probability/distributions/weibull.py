@@ -1,0 +1,69 @@
+"""Weibull distribution (parity:
+`python/mxnet/gluon/probability/distributions/weibull.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....random import next_key
+from . import constraint
+from .distribution import Distribution
+from .utils import _j, _w, gammaln, sample_n_shape_converter
+
+__all__ = ["Weibull"]
+
+_EULER = 0.5772156649015329
+
+
+class Weibull(Distribution):
+    has_grad = True
+    arg_constraints = {"concentration": constraint.positive,
+                       "scale": constraint.positive}
+    support = constraint.positive
+
+    def __init__(self, concentration, scale=1.0, validate_args=None):
+        self.concentration = _j(concentration)
+        self.scale = _j(scale)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    @property
+    def _batch(self):
+        return jnp.broadcast_shapes(jnp.shape(self.concentration),
+                                    jnp.shape(self.scale))
+
+    def sample(self, size=None):
+        shape = sample_n_shape_converter(size) + self._batch
+        dtype = jnp.result_type(self.concentration, self.scale, jnp.float32)
+        e = jax.random.exponential(next_key(), shape, dtype)
+        return _w(self.scale * e ** (1.0 / self.concentration))
+
+    def log_prob(self, value):
+        v = self._validate_sample(_j(value))
+        k, lam = self.concentration, self.scale
+        z = v / lam
+        return _w(jnp.log(k / lam) + (k - 1) * jnp.log(z) - z ** k)
+
+    def cdf(self, value):
+        z = _j(value) / self.scale
+        return _w(-jnp.expm1(-z ** self.concentration))
+
+    def icdf(self, value):
+        p = _j(value)
+        return _w(self.scale *
+                  (-jnp.log1p(-p)) ** (1.0 / self.concentration))
+
+    def _mean(self):
+        k = self.concentration
+        return jnp.broadcast_to(
+            self.scale * jnp.exp(gammaln(1 + 1.0 / k)), self._batch)
+
+    def _variance(self):
+        k = self.concentration
+        m1 = jnp.exp(gammaln(1 + 1.0 / k))
+        m2 = jnp.exp(gammaln(1 + 2.0 / k))
+        return jnp.broadcast_to(self.scale ** 2 * (m2 - m1 ** 2), self._batch)
+
+    def entropy(self):
+        k = self.concentration
+        return _w(jnp.broadcast_to(
+            _EULER * (1 - 1.0 / k) + jnp.log(self.scale / k) + 1, self._batch))

@@ -1,0 +1,9 @@
+"""`mx.gluon.probability.transformation` (parity:
+`python/mxnet/gluon/probability/transformation/__init__.py`)."""
+from . import transformation as _transformation_mod
+from . import domain_map as _domain_map_mod
+
+from .transformation import *  # noqa: F401,F403
+from .domain_map import *  # noqa: F401,F403
+
+__all__ = _transformation_mod.__all__ + _domain_map_mod.__all__

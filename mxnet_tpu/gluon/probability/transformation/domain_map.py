@@ -1,0 +1,92 @@
+"""Constraint -> transformation registry (parity:
+`python/mxnet/gluon/probability/transformation/domain_map.py`).
+
+`biject_to(constraint)` returns a bijection from the unconstrained reals onto
+the constrained domain; `transform_to` is the (possibly non-bijective)
+variant used for optimization re-parameterization.
+"""
+from __future__ import annotations
+
+from ..distributions import constraint as _c
+from .transformation import (AffineTransform, ComposeTransformation,
+                             ExpTransform, SigmoidTransform, SoftmaxTransform,
+                             Transformation)
+
+__all__ = ["biject_to", "transform_to", "domain_map"]
+
+
+class _IdentityTransform(Transformation):
+    def _forward_compute(self, x):
+        return x
+
+    def _inverse_compute(self, y):
+        return y
+
+    def _log_det_jacobian(self, x, y):
+        import jax.numpy as jnp
+        return jnp.zeros(jnp.shape(x))
+
+
+class domain_map:
+    """Registry dispatching on Constraint type."""
+
+    def __init__(self):
+        self._registry = {}
+
+    def register(self, constraint_cls, factory=None):
+        if factory is None:
+            def deco(f):
+                self._registry[constraint_cls] = f
+                return f
+            return deco
+        self._registry[constraint_cls] = factory
+        return factory
+
+    def __call__(self, constr):
+        for cls in type(constr).__mro__:
+            if cls in self._registry:
+                return self._registry[cls](constr)
+        raise NotImplementedError(
+            f"No transform registered for constraint {constr!r}")
+
+
+biject_to = domain_map()
+transform_to = domain_map()
+
+
+@biject_to.register(_c.Real)
+@transform_to.register(_c.Real)
+def _real(constr):
+    return _IdentityTransform()
+
+
+@biject_to.register(_c.GreaterThan)
+@transform_to.register(_c.GreaterThan)
+def _greater_than(constr):
+    parts = [ExpTransform()]
+    if getattr(constr, "lower_bound", 0.0) != 0.0:
+        parts.append(AffineTransform(constr.lower_bound, 1.0))
+    return parts[0] if len(parts) == 1 else ComposeTransformation(parts)
+
+
+@biject_to.register(_c.LessThan)
+@transform_to.register(_c.LessThan)
+def _less_than(constr):
+    return ComposeTransformation(
+        [ExpTransform(), AffineTransform(constr.upper_bound, -1.0)])
+
+
+@biject_to.register(_c.Interval)
+@transform_to.register(_c.Interval)
+def _interval(constr):
+    lo, hi = constr.lower_bound, constr.upper_bound
+    parts = [SigmoidTransform()]
+    if (lo, hi) != (0.0, 1.0):
+        parts.append(AffineTransform(lo, hi - lo))
+    return parts[0] if len(parts) == 1 else ComposeTransformation(parts)
+
+
+@biject_to.register(_c.Simplex)
+@transform_to.register(_c.Simplex)
+def _simplex(constr):
+    return SoftmaxTransform()

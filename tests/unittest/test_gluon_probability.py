@@ -1,0 +1,275 @@
+"""Tests for mx.gluon.probability (parity model:
+`tests/python/unittest/test_gluon_probability_v2.py` in the reference —
+densities validated against scipy.stats golden values)."""
+import numpy as onp
+import pytest
+import scipy.stats as ss
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import probability as mgp
+from mxnet_tpu.gluon.probability import transformation as T
+
+TOL = 2e-4
+
+
+def _close(a, ref, tol=TOL):
+    a = float(a.asnumpy()) if hasattr(a, "asnumpy") else float(a)
+    if onp.isnan(ref):
+        assert onp.isnan(a)
+        return
+    assert abs(a - ref) < tol * max(1.0, abs(ref)), (a, ref)
+
+
+@pytest.mark.parametrize("dist,scipy_dist,value", [
+    (mgp.Normal(1.0, 2.0), ss.norm(1, 2), 0.5),
+    (mgp.Laplace(1.0, 2.0), ss.laplace(1, 2), 0.5),
+    (mgp.Cauchy(1.0, 2.0), ss.cauchy(1, 2), 0.5),
+    (mgp.Gumbel(1.0, 2.0), ss.gumbel_r(1, 2), 0.5),
+    (mgp.Exponential(2.0), ss.expon(scale=2), 1.5),
+    (mgp.Uniform(-1.0, 3.0), ss.uniform(-1, 4), 0.5),
+])
+def test_loc_scale_family_logpdf_cdf_icdf_entropy(dist, scipy_dist, value):
+    _close(dist.log_prob(value), scipy_dist.logpdf(value))
+    _close(dist.cdf(value), scipy_dist.cdf(value))
+    _close(dist.icdf(0.3), scipy_dist.ppf(0.3), 1e-3)
+    _close(dist.entropy(), scipy_dist.entropy())
+    _close(dist.mean, scipy_dist.mean())
+    _close(dist.variance, scipy_dist.var())
+
+
+@pytest.mark.parametrize("dist,scipy_dist,value", [
+    (mgp.Gamma(3.0, 2.0), ss.gamma(3, scale=2), 2.5),
+    (mgp.Chi2(4.0), ss.chi2(4), 3.0),
+    (mgp.Beta(2.0, 3.0), ss.beta(2, 3), 0.4),
+    (mgp.StudentT(5.0, 1.0, 2.0), ss.t(5, 1, 2), 0.3),
+    (mgp.FisherSnedecor(4.0, 6.0), ss.f(4, 6), 1.5),
+    (mgp.Weibull(2.0, 3.0), ss.weibull_min(2, scale=3), 2.0),
+    (mgp.Pareto(3.0, 2.0), ss.pareto(3, scale=2), 4.0),
+    (mgp.HalfNormal(2.0), ss.halfnorm(scale=2), 1.0),
+    (mgp.HalfCauchy(2.0), ss.halfcauchy(scale=2), 1.0),
+])
+def test_positive_family_logpdf(dist, scipy_dist, value):
+    _close(dist.log_prob(value), scipy_dist.logpdf(value))
+
+
+def test_entropy_analytic_and_exp_family():
+    _close(mgp.Gamma(3.0, 2.0).entropy(), ss.gamma.entropy(3, scale=2))
+    _close(mgp.Beta(2.0, 3.0).entropy(), ss.beta.entropy(2, 3))
+    _close(mgp.Dirichlet(onp.array([2., 3., 4.])).entropy(),
+           ss.dirichlet.entropy([2, 3, 4]))
+    _close(mgp.Bernoulli(prob=0.3).entropy(), ss.bernoulli.entropy(0.3))
+    _close(mgp.Exponential(2.0).entropy(), ss.expon.entropy(scale=2))
+
+
+@pytest.mark.parametrize("dist,scipy_logpmf,value", [
+    (mgp.Poisson(3.5), lambda v: ss.poisson.logpmf(v, 3.5), 2.0),
+    (mgp.Bernoulli(prob=0.3), lambda v: ss.bernoulli.logpmf(v, 0.3), 1.0),
+    (mgp.Binomial(10, 0.3), lambda v: ss.binom.logpmf(v, 10, 0.3), 4.0),
+    (mgp.Geometric(0.3), lambda v: ss.geom.logpmf(v + 1, 0.3), 2.0),
+    (mgp.NegativeBinomial(5, prob=0.4),
+     lambda v: ss.nbinom.logpmf(v, 5, 0.4), 3.0),
+])
+def test_discrete_logpmf(dist, scipy_logpmf, value):
+    _close(dist.log_prob(value), scipy_logpmf(value))
+
+
+def test_sampling_moments():
+    mx.random.seed(7)
+    for dist, mean, std in [
+        (mgp.Normal(1.0, 2.0), 1.0, 2.0),
+        (mgp.Gamma(3.0, 2.0), 6.0, onp.sqrt(12)),
+        (mgp.Poisson(4.0), 4.0, 2.0),
+        (mgp.Bernoulli(prob=0.3), 0.3, onp.sqrt(0.21)),
+        (mgp.Uniform(0.0, 2.0), 1.0, onp.sqrt(1 / 3)),
+    ]:
+        x = dist.sample((4000,)).asnumpy()
+        assert abs(x.mean() - mean) < 0.15 * max(1, abs(mean))
+        assert abs(x.std() - std) < 0.15 * max(1, std)
+
+
+def test_sample_shapes_and_batch():
+    d = mgp.Normal(onp.zeros((3, 2)), onp.ones((3, 2)))
+    assert d.sample().shape == (3, 2)
+    assert d.sample((5,)).shape == (5, 3, 2)
+    assert d.sample_n(5).shape == (5, 3, 2)
+    assert d.log_prob(onp.zeros((3, 2))).shape == (3, 2)
+    b = d.broadcast_to((4, 3, 2))
+    assert b.sample().shape == (4, 3, 2)
+
+
+def test_multivariate_normal():
+    cov = onp.eye(3) * 2 + 0.5 * (onp.ones((3, 3)) - onp.eye(3))
+    mvn = mgp.MultivariateNormal(onp.zeros(3), cov=cov)
+    v = onp.array([0.3, -0.2, 0.7])
+    _close(mvn.log_prob(v), ss.multivariate_normal.logpdf(v, onp.zeros(3), cov),
+           1e-3)
+    _close(mvn.entropy(), ss.multivariate_normal.entropy(onp.zeros(3), cov),
+           1e-3)
+    assert mvn.sample((4,)).shape == (4, 3)
+    # scale_tril / precision parameterizations agree
+    L = onp.linalg.cholesky(cov)
+    _close(mgp.MultivariateNormal(onp.zeros(3), scale_tril=L).log_prob(v),
+           float(mvn.log_prob(v).asnumpy()), 1e-3)
+    _close(mgp.MultivariateNormal(
+        onp.zeros(3), precision=onp.linalg.inv(cov)).log_prob(v),
+        float(mvn.log_prob(v).asnumpy()), 1e-2)
+
+
+def test_categorical_family():
+    p = onp.array([0.2, 0.3, 0.5])
+    cat = mgp.Categorical(3, prob=p)
+    _close(cat.log_prob(2.0), onp.log(0.5))
+    assert cat.enumerate_support().shape == (3,)
+    assert cat.sample((9,)).shape == (9,)
+    oh = mgp.OneHotCategorical(3, prob=p)
+    assert oh.sample((7,)).shape == (7, 3)
+    _close(oh.log_prob(onp.array([0., 0., 1.])), onp.log(0.5))
+    mu = mgp.Multinomial(3, prob=p, total_count=10)
+    assert float(mu.sample((6,)).asnumpy().sum(-1).mean()) == 10.0
+    _close(mu.log_prob(onp.array([2., 3., 5.])),
+           ss.multinomial.logpmf([2, 3, 5], 10, p), 1e-3)
+    d = mgp.Dirichlet(onp.array([2., 3., 4.]))
+    _close(d.log_prob(onp.array([0.2, 0.3, 0.5])),
+           ss.dirichlet.logpdf([0.2, 0.3, 0.5], [2, 3, 4]), 1e-3)
+    s = d.sample((5,))
+    assert onp.allclose(s.asnumpy().sum(-1), 1.0, atol=1e-5)
+
+
+def test_relaxed_distributions_reparameterized():
+    mx.random.seed(3)
+    rb = mgp.RelaxedBernoulli(0.5, prob=0.3)
+    x = rb.sample((100,)).asnumpy()
+    assert ((x > 0) & (x < 1)).all()
+    rc = mgp.RelaxedOneHotCategorical(0.5, 3, prob=onp.array([0.2, 0.3, 0.5]))
+    s = rc.sample((50,))
+    assert onp.allclose(s.asnumpy().sum(-1), 1.0, atol=1e-5)
+    assert onp.isfinite(rc.log_prob(s).asnumpy()).all()
+
+
+def test_kl_divergence_registry():
+    ref_kl = onp.log(2) + 2 / 8 - 0.5
+    _close(mgp.kl_divergence(mgp.Normal(0., 1.), mgp.Normal(1., 2.)), ref_kl)
+    # empirical KL agrees with analytic for a nontrivial pair
+    mx.random.seed(11)
+    kl = float(mgp.kl_divergence(mgp.Gamma(2., 3.), mgp.Gamma(3., 2.))
+               .asnumpy())
+    ekl = float(mgp.empirical_kl(mgp.Gamma(2., 3.), mgp.Gamma(3., 2.),
+                                 8000).asnumpy())
+    assert abs(kl - ekl) < 0.1
+    # batched KL through Independent
+    kl3 = mgp.kl_divergence(
+        mgp.Independent(mgp.Normal(onp.zeros((4, 3)), onp.ones((4, 3))), 1),
+        mgp.Independent(mgp.Normal(onp.ones((4, 3)), onp.ones((4, 3))), 1))
+    assert kl3.shape == (4,)
+    assert onp.allclose(kl3.asnumpy(), 1.5, atol=1e-5)
+    with pytest.raises(mx.MXNetError):
+        mgp.kl_divergence(mgp.Normal(0., 1.), mgp.Poisson(1.0))
+
+
+def test_transformed_distribution_lognormal():
+    ln = mgp.TransformedDistribution(mgp.Normal(0.5, 0.8), T.ExpTransform())
+    _close(ln.log_prob(2.0),
+           ss.lognorm.logpdf(2.0, 0.8, scale=onp.exp(0.5)))
+    _close(ln.cdf(2.0), ss.lognorm.cdf(2.0, 0.8, scale=onp.exp(0.5)))
+    _close(ln.icdf(0.3), ss.lognorm.ppf(0.3, 0.8, scale=onp.exp(0.5)), 1e-3)
+
+
+def test_transformations_roundtrip():
+    x = mx.np.array([-1.5, 0.3, 2.0])
+    for t in [T.ExpTransform(), T.AffineTransform(2.0, 3.0),
+              T.SigmoidTransform()]:
+        y = t(x)
+        xb = t.inv(y)
+        assert onp.allclose(x.asnumpy(), xb.asnumpy(), atol=1e-5)
+        ldj = t.log_det_jacobian(x, y).asnumpy()
+        assert onp.isfinite(ldj).all()
+
+
+def test_biject_to_domain_map():
+    from mxnet_tpu.gluon.probability.distributions import constraint as C
+    x = mx.np.array(-2.0)
+    assert float(T.biject_to(C.positive)(x).asnumpy()) > 0
+    y = T.biject_to(C.Interval(2.0, 5.0))(x)
+    assert 2.0 < float(y.asnumpy()) < 5.0
+    v = T.biject_to(C.simplex)(mx.np.array([0.3, -1.0, 2.0]))
+    assert abs(float(v.asnumpy().sum()) - 1) < 1e-5
+
+
+def test_constraint_validation_raises():
+    with pytest.raises(mx.MXNetError):
+        mgp.Normal(0.0, -1.0, validate_args=True)
+    with pytest.raises(mx.MXNetError):
+        mgp.Gamma(-1.0, 1.0, validate_args=True)
+    d = mgp.Uniform(0.0, 1.0, validate_args=True)
+    with pytest.raises(mx.MXNetError):
+        d.log_prob(2.0)
+
+
+def test_sampling_gradients_reparameterized():
+    import jax
+    import jax.numpy as jnp
+
+    def loss(mu):
+        # E[x^2] for x ~ N(mu, 1): gradient should be 2*mu
+        mx.random.seed(0)
+        d = mgp.Normal(mu, 1.0)
+        x = d.sample((2000,))
+        from mxnet_tpu.ndarray.ndarray import as_jax
+        return jnp.mean(as_jax(x) ** 2)
+
+    g = jax.grad(lambda mu: loss(mu))(1.0)
+    assert abs(float(g) - 2.0) < 0.2
+
+
+def test_stochastic_block_collects_losses():
+    from mxnet_tpu.gluon.probability import StochasticBlock, StochasticSequential
+    from mxnet_tpu.gluon import nn
+
+    class VAEBlock(StochasticBlock):
+        def __init__(self):
+            super().__init__()
+            self.dense = nn.Dense(4, in_units=4)
+
+        def forward(self, x):
+            h = self.dense(x)
+            self.add_loss((h * h).sum())
+            return h
+
+    blk = VAEBlock()
+    blk.initialize()
+    out = blk(mx.np.ones((2, 4)))
+    assert len(blk.losses) == 1
+    seq = StochasticSequential()
+    b1, b2 = VAEBlock(), VAEBlock()
+    seq.add(b1, b2)
+    seq.initialize()
+    seq(mx.np.ones((2, 4)))
+    assert len(seq.losses) == 2
+
+
+def test_exp_family_bregman_entropy_matches_analytic():
+    from mxnet_tpu.gluon.probability.distributions.exp_family import (
+        ExponentialFamily)
+    for d, ref in [
+        (mgp.Normal(1.0, 2.0), ss.norm.entropy(1, 2)),
+        (mgp.Exponential(2.0), ss.expon.entropy(scale=2)),
+        (mgp.Bernoulli(prob=0.3), ss.bernoulli.entropy(0.3)),
+    ]:
+        _close(ExponentialFamily.entropy(d), ref, 1e-3)
+
+
+def test_poisson_entropy_series():
+    for lam in [0.5, 1.0, 3.5, 10.0]:
+        _close(mgp.Poisson(lam).entropy(), ss.poisson.entropy(lam), 1e-3)
+
+
+def test_kl_exponential_exponential():
+    # KL(Exp(scale=1) || Exp(scale=2)) = log 2 + 1/2 - 1
+    _close(mgp.kl_divergence(mgp.Exponential(1.0), mgp.Exponential(2.0)),
+           onp.log(2) + 0.5 - 1)
+    mx.random.seed(5)
+    kl = float(mgp.kl_divergence(mgp.Exponential(2.0),
+                                 mgp.Exponential(0.5)).asnumpy())
+    ekl = float(mgp.empirical_kl(mgp.Exponential(2.0), mgp.Exponential(0.5),
+                                 8000).asnumpy())
+    assert abs(kl - ekl) < 0.1
