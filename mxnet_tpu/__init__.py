@@ -51,5 +51,6 @@ from . import image  # noqa: F401
 from . import ops  # noqa: F401
 from . import models  # noqa: F401
 from . import operator  # noqa: F401
+from . import contrib  # noqa: F401
 
 device_module = device

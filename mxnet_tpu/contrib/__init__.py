@@ -1,0 +1,15 @@
+"""`mx.contrib` — contrib operator namespace + quantization workflow.
+
+Parity: `src/operator/contrib/` (bounding_box.cc, boolean_mask.cc,
+allclose_op.cc, index_copy.cc, index_array.cc, roi_align.cc, fft.cc,
+bilinear_resize.cc, adaptive_avg_pooling.cc, multibox_prior.cc,
+gradient_multiplier_op.cc, quadratic_op.cc) and
+`python/mxnet/contrib/quantization.py`.
+
+Graph/sparse-only contrib ops (`dgl_*`, `getnnz`, `edge_id`) are out of
+scope on TPU — see SURVEY.md §7 "Sparse".
+"""
+from . import op  # noqa: F401
+from . import op as nd  # noqa: F401  (reference spelling: mx.nd.contrib)
+from .op import *  # noqa: F401,F403
+from . import quantization  # noqa: F401

@@ -1,0 +1,481 @@
+"""Contrib operators, TPU-native (jnp/lax; fixed shapes wherever possible).
+
+Parity notes (reference files under `/root/reference/`):
+- box_iou/box_nms/box_encode/box_decode/bipartite_matching:
+  `src/operator/contrib/bounding_box-inl.h:47-1030`
+- boolean_mask: `src/operator/contrib/boolean_mask.cc`
+- allclose: `src/operator/contrib/allclose_op-inl.h`
+- index_copy / index_array: `src/operator/contrib/index_copy.cc`,
+  `index_array.cc`
+- ROIAlign: `src/operator/contrib/roi_align.cc`
+- fft/ifft: `src/operator/contrib/fft-inl.h` (interleaved real/imag layout)
+- BilinearResize2D / AdaptiveAvgPooling2D: `bilinear_resize.cc`,
+  `adaptive_avg_pooling.cc`
+- MultiBoxPrior: `src/operator/contrib/multibox_prior.cc`
+- gradient multiplier: `gradient_multiplier_op.cc`
+- quadratic: `quadratic_op.cc` (the tutorial op)
+
+The NMS here is a fixed-shape `lax.fori_loop` suppression sweep (jittable,
+no data-dependent shapes), unlike the reference's workspace-sort CUDA
+kernel — scores are sorted once, then an O(N) masked sweep suppresses
+overlaps, which XLA vectorizes across the box axis.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as _onp
+from jax import lax
+
+from ..ndarray.ndarray import apply_op, from_jax
+
+__all__ = [
+    "quadratic", "allclose", "index_copy", "index_array", "boolean_mask",
+    "box_iou", "box_nms", "box_decode", "box_encode", "bipartite_matching",
+    "ROIAlign", "roi_align", "fft", "ifft", "BilinearResize2D",
+    "AdaptiveAvgPooling2D", "MultiBoxPrior", "gradient_multiplier",
+    "dynamic_reshape", "batch_norm_with_relu",
+]
+
+
+def _corner_to_center(boxes):
+    xmin, ymin, xmax, ymax = jnp.split(boxes, 4, axis=-1)
+    return jnp.concatenate([(xmin + xmax) / 2, (ymin + ymax) / 2,
+                            xmax - xmin, ymax - ymin], axis=-1)
+
+
+def _center_to_corner(boxes):
+    x, y, w, h = jnp.split(boxes, 4, axis=-1)
+    return jnp.concatenate([x - w / 2, y - h / 2, x + w / 2, y + h / 2],
+                           axis=-1)
+
+
+def quadratic(data, a=0.0, b=0.0, c=0.0):
+    """a*x^2 + b*x + c (the reference's tutorial op, `quadratic_op.cc`)."""
+    return apply_op(lambda x: a * x * x + b * x + c, (data,), {},
+                    name="quadratic")
+
+
+def allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    """Scalar 1/0 like `_contrib_allclose`."""
+    return apply_op(
+        lambda x, y: jnp.allclose(x, y, rtol=rtol, atol=atol,
+                                  equal_nan=equal_nan).astype(jnp.int32),
+        (a, b), {}, name="allclose")
+
+
+def index_copy(old_tensor, index_vector, new_tensor):
+    """Copy rows of `new_tensor` into `old_tensor` at `index_vector`."""
+    def fn(old, idx, new):
+        return old.at[idx.astype(jnp.int32)].set(new)
+    return apply_op(fn, (old_tensor, index_vector, new_tensor), {},
+                    name="index_copy")
+
+
+def index_array(data, axes: Optional[Sequence[int]] = None):
+    """Grid of element indices: output shape `data.shape + (len(axes),)`."""
+    shape = data.shape
+    ax = list(axes) if axes is not None else list(range(len(shape)))
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in shape], indexing="ij")
+    out = jnp.stack([grids[a] for a in ax], axis=-1).astype(jnp.int64
+                    if jax.config.jax_enable_x64 else jnp.int32)
+    return from_jax(out, data._device)
+
+
+def boolean_mask(data, index, axis=0):
+    """Select slices where `index` is nonzero. Data-dependent output shape —
+    eager-only (the reference's `Invoke` also syncs for this op,
+    `src/imperative/imperative.cc:128-135`); inside `jit` use `jnp.where`
+    masking instead."""
+    idx = _onp.asarray(index.asnumpy()).astype(bool)
+    keep = _onp.nonzero(idx)[0]
+
+    def fn(x):
+        return jnp.take(x, jnp.asarray(keep), axis=axis)
+    return apply_op(fn, (data,), {}, name="boolean_mask")
+
+
+def box_iou(lhs, rhs, format="corner"):
+    """Pairwise IoU between two box sets; output shape lhs[:-1] + rhs[:-1]."""
+    def fn(a, b):
+        if format == "center":
+            a = _center_to_corner(a)
+            b = _center_to_corner(b)
+        a_shape, b_shape = a.shape[:-1], b.shape[:-1]
+        a2 = a.reshape((-1, 4))
+        b2 = b.reshape((-1, 4))
+        tl = jnp.maximum(a2[:, None, :2], b2[None, :, :2])
+        br = jnp.minimum(a2[:, None, 2:], b2[None, :, 2:])
+        wh = jnp.clip(br - tl, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        area_a = (a2[:, 2] - a2[:, 0]) * (a2[:, 3] - a2[:, 1])
+        area_b = (b2[:, 2] - b2[:, 0]) * (b2[:, 3] - b2[:, 1])
+        union = area_a[:, None] + area_b[None, :] - inter
+        iou = jnp.where(union > 0, inter / jnp.maximum(union, 1e-12), 0.0)
+        return iou.reshape(a_shape + b_shape)
+    return apply_op(fn, (lhs, rhs), {}, name="box_iou")
+
+
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, background_id=-1,
+            force_suppress=False, in_format="corner", out_format="corner"):
+    """Non-maximum suppression. Input `(..., N, K)` with scores/ids/coords at
+    the given columns; output is score-sorted with suppressed/invalid rows
+    filled with -1 (reference semantics, `bounding_box-inl.h:47-96`)."""
+    def fn(x):
+        shape = x.shape
+        n = shape[-2]
+        flat = x.reshape((-1, n, shape[-1]))
+
+        def one_batch(batch):
+            scores = batch[:, score_index]
+            valid = scores > valid_thresh
+            if id_index >= 0 and background_id >= 0:
+                valid &= batch[:, id_index] != background_id
+            order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
+            sorted_boxes = batch[order]
+            sorted_valid = valid[order]
+            if topk > 0:
+                sorted_valid &= jnp.arange(n) < topk
+            coords = lax.dynamic_slice_in_dim(sorted_boxes, coord_start, 4,
+                                              axis=1)
+            if in_format == "center":
+                coords = _center_to_corner(coords)
+            tl = jnp.maximum(coords[:, None, :2], coords[None, :, :2])
+            br = jnp.minimum(coords[:, None, 2:], coords[None, :, 2:])
+            wh = jnp.clip(br - tl, 0)
+            inter = wh[..., 0] * wh[..., 1]
+            area = (coords[:, 2] - coords[:, 0]) * (coords[:, 3] - coords[:, 1])
+            union = area[:, None] + area[None, :] - inter
+            iou = jnp.where(union > 0, inter / jnp.maximum(union, 1e-12), 0.0)
+            same_class = jnp.ones((n, n), dtype=bool)
+            if id_index >= 0 and not force_suppress:
+                ids = sorted_boxes[:, id_index]
+                same_class = ids[:, None] == ids[None, :]
+            suppress_mat = (iou > overlap_thresh) & same_class
+
+            def body(i, keep):
+                keep_i = keep[i]
+                later = jnp.arange(n) > i
+                kill = suppress_mat[i] & later & keep_i
+                return keep & ~kill
+
+            keep = lax.fori_loop(0, n, body, sorted_valid)
+            out = jnp.where(keep[:, None], sorted_boxes, -jnp.ones_like(sorted_boxes))
+            if out_format != in_format:
+                c = lax.dynamic_slice_in_dim(out, coord_start, 4, axis=1)
+                conv = _center_to_corner(c) if in_format == "center" \
+                    else _corner_to_center(c)
+                conv = jnp.where(keep[:, None], conv, -1.0)
+                out = lax.dynamic_update_slice_in_dim(out, conv, coord_start,
+                                                      axis=1)
+            return out
+
+        out = jax.vmap(one_batch)(flat)
+        return out.reshape(shape)
+    return apply_op(fn, (data,), {}, name="box_nms")
+
+
+def box_decode(data, anchors, std0=1.0, std1=1.0, std2=1.0, std3=1.0,
+               clip=-1.0, format="corner"):
+    """Decode (dx,dy,dw,dh)*std deltas against center-format anchors
+    (`bounding_box-inl.h:1016-1030`)."""
+    def fn(d, a):
+        if format == "corner":
+            a = _corner_to_center(a)
+        ax, ay, aw, ah = jnp.split(a, 4, axis=-1)
+        dx = d[..., 0:1] * std0
+        dy = d[..., 1:2] * std1
+        dw = d[..., 2:3] * std2
+        dh = d[..., 3:4] * std3
+        if clip > 0:
+            dw = jnp.minimum(dw, clip)
+            dh = jnp.minimum(dh, clip)
+        cx = dx * aw + ax
+        cy = dy * ah + ay
+        w = jnp.exp(dw) * aw
+        h = jnp.exp(dh) * ah
+        out = jnp.concatenate([cx, cy, w, h], axis=-1)
+        return _center_to_corner(out) if format == "corner" else out
+    return apply_op(fn, (data, anchors), {}, name="box_decode")
+
+
+def box_encode(refs, anchors, means=(0.0, 0.0, 0.0, 0.0),
+               stds=(0.1, 0.1, 0.2, 0.2)):
+    """Encode corner-format ground-truth boxes against corner anchors into
+    normalized (dx,dy,dw,dh) deltas (inverse of `box_decode`)."""
+    means = tuple(means)
+    stds = tuple(stds)
+
+    def fn(g, a):
+        g = _corner_to_center(g)
+        a = _corner_to_center(a)
+        gx, gy, gw, gh = jnp.split(g, 4, axis=-1)
+        ax, ay, aw, ah = jnp.split(a, 4, axis=-1)
+        dx = ((gx - ax) / jnp.maximum(aw, 1e-12) - means[0]) / stds[0]
+        dy = ((gy - ay) / jnp.maximum(ah, 1e-12) - means[1]) / stds[1]
+        dw = (jnp.log(jnp.maximum(gw, 1e-12) / jnp.maximum(aw, 1e-12))
+              - means[2]) / stds[2]
+        dh = (jnp.log(jnp.maximum(gh, 1e-12) / jnp.maximum(ah, 1e-12))
+              - means[3]) / stds[3]
+        return jnp.concatenate([dx, dy, dw, dh], axis=-1)
+    return apply_op(fn, (refs, anchors), {}, name="box_encode")
+
+
+def bipartite_matching(data, threshold, is_ascend=False, topk=-1):
+    """Greedy bipartite matching over a score matrix `(..., M, N)`.
+    Returns (row_assignments `(..., M)`, col_assignments `(..., N)`), -1 for
+    unmatched (`bounding_box-inl.h:703-720`)."""
+    def fn(x):
+        shape = x.shape
+        m, n = shape[-2], shape[-1]
+        flat = x.reshape((-1, m, n))
+        k = m if topk <= 0 else min(topk, m)
+
+        def one(mat):
+            score = -mat if is_ascend else mat
+            init = (jnp.full((m,), -1, jnp.int32),
+                    jnp.full((n,), -1, jnp.int32), score)
+
+            def body(_, carry):
+                rows, cols, s = carry
+                idx = jnp.argmax(s)
+                i, j = idx // n, idx % n
+                best = s[i, j]
+                ok = best > (-threshold if is_ascend else threshold)
+                rows = jnp.where(ok, rows.at[i].set(j), rows)
+                cols = jnp.where(ok, cols.at[j].set(i), cols)
+                s = jnp.where(ok, s.at[i, :].set(-jnp.inf).at[:, j]
+                              .set(-jnp.inf), s)
+                return rows, cols, s
+
+            rows, cols, _ = lax.fori_loop(0, k, body, init)
+            return rows, cols
+
+        rows, cols = jax.vmap(one)(flat)
+        return (rows.reshape(shape[:-1]).astype(jnp.float32),
+                cols.reshape(shape[:-2] + (n,)).astype(jnp.float32))
+    return apply_op(fn, (data,), {}, name="bipartite_matching", n_out=2)
+
+
+def roi_align(data, rois, pooled_size, spatial_scale=1.0, sample_ratio=-1,
+              position_sensitive=False, aligned=False):
+    """ROI Align over NCHW features; `rois` is `(R, 5)` as
+    `[batch_idx, x1, y1, x2, y2]` (`src/operator/contrib/roi_align.cc`)."""
+    ph, pw = (pooled_size if isinstance(pooled_size, (tuple, list))
+              else (pooled_size, pooled_size))
+
+    def fn(x, r):
+        N, C, H, W = x.shape
+        offset = 0.5 if aligned else 0.0
+        sr = sample_ratio if sample_ratio > 0 else 2
+
+        def one_roi(roi):
+            bidx = roi[0].astype(jnp.int32)
+            x1, y1, x2, y2 = roi[1], roi[2], roi[3], roi[4]
+            x1 = x1 * spatial_scale - offset
+            y1 = y1 * spatial_scale - offset
+            x2 = x2 * spatial_scale - offset
+            y2 = y2 * spatial_scale - offset
+            rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+            rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+            bin_w = rw / pw
+            bin_h = rh / ph
+            # sample grid: (ph, pw, sr, sr)
+            iy = jnp.arange(ph)[:, None] * bin_h + \
+                (jnp.arange(sr) + 0.5)[None, :] * (bin_h / sr) + y1
+            ix = jnp.arange(pw)[:, None] * bin_w + \
+                (jnp.arange(sr) + 0.5)[None, :] * (bin_w / sr) + x1
+
+            def bilinear(feat, yy, xx):
+                y0 = jnp.clip(jnp.floor(yy), 0, H - 1)
+                x0 = jnp.clip(jnp.floor(xx), 0, W - 1)
+                y1i = jnp.clip(y0 + 1, 0, H - 1)
+                x1i = jnp.clip(x0 + 1, 0, W - 1)
+                wy = jnp.clip(yy, 0, H - 1) - y0
+                wx = jnp.clip(xx, 0, W - 1) - x0
+                y0, x0, y1i, x1i = (a.astype(jnp.int32)
+                                    for a in (y0, x0, y1i, x1i))
+                v00 = feat[:, y0, :][:, :, x0]
+                v01 = feat[:, y0, :][:, :, x1i]
+                v10 = feat[:, y1i, :][:, :, x0]
+                v11 = feat[:, y1i, :][:, :, x1i]
+                return (v00 * (1 - wy)[None, :, None] * (1 - wx)[None, None, :]
+                        + v01 * (1 - wy)[None, :, None] * wx[None, None, :]
+                        + v10 * wy[None, :, None] * (1 - wx)[None, None, :]
+                        + v11 * wy[None, :, None] * wx[None, None, :])
+
+            feat = x[bidx]                          # (C, H, W)
+            ys = iy.reshape(-1)                     # (ph*sr,)
+            xs = ix.reshape(-1)                     # (pw*sr,)
+            sampled = bilinear(feat, ys, xs)        # (C, ph*sr, pw*sr)
+            sampled = sampled.reshape(C, ph, sr, pw, sr)
+            binmean = sampled.mean(axis=(2, 4))     # (C, ph, pw)
+            if position_sensitive:
+                # R-FCN PSROIAlign: C = outC*ph*pw; bin (i,j) reads its own
+                # channel group (`deformable_psroi_pooling-inl.h` semantics)
+                out_c = C // (ph * pw)
+                grouped = binmean.reshape(out_c, ph, pw, ph, pw)
+                ci, ii, jj = jnp.meshgrid(jnp.arange(out_c), jnp.arange(ph),
+                                          jnp.arange(pw), indexing="ij")
+                return grouped[ci, ii, jj, ii, jj]  # (outC, ph, pw)
+            return binmean
+
+        if position_sensitive and x.shape[1] % (ph * pw) != 0:
+            raise ValueError("position_sensitive roi_align needs channels "
+                             "divisible by pooled_h*pooled_w")
+        return jax.vmap(one_roi)(r)
+    return apply_op(fn, (data, rois), {}, name="roi_align")
+
+
+ROIAlign = roi_align
+
+
+def fft(data, compute_size=128):
+    """FFT along the last axis; output interleaves real/imag → last dim
+    doubles (`fft-inl.h` layout)."""
+    def fn(x):
+        c = jnp.fft.fft(x.astype(jnp.complex64), axis=-1)
+        return jnp.stack([c.real, c.imag], axis=-1).reshape(
+            x.shape[:-1] + (2 * x.shape[-1],)).astype(x.dtype)
+    return apply_op(fn, (data,), {}, name="fft")
+
+
+def ifft(data, compute_size=128):
+    """Inverse of `fft`: input `(..., 2*d)` interleaved → real `(..., d)`."""
+    def fn(x):
+        d = x.shape[-1] // 2
+        pairs = x.reshape(x.shape[:-1] + (d, 2))
+        c = pairs[..., 0] + 1j * pairs[..., 1]
+        return jnp.fft.ifft(c, axis=-1).real.astype(x.dtype) * d
+    return apply_op(fn, (data,), {}, name="ifft")
+
+
+def BilinearResize2D(data, height=None, width=None, scale_height=None,
+                     scale_width=None, mode="size", align_corners=True):
+    """Bilinear up/down-sampling of NCHW input (`bilinear_resize.cc`;
+    the reference kernel uses align-corners sampling)."""
+    def fn(x):
+        N, C, H, W = x.shape
+        h = int(height) if height else int(round(H * (scale_height or 1.0)))
+        w = int(width) if width else int(round(W * (scale_width or 1.0)))
+        if align_corners and h > 1 and w > 1:
+            ys = jnp.linspace(0.0, H - 1.0, h)
+            xs = jnp.linspace(0.0, W - 1.0, w)
+        else:
+            ys = (jnp.arange(h) + 0.5) * H / h - 0.5
+            xs = (jnp.arange(w) + 0.5) * W / w - 0.5
+        y0 = jnp.clip(jnp.floor(ys), 0, H - 1)
+        x0 = jnp.clip(jnp.floor(xs), 0, W - 1)
+        y1 = jnp.clip(y0 + 1, 0, H - 1)
+        x1 = jnp.clip(x0 + 1, 0, W - 1)
+        wy = jnp.clip(ys, 0, H - 1) - y0
+        wx = jnp.clip(xs, 0, W - 1) - x0
+        y0i, y1i = y0.astype(jnp.int32), y1.astype(jnp.int32)
+        x0i, x1i = x0.astype(jnp.int32), x1.astype(jnp.int32)
+        top = x[:, :, y0i, :]
+        bot = x[:, :, y1i, :]
+        v00, v01 = top[..., x0i], top[..., x1i]
+        v10, v11 = bot[..., x0i], bot[..., x1i]
+        wy_ = wy[None, None, :, None]
+        wx_ = wx[None, None, None, :]
+        return (v00 * (1 - wy_) * (1 - wx_) + v01 * (1 - wy_) * wx_
+                + v10 * wy_ * (1 - wx_) + v11 * wy_ * wx_)
+    return apply_op(fn, (data,), {}, name="bilinear_resize_2d")
+
+
+def AdaptiveAvgPooling2D(data, output_size=1):
+    """Adaptive average pooling to `output_size` (NCHW), exact bin averages
+    like the reference (`adaptive_avg_pooling.cc`)."""
+    oh, ow = (output_size if isinstance(output_size, (tuple, list))
+              else (output_size, output_size))
+
+    def fn(x):
+        N, C, H, W = x.shape
+        rows = []
+        for i in range(oh):
+            h0, h1 = (i * H) // oh, -(-((i + 1) * H) // oh)
+            cols = []
+            for j in range(ow):
+                w0, w1 = (j * W) // ow, -(-((j + 1) * W) // ow)
+                cols.append(x[:, :, h0:h1, w0:w1].mean(axis=(2, 3)))
+            rows.append(jnp.stack(cols, axis=-1))
+        return jnp.stack(rows, axis=-2)
+    return apply_op(fn, (data,), {}, name="adaptive_avg_pooling_2d")
+
+
+def MultiBoxPrior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                  steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """SSD anchor generation over the NCHW feature map grid
+    (`multibox_prior.cc`): per cell, `len(sizes)+len(ratios)-1` anchors;
+    output `(1, H*W*A, 4)` corner boxes in [0,1] coords."""
+    sizes = tuple(sizes)
+    ratios = tuple(ratios)
+
+    def fn(x):
+        H, W = x.shape[2], x.shape[3]
+        step_y = steps[0] if steps[0] > 0 else 1.0 / H
+        step_x = steps[1] if steps[1] > 0 else 1.0 / W
+        cy = (jnp.arange(H) + offsets[0]) * step_y
+        cx = (jnp.arange(W) + offsets[1]) * step_x
+        wh = []
+        for s in sizes:
+            wh.append((s * _onp.sqrt(ratios[0]), s / _onp.sqrt(ratios[0])))
+        for r in ratios[1:]:
+            wh.append((sizes[0] * _onp.sqrt(r), sizes[0] / _onp.sqrt(r)))
+        wh = jnp.asarray(wh)                       # (A, 2)
+        cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")
+        centers = jnp.stack([cxg, cyg], axis=-1).reshape(-1, 1, 2)
+        half = wh[None, :, :] / 2
+        tl = centers - half
+        br = centers + half
+        boxes = jnp.concatenate([tl, br], axis=-1).reshape(1, -1, 4)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        return boxes.astype(x.dtype)
+    return apply_op(fn, (data,), {}, name="multibox_prior")
+
+
+@jax.custom_vjp
+def _grad_mult(x, scalar):
+    return x
+
+
+def _grad_mult_fwd(x, scalar):
+    return x, scalar
+
+
+def _grad_mult_bwd(scalar, g):
+    return (g * scalar, None)
+
+
+_grad_mult.defvjp(_grad_mult_fwd, _grad_mult_bwd)
+
+
+def gradient_multiplier(data, scalar=1.0):
+    """Identity forward, gradient scaled by `scalar` on backward
+    (`gradient_multiplier_op.cc` — gradient-reversal when scalar < 0)."""
+    return apply_op(lambda x: _grad_mult(x, scalar), (data,), {},
+                    name="gradient_multiplier")
+
+
+def dynamic_reshape(data, shape_like):
+    """Reshape `data` to the values held in `shape_like` (eager-only;
+    `dynamic_shape_ops.cc`)."""
+    target = tuple(int(v) for v in shape_like.asnumpy().ravel())
+    return apply_op(lambda x: jnp.reshape(x, target), (data,), {},
+                    name="dynamic_reshape")
+
+
+def batch_norm_with_relu(x, gamma_, beta, running_mean, running_var,
+                         eps=1e-5, momentum=0.9, fix_gamma=False, axis=1,
+                         use_global_stats=False):
+    """Fused BN+ReLU (`batch_norm_relu.cc`); XLA fuses the relu into the
+    normalization epilogue."""
+    from ..numpy_extension import batch_norm, relu as _relu
+    out = batch_norm(x, gamma_, beta, running_mean, running_var, eps=eps,
+                     momentum=momentum, fix_gamma=fix_gamma, axis=axis,
+                     use_global_stats=use_global_stats)
+    return _relu(out)

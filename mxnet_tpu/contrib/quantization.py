@@ -1,0 +1,316 @@
+"""INT8 quantization workflow (parity:
+`python/mxnet/contrib/quantization.py:158-278` + `src/operator/quantization/`).
+
+TPU-native design: instead of the reference's oneDNN/cuDNN quantized kernels
+behind a subgraph pass, quantized layers here compute `int8 × int8 → int32`
+contractions with `lax.dot_general(preferred_element_type=int32)` — the MXU
+has a native 8-bit multiply path — and dequantize in the epilogue. Calibration
+(minmax / entropy) collects activation ranges by running the fp32 net over a
+calibration iterator, mirroring `calibrate_entropy` (`quantization.py:278`).
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as _onp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import ndarray, apply_op, from_jax
+
+__all__ = [
+    "quantize", "dequantize", "requantize", "quantized_fully_connected",
+    "calib_minmax", "calib_entropy", "LayerCalibrator", "quantize_net",
+    "QuantizedDense",
+]
+
+INT8_MAX = 127.0
+
+
+# ---------------------------------------------------------------------------
+# core ops (parity: src/operator/quantization/{quantize,dequantize,requantize})
+# ---------------------------------------------------------------------------
+
+def quantize(data, min_range=None, max_range=None, out_type="int8"):
+    """fp32 → int8 with symmetric scaling; returns (q, min, max)."""
+    if out_type != "int8":
+        raise MXNetError("TPU quantization supports int8 only")
+
+    def fn(x):
+        if min_range is None or max_range is None:
+            amax = jnp.max(jnp.abs(x))
+        else:
+            amax = jnp.maximum(abs(float(min_range)), abs(float(max_range)))
+        scale = INT8_MAX / jnp.maximum(amax, 1e-12)
+        q = jnp.clip(jnp.round(x * scale), -INT8_MAX, INT8_MAX)
+        return q.astype(jnp.int8), -amax * jnp.ones(()), amax * jnp.ones(())
+    return apply_op(fn, (data,), {}, name="quantize", n_out=3)
+
+
+def dequantize(data, min_range, max_range, out_type="float32"):
+    """int8 → fp32 given the recorded range."""
+    def fn(q, lo, hi):
+        amax = jnp.maximum(jnp.abs(lo), jnp.abs(hi))
+        return q.astype(jnp.float32) * (amax / INT8_MAX)
+    return apply_op(fn, (data, min_range, max_range), {}, name="dequantize")
+
+
+def requantize(data, min_range, max_range, out_min, out_max):
+    """int32 accumulator → int8 under a new output range."""
+    def fn(acc, lo, hi, olo, ohi):
+        in_amax = jnp.maximum(jnp.abs(lo), jnp.abs(hi))
+        out_amax = jnp.maximum(jnp.abs(olo), jnp.abs(ohi))
+        in_scale = in_amax / (INT8_MAX * INT8_MAX)
+        out_scale = INT8_MAX / jnp.maximum(out_amax, 1e-12)
+        q = jnp.clip(jnp.round(acc.astype(jnp.float32) * in_scale * out_scale),
+                     -INT8_MAX, INT8_MAX)
+        return q.astype(jnp.int8)
+    return apply_op(fn, (data, min_range, max_range, out_min, out_max), {},
+                    name="requantize")
+
+
+def _q8(x, amax):
+    scale = INT8_MAX / jnp.maximum(amax, 1e-12)
+    return jnp.clip(jnp.round(x * scale), -INT8_MAX, INT8_MAX).astype(jnp.int8)
+
+
+def quantized_fully_connected(x, weight, bias, x_amax, w_amax):
+    """int8×int8→int32 dense with fp32 dequant epilogue. `x` fp32 in, fp32
+    out — quantization is internal, as in the reference's quantized FC with
+    enabled calibration."""
+    def fn(xv, wv, bv):
+        xq = _q8(xv, x_amax)
+        wq = _q8(wv, w_amax)
+        acc = jax.lax.dot_general(
+            xq, wq, (((xv.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        scale = (x_amax / INT8_MAX) * (w_amax / INT8_MAX)
+        out = acc.astype(jnp.float32) * scale
+        if bv is not None:
+            out = out + bv
+        return out
+    if bias is None:
+        return apply_op(lambda xv, wv: fn(xv, wv, None), (x, weight), {},
+                        name="quantized_fully_connected")
+    return apply_op(fn, (x, weight, bias), {},
+                    name="quantized_fully_connected")
+
+
+# ---------------------------------------------------------------------------
+# calibration (parity: quantization.py `_LayerOutputMinMaxCollector` /
+# `calibrate_entropy`)
+# ---------------------------------------------------------------------------
+
+def calib_minmax(samples: _onp.ndarray) -> float:
+    """Naive calibration: absolute max over observed activations."""
+    return float(_onp.max(_onp.abs(samples)))
+
+
+def calib_entropy(samples: _onp.ndarray, num_bins: int = 2048,
+                  num_quantized_bins: int = 255) -> float:
+    """KL-divergence threshold search (entropy calibration) — returns the
+    clipping amax minimizing KL(P‖Q) between the fp32 histogram and its
+    int8-quantized reconstruction."""
+    arr = _onp.abs(_onp.asarray(samples).ravel())
+    amax = arr.max()
+    if amax == 0:
+        return 1e-8
+    # keep bins populated: sparse histograms make the KL search over-clip
+    num_bins = int(min(num_bins, max(num_quantized_bins + 1, arr.size // 8)))
+    hist, edges = _onp.histogram(arr, bins=num_bins, range=(0, amax))
+    hist = hist.astype(_onp.float64)
+    best_div, best_t = _onp.inf, amax
+    start = num_quantized_bins // 2 + 1
+    for i in range(start, num_bins + 1, max(1, num_bins // 128)):
+        p = hist[:i].copy()
+        outliers = hist[i:].sum()
+        p[-1] += outliers
+        if p.sum() == 0:
+            continue
+        # quantize the i-bin histogram down to num_quantized_bins
+        idx = _onp.linspace(0, i, num_quantized_bins + 1).astype(int)
+        q = _onp.zeros(i)
+        for b in range(num_quantized_bins):
+            lo, hi = idx[b], max(idx[b + 1], idx[b] + 1)
+            chunk = hist[lo:hi]
+            nz = (chunk > 0).sum()
+            if nz:
+                q[lo:hi] = _onp.where(chunk > 0, chunk.sum() / nz, 0)
+        if q.sum() == 0:
+            continue
+        pn = _smooth_distribution(p)
+        qn = _smooth_distribution(q)
+        div = _onp.sum(pn * _onp.log(pn / qn))
+        if div < best_div:
+            best_div = div
+            best_t = edges[i]
+    return float(best_t)
+
+
+def _smooth_distribution(d, eps=1e-6):
+    """Additive smoothing so KL(P‖Q) stays finite on sparse histograms (the
+    reference's `_smooth_distribution` shifts mass instead but assumes dense
+    calibration histograms, `quantization.py`)."""
+    d = d + eps
+    return d / d.sum()
+
+
+class LayerCalibrator:
+    """Collects per-layer activation ranges. Memory-bounded: `naive` keeps
+    only a running abs-max; `entropy` keeps a running abs-max plus a
+    per-layer subsample capped at `max_samples` elements."""
+
+    def __init__(self, mode="naive", num_bins=2048, max_samples=1 << 20):
+        if mode not in ("naive", "entropy"):
+            raise MXNetError(f"unknown calibration mode {mode}")
+        self.mode = mode
+        self.num_bins = num_bins
+        self.max_samples = max_samples
+        self.amax: Dict[str, float] = {}
+        self.samples: Dict[str, list] = {}
+        self._counts: Dict[str, int] = {}
+
+    def observe(self, name: str, value: ndarray):
+        arr = _onp.abs(_onp.asarray(value.asnumpy(), dtype=_onp.float32)
+                       .ravel())
+        self.amax[name] = max(self.amax.get(name, 0.0), float(arr.max()))
+        if self.mode == "entropy":
+            have = self._counts.get(name, 0)
+            room = self.max_samples - have
+            if room > 0:
+                if arr.size > room:
+                    arr = arr[_onp.random.randint(0, arr.size, room)]
+                self.samples.setdefault(name, []).append(arr)
+                self._counts[name] = have + arr.size
+
+    def thresholds(self) -> Dict[str, float]:
+        out = {}
+        for name, amax in self.amax.items():
+            if self.mode == "naive":
+                out[name] = amax
+            else:
+                arr = _onp.concatenate(self.samples[name])
+                # embed the true amax so the histogram range is exact even
+                # if the subsample missed it
+                arr = _onp.append(arr, amax)
+                out[name] = calib_entropy(arr, self.num_bins)
+        return out
+
+
+class QuantizedDense:
+    """Inference-only int8 replacement for a Gluon `Dense` block."""
+
+    def __init__(self, dense, x_amax: float):
+        self._dense = dense
+        w = dense.weight._data
+        self.w_amax = float(jnp.max(jnp.abs(w._data)))
+        self.x_amax = float(x_amax)
+
+    def __call__(self, x):
+        if getattr(self._dense, "_flatten", False) and x.ndim > 2:
+            x = x.reshape((x.shape[0], -1))
+        bias = self._dense.bias._data if self._dense.bias is not None else None
+        out = quantized_fully_connected(x, self._dense.weight._data, bias,
+                                        self.x_amax, self.w_amax)
+        act = getattr(self._dense, "act", None)
+        return act(out) if act is not None else out
+
+
+def quantize_net(net, calib_data=None, calib_mode="naive",
+                 quantized_dtype="int8", exclude_layers=None,
+                 num_calib_batches=None, logger=None):
+    """Post-training INT8 quantization of a Gluon net's Dense layers.
+
+    Runs `calib_data` through the fp32 net collecting per-layer input
+    ranges, then swaps each `Dense` for a `QuantizedDense`. Returns a
+    callable net (a shallow wrapper; the original is untouched).
+    Parity: `quantize_net` (`python/mxnet/contrib/quantization.py:158`).
+    """
+    from ..gluon import nn as _nn
+
+    if quantized_dtype != "int8":
+        raise MXNetError("TPU quantization supports int8 only")
+    exclude = set(exclude_layers or [])
+
+    # locate Dense children inside Sequential containers
+    dense_sites = []
+
+    def walk(block, prefix):
+        if not _is_sequential(block):
+            return
+        for name, child in block._children.items():
+            full = f"{prefix}.{name}" if prefix else str(name)
+            if isinstance(child, _nn.Dense) and full not in exclude:
+                dense_sites.append((block, name, full, child))
+            else:
+                walk(child, full)
+
+    walk(net, "")
+    if not dense_sites:
+        return net
+
+    calib = LayerCalibrator(mode=calib_mode)
+    if calib_data is not None:
+        sites = {full: d for _, _, full, d in dense_sites}
+        n = 0
+        for batch in calib_data:
+            data = batch[0] if isinstance(batch, (tuple, list)) else batch
+            _forward_with_map(net, data, observer=calib.observe, sites=sites)
+            n += 1
+            if num_calib_batches and n >= num_calib_batches:
+                break
+        thresholds = calib.thresholds()
+    else:
+        thresholds = {full: 1.0 for _, _, full, _ in dense_sites}
+
+    qmap = {full: QuantizedDense(dense, thresholds.get(full, 1.0))
+            for _, _, full, dense in dense_sites}
+    return _QuantizedNet(net, qmap)
+
+
+def _is_sequential(block):
+    from ..gluon import nn as _nn
+    return isinstance(block, (_nn.Sequential, _nn.HybridSequential))
+
+
+def _forward_with_map(block, x, observer=None, sites=None, qmap=None,
+                      prefix=""):
+    """Walk a sequential-style block tree, substituting quantized layers
+    (`qmap`) and/or observing fp32 inputs to calibration `sites`. Only
+    `Sequential`-style containers are recursed into — any other block (e.g.
+    a `Dense`, whose `Activation` child is applied inside its own forward)
+    is invoked whole. Nets with non-sequential `forward` bodies need manual
+    substitution — documented limitation (the reference's graph-pass
+    substitution has no analog without a traced graph)."""
+    if not _is_sequential(block):
+        return block(x)
+    out = x
+    for name, child in block._children.items():
+        full = f"{prefix}.{name}" if prefix else str(name)
+        if sites is not None and full in sites:
+            if observer is not None:
+                observer(full, out)
+            out = sites[full](out)
+        elif qmap is not None and full in qmap:
+            out = qmap[full](out)
+        elif _is_sequential(child):
+            out = _forward_with_map(child, out, observer, sites, qmap, full)
+        else:
+            out = child(out)
+    return out
+
+
+class _QuantizedNet:
+    """Sequential-style wrapper running the original net with Dense layers
+    substituted by their int8 twins."""
+
+    def __init__(self, net, qmap):
+        self._net = net
+        self._qmap = qmap
+
+    def __call__(self, x):
+        return _forward_with_map(self._net, x, qmap=self._qmap)
+
+    def collect_params(self):
+        return self._net.collect_params()

@@ -11,3 +11,4 @@ from . import utils
 from .trainer import Trainer
 from . import model_zoo
 from . import probability
+from . import contrib

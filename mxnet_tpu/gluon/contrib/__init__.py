@@ -1,0 +1,6 @@
+"""`mx.gluon.contrib` — experimental Gluon extras.
+
+Parity: `python/mxnet/gluon/contrib/` (reference). The flagship member is the
+Keras-style `estimator` training-loop facility.
+"""
+from . import estimator  # noqa: F401

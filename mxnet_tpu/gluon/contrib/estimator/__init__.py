@@ -1,0 +1,13 @@
+"""`gluon.contrib.estimator` — Keras-style training loop with event handlers.
+
+Parity: `python/mxnet/gluon/contrib/estimator/` (reference:
+`estimator.py:42` `Estimator`, `event_handler.py`, `batch_processor.py`).
+"""
+from .event_handler import (  # noqa: F401
+    EventHandler, TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin,
+    BatchEnd, StoppingHandler, MetricHandler, ValidationHandler,
+    LoggingHandler, CheckpointHandler, EarlyStoppingHandler,
+    GradientUpdateHandler,
+)
+from .batch_processor import BatchProcessor  # noqa: F401
+from .estimator import Estimator  # noqa: F401

@@ -1,0 +1,32 @@
+"""Batch processor: per-minibatch fit/evaluate hooks (parity:
+`python/mxnet/gluon/contrib/estimator/batch_processor.py:28-70`)."""
+from __future__ import annotations
+
+from .... import autograd
+
+__all__ = ["BatchProcessor"]
+
+
+class BatchProcessor:
+    """Default single-device batch processing; subclass and override
+    `fit_batch`/`evaluate_batch` for custom training logic."""
+
+    def _get_data_and_label(self, batch, device, batch_axis=0):
+        data, label = batch[0], batch[1]
+        return data, label
+
+    def evaluate_batch(self, estimator, val_batch, batch_axis=0):
+        data, label = self._get_data_and_label(val_batch, estimator.device,
+                                               batch_axis)
+        pred = estimator.val_net(data)
+        loss = estimator.val_loss(pred, label)
+        return data, label, pred, loss
+
+    def fit_batch(self, estimator, train_batch, batch_axis=0):
+        data, label = self._get_data_and_label(train_batch, estimator.device,
+                                               batch_axis)
+        with autograd.record():
+            pred = estimator.net(data)
+            loss = estimator.loss(pred, label)
+        loss.backward()
+        return data, label, pred, loss

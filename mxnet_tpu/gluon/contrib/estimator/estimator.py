@@ -1,0 +1,254 @@
+"""Keras-style `Estimator` (parity:
+`python/mxnet/gluon/contrib/estimator/estimator.py:42,110,279,333`).
+
+TPU-native notes: there is no per-device parameter copy management here —
+single-device training runs eagerly over jitted blocks, and data-parallel
+training is expressed through `Trainer`'s kvstore (GSPMD collectives), so
+the estimator body is device-count agnostic.
+"""
+from __future__ import annotations
+
+import copy
+import logging
+
+from .... import device as _device_mod
+from .... import initializer as _init
+from ... import loss as gluon_loss
+from ... import metric as metric_mod
+from ...trainer import Trainer
+from .batch_processor import BatchProcessor
+from .event_handler import (
+    _check_event_handlers, BatchBegin, BatchEnd, EpochBegin, EpochEnd,
+    TrainBegin, TrainEnd, GradientUpdateHandler, LoggingHandler,
+    MetricHandler, StoppingHandler, ValidationHandler,
+)
+
+__all__ = ["Estimator"]
+
+
+class Estimator:
+    """Drive `net` training with `loss`, `train_metrics`, and a `Trainer`,
+    firing event handlers around the loop."""
+
+    logger = None
+
+    def __init__(self, net, loss, train_metrics=None, val_metrics=None,
+                 initializer=None, trainer=None, device=None, context=None,
+                 val_net=None, val_loss=None, batch_processor=None):
+        self.net = net
+        self.loss = self._check_loss(loss)
+        self._train_metrics = _check_metrics(train_metrics)
+        self._val_metrics = _check_metrics(val_metrics)
+        self._add_default_training_metrics()
+        self._add_validation_metrics()
+        self.val_net = net if val_net is None else val_net
+        self.val_loss = self.loss if val_loss is None else self._check_loss(val_loss)
+        self.logger = logging.getLogger("Estimator")
+        self.logger.setLevel(logging.INFO)
+        self.device = device or context or _device_mod.current_device()
+        self._initialize(initializer)
+        self.trainer = self._check_trainer(trainer)
+        self.batch_processor = batch_processor or BatchProcessor()
+        if not isinstance(self.batch_processor, BatchProcessor):
+            raise ValueError("batch_processor must be a BatchProcessor")
+        self.max_epoch = None
+        self.max_batch = None
+        self.stop_training = False
+
+    # -- setup helpers ----------------------------------------------------
+    def _check_loss(self, loss):
+        if not isinstance(loss, gluon_loss.Loss):
+            raise ValueError("loss must be a gluon.loss.Loss instance")
+        return loss
+
+    def _initialize(self, initializer):
+        if not self._is_initialized():
+            self.net.initialize(init=initializer or _init.Uniform(),
+                                device=self.device)
+        elif initializer is not None:
+            self.logger.info("Network already initialized; "
+                             "ignoring initializer.")
+
+    def _is_initialized(self):
+        for param in self.net.collect_params().values():
+            if param._data is None:
+                return False
+        return True
+
+    def _check_trainer(self, trainer):
+        if trainer is None:
+            self.logger.info("No trainer specified; using SGD(lr=0.001)")
+            trainer = Trainer(self.net.collect_params(), "sgd",
+                              {"learning_rate": 0.001})
+        elif not isinstance(trainer, Trainer):
+            raise ValueError("trainer must be a gluon.Trainer instance")
+        return trainer
+
+    def _add_default_training_metrics(self):
+        if not self._train_metrics:
+            suggested = self.loss.metric_suggestion() \
+                if hasattr(self.loss, "metric_suggestion") else None
+            self._train_metrics = [suggested or metric_mod.Accuracy()]
+        for metric in self._train_metrics:
+            metric.name = "training " + metric.name
+        loss_name = self.loss.__class__.__name__.lower()
+        self._train_metrics.append(metric_mod.Loss("training " + loss_name))
+
+    def _add_validation_metrics(self):
+        if not self._val_metrics:
+            self._val_metrics = [copy.deepcopy(m) for m in self._train_metrics
+                                 if not isinstance(m, metric_mod.Loss)]
+        for metric in self._val_metrics:
+            metric.name = metric.name.replace("training", "validation") \
+                if "training" in metric.name else "validation " + metric.name
+
+    @property
+    def train_metrics(self):
+        return self._train_metrics
+
+    @property
+    def val_metrics(self):
+        return self._val_metrics
+
+    def _get_data_and_label(self, batch, device, batch_axis=0):
+        return self.batch_processor._get_data_and_label(batch, device,
+                                                        batch_axis)
+
+    # -- evaluation -------------------------------------------------------
+    def evaluate(self, val_data, batch_axis=0, event_handlers=None):
+        for metric in self.val_metrics:
+            metric.reset()
+        event_handlers = self._prepare_val_handlers(event_handlers)
+        train_begin, epoch_begin, batch_begin, batch_end, epoch_end, \
+            train_end = self._categorize_handlers(event_handlers)
+        estimator_ref = self
+        for handler in epoch_begin:
+            handler.epoch_begin(estimator_ref)
+        for batch in val_data:
+            for handler in batch_begin:
+                handler.batch_begin(estimator_ref, batch=batch)
+            _, label, pred, loss = self.batch_processor.evaluate_batch(
+                self, batch, batch_axis)
+            for metric in self.val_metrics:
+                if isinstance(metric, metric_mod.Loss):
+                    metric.update(0, loss)
+                else:
+                    metric.update(label, pred)
+            for handler in batch_end:
+                handler.batch_end(estimator_ref, batch=batch, pred=pred,
+                                  label=label, loss=loss)
+        for handler in epoch_end:
+            handler.epoch_end(estimator_ref)
+
+    def _prepare_val_handlers(self, event_handlers):
+        return _check_event_handlers(event_handlers)
+
+    # -- training ---------------------------------------------------------
+    def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
+            batches=None, batch_axis=0):
+        if not (epochs or batches):
+            raise ValueError("please specify epochs or batches")
+        self.max_epoch = epochs
+        self.max_batch = batches
+        self.stop_training = False
+
+        event_handlers = self._prepare_default_handlers(val_data,
+                                                        event_handlers)
+        train_begin, epoch_begin, batch_begin, batch_end, epoch_end, \
+            train_end = self._categorize_handlers(event_handlers)
+        estimator_ref = self
+
+        for handler in train_begin:
+            handler.train_begin(estimator_ref)
+
+        while True:
+            for handler in epoch_begin:
+                handler.epoch_begin(estimator_ref)
+            for batch in train_data:
+                for handler in batch_begin:
+                    handler.batch_begin(estimator_ref, batch=batch)
+                _, label, pred, loss = self.batch_processor.fit_batch(
+                    self, batch, batch_axis)
+                for handler in batch_end:
+                    handler.batch_end(estimator_ref, batch=batch, pred=pred,
+                                      label=label, loss=loss)
+                if self.stop_training:
+                    break
+            for handler in epoch_end:
+                handler.epoch_end(estimator_ref)
+            if self.stop_training:
+                break
+
+        for handler in train_end:
+            handler.train_end(estimator_ref)
+
+    def _prepare_default_handlers(self, val_data, event_handlers):
+        event_handlers = _check_event_handlers(event_handlers)
+        added_default_handlers = []
+        if not any(isinstance(h, StoppingHandler) for h in event_handlers):
+            added_default_handlers.append(
+                StoppingHandler(self.max_epoch, self.max_batch))
+        if not any(isinstance(h, GradientUpdateHandler)
+                   for h in event_handlers):
+            added_default_handlers.append(GradientUpdateHandler())
+        if not any(isinstance(h, MetricHandler) for h in event_handlers):
+            added_default_handlers.append(
+                MetricHandler(metrics=self.train_metrics))
+        if val_data is not None and not any(
+                isinstance(h, ValidationHandler) for h in event_handlers):
+            added_default_handlers.append(
+                ValidationHandler(val_data=val_data, eval_fn=self.evaluate))
+        if not any(isinstance(h, LoggingHandler) for h in event_handlers):
+            added_default_handlers.append(
+                LoggingHandler(metrics=self.train_metrics + self.val_metrics))
+        event_handlers.extend(added_default_handlers)
+        # stop_training flows from any handler that owns the flag
+        mixing = [h for h in event_handlers
+                  if hasattr(h, "stop_training")]
+        self._stop_owners = mixing
+        event_handlers.sort(key=lambda h: getattr(h, "priority", 0),
+                            reverse=True)
+        return event_handlers
+
+    def _categorize_handlers(self, event_handlers):
+        train_begin = [h for h in event_handlers if isinstance(h, TrainBegin)]
+        epoch_begin = [h for h in event_handlers if isinstance(h, EpochBegin)]
+        batch_begin = [h for h in event_handlers if isinstance(h, BatchBegin)]
+        batch_end = [h for h in event_handlers if isinstance(h, BatchEnd)]
+        epoch_end = [h for h in event_handlers if isinstance(h, EpochEnd)]
+        train_end = [h for h in event_handlers if isinstance(h, TrainEnd)]
+
+        # wrap end-events so any handler's stop_training flag reaches us
+        est = self
+
+        def _sync_stop():
+            # OR, never clobber: a custom handler may set the flag directly
+            # on the estimator (the reference's documented pattern)
+            est.stop_training = est.stop_training or any(
+                getattr(h, "stop_training", False)
+                for h in getattr(est, "_stop_owners", []))
+
+        class _Sync(BatchEnd, EpochEnd):
+            def batch_end(self, estimator, *a, **k):
+                _sync_stop()
+
+            def epoch_end(self, estimator, *a, **k):
+                _sync_stop()
+
+        sync = _Sync()
+        batch_end = batch_end + [sync]
+        epoch_end = epoch_end + [sync]
+        return (train_begin, epoch_begin, batch_begin, batch_end, epoch_end,
+                train_end)
+
+
+def _check_metrics(metrics):
+    if isinstance(metrics, metric_mod.CompositeEvalMetric):
+        metrics = [m for m in metrics.metrics]
+    elif isinstance(metrics, metric_mod.EvalMetric):
+        metrics = [metrics]
+    else:
+        metrics = metrics or []
+        if not all(isinstance(m, metric_mod.EvalMetric) for m in metrics):
+            raise ValueError("metrics must be EvalMetric instances")
+    return metrics

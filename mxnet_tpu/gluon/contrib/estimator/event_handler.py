@@ -1,0 +1,405 @@
+"""Event handlers for `Estimator.fit` (parity:
+`python/mxnet/gluon/contrib/estimator/event_handler.py:52-737`).
+
+Handlers subscribe to train/epoch/batch begin/end events via mixin base
+classes; `Estimator` sorts same-event handlers by descending `priority`.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+import warnings
+
+import numpy as _onp
+
+__all__ = [
+    "EventHandler", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
+    "BatchBegin", "BatchEnd", "StoppingHandler", "MetricHandler",
+    "ValidationHandler", "LoggingHandler", "CheckpointHandler",
+    "EarlyStoppingHandler", "GradientUpdateHandler",
+]
+
+
+class EventHandler:
+    pass
+
+
+def _check_event_handlers(handlers):
+    if isinstance(handlers, EventHandler):
+        handlers = [handlers]
+    handlers = handlers or []
+    if not all(isinstance(h, EventHandler) for h in handlers):
+        raise ValueError("handlers must all be EventHandler instances")
+    return handlers
+
+
+class TrainBegin(EventHandler):
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd(EventHandler):
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin(EventHandler):
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd(EventHandler):
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin(EventHandler):
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd(EventHandler):
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop after `max_epoch` epochs or `max_batch` batches."""
+
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.max_epoch = self.max_epoch or estimator.max_epoch
+        self.max_batch = self.max_batch or estimator.max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.max_batch is not None and self.current_batch == self.max_batch:
+            self.stop_training = True
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.max_epoch is not None and self.current_epoch == self.max_epoch:
+            self.stop_training = True
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    """Reset train metrics at epoch start; update them after each batch."""
+
+    def __init__(self, metrics, priority=-1000):
+        self.metrics = metrics or []
+        self.priority = priority
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        for metric in self.metrics:
+            metric.reset()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        pred = kwargs.get("pred")
+        label = kwargs.get("label")
+        loss = kwargs.get("loss")
+        from ... import metric as _metric_mod
+        for metric in self.metrics:
+            if isinstance(metric, _metric_mod.Loss):
+                metric.update(0, loss)
+            else:
+                metric.update(label, pred)
+
+
+class ValidationHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Run validation every `epoch_period` epochs and/or `batch_period`
+    batches via the estimator's `evaluate`."""
+
+    def __init__(self, val_data, eval_fn, epoch_period=1, batch_period=None,
+                 priority=-1000, event_handlers=None):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.priority = priority
+        self.event_handlers = event_handlers
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and self.current_batch % self.batch_period == 0:
+            self.eval_fn(val_data=self.val_data,
+                         event_handlers=self.event_handlers)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and self.current_epoch % self.epoch_period == 0:
+            self.eval_fn(val_data=self.val_data,
+                         event_handlers=self.event_handlers)
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin,
+                     BatchEnd):
+    """Log training progress each epoch or every `log_interval` batches."""
+
+    def __init__(self, log_interval="epoch", metrics=None, priority=_onp.inf):
+        if not (log_interval == "epoch" or isinstance(log_interval, int)):
+            raise ValueError("log_interval must be 'epoch' or an int")
+        self.logger = logging.getLogger(__name__)
+        self.log_interval = log_interval
+        self.metrics = metrics or []
+        self.priority = priority
+        self.batch_index = 0
+        self.current_epoch = 0
+        self.processed_samples = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.train_start = time.time()
+        self.logger.info("Training begin: using optimizer %s with lr %s",
+                         estimator.trainer.optimizer.__class__.__name__,
+                         estimator.trainer.learning_rate)
+
+    def train_end(self, estimator, *args, **kwargs):
+        train_time = time.time() - self.train_start
+        msg = "Train finished using total %ds with %d epochs. " % (
+            train_time, self.current_epoch)
+        for metric in self.metrics:
+            name, value = metric.get()
+            msg += "%s: %.4f, " % (name, value)
+        self.logger.info(msg.rstrip(", "))
+
+    def batch_begin(self, estimator, *args, **kwargs):
+        if isinstance(self.log_interval, int):
+            self.batch_start = time.time()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        if isinstance(self.log_interval, int):
+            batch_time = time.time() - self.batch_start
+            msg = "[Epoch %d][Batch %d]" % (self.current_epoch,
+                                            self.batch_index)
+            batch = kwargs.get("batch")
+            if batch is not None:
+                data = batch[0] if isinstance(batch, (tuple, list)) else batch
+                self.processed_samples += len(data)
+            msg += "[Samples %s] " % self.processed_samples
+            if self.batch_index % self.log_interval == 0:
+                msg += "time/batch: %.3fs " % batch_time
+                for metric in self.metrics:
+                    name, value = metric.get()
+                    msg += "%s: %.4f, " % (name, value)
+                self.logger.info(msg.rstrip(", "))
+        self.batch_index += 1
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        if self.log_interval is not None:
+            self.epoch_start = time.time()
+            self.logger.info("[Epoch %d] Begin, current learning rate: %.4f",
+                             self.current_epoch,
+                             estimator.trainer.learning_rate)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        if self.log_interval is not None:
+            epoch_time = time.time() - self.epoch_start
+            msg = "[Epoch %d] Finished in %.3fs, " % (self.current_epoch,
+                                                      epoch_time)
+            for metric in self.metrics:
+                name, value = metric.get()
+                msg += "%s: %.4f, " % (name, value)
+            self.logger.info(msg.rstrip(", "))
+        self.current_epoch += 1
+        self.batch_index = 0
+
+
+class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Save model params (+trainer states) every `epoch_period` epochs /
+    `batch_period` batches; optionally keep only the best by `monitor`."""
+
+    def __init__(self, model_dir, model_prefix="model", monitor=None,
+                 verbose=0, save_best=False, mode="auto", epoch_period=1,
+                 batch_period=None, max_checkpoints=5, resume_from_checkpoint=False):
+        self.logger = logging.getLogger(__name__)
+        os.makedirs(model_dir, exist_ok=True)
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.monitor = monitor
+        self.verbose = verbose
+        self.save_best = save_best
+        if self.save_best and monitor is None:
+            raise ValueError("monitor metric is required for save_best")
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.max_checkpoints = max_checkpoints
+        self.resume_from_checkpoint = resume_from_checkpoint
+        self.saved_checkpoints = []
+        self.current_batch = 0
+        self.current_epoch = 0
+        if mode not in ("auto", "min", "max"):
+            warnings.warn("mode %s unknown; falling back to auto" % mode)
+            mode = "auto"
+        if mode == "min":
+            self.monitor_op = _onp.less
+        elif mode == "max":
+            self.monitor_op = _onp.greater
+        else:
+            if monitor is not None and "acc" in monitor.get()[0].lower():
+                self.monitor_op = _onp.greater
+            else:
+                self.monitor_op = _onp.less
+        self.best = _onp.inf if self.monitor_op == _onp.less else -_onp.inf
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+        if self.resume_from_checkpoint:
+            self._resume(estimator)
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and self.current_batch % self.batch_period == 0:
+            self._save_checkpoint(estimator)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and self.current_epoch % self.epoch_period == 0:
+            self._save_checkpoint(estimator)
+
+    # -- helpers ----------------------------------------------------------
+    def _ckpt_prefix(self):
+        return os.path.join(
+            self.model_dir, "%s-epoch%dbatch%d" % (
+                self.model_prefix, self.current_epoch, self.current_batch))
+
+    def _save_checkpoint(self, estimator):
+        prefix = self._ckpt_prefix()
+        estimator.net.save_parameters(prefix + ".params")
+        if estimator.trainer is not None:
+            estimator.trainer.save_states(prefix + ".states")
+        self.saved_checkpoints.append(prefix)
+        if self.verbose > 0:
+            self.logger.info("[Epoch %d] saved checkpoint to %s",
+                             self.current_epoch, prefix)
+        while len(self.saved_checkpoints) > self.max_checkpoints:
+            old = self.saved_checkpoints.pop(0)
+            for suffix in (".params", ".states"):
+                if os.path.exists(old + suffix):
+                    os.remove(old + suffix)
+        if self.save_best:
+            name, value = self.monitor.get()
+            if self.monitor_op(value, self.best):
+                self.best = value
+                best_prefix = os.path.join(self.model_dir,
+                                           "%s-best" % self.model_prefix)
+                estimator.net.save_parameters(best_prefix + ".params")
+                if estimator.trainer is not None:
+                    estimator.trainer.save_states(best_prefix + ".states")
+                if self.verbose > 0:
+                    self.logger.info("new best %s: %.6f", name, value)
+
+    def _resume(self, estimator):
+        import re
+        pat = re.compile(re.escape(self.model_prefix)
+                         + r"-epoch(\d+)batch(\d+)\.params$")
+        candidates = [(m.group(0), int(m.group(1)), int(m.group(2)))
+                      for m in (pat.match(f)
+                                for f in os.listdir(self.model_dir)) if m]
+        if not candidates:
+            return
+        latest = max(candidates, key=lambda t: (t[1], t[2]))[0]
+        prefix = os.path.join(self.model_dir, latest[:-len(".params")])
+        estimator.net.load_parameters(prefix + ".params")
+        if estimator.trainer is not None and os.path.exists(prefix + ".states"):
+            estimator.trainer.load_states(prefix + ".states")
+        self.logger.info("resumed from checkpoint %s", prefix)
+
+
+class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
+    """Stop training when `monitor` stops improving."""
+
+    def __init__(self, monitor, min_delta=0, patience=0, mode="auto",
+                 baseline=None):
+        self.logger = logging.getLogger(__name__)
+        self.monitor = monitor
+        self.baseline = baseline
+        self.patience = patience
+        self.min_delta = min_delta
+        self.wait = 0
+        self.stopped_epoch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+        if mode not in ("auto", "min", "max"):
+            warnings.warn("mode %s unknown; falling back to auto" % mode)
+            mode = "auto"
+        if mode == "min":
+            self.monitor_op = _onp.less
+        elif mode == "max":
+            self.monitor_op = _onp.greater
+        else:
+            if "acc" in monitor.get()[0].lower():
+                self.monitor_op = _onp.greater
+            else:
+                self.monitor_op = _onp.less
+        if self.monitor_op == _onp.greater:
+            self.min_delta *= 1
+        else:
+            self.min_delta *= -1
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.wait = 0
+        self.stopped_epoch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+        if self.baseline is not None:
+            self.best = self.baseline
+        else:
+            self.best = _onp.inf if self.monitor_op == _onp.less else -_onp.inf
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        name, value = self.monitor.get()
+        if _onp.isnan(value):
+            self.current_epoch += 1
+            return
+        if self.monitor_op(value - self.min_delta, self.best):
+            self.best = value
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stopped_epoch = self.current_epoch
+                self.stop_training = True
+        self.current_epoch += 1
+
+    def train_end(self, estimator, *args, **kwargs):
+        if self.stopped_epoch > 0:
+            self.logger.info("[Epoch %d] early stopping (monitor %s)",
+                             self.stopped_epoch, self.monitor.get()[0])
+
+
+class GradientUpdateHandler(BatchEnd):
+    """Apply the optimizer step after each batch (runs last by priority;
+    parity `event_handler.py:722`)."""
+
+    def __init__(self, priority=-2000):
+        self.priority = priority
+
+    def batch_end(self, estimator, *args, **kwargs):
+        loss = kwargs.get("loss")
+        batch = kwargs.get("batch")
+        if isinstance(loss, (list, tuple)) and loss:
+            batch_size = sum(l.shape[0] if getattr(l, "ndim", 0) else 1
+                             for l in loss)
+        elif getattr(loss, "ndim", 0):
+            batch_size = loss.shape[0]
+        elif batch is not None:
+            data = batch[0] if isinstance(batch, (tuple, list)) else batch
+            batch_size = len(data)
+        else:
+            batch_size = 1
+        estimator.trainer.step(batch_size)

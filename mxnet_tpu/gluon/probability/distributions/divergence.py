@@ -8,7 +8,7 @@ Monte-Carlo fallback for unregistered reparameterized pairs.
 from __future__ import annotations
 
 import jax.numpy as jnp
-from jax.scipy.special import betaln, logsumexp
+from jax.scipy.special import betaln
 
 from ....base import MXNetError
 from .bernoulli import Bernoulli

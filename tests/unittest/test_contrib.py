@@ -1,0 +1,281 @@
+"""Tests for mx.contrib ops + INT8 quantization (parity model:
+reference tests/python/unittest/test_contrib_operator.py and
+tests/python/quantization/)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import contrib, gluon
+from mxnet_tpu.gluon import nn
+
+
+def A(x, dtype="float32"):
+    return mx.np.array(onp.asarray(x, dtype=dtype))
+
+
+def test_quadratic_forward_backward():
+    x = A([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with mx.autograd.record():
+        y = contrib.quadratic(x, a=2.0, b=3.0, c=1.0)
+        s = y.sum()
+    s.backward()
+    onp.testing.assert_allclose(y.asnumpy(),
+                                2 * x.asnumpy() ** 2 + 3 * x.asnumpy() + 1,
+                                rtol=1e-6)
+    onp.testing.assert_allclose(x.grad.asnumpy(), 4 * x.asnumpy() + 3,
+                                rtol=1e-6)
+
+
+def test_allclose():
+    a = A([1.0, 2.0])
+    b = A([1.0, 2.0 + 1e-9])
+    assert int(contrib.allclose(a, b).asnumpy()) == 1
+    assert int(contrib.allclose(a, A([1.0, 3.0])).asnumpy()) == 0
+
+
+def test_index_copy_and_index_array():
+    old = mx.np.zeros((4, 3))
+    new = A([[1, 1, 1], [2, 2, 2]])
+    idx = A([1, 3], dtype="int32")
+    out = contrib.index_copy(old, idx, new)
+    exp = onp.zeros((4, 3), dtype="float32")
+    exp[1] = 1
+    exp[3] = 2
+    onp.testing.assert_allclose(out.asnumpy(), exp)
+
+    ia = contrib.index_array(mx.np.zeros((2, 3)))
+    assert ia.shape == (2, 3, 2)
+    assert ia.asnumpy()[1, 2, 0] == 1 and ia.asnumpy()[1, 2, 1] == 2
+    ia1 = contrib.index_array(mx.np.zeros((2, 3)), axes=(1,))
+    assert ia1.shape == (2, 3, 1)
+
+
+def test_boolean_mask():
+    data = A([[1, 2], [3, 4], [5, 6]])
+    index = A([1, 0, 1], dtype="int32")
+    out = contrib.boolean_mask(data, index)
+    onp.testing.assert_allclose(out.asnumpy(), [[1, 2], [5, 6]])
+
+
+def test_box_iou():
+    a = A([[0, 0, 2, 2]])
+    b = A([[1, 1, 3, 3], [0, 0, 2, 2], [10, 10, 11, 11]])
+    iou = contrib.box_iou(a, b).asnumpy()
+    onp.testing.assert_allclose(iou[0], [1.0 / 7.0, 1.0, 0.0], rtol=1e-5)
+
+
+def test_box_nms_suppresses_overlaps():
+    # [score, x1, y1, x2, y2] with coord_start=1, score_index=0
+    boxes = A([[[0.9, 0, 0, 2, 2],
+                [0.8, 0.1, 0.1, 2.1, 2.1],   # overlaps first -> suppressed
+                [0.7, 5, 5, 7, 7],
+                [0.01, 0, 0, 1, 1]]])        # below valid_thresh
+    out = contrib.box_nms(boxes, overlap_thresh=0.5, valid_thresh=0.05,
+                          coord_start=1, score_index=0).asnumpy()[0]
+    # sorted by score: row0 kept, row1 suppressed (-1), row2 kept, row3 invalid
+    assert out[0][0] == pytest.approx(0.9)
+    assert (out[1] == -1).all()
+    assert out[2][0] == pytest.approx(0.7)
+    assert (out[3] == -1).all()
+
+
+def test_box_nms_class_aware():
+    # id_index: different classes should not suppress each other
+    boxes = A([[[0, 0.9, 0, 0, 2, 2],
+                [1, 0.8, 0.1, 0.1, 2.1, 2.1]]])
+    out = contrib.box_nms(boxes, overlap_thresh=0.5, valid_thresh=0.0,
+                          coord_start=2, score_index=1, id_index=0).asnumpy()[0]
+    assert (out != -1).all()
+    out2 = contrib.box_nms(boxes, overlap_thresh=0.5, valid_thresh=0.0,
+                           coord_start=2, score_index=1, id_index=0,
+                           force_suppress=True).asnumpy()[0]
+    assert (out2[1] == -1).all()
+
+
+def test_box_encode_decode_roundtrip():
+    anchors = A([[[0, 0, 2, 2], [1, 1, 4, 5]]])
+    gt = A([[[0.2, 0.1, 2.5, 2.2], [1.5, 1.0, 4.2, 5.5]]])
+    deltas = contrib.box_encode(gt, anchors)
+    stds = (0.1, 0.1, 0.2, 0.2)
+    dec = contrib.box_decode(deltas * A(stds), anchors, format="corner")
+    onp.testing.assert_allclose(dec.asnumpy(), gt.asnumpy(), rtol=1e-4,
+                                atol=1e-4)
+
+
+def test_bipartite_matching():
+    score = A([[0.5, 0.6], [0.1, 0.2], [0.3, 0.4]])
+    rows, cols = contrib.bipartite_matching(score, threshold=1e-12)
+    r = rows.asnumpy()
+    c = cols.asnumpy()
+    assert r[0] == 1          # best global pair (0,1)
+    assert c[1] == 0
+    assert r[2] == 0          # next best in remaining
+    assert c[0] == 2
+    assert r[1] == -1         # nothing left for row 1
+
+
+def test_roi_align_identity():
+    # 1x1 channel, exact bilinear average check on a constant map
+    x = mx.np.ones((1, 1, 8, 8))
+    rois = A([[0, 0, 0, 4, 4]])
+    out = contrib.roi_align(x, rois, pooled_size=(2, 2), spatial_scale=1.0)
+    assert out.shape == (1, 1, 2, 2)
+    onp.testing.assert_allclose(out.asnumpy(), onp.ones((1, 1, 2, 2)),
+                                rtol=1e-5)
+
+
+def test_roi_align_gradient_flows():
+    x = mx.np.array(onp.random.randn(1, 2, 8, 8).astype("float32"))
+    x.attach_grad()
+    rois = A([[0, 1, 1, 6, 6]])
+    with mx.autograd.record():
+        out = contrib.roi_align(x, rois, pooled_size=(3, 3),
+                                spatial_scale=1.0)
+        s = out.sum()
+    s.backward()
+    assert float(mx.np.abs(x.grad).sum().asnumpy()) > 0
+
+
+def test_fft_ifft_roundtrip():
+    x = mx.np.array(onp.random.randn(4, 16).astype("float32"))
+    f = contrib.fft(x)
+    assert f.shape == (4, 32)
+    rec = contrib.ifft(f) / 16.0
+    onp.testing.assert_allclose(rec.asnumpy(), x.asnumpy(), rtol=1e-4,
+                                atol=1e-4)
+
+
+def test_bilinear_resize():
+    x = mx.np.array(onp.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+    out = contrib.BilinearResize2D(x, height=8, width=8)
+    assert out.shape == (1, 1, 8, 8)
+    # corners preserved under align_corners
+    onp.testing.assert_allclose(out.asnumpy()[0, 0, 0, 0], 0.0, atol=1e-5)
+    onp.testing.assert_allclose(out.asnumpy()[0, 0, -1, -1], 15.0, atol=1e-5)
+
+
+def test_adaptive_avg_pooling():
+    x = mx.np.array(onp.arange(36, dtype="float32").reshape(1, 1, 6, 6))
+    out = contrib.AdaptiveAvgPooling2D(x, output_size=2)
+    assert out.shape == (1, 1, 2, 2)
+    exp = x.asnumpy().reshape(1, 1, 2, 3, 2, 3).mean(axis=(3, 5))
+    onp.testing.assert_allclose(out.asnumpy(), exp, rtol=1e-6)
+    # global pooling (output_size=1) == mean
+    g = contrib.AdaptiveAvgPooling2D(x, output_size=1)
+    onp.testing.assert_allclose(g.asnumpy().ravel(), [x.asnumpy().mean()],
+                                rtol=1e-6)
+
+
+def test_multibox_prior():
+    x = mx.np.zeros((1, 3, 4, 4))
+    anchors = contrib.MultiBoxPrior(x, sizes=(0.5, 0.25), ratios=(1, 2))
+    # A = len(sizes) + len(ratios) - 1 = 3
+    assert anchors.shape == (1, 4 * 4 * 3, 4)
+    a = anchors.asnumpy()[0]
+    # first anchor centered at (0.125, 0.125) with w=h=0.5
+    onp.testing.assert_allclose(a[0], [0.125 - 0.25, 0.125 - 0.25,
+                                       0.125 + 0.25, 0.125 + 0.25],
+                                rtol=1e-5)
+
+
+def test_gradient_multiplier():
+    x = A([1.0, 2.0])
+    x.attach_grad()
+    with mx.autograd.record():
+        y = contrib.gradient_multiplier(x, scalar=-0.5)
+        s = (y * y).sum()
+    s.backward()
+    onp.testing.assert_allclose(y.asnumpy(), x.asnumpy(), rtol=1e-6)
+    onp.testing.assert_allclose(x.grad.asnumpy(), -0.5 * 2 * x.asnumpy(),
+                                rtol=1e-5)
+
+
+def test_dynamic_reshape():
+    x = mx.np.ones((2, 6))
+    shape = A([3, 4], dtype="int32")
+    assert contrib.dynamic_reshape(x, shape).shape == (3, 4)
+
+
+# ---------------------------------------------------------------------------
+# quantization
+# ---------------------------------------------------------------------------
+
+def test_quantize_dequantize_roundtrip():
+    x = mx.np.array(onp.random.randn(32, 16).astype("float32"))
+    q, lo, hi = contrib.quantization.quantize(x)
+    assert str(q.dtype) == "int8"
+    back = contrib.quantization.dequantize(q, lo, hi)
+    err = onp.abs(back.asnumpy() - x.asnumpy()).max()
+    amax = onp.abs(x.asnumpy()).max()
+    assert err <= amax / 127.0 + 1e-6
+
+
+def test_quantized_fully_connected_close_to_fp32():
+    rng = onp.random.RandomState(0)
+    x = mx.np.array(rng.randn(8, 32).astype("float32"))
+    w = mx.np.array(rng.randn(16, 32).astype("float32"))
+    b = mx.np.array(rng.randn(16).astype("float32"))
+    q = contrib.quantization.quantized_fully_connected(
+        x, w, b, float(onp.abs(x.asnumpy()).max()),
+        float(onp.abs(w.asnumpy()).max()))
+    ref = x.asnumpy() @ w.asnumpy().T + b.asnumpy()
+    rel = onp.abs(q.asnumpy() - ref).max() / onp.abs(ref).max()
+    assert rel < 0.1
+
+
+def test_calib_entropy_reasonable():
+    rng = onp.random.RandomState(0)
+    data = rng.randn(10000).astype("float32")
+    data[0] = 100.0  # single outlier
+    t = contrib.quantization.calib_entropy(data)
+    assert 1.0 < t < 50.0  # clips the outlier, keeps the bulk
+
+
+@pytest.mark.parametrize("calib_mode", ["naive", "entropy"])
+def test_quantize_net_accuracy(calib_mode):
+    rng = onp.random.RandomState(0)
+    net = nn.Sequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(8))
+    net.initialize()
+    x = mx.np.array(rng.randn(64, 16).astype("float32"))
+    fp32_out = net(x).asnumpy()
+
+    ds = gluon.data.ArrayDataset(x, mx.np.zeros((64,)))
+    loader = gluon.data.DataLoader(ds, batch_size=16)
+    qnet = contrib.quantization.quantize_net(net, calib_data=loader,
+                                             calib_mode=calib_mode)
+    q_out = qnet(x).asnumpy()
+    if calib_mode == "naive":
+        rel = onp.abs(q_out - fp32_out).max() / (onp.abs(fp32_out).max() + 1e-9)
+        assert rel < 0.15, rel
+    else:
+        # entropy mode clips the tail: judge by mean error (its objective)
+        rel = onp.abs(q_out - fp32_out).mean() / (onp.abs(fp32_out).mean()
+                                                  + 1e-9)
+        assert rel < 0.2, rel
+
+
+def test_quantize_net_exclude_and_activation_dense():
+    rng = onp.random.RandomState(1)
+    net = nn.Sequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(8))
+    net.initialize()
+    x = mx.np.array(rng.randn(16, 8).astype("float32"))
+    fp32_out = net(x).asnumpy()
+    # exclude the activation-carrying Dense: its full forward (matmul+relu)
+    # must still run in the quantized net
+    qnet = contrib.quantization.quantize_net(net, calib_data=None,
+                                             exclude_layers=["0"])
+    q_out = qnet(x).asnumpy()
+    assert q_out.shape == fp32_out.shape
+    assert not onp.allclose(q_out, 0.0)
+
+
+def test_roi_align_position_sensitive():
+    # C = outC * ph * pw = 2*2*2 = 8
+    x = mx.np.array(onp.random.randn(1, 8, 8, 8).astype("float32"))
+    rois = A([[0, 0, 0, 7, 7]])
+    out = contrib.roi_align(x, rois, pooled_size=(2, 2), spatial_scale=1.0,
+                            position_sensitive=True)
+    assert out.shape == (1, 2, 2, 2)

@@ -1,0 +1,142 @@
+"""Tests for gluon.contrib.estimator (parity: reference
+`tests/nightly/estimator/` + unittest handler tests)."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.contrib.estimator import (
+    Estimator, EarlyStoppingHandler, CheckpointHandler, LoggingHandler,
+    StoppingHandler, EventHandler, EpochEnd,
+)
+
+
+def _toy_data(n=64, d=8, classes=3, batch=16, seed=0):
+    rng = onp.random.RandomState(seed)
+    x = rng.randn(n, d).astype("float32")
+    w = rng.randn(d, classes).astype("float32")
+    y = onp.argmax(x @ w, axis=1).astype("float32")
+    ds = gluon.data.ArrayDataset(mx.np.array(x), mx.np.array(y))
+    return gluon.data.DataLoader(ds, batch_size=batch)
+
+
+def _toy_net(classes=3):
+    net = nn.Sequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(classes))
+    return net
+
+
+def _make_est(lr=1.0):
+    net = _toy_net()
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    est = Estimator(net=net, loss=loss,
+                    train_metrics=gluon.metric.Accuracy())
+    est.trainer.set_learning_rate(lr)
+    return est
+
+
+def test_estimator_fit_improves_accuracy():
+    data = _toy_data()
+    est = _make_est()
+    est.fit(train_data=data, epochs=20)
+    name, acc = est.train_metrics[0].get()
+    assert "training" in name
+    assert acc > 0.5
+
+
+def test_estimator_evaluate():
+    data = _toy_data()
+    est = _make_est()
+    est.fit(train_data=data, epochs=3)
+    est.evaluate(val_data=data)
+    name, acc = est.val_metrics[0].get()
+    assert "validation" in name
+    assert 0.0 <= acc <= 1.0
+
+
+def test_estimator_max_batch_stop():
+    data = _toy_data()
+    est = _make_est()
+    est.fit(train_data=data, batches=3)
+    # StoppingHandler counted exactly 3 batches
+    handlers = est._stop_owners
+    stopping = [h for h in handlers if isinstance(h, StoppingHandler)][0]
+    assert stopping.current_batch == 3
+
+
+def test_estimator_validation_handler_runs():
+    data = _toy_data()
+    est = _make_est()
+    est.fit(train_data=data, val_data=data, epochs=2)
+    _, acc = est.val_metrics[0].get()
+    assert not onp.isnan(acc)
+
+
+def test_early_stopping_handler():
+    data = _toy_data()
+    est = _make_est(lr=0.0)  # no learning => metric never improves
+    handler = EarlyStoppingHandler(monitor=est.train_metrics[0],
+                                   patience=1, mode="max")
+    est.fit(train_data=data, epochs=50, event_handlers=[handler])
+    assert handler.stop_training
+    assert handler.current_epoch < 50
+
+
+def test_checkpoint_handler(tmp_path):
+    data = _toy_data()
+    est = _make_est()
+    ckpt = CheckpointHandler(model_dir=str(tmp_path), model_prefix="toy",
+                             monitor=est.train_metrics[0], save_best=True,
+                             mode="max")
+    est.fit(train_data=data, epochs=2, event_handlers=[ckpt])
+    files = os.listdir(str(tmp_path))
+    assert any(f.endswith(".params") for f in files)
+    assert any("best" in f for f in files)
+    # reload round-trips
+    net2 = _toy_net()
+    best = [f for f in files if "best" in f and f.endswith(".params")][0]
+    net2.load_parameters(os.path.join(str(tmp_path), best))
+
+
+def test_checkpoint_resume(tmp_path):
+    data = _toy_data()
+    est = _make_est()
+    ckpt = CheckpointHandler(model_dir=str(tmp_path), model_prefix="toy")
+    est.fit(train_data=data, epochs=1, event_handlers=[ckpt])
+    est2 = _make_est()
+    ckpt2 = CheckpointHandler(model_dir=str(tmp_path), model_prefix="toy",
+                              resume_from_checkpoint=True)
+    est2.fit(train_data=data, epochs=1, event_handlers=[ckpt2])
+
+
+def test_custom_event_handler_and_priority_order():
+    calls = []
+
+    class A(EpochEnd, EventHandler):
+        priority = 10
+
+        def epoch_end(self, estimator, *a, **k):
+            calls.append("A")
+
+    class B(EpochEnd, EventHandler):
+        priority = -10
+
+        def epoch_end(self, estimator, *a, **k):
+            calls.append("B")
+
+    data = _toy_data()
+    est = _make_est()
+    est.fit(train_data=data, epochs=1, event_handlers=[B(), A()])
+    assert calls.index("A") < calls.index("B")
+
+
+def test_estimator_rejects_bad_loss_and_metric():
+    net = _toy_net()
+    with pytest.raises(ValueError):
+        Estimator(net=net, loss="not-a-loss")
+    with pytest.raises(ValueError):
+        Estimator(net=net, loss=gluon.loss.L2Loss(),
+                  train_metrics=["not-a-metric"])
