@@ -52,5 +52,8 @@ from . import ops  # noqa: F401
 from . import models  # noqa: F401
 from . import operator  # noqa: F401
 from . import contrib  # noqa: F401
+from . import symbol  # noqa: F401
+from . import symbol as sym  # noqa: F401
+from . import onnx  # noqa: F401
 
 device_module = device

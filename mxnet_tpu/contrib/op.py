@@ -270,7 +270,15 @@ def roi_align(data, rois, pooled_size, spatial_scale=1.0, sample_ratio=-1,
     def fn(x, r):
         N, C, H, W = x.shape
         offset = 0.5 if aligned else 0.0
-        sr = sample_ratio if sample_ratio > 0 else 2
+        if sample_ratio > 0:
+            sr_h = sr_w = sample_ratio
+        else:
+            # reference uses ceil(roi_size/pooled) per ROI (data-dependent);
+            # the static stand-in ceil(feature/pooled) matches it for
+            # image-spanning ROIs and oversamples smaller ones, keeping the
+            # grid shape jittable
+            sr_h = max(1, -(-H // ph))
+            sr_w = max(1, -(-W // pw))
 
         def one_roi(roi):
             bidx = roi[0].astype(jnp.int32)
@@ -283,13 +291,17 @@ def roi_align(data, rois, pooled_size, spatial_scale=1.0, sample_ratio=-1,
             rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
             bin_w = rw / pw
             bin_h = rh / ph
-            # sample grid: (ph, pw, sr, sr)
+            # sample grid: (ph, sr_h) and (pw, sr_w)
             iy = jnp.arange(ph)[:, None] * bin_h + \
-                (jnp.arange(sr) + 0.5)[None, :] * (bin_h / sr) + y1
+                (jnp.arange(sr_h) + 0.5)[None, :] * (bin_h / sr_h) + y1
             ix = jnp.arange(pw)[:, None] * bin_w + \
-                (jnp.arange(sr) + 0.5)[None, :] * (bin_w / sr) + x1
+                (jnp.arange(sr_w) + 0.5)[None, :] * (bin_w / sr_w) + x1
 
             def bilinear(feat, yy, xx):
+                # samples outside [-1, size] contribute zero
+                # (`roi_align.cc` bilinear_interpolate)
+                vy = (yy >= -1.0) & (yy <= H)
+                vx = (xx >= -1.0) & (xx <= W)
                 y0 = jnp.clip(jnp.floor(yy), 0, H - 1)
                 x0 = jnp.clip(jnp.floor(xx), 0, W - 1)
                 y1i = jnp.clip(y0 + 1, 0, H - 1)
@@ -302,16 +314,17 @@ def roi_align(data, rois, pooled_size, spatial_scale=1.0, sample_ratio=-1,
                 v01 = feat[:, y0, :][:, :, x1i]
                 v10 = feat[:, y1i, :][:, :, x0]
                 v11 = feat[:, y1i, :][:, :, x1i]
-                return (v00 * (1 - wy)[None, :, None] * (1 - wx)[None, None, :]
-                        + v01 * (1 - wy)[None, :, None] * wx[None, None, :]
-                        + v10 * wy[None, :, None] * (1 - wx)[None, None, :]
-                        + v11 * wy[None, :, None] * wx[None, None, :])
+                out = (v00 * (1 - wy)[None, :, None] * (1 - wx)[None, None, :]
+                       + v01 * (1 - wy)[None, :, None] * wx[None, None, :]
+                       + v10 * wy[None, :, None] * (1 - wx)[None, None, :]
+                       + v11 * wy[None, :, None] * wx[None, None, :])
+                return out * (vy[:, None] & vx[None, :])[None, :, :]
 
             feat = x[bidx]                          # (C, H, W)
-            ys = iy.reshape(-1)                     # (ph*sr,)
-            xs = ix.reshape(-1)                     # (pw*sr,)
-            sampled = bilinear(feat, ys, xs)        # (C, ph*sr, pw*sr)
-            sampled = sampled.reshape(C, ph, sr, pw, sr)
+            ys = iy.reshape(-1)                     # (ph*sr_h,)
+            xs = ix.reshape(-1)                     # (pw*sr_w,)
+            sampled = bilinear(feat, ys, xs)        # (C, ph*sr_h, pw*sr_w)
+            sampled = sampled.reshape(C, ph, sr_h, pw, sr_w)
             binmean = sampled.mean(axis=(2, 4))     # (C, ph, pw)
             if position_sensitive:
                 # R-FCN PSROIAlign: C = outC*ph*pw; bin (i,j) reads its own
@@ -420,11 +433,16 @@ def MultiBoxPrior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
         step_x = steps[1] if steps[1] > 0 else 1.0 / W
         cy = (jnp.arange(H) + offsets[0]) * step_y
         cx = (jnp.arange(W) + offsets[1]) * step_x
+        # aspect correction: widths scale by H/W so anchors are square in
+        # pixel space (`multibox_prior.cc:51,63`)
+        aspect = H / W
         wh = []
         for s in sizes:
-            wh.append((s * _onp.sqrt(ratios[0]), s / _onp.sqrt(ratios[0])))
+            wh.append((s * aspect * _onp.sqrt(ratios[0]),
+                       s / _onp.sqrt(ratios[0])))
         for r in ratios[1:]:
-            wh.append((sizes[0] * _onp.sqrt(r), sizes[0] / _onp.sqrt(r)))
+            wh.append((sizes[0] * aspect * _onp.sqrt(r),
+                       sizes[0] / _onp.sqrt(r)))
         wh = jnp.asarray(wh)                       # (A, 2)
         cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")
         centers = jnp.stack([cxg, cyg], axis=-1).reshape(-1, 1, 2)

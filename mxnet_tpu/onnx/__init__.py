@@ -1,0 +1,127 @@
+"""`mx.onnx` — ONNX model export (parity: `python/mxnet/onnx/mx2onnx/`).
+
+`export_model` accepts a Gluon `HybridBlock` (traced via the same
+functional bridge that powers jit/sharding) or an `mx.sym.Symbol`, and
+writes a self-contained ONNX `ModelProto` — no `onnx` package required
+(see `_proto.py`). Per-primitive converters live in `_export.py`.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as _onp
+
+from ..base import MXNetError
+from ..device import current_device
+from ..ndarray.ndarray import ndarray, from_jax
+from ._export import jaxpr_to_onnx, UnsupportedOp  # noqa: F401
+from ._runtime import run_model  # noqa: F401
+from . import _proto  # noqa: F401
+
+__all__ = ["export_model", "run_model", "check_model", "UnsupportedOp"]
+
+
+def check_model(path: str, inputs: Dict[str, "_onp.ndarray"],
+                expected, rtol=1e-4, atol=1e-5):
+    """Run the exported graph with the reference interpreter and compare
+    against `expected` outputs (list of arrays). Raises on mismatch."""
+    outs = run_model(path, inputs)
+    vals = list(outs.values())
+    if len(vals) != len(expected):
+        raise MXNetError(f"output arity {len(vals)} != {len(expected)}")
+    for got, exp in zip(vals, expected):
+        _onp.testing.assert_allclose(got, _onp.asarray(exp), rtol=rtol,
+                                     atol=atol)
+    return True
+
+
+def export_model(model, path: str, example_inputs=None, input_names=None,
+                 output_names=None, opset: int = 12, args: Dict = None):
+    """Export `model` to `path` (ONNX). Returns the path.
+
+    - HybridBlock: pass `example_inputs` (ndarray or tuple of ndarrays);
+      parameters become graph initializers.
+    - Symbol: pass `args` binding every `list_arguments()` name to an
+      ndarray; variables bound in `args` that carry `_is_param=True` (or
+      listed under `input_names`) control which become graph inputs vs
+      initializers — by default all Symbol variables are graph inputs.
+    """
+    from ..gluon.block import Block, functional_call
+    from ..symbol.symbol import Symbol
+
+    if isinstance(model, Symbol):
+        return _export_symbol(model, path, args or {}, input_names,
+                              output_names, opset)
+    if not isinstance(model, Block):
+        raise MXNetError("export_model expects a Gluon Block or mx.sym.Symbol")
+
+    if example_inputs is None:
+        raise MXNetError("export_model(HybridBlock) requires example_inputs")
+    if not isinstance(example_inputs, (tuple, list)):
+        example_inputs = (example_inputs,)
+    example_inputs = tuple(
+        x if isinstance(x, ndarray) else from_jax(_to_jax(x))
+        for x in example_inputs)
+
+    # one eager call resolves deferred shapes
+    model(*example_inputs)
+    params = {n: p._data._data for n, p in model.collect_params().items()
+              if p._data is not None}
+
+    def fn(pvals, *xs):
+        out, _ = functional_call(model, pvals, *xs, training=False)
+        leaves = jax.tree_util.tree_leaves(
+            out, is_leaf=lambda x: isinstance(x, ndarray))
+        return tuple(o._data if isinstance(o, ndarray) else o
+                     for o in leaves)
+
+    closed = jax.make_jaxpr(fn)(params, *[x._data for x in example_inputs])
+    # invars order = tree-flatten of the params dict (sorted keys), then xs
+    flat_names = sorted(params)
+    param_vals = {n: _onp.asarray(params[n]) for n in flat_names}
+    in_names = input_names or [f"data{i}" if i else "data"
+                               for i in range(len(example_inputs))]
+    buf = jaxpr_to_onnx(closed, param_vals, in_names, output_names,
+                        graph_name=type(model).__name__, opset=opset)
+    with open(path, "wb") as f:
+        f.write(buf)
+    return path
+
+
+def _export_symbol(sym, path, args, input_names, output_names, opset):
+    arg_names = sym.list_arguments()
+    missing = [n for n in arg_names if n not in args]
+    if missing:
+        raise MXNetError(f"export_model(Symbol) missing bindings for "
+                         f"{missing}")
+
+    if input_names is None:
+        order = list(arg_names)          # all variables are graph inputs
+        param_vals = {}
+        input_names = arg_names
+    else:
+        # jaxpr_to_onnx expects params first, inputs last
+        order = [n for n in arg_names if n not in input_names] + \
+                [n for n in arg_names if n in input_names]
+        param_vals = {n: _onp.asarray(args[n]._data) for n in arg_names
+                      if n not in input_names}
+        input_names = [n for n in arg_names if n in input_names]
+
+    def fn(*vals):
+        bindings = {n: from_jax(v, current_device())
+                    for n, v in zip(order, vals)}
+        outs = sym.eval(**bindings)
+        return tuple(o._data for o in outs)
+
+    closed = jax.make_jaxpr(fn)(*[args[n]._data for n in order])
+    buf = jaxpr_to_onnx(closed, param_vals, list(input_names), output_names,
+                        graph_name="symbol", opset=opset)
+    with open(path, "wb") as f:
+        f.write(buf)
+    return path
+
+
+def _to_jax(x):
+    import jax.numpy as jnp
+    return jnp.asarray(x)

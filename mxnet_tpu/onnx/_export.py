@@ -1,0 +1,479 @@
+"""jaxpr → ONNX graph conversion.
+
+Where the reference exports ONNX by walking symbol-graph nodes with per-op
+translation tables (`python/mxnet/onnx/mx2onnx/_op_translations/`), the
+TPU-native exporter traces the model to a jaxpr (the same trace that powers
+`jit`) and converts XLA-level primitives. One converter table therefore
+covers every front-end op that lowers to supported primitives — layers,
+`mx.np` math, and user compositions alike.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as _onp
+
+from ..base import MXNetError
+from . import _proto as P
+
+_LETTERS = "abcdefghijklmnopqrstuvwxyz"
+
+
+class UnsupportedOp(MXNetError):
+    pass
+
+
+class _Graph:
+    """Accumulates ONNX nodes/initializers with unique tensor names."""
+
+    def __init__(self):
+        self.nodes: List[bytes] = []
+        self.initializers: List[bytes] = []
+        self.names: Dict[object, str] = {}   # jaxpr Var -> tensor name
+        self._counter = itertools.count()
+        self._const_cache: Dict[bytes, str] = {}
+
+    def fresh(self, hint="t"):
+        return f"{hint}_{next(self._counter)}"
+
+    def add_node(self, op, inputs, outputs, **attrs):
+        self.nodes.append(P.node(op, list(inputs), list(outputs),
+                                 name=self.fresh(op.lower()), attrs=attrs))
+
+    def add_const(self, arr, hint="const"):
+        arr = _onp.asarray(arr)
+        if arr.dtype == _onp.float64:
+            arr = arr.astype(_onp.float32)
+        if arr.dtype == bool:
+            raw = arr.astype(_onp.uint8).tobytes()
+        else:
+            raw = arr.tobytes()
+        key = (str(arr.dtype), arr.shape, raw)
+        cache_key = repr(key).encode() if len(raw) < 256 else None
+        if cache_key and cache_key in self._const_cache:
+            return self._const_cache[cache_key]
+        name = self.fresh(hint)
+        onnx_dt = P.DTYPE_TO_ONNX[str(arr.dtype)]
+        self.initializers.append(P.tensor(name, arr.shape, onnx_dt, raw))
+        if cache_key:
+            self._const_cache[cache_key] = name
+        return name
+
+    def name_of(self, var):
+        """Tensor name for a jaxpr atom (Var or Literal)."""
+        from jax._src.core import Literal
+        if isinstance(var, Literal):
+            return self.add_const(var.val, "lit")
+        if var not in self.names:
+            self.names[var] = self.fresh("v")
+        return self.names[var]
+
+
+# ---------------------------------------------------------------------------
+# primitive converters
+# ---------------------------------------------------------------------------
+
+_SIMPLE = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div", "rem": "Mod",
+    "max": "Max", "min": "Min", "pow": "Pow", "neg": "Neg", "exp": "Exp",
+    "log": "Log", "tanh": "Tanh", "sqrt": "Sqrt", "abs": "Abs",
+    "sign": "Sign", "floor": "Floor", "ceil": "Ceil", "round": "Round",
+    "logistic": "Sigmoid", "erf": "Erf", "sin": "Sin", "cos": "Cos",
+    "tan": "Tan", "asin": "Asin", "acos": "Acos", "atan": "Atan",
+    "sinh": "Sinh", "cosh": "Cosh", "asinh": "Asinh", "acosh": "Acosh",
+    "atanh": "Atanh", "and": "And", "or": "Or", "xor": "Xor", "not": "Not",
+    "stop_gradient": "Identity", "copy": "Identity",
+}
+
+_COMPARE = {"eq": ("Equal", False), "lt": ("Less", False),
+            "le": ("LessOrEqual", False), "gt": ("Greater", False),
+            "ge": ("GreaterOrEqual", False), "ne": ("Equal", True)}
+
+
+def _einsum_equation(dnums, lhs_rank, rhs_rank):
+    (lc, rc), (lb, rb) = dnums
+    letters = iter(_LETTERS)
+    lhs = [None] * lhs_rank
+    rhs = [None] * rhs_rank
+    # batch dims share letters
+    for i, j in zip(lb, rb):
+        ch = next(letters)
+        lhs[i] = ch
+        rhs[j] = ch
+    # contracting dims share letters
+    for i, j in zip(lc, rc):
+        ch = next(letters)
+        lhs[i] = ch
+        rhs[j] = ch
+    for i in range(lhs_rank):
+        if lhs[i] is None:
+            lhs[i] = next(letters)
+    for j in range(rhs_rank):
+        if rhs[j] is None:
+            rhs[j] = next(letters)
+    out = [lhs[i] for i in lb] \
+        + [lhs[i] for i in range(lhs_rank) if i not in lb and i not in lc] \
+        + [rhs[j] for j in range(rhs_rank) if j not in rb and j not in rc]
+    return f"{''.join(lhs)},{''.join(rhs)}->{''.join(out)}"
+
+
+_ONNX_DT_FROM_JAX = {
+    "float32": P.FLOAT, "float16": P.FLOAT16, "bfloat16": P.BFLOAT16,
+    "float64": P.FLOAT, "int32": P.INT32, "int64": P.INT64,
+    "int8": P.INT8, "uint8": P.UINT8, "bool": P.BOOL,
+}
+
+
+def _convert_eqn(g: _Graph, eqn):
+    prim = eqn.primitive.name
+    ins = [g.name_of(v) for v in eqn.invars]
+    outs = [g.name_of(v) for v in eqn.outvars]
+    p = eqn.params
+
+    if prim in _SIMPLE:
+        g.add_node(_SIMPLE[prim], ins, outs)
+        return
+    if prim in _COMPARE:
+        op, negate = _COMPARE[prim]
+        if negate:
+            tmp = g.fresh("cmp")
+            g.add_node(op, ins, [tmp])
+            g.add_node("Not", [tmp], outs)
+        else:
+            g.add_node(op, ins, outs)
+        return
+
+    if prim == "erfc":
+        one = g.add_const(_onp.float32(1.0))
+        tmp = g.fresh("erf")
+        g.add_node("Erf", ins, [tmp])
+        g.add_node("Sub", [one, tmp], outs)
+        return
+    if prim == "square":
+        g.add_node("Mul", [ins[0], ins[0]], outs)
+        return
+    if prim == "integer_pow":
+        e = g.add_const(_onp.asarray(p["y"], dtype=_onp.float32), "exp")
+        g.add_node("Pow", [ins[0], e], outs)
+        return
+    if prim == "rsqrt":
+        tmp = g.fresh("sqrt")
+        g.add_node("Sqrt", ins, [tmp])
+        g.add_node("Reciprocal", [tmp], outs)
+        return
+    if prim == "log1p":
+        one = g.add_const(_onp.float32(1.0))
+        tmp = g.fresh("add1")
+        g.add_node("Add", [ins[0], one], [tmp])
+        g.add_node("Log", [tmp], outs)
+        return
+    if prim == "expm1":
+        one = g.add_const(_onp.float32(1.0))
+        tmp = g.fresh("exp")
+        g.add_node("Exp", ins, [tmp])
+        g.add_node("Sub", [tmp, one], outs)
+        return
+    if prim == "convert_element_type":
+        to = _ONNX_DT_FROM_JAX.get(str(_onp.dtype(p["new_dtype"])))
+        if to is None:
+            raise UnsupportedOp(f"cast to {p['new_dtype']}")
+        g.add_node("Cast", ins, outs, to=to)
+        return
+    if prim == "reshape":
+        shape = g.add_const(_onp.asarray(p["new_sizes"], dtype=_onp.int64),
+                            "shape")
+        g.add_node("Reshape", [ins[0], shape], outs)
+        return
+    if prim == "squeeze":
+        out_shape = tuple(eqn.outvars[0].aval.shape)
+        shape = g.add_const(_onp.asarray(out_shape, dtype=_onp.int64),
+                            "shape")
+        g.add_node("Reshape", [ins[0], shape], outs)
+        return
+    if prim == "expand_dims":
+        out_shape = tuple(eqn.outvars[0].aval.shape)
+        shape = g.add_const(_onp.asarray(out_shape, dtype=_onp.int64),
+                            "shape")
+        g.add_node("Reshape", [ins[0], shape], outs)
+        return
+    if prim == "transpose":
+        g.add_node("Transpose", ins, outs,
+                   perm=[int(x) for x in p["permutation"]])
+        return
+    if prim == "broadcast_in_dim":
+        target = tuple(int(s) for s in p["shape"])
+        bdims = tuple(int(d) for d in p["broadcast_dimensions"])
+        in_shape = tuple(eqn.invars[0].aval.shape)
+        # step 1: reshape to rank(target) with 1s in non-mapped dims
+        interm = [1] * len(target)
+        for src, dst in enumerate(bdims):
+            interm[dst] = in_shape[src] if src < len(in_shape) else 1
+        cur = ins[0]
+        if tuple(interm) != in_shape:
+            shape_c = g.add_const(_onp.asarray(interm, dtype=_onp.int64),
+                                  "shape")
+            tmp = g.fresh("rsh")
+            g.add_node("Reshape", [cur, shape_c], [tmp])
+            cur = tmp
+        if tuple(interm) == target:
+            g.add_node("Identity", [cur], outs)
+        else:
+            shape_c = g.add_const(_onp.asarray(target, dtype=_onp.int64),
+                                  "shape")
+            g.add_node("Expand", [cur, shape_c], outs)
+        return
+    if prim == "dot_general":
+        eqs = _einsum_equation(p["dimension_numbers"],
+                               len(eqn.invars[0].aval.shape),
+                               len(eqn.invars[1].aval.shape))
+        g.add_node("Einsum", ins, outs, equation=eqs)
+        return
+    if prim == "conv_general_dilated":
+        dn = p["dimension_numbers"]
+        spec = (dn.lhs_spec, dn.rhs_spec, dn.out_spec)
+        nd = len(dn.lhs_spec) - 2
+        expect = (tuple(range(nd + 2)),) * 3  # NCHW/OIHW/NCHW
+        if spec != expect:
+            raise UnsupportedOp(f"conv layout {spec}")
+        if any(d != 1 for d in p["lhs_dilation"]):
+            raise UnsupportedOp("transposed conv export")
+        pads = [int(lo) for lo, hi in p["padding"]] + \
+               [int(hi) for lo, hi in p["padding"]]
+        g.add_node("Conv", ins, outs,
+                   strides=[int(s) for s in p["window_strides"]],
+                   pads=pads,
+                   dilations=[int(d) for d in p["rhs_dilation"]],
+                   group=int(p["feature_group_count"]))
+        return
+    if prim in ("reduce_sum", "reduce_max", "reduce_min", "reduce_prod"):
+        op = {"reduce_sum": "ReduceSum", "reduce_max": "ReduceMax",
+              "reduce_min": "ReduceMin", "reduce_prod": "ReduceProd"}[prim]
+        g.add_node(op, ins, outs, axes=[int(a) for a in p["axes"]],
+                   keepdims=0)
+        return
+    if prim in ("reduce_and", "reduce_or"):
+        # cast to int32, reduce, cast back
+        tmp = g.fresh("int")
+        g.add_node("Cast", ins, [tmp], to=P.INT32)
+        red = g.fresh("red")
+        op = "ReduceMin" if prim == "reduce_and" else "ReduceMax"
+        g.add_node(op, [tmp], [red], axes=[int(a) for a in p["axes"]],
+                   keepdims=0)
+        g.add_node("Cast", [red], outs, to=P.BOOL)
+        return
+    if prim in ("argmax", "argmin"):
+        axes = p["axes"]
+        if len(axes) != 1:
+            raise UnsupportedOp("multi-axis argmax")
+        op = "ArgMax" if prim == "argmax" else "ArgMin"
+        idx = g.fresh("arg")
+        g.add_node(op, ins, [idx], axis=int(axes[0]), keepdims=0)
+        want = _ONNX_DT_FROM_JAX.get(str(_onp.dtype(p["index_dtype"])),
+                                     P.INT64)
+        g.add_node("Cast", [idx], outs, to=want)
+        return
+    if prim in ("reduce_window_max", "reduce_window_sum",
+                "reduce_window_min"):
+        _convert_reduce_window(g, eqn, prim, ins, outs)
+        return
+    if prim == "select_n":
+        if len(ins) != 3:
+            raise UnsupportedOp("select_n with >2 cases")
+        # select_n(pred, on_false, on_true) -> Where(pred, on_true, on_false)
+        g.add_node("Where", [ins[0], ins[2], ins[1]], outs)
+        return
+    if prim == "clamp":
+        # clamp(min, x, max) -> Clip(x, min, max)
+        g.add_node("Clip", [ins[1], ins[0], ins[2]], outs)
+        return
+    if prim == "concatenate":
+        g.add_node("Concat", ins, outs, axis=int(p["dimension"]))
+        return
+    if prim == "slice":
+        starts = g.add_const(_onp.asarray(p["start_indices"], _onp.int64))
+        ends = g.add_const(_onp.asarray(p["limit_indices"], _onp.int64))
+        axes = g.add_const(_onp.arange(len(p["start_indices"]),
+                                       dtype=_onp.int64))
+        strides = p["strides"] or [1] * len(p["start_indices"])
+        steps = g.add_const(_onp.asarray(strides, _onp.int64))
+        g.add_node("Slice", [ins[0], starts, ends, axes, steps], outs)
+        return
+    if prim == "pad":
+        lo_hi_interior = p["padding_config"]
+        if any(i != 0 for _, _, i in lo_hi_interior):
+            raise UnsupportedOp("interior padding")
+        if any(lo < 0 or hi < 0 for lo, hi, _ in lo_hi_interior):
+            # negative padding == cropping; express as Slice
+            _convert_negative_pad(g, eqn, ins, outs)
+            return
+        pads = [int(lo) for lo, _, _ in lo_hi_interior] + \
+               [int(hi) for _, hi, _ in lo_hi_interior]
+        pads_c = g.add_const(_onp.asarray(pads, _onp.int64))
+        g.add_node("Pad", [ins[0], pads_c, ins[1]], outs, mode="constant")
+        return
+    if prim == "iota":
+        aval = eqn.outvars[0].aval
+        val = jax.lax.iota(aval.dtype, aval.shape[p["dimension"]])
+        arr = _onp.asarray(val)
+        target = _onp.broadcast_to(
+            arr.reshape([-1 if i == p["dimension"] else 1
+                         for i in range(len(aval.shape))]), aval.shape)
+        g.names[eqn.outvars[0]] = g.add_const(_onp.ascontiguousarray(target),
+                                              "iota")
+        return
+    if prim == "gather":
+        _convert_gather(g, eqn, ins, outs)
+        return
+    if prim == "cumsum":
+        axis = g.add_const(_onp.asarray(p["axis"], _onp.int64))
+        g.add_node("CumSum", [ins[0], axis], outs,
+                   reverse=1 if p.get("reverse") else 0)
+        return
+    if prim in ("jit", "pjit", "closed_call", "core_call", "custom_jvp_call",
+                "custom_vjp_call", "custom_vjp_call_jaxpr", "remat",
+                "checkpoint", "custom_jvp_call_jaxpr"):
+        sub = p.get("jaxpr") or p.get("call_jaxpr") or p.get("fun_jaxpr")
+        if sub is None:
+            raise UnsupportedOp(f"{prim} without inner jaxpr")
+        closed = sub if hasattr(sub, "jaxpr") else None
+        inner = closed.jaxpr if closed else sub
+        consts = closed.consts if closed else []
+        for cv, cval in zip(inner.constvars, consts):
+            g.names[cv] = g.add_const(_onp.asarray(cval), "const")
+        for iv, outer in zip(inner.invars, eqn.invars):
+            g.names[iv] = g.name_of(outer)
+        for sub_eqn in inner.eqns:
+            _convert_eqn(g, sub_eqn)
+        for ov, outer in zip(inner.outvars, eqn.outvars):
+            g.add_node("Identity", [g.name_of(ov)], [g.name_of(outer)])
+        return
+
+    raise UnsupportedOp(f"no ONNX converter for primitive '{prim}'")
+
+
+def _convert_reduce_window(g, eqn, prim, ins, outs):
+    p = eqn.params
+    wd = tuple(int(w) for w in p["window_dimensions"])
+    ws = tuple(int(s) for s in p["window_strides"])
+    pads = tuple((int(lo), int(hi)) for lo, hi in p["padding"])
+    dil = p.get("window_dilation")
+    if dil is not None and any(d != 1 for d in dil):
+        raise UnsupportedOp("dilated pooling window")
+    if len(wd) < 3 or wd[0] != 1 or wd[1] != 1:
+        raise UnsupportedOp(f"reduce_window over dims {wd}")
+    kernel = list(wd[2:])
+    strides = list(ws[2:])
+    sp_pads = pads[2:]
+    onnx_pads = [lo for lo, _ in sp_pads] + [hi for _, hi in sp_pads]
+    if prim == "reduce_window_max":
+        g.add_node("MaxPool", ins, outs, kernel_shape=kernel,
+                   strides=strides, pads=onnx_pads)
+    elif prim == "reduce_window_min":
+        neg = g.fresh("neg")
+        g.add_node("Neg", ins, [neg])
+        pooled = g.fresh("pool")
+        g.add_node("MaxPool", [neg], [pooled], kernel_shape=kernel,
+                   strides=strides, pads=onnx_pads)
+        g.add_node("Neg", [pooled], outs)
+    else:  # sum = avg * window_count (count_include_pad for exactness)
+        pooled = g.fresh("pool")
+        g.add_node("AveragePool", ins, [pooled], kernel_shape=kernel,
+                   strides=strides, pads=onnx_pads, count_include_pad=1)
+        count = g.add_const(_onp.float32(_onp.prod(kernel)))
+        g.add_node("Mul", [pooled, count], outs)
+
+
+def _convert_negative_pad(g, eqn, ins, outs):
+    cfg = eqn.params["padding_config"]
+    in_shape = eqn.invars[0].aval.shape
+    starts, ends = [], []
+    for (lo, hi, _), dim in zip(cfg, in_shape):
+        if lo > 0 or hi > 0:
+            raise UnsupportedOp("mixed positive/negative padding")
+        starts.append(-lo)
+        ends.append(dim + hi)
+    s = g.add_const(_onp.asarray(starts, _onp.int64))
+    e = g.add_const(_onp.asarray(ends, _onp.int64))
+    g.add_node("Slice", [ins[0], s, e], outs)
+
+
+def _convert_gather(g, eqn, ins, outs):
+    """Map the common `jnp.take(x, idx, axis)` gather to ONNX Gather."""
+    p = eqn.params
+    dn = p["dimension_numbers"]
+    operand_shape = tuple(eqn.invars[0].aval.shape)
+    slice_sizes = tuple(int(s) for s in p["slice_sizes"])
+    if len(dn.start_index_map) != 1:
+        raise UnsupportedOp("general gather")
+    axis = dn.start_index_map[0]
+    if dn.collapsed_slice_dims != (axis,):
+        raise UnsupportedOp("general gather")
+    expected = tuple(1 if i == axis else d
+                     for i, d in enumerate(operand_shape))
+    if slice_sizes != expected:
+        raise UnsupportedOp("general gather (partial slices)")
+    # indices last dim is 1 → drop it
+    idx_shape = tuple(eqn.invars[1].aval.shape)
+    idx_in = ins[1]
+    if idx_shape and idx_shape[-1] == 1:
+        shape_c = g.add_const(_onp.asarray(idx_shape[:-1], _onp.int64),
+                              "shape")
+        tmp = g.fresh("idx")
+        g.add_node("Reshape", [idx_in, shape_c], [tmp])
+        idx_in = tmp
+    g.add_node("Gather", [ins[0], idx_in], outs, axis=int(axis))
+
+
+# ---------------------------------------------------------------------------
+# top-level conversion
+# ---------------------------------------------------------------------------
+
+def jaxpr_to_onnx(closed_jaxpr, param_vals: Dict[str, _onp.ndarray],
+                  input_names: List[str], output_names: Optional[List[str]],
+                  graph_name="mxnet_tpu", opset=12) -> bytes:
+    """Convert a ClosedJaxpr whose invars are [flat params..., inputs...]
+    into serialized ModelProto bytes."""
+    jaxpr = closed_jaxpr.jaxpr
+    g = _Graph()
+
+    for cv, cval in zip(jaxpr.constvars, closed_jaxpr.consts):
+        g.names[cv] = g.add_const(_onp.asarray(cval), "const")
+
+    flat_param_names = list(param_vals)
+    n_params = len(flat_param_names)
+    graph_inputs = []
+    for i, iv in enumerate(jaxpr.invars):
+        if i < n_params:
+            name = flat_param_names[i]
+            g.names[iv] = name
+            arr = _onp.asarray(param_vals[name])
+            if arr.dtype == _onp.float64:
+                arr = arr.astype(_onp.float32)
+            g.initializers.append(P.tensor(
+                name, arr.shape, P.DTYPE_TO_ONNX[str(arr.dtype)],
+                arr.tobytes()))
+        else:
+            name = input_names[i - n_params]
+            g.names[iv] = name
+            aval = iv.aval
+            dt = _ONNX_DT_FROM_JAX.get(str(aval.dtype), P.FLOAT)
+            graph_inputs.append(P.value_info(name, dt, tuple(aval.shape)))
+
+    for eqn in jaxpr.eqns:
+        _convert_eqn(g, eqn)
+
+    graph_outputs = []
+    out_names = output_names or [f"output{i}"
+                                 for i in range(len(jaxpr.outvars))]
+    for ov, oname in zip(jaxpr.outvars, out_names):
+        g.add_node("Identity", [g.name_of(ov)], [oname])
+        aval = ov.aval
+        dt = _ONNX_DT_FROM_JAX.get(str(aval.dtype), P.FLOAT)
+        graph_outputs.append(P.value_info(oname, dt, tuple(aval.shape)))
+
+    body = P.graph(g.nodes, graph_name, g.initializers, graph_inputs,
+                   graph_outputs)
+    return P.model(body, opset=opset)

@@ -1,0 +1,265 @@
+"""Minimal protobuf wire-format writer/reader for ONNX messages.
+
+The environment has no `onnx` package, so the exporter serializes
+`ModelProto` by hand against the public ONNX protobuf schema
+(onnx/onnx.proto, proto3). Only the fields the exporter emits are
+implemented. The reader exists for round-trip verification in tests.
+
+Wire format: tag = (field_number << 3) | wire_type; wire types used:
+0 = varint, 2 = length-delimited, 5 = 32-bit.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Tuple, Union
+
+# -- ONNX enums --------------------------------------------------------------
+
+# TensorProto.DataType
+FLOAT, UINT8, INT8, INT32, INT64, BOOL = 1, 2, 3, 6, 7, 9
+FLOAT16, DOUBLE, UINT32, UINT64, BFLOAT16 = 10, 11, 12, 13, 16
+
+DTYPE_TO_ONNX = {
+    "float32": FLOAT, "float64": DOUBLE, "float16": FLOAT16,
+    "bfloat16": BFLOAT16, "int8": INT8, "uint8": UINT8, "int32": INT32,
+    "int64": INT64, "uint32": UINT32, "uint64": UINT64, "bool": BOOL,
+}
+
+# AttributeProto.AttributeType
+ATTR_FLOAT, ATTR_INT, ATTR_STRING, ATTR_TENSOR = 1, 2, 3, 4
+ATTR_FLOATS, ATTR_INTS, ATTR_STRINGS = 6, 7, 8
+
+
+# -- writer ------------------------------------------------------------------
+
+def _varint(value: int) -> bytes:
+    if value < 0:
+        value += 1 << 64
+    out = bytearray()
+    while True:
+        bits = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(bits | 0x80)
+        else:
+            out.append(bits)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def w_varint(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(int(value))
+
+
+def w_bytes(field: int, data: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(data)) + data
+
+
+def w_string(field: int, s: str) -> bytes:
+    return w_bytes(field, s.encode("utf-8"))
+
+
+def w_message(field: int, body: bytes) -> bytes:
+    return w_bytes(field, body)
+
+
+def w_packed_int64(field: int, values) -> bytes:
+    body = b"".join(_varint(int(v)) for v in values)
+    return w_bytes(field, body)
+
+
+def w_float(field: int, value: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", float(value))
+
+
+# -- message builders --------------------------------------------------------
+
+def attribute(name: str, value) -> bytes:
+    """AttributeProto: name=1, f=2, i=3, s=4, t=5, floats=7, ints=8, type=20."""
+    body = w_string(1, name)
+    if isinstance(value, bool):
+        body += w_varint(3, int(value)) + w_varint(20, ATTR_INT)
+    elif isinstance(value, int):
+        body += w_varint(3, value) + w_varint(20, ATTR_INT)
+    elif isinstance(value, float):
+        body += w_float(2, value) + w_varint(20, ATTR_FLOAT)
+    elif isinstance(value, str):
+        body += w_bytes(4, value.encode()) + w_varint(20, ATTR_STRING)
+    elif isinstance(value, bytes):
+        body += w_message(5, value) + w_varint(20, ATTR_TENSOR)
+    elif isinstance(value, (list, tuple)):
+        if all(isinstance(v, int) for v in value):
+            for v in value:
+                body += w_varint(8, v)
+            body += w_varint(20, ATTR_INTS)
+        elif all(isinstance(v, (int, float)) for v in value):
+            for v in value:
+                body += w_float(7, float(v))
+            body += w_varint(20, ATTR_FLOATS)
+        else:
+            raise TypeError(f"unsupported attribute list {value!r}")
+    else:
+        raise TypeError(f"unsupported attribute {value!r}")
+    return body
+
+
+def node(op_type: str, inputs: List[str], outputs: List[str], name: str = "",
+         attrs: Dict[str, object] = None) -> bytes:
+    """NodeProto: input=1, output=2, name=3, op_type=4, attribute=5."""
+    body = b"".join(w_string(1, i) for i in inputs)
+    body += b"".join(w_string(2, o) for o in outputs)
+    if name:
+        body += w_string(3, name)
+    body += w_string(4, op_type)
+    for k, v in (attrs or {}).items():
+        body += w_message(5, attribute(k, v))
+    return body
+
+
+def tensor(name: str, dims: Tuple[int, ...], onnx_dtype: int,
+           raw: bytes) -> bytes:
+    """TensorProto: dims=1, data_type=2, name=8, raw_data=9."""
+    body = b"".join(w_varint(1, d) for d in dims)
+    body += w_varint(2, onnx_dtype)
+    body += w_string(8, name)
+    body += w_bytes(9, raw)
+    return body
+
+
+def tensor_type(onnx_dtype: int, shape: Tuple[int, ...]) -> bytes:
+    """TypeProto{tensor_type=1{elem_type=1, shape=2{dim=1{dim_value=1}}}}"""
+    dims = b"".join(w_message(1, w_varint(1, d)) for d in shape)
+    tshape = w_message(2, dims)
+    return w_message(1, w_varint(1, onnx_dtype) + tshape)
+
+
+def value_info(name: str, onnx_dtype: int, shape: Tuple[int, ...]) -> bytes:
+    """ValueInfoProto: name=1, type=2."""
+    return w_string(1, name) + w_message(2, tensor_type(onnx_dtype, shape))
+
+
+def graph(nodes: List[bytes], name: str, initializers: List[bytes],
+          inputs: List[bytes], outputs: List[bytes]) -> bytes:
+    """GraphProto: node=1, name=2, initializer=5, input=11, output=12."""
+    body = b"".join(w_message(1, n) for n in nodes)
+    body += w_string(2, name)
+    body += b"".join(w_message(5, t) for t in initializers)
+    body += b"".join(w_message(11, vi) for vi in inputs)
+    body += b"".join(w_message(12, vi) for vi in outputs)
+    return body
+
+
+def model(graph_body: bytes, opset: int = 13,
+          producer: str = "mxnet_tpu") -> bytes:
+    """ModelProto: ir_version=1, producer_name=2, graph=7, opset_import=8."""
+    opset_id = w_varint(2, opset)  # OperatorSetIdProto: domain=1, version=2
+    return (w_varint(1, 8)  # IR version 8
+            + w_string(2, producer)
+            + w_message(7, graph_body)
+            + w_message(8, opset_id))
+
+
+# -- reader (for tests) ------------------------------------------------------
+
+def _read_varint(buf: bytes, pos: int):
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def parse(buf: bytes) -> Dict[int, list]:
+    """Parse one message into {field_number: [raw values]} (varints as int,
+    length-delimited as bytes, 32-bit as raw 4 bytes)."""
+    out: Dict[int, list] = {}
+    pos = 0
+    while pos < len(buf):
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            val = buf[pos:pos + 4]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        out.setdefault(field, []).append(val)
+    return out
+
+
+def parse_model(buf: bytes) -> dict:
+    """Decode the subset we write, returning a friendly dict."""
+    m = parse(buf)
+    g = parse(m[7][0])
+    def s(b):
+        return b.decode("utf-8")
+
+    def parse_node(nb):
+        n = parse(nb)
+        attrs = {}
+        for ab in n.get(5, []):
+            a = parse(ab)
+            aname = s(a[1][0])
+            atype = a.get(20, [0])[0]
+            if atype == ATTR_INT:
+                attrs[aname] = a[3][0]
+            elif atype == ATTR_FLOAT:
+                attrs[aname] = struct.unpack("<f", a[2][0])[0]
+            elif atype == ATTR_STRING:
+                attrs[aname] = s(a[4][0])
+            elif atype == ATTR_INTS:
+                attrs[aname] = a.get(8, [])
+            elif atype == ATTR_FLOATS:
+                attrs[aname] = [struct.unpack("<f", f)[0]
+                                for f in a.get(7, [])]
+        return {
+            "op_type": s(n[4][0]),
+            "inputs": [s(i) for i in n.get(1, [])],
+            "outputs": [s(o) for o in n.get(2, [])],
+            "name": s(n[3][0]) if 3 in n else "",
+            "attrs": attrs,
+        }
+
+    def parse_tensor(tb):
+        t = parse(tb)
+        return {
+            "name": s(t[8][0]) if 8 in t else "",
+            "dims": t.get(1, []),
+            "data_type": t[2][0],
+            "raw": t.get(9, [b""])[0],
+        }
+
+    def parse_vi(vb):
+        v = parse(vb)
+        tt = parse(parse(v[2][0])[1][0])
+        shape = []
+        if 2 in tt:
+            for dim in parse(tt[2][0]).get(1, []):
+                d = parse(dim)
+                shape.append(d.get(1, [0])[0])
+        return {"name": s(v[1][0]), "elem_type": tt[1][0],
+                "shape": shape}
+
+    return {
+        "ir_version": m[1][0],
+        "producer": s(m[2][0]) if 2 in m else "",
+        "opset": parse(m[8][0]).get(2, [0])[0],
+        "graph": {
+            "name": s(g[2][0]) if 2 in g else "",
+            "nodes": [parse_node(nb) for nb in g.get(1, [])],
+            "initializers": [parse_tensor(tb) for tb in g.get(5, [])],
+            "inputs": [parse_vi(vb) for vb in g.get(11, [])],
+            "outputs": [parse_vi(vb) for vb in g.get(12, [])],
+        },
+    }

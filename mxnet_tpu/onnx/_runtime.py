@@ -1,0 +1,258 @@
+"""Reference interpreter for the ONNX subset the exporter emits.
+
+Used to validate exports without an `onnx`/`onnxruntime` dependency
+(`mx.onnx.check_model`), and doubling as a minimal ONNX *import* path:
+`run_model(path_or_bytes, inputs)` evaluates the graph with numpy.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict
+
+import numpy as _onp
+
+from ..base import MXNetError
+from . import _proto as P
+
+_ONNX_TO_NP = {
+    P.FLOAT: _onp.float32, P.DOUBLE: _onp.float64, P.FLOAT16: _onp.float16,
+    P.INT8: _onp.int8, P.UINT8: _onp.uint8, P.INT32: _onp.int32,
+    P.INT64: _onp.int64, P.BOOL: _onp.bool_,
+}
+
+
+def _tensor_to_np(t):
+    dt = _ONNX_TO_NP.get(t["data_type"])
+    if dt is None:
+        raise MXNetError(f"unsupported tensor dtype {t['data_type']}")
+    if t["data_type"] == P.BOOL:
+        arr = _onp.frombuffer(t["raw"], dtype=_onp.uint8).astype(bool)
+    else:
+        arr = _onp.frombuffer(t["raw"], dtype=dt)
+    return arr.reshape(t["dims"]).copy()
+
+
+def _pool_patches(x, kernel, strides, pads):
+    n, c, h, w = x.shape
+    kh, kw = kernel
+    sh, sw = strides
+    ph0, pw0, ph1, pw1 = pads
+    oh = (h + ph0 + ph1 - kh) // sh + 1
+    ow = (w + pw0 + pw1 - kw) // sw + 1
+    out = _onp.empty((n, c, oh, ow, kh, kw), dtype=x.dtype)
+    padded = _onp.pad(x, ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)),
+                      constant_values=_onp.nan)
+    for i in range(oh):
+        for j in range(ow):
+            out[:, :, i, j] = padded[:, :, i * sh:i * sh + kh,
+                                     j * sw:j * sw + kw]
+    return out
+
+
+def _conv2d(x, w, b, strides, pads, dilations, group):
+    n, cin, h, wd = x.shape
+    cout, cin_g, kh, kw = w.shape
+    sh, sw = strides
+    dh, dw = dilations
+    ph0, pw0, ph1, pw1 = pads
+    eff_kh = (kh - 1) * dh + 1
+    eff_kw = (kw - 1) * dw + 1
+    oh = (h + ph0 + ph1 - eff_kh) // sh + 1
+    ow = (wd + pw0 + pw1 - eff_kw) // sw + 1
+    padded = _onp.pad(x, ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)))
+    out = _onp.zeros((n, cout, oh, ow), dtype=_onp.float32)
+    cout_g = cout // group
+    for gi in range(group):
+        xs = padded[:, gi * cin_g:(gi + 1) * cin_g]
+        ws = w[gi * cout_g:(gi + 1) * cout_g]
+        for i in range(oh):
+            for j in range(ow):
+                patch = xs[:, :, i * sh:i * sh + eff_kh:dh,
+                           j * sw:j * sw + eff_kw:dw]
+                out[:, gi * cout_g:(gi + 1) * cout_g, i, j] = _onp.einsum(
+                    "nchw,ochw->no", patch, ws)
+    if b is not None:
+        out += b.reshape(1, -1, 1, 1)
+    return out
+
+
+def run_model(model_bytes: bytes, inputs: Dict[str, _onp.ndarray]):
+    """Evaluate the parsed model on numpy inputs; returns {name: array}."""
+    if isinstance(model_bytes, str):
+        with open(model_bytes, "rb") as f:
+            model_bytes = f.read()
+    m = model_bytes if isinstance(model_bytes, dict) \
+        else P.parse_model(model_bytes)
+    g = m["graph"]
+    env: Dict[str, _onp.ndarray] = {}
+    for t in g["initializers"]:
+        env[t["name"]] = _tensor_to_np(t)
+    for vi in g["inputs"]:
+        if vi["name"] not in inputs:
+            raise MXNetError(f"missing input {vi['name']}")
+        env[vi["name"]] = _onp.asarray(inputs[vi["name"]])
+
+    for nd in g["nodes"]:
+        op = nd["op_type"]
+        ins = [env[i] for i in nd["inputs"] if i]
+        a = nd["attrs"]
+        if op == "Identity":
+            out = ins[0]
+        elif op == "Add":
+            out = ins[0] + ins[1]
+        elif op == "Sub":
+            out = ins[0] - ins[1]
+        elif op == "Mul":
+            out = ins[0] * ins[1]
+        elif op == "Div":
+            out = ins[0] / ins[1]
+        elif op == "Mod":
+            out = _onp.mod(ins[0], ins[1])
+        elif op == "Max":
+            out = _onp.maximum(ins[0], ins[1])
+        elif op == "Min":
+            out = _onp.minimum(ins[0], ins[1])
+        elif op == "Pow":
+            out = _onp.power(ins[0], ins[1]).astype(ins[0].dtype)
+        elif op == "Neg":
+            out = -ins[0]
+        elif op == "Exp":
+            out = _onp.exp(ins[0])
+        elif op == "Log":
+            out = _onp.log(ins[0])
+        elif op == "Sqrt":
+            out = _onp.sqrt(ins[0])
+        elif op == "Reciprocal":
+            out = 1.0 / ins[0]
+        elif op == "Abs":
+            out = _onp.abs(ins[0])
+        elif op == "Sign":
+            out = _onp.sign(ins[0])
+        elif op == "Floor":
+            out = _onp.floor(ins[0])
+        elif op == "Ceil":
+            out = _onp.ceil(ins[0])
+        elif op == "Round":
+            out = _onp.round(ins[0])
+        elif op == "Sigmoid":
+            out = 1.0 / (1.0 + _onp.exp(-ins[0]))
+        elif op == "Tanh":
+            out = _onp.tanh(ins[0])
+        elif op == "Erf":
+            out = _onp.vectorize(math.erf, otypes=[_onp.float32])(ins[0])
+        elif op in ("Sin", "Cos", "Tan", "Asin", "Acos", "Atan", "Sinh",
+                    "Cosh", "Asinh", "Acosh", "Atanh"):
+            out = getattr(_onp, {"Sin": "sin", "Cos": "cos", "Tan": "tan",
+                                 "Asin": "arcsin", "Acos": "arccos",
+                                 "Atan": "arctan", "Sinh": "sinh",
+                                 "Cosh": "cosh", "Asinh": "arcsinh",
+                                 "Acosh": "arccosh", "Atanh": "arctanh"}[op])(
+                ins[0])
+        elif op == "Not":
+            out = ~ins[0].astype(bool)
+        elif op == "And":
+            out = ins[0].astype(bool) & ins[1].astype(bool)
+        elif op == "Or":
+            out = ins[0].astype(bool) | ins[1].astype(bool)
+        elif op == "Xor":
+            out = ins[0].astype(bool) ^ ins[1].astype(bool)
+        elif op == "Equal":
+            out = ins[0] == ins[1]
+        elif op == "Less":
+            out = ins[0] < ins[1]
+        elif op == "LessOrEqual":
+            out = ins[0] <= ins[1]
+        elif op == "Greater":
+            out = ins[0] > ins[1]
+        elif op == "GreaterOrEqual":
+            out = ins[0] >= ins[1]
+        elif op == "Where":
+            out = _onp.where(ins[0], ins[1], ins[2])
+        elif op == "Clip":
+            lo = ins[1] if len(ins) > 1 else None
+            hi = ins[2] if len(ins) > 2 else None
+            out = _onp.clip(ins[0], lo, hi)
+        elif op == "Cast":
+            out = ins[0].astype(_ONNX_TO_NP[a["to"]])
+        elif op == "Reshape":
+            out = ins[0].reshape([int(d) for d in ins[1]])
+        elif op == "Transpose":
+            out = _onp.transpose(ins[0], a.get("perm"))
+        elif op == "Expand":
+            out = _onp.broadcast_to(ins[0],
+                                    [int(d) for d in ins[1]]).copy()
+        elif op == "Einsum":
+            out = _onp.einsum(a["equation"], *ins)
+        elif op == "MatMul":
+            out = ins[0] @ ins[1]
+        elif op == "Conv":
+            b = ins[2] if len(ins) > 2 else None
+            pads = a.get("pads", [0, 0, 0, 0])
+            out = _conv2d(ins[0], ins[1], b, a.get("strides", [1, 1]),
+                          [pads[0], pads[1], pads[2], pads[3]],
+                          a.get("dilations", [1, 1]), a.get("group", 1))
+        elif op == "MaxPool":
+            pads = a.get("pads", [0, 0, 0, 0])
+            patches = _pool_patches(ins[0], a["kernel_shape"],
+                                    a.get("strides", [1, 1]),
+                                    [pads[0], pads[1], pads[2], pads[3]])
+            out = _onp.nanmax(patches, axis=(4, 5)).astype(ins[0].dtype)
+        elif op == "AveragePool":
+            pads = a.get("pads", [0, 0, 0, 0])
+            patches = _pool_patches(ins[0], a["kernel_shape"],
+                                    a.get("strides", [1, 1]),
+                                    [pads[0], pads[1], pads[2], pads[3]])
+            if a.get("count_include_pad"):
+                out = _onp.nansum(patches, axis=(4, 5)) / (
+                    a["kernel_shape"][0] * a["kernel_shape"][1])
+            else:
+                out = _onp.nanmean(patches, axis=(4, 5))
+            out = out.astype(ins[0].dtype)
+        elif op in ("ReduceSum", "ReduceMax", "ReduceMin", "ReduceProd"):
+            fn = {"ReduceSum": _onp.sum, "ReduceMax": _onp.max,
+                  "ReduceMin": _onp.min, "ReduceProd": _onp.prod}[op]
+            axes = tuple(int(x) for x in a.get("axes", []))
+            out = fn(ins[0], axis=axes or None,
+                     keepdims=bool(a.get("keepdims", 1)))
+            out = _onp.asarray(out, dtype=ins[0].dtype)
+        elif op in ("ArgMax", "ArgMin"):
+            fn = _onp.argmax if op == "ArgMax" else _onp.argmin
+            out = fn(ins[0], axis=a["axis"])
+            if a.get("keepdims", 1):
+                out = _onp.expand_dims(out, a["axis"])
+        elif op == "Gather":
+            out = _onp.take(ins[0], ins[1].astype(_onp.int64),
+                            axis=a.get("axis", 0))
+        elif op == "Concat":
+            out = _onp.concatenate(ins, axis=a["axis"])
+        elif op == "Slice":
+            starts = [int(v) for v in ins[1]]
+            ends = [int(v) for v in ins[2]]
+            axes = [int(v) for v in ins[3]] if len(ins) > 3 else \
+                list(range(len(starts)))
+            steps = [int(v) for v in ins[4]] if len(ins) > 4 else \
+                [1] * len(starts)
+            sl = [slice(None)] * ins[0].ndim
+            for ax, st, en, sp in zip(axes, starts, ends, steps):
+                sl[ax] = slice(st, en, sp)
+            out = ins[0][tuple(sl)]
+        elif op == "Pad":
+            pads = [int(v) for v in ins[1]]
+            nd_ = ins[0].ndim
+            cval = float(ins[2]) if len(ins) > 2 else 0.0
+            widths = [(pads[i], pads[i + nd_]) for i in range(nd_)]
+            out = _onp.pad(ins[0], widths, constant_values=cval)
+        elif op == "CumSum":
+            out = ins[0]
+            ax = int(ins[1])
+            if a.get("reverse"):
+                out = _onp.flip(_onp.cumsum(_onp.flip(out, ax), ax), ax)
+            else:
+                out = _onp.cumsum(out, ax)
+            out = out.astype(ins[0].dtype)
+        else:
+            raise MXNetError(f"interpreter: unsupported op {op}")
+        for oname in nd["outputs"]:
+            env[oname] = _onp.asarray(out)
+
+    return {vi["name"]: env[vi["name"]] for vi in g["outputs"]}

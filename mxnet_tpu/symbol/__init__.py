@@ -1,0 +1,26 @@
+"""`mx.sym` — symbolic graph front end (parity: `python/mxnet/symbol/`,
+`src/c_api/c_api_symbolic.cc`; the NNVM `Symbol` of the reference).
+
+TPU-native design: a `Symbol` is a lightweight op-DAG node (name, op,
+inputs, attrs) — the moral equivalent of an `nnvm::Node`. There is no
+separate symbolic executor: `bind`/`eval` walk the DAG calling the same
+eager `mx.np`/`mx.npx` functions (which lower to XLA), and `tojson`/`load`
+round-trip the DAG as the reference's symbol JSON does
+(`src/nnvm/legacy_json_util.cc`). Under `jax.jit` the walked graph traces
+into a single XLA computation, so the CachedOp/`simple_bind` machinery of
+the reference collapses into a jit cache here.
+"""
+from .symbol import (  # noqa: F401
+    Symbol, Variable, var, Group, load, load_json, fromjson, zeros, ones,
+    register_sym_op,
+)
+
+# populate operator namespace dynamically (mirrors generated mx.sym.<op>)
+from . import symbol as _symbol_mod
+
+
+def __getattr__(name):
+    fn = _symbol_mod._make_op(name)
+    if fn is None:
+        raise AttributeError(f"module 'mxnet_tpu.symbol' has no op '{name}'")
+    return fn

@@ -1,0 +1,422 @@
+"""Symbol DAG core. See package docstring for the design rationale.
+
+Reference parity map:
+- `Symbol` composition / `__call__`-style grouping: `python/mxnet/symbol/symbol.py`
+- `Variable`: `python/mxnet/symbol/symbol.py` `var()`
+- `bind`/`simple_bind` → `Executor`: `python/mxnet/executor.py:25,125`
+  (a thin shim over the jit cache here, as the reference's is over CachedOp)
+- `tojson`/`load`: `src/nnvm/legacy_json_util.cc` JSON graph format
+  (same top-level keys: nodes/arg_nodes/heads)
+"""
+from __future__ import annotations
+
+import itertools
+import json
+from typing import Dict, List, Optional, Sequence
+
+import numpy as _onp
+
+from ..base import MXNetError
+from ..device import current_device
+from ..ndarray.ndarray import ndarray
+
+_name_counter = itertools.count()
+
+
+def _auto_name(op):
+    return f"{op.lstrip('_')}{next(_name_counter)}"
+
+
+# ---------------------------------------------------------------------------
+# op registry
+# ---------------------------------------------------------------------------
+
+_SYM_OPS: Dict[str, callable] = {}
+
+
+def register_sym_op(name, fn):
+    """Register a callable (over `ndarray`s) as a symbolic op."""
+    _SYM_OPS[name] = fn
+    return fn
+
+
+def _resolve_op(name):
+    """Find the eager implementation for an op name: explicit registry,
+    then `mx.npx`, `mx.np`, `mx.contrib`."""
+    if name in _SYM_OPS:
+        return _SYM_OPS[name]
+    from .. import numpy_extension as npx
+    from .. import numpy as mnp
+    from .. import contrib
+    for mod in (npx, mnp, contrib):
+        fn = getattr(mod, name, None)
+        if callable(fn):
+            return fn
+    return None
+
+
+def _init_builtin_ops():
+    from .. import numpy as mnp
+
+    def binop(fn):
+        return lambda a, b: fn(a, b)
+
+    register_sym_op("_plus", binop(lambda a, b: a + b))
+    register_sym_op("_minus", binop(lambda a, b: a - b))
+    register_sym_op("_mul", binop(lambda a, b: a * b))
+    register_sym_op("_div", binop(lambda a, b: a / b))
+    register_sym_op("_mod", binop(lambda a, b: a % b))
+    register_sym_op("_pow", binop(lambda a, b: a ** b))
+    register_sym_op("_plus_scalar", lambda a, scalar=0.0: a + scalar)
+    register_sym_op("_minus_scalar", lambda a, scalar=0.0: a - scalar)
+    register_sym_op("_rminus_scalar", lambda a, scalar=0.0: scalar - a)
+    register_sym_op("_mul_scalar", lambda a, scalar=1.0: a * scalar)
+    register_sym_op("_div_scalar", lambda a, scalar=1.0: a / scalar)
+    register_sym_op("_rdiv_scalar", lambda a, scalar=1.0: scalar / a)
+    register_sym_op("_pow_scalar", lambda a, scalar=1.0: a ** scalar)
+    register_sym_op("_neg", lambda a: -a)
+    register_sym_op("_zeros",
+                    lambda shape=(), dtype="float32": mnp.zeros(shape, dtype))
+    register_sym_op("_ones",
+                    lambda shape=(), dtype="float32": mnp.ones(shape, dtype))
+    register_sym_op("FullyConnected", _fc)
+    register_sym_op("dot", lambda a, b: mnp.dot(a, b))
+
+
+def _fc(data, weight, bias=None, num_hidden=None, no_bias=False, **kw):
+    from .. import numpy_extension as npx
+    return npx.fully_connected(data, weight, bias, num_hidden=num_hidden,
+                               no_bias=no_bias or bias is None, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Symbol
+# ---------------------------------------------------------------------------
+
+class Symbol:
+    """One node of the op DAG (≈ `nnvm::Node` + output selection)."""
+
+    __slots__ = ("op", "name", "inputs", "attrs", "_out_index")
+
+    def __init__(self, op: Optional[str], name: str,
+                 inputs: Sequence["Symbol"] = (), attrs: Optional[dict] = None,
+                 out_index: Optional[int] = None):
+        self.op = op                      # None → variable ("null" in json)
+        self.name = name
+        self.inputs = list(inputs)
+        self.attrs = dict(attrs or {})
+        self._out_index = out_index
+
+    # -- construction -------------------------------------------------------
+    @staticmethod
+    def _node(op, inputs, attrs=None, name=None):
+        return Symbol(op, name or _auto_name(op), inputs, attrs)
+
+    # -- introspection ------------------------------------------------------
+    def list_arguments(self) -> List[str]:
+        seen, order, visited = set(), [], set()
+
+        def walk(s):
+            if id(s) in visited:
+                return
+            visited.add(id(s))
+            if s.op is None and s.name not in seen:
+                seen.add(s.name)
+                order.append(s.name)
+            for i in s.inputs:
+                walk(i)
+        walk(self)
+        return order
+
+    def list_outputs(self) -> List[str]:
+        if self.op == "_group":
+            return [f"{i.name}_output" for i in self.inputs]
+        return [f"{self.name}_output"]
+
+    def get_internals(self):
+        """All nodes as a Group (parity: `Symbol.get_internals`)."""
+        nodes = []
+        seen = set()
+
+        def walk(s):
+            if id(s) in seen:
+                return
+            seen.add(id(s))
+            for i in s.inputs:
+                walk(i)
+            nodes.append(s)
+        walk(self)
+        return Group([n for n in nodes if n.op != "_group"])
+
+    def __getitem__(self, idx):
+        if self.op == "_group":
+            return self.inputs[idx]
+        if isinstance(idx, str):
+            for n in self.get_internals().inputs:
+                if f"{n.name}_output" == idx or n.name == idx:
+                    return n
+            raise KeyError(idx)
+        if idx == 0:
+            return self
+        raise IndexError(idx)
+
+    def __iter__(self):
+        if self.op == "_group":
+            return iter(self.inputs)
+        return iter([self])
+
+    def __repr__(self):
+        return f"<Symbol {self.name}>"
+
+    # -- arithmetic ---------------------------------------------------------
+    def _binary(self, other, op, scalar_op, swap=False):
+        if isinstance(other, Symbol):
+            ins = (other, self) if swap else (self, other)
+            return Symbol._node(op, ins)
+        return Symbol._node(scalar_op, (self,), {"scalar": float(other)})
+
+    def __add__(self, o):
+        return self._binary(o, "_plus", "_plus_scalar")
+
+    def __radd__(self, o):
+        return self._binary(o, "_plus", "_plus_scalar")
+
+    def __sub__(self, o):
+        return self._binary(o, "_minus", "_minus_scalar")
+
+    def __rsub__(self, o):
+        return self._binary(o, "_minus", "_rminus_scalar", swap=True)
+
+    def __mul__(self, o):
+        return self._binary(o, "_mul", "_mul_scalar")
+
+    def __rmul__(self, o):
+        return self._binary(o, "_mul", "_mul_scalar")
+
+    def __truediv__(self, o):
+        return self._binary(o, "_div", "_div_scalar")
+
+    def __rtruediv__(self, o):
+        return self._binary(o, "_div", "_rdiv_scalar", swap=True)
+
+    def __pow__(self, o):
+        return self._binary(o, "_pow", "_pow_scalar")
+
+    def __neg__(self):
+        return Symbol._node("_neg", (self,))
+
+    # -- execution ----------------------------------------------------------
+    def eval(self, device=None, ctx=None, **bindings):
+        """Evaluate the DAG with `name=ndarray` bindings; returns a list of
+        outputs (reference `Symbol.eval`)."""
+        device = device or ctx or current_device()
+        cache: Dict[int, object] = {}
+
+        def run(s):
+            key = id(s)
+            if key in cache:
+                return cache[key]
+            if s.op is None:
+                if s.name not in bindings:
+                    raise MXNetError(f"unbound variable '{s.name}'")
+                val = bindings[s.name]
+            elif s.op == "_group":
+                val = [run(i) for i in s.inputs]
+            else:
+                fn = _resolve_op(s.op)
+                if fn is None:
+                    raise MXNetError(f"unknown op '{s.op}'")
+                ins = [run(i) for i in s.inputs]
+                val = fn(*ins, **s.attrs)
+                if isinstance(val, (tuple, list)) and s._out_index is None:
+                    val = list(val)
+            cache[key] = val
+            return val
+
+        out = run(self)
+        if self._out_index is not None and isinstance(out, (tuple, list)):
+            out = out[self._out_index]
+        return out if isinstance(out, list) else [out]
+
+    def bind(self, device=None, args=None, ctx=None, args_grad=None,
+             grad_req="write", **kwargs):
+        return Executor(self, device or ctx, args or {}, args_grad, grad_req)
+
+    def simple_bind(self, device=None, ctx=None, grad_req="write", **shapes):
+        from .. import numpy as mnp
+        args = {n: mnp.zeros(shapes[n]) for n in self.list_arguments()
+                if n in shapes}
+        missing = [n for n in self.list_arguments() if n not in args]
+        if missing:
+            raise MXNetError(f"simple_bind missing shapes for {missing}")
+        return Executor(self, device or ctx, args, None, grad_req)
+
+    def infer_shape(self, **shapes):
+        """Run a zero-filled evaluation to recover shapes (XLA would trace
+        abstractly; eager zeros keep this dependency-free)."""
+        from .. import numpy as mnp
+        args = self.list_arguments()
+        if any(n not in shapes for n in args):
+            return None, None, None
+        bindings = {n: mnp.zeros(shapes[n]) for n in args}
+        outs = self.eval(**bindings)
+        return ([tuple(shapes[n]) for n in args],
+                [tuple(o.shape) for o in outs], [])
+
+    # -- serialization ------------------------------------------------------
+    def tojson(self) -> str:
+        nodes, index = [], {}
+
+        def visit(s):
+            if id(s) in index:
+                return index[id(s)]
+            ins = [visit(i) for i in s.inputs]
+            idx = len(nodes)
+            nodes.append({
+                "op": "null" if s.op is None else s.op,
+                "name": s.name,
+                "attrs": _json_attrs(s.attrs),
+                "inputs": [[i, 0, 0] for i in ins],
+            })
+            index[id(s)] = idx
+            return idx
+
+        if self.op == "_group":
+            heads = [[visit(i), 0, 0] for i in self.inputs]
+        else:
+            heads = [[visit(self), 0, 0]]
+        arg_nodes = [i for i, n in enumerate(nodes) if n["op"] == "null"]
+        return json.dumps({"nodes": nodes, "arg_nodes": arg_nodes,
+                           "heads": heads,
+                           "attrs": {"mxnet_tpu_version": 1}}, indent=2)
+
+    def save(self, fname: str):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+
+def _json_attrs(attrs):
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, tuple):
+            v = list(v)
+        out[k] = v
+    return out
+
+
+# ---------------------------------------------------------------------------
+# factory functions
+# ---------------------------------------------------------------------------
+
+def Variable(name, **kwargs):
+    return Symbol(None, name)
+
+
+var = Variable
+
+
+def Group(symbols):
+    symbols = list(symbols)
+    return Symbol("_group", _auto_name("_group"), symbols)
+
+
+def zeros(shape, dtype="float32", name=None):
+    return Symbol._node("_zeros", (), {"shape": tuple(shape),
+                                       "dtype": dtype}, name)
+
+
+def ones(shape, dtype="float32", name=None):
+    return Symbol._node("_ones", (), {"shape": tuple(shape),
+                                      "dtype": dtype}, name)
+
+
+def fromjson(json_str: str) -> Symbol:
+    g = json.loads(json_str)
+    built: List[Symbol] = []
+    for node in g["nodes"]:
+        ins = [built[i[0]] for i in node.get("inputs", [])]
+        attrs = node.get("attrs", {}) or {}
+        if node["op"] == "null":
+            built.append(Symbol(None, node["name"]))
+        else:
+            built.append(Symbol(node["op"], node["name"], ins, attrs))
+    heads = [built[h[0]] for h in g["heads"]]
+    return heads[0] if len(heads) == 1 else Group(heads)
+
+
+load_json = fromjson
+
+
+def load(fname: str) -> Symbol:
+    with open(fname) as f:
+        return fromjson(f.read())
+
+
+# ---------------------------------------------------------------------------
+# Executor (legacy bind API; parity `python/mxnet/executor.py:25`)
+# ---------------------------------------------------------------------------
+
+class Executor:
+    def __init__(self, symbol, device, args, args_grad, grad_req):
+        self._symbol = symbol
+        self._device = device or current_device()
+        self.arg_dict = dict(args)
+        self.grad_dict = dict(args_grad or {})
+        self._grad_req = grad_req
+        self.outputs: List[ndarray] = []
+
+    def forward(self, is_train=False, **kwargs):
+        self.arg_dict.update(kwargs)
+        if is_train:
+            from .. import autograd
+            for name, arr in self.arg_dict.items():
+                if name in self.grad_dict or self._grad_req != "null":
+                    if arr._grad_req == "null":
+                        arr.attach_grad(self._grad_req)
+            with autograd.record():
+                self.outputs = self._symbol.eval(device=self._device,
+                                                 **self.arg_dict)
+        else:
+            self.outputs = self._symbol.eval(device=self._device,
+                                             **self.arg_dict)
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        if not self.outputs:
+            raise MXNetError("call forward(is_train=True) first")
+        from .. import autograd
+        autograd.backward(self.outputs, head_grads=out_grads)
+        for name, arr in self.arg_dict.items():
+            if arr.grad is not None:
+                self.grad_dict[name] = arr.grad
+        return self.grad_dict
+
+
+# ---------------------------------------------------------------------------
+# dynamic op surface: mx.sym.<op_name>(*symbols, **attrs)
+# ---------------------------------------------------------------------------
+
+def _make_op(name):
+    if name.startswith("__"):
+        return None
+    if _resolve_op(name) is None:
+        return None
+
+    op_name = name
+
+    def sym_op(*args, name: Optional[str] = None, **attrs):
+        sym_inputs = []
+        for a in args:
+            if isinstance(a, Symbol):
+                sym_inputs.append(a)
+            else:
+                raise MXNetError(
+                    f"mx.sym.{op_name} positional args must be Symbols; "
+                    f"got {type(a).__name__} (pass arrays via eval bindings)")
+        return Symbol._node(op_name, tuple(sym_inputs), attrs, name)
+
+    sym_op.__name__ = op_name
+    return sym_op
+
+
+_init_builtin_ops()

@@ -279,3 +279,21 @@ def test_roi_align_position_sensitive():
     out = contrib.roi_align(x, rois, pooled_size=(2, 2), spatial_scale=1.0,
                             position_sensitive=True)
     assert out.shape == (1, 2, 2, 2)
+
+
+def test_roi_align_out_of_image_zero():
+    x = mx.np.ones((1, 1, 8, 8))
+    # ROI fully outside the image -> all samples invalid -> zeros
+    rois = A([[0, -30, -30, -20, -20]])
+    out = contrib.roi_align(x, rois, pooled_size=(2, 2), spatial_scale=1.0)
+    onp.testing.assert_allclose(out.asnumpy(), onp.zeros((1, 1, 2, 2)))
+
+
+def test_multibox_prior_nonsquare_aspect():
+    # on a non-square map, anchor pixel-space squares need H/W width scaling
+    x = mx.np.zeros((1, 3, 10, 20))
+    anchors = contrib.MultiBoxPrior(x, sizes=(0.4,)).asnumpy()[0]
+    w = anchors[0][2] - anchors[0][0]
+    h = anchors[0][3] - anchors[0][1]
+    onp.testing.assert_allclose(w, 0.4 * 10 / 20, rtol=1e-5)
+    onp.testing.assert_allclose(h, 0.4, rtol=1e-5)

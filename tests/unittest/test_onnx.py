@@ -1,0 +1,145 @@
+"""ONNX export round-trip tests (parity model: reference
+tests/python/onnx/). Exports are validated numerically with the built-in
+reference interpreter (`mx.onnx.run_model`) — no onnx package needed."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+def _export_and_run(net, x, tmp_path, name="m.onnx"):
+    path = str(tmp_path / name)
+    mx.onnx.export_model(net, path, example_inputs=x)
+    expected = net(x).asnumpy()
+    outs = mx.onnx.run_model(path, {"data": x.asnumpy()})
+    got = list(outs.values())[0]
+    return got, expected, path
+
+
+def test_export_dense_relu(tmp_path):
+    net = nn.Sequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    x = mx.np.array(onp.random.randn(3, 8).astype("float32"))
+    got, exp, path = _export_and_run(net, x, tmp_path)
+    onp.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-5)
+    # structural sanity
+    m = mx.onnx._proto.parse_model(open(path, "rb").read())
+    assert m["opset"] == 12
+    assert m["graph"]["inputs"][0]["name"] == "data"
+    assert any("Einsum" == n["op_type"] for n in m["graph"]["nodes"])
+
+
+def test_export_mlp_softmax(tmp_path):
+    net = nn.Sequential()
+    net.add(nn.Dense(32, activation="tanh"), nn.Dense(10))
+    net.initialize()
+    x = mx.np.array(onp.random.randn(4, 20).astype("float32"))
+    path = str(tmp_path / "m.onnx")
+    mx.onnx.export_model(net, path, example_inputs=x)
+    logits = net(x)
+    sm = mx.npx.softmax(logits)
+    outs = mx.onnx.run_model(path, {"data": x.asnumpy()})
+    got = list(outs.values())[0]
+    onp.testing.assert_allclose(got, logits.asnumpy(), rtol=1e-4, atol=1e-5)
+    assert sm.shape == (4, 10)
+
+
+def test_export_conv_pool_bn(tmp_path):
+    net = nn.Sequential()
+    net.add(nn.Conv2D(4, kernel_size=3, padding=1, activation="relu"),
+            nn.MaxPool2D(pool_size=2),
+            nn.BatchNorm(),
+            nn.Flatten(),
+            nn.Dense(5))
+    net.initialize()
+    x = mx.np.array(onp.random.randn(2, 3, 8, 8).astype("float32"))
+    net(x)  # warm up running stats shapes
+    got, exp, _ = _export_and_run(net, x, tmp_path)
+    onp.testing.assert_allclose(got, exp, rtol=1e-3, atol=1e-4)
+
+
+def test_export_avgpool(tmp_path):
+    net = nn.Sequential()
+    net.add(nn.AvgPool2D(pool_size=2, strides=2))
+    net.initialize()
+    x = mx.np.array(onp.random.randn(1, 2, 6, 6).astype("float32"))
+    got, exp, _ = _export_and_run(net, x, tmp_path)
+    onp.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-5)
+
+
+def test_export_embedding(tmp_path):
+    net = nn.Sequential()
+    net.add(nn.Embedding(input_dim=11, output_dim=6))
+    net.initialize()
+    x = mx.np.array(onp.array([[1, 2, 10], [0, 3, 4]], dtype="int32"))
+    got, exp, _ = _export_and_run(net, x, tmp_path)
+    onp.testing.assert_allclose(got, exp, rtol=1e-5)
+
+
+def test_export_symbol(tmp_path):
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    y = mx.sym.relu(a * 2.0 + b)
+    av = mx.np.array(onp.random.randn(3, 3).astype("float32"))
+    bv = mx.np.array(onp.random.randn(3, 3).astype("float32"))
+    path = str(tmp_path / "s.onnx")
+    mx.onnx.export_model(y, path, args={"a": av, "b": bv})
+    expected = y.eval(a=av, b=bv)[0].asnumpy()
+    outs = mx.onnx.run_model(path, {"a": av.asnumpy(), "b": bv.asnumpy()})
+    onp.testing.assert_allclose(list(outs.values())[0], expected, rtol=1e-5)
+
+
+def test_export_symbol_with_params(tmp_path):
+    x = mx.sym.Variable("x")
+    w = mx.sym.Variable("w")
+    y = mx.sym.dot(x, w)
+    xv = mx.np.array(onp.random.randn(2, 4).astype("float32"))
+    wv = mx.np.array(onp.random.randn(4, 3).astype("float32"))
+    path = str(tmp_path / "s.onnx")
+    # w becomes an initializer, x stays a graph input
+    mx.onnx.export_model(y, path, args={"x": xv, "w": wv},
+                         input_names=["x"])
+    m = mx.onnx._proto.parse_model(open(path, "rb").read())
+    assert [i["name"] for i in m["graph"]["inputs"]] == ["x"]
+    assert any(t["name"] == "w" for t in m["graph"]["initializers"])
+    outs = mx.onnx.run_model(path, {"x": xv.asnumpy()})
+    onp.testing.assert_allclose(list(outs.values())[0],
+                                xv.asnumpy() @ wv.asnumpy(), rtol=1e-5)
+
+
+def test_check_model_helper(tmp_path):
+    net = nn.Sequential()
+    net.add(nn.Dense(3))
+    net.initialize()
+    x = mx.np.array(onp.random.randn(2, 5).astype("float32"))
+    path = str(tmp_path / "m.onnx")
+    mx.onnx.export_model(net, path, example_inputs=x)
+    assert mx.onnx.check_model(path, {"data": x.asnumpy()},
+                               [net(x).asnumpy()])
+
+
+def test_layernorm_and_gelu_export(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8), nn.LayerNorm(), nn.GELU())
+    net.initialize()
+    x = mx.np.array(onp.random.randn(4, 8).astype("float32"))
+    got, exp, _ = _export_and_run(net, x, tmp_path)
+    onp.testing.assert_allclose(got, exp, rtol=1e-3, atol=1e-4)
+
+
+def test_export_resnet18(tmp_path):
+    from mxnet_tpu.gluon.model_zoo import vision
+    net = vision.get_model("resnet18_v1")
+    net.initialize(init=mx.init.Xavier())
+    x = mx.np.array((0.1 * onp.random.randn(1, 3, 32, 32)).astype("float32"))
+    y = net(x).asnumpy()
+    path = str(tmp_path / "r18.onnx")
+    mx.onnx.export_model(net, path, example_inputs=x)
+    outs = mx.onnx.run_model(path, {"data": x.asnumpy()})
+    got = list(outs.values())[0]
+    # untrained predict-mode BN lets magnitudes grow; compare relatively
+    rel = onp.abs(got - y).max() / (onp.abs(y).max() + 1e-30)
+    assert rel < 1e-4, rel

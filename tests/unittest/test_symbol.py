@@ -1,0 +1,152 @@
+"""Tests for mx.sym (parity model: reference tests/python/unittest/
+test_symbol.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+
+def test_variable_and_arithmetic_eval():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = 2 * a + b / 2 - 1
+    out = c.eval(a=mx.np.array([1.0, 2.0]), b=mx.np.array([4.0, 6.0]))[0]
+    onp.testing.assert_allclose(out.asnumpy(), [3.0, 6.0], rtol=1e-6)
+
+
+def test_list_arguments_order():
+    x = mx.sym.Variable("x")
+    w = mx.sym.Variable("w")
+    y = mx.sym.dot(x, w) + x
+    assert y.list_arguments() == ["x", "w"]
+    assert y.list_outputs()[0].endswith("_output")
+
+
+def test_dynamic_op_namespace():
+    x = mx.sym.Variable("x")
+    y = mx.sym.relu(x)
+    out = y.eval(x=mx.np.array([-1.0, 2.0]))[0]
+    onp.testing.assert_allclose(out.asnumpy(), [0.0, 2.0])
+    s = mx.sym.softmax(x)
+    v = s.eval(x=mx.np.array([1.0, 1.0]))[0]
+    onp.testing.assert_allclose(v.asnumpy(), [0.5, 0.5], rtol=1e-6)
+
+
+def test_unknown_op_raises():
+    with pytest.raises(AttributeError):
+        mx.sym.definitely_not_an_op
+
+
+def test_fully_connected_symbolic():
+    x = mx.sym.Variable("x")
+    w = mx.sym.Variable("w")
+    b = mx.sym.Variable("b")
+    y = mx.sym.FullyConnected(x, w, b, num_hidden=3)
+    rng = onp.random.RandomState(0)
+    xv = mx.np.array(rng.randn(4, 5).astype("float32"))
+    wv = mx.np.array(rng.randn(3, 5).astype("float32"))
+    bv = mx.np.array(rng.randn(3).astype("float32"))
+    out = y.eval(x=xv, w=wv, b=bv)[0]
+    ref = xv.asnumpy() @ wv.asnumpy().T + bv.asnumpy()
+    onp.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5)
+
+
+def test_group_and_getitem():
+    a = mx.sym.Variable("a")
+    s1 = mx.sym.relu(a)
+    s2 = mx.sym.sigmoid(a)
+    g = mx.sym.Group([s1, s2])
+    outs = g.eval(a=mx.np.array([0.0]))
+    assert len(outs) == 2
+    assert g[0] is s1 and g[1] is s2
+    assert len(g.list_outputs()) == 2
+
+
+def test_json_roundtrip():
+    x = mx.sym.Variable("x")
+    w = mx.sym.Variable("w")
+    y = mx.sym.dot(x, w) + 3.0
+    js = y.tojson()
+    y2 = mx.sym.load_json(js)
+    assert y2.list_arguments() == y.list_arguments()
+    xv = mx.np.array(onp.eye(2, dtype="float32"))
+    wv = mx.np.array(onp.arange(4, dtype="float32").reshape(2, 2))
+    o1 = y.eval(x=xv, w=wv)[0].asnumpy()
+    o2 = y2.eval(x=xv, w=wv)[0].asnumpy()
+    onp.testing.assert_allclose(o1, o2)
+
+
+def test_save_load_file(tmp_path):
+    x = mx.sym.Variable("x")
+    y = mx.sym.relu(x * 2.0)
+    path = str(tmp_path / "net-symbol.json")
+    y.save(path)
+    y2 = mx.sym.load(path)
+    out = y2.eval(x=mx.np.array([-1.0, 1.0]))[0]
+    onp.testing.assert_allclose(out.asnumpy(), [0.0, 2.0])
+
+
+def test_infer_shape():
+    x = mx.sym.Variable("x")
+    w = mx.sym.Variable("w")
+    y = mx.sym.dot(x, w)
+    arg_shapes, out_shapes, _ = y.infer_shape(x=(4, 5), w=(5, 3))
+    assert out_shapes == [(4, 3)]
+    assert arg_shapes == [(4, 5), (5, 3)]
+
+
+def test_unbound_variable_raises():
+    x = mx.sym.Variable("x")
+    y = mx.sym.Variable("y")
+    with pytest.raises(MXNetError):
+        (x + y).eval(x=mx.np.ones((1,)))
+
+
+def test_executor_forward_backward():
+    x = mx.sym.Variable("x")
+    y = (x * x).eval  # ensure eval path untouched
+    s = (x * x)
+    exe = s.bind(args={"x": mx.np.array([2.0, 3.0])})
+    out = exe.forward(is_train=True)[0]
+    onp.testing.assert_allclose(out.asnumpy(), [4.0, 9.0])
+    grads = exe.backward()
+    onp.testing.assert_allclose(grads["x"].asnumpy(), [4.0, 6.0], rtol=1e-6)
+
+
+def test_simple_bind():
+    x = mx.sym.Variable("x")
+    s = mx.sym.relu(x)
+    exe = s.simple_bind(x=(2, 2))
+    out = exe.forward()[0]
+    assert out.shape == (2, 2)
+    with pytest.raises(MXNetError):
+        s.simple_bind(wrong_name=(2, 2))
+
+
+def test_zeros_ones_constants():
+    z = mx.sym.zeros((2, 3))
+    o = mx.sym.ones((2, 3))
+    s = (z + o).eval()[0]
+    onp.testing.assert_allclose(s.asnumpy(), onp.ones((2, 3)))
+
+
+def test_get_internals():
+    x = mx.sym.Variable("x")
+    h = mx.sym.relu(x)
+    y = h * 2.0
+    internals = y.get_internals()
+    names = [n.name for n in internals]
+    assert "x" in names
+    assert any(n.startswith("relu") for n in names)
+
+
+def test_shared_subexpression_traversal_fast():
+    # 2^50 paths if traversal isn't memoized
+    s = mx.sym.Variable("a")
+    for _ in range(50):
+        s = s + s
+    assert s.list_arguments() == ["a"]
+    assert len([n for n in s.get_internals()]) == 51
+    out = s.eval(a=mx.np.array([1.0]))[0]
+    assert float(out.asnumpy()[0]) == 2.0 ** 50
