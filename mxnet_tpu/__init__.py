@@ -55,5 +55,8 @@ from . import contrib  # noqa: F401
 from . import symbol  # noqa: F401
 from . import symbol as sym  # noqa: F401
 from . import onnx  # noqa: F401
+from . import library  # noqa: F401
+from . import benchmark  # noqa: F401
+from . import _native  # noqa: F401
 
 device_module = device

@@ -1,0 +1,2 @@
+"""`mx.benchmark` — per-op performance harness (parity: `benchmark/opperf/`)."""
+from .opperf import run_performance_test, run_op_benchmarks  # noqa: F401

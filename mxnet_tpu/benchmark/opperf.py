@@ -1,0 +1,135 @@
+"""Per-operator benchmark harness (parity: `benchmark/opperf/` —
+`run_performance_test` and the full-suite runner whose published tables are
+the reference's per-op baselines,
+`benchmark/opperf/results/mxnet_operator_benchmark_results_*.md`).
+
+TPU notes: each measured call is jitted and synchronized with
+`block_until_ready`, so forward numbers are compiled-kernel latencies (the
+reference measures eager C++ dispatch; XLA's compile-once model is the
+framework's actual serving path). Backward timing jits value+grad.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as _onp
+
+from ..base import MXNetError
+
+__all__ = ["run_performance_test", "run_op_benchmarks", "DEFAULT_OPS"]
+
+
+def _time_it(fn, args, warmup: int, runs: int) -> float:
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.tree_util.tree_map(
+        lambda x: x.block_until_ready() if hasattr(x, "block_until_ready")
+        else x, out)
+    t0 = time.perf_counter()
+    for _ in range(runs):
+        out = fn(*args)
+    jax.tree_util.tree_map(
+        lambda x: x.block_until_ready() if hasattr(x, "block_until_ready")
+        else x, out)
+    return (time.perf_counter() - t0) / runs
+
+
+def run_performance_test(ops, inputs: Optional[Sequence[dict]] = None,
+                         run_backward: bool = True, dtype: str = "float32",
+                         warmup: int = 3, runs: int = 10,
+                         device=None, ctx=None) -> List[dict]:
+    """Benchmark `ops` (callables over jax arrays, or names resolved from
+    `mx.np`/`mx.npx`) against each input spec. An input spec maps argument
+    names to shapes (tuples) or concrete values. Returns a list of
+    `{op, inputs, avg_forward_time_ms, avg_backward_time_ms}`.
+    """
+    from .. import numpy as mnp
+    from .. import numpy_extension as npx
+
+    if not isinstance(ops, (list, tuple)):
+        ops = [ops]
+    inputs = inputs or [{}]
+    results = []
+    rng = _onp.random.RandomState(0)
+
+    for op in ops:
+        if isinstance(op, str):
+            fn = getattr(npx, op, None) or getattr(mnp, op, None)
+            if fn is None:
+                raise MXNetError(f"unknown op {op!r}")
+            name = op
+        else:
+            fn, name = op, getattr(op, "__name__", str(op))
+
+        for spec in inputs:
+            arrays, kwargs = [], {}
+            for k, v in spec.items():
+                if isinstance(v, tuple) and all(isinstance(d, int)
+                                                for d in v):
+                    arrays.append(jnp.asarray(
+                        rng.randn(*v).astype(dtype)))
+                else:
+                    kwargs[k] = v
+
+            def jax_fn(*xs):
+                from ..ndarray.ndarray import from_jax
+                wrapped = [from_jax(x) for x in xs]
+                out = fn(*wrapped, **kwargs)
+                leaves = out if isinstance(out, (list, tuple)) else [out]
+                return tuple(o._data if hasattr(o, "_data") else o
+                             for o in leaves)
+
+            fwd = jax.jit(jax_fn)
+            entry = {"op": name, "inputs": dict(spec)}
+            entry["avg_forward_time_ms"] = _time_it(fwd, arrays, warmup,
+                                                    runs) * 1e3
+            if run_backward and arrays:
+                def loss_fn(*xs):
+                    outs = jax_fn(*xs)
+                    return sum(jnp.sum(o) for o in outs
+                               if jnp.issubdtype(o.dtype, jnp.inexact))
+
+                try:
+                    bwd = jax.jit(jax.grad(loss_fn, argnums=tuple(
+                        range(len(arrays)))))
+                    entry["avg_backward_time_ms"] = _time_it(
+                        bwd, arrays, warmup, runs) * 1e3
+                except Exception:
+                    entry["avg_backward_time_ms"] = None
+            results.append(entry)
+    return results
+
+
+DEFAULT_OPS = [
+    ("add", [{"lhs": (1024, 1024), "rhs": (1024, 1024)}]),
+    ("multiply", [{"lhs": (1024, 1024), "rhs": (1024, 1024)}]),
+    ("dot", [{"lhs": (256, 256), "rhs": (256, 256)}]),
+    ("exp", [{"data": (1024, 1024)}]),
+    ("log", [{"data": (1024, 1024)}]),
+    ("sum", [{"data": (1024, 1024)}]),
+    ("max", [{"data": (1024, 1024)}]),
+    ("softmax", [{"data": (64, 1024)}]),
+    ("relu", [{"data": (1024, 1024)}]),
+    ("sigmoid", [{"data": (1024, 1024)}]),
+    ("fully_connected", [{"x": (64, 1024), "weight": (512, 1024),
+                          "bias": (512,)}]),
+]
+
+
+def run_op_benchmarks(ops=None, dtype="float32", warmup=3, runs=10,
+                      int_ops=False) -> Dict[str, List[dict]]:
+    """Run the default op suite; returns {op_name: results}. Mirrors
+    `opperf.py --output-format json` at a useful subset of coverage."""
+    suite = ops or DEFAULT_OPS
+    all_results = {}
+    for name, specs in suite:
+        try:
+            all_results[name] = run_performance_test(
+                name, inputs=specs, dtype=dtype, warmup=warmup, runs=runs)
+        except Exception as e:
+            all_results[name] = [{"op": name, "error": str(e)}]
+    return all_results

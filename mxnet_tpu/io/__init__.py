@@ -124,13 +124,21 @@ class CSVIter(DataIter):
     def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
                  batch_size=1, **kwargs):
         super().__init__(batch_size)
-        data = _onp.loadtxt(data_csv, delimiter=",", dtype=_onp.float32)
+        data = self._load_csv(data_csv)
         data = data.reshape((-1,) + tuple(data_shape))
         label = None
         if label_csv is not None:
-            label = _onp.loadtxt(label_csv, delimiter=",", dtype=_onp.float32)
+            label = self._load_csv(label_csv)
             label = label.reshape((-1,) + tuple(label_shape))
         self._inner = NDArrayIter(data, label, batch_size, **kwargs)
+
+    @staticmethod
+    def _load_csv(path):
+        from .. import _native
+        if _native.available():
+            return _native.csv_read(path)
+        return _onp.loadtxt(path, delimiter=",", dtype=_onp.float32,
+                            ndmin=2)
 
     def reset(self):
         self._inner.reset()

@@ -2,5 +2,9 @@
 (parity: `python/mxnet/kvstore/`)."""
 from .base import KVStoreBase
 from .kvstore import KVStore, create
+from .compression import GradientCompression
+from .horovod import Horovod
+from .byteps import BytePS
 
-__all__ = ["KVStoreBase", "KVStore", "create"]
+__all__ = ["KVStoreBase", "KVStore", "create", "GradientCompression",
+           "Horovod", "BytePS"]

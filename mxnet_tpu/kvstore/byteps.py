@@ -1,0 +1,29 @@
+"""BytePS KVStore adapter slot (parity: `python/mxnet/kvstore/byteps.py:29`).
+
+Same situation as the Horovod adapter (see `horovod.py`): byteps's mxnet
+bindings push/pull original-MXNet NDArrays in place and cannot mutate this
+framework's immutable jax buffers. The `"byteps"` registry name resolves to
+a precise error; TPU deployments use `kvstore="dist_sync"` (GSPMD
+collectives), and a custom transport can be registered by subclassing.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from .base import KVStoreBase
+
+__all__ = ["BytePS"]
+
+
+@KVStoreBase.register
+class BytePS(KVStoreBase):
+    def __init__(self):
+        raise MXNetError(
+            "kvstore 'byteps' is not supported by mxnet_tpu: byteps's mxnet "
+            "bindings mutate original-MXNet NDArrays in place and cannot "
+            "operate on jax-backed arrays. Use kvstore='dist_sync' — XLA "
+            "collectives over ICI/DCN provide the same push-pull semantics "
+            "— or register a subclass with a numpy-based transport.")
+
+    @staticmethod
+    def is_capable(capability: str) -> bool:
+        return False

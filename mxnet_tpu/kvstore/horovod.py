@@ -1,0 +1,37 @@
+"""Horovod KVStore adapter slot (parity: `python/mxnet/kvstore/horovod.py:27`).
+
+The reference's adapter forwards `mx.nd.NDArray`s to `horovod.mxnet`; those
+bindings require the original MXNet runtime and cannot consume this
+framework's jax-backed arrays, so a direct port would fail at the ABI
+boundary even with horovod installed. On TPU the same role — multi-worker
+gradient allreduce — is native: `kvstore="dist_sync"` lowers to XLA
+collectives over ICI/DCN.
+
+This module keeps the `"horovod"` registry name working (reference training
+scripts that pass `kvstore="horovod"` get a precise error instead of a
+lookup failure) and documents the extension point: subclass and override
+`broadcast`/`pushpull` with a transport that accepts host numpy buffers
+(e.g. horovod's own tensor types after conversion via `asnumpy()`).
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from .base import KVStoreBase
+
+__all__ = ["Horovod"]
+
+
+@KVStoreBase.register
+class Horovod(KVStoreBase):
+    def __init__(self):
+        raise MXNetError(
+            "kvstore 'horovod' is not supported by mxnet_tpu: horovod's "
+            "mxnet bindings require the original MXNet runtime and cannot "
+            "operate on jax-backed arrays. Use kvstore='dist_sync' — XLA "
+            "collectives over ICI/DCN provide the same allreduce semantics "
+            "— or register a subclass overriding broadcast/pushpull with a "
+            "numpy-based transport.")
+
+    @staticmethod
+    def is_capable(capability: str) -> bool:
+        return False

@@ -40,6 +40,7 @@ class KVStore(KVStoreBase):
         self._updater: Optional[Updater] = None
         self._optimizer: Optional[Optimizer] = None
         self._barrier_count = 0
+        self._compression = None
 
     # -- identity -----------------------------------------------------------
     @property
@@ -103,6 +104,15 @@ class KVStore(KVStoreBase):
         keys, values = _normalize(key, value)
         for k, vlist in zip(keys, values):
             kk = self._key(k)
+            if self._compression is not None and kk in self._store:
+                # compress each device's contribution pre-reduce with error
+                # feedback, as the reference compresses worker pushes
+                # (`kvstore_dist.h` push path); init pushes stay exact
+                single = isinstance(vlist, ndarray)
+                vl = [vlist] if single else list(vlist)
+                vl = [self._compression.compress(f"{kk}#{i}", v)
+                      for i, v in enumerate(vl)]
+                vlist = vl[0] if single else vl
             agg = self._aggregate(vlist)
             if kk not in self._store:
                 from ..ndarray.ndarray import from_jax
@@ -156,9 +166,16 @@ class KVStore(KVStoreBase):
         self._barrier_count += 1  # single-controller: no-op
 
     def set_gradient_compression(self, compression_params):
-        # ICI is bandwidth-rich; 1/2-bit compression is a documented non-goal
-        # (SURVEY.md §2.4); accepted and ignored for API parity.
-        self._compression = compression_params
+        """Enable 1/2-bit gradient compression with error feedback on
+        subsequent pushes (reference semantics; see
+        `kvstore/compression.py`). Mostly useful over DCN — ICI is
+        bandwidth-rich enough that this is usually off."""
+        from .compression import GradientCompression
+        params = dict(compression_params or {})
+        if params.get("type", "none") in ("none", None):
+            self._compression = None
+            return
+        self._compression = GradientCompression(**params)
 
 
 def _normalize(key, value):

@@ -1,0 +1,73 @@
+"""`mx.library` — out-of-tree extension loading (parity:
+`python/mxnet/library.py` over `include/mxnet/lib_api.h:779-1611`).
+
+Two extension flavors:
+
+- **Python extension** (`.py`): executed as a module. If it defines
+  `register(mx)` it is called with the `mxnet_tpu` package so it can
+  register custom ops (`mx.operator.CustomOpProp`), symbolic ops
+  (`mx.sym.register_sym_op`), optimizers (`mx.optimizer.register`), or
+  kvstores (`mx.kv.KVStoreBase.register`). This is the TPU-native analog of
+  the reference's CustomOp/CustomPass tables — the graph passes themselves
+  belong to XLA here.
+- **Native library** (`.so`): loaded with ctypes; the versioned handshake
+  `int initialize(int api_version)` from `lib_api.h:1611` is honored (a
+  falsy return aborts the load). Exposed symbols can then be bound by the
+  extension's own Python shim (e.g. via `jax.ffi` for custom calls).
+"""
+from __future__ import annotations
+
+import ctypes
+import importlib.util
+import os
+import sys
+from typing import Dict
+
+from .base import MXNetError
+
+__all__ = ["load", "loaded_libraries", "MX_LIBRARY_VERSION"]
+
+MX_LIBRARY_VERSION = 11  # mirrors MX_LIBRARY_VERSION in lib_api.h
+
+_loaded: Dict[str, object] = {}
+
+
+def loaded_libraries() -> Dict[str, object]:
+    return dict(_loaded)
+
+
+def load(path: str, verbose: bool = True):
+    """Load an extension library; returns the module (py) or CDLL (so)."""
+    path = os.path.abspath(path)
+    if not os.path.exists(path):
+        raise MXNetError(f"extension not found: {path}")
+    if path in _loaded:
+        return _loaded[path]
+    if path.endswith(".py"):
+        name = f"mxtpu_ext_{os.path.basename(path)[:-3]}"
+        spec = importlib.util.spec_from_file_location(name, path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+        if hasattr(mod, "register"):
+            import mxnet_tpu as mx
+            mod.register(mx)
+        _loaded[path] = mod
+        if verbose:
+            print(f"loaded python extension {path}")
+        return mod
+    if path.endswith(".so") or path.endswith(".dylib"):
+        lib = ctypes.CDLL(path, ctypes.RTLD_LOCAL)
+        if hasattr(lib, "initialize"):
+            lib.initialize.restype = ctypes.c_int
+            lib.initialize.argtypes = [ctypes.c_int]
+            if not lib.initialize(MX_LIBRARY_VERSION):
+                raise MXNetError(
+                    f"library {path} failed to initialize (incompatible "
+                    f"with version {MX_LIBRARY_VERSION})")
+        _loaded[path] = lib
+        if verbose:
+            print(f"loaded native extension {path}")
+        return lib
+    raise MXNetError(f"unsupported extension type: {path} "
+                     "(expected .py or .so)")

@@ -466,6 +466,10 @@ def jaxpr_to_onnx(closed_jaxpr, param_vals: Dict[str, _onp.ndarray],
         _convert_eqn(g, eqn)
 
     graph_outputs = []
+    if output_names is not None and len(output_names) != len(jaxpr.outvars):
+        raise MXNetError(
+            f"output_names has {len(output_names)} entries but the model "
+            f"produces {len(jaxpr.outvars)} outputs")
     out_names = output_names or [f"output{i}"
                                  for i in range(len(jaxpr.outvars))]
     for ov, oname in zip(jaxpr.outvars, out_names):

@@ -19,6 +19,11 @@ _ONNX_TO_NP = {
     P.INT8: _onp.int8, P.UINT8: _onp.uint8, P.INT32: _onp.int32,
     P.INT64: _onp.int64, P.BOOL: _onp.bool_,
 }
+try:  # bfloat16 casts are legal exporter output; numpy needs ml_dtypes
+    import ml_dtypes as _ml
+    _ONNX_TO_NP[P.BFLOAT16] = _ml.bfloat16
+except ImportError:
+    pass
 
 
 def _tensor_to_np(t):
@@ -173,7 +178,11 @@ def run_model(model_bytes: bytes, inputs: Dict[str, _onp.ndarray]):
             hi = ins[2] if len(ins) > 2 else None
             out = _onp.clip(ins[0], lo, hi)
         elif op == "Cast":
-            out = ins[0].astype(_ONNX_TO_NP[a["to"]])
+            to = _ONNX_TO_NP.get(a["to"])
+            if to is None:
+                raise MXNetError(f"interpreter: unsupported cast target "
+                                 f"{a['to']}")
+            out = ins[0].astype(to)
         elif op == "Reshape":
             out = ins[0].reshape([int(d) for d in ins[1]])
         elif op == "Transpose":

@@ -3,8 +3,11 @@ recordio + `tools/im2rec`). Pure-Python reimplementation of the same binary
 format: records framed by a magic number + length, 4-byte aligned, with an
 optional `IRHeader` (label/id) prefix for packed datasets.
 
-A C++ accelerated indexer/reader is planned under `src/` (native data plane);
-the format here is compatible with files produced by the reference's
+The native data plane (`mxnet_tpu/_native/io.cc`) provides the fast path —
+C++ record codec + background-thread read-ahead (parity: dmlc recordio and
+`src/io/iter_prefetcher.h`); this module transparently uses it when the
+library is built and falls back to pure Python otherwise. The format is
+compatible both ways and with files produced by the reference's
 `tools/im2rec`.
 """
 from __future__ import annotations
@@ -19,8 +22,8 @@ import numpy as _onp
 
 from .base import MXNetError
 
-__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
-           "pack_img", "unpack_img"]
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "MXPrefetchedRecordIO",
+           "IRHeader", "pack", "unpack", "pack_img", "unpack_img"]
 
 _MAGIC = 0xced7230a
 _LFLAG_BITS = 29
@@ -36,12 +39,24 @@ class MXRecordIO:
         self.open()
 
     def open(self):
+        from . import _native
+        native = _native.available()
         if self.flag == "w":
-            self.handle = open(self.uri, "wb")
             self.writable = True
+            if native:
+                self.handle = _native.NativeRecordWriter(self.uri)
+                self._native = True
+            else:
+                self.handle = open(self.uri, "wb")
+                self._native = False
         elif self.flag == "r":
-            self.handle = open(self.uri, "rb")
             self.writable = False
+            if native:
+                self.handle = _native.NativeRecordReader(self.uri)
+                self._native = True
+            else:
+                self.handle = open(self.uri, "rb")
+                self._native = False
         else:
             raise MXNetError("flag must be 'r' or 'w'")
 
@@ -75,7 +90,14 @@ class MXRecordIO:
         self.handle.seek(pos)
 
     def write(self, buf: bytes):
+        self._write(buf)
+
+    def _write(self, buf: bytes) -> int:
+        """Append one record; returns its byte offset (for .idx files)."""
         assert self.writable
+        if self._native:
+            return self.handle.write(buf)
+        pos = self.handle.tell()
         # dmlc framing: [magic][lrec][data][pad to 4B]
         lrec = len(buf)  # upper 3 bits: continuation flag (0 = complete)
         self.handle.write(struct.pack("<II", _MAGIC, lrec))
@@ -83,9 +105,12 @@ class MXRecordIO:
         pad = (4 - (len(buf) % 4)) % 4
         if pad:
             self.handle.write(b"\x00" * pad)
+        return pos
 
     def read(self) -> Optional[bytes]:
         assert not self.writable
+        if self._native:
+            return self.handle.read()
         hdr = self.handle.read(8)
         if len(hdr) < 8:
             return None
@@ -130,8 +155,7 @@ class MXIndexedRecordIO(MXRecordIO):
         return self.read()
 
     def write_idx(self, idx, buf: bytes):
-        pos = self.tell()
-        self.write(buf)
+        pos = self._write(buf)
         self.idx[idx] = pos
         self.keys.append(idx)
 
@@ -160,6 +184,59 @@ def unpack(s: bytes):
         header = header._replace(label=label)
         s = s[header.flag * 4:]
     return header, s
+
+
+class MXPrefetchedRecordIO:
+    """Sequential reader with background read-ahead.
+
+    Uses the C++ threaded prefetcher (`_native/io.cc` Prefetcher) when
+    available; otherwise a Python thread + bounded queue (parity:
+    `src/io/iter_prefetcher.h`). Iterate to get raw record bytes.
+    """
+
+    def __init__(self, uri: str, capacity: int = 16):
+        from . import _native
+        self.uri = uri
+        self.capacity = capacity
+        if _native.available():
+            self._impl = _native.NativePrefetchReader(uri, capacity)
+            self._queue = None
+        else:
+            import queue as _q
+            import threading as _t
+            self._impl = None
+            self._queue = _q.Queue(maxsize=capacity)
+            self._reader = MXRecordIO(uri, "r")
+            self._exhausted = False
+
+            def worker():
+                while True:
+                    rec = self._reader.read()
+                    self._queue.put(rec)
+                    if rec is None:
+                        return
+            self._thread = _t.Thread(target=worker, daemon=True)
+            self._thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._impl is not None:
+            return next(self._impl)
+        if self._exhausted:
+            raise StopIteration
+        rec = self._queue.get()
+        if rec is None:
+            self._exhausted = True
+            raise StopIteration
+        return rec
+
+    def close(self):
+        if self._impl is not None:
+            self._impl.close()
+        elif self._queue is not None:
+            self._reader.close()
 
 
 def pack_img(header: IRHeader, img, quality=95, img_fmt=".jpg"):

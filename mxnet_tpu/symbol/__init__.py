@@ -23,4 +23,5 @@ def __getattr__(name):
     fn = _symbol_mod._make_op(name)
     if fn is None:
         raise AttributeError(f"module 'mxnet_tpu.symbol' has no op '{name}'")
+    globals()[name] = fn  # cache: later accesses are plain dict lookups
     return fn

@@ -1,0 +1,156 @@
+"""Tests: mx.library extension loading, opperf harness, gradient
+compression, horovod/byteps adapter gating."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+
+# ---------------------------------------------------------------------------
+# mx.library
+# ---------------------------------------------------------------------------
+
+def test_load_python_extension(tmp_path):
+    ext = tmp_path / "myext.py"
+    ext.write_text(
+        "CALLED = {}\n"
+        "def register(mx):\n"
+        "    CALLED['mx'] = mx.__name__\n"
+        "    mx.sym.register_sym_op('myext_double', lambda a: a * 2)\n")
+    mod = mx.library.load(str(ext), verbose=False)
+    assert mod.CALLED["mx"] == "mxnet_tpu"
+    # the registered symbolic op works
+    x = mx.sym.Variable("x")
+    y = mx.sym.myext_double(x)
+    out = y.eval(x=mx.np.array([3.0]))[0]
+    assert float(out.asnumpy()[0]) == 6.0
+    assert str(ext) in mx.library.loaded_libraries()
+
+
+def test_load_native_extension_version_handshake(tmp_path):
+    from mxnet_tpu import _native
+    if not _native.available():
+        pytest.skip("no toolchain")
+    src = tmp_path / "ext.cc"
+    src.write_text(
+        'extern "C" int initialize(int v) { return v >= 11 ? 1 : 0; }\n'
+        'extern "C" int my_fn() { return 42; }\n')
+    so = tmp_path / "ext.so"
+    import subprocess
+    subprocess.run(["g++", "-shared", "-fPIC", str(src), "-o", str(so)],
+                   check=True)
+    lib = mx.library.load(str(so), verbose=False)
+    assert lib.my_fn() == 42
+
+
+def test_load_missing_extension():
+    with pytest.raises(MXNetError):
+        mx.library.load("/nonexistent/ext.py")
+
+
+# ---------------------------------------------------------------------------
+# opperf
+# ---------------------------------------------------------------------------
+
+def test_run_performance_test_basic():
+    res = mx.benchmark.run_performance_test(
+        "relu", inputs=[{"data": (64, 64)}], warmup=1, runs=2)
+    assert len(res) == 1
+    assert res[0]["op"] == "relu"
+    assert res[0]["avg_forward_time_ms"] > 0
+    assert res[0]["avg_backward_time_ms"] > 0
+
+
+def test_run_performance_test_kwargs_and_callable():
+    res = mx.benchmark.run_performance_test(
+        "softmax", inputs=[{"data": (8, 32), "axis": -1}], warmup=1, runs=2)
+    assert res[0]["avg_forward_time_ms"] > 0
+
+    def my_op(x):
+        return x * 2
+    res2 = mx.benchmark.run_performance_test(
+        my_op, inputs=[{"x": (16, 16)}], warmup=1, runs=2)
+    assert res2[0]["op"] == "my_op"
+
+
+def test_run_op_benchmarks_suite():
+    out = mx.benchmark.run_op_benchmarks(
+        ops=[("relu", [{"data": (32, 32)}]),
+             ("dot", [{"lhs": (16, 16), "rhs": (16, 16)}])],
+        warmup=1, runs=2)
+    assert set(out) == {"relu", "dot"}
+    assert all("error" not in r for rs in out.values() for r in rs)
+
+
+# ---------------------------------------------------------------------------
+# gradient compression
+# ---------------------------------------------------------------------------
+
+def test_gradient_compression_2bit_semantics():
+    from mxnet_tpu.kvstore import GradientCompression
+    gc = GradientCompression(type="2bit", threshold=0.5)
+    g = mx.np.array([0.8, -0.7, 0.1, 0.0])
+    out1 = gc.compress("k", g).asnumpy()
+    onp.testing.assert_allclose(out1, [0.5, -0.5, 0.0, 0.0])
+    # residuals: [0.3, -0.2, 0.1, 0.0]; second push accumulates
+    out2 = gc.compress("k", g).asnumpy()
+    # residual+grad = [1.1, -0.9, 0.2, 0.0] -> emit [0.5,-0.5,0,0]
+    onp.testing.assert_allclose(out2, [0.5, -0.5, 0.0, 0.0])
+    # error feedback conserves mass: total emitted approaches total pushed
+    total_emitted = out1 + out2
+    assert abs(total_emitted[0] - 1.0) < 0.61
+
+
+def test_gradient_compression_1bit_semantics():
+    from mxnet_tpu.kvstore import GradientCompression
+    gc = GradientCompression(type="1bit", threshold=0.5)
+    g = mx.np.array([2.0, -2.0])
+    out = gc.compress("k", g).asnumpy()
+    onp.testing.assert_allclose(out, [1.0, -1.0])
+
+
+def test_gradient_compression_invalid():
+    from mxnet_tpu.kvstore import GradientCompression
+    with pytest.raises(MXNetError):
+        GradientCompression(type="4bit")
+    with pytest.raises(MXNetError):
+        GradientCompression(threshold=-1)
+
+
+def test_kvstore_compression_integration():
+    kv = mx.kv.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    init = mx.np.zeros((4,))
+    kv.push("w", init)          # init push exact
+    out = mx.np.zeros((4,))
+    g = mx.np.array([0.8, -0.7, 0.2, 0.0])
+    kv.pushpull("w", g, out=out)
+    onp.testing.assert_allclose(out.asnumpy(), [0.5, -0.5, 0.0, 0.0])
+    # error feedback: residual [0.3,-0.2,0.2,0] + g crosses threshold only
+    # in the first two lanes again
+    kv.pushpull("w", g, out=out)
+    onp.testing.assert_allclose(out.asnumpy(), [0.5, -0.5, 0.0, 0.0])
+
+
+def test_kvstore_compression_off():
+    kv = mx.kv.create("device")
+    kv.set_gradient_compression({"type": "none"})
+    kv.push("w", mx.np.ones((2,)))
+    out = mx.np.zeros((2,))
+    kv.pushpull("w", mx.np.full((2,), 3.0), out=out)
+    # compression disabled: values flow through exactly
+    onp.testing.assert_allclose(out.asnumpy(), [3.0, 3.0])
+
+
+# ---------------------------------------------------------------------------
+# horovod / byteps adapters
+# ---------------------------------------------------------------------------
+
+def test_horovod_byteps_registered_but_gated():
+    with pytest.raises(MXNetError, match="horovod"):
+        mx.kv.create("horovod")
+    with pytest.raises(MXNetError, match="byteps"):
+        mx.kv.create("byteps")

@@ -1,0 +1,200 @@
+"""Native C++ IO data-plane tests (recordio codec, prefetcher, CSV) and
+pure-Python fallback interop (parity model: dmlc recordio tests +
+tests/python/unittest/test_recordio.py)."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import _native, recordio
+
+
+requires_native = pytest.mark.skipif(not _native.available(),
+                                     reason="native lib unavailable")
+
+
+def _force_python(monkeypatch):
+    monkeypatch.setattr(_native, "available", lambda: False)
+
+
+def test_recordio_roundtrip(tmp_path):
+    p = str(tmp_path / "a.rec")
+    w = recordio.MXRecordIO(p, "w")
+    records = [b"hello", b"x" * 1001, b"", b"tail"]
+    for r in records:
+        w.write(r)
+    w.close()
+    r = recordio.MXRecordIO(p, "r")
+    got = []
+    while True:
+        rec = r.read()
+        if rec is None:
+            break
+        got.append(rec)
+    r.close()
+    assert got == records
+
+
+@requires_native
+def test_native_python_interop(tmp_path, monkeypatch):
+    # write with native, read with pure python (and vice versa)
+    p1 = str(tmp_path / "n.rec")
+    w = recordio.MXRecordIO(p1, "w")
+    assert w._native
+    w.write(b"abc")
+    w.write(b"defgh")
+    w.close()
+
+    _force_python(monkeypatch)
+    r = recordio.MXRecordIO(p1, "r")
+    assert not r._native
+    assert r.read() == b"abc"
+    assert r.read() == b"defgh"
+    assert r.read() is None
+    r.close()
+
+    p2 = str(tmp_path / "p.rec")
+    w = recordio.MXRecordIO(p2, "w")
+    w.write(b"pure")
+    w.close()
+    monkeypatch.undo()
+    r = recordio.MXRecordIO(p2, "r")
+    assert r._native
+    assert r.read() == b"pure"
+    r.close()
+
+
+@pytest.mark.parametrize("native", [True, False])
+def test_indexed_recordio(tmp_path, monkeypatch, native):
+    if native and not _native.available():
+        pytest.skip("native unavailable")
+    if not native:
+        _force_python(monkeypatch)
+    p = str(tmp_path / "a.rec")
+    ip = str(tmp_path / "a.idx")
+    w = recordio.MXIndexedRecordIO(ip, p, "w")
+    for i in range(20):
+        w.write_idx(i, bytes([i]) * (i + 1))
+    w.close()
+    assert os.path.exists(ip)
+    r = recordio.MXIndexedRecordIO(ip, p, "r")
+    assert r.read_idx(7) == bytes([7]) * 8
+    assert r.read_idx(0) == b"\x00"
+    assert r.read_idx(19) == bytes([19]) * 20
+    r.close()
+
+
+@pytest.mark.parametrize("native", [True, False])
+def test_prefetched_recordio(tmp_path, monkeypatch, native):
+    if native and not _native.available():
+        pytest.skip("native unavailable")
+    if not native:
+        _force_python(monkeypatch)
+    p = str(tmp_path / "a.rec")
+    w = recordio.MXRecordIO(p, "w")
+    records = [os.urandom(100 + i) for i in range(50)]
+    for r in records:
+        w.write(r)
+    w.close()
+    pf = recordio.MXPrefetchedRecordIO(p, capacity=4)
+    got = list(pf)
+    pf.close()
+    assert got == records
+
+
+def test_pack_unpack_through_recordio(tmp_path):
+    p = str(tmp_path / "a.rec")
+    w = recordio.MXRecordIO(p, "w")
+    hdr = recordio.IRHeader(0, 3.0, 42, 0)
+    w.write(recordio.pack(hdr, b"payload"))
+    w.close()
+    r = recordio.MXRecordIO(p, "r")
+    h2, data = recordio.unpack(r.read())
+    assert h2.label == 3.0 and h2.id == 42 and data == b"payload"
+    r.close()
+
+
+@requires_native
+def test_native_csv_matches_numpy(tmp_path):
+    rng = onp.random.RandomState(0)
+    arr = rng.randn(40, 7).astype("float32")
+    p = str(tmp_path / "d.csv")
+    onp.savetxt(p, arr, delimiter=",", fmt="%.6g")
+    got = _native.csv_read(p)
+    ref = onp.loadtxt(p, delimiter=",", dtype=onp.float32, ndmin=2)
+    onp.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+@requires_native
+def test_native_csv_ragged_raises(tmp_path):
+    p = str(tmp_path / "bad.csv")
+    with open(p, "w") as f:
+        f.write("1,2,3\n4,5\n")
+    with pytest.raises(ValueError):
+        _native.csv_read(p)
+
+
+@pytest.mark.parametrize("native", [True, False])
+def test_csviter(tmp_path, monkeypatch, native):
+    if native and not _native.available():
+        pytest.skip("native unavailable")
+    if not native:
+        _force_python(monkeypatch)
+    rng = onp.random.RandomState(0)
+    data = rng.randn(10, 4).astype("float32")
+    labels = onp.arange(10, dtype="float32")
+    dp = str(tmp_path / "d.csv")
+    lp = str(tmp_path / "l.csv")
+    onp.savetxt(dp, data, delimiter=",", fmt="%.6g")
+    onp.savetxt(lp, labels, delimiter=",", fmt="%.6g")
+    it = mx.io.CSVIter(data_csv=dp, data_shape=(4,), label_csv=lp,
+                       batch_size=5)
+    batches = list(it)
+    assert len(batches) == 2
+    onp.testing.assert_allclose(batches[0].data[0].asnumpy(),
+                                data[:5], rtol=1e-4)
+
+
+@requires_native
+def test_corrupt_record_raises(tmp_path):
+    p = str(tmp_path / "bad.rec")
+    with open(p, "wb") as f:
+        f.write(b"\x00" * 16)
+    r = _native.NativeRecordReader(p)
+    with pytest.raises(IOError):
+        r.read()
+    r.close()
+
+
+def test_prefetcher_safe_after_exhaustion_and_close(tmp_path, monkeypatch):
+    p = str(tmp_path / "a.rec")
+    w = recordio.MXRecordIO(p, "w")
+    w.write(b"one")
+    w.close()
+    # python fallback: exhaust, then next() must raise again (not hang)
+    _force_python(monkeypatch)
+    pf = recordio.MXPrefetchedRecordIO(p)
+    assert list(pf) == [b"one"]
+    with pytest.raises(StopIteration):
+        next(pf)
+    pf.close()
+    monkeypatch.undo()
+    if _native.available():
+        pf = recordio.MXPrefetchedRecordIO(p)
+        assert list(pf) == [b"one"]
+        pf.close()
+        with pytest.raises(ValueError):
+            next(pf)
+
+
+@requires_native
+def test_native_reader_closed_raises(tmp_path):
+    p = str(tmp_path / "a.rec")
+    w = recordio.MXRecordIO(p, "w")
+    w.write(b"x")
+    w.close()
+    r = _native.NativeRecordReader(p)
+    r.close()
+    with pytest.raises(ValueError):
+        r.read()
