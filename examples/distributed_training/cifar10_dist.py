@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Data-parallel training over a device mesh (parity:
+`example/distributed_training/cifar10_dist.py`, whose NCCL/PS allreduce
+becomes GSPMD collectives here).
+
+Runs on real multi-chip TPU or a virtual CPU mesh:
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/distributed_training/cifar10_dist.py
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as onp
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=64,
+                    help="global batch size (split across devices)")
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--samples", type=int, default=512)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import make_mesh, make_sharded_train_step
+
+    n_dev = mx.num_devices()
+    print(f"training data-parallel over {n_dev} devices")
+    mesh = make_mesh({"dp": n_dev})
+
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(32, 3, padding=1, activation="relu"),
+            nn.MaxPool2D(2),
+            nn.Conv2D(64, 3, padding=1, activation="relu"),
+            nn.MaxPool2D(2),
+            nn.Flatten(),
+            nn.Dense(128, activation="relu"),
+            nn.Dense(10))
+    net.initialize(init=mx.init.Xavier())
+
+    rng = onp.random.RandomState(0)
+    x = rng.rand(args.samples, 3, 32, 32).astype("float32")
+    y = rng.randint(0, 10, args.samples).astype("int32")
+    net(mx.np.array(x[:2]))  # finish deferred shape inference
+
+    def loss_fn(out, data, label):
+        logp = jax.nn.log_softmax(out.astype(jnp.float32), axis=-1)
+        return -jnp.mean(jnp.take_along_axis(
+            logp, label[:, None].astype(jnp.int32), axis=-1))
+
+    step = make_sharded_train_step(
+        net, opt.SGD(learning_rate=0.05), loss_fn, mesh, num_model_args=1)
+
+    bs = args.batch_size
+    for epoch in range(args.epochs):
+        tic = time.time()
+        tot, nb = 0.0, 0
+        for i in range(0, args.samples - bs + 1, bs):
+            loss = step(mx.np.array(x[i:i + bs]), mx.np.array(y[i:i + bs]))
+            tot += float(loss)
+            nb += 1
+        step.sync_params_to_block()
+        print(f"[Epoch {epoch}] loss {tot / max(nb, 1):.4f} "
+              f"({args.samples / (time.time() - tic):.0f} samples/sec)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Actor-critic policy gradient (parity: `example/gluon/actor_critic.py`).
+Uses a self-contained CartPole implementation (no gym dependency): same
+dynamics constants as the classic environment."""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+class CartPole:
+    """Minimal CartPole-v1 dynamics (Barto-Sutton-Anderson constants)."""
+
+    def __init__(self, seed=0):
+        self.rng = onp.random.RandomState(seed)
+        self.reset()
+
+    def reset(self):
+        self.state = self.rng.uniform(-0.05, 0.05, size=4)
+        self.steps = 0
+        return self.state.copy()
+
+    def step(self, action):
+        x, x_dot, theta, theta_dot = self.state
+        force = 10.0 if action == 1 else -10.0
+        costh, sinth = onp.cos(theta), onp.sin(theta)
+        temp = (force + 0.05 * theta_dot ** 2 * sinth) / 1.1
+        theta_acc = (9.8 * sinth - costh * temp) / (
+            0.5 * (4.0 / 3.0 - 0.1 * costh ** 2 / 1.1))
+        x_acc = temp - 0.05 * theta_acc * costh / 1.1
+        tau = 0.02
+        self.state = onp.array([x + tau * x_dot, x_dot + tau * x_acc,
+                                theta + tau * theta_dot,
+                                theta_dot + tau * theta_acc])
+        self.steps += 1
+        done = bool(abs(self.state[0]) > 2.4
+                    or abs(self.state[2]) > 12 * onp.pi / 180
+                    or self.steps >= 200)
+        return self.state.copy(), 1.0, done
+
+
+class Policy(gluon.Block):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.dense = nn.Dense(16, in_units=4, activation="relu")
+        self.action_pred = nn.Dense(2, in_units=16)
+        self.value_pred = nn.Dense(1, in_units=16)
+
+    def forward(self, x):
+        x = self.dense(x)
+        probs = mx.npx.softmax(self.action_pred(x))
+        values = self.value_pred(x)
+        return probs, values
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--episodes", type=int, default=30)
+    ap.add_argument("--gamma", type=float, default=0.99)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    env = CartPole(args.seed)
+    onp.random.seed(args.seed)
+    net = Policy()
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-2})
+    l2 = gluon.loss.L2Loss()
+
+    for episode in range(args.episodes):
+        state = env.reset()
+        rewards, heads = [], []
+        with autograd.record():
+            losses = []
+            done = False
+            while not done:
+                s = mx.np.array(state.astype("float32")).reshape(1, 4)
+                probs, value = net(s)
+                p = probs.asnumpy()[0]
+                action = int(onp.random.choice(2, p=p / p.sum()))
+                logp = mx.np.log(probs[0, action])
+                state, reward, done = env.step(action)
+                rewards.append(reward)
+                heads.append((logp, value))
+            # discounted returns, normalized
+            R = 0.0
+            returns = []
+            for r in reversed(rewards):
+                R = r + args.gamma * R
+                returns.append(R)
+            returns.reverse()
+            ret = onp.asarray(returns, dtype="float32")
+            ret = (ret - ret.mean()) / (ret.std() + 1e-6)
+            for (logp, value), r in zip(heads, returns):
+                rr = mx.np.array([float(r)])
+                advantage = float(r) - float(value.asnumpy().ravel()[0])
+                losses.append(-logp * advantage
+                              + l2(value.reshape(-1), rr))
+            total = sum(losses[1:], losses[0])
+        total.backward()
+        trainer.step(1)
+        if (episode + 1) % 10 == 0:
+            print(f"episode {episode + 1}: length {len(rewards)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Regression with k-fold cross validation on synthetic tabular data
+(parity: `example/gluon/house_prices/kaggle_k_fold_cross_validation.py`)."""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def get_net():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(64, activation="relu"), nn.Dense(1))
+    net.initialize()
+    return net
+
+
+def train(net, x_train, y_train, epochs=30, lr=0.05, wd=1e-4):
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": lr, "wd": wd})
+    loss_fn = gluon.loss.L2Loss()
+    ds = gluon.data.ArrayDataset(x_train, y_train)
+    loader = gluon.data.DataLoader(ds, batch_size=64, shuffle=True)
+    for _ in range(epochs):
+        for data, label in loader:
+            with autograd.record():
+                loss = loss_fn(net(data), label)
+            loss.backward()
+            trainer.step(data.shape[0])
+    return net
+
+
+def rmse_log(net, x, y):
+    pred = mx.np.maximum(net(x).reshape(-1), 1e-6)
+    return float(mx.np.sqrt(
+        ((mx.np.log(pred) - mx.np.log(y)) ** 2).mean()).asnumpy())
+
+
+def k_fold(k, x, y):
+    fold = x.shape[0] // k
+    errors = []
+    for i in range(k):
+        lo, hi = i * fold, (i + 1) * fold
+        x_val, y_val = x[lo:hi], y[lo:hi]
+        x_tr = mx.np.concatenate([x[:lo], x[hi:]])
+        y_tr = mx.np.concatenate([y[:lo], y[hi:]])
+        net = train(get_net(), x_tr, y_tr)
+        errors.append(rmse_log(net, x_val, y_val))
+        print(f"fold {i}: rmse(log)={errors[-1]:.4f}")
+    return sum(errors) / k
+
+
+def main():
+    rng = onp.random.RandomState(0)
+    n, d = 1000, 16
+    features = rng.randn(n, d).astype("float32")
+    w = rng.rand(d).astype("float32")
+    prices = onp.exp(features @ w * 0.3 + 1.0).astype("float32")
+    avg = k_fold(5, mx.np.array(features), mx.np.array(prices))
+    print(f"5-fold average rmse(log): {avg:.4f}")
+
+
+if __name__ == "__main__":
+    main()

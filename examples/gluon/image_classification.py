@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Train a model_zoo vision network (parity:
+`example/gluon/image_classification.py`). Synthetic CIFAR-shaped data by
+default so it runs without downloads.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet18_v1")
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--samples", type=int, default=256)
+    ap.add_argument("--classes", type=int, default=10)
+    ap.add_argument("--lr", type=float, default=0.05)
+    args = ap.parse_args()
+
+    net = vision.get_model(args.model, classes=args.classes)
+    net.initialize(init=mx.init.Xavier())
+    net.hybridize()
+
+    rng = onp.random.RandomState(0)
+    x = rng.rand(args.samples, 3, 32, 32).astype("float32")
+    y = rng.randint(0, args.classes, args.samples).astype("float32")
+    ds = gluon.data.ArrayDataset(mx.np.array(x), mx.np.array(y))
+    loader = gluon.data.DataLoader(ds, batch_size=args.batch_size,
+                                   shuffle=True)
+
+    trainer = gluon.Trainer(net.collect_params(), "nag",
+                            {"learning_rate": args.lr, "momentum": 0.9,
+                             "wd": 1e-4})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = gluon.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        metric.reset()
+        tic = time.time()
+        for data, label in loader:
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            metric.update(label, out)
+        name, acc = metric.get()
+        print(f"[Epoch {epoch}] {args.model} {name}={acc:.4f} "
+              f"time={time.time() - tic:.1f}s")
+
+
+if __name__ == "__main__":
+    main()

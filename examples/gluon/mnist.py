@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""MNIST MLP — the framework's north-star config #1 (parity:
+`example/gluon/mnist/mnist.py`).
+
+Downloads MNIST via `gluon.data.vision.MNIST` when network is available;
+`--synthetic` trains on a generated stand-in so the example runs anywhere.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def get_data(synthetic: bool, batch_size: int):
+    if synthetic:
+        rng = onp.random.RandomState(0)
+        x = rng.rand(2048, 1, 28, 28).astype("float32")
+        w = rng.randn(784, 10).astype("float32")
+        y = onp.argmax(x.reshape(2048, -1) @ w, axis=1).astype("float32")
+        train = gluon.data.ArrayDataset(mx.np.array(x), mx.np.array(y))
+        val = train
+    else:
+        transform = gluon.data.vision.transforms.ToTensor()
+        train = gluon.data.vision.MNIST(train=True).transform_first(transform)
+        val = gluon.data.vision.MNIST(train=False).transform_first(transform)
+    return (gluon.data.DataLoader(train, batch_size=batch_size, shuffle=True),
+            gluon.data.DataLoader(val, batch_size=batch_size))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=100)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--synthetic", action="store_true")
+    args = ap.parse_args()
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(128, activation="relu"),
+            nn.Dense(64, activation="relu"),
+            nn.Dense(10))
+    net.initialize()
+    net.hybridize(static_alloc=True, static_shape=True)
+
+    train_data, val_data = get_data(args.synthetic, args.batch_size)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = gluon.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        metric.reset()
+        tic = time.time()
+        n = 0
+        for data, label in train_data:
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            metric.update(label, out)
+            n += data.shape[0]
+        name, acc = metric.get()
+        print(f"Epoch {epoch}: {name}={acc:.4f} "
+              f"({n / (time.time() - tic):.0f} samples/sec)")
+
+    metric.reset()
+    for data, label in val_data:
+        metric.update(label, net(data))
+    print("Validation: %s=%.4f" % metric.get())
+
+
+if __name__ == "__main__":
+    main()

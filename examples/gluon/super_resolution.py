@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Super-resolution with an ESPCN-style sub-pixel CNN (parity:
+`example/gluon/super_resolution/super_resolution.py`): conv stack +
+PixelShuffle upsampling, trained on synthetic downsampled images."""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+class SuperResolutionNet(nn.HybridSequential):
+    def __init__(self, upscale_factor=2):
+        super().__init__()
+        self.add(
+            nn.Conv2D(64, kernel_size=5, padding=2, activation="relu"),
+            nn.Conv2D(64, kernel_size=3, padding=1, activation="relu"),
+            nn.Conv2D(32, kernel_size=3, padding=1, activation="relu"),
+            nn.Conv2D(upscale_factor ** 2, kernel_size=3, padding=1),
+            nn.PixelShuffle2D(upscale_factor),
+        )
+
+
+def psnr(a, b):
+    mse = float(((a - b) ** 2).mean().asnumpy())
+    return 10.0 * onp.log10(1.0 / max(mse, 1e-12))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=5)
+    ap.add_argument("--upscale", type=int, default=2)
+    args = ap.parse_args()
+
+    rng = onp.random.RandomState(0)
+    n, size = 128, 32
+    hi = rng.rand(n, 1, size, size).astype("float32")
+    lo = hi[:, :, ::args.upscale, ::args.upscale]  # naive downsample
+    ds = gluon.data.ArrayDataset(mx.np.array(lo), mx.np.array(hi))
+    loader = gluon.data.DataLoader(ds, batch_size=16, shuffle=True)
+
+    net = SuperResolutionNet(args.upscale)
+    net.initialize(init=mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    loss_fn = gluon.loss.L2Loss()
+
+    for epoch in range(args.epochs):
+        tot, cnt = 0.0, 0
+        for data, label in loader:
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            tot += float(loss.asnumpy().sum())
+            cnt += data.shape[0]
+        x, y = next(iter(loader))
+        print(f"Epoch {epoch}: avg loss {tot / cnt:.5f} "
+              f"psnr {psnr(net(x), y):.2f} dB")
+
+
+if __name__ == "__main__":
+    main()

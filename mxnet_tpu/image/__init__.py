@@ -6,6 +6,7 @@ Layout: HWC uint8/float like the reference's image namespace.
 """
 from __future__ import annotations
 
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -18,8 +19,13 @@ from ..ndarray.ndarray import ndarray, apply_op, from_jax
 from .. import random as _rng
 
 __all__ = ["imdecode", "imresize", "resize_short", "fixed_crop", "center_crop",
-           "random_crop", "color_normalize", "HorizontalFlipAug", "CastAug",
-           "ColorNormalizeAug", "ResizeAug", "CenterCropAug", "RandomCropAug"]
+           "random_crop", "random_size_crop", "color_normalize",
+           "HorizontalFlipAug", "CastAug", "ColorNormalizeAug", "ResizeAug",
+           "ForceResizeAug", "CenterCropAug", "RandomCropAug",
+           "RandomSizedCropAug", "BrightnessJitterAug", "ContrastJitterAug",
+           "SaturationJitterAug", "HueJitterAug", "ColorJitterAug",
+           "LightingAug", "RandomGrayAug", "RandomOrderAug", "SequentialAug",
+           "CreateAugmenter", "ImageIter"]
 
 
 def imdecode(buf, to_rgb=1, flag=1):
@@ -158,3 +164,323 @@ class ColorNormalizeAug(Augmenter):
 
     def __call__(self, src):
         return color_normalize(src, self.mean, self.std)
+
+
+def random_size_crop(src: ndarray, size: Tuple[int, int], area,
+                     ratio: Tuple[float, float], interp=2):
+    """Random crop with area/aspect jitter then resize (parity:
+    `python/mxnet/image/image.py` random_size_crop)."""
+    h, w = src.shape[0], src.shape[1]
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = float(_onp.random.uniform(area[0], area[1])) * h * w
+        log_ratio = (_onp.log(ratio[0]), _onp.log(ratio[1]))
+        aspect = float(_onp.exp(_onp.random.uniform(*log_ratio)))
+        new_w = int(round(_onp.sqrt(target_area * aspect)))
+        new_h = int(round(_onp.sqrt(target_area / aspect)))
+        if new_w <= w and new_h <= h:
+            x0 = int(_onp.random.randint(0, w - new_w + 1))
+            y0 = int(_onp.random.randint(0, h - new_h + 1))
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__()
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__()
+        self.size, self.area, self.ratio, self.interp = size, area, ratio, \
+            interp
+
+    def __call__(self, src):
+        out = random_size_crop(src, self.size, self.area, self.ratio,
+                               self.interp)
+        return out[0] if isinstance(out, tuple) else out
+
+
+class BrightnessJitterAug(Augmenter):
+    """Scale pixel values by 1 ± U(-brightness, brightness)."""
+
+    def __init__(self, brightness):
+        super().__init__()
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + float(_onp.random.uniform(-self.brightness,
+                                                self.brightness))
+        return apply_op(lambda x: x * alpha, (src,), {}, name="brightness")
+
+
+class ContrastJitterAug(Augmenter):
+    """Blend with the mean gray value (ITU-R BT.601 coefficients, as the
+    reference's contrast_aug)."""
+
+    def __init__(self, contrast):
+        super().__init__()
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + float(_onp.random.uniform(-self.contrast,
+                                                self.contrast))
+        coef = jnp.asarray([0.299, 0.587, 0.114])
+
+        def fn(x):
+            gray = (x * coef).sum(axis=-1, keepdims=True)
+            mean = gray.mean()
+            return x * alpha + mean * (1.0 - alpha)
+        return apply_op(fn, (src,), {}, name="contrast")
+
+
+class SaturationJitterAug(Augmenter):
+    """Blend with the per-pixel gray image."""
+
+    def __init__(self, saturation):
+        super().__init__()
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + float(_onp.random.uniform(-self.saturation,
+                                                self.saturation))
+        coef = jnp.asarray([0.299, 0.587, 0.114])
+
+        def fn(x):
+            gray = (x * coef).sum(axis=-1, keepdims=True)
+            return x * alpha + gray * (1.0 - alpha)
+        return apply_op(fn, (src,), {}, name="saturation")
+
+
+class HueJitterAug(Augmenter):
+    """Rotate hue via the YIQ linear approximation (reference hue_aug)."""
+
+    def __init__(self, hue):
+        super().__init__()
+        self.hue = hue
+
+    def __call__(self, src):
+        alpha = float(_onp.random.uniform(-self.hue, self.hue))
+        u = _onp.cos(alpha * _onp.pi)
+        w_ = _onp.sin(alpha * _onp.pi)
+        bt = _onp.array([[1.0, 0.0, 0.0],
+                         [0.0, u, -w_],
+                         [0.0, w_, u]])
+        tyiq = _onp.array([[0.299, 0.587, 0.114],
+                           [0.596, -0.274, -0.321],
+                           [0.211, -0.523, 0.311]])
+        ityiq = _onp.array([[1.0, 0.9563, 0.6210],
+                            [1.0, -0.2721, -0.6474],
+                            [1.0, -1.107, 1.7046]])
+        t = jnp.asarray(_onp.dot(_onp.dot(ityiq, bt), tyiq).T)
+        return apply_op(lambda x: jnp.dot(x, t), (src,), {}, name="hue")
+
+
+class ColorJitterAug(Augmenter):
+    """Random order of brightness/contrast/saturation jitter."""
+
+    def __init__(self, brightness=0.0, contrast=0.0, saturation=0.0):
+        super().__init__()
+        self.augs = []
+        if brightness:
+            self.augs.append(BrightnessJitterAug(brightness))
+        if contrast:
+            self.augs.append(ContrastJitterAug(contrast))
+        if saturation:
+            self.augs.append(SaturationJitterAug(saturation))
+
+    def __call__(self, src):
+        order = _onp.random.permutation(len(self.augs))
+        for i in order:
+            src = self.augs[i](src)
+        return src
+
+
+class LightingAug(Augmenter):
+    """PCA-based lighting noise (AlexNet-style)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__()
+        self.alphastd = alphastd
+        self.eigval = _onp.asarray(eigval)
+        self.eigvec = _onp.asarray(eigvec)
+
+    def __call__(self, src):
+        alpha = _onp.random.normal(0, self.alphastd, size=(3,))
+        rgb = jnp.asarray(_onp.dot(self.eigvec * alpha, self.eigval))
+        return apply_op(lambda x: x + rgb, (src,), {}, name="lighting")
+
+
+class RandomGrayAug(Augmenter):
+    def __init__(self, p):
+        super().__init__()
+        self.p = p
+        self._mat = jnp.asarray([[0.21, 0.21, 0.21],
+                                 [0.72, 0.72, 0.72],
+                                 [0.07, 0.07, 0.07]])
+
+    def __call__(self, src):
+        if _onp.random.uniform() < self.p:
+            mat = self._mat
+            return apply_op(lambda x: jnp.dot(x, mat), (src,), {},
+                            name="gray")
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = list(ts)
+
+    def __call__(self, src):
+        for i in _onp.random.permutation(len(self.ts)):
+            src = self.ts[i](src)
+        return src
+
+
+class SequentialAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = list(ts)
+
+    def __call__(self, src):
+        for t in self.ts:
+            src = t(src)
+        return src
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Build the standard augmentation pipeline (parity:
+    `python/mxnet/image/image.py` CreateAugmenter)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3 / 4.0, 4 / 3.0), inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    jitter = []
+    if brightness:
+        jitter.append(BrightnessJitterAug(brightness))
+    if contrast:
+        jitter.append(ContrastJitterAug(contrast))
+    if saturation:
+        jitter.append(SaturationJitterAug(saturation))
+    if jitter:
+        auglist.append(RandomOrderAug(jitter))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = _onp.array([55.46, 4.794, 1.148])
+        eigvec = _onp.array([[-0.5675, 0.7192, 0.4009],
+                             [-0.5808, -0.0045, -0.8140],
+                             [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = _onp.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = _onp.array([58.395, 57.12, 57.375])
+    if mean is not None and not isinstance(mean, bool):
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter:
+    """Image data iterator over an indexed RecordIO pack or an image list
+    (parity: `python/mxnet/image/image.py` ImageIter). Yields `DataBatch`
+    with NCHW float data."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imgidx=None, imglist=None, path_root="",
+                 shuffle=False, aug_list=None, label_width=1, **kwargs):
+        from ..io import DataBatch, DataDesc
+        from .. import recordio as _recordio
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self._DataBatch = DataBatch
+        self.auglist = aug_list if aug_list is not None else \
+            CreateAugmenter(data_shape, **kwargs)
+        self.shuffle = shuffle
+        self._rec = None
+        if path_imgrec is not None:
+            idx_path = path_imgidx or os.path.splitext(path_imgrec)[0] + \
+                ".idx"
+            self._rec = _recordio.MXIndexedRecordIO(idx_path, path_imgrec,
+                                                    "r")
+            self._keys = list(self._rec.keys)
+        elif imglist is not None:
+            self._list = [(float(e[0]) if label_width == 1
+                           else _onp.asarray(e[:-1], dtype=_onp.float32),
+                           os.path.join(path_root, e[-1]))
+                          for e in imglist]
+            self._keys = list(range(len(self._list)))
+        else:
+            raise MXNetError("ImageIter needs path_imgrec or imglist")
+        self._order = list(self._keys)
+        self.reset()
+
+    def reset(self):
+        if self.shuffle:
+            _onp.random.shuffle(self._order)
+        self._cursor = 0
+
+    def _read_sample(self, key):
+        from .. import recordio as _recordio
+        if self._rec is not None:
+            header, img_bytes = _recordio.unpack(self._rec.read_idx(key))
+            label = header.label
+            img = imdecode(img_bytes)
+        else:
+            label, path = self._list[key]
+            with open(path, "rb") as f:
+                img = imdecode(f.read())
+        for aug in self.auglist:
+            img = aug(img)
+        return img, label
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    def next(self):
+        if self._cursor >= len(self._order):
+            self.reset()
+            raise StopIteration
+        from ..numpy import stack as _stack, array as _array
+        imgs, labels = [], []
+        while len(imgs) < self.batch_size and \
+                self._cursor < len(self._order):
+            img, label = self._read_sample(self._order[self._cursor])
+            self._cursor += 1
+            imgs.append(img.transpose(2, 0, 1))
+            labels.append(label)
+        # pad the final partial batch by repeating the last sample
+        pad = self.batch_size - len(imgs)
+        for _ in range(pad):
+            imgs.append(imgs[-1])
+            labels.append(labels[-1])
+        data = _stack(imgs)
+        label = _array(_onp.asarray(labels, dtype=_onp.float32))
+        return self._DataBatch([data], [label], pad=pad)

@@ -1,0 +1,100 @@
+"""mx.image augmenter + ImageIter tests (parity model: reference
+tests/python/unittest/test_image.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image as img
+
+
+def _rand_img(h=32, w=32):
+    return mx.np.array(onp.random.uniform(0, 255, (h, w, 3))
+                       .astype("float32"))
+
+
+def test_resize_and_crops():
+    x = _rand_img(40, 60)
+    r = img.imresize(x, 20, 10)
+    assert r.shape == (10, 20, 3)
+    s = img.resize_short(x, 30)
+    assert min(s.shape[:2]) == 30
+    c = img.center_crop(x, (20, 20))[0] if isinstance(
+        img.center_crop(x, (20, 20)), tuple) else img.center_crop(x, (20, 20))
+    cc = img.center_crop(x, (20, 20))
+    cc = cc[0] if isinstance(cc, tuple) else cc
+    assert cc.shape == (20, 20, 3)
+
+
+def test_random_size_crop():
+    x = _rand_img(64, 64)
+    out, rect = img.random_size_crop(x, (32, 32), (0.5, 1.0), (0.75, 1.333))
+    assert out.shape == (32, 32, 3)
+    x0, y0, w, h = rect
+    assert 0 <= x0 and x0 + w <= 64 and 0 <= y0 and y0 + h <= 64
+
+
+def test_brightness_contrast_saturation_hue():
+    x = _rand_img()
+    for aug in (img.BrightnessJitterAug(0.5), img.ContrastJitterAug(0.5),
+                img.SaturationJitterAug(0.5), img.HueJitterAug(0.5)):
+        out = aug(x)
+        assert out.shape == x.shape
+    # zero jitter is identity
+    onp.testing.assert_allclose(img.BrightnessJitterAug(0.0)(x).asnumpy(),
+                                x.asnumpy(), rtol=1e-6)
+    # the YIQ forward/inverse matrices are 4-digit approximations, so the
+    # zero-hue identity holds to ~0.5 absolute on a 0-255 scale
+    onp.testing.assert_allclose(img.HueJitterAug(0.0)(x).asnumpy(),
+                                x.asnumpy(), atol=1.0)
+
+
+def test_lighting_gray_order_augs():
+    x = _rand_img()
+    eigval = onp.array([55.46, 4.794, 1.148])
+    eigvec = onp.eye(3)
+    out = img.LightingAug(0.1, eigval, eigvec)(x)
+    assert out.shape == x.shape
+    g = img.RandomGrayAug(1.0)(x).asnumpy()
+    # all channels equal after gray
+    onp.testing.assert_allclose(g[..., 0], g[..., 1], rtol=1e-5)
+    seq = img.SequentialAug([img.CastAug(), img.BrightnessJitterAug(0.0)])
+    assert seq(x).shape == x.shape
+
+
+def test_create_augmenter_pipeline():
+    augs = img.CreateAugmenter((3, 24, 24), rand_mirror=True, brightness=0.1,
+                               contrast=0.1, saturation=0.1, hue=0.1,
+                               pca_noise=0.1, rand_gray=0.1, mean=True,
+                               std=True)
+    x = _rand_img(32, 32)
+    for a in augs:
+        x = a(x)
+    assert x.shape == (24, 24, 3)
+
+
+def test_image_iter_from_recordio(tmp_path):
+    pytest.importorskip("PIL")
+    from PIL import Image
+    import io as _io
+    from mxnet_tpu import recordio
+
+    rec_p = str(tmp_path / "d.rec")
+    idx_p = str(tmp_path / "d.idx")
+    w = recordio.MXIndexedRecordIO(idx_p, rec_p, "w")
+    rng = onp.random.RandomState(0)
+    for i in range(10):
+        arr = rng.randint(0, 255, (36, 36, 3), dtype=onp.uint8)
+        buf = _io.BytesIO()
+        Image.fromarray(arr).save(buf, format="PNG")
+        w.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i % 3), i, 0), buf.getvalue()))
+    w.close()
+
+    it = img.ImageIter(batch_size=4, data_shape=(3, 24, 24),
+                       path_imgrec=rec_p, path_imgidx=idx_p)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 3, 24, 24)
+    assert batches[-1].pad == 2   # 10 samples -> last batch padded by 2
+    # labels preserved
+    assert batches[0].label[0].shape == (4,)

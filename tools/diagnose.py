@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Environment diagnosis (parity: `tools/diagnose.py`): platform, python,
+framework features, device inventory, key environment variables."""
+from __future__ import annotations
+
+import os
+import platform
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    print("----------Platform Info----------")
+    print(f"system  : {platform.system()} {platform.release()}")
+    print(f"machine : {platform.machine()}")
+    print(f"python  : {sys.version.split()[0]}")
+    try:
+        import numpy
+        print(f"numpy   : {numpy.__version__}")
+    except ImportError:
+        pass
+    try:
+        import jax
+        if os.environ.get("JAX_PLATFORMS"):
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        print(f"jax     : {jax.__version__}")
+    except ImportError:
+        print("jax     : NOT FOUND")
+        return
+    print("----------Framework Info----------")
+    import mxnet_tpu as mx
+    print(f"mxnet_tpu: {mx.__version__} ({os.path.dirname(mx.__file__)})")
+    feats = mx.runtime.feature_list() if hasattr(mx.runtime, "feature_list") \
+        else []
+    if feats:
+        enabled = [f.name for f in feats if getattr(f, "enabled", False)]
+        print(f"features : {', '.join(enabled)}")
+    from mxnet_tpu import _native
+    print(f"native io: {'built' if _native.available() else 'python fallback'}")
+    print("----------Device Info----------")
+    try:
+        for d in __import__("jax").devices():
+            print(f"  {d.id}: {d.platform} {d.device_kind}")
+    except Exception as e:
+        print(f"  device init failed: {e}")
+    print("----------Environment----------")
+    for k, v in sorted(os.environ.items()):
+        if k.startswith(("MXTPU_", "MXNET_", "XLA_", "JAX_", "DMLC_")):
+            print(f"  {k}={v}")
+
+
+if __name__ == "__main__":
+    main()

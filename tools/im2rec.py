@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Pack an image folder or .lst file into RecordIO (parity:
+`tools/im2rec.py` of the reference; same .lst format
+`index\tlabel[\tlabel...]\trelative_path`).
+
+Uses the native C++ recordio writer when built. Requires PIL for image
+re-encoding; with `--pass-through` the raw file bytes are packed without
+decoding (no PIL needed).
+
+Usage:
+    python tools/im2rec.py --list prefix image_root   # generate prefix.lst
+    python tools/im2rec.py prefix image_root          # pack prefix.rec/.idx
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def make_list(prefix, root, recursive=False, train_ratio=1.0, shuffle=True,
+              exts=(".jpg", ".jpeg", ".png", ".bmp")):
+    entries = []
+    classes = {}
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames.sort()
+        if not recursive and os.path.abspath(dirpath) != os.path.abspath(root):
+            label_dir = os.path.relpath(dirpath, root).split(os.sep)[0]
+        for fn in sorted(filenames):
+            if os.path.splitext(fn)[1].lower() not in exts:
+                continue
+            rel = os.path.relpath(os.path.join(dirpath, fn), root)
+            top = rel.split(os.sep)[0] if os.sep in rel else ""
+            label = classes.setdefault(top, len(classes))
+            entries.append((label, rel))
+    if shuffle:
+        random.shuffle(entries)
+    n_train = int(len(entries) * train_ratio)
+    splits = [("", entries)] if train_ratio >= 1.0 else [
+        ("_train", entries[:n_train]), ("_val", entries[n_train:])]
+    for suffix, items in splits:
+        with open(f"{prefix}{suffix}.lst", "w") as f:
+            for i, (label, rel) in enumerate(items):
+                f.write(f"{i}\t{label}\t{rel}\n")
+    return classes
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield int(parts[0]), [float(x) for x in parts[1:-1]], parts[-1]
+
+
+def pack(prefix, root, resize=0, quality=95, color=1, pass_through=False):
+    from mxnet_tpu import recordio
+    rec_path = prefix + ".rec"
+    idx_path = prefix + ".idx"
+    writer = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    count = 0
+    for idx, labels, rel in read_list(prefix + ".lst"):
+        path = os.path.join(root, rel)
+        label = labels[0] if len(labels) == 1 else labels
+        header = recordio.IRHeader(0, label, idx, 0)
+        if pass_through or resize == 0:
+            with open(path, "rb") as f:
+                data = f.read()
+        else:
+            import io as _io
+
+            from PIL import Image
+            img = Image.open(path)
+            if color == 0:
+                img = img.convert("L")
+            if resize:
+                w, h = img.size
+                scale = resize / min(w, h)
+                img = img.resize((int(w * scale), int(h * scale)))
+            buf = _io.BytesIO()
+            img.save(buf, format="JPEG", quality=quality)
+            data = buf.getvalue()
+        writer.write_idx(idx, recordio.pack(header, data))
+        count += 1
+        if count % 1000 == 0:
+            print(f"packed {count} images", file=sys.stderr)
+    writer.close()
+    print(f"wrote {count} records to {rec_path}")
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix")
+    ap.add_argument("root")
+    ap.add_argument("--list", action="store_true",
+                    help="generate .lst instead of packing")
+    ap.add_argument("--recursive", action="store_true")
+    ap.add_argument("--train-ratio", type=float, default=1.0)
+    ap.add_argument("--no-shuffle", action="store_true")
+    ap.add_argument("--resize", type=int, default=0)
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--color", type=int, default=1)
+    ap.add_argument("--pass-through", action="store_true",
+                    help="pack raw file bytes without re-encoding")
+    args = ap.parse_args()
+    if args.list:
+        classes = make_list(args.prefix, args.root, args.recursive,
+                            args.train_ratio, not args.no_shuffle)
+        print(f"classes: {classes}")
+    else:
+        if not os.path.exists(args.prefix + ".lst"):
+            make_list(args.prefix, args.root, args.recursive, 1.0,
+                      not args.no_shuffle)
+        pack(args.prefix, args.root, args.resize, args.quality, args.color,
+             args.pass_through)
+
+
+if __name__ == "__main__":
+    main()

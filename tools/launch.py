@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Multi-host job launcher (parity: `tools/launch.py:72-109` of the
+reference — dmlc_tracker's `local|ssh|mpi` launchers).
+
+TPU-native mapping: there are no parameter servers; each launched worker is
+a JAX process in a multi-controller job. The launcher exports the
+environment `jax.distributed.initialize` reads:
+
+    MXTPU_COORDINATOR   (≈ DMLC_PS_ROOT_URI:PORT)
+    MXTPU_NUM_PROCESSES (≈ DMLC_NUM_WORKER)
+    MXTPU_PROCESS_ID    (rank)
+
+- `--launcher local` spawns N copies of the command on this machine (the
+  reference's single-machine multi-process test trick,
+  `tests/nightly/test_distributed_training-gpu.sh:25-38`).
+- `--launcher ssh -H hostfile` prints/execs ssh commands per host.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def launch_local(n, command, port=29500):
+    procs = []
+    for rank in range(n):
+        env = dict(os.environ)
+        env["MXTPU_COORDINATOR"] = f"127.0.0.1:{port}"
+        env["MXTPU_NUM_PROCESSES"] = str(n)
+        env["MXTPU_PROCESS_ID"] = str(rank)
+        # legacy names so reference scripts keep working
+        env["DMLC_ROLE"] = "worker"
+        env["DMLC_NUM_WORKER"] = str(n)
+        env["DMLC_WORKER_ID"] = str(rank)
+        procs.append(subprocess.Popen(command, shell=True, env=env))
+    rc = 0
+    for p in procs:
+        rc |= p.wait()
+    return rc
+
+
+def launch_ssh(hosts, n, command, port=29500, dry_run=False):
+    coordinator = f"{hosts[0]}:{port}"
+    procs = []
+    for rank in range(n):
+        host = hosts[rank % len(hosts)]
+        env = (f"MXTPU_COORDINATOR={coordinator} "
+               f"MXTPU_NUM_PROCESSES={n} MXTPU_PROCESS_ID={rank}")
+        cmd = f"ssh -o StrictHostKeyChecking=no {host} '{env} {command}'"
+        if dry_run:
+            print(cmd)
+        else:
+            procs.append(subprocess.Popen(cmd, shell=True))
+    rc = 0
+    for p in procs:
+        rc |= p.wait()
+    return rc
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("--launcher", choices=["local", "ssh"], default="local")
+    ap.add_argument("-H", "--hostfile", default=None)
+    ap.add_argument("-p", "--port", type=int, default=29500)
+    ap.add_argument("--dry-run", action="store_true")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    command = " ".join(args.command)
+    if not command:
+        ap.error("no command given")
+    if args.launcher == "local":
+        sys.exit(launch_local(args.num_workers, command, args.port))
+    hosts = [l.strip() for l in open(args.hostfile) if l.strip()]
+    sys.exit(launch_ssh(hosts, args.num_workers, command, args.port,
+                        args.dry_run))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Collective bandwidth microbenchmark (parity: `tools/bandwidth/measure.py`
+— the reference measures kvstore push/pull; here the wire is XLA collectives
+over the device mesh, so we time psum/all_gather at increasing sizes).
+
+Run with a virtual mesh for smoke tests:
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python tools/measure_bandwidth.py --sizes 1,4,16
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--sizes", default="1,4,16,64",
+                    help="comma-separated tensor sizes in MiB")
+    ap.add_argument("--runs", type=int, default=5)
+    args = ap.parse_args()
+
+    import jax
+    if os.environ.get("JAX_PLATFORMS"):
+        # some PJRT plugins register themselves as default regardless of the
+        # env var; re-assert the user's choice before backend init
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = jax.devices()
+    n = len(devices)
+    print(f"devices: {n} x {devices[0].platform}")
+    mesh = Mesh(jax.numpy.array(devices).reshape(n), ("dp",))
+
+    for mib in [float(s) for s in args.sizes.split(",")]:
+        elems = int(mib * (1 << 20) / 4)
+        x = jnp.ones((n, max(elems // 1, 1)), jnp.float32)
+        x = jax.device_put(x, NamedSharding(mesh, P("dp", None)))
+
+        @jax.jit
+        def allreduce(v):
+            return jax.lax.with_sharding_constraint(
+                v.sum(axis=0, keepdims=True), NamedSharding(mesh, P()))
+
+        allreduce(x).block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(args.runs):
+            out = allreduce(x)
+        out.block_until_ready()
+        dt = (time.perf_counter() - t0) / args.runs
+        gbps = (mib / 1024) * 2 * (n - 1) / n / dt if dt else float("inf")
+        print(f"size {mib:8.1f} MiB  allreduce {dt*1e3:8.2f} ms  "
+              f"algbw {gbps:6.2f} GiB/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Parse training logs into a table (parity: `tools/parse_log.py`).
+
+Understands the LoggingHandler/estimator format
+(`[Epoch N] ... metric: value`) and speedometer-style
+`Epoch[N] Batch [M] Speed: S samples/sec` lines.
+"""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+EPOCH_RE = re.compile(r"\[?Epoch[\s\[](\d+)\]?")
+METRIC_RE = re.compile(r"([\w\- ]+):\s*([-+0-9.eE]+)")
+SPEED_RE = re.compile(r"Speed[:=]\s*([0-9.]+)")
+
+
+def parse(lines):
+    rows = {}
+    for line in lines:
+        m = EPOCH_RE.search(line)
+        if not m:
+            continue
+        epoch = int(m.group(1))
+        row = rows.setdefault(epoch, {})
+        sp = SPEED_RE.search(line)
+        if sp:
+            row.setdefault("speeds", []).append(float(sp.group(1)))
+        for name, value in METRIC_RE.findall(line):
+            name = name.strip().lower()
+            if name in ("epoch", "batch", "samples"):
+                continue
+            try:
+                row[name] = float(value)
+            except ValueError:
+                pass
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("logfile", nargs="?", default="-")
+    ap.add_argument("--format", choices=["table", "csv"], default="table")
+    args = ap.parse_args()
+    lines = sys.stdin if args.logfile == "-" else open(args.logfile)
+    rows = parse(lines)
+    if not rows:
+        print("no epochs found", file=sys.stderr)
+        return
+    cols = sorted({k for r in rows.values() for k in r if k != "speeds"})
+    header = ["epoch"] + cols + ["avg_speed"]
+    sep = "," if args.format == "csv" else "\t"
+    print(sep.join(header))
+    for epoch in sorted(rows):
+        r = rows[epoch]
+        speeds = r.get("speeds", [])
+        avg = sum(speeds) / len(speeds) if speeds else ""
+        print(sep.join([str(epoch)] + [str(r.get(c, "")) for c in cols]
+                       + [str(avg)]))
+
+
+if __name__ == "__main__":
+    main()
