@@ -2,18 +2,28 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 North-star (BASELINE.json): BERT-base pretraining at >=40% MFU on v5p-32;
-vs_baseline = measured_MFU / 0.40. Also reports samples/sec/chip inside the
-JSON's extras.
+vs_baseline = measured_MFU / 0.40. Also reports samples/sec/chip in extras.
+
+Backend robustness (round-2 fix for BENCH_r01 rc=1): the default platform in
+this environment is a remote-TPU tunnel whose initialisation can fail or
+block indefinitely. The orchestrator (no args) therefore runs the measurement
+in a child process with a hard timeout, retries once, and falls back to a CPU
+measurement — ALWAYS emitting one valid JSON line with the failure diagnostic
+in extras.
 """
 from __future__ import annotations
 
 import json
 import math
 import os
+import subprocess
 import sys
 import time
 
 import numpy as _onp
+
+ATTEMPT_TIMEOUTS = (480, 300)   # seconds per TPU attempt
+CPU_TIMEOUT = 600
 
 
 def _peak_flops(device) -> float:
@@ -29,8 +39,10 @@ def _peak_flops(device) -> float:
     return 197e12  # conservative default
 
 
-def main():
+def _measure(platform: str) -> dict:
     import jax
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     import mxnet_tpu as mx
@@ -99,7 +111,7 @@ def main():
     achieved = flops_per_step / step_time
     mfu = achieved / _peak_flops(dev)
 
-    result = {
+    return {
         "metric": "bert_base_pretrain_mfu",
         "value": round(mfu, 4),
         "unit": "MFU_fraction",
@@ -110,9 +122,56 @@ def main():
             "achieved_tflops": round(achieved / 1e12, 2),
             "batch": batch, "seq": seq,
             "device": getattr(dev, "device_kind", str(dev)),
+            "platform": dev.platform,
             "loss": float(loss),
         },
     }
+
+
+def _run_child(platform: str, timeout: float):
+    """Run `bench.py --measure <platform>` in a child; return (dict|None, err)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--measure", platform],
+            capture_output=True, text=True, timeout=timeout,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return None, f"timeout after {timeout}s"
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-8:]
+        return None, (f"rc={proc.returncode}: " + " | ".join(tail))[-500:]
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except json.JSONDecodeError:
+                continue
+    return None, "no JSON line in child output"
+
+
+def main():
+    if len(sys.argv) >= 3 and sys.argv[1] == "--measure":
+        print(json.dumps(_measure(sys.argv[2])))
+        return
+
+    errors = []
+    for timeout in ATTEMPT_TIMEOUTS:
+        result, err = _run_child("default", timeout)
+        if result is not None:
+            print(json.dumps(result))
+            return
+        errors.append(err)
+
+    # TPU unreachable — CPU fallback so the driver still gets a numeric line
+    result, err = _run_child("cpu", CPU_TIMEOUT)
+    if result is None:
+        print(json.dumps({
+            "metric": "bert_base_pretrain_mfu", "value": 0.0,
+            "unit": "MFU_fraction", "vs_baseline": 0.0,
+            "extras": {"error": f"tpu: {errors}; cpu: {err}"}}))
+        return
+    result["extras"]["tpu_unavailable"] = "; ".join(e or "" for e in errors)
     print(json.dumps(result))
 
 

@@ -47,6 +47,8 @@ def reference_attention(q, k, v, mask=None, causal=False, scale=None,
 def _use_pallas() -> bool:
     if getenv_bool("MXTPU_DISABLE_FLASH", False):
         return False
+    if getenv_bool("MXTPU_PALLAS_INTERPRET", False):
+        return True  # kernels run through the Pallas interpreter on CPU
     try:
         return jax.default_backend() not in ("cpu",)
     except Exception:
