@@ -25,8 +25,11 @@ _ACCEL_TYPES = ("tpu", "gpu", "cuda", "rocm", "axon")
 
 
 def _jax_devices_by_platform():
+    # local_devices: in a multi-controller (jax.distributed) job, global
+    # jax.devices() includes other processes' devices, which this process
+    # cannot address (device_put would fail)
     by_platform = {}
-    for d in jax.devices():
+    for d in jax.local_devices():
         by_platform.setdefault(d.platform.lower(), []).append(d)
     return by_platform
 
@@ -64,11 +67,9 @@ class Device:
             if pool:
                 return pool[self.device_id % len(pool)]
             raise MXNetError("no JAX devices available")
-        pool = by_platform.get("cpu")
-        if pool is None:
-            # cpu platform not initialised (e.g. JAX_PLATFORMS=axon only):
-            # use the default device.
-            return jax.devices()[self.device_id % len(jax.devices())]
+        # cpu platform may be uninitialised (e.g. JAX_PLATFORMS=axon only):
+        # fall back to the default local devices
+        pool = by_platform.get("cpu") or jax.local_devices()
         return pool[self.device_id % len(pool)]
 
     # -- equality / hashing -------------------------------------------------
@@ -160,7 +161,10 @@ def current_context() -> Device:
 
 
 def num_devices() -> int:
-    return len(jax.devices())
+    """Count of LOCAL (addressable) devices — consistent with
+    `Device.jax_device` resolution; use `jax.device_count()` for the
+    global count in multi-process jobs."""
+    return len(jax.local_devices())
 
 
 def _num_accel() -> int:

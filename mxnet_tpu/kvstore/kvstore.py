@@ -6,12 +6,18 @@ Semantics preserved from the reference:
   device values into the store (running the optimizer updater server-side if
   one is set, like `update_on_kvstore`); `pull` copies the stored value out;
   `pushpull` fuses both.
-- `local`/`device` types are single-process. On multi-host deployments the
-  same API is driven by `jax.distributed` + GSPMD collectives — the
-  per-key ZMQ push/pull of the reference's PS (`ps::KVWorker`) has no TPU
-  analog and sync data-parallel is expressed as sharded computation instead
-  (SURVEY.md §2.4); `dist_sync`/`dist_device_sync` here alias to the local
-  aggregation + collective path so Trainer code runs unchanged.
+- `local`/`device` types are single-process. `dist_sync`/`dist_device_sync`
+  additionally reduce each push across ALL `jax.distributed` processes
+  (parity with the reference's worker→server aggregation,
+  `src/kvstore/kvstore_dist.h:445,501,587` + server updater
+  `kvstore_dist_server.h:161`): the local device aggregate is summed across
+  processes with a host collective, and when an optimizer is set every rank
+  runs the identical updater on the identical global gradient — equivalent
+  to the server-side update, with no server. The per-key ZMQ push/pull of
+  ps-lite has no TPU analog; bulk training should prefer the GSPMD
+  `ShardedTrainStep` path where XLA lays collectives on ICI/DCN
+  (SURVEY.md §2.4), but this keeps `Trainer(kvstore='dist_sync')` code
+  running unchanged and *correct* across processes.
 """
 from __future__ import annotations
 
@@ -75,7 +81,10 @@ class KVStore(KVStoreBase):
         keys = key if isinstance(key, (list, tuple)) else [key]
         values = value if isinstance(value, (list, tuple)) else [value]
         for k, v in zip(keys, values):
-            self._store[self._key(k)] = v.copy()
+            stored = v.copy()
+            if self._is_dist:
+                stored._data = self._cross_process_bcast(stored._data)
+            self._store[self._key(k)] = stored
 
     def broadcast(self, key, value, out, priority=0):
         if isinstance(key, (list, tuple)):
@@ -84,11 +93,34 @@ class KVStore(KVStoreBase):
             # single key: `out` may be a list of device copies for that key
             keys, values, outs = [key], [value], [out]
         for k, v in zip(keys, values):
-            self._store[self._key(k)] = v.copy()
+            stored = v.copy()
+            if self._is_dist:
+                stored._data = self._cross_process_bcast(stored._data)
+            self._store[self._key(k)] = stored
         for k, o in zip(keys, outs):
             olist = o if isinstance(o, (list, tuple)) else [o]
             for oi in olist:
                 oi._data = jnp.asarray(self._store[self._key(k)]._data)
+
+    @property
+    def _is_dist(self) -> bool:
+        return self._type.startswith("dist") and self.num_workers > 1
+
+    def _cross_process_sum(self, x: jax.Array) -> jax.Array:
+        """Sum `x` across all processes (the dist_* reduce).
+
+        Host-level collective (gloo on CPU, ICI/DCN on TPU pods) via
+        `process_allgather`; every rank gets the identical global sum, like
+        every worker pulling the server's aggregate in the reference.
+        """
+        from jax.experimental import multihost_utils
+        return jnp.sum(multihost_utils.process_allgather(x), axis=0)
+
+    def _cross_process_bcast(self, x: jax.Array) -> jax.Array:
+        """Every rank adopts rank 0's value (reference: init pushed by
+        worker 0, `python/mxnet/kvstore/kvstore.py` init semantics)."""
+        from jax.experimental import multihost_utils
+        return multihost_utils.process_allgather(x)[0]
 
     def _aggregate(self, vlist) -> jax.Array:
         if isinstance(vlist, ndarray):
@@ -114,6 +146,8 @@ class KVStore(KVStoreBase):
                       for i, v in enumerate(vl)]
                 vlist = vl[0] if single else vl
             agg = self._aggregate(vlist)
+            if self._is_dist:
+                agg = self._cross_process_sum(agg)
             if kk not in self._store:
                 from ..ndarray.ndarray import from_jax
                 self._store[kk] = from_jax(jnp.zeros_like(agg))
@@ -163,7 +197,11 @@ class KVStore(KVStoreBase):
 
     # -- distributed scaffolding --------------------------------------------
     def barrier(self):
-        self._barrier_count += 1  # single-controller: no-op
+        self._barrier_count += 1
+        if self._is_dist:  # reference: `KVStore::Barrier` over ps-lite
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(
+                f"mxtpu_kvstore_barrier_{self._barrier_count}")
 
     def set_gradient_compression(self, compression_params):
         """Enable 1/2-bit gradient compression with error feedback on

@@ -38,10 +38,14 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None):
         "MXTPU_COORDINATOR") or _dmlc_coordinator()
     if coordinator_address is None:
         return  # single process
-    num_processes = num_processes or int(os.environ.get(
-        "MXTPU_NUM_WORKERS", os.environ.get("DMLC_NUM_WORKER", "1")))
-    process_id = process_id if process_id is not None else int(os.environ.get(
-        "MXTPU_WORKER_ID", os.environ.get("DMLC_WORKER_ID", "0")))
+    num_processes = num_processes or int(
+        os.environ.get("MXTPU_NUM_PROCESSES")
+        or os.environ.get("MXTPU_NUM_WORKERS")
+        or os.environ.get("DMLC_NUM_WORKER", "1"))
+    process_id = process_id if process_id is not None else int(
+        os.environ.get("MXTPU_PROCESS_ID")
+        or os.environ.get("MXTPU_WORKER_ID")
+        or os.environ.get("DMLC_WORKER_ID", "0"))
     jax.distributed.initialize(coordinator_address, num_processes, process_id)
 
 

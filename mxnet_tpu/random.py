@@ -24,12 +24,19 @@ __all__ = ["seed", "next_key", "key_scope", "Generator", "generator"]
 class Generator:
     def __init__(self, seed_: int = 0):
         self._lock = threading.Lock()
-        self._key = jax.random.PRNGKey(seed_)
+        # lazy: creating a PRNGKey initialises the JAX backend, which must
+        # not happen at import time (breaks jax.distributed.initialize)
+        self._seed = seed_
+        self._key = None
         self._scope = threading.local()
 
     def seed(self, seed_: int):
         with self._lock:
-            self._key = jax.random.PRNGKey(seed_)
+            # stays lazy: materialising a key here would initialise the JAX
+            # backend, breaking `mx.random.seed()` before
+            # `parallel.initialize()` in multi-host scripts
+            self._seed = seed_
+            self._key = None
 
     # -- traced-key scope ---------------------------------------------------
     def _scope_stack(self):
@@ -62,6 +69,8 @@ class Generator:
             scope.counter += 1
             return k
         with self._lock:
+            if self._key is None:
+                self._key = jax.random.PRNGKey(self._seed)
             self._key, sub = jax.random.split(self._key)
             return sub
 

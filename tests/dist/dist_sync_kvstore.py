@@ -1,0 +1,73 @@
+"""Multi-process dist_sync KVStore worker (parity:
+`tests/nightly/dist_sync_kvstore.py` run via `tools/launch.py --launcher
+local -n 2`, the reference's localhost multi-worker trick,
+`tests/nightly/test_distributed_training-gpu.sh:25-38`).
+
+Each rank pushes rank-dependent gradients; asserts every rank sees the
+cross-process SUM (and identical optimizer updates). Run with:
+
+    python tools/launch.py -n 2 --launcher local python tests/dist/dist_sync_kvstore.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import parallel
+
+
+def main():
+    parallel.initialize()
+    rank = parallel.rank()
+    n = parallel.num_workers()
+    assert n >= 2, f"expected >=2 processes, got {n} (launcher env missing?)"
+
+    kv = mx.kv.create("dist_sync")
+    assert kv.num_workers == n and kv.rank == rank
+
+    # init is broadcast from rank 0: ranks propose different values
+    kv.init("w", mx.np.full((4, 3), float(rank + 10)))
+    out = mx.np.zeros((4, 3))
+    kv.pull("w", out=out)
+    onp.testing.assert_allclose(onp.asarray(out.asnumpy()), 10.0)
+
+    # push sums across processes: rank r pushes (r+1) -> sum = n(n+1)/2
+    kv.push("w", mx.np.full((4, 3), float(rank + 1)))
+    kv.pull("w", out=out)
+    expect = n * (n + 1) / 2
+    onp.testing.assert_allclose(onp.asarray(out.asnumpy()), expect)
+
+    # pushpull with per-device lists (2 local "device" copies each)
+    kv.init("g", mx.np.zeros((8,)))
+    dev_vals = [mx.np.full((8,), 1.0), mx.np.full((8,), 2.0)]
+    outs = [mx.np.zeros((8,)), mx.np.zeros((8,))]
+    kv.pushpull("g", dev_vals, out=outs)
+    # local agg = 3, global = 3 * n
+    for o in outs:
+        onp.testing.assert_allclose(onp.asarray(o.asnumpy()), 3.0 * n)
+
+    # server-side optimizer (update_on_kvstore parity): every rank must end
+    # with identical weights after updating with the global gradient
+    kv2 = mx.kv.create("dist_sync")
+    kv2.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
+    kv2.init("p", mx.np.ones((5,)))
+    grad = mx.np.full((5,), float(rank + 1))
+    kv2.push("p", grad)
+    w = mx.np.zeros((5,))
+    kv2.pull("p", out=w)
+    # w = 1 - 0.5 * sum(rank+1) (no rescale_grad normalisation here)
+    expect_w = 1.0 - 0.5 * expect
+    onp.testing.assert_allclose(onp.asarray(w.asnumpy()), expect_w, rtol=1e-6)
+
+    kv.barrier()
+    print(f"[rank {rank}] dist_sync_kvstore OK (n={n})", flush=True)
+
+
+if __name__ == "__main__":
+    main()

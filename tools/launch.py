@@ -35,9 +35,22 @@ def launch_local(n, command, port=29500):
         env["DMLC_NUM_WORKER"] = str(n)
         env["DMLC_WORKER_ID"] = str(rank)
         procs.append(subprocess.Popen(command, shell=True, env=env))
+    # a failed worker must not leave siblings wedged in a collective: kill
+    # the remaining workers as soon as any worker exits nonzero
+    import time
     rc = 0
-    for p in procs:
-        rc |= p.wait()
+    pending = set(procs)
+    while pending:
+        for p in list(pending):
+            code = p.poll()
+            if code is None:
+                continue
+            pending.discard(p)
+            rc |= code
+            if code != 0:
+                for q in pending:
+                    q.terminate()
+        time.sleep(0.1)
     return rc
 
 
