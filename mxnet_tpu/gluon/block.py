@@ -326,10 +326,18 @@ class HybridBlock(Block):
     def hybridize(self, active=True, static_alloc=True, static_shape=True,
                   backend=None, backend_opts=None, inline_limit=2,
                   forward_bulk_size=None, backward_bulk_size=None, **kwargs):
-        """Parity: `gluon/block.py:1389`; flags map to XLA (always-static)."""
+        """Parity: `gluon/block.py:1389`; flags map to XLA (always-static).
+
+        `backend` selects a registered subgraph backend
+        (`mx.subgraph.register_subgraph_backend`) whose matchers rewrite the
+        traced jaxpr — parity with the reference's partitioning API
+        (`subgraph_property.h:603`, `block.py:1282`)."""
         self.__dict__["_active"] = active
         self.__dict__["_flags"] = {"static_alloc": static_alloc,
                                    "static_shape": static_shape}
+        if backend is not None or "_subgraph_backend" not in self.__dict__:
+            from ..subgraph import get_subgraph_backend
+            self.__dict__["_subgraph_backend"] = get_subgraph_backend(backend)
         self._invalidate_cache()
         for c in self._children.values():
             if isinstance(c, HybridBlock):
@@ -342,8 +350,9 @@ class HybridBlock(Block):
         return self
 
     def optimize_for(self, x, *args, backend=None, clear=True, **kwargs):
-        """Parity: `gluon/block.py:1282` — compile eagerly for given input."""
-        self.hybridize(True, **kwargs)
+        """Parity: `gluon/block.py:1282` — compile eagerly for given input,
+        optionally partitioning through a registered subgraph `backend`."""
+        self.hybridize(True, backend=backend, **kwargs)
         return self(x, *args)
 
     def _invalidate_cache(self):
@@ -390,6 +399,9 @@ class HybridBlock(Block):
             fn._out_def = out_def
             return tuple(out_vals), aux
 
+        backend = self.__dict__.get("_subgraph_backend")
+        if backend is not None:
+            return jax.jit(backend.apply(fn)), fn
         return jax.jit(fn), fn
 
     def _call_cached_op(self, *args, **kwargs):

@@ -1,0 +1,182 @@
+"""Subgraph/partitioning backend API (parity:
+`src/operator/subgraph/subgraph_property.h:88,265,603,609` and the Python
+surface `HybridBlock.optimize_for(backend=...)`,
+`python/mxnet/gluon/block.py:1282`).
+
+TPU-native redesign: the reference's `SubgraphProperty` pattern-matches the
+NNVM graph and replaces matched subgraphs with super-ops (oneDNN fusion,
+TensorRT). Here the traced **jaxpr** of a hybridized block plays the role of
+the NNVM graph: a backend supplies matchers that claim sets of equations and
+replace them with a fused implementation (e.g. a Pallas kernel). Everything
+still runs under `jax.jit`, so XLA keeps fusing around the replacements.
+
+Usage::
+
+    @register_subgraph_backend("my_backend")
+    class MyBackend(SubgraphBackend):
+        def matchers(self):
+            return [my_matcher]          # jaxpr -> [Match, ...]
+
+    net.optimize_for(x, backend="my_backend")   # or hybridize(backend=...)
+
+Built-in backends: ``flash_attn`` (rewrites vanilla softmax(QK^T)V chains to
+the flash-attention Pallas kernel, `ops/pallas/flash_attention.py`).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+from jax.extend import core as jcore
+
+from ..base import MXNetError
+
+__all__ = ["SubgraphBackend", "Match", "register_subgraph_backend",
+           "get_subgraph_backend", "list_subgraph_backends"]
+
+_BACKENDS: Dict[str, "SubgraphBackend"] = {}
+
+
+@dataclass
+class Match:
+    """One claimed subgraph: `eqn_ids` are indices into `jaxpr.eqns` that the
+    rewrite replaces; when evaluation reaches the LAST claimed equation,
+    `fn(*invars values)` runs and its outputs are bound to `outvars`."""
+    eqn_ids: frozenset
+    invars: Sequence
+    outvars: Sequence
+    fn: Callable
+    name: str = "subgraph"
+
+
+class SubgraphBackend:
+    """Base class: register with `@register_subgraph_backend(name)`."""
+
+    name: Optional[str] = None
+
+    def matchers(self) -> List[Callable]:
+        """Return matcher callables `(jaxpr) -> List[Match]`."""
+        raise NotImplementedError
+
+    # populated at trace time; lets tests assert the rewrite really fired
+    last_num_matches: int = 0
+
+    def apply(self, fn: Callable) -> Callable:
+        """Wrap `fn` so each trace pattern-matches + rewrites its jaxpr."""
+        backend = self
+
+        def wrapped(*args, **kwargs):
+            closed, out_shape = jax.make_jaxpr(
+                fn, return_shape=True)(*args, **kwargs)
+            matches = []
+            claimed = set()
+            for matcher in backend.matchers():
+                for m in matcher(closed.jaxpr):
+                    if m.eqn_ids & claimed:
+                        continue  # first matcher wins overlaps
+                    matches.append(m)
+                    claimed |= set(m.eqn_ids)
+            backend.last_num_matches = len(matches)
+            flat_args = jax.tree_util.tree_leaves((args, kwargs))
+            out_flat = _eval_rewritten(closed, matches, flat_args)
+            out_tree = jax.tree_util.tree_structure(out_shape)
+            return jax.tree_util.tree_unflatten(out_tree, out_flat)
+
+        return wrapped
+
+
+def register_subgraph_backend(name: str):
+    """Decorator registering a SubgraphBackend class or instance (parity:
+    `MXNET_REGISTER_SUBGRAPH_BACKEND`, `subgraph_property.h:603`)."""
+    def deco(cls_or_obj):
+        obj = cls_or_obj() if isinstance(cls_or_obj, type) else cls_or_obj
+        obj.name = name
+        _BACKENDS[name] = obj
+        return cls_or_obj
+    return deco
+
+
+def get_subgraph_backend(name) -> Optional[SubgraphBackend]:
+    if name is None:
+        return None
+    if isinstance(name, SubgraphBackend):
+        return name
+    be = _BACKENDS.get(name)
+    if be is None:
+        raise MXNetError(
+            f"unknown subgraph backend {name!r}; registered: "
+            f"{sorted(_BACKENDS)} (register with "
+            f"@mx.subgraph.register_subgraph_backend)")
+    return be
+
+
+def list_subgraph_backends() -> List[str]:
+    return sorted(_BACKENDS)
+
+
+# ---------------------------------------------------------------------------
+# jaxpr evaluation with rewrites (the standard custom-interpreter pattern)
+# ---------------------------------------------------------------------------
+
+def _eval_rewritten(closed, matches: List[Match], flat_args):
+    jaxpr = closed.jaxpr
+    by_last: Dict[int, Match] = {max(m.eqn_ids): m for m in matches}
+    skip = set()
+    for m in matches:
+        skip |= set(m.eqn_ids)
+
+    env = {}
+
+    def read(v):
+        return v.val if isinstance(v, jcore.Literal) else env[v]
+
+    def write(v, val):
+        env[v] = val
+
+    for v, c in zip(jaxpr.constvars, closed.consts):
+        write(v, c)
+    if len(flat_args) != len(jaxpr.invars):
+        raise MXNetError(
+            f"subgraph rewrite: arg leaves {len(flat_args)} != jaxpr invars "
+            f"{len(jaxpr.invars)}")
+    for v, a in zip(jaxpr.invars, flat_args):
+        write(v, a)
+
+    for i, eqn in enumerate(jaxpr.eqns):
+        m = by_last.get(i)
+        if m is not None:
+            outs = m.fn(*[read(v) for v in m.invars])
+            if not isinstance(outs, (tuple, list)):
+                outs = (outs,)
+            for v, val in zip(m.outvars, outs):
+                write(v, val)
+            continue
+        if i in skip:
+            continue
+        subfuns, bind_params = eqn.primitive.get_bind_params(eqn.params)
+        ans = eqn.primitive.bind(*subfuns, *[read(v) for v in eqn.invars],
+                                 **bind_params)
+        if eqn.primitive.multiple_results:
+            for v, val in zip(eqn.outvars, ans):
+                write(v, val)
+        else:
+            write(eqn.outvars[0], ans)
+    return [read(v) for v in jaxpr.outvars]
+
+
+def build_consumer_map(jaxpr):
+    """var -> list of (eqn_id, eqn) that read it (jaxpr outvars get id -1)."""
+    consumers: Dict = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            if not isinstance(v, jcore.Literal):
+                consumers.setdefault(v, []).append((i, eqn))
+    for v in jaxpr.outvars:
+        if not isinstance(v, jcore.Literal):
+            consumers.setdefault(v, []).append((-1, None))
+    return consumers
+
+
+# built-in backends register themselves on import
+from . import flash_attn  # noqa: E402,F401

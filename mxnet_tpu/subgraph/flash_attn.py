@@ -1,0 +1,231 @@
+"""Built-in `flash_attn` subgraph backend: rewrites vanilla
+softmax(Q·Kᵀ·s)·V equation chains in a traced jaxpr to the Pallas
+flash-attention kernel (`ops/pallas/flash_attention.py`).
+
+Pattern (as produced by `einsum → [scale] → softmax → einsum`, the classic
+hand-written attention a user block would contain):
+
+    S  = dot_general(Q, K)      # batch (0,1)x(0,1), contract last dims
+    S' = S * scale              # optional scalar mul/div
+    M  = reduce_max(S', -1); E = exp(S' - M); Z = reduce_sum(E, -1)
+    P  = E / Z
+    O  = dot_general(P, V)      # contract lhs[3] with rhs[2]
+
+The whole chain — including the (L, L) intermediates — is replaced with one
+`flash_attention(Q, K, V, scale)` call. Masked/causal variants are not
+matched (the `where`-mask breaks the chain) and fall through untouched.
+
+Parity: this is the TPU analog of the reference's oneDNN/TensorRT subgraph
+properties (`src/operator/subgraph/dnnl/`, `subgraph_property.h:265`) —
+pattern-match, replace with fused super-op.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from jax.extend import core as jcore
+
+from . import Match, SubgraphBackend, build_consumer_map, \
+    register_subgraph_backend
+
+_PASS_THROUGH = ("convert_element_type", "stop_gradient")
+
+
+def _scalar_literal(v):
+    if isinstance(v, jcore.Literal):
+        arr = onp.asarray(v.val)
+        if arr.ndim == 0:
+            return float(arr)
+    return None
+
+
+def _sole_consumers(consumers, var):
+    return [c for c in consumers.get(var, [])]
+
+
+def _chase_passthrough(consumers, producers, var, matched):
+    """Follow pass-through unary ops; return the final var."""
+    while True:
+        cons = _sole_consumers(consumers, var)
+        if len(cons) == 1 and cons[0][0] >= 0 and \
+                cons[0][1].primitive.name in _PASS_THROUGH:
+            i, eqn = cons[0]
+            matched.add(i)
+            var = eqn.outvars[0]
+        else:
+            return var
+
+
+def _is_scores_dot(eqn):
+    if eqn.primitive.name != "dot_general":
+        return False
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    q, k = eqn.invars[0].aval, eqn.invars[1].aval
+    return (len(q.shape) == 4 and len(k.shape) == 4
+            and tuple(lb) == (0, 1) and tuple(rb) == (0, 1)
+            and tuple(lc) == (3,) and tuple(rc) == (3,))
+
+
+def _is_context_dot(eqn):
+    if eqn.primitive.name != "dot_general":
+        return False
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    return (tuple(lb) == (0, 1) and tuple(rb) == (0, 1)
+            and tuple(lc) == (3,) and tuple(rc) == (2,))
+
+
+def _match_attention(jaxpr):
+    """Scan for softmax(QK^T)V chains; return Matches."""
+    from ..ops.pallas.flash_attention import flash_attention
+
+    consumers = build_consumer_map(jaxpr)
+    producers = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.outvars:
+            producers[v] = (i, eqn)
+
+    matches = []
+    for i, eqn in enumerate(jaxpr.eqns):
+        if not _is_scores_dot(eqn):
+            continue
+        matched = {i}
+        q_var, k_var = eqn.invars[0], eqn.invars[1]
+        cur = eqn.outvars[0]
+        scale = 1.0
+
+        # optional scalar scaling (mul/div by literal), possibly repeated
+        while True:
+            cons = _sole_consumers(consumers, cur)
+            if len(cons) != 1 or cons[0][0] < 0:
+                break
+            j, e2 = cons[0]
+            if e2.primitive.name in ("mul", "div"):
+                other = [v for v in e2.invars if v is not cur]
+                lit = _scalar_literal(other[0]) if other else None
+                if lit is None:
+                    break
+                scale = scale * lit if e2.primitive.name == "mul" \
+                    else scale / lit
+                matched.add(j)
+                cur = e2.outvars[0]
+            else:
+                break
+
+        # softmax: consumers of cur must be reduce_max + sub
+        cons = consumers.get(cur, [])
+        if len(cons) != 2 or any(j < 0 for j, _ in cons):
+            continue
+        names = {e.primitive.name: (j, e) for j, e in cons}
+        if "reduce_max" not in names or "sub" not in names:
+            continue
+        jmax, emax = names["reduce_max"]
+        if tuple(emax.params["axes"]) != (3,):
+            continue
+        jsub, esub = names["sub"]
+        matched |= {jmax, jsub}
+        # the max flows through (max -inf), broadcast, stop_gradient into sub
+        mv = emax.outvars[0]
+        guard = 0
+        ok = True
+        while mv not in esub.invars:
+            mc = _sole_consumers(consumers, mv)
+            if len(mc) != 1 or mc[0][0] < 0 or guard > 4:
+                ok = False
+                break
+            jm, em = mc[0]
+            if em.primitive.name not in ("max", "broadcast_in_dim",
+                                         "stop_gradient", "reshape",
+                                         "convert_element_type"):
+                ok = False
+                break
+            matched.add(jm)
+            mv = em.outvars[0]
+            guard += 1
+        if not ok:
+            continue
+
+        # exp
+        ec = _sole_consumers(consumers, esub.outvars[0])
+        if len(ec) != 1 or ec[0][1].primitive.name != "exp":
+            continue
+        jexp, eexp = ec[0]
+        matched.add(jexp)
+        evar = eexp.outvars[0]
+
+        # consumers of exp: reduce_sum + div
+        cons = consumers.get(evar, [])
+        if len(cons) != 2:
+            continue
+        names = {e.primitive.name: (j, e) for j, e in cons}
+        if "reduce_sum" not in names or "div" not in names:
+            continue
+        jsum, esum = names["reduce_sum"]
+        jdiv, ediv = names["div"]
+        if tuple(esum.params["axes"]) != (3,):
+            continue
+        matched |= {jsum, jdiv}
+        # sum flows through broadcast into div's rhs
+        sv = esum.outvars[0]
+        guard = 0
+        ok = True
+        while sv not in ediv.invars:
+            sc = _sole_consumers(consumers, sv)
+            if len(sc) != 1 or sc[0][0] < 0 or guard > 4:
+                ok = False
+                break
+            js, es = sc[0]
+            if es.primitive.name not in ("broadcast_in_dim", "reshape",
+                                         "convert_element_type"):
+                ok = False
+                break
+            matched.add(js)
+            sv = es.outvars[0]
+            guard += 1
+        if not ok:
+            continue
+
+        # p (div out) -> optional pass-through -> context dot_general with V
+        pvar = _chase_passthrough(consumers, producers, ediv.outvars[0],
+                                  matched)
+        pc = _sole_consumers(consumers, pvar)
+        if len(pc) != 1 or pc[0][0] < 0 or not _is_context_dot(pc[0][1]):
+            continue
+        jctx, ectx = pc[0]
+        if ectx.invars[0] is not pvar:
+            continue
+        matched.add(jctx)
+        v_var = ectx.invars[1]
+        out_var = ectx.outvars[0]
+
+        # safety: no interior var may escape the matched set
+        interior_ok = True
+        for j in matched:
+            if j == jctx:
+                continue
+            for ov in jaxpr.eqns[j].outvars:
+                for cj, _ in consumers.get(ov, []):
+                    if cj < 0 or cj not in matched:
+                        interior_ok = False
+        if not interior_ok:
+            continue
+
+        out_aval = out_var.aval
+        s = scale
+
+        def fused(q, k, v, _s=s, _dt=out_aval.dtype):
+            return flash_attention(q, k, v, causal=False,
+                                   scale=_s).astype(_dt)
+
+        matches.append(Match(eqn_ids=frozenset(matched),
+                             invars=[q_var, k_var, v_var],
+                             outvars=[out_var], fn=fused,
+                             name="flash_attention"))
+    return matches
+
+
+@register_subgraph_backend("flash_attn")
+class FlashAttentionBackend(SubgraphBackend):
+    """Fuses vanilla attention chains into the Pallas flash kernel."""
+
+    def matchers(self):
+        return [_match_attention]
