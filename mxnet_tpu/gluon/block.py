@@ -353,6 +353,10 @@ class HybridBlock(Block):
         """Parity: `gluon/block.py:1282` — compile eagerly for given input,
         optionally partitioning through a registered subgraph `backend`."""
         self.hybridize(True, backend=backend, **kwargs)
+        if not self._warmed_up:
+            # first call after (re)hybridize runs eagerly to finish deferred
+            # init; a second call is needed to actually trace + partition
+            self(x, *args)
         return self(x, *args)
 
     def _invalidate_cache(self):

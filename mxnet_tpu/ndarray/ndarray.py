@@ -550,6 +550,14 @@ def wrap_like(data, ref: ndarray) -> ndarray:
 # central op dispatch with autograd recording
 # ----------------------------------------------------------------------
 
+# Set by `mxnet_tpu.profiler` when aggregate stats are enabled: called as
+# hook(op_name, elapsed_seconds) after each imperative op. The reference
+# equivalently wraps every engine op when profiling is on
+# (`src/engine/threaded_engine.cc:288`); timing forces a sync, just as the
+# reference's profiled ops carry start/end engine timestamps.
+_op_profile_hook: Optional[Callable[[str, float], None]] = None
+
+
 def apply_op(fn: Callable, array_args: Sequence[ndarray], kwargs: dict,
              name: str = "op", n_out: int = 1):
     """Execute `fn(*jax_values, **kwargs)`; record VJP if autograd is on.
@@ -558,6 +566,22 @@ def apply_op(fn: Callable, array_args: Sequence[ndarray], kwargs: dict,
     (`src/imperative/imperative.cc:105,235`). `fn` must be a pure function of
     its array arguments; `kwargs` are static.
     """
+    if _op_profile_hook is not None:
+        import time as _time
+        t0 = _time.perf_counter()
+        r = _apply_op(fn, array_args, kwargs, name, n_out)
+        try:
+            jax.block_until_ready(
+                [o._data for o in (r if isinstance(r, tuple) else (r,))])
+        except Exception:
+            pass
+        _op_profile_hook(name, _time.perf_counter() - t0)
+        return r
+    return _apply_op(fn, array_args, kwargs, name, n_out)
+
+
+def _apply_op(fn: Callable, array_args: Sequence[ndarray], kwargs: dict,
+              name: str = "op", n_out: int = 1):
     vals = [a._data for a in array_args]
     device = array_args[0]._device if array_args else current_device()
 

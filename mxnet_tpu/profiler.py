@@ -1,14 +1,22 @@
 """Profiler facade (parity: `python/mxnet/profiler.py:34,125,154` over
 `src/profiler/profiler.h:263`).
 
-The reference collects engine-op stats into chrome://tracing JSON; here the
-same `set_config/start/stop/dump` API drives `jax.profiler`, whose XPlane
-traces open in TensorBoard/Perfetto (chrome-trace parity for free). User
-scopes (`ProfileTask`/`scope`) map to `jax.profiler.TraceAnnotation`.
+The reference collects engine-op stats into chrome://tracing JSON plus an
+aggregate per-op table (`src/profiler/aggregate_stats.cc`). Here the same
+`set_config/start/stop/dump(s)` API drives `jax.profiler`, whose XPlane
+traces open in TensorBoard/Perfetto (chrome-trace parity for free), while
+aggregate stats are accumulated host-side: when `aggregate_stats=True`,
+every imperative op dispatched through `apply_op` is timed (the reference
+equivalently wraps each engine op when profiling is on,
+`src/engine/threaded_engine.cc:288`), and user scopes
+(`ProfileTask`/`scope`) record into the same table. User scopes map to
+`jax.profiler.TraceAnnotation` for the trace view.
 """
 from __future__ import annotations
 
+import json as _json
 import os
+import threading
 import time
 from typing import Optional
 
@@ -22,32 +30,67 @@ __all__ = [
 _config = {"profile_all": False, "filename": "profile_output",
            "aggregate_stats": False, "running": False}
 
+# name -> [count, total_s, min_s, max_s]; guarded by _agg_lock (imperative
+# ops may run from DataLoader worker threads)
+_agg: dict = {}
+_agg_lock = threading.Lock()
+_counters: dict = {}
+
+
+def _record_stat(name: str, elapsed_s: float) -> None:
+    with _agg_lock:
+        st = _agg.get(name)
+        if st is None:
+            _agg[name] = [1, elapsed_s, elapsed_s, elapsed_s]
+        else:
+            st[0] += 1
+            st[1] += elapsed_s
+            if elapsed_s < st[2]:
+                st[2] = elapsed_s
+            if elapsed_s > st[3]:
+                st[3] = elapsed_s
+
 
 def set_config(**kwargs):
     _config.update(kwargs)
+
+
+def _ndarray_module():
+    import importlib
+    return importlib.import_module("mxnet_tpu.ndarray.ndarray")
 
 
 def start():
     out = _config.get("filename", "profile_output")
     outdir = out if not out.endswith(".json") else out + "_dir"
     os.makedirs(outdir, exist_ok=True)
-    jax.profiler.start_trace(outdir)
+    try:
+        jax.profiler.start_trace(outdir)
+        _config["tracing"] = True
+    except Exception:  # trace already running, or backend quirk
+        _config["tracing"] = False
     _config["running"] = True
     _config["outdir"] = outdir
+    if _config.get("aggregate_stats"):
+        _ndarray_module()._op_profile_hook = _record_stat
 
 
 def stop():
     if _config.get("running"):
-        jax.profiler.stop_trace()
+        _ndarray_module()._op_profile_hook = None
+        if _config.get("tracing"):
+            jax.profiler.stop_trace()
         _config["running"] = False
 
 
 def pause(profile_process="worker"):
-    stop()
+    """Temporarily stop collecting aggregate stats (trace keeps running)."""
+    _ndarray_module()._op_profile_hook = None
 
 
 def resume(profile_process="worker"):
-    start()
+    if _config.get("running") and _config.get("aggregate_stats"):
+        _ndarray_module()._op_profile_hook = _record_stat
 
 
 def dump(finished=True, profile_process="worker"):
@@ -56,7 +99,56 @@ def dump(finished=True, profile_process="worker"):
 
 
 def dumps(reset=False, format="table", sort_by="total", ascending=False):
-    return "(profiler stats are written as XPlane traces; open in TensorBoard)"
+    """Return aggregate stats (parity: `python/mxnet/profiler.py:154` over
+    `src/profiler/aggregate_stats.cc`).
+
+    format: "table" (reference-style text table) or "json".
+    sort_by: one of "total", "avg", "min", "max", "count".
+    """
+    with _agg_lock:
+        rows = [(name, st[0], st[1] * 1e3, st[2] * 1e3, st[3] * 1e3,
+                 st[1] * 1e3 / st[0])
+                for name, st in _agg.items()]
+        counters = dict(_counters)
+        if reset:
+            _agg.clear()
+            _counters.clear()
+
+    key_idx = {"count": 1, "total": 2, "min": 3, "max": 4, "avg": 5}
+    idx = key_idx.get(sort_by, 2)
+    rows.sort(key=lambda r: r[idx], reverse=not ascending)
+
+    if format == "json":
+        return _json.dumps({
+            "Time": {name: {"Count": c, "Total": t, "Min": mn, "Max": mx,
+                            "Avg": avg}
+                     for name, c, t, mn, mx, avg in rows},
+            "Unit": "ms",
+            "Counters": counters,
+        })
+
+    lines = ["", "Profile Statistics:",
+             "\tNote the difference in units for different entries."]
+    lines.append("Device Time (imperative ops + user scopes)")
+    lines.append("=" * 42)
+    hdr = (f"{'Name':<40s} {'Total Count':>12s} {'Time (ms)':>14s} "
+           f"{'Min Time (ms)':>14s} {'Max Time (ms)':>14s} "
+           f"{'Avg Time (ms)':>14s}")
+    lines.append(hdr)
+    lines.append(f"{'----':<40s} {'-----------':>12s} {'---------':>14s} "
+                 f"{'-------------':>14s} {'-------------':>14s} "
+                 f"{'-------------':>14s}")
+    for name, c, t, mn, mx, avg in rows:
+        lines.append(f"{name[:40]:<40s} {c:>12d} {t:>14.4f} {mn:>14.4f} "
+                     f"{mx:>14.4f} {avg:>14.4f}")
+    if counters:
+        lines.append("")
+        lines.append("Counters")
+        lines.append("=" * 8)
+        for name, v in sorted(counters.items()):
+            lines.append(f"{name[:40]:<40s} {v:>12d}")
+    lines.append("")
+    return "\n".join(lines)
 
 
 def state():
@@ -64,18 +156,27 @@ def state():
 
 
 class scope:
-    """Named profiling scope (parity: profiler scopes `profiler.h:772`)."""
+    """Named profiling scope (parity: profiler scopes `profiler.h:772`).
+
+    Records into the trace (TraceAnnotation) and, when the profiler is
+    running, into the aggregate-stats table.
+    """
 
     def __init__(self, name="<unk>:"):
         self._name = name
         self._t = None
+        self._t0 = None
 
     def __enter__(self):
         self._t = jax.profiler.TraceAnnotation(self._name)
         self._t.__enter__()
+        self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
+        if self._t0 is not None:
+            _record_stat(self._name, time.perf_counter() - self._t0)
+            self._t0 = None
         self._t.__exit__(*exc)
         return False
 
@@ -98,16 +199,18 @@ Event = Task
 
 class Counter:
     def __init__(self, name="counter", domain=None, value=0):
-        self.name, self.value = name, value
+        self.name = name
+        self.set_value(value)
 
     def set_value(self, value):
         self.value = value
+        _counters[self.name] = value
 
     def increment(self, delta=1):
-        self.value += delta
+        self.set_value(self.value + delta)
 
     def decrement(self, delta=1):
-        self.value -= delta
+        self.set_value(self.value - delta)
 
 
 class Marker:
@@ -115,4 +218,4 @@ class Marker:
         self.name = name
 
     def mark(self, scope_="process"):
-        pass
+        _record_stat(f"marker:{self.name}", 0.0)

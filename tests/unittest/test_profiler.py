@@ -1,0 +1,59 @@
+"""Profiler tests (parity: reference `tests/python/unittest/test_profiler.py`
+over `src/profiler/aggregate_stats.cc` + `python/mxnet/profiler.py:154`)."""
+import json
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+
+
+def _run_ops():
+    a = mx.np.ones((32, 32))
+    b = mx.np.ones((32, 32))
+    for _ in range(3):
+        c = mx.np.dot(a, b)
+    c.wait_to_read()
+    return c
+
+
+def test_aggregate_stats_table(tmp_path):
+    profiler.set_config(aggregate_stats=True,
+                        filename=str(tmp_path / "trace"))
+    profiler.start()
+    _run_ops()
+    with profiler.scope("user_scope"):
+        _run_ops()
+    profiler.stop()
+
+    table = profiler.dumps(reset=False)
+    assert "Profile Statistics" in table
+    assert "dot" in table
+    assert "user_scope" in table
+    assert "Total Count" in table and "Avg Time (ms)" in table
+
+    stats = json.loads(profiler.dumps(format="json", reset=True))
+    assert stats["Unit"] == "ms"
+    dot = next(v for k, v in stats["Time"].items() if "dot" in k)
+    assert dot["Count"] >= 3
+    assert dot["Total"] >= dot["Max"] >= dot["Min"] > 0
+    # reset=True cleared the table
+    assert json.loads(profiler.dumps(format="json"))["Time"] == {}
+
+
+def test_profiler_off_no_overhead_hook(tmp_path):
+    import importlib
+    nd_mod = importlib.import_module("mxnet_tpu.ndarray.ndarray")
+    assert nd_mod._op_profile_hook is None
+    _run_ops()
+    assert profiler.state() == "STOPPED"
+
+
+def test_counters_and_sort(tmp_path):
+    profiler.set_config(aggregate_stats=True,
+                        filename=str(tmp_path / "trace2"))
+    profiler.start()
+    ctr = profiler.Counter("batches", value=0)
+    ctr.increment(5)
+    _run_ops()
+    profiler.stop()
+    table = profiler.dumps(sort_by="count", reset=True)
+    assert "batches" in table and "5" in table
