@@ -186,7 +186,25 @@ def backward_on_heads(heads, head_grads, retain_graph: bool = False,
             total = total + c
         result[id(arr)] = total
         if accumulate_into_leaves and arr.grad is not None:
-            if arr._grad_req == "add":
+            total_sparse = getattr(total, "stype", "default") == "row_sparse"
+            grad_sparse = getattr(arr.grad, "stype", "default") == "row_sparse"
+            if total_sparse and (arr._grad_req != "add" or grad_sparse):
+                # row-sparse cotangent (Embedding sparse_grad): never
+                # densified — the grad handle becomes/merges a
+                # RowSparseNDArray (parity: kRowSparseStorage grads)
+                arr._grad = arr.grad + total if arr._grad_req == "add" \
+                    else total
+            elif total_sparse or grad_sparse:
+                # storage type flipped between backward passes (mixed
+                # dense/sparse consumers): correctness first — densify
+                from .ndarray.ndarray import ndarray as _nd_cls
+                prev = arr.grad.todense() if grad_sparse else arr.grad._data
+                dense_tot = total.todense() if total_sparse else total
+                val = prev + dense_tot if arr._grad_req == "add" \
+                    else jnp.broadcast_to(dense_tot, arr.shape)
+                arr._grad = _nd_cls(val.astype(arr._data.dtype),
+                                    arr._device, _no_copy=True)
+            elif arr._grad_req == "add":
                 arr.grad._data = arr.grad._data + total
             else:  # write
                 arr.grad._data = jnp.broadcast_to(total, arr.grad.shape).astype(arr.grad.dtype)

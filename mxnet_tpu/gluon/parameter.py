@@ -48,6 +48,7 @@ class Parameter:
         self.init = init
         self.allow_deferred_init = allow_deferred_init
         self.grad_req = grad_req if differentiable else "null"
+        self.grad_stype = grad_stype
         self._differentiable = differentiable
         self.sharding = sharding  # logical PartitionSpec-like annotation
         self._data: Optional[ndarray] = None
@@ -111,7 +112,7 @@ class Parameter:
         with jax.default_device(device.jax_device):
             initializer(self._name, data)
         self._data = data
-        self._data.attach_grad(self.grad_req) if self.grad_req != "null" \
+        self._data.attach_grad(self.grad_req, stype=self.grad_stype) if self.grad_req != "null" \
             else None
         self._deferred_init = None
 
@@ -162,7 +163,7 @@ class Parameter:
             else:
                 self._data = from_jax(val.astype(self.dtype), current_device())
                 if self.grad_req != "null":
-                    self._data.attach_grad(self.grad_req)
+                    self._data.attach_grad(self.grad_req, stype=self.grad_stype)
                 return
         self._data._data = val.astype(self._data._data.dtype)
 

@@ -119,6 +119,13 @@ class Trainer:
         if self._kvstore is None:
             return
         for i, p in enumerate(self._params):
+            if getattr(p.grad, "stype", "default") == "row_sparse":
+                raise MXNetError(
+                    f"parameter {p.name}: row_sparse gradients are only "
+                    "supported with local updates (kvstore=None); the "
+                    "kvstore aggregation path would densify them. Use "
+                    "Trainer(..., kvstore=None) or Embedding("
+                    "sparse_grad=False).")
             if p.grad_req != "null":
                 if self._update_on_kvstore:
                     self._kvstore.push(i, p.grad)
@@ -133,12 +140,21 @@ class Trainer:
         if self._update_on_kvstore and self._kvstore is not None:
             # server-side update: push grads, pull fresh weights
             for i, p in enumerate(self._params):
+                if getattr(p.grad, "stype", "default") == "row_sparse":
+                    raise MXNetError(
+                        f"parameter {p.name}: row_sparse gradients are not "
+                        "supported with update_on_kvstore; use local "
+                        "updates (kvstore=None).")
                 self._kvstore.push(i, p.grad)
                 self._kvstore.pull(i, out=p.data())
             return
         self._ensure_states()
+        any_sparse = any(
+            getattr(p.grad, "stype", "default") == "row_sparse"
+            for p in self._params)
         if getattr(self._optimizer, "fused_safe", True) and \
                 not self._optimizer.multi_precision and \
+                not any_sparse and \
                 self._uniform_mults():
             self._fused_update()
         else:

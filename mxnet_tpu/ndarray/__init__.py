@@ -28,3 +28,6 @@ def _populate():
 
 _populate()
 del _populate
+
+
+from . import sparse  # noqa: E402,F401  (mx.nd.sparse namespace)

@@ -57,7 +57,7 @@ class ndarray:
     """
 
     __slots__ = ("_data", "_device", "_ag_node", "_ag_out_index", "_grad",
-                 "_grad_req", "__weakref__")
+                 "_grad_req", "_grad_stype", "__weakref__")
 
     # make ndarray win against numpy scalars in binary ops
     __array_priority__ = 1000.0
@@ -73,6 +73,7 @@ class ndarray:
         self._ag_out_index = 0
         self._grad = None
         self._grad_req = "null"
+        self._grad_stype = "default"
 
     # ------------------------------------------------------------------
     # basic properties
@@ -244,8 +245,16 @@ class ndarray:
         if grad_req not in ("write", "add", "null"):
             raise MXNetError(f"invalid grad_req {grad_req!r}")
         self._grad_req = grad_req
+        self._grad_stype = stype or "default"
         if grad_req == "null":
             self._grad = None
+        elif self._grad_stype == "row_sparse":
+            # starts as an empty row-sparse grad; backward fills it
+            from .sparse import RowSparseNDArray
+            self._grad = RowSparseNDArray(
+                jnp.zeros((0,), jnp.int32),
+                jnp.zeros((0,) + tuple(self.shape[1:]), self._data.dtype),
+                self.shape)
         else:
             self._grad = ndarray(jnp.zeros(self.shape, self._data.dtype),
                                  self._device, _no_copy=True)
@@ -267,7 +276,16 @@ class ndarray:
                           retain_graph=retain_graph, train_mode=train_mode)
 
     def zero_grad(self):
-        if self._grad is not None:
+        if self._grad is None:
+            return
+        if getattr(self._grad, "stype", "default") == "row_sparse" \
+                or self._grad_stype == "row_sparse":
+            from .sparse import RowSparseNDArray
+            self._grad = RowSparseNDArray(
+                jnp.zeros((0,), jnp.int32),
+                jnp.zeros((0,) + tuple(self.shape[1:]), self._data.dtype),
+                self.shape)
+        else:
             self._grad._data = jnp.zeros_like(self._grad._data)
 
     # ------------------------------------------------------------------

@@ -1,0 +1,146 @@
+"""Row-sparse tensors — the slice of the reference's sparse storage that
+matters for training (`include/mxnet/ndarray.h:61` `kRowSparseStorage`;
+`src/operator/tensor/indexing_op.cc` Embedding sparse grad;
+`src/operator/optimizer_op.cc` lazy/sparse updates).
+
+TPU-native scope decision (SURVEY.md §7 hard parts): XLA has no sparse
+storage, so generic `row_sparse`/`csr` compute is a documented non-goal.
+What IS implemented is the one path that matters for large-vocab training:
+
+- `Embedding(sparse_grad=True)` backward produces a `RowSparseNDArray`
+  (index/value pairs, never densified) in eager autograd;
+- SGD / Adam / AdaGrad apply `lazy_update` row-wise updates that touch
+  only the gathered rows (duplicate indices are segment-summed first);
+- everything else raises `MXNetError` naming the supported surface.
+
+Under `jit`/hybridize the dense scatter-add path is used instead — XLA
+fuses it, and sparse storage would force dynamic shapes into the trace.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as _onp
+
+from ..base import MXNetError
+
+__all__ = ["RowSparseNDArray", "row_sparse_array", "csr_matrix"]
+
+
+class RowSparseNDArray:
+    """Index/value pair representing a tensor whose rows outside `indices`
+    are zero. `indices` is int32 [nnz]; `values` is [nnz, *row_shape].
+    Duplicate indices are allowed and mean summation (gradient semantics).
+    """
+
+    stype = "row_sparse"
+
+    def __init__(self, indices, values, shape: Tuple[int, ...]):
+        self.indices = jnp.asarray(indices, jnp.int32)
+        self.values = jnp.asarray(values)
+        self.shape = tuple(shape)
+        if self.values.shape[1:] != self.shape[1:]:
+            raise MXNetError(
+                f"row_sparse values row shape {self.values.shape[1:]} != "
+                f"dense row shape {self.shape[1:]}")
+
+    # MXNet calls the value blob `.data`
+    @property
+    def data(self):
+        return self.values
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def __add__(self, other):
+        if isinstance(other, RowSparseNDArray):
+            if other.shape != self.shape:
+                raise MXNetError("row_sparse shape mismatch in add")
+            return RowSparseNDArray(
+                jnp.concatenate([self.indices, other.indices]),
+                jnp.concatenate([self.values, other.values]), self.shape)
+        if other is None or (isinstance(other, (int, float)) and other == 0):
+            return self
+        # dense + sparse densifies (rare; e.g. mixed grad paths)
+        return self.todense() + other
+
+    __radd__ = __add__
+
+    def aggregated(self):
+        """(unique_indices, summed_values): duplicates segment-summed.
+        Eager-only (dynamic output shape)."""
+        idx = _onp.asarray(jax.device_get(self.indices))
+        uniq, inv = _onp.unique(idx, return_inverse=True)
+        agg = jax.ops.segment_sum(self.values,
+                                  jnp.asarray(inv, jnp.int32),
+                                  num_segments=int(uniq.shape[0]))
+        return jnp.asarray(uniq, jnp.int32), agg
+
+    def todense(self):
+        z = jnp.zeros(self.shape, self.values.dtype)
+        return z.at[self.indices].add(self.values)
+
+    def tostype(self, stype: str):
+        from .ndarray import ndarray
+        from ..device import current_device
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            return ndarray(self.todense(), current_device(), _no_copy=True)
+        raise MXNetError(f"cast row_sparse -> {stype!r} not supported "
+                         f"(supported: 'default', 'row_sparse')")
+
+    def asnumpy(self):
+        return _onp.asarray(jax.device_get(self.todense()))
+
+    def copy(self):
+        return RowSparseNDArray(self.indices, self.values, self.shape)
+
+    def wait_to_read(self):
+        jax.block_until_ready((self.indices, self.values))
+
+    def __repr__(self):
+        return (f"RowSparseNDArray(nnz_rows={int(self.indices.shape[0])}, "
+                f"shape={self.shape}, dtype={self.values.dtype})")
+
+
+def row_sparse_array(arg, shape=None, ctx=None, dtype=None):
+    """Create a RowSparseNDArray from `(values, indices)` (parity:
+    `python/mxnet/ndarray/sparse.py` row_sparse_array)."""
+    if isinstance(arg, RowSparseNDArray):
+        return arg
+    if isinstance(arg, tuple) and len(arg) == 2:
+        values, indices = arg
+        values = jnp.asarray(getattr(values, "_data", values))
+        if dtype is not None:
+            values = values.astype(dtype)
+        indices = jnp.asarray(getattr(indices, "_data", indices), jnp.int32)
+        if shape is None:
+            nrows = int(jnp.max(indices)) + 1 if indices.size else 0
+            shape = (nrows,) + tuple(values.shape[1:])
+        return RowSparseNDArray(indices, values, shape)
+    # dense input: keep only non-zero rows
+    dense = jnp.asarray(getattr(arg, "_data", arg))
+    if dtype is not None:
+        dense = dense.astype(dtype)
+    nz = _onp.nonzero(_onp.asarray(
+        jax.device_get(jnp.any(dense != 0, axis=tuple(
+            range(1, dense.ndim))))))[0]
+    return RowSparseNDArray(jnp.asarray(nz, jnp.int32), dense[nz],
+                            tuple(dense.shape))
+
+
+def csr_matrix(*args, **kwargs):
+    raise MXNetError(
+        "CSR storage is not supported by the TPU backend: XLA has no sparse "
+        "kernels and CSR compute would densify. Supported sparse surface: "
+        "row_sparse gradients from Embedding(sparse_grad=True) with "
+        "sgd/adam/adagrad lazy updates. Use dense arrays (XLA fuses "
+        "masked/segment ops) or preprocess on the host.")

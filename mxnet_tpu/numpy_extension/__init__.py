@@ -493,12 +493,44 @@ def dropout(data, p=0.5, mode="training", axes=(), cudnn_off=False):
 
 def embedding(data, weight, input_dim=None, output_dim=None, dtype=None,
               sparse_grad=False):
-    """Embedding lookup (parity: `src/operator/tensor/indexing_op.cc` Embedding)."""
+    """Embedding lookup (parity: `src/operator/tensor/indexing_op.cc`
+    Embedding). With `sparse_grad=True` in eager autograd the weight
+    gradient is produced as a `RowSparseNDArray` (index/value pairs, never
+    densified) — the reference's row-sparse grad path; under jit/hybridize
+    the dense scatter-add path is used (XLA fuses it; sparse storage would
+    force dynamic shapes into the trace)."""
     def fn(idx, w):
         # mode='clip' matches the reference's index clipping and avoids
         # XLA's NaN-fill for out-of-bounds gathers under jit
         out = jnp.take(w, idx.astype(jnp.int32), axis=0, mode="clip")
         return out.astype(dtype) if dtype else out
+
+    if sparse_grad and _tape.is_recording() \
+            and not isinstance(weight._data, jax.core.Tracer) \
+            and not isinstance(data._data, jax.core.Tracer) \
+            and weight._ag_node is None and weight._grad_req != "null":
+        # leaf weights only: a non-leaf weight (e.g. w*scale) would feed the
+        # RowSparseNDArray cotangent into an upstream dense jax VJP — those
+        # fall through to the dense scatter-add path below
+        from ..ndarray.sparse import RowSparseNDArray
+        idx_v, w_v = data._data, weight._data
+        out_v = fn(idx_v, w_v)
+        n_rows, row_shape = w_v.shape[0], w_v.shape[1:]
+
+        def sparse_vjp(cot):
+            flat_idx = jnp.clip(idx_v.astype(jnp.int32).reshape(-1),
+                                0, n_rows - 1)
+            vals = cot.reshape((-1,) + tuple(row_shape)).astype(w_v.dtype)
+            return (RowSparseNDArray(flat_idx, vals, w_v.shape),)
+
+        node = _tape.record_node(
+            sparse_vjp, [weight], 1, name="embedding_sparse",
+            out_avals=[(tuple(out_v.shape), out_v.dtype)])
+        node.out_is_tuple = False
+        out = ndarray(out_v, weight._device, _no_copy=True)
+        out._ag_node = node
+        out._ag_out_index = 0
+        return out
     return apply_op(fn, (data, weight), {}, name="embedding")
 
 

@@ -9,6 +9,7 @@ from .optimizer import Optimizer, register
 
 @register
 class AdaGrad(Optimizer):
+    sparse_safe = True
     def __init__(self, learning_rate=0.01, epsilon=1e-7, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.epsilon = epsilon

@@ -9,6 +9,7 @@ from .optimizer import Optimizer, register
 
 @register
 class Adam(Optimizer):
+    sparse_safe = True
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, lazy_update=False, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)

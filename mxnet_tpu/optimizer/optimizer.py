@@ -168,6 +168,13 @@ class Optimizer:
                 and state[0].dtype == jnp.float32
                 and weight.dtype != jnp.float32)
 
+    # Optimizers whose `_rule` is purely elementwise over (w, g, state) can
+    # apply row-sparse gradients lazily (only touched rows update —
+    # reference `lazy_update` semantics, `src/operator/optimizer_op.cc`).
+    # Rules with global terms (LAMB/LARS trust ratios, multi-tensor norms)
+    # cannot, and raise.
+    sparse_safe = False
+
     def update(self, index, weight, grad, state):
         """Stateful update; mutates weight (and state) in place."""
         if not isinstance(index, (list, tuple)):
@@ -176,9 +183,44 @@ class Optimizer:
             self._update_count(i)
             hp = self.hparams(i)
             sv = _state_values(s)
-            new_w, new_s = self._rule(w._data, g._data, sv, hp)
+            if getattr(g, "stype", "default") == "row_sparse":
+                new_w, new_s = self._sparse_update(w, g, sv, hp)
+            else:
+                new_w, new_s = self._rule(w._data, g._data, sv, hp)
             w._data = new_w
             _state_writeback(s, new_s)
+
+    def _sparse_update(self, w, g, sv, hp):
+        """Lazy row-wise update: gather touched rows, run the elementwise
+        `_rule` on them, scatter back. Gradient rows with duplicate indices
+        are segment-summed first. Never densifies the gradient."""
+        import jax.tree_util as jtu
+        if not self.sparse_safe:
+            raise MXNetError(
+                f"optimizer {type(self).__name__} does not support "
+                "row_sparse gradients; supported: "
+                "sgd, adam, adagrad (elementwise rules with lazy_update "
+                "semantics). Convert the gradient with "
+                "grad.tostype('default') to use this optimizer.")
+        uniq, agg = g.aggregated()
+        w_shape = tuple(w._data.shape)
+
+        def take_rows(x):
+            return x[uniq] if hasattr(x, "shape") and \
+                tuple(x.shape) == w_shape else x
+
+        row_sv = jtu.tree_map(take_rows, sv)
+        new_rows, new_row_sv = self._rule(
+            w._data[uniq], agg.astype(w._data.dtype), row_sv, hp)
+        new_w = w._data.at[uniq].set(new_rows)
+
+        def put_rows(old, new):
+            if hasattr(old, "shape") and tuple(old.shape) == w_shape:
+                return old.at[uniq].set(new)
+            return new
+
+        new_sv = jtu.tree_map(put_rows, sv, new_row_sv)
+        return new_w, new_sv
 
     def update_multi_precision(self, index, weight, grad, state):
         if not isinstance(index, (list, tuple)):
@@ -189,8 +231,16 @@ class Optimizer:
                 self._update_count(i)
                 hp = self.hparams(i)
                 sv = _state_values(inner)
-                new_w32, new_inner = self._rule(
-                    w32._data, g._data.astype(jnp.float32), sv, hp)
+                if getattr(g, "stype", "default") == "row_sparse":
+                    # lazy rows on the fp32 master copy; the low-precision
+                    # weight is a cast of the (dense) master, so re-casting
+                    # it densifies nothing that wasn't already dense
+                    g32 = type(g)(g.indices,
+                                  g.values.astype(jnp.float32), g.shape)
+                    new_w32, new_inner = self._sparse_update(w32, g32, sv, hp)
+                else:
+                    new_w32, new_inner = self._rule(
+                        w32._data, g._data.astype(jnp.float32), sv, hp)
                 w32._data = new_w32
                 w._data = new_w32.astype(w._data.dtype)
                 _state_writeback(inner, new_inner)

@@ -13,6 +13,8 @@ from .optimizer import Optimizer, register
 class SGD(Optimizer):
     """SGD with momentum and weight decay (grad += wd*w like the reference)."""
 
+    sparse_safe = True
+
     def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=False,
                  **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)

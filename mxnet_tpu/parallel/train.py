@@ -167,6 +167,78 @@ class ShardedTrainStep:
         for n in self.param_names:
             self.params[n]._data._data = self.pvals[n]
 
+    # -- checkpoint/resume ----------------------------------------------
+    # Parity: `gluon/trainer.py:510,537` (save_states/load_states) widened
+    # to the full sharded training state — params + optimizer state + step
+    # counter + host RNG — so a killed job resumes bit-exact (the recovery
+    # story SURVEY.md §5.3 plans as a new capability).
+
+    def save(self, path: str) -> None:
+        """Checkpoint params, optimizer state, step count, and RNG to `path`
+        (.npz). Sharded arrays are gathered to host; `load` re-shards."""
+        import numpy as onp
+        from .. import random as _rng
+        from ..util import npz_encode_entry
+
+        def put(out, key, val):
+            npz_encode_entry(out, key, onp.asarray(jax.device_get(val)))
+
+        out = {}
+        for n in self.param_names:
+            put(out, "p:" + n, self.pvals[n])
+        for n in self.diff_names:
+            for i, leaf in enumerate(
+                    jax.tree_util.tree_leaves(self.opt_state[n])):
+                put(out, f"s:{n}:{i}", leaf)
+        out["meta:t"] = onp.asarray(self._t, onp.int64)
+        g = _rng.generator
+        out["meta:rng_seed"] = onp.asarray(g._seed, onp.int64)
+        if g._key is not None:
+            put(out, "meta:rng_key", g._key)
+        with open(path, "wb") as f:
+            onp.savez(f, **out)
+
+    def load(self, path: str) -> None:
+        """Restore a `save` checkpoint; arrays are re-placed with this
+        step's shardings (the mesh/topology may differ from save time)."""
+        import numpy as onp
+        from .. import random as _rng
+
+        from ..util import npz_decode_entry
+        with onp.load(path, allow_pickle=False) as z:
+            raw = dict(npz_decode_entry(k, z[k]) for k in z.files)
+
+        for n in self.param_names:
+            if "p:" + n not in raw:
+                raise MXNetError(f"checkpoint {path} missing parameter {n}")
+            self.pvals[n] = jax.device_put(jnp.asarray(raw["p:" + n]),
+                                           self.param_shardings[n])
+        for n in self.diff_names:
+            leaves, treedef = jax.tree_util.tree_flatten(self.opt_state[n])
+            new_leaves = []
+            for i, old in enumerate(leaves):
+                key = f"s:{n}:{i}"
+                if key not in raw:
+                    raise MXNetError(
+                        f"checkpoint {path} missing optimizer state {key} "
+                        f"(optimizer type changed since save?)")
+                sharding = _like_sharding(self.param_shardings[n],
+                                          raw[key], self.params[n])
+                new_leaves.append(
+                    jax.device_put(jnp.asarray(raw[key]), sharding))
+            self.opt_state[n] = jax.tree_util.tree_unflatten(
+                treedef, new_leaves)
+        self._t = int(raw["meta:t"])
+        g = _rng.generator
+        g._seed = int(raw.get("meta:rng_seed", g._seed))
+        if "meta:rng_key" in raw:
+            g._key = jnp.asarray(raw["meta:rng_key"])
+        else:
+            # checkpoint predates any RNG draw: clear this process's
+            # (possibly advanced) key so draws restart from PRNGKey(seed)
+            g._key = None
+        self.sync_params_to_block()
+
 
 def _like_sharding(param_sharding: NamedSharding, state_leaf, param):
     """Optimizer state shards like its parameter when shapes match, else

@@ -146,7 +146,7 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
         lines.append("Counters")
         lines.append("=" * 8)
         for name, v in sorted(counters.items()):
-            lines.append(f"{name[:40]:<40s} {v:>12d}")
+            lines.append(f"{name[:40]:<40s} {v:>12g}")
     lines.append("")
     return "\n".join(lines)
 
@@ -175,7 +175,8 @@ class scope:
 
     def __exit__(self, *exc):
         if self._t0 is not None:
-            _record_stat(self._name, time.perf_counter() - self._t0)
+            if _config.get("running"):
+                _record_stat(self._name, time.perf_counter() - self._t0)
             self._t0 = None
         self._t.__exit__(*exc)
         return False
@@ -204,7 +205,8 @@ class Counter:
 
     def set_value(self, value):
         self.value = value
-        _counters[self.name] = value
+        if _config.get("running"):
+            _counters[self.name] = value
 
     def increment(self, delta=1):
         self.set_value(self.value + delta)

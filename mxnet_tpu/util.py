@@ -20,6 +20,23 @@ __all__ = [
 ]
 
 
+def npz_encode_entry(out: dict, key: str, arr) -> None:
+    """Stage one host array for `np.savez`; npz has no bfloat16, so bf16
+    values are stored as a uint16 view under a `__bf16__` name tag."""
+    arr = _onp.asarray(arr)
+    if arr.dtype == jnp.bfloat16:
+        out["__bf16__" + key] = arr.view(_onp.uint16)
+    else:
+        out[key] = arr
+
+
+def npz_decode_entry(key: str, value):
+    """Inverse of `npz_encode_entry`: -> (original key, decoded array)."""
+    if key.startswith("__bf16__"):
+        return key[len("__bf16__"):], value.view(jnp.bfloat16)
+    return key, value
+
+
 def save_arrays(fname: str, data):
     """Save ndarray dict/list/single to `.npz` (or legacy param format)."""
     from .ndarray.ndarray import ndarray
@@ -29,12 +46,7 @@ def save_arrays(fname: str, data):
         data = {f"arr_{i}": a for i, a in enumerate(data)}
     out = {}
     for k, v in data.items():
-        arr = v.asnumpy() if isinstance(v, ndarray) else _onp.asarray(v)
-        if arr.dtype == jnp.bfloat16:
-            # npz has no bfloat16: store as uint16 view with name tag
-            out["__bf16__" + k] = arr.view(_onp.uint16)
-        else:
-            out[k] = arr
+        npz_encode_entry(out, k, v.asnumpy() if isinstance(v, ndarray) else v)
     with open(fname, "wb") as f:
         _onp.savez(f, **out)
 
@@ -44,11 +56,8 @@ def load_arrays(fname: str):
     out = {}
     with _onp.load(fname, allow_pickle=False) as z:
         for k in z.files:
-            v = z[k]
-            if k.startswith("__bf16__"):
-                out[k[len("__bf16__"):]] = array(v.view(jnp.bfloat16))
-            else:
-                out[k] = array(v)
+            name, v = npz_decode_entry(k, z[k])
+            out[name] = array(v)
     return out
 
 

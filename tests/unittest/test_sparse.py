@@ -1,0 +1,200 @@
+"""Row-sparse gradient slice (parity: `include/mxnet/ndarray.h:61`
+kRowSparseStorage, Embedding sparse grad `src/operator/tensor/indexing_op.cc`,
+lazy optimizer updates `src/operator/optimizer_op.cc`; scope per SURVEY.md §7:
+the embedding-training slice is implemented, the rest raises documented
+errors)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.ndarray.sparse import RowSparseNDArray, row_sparse_array, \
+    csr_matrix
+
+VOCAB, DIM = 50, 8
+
+
+def test_row_sparse_array_roundtrip():
+    vals = onp.arange(6, dtype=onp.float32).reshape(2, 3)
+    rs = row_sparse_array((vals, [1, 4]), shape=(6, 3))
+    assert rs.stype == "row_sparse"
+    dense = rs.asnumpy()
+    assert dense.shape == (6, 3)
+    onp.testing.assert_array_equal(dense[1], vals[0])
+    onp.testing.assert_array_equal(dense[4], vals[1])
+    assert onp.all(dense[[0, 2, 3, 5]] == 0)
+
+    # duplicate indices mean summation
+    rs2 = RowSparseNDArray([1, 1], onp.ones((2, 3), onp.float32), (6, 3))
+    onp.testing.assert_array_equal(rs2.asnumpy()[1], 2 * onp.ones(3))
+    uniq, agg = rs2.aggregated()
+    assert uniq.shape == (1,)
+    onp.testing.assert_array_equal(onp.asarray(agg)[0], 2 * onp.ones(3))
+
+    # sparse + sparse stays sparse
+    s = rs2 + rs2
+    assert s.stype == "row_sparse"
+    onp.testing.assert_array_equal(s.asnumpy()[1], 4 * onp.ones(3))
+
+
+def test_csr_documented_error():
+    with pytest.raises(MXNetError, match="CSR"):
+        csr_matrix(([1.0], [0], [0, 1]), shape=(1, 1))
+
+
+def _embed_batch(seed=0):
+    rng = onp.random.RandomState(seed)
+    return mx.np.array(rng.randint(0, VOCAB, (4, 5)), dtype="int32")
+
+
+def test_embedding_sparse_grad_matches_dense():
+    onp.random.seed(3)
+    ids = _embed_batch()
+
+    def run(sparse):
+        emb = nn.Embedding(VOCAB, DIM, sparse_grad=sparse)
+        emb.initialize()
+        emb.weight.set_data(mx.np.array(
+            onp.random.RandomState(5).standard_normal((VOCAB, DIM))
+            .astype("float32")))
+        with autograd.record():
+            out = emb(ids)
+            loss = (out * out).sum()
+        loss.backward()
+        return emb.weight.grad
+
+    g_dense = run(False)
+    g_sparse = run(True)
+    assert getattr(g_dense, "stype", "default") == "default"
+    assert g_sparse.stype == "row_sparse"
+    # nnz rows == number of lookups — the gradient was never densified
+    assert g_sparse.indices.shape[0] == 4 * 5
+    onp.testing.assert_allclose(g_sparse.asnumpy(), g_dense.asnumpy(),
+                                rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("optname", ["sgd", "adam", "adagrad"])
+def test_sparse_update_lazy_semantics(optname):
+    rng = onp.random.RandomState(11)
+    w0 = rng.standard_normal((VOCAB, DIM)).astype(onp.float32)
+    touched = onp.array([2, 7, 7, 30], onp.int32)   # includes a duplicate
+    vals = rng.standard_normal((4, DIM)).astype(onp.float32)
+
+    o = opt.create(optname, learning_rate=0.1, wd=0.01)
+    w = mx.np.array(w0)
+    state = o.create_state(0, w)
+    g = RowSparseNDArray(touched, vals, (VOCAB, DIM))
+    o.update(0, w, g, state)
+    w_new = w.asnumpy()
+
+    untouched = onp.setdiff1d(onp.arange(VOCAB), touched)
+    # lazy update: untouched rows bit-identical (no decay, no state step)
+    onp.testing.assert_array_equal(w_new[untouched], w0[untouched])
+    assert not onp.allclose(w_new[touched], w0[touched])
+
+    # touched rows match the dense rule restricted to those rows
+    o2 = opt.create(optname, learning_rate=0.1, wd=0.01)
+    wd_full = mx.np.array(w0)
+    state2 = o2.create_state(0, wd_full)
+    o2.update(0, wd_full, mx.np.array(g.asnumpy()), state2)
+    onp.testing.assert_allclose(w_new[touched],
+                                wd_full.asnumpy()[touched],
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_unsupported_optimizer_raises():
+    g = RowSparseNDArray([0], onp.ones((1, DIM), onp.float32), (VOCAB, DIM))
+    o = opt.create("lamb", learning_rate=0.1)
+    w = mx.np.array(onp.zeros((VOCAB, DIM), onp.float32))
+    state = o.create_state(0, w)
+    with pytest.raises(MXNetError, match="row_sparse"):
+        o.update(0, w, g, state)
+
+
+def test_trainer_embedding_sparse_end_to_end():
+    """Large-vocab embedding training with sparse grads: loss falls and the
+    gradient is row-sparse at update time (never densified)."""
+    onp.random.seed(4)
+    emb = nn.Embedding(VOCAB, DIM, sparse_grad=True)
+    emb.initialize()
+    target = mx.np.array(
+        onp.random.standard_normal((4, 5, DIM)).astype("float32"))
+    trainer = gluon.Trainer(emb.collect_params(), "adam",
+                            {"learning_rate": 0.05})
+    ids = _embed_batch(seed=9)
+    losses = []
+    for _ in range(12):
+        with autograd.record():
+            out = emb(ids)
+            loss = ((out - target) ** 2).mean()
+        loss.backward()
+        assert emb.weight.grad.stype == "row_sparse"
+        trainer.step(1)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < 0.5 * losses[0], losses
+
+
+def test_zero_grad_on_sparse_grad():
+    emb = nn.Embedding(VOCAB, DIM, sparse_grad=True)
+    emb.initialize()
+    ids = _embed_batch()
+    with autograd.record():
+        loss = (emb(ids) ** 2).sum()
+    loss.backward()
+    assert emb.weight.grad.stype == "row_sparse"
+    emb.weight.zero_grad()
+    g = emb.weight.grad
+    assert g.stype == "row_sparse" and g.indices.shape[0] == 0
+    assert onp.all(g.asnumpy() == 0)
+
+
+def test_mixed_dense_sparse_add_accumulation():
+    """grad_req='add' with storage flipping sparse->dense must not drop the
+    first backward's contribution (densify instead)."""
+    w = mx.np.array(onp.random.RandomState(0)
+                    .standard_normal((VOCAB, DIM)).astype("float32"))
+    w.attach_grad("add", stype="row_sparse")
+    ids = _embed_batch()
+
+    with autograd.record():
+        loss1 = (mx.npx.embedding(ids, w, sparse_grad=True) ** 2).sum()
+    loss1.backward()
+    g1 = w.grad.asnumpy()
+    with autograd.record():
+        loss2 = (w * 2.0).sum()    # dense consumer
+    loss2.backward()
+    g2 = w.grad
+    assert getattr(g2, "stype", "default") == "default"
+    onp.testing.assert_allclose(g2.asnumpy(), g1 + 2.0, rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_grad_nonleaf_weight_falls_back_dense():
+    w = mx.np.array(onp.random.RandomState(1)
+                    .standard_normal((VOCAB, DIM)).astype("float32"))
+    w.attach_grad()
+    ids = _embed_batch()
+    with autograd.record():
+        scaled = w * 0.5                       # non-leaf weight
+        loss = (mx.npx.embedding(ids, scaled, sparse_grad=True) ** 2).sum()
+    loss.backward()   # must not crash; dense path
+    assert getattr(w.grad, "stype", "default") == "default"
+    assert w.grad.asnumpy().shape == (VOCAB, DIM)
+
+
+def test_sparse_multi_precision_update():
+    o = opt.create("adam", learning_rate=0.1, multi_precision=True)
+    w16 = mx.np.array(onp.random.RandomState(2)
+                      .standard_normal((VOCAB, DIM)), dtype="float16")
+    state = o.create_state_multi_precision(0, w16)
+    g = RowSparseNDArray([3, 9], onp.ones((2, DIM), onp.float16),
+                         (VOCAB, DIM))
+    w_before = w16.asnumpy().copy()
+    o.update_multi_precision(0, w16, g, state)
+    w_after = w16.asnumpy()
+    changed = onp.array([3, 9])
+    untouched = onp.setdiff1d(onp.arange(VOCAB), changed)
+    assert not onp.allclose(w_after[changed], w_before[changed])
+    onp.testing.assert_array_equal(w_after[untouched], w_before[untouched])
