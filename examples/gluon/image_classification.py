@@ -27,18 +27,41 @@ def main():
     ap.add_argument("--samples", type=int, default=256)
     ap.add_argument("--classes", type=int, default=10)
     ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--rec", default=None,
+                    help=".rec file from tools/im2rec.py; trains from the "
+                         "threaded ImageRecordIter pipeline instead of "
+                         "synthetic data")
+    ap.add_argument("--data-shape", default="3,32,32")
     args = ap.parse_args()
 
     net = vision.get_model(args.model, classes=args.classes)
     net.initialize(init=mx.init.Xavier())
     net.hybridize()
 
-    rng = onp.random.RandomState(0)
-    x = rng.rand(args.samples, 3, 32, 32).astype("float32")
-    y = rng.randint(0, args.classes, args.samples).astype("float32")
-    ds = gluon.data.ArrayDataset(mx.np.array(x), mx.np.array(y))
-    loader = gluon.data.DataLoader(ds, batch_size=args.batch_size,
-                                   shuffle=True)
+    if args.rec:
+        shape = tuple(int(s) for s in args.data_shape.split(","))
+        rec_iter = mx.io.ImageRecordIter(
+            path_imgrec=args.rec, data_shape=shape,
+            batch_size=args.batch_size, shuffle=True,
+            rand_crop=True, rand_mirror=True,
+            mean_r=123.68, mean_g=116.78, mean_b=103.94,
+            std_r=58.4, std_g=57.12, std_b=57.38,
+            preprocess_threads=os.cpu_count() or 4, prefetch_buffer=4)
+
+        class _RecLoader:
+            def __iter__(self):
+                for b in iter(rec_iter):
+                    yield b.data[0], b.label[0]
+                rec_iter.reset()   # producer restarts for the next epoch
+
+        loader = _RecLoader()
+    else:
+        rng = onp.random.RandomState(0)
+        x = rng.rand(args.samples, 3, 32, 32).astype("float32")
+        y = rng.randint(0, args.classes, args.samples).astype("float32")
+        ds = gluon.data.ArrayDataset(mx.np.array(x), mx.np.array(y))
+        loader = gluon.data.DataLoader(ds, batch_size=args.batch_size,
+                                       shuffle=True)
 
     trainer = gluon.Trainer(net.collect_params(), "nag",
                             {"learning_rate": args.lr, "momentum": 0.9,

@@ -188,7 +188,12 @@ def backward_on_heads(heads, head_grads, retain_graph: bool = False,
         if accumulate_into_leaves and arr.grad is not None:
             total_sparse = getattr(total, "stype", "default") == "row_sparse"
             grad_sparse = getattr(arr.grad, "stype", "default") == "row_sparse"
-            if total_sparse and (arr._grad_req != "add" or grad_sparse):
+            # the grad STAYS sparse only when the user asked for row_sparse
+            # storage (attach_grad stype / Parameter grad_stype); a dense
+            # grad slot receives a densified cotangent
+            keep_sparse = total_sparse and \
+                getattr(arr, "_grad_stype", "default") == "row_sparse"
+            if keep_sparse and (arr._grad_req != "add" or grad_sparse):
                 # row-sparse cotangent (Embedding sparse_grad): never
                 # densified — the grad handle becomes/merges a
                 # RowSparseNDArray (parity: kRowSparseStorage grads)

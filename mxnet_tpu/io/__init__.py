@@ -203,3 +203,8 @@ class PrefetchingIter(DataIter):
         if b is None:
             raise StopIteration
         return b[0] if len(b) == 1 else b
+
+
+from .image_record import ImageRecordIter, MNISTIter  # noqa: E402
+
+__all__ += ["ImageRecordIter", "MNISTIter"]

@@ -526,7 +526,6 @@ def embedding(data, weight, input_dim=None, output_dim=None, dtype=None,
         node = _tape.record_node(
             sparse_vjp, [weight], 1, name="embedding_sparse",
             out_avals=[(tuple(out_v.shape), out_v.dtype)])
-        node.out_is_tuple = False
         out = ndarray(out_v, weight._device, _no_copy=True)
         out._ag_node = node
         out._ag_out_index = 0

@@ -181,7 +181,7 @@ class ShardedTrainStep:
         from ..util import npz_encode_entry
 
         def put(out, key, val):
-            npz_encode_entry(out, key, onp.asarray(jax.device_get(val)))
+            npz_encode_entry(out, key, onp.asarray(_gather_to_host(val)))
 
         out = {}
         for n in self.param_names:
@@ -211,8 +211,8 @@ class ShardedTrainStep:
         for n in self.param_names:
             if "p:" + n not in raw:
                 raise MXNetError(f"checkpoint {path} missing parameter {n}")
-            self.pvals[n] = jax.device_put(jnp.asarray(raw["p:" + n]),
-                                           self.param_shardings[n])
+            self.pvals[n] = _shard_from_host(raw["p:" + n],
+                                             self.param_shardings[n])
         for n in self.diff_names:
             leaves, treedef = jax.tree_util.tree_flatten(self.opt_state[n])
             new_leaves = []
@@ -224,8 +224,7 @@ class ShardedTrainStep:
                         f"(optimizer type changed since save?)")
                 sharding = _like_sharding(self.param_shardings[n],
                                           raw[key], self.params[n])
-                new_leaves.append(
-                    jax.device_put(jnp.asarray(raw[key]), sharding))
+                new_leaves.append(_shard_from_host(raw[key], sharding))
             self.opt_state[n] = jax.tree_util.tree_unflatten(
                 treedef, new_leaves)
         self._t = int(raw["meta:t"])
@@ -238,6 +237,28 @@ class ShardedTrainStep:
             # (possibly advanced) key so draws restart from PRNGKey(seed)
             g._key = None
         self.sync_params_to_block()
+
+
+def _gather_to_host(x):
+    """Fetch a (possibly multi-process-sharded) jax array to host numpy.
+    Single-process arrays are fully addressable; multi-process global arrays
+    need the allgather helper."""
+    if not isinstance(x, jax.Array) or x.is_fully_addressable:
+        return jax.device_get(x)
+    from jax.experimental import multihost_utils
+    return multihost_utils.process_allgather(x, tiled=True)
+
+
+def _shard_from_host(arr, sharding):
+    """Place a host array with `sharding`; works when the mesh spans
+    multiple processes (each process fills only its addressable shards)."""
+    a = jnp.asarray(arr) if jax.process_count() == 1 else arr
+    if jax.process_count() == 1:
+        return jax.device_put(a, sharding)
+    import numpy as onp
+    arr = onp.asarray(arr)
+    return jax.make_array_from_callback(arr.shape, sharding,
+                                        lambda idx: arr[idx])
 
 
 def _like_sharding(param_sharding: NamedSharding, state_leaf, param):

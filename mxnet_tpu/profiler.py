@@ -146,7 +146,8 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
         lines.append("Counters")
         lines.append("=" * 8)
         for name, v in sorted(counters.items()):
-            lines.append(f"{name[:40]:<40s} {v:>12g}")
+            v_str = f"{v:d}" if isinstance(v, int) else f"{v:g}"
+            lines.append(f"{name[:40]:<40s} {v_str:>12s}")
     lines.append("")
     return "\n".join(lines)
 

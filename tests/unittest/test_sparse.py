@@ -198,3 +198,18 @@ def test_sparse_multi_precision_update():
     untouched = onp.setdiff1d(onp.arange(VOCAB), changed)
     assert not onp.allclose(w_after[changed], w_before[changed])
     onp.testing.assert_array_equal(w_after[untouched], w_before[untouched])
+
+
+def test_sparse_cotangent_into_dense_grad_slot_densifies():
+    """attach_grad() without row_sparse stype: the user asked for dense
+    storage, so a sparse embedding cotangent must densify into it."""
+    w = mx.np.array(onp.random.RandomState(3)
+                    .standard_normal((VOCAB, DIM)).astype("float32"))
+    w.attach_grad()          # default (dense) storage
+    ids = _embed_batch()
+    with autograd.record():
+        loss = (mx.npx.embedding(ids, w, sparse_grad=True) ** 2).sum()
+    loss.backward()
+    assert getattr(w.grad, "stype", "default") == "default"
+    out = w.grad * 2         # dense arithmetic must work
+    assert out.shape == (VOCAB, DIM)
