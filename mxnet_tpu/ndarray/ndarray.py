@@ -648,5 +648,8 @@ def _apply_op(fn: Callable, array_args: Sequence[ndarray], kwargs: dict,
 
 def _wrap_outputs(out, device):
     if isinstance(out, (tuple, list)):
-        return tuple(ndarray(o, device, _no_copy=True) for o in out)
-    return ndarray(out, device, _no_copy=True)
+        return tuple(_wrap_outputs(o, device) for o in out)
+    # ops can return non-array metadata (python scalars, dtypes, bools from
+    # meta queries); only array values get the no-copy fast path
+    no_copy = isinstance(out, (jax.Array, jax.core.Tracer))
+    return ndarray(out, device, _no_copy=no_copy)

@@ -437,6 +437,67 @@ for _name in _DELEGATE:
         _g[_name] = _mk(_nf, _name)
 
 
+# meta queries return plain Python values, not wrapped arrays
+def shape(a):
+    return tuple(a.shape) if hasattr(a, "shape") else _onp.shape(a)
+
+
+def ndim(a):
+    return a.ndim if hasattr(a, "ndim") else _onp.ndim(a)
+
+
+def size(a, axis=None):
+    if axis is not None:
+        return (a.shape if hasattr(a, "shape") else _onp.shape(a))[axis]
+    return int(a.size) if hasattr(a, "size") else _onp.size(a)
+
+
+def result_type(*args):
+    return jnp.result_type(*[a._data if isinstance(a, ndarray) else a
+                             for a in args])
+
+
+def promote_types(type1, type2):
+    return jnp.promote_types(type1, type2)
+
+
+def iscomplexobj(x):
+    return bool(jnp.iscomplexobj(x._data if isinstance(x, ndarray) else x))
+
+
+def put_along_axis(arr, indices, values, axis):
+    """In-place scatter (numpy semantics). Routed through apply_op +
+    _rebind like __setitem__ so the autograd tape records the overwrite
+    (SURVEY.md §7 mutability mapping)."""
+    idx = indices._data if isinstance(indices, ndarray) \
+        else jnp.asarray(indices)
+    if isinstance(values, ndarray):
+        out = apply_op(
+            lambda x, v: jnp.put_along_axis(x, idx, v.astype(x.dtype),
+                                            axis=axis, inplace=False),
+            (arr, values), {}, name="put_along_axis")
+    else:
+        vv = jnp.asarray(values)
+        out = apply_op(
+            lambda x: jnp.put_along_axis(x, idx, vv.astype(x.dtype),
+                                         axis=axis, inplace=False),
+            (arr,), {}, name="put_along_axis")
+    arr._rebind(out)
+
+
+def fill_diagonal(a, val, wrap=False):
+    if isinstance(val, ndarray):
+        out = apply_op(
+            lambda x, v: jnp.fill_diagonal(x, v.astype(x.dtype), wrap=wrap,
+                                           inplace=False),
+            (a, val), {}, name="fill_diagonal")
+    else:
+        out = apply_op(
+            lambda x: jnp.fill_diagonal(x, val, wrap=wrap, inplace=False),
+            (a,), {}, name="fill_diagonal")
+    a._rebind(out)
+
+
 def may_share_memory(a, b, max_work=None):
     return False  # functional arrays never alias at the Python level
 

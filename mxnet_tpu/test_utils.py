@@ -13,6 +13,7 @@ from .ndarray.ndarray import ndarray
 __all__ = [
     "assert_almost_equal", "almost_equal", "same", "rand_ndarray", "rand_shape_2d",
     "rand_shape_3d", "rand_shape_nd", "check_numeric_gradient", "default_device",
+    "retry",
     "default_context", "effective_dtype", "environment",
 ]
 
@@ -141,3 +142,29 @@ class environment:
             else:
                 self._os.environ[k] = old
         return False
+
+
+def retry(n=3):
+    """Decorator retrying a flaky (statistical) test up to `n` times with a
+    fresh seed each attempt (parity: `tests/python/unittest/common.py:218`).
+    The failing seed is printed for replay."""
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            last = None
+            for attempt in range(n):
+                seed = _onp.random.randint(0, 2 ** 31)
+                _onp.random.seed(seed)
+                from . import random as _mx_random
+                _mx_random.seed(seed)   # framework RNG too (common.py:67)
+                try:
+                    return fn(*args, **kwargs)
+                except AssertionError as e:
+                    last = e
+                    print(f"retry[{attempt + 1}/{n}] failed with seed "
+                          f"{seed}: {e}")
+            raise last
+        return wrapped
+    return deco

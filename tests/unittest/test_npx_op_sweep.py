@@ -1,0 +1,457 @@
+"""Direct `mx.npx` NN-op numerics sweep (parity model: the reference op unit
+tests in `tests/python/unittest/test_operator.py`, 261 fns over
+`src/operator/nn/`). Each op is checked against a hand-rolled numpy
+reference and, for the differentiable core, against finite differences."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+A = mx.np.array
+
+
+def _r(*shape, lo=-1.0, hi=1.0, seed=0):
+    return onp.random.RandomState(seed).uniform(
+        lo, hi, size=shape).astype(onp.float32)
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+
+ACT_REFS = {
+    "relu": lambda x: onp.maximum(x, 0),
+    "sigmoid": lambda x: 1 / (1 + onp.exp(-x)),
+    "tanh": onp.tanh,
+    "softrelu": lambda x: onp.log1p(onp.exp(x)),
+    "softsign": lambda x: x / (1 + onp.abs(x)),
+    "silu": lambda x: x / (1 + onp.exp(-x)),
+}
+
+
+@pytest.mark.parametrize("name", sorted(ACT_REFS))
+def test_npx_activation_numerics(name):
+    x = _r(3, 4, lo=-2, hi=2, seed=1)
+    got = getattr(mx.npx, name)(A(x))
+    assert_almost_equal(got, ACT_REFS[name](x), rtol=1e-5, atol=1e-5)
+    got2 = mx.npx.activation(A(x), act_type=name) \
+        if name in ("relu", "sigmoid", "tanh", "softrelu", "softsign") else got
+    assert_almost_equal(got2, ACT_REFS[name](x), rtol=1e-5, atol=1e-5)
+
+
+def test_npx_gelu_elu_selu_leaky():
+    x = _r(3, 4, lo=-2, hi=2, seed=2)
+    from scipy.special import erf as _erf  # scipy ships with the image
+    want = 0.5 * x * (1 + _erf(x / onp.sqrt(2)))
+    assert_almost_equal(mx.npx.gelu(A(x)), want, rtol=1e-4, atol=1e-5)
+    assert_almost_equal(mx.npx.elu(A(x)),
+                        onp.where(x > 0, x, onp.expm1(x)), rtol=1e-5,
+                        atol=1e-5)
+    a_selu, l_selu = 1.6732632423543772, 1.0507009873554805
+    assert_almost_equal(
+        mx.npx.selu(A(x)),
+        onp.where(x > 0, l_selu * x, l_selu * a_selu * onp.expm1(x)),
+        rtol=1e-4, atol=1e-5)
+    assert_almost_equal(mx.npx.leaky_relu(A(x), slope=0.1),
+                        onp.where(x >= 0, x, 0.1 * x), rtol=1e-5, atol=1e-6)
+    g = _r(1, seed=3)
+    assert_almost_equal(mx.npx.prelu(A(x), A(g)),
+                        onp.where(x >= 0, x, g * x), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("name", ["relu", "sigmoid", "tanh", "softrelu",
+                                  "gelu", "silu"])
+def test_npx_activation_grad(name):
+    x = mx.np.array(_r(2, 3, lo=-1.2, hi=1.2, seed=4))
+    fn = getattr(mx.npx, name)
+    check_numeric_gradient(lambda t: fn(t).sum(), [x], rtol=2e-2, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# softmax family
+# ---------------------------------------------------------------------------
+
+def _np_softmax(x, axis=-1):
+    e = onp.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+@pytest.mark.parametrize("axis", [-1, 0, 1])
+def test_npx_softmax_axes(axis):
+    x = _r(3, 4, 5, seed=5)
+    assert_almost_equal(mx.npx.softmax(A(x), axis=axis),
+                        _np_softmax(x, axis), rtol=1e-5, atol=1e-6)
+    assert_almost_equal(mx.npx.log_softmax(A(x), axis=axis),
+                        onp.log(_np_softmax(x, axis)), rtol=1e-4, atol=1e-5)
+
+
+def test_npx_softmax_temperature_length():
+    x = _r(2, 5, seed=6)
+    assert_almost_equal(mx.npx.softmax(A(x), temperature=2.0),
+                        _np_softmax(x / 2.0), rtol=1e-5, atol=1e-6)
+    ln = onp.array([3, 5], onp.int32)
+    got = onp.asarray(mx.npx.softmax(A(x), A(ln), use_length=True, axis=-1))
+    assert onp.all(got[0, 3:] == 0)
+    assert abs(got[0, :3].sum() - 1) < 1e-5
+    assert abs(got[1].sum() - 1) < 1e-5
+
+
+def test_npx_masked_softmax_grad():
+    x = mx.np.array(_r(2, 4, seed=7))
+    m = mx.np.array(onp.array([[1, 1, 0, 1], [1, 0, 1, 1]], bool))
+    check_numeric_gradient(
+        lambda t: (mx.npx.masked_softmax(t, m) ** 2).sum(), [x],
+        rtol=2e-2, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# fully connected / convolution / deconvolution
+# ---------------------------------------------------------------------------
+
+def test_npx_fully_connected():
+    x, w, b = _r(4, 5, seed=8), _r(3, 5, seed=9), _r(3, seed=10)
+    got = mx.npx.fully_connected(A(x), A(w), A(b), num_hidden=3)
+    assert_almost_equal(got, x @ w.T + b, rtol=1e-4, atol=1e-5)
+    got = mx.npx.fully_connected(A(x), A(w), None, no_bias=True,
+                                 num_hidden=3)
+    assert_almost_equal(got, x @ w.T, rtol=1e-4, atol=1e-5)
+    xf = _r(2, 3, 5, seed=11)
+    got = mx.npx.fully_connected(A(xf), A(w), A(b), num_hidden=3,
+                                 flatten=False)
+    assert_almost_equal(got, xf @ w.T + b, rtol=1e-4, atol=1e-5)
+
+
+def _np_conv2d(x, w, stride=1, pad=0, dilate=1):
+    n, cin, h, wd = x.shape
+    co, _, kh, kw = w.shape
+    ekh, ekw = (kh - 1) * dilate + 1, (kw - 1) * dilate + 1
+    xp = onp.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    oh = (h + 2 * pad - ekh) // stride + 1
+    ow = (wd + 2 * pad - ekw) // stride + 1
+    out = onp.zeros((n, co, oh, ow), onp.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride:i * stride + ekh:dilate,
+                       j * stride:j * stride + ekw:dilate]
+            out[:, :, i, j] = onp.einsum("nchw,ochw->no", patch, w)
+    return out
+
+
+@pytest.mark.parametrize("stride,pad,dilate", [(1, 0, 1), (2, 1, 1),
+                                               (1, 1, 2)])
+def test_npx_convolution(stride, pad, dilate):
+    x, w = _r(2, 3, 7, 7, seed=12), _r(4, 3, 3, 3, seed=13)
+    got = mx.npx.convolution(A(x), A(w), None, kernel=(3, 3),
+                             num_filter=4, stride=(stride, stride),
+                             pad=(pad, pad), dilate=(dilate, dilate),
+                             no_bias=True)
+    want = _np_conv2d(x, w, stride, pad, dilate)
+    assert_almost_equal(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_npx_convolution_bias_groups_1d():
+    x, w, b = _r(2, 3, 6, 6, seed=14), _r(4, 3, 1, 1, seed=15), _r(4, seed=16)
+    got = mx.npx.convolution(A(x), A(w), A(b), kernel=(1, 1), num_filter=4)
+    want = _np_conv2d(x, w) + b.reshape(1, -1, 1, 1)
+    assert_almost_equal(got, want, rtol=1e-3, atol=1e-4)
+    # grouped: 2 groups of 2 channels
+    xg, wg = _r(1, 4, 5, 5, seed=17), _r(4, 2, 3, 3, seed=18)
+    got = mx.npx.convolution(A(xg), A(wg), None, kernel=(3, 3),
+                             num_filter=4, num_group=2, no_bias=True)
+    w1 = _np_conv2d(xg[:, :2], wg[:2])
+    w2 = _np_conv2d(xg[:, 2:], wg[2:])
+    assert_almost_equal(got, onp.concatenate([w1, w2], axis=1), rtol=1e-3,
+                        atol=1e-4)
+    # 1-d conv
+    x1, w1d = _r(2, 3, 9, seed=19), _r(4, 3, 3, seed=20)
+    got = mx.npx.convolution(A(x1), A(w1d), None, kernel=(3,),
+                             num_filter=4, no_bias=True)
+    want = _np_conv2d(x1[:, :, None, :], w1d[:, :, None, :])[:, :, 0]
+    assert_almost_equal(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_npx_convolution_grad():
+    x = mx.np.array(_r(1, 2, 5, 5, seed=21))
+    w = mx.np.array(_r(2, 2, 3, 3, seed=22))
+    # conv is linear in x and w, so with a linear loss the finite
+    # difference is exact up to float32 rounding
+    cw = mx.np.array(_r(1, 2, 3, 3, seed=60))
+    check_numeric_gradient(
+        lambda xx, ww: (mx.npx.convolution(
+            xx, ww, None, kernel=(3, 3), num_filter=2,
+            no_bias=True) * cw).sum(),
+        [x, w], rtol=1e-2, atol=3e-3)
+
+
+def test_npx_deconvolution_shape_and_inverse():
+    x = _r(1, 3, 4, 4, seed=23)
+    w = _r(3, 2, 3, 3, seed=24)
+    got = mx.npx.deconvolution(A(x), A(w), None, kernel=(3, 3),
+                               num_filter=2, no_bias=True)
+    assert got.shape == (1, 2, 6, 6)
+    got = mx.npx.deconvolution(A(x), A(w), None, kernel=(3, 3),
+                               num_filter=2, stride=(2, 2), pad=(1, 1),
+                               no_bias=True)
+    assert got.shape == (1, 2, 7, 7)
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+
+def _np_pool(x, k, stride, mode, pad=0):
+    n, c, h, w = x.shape
+    xp = onp.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)),
+                 constant_values=-onp.inf if mode == "max" else 0.0)
+    oh = (h + 2 * pad - k) // stride + 1
+    ow = (w + 2 * pad - k) // stride + 1
+    out = onp.zeros((n, c, oh, ow), onp.float32)
+    for i in range(oh):
+        for j in range(ow):
+            win = xp[:, :, i * stride:i * stride + k,
+                     j * stride:j * stride + k]
+            out[:, :, i, j] = win.max((2, 3)) if mode == "max" \
+                else win.mean((2, 3))
+    return out
+
+
+@pytest.mark.parametrize("mode", ["max", "avg"])
+@pytest.mark.parametrize("k,stride", [(2, 2), (3, 1)])
+def test_npx_pooling(mode, k, stride):
+    x = _r(2, 3, 6, 6, seed=25)
+    got = mx.npx.pooling(A(x), kernel=(k, k), stride=(stride, stride),
+                         pool_type=mode)
+    assert_almost_equal(got, _np_pool(x, k, stride, mode), rtol=1e-4,
+                        atol=1e-5)
+
+
+def test_npx_pooling_global_and_pad():
+    x = _r(2, 3, 5, 5, seed=26)
+    got = mx.npx.pooling(A(x), kernel=(2, 2), global_pool=True,
+                         pool_type="avg")
+    assert_almost_equal(onp.asarray(got).squeeze(), x.mean((2, 3)),
+                        rtol=1e-4, atol=1e-5)
+    got = mx.npx.pooling(A(x), kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                         pool_type="max")
+    assert_almost_equal(got, _np_pool(x, 3, 2, "max", pad=1), rtol=1e-4,
+                        atol=1e-5)
+
+
+def test_npx_pooling_grad():
+    x = mx.np.array(_r(1, 2, 4, 4, seed=27))
+    check_numeric_gradient(
+        lambda t: (mx.npx.pooling(t, kernel=(2, 2), stride=(2, 2),
+                                  pool_type="avg") ** 2).sum(), [x],
+        rtol=2e-2, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+def test_npx_layer_norm():
+    x = _r(3, 5, seed=28)
+    g, b = _r(5, lo=0.5, hi=1.5, seed=29), _r(5, seed=30)
+    got = mx.npx.layer_norm(A(x), A(g), A(b), axis=-1, eps=1e-5)
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    want = (x - mu) / onp.sqrt(var + 1e-5) * g + b
+    assert_almost_equal(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_npx_batch_norm_inference_and_training():
+    x = _r(4, 3, 2, 2, seed=31)
+    g, b = _r(3, lo=0.5, hi=1.5, seed=32), _r(3, seed=33)
+    rm, rv = _r(3, seed=34), _r(3, lo=0.5, hi=1.5, seed=35)
+    got = mx.npx.batch_norm(A(x), A(g), A(b), A(rm), A(rv), eps=1e-5)
+    want = (x - rm.reshape(1, -1, 1, 1)) / onp.sqrt(
+        rv.reshape(1, -1, 1, 1) + 1e-5) * g.reshape(1, -1, 1, 1) + \
+        b.reshape(1, -1, 1, 1)
+    assert_almost_equal(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_npx_group_instance_l2norm():
+    x = _r(2, 4, 3, 3, seed=36)
+    g, b = onp.ones(4, onp.float32), onp.zeros(4, onp.float32)
+    got = onp.asarray(mx.npx.group_norm(A(x), A(g), A(b), num_groups=2))
+    xr = x.reshape(2, 2, 2, 3, 3)
+    mu = xr.mean((2, 3, 4), keepdims=True)
+    var = xr.var((2, 3, 4), keepdims=True)
+    want = ((xr - mu) / onp.sqrt(var + 1e-5)).reshape(x.shape)
+    assert_almost_equal(got, want, rtol=1e-4, atol=1e-4)
+
+    got = onp.asarray(mx.npx.instance_norm(A(x), A(g), A(b)))
+    mu = x.mean((2, 3), keepdims=True)
+    var = x.var((2, 3), keepdims=True)
+    assert_almost_equal(got, (x - mu) / onp.sqrt(var + 1e-5), rtol=1e-4,
+                        atol=1e-4)
+
+    v = _r(3, 6, seed=37)
+    got = onp.asarray(mx.npx.l2_normalization(A(v), mode="instance"))
+    assert_almost_equal(got, v / onp.sqrt((v ** 2).sum(
+        1, keepdims=True) + 1e-10), rtol=1e-4, atol=1e-5)
+
+
+def test_npx_norm_grads():
+    x = mx.np.array(_r(2, 4, seed=38))
+    g = mx.np.array(_r(4, lo=0.5, hi=1.5, seed=39))
+    b = mx.np.array(_r(4, seed=40))
+    check_numeric_gradient(
+        lambda xx, gg, bb: (mx.npx.layer_norm(xx, gg, bb,
+                                              axis=-1) ** 2).sum(),
+        [x, g, b], rtol=3e-2, atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# dropout / embedding / one_hot / pick / topk
+# ---------------------------------------------------------------------------
+
+def test_npx_dropout_semantics():
+    x = A(onp.ones((200, 50), onp.float32))
+    out_eval = mx.npx.dropout(x, p=0.5)         # predict mode: identity
+    assert_almost_equal(out_eval, onp.ones((200, 50)))
+    with autograd.record(train_mode=True):
+        out = onp.asarray(mx.npx.dropout(x, p=0.4))
+    kept = out > 0
+    assert abs(kept.mean() - 0.6) < 0.05
+    assert_almost_equal(out[kept], onp.full(kept.sum(), 1 / 0.6), rtol=1e-5,
+                        atol=1e-5)
+
+
+def test_npx_embedding_onehot():
+    w = _r(7, 4, seed=41)
+    idx = onp.array([[0, 3], [6, 2]], onp.int32)
+    got = mx.npx.embedding(A(idx), A(w), input_dim=7, output_dim=4)
+    assert_almost_equal(got, w[idx], rtol=1e-6, atol=1e-7)
+    got = mx.npx.one_hot(A(onp.array([1, 3], onp.int32)), 5, on_value=2.0,
+                         off_value=-1.0)
+    want = onp.full((2, 5), -1.0, onp.float32)
+    want[0, 1] = want[1, 3] = 2.0
+    assert_almost_equal(got, want)
+
+
+def test_npx_pick_topk():
+    x = _r(3, 5, seed=42)
+    idx = onp.array([0, 4, 2], onp.int32)
+    got = mx.npx.pick(A(x), A(idx), axis=1)
+    assert_almost_equal(got, x[onp.arange(3), idx], rtol=1e-6, atol=1e-7)
+    got = mx.npx.topk(A(x), k=2, axis=1, ret_typ="value")
+    want = onp.sort(x, axis=1)[:, ::-1][:, :2]
+    assert_almost_equal(got, want, rtol=1e-6, atol=1e-7)
+    got_i = onp.asarray(mx.npx.topk(A(x), k=2, axis=1, ret_typ="indices"))
+    assert_almost_equal(onp.take_along_axis(x, got_i.astype(int), axis=1),
+                        want, rtol=1e-6, atol=1e-7)
+
+
+def test_npx_sequence_mask_arange_like():
+    x = _r(3, 4, seed=43)  # (seq, batch) layout? npx.sequence_mask: (max_len, batch)
+    ln = onp.array([2, 4, 1, 3], onp.float32)
+    got = onp.asarray(mx.npx.sequence_mask(A(x), A(ln),
+                                           use_sequence_length=True,
+                                           value=-1.0))
+    for b in range(4):
+        L = int(ln[b])
+        assert onp.allclose(got[:L, b], x[:L, b])
+        assert onp.all(got[L:, b] == -1.0)
+    got = mx.npx.arange_like(A(x), axis=0)
+    assert_almost_equal(got, onp.arange(3, dtype=onp.float32))
+    assert_almost_equal(mx.npx.shape_array(A(x)), onp.array([3, 4]))
+    y = _r(12, seed=44)
+    assert_almost_equal(mx.npx.reshape_like(A(y), A(x)), y.reshape(3, 4))
+    z = _r(1, 4, seed=45)
+    assert_almost_equal(mx.npx.broadcast_like(A(z), A(x)),
+                        onp.broadcast_to(z, (3, 4)))
+
+
+def test_npx_gather_scatter_nd_smooth_l1_cast():
+    x = _r(3, 4, seed=46)
+    ind = onp.array([[0, 2], [1, 3]], onp.int64)  # 2 points (r, c)
+    got = mx.npx.gather_nd(A(x), A(ind))
+    assert_almost_equal(got, x[ind[0], ind[1]], rtol=1e-6, atol=1e-7)
+    vals = onp.array([5.0, 7.0], onp.float32)
+    got = mx.npx.scatter_nd(A(vals), A(ind), (3, 4))
+    want = onp.zeros((3, 4), onp.float32)
+    want[ind[0], ind[1]] = vals
+    assert_almost_equal(got, want)
+    t = onp.array([-2.0, -0.5, 0.0, 0.5, 2.0], onp.float32)
+    want = onp.where(onp.abs(t) < 1, 0.5 * t * t, onp.abs(t) - 0.5)
+    assert_almost_equal(mx.npx.smooth_l1(A(t)), want, rtol=1e-5, atol=1e-6)
+    got = mx.npx.cast(A(t), dtype="float16")
+    assert str(got.dtype) == "float16"
+    got = mx.npx.amp_cast(A(t), dtype="bfloat16")
+    assert "bfloat16" in str(got.dtype)
+
+
+# ---------------------------------------------------------------------------
+# ctc / rnn
+# ---------------------------------------------------------------------------
+
+def _np_ctc_loss_brute(logits, labels):
+    """Brute-force CTC over all alignments; logits (T, C), labels (L,),
+    blank=0."""
+    import itertools
+    T, C = logits.shape
+    p = _np_softmax(logits, axis=-1)
+
+    def collapse(path):
+        out = []
+        prev = None
+        for s in path:
+            if s != prev and s != 0:
+                out.append(s)
+            prev = s
+        return tuple(out)
+
+    total = 0.0
+    target = tuple(int(l) for l in labels if l != 0)
+    for path in itertools.product(range(C), repeat=T):
+        if collapse(path) == target:
+            prob = 1.0
+            for t, s in enumerate(path):
+                prob *= p[t, s]
+            total += prob
+    return -onp.log(total)
+
+
+def test_npx_ctc_loss_vs_brute_force():
+    rng = onp.random.RandomState(47)
+    T, B, C = 4, 1, 3
+    logits = rng.uniform(-1, 1, (T, B, C)).astype(onp.float32)
+    labels = onp.array([[1, 2]], onp.int32)
+    got = float(onp.asarray(mx.npx.ctc_loss(A(logits), A(labels))).ravel()[0])
+    want = _np_ctc_loss_brute(logits[:, 0], labels[0])
+    assert abs(got - want) < 1e-3, (got, want)
+
+
+def test_npx_rnn_shapes_and_tanh_step():
+    T, B, I, H = 3, 2, 4, 5
+    x = _r(T, B, I, seed=48)
+    # relu/tanh vanilla rnn parameter layout: [Wx, Wh, bx, bh]
+    wx, wh = _r(H, I, seed=49), _r(H, H, seed=50)
+    bx, bh = _r(H, seed=51), _r(H, seed=52)
+    params = onp.concatenate([wx.ravel(), wh.ravel(), bx, bh])
+    state = onp.zeros((1, B, H), onp.float32)
+    out = mx.npx.rnn(data=A(x), parameters=A(params), state=A(state),
+                     state_size=H, num_layers=1, mode="rnn_tanh")
+    if isinstance(out, (tuple, list)):   # (output, state...)
+        out = out[0]
+    got = onp.asarray(out)
+    assert got.shape == (T, B, H)
+    h = onp.zeros((B, H), onp.float32)
+    for t in range(T):
+        h = onp.tanh(x[t] @ wx.T + bx + h @ wh.T + bh)
+        assert_almost_equal(got[t], h, rtol=1e-4, atol=1e-4)
+
+
+def test_npx_interleaved_attention_ops():
+    B, H, L, D = 2, 2, 4, 3
+    qkv = _r(L, B, H * 3 * D, seed=53)
+    got = onp.asarray(mx.npx.interleaved_matmul_selfatt_qk(
+        A(qkv), heads=H))
+    proj = qkv.reshape(L, B, H, 3, D)
+    q, k = proj[..., 0, :], proj[..., 1, :]
+    want = onp.einsum("lbhd,mbhd->bhlm", q, k).reshape(B * H, L, L) \
+        / onp.sqrt(D)
+    assert_almost_equal(got, want, rtol=1e-4, atol=1e-4)

@@ -1,0 +1,642 @@
+"""Exhaustive `mx.np` op-numerics sweep against NumPy golden outputs
+(parity model: `tests/python/unittest/test_numpy_op.py`, 203 test fns — the
+reference checks every registered numpy op; this sweep touches the whole
+exported `mx.np` surface with value checks and finite-difference gradient
+checks on the differentiable core)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+A = mx.np.array
+
+
+def _r(*shape, lo=-1.0, hi=1.0, dtype=onp.float32, seed=None):
+    rng = onp.random.RandomState(0 if seed is None else seed)
+    return rng.uniform(lo, hi, size=shape).astype(dtype)
+
+
+def _cmp(name, *args, mx_args=None, rtol=1e-5, atol=1e-6, np_name=None,
+         mod=None, **kw):
+    mfn = getattr(mod or mx.np, name)
+    nfn = getattr(onp, np_name or name) if mod is None else \
+        getattr(onp.linalg, np_name or name)
+    got = mfn(*[A(a) if isinstance(a, onp.ndarray) else a
+                for a in (mx_args or args)], **kw)
+    want = nfn(*args, **kw)
+    if isinstance(want, (tuple, list)):
+        for g, w in zip(got, want):
+            assert_almost_equal(g, w, rtol=rtol, atol=atol)
+    else:
+        assert_almost_equal(got, want, rtol=rtol, atol=atol)
+
+
+# ---------------------------------------------------------------------------
+# unary elementwise
+# ---------------------------------------------------------------------------
+
+UNARY_ANY = ["abs", "absolute", "fabs", "negative", "positive", "sign",
+             "square", "cbrt", "ceil", "floor", "trunc", "rint", "fix",
+             "sin", "cos", "tan", "sinh", "cosh", "tanh", "arctan",
+             "arcsinh", "exp", "exp2", "expm1", "deg2rad", "rad2deg",
+             "degrees", "radians", "sinc", "i0", "isnan", "isinf",
+             "isfinite", "isneginf", "isposinf", "signbit", "conj",
+             "conjugate", "real", "imag", "nan_to_num", "reciprocal",
+             "heaviside_x"]
+
+
+@pytest.mark.parametrize("name", UNARY_ANY)
+@pytest.mark.parametrize("shape", [(7,), (3, 4)])
+def test_sweep_unary_any(name, shape):
+    x = _r(*shape, lo=-2.0, hi=2.0) + 0.25  # avoid exact 0 (sign/recip)
+    if name == "heaviside_x":
+        _cmp("heaviside", x, onp.float32(0.5))
+        return
+    _cmp(name, x, rtol=1e-5, atol=1e-5)
+
+
+UNARY_POS = ["log", "log2", "log10", "log1p", "sqrt"]
+
+
+@pytest.mark.parametrize("name", UNARY_POS)
+def test_sweep_unary_positive(name):
+    x = _r(3, 4, lo=0.1, hi=3.0)
+    _cmp(name, x, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("name,lo,hi", [
+    ("arcsin", -0.9, 0.9), ("arccos", -0.9, 0.9), ("arctanh", -0.9, 0.9),
+    ("arccosh", 1.1, 3.0),
+])
+def test_sweep_unary_domain(name, lo, hi):
+    x = _r(3, 4, lo=lo, hi=hi)
+    _cmp(name, x, rtol=1e-5, atol=1e-5)
+
+
+def test_sweep_unary_int():
+    x = onp.array([[1, 2, 3], [4, 5, 6]], onp.int32)
+    _cmp("invert", x)
+    _cmp("bitwise_not", x)
+    assert_almost_equal(mx.np.angle(A(_r(3))), onp.angle(_r(3)))
+
+
+# ---------------------------------------------------------------------------
+# binary elementwise
+# ---------------------------------------------------------------------------
+
+BINARY_FLOAT = ["add", "subtract", "multiply", "divide", "true_divide",
+                "maximum", "minimum", "fmax", "fmin", "arctan2", "hypot",
+                "copysign", "nextafter", "logaddexp", "logaddexp2",
+                "floor_divide", "remainder", "mod", "fmod"]
+
+
+@pytest.mark.parametrize("name", BINARY_FLOAT)
+@pytest.mark.parametrize("broadcast", [False, True])
+def test_sweep_binary_float(name, broadcast):
+    a = _r(3, 4, lo=0.5, hi=2.0, seed=1)
+    b = _r(4, lo=0.5, hi=2.0, seed=2) if broadcast \
+        else _r(3, 4, lo=0.5, hi=2.0, seed=2)
+    _cmp(name, a, b, rtol=1e-5, atol=1e-5)
+
+
+def test_sweep_binary_power_ldexp_frexp():
+    a = _r(3, 4, lo=0.5, hi=2.0)
+    _cmp("power", a, onp.float32(1.7), rtol=1e-4, atol=1e-5)
+    _cmp("float_power", a, onp.float32(2.0), rtol=1e-5, atol=1e-5)
+    _cmp("ldexp", a, onp.array([1, 2, 3, 4], onp.int32))
+    m, e = mx.np.frexp(A(a))
+    wm, we = onp.frexp(a)
+    assert_almost_equal(m, wm)
+    assert_almost_equal(e, we)
+
+
+BINARY_INT = ["bitwise_and", "bitwise_or", "bitwise_xor", "left_shift",
+              "right_shift", "gcd", "lcm"]
+
+
+@pytest.mark.parametrize("name", BINARY_INT)
+def test_sweep_binary_int(name):
+    a = onp.array([[1, 12, 7], [4, 9, 30]], onp.int32)
+    b = onp.array([[3, 5, 2], [2, 6, 4]], onp.int32)
+    _cmp(name, a, b)
+
+
+COMPARISON = ["equal", "not_equal", "greater", "greater_equal", "less",
+              "less_equal", "logical_and", "logical_or", "logical_xor"]
+
+
+@pytest.mark.parametrize("name", COMPARISON)
+def test_sweep_comparison(name):
+    a = onp.array([[0.0, 1.0, -1.0], [2.0, 0.0, 2.0]], onp.float32)
+    b = onp.array([[0.0, -1.0, -1.0], [1.0, 1.0, 2.0]], onp.float32)
+    _cmp(name, a, b)
+
+
+def test_sweep_logical_not_isclose():
+    a = onp.array([0.0, 1.0, 2.0], onp.float32)
+    _cmp("logical_not", a)
+    b = a + onp.array([1e-9, 1e-3, 0.0], onp.float32)
+    assert_almost_equal(mx.np.isclose(A(a), A(b)), onp.isclose(a, b))
+    assert bool(mx.np.allclose(A(a), A(a)))
+    assert bool(mx.np.array_equal(A(a), A(a)))
+    assert bool(mx.np.array_equiv(A(a), A(a)))
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+
+REDUCTIONS = ["sum", "prod", "mean", "std", "var", "max", "min", "amax",
+              "amin", "ptp", "median", "argmax", "argmin",
+              "count_nonzero", "any", "all"]
+
+
+@pytest.mark.parametrize("name", REDUCTIONS)
+@pytest.mark.parametrize("kw", [{}, {"axis": 0}, {"axis": 1}])
+def test_sweep_reductions(name, kw):
+    x = _r(4, 5, lo=-2, hi=2)
+    if name in ("any", "all"):
+        x = (x > 0)
+    _cmp(name, x, rtol=1e-4, atol=1e-5, **kw)
+
+
+@pytest.mark.parametrize("name", ["sum", "mean", "max", "std"])
+def test_sweep_reductions_keepdims(name):
+    x = _r(4, 5)
+    _cmp(name, x, axis=1, keepdims=True, rtol=1e-4, atol=1e-5)
+
+
+NAN_REDUCTIONS = ["nansum", "nanprod", "nanmean", "nanstd", "nanvar",
+                  "nanmax", "nanmin", "nanargmax", "nanargmin",
+                  "nancumsum", "nancumprod", "nanmedian"]
+
+
+@pytest.mark.parametrize("name", NAN_REDUCTIONS)
+def test_sweep_nan_reductions(name):
+    x = _r(4, 5, lo=0.5, hi=2.0)
+    x[1, 2] = onp.nan
+    x[3, 0] = onp.nan
+    _cmp(name, x, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("name", ["cumsum", "cumprod"])
+@pytest.mark.parametrize("kw", [{}, {"axis": 0}, {"axis": 1}])
+def test_sweep_cumulative(name, kw):
+    x = _r(3, 4, lo=0.5, hi=1.5)
+    _cmp(name, x, rtol=1e-5, atol=1e-5, **kw)
+
+
+@pytest.mark.parametrize("q", [0, 25, 50, 75, 100])
+def test_sweep_percentile_quantile(q):
+    x = _r(5, 6)
+    _cmp("percentile", x, q, rtol=1e-5, atol=1e-6)
+    _cmp("quantile", x, q / 100.0, rtol=1e-5, atol=1e-6)
+    _cmp("nanpercentile", x, q, rtol=1e-5, atol=1e-6)
+    _cmp("nanquantile", x, q / 100.0, rtol=1e-5, atol=1e-6)
+
+
+def test_sweep_average_cov_corrcoef():
+    x = _r(4, 5, seed=3)
+    w = _r(4, lo=0.1, hi=1.0, seed=4)
+    _cmp("average", x)
+    assert_almost_equal(mx.np.average(A(x), axis=0, weights=A(w)),
+                        onp.average(x, axis=0, weights=w), rtol=1e-5,
+                        atol=1e-6)
+    _cmp("cov", x, rtol=1e-4, atol=1e-5)
+    _cmp("corrcoef", x, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# shape manipulation
+# ---------------------------------------------------------------------------
+
+def test_sweep_reshape_family():
+    x = _r(2, 3, 4)
+    _cmp("reshape", x, (4, 6))
+    _cmp("ravel", x)
+    _cmp("squeeze", x[None])
+    _cmp("expand_dims", x, mx_args=None, axis=1)
+    _cmp("transpose", x)
+    _cmp("swapaxes", x, 0, 2)
+    _cmp("moveaxis", x, 0, -1)
+    _cmp("rollaxis", x, 2)
+    assert mx.np.ndim(A(x)) == 3
+    assert mx.np.size(A(x)) == 24
+    assert mx.np.shape(A(x)) == (2, 3, 4)
+
+
+def test_sweep_flip_roll_rot():
+    x = _r(3, 4)
+    _cmp("flip", x, mx_args=None, axis=0)
+    _cmp("fliplr", x)
+    _cmp("flipud", x)
+    _cmp("roll", x, 2)
+    _cmp("roll", x, 1, axis=1)
+    _cmp("rot90", x)
+    _cmp("rot90", x, 2)
+
+
+def test_sweep_tile_repeat_pad():
+    x = _r(2, 3)
+    _cmp("tile", x, (2, 2))
+    _cmp("repeat", x, 3)
+    _cmp("repeat", x, 2, axis=1)
+    _cmp("pad", x, 1)
+    _cmp("pad", x, ((1, 0), (0, 2)))
+    got = mx.np.pad(A(x), 1, mode="edge")
+    assert_almost_equal(got, onp.pad(x, 1, mode="edge"))
+
+
+def test_sweep_broadcast_atleast():
+    x = _r(3)
+    _cmp("broadcast_to", x, (2, 3))
+    _cmp("atleast_1d", onp.float32(3.0))
+    _cmp("atleast_2d", x)
+    _cmp("atleast_3d", x)
+    a, b = mx.np.broadcast_arrays(A(_r(3)), A(_r(2, 3)))
+    assert a.shape == b.shape == (2, 3)
+
+
+def test_sweep_concat_stack():
+    a, b = _r(2, 3, seed=1), _r(2, 3, seed=2)
+    _cmp("concatenate", [a, b], mx_args=[[A(a), A(b)]])
+    got = mx.np.concatenate([A(a), A(b)], axis=1)
+    assert_almost_equal(got, onp.concatenate([a, b], axis=1))
+    for name in ["stack", "vstack", "hstack", "dstack", "column_stack"]:
+        got = getattr(mx.np, name)([A(a), A(b)])
+        assert_almost_equal(got, getattr(onp, name)([a, b]))
+
+
+@pytest.mark.parametrize("name", ["split", "array_split", "hsplit", "vsplit",
+                                  "dsplit"])
+def test_sweep_split(name):
+    x = _r(4, 6, 8)
+    n = {"split": 2, "array_split": 3, "hsplit": 3, "vsplit": 2,
+         "dsplit": 4}[name]
+    got = getattr(mx.np, name)(A(x), n)
+    want = getattr(onp, name)(x, n)
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        assert_almost_equal(g, w)
+
+
+def test_sweep_insert_delete_append_resize():
+    x = _r(3, 4)
+    _cmp("append", x, _r(2, 4, seed=5), mx_args=None, axis=0)
+    _cmp("delete", x, 1, mx_args=None, axis=0)
+    _cmp("insert", x, 1, onp.float32(9.0), mx_args=None, axis=1)
+    _cmp("resize", x, (2, 2))
+    _cmp("trim_zeros", onp.array([0, 0, 1, 2, 0], onp.float32))
+
+
+# ---------------------------------------------------------------------------
+# indexing / gather / scatter
+# ---------------------------------------------------------------------------
+
+def test_sweep_take_family():
+    x = _r(4, 5)
+    idx = onp.array([0, 2, 3], onp.int32)
+    _cmp("take", x, idx, mx_args=None, axis=1)
+    ii = onp.array([[0, 1, 2, 0, 1]], onp.int64)
+    _cmp("take_along_axis", x, ii, mx_args=None, axis=0)
+    _cmp("compress", onp.array([True, False, True, True]), x,
+         mx_args=None, axis=0)
+    _cmp("extract", x > 0, x)
+    _cmp("choose", onp.array([0, 1, 1], onp.int32),
+         [onp.arange(3, dtype=onp.float32),
+          onp.arange(3, 6).astype(onp.float32)])
+
+
+def test_sweep_where_select_clip():
+    x = _r(3, 4)
+    _cmp("where", x > 0, x, -x)
+    _cmp("clip", x, -0.3, 0.3)
+    got = mx.np.select([A(x) > 0.3, A(x) < -0.3], [A(x), A(-x)], 0.0)
+    want = onp.select([x > 0.3, x < -0.3], [x, -x], 0.0)
+    assert_almost_equal(got, want)
+    _cmp("piecewise", x, [x < 0, x >= 0], [-1.0, 1.0])
+
+
+def test_sweep_put_along_fill_diag():
+    x = _r(3, 4)
+    idx = onp.array([[1], [0], [2]], onp.int64)
+    vals = onp.full((3, 1), 7.0, onp.float32)
+    xm = A(x.copy())
+    mx.np.put_along_axis(xm, A(idx), A(vals), axis=1)
+    want = x.copy()
+    onp.put_along_axis(want, idx, vals, axis=1)
+    assert_almost_equal(xm, want)
+    ym = A(x.copy())
+    mx.np.fill_diagonal(ym, 5.0)
+    want = x.copy()
+    onp.fill_diagonal(want, 5.0)
+    assert_almost_equal(ym, want)
+
+
+def test_sweep_nonzero_argwhere_unravel():
+    x = onp.array([[0, 1, 0], [2, 0, 3]], onp.float32)
+    got = mx.np.nonzero(A(x))
+    want = onp.nonzero(x)
+    for g, w in zip(got, want):
+        assert_almost_equal(g, w)
+    _cmp("argwhere", x)
+    _cmp("flatnonzero", x)
+    got = mx.np.unravel_index(A(onp.array([1, 3], onp.int64)), (2, 3))
+    want = onp.unravel_index(onp.array([1, 3]), (2, 3))
+    for g, w in zip(got, want):
+        assert_almost_equal(g, w)
+    got = mx.np.ravel_multi_index(
+        (A(onp.array([0, 1], onp.int64)), A(onp.array([1, 2], onp.int64))),
+        (2, 3))
+    assert_almost_equal(got, onp.ravel_multi_index(
+        (onp.array([0, 1]), onp.array([1, 2])), (2, 3)))
+
+
+def test_sweep_diag_tri():
+    x = _r(4, 4)
+    for name in ["diag", "diagonal", "tril", "triu", "trace", "diagflat"]:
+        _cmp(name, x if name != "diagflat" else _r(3), rtol=1e-5, atol=1e-6)
+    _cmp("tri", 3, mx_args=[3])
+    r, c = mx.np.tril_indices(4)
+    wr, wc = onp.tril_indices(4)
+    assert_almost_equal(r, wr)
+    assert_almost_equal(c, wc)
+    r, c = mx.np.triu_indices(4, 1)
+    wr, wc = onp.triu_indices(4, 1)
+    assert_almost_equal(r, wr)
+    d = mx.np.diag_indices(3)
+    wd = onp.diag_indices(3)
+    for g, w in zip(d, wd):
+        assert_almost_equal(g, w)
+
+
+# ---------------------------------------------------------------------------
+# sorting / searching / sets
+# ---------------------------------------------------------------------------
+
+def test_sweep_sort_partition():
+    x = _r(4, 6, seed=7)
+    _cmp("sort", x)
+    _cmp("argsort", x)
+    got = mx.np.partition(A(x), 2, axis=1)
+    assert_almost_equal(onp.sort(onp.asarray(got), axis=1)[:, :3],
+                        onp.sort(x, axis=1)[:, :3])
+    gota = mx.np.argpartition(A(x), 2, axis=1)
+    picked = onp.take_along_axis(x, onp.asarray(gota)[:, :3].astype(int),
+                                 axis=1)
+    assert_almost_equal(onp.sort(picked, axis=1),
+                        onp.sort(x, axis=1)[:, :3])
+    keys = (_r(5, seed=8), _r(5, seed=9))
+    assert_almost_equal(mx.np.lexsort((A(keys[0]), A(keys[1]))),
+                        onp.lexsort(keys))
+
+
+def test_sweep_searchsorted_digitize_bincount():
+    edges = onp.array([0.0, 1.0, 2.0, 3.0], onp.float32)
+    vals = onp.array([0.5, 2.5, 1.5, 2.0], onp.float32)
+    _cmp("searchsorted", edges, vals)
+    _cmp("digitize", vals, edges)
+    x = onp.array([0, 1, 1, 3, 2, 1], onp.int32)
+    _cmp("bincount", x)
+
+
+def test_sweep_unique_setops():
+    x = onp.array([3, 1, 2, 3, 1, 7], onp.float32)
+    y = onp.array([2, 3, 9], onp.float32)
+    assert_almost_equal(mx.np.unique(A(x)), onp.unique(x))
+    assert_almost_equal(mx.np.in1d(A(x), A(y)), onp.in1d(x, y))
+    assert_almost_equal(mx.np.isin(A(x), A(y)), onp.isin(x, y))
+    assert_almost_equal(mx.np.intersect1d(A(x), A(y)), onp.intersect1d(x, y))
+    assert_almost_equal(mx.np.setdiff1d(A(x), A(y)), onp.setdiff1d(x, y))
+    assert_almost_equal(mx.np.union1d(A(x), A(y)), onp.union1d(x, y))
+
+
+def test_sweep_histogram():
+    x = _r(50, seed=11)
+    h, e = mx.np.histogram(A(x), bins=5)
+    wh, we = onp.histogram(x, bins=5)
+    assert_almost_equal(h, wh)
+    assert_almost_equal(e, we, rtol=1e-5, atol=1e-6)
+    _cmp("histogram_bin_edges", x, mx_args=None, bins=4)
+
+
+# ---------------------------------------------------------------------------
+# linear algebra & products
+# ---------------------------------------------------------------------------
+
+def test_sweep_products():
+    a, b = _r(3, 4, seed=1), _r(4, 5, seed=2)
+    _cmp("dot", a, b, rtol=1e-4, atol=1e-5)
+    _cmp("matmul", a, b, rtol=1e-4, atol=1e-5)
+    v, w = _r(4, seed=3), _r(4, seed=4)
+    _cmp("inner", v, w, rtol=1e-4, atol=1e-5)
+    _cmp("outer", v, w, rtol=1e-4, atol=1e-5)
+    _cmp("vdot", v, w, rtol=1e-4, atol=1e-5)
+    _cmp("kron", _r(2, 2, seed=5), _r(2, 2, seed=6), rtol=1e-4, atol=1e-5)
+    _cmp("cross", _r(3, seed=7), _r(3, seed=8), rtol=1e-4, atol=1e-5)
+    _cmp("tensordot", a, b.T, mx_args=None, axes=0, rtol=1e-4, atol=1e-4)
+    got = mx.np.einsum("ij,jk->ik", A(a), A(b))
+    assert_almost_equal(got, onp.einsum("ij,jk->ik", a, b), rtol=1e-4,
+                        atol=1e-5)
+
+
+LINALG_1IN = ["det", "inv", "cholesky", "slogdet", "matrix_rank", "pinv",
+              "eigvalsh", "norm"]
+
+
+@pytest.mark.parametrize("name", LINALG_1IN)
+def test_sweep_linalg(name):
+    rng = onp.random.RandomState(5)
+    m = rng.standard_normal((4, 4)).astype(onp.float32)
+    spd = (m @ m.T + 4 * onp.eye(4)).astype(onp.float32)
+    _cmp(name, spd, mod=mx.np.linalg, rtol=1e-3, atol=1e-4)
+
+
+def test_sweep_linalg_decomp_solve():
+    rng = onp.random.RandomState(6)
+    a = rng.standard_normal((4, 4)).astype(onp.float32) + 4 * onp.eye(
+        4, dtype=onp.float32)
+    b = rng.standard_normal((4, 2)).astype(onp.float32)
+    assert_almost_equal(mx.np.linalg.solve(A(a), A(b)),
+                        onp.linalg.solve(a, b), rtol=1e-3, atol=1e-4)
+    q, r = mx.np.linalg.qr(A(a))
+    assert_almost_equal(mx.np.matmul(q, r), a, rtol=1e-4, atol=1e-4)
+    u, s, vt = mx.np.linalg.svd(A(a))
+    assert_almost_equal(s, onp.linalg.svd(a)[1], rtol=1e-3, atol=1e-4)
+    w, v = mx.np.linalg.eigh(A(a @ a.T))
+    assert_almost_equal(w, onp.linalg.eigh(a @ a.T)[0], rtol=1e-3, atol=1e-3)
+    p = mx.np.linalg.matrix_power(A(a), 3)
+    assert_almost_equal(p, onp.linalg.matrix_power(a, 3), rtol=1e-3,
+                        atol=1e-2)
+    md = mx.np.linalg.multi_dot([A(a), A(a), A(b)])
+    assert_almost_equal(md, onp.linalg.multi_dot([a, a, b]), rtol=1e-3,
+                        atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# creation / ranges / windows / misc numerics
+# ---------------------------------------------------------------------------
+
+def test_sweep_creation():
+    for name, args in [("zeros", ((2, 3),)), ("ones", ((2, 3),)),
+                       ("full", ((2, 3), 7.0)), ("eye", (3,)),
+                       ("identity", (3,)), ("arange", (2, 10, 2)),
+                       ("linspace", (0.0, 1.0, 5)),
+                       ("logspace", (0.0, 2.0, 4))]:
+        got = getattr(mx.np, name)(*args)
+        want = getattr(onp, name)(*args)
+        assert_almost_equal(got, want, rtol=1e-5, atol=1e-6)
+    x = _r(2, 3)
+    for name in ["zeros_like", "ones_like", "empty_like", "full_like"]:
+        args = (x, 3.0) if name == "full_like" else (x,)
+        got = getattr(mx.np, name)(A(x), *args[1:])
+        assert got.shape == x.shape
+    assert mx.np.empty((2, 3)).shape == (2, 3)
+    got = mx.np.fromfunction(lambda i, j: i + j, (3, 3))
+    assert_almost_equal(got, onp.fromfunction(lambda i, j: i + j, (3, 3)))
+    _cmp("vander", _r(4), mx_args=None, N=3)
+    m = mx.np.meshgrid(A(_r(3)), A(_r(2)))
+    wm = onp.meshgrid(_r(3), _r(2))
+    for g, w in zip(m, wm):
+        assert_almost_equal(g, w)
+    gi = mx.np.indices((2, 3))
+    assert_almost_equal(gi, onp.indices((2, 3)))
+
+
+def test_sweep_numeric_misc():
+    x = _r(8, lo=0.1, hi=2.0, seed=13)
+    _cmp("diff", x, rtol=1e-5, atol=1e-6)
+    _cmp("ediff1d", x, rtol=1e-5, atol=1e-6)
+    _cmp("gradient", x, rtol=1e-4, atol=1e-5)
+    _cmp("trapezoid", x, rtol=1e-4, atol=1e-5)
+    xp = onp.array([0.0, 1.0, 2.0], onp.float32)
+    fp = onp.array([0.0, 10.0, 20.0], onp.float32)
+    _cmp("interp", onp.array([0.5, 1.5], onp.float32), xp, fp)
+    _cmp("convolve", _r(5, seed=14), _r(3, seed=15), rtol=1e-4, atol=1e-5)
+    _cmp("correlate", _r(5, seed=16), _r(3, seed=17), rtol=1e-4, atol=1e-5)
+    _cmp("around", x * 3)
+    _cmp("round", x * 3)
+    assert float(mx.np.prod(A(onp.array([1.5, 2.0], onp.float32)))) == 3.0
+
+
+def test_sweep_constants_dtypes():
+    assert mx.np.pi == onp.pi and mx.np.e == onp.e
+    assert onp.isnan(mx.np.nan) and onp.isinf(mx.np.inf)
+    assert mx.np.euler_gamma == onp.euler_gamma
+    assert mx.np.finfo(mx.np.float32).eps == onp.finfo(onp.float32).eps
+    assert mx.np.iinfo(mx.np.int32).max == onp.iinfo(onp.int32).max
+    assert mx.np.result_type(mx.np.float32, mx.np.int32) == onp.float32
+    assert mx.np.promote_types("float32", "int32") == onp.float32
+    for dt in ["int8", "int16", "int32", "int64", "uint8", "float16",
+               "float32", "float64", "bool_"]:
+        assert getattr(mx.np, dt) is not None
+
+
+# ---------------------------------------------------------------------------
+# gradient sweep (finite differences through autograd)
+# ---------------------------------------------------------------------------
+
+GRAD_UNARY = ["exp", "log", "sqrt", "sin", "cos", "tanh", "arctan", "square",
+              "cbrt", "log1p", "expm1", "sinh", "cosh", "arcsinh", "abs",
+              "reciprocal", "sigmoid_like"]
+
+
+@pytest.mark.parametrize("name", GRAD_UNARY)
+def test_sweep_grad_unary(name):
+    x = mx.np.array(_r(2, 3, lo=0.3, hi=1.2, seed=21))
+    if name == "sigmoid_like":
+        f = lambda t: (1.0 / (1.0 + mx.np.exp(-t))).sum()
+    else:
+        fn = getattr(mx.np, name)
+        f = lambda t: fn(t).sum()
+    check_numeric_gradient(f, [x], rtol=2e-2, atol=1e-3)
+
+
+GRAD_BINARY = ["add", "subtract", "multiply", "divide", "maximum",
+               "minimum", "hypot", "arctan2", "power"]
+
+
+@pytest.mark.parametrize("name", GRAD_BINARY)
+def test_sweep_grad_binary(name):
+    a = mx.np.array(_r(2, 3, lo=0.6, hi=1.4, seed=22))
+    b = mx.np.array(_r(2, 3, lo=0.6, hi=1.4, seed=23))
+    fn = getattr(mx.np, name)
+    check_numeric_gradient(lambda x, y: fn(x, y).sum(), [a, b],
+                           rtol=2e-2, atol=1e-3)
+
+
+@pytest.mark.parametrize("spec", [
+    ("sum", {}), ("mean", {}), ("prod", {}), ("max", {}), ("min", {}),
+    ("std", {}), ("var", {}), ("sum", {"axis": 1}),
+    ("cumsum", {}),
+])
+def test_sweep_grad_reduction(spec):
+    name, kw = spec
+    x = mx.np.array(_r(2, 3, lo=0.5, hi=1.5, seed=24))
+    fn = getattr(mx.np, name)
+    check_numeric_gradient(lambda t: fn(t, **kw).sum(), [x],
+                           rtol=2e-2, atol=1e-3)
+
+
+@pytest.mark.parametrize("case", ["matmul", "dot", "einsum", "tensordot",
+                                  "where", "concatenate", "transpose",
+                                  "reshape", "take", "clip", "pad"])
+def test_sweep_grad_structural(case):
+    a = mx.np.array(_r(2, 3, seed=25))
+    b = mx.np.array(_r(3, 2, seed=26))
+    if case == "matmul":
+        f, args = (lambda x, y: mx.np.matmul(x, y).sum()), [a, b]
+    elif case == "dot":
+        f, args = (lambda x, y: mx.np.dot(x, y).sum()), [a, b]
+    elif case == "einsum":
+        f, args = (lambda x, y: mx.np.einsum("ij,jk->ik", x, y).sum()), [a, b]
+    elif case == "tensordot":
+        f, args = (lambda x, y: mx.np.tensordot(
+            x, y, axes=([1], [0])).sum()), [a, b]
+    elif case == "where":
+        f, args = (lambda x: mx.np.where(x > 0, x * 2, x * 3).sum()), [a]
+    elif case == "concatenate":
+        f, args = (lambda x, y: mx.np.concatenate(
+            [x, y.T], axis=0).sum()), [a, b]
+    elif case == "transpose":
+        f, args = (lambda x: (mx.np.transpose(x) * 2).sum()), [a]
+    elif case == "reshape":
+        f, args = (lambda x: (mx.np.reshape(x, (3, 2)) ** 2).sum()), [a]
+    elif case == "take":
+        idx = mx.np.array(onp.array([0, 2], onp.int32))
+        f, args = (lambda x: mx.np.take(x, idx, axis=1).sum()), [a]
+    elif case == "clip":
+        f, args = (lambda x: mx.np.clip(x * 2, -0.5, 0.5).sum()), [a]
+    else:  # pad
+        f, args = (lambda x: mx.np.pad(x, 1).sum()), [a]
+    check_numeric_gradient(f, args, rtol=2e-2, atol=1e-3)
+
+
+def test_sweep_grad_inplace_overwrite_recorded():
+    """fill_diagonal/put_along_axis under record() must null the gradient
+    of overwritten entries (tape records the overwrite)."""
+    from mxnet_tpu import autograd
+    a = mx.np.array(onp.ones((3, 3), onp.float32))
+    a.attach_grad()
+    with autograd.record():
+        b = a * 2.0
+        mx.np.fill_diagonal(b, 0.0)
+        loss = b.sum()
+    loss.backward()
+    want = onp.full((3, 3), 2.0, onp.float32)
+    onp.fill_diagonal(want, 0.0)
+    assert_almost_equal(a.grad, want)
+
+    a2 = mx.np.array(onp.ones((2, 3), onp.float32))
+    a2.attach_grad()
+    idx = mx.np.array(onp.array([[1], [2]], onp.int64))
+    with autograd.record():
+        c = a2 * 3.0
+        mx.np.put_along_axis(c, idx, mx.np.array(
+            onp.zeros((2, 1), onp.float32)), axis=1)
+        loss = c.sum()
+    loss.backward()
+    want = onp.full((2, 3), 3.0, onp.float32)
+    want[0, 1] = want[1, 2] = 0.0
+    assert_almost_equal(a2.grad, want)

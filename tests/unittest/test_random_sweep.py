@@ -1,0 +1,124 @@
+"""`mx.np.random` distribution sweep: shape/dtype contracts + first/second
+moment checks for every sampler (parity model: reference random-op tests in
+`tests/python/unittest/test_numpy_op.py` + `test_random.py` over
+`src/operator/numpy/random/`). Statistical checks use the retry fixture
+pattern (`common.py:218`)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import retry
+
+N = 40_000
+
+
+def _draw(name, *args, **kw):
+    # seeding comes from the ambient retry() fixture so each attempt
+    # actually resamples
+    out = getattr(mx.np.random, name)(*args, size=(N,), **kw)
+    a = onp.asarray(out)
+    assert a.shape == (N,)
+    return a
+
+
+# (name, args, kwargs, expected_mean, expected_var)
+MOMENTS = [
+    ("normal", (2.0, 3.0), {}, 2.0, 9.0),
+    ("uniform", (-1.0, 3.0), {}, 1.0, 16.0 / 12.0),
+    ("exponential", (2.0,), {}, 2.0, 4.0),
+    ("gamma", (3.0, 2.0), {}, 6.0, 12.0),
+    ("beta", (2.0, 5.0), {}, 2.0 / 7.0, 10.0 / (49 * 8)),
+    ("chisquare", (4.0,), {}, 4.0, 8.0),
+    ("poisson", (3.5,), {}, 3.5, 3.5),
+    ("laplace", (1.0, 2.0), {}, 1.0, 8.0),
+    ("logistic", (1.0, 2.0), {}, 1.0, (onp.pi * 2.0) ** 2 / 3.0),
+    ("gumbel", (0.5, 2.0), {}, 0.5 + 2.0 * onp.euler_gamma,
+     (onp.pi * 2.0) ** 2 / 6.0),
+    ("rayleigh", (2.0,), {}, 2.0 * onp.sqrt(onp.pi / 2),
+     (4 - onp.pi) / 2 * 4.0),
+    ("weibull", (2.0,), {}, 0.8862269, 0.2146018),
+    ("pareto", (4.0,), {}, 1.0 / 3.0, None),  # var check skipped (heavy tail)
+    ("power", (3.0,), {}, 0.75, 3.0 / (16 * 5)),
+    ("lognormal", (0.0, 0.5), {}, onp.exp(0.125),
+     (onp.exp(0.25) - 1) * onp.exp(0.25)),
+]
+
+
+@pytest.mark.parametrize("name,args,kw,mean,var",
+                         MOMENTS, ids=[m[0] for m in MOMENTS])
+@retry(3)
+def test_random_moments(name, args, kw, mean, var):
+    a = _draw(name, *args, **kw)
+    assert onp.isfinite(a).all()
+    sd = onp.sqrt(var / N) if var else max(abs(mean), 1.0) / onp.sqrt(N)
+    assert abs(a.mean() - mean) < 6 * sd + 1e-3, (a.mean(), mean)
+    if var is not None:
+        assert abs(a.var() - var) / var < 0.1, (a.var(), var)
+
+
+@retry(3)
+def test_random_rand_randn_randint():
+    mx.np.random.seed(11)
+    a = onp.asarray(mx.np.random.rand(1000, 3))
+    assert a.shape == (1000, 3) and (a >= 0).all() and (a < 1).all()
+    b = onp.asarray(mx.np.random.randn(5000))
+    assert abs(b.mean()) < 0.1 and abs(b.std() - 1) < 0.1
+    c = onp.asarray(mx.np.random.randint(2, 9, size=(5000,)))
+    assert c.min() >= 2 and c.max() <= 8
+    assert set(onp.unique(c)) == set(range(2, 9))
+
+
+def test_random_bernoulli_multinomial():
+    mx.np.random.seed(13)
+    a = onp.asarray(mx.np.random.bernoulli(prob=0.3, size=(N,)))
+    assert abs(a.mean() - 0.3) < 0.02
+    p = onp.array([0.2, 0.5, 0.3])
+    m = onp.asarray(mx.np.random.multinomial(50, mx.np.array(p), size=(200,)))
+    assert m.shape == (200, 3)
+    assert (m.sum(-1) == 50).all()
+    assert abs(m[:, 1].mean() - 25) < 3
+
+
+def test_random_multivariate_normal():
+    mx.np.random.seed(17)
+    mean = onp.array([1.0, -1.0], onp.float32)
+    cov = onp.array([[2.0, 0.5], [0.5, 1.0]], onp.float32)
+    s = onp.asarray(mx.np.random.multivariate_normal(
+        mx.np.array(mean), mx.np.array(cov), size=(20000,)))
+    assert s.shape == (20000, 2)
+    assert onp.allclose(s.mean(0), mean, atol=0.1)
+    assert onp.allclose(onp.cov(s.T), cov, atol=0.15)
+
+
+def test_random_choice_permutation_shuffle():
+    mx.np.random.seed(19)
+    pool = mx.np.array(onp.arange(10, dtype=onp.float32))
+    c = onp.asarray(mx.np.random.choice(pool, size=(500,)))
+    assert set(onp.unique(c)).issubset(set(range(10)))
+    p = onp.asarray(mx.np.random.permutation(10))
+    assert sorted(p.tolist()) == list(range(10))
+    x = mx.np.array(onp.arange(10, dtype=onp.float32))
+    mx.np.random.shuffle(x)
+    assert sorted(onp.asarray(x).tolist()) == list(range(10))
+
+
+def test_random_seed_reproducibility():
+    mx.np.random.seed(123)
+    a = onp.asarray(mx.np.random.normal(0, 1, size=(100,)))
+    mx.np.random.seed(123)
+    b = onp.asarray(mx.np.random.normal(0, 1, size=(100,)))
+    onp.testing.assert_array_equal(a, b)
+    mx.np.random.seed(124)
+    c = onp.asarray(mx.np.random.normal(0, 1, size=(100,)))
+    assert not onp.array_equal(a, c)
+
+
+@pytest.mark.parametrize("name", ["normal", "uniform", "gamma"])
+def test_random_dtype_and_broadcast(name):
+    mx.np.random.seed(23)
+    fn = getattr(mx.np.random, name)
+    args = {"normal": (0.0, 1.0), "uniform": (0.0, 1.0),
+            "gamma": (2.0, 1.0)}[name]
+    out = fn(*args, size=(3, 4))
+    assert out.shape == (3, 4)
+    assert out.dtype == onp.float32
