@@ -524,18 +524,40 @@ class HybridBlock(Block):
         return self.forward(*args, **kwargs)
 
     # -- export --------------------------------------------------------------
-    def export(self, path: str, epoch: int = 0, remove_amp_cast=True):
+    def export(self, path: str, epoch: int = 0, remove_amp_cast=True,
+               input_shapes=None, input_dtypes="float32"):
         """Serialize compiled graph + params (parity: `gluon/block.py:1481`,
-        symbol-json+params → StableHLO + npz)."""
+        symbol-json+params → StableHLO + npz).
+
+        Works from shape info alone (reference semantics): pass
+        `input_shapes` (one shape tuple, or a tuple/list of them for
+        multi-input blocks) and export traces on zeros of those shapes —
+        no prior forward call needed."""
         import jax.export as jexport
+
+        example = getattr(self, "_example_input", None)
+        if input_shapes is not None:  # explicit shapes win over the cache
+            from ..numpy import zeros as _zeros
+            shapes = input_shapes
+            if shapes and isinstance(shapes[0], int):
+                shapes = (shapes,)
+            dtypes = input_dtypes if isinstance(input_dtypes, (list, tuple)) \
+                else [input_dtypes] * len(shapes)
+            if len(dtypes) != len(shapes):
+                raise MXNetError(
+                    f"export: input_dtypes has {len(dtypes)} entries but "
+                    f"input_shapes has {len(shapes)}")
+            example = tuple(_zeros(s, dtype=d)
+                            for s, d in zip(shapes, dtypes))
+            self(*example)  # finishes deferred init; caches example input
+        if example is None:
+            raise MXNetError(
+                "export requires a prior forward call, input_shapes=..., "
+                "or block._example_input")
 
         params = {n: p for n, p in self.collect_params().items()
                   if p._data is not None}
         pvals = {n: p._data._data for n, p in params.items()}
-        example = getattr(self, "_example_input", None)
-        if example is None:
-            raise MXNetError("export requires at least one prior forward "
-                             "call or set block._example_input")
         leaves, struct = _flatten_args((example,), {}) \
             if not isinstance(example, tuple) else _flatten_args(example, {})
 

@@ -11,6 +11,7 @@ copy-stream path.
 from __future__ import annotations
 
 from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from typing import Callable, Optional
 
 import numpy as _onp
@@ -61,6 +62,10 @@ class DataLoader:
         self._batchify_fn = batchify_fn or default_batchify_fn
         self._num_workers = num_workers
         self._prefetch = max(0, prefetch or 2 * max(num_workers, 1))
+        # timeout (seconds) bounds the wait for each worker batch — a hung
+        # transform raises instead of deadlocking the training loop
+        # (parity: dataloader.py:514 timeout semantics)
+        self._timeout = timeout
         self._pool = ThreadPoolExecutor(max_workers=num_workers) \
             if num_workers > 0 else None
 
@@ -92,7 +97,14 @@ class DataLoader:
         while queue:
             fut = queue.popleft()
             submit()
-            yield fut.result()
+            try:
+                yield fut.result(timeout=self._timeout)
+            except FuturesTimeoutError:
+                raise MXNetError(
+                    f"DataLoader worker batch timed out after "
+                    f"{self._timeout}s (num_workers={self._num_workers}); "
+                    "a dataset transform is stuck or too slow — raise "
+                    "`timeout=` or debug the transform")
 
     def __len__(self):
         return len(self._batch_sampler)

@@ -1,2 +1,4 @@
 from . import config  # noqa: F401
 from .config import flags  # noqa: F401
+
+from .checkpoint import CheckpointManager  # noqa: E402,F401

@@ -1,0 +1,104 @@
+"""CheckpointManager — periodic checkpoint + auto-resume.
+
+The recovery story SURVEY.md §5.3 plans as a NEW capability (the reference
+has none: a dead ps-lite node kills the job). Works with any target
+exposing ``save(path)`` / ``load(path)`` — `ShardedTrainStep` is the
+canonical one — and implements the usual manager contract (atomic writes,
+keep-last-K pruning, latest-step discovery) so a restarted job continues
+from the newest complete checkpoint.
+
+Usage::
+
+    mgr = CheckpointManager("/ckpts", keep=3)
+    start = mgr.restore(step) or 0          # 0 when starting fresh
+    for i in range(start, total_steps):
+        loss = step(batch())
+        mgr.maybe_save(step, i + 1, every=500)
+"""
+from __future__ import annotations
+
+import os
+import re
+import tempfile
+from typing import List, Optional, Tuple
+
+from ..base import MXNetError
+
+__all__ = ["CheckpointManager"]
+
+_FNAME = re.compile(r"^(?P<prefix>.+)-(?P<step>\d+)\.npz$")
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, keep: int = 3, prefix: str = "ckpt"):
+        if keep < 1:
+            raise MXNetError("keep must be >= 1")
+        self.directory = directory
+        self.keep = keep
+        self.prefix = prefix
+        os.makedirs(directory, exist_ok=True)
+
+    # -- discovery -------------------------------------------------------
+    def checkpoints(self) -> List[Tuple[int, str]]:
+        """Sorted [(step, path)] of complete checkpoints on disk."""
+        out = []
+        for fn in os.listdir(self.directory):
+            m = _FNAME.match(fn)
+            if m and m.group("prefix") == self.prefix:
+                out.append((int(m.group("step")),
+                            os.path.join(self.directory, fn)))
+        return sorted(out)
+
+    def latest(self) -> Optional[Tuple[int, str]]:
+        cps = self.checkpoints()
+        return cps[-1] if cps else None
+
+    # -- save/restore ----------------------------------------------------
+    def _path(self, step: int) -> str:
+        return os.path.join(self.directory, f"{self.prefix}-{step}.npz")
+
+    def save(self, target, step: int) -> str:
+        """Checkpoint `target` at `step`. The write is atomic (temp file +
+        rename) so a crash mid-save never leaves a truncated checkpoint as
+        the latest."""
+        final = self._path(step)
+        fd, tmp = tempfile.mkstemp(dir=self.directory,
+                                   prefix=f".{self.prefix}-tmp")
+        os.close(fd)
+        try:
+            target.save(tmp)
+            os.replace(tmp, final)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        self._prune()
+        return final
+
+    def maybe_save(self, target, step: int, every: int) -> Optional[str]:
+        if every > 0 and step % every == 0:
+            return self.save(target, step)
+        return None
+
+    def restore(self, target, step: Optional[int] = None) -> int:
+        """Load the checkpoint at `step` (default: latest) into `target`;
+        returns the restored step, or 0 if none exists."""
+        if step is not None:
+            path = self._path(step)
+            if not os.path.exists(path):
+                raise MXNetError(f"no checkpoint for step {step} in "
+                                 f"{self.directory}")
+            target.load(path)
+            return step
+        latest = self.latest()
+        if latest is None:
+            return 0
+        target.load(latest[1])
+        return latest[0]
+
+    def _prune(self):
+        cps = self.checkpoints()
+        for _, path in cps[:-self.keep]:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass

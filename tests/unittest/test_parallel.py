@@ -205,3 +205,89 @@ def test_sharded_train_step_checkpoint_resume_bitexact(tmp_path):
 
     assert_almost_equal(onp.asarray(losses_b), onp.asarray(losses_a[2:]),
                         rtol=1e-6, atol=1e-7)
+
+
+def test_checkpoint_manager_resume(tmp_path):
+    """CheckpointManager + ShardedTrainStep: crash/restart resumes from the
+    newest complete checkpoint with keep-K pruning (SURVEY.md §5.3)."""
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu.utils import CheckpointManager
+
+    rng = onp.random.RandomState(3)
+    batches = [(rng.standard_normal((4, 5)).astype(onp.float32),
+                rng.standard_normal((4, 2)).astype(onp.float32))
+               for _ in range(5)]
+
+    def build():
+        onp.random.seed(5)
+        net = nn.Dense(2, in_units=5)
+        net.initialize()
+        return net
+
+    def loss_fn(out, x, y):
+        return jnp.mean((out - y) ** 2)
+
+    def make_step(net):
+        mesh = make_mesh({"dp": 2}, _cpu_devices(2))
+        return make_sharded_train_step(net, opt.SGD(learning_rate=0.1),
+                                       loss_fn, mesh, num_model_args=1)
+
+    mgr = CheckpointManager(str(tmp_path), keep=2)
+    assert mgr.restore(make_step(build())) == 0  # fresh start
+
+    step_a = make_step(build())
+    losses_a = []
+    for i, (x, y) in enumerate(batches):
+        losses_a.append(float(step_a(mx.np.array(x), mx.np.array(y))))
+        mgr.maybe_save(step_a, i + 1, every=1)
+    # keep=2: only steps 4 and 5 remain
+    assert [s for s, _ in mgr.checkpoints()] == [4, 5]
+
+    # "crash": fresh process state, restore latest, replay nothing
+    step_b = make_step(build())
+    resumed = mgr.restore(step_b)
+    assert resumed == 5
+    for n in step_b.param_names:
+        onp.testing.assert_array_equal(onp.asarray(step_b.pvals[n]),
+                                       onp.asarray(step_a.pvals[n]))
+    # restoring an explicit earlier step works too
+    step_c = make_step(build())
+    assert mgr.restore(step_c, step=4) == 4
+
+
+def test_parameter_sharding_annotation_wins(caplog):
+    """Explicit Parameter(sharding=...) beats the rules table; a large
+    unmatched param logs a replication warning instead of silent
+    fall-through (round-1 verdict weak #8)."""
+    import logging
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import Parameter
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu import optimizer as opt
+    from jax.sharding import PartitionSpec as P
+
+    class Oddly(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            # name matches no TP rule; explicit annotation shards dim 0
+            self.mystery = Parameter("mystery", shape=(8, 4),
+                                     sharding=("tp", None))
+            # large param, no rule, no annotation -> warning
+            self.blob = Parameter("blob", shape=(1000, 1001))
+
+        def forward(self, x):
+            return x @ self.mystery.data() + self.blob.data().sum() * 0.0
+
+    net = Oddly()
+    net.initialize()
+    mesh = make_mesh({"dp": 2, "tp": 2}, _cpu_devices(4))
+    with caplog.at_level(logging.WARNING):
+        step = make_sharded_train_step(
+            net, opt.SGD(learning_rate=0.1),
+            lambda out, x, y: jnp.mean((out - y) ** 2), mesh,
+            num_model_args=1)
+    name = [n for n in step.param_names if "mystery" in n][0]
+    assert step.param_shardings[name].spec == P("tp", None)
+    assert any("blob" in r.message and "REPLICATED" in r.message
+               for r in caplog.records)
