@@ -31,3 +31,10 @@ del _populate
 
 
 from . import sparse  # noqa: E402,F401  (mx.nd.sparse namespace)
+
+# the legacy operator tail overrides np-style names where the 1.x
+# semantics differ (split's axis=1 default, reshape special codes,
+# argmax returning float32, ...) — mx.nd IS the legacy surface; use
+# mx.np for numpy semantics
+from .legacy_ops import *  # noqa: E402,F401,F403
+from . import legacy_ops as op  # noqa: E402,F401  (mx.nd.op alias)

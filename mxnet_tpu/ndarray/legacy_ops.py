@@ -1,0 +1,1100 @@
+"""Legacy `mx.nd` operator tail (parity: the pre-numpy op namespace over
+`src/operator/tensor/` + `src/operator/nn/` — `elemwise_*`, `broadcast_*`,
+CamelCase layer ops, `reshape` special codes, `slice_axis`, `batch_dot`,
+`SoftmaxOutput`, fused optimizer update kernels `src/operator/optimizer_op.cc`).
+
+These are the names 1.x-era user code calls; each lowers to the same XLA
+paths as the `mx.np`/`mx.npx` front ends. Gradients flow through `apply_op`
+like every other op.
+"""
+from __future__ import annotations
+
+import builtins
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as _onp
+
+from ..base import MXNetError
+from .ndarray import ndarray, apply_op, _write_out
+
+__all__ = [
+    # elemwise / broadcast
+    "elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
+    "broadcast_add", "broadcast_plus", "broadcast_sub", "broadcast_minus",
+    "broadcast_mul", "broadcast_div", "broadcast_mod", "broadcast_power",
+    "broadcast_maximum", "broadcast_minimum", "broadcast_hypot",
+    "broadcast_equal", "broadcast_not_equal", "broadcast_greater",
+    "broadcast_greater_equal", "broadcast_lesser", "broadcast_lesser_equal",
+    "broadcast_logical_and", "broadcast_logical_or", "broadcast_logical_xor",
+    "broadcast_axis", "broadcast_axes", "add_n", "ElementWiseSum",
+    # structure
+    "Flatten", "flatten", "Reshape", "reshape", "transpose", "SwapAxis",
+    "swapaxes", "expand_dims", "Concat", "concat", "SliceChannel", "split",
+    "slice", "slice_axis", "slice_like", "reverse", "flip", "tile", "repeat",
+    "Pad", "pad", "stack", "squeeze",
+    # indexing
+    "take", "batch_take", "one_hot", "pick", "gather_nd", "scatter_nd",
+    "where", "Embedding",
+    # reduce / sort
+    "sum", "sum_axis", "nansum", "prod", "nanprod", "mean", "max", "min",
+    "max_axis", "min_axis", "norm", "argmax", "argmin", "argmax_channel",
+    "sort", "argsort", "topk", "shuffle",
+    # math
+    "dot", "batch_dot", "khatri_rao", "L2Normalization", "smooth_l1",
+    "identity", "BlockGrad", "stop_gradient", "make_loss", "MakeLoss",
+    "clip", "Cast", "cast", "negative", "reciprocal", "rsqrt", "rcbrt",
+    "square_root",
+    # layers
+    "Activation", "LeakyReLU", "FullyConnected", "Convolution",
+    "Deconvolution", "BatchNorm", "LayerNorm", "InstanceNorm", "GroupNorm",
+    "Pooling", "Dropout", "RNN", "SoftmaxOutput", "softmax", "log_softmax",
+    "SoftmaxActivation", "UpSampling", "SequenceMask", "SequenceLast",
+    "SequenceReverse", "Custom",
+    # random / samplers
+    "random_uniform", "random_normal", "random_gamma", "random_exponential",
+    "random_poisson", "random_negative_binomial", "random_randint",
+    "sample_uniform", "sample_normal", "sample_gamma", "sample_multinomial",
+    "uniform", "normal",
+    # optimizer update kernels
+    "sgd_update", "sgd_mom_update", "adam_update", "rmsprop_update",
+    "rmspropalex_update", "ftrl_update", "signsgd_update", "signum_update",
+    "nag_mom_update", "mp_sgd_update", "mp_sgd_mom_update",
+    # linalg (legacy naming)
+    "linalg_gemm", "linalg_gemm2", "linalg_potrf", "linalg_trsm",
+    "linalg_trmm", "linalg_syrk", "linalg_sumlogdiag", "linalg_extractdiag",
+    "linalg_makediag",
+]
+
+
+def _v(x):
+    return x._data if isinstance(x, ndarray) else jnp.asarray(x)
+
+
+def _op(fn, *arrs, name="op", out=None, **kw):
+    arr_objs = [a if isinstance(a, ndarray) else ndarray(jnp.asarray(a))
+                for a in arrs]
+    r = apply_op(fn, arr_objs, kw, name=name)
+    return _write_out(r, out)
+
+
+# ---------------------------------------------------------------------------
+# elemwise / broadcast
+# ---------------------------------------------------------------------------
+
+def _binary(jfn, name):
+    def op(lhs, rhs, out=None, **kw):
+        return _op(lambda a, b: jfn(a, b), lhs, rhs, name=name, out=out)
+    op.__name__ = name
+    return op
+
+
+elemwise_add = _binary(jnp.add, "elemwise_add")
+elemwise_sub = _binary(jnp.subtract, "elemwise_sub")
+elemwise_mul = _binary(jnp.multiply, "elemwise_mul")
+elemwise_div = _binary(jnp.divide, "elemwise_div")
+broadcast_add = broadcast_plus = _binary(jnp.add, "broadcast_add")
+broadcast_sub = broadcast_minus = _binary(jnp.subtract, "broadcast_sub")
+broadcast_mul = _binary(jnp.multiply, "broadcast_mul")
+broadcast_div = _binary(jnp.divide, "broadcast_div")
+broadcast_mod = _binary(jnp.mod, "broadcast_mod")
+broadcast_power = _binary(jnp.power, "broadcast_power")
+broadcast_maximum = _binary(jnp.maximum, "broadcast_maximum")
+broadcast_minimum = _binary(jnp.minimum, "broadcast_minimum")
+broadcast_hypot = _binary(jnp.hypot, "broadcast_hypot")
+
+
+def _binary_cmp(jfn, name):
+    def op(lhs, rhs, out=None):
+        return _op(lambda a, b: jfn(a, b).astype(a.dtype), lhs, rhs,
+                   name=name, out=out)
+    op.__name__ = name
+    return op
+
+
+broadcast_equal = _binary_cmp(jnp.equal, "broadcast_equal")
+broadcast_not_equal = _binary_cmp(jnp.not_equal, "broadcast_not_equal")
+broadcast_greater = _binary_cmp(jnp.greater, "broadcast_greater")
+broadcast_greater_equal = _binary_cmp(jnp.greater_equal,
+                                      "broadcast_greater_equal")
+broadcast_lesser = _binary_cmp(jnp.less, "broadcast_lesser")
+broadcast_lesser_equal = _binary_cmp(jnp.less_equal, "broadcast_lesser_equal")
+broadcast_logical_and = _binary_cmp(jnp.logical_and, "broadcast_logical_and")
+broadcast_logical_or = _binary_cmp(jnp.logical_or, "broadcast_logical_or")
+broadcast_logical_xor = _binary_cmp(jnp.logical_xor, "broadcast_logical_xor")
+
+
+def broadcast_axis(data, axis=None, size=None, out=None):
+    """Broadcast size-1 axes to `size` (parity: broadcast_axis)."""
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+
+    def fn(x):
+        shape = list(x.shape)
+        for a, s in zip(axes, sizes):
+            shape[a] = s
+        return jnp.broadcast_to(x, shape)
+    return _op(fn, data, name="broadcast_axis", out=out)
+
+
+broadcast_axes = broadcast_axis
+
+
+def add_n(*args, out=None):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+
+    def fn(*xs):
+        acc = xs[0]
+        for x in xs[1:]:
+            acc = acc + x
+        return acc
+    return _op(fn, *args, name="add_n", out=out)
+
+
+ElementWiseSum = add_n
+
+
+# ---------------------------------------------------------------------------
+# structure
+# ---------------------------------------------------------------------------
+
+def _resolve_reshape_spec(in_shape, spec):
+    """Pure shape math for legacy reshape codes: 0 copies the input dim,
+    -1 infers, -2 copies all remaining, -3 merges two dims, -4 splits a
+    dim into the next two values."""
+    new_shape = []
+    i = 0  # input dim cursor
+    spec = list(spec)
+    j = 0
+    while j < len(spec):
+        s = spec[j]
+        if s == 0:
+            new_shape.append(in_shape[i])
+            i += 1
+        elif s == -1:
+            new_shape.append(-1)
+            i += 1
+        elif s == -2:
+            new_shape.extend(in_shape[i:])
+            i = len(in_shape)
+        elif s == -3:
+            new_shape.append(in_shape[i] * in_shape[i + 1])
+            i += 2
+        elif s == -4:
+            d1, d2 = spec[j + 1], spec[j + 2]
+            if d1 == -1:
+                d1 = in_shape[i] // d2
+            if d2 == -1:
+                d2 = in_shape[i] // d1
+            new_shape.extend([d1, d2])
+            i += 1
+            j += 2
+        else:
+            new_shape.append(s)
+            i += 1
+        j += 1
+    return tuple(new_shape)
+
+
+def reshape(data, shape=None, reverse=False, out=None, **kw):
+    """Legacy reshape with special codes (parity:
+    `src/operator/tensor/matrix_op.cc` Reshape; see
+    `_resolve_reshape_spec`). `reverse=True` applies the spec
+    right-to-left."""
+    if shape is None:
+        shape = kw.get("target_shape")
+    in_shape = tuple(data.shape)
+    if reverse:
+        rev = list(_resolve_reshape_spec(in_shape[::-1], tuple(shape)[::-1]))
+        if -1 in rev:   # infer against the total element count
+            total = 1
+            for d in in_shape:
+                total *= d
+            known = 1
+            for d in rev:
+                if d != -1:
+                    known *= d
+            rev[rev.index(-1)] = total // builtins.max(known, 1)
+        ns = tuple(rev)[::-1]
+    else:
+        ns = _resolve_reshape_spec(in_shape, shape)
+    return _op(lambda x: jnp.reshape(x, ns), data, name="reshape", out=out)
+
+
+Reshape = reshape
+
+
+def Flatten(data, out=None):
+    return _op(lambda x: jnp.reshape(x, (x.shape[0], -1)), data,
+               name="flatten", out=out)
+
+
+flatten = Flatten
+
+
+def transpose(data, axes=None, out=None):
+    ax = tuple(axes) if axes else None
+    return _op(lambda x: jnp.transpose(x, ax), data, name="transpose",
+               out=out)
+
+
+def SwapAxis(data, dim1=0, dim2=0, out=None):
+    return _op(lambda x: jnp.swapaxes(x, dim1, dim2), data, name="swapaxes",
+               out=out)
+
+
+swapaxes = SwapAxis
+
+
+def expand_dims(data, axis, out=None):
+    return _op(lambda x: jnp.expand_dims(x, axis), data, name="expand_dims",
+               out=out)
+
+
+def concat(*args, dim=1, out=None):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return _op(lambda *xs: jnp.concatenate(xs, axis=dim), *args,
+               name="concat", out=out)
+
+
+Concat = concat
+
+
+def split(data, num_outputs=None, axis=1, squeeze_axis=False, out=None):
+    n = num_outputs
+
+    def fn(x):
+        parts = jnp.split(x, n, axis=axis)
+        if squeeze_axis:
+            parts = [jnp.squeeze(p, axis=axis) for p in parts]
+        return tuple(parts)
+    return _op(fn, data, name="split", out=out)
+
+
+SliceChannel = split
+
+
+def slice(data, begin, end, step=None, out=None):  # noqa: A001
+    begin, end = tuple(begin), tuple(end)
+    step = tuple(step) if step is not None else (1,) * len(begin)
+
+    def fn(x):
+        idx = tuple(builtins.slice(b, e, s if s else 1)
+                    for b, e, s in zip(begin, end, step))
+        return x[idx]
+    return _op(fn, data, name="slice", out=out)
+
+
+def slice_axis(data, axis, begin, end, out=None):
+    def fn(x):
+        e = end if end is not None else x.shape[axis]
+        idx = [builtins.slice(None)] * x.ndim
+        idx[axis] = builtins.slice(begin, e)
+        return x[tuple(idx)]
+    return _op(fn, data, name="slice_axis", out=out)
+
+
+def slice_like(data, shape_like, axes=None, out=None):
+    def fn(x, ref):
+        idx = [builtins.slice(None)] * x.ndim
+        dims = axes if axes else range(builtins.min(x.ndim, ref.ndim))
+        for a in dims:
+            idx[a] = builtins.slice(0, ref.shape[a])
+        return x[tuple(idx)]
+    return _op(fn, data, shape_like, name="slice_like", out=out)
+
+
+def reverse(data, axis=0, out=None):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    return _op(lambda x: jnp.flip(x, axes), data, name="reverse", out=out)
+
+
+flip = reverse
+
+
+def tile(data, reps, out=None):
+    return _op(lambda x: jnp.tile(x, tuple(reps)), data, name="tile", out=out)
+
+
+def repeat(data, repeats, axis=None, out=None):
+    return _op(lambda x: jnp.repeat(x, repeats, axis=axis), data,
+               name="repeat", out=out)
+
+
+def pad(data, mode="constant", pad_width=None, constant_value=0, out=None):
+    """Legacy Pad: pad_width is the flat (before, after) per-dim list the
+    reference uses (NCHW: 8 values)."""
+    pw = list(pad_width)
+    pairs = [(pw[i], pw[i + 1]) for i in range(0, len(pw), 2)]
+    jmode = {"constant": "constant", "edge": "edge",
+             "reflect": "reflect"}[mode]
+
+    def fn(x):
+        if jmode == "constant":
+            return jnp.pad(x, pairs, constant_values=constant_value)
+        return jnp.pad(x, pairs, mode=jmode)
+    return _op(fn, data, name="pad", out=out)
+
+
+Pad = pad
+
+
+def stack(*args, axis=0, out=None):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return _op(lambda *xs: jnp.stack(xs, axis=axis), *args, name="stack",
+               out=out)
+
+
+def squeeze(data, axis=None, out=None):
+    return _op(lambda x: jnp.squeeze(x, axis), data, name="squeeze", out=out)
+
+
+# ---------------------------------------------------------------------------
+# indexing
+# ---------------------------------------------------------------------------
+
+def take(a, indices, axis=0, mode="clip", out=None):
+    return _op(lambda x, i: jnp.take(x, i.astype(jnp.int32), axis=axis,
+                                     mode="clip" if mode != "wrap" else "wrap"),
+               a, indices, name="take", out=out)
+
+
+def batch_take(a, indices, out=None):
+    return _op(lambda x, i: jnp.take_along_axis(
+        x, i.astype(jnp.int32)[..., None], axis=-1)[..., 0],
+        a, indices, name="batch_take", out=out)
+
+
+def where(condition, x, y, out=None):
+    return _op(lambda c, a, b: jnp.where(c.astype(bool), a, b),
+               condition, x, y, name="where", out=out)
+
+
+def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype="float32",
+            out=None):
+    from ..numpy_extension import one_hot as _oh
+    r = _oh(indices if isinstance(indices, ndarray) else ndarray(_v(indices)),
+            depth, on_value, off_value, dtype)
+    return _write_out(r, out)
+
+
+def pick(data, index, axis=-1, keepdims=False, out=None):
+    from ..numpy_extension import pick as _pick
+    return _write_out(_pick(data, index, axis=axis, keepdims=keepdims), out)
+
+
+def gather_nd(data, indices, out=None):
+    from ..numpy_extension import gather_nd as _g
+    return _write_out(_g(data, indices), out)
+
+
+def scatter_nd(data, indices, shape, out=None):
+    from ..numpy_extension import scatter_nd as _s
+    return _write_out(_s(data, indices, shape), out)
+
+
+def Embedding(data, weight, input_dim=None, output_dim=None,
+              dtype="float32", sparse_grad=False, out=None):
+    from ..numpy_extension import embedding as _e
+    return _write_out(_e(data, weight, input_dim, output_dim,
+                         dtype=dtype, sparse_grad=sparse_grad), out)
+
+
+# ---------------------------------------------------------------------------
+# reductions / sorting
+# ---------------------------------------------------------------------------
+
+def _reduce(jfn, name):
+    def op(data, axis=None, keepdims=False, out=None, exclude=False, **kw):
+        ax = axis
+        if exclude and ax is not None:
+            axes = (ax,) if isinstance(ax, int) else tuple(ax)
+            ax = tuple(i for i in range(data.ndim) if i not in axes)
+        return _op(lambda x: jfn(x, axis=ax, keepdims=keepdims), data,
+                   name=name, out=out)
+    op.__name__ = name
+    return op
+
+
+sum = _reduce(jnp.sum, "sum")           # noqa: A001
+sum_axis = sum
+nansum = _reduce(jnp.nansum, "nansum")
+prod = _reduce(jnp.prod, "prod")
+nanprod = _reduce(jnp.nanprod, "nanprod")
+mean = _reduce(jnp.mean, "mean")
+max = _reduce(jnp.max, "max")           # noqa: A001
+min = _reduce(jnp.min, "min")           # noqa: A001
+max_axis = max
+min_axis = min
+
+
+def norm(data, ord=2, axis=None, keepdims=False, out=None):  # noqa: A002
+    """Legacy nd.norm: with axis=None this is the ELEMENTWISE L-ord norm
+    of the flattened tensor (never the spectral norm)."""
+    def fn(x):
+        if axis is not None:
+            return jnp.linalg.norm(x, ord=ord, axis=axis, keepdims=keepdims)
+        flat = x.reshape(-1)
+        r = jnp.linalg.norm(flat, ord=ord)
+        return r.reshape((1,) * x.ndim) if keepdims else r
+    return _op(fn, data, name="norm", out=out)
+
+
+def argmax(data, axis=None, keepdims=False, out=None):
+    return _op(lambda x: jnp.argmax(x, axis=axis, keepdims=keepdims)
+               .astype(jnp.float32), data, name="argmax", out=out)
+
+
+def argmin(data, axis=None, keepdims=False, out=None):
+    return _op(lambda x: jnp.argmin(x, axis=axis, keepdims=keepdims)
+               .astype(jnp.float32), data, name="argmin", out=out)
+
+
+def argmax_channel(data, out=None):
+    return _op(lambda x: jnp.argmax(x, axis=1).astype(jnp.float32), data,
+               name="argmax_channel", out=out)
+
+
+def sort(data, axis=-1, is_ascend=True, out=None):
+    def fn(x):
+        s = jnp.sort(x, axis=axis)
+        return s if is_ascend else jnp.flip(s, axis=axis)
+    return _op(fn, data, name="sort", out=out)
+
+
+def argsort(data, axis=-1, is_ascend=True, dtype="float32", out=None):
+    def fn(x):
+        s = jnp.argsort(x, axis=axis)
+        if not is_ascend:
+            s = jnp.flip(s, axis=axis)
+        return s.astype(jnp.dtype(dtype))
+    return _op(fn, data, name="argsort", out=out)
+
+
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32",
+         out=None):
+    from ..numpy_extension import topk as _topk
+    return _write_out(_topk(data, axis=axis, k=k, ret_typ=ret_typ,
+                            is_ascend=is_ascend, dtype=dtype), out)
+
+
+def shuffle(data, out=None):
+    from .. import random as _rng
+    k = _rng.next_key()
+    return _op(lambda x: jax.random.permutation(k, x, axis=0,
+                                                independent=False),
+               data, name="shuffle", out=out)
+
+
+# ---------------------------------------------------------------------------
+# math
+# ---------------------------------------------------------------------------
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False, out=None):
+    def fn(a, b):
+        if transpose_a:
+            a = a.T if a.ndim == 2 else jnp.moveaxis(a, 0, -1)
+        if transpose_b:
+            b = b.T if b.ndim == 2 else jnp.moveaxis(b, -1, 0)
+        return jnp.dot(a, b)
+    return _op(fn, lhs, rhs, name="dot", out=out)
+
+
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False, out=None):
+    def fn(a, b):
+        if transpose_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+    return _op(fn, lhs, rhs, name="batch_dot", out=out)
+
+
+def khatri_rao(*args, out=None):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+
+    def fn(*ms):
+        acc = ms[0]
+        for m in ms[1:]:
+            acc = jnp.einsum("i...,j...->ij...", acc, m).reshape(
+                (-1,) + acc.shape[1:])
+        return acc
+    return _op(fn, *args, name="khatri_rao", out=out)
+
+
+def L2Normalization(data, eps=1e-10, mode="instance", out=None):
+    from ..numpy_extension import l2_normalization as _l2
+    return _write_out(_l2(data, eps=eps, mode=mode), out)
+
+
+def smooth_l1(data, scalar=1.0, out=None):
+    s2 = scalar * scalar
+
+    def fn(x):
+        return jnp.where(jnp.abs(x) < 1.0 / s2, 0.5 * s2 * x * x,
+                         jnp.abs(x) - 0.5 / s2)
+    return _op(fn, data, name="smooth_l1", out=out)
+
+
+def identity(data, out=None):
+    return _op(lambda x: x, data, name="identity", out=out)
+
+
+def BlockGrad(data, out=None):
+    return _op(jax.lax.stop_gradient, data, name="stop_gradient", out=out)
+
+
+stop_gradient = BlockGrad
+
+
+def make_loss(data, grad_scale=1.0, out=None):
+    return _op(lambda x: x * grad_scale if grad_scale != 1.0 else x, data,
+               name="make_loss", out=out)
+
+
+MakeLoss = make_loss
+
+
+def clip(data, a_min, a_max, out=None):
+    return _op(lambda x: jnp.clip(x, a_min, a_max), data, name="clip",
+               out=out)
+
+
+def cast(data, dtype, out=None):
+    return _op(lambda x: x.astype(jnp.dtype(dtype)), data, name="cast",
+               out=out)
+
+
+Cast = cast
+
+
+def negative(data, out=None):
+    return _op(jnp.negative, data, name="negative", out=out)
+
+
+def reciprocal(data, out=None):
+    return _op(jnp.reciprocal, data, name="reciprocal", out=out)
+
+
+def rsqrt(data, out=None):
+    return _op(jax.lax.rsqrt, data, name="rsqrt", out=out)
+
+
+def rcbrt(data, out=None):
+    return _op(lambda x: 1.0 / jnp.cbrt(x), data, name="rcbrt", out=out)
+
+
+def square_root(data, out=None):
+    return _op(jnp.sqrt, data, name="sqrt", out=out)
+
+
+# ---------------------------------------------------------------------------
+# layers (CamelCase legacy API over npx)
+# ---------------------------------------------------------------------------
+
+def Activation(data, act_type="relu", out=None):
+    from ..numpy_extension import activation as _a
+    return _write_out(_a(data, act_type=act_type), out)
+
+
+def LeakyReLU(data, gamma=None, act_type="leaky", slope=0.25,
+              lower_bound=0.125, upper_bound=0.334, out=None):
+    from ..numpy_extension import leaky_relu as _l
+    return _write_out(_l(data, gamma, act_type=act_type, slope=slope,
+                         lower_bound=lower_bound, upper_bound=upper_bound),
+                      out)
+
+
+def FullyConnected(data, weight, bias=None, num_hidden=None, no_bias=False,
+                   flatten=True, out=None):
+    from ..numpy_extension import fully_connected as _fc
+    return _write_out(_fc(data, weight, bias, num_hidden=num_hidden,
+                          no_bias=no_bias, flatten=flatten), out)
+
+
+def Convolution(data, weight, bias=None, kernel=None, stride=None,
+                dilate=None, pad=None, num_filter=None, num_group=1,
+                no_bias=False, layout=None, out=None, **kw):
+    from ..numpy_extension import convolution as _conv
+    return _write_out(_conv(data, weight, bias, kernel=kernel,
+                            stride=stride, dilate=dilate, pad=pad,
+                            num_filter=num_filter, num_group=num_group,
+                            no_bias=no_bias), out)
+
+
+def Deconvolution(data, weight, bias=None, kernel=None, stride=None,
+                  dilate=None, pad=None, adj=None, num_filter=None,
+                  num_group=1, no_bias=True, out=None, **kw):
+    from ..numpy_extension import deconvolution as _dc
+    return _write_out(_dc(data, weight, bias, kernel=kernel, stride=stride,
+                          dilate=dilate, pad=pad, adj=adj,
+                          num_filter=num_filter, num_group=num_group,
+                          no_bias=no_bias), out)
+
+
+def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+              momentum=0.9, fix_gamma=True, use_global_stats=False,
+              axis=1, out=None, **kw):
+    from ..numpy_extension import batch_norm as _bn
+    return _write_out(_bn(data, gamma, beta, moving_mean, moving_var,
+                          eps=eps, momentum=momentum, fix_gamma=fix_gamma,
+                          use_global_stats=use_global_stats, axis=axis), out)
+
+
+def LayerNorm(data, gamma, beta, axis=-1, eps=1e-5, out=None):
+    from ..numpy_extension import layer_norm as _ln
+    return _write_out(_ln(data, gamma, beta, axis=axis, eps=eps), out)
+
+
+def InstanceNorm(data, gamma, beta, eps=1e-3, out=None):
+    from ..numpy_extension import instance_norm as _in
+    return _write_out(_in(data, gamma, beta, eps=eps), out)
+
+
+def GroupNorm(data, gamma, beta, num_groups=1, eps=1e-5, out=None):
+    from ..numpy_extension import group_norm as _gn
+    return _write_out(_gn(data, gamma, beta, num_groups=num_groups,
+                          eps=eps), out)
+
+
+def Pooling(data, kernel=None, pool_type="max", global_pool=False,
+            stride=None, pad=None, pooling_convention="valid",
+            count_include_pad=True, out=None, **kw):
+    from ..numpy_extension import pooling as _p
+    return _write_out(_p(data, kernel=kernel, pool_type=pool_type,
+                         global_pool=global_pool, stride=stride, pad=pad,
+                         pooling_convention=pooling_convention,
+                         count_include_pad=count_include_pad), out)
+
+
+def Dropout(data, p=0.5, mode="training", out=None, **kw):
+    from ..numpy_extension import dropout as _d
+    return _write_out(_d(data, p=p, mode=mode), out)
+
+
+def RNN(data, parameters, state, state_cell=None, state_size=None,
+        num_layers=1, mode="lstm", bidirectional=False, p=0.0,
+        state_outputs=False, out=None, **kw):
+    from ..numpy_extension import rnn as _rnn
+    return _write_out(_rnn(data=data, parameters=parameters, state=state,
+                           state_cell=state_cell, state_size=state_size,
+                           num_layers=num_layers, mode=mode,
+                           bidirectional=bidirectional, p=p,
+                           state_outputs=state_outputs), out)
+
+
+def softmax(data, axis=-1, temperature=None, out=None, **kw):
+    from ..numpy_extension import softmax as _s
+    return _write_out(_s(data, axis=axis, temperature=temperature), out)
+
+
+def log_softmax(data, axis=-1, temperature=None, out=None, **kw):
+    from ..numpy_extension import log_softmax as _ls
+    return _write_out(_ls(data, axis=axis, temperature=temperature), out)
+
+
+def SoftmaxActivation(data, mode="instance", out=None):
+    axis = -1 if mode == "instance" else 1
+    return softmax(data, axis=axis, out=out)
+
+
+def SoftmaxOutput(data, label, grad_scale=1.0, ignore_label=-1,
+                  use_ignore=False, multi_output=False,
+                  preserve_shape=False, normalization="null",
+                  out_grad=False, smooth_alpha=0.0, out=None):
+    """Forward = softmax; backward = (softmax - onehot(label)) * scale
+    (parity: `src/operator/softmax_output.cc:166`). Implemented as a
+    custom-VJP op so legacy training loops get the fused gradient."""
+    axis = 1 if multi_output else -1
+
+    @jax.custom_vjp
+    def _so(x, lbl):
+        return jax.nn.softmax(x, axis=axis)
+
+    def _fwd(x, lbl):
+        p = jax.nn.softmax(x, axis=axis)
+        return p, (p, lbl)
+
+    def _bwd(res, g):
+        p, lbl = res
+        n_class = p.shape[axis]
+        oh = jax.nn.one_hot(lbl.astype(jnp.int32), n_class,
+                            dtype=p.dtype)
+        if axis == 1 and p.ndim > 2:
+            oh = jnp.moveaxis(oh, -1, 1)
+        grad = (p - oh) * grad_scale
+        if use_ignore:
+            mask = (lbl != ignore_label)
+            if axis == 1 and p.ndim > 2:   # (n, L...) labels, class axis 1
+                grad = grad * jnp.expand_dims(mask, 1).astype(p.dtype)
+            else:
+                grad = grad * mask[..., None].astype(p.dtype)
+        if normalization == "batch":
+            grad = grad / p.shape[0]
+        elif normalization == "valid" and use_ignore:
+            nvalid = jnp.maximum(jnp.sum(lbl != ignore_label), 1)
+            grad = grad / nvalid.astype(p.dtype)
+        return grad, None
+
+    _so.defvjp(_fwd, _bwd)
+    return _op(lambda x, l: _so(x, l), data, label, name="SoftmaxOutput",
+               out=out)
+
+
+def UpSampling(data, scale=2, sample_type="nearest", num_args=1, out=None,
+               **kw):
+    def fn(x):
+        if sample_type == "nearest":
+            return jnp.repeat(jnp.repeat(x, scale, axis=-2), scale, axis=-1)
+        n, c, h, w = x.shape
+        return jax.image.resize(x, (n, c, h * scale, w * scale), "bilinear")
+    return _op(fn, data, name="upsampling", out=out)
+
+
+def SequenceMask(data, sequence_length=None, use_sequence_length=False,
+                 value=0.0, axis=0, out=None):
+    from ..numpy_extension import sequence_mask as _sm
+    return _write_out(_sm(data, sequence_length,
+                          use_sequence_length=use_sequence_length,
+                          value=value, axis=axis), out)
+
+
+def SequenceLast(data, sequence_length=None, use_sequence_length=False,
+                 axis=0, out=None):
+    def fn(x, *ln):
+        if not ln:
+            idx = x.shape[axis] - 1
+            return jnp.take(x, idx, axis=axis)
+        t = (ln[0].astype(jnp.int32) - 1)
+        moved = jnp.moveaxis(x, axis, 0)   # (seq, batch, ...)
+        return jnp.take_along_axis(
+            moved, t.reshape((1, -1) + (1,) * (moved.ndim - 2)), axis=0)[0]
+    args = (data, sequence_length) if use_sequence_length else (data,)
+    return _op(fn, *args, name="sequence_last", out=out)
+
+
+def SequenceReverse(data, sequence_length=None, use_sequence_length=False,
+                    axis=0, out=None):
+    def fn(x, *ln):
+        if not ln:
+            return jnp.flip(x, axis)
+        moved = jnp.moveaxis(x, axis, 0)
+        seq = moved.shape[0]
+        lens = ln[0].astype(jnp.int32)
+        idx = jnp.arange(seq)[:, None]                       # (seq, 1)
+        rev = jnp.where(idx < lens[None, :], lens[None, :] - 1 - idx, idx)
+        gathered = jnp.take_along_axis(
+            moved, rev.reshape(rev.shape + (1,) * (moved.ndim - 2)), axis=0)
+        return jnp.moveaxis(gathered, 0, axis)
+    args = (data, sequence_length) if use_sequence_length else (data,)
+    return _op(fn, *args, name="sequence_reverse", out=out)
+
+
+def Custom(*args, op_type=None, out=None, **kw):
+    from ..operator import custom as _custom
+    return _write_out(_custom(*args, op_type=op_type, **kw), out)
+
+
+# ---------------------------------------------------------------------------
+# random / samplers (legacy names)
+# ---------------------------------------------------------------------------
+
+def _legacy_random(sampler_name):
+    def op(*args, shape=None, dtype="float32", out=None, **kw):
+        from ..numpy import random as _r
+        fn = getattr(_r, sampler_name)
+        r = fn(*args, size=shape, **kw)
+        if dtype and str(r.dtype) != dtype:
+            r = r.astype(dtype)
+        return _write_out(r, out)
+    op.__name__ = "random_" + sampler_name
+    return op
+
+
+random_uniform = uniform = _legacy_random("uniform")
+random_normal = normal = _legacy_random("normal")
+random_gamma = _legacy_random("gamma")
+random_exponential = _legacy_random("exponential")
+random_poisson = _legacy_random("poisson")
+random_randint = _legacy_random("randint")
+
+
+def random_negative_binomial(k=1, p=1, shape=None, dtype="float32", out=None):
+    from .. import random as _rng
+    key = _rng.next_key()
+    lam = jax.random.gamma(key, k, shape=shape or ()) * (1 - p) / p
+    r = jax.random.poisson(jax.random.fold_in(key, 1), lam)
+    return _write_out(ndarray(r.astype(jnp.dtype(dtype))), out)
+
+
+def _sample(sampler_name):
+    """sample_* draws one sample per parameter row (parity:
+    `src/operator/random/multisample_op.cc`)."""
+    def op(*params, shape=None, dtype="float32", out=None):
+        from ..numpy import random as _r
+        fn = getattr(_r, sampler_name)
+        pvals = [(p.asnumpy() if isinstance(p, ndarray) else _onp.asarray(p))
+                 for p in params]
+        n = pvals[0].shape[0] if pvals and pvals[0].ndim else 1
+        extra = tuple(shape) if shape else ()
+        rows = []
+        for i in range(n):
+            args_i = [pv[i] if pv.ndim else pv for pv in pvals]
+            rows.append(fn(*[float(a) for a in args_i],
+                           size=extra or None)._data)
+        r = jnp.stack(rows)
+        return _write_out(ndarray(r.astype(jnp.dtype(dtype))), out)
+    op.__name__ = "sample_" + sampler_name
+    return op
+
+
+sample_uniform = _sample("uniform")
+sample_normal = _sample("normal")
+sample_gamma = _sample("gamma")
+
+
+def sample_multinomial(data, shape=None, get_prob=False, dtype="int32",
+                       out=None):
+    from .. import random as _rng
+    key = _rng.next_key()
+    p = _v(data)
+    n = int(_onp.prod(shape)) if shape else 1
+    logits = jnp.log(jnp.maximum(p, 1e-30))
+    if p.ndim == 1:
+        draws = jax.random.categorical(key, logits, shape=(n,))
+        r = draws.reshape(shape) if shape else draws[0]
+        logp = jnp.take(jax.nn.log_softmax(logits), r)
+    else:
+        draws = jax.random.categorical(key, logits[:, None, :],
+                                       axis=-1, shape=(p.shape[0], n))
+        r = draws.reshape((p.shape[0],) + tuple(shape)) if shape \
+            else draws[:, 0]
+        logp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1),
+            r.reshape(p.shape[0], -1), axis=-1).reshape(r.shape)
+    samples = _write_out(ndarray(r.astype(jnp.dtype(dtype))), out)
+    if get_prob:
+        return samples, ndarray(logp.astype(jnp.float32))
+    return samples
+
+
+# ---------------------------------------------------------------------------
+# optimizer update kernels (parity: `src/operator/optimizer_op.cc`)
+# ---------------------------------------------------------------------------
+
+def _apply_update(weight, new_w, out):
+    if out is not None:
+        out._data = new_w
+        return out
+    weight._data = new_w
+    return weight
+
+
+def _prep_grad(grad, rescale_grad, clip_gradient):
+    g = _v(grad) * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g
+
+
+def sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1, lazy_update=True, out=None):
+    g = _prep_grad(grad, rescale_grad, clip_gradient) + wd * _v(weight)
+    return _apply_update(weight, _v(weight) - lr * g, out)
+
+
+def sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1, lazy_update=True,
+                   out=None):
+    g = _prep_grad(grad, rescale_grad, clip_gradient) + wd * _v(weight)
+    new_mom = momentum * _v(mom) - lr * g
+    mom._data = new_mom
+    return _apply_update(weight, _v(weight) + new_mom, out)
+
+
+def nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1, out=None):
+    g = _prep_grad(grad, rescale_grad, clip_gradient) + wd * _v(weight)
+    new_mom = momentum * _v(mom) + g
+    mom._data = new_mom
+    return _apply_update(weight,
+                         _v(weight) - lr * (g + momentum * new_mom), out)
+
+
+def adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1,
+                lazy_update=True, out=None):
+    g = _prep_grad(grad, rescale_grad, clip_gradient) + wd * _v(weight)
+    m = beta1 * _v(mean) + (1 - beta1) * g
+    v = beta2 * _v(var) + (1 - beta2) * g * g
+    mean._data = m
+    var._data = v
+    return _apply_update(weight,
+                         _v(weight) - lr * m / (jnp.sqrt(v) + epsilon), out)
+
+
+def rmsprop_update(weight, grad, n, lr=0.01, gamma1=0.95, epsilon=1e-8,
+                   wd=0.0, rescale_grad=1.0, clip_gradient=-1,
+                   clip_weights=-1, out=None):
+    g = _prep_grad(grad, rescale_grad, clip_gradient) + wd * _v(weight)
+    new_n = gamma1 * _v(n) + (1 - gamma1) * g * g
+    n._data = new_n
+    new_w = _v(weight) - lr * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return _apply_update(weight, new_w, out)
+
+
+def rmspropalex_update(weight, grad, n, g, delta, lr=0.01, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1, clip_weights=-1, out=None):
+    gr = _prep_grad(grad, rescale_grad, clip_gradient) + wd * _v(weight)
+    new_n = gamma1 * _v(n) + (1 - gamma1) * gr * gr
+    new_g = gamma1 * _v(g) + (1 - gamma1) * gr
+    new_d = gamma2 * _v(delta) - lr * gr / jnp.sqrt(
+        new_n - new_g * new_g + epsilon)
+    n._data, g._data, delta._data = new_n, new_g, new_d
+    new_w = _v(weight) + new_d
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return _apply_update(weight, new_w, out)
+
+
+def ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1, out=None):
+    g = _prep_grad(grad, rescale_grad, clip_gradient)
+    w = _v(weight)
+    new_n = _v(n) + g * g
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(_v(n))) / lr
+    new_z = _v(z) + g - sigma * w
+    z._data, n._data = new_z, new_n
+    new_w = jnp.where(
+        jnp.abs(new_z) <= lamda1, 0.0,
+        -(new_z - jnp.sign(new_z) * lamda1) /
+        ((beta + jnp.sqrt(new_n)) / lr + wd))
+    return _apply_update(weight, new_w, out)
+
+
+def signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1, out=None):
+    g = _prep_grad(grad, rescale_grad, clip_gradient)
+    return _apply_update(
+        weight, _v(weight) - lr * (jnp.sign(g) + wd * _v(weight)), out)
+
+
+def signum_update(weight, grad, mom, lr=0.01, momentum=0.9, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1, wd_lh=0.0, out=None):
+    g = _prep_grad(grad, rescale_grad, clip_gradient)
+    new_mom = momentum * _v(mom) - (1 - momentum) * g
+    mom._data = new_mom
+    return _apply_update(
+        weight, (1 - lr * wd_lh) * _v(weight) + lr * jnp.sign(new_mom), out)
+
+
+def mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1, out=None):
+    g = _prep_grad(grad, rescale_grad, clip_gradient).astype(jnp.float32)
+    w32 = _v(weight32) - lr * (g + wd * _v(weight32))
+    weight32._data = w32
+    return _apply_update(weight, w32.astype(_v(weight).dtype), out)
+
+
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1, out=None):
+    g = _prep_grad(grad, rescale_grad, clip_gradient).astype(jnp.float32)
+    g = g + wd * _v(weight32)
+    new_mom = momentum * _v(mom) - lr * g
+    mom._data = new_mom
+    w32 = _v(weight32) + new_mom
+    weight32._data = w32
+    return _apply_update(weight, w32.astype(_v(weight).dtype), out)
+
+
+# ---------------------------------------------------------------------------
+# linalg (legacy `linalg_*` names over jnp)
+# ---------------------------------------------------------------------------
+
+def linalg_gemm(A, B, C, alpha=1.0, beta=1.0, transpose_a=False,
+                transpose_b=False, out=None):
+    def fn(a, b, c):
+        if transpose_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return alpha * jnp.matmul(a, b) + beta * c
+    return _op(fn, A, B, C, name="linalg_gemm", out=out)
+
+
+def linalg_gemm2(A, B, alpha=1.0, transpose_a=False, transpose_b=False,
+                 out=None):
+    def fn(a, b):
+        if transpose_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return alpha * jnp.matmul(a, b)
+    return _op(fn, A, B, name="linalg_gemm2", out=out)
+
+
+def linalg_potrf(A, out=None):
+    return _op(jnp.linalg.cholesky, A, name="linalg_potrf", out=out)
+
+
+def linalg_trsm(A, B, alpha=1.0, transpose=False, rightside=False,
+                lower=True, out=None):
+    def fn(a, b):
+        aa = jnp.swapaxes(a, -1, -2) if transpose else a
+        if rightside:
+            x = jax.scipy.linalg.solve_triangular(
+                jnp.swapaxes(aa, -1, -2), jnp.swapaxes(b, -1, -2),
+                lower=not lower)
+            return alpha * jnp.swapaxes(x, -1, -2)
+        return alpha * jax.scipy.linalg.solve_triangular(aa, b, lower=lower)
+    return _op(fn, A, B, name="linalg_trsm", out=out)
+
+
+def linalg_trmm(A, B, alpha=1.0, transpose=False, rightside=False,
+                lower=True, out=None):
+    def fn(a, b):
+        tri = jnp.tril(a) if lower else jnp.triu(a)
+        if transpose:
+            tri = jnp.swapaxes(tri, -1, -2)
+        return alpha * (jnp.matmul(b, tri) if rightside
+                        else jnp.matmul(tri, b))
+    return _op(fn, A, B, name="linalg_trmm", out=out)
+
+
+def linalg_syrk(A, alpha=1.0, transpose=False, out=None):
+    def fn(a):
+        at = jnp.swapaxes(a, -1, -2)
+        return alpha * (jnp.matmul(at, a) if transpose
+                        else jnp.matmul(a, at))
+    return _op(fn, A, name="linalg_syrk", out=out)
+
+
+def linalg_sumlogdiag(A, out=None):
+    return _op(lambda a: jnp.sum(jnp.log(jnp.diagonal(
+        a, axis1=-2, axis2=-1)), axis=-1), A, name="linalg_sumlogdiag",
+        out=out)
+
+
+def linalg_extractdiag(A, offset=0, out=None):
+    return _op(lambda a: jnp.diagonal(a, offset=offset, axis1=-2, axis2=-1),
+               A, name="linalg_extractdiag", out=out)
+
+
+def linalg_makediag(A, offset=0, out=None):
+    def fn(a):
+        n = a.shape[-1] + abs(offset)
+        base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + builtins.max(-offset, 0)
+        c = idx + builtins.max(offset, 0)
+        return base.at[..., r, c].set(a)
+    return _op(fn, A, name="linalg_makediag", out=out)

@@ -1,0 +1,303 @@
+"""Legacy `mx.nd` operator-tail tests (parity: the 1.x op namespace —
+`src/operator/tensor/matrix_op.cc` reshape codes, `optimizer_op.cc` update
+kernels, `softmax_output.cc`, legacy layer/random/linalg names)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+nd = mx.nd
+
+
+def _r(*shape, seed=0):
+    return onp.random.RandomState(seed).uniform(-1, 1, shape).astype("float32")
+
+
+def test_legacy_elemwise_broadcast():
+    a, b = _r(2, 3, seed=1), _r(2, 3, seed=2)
+    assert_almost_equal(nd.elemwise_add(nd.array(a), nd.array(b)), a + b)
+    assert_almost_equal(nd.elemwise_mul(nd.array(a), nd.array(b)), a * b)
+    c = _r(3, seed=3)
+    assert_almost_equal(nd.broadcast_add(nd.array(a), nd.array(c)), a + c)
+    assert_almost_equal(nd.broadcast_maximum(nd.array(a), nd.array(c)),
+                        onp.maximum(a, c))
+    got = nd.broadcast_greater(nd.array(a), nd.array(c))
+    assert_almost_equal(got, (a > c).astype(onp.float32))
+    assert str(onp.asarray(got).dtype) == "float32"  # legacy: float mask
+    x1 = _r(1, 3, seed=4)
+    assert_almost_equal(nd.broadcast_axis(nd.array(x1), axis=0, size=4),
+                        onp.broadcast_to(x1, (4, 3)))
+    assert_almost_equal(nd.add_n(nd.array(a), nd.array(b), nd.array(a)),
+                        a + b + a)
+
+
+@pytest.mark.parametrize("spec,expected", [
+    ((-1, 0), (8, 3)),       # infer x keep (note: 0 maps to dim at its pos)
+    ((-3, 0), (6, 4)),       # merge first two, keep last
+    ((0, -2), (2, 3, 4)),    # keep, copy rest
+    ((-4, 2, 1, 0, 0), (2, 1, 3, 4)),   # split dim0 2 -> (2, 1)
+    ((4, 6), (4, 6)),
+])
+def test_legacy_reshape_codes(spec, expected):
+    x = nd.array(onp.arange(24, dtype=onp.float32).reshape(2, 3, 4))
+    got = nd.reshape(x, spec)
+    assert got.shape == expected
+    assert_almost_equal(nd.reshape(got, (2, 3, 4)), onp.asarray(x))
+
+
+def test_legacy_structure():
+    x = nd.array(_r(2, 3, 4, seed=5))
+    assert nd.Flatten(x).shape == (2, 12)
+    assert nd.SwapAxis(x, 0, 2).shape == (4, 3, 2)
+    parts = nd.split(x, num_outputs=3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 1, 4)
+    parts = nd.SliceChannel(x, num_outputs=3, axis=1, squeeze_axis=True)
+    assert parts[0].shape == (2, 4)
+    got = nd.slice(x, begin=(0, 1, 0), end=(2, 3, 2))
+    assert_almost_equal(got, onp.asarray(x)[0:2, 1:3, 0:2])
+    got = nd.slice_axis(x, axis=2, begin=1, end=3)
+    assert_almost_equal(got, onp.asarray(x)[:, :, 1:3])
+    ref = nd.array(_r(2, 2, 2, seed=6))
+    got = nd.slice_like(x, ref)
+    assert got.shape == (2, 2, 2)
+    assert_almost_equal(nd.reverse(x, axis=1), onp.asarray(x)[:, ::-1])
+    got = nd.pad(nd.array(_r(1, 1, 3, 3, seed=7)), mode="constant",
+                 pad_width=(0, 0, 0, 0, 1, 1, 1, 1), constant_value=9)
+    assert got.shape == (1, 1, 5, 5)
+    assert float(onp.asarray(got)[0, 0, 0, 0]) == 9.0
+
+
+def test_legacy_indexing():
+    x = _r(4, 5, seed=8)
+    idx = onp.array([2, 0], onp.int32)
+    assert_almost_equal(nd.take(nd.array(x), nd.array(idx)), x[idx])
+    bt = nd.batch_take(nd.array(x), nd.array(onp.array([1, 0, 3, 2],
+                                                       onp.int32)))
+    assert_almost_equal(bt, x[onp.arange(4), [1, 0, 3, 2]])
+    got = nd.where(nd.array((x > 0).astype(onp.float32)), nd.array(x),
+                   nd.array(-x))
+    assert_almost_equal(got, onp.abs(x))
+
+
+def test_legacy_reductions_sort():
+    x = _r(3, 4, seed=9)
+    assert_almost_equal(nd.sum(nd.array(x), axis=1), x.sum(1), rtol=1e-5,
+                        atol=1e-6)
+    # exclude reduces over all OTHER axes (legacy semantics)
+    assert_almost_equal(nd.sum(nd.array(x), axis=1, exclude=True), x.sum(0),
+                        rtol=1e-5, atol=1e-6)
+    got = nd.argmax(nd.array(x), axis=1)
+    assert str(onp.asarray(got).dtype) == "float32"  # legacy float indices
+    assert_almost_equal(got, onp.argmax(x, 1).astype(onp.float32))
+    got = nd.sort(nd.array(x), axis=1, is_ascend=False)
+    assert_almost_equal(got, -onp.sort(-x, axis=1))
+    assert_almost_equal(nd.argmax_channel(nd.array(x)),
+                        onp.argmax(x, 1).astype(onp.float32))
+
+
+def test_legacy_dot_batch_dot():
+    a, b = _r(3, 4, seed=10), _r(3, 5, seed=11)
+    assert_almost_equal(nd.dot(nd.array(a), nd.array(b), transpose_a=True),
+                        a.T @ b, rtol=1e-4, atol=1e-5)
+    ab, bb = _r(2, 3, 4, seed=12), _r(2, 5, 4, seed=13)
+    assert_almost_equal(
+        nd.batch_dot(nd.array(ab), nd.array(bb), transpose_b=True),
+        onp.matmul(ab, bb.transpose(0, 2, 1)), rtol=1e-4, atol=1e-5)
+
+
+def test_legacy_layers():
+    x, w, bias = _r(4, 5, seed=14), _r(3, 5, seed=15), _r(3, seed=16)
+    got = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(bias),
+                            num_hidden=3)
+    assert_almost_equal(got, x @ w.T + bias, rtol=1e-4, atol=1e-5)
+    assert_almost_equal(nd.Activation(nd.array(x), act_type="relu"),
+                        onp.maximum(x, 0))
+    xc = _r(1, 2, 5, 5, seed=17)
+    wc = _r(3, 2, 3, 3, seed=18)
+    got = nd.Convolution(nd.array(xc), nd.array(wc), None, kernel=(3, 3),
+                         num_filter=3, no_bias=True)
+    assert got.shape == (1, 3, 3, 3)
+    got = nd.Pooling(nd.array(xc), kernel=(2, 2), stride=(2, 2),
+                     pool_type="max")
+    assert got.shape == (1, 2, 2, 2)
+    up = nd.UpSampling(nd.array(xc), scale=2, sample_type="nearest")
+    assert up.shape == (1, 2, 10, 10)
+
+
+def test_legacy_softmax_output_gradient():
+    """SoftmaxOutput backward = (p - onehot) * scale (softmax_output.cc)."""
+    x = nd.array(_r(4, 3, seed=19))
+    lbl = nd.array(onp.array([0, 2, 1, 2], onp.float32))
+    x.attach_grad()
+    with autograd.record():
+        p = nd.SoftmaxOutput(x, lbl, grad_scale=2.0)
+        # legacy semantics: backward seeds the fused grad regardless of head
+        loss = p.sum()
+    loss.backward()
+    pv = onp.asarray(p)
+    oh = onp.eye(3, dtype=onp.float32)[[0, 2, 1, 2]]
+    assert_almost_equal(x.grad, (pv - oh) * 2.0, rtol=1e-4, atol=1e-5)
+
+
+def test_legacy_sequence_ops():
+    x = _r(5, 3, 2, seed=20)  # (seq, batch, feat)
+    ln = onp.array([2, 5, 3], onp.float32)
+    last = nd.SequenceLast(nd.array(x), nd.array(ln),
+                           use_sequence_length=True)
+    want = onp.stack([x[1, 0], x[4, 1], x[2, 2]])
+    assert_almost_equal(last, want)
+    rev = nd.SequenceReverse(nd.array(x), nd.array(ln),
+                             use_sequence_length=True)
+    rv = onp.asarray(rev)
+    assert_almost_equal(rv[0, 0], x[1, 0])   # first 2 reversed for batch 0
+    assert_almost_equal(rv[2, 0], x[2, 0])   # beyond length untouched
+    assert_almost_equal(rv[0, 1], x[4, 1])   # full reverse for batch 1
+
+
+def test_legacy_optimizer_update_kernels():
+    w0 = _r(4, 3, seed=21)
+    g = _r(4, 3, seed=22)
+    w = nd.array(w0.copy())
+    nd.sgd_update(w, nd.array(g), lr=0.1, wd=0.01)
+    assert_almost_equal(w, w0 - 0.1 * (g + 0.01 * w0), rtol=1e-5, atol=1e-6)
+
+    w = nd.array(w0.copy())
+    mom = nd.array(onp.zeros_like(w0))
+    nd.sgd_mom_update(w, nd.array(g), mom, lr=0.1, momentum=0.9)
+    assert_almost_equal(w, w0 - 0.1 * g, rtol=1e-5, atol=1e-6)
+    nd.sgd_mom_update(w, nd.array(g), mom, lr=0.1, momentum=0.9)
+    # second step: mom = 0.9*(-0.1 g) - 0.1 g
+    assert_almost_equal(w, w0 - 0.1 * g + (0.9 * (-0.1 * g) - 0.1 * g),
+                        rtol=1e-5, atol=1e-6)
+
+    w = nd.array(w0.copy())
+    m, v = nd.array(onp.zeros_like(w0)), nd.array(onp.zeros_like(w0))
+    nd.adam_update(w, nd.array(g), m, v, lr=0.01)
+    mm = 0.1 * g
+    vv = 0.001 * g * g
+    assert_almost_equal(w, w0 - 0.01 * mm / (onp.sqrt(vv) + 1e-8),
+                        rtol=1e-4, atol=1e-5)
+
+    # multi-precision: fp16 weight, fp32 master
+    w16 = nd.array(w0.astype(onp.float16))
+    w32 = nd.array(w0.copy())
+    nd.mp_sgd_update(w16, nd.array(g.astype(onp.float16)), w32, lr=0.1)
+    assert str(onp.asarray(w16).dtype) == "float16"
+    assert_almost_equal(w32, w0 - 0.1 * g.astype(onp.float16).astype(
+        onp.float32), rtol=1e-3, atol=1e-3)
+
+
+def test_legacy_random_and_samplers():
+    mx.np.random.seed(3)
+    u = nd.random_uniform(0.0, 1.0, shape=(1000,))
+    a = onp.asarray(u)
+    assert a.shape == (1000,) and (a >= 0).all() and (a < 1).all()
+    n = onp.asarray(nd.random_normal(1.0, 2.0, shape=(5000,)))
+    assert abs(n.mean() - 1.0) < 0.2 and abs(n.std() - 2.0) < 0.2
+    s = nd.sample_uniform(nd.array(onp.array([0.0, 10.0], onp.float32)),
+                          nd.array(onp.array([1.0, 20.0], onp.float32)),
+                          shape=(5,))
+    sv = onp.asarray(s)
+    assert sv.shape == (2, 5)
+    assert (sv[0] < 1.0).all() and (sv[1] >= 10.0).all()
+    m = onp.asarray(nd.sample_multinomial(
+        nd.array(onp.array([0.1, 0.0, 0.9], onp.float32)), shape=(100,)))
+    assert set(onp.unique(m)).issubset({0, 2})
+
+
+def test_legacy_linalg():
+    rng = onp.random.RandomState(7)
+    a = rng.standard_normal((3, 3)).astype(onp.float32)
+    b = rng.standard_normal((3, 3)).astype(onp.float32)
+    c = rng.standard_normal((3, 3)).astype(onp.float32)
+    assert_almost_equal(nd.linalg_gemm(nd.array(a), nd.array(b), nd.array(c),
+                                       alpha=2.0, beta=0.5),
+                        2 * a @ b + 0.5 * c, rtol=1e-4, atol=1e-4)
+    assert_almost_equal(nd.linalg_gemm2(nd.array(a), nd.array(b),
+                                        transpose_b=True),
+                        a @ b.T, rtol=1e-4, atol=1e-4)
+    spd = a @ a.T + 3 * onp.eye(3, dtype=onp.float32)
+    L = onp.asarray(nd.linalg_potrf(nd.array(spd)))
+    assert_almost_equal(L @ L.T, spd, rtol=1e-3, atol=1e-3)
+    assert_almost_equal(nd.linalg_sumlogdiag(nd.array(spd)),
+                        onp.log(onp.diag(spd)).sum(), rtol=1e-4, atol=1e-4)
+    d = onp.asarray(nd.linalg_extractdiag(nd.array(spd)))
+    assert_almost_equal(d, onp.diag(spd))
+    md = onp.asarray(nd.linalg_makediag(nd.array(d)))
+    assert_almost_equal(md, onp.diag(d))
+    # triangular solve round-trip
+    y = onp.asarray(nd.linalg_trsm(nd.array(L), nd.array(b)))
+    assert_almost_equal(L @ y, b, rtol=1e-3, atol=1e-3)
+
+
+def test_legacy_misc():
+    x = _r(3, 4, seed=23)
+    assert_almost_equal(nd.rsqrt(nd.array(onp.abs(x) + 1)),
+                        1 / onp.sqrt(onp.abs(x) + 1), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(nd.smooth_l1(nd.array(x), scalar=1.0),
+                        onp.where(onp.abs(x) < 1, 0.5 * x * x,
+                                  onp.abs(x) - 0.5), rtol=1e-5, atol=1e-6)
+    xg = nd.array(x)
+    xg.attach_grad()
+    with autograd.record():
+        y = (nd.BlockGrad(xg) * 3 + xg).sum()
+    y.backward()
+    assert_almost_equal(xg.grad, onp.ones_like(x))  # only the direct path
+    assert_almost_equal(nd.khatri_rao(nd.array(_r(2, 3, seed=24)),
+                                      nd.array(_r(4, 3, seed=25))).shape,
+                        (8, 3))
+
+
+def test_legacy_norm_elementwise_l2():
+    """axis=None is the flattened L2 norm, never the spectral norm."""
+    m = nd.array(onp.array([[3.0, 0.0], [0.0, 4.0]], onp.float32))
+    assert abs(float(onp.asarray(nd.norm(m))) - 5.0) < 1e-5
+
+
+def test_legacy_slice_negative_step():
+    x = nd.array(onp.arange(5, dtype=onp.float32))
+    got = nd.slice(x, begin=(None,), end=(None,), step=(-1,))
+    assert_almost_equal(got, onp.arange(5, dtype=onp.float32)[::-1])
+
+
+def test_legacy_softmax_output_multi_output_ignore():
+    x = nd.array(_r(2, 3, 4, seed=26))
+    lbl = onp.array([[0, 1, -1, 2], [2, -1, 1, 0]], onp.float32)
+    xl = nd.array(lbl)
+    x.attach_grad()
+    with autograd.record():
+        p = nd.SoftmaxOutput(x, xl, multi_output=True, use_ignore=True,
+                             ignore_label=-1)
+        p.sum().backward()
+    g = onp.asarray(x.grad)
+    assert g.shape == (2, 3, 4)
+    # ignored positions carry zero gradient
+    assert onp.all(g[0, :, 2] == 0) and onp.all(g[1, :, 1] == 0)
+    assert not onp.all(g == 0)
+
+
+def test_legacy_sample_multinomial_get_prob():
+    mx.np.random.seed(5)
+    s, logp = nd.sample_multinomial(
+        nd.array(onp.array([0.3, 0.7], onp.float32)), shape=(50,),
+        get_prob=True)
+    sv, lv = onp.asarray(s), onp.asarray(logp)
+    assert sv.shape == lv.shape == (50,)
+    want = onp.log(onp.array([0.3, 0.7]))[sv.astype(int)]
+    onp.testing.assert_allclose(lv, want, rtol=1e-4, atol=1e-5)
+
+
+def test_legacy_embedding_dtype():
+    w = nd.array(_r(6, 4, seed=27))
+    idx = nd.array(onp.array([1, 3], onp.int32))
+    got = nd.Embedding(idx, w, input_dim=6, output_dim=4, dtype="float16")
+    assert str(onp.asarray(got).dtype) == "float16"
+
+
+def test_legacy_reshape_reverse():
+    x = nd.array(onp.arange(24, dtype=onp.float32).reshape(2, 3, 4))
+    # reverse: spec applied right-to-left; (-1, 4) -> last dim 4, infer rest
+    got = nd.reshape(x, (-1, 4), reverse=True)
+    assert got.shape == (6, 4)
