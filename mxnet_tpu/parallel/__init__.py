@@ -19,6 +19,7 @@ from . import collectives
 from .collectives import (allreduce, allgather, reduce_scatter, broadcast,
                           ppermute_shift, all_to_all)
 from .ring_attention import ring_attention, ring_attention_sharded
+from .ulysses import ulysses_attention, ulysses_attention_sharded
 from .train import ShardedTrainStep, make_sharded_train_step
 
 __all__ = [
@@ -26,7 +27,8 @@ __all__ = [
     "PartitionSpec", "ShardingRules", "default_tp_rules", "param_sharding",
     "shard_parameter_tree", "replicated", "collectives", "allreduce",
     "allgather", "reduce_scatter", "broadcast", "ppermute_shift", "all_to_all",
-    "ring_attention", "ring_attention_sharded", "ShardedTrainStep",
+    "ring_attention", "ring_attention_sharded", "ulysses_attention",
+    "ulysses_attention_sharded", "ShardedTrainStep",
     "make_sharded_train_step", "initialize", "rank", "num_workers",
 ]
 

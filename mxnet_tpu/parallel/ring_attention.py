@@ -22,7 +22,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from jax import shard_map
 
-__all__ = ["ring_attention", "ring_attention_sharded"]
+__all__ = ["ring_attention", "ring_attention_sharded", "seq_sharded_call"]
 
 NEG_INF = -1e30
 
@@ -93,20 +93,24 @@ def ring_attention_sharded(q, k, v, axis_name: str = "sp",
     return out.astype(q.dtype)
 
 
-def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
-                   causal: bool = False, scale: Optional[float] = None,
-                   batch_axis: Optional[str] = "dp"):
-    """Top-level ring attention over (B, H, L, D) jax arrays.
-
-    Shards L over `axis_name` (and B over `batch_axis` if present in the
-    mesh) with shard_map; composes under jit/pjit.
-    """
+def seq_sharded_call(fn, q, k, v, mesh: Mesh, axis_name: str = "sp",
+                     batch_axis: Optional[str] = "dp"):
+    """shard_map a per-shard attention fn over (B, H, L, D) arrays with L
+    sharded on `axis_name` (and B on `batch_axis` when present). Shared by
+    the ring and Ulysses sequence-parallel strategies."""
     axes = set(mesh.axis_names)
     bspec = batch_axis if (batch_axis and batch_axis in axes) else None
     spec = P(bspec, None, axis_name, None)
-
-    fn = functools.partial(ring_attention_sharded, axis_name=axis_name,
-                           causal=causal, scale=scale)
     mapped = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec)
     return mapped(q, k, v)
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
+                   causal: bool = False, scale: Optional[float] = None,
+                   batch_axis: Optional[str] = "dp"):
+    """Top-level ring attention over (B, H, L, D) jax arrays; composes
+    under jit/pjit."""
+    fn = functools.partial(ring_attention_sharded, axis_name=axis_name,
+                           causal=causal, scale=scale)
+    return seq_sharded_call(fn, q, k, v, mesh, axis_name, batch_axis)

@@ -1,0 +1,73 @@
+"""Ulysses (DeepSpeed-style) sequence parallelism: all-to-all head scatter.
+
+The second sequence-parallel strategy SURVEY.md §5.7 plans (alongside ring
+attention): instead of streaming K/V around the ring, one `all_to_all`
+re-shards activations from sequence-sharded (B, H, L/n, D) to head-sharded
+(B, H/n, L, D), runs FULL-sequence attention locally on the head subset
+(any kernel — including the Pallas flash kernel — works unchanged because
+each device sees the whole sequence), and a second `all_to_all` restores
+sequence sharding.
+
+Trade-off vs ring attention (public recipe): two all-to-alls of the
+activations per attention call instead of n ppermutes of K/V — cheaper
+when heads >> devices and ICI all-to-all bandwidth is good; requires
+num_heads % n == 0 while ring requires seq % n == 0.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+from jax import lax
+from jax.sharding import Mesh
+
+from ..base import MXNetError
+
+__all__ = ["ulysses_attention", "ulysses_attention_sharded"]
+
+
+def ulysses_attention_sharded(q, k, v, axis_name: str = "sp",
+                              causal: bool = False,
+                              scale: Optional[float] = None,
+                              attn_fn=None):
+    """Attention over sequence-sharded q/k/v — call INSIDE shard_map.
+
+    q, k, v: local shards (B, H, L_local, D) with the sequence axis sharded
+    over `axis_name`. Returns the local (B, H, L_local, D) output shard.
+
+    `attn_fn(q, k, v, causal=..., scale=...)` runs on full-sequence,
+    head-sharded blocks; defaults to the flash/reference dispatcher.
+    """
+    n = lax.axis_size(axis_name)
+    b, h, l_loc, d = q.shape
+    if h % n != 0:
+        raise MXNetError(
+            f"ulysses attention needs num_heads ({h}) divisible by the "
+            f"'{axis_name}' axis size ({n}); use ring attention for "
+            "head counts that don't divide")
+    if attn_fn is None:
+        from ..ops.attention import dot_product_attention
+        attn_fn = dot_product_attention
+
+    # (B, H, L/n, D) -> tiled all_to_all swaps a head tile against the
+    # sequence tiles: every device ends up with the FULL sequence for H/n
+    # heads.
+    qh, kh, vh = (lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                 tiled=True) for x in (q, k, v))
+
+    out = attn_fn(qh, kh, vh, causal=causal, scale=scale)  # (B, H/n, L, D)
+
+    # inverse: scatter sequence back, gather heads
+    return lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
+                      causal: bool = False, scale: Optional[float] = None,
+                      batch_axis: Optional[str] = "dp", attn_fn=None):
+    """Top-level Ulysses attention over (B, H, L, D) jax arrays; composes
+    under jit/pjit like `ring_attention`."""
+    from .ring_attention import seq_sharded_call
+    fn = functools.partial(ulysses_attention_sharded, axis_name=axis_name,
+                           causal=causal, scale=scale, attn_fn=attn_fn)
+    return seq_sharded_call(fn, q, k, v, mesh, axis_name, batch_axis)

@@ -291,3 +291,37 @@ def test_parameter_sharding_annotation_wins(caplog):
     assert step.param_shardings[name].spec == P("tp", None)
     assert any("blob" in r.message and "REPLICATED" in r.message
                for r in caplog.records)
+
+
+def test_ulysses_attention_matches_reference():
+    """Ulysses all-to-all SP must equal single-device attention, incl. the
+    causal path, and agree with ring attention (SURVEY.md §5.7)."""
+    from mxnet_tpu.parallel import ulysses_attention
+
+    rng = onp.random.RandomState(0)
+    B, H, L, D = 2, 4, 32, 8
+    q, k, v = (jnp.asarray(rng.standard_normal((B, H, L, D)),
+                           jnp.float32) for _ in range(3))
+    mesh = make_mesh({"dp": 2, "sp": 4}, _cpu_devices(8))
+    want = onp.asarray(reference_attention(q, k, v))
+
+    got = onp.asarray(ulysses_attention(q, k, v, mesh))
+    assert_almost_equal(got, want, rtol=2e-4, atol=2e-5)
+
+    want_c = onp.asarray(reference_attention(q, k, v, causal=True))
+    got_c = onp.asarray(ulysses_attention(q, k, v, mesh, causal=True))
+    assert_almost_equal(got_c, want_c, rtol=2e-4, atol=2e-5)
+
+    ring = onp.asarray(ring_attention(q, k, v, mesh))
+    assert_almost_equal(got, ring, rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_head_divisibility_error():
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.parallel import ulysses_attention
+
+    rng = onp.random.RandomState(1)
+    q = jnp.asarray(rng.standard_normal((2, 3, 32, 8)), jnp.float32)
+    mesh = make_mesh({"sp": 4}, _cpu_devices(4))
+    with pytest.raises(MXNetError, match="divisible"):
+        ulysses_attention(q, q, q, mesh)
