@@ -14,6 +14,7 @@ from typing import Optional
 
 from ..gluon import nn
 from ..gluon.block import HybridBlock
+from .layers import FusedSelfAttention
 from .. import numpy as np
 from .. import numpy_extension as npx
 
@@ -49,34 +50,19 @@ def bert_large(**kwargs):
     return BertConfig(**cfg)
 
 
-class BertSelfAttention(HybridBlock):
-    def __init__(self, cfg: BertConfig):
-        super().__init__()
-        h = cfg.hidden_size
-        self.num_heads = cfg.num_heads
-        # single fused qkv projection: one big MXU matmul (column-parallel
-        # under TP: name matches the 'qkv' sharding rule)
-        self.attn_qkv = nn.Dense(3 * h, in_units=h, flatten=False,
-                                 dtype=cfg.dtype)
-        self.attn_proj = nn.Dense(h, in_units=h, flatten=False,
-                                  dtype=cfg.dtype)
-        self.dropout = nn.Dropout(cfg.dropout)
-
-    def forward(self, x, attn_mask=None):
-        qkv = self.attn_qkv(x)                      # (B, L, 3H)
-        h = qkv.shape[-1] // 3
-        q = qkv[..., :h]
-        k = qkv[..., h:2 * h]
-        v = qkv[..., 2 * h:]
-        ctx = npx.multi_head_attention(q, k, v, self.num_heads,
-                                       mask=attn_mask)
-        return self.dropout(self.attn_proj(ctx))
+# The fused-QKV self-attention lives in models/layers.py (shared with
+# gpt/transformer): one big MXU matmul, column-parallel under TP (name
+# matches the 'qkv' sharding rule). Alias kept for the public name.
+BertSelfAttention = FusedSelfAttention
 
 
 class BertLayer(HybridBlock):
     def __init__(self, cfg: BertConfig):
         super().__init__()
-        self.attention = BertSelfAttention(cfg)
+        self.attention = FusedSelfAttention(cfg.hidden_size,
+                                            cfg.num_heads,
+                                            dropout=cfg.dropout,
+                                            dtype=cfg.dtype)
         self.attn_norm = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
                                       in_channels=cfg.hidden_size)
         self.ffn_intermediate = nn.Dense(cfg.intermediate_size,

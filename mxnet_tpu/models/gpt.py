@@ -1,0 +1,143 @@
+"""GPT-style decoder-only causal language model.
+
+Workload-parity target: the reference era's GluonNLP text-generation models
+(AWD-LSTM/Transformer-XL family); redesigned TPU-first as a pre-LN
+transformer with fused QKV (one MXU matmul), causal flash attention
+(`ops/attention.py` → Pallas kernel), and layer naming that matches
+`parallel.sharding.default_tp_rules` so tensor parallelism is annotation-
+free. Sequence parallelism: the attention op composes with
+`parallel.ring_attention` / `parallel.ulysses_attention` under shard_map.
+"""
+from __future__ import annotations
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from .layers import FusedSelfAttention, FeedForward, check_max_position
+from .. import numpy as np
+from .. import numpy_extension as npx
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "gpt_small",
+           "gpt_medium"]
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50257, hidden_size=768, num_layers=12,
+                 num_heads=12, intermediate_size=3072, max_position=1024,
+                 dropout=0.1, layer_norm_eps=1e-5, tie_embeddings=True,
+                 dtype="float32"):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size
+        self.max_position = max_position
+        self.dropout = dropout
+        self.layer_norm_eps = layer_norm_eps
+        self.tie_embeddings = tie_embeddings
+        self.dtype = dtype
+
+
+def gpt_small(**kwargs):
+    return GPTConfig(**kwargs)
+
+
+def gpt_medium(**kwargs):
+    cfg = dict(hidden_size=1024, num_layers=24, num_heads=16,
+               intermediate_size=4096)
+    cfg.update(kwargs)
+    return GPTConfig(**cfg)
+
+
+class GPTBlock(HybridBlock):
+    """Pre-LN block (GPT-2 style): x + attn(ln(x)); x + ffn(ln(x))."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.attn_norm = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                                      in_channels=cfg.hidden_size)
+        self.attention = FusedSelfAttention(cfg.hidden_size, cfg.num_heads,
+                                            dropout=cfg.dropout, causal=True,
+                                            dtype=cfg.dtype)
+        self.ffn_norm = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                                     in_channels=cfg.hidden_size)
+        self.ffn = FeedForward(cfg.hidden_size, cfg.intermediate_size,
+                               dropout=cfg.dropout, activation="gelu",
+                               dtype=cfg.dtype)
+
+    def forward(self, x):
+        x = x + self.attention(self.attn_norm(x))
+        return x + self.ffn(self.ffn_norm(x))
+
+
+class GPTModel(HybridBlock):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.word_embed = nn.Embedding(cfg.vocab_size, cfg.hidden_size,
+                                       dtype=cfg.dtype)
+        self.position_embed = nn.Embedding(cfg.max_position, cfg.hidden_size,
+                                           dtype=cfg.dtype)
+        self.embed_dropout = nn.Dropout(cfg.dropout)
+        self.layers = nn.HybridSequential()
+        for _ in range(cfg.num_layers):
+            self.layers.add(GPTBlock(cfg))
+        self.final_norm = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                                       in_channels=cfg.hidden_size)
+
+    def forward(self, input_ids):
+        b, l = input_ids.shape
+        check_max_position(l, self.cfg.max_position)
+        pos = npx.arange_like(input_ids, axis=1).astype("int32")
+        x = self.word_embed(input_ids) + self.position_embed(
+            pos.reshape(1, l))
+        x = self.embed_dropout(x)
+        for layer in self.layers:
+            x = layer(x)
+        return self.final_norm(x)
+
+
+class GPTForCausalLM(HybridBlock):
+    """Next-token LM head; with `tie_embeddings` the decoder reuses the
+    input embedding matrix (GPT-2 parity, halves embed params)."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.transformer = GPTModel(cfg)
+        if not cfg.tie_embeddings:
+            self.lm_head = nn.Dense(cfg.vocab_size, in_units=cfg.hidden_size,
+                                    use_bias=False, flatten=False,
+                                    dtype=cfg.dtype)
+
+    def forward(self, input_ids):
+        x = self.transformer(input_ids)
+        if self.cfg.tie_embeddings:
+            w = self.transformer.word_embed.weight.data()
+            return np.matmul(x, w.T)
+        return self.lm_head(x)
+
+    def generate(self, input_ids, max_new_tokens=20, temperature=1.0,
+                 greedy=True):
+        """Simple autoregressive decode (eager; full-context recompute per
+        step — KV caching is a serving optimization, not parity)."""
+        from .. import random as _rng
+        import jax
+        ids = input_ids
+        for _ in range(max_new_tokens):
+            logits = self(ids)[:, -1]
+            if greedy:
+                nxt = np.argmax(logits, axis=-1).astype("int32")
+            else:
+                key = _rng.next_key()
+                nxt = np.from_jax(jax.random.categorical(
+                    key, (logits / temperature)._data, axis=-1)).astype(
+                    "int32")
+            ids = np.concatenate([ids, nxt.reshape(-1, 1)], axis=1)
+        return ids
+
+    @staticmethod
+    def flops_per_token(cfg: GPTConfig, seq_len: int) -> float:
+        h, l, i = cfg.hidden_size, cfg.num_layers, cfg.intermediate_size
+        per_layer = 4 * h * h + 2 * h * i
+        head = cfg.vocab_size * h
+        return 6 * (l * per_layer + head) + 12 * l * seq_len * h // 2

@@ -1,0 +1,69 @@
+"""Shared transformer building blocks for the model families (bert/gpt/
+transformer): fused-QKV self-attention (one MXU matmul, TP-rule-compatible
+naming) and the position-wise FFN. Keeping one implementation means a fix
+to the QKV split or the sharding-name convention lands everywhere at once.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from .. import numpy_extension as npx
+
+__all__ = ["FusedSelfAttention", "FeedForward", "check_max_position"]
+
+
+def check_max_position(seq_len: int, max_position: int) -> None:
+    """npx.embedding clips out-of-range indices, which would silently reuse
+    the last position embedding — raise instead."""
+    if seq_len > max_position:
+        raise MXNetError(
+            f"sequence length {seq_len} exceeds max_position "
+            f"{max_position}; raise the config's max_position (position "
+            "embeddings would silently clip)")
+
+
+class FusedSelfAttention(HybridBlock):
+    """softmax(QK^T)V with a single fused qkv projection; lowers to the
+    Pallas flash kernel via `npx.multi_head_attention`."""
+
+    def __init__(self, hidden_size: int, num_heads: int, dropout: float = 0.0,
+                 causal: bool = False, dtype="float32"):
+        super().__init__()
+        self.num_heads = num_heads
+        self.causal = causal
+        self.attn_qkv = nn.Dense(3 * hidden_size, in_units=hidden_size,
+                                 flatten=False, dtype=dtype)
+        self.attn_proj = nn.Dense(hidden_size, in_units=hidden_size,
+                                  flatten=False, dtype=dtype)
+        self.dropout = nn.Dropout(dropout)
+
+    def forward(self, x, mask=None):
+        qkv = self.attn_qkv(x)
+        h = qkv.shape[-1] // 3
+        q, k, v = qkv[..., :h], qkv[..., h:2 * h], qkv[..., 2 * h:]
+        ctx = npx.multi_head_attention(q, k, v, self.num_heads, mask=mask,
+                                       causal=self.causal)
+        return self.dropout(self.attn_proj(ctx))
+
+
+class FeedForward(HybridBlock):
+    """Position-wise FFN: proj-up, activation, proj-down, dropout."""
+
+    def __init__(self, hidden_size: int, intermediate_size: int,
+                 dropout: float = 0.0, activation: str = "gelu",
+                 dtype="float32"):
+        super().__init__()
+        self.ffn_intermediate = nn.Dense(intermediate_size,
+                                         in_units=hidden_size,
+                                         flatten=False, dtype=dtype)
+        self.ffn_output = nn.Dense(hidden_size, in_units=intermediate_size,
+                                   flatten=False, dtype=dtype)
+        self.dropout = nn.Dropout(dropout)
+        self._act = activation
+
+    def forward(self, x):
+        y = self.ffn_intermediate(x)
+        y = npx.gelu(y) if self._act == "gelu" else npx.activation(
+            y, act_type=self._act)
+        return self.dropout(self.ffn_output(y))
