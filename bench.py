@@ -55,7 +55,8 @@ def _measure(platform: str) -> dict:
 
     # BERT-base; bf16 weights/compute for the MXU, seq 128 (phase-1 pretrain)
     if on_accel:
-        batch, seq = 32, 128
+        batch = int(os.environ.get("MXTPU_BENCH_BATCH", 64))
+        seq = 128
         cfg = BertConfig(dtype="bfloat16")
     else:  # CI/CPU smoke config
         batch, seq = 4, 64
@@ -162,6 +163,9 @@ def main():
             print(json.dumps(result))
             return
         errors.append(err)
+        if "MEMORY" in (err or "").upper() or "OOM" in (err or "").upper():
+            # larger default batch blew HBM: drop to the round-1 config
+            os.environ["MXTPU_BENCH_BATCH"] = "32"
 
     # TPU unreachable — CPU fallback so the driver still gets a numeric line
     result, err = _run_child("cpu", CPU_TIMEOUT)

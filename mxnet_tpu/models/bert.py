@@ -14,7 +14,7 @@ from typing import Optional
 
 from ..gluon import nn
 from ..gluon.block import HybridBlock
-from .layers import FusedSelfAttention
+from .layers import FusedSelfAttention, check_max_position
 from .. import numpy as np
 from .. import numpy_extension as npx
 
@@ -50,10 +50,17 @@ def bert_large(**kwargs):
     return BertConfig(**cfg)
 
 
-# The fused-QKV self-attention lives in models/layers.py (shared with
-# gpt/transformer): one big MXU matmul, column-parallel under TP (name
-# matches the 'qkv' sharding rule). Alias kept for the public name.
-BertSelfAttention = FusedSelfAttention
+class BertSelfAttention(FusedSelfAttention):
+    """Back-compat shim over the shared fused-QKV block
+    (models/layers.py): keeps the original (cfg) constructor and
+    `attn_mask` keyword."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__(cfg.hidden_size, cfg.num_heads,
+                         dropout=cfg.dropout, dtype=cfg.dtype)
+
+    def forward(self, x, attn_mask=None):
+        return super().forward(x, mask=attn_mask)
 
 
 class BertLayer(HybridBlock):
@@ -104,6 +111,7 @@ class BertModel(HybridBlock):
 
     def forward(self, input_ids, token_types=None, valid_length=None):
         b, l = input_ids.shape
+        check_max_position(l, self.cfg.max_position)
         pos = npx.arange_like(input_ids, axis=1).astype("int32")
         x = self.word_embed(input_ids)
         x = x + self.position_embed(pos.reshape(1, l))

@@ -189,11 +189,16 @@ class TransformerNMT(HybridBlock):
         memory, mask = self.encoder(src_ids, src_valid_length)
         b = src_ids.shape[0]
         tgt = np.full((b, 1), bos_id, dtype="int32")
+        finished = np.zeros((b,), dtype="bool")
         for _ in range(max_len - 1):
             dec = self.decoder(tgt, memory, mask)
             logits = self.proj(dec)[:, -1]
             nxt = np.argmax(logits, axis=-1).astype("int32")
+            # finished sequences keep emitting EOS (frozen)
+            nxt = np.where(finished, np.full((b,), eos_id, dtype="int32"),
+                           nxt).astype("int32")
             tgt = np.concatenate([tgt, nxt.reshape(-1, 1)], axis=1)
-            if bool((nxt == eos_id).all()):
+            finished = np.logical_or(finished, nxt == eos_id)
+            if bool(finished.all()):
                 break
         return tgt

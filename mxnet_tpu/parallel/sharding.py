@@ -57,8 +57,8 @@ def default_tp_rules() -> ShardingRules:
     """
     return ShardingRules([
         # attention: qkv projections column-parallel, out proj row-parallel
-        (r"(attn|attention).*(query|key|value|qkv).*weight", PartitionSpec("tp", None)),
-        (r"(attn|attention).*(query|key|value|qkv).*bias", PartitionSpec("tp")),
+        (r"(attn|attention).*(query|key|value|qkv|kv).*weight", PartitionSpec("tp", None)),
+        (r"(attn|attention).*(query|key|value|qkv|kv).*bias", PartitionSpec("tp")),
         (r"(attn|attention).*(proj|out).*weight", PartitionSpec(None, "tp")),
         # mlp/ffn: in column-parallel, out row-parallel
         (r"(ffn|mlp|intermediate|fc1|dense1).*weight", PartitionSpec("tp", None)),

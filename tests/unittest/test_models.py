@@ -124,3 +124,39 @@ def test_nmt_trains_and_translates():
     assert losses[-1] < 0.7 * losses[0], (losses[0], losses[-1])
     out = m.greedy_translate(src, bos_id=1, max_len=6)
     assert out.shape[0] == 4 and out.shape[1] <= 6
+
+
+def test_max_position_guard_all_models():
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.models.bert import BertConfig, BertModel
+
+    bm = BertModel(BertConfig(vocab_size=V, hidden_size=H, num_layers=1,
+                              num_heads=4, intermediate_size=64,
+                              max_position=8))
+    bm.initialize()
+    ids = mx.np.array(onp.zeros((1, 16), "int32"))
+    with pytest.raises(MXNetError, match="max_position"):
+        bm(ids)
+    g = _tiny_gpt()
+    with pytest.raises(MXNetError, match="max_position"):
+        g(mx.np.array(onp.zeros((1, 64), "int32")))
+
+
+def test_bert_self_attention_back_compat():
+    from mxnet_tpu.models.bert import BertConfig, BertSelfAttention
+    cfg = BertConfig(vocab_size=V, hidden_size=H, num_heads=4, dropout=0.0)
+    att = BertSelfAttention(cfg)            # (cfg) ctor preserved
+    att.initialize()
+    x = mx.np.array(onp.random.RandomState(0)
+                    .standard_normal((2, 6, H)).astype("float32"))
+    out = att(x, attn_mask=None)            # attn_mask kwarg preserved
+    assert out.shape == (2, 6, H)
+
+
+def test_tp_rules_cover_cross_attention_kv():
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.parallel.sharding import default_tp_rules
+    rules = default_tp_rules()
+    spec = rules.spec_for(
+        "decoder.layers.0.cross_attention.attn_kv.weight", (64, 32))
+    assert spec == P("tp", None), spec
