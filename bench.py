@@ -157,15 +157,23 @@ def main():
         return
 
     errors = []
-    for timeout in ATTEMPT_TIMEOUTS:
+    oom_retry_left = True
+    attempts = list(ATTEMPT_TIMEOUTS)
+    while attempts:
+        timeout = attempts.pop(0)
         result, err = _run_child("default", timeout)
         if result is not None:
             print(json.dumps(result))
             return
         errors.append(err)
-        if "MEMORY" in (err or "").upper() or "OOM" in (err or "").upper():
-            # larger default batch blew HBM: drop to the round-1 config
+        if oom_retry_left and (
+                "MEMORY" in (err or "").upper() or "OOM" in (err or "").upper()):
+            # larger default batch blew HBM: drop to the proven round-1
+            # config and guarantee one more TPU attempt at that size
             os.environ["MXTPU_BENCH_BATCH"] = "32"
+            oom_retry_left = False
+            if not attempts:
+                attempts.append(ATTEMPT_TIMEOUTS[-1])
 
     # TPU unreachable — CPU fallback so the driver still gets a numeric line
     result, err = _run_child("cpu", CPU_TIMEOUT)

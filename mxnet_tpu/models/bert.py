@@ -51,16 +51,21 @@ def bert_large(**kwargs):
 
 
 class BertSelfAttention(FusedSelfAttention):
-    """Back-compat shim over the shared fused-QKV block
-    (models/layers.py): keeps the original (cfg) constructor and
-    `attn_mask` keyword."""
+    """Back-compat shim over the shared fused-QKV block (models/layers.py):
+    accepts both the original `(cfg)` constructor + `attn_mask` keyword and
+    the shared `(hidden_size, num_heads, ...)` + `mask` surface."""
 
-    def __init__(self, cfg: BertConfig):
-        super().__init__(cfg.hidden_size, cfg.num_heads,
-                         dropout=cfg.dropout, dtype=cfg.dtype)
+    def __init__(self, cfg_or_hidden, *args, **kwargs):
+        if isinstance(cfg_or_hidden, BertConfig):
+            cfg = cfg_or_hidden
+            super().__init__(cfg.hidden_size, cfg.num_heads,
+                             dropout=cfg.dropout, dtype=cfg.dtype)
+        else:
+            super().__init__(cfg_or_hidden, *args, **kwargs)
 
-    def forward(self, x, attn_mask=None):
-        return super().forward(x, mask=attn_mask)
+    def forward(self, x, attn_mask=None, mask=None):
+        return super().forward(x, mask=mask if mask is not None
+                               else attn_mask)
 
 
 class BertLayer(HybridBlock):

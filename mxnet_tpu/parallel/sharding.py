@@ -41,12 +41,32 @@ class ShardingRules:
 
     def sharding_for(self, mesh: Mesh, name: str, shape=None) -> NamedSharding:
         spec = self.spec_for(name, shape)
-        # drop axes not present in the mesh
+        # drop axes not present in the mesh (tuple entries element-wise:
+        # a partial match keeps only the mesh's axes)
         names = set(mesh.axis_names)
-        clean = PartitionSpec(*[
-            (a if (a is None or (a if isinstance(a, str) else a[0]) in names)
-             else None) for a in spec])
-        return NamedSharding(mesh, clean)
+        clean = []
+        for a in spec:
+            if a is None or (isinstance(a, str) and a in names):
+                clean.append(a)
+            elif isinstance(a, str):
+                clean.append(None)
+            else:  # tuple of axes
+                kept = tuple(ax for ax in a if ax in names)
+                clean.append(kept if len(kept) > 1 else
+                             (kept[0] if kept else None))
+        # a dim the mesh axes don't divide evenly falls back to replicated
+        # (e.g. an odd vocab over tp=2) instead of crashing at device_put
+        if shape is not None:
+            for i, a in enumerate(clean):
+                if a is None:
+                    continue
+                axes = (a,) if isinstance(a, str) else tuple(a)
+                ways = 1
+                for ax in axes:
+                    ways *= mesh.shape[ax]
+                if shape[i] % ways != 0:
+                    clean[i] = None
+        return NamedSharding(mesh, PartitionSpec(*clean))
 
 
 def default_tp_rules() -> ShardingRules:
