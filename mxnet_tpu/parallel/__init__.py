@@ -20,6 +20,8 @@ from .collectives import (allreduce, allgather, reduce_scatter, broadcast,
                           ppermute_shift, all_to_all)
 from .ring_attention import ring_attention, ring_attention_sharded
 from .ulysses import ulysses_attention, ulysses_attention_sharded
+from .moe import MoEFeedForward, switch_moe
+from .pipeline import pipeline_apply, gpipe_sharded
 from .train import ShardedTrainStep, make_sharded_train_step
 
 __all__ = [
@@ -28,7 +30,9 @@ __all__ = [
     "shard_parameter_tree", "replicated", "collectives", "allreduce",
     "allgather", "reduce_scatter", "broadcast", "ppermute_shift", "all_to_all",
     "ring_attention", "ring_attention_sharded", "ulysses_attention",
-    "ulysses_attention_sharded", "ShardedTrainStep",
+    "ulysses_attention_sharded", "MoEFeedForward", "switch_moe",
+    "pipeline_apply", "gpipe_sharded",
+    "ShardedTrainStep",
     "make_sharded_train_step", "initialize", "rank", "num_workers",
 ]
 

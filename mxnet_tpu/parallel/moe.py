@@ -1,0 +1,119 @@
+"""Mixture-of-Experts with expert parallelism (the 'ep' mesh axis).
+
+New capability beyond the reference (SURVEY.md §2.4 lists EP as absent
+upstream): a Switch-Transformer-style top-1 routed FFN whose expert
+weights are stacked on a leading expert dim and sharded over 'ep'. The
+dispatch/combine are dense einsums over static capacity buffers — the
+GSPMD-friendly formulation: with tokens sharded over 'dp' and experts over
+'ep', XLA lowers the dispatch einsum to the expert all-to-all over ICI.
+
+Everything is static-shaped (capacity_factor bounds tokens/expert; overflow
+tokens are dropped, underflow is zero-padded) so the layer jits and
+composes with the sharded train step.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..gluon.block import HybridBlock
+from ..gluon.parameter import Parameter
+from ..ndarray.ndarray import apply_op
+
+__all__ = ["MoEFeedForward", "switch_moe"]
+
+
+def switch_moe(x, router_w, w_up, w_down, capacity_factor=1.25,
+               activation="gelu", router_noise=0.0, rng_key=None):
+    """Functional top-1 MoE over jax values.
+
+    x: (B, L, H); router_w: (E, H); w_up: (E, I, H); w_down: (E, H, I).
+    Returns (out (B, L, H), aux_loss scalar). Pure jax — safe under jit.
+    """
+    b, l, h = x.shape
+    e = router_w.shape[0]
+    tokens = b * l
+    xt = x.reshape(tokens, h)
+
+    logits = jnp.einsum("th,eh->te", xt.astype(jnp.float32),
+                        router_w.astype(jnp.float32))
+    if router_noise > 0.0 and rng_key is not None:
+        logits = logits + router_noise * jax.random.normal(
+            rng_key, logits.shape, logits.dtype)
+    probs = jax.nn.softmax(logits, axis=-1)            # (T, E)
+    expert = jnp.argmax(probs, axis=-1)                # (T,)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+
+    # load-balance aux loss (Switch eq. 4): E * sum_e f_e * p_e
+    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)   # (T, E)
+    density = onehot.mean(0)
+    density_proxy = probs.mean(0)
+    aux_loss = e * jnp.sum(density * density_proxy)
+
+    capacity = max(1, int(capacity_factor * tokens / e))
+    # position of each token within its expert's buffer
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot       # (T, E)
+    in_cap = (pos < capacity) & (onehot > 0)
+    pos = jnp.sum(pos * in_cap, axis=-1).astype(jnp.int32)  # (T,)
+    kept = jnp.any(in_cap, axis=-1)
+
+    # dispatch tensor (T, E, C): one-hot over expert x slot
+    disp = (onehot * kept[:, None])[:, :, None] * jax.nn.one_hot(
+        pos, capacity, dtype=x.dtype)[:, None, :]
+    disp = disp.astype(x.dtype)
+    buf = jnp.einsum("tec,th->ech", disp, xt)               # (E, C, H)
+
+    # expert FFN (batched over E; sharded on 'ep' when annotated)
+    up = jnp.einsum("ech,eih->eci", buf, w_up.astype(buf.dtype))
+    if activation == "gelu":
+        up = jax.nn.gelu(up)
+    else:
+        up = jax.nn.relu(up)
+    down = jnp.einsum("eci,ehi->ech", up, w_down.astype(up.dtype))
+
+    # combine weighted by the gate
+    out = jnp.einsum("tec,ech->th", disp * gate[:, None, None].astype(
+        x.dtype), down)
+    return out.reshape(b, l, h), aux_loss
+
+
+class MoEFeedForward(HybridBlock):
+    """Routed FFN layer for transformer blocks.
+
+    Expert weights are stacked (E, ...) with `Parameter(sharding=('ep',
+    ...))` annotations so `ShardedTrainStep` places one expert group per
+    'ep' mesh slice. `forward` returns `(out, aux_loss)` — add the
+    load-balance aux loss to the training loss scaled by e.g. 0.01
+    (Switch Transformer's alpha). Returning it (rather than stashing it on
+    an attribute) keeps the layer usable under jit/ShardedTrainStep, where
+    a side-effect attribute would leak a tracer."""
+
+    def __init__(self, hidden_size: int, intermediate_size: int,
+                 num_experts: int, capacity_factor: float = 1.25,
+                 activation: str = "gelu", dtype="float32"):
+        super().__init__()
+        if num_experts < 2:
+            raise MXNetError("MoEFeedForward needs num_experts >= 2")
+        self._cf = capacity_factor
+        self._act = activation
+        self.router = Parameter("router", shape=(num_experts, hidden_size),
+                                dtype=dtype)
+        self.expert_up = Parameter(
+            "expert_up", shape=(num_experts, intermediate_size, hidden_size),
+            dtype=dtype, sharding=("ep", None, None))
+        self.expert_down = Parameter(
+            "expert_down", shape=(num_experts, hidden_size,
+                                  intermediate_size),
+            dtype=dtype, sharding=("ep", None, None))
+
+    def forward(self, x):
+        def fn(xv, rw, wu, wd):
+            out, aux = switch_moe(xv, rw, wu, wd,
+                                  capacity_factor=self._cf,
+                                  activation=self._act)
+            return out, aux
+
+        return apply_op(fn, (x, self.router.data(),
+                             self.expert_up.data(),
+                             self.expert_down.data()), {}, name="moe")

@@ -1,0 +1,113 @@
+"""Pipeline parallelism over a 'pp' mesh axis (SPMD collective-permute
+GPipe).
+
+New capability beyond the reference (SURVEY.md §2.4: PP absent upstream).
+TPU-native formulation — no per-stage processes or schedulers: all stages
+run the SAME program under `shard_map`; each device holds one stage's
+parameters (stacked on a leading stage dim, sharded over 'pp'), and a
+`lax.scan` over ticks shifts in-flight microbatch activations one stage
+forward per tick with `lax.ppermute`. After S + M - 1 ticks every
+microbatch has flowed through all S stages. Differentiable end-to-end
+(jax reverses the ppermutes in the backward pass), so it composes with
+`jax.grad`/`jit` and the dp/tp/sp axes.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from jax import shard_map
+
+from ..base import MXNetError
+
+__all__ = ["pipeline_apply", "gpipe_sharded"]
+
+
+def gpipe_sharded(stage_fn: Callable, stage_params, x_mb,
+                  axis_name: str = "pp"):
+    """Run microbatches through the stage pipeline — call INSIDE shard_map.
+
+    stage_fn(params_leaf_tree, x) -> y, same activation shape in and out.
+    stage_params: pytree whose leaves have a leading LOCAL stage dim of 1
+      (the global stacked dim S is sharded over `axis_name`).
+    x_mb: (M, ...) microbatched input, replicated over `axis_name`.
+    Returns (M, ...) outputs of the LAST stage, replicated (psum-gathered).
+    """
+    s = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    m = x_mb.shape[0]
+    params_local = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+    is_first = my == 0
+    is_last = my == s - 1
+
+    perm = [(i, (i + 1) % s) for i in range(s)]
+    # the scan carry must carry the 'pp'-varying manual-axes type (same
+    # trick as ring_attention's carries): tie it to the local params
+    seed = jax.tree_util.tree_leaves(params_local)[0]
+    zero = jnp.zeros_like(x_mb[0]) + \
+        (0.0 * jnp.sum(seed)).astype(x_mb.dtype)
+
+    def tick(carry, t):
+        inflight = carry                       # activation entering my stage
+        # stage 0 ingests microbatch t while t < M; later stages take the
+        # activation permuted from the previous stage
+        mb_idx = jnp.clip(t, 0, m - 1)
+        fed = jnp.where(is_first, x_mb[mb_idx], inflight)
+        y = stage_fn(params_local, fed)
+        # collect the last stage's result for microbatch t - (S - 1)
+        out_valid = is_last & (t >= s - 1)
+        out = jnp.where(out_valid, y, zero)
+        nxt = lax.ppermute(y, axis_name, perm)
+        return nxt, out
+
+    _, outs = lax.scan(tick, zero, jnp.arange(s + m - 1))
+    # outs[t] is microbatch t-(S-1) on the last stage, zero elsewhere —
+    # select the valid window and broadcast to every stage
+    outs = outs[s - 1:]
+    return lax.psum(outs, axis_name) if s > 1 else outs
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh,
+                   num_microbatches: int, axis_name: str = "pp"):
+    """Top-level GPipe: stage_fn(params, x)->y applied through S stages.
+
+    stacked_params: pytree whose leaves have leading dim S (= size of the
+      `axis_name` mesh axis) — stage i uses leaf[i].
+    x: (B, ...) batch; B must divide into `num_microbatches`.
+    Returns (B, ...) outputs of the final stage.
+    """
+    s = mesh.shape[axis_name]
+    b = x.shape[0]
+    if b % num_microbatches != 0:
+        raise MXNetError(f"batch {b} not divisible into "
+                         f"{num_microbatches} microbatches")
+    leaves = jax.tree_util.tree_leaves(stacked_params)
+    for leaf in leaves:
+        if leaf.shape[0] != s:
+            raise MXNetError(
+                f"stacked parameter leading dim {leaf.shape[0]} != pipeline "
+                f"stages {s} (mesh axis {axis_name!r})")
+    x_mb = x.reshape((num_microbatches, b // num_microbatches) +
+                     tuple(x.shape[1:]))
+
+    pspec = jax.tree_util.tree_map(
+        lambda leaf: P(axis_name, *([None] * (leaf.ndim - 1))),
+        stacked_params)
+    # true data parallelism: shard the per-microbatch batch dim over 'dp'
+    # when the mesh has it and it divides; otherwise replicate
+    mb = b // num_microbatches
+    if "dp" in mesh.axis_names and mesh.shape["dp"] > 1 \
+            and mb % mesh.shape["dp"] == 0:
+        xspec = P(None, "dp")
+    else:
+        xspec = P()
+    fn = functools.partial(gpipe_sharded, stage_fn, axis_name=axis_name)
+    mapped = shard_map(fn, mesh=mesh, in_specs=(pspec, xspec),
+                       out_specs=xspec)
+    out_mb = mapped(stacked_params, x_mb)
+    return out_mb.reshape((b,) + tuple(out_mb.shape[2:]))

@@ -1,0 +1,94 @@
+"""Pipeline parallelism tests (SPMD collective-permute GPipe over 'pp';
+new capability beyond the reference — SURVEY.md §2.4 lists PP as absent
+upstream)."""
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.parallel import make_mesh, pipeline_apply
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices("cpu")) < 4, reason="needs 4 virtual devices")
+
+S, B, H = 4, 8, 16
+
+
+def _stage_fn(params, x):
+    w, b = params
+    return jnp.tanh(x @ w + b)
+
+
+def _params(seed=0):
+    rng = onp.random.RandomState(seed)
+    w = jnp.asarray(rng.standard_normal((S, H, H)) * 0.5, jnp.float32)
+    b = jnp.asarray(rng.standard_normal((S, H)) * 0.1, jnp.float32)
+    return (w, b)
+
+
+def _sequential(params, x):
+    w, b = params
+    for i in range(S):
+        x = jnp.tanh(x @ w[i] + b[i])
+    return x
+
+
+def test_pipeline_matches_sequential():
+    params = _params()
+    rng = onp.random.RandomState(1)
+    x = jnp.asarray(rng.standard_normal((B, H)), jnp.float32)
+    mesh = make_mesh({"pp": S}, jax.devices("cpu")[:S])
+    want = _sequential(params, x)
+    for m in (2, 4, 8):     # microbatch counts incl. M != S
+        got = pipeline_apply(_stage_fn, params, x, mesh,
+                             num_microbatches=m)
+        onp.testing.assert_allclose(onp.asarray(got), onp.asarray(want),
+                                    rtol=2e-5, atol=2e-6)
+
+
+def test_pipeline_differentiable():
+    params = _params(seed=2)
+    rng = onp.random.RandomState(3)
+    x = jnp.asarray(rng.standard_normal((B, H)), jnp.float32)
+    tgt = jnp.asarray(rng.standard_normal((B, H)), jnp.float32)
+    mesh = make_mesh({"pp": S}, jax.devices("cpu")[:S])
+
+    def loss_pp(p):
+        return jnp.mean((pipeline_apply(_stage_fn, p, x, mesh, 4) - tgt) ** 2)
+
+    def loss_seq(p):
+        return jnp.mean((_sequential(p, x) - tgt) ** 2)
+
+    g_pp = jax.grad(loss_pp)(params)
+    g_seq = jax.grad(loss_seq)(params)
+    for a, b_ in zip(jax.tree_util.tree_leaves(g_pp),
+                     jax.tree_util.tree_leaves(g_seq)):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b_),
+                                    rtol=5e-4, atol=5e-6)
+
+
+def test_pipeline_composes_with_dp():
+    """pp x dp mesh: batch sharded over dp, stages over pp."""
+    if len(jax.devices("cpu")) < 8:
+        pytest.skip("needs 8 virtual devices")
+    params = _params(seed=4)
+    rng = onp.random.RandomState(5)
+    x = jnp.asarray(rng.standard_normal((B, H)), jnp.float32)
+    mesh = make_mesh({"pp": S, "dp": 2}, jax.devices("cpu")[:8])
+    got = pipeline_apply(_stage_fn, params, x, mesh, num_microbatches=4)
+    onp.testing.assert_allclose(onp.asarray(got),
+                                onp.asarray(_sequential(params, x)),
+                                rtol=2e-5, atol=2e-6)
+
+
+def test_pipeline_validation_errors():
+    params = _params()
+    x = jnp.zeros((B, H), jnp.float32)
+    mesh = make_mesh({"pp": S}, jax.devices("cpu")[:S])
+    with pytest.raises(MXNetError, match="microbatch"):
+        pipeline_apply(_stage_fn, params, x, mesh, num_microbatches=3)
+    bad = (jnp.zeros((S + 1, H, H)), jnp.zeros((S + 1, H)))
+    with pytest.raises(MXNetError, match="stages"):
+        pipeline_apply(_stage_fn, bad, x, mesh, num_microbatches=4)
