@@ -57,6 +57,7 @@ from . import symbol as sym  # noqa: F401
 from . import onnx  # noqa: F401
 from . import library  # noqa: F401
 from . import subgraph  # noqa: F401
+from . import elastic  # noqa: F401
 from . import benchmark  # noqa: F401
 from . import _native  # noqa: F401
 

@@ -1,0 +1,279 @@
+"""Elastic training / fault tolerance (SURVEY.md §5.3 — NEW capability).
+
+The reference has no recovery story: a dead ps-lite server or worker kills
+the whole job (`src/kvstore/kvstore_dist.h` — no rejoin path; SURVEY §5.3).
+On TPU the failure model is different and simpler to cover:
+
+* **preemption** — Cloud TPU sends SIGTERM with a grace window; the right
+  response is save-and-exit, then the scheduler restarts the job and it
+  resumes from the newest checkpoint.
+* **transient runtime errors** — tunnel/network hiccups or collective
+  timeouts surface as ``RuntimeError`` / ``MXNetError`` at the sync point
+  (XLA's async dispatch defers errors, like the reference engine's
+  exception propagation, `src/engine/threaded_engine.h:67`). Recovery is
+  restore-from-checkpoint and retry.
+* **hangs** — a stuck collective never raises. A watchdog thread detects a
+  step that stopped completing, dumps all-thread stacks, and (optionally)
+  kills the process so the supervisor can restart it.
+
+`ElasticLoop` composes these around any step callable and any checkpoint
+target exposing ``save(path)``/``load(path)`` (canonically
+`parallel.ShardedTrainStep`, via `utils.CheckpointManager`).
+
+Usage::
+
+    step = make_sharded_train_step(model, opt, loss_fn, mesh)
+    loop = ElasticLoop(step, directory="/ckpts", save_every=500)
+    loop.run(lambda i: step(*batch(i)), total_steps=10_000)
+"""
+from __future__ import annotations
+
+import faulthandler
+import logging
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Callable, Optional, Sequence
+
+from .base import MXNetError
+from .utils.checkpoint import CheckpointManager
+
+__all__ = ["PreemptionGuard", "Watchdog", "FailureInjector", "ElasticLoop",
+           "sync_flag"]
+
+_log = logging.getLogger(__name__)
+
+
+class PreemptionGuard:
+    """Convert termination signals into a cooperative stop flag.
+
+    Installs handlers for `signals` (default SIGTERM — what Cloud TPU
+    preemption delivers) that set :attr:`preempted` instead of killing the
+    process, giving the training loop a grace window to checkpoint. Restores
+    the previous handlers on exit. Signal handlers only work on the main
+    thread; elsewhere the guard degrades to a manual flag
+    (:meth:`request_stop`).
+    """
+
+    def __init__(self, signals: Sequence[int] = (signal.SIGTERM,)):
+        self._signals = tuple(signals)
+        self._prev = {}
+        self._event = threading.Event()
+        self._installed = False
+
+    @property
+    def preempted(self) -> bool:
+        return self._event.is_set()
+
+    def request_stop(self) -> None:
+        """Manually trigger the stop flag (tests, custom schedulers)."""
+        self._event.set()
+
+    def _handler(self, signum, frame):
+        _log.warning("received signal %d: requesting checkpoint-and-exit",
+                     signum)
+        self._event.set()
+
+    def __enter__(self):
+        if threading.current_thread() is threading.main_thread():
+            for s in self._signals:
+                self._prev[s] = signal.signal(s, self._handler)
+            self._installed = True
+        return self
+
+    def __exit__(self, *exc):
+        if self._installed:
+            for s, h in self._prev.items():
+                signal.signal(s, h)
+            self._prev.clear()
+            self._installed = False
+        return False
+
+
+class Watchdog:
+    """Hang detector: a daemon thread that fires if :meth:`ping` is not
+    called within `timeout` seconds.
+
+    On expiry it dumps every thread's stack to stderr (the evidence a hung
+    collective leaves nowhere else), invokes `on_hang`, and — when
+    `kill=True` — SIGABRTs the process so a supervisor can restart it. The
+    default is detect-and-report only.
+    """
+
+    def __init__(self, timeout: float, on_hang: Optional[Callable] = None,
+                 kill: bool = False):
+        if timeout <= 0:
+            raise MXNetError("watchdog timeout must be positive")
+        self.timeout = timeout
+        self.on_hang = on_hang
+        self.kill = kill
+        self.fired = False
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self._thread = None
+
+    def ping(self) -> None:
+        self._last = time.monotonic()
+
+    def _watch(self):
+        while not self._stop.wait(min(self.timeout / 4, 1.0)):
+            if time.monotonic() - self._last > self.timeout:
+                self.fired = True
+                _log.error("watchdog: no step completion in %.1fs — "
+                           "dumping stacks", self.timeout)
+                try:
+                    faulthandler.dump_traceback(file=sys.stderr)
+                except Exception:
+                    pass
+                if self.on_hang is not None:
+                    try:
+                        self.on_hang()
+                    except Exception:
+                        _log.exception("watchdog on_hang callback failed")
+                if self.kill:
+                    os.kill(os.getpid(), signal.SIGABRT)
+                self._last = time.monotonic()  # avoid refiring every poll
+
+    def __enter__(self):
+        self.ping()
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._watch, daemon=True,
+                                        name="mxtpu-watchdog")
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        return False
+
+
+class FailureInjector:
+    """Deterministic fault injection (SURVEY §5.3 names fault *injection*
+    as part of the recovery test strategy). Raises `exc_type` the first
+    time each step in `at_steps` is reached."""
+
+    def __init__(self, at_steps: Sequence[int],
+                 exc_type=RuntimeError):
+        self._pending = set(at_steps)
+        self._exc_type = exc_type
+        self.injected = []
+
+    def check(self, step: int) -> None:
+        if step in self._pending:
+            self._pending.discard(step)
+            self.injected.append(step)
+            raise self._exc_type(f"injected failure at step {step}")
+
+
+def sync_flag(flag: bool) -> bool:
+    """Agree on a boolean across all processes (logical OR), so e.g. a
+    preemption notice on one host checkpoints every host at the same step.
+    Single-process: identity."""
+    import jax
+    if jax.process_count() == 1:
+        return bool(flag)
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+    v = multihost_utils.process_allgather(jnp.asarray([1 if flag else 0]))
+    return bool(v.max())
+
+
+class ElasticLoop:
+    """Checkpointed, preemption-aware, self-restoring training loop.
+
+    Composes `CheckpointManager` (periodic atomic saves + resume),
+    `PreemptionGuard` (SIGTERM → save-and-exit), `Watchdog` (hang report)
+    and restore-retry on transient step failures around a user step
+    function ``step_fn(i) -> loss``.
+
+    The `target` must expose ``save(path)``/``load(path)``. Returns a dict
+    with the exit status — ``"completed"``, ``"preempted"`` (checkpoint
+    written; rerun to resume), or raises after `max_restores` failed
+    recoveries.
+    """
+
+    def __init__(self, target, directory: str, save_every: int = 100,
+                 keep: int = 3, max_restores: int = 3,
+                 watchdog_timeout: Optional[float] = None,
+                 retry_on=(RuntimeError, MXNetError),
+                 failure_injector: Optional[FailureInjector] = None):
+        self.target = target
+        self.manager = CheckpointManager(directory, keep=keep)
+        self.save_every = save_every
+        self.max_restores = max_restores
+        self.watchdog_timeout = watchdog_timeout
+        self.retry_on = tuple(retry_on)
+        self.failure_injector = failure_injector
+
+    def run(self, step_fn: Callable[[int], object], total_steps: int,
+            on_step: Optional[Callable[[int, object], None]] = None) -> dict:
+        restores = 0       # total, reported in the result
+        consecutive = 0    # failed recoveries in a row, bounds the retry
+        start = self.manager.restore(self.target)
+        if start:
+            _log.info("elastic: resumed from checkpoint at step %d", start)
+        elif self.manager.latest() is None:
+            # anchor checkpoint so a failure before the first periodic save
+            # still has a consistent state to roll back to
+            self.manager.save(self.target, 0)
+        guard = PreemptionGuard()
+        watchdog = (Watchdog(self.watchdog_timeout)
+                    if self.watchdog_timeout else None)
+        last_loss = None
+        i = start
+        with guard:
+            ctx = watchdog if watchdog is not None else _null_ctx()
+            with ctx:
+                while i < total_steps:
+                    if sync_flag(guard.preempted):
+                        path = self.manager.save(self.target, i)
+                        _log.warning("elastic: preempted at step %d; "
+                                     "checkpoint %s written", i, path)
+                        return {"status": "preempted", "step": i,
+                                "checkpoint": path, "restores": restores}
+                    try:
+                        if self.failure_injector is not None:
+                            self.failure_injector.check(i)
+                        last_loss = step_fn(i)
+                        # a completed step proves the recovery worked;
+                        # max_restores bounds CONSECUTIVE failed recoveries,
+                        # not total hiccups over a long job's lifetime
+                        consecutive = 0
+                    except self.retry_on as e:
+                        restores += 1
+                        consecutive += 1
+                        if consecutive > self.max_restores:
+                            raise MXNetError(
+                                f"elastic: step {i} failed after "
+                                f"{self.max_restores} restores") from e
+                        rollback = self.manager.restore(self.target)
+                        _log.warning(
+                            "elastic: step %d failed (%s); restored "
+                            "checkpoint at step %d (restore %d/%d)",
+                            i, e, rollback, consecutive, self.max_restores)
+                        i = rollback
+                        continue
+                    i += 1
+                    if watchdog is not None:
+                        watchdog.ping()
+                    if on_step is not None:
+                        on_step(i, last_loss)
+                    self.manager.maybe_save(self.target, i,
+                                            every=self.save_every)
+        final = self.manager.save(self.target, total_steps)
+        return {"status": "completed", "step": total_steps,
+                "checkpoint": final, "restores": restores,
+                "loss": last_loss}
+
+
+class _null_ctx:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

@@ -600,14 +600,21 @@ def apply_op(fn: Callable, array_args: Sequence[ndarray], kwargs: dict,
 
 def _apply_op(fn: Callable, array_args: Sequence[ndarray], kwargs: dict,
               name: str = "op", n_out: int = 1):
-    vals = [a._data for a in array_args]
-    device = array_args[0]._device if array_args else current_device()
+    # accept raw jax values (incl. tracers) alongside ndarray wrappers, so
+    # mx ops compose inside user jit/grad code — e.g. a loss_fn handed jax
+    # arrays by the sharded train step. Raw values carry no tape state;
+    # the enclosing jax transform differentiates them.
+    vals = [a._data if isinstance(a, ndarray) else a for a in array_args]
+    device = next((a._device for a in array_args if isinstance(a, ndarray)),
+                  current_device())
 
     recording = _tape.is_recording()
     diff_idx = []
     if recording:
         for i, a in enumerate(array_args):
-            if (a._ag_node is not None or a._grad_req != "null") and _is_inexact(a._data):
+            if isinstance(a, ndarray) and \
+                    (a._ag_node is not None or a._grad_req != "null") \
+                    and _is_inexact(a._data):
                 diff_idx.append(i)
 
     if not diff_idx:

@@ -404,6 +404,13 @@ _DELEGATE = [
     "result_type", "promote_types", "shape", "ndim", "size", "iscomplexobj",
     "insert", "delete", "resize", "setdiff1d", "union1d", "intersect1d",
     "isin", "in1d", "fill_diagonal",
+    # long-tail NumPy-compat surface (reference serves these via its onp
+    # fallback table, `python/mxnet/numpy/fallback.py:25`; jnp implements
+    # them natively so they stay on-device here)
+    "apply_along_axis", "apply_over_axes", "divmod", "ix_", "modf",
+    "packbits", "unpackbits", "poly", "polyadd", "polyder", "polydiv",
+    "polyfit", "polyint", "polymul", "polysub", "polyval", "roots",
+    "setxor1d", "spacing", "tril_indices_from", "unwrap",
 ]
 
 _g = globals()
@@ -509,11 +516,30 @@ def bfloat16_cast(a):
     return a.astype(jnp.bfloat16)
 
 
+# NumPy-compat aliases for names modern NumPy/jnp renamed or dropped
+# (reference fallback table `python/mxnet/numpy/fallback.py:25`)
+trapz = wrap_fn(jnp.trapezoid, "trapz")
+
+
+def msort(a):
+    """Sort along the first axis (removed in NumPy 2.0; kept for parity)."""
+    return sort(a, axis=0)
+
+
+def alltrue(a, axis=None, **kwargs):
+    return all(a, axis=axis, **kwargs)
+
+
+def min_scalar_type(a):
+    return _onp.min_scalar_type(a.asnumpy() if isinstance(a, ndarray) else a)
+
+
 # -----------------------------------------------------------------------
 # submodules
 # -----------------------------------------------------------------------
 from . import linalg  # noqa: E402
 from . import random  # noqa: E402
+from . import fft  # noqa: E402
 
 ndarray = ndarray  # re-export
 

@@ -1,0 +1,29 @@
+"""`mx.np.fft` — discrete Fourier transforms.
+
+The reference serves FFTs two ways: the contrib op pair
+(`src/operator/contrib/fft-inl.h`, interleaved-layout cuFFT wrapper —
+mirrored by `mxnet_tpu.contrib.op.fft/ifft`) and NumPy fallback for the
+`np.fft` module (`python/mxnet/numpy/utils.py:70` lists `onp.fft` among the
+op modules). Here the whole module is jnp.fft — XLA lowers these natively,
+so they run on-device (TPU) instead of the reference's host round-trip.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._wrap import wrap_fn
+
+_NAMES = [
+    "fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
+    "rfft", "irfft", "rfft2", "irfft2", "rfftn", "irfftn",
+    "hfft", "ihfft",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+_g = globals()
+for _name in _NAMES:
+    _j = getattr(jnp.fft, _name, None)
+    if _j is not None:
+        _g[_name] = wrap_fn(_j, _name)
+
+__all__ = [n for n in _NAMES if n in _g]

@@ -81,6 +81,17 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh,
     x: (B, ...) batch; B must divide into `num_microbatches`.
     Returns (B, ...) outputs of the final stage.
     """
+    # make_mesh drops size-1 axes, so a degenerate pp=1 mesh has no
+    # `axis_name` at all — run the single stage directly (microbatching
+    # and the shard_map specs would otherwise name a nonexistent axis)
+    if axis_name not in mesh.axis_names:
+        single = jax.tree_util.tree_map(lambda leaf: leaf[0], stacked_params)
+        for leaf in jax.tree_util.tree_leaves(stacked_params):
+            if leaf.shape[0] != 1:
+                raise MXNetError(
+                    f"stacked parameter leading dim {leaf.shape[0]} != 1 "
+                    f"but mesh has no {axis_name!r} axis")
+        return stage_fn(single, x)
     s = mesh.shape[axis_name]
     b = x.shape[0]
     if b % num_microbatches != 0:

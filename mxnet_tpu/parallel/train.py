@@ -97,14 +97,28 @@ class ShardedTrainStep:
                     f"has rank {len(spec)} > parameter rank "
                     f"{len(param.shape)} (shape {tuple(param.shape)})")
             names = set(mesh.axis_names)
+            from .mesh import AXES as _KNOWN_AXES
+            cleaned = []
             for a in spec:
-                axes = (a,) if isinstance(a, str) else (a or ())
+                axes = (a,) if isinstance(a, str) else tuple(a or ())
+                kept = []
                 for ax in axes:
-                    if ax not in names:
+                    if ax in names:
+                        kept.append(ax)
+                    elif ax in _KNOWN_AXES:
+                        # a standard parallelism axis this mesh runs at
+                        # size 1 (make_mesh drops those): the annotation
+                        # degrades to replicated on that axis, so the same
+                        # model code works when the mesh shrinks
+                        continue
+                    else:
                         raise MXNetError(
                             f"parameter {name}: sharding annotation names "
                             f"mesh axis {ax!r} but this mesh has axes "
                             f"{sorted(names)}")
+                cleaned.append(kept[0] if len(kept) == 1
+                               else (tuple(kept) if kept else None))
+            spec = P(*cleaned)
             return NamedSharding(mesh, spec)
         sharding = self.rules.sharding_for(mesh, name, param.shape)
         # 'dp' replicates params by design; 'sp' shards activations, never
@@ -152,7 +166,11 @@ class ShardedTrainStep:
                 model_args = batch if n_model is None else batch[:n_model]
                 out, aux = functional_call(block, pv, *model_args,
                                            training=True, rng_key=key)
-                return loss_fn(out, *batch), aux
+                loss = loss_fn(out, *batch)
+                # a loss_fn written in mx.np ops returns a wrapped scalar;
+                # unwrap so value_and_grad sees a jax value
+                loss = getattr(loss, "_data", loss)
+                return loss, aux
 
             diff_vals = {n: pvals[n] for n in diff_names}
             (loss, aux), grads = jax.value_and_grad(

@@ -10,8 +10,6 @@ import mxnet_tpu as mx
 from mxnet_tpu import autograd, gluon
 from mxnet_tpu.parallel import MoEFeedForward, switch_moe, make_mesh, \
     make_sharded_train_step
-from mxnet_tpu.parallel.moe import switch_moe as _sm
-
 B, L, H, I, E = 2, 8, 16, 32, 4
 
 

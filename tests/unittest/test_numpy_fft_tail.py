@@ -1,0 +1,141 @@
+"""np.fft module + long-tail NumPy-compat names (reference:
+`python/mxnet/numpy/fallback.py:25` fallback table; fft via
+`python/mxnet/numpy/utils.py:70`). Values are checked against real NumPy."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _np(x):
+    return x.asnumpy() if hasattr(x, "asnumpy") else onp.asarray(x)
+
+
+class TestFFT:
+    def setup_method(self, _):
+        self.rng = onp.random.RandomState(7)
+
+    def test_fft_ifft_roundtrip(self):
+        x = self.rng.randn(4, 16).astype("float32")
+        a = mx.np.array(x)
+        f = mx.np.fft.fft(a)
+        assert _np(f).dtype == onp.complex64
+        onp.testing.assert_allclose(_np(mx.np.fft.ifft(f)).real, x,
+                                    atol=1e-4)
+        onp.testing.assert_allclose(_np(f), onp.fft.fft(x), rtol=1e-3,
+                                    atol=1e-3)
+
+    def test_rfft_irfft(self):
+        x = self.rng.randn(8, 32).astype("float32")
+        f = mx.np.fft.rfft(mx.np.array(x))
+        assert f.shape == (8, 17)
+        onp.testing.assert_allclose(_np(f), onp.fft.rfft(x), rtol=1e-3,
+                                    atol=1e-3)
+        back = mx.np.fft.irfft(f, )
+        onp.testing.assert_allclose(_np(back), x, atol=1e-4)
+
+    @pytest.mark.parametrize("name", ["fft2", "fftn"])
+    def test_2d_nd(self, name):
+        x = self.rng.randn(3, 8, 8).astype("float32")
+        got = _np(getattr(mx.np.fft, name)(mx.np.array(x)))
+        want = getattr(onp.fft, name)(x)
+        onp.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+    def test_fftshift_fftfreq(self):
+        onp.testing.assert_allclose(_np(mx.np.fft.fftfreq(8, d=0.5)),
+                                    onp.fft.fftfreq(8, d=0.5), rtol=1e-6)
+        x = onp.arange(8.0)
+        onp.testing.assert_allclose(_np(mx.np.fft.fftshift(mx.np.array(x))),
+                                    onp.fft.fftshift(x))
+
+    def test_fft_gradient(self):
+        """FFT is linear: d/dx of sum(|fft(x)|^2) is well-defined and XLA
+        differentiates it — something the reference's onp fallback cannot."""
+        x = mx.np.array(self.rng.randn(16).astype("float32"))
+        x.attach_grad()
+        with mx.autograd.record():
+            y = (mx.np.abs(mx.np.fft.fft(x)) ** 2).sum()
+        y.backward()
+        # Parseval: sum|F|^2 = N * sum|x|^2, so grad = 2N x
+        onp.testing.assert_allclose(_np(x.grad), 2 * 16 * _np(x),
+                                    rtol=1e-3)
+
+
+class TestLongTail:
+    def setup_method(self, _):
+        self.rng = onp.random.RandomState(3)
+
+    def test_polyfit_polyval_roots(self):
+        x = onp.linspace(-1, 1, 9).astype("float64")
+        y = 2 * x ** 2 + 3 * x - 1
+        c = _np(mx.np.polyfit(mx.np.array(x), mx.np.array(y), 2))
+        onp.testing.assert_allclose(c, [2, 3, -1], atol=1e-4)
+        v = _np(mx.np.polyval(mx.np.array([2.0, 3, -1]),
+                              mx.np.array([0.0, 1.0])))
+        onp.testing.assert_allclose(v, [-1, 4], atol=1e-5)
+        r = sorted(_np(mx.np.roots(mx.np.array([1.0, -3, 2]))).real)
+        onp.testing.assert_allclose(r, [1, 2], atol=1e-4)
+
+    def test_poly_arithmetic(self):
+        a, b = [1.0, 2.0], [1.0, -1.0]
+        onp.testing.assert_allclose(
+            _np(mx.np.polymul(mx.np.array(a), mx.np.array(b))),
+            onp.polymul(a, b))
+        onp.testing.assert_allclose(
+            _np(mx.np.polyadd(mx.np.array(a), mx.np.array(b))),
+            onp.polyadd(a, b))
+
+    def test_unwrap_modf_divmod(self):
+        p = onp.array([0.0, 0.5, 6.5, 7.0])
+        onp.testing.assert_allclose(_np(mx.np.unwrap(mx.np.array(p))),
+                                    onp.unwrap(p), rtol=1e-4, atol=1e-6)
+        frac, whole = mx.np.modf(mx.np.array([1.5, -2.25]))
+        onp.testing.assert_allclose(_np(frac), [0.5, -0.25])
+        onp.testing.assert_allclose(_np(whole), [1.0, -2.0])
+        q, r = mx.np.divmod(mx.np.array([7, -7]), 3)
+        onp.testing.assert_allclose(_np(q), [2, -3])
+        onp.testing.assert_allclose(_np(r), [1, 2])
+
+    def test_packbits_unpackbits(self):
+        bits = onp.array([1, 0, 1, 1, 0, 0, 0, 1], dtype=onp.uint8)
+        packed = _np(mx.np.packbits(mx.np.array(bits)))
+        onp.testing.assert_array_equal(packed, onp.packbits(bits))
+        onp.testing.assert_array_equal(
+            _np(mx.np.unpackbits(mx.np.array(packed))),
+            onp.unpackbits(onp.packbits(bits)))
+
+    def test_setxor1d_apply_along_axis(self):
+        a, b = onp.array([1, 2, 3, 4]), onp.array([3, 4, 5])
+        onp.testing.assert_array_equal(
+            _np(mx.np.setxor1d(mx.np.array(a), mx.np.array(b))),
+            onp.setxor1d(a, b))
+        m = self.rng.randn(3, 4).astype("float32")
+        got = _np(mx.np.apply_along_axis(lambda r: r.sum(), 1,
+                                         mx.np.array(m)))
+        onp.testing.assert_allclose(got, m.sum(axis=1), rtol=1e-5)
+
+    def test_renamed_aliases(self):
+        y = onp.array([0.0, 1.0, 4.0, 9.0])
+        onp.testing.assert_allclose(float(mx.np.trapz(mx.np.array(y))),
+                                    onp.trapezoid(y)
+                                    if hasattr(onp, "trapezoid")
+                                    else onp.trapz(y))
+        m = onp.array([[3.0, 1.0], [2.0, 4.0]])
+        onp.testing.assert_allclose(_np(mx.np.msort(mx.np.array(m))),
+                                    onp.sort(m, axis=0))
+        assert bool(mx.np.alltrue(mx.np.array([1, 1, 1])))
+        assert not bool(mx.np.alltrue(mx.np.array([1, 0])))
+
+    def test_indexing_helpers(self):
+        ix = mx.np.ix_(mx.np.array([0, 2]), mx.np.array([1, 3]))
+        m = self.rng.randn(4, 4).astype("float32")
+        got = _np(mx.np.array(m)[ix])
+        onp.testing.assert_allclose(got, m[onp.ix_([0, 2], [1, 3])])
+        tri = mx.np.tril_indices_from(mx.np.array(m))
+        want = onp.tril_indices_from(m)
+        onp.testing.assert_array_equal(_np(tri[0]), want[0])
+        onp.testing.assert_array_equal(_np(tri[1]), want[1])
+
+    def test_dtype_queries(self):
+        assert mx.np.min_scalar_type(255) == onp.min_scalar_type(255)
+        assert _np(mx.np.spacing(mx.np.array([1.0]))).dtype == onp.float32
