@@ -1,0 +1,41 @@
+/*
+ * C++ inference walkthrough (parity: the reference's
+ * cpp-package/example/mlp.cpp, redesigned for exported-model inference):
+ * load a `HybridBlock.export` artifact pair, run it, and exercise the
+ * by-name operator surface. Expects argv[1] = symbol file, argv[2] =
+ * params file; prints the argmax of the first output row.
+ */
+#include <cstdio>
+#include <vector>
+
+#include "mxnet-tpu-cpp/MxNetTpuCpp.hpp"
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::fprintf(stderr, "usage: %s <symbol.stablehlo> <params>\n", argv[0]);
+    return 2;
+  }
+  const char* platform = argc > 3 ? argv[3] : "";
+  mxtpu::Runtime rt(platform);
+  mxtpu::Runtime::Seed(7);
+
+  // by-name operator invocation
+  auto x = mxtpu::NDArray::FromVector({2, 4}, {1, -2, 3, -4,
+                                               -1, 2, -3, 4});
+  auto r = mxtpu::Op("relu")(x);
+  auto v = r.ToVector();
+  std::printf("relu: %.1f %.1f %.1f %.1f\n", v[0], v[1], v[2], v[3]);
+
+  // exported-model inference
+  mxtpu::Model model(argv[1], argv[2]);
+  auto in = mxtpu::NDArray::FromVector({1, 4}, {0.5f, -0.5f, 0.25f, 1.0f});
+  auto out = model.Forward({&in});
+  auto probs = out[0].ToVector();
+  int best = 0;
+  for (size_t i = 1; i < probs.size(); ++i) {
+    if (probs[i] > probs[best]) best = static_cast<int>(i);
+  }
+  std::printf("model outputs %zu values; argmax=%d\n", probs.size(), best);
+  std::printf("MXTPU_CPP_OK\n");
+  return 0;
+}

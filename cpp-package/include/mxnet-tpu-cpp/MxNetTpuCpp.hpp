@@ -1,0 +1,178 @@
+/*
+ * mxnet_tpu C++ user API — RAII wrappers over the C ABI (c_api.h).
+ *
+ * Parity target: `cpp-package/include/mxnet-cpp/MxNetCpp.h` and friends
+ * (NDArray ndarray.h, Operator operator.h, model load/run executor.h).
+ * The surface is redesigned for the TPU runtime's shape: there is no
+ * Symbol/Executor split (a Model IS a compiled XLA executable restored
+ * from `HybridBlock.export`), and operator invocation is by name against
+ * the `mx.np`/`mx.npx` namespaces — the registry the Python front end
+ * uses, so the two APIs can never drift.
+ *
+ * Usage:
+ *   mxtpu::Runtime rt("cpu");                  // or "tpu" / "" = default
+ *   auto x = mxtpu::NDArray::FromVector({2, 3}, data);
+ *   auto y = mxtpu::Op("relu")(x);
+ *   mxtpu::Model m("net-symbol.stablehlo", "net-0000.params");
+ *   auto out = m.Forward({x});
+ */
+#ifndef MXNET_TPU_CPP_MXNETTPUCPP_HPP_
+#define MXNET_TPU_CPP_MXNETTPUCPP_HPP_
+
+#include <cstdint>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "c_api.h"
+
+namespace mxtpu {
+
+inline void Check(int rc, const char* what) {
+  if (rc != 0) {
+    throw std::runtime_error(std::string(what) + ": " + MXTPUGetLastError());
+  }
+}
+
+/* Owns runtime init/teardown. Construct exactly one, first. */
+class Runtime {
+ public:
+  explicit Runtime(const std::string& platform = "") {
+    Check(MXTPUInit(platform.empty() ? nullptr : platform.c_str()),
+          "MXTPUInit");
+  }
+  ~Runtime() { MXTPUShutdown(); }
+  Runtime(const Runtime&) = delete;
+  Runtime& operator=(const Runtime&) = delete;
+
+  static void Seed(int seed) { Check(MXTPURandomSeed(seed), "Seed"); }
+};
+
+class NDArray {
+ public:
+  NDArray() = default;
+  explicit NDArray(MXTPUNDArrayHandle h) : handle_(h) {}
+
+  static NDArray FromVector(const std::vector<int64_t>& shape,
+                            const std::vector<float>& data) {
+    MXTPUNDArrayHandle h = nullptr;
+    Check(MXTPUNDArrayCreate(data.data(), shape.data(),
+                             static_cast<int>(shape.size()), &h),
+          "NDArrayCreate");
+    return NDArray(h);
+  }
+
+  ~NDArray() { reset(); }
+  NDArray(NDArray&& o) noexcept : handle_(o.handle_) { o.handle_ = nullptr; }
+  NDArray& operator=(NDArray&& o) noexcept {
+    if (this != &o) {
+      reset();
+      handle_ = o.handle_;
+      o.handle_ = nullptr;
+    }
+    return *this;
+  }
+  NDArray(const NDArray&) = delete;
+  NDArray& operator=(const NDArray&) = delete;
+
+  std::vector<int64_t> Shape() const {
+    int64_t dims[8];
+    int ndim = 0;
+    Check(MXTPUNDArrayShape(handle_, dims, &ndim), "NDArrayShape");
+    return std::vector<int64_t>(dims, dims + ndim);
+  }
+
+  int64_t Size() const {
+    int64_t n = 0;
+    Check(MXTPUNDArraySize(handle_, &n), "NDArraySize");
+    return n;
+  }
+
+  /* Blocking device->host fetch (the reference's SyncCopyToCPU). */
+  std::vector<float> ToVector() const {
+    std::vector<float> out(static_cast<size_t>(Size()));
+    Check(MXTPUNDArrayCopyTo(handle_, out.data(),
+                             static_cast<int64_t>(out.size())),
+          "NDArrayCopyTo");
+    return out;
+  }
+
+  MXTPUNDArrayHandle handle() const { return handle_; }
+
+ private:
+  void reset() {
+    if (handle_ != nullptr) {
+      MXTPUNDArrayFree(handle_);
+      handle_ = nullptr;
+    }
+  }
+  MXTPUNDArrayHandle handle_ = nullptr;
+};
+
+/* Named-operator functor (the reference's Operator("relu")(x).Invoke()). */
+class Op {
+ public:
+  explicit Op(std::string name, std::string kwargs_json = "")
+      : name_(std::move(name)), kwargs_(std::move(kwargs_json)) {}
+
+  NDArray operator()(const NDArray& a) const { return Invoke({&a}); }
+  NDArray operator()(const NDArray& a, const NDArray& b) const {
+    return Invoke({&a, &b});
+  }
+  NDArray Invoke(const std::vector<const NDArray*>& inputs) const {
+    std::vector<MXTPUNDArrayHandle> hs;
+    hs.reserve(inputs.size());
+    for (const NDArray* p : inputs) hs.push_back(p->handle());
+    MXTPUNDArrayHandle out = nullptr;
+    Check(MXTPUInvoke(name_.c_str(), hs.data(),
+                      static_cast<int>(hs.size()),
+                      kwargs_.empty() ? nullptr : kwargs_.c_str(), &out),
+          name_.c_str());
+    return NDArray(out);
+  }
+
+ private:
+  std::string name_;
+  std::string kwargs_;
+};
+
+/* A compiled model restored from HybridBlock.export artifacts. */
+class Model {
+ public:
+  Model(const std::string& symbol_file, const std::string& params_file) {
+    Check(MXTPUModelLoad(symbol_file.c_str(),
+                         params_file.empty() ? nullptr : params_file.c_str(),
+                         &handle_),
+          "ModelLoad");
+  }
+  ~Model() {
+    if (handle_ != nullptr) MXTPUModelFree(handle_);
+  }
+  Model(const Model&) = delete;
+  Model& operator=(const Model&) = delete;
+
+  std::vector<NDArray> Forward(const std::vector<const NDArray*>& inputs,
+                               int max_outputs = 8) const {
+    std::vector<MXTPUNDArrayHandle> hs;
+    hs.reserve(inputs.size());
+    for (const NDArray* p : inputs) hs.push_back(p->handle());
+    std::vector<MXTPUNDArrayHandle> outs(max_outputs, nullptr);
+    int n_out = max_outputs;
+    Check(MXTPUModelForward(handle_, hs.data(),
+                            static_cast<int>(hs.size()), outs.data(),
+                            &n_out),
+          "ModelForward");
+    std::vector<NDArray> result;
+    result.reserve(n_out);
+    for (int i = 0; i < n_out; ++i) result.emplace_back(outs[i]);
+    return result;
+  }
+
+ private:
+  MXTPUModelHandle handle_ = nullptr;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXNET_TPU_CPP_MXNETTPUCPP_HPP_

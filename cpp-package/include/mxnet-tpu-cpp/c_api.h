@@ -1,0 +1,84 @@
+/*
+ * mxnet_tpu C ABI — the native entry point for non-Python users.
+ *
+ * Parity target: the reference's `cpp-package/include/mxnet-cpp/` wraps a C
+ * ABI (`include/mxnet/c_api.h`, 246 MX* functions) over its C++ engine. In
+ * this framework the "engine" is the JAX/XLA runtime, which owns the PjRt
+ * TPU client from Python — so the TPU-native C ABI hosts an embedded CPython
+ * interpreter and drives the same runtime a Python user gets: one compile
+ * path, one allocator, one device claim. (Design decision, not a stand-in:
+ * a second, Python-free PjRt client in the same process would fight the
+ * first for the exclusive TPU chip claim.)
+ *
+ * Thread-safety: every call acquires the GIL; concurrent calls serialize.
+ * Error handling mirrors the reference (`MXGetLastError`): failing calls
+ * return -1 and the message is retrievable via MXTPUGetLastError().
+ */
+#ifndef MXNET_TPU_CPP_C_API_H_
+#define MXNET_TPU_CPP_C_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* MXTPUNDArrayHandle;
+typedef void* MXTPUModelHandle;
+
+/* Start the embedded runtime. `platform` selects the JAX backend ("tpu",
+ * "cpu", or NULL/"" for the environment default). Idempotent. */
+int MXTPUInit(const char* platform);
+
+/* Finalize the embedded interpreter. After this no handle is valid. */
+int MXTPUShutdown(void);
+
+/* Message for the last failing call on this thread ("" if none). */
+const char* MXTPUGetLastError(void);
+
+/* --- NDArray ---------------------------------------------------------- */
+
+/* Create a float32 NDArray on the active device from host data. */
+int MXTPUNDArrayCreate(const float* data, const int64_t* shape, int ndim,
+                       MXTPUNDArrayHandle* out);
+
+/* Query rank and dims. `shape` must hold at least 8 entries. */
+int MXTPUNDArrayShape(MXTPUNDArrayHandle handle, int64_t* shape, int* ndim);
+
+/* Total element count. */
+int MXTPUNDArraySize(MXTPUNDArrayHandle handle, int64_t* size);
+
+/* Blocking device->host copy of all elements (float32). */
+int MXTPUNDArrayCopyTo(MXTPUNDArrayHandle handle, float* buf, int64_t size);
+
+int MXTPUNDArrayFree(MXTPUNDArrayHandle handle);
+
+/* Invoke any `mx.np` / `mx.npx` operator by name on NDArray inputs —
+ * the analogue of the reference's MXImperativeInvoke. `kwargs_json` is a
+ * JSON object of keyword scalars/strings (NULL = none). Ops with one
+ * output write it to `out`. */
+int MXTPUInvoke(const char* op_name, MXTPUNDArrayHandle* inputs, int n_in,
+                const char* kwargs_json, MXTPUNDArrayHandle* out);
+
+/* --- Model (exported HybridBlock) -------------------------------------- */
+
+/* Load a `HybridBlock.export` artifact pair: `*-symbol.stablehlo` +
+ * `*-NNNN.params` (params_file may be NULL for param-free graphs). */
+int MXTPUModelLoad(const char* symbol_file, const char* params_file,
+                   MXTPUModelHandle* out);
+
+/* Run the model. On entry *n_out is the capacity of `outputs`; on exit it
+ * is the number of outputs written. */
+int MXTPUModelForward(MXTPUModelHandle model, MXTPUNDArrayHandle* inputs,
+                      int n_in, MXTPUNDArrayHandle* outputs, int* n_out);
+
+int MXTPUModelFree(MXTPUModelHandle handle);
+
+/* Seed the global RNG (`mx.random.seed`). */
+int MXTPURandomSeed(int seed);
+
+#ifdef __cplusplus
+}  /* extern "C" */
+#endif
+
+#endif  /* MXNET_TPU_CPP_C_API_H_ */

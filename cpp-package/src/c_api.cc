@@ -1,0 +1,309 @@
+/*
+ * C ABI implementation: embedded CPython driving the mxnet_tpu runtime.
+ * See c_api.h for the design rationale (single PjRt client per process).
+ *
+ * Python objects cross the ABI as opaque handles (owned references).
+ * Every entry point takes the GIL, so the ABI is safe to call from any
+ * thread; calls serialize like the reference engine's exclusive-write
+ * semantics on a single var.
+ */
+#include "../include/mxnet-tpu-cpp/c_api.h"
+
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+
+namespace {
+
+PyObject* g_helpers = nullptr;   // module dict holding the helper funcs
+bool g_initialized = false;
+PyThreadState* g_main_state = nullptr;  // saved so the GIL is released
+thread_local std::string tls_last_error;
+
+// Helper functions injected at init. Kept in Python because the work —
+// dtype plumbing, pytree flattening — is runtime logic, not ABI logic.
+const char kBootstrap[] = R"PY(
+import os, sys, json
+_home = os.environ.get('MXTPU_HOME')
+if _home and _home not in sys.path:
+    sys.path.insert(0, _home)
+import jax
+_platform = os.environ.get('_MXTPU_CAPI_PLATFORM', '')
+if _platform:
+    jax.config.update('jax_platforms', _platform)
+import numpy as _onp
+import mxnet_tpu as mx
+
+def nd_from_buffer(mv, shape):
+    a = _onp.frombuffer(mv, dtype=_onp.float32)
+    return mx.np.array(a.reshape(tuple(shape)).copy())
+
+def nd_shape(nd):
+    return tuple(int(d) for d in nd.shape)
+
+def nd_bytes(nd):
+    return nd.asnumpy().astype(_onp.float32, copy=False).tobytes()
+
+def invoke(op, inputs, kwargs_json):
+    ns = mx.np if hasattr(mx.np, op) else mx.npx
+    if not hasattr(ns, op):
+        raise AttributeError(f'no operator {op!r} in mx.np or mx.npx')
+    kwargs = json.loads(kwargs_json) if kwargs_json else {}
+    return getattr(ns, op)(*inputs, **kwargs)
+
+def model_load(symbol_file, params_file):
+    from mxnet_tpu.gluon.block import SymbolBlock
+    return SymbolBlock.imports(symbol_file, param_file=params_file or None)
+
+def model_forward(model, inputs):
+    out = model(*inputs)
+    return out if isinstance(out, tuple) else (out,)
+
+def seed(s):
+    mx.random.seed(s)
+)PY";
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  tls_last_error = "unknown python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* msg = PyUnicode_AsUTF8(s);
+      if (msg) tls_last_error = msg;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+PyObject* helper(const char* name) {
+  return PyDict_GetItemString(g_helpers, name);  // borrowed
+}
+
+// RAII GIL acquisition for every ABI entry point.
+class GILGuard {
+ public:
+  GILGuard() : state_(PyGILState_Ensure()) {}
+  ~GILGuard() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+#define MXTPU_REQUIRE_INIT()                                   \
+  do {                                                         \
+    if (!g_initialized) {                                      \
+      tls_last_error = "MXTPUInit has not been called";        \
+      return -1;                                               \
+    }                                                          \
+  } while (0)
+
+}  // namespace
+
+extern "C" {
+
+int MXTPUInit(const char* platform) {
+  if (g_initialized) return 0;
+  if (platform && platform[0] != '\0') {
+    setenv("_MXTPU_CAPI_PLATFORM", platform, 1);
+  }
+  bool fresh = !Py_IsInitialized();
+  if (fresh) {
+    Py_InitializeEx(0);
+  }
+  {
+    GILGuard gil;
+    PyObject* mod = PyModule_New("__mxtpu_capi__");
+    if (!mod) { set_error_from_python(); return -1; }
+    g_helpers = PyModule_GetDict(mod);  // borrowed; mod leaks on purpose
+    PyDict_SetItemString(g_helpers, "__builtins__", PyEval_GetBuiltins());
+    PyObject* r = PyRun_String(kBootstrap, Py_file_input, g_helpers,
+                               g_helpers);
+    if (!r) {
+      set_error_from_python();
+      g_helpers = nullptr;
+      return -1;
+    }
+    Py_DECREF(r);
+  }
+  if (fresh) {
+    // Py_InitializeEx leaves this thread holding the GIL; release it so
+    // any thread (including this one, via GILGuard) can re-acquire
+    g_main_state = PyEval_SaveThread();
+  }
+  g_initialized = true;
+  return 0;
+}
+
+int MXTPUShutdown(void) {
+  if (!g_initialized) return 0;
+  g_initialized = false;
+  g_helpers = nullptr;
+  if (g_main_state != nullptr) {
+    PyEval_RestoreThread(g_main_state);  // Finalize needs the GIL
+    g_main_state = nullptr;
+  }
+  Py_Finalize();
+  return 0;
+}
+
+const char* MXTPUGetLastError(void) { return tls_last_error.c_str(); }
+
+int MXTPUNDArrayCreate(const float* data, const int64_t* shape, int ndim,
+                       MXTPUNDArrayHandle* out) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  int64_t n = 1;
+  PyObject* pyshape = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    n *= shape[i];
+    PyTuple_SET_ITEM(pyshape, i, PyLong_FromLongLong(shape[i]));
+  }
+  PyObject* mv = PyMemoryView_FromMemory(
+      reinterpret_cast<char*>(const_cast<float*>(data)),
+      n * static_cast<int64_t>(sizeof(float)), PyBUF_READ);
+  PyObject* r = PyObject_CallFunctionObjArgs(helper("nd_from_buffer"), mv,
+                                             pyshape, nullptr);
+  Py_DECREF(mv);
+  Py_DECREF(pyshape);
+  if (!r) { set_error_from_python(); return -1; }
+  *out = r;  // ownership transferred to the handle
+  return 0;
+}
+
+int MXTPUNDArrayShape(MXTPUNDArrayHandle handle, int64_t* shape, int* ndim) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  PyObject* r = PyObject_CallFunctionObjArgs(
+      helper("nd_shape"), static_cast<PyObject*>(handle), nullptr);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_ssize_t k = PyTuple_Size(r);
+  if (k > 8) { Py_DECREF(r); tls_last_error = "rank > 8"; return -1; }
+  *ndim = static_cast<int>(k);
+  for (Py_ssize_t i = 0; i < k; ++i) {
+    shape[i] = PyLong_AsLongLong(PyTuple_GET_ITEM(r, i));
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUNDArraySize(MXTPUNDArrayHandle handle, int64_t* size) {
+  int64_t shape[8];
+  int ndim = 0;
+  if (MXTPUNDArrayShape(handle, shape, &ndim) != 0) return -1;
+  int64_t n = 1;
+  for (int i = 0; i < ndim; ++i) n *= shape[i];
+  *size = n;
+  return 0;
+}
+
+int MXTPUNDArrayCopyTo(MXTPUNDArrayHandle handle, float* buf, int64_t size) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  PyObject* r = PyObject_CallFunctionObjArgs(
+      helper("nd_bytes"), static_cast<PyObject*>(handle), nullptr);
+  if (!r) { set_error_from_python(); return -1; }
+  char* raw = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &raw, &len) != 0) {
+    Py_DECREF(r);
+    set_error_from_python();
+    return -1;
+  }
+  if (len != size * static_cast<int64_t>(sizeof(float))) {
+    Py_DECREF(r);
+    tls_last_error = "CopyTo: size mismatch";
+    return -1;
+  }
+  std::memcpy(buf, raw, len);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUNDArrayFree(MXTPUNDArrayHandle handle) {
+  if (!g_initialized || handle == nullptr) return 0;
+  GILGuard gil;
+  Py_DECREF(static_cast<PyObject*>(handle));
+  return 0;
+}
+
+int MXTPUInvoke(const char* op_name, MXTPUNDArrayHandle* inputs, int n_in,
+                const char* kwargs_json, MXTPUNDArrayHandle* out) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  PyObject* ins = PyTuple_New(n_in);
+  for (int i = 0; i < n_in; ++i) {
+    PyObject* o = static_cast<PyObject*>(inputs[i]);
+    Py_INCREF(o);
+    PyTuple_SET_ITEM(ins, i, o);
+  }
+  PyObject* r = PyObject_CallFunction(
+      helper("invoke"), "sOs", op_name, ins,
+      kwargs_json ? kwargs_json : "");
+  Py_DECREF(ins);
+  if (!r) { set_error_from_python(); return -1; }
+  *out = r;
+  return 0;
+}
+
+int MXTPUModelLoad(const char* symbol_file, const char* params_file,
+                   MXTPUModelHandle* out) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  PyObject* r = PyObject_CallFunction(helper("model_load"), "ss", symbol_file,
+                                      params_file ? params_file : "");
+  if (!r) { set_error_from_python(); return -1; }
+  *out = r;
+  return 0;
+}
+
+int MXTPUModelForward(MXTPUModelHandle model, MXTPUNDArrayHandle* inputs,
+                      int n_in, MXTPUNDArrayHandle* outputs, int* n_out) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  PyObject* ins = PyTuple_New(n_in);
+  for (int i = 0; i < n_in; ++i) {
+    PyObject* o = static_cast<PyObject*>(inputs[i]);
+    Py_INCREF(o);
+    PyTuple_SET_ITEM(ins, i, o);
+  }
+  PyObject* r = PyObject_CallFunctionObjArgs(
+      helper("model_forward"), static_cast<PyObject*>(model), ins, nullptr);
+  Py_DECREF(ins);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_ssize_t k = PyTuple_Size(r);
+  if (k > *n_out) {
+    Py_DECREF(r);
+    tls_last_error = "Forward: output capacity too small";
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < k; ++i) {
+    PyObject* o = PyTuple_GET_ITEM(r, i);
+    Py_INCREF(o);
+    outputs[i] = o;
+  }
+  *n_out = static_cast<int>(k);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUModelFree(MXTPUModelHandle handle) {
+  return MXTPUNDArrayFree(handle);
+}
+
+int MXTPURandomSeed(int seed) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  PyObject* r = PyObject_CallFunction(helper("seed"), "i", seed);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+}  // extern "C"
