@@ -53,26 +53,44 @@ def _measure(platform: str) -> dict:
     dev = jax.devices()[0]
     on_accel = dev.platform.lower() != "cpu"
 
-    # BERT-base; bf16 weights/compute for the MXU, seq 128 (phase-1 pretrain)
+    # BERT-base; bf16 weights/compute for the MXU, seq 128 (phase-1
+    # pretrain), MLM loss on masked positions only (the GluonNLP
+    # create_pretraining_data shape: max_predictions_per_seq=20 at seq 128)
     if on_accel:
         batch = int(os.environ.get("MXTPU_BENCH_BATCH", 64))
-        seq = 128
+        seq, n_mask = 128, 20
         cfg = BertConfig(dtype="bfloat16")
     else:  # CI/CPU smoke config
-        batch, seq = 4, 64
+        batch, seq, n_mask = 4, 64, 10
         cfg = BertConfig(hidden_size=128, num_layers=2, num_heads=4,
                          intermediate_size=512, vocab_size=1024)
 
-    model = BertForPretraining(cfg)
+    from mxnet_tpu.gluon.block import HybridBlock
+
+    class BenchBert(HybridBlock):
+        """Positional adapter: the sharded step passes batch args
+        positionally; pretraining uses (ids, masked_positions)."""
+
+        def __init__(self, c):
+            super().__init__()
+            self.model = BertForPretraining(c)
+
+        def forward(self, input_ids, masked_positions):
+            return self.model(input_ids, masked_positions=masked_positions)
+
+    model = BenchBert(cfg)
     model.initialize()
     rng = _onp.random.RandomState(0)
     ids = mx.np.array(rng.randint(0, cfg.vocab_size, (batch, seq)),
                       dtype="int32")
-    labels = mx.np.array(rng.randint(0, cfg.vocab_size, (batch, seq)),
+    mpos = mx.np.array(
+        _onp.sort(rng.rand(batch, seq).argsort(axis=1)[:, :n_mask], axis=1),
+        dtype="int32")
+    labels = mx.np.array(rng.randint(0, cfg.vocab_size, (batch, n_mask)),
                          dtype="int32")
-    model(ids)  # deferred init
+    model(ids, mpos)  # deferred init
 
-    def loss_fn(out, input_ids, lbl):
+    def loss_fn(out, input_ids, masked_positions, lbl):
         mlm, nsp = out
         logp = jax.nn.log_softmax(mlm.astype(jnp.float32), axis=-1)
         ll = jnp.take_along_axis(logp, lbl[..., None].astype(jnp.int32),
@@ -81,18 +99,18 @@ def _measure(platform: str) -> dict:
 
     mesh = make_mesh({"dp": 1}, jax.devices()[:1])
     step = make_sharded_train_step(model, opt.Adam(learning_rate=1e-4),
-                                   loss_fn, mesh, num_model_args=1)
+                                   loss_fn, mesh, num_model_args=2)
 
     # warmup (compile); sync via device_get — on tunneled backends
     # block_until_ready can return before remote execution finishes
     for _ in range(2):
-        loss = step(ids, labels)
+        loss = step(ids, mpos, labels)
     jax.device_get(loss)
 
     def timed(n):
         t0 = time.perf_counter()
         for _ in range(n):
-            loss = step(ids, labels)
+            loss = step(ids, mpos, labels)
         jax.device_get(loss)
         return time.perf_counter() - t0, loss
 
@@ -103,12 +121,15 @@ def _measure(platform: str) -> dict:
     step_time = max((t2 - t1) / (n2 - n1), 1e-9)
     samples_per_sec = batch / step_time
 
-    # train FLOPs per token: 3x forward; forward = matmul MACs * 2
+    # train FLOPs: 3x forward; forward = matmul MACs * 2. The MLM head
+    # (hidden->hidden + hidden->vocab) runs only on the n_mask gathered
+    # positions — counting it per token would inflate MFU.
     h, l, i, V = (cfg.hidden_size, cfg.num_layers, cfg.intermediate_size,
                   cfg.vocab_size)
-    fwd_per_token = 2 * (l * (4 * h * h + 2 * h * i) + h * h + h * V) \
-        + 4 * l * seq * h
-    flops_per_step = 3 * fwd_per_token * batch * seq
+    fwd_per_token = 2 * l * (4 * h * h + 2 * h * i) + 4 * l * seq * h
+    fwd_per_masked = 2 * (h * h + h * V)
+    flops_per_step = 3 * batch * (fwd_per_token * seq
+                                  + fwd_per_masked * n_mask)
     achieved = flops_per_step / step_time
     mfu = achieved / _peak_flops(dev)
 
@@ -121,7 +142,7 @@ def _measure(platform: str) -> dict:
             "samples_per_sec_per_chip": round(samples_per_sec, 2),
             "step_time_ms": round(step_time * 1e3, 2),
             "achieved_tflops": round(achieved / 1e12, 2),
-            "batch": batch, "seq": seq,
+            "batch": batch, "seq": seq, "n_mask": n_mask,
             "device": getattr(dev, "device_kind", str(dev)),
             "platform": dev.platform,
             "loss": float(loss),
@@ -130,13 +151,31 @@ def _measure(platform: str) -> dict:
 
 
 def _run_child(platform: str, timeout: float):
-    """Run `bench.py --measure <platform>` in a child; return (dict|None, err)."""
+    """Run `bench.py --measure <platform>` in a child; return (dict|None, err).
+
+    On timeout the child gets SIGINT + a grace period before SIGKILL:
+    a hard-killed process holding (or waiting on) the remote TPU claim
+    wedges the tunnel for every later attempt, so exiting cleanly matters
+    more than exiting fast."""
+    import signal as _signal
+    popen = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--measure", platform],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
     try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--measure", platform],
-            capture_output=True, text=True, timeout=timeout,
-            cwd=os.path.dirname(os.path.abspath(__file__)))
+        out, err_s = popen.communicate(timeout=timeout)
+        proc = subprocess.CompletedProcess(popen.args, popen.returncode,
+                                           out, err_s)
     except subprocess.TimeoutExpired:
+        popen.send_signal(_signal.SIGINT)
+        try:
+            popen.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            popen.kill()
+            try:
+                popen.communicate(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
         return None, f"timeout after {timeout}s"
     if proc.returncode != 0:
         tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-8:]

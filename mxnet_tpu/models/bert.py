@@ -138,7 +138,15 @@ class BertModel(HybridBlock):
 
 
 class BertForPretraining(HybridBlock):
-    """MLM + NSP heads (GluonNLP BERTForPretrain parity)."""
+    """MLM + NSP heads (GluonNLP BERTForPretrain parity).
+
+    Like the reference pretraining decode path, the MLM head can run on
+    `masked_positions` only — the (batch, num_masked) indices of the [MASK]
+    slots. Pretraining masks ~15% of tokens, so gathering before the
+    hidden→vocab projection cuts the head's matmul and softmax work ~6x;
+    on TPU the full-sequence head is HBM-bandwidth-bound (the fp32
+    (tokens, vocab) softmax), so this is the difference between the MXU
+    idling and not. Omit `masked_positions` to score every position."""
 
     def __init__(self, cfg: BertConfig):
         super().__init__()
@@ -152,19 +160,28 @@ class BertForPretraining(HybridBlock):
         self.nsp_classifier = nn.Dense(2, in_units=cfg.hidden_size,
                                        dtype=cfg.dtype)
 
-    def forward(self, input_ids, token_types=None, valid_length=None):
+    def forward(self, input_ids, token_types=None, valid_length=None,
+                masked_positions=None):
         seq, pooled = self.bert(input_ids, token_types, valid_length)
+        if masked_positions is not None:
+            # (b, l, h) -> (b, m, h) gather of the masked slots
+            seq = np.take_along_axis(
+                seq, np.expand_dims(masked_positions.astype("int32"), -1),
+                axis=1)
         mlm = self.mlm_decoder(self.mlm_norm(npx.gelu(self.mlm_dense(seq))))
         nsp = self.nsp_classifier(pooled)
         return mlm, nsp
 
     @staticmethod
-    def flops_per_token(cfg: BertConfig, seq_len: int) -> float:
-        """Training FLOPs/token (fwd+bwd ≈ 6·params + attention terms)."""
+    def flops_per_token(cfg: BertConfig, seq_len: int,
+                        mask_frac: float = 1.0) -> float:
+        """Training FLOPs/token (fwd+bwd ≈ 6·params + attention terms).
+        `mask_frac` scales the MLM-head term when the head runs on masked
+        positions only (`masked_positions`): 20/128 for phase-1 pretrain."""
         h, l, i = cfg.hidden_size, cfg.num_layers, cfg.intermediate_size
         per_layer = 4 * h * h + 2 * h * i  # qkv+proj + ffn (matmul mults)
         embed = 0  # lookups are bandwidth, not FLOPs
-        mlm = cfg.vocab_size * h + h * h
+        mlm = (cfg.vocab_size * h + h * h) * mask_frac
         params_matmul = l * per_layer + mlm
         attn = l * 2 * seq_len * h  # QK^T + PV per token
         return 6.0 * (params_matmul + attn)
