@@ -1,0 +1,24 @@
+# Developer entry points (parity: reference Makefile/CMake targets, reduced
+# to what a single-language-core framework needs).
+PY ?= python
+
+.PHONY: test test-dist lint bench cpp docs clean
+
+test:
+	$(PY) -m pytest tests/unittest -q
+
+test-dist:
+	$(PY) -m pytest tests/dist -q
+
+lint:
+	ruff check mxnet_tpu tests || true
+
+bench:
+	$(PY) bench.py
+
+cpp:
+	cmake -S cpp-package -B cpp-package/build && \
+	cmake --build cpp-package/build
+
+clean:
+	rm -rf cpp-package/build .pytest_cache $(shell find . -name __pycache__)
