@@ -8,7 +8,7 @@ test:
 	$(PY) -m pytest tests/unittest -q
 
 test-dist:
-	$(PY) -m pytest tests/dist -q
+	$(PY) -m pytest tests/unittest/test_dist_kvstore.py -q
 
 lint:
 	ruff check mxnet_tpu tests || true

@@ -26,7 +26,7 @@ class BertConfig:
     def __init__(self, vocab_size=30522, hidden_size=768, num_layers=12,
                  num_heads=12, intermediate_size=3072, max_position=512,
                  type_vocab_size=2, dropout=0.1, layer_norm_eps=1e-12,
-                 dtype="float32"):
+                 dtype="float32", remat=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -37,6 +37,9 @@ class BertConfig:
         self.dropout = dropout
         self.layer_norm_eps = layer_norm_eps
         self.dtype = dtype
+        # recompute each layer's activations in backward (jax.checkpoint)
+        # — the long-sequence memory knob (docs/performance.md)
+        self.remat = remat
 
 
 def bert_base(**kwargs):
@@ -132,7 +135,11 @@ class BertModel(HybridBlock):
             mask = mask.reshape(b, 1, 1, l)
 
         for layer in self.layers:
-            x = layer(x, mask)
+            if getattr(self.cfg, "remat", False):
+                x = npx.remat_call(
+                    lambda t, _l=layer, _m=mask: _l(t, _m), x)
+            else:
+                x = layer(x, mask)
         pooled = self.pooler(x[:, 0])
         return x, pooled
 

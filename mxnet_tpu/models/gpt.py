@@ -24,7 +24,7 @@ class GPTConfig:
     def __init__(self, vocab_size=50257, hidden_size=768, num_layers=12,
                  num_heads=12, intermediate_size=3072, max_position=1024,
                  dropout=0.1, layer_norm_eps=1e-5, tie_embeddings=True,
-                 dtype="float32"):
+                 dtype="float32", remat=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -35,6 +35,8 @@ class GPTConfig:
         self.layer_norm_eps = layer_norm_eps
         self.tie_embeddings = tie_embeddings
         self.dtype = dtype
+        # recompute each layer's activations in backward (jax.checkpoint)
+        self.remat = remat
 
 
 def gpt_small(**kwargs):
@@ -92,7 +94,10 @@ class GPTModel(HybridBlock):
             pos.reshape(1, l))
         x = self.embed_dropout(x)
         for layer in self.layers:
-            x = layer(x)
+            if getattr(self.cfg, "remat", False):
+                x = npx.remat_call(lambda t, _l=layer: _l(t), x)
+            else:
+                x = layer(x)
         return self.final_norm(x)
 
 

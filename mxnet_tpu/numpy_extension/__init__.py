@@ -38,6 +38,7 @@ __all__ = [
     "interleaved_matmul_encdec_qk", "interleaved_matmul_encdec_valatt",
     "sldwin_atten_mask_like", "sldwin_atten_score", "sldwin_atten_context",
     "multi_head_attention", "ctc_loss", "foreach", "while_loop", "cond",
+    "remat_call",
     "save", "load", "waitall", "set_np", "reset_np", "is_np_array",
     "seed", "rnn", "intgemm_fully_connected", "custom",
 ]
@@ -491,6 +492,85 @@ def dropout(data, p=0.5, mode="training", axes=(), cudnn_off=False):
     return apply_op(fn, (data,), {}, name="dropout")
 
 
+def remat_call(fn, *args, policy=None):
+    """Run `fn(*args)` under `jax.checkpoint`: its activations are
+    recomputed during the backward pass instead of stored — the
+    FLOPs-for-HBM trade that makes long-sequence training fit (SURVEY §7;
+    the reference's closest knob is the mirror/memonger graph pass).
+
+    `fn` takes and returns ndarrays (a Gluon block call is the intended
+    use: ``npx.remat_call(lambda t: layer(t, mask), x)``). Effective under
+    `hybridize`/`jit`/the sharded train step, where gradients flow through
+    the parameters `fn` closes over. Under eager tape recording this calls
+    `fn` directly — remat would detach closed-over parameters from the
+    tape, and eager execution materializes per-op residuals anyway.
+    """
+    from ..ndarray.ndarray import from_jax, current_device
+    if _tape.is_recording():
+        return fn(*args)
+
+    dev = next((a._device for a in args if isinstance(a, ndarray)),
+               current_device())
+
+    def pure(*vals):
+        nds = [from_jax(v, dev) for v in vals]
+        out = fn(*nds)
+        return out._data if isinstance(out, ndarray) else out
+
+    ck = jax.checkpoint(pure, policy=policy)
+    return apply_op(ck, args, {}, name="remat")
+
+
+def _embedding_grad_via_matmul(w) -> bool:
+    """Policy for the embedding weight-grad strategy (flags.embedding_grad).
+    XLA:TPU lowers scatter-add row-serially, so the dense embedding
+    backward can dominate a step; a one-hot(tokens,V) @ cotangent matmul
+    is MXU work instead. 'auto' enables it on TPU when the bf16 one-hot
+    stays comfortably under HBM pressure."""
+    from ..utils.config import flags
+    mode = flags.embedding_grad
+    if mode == "matmul":
+        return True
+    if mode == "auto":
+        try:
+            return jax.default_backend() == "tpu"
+        except Exception:
+            return False
+    return False
+
+
+def _embedding_matmul_grad(idx32, w):
+    """take(w, idx) with a custom VJP: dW = one_hot(idx)^T @ cotangent.
+    The one-hot is built at the cotangent's dtype (bf16 in AMP training)
+    and the product accumulates in fp32 (MXU native)."""
+    n_rows = w.shape[0]
+    # guard the HBM cost of materializing the one-hot: fall back to the
+    # scatter path above ~0.75 GB. The one-hot is built at the cotangent's
+    # dtype, which for a jax VJP matches the primal's — use w's item size.
+    itemsize = jnp.dtype(w.dtype).itemsize
+    if int(idx32.size) * int(n_rows) * itemsize > 750_000_000:
+        return jnp.take(w, idx32, axis=0, mode="clip")
+
+    @jax.custom_vjp
+    def emb(w):
+        return jnp.take(w, idx32, axis=0, mode="clip")
+
+    def fwd(w):
+        return emb(w), None
+
+    def bwd(_, cot):
+        flat = jnp.clip(idx32.reshape(-1), 0, n_rows - 1)
+        oh = jax.nn.one_hot(flat, n_rows, dtype=cot.dtype)       # (T, V)
+        cot2 = cot.reshape((flat.shape[0], -1))                  # (T, E)
+        g = jax.lax.dot_general(
+            oh, cot2, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                  # (V, E)
+        return (g.reshape(w.shape).astype(w.dtype),)
+
+    emb.defvjp(fwd, bwd)
+    return emb(w)
+
+
 def embedding(data, weight, input_dim=None, output_dim=None, dtype=None,
               sparse_grad=False):
     """Embedding lookup (parity: `src/operator/tensor/indexing_op.cc`
@@ -502,7 +582,11 @@ def embedding(data, weight, input_dim=None, output_dim=None, dtype=None,
     def fn(idx, w):
         # mode='clip' matches the reference's index clipping and avoids
         # XLA's NaN-fill for out-of-bounds gathers under jit
-        out = jnp.take(w, idx.astype(jnp.int32), axis=0, mode="clip")
+        idx32 = idx.astype(jnp.int32)
+        if _embedding_grad_via_matmul(w):
+            out = _embedding_matmul_grad(idx32, w)
+        else:
+            out = jnp.take(w, idx32, axis=0, mode="clip")
         return out.astype(dtype) if dtype else out
 
     if sparse_grad and _tape.is_recording() \

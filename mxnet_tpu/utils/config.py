@@ -40,6 +40,10 @@ class Flags:
     # safe-accumulation parity (MXNET_SAFE_ACCUMULATION): accumulate in fp32
     safe_accumulation: bool = _env("SAFE_ACCUMULATION",
                                    "MXNET_SAFE_ACCUMULATION", True, bool)
+    # embedding weight-gradient strategy: 'scatter' (XLA scatter-add),
+    # 'matmul' (one-hot @ cotangent — rides the MXU; TPU scatter is slow),
+    # or 'auto' (matmul on TPU when the one-hot fits comfortably)
+    embedding_grad: str = _env("EMBEDDING_GRAD", None, "auto", str)
 
 
 flags = Flags()

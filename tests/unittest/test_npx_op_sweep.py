@@ -455,3 +455,44 @@ def test_npx_interleaved_attention_ops():
     want = onp.einsum("lbhd,mbhd->bhlm", q, k).reshape(B * H, L, L) \
         / onp.sqrt(D)
     assert_almost_equal(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_embedding_matmul_grad_matches_scatter():
+    """flags.embedding_grad='matmul' (one-hot @ cot on the MXU) must give
+    the same weight gradient as the default XLA scatter-add path."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.numpy_extension import _embedding_matmul_grad
+
+    rng = onp.random.RandomState(5)
+    idx = jnp.asarray(rng.randint(0, 11, (4, 6)), jnp.int32)
+    w = jnp.asarray(rng.randn(11, 3).astype("float32"))
+    cot = jnp.asarray(rng.randn(4, 6, 3).astype("float32"))
+
+    def via_scatter(w):
+        return jnp.take(w, idx, axis=0, mode="clip")
+
+    g_scatter = jax.vjp(via_scatter, w)[1](cot)[0]
+    g_matmul = jax.vjp(lambda w: _embedding_matmul_grad(idx, w), w)[1](cot)[0]
+    onp.testing.assert_allclose(onp.asarray(g_matmul),
+                                onp.asarray(g_scatter), rtol=1e-5, atol=1e-5)
+
+    # end-to-end through the npx op with the flag forced
+    from mxnet_tpu.utils.config import flags
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    prev = flags.embedding_grad
+    flags.embedding_grad = "matmul"
+    try:
+        wnd = mx.np.array(onp.asarray(w))
+        wnd.attach_grad()
+        ind = mx.np.array(onp.asarray(idx), dtype="int32")
+        with autograd.record():
+            out = mx.npx.embedding(ind, wnd, input_dim=11, output_dim=3)
+            loss = (out * mx.np.array(onp.asarray(cot))).sum()
+        loss.backward()
+        onp.testing.assert_allclose(wnd.grad.asnumpy(),
+                                    onp.asarray(g_scatter),
+                                    rtol=1e-5, atol=1e-5)
+    finally:
+        flags.embedding_grad = prev
