@@ -505,7 +505,6 @@ def remat_call(fn, *args, policy=None):
     `fn` directly — remat would detach closed-over parameters from the
     tape, and eager execution materializes per-op residuals anyway.
     """
-    from ..ndarray.ndarray import from_jax, current_device
     if _tape.is_recording():
         return fn(*args)
 
