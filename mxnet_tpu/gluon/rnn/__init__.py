@@ -1,5 +1,11 @@
 """`mx.gluon.rnn` (parity: `python/mxnet/gluon/rnn/`)."""
 from .rnn_cell import (RecurrentCell, HybridRecurrentCell, RNNCell, LSTMCell,
-                       GRUCell, SequentialRNNCell, DropoutCell,
-                       BidirectionalCell, ResidualCell, ZoneoutCell)
+                       GRUCell, SequentialRNNCell, HybridSequentialRNNCell,
+                       DropoutCell, BidirectionalCell, ModifierCell,
+                       ResidualCell, ZoneoutCell, VariationalDropoutCell,
+                       LSTMPCell)
+from .conv_rnn_cell import (Conv1DRNNCell, Conv2DRNNCell, Conv3DRNNCell,
+                            Conv1DLSTMCell, Conv2DLSTMCell, Conv3DLSTMCell,
+                            Conv1DGRUCell, Conv2DGRUCell, Conv3DGRUCell)
+
 from .rnn_layer import RNN, LSTM, GRU, rnn_cell_scan, _fused_rnn_op

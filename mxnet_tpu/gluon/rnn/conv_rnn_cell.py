@@ -1,0 +1,204 @@
+"""Convolutional recurrent cells (parity:
+`python/mxnet/gluon/rnn/conv_rnn_cell.py:222-846` — Conv{1,2,3}D
+{RNN,LSTM,GRU}Cell). Same contracts: NC-first layouts, i2h convolution may
+change the spatial size (kernel/pad/dilate), h2h convolution is
+auto-padded (`d*(k-1)//2`) so the state's spatial size is preserved;
+gate orders match the dense cells ([i,f,g,o] LSTM, [r,z,n] GRU).
+
+Unlike the reference's per-device CUDA/oneDNN conv kernels, both
+convolutions lower through `npx.convolution` to a single
+`lax.conv_general_dilated` each — XLA fuses the gate arithmetic into the
+conv epilogue on TPU."""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ... import numpy as _np
+from ... import numpy_extension as npx
+from ..parameter import Parameter
+from .rnn_cell import RecurrentCell
+
+__all__ = ["Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+           "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+           "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell"]
+
+
+def _tuplize(v, n):
+    if isinstance(v, (tuple, list)):
+        if len(v) != n:
+            raise MXNetError(f"expected length-{n} tuple, got {v}")
+        return tuple(v)
+    return (v,) * n
+
+
+class _BaseConvRNNCell(RecurrentCell):
+    """Shared conv-gate plumbing. `input_shape` is (C, *spatial) — required
+    up front (like the reference) because the state's spatial shape depends
+    on the i2h conv geometry."""
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad, i2h_dilate, h2h_dilate, activation, dims,
+                 num_gates, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 conv_layout=None, **kwargs):
+        super().__init__(**kwargs)
+        if conv_layout is not None and "C" in conv_layout \
+                and conv_layout.find("C") != 1:
+            raise MXNetError(f"only channel-first layouts are supported, "
+                             f"got {conv_layout!r}")
+        self._input_shape = tuple(input_shape)
+        if len(self._input_shape) != dims + 1:
+            raise MXNetError(
+                f"input_shape must be (channels, *{dims} spatial dims), "
+                f"got {input_shape}")
+        self._hidden_channels = hidden_channels
+        self._dims = dims
+        self._num_gates = num_gates
+        self._activation = activation
+        self._i2h_kernel = _tuplize(i2h_kernel, dims)
+        self._h2h_kernel = _tuplize(h2h_kernel, dims)
+        for k in self._h2h_kernel:
+            if k % 2 == 0:
+                raise MXNetError(f"h2h_kernel must be odd (state-size "
+                                 f"preserving), got {self._h2h_kernel}")
+        self._i2h_pad = _tuplize(i2h_pad, dims)
+        self._i2h_dilate = _tuplize(i2h_dilate, dims)
+        self._h2h_dilate = _tuplize(h2h_dilate, dims)
+        self._h2h_pad = tuple(d * (k - 1) // 2 for d, k in
+                              zip(self._h2h_dilate, self._h2h_kernel))
+
+        in_c, spatial = self._input_shape[0], self._input_shape[1:]
+        self._state_spatial = tuple(
+            (x + 2 * p - d * (k - 1) - 1) + 1
+            for x, p, d, k in zip(spatial, self._i2h_pad, self._i2h_dilate,
+                                  self._i2h_kernel))
+        total = num_gates * hidden_channels
+        self.i2h_weight = Parameter(
+            "i2h_weight", shape=(total, in_c) + self._i2h_kernel,
+            init=i2h_weight_initializer)
+        self.h2h_weight = Parameter(
+            "h2h_weight", shape=(total, hidden_channels) + self._h2h_kernel,
+            init=h2h_weight_initializer)
+        self.i2h_bias = Parameter("i2h_bias", shape=(total,),
+                                  init=i2h_bias_initializer)
+        self.h2h_bias = Parameter("h2h_bias", shape=(total,),
+                                  init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        shape = (batch_size, self._hidden_channels) + self._state_spatial
+        return [{"shape": shape, "__layout__": "NC" + "DHW"[-self._dims:]}
+                ] * self._num_states
+
+    def _conv_gates(self, inputs, h):
+        """(i2h, h2h) conv pre-activations — kept separate because the GRU
+        applies its reset gate to h2h only; RNN/LSTM just sum them."""
+        total = self._num_gates * self._hidden_channels
+        i2h = npx.convolution(inputs, self.i2h_weight.data(),
+                              self.i2h_bias.data(),
+                              kernel=self._i2h_kernel, pad=self._i2h_pad,
+                              dilate=self._i2h_dilate, num_filter=total)
+        h2h = npx.convolution(h, self.h2h_weight.data(),
+                              self.h2h_bias.data(),
+                              kernel=self._h2h_kernel, pad=self._h2h_pad,
+                              dilate=self._h2h_dilate, num_filter=total)
+        return i2h, h2h
+
+    def _split(self, gates):
+        hc = self._hidden_channels
+        return [gates[:, i * hc:(i + 1) * hc] for i in
+                range(self._num_gates)]
+
+
+class _ConvRNNCell(_BaseConvRNNCell):
+    _num_states = 1
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                 h2h_kernel, i2h_pad=0, i2h_dilate=1, h2h_dilate=1,
+                 activation="tanh", dims=1, **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, i2h_pad, i2h_dilate, h2h_dilate,
+                         activation, dims, num_gates=1, **kwargs)
+
+    def forward(self, inputs, states):
+        i2h, h2h = self._conv_gates(inputs, states[0])
+        out = npx.activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class _ConvLSTMCell(_BaseConvRNNCell):
+    _num_states = 2
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                 h2h_kernel, i2h_pad=0, i2h_dilate=1, h2h_dilate=1,
+                 activation="tanh", dims=1, **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, i2h_pad, i2h_dilate, h2h_dilate,
+                         activation, dims, num_gates=4, **kwargs)
+
+    def forward(self, inputs, states):
+        h, c = states
+        i2h, h2h = self._conv_gates(inputs, h)
+        i, f, g, o = self._split(i2h + h2h)
+        i = npx.sigmoid(i)
+        f = npx.sigmoid(f)
+        g = npx.activation(g, act_type=self._activation)
+        o = npx.sigmoid(o)
+        c_new = f * c + i * g
+        h_new = o * npx.activation(c_new, act_type=self._activation)
+        return h_new, [h_new, c_new]
+
+
+class _ConvGRUCell(_BaseConvRNNCell):
+    _num_states = 1
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                 h2h_kernel, i2h_pad=0, i2h_dilate=1, h2h_dilate=1,
+                 activation="tanh", dims=1, **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, i2h_pad, i2h_dilate, h2h_dilate,
+                         activation, dims, num_gates=3, **kwargs)
+
+    def forward(self, inputs, states):
+        h = states[0]
+        i2h, h2h = self._conv_gates(inputs, h)
+        hc = self._hidden_channels
+        i2h_r, i2h_z, i2h_n = (i2h[:, :hc], i2h[:, hc:2 * hc],
+                               i2h[:, 2 * hc:])
+        h2h_r, h2h_z, h2h_n = (h2h[:, :hc], h2h[:, hc:2 * hc],
+                               h2h[:, 2 * hc:])
+        r = npx.sigmoid(i2h_r + h2h_r)
+        z = npx.sigmoid(i2h_z + h2h_z)
+        n = npx.activation(i2h_n + r * h2h_n, act_type=self._activation)
+        h_new = (1 - z) * n + z * h
+        return h_new, [h_new]
+
+
+def _mk(base, dims, name, doc):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad=0, i2h_dilate=1, h2h_dilate=1, activation="tanh",
+                 **kwargs):
+        base.__init__(self, input_shape, hidden_channels, i2h_kernel,
+                      h2h_kernel, i2h_pad, i2h_dilate, h2h_dilate,
+                      activation, dims=dims, **kwargs)
+    cls = type(name, (base,), {"__init__": __init__, "__doc__": doc})
+    return cls
+
+
+Conv1DRNNCell = _mk(_ConvRNNCell, 1, "Conv1DRNNCell",
+                    "1D convolutional RNN cell ('NCW').")
+Conv2DRNNCell = _mk(_ConvRNNCell, 2, "Conv2DRNNCell",
+                    "2D convolutional RNN cell ('NCHW').")
+Conv3DRNNCell = _mk(_ConvRNNCell, 3, "Conv3DRNNCell",
+                    "3D convolutional RNN cell ('NCDHW').")
+Conv1DLSTMCell = _mk(_ConvLSTMCell, 1, "Conv1DLSTMCell",
+                     "1D ConvLSTM cell (Shi et al. 2015; 'NCW').")
+Conv2DLSTMCell = _mk(_ConvLSTMCell, 2, "Conv2DLSTMCell",
+                     "2D ConvLSTM cell (Shi et al. 2015; 'NCHW').")
+Conv3DLSTMCell = _mk(_ConvLSTMCell, 3, "Conv3DLSTMCell",
+                     "3D ConvLSTM cell (Shi et al. 2015; 'NCDHW').")
+Conv1DGRUCell = _mk(_ConvGRUCell, 1, "Conv1DGRUCell",
+                    "1D convolutional GRU cell ('NCW').")
+Conv2DGRUCell = _mk(_ConvGRUCell, 2, "Conv2DGRUCell",
+                    "2D convolutional GRU cell ('NCHW').")
+Conv3DGRUCell = _mk(_ConvGRUCell, 3, "Conv3DGRUCell",
+                    "3D convolutional GRU cell ('NCDHW').")

@@ -18,8 +18,10 @@ from ..block import Block, HybridBlock
 from ..parameter import Parameter
 
 __all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
-           "SequentialRNNCell", "DropoutCell", "BidirectionalCell",
-           "ResidualCell", "ZoneoutCell", "HybridRecurrentCell"]
+           "SequentialRNNCell", "HybridSequentialRNNCell", "DropoutCell",
+           "BidirectionalCell", "ModifierCell", "ResidualCell",
+           "ZoneoutCell", "VariationalDropoutCell", "LSTMPCell",
+           "HybridRecurrentCell"]
 
 
 class RecurrentCell(HybridBlock):
@@ -207,6 +209,8 @@ class GRUCell(RecurrentCell):
 
 
 class SequentialRNNCell(RecurrentCell):
+    """Sequential stack of cells; also exported as HybridSequentialRNNCell
+    (parity: `python/mxnet/gluon/rnn/rnn_cell.py:755`)."""
     def __init__(self, **kwargs):
         super().__init__(**kwargs)
 
@@ -240,6 +244,11 @@ class SequentialRNNCell(RecurrentCell):
 
     def __getitem__(self, i):
         return list(self._children.values())[i]
+
+
+# parity alias (`python/mxnet/gluon/rnn/rnn_cell.py:755`): every cell here
+# is hybrid-capable, so the sequential container is shared
+HybridSequentialRNNCell = SequentialRNNCell
 
 
 class DropoutCell(RecurrentCell):
@@ -297,6 +306,123 @@ class ResidualCell(ModifierCell):
     def forward(self, inputs, states):
         out, next_states = self.base_cell(inputs, states)
         return out + inputs, next_states
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Variational (locked) dropout (parity:
+    `python/mxnet/gluon/rnn/rnn_cell.py:1110`; Gal & Ghahramani 2016):
+    ONE dropout mask per sequence, reused at every time step, applied to
+    inputs/states/outputs as requested. Masks are drawn on the first step
+    of each `unroll` (and cleared on `reset()`), so a mask created inside
+    one jit trace can never leak into a later trace or eager call. When
+    stepping the cell manually across separate traced calls, call
+    `reset()` between sequences."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0, **kwargs):
+        super().__init__(base_cell, **kwargs)
+        self._drop_inputs = drop_inputs
+        self._drop_states = drop_states
+        self._drop_outputs = drop_outputs
+        self._mask_in = None
+        self._mask_states = None
+        self._mask_out = None
+
+    def reset(self):
+        super().reset()
+        self._mask_in = self._mask_states = self._mask_out = None
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self._mask_in = self._mask_states = self._mask_out = None
+        return super().unroll(length, inputs, begin_state, layout,
+                              merge_outputs, valid_length)
+
+    @staticmethod
+    def _mask(like, p):
+        # 0/(1/(1-p)) inverted-dropout mask, drawn once
+        return npx.dropout(_np.ones_like(like), p=p, mode="always")
+
+    def forward(self, inputs, states):
+        from ... import _tape
+        training = _tape.is_training()
+        if training and self._drop_inputs:
+            if self._mask_in is None:
+                self._mask_in = self._mask(inputs, self._drop_inputs)
+            inputs = inputs * self._mask_in
+        if training and self._drop_states:
+            if self._mask_states is None:
+                self._mask_states = self._mask(states[0], self._drop_states)
+            states = [states[0] * self._mask_states] + list(states[1:])
+        out, next_states = self.base_cell(inputs, states)
+        if training and self._drop_outputs:
+            if self._mask_out is None:
+                self._mask_out = self._mask(out, self._drop_outputs)
+            out = out * self._mask_out
+        return out, next_states
+
+
+class LSTMPCell(RecurrentCell):
+    """LSTM with a recurrent projection (parity:
+    `python/mxnet/gluon/rnn/rnn_cell.py:1284`; Sak et al. 2014): the
+    recurrent path sees r_t = W_hr h_t (size `projection_size`), shrinking
+    the h2h matmul — the trick LSTM-era speech models used for the same
+    reason TP shards the QKV matmul today."""
+
+    def __init__(self, hidden_size, projection_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 h2r_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self._input_size = input_size
+        self.i2h_weight = Parameter("i2h_weight",
+                                    shape=(4 * hidden_size, input_size),
+                                    init=i2h_weight_initializer,
+                                    allow_deferred_init=not input_size)
+        self.h2h_weight = Parameter("h2h_weight",
+                                    shape=(4 * hidden_size, projection_size),
+                                    init=h2h_weight_initializer)
+        self.h2r_weight = Parameter("h2r_weight",
+                                    shape=(projection_size, hidden_size),
+                                    init=h2r_weight_initializer)
+        self.i2h_bias = Parameter("i2h_bias", shape=(4 * hidden_size,),
+                                  init=i2h_bias_initializer)
+        self.h2h_bias = Parameter("h2h_bias", shape=(4 * hidden_size,),
+                                  init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [
+            {"shape": (batch_size, self._projection_size),
+             "__layout__": "NC"},
+            {"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+        ]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+
+    def forward(self, inputs, states):
+        r, c = states
+        hs = self._hidden_size
+        i2h = npx.fully_connected(inputs, self.i2h_weight.data(),
+                                  self.i2h_bias.data(),
+                                  num_hidden=4 * hs, flatten=False)
+        h2h = npx.fully_connected(r, self.h2h_weight.data(),
+                                  self.h2h_bias.data(),
+                                  num_hidden=4 * hs, flatten=False)
+        gates = i2h + h2h
+        i = npx.sigmoid(gates[..., :hs])
+        f = npx.sigmoid(gates[..., hs:2 * hs])
+        g = _np.tanh(gates[..., 2 * hs:3 * hs])
+        o = npx.sigmoid(gates[..., 3 * hs:])
+        c_new = f * c + i * g
+        h_new = o * _np.tanh(c_new)
+        r_new = npx.fully_connected(h_new, self.h2r_weight.data(), None,
+                                    num_hidden=self._projection_size,
+                                    no_bias=True, flatten=False)
+        return r_new, [r_new, c_new]
 
 
 class BidirectionalCell(RecurrentCell):

@@ -130,3 +130,103 @@ def test_rnn_gradient_flows():
         y = layer(x).sum()
     y.backward()
     assert float(abs(x.grad).sum()) > 0
+
+
+class TestConvAndProjectedCells:
+    """New-cell parity (reference `gluon/rnn/conv_rnn_cell.py:222-846`,
+    `rnn_cell.py:755,1110,1284`)."""
+
+    def test_conv_lstm_2d_shapes_and_unroll(self):
+        cell = mx.gluon.rnn.Conv2DLSTMCell(
+            input_shape=(3, 8, 8), hidden_channels=5, i2h_kernel=3,
+            h2h_kernel=3, i2h_pad=1)
+        cell.initialize()
+        x = mx.np.array(onp.random.RandomState(0).rand(2, 4, 3, 8, 8)
+                        .astype("float32"))
+        out, states = cell.unroll(4, x, layout="NTC")
+        assert out.shape == (2, 4, 5, 8, 8)
+        assert states[0].shape == (2, 5, 8, 8)
+        assert states[1].shape == (2, 5, 8, 8)
+
+    def test_conv_rnn_1d_i2h_shrinks_state(self):
+        # no i2h pad: spatial 10 -> 8 with kernel 3; h2h preserves it
+        cell = mx.gluon.rnn.Conv1DRNNCell(
+            input_shape=(2, 10), hidden_channels=4, i2h_kernel=3,
+            h2h_kernel=3)
+        cell.initialize()
+        x = mx.np.array(onp.random.RandomState(1).rand(3, 2, 10)
+                        .astype("float32"))
+        out, st = cell(x, cell.begin_state(3))
+        assert out.shape == (3, 4, 8)
+        assert st[0].shape == (3, 4, 8)
+
+    def test_conv_gru_gradient_flows(self):
+        cell = mx.gluon.rnn.Conv2DGRUCell(
+            input_shape=(1, 4, 4), hidden_channels=2, i2h_kernel=3,
+            h2h_kernel=3, i2h_pad=1)
+        cell.initialize()
+        x = mx.np.array(onp.ones((1, 3, 1, 4, 4), dtype="float32"))
+        with mx.autograd.record():
+            out, _ = cell.unroll(3, x)
+            loss = (out ** 2).sum()
+        loss.backward()
+        g = cell.i2h_weight.grad
+        assert float(mx.np.abs(g).sum()) > 0
+
+    def test_even_h2h_kernel_rejected(self):
+        with pytest.raises(mx.MXNetError, match="odd"):
+            mx.gluon.rnn.Conv1DRNNCell(input_shape=(1, 8),
+                                       hidden_channels=2, i2h_kernel=3,
+                                       h2h_kernel=2)
+
+    def test_lstmp_projection_shapes(self):
+        cell = mx.gluon.rnn.LSTMPCell(hidden_size=12, projection_size=5,
+                                      input_size=7)
+        cell.initialize()
+        x = mx.np.array(onp.random.RandomState(2).rand(4, 7)
+                        .astype("float32"))
+        out, (r, c) = cell(x, cell.begin_state(4))
+        assert out.shape == (4, 5)      # projected
+        assert r.shape == (4, 5)
+        assert c.shape == (4, 12)       # cell state keeps hidden_size
+        # unroll + gradient
+        seq = mx.np.array(onp.random.RandomState(3).rand(4, 6, 7)
+                          .astype("float32"))
+        with mx.autograd.record():
+            o, _ = cell.unroll(6, seq)
+            (o ** 2).sum().backward()
+        assert float(mx.np.abs(cell.h2r_weight.grad).sum()) > 0
+
+    def test_variational_dropout_locked_masks(self):
+        base = mx.gluon.rnn.RNNCell(8, input_size=8)
+        cell = mx.gluon.rnn.VariationalDropoutCell(base, drop_inputs=0.5)
+        cell.initialize()
+        x = mx.np.array(onp.ones((2, 5, 8), dtype="float32"))
+        with mx.autograd.record():  # training mode: masks active
+            cell.reset()
+            _ = cell.unroll(5, x)
+            mask1 = cell._mask_in.asnumpy()
+        assert mask1 is not None
+        # mask is reused across all 5 steps (locked), new after reset
+        with mx.autograd.record():
+            cell.reset()
+            _ = cell.unroll(5, x)
+            mask2 = cell._mask_in.asnumpy()
+        assert mask1.shape == mask2.shape == (2, 8)
+        assert (mask1 != mask2).any()
+        # predict mode: dropout inactive, equals the bare base cell
+        cell.reset()
+        out, _ = cell.unroll(5, x)
+        base_out, _ = base.unroll(5, x)
+        onp.testing.assert_allclose(out.asnumpy(), base_out.asnumpy(),
+                                    rtol=1e-6)
+
+    def test_hybrid_sequential_alias(self):
+        s = mx.gluon.rnn.HybridSequentialRNNCell()
+        s.add(mx.gluon.rnn.LSTMCell(4, input_size=3))
+        s.add(mx.gluon.rnn.GRUCell(5, input_size=4))
+        s.initialize()
+        x = mx.np.array(onp.ones((2, 3), dtype="float32"))
+        out, states = s(x, s.begin_state(2))
+        assert out.shape == (2, 5)
+        assert len(states) == 3  # lstm h,c + gru h
