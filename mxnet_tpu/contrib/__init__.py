@@ -13,3 +13,4 @@ from . import op  # noqa: F401
 from . import op as nd  # noqa: F401  (reference spelling: mx.nd.contrib)
 from .op import *  # noqa: F401,F403
 from . import quantization  # noqa: F401
+from . import graph  # noqa: F401

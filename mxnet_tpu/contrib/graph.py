@@ -1,0 +1,228 @@
+"""DGL-style graph sampling (parity: `src/operator/contrib/dgl_graph.cc`:
+`_contrib_dgl_csr_neighbor_uniform_sample:737`,
+`_contrib_dgl_csr_neighbor_non_uniform_sample:841`,
+`_contrib_dgl_subgraph:1129`, `_contrib_edge_id:1326`,
+`_contrib_dgl_adjacency:1402`, `_contrib_dgl_graph_compact:1577`).
+
+Graph sampling is dynamic-shape, data-dependent work — the reference runs
+these ops on CPU only (`FComputeEx<cpu>`), and that is exactly the right
+split on TPU too: sampling happens on the host over numpy CSR arrays, and
+every output is **padded to the static `max_num_vertices` bound** (the
+reference's own convention — its vertex arrays carry the true count in the
+last slot) so results feed straight into jit-compiled device computation.
+`dgl_adjacency` returns a device ndarray (dense), the rest return host
+`CSRGraph`/numpy structures.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as onp
+
+from ..base import MXNetError
+
+__all__ = ["CSRGraph", "csr_graph", "dgl_csr_neighbor_uniform_sample",
+           "dgl_csr_neighbor_non_uniform_sample", "dgl_subgraph",
+           "dgl_adjacency", "dgl_graph_compact", "edge_id"]
+
+
+class CSRGraph:
+    """Host CSR adjacency: `data` holds edge ids/weights (the reference
+    stores edge ids 1..E so 0 can mean "no edge" in dense views)."""
+
+    def __init__(self, data, indices, indptr, shape):
+        self.data = onp.asarray(data)
+        self.indices = onp.asarray(indices, dtype=onp.int64)
+        self.indptr = onp.asarray(indptr, dtype=onp.int64)
+        self.shape = tuple(shape)
+        if len(self.indptr) != self.shape[0] + 1:
+            raise MXNetError(
+                f"indptr length {len(self.indptr)} != rows+1 "
+                f"({self.shape[0] + 1})")
+
+    def row(self, i) -> Tuple[onp.ndarray, onp.ndarray]:
+        s, e = self.indptr[i], self.indptr[i + 1]
+        return self.indices[s:e], self.data[s:e]
+
+    @property
+    def nnz(self) -> int:
+        return int(self.indptr[-1])
+
+    def asnumpy(self) -> onp.ndarray:
+        out = onp.zeros(self.shape, dtype=self.data.dtype)
+        for i in range(self.shape[0]):
+            cols, vals = self.row(i)
+            out[i, cols] = vals
+        return out
+
+
+def csr_graph(data, indices, indptr, shape) -> CSRGraph:
+    """Build a host CSR graph (the sampling-side stand-in for the
+    reference's `mx.nd.sparse.csr_matrix`; device CSR compute stays
+    unsupported — see `ndarray/sparse.py`)."""
+    return CSRGraph(data, indices, indptr, shape)
+
+
+def _as_host(a):
+    return a.asnumpy() if hasattr(a, "asnumpy") else onp.asarray(a)
+
+
+def _neighbor_sample(csr: CSRGraph, seed, num_hops, num_neighbor,
+                     max_num_vertices, rng, prob=None):
+    seed = _as_host(seed).astype(onp.int64)
+    layer_of = {}
+    frontier = []
+    for v in seed:
+        if v not in layer_of:
+            layer_of[int(v)] = 0
+            frontier.append(int(v))
+    kept_edges = {}  # (src row) -> {col: edge_val}
+    for hop in range(1, num_hops + 1):
+        nxt = []
+        for v in frontier:
+            cols, vals = csr.row(v)
+            if len(cols) == 0:
+                continue
+            if prob is not None:
+                p = prob[cols].astype(onp.float64)
+                tot = p.sum()
+                if tot <= 0:
+                    continue
+                k = min(num_neighbor, int((p > 0).sum()))
+                picks = rng.choice(len(cols), size=k, replace=False,
+                                   p=p / tot)
+            else:
+                k = min(num_neighbor, len(cols))
+                picks = rng.choice(len(cols), size=k, replace=False)
+            row = kept_edges.setdefault(v, {})
+            for j in picks:
+                c = int(cols[j])
+                if c not in layer_of:
+                    if len(layer_of) >= max_num_vertices:
+                        # vertex rejected by the cap: drop the edge too,
+                        # so the edge CSR never references a vertex
+                        # absent from the vertex/layer outputs
+                        continue
+                    layer_of[c] = hop
+                    nxt.append(c)
+                row[c] = vals[j]
+        frontier = nxt
+    verts = onp.array(sorted(layer_of), dtype=onp.int64)
+    n = len(verts)
+    if n > max_num_vertices:
+        raise MXNetError(f"sampled {n} vertices > max_num_vertices "
+                         f"{max_num_vertices}")
+    # padded vertex array, true count in the last slot (reference layout)
+    vout = onp.zeros(max_num_vertices + 1, dtype=onp.int64)
+    vout[:n] = verts
+    vout[-1] = n
+    layers = onp.full(max_num_vertices, -1, dtype=onp.int64)
+    layers[:n] = [layer_of[int(v)] for v in verts]
+    # sampled edges as a CSR over the ORIGINAL shape (reference example)
+    data, indices, indptr = [], [], [0]
+    for i in range(csr.shape[0]):
+        row = kept_edges.get(i, {})
+        for c in sorted(row):
+            indices.append(c)
+            data.append(row[c])
+        indptr.append(len(indices))
+    sub = CSRGraph(onp.asarray(data), indices, indptr, csr.shape)
+    return vout, sub, layers
+
+
+def dgl_csr_neighbor_uniform_sample(csr: CSRGraph, *seeds, num_hops=1,
+                                    num_neighbor=2, max_num_vertices=100,
+                                    seed: Optional[int] = None):
+    """Uniform neighbor sampling (ref `dgl_graph.cc:737`): per seed array
+    returns (vertices[max+1; count last], sampled-edge CSR, layers[max])."""
+    rng = onp.random.RandomState(seed)
+    out = []
+    for s in seeds:
+        out.extend(_neighbor_sample(csr, s, num_hops, num_neighbor,
+                                    max_num_vertices, rng))
+    return tuple(out)
+
+
+def dgl_csr_neighbor_non_uniform_sample(csr: CSRGraph, probability, *seeds,
+                                        num_hops=1, num_neighbor=2,
+                                        max_num_vertices=100,
+                                        seed: Optional[int] = None):
+    """Probability-weighted sampling (ref `dgl_graph.cc:841`);
+    `probability` has one non-negative weight per vertex."""
+    prob = _as_host(probability).astype(onp.float64)
+    if prob.shape[0] != csr.shape[1]:
+        raise MXNetError("probability length must equal vertex count")
+    rng = onp.random.RandomState(seed)
+    out = []
+    for s in seeds:
+        out.extend(_neighbor_sample(csr, s, num_hops, num_neighbor,
+                                    max_num_vertices, rng, prob=prob))
+    return tuple(out)
+
+
+def dgl_subgraph(csr: CSRGraph, *vids, return_mapping=False):
+    """Induced subgraph per vertex list (ref `dgl_graph.cc:1129`):
+    compacted square CSR over the given vertices; with `return_mapping`
+    also a CSR whose data are the parent edge ids."""
+    outs = []
+    maps = []
+    for v in vids:
+        v = _as_host(v).astype(onp.int64)
+        pos = {int(x): i for i, x in enumerate(v)}
+        data, parent, indices, indptr = [], [], [], [0]
+        for x in v:
+            cols, vals = csr.row(int(x))
+            for c, val in zip(cols, vals):
+                if int(c) in pos:
+                    indices.append(pos[int(c)])
+                    # subgraph edges get fresh local ids 1..n; the
+                    # mapping CSR carries the PARENT edge ids (reference
+                    # return_mapping contract, dgl_graph.cc:920)
+                    data.append(len(data) + 1)
+                    parent.append(val)
+            indptr.append(len(indices))
+        shape = (len(v), len(v))
+        outs.append(CSRGraph(onp.asarray(data, dtype=onp.int64),
+                             indices, indptr, shape))
+        maps.append(CSRGraph(onp.asarray(parent), indices, indptr, shape))
+    if return_mapping:
+        return tuple(outs) + tuple(maps)
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def dgl_adjacency(csr: CSRGraph):
+    """Binary adjacency as a dense DEVICE ndarray (ref
+    `dgl_graph.cc:1402`) — the handoff point from host sampling to
+    jit-compiled device GNN compute."""
+    from .. import numpy as mnp
+    dense = (csr.asnumpy() != 0).astype(onp.float32)
+    return mnp.array(dense)
+
+
+def dgl_graph_compact(csr: CSRGraph, vertices, graph_sizes=None,
+                      return_mapping=False):
+    """Compact a sampled original-shape CSR onto its vertex list (ref
+    `dgl_graph.cc:1577`): relabel rows/cols to 0..n-1. `vertices` is the
+    padded array from the samplers (true count in the last slot) or a
+    plain id list; `graph_sizes` overrides the count."""
+    v = _as_host(vertices).astype(onp.int64)
+    n = int(graph_sizes) if graph_sizes is not None else int(v[-1])
+    ids = v[:n]
+    sub = dgl_subgraph(csr, ids, return_mapping=return_mapping)
+    return sub
+
+
+def edge_id(csr: CSRGraph, u, v):
+    """Edge data (id) for each (u[i], v[i]) pair, -1 when absent (ref
+    `dgl_graph.cc:1326`)."""
+    u = _as_host(u).astype(onp.int64)
+    v = _as_host(v).astype(onp.int64)
+    if u.shape != v.shape:
+        raise MXNetError("u and v must have the same shape")
+    out = onp.full(u.shape, -1, dtype=onp.int64)
+    for i in range(u.size):
+        cols, vals = csr.row(int(u.flat[i]))
+        hit = onp.nonzero(cols == v.flat[i])[0]
+        if hit.size:
+            out.flat[i] = vals[hit[0]]
+    return out

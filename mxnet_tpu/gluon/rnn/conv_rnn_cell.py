@@ -161,11 +161,8 @@ class _ConvGRUCell(_BaseConvRNNCell):
     def forward(self, inputs, states):
         h = states[0]
         i2h, h2h = self._conv_gates(inputs, h)
-        hc = self._hidden_channels
-        i2h_r, i2h_z, i2h_n = (i2h[:, :hc], i2h[:, hc:2 * hc],
-                               i2h[:, 2 * hc:])
-        h2h_r, h2h_z, h2h_n = (h2h[:, :hc], h2h[:, hc:2 * hc],
-                               h2h[:, 2 * hc:])
+        i2h_r, i2h_z, i2h_n = self._split(i2h)
+        h2h_r, h2h_z, h2h_n = self._split(h2h)
         r = npx.sigmoid(i2h_r + h2h_r)
         z = npx.sigmoid(i2h_z + h2h_z)
         n = npx.activation(i2h_n + r * h2h_n, act_type=self._activation)

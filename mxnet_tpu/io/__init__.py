@@ -12,7 +12,7 @@ from ..base import MXNetError
 from ..ndarray.ndarray import ndarray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
-           "ResizeIter", "PrefetchingIter"]
+           "LibSVMIter", "ResizeIter", "PrefetchingIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
@@ -139,6 +139,62 @@ class CSVIter(DataIter):
             return _native.csv_read(path)
         return _onp.loadtxt(path, delimiter=",", dtype=_onp.float32,
                             ndmin=2)
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+
+class LibSVMIter(DataIter):
+    """LibSVM-format iterator (parity: `src/io/iter_libsvm.cc`).
+
+    Parses ``label idx:val idx:val ...`` lines. The reference emits CSR
+    batches; on TPU batches are DENSE (static shapes feed the compiler;
+    device CSR compute is out of scope — `ndarray/sparse.py`). Feature
+    indices are 0-based like the reference's default."""
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 label_shape=(1,), batch_size=1, **kwargs):
+        super().__init__(batch_size)
+        n_feat = int(_onp.prod(data_shape))
+        data, labels = self._load(data_libsvm, n_feat)
+        data = data.reshape((-1,) + tuple(data_shape))
+        if label_libsvm is not None:
+            # separate label file: plain values per line (reference
+            # iter_libsvm.cc label-libsvm input), not idx:val records
+            label = self._load_labels(label_libsvm)
+            label = label.reshape((-1,) + tuple(label_shape))
+        else:
+            label = labels.reshape((-1,) + tuple(label_shape))
+        self._inner = NDArrayIter(data, label, batch_size, **kwargs)
+
+    @staticmethod
+    def _load(path, n_feat):
+        rows, labels = [], []
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                row = _onp.zeros(n_feat, dtype=_onp.float32)
+                for tok in parts[1:]:
+                    idx, val = tok.split(":")
+                    row[int(idx)] = float(val)
+                rows.append(row)
+        return (_onp.stack(rows) if rows
+                else _onp.zeros((0, n_feat), _onp.float32)), \
+            _onp.asarray(labels, dtype=_onp.float32)
+
+    @staticmethod
+    def _load_labels(path):
+        vals = []
+        with open(path) as f:
+            for line in f:
+                vals.extend(float(t) for t in line.split())
+        return _onp.asarray(vals, dtype=_onp.float32)
 
     def reset(self):
         self._inner.reset()

@@ -297,3 +297,95 @@ def test_multibox_prior_nonsquare_aspect():
     h = anchors[0][3] - anchors[0][1]
     onp.testing.assert_allclose(w, 0.4 * 10 / 20, rtol=1e-5)
     onp.testing.assert_allclose(h, 0.4, rtol=1e-5)
+
+
+class TestGraphSampling:
+    """DGL-op parity (ref `src/operator/contrib/dgl_graph.cc`), host-side
+    sampling with padded device-ready outputs."""
+
+    def _k5(self):
+        # the reference docstring's 5-vertex complete graph, edge ids 1..20
+        from mxnet_tpu.contrib.graph import csr_graph
+        data = onp.arange(1, 21, dtype=onp.int64)
+        indices = onp.array([1, 2, 3, 4, 0, 2, 3, 4, 0, 1, 3, 4,
+                             0, 1, 2, 4, 0, 1, 2, 3], dtype=onp.int64)
+        indptr = onp.array([0, 4, 8, 12, 16, 20], dtype=onp.int64)
+        return csr_graph(data, indices, indptr, (5, 5))
+
+    def test_uniform_sample_shapes_and_counts(self):
+        from mxnet_tpu.contrib import graph as G
+        g = self._k5()
+        verts, sub, layers = G.dgl_csr_neighbor_uniform_sample(
+            g, onp.arange(5), num_hops=1, num_neighbor=2,
+            max_num_vertices=5, seed=0)
+        assert verts.shape == (6,)
+        assert verts[-1] == 5           # true count in the last slot
+        onp.testing.assert_array_equal(sorted(verts[:5]), range(5))
+        assert layers.shape == (5,)
+        assert set(layers.tolist()) == {0}  # all seeds are layer 0
+        # each row sampled exactly 2 of its 4 edges; values are edge ids
+        dense = sub.asnumpy()
+        assert sub.shape == (5, 5)
+        assert (dense > 0).sum() == 10
+        full = self._k5().asnumpy()
+        mask = dense > 0
+        onp.testing.assert_array_equal(dense[mask], full[mask])
+
+    def test_non_uniform_sample_respects_zero_prob(self):
+        from mxnet_tpu.contrib import graph as G
+        g = self._k5()
+        prob = onp.array([1.0, 1.0, 0.0, 1.0, 1.0])  # vertex 2 excluded
+        verts, sub, layers = G.dgl_csr_neighbor_non_uniform_sample(
+            g, prob, onp.array([0]), num_hops=1, num_neighbor=3,
+            max_num_vertices=5, seed=1)
+        dense = sub.asnumpy()
+        assert dense[:, 2].sum() == 0   # never samples prob-0 vertex
+
+    def test_subgraph_and_compact(self):
+        from mxnet_tpu.contrib import graph as G
+        g = self._k5()
+        sub = G.dgl_subgraph(g, onp.array([0, 2, 4]))
+        assert sub.shape == (3, 3)
+        # induced edges only: k5 restricted to {0,2,4} is complete on 3
+        assert (sub.asnumpy() > 0).sum() == 6
+        verts, sampled, _ = G.dgl_csr_neighbor_uniform_sample(
+            g, onp.array([1]), num_hops=1, num_neighbor=2,
+            max_num_vertices=5, seed=2)
+        compact = G.dgl_graph_compact(sampled, verts)
+        assert compact.shape == (int(verts[-1]), int(verts[-1]))
+
+    def test_adjacency_and_edge_id(self):
+        from mxnet_tpu.contrib import graph as G
+        g = self._k5()
+        adj = G.dgl_adjacency(g)
+        assert adj.shape == (5, 5)
+        a = adj.asnumpy()
+        assert a.sum() == 20 and a.diagonal().sum() == 0
+        eid = G.edge_id(g, onp.array([0, 0, 1]), onp.array([1, 0, 0]))
+        onp.testing.assert_array_equal(eid, [1, -1, 5])
+
+    def test_vertex_cap_drops_edges_consistently(self):
+        from mxnet_tpu.contrib import graph as G
+        g = self._k5()
+        verts, sub, layers = G.dgl_csr_neighbor_uniform_sample(
+            g, onp.array([0]), num_hops=1, num_neighbor=4,
+            max_num_vertices=3, seed=0)
+        n = int(verts[-1])
+        kept = set(verts[:n].tolist())
+        dense = sub.asnumpy()
+        srcs, dsts = onp.nonzero(dense)
+        # every edge endpoint is in the returned vertex set
+        assert set(srcs.tolist()) <= kept and set(dsts.tolist()) <= kept
+
+    def test_subgraph_mapping_carries_parent_ids(self):
+        from mxnet_tpu.contrib import graph as G
+        g = self._k5()
+        sub, mapping = G.dgl_subgraph(g, onp.array([0, 2, 4]),
+                                      return_mapping=True)
+        # subgraph edges are fresh local ids; mapping holds parent ids
+        assert sorted(sub.data.tolist()) == list(range(1, 7))
+        parent_dense = g.asnumpy()
+        for local_row, orig in enumerate([0, 2, 4]):
+            cols, parents = mapping.row(local_row)
+            for c, pid in zip(cols, parents):
+                assert parent_dense[orig, [0, 2, 4][c]] == pid
