@@ -202,14 +202,23 @@ def dgl_adjacency(csr: CSRGraph):
 def dgl_graph_compact(csr: CSRGraph, vertices, graph_sizes=None,
                       return_mapping=False):
     """Compact a sampled original-shape CSR onto its vertex list (ref
-    `dgl_graph.cc:1577`): relabel rows/cols to 0..n-1. `vertices` is the
-    padded array from the samplers (true count in the last slot) or a
-    plain id list; `graph_sizes` overrides the count."""
+    `dgl_graph.cc:1577`): relabel rows/cols to 0..n-1, PRESERVING the
+    input's edge data (edge ids) so edge-feature lookups stay valid.
+    `vertices` is the padded array from the samplers (true count in the
+    last slot) or a plain id list; `graph_sizes` overrides the count.
+    With `return_mapping`, also returns the same-structure CSR of parent
+    edge ids (== the data here, kept for reference-contract parity)."""
     v = _as_host(vertices).astype(onp.int64)
     n = int(graph_sizes) if graph_sizes is not None else int(v[-1])
     ids = v[:n]
-    sub = dgl_subgraph(csr, ids, return_mapping=return_mapping)
-    return sub
+    _, mapping = dgl_subgraph(csr, ids, return_mapping=True)
+    # mapping carries the parent (original) edge data — that IS the
+    # compacted graph's data under the reference contract
+    compact = CSRGraph(mapping.data, mapping.indices, mapping.indptr,
+                       mapping.shape)
+    if return_mapping:
+        return compact, mapping
+    return compact
 
 
 def edge_id(csr: CSRGraph, u, v):

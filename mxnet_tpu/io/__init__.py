@@ -166,6 +166,10 @@ class LibSVMIter(DataIter):
             # iter_libsvm.cc label-libsvm input), not idx:val records
             label = self._load_labels(label_libsvm)
             label = label.reshape((-1,) + tuple(label_shape))
+            if label.shape[0] != data.shape[0]:
+                raise MXNetError(
+                    f"label file has {label.shape[0]} rows but data file "
+                    f"has {data.shape[0]}")
         else:
             label = labels.reshape((-1,) + tuple(label_shape))
         self._inner = NDArrayIter(data, label, batch_size, **kwargs)
@@ -182,7 +186,12 @@ class LibSVMIter(DataIter):
                 row = _onp.zeros(n_feat, dtype=_onp.float32)
                 for tok in parts[1:]:
                     idx, val = tok.split(":")
-                    row[int(idx)] = float(val)
+                    i = int(idx)
+                    if not 0 <= i < n_feat:
+                        raise MXNetError(
+                            f"libsvm feature index {i} out of range "
+                            f"[0, {n_feat}) in line: {line.strip()!r}")
+                    row[i] = float(val)
                 rows.append(row)
         return (_onp.stack(rows) if rows
                 else _onp.zeros((0, n_feat), _onp.float32)), \

@@ -389,3 +389,20 @@ class TestGraphSampling:
             cols, parents = mapping.row(local_row)
             for c, pid in zip(cols, parents):
                 assert parent_dense[orig, [0, 2, 4][c]] == pid
+
+    def test_compact_preserves_edge_data(self):
+        from mxnet_tpu.contrib import graph as G
+        g = self._k5()
+        verts, sampled, _ = G.dgl_csr_neighbor_uniform_sample(
+            g, onp.array([1]), num_hops=1, num_neighbor=2,
+            max_num_vertices=5, seed=2)
+        compact = G.dgl_graph_compact(sampled, verts)
+        # compacted data are the ORIGINAL edge ids, not local relabels
+        full = g.asnumpy()
+        n = int(verts[-1])
+        ids = verts[:n]
+        dense = compact.asnumpy()
+        for i in range(n):
+            for j in range(n):
+                if dense[i, j]:
+                    assert dense[i, j] == full[ids[i], ids[j]]
