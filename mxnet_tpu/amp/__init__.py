@@ -70,8 +70,11 @@ def unscale(trainer):
         return
     scale = 1.0 / scaler.loss_scale
     for p in trainer._params:
-        if p.grad_req != "null" and p._grad is not None:
-            p._grad._data = p._grad._data * scale
+        if p.grad_req == "null" or getattr(p, "_data", None) is None:
+            continue
+        g = p.grad
+        if g is not None:
+            g._data = g._data * scale
 
 
 def convert_hybrid_block(block, target_dtype="bfloat16", target_dtype_ops=None,

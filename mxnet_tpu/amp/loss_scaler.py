@@ -15,10 +15,12 @@ class LossScaler:
     def has_overflow(self, params) -> bool:
         """Check gradients for inf/nan; returns True if the step must be skipped."""
         for p in params:
-            if p._grad is None:
+            if getattr(p, "_data", None) is None:
+                continue  # deferred/uninitialized: no gradient to check
+            g = p.grad  # ndarray or None (grad_req='null')
+            if g is None:
                 continue
-            g = p._grad.asnumpy()
-            if not _onp.isfinite(g).all():
+            if not _onp.isfinite(g.asnumpy()).all():
                 return True
         return False
 

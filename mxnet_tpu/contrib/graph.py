@@ -206,10 +206,21 @@ def dgl_graph_compact(csr: CSRGraph, vertices, graph_sizes=None,
     input's edge data (edge ids) so edge-feature lookups stay valid.
     `vertices` is the padded array from the samplers (true count in the
     last slot) or a plain id list; `graph_sizes` overrides the count.
-    With `return_mapping`, also returns the same-structure CSR of parent
-    edge ids (== the data here, kept for reference-contract parity)."""
+    With `return_mapping`, also returns an independent same-structure CSR
+    of parent edge ids (== the data here, kept for reference-contract
+    parity).
+
+    NOTE: without `graph_sizes`, `vertices` MUST be the padded sampler
+    layout (true count in the last slot) — a plain id list is
+    indistinguishable from it, so plain lists require
+    ``graph_sizes=len(ids)`` explicitly."""
     v = _as_host(vertices).astype(onp.int64)
     n = int(graph_sizes) if graph_sizes is not None else int(v[-1])
+    if not 0 <= n <= len(v):
+        raise MXNetError(
+            f"graph_compact: vertex count {n} out of range for a "
+            f"length-{len(v)} vertex array (plain id lists need "
+            f"graph_sizes=len(ids))")
     ids = v[:n]
     _, mapping = dgl_subgraph(csr, ids, return_mapping=True)
     # mapping carries the parent (original) edge data — that IS the
@@ -217,7 +228,9 @@ def dgl_graph_compact(csr: CSRGraph, vertices, graph_sizes=None,
     compact = CSRGraph(mapping.data, mapping.indices, mapping.indptr,
                        mapping.shape)
     if return_mapping:
-        return compact, mapping
+        return compact, CSRGraph(mapping.data.copy(),
+                                 mapping.indices.copy(),
+                                 mapping.indptr.copy(), mapping.shape)
     return compact
 
 

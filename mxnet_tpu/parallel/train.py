@@ -75,7 +75,7 @@ class ShardedTrainStep:
             n: jax.tree_util.tree_map(
                 lambda s: jax.device_put(s, _like_sharding(
                     self.param_shardings[n], s, params[n])),
-                optimizer.create_state_jax(self.pvals[n]))
+                optimizer.create_state_jax(_master_dtype(self.pvals[n])))
             for n in self.diff_names}
         self._t = 0
 
@@ -179,6 +179,17 @@ class ShardedTrainStep:
             new_s = {}
             for n in diff_names:
                 w, s = optimizer._rule(pvals[n], grads[n], opt_state[n], hp)
+                # low-precision training: fp32 hyperparameter scalars
+                # promote the update math (desired — that's the implicit
+                # master-weight path; state was created fp32 above), but
+                # the stored weight/state dtypes must stay EXACTLY as
+                # declared or donation breaks and every step retraces
+                if w.dtype != pvals[n].dtype:
+                    w = w.astype(pvals[n].dtype)
+                s = jax.tree_util.tree_map(
+                    lambda new, old: new.astype(old.dtype)
+                    if hasattr(new, "dtype") and new.dtype != old.dtype
+                    else new, s, opt_state[n])
                 new_p[n] = w
                 new_s[n] = s
             new_p.update(aux)  # running-stat writebacks
@@ -320,6 +331,17 @@ def _shard_from_host(arr, sharding):
     arr = onp.asarray(arr)
     return jax.make_array_from_callback(arr.shape, sharding,
                                         lambda idx: arr[idx])
+
+
+def _master_dtype(w):
+    """Optimizer state for 16-bit weights accumulates in fp32 (the
+    multi-precision default; bf16 m/v drifts) — hand `create_state_jax` an
+    fp32 ShapeDtypeStruct so `zeros_like` state comes out fp32 WITHOUT
+    materializing an fp32 copy of the parameter (2x HBM spike at init)."""
+    if jnp.issubdtype(w.dtype, jnp.floating) and \
+            jnp.dtype(w.dtype).itemsize < 4:
+        return jax.ShapeDtypeStruct(w.shape, jnp.float32)
+    return w
 
 
 def _like_sharding(param_sharding: NamedSharding, state_leaf, param):
