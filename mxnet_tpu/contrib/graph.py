@@ -215,6 +215,10 @@ def dgl_graph_compact(csr: CSRGraph, vertices, graph_sizes=None,
     indistinguishable from it, so plain lists require
     ``graph_sizes=len(ids)`` explicitly."""
     v = _as_host(vertices).astype(onp.int64)
+    if graph_sizes is None and len(v) == 0:
+        raise MXNetError(
+            "graph_compact: empty vertices array (plain id lists need "
+            "graph_sizes=len(ids))")
     n = int(graph_sizes) if graph_sizes is not None else int(v[-1])
     if not 0 <= n <= len(v):
         raise MXNetError(

@@ -294,9 +294,15 @@ class ShardedTrainStep:
                     raise MXNetError(
                         f"checkpoint {path} missing optimizer state {key} "
                         f"(optimizer type changed since save?)")
+                val = raw[key]
+                # restore at the CURRENT state dtype: a checkpoint written
+                # before the fp32-master-state default would otherwise pin
+                # bf16 m/v back onto a step compiled for fp32 state
+                if hasattr(old, "dtype") and val.dtype != old.dtype:
+                    val = val.astype(old.dtype)
                 sharding = _like_sharding(self.param_shardings[n],
-                                          raw[key], self.params[n])
-                new_leaves.append(_shard_from_host(raw[key], sharding))
+                                          val, self.params[n])
+                new_leaves.append(_shard_from_host(val, sharding))
             self.opt_state[n] = jax.tree_util.tree_unflatten(
                 treedef, new_leaves)
         self._t = int(raw["meta:t"])

@@ -109,6 +109,35 @@ def test_bf16_sharded_train_step_converges():
     losses = [float(step(x, y)) for _ in range(25)]
     assert losses[-1] < losses[0]
     # parameters stayed bf16 end to end (no silent fp32 promotion)
-    assert step.pvals[net.weight._uuid if hasattr(net.weight, '_uuid')
-                      else sorted(step.pvals)[1]].dtype == jnp.bfloat16 \
-        or all(v.dtype == jnp.bfloat16 for v in step.pvals.values())
+    assert all(v.dtype == jnp.bfloat16 for v in step.pvals.values()), \
+        {n: str(v.dtype) for n, v in step.pvals.items()}
+
+
+@pytest.mark.parametrize("opt_name", ["sgd", "adam", "adamw", "lamb",
+                                      "rmsprop", "adagrad"])
+def test_bf16_weight_dtype_stable_across_optimizers(opt_name):
+    """Regression: fp32 hyperparameter scalars must not promote bf16
+    weights through any optimizer's update rule in the sharded step."""
+    import jax
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu.parallel import make_mesh, make_sharded_train_step
+
+    net = gluon.nn.Dense(3, in_units=5, dtype="bfloat16")
+    net.initialize()
+    x = mx.np.array(onp.ones((4, 5), dtype="float32")).astype("bfloat16")
+    y = mx.np.array(onp.ones((4, 3), dtype="float32"))
+
+    def loss_fn(out, xb, yb):
+        return ((out.astype(jnp.float32) - yb) ** 2).mean()
+
+    mesh = make_mesh({"dp": 1}, jax.devices("cpu")[:1])
+    step = make_sharded_train_step(
+        net, opt.create(opt_name, learning_rate=0.01), loss_fn, mesh,
+        num_model_args=1)
+    for _ in range(3):
+        step(x, y)
+    assert all(v.dtype == jnp.bfloat16 for v in step.pvals.values()), \
+        {n: str(v.dtype) for n, v in step.pvals.items()}
+    assert all(l.dtype == jnp.float32
+               for s in step.opt_state.values()
+               for l in jax.tree_util.tree_leaves(s))

@@ -49,3 +49,31 @@ def test_dist_sync_kvstore_two_processes():
     assert proc.returncode == 0, f"dist workers failed:\n{out[-4000:]}"
     assert "[rank 0] dist_sync_kvstore OK (n=2)" in out
     assert "[rank 1] dist_sync_kvstore OK (n=2)" in out
+
+
+def test_dist_elastic_coordinated_preemption():
+    """One rank's preemption notice must checkpoint-and-stop EVERY rank at
+    the same step (elastic.sync_flag allgather; SURVEY §5.3)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    cmd = [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+           "-n", "2", "--launcher", "local", "-p", str(_free_port()),
+           sys.executable, os.path.join(ROOT, "tests", "dist",
+                                        "dist_elastic.py")]
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True, env=env,
+                            cwd=ROOT, start_new_session=True)
+    try:
+        stdout, _ = proc.communicate(timeout=280)
+    except subprocess.TimeoutExpired:
+        os.killpg(proc.pid, signal.SIGKILL)
+        stdout, _ = proc.communicate()
+        pytest.fail(f"elastic dist workers timed out:\n{stdout[-4000:]}")
+    assert proc.returncode == 0, stdout[-4000:]
+    import re
+    steps = re.findall(r"\[rank (\d)\] elastic preempted at step (\d+) OK",
+                       stdout)
+    assert len(steps) == 2, stdout[-2000:]
+    assert steps[0][1] == steps[1][1], steps  # same step on every rank
