@@ -56,6 +56,12 @@ def run_performance_test(ops, inputs: Optional[Sequence[dict]] = None,
     results = []
     rng = _onp.random.RandomState(0)
 
+    # ops whose domain (or whose gradient's domain) excludes negatives:
+    # standard-normal inputs would time NaN-saturated transcendental
+    # paths instead of the real kernels
+    _POSITIVE_DOMAIN = {"log", "log2", "log10", "log1p", "sqrt", "rsqrt",
+                        "cbrt", "power", "gamma", "gammaln"}
+
     for op in ops:
         if isinstance(op, str):
             fn = getattr(npx, op, None) or getattr(mnp, op, None)
@@ -70,8 +76,11 @@ def run_performance_test(ops, inputs: Optional[Sequence[dict]] = None,
             for k, v in spec.items():
                 if isinstance(v, tuple) and all(isinstance(d, int)
                                                 for d in v):
-                    arrays.append(jnp.asarray(
-                        rng.randn(*v).astype(dtype)))
+                    if name in _POSITIVE_DOMAIN:
+                        raw = rng.uniform(0.5, 1.5, size=v)
+                    else:
+                        raw = rng.randn(*v)
+                    arrays.append(jnp.asarray(raw.astype(dtype)))
                 else:
                     kwargs[k] = v
 
@@ -117,6 +126,32 @@ DEFAULT_OPS = [
     ("sigmoid", [{"data": (1024, 1024)}]),
     ("fully_connected", [{"x": (64, 1024), "weight": (512, 1024),
                           "bias": (512,)}]),
+    # NN layer corpus (reference tables cover conv/norm/pool families)
+    # NOTE: tuple values mean "random array of this shape"; structural
+    # kwargs (kernel/stride) must therefore be LISTS
+    ("convolution", [{"data": (8, 32, 28, 28), "weight": (64, 32, 3, 3),
+                      "bias": (64,), "kernel": [3, 3], "num_filter": 64}]),
+    ("pooling", [{"data": (8, 32, 28, 28), "kernel": [2, 2],
+                  "pool_type": "max", "stride": [2, 2]}]),
+    ("layer_norm", [{"data": (64, 1024), "gamma": (1024,),
+                     "beta": (1024,)}]),
+    ("log_softmax", [{"data": (64, 1024)}]),
+    ("gelu", [{"data": (1024, 1024)}]),
+    ("tanh", [{"data": (1024, 1024)}]),
+    ("sqrt", [{"data": (1024, 1024)}]),
+    ("divide", [{"lhs": (1024, 1024), "rhs": (1024, 1024)}]),
+    ("subtract", [{"lhs": (1024, 1024), "rhs": (1024, 1024)}]),
+    ("power", [{"lhs": (1024, 1024), "rhs": (1024, 1024)}]),
+    ("maximum", [{"lhs": (1024, 1024), "rhs": (1024, 1024)}]),
+    ("mean", [{"data": (1024, 1024)}]),
+    ("min", [{"data": (1024, 1024)}]),
+    ("argmax", [{"data": (1024, 1024)}]),
+    ("transpose", [{"data": (1024, 1024)}]),
+    ("matmul", [{"a": (512, 512), "b": (512, 512)}]),
+    ("abs", [{"data": (1024, 1024)}]),
+    ("clip", [{"data": (1024, 1024), "min": -1.0, "max": 1.0}]),
+    ("cumsum", [{"data": (1024, 1024)}]),
+    ("sort", [{"data": (1024, 1024)}]),
 ]
 
 
