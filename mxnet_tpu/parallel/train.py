@@ -38,7 +38,14 @@ class ShardedTrainStep:
                  rules: Optional[ShardingRules] = None,
                  batch_specs: Optional[Tuple] = None,
                  num_model_args: Optional[int] = None,
-                 grad_accum_dtype=jnp.float32):
+                 grad_accum_dtype=jnp.float32,
+                 zero: bool = False):
+        # ZeRO stage 1: shard optimizer state over the 'dp' axis instead
+        # of replicating it (params stay replicated; XLA inserts the
+        # reduce-scatter/all-gather around the sharded update). Cuts
+        # optimizer-state HBM by the dp degree — for Adam on bf16 weights
+        # that's 4x the weight bytes saved per extra dp shard.
+        self.zero = zero
         self.block = block
         # how many leading batch args feed block.forward; the rest (labels
         # etc.) only reach loss_fn. None = all.
@@ -73,11 +80,38 @@ class ShardedTrainStep:
                       for n in self.param_names}
         self.opt_state = {
             n: jax.tree_util.tree_map(
-                lambda s: jax.device_put(s, _like_sharding(
-                    self.param_shardings[n], s, params[n])),
+                lambda s, _n=n: jax.device_put(s, self._state_sharding(
+                    self.param_shardings[_n], s, params[_n])),
                 optimizer.create_state_jax(_master_dtype(self.pvals[n])))
             for n in self.diff_names}
         self._t = 0
+
+    def _state_sharding(self, param_sharding, state_leaf, param):
+        """Placement for one optimizer-state leaf: like the parameter —
+        plus, under ZeRO, the first unsharded divisible dim spread over
+        'dp' (the reduce-scatter/all-gather pattern XLA then emits is
+        exactly ZeRO stage 1)."""
+        base = _like_sharding(param_sharding, state_leaf, param)
+        if not self.zero or "dp" not in self.mesh.axis_names:
+            return base
+        dp = self.mesh.shape["dp"]
+        shape = getattr(state_leaf, "shape", ())
+        if dp <= 1 or not shape:
+            return base
+        spec = list(base.spec) + [None] * (len(shape) - len(base.spec))
+        used = {a for e in spec
+                for a in ((e,) if isinstance(e, str) else (e or ()))}
+        if "dp" not in used:  # an FSDP-style param may already use 'dp'
+            for i, dim in enumerate(shape):
+                if spec[i] is None and dim % dp == 0:
+                    spec[i] = "dp"
+                    return NamedSharding(self.mesh, P(*spec))
+        import logging
+        logging.getLogger(__name__).warning(
+            "zero=True: optimizer-state leaf %s for parameter of shape %s "
+            "cannot shard over dp=%d (no free divisible dim); it stays "
+            "replicated", tuple(shape), tuple(param.shape), dp)
+        return base
 
     def _resolve_sharding(self, name: str, param) -> NamedSharding:
         import logging
@@ -198,8 +232,8 @@ class ShardedTrainStep:
         pspec = {n: self.param_shardings[n] for n in self.param_names}
         sspec = {
             n: jax.tree_util.tree_map(
-                lambda s: _like_sharding(self.param_shardings[n], s,
-                                         self.params[n]),
+                lambda s, _n=n: self._state_sharding(
+                    self.param_shardings[_n], s, self.params[_n]),
                 self.opt_state[n])
             for n in self.diff_names}
         repl = NamedSharding(mesh, P())
@@ -300,8 +334,8 @@ class ShardedTrainStep:
                 # bf16 m/v back onto a step compiled for fp32 state
                 if hasattr(old, "dtype") and val.dtype != old.dtype:
                     val = val.astype(old.dtype)
-                sharding = _like_sharding(self.param_shardings[n],
-                                          val, self.params[n])
+                sharding = self._state_sharding(self.param_shardings[n],
+                                                val, self.params[n])
                 new_leaves.append(_shard_from_host(val, sharding))
             self.opt_state[n] = jax.tree_util.tree_unflatten(
                 treedef, new_leaves)
@@ -360,7 +394,7 @@ def _like_sharding(param_sharding: NamedSharding, state_leaf, param):
 
 
 def make_sharded_train_step(block, optimizer, loss_fn, mesh, rules=None,
-                            batch_specs=None,
-                            num_model_args=None) -> ShardedTrainStep:
+                            batch_specs=None, num_model_args=None,
+                            zero=False) -> ShardedTrainStep:
     return ShardedTrainStep(block, optimizer, loss_fn, mesh, rules,
-                            batch_specs, num_model_args)
+                            batch_specs, num_model_args, zero=zero)

@@ -381,3 +381,56 @@ def test_bert_masked_remat_dp_sp_tp_matches_single_device():
     single = build({"dp": 1}, devs[:1])
     full = build({"dp": 2, "sp": 2, "tp": 2}, devs[:8])
     onp.testing.assert_allclose(full, single, rtol=1e-4)
+
+
+def test_zero1_optimizer_state_sharding_matches_replicated(tmp_path):
+    """ZeRO stage 1 (optimizer state sharded over dp) must reproduce the
+    replicated-state trajectory exactly, actually shard the state, and
+    checkpoint/restore across the two layouts."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu.gluon import nn
+
+    def build(zero):
+        mx.random.seed(31)
+        net = nn.Dense(8, in_units=16)  # weight (8, 16): 8 % 4 == 0
+        net.initialize()
+        rng = onp.random.RandomState(0)
+        x = mx.np.array(rng.rand(8, 16).astype("float32"))
+        y = mx.np.array(rng.rand(8, 8).astype("float32"))
+        mesh = make_mesh({"dp": 4}, jax.devices("cpu")[:4])
+        step = make_sharded_train_step(
+            net, opt.Adam(learning_rate=0.01),
+            lambda out, xa, ya: ((out - ya) ** 2).mean(), mesh,
+            num_model_args=1, zero=zero)
+        return step, x, y
+
+    step_r, x, y = build(zero=False)
+    ref = [float(step_r(x, y)) for _ in range(5)]
+
+    step_z, x2, y2 = build(zero=True)
+    got = [float(step_z(x2, y2)) for _ in range(5)]
+    onp.testing.assert_allclose(got, ref, rtol=1e-6)
+
+    # the state really is sharded over dp (weight-shaped leaves)
+    def _axes(spec):
+        for e in spec:
+            if isinstance(e, str):
+                yield e
+            elif e:
+                yield from e
+
+    sharded = [l for s in step_z.opt_state.values()
+               for l in jax.tree_util.tree_leaves(s)
+               if "dp" in set(_axes(l.sharding.spec))]
+    assert sharded, "no optimizer-state leaf is dp-sharded under zero=True"
+
+    # checkpoint round-trip: save sharded, load into replicated, continue
+    p = str(tmp_path / "z.npz")
+    step_z.save(p)
+    step_r2, x3, y3 = build(zero=False)
+    step_r2.load(p)
+    a = [float(step_z(x2, y2)) for _ in range(3)]
+    b = [float(step_r2(x3, y3)) for _ in range(3)]
+    onp.testing.assert_allclose(b, a, rtol=1e-6)
