@@ -15,6 +15,16 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as onp
+import logging
+
+_log = logging.getLogger(__name__)
+
+
+def _spec_axes(spec):
+    """Flatten a PartitionSpec's entries to the set of mesh-axis names."""
+    return {a for e in spec
+            for a in ((e,) if isinstance(e, str) else (e or ()))}
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError
@@ -39,13 +49,21 @@ class ShardedTrainStep:
                  batch_specs: Optional[Tuple] = None,
                  num_model_args: Optional[int] = None,
                  grad_accum_dtype=jnp.float32,
-                 zero: bool = False):
+                 zero: bool = False, fsdp: bool = False):
         # ZeRO stage 1: shard optimizer state over the 'dp' axis instead
         # of replicating it (params stay replicated; XLA inserts the
         # reduce-scatter/all-gather around the sharded update). Cuts
         # optimizer-state HBM by the dp degree — for Adam on bf16 weights
         # that's 4x the weight bytes saved per extra dp shard.
         self.zero = zero
+        # FSDP (ZeRO stage 3): ALSO shard the parameters themselves over
+        # 'dp' (first free divisible dim); XLA all-gathers each weight
+        # just-in-time at its use and keeps gradients reduce-scattered.
+        # Implies zero (sharded params get matching sharded state).
+        self.fsdp = fsdp
+        if fsdp:
+            self.zero = True
+        self._zero_warned = set()
         self.block = block
         # how many leading batch args feed block.forward; the rest (labels
         # etc.) only reach loss_fn. None = all.
@@ -86,6 +104,17 @@ class ShardedTrainStep:
             for n in self.diff_names}
         self._t = 0
 
+    # parameters below this size stay replicated under fsdp (per-use
+    # all-gathers of tiny biases cost more than they save)
+    FSDP_MIN_SIZE = 8192
+
+    def _maybe_fsdp(self, sharding: NamedSharding, param) -> NamedSharding:
+        if not self.fsdp or \
+                int(onp.prod(param.shape)) < self.FSDP_MIN_SIZE:
+            return sharding
+        ns = _with_dp_axis(self.mesh, sharding.spec, param.shape)
+        return ns if ns is not None else sharding
+
     def _state_sharding(self, param_sharding, state_leaf, param):
         """Placement for one optimizer-state leaf: like the parameter —
         plus, under ZeRO, the first unsharded divisible dim spread over
@@ -94,29 +123,23 @@ class ShardedTrainStep:
         base = _like_sharding(param_sharding, state_leaf, param)
         if not self.zero or "dp" not in self.mesh.axis_names:
             return base
-        dp = self.mesh.shape["dp"]
         shape = getattr(state_leaf, "shape", ())
-        if dp <= 1 or not shape:
-            return base
-        spec = list(base.spec) + [None] * (len(shape) - len(base.spec))
-        used = {a for e in spec
-                for a in ((e,) if isinstance(e, str) else (e or ()))}
-        if "dp" not in used:  # an FSDP-style param may already use 'dp'
-            for i, dim in enumerate(shape):
-                if spec[i] is None and dim % dp == 0:
-                    spec[i] = "dp"
-                    return NamedSharding(self.mesh, P(*spec))
-        import logging
-        logging.getLogger(__name__).warning(
-            "zero=True: optimizer-state leaf %s for parameter of shape %s "
-            "cannot shard over dp=%d (no free divisible dim); it stays "
-            "replicated", tuple(shape), tuple(param.shape), dp)
+        ns = _with_dp_axis(self.mesh, base.spec, shape)
+        if ns is not None:
+            return ns
+        key = (tuple(param.shape), tuple(shape))
+        if "dp" not in _spec_axes(base.spec) and shape \
+                and self.mesh.shape["dp"] > 1 \
+                and key not in self._zero_warned:
+            self._zero_warned.add(key)
+            _log.warning(
+                "zero=True: optimizer-state leaf %s for parameter of "
+                "shape %s cannot shard over dp=%d (no free divisible "
+                "dim); it stays replicated", tuple(shape),
+                tuple(param.shape), self.mesh.shape["dp"])
         return base
 
     def _resolve_sharding(self, name: str, param) -> NamedSharding:
-        import logging
-        import numpy as onp
-        from jax.sharding import PartitionSpec as P
         mesh = self.mesh
         ann = getattr(param, "sharding", None)
         if ann is not None:
@@ -153,20 +176,22 @@ class ShardedTrainStep:
                 cleaned.append(kept[0] if len(kept) == 1
                                else (tuple(kept) if kept else None))
             spec = P(*cleaned)
-            return NamedSharding(mesh, spec)
+            return self._maybe_fsdp(NamedSharding(mesh, spec), param)
         sharding = self.rules.sharding_for(mesh, name, param.shape)
         # 'dp' replicates params by design; 'sp' shards activations, never
-        # params — only true model axes (tp/ep/...) make replication a smell
+        # params — only true model axes (tp/ep/...) make replication a smell.
+        # Checked BEFORE the fsdp augment: fsdp's dp axis doesn't cure
+        # replication across tp/ep
         model_axes = [a for a in mesh.axis_names if a not in ("dp", "sp")
                       and mesh.shape[a] > 1]
         if sharding.spec == P() and model_axes and \
                 int(onp.prod(param.shape)) >= 1_000_000:
-            logging.getLogger(__name__).warning(
+            _log.warning(
                 "parameter %s %s matched no sharding rule and will be "
                 "REPLICATED across the %s mesh axes; annotate it with "
                 "Parameter(sharding=...) or extend ShardingRules",
                 name, tuple(param.shape), model_axes)
-        return sharding
+        return self._maybe_fsdp(sharding, param)
 
     # ------------------------------------------------------------------
     def _build(self, batch_vals, rng_key):
@@ -282,7 +307,6 @@ class ShardedTrainStep:
     def save(self, path: str) -> None:
         """Checkpoint params, optimizer state, step count, and RNG to `path`
         (.npz). Sharded arrays are gathered to host; `load` re-shards."""
-        import numpy as onp
         from .. import random as _rng
         from ..util import npz_encode_entry
 
@@ -307,7 +331,6 @@ class ShardedTrainStep:
     def load(self, path: str) -> None:
         """Restore a `save` checkpoint; arrays are re-placed with this
         step's shardings (the mesh/topology may differ from save time)."""
-        import numpy as onp
         from .. import random as _rng
 
         from ..util import npz_decode_entry
@@ -367,10 +390,25 @@ def _shard_from_host(arr, sharding):
     a = jnp.asarray(arr) if jax.process_count() == 1 else arr
     if jax.process_count() == 1:
         return jax.device_put(a, sharding)
-    import numpy as onp
     arr = onp.asarray(arr)
     return jax.make_array_from_callback(arr.shape, sharding,
                                         lambda idx: arr[idx])
+
+
+def _with_dp_axis(mesh: Mesh, spec, shape):
+    """Add 'dp' to the first free divisible dim of `spec`; None when the
+    mesh has no dp>1 axis, 'dp' is already used, or no dim divides."""
+    dp = dict(mesh.shape).get("dp", 1)
+    if dp <= 1 or not shape:
+        return None
+    spec = list(spec) + [None] * (len(shape) - len(spec))
+    if "dp" in _spec_axes(spec):
+        return None
+    for i, dim in enumerate(shape):
+        if spec[i] is None and dim % dp == 0:
+            spec[i] = "dp"
+            return NamedSharding(mesh, P(*spec))
+    return None
 
 
 def _master_dtype(w):
@@ -395,6 +433,7 @@ def _like_sharding(param_sharding: NamedSharding, state_leaf, param):
 
 def make_sharded_train_step(block, optimizer, loss_fn, mesh, rules=None,
                             batch_specs=None, num_model_args=None,
-                            zero=False) -> ShardedTrainStep:
+                            zero=False, fsdp=False) -> ShardedTrainStep:
     return ShardedTrainStep(block, optimizer, loss_fn, mesh, rules,
-                            batch_specs, num_model_args, zero=zero)
+                            batch_specs, num_model_args, zero=zero,
+                            fsdp=fsdp)

@@ -414,16 +414,10 @@ def test_zero1_optimizer_state_sharding_matches_replicated(tmp_path):
     onp.testing.assert_allclose(got, ref, rtol=1e-6)
 
     # the state really is sharded over dp (weight-shaped leaves)
-    def _axes(spec):
-        for e in spec:
-            if isinstance(e, str):
-                yield e
-            elif e:
-                yield from e
-
+    from mxnet_tpu.parallel.train import _spec_axes
     sharded = [l for s in step_z.opt_state.values()
                for l in jax.tree_util.tree_leaves(s)
-               if "dp" in set(_axes(l.sharding.spec))]
+               if "dp" in _spec_axes(l.sharding.spec)]
     assert sharded, "no optimizer-state leaf is dp-sharded under zero=True"
 
     # checkpoint round-trip: save sharded, load into replicated, continue
@@ -434,3 +428,43 @@ def test_zero1_optimizer_state_sharding_matches_replicated(tmp_path):
     a = [float(step_z(x2, y2)) for _ in range(3)]
     b = [float(step_r2(x3, y3)) for _ in range(3)]
     onp.testing.assert_allclose(b, a, rtol=1e-6)
+
+
+def test_fsdp_parameter_sharding_matches_replicated():
+    """fsdp=True (ZeRO-3: params dp-sharded, gathered at use) must match
+    the replicated trajectory and actually shard large parameters."""
+    import jax
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu.gluon import nn
+
+    def build(fsdp):
+        mx.random.seed(13)
+        net = nn.HybridSequential()
+        # 128*128 = 16384 >= FSDP_MIN_SIZE -> sharded; bias stays small
+        net.add(nn.Dense(128, in_units=128, activation="relu"),
+                nn.Dense(4, in_units=128))
+        net.initialize()
+        rng = onp.random.RandomState(1)
+        x = mx.np.array(rng.rand(8, 128).astype("float32"))
+        y = mx.np.array(rng.rand(8, 4).astype("float32"))
+        mesh = make_mesh({"dp": 4}, jax.devices("cpu")[:4])
+        step = make_sharded_train_step(
+            net, opt.Adam(learning_rate=0.01),
+            lambda out, xa, ya: ((out - ya) ** 2).mean(), mesh,
+            num_model_args=1, fsdp=fsdp)
+        return step, x, y
+
+    step_r, x, y = build(False)
+    ref = [float(step_r(x, y)) for _ in range(5)]
+    step_f, x2, y2 = build(True)
+    got = [float(step_f(x2, y2)) for _ in range(5)]
+    onp.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    from mxnet_tpu.parallel.train import _spec_axes
+    big = [n for n, v in step_f.pvals.items() if v.size >= 8192]
+    assert big
+    for n in big:
+        assert "dp" in _spec_axes(step_f.pvals[n].sharding.spec), \
+            (n, step_f.pvals[n].sharding)
+    # fsdp implies zero: matching state is sharded too
+    assert step_f.zero
