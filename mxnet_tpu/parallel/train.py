@@ -15,16 +15,9 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as onp
 import logging
 
-_log = logging.getLogger(__name__)
-
-
-def _spec_axes(spec):
-    """Flatten a PartitionSpec's entries to the set of mesh-axis names."""
-    return {a for e in spec
-            for a in ((e,) if isinstance(e, str) else (e or ()))}
+import numpy as onp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError
@@ -34,6 +27,14 @@ from ..optimizer import Optimizer
 from .sharding import ShardingRules, default_tp_rules
 
 __all__ = ["ShardedTrainStep", "make_sharded_train_step"]
+
+_log = logging.getLogger(__name__)
+
+
+def _spec_axes(spec):
+    """Flatten a PartitionSpec's entries to the set of mesh-axis names."""
+    return {a for e in spec
+            for a in ((e,) if isinstance(e, str) else (e or ()))}
 
 
 class ShardedTrainStep:
@@ -48,7 +49,7 @@ class ShardedTrainStep:
                  rules: Optional[ShardingRules] = None,
                  batch_specs: Optional[Tuple] = None,
                  num_model_args: Optional[int] = None,
-                 grad_accum_dtype=jnp.float32,
+                 grad_accum_dtype=jnp.float32, grad_accum: int = 1,
                  zero: bool = False, fsdp: bool = False):
         # ZeRO stage 1: shard optimizer state over the 'dp' axis instead
         # of replicating it (params stay replicated; XLA inserts the
@@ -64,6 +65,12 @@ class ShardedTrainStep:
         if fsdp:
             self.zero = True
         self._zero_warned = set()
+        # accumulate gradients over this many microbatches per step (the
+        # global batch splits on its leading dim; must divide it)
+        if grad_accum < 1:
+            raise MXNetError(f"grad_accum must be >= 1, got {grad_accum}")
+        self.grad_accum = int(grad_accum)
+        self.grad_accum_dtype = grad_accum_dtype
         self.block = block
         # how many leading batch args feed block.forward; the rest (labels
         # etc.) only reach loss_fn. None = all.
@@ -218,22 +225,71 @@ class ShardedTrainStep:
 
         n_model = self.num_model_args
 
+        k = self.grad_accum
+        accum_dtype = self.grad_accum_dtype
+
         def step(pvals, opt_state, hp, key, *batch):
-            def compute_loss(diff_vals):
+            def compute_loss(diff_vals, mkey, *mb):
                 pv = dict(pvals)
                 pv.update(diff_vals)
-                model_args = batch if n_model is None else batch[:n_model]
+                model_args = mb if n_model is None else mb[:n_model]
                 out, aux = functional_call(block, pv, *model_args,
-                                           training=True, rng_key=key)
-                loss = loss_fn(out, *batch)
+                                           training=True, rng_key=mkey)
+                loss = loss_fn(out, *mb)
                 # a loss_fn written in mx.np ops returns a wrapped scalar;
                 # unwrap so value_and_grad sees a jax value
                 loss = getattr(loss, "_data", loss)
                 return loss, aux
 
             diff_vals = {n: pvals[n] for n in diff_names}
-            (loss, aux), grads = jax.value_and_grad(
-                compute_loss, has_aux=True)(diff_vals)
+            if k == 1:
+                (loss, aux), grads = jax.value_and_grad(
+                    compute_loss, has_aux=True)(diff_vals, key, *batch)
+            else:
+                # gradient accumulation: scan over k microbatches,
+                # accumulating mean-of-means grads at accum_dtype — the
+                # large-effective-batch path (reference Trainer's
+                # update-skipping idiom, compiled into one program)
+                micro = []
+                for bi, b in enumerate(batch):
+                    if b.ndim < 1 or b.shape[0] % k:
+                        raise MXNetError(
+                            f"grad_accum={k} must divide every batch "
+                            f"arg's leading dim; got shape "
+                            f"{tuple(b.shape)}")
+                    mb = b.reshape((k, b.shape[0] // k)
+                                   + tuple(b.shape[1:]))
+                    # keep each microbatch dp-sharded on ITS batch dim —
+                    # without the constraint GSPMD can move 'dp' onto the
+                    # scan axis and every iteration pays a reshard
+                    spec = (self.batch_specs[bi]
+                            if self.batch_specs else None)
+                    if spec is not None and "dp" in _spec_axes(spec):
+                        mb = jax.lax.with_sharding_constraint(
+                            mb, NamedSharding(mesh, P(None, *spec)))
+                    micro.append(mb)
+                micro = tuple(micro)
+                keys = jax.random.split(key, k)
+
+                def body(carry, xs):
+                    acc, lsum = carry
+                    mkey, mb = xs[0], xs[1:]
+                    (loss, aux), grads = jax.value_and_grad(
+                        compute_loss, has_aux=True)(diff_vals, mkey, *mb)
+                    acc = jax.tree_util.tree_map(
+                        lambda a, g: a + g.astype(accum_dtype), acc, grads)
+                    return (acc, lsum + loss), aux
+
+                init = (jax.tree_util.tree_map(
+                    lambda v: jnp.zeros(v.shape, accum_dtype), diff_vals),
+                    jnp.zeros((), accum_dtype))
+                (acc, lsum), auxes = jax.lax.scan(
+                    body, init, (keys,) + micro)
+                grads = jax.tree_util.tree_map(
+                    lambda a, v: (a / k).astype(v.dtype), acc, diff_vals)
+                loss = (lsum / k).astype(jnp.float32)
+                # running-stat writebacks: keep the final microbatch's
+                aux = jax.tree_util.tree_map(lambda x: x[-1], auxes)
             new_p = dict(pvals)
             new_s = {}
             for n in diff_names:
@@ -433,7 +489,8 @@ def _like_sharding(param_sharding: NamedSharding, state_leaf, param):
 
 def make_sharded_train_step(block, optimizer, loss_fn, mesh, rules=None,
                             batch_specs=None, num_model_args=None,
-                            zero=False, fsdp=False) -> ShardedTrainStep:
+                            zero=False, fsdp=False,
+                            grad_accum=1) -> ShardedTrainStep:
     return ShardedTrainStep(block, optimizer, loss_fn, mesh, rules,
                             batch_specs, num_model_args, zero=zero,
-                            fsdp=fsdp)
+                            fsdp=fsdp, grad_accum=grad_accum)

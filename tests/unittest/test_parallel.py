@@ -468,3 +468,52 @@ def test_fsdp_parameter_sharding_matches_replicated():
             (n, step_f.pvals[n].sharding)
     # fsdp implies zero: matching state is sharded too
     assert step_f.zero
+
+
+def test_grad_accum_matches_full_batch():
+    """grad_accum=k over the split batch must reproduce the full-batch
+    update (deterministic model: no dropout), and must divide the batch."""
+    import jax
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu.gluon import nn
+
+    def build(grad_accum):
+        mx.random.seed(21)
+        net = nn.Dense(4, in_units=6)
+        net.initialize()
+        rng = onp.random.RandomState(2)
+        x = mx.np.array(rng.rand(8, 6).astype("float32"))
+        y = mx.np.array(rng.rand(8, 4).astype("float32"))
+        mesh = make_mesh({"dp": 2}, _cpu_devices(2))
+        step = make_sharded_train_step(
+            net, opt.SGD(learning_rate=0.1),
+            lambda out, xa, ya: ((out - ya) ** 2).mean(), mesh,
+            num_model_args=1, grad_accum=grad_accum)
+        return step, x, y
+
+    step1, x, y = build(1)
+    ref = [float(step1(x, y)) for _ in range(4)]
+    step4, x2, y2 = build(4)
+    got = [float(step4(x2, y2)) for _ in range(4)]
+    # mean-of-microbatch-means == full-batch mean for equal splits
+    onp.testing.assert_allclose(got, ref, rtol=1e-5)
+    w1 = onp.asarray(step1.pvals[sorted(step1.pvals)[1]])
+    w4 = onp.asarray(step4.pvals[sorted(step4.pvals)[1]])
+    onp.testing.assert_allclose(w4, w1, rtol=1e-5)
+
+
+def test_grad_accum_divisibility_error():
+    import jax
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    mesh = make_mesh({"dp": 1}, _cpu_devices(1))
+    step = make_sharded_train_step(
+        net, opt.SGD(learning_rate=0.1),
+        lambda out, xa, ya: ((out - ya) ** 2).mean(), mesh,
+        num_model_args=1, grad_accum=3)
+    x = mx.np.array(onp.ones((8, 3), dtype="float32"))  # 8 % 3 != 0
+    y = mx.np.array(onp.ones((8, 2), dtype="float32"))
+    with pytest.raises(mx.MXNetError, match="must divide"):
+        step(x, y)
