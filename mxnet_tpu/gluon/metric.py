@@ -11,8 +11,10 @@ from ..ndarray.ndarray import ndarray
 
 __all__ = [
     "EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy", "F1",
-    "MCC", "MAE", "MSE", "RMSE", "CrossEntropy", "Perplexity", "PearsonCorrelation",
-    "Loss", "Torch", "CustomMetric", "create", "np",
+    "Fbeta", "BinaryAccuracy", "MCC", "PCC", "MAE", "MSE", "RMSE",
+    "MeanPairwiseDistance", "MeanCosineSimilarity", "CrossEntropy",
+    "Perplexity", "NegativeLogLikelihood", "PearsonCorrelation",
+    "Loss", "Torch", "Caffe", "CustomMetric", "create", "np",
 ]
 
 _registry: Registry = Registry("metric")
@@ -149,6 +151,8 @@ class TopKAccuracy(EvalMetric):
 
 @register
 class F1(EvalMetric):
+    beta = 1.0  # Fbeta overrides; F1 is exactly beta=1
+
     def __init__(self, name="f1", average="macro", threshold=0.5, **kwargs):
         super().__init__(name, **kwargs)
         self.average = average
@@ -179,8 +183,9 @@ class F1(EvalMetric):
     def get(self):
         prec = self._tp / max(self._tp + self._fp, 1e-12)
         rec = self._tp / max(self._tp + self._fn, 1e-12)
-        f1 = 2 * prec * rec / max(prec + rec, 1e-12)
-        return self.name, f1 if self.num_inst else float("nan")
+        b2 = self.beta * self.beta
+        f = (1 + b2) * prec * rec / max(b2 * prec + rec, 1e-12)
+        return self.name, f if self.num_inst else float("nan")
 
 
 @register
@@ -343,3 +348,137 @@ def np(numpy_feval, name="custom", allow_extra_outputs=False):
         return numpy_feval(label, pred)
     feval.__name__ = getattr(numpy_feval, "__name__", name)
     return CustomMetric(feval, name, allow_extra_outputs)
+
+
+@register
+class Fbeta(F1):
+    """F-beta (parity: `gluon/metric.py:816`): weighted harmonic mean of
+    precision and recall; beta>1 favors recall."""
+
+    def __init__(self, name="fbeta", beta=1.0, threshold=0.5, **kwargs):
+        super().__init__(name=name, threshold=threshold, **kwargs)
+        self.beta = beta
+
+
+@register
+class BinaryAccuracy(EvalMetric):
+    """Thresholded binary accuracy (parity: `gluon/metric.py:877`)."""
+
+    def __init__(self, name="binary_accuracy", threshold=0.5, **kwargs):
+        super().__init__(name, **kwargs)
+        self.threshold = threshold
+
+    def update(self, labels, preds):
+        labels, preds = _as_lists(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = _to_np(label).ravel()
+            pred = (_to_np(pred).ravel() > self.threshold)
+            self.sum_metric += float((pred == (label > 0.5)).sum())
+            self.num_inst += label.size
+
+
+@register
+class MeanPairwiseDistance(EvalMetric):
+    """Mean p-norm distance between prediction and label rows (parity:
+    `gluon/metric.py:1202`)."""
+
+    def __init__(self, name="mpd", p=2, **kwargs):
+        super().__init__(name, **kwargs)
+        self.p = p
+
+    def update(self, labels, preds):
+        labels, preds = _as_lists(labels, preds)
+        for label, pred in zip(labels, preds):
+            l_ = _to_np(label)
+            l_ = l_.reshape(l_.shape[0], -1)
+            p_ = _to_np(pred)
+            p_ = p_.reshape(p_.shape[0], -1)
+            d = (_onp.abs(p_ - l_) ** self.p).sum(axis=1) ** (1 / self.p)
+            self.sum_metric += float(d.sum())
+            self.num_inst += d.shape[0]
+
+
+@register
+class MeanCosineSimilarity(EvalMetric):
+    """Mean cosine similarity along the last axis (parity:
+    `gluon/metric.py:1269`)."""
+
+    def __init__(self, name="cos_sim", eps=1e-12, **kwargs):
+        super().__init__(name, **kwargs)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        labels, preds = _as_lists(labels, preds)
+        for label, pred in zip(labels, preds):
+            l_ = _to_np(label)
+            p_ = _to_np(pred)
+            num = (l_ * p_).sum(axis=-1)
+            den = _onp.linalg.norm(l_, axis=-1) * \
+                _onp.linalg.norm(p_, axis=-1)
+            sim = num / _onp.maximum(den, self.eps)
+            self.sum_metric += float(sim.sum())
+            self.num_inst += int(_onp.prod(sim.shape)) if sim.ndim else 1
+
+
+@register
+class NegativeLogLikelihood(CrossEntropy):
+    """NLL over predicted probabilities (parity: the reference treats it
+    as CrossEntropy with its own display name)."""
+
+    def __init__(self, name="nll-loss", **kwargs):
+        super().__init__(name=name, **kwargs)
+
+
+@register
+class PCC(EvalMetric):
+    """Multiclass Pearson correlation of the confusion matrix (parity:
+    `gluon/metric.py:1595`) — reduces to MCC for binary problems."""
+
+    def __init__(self, name="pcc", **kwargs):
+        super().__init__(name, **kwargs)
+        self._cm = None
+
+    def reset(self):
+        super().reset()
+        self._cm = None
+
+    def update(self, labels, preds):
+        labels, preds = _as_lists(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = _to_np(label).ravel().astype(_onp.int64)
+            pred = _to_np(pred)
+            if pred.ndim > 1 and pred.shape[-1] > 1:
+                k = pred.shape[-1]
+                pred = pred.reshape(-1, k).argmax(-1)
+            else:
+                pred = (pred.ravel() > 0.5).astype(_onp.int64)
+                k = 2
+            k = max(k, int(label.max()) + 1, int(pred.max()) + 1)
+            if self._cm is None or self._cm.shape[0] < k:
+                cm = _onp.zeros((k, k), _onp.float64)
+                if self._cm is not None:
+                    cm[:self._cm.shape[0], :self._cm.shape[1]] = self._cm
+                self._cm = cm
+            _onp.add.at(self._cm, (label, pred), 1)
+            self.num_inst += label.size
+
+    def get(self):
+        if self._cm is None:
+            return self.name, float("nan")
+        c = self._cm
+        n = c.sum()
+        tk = c.sum(axis=1)  # true class counts
+        pk = c.sum(axis=0)  # predicted class counts
+        cov_tp = (c.diagonal().sum() * n - (tk * pk).sum())
+        cov_tt = (n * n - (tk * tk).sum())
+        cov_pp = (n * n - (pk * pk).sum())
+        den = _onp.sqrt(cov_tt * cov_pp)
+        return self.name, float(cov_tp / den) if den > 0 else float("nan")
+
+
+@register
+class Caffe(Loss):
+    """Legacy alias (parity: `gluon/metric.py` Torch/Caffe = Loss)."""
+
+    def __init__(self, name="caffe", **kwargs):
+        super().__init__(name, **kwargs)

@@ -42,9 +42,9 @@ def main():
     assert n >= 2
 
     t = Target()
-    d = os.path.join(tempfile.gettempdir(),
-                     f"elastic_dist_{os.environ.get('DMLC_PS_ROOT_PORT', '0')}"
-                     f"_{rank}")
+    # fresh dir per run+rank: a leftover checkpoint from a previous run
+    # would make ElasticLoop resume at total_steps and skip the loop
+    d = tempfile.mkdtemp(prefix=f"elastic_dist_r{rank}_")
     loop = ElasticLoop(t, d, save_every=100)
 
     # rank 1 is "preempted" before step 5; sync_flag must stop every rank

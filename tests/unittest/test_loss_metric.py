@@ -137,3 +137,60 @@ def test_metric_reset_and_create():
     m.update(mx.np.array([1]), mx.np.array([[0.0, 1.0]]))
     m.reset()
     assert m.num_inst == 0
+
+
+class TestNewMetrics:
+    """Parity additions (ref `gluon/metric.py:816,877,1202,1269,1595`),
+    values checked against hand computations / sklearn formulas."""
+
+    def test_fbeta(self):
+        m = mx.gluon.metric.Fbeta(beta=2.0)
+        m.update([mx.np.array([1, 0, 1, 1])],
+                 [mx.np.array([0.9, 0.8, 0.2, 0.7])])
+        # tp=2 fp=1 fn=1 -> prec=2/3 rec=2/3; f_beta == f1 when prec==rec
+        assert m.get()[1] == pytest.approx(2 / 3, rel=1e-6)
+
+    def test_binary_accuracy(self):
+        m = mx.gluon.metric.BinaryAccuracy(threshold=0.6)
+        m.update([mx.np.array([1, 0, 1, 0])],
+                 [mx.np.array([0.7, 0.2, 0.5, 0.8])])
+        assert m.get()[1] == pytest.approx(0.5)
+
+    def test_mean_pairwise_distance(self):
+        m = mx.gluon.metric.MeanPairwiseDistance()
+        lab = onp.array([[0.0, 0.0], [1.0, 1.0]])
+        pred = onp.array([[3.0, 4.0], [1.0, 1.0]])
+        m.update([mx.np.array(lab)], [mx.np.array(pred)])
+        assert m.get()[1] == pytest.approx(2.5)  # (5 + 0) / 2
+
+    def test_mean_cosine_similarity(self):
+        m = mx.gluon.metric.MeanCosineSimilarity()
+        lab = onp.array([[1.0, 0.0], [0.0, 2.0]])
+        pred = onp.array([[2.0, 0.0], [0.0, -1.0]])
+        m.update([mx.np.array(lab)], [mx.np.array(pred)])
+        assert m.get()[1] == pytest.approx(0.0)  # (1 + -1) / 2
+
+    def test_nll(self):
+        m = mx.gluon.metric.NegativeLogLikelihood()
+        probs = onp.array([[0.25, 0.75], [0.5, 0.5]])
+        m.update([mx.np.array([1, 0])], [mx.np.array(probs)])
+        want = -(onp.log(0.75) + onp.log(0.5)) / 2
+        assert m.get()[1] == pytest.approx(want, rel=1e-5)
+        assert m.get()[0] == "nll-loss"
+
+    def test_pcc_matches_mcc_binary(self):
+        labels = onp.array([1, 0, 1, 1, 0, 1, 0, 0, 1, 1])
+        preds01 = onp.array([0.9, 0.1, 0.8, 0.3, 0.2, 0.7, 0.6, 0.1,
+                             0.9, 0.4])
+        pcc = mx.gluon.metric.PCC()
+        mcc = mx.gluon.metric.MCC()
+        pred2 = onp.stack([1 - preds01, preds01], axis=-1)
+        pcc.update([mx.np.array(labels)], [mx.np.array(pred2)])
+        mcc.update([mx.np.array(labels)], [mx.np.array(preds01)])
+        assert pcc.get()[1] == pytest.approx(mcc.get()[1], rel=1e-6)
+
+    def test_registry_create(self):
+        for name in ["fbeta", "binaryaccuracy", "pcc",
+                     "negativeloglikelihood"]:
+            m = mx.gluon.metric.create(name)
+            assert isinstance(m, mx.gluon.metric.EvalMetric)
