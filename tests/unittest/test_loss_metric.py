@@ -144,11 +144,20 @@ class TestNewMetrics:
     values checked against hand computations / sklearn formulas."""
 
     def test_fbeta(self):
-        m = mx.gluon.metric.Fbeta(beta=2.0)
-        m.update([mx.np.array([1, 0, 1, 1])],
-                 [mx.np.array([0.9, 0.8, 0.2, 0.7])])
-        # tp=2 fp=1 fn=1 -> prec=2/3 rec=2/3; f_beta == f1 when prec==rec
-        assert m.get()[1] == pytest.approx(2 / 3, rel=1e-6)
+        # asymmetric case (prec != rec) so a broken beta wiring fails:
+        # tp=2 fp=2 fn=1 -> prec=0.5 rec=2/3
+        labels = [mx.np.array([1, 0, 1, 1, 0, 0])]
+        preds = [mx.np.array([0.9, 0.8, 0.2, 0.7, 0.6, 0.1])]
+        f2 = mx.gluon.metric.Fbeta(beta=2.0)
+        f2.update(labels, preds)
+        prec, rec = 0.5, 2 / 3
+        want2 = 5 * prec * rec / (4 * prec + rec)
+        assert f2.get()[1] == pytest.approx(want2, rel=1e-6)
+        f1 = mx.gluon.metric.F1()
+        f1.update(labels, preds)
+        want1 = 2 * prec * rec / (prec + rec)
+        assert f1.get()[1] == pytest.approx(want1, rel=1e-6)
+        assert abs(want1 - want2) > 0.01
 
     def test_binary_accuracy(self):
         m = mx.gluon.metric.BinaryAccuracy(threshold=0.6)

@@ -122,3 +122,66 @@ def test_random_dtype_and_broadcast(name):
     out = fn(*args, size=(3, 4))
     assert out.shape == (3, 4)
     assert out.dtype == onp.float32
+
+
+class TestLongTailSamplers:
+    """New sampler coverage (moments checked against theory)."""
+
+    def setup_method(self, _):
+        mx.random.seed(7)
+
+    def _m(self, arr):
+        a = arr.asnumpy()
+        return float(a.mean()), float(a.var())
+
+    def test_standard_aliases(self):
+        m, v = self._m(mx.np.random.standard_normal((20000,)))
+        assert abs(m) < 0.05 and abs(v - 1) < 0.1
+        m, _ = self._m(mx.np.random.standard_exponential((20000,)))
+        assert abs(m - 1) < 0.05
+        m, _ = self._m(mx.np.random.standard_gamma(3.0, (20000,)))
+        assert abs(m - 3) < 0.1
+        t = mx.np.random.standard_t(10.0, (20000,))
+        assert abs(self._m(t)[0]) < 0.1
+
+    def test_binomial_geometric(self):
+        m, v = self._m(mx.np.random.binomial(20, 0.3, (20000,)))
+        assert abs(m - 6.0) < 0.1 and abs(v - 4.2) < 0.4
+        m, _ = self._m(mx.np.random.geometric(0.25, (20000,)))
+        assert abs(m - 4.0) < 0.15
+
+    def test_negative_binomial(self):
+        n, p = 5.0, 0.4
+        m, v = self._m(mx.np.random.negative_binomial(n, p, (30000,)))
+        want_mean = n * (1 - p) / p
+        assert abs(m - want_mean) < 0.3
+
+    def test_dirichlet(self):
+        d = mx.np.random.dirichlet(onp.array([2.0, 3.0, 5.0]), (5000,))
+        a = d.asnumpy()
+        onp.testing.assert_allclose(a.sum(-1), 1.0, rtol=1e-5)
+        onp.testing.assert_allclose(a.mean(0), [0.2, 0.3, 0.5], atol=0.02)
+
+    def test_triangular_wald(self):
+        m, _ = self._m(mx.np.random.triangular(0.0, 1.0, 2.0, (20000,)))
+        assert abs(m - 1.0) < 0.05
+        m, _ = self._m(mx.np.random.wald(3.0, 2.0, (20000,)))
+        assert abs(m - 3.0) < 0.3
+
+    def test_vonmises_concentration(self):
+        r = mx.np.random.vonmises(0.5, 4.0, (20000,)).asnumpy()
+        assert (-onp.pi <= r).all() and (r <= onp.pi).all()
+        # circular mean near mu for large kappa
+        ang = onp.angle(onp.exp(1j * r).mean())
+        assert abs(ang - 0.5) < 0.1
+
+    def test_zipf_logseries_hypergeometric(self):
+        z = mx.np.random.zipf(2.0, (20000,)).asnumpy()
+        assert z.min() >= 1
+        assert abs((z == 1).mean() - 1 / 1.6449) < 0.03  # 1/zeta(2)
+        ls = mx.np.random.logseries(0.5, (20000,)).asnumpy()
+        want = -0.5 / (0.5 * onp.log(0.5))  # -p/((1-p)ln(1-p))
+        assert abs(ls.mean() - want) < 0.05
+        h = mx.np.random.hypergeometric(7, 3, 5, (5000,)).asnumpy()
+        assert abs(h.mean() - 3.5) < 0.1  # n*K/N = 5*7/10
+        assert h.max() <= 5 and h.min() >= 2  # max(0, n-nbad)=2
