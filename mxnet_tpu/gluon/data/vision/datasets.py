@@ -17,7 +17,7 @@ import numpy as _onp
 from ....base import MXNetError, getenv_bool
 from ..dataset import ArrayDataset, Dataset
 
-__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100", "ImageListDataset",
            "ImageRecordDataset", "ImageFolderDataset"]
 
 
@@ -192,6 +192,52 @@ class ImageFolderDataset(Dataset):
             for fname in sorted(os.listdir(path)):
                 if fname.lower().endswith(exts):
                     self.items.append((os.path.join(path, fname), label))
+
+    def __len__(self):
+        return len(self.items)
+
+    def __getitem__(self, idx):
+        from ....image import imdecode
+        path, label = self.items[idx]
+        with open(path, "rb") as f:
+            img = imdecode(f.read(), flag=self._flag)
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class ImageListDataset(Dataset):
+    """Images listed in a .lst file (``idx\\tlabel...\\tpath``) or a
+    python list of ``[label, path]`` entries (parity:
+    `gluon/data/vision/datasets.py:365`; the format `tools/im2rec.py`
+    emits and consumes)."""
+
+    def __init__(self, root=".", imglist=None, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self.items = []
+        if isinstance(imglist, str):
+            with open(imglist) as f:
+                for ln, line in enumerate(f, 1):
+                    if not line.strip():
+                        continue
+                    parts = line.strip().split("\t")
+                    if len(parts) < 3:
+                        raise ValueError(
+                            f"malformed .lst line {ln}: expected "
+                            f"idx\\tlabel...\\tpath (tab-separated), got "
+                            f"{line.strip()!r}")
+                    label = [float(x) for x in parts[1:-1]]
+                    self.items.append(
+                        (os.path.join(self._root, parts[-1]),
+                         label[0] if len(label) == 1 else label))
+        elif imglist is not None:
+            for entry in imglist:
+                label, path = entry[0], entry[1]
+                self.items.append((os.path.join(self._root, path), label))
+        else:
+            raise ValueError("imglist is required (path to .lst or list)")
 
     def __len__(self):
         return len(self.items)

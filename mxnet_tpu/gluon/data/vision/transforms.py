@@ -15,9 +15,13 @@ from ....image import (imresize, center_crop, random_crop, color_normalize,
 from ...block import Block, HybridBlock
 from ...nn.basic_layers import Sequential, HybridSequential
 
-__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
-           "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
-           "CropResize"]
+__all__ = ["Compose", "HybridCompose", "Cast", "ToTensor", "Normalize",
+           "Resize", "CenterCrop", "RandomResizedCrop",
+           "RandomFlipLeftRight", "RandomFlipTopBottom", "CropResize",
+           "RandomBrightness", "RandomContrast", "RandomSaturation",
+           "RandomHue", "RandomColorJitter", "RandomLighting",
+           "RandomGray", "RandomApply", "HybridRandomApply", "RandomCrop",
+           "Rotate", "RandomRotation"]
 
 
 class Compose(Sequential):
@@ -146,3 +150,208 @@ class CropResize(HybridBlock):
             out = imresize(out, self._size[0], self._size[1],
                            self._interpolation)
         return out
+
+
+# -- color/geometry augmentation transforms (parity:
+# `gluon/data/vision/transforms/__init__.py` RandomBrightness..Rotate;
+# each wraps the corresponding `mx.image` augmenter) ----------------------
+
+class RandomBrightness(Block):
+    def __init__(self, brightness):
+        super().__init__()
+        from ....image import BrightnessJitterAug
+        self._aug = BrightnessJitterAug(brightness)
+
+    def forward(self, x):
+        return self._aug(x)
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super().__init__()
+        from ....image import ContrastJitterAug
+        self._aug = ContrastJitterAug(contrast)
+
+    def forward(self, x):
+        return self._aug(x)
+
+
+class RandomSaturation(Block):
+    def __init__(self, saturation):
+        super().__init__()
+        from ....image import SaturationJitterAug
+        self._aug = SaturationJitterAug(saturation)
+
+    def forward(self, x):
+        return self._aug(x)
+
+
+class RandomHue(Block):
+    def __init__(self, hue):
+        super().__init__()
+        from ....image import HueJitterAug
+        self._aug = HueJitterAug(hue)
+
+    def forward(self, x):
+        return self._aug(x)
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0.0, contrast=0.0, saturation=0.0,
+                 hue=0.0):
+        super().__init__()
+        from ....image import ColorJitterAug, HueJitterAug
+        self._aug = ColorJitterAug(brightness, contrast, saturation)
+        self._hue = HueJitterAug(hue) if hue else None
+
+    def forward(self, x):
+        x = self._aug(x)
+        return self._hue(x) if self._hue is not None else x
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA lighting noise (default ImageNet eigvals)."""
+
+    _EIGVAL = [55.46, 4.794, 1.148]
+    _EIGVEC = [[-0.5675, 0.7192, 0.4009],
+               [-0.5808, -0.0045, -0.8140],
+               [-0.5836, -0.6948, 0.4203]]
+
+    def __init__(self, alpha, eigval=None, eigvec=None):
+        super().__init__()
+        from ....image import LightingAug
+        self._aug = LightingAug(
+            alpha,
+            self._EIGVAL if eigval is None else eigval,
+            self._EIGVEC if eigvec is None else eigvec)
+
+    def forward(self, x):
+        return self._aug(x)
+
+
+class RandomGray(Block):
+    def __init__(self, p=0.5):
+        super().__init__()
+        from ....image import RandomGrayAug
+        self._aug = RandomGrayAug(p)
+
+    def forward(self, x):
+        return self._aug(x)
+
+
+class RandomApply(Block):
+    """Apply `transform` with probability p (ref transforms RandomApply)."""
+
+    def __init__(self, transform, p=0.5):
+        super().__init__()
+        self._t = transform
+        self.p = p
+
+    def forward(self, x):
+        if _onp.random.uniform() < self.p:
+            return self._t(x)
+        return x
+
+
+class RandomCrop(Block):
+    """Pad (optional) then crop a random window (ref RandomCrop).
+    `size` is (width, height) — the `mx.image.random_crop` convention —
+    or an int for square crops. `pad` is an int (symmetric H/W padding)
+    or a full jnp.pad width spec like ((2, 2), (2, 2), (0, 0))."""
+
+    def __init__(self, size, pad=None, pad_value=0):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._pad = pad
+        self._pad_value = pad_value
+
+    def forward(self, x):
+        from ....image import random_crop
+        from .... import numpy as mnp
+        if self._pad:
+            p = self._pad
+            widths = ((p, p), (p, p), (0, 0)) if isinstance(p, int) else p
+            x = mnp.pad(x, widths, mode="constant",
+                        constant_values=self._pad_value)
+        out = random_crop(x, self._size)
+        return out[0] if isinstance(out, tuple) else out
+
+
+def _rotate_hwc(img, deg, zoom_in=False, zoom_out=False):
+    """Bilinear rotation about the center, same output size (HWC).
+    zoom_out shrinks so every source pixel stays visible; zoom_in
+    enlarges so no out-of-bounds padding shows."""
+    import jax.numpy as jnp
+    from ....ndarray.ndarray import apply_op
+    rad = float(_onp.deg2rad(deg))
+    c, s = _onp.cos(rad), _onp.sin(rad)
+    scale = 1.0
+    if zoom_out or zoom_in:
+        # factor by which the rotated bounding box exceeds the frame
+        grow = abs(c) + abs(s)
+        scale = grow if zoom_out else 1.0 / grow
+    c, s = c * scale, s * scale
+
+    def fn(x):
+        h, w = x.shape[0], x.shape[1]
+        cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+        yy, xx = jnp.meshgrid(jnp.arange(h, dtype=jnp.float32),
+                              jnp.arange(w, dtype=jnp.float32),
+                              indexing="ij")
+        ys = cy + (yy - cy) * c - (xx - cx) * s
+        xs = cx + (yy - cy) * s + (xx - cx) * c
+        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+        y1 = jnp.clip(y0 + 1, 0, h - 1)
+        x1 = jnp.clip(x0 + 1, 0, w - 1)
+        wy = jnp.clip(ys - y0, 0.0, 1.0)[..., None]
+        wx = jnp.clip(xs - x0, 0.0, 1.0)[..., None]
+        xf = x.astype(jnp.float32)
+        out = (xf[y0, x0] * (1 - wy) * (1 - wx) + xf[y1, x0] * wy * (1 - wx)
+               + xf[y0, x1] * (1 - wy) * wx + xf[y1, x1] * wy * wx)
+        valid = ((ys >= 0) & (ys <= h - 1) & (xs >= 0)
+                 & (xs <= w - 1))[..., None]
+        return jnp.where(valid, out, 0.0).astype(x.dtype)
+    return apply_op(fn, (img,), {}, name="rotate")
+
+
+class Rotate(Block):
+    """Rotate by a fixed angle in degrees (ref transforms Rotate).
+    zoom_in/zoom_out rescale so no content (zoom_out) or no padding
+    (zoom_in) appears, like the reference."""
+
+    def __init__(self, rotation_degrees, zoom_in=False, zoom_out=False):
+        super().__init__()
+        if zoom_in and zoom_out:
+            raise MXNetError("zoom_in and zoom_out are exclusive")
+        self._deg = rotation_degrees
+        self._zoom_in = zoom_in
+        self._zoom_out = zoom_out
+
+    def forward(self, x):
+        return _rotate_hwc(x, self._deg, self._zoom_in, self._zoom_out)
+
+
+class RandomRotation(Block):
+    """Rotate by U(angle_limits) degrees (ref transforms RandomRotation)."""
+
+    def __init__(self, angle_limits, zoom_in=False, zoom_out=False,
+                 rotate_with_proba=1.0):
+        super().__init__()
+        if zoom_in and zoom_out:
+            raise MXNetError("zoom_in and zoom_out are exclusive")
+        self._limits = angle_limits
+        self._zoom_in = zoom_in
+        self._zoom_out = zoom_out
+        self._p = rotate_with_proba
+
+    def forward(self, x):
+        if _onp.random.uniform() >= self._p:
+            return x
+        deg = float(_onp.random.uniform(*self._limits))
+        return _rotate_hwc(x, deg, self._zoom_in, self._zoom_out)
+
+
+# hybrid aliases (every transform here is trace-compatible already)
+HybridCompose = Compose
+HybridRandomApply = RandomApply

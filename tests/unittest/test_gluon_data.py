@@ -148,3 +148,80 @@ def test_dataloader_timeout_enforced():
                                    num_workers=2, timeout=0.2)
     with _pytest.raises(MXNetError, match="timed out"):
         next(iter(loader))
+
+
+def test_image_list_dataset(tmp_path):
+    """ImageListDataset parity (ref `gluon/data/vision/datasets.py:365`):
+    .lst file form and python-list form."""
+    pytest.importorskip("PIL")
+    from PIL import Image
+    import os
+    rng = onp.random.RandomState(0)
+    names = []
+    for i in range(4):
+        arr = rng.randint(0, 255, (6, 8, 3), dtype=onp.uint8)
+        p = tmp_path / f"img{i}.png"
+        Image.fromarray(arr).save(p)
+        names.append(p.name)
+    lst = tmp_path / "data.lst"
+    lst.write_text("".join(f"{i}\t{i % 2}\t{n}\n"
+                           for i, n in enumerate(names)))
+
+    ds = mx.gluon.data.vision.ImageListDataset(str(tmp_path), str(lst))
+    assert len(ds) == 4
+    img, label = ds[1]
+    assert img.shape == (6, 8, 3)
+    assert label == 1.0
+
+    ds2 = mx.gluon.data.vision.ImageListDataset(
+        str(tmp_path), [[0, names[0]], [1, names[3]]])
+    assert len(ds2) == 2
+    img2, label2 = ds2[1]
+    assert img2.shape == (6, 8, 3) and label2 == 1
+
+    # feeds the DataLoader like any dataset
+    loader = mx.gluon.data.DataLoader(ds, batch_size=2)
+    batches = list(loader)
+    assert len(batches) == 2 and batches[0][0].shape == (2, 6, 8, 3)
+
+
+def test_augmentation_transforms():
+    """New transform coverage (ref `gluon/data/vision/transforms/`):
+    color jitter family, gray, lighting, apply, crop, rotation."""
+    T = mx.gluon.data.vision.transforms
+    rng = onp.random.RandomState(0)
+    img = mx.np.array(rng.rand(16, 12, 3).astype("float32"))
+
+    for t in [T.RandomBrightness(0.3), T.RandomContrast(0.3),
+              T.RandomSaturation(0.3), T.RandomHue(0.1),
+              T.RandomColorJitter(0.2, 0.2, 0.2, 0.05),
+              T.RandomLighting(0.1), T.RandomGray(1.0)]:
+        out = t(img)
+        assert out.shape == img.shape, type(t).__name__
+
+    # RandomGray(p=1): channels equal
+    g = T.RandomGray(1.0)(img).asnumpy()
+    onp.testing.assert_allclose(g[..., 0], g[..., 1], rtol=1e-5)
+
+    # RandomApply p=0 is identity, p=1 applies
+    ra0 = T.RandomApply(T.RandomGray(1.0), p=0.0)(img)
+    onp.testing.assert_allclose(ra0.asnumpy(), img.asnumpy())
+    ra1 = T.HybridRandomApply(T.RandomGray(1.0), p=1.0)(img).asnumpy()
+    onp.testing.assert_allclose(ra1[..., 0], ra1[..., 2], rtol=1e-5)
+
+    # RandomCrop with padding
+    c = T.RandomCrop((8, 8), pad=2)(img)
+    assert c.shape == (8, 8, 3)
+
+    # Rotate: 90-degree rotation of an impulse moves it predictably
+    imp = onp.zeros((9, 9, 1), dtype="float32")
+    imp[2, 4, 0] = 1.0
+    rot = T.Rotate(90)(mx.np.array(imp)).asnumpy()
+    assert rot[4, 2, 0] > 0.9 or rot[4, 6, 0] > 0.9  # rotated position
+    assert abs(rot.sum() - 1.0) < 0.1
+
+    rr = T.RandomRotation((-30, 30))(img)
+    assert rr.shape == img.shape
+
+    comp = T.HybridCompose([T.RandomBrightness(0.1), T.RandomGray(1.0)])
+    assert comp(img).shape == img.shape
