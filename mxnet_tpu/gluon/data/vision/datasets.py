@@ -173,6 +173,18 @@ class ImageRecordDataset(Dataset):
         return x, label
 
 
+def _read_image_item(ds, idx):
+    """Shared decode path for items-based image datasets
+    (ImageFolderDataset / ImageListDataset)."""
+    from ....image import imdecode
+    path, label = ds.items[idx]
+    with open(path, "rb") as f:
+        img = imdecode(f.read(), flag=ds._flag)
+    if ds._transform is not None:
+        return ds._transform(img, label)
+    return img, label
+
+
 class ImageFolderDataset(Dataset):
     """Folder-per-class image dataset (parity: datasets.py)."""
 
@@ -196,14 +208,7 @@ class ImageFolderDataset(Dataset):
     def __len__(self):
         return len(self.items)
 
-    def __getitem__(self, idx):
-        from ....image import imdecode
-        path, label = self.items[idx]
-        with open(path, "rb") as f:
-            img = imdecode(f.read(), flag=self._flag)
-        if self._transform is not None:
-            return self._transform(img, label)
-        return img, label
+    __getitem__ = _read_image_item
 
 
 class ImageListDataset(Dataset):
@@ -242,11 +247,4 @@ class ImageListDataset(Dataset):
     def __len__(self):
         return len(self.items)
 
-    def __getitem__(self, idx):
-        from ....image import imdecode
-        path, label = self.items[idx]
-        with open(path, "rb") as f:
-            img = imdecode(f.read(), flag=self._flag)
-        if self._transform is not None:
-            return self._transform(img, label)
-        return img, label
+    __getitem__ = _read_image_item

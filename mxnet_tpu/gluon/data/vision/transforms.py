@@ -292,6 +292,11 @@ def _rotate_hwc(img, deg, zoom_in=False, zoom_out=False):
         scale = grow if zoom_out else 1.0 / grow
     c, s = c * scale, s * scale
 
+    if img.ndim != 3:
+        raise MXNetError(
+            f"Rotate expects a single HWC image (got ndim={img.ndim}); "
+            f"apply before batching")
+
     def fn(x):
         h, w = x.shape[0], x.shape[1]
         cy, cx = (h - 1) / 2.0, (w - 1) / 2.0

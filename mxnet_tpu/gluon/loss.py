@@ -13,7 +13,7 @@ __all__ = [
     "Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
     "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss", "KLDivLoss",
     "CTCLoss", "HuberLoss", "HingeLoss", "SquaredHingeLoss", "LogisticLoss",
-    "TripletLoss", "PoissonNLLLoss", "CosineEmbeddingLoss",
+    "TripletLoss", "PoissonNLLLoss", "CosineEmbeddingLoss", "SDMLLoss",
 ]
 
 
@@ -270,3 +270,42 @@ class CosineEmbeddingLoss(Loss):
         loss = _np.where(label == 1, 1 - sim,
                          npx.relu(sim - self._margin))
         return _apply_weighting(loss, self._weight, sample_weight)
+
+
+class SDMLLoss(Loss):
+    """Smoothed Deep Metric Learning loss (parity: `gluon/loss.py`
+    SDMLLoss; Bonadiman et al. 2019): aligned pairs (x1[i], x2[i]) are
+    positives, every other row in the minibatch is a smoothed negative.
+    Per-row KL between the smoothed one-hot target and the softmax over
+    negative squared euclidean distances (reference scaling)."""
+
+    def __init__(self, smoothing_parameter=0.3, weight=1.0, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self.smoothing_parameter = smoothing_parameter
+
+    @staticmethod
+    def _distances(x1, x2):
+        a = _np.expand_dims(x1, 1)
+        b = _np.expand_dims(x2, 0)
+        return _np.square(a - b).sum(axis=2)
+
+    def _smoothed_targets(self, n):
+        import numpy as onp
+        eye = onp.eye(n)
+        smooth = self.smoothing_parameter / max(n - 1, 1)
+        t = eye * (1.0 - self.smoothing_parameter) + (1 - eye) * smooth
+        return _np.array(t.astype(onp.float32))
+
+    def forward(self, x1, x2, sample_weight=None):
+        n = x1.shape[0]
+        target = self._smoothed_targets(n)
+        # reference formulation: KL(target || softmax(-distances)) per
+        # row, one direction, scaled so the per-sample magnitude matches
+        # `kl_loss(log_pred, labels) * batch_size` upstream
+        logp = npx.log_softmax(-self._distances(x1, x2), axis=-1)
+        import numpy as onp_
+        t_np = target.asnumpy()
+        ent = float((t_np * onp_.log(onp_.maximum(t_np, 1e-12))).sum(1)[0])
+        kl = ent - (target * logp).sum(axis=-1)
+        return _apply_weighting(kl, self._weight, sample_weight)

@@ -15,7 +15,7 @@ from .ndarray.ndarray import ndarray
 __all__ = [
     "Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
     "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias", "register",
-    "create",
+    "create", "InitDesc", "Load", "Mixed", "RNNFused",
 ]
 
 _registry: Registry = Registry("initializer")
@@ -224,3 +224,109 @@ def create(initializer, **kwargs):
         cls = _registry.get(initializer)
         return cls(**kwargs)
     raise MXNetError(f"cannot create initializer from {initializer!r}")
+
+
+class InitDesc(str):
+    """Parameter-name descriptor carrying init attrs (parity:
+    `python/mxnet/initializer.py` InitDesc): a str subclass with
+    `attrs`/`global_init` so initializers can dispatch on metadata."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        obj = super().__new__(cls, name)
+        obj.attrs = attrs or {}
+        obj.global_init = global_init
+        return obj
+
+
+@register
+class Load(Initializer):
+    """Initialize from saved arrays by parameter name (parity:
+    `python/mxnet/initializer.py` Load): `param` is a dict or an .npz/
+    params file path; `arg:`/`aux:` prefixes are dropped; names not
+    found fall back to `default_init` (error when None)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        super().__init__()
+        if isinstance(param, str):
+            from .util import load_arrays
+            param = load_arrays(param)
+        self.param = {}
+        for name, arr in param.items():
+            if name.startswith(("arg:", "aux:")):
+                name = name[4:]
+            self.param[name] = arr
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr=None):
+        if arr is None:
+            name, arr = "", name
+        if name in self.param:
+            src = self.param[name]
+            src_shape = tuple(src.shape)
+            if src_shape != tuple(arr.shape):
+                raise MXNetError(
+                    f"Load: parameter {name} has shape {tuple(arr.shape)} "
+                    f"but the saved array is {src_shape}")
+            from .ndarray.ndarray import ndarray as _nd
+            arr[...] = src if isinstance(src, _nd) else _onp.asarray(src)
+            if self.verbose:
+                import logging
+                logging.getLogger(__name__).info("Load init %s", name)
+        elif self.default_init is not None:
+            self.default_init(name, arr)
+        else:
+            raise MXNetError(
+                f"Load: no saved value for {name} and no default_init")
+
+
+@register
+class Mixed(Initializer):
+    """Dispatch to initializers by regex over parameter names (parity:
+    `python/mxnet/initializer.py` Mixed). Patterns are tried in order;
+    use '.*' last as the fallback."""
+
+    def __init__(self, patterns, initializers):
+        super().__init__()
+        if len(patterns) != len(initializers):
+            raise MXNetError("patterns and initializers must pair up")
+        import re as _re
+        self.map = [(_re.compile(p), i) for p, i in
+                    zip(patterns, initializers)]
+
+    def __call__(self, name, arr=None):
+        if arr is None:
+            name, arr = "", name
+        for pat, init in self.map:
+            if pat.match(name):
+                init(name, arr)
+                return
+        raise MXNetError(
+            f"Mixed: parameter {name} matched no pattern; add '.*' with a "
+            f"default initializer as the last entry")
+
+
+@register
+class RNNFused(Initializer):
+    """Initializer for fused-RNN packed weights (parity: RNNFused):
+    applies `init` to weight slices and sets the LSTM forget-gate bias
+    section ([i, f, g, o] layout, second quarter) of i2h_bias to
+    `forget_bias` — the standard trick that keeps early forget gates
+    open."""
+
+    def __init__(self, init="xavier", forget_bias=1.0):
+        super().__init__()
+        self._inner = create(init) if isinstance(init, str) else init
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        self._inner._init_weight(name, arr)
+
+    def _init_bias(self, name, arr):
+        import numpy as _np_
+        vals = _np_.zeros(arr.shape, dtype=_np_.float32)
+        n = arr.shape[0]
+        if self.forget_bias and n % 4 == 0 and name.endswith("i2h_bias"):
+            h = n // 4
+            vals[h:2 * h] = self.forget_bias  # [i, f, g, o] forget slice
+        arr[...] = vals

@@ -235,3 +235,37 @@ def test_export_from_input_shapes(tmp_path):
     re_net = SymbolBlock.imports(sym_path, ["data"], params_path)
     onp.testing.assert_allclose(re_net(x).asnumpy(), want, rtol=1e-5,
                                 atol=1e-6)
+
+
+def test_initializer_mixed_load_rnnfused(tmp_path):
+    """Init parity additions (ref `python/mxnet/initializer.py`:
+    Mixed regex dispatch, Load from saved arrays, InitDesc metadata)."""
+    net = mx.gluon.nn.Dense(4, in_units=3)
+    net.initialize(mx.init.Mixed([".*bias", ".*"],
+                                 [mx.init.Zero(), mx.init.One()]))
+    assert (net.bias.data().asnumpy() == 0).all()
+    assert (net.weight.data().asnumpy() == 1).all()
+
+    saved = {"arg:weight": onp.full((4, 3), 7.0, dtype="float32")}
+    net2 = mx.gluon.nn.Dense(4, in_units=3)
+    net2.initialize(mx.init.Load(saved, default_init=mx.init.Zero()))
+    assert (net2.weight.data().asnumpy() == 7.0).all()  # arg: dropped
+    assert (net2.bias.data().asnumpy() == 0).all()
+
+    # shape mismatch must raise, missing without default must raise
+    bad = {"weight": onp.zeros((2, 2), dtype="float32")}
+    net3 = mx.gluon.nn.Dense(4, in_units=3)
+    with pytest.raises(mx.MXNetError, match="shape"):
+        net3.initialize(mx.init.Load(bad, default_init=mx.init.Zero()))
+    with pytest.raises(mx.MXNetError, match="no pattern"):
+        net3b = mx.gluon.nn.Dense(2, in_units=2)
+        net3b.initialize(mx.init.Mixed([".*bias"], [mx.init.Zero()]),
+                         force_reinit=True)
+
+    d = mx.init.InitDesc("encoder.weight", attrs={"lr_mult": "2"})
+    assert d == "encoder.weight" and d.attrs["lr_mult"] == "2"
+
+    cell = mx.gluon.rnn.LSTMCell(8, input_size=4)
+    cell.initialize(mx.init.RNNFused("xavier"), force_reinit=True)
+    w = cell.i2h_weight.data().asnumpy()
+    assert w.std() > 0  # actually initialized

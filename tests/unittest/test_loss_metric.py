@@ -203,3 +203,25 @@ class TestNewMetrics:
                      "negativeloglikelihood"]:
             m = mx.gluon.metric.create(name)
             assert isinstance(m, mx.gluon.metric.EvalMetric)
+
+
+def test_sdml_loss():
+    """SDMLLoss: perfectly-separated aligned pairs score lower loss than
+    shuffled pairs; shape (batch,); gradients flow."""
+    from mxnet_tpu.gluon.loss import SDMLLoss
+    rng = onp.random.RandomState(0)
+    x1 = mx.np.array(rng.randn(6, 8).astype("float32"))
+    loss_fn = SDMLLoss(smoothing_parameter=0.2)
+    aligned = loss_fn(x1, x1 + 0.01 *
+                      mx.np.array(rng.randn(6, 8).astype("float32")))
+    assert aligned.shape == (6,)
+    perm = onp.roll(onp.arange(6), 1)
+    shuffled = loss_fn(x1, mx.np.array(x1.asnumpy()[perm]))
+    assert float(aligned.mean()) < float(shuffled.mean())
+
+    w = mx.np.array(rng.randn(8, 8).astype("float32"))
+    w.attach_grad()
+    with mx.autograd.record():
+        out = loss_fn(mx.np.matmul(x1, w), x1).mean()
+    out.backward()
+    assert float(mx.np.abs(w.grad).sum()) > 0
