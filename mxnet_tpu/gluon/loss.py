@@ -283,6 +283,7 @@ class SDMLLoss(Loss):
                  **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self.smoothing_parameter = smoothing_parameter
+        self._target_cache = {}
 
     @staticmethod
     def _distances(x1, x2):
@@ -291,21 +292,29 @@ class SDMLLoss(Loss):
         return _np.square(a - b).sum(axis=2)
 
     def _smoothed_targets(self, n):
-        import numpy as onp
-        eye = onp.eye(n)
-        smooth = self.smoothing_parameter / max(n - 1, 1)
-        t = eye * (1.0 - self.smoothing_parameter) + (1 - eye) * smooth
-        return _np.array(t.astype(onp.float32))
+        if n not in self._target_cache:
+            import numpy as onp
+            eye = onp.eye(n)
+            smooth = self.smoothing_parameter / (n - 1)
+            t = eye * (1.0 - self.smoothing_parameter) + (1 - eye) * smooth
+            sp = self.smoothing_parameter
+            # closed-form row entropy (all rows identical): no device sync
+            ent = (1 - sp) * onp.log(max(1 - sp, 1e-12)) + \
+                (n - 1) * smooth * onp.log(max(smooth, 1e-12))
+            self._target_cache[n] = (_np.array(t.astype(onp.float32)),
+                                     float(ent))
+        return self._target_cache[n]
 
     def forward(self, x1, x2, sample_weight=None):
         n = x1.shape[0]
-        target = self._smoothed_targets(n)
+        if n < 2:
+            raise MXNetError(
+                "SDMLLoss needs batch size >= 2: the other rows of the "
+                "minibatch are the negative examples")
+        target, ent = self._smoothed_targets(n)
         # reference formulation: KL(target || softmax(-distances)) per
         # row, one direction, scaled so the per-sample magnitude matches
         # `kl_loss(log_pred, labels) * batch_size` upstream
         logp = npx.log_softmax(-self._distances(x1, x2), axis=-1)
-        import numpy as onp_
-        t_np = target.asnumpy()
-        ent = float((t_np * onp_.log(onp_.maximum(t_np, 1e-12))).sum(1)[0])
         kl = ent - (target * logp).sum(axis=-1)
         return _apply_weighting(kl, self._weight, sample_weight)

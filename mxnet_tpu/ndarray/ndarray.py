@@ -438,6 +438,26 @@ class ndarray:
     def cumsum(self, axis=None, dtype=None, out=None):
         return _write_out(self._method(jnp.cumsum, axis=axis, dtype=dtype), out)
 
+    def nonzero(self):
+        # numpy semantics: tuple of index arrays (host round-trip — the
+        # output shape is data-dependent, like the reference's np.nonzero)
+        import numpy as _np_host
+        idx = _np_host.nonzero(self.asnumpy())
+        dev = self._device
+        return tuple(from_jax(jnp.asarray(i), dev) for i in idx)
+
+    def sort(self, axis=-1, kind=None, order=None):
+        return self._method(jnp.sort, axis=axis)
+
+    def argsort(self, axis=-1, kind=None, order=None):
+        return self._method(jnp.argsort, axis=axis)
+
+    def diag(self, k=0):
+        return self._method(jnp.diag, k)
+
+    def flip(self, axis=None):
+        return self._method(jnp.flip, axis)
+
     def clip(self, a_min=None, a_max=None, out=None):
         return _write_out(self._method(jnp.clip, a_min, a_max), out)
 

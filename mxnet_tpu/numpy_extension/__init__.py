@@ -1094,3 +1094,10 @@ def custom(*inputs, op_type, **kwargs):
     `mx.nd.Custom`, `src/operator/custom/custom.cc`)."""
     from ..operator import custom as _custom
     return _custom(*inputs, op_type=op_type, **kwargs)
+
+
+# submodule re-exports (parity: `python/mxnet/numpy_extension/__init__.py`
+# exposes npx.random, npx.image, and the device helpers)
+from ..numpy import random  # noqa: E402,F401
+from .. import image  # noqa: E402,F401
+from ..device import cpu, gpu, tpu, num_gpus, num_tpus  # noqa: E402,F401

@@ -265,7 +265,12 @@ def test_initializer_mixed_load_rnnfused(tmp_path):
     d = mx.init.InitDesc("encoder.weight", attrs={"lr_mult": "2"})
     assert d == "encoder.weight" and d.attrs["lr_mult"] == "2"
 
-    cell = mx.gluon.rnn.LSTMCell(8, input_size=4)
-    cell.initialize(mx.init.RNNFused("xavier"), force_reinit=True)
+    cell = mx.gluon.rnn.LSTMCell(
+        8, input_size=4,
+        i2h_bias_initializer=mx.init.RNNFused(forget_bias=1.0))
+    cell.initialize()
+    b = cell.i2h_bias.data().asnumpy()
+    onp.testing.assert_allclose(b[8:16], 1.0)   # forget-gate slice
+    onp.testing.assert_allclose(b[:8], 0.0)
     w = cell.i2h_weight.data().asnumpy()
-    assert w.std() > 0  # actually initialized
+    assert w.std() > 0

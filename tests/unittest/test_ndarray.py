@@ -139,3 +139,20 @@ def test_conversion():
         bool(mx.np.ones((2,)))
     n = onp.asarray(mx.np.ones((2, 2)))
     assert n.shape == (2, 2)
+
+
+def test_ndarray_method_tail():
+    """Method-surface parity: nonzero/sort/argsort/diag/flip."""
+    a = mx.np.array(onp.array([[3.0, 0.0], [0.0, 1.0]], dtype="float32"))
+    nz = a.nonzero()
+    assert len(nz) == 2
+    onp.testing.assert_array_equal(nz[0].asnumpy(), [0, 1])
+    onp.testing.assert_array_equal(nz[1].asnumpy(), [0, 1])
+    onp.testing.assert_array_equal(a.sort().asnumpy(),
+                                   onp.sort(a.asnumpy()))
+    onp.testing.assert_array_equal(a.argsort().asnumpy(),
+                                   onp.argsort(a.asnumpy()))
+    v = mx.np.array(onp.array([1.0, 2.0], dtype="float32"))
+    onp.testing.assert_array_equal(v.diag().asnumpy(), onp.diag([1.0, 2.0]))
+    onp.testing.assert_array_equal(a.flip(1).asnumpy(),
+                                   onp.flip(a.asnumpy(), 1))
