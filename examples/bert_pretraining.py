@@ -69,15 +69,23 @@ def main():
     ap.add_argument("--tiny", action="store_true",
                     help="tiny config for CPU smoke runs")
     ap.add_argument("--ckpt-dir", default="/tmp/bert_pretrain_ckpts")
+    ap.add_argument("--grad-accum", type=int, default=1,
+                    help="microbatches per optimizer update")
+    ap.add_argument("--zero", action="store_true",
+                    help="ZeRO-1: shard optimizer state over dp")
+    ap.add_argument("--fsdp", action="store_true",
+                    help="ZeRO-3: shard parameters over dp too")
+    ap.add_argument("--remat", action="store_true",
+                    help="recompute layer activations in backward")
     args = ap.parse_args()
 
     on_tpu = jax.devices()[0].platform != "cpu"
     if args.tiny or not on_tpu:
         cfg = BertConfig(vocab_size=1024, hidden_size=64, num_layers=2,
                          num_heads=4, intermediate_size=128,
-                         dtype="float32")
+                         dtype="float32", remat=args.remat)
     else:
-        cfg = BertConfig(dtype="bfloat16")
+        cfg = BertConfig(dtype="bfloat16", remat=args.remat)
 
     net = PretrainNet(cfg)
     net.initialize()
@@ -91,7 +99,8 @@ def main():
     mesh = make_mesh({"dp": jax.device_count()})
     step = make_sharded_train_step(
         net, opt.Adam(learning_rate=1e-4), mlm_nsp_loss, mesh,
-        num_model_args=2)
+        num_model_args=2, grad_accum=args.grad_accum, zero=args.zero,
+        fsdp=args.fsdp)
 
     def run_step(i):
         ids, mpos, labels = next(data)

@@ -292,18 +292,21 @@ class SDMLLoss(Loss):
         return _np.square(a - b).sum(axis=2)
 
     def _smoothed_targets(self, n):
-        if n not in self._target_cache:
+        # keyed by (n, smoothing) so annealing the public attribute is
+        # honored instead of serving stale targets
+        sp = self.smoothing_parameter
+        key = (n, sp)
+        if key not in self._target_cache:
             import numpy as onp
             eye = onp.eye(n)
-            smooth = self.smoothing_parameter / (n - 1)
-            t = eye * (1.0 - self.smoothing_parameter) + (1 - eye) * smooth
-            sp = self.smoothing_parameter
+            smooth = sp / (n - 1)
+            t = eye * (1.0 - sp) + (1 - eye) * smooth
             # closed-form row entropy (all rows identical): no device sync
             ent = (1 - sp) * onp.log(max(1 - sp, 1e-12)) + \
                 (n - 1) * smooth * onp.log(max(smooth, 1e-12))
-            self._target_cache[n] = (_np.array(t.astype(onp.float32)),
-                                     float(ent))
-        return self._target_cache[n]
+            self._target_cache[key] = (_np.array(t.astype(onp.float32)),
+                                       float(ent))
+        return self._target_cache[key]
 
     def forward(self, x1, x2, sample_weight=None):
         n = x1.shape[0]

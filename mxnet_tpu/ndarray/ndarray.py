@@ -439,12 +439,10 @@ class ndarray:
         return _write_out(self._method(jnp.cumsum, axis=axis, dtype=dtype), out)
 
     def nonzero(self):
-        # numpy semantics: tuple of index arrays (host round-trip — the
-        # output shape is data-dependent, like the reference's np.nonzero)
-        import numpy as _np_host
-        idx = _np_host.nonzero(self.asnumpy())
-        dev = self._device
-        return tuple(from_jax(jnp.asarray(i), dev) for i in idx)
+        # numpy semantics: tuple of index arrays; shares the module-level
+        # host round-trip (output shape is data-dependent)
+        from ..numpy import nonzero as _np_nonzero
+        return _np_nonzero(self)
 
     def sort(self, axis=-1, kind=None, order=None):
         return self._method(jnp.sort, axis=axis)

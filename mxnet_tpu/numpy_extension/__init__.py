@@ -41,6 +41,7 @@ __all__ = [
     "remat_call",
     "save", "load", "waitall", "set_np", "reset_np", "is_np_array",
     "seed", "rnn", "intgemm_fully_connected", "custom",
+    "random", "image", "cpu", "gpu", "tpu", "num_gpus", "num_tpus",
 ]
 
 

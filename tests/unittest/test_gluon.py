@@ -274,3 +274,9 @@ def test_initializer_mixed_load_rnnfused(tmp_path):
     onp.testing.assert_allclose(b[:8], 0.0)
     w = cell.i2h_weight.data().asnumpy()
     assert w.std() > 0
+
+    # used as a full (global) initializer: string inner init resolves
+    # and _init_weight delegates to it
+    cell2 = mx.gluon.rnn.LSTMCell(8, input_size=4)
+    cell2.initialize(mx.init.RNNFused("xavier"), force_reinit=True)
+    assert cell2.i2h_weight.data().asnumpy().std() > 0
