@@ -59,13 +59,19 @@ def build_step(cfg, loss_kind="mlm", optimizer=None, dropout=True):
 results = {}
 
 # A0. NEW bench config: masked-position MLM head (n_mask=20)
+import os as _os
 import subprocess
-r = subprocess.run([sys.executable, "/root/repo/bench.py", "--measure",
-                    "default"], capture_output=True, text=True, timeout=600)
-for line in reversed(r.stdout.strip().splitlines()):
-    if line.startswith("{"):
-        print("A0 bench(masked):", line)
-        break
+_repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+try:
+    r = subprocess.run([sys.executable, _os.path.join(_repo, "bench.py"),
+                        "--measure", "default"], capture_output=True,
+                       text=True, timeout=600)
+    for line in reversed(r.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            print("A0 bench(masked):", line)
+            break
+except subprocess.TimeoutExpired:
+    print("A0 bench(masked): timed out; continuing with A-G")
 
 # A. full-sequence head (= old bench config)
 f = build_step(BertConfig(dtype="bfloat16"))

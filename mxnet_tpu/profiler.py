@@ -35,9 +35,13 @@ _config = {"profile_all": False, "filename": "profile_output",
 _agg: dict = {}
 _agg_lock = threading.Lock()
 _counters: dict = {}
+# chrome://tracing events [(name, t_begin_s, dur_s, tid)], bounded
+_events: list = []
+_MAX_EVENTS = 200_000
 
 
 def _record_stat(name: str, elapsed_s: float) -> None:
+    now = time.perf_counter()
     with _agg_lock:
         st = _agg.get(name)
         if st is None:
@@ -49,6 +53,9 @@ def _record_stat(name: str, elapsed_s: float) -> None:
                 st[2] = elapsed_s
             if elapsed_s > st[3]:
                 st[3] = elapsed_s
+        if len(_events) < _MAX_EVENTS:
+            _events.append((name, now - elapsed_s, elapsed_s,
+                            threading.get_ident()))
 
 
 def set_config(**kwargs):
@@ -71,6 +78,8 @@ def start():
         _config["tracing"] = False
     _config["running"] = True
     _config["outdir"] = outdir
+    with _agg_lock:
+        _events.clear()  # no stale events from a previous session
     if _config.get("aggregate_stats"):
         _ndarray_module()._op_profile_hook = _record_stat
 
@@ -94,8 +103,27 @@ def resume(profile_process="worker"):
 
 
 def dump(finished=True, profile_process="worker"):
-    if _config.get("running"):
-        stop()
+    """Stop (like the reference's finished=True) and write the collected
+    op events as chrome://tracing JSON to `filename` (parity:
+    `src/profiler/profiler.h:87,441` DumpProfile; open in
+    chrome://tracing or Perfetto). The XPlane trace from `jax.profiler`
+    lands separately under the trace directory."""
+    if finished and _config.get("running"):
+        stop()  # finished=False: snapshot and keep collecting
+    out = _config.get("filename", "profile_output")
+    if not out.endswith(".json"):
+        out = out + ".json"
+    with _agg_lock:
+        events = list(_events)
+        if finished:
+            _events.clear()
+    trace = {"traceEvents": [
+        {"name": name, "ph": "X", "cat": "op",
+         "ts": t0 * 1e6, "dur": dur * 1e6, "pid": os.getpid(), "tid": tid}
+        for name, t0, dur, tid in events]}
+    with open(out, "w") as f:
+        _json.dump(trace, f)
+    return out
 
 
 def dumps(reset=False, format="table", sort_by="total", ascending=False):
@@ -113,6 +141,7 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
         if reset:
             _agg.clear()
             _counters.clear()
+            _events.clear()
 
     key_idx = {"count": 1, "total": 2, "min": 3, "max": 4, "avg": 5}
     idx = key_idx.get(sort_by, 2)
@@ -221,4 +250,5 @@ class Marker:
         self.name = name
 
     def mark(self, scope_="process"):
-        _record_stat(f"marker:{self.name}", 0.0)
+        if _config.get("running"):
+            _record_stat(f"marker:{self.name}", 0.0)

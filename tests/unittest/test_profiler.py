@@ -57,3 +57,27 @@ def test_counters_and_sort(tmp_path):
     profiler.stop()
     table = profiler.dumps(sort_by="count", reset=True)
     assert "batches" in table and "5" in table
+
+
+def test_dump_writes_chrome_trace(tmp_path):
+    """dump() emits chrome://tracing JSON (parity: the reference's
+    DumpProfile output format, `src/profiler/profiler.h:87,441`)."""
+    import json
+    out = str(tmp_path / "trace")
+    mx.profiler.set_config(aggregate_stats=True, filename=out)
+    mx.profiler.start()
+    import numpy as onp
+    a = mx.np.array(onp.ones((8, 8), dtype="float32"))
+    for _ in range(3):
+        a = a + 1
+    (a * 2).asnumpy()
+    path = mx.profiler.dump()
+    assert path.endswith(".json")
+    with open(path) as f:
+        trace = json.load(f)
+    evs = trace["traceEvents"]
+    assert len(evs) >= 4
+    names = {e["name"] for e in evs}
+    assert any("add" in n for n in names), names
+    for e in evs[:3]:
+        assert e["ph"] == "X" and e["dur"] >= 0 and "ts" in e
