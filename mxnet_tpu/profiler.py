@@ -56,6 +56,14 @@ def _record_stat(name: str, elapsed_s: float) -> None:
         if len(_events) < _MAX_EVENTS:
             _events.append((name, now - elapsed_s, elapsed_s,
                             threading.get_ident()))
+        elif not _config.get("_events_truncated"):
+            _config["_events_truncated"] = True
+            _events.append(("<TRACE TRUNCATED: event cap reached>",
+                            now, 0.0, threading.get_ident()))
+            import logging
+            logging.getLogger(__name__).warning(
+                "profiler: chrome-trace event cap (%d) reached; later "
+                "ops are not recorded in the trace", _MAX_EVENTS)
 
 
 def set_config(**kwargs):
@@ -78,6 +86,7 @@ def start():
         _config["tracing"] = False
     _config["running"] = True
     _config["outdir"] = outdir
+    _config["_events_truncated"] = False
     with _agg_lock:
         _events.clear()  # no stale events from a previous session
     if _config.get("aggregate_stats"):
@@ -139,9 +148,10 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
                 for name, st in _agg.items()]
         counters = dict(_counters)
         if reset:
+            # resets aggregate stats only (reference semantics); the
+            # chrome-trace buffer lives until the next start()
             _agg.clear()
             _counters.clear()
-            _events.clear()
 
     key_idx = {"count": 1, "total": 2, "min": 3, "max": 4, "avg": 5}
     idx = key_idx.get(sort_by, 2)

@@ -496,3 +496,50 @@ def test_embedding_matmul_grad_matches_scatter():
                                     rtol=1e-5, atol=1e-5)
     finally:
         flags.embedding_grad = prev
+
+
+@pytest.mark.parametrize("name,args,kwargs", [
+    ("relu", ((4, 8),), {}),
+    ("gelu", ((4, 8),), {}),
+    ("sigmoid", ((4, 8),), {}),
+    ("softmax", ((4, 8),), {"axis": -1}),
+    ("log_softmax", ((4, 8),), {"axis": -1}),
+])
+def test_npx_bf16_forward(name, args, kwargs):
+    """bf16 in -> bf16 out with values matching the fp32 path to bf16
+    tolerance (the dtype every TPU model runs in)."""
+    import jax.numpy as jnp
+    rng = onp.random.RandomState(0)
+    arrs = [rng.randn(*s).astype("float32") for s in args]
+    fn = getattr(mx.npx, name)
+    out32 = fn(*[mx.np.array(a) for a in arrs], **kwargs)
+    out16 = fn(*[mx.np.array(a).astype("bfloat16") for a in arrs],
+               **kwargs)
+    assert out16.dtype == jnp.bfloat16, (name, out16.dtype)
+    onp.testing.assert_allclose(
+        out16.asnumpy().astype("float32"), out32.asnumpy(),
+        rtol=0.05, atol=0.05)
+
+
+def test_npx_bf16_nn_layers():
+    """Conv/FC/norm layers keep bf16 end to end."""
+    import jax.numpy as jnp
+    rng = onp.random.RandomState(1)
+    x = mx.np.array(rng.randn(2, 3, 8, 8).astype("float32")) \
+        .astype("bfloat16")
+    w = mx.np.array(rng.randn(4, 3, 3, 3).astype("float32")) \
+        .astype("bfloat16")
+    out = mx.npx.convolution(x, w, kernel=(3, 3), num_filter=4, pad=(1, 1),
+                             no_bias=True)
+    assert out.dtype == jnp.bfloat16 and out.shape == (2, 4, 8, 8)
+
+    xf = mx.np.array(rng.randn(4, 16).astype("float32")).astype("bfloat16")
+    wf = mx.np.array(rng.randn(8, 16).astype("float32")).astype("bfloat16")
+    o = mx.npx.fully_connected(xf, wf, None, num_hidden=8, no_bias=True,
+                               flatten=False)
+    assert o.dtype == jnp.bfloat16
+
+    g = mx.np.array(onp.ones(16, dtype="float32")).astype("bfloat16")
+    b = mx.np.array(onp.zeros(16, dtype="float32")).astype("bfloat16")
+    ln = mx.npx.layer_norm(xf, g, b, axis=-1)
+    assert ln.dtype == jnp.bfloat16

@@ -167,3 +167,22 @@ def test_onnx_export_validates_against_onnxruntime():
         sess = ort.InferenceSession(path)
         got = sess.run(None, {sess.get_inputs()[0].name: x})[0]
     onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_export_transformer_block(tmp_path):
+    """Export a full transformer encoder layer (fused QKV attention +
+    FFN + layernorms) and validate against the self-runtime — the BERT
+    building block (ref: `mx2onnx` transformer op translations)."""
+    from mxnet_tpu.models.bert import BertConfig, BertLayer
+    cfg = BertConfig(vocab_size=32, hidden_size=16, num_layers=1,
+                     num_heads=2, intermediate_size=32, max_position=8,
+                     dropout=0.0)
+    layer = BertLayer(cfg)
+    layer.initialize()
+    x = mx.np.array(onp.random.RandomState(0)
+                    .randn(2, 8, 16).astype("float32"))
+    want = layer(x).asnumpy()
+
+    got, want2, _ = _export_and_run(layer, x, tmp_path, "block.onnx")
+    onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+    onp.testing.assert_allclose(want2, want, rtol=1e-6)
