@@ -4,3 +4,4 @@ Parity: `python/mxnet/gluon/contrib/` (reference). The flagship member is the
 Keras-style `estimator` training-loop facility.
 """
 from . import estimator  # noqa: F401
+from . import data  # noqa: F401

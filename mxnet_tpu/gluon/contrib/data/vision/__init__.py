@@ -1,0 +1,4 @@
+"""Detection-pipeline data utilities (parity:
+`python/mxnet/gluon/contrib/data/vision/`)."""
+from . import bbox  # noqa: F401
+from .bbox import *  # noqa: F401,F403

@@ -1,0 +1,201 @@
+"""Bounding-box-aware image transforms (parity:
+`python/mxnet/gluon/contrib/data/vision/transforms/bbox/bbox.py:34-297` —
+the detection-pipeline augmentations). Bboxes are (N, 4+) arrays of
+(xmin, ymin, xmax, ymax, *extra); extra columns pass through untouched.
+Box geometry runs on host numpy (box counts are data-dependent — the
+reference also round-trips through .asnumpy() here); image pixels stay
+on device."""
+from __future__ import annotations
+
+import numpy as _onp
+
+from .....base import MXNetError
+from ....block import Block
+from ..... import numpy as _np
+
+__all__ = ["ImageBboxRandomFlipLeftRight", "ImageBboxCrop",
+           "ImageBboxRandomCropWithConstraints", "ImageBboxRandomExpand",
+           "ImageBboxResize", "bbox_crop", "bbox_iou"]
+
+
+def _check_bbox(bbox):
+    if bbox.ndim != 2 or bbox.shape[1] < 4:
+        raise MXNetError(f"bbox must be (N, 4+), got {tuple(bbox.shape)}")
+
+
+def _host(b):
+    return b.asnumpy() if hasattr(b, "asnumpy") else _onp.asarray(b)
+
+
+def bbox_iou(a, b):
+    """Pairwise IoU between (N, 4) and (M, 4) host boxes -> (N, M)."""
+    tl = _onp.maximum(a[:, None, :2], b[None, :, :2])
+    br = _onp.minimum(a[:, None, 2:4], b[None, :, 2:4])
+    wh = _onp.clip(br - tl, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return inter / _onp.maximum(area_a[:, None] + area_b[None] - inter,
+                                1e-12)
+
+
+def bbox_crop(bbox, crop_box, allow_outside_center=False):
+    """Clip host boxes to crop (x, y, w, h), translate to crop frame, and
+    drop degenerate (and, optionally, outside-center) boxes."""
+    x0, y0, w, h = crop_box
+    out = bbox.copy().astype(_onp.float64)
+    out[:, 0] = _onp.clip(out[:, 0], x0, x0 + w) - x0
+    out[:, 1] = _onp.clip(out[:, 1], y0, y0 + h) - y0
+    out[:, 2] = _onp.clip(out[:, 2], x0, x0 + w) - x0
+    out[:, 3] = _onp.clip(out[:, 3], y0, y0 + h) - y0
+    keep = (out[:, 2] > out[:, 0]) & (out[:, 3] > out[:, 1])
+    if not allow_outside_center:
+        cx = (bbox[:, 0] + bbox[:, 2]) / 2
+        cy = (bbox[:, 1] + bbox[:, 3]) / 2
+        keep &= ((cx >= x0) & (cx <= x0 + w) &
+                 (cy >= y0) & (cy <= y0 + h))
+    return out[keep]
+
+
+class ImageBboxRandomFlipLeftRight(Block):
+    """Flip image + boxes horizontally with probability p (ref :34)."""
+
+    def __init__(self, p=0.5):
+        super().__init__()
+        self.p = p
+
+    def forward(self, img, bbox):
+        b = _host(bbox)
+        _check_bbox(b)
+        if self.p <= 0 or (self.p < 1 and _onp.random.random() > self.p):
+            return img, _np.array(b)
+        img = _np.flip(img, axis=1)  # HWC width axis
+        width = img.shape[1]
+        out = b.copy()
+        out[:, 0] = width - b[:, 2]
+        out[:, 2] = width - b[:, 0]
+        return img, _np.array(out)
+
+
+class ImageBboxCrop(Block):
+    """Crop image to (x, y, w, h) and clip/translate boxes (ref :90)."""
+
+    def __init__(self, crop, allow_outside_center=False):
+        super().__init__()
+        if len(crop) != 4:
+            raise MXNetError("crop must be (x_min, y_min, width, height)")
+        self.x0, self.y0, self.w, self.h = crop
+        if self.x0 < 0 or self.y0 < 0 or self.w <= 0 or self.h <= 0:
+            raise MXNetError(f"invalid crop {crop}")
+        self._allow = allow_outside_center
+
+    def forward(self, img, bbox):
+        b = _host(bbox)
+        _check_bbox(b)
+        if self.x0 + self.w >= img.shape[1] or \
+                self.y0 + self.h >= img.shape[0]:
+            return img, _np.array(b)
+        new_img = img[self.y0:self.y0 + self.h, self.x0:self.x0 + self.w]
+        return new_img, _np.array(
+            bbox_crop(b, (self.x0, self.y0, self.w, self.h), self._allow))
+
+
+class ImageBboxRandomCropWithConstraints(Block):
+    """Random crop whose IoU with some box satisfies sampled constraints
+    (SSD-style augmentation; ref :146)."""
+
+    def __init__(self, p=0.5, min_scale=0.3, max_scale=1.0,
+                 max_aspect_ratio=2.0, constraints=None, max_trial=50):
+        super().__init__()
+        self.p = p
+        self.min_scale = min_scale
+        self.max_scale = max_scale
+        self.max_aspect = max_aspect_ratio
+        self.constraints = constraints or ((0.1, None), (0.3, None),
+                                           (0.5, None), (0.7, None),
+                                           (0.9, None), (None, 1))
+        self.max_trial = max_trial
+
+    def forward(self, img, bbox):
+        b = _host(bbox)
+        _check_bbox(b)
+        if _onp.random.random() > self.p:
+            return img, _np.array(b)
+        h, w = img.shape[0], img.shape[1]
+        min_iou, max_iou = self.constraints[
+            _onp.random.randint(len(self.constraints))]
+        min_iou = -_onp.inf if min_iou is None else min_iou
+        max_iou = _onp.inf if max_iou is None else max_iou
+        for _ in range(self.max_trial):
+            scale = _onp.random.uniform(self.min_scale, self.max_scale)
+            ar = _onp.random.uniform(
+                max(1 / self.max_aspect, scale * scale),
+                min(self.max_aspect, 1 / (scale * scale)))
+            cw = int(w * scale * _onp.sqrt(ar))
+            ch = int(h * scale / _onp.sqrt(ar))
+            if cw > w or ch > h or cw <= 0 or ch <= 0:
+                continue
+            cx = _onp.random.randint(0, w - cw + 1)
+            cy = _onp.random.randint(0, h - ch + 1)
+            crop = _onp.array([[cx, cy, cx + cw, cy + ch]],
+                              dtype=_onp.float64)
+            iou = bbox_iou(b[:, :4].astype(_onp.float64), crop)
+            if iou.size and min_iou <= iou.min() and iou.max() <= max_iou:
+                new_b = bbox_crop(b, (cx, cy, cw, ch), False)
+                if new_b.shape[0] == 0:
+                    continue
+                return img[cy:cy + ch, cx:cx + cw], _np.array(new_b)
+        return img, _np.array(b)
+
+
+class ImageBboxRandomExpand(Block):
+    """Place the image on a larger filled canvas, offsetting boxes
+    (ref :216)."""
+
+    def __init__(self, p=0.5, max_ratio=4.0, fill=0, keep_ratio=True):
+        super().__init__()
+        self.p = p
+        self.max_ratio = max_ratio
+        self.fill = fill
+        self.keep_ratio = keep_ratio
+
+    def forward(self, img, bbox):
+        b = _host(bbox)
+        _check_bbox(b)
+        if self.max_ratio <= 1 or _onp.random.random() > self.p:
+            return img, _np.array(b)
+        h, w, c = img.shape
+        rx = _onp.random.uniform(1, self.max_ratio)
+        ry = rx if self.keep_ratio else _onp.random.uniform(
+            1, self.max_ratio)
+        nh, nw = int(h * ry), int(w * rx)
+        ox = _onp.random.randint(0, nw - w + 1)
+        oy = _onp.random.randint(0, nh - h + 1)
+        canvas = _np.full((nh, nw, c), float(self.fill),
+                          dtype=str(img.dtype))
+        canvas[oy:oy + h, ox:ox + w] = img
+        out = b.copy()
+        out[:, (0, 2)] += ox
+        out[:, (1, 3)] += oy
+        return canvas, _np.array(out)
+
+
+class ImageBboxResize(Block):
+    """Resize image to (w, h), scaling boxes accordingly (ref :297)."""
+
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._interp = interpolation
+
+    def forward(self, img, bbox):
+        from .....image import imresize
+        b = _host(bbox)
+        _check_bbox(b)
+        h, w = img.shape[0], img.shape[1]
+        nw, nh = self._size
+        out_img = imresize(img, nw, nh, self._interp)
+        out = b.copy().astype(_onp.float64)
+        out[:, (0, 2)] *= nw / w
+        out[:, (1, 3)] *= nh / h
+        return out_img, _np.array(out)

@@ -42,6 +42,7 @@ _MAX_EVENTS = 200_000
 
 def _record_stat(name: str, elapsed_s: float) -> None:
     now = time.perf_counter()
+    warn_cap = False
     with _agg_lock:
         st = _agg.get(name)
         if st is None:
@@ -60,10 +61,12 @@ def _record_stat(name: str, elapsed_s: float) -> None:
             _config["_events_truncated"] = True
             _events.append(("<TRACE TRUNCATED: event cap reached>",
                             now, 0.0, threading.get_ident()))
-            import logging
-            logging.getLogger(__name__).warning(
-                "profiler: chrome-trace event cap (%d) reached; later "
-                "ops are not recorded in the trace", _MAX_EVENTS)
+            warn_cap = True
+    if warn_cap:  # log OUTSIDE the lock every op dispatch takes
+        import logging
+        logging.getLogger(__name__).warning(
+            "profiler: chrome-trace event cap (%d) reached; later "
+            "ops are not recorded in the trace", _MAX_EVENTS)
 
 
 def set_config(**kwargs):

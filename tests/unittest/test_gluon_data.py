@@ -225,3 +225,69 @@ def test_augmentation_transforms():
 
     comp = T.HybridCompose([T.RandomBrightness(0.1), T.RandomGray(1.0)])
     assert comp(img).shape == img.shape
+
+
+class TestBboxTransforms:
+    """Detection augmentations (ref `gluon/contrib/data/vision/transforms/
+    bbox/bbox.py:34-297`)."""
+
+    def _img_boxes(self):
+        rng = onp.random.RandomState(0)
+        img = mx.np.array(rng.rand(20, 30, 3).astype("float32"))
+        boxes = mx.np.array(onp.array(
+            [[2.0, 3.0, 10.0, 12.0, 1.0],    # extra class column
+             [15.0, 5.0, 28.0, 18.0, 2.0]], dtype="float32"))
+        return img, boxes
+
+    def test_flip(self):
+        from mxnet_tpu.gluon.contrib.data.vision import (
+            ImageBboxRandomFlipLeftRight)
+        img, boxes = self._img_boxes()
+        out_img, out_b = ImageBboxRandomFlipLeftRight(p=1.0)(img, boxes)
+        onp.testing.assert_allclose(out_img.asnumpy(),
+                                    img.asnumpy()[:, ::-1])
+        b = out_b.asnumpy()
+        onp.testing.assert_allclose(b[0, :4], [30 - 10, 3, 30 - 2, 12])
+        onp.testing.assert_allclose(b[:, 4], [1, 2])  # extras intact
+
+    def test_crop_filters_and_translates(self):
+        from mxnet_tpu.gluon.contrib.data.vision import ImageBboxCrop
+        img, boxes = self._img_boxes()
+        out_img, out_b = ImageBboxCrop((0, 0, 14, 15))(img, boxes)
+        assert out_img.shape == (15, 14, 3)
+        b = out_b.asnumpy()
+        assert b.shape[0] == 1  # second box center outside -> dropped
+        onp.testing.assert_allclose(b[0, :4], [2, 3, 10, 12])
+
+    def test_random_crop_with_constraints_keeps_box(self):
+        from mxnet_tpu.gluon.contrib.data.vision import (
+            ImageBboxRandomCropWithConstraints)
+        onp.random.seed(3)
+        img, boxes = self._img_boxes()
+        t = ImageBboxRandomCropWithConstraints(p=1.0, max_trial=100)
+        out_img, out_b = t(img, boxes)
+        assert out_b.shape[0] >= 1
+        b = out_b.asnumpy()
+        h, w = out_img.shape[0], out_img.shape[1]
+        assert (b[:, 0] >= 0).all() and (b[:, 2] <= w + 1e-6).all()
+        assert (b[:, 1] >= 0).all() and (b[:, 3] <= h + 1e-6).all()
+
+    def test_expand_offsets_boxes(self):
+        from mxnet_tpu.gluon.contrib.data.vision import (
+            ImageBboxRandomExpand)
+        onp.random.seed(1)
+        img, boxes = self._img_boxes()
+        out_img, out_b = ImageBboxRandomExpand(p=1.0, fill=0.5)(img, boxes)
+        assert out_img.shape[0] >= 20 and out_img.shape[1] >= 30
+        b = out_b.asnumpy()
+        # box size preserved
+        onp.testing.assert_allclose(b[:, 2] - b[:, 0],
+                                    [8.0, 13.0], rtol=1e-6)
+
+    def test_resize_scales_boxes(self):
+        from mxnet_tpu.gluon.contrib.data.vision import ImageBboxResize
+        img, boxes = self._img_boxes()
+        out_img, out_b = ImageBboxResize((60, 40))(img, boxes)
+        assert out_img.shape == (40, 60, 3)
+        b = out_b.asnumpy()
+        onp.testing.assert_allclose(b[0, :4], [4, 6, 20, 24], rtol=1e-5)
