@@ -39,7 +39,7 @@ def bbox_iou(a, b):
                                 1e-12)
 
 
-def bbox_crop(bbox, crop_box, allow_outside_center=False):
+def bbox_crop(bbox, crop_box, allow_outside_center=True):
     """Clip host boxes to crop (x, y, w, h), translate to crop frame, and
     drop degenerate (and, optionally, outside-center) boxes."""
     x0, y0, w, h = crop_box
@@ -92,12 +92,13 @@ class ImageBboxCrop(Block):
     def forward(self, img, bbox):
         b = _host(bbox)
         _check_bbox(b)
-        if self.x0 + self.w >= img.shape[1] or \
-                self.y0 + self.h >= img.shape[0]:
-            return img, _np.array(b)
+        if self.x0 + self.w > img.shape[1] or \
+                self.y0 + self.h > img.shape[0]:
+            return img, _np.array(b)  # crop exceeds the image: no-op
         new_img = img[self.y0:self.y0 + self.h, self.x0:self.x0 + self.w]
-        return new_img, _np.array(
-            bbox_crop(b, (self.x0, self.y0, self.w, self.h), self._allow))
+        return new_img, _np.array(bbox_crop(
+            b, (self.x0, self.y0, self.w, self.h),
+            allow_outside_center=self._allow))
 
 
 class ImageBboxRandomCropWithConstraints(Block):
@@ -171,8 +172,12 @@ class ImageBboxRandomExpand(Block):
         nh, nw = int(h * ry), int(w * rx)
         ox = _onp.random.randint(0, nw - w + 1)
         oy = _onp.random.randint(0, nh - h + 1)
-        canvas = _np.full((nh, nw, c), float(self.fill),
-                          dtype=str(img.dtype))
+        # fill may be a scalar or per-channel (e.g. the SSD mean pixel)
+        fill = _onp.broadcast_to(
+            _onp.asarray(self.fill, dtype=str(img.dtype)), (c,))
+        canvas_np = _onp.empty((nh, nw, c), dtype=str(img.dtype))
+        canvas_np[...] = fill
+        canvas = _np.array(canvas_np)
         canvas[oy:oy + h, ox:ox + w] = img
         out = b.copy()
         out[:, (0, 2)] += ox

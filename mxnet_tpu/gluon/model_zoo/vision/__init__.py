@@ -16,6 +16,25 @@ for _mod in (_resnet_mod, _others_mod):
         if callable(_obj) and _name[0].islower():
             _models[_name] = _obj
 
+# reference registry spellings (`model_zoo/vision/__init__.py:91` keys use
+# e.g. 'inceptionv3' and width-dotted 'mobilenetv2_1.0')
+_ALIASES = {
+    "inceptionv3": "inception_v3",
+    "mobilenetv2_1.0": "mobilenet_v2_1_0",
+    "mobilenetv2_0.75": "mobilenet_v2_0_75",
+    "mobilenetv2_0.5": "mobilenet_v2_0_5",
+    "mobilenetv2_0.25": "mobilenet_v2_0_25",
+    "mobilenet1.0": "mobilenet1_0",
+    "mobilenet0.75": "mobilenet0_75",
+    "mobilenet0.5": "mobilenet0_5",
+    "mobilenet0.25": "mobilenet0_25",
+    "squeezenet1.0": "squeezenet1_0",
+    "squeezenet1.1": "squeezenet1_1",
+}
+for _alias, _target in _ALIASES.items():
+    if _target in _models:
+        _models[_alias] = _models[_target]
+
 
 def get_model(name, **kwargs):
     """Create a model by name (parity: vision/__init__.py:91)."""

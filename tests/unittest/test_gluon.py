@@ -280,3 +280,19 @@ def test_initializer_mixed_load_rnnfused(tmp_path):
     cell2 = mx.gluon.rnn.LSTMCell(8, input_size=4)
     cell2.initialize(mx.init.RNNFused("xavier"), force_reinit=True)
     assert cell2.i2h_weight.data().asnumpy().std() > 0
+
+
+def test_model_zoo_reference_registry_names():
+    """Every name in the reference get_model registry resolves (incl.
+    the 'inceptionv3'/'mobilenetv2_1.0' spellings)."""
+    from mxnet_tpu.gluon.model_zoo import vision
+    import re
+    ref_names = ["inceptionv3", "mobilenetv2_1.0", "mobilenetv2_0.75",
+                 "mobilenetv2_0.5", "mobilenetv2_0.25", "mobilenet1.0",
+                 "mobilenet0.75", "mobilenet0.5", "mobilenet0.25",
+                 "squeezenet1.0", "squeezenet1.1", "resnet18_v1",
+                 "resnet152_v2", "vgg16", "vgg19_bn", "densenet121",
+                 "alexnet"]
+    for name in ref_names:
+        net = vision.get_model(name)
+        assert net is not None, name

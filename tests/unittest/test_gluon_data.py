@@ -291,3 +291,25 @@ class TestBboxTransforms:
         assert out_img.shape == (40, 60, 3)
         b = out_b.asnumpy()
         onp.testing.assert_allclose(b[0, :4], [4, 6, 20, 24], rtol=1e-5)
+
+    def test_edge_touching_crop_and_channel_fill(self):
+        from mxnet_tpu.gluon.contrib.data.vision import (
+            ImageBboxCrop, ImageBboxRandomExpand)
+        img, boxes = self._img_boxes()
+        # crop touching the right/bottom edge is valid, incl. full-image
+        out_img, _ = ImageBboxCrop((16, 5, 14, 15))(img, boxes)
+        assert out_img.shape == (15, 14, 3)
+        full_img, full_b = ImageBboxCrop((0, 0, 30, 20),
+                                         allow_outside_center=True)(
+            img, boxes)
+        assert full_img.shape == (20, 30, 3)
+        assert full_b.shape[0] == 2
+        # per-channel fill (SSD mean pixel)
+        onp.random.seed(2)
+        out, _ = ImageBboxRandomExpand(p=1.0,
+                                       fill=(0.485, 0.456, 0.406))(
+            img, boxes)
+        corner = out.asnumpy()[0, 0]
+        if not onp.allclose(corner, img.asnumpy()[0, 0]):
+            onp.testing.assert_allclose(corner, [0.485, 0.456, 0.406],
+                                        rtol=1e-5)
