@@ -172,12 +172,13 @@ class ImageBboxRandomExpand(Block):
         nh, nw = int(h * ry), int(w * rx)
         ox = _onp.random.randint(0, nw - w + 1)
         oy = _onp.random.randint(0, nh - h + 1)
-        # fill may be a scalar or per-channel (e.g. the SSD mean pixel)
+        # fill may be a scalar or per-channel (e.g. the SSD mean pixel);
+        # only the (c,) fill vector crosses to device — the canvas is a
+        # device-side broadcast
         fill = _onp.broadcast_to(
             _onp.asarray(self.fill, dtype=str(img.dtype)), (c,))
-        canvas_np = _onp.empty((nh, nw, c), dtype=str(img.dtype))
-        canvas_np[...] = fill
-        canvas = _np.array(canvas_np)
+        canvas = _np.broadcast_to(_np.array(fill.copy()),
+                                  (nh, nw, c)).copy()
         canvas[oy:oy + h, ox:ox + w] = img
         out = b.copy()
         out[:, (0, 2)] += ox

@@ -1,0 +1,177 @@
+"""`npx.image` / `nd.image` operator namespace (parity:
+`src/operator/image/image_random.cc` + `resize.cc`/`crop.cc` ops surfaced
+as `_image_*`; python wrappers `python/mxnet/ndarray/image.py`).
+
+Thin op-style adapters over `mxnet_tpu.image`'s functions/augmenters with
+the reference's names and argument shapes (HWC or NHWC input)."""
+from __future__ import annotations
+
+import numpy as _onp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import ndarray, apply_op
+from .. import numpy as _np
+
+__all__ = ["resize", "crop", "random_crop", "random_resized_crop",
+           "to_tensor", "normalize", "flip_left_right", "flip_top_bottom",
+           "random_flip_left_right", "random_flip_top_bottom",
+           "random_brightness", "random_contrast", "random_saturation",
+           "random_hue", "random_color_jitter", "random_lighting"]
+
+
+def _hwc(call, data, *args, **kwargs):
+    """Apply an HWC function over HWC or NHWC input."""
+    if data.ndim == 4:
+        outs = [call(data[i], *args, **kwargs) for i in range(data.shape[0])]
+        return _np.stack(outs, axis=0)
+    return call(data, *args, **kwargs)
+
+
+def resize(data, size=(224, 224), keep_ratio=False, interp=1):
+    from . import imresize, resize_short
+    if isinstance(size, int):
+        size = (size, size)
+
+    def one(img):
+        if keep_ratio:
+            return resize_short(img, min(size), interp)
+        return imresize(img, size[0], size[1], interp)
+    return _hwc(one, data)
+
+
+def crop(data, x, y, width, height):
+    from . import fixed_crop
+    return _hwc(lambda img: fixed_crop(img, x, y, width, height), data)
+
+
+def random_crop(data, xrange=(0.0, 1.0), yrange=(0.0, 1.0),
+                wrange=(0.0, 1.0), hrange=(0.0, 1.0), size=None, interp=1):
+    from . import random_crop as _rc
+
+    def one(img):
+        h, w = img.shape[0], img.shape[1]
+        sz = size or (w, h)
+        out = _rc(img, sz if not isinstance(sz, int) else (sz, sz), interp)
+        return out[0] if isinstance(out, tuple) else out
+    return _hwc(one, data)
+
+
+def random_resized_crop(data, xrange=(0.0, 1.0), yrange=(0.0, 1.0),
+                        area=(0.08, 1.0), ratio=(3 / 4.0, 4 / 3.0),
+                        size=None, interp=1):
+    from . import random_size_crop
+
+    def one(img):
+        h, w = img.shape[0], img.shape[1]
+        sz = size or (w, h)
+        out = random_size_crop(img, sz if not isinstance(sz, int)
+                               else (sz, sz), area, ratio, interp)
+        return out[0] if isinstance(out, tuple) else out
+    return _hwc(one, data)
+
+
+def to_tensor(data):
+    """HWC uint8/float [0,255] -> CHW float32 [0,1] (`_image_to_tensor`)."""
+    def fn(x):
+        import jax.numpy as jnp
+        y = x.astype(jnp.float32) / 255.0
+        perm = (2, 0, 1) if x.ndim == 3 else (0, 3, 1, 2)
+        return jnp.transpose(y, perm)
+    return apply_op(fn, (data,), {}, name="image_to_tensor")
+
+
+def normalize(data, mean=0.0, std=1.0):
+    """CHW/NCHW channel normalize (`_image_normalize`)."""
+    def fn(x):
+        import jax.numpy as jnp
+        m = jnp.asarray(mean, jnp.float32)
+        s = jnp.asarray(std, jnp.float32)
+        shape = (-1, 1, 1) if x.ndim == 3 else (1, -1, 1, 1)
+        return (x - m.reshape(shape)) / s.reshape(shape)
+    return apply_op(fn, (data,), {}, name="image_normalize")
+
+
+def flip_left_right(data):
+    axis = 1 if data.ndim == 3 else 2
+    return _np.flip(data, axis=axis)
+
+
+def flip_top_bottom(data):
+    axis = 0 if data.ndim == 3 else 1
+    return _np.flip(data, axis=axis)
+
+
+def random_flip_left_right(data, p=0.5):
+    return flip_left_right(data) if _onp.random.random() < p else data
+
+
+def random_flip_top_bottom(data, p=0.5):
+    return flip_top_bottom(data) if _onp.random.random() < p else data
+
+
+def random_brightness(data, min_factor, max_factor):
+    alpha = float(_onp.random.uniform(min_factor, max_factor))
+    return apply_op(lambda x: x * alpha, (data,), {},
+                    name="image_random_brightness")
+
+
+def random_contrast(data, min_factor, max_factor):
+    alpha = float(_onp.random.uniform(min_factor, max_factor))
+    import jax.numpy as jnp
+    coef = jnp.asarray([0.299, 0.587, 0.114])
+
+    def fn(x):
+        gray = (x * coef).sum(axis=-1, keepdims=True)
+        return x * alpha + gray.mean() * (1.0 - alpha)
+    return apply_op(fn, (data,), {}, name="image_random_contrast")
+
+
+def random_saturation(data, min_factor, max_factor):
+    alpha = float(_onp.random.uniform(min_factor, max_factor))
+    import jax.numpy as jnp
+    coef = jnp.asarray([0.299, 0.587, 0.114])
+
+    def fn(x):
+        gray = (x * coef).sum(axis=-1, keepdims=True)
+        return x * alpha + gray * (1.0 - alpha)
+    return apply_op(fn, (data,), {}, name="image_random_saturation")
+
+
+def random_hue(data, min_factor, max_factor):
+    # draw the uniform factor and apply the YIQ hue rotation directly
+    delta = float(_onp.random.uniform(min_factor, max_factor)) - 1.0
+    import jax.numpy as jnp
+    u = _onp.cos(delta * _onp.pi)
+    w_ = _onp.sin(delta * _onp.pi)
+    bt = _onp.array([[1.0, 0.0, 0.0], [0.0, u, -w_], [0.0, w_, u]])
+    tyiq = _onp.array([[0.299, 0.587, 0.114],
+                       [0.596, -0.274, -0.321],
+                       [0.211, -0.523, 0.311]])
+    ityiq = _onp.array([[1.0, 0.9563, 0.6210],
+                        [1.0, -0.2721, -0.6474],
+                        [1.0, -1.107, 1.7046]])
+    t = jnp.asarray(_onp.dot(_onp.dot(ityiq, bt), tyiq).T)
+    return apply_op(lambda x: jnp.dot(x, t), (data,), {},
+                    name="image_random_hue")
+
+
+def random_color_jitter(data, brightness=0.0, contrast=0.0,
+                        saturation=0.0, hue=0.0):
+    out = data
+    if brightness:
+        out = random_brightness(out, 1 - brightness, 1 + brightness)
+    if contrast:
+        out = random_contrast(out, 1 - contrast, 1 + contrast)
+    if saturation:
+        out = random_saturation(out, 1 - saturation, 1 + saturation)
+    if hue:
+        out = random_hue(out, 1 - hue, 1 + hue)
+    return out
+
+
+def random_lighting(data, alpha_std=0.05):
+    from . import LightingAug
+    from ..gluon.data.vision.transforms import RandomLighting
+    aug = LightingAug(alpha_std, RandomLighting._EIGVAL,
+                      RandomLighting._EIGVEC)
+    return aug(data)

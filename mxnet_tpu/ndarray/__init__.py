@@ -38,3 +38,6 @@ from . import sparse  # noqa: E402,F401  (mx.nd.sparse namespace)
 # mx.np for numpy semantics
 from .legacy_ops import *  # noqa: E402,F401,F403
 from . import legacy_ops as op  # noqa: E402,F401  (mx.nd.op alias)
+
+# `nd.image` op namespace (parity: `python/mxnet/ndarray/image.py`)
+from ..image import _npx_image as image  # noqa: E402,F401

@@ -1100,5 +1100,5 @@ def custom(*inputs, op_type, **kwargs):
 # submodule re-exports (parity: `python/mxnet/numpy_extension/__init__.py`
 # exposes npx.random, npx.image, and the device helpers)
 from ..numpy import random  # noqa: E402,F401
-from .. import image  # noqa: E402,F401
+from ..image import _npx_image as image  # noqa: E402,F401
 from ..device import cpu, gpu, tpu, num_gpus, num_tpus  # noqa: E402,F401

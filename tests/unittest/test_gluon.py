@@ -286,7 +286,6 @@ def test_model_zoo_reference_registry_names():
     """Every name in the reference get_model registry resolves (incl.
     the 'inceptionv3'/'mobilenetv2_1.0' spellings)."""
     from mxnet_tpu.gluon.model_zoo import vision
-    import re
     ref_names = ["inceptionv3", "mobilenetv2_1.0", "mobilenetv2_0.75",
                  "mobilenetv2_0.5", "mobilenetv2_0.25", "mobilenet1.0",
                  "mobilenet0.75", "mobilenet0.5", "mobilenet0.25",

@@ -98,3 +98,43 @@ def test_image_iter_from_recordio(tmp_path):
     assert batches[-1].pad == 2   # 10 samples -> last batch padded by 2
     # labels preserved
     assert batches[0].label[0].shape == (4,)
+
+
+def test_npx_image_op_namespace():
+    """`npx.image` / `nd.image` reference op surface (ref
+    `src/operator/image/image_random.cc`, `python/mxnet/ndarray/image.py`)."""
+    rng = onp.random.RandomState(0)
+    img = mx.np.array((rng.rand(8, 10, 3) * 255).astype("float32"))
+
+    t = mx.npx.image.to_tensor(img)
+    assert t.shape == (3, 8, 10)
+    assert 0.0 <= float(t.asnumpy().min()) and \
+        float(t.asnumpy().max()) <= 1.0
+
+    norm = mx.npx.image.normalize(t, mean=(0.5, 0.5, 0.5),
+                                  std=(0.5, 0.5, 0.5))
+    onp.testing.assert_allclose(norm.asnumpy(),
+                                (t.asnumpy() - 0.5) / 0.5, rtol=1e-5)
+
+    f = mx.npx.image.flip_left_right(img)
+    onp.testing.assert_allclose(f.asnumpy(), img.asnumpy()[:, ::-1])
+
+    r = mx.npx.image.resize(img, (5, 4))
+    assert r.shape == (4, 5, 3)
+    c = mx.npx.image.crop(img, 2, 1, 6, 5)
+    assert c.shape == (5, 6, 3)
+
+    # batched NHWC input
+    batch = mx.np.array((rng.rand(2, 8, 10, 3) * 255).astype("float32"))
+    tb = mx.npx.image.to_tensor(batch)
+    assert tb.shape == (2, 3, 8, 10)
+    rb = mx.npx.image.resize(batch, (6, 6))
+    assert rb.shape == (2, 6, 6, 3)
+
+    # nd alias sees the same module
+    assert mx.nd.image.to_tensor is mx.npx.image.to_tensor
+
+    jit = mx.npx.image.random_color_jitter(img, 0.1, 0.1, 0.1, 0.05)
+    assert jit.shape == img.shape
+    lit = mx.npx.image.random_lighting(img, 0.05)
+    assert lit.shape == img.shape
