@@ -8,8 +8,7 @@ from __future__ import annotations
 
 import numpy as _onp
 
-from ..base import MXNetError
-from ..ndarray.ndarray import ndarray, apply_op
+from ..ndarray.ndarray import apply_op
 from .. import numpy as _np
 
 __all__ = ["resize", "crop", "random_crop", "random_resized_crop",
@@ -46,12 +45,20 @@ def crop(data, x, y, width, height):
 
 def random_crop(data, xrange=(0.0, 1.0), yrange=(0.0, 1.0),
                 wrange=(0.0, 1.0), hrange=(0.0, 1.0), size=None, interp=1):
+    """Random crop; the crop extent is sampled from wrange/hrange
+    fractions of the source (reference `_image_random_crop` semantics)
+    unless an explicit pixel `size` is given."""
     from . import random_crop as _rc
 
     def one(img):
         h, w = img.shape[0], img.shape[1]
-        sz = size or (w, h)
-        out = _rc(img, sz if not isinstance(sz, int) else (sz, sz), interp)
+        if size is not None:
+            sz = (size, size) if isinstance(size, int) else size
+        else:
+            cw = int(w * _onp.random.uniform(*wrange))
+            ch = int(h * _onp.random.uniform(*hrange))
+            sz = (max(cw, 1), max(ch, 1))
+        out = _rc(img, sz, interp)
         return out[0] if isinstance(out, tuple) else out
     return _hwc(one, data)
 
@@ -122,7 +129,10 @@ def random_contrast(data, min_factor, max_factor):
 
     def fn(x):
         gray = (x * coef).sum(axis=-1, keepdims=True)
-        return x * alpha + gray.mean() * (1.0 - alpha)
+        # per-image mean (batched NHWC keeps each image's own statistic)
+        axes = tuple(range(x.ndim - 3, x.ndim))
+        mean = gray.mean(axis=axes, keepdims=True)
+        return x * alpha + mean * (1.0 - alpha)
     return apply_op(fn, (data,), {}, name="image_random_contrast")
 
 

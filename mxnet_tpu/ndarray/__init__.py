@@ -41,3 +41,13 @@ from . import legacy_ops as op  # noqa: E402,F401  (mx.nd.op alias)
 
 # `nd.image` op namespace (parity: `python/mxnet/ndarray/image.py`)
 from ..image import _npx_image as image  # noqa: E402,F401
+
+
+def __getattr__(name):
+    # `mx.nd.contrib` (reference spelling) — resolved lazily to avoid a
+    # circular import (contrib's ops import this package at init)
+    if name == "contrib":
+        from .. import contrib as _contrib
+        return _contrib.op
+    raise AttributeError(f"module 'mxnet_tpu.ndarray' has no attribute "
+                         f"{name!r}")

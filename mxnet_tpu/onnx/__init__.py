@@ -76,7 +76,15 @@ def export_model(model, path: str, example_inputs=None, input_names=None,
         return tuple(o._data if isinstance(o, ndarray) else o
                      for o in leaves)
 
-    closed = jax.make_jaxpr(fn)(params, *[x._data for x in example_inputs])
+    # trace the pure-math attention path: pallas_call has no ONNX op
+    from ..ops import attention as _attn
+    prev = _attn._force_reference[0]
+    _attn._force_reference[0] = True
+    try:
+        closed = jax.make_jaxpr(fn)(params,
+                                    *[x._data for x in example_inputs])
+    finally:
+        _attn._force_reference[0] = prev
     # invars order = tree-flatten of the params dict (sorted keys), then xs
     flat_names = sorted(params)
     param_vals = {n: _onp.asarray(params[n]) for n in flat_names}

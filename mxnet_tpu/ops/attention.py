@@ -44,7 +44,14 @@ def reference_attention(q, k, v, mask=None, causal=False, scale=None,
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
+# exporters (ONNX) set this to trace the pure-math attention instead of
+# the Pallas kernel — `pallas_call` has no serializable op equivalent
+_force_reference = [False]
+
+
 def _use_pallas() -> bool:
+    if _force_reference[0]:
+        return False
     if getenv_bool("MXTPU_DISABLE_FLASH", False):
         return False
     if getenv_bool("MXTPU_PALLAS_INTERPRET", False):

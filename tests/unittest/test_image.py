@@ -138,3 +138,22 @@ def test_npx_image_op_namespace():
     assert jit.shape == img.shape
     lit = mx.npx.image.random_lighting(img, 0.05)
     assert lit.shape == img.shape
+
+
+def test_npx_image_random_crop_ranges_and_contrast_batching():
+    rng = onp.random.RandomState(0)
+    img = mx.np.array((rng.rand(20, 20, 3)).astype("float32"))
+    onp.random.seed(0)
+    out = mx.npx.image.random_crop(img, wrange=(0.5, 0.5),
+                                   hrange=(0.5, 0.5))
+    assert out.shape == (10, 10, 3)
+
+    # per-image contrast statistics: a dark and a bright image batched
+    dark = onp.zeros((4, 4, 3), dtype="float32")
+    bright = onp.ones((4, 4, 3), dtype="float32")
+    batch = mx.np.array(onp.stack([dark, bright]))
+    onp.random.seed(1)
+    out_b = mx.npx.image.random_contrast(batch, 0.5, 0.5).asnumpy()
+    # each image blends toward ITS OWN mean: dark stays 0, bright stays ~1
+    onp.testing.assert_allclose(out_b[0], 0.0, atol=1e-6)
+    onp.testing.assert_allclose(out_b[1], 1.0, atol=1e-5)

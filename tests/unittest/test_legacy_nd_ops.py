@@ -301,3 +301,14 @@ def test_legacy_reshape_reverse():
     # reverse: spec applied right-to-left; (-1, 4) -> last dim 4, infer rest
     got = nd.reshape(x, (-1, 4), reverse=True)
     assert got.shape == (6, 4)
+
+
+def test_nd_contrib_namespace():
+    """`mx.nd.contrib` resolves to the contrib op surface (reference
+    spelling used by detection examples)."""
+    assert mx.nd.contrib.box_nms is not None
+    assert mx.nd.contrib.box_iou is not None
+    b1 = mx.np.array(onp.array([[0., 0., 2., 2.]], dtype="float32"))
+    b2 = mx.np.array(onp.array([[1., 1., 3., 3.]], dtype="float32"))
+    iou = mx.nd.contrib.box_iou(b1, b2)
+    onp.testing.assert_allclose(iou.asnumpy(), [[1.0 / 7.0]], rtol=1e-5)
