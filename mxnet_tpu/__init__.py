@@ -35,6 +35,7 @@ from . import random  # noqa: F401
 from . import initializer  # noqa: F401
 from . import initializer as init  # noqa: F401
 from . import optimizer  # noqa: F401
+from .optimizer import lr_scheduler  # noqa: F401  (mx.lr_scheduler parity)
 from . import engine  # noqa: F401
 from . import gluon  # noqa: F401
 from . import kvstore  # noqa: F401
@@ -58,6 +59,16 @@ from . import onnx  # noqa: F401
 from . import library  # noqa: F401
 from . import subgraph  # noqa: F401
 from . import elastic  # noqa: F401
+from . import context  # noqa: F401  (legacy 1.x spelling of device)
+from . import error  # noqa: F401
+from . import log  # noqa: F401
+from . import name  # noqa: F401
+from . import attribute  # noqa: F401
+from . import dlpack  # noqa: F401
+from . import rtc  # noqa: F401
+from . import callback  # noqa: F401
+from .context import Context  # noqa: F401
+from . import runtime as libinfo  # noqa: F401  (feature discovery alias)
 from . import benchmark  # noqa: F401
 from . import _native  # noqa: F401
 

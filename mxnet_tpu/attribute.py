@@ -1,0 +1,39 @@
+"""`mx.attribute` (parity: `python/mxnet/attribute.py`): scoped symbol
+attributes (AttrScope)."""
+import threading
+
+__all__ = ["AttrScope", "current"]
+
+
+class AttrScope:
+    _state = threading.local()
+
+    def __init__(self, **kwargs):
+        self._attr = kwargs
+        self._old = None
+
+    def get(self, attr=None):
+        out = dict(self._attr)
+        if attr:
+            out.update(attr)
+        return out
+
+    def __enter__(self):
+        if not hasattr(AttrScope._state, "stack"):
+            AttrScope._state.stack = [AttrScope()]
+        parent = AttrScope._state.stack[-1]
+        merged = dict(parent._attr)
+        merged.update(self._attr)
+        self._attr = merged
+        AttrScope._state.stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        AttrScope._state.stack.pop()
+        return False
+
+
+def current():
+    if not hasattr(AttrScope._state, "stack"):
+        AttrScope._state.stack = [AttrScope()]
+    return AttrScope._state.stack[-1]

@@ -1,0 +1,100 @@
+"""`mx.callback` (parity: `python/mxnet/callback.py`): training callbacks
+for epoch/batch hooks. Usable with any loop that passes the reference's
+`(epoch, nbatch, eval_metric)` param object."""
+from __future__ import annotations
+
+import logging
+import time
+
+__all__ = ["Speedometer", "ProgressBar", "do_checkpoint",
+           "log_train_metric", "LogValidationMetricsCallback"]
+
+
+def do_checkpoint(prefix, period=1):
+    """Epoch-end callback: save block params every `period` epochs."""
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None, block=None):
+        if (iter_no + 1) % period == 0 and block is not None:
+            block.save_parameters(f"{prefix}-{iter_no + 1:04d}.params")
+    return _callback
+
+
+def log_train_metric(period, auto_reset=False):
+    def _callback(param):
+        if param.nbatch % period == 0 and param.eval_metric is not None:
+            name_value = param.eval_metric.get_name_value() \
+                if hasattr(param.eval_metric, "get_name_value") else \
+                [param.eval_metric.get()]
+            for name, value in name_value:
+                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
+                             param.epoch, param.nbatch, name, value)
+            if auto_reset:
+                param.eval_metric.reset()
+    return _callback
+
+
+class Speedometer:
+    """Log samples/sec every `frequent` batches."""
+
+    def __init__(self, batch_size, frequent=50, auto_reset=True):
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self.auto_reset = auto_reset
+        self.init = False
+        self.tic = 0.0
+        self.last_count = 0
+
+    def __call__(self, param):
+        count = param.nbatch
+        if self.last_count > count:
+            self.init = False
+        self.last_count = count
+        if self.init:
+            if count % self.frequent == 0:
+                speed = self.frequent * self.batch_size / \
+                    max(time.time() - self.tic, 1e-12)
+                if param.eval_metric is not None:
+                    nv = param.eval_metric.get_name_value() \
+                        if hasattr(param.eval_metric, "get_name_value") \
+                        else [param.eval_metric.get()]
+                    msg = " ".join(f"{n}={v:.6f}" for n, v in nv)
+                    logging.info("Epoch[%d] Batch [%d] Speed: %.2f "
+                                 "samples/sec %s", param.epoch, count,
+                                 speed, msg)
+                    if self.auto_reset:
+                        param.eval_metric.reset()
+                else:
+                    logging.info("Epoch[%d] Batch [%d] Speed: %.2f "
+                                 "samples/sec", param.epoch, count, speed)
+                self.tic = time.time()
+        else:
+            self.init = True
+            self.tic = time.time()
+
+
+class ProgressBar:
+    """Text progress bar over `total` batches."""
+
+    def __init__(self, total, length=80):
+        self.bar_len = length
+        self.total = total
+
+    def __call__(self, param):
+        count = param.nbatch
+        filled = int(round(self.bar_len * count / float(self.total)))
+        pct = round(100.0 * count / float(self.total), 1)
+        bar = "=" * filled + "-" * (self.bar_len - filled)
+        logging.info("[%s] %s%%", bar, pct)
+
+
+class LogValidationMetricsCallback:
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        nv = param.eval_metric.get_name_value() \
+            if hasattr(param.eval_metric, "get_name_value") else \
+            [param.eval_metric.get()]
+        for name, value in nv:
+            logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name,
+                         value)

@@ -1,0 +1,41 @@
+"""`mx.dlpack` (parity: dlpack interop in `python/mxnet/dlpack.py`)."""
+from .ndarray.ndarray import ndarray, from_jax
+
+__all__ = ["to_dlpack_for_read", "to_dlpack_for_write", "from_dlpack"]
+
+
+def to_dlpack_for_read(arr: ndarray):
+    """Return a dlpack-protocol object (modern consumers — torch, numpy,
+    jax — accept these directly; the reference returned a raw capsule)."""
+    arr.wait_to_read()
+    return arr._data
+
+
+def to_dlpack_for_write(arr: ndarray):
+    """NOTE: unlike the reference, the exported buffer is immutable (jax
+    arrays are functional) — consumer writes do NOT alias back into
+    `arr`. Kept for API parity; use the read form + explicit copy-back
+    for mutation."""
+    arr.wait_to_write()
+    return arr._data
+
+
+class _CapsuleWrapper:
+    """Adapt a raw DLPack capsule (legacy producers) to the protocol."""
+
+    def __init__(self, capsule):
+        self._capsule = capsule
+
+    def __dlpack__(self, **kwargs):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        return (1, 0)  # kDLCPU — legacy capsules carry no device info
+
+
+def from_dlpack(obj) -> ndarray:
+    import jax.numpy as jnp
+    from .device import current_device
+    if not hasattr(obj, "__dlpack__"):
+        obj = _CapsuleWrapper(obj)
+    return from_jax(jnp.from_dlpack(obj), current_device())

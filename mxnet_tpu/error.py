@@ -1,0 +1,43 @@
+"""`mx.error` (parity: `python/mxnet/error.py`): typed error classes over
+MXNetError with a registry keyed by error-type name."""
+from .base import MXNetError
+
+_ERROR_TYPES = {}
+
+
+def register_error(name_or_cls=None, cls=None):
+    """Register an error class: decorator (`@register_error`), named
+    decorator factory (`@register_error("Name")`), or direct call
+    (`register_error("Name", SomeError)`)."""
+    if isinstance(name_or_cls, str):
+        name = name_or_cls
+        if cls is not None:
+            _ERROR_TYPES[name] = cls
+            return cls
+
+        def _named(c):
+            _ERROR_TYPES[name] = c
+            return c
+        return _named
+
+    def _do(c):
+        _ERROR_TYPES[c.__name__] = c
+        return c
+    return _do(name_or_cls) if name_or_cls is not None else _do
+
+
+register = register_error
+
+
+@register_error
+class InternalError(MXNetError):
+    """Framework-internal invariant violation."""
+
+
+for _name, _cls in [("ValueError", ValueError), ("TypeError", TypeError),
+                    ("AttributeError", AttributeError),
+                    ("IndexError", IndexError),
+                    ("NotImplementedError", NotImplementedError),
+                    ("IOError", IOError),
+                    ("FloatingPointError", FloatingPointError)]:
+    register_error(_name, _cls)

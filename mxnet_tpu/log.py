@@ -1,0 +1,24 @@
+"""`mx.log` (parity: `python/mxnet/log.py`): logging helpers."""
+import logging
+
+DEBUG = logging.DEBUG
+INFO = logging.INFO
+WARNING = logging.WARNING
+ERROR = logging.ERROR
+CRITICAL = logging.CRITICAL
+
+
+def get_logger(name=None, filename=None, filemode=None, level=WARNING):
+    logger = logging.getLogger(name)
+    if getattr(logger, "_mxtpu_init_done", False):
+        return logger  # don't stack handlers on repeated calls
+    logger._mxtpu_init_done = True
+    if filename:
+        handler = logging.FileHandler(filename, filemode or "a")
+    else:
+        handler = logging.StreamHandler()
+    handler.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname)s %(name)s: %(message)s"))
+    logger.addHandler(handler)
+    logger.setLevel(level)
+    return logger

@@ -1,0 +1,48 @@
+"""`mx.name` (parity: `python/mxnet/name.py`): name-manager scopes that
+assign unique names to symbols/blocks created without explicit names."""
+import threading
+
+__all__ = ["NameManager", "Prefix", "current"]
+
+
+class NameManager:
+    _state = threading.local()
+
+    def __init__(self):
+        self._counter = {}
+        self._old = None
+
+    def get(self, name, hint):
+        if name:
+            return name
+        if hint not in self._counter:
+            self._counter[hint] = 0
+        name = f"{hint}{self._counter[hint]}"
+        self._counter[hint] += 1
+        return name
+
+    def __enter__(self):
+        if not hasattr(NameManager._state, "stack"):
+            NameManager._state.stack = [NameManager()]
+        self._old = NameManager._state.stack[-1]
+        NameManager._state.stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        NameManager._state.stack.pop()
+        return False
+
+
+class Prefix(NameManager):
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        return name if name else self._prefix + super().get(None, hint)
+
+
+def current():
+    if not hasattr(NameManager._state, "stack"):
+        NameManager._state.stack = [NameManager()]
+    return NameManager._state.stack[-1]

@@ -1,0 +1,14 @@
+"""`mx.rtc` (parity surface for `python/mxnet/rtc.py`): CUDA runtime
+compilation has no TPU analog — XLA owns codegen (SURVEY §7 maps RTC to
+XLA fusion; custom kernels are Pallas, `mxnet_tpu/ops/pallas/`)."""
+from .base import MXNetError
+
+__all__ = ["CudaModule"]
+
+
+class CudaModule:
+    def __init__(self, *args, **kwargs):
+        raise MXNetError(
+            "CUDA RTC is not available on the TPU backend: XLA compiles "
+            "all kernels. For custom kernels write Pallas "
+            "(mxnet_tpu/ops/pallas) or use mx.operator.CustomOp.")

@@ -1,0 +1,90 @@
+"""Top-level legacy-module parity (`mx.context`, `mx.callback`,
+`mx.error`, `mx.name`, `mx.attribute`, `mx.dlpack`, `mx.log`, `mx.rtc`)."""
+import logging
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_context_aliases():
+    assert mx.context.Context is mx.device.Device
+    assert mx.Context is mx.device.Device
+    assert mx.context.cpu_pinned is mx.device.cpu_pinned  # true alias
+    assert mx.context.current_context() is not None
+
+
+def test_error_registry():
+    with pytest.raises(mx.MXNetError):
+        raise mx.error.InternalError("boom")
+    assert mx.error._ERROR_TYPES["ValueError"] is ValueError
+
+    @mx.error.register
+    class CustomThing(mx.MXNetError):
+        pass
+    assert mx.error._ERROR_TYPES["CustomThing"] is CustomThing
+
+
+def test_name_manager_scopes():
+    base = mx.name.current().get(None, "dense")
+    with mx.name.Prefix("enc_"):
+        n1 = mx.name.current().get(None, "dense")
+        assert n1.startswith("enc_dense")
+    n2 = mx.name.current().get(None, "dense")
+    assert not n2.startswith("enc_")
+    assert mx.name.current().get("explicit", "dense") == "explicit"
+
+
+def test_attr_scope_nesting():
+    with mx.attribute.AttrScope(lr_mult="2"):
+        assert mx.attribute.current().get()["lr_mult"] == "2"
+        with mx.attribute.AttrScope(wd_mult="0"):
+            attrs = mx.attribute.current().get()
+            assert attrs["lr_mult"] == "2" and attrs["wd_mult"] == "0"
+    assert "lr_mult" not in mx.attribute.current().get()
+
+
+def test_dlpack_roundtrip():
+    a = mx.np.array(onp.arange(6.0, dtype="float32").reshape(2, 3))
+    cap = mx.dlpack.to_dlpack_for_read(a)
+    b = mx.dlpack.from_dlpack(cap)
+    onp.testing.assert_allclose(b.asnumpy(), a.asnumpy())
+
+
+def test_rtc_raises_documented_error():
+    with pytest.raises(mx.MXNetError, match="Pallas"):
+        mx.rtc.CudaModule("__global__ void f() {}")
+
+
+def test_callbacks_drive(caplog, tmp_path):
+    class Param:
+        def __init__(self, epoch, nbatch, metric):
+            self.epoch = epoch
+            self.nbatch = nbatch
+            self.eval_metric = metric
+
+    m = mx.gluon.metric.Accuracy()
+    m.update([mx.np.array([1, 0])], [mx.np.array([[0.1, 0.9],
+                                                  [0.8, 0.2]])])
+    speed = mx.callback.Speedometer(batch_size=4, frequent=1,
+                                    auto_reset=False)
+    with caplog.at_level(logging.INFO):
+        speed(Param(0, 0, m))   # init
+        speed(Param(0, 1, m))   # logs
+        mx.callback.log_train_metric(1)(Param(0, 1, m))
+        mx.callback.ProgressBar(total=4)(Param(0, 2, m))
+        mx.callback.LogValidationMetricsCallback()(Param(0, 2, m))
+    text = caplog.text
+    assert "Speed" in text and "accuracy" in text and "50.0%" in text
+
+    # do_checkpoint saves block params
+    net = mx.gluon.nn.Dense(2, in_units=2)
+    net.initialize()
+    cb = mx.callback.do_checkpoint(str(tmp_path / "model"), period=1)
+    cb(0, block=net)
+    assert (tmp_path / "model-0001.params").exists()
+
+
+def test_libinfo_alias():
+    assert mx.libinfo is mx.runtime

@@ -126,3 +126,28 @@ def test_optimizer_with_scheduler_in_trainer_updates_num_update():
     o.update(0, w, mx.np.array(onp.array([0.0], onp.float32)), st)
     o.update(0, w, mx.np.array(onp.array([0.0], onp.float32)), st)
     assert o.num_update == 2
+
+
+def test_trainer_with_lr_scheduler_end_to_end():
+    """Trainer + lr_scheduler integration (mx.lr_scheduler top-level
+    alias, reference spelling): the effective LR follows the schedule
+    across steps."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, autograd
+
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5,
+                                            base_lr=0.4)
+    net = gluon.nn.Dense(1, in_units=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.4, "lr_scheduler": sched})
+    x = mx.np.array(onp.ones((4, 2), dtype="float32"))
+    lrs = []
+    for _ in range(6):
+        with autograd.record():
+            loss = (net(x) ** 2).mean()
+        loss.backward()
+        tr.step(4)
+        lrs.append(tr.learning_rate)
+    assert lrs[0] == pytest.approx(0.4)
+    assert lrs[-1] < lrs[0]  # decayed by the factor schedule
