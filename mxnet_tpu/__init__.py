@@ -67,6 +67,7 @@ from . import attribute  # noqa: F401
 from . import dlpack  # noqa: F401
 from . import rtc  # noqa: F401
 from . import callback  # noqa: F401
+from . import model  # noqa: F401
 from .context import Context  # noqa: F401
 from . import runtime as libinfo  # noqa: F401  (feature discovery alias)
 from . import benchmark  # noqa: F401

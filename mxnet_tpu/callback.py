@@ -11,12 +11,20 @@ __all__ = ["Speedometer", "ProgressBar", "do_checkpoint",
 
 
 def do_checkpoint(prefix, period=1):
-    """Epoch-end callback: save block params every `period` epochs."""
+    """Epoch-end callback: save every `period` epochs. Accepts both the
+    reference convention `cb(epoch, sym, arg_params, aux_params)` (saved
+    via `mx.model.save_checkpoint`) and the Gluon form
+    `cb(epoch, block=net)`."""
     period = int(max(1, period))
 
     def _callback(iter_no, sym=None, arg=None, aux=None, block=None):
-        if (iter_no + 1) % period == 0 and block is not None:
+        if (iter_no + 1) % period:
+            return
+        if block is not None:
             block.save_parameters(f"{prefix}-{iter_no + 1:04d}.params")
+        elif arg is not None or aux is not None or sym is not None:
+            from .model import save_checkpoint
+            save_checkpoint(prefix, iter_no + 1, sym, arg or {}, aux or {})
     return _callback
 
 

@@ -1,7 +1,5 @@
 """Legacy `mx.context` module (parity: `python/mxnet/context.py` — the
 1.x spelling; 2.x renamed it `device`). Pure aliases."""
-from .device import (Device, cpu, cpu_pinned, gpu, tpu,  # noqa: F401
-                     num_gpus, num_tpus, current_device)
-
-Context = Device
-current_context = current_device
+from .device import (Device, Context, cpu, cpu_pinned, gpu,  # noqa: F401
+                     tpu, num_gpus, num_tpus, current_device,
+                     current_context)

@@ -34,10 +34,13 @@ class InternalError(MXNetError):
     """Framework-internal invariant violation."""
 
 
-for _name, _cls in [("ValueError", ValueError), ("TypeError", TypeError),
-                    ("AttributeError", AttributeError),
-                    ("IndexError", IndexError),
-                    ("NotImplementedError", NotImplementedError),
-                    ("IOError", IOError),
-                    ("FloatingPointError", FloatingPointError)]:
-    register_error(_name, _cls)
+# typed duals (reference semantics): each subclasses BOTH MXNetError and
+# the builtin, so `except mx.error.ValueError` and `except MXNetError`
+# and `except ValueError` all catch it
+for _builtin in (ValueError, TypeError, AttributeError, IndexError,
+                 NotImplementedError, IOError, FloatingPointError):
+    _typed = type(_builtin.__name__, (MXNetError, _builtin),
+                  {"__doc__": f"MXNetError specialized as "
+                              f"{_builtin.__name__}."})
+    globals()[_builtin.__name__] = _typed
+    register_error(_builtin.__name__, _typed)

@@ -11,8 +11,10 @@ CRITICAL = logging.CRITICAL
 def get_logger(name=None, filename=None, filemode=None, level=WARNING):
     logger = logging.getLogger(name)
     if getattr(logger, "_mxtpu_init_done", False):
-        return logger  # don't stack handlers on repeated calls
+        logger.setLevel(level)  # honor the new level, but don't stack
+        return logger           # another handler
     logger._mxtpu_init_done = True
+    logger.propagate = False  # the handler added here is the only sink
     if filename:
         handler = logging.FileHandler(filename, filemode or "a")
     else:

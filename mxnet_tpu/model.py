@@ -1,0 +1,58 @@
+"""`mx.model` (parity: `python/mxnet/model.py` — 2.x keeps the
+checkpoint helpers + BatchEndParam; the Module API itself was removed
+upstream)."""
+from __future__ import annotations
+
+from collections import namedtuple
+
+from .base import MXNetError
+from .util import save_arrays, load_arrays
+
+__all__ = ["BatchEndParam", "save_checkpoint", "load_params",
+           "load_checkpoint"]
+
+BatchEndParam = namedtuple("BatchEndParams",
+                           ["epoch", "nbatch", "eval_metric", "locals"])
+BatchEndParam.__new__.__defaults__ = (None,)
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    """Save `prefix-symbol.json`-era checkpoints: the traced graph (via
+    Symbol.save when given) plus `prefix-<epoch>.params` with arg:/aux:
+    prefixes (reference on-disk layout)."""
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    out = {}
+    for k, v in (arg_params or {}).items():
+        out["arg:" + k] = v
+    for k, v in (aux_params or {}).items():
+        out["aux:" + k] = v
+    save_arrays(f"{prefix}-{epoch:04d}.params", out)
+
+
+def load_params(prefix, epoch):
+    """Returns (arg_params, aux_params) from `prefix-<epoch>.params`."""
+    raw = load_arrays(f"{prefix}-{epoch:04d}.params")
+    arg, aux = {}, {}
+    for k, v in raw.items():
+        if k.startswith("arg:"):
+            arg[k[4:]] = v
+        elif k.startswith("aux:"):
+            aux[k[4:]] = v
+        else:
+            arg[k] = v
+    return arg, aux
+
+
+def load_checkpoint(prefix, epoch):
+    """Returns (symbol, arg_params, aux_params); symbol is None when no
+    symbol file exists (Gluon-era checkpoints)."""
+    import os
+    sym = None
+    sym_file = f"{prefix}-symbol.json"
+    if os.path.exists(sym_file):
+        from .symbol.symbol import load as sym_load
+        sym = sym_load(sym_file)
+    arg, aux = load_params(prefix, epoch)
+    return sym, arg, aux

@@ -39,7 +39,8 @@ class Prefix(NameManager):
         self._prefix = prefix
 
     def get(self, name, hint):
-        return name if name else self._prefix + super().get(None, hint)
+        # the reference prefixes EXPLICIT names too (name.py Prefix.get)
+        return self._prefix + (name if name else super().get(None, hint))
 
 
 def current():

@@ -18,7 +18,11 @@ def test_context_aliases():
 def test_error_registry():
     with pytest.raises(mx.MXNetError):
         raise mx.error.InternalError("boom")
-    assert mx.error._ERROR_TYPES["ValueError"] is ValueError
+    # typed duals: catchable as MXNetError AND as the builtin
+    with pytest.raises(mx.MXNetError):
+        raise mx.error.ValueError("boom")
+    with pytest.raises(ValueError):
+        raise mx.error.ValueError("boom")
 
     @mx.error.register
     class CustomThing(mx.MXNetError):
@@ -34,6 +38,9 @@ def test_name_manager_scopes():
     n2 = mx.name.current().get(None, "dense")
     assert not n2.startswith("enc_")
     assert mx.name.current().get("explicit", "dense") == "explicit"
+    with mx.name.Prefix("enc_"):
+        # the reference prefixes explicit names too
+        assert mx.name.current().get("w", "dense") == "enc_w"
 
 
 def test_attr_scope_nesting():
@@ -84,7 +91,31 @@ def test_callbacks_drive(caplog, tmp_path):
     cb = mx.callback.do_checkpoint(str(tmp_path / "model"), period=1)
     cb(0, block=net)
     assert (tmp_path / "model-0001.params").exists()
+    # reference positional convention: (epoch, sym, arg, aux)
+    cb(1, None, {"w": mx.np.array(onp.ones(2, dtype="float32"))}, {})
+    assert (tmp_path / "model-0002.params").exists()
 
 
 def test_libinfo_alias():
     assert mx.libinfo is mx.runtime
+
+
+def test_model_checkpoint_helpers(tmp_path):
+    """mx.model save/load_checkpoint round-trip (ref `model.py:189,221,238`
+    on-disk layout: arg:/aux: prefixes + optional symbol json)."""
+    import mxnet_tpu as mx
+    prefix = str(tmp_path / "net")
+    arg = {"fc_weight": mx.np.array(onp.ones((2, 3), dtype="float32"))}
+    aux = {"bn_mean": mx.np.array(onp.zeros(3, dtype="float32"))}
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    s = a + b
+    mx.model.save_checkpoint(prefix, 3, s, arg, aux)
+    sym2, arg2, aux2 = mx.model.load_checkpoint(prefix, 3)
+    assert sym2 is not None
+    onp.testing.assert_allclose(arg2["fc_weight"].asnumpy(),
+                                arg["fc_weight"].asnumpy())
+    onp.testing.assert_allclose(aux2["bn_mean"].asnumpy(),
+                                aux["bn_mean"].asnumpy())
+    p = mx.model.BatchEndParam(epoch=1, nbatch=2, eval_metric=None)
+    assert p.epoch == 1 and p.locals is None
