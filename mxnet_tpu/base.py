@@ -29,9 +29,12 @@ class Registry(Generic[T]):
     equivalent used across the package.
     """
 
+    _instances: list = []  # all registries, for mx.registry discovery
+
     def __init__(self, name: str):
         self.name = name
         self._store: Dict[str, T] = {}
+        Registry._instances.append(self)
 
     def register(self, obj: Optional[T] = None, name: Optional[str] = None, *, aliases=()):
         def _do(o, nm):

@@ -1,0 +1,6 @@
+"""`mx.executor` (parity: `python/mxnet/executor.py`): the legacy
+Executor type lives with the symbol front end; this module re-exports it
+at the reference's path."""
+from .symbol.symbol import Executor  # noqa: F401
+
+__all__ = ["Executor"]

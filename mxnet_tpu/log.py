@@ -8,11 +8,12 @@ ERROR = logging.ERROR
 CRITICAL = logging.CRITICAL
 
 
-def get_logger(name=None, filename=None, filemode=None, level=WARNING):
+def get_logger(name=None, filename=None, filemode=None, level=None):
     logger = logging.getLogger(name)
     if getattr(logger, "_mxtpu_init_done", False):
-        logger.setLevel(level)  # honor the new level, but don't stack
-        return logger           # another handler
+        if level is not None:   # only an explicit level overrides
+            logger.setLevel(level)
+        return logger           # never stack another handler
     logger._mxtpu_init_done = True
     logger.propagate = False  # the handler added here is the only sink
     if filename:
@@ -22,5 +23,5 @@ def get_logger(name=None, filename=None, filemode=None, level=WARNING):
     handler.setFormatter(logging.Formatter(
         "%(asctime)s %(levelname)s %(name)s: %(message)s"))
     logger.addHandler(handler)
-    logger.setLevel(level)
+    logger.setLevel(WARNING if level is None else level)
     return logger

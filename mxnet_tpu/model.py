@@ -16,12 +16,33 @@ BatchEndParam = namedtuple("BatchEndParams",
 BatchEndParam.__new__.__defaults__ = (None,)
 
 
+def _strip_amp_cast(node, _memo=None):
+    """Rewrite the Symbol DAG without amp_cast/amp_multicast nodes
+    (reference `remove_amp_cast` semantics: checkpoints load clean for
+    full-precision inference)."""
+    memo = _memo if _memo is not None else {}
+    if id(node) in memo:
+        return memo[id(node)]
+    new_inputs = [_strip_amp_cast(i, memo) for i in node.inputs]
+    if node.op in ("amp_cast", "amp_multicast"):
+        out = new_inputs[0]
+    else:
+        from .symbol.symbol import Symbol
+        out = Symbol(node.op, node.name, new_inputs, node.attrs,
+                     node._out_index)
+    memo[id(node)] = out
+    return out
+
+
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
                     remove_amp_cast=True):
     """Save `prefix-symbol.json`-era checkpoints: the traced graph (via
-    Symbol.save when given) plus `prefix-<epoch>.params` with arg:/aux:
+    Symbol.save when given; amp_cast nodes stripped when
+    `remove_amp_cast`) plus `prefix-<epoch>.params` with arg:/aux:
     prefixes (reference on-disk layout)."""
     if symbol is not None:
+        if remove_amp_cast:
+            symbol = _strip_amp_cast(symbol)
         symbol.save(f"{prefix}-symbol.json")
     out = {}
     for k, v in (arg_params or {}).items():

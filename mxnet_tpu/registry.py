@@ -1,0 +1,74 @@
+"""`mx.registry` (parity: `python/mxnet/registry.py`): generic
+register/alias/create machinery for named-class registries — the factory
+behind `mx.optimizer.create('adam')`-style lookups."""
+from __future__ import annotations
+
+from .base import MXNetError, Registry
+
+__all__ = ["get_registry", "get_register_func", "get_alias_func",
+           "get_create_func"]
+
+_LOCAL: dict = {}
+
+
+def _store_for(base_class):
+    """The live name->class store for `base_class`: an existing
+    base.Registry whose entries subclass it (so the package's own
+    optimizer/initializer/metric registries are visible here), else a
+    module-local store."""
+    for reg in Registry._instances:
+        vals = [v for v in reg._store.values() if isinstance(v, type)]
+        if vals and all(issubclass(v, base_class) for v in vals):
+            return reg._store
+    return _LOCAL.setdefault(base_class, {})
+
+
+def get_registry(base_class):
+    """A copy of the name -> class registry for `base_class`."""
+    return dict(_store_for(base_class))
+
+
+def get_register_func(base_class, nickname):
+    reg = _store_for(base_class)
+
+    def register(klass, name=None):
+        if not issubclass(klass, base_class):
+            raise MXNetError(
+                f"can only register subclasses of {base_class.__name__}, "
+                f"got {klass!r}")
+        key = (name or klass.__name__).lower()
+        reg[key] = klass
+        return klass
+    register.__name__ = f"register_{nickname}"
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    register = get_register_func(base_class, nickname)
+
+    def alias(*aliases):
+        def reg(klass):
+            for a in aliases:
+                register(klass, a)
+            return klass
+        return reg
+    alias.__name__ = f"alias_{nickname}"
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    reg = _store_for(base_class)
+
+    def create(*args, **kwargs):
+        if args and isinstance(args[0], base_class):
+            return args[0]
+        if not args or not isinstance(args[0], str):
+            raise MXNetError(f"first argument must be a {nickname} name")
+        name, args = args[0].lower(), args[1:]
+        if name not in reg:
+            raise MXNetError(
+                f"{nickname} {name!r} is not registered; known: "
+                f"{sorted(reg)}")
+        return reg[name](*args, **kwargs)
+    create.__name__ = f"create_{nickname}"
+    return create

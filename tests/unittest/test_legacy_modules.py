@@ -119,3 +119,38 @@ def test_model_checkpoint_helpers(tmp_path):
                                 aux["bn_mean"].asnumpy())
     p = mx.model.BatchEndParam(epoch=1, nbatch=2, eval_metric=None)
     assert p.epoch == 1 and p.locals is None
+
+
+def test_executor_module_alias():
+    import mxnet_tpu as mx
+    assert mx.executor.Executor is not None
+    a = mx.sym.Variable("a")
+    out = (a * 2.0).bind(mx.cpu(),
+                         {"a": mx.np.array(onp.ones(3, dtype="float32"))})
+    assert isinstance(out, mx.executor.Executor)
+
+
+def test_registry_machinery():
+    import mxnet_tpu as mx
+
+    class Base:
+        pass
+
+    register = mx.registry.get_register_func(Base, "thing")
+    alias = mx.registry.get_alias_func(Base, "thing")
+    create = mx.registry.get_create_func(Base, "thing")
+
+    @alias("t1", "first")
+    class Thing1(Base):
+        def __init__(self, x=1):
+            self.x = x
+
+    register(Thing1)
+    assert create("t1").x == 1
+    assert create("First", 5).x == 5        # case-insensitive alias
+    assert isinstance(create(Thing1()), Thing1)
+    assert "thing1" in mx.registry.get_registry(Base)
+    with pytest.raises(mx.MXNetError, match="not registered"):
+        create("nope")
+    with pytest.raises(mx.MXNetError, match="subclasses"):
+        register(dict)
