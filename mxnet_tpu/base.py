@@ -29,12 +29,13 @@ class Registry(Generic[T]):
     equivalent used across the package.
     """
 
-    _instances: list = []  # all registries, for mx.registry discovery
+    _instances: list = []  # weakrefs to registries (mx.registry discovery)
 
     def __init__(self, name: str):
+        import weakref
         self.name = name
         self._store: Dict[str, T] = {}
-        Registry._instances.append(self)
+        Registry._instances.append(weakref.ref(self))
 
     def register(self, obj: Optional[T] = None, name: Optional[str] = None, *, aliases=()):
         def _do(o, nm):

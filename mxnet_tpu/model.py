@@ -25,7 +25,9 @@ def _strip_amp_cast(node, _memo=None):
         return memo[id(node)]
     new_inputs = [_strip_amp_cast(i, memo) for i in node.inputs]
     if node.op in ("amp_cast", "amp_multicast"):
-        out = new_inputs[0]
+        # amp_multicast output k is the cast of input k — preserve the
+        # selection when a consumer reads a non-first output
+        out = new_inputs[node._out_index or 0]
     else:
         from .symbol.symbol import Symbol
         out = Symbol(node.op, node.name, new_inputs, node.attrs,

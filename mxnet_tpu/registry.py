@@ -15,10 +15,18 @@ def _store_for(base_class):
     """The live name->class store for `base_class`: an existing
     base.Registry whose entries subclass it (so the package's own
     optimizer/initializer/metric registries are visible here), else a
-    module-local store."""
-    for reg in Registry._instances:
+    module-local store. `object` (or another universal ancestor) never
+    captures a package registry — it gets a local store."""
+    if base_class is object:
+        return _LOCAL.setdefault(base_class, {})
+    for ref in list(Registry._instances):
+        reg = ref()
+        if reg is None:
+            Registry._instances.remove(ref)
+            continue
         vals = [v for v in reg._store.values() if isinstance(v, type)]
-        if vals and all(issubclass(v, base_class) for v in vals):
+        if vals and all(issubclass(v, base_class) for v in vals) \
+                and any(base_class in v.__mro__[1:-1] for v in vals):
             return reg._store
     return _LOCAL.setdefault(base_class, {})
 

@@ -1,0 +1,116 @@
+"""`mx.visualization` (parity: `python/mxnet/visualization.py`):
+`print_summary` renders a layer table over the Symbol DAG;
+`plot_network` emits graphviz when the library is present (not baked
+into the TPU image — a documented error otherwise)."""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def _walk(symbol):
+    """Topological node order over the Symbol DAG (inputs first);
+    synthetic group nodes are skipped (their inputs stand in for them,
+    like `Symbol.get_internals`)."""
+    seen, order = set(), []
+
+    def visit(node):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for i in node.inputs:
+            visit(i)
+        if node.op != "_group":
+            order.append(node)
+    visit(symbol)
+    return order
+
+
+def print_summary(symbol, shape=None, line_length=120,
+                  positions=(.44, .64, .74, 1.)):
+    """Print a per-layer table: name(op), output shape (when input shapes
+    are given), params, and predecessors (reference `visualization.py:46`
+    layout)."""
+    pos = [int(line_length * p) for p in positions]
+    headers = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+    shapes = {}
+    if shape:
+        try:
+            args = symbol.list_arguments()
+            inferred, _, _ = symbol.infer_shape(**shape)
+            shapes = dict(zip(args, inferred))
+        except Exception:
+            shapes = dict(shape)
+
+    def row(fields):
+        line = ""
+        for f, p in zip(fields, pos):
+            line = (line + str(f))[:p - 1].ljust(p)
+        print(line)
+
+    # per-layer output shapes: evaluate each node on zeros of the
+    # inferred argument shapes (graphs handed to summaries are small)
+    node_out_shapes = {}
+    if shapes:
+        try:
+            from . import numpy as _mnp
+            from .device import cpu as _cpu
+            zeros = {n: _mnp.zeros(s) for n, s in shapes.items()}
+            for node in _walk(symbol):
+                try:
+                    args = {n: zeros[n] for n in node.list_arguments()}
+                    outs = node.bind(_cpu(), args).forward()
+                    first = outs[0] if isinstance(outs, (list, tuple)) \
+                        else outs
+                    node_out_shapes[node.name] = tuple(first.shape)
+                except Exception:
+                    pass
+        except Exception:
+            pass
+
+    print("=" * line_length)
+    row(headers)
+    print("=" * line_length)
+    total_params = 0
+    for node in _walk(symbol):
+        if node.op is None and node.name in shapes:
+            import numpy as _onp
+            n_par = int(_onp.prod(shapes[node.name])) \
+                if node.name not in (shape or {}) else 0
+        else:
+            n_par = 0
+        total_params += n_par
+        out_shape = node_out_shapes.get(node.name,
+                                        shapes.get(node.name, ""))
+        prev = ",".join(i.name for i in node.inputs)
+        kind = node.op or "null"
+        row([f"{node.name}({kind})", out_shape, n_par, prev])
+    print("=" * line_length)
+    print(f"Total params: {total_params}")
+    print("=" * line_length)
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 dtype=None, node_attrs=None, hide_weights=True):
+    """Graphviz rendering of the Symbol DAG (reference plot_network).
+    Requires the optional `graphviz` package."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise MXNetError(
+            "plot_network requires the graphviz package, which is not "
+            "baked into this image; use print_summary for a text view"
+        ) from e
+    dot = Digraph(name=title, format=save_format)
+    for node in _walk(symbol):
+        if hide_weights and node.op is None and \
+                ("weight" in node.name or "bias" in node.name):
+            continue
+        dot.node(node.name, f"{node.name}\n{node.op or 'input'}")
+        for i in node.inputs:
+            if hide_weights and i.op is None and \
+                    ("weight" in i.name or "bias" in i.name):
+                continue
+            dot.edge(i.name, node.name)
+    return dot

@@ -154,3 +154,21 @@ def test_registry_machinery():
         create("nope")
     with pytest.raises(mx.MXNetError, match="subclasses"):
         register(dict)
+
+
+def test_visualization_print_summary(capsys):
+    import mxnet_tpu as mx
+    a = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+    y = a * w + 1.0
+    mx.viz.print_summary(y)
+    out = capsys.readouterr().out
+    assert "Layer (type)" in out and "Total params" in out
+    assert "data(null)" in out
+    try:
+        import graphviz  # noqa: F401
+        dot = mx.viz.plot_network(y)
+        assert "data" in dot.source
+    except ImportError:
+        with pytest.raises(mx.MXNetError, match="graphviz"):
+            mx.viz.plot_network(y)
