@@ -1,0 +1,245 @@
+"""Multiprocessing DataLoader workers with shared-memory batch transport.
+
+Parity: the reference's process-pool DataLoader ships NDArrays between
+worker processes and the trainer through POSIX shared memory
+(`python/mxnet/gluon/data/dataloader.py:123-138,187,514` ForkingPickler +
+`src/storage/cpu_shared_storage_manager.h`).  The TPU build's equivalent:
+
+- workers are **spawned** (not forked): a forked child would inherit the
+  parent's initialised PjRt client — including a remote-TPU claim — which
+  is neither fork-safe nor shareable.  Each spawned worker pins JAX to the
+  CPU platform *before* any backend initialisation, so dataset transforms
+  written against `mx.np` run safely in the worker.
+- the dataset/batchify closure crosses once, at pool startup, as an opaque
+  pickle blob deserialised only after the CPU pin (ndarrays pickle via
+  their numpy values).
+- finished batches cross zero-copy: each array leaf is written to a
+  `multiprocessing.shared_memory` segment; the parent maps it, wraps it in
+  an `mx.np` array (one H2D/device_put copy — the reference's pinned-memory
+  role), and unlinks the segment.
+
+The parent preserves batch order (a reorder buffer keyed on batch id) and
+bounds each wait with the loader timeout, like the thread-pool path.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import queue as _queue_mod
+import struct
+from typing import Any, Callable
+
+import numpy as _onp
+
+__all__ = ["ProcessPool"]
+
+
+# ---------------------------------------------------------------------------
+# tree <-> shared-memory descriptors
+# ---------------------------------------------------------------------------
+
+def _to_shm(obj, segments):
+    """Replace array leaves with shared-memory descriptors (recursive)."""
+    from multiprocessing import shared_memory
+    if isinstance(obj, (tuple, list)):
+        return type(obj)(_to_shm(o, segments) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _to_shm(v, segments) for k, v in obj.items()}
+    arr = None
+    if isinstance(obj, _onp.ndarray):
+        arr = obj
+    else:
+        data = getattr(obj, "_data", None)   # mx ndarray leaf
+        if data is not None:
+            arr = _onp.asarray(data)
+    if arr is None:
+        return ("py", obj)
+    arr = _onp.ascontiguousarray(arr)
+    if arr.nbytes == 0:
+        return ("npz", arr.shape, arr.dtype.str)
+    shm = shared_memory.SharedMemory(create=True, size=arr.nbytes)
+    shm.buf[:arr.nbytes] = arr.tobytes()
+    segments.append(shm)
+    return ("shm", shm.name, arr.shape, arr.dtype.str)
+
+
+def _from_shm(spec, to_array: Callable[[_onp.ndarray], Any]):
+    """Rebuild the batch tree in the parent; unlinks each segment."""
+    from multiprocessing import shared_memory
+    if isinstance(spec, tuple) and spec and spec[0] == "py":
+        return spec[1]
+    if isinstance(spec, tuple) and spec and spec[0] == "npz":
+        _, shape, dtype = spec
+        return to_array(_onp.empty(shape, _onp.dtype(dtype)))
+    if isinstance(spec, tuple) and spec and spec[0] == "shm":
+        _, name, shape, dtype = spec
+        shm = shared_memory.SharedMemory(name=name)
+        try:
+            view = _onp.ndarray(shape, _onp.dtype(dtype), buffer=shm.buf)
+            # one explicit host copy: the CPU backend's device_put may
+            # zero-copy-alias its input, which must outlive the segment
+            out = to_array(view.copy())
+        finally:
+            shm.close()
+            try:
+                shm.unlink()
+            except FileNotFoundError:
+                pass
+        return out
+    if isinstance(spec, (tuple, list)):
+        return type(spec)(_from_shm(s, to_array) for s in spec)
+    if isinstance(spec, dict):
+        return {k: _from_shm(v, to_array) for k, v in spec.items()}
+    return spec
+
+
+# ---------------------------------------------------------------------------
+# worker process
+# ---------------------------------------------------------------------------
+
+def _worker_main(blob: bytes, task_q, data_q):
+    """Worker entry. `blob` is deserialised only after the CPU pin so the
+    dataset's ndarrays (and any transform's mx ops) run on the in-process
+    CPU backend — never on (or through) the parent's accelerator client."""
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    dataset, batchify_fn = pickle.loads(blob)
+    from multiprocessing import resource_tracker
+    while True:
+        task = task_q.get()
+        if task is None:
+            return
+        batch_id, indices = task
+        try:
+            samples = [dataset[i] for i in indices]
+            batch = batchify_fn(samples)
+            segments = []
+            spec = _to_shm(batch, segments)
+            for shm in segments:
+                shm.close()
+                # ownership transfers to the parent (which unlinks after
+                # copying); unregister so this process's resource tracker
+                # doesn't destroy — or warn about — the in-flight segment
+                try:
+                    resource_tracker.unregister(shm._name, "shared_memory")
+                except Exception:
+                    pass
+            data_q.put((batch_id, spec, None))
+        except Exception as e:  # ship the failure instead of dying silently
+            import traceback
+            data_q.put((batch_id, None,
+                        f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))
+
+
+class ProcessPool:
+    """Order-preserving process pool: submit(indices) -> batches in order."""
+
+    def __init__(self, dataset, batchify_fn, num_workers: int):
+        import multiprocessing as mp
+        ctx = mp.get_context("spawn")
+        self._task_q = ctx.Queue()
+        self._data_q = ctx.Queue()
+        blob = pickle.dumps((dataset, batchify_fn),
+                            protocol=pickle.HIGHEST_PROTOCOL)
+        self._procs = [
+            ctx.Process(target=_worker_main,
+                        args=(blob, self._task_q, self._data_q), daemon=True)
+            for _ in range(num_workers)]
+        for p in self._procs:
+            p.start()
+        self._next_submit = 0
+        self._next_yield = 0
+        self._reorder = {}
+        self._closed = False
+
+    def submit(self, indices) -> None:
+        self._task_q.put((self._next_submit, list(indices)))
+        self._next_submit += 1
+
+    @property
+    def outstanding(self) -> int:
+        return self._next_submit - self._next_yield
+
+    def get(self, to_array, timeout: float):
+        """Next batch in submission order (reorder buffer over the queue)."""
+        from ...base import MXNetError
+        want = self._next_yield
+        while want not in self._reorder:
+            try:
+                batch_id, spec, err = self._data_q.get(timeout=timeout)
+            except _queue_mod.Empty:
+                raise MXNetError(
+                    f"DataLoader worker batch timed out after {timeout}s "
+                    f"(num_workers={len(self._procs)}); a dataset transform "
+                    "is stuck or too slow — raise `timeout=` or debug the "
+                    "transform")
+            if err is not None:
+                # mark the failed batch consumed so a caller that catches
+                # the error (or a later epoch) doesn't wait on it forever
+                if batch_id == want:
+                    self._next_yield += 1
+                raise MXNetError(f"DataLoader worker failed: {err}")
+            self._reorder[batch_id] = spec
+        spec = self._reorder.pop(want)
+        self._next_yield += 1
+        return _from_shm(spec, to_array)
+
+    def _discard(self, spec) -> None:
+        """Unlink a batch's shared-memory segments without materialising."""
+        try:
+            _from_shm(spec, lambda a: None)
+        except Exception:
+            pass
+
+    def reset(self, timeout: float) -> None:
+        """Drain every outstanding batch (discarding data + unlinking its
+        segments) so a fresh epoch starts from a clean queue — an abandoned
+        iterator (``for b in dl: break``) must not leak its prefetched
+        batches into the next one."""
+        deadline = None
+        while self._next_yield < self._next_submit:
+            if self._next_yield in self._reorder:
+                self._discard(self._reorder.pop(self._next_yield))
+                self._next_yield += 1
+                continue
+            try:
+                batch_id, spec, _err = self._data_q.get(timeout=timeout)
+            except _queue_mod.Empty:
+                break   # worker wedged; shutdown() will clean up
+            if spec is not None:
+                self._reorder[batch_id] = spec
+            else:
+                if batch_id == self._next_yield:
+                    self._next_yield += 1
+        for spec in self._reorder.values():
+            self._discard(spec)
+        self._reorder.clear()
+        self._next_submit = self._next_yield = 0
+
+    def shutdown(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for _ in self._procs:
+            try:
+                self._task_q.put(None)
+            except Exception:
+                pass
+        for p in self._procs:
+            p.join(timeout=2)
+            if p.is_alive():
+                p.terminate()
+        # drain in-flight and buffered segments so nothing leaks /dev/shm
+        for spec in self._reorder.values():
+            self._discard(spec)
+        self._reorder.clear()
+        try:
+            while True:
+                _, spec, _err = self._data_q.get_nowait()
+                if spec is not None:
+                    self._discard(spec)
+        except Exception:
+            pass

