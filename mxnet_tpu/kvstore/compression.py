@@ -62,5 +62,67 @@ class GradientCompression:
         self._residuals[key] = res
         return from_jax(out.astype(g.dtype), grad._device)
 
+    # -- wire transport (dist mode) ----------------------------------------
+    # Parity: the reference quantizes what travels worker->server
+    # (`src/kvstore/gradient_compression.h:37,77-83`), not just the values.
+    # 2-bit packs 4 elements/byte (1/16 of fp32 on the wire); 1-bit packs
+    # 8/byte (1/32). Error feedback stays process-local.
+
+    def _quantize(self, key: str, g: jnp.ndarray):
+        """Shared residual-update + code emission. Returns (codes, out)."""
+        res = self._residuals.get(key)
+        if res is None or res.shape != g.shape:
+            res = jnp.zeros_like(g)
+        res = res + g
+        t = self.threshold
+        if self.type == "2bit":
+            pos = res >= t
+            neg = res <= -t
+            out = jnp.where(pos, t, jnp.where(neg, -t, 0.0))
+            codes = jnp.where(pos, 1, jnp.where(neg, 2, 0)).astype(jnp.uint8)
+        else:
+            pos = res > t
+            out = jnp.where(pos, 1.0, -1.0)
+            codes = pos.astype(jnp.uint8)
+        self._residuals[key] = res - out
+        return codes, out
+
+    def wire_compress(self, key: str, g: jnp.ndarray):
+        """Quantize `g` (error feedback) and bit-pack for transport.
+        Returns (packed uint8 vector, element count)."""
+        codes, _ = self._quantize(key, g)
+        flat = codes.reshape(-1)
+        n = flat.size
+        if self.type == "2bit":
+            per, shifts = 4, (0, 2, 4, 6)
+        else:
+            per, shifts = 8, tuple(range(8))
+        pad = (-n) % per
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.uint8)])
+        grp = flat.reshape(-1, per)
+        byte = jnp.zeros((grp.shape[0],), jnp.uint8)
+        for i, s in enumerate(shifts):
+            byte = byte | (grp[:, i] << s)
+        self.last_wire_bytes = int(byte.size)
+        self.last_raw_bytes = int(n * jnp.dtype(g.dtype).itemsize)
+        return byte, n
+
+    def wire_decode_sum(self, packed, n: int, shape, dtype):
+        """Decode gathered payloads (P, nbytes) and sum over processes."""
+        b = jnp.asarray(packed, jnp.uint8)
+        if b.ndim == 1:
+            b = b[None]
+        t = self.threshold
+        if self.type == "2bit":
+            parts = [(b >> s) & 3 for s in (0, 2, 4, 6)]
+            codes = jnp.stack(parts, axis=-1).reshape(b.shape[0], -1)[:, :n]
+            vals = jnp.where(codes == 1, t, jnp.where(codes == 2, -t, 0.0))
+        else:
+            parts = [(b >> s) & 1 for s in range(8)]
+            codes = jnp.stack(parts, axis=-1).reshape(b.shape[0], -1)[:, :n]
+            vals = jnp.where(codes == 1, 1.0, -1.0)
+        return jnp.sum(vals, axis=0).reshape(shape).astype(dtype)
+
     def reset(self):
         self._residuals.clear()

@@ -136,10 +136,13 @@ class KVStore(KVStoreBase):
         keys, values = _normalize(key, value)
         for k, vlist in zip(keys, values):
             kk = self._key(k)
-            if self._compression is not None and kk in self._store:
-                # compress each device's contribution pre-reduce with error
-                # feedback, as the reference compresses worker pushes
-                # (`kvstore_dist.h` push path); init pushes stay exact
+            compressed_wire = (self._compression is not None
+                               and kk in self._store and self._is_dist)
+            if (self._compression is not None and kk in self._store
+                    and not compressed_wire):
+                # single-process: compress each device's contribution
+                # pre-reduce with error feedback, as the reference
+                # compresses device pushes; init pushes stay exact
                 single = isinstance(vlist, ndarray)
                 vl = [vlist] if single else list(vlist)
                 vl = [self._compression.compress(f"{kk}#{i}", v)
@@ -147,7 +150,19 @@ class KVStore(KVStoreBase):
                 vlist = vl[0] if single else vl
             agg = self._aggregate(vlist)
             if self._is_dist:
-                agg = self._cross_process_sum(agg)
+                if compressed_wire:
+                    # reference parity (`kvstore_dist.h` push +
+                    # `gradient_compression.h:37`): the locally-reduced
+                    # gradient is quantized and only the PACKED payload
+                    # crosses processes — 1/16 (2bit) or 1/32 (1bit) of
+                    # the fp32 bytes; dequantize + sum after transport
+                    from jax.experimental import multihost_utils
+                    packed, n = self._compression.wire_compress(kk, agg)
+                    gathered = multihost_utils.process_allgather(packed)
+                    agg = self._compression.wire_decode_sum(
+                        gathered, n, agg.shape, agg.dtype)
+                else:
+                    agg = self._cross_process_sum(agg)
             if kk not in self._store:
                 from ..ndarray.ndarray import from_jax
                 self._store[kk] = from_jax(jnp.zeros_like(agg))

@@ -77,3 +77,29 @@ def test_dist_elastic_coordinated_preemption():
                        stdout)
     assert len(steps) == 2, stdout[-2000:]
     assert steps[0][1] == steps[1][1], steps  # same step on every rank
+
+
+def test_dist_sharded_train_step_two_processes():
+    """Flagship ShardedTrainStep over a 2-process x 2-device global mesh:
+    dp=4 loss must match single-device training bit-for-bit-ish
+    (VERDICT round-2 next-step #8)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)   # the worker script sets its own 2-device flag
+    env["JAX_PLATFORMS"] = "cpu"
+    cmd = [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+           "-n", "2", "--launcher", "local", "-p", str(_free_port()),
+           sys.executable, os.path.join(ROOT, "tests", "dist",
+                                        "dist_sharded_step.py")]
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True, env=env,
+                            cwd=ROOT, start_new_session=True)
+    try:
+        stdout, _ = proc.communicate(timeout=280)
+    except subprocess.TimeoutExpired:
+        os.killpg(proc.pid, signal.SIGKILL)
+        stdout, _ = proc.communicate()
+        pytest.fail(f"dist sharded-step workers timed out:\n{stdout[-4000:]}")
+    assert proc.returncode == 0, f"workers failed:\n{stdout[-4000:]}"
+    assert "[rank 0] dist_sharded_step OK (n=2" in stdout
+    assert "[rank 1] dist_sharded_step OK (n=2" in stdout
