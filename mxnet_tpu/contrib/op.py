@@ -36,7 +36,7 @@ __all__ = [
     "box_iou", "box_nms", "box_decode", "box_encode", "bipartite_matching",
     "ROIAlign", "roi_align", "fft", "ifft", "BilinearResize2D",
     "AdaptiveAvgPooling2D", "MultiBoxPrior", "gradient_multiplier",
-    "dynamic_reshape", "batch_norm_with_relu",
+    "dynamic_reshape", "batch_norm_with_relu", "DeformableConvolution",
 ]
 
 
@@ -497,3 +497,16 @@ def batch_norm_with_relu(x, gamma_, beta, running_mean, running_var,
                      momentum=momentum, fix_gamma=fix_gamma, axis=axis,
                      use_global_stats=use_global_stats)
     return _relu(out)
+
+
+def DeformableConvolution(data, offset, weight, bias=None, kernel=(3, 3),
+                          stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                          num_filter=None, num_group=1,
+                          num_deformable_group=1, no_bias=False, **kw):
+    """Deformable conv v1 (ref `src/operator/contrib/
+    deformable_convolution.cc`; math in `mxnet_tpu/ops/spatial.py`)."""
+    from ..numpy_extension import deformable_convolution as _dc
+    return _dc(data, offset, weight, None if no_bias else bias,
+               kernel=kernel, stride=stride, dilate=dilate, pad=pad,
+               num_filter=num_filter, num_group=num_group,
+               num_deformable_group=num_deformable_group)

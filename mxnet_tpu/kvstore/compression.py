@@ -43,24 +43,10 @@ class GradientCompression:
     def compress(self, key: str, grad: ndarray) -> ndarray:
         """Quantize `grad`, updating the per-key residual (error feedback).
         Returns the dequantized representation (what the receiving side
-        reconstructs)."""
-        g = grad._data
-        res = self._residuals.get(key)
-        if res is None or res.shape != g.shape:
-            res = jnp.zeros_like(g)
-        res = res + g
-        t = self.threshold
-        if self.type == "2bit":
-            pos = res >= t
-            neg = res <= -t
-            out = jnp.where(pos, t, jnp.where(neg, -t, 0.0))
-            res = res - out
-        else:  # 1bit: emit +1/-1; residual -= emitted
-            pos = res > t
-            out = jnp.where(pos, 1.0, -1.0)
-            res = res - out
-        self._residuals[key] = res
-        return from_jax(out.astype(g.dtype), grad._device)
+        reconstructs). Same residual math as the wire path (`_quantize`),
+        so single-process and dist results stay bit-identical."""
+        _, out = self._quantize(key, grad._data)
+        return from_jax(out.astype(grad._data.dtype), grad._device)
 
     # -- wire transport (dist mode) ----------------------------------------
     # Parity: the reference quantizes what travels worker->server

@@ -136,13 +136,12 @@ class KVStore(KVStoreBase):
         keys, values = _normalize(key, value)
         for k, vlist in zip(keys, values):
             kk = self._key(k)
-            compressed_wire = (self._compression is not None
-                               and kk in self._store and self._is_dist)
-            if (self._compression is not None and kk in self._store
-                    and not compressed_wire):
+            # init pushes (key not yet stored) stay exact in both branches
+            compressing = self._compression is not None and kk in self._store
+            if compressing and not self._is_dist:
                 # single-process: compress each device's contribution
                 # pre-reduce with error feedback, as the reference
-                # compresses device pushes; init pushes stay exact
+                # compresses device pushes
                 single = isinstance(vlist, ndarray)
                 vl = [vlist] if single else list(vlist)
                 vl = [self._compression.compress(f"{kk}#{i}", v)
@@ -150,7 +149,7 @@ class KVStore(KVStoreBase):
                 vlist = vl[0] if single else vl
             agg = self._aggregate(vlist)
             if self._is_dist:
-                if compressed_wire:
+                if compressing:
                     # reference parity (`kvstore_dist.h` push +
                     # `gradient_compression.h:37`): the locally-reduced
                     # gradient is quantized and only the PACKED payload

@@ -52,7 +52,8 @@ __all__ = [
     "Deconvolution", "BatchNorm", "LayerNorm", "InstanceNorm", "GroupNorm",
     "Pooling", "Dropout", "RNN", "SoftmaxOutput", "softmax", "log_softmax",
     "SoftmaxActivation", "UpSampling", "SequenceMask", "SequenceLast",
-    "SequenceReverse", "Custom",
+    "SequenceReverse", "Custom", "SpatialTransformer", "BilinearSampler",
+    "GridGenerator", "Correlation", "im2col", "col2im",
     # random / samplers
     "random_uniform", "random_normal", "random_gamma", "random_exponential",
     "random_poisson", "random_negative_binomial", "random_randint",
@@ -1098,3 +1099,53 @@ def linalg_makediag(A, offset=0, out=None):
         c = idx + builtins.max(offset, 0)
         return base.at[..., r, c].set(a)
     return _op(fn, A, name="linalg_makediag", out=out)
+
+
+def SpatialTransformer(data, loc, target_shape=None,
+                       transform_type="affine", sampler_type="bilinear",
+                       out=None, **kw):
+    """ref `src/operator/spatial_transformer.cc:224`"""
+    from ..numpy_extension import spatial_transformer as _st
+    return _write_out(_st(data, loc, target_shape=target_shape,
+                          transform_type=transform_type,
+                          sampler_type=sampler_type), out)
+
+
+def BilinearSampler(data, grid, out=None, **kw):
+    """ref `src/operator/bilinear_sampler.cc`"""
+    from ..numpy_extension import bilinear_sampler as _bs
+    return _write_out(_bs(data, grid), out)
+
+
+def GridGenerator(data, transform_type="affine", target_shape=(0, 0),
+                  out=None, **kw):
+    """ref `src/operator/grid_generator.cc`"""
+    from ..numpy_extension import grid_generator as _gg
+    return _write_out(_gg(data, transform_type=transform_type,
+                          target_shape=target_shape), out)
+
+
+def Correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True, out=None, **kw):
+    """ref `src/operator/correlation.cc`"""
+    from ..numpy_extension import correlation as _corr
+    return _write_out(_corr(data1, data2, kernel_size=kernel_size,
+                            max_displacement=max_displacement,
+                            stride1=stride1, stride2=stride2,
+                            pad_size=pad_size, is_multiply=is_multiply), out)
+
+
+def im2col(data, kernel, stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+           out=None, **kw):
+    """ref `src/operator/nn/im2col.h`"""
+    from ..numpy_extension import im2col as _i2c
+    return _write_out(_i2c(data, kernel, stride=stride, dilate=dilate,
+                           pad=pad), out)
+
+
+def col2im(data, output_size, kernel, stride=(1, 1), dilate=(1, 1),
+           pad=(0, 0), out=None, **kw):
+    """ref `src/operator/nn/im2col.h` (col2im adjoint)"""
+    from ..numpy_extension import col2im as _c2i
+    return _write_out(_c2i(data, output_size, kernel, stride=stride,
+                           dilate=dilate, pad=pad), out)

@@ -39,6 +39,8 @@ __all__ = [
     "sldwin_atten_mask_like", "sldwin_atten_score", "sldwin_atten_context",
     "multi_head_attention", "ctc_loss", "foreach", "while_loop", "cond",
     "remat_call",
+    "grid_generator", "bilinear_sampler", "spatial_transformer",
+    "correlation", "im2col", "col2im", "deformable_convolution",
     "save", "load", "waitall", "set_np", "reset_np", "is_np_array",
     "seed", "rnn", "intgemm_fully_connected", "custom",
     "random", "image", "cpu", "gpu", "tpu", "num_gpus", "num_tpus",
@@ -1095,6 +1097,73 @@ def custom(*inputs, op_type, **kwargs):
     `mx.nd.Custom`, `src/operator/custom/custom.cc`)."""
     from ..operator import custom as _custom
     return _custom(*inputs, op_type=op_type, **kwargs)
+
+
+
+# ---------------------------------------------------------------------------
+# spatial / warping ops (ref `src/operator/spatial_transformer.cc`,
+# `bilinear_sampler.cc`, `grid_generator.cc`, `correlation.cc`,
+# `src/operator/nn/im2col.h`; jax-level math in `mxnet_tpu/ops/spatial.py`)
+# ---------------------------------------------------------------------------
+
+def grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    from ..ops import spatial as _sp
+    return apply_op(
+        lambda d: _sp.grid_generator(d, transform_type, target_shape),
+        (data,), {}, name="grid_generator")
+
+
+def bilinear_sampler(data, grid):
+    from ..ops import spatial as _sp
+    return apply_op(lambda d, g: _sp.bilinear_sample(d, g), (data, grid),
+                    {}, name="bilinear_sampler")
+
+
+def spatial_transformer(data, loc, target_shape=None,
+                        transform_type="affine", sampler_type="bilinear"):
+    from ..ops import spatial as _sp
+    return apply_op(
+        lambda d, l: _sp.spatial_transformer(d, l, target_shape,
+                                             transform_type, sampler_type),
+        (data, loc), {}, name="spatial_transformer")
+
+
+def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True):
+    from ..ops import spatial as _sp
+    return apply_op(
+        lambda a, b: _sp.correlation(a, b, kernel_size, max_displacement,
+                                     stride1, stride2, pad_size,
+                                     is_multiply),
+        (data1, data2), {}, name="correlation")
+
+
+def im2col(data, kernel, stride=(1, 1), dilate=(1, 1), pad=(0, 0)):
+    from ..ops import spatial as _sp
+    return apply_op(lambda d: _sp.im2col(d, kernel, stride, dilate, pad),
+                    (data,), {}, name="im2col")
+
+
+def col2im(data, output_size, kernel, stride=(1, 1), dilate=(1, 1),
+           pad=(0, 0)):
+    from ..ops import spatial as _sp
+    return apply_op(
+        lambda c: _sp.col2im(c, output_size, kernel, stride, dilate, pad),
+        (data,), {}, name="col2im")
+
+
+def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                           stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                           num_filter=None, num_group=1,
+                           num_deformable_group=1):
+    from ..ops import spatial as _sp
+    args = (data, offset, weight) + (() if bias is None else (bias,))
+
+    def fn(d, o, w, *rest):
+        return _sp.deformable_convolution(
+            d, o, w, rest[0] if rest else None, kernel, stride, dilate,
+            pad, num_filter, num_group, num_deformable_group)
+    return apply_op(fn, args, {}, name="deformable_convolution")
 
 
 # submodule re-exports (parity: `python/mxnet/numpy_extension/__init__.py`

@@ -1,0 +1,264 @@
+"""Spatial / warping / matching operators, TPU-native.
+
+Parity targets (reference files under `/root/reference/`):
+- GridGenerator: `src/operator/grid_generator.cc` (affine + warp types)
+- BilinearSampler: `src/operator/bilinear_sampler.cc`
+- SpatialTransformer: `src/operator/spatial_transformer.cc:224`
+- Correlation: `src/operator/correlation.cc` (FlowNet cost volume)
+- DeformableConvolution: `src/operator/contrib/deformable_convolution.cc`
+- im2col / col2im: `src/operator/nn/im2col.h`
+
+Design: everything is pure jnp/lax with static shapes — gathers vectorise
+onto the VPU, the per-tap loops (kernel taps, displacement grid) are
+Python-static so XLA unrolls and fuses them, and gradients come from JAX
+autodiff (the reference hand-writes every backward kernel). `col2im` is
+defined as the exact VJP of `im2col`, which is its mathematical definition.
+
+Convention notes:
+- sampling grids are normalised to [-1, 1] with align-corners semantics
+  (grid -1 ↦ pixel 0, +1 ↦ pixel N-1), the reference's mapping
+  (`bilinear_sampler-inl.h` `between()` + scaling).
+- out-of-range taps contribute zero (zero padding), including their
+  gradients.
+"""
+from __future__ import annotations
+
+import math
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "bilinear_gather", "bilinear_sample", "grid_generator",
+    "spatial_transformer", "correlation", "im2col", "col2im",
+    "deformable_convolution",
+]
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (tuple, list)):
+        return int(v[0]), int(v[1])
+    return int(v), int(v)
+
+
+def bilinear_gather(data, x, y):
+    """Bilinear sample `data` (B, C, H, W) at pixel coords x/y (B, Ho, Wo).
+
+    Taps outside [0, W-1]x[0, H-1] contribute zero (zero padding); a
+    partially-outside sample keeps its in-range taps — the reference's
+    border behavior (`bilinear_sampler.cc` `between()` guards)."""
+    B, C, H, W = data.shape
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    flat = data.reshape(B, C, H * W)
+
+    def tap(xi, yi, w):
+        valid = (xi >= 0) & (xi <= W - 1) & (yi >= 0) & (yi <= H - 1)
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        idx = (yc * W + xc).reshape(B, 1, -1)
+        v = jnp.take_along_axis(
+            flat, jnp.broadcast_to(idx, (B, C, idx.shape[-1])), axis=2)
+        v = v.reshape(B, C, *x.shape[1:])
+        return v * (w * valid)[:, None].astype(data.dtype)
+
+    wx1 = x - x0
+    wy1 = y - y0
+    return (tap(x0, y0, (1 - wx1) * (1 - wy1))
+            + tap(x0 + 1, y0, wx1 * (1 - wy1))
+            + tap(x0, y0 + 1, (1 - wx1) * wy1)
+            + tap(x0 + 1, y0 + 1, wx1 * wy1))
+
+
+def bilinear_sample(data, grid):
+    """BilinearSampler: `grid` (B, 2, Ho, Wo) holds normalised (x, y) in
+    [-1, 1]; returns (B, C, Ho, Wo)."""
+    _, _, H, W = data.shape
+    x = (grid[:, 0] + 1.0) * (W - 1) / 2.0
+    y = (grid[:, 1] + 1.0) * (H - 1) / 2.0
+    return bilinear_gather(data, x, y)
+
+
+def grid_generator(data, transform_type: str = "affine",
+                   target_shape: Sequence[int] = (0, 0)):
+    """GridGenerator -> (B, 2, H, W) normalised sampling grid.
+
+    affine: `data` is (B, 6), row-major 2x3 theta mapping target (x_t, y_t,
+    1) -> source (x_s, y_s).  warp: `data` is a (B, 2, H, W) pixel-offset
+    flow field added to the identity grid."""
+    if transform_type == "affine":
+        H, W = int(target_shape[0]), int(target_shape[1])
+        if H <= 0 or W <= 0:
+            raise ValueError(
+                f"affine grid_generator needs a positive target_shape, got "
+                f"{tuple(target_shape)} (the reference operator errors at "
+                "shape inference too)")
+        B = data.shape[0]
+        theta = data.reshape(B, 2, 3).astype(jnp.float32)
+        xt = jnp.linspace(-1.0, 1.0, W)
+        yt = jnp.linspace(-1.0, 1.0, H)
+        yg, xg = jnp.meshgrid(yt, xt, indexing="ij")        # (H, W)
+        ones = jnp.ones_like(xg)
+        tgt = jnp.stack([xg, yg, ones], axis=0).reshape(3, H * W)
+        src = jnp.einsum("bij,jk->bik", theta, tgt)          # (B, 2, H*W)
+        return src.reshape(B, 2, H, W).astype(data.dtype)
+    if transform_type == "warp":
+        B, two, H, W = data.shape
+        xg = jnp.arange(W, dtype=jnp.float32)
+        yg = jnp.arange(H, dtype=jnp.float32)
+        yy, xx = jnp.meshgrid(yg, xg, indexing="ij")
+        x = xx[None] + data[:, 0].astype(jnp.float32)
+        y = yy[None] + data[:, 1].astype(jnp.float32)
+        # normalise pixel coords back to [-1, 1]
+        xn = 2.0 * x / max(W - 1, 1) - 1.0
+        yn = 2.0 * y / max(H - 1, 1) - 1.0
+        return jnp.stack([xn, yn], axis=1).astype(data.dtype)
+    raise ValueError(f"unknown transform_type {transform_type!r}")
+
+
+def spatial_transformer(data, loc, target_shape=None,
+                        transform_type: str = "affine",
+                        sampler_type: str = "bilinear"):
+    """SpatialTransformer: affine grid from `loc` (B, 6) + bilinear
+    sampling of `data` (B, C, H, W) at `target_shape` (Ho, Wo)."""
+    if transform_type != "affine":
+        raise ValueError("only affine SpatialTransformer is defined "
+                         "(reference: spatial_transformer.cc)")
+    if sampler_type != "bilinear":
+        raise ValueError("only bilinear sampling is defined")
+    if target_shape is None or tuple(target_shape)[-1] == 0:
+        target_shape = data.shape[2:]
+    grid = grid_generator(loc, "affine", target_shape)
+    return bilinear_sample(data, grid)
+
+
+def correlation(data1, data2, kernel_size: int = 1,
+                max_displacement: int = 1, stride1: int = 1,
+                stride2: int = 1, pad_size: int = 0,
+                is_multiply: bool = True):
+    """FlowNet correlation cost volume (ref `correlation.cc`).
+
+    Output (B, D*D, Ho, Wo) with D = 2*(max_displacement//stride2)+1;
+    channel d indexes displacement (dy, dx) = stride2*(d//D - bd, d%D - bd).
+    Each entry is the mean over channels and the kernel window of
+    data1[x] * data2[x + disp] (or |a - b| when ``is_multiply=False``)."""
+    B, C, H, W = data1.shape
+    k = int(kernel_size)
+    if k % 2 != 1:
+        raise ValueError("kernel_size must be odd")
+    kr = k // 2
+    bd = max_displacement // stride2
+    D = 2 * bd + 1
+    p = pad_size
+    d1 = jnp.pad(data1, ((0, 0), (0, 0), (p, p), (p, p)))
+    d2 = jnp.pad(data2, ((0, 0), (0, 0), (p, p), (p, p)))
+    Hp, Wp = H + 2 * p, W + 2 * p
+    border = max_displacement + kr
+    Ho = int(math.ceil((Hp - 2 * border) / stride1))
+    Wo = int(math.ceil((Wp - 2 * border) / stride1))
+    if Ho <= 0 or Wo <= 0:
+        raise ValueError("correlation output would be empty; grow pad_size "
+                         "or shrink max_displacement/kernel_size")
+    norm = float(k * k * C)
+    outs = []
+    for dy in range(-bd, bd + 1):
+        for dx in range(-bd, bd + 1):
+            oy, ox = dy * stride2, dx * stride2
+            shifted = jnp.roll(d2, shift=(-oy, -ox), axis=(2, 3))
+            prod = (d1 * shifted if is_multiply
+                    else jnp.abs(d1 - shifted))
+            # sum over channels and the kxk window around each position
+            csum = jnp.sum(prod, axis=1, keepdims=True)
+            if k > 1:
+                csum = lax.reduce_window(
+                    csum, 0.0, lax.add, (1, 1, k, k), (1, 1, 1, 1), "VALID")
+                off = border - kr
+            else:
+                off = border
+            # rolled values that wrapped around are out-of-range taps in the
+            # reference (reads beyond the padded border never happen there
+            # because |disp| <= max_displacement <= border)
+            sl = csum[:, :, off:off + Ho * stride1:stride1,
+                      off:off + Wo * stride1:stride1]
+            outs.append(sl / norm)
+    return jnp.concatenate(outs, axis=1)
+
+
+def im2col(data, kernel, stride=(1, 1), dilate=(1, 1), pad=(0, 0)):
+    """(B, C, H, W) -> (B, C*kh*kw, L) patch matrix (ref `im2col.h`)."""
+    kh, kw = _pair(kernel)
+    sh, sw = _pair(stride)
+    dh, dw = _pair(dilate)
+    ph, pw = _pair(pad)
+    patches = lax.conv_general_dilated_patches(
+        data, filter_shape=(kh, kw), window_strides=(sh, sw),
+        padding=((ph, ph), (pw, pw)), rhs_dilation=(dh, dw),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    B = data.shape[0]
+    return patches.reshape(B, patches.shape[1], -1)
+
+
+def col2im(col, output_size, kernel, stride=(1, 1), dilate=(1, 1),
+           pad=(0, 0)):
+    """Scatter-accumulate patches back to (B, C, H, W): the exact adjoint
+    (VJP) of `im2col` — overlapping taps sum (ref `im2col.h` col2im)."""
+    H, W = _pair(output_size)
+    kh, kw = _pair(kernel)
+    B = col.shape[0]
+    C = col.shape[1] // (kh * kw)
+    # linear_transpose traces im2col abstractly — no throwaway forward pass
+    t = jax.linear_transpose(
+        lambda x: im2col(x, kernel, stride, dilate, pad),
+        jax.ShapeDtypeStruct((B, C, H, W), col.dtype))
+    return t(col)[0]
+
+
+def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                           stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                           num_filter=None, num_group: int = 1,
+                           num_deformable_group: int = 1):
+    """Deformable convolution v1 (ref `deformable_convolution.cc`).
+
+    `offset` is (B, 2*ndg*kh*kw, Ho, Wo), per-tap (dy, dx) pairs in the
+    reference's channel order; each kernel tap bilinearly samples the input
+    at its offset position, then taps contract with the weights — a static
+    kh*kw-tap loop of gathers + one einsum per tap, which XLA fuses."""
+    if num_group != 1:
+        raise ValueError("num_group > 1 is not supported (the deformable "
+                         "models the reference ships use num_group=1)")
+    kh, kw = _pair(kernel)
+    sh, sw = _pair(stride)
+    dh, dw = _pair(dilate)
+    ph, pw = _pair(pad)
+    B, C, H, W = data.shape
+    O = int(num_filter if num_filter is not None else weight.shape[0])
+    ndg = int(num_deformable_group)
+    if C % ndg:
+        raise ValueError(f"channels {C} not divisible by "
+                         f"num_deformable_group {ndg}")
+    Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    Wo = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    base_y = (jnp.arange(Ho) * sh - ph).astype(jnp.float32)
+    base_x = (jnp.arange(Wo) * sw - pw).astype(jnp.float32)
+    yy, xx = jnp.meshgrid(base_y, base_x, indexing="ij")    # (Ho, Wo)
+    cg = C // ndg
+    out = jnp.zeros((B, O, Ho, Wo), jnp.float32)
+    off = offset.astype(jnp.float32).reshape(B, ndg, kh * kw, 2, Ho, Wo)
+    w = weight.astype(jnp.float32)
+    for t in range(kh * kw):
+        r, s = divmod(t, kw)
+        taps = []
+        for g in range(ndg):
+            dy = off[:, g, t, 0]
+            dx = off[:, g, t, 1]
+            y = yy[None] + r * dh + dy
+            x = xx[None] + s * dw + dx
+            taps.append(bilinear_gather(
+                data[:, g * cg:(g + 1) * cg].astype(jnp.float32), x, y))
+        sampled = jnp.concatenate(taps, axis=1)              # (B, C, Ho, Wo)
+        out = out + jnp.einsum("bchw,oc->bohw", sampled, w[:, :, r, s])
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)[None, :, None, None]
+    return out.astype(data.dtype)

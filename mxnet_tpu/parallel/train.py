@@ -339,14 +339,42 @@ class ShardedTrainStep:
             out_shardings=(pspec, sspec, repl),
             donate_argnums=(0, 1))
 
+    def _check_global_batch(self, batch_vals) -> None:
+        """First-step guard: on a mesh spanning processes, assert every
+        process passed the same global batch (cheap checksum allgather)."""
+        if all(getattr(s, "is_fully_addressable", True)
+               for s in self._batch_shardings):
+            return
+        from jax.experimental import multihost_utils
+        sums = onp.asarray(
+            [float(jnp.sum(jnp.abs(jnp.asarray(b, jnp.float32))))
+             for b in batch_vals], onp.float32)
+        gathered = multihost_utils.process_allgather(sums)
+        if not onp.allclose(gathered, gathered[0], rtol=1e-5):
+            raise MXNetError(
+                "ShardedTrainStep on a multi-process mesh requires every "
+                "process to pass the IDENTICAL global batch (each host "
+                "contributes its addressable shards). Got differing batch "
+                f"checksums across processes: {gathered.tolist()}. If each "
+                "worker loads its own shard, concatenate/allgather to the "
+                "global batch first (or give every worker the same data "
+                "stream + global indices).")
+
     # ------------------------------------------------------------------
     def __call__(self, *batch, rng_key=None):
-        """Run one step; returns the (replicated) scalar loss as jax array."""
+        """Run one step; returns the (replicated) scalar loss as jax array.
+
+        Multi-process meshes: every process must pass the identical GLOBAL
+        batch (each contributes its addressable shards — see `_put_global`);
+        the first step cross-checks this so the per-host-shard habit from
+        the reference's KVStore path fails loudly instead of training on a
+        silent patchwork of half-dropped data."""
         from .. import random as _rng
         batch_vals = [b._data if hasattr(b, "_data") else jnp.asarray(b)
                       for b in batch]
         if self._step_fn is None:
             self._build(batch_vals, rng_key)
+            self._check_global_batch(batch_vals)
         self._t += 1
         o = self.optimizer
         hp = {"lr": jnp.asarray(o.learning_rate, jnp.float32),

@@ -1,0 +1,343 @@
+"""Spatial/warping op tail (VERDICT round-2 missing #2): GridGenerator,
+BilinearSampler, SpatialTransformer, Correlation, im2col/col2im,
+DeformableConvolution — value semantics + finite-difference gradient checks
+(the sweep-test pattern of `test_numpy_op_sweep.py`).
+
+Reference parity targets: `src/operator/spatial_transformer.cc`,
+`bilinear_sampler.cc`, `grid_generator.cc`, `correlation.cc`,
+`src/operator/nn/im2col.h`, `src/operator/contrib/deformable_convolution.cc`.
+"""
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops import spatial as sp
+
+
+def _fd_grad(f, x, eps=1e-3, n_probe=6, seed=0):
+    """Finite-difference per-coordinate check of jax.grad(f) at x."""
+    g = jax.grad(f)(x)
+    rng = onp.random.RandomState(seed)
+    for _ in range(n_probe):
+        i = tuple(rng.randint(0, s) for s in x.shape)
+        d = onp.zeros(x.shape, onp.float32)
+        d[i] = eps
+        fd = (float(f(x + d)) - float(f(x - d))) / (2 * eps)
+        onp.testing.assert_allclose(fd, float(g[i]), rtol=5e-2, atol=1e-3)
+
+
+def _fd_grad_dir(f, x, eps=1e-3, n_probe=3, seed=0):
+    """Directional finite-difference check: aggregates every coordinate,
+    so the FD signal clears float32 cancellation even where individual
+    partials are tiny."""
+    g = jax.grad(f)(x)
+    rng = onp.random.RandomState(seed)
+    for _ in range(n_probe):
+        d = rng.randn(*x.shape).astype(onp.float32)
+        d /= onp.linalg.norm(d)
+        fd = (float(f(x + eps * d)) - float(f(x - eps * d))) / (2 * eps)
+        ref = float(jnp.vdot(g, jnp.asarray(d)))
+        onp.testing.assert_allclose(fd, ref, rtol=2e-2, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# GridGenerator
+# ---------------------------------------------------------------------------
+
+def test_grid_generator_affine_identity():
+    theta = jnp.asarray([[1, 0, 0, 0, 1, 0]], jnp.float32)
+    g = sp.grid_generator(theta, "affine", (3, 5))
+    onp.testing.assert_allclose(onp.asarray(g[0, 0, 0]),
+                                onp.linspace(-1, 1, 5), rtol=1e-6)
+    onp.testing.assert_allclose(onp.asarray(g[0, 1, :, 0]),
+                                onp.linspace(-1, 1, 3), rtol=1e-6)
+
+
+def test_grid_generator_warp_zero_flow_is_identity_grid():
+    flow = jnp.zeros((2, 2, 4, 6), jnp.float32)
+    g = sp.grid_generator(flow, "warp")
+    onp.testing.assert_allclose(onp.asarray(g[0, 0, 0]),
+                                onp.linspace(-1, 1, 6), atol=1e-6)
+    onp.testing.assert_allclose(onp.asarray(g[1, 1, :, 2]),
+                                onp.linspace(-1, 1, 4), atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# BilinearSampler
+# ---------------------------------------------------------------------------
+
+def _identity_grid(b, h, w):
+    x = onp.linspace(-1, 1, w, dtype=onp.float32)
+    y = onp.linspace(-1, 1, h, dtype=onp.float32)
+    yy, xx = onp.meshgrid(y, x, indexing="ij")
+    return jnp.asarray(onp.tile(onp.stack([xx, yy])[None], (b, 1, 1, 1)))
+
+
+def test_bilinear_sampler_identity_and_outside_zero():
+    rng = onp.random.RandomState(0)
+    data = jnp.asarray(rng.rand(2, 3, 5, 7).astype(onp.float32))
+    out = sp.bilinear_sample(data, _identity_grid(2, 5, 7))
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(data),
+                                rtol=1e-5, atol=1e-6)
+    far = jnp.full((2, 2, 4, 4), 3.0, jnp.float32)   # entirely off-image
+    onp.testing.assert_allclose(onp.asarray(sp.bilinear_sample(data, far)),
+                                0.0, atol=1e-7)
+
+
+def test_bilinear_sampler_integer_shift_matches_slice():
+    rng = onp.random.RandomState(1)
+    data = jnp.asarray(rng.rand(1, 1, 6, 8).astype(onp.float32))
+    g = onp.asarray(_identity_grid(1, 6, 8)).copy()
+    g[:, 0] += 2.0 / (8 - 1) * 2     # shift x by +2 source pixels
+    out = onp.asarray(sp.bilinear_sample(data, jnp.asarray(g)))
+    ref = onp.zeros_like(out)
+    ref[..., :6] = onp.asarray(data)[..., 2:]
+    onp.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_bilinear_sampler_gradients():
+    rng = onp.random.RandomState(2)
+    data = jnp.asarray(rng.rand(1, 2, 5, 5).astype(onp.float32))
+    grid = jnp.asarray((rng.rand(1, 2, 4, 4) * 1.6 - 0.8)
+                       .astype(onp.float32))
+    _fd_grad(lambda d: jnp.sum(sp.bilinear_sample(d, grid) ** 2), data)
+    _fd_grad(lambda g: jnp.sum(sp.bilinear_sample(data, g) ** 2), grid)
+
+
+# ---------------------------------------------------------------------------
+# SpatialTransformer
+# ---------------------------------------------------------------------------
+
+def test_spatial_transformer_identity_and_zoom():
+    rng = onp.random.RandomState(3)
+    x = jnp.asarray(rng.rand(2, 3, 8, 8).astype(onp.float32))
+    ident = jnp.asarray(onp.tile([1, 0, 0, 0, 1, 0], (2, 1))
+                        .astype(onp.float32))
+    out = sp.spatial_transformer(x, ident, (8, 8))
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(x),
+                                rtol=1e-5, atol=1e-6)
+    # 0.5-scale zoom samples the central half: corners land inside
+    zoom = jnp.asarray(onp.tile([0.5, 0, 0, 0, 0.5, 0], (2, 1))
+                       .astype(onp.float32))
+    z = sp.spatial_transformer(x, zoom, (8, 8))
+    assert z.shape == x.shape
+    # center pixel unchanged by a pure scale about the origin
+    onp.testing.assert_allclose(onp.asarray(z[:, :, 3:5, 3:5]).mean(),
+                                onp.asarray(x[:, :, 2:6, 2:6]).mean(),
+                                rtol=0.2)
+
+
+def test_spatial_transformer_grad_wrt_loc():
+    rng = onp.random.RandomState(4)
+    x = jnp.asarray(rng.rand(1, 1, 6, 6).astype(onp.float32))
+    theta = jnp.asarray([[0.9, 0.05, 0.02, -0.03, 1.1, -0.04]], jnp.float32)
+    _fd_grad(lambda t: jnp.sum(sp.spatial_transformer(x, t, (6, 6)) ** 2),
+             theta, eps=1e-4)
+
+
+def test_spatial_transformer_nd_autograd():
+    """ndarray-level op participates in autograd like any other."""
+    from mxnet_tpu import autograd
+    x = mx.nd.array(onp.random.RandomState(5).rand(1, 1, 4, 4)
+                    .astype(onp.float32))
+    th = mx.nd.array([[1.0, 0, 0, 0, 1.0, 0]])
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.SpatialTransformer(x, th, target_shape=(4, 4))
+        loss = (y * y).sum()
+    loss.backward()
+    onp.testing.assert_allclose(onp.asarray(x.grad.asnumpy()),
+                                2 * onp.asarray(x.asnumpy()),
+                                rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Correlation
+# ---------------------------------------------------------------------------
+
+def test_correlation_zero_displacement_channel():
+    rng = onp.random.RandomState(6)
+    a = jnp.asarray(rng.rand(1, 4, 6, 6).astype(onp.float32))
+    out = sp.correlation(a, a, kernel_size=1, max_displacement=1,
+                         pad_size=1)
+    assert out.shape == (1, 9, 6, 6)
+    # center channel (d=4) is the zero-displacement self-correlation
+    ref = onp.mean(onp.asarray(a) ** 2, axis=1)
+    onp.testing.assert_allclose(onp.asarray(out[:, 4]), ref,
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_correlation_detects_shift():
+    rng = onp.random.RandomState(7)
+    a = onp.zeros((1, 1, 7, 7), onp.float32)
+    a[0, 0, 3, 3] = 1.0
+    b = onp.roll(a, 1, axis=3)          # feature moved +1 in x
+    out = onp.asarray(sp.correlation(jnp.asarray(a), jnp.asarray(b),
+                                     max_displacement=1, pad_size=1))
+    # displacement channel (dy=0, dx=+1) = index 5 peaks at (3,3)
+    assert out[0, 5, 3, 3] == out.max() > 0
+    assert out[0, 4, 3, 3] == 0.0
+
+
+def test_correlation_abs_difference_mode():
+    a = jnp.ones((1, 2, 5, 5), jnp.float32)
+    b = jnp.zeros((1, 2, 5, 5), jnp.float32)
+    out = sp.correlation(a, b, max_displacement=0, pad_size=0,
+                         is_multiply=False)
+    onp.testing.assert_allclose(onp.asarray(out), 1.0, atol=1e-6)
+
+
+def test_correlation_gradients():
+    rng = onp.random.RandomState(8)
+    a = jnp.asarray(rng.rand(1, 2, 5, 5).astype(onp.float32))
+    b = jnp.asarray(rng.rand(1, 2, 5, 5).astype(onp.float32))
+    _fd_grad(lambda x: jnp.sum(
+        sp.correlation(x, b, max_displacement=1, pad_size=1) ** 2), a)
+    _fd_grad(lambda x: jnp.sum(
+        sp.correlation(a, x, max_displacement=1, pad_size=1) ** 2), b)
+
+
+# ---------------------------------------------------------------------------
+# im2col / col2im
+# ---------------------------------------------------------------------------
+
+def test_im2col_matches_manual_patches():
+    x = jnp.asarray(onp.arange(16, dtype=onp.float32).reshape(1, 1, 4, 4))
+    cols = onp.asarray(sp.im2col(x, (2, 2)))        # (1, 4, 9)
+    assert cols.shape == (1, 4, 9)
+    xx = onp.asarray(x)[0, 0]
+    # first output position = top-left 2x2 patch, row-major taps
+    onp.testing.assert_allclose(cols[0, :, 0],
+                                [xx[0, 0], xx[0, 1], xx[1, 0], xx[1, 1]])
+    # last = bottom-right patch
+    onp.testing.assert_allclose(cols[0, :, 8],
+                                [xx[2, 2], xx[2, 3], xx[3, 2], xx[3, 3]])
+
+
+def test_col2im_is_adjoint_of_im2col():
+    rng = onp.random.RandomState(9)
+    x = jnp.asarray(rng.rand(2, 3, 6, 6).astype(onp.float32))
+    kw = dict(kernel=(3, 3), stride=(2, 2), pad=(1, 1))
+    cols = sp.im2col(x, **kw)
+    c = jnp.asarray(rng.rand(*cols.shape).astype(onp.float32))
+    lhs = float(jnp.sum(c * cols))
+    rhs = float(jnp.sum(sp.col2im(c, (6, 6), **kw) * x))
+    onp.testing.assert_allclose(lhs, rhs, rtol=1e-5)
+
+
+def test_col2im_overlap_counts():
+    x = jnp.ones((1, 1, 4, 4), jnp.float32)
+    cols = sp.im2col(x, (2, 2))
+    back = onp.asarray(sp.col2im(cols, (4, 4), (2, 2)))
+    # interior pixels covered by 4 patches, corners by 1, edges by 2
+    onp.testing.assert_allclose(back[0, 0, 0, 0], 1.0)
+    onp.testing.assert_allclose(back[0, 0, 1, 1], 4.0)
+    onp.testing.assert_allclose(back[0, 0, 0, 1], 2.0)
+
+
+def test_im2col_gradient():
+    rng = onp.random.RandomState(10)
+    x = jnp.asarray(rng.rand(1, 2, 5, 5).astype(onp.float32))
+    _fd_grad(lambda d: jnp.sum(sp.im2col(d, (3, 3), pad=(1, 1)) ** 2), x)
+
+
+# ---------------------------------------------------------------------------
+# DeformableConvolution
+# ---------------------------------------------------------------------------
+
+def test_deformable_conv_zero_offset_equals_convolution():
+    rng = onp.random.RandomState(11)
+    x = rng.rand(2, 3, 7, 7).astype(onp.float32)
+    w = rng.rand(5, 3, 3, 3).astype(onp.float32)
+    b = rng.rand(5).astype(onp.float32)
+    off = onp.zeros((2, 18, 7, 7), onp.float32)
+    out = sp.deformable_convolution(
+        jnp.asarray(x), jnp.asarray(off), jnp.asarray(w), jnp.asarray(b),
+        kernel=(3, 3), pad=(1, 1), num_filter=5)
+    ref = mx.npx.convolution(mx.np.array(x), mx.np.array(w), mx.np.array(b),
+                             kernel=(3, 3), pad=(1, 1), num_filter=5)
+    onp.testing.assert_allclose(onp.asarray(out),
+                                onp.asarray(ref.asnumpy()),
+                                rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_integer_offset_shifts_taps():
+    """All taps offset by (0, +1) equals convolving the x-shifted input."""
+    rng = onp.random.RandomState(12)
+    x = rng.rand(1, 2, 6, 6).astype(onp.float32)
+    w = rng.rand(4, 2, 3, 3).astype(onp.float32)
+    off = onp.zeros((1, 18, 6, 6), onp.float32)
+    off[:, 1::2] = 1.0       # dx = +1 for every tap
+    out = sp.deformable_convolution(
+        jnp.asarray(x), jnp.asarray(off), jnp.asarray(w),
+        kernel=(3, 3), pad=(1, 1), num_filter=4)
+    xs = onp.zeros_like(x)
+    xs[..., :-1] = x[..., 1:]           # shift left (sample at x+1)
+    ref = sp.deformable_convolution(
+        jnp.asarray(xs), jnp.zeros((1, 18, 6, 6), jnp.float32),
+        jnp.asarray(w), kernel=(3, 3), pad=(1, 1), num_filter=4)
+    # interior columns agree (border columns see different zero padding)
+    onp.testing.assert_allclose(onp.asarray(out)[..., 1:-2],
+                                onp.asarray(ref)[..., 1:-2],
+                                rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_offset_gradient_analytic():
+    """On x-ramp data (data[..., x] = x), the interior offset-x gradient of
+    sum(out) is exactly sum(weights) per tap and the offset-y gradient is
+    exactly zero — a closed-form check that sidesteps float32 FD noise
+    (bilinear sampling of a linear ramp is locally linear in the offset).
+    Verified against float64 finite differences during development."""
+    C, O = 2, 3
+    ramp = onp.tile(onp.arange(5, dtype=onp.float32), (1, C, 5, 1))
+    x = jnp.asarray(ramp)
+    rng = onp.random.RandomState(13)
+    w = jnp.asarray(rng.rand(O, C, 3, 3).astype(onp.float32))
+    off = jnp.asarray(onp.full((1, 18, 5, 5), 0.3, onp.float32))
+
+    def f(o):
+        out = sp.deformable_convolution(x, o, w, kernel=(3, 3), pad=(1, 1),
+                                        num_filter=O)
+        # rows/cols where every tap (base + r|s + 0.3) stays in-range:
+        # j + s - 1 + 0.3 <= 4 for s<=2  =>  j <= 2
+        return jnp.sum(out[:, :, 1:3, 1:3])
+
+    g = onp.asarray(jax.grad(f)(off)).reshape(9, 2, 5, 5)
+    w_np = onp.asarray(w)
+    for t in range(9):
+        r, s_ = divmod(t, 3)
+        expect_dx = w_np[:, :, r, s_].sum()
+        onp.testing.assert_allclose(g[t, 1, 1:3, 1:3], expect_dx,
+                                    rtol=1e-4, err_msg=f"tap {t} dx")
+        onp.testing.assert_allclose(g[t, 0, 1:3, 1:3], 0.0, atol=1e-5,
+                                    err_msg=f"tap {t} dy")
+
+
+def test_deformable_conv_weight_gradient():
+    rng = onp.random.RandomState(13)
+    x = jnp.asarray(rng.rand(1, 2, 5, 5).astype(onp.float32))
+    w = jnp.asarray(rng.rand(3, 2, 3, 3).astype(onp.float32))
+    off = jnp.asarray((0.3 + 0.1 * rng.rand(1, 18, 5, 5))
+                      .astype(onp.float32))
+
+    def f_w(ww):
+        return jnp.sum(sp.deformable_convolution(
+            x, off, ww, kernel=(3, 3), pad=(1, 1), num_filter=3) ** 2)
+
+    _fd_grad_dir(f_w, w, eps=5e-3)
+
+
+def test_deformable_conv_group_support():
+    rng = onp.random.RandomState(14)
+    x = jnp.asarray(rng.rand(1, 4, 5, 5).astype(onp.float32))
+    w = jnp.asarray(rng.rand(2, 4, 3, 3).astype(onp.float32))
+    off = jnp.asarray(rng.rand(1, 2 * 2 * 9, 5, 5).astype(onp.float32) * 0.1)
+    out = sp.deformable_convolution(x, off, w, kernel=(3, 3), pad=(1, 1),
+                                    num_filter=2, num_deformable_group=2)
+    assert out.shape == (1, 2, 5, 5)
+    with pytest.raises(ValueError, match="num_group"):
+        sp.deformable_convolution(x, off, w, kernel=(3, 3), pad=(1, 1),
+                                  num_filter=2, num_group=2)
