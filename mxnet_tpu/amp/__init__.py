@@ -15,28 +15,107 @@ from typing import Optional
 import jax.numpy as jnp
 
 from .loss_scaler import LossScaler
-from .lists import FP16_FP32_FUNCS, FP16_FUNCS, FP32_FUNCS
+from .lists import (CONDITIONAL_FP32_OPS, FP16_FP32_FUNCS, FP16_FUNCS,
+                    FP32_FUNCS, FP32_OPS, TARGET_DTYPE_OPS,
+                    WIDEST_TYPE_CASTS)
 
 __all__ = ["init", "init_trainer", "scale_loss", "unscale", "convert_hybrid_block",
            "LossScaler", "mixed_precision_dtype"]
 
 _state = {"enabled": False, "dtype": jnp.bfloat16, "scaler": None}
 
+_TARGET = set(TARGET_DTYPE_OPS)
+_FP32 = set(FP32_OPS)
+_WIDEST = set(WIDEST_TYPE_CASTS)
+
+
+def _is_float(v):
+    return hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating)
+
+
+def _cast_args_for_op(name, vals, kwargs):
+    """The live cast-insertion policy (reference: amp_cast insertion in
+    `src/nnvm/low_precision_pass.cc` driven by the lists). Returns the op's
+    float inputs cast per its list membership; non-float inputs untouched.
+
+    Precedence: user target_precision_ops > fp32 lists > default target
+    list > widest-cast > conditional (attribute-keyed) entries."""
+    if name in (_state.get("user_target") or ()):
+        tgt = _state["dtype"]
+    elif name in _FP32 or name in (_state.get("user_fp32") or ()):
+        tgt = jnp.float32
+    elif name in _TARGET:
+        tgt = _state["dtype"]
+    elif name in _WIDEST:
+        floats = [v.dtype for v in vals if _is_float(v)]
+        if len(floats) < 2:
+            return vals
+        tgt = jnp.result_type(*floats)
+    else:
+        cond = _state.get("conditional") or {}
+        if name not in cond:
+            return vals
+        attr, bad = cond[name]
+        if str(kwargs.get(attr)) not in bad:
+            return vals
+        tgt = jnp.float32
+    return [v.astype(tgt) if _is_float(v) and v.dtype != tgt else v
+            for v in vals]
+
 
 def init(target_dtype="bfloat16", target_precision_ops=None,
          conditional_fp32_ops=None, fp32_ops=None):
-    """Enable AMP. target_dtype in {'bfloat16','float16'}."""
+    """Enable AMP. target_dtype in {'bfloat16','float16'}.
+
+    Installs the per-op cast hook: from here on, every `apply_op`-routed op
+    (mx.np / mx.npx / mx.nd, eager or traced) casts its float inputs per
+    the lists. `target_precision_ops` FORCES extra ops into the target
+    dtype (overrides the fp32 lists, reference semantics); `fp32_ops` adds
+    ops to the deny list; `conditional_fp32_ops` adds
+    {op: (attr, [values])} attribute-keyed fp32 routes for ops whose
+    `apply_op` call carries that attribute in kwargs."""
     dt = jnp.bfloat16 if str(target_dtype) in ("bfloat16", "bf16") else jnp.float16
     _state["enabled"] = True
     _state["dtype"] = dt
+    _state["user_fp32"] = set(fp32_ops or ())
+    _state["user_target"] = set(target_precision_ops or ())
+    cond = dict(CONDITIONAL_FP32_OPS)
+    for entry in (conditional_fp32_ops or ()):
+        op, attr, values = entry
+        cond[op] = (attr, [str(v) for v in values])
+    _state["conditional"] = cond
     if dt == jnp.float16:
         _state["scaler"] = LossScaler()
+    else:
+        # re-init with bf16 must not leave a stale fp16 scaler attached
+        old = _state.get("scaler")
+        if old is not None:
+            old.active = False
+        _state["scaler"] = None
+    import importlib
     from ..gluon import block as _block
+    _nd_mod = importlib.import_module("mxnet_tpu.ndarray.ndarray")
     _block._amp_dtype[0] = dt
+    _nd_mod._amp_cast_hook[0] = _cast_args_for_op
 
 
 def mixed_precision_dtype():
     return _state["dtype"] if _state["enabled"] else None
+
+
+def disable():
+    """Turn AMP off and uninstall the cast hook (tests / scoped usage).
+    Scalers already attached to Trainers deactivate in place."""
+    _state["enabled"] = False
+    old = _state.get("scaler")
+    if old is not None:
+        old.active = False
+    _state["scaler"] = None
+    import importlib
+    from ..gluon import block as _block
+    _nd_mod = importlib.import_module("mxnet_tpu.ndarray.ndarray")
+    _block._amp_dtype[0] = None
+    _nd_mod._amp_cast_hook[0] = None
 
 
 def init_trainer(trainer):

@@ -11,18 +11,28 @@ class LossScaler:
         self._scale_factor = scale_factor
         self._scale_window = scale_window
         self._unskipped = 0
+        # amp.disable()/re-init flips this so Trainers holding a stale
+        # reference stop scaling instead of dividing unscaled grads
+        self.active = True
 
     def has_overflow(self, params) -> bool:
-        """Check gradients for inf/nan; returns True if the step must be skipped."""
+        """True if any gradient holds inf/nan and the step must be skipped.
+
+        One fused device-side reduction + a single scalar transfer
+        (reference: the multi_all_finite kernel), not a per-parameter
+        host round-trip."""
+        import jax.numpy as jnp
+        checks = []
         for p in params:
             if getattr(p, "_data", None) is None:
                 continue  # deferred/uninitialized: no gradient to check
             g = p.grad  # ndarray or None (grad_req='null')
             if g is None:
                 continue
-            if not _onp.isfinite(g.asnumpy()).all():
-                return True
-        return False
+            checks.append(jnp.isfinite(g._data).all())
+        if not checks:
+            return False
+        return not bool(jnp.stack(checks).all())
 
     def update_scale(self, overflow: bool):
         if overflow:

@@ -102,16 +102,33 @@ class Trainer:
 
     # -- main API -------------------------------------------------------------
     def step(self, batch_size, ignore_stale_grad=False):
-        """Grad-allreduce + optimizer update (parity: trainer.py:341)."""
+        """Grad-allreduce + optimizer update (parity: trainer.py:341).
+
+        With AMP fp16 (`amp.init_trainer(trainer)`): gradients are checked
+        for inf/nan BEFORE the update — an overflowed step is skipped
+        entirely and the loss scale halves; clean steps divide the scale
+        back out (reference: `amp/loss_scaler.py` + trainer patching)."""
         self._init_kvstore()
-        self._optimizer.rescale_grad = self._scale / batch_size
-        if self._kvstore is not None and not self._update_on_kvstore:
-            # with update_on_kvstore the push inside update() both
-            # aggregates and applies the optimizer — pushing here too would
-            # apply the update twice
-            self.allreduce_grads()
-        self.update(batch_size, ignore_stale_grad=ignore_stale_grad,
-                    _already_reduced=True)
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        divisor = 1.0
+        if scaler is not None and getattr(scaler, "active", True):
+            divisor = scaler.loss_scale      # the scale this loss used
+            overflow = scaler.has_overflow(
+                [p for p in self._params if p.grad_req != "null"])
+            scaler.update_scale(overflow)
+            if overflow:
+                return      # skip: stale weights beat poisoned weights
+        self._optimizer.rescale_grad = self._scale / batch_size / divisor
+        try:
+            if self._kvstore is not None and not self._update_on_kvstore:
+                # with update_on_kvstore the push inside update() both
+                # aggregates and applies the optimizer — pushing here too
+                # would apply the update twice
+                self.allreduce_grads()
+            self.update(batch_size, ignore_stale_grad=ignore_stale_grad,
+                        _already_reduced=True)
+        finally:
+            self._optimizer.rescale_grad = self._scale / batch_size
 
     def allreduce_grads(self):
         """Parity: trainer.py:370. Single-process: kvstore aggregation."""

@@ -593,6 +593,10 @@ def wrap_like(data, ref: ndarray) -> ndarray:
 # reference's profiled ops carry start/end engine timestamps.
 _op_profile_hook: Optional[Callable[[str, float], None]] = None
 
+# installed by mxnet_tpu.amp.init(): (op_name, jax_vals, kwargs) -> jax_vals
+# with float inputs cast per the AMP lists (the reference's amp_cast pass)
+_amp_cast_hook: list = [None]
+
 
 def apply_op(fn: Callable, array_args: Sequence[ndarray], kwargs: dict,
              name: str = "op", n_out: int = 1):
@@ -623,6 +627,15 @@ def _apply_op(fn: Callable, array_args: Sequence[ndarray], kwargs: dict,
     # arrays by the sharded train step. Raw values carry no tape state;
     # the enclosing jax transform differentiates them.
     vals = [a._data if isinstance(a, ndarray) else a for a in array_args]
+    if _amp_cast_hook[0] is not None:
+        # wrap fn so the casts live INSIDE the differentiated region:
+        # cotangents are cast back to each input's dtype by JAX's
+        # convert_element_type transpose (the reference's amp_cast backward)
+        _inner, _hook = fn, _amp_cast_hook[0]
+
+        def fn(*v, **kw):  # noqa: F811
+            cast = _hook(name, list(v), kw)
+            return _inner(*cast, **kw) if kw else _inner(*cast)
     device = next((a._device for a in array_args if isinstance(a, ndarray)),
                   current_device())
 
