@@ -2,7 +2,14 @@
 # to what a single-language-core framework needs).
 PY ?= python
 
-.PHONY: test test-dist lint bench cpp docs clean
+.PHONY: ci test test-dist lint bench cpp docs clean
+
+# the one-command gate CI runs (VERDICT round-2 next-step #7): lint +
+# unit suite + 2-process dist tests + C++ package build/tests
+ci: lint test test-dist cpp-test
+
+cpp-test:
+	$(PY) -m pytest tests/unittest/test_cpp_package.py -q
 
 test:
 	$(PY) -m pytest tests/unittest -q --ignore=tests/unittest/test_dist_kvstore.py
@@ -11,7 +18,9 @@ test-dist:
 	$(PY) -m pytest tests/unittest/test_dist_kvstore.py -q
 
 lint:
-	ruff check mxnet_tpu tests || true
+	@if $(PY) -m ruff --version >/dev/null 2>&1; then \
+		$(PY) -m ruff check mxnet_tpu tests; \
+	else echo "ruff not installed; lint skipped (CI installs it)"; fi
 
 bench:
 	$(PY) bench.py

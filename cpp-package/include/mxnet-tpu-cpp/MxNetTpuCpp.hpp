@@ -137,7 +137,9 @@ class Op {
   std::string kwargs_;
 };
 
-/* A compiled model restored from HybridBlock.export artifacts. */
+/* A model: either a compiled artifact restored from HybridBlock.export
+ * (inference) or a trainable net built from a JSON spec (training —
+ * parity: the reference's cpp-package builds + trains MLPs in C++). */
 class Model {
  public:
   Model(const std::string& symbol_file, const std::string& params_file) {
@@ -145,6 +147,21 @@ class Model {
                          params_file.empty() ? nullptr : params_file.c_str(),
                          &handle_),
           "ModelLoad");
+  }
+
+  /* e.g. Model::Create("{\"type\":\"mlp\",\"in_units\":4,"
+   *                    "\"layers\":[16,2]}") */
+  static Model Create(const std::string& spec_json) {
+    MXTPUModelHandle h = nullptr;
+    Check(MXTPUModelCreate(spec_json.c_str(), &h), "ModelCreate");
+    return Model(h, 0);
+  }
+
+  void SaveParams(const std::string& path) const {
+    Check(MXTPUModelSaveParams(handle_, path.c_str()), "SaveParams");
+  }
+  void LoadParams(const std::string& path) {
+    Check(MXTPUModelLoadParams(handle_, path.c_str()), "LoadParams");
   }
   ~Model() {
     if (handle_ != nullptr) MXTPUModelFree(handle_);
@@ -169,8 +186,49 @@ class Model {
     return result;
   }
 
+  MXTPUModelHandle handle() const { return handle_; }
+
  private:
+  Model(MXTPUModelHandle h, int) : handle_(h) {}
   MXTPUModelHandle handle_ = nullptr;
+};
+
+/* Optimizer-driven training over a Model's parameters (parity: the
+ * reference's Optimizer + Executor loop in cpp-package/example/mlp.cpp). */
+class Trainer {
+ public:
+  Trainer(const Model& model, const std::string& optimizer,
+          const std::string& optimizer_params_json = "") {
+    Check(MXTPUTrainerCreate(model.handle(), optimizer.c_str(),
+                             optimizer_params_json.empty()
+                                 ? nullptr
+                                 : optimizer_params_json.c_str(),
+                             &handle_),
+          "TrainerCreate");
+  }
+  ~Trainer() {
+    if (handle_ != nullptr) MXTPUTrainerFree(handle_);
+  }
+  Trainer(const Trainer&) = delete;
+  Trainer& operator=(const Trainer&) = delete;
+
+  /* Forward + loss + backward + update; returns the mean batch loss. */
+  float Step(const Model& model,
+             const std::vector<const NDArray*>& inputs,
+             const NDArray& label, const std::string& loss = "softmax_ce") {
+    std::vector<MXTPUNDArrayHandle> hs;
+    hs.reserve(inputs.size());
+    for (const NDArray* p : inputs) hs.push_back(p->handle());
+    float out = 0.0f;
+    Check(MXTPUTrainerStep(handle_, model.handle(), hs.data(),
+                           static_cast<int>(hs.size()), label.handle(),
+                           loss.c_str(), &out),
+          "TrainerStep");
+    return out;
+  }
+
+ private:
+  MXTPUTrainerHandle handle_ = nullptr;
 };
 
 }  // namespace mxtpu

@@ -77,6 +77,37 @@ int MXTPUModelFree(MXTPUModelHandle handle);
 /* Seed the global RNG (`mx.random.seed`). */
 int MXTPURandomSeed(int seed);
 
+/* --- Training (parity: reference cpp-package Optimizer/KVStore/Executor,
+ * --- which trains models from C++ — `cpp-package/example/mlp.cpp`) ----- */
+
+typedef void* MXTPUTrainerHandle;
+
+/* Build a trainable model from a JSON spec, e.g.
+ * {"type":"mlp","in_units":4,"layers":[16,2],"activation":"relu"}.
+ * The model owns initialized parameters and can Forward immediately. */
+int MXTPUModelCreate(const char* spec_json, MXTPUModelHandle* out);
+
+/* Create an optimizer-driven trainer over the model's parameters.
+ * `optimizer` is any registered name ("sgd", "adam", ...);
+ * `optimizer_params_json` e.g. {"learning_rate": 0.1} (NULL = defaults). */
+int MXTPUTrainerCreate(MXTPUModelHandle model, const char* optimizer,
+                       const char* optimizer_params_json,
+                       MXTPUTrainerHandle* out);
+
+/* One training step: forward under autograd, `loss` in {"softmax_ce",
+ * "sigmoid_bce", "l2", "l1"}, backward, optimizer update (batch size is
+ * label's leading dim). Writes the mean batch loss to `loss_out`. */
+int MXTPUTrainerStep(MXTPUTrainerHandle trainer, MXTPUModelHandle model,
+                     MXTPUNDArrayHandle* inputs, int n_in,
+                     MXTPUNDArrayHandle label, const char* loss,
+                     float* loss_out);
+
+int MXTPUTrainerFree(MXTPUTrainerHandle handle);
+
+/* Parameter checkpointing (`save_parameters`/`load_parameters`). */
+int MXTPUModelSaveParams(MXTPUModelHandle model, const char* path);
+int MXTPUModelLoadParams(MXTPUModelHandle model, const char* path);
+
 #ifdef __cplusplus
 }  /* extern "C" */
 #endif

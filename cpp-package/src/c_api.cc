@@ -62,6 +62,64 @@ def model_forward(model, inputs):
 
 def seed(s):
     mx.random.seed(s)
+
+# --- training surface (parity: reference cpp-package Optimizer/Executor,
+# --- `cpp-package/example/mlp.cpp` trains an MLP from C++) ---------------
+
+def model_create(spec_json):
+    """Build a trainable Gluon net from a JSON spec:
+    {"type": "mlp", "in_units": N, "layers": [h1, ..., out],
+     "activation": "relu"}."""
+    spec = json.loads(spec_json)
+    from mxnet_tpu.gluon import nn
+    if spec.get('type', 'mlp') != 'mlp':
+        raise ValueError(f"unknown model type {spec.get('type')!r}")
+    net = nn.HybridSequential()
+    first = in_units = int(spec['in_units'])
+    act = spec.get('activation', 'relu')
+    layers = [int(w) for w in spec['layers']]
+    for i, width in enumerate(layers):
+        net.add(nn.Dense(width, in_units=in_units,
+                         activation=None if i == len(layers) - 1 else act))
+        in_units = width
+    net.initialize()
+    net.hybridize()
+    net(mx.np.zeros((1, first)))
+    return net
+
+def trainer_create(model, opt_name, opt_params_json):
+    from mxnet_tpu import gluon
+    kw = json.loads(opt_params_json) if opt_params_json else {}
+    return gluon.Trainer(model.collect_params(), opt_name, kw)
+
+_LOSSES = None
+
+def train_step(model, trainer, inputs, label, loss_name):
+    global _LOSSES
+    from mxnet_tpu import autograd, gluon
+    if _LOSSES is None:
+        _LOSSES = {
+            'softmax_ce': gluon.loss.SoftmaxCrossEntropyLoss,
+            'sigmoid_bce': gluon.loss.SigmoidBinaryCrossEntropyLoss,
+            'l2': gluon.loss.L2Loss,
+            'l1': gluon.loss.L1Loss,
+        }
+    if loss_name not in _LOSSES:
+        raise ValueError(
+            f'unknown loss {loss_name!r}; one of {sorted(_LOSSES)}')
+    loss_fn = _LOSSES[loss_name]()
+    with autograd.record():
+        out = model(*inputs)
+        loss = loss_fn(out, label)
+    loss.backward()
+    trainer.step(int(label.shape[0]))
+    return float(loss.mean().asnumpy())
+
+def model_save_params(model, path):
+    model.save_parameters(path)
+
+def model_load_params(model, path):
+    model.load_parameters(path)
 )PY";
 
 void set_error_from_python() {
@@ -301,6 +359,78 @@ int MXTPURandomSeed(int seed) {
   MXTPU_REQUIRE_INIT();
   GILGuard gil;
   PyObject* r = PyObject_CallFunction(helper("seed"), "i", seed);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+/* --- training (parity: reference cpp-package Optimizer/Executor) ------ */
+
+int MXTPUModelCreate(const char* spec_json, MXTPUModelHandle* out) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  PyObject* r = PyObject_CallFunction(helper("model_create"), "s", spec_json);
+  if (!r) { set_error_from_python(); return -1; }
+  *out = r;
+  return 0;
+}
+
+int MXTPUTrainerCreate(MXTPUModelHandle model, const char* optimizer,
+                       const char* optimizer_params_json,
+                       MXTPUTrainerHandle* out) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  PyObject* r = PyObject_CallFunction(
+      helper("trainer_create"), "Oss", static_cast<PyObject*>(model),
+      optimizer, optimizer_params_json ? optimizer_params_json : "");
+  if (!r) { set_error_from_python(); return -1; }
+  *out = r;
+  return 0;
+}
+
+int MXTPUTrainerStep(MXTPUTrainerHandle trainer, MXTPUModelHandle model,
+                     MXTPUNDArrayHandle* inputs, int n_in,
+                     MXTPUNDArrayHandle label, const char* loss,
+                     float* loss_out) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  PyObject* ins = PyTuple_New(n_in);
+  for (int i = 0; i < n_in; ++i) {
+    PyObject* o = static_cast<PyObject*>(inputs[i]);
+    Py_INCREF(o);
+    PyTuple_SET_ITEM(ins, i, o);
+  }
+  PyObject* r = PyObject_CallFunction(
+      helper("train_step"), "OOOOs", static_cast<PyObject*>(model),
+      static_cast<PyObject*>(trainer), ins,
+      static_cast<PyObject*>(label), loss);
+  Py_DECREF(ins);
+  if (!r) { set_error_from_python(); return -1; }
+  *loss_out = static_cast<float>(PyFloat_AsDouble(r));
+  Py_DECREF(r);
+  if (PyErr_Occurred()) { set_error_from_python(); return -1; }
+  return 0;
+}
+
+int MXTPUTrainerFree(MXTPUTrainerHandle handle) {
+  return MXTPUNDArrayFree(handle);
+}
+
+int MXTPUModelSaveParams(MXTPUModelHandle model, const char* path) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  PyObject* r = PyObject_CallFunction(
+      helper("model_save_params"), "Os", static_cast<PyObject*>(model), path);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUModelLoadParams(MXTPUModelHandle model, const char* path) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  PyObject* r = PyObject_CallFunction(
+      helper("model_load_params"), "Os", static_cast<PyObject*>(model), path);
   if (!r) { set_error_from_python(); return -1; }
   Py_DECREF(r);
   return 0;
