@@ -1,7 +1,10 @@
 # TPU ablation suite (run manually when the tunnel is healthy):
 #   python bench_results/perf_ablation_suite.py
-# Sections: A0 bench(masked head), A full-seq head, B no dropout,
-# C dummy loss, D SGD, E small vocab, F matmul ceiling, G GPT-2k flash+remat.
+# Sections: A0 bench(masked head+padding mask), A full-seq head,
+# B no dropout, C dummy loss, D SGD, E small vocab, F matmul ceiling,
+# G GPT-2k flash+remat, H masked-flash vs reference-attention (round 3:
+# masks now stay on the Pallas path — H measures the kernel's win on
+# production-shaped batches).
 """TPU step-time ablations for the BERT bench. One process, incremental
 prints, clean exit. Identifies where the 117ms (vs ~28ms ideal) goes."""
 import sys, time, functools
@@ -113,6 +116,54 @@ fl = 24 * 2 * 2 * batch * seq * 768 * 3072 / (t / 1e3)
 print(f"F matmul chain: {t:.2f} ms -> {fl/1e12:.1f} TF/s")
 
 print("RESULTS", results)
+
+# H. masked attention: flash kernel vs XLA reference path (padding masks)
+import os as _os2
+
+def build_masked_step(cfg):
+    model = BertForPretraining(cfg)
+    model.initialize()
+    rng = onp.random.RandomState(0)
+    ids = mx.np.array(rng.randint(0, cfg.vocab_size, (batch, seq)),
+                      dtype="int32")
+    vlen = mx.np.array(rng.randint(int(0.85 * seq), seq + 1, (batch,)),
+                       dtype="int32")
+    labels = mx.np.array(rng.randint(0, cfg.vocab_size, (batch, seq)),
+                         dtype="int32")
+    model(ids, valid_length=vlen)
+
+    def loss_mlm(out, input_ids, vl, lbl):
+        mlm, nsp = out
+        logp = jax.nn.log_softmax(mlm.astype(jnp.float32), axis=-1)
+        ll = jnp.take_along_axis(logp, lbl[..., None].astype(jnp.int32),
+                                 axis=-1)
+        return -jnp.mean(ll)
+
+    from mxnet_tpu.gluon.block import HybridBlock
+
+    class W(HybridBlock):
+        def __init__(self, m):
+            super().__init__()
+            self.m = m
+
+        def forward(self, i, vl):
+            return self.m(i, valid_length=vl)
+
+    w = W(model)
+    mesh = make_mesh({"dp": 1}, jax.devices()[:1])
+    step = make_sharded_train_step(w, opt.Adam(learning_rate=1e-4),
+                                   loss_mlm, mesh, num_model_args=2)
+    return lambda: step(ids, vlen, labels)
+
+f = build_masked_step(BertConfig(dtype="bfloat16"))
+results["H_masked_flash"] = timed(f)
+print("H masked (flash kernel):", results["H_masked_flash"], "ms")
+
+_os2.environ["MXTPU_DISABLE_FLASH"] = "1"
+f = build_masked_step(BertConfig(dtype="bfloat16"))
+results["H_masked_reference"] = timed(f)
+print("H masked (XLA reference):", results["H_masked_reference"], "ms")
+del _os2.environ["MXTPU_DISABLE_FLASH"]
 
 # G. long-context GPT: seq 2048, flash attention + per-layer remat
 try:

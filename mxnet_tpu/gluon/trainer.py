@@ -131,10 +131,13 @@ class Trainer:
             self._optimizer.rescale_grad = self._scale / batch_size
 
     def allreduce_grads(self):
-        """Parity: trainer.py:370. Single-process: kvstore aggregation."""
+        """Parity: trainer.py:370. Single-process: kvstore aggregation.
+        All parameters go in ONE multi-key call so a dist store pays one
+        host collective per step, not one per parameter."""
         self._init_kvstore()
         if self._kvstore is None:
             return
+        idx, grads = [], []
         for i, p in enumerate(self._params):
             if getattr(p.grad, "stype", "default") == "row_sparse":
                 raise MXNetError(
@@ -144,10 +147,14 @@ class Trainer:
                     "Trainer(..., kvstore=None) or Embedding("
                     "sparse_grad=False).")
             if p.grad_req != "null":
-                if self._update_on_kvstore:
-                    self._kvstore.push(i, p.grad)
-                else:
-                    self._kvstore.pushpull(i, p.grad, out=p.grad)
+                idx.append(i)
+                grads.append(p.grad)
+        if not idx:
+            return
+        if self._update_on_kvstore:
+            self._kvstore.push(idx, grads)
+        else:
+            self._kvstore.pushpull(idx, grads, out=grads)
 
     def update(self, batch_size, ignore_stale_grad=False,
                _already_reduced=False):

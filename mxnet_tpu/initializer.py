@@ -35,10 +35,12 @@ class Initializer:
             self._init_gamma(name, arr)
         elif name.endswith("beta"):
             self._init_beta(name, arr)
-        elif name.endswith("running_mean") or name.endswith("running_var") or \
-                name.endswith("moving_mean"):
+        elif name.endswith("running_mean") or name.endswith("moving_mean"):
             self._init_zero(name, arr)
-        elif name.endswith("moving_var"):
+        elif name.endswith("running_var") or name.endswith("moving_var"):
+            # variance starts at ONE (ref initializer.py:208) — zero-init
+            # running_var makes inference-mode BatchNorm divide by
+            # sqrt(eps) and untrained deep nets (DenseNet etc.) blow up
             self._init_one(name, arr)
         elif name.endswith("bias"):
             self._init_bias(name, arr)

@@ -301,6 +301,31 @@ def _convert_eqn(g: _Graph, eqn):
         steps = g.add_const(_onp.asarray(strides, _onp.int64))
         g.add_node("Slice", [ins[0], starts, ends, axes, steps], outs)
         return
+    if prim == "rev":
+        # ONNX reverse = Slice with step -1 on each reversed axis
+        # (end = INT64_MIN sentinel per the ONNX spec)
+        dims = list(p["dimensions"])
+        starts = g.add_const(_onp.full(len(dims), -1, _onp.int64))
+        ends = g.add_const(_onp.full(len(dims), _onp.iinfo(_onp.int64).min,
+                                     _onp.int64))
+        axes = g.add_const(_onp.asarray(dims, _onp.int64))
+        steps = g.add_const(_onp.full(len(dims), -1, _onp.int64))
+        g.add_node("Slice", [ins[0], starts, ends, axes, steps], outs)
+        return
+    if prim == "split":
+        axis = int(p["axis"])
+        off = 0
+        for out_name, size in zip(outs, p["sizes"]):
+            starts = g.add_const(_onp.asarray([off], _onp.int64))
+            ends = g.add_const(_onp.asarray([off + int(size)], _onp.int64))
+            axes = g.add_const(_onp.asarray([axis], _onp.int64))
+            g.add_node("Slice", [ins[0], starts, ends, axes], [out_name])
+            off += int(size)
+        return
+    if prim == "tile":
+        reps = g.add_const(_onp.asarray(p["reps"], _onp.int64))
+        g.add_node("Tile", [ins[0], reps], outs)
+        return
     if prim == "pad":
         lo_hi_interior = p["padding_config"]
         if any(i != 0 for _, _, i in lo_hi_interior):

@@ -243,8 +243,18 @@ def run_model(model_bytes: bytes, inputs: Dict[str, _onp.ndarray]):
                 [1] * len(starts)
             sl = [slice(None)] * ins[0].ndim
             for ax, st, en, sp in zip(axes, starts, ends, steps):
+                dim = ins[0].shape[ax]
+                # ONNX clamping semantics: out-of-range ends mean "to the
+                # boundary" (INT64_MIN end + step -1 reverses a full axis;
+                # numpy would misread it as a tiny negative index)
+                if sp < 0 and en < -dim:
+                    en = None
+                elif sp > 0 and en > dim:
+                    en = dim
                 sl[ax] = slice(st, en, sp)
             out = ins[0][tuple(sl)]
+        elif op == "Tile":
+            out = _onp.tile(ins[0], [int(v) for v in ins[1]])
         elif op == "Pad":
             pads = [int(v) for v in ins[1]]
             nd_ = ins[0].ndim

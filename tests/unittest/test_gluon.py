@@ -295,3 +295,15 @@ def test_model_zoo_reference_registry_names():
     for name in ref_names:
         net = vision.get_model(name)
         assert net is not None, name
+
+
+def test_batchnorm_running_var_inits_to_one():
+    """ref initializer.py:208: variance starts at ONE — zero-init made
+    inference-mode BN divide by sqrt(eps) (found via DenseNet ONNX sweep)."""
+    bn = mx.gluon.nn.BatchNorm(in_channels=3)
+    bn.initialize()
+    bn(mx.np.zeros((1, 3, 2, 2)))
+    onp.testing.assert_allclose(
+        onp.asarray(bn.running_var.data().asnumpy()), 1.0)
+    onp.testing.assert_allclose(
+        onp.asarray(bn.running_mean.data().asnumpy()), 0.0)

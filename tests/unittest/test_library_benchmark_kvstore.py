@@ -197,3 +197,17 @@ def test_gradient_compression_wire_roundtrip():
 def _nd(jarr):
     from mxnet_tpu.ndarray.ndarray import from_jax
     return from_jax(jarr)
+
+
+def test_push_repeated_key_applies_each():
+    """A key repeated within one push must hit the updater once per
+    occurrence (reference server semantics; review regression guard)."""
+    import mxnet_tpu as mx
+    kv = mx.kv.create("local")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0))
+    kv.init("w", mx.np.ones((3,)))
+    kv.push(["w", "w"], [mx.np.full((3,), 1.0), mx.np.full((3,), 2.0)])
+    out = mx.np.zeros((3,))
+    kv.pull("w", out=out)
+    # w = 1 - 1*1 - 1*2 = -2 (both gradients applied in order)
+    onp.testing.assert_allclose(onp.asarray(out.asnumpy()), -2.0)
