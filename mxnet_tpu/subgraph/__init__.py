@@ -72,7 +72,11 @@ class SubgraphBackend:
             matches = []
             claimed = set()
             for matcher in backend.matchers():
-                for m in matcher(closed.jaxpr):
+                try:      # new-style matchers also see the const VALUES
+                    found = matcher(closed.jaxpr, consts=closed.consts)
+                except TypeError:
+                    found = matcher(closed.jaxpr)
+                for m in found:
                     if m.eqn_ids & claimed:
                         continue  # first matcher wins overlaps
                     matches.append(m)

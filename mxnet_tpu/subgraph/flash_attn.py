@@ -12,8 +12,16 @@ hand-written attention a user block would contain):
     O  = dot_general(P, V)      # contract lhs[3] with rhs[2]
 
 The whole chain — including the (L, L) intermediates — is replaced with one
-`flash_attention(Q, K, V, scale)` call. Masked/causal variants are not
-matched (the `where`-mask breaks the chain) and fall through untouched.
+`flash_attention(Q, K, V, scale)` call.  Since round 3 the `where`-masked
+variant is matched too:
+
+    S'' = select_n(mask, fill, S')   # jnp.where(mask, S', -1e30)
+
+becomes the kernel's additive-bias input (`where(mask, 0, MASK_VALUE)`),
+so padding/causal masks keep the (L, L)-free kernel.  Only BOOLEAN masks
+with a large-negative literal fill are matched — a learned additive bias
+must not be fused because the kernel treats bias as a constant (zero
+cotangent), and those chains fall through untouched.
 
 Parity: this is the TPU analog of the reference's oneDNN/TensorRT subgraph
 properties (`src/operator/subgraph/dnnl/`, `subgraph_property.h:265`) —
@@ -74,11 +82,79 @@ def _is_context_dot(eqn):
             and tuple(lc) == (3,) and tuple(rc) == (2,))
 
 
-def _match_attention(jaxpr):
+def _fill_value(producers, constmap, var, guard=5):
+    """Resolve `var` to a python scalar if it is a (possibly broadcast/
+    cast) scalar constant; else None."""
+    for _ in range(guard):
+        if isinstance(var, jcore.Literal):
+            arr = onp.asarray(var.val)
+            return float(arr.ravel()[0]) if arr.size else None
+        if var in constmap:
+            arr = onp.asarray(constmap[var])
+            return float(arr.ravel()[0]) if arr.size == 1 else None
+        pe = producers.get(var)
+        if pe is None:
+            return None
+        _, e = pe
+        if e.primitive.name not in ("broadcast_in_dim",
+                                    "convert_element_type", "reshape",
+                                    "device_put", "squeeze"):
+            return None
+        var = e.invars[0]
+    return None
+
+
+def _where_jit_parts(eqn):
+    """`jnp.where` traces as a nested jit holding one select_n. Return
+    (pred_idx, fill_spec, true_idx) mapping the outer eqn's invars, where
+    fill_spec is (invar_idx, literal) — whichever resolved. None if the
+    eqn is not a where-shaped jit."""
+    if eqn.primitive.name not in ("pjit", "jit", "closed_call"):
+        return None
+    inner = eqn.params.get("jaxpr")
+    if inner is None:
+        return None
+    ij = inner.jaxpr if hasattr(inner, "jaxpr") else inner
+    if len(ij.outvars) != 1 or len(ij.eqns) > 6:
+        return None
+    sels = [e for e in ij.eqns if e.primitive.name == "select_n"]
+    if len(sels) != 1 or len(sels[0].invars) != 3:
+        return None
+    se = sels[0]
+    if ij.outvars[0] is not se.outvars[0]:
+        return None
+    prod = {}
+    for e in ij.eqns:
+        for ov in e.outvars:
+            prod[ov] = e
+
+    def resolve(v, guard=4):
+        for _ in range(guard):
+            if isinstance(v, jcore.Literal):
+                return None, v
+            if v in ij.invars:
+                return ij.invars.index(v), None
+            e = prod.get(v)
+            if e is None or e.primitive.name not in (
+                    "broadcast_in_dim", "convert_element_type", "reshape"):
+                return None, None
+            v = e.invars[0]
+        return None, None
+
+    pred_idx, pred_lit = resolve(se.invars[0])
+    fill_spec = resolve(se.invars[1])
+    true_idx, true_lit = resolve(se.invars[2])
+    if pred_idx is None or true_idx is None or pred_lit is not None:
+        return None
+    return pred_idx, fill_spec, true_idx
+
+
+def _match_attention(jaxpr, consts=None):
     """Scan for softmax(QK^T)V chains; return Matches."""
     from ..ops.pallas.flash_attention import flash_attention
 
     consumers = build_consumer_map(jaxpr)
+    constmap = dict(zip(jaxpr.constvars, consts or ()))
     producers = {}
     for i, eqn in enumerate(jaxpr.eqns):
         for v in eqn.outvars:
@@ -92,8 +168,10 @@ def _match_attention(jaxpr):
         q_var, k_var = eqn.invars[0], eqn.invars[1]
         cur = eqn.outvars[0]
         scale = 1.0
+        mask_var = None      # boolean mask from a where(mask, S, -big)
 
-        # optional scalar scaling (mul/div by literal), possibly repeated
+        # optional scalar scaling (mul/div by literal) and/or ONE boolean
+        # where-mask with a large-negative fill, in any order
         while True:
             cons = _sole_consumers(consumers, cur)
             if len(cons) != 1 or cons[0][0] < 0:
@@ -106,6 +184,46 @@ def _match_attention(jaxpr):
                     break
                 scale = scale * lit if e2.primitive.name == "mul" \
                     else scale / lit
+                matched.add(j)
+                cur = e2.outvars[0]
+            elif e2.primitive.name == "select_n" and mask_var is None \
+                    and len(e2.invars) == 3:
+                pred, c0, c1 = e2.invars
+                pred_aval = getattr(pred, "aval", None)
+                if pred_aval is None or pred_aval.dtype != onp.bool_:
+                    break
+                # jnp.where(mask, S, fill) -> select_n(mask, fill, S):
+                # S must be the TRUE case and fill a huge-negative
+                # constant (chased through its producer chain)
+                if c1 is not cur:
+                    break
+                fill = _fill_value(producers, constmap, c0)
+                if fill is None or fill > -1e9:
+                    break
+                mask_var = pred
+                matched.add(j)
+                cur = e2.outvars[0]
+            elif mask_var is None and _where_jit_parts(e2) is not None:
+                # jnp.where wrapped in its nested jit
+                pred_idx, (fill_idx, fill_lit), true_idx = \
+                    _where_jit_parts(e2)
+                if e2.invars[true_idx] is not cur:
+                    break
+                pred = e2.invars[pred_idx]
+                if getattr(pred, "aval", None) is None or \
+                        pred.aval.dtype != onp.bool_:
+                    break
+                if fill_lit is not None:
+                    arr = onp.asarray(fill_lit.val)
+                    fill = float(arr.ravel()[0]) if arr.size else None
+                elif fill_idx is not None:
+                    fill = _fill_value(producers, constmap,
+                                       e2.invars[fill_idx])
+                else:
+                    fill = None
+                if fill is None or fill > -1e9:
+                    break
+                mask_var = pred
                 matched.add(j)
                 cur = e2.outvars[0]
             else:
@@ -212,12 +330,27 @@ def _match_attention(jaxpr):
         out_aval = out_var.aval
         s = scale
 
-        def fused(q, k, v, _s=s, _dt=out_aval.dtype):
-            return flash_attention(q, k, v, causal=False,
-                                   scale=_s).astype(_dt)
+        if mask_var is None:
+            def fused(q, k, v, _s=s, _dt=out_aval.dtype):
+                return flash_attention(q, k, v, causal=False,
+                                       scale=_s).astype(_dt)
+            invars = [q_var, k_var, v_var]
+        else:
+            def fused(q, k, v, m, _s=s, _dt=out_aval.dtype):
+                import jax.numpy as jnp
+                from ..ops.pallas.flash_attention import MASK_VALUE
+                bias = jnp.where(m, 0.0, MASK_VALUE).astype(jnp.float32)
+                while bias.ndim < 4:
+                    bias = bias[None]
+                if bias.shape[0] == 1 and q.shape[0] != 1:
+                    bias = jnp.broadcast_to(
+                        bias, (q.shape[0],) + bias.shape[1:])
+                return flash_attention(q, k, v, causal=False, scale=_s,
+                                       bias=bias).astype(_dt)
+            invars = [q_var, k_var, v_var, mask_var]
 
         matches.append(Match(eqn_ids=frozenset(matched),
-                             invars=[q_var, k_var, v_var],
+                             invars=invars,
                              outvars=[out_var], fn=fused,
                              name="flash_attention"))
     return matches
