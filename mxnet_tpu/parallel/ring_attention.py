@@ -30,24 +30,34 @@ NEG_INF = -1e30
 def _block_attn(q, k, v, mask):
     """Unnormalised blockwise attention: returns (acc, m, l).
 
-    q: (B,H,Lq,D); k,v: (B,H,Lk,D); mask broadcastable (B,H,Lq,Lk) or None."""
+    q: (B,H,Lq,D); k,v: (B,H,Lk,D); mask broadcastable (B,H,Lq,Lk) or None.
+    Masked entries contribute exactly zero (a fully-masked row yields
+    l = 0 → zero output), matching the flash kernel's masked-softmax
+    semantics."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32)
     if mask is not None:
         s = jnp.where(mask, s, NEG_INF)
     m = jnp.max(s, axis=-1)                      # (B,H,Lq)
     p = jnp.exp(s - m[..., None])
+    if mask is not None:
+        # an all-masked row has m == NEG_INF and would otherwise give
+        # p == 1 uniformly (the exp(NEG_INF - NEG_INF) trap)
+        p = jnp.where(s > 0.5 * NEG_INF, p, 0.0)
     l = jnp.sum(p, axis=-1)                      # (B,H,Lq)
     acc = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
     return acc, m, l
 
 
-def ring_attention_sharded(q, k, v, axis_name: str = "sp",
+def ring_attention_sharded(q, k, v, kv_mask=None, axis_name: str = "sp",
                            causal: bool = False, scale: Optional[float] = None):
     """Attention over sequence-sharded q/k/v — call INSIDE shard_map.
 
     q, k, v: local shards (B, H, L_local, D); the sequence axis is sharded
-    over `axis_name`. Returns the local output shard (B, H, L_local, D).
+    over `axis_name`. `kv_mask` is the LOCAL key-validity shard (B,
+    L_local) bool — it rides the ring alongside its keys, so padded
+    long-context batches stay O(L/n · L/n) per device. Returns the local
+    output shard (B, H, L_local, D).
     """
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
@@ -63,17 +73,22 @@ def ring_attention_sharded(q, k, v, axis_name: str = "sp",
     m0 = zq[..., 0] + NEG_INF
     l0 = zq[..., 0]
     acc0 = zq
+    has_mask = kv_mask is not None
+    # the dummy all-valid mask derives from k so it carries the same
+    # sp-varying manual-axes type as the rotated carries (see zq above)
+    mk0 = kv_mask if has_mask else (k[:, 0, :, 0] * 0 == 0)
 
     def step(carry, t):
-        acc, m, l, kk, vv = carry
+        acc, m, l, kk, vv, mk = carry
         src = (my - t) % n  # which global shard kk currently holds
+        mask = None
         if causal:
             qpos = my * lq + jnp.arange(lq)
             kpos = src * kk.shape[2] + jnp.arange(kk.shape[2])
-            mask = qpos[:, None] >= kpos[None, :]
-            mask = mask[None, None]
-        else:
-            mask = None
+            mask = (qpos[:, None] >= kpos[None, :])[None, None]
+        if has_mask:
+            kvm = mk[:, None, None, :]           # (B,1,1,Lk)
+            mask = kvm if mask is None else (mask & kvm)
         a, bm, bl = _block_attn(q, kk, vv, mask)
         m_new = jnp.maximum(m, bm)
         alpha = jnp.exp(m - m_new)
@@ -81,15 +96,20 @@ def ring_attention_sharded(q, k, v, axis_name: str = "sp",
         l = l * alpha + bl * beta
         acc = acc * alpha[..., None] + a * beta[..., None]
         m = m_new
-        # rotate k/v to the next device (skip the final rotate's result use,
-        # but keep it unconditional so the comm schedule is static)
-        kk = lax.ppermute(kk, axis_name, [(i, (i + 1) % n) for i in range(n)])
-        vv = lax.ppermute(vv, axis_name, [(i, (i + 1) % n) for i in range(n)])
-        return (acc, m, l, kk, vv), None
+        # rotate k/v (+ their validity mask) to the next device (skip the
+        # final rotate's result use, but keep it unconditional so the comm
+        # schedule is static)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        kk = lax.ppermute(kk, axis_name, perm)
+        vv = lax.ppermute(vv, axis_name, perm)
+        mk = lax.ppermute(mk, axis_name, perm)
+        return (acc, m, l, kk, vv, mk), None
 
-    (acc, m, l, _, _), _ = lax.scan(step, (acc0, m0, l0, k, v),
-                                    jnp.arange(n))
-    out = acc / jnp.maximum(l[..., None], 1e-38)
+    (acc, m, l, _, _, _), _ = lax.scan(step, (acc0, m0, l0, k, v, mk0),
+                                       jnp.arange(n))
+    # explicit zero guard: a subnormal epsilon (1e-38) flushes to zero
+    # under f32 FTZ, turning fully-masked rows into 0/0 = NaN
+    out = acc / jnp.where(l[..., None] > 0, l[..., None], 1.0)
     return out.astype(q.dtype)
 
 
@@ -108,9 +128,24 @@ def seq_sharded_call(fn, q, k, v, mesh: Mesh, axis_name: str = "sp",
 
 def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
                    causal: bool = False, scale: Optional[float] = None,
-                   batch_axis: Optional[str] = "dp"):
+                   batch_axis: Optional[str] = "dp", kv_mask=None):
     """Top-level ring attention over (B, H, L, D) jax arrays; composes
-    under jit/pjit."""
-    fn = functools.partial(ring_attention_sharded, axis_name=axis_name,
-                           causal=causal, scale=scale)
-    return seq_sharded_call(fn, q, k, v, mesh, axis_name, batch_axis)
+    under jit/pjit. `kv_mask` is a (B, L) bool key-validity mask (padded
+    long-context batches), sequence-sharded like k/v."""
+    if kv_mask is None:
+        fn = functools.partial(ring_attention_sharded, axis_name=axis_name,
+                               causal=causal, scale=scale)
+        return seq_sharded_call(fn, q, k, v, mesh, axis_name, batch_axis)
+    axes = set(mesh.axis_names)
+    bspec = batch_axis if (batch_axis and batch_axis in axes) else None
+    spec = P(bspec, None, axis_name, None)
+    mspec = P(bspec, axis_name)
+
+    def fn(qq, kk, vv, mm):
+        return ring_attention_sharded(qq, kk, vv, kv_mask=mm,
+                                      axis_name=axis_name, causal=causal,
+                                      scale=scale)
+
+    mapped = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec, mspec),
+                       out_specs=spec)
+    return mapped(q, k, v, kv_mask)

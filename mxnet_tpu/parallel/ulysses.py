@@ -26,17 +26,21 @@ from ..base import MXNetError
 __all__ = ["ulysses_attention", "ulysses_attention_sharded"]
 
 
-def ulysses_attention_sharded(q, k, v, axis_name: str = "sp",
+def ulysses_attention_sharded(q, k, v, kv_mask=None, axis_name: str = "sp",
                               causal: bool = False,
                               scale: Optional[float] = None,
                               attn_fn=None):
     """Attention over sequence-sharded q/k/v — call INSIDE shard_map.
 
     q, k, v: local shards (B, H, L_local, D) with the sequence axis sharded
-    over `axis_name`. Returns the local (B, H, L_local, D) output shard.
+    over `axis_name`. `kv_mask` is the LOCAL (B, L_local) key-validity
+    shard; an all_gather over the tiny bool vector rebuilds the full-
+    sequence mask each device needs after the head scatter. Returns the
+    local (B, H, L_local, D) output shard.
 
-    `attn_fn(q, k, v, causal=..., scale=...)` runs on full-sequence,
-    head-sharded blocks; defaults to the flash/reference dispatcher.
+    `attn_fn(q, k, v, mask=..., causal=..., scale=...)` runs on
+    full-sequence, head-sharded blocks; defaults to the flash/reference
+    dispatcher (masks stay on the Pallas kernel as its bias input).
     """
     n = lax.axis_size(axis_name)
     b, h, l_loc, d = q.shape
@@ -55,7 +59,14 @@ def ulysses_attention_sharded(q, k, v, axis_name: str = "sp",
     qh, kh, vh = (lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
                                  tiled=True) for x in (q, k, v))
 
-    out = attn_fn(qh, kh, vh, causal=causal, scale=scale)  # (B, H/n, L, D)
+    kwargs = {}
+    if kv_mask is not None:
+        # (B, L_local) -> (B, L): bool gather is L bytes, negligible next
+        # to the activation all-to-alls
+        full = lax.all_gather(kv_mask, axis_name, axis=1, tiled=True)
+        kwargs["mask"] = full[:, None, None, :]
+    out = attn_fn(qh, kh, vh, causal=causal, scale=scale,
+                  **kwargs)                                  # (B, H/n, L, D)
 
     # inverse: scatter sequence back, gather heads
     return lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
@@ -64,10 +75,29 @@ def ulysses_attention_sharded(q, k, v, axis_name: str = "sp",
 
 def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
                       causal: bool = False, scale: Optional[float] = None,
-                      batch_axis: Optional[str] = "dp", attn_fn=None):
+                      batch_axis: Optional[str] = "dp", attn_fn=None,
+                      kv_mask=None):
     """Top-level Ulysses attention over (B, H, L, D) jax arrays; composes
-    under jit/pjit like `ring_attention`."""
+    under jit/pjit like `ring_attention`. `kv_mask` is a (B, L) bool
+    key-validity mask, sequence-sharded like k/v."""
     from .ring_attention import seq_sharded_call
-    fn = functools.partial(ulysses_attention_sharded, axis_name=axis_name,
-                           causal=causal, scale=scale, attn_fn=attn_fn)
-    return seq_sharded_call(fn, q, k, v, mesh, axis_name, batch_axis)
+    if kv_mask is None:
+        fn = functools.partial(ulysses_attention_sharded,
+                               axis_name=axis_name, causal=causal,
+                               scale=scale, attn_fn=attn_fn)
+        return seq_sharded_call(fn, q, k, v, mesh, axis_name, batch_axis)
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+    axes = set(mesh.axis_names)
+    bspec = batch_axis if (batch_axis and batch_axis in axes) else None
+    spec = P(bspec, None, axis_name, None)
+    mspec = P(bspec, axis_name)
+
+    def fn(qq, kk, vv, mm):
+        return ulysses_attention_sharded(qq, kk, vv, kv_mask=mm,
+                                         axis_name=axis_name, causal=causal,
+                                         scale=scale, attn_fn=attn_fn)
+
+    mapped = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec, mspec),
+                       out_specs=spec)
+    return mapped(q, k, v, kv_mask)

@@ -517,3 +517,56 @@ def test_grad_accum_divisibility_error():
     y = mx.np.array(onp.ones((8, 2), dtype="float32"))
     with pytest.raises(mx.MXNetError, match="must divide"):
         step(x, y)
+
+
+def test_ring_attention_with_kv_mask():
+    """Padded long-context batches: the key-validity mask rides the ring
+    with its keys; result matches masked reference attention, and rows
+    whose keys are ALL padded come out zero (round-3)."""
+    onp.random.seed(5)
+    b, h, l, d = 2, 2, 16, 8
+    q = jnp.asarray(onp.random.normal(size=(b, h, l, d)).astype(onp.float32))
+    k = jnp.asarray(onp.random.normal(size=(b, h, l, d)).astype(onp.float32))
+    v = jnp.asarray(onp.random.normal(size=(b, h, l, d)).astype(onp.float32))
+    valid = onp.array([11, 16])
+    kv_mask = jnp.asarray(onp.arange(l)[None, :] < valid[:, None])
+    mesh = make_mesh({"sp": 4}, _cpu_devices(4))
+    out = ring_attention(q, k, v, mesh, axis_name="sp",
+                         kv_mask=kv_mask)
+    want = reference_attention(q, k, v, mask=kv_mask[:, None, None, :])
+    assert_almost_equal(onp.asarray(out), onp.asarray(want),
+                        rtol=1e-4, atol=1e-4)
+
+    # causal x padding composition
+    out_c = ring_attention(q, k, v, mesh, axis_name="sp", causal=True,
+                           kv_mask=kv_mask)
+    cm = onp.tril(onp.ones((l, l), bool))[None, None]
+    full = cm & onp.asarray(kv_mask)[:, None, None, :]
+    want_c = reference_attention(q, k, v, mask=jnp.asarray(full))
+    assert_almost_equal(onp.asarray(out_c), onp.asarray(want_c),
+                        rtol=1e-4, atol=1e-4)
+
+    # fully-padded batch row -> zeros, not NaN/mean(V)
+    all_pad = jnp.zeros((b, l), bool)
+    out_z = ring_attention(q, k, v, mesh, axis_name="sp", kv_mask=all_pad)
+    assert_almost_equal(onp.asarray(out_z), onp.zeros_like(onp.asarray(q)),
+                        rtol=0, atol=1e-6)
+
+
+def test_ulysses_attention_with_kv_mask():
+    """Ulysses SP with padded batches: the (B, L_local) validity shard is
+    all-gathered (bool, tiny) after the head scatter; matches masked
+    reference attention."""
+    onp.random.seed(6)
+    b, h, l, d = 2, 4, 16, 8
+    q = jnp.asarray(onp.random.normal(size=(b, h, l, d)).astype(onp.float32))
+    k = jnp.asarray(onp.random.normal(size=(b, h, l, d)).astype(onp.float32))
+    v = jnp.asarray(onp.random.normal(size=(b, h, l, d)).astype(onp.float32))
+    valid = onp.array([9, 16])
+    kv_mask = jnp.asarray(onp.arange(l)[None, :] < valid[:, None])
+    from mxnet_tpu.parallel import ulysses_attention
+    mesh = make_mesh({"sp": 4}, _cpu_devices(4))
+    out = ulysses_attention(q, k, v, mesh, axis_name="sp", kv_mask=kv_mask)
+    want = reference_attention(q, k, v, mask=kv_mask[:, None, None, :])
+    assert_almost_equal(onp.asarray(out), onp.asarray(want),
+                        rtol=1e-4, atol=1e-4)
