@@ -234,14 +234,20 @@ def main():
     errors = []
     oom_retry_left = True
     attempts = list(ATTEMPT_TIMEOUTS)
+    # fast-fail wedges (UNAVAILABLE in seconds) sometimes heal within
+    # minutes: spend up to this much extra wall clock on patient, clean
+    # retries (child exits on its own each time — never a hard kill)
+    patience = 900.0
     while attempts:
         timeout = attempts.pop(0)
+        t0 = time.time()
         result, err = _run_child("default", timeout)
         if result is not None:
             _remember_tpu_result(result)
             print(json.dumps(result))
             return
         errors.append(err)
+        elapsed = time.time() - t0
         if oom_retry_left and (
                 "MEMORY" in (err or "").upper() or "OOM" in (err or "").upper()):
             # larger default batch blew HBM: drop to the proven round-1
@@ -250,6 +256,13 @@ def main():
             oom_retry_left = False
             if not attempts:
                 attempts.append(ATTEMPT_TIMEOUTS[-1])
+        elif elapsed < 90 and patience > 0 and "UNAVAILABLE" in (err or ""):
+            # tunnel fast-fail mode: wait out a slice of the patience
+            # budget and queue another attempt
+            wait = min(120.0, patience)
+            time.sleep(wait)
+            patience -= wait + elapsed
+            attempts.append(ATTEMPT_TIMEOUTS[-1])
 
     # TPU unreachable — CPU fallback so the driver still gets a numeric line
     result, err = _run_child("cpu", CPU_TIMEOUT)
