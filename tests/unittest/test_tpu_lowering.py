@@ -1,0 +1,51 @@
+"""AOT cross-lowering checks: Mosaic (TPU) lowering of the Pallas kernels
+runs at `.lower(lowering_platforms=("tpu",))` time, so kernel-level TPU
+compile breakage (unsupported ops, layout errors) surfaces on the CPU-only
+CI host — without a chip. The round-3 in-kernel hash RNG and bias streaming
+are exactly the kind of code this guards.
+"""
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.ops.pallas.flash_attention import flash_attention
+
+
+def _lower_for_tpu(fn, *args):
+    return jax.jit(fn).trace(*args).lower(
+        lowering_platforms=("tpu",)).as_text()
+
+
+def test_flash_kernel_masked_dropout_lowers_for_tpu():
+    b, h, l, d = 2, 4, 128, 64
+    q = jnp.ones((b, h, l, d), jnp.bfloat16)
+    bias = jnp.zeros((b, 1, l), jnp.float32)
+
+    def fwd(q, k, v, bias):
+        return flash_attention(q, k, v, bias=bias, dropout_rate=0.1,
+                               dropout_seed=7)
+
+    txt = _lower_for_tpu(fwd, q, q, q, bias)
+    assert txt.count("tpu_custom_call") == 1
+
+    def train(q, k, v):
+        def loss(q, k, v):
+            return jnp.sum(fwd(q, k, v, bias).astype(jnp.float32) ** 2)
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    txt = _lower_for_tpu(train, q, q, q)
+    # forward (rematerialised in vjp) + dq + dkv kernels
+    assert txt.count("tpu_custom_call") == 3
+
+
+def test_flash_kernel_causal_lowers_for_tpu():
+    b, h, l, d = 1, 2, 256, 128
+    q = jnp.ones((b, h, l, d), jnp.bfloat16)
+
+    def f(q, k, v):
+        return flash_attention(q, k, v, causal=True)
+
+    txt = _lower_for_tpu(f, q, q, q)
+    assert txt.count("tpu_custom_call") == 1
