@@ -98,10 +98,10 @@ def _measure(platform: str) -> dict:
 
     def loss_fn(out, input_ids, valid_length, masked_positions, lbl):
         mlm, nsp = out
-        logp = jax.nn.log_softmax(mlm.astype(jnp.float32), axis=-1)
-        ll = jnp.take_along_axis(logp, lbl[..., None].astype(jnp.int32),
-                                 axis=-1)
-        return -jnp.mean(ll)
+        # fused streaming CE (Pallas on TPU): no fp32 (tokens, vocab)
+        # log-prob materialisation (ops/pallas/softmax_xent.py)
+        from mxnet_tpu.ops.pallas.softmax_xent import softmax_cross_entropy
+        return jnp.mean(softmax_cross_entropy(mlm, lbl.astype(jnp.int32)))
 
     mesh = make_mesh({"dp": 1}, jax.devices()[:1])
     step = make_sharded_train_step(model, opt.Adam(learning_rate=1e-4),

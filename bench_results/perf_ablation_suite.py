@@ -179,10 +179,9 @@ try:
     m(ids)
 
     def lm_loss(out, i):
-        logits = out[:, :-1].astype(jnp.float32)
-        tgt = i[:, 1:].astype(jnp.int32)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        return -jnp.take_along_axis(logp, tgt[..., None], axis=-1).mean()
+        from mxnet_tpu.ops.pallas.softmax_xent import softmax_cross_entropy
+        return softmax_cross_entropy(out[:, :-1],
+                                     i[:, 1:].astype(jnp.int32)).mean()
 
     mesh = make_mesh({"dp": 1}, jax.devices()[:1])
     gstep = make_sharded_train_step(m, opt.Adam(learning_rate=1e-4),

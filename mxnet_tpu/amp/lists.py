@@ -116,6 +116,6 @@ FP16_FP32_OPS = [
     "bilinear_sampler", "BilinearSampler", "grid_generator",
     "GridGenerator", "BilinearResize2D", "AdaptiveAvgPooling2D",
     "ROIAlign", "roi_align", "box_iou", "box_nms", "sldwin_atten_mask_like",
-    "batch_take",
+    "batch_take", "softmax_cross_entropy",
 ]
 FP16_FP32_FUNCS = FP16_FP32_OPS  # back-compat alias

@@ -41,6 +41,7 @@ __all__ = [
     "remat_call",
     "grid_generator", "bilinear_sampler", "spatial_transformer",
     "correlation", "im2col", "col2im", "deformable_convolution",
+    "softmax_cross_entropy",
     "save", "load", "waitall", "set_np", "reset_np", "is_np_array",
     "seed", "rnn", "intgemm_fully_connected", "custom",
     "random", "image", "cpu", "gpu", "tpu", "num_gpus", "num_tpus",
@@ -1105,6 +1106,22 @@ def custom(*inputs, op_type, **kwargs):
 # `bilinear_sampler.cc`, `grid_generator.cc`, `correlation.cc`,
 # `src/operator/nn/im2col.h`; jax-level math in `mxnet_tpu/ops/spatial.py`)
 # ---------------------------------------------------------------------------
+
+def softmax_cross_entropy(logits, labels, reduction="none"):
+    """Fused sparse-label cross entropy (ref `mx.nd.softmax_cross_entropy`,
+    `src/operator/softmax_output.cc`). On TPU this streams the logits
+    through a Pallas kernel without materialising fp32 (N, V) log-probs
+    (`ops/pallas/softmax_xent.py`).
+
+    reduction='none' (default) returns per-row loss with the label shape;
+    reduction='sum' matches the reference op's summed (1,) output."""
+    from ..ops.pallas.softmax_xent import softmax_cross_entropy as _sce
+    if reduction == "sum":      # the reference op's contract
+        return apply_op(lambda x, l: _sce(x, l).sum().reshape(1),
+                        (logits, labels), {}, name="softmax_cross_entropy")
+    return apply_op(lambda x, l: _sce(x, l), (logits, labels), {},
+                    name="softmax_cross_entropy")
+
 
 def grid_generator(data, transform_type="affine", target_shape=(0, 0)):
     from ..ops import spatial as _sp
