@@ -110,13 +110,24 @@ class SoftmaxCrossEntropyLoss(Loss):
         self._from_logits = from_logits
 
     def forward(self, pred, label, sample_weight=None):
-        if not self._from_logits:
-            pred = npx.log_softmax(pred, axis=self._axis)
-        if self._sparse_label:
-            loss = -npx.pick(pred, label, axis=self._axis)
+        if self._from_logits:
+            if self._sparse_label:
+                loss = -npx.pick(pred, label, axis=self._axis)
+            else:
+                label = _reshape_like(pred, label)
+                loss = -(pred * label).sum(axis=self._axis)
+        elif self._sparse_label and self._axis in (-1, pred.ndim - 1):
+            # fused streaming CE: on TPU the Pallas kernel never
+            # materialises the fp32 (N, V) log-probs
+            # (`ops/pallas/softmax_xent.py`; ref `softmax_output.cc`)
+            loss = npx.softmax_cross_entropy(pred, label)
         else:
-            label = _reshape_like(pred, label)
-            loss = -(pred * label).sum(axis=self._axis)
+            logp = npx.log_softmax(pred, axis=self._axis)
+            if self._sparse_label:
+                loss = -npx.pick(logp, label, axis=self._axis)
+            else:
+                label = _reshape_like(logp, label)
+                loss = -(logp * label).sum(axis=self._axis)
         loss = _apply_weighting(loss, self._weight, sample_weight)
         return self._mean_nonbatch(loss)
 

@@ -52,7 +52,8 @@ __all__ = [
     "Deconvolution", "BatchNorm", "LayerNorm", "InstanceNorm", "GroupNorm",
     "Pooling", "Dropout", "RNN", "SoftmaxOutput", "softmax", "log_softmax",
     "SoftmaxActivation", "UpSampling", "SequenceMask", "SequenceLast",
-    "SequenceReverse", "Custom", "SpatialTransformer", "BilinearSampler",
+    "SequenceReverse", "Custom", "softmax_cross_entropy",
+    "SpatialTransformer", "BilinearSampler",
     "GridGenerator", "Correlation", "im2col", "col2im",
     # random / samplers
     "random_uniform", "random_normal", "random_gamma", "random_exponential",
@@ -1149,3 +1150,10 @@ def col2im(data, output_size, kernel, stride=(1, 1), dilate=(1, 1),
     from ..numpy_extension import col2im as _c2i
     return _write_out(_c2i(data, output_size, kernel, stride=stride,
                            dilate=dilate, pad=pad), out)
+
+
+def softmax_cross_entropy(data, label, out=None, **kw):
+    """Fused CE summed to (1,) (ref `src/operator/loss_binary_op.cc`
+    `softmax_cross_entropy`); Pallas streaming kernel on TPU."""
+    from ..numpy_extension import softmax_cross_entropy as _sce
+    return _write_out(_sce(data, label, reduction="sum"), out)
