@@ -312,3 +312,128 @@ def test_nd_contrib_namespace():
     b2 = mx.np.array(onp.array([[1., 1., 3., 3.]], dtype="float32"))
     iou = mx.nd.contrib.box_iou(b1, b2)
     onp.testing.assert_allclose(iou.asnumpy(), [[1.0 / 7.0]], rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# round-3 parity-audit tail (NNVM_REGISTER_OP sweep vs namespaces)
+# ---------------------------------------------------------------------------
+
+class TestParityAuditTail:
+    def test_lrn_matches_manual(self):
+        rng = onp.random.RandomState(0)
+        x = rng.rand(2, 6, 4, 4).astype("f")
+        out = onp.asarray(mx.nd.LRN(mx.nd.array(x), nsize=3).asnumpy())
+        sq = x ** 2
+        pad = onp.pad(sq, ((0, 0), (1, 1), (0, 0), (0, 0)))
+        win = pad[:, 0:6] + pad[:, 1:7] + pad[:, 2:8]
+        ref = x / (2.0 + 1e-4 / 3 * win) ** 0.75
+        onp.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_depth_space_roundtrip_and_layout(self):
+        x = onp.arange(16, dtype=onp.float32).reshape(1, 4, 2, 2)
+        d = onp.asarray(mx.nd.depth_to_space(mx.nd.array(x), 2).asnumpy())
+        assert d.shape == (1, 1, 4, 4)
+        # NCHW depth_to_space: out[0,0,0,:2] = [x[c0,0,0], x[c1,0,0]]
+        onp.testing.assert_allclose(d[0, 0, 0, :2], [x[0, 0, 0, 0],
+                                                     x[0, 1, 0, 0]])
+        back = onp.asarray(mx.nd.space_to_depth(
+            mx.nd.array(d), 2).asnumpy())
+        onp.testing.assert_allclose(back, x)
+
+    def test_moments(self):
+        rng = onp.random.RandomState(1)
+        x = rng.rand(3, 5).astype("f")
+        m, v = mx.nd.moments(mx.nd.array(x), axes=(0,))
+        onp.testing.assert_allclose(onp.asarray(m.asnumpy()),
+                                    x.mean(0), rtol=1e-6)
+        onp.testing.assert_allclose(onp.asarray(v.asnumpy()),
+                                    x.var(0), rtol=1e-5)
+
+    def test_roi_pooling_hand_case(self):
+        x = onp.arange(36, dtype=onp.float32).reshape(1, 1, 6, 6)
+        rois = mx.nd.array([[0, 0, 0, 3, 3]])   # 4x4 region, 2x2 pool
+        out = onp.asarray(mx.nd.ROIPooling(
+            mx.nd.array(x), rois, (2, 2), 1.0).asnumpy())
+        # quadrant maxima of x[0:4, 0:4]
+        onp.testing.assert_allclose(out[0, 0], [[7, 9], [19, 21]])
+
+    def test_multi_sgd_matches_single(self):
+        rng = onp.random.RandomState(2)
+        w1, w2 = rng.rand(4).astype("f"), rng.rand(3).astype("f")
+        g1, g2 = rng.rand(4).astype("f"), rng.rand(3).astype("f")
+        a1, a2 = mx.nd.array(w1), mx.nd.array(w2)
+        mx.nd.multi_sgd_update(a1, mx.nd.array(g1), a2, mx.nd.array(g2),
+                               lrs=[0.1, 0.2], wds=[0.0, 0.1],
+                               num_weights=2)
+        s1, s2 = mx.nd.array(w1), mx.nd.array(w2)
+        mx.nd.sgd_update(s1, mx.nd.array(g1), lr=0.1, wd=0.0, out=s1)
+        mx.nd.sgd_update(s2, mx.nd.array(g2), lr=0.2, wd=0.1, out=s2)
+        onp.testing.assert_allclose(onp.asarray(a1.asnumpy()),
+                                    onp.asarray(s1.asnumpy()), rtol=1e-6)
+        onp.testing.assert_allclose(onp.asarray(a2.asnumpy()),
+                                    onp.asarray(s2.asnumpy()), rtol=1e-6)
+
+    def test_preloaded_multi_sgd(self):
+        rng = onp.random.RandomState(3)
+        w = rng.rand(4).astype("f")
+        g = rng.rand(4).astype("f")
+        a = mx.nd.array(w)
+        mx.nd.preloaded_multi_sgd_update(
+            a, mx.nd.array(g), mx.nd.array([0.5]), mx.nd.array([0.0]),
+            num_weights=1)
+        onp.testing.assert_allclose(onp.asarray(a.asnumpy()),
+                                    w - 0.5 * g, rtol=1e-6)
+
+    def test_lamb_phases(self):
+        w = mx.nd.array([1.0, 2.0])
+        g = mx.nd.array([0.1, -0.2])
+        mean = mx.nd.array([0.0, 0.0])
+        var = mx.nd.array([0.0, 0.0])
+        upd = mx.nd.lamb_update_phase1(w, g, mean, var, beta1=0.9,
+                                       beta2=0.999, epsilon=1e-6, t=1)
+        # t=1 bias correction: m_hat = g, v_hat = g^2 -> update ~ sign(g)
+        onp.testing.assert_allclose(onp.asarray(upd.asnumpy()),
+                                    [0.99999, -1.0], rtol=1e-3)
+        r1 = mx.nd.array([onp.sqrt(5.0)])
+        r2 = mx.nd.array([onp.sqrt(2.0)])
+        mx.nd.lamb_update_phase2(w, upd, r1, r2, lr=0.1, out=w)
+        ratio = onp.sqrt(5.0 / 2.0)
+        onp.testing.assert_allclose(
+            onp.asarray(w.asnumpy()),
+            [1.0 - 0.1 * ratio * 0.99999, 2.0 + 0.1 * ratio], rtol=1e-4)
+
+    def test_ftml_update_runs_finite(self):
+        w = mx.nd.array([1.0, -1.0])
+        g = mx.nd.array([0.5, 0.25])
+        d = mx.nd.array([0.0, 0.0])
+        v = mx.nd.array([0.0, 0.0])
+        z = mx.nd.array([0.0, 0.0])
+        mx.nd.ftml_update(w, g, d, v, z, lr=0.01, t=1, out=w)
+        assert onp.isfinite(onp.asarray(w.asnumpy())).all()
+
+    def test_multi_lars_formula(self):
+        lrs = mx.nd.array([0.1])
+        wsq = mx.nd.array([4.0])
+        gsq = mx.nd.array([1.0])
+        wds = mx.nd.array([0.0])
+        out = onp.asarray(mx.nd.multi_lars(lrs, wsq, gsq, wds,
+                                           eta=0.01).asnumpy())
+        onp.testing.assert_allclose(out, [0.1 * 0.01 * 2.0 / 1.0],
+                                    rtol=1e-4)
+
+    def test_all_finite_and_reset(self):
+        good = mx.nd.array([1.0, 2.0])
+        bad = mx.nd.array([1.0, onp.inf])
+        assert bool(mx.nd.all_finite(good).asnumpy()[0])
+        assert not bool(mx.nd.all_finite(bad).asnumpy()[0])
+        assert not bool(mx.nd.multi_all_finite(good, bad).asnumpy()[0])
+        mx.nd.reset_arrays(good, bad)
+        onp.testing.assert_allclose(onp.asarray(good.asnumpy()), 0.0)
+
+    def test_softmin_size_array(self):
+        x = mx.nd.array([[1.0, 2.0, 3.0]])
+        sm = onp.asarray(mx.nd.softmin(x).asnumpy())
+        ref = onp.exp(-onp.array([1, 2, 3.0]))
+        ref /= ref.sum()
+        onp.testing.assert_allclose(sm[0], ref, rtol=1e-5)
+        assert int(mx.nd.size_array(x).asnumpy()[0]) == 3
