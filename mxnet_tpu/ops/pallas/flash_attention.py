@@ -528,10 +528,22 @@ def _normalize_bias(bias, b, h, lq, lk):
     return bb, per_head, per_row
 
 
-def flash_attention(q, k, v, causal=False, scale=None, block_q=256,
-                    block_k=256, bias=None, dropout_rate=0.0,
+def _env_int(name, default):
+    import os
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
+                    block_k=None, bias=None, dropout_rate=0.0,
                     dropout_seed=None):
     """Flash attention over (B, H, L, D) jax arrays.
+
+    Block sizes default to 256 and are tunable per run via
+    MXTPU_FLASH_BLOCK_Q / MXTPU_FLASH_BLOCK_K (the ablation-suite knob —
+    retune without code edits).
 
     `bias` is an additive fp32 logits bias (use MASK_VALUE ≈ -1e30 for hard
     masking); see `_normalize_bias` for accepted shapes.  `dropout_rate` with
@@ -543,6 +555,10 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=256,
     tiled to MXU-friendly blocks (compiled mode needs >=128-lane k blocks;
     interpret mode accepts >=8).
     """
+    if block_q is None:
+        block_q = _env_int("MXTPU_FLASH_BLOCK_Q", 256)
+    if block_k is None:
+        block_k = _env_int("MXTPU_FLASH_BLOCK_K", 256)
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / math.sqrt(d)
     b, h, lq, lk = q.shape[0], q.shape[1], q.shape[2], k.shape[2]

@@ -169,8 +169,8 @@ def _reference(x, labels):
         logp, labels.astype(jnp.int32)[:, None], axis=-1)[:, 0]
 
 
-def softmax_cross_entropy(logits, labels, block_n: int = 256,
-                          block_v: int = 512):
+def softmax_cross_entropy(logits, labels, block_n: int = None,
+                          block_v: int = None):
     """Per-row sparse-label cross entropy over (N, V) logits -> (N,) loss.
 
     Dispatches to the streaming Pallas kernel when the shapes tile onto
@@ -178,6 +178,11 @@ def softmax_cross_entropy(logits, labels, block_n: int = 256,
     XLA reference path. Accepts leading batch dims (flattened internally).
     """
     from ..attention import _use_pallas
+    from .flash_attention import _env_int
+    if block_n is None:
+        block_n = _env_int("MXTPU_XENT_BLOCK_N", 256)
+    if block_v is None:
+        block_v = _env_int("MXTPU_XENT_BLOCK_V", 512)
     shape = logits.shape
     v = shape[-1]
     x = logits.reshape(-1, v)
