@@ -1,0 +1,74 @@
+"""Custom-operator extension (workload parity:
+`example/extensions/lib_custom_op` — the reference implements gemm/relu
+in an external C++ library; here the same registry is Python-level
+(`mx.operator.CustomOpProp`, backed by `jax.pure_callback`), and native
+.so extensions load via `mx.library` — see lib_external_ops.py).
+
+Defines a custom 'leaky_clip' op with its own backward, registers it,
+and drives it through eager + autograd.
+
+Run: JAX_PLATFORMS=cpu python examples/extensions/lib_custom_op.py
+"""
+import numpy as onp
+
+import jax
+jax.config.update("jax_platforms", "cpu") if __name__ == "__main__" else None
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, operator
+
+
+@operator.register("leaky_clip")
+class LeakyClipProp(operator.CustomOpProp):
+    def __init__(self, lo="-1.0", hi="1.0", slope="0.05"):
+        super().__init__(need_top_grad=True)
+        self.lo, self.hi, self.slope = float(lo), float(hi), float(slope)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return LeakyClip(self.lo, self.hi, self.slope)
+
+
+class LeakyClip(operator.CustomOp):
+    def __init__(self, lo, hi, slope):
+        super().__init__()
+        self.lo, self.hi, self.slope = lo, hi, slope
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0]                      # plain numpy on the host
+        y = onp.clip(x, self.lo, self.hi) + self.slope * (
+            onp.minimum(x - self.lo, 0) + onp.maximum(x - self.hi, 0))
+        self.assign(out_data[0], req[0], y)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        x = in_data[0]
+        inside = ((x >= self.lo) & (x <= self.hi)).astype(x.dtype)
+        g = inside + self.slope * (1 - inside)
+        self.assign(in_grad[0], req[0], g * out_grad[0])
+
+
+def main():
+    x = mx.np.array(onp.linspace(-3, 3, 13).astype("f"))
+    x.attach_grad()
+    with autograd.record():
+        y = mx.npx.custom(x, op_type="leaky_clip")
+        loss = (y * y).sum()
+    loss.backward()
+    yv = onp.asarray(y.asnumpy())
+    gv = onp.asarray(x.grad.asnumpy())
+    assert abs(yv[0] - (-1.0 + 0.05 * -2.0)) < 1e-5
+    assert abs(yv[6]) < 1e-6 and abs(gv[6] - 2 * yv[6]) < 1e-5
+    print("custom op values:", onp.round(yv, 3))
+    print("CUSTOM OP EXAMPLE OK")
+
+
+if __name__ == "__main__":
+    main()
