@@ -122,7 +122,8 @@ class GPTForCausalLM(HybridBlock):
         return self.lm_head(x)
 
     def generate(self, input_ids, max_new_tokens=20, temperature=1.0,
-                 greedy=True, use_cache=True):
+                 greedy=True, use_cache=True, num_beams=1,
+                 eos_token_id=None):
         """Autoregressive decode.
 
         `use_cache=True` (default): ONE jitted `lax.scan` over
@@ -130,7 +131,14 @@ class GPTForCausalLM(HybridBlock):
         per new token, static shapes (compiles once per
         (batch, total_len) bucket), the TPU-native incremental-decoding
         path. `use_cache=False` keeps the simple full-context recompute
-        (the two paths produce identical greedy outputs; tested)."""
+        (the two paths produce identical greedy outputs; tested).
+
+        `num_beams > 1`: length-normalised beam search on the same cached
+        scan (caches/histories gather-reindexed per step; finished beams
+        freeze on `eos_token_id`). Returns the best beam per batch row."""
+        if num_beams > 1:
+            return self._generate_beam(input_ids, max_new_tokens,
+                                       num_beams, eos_token_id)
         if use_cache:
             return self._generate_cached(input_ids, max_new_tokens,
                                          temperature, greedy)
@@ -174,6 +182,140 @@ class GPTForCausalLM(HybridBlock):
                     pos=w(t.position_embed.weight),
                     lnf_g=w(t.final_norm.gamma), lnf_b=w(t.final_norm.beta),
                     head=head, layers=layers)
+
+    def _token_step(self, P, tok, t, kcache, vcache, T):
+        """One cached decoder step: token ids (N,) at position t against
+        (n_layers, N, H, T, D) caches -> (logits (N, V), new caches)."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        cfg = self.cfg
+        H, E = cfg.num_heads, cfg.hidden_size
+        D = E // H
+        eps = cfg.layer_norm_eps
+        N = tok.shape[0]
+
+        def ln(x, g, b):
+            m = x.mean(-1, keepdims=True)
+            v = ((x - m) ** 2).mean(-1, keepdims=True)
+            return (x - m) / jnp.sqrt(v + eps) * g + b
+
+        h = P["embed"][tok] + P["pos"][t]
+        new_k, new_v = [], []
+        for li, L in enumerate(P["layers"]):
+            a = ln(h, L["ln1_g"], L["ln1_b"])
+            qkv = a @ L["wqkv"].T + L["bqkv"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            qh = q.reshape(N, H, D)
+            kc = lax.dynamic_update_slice_in_dim(
+                kcache[li], k.reshape(N, H, D)[:, :, None], t, axis=2)
+            vc = lax.dynamic_update_slice_in_dim(
+                vcache[li], v.reshape(N, H, D)[:, :, None], t, axis=2)
+            new_k.append(kc)
+            new_v.append(vc)
+            s = jnp.einsum("bhd,bhtd->bht", qh, kc) / jnp.sqrt(
+                jnp.float32(D)).astype(h.dtype)
+            mask = jnp.arange(T) <= t
+            s = jnp.where(mask[None, None], s, -1e30)
+            p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(
+                h.dtype)
+            ctx = jnp.einsum("bht,bhtd->bhd", p, vc).reshape(N, E)
+            h = h + ctx @ L["wo"].T + L["bo"]
+            f = ln(h, L["ln2_g"], L["ln2_b"])
+            h = h + jax.nn.gelu(f @ L["w1"].T + L["b1"]) @ L["w2"].T \
+                + L["b2"]
+        h = ln(h, P["lnf_g"], P["lnf_b"])
+        logits = h @ (P["embed"].T if P["head"] is None else P["head"].T)
+        return logits, jnp.stack(new_k), jnp.stack(new_v)
+
+    def _generate_beam(self, input_ids, max_new_tokens, num_beams,
+                       eos_token_id):
+        """Batched beam search on the cached scan: beams flatten into the
+        cache batch dim; per step the top-k over (beams x vocab) selects
+        (source beam, token) pairs and the caches + token histories are
+        gather-reindexed (the GluonNLP BeamSearch capability, TPU-native:
+        static shapes, one compiled scan)."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        cfg = self.cfg
+        H, E = cfg.num_heads, cfg.hidden_size
+        D = E // H
+        K = int(num_beams)
+        P = self._decode_weights()
+        prompt = input_ids._data if hasattr(input_ids, "_data") \
+            else jnp.asarray(input_ids)
+        B, plen = prompt.shape
+        T = plen + max_new_tokens
+        check_max_position(T, cfg.max_position)
+        n_layers = len(P["layers"])
+        eos = -1 if eos_token_id is None else int(eos_token_id)
+        NEG = jnp.float32(-1e9)
+
+        def step(carry, t):
+            kc, vc, prev, scores, hist, finished = carry
+            logits, kc, vc = self._token_step(P, prev, t, kc, vc, T)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            logp = logp.reshape(B, K, -1)
+            V = logp.shape[-1]
+
+            def prompt_step(_):
+                tok = jnp.broadcast_to(
+                    prompt[:, None, jnp.minimum(t + 1, plen - 1)], (B, K))
+                return (scores, tok,
+                        jnp.arange(K)[None].repeat(B, 0), finished)
+
+            def beam_step(_):
+                # finished beams contribute a single 0-logp continuation
+                # (the eos/pad slot) so their score freezes
+                frozen = jnp.full((B, K, V), NEG).at[:, :, max(eos, 0)] \
+                    .set(0.0)
+                cand = scores[:, :, None] + jnp.where(
+                    finished[:, :, None], frozen, logp)
+                top, idx = lax.top_k(cand.reshape(B, K * V), K)
+                src = idx // V
+                tok = idx % V
+                fin = jnp.take_along_axis(finished, src, axis=1)
+                fin = fin | (tok == eos)
+                return top, tok, src, fin
+
+            scores, tok, src, finished = lax.cond(
+                t + 1 < plen, prompt_step, beam_step, operand=None)
+
+            # reindex beam state by source beam
+            def regather(c):
+                return jnp.take_along_axis(
+                    c.reshape(n_layers, B, K, H, T, D),
+                    src[None, :, :, None, None, None], axis=2
+                ).reshape(n_layers, B * K, H, T, D)
+
+            kc = regather(kc)
+            vc = regather(vc)
+            hist = jnp.take_along_axis(hist, src[:, :, None], axis=1)
+            hist = lax.dynamic_update_slice_in_dim(
+                hist, tok[:, :, None].astype(jnp.int32), t + 1, axis=2)
+            return (kc, vc, tok.reshape(B * K).astype(jnp.int32), scores,
+                    hist, finished), None
+
+        @jax.jit
+        def run(prompt):
+            kc = jnp.zeros((n_layers, B * K, H, T, D), P["embed"].dtype)
+            vc = jnp.zeros_like(kc)
+            scores = jnp.where(jnp.arange(K)[None] == 0, 0.0, NEG)
+            scores = jnp.broadcast_to(scores, (B, K)).astype(jnp.float32)
+            hist = jnp.zeros((B, K, T), jnp.int32)
+            hist = hist.at[:, :, 0].set(prompt[:, :1])
+            prev = jnp.broadcast_to(prompt[:, None, 0], (B, K)) \
+                .reshape(B * K).astype(jnp.int32)
+            finished = jnp.zeros((B, K), bool)
+            (kc, vc, prev, scores, hist, finished), _ = lax.scan(
+                step, (kc, vc, prev, scores, hist, finished),
+                jnp.arange(T - 1))
+            return hist[:, 0]        # top_k keeps beams score-sorted
+
+        return np.from_jax(run(prompt))
 
     def _generate_cached(self, input_ids, max_new_tokens, temperature,
                         greedy):

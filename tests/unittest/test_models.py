@@ -377,3 +377,56 @@ def test_gpt_kv_cache_decode_untied_and_sampled():
     assert arr.shape == (1, 8)
     onp.testing.assert_array_equal(arr[:, :3], [[1, 2, 3]])
     assert ((arr >= 0) & (arr < 64)).all()
+
+
+def test_gpt_beam_search_beats_greedy_logprob():
+    """Beam search must find a joint sequence log-probability >= greedy's
+    (same model, same prompt) and keep the prompt prefix intact."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=4, intermediate_size=64, max_position=48,
+                    dropout=0.0)
+    m = GPTForCausalLM(cfg)
+    m.initialize()
+    rng = onp.random.RandomState(1)
+    prompt = mx.np.array(rng.randint(0, 64, (2, 4)), dtype="int32")
+    m(prompt)
+    greedy = onp.asarray(m.generate(prompt, max_new_tokens=6,
+                                    use_cache=True).asnumpy())
+    beam = onp.asarray(m.generate(prompt, max_new_tokens=6,
+                                  num_beams=4).asnumpy())
+    onp.testing.assert_array_equal(beam[:, :4],
+                                   onp.asarray(prompt.asnumpy()))
+
+    def joint_logp(ids):
+        logits = m(mx.np.array(ids))._data.astype(jnp.float32)
+        lp = jax.nn.log_softmax(logits, axis=-1)
+        tot = 0.0
+        for b in range(ids.shape[0]):
+            for t in range(3, ids.shape[1] - 1):
+                tot += float(lp[b, t, ids[b, t + 1]])
+        return tot
+
+    assert joint_logp(beam) >= joint_logp(greedy) - 1e-4
+
+
+def test_gpt_beam_search_eos_freezes():
+    from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    cfg = GPTConfig(vocab_size=32, hidden_size=32, num_layers=1,
+                    num_heads=4, intermediate_size=64, max_position=32,
+                    dropout=0.0)
+    m = GPTForCausalLM(cfg)
+    m.initialize()
+    prompt = mx.np.array([[3, 7]], dtype="int32")
+    m(prompt)
+    # pick whatever token beam-1-step emits as the "eos" and re-run: the
+    # sequence must then hold eos from first emission onward
+    first = onp.asarray(m.generate(prompt, max_new_tokens=1,
+                                   num_beams=2).asnumpy())[0, 2]
+    out = onp.asarray(m.generate(prompt, max_new_tokens=8, num_beams=2,
+                                 eos_token_id=int(first)).asnumpy())[0]
+    hit = onp.where(out[2:] == first)[0]
+    assert hit.size > 0
+    onp.testing.assert_array_equal(out[2 + hit[0]:], first)
