@@ -230,12 +230,17 @@ class GPTForCausalLM(HybridBlock):
         return logits, jnp.stack(new_k), jnp.stack(new_v)
 
     def _generate_beam(self, input_ids, max_new_tokens, num_beams,
-                       eos_token_id):
-        """Batched beam search on the cached scan: beams flatten into the
-        cache batch dim; per step the top-k over (beams x vocab) selects
-        (source beam, token) pairs and the caches + token histories are
-        gather-reindexed (the GluonNLP BeamSearch capability, TPU-native:
-        static shapes, one compiled scan)."""
+                       eos_token_id, length_penalty=1.0):
+        """Batched beam search on the cached scan (the GluonNLP BeamSearch
+        capability, TPU-native: static shapes, compiled scans).
+
+        Prefill runs at batch B (beams are identical until they diverge),
+        then the caches tile to B*K and the beam scan takes top-k over
+        (beams x vocab), gather-reindexing caches + token histories by
+        source beam. Finished beams freeze on `eos_token_id`; the final
+        winner maximises score / length**length_penalty (GluonNLP-style
+        normalisation — without it the shortest finished beam would
+        always win)."""
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -253,38 +258,33 @@ class GPTForCausalLM(HybridBlock):
         n_layers = len(P["layers"])
         eos = -1 if eos_token_id is None else int(eos_token_id)
         NEG = jnp.float32(-1e9)
+        lp_pow = float(length_penalty)
 
-        def step(carry, t):
-            kc, vc, prev, scores, hist, finished = carry
+        def prefill_step(carry, t):
+            kc, vc = carry
+            _, kc, vc = self._token_step(P, prompt[:, t], t, kc, vc, T)
+            return (kc, vc), None
+
+        def beam_step(carry, t):
+            kc, vc, prev, scores, hist, finished, fin_len = carry
             logits, kc, vc = self._token_step(P, prev, t, kc, vc, T)
             logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
             logp = logp.reshape(B, K, -1)
             V = logp.shape[-1]
+            # finished beams contribute one 0-logp continuation (the
+            # eos/pad slot) so their score freezes
+            frozen_row = jnp.full((V,), NEG).at[max(eos, 0)].set(0.0)
+            cand = scores[:, :, None] + jnp.where(
+                finished[:, :, None], frozen_row[None, None], logp)
+            top, idx = lax.top_k(cand.reshape(B, K * V), K)
+            src = idx // V
+            tok = idx % V
+            was_fin = jnp.take_along_axis(finished, src, axis=1)
+            fin_len = jnp.take_along_axis(fin_len, src, axis=1)
+            now_fin = was_fin | (tok == eos)
+            gen_len = t + 2 - plen      # tokens generated incl. this one
+            fin_len = jnp.where(now_fin & ~was_fin, gen_len, fin_len)
 
-            def prompt_step(_):
-                tok = jnp.broadcast_to(
-                    prompt[:, None, jnp.minimum(t + 1, plen - 1)], (B, K))
-                return (scores, tok,
-                        jnp.arange(K)[None].repeat(B, 0), finished)
-
-            def beam_step(_):
-                # finished beams contribute a single 0-logp continuation
-                # (the eos/pad slot) so their score freezes
-                frozen = jnp.full((B, K, V), NEG).at[:, :, max(eos, 0)] \
-                    .set(0.0)
-                cand = scores[:, :, None] + jnp.where(
-                    finished[:, :, None], frozen, logp)
-                top, idx = lax.top_k(cand.reshape(B, K * V), K)
-                src = idx // V
-                tok = idx % V
-                fin = jnp.take_along_axis(finished, src, axis=1)
-                fin = fin | (tok == eos)
-                return top, tok, src, fin
-
-            scores, tok, src, finished = lax.cond(
-                t + 1 < plen, prompt_step, beam_step, operand=None)
-
-            # reindex beam state by source beam
             def regather(c):
                 return jnp.take_along_axis(
                     c.reshape(n_layers, B, K, H, T, D),
@@ -296,24 +296,40 @@ class GPTForCausalLM(HybridBlock):
             hist = jnp.take_along_axis(hist, src[:, :, None], axis=1)
             hist = lax.dynamic_update_slice_in_dim(
                 hist, tok[:, :, None].astype(jnp.int32), t + 1, axis=2)
-            return (kc, vc, tok.reshape(B * K).astype(jnp.int32), scores,
-                    hist, finished), None
+            return (kc, vc, tok.reshape(B * K).astype(jnp.int32), top,
+                    hist, now_fin, fin_len), None
 
         @jax.jit
         def run(prompt):
-            kc = jnp.zeros((n_layers, B * K, H, T, D), P["embed"].dtype)
+            # phase 1: prefill at batch B — beams are identical here
+            kc = jnp.zeros((n_layers, B, H, T, D), P["embed"].dtype)
             vc = jnp.zeros_like(kc)
+            if plen > 1:
+                (kc, vc), _ = lax.scan(prefill_step, (kc, vc),
+                                       jnp.arange(plen - 1))
+            # tile caches to B*K beams
+            def tile(c):
+                return jnp.repeat(c, K, axis=1)
+            kc, vc = tile(kc), tile(vc)
             scores = jnp.where(jnp.arange(K)[None] == 0, 0.0, NEG)
             scores = jnp.broadcast_to(scores, (B, K)).astype(jnp.float32)
-            hist = jnp.zeros((B, K, T), jnp.int32)
-            hist = hist.at[:, :, 0].set(prompt[:, :1])
-            prev = jnp.broadcast_to(prompt[:, None, 0], (B, K)) \
+            hist = jnp.broadcast_to(
+                jnp.pad(prompt, ((0, 0), (0, T - plen)))[:, None],
+                (B, K, T)).astype(jnp.int32)
+            prev = jnp.broadcast_to(prompt[:, None, plen - 1], (B, K)) \
                 .reshape(B * K).astype(jnp.int32)
             finished = jnp.zeros((B, K), bool)
-            (kc, vc, prev, scores, hist, finished), _ = lax.scan(
-                step, (kc, vc, prev, scores, hist, finished),
-                jnp.arange(T - 1))
-            return hist[:, 0]        # top_k keeps beams score-sorted
+            fin_len = jnp.zeros((B, K), jnp.int32)
+            carry = (kc, vc, prev, scores, hist, finished, fin_len)
+            carry, _ = lax.scan(beam_step, carry,
+                                jnp.arange(plen - 1, T - 1))
+            _, _, _, scores, hist, finished, fin_len = carry
+            lengths = jnp.where(finished, fin_len, max_new_tokens) \
+                .astype(jnp.float32)
+            norm = scores / jnp.maximum(lengths, 1.0) ** lp_pow
+            best = jnp.argmax(norm, axis=1)
+            return jnp.take_along_axis(hist, best[:, None, None],
+                                       axis=1)[:, 0]
 
         return np.from_jax(run(prompt))
 
@@ -337,44 +353,11 @@ class GPTForCausalLM(HybridBlock):
         n_layers = len(P["layers"])
         key = _rng.next_key() if not greedy else jax.random.PRNGKey(0)
 
-        def ln(x, g, b):
-            m = x.mean(-1, keepdims=True)
-            v = ((x - m) ** 2).mean(-1, keepdims=True)
-            return (x - m) / jnp.sqrt(v + eps) * g + b
-
         def step(carry, t):
             kcache, vcache, prev = carry
             tok = jnp.where(t < plen, prompt[:, jnp.minimum(t, plen - 1)],
                             prev)
-            h = P["embed"][tok] + P["pos"][t]             # (B, E)
-            new_k, new_v = [], []
-            for li, L in enumerate(P["layers"]):
-                a = ln(h, L["ln1_g"], L["ln1_b"])
-                qkv = a @ L["wqkv"].T + L["bqkv"]
-                q, k, v = jnp.split(qkv, 3, axis=-1)
-                qh = q.reshape(B, H, D)
-                kh = k.reshape(B, H, D)
-                vh = v.reshape(B, H, D)
-                kc = lax.dynamic_update_slice_in_dim(
-                    kcache[li], kh[:, :, None], t, axis=2)
-                vc = lax.dynamic_update_slice_in_dim(
-                    vcache[li], vh[:, :, None], t, axis=2)
-                new_k.append(kc)
-                new_v.append(vc)
-                s = jnp.einsum("bhd,bhtd->bht", qh, kc) / jnp.sqrt(
-                    jnp.float32(D)).astype(h.dtype)
-                mask = jnp.arange(T) <= t
-                s = jnp.where(mask[None, None], s, -1e30)
-                p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(
-                    h.dtype)
-                ctx = jnp.einsum("bht,bhtd->bhd", p, vc).reshape(B, E)
-                h = h + ctx @ L["wo"].T + L["bo"]
-                f = ln(h, L["ln2_g"], L["ln2_b"])
-                h = h + jax.nn.gelu(f @ L["w1"].T + L["b1"]) @ L["w2"].T \
-                    + L["b2"]
-            h = ln(h, P["lnf_g"], P["lnf_b"])
-            logits = h @ (P["embed"].T if P["head"] is None
-                          else P["head"].T)
+            logits, kc, vc = self._token_step(P, tok, t, kcache, vcache, T)
             if greedy:
                 nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             else:
@@ -385,7 +368,7 @@ class GPTForCausalLM(HybridBlock):
             out_tok = jnp.where(t + 1 < plen,
                                 prompt[:, jnp.minimum(t + 1, plen - 1)],
                                 nxt)
-            return (jnp.stack(new_k), jnp.stack(new_v), out_tok), out_tok
+            return (kc, vc, out_tok), out_tok
 
         @jax.jit
         def run(prompt):
