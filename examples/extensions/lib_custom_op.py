@@ -9,6 +9,11 @@ and drives it through eager + autograd.
 
 Run: JAX_PLATFORMS=cpu python examples/extensions/lib_custom_op.py
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", ".."))
 import numpy as onp
 
 import jax

@@ -8,6 +8,11 @@ Registers a backend that fuses `exp(x) / (1 + exp(x))` chains into one
 
 Run: JAX_PLATFORMS=cpu python examples/extensions/lib_subgraph.py
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", ".."))
 import numpy as onp
 
 import jax

@@ -19,6 +19,11 @@ Runs anywhere: on a CPU dev box use the virtual mesh —
 
 On a TPU slice drop the env vars; the same code shards over real chips.
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
 import argparse
 
 import numpy as onp

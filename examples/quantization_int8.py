@@ -9,6 +9,11 @@ int8 path.
 
 Run: JAX_PLATFORMS=cpu python examples/quantization_int8.py
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
 import argparse
 
 import numpy as onp
