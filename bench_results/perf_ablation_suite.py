@@ -81,6 +81,20 @@ f = build_step(BertConfig(dtype="bfloat16"))
 results["A_full"] = timed(f)
 print("A full step:", results["A_full"], "ms")
 
+# A-prof: per-op aggregate table for the full-head step (the VERDICT's
+# "name the next limiter" ask) — eager per-op timing via the profiler
+# hook; coarse but ranks the offenders
+try:
+    import mxnet_tpu.profiler as prof
+    prof.set_config(aggregate_stats=True)
+    prof.start()
+    f()
+    prof.stop()
+    print("A-prof per-op table:")
+    print(prof.dumps(reset=True))
+except Exception as e:
+    print("A-prof failed:", type(e).__name__, e)
+
 # B. no dropout
 f = build_step(BertConfig(dtype="bfloat16"), dropout=False)
 results["B_no_dropout"] = timed(f)
