@@ -1,0 +1,50 @@
+"""Example-corpus smoke tests: the reference CI runs its example/ scripts
+(`tests/nightly/test_tutorial.py` pattern); here each example is executed
+as a subprocess on the CPU backend and must print its OK marker."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def _run(rel, *args, timeout=420):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([sys.executable, os.path.join(ROOT, rel), *args],
+                       capture_output=True, text=True, env=env, cwd=ROOT,
+                       timeout=timeout)
+    assert r.returncode == 0, (rel, r.stdout[-1500:], r.stderr[-1500:])
+    return r.stdout
+
+
+def test_custom_op_example():
+    out = _run("examples/extensions/lib_custom_op.py")
+    assert "CUSTOM OP EXAMPLE OK" in out
+
+
+def test_subgraph_example():
+    out = _run("examples/extensions/lib_subgraph.py")
+    assert "SUBGRAPH EXTENSION EXAMPLE OK" in out
+
+
+@pytest.mark.slow
+def test_quantization_example():
+    out = _run("examples/quantization_int8.py", "--cpu")
+    assert "INT8 QUANTIZATION EXAMPLE OK" in out
+
+
+@pytest.mark.slow
+def test_long_context_sp_example():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "examples/long_context_sp.py"),
+         "--cpu", "--seq", "256", "--steps", "2"],
+        capture_output=True, text=True, env=env, cwd=ROOT, timeout=600)
+    assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-1500:])
+    assert "long-context sp example OK" in r.stdout
