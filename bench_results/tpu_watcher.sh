@@ -1,0 +1,45 @@
+#!/bin/bash
+# TPU tunnel watcher (round 3): probe cleanly every ~7 min; when the tunnel
+# answers, immediately run bench.py and then the ablation suite, logging
+# everything. Discipline per docs/performance.md: probes and runs are fresh
+# processes that exit on their own; timeouts deliver SIGINT (Python-level
+# KeyboardInterrupt -> clean PjRt teardown), never SIGKILL.
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+LOG="$REPO/bench_results/r03_watcher.log"
+OUT="$REPO/bench_results/r03_tpu_run.log"
+cd "$REPO"
+
+log() { echo "$(date -u +%H:%M:%S) $*" >> "$LOG"; }
+
+log "watcher started"
+while true; do
+    # clean probe: devices + one tiny jitted matmul end-to-end
+    timeout -s INT 240 python - <<'EOF' >> "$LOG" 2>&1
+import time, jax, jax.numpy as jnp
+t0 = time.time()
+d = jax.devices()
+f = jax.jit(lambda a: (a @ a).sum())
+x = jnp.ones((256, 256), jnp.bfloat16)
+v = jax.device_get(f(x))
+print(f"probe ok: {d[0].device_kind} matmul={float(v):.0f} {time.time()-t0:.1f}s", flush=True)
+EOF
+    rc=$?
+    if [ $rc -eq 0 ]; then
+        log "tunnel healthy -> running bench.py"
+        timeout -s INT 2700 python bench.py > "$REPO/bench_results/r03_bench_line.json" 2>> "$OUT"
+        brc=$?
+        log "bench rc=$brc: $(cat "$REPO/bench_results/r03_bench_line.json" | head -c 400)"
+        if grep -q '"platform": "tpu"' "$REPO/bench_results/latest_tpu.json" 2>/dev/null \
+           && grep -q '"platform": "tpu"' "$REPO/bench_results/r03_bench_line.json" 2>/dev/null; then
+            log "TPU bench captured -> running ablation suite"
+            timeout -s INT 3600 python bench_results/perf_ablation_suite.py >> "$OUT" 2>&1
+            log "ablation suite rc=$? -- watcher done"
+            exit 0
+        fi
+        log "bench did not land a TPU line; continue probing"
+    else
+        log "probe rc=$rc (hang/unavailable)"
+    fi
+    sleep 420
+done

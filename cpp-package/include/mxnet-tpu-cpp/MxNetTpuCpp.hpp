@@ -47,6 +47,43 @@ class Runtime {
   Runtime& operator=(const Runtime&) = delete;
 
   static void Seed(int seed) { Check(MXTPURandomSeed(seed), "Seed"); }
+
+  /* MAJOR*10000 + MINOR*100 + PATCH (reference MXGetVersion). */
+  static int Version() {
+    int v = 0;
+    Check(MXTPUGetVersion(&v), "GetVersion");
+    return v;
+  }
+
+  /* All registered operator names (reference MXListAllOpNames). */
+  static std::vector<std::string> ListOps() {
+    const char* s = nullptr;
+    int n = 0;
+    Check(MXTPUListOps(&s, &n), "ListOps");
+    std::vector<std::string> out;
+    out.reserve(n);
+    std::string cur;
+    for (const char* p = s;; ++p) {
+      if (*p == ',' || *p == '\0') {
+        if (!cur.empty()) out.push_back(cur);
+        cur.clear();
+        if (*p == '\0') break;
+      } else {
+        cur.push_back(*p);
+      }
+    }
+    return out;
+  }
+
+  /* Runtime feature discovery (reference mx.runtime / libinfo). */
+  static bool FeatureEnabled(const std::string& name) {
+    int v = 0;
+    Check(MXTPUFeatureIsEnabled(name.c_str(), &v), "FeatureIsEnabled");
+    return v != 0;
+  }
+
+  /* Engine::WaitForAll parity — block until device work completes. */
+  static void WaitAll() { Check(MXTPUWaitAll(), "WaitAll"); }
 };
 
 class NDArray {
@@ -61,6 +98,63 @@ class NDArray {
                              static_cast<int>(shape.size()), &h),
           "NDArrayCreate");
     return NDArray(h);
+  }
+
+  /* Explicit-dtype create ("bfloat16", "int32", ...); host data is
+   * float32, cast on device (reference MXNDArrayCreateEx convention). */
+  static NDArray FromVector(const std::vector<int64_t>& shape,
+                            const std::vector<float>& data,
+                            const std::string& dtype) {
+    MXTPUNDArrayHandle h = nullptr;
+    Check(MXTPUNDArrayCreateEx(data.data(), shape.data(),
+                               static_cast<int>(shape.size()),
+                               dtype.c_str(), &h),
+          "NDArrayCreateEx");
+    return NDArray(h);
+  }
+
+  std::string DType() const {
+    const char* s = nullptr;
+    Check(MXTPUNDArrayDType(handle_, &s), "NDArrayDType");
+    return s;
+  }
+
+  /* Autograd surface (reference autograd.py:196,245 via the C ABI). */
+  void AttachGrad() { Check(MXTPUNDArrayAttachGrad(handle_), "AttachGrad"); }
+  void Backward() { Check(MXTPUAutogradBackward(handle_), "Backward"); }
+  NDArray Grad() const {
+    MXTPUNDArrayHandle g = nullptr;
+    Check(MXTPUNDArrayGetGrad(handle_, &g), "GetGrad");
+    return NDArray(g);
+  }
+
+  /* Save/load named arrays (.npz; reference MXNDArraySave/Load). */
+  static void Save(const std::string& path,
+                   const std::vector<std::pair<std::string,
+                                               const NDArray*>>& items) {
+    std::vector<MXTPUNDArrayHandle> hs;
+    std::vector<const char*> names;
+    hs.reserve(items.size());
+    names.reserve(items.size());
+    for (const auto& kv : items) {
+      names.push_back(kv.first.c_str());
+      hs.push_back(kv.second->handle());
+    }
+    Check(MXTPUNDArraySave(path.c_str(), hs.data(), names.data(),
+                           static_cast<int>(items.size())),
+          "NDArraySave");
+  }
+  static std::vector<std::pair<std::string, NDArray>> Load(
+      const std::string& path, int max_arrays = 64) {
+    std::vector<MXTPUNDArrayHandle> hs(max_arrays, nullptr);
+    std::vector<const char*> names(max_arrays, nullptr);
+    int n = max_arrays;
+    Check(MXTPUNDArrayLoad(path.c_str(), hs.data(), names.data(), &n),
+          "NDArrayLoad");
+    std::vector<std::pair<std::string, NDArray>> out;
+    out.reserve(n);
+    for (int i = 0; i < n; ++i) out.emplace_back(names[i], NDArray(hs[i]));
+    return out;
   }
 
   ~NDArray() { reset(); }
@@ -229,6 +323,66 @@ class Trainer {
 
  private:
   MXTPUTrainerHandle handle_ = nullptr;
+};
+
+/* Scoped autograd recording (autograd.record() as RAII). */
+class AutogradRecord {
+ public:
+  AutogradRecord() { Check(MXTPUAutogradRecordBegin(), "RecordBegin"); }
+  ~AutogradRecord() { MXTPUAutogradRecordEnd(); }
+  AutogradRecord(const AutogradRecord&) = delete;
+  AutogradRecord& operator=(const AutogradRecord&) = delete;
+};
+
+/* KVStore over the C ABI (reference kvstore.h:104-238 workflow). */
+class KVStore {
+ public:
+  explicit KVStore(const std::string& type = "local") {
+    Check(MXTPUKVStoreCreate(type.c_str(), &handle_), "KVStoreCreate");
+  }
+  ~KVStore() {
+    if (handle_ != nullptr) MXTPUKVStoreFree(handle_);
+  }
+  KVStore(const KVStore&) = delete;
+  KVStore& operator=(const KVStore&) = delete;
+
+  void Init(int key, const NDArray& val) {
+    Check(MXTPUKVStoreInit(handle_, key, val.handle()), "KVStoreInit");
+  }
+  void Push(int key, const NDArray& val) {
+    Check(MXTPUKVStorePush(handle_, key, val.handle()), "KVStorePush");
+  }
+  NDArray Pull(int key) {
+    MXTPUNDArrayHandle h = nullptr;
+    Check(MXTPUKVStorePull(handle_, key, &h), "KVStorePull");
+    return NDArray(h);
+  }
+  int Rank() const {
+    int r = 0;
+    Check(MXTPUKVStoreRank(handle_, &r), "KVStoreRank");
+    return r;
+  }
+  int NumWorkers() const {
+    int n = 0;
+    Check(MXTPUKVStoreNumWorkers(handle_, &n), "KVStoreNumWorkers");
+    return n;
+  }
+
+ private:
+  MXTPUKVStoreHandle handle_ = nullptr;
+};
+
+/* Profiler control (reference profiler.py:34,125 via c_api_profile.cc). */
+class Profiler {
+ public:
+  static void Start() { Check(MXTPUProfilerStart(), "ProfilerStart"); }
+  static void Stop() { Check(MXTPUProfilerStop(), "ProfilerStop"); }
+  /* Non-destructive by default; reset=true clears the stats after read. */
+  static std::string Dumps(bool reset = false) {
+    const char* s = nullptr;
+    Check(MXTPUProfilerDumps(&s, reset ? 1 : 0), "ProfilerDumps");
+    return s;
+  }
 };
 
 }  // namespace mxtpu

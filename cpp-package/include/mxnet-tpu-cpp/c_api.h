@@ -108,6 +108,97 @@ int MXTPUTrainerFree(MXTPUTrainerHandle handle);
 int MXTPUModelSaveParams(MXTPUModelHandle model, const char* path);
 int MXTPUModelLoadParams(MXTPUModelHandle model, const char* path);
 
+/* --- Runtime introspection (parity: reference MXGetVersion,
+ * --- MXListAllOpNames `src/c_api/c_api.cc`, MXLibInfoFeatures
+ * --- `include/mxnet/libinfo.h:132-213`) ------------------------------- */
+
+/* Library version as MAJOR*10000 + MINOR*100 + PATCH. */
+int MXTPUGetVersion(int* out);
+
+/* Comma-separated list of all registered `mx.np`/`mx.npx`/`mx.nd` operator
+ * names. The returned pointer stays valid until the next MXTPU* call on
+ * this thread. `n_ops` (optional, may be NULL) receives the count. */
+int MXTPUListOps(const char** out, int* n_ops);
+
+/* 1 if the named runtime feature (mx.runtime.Features; e.g. "TPU",
+ * "BF16", "INT64_TENSOR_SIZE") is enabled, else 0. */
+int MXTPUFeatureIsEnabled(const char* name, int* out);
+
+/* --- NDArray breadth (parity: MXNDArrayCreateEx dtype surface,
+ * --- MXNDArraySave/MXNDArrayLoad `src/c_api/c_api.cc`,
+ * --- MXNDArrayWaitAll = Engine::WaitForAll) --------------------------- */
+
+/* Create an NDArray with an explicit dtype ("float32", "float16",
+ * "bfloat16", "int32", "int64", "uint8", "bool"...). `data` is always
+ * host float32 and is cast on device — the reference's MXNDArraySyncCopy
+ * convention for mixed-precision feeds. */
+int MXTPUNDArrayCreateEx(const float* data, const int64_t* shape, int ndim,
+                         const char* dtype, MXTPUNDArrayHandle* out);
+
+/* Dtype name of an array (pointer valid until the next call). */
+int MXTPUNDArrayDType(MXTPUNDArrayHandle handle, const char** out);
+
+/* Save named arrays to an `.npz` (the reference's MXNDArraySave dict
+ * format). `names` is n nul-terminated keys. */
+int MXTPUNDArraySave(const char* path, MXTPUNDArrayHandle* arrays,
+                     const char** names, int n);
+
+/* Load an `.npz` saved by MXTPUNDArraySave. On entry *n is the capacity
+ * of `arrays`/`name_buf`; on exit the count. Each name_buf[i] points into
+ * a thread-local buffer valid until the next call. */
+int MXTPUNDArrayLoad(const char* path, MXTPUNDArrayHandle* arrays,
+                     const char** name_buf, int* n);
+
+/* Block until all pending device work completes (MXNDArrayWaitAll). */
+int MXTPUWaitAll(void);
+
+/* --- Autograd (parity: MXAutogradSetIsRecording, MXAutogradMarkVariables,
+ * --- MXAutogradBackward, MXNDArrayGetGrad — `src/c_api/c_api_ndarray.cc`,
+ * --- `python/mxnet/autograd.py:121,196,245`) -------------------------- */
+
+/* Enter/exit a recording scope (autograd.record()). Not nestable (one
+ * active scope at a time), and THREAD-LOCAL like the reference's
+ * `Imperative` recording state (`include/mxnet/imperative.h:51`): ops
+ * recorded between Begin/End must run on the thread that called Begin —
+ * calls from other threads execute un-recorded. */
+int MXTPUAutogradRecordBegin(void);
+int MXTPUAutogradRecordEnd(void);
+
+/* Mark an array as a differentiable input (x.attach_grad()). */
+int MXTPUNDArrayAttachGrad(MXTPUNDArrayHandle handle);
+
+/* Backward from a (scalar or summed) head computed inside the recording
+ * scope; gradients land on attached arrays. */
+int MXTPUAutogradBackward(MXTPUNDArrayHandle head);
+
+/* Fetch the gradient of an attached array (new handle; caller frees). */
+int MXTPUNDArrayGetGrad(MXTPUNDArrayHandle handle, MXTPUNDArrayHandle* out);
+
+/* --- KVStore (parity: MXKVStoreCreate/Init/Push/Pull, rank/size —
+ * --- `include/mxnet/c_api.h`, `src/kvstore/kvstore.cc:41-79`) --------- */
+
+typedef void* MXTPUKVStoreHandle;
+
+/* `type` as in the Python registry: "local", "device", "dist_sync", ... */
+int MXTPUKVStoreCreate(const char* type, MXTPUKVStoreHandle* out);
+int MXTPUKVStoreInit(MXTPUKVStoreHandle kv, int key, MXTPUNDArrayHandle val);
+int MXTPUKVStorePush(MXTPUKVStoreHandle kv, int key, MXTPUNDArrayHandle val);
+/* Pull writes a NEW handle holding the current value (caller frees). */
+int MXTPUKVStorePull(MXTPUKVStoreHandle kv, int key, MXTPUNDArrayHandle* out);
+int MXTPUKVStoreRank(MXTPUKVStoreHandle kv, int* rank);
+int MXTPUKVStoreNumWorkers(MXTPUKVStoreHandle kv, int* n);
+int MXTPUKVStoreFree(MXTPUKVStoreHandle kv);
+
+/* --- Profiler (parity: MXSetProcessProfilerConfig/State, MXDumpProfile —
+ * --- `src/c_api/c_api_profile.cc`, `python/mxnet/profiler.py:34,125`) -- */
+
+int MXTPUProfilerStart(void);
+int MXTPUProfilerStop(void);
+/* Aggregate per-op table (pointer valid until the next call). `reset`
+ * nonzero clears the accumulated stats after reading (the reference's
+ * profiler.dumps(reset=...) — default there is a non-destructive read). */
+int MXTPUProfilerDumps(const char** out, int reset);
+
 #ifdef __cplusplus
 }  /* extern "C" */
 #endif

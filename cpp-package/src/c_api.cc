@@ -13,6 +13,7 @@
 
 #include <cstring>
 #include <string>
+#include <vector>
 
 namespace {
 
@@ -120,6 +121,129 @@ def model_save_params(model, path):
 
 def model_load_params(model, path):
     model.load_parameters(path)
+
+# --- runtime introspection ----------------------------------------------
+
+def version():
+    parts = (mx.__version__.split('+')[0].split('.') + ['0', '0'])[:3]
+    nums = [int(''.join(ch for ch in p if ch.isdigit()) or 0)
+            for p in parts]
+    return nums[0] * 10000 + nums[1] * 100 + nums[2]
+
+def list_ops():
+    names = set()
+    for ns in (mx.np, mx.npx, getattr(mx, 'nd', None)):
+        if ns is None:
+            continue
+        for n in dir(ns):
+            if not n.startswith('_') and callable(getattr(ns, n, None)):
+                names.add(n)
+    return ','.join(sorted(names))
+
+def feature_enabled(name):
+    feats = mx.runtime.Features()
+    return 1 if (name in feats and feats[name].enabled) else 0
+
+# --- ndarray breadth ----------------------------------------------------
+
+def nd_from_buffer_ex(mv, shape, dtype):
+    return nd_from_buffer(mv, shape).astype(dtype)
+
+def nd_dtype(nd):
+    return str(nd.dtype)
+
+def nd_save(path, arrays, names):
+    mx.nd.save(path, {n: a for n, a in zip(names, arrays)})
+
+def nd_load(path):
+    d = mx.nd.load(path)
+    if isinstance(d, dict):
+        items = sorted(d.items())
+    else:
+        # list results keep their on-disk arr_N keys so a Save/Load
+        # round-trip preserves the caller's names
+        items = [(f'arr_{i}', a) for i, a in enumerate(d)]
+    return [n for n, _ in items], [a for _, a in items]
+
+def wait_all():
+    mx.nd.waitall()
+
+# --- autograd -----------------------------------------------------------
+
+_record_scope = None
+
+def record_begin():
+    global _record_scope
+    if _record_scope is not None:
+        raise RuntimeError('a recording scope is already active')
+    _record_scope = mx.autograd.record()
+    _record_scope.__enter__()
+
+def record_end():
+    global _record_scope
+    if _record_scope is None:
+        raise RuntimeError('no active recording scope')
+    scope, _record_scope = _record_scope, None
+    scope.__exit__(None, None, None)
+
+def attach_grad(nd):
+    nd.attach_grad()
+
+def backward(head):
+    head.backward()
+
+def get_grad(nd):
+    if nd.grad is None:
+        raise RuntimeError('array has no gradient (attach_grad + backward '
+                           'inside a recording scope first)')
+    return nd.grad
+
+# --- kvstore ------------------------------------------------------------
+
+def kv_create(kind):
+    return mx.kv.create(kind)
+
+def kv_init(kv, key, val):
+    kv.init(key, val)
+
+def kv_push(kv, key, val):
+    kv.push(key, val)
+
+def kv_pull(kv, key):
+    # the reference's MXKVStorePull writes into caller NDArrays; C callers
+    # here get the pulled copy AS the new handle, shaped off the stored
+    # value. Shaping needs the built-in store's key table; plugin stores
+    # (horovod/byteps-style) fail loudly rather than mis-shape.
+    store = getattr(kv, '_store', None)
+    if store is None or not hasattr(kv, '_key'):
+        raise RuntimeError(
+            f'MXTPUKVStorePull supports the built-in kvstore types; '
+            f'{type(kv).__name__} does not expose a key table')
+    kk = kv._key(key)
+    if kk not in store:
+        raise RuntimeError(f'key {key} has not been initialised')
+    tmpl = store[kk]
+    out = mx.np.zeros(tmpl.shape, dtype=str(tmpl.dtype))
+    kv.pull(key, out=out)
+    return out
+
+def kv_rank(kv):
+    return int(kv.rank)
+
+def kv_num_workers(kv):
+    return int(kv.num_workers)
+
+# --- profiler -----------------------------------------------------------
+
+def profiler_start():
+    mx.profiler.set_config(aggregate_stats=True)
+    mx.profiler.start()
+
+def profiler_stop():
+    mx.profiler.stop()
+
+def profiler_dumps(reset):
+    return mx.profiler.dumps(reset=bool(reset))
 )PY";
 
 void set_error_from_python() {
@@ -434,6 +558,301 @@ int MXTPUModelLoadParams(MXTPUModelHandle model, const char* path) {
   if (!r) { set_error_from_python(); return -1; }
   Py_DECREF(r);
   return 0;
+}
+
+/* --- runtime introspection -------------------------------------------- */
+
+namespace {
+
+// string results live here until the next call on the same thread
+thread_local std::string tls_string_result;
+thread_local std::vector<std::string> tls_name_results;
+
+// Shared call driver. `has_args` distinguishes "helper takes no args"
+// from "Py_BuildValue failed" (nullptr args with has_args=true must
+// surface the pending build error, not call the helper argless).
+PyObject* call_helper(const char* name, PyObject* args_owned, bool has_args) {
+  if (has_args && args_owned == nullptr) {
+    set_error_from_python();  // Py_BuildValue failure (bad UTF-8, OOM...)
+    return nullptr;
+  }
+  PyObject* r = args_owned
+      ? PyObject_CallObject(helper(name), args_owned)
+      : PyObject_CallFunctionObjArgs(helper(name), nullptr);
+  Py_XDECREF(args_owned);
+  if (!r) set_error_from_python();
+  return r;
+}
+
+// call a helper expecting an int result
+int call_int_helper(const char* name, PyObject* args_owned, int* out) {
+  PyObject* r = call_helper(name, args_owned, args_owned != nullptr ||
+                            PyErr_Occurred());
+  if (!r) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  if (PyErr_Occurred()) { set_error_from_python(); return -1; }
+  return 0;
+}
+
+// call a no-arg helper, discard the result
+int call_void_helper(const char* name, PyObject* args_owned = nullptr) {
+  PyObject* r = call_helper(name, args_owned, args_owned != nullptr ||
+                            PyErr_Occurred());
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+// call a helper returning str; point *out at a thread-local copy
+int call_str_helper(const char* name, PyObject* args_owned,
+                    const char** out) {
+  PyObject* r = call_helper(name, args_owned, args_owned != nullptr ||
+                            PyErr_Occurred());
+  if (!r) return -1;
+  const char* s = PyUnicode_AsUTF8(r);
+  if (!s) { Py_DECREF(r); set_error_from_python(); return -1; }
+  tls_string_result = s;
+  Py_DECREF(r);
+  *out = tls_string_result.c_str();
+  return 0;
+}
+
+}  // namespace
+
+int MXTPUGetVersion(int* out) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  return call_int_helper("version", nullptr, out);
+}
+
+int MXTPUListOps(const char** out, int* n_ops) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  if (call_str_helper("list_ops", nullptr, out) != 0) return -1;
+  if (n_ops != nullptr) {
+    int n = tls_string_result.empty() ? 0 : 1;
+    for (char c : tls_string_result) n += (c == ',');
+    *n_ops = n;
+  }
+  return 0;
+}
+
+int MXTPUFeatureIsEnabled(const char* name, int* out) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  return call_int_helper("feature_enabled",
+                         Py_BuildValue("(s)", name), out);
+}
+
+/* --- NDArray breadth --------------------------------------------------- */
+
+int MXTPUNDArrayCreateEx(const float* data, const int64_t* shape, int ndim,
+                         const char* dtype, MXTPUNDArrayHandle* out) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  int64_t n = 1;
+  PyObject* pyshape = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    n *= shape[i];
+    PyTuple_SET_ITEM(pyshape, i, PyLong_FromLongLong(shape[i]));
+  }
+  PyObject* mv = PyMemoryView_FromMemory(
+      reinterpret_cast<char*>(const_cast<float*>(data)),
+      n * static_cast<int64_t>(sizeof(float)), PyBUF_READ);
+  PyObject* r = PyObject_CallFunction(helper("nd_from_buffer_ex"), "OOs",
+                                      mv, pyshape, dtype);
+  Py_DECREF(mv);
+  Py_DECREF(pyshape);
+  if (!r) { set_error_from_python(); return -1; }
+  *out = r;
+  return 0;
+}
+
+int MXTPUNDArrayDType(MXTPUNDArrayHandle handle, const char** out) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  return call_str_helper(
+      "nd_dtype",
+      Py_BuildValue("(O)", static_cast<PyObject*>(handle)), out);
+}
+
+int MXTPUNDArraySave(const char* path, MXTPUNDArrayHandle* arrays,
+                     const char** names, int n) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  PyObject* arrs = PyList_New(n);
+  PyObject* keys = PyList_New(n);
+  for (int i = 0; i < n; ++i) {
+    PyObject* o = static_cast<PyObject*>(arrays[i]);
+    Py_INCREF(o);
+    PyList_SET_ITEM(arrs, i, o);
+    PyObject* name = PyUnicode_FromString(names[i]);
+    if (name == nullptr) {  // e.g. invalid UTF-8 in the caller's key
+      Py_DECREF(arrs);
+      Py_DECREF(keys);
+      set_error_from_python();
+      return -1;
+    }
+    PyList_SET_ITEM(keys, i, name);
+  }
+  PyObject* r = PyObject_CallFunction(helper("nd_save"), "sOO", path,
+                                      arrs, keys);
+  Py_DECREF(arrs);
+  Py_DECREF(keys);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUNDArrayLoad(const char* path, MXTPUNDArrayHandle* arrays,
+                     const char** name_buf, int* n) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  PyObject* r = PyObject_CallFunction(helper("nd_load"), "s", path);
+  if (!r) { set_error_from_python(); return -1; }
+  PyObject* names = PyTuple_GetItem(r, 0);
+  PyObject* arrs = PyTuple_GetItem(r, 1);
+  Py_ssize_t k = PyList_Size(arrs);
+  if (k > *n) {
+    Py_DECREF(r);
+    tls_last_error = "Load: output capacity too small";
+    return -1;
+  }
+  tls_name_results.clear();
+  for (Py_ssize_t i = 0; i < k; ++i) {
+    tls_name_results.emplace_back(
+        PyUnicode_AsUTF8(PyList_GET_ITEM(names, i)));
+  }
+  for (Py_ssize_t i = 0; i < k; ++i) {
+    PyObject* o = PyList_GET_ITEM(arrs, i);
+    Py_INCREF(o);
+    arrays[i] = o;
+    name_buf[i] = tls_name_results[i].c_str();
+  }
+  *n = static_cast<int>(k);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUWaitAll(void) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  return call_void_helper("wait_all");
+}
+
+/* --- autograd ---------------------------------------------------------- */
+
+int MXTPUAutogradRecordBegin(void) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  return call_void_helper("record_begin");
+}
+
+int MXTPUAutogradRecordEnd(void) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  return call_void_helper("record_end");
+}
+
+int MXTPUNDArrayAttachGrad(MXTPUNDArrayHandle handle) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  return call_void_helper(
+      "attach_grad", Py_BuildValue("(O)", static_cast<PyObject*>(handle)));
+}
+
+int MXTPUAutogradBackward(MXTPUNDArrayHandle head) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  return call_void_helper(
+      "backward", Py_BuildValue("(O)", static_cast<PyObject*>(head)));
+}
+
+int MXTPUNDArrayGetGrad(MXTPUNDArrayHandle handle, MXTPUNDArrayHandle* out) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  PyObject* r = PyObject_CallFunctionObjArgs(
+      helper("get_grad"), static_cast<PyObject*>(handle), nullptr);
+  if (!r) { set_error_from_python(); return -1; }
+  *out = r;
+  return 0;
+}
+
+/* --- kvstore ----------------------------------------------------------- */
+
+int MXTPUKVStoreCreate(const char* type, MXTPUKVStoreHandle* out) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  PyObject* r = PyObject_CallFunction(helper("kv_create"), "s", type);
+  if (!r) { set_error_from_python(); return -1; }
+  *out = r;
+  return 0;
+}
+
+int MXTPUKVStoreInit(MXTPUKVStoreHandle kv, int key, MXTPUNDArrayHandle val) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  return call_void_helper(
+      "kv_init", Py_BuildValue("(OiO)", static_cast<PyObject*>(kv), key,
+                               static_cast<PyObject*>(val)));
+}
+
+int MXTPUKVStorePush(MXTPUKVStoreHandle kv, int key, MXTPUNDArrayHandle val) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  return call_void_helper(
+      "kv_push", Py_BuildValue("(OiO)", static_cast<PyObject*>(kv), key,
+                               static_cast<PyObject*>(val)));
+}
+
+int MXTPUKVStorePull(MXTPUKVStoreHandle kv, int key,
+                     MXTPUNDArrayHandle* out) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  PyObject* r = PyObject_CallFunction(helper("kv_pull"), "Oi",
+                                      static_cast<PyObject*>(kv), key);
+  if (!r) { set_error_from_python(); return -1; }
+  *out = r;
+  return 0;
+}
+
+int MXTPUKVStoreRank(MXTPUKVStoreHandle kv, int* rank) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  return call_int_helper(
+      "kv_rank", Py_BuildValue("(O)", static_cast<PyObject*>(kv)), rank);
+}
+
+int MXTPUKVStoreNumWorkers(MXTPUKVStoreHandle kv, int* n) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  return call_int_helper(
+      "kv_num_workers", Py_BuildValue("(O)", static_cast<PyObject*>(kv)), n);
+}
+
+int MXTPUKVStoreFree(MXTPUKVStoreHandle kv) {
+  return MXTPUNDArrayFree(kv);
+}
+
+/* --- profiler ---------------------------------------------------------- */
+
+int MXTPUProfilerStart(void) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  return call_void_helper("profiler_start");
+}
+
+int MXTPUProfilerStop(void) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  return call_void_helper("profiler_stop");
+}
+
+int MXTPUProfilerDumps(const char** out, int reset) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  return call_str_helper("profiler_dumps", Py_BuildValue("(i)", reset), out);
 }
 
 }  // extern "C"

@@ -16,6 +16,32 @@ def waitall():
     jax.effects_barrier()
 
 
+def save(fname, data):
+    """Save an NDArray / list / dict of NDArrays to `fname` (parity:
+    `python/mxnet/ndarray/utils.py` `save`; format is `.npz`-based here —
+    `src/serialization/cnpy.cc` is the reference's own npz path)."""
+    from ..util import save_arrays
+    save_arrays(fname, data)
+
+
+def load(fname):
+    """Load arrays saved by `save` -> dict (or list if keys are arr_N)
+    (parity: `python/mxnet/ndarray/utils.py` `load`).
+
+    Name-less saves (lists) are stored under ``arr_0..arr_{n-1}``, so a
+    dict saved with EXACTLY those contiguous keys loads back as a list —
+    the same list-vs-dict ambiguity the reference's name-less binary
+    format has. Use any other key naming to guarantee dict round-trip."""
+    from ..util import load_arrays
+    out = load_arrays(fname)
+    # lists round-trip as exactly arr_0..arr_{n-1} (the save() encoding);
+    # anything else — including a dict that merely uses arr_-style keys
+    # non-contiguously — stays a dict
+    if out and set(out) == {f"arr_{i}" for i in range(len(out))}:
+        return [out[f"arr_{i}"] for i in range(len(out))]
+    return out
+
+
 def _populate():
     from .. import numpy as _mnp
     g = globals()

@@ -34,7 +34,10 @@ def _resolve():
     platforms = {d.platform.lower() for d in jax.devices()}
     feats["TPU"] = bool(platforms & {"tpu", "axon"})
     try:
-        import jax.experimental.pallas  # noqa: F401
+        # NOTE: `import jax.experimental.pallas` would rebind `jax` as a
+        # function-local and break the `jax.devices()` call above
+        import importlib
+        importlib.import_module("jax.experimental.pallas")
         feats["PALLAS"] = True
     except ImportError:
         feats["PALLAS"] = False

@@ -24,30 +24,37 @@ def _python_embed_flags():
     return inc, libdir, ver
 
 
+def _build_example(build_dir, source_name, exe_name):
+    """g++-compile one cpp-package example against the built C ABI .so."""
+    _, libdir, ver = _python_embed_flags()
+    exe = build_dir / exe_name
+    cmd = [
+        "g++", "-std=c++17",
+        os.path.join(CPP, "example", source_name),
+        f"-I{os.path.join(CPP, 'include')}",
+        str(build_dir / "libmxtpu_c.so"), f"-L{libdir}", f"-l{ver}",
+        f"-Wl,-rpath,{libdir}", f"-Wl,-rpath,{build_dir}",
+        "-o", str(exe),
+    ]
+    r = subprocess.run(cmd, capture_output=True, text=True)
+    assert r.returncode == 0, f"{' '.join(cmd)}\n{r.stderr}"
+    return exe
+
+
 @pytest.fixture(scope="module")
 def built(tmp_path_factory):
     d = tmp_path_factory.mktemp("cppbuild")
     inc, libdir, ver = _python_embed_flags()
     lib = d / "libmxtpu_c.so"
-    exe = d / "mlp_inference"
     compile_lib = [
         "g++", "-std=c++17", "-shared", "-fPIC",
         os.path.join(CPP, "src", "c_api.cc"),
         f"-I{inc}", f"-I{os.path.join(CPP, 'include')}",
         f"-L{libdir}", f"-l{ver}", "-o", str(lib),
     ]
-    compile_exe = [
-        "g++", "-std=c++17",
-        os.path.join(CPP, "example", "mlp_inference.cpp"),
-        f"-I{os.path.join(CPP, 'include')}",
-        str(lib), f"-L{libdir}", f"-l{ver}",
-        f"-Wl,-rpath,{libdir}", f"-Wl,-rpath,{d}",
-        "-o", str(exe),
-    ]
-    for cmd in (compile_lib, compile_exe):
-        r = subprocess.run(cmd, capture_output=True, text=True)
-        assert r.returncode == 0, f"{' '.join(cmd)}\n{r.stderr}"
-    return exe
+    r = subprocess.run(compile_lib, capture_output=True, text=True)
+    assert r.returncode == 0, f"{' '.join(compile_lib)}\n{r.stderr}"
+    return _build_example(d, "mlp_inference.cpp", "mlp_inference")
 
 
 @pytest.fixture(scope="module")
@@ -96,20 +103,7 @@ def test_cpp_error_surface(built, exported_model):
 def built_train(tmp_path_factory, built):
     """Compile the C++ TRAINING example against the already-built C ABI
     (VERDICT round-2 missing #3: the reference's cpp-package trains)."""
-    d = built.parent
-    inc, libdir, ver = _python_embed_flags()
-    exe = d / "mlp_train"
-    cmd = [
-        "g++", "-std=c++17",
-        os.path.join(CPP, "example", "mlp_train.cpp"),
-        f"-I{os.path.join(CPP, 'include')}",
-        str(d / "libmxtpu_c.so"), f"-L{libdir}", f"-l{ver}",
-        f"-Wl,-rpath,{libdir}", f"-Wl,-rpath,{d}",
-        "-o", str(exe),
-    ]
-    r = subprocess.run(cmd, capture_output=True, text=True)
-    assert r.returncode == 0, f"{' '.join(cmd)}\n{r.stderr}"
-    return exe
+    return _build_example(built.parent, "mlp_train.cpp", "mlp_train")
 
 
 def test_cpp_training_end_to_end(built_train):
@@ -121,3 +115,21 @@ def test_cpp_training_end_to_end(built_train):
                        text=True, env=env, timeout=600)
     assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-1500:])
     assert "MLP TRAIN OK" in r.stdout
+
+
+@pytest.fixture(scope="module")
+def built_tour(tmp_path_factory, built):
+    """Compile the C-API tour example (the widened ABI surface: version/
+    op-list/features, dtype create, npz save/load, autograd, kvstore,
+    profiler — parity groups of `include/mxnet/c_api.h`)."""
+    return _build_example(built.parent, "capi_tour.cpp", "capi_tour")
+
+
+def test_capi_tour(built_tour, tmp_path):
+    """Runs every widened C-ABI group end-to-end from C++."""
+    env = dict(os.environ)
+    env["MXTPU_HOME"] = REPO
+    r = subprocess.run([str(built_tour), "cpu", str(tmp_path)],
+                       capture_output=True, text=True, env=env, timeout=600)
+    assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-1500:])
+    assert "CAPI TOUR OK" in r.stdout

