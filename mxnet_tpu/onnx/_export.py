@@ -86,6 +86,7 @@ _SIMPLE = {
     "sinh": "Sinh", "cosh": "Cosh", "asinh": "Asinh", "acosh": "Acosh",
     "atanh": "Atanh", "and": "And", "or": "Or", "xor": "Xor", "not": "Not",
     "stop_gradient": "Identity", "copy": "Identity",
+    "device_put": "Identity",   # placement is meaningless in a graph file
 }
 
 _COMPARE = {"eq": ("Equal", False), "lt": ("Less", False),
@@ -357,6 +358,120 @@ def _convert_eqn(g: _Graph, eqn):
         g.add_node("CumSum", [ins[0], axis], outs,
                    reverse=1 if p.get("reverse") else 0)
         return
+    if prim == "exp2":
+        two = g.add_const(_onp.float32(2.0))
+        g.add_node("Pow", [two, ins[0]], outs)
+        return
+    if prim == "is_finite":
+        # |x| < inf is False for nan and +-inf, True otherwise
+        absx = g.fresh("abs")
+        g.add_node("Abs", ins, [absx])
+        inf = g.add_const(_onp.float32(_onp.inf))
+        g.add_node("Less", [absx, inf], outs)
+        return
+    if prim == "atan2":
+        # atan(y/x) + pi * (x < 0) * (y >= 0 ? 1 : -1), then two Where
+        # fixes for x == +-0 (comparisons can't see the sign of zero):
+        # x==0, y!=0 -> ysign * pi/2; x==0, y==0 -> 0.  Remaining known
+        # divergence from IEEE arctan2: signed-zero y at the origin
+        # (arctan2(-0., -0.) = -pi) is reported as 0.
+        y, x = ins
+        ratio, at = g.fresh("ratio"), g.fresh("atan")
+        g.add_node("Div", [y, x], [ratio])
+        g.add_node("Atan", [ratio], [at])
+        xneg, xneg_f = g.fresh("xneg"), g.fresh("xnegf")
+        zero = g.add_const(_onp.float32(0.0))
+        g.add_node("Less", [x, zero], [xneg])
+        g.add_node("Cast", [xneg], [xneg_f], to=P.FLOAT)
+        ypos, ypos_f = g.fresh("ypos"), g.fresh("yposf")
+        g.add_node("GreaterOrEqual", [y, zero], [ypos])
+        g.add_node("Cast", [ypos], [ypos_f], to=P.FLOAT)
+        two = g.add_const(_onp.float32(2.0))
+        one = g.add_const(_onp.float32(1.0))
+        ysign, t1 = g.fresh("ysign"), g.fresh("t")
+        g.add_node("Mul", [ypos_f, two], [t1])
+        g.add_node("Sub", [t1, one], [ysign])    # +1 if y>=0 else -1
+        pi = g.add_const(_onp.float32(_onp.pi))
+        corr, corr2, base = g.fresh("corr"), g.fresh("corr2"), g.fresh("base")
+        g.add_node("Mul", [xneg_f, ysign], [corr])
+        g.add_node("Mul", [corr, pi], [corr2])
+        g.add_node("Add", [at, corr2], [base])
+        xzero, yzero = g.fresh("xzero"), g.fresh("yzero")
+        g.add_node("Equal", [x, zero], [xzero])     # true for +-0
+        g.add_node("Equal", [y, zero], [yzero])
+        halfpi = g.add_const(_onp.float32(_onp.pi / 2))
+        yhalf, onaxis = g.fresh("yhalf"), g.fresh("onaxis")
+        g.add_node("Mul", [ysign, halfpi], [yhalf])
+        g.add_node("Where", [xzero, yhalf, base], [onaxis])
+        origin = g.fresh("origin")
+        g.add_node("And", [xzero, yzero], [origin])
+        g.add_node("Where", [origin, zero, onaxis], outs)
+        return
+    if prim in ("reduce_and", "reduce_or"):
+        # boolean reductions via int min/max (onnx reduces are numeric)
+        as_int, red = g.fresh("bint"), g.fresh("red")
+        g.add_node("Cast", ins, [as_int], to=P.INT32)
+        g.add_node("ReduceMin" if prim == "reduce_and" else "ReduceMax",
+                   [as_int], [red],
+                   axes=[int(a) for a in p["axes"]], keepdims=0)
+        g.add_node("Cast", [red], outs, to=P.BOOL)
+        return
+    if prim == "top_k":
+        kc = g.add_const(_onp.asarray([p["k"]], _onp.int64))
+        idx64 = g.fresh("topk_i")
+        # positive axis: attr ints serialize unsigned in the proto writer
+        last = len(eqn.invars[0].aval.shape) - 1
+        g.add_node("TopK", [ins[0], kc], [outs[0], idx64],
+                   axis=last, largest=1, sorted=1)
+        g.add_node("Cast", [idx64], [outs[1]], to=P.INT32)
+        return
+    if prim == "sort":
+        # lax.sort: ascending along `dimension`; extra operands are
+        # permuted by the first (num_keys == 1): TopK(largest=0) gives the
+        # ascending order, GatherElements applies it to the others
+        if p.get("num_keys", 1) != 1:
+            raise UnsupportedOp("sort with num_keys > 1")
+        dim = p["dimension"]
+        axis_len = eqn.invars[0].aval.shape[dim]
+        kc = g.add_const(_onp.asarray([axis_len], _onp.int64))
+        idx = g.fresh("sort_i")
+        g.add_node("TopK", [ins[0], kc], [outs[0], idx],
+                   axis=dim, largest=0, sorted=1)
+        for extra_in, extra_out in zip(ins[1:], outs[1:]):
+            g.add_node("GatherElements", [extra_in, idx], [extra_out],
+                       axis=dim)
+        return
+    if prim == "dynamic_slice":
+        # runtime starts: clamp into range, then tensor-input Slice
+        operand_var = eqn.invars[0]
+        sizes = p["slice_sizes"]
+        rank = len(sizes)
+        shape = operand_var.aval.shape
+        start_parts = []
+        for i, s in enumerate(ins[1:]):
+            s64, sr = g.fresh("st64"), g.fresh("st")
+            g.add_node("Cast", [s], [s64], to=P.INT64)
+            g.add_node("Reshape",
+                       [s64, g.add_const(_onp.asarray([1], _onp.int64))],
+                       [sr])
+            lo = g.add_const(_onp.asarray([0], _onp.int64))
+            hi = g.add_const(_onp.asarray([shape[i] - sizes[i]], _onp.int64))
+            cl, cl2 = g.fresh("cl"), g.fresh("cl2")
+            g.add_node("Max", [sr, lo], [cl])
+            g.add_node("Min", [cl, hi], [cl2])
+            start_parts.append(cl2)
+        starts = g.fresh("starts")
+        g.add_node("Concat", start_parts, [starts], axis=0)
+        ends = g.fresh("ends")
+        g.add_node("Add", [starts,
+                           g.add_const(_onp.asarray(sizes, _onp.int64))],
+                   [ends])
+        axes = g.add_const(_onp.asarray(list(range(rank)), _onp.int64))
+        g.add_node("Slice", [ins[0], starts, ends, axes], outs)
+        return
+    if prim == "scan":
+        _convert_scan(g, eqn, ins, outs)
+        return
     if prim in ("jit", "pjit", "closed_call", "core_call", "custom_jvp_call",
                 "custom_vjp_call", "custom_vjp_call_jaxpr", "remat",
                 "checkpoint", "custom_jvp_call_jaxpr"):
@@ -377,6 +492,76 @@ def _convert_eqn(g: _Graph, eqn):
         return
 
     raise UnsupportedOp(f"no ONNX converter for primitive '{prim}'")
+
+
+def _convert_scan(g: _Graph, eqn, ins, outs):
+    """`lax.scan` → unrolled ONNX nodes (static trip count).
+
+    The reference exports RNN layers through per-op translation tables;
+    here LSTM/GRU/RNN lower to one `scan` primitive whose body we inline
+    `length` times (ONNX Loop would also work but the interpreter- and
+    runtime-portable choice is unrolling; trip counts are bounded by
+    MXTPU_ONNX_MAX_UNROLL, default 1024)."""
+    import os
+    p = eqn.params
+    length, reverse = p["length"], p["reverse"]
+    n_const, n_carry = p["num_consts"], p["num_carry"]
+    cap = int(os.environ.get("MXTPU_ONNX_MAX_UNROLL", 1024))
+    if length > cap:
+        raise UnsupportedOp(
+            f"scan of length {length} > MXTPU_ONNX_MAX_UNROLL={cap}")
+    closed = p["jaxpr"]
+    inner = closed.jaxpr
+    const_names = ins[:n_const]
+    carry_names = list(ins[n_const:n_const + n_carry])
+    xs_names = ins[n_const + n_carry:]
+
+    # every var the body binds must be un-named between iterations so each
+    # unrolled copy emits fresh SSA tensor names. Closure constants are
+    # iteration-invariant — bound ONCE here; re-adding per iteration would
+    # duplicate every >=256 B initializer `length` times (add_const only
+    # dedupes small payloads).
+    inner_vars = set(inner.invars)
+    for e2 in inner.eqns:
+        inner_vars.update(e2.outvars)
+    for cv, cval in zip(inner.constvars, closed.consts):
+        g.names[cv] = g.add_const(_onp.asarray(cval), "const")
+
+    n_ys = len(inner.outvars) - n_carry
+    ys_steps: List[List[str]] = [[] for _ in range(n_ys)]
+    order = range(length - 1, -1, -1) if reverse else range(length)
+    for it in order:
+        for iv, nm in zip(inner.invars[:n_const], const_names):
+            g.names[iv] = nm
+        for iv, nm in zip(inner.invars[n_const:n_const + n_carry],
+                          carry_names):
+            g.names[iv] = nm
+        idx = g.add_const(_onp.asarray(it, _onp.int64))
+        for iv, xs_nm in zip(inner.invars[n_const + n_carry:], xs_names):
+            sliced = g.fresh("xs")
+            g.add_node("Gather", [xs_nm, idx], [sliced], axis=0)
+            g.names[iv] = sliced
+        for e2 in inner.eqns:
+            _convert_eqn(g, e2)
+        carry_names = [g.name_of(ov) for ov in inner.outvars[:n_carry]]
+        for k, ov in enumerate(inner.outvars[n_carry:]):
+            shp = g.add_const(
+                _onp.asarray((1,) + tuple(ov.aval.shape), _onp.int64))
+            u = g.fresh("y")
+            g.add_node("Reshape", [g.name_of(ov), shp], [u])
+            ys_steps[k].append(u)
+        for v in inner_vars:
+            g.names.pop(v, None)
+
+    for nm, out in zip(carry_names, outs[:n_carry]):
+        g.add_node("Identity", [nm], [out])
+    for steps, out in zip(ys_steps, outs[n_carry:]):
+        if reverse:
+            steps = steps[::-1]  # stacked ys stay in xs index order
+        if len(steps) == 1:
+            g.add_node("Identity", steps, [out])
+        else:
+            g.add_node("Concat", steps, [out], axis=0)
 
 
 def _convert_reduce_window(g, eqn, prim, ins, outs):

@@ -269,9 +269,27 @@ def run_model(model_bytes: bytes, inputs: Dict[str, _onp.ndarray]):
             else:
                 out = _onp.cumsum(out, ax)
             out = out.astype(ins[0].dtype)
+        elif op == "TopK":
+            x = ins[0]
+            k = int(_onp.asarray(ins[1]).reshape(-1)[0])
+            ax = a.get("axis", -1)
+            if a.get("largest", 1):
+                # stable argsort of the NEGATED key keeps the lower index
+                # first among ties (flipping an ascending sort would not)
+                key = -x.astype(_onp.int64) if x.dtype.kind == "u" else -x
+            else:
+                key = x
+            idx = _onp.argsort(key, axis=ax, kind="stable")
+            idx = _onp.take(idx, _onp.arange(k), axis=ax)
+            vals = _onp.take_along_axis(x, idx, axis=ax)
+            out = (vals, idx.astype(_onp.int64))
+        elif op == "GatherElements":
+            out = _onp.take_along_axis(ins[0], ins[1].astype(_onp.int64),
+                                       axis=a.get("axis", 0))
         else:
             raise MXNetError(f"interpreter: unsupported op {op}")
-        for oname in nd["outputs"]:
-            env[oname] = _onp.asarray(out)
+        outs = out if isinstance(out, tuple) else (out,) * len(nd["outputs"])
+        for oname, o in zip(nd["outputs"], outs):
+            env[oname] = _onp.asarray(o)
 
     return {vi["name"]: env[vi["name"]] for vi in g["outputs"]}
