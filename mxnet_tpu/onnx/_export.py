@@ -35,11 +35,20 @@ class _Graph:
         self.names: Dict[object, str] = {}   # jaxpr Var -> tensor name
         self._counter = itertools.count()
         self._const_cache: Dict[bytes, str] = {}
+        self._emitted: set = set()           # SSA guard: output names
 
     def fresh(self, hint="t"):
         return f"{hint}_{next(self._counter)}"
 
     def add_node(self, op, inputs, outputs, **attrs):
+        for o in outputs:
+            # ONNX graphs are SSA; the in-repo interpreter would silently
+            # shadow a duplicate but onnxruntime rejects the file
+            if o in self._emitted:
+                raise MXNetError(
+                    f"exporter bug: tensor name {o!r} written twice "
+                    f"(op {op})")
+            self._emitted.add(o)
         self.nodes.append(P.node(op, list(inputs), list(outputs),
                                  name=self.fresh(op.lower()), attrs=attrs))
 
@@ -86,7 +95,6 @@ _SIMPLE = {
     "sinh": "Sinh", "cosh": "Cosh", "asinh": "Asinh", "acosh": "Acosh",
     "atanh": "Atanh", "and": "And", "or": "Or", "xor": "Xor", "not": "Not",
     "stop_gradient": "Identity", "copy": "Identity",
-    "device_put": "Identity",   # placement is meaningless in a graph file
 }
 
 _COMPARE = {"eq": ("Equal", False), "lt": ("Less", False),
@@ -358,6 +366,12 @@ def _convert_eqn(g: _Graph, eqn):
         g.add_node("CumSum", [ins[0], axis], outs,
                    reverse=1 if p.get("reverse") else 0)
         return
+    if prim == "device_put":
+        # placement is meaningless in a graph file; the primitive is
+        # VARIADIC (jax >= 0.4.31) so emit one Identity per operand
+        for i_nm, o_nm in zip(ins, outs):
+            g.add_node("Identity", [i_nm], [o_nm])
+        return
     if prim == "exp2":
         two = g.add_const(_onp.float32(2.0))
         g.add_node("Pow", [two, ins[0]], outs)
@@ -481,14 +495,24 @@ def _convert_eqn(g: _Graph, eqn):
         closed = sub if hasattr(sub, "jaxpr") else None
         inner = closed.jaxpr if closed else sub
         consts = closed.consts if closed else []
+        # jax CACHES traced sub-jaxprs: two calls of the same function
+        # (two relu layers, var+std, ...) share the identical inner Var
+        # objects.  Scope the name map per inlining — resolve the outer
+        # boundary names first (those must persist), restore after — or
+        # the second inlining would re-emit the first one's tensor names
+        # (SSA violation; onnxruntime rejects the file).
+        in_names = [g.name_of(iv) for iv in eqn.invars]
+        out_names = [g.name_of(ov) for ov in eqn.outvars]
+        base_names = dict(g.names)
         for cv, cval in zip(inner.constvars, consts):
             g.names[cv] = g.add_const(_onp.asarray(cval), "const")
-        for iv, outer in zip(inner.invars, eqn.invars):
-            g.names[iv] = g.name_of(outer)
+        for iv, in_nm in zip(inner.invars, in_names):
+            g.names[iv] = in_nm
         for sub_eqn in inner.eqns:
             _convert_eqn(g, sub_eqn)
-        for ov, outer in zip(inner.outvars, eqn.outvars):
-            g.add_node("Identity", [g.name_of(ov)], [g.name_of(outer)])
+        for ov, out_nm in zip(inner.outvars, out_names):
+            g.add_node("Identity", [g.name_of(ov)], [out_nm])
+        g.names = base_names
         return
 
     raise UnsupportedOp(f"no ONNX converter for primitive '{prim}'")
@@ -516,16 +540,19 @@ def _convert_scan(g: _Graph, eqn, ins, outs):
     carry_names = list(ins[n_const:n_const + n_carry])
     xs_names = ins[n_const + n_carry:]
 
-    # every var the body binds must be un-named between iterations so each
-    # unrolled copy emits fresh SSA tensor names. Closure constants are
-    # iteration-invariant — bound ONCE here; re-adding per iteration would
-    # duplicate every >=256 B initializer `length` times (add_const only
-    # dedupes small payloads).
-    inner_vars = set(inner.invars)
-    for e2 in inner.eqns:
-        inner_vars.update(e2.outvars)
+    # Closure constants are iteration-invariant — bound ONCE here;
+    # re-adding per iteration would duplicate every >=256 B initializer
+    # `length` times (add_const only dedupes small payloads).
     for cv, cval in zip(inner.constvars, closed.consts):
         g.names[cv] = g.add_const(_onp.asarray(cval), "const")
+
+    # Every var the body binds — including vars inside NESTED jaxprs
+    # (custom_jvp_call / pjit bodies, which the call-inlining branch names
+    # too) — must be un-named between iterations so each unrolled copy
+    # emits fresh SSA tensor names.  Restoring the whole map is the only
+    # scheme that is robust to arbitrary nesting; per-iteration results
+    # travel as name STRINGS (carry_names / ys_steps), not var entries.
+    base_names = dict(g.names)
 
     n_ys = len(inner.outvars) - n_carry
     ys_steps: List[List[str]] = [[] for _ in range(n_ys)]
@@ -550,8 +577,7 @@ def _convert_scan(g: _Graph, eqn, ins, outs):
             u = g.fresh("y")
             g.add_node("Reshape", [g.name_of(ov), shp], [u])
             ys_steps[k].append(u)
-        for v in inner_vars:
-            g.names.pop(v, None)
+        g.names = dict(base_names)
 
     for nm, out in zip(carry_names, outs[:n_carry]):
         g.add_node("Identity", [nm], [out])

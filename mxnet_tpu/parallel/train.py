@@ -406,7 +406,73 @@ class ShardedTrainStep:
     def save(self, path: str) -> None:
         """Checkpoint params, optimizer state, step count, and RNG to `path`
         (.npz). Sharded arrays are gathered to host; `load` re-shards."""
+        self._drain_async_save()
+        self._write_checkpoint(path, self._snapshot())
+
+    def save_async(self, path: str):
+        """Non-blocking checkpoint: snapshot the training state as
+        device-side COPIES (async dispatches — cheap to enqueue) and
+        gather + write in a background thread while training continues.
+        Returns a handle; call `.result()` to wait and re-raise any
+        writer error.  Copies, not references: the jitted step donates
+        its param/state buffers (`donate_argnums`), so the next step()
+        would invalidate snapshotted originals on TPU — the private
+        copies are untouched by donation.  Costs one transient extra
+        params+opt-state footprint in HBM until the write drains.  The
+        reference has no analogue — its NDArrays are mutable, so
+        `save_states` must stop the engine (SURVEY §5.4's recovery story
+        without the stall).
+
+        Only one async save runs at a time: a second call waits for the
+        first.  Multi-process meshes fall back to a synchronous save —
+        the cross-host allgather must not race training collectives."""
+        import concurrent.futures as _fut
+        multi = any(not getattr(s, "is_fully_addressable", True)
+                    for s in self.param_shardings.values())
+        if multi:
+            self.save(path)
+            done: _fut.Future = _fut.Future()
+            done.set_result(path)
+            return done
+        if self._ckpt_pool is None:
+            self._ckpt_pool = _fut.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="mxtpu-ckpt")
+        self._drain_async_save()
+        snap = self._snapshot(copy=True)
+        self._ckpt_last = self._ckpt_pool.submit(
+            self._write_checkpoint, path, snap)
+        return self._ckpt_last
+
+    _ckpt_pool = None
+    _ckpt_last = None
+
+    def _drain_async_save(self):
+        """Wait for any in-flight async save; re-raise its error if it
+        failed (also surfaces errors of already-finished saves the caller
+        never polled).  The future is cleared FIRST so one failed write
+        doesn't poison every later save attempt."""
+        fut, self._ckpt_last = self._ckpt_last, None
+        if fut is not None:
+            fut.result()
+
+    def _snapshot(self, copy: bool = False):
+        """Consistent view of the current training state.  With
+        `copy=True` every device array is copied (async dispatch) so the
+        snapshot survives the next step's buffer donation."""
         from .. import random as _rng
+        g = _rng.generator
+        dup = (lambda x: jnp.copy(x)) if copy else (lambda x: x)
+        return {
+            "pvals": {n: dup(v) for n, v in self.pvals.items()},
+            "opt_state": {n: [dup(leaf) for leaf in
+                              jax.tree_util.tree_leaves(self.opt_state[n])]
+                          for n in self.diff_names},
+            "t": self._t,
+            "rng_seed": g._seed,
+            "rng_key": g._key,
+        }
+
+    def _write_checkpoint(self, path: str, snap) -> str:
         from ..util import npz_encode_entry
 
         def put(out, key, val):
@@ -414,18 +480,25 @@ class ShardedTrainStep:
 
         out = {}
         for n in self.param_names:
-            put(out, "p:" + n, self.pvals[n])
+            put(out, "p:" + n, snap["pvals"][n])
         for n in self.diff_names:
-            for i, leaf in enumerate(
-                    jax.tree_util.tree_leaves(self.opt_state[n])):
+            for i, leaf in enumerate(snap["opt_state"][n]):
                 put(out, f"s:{n}:{i}", leaf)
-        out["meta:t"] = onp.asarray(self._t, onp.int64)
-        g = _rng.generator
-        out["meta:rng_seed"] = onp.asarray(g._seed, onp.int64)
-        if g._key is not None:
-            put(out, "meta:rng_key", g._key)
-        with open(path, "wb") as f:
+        out["meta:t"] = onp.asarray(snap["t"], onp.int64)
+        out["meta:rng_seed"] = onp.asarray(snap["rng_seed"], onp.int64)
+        if snap["rng_key"] is not None:
+            put(out, "meta:rng_key", snap["rng_key"])
+        # every process participated in the gathers above (collectives);
+        # only rank 0 touches the filesystem — concurrent writers to one
+        # shared path would corrupt each other's tmp file
+        import os
+        if jax.process_index() != 0:
+            return path
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
             onp.savez(f, **out)
+        os.replace(tmp, path)   # atomic: a crash never truncates `path`
+        return path
 
     def load(self, path: str) -> None:
         """Restore a `save` checkpoint; arrays are re-placed with this
