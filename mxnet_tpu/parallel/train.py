@@ -434,16 +434,15 @@ class ShardedTrainStep:
             done: _fut.Future = _fut.Future()
             done.set_result(path)
             return done
-        if self._ckpt_pool is None:
-            self._ckpt_pool = _fut.ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="mxtpu-ckpt")
+        return self._submit_async_save(path)
+
+    def _submit_async_save(self, path: str):
         self._drain_async_save()
         snap = self._snapshot(copy=True)
-        self._ckpt_last = self._ckpt_pool.submit(
+        self._ckpt_last = _ckpt_pool().submit(
             self._write_checkpoint, path, snap)
         return self._ckpt_last
 
-    _ckpt_pool = None
     _ckpt_last = None
 
     def _drain_async_save(self):
@@ -488,12 +487,14 @@ class ShardedTrainStep:
         out["meta:rng_seed"] = onp.asarray(snap["rng_seed"], onp.int64)
         if snap["rng_key"] is not None:
             put(out, "meta:rng_key", snap["rng_key"])
-        # every process participated in the gathers above (collectives);
-        # only rank 0 touches the filesystem — concurrent writers to one
-        # shared path would corrupt each other's tmp file
+        # Multi-process meshes: every rank gathered the identical global
+        # payload above (collectives), and every rank writes it — to a
+        # pid-suffixed tmp so concurrent writers never interleave within
+        # one file; the atomic replaces then race benignly (identical
+        # content, last one wins, `path` is always complete). Skipping
+        # the write on rank != 0 would break callers that hand each rank
+        # its own tmp path and replace afterwards (CheckpointManager).
         import os
-        if jax.process_index() != 0:
-            return path
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "wb") as f:
             onp.savez(f, **out)
@@ -544,6 +545,21 @@ class ShardedTrainStep:
             # (possibly advanced) key so draws restart from PRNGKey(seed)
             g._key = None
         self.sync_params_to_block()
+
+
+_CKPT_POOL = None
+
+
+def _ckpt_pool():
+    """Process-wide single-worker writer pool: shared across every
+    ShardedTrainStep so repeated step construction (elastic restarts,
+    sweeps) doesn't accumulate idle checkpoint threads."""
+    global _CKPT_POOL
+    if _CKPT_POOL is None:
+        import concurrent.futures as _fut
+        _CKPT_POOL = _fut.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="mxtpu-ckpt")
+    return _CKPT_POOL
 
 
 def _gather_to_host(x):

@@ -37,6 +37,12 @@ def test_quantization_example():
     assert "INT8 QUANTIZATION EXAMPLE OK" in out
 
 
+def test_bert_finetune_example():
+    # 60 steps: enough for the loss-falls assert, light enough for CI
+    out = _run("examples/bert_finetune.py", "--cpu", "--steps", "60")
+    assert "bert finetune example OK" in out
+
+
 @pytest.mark.slow
 def test_long_context_sp_example():
     env = dict(os.environ)
