@@ -87,6 +87,32 @@ def main():
         assert abs(loss - ref) < 1e-4 * max(1.0, abs(ref)), (i, loss, ref)
         losses.append(loss)
     assert losses[-1] < losses[0], losses
+
+    # --- checkpoint leg: every rank writes the SAME shared path (the
+    # pid-suffixed tmp + atomic rename makes concurrent writers safe;
+    # identical gathered payload means last-rename-wins is benign), and
+    # save_async falls back to a synchronous write on multi-process
+    # meshes ----------------------------------------------------------
+    ckpt = os.environ.get("MXTPU_TEST_CKPT",
+                          "/tmp/dist_sharded_step_ckpt.npz")
+    fut = step.save_async(ckpt)
+    assert fut.result() == ckpt
+    multihost_utils.sync_global_devices("ckpt written")
+    assert os.path.getsize(ckpt) > 0, "checkpoint file empty"
+
+    resumed = build(global_mesh)
+    resumed.load(ckpt)
+    assert resumed._t == step._t, (resumed._t, step._t)
+    next_a = float(jax.device_get(step(mx.np.array(xb), mx.np.array(yb))))
+    next_b = float(jax.device_get(resumed(mx.np.array(xb),
+                                          mx.np.array(yb))))
+    assert abs(next_a - next_b) < 1e-6 * max(1.0, abs(next_a)), \
+        (next_a, next_b)
+    if rank == 0:
+        try:
+            os.remove(ckpt)
+        except OSError:
+            pass
     print(f"[rank {rank}] dist_sharded_step OK (n={n}, "
           f"losses={[round(l, 5) for l in losses]})", flush=True)
 

@@ -79,7 +79,7 @@ def test_dist_elastic_coordinated_preemption():
     assert steps[0][1] == steps[1][1], steps  # same step on every rank
 
 
-def test_dist_sharded_train_step_two_processes():
+def test_dist_sharded_train_step_two_processes(tmp_path):
     """Flagship ShardedTrainStep over a 2-process x 2-device global mesh:
     dp=4 loss must match single-device training bit-for-bit-ish
     (VERDICT round-2 next-step #8)."""
@@ -87,6 +87,9 @@ def test_dist_sharded_train_step_two_processes():
     env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
     env.pop("XLA_FLAGS", None)   # the worker script sets its own 2-device flag
     env["JAX_PLATFORMS"] = "cpu"
+    # unique shared checkpoint path for the multi-writer save leg
+    # (pytest cleans tmp_path, so worker failures can't leak files)
+    env["MXTPU_TEST_CKPT"] = str(tmp_path / "step.npz")
     cmd = [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
            "-n", "2", "--launcher", "local", "-p", str(_free_port()),
            sys.executable, os.path.join(ROOT, "tests", "dist",
