@@ -101,6 +101,42 @@ class GPTModel(HybridBlock):
         return self.final_norm(x)
 
 
+def _filter_logits(logits, top_k=0, top_p=1.0):
+    """Top-k then top-p (nucleus) logit filtering over the last axis.
+
+    Pure jax (static k/p -> jit-safe inside the decode scan). Dropped
+    tokens get -1e30 so `jax.random.categorical` never selects them.
+    Exact truncation even under tied logits: positions are RANKED (stable
+    descending sort, lower vocab index wins ties) and exactly the first
+    `keep_n` ranks survive — a value threshold would keep every tie at
+    the boundary.  Always keeps at least the argmax token."""
+    import jax
+    import jax.numpy as jnp
+
+    V = logits.shape[-1]
+    want_k = bool(top_k) and 0 < top_k < V
+    want_p = top_p < 1.0
+    if not (want_k or want_p):
+        return logits
+
+    order = jnp.argsort(-logits, axis=-1, stable=True)   # descending
+    keep_n = jnp.full(logits.shape[:-1] + (1,), V, jnp.int32)
+    if want_k:
+        keep_n = jnp.minimum(keep_n, top_k)
+    if want_p:
+        sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # a sorted position is INSIDE the nucleus while the mass BEFORE
+        # it is < p (the first token always stays)
+        inside = (cum - probs) < top_p
+        keep_n = jnp.minimum(
+            keep_n, jnp.maximum(
+                1, jnp.sum(inside, axis=-1, keepdims=True)))
+    ranks = jnp.argsort(order, axis=-1, stable=True)
+    return jnp.where(ranks < keep_n, logits, -1e30)
+
+
 class GPTForCausalLM(HybridBlock):
     """Next-token LM head; with `tie_embeddings` the decoder reuses the
     input embedding matrix (GPT-2 parity, halves embed params)."""
@@ -123,7 +159,7 @@ class GPTForCausalLM(HybridBlock):
 
     def generate(self, input_ids, max_new_tokens=20, temperature=1.0,
                  greedy=True, use_cache=True, num_beams=1,
-                 eos_token_id=None):
+                 eos_token_id=None, top_k=0, top_p=1.0):
         """Autoregressive decode.
 
         `use_cache=True` (default): ONE jitted `lax.scan` over
@@ -133,6 +169,12 @@ class GPTForCausalLM(HybridBlock):
         path. `use_cache=False` keeps the simple full-context recompute
         (the two paths produce identical greedy outputs; tested).
 
+        Sampling (`greedy=False`) supports the standard decoding
+        controls: `temperature`, `top_k` (keep the k highest logits;
+        0 = off), and `top_p` nucleus filtering (keep the smallest set
+        of tokens whose probability mass reaches p; 1.0 = off) — k/p
+        compose in that order, like the common HF semantics.
+
         `num_beams > 1`: length-normalised beam search on the same cached
         scan (caches/histories gather-reindexed per step; finished beams
         freeze on `eos_token_id`). Returns the best beam per batch row."""
@@ -141,7 +183,7 @@ class GPTForCausalLM(HybridBlock):
                                        num_beams, eos_token_id)
         if use_cache:
             return self._generate_cached(input_ids, max_new_tokens,
-                                         temperature, greedy)
+                                         temperature, greedy, top_k, top_p)
         from .. import random as _rng
         import jax
         ids = input_ids
@@ -151,9 +193,11 @@ class GPTForCausalLM(HybridBlock):
                 nxt = np.argmax(logits, axis=-1).astype("int32")
             else:
                 key = _rng.next_key()
+                filtered = _filter_logits(
+                    (logits.astype("float32") / temperature)._data,
+                    top_k, top_p)
                 nxt = np.from_jax(jax.random.categorical(
-                    key, (logits / temperature)._data, axis=-1)).astype(
-                    "int32")
+                    key, filtered, axis=-1)).astype("int32")
             ids = np.concatenate([ids, nxt.reshape(-1, 1)], axis=1)
         return ids
 
@@ -334,7 +378,7 @@ class GPTForCausalLM(HybridBlock):
         return np.from_jax(run(prompt))
 
     def _generate_cached(self, input_ids, max_new_tokens, temperature,
-                        greedy):
+                        greedy, top_k=0, top_p=1.0):
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -362,9 +406,18 @@ class GPTForCausalLM(HybridBlock):
                 nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             else:
                 kt = jax.random.fold_in(key, t)
-                nxt = jax.random.categorical(
-                    kt, logits.astype(jnp.float32) / temperature,
-                    axis=-1).astype(jnp.int32)
+
+                def _sample(lg):
+                    filtered = _filter_logits(
+                        lg.astype(jnp.float32) / temperature, top_k, top_p)
+                    return jax.random.categorical(
+                        kt, filtered, axis=-1).astype(jnp.int32)
+
+                # prefill steps discard the draw (out_tok forces the
+                # prompt token) — skip the O(V log V) filter+sample there
+                nxt = lax.cond(
+                    t + 1 >= plen, _sample,
+                    lambda lg: jnp.zeros(lg.shape[:-1], jnp.int32), logits)
             out_tok = jnp.where(t + 1 < plen,
                                 prompt[:, jnp.minimum(t + 1, plen - 1)],
                                 nxt)

@@ -379,6 +379,71 @@ def test_gpt_kv_cache_decode_untied_and_sampled():
     assert ((arr >= 0) & (arr < 64)).all()
 
 
+def test_gpt_logit_filters():
+    """_filter_logits semantics: top-k keeps exactly the k best, top-p
+    keeps the smallest nucleus reaching p, and the two compose."""
+    import jax.numpy as jnp
+    from mxnet_tpu.models.gpt import _filter_logits
+
+    logits = jnp.log(jnp.array([[0.5, 0.25, 0.15, 0.08, 0.02]]))
+
+    kept = onp.asarray(_filter_logits(logits, top_k=2)[0] > -1e29)
+    onp.testing.assert_array_equal(kept, [True, True, False, False, False])
+
+    # nucleus at p=0.7: 0.5 alone misses p, 0.5+0.25 reaches it -> keep 2
+    kept = onp.asarray(_filter_logits(logits, top_p=0.7)[0] > -1e29)
+    onp.testing.assert_array_equal(kept, [True, True, False, False, False])
+
+    # p tiny: always keeps at least the argmax
+    kept = onp.asarray(_filter_logits(logits, top_p=1e-6)[0] > -1e29)
+    onp.testing.assert_array_equal(kept, [True, False, False, False, False])
+
+    # compose: k=4 then p=0.95 -> 0.5+0.25+0.15 < .95, +0.08 reaches it
+    kept = onp.asarray(
+        _filter_logits(logits, top_k=4, top_p=0.95)[0] > -1e29)
+    onp.testing.assert_array_equal(kept, [True, True, True, True, False])
+
+    # off = passthrough
+    onp.testing.assert_array_equal(onp.asarray(_filter_logits(logits)),
+                                   onp.asarray(logits))
+
+    # exact truncation under TIES: four equal logits, top_k=2 keeps
+    # exactly 2 (lowest indices win), top_p=0.3 likewise
+    tied = jnp.log(jnp.array([[0.25, 0.25, 0.25, 0.25]]))
+    kept = onp.asarray(_filter_logits(tied, top_k=2)[0] > -1e29)
+    onp.testing.assert_array_equal(kept, [True, True, False, False])
+    kept = onp.asarray(_filter_logits(tied, top_p=0.3)[0] > -1e29)
+    onp.testing.assert_array_equal(kept, [True, True, False, False])
+
+
+def test_gpt_topk_sampling_restricted_support():
+    """With top_k=1, sampling must reproduce greedy decode exactly —
+    the filter really constrains the categorical draw in the scan."""
+    from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                    num_heads=4, intermediate_size=64, max_position=32,
+                    dropout=0.0)
+    m = GPTForCausalLM(cfg)
+    m.initialize()
+    prompt = mx.np.array([[5, 9]], dtype="int32")
+    m(prompt)
+    greedy = m.generate(prompt, max_new_tokens=6, use_cache=True)
+    forced = m.generate(prompt, max_new_tokens=6, greedy=False,
+                        temperature=0.7, top_k=1, use_cache=True)
+    onp.testing.assert_array_equal(onp.asarray(greedy.asnumpy()),
+                                   onp.asarray(forced.asnumpy()))
+    # nucleus path stays in-vocab and keeps the prompt
+    nuc = m.generate(prompt, max_new_tokens=6, greedy=False,
+                     temperature=1.2, top_p=0.9, use_cache=True)
+    arr = onp.asarray(nuc.asnumpy())
+    onp.testing.assert_array_equal(arr[:, :2], [[5, 9]])
+    assert ((arr >= 0) & (arr < 64)).all()
+    # uncached sampling path accepts the same knobs
+    slow = m.generate(prompt, max_new_tokens=2, greedy=False,
+                      top_k=8, top_p=0.9, use_cache=False)
+    assert onp.asarray(slow.asnumpy()).shape == (1, 4)
+
+
 def test_gpt_beam_search_beats_greedy_logprob():
     """Beam search must find a joint sequence log-probability >= greedy's
     (same model, same prompt) and keep the prompt prefix intact."""
@@ -417,16 +482,24 @@ def test_gpt_beam_search_eos_freezes():
     cfg = GPTConfig(vocab_size=32, hidden_size=32, num_layers=1,
                     num_heads=4, intermediate_size=64, max_position=32,
                     dropout=0.0)
+    # deterministic init: with a random init the 8-step length-normalised
+    # beam occasionally never re-emits the 1-step winner chosen as "eos",
+    # and the freeze property is then unexercised (seed-dependent flake)
+    onp.random.seed(0)
+    mx.random.seed(0)
     m = GPTForCausalLM(cfg)
     m.initialize()
     prompt = mx.np.array([[3, 7]], dtype="int32")
     m(prompt)
-    # pick whatever token beam-1-step emits as the "eos" and re-run: the
-    # sequence must then hold eos from first emission onward
-    first = onp.asarray(m.generate(prompt, max_new_tokens=1,
-                                   num_beams=2).asnumpy())[0, 2]
+    # pick the first token the UNCONSTRAINED 8-step beam emits as the
+    # "eos" and re-run with it: the sequence must then hold eos from its
+    # first emission onward. (The 1-step winner is the wrong anchor —
+    # length-normalised search may legitimately never revisit it.)
+    free = onp.asarray(m.generate(prompt, max_new_tokens=8,
+                                  num_beams=2).asnumpy())[0]
+    eos = int(free[2])
     out = onp.asarray(m.generate(prompt, max_new_tokens=8, num_beams=2,
-                                 eos_token_id=int(first)).asnumpy())[0]
-    hit = onp.where(out[2:] == first)[0]
-    assert hit.size > 0
-    onp.testing.assert_array_equal(out[2 + hit[0]:], first)
+                                 eos_token_id=eos).asnumpy())[0]
+    hit = onp.where(out[2:] == eos)[0]
+    assert hit.size > 0, (free, out)
+    onp.testing.assert_array_equal(out[2 + hit[0]:], eos)
