@@ -101,40 +101,44 @@ class GPTModel(HybridBlock):
         return self.final_norm(x)
 
 
-def _filter_logits(logits, top_k=0, top_p=1.0):
-    """Top-k then top-p (nucleus) logit filtering over the last axis.
+def _rank_mask(logits, keep_n):
+    """Keep exactly the first `keep_n` positions of the stable descending
+    order (lower vocab index wins ties); the rest get -1e30.  A value
+    threshold would keep every tie at the boundary — ranking is exact."""
+    import jax.numpy as jnp
+    order = jnp.argsort(-logits, axis=-1, stable=True)
+    ranks = jnp.argsort(order, axis=-1, stable=True)
+    return jnp.where(ranks < keep_n, logits, -1e30)
 
-    Pure jax (static k/p -> jit-safe inside the decode scan). Dropped
-    tokens get -1e30 so `jax.random.categorical` never selects them.
-    Exact truncation even under tied logits: positions are RANKED (stable
-    descending sort, lower vocab index wins ties) and exactly the first
-    `keep_n` ranks survive — a value threshold would keep every tie at
-    the boundary.  Always keeps at least the argmax token."""
+
+def _filter_logits(logits, top_k=0, top_p=1.0):
+    """Top-k then top-p (nucleus) logit filtering over the last axis,
+    applied SEQUENTIALLY like HF `TopKLogitsWarper` -> `TopPLogitsWarper`:
+    the nucleus is computed over the renormalized post-top-k softmax, not
+    the original distribution.  Pure jax (static k/p -> jit-safe inside
+    the decode scan); dropped tokens get -1e30 so
+    `jax.random.categorical` never selects them.  Exact truncation even
+    under tied logits (see `_rank_mask`); at least the argmax always
+    survives."""
     import jax
     import jax.numpy as jnp
 
     V = logits.shape[-1]
-    want_k = bool(top_k) and 0 < top_k < V
-    want_p = top_p < 1.0
-    if not (want_k or want_p):
-        return logits
-
-    order = jnp.argsort(-logits, axis=-1, stable=True)   # descending
-    keep_n = jnp.full(logits.shape[:-1] + (1,), V, jnp.int32)
-    if want_k:
-        keep_n = jnp.minimum(keep_n, top_k)
-    if want_p:
+    if top_k and 0 < top_k < V:
+        logits = _rank_mask(logits, top_k)
+    if top_p < 1.0:
+        order = jnp.argsort(-logits, axis=-1, stable=True)
         sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
+        # softmax over the (possibly top-k-masked) logits: -1e30 entries
+        # carry ~0 mass, so this IS the renormalized truncated dist
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
         # a sorted position is INSIDE the nucleus while the mass BEFORE
         # it is < p (the first token always stays)
         inside = (cum - probs) < top_p
-        keep_n = jnp.minimum(
-            keep_n, jnp.maximum(
-                1, jnp.sum(inside, axis=-1, keepdims=True)))
-    ranks = jnp.argsort(order, axis=-1, stable=True)
-    return jnp.where(ranks < keep_n, logits, -1e30)
+        keep_n = jnp.maximum(1, jnp.sum(inside, axis=-1, keepdims=True))
+        logits = _rank_mask(logits, keep_n)
+    return logits
 
 
 class GPTForCausalLM(HybridBlock):
@@ -177,8 +181,15 @@ class GPTForCausalLM(HybridBlock):
 
         `num_beams > 1`: length-normalised beam search on the same cached
         scan (caches/histories gather-reindexed per step; finished beams
-        freeze on `eos_token_id`). Returns the best beam per batch row."""
+        freeze on `eos_token_id`). Returns the best beam per batch row.
+        Beam search is deterministic — combining it with the sampling
+        knobs raises (sampled/diverse beam search is not implemented)."""
         if num_beams > 1:
+            if not greedy or top_k or top_p < 1.0 or temperature != 1.0:
+                raise ValueError(
+                    "num_beams > 1 runs deterministic beam search; the "
+                    "sampling knobs (greedy=False, temperature, top_k, "
+                    "top_p) are not supported with it")
             return self._generate_beam(input_ids, max_new_tokens,
                                        num_beams, eos_token_id)
         if use_cache:

@@ -398,10 +398,19 @@ def test_gpt_logit_filters():
     kept = onp.asarray(_filter_logits(logits, top_p=1e-6)[0] > -1e29)
     onp.testing.assert_array_equal(kept, [True, False, False, False, False])
 
-    # compose: k=4 then p=0.95 -> 0.5+0.25+0.15 < .95, +0.08 reaches it
+    # compose: k=4 then p=0.95 over the RENORMALIZED top-4 dist
+    # ([.51, .255, .153, .082]: cum-before of the last is .918 < .95)
     kept = onp.asarray(
         _filter_logits(logits, top_k=4, top_p=0.95)[0] > -1e29)
     onp.testing.assert_array_equal(kept, [True, True, True, True, False])
+
+    # sequential semantics (HF): nucleus over the post-top-k renormalized
+    # distribution — [.4,.35,.15,.1] with k=2 renormalizes to
+    # [.533, .467]; p=0.5 then keeps only the first token
+    lg2 = jnp.log(jnp.array([[0.4, 0.35, 0.15, 0.1]]))
+    kept = onp.asarray(
+        _filter_logits(lg2, top_k=2, top_p=0.5)[0] > -1e29)
+    onp.testing.assert_array_equal(kept, [True, False, False, False])
 
     # off = passthrough
     onp.testing.assert_array_equal(onp.asarray(_filter_logits(logits)),
@@ -442,6 +451,10 @@ def test_gpt_topk_sampling_restricted_support():
     slow = m.generate(prompt, max_new_tokens=2, greedy=False,
                       top_k=8, top_p=0.9, use_cache=False)
     assert onp.asarray(slow.asnumpy()).shape == (1, 4)
+    # beam search is deterministic: sampling knobs must raise, not be
+    # silently dropped
+    with pytest.raises(ValueError, match="deterministic beam"):
+        m.generate(prompt, max_new_tokens=2, num_beams=2, top_p=0.9)
 
 
 def test_gpt_beam_search_beats_greedy_logprob():

@@ -208,3 +208,46 @@ def test_onnx_model_sweep(name, tmp_path):
     x = _rand(1, 3, 64, 64, scale=0.5)
     net(x)   # materialize deferred params
     _export_roundtrip(net, x, tmp_path, rtol=5e-3, atol=5e-4)
+
+
+def test_onnx_bert_model(tmp_path):
+    """Whole-model BERT export (tiny config): embeddings + masked flash
+    attention (forced to the exportable reference math) + pooler + MLM
+    head round-trip through the interpreter."""
+    from mxnet_tpu.models.bert import BertConfig, BertModel
+    cfg = BertConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                     num_heads=4, intermediate_size=64, max_position=32,
+                     dropout=0.0)
+    net = BertModel(cfg)
+    net.initialize()
+    ids = mx.np.array(onp.random.RandomState(0).randint(0, 64, (2, 16)),
+                      dtype="int32")
+    net(ids)
+    path = str(tmp_path / "bert.onnx")
+    mx.onnx.export_model(net, path, example_inputs=(ids,))
+    seq, pooled = net(ids)
+    outs = list(mx.onnx.run_model(path, {"data": ids.asnumpy()}).values())
+    onp.testing.assert_allclose(outs[0], seq.asnumpy(), rtol=1e-4,
+                                atol=1e-5)
+    onp.testing.assert_allclose(outs[1], pooled.asnumpy(), rtol=1e-4,
+                                atol=1e-5)
+
+
+def test_onnx_gpt_model(tmp_path):
+    """Whole-model GPT export (tiny config): causal attention + tied
+    embeddings decode head round-trip through the interpreter."""
+    from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=4, intermediate_size=64, max_position=32,
+                    dropout=0.0)
+    net = GPTForCausalLM(cfg)
+    net.initialize()
+    ids = mx.np.array(onp.random.RandomState(1).randint(0, 64, (2, 12)),
+                      dtype="int32")
+    net(ids)
+    path = str(tmp_path / "gpt.onnx")
+    mx.onnx.export_model(net, path, example_inputs=(ids,))
+    expect = net(ids)
+    outs = list(mx.onnx.run_model(path, {"data": ids.asnumpy()}).values())
+    onp.testing.assert_allclose(outs[0], expect.asnumpy(), rtol=1e-4,
+                                atol=1e-5)
