@@ -209,4 +209,66 @@ try:
 except Exception as e:
     print("G gpt2k failed:", type(e).__name__, e)
 
+# I. ResNet-50 throughput vs the reference's headline tables
+# (BASELINE.md: V100 fp32 inference 1076.81 img/s @ bs32, 1233.15 @ bs128,
+# fp16 2085.51 @ bs32; training fp32 251.22 img/s @ bs16). TPU bf16 is
+# the comparable mixed-precision config.
+try:
+    from mxnet_tpu.gluon.model_zoo import vision as _zoo
+    from mxnet_tpu.gluon.block import functional_call
+
+    def resnet_infer(bs, dtype="bfloat16"):
+        net = _zoo.get_model("resnet50_v1")
+        net.initialize()
+        x = mx.np.array(onp.random.RandomState(0)
+                        .rand(bs, 3, 224, 224).astype("float32"))
+        net(x)
+        params = {n: p._data._data.astype(dtype)
+                  if p._data._data.dtype == jnp.float32 else p._data._data
+                  for n, p in net.collect_params().items()}
+        xd = x._data.astype(dtype)
+
+        @jax.jit
+        def fwd(pv, xv):
+            out, _ = functional_call(net, pv, xv, training=False)
+            return out
+
+        jax.device_get(fwd(params, xd))
+        t = timed(lambda: fwd(params, xd), n=20)
+        return bs / (t / 1e3)
+
+    for bs, ref in ((32, 1076.81), (128, 1233.15)):
+        ips = resnet_infer(bs)
+        results[f"I_resnet50_infer_bs{bs}"] = ips
+        print(f"I resnet50 bf16 inference bs={bs}: {ips:.1f} img/s "
+              f"(V100 fp32 ref {ref}; fp16 ref 2085.51 @ bs32)")
+
+    def resnet_train(bs):
+        net = _zoo.get_model("resnet50_v1")
+        net.initialize()
+        x = mx.np.array(onp.random.RandomState(0)
+                        .rand(bs, 3, 224, 224).astype("float32"))
+        net(x)
+        y = mx.np.array(onp.random.RandomState(1)
+                        .randint(0, 1000, (bs,)), dtype="int32")
+
+        def lf(out, xv, yv):
+            from mxnet_tpu.ops.pallas.softmax_xent import \
+                softmax_cross_entropy
+            return softmax_cross_entropy(out, yv.astype(jnp.int32)).mean()
+
+        mesh = make_mesh({"dp": 1}, jax.devices()[:1])
+        tstep = make_sharded_train_step(
+            net, opt.SGD(learning_rate=0.1, momentum=0.9), lf, mesh,
+            num_model_args=1)
+        t = timed(lambda: tstep(x, y), n=10)
+        return bs / (t / 1e3)
+
+    ips = resnet_train(32)
+    results["I_resnet50_train_bs32"] = ips
+    print(f"I resnet50 fp32 train bs=32: {ips:.1f} img/s "
+          f"(V100 fp32 ref 251.22 @ bs16, K80 49.48 @ bs32)")
+except Exception as e:
+    print("I resnet50 failed:", type(e).__name__, e)
+
 print("ALL DONE", results)

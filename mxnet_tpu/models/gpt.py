@@ -127,6 +127,8 @@ def _filter_logits(logits, top_k=0, top_p=1.0):
     if top_k and 0 < top_k < V:
         logits = _rank_mask(logits, top_k)
     if top_p < 1.0:
+        # one sort serves both the nucleus boundary and the final mask
+        # (re-calling _rank_mask would redo the argsorts)
         order = jnp.argsort(-logits, axis=-1, stable=True)
         sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
         # softmax over the (possibly top-k-masked) logits: -1e30 entries
@@ -137,7 +139,8 @@ def _filter_logits(logits, top_k=0, top_p=1.0):
         # it is < p (the first token always stays)
         inside = (cum - probs) < top_p
         keep_n = jnp.maximum(1, jnp.sum(inside, axis=-1, keepdims=True))
-        logits = _rank_mask(logits, keep_n)
+        ranks = jnp.argsort(order, axis=-1, stable=True)
+        logits = jnp.where(ranks < keep_n, logits, -1e30)
     return logits
 
 

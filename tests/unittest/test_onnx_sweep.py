@@ -211,25 +211,25 @@ def test_onnx_model_sweep(name, tmp_path):
 
 
 def test_onnx_bert_model(tmp_path):
-    """Whole-model BERT export (tiny config): embeddings + masked flash
-    attention (forced to the exportable reference math) + pooler + MLM
-    head round-trip through the interpreter."""
-    from mxnet_tpu.models.bert import BertConfig, BertModel
+    """Whole-model BERT export (tiny config): embeddings + attention
+    (forced to the exportable reference math) + pooler + MLM/NSP heads
+    round-trip through the interpreter."""
+    from mxnet_tpu.models.bert import BertConfig, BertForPretraining
     cfg = BertConfig(vocab_size=64, hidden_size=32, num_layers=2,
                      num_heads=4, intermediate_size=64, max_position=32,
                      dropout=0.0)
-    net = BertModel(cfg)
+    net = BertForPretraining(cfg)
     net.initialize()
     ids = mx.np.array(onp.random.RandomState(0).randint(0, 64, (2, 16)),
                       dtype="int32")
     net(ids)
     path = str(tmp_path / "bert.onnx")
     mx.onnx.export_model(net, path, example_inputs=(ids,))
-    seq, pooled = net(ids)
+    mlm, nsp = net(ids)
     outs = list(mx.onnx.run_model(path, {"data": ids.asnumpy()}).values())
-    onp.testing.assert_allclose(outs[0], seq.asnumpy(), rtol=1e-4,
+    onp.testing.assert_allclose(outs[0], mlm.asnumpy(), rtol=1e-4,
                                 atol=1e-5)
-    onp.testing.assert_allclose(outs[1], pooled.asnumpy(), rtol=1e-4,
+    onp.testing.assert_allclose(outs[1], nsp.asnumpy(), rtol=1e-4,
                                 atol=1e-5)
 
 
