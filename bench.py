@@ -226,11 +226,89 @@ def _last_known_tpu():
         return None
 
 
+_CLAIM_LOCK = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "bench_results", ".tpu_claim.lock")
+
+
+def _wait_for_claim_lock(max_wait=3600.0):
+    """If another measurement (the tunnel watcher's bench/ablation run)
+    holds the TPU claim, wait for it instead of contending — two clients
+    fighting over the exclusive claim is how attempts turn into hangs.
+    The cap covers the watcher's bench phase and most of its ablation
+    phase; stale locks (>90 min since last refresh) are ignored."""
+    if os.environ.get("MXTPU_CLAIM_HOLDER"):
+        return   # we ARE the lock holder (the watcher invoking bench.py)
+    t0 = time.time()
+    while time.time() - t0 < max_wait:
+        try:
+            age = time.time() - os.path.getmtime(_CLAIM_LOCK)
+        except OSError:
+            return
+        if age > 5400:
+            return
+        time.sleep(30)
+
+
+class _ClaimLock:
+    """Advertise THIS process's TPU use via the shared lockfile (refreshed
+    by a daemon thread) so watcher and driver benches never contend —
+    whichever starts first holds the chip, the other waits."""
+
+    def __enter__(self):
+        if os.environ.get("MXTPU_CLAIM_HOLDER"):
+            self._mine = False   # the watcher already owns + refreshes it
+            return self
+        self._mine = True
+        self._stop = False
+        os.makedirs(os.path.dirname(_CLAIM_LOCK), exist_ok=True)
+        try:   # synchronously, so the lock exists when __enter__ returns
+            with open(_CLAIM_LOCK, "w") as f:
+                f.write(str(os.getpid()))
+        except OSError:
+            pass
+
+        def keepalive():
+            while not self._stop:
+                for _ in range(60):
+                    if self._stop:
+                        return
+                    time.sleep(1)
+                try:
+                    os.utime(_CLAIM_LOCK)
+                except OSError:
+                    try:
+                        with open(_CLAIM_LOCK, "w") as f:
+                            f.write(str(os.getpid()))
+                    except OSError:
+                        pass
+
+        import threading
+        self._thread = threading.Thread(target=keepalive, daemon=True)
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        if self._mine:
+            self._stop = True
+            self._thread.join(timeout=5)
+            try:
+                os.remove(_CLAIM_LOCK)
+            except OSError:
+                pass
+        return False
+
+
 def main():
     if len(sys.argv) >= 3 and sys.argv[1] == "--measure":
         print(json.dumps(_measure(sys.argv[2])))
         return
 
+    _wait_for_claim_lock()
+    with _ClaimLock():
+        _main_attempts()
+
+
+def _main_attempts():
     errors = []
     oom_retry_left = True
     attempts = list(ATTEMPT_TIMEOUTS)

@@ -27,6 +27,14 @@ EOF
     rc=$?
     if [ $rc -eq 0 ]; then
         log "tunnel healthy -> running bench.py"
+        # advertise the claim so a concurrent driver bench waits politely;
+        # trap guarantees the keepalive + lock die with the watcher too
+        LOCK="$REPO/bench_results/.tpu_claim.lock"
+        touch "$LOCK"
+        ( while true; do sleep 60; touch "$LOCK" 2>/dev/null || exit; done ) &
+        KEEPALIVE=$!
+        trap 'kill $KEEPALIVE 2>/dev/null; rm -f "$LOCK"' EXIT
+        export MXTPU_CLAIM_HOLDER=1
         timeout -s INT 2700 python bench.py > "$REPO/bench_results/r03_bench_line.json" 2>> "$OUT"
         brc=$?
         log "bench rc=$brc: $(cat "$REPO/bench_results/r03_bench_line.json" | head -c 400)"
@@ -37,6 +45,10 @@ EOF
             log "ablation suite rc=$? -- watcher done"
             exit 0
         fi
+        kill $KEEPALIVE 2>/dev/null
+        rm -f "$LOCK"
+        trap - EXIT
+        unset MXTPU_CLAIM_HOLDER
         log "bench did not land a TPU line; continue probing"
     else
         log "probe rc=$rc (hang/unavailable)"

@@ -101,12 +101,14 @@ class GPTModel(HybridBlock):
         return self.final_norm(x)
 
 
-def _rank_mask(logits, keep_n):
+def _rank_mask(logits, keep_n, order=None):
     """Keep exactly the first `keep_n` positions of the stable descending
     order (lower vocab index wins ties); the rest get -1e30.  A value
-    threshold would keep every tie at the boundary — ranking is exact."""
+    threshold would keep every tie at the boundary — ranking is exact.
+    Pass a precomputed descending `order` to reuse an existing sort."""
     import jax.numpy as jnp
-    order = jnp.argsort(-logits, axis=-1, stable=True)
+    if order is None:
+        order = jnp.argsort(-logits, axis=-1, stable=True)
     ranks = jnp.argsort(order, axis=-1, stable=True)
     return jnp.where(ranks < keep_n, logits, -1e30)
 
@@ -139,8 +141,7 @@ def _filter_logits(logits, top_k=0, top_p=1.0):
         # it is < p (the first token always stays)
         inside = (cum - probs) < top_p
         keep_n = jnp.maximum(1, jnp.sum(inside, axis=-1, keepdims=True))
-        ranks = jnp.argsort(order, axis=-1, stable=True)
-        logits = jnp.where(ranks < keep_n, logits, -1e30)
+        logits = _rank_mask(logits, keep_n, order=order)
     return logits
 
 
