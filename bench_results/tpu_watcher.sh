@@ -26,14 +26,29 @@ print(f"probe ok: {d[0].device_kind} matmul={float(v):.0f} {time.time()-t0:.1f}s
 EOF
     rc=$?
     if [ $rc -eq 0 ]; then
+        # two-way protocol: if a DRIVER bench already holds a fresh claim,
+        # wait for it to finish (or go stale) before claiming ourselves
+        LOCK="$REPO/bench_results/.tpu_claim.lock"
+        waited=0
+        while [ -f "$LOCK" ] && [ $waited -lt 3600 ]; do
+            age=$(( $(date +%s) - $(stat -c %Y "$LOCK" 2>/dev/null || echo 0) ))
+            [ $age -gt 5400 ] && break
+            [ $waited -eq 0 ] && log "driver claim lock present; waiting"
+            sleep 30; waited=$((waited + 30))
+        done
         log "tunnel healthy -> running bench.py"
         # advertise the claim so a concurrent driver bench waits politely;
-        # trap guarantees the keepalive + lock die with the watcher too
-        LOCK="$REPO/bench_results/.tpu_claim.lock"
-        touch "$LOCK"
+        # traps cover signals too (an orphaned keepalive would refresh a
+        # phantom lock forever); only OUR lock ($$-stamped) is removed
+        echo "$$" > "$LOCK"
         ( while true; do sleep 60; touch "$LOCK" 2>/dev/null || exit; done ) &
         KEEPALIVE=$!
-        trap 'kill $KEEPALIVE 2>/dev/null; rm -f "$LOCK"' EXIT
+        release() {
+            kill $KEEPALIVE 2>/dev/null
+            [ "$(cat "$LOCK" 2>/dev/null)" = "$$" ] && rm -f "$LOCK"
+        }
+        trap 'release' EXIT
+        trap 'release; exit 130' INT TERM HUP
         export MXTPU_CLAIM_HOLDER=1
         timeout -s INT 2700 python bench.py > "$REPO/bench_results/r03_bench_line.json" 2>> "$OUT"
         brc=$?
@@ -45,9 +60,8 @@ EOF
             log "ablation suite rc=$? -- watcher done"
             exit 0
         fi
-        kill $KEEPALIVE 2>/dev/null
-        rm -f "$LOCK"
-        trap - EXIT
+        release
+        trap - EXIT INT TERM HUP
         unset MXTPU_CLAIM_HOLDER
         log "bench did not land a TPU line; continue probing"
     else

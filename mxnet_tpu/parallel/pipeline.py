@@ -21,7 +21,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from jax import shard_map
+from .ring_attention import shard_map_nocheck
 
 from ..base import MXNetError
 
@@ -118,7 +118,6 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh,
     else:
         xspec = P()
     fn = functools.partial(gpipe_sharded, stage_fn, axis_name=axis_name)
-    mapped = shard_map(fn, mesh=mesh, in_specs=(pspec, xspec),
-                       out_specs=xspec)
+    mapped = shard_map_nocheck(fn, mesh, (pspec, xspec), xspec)
     out_mb = mapped(stacked_params, x_mb)
     return out_mb.reshape((b,) + tuple(out_mb.shape[2:]))

@@ -113,6 +113,21 @@ def ring_attention_sharded(q, k, v, kv_mask=None, axis_name: str = "sp",
     return out.astype(q.dtype)
 
 
+def shard_map_nocheck(fn, mesh, in_specs, out_specs):
+    """`shard_map` with the vma/replication checker off: the Pallas flash
+    kernel's `pallas_call` output ShapeDtypeStructs carry no `vma`
+    annotation, which jax's `check_vma=True` default rejects inside a
+    mapped body (the kernel would silently fall back to O(L²) reference
+    attention on the SP path). Single switch point for every SP/PP
+    shard_map in the package; older jax without the kwarg falls through."""
+    try:
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+    except TypeError:
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs)
+
+
 def seq_sharded_call(fn, q, k, v, mesh: Mesh, axis_name: str = "sp",
                      batch_axis: Optional[str] = "dp"):
     """shard_map a per-shard attention fn over (B, H, L, D) arrays with L
@@ -121,8 +136,7 @@ def seq_sharded_call(fn, q, k, v, mesh: Mesh, axis_name: str = "sp",
     axes = set(mesh.axis_names)
     bspec = batch_axis if (batch_axis and batch_axis in axes) else None
     spec = P(bspec, None, axis_name, None)
-    mapped = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec)
+    mapped = shard_map_nocheck(fn, mesh, (spec, spec, spec), spec)
     return mapped(q, k, v)
 
 
@@ -146,6 +160,5 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
                                       axis_name=axis_name, causal=causal,
                                       scale=scale)
 
-    mapped = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec, mspec),
-                       out_specs=spec)
+    mapped = shard_map_nocheck(fn, mesh, (spec, spec, spec, mspec), spec)
     return mapped(q, k, v, kv_mask)

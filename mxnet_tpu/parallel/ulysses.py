@@ -87,7 +87,7 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
                                scale=scale, attn_fn=attn_fn)
         return seq_sharded_call(fn, q, k, v, mesh, axis_name, batch_axis)
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from .ring_attention import shard_map_nocheck
     axes = set(mesh.axis_names)
     bspec = batch_axis if (batch_axis and batch_axis in axes) else None
     spec = P(bspec, None, axis_name, None)
@@ -98,6 +98,5 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
                                          axis_name=axis_name, causal=causal,
                                          scale=scale, attn_fn=attn_fn)
 
-    mapped = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec, mspec),
-                       out_specs=spec)
+    mapped = shard_map_nocheck(fn, mesh, (spec, spec, spec, mspec), spec)
     return mapped(q, k, v, kv_mask)

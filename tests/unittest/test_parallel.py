@@ -207,6 +207,32 @@ def test_sharded_train_step_checkpoint_resume_bitexact(tmp_path):
                         rtol=1e-6, atol=1e-7)
 
 
+def test_sp_paths_keep_flash_kernel(monkeypatch):
+    """The Pallas flash kernel must stay engaged INSIDE the SP shard_maps
+    (a jax check_vma regression once silently dropped ring/Ulysses to the
+    O(L²) reference path — the long-context TPU path's whole point)."""
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel.ring_attention import ring_attention
+    from mxnet_tpu.parallel.ulysses import ulysses_attention
+
+    monkeypatch.setenv("MXTPU_FLASH_STRICT", "1")
+    # run the real kernel code through the Pallas interpreter on CPU
+    # (without this the dispatch skips the kernel on cpu backends and
+    # the strict flag guards nothing)
+    monkeypatch.setenv("MXTPU_PALLAS_INTERPRET", "1")
+    rng = onp.random.RandomState(0)
+    q = jnp.asarray(rng.randn(2, 4, 64, 16).astype("float32"))
+    vl = jnp.asarray([48, 64])
+    kvm = jnp.arange(64)[None, :] < vl[:, None]
+    mesh = make_mesh({"sp": 4}, _cpu_devices(4))
+    for out in (ulysses_attention(q, q, q, mesh, causal=True),
+                ulysses_attention(q, q, q, mesh, kv_mask=kvm),
+                ring_attention(q, q, q, mesh, axis_name="sp", causal=True),
+                ring_attention(q, q, q, mesh, axis_name="sp",
+                               kv_mask=kvm)):
+        assert out.shape == (2, 4, 64, 16)
+
+
 def test_save_async_overlaps_training(tmp_path):
     """`save_async` snapshots step-N state by reference and writes in the
     background: training continues immediately, later steps cannot leak
