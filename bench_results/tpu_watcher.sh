@@ -26,21 +26,27 @@ print(f"probe ok: {d[0].device_kind} matmul={float(v):.0f} {time.time()-t0:.1f}s
 EOF
     rc=$?
     if [ $rc -eq 0 ]; then
-        # two-way protocol: if a DRIVER bench already holds a fresh claim,
-        # wait for it to finish (or go stale) before claiming ourselves
+        # two-way protocol: claim the lock ATOMICALLY (noclobber), waiting
+        # while a live driver holds it; stale locks (>90 min unrefreshed)
+        # are broken. A live holder always finishes or goes stale, so no
+        # overall cap — a cap shorter than the staleness window would
+        # steal a live claim.
         LOCK="$REPO/bench_results/.tpu_claim.lock"
-        waited=0
-        while [ -f "$LOCK" ] && [ $waited -lt 3600 ]; do
+        announced=0
+        while ! ( set -o noclobber; echo "$$" > "$LOCK" ) 2>/dev/null; do
             age=$(( $(date +%s) - $(stat -c %Y "$LOCK" 2>/dev/null || echo 0) ))
-            [ $age -gt 5400 ] && break
-            [ $waited -eq 0 ] && log "driver claim lock present; waiting"
-            sleep 30; waited=$((waited + 30))
+            if [ $age -gt 5400 ]; then
+                log "breaking stale claim lock (age ${age}s)"
+                rm -f "$LOCK"
+                continue
+            fi
+            [ $announced -eq 0 ] && log "driver claim lock present; waiting"
+            announced=1
+            sleep 30
         done
         log "tunnel healthy -> running bench.py"
-        # advertise the claim so a concurrent driver bench waits politely;
         # traps cover signals too (an orphaned keepalive would refresh a
         # phantom lock forever); only OUR lock ($$-stamped) is removed
-        echo "$$" > "$LOCK"
         ( while true; do sleep 60; touch "$LOCK" 2>/dev/null || exit; done ) &
         KEEPALIVE=$!
         release() {

@@ -21,6 +21,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from ..base import MXNetError
 
 __all__ = ["make_mesh", "auto_mesh", "MeshConfig", "Mesh", "NamedSharding",
+           "shard_map_nocheck",
            "PartitionSpec"]
 
 AXES = ("dp", "sp", "tp", "pp", "ep")
@@ -68,3 +69,19 @@ def auto_mesh(n_devices: Optional[int] = None, tp: int = 1, sp: int = 1,
         raise MXNetError(f"{n} devices not divisible by tp*sp*pp*ep={denom}")
     return make_mesh({"dp": n // denom, "sp": sp, "tp": tp, "pp": pp,
                       "ep": ep}, devices[:n])
+
+
+def shard_map_nocheck(fn, mesh, in_specs, out_specs):
+    """`shard_map` with the vma/replication checker off: the Pallas flash
+    kernel's `pallas_call` output ShapeDtypeStructs carry no `vma`
+    annotation, which jax's `check_vma=True` default rejects inside a
+    mapped body (the kernel would silently fall back to O(L²) reference
+    attention on the SP path). Single switch point for every SP/PP
+    shard_map in the package; older jax without the kwarg falls through."""
+    from jax import shard_map
+    try:
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+    except TypeError:
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs)

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .ring_attention import shard_map_nocheck
+from .mesh import shard_map_nocheck
 
 from ..base import MXNetError
 

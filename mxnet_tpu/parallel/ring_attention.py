@@ -20,7 +20,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from jax import shard_map
+from .mesh import shard_map_nocheck
 
 __all__ = ["ring_attention", "ring_attention_sharded", "seq_sharded_call"]
 
@@ -111,21 +111,6 @@ def ring_attention_sharded(q, k, v, kv_mask=None, axis_name: str = "sp",
     # under f32 FTZ, turning fully-masked rows into 0/0 = NaN
     out = acc / jnp.where(l[..., None] > 0, l[..., None], 1.0)
     return out.astype(q.dtype)
-
-
-def shard_map_nocheck(fn, mesh, in_specs, out_specs):
-    """`shard_map` with the vma/replication checker off: the Pallas flash
-    kernel's `pallas_call` output ShapeDtypeStructs carry no `vma`
-    annotation, which jax's `check_vma=True` default rejects inside a
-    mapped body (the kernel would silently fall back to O(L²) reference
-    attention on the SP path). Single switch point for every SP/PP
-    shard_map in the package; older jax without the kwarg falls through."""
-    try:
-        return shard_map(fn, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs, check_vma=False)
-    except TypeError:
-        return shard_map(fn, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs)
 
 
 def seq_sharded_call(fn, q, k, v, mesh: Mesh, axis_name: str = "sp",

@@ -87,7 +87,7 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
                                scale=scale, attn_fn=attn_fn)
         return seq_sharded_call(fn, q, k, v, mesh, axis_name, batch_axis)
     from jax.sharding import PartitionSpec as P
-    from .ring_attention import shard_map_nocheck
+    from .mesh import shard_map_nocheck
     axes = set(mesh.axis_names)
     bspec = batch_axis if (batch_axis and batch_axis in axes) else None
     spec = P(bspec, None, axis_name, None)

@@ -61,6 +61,7 @@ class CheckpointManager:
         """Checkpoint `target` at `step`. The write is atomic (temp file +
         rename) so a crash mid-save never leaves a truncated checkpoint as
         the latest."""
+        self.wait_async()
         final = self._path(step)
         fd, tmp = tempfile.mkstemp(dir=self.directory,
                                    prefix=f".{self.prefix}-tmp")
@@ -74,14 +75,59 @@ class CheckpointManager:
         self._prune()
         return final
 
-    def maybe_save(self, target, step: int, every: int) -> Optional[str]:
+    _last_async = None
+
+    def save_async(self, target, step: int):
+        """Non-stalling checkpoint for targets that support it
+        (`ShardedTrainStep.save_async`): snapshot now, write + prune in
+        the background. Returns a future resolving to the final path;
+        targets without `save_async` fall back to a blocking `save` (the
+        returned future is already resolved). The manager tracks the
+        newest future, so even a dropped one surfaces its error at the
+        next save/restore/`wait_async` instead of vanishing."""
+        import concurrent.futures as _fut
+        self.wait_async()
+        if not hasattr(target, "save_async"):
+            done: _fut.Future = _fut.Future()
+            done.set_result(self.save(target, step))
+            return done
+        final = self._path(step)
+        inner = target.save_async(final)
+
+        out: _fut.Future = _fut.Future()
+
+        def _finish(f):
+            try:
+                f.result()
+                self._prune()
+                out.set_result(final)
+            except BaseException as e:  # surface writer errors to .result()
+                out.set_exception(e)
+
+        inner.add_done_callback(_finish)
+        self._last_async = out
+        return out
+
+    def wait_async(self) -> None:
+        """Block until the newest async save finishes; re-raise its error
+        (clearing it first, so one failure can't wedge every later save)."""
+        fut, self._last_async = self._last_async, None
+        if fut is not None:
+            fut.result()
+
+    def maybe_save(self, target, step: int, every: int,
+                   async_save: bool = False) -> Optional[str]:
         if every > 0 and step % every == 0:
+            if async_save:
+                self.save_async(target, step)
+                return self._path(step)
             return self.save(target, step)
         return None
 
     def restore(self, target, step: Optional[int] = None) -> int:
         """Load the checkpoint at `step` (default: latest) into `target`;
         returns the restored step, or 0 if none exists."""
+        self.wait_async()
         if step is not None:
             path = self._path(step)
             if not os.path.exists(path):
