@@ -27,9 +27,23 @@ from .. import random as _rng
 from .. import _tape
 
 __all__ = ["multi_head_attention", "dot_product_attention",
-           "reference_attention"]
+           "reference_attention", "band_bias"]
 
 MASK_VALUE = -1e30
+
+
+def band_bias(lq, lk, window, causal=False, symmetric=True):
+    """(1, 1, Lq, Lk) additive bias for sliding-window attention: 0 inside
+    the band ([q-w, q+w] symmetric non-causal, else [q-w, q]), MASK_VALUE
+    outside — the XLA-path equivalent of the kernel's in-band masking."""
+    rows = jnp.arange(lq)[:, None]
+    cols = jnp.arange(lk)[None, :]
+    keep = cols >= rows - window
+    if symmetric and not causal:
+        keep &= cols <= rows + window
+    else:
+        keep &= cols <= rows
+    return jnp.where(keep, 0.0, MASK_VALUE).astype(jnp.float32)[None, None]
 
 
 def reference_attention(q, k, v, mask=None, causal=False, scale=None,
@@ -117,12 +131,16 @@ _warned_fallback = [False]
 
 
 def dot_product_attention(q, k, v, mask=None, causal=False, scale=None,
-                          use_flash=True, dropout_rate=0.0, dropout_key=None):
+                          use_flash=True, dropout_rate=0.0, dropout_key=None,
+                          window=None, window_symmetric=True):
     """jax-level fused attention over (B, H, L, D).
 
     `mask` is boolean-style (nonzero = keep), broadcastable over heads/rows:
     (B, Lk), (B, 1|Lq, Lk) or (B, 1|H, 1|Lq, Lk).  Masked batches stay on
     the Pallas flash path (the kernel streams the mask as an additive bias).
+    `window=w` enables fused sliding-window (local) attention — in-kernel
+    band masking with out-of-band BLOCKS skipped (O(L·w) compute); the XLA
+    fallback applies the equivalent `band_bias`.
     Set MXTPU_FLASH_STRICT=1 to raise instead of silently falling back when
     the kernel rejects an input.
     """
@@ -138,7 +156,8 @@ def dot_product_attention(q, k, v, mask=None, causal=False, scale=None,
             return flash_attention(q, k, v, causal=causal, scale=scale,
                                    bias=bias, dropout_rate=dropout_rate
                                    if seed is not None else 0.0,
-                                   dropout_seed=seed)
+                                   dropout_seed=seed, window=window,
+                                   window_symmetric=window_symmetric)
         except Exception as e:
             if getenv_bool("MXTPU_FLASH_STRICT", False):
                 raise
@@ -148,8 +167,12 @@ def dot_product_attention(q, k, v, mask=None, causal=False, scale=None,
                     f"flash attention unavailable ({type(e).__name__}: {e}); "
                     "using the XLA reference path. Set MXTPU_FLASH_STRICT=1 "
                     "to raise instead.")
+    bias = None
+    if window is not None:
+        bias = band_bias(q.shape[2], k.shape[2], window, causal,
+                         window_symmetric)
     return reference_attention(q, k, v, mask=mask, causal=causal, scale=scale,
-                               dropout_rate=dropout_rate,
+                               bias=bias, dropout_rate=dropout_rate,
                                dropout_key=dropout_key)
 
 

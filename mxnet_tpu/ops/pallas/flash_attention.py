@@ -73,6 +73,34 @@ def _causal_mask(s, qi, bq, ki, bk):
     return jnp.where(cols <= rows, s, MASK_VALUE)
 
 
+def _band_mask(s, qi, bq, ki, bk, causal, window, symmetric):
+    """Sliding-window (Longformer/Mistral-style local attention) band:
+    keep k within `window` positions of q — [q-w, q] when causal (or
+    symmetric=False), [q-w, q+w] when symmetric. Composes with the
+    causal mask (which the caller applies separately)."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + qi * bq
+    cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ki * bk
+    keep = cols >= rows - window
+    if symmetric and not causal:
+        keep &= cols <= rows + window
+    else:
+        keep &= cols <= rows
+    return jnp.where(keep, s, MASK_VALUE)
+
+
+def _band_block_live(qi, bq, ki, bk, causal, window, symmetric):
+    """Grid predicate: does k-block `ki` overlap q-block `qi`'s band at
+    all? Blocks entirely outside are SKIPPED — the O(L·w) win."""
+    q_lo, q_hi = qi * bq, (qi + 1) * bq - 1
+    k_lo, k_hi = ki * bk, (ki + 1) * bk - 1
+    live = k_hi >= q_lo - window
+    if symmetric and not causal:
+        live &= k_lo <= q_hi + window
+    else:
+        live &= k_lo <= q_hi
+    return live
+
+
 def _splitmix32(x):
     """32-bit splitmix finalizer — cheap, stateless, good-enough bits for
     dropout (not crypto). All ops lower to the TPU VPU's int32 ALU."""
@@ -106,7 +134,8 @@ def _keep_mask(seed_ref, bh, row0, col0, shape, rate):
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(*refs, scale, causal, has_bias, rate):
+def _fwd_kernel(*refs, scale, causal, has_bias, rate, window=None,
+                window_symmetric=True):
     i = 3
     q_ref, k_ref, v_ref = refs[:3]
     bias_ref = None
@@ -142,12 +171,15 @@ def _fwd_kernel(*refs, scale, causal, has_bias, rate):
             s = s + bias_ref[...]          # (1|bq, bk) broadcasts over rows
         if causal:
             s = _causal_mask(s, qi, bq, ki, bk)
+        if window is not None:
+            s = _band_mask(s, qi, bq, ki, bk, causal, window,
+                           window_symmetric)
         m_prev = m_scr[...]
         l_prev = l_scr[...]
         m_cur = jnp.max(s, axis=1)[:, None]           # [bq, 1]
         m_next = jnp.maximum(m_prev, m_cur)           # [bq, LANES]
         p = jnp.exp(s - _lanes(m_next, bk))           # [bq, bk]
-        if has_bias:
+        if has_bias or window is not None:
             # hard-masked entries must contribute 0 even when the whole row
             # is masked (m == MASK_VALUE would otherwise make exp(s-m) = 1)
             p = jnp.where(s > 0.5 * MASK_VALUE, p, 0.0)
@@ -162,7 +194,10 @@ def _fwd_kernel(*refs, scale, causal, has_bias, rate):
         acc_scr[...] = acc_scr[...] * _lanes(alpha, d) + jax.lax.dot(
             p.astype(v.dtype), v, preferred_element_type=jnp.float32)
 
-    if causal:
+    if window is not None:
+        pl.when(_band_block_live(qi, bq, ki, bk, causal, window,
+                                 window_symmetric))(_step)
+    elif causal:
         pl.when(ki * bk <= (qi + 1) * bq - 1)(_step)
     else:
         _step()
@@ -201,7 +236,7 @@ _SEED_SPEC = pl.BlockSpec(memory_space=pltpu.SMEM)
 
 
 def _flash_fwd(q, k, v, bias, seed, scale, causal, block_q, block_k,
-               rate, per_head, per_row):
+               rate, per_head, per_row, window=None, window_symmetric=True):
     b, h, lq, d = q.shape
     lk = k.shape[2]
     bq, bk = block_q, block_k
@@ -224,7 +259,8 @@ def _flash_fwd(q, k, v, bias, seed, scale, causal, block_q, block_k,
         args.append(seed)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          has_bias=has_bias, rate=rate),
+                          has_bias=has_bias, rate=rate, window=window,
+                          window_symmetric=window_symmetric),
         grid=grid,
         in_specs=in_specs,
         out_specs=[
@@ -251,7 +287,8 @@ def _flash_fwd(q, k, v, bias, seed, scale, causal, block_q, block_k,
 # backward
 # ---------------------------------------------------------------------------
 
-def _p_block(q_ref, k_ref, lse_ref, bias_ref, scale, causal, qi, ki, bq, bk):
+def _p_block(q_ref, k_ref, lse_ref, bias_ref, scale, causal, qi, ki, bq, bk,
+             window=None, window_symmetric=True):
     """Recompute the normalised probability block p = exp(s - lse)."""
     s = jax.lax.dot_general(
         q_ref[...], k_ref[...], (((1,), (1,)), ((), ())),
@@ -260,8 +297,10 @@ def _p_block(q_ref, k_ref, lse_ref, bias_ref, scale, causal, qi, ki, bq, bk):
         s = s + bias_ref[...]
     if causal:
         s = _causal_mask(s, qi, bq, ki, bk)
+    if window is not None:
+        s = _band_mask(s, qi, bq, ki, bk, causal, window, window_symmetric)
     p = jnp.exp(s - _lanes(lse_ref[...], bk))
-    if bias_ref is not None:
+    if bias_ref is not None or window is not None:
         p = jnp.where(s > 0.5 * MASK_VALUE, p, 0.0)
     return p
 
@@ -275,7 +314,8 @@ def _di_block(do_ref, o_ref):
                    * o_ref[...].astype(jnp.float32), axis=1)[:, None]
 
 
-def _dq_kernel(*refs, scale, causal, has_bias, rate):
+def _dq_kernel(*refs, scale, causal, has_bias, rate, window=None,
+               window_symmetric=True):
     i = 6
     q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref = refs[:6]
     bias_ref = None
@@ -301,7 +341,7 @@ def _dq_kernel(*refs, scale, causal, has_bias, rate):
 
     def _step():
         p = _p_block(q_ref, k_ref, lse_ref, bias_ref, scale, causal,
-                     qi, ki, bq, bk)
+                     qi, ki, bq, bk, window, window_symmetric)
         do = do_ref[...]
         dp = jax.lax.dot_general(
             do, v_ref[...], (((1,), (1,)), ((), ())),
@@ -314,7 +354,10 @@ def _dq_kernel(*refs, scale, causal, has_bias, rate):
             ds.astype(k_ref.dtype), k_ref[...],
             preferred_element_type=jnp.float32)
 
-    if causal:
+    if window is not None:
+        pl.when(_band_block_live(qi, bq, ki, bk, causal, window,
+                                 window_symmetric))(_step)
+    elif causal:
         pl.when(ki * bk <= (qi + 1) * bq - 1)(_step)
     else:
         _step()
@@ -324,7 +367,8 @@ def _dq_kernel(*refs, scale, causal, has_bias, rate):
         dq_ref[...] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(*refs, scale, causal, has_bias, rate):
+def _dkv_kernel(*refs, scale, causal, has_bias, rate, window=None,
+                window_symmetric=True):
     i = 6
     q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref = refs[:6]
     bias_ref = None
@@ -351,7 +395,7 @@ def _dkv_kernel(*refs, scale, causal, has_bias, rate):
 
     def _step():
         p = _p_block(q_ref, k_ref, lse_ref, bias_ref, scale, causal,
-                     qi, ki, bq, bk)
+                     qi, ki, bq, bk, window, window_symmetric)
         do = do_ref[...]
         if rate > 0.0:
             keep = _keep_mask(seed_ref, bh, qi * bq, ki * bk, p.shape, rate)
@@ -373,7 +417,10 @@ def _dkv_kernel(*refs, scale, causal, has_bias, rate):
             ds.astype(q_ref.dtype), q_ref[...], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
+    if window is not None:
+        pl.when(_band_block_live(qi, bq, ki, bk, causal, window,
+                                 window_symmetric))(_step)
+    elif causal:
         pl.when((qi + 1) * bq - 1 >= ki * bk)(_step)
     else:
         _step()
@@ -385,7 +432,8 @@ def _dkv_kernel(*refs, scale, causal, has_bias, rate):
 
 
 def _flash_bwd(q, k, v, bias, seed, o, lse, g, scale, causal,
-               block_q, block_k, rate, per_head, per_row):
+               block_q, block_k, rate, per_head, per_row,
+               window=None, window_symmetric=True):
     b, h, lq, d = q.shape
     lk = k.shape[2]
     bq, bk = block_q, block_k
@@ -413,7 +461,8 @@ def _flash_bwd(q, k, v, bias, seed, o, lse, g, scale, causal,
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          has_bias=has_bias, rate=rate),
+                          has_bias=has_bias, rate=rate, window=window,
+                          window_symmetric=window_symmetric),
         grid=(b * h, lq // bq, lk // bk),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((None, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
@@ -440,7 +489,8 @@ def _flash_bwd(q, k, v, bias, seed, o, lse, g, scale, causal,
         args2.append(seed)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          has_bias=has_bias, rate=rate),
+                          has_bias=has_bias, rate=rate, window=window,
+                          window_symmetric=window_symmetric),
         grid=(b * h, lk // bk, lq // bq),
         in_specs=in_specs2,
         out_specs=[
@@ -466,26 +516,31 @@ def _flash_bwd(q, k, v, bias, seed, o, lse, g, scale, causal,
 # custom_vjp plumbing
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(5, 6, 7, 8, 9, 10, 11, 12, 13))
 def _flash(q, k, v, bias, seed, scale, causal, block_q, block_k,
-           rate, per_head, per_row):
+           rate, per_head, per_row, window=None, window_symmetric=True):
     out, _ = _flash_fwd(q, k, v, bias, seed, scale, causal, block_q,
-                        block_k, rate, per_head, per_row)
+                        block_k, rate, per_head, per_row, window,
+                        window_symmetric)
     return out
 
 
 def _flash_vjp_fwd(q, k, v, bias, seed, scale, causal, block_q, block_k,
-                   rate, per_head, per_row):
+                   rate, per_head, per_row, window=None,
+                   window_symmetric=True):
     out, lse = _flash_fwd(q, k, v, bias, seed, scale, causal, block_q,
-                          block_k, rate, per_head, per_row)
+                          block_k, rate, per_head, per_row, window,
+                          window_symmetric)
     return out, (q, k, v, bias, seed, out, lse)
 
 
 def _flash_vjp_bwd(scale, causal, block_q, block_k, rate, per_head, per_row,
-                   res, g):
+                   window, window_symmetric, res, g):
     q, k, v, bias, seed, o, lse = res
     dq, dk, dv = _flash_bwd(q, k, v, bias, seed, o, lse, g, scale, causal,
-                            block_q, block_k, rate, per_head, per_row)
+                            block_q, block_k, rate, per_head, per_row,
+                            window, window_symmetric)
     # bias gradients are not computed (masks are constants; a learned bias
     # should use the reference path) — cotangent is zeros; seed is integer
     # (tangent dtype float0)
@@ -538,7 +593,7 @@ def _env_int(name, default):
 
 def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
                     block_k=None, bias=None, dropout_rate=0.0,
-                    dropout_seed=None):
+                    dropout_seed=None, window=None, window_symmetric=True):
     """Flash attention over (B, H, L, D) jax arrays.
 
     Block sizes default to 256 and are tunable per run via
@@ -550,6 +605,13 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
     a scalar int32 `dropout_seed` applies attention-probs dropout inside the
     kernel (deterministic given the seed).  Bias is treated as a constant
     (zero cotangent).
+
+    `window=w` enables sliding-window (local) attention INSIDE the kernel:
+    k within [q-w, q+w] when `window_symmetric` (Longformer), [q-w, q]
+    when causal or not symmetric (Mistral-style). Blocks entirely outside
+    the band are skipped in forward AND both backward kernels, so compute
+    is O(L·w) — the fused form of the reference's sldwin score/context
+    ops (`src/operator/contrib/transformer.cc:887-1095`).
 
     Falls back to the XLA reference path when the sequence length cannot be
     tiled to MXU-friendly blocks (compiled mode needs >=128-lane k blocks;
@@ -572,9 +634,21 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
     min_block = 8 if _interpret() else LANES
     d_ok = d <= LANES or d % LANES == 0
     if bq < min_block or bk < min_block or not d_ok:
-        from ..attention import reference_attention
+        from ..attention import reference_attention, band_bias
         key = (None if dropout_seed is None
                else jax.random.PRNGKey(dropout_seed))
+        if window is not None:
+            wb = band_bias(lq, lk, window, causal, window_symmetric)
+            if bias is None:
+                bias = wb
+            else:
+                # compact bias shapes (B, Lk)/(B, Lq, Lk) must be rank-4
+                # aligned before adding the (1,1,Lq,Lk) band (raw
+                # right-aligned broadcasting would map B onto Lq/H)
+                bb = jnp.asarray(bias)
+                while bb.ndim < 4:
+                    bb = bb[:, None]
+                bias = bb + wb
         return reference_attention(q, k, v, causal=causal, scale=s,
                                    bias=bias, dropout_rate=dropout_rate,
                                    dropout_key=key)
@@ -588,5 +662,6 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
         if dropout_seed is None:
             raise ValueError("dropout_rate > 0 requires dropout_seed")
         seed = jnp.asarray(dropout_seed, jnp.int32).reshape(1, 1)
+    win = None if window is None else int(window)
     return _flash(q, k, v, bias3, seed, s, causal, bq, bk, rate,
-                  per_head, per_row)
+                  per_head, per_row, win, bool(window_symmetric))

@@ -260,3 +260,84 @@ def test_masked_batch_stays_on_flash_path(monkeypatch):
         .astype(onp.float32).reshape(b, 1, 1, l))
     out = mx.npx.multi_head_attention(x, x, x, heads, mask=mask)
     assert out.shape == (b, l, e)
+
+
+@pytest.mark.parametrize("causal,symmetric", [(False, True), (False, False),
+                                              (True, True)])
+def test_flash_sliding_window_matches_reference(causal, symmetric):
+    """Banded (sliding-window) kernel mode vs reference attention with the
+    equivalent band bias — the fused form of the reference's sldwin ops
+    (`src/operator/contrib/transformer.cc:887-1095`), with out-of-band
+    blocks skipped."""
+    from mxnet_tpu.ops.attention import band_bias
+    b, h, l, d, w = 2, 3, 128, 16, 20
+    q = _rand((b, h, l, d), seed=4)
+    k = _rand((b, h, l, d), seed=5)
+    v = _rand((b, h, l, d), seed=6)
+    out = flash_attention(q, k, v, causal=causal, window=w,
+                          window_symmetric=symmetric,
+                          block_q=32, block_k=32)
+    ref = reference_attention(
+        q, k, v, causal=causal,
+        bias=band_bias(l, l, w, causal, symmetric))
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-5, atol=2e-5)
+
+
+def test_flash_sliding_window_backward_matches_reference():
+    from mxnet_tpu.ops.attention import band_bias
+    b, h, l, d, w = 1, 2, 64, 16, 10
+    q = _rand((b, h, l, d), seed=7)
+    k = _rand((b, h, l, d), seed=8)
+    v = _rand((b, h, l, d), seed=9)
+
+    def f_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, window=w, block_q=16,
+                                       block_k=16) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(reference_attention(
+            q, k, v, bias=band_bias(l, l, w, False, True)) ** 2)
+
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr in zip(g_flash, g_ref):
+        onp.testing.assert_allclose(onp.asarray(gf), onp.asarray(gr),
+                                    rtol=5e-5, atol=5e-5)
+
+
+def test_flash_sliding_window_with_padding_mask():
+    """Band + padding mask compose: the bias streams through the kernel
+    while the band masks in-kernel."""
+    from mxnet_tpu.ops.attention import band_bias
+    b, h, l, d, w = 2, 2, 64, 16, 12
+    q = _rand((b, h, l, d), seed=10)
+    k = _rand((b, h, l, d), seed=11)
+    v = _rand((b, h, l, d), seed=12)
+    vl = onp.asarray([40, 64])
+    keep = (onp.arange(l)[None, :] < vl[:, None])
+    bias = jnp.where(jnp.asarray(keep), 0.0, -1e30).astype(
+        jnp.float32)  # (B, Lk)
+    out = flash_attention(q, k, v, window=w, bias=bias,
+                          block_q=16, block_k=16)
+    ref = reference_attention(q, k, v, mask=jnp.asarray(keep)[:, None, None],
+                              bias=band_bias(l, l, w, False, True))
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-5, atol=2e-5)
+
+
+def test_flash_sliding_window_fallback_bias_alignment():
+    """Small-block fallback with window + compact (B, Lk) bias: the band
+    must combine with a rank-4-aligned bias (raw broadcasting would map
+    the batch dim onto Lq/H)."""
+    from mxnet_tpu.ops.attention import band_bias
+    b, h, l, d, w = 3, 2, 6, 4, 2   # l=6 -> below min block, fallback path
+    q = _rand((b, h, l, d), seed=13)
+    keep = onp.ones((b, l), bool)
+    keep[0, 4:] = False
+    bias = jnp.where(jnp.asarray(keep), 0.0, -1e30).astype(jnp.float32)
+    out = flash_attention(q, q, q, window=w, bias=bias)
+    ref = reference_attention(q, q, q, mask=jnp.asarray(keep)[:, None, None],
+                              bias=band_bias(l, l, w, False, True))
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-5, atol=2e-5)

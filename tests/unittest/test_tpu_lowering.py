@@ -99,3 +99,25 @@ def test_softmax_xent_lowers_for_tpu_at_real_vocab():
         assert txt.count("tpu_custom_call") == 1
     finally:
         _att._use_pallas = orig
+
+
+def test_flash_kernel_sliding_window_lowers_for_tpu():
+    """Banded (sliding-window) kernel mode: forward and both backward
+    kernels must pass Mosaic lowering — the band iota/compares and the
+    block-skip predicates are TPU-side code paths."""
+    b, h, l, d = 2, 4, 512, 64
+    q = jnp.ones((b, h, l, d), jnp.bfloat16)
+
+    def fwd(q, k, v):
+        return flash_attention(q, k, v, window=128, causal=True)
+
+    txt = _lower_for_tpu(fwd, q, q, q)
+    assert txt.count("tpu_custom_call") == 1
+
+    def train(q, k, v):
+        def loss(q, k, v):
+            return jnp.sum(fwd(q, k, v).astype(jnp.float32) ** 2)
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    txt = _lower_for_tpu(train, q, q, q)
+    assert txt.count("tpu_custom_call") == 3   # fwd + dq + dkv
