@@ -24,7 +24,7 @@ class GPTConfig:
     def __init__(self, vocab_size=50257, hidden_size=768, num_layers=12,
                  num_heads=12, intermediate_size=3072, max_position=1024,
                  dropout=0.1, layer_norm_eps=1e-5, tie_embeddings=True,
-                 dtype="float32", remat=False):
+                 dtype="float32", remat=False, window=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -37,6 +37,13 @@ class GPTConfig:
         self.dtype = dtype
         # recompute each layer's activations in backward (jax.checkpoint)
         self.remat = remat
+        # Mistral-style sliding-window attention: each position attends the
+        # last `window` tokens only — O(L·window) in the fused flash kernel
+        if window is not None and window < 1:
+            raise ValueError(f"window must be >= 1, got {window} (the "
+                             "truthiness-vs-None split would otherwise make "
+                             "train and cached-decode masks disagree)")
+        self.window = window
 
 
 def gpt_small(**kwargs):
@@ -59,7 +66,9 @@ class GPTBlock(HybridBlock):
                                       in_channels=cfg.hidden_size)
         self.attention = FusedSelfAttention(cfg.hidden_size, cfg.num_heads,
                                             dropout=cfg.dropout, causal=True,
-                                            dtype=cfg.dtype)
+                                            dtype=cfg.dtype,
+                                            window=getattr(cfg, "window",
+                                                           None))
         self.ffn_norm = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
                                      in_channels=cfg.hidden_size)
         self.ffn = FeedForward(cfg.hidden_size, cfg.intermediate_size,
@@ -276,6 +285,9 @@ class GPTForCausalLM(HybridBlock):
             s = jnp.einsum("bhd,bhtd->bht", qh, kc) / jnp.sqrt(
                 jnp.float32(D)).astype(h.dtype)
             mask = jnp.arange(T) <= t
+            if getattr(cfg, "window", None):
+                # sliding-window decode: only the last `window` positions
+                mask &= jnp.arange(T) >= t - cfg.window
             s = jnp.where(mask[None, None], s, -1e30)
             p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(
                 h.dtype)

@@ -29,10 +29,13 @@ class FusedSelfAttention(HybridBlock):
 
     def __init__(self, hidden_size: int, num_heads: int, dropout: float = 0.0,
                  causal: bool = False, dtype="float32",
-                 attn_dropout: float = None):
+                 attn_dropout: float = None, window=None):
         super().__init__()
         self.num_heads = num_heads
         self.causal = causal
+        # sliding-window (local) attention: O(L·window) fused kernel path
+        # (Mistral-style when causal, Longformer-style otherwise)
+        self.window = window
         # attention-probs dropout (BERT's attention_probs_dropout_prob);
         # defaults to the output dropout rate, applied inside the flash
         # kernel on the TPU path
@@ -49,7 +52,8 @@ class FusedSelfAttention(HybridBlock):
         q, k, v = qkv[..., :h], qkv[..., h:2 * h], qkv[..., 2 * h:]
         ctx = npx.multi_head_attention(q, k, v, self.num_heads, mask=mask,
                                        dropout_p=self._attn_dropout,
-                                       causal=self.causal)
+                                       causal=self.causal,
+                                       window=self.window)
         return self.dropout(self.attn_proj(ctx))
 
 

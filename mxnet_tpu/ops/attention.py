@@ -178,12 +178,14 @@ def dot_product_attention(q, k, v, mask=None, causal=False, scale=None,
 
 def multi_head_attention(query: ndarray, key: ndarray, value: ndarray,
                          num_heads: int, mask=None, dropout_p: float = 0.0,
-                         causal: bool = False, use_flash: bool = True):
+                         causal: bool = False, use_flash: bool = True,
+                         window=None, window_symmetric: bool = True):
     """Multi-head attention over (B, L, E) `ndarray`s (already projected).
 
     `dropout_p` applies attention-probs dropout (active under
     `autograd.train_mode`, like `npx.dropout`) — inside the Pallas kernel on
     the flash path, via `jax.random.bernoulli` on the reference path.
+    `window=w` selects fused sliding-window (local) attention.
     """
     arrs = [query, key, value]
     has_mask = isinstance(mask, ndarray)
@@ -207,7 +209,8 @@ def multi_head_attention(query: ndarray, key: ndarray, value: ndarray,
                                     use_flash=use_flash,
                                     dropout_rate=dropout_p
                                     if drop_key is not None else 0.0,
-                                    dropout_key=drop_key)
+                                    dropout_key=drop_key, window=window,
+                                    window_symmetric=window_symmetric)
         return out.transpose(0, 2, 1, 3).reshape(b, lq, e)
 
     return apply_op(fn, tuple(arrs), {}, name="multi_head_attention")

@@ -379,6 +379,40 @@ def test_gpt_kv_cache_decode_untied_and_sampled():
     assert ((arr >= 0) & (arr < 64)).all()
 
 
+def test_gpt_sliding_window_decode_consistent():
+    """GPTConfig(window=w): the cached decode scan's windowed mask must
+    agree with the full-recompute forward (whose attention masks to the
+    band inside the fused kernel / reference path)."""
+    from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=4, intermediate_size=64, max_position=32,
+                    dropout=0.0, window=4)
+    m = GPTForCausalLM(cfg)
+    m.initialize()
+    prompt = mx.np.array([[3, 9, 1, 7, 2, 5]], dtype="int32")
+    m(prompt)
+    slow = m.generate(prompt, max_new_tokens=8, use_cache=False)
+    fast = m.generate(prompt, max_new_tokens=8, use_cache=True)
+    onp.testing.assert_array_equal(onp.asarray(slow.asnumpy()),
+                                   onp.asarray(fast.asnumpy()))
+    # the window genuinely restricts context: a full-attention model with
+    # identical weights diverges once the context outgrows the window
+    cfg_full = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                         num_heads=4, intermediate_size=64, max_position=32,
+                         dropout=0.0)
+    m2 = GPTForCausalLM(cfg_full)
+    m2.initialize()
+    m2(prompt)
+    for (n1, p1), (n2, p2) in zip(sorted(m.collect_params().items()),
+                                  sorted(m2.collect_params().items())):
+        p2.set_data(p1.data())
+    lw = m(prompt)
+    lf = m2(prompt)
+    assert not onp.allclose(onp.asarray(lw.asnumpy()),
+                            onp.asarray(lf.asnumpy())), \
+        "window had no effect on logits"
+
+
 def test_gpt_logit_filters():
     """_filter_logits semantics: top-k keeps exactly the k best, top-p
     keeps the smallest nucleus reaching p, and the two compose."""
