@@ -209,6 +209,36 @@ try:
 except Exception as e:
     print("G gpt2k failed:", type(e).__name__, e)
 
+# G2. long-context GPT with SLIDING-WINDOW attention (window=256):
+# same model as G but O(L·w) attention — the banded-kernel win at 2k ctx
+try:
+    from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    cfg = GPTConfig(vocab_size=50257, hidden_size=768, num_layers=12,
+                    num_heads=12, intermediate_size=3072,
+                    max_position=2048, dtype="bfloat16", remat=True,
+                    window=256)
+    m = GPTForCausalLM(cfg)
+    m.initialize()
+    rng = onp.random.RandomState(0)
+    B, L = 4, 2048
+    ids = mx.np.array(rng.randint(0, cfg.vocab_size, (B, L)), dtype="int32")
+    m(ids)
+
+    def lm_loss_w(out, i):
+        from mxnet_tpu.ops.pallas.softmax_xent import softmax_cross_entropy
+        return softmax_cross_entropy(out[:, :-1],
+                                     i[:, 1:].astype(jnp.int32)).mean()
+
+    mesh = make_mesh({"dp": 1}, jax.devices()[:1])
+    wstep = make_sharded_train_step(m, opt.Adam(learning_rate=1e-4),
+                                    lm_loss_w, mesh, num_model_args=1)
+    t = timed(lambda: wstep(ids), n=10)
+    results["G2_gpt2k_window256_ms"] = t
+    print(f"G2 gpt2k window=256 flash+remat: {t:.1f} ms "
+          f"(vs G full attention above — the banded-kernel delta)")
+except Exception as e:
+    print("G2 gpt2k window failed:", type(e).__name__, e)
+
 # I. ResNet-50 throughput vs the reference's headline tables
 # (BASELINE.md: V100 fp32 inference 1076.81 img/s @ bs32, 1233.15 @ bs128,
 # fp16 2085.51 @ bs32; training fp32 251.22 img/s @ bs16). TPU bf16 is
