@@ -26,7 +26,7 @@ class BertConfig:
     def __init__(self, vocab_size=30522, hidden_size=768, num_layers=12,
                  num_heads=12, intermediate_size=3072, max_position=512,
                  type_vocab_size=2, dropout=0.1, layer_norm_eps=1e-12,
-                 dtype="float32", remat=False):
+                 dtype="float32", remat=False, window=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -40,6 +40,11 @@ class BertConfig:
         # recompute each layer's activations in backward (jax.checkpoint)
         # — the long-sequence memory knob (docs/performance.md)
         self.remat = remat
+        # Longformer-style symmetric sliding-window attention ([q-w, q+w]):
+        # O(L·window) in the fused flash kernel — the long-document knob
+        if window is not None and window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self.window = window
 
 
 def bert_base(**kwargs):
@@ -62,7 +67,8 @@ class BertSelfAttention(FusedSelfAttention):
         if isinstance(cfg_or_hidden, BertConfig):
             cfg = cfg_or_hidden
             super().__init__(cfg.hidden_size, cfg.num_heads,
-                             dropout=cfg.dropout, dtype=cfg.dtype)
+                             dropout=cfg.dropout, dtype=cfg.dtype,
+                             window=getattr(cfg, "window", None))
         else:
             super().__init__(cfg_or_hidden, *args, **kwargs)
 
@@ -77,7 +83,9 @@ class BertLayer(HybridBlock):
         self.attention = FusedSelfAttention(cfg.hidden_size,
                                             cfg.num_heads,
                                             dropout=cfg.dropout,
-                                            dtype=cfg.dtype)
+                                            dtype=cfg.dtype,
+                                            window=getattr(cfg, "window",
+                                                           None))
         self.attn_norm = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
                                       in_channels=cfg.hidden_size)
         self.ffn_intermediate = nn.Dense(cfg.intermediate_size,
@@ -190,5 +198,8 @@ class BertForPretraining(HybridBlock):
         embed = 0  # lookups are bandwidth, not FLOPs
         mlm = (cfg.vocab_size * h + h * h) * mask_frac
         params_matmul = l * per_layer + mlm
-        attn = l * 2 * seq_len * h  # QK^T + PV per token
+        # windowed attention touches min(L, 2w+1) keys per query, not L
+        w = getattr(cfg, "window", None)
+        kv_span = seq_len if w is None else min(seq_len, 2 * w + 1)
+        attn = l * 2 * kv_span * h  # QK^T + PV per token
         return 6.0 * (params_matmul + attn)

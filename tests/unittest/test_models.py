@@ -550,3 +550,31 @@ def test_gpt_beam_search_eos_freezes():
     hit = onp.where(out[2:] == eos)[0]
     assert hit.size > 0, (free, out)
     onp.testing.assert_array_equal(out[2 + hit[0]:], eos)
+
+
+def test_bert_sliding_window_config():
+    """BertConfig(window=w): Longformer-style symmetric local attention —
+    logits diverge from a full-attention twin with identical weights, and
+    padded batches still work (window composes with the padding mask)."""
+    from mxnet_tpu.models.bert import BertConfig, BertModel
+    kw = dict(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+              intermediate_size=64, max_position=64, dropout=0.0)
+    mw = BertModel(BertConfig(window=3, **kw))
+    mw.initialize()
+    ids = mx.np.array(onp.random.RandomState(0).randint(0, 64, (2, 32)),
+                      dtype="int32")
+    vlen = mx.np.array([24, 32], dtype="int32")
+    seq_w, _ = mw(ids, valid_length=vlen)
+
+    mf = BertModel(BertConfig(**kw))
+    mf.initialize()
+    mf(ids)
+    for (_, p1), (_, p2) in zip(sorted(mw.collect_params().items()),
+                                sorted(mf.collect_params().items())):
+        p2.set_data(p1.data())
+    seq_f, _ = mf(ids, valid_length=vlen)
+    assert not onp.allclose(onp.asarray(seq_w.asnumpy()),
+                            onp.asarray(seq_f.asnumpy())), \
+        "window had no effect"
+    with pytest.raises(ValueError):
+        BertConfig(window=0, **kw)
