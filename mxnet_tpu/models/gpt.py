@@ -24,7 +24,8 @@ class GPTConfig:
     def __init__(self, vocab_size=50257, hidden_size=768, num_layers=12,
                  num_heads=12, intermediate_size=3072, max_position=1024,
                  dropout=0.1, layer_norm_eps=1e-5, tie_embeddings=True,
-                 dtype="float32", remat=False, window=None):
+                 dtype="float32", remat=False, window=None, rope=False,
+                 rope_theta=10000.0):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -44,6 +45,10 @@ class GPTConfig:
                              "truthiness-vs-None split would otherwise make "
                              "train and cached-decode masks disagree)")
         self.window = window
+        # rotary position embeddings (RoPE) instead of learned absolute
+        # positions; `max_position` still bounds the decode cache length
+        self.rope = rope
+        self.rope_theta = rope_theta
 
 
 def gpt_small(**kwargs):
@@ -64,11 +69,12 @@ class GPTBlock(HybridBlock):
         super().__init__()
         self.attn_norm = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
                                       in_channels=cfg.hidden_size)
-        self.attention = FusedSelfAttention(cfg.hidden_size, cfg.num_heads,
-                                            dropout=cfg.dropout, causal=True,
-                                            dtype=cfg.dtype,
-                                            window=getattr(cfg, "window",
-                                                           None))
+        self.attention = FusedSelfAttention(
+            cfg.hidden_size, cfg.num_heads, dropout=cfg.dropout,
+            causal=True, dtype=cfg.dtype,
+            window=getattr(cfg, "window", None),
+            rope_theta=(cfg.rope_theta
+                        if getattr(cfg, "rope", False) else None))
         self.ffn_norm = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
                                      in_channels=cfg.hidden_size)
         self.ffn = FeedForward(cfg.hidden_size, cfg.intermediate_size,
@@ -86,8 +92,11 @@ class GPTModel(HybridBlock):
         self.cfg = cfg
         self.word_embed = nn.Embedding(cfg.vocab_size, cfg.hidden_size,
                                        dtype=cfg.dtype)
-        self.position_embed = nn.Embedding(cfg.max_position, cfg.hidden_size,
-                                           dtype=cfg.dtype)
+        if not getattr(cfg, "rope", False):
+            # RoPE rotates q/k inside attention; no absolute-position table
+            self.position_embed = nn.Embedding(cfg.max_position,
+                                               cfg.hidden_size,
+                                               dtype=cfg.dtype)
         self.embed_dropout = nn.Dropout(cfg.dropout)
         self.layers = nn.HybridSequential()
         for _ in range(cfg.num_layers):
@@ -98,9 +107,10 @@ class GPTModel(HybridBlock):
     def forward(self, input_ids):
         b, l = input_ids.shape
         check_max_position(l, self.cfg.max_position)
-        pos = npx.arange_like(input_ids, axis=1).astype("int32")
-        x = self.word_embed(input_ids) + self.position_embed(
-            pos.reshape(1, l))
+        x = self.word_embed(input_ids)
+        if not getattr(self.cfg, "rope", False):
+            pos = npx.arange_like(input_ids, axis=1).astype("int32")
+            x = x + self.position_embed(pos.reshape(1, l))
         x = self.embed_dropout(x)
         for layer in self.layers:
             if getattr(self.cfg, "remat", False):
@@ -246,8 +256,9 @@ class GPTForCausalLM(HybridBlock):
                 b2=w(blk.ffn.ffn_output.bias)))
         head = (None if self.cfg.tie_embeddings
                 else w(self.lm_head.weight))
-        return dict(embed=w(t.word_embed.weight),
-                    pos=w(t.position_embed.weight),
+        pos = (None if getattr(self.cfg, "rope", False)
+               else w(t.position_embed.weight))
+        return dict(embed=w(t.word_embed.weight), pos=pos,
                     lnf_g=w(t.final_norm.gamma), lnf_b=w(t.final_norm.beta),
                     head=head, layers=layers)
 
@@ -257,6 +268,7 @@ class GPTForCausalLM(HybridBlock):
         import jax
         import jax.numpy as jnp
         from jax import lax
+        from ..ops.attention import rope_rotate
 
         cfg = self.cfg
         H, E = cfg.num_heads, cfg.hidden_size
@@ -269,15 +281,24 @@ class GPTForCausalLM(HybridBlock):
             v = ((x - m) ** 2).mean(-1, keepdims=True)
             return (x - m) / jnp.sqrt(v + eps) * g + b
 
-        h = P["embed"][tok] + P["pos"][t]
+        use_rope = getattr(cfg, "rope", False)
+        h = P["embed"][tok]
+        if not use_rope:
+            h = h + P["pos"][t]
         new_k, new_v = [], []
         for li, L in enumerate(P["layers"]):
             a = ln(h, L["ln1_g"], L["ln1_b"])
             qkv = a @ L["wqkv"].T + L["bqkv"]
             q, k, v = jnp.split(qkv, 3, axis=-1)
             qh = q.reshape(N, H, D)
+            kh_new = k.reshape(N, H, D)
+            if use_rope:
+                # the SAME rotation helper as the full forward, at this
+                # step's absolute position (cached keys are pre-rotated)
+                qh = rope_rotate(qh, t, cfg.rope_theta)
+                kh_new = rope_rotate(kh_new, t, cfg.rope_theta)
             kc = lax.dynamic_update_slice_in_dim(
-                kcache[li], k.reshape(N, H, D)[:, :, None], t, axis=2)
+                kcache[li], kh_new[:, :, None], t, axis=2)
             vc = lax.dynamic_update_slice_in_dim(
                 vcache[li], v.reshape(N, H, D)[:, :, None], t, axis=2)
             new_k.append(kc)
@@ -467,4 +488,8 @@ class GPTForCausalLM(HybridBlock):
         h, l, i = cfg.hidden_size, cfg.num_layers, cfg.intermediate_size
         per_layer = 4 * h * h + 2 * h * i
         head = cfg.vocab_size * h
-        return 6 * (l * per_layer + head) + 12 * l * seq_len * h // 2
+        # causal window attends min(L, w+1) keys per query, not L
+        # (same accounting fix as BertForPretraining.flops_per_token)
+        w = getattr(cfg, "window", None)
+        kv_span = seq_len if w is None else min(seq_len, w + 1)
+        return 6 * (l * per_layer + head) + 12 * l * kv_span * h // 2

@@ -29,13 +29,15 @@ class FusedSelfAttention(HybridBlock):
 
     def __init__(self, hidden_size: int, num_heads: int, dropout: float = 0.0,
                  causal: bool = False, dtype="float32",
-                 attn_dropout: float = None, window=None):
+                 attn_dropout: float = None, window=None, rope_theta=None):
         super().__init__()
         self.num_heads = num_heads
         self.causal = causal
         # sliding-window (local) attention: O(L·window) fused kernel path
         # (Mistral-style when causal, Longformer-style otherwise)
         self.window = window
+        # rotary position embeddings applied to q/k (RoPE; None = off)
+        self.rope_theta = rope_theta
         # attention-probs dropout (BERT's attention_probs_dropout_prob);
         # defaults to the output dropout rate, applied inside the flash
         # kernel on the TPU path
@@ -53,7 +55,8 @@ class FusedSelfAttention(HybridBlock):
         ctx = npx.multi_head_attention(q, k, v, self.num_heads, mask=mask,
                                        dropout_p=self._attn_dropout,
                                        causal=self.causal,
-                                       window=self.window)
+                                       window=self.window,
+                                       rope_theta=self.rope_theta)
         return self.dropout(self.attn_proj(ctx))
 
 

@@ -21,13 +21,13 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from ..base import getenv_bool
+from ..base import getenv_bool, MXNetError
 from ..ndarray.ndarray import ndarray, apply_op
 from .. import random as _rng
 from .. import _tape
 
 __all__ = ["multi_head_attention", "dot_product_attention",
-           "reference_attention", "band_bias"]
+           "reference_attention", "band_bias", "rope_rotate"]
 
 MASK_VALUE = -1e30
 
@@ -176,10 +176,28 @@ def dot_product_attention(q, k, v, mask=None, causal=False, scale=None,
                                dropout_key=dropout_key)
 
 
+def rope_rotate(x, positions, theta: float = 10000.0):
+    """Rotary position embedding (rotate-half form) over the last axis.
+
+    x: (..., L, D) with D even (or (..., D) with scalar `positions` for
+    single-step decode); `positions` broadcasts against the L axis. Both
+    the full forward and the KV-cache decode step use THIS function, so
+    the two paths can never disagree on the rotation convention."""
+    d2 = x.shape[-1] // 2
+    freq = theta ** (-jnp.arange(d2, dtype=jnp.float32) / d2)
+    ang = jnp.asarray(positions, jnp.float32)[..., None] * freq
+    cos = jnp.cos(ang).astype(x.dtype)
+    sin = jnp.sin(ang).astype(x.dtype)
+    x1, x2 = x[..., :d2], x[..., d2:]
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x1 * sin + x2 * cos], axis=-1)
+
+
 def multi_head_attention(query: ndarray, key: ndarray, value: ndarray,
                          num_heads: int, mask=None, dropout_p: float = 0.0,
                          causal: bool = False, use_flash: bool = True,
-                         window=None, window_symmetric: bool = True):
+                         window=None, window_symmetric: bool = True,
+                         rope_theta=None):
     """Multi-head attention over (B, L, E) `ndarray`s (already projected).
 
     `dropout_p` applies attention-probs dropout (active under
@@ -202,6 +220,15 @@ def multi_head_attention(query: ndarray, key: ndarray, value: ndarray,
         qh = qv.reshape(b, lq, num_heads, hd).transpose(0, 2, 1, 3)
         kh = kv.reshape(b, lk, num_heads, hd).transpose(0, 2, 1, 3)
         vh = vv.reshape(b, lk, num_heads, hd).transpose(0, 2, 1, 3)
+        if rope_theta is not None:
+            if lq != lk:
+                raise MXNetError(
+                    "rope_theta requires self-attention (Lq == Lk): "
+                    f"got Lq={lq}, Lk={lk} — a cross/decode call would "
+                    "silently rotate queries from position 0; rotate q/k "
+                    "explicitly with ops.attention.rope_rotate instead")
+            qh = rope_rotate(qh, jnp.arange(lq), float(rope_theta))
+            kh = rope_rotate(kh, jnp.arange(lk), float(rope_theta))
         m = rest[0] if rest else None
         if m is not None and m.ndim == 3:   # (B, Lq, Lk) -> (B, 1, Lq, Lk)
             m = m[:, None]
