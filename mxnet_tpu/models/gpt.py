@@ -25,7 +25,7 @@ class GPTConfig:
                  num_heads=12, intermediate_size=3072, max_position=1024,
                  dropout=0.1, layer_norm_eps=1e-5, tie_embeddings=True,
                  dtype="float32", remat=False, window=None, rope=False,
-                 rope_theta=10000.0):
+                 rope_theta=10000.0, num_kv_heads=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -47,8 +47,19 @@ class GPTConfig:
         self.window = window
         # rotary position embeddings (RoPE) instead of learned absolute
         # positions; `max_position` still bounds the decode cache length
+        if rope and (hidden_size // num_heads) % 2:
+            raise ValueError(
+                f"rope requires an even head_dim; hidden_size="
+                f"{hidden_size} / num_heads={num_heads} gives "
+                f"{hidden_size // num_heads}")
         self.rope = rope
         self.rope_theta = rope_theta
+        # grouped-query attention: kv carry this many heads (< num_heads);
+        # the decode KV cache shrinks by the same factor
+        if num_kv_heads is not None and num_heads % num_kv_heads:
+            raise ValueError(f"num_heads ({num_heads}) must be divisible "
+                             f"by num_kv_heads ({num_kv_heads})")
+        self.num_kv_heads = num_kv_heads
 
 
 def gpt_small(**kwargs):
@@ -74,7 +85,8 @@ class GPTBlock(HybridBlock):
             causal=True, dtype=cfg.dtype,
             window=getattr(cfg, "window", None),
             rope_theta=(cfg.rope_theta
-                        if getattr(cfg, "rope", False) else None))
+                        if getattr(cfg, "rope", False) else None),
+            num_kv_heads=getattr(cfg, "num_kv_heads", None))
         self.ffn_norm = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
                                      in_channels=cfg.hidden_size)
         self.ffn = FeedForward(cfg.hidden_size, cfg.intermediate_size,
@@ -264,7 +276,10 @@ class GPTForCausalLM(HybridBlock):
 
     def _token_step(self, P, tok, t, kcache, vcache, T):
         """One cached decoder step: token ids (N,) at position t against
-        (n_layers, N, H, T, D) caches -> (logits (N, V), new caches)."""
+        (n_layers, N, H_kv, T, D) caches -> (logits (N, V), new caches).
+        Under GQA (num_kv_heads < num_heads) the caches store only the kv
+        heads — the memory saving — and repeat per query-head group at
+        use."""
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -273,6 +288,8 @@ class GPTForCausalLM(HybridBlock):
         cfg = self.cfg
         H, E = cfg.num_heads, cfg.hidden_size
         D = E // H
+        Hkv = getattr(cfg, "num_kv_heads", None) or H
+        kvw = Hkv * D
         eps = cfg.layer_norm_eps
         N = tok.shape[0]
 
@@ -289,9 +306,11 @@ class GPTForCausalLM(HybridBlock):
         for li, L in enumerate(P["layers"]):
             a = ln(h, L["ln1_g"], L["ln1_b"])
             qkv = a @ L["wqkv"].T + L["bqkv"]
-            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = qkv[..., :E]
+            k = qkv[..., E:E + kvw]
+            v = qkv[..., E + kvw:]
             qh = q.reshape(N, H, D)
-            kh_new = k.reshape(N, H, D)
+            kh_new = k.reshape(N, Hkv, D)
             if use_rope:
                 # the SAME rotation helper as the full forward, at this
                 # step's absolute position (cached keys are pre-rotated)
@@ -300,11 +319,20 @@ class GPTForCausalLM(HybridBlock):
             kc = lax.dynamic_update_slice_in_dim(
                 kcache[li], kh_new[:, :, None], t, axis=2)
             vc = lax.dynamic_update_slice_in_dim(
-                vcache[li], v.reshape(N, H, D)[:, :, None], t, axis=2)
+                vcache[li], v.reshape(N, Hkv, D)[:, :, None], t, axis=2)
             new_k.append(kc)
             new_v.append(vc)
-            s = jnp.einsum("bhd,bhtd->bht", qh, kc) / jnp.sqrt(
-                jnp.float32(D)).astype(h.dtype)
+            # GQA: the cache stores Hkv heads (the memory saving); score
+            # each query-head GROUP against its kv head directly — a
+            # jnp.repeat of the cache would rematerialize exactly the
+            # bandwidth GQA saves, every step
+            scale = 1.0 / jnp.sqrt(jnp.float32(D)).astype(h.dtype)
+            if Hkv == H:
+                s = jnp.einsum("bhd,bhtd->bht", qh, kc) * scale
+            else:
+                qg = qh.reshape(N, Hkv, H // Hkv, D)
+                s = (jnp.einsum("bgrd,bgtd->bgrt", qg, kc)
+                     .reshape(N, H, T) * scale)
             mask = jnp.arange(T) <= t
             if getattr(cfg, "window", None):
                 # sliding-window decode: only the last `window` positions
@@ -312,7 +340,11 @@ class GPTForCausalLM(HybridBlock):
             s = jnp.where(mask[None, None], s, -1e30)
             p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(
                 h.dtype)
-            ctx = jnp.einsum("bht,bhtd->bhd", p, vc).reshape(N, E)
+            if Hkv == H:
+                ctx = jnp.einsum("bht,bhtd->bhd", p, vc).reshape(N, E)
+            else:
+                pg = p.reshape(N, Hkv, H // Hkv, T)
+                ctx = jnp.einsum("bgrt,bgtd->bgrd", pg, vc).reshape(N, E)
             h = h + ctx @ L["wo"].T + L["bo"]
             f = ln(h, L["ln2_g"], L["ln2_b"])
             h = h + jax.nn.gelu(f @ L["w1"].T + L["b1"]) @ L["w2"].T \
@@ -340,6 +372,7 @@ class GPTForCausalLM(HybridBlock):
         cfg = self.cfg
         H, E = cfg.num_heads, cfg.hidden_size
         D = E // H
+        H_kv = getattr(cfg, "num_kv_heads", None) or H   # cache head count
         K = int(num_beams)
         P = self._decode_weights()
         prompt = input_ids._data if hasattr(input_ids, "_data") \
@@ -379,9 +412,9 @@ class GPTForCausalLM(HybridBlock):
 
             def regather(c):
                 return jnp.take_along_axis(
-                    c.reshape(n_layers, B, K, H, T, D),
+                    c.reshape(n_layers, B, K, H_kv, T, D),
                     src[None, :, :, None, None, None], axis=2
-                ).reshape(n_layers, B * K, H, T, D)
+                ).reshape(n_layers, B * K, H_kv, T, D)
 
             kc = regather(kc)
             vc = regather(vc)
@@ -394,7 +427,7 @@ class GPTForCausalLM(HybridBlock):
         @jax.jit
         def run(prompt):
             # phase 1: prefill at batch B — beams are identical here
-            kc = jnp.zeros((n_layers, B, H, T, D), P["embed"].dtype)
+            kc = jnp.zeros((n_layers, B, H_kv, T, D), P["embed"].dtype)
             vc = jnp.zeros_like(kc)
             if plen > 1:
                 (kc, vc), _ = lax.scan(prefill_step, (kc, vc),
@@ -435,6 +468,7 @@ class GPTForCausalLM(HybridBlock):
         cfg = self.cfg
         H, E = cfg.num_heads, cfg.hidden_size
         D = E // H
+        H_kv = getattr(cfg, "num_kv_heads", None) or H   # cache head count
         eps = cfg.layer_norm_eps
         P = self._decode_weights()
         prompt = input_ids._data if hasattr(input_ids, "_data") \
@@ -473,7 +507,7 @@ class GPTForCausalLM(HybridBlock):
 
         @jax.jit
         def run(prompt):
-            kc = jnp.zeros((n_layers, B, H, T, D), P["embed"].dtype)
+            kc = jnp.zeros((n_layers, B, H_kv, T, D), P["embed"].dtype)
             vc = jnp.zeros_like(kc)
             init = (kc, vc, prompt[:, 0])
             _, toks = lax.scan(step, init, jnp.arange(T - 1))
@@ -486,10 +520,20 @@ class GPTForCausalLM(HybridBlock):
     @staticmethod
     def flops_per_token(cfg: GPTConfig, seq_len: int) -> float:
         h, l, i = cfg.hidden_size, cfg.num_layers, cfg.intermediate_size
-        per_layer = 4 * h * h + 2 * h * i
+        # GQA: k/v projections are num_kv_heads/num_heads the width
+        kvh = getattr(cfg, "num_kv_heads", None) or cfg.num_heads
+        kv_width = h * kvh // cfg.num_heads
+        per_layer = 2 * h * h + 2 * h * kv_width + 2 * h * i
         head = cfg.vocab_size * h
-        # causal window attends min(L, w+1) keys per query, not L
-        # (same accounting fix as BertForPretraining.flops_per_token)
+        # average kv span per query: causal full attention averages
+        # (L+1)/2; a causal window of w clamps each query's span at w+1,
+        # so the average is ((w(w+1)/2) + (L-w)(w+1)) / L — NOT halved
+        # again (only the first w queries have growing spans)
         w = getattr(cfg, "window", None)
-        kv_span = seq_len if w is None else min(seq_len, w + 1)
-        return 6 * (l * per_layer + head) + 12 * l * kv_span * h // 2
+        if w is None:
+            avg_span = (seq_len + 1) / 2
+        else:
+            ww = min(w, seq_len - 1)
+            avg_span = (ww * (ww + 1) / 2
+                        + (seq_len - ww) * (ww + 1)) / seq_len
+        return 6 * (l * per_layer + head) + 12 * l * h * avg_span

@@ -29,7 +29,8 @@ class FusedSelfAttention(HybridBlock):
 
     def __init__(self, hidden_size: int, num_heads: int, dropout: float = 0.0,
                  causal: bool = False, dtype="float32",
-                 attn_dropout: float = None, window=None, rope_theta=None):
+                 attn_dropout: float = None, window=None, rope_theta=None,
+                 num_kv_heads=None):
         super().__init__()
         self.num_heads = num_heads
         self.causal = causal
@@ -38,11 +39,23 @@ class FusedSelfAttention(HybridBlock):
         self.window = window
         # rotary position embeddings applied to q/k (RoPE; None = off)
         self.rope_theta = rope_theta
+        # grouped-query attention: kv carry num_kv_heads heads (< q heads)
+        self.num_kv_heads = num_kv_heads
+        if num_kv_heads is not None and num_heads % num_kv_heads:
+            # ValueError across all three validation sites (GPTConfig,
+            # here, ops.attention) so callers can catch one type
+            raise ValueError(f"num_heads ({num_heads}) must be divisible "
+                             f"by num_kv_heads ({num_kv_heads})")
+        head_dim = hidden_size // num_heads
+        kv_width = (num_kv_heads or num_heads) * head_dim
+        self._kv_width = kv_width
         # attention-probs dropout (BERT's attention_probs_dropout_prob);
         # defaults to the output dropout rate, applied inside the flash
         # kernel on the TPU path
         self._attn_dropout = dropout if attn_dropout is None else attn_dropout
-        self.attn_qkv = nn.Dense(3 * hidden_size, in_units=hidden_size,
+        # one fused projection even under GQA: [q | k | v] columns
+        self.attn_qkv = nn.Dense(hidden_size + 2 * kv_width,
+                                 in_units=hidden_size,
                                  flatten=False, dtype=dtype)
         self.attn_proj = nn.Dense(hidden_size, in_units=hidden_size,
                                   flatten=False, dtype=dtype)
@@ -50,13 +63,15 @@ class FusedSelfAttention(HybridBlock):
 
     def forward(self, x, mask=None):
         qkv = self.attn_qkv(x)
-        h = qkv.shape[-1] // 3
-        q, k, v = qkv[..., :h], qkv[..., h:2 * h], qkv[..., 2 * h:]
+        h = qkv.shape[-1] - 2 * self._kv_width
+        kw = self._kv_width
+        q, k, v = (qkv[..., :h], qkv[..., h:h + kw], qkv[..., h + kw:])
         ctx = npx.multi_head_attention(q, k, v, self.num_heads, mask=mask,
                                        dropout_p=self._attn_dropout,
                                        causal=self.causal,
                                        window=self.window,
-                                       rope_theta=self.rope_theta)
+                                       rope_theta=self.rope_theta,
+                                       num_kv_heads=self.num_kv_heads)
         return self.dropout(self.attn_proj(ctx))
 
 

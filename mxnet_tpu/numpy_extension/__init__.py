@@ -868,7 +868,7 @@ def sldwin_atten_context(score, value, dilation, w=1, symmetric=True):
 def multi_head_attention(query, key, value, num_heads, mask=None,
                          dropout_p=0.0, causal=False, use_flash=True,
                          window=None, window_symmetric=True,
-                         rope_theta=None):
+                         rope_theta=None, num_kv_heads=None):
     """Fused multi-head attention over (B, L, E) tensors.
 
     New-capability op (the reference only has the interleaved primitives):
@@ -876,13 +876,15 @@ def multi_head_attention(query, key, value, num_heads, mask=None,
     otherwise a jnp reference path. `window=w` runs fused sliding-window
     (local) attention — O(L·w), out-of-band blocks skipped in-kernel.
     `rope_theta` applies rotary position embeddings to q/k per head.
+    `num_kv_heads=g` selects grouped-query attention (GQA/MQA).
     See `mxnet_tpu.ops.attention`."""
     from ..ops import attention as _att
     return _att.multi_head_attention(query, key, value, num_heads, mask=mask,
                                      dropout_p=dropout_p, causal=causal,
                                      use_flash=use_flash, window=window,
                                      window_symmetric=window_symmetric,
-                                     rope_theta=rope_theta)
+                                     rope_theta=rope_theta,
+                                     num_kv_heads=num_kv_heads)
 
 
 # ---------------------------------------------------------------------------

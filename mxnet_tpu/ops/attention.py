@@ -182,28 +182,38 @@ def rope_rotate(x, positions, theta: float = 10000.0):
     x: (..., L, D) with D even (or (..., D) with scalar `positions` for
     single-step decode); `positions` broadcasts against the L axis. Both
     the full forward and the KV-cache decode step use THIS function, so
-    the two paths can never disagree on the rotation convention."""
+    the two paths can never disagree on the rotation convention.  The
+    rotation arithmetic runs in fp32 regardless of activation dtype —
+    bf16 cos/sin tables would alias adjacent positions in the
+    low-frequency bands at long context."""
+    if x.shape[-1] % 2:
+        raise ValueError(f"rope requires an even head_dim, got "
+                         f"{x.shape[-1]}")
     d2 = x.shape[-1] // 2
     freq = theta ** (-jnp.arange(d2, dtype=jnp.float32) / d2)
     ang = jnp.asarray(positions, jnp.float32)[..., None] * freq
-    cos = jnp.cos(ang).astype(x.dtype)
-    sin = jnp.sin(ang).astype(x.dtype)
-    x1, x2 = x[..., :d2], x[..., d2:]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :d2], xf[..., d2:]
     return jnp.concatenate([x1 * cos - x2 * sin,
-                            x1 * sin + x2 * cos], axis=-1)
+                            x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
 
 
 def multi_head_attention(query: ndarray, key: ndarray, value: ndarray,
                          num_heads: int, mask=None, dropout_p: float = 0.0,
                          causal: bool = False, use_flash: bool = True,
                          window=None, window_symmetric: bool = True,
-                         rope_theta=None):
+                         rope_theta=None, num_kv_heads=None):
     """Multi-head attention over (B, L, E) `ndarray`s (already projected).
 
     `dropout_p` applies attention-probs dropout (active under
     `autograd.train_mode`, like `npx.dropout`) — inside the Pallas kernel on
     the flash path, via `jax.random.bernoulli` on the reference path.
     `window=w` selects fused sliding-window (local) attention.
+    `num_kv_heads=g` enables grouped-query attention: key/value carry g
+    heads (their E dim is g*head_dim, smaller than the query's) and each
+    kv head serves num_heads//g query heads — the KV-cache/bandwidth
+    saving of GQA/MQA.
     """
     arrs = [query, key, value]
     has_mask = isinstance(mask, ndarray)
@@ -212,14 +222,24 @@ def multi_head_attention(query: ndarray, key: ndarray, value: ndarray,
     drop_key = None
     if dropout_p > 0.0 and _tape.is_training():
         drop_key = _rng.next_key()
+    kvh = num_kv_heads or num_heads
+    if num_heads % kvh:
+        # ValueError everywhere this is validated (see models/layers.py)
+        raise ValueError(f"num_heads ({num_heads}) must be divisible by "
+                         f"num_kv_heads ({kvh})")
 
     def fn(qv, kv, vv, *rest):
         b, lq, e = qv.shape
         lk = kv.shape[1]
         hd = e // num_heads
         qh = qv.reshape(b, lq, num_heads, hd).transpose(0, 2, 1, 3)
-        kh = kv.reshape(b, lk, num_heads, hd).transpose(0, 2, 1, 3)
-        vh = vv.reshape(b, lk, num_heads, hd).transpose(0, 2, 1, 3)
+        kh = kv.reshape(b, lk, kvh, hd).transpose(0, 2, 1, 3)
+        vh = vv.reshape(b, lk, kvh, hd).transpose(0, 2, 1, 3)
+        if kvh != num_heads:
+            # GQA: repeat each kv head across its query-head group
+            rep = num_heads // kvh
+            kh = jnp.repeat(kh, rep, axis=1)
+            vh = jnp.repeat(vh, rep, axis=1)
         if rope_theta is not None:
             if lq != lk:
                 raise MXNetError(

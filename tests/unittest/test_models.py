@@ -624,3 +624,48 @@ def test_gpt_rope_decode_consistent_and_trains():
         trainer.step(1)
         losses.append(float(loss.asnumpy()))
     assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+def test_gpt_gqa_decode_consistent_and_trains():
+    """GPTConfig(num_kv_heads=2) with num_heads=4 (GQA): the fused qkv
+    projection shrinks, the decode KV cache stores only 2 heads, cached
+    and full-recompute greedy decode agree (incl. with RoPE), beam search
+    runs, and the model trains."""
+    from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=4, intermediate_size=64, max_position=32,
+                    dropout=0.0, num_kv_heads=2, rope=True)
+    m = GPTForCausalLM(cfg)
+    m.initialize()
+    prompt = mx.np.array([[3, 9, 1]], dtype="int32")
+    m(prompt)
+    # fused projection: E + 2 * (kvh * head_dim) = 32 + 2*16 = 64 columns
+    w = m.transformer.layers[0].attention.attn_qkv.weight
+    assert w.shape == (64, 32), w.shape
+
+    slow = m.generate(prompt, max_new_tokens=6, use_cache=False)
+    fast = m.generate(prompt, max_new_tokens=6, use_cache=True)
+    onp.testing.assert_array_equal(onp.asarray(slow.asnumpy()),
+                                   onp.asarray(fast.asnumpy()))
+    beam = m.generate(prompt, max_new_tokens=4, num_beams=2)
+    assert beam.shape == (1, 7)
+
+    m.hybridize()
+    rng = onp.random.RandomState(2)
+    ids = mx.np.array(rng.randint(0, 64, (4, 12)), dtype="int32")
+    trainer = gluon.Trainer(m.collect_params(), "adam",
+                            {"learning_rate": 3e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    losses = []
+    for _ in range(10):
+        with autograd.record():
+            logits = m(ids)
+            loss = loss_fn(logits[:, :-1].reshape(-1, 64),
+                           ids[:, 1:].reshape(-1)).mean()
+        loss.backward()
+        trainer.step(1)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+    with pytest.raises(ValueError):
+        GPTConfig(vocab_size=64, hidden_size=32, num_heads=4,
+                  num_kv_heads=3)
