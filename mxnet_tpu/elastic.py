@@ -201,7 +201,8 @@ class ElasticLoop:
                  keep: int = 3, max_restores: int = 3,
                  watchdog_timeout: Optional[float] = None,
                  retry_on=(RuntimeError, MXNetError),
-                 failure_injector: Optional[FailureInjector] = None):
+                 failure_injector: Optional[FailureInjector] = None,
+                 async_save: bool = False):
         self.target = target
         self.manager = CheckpointManager(directory, keep=keep)
         self.save_every = save_every
@@ -209,6 +210,22 @@ class ElasticLoop:
         self.watchdog_timeout = watchdog_timeout
         self.retry_on = tuple(retry_on)
         self.failure_injector = failure_injector
+        # periodic saves overlap training (ShardedTrainStep.save_async);
+        # preemption/rollback/final saves stay synchronous — those must
+        # be on disk before the process acts on them
+        self.async_save = async_save
+
+    def _drain_async_tolerant(self):
+        """Surface-but-survive a deferred async-write failure: the loop's
+        recovery/preemption/final paths must not let an OLD write error
+        mask the operation they're about to perform (the last COMPLETE
+        checkpoint on disk is still valid)."""
+        try:
+            self.manager.wait_async()
+        except Exception as e:   # noqa: BLE001 — deliberately broad
+            _log.warning(
+                "elastic: a deferred async checkpoint write failed (%s); "
+                "continuing from the last complete checkpoint", e)
 
     def run(self, step_fn: Callable[[int], object], total_steps: int,
             on_step: Optional[Callable[[int, object], None]] = None) -> dict:
@@ -231,6 +248,7 @@ class ElasticLoop:
             with ctx:
                 while i < total_steps:
                     if sync_flag(guard.preempted):
+                        self._drain_async_tolerant()
                         path = self.manager.save(self.target, i)
                         _log.warning("elastic: preempted at step %d; "
                                      "checkpoint %s written", i, path)
@@ -251,6 +269,7 @@ class ElasticLoop:
                             raise MXNetError(
                                 f"elastic: step {i} failed after "
                                 f"{self.max_restores} restores") from e
+                        self._drain_async_tolerant()
                         rollback = self.manager.restore(self.target)
                         _log.warning(
                             "elastic: step %d failed (%s); restored "
@@ -263,8 +282,11 @@ class ElasticLoop:
                         watchdog.ping()
                     if on_step is not None:
                         on_step(i, last_loss)
+                    self._drain_async_tolerant()
                     self.manager.maybe_save(self.target, i,
-                                            every=self.save_every)
+                                            every=self.save_every,
+                                            async_save=self.async_save)
+        self._drain_async_tolerant()
         final = self.manager.save(self.target, total_steps)
         return {"status": "completed", "step": total_steps,
                 "checkpoint": final, "restores": restores,

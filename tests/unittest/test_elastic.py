@@ -153,41 +153,44 @@ def test_sync_flag_single_process():
     assert sync_flag(False) is False
 
 
-def test_elastic_with_sharded_train_step(tmp_path):
-    """End-to-end: ElasticLoop over a real ShardedTrainStep with an injected
-    failure reproduces the uninterrupted loss trajectory (SURVEY §5.3
-    'resume bit-exact' requirement)."""
+def _build_sharded(seed):
+    """Tiny ShardedTrainStep + fixed batch for the bit-exact elastic tests."""
     import jax
     from mxnet_tpu import optimizer as opt
     from mxnet_tpu.gluon import nn
     from mxnet_tpu.parallel import make_mesh, make_sharded_train_step
 
-    def build():
-        mx.random.seed(42)
-        net = nn.Dense(4, in_units=3)
-        net.initialize()
-        xs = mx.np.array(onp.random.RandomState(0).randn(8, 3))
-        ys = mx.np.array(onp.random.RandomState(1).randn(8, 4))
-        mesh = make_mesh({"dp": 1}, jax.devices("cpu")[:1])
-        step = make_sharded_train_step(
-            net, opt.SGD(learning_rate=0.1),
-            lambda out, x, y: ((out - y) ** 2).mean(), mesh,
-            num_model_args=1)
-        return step, xs, ys
+    mx.random.seed(seed)
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    xs = mx.np.array(onp.random.RandomState(0).randn(8, 3))
+    ys = mx.np.array(onp.random.RandomState(1).randn(8, 4))
+    mesh = make_mesh({"dp": 1}, jax.devices("cpu")[:1])
+    step = make_sharded_train_step(
+        net, opt.SGD(learning_rate=0.1),
+        lambda out, x, y: ((out - y) ** 2).mean(), mesh,
+        num_model_args=1)
+    return step, xs, ys
 
-    # uninterrupted reference trajectory
-    step, xs, ys = build()
+
+@pytest.mark.parametrize("async_save,fail_at,seed",
+                         [(False, 3, 42), (True, 4, 7)])
+def test_elastic_sharded_step_bitexact(tmp_path, async_save, fail_at, seed):
+    """End-to-end: ElasticLoop over a real ShardedTrainStep with an
+    injected failure reproduces the uninterrupted loss trajectory (SURVEY
+    §5.3 'resume bit-exact'). The async variant overlaps periodic
+    checkpoints with the steps; rollback drains pending writes first."""
+    step, xs, ys = _build_sharded(seed)
     ref_losses = [float(step(xs, ys)) for _ in range(6)]
 
-    # elastic run with a failure at step 3
-    step2, xs2, ys2 = build()
-    inj = FailureInjector(at_steps=[3])
+    step2, xs2, ys2 = _build_sharded(seed)
+    inj = FailureInjector(at_steps=[fail_at])
     loop = ElasticLoop(step2, str(tmp_path), save_every=1,
-                       failure_injector=inj)
+                       failure_injector=inj, async_save=async_save)
     losses = []
     out = loop.run(lambda i: losses.append(float(step2(xs2, ys2))),
                    total_steps=6)
     assert out["status"] == "completed" and out["restores"] == 1
-    # the failure hit before step 3 executed; after rollback the replayed
-    # trajectory must equal the uninterrupted one exactly
+    # the failure hit before the step executed; after rollback the
+    # replayed trajectory must equal the uninterrupted one exactly
     onp.testing.assert_allclose(losses, ref_losses, rtol=1e-6)
