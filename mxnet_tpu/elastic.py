@@ -215,17 +215,30 @@ class ElasticLoop:
         # be on disk before the process acts on them
         self.async_save = async_save
 
+    _deferred_failures = 0
+
     def _drain_async_tolerant(self):
         """Surface-but-survive a deferred async-write failure: the loop's
         recovery/preemption/final paths must not let an OLD write error
         mask the operation they're about to perform (the last COMPLETE
-        checkpoint on disk is still valid)."""
+        checkpoint on disk is still valid).  CONSECUTIVE failures are
+        bounded like step failures — a full disk must not let the job
+        run for days producing no durable checkpoints."""
         try:
             self.manager.wait_async()
+            self._deferred_failures = 0
         except Exception as e:   # noqa: BLE001 — deliberately broad
+            self._deferred_failures += 1
+            if self._deferred_failures > self.max_restores:
+                raise MXNetError(
+                    f"elastic: {self._deferred_failures} consecutive async "
+                    f"checkpoint writes failed; aborting rather than "
+                    f"training without durable checkpoints") from e
             _log.warning(
                 "elastic: a deferred async checkpoint write failed (%s); "
-                "continuing from the last complete checkpoint", e)
+                "continuing from the last complete checkpoint "
+                "(%d/%d consecutive)", e, self._deferred_failures,
+                self.max_restores)
 
     def run(self, step_fn: Callable[[int], object], total_steps: int,
             on_step: Optional[Callable[[int, object], None]] = None) -> dict:
@@ -282,10 +295,13 @@ class ElasticLoop:
                         watchdog.ping()
                     if on_step is not None:
                         on_step(i, last_loss)
-                    self._drain_async_tolerant()
-                    self.manager.maybe_save(self.target, i,
-                                            every=self.save_every,
-                                            async_save=self.async_save)
+                    # drain only when a save is DUE: draining every step
+                    # would cap write/compute overlap at one step
+                    if self.save_every > 0 and i % self.save_every == 0:
+                        self._drain_async_tolerant()
+                        self.manager.maybe_save(self.target, i,
+                                                every=self.save_every,
+                                                async_save=self.async_save)
         self._drain_async_tolerant()
         final = self.manager.save(self.target, total_steps)
         return {"status": "completed", "step": total_steps,

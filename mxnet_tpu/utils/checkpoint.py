@@ -92,16 +92,29 @@ class CheckpointManager:
             done.set_result(self.save(target, step))
             return done
         final = self._path(step)
-        inner = target.save_async(final)
+        # manager-side tmp + rename: the restore path treats the NEWEST
+        # file as a complete checkpoint, so a generic target whose
+        # save_async writes in place must never leave a truncated file
+        # at the final name (ShardedTrainStep is atomic on its own; the
+        # extra same-directory rename is free)
+        fd, tmp = tempfile.mkstemp(dir=self.directory,
+                                   prefix=f".{self.prefix}-atmp")
+        os.close(fd)
+        inner = target.save_async(tmp)
 
         out: _fut.Future = _fut.Future()
 
         def _finish(f):
             try:
                 f.result()
+                os.replace(tmp, final)
                 self._prune()
                 out.set_result(final)
             except BaseException as e:  # surface writer errors to .result()
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
                 out.set_exception(e)
 
         inner.add_done_callback(_finish)

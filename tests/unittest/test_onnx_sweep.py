@@ -233,13 +233,18 @@ def test_onnx_bert_model(tmp_path):
                                 atol=1e-5)
 
 
-def test_onnx_gpt_model(tmp_path):
+@pytest.mark.parametrize("variant", ["base", "modern"])
+def test_onnx_gpt_model(variant, tmp_path):
     """Whole-model GPT export (tiny config): causal attention + tied
-    embeddings decode head round-trip through the interpreter."""
+    embeddings decode head round-trip through the interpreter. The
+    'modern' variant adds RoPE + GQA + sliding window — the jaxpr-driven
+    exporter must carry all three without per-feature converters."""
     from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    extra = (dict(rope=True, num_kv_heads=2, window=6)
+             if variant == "modern" else {})
     cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
                     num_heads=4, intermediate_size=64, max_position=32,
-                    dropout=0.0)
+                    dropout=0.0, **extra)
     net = GPTForCausalLM(cfg)
     net.initialize()
     ids = mx.np.array(onp.random.RandomState(1).randint(0, 64, (2, 12)),
