@@ -1,0 +1,120 @@
+"""GPT text generation: the decode surface end-to-end.
+
+A tiny GPT is first trained on a synthetic grammar (so generation has
+signal), then every decode mode runs on the SAME weights:
+
+- greedy with per-layer KV caches (one compiled `lax.scan`, O(L)/token),
+- temperature + top-k / top-p (nucleus) sampling,
+- length-normalised beam search with eos freezing,
+- a "modern" config twin (RoPE + GQA + sliding window) doing the same.
+
+Synthetic grammar: token t is followed by (t*3 + 1) % V with high
+probability — easy for a 2-layer model, and greedy decode accuracy
+against the rule is checkable. Run:
+    python examples/gpt_generation.py [--steps N] [--cpu]
+Prints "gpt generation example OK".
+"""
+import argparse
+import os
+import sys
+
+import numpy as onp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def synthetic_batch(rng, batch, seq, vocab):
+    """Markov grammar: next = cur*3+1 (mod V) with p=0.9, else random."""
+    ids = onp.empty((batch, seq), onp.int64)
+    ids[:, 0] = rng.randint(0, vocab, batch)
+    for t in range(1, seq):
+        follow = (ids[:, t - 1] * 3 + 1) % vocab
+        noise = rng.randint(0, vocab, batch)
+        ids[:, t] = onp.where(rng.rand(batch) < 0.9, follow, noise)
+    return ids.astype(onp.int32)
+
+
+def train(model, mx, gluon, autograd, steps, rng, vocab, seq):
+    trainer = gluon.Trainer(model.collect_params(), "adam",
+                            {"learning_rate": 3e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    model.hybridize()
+    last = None
+    for step in range(steps):
+        ids = mx.np.array(synthetic_batch(rng, 8, seq, vocab))
+        with autograd.record():
+            logits = model(ids)
+            loss = loss_fn(logits[:, :-1].reshape(-1, vocab),
+                           ids[:, 1:].reshape(-1)).mean()
+        loss.backward()
+        # loss is already .mean()-reduced -> step(1); step(batch) would
+        # rescale gradients by 1/batch a second time
+        trainer.step(1)
+        last = float(loss.asnumpy())
+        if step % 20 == 0 or step == steps - 1:
+            print(f"  step {step}: loss {last:.3f}", flush=True)
+    return last
+
+
+def rule_accuracy(tokens, vocab):
+    """Fraction of generated transitions following the grammar."""
+    t = onp.asarray(tokens)
+    follow = (t[:, :-1] * 3 + 1) % vocab
+    return float((t[:, 1:] == follow).mean())
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=120)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    mx.random.seed(0)
+    rng = onp.random.RandomState(0)
+    V, SEQ = 64, 24
+
+    for name, extra in (("classic", {}),
+                        ("modern (rope+gqa+window)",
+                         dict(rope=True, num_kv_heads=2, window=8))):
+        print(f"== {name} ==", flush=True)
+        cfg = GPTConfig(vocab_size=V, hidden_size=64, num_layers=2,
+                        num_heads=4, intermediate_size=128,
+                        max_position=64, dropout=0.0, **extra)
+        model = GPTForCausalLM(cfg)
+        model.initialize()
+        prompt = mx.np.array(synthetic_batch(rng, 2, 4, V))
+        model(prompt)
+        train(model, mx, gluon, autograd, args.steps, rng, V, SEQ)
+
+        greedy = model.generate(prompt, max_new_tokens=16)
+        # score only generated transitions: start at the last prompt token
+        plen = prompt.shape[1]
+        acc = rule_accuracy(greedy.asnumpy()[:, plen - 1:], V)
+        print(f"  greedy (KV-cache scan): {greedy.asnumpy()[0].tolist()} "
+              f" rule-accuracy {acc:.2f}", flush=True)
+        assert acc > 0.6, f"greedy decode did not learn the grammar ({acc})"
+
+        sampled = model.generate(prompt, max_new_tokens=16, greedy=False,
+                                 temperature=0.8, top_k=8, top_p=0.95)
+        print(f"  sampled (T=0.8, k=8, p=.95): "
+              f"{sampled.asnumpy()[0].tolist()}", flush=True)
+
+        beam = model.generate(prompt, max_new_tokens=16, num_beams=4,
+                              eos_token_id=V - 1)
+        print(f"  beam (k=4, eos={V - 1}): {beam.asnumpy()[0].tolist()}",
+              flush=True)
+
+    print("gpt generation example OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -44,6 +44,16 @@ def test_bert_finetune_example():
 
 
 @pytest.mark.slow
+def test_gpt_generation_example():
+    """Trains the synthetic grammar and runs every decode mode (greedy
+    KV-cache scan, top-k/top-p sampling, beam, modern rope+gqa+window
+    twin)."""
+    out = _run("examples/gpt_generation.py", "--cpu", "--steps", "120",
+               timeout=1200)
+    assert "gpt generation example OK" in out
+
+
+@pytest.mark.slow
 def test_long_context_sp_example():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
