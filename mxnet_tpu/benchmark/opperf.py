@@ -152,6 +152,11 @@ DEFAULT_OPS = [
     ("clip", [{"data": (1024, 1024), "min": -1.0, "max": 1.0}]),
     ("cumsum", [{"data": (1024, 1024)}]),
     ("sort", [{"data": (1024, 1024)}]),
+    # fused attention (flash kernel on TPU; the new-capability hot op)
+    ("multi_head_attention", [{"query": (8, 256, 512),
+                               "key": (8, 256, 512),
+                               "value": (8, 256, 512),
+                               "num_heads": 8}]),
 ]
 
 
