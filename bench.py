@@ -42,6 +42,9 @@ def _peak_flops(device) -> float:
 def _measure(platform: str) -> dict:
     import jax
     if platform == "cpu":
+        # env var too: mxnet_tpu's import honors JAX_PLATFORMS and would
+        # re-override a config-only choice with the ambient env value
+        os.environ["JAX_PLATFORMS"] = "cpu"
         jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 

@@ -32,6 +32,9 @@ if _home and _home not in sys.path:
 import jax
 _platform = os.environ.get('_MXTPU_CAPI_PLATFORM', '')
 if _platform:
+    # env var too: mxnet_tpu's import honors JAX_PLATFORMS and would
+    # re-override a config-only choice with the ambient env value
+    os.environ['JAX_PLATFORMS'] = _platform
     jax.config.update('jax_platforms', _platform)
 import numpy as _onp
 import mxnet_tpu as mx

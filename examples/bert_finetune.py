@@ -38,6 +38,7 @@ def main():
 
     if args.cpu:
         import jax
+        os.environ["JAX_PLATFORMS"] = "cpu"
         jax.config.update("jax_platforms", "cpu")
 
     import mxnet_tpu as mx

@@ -14,6 +14,11 @@ reader in production. Run: python examples/bert_pretraining.py [--steps N]
 """
 import argparse
 import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
 import numpy as onp
 

@@ -17,7 +17,9 @@ sys.path.insert(0, os.path.join(
 import numpy as onp
 
 import jax
-jax.config.update("jax_platforms", "cpu") if __name__ == "__main__" else None
+if __name__ == "__main__":      # CPU demo; importable without side effects
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
 
 import mxnet_tpu as mx
 from mxnet_tpu import autograd, operator

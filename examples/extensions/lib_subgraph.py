@@ -16,7 +16,9 @@ sys.path.insert(0, os.path.join(
 import numpy as onp
 
 import jax
-jax.config.update("jax_platforms", "cpu") if __name__ == "__main__" else None
+if __name__ == "__main__":      # CPU demo; importable without side effects
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp
 

@@ -111,6 +111,7 @@ def main():
         trainer.step(1)
         if (episode + 1) % 10 == 0:
             print(f"episode {episode + 1}: length {len(rewards)}")
+    print("actor critic example OK")
 
 
 if __name__ == "__main__":

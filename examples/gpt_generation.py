@@ -72,6 +72,7 @@ def main():
     args = ap.parse_args()
     if args.cpu:
         import jax
+        os.environ["JAX_PLATFORMS"] = "cpu"
         jax.config.update("jax_platforms", "cpu")
 
     import mxnet_tpu as mx

@@ -28,6 +28,7 @@ def main():
 
     import jax
     if args.cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
         jax.config.update("jax_platforms", "cpu")
 
     import mxnet_tpu as mx

@@ -16,6 +16,26 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+# Honor JAX_PLATFORMS even when a sitecustomize overrode the jax config at
+# interpreter start (managed environments register accelerator plugins that
+# way): `JAX_PLATFORMS=cpu python train.py` must not silently initialize
+# the overridden platform — and hang when that accelerator is unreachable.
+# Embedding code that picks a platform programmatically should set the env
+# var before importing mxnet_tpu (the in-repo embedders — test conftest,
+# C ABI bootstrap, bench, driver entry — all do), or update the jax config
+# after this import. Backends initialize lazily, so this update is
+# authoritative for everything that runs afterwards.
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS"):
+    try:
+        import jax as _jax
+        _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+        del _jax
+    except Exception:  # noqa: BLE001 — never block import on a config nicety
+        pass
+del _os
+
 from .base import MXNetError  # noqa: F401
 from . import device  # noqa: F401
 from .device import (  # noqa: F401

@@ -10,6 +10,10 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = \
         _flags + " --xla_force_host_platform_device_count=8"
+# the env var, not just the config: mxnet_tpu's import honors
+# JAX_PLATFORMS (so user scripts work under sitecustomize-managed
+# environments), which would re-override a config-only setting here
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 

@@ -10,6 +10,9 @@ import tempfile
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 import jax
+os.environ["JAX_PLATFORMS"] = "cpu"  # env var too: the
+# mxnet_tpu import honors JAX_PLATFORMS and would re-override
+# a config-only choice when run standalone on a managed box
 jax.config.update("jax_platforms", "cpu")
 
 import numpy as onp

@@ -21,6 +21,9 @@ os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                            + " --xla_force_host_platform_device_count=2")
 
 import jax
+os.environ["JAX_PLATFORMS"] = "cpu"  # env var too: the
+# mxnet_tpu import honors JAX_PLATFORMS and would re-override
+# a config-only choice when run standalone on a managed box
 jax.config.update("jax_platforms", "cpu")
 
 import numpy as onp

@@ -44,6 +44,39 @@ def test_bert_finetune_example():
 
 
 @pytest.mark.slow
+def test_mnist_example():
+    """North-star config #1 (`example/gluon/mnist`): synthetic MNIST MLP
+    must train past chance in one epoch. Also guards the JAX_PLATFORMS
+    env honor at import — before it, examples without a --cpu flag hung
+    forever on this environment's overridden default platform."""
+    out = _run("examples/gluon/mnist.py", "--synthetic", "--epochs", "1")
+    import re
+    m = re.search(r"Validation: accuracy=([0-9.]+)", out)
+    assert m and float(m.group(1)) > 0.3, out[-500:]
+
+
+@pytest.mark.slow
+def test_house_prices_example():
+    out = _run("examples/gluon/house_prices.py")
+    assert "5-fold average rmse(log)" in out, out[-500:]
+
+
+@pytest.mark.slow
+def test_actor_critic_example():
+    out = _run("examples/gluon/actor_critic.py", "--episodes", "3")
+    assert "actor critic example OK" in out, out[-500:]
+
+
+@pytest.mark.slow
+def test_bert_pretraining_example(tmp_path):
+    # fresh ckpt dir: the example's ElasticLoop would otherwise restore
+    # step 3 from a PREVIOUS run's default /tmp dir and train 0 steps
+    out = _run("examples/bert_pretraining.py", "--tiny", "--steps", "3",
+               "--ckpt-dir", str(tmp_path / "ckpts"), timeout=600)
+    assert "completed at step 3" in out, out[-500:]
+
+
+@pytest.mark.slow
 def test_gpt_generation_example():
     """Trains the synthetic grammar and runs every decode mode (greedy
     KV-cache scan, top-k/top-p sampling, beam, modern rope+gqa+window
