@@ -10,7 +10,9 @@ donated, so weights update in place — the `static_alloc` end-state.
 """
 from __future__ import annotations
 
+import concurrent.futures as _cf
 import functools
+import threading
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -426,12 +428,11 @@ class ShardedTrainStep:
         Only one async save runs at a time: a second call waits for the
         first.  Multi-process meshes fall back to a synchronous save —
         the cross-host allgather must not race training collectives."""
-        import concurrent.futures as _fut
         multi = any(not getattr(s, "is_fully_addressable", True)
                     for s in self.param_shardings.values())
         if multi:
             self.save(path)
-            done: _fut.Future = _fut.Future()
+            done: _cf.Future = _cf.Future()
             done.set_result(path)
             return done
         return self._submit_async_save(path)
@@ -439,20 +440,40 @@ class ShardedTrainStep:
     def _submit_async_save(self, path: str):
         self._drain_async_save()
         snap = self._snapshot(copy=True)
-        self._ckpt_last = _ckpt_pool().submit(
-            self._write_checkpoint, path, snap)
-        return self._ckpt_last
+        raw = _ckpt_pool().submit(self._write_checkpoint, path, snap)
+        fut = _ObservedFuture()
+
+        def _relay(f):
+            e = f.exception()  # retrieves — the raw future never warns
+            try:
+                if e is None:
+                    fut.set_result(path)
+                else:
+                    fut.set_exception(e)
+            finally:
+                fut.settled.set()
+
+        raw.add_done_callback(_relay)
+        self._ckpt_last = fut
+        return fut
 
     _ckpt_last = None
 
     def _drain_async_save(self):
-        """Wait for any in-flight async save; re-raise its error if it
-        failed (also surfaces errors of already-finished saves the caller
-        never polled).  The future is cleared FIRST so one failed write
-        doesn't poison every later save attempt."""
+        """Wait for any in-flight async save; re-raise its error ONLY if
+        no holder of the returned future retrieved it yet (backstop for
+        saves the caller never polled).  An error that `CheckpointManager`
+        (or any `.result()` caller) already consumed is NOT raised again —
+        otherwise one failed background write would abort the NEXT
+        save/save_async synchronously, escaping ElasticLoop's tolerant
+        drain and defeating its documented max_restores failure budget."""
         fut, self._ckpt_last = self._ckpt_last, None
-        if fut is not None:
-            fut.result()
+        if fut is None:
+            return
+        fut.settled.wait()
+        if fut.error_retrieved:
+            return
+        fut.result()
 
     def _snapshot(self, copy: bool = False):
         """Consistent view of the current training state.  With
@@ -547,6 +568,40 @@ class ShardedTrainStep:
         self.sync_params_to_block()
 
 
+class _ObservedFuture(_cf.Future):
+    """Future that records whether its exception was ever retrieved
+    (`result()` raised it or `exception()` returned it).  Lets
+    `_drain_async_save` deliver a failed write's error exactly once:
+    consumers like CheckpointManager retrieve it through the future, and
+    the drain backstop raises only for never-polled failures."""
+
+    error_retrieved = False
+
+    def __init__(self):
+        super().__init__()
+        # set by the producer AFTER set_result/set_exception returns, i.e.
+        # after done-callbacks ran — the drain waits on this, not on the
+        # future's state, so it can't observe a failure mid-delivery
+        self.settled = threading.Event()
+
+    def result(self, timeout=None):
+        try:
+            return super().result(timeout)
+        except BaseException:
+            # only the future's OWN error counts as retrieved — a wait
+            # timeout / interrupt raised while still pending must not
+            # swallow the real failure from the later drain backstop
+            if self.done():
+                self.error_retrieved = True
+            raise
+
+    def exception(self, timeout=None):
+        e = super().exception(timeout)
+        if e is not None:
+            self.error_retrieved = True
+        return e
+
+
 _CKPT_POOL = None
 
 
@@ -556,8 +611,7 @@ def _ckpt_pool():
     sweeps) doesn't accumulate idle checkpoint threads."""
     global _CKPT_POOL
     if _CKPT_POOL is None:
-        import concurrent.futures as _fut
-        _CKPT_POOL = _fut.ThreadPoolExecutor(
+        _CKPT_POOL = _cf.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="mxtpu-ckpt")
     return _CKPT_POOL
 

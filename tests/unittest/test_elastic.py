@@ -194,3 +194,63 @@ def test_elastic_sharded_step_bitexact(tmp_path, async_save, fail_at, seed):
     # the failure hit before the step executed; after rollback the
     # replayed trajectory must equal the uninterrupted one exactly
     onp.testing.assert_allclose(losses, ref_losses, rtol=1e-6)
+
+
+def test_elastic_tolerates_failed_async_writes(tmp_path, monkeypatch):
+    """A failed BACKGROUND checkpoint write must consume exactly one slot
+    of the deferred-failure budget — not re-raise synchronously from the
+    next save and abort the run (the sticky-future bug: the target's
+    _ckpt_last held an error CheckpointManager had already consumed)."""
+    step, xs, ys = _build_sharded(11)
+    ref = [float(step(xs, ys)) for _ in range(5)]
+
+    step2, xs2, ys2 = _build_sharded(11)
+    real_write = step2._write_checkpoint
+    calls = {"n": 0}
+
+    def flaky(path, snap):
+        calls["n"] += 1
+        # call 1 = the sync anchor save; 2 and 3 = the first two ASYNC
+        # periodic writes -> two consecutive deferred failures, then clean
+        if calls["n"] in (2, 3):
+            raise OSError("disk full (injected)")
+        return real_write(path, snap)
+
+    monkeypatch.setattr(step2, "_write_checkpoint", flaky)
+    loop = ElasticLoop(step2, str(tmp_path), save_every=1, max_restores=3,
+                       async_save=True)
+    losses = []
+    out = loop.run(lambda i: losses.append(float(step2(xs2, ys2))),
+                   total_steps=5)
+    assert out["status"] == "completed" and calls["n"] >= 4
+    onp.testing.assert_allclose(losses, ref, rtol=1e-6)
+
+
+def test_async_save_error_delivered_exactly_once(tmp_path, monkeypatch):
+    """ShardedTrainStep.save_async error contract: a failure retrieved via
+    the returned future is NOT re-raised by the next save; a never-polled
+    failure still surfaces there (the drain backstop)."""
+    step, _, _ = _build_sharded(3)
+    real_write = step._write_checkpoint
+    state = {"fail": True}
+
+    def flaky(path, snap):
+        if state["fail"]:
+            raise OSError("injected write failure")
+        return real_write(path, snap)
+
+    monkeypatch.setattr(step, "_write_checkpoint", flaky)
+    fut = step.save_async(str(tmp_path / "a.npz"))
+    with pytest.raises(OSError):
+        fut.result()                      # consumer takes delivery...
+    state["fail"] = False
+    step.save(str(tmp_path / "b.npz"))    # ...next save must not re-raise
+
+    state["fail"] = True
+    fut_c = step.save_async(str(tmp_path / "c.npz"))
+    while not fut_c.done():                    # held but never POLLED —
+        time.sleep(0.01)                       # done() retrieves nothing
+    state["fail"] = False
+    with pytest.raises(OSError):               # backstop still fires
+        step.save(str(tmp_path / "d.npz"))
+    step.save(str(tmp_path / "e.npz"))         # and clears after delivery
