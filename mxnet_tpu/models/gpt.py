@@ -54,8 +54,10 @@ class GPTConfig:
                 f"{hidden_size // num_heads}")
         self.rope = rope
         self.rope_theta = rope_theta
-        # grouped-query attention: kv carry this many heads (< num_heads);
-        # the decode KV cache shrinks by the same factor
+        # grouped-query attention: kv carry this many heads (< num_heads).
+        # The decode KV cache shrinks by the same factor AND the training/
+        # prefill flash kernel streams K/V at this head count (grouped-KV
+        # folding — no full-head expansion in HBM)
         if num_kv_heads is not None and num_heads % num_kv_heads:
             raise ValueError(f"num_heads ({num_heads}) must be divisible "
                              f"by num_kv_heads ({num_kv_heads})")

@@ -141,11 +141,21 @@ def dot_product_attention(q, k, v, mask=None, causal=False, scale=None,
     `window=w` enables fused sliding-window (local) attention — in-kernel
     band masking with out-of-band BLOCKS skipped (O(L·w) compute); the XLA
     fallback applies the equivalent `band_bias`.
+    Grouped-query attention: k/v may carry g < H heads (H % g == 0) — the
+    flash kernel streams them at g heads (no HBM expansion); only the XLA
+    fallback materialises the repeat.
     Set MXTPU_FLASH_STRICT=1 to raise instead of silently falling back when
     the kernel rejects an input.
     """
     if mask is not None:
         mask = _normalize_mask_4d(mask)
+    if k.shape[1] != q.shape[1] and (
+            k.shape[1] == 0 or q.shape[1] % k.shape[1]):
+        # validate BEFORE the flash try: an input error must not consume
+        # the one-shot "flash unavailable" warning or masquerade as a
+        # kernel rejection
+        raise ValueError(f"query heads ({q.shape[1]}) must be a "
+                         f"multiple of kv heads ({k.shape[1]})")
     if use_flash and _use_pallas():
         try:
             from .pallas.flash_attention import flash_attention
@@ -167,6 +177,9 @@ def dot_product_attention(q, k, v, mask=None, causal=False, scale=None,
                     f"flash attention unavailable ({type(e).__name__}: {e}); "
                     "using the XLA reference path. Set MXTPU_FLASH_STRICT=1 "
                     "to raise instead.")
+    if k.shape[1] != q.shape[1]:   # GQA: the einsum path needs full heads
+        from .pallas.flash_attention import _expand_kv
+        k, v = _expand_kv(k, v, q.shape[1])
     bias = None
     if window is not None:
         bias = band_bias(q.shape[2], k.shape[2], window, causal,
@@ -233,13 +246,11 @@ def multi_head_attention(query: ndarray, key: ndarray, value: ndarray,
         lk = kv.shape[1]
         hd = e // num_heads
         qh = qv.reshape(b, lq, num_heads, hd).transpose(0, 2, 1, 3)
+        # GQA: k/v stay at kvh heads — dot_product_attention streams them
+        # grouped through the flash kernel (no jnp.repeat HBM expansion;
+        # VERDICT r3 next-step #3); only the XLA fallback repeats
         kh = kv.reshape(b, lk, kvh, hd).transpose(0, 2, 1, 3)
         vh = vv.reshape(b, lk, kvh, hd).transpose(0, 2, 1, 3)
-        if kvh != num_heads:
-            # GQA: repeat each kv head across its query-head group
-            rep = num_heads // kvh
-            kh = jnp.repeat(kh, rep, axis=1)
-            vh = jnp.repeat(vh, rep, axis=1)
         if rope_theta is not None:
             if lq != lk:
                 raise MXNetError(

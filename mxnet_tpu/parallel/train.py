@@ -587,11 +587,15 @@ class _ObservedFuture(_cf.Future):
     def result(self, timeout=None):
         try:
             return super().result(timeout)
-        except BaseException:
+        except BaseException as e:
             # only the future's OWN error counts as retrieved — a wait
-            # timeout / interrupt raised while still pending must not
-            # swallow the real failure from the later drain backstop
-            if self.done():
+            # timeout / interrupt (even one racing the completion) must
+            # not swallow the real failure from the later drain backstop
+            try:
+                own = super().exception(timeout=0) if self.done() else None
+            except BaseException:
+                own = None
+            if own is not None and e is own:
                 self.error_retrieved = True
             raise
 
@@ -600,6 +604,12 @@ class _ObservedFuture(_cf.Future):
         if e is not None:
             self.error_retrieved = True
         return e
+
+    def cancel(self):
+        # the background write is not cancellable: a True here would let
+        # _relay's set_result/set_exception raise InvalidStateError and
+        # lose the write's real outcome
+        return False
 
 
 _CKPT_POOL = None

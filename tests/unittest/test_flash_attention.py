@@ -341,3 +341,169 @@ def test_flash_sliding_window_fallback_bias_alignment():
                               bias=band_bias(l, l, w, False, True))
     onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
                                 rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# grouped-query attention (GQA/MQA): K/V at g < H heads, never expanded
+# (VERDICT r3 next-step #3 — the kernel folds the query-head group onto
+# the row axis instead of jnp.repeat-ing K/V to H heads in HBM)
+# ---------------------------------------------------------------------------
+
+def _gqa_ref(q, k, v, rep, **kw):
+    """Repeat-based reference: expand K/V to full heads, plain attention."""
+    return reference_attention(q, jnp.repeat(k, rep, axis=1),
+                               jnp.repeat(v, rep, axis=1), **kw)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("h,g", [(4, 2), (4, 1), (6, 3)])
+def test_flash_gqa_forward_matches_repeat_reference(causal, h, g):
+    b, lq, lk, d = 2, 64, 64, 16
+    q = _rand((b, h, lq, d), seed=21)
+    k = _rand((b, g, lk, d), seed=22)
+    v = _rand((b, g, lk, d), seed=23)
+    # block_q=16 < lq -> n_seg=4: the folded-row position wrap is exercised
+    out = flash_attention(q, k, v, causal=causal, block_q=16, block_k=32)
+    ref = _gqa_ref(q, k, v, h // g, causal=causal)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-5, atol=2e-5)
+
+
+def test_flash_gqa_backward_matches_repeat_reference():
+    """dk/dv must accumulate across the query-head group (the dkv kernel
+    sums all folded q rows); dq must match the plain per-head gradient."""
+    b, h, g, l, d = 2, 4, 2, 64, 16
+    q = _rand((b, h, l, d), seed=24)
+    k = _rand((b, g, l, d), seed=25)
+    v = _rand((b, g, l, d), seed=26)
+
+    def f_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       block_q=16, block_k=16) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(_gqa_ref(q, k, v, h // g, causal=True) ** 2)
+
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_flash, g_ref):
+        assert a.shape == b_.shape
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b_),
+                                    rtol=2e-4, atol=2e-4)
+
+
+def test_flash_gqa_padding_mask_and_window():
+    """Compact (B, Lk) key-padding bias and the sliding-window band both
+    key on POSITION — under GQA folding the row index wraps per segment."""
+    b, h, g, l, d, w = 2, 4, 2, 64, 16, 8
+    q = _rand((b, h, l, d), seed=27)
+    k = _rand((b, g, l, d), seed=28)
+    v = _rand((b, g, l, d), seed=29)
+    vl = onp.asarray([48, 64])
+    keep = (onp.arange(l)[None, :] < vl[:, None])
+    bias = jnp.where(jnp.asarray(keep), 0.0, -1e30).astype(jnp.float32)
+
+    from mxnet_tpu.ops.attention import band_bias
+    out = flash_attention(q, k, v, bias=bias, window=w,
+                          block_q=16, block_k=16)
+    ref = _gqa_ref(q, k, v, h // g,
+                   mask=jnp.asarray(keep)[:, None, None],
+                   bias=band_bias(l, l, w, False, True))
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-5, atol=2e-5)
+
+
+def test_flash_gqa_per_row_bias():
+    """(B, Lq, Lk) biases stream blockwise; the row-block index must wrap
+    by segment under folding (bias stays at positional Lq rows)."""
+    b, h, g, lq, lk, d = 2, 4, 2, 32, 64, 16
+    q = _rand((b, h, lq, d), seed=30)
+    k = _rand((b, g, lk, d), seed=31)
+    v = _rand((b, g, lk, d), seed=32)
+    rng = onp.random.RandomState(33)
+    bias = jnp.asarray(
+        onp.where(rng.rand(b, lq, lk) < 0.2, -1e30, 0.0), jnp.float32)
+    out = flash_attention(q, k, v, bias=bias, block_q=16, block_k=16)
+    ref = _gqa_ref(q, k, v, h // g, bias=bias[:, None])
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-5, atol=2e-5)
+
+
+def test_flash_gqa_never_materialises_full_head_kv():
+    """The whole point: no intermediate in the traced computation carries
+    K/V expanded to H heads (shape (B, H, Lk, D) or (B*H, Lk, D))."""
+    b, h, g, lq, lk, d = 2, 4, 2, 32, 64, 16
+    q = _rand((b, h, lq, d), seed=34)
+    k = _rand((b, g, lk, d), seed=35)
+    v = _rand((b, g, lk, d), seed=36)
+
+    def subjaxprs(eqn):
+        vals = []
+        for v in eqn.params.values():
+            vals.extend(v if isinstance(v, (list, tuple)) else [v])
+        for v in vals:
+            if isinstance(v, jax.extend.core.ClosedJaxpr):
+                yield v.jaxpr
+            elif isinstance(v, jax.extend.core.Jaxpr):
+                yield v
+
+    def walk(jaxpr, seen):
+        for eqn in jaxpr.eqns:
+            for var in eqn.outvars:
+                shape = getattr(getattr(var, "aval", None), "shape", ())
+                seen.add(tuple(shape))
+            for sub in subjaxprs(eqn):
+                walk(sub, seen)
+        return seen
+
+    def fwd_bwd(q, k, v):
+        def loss(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True,
+                                           block_q=16, block_k=16) ** 2)
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    jaxpr = jax.make_jaxpr(fwd_bwd)(q, k, v)
+    shapes = set()
+    for j in [jaxpr.jaxpr]:
+        walk(j, shapes)
+    # the walk must actually reach the folded kernel call — the folded q
+    # shape proves the sub-jaxpr recursion isn't silently skipping levels
+    rep = h // g
+    assert (b, g, rep * lq, d) in shapes, "jaxpr walk missed the fold"
+    forbidden = {(b, h, lk, d), (b * h, lk, d)}
+    assert not (shapes & forbidden), (
+        f"full-head K/V materialised: {shapes & forbidden}")
+
+
+def test_flash_gqa_rejects_bad_head_ratio():
+    q = _rand((1, 4, 32, 16), seed=37)
+    k = _rand((1, 3, 32, 16), seed=38)
+    with pytest.raises(ValueError, match="multiple of kv heads"):
+        flash_attention(q, k, k)
+
+
+def test_flash_gqa_per_head_bias_expands_and_stays_on_flash():
+    """GQA + a per-head (B, H, Lq, Lk) bias: no per-kv-head fold exists, so
+    the kernel expands K/V for this case — but must NOT error or leave the
+    flash path (pre-GQA behavior preserved)."""
+    b, h, g, l, d = 2, 4, 2, 64, 16
+    q = _rand((b, h, l, d), seed=40)
+    k = _rand((b, g, l, d), seed=41)
+    v = _rand((b, g, l, d), seed=42)
+    rng = onp.random.RandomState(43)
+    bias = jnp.asarray(
+        onp.where(rng.rand(b, h, l, l) < 0.2, -1e30, 0.0), jnp.float32)
+    out = flash_attention(q, k, v, bias=bias, block_q=16, block_k=16)
+    ref = _gqa_ref(q, k, v, h // g, bias=bias)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-5, atol=2e-5)
+
+
+def test_dot_product_attention_gqa_fallback_validates_heads():
+    """The XLA fallback path must give the clear divisibility error, not an
+    obscure einsum shape failure after a silent floor-division repeat."""
+    from mxnet_tpu.ops.attention import dot_product_attention
+    q = _rand((1, 4, 16, 8), seed=44)
+    k = _rand((1, 3, 16, 8), seed=45)
+    with pytest.raises(ValueError, match="multiple of kv heads"):
+        dot_product_attention(q, k, k, use_flash=False)

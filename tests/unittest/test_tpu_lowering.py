@@ -121,3 +121,30 @@ def test_flash_kernel_sliding_window_lowers_for_tpu():
 
     txt = _lower_for_tpu(train, q, q, q)
     assert txt.count("tpu_custom_call") == 3   # fwd + dq + dkv
+
+
+def test_flash_gqa_grouped_kernel_lowers_for_tpu():
+    """Grouped-KV (GQA) kernel mode: q folded to (B, g, rep*Lq, D), K/V
+    streamed at g heads. Pins that the folded kernels (position-wrapped
+    causal mask, per-segment row indexing) survive Mosaic lowering AND
+    that no full-head K/V expansion appears in the lowered module."""
+    b, h, g, l, d = 2, 8, 2, 512, 64
+    q = jnp.ones((b, h, l, d), jnp.bfloat16)
+    kv = jnp.ones((b, g, l, d), jnp.bfloat16)
+
+    def fwd(q, k, v):
+        return flash_attention(q, k, v, causal=True)
+
+    txt = _lower_for_tpu(fwd, q, kv, kv)
+    assert txt.count("tpu_custom_call") == 1
+    # K/V at full heads would show up as a (b*h)xLxD = 16x512x64 tensor
+    assert f"tensor<{b * h}x{l}x{d}xbf16" not in txt
+
+    def train(q, k, v):
+        def loss(q, k, v):
+            return jnp.sum(fwd(q, k, v).astype(jnp.float32) ** 2)
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    txt = _lower_for_tpu(train, q, kv, kv)
+    assert txt.count("tpu_custom_call") == 3   # fwd + dq + dkv
+    assert f"tensor<{b * h}x{l}x{d}xbf16" not in txt
