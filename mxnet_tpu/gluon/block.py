@@ -152,26 +152,57 @@ class Block:
         return self
 
     # -- persistence ---------------------------------------------------------
-    def save_parameters(self, filename: str, deduplicate: bool = False):
-        """Parity: `gluon/block.py:340` (NDArray-dict format → .npz here)."""
+    def save_parameters(self, filename: str, deduplicate: bool = False,
+                        format: str = "npz"):
+        """Parity: `gluon/block.py:340`.  `format="npz"` (default) is this
+        framework's native container; `format="params"` writes the
+        reference's binary NDArray-dict (`src/ndarray/ndarray.cc`
+        NDArray::Save) so checkpoints interchange with stock MXNet."""
         arrays = {}
         for name, p in self.collect_params().items():
             if p._data is not None:
                 arrays[name] = p.data()
-        save_arrays(filename, arrays)
+        if format == "params":
+            from ..ndarray import save as _nd_save
+            _nd_save(filename, arrays)
+        elif format == "npz":
+            save_arrays(filename, arrays)
+        else:
+            raise MXNetError(f"unknown save format {format!r} "
+                             "(use 'npz' or 'params')")
 
     def load_parameters(self, filename: str, device=None, ctx=None,
                         allow_missing=False, ignore_extra=False,
                         cast_dtype=False, dtype_source="current"):
-        """Parity: `gluon/block.py:379`."""
-        loaded = load_arrays(filename)
+        """Parity: `gluon/block.py:379`.
+
+        Accepts BOTH this framework's `.npz` saves and the reference's
+        binary `.params` files (sniffed by magic, like the reference's own
+        dual npz/binary load path) — including Module-era files whose
+        names carry ``arg:``/``aux:`` prefixes (stripped, matching
+        `gluon/block.py:466` load_dict).  `cast_dtype` casts loaded values
+        to each Parameter's current dtype (`dtype_source="current"`) or
+        re-types the Parameter to the file's dtype (`"saved"`)."""
+        if dtype_source not in ("current", "saved"):
+            raise MXNetError(f"dtype_source must be 'current' or 'saved', "
+                             f"got {dtype_source!r}")
+        from ..ndarray import load as _nd_load
+        loaded = _nd_load(filename)
+        if isinstance(loaded, list):
+            raise MXNetError(f"{filename} holds a name-less array list, "
+                             "not a parameter dict")
+        loaded = {(k[4:] if k.startswith(("arg:", "aux:")) else k): v
+                  for k, v in loaded.items()}
         params = self.collect_params()
         for name, p in params.items():
             if name not in loaded:
                 if not allow_missing:
                     raise MXNetError(f"parameter {name} missing in {filename}")
                 continue
-            p.set_data(loaded[name])
+            v = loaded[name]
+            if cast_dtype and dtype_source == "saved":
+                p.cast(v.dtype)   # set_data then keeps the file's dtype
+            p.set_data(v)         # set_data casts to the param dtype
         if not ignore_extra:
             extra = set(loaded) - set(params)
             if extra:
@@ -573,8 +604,11 @@ class HybridBlock(Block):
             pvals, *[l._data for l in leaves])
         with open(f"{path}-symbol.stablehlo", "wb") as f:
             f.write(exp.serialize())
-        save_arrays(f"{path}-{epoch:04d}.params",
-                    {n: p.data() for n, p in params.items()})
+        # reference on-disk .params layout (binary NDArray dict) so the
+        # exported pair interchanges with stock-MXNet tooling
+        from ..ndarray import save as _nd_save
+        _nd_save(f"{path}-{epoch:04d}.params",
+                 {n: p.data() for n, p in params.items()})
         return f"{path}-symbol.stablehlo", f"{path}-{epoch:04d}.params"
 
     def infer_shape(self, *args):
@@ -612,7 +646,14 @@ class SymbolBlock(HybridBlock):
         import jax.export as jexport
         with open(symbol_file, "rb") as f:
             exported = jexport.deserialize(f.read())
-        params = load_arrays(param_file) if param_file else {}
+        if param_file:
+            from ..ndarray import load as _nd_load  # binary or npz
+            params = _nd_load(param_file)
+            if isinstance(params, list):
+                raise MXNetError(f"{param_file} holds a name-less array "
+                                 "list, not a parameter dict")
+        else:
+            params = {}
         return SymbolBlock(exported, params)
 
     def forward(self, *args):

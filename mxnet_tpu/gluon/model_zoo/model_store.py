@@ -1,0 +1,56 @@
+"""Local model-zoo weight store (parity:
+`python/mxnet/gluon/model_zoo/model_store.py`).
+
+The reference downloads `{name}-{short_hash}.params` into
+`$MXNET_HOME/models`; this environment has zero egress, so the store is
+LOCAL-ONLY: `get_model_file` finds a weights file already placed in
+`root` (default `$MXNET_HOME/models` or `~/.mxnet/models`) and the
+`pretrained=True` factories load it.  Stock-MXNet zoo files load
+directly — the binary `.params` reader
+(`ndarray/legacy_serialization.py`) handles their format.
+
+Accepted filenames for model `name`, in order: `{name}.params` (a user's
+own save — an explicit override wins), then the first sorted
+`{name}-{anything}.params` match (the reference's hash-stamped layout,
+e.g. `resnet50_v1-0aee57f9.params`).
+"""
+from __future__ import annotations
+
+import glob
+import os
+
+from ...base import MXNetError
+
+__all__ = ["get_model_file", "load_pretrained"]
+
+
+def _default_root() -> str:
+    home = os.environ.get("MXNET_HOME")
+    if home:
+        return os.path.join(home, "models")
+    return os.path.join(os.path.expanduser("~"), ".mxnet", "models")
+
+
+def get_model_file(name: str, root: str | None = None) -> str:
+    """Path of the local weights file for `name`; raises with download
+    instructions when absent (no network egress here)."""
+    root = os.path.expanduser(root or _default_root())
+    exact = os.path.join(root, f"{name}.params")
+    if os.path.isfile(exact):
+        return exact
+    stamped = sorted(glob.glob(os.path.join(root, f"{name}-*.params")))
+    if stamped:
+        return stamped[0]
+    raise MXNetError(
+        f"no local weights for model {name!r}: looked for "
+        f"'{name}.params' or '{name}-*.params' under {root}. This "
+        "environment cannot download; place a stock-MXNet zoo file "
+        "(binary .params) or a save_parameters output there, or pass "
+        "root=<dir>.")
+
+
+def load_pretrained(net, pretrained: bool, name: str, root=None):
+    """Factory tail-call: load zoo weights into `net` when `pretrained`."""
+    if pretrained:
+        net.load_parameters(get_model_file(name, root), cast_dtype=True)
+    return net

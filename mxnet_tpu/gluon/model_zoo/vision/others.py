@@ -3,7 +3,6 @@
 mobilenet,densenet,inception}.py`)."""
 from __future__ import annotations
 
-from ....base import MXNetError
 from ... import nn
 from ...block import HybridBlock
 from .... import numpy as _np
@@ -40,9 +39,9 @@ class AlexNet(HybridBlock):
         return self.output(self.features(x))
 
 
-def alexnet(pretrained=False, **kwargs):
-    _no_pretrained(pretrained)
-    return AlexNet(**_model_kwargs(kwargs))
+def alexnet(pretrained=False, root=None, **kwargs):
+    return _pretrained(AlexNet(**_model_kwargs(kwargs)),
+                       pretrained, "alexnet", root)
 
 
 class VGG(HybridBlock):
@@ -76,11 +75,13 @@ _vgg_spec = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
              19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512])}
 
 
-def _vgg(num_layers, batch_norm=False, pretrained=False, **kwargs):
-    _no_pretrained(pretrained)
+def _vgg(num_layers, batch_norm=False, pretrained=False, root=None,
+         **kwargs):
     layers, filters = _vgg_spec[num_layers]
-    return VGG(layers, filters, batch_norm=batch_norm,
-               **_model_kwargs(kwargs))
+    net = VGG(layers, filters, batch_norm=batch_norm,
+              **_model_kwargs(kwargs))
+    name = f"vgg{num_layers}" + ("_bn" if batch_norm else "")
+    return _pretrained(net, pretrained, name, root)
 
 
 def vgg11(**kw):
@@ -170,14 +171,14 @@ class SqueezeNet(HybridBlock):
         return self.output(self.features(x))
 
 
-def squeezenet1_0(pretrained=False, **kw):
-    _no_pretrained(pretrained)
-    return SqueezeNet("1.0", **_model_kwargs(kw))
+def squeezenet1_0(pretrained=False, root=None, **kw):
+    return _pretrained(SqueezeNet("1.0", **_model_kwargs(kw)),
+                       pretrained, "squeezenet1.0", root)
 
 
-def squeezenet1_1(pretrained=False, **kw):
-    _no_pretrained(pretrained)
-    return SqueezeNet("1.1", **_model_kwargs(kw))
+def squeezenet1_1(pretrained=False, root=None, **kw):
+    return _pretrained(SqueezeNet("1.1", **_model_kwargs(kw)),
+                       pretrained, "squeezenet1.1", root)
 
 
 def _conv_block(channels, kernel=1, stride=1, pad=0, num_group=1):
@@ -254,14 +255,14 @@ class MobileNetV2(HybridBlock):
         return self.output(self.features(x))
 
 
-def _mobilenet(mult, pretrained=False, **kw):
-    _no_pretrained(pretrained)
-    return MobileNet(mult, **_model_kwargs(kw))
+def _mobilenet(mult, pretrained=False, root=None, **kw):
+    return _pretrained(MobileNet(mult, **_model_kwargs(kw)),
+                       pretrained, f"mobilenet{mult}", root)
 
 
-def _mobilenet_v2(mult, pretrained=False, **kw):
-    _no_pretrained(pretrained)
-    return MobileNetV2(mult, **_model_kwargs(kw))
+def _mobilenet_v2(mult, pretrained=False, root=None, **kw):
+    return _pretrained(MobileNetV2(mult, **_model_kwargs(kw)),
+                       pretrained, f"mobilenetv2_{mult}", root)
 
 
 def mobilenet1_0(**kw):
@@ -361,10 +362,10 @@ _densenet_spec = {121: (64, 32, [6, 12, 24, 16]),
                   201: (64, 32, [6, 12, 48, 32])}
 
 
-def _densenet(num_layers, pretrained=False, **kw):
-    _no_pretrained(pretrained)
+def _densenet(num_layers, pretrained=False, root=None, **kw):
     init_f, growth, cfg = _densenet_spec[num_layers]
-    return DenseNet(init_f, growth, cfg, **_model_kwargs(kw))
+    return _pretrained(DenseNet(init_f, growth, cfg, **_model_kwargs(kw)),
+                       pretrained, f"densenet{num_layers}", root)
 
 
 def densenet121(**kw):
@@ -518,15 +519,16 @@ class Inception3(HybridBlock):
         return self.output(self.features(x))
 
 
-def inception_v3(pretrained=False, **kw):
-    _no_pretrained(pretrained)
-    return Inception3(**_model_kwargs(kw))
+def inception_v3(pretrained=False, root=None, **kw):
+    return _pretrained(Inception3(**_model_kwargs(kw)),
+                       pretrained, "inceptionv3", root)
 
 
-def _no_pretrained(pretrained):
-    if pretrained:
-        raise MXNetError("pretrained weights are unavailable offline; "
-                         "use load_parameters with a local file")
+def _pretrained(net, pretrained, name, root):
+    """Load zoo weights from the LOCAL store when pretrained=True
+    (model_store.py — reference names, binary .params format)."""
+    from ..model_store import load_pretrained
+    return load_pretrained(net, pretrained, name, root)
 
 
 def _model_kwargs(kw):

@@ -224,16 +224,19 @@ _resnet_block_versions = [
 ]
 
 
-def get_resnet(version, num_layers, pretrained=False, device=None, **kwargs):
+def get_resnet(version, num_layers, pretrained=False, device=None,
+               root=None, **kwargs):
     if num_layers not in _resnet_spec:
         raise MXNetError(f"invalid resnet depth {num_layers}")
-    if pretrained:
-        raise MXNetError("pretrained weights are unavailable offline; "
-                         "use load_parameters with a local file")
     block_type, layers, channels = _resnet_spec[num_layers]
     resnet_class = _resnet_net_versions[version - 1]
     block_class = _resnet_block_versions[version - 1][block_type]
-    return resnet_class(block_class, layers, channels, **kwargs)
+    net = resnet_class(block_class, layers, channels, **kwargs)
+    if pretrained:
+        # local-only zoo store; stock files load via the binary reader
+        from ..model_store import load_pretrained
+        load_pretrained(net, True, f"resnet{num_layers}_v{version}", root)
+    return net
 
 
 def resnet18_v1(**kwargs):

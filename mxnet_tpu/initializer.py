@@ -250,8 +250,11 @@ class Load(Initializer):
     def __init__(self, param, default_init=None, verbose=False):
         super().__init__()
         if isinstance(param, str):
-            from .util import load_arrays
-            param = load_arrays(param)
+            from .ndarray import load as _nd_load  # binary or npz, sniffed
+            param = _nd_load(param)
+            if isinstance(param, list):
+                raise MXNetError("init.Load needs a NAMED parameter file, "
+                                 "got a name-less array list")
         self.param = {}
         for name, arr in param.items():
             if name.startswith(("arg:", "aux:")):

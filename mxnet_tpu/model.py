@@ -5,8 +5,7 @@ from __future__ import annotations
 
 from collections import namedtuple
 
-from .base import MXNetError
-from .util import save_arrays, load_arrays
+from .base import MXNetError  # noqa: F401  (re-exported surface)
 
 __all__ = ["BatchEndParam", "save_checkpoint", "load_params",
            "load_checkpoint"]
@@ -51,12 +50,21 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
         out["arg:" + k] = v
     for k, v in (aux_params or {}).items():
         out["aux:" + k] = v
-    save_arrays(f"{prefix}-{epoch:04d}.params", out)
+    # reference on-disk format: binary NDArray dict — these checkpoints
+    # interchange with stock MXNet (ndarray/legacy_serialization.py)
+    from .ndarray import save as _nd_save
+    _nd_save(f"{prefix}-{epoch:04d}.params", out)
 
 
 def load_params(prefix, epoch):
-    """Returns (arg_params, aux_params) from `prefix-<epoch>.params`."""
-    raw = load_arrays(f"{prefix}-{epoch:04d}.params")
+    """Returns (arg_params, aux_params) from `prefix-<epoch>.params`
+    (either the reference binary format or this framework's npz —
+    sniffed by magic)."""
+    from .ndarray import load as _nd_load
+    raw = _nd_load(f"{prefix}-{epoch:04d}.params")
+    if isinstance(raw, list):
+        raise MXNetError(f"{prefix}-{epoch:04d}.params holds a name-less "
+                         "array list, not a parameter dict")
     arg, aux = {}, {}
     for k, v in raw.items():
         if k.startswith("arg:"):

@@ -17,26 +17,42 @@ def waitall():
 
 
 def save(fname, data):
-    """Save an NDArray / list / dict of NDArrays to `fname` (parity:
-    `python/mxnet/ndarray/utils.py` `save`; format is `.npz`-based here —
-    `src/serialization/cnpy.cc` is the reference's own npz path)."""
-    from ..util import save_arrays
-    save_arrays(fname, data)
+    """Save an NDArray / list / dict of NDArrays to `fname` in the
+    reference's BINARY NDArray-dict format (parity:
+    `python/mxnet/ndarray/utils.py` `save` → `src/ndarray/ndarray.cc`
+    NDArray::Save) — files written here load in stock MXNet and vice
+    versa. Lists save name-less (the native list form; no arr_N
+    encoding needed)."""
+    from .ndarray import ndarray as _nd
+    from .legacy_serialization import save_legacy_ndarray_dict
+    if isinstance(data, _nd):
+        data = [data]
+    if isinstance(data, dict):
+        data = {k: (v.asnumpy() if isinstance(v, _nd) else v)
+                for k, v in data.items()}
+    else:
+        data = [v.asnumpy() if isinstance(v, _nd) else v for v in data]
+    save_legacy_ndarray_dict(fname, data)
 
 
 def load(fname):
-    """Load arrays saved by `save` -> dict (or list if keys are arr_N)
+    """Load `fname` -> dict of NDArrays (or list for name-less saves)
     (parity: `python/mxnet/ndarray/utils.py` `load`).
 
-    Name-less saves (lists) are stored under ``arr_0..arr_{n-1}``, so a
-    dict saved with EXACTLY those contiguous keys loads back as a list —
-    the same list-vs-dict ambiguity the reference's name-less binary
-    format has. Use any other key naming to guarantee dict round-trip."""
+    Reads BOTH formats: the reference's binary NDArray file (sniffed by
+    its 0x112 magic) and this framework's `.npz` (where a dict saved with
+    exactly arr_0..arr_{n-1} keys loads back as a list — the npz list
+    encoding)."""
+    from ..numpy import array
+    from .legacy_serialization import (is_legacy_ndarray_file,
+                                       load_legacy_ndarray_dict)
+    if is_legacy_ndarray_file(fname):
+        out = load_legacy_ndarray_dict(fname)
+        if isinstance(out, list):
+            return [array(a) for a in out]
+        return {k: array(a) for k, a in out.items()}
     from ..util import load_arrays
     out = load_arrays(fname)
-    # lists round-trip as exactly arr_0..arr_{n-1} (the save() encoding);
-    # anything else — including a dict that merely uses arr_-style keys
-    # non-contiguously — stays a dict
     if out and set(out) == {f"arr_{i}" for i in range(len(out))}:
         return [out[f"arr_{i}"] for i in range(len(out))]
     return out

@@ -1,0 +1,256 @@
+"""Reference-format binary NDArray-dict serialization (`.params` files).
+
+Byte-compatible reader/writer for the reference's `NDArray::Save/Load`
+stream layout (`src/ndarray/ndarray.cc:1865-2150`), so real MXNet
+checkpoints — gluon `save_parameters` output, Module `.params` files, the
+pretrained model zoo (`python/mxnet/gluon/model_zoo/model_store.py`) —
+migrate directly into this framework, and files written here load back
+into stock MXNet.
+
+Layout (little-endian, all structured by `dmlc::Stream`):
+
+    uint64  0x112                 list magic  (kMXAPINDArrayListMagic)
+    uint64  0                     reserved
+    uint64  N                     number of arrays
+    N x NDArray records:
+        uint32  magic             0xF993faca (V3/np) | 0xF993fac9 (V2)
+                                  | 0xF993fac8 (V1) | legacy: ndim itself
+        [V2/V3] int32 stype       0 dense, 1 row_sparse, 2 csr
+        [sparse] storage_shape    int32 ndim + int64[ndim]
+        shape                     int32 ndim + int64[ndim]
+                                  (V3: ndim == -1 -> "none", record ends;
+                                   V2: ndim == 0  -> "none", record ends)
+        int32   dev_type, int32 dev_id        (context; always cpu here)
+        int32   type_flag         mshadow dtype enum (see _DTYPES)
+        [sparse] per aux: int32 aux_type + aux shape (int32 + int64[ndim])
+        raw data                  prod(storage_shape|shape) * sizeof(dtype)
+        [sparse] per aux: raw aux data
+    uint64  K                     number of names (0 for list saves, else N)
+    K x { uint64 len, bytes }     UTF-8 names
+
+Sparse records (row_sparse/csr) are DENSIFIED on load — this framework's
+compute path is dense+XLA; the scoped `mx.nd.sparse` types cover sparse
+compute, and a checkpoint's sparse layout is a storage detail.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Sequence, Tuple, Union
+
+import numpy as onp
+
+from ..base import MXNetError
+
+__all__ = ["save_legacy_ndarray_dict", "load_legacy_ndarray_dict",
+           "is_legacy_ndarray_file", "LIST_MAGIC"]
+
+LIST_MAGIC = 0x112
+_V1 = 0xF993FAC8
+_V2 = 0xF993FAC9
+_V3 = 0xF993FACA
+
+# mshadow dtype enum (3rdparty/mshadow/mshadow/base.h:352-364)
+_DTYPES = {0: "float32", 1: "float64", 2: "float16", 3: "uint8",
+           4: "int32", 5: "int8", 6: "int64", 7: "bool", 8: "int16",
+           9: "uint16", 10: "uint32", 11: "uint64", 12: "bfloat16"}
+_FLAGS = {v: k for k, v in _DTYPES.items()}
+
+
+def _np_dtype(name):
+    if name == "bfloat16":
+        import ml_dtypes
+        return onp.dtype(ml_dtypes.bfloat16)
+    return onp.dtype(name)
+
+
+class _Reader:
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def take(self, n: int) -> bytes:
+        if self.pos + n > len(self.buf):
+            raise MXNetError("invalid NDArray file format: truncated "
+                             f"(wanted {n} bytes at offset {self.pos})")
+        out = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def u32(self) -> int:
+        return struct.unpack("<I", self.take(4))[0]
+
+    def i32(self) -> int:
+        return struct.unpack("<i", self.take(4))[0]
+
+    def u64(self) -> int:
+        return struct.unpack("<Q", self.take(8))[0]
+
+    def i64s(self, n: int) -> Tuple[int, ...]:
+        return struct.unpack(f"<{n}q", self.take(8 * n))
+
+    def u32s(self, n: int) -> Tuple[int, ...]:
+        return struct.unpack(f"<{n}I", self.take(4 * n))
+
+
+def _read_shape(r: _Reader):
+    """int32 ndim + int64[ndim] (TShape = Tuple<int64>, tuple.h:736-767);
+    ndim < 0 is the np-semantics 'unknown' marker."""
+    ndim = r.i32()
+    if ndim < 0:
+        return None
+    return tuple(r.i64s(ndim))
+
+
+def _read_array(r: _Reader) -> onp.ndarray:
+    magic = r.u32()
+    if magic in (_V2, _V3):
+        stype = r.i32()
+        nad = {0: 0, 1: 1, 2: 2}.get(stype)
+        if nad is None:
+            raise MXNetError(f"invalid NDArray file format: storage type "
+                             f"{stype}")
+        sshape = _read_shape(r) if nad else None
+        shape = _read_shape(r)
+        if shape is None or (magic == _V2 and shape == ()):
+            # "none" arrays serialize as shape-only records.  A V2 scalar
+            # is indistinguishable from V2-none by design (the reference
+            # has the same ambiguity: legacy ndim==0 means none)
+            return onp.zeros((0,), onp.float32)
+    elif magic == _V1:
+        stype, nad, sshape = 0, 0, None
+        shape = _read_shape(r)
+        if shape is None:
+            return onp.zeros((0,), onp.float32)
+    else:
+        # oldest layout: the magic word IS ndim, dims are uint32
+        stype, nad, sshape = 0, 0, None
+        if magic > 32:   # not a plausible rank
+            raise MXNetError(f"invalid NDArray file format: bad magic "
+                             f"0x{magic:x}")
+        shape = tuple(r.u32s(magic))
+    r.i32()  # dev_type — always loaded to cpu
+    r.i32()  # dev_id
+    flag = r.i32()
+    if flag not in _DTYPES:
+        raise MXNetError(f"invalid NDArray file format: dtype flag {flag}")
+    dt = _np_dtype(_DTYPES[flag])
+    aux = []
+    for _ in range(nad):
+        aflag = r.i32()
+        ashape = _read_shape(r)
+        aux.append((_np_dtype(_DTYPES[aflag]), ashape))
+    data_shape = sshape if nad else shape
+    n = 1
+    for s in data_shape:
+        n *= s
+    data = onp.frombuffer(r.take(n * dt.itemsize), dt).reshape(data_shape)
+    if nad == 0:
+        return data.copy()
+    aux_data = []
+    for adt, ashape in aux:
+        an = 1
+        for s in ashape:
+            an *= s
+        aux_data.append(
+            onp.frombuffer(r.take(an * adt.itemsize), adt).reshape(ashape))
+    dense = onp.zeros(shape, dt)
+    if stype == 1:                      # row_sparse: aux0 = row indices
+        idx = aux_data[0]
+        if len(idx):
+            dense[onp.asarray(idx, onp.int64)] = data
+    else:                               # csr: aux = (indptr, indices)
+        indptr, indices = aux_data
+        for row in range(shape[0]):
+            lo, hi = int(indptr[row]), int(indptr[row + 1])
+            if hi > lo:
+                dense[row, onp.asarray(indices[lo:hi], onp.int64)] = \
+                    data[lo:hi]
+    return dense
+
+
+def is_legacy_ndarray_file(fname: str) -> bool:
+    """True when `fname` starts with the binary list magic (0x112)."""
+    try:
+        with open(fname, "rb") as f:
+            head = f.read(8)
+    except OSError:
+        return False
+    return len(head) == 8 and struct.unpack("<Q", head)[0] == LIST_MAGIC
+
+
+def load_legacy_ndarray_dict(fname: str):
+    """Read a reference-format `.params`/NDArray file.
+
+    Returns a dict {name: numpy array} when the file carries names, else a
+    list of arrays (the reference's name-less `nd.save([a, b])` form).
+    bfloat16 payloads come back as ml_dtypes.bfloat16 numpy arrays.
+    """
+    with open(fname, "rb") as f:
+        r = _Reader(f.read())
+    if r.u64() != LIST_MAGIC:
+        raise MXNetError(f"{fname} is not a reference-format NDArray file "
+                         "(bad magic); use util.load_arrays for .npz")
+    r.u64()   # reserved
+    n = r.u64()
+    arrays = [_read_array(r) for _ in range(n)]
+    k = r.u64()
+    if k == 0:
+        return arrays
+    if k != n:
+        raise MXNetError("invalid NDArray file format: "
+                         f"{k} names for {n} arrays")
+    names = [r.take(r.u64()).decode("utf-8") for _ in range(k)]
+    return dict(zip(names, arrays))
+
+
+def _write_shape(out: List[bytes], shape: Sequence[int]):
+    out.append(struct.pack("<i", len(shape)))
+    out.append(struct.pack(f"<{len(shape)}q", *shape))
+
+
+def _write_array(out: List[bytes], arr: onp.ndarray, np_semantics: bool):
+    dtname = arr.dtype.name
+    if dtname not in _FLAGS:
+        raise MXNetError(f"dtype {arr.dtype} has no reference NDArray "
+                         "serialization flag")
+    if arr.ndim == 0 and not np_semantics:
+        # a V2 ndim-0 record IS the "none" marker — 1.x cannot represent
+        # scalars; writing one would silently load back empty
+        raise MXNetError("0-d arrays need np_semantics=True (the V2 "
+                         "format has no scalar representation)")
+    out.append(struct.pack("<I", _V3 if np_semantics else _V2))
+    out.append(struct.pack("<i", 0))          # dense storage
+    _write_shape(out, arr.shape)
+    out.append(struct.pack("<ii", 1, 0))      # context: cpu(0)
+    out.append(struct.pack("<i", _FLAGS[dtname]))
+    out.append(onp.ascontiguousarray(arr).tobytes())
+
+
+def save_legacy_ndarray_dict(
+        fname: str,
+        data: Union[Dict[str, onp.ndarray], Sequence[onp.ndarray]],
+        np_semantics: bool = True) -> None:
+    """Write `data` in the reference's binary NDArray-dict format.
+
+    `np_semantics=True` stamps V3 records (what 2.x `npx.save`/gluon
+    writes); False stamps V2 (loadable by 1.x without np scope). Dense
+    arrays only — matching the reference's own constraint for np-semantics
+    saves (`ndarray.cc:1866-1868`).
+    """
+    if isinstance(data, dict):
+        names = list(data)
+        arrays = [onp.asarray(data[k]) for k in names]
+    else:
+        names = []
+        arrays = [onp.asarray(a) for a in data]
+    out: List[bytes] = [struct.pack("<QQ", LIST_MAGIC, 0),
+                        struct.pack("<Q", len(arrays))]
+    for a in arrays:
+        _write_array(out, a, np_semantics)
+    out.append(struct.pack("<Q", len(names)))
+    for nm in names:
+        raw = nm.encode("utf-8")
+        out.append(struct.pack("<Q", len(raw)))
+        out.append(raw)
+    with open(fname, "wb") as f:
+        f.write(b"".join(out))

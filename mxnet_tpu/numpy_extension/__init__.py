@@ -1066,6 +1066,15 @@ def save(fname, data):
 
 
 def load(fname):
+    """Load `.npz` saves AND reference binary NDArray files (sniffed) —
+    `npx.load` in the reference likewise reads both its own and legacy
+    formats."""
+    from ..ndarray.legacy_serialization import is_legacy_ndarray_file
+    if is_legacy_ndarray_file(fname):
+        from ..ndarray import load as _nd_load
+        out = _nd_load(fname)
+        return out if isinstance(out, dict) else \
+            {f"arr_{i}": a for i, a in enumerate(out)}
     from ..util import load_arrays
     return load_arrays(fname)
 
