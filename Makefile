@@ -2,16 +2,22 @@
 # to what a single-language-core framework needs).
 PY ?= python
 
-.PHONY: ci test test-dist lint bench cpp docs clean
+.PHONY: ci test test-all test-dist lint bench cpp docs clean
 
 # the one-command gate CI runs (VERDICT round-2 next-step #7): lint +
 # unit suite + 2-process dist tests + C++ package build/tests
-ci: lint test test-dist cpp-test
+ci: lint test-all test-dist cpp-test
 
 cpp-test:
 	$(PY) -m pytest tests/unittest/test_cpp_package.py -q
 
+# fast default for local iteration (VERDICT r3 weak #5): skips the
+# slow-marked tests (example subprocesses, scaling/large-tensor
+# benches); `make test-all` runs everything
 test:
+	$(PY) -m pytest tests/unittest -q -m "not slow" --ignore=tests/unittest/test_dist_kvstore.py
+
+test-all:
 	$(PY) -m pytest tests/unittest -q --ignore=tests/unittest/test_dist_kvstore.py
 
 test-dist:

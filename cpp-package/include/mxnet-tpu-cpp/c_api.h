@@ -199,6 +199,88 @@ int MXTPUProfilerStop(void);
  * profiler.dumps(reset=...) — default there is a non-destructive read). */
 int MXTPUProfilerDumps(const char** out, int reset);
 
+/* --- Symbol construction (parity: MXSymbolCreateVariable,
+ * --- MXSymbolCreateAtomicSymbol + MXSymbolCompose,
+ * --- MXSymbolCreateFromFile/FromJSON, MXSymbolSaveToJSON,
+ * --- MXSymbolListArguments/ListOutputs — `include/mxnet/c_api.h`
+ * --- MXSymbol* family) ------------------------------------------------ */
+
+typedef void* MXTPUSymbolHandle;
+
+/* A free variable (input/parameter placeholder). */
+int MXTPUSymbolCreateVariable(const char* name, MXTPUSymbolHandle* out);
+
+/* One op applied to input symbols (atomic-symbol + compose in one step;
+ * the graph is functional, so there is no separate mutation phase).
+ * `kwargs_json` holds the op attrs; `name` may be NULL (auto-named). */
+int MXTPUSymbolCreateFromOp(const char* op, const char* name,
+                            MXTPUSymbolHandle* inputs, int n_in,
+                            const char* kwargs_json, MXTPUSymbolHandle* out);
+
+/* Load / parse the exported symbol-JSON graph. */
+int MXTPUSymbolLoad(const char* path, MXTPUSymbolHandle* out);
+int MXTPUSymbolLoadJSON(const char* json, MXTPUSymbolHandle* out);
+
+/* Serialize to JSON (pointer valid until the next MXTPU* call). */
+int MXTPUSymbolSaveJSON(MXTPUSymbolHandle sym, const char** out);
+
+/* Argument/output names. On entry *n is the capacity of `name_buf`; on
+ * exit the count. Pointers valid until the next MXTPU* call. */
+int MXTPUSymbolListArguments(MXTPUSymbolHandle sym, const char** name_buf,
+                             int* n);
+int MXTPUSymbolListOutputs(MXTPUSymbolHandle sym, const char** name_buf,
+                           int* n);
+
+/* Bind `arg_names[i] = arg_vals[i]` and evaluate (Executor bind+forward).
+ * On entry *n_out is the capacity of `outputs`; on exit the count. */
+int MXTPUSymbolEval(MXTPUSymbolHandle sym, const char** arg_names,
+                    MXTPUNDArrayHandle* arg_vals, int n_args,
+                    MXTPUNDArrayHandle* outputs, int* n_out);
+
+int MXTPUSymbolFree(MXTPUSymbolHandle sym);
+
+/* --- Model (CachedOp) flags (parity: MXCreateCachedOpEx's flag pairs —
+ * --- static_alloc/static_shape — and Block train/predict mode).
+ * --- `flags_json` e.g. {"training": true, "hybridize": true}.
+ * --- static_alloc/static_shape are always true on XLA (accepted for
+ * --- parity; disabling them errors). ---------------------------------- */
+int MXTPUModelSetFlags(MXTPUModelHandle model, const char* flags_json);
+int MXTPUModelGetFlags(MXTPUModelHandle model, const char** out_json);
+
+/* --- Data iterators (parity: MXListDataIters, MXDataIterCreateIter,
+ * --- MXDataIterNext/BeforeFirst, MXDataIterGetData/GetLabel,
+ * --- MXDataIterFree — `include/mxnet/c_api.h` MXDataIter* family) ----- */
+
+typedef void* MXTPUDataIterHandle;
+
+/* Comma-separated iterator type names (MNISTIter, ImageRecordIter,
+ * CSVIter, LibSVMIter, NDArrayIter). Pointer valid until the next call. */
+int MXTPUListDataIters(const char** out, int* n);
+
+/* Create by type name with JSON params (the reference's key/value pairs),
+ * e.g. MNISTIter: {"batch_size": 32, "shuffle": true} or CSVIter:
+ * {"data_csv": "x.csv", "data_shape": [3], "batch_size": 4}. */
+int MXTPUDataIterCreate(const char* type, const char* params_json,
+                        MXTPUDataIterHandle* out);
+
+/* In-memory iterator over existing arrays (NDArrayIter; label may be
+ * NULL). */
+int MXTPUDataIterCreateFromArrays(MXTPUNDArrayHandle data,
+                                  MXTPUNDArrayHandle label, int batch_size,
+                                  int shuffle, MXTPUDataIterHandle* out);
+
+/* Advance; *more = 1 while a batch is available, 0 at epoch end. */
+int MXTPUDataIterNext(MXTPUDataIterHandle it, int* more);
+
+/* Rewind to the epoch start (MXDataIterBeforeFirst). */
+int MXTPUDataIterReset(MXTPUDataIterHandle it);
+
+/* Current batch's data/label (new handles; caller frees). */
+int MXTPUDataIterGetData(MXTPUDataIterHandle it, MXTPUNDArrayHandle* out);
+int MXTPUDataIterGetLabel(MXTPUDataIterHandle it, MXTPUNDArrayHandle* out);
+
+int MXTPUDataIterFree(MXTPUDataIterHandle it);
+
 #ifdef __cplusplus
 }  /* extern "C" */
 #endif

@@ -60,10 +60,6 @@ def model_load(symbol_file, params_file):
     from mxnet_tpu.gluon.block import SymbolBlock
     return SymbolBlock.imports(symbol_file, param_file=params_file or None)
 
-def model_forward(model, inputs):
-    out = model(*inputs)
-    return out if isinstance(out, tuple) else (out,)
-
 def seed(s):
     mx.random.seed(s)
 
@@ -247,6 +243,139 @@ def profiler_stop():
 
 def profiler_dumps(reset):
     return mx.profiler.dumps(reset=bool(reset))
+
+# --- symbol construction (parity: MXSymbolCreateVariable,
+# --- MXSymbolCreateAtomicSymbol+Compose, MXSymbolCreateFromFile/JSON,
+# --- MXSymbolSaveToJSON, MXSymbolListArguments/Outputs, MXSymbolFree —
+# --- `include/mxnet/c_api.h` MXSymbol* family) --------------------------
+
+def sym_variable(name):
+    from mxnet_tpu import symbol
+    return symbol.var(name)
+
+def sym_from_op(op, name, inputs, kwargs_json):
+    from mxnet_tpu.symbol.symbol import _resolve_op, Symbol
+    kwargs = json.loads(kwargs_json) if kwargs_json else {}
+    if _resolve_op(op) is None:   # fail now on unknown ops, not at eval
+        raise ValueError(f'unknown symbol op {op!r}')
+    return Symbol._node(op, list(inputs), kwargs, name or None)
+
+def sym_load(path):
+    from mxnet_tpu import symbol
+    return symbol.load(path)
+
+def sym_load_json(js):
+    from mxnet_tpu.symbol.symbol import fromjson
+    return fromjson(js)
+
+def sym_to_json(sym):
+    return sym.tojson()
+
+def sym_list_arguments(sym):
+    return list(sym.list_arguments())
+
+def sym_list_outputs(sym):
+    return list(sym.list_outputs())
+
+def sym_eval(sym, names, vals):
+    out = sym.eval(**{n: v for n, v in zip(names, vals)})
+    return out if isinstance(out, (tuple, list)) else (out,)
+
+# --- model (CachedOp) flags (parity: MXCreateCachedOpEx flag pairs —
+# --- static_alloc/static_shape/data_indices — `include/mxnet/c_api.h`;
+# --- here flags configure the jit cache + forward mode) -----------------
+
+_KNOWN_FLAGS = {'training', 'hybridize', 'static_alloc', 'static_shape'}
+
+def model_set_flags(model, flags_json):
+    flags = json.loads(flags_json)
+    unknown = set(flags) - _KNOWN_FLAGS
+    if unknown:
+        raise ValueError(f'unknown model flags {sorted(unknown)}; '
+                         f'known: {sorted(_KNOWN_FLAGS)}')
+    cur = dict(getattr(model, '_capi_flags', None) or {
+        'training': False, 'hybridize': True,
+        # XLA compiles statically always — accepted for parity, fixed True
+        'static_alloc': True, 'static_shape': True})
+    cur.update({k: bool(v) for k, v in flags.items()})
+    # validate on the COPY: a rejected call must not corrupt stored state
+    if not cur['static_alloc'] or not cur['static_shape']:
+        raise ValueError('static_alloc/static_shape are always true on '
+                         'the XLA runtime and cannot be disabled')
+    model._capi_flags = cur
+    if hasattr(model, 'hybridize'):
+        model.hybridize(cur['hybridize'])
+
+def model_get_flags(model):
+    cur = getattr(model, '_capi_flags', None) or {
+        'training': False, 'hybridize': True,
+        'static_alloc': True, 'static_shape': True}
+    return json.dumps(cur)
+
+def model_forward(model, inputs):
+    from mxnet_tpu import autograd
+    flags = getattr(model, '_capi_flags', None)
+    if flags and flags.get('training'):
+        with autograd.train_mode():
+            out = model(*inputs)
+    else:
+        out = model(*inputs)
+    return out if isinstance(out, tuple) else (out,)
+
+# --- data iterators (parity: MXListDataIters, MXDataIterCreateIter,
+# --- MXDataIterNext/BeforeFirst, MXDataIterGetData/GetLabel, MXDataIterFree
+# --- — `include/mxnet/c_api.h` MXDataIter* family; `src/io/iter_mnist.cc`
+# --- and friends) -------------------------------------------------------
+
+_ITER_TYPES = ('MNISTIter', 'ImageRecordIter', 'CSVIter', 'LibSVMIter',
+               'NDArrayIter')
+
+def list_data_iters():
+    return ','.join(_ITER_TYPES)
+
+def data_iter_create(kind, params_json):
+    import mxnet_tpu.io as io
+    if kind not in _ITER_TYPES:
+        raise ValueError(f'unknown iterator {kind!r}; one of {_ITER_TYPES}')
+    params = json.loads(params_json) if params_json else {}
+    for k in ('data_shape', 'label_shape', 'input_shape'):
+        if k in params and isinstance(params[k], list):
+            params[k] = tuple(params[k])
+    return [getattr(io, kind)(**params), None]   # [iter, current_batch]
+
+def data_iter_from_arrays(data, label, batch_size, shuffle):
+    import mxnet_tpu.io as io
+    return [io.NDArrayIter(data, label=label, batch_size=int(batch_size),
+                           shuffle=bool(shuffle)), None]
+
+def data_iter_next(state):
+    it = state[0]
+    try:
+        state[1] = it.next()
+        return 1
+    except StopIteration:
+        state[1] = None
+        return 0
+
+def data_iter_reset(state):
+    state[0].reset()
+    state[1] = None
+
+def _iter_part(state, what):
+    b = state[1]
+    if b is None:
+        raise RuntimeError('no current batch: call MXTPUDataIterNext first '
+                           '(and check it returned more=1)')
+    part = getattr(b, what)
+    if not part:
+        raise RuntimeError(f'batch has no {what}')
+    return part[0]
+
+def data_iter_data(state):
+    return _iter_part(state, 'data')
+
+def data_iter_label(state):
+    return _iter_part(state, 'label')
 )PY";
 
 void set_error_from_python() {
@@ -856,6 +985,232 @@ int MXTPUProfilerDumps(const char** out, int reset) {
   MXTPU_REQUIRE_INIT();
   GILGuard gil;
   return call_str_helper("profiler_dumps", Py_BuildValue("(i)", reset), out);
+}
+
+/* --- symbol ------------------------------------------------------------ */
+
+namespace {
+
+// helper returning a handle (new python reference becomes the handle)
+int call_handle_helper(const char* name, PyObject* args_owned, void** out) {
+  PyObject* r = call_helper(name, args_owned, true);
+  if (!r) return -1;
+  *out = r;
+  return 0;
+}
+
+// helper returning list[str] -> thread-local name buffer
+int call_names_helper(const char* name, PyObject* args_owned,
+                      const char** name_buf, int* n) {
+  PyObject* r = call_helper(name, args_owned, true);
+  if (!r) return -1;
+  Py_ssize_t k = PyList_Size(r);
+  if (k > *n) {
+    Py_DECREF(r);
+    tls_last_error = "name buffer capacity too small";
+    return -1;
+  }
+  tls_name_results.clear();
+  for (Py_ssize_t i = 0; i < k; ++i) {
+    const char* s = PyUnicode_AsUTF8(PyList_GetItem(r, i));
+    tls_name_results.emplace_back(s ? s : "");
+  }
+  Py_DECREF(r);
+  for (Py_ssize_t i = 0; i < k; ++i) name_buf[i] = tls_name_results[i].c_str();
+  *n = static_cast<int>(k);
+  return 0;
+}
+
+}  // namespace
+
+int MXTPUSymbolCreateVariable(const char* name, MXTPUSymbolHandle* out) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  return call_handle_helper("sym_variable", Py_BuildValue("(s)", name), out);
+}
+
+int MXTPUSymbolCreateFromOp(const char* op, const char* name,
+                            MXTPUSymbolHandle* inputs, int n_in,
+                            const char* kwargs_json, MXTPUSymbolHandle* out) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  PyObject* ins = PyTuple_New(n_in);
+  for (int i = 0; i < n_in; ++i) {
+    PyObject* o = static_cast<PyObject*>(inputs[i]);
+    Py_INCREF(o);
+    PyTuple_SET_ITEM(ins, i, o);
+  }
+  int rc = call_handle_helper(
+      "sym_from_op",
+      Py_BuildValue("(ssOs)", op, name ? name : "", ins,
+                    kwargs_json ? kwargs_json : ""),
+      out);
+  Py_DECREF(ins);
+  return rc;
+}
+
+int MXTPUSymbolLoad(const char* path, MXTPUSymbolHandle* out) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  return call_handle_helper("sym_load", Py_BuildValue("(s)", path), out);
+}
+
+int MXTPUSymbolLoadJSON(const char* json, MXTPUSymbolHandle* out) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  return call_handle_helper("sym_load_json", Py_BuildValue("(s)", json), out);
+}
+
+int MXTPUSymbolSaveJSON(MXTPUSymbolHandle sym, const char** out) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  return call_str_helper(
+      "sym_to_json", Py_BuildValue("(O)", static_cast<PyObject*>(sym)), out);
+}
+
+int MXTPUSymbolListArguments(MXTPUSymbolHandle sym, const char** name_buf,
+                             int* n) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  return call_names_helper(
+      "sym_list_arguments",
+      Py_BuildValue("(O)", static_cast<PyObject*>(sym)), name_buf, n);
+}
+
+int MXTPUSymbolListOutputs(MXTPUSymbolHandle sym, const char** name_buf,
+                           int* n) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  return call_names_helper(
+      "sym_list_outputs",
+      Py_BuildValue("(O)", static_cast<PyObject*>(sym)), name_buf, n);
+}
+
+int MXTPUSymbolEval(MXTPUSymbolHandle sym, const char** arg_names,
+                    MXTPUNDArrayHandle* arg_vals, int n_args,
+                    MXTPUNDArrayHandle* outputs, int* n_out) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  PyObject* names = PyTuple_New(n_args);
+  PyObject* vals = PyTuple_New(n_args);
+  for (int i = 0; i < n_args; ++i) {
+    PyTuple_SET_ITEM(names, i, PyUnicode_FromString(arg_names[i]));
+    PyObject* v = static_cast<PyObject*>(arg_vals[i]);
+    Py_INCREF(v);
+    PyTuple_SET_ITEM(vals, i, v);
+  }
+  PyObject* r = call_helper(
+      "sym_eval",
+      Py_BuildValue("(OOO)", static_cast<PyObject*>(sym), names, vals), true);
+  Py_DECREF(names);
+  Py_DECREF(vals);
+  if (!r) return -1;
+  Py_ssize_t k = PySequence_Size(r);
+  if (k > *n_out) {
+    Py_DECREF(r);
+    tls_last_error = "SymbolEval: output capacity too small";
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < k; ++i) {
+    outputs[i] = PySequence_GetItem(r, i);  // new refs become handles
+  }
+  *n_out = static_cast<int>(k);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUSymbolFree(MXTPUSymbolHandle sym) {
+  return MXTPUNDArrayFree(sym);
+}
+
+/* --- model flags ------------------------------------------------------- */
+
+int MXTPUModelSetFlags(MXTPUModelHandle model, const char* flags_json) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  return call_void_helper(
+      "model_set_flags",
+      Py_BuildValue("(Os)", static_cast<PyObject*>(model),
+                    flags_json ? flags_json : "{}"));
+}
+
+int MXTPUModelGetFlags(MXTPUModelHandle model, const char** out_json) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  return call_str_helper(
+      "model_get_flags",
+      Py_BuildValue("(O)", static_cast<PyObject*>(model)), out_json);
+}
+
+/* --- data iterators ---------------------------------------------------- */
+
+int MXTPUListDataIters(const char** out, int* n) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  if (call_str_helper("list_data_iters", nullptr, out) != 0) return -1;
+  if (n) {
+    int k = tls_string_result.empty() ? 0 : 1;
+    for (char c : tls_string_result) k += (c == ',');
+    *n = k;
+  }
+  return 0;
+}
+
+int MXTPUDataIterCreate(const char* type, const char* params_json,
+                        MXTPUDataIterHandle* out) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  return call_handle_helper(
+      "data_iter_create",
+      Py_BuildValue("(ss)", type, params_json ? params_json : ""), out);
+}
+
+int MXTPUDataIterCreateFromArrays(MXTPUNDArrayHandle data,
+                                  MXTPUNDArrayHandle label, int batch_size,
+                                  int shuffle, MXTPUDataIterHandle* out) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  PyObject* lab = label ? static_cast<PyObject*>(label) : Py_None;
+  return call_handle_helper(
+      "data_iter_from_arrays",
+      Py_BuildValue("(OOii)", static_cast<PyObject*>(data), lab, batch_size,
+                    shuffle),
+      out);
+}
+
+int MXTPUDataIterNext(MXTPUDataIterHandle it, int* more) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  return call_int_helper(
+      "data_iter_next", Py_BuildValue("(O)", static_cast<PyObject*>(it)),
+      more);
+}
+
+int MXTPUDataIterReset(MXTPUDataIterHandle it) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  return call_void_helper(
+      "data_iter_reset", Py_BuildValue("(O)", static_cast<PyObject*>(it)));
+}
+
+int MXTPUDataIterGetData(MXTPUDataIterHandle it, MXTPUNDArrayHandle* out) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  return call_handle_helper(
+      "data_iter_data", Py_BuildValue("(O)", static_cast<PyObject*>(it)),
+      out);
+}
+
+int MXTPUDataIterGetLabel(MXTPUDataIterHandle it, MXTPUNDArrayHandle* out) {
+  MXTPU_REQUIRE_INIT();
+  GILGuard gil;
+  return call_handle_helper(
+      "data_iter_label", Py_BuildValue("(O)", static_cast<PyObject*>(it)),
+      out);
+}
+
+int MXTPUDataIterFree(MXTPUDataIterHandle it) {
+  return MXTPUNDArrayFree(it);
 }
 
 }  // extern "C"

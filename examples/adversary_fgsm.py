@@ -1,0 +1,80 @@
+"""FGSM adversarial examples (parity: `example/adversary/adversary_generation.ipynb`).
+
+Trains a small classifier, then perturbs INPUTS along the sign of the
+input gradient (Goodfellow et al.'s fast gradient sign method) — the API
+surface exercised is input-gradient autograd: `x.attach_grad()` inside
+`autograd.record`, `loss.backward()`, read `x.grad`.
+
+Synthetic two-moons-style data keeps it hermetic (no downloads).
+Run: python examples/adversary_fgsm.py
+"""
+import os
+import sys
+
+if os.environ.get("JAX_PLATFORMS") is None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon import Trainer, nn
+
+
+def make_data(n=512, seed=0):
+    """Two noisy clusters per class in 16-d — linearly separable-ish."""
+    rs = onp.random.RandomState(seed)
+    centers = rs.randn(4, 16) * 1.2
+    labels = onp.array([0, 1, 0, 1])
+    idx = rs.randint(0, 4, n)
+    x = centers[idx] + 0.5 * rs.randn(n, 16)
+    return x.astype("float32"), labels[idx].astype("int32")
+
+
+def accuracy(net, x, y):
+    pred = net(x).argmax(axis=1).astype("int32")
+    return float((pred == y).mean())
+
+
+def main():
+    mx.random.seed(7)
+    xs, ys = make_data()
+    x, y = mx.np.array(xs), mx.np.array(ys)
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu", in_units=16))
+    net.add(nn.Dense(2, in_units=32))
+    net.initialize()
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = Trainer(net.collect_params(), "adam",
+                      {"learning_rate": 0.01})
+    for epoch in range(30):
+        with autograd.record():
+            loss = loss_fn(net(x), y).mean()
+        loss.backward()
+        trainer.step(1)
+    clean_acc = accuracy(net, x, y)
+
+    # FGSM: gradient of the loss wrt the INPUT, step along its sign
+    eps = 1.5
+    xa = x.copy()
+    xa.attach_grad()
+    with autograd.record():
+        adv_loss = loss_fn(net(xa), y).mean()
+    adv_loss.backward()
+    x_adv = x + eps * mx.np.sign(xa.grad)
+    adv_acc = accuracy(net, x_adv, y)
+
+    print(f"clean accuracy {clean_acc:.3f} -> adversarial {adv_acc:.3f} "
+          f"(eps={eps})")
+    assert clean_acc > 0.85, clean_acc
+    assert adv_acc < clean_acc - 0.1, (clean_acc, adv_acc)
+    print("ADVERSARY EXAMPLE OK")
+
+
+if __name__ == "__main__":
+    main()

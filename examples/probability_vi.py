@@ -1,0 +1,79 @@
+"""Variational inference with gluon.probability (parity:
+`example/probability` territory — the reference ships probabilistic-layer
+examples; the canonical 2.x surface is `mxnet.gluon.probability`).
+
+Fits a 1-d Bayesian posterior by maximising the ELBO: data y ~
+Normal(theta, 0.5) with prior theta ~ Normal(0, 1); the variational
+q(theta) = Normal(mu, sigma) must land near the analytic posterior.
+Exercises Distribution.log_prob/sample, kl_divergence, and
+reparameterised gradients through a sampled latent.
+
+Run: python examples/probability_vi.py
+"""
+import os
+import sys
+
+if os.environ.get("JAX_PLATFORMS") is None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon import Trainer
+from mxnet_tpu.gluon.parameter import Parameter
+from mxnet_tpu.gluon.probability import Normal, kl_divergence
+
+
+def main():
+    mx.random.seed(2)
+    rs = onp.random.RandomState(0)
+    true_theta, obs_scale = 1.6, 0.5
+    y = mx.np.array((true_theta
+                     + obs_scale * rs.randn(64)).astype("float32"))
+
+    # analytic posterior for the conjugate normal-normal model
+    n = y.shape[0]
+    prec = 1.0 / 1.0 ** 2 + n / obs_scale ** 2
+    post_mu = float(y.sum() / obs_scale ** 2) / prec
+    post_sigma = (1.0 / prec) ** 0.5
+
+    mu = Parameter("mu", shape=(1,))
+    log_sigma = Parameter("log_sigma", shape=(1,))
+    mu.initialize(init="zeros")
+    log_sigma.initialize(init="zeros")
+    trainer = Trainer({"mu": mu, "log_sigma": log_sigma}, "adam",
+                      {"learning_rate": 0.05})
+
+    prior = Normal(0.0, 1.0)
+    first = None
+    for step in range(150):
+        with autograd.record():
+            q = Normal(mu.data(), mx.np.exp(log_sigma.data()))
+            theta = q.sample((8,))          # reparameterised draws
+            loglik = Normal(theta[..., None], obs_scale).log_prob(
+                y[None, None, :])           # (draws, 1, n)
+            elbo = loglik.sum(axis=-1).mean() - kl_divergence(q, prior).sum()
+            loss = -elbo
+        loss.backward()
+        trainer.step(1)
+        if first is None:
+            first = float(loss)
+    final = float(loss)
+
+    got_mu = float(mu.data()[0])
+    got_sigma = float(mx.np.exp(log_sigma.data())[0])
+    print(f"-ELBO {first:.1f} -> {final:.1f}; q = N({got_mu:.3f}, "
+          f"{got_sigma:.3f}) vs analytic N({post_mu:.3f}, {post_sigma:.3f})")
+    assert final < first, (first, final)
+    assert abs(got_mu - post_mu) < 0.15, (got_mu, post_mu)
+    assert abs(got_sigma - post_sigma) < 0.1, (got_sigma, post_sigma)
+    print("PROBABILITY VI EXAMPLE OK")
+
+
+if __name__ == "__main__":
+    main()

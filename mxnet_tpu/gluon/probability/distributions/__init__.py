@@ -44,3 +44,20 @@ __all__ = [
     "RelaxedOneHotCategorical", "Independent", "register_kl", "kl_divergence",
     "empirical_kl", "constraint",
 ]
+
+# eager-autograd bridge (utils.make_eager_differentiable): Parameters fed
+# as distribution args get gradients from log_prob/sample/... on the
+# EAGER tape, not only under jit tracing.  Classes taking DISTRIBUTION
+# objects as constructor args (TransformedDistribution + its Half*
+# subclasses, Independent) are excluded: rebuilding them from raw leaves
+# cannot reach the nested distribution's parameters, which would sever
+# the tape and return silent zero gradients — they stay traced-only.
+from .utils import make_eager_differentiable as _mk_eager  # noqa: E402
+
+for _obj in list(globals().values()):
+    if isinstance(_obj, type) and issubclass(_obj, Distribution) \
+        and _obj not in (Distribution, ExponentialFamily,
+                         TransformedDistribution, HalfNormal, HalfCauchy,
+                         Independent):
+        _mk_eager(_obj)
+del _obj, _mk_eager

@@ -60,6 +60,25 @@ def kl_divergence(p, q):
             f"No KL(p||q) registered for ({type(p).__name__}, "
             f"{type(q).__name__}); use empirical_kl for a Monte-Carlo "
             "estimate")
+    # eager-autograd bridge (utils.make_eager_differentiable): when either
+    # distribution was built from tape-active ndarrays, rebuild both from
+    # raw leaves inside apply_op so KL gradients reach the Parameters
+    from .... import _tape
+    from .utils import _leaves, _substitute
+    pt = getattr(p, "_eager_args", ((), {}))
+    qt = getattr(q, "_eager_args", ((), {}))
+    leaves = _leaves(pt) + _leaves(qt)
+    if _tape.is_recording() and leaves:
+        from ....ndarray.ndarray import apply_op, as_jax
+
+        def raw_fn(*raw):
+            it = iter(raw)
+            pa, pk = _substitute(pt, it)
+            qa, qk = _substitute(qt, it)
+            return as_jax(fn(type(p)(*pa, **pk), type(q)(*qa, **qk)))
+
+        return apply_op(raw_fn, tuple(leaves), {},
+                        name=f"kl_{type(p).__name__}_{type(q).__name__}")
     return fn(p, q)
 
 

@@ -70,3 +70,100 @@ def sample_n_shape_converter(size):
 
 
 cached_property = functools.cached_property
+
+
+# ---------------------------------------------------------------------------
+# eager-autograd bridge
+#
+# Distribution internals compute in RAW jax (`_j` unwraps) — correct and
+# fast under jit tracing (functional_call / ShardedTrainStep hand tracers
+# straight through), but invisible to the EAGER tape: a Parameter passed
+# as `loc` would get no gradient from log_prob/sample.  The reference's
+# distributions are eagerly trainable (its ops all route the recorder),
+# so this bridge closes the gap at ONE choke point: constructors capture
+# their ORIGINAL (possibly tape-active) ndarray arguments, and wrapped
+# methods rebuild the distribution from raw leaves INSIDE `apply_op`,
+# making each call a single recorded differentiable op.
+# ---------------------------------------------------------------------------
+
+_EAGER_METHODS = ("log_prob", "prob", "sample", "sample_n", "cdf", "icdf",
+                  "entropy")
+
+
+def _capture_init(cls):
+    orig_init = cls.__dict__["__init__"]
+
+    @functools.wraps(orig_init)
+    def wrapped_init(self, *a, **k):
+        # outermost constructor wins: super().__init__ chains must not
+        # overwrite the user-visible argument list
+        if not hasattr(self, "_eager_args"):
+            self._eager_args = (a, k)
+        orig_init(self, *a, **k)
+
+    cls.__init__ = wrapped_init
+
+
+def _substitute(template, it):
+    """Replace each ndarray in (args, kwargs) by the next raw leaf."""
+    a, k = template
+    sub_a = [next(it) if isinstance(v, ndarray) else v for v in a]
+    sub_k = {key: (next(it) if isinstance(v, ndarray) else v)
+             for key, v in k.items()}
+    return sub_a, sub_k
+
+
+def _leaves(template):
+    a, k = template
+    return [v for v in list(a) + list(k.values()) if isinstance(v, ndarray)]
+
+
+def _raw(x):
+    if isinstance(x, ndarray):
+        return x._data
+    if isinstance(x, (tuple, list)):
+        return tuple(_raw(v) for v in x)
+    return x
+
+
+def _wrap_method(cls, mname):
+    orig = cls.__dict__[mname]
+
+    @functools.wraps(orig)
+    def wrapped(self, *m_args, **m_kw):
+        from .... import _tape
+        init_t = getattr(self, "_eager_args", ((), {}))
+        leaves = _leaves(init_t) + _leaves((m_args, m_kw))
+        if not _tape.is_recording() or not leaves:
+            return orig(self, *m_args, **m_kw)
+        from ....ndarray.ndarray import apply_op
+
+        def fn(*raw):
+            it = iter(raw)
+            sub_ia, sub_ik = _substitute(init_t, it)
+            sub_ma, sub_mk = _substitute((m_args, m_kw), it)
+            fresh = type(self)(*sub_ia, **sub_ik)
+            return _raw(orig(fresh, *sub_ma, **sub_mk))
+
+        return apply_op(fn, tuple(leaves), {},
+                        name=f"{cls.__name__}.{mname}")
+
+    setattr(cls, mname, wrapped)
+
+
+def make_eager_differentiable(cls):
+    """Apply the eager-autograd bridge to a Distribution class: wraps its
+    own __init__ (argument capture) and its OWN public methods.  Only
+    top-level ndarray arguments participate; nested containers and
+    distribution-valued arguments (TransformedDistribution etc.) stay on
+    the raw path — use the traced/jit route for those."""
+    if "__init__" in cls.__dict__ and \
+            not getattr(cls.__dict__["__init__"], "_eager_wrapped", False):
+        _capture_init(cls)
+        cls.__dict__["__init__"]._eager_wrapped = True
+    for m in _EAGER_METHODS:
+        fn = cls.__dict__.get(m)
+        if callable(fn) and not getattr(fn, "_eager_wrapped", False):
+            _wrap_method(cls, m)
+            cls.__dict__[m]._eager_wrapped = True
+    return cls

@@ -82,12 +82,25 @@ def array(object, dtype=None, device=None, ctx=None, copy=True):
         elif copy:
             data = data + 0 if jnp.issubdtype(data.dtype, jnp.number) else jnp.array(data)
         return from_jax(data, dev)
-    npv = _onp.asarray(object)
     if dtype is None:
+        npv = _onp.asarray(object)
         if npv.dtype == _onp.float64:
             dtype = _default_float[0]
         else:
             dtype = npv.dtype
+    else:
+        # signed int32/int64 targets convert THROUGH numpy with the
+        # dtype: out-of-range Python ints raise numpy's OverflowError
+        # (loud) instead of silently wrapping in a later jnp downcast —
+        # the documented large-tensor stance (docs/env_vars.md "Large
+        # tensors").  Other integer dtypes keep wraparound (the
+        # reference's semantics for e.g. np.array([-1], dtype="uint8")).
+        try:
+            npdt = jnp.dtype(dtype)
+        except TypeError:
+            npdt = None
+        loud = npdt is not None and npdt.kind == "i" and npdt.itemsize >= 4
+        npv = _onp.asarray(object, dtype=npdt if loud else None)
     data = jnp.asarray(npv, dtype=dtype)
     data = jax.device_put(data, dev.jax_device)
     return from_jax(data, dev)

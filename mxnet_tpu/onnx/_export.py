@@ -534,6 +534,11 @@ def _convert_scan(g: _Graph, eqn, ins, outs):
     if length > cap:
         raise UnsupportedOp(
             f"scan of length {length} > MXTPU_ONNX_MAX_UNROLL={cap}")
+    if length == 0:
+        # zero-trip scan has no steps to unroll: the stacked-ys branch
+        # would emit a Concat with no inputs — an invalid ONNX graph
+        raise UnsupportedOp("scan of length 0 (zero-size stacked outputs "
+                            "have no ONNX representation)")
     closed = p["jaxpr"]
     inner = closed.jaxpr
     const_names = ins[:n_const]

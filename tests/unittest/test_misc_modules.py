@@ -1,0 +1,27 @@
+
+
+def test_container_adt_and_map():
+    """Parity: `python/mxnet/container.py` (TVM-FFI objects there; plain
+    containers here — the TVM bridge is a documented non-goal)."""
+    from mxnet_tpu.container import ADT, Map
+    from mxnet_tpu.base import MXNetError
+    a = ADT(3, [1, "x", 2.5])
+    assert a.tag == 3 and len(a) == 3 and a[1] == "x"
+    m = Map({"w": 1, "b": 2})
+    assert m["w"] == 1 and "b" in m and len(m) == 2
+    assert m.get("nope", 9) == 9
+    assert sorted(m.keys()) == ["b", "w"]
+    import pytest as _pt
+    with _pt.raises(MXNetError):
+        m["missing"]
+
+
+def test_space_entities():
+    """Parity: `python/mxnet/space.py` (autotvm ConfigSpace shapes)."""
+    from mxnet_tpu.space import OtherOptionEntity, OtherOptionSpace
+    s = OtherOptionSpace([1, 2, 3])
+    assert len(s) == 3 and s.entities[0].val == 1
+    e = OtherOptionEntity.from_tvm(OtherOptionEntity(7))
+    assert e.val == 7
+    s2 = OtherOptionSpace.from_tvm(s)
+    assert len(s2) == 3 and s2.entities[2].val == 3

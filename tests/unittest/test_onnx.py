@@ -186,3 +186,21 @@ def test_export_transformer_block(tmp_path):
     got, want2, _ = _export_and_run(layer, x, tmp_path, "block.onnx")
     onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
     onp.testing.assert_allclose(want2, want, rtol=1e-6)
+
+
+def test_scan_length_zero_raises_unsupported():
+    """ADVICE r3: a zero-trip scan must raise UnsupportedOp, not emit an
+    invalid zero-input Concat node."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.onnx._export import UnsupportedOp, jaxpr_to_onnx
+
+    def f(x):
+        def body(c, xi):
+            return c + xi, c
+        c, ys = jax.lax.scan(body, x, jnp.zeros((0, 3)))
+        return ys
+
+    jaxpr = jax.make_jaxpr(f)(jnp.ones((3,)))
+    with pytest.raises(UnsupportedOp, match="length 0"):
+        jaxpr_to_onnx(jaxpr, {}, ["x"], ["y"])
