@@ -30,12 +30,26 @@ NEG_INF = -1e30
 def _block_attn(q, k, v, mask):
     """Unnormalised blockwise attention: returns (acc, m, l).
 
-    q: (B,H,Lq,D); k,v: (B,H,Lk,D); mask broadcastable (B,H,Lq,Lk) or None.
-    Masked entries contribute exactly zero (a fully-masked row yields
-    l = 0 → zero output), matching the flash kernel's masked-softmax
-    semantics."""
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                   preferred_element_type=jnp.float32)
+    q: (B,H,Lq,D); k,v: (B,Hkv,Lk,D) with Hkv == H or Hkv == g < H (GQA:
+    each kv head serves H//g query heads — K/V ride the ring at g heads,
+    an ICI-bandwidth saving of H/g on top of the memory one).  `mask` is
+    broadcastable (B,1|H,1|Lq,Lk) or None.  Masked entries contribute
+    exactly zero (a fully-masked row yields l = 0 → zero output),
+    matching the flash kernel's masked-softmax semantics."""
+    b, h, lq, dd = q.shape
+    g, lk = k.shape[1], k.shape[2]
+    if g != h and (g == 0 or h % g):
+        raise ValueError(f"query heads ({h}) must be a multiple of kv "
+                         f"heads ({g})")
+    if g != h:
+        rep = h // g
+        qg = q.reshape(b, g, rep, lq, dd)
+        s = jnp.einsum("bgrqd,bgkd->bgrqk", qg, k,
+                       preferred_element_type=jnp.float32)
+        s = s.reshape(b, h, lq, lk)
+    else:
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                       preferred_element_type=jnp.float32)
     if mask is not None:
         s = jnp.where(mask, s, NEG_INF)
     m = jnp.max(s, axis=-1)                      # (B,H,Lq)
@@ -45,7 +59,12 @@ def _block_attn(q, k, v, mask):
         # p == 1 uniformly (the exp(NEG_INF - NEG_INF) trap)
         p = jnp.where(s > 0.5 * NEG_INF, p, 0.0)
     l = jnp.sum(p, axis=-1)                      # (B,H,Lq)
-    acc = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    if g != h:
+        pg = p.reshape(b, g, h // g, lq, lk)
+        acc = jnp.einsum("bgrqk,bgkd->bgrqd", pg,
+                         v.astype(jnp.float32)).reshape(b, h, lq, dd)
+    else:
+        acc = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
     return acc, m, l
 
 

@@ -49,6 +49,16 @@ def ulysses_attention_sharded(q, k, v, kv_mask=None, axis_name: str = "sp",
             f"ulysses attention needs num_heads ({h}) divisible by the "
             f"'{axis_name}' axis size ({n}); use ring attention for "
             "head counts that don't divide")
+    g = k.shape[1]
+    if g != h and (g == 0 or h % g):
+        raise MXNetError(f"query heads ({h}) must be a multiple of kv "
+                         f"heads ({g})")
+    if g != h and g % n != 0:
+        # GQA with fewer kv heads than the axis can split: expand K/V to
+        # full heads BEFORE the scatter (correct, but forfeits the GQA
+        # all-to-all saving — ring attention keeps it for this shape)
+        from ..ops.pallas.flash_attention import _expand_kv
+        k, v = _expand_kv(k, v, h)
     if attn_fn is None:
         from ..ops.attention import dot_product_attention
         attn_fn = dot_product_attention

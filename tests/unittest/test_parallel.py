@@ -687,3 +687,56 @@ def test_ulysses_attention_with_kv_mask():
     want = reference_attention(q, k, v, mask=kv_mask[:, None, None, :])
     assert_almost_equal(onp.asarray(out), onp.asarray(want),
                         rtol=1e-4, atol=1e-4)
+
+
+def test_ring_attention_gqa_matches_repeat_reference():
+    """GQA ring attention: K/V ride the ICI ring at g < H heads (the
+    all-gather bytes shrink by H/g); numerics must equal the full-head
+    reference, incl. causal and padded-batch masks."""
+    rng = onp.random.RandomState(7)
+    B, H, G, L, D = 2, 4, 2, 16, 8
+    q = jnp.asarray(rng.standard_normal((B, H, L, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, G, L, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, G, L, D)), jnp.float32)
+    kf = jnp.repeat(k, H // G, axis=1)
+    vf = jnp.repeat(v, H // G, axis=1)
+    mesh = make_mesh({"sp": 4}, _cpu_devices(4))
+
+    got = onp.asarray(ring_attention(q, k, v, mesh))
+    want = onp.asarray(reference_attention(q, kf, vf))
+    assert_almost_equal(got, want, rtol=1e-4, atol=1e-4)
+
+    got_c = onp.asarray(ring_attention(q, k, v, mesh, causal=True))
+    want_c = onp.asarray(reference_attention(q, kf, vf, causal=True))
+    assert_almost_equal(got_c, want_c, rtol=1e-4, atol=1e-4)
+
+    valid = onp.asarray([12, 16])
+    keep = (onp.arange(L)[None, :] < valid[:, None])
+    got_m = onp.asarray(ring_attention(q, k, v, mesh,
+                                       kv_mask=jnp.asarray(keep)))
+    want_m = onp.asarray(reference_attention(
+        q, kf, vf, mask=jnp.asarray(keep)[:, None, None]))
+    assert_almost_equal(got_m, want_m, rtol=1e-4, atol=1e-4)
+
+
+def test_ulysses_attention_gqa():
+    """Ulysses SP with grouped KV: g % sp == 0 scatters kv heads grouped
+    (local attention runs the grouped path); g % sp != 0 expands to full
+    heads before the scatter (correct, documented trade-off)."""
+    from mxnet_tpu.parallel import ulysses_attention
+
+    rng = onp.random.RandomState(8)
+    B, H, L, D = 2, 8, 32, 8
+    q = jnp.asarray(rng.standard_normal((B, H, L, D)), jnp.float32)
+    for G in (4, 2):      # 4 % sp(4) == 0 grouped; 2 % 4 != 0 expanded
+        k = jnp.asarray(rng.standard_normal((B, G, L, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, G, L, D)), jnp.float32)
+        kf = jnp.repeat(k, H // G, axis=1)
+        vf = jnp.repeat(v, H // G, axis=1)
+        mesh = make_mesh({"sp": 4}, _cpu_devices(4))
+        got = onp.asarray(ulysses_attention(q, k, v, mesh))
+        want = onp.asarray(reference_attention(q, kf, vf))
+        assert_almost_equal(got, want, rtol=2e-4, atol=2e-5)
+        got_c = onp.asarray(ulysses_attention(q, k, v, mesh, causal=True))
+        want_c = onp.asarray(reference_attention(q, kf, vf, causal=True))
+        assert_almost_equal(got_c, want_c, rtol=2e-4, atol=2e-5)
