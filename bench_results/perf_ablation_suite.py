@@ -239,6 +239,46 @@ try:
 except Exception as e:
     print("G2 gpt2k window failed:", type(e).__name__, e)
 
+# J. GQA: grouped-KV flash kernel (round 4) vs repeat-expanded KV —
+# the same GPT body with num_kv_heads=3 (4x fewer kv heads), measured
+# against a variant that expands K/V to full heads before the kernel.
+# Quantifies the HBM-bandwidth win of the folded grouped kernel.
+try:
+    from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    def gqa_step_ms(expand):
+        cfg = GPTConfig(vocab_size=50257, hidden_size=768, num_layers=12,
+                        num_heads=12, intermediate_size=3072,
+                        max_position=2048, dtype="bfloat16", remat=True,
+                        num_kv_heads=12 if expand else 3)
+        m = GPTForCausalLM(cfg)
+        m.initialize()
+        rng = onp.random.RandomState(0)
+        B, L = 4, 2048
+        ids = mx.np.array(rng.randint(0, cfg.vocab_size, (B, L)),
+                          dtype="int32")
+        m(ids)
+
+        def lm_loss(out, i):
+            from mxnet_tpu.ops.pallas.softmax_xent import \
+                softmax_cross_entropy
+            return softmax_cross_entropy(out[:, :-1],
+                                         i[:, 1:].astype(jnp.int32)).mean()
+
+        mesh = make_mesh({"dp": 1}, jax.devices()[:1])
+        st = make_sharded_train_step(m, opt.Adam(learning_rate=1e-4),
+                                     lm_loss, mesh, num_model_args=1)
+        return timed(lambda: st(ids), n=10)
+
+    t_mha = gqa_step_ms(expand=True)    # full 12 kv heads (baseline)
+    t_gqa = gqa_step_ms(expand=False)   # 3 kv heads, grouped kernel
+    results["J_gpt2k_mha_ms"] = t_mha
+    results["J_gpt2k_gqa3_ms"] = t_gqa
+    print(f"J gpt2k GQA(kv=3) {t_gqa:.1f} ms vs MHA {t_mha:.1f} ms "
+          f"(grouped-KV kernel; also smaller kv projections)")
+except Exception as e:
+    print("J gqa failed:", type(e).__name__, e)
+
 # I. ResNet-50 throughput vs the reference's headline tables
 # (BASELINE.md: V100 fp32 inference 1076.81 img/s @ bs32, 1233.15 @ bs128,
 # fp16 2085.51 @ bs32; training fp32 251.22 img/s @ bs16). TPU bf16 is

@@ -156,7 +156,14 @@ DEFAULT_OPS = [
     ("multi_head_attention", [{"query": (8, 256, 512),
                                "key": (8, 256, 512),
                                "value": (8, 256, 512),
-                               "num_heads": 8}]),
+                               "num_heads": 8},
+                              # GQA: kv at 2 of 8 heads — the grouped-KV
+                              # kernel streams K/V without expansion
+                              {"query": (8, 256, 512),
+                               "key": (8, 256, 128),
+                               "value": (8, 256, 128),
+                               "num_heads": 8,
+                               "num_kv_heads": 2}]),
 ]
 
 
