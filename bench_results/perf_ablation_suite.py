@@ -239,18 +239,22 @@ try:
 except Exception as e:
     print("G2 gpt2k window failed:", type(e).__name__, e)
 
-# J. GQA: grouped-KV flash kernel (round 4) vs repeat-expanded KV —
-# the same GPT body with num_kv_heads=3 (4x fewer kv heads), measured
-# against a variant that expands K/V to full heads before the kernel.
-# Quantifies the HBM-bandwidth win of the folded grouped kernel.
+# J. GQA kernel ablation (round 4). Three legs at gpt2k shapes:
+#   J1 num_kv_heads=3, grouped-KV folded kernel (the round-4 path)
+#   J2 num_kv_heads=3, SAME model but K/V repeat-expanded to 12 heads
+#      before the kernel (the pre-round-4 behavior) — J1 vs J2 isolates
+#      the kernel's HBM-bandwidth win at identical params/projections
+#   J3 num_kv_heads=12 MHA — the end-to-end model-level GQA-vs-MHA delta
+#      (includes the smaller kv projections)
 try:
+    import mxnet_tpu.ops.pallas.flash_attention as _fa
     from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM
 
-    def gqa_step_ms(expand):
+    def gqa_step_ms(kv_heads, force_expand=False):
         cfg = GPTConfig(vocab_size=50257, hidden_size=768, num_layers=12,
                         num_heads=12, intermediate_size=3072,
                         max_position=2048, dtype="bfloat16", remat=True,
-                        num_kv_heads=12 if expand else 3)
+                        num_kv_heads=None if kv_heads == 12 else kv_heads)
         m = GPTForCausalLM(cfg)
         m.initialize()
         rng = onp.random.RandomState(0)
@@ -265,17 +269,30 @@ try:
             return softmax_cross_entropy(out[:, :-1],
                                          i[:, 1:].astype(jnp.int32)).mean()
 
-        mesh = make_mesh({"dp": 1}, jax.devices()[:1])
-        st = make_sharded_train_step(m, opt.Adam(learning_rate=1e-4),
-                                     lm_loss, mesh, num_model_args=1)
-        return timed(lambda: st(ids), n=10)
+        orig = _fa.flash_attention
+        if force_expand:
+            def expanded(q, k, v, **kw):
+                if k.shape[1] != q.shape[1]:
+                    k, v = _fa._expand_kv(k, v, q.shape[1])
+                return orig(q, k, v, **kw)
+            _fa.flash_attention = expanded   # dispatcher re-imports per call
+        try:
+            mesh = make_mesh({"dp": 1}, jax.devices()[:1])
+            st = make_sharded_train_step(m, opt.Adam(learning_rate=1e-4),
+                                         lm_loss, mesh, num_model_args=1)
+            return timed(lambda: st(ids), n=10)
+        finally:
+            _fa.flash_attention = orig
 
-    t_mha = gqa_step_ms(expand=True)    # full 12 kv heads (baseline)
-    t_gqa = gqa_step_ms(expand=False)   # 3 kv heads, grouped kernel
-    results["J_gpt2k_mha_ms"] = t_mha
-    results["J_gpt2k_gqa3_ms"] = t_gqa
-    print(f"J gpt2k GQA(kv=3) {t_gqa:.1f} ms vs MHA {t_mha:.1f} ms "
-          f"(grouped-KV kernel; also smaller kv projections)")
+    t_grouped = gqa_step_ms(3)                      # J1
+    t_expanded = gqa_step_ms(3, force_expand=True)  # J2
+    t_mha = gqa_step_ms(12)                         # J3
+    results["J1_gpt2k_gqa3_grouped_ms"] = t_grouped
+    results["J2_gpt2k_gqa3_expanded_ms"] = t_expanded
+    results["J3_gpt2k_mha_ms"] = t_mha
+    print(f"J gpt2k kv=3 grouped {t_grouped:.1f} ms vs kv=3 expanded "
+          f"{t_expanded:.1f} ms (kernel HBM win) vs MHA {t_mha:.1f} ms "
+          f"(model-level delta)")
 except Exception as e:
     print("J gqa failed:", type(e).__name__, e)
 

@@ -1,0 +1,86 @@
+"""2-process cross-host sequence-parallelism worker (SURVEY §5.8/§5.7).
+
+Each process exposes 4 virtual CPU devices; `jax.distributed` joins them
+into one 8-device global mesh with sp=8 — the ring attention ppermutes
+CROSS the process boundary (the DCN leg of the ICI/DCN story) and
+Ulysses' all_to_all likewise spans both hosts.  Numerics must equal the
+process-local single-device reference, for full-head AND grouped-KV
+(GQA) attention.
+
+Run: python tools/launch.py -n 2 --launcher local python tests/dist/dist_ring_attention.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+
+import jax
+os.environ["JAX_PLATFORMS"] = "cpu"  # env var too: mxnet_tpu's import
+# honors JAX_PLATFORMS and would re-override a config-only choice
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as onp
+
+from jax.experimental import multihost_utils
+
+from mxnet_tpu import parallel
+from mxnet_tpu.ops.attention import reference_attention
+from mxnet_tpu.parallel import make_mesh, ring_attention, ulysses_attention
+
+
+def fetch(x):
+    """Materialise a global (cross-process-sharded) array on every host."""
+    return onp.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
+def main():
+    parallel.initialize()
+    rank = parallel.rank()
+    n = parallel.num_workers()
+    assert n == 2, f"expected 2 processes, got {n}"
+    assert jax.device_count() == 8 and jax.local_device_count() == 4
+
+    rng = onp.random.RandomState(0)     # same data on every rank
+    B, H, G, L, D = 2, 4, 2, 64, 8
+    q = jnp.asarray(rng.standard_normal((B, H, L, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, G, L, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, G, L, D)), jnp.float32)
+    kf = jnp.repeat(k, H // G, axis=1)
+    vf = jnp.repeat(v, H // G, axis=1)
+
+    mesh = make_mesh({"sp": 8}, jax.devices())   # ring spans both hosts
+    assert {d.process_index for d in mesh.devices.reshape(-1)} == {0, 1}
+    want = onp.asarray(reference_attention(q, kf, vf, causal=True))
+    want_nc = onp.asarray(reference_attention(q, kf, vf))
+
+    # ring, full heads
+    got = fetch(ring_attention(q, kf, vf, mesh, causal=True))
+    onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    # ring, grouped KV: g-head shards ride the cross-process ring
+    got_g = fetch(ring_attention(q, k, v, mesh, causal=True))
+    onp.testing.assert_allclose(got_g, want, rtol=1e-4, atol=1e-4)
+
+    # Ulysses all_to_all across hosts. make_mesh reshapes by its FIXED
+    # axis order (dp before sp), which would put each sp group wholly
+    # inside one process — so interleave the device list to force every
+    # sp group to span both hosts, and ASSERT it does.
+    local0, local1 = jax.devices()[:4], jax.devices()[4:]
+    interleaved = [d for pair in zip(local0, local1) for d in pair]
+    mesh2 = make_mesh({"dp": 2, "sp": 4}, interleaved)
+    sp_rows = mesh2.devices            # shape (dp=2, sp=4)
+    for row in sp_rows:
+        assert {d.process_index for d in row} == {0, 1}, sp_rows
+    got_u = fetch(ulysses_attention(q, kf, vf, mesh2, batch_axis="dp"))
+    onp.testing.assert_allclose(got_u, want_nc, rtol=2e-4, atol=2e-5)
+
+    print(f"[rank {rank}] dist_ring_attention OK (n={n}, sp=8 ring + "
+          "gqa + ulysses)", flush=True)
+
+
+if __name__ == "__main__":
+    main()
