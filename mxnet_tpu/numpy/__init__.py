@@ -559,3 +559,78 @@ ndarray = ndarray  # re-export
 
 def get_include():
     return _onp.get_include()
+
+
+# -----------------------------------------------------------------------
+# Array-API aliases + tail utilities (parity: the reference numpy
+# surface exports these names — `python/mxnet/numpy/multiarray.py`
+# __all__ / function_base.py; the aliases are NumPy 2.0 spellings)
+# -----------------------------------------------------------------------
+acos = arccos                 # noqa: F821
+acosh = arccosh               # noqa: F821
+asin = arcsin                 # noqa: F821
+asinh = arcsinh               # noqa: F821
+atan = arctan                 # noqa: F821
+atan2 = arctan2               # noqa: F821
+atanh = arctanh               # noqa: F821
+bitwise_invert = invert       # noqa: F821
+bitwise_left_shift = left_shift   # noqa: F821
+bitwise_right_shift = right_shift  # noqa: F821
+concat = concatenate
+permute_dims = transpose      # noqa: F821
+pow = power                   # noqa: F821
+round_ = round                # noqa: F821
+row_stack = vstack
+
+
+def _window(jfn):
+    def fn(M, dtype=None, device=None, ctx=None):
+        dev = _dev(device, ctx)
+        data = jfn(M).astype(dtype or _default_float[0])
+        return from_jax(jax.device_put(data, dev.jax_device), dev)
+    return fn
+
+
+blackman = _window(jnp.blackman)
+hamming = _window(jnp.hamming)
+hanning = _window(jnp.hanning)
+
+
+def diag_indices_from(arr):
+    if arr.ndim < 2:
+        raise MXNetError("diag_indices_from needs an array of at least "
+                         f"2 dimensions, got {arr.ndim}-d")
+    n = arr.shape[0]
+    # NB: `any` here is mx.np's reduction (module shadowing) — use set()
+    if len(set(arr.shape)) != 1:
+        raise MXNetError("diag_indices_from needs a square array, got "
+                         f"shape {arr.shape}")
+    i = arange(n, dtype=_onp.int32)
+    return tuple(i for _ in range(arr.ndim))
+
+
+def triu_indices_from(arr, k=0):
+    if arr.ndim != 2:
+        raise MXNetError(f"triu_indices_from needs a 2-d array, got "
+                         f"{arr.ndim}-d")
+    dev = arr._device if isinstance(arr, ndarray) else current_device()
+    return tuple(from_jax(jax.device_put(i, dev.jax_device), dev)
+                 for i in jnp.triu_indices(arr.shape[0], k, arr.shape[1]))
+
+
+def from_dlpack(x):
+    """Import an array through the DLPack protocol (zero-copy where the
+    producer's device is compatible with XLA's); delegates to mx.dlpack
+    (which also adapts legacy raw capsules)."""
+    from ..dlpack import from_dlpack as _fd
+    return _fd(x)
+
+
+def genfromtxt(*args, **kwargs):
+    """numpy.genfromtxt -> device array (host parse, then transfer)."""
+    return array(_onp.genfromtxt(*args, **kwargs))
+
+
+def set_printoptions(*args, **kwargs):
+    """Applies to the host repr (asnumpy()-backed printing)."""
+    _onp.set_printoptions(*args, **kwargs)

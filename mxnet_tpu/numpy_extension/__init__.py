@@ -45,6 +45,9 @@ __all__ = [
     "save", "load", "waitall", "set_np", "reset_np", "is_np_array",
     "seed", "rnn", "intgemm_fully_connected", "custom",
     "random", "image", "cpu", "gpu", "tpu", "num_gpus", "num_tpus",
+    "batch_dot", "bernoulli", "from_numpy", "from_dlpack",
+    "to_dlpack_for_read", "to_dlpack_for_write", "savez", "normal_n",
+    "uniform_n",
 ]
 
 
@@ -1206,3 +1209,83 @@ def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
 from ..numpy import random  # noqa: E402,F401
 from ..image import _npx_image as image  # noqa: E402,F401
 from ..device import cpu, gpu, tpu, num_gpus, num_tpus  # noqa: E402,F401
+
+
+# ---------------------------------------------------------------------------
+# npx tail parity (`python/mxnet/numpy_extension/__init__.py` __all__):
+# batch_dot, dlpack/numpy interop, savez, and the *_n samplers
+# ---------------------------------------------------------------------------
+
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Batched matmul over leading batch dims (`npx.batch_dot`)."""
+    from ..ndarray.legacy_ops import batch_dot as _bd
+    return _bd(lhs, rhs, transpose_a=transpose_a, transpose_b=transpose_b)
+
+
+def bernoulli(prob=None, logit=None, size=None, dtype=None, device=None,
+              ctx=None):
+    """`npx.random.bernoulli` surface (also exported at npx top level)."""
+    from ..numpy.random import bernoulli as _b
+    return _b(prob=prob, logit=logit, size=size, dtype=dtype,
+              device=device, ctx=ctx)
+
+
+def from_numpy(ndarray, zero_copy=True):
+    """Host numpy -> device array (`npx.from_numpy`; dtype-preserving up
+    to jax's x64 policy — float64 narrows to float32 unless
+    JAX_ENABLE_X64 — and the device transfer copies regardless, XLA owns
+    its buffers)."""
+    from ..numpy import array as _array
+    return _array(ndarray, dtype=ndarray.dtype)
+
+
+# DLPack interop: one implementation, mx.dlpack (protocol objects +
+# legacy-capsule adaptation + read/write sync) — re-exported here
+from ..dlpack import (from_dlpack, to_dlpack_for_read,  # noqa: E402,F401
+                      to_dlpack_for_write)
+
+
+def savez(file, *args, **kwargs):
+    """numpy-style savez (`npx.savez`): positional arrays land under
+    arr_0..arr_{n-1}, keywords under their names."""
+    from ..util import save_arrays
+    data = {f"arr_{i}": a for i, a in enumerate(args)}
+    overlap = set(data) & set(kwargs)
+    if overlap:
+        raise ValueError(f"savez name collision: {sorted(overlap)}")
+    data.update(kwargs)
+    save_arrays(file, data)
+
+
+def _n_sampler(sampler):
+    def fn(arg0=0.0, arg1=1.0, batch_shape=None, dtype=None, device=None,
+           ctx=None):
+        import jax.numpy as _jnp
+        from ..ndarray.ndarray import ndarray as _nd
+        if batch_shape is None:
+            bshape = ()
+        elif isinstance(batch_shape, (list, tuple)):
+            bshape = tuple(int(s) for s in batch_shape)
+        else:
+            bshape = (int(batch_shape),)
+        event = _jnp.broadcast_shapes(
+            _jnp.shape(arg0._data if isinstance(arg0, _nd) else arg0),
+            _jnp.shape(arg1._data if isinstance(arg1, _nd) else arg1))
+        return sampler(arg0, arg1, size=bshape + event, dtype=dtype,
+                       device=device, ctx=ctx)
+    return fn
+
+
+def normal_n(loc=0.0, scale=1.0, batch_shape=None, dtype=None, device=None,
+             ctx=None):
+    """`npx.normal_n`: output shape = batch_shape + broadcast(loc, scale)
+    — the leading-batch sampler form."""
+    from ..numpy.random import normal as _normal
+    return _n_sampler(_normal)(loc, scale, batch_shape, dtype, device, ctx)
+
+
+def uniform_n(low=0.0, high=1.0, batch_shape=None, dtype=None, device=None,
+              ctx=None):
+    """`npx.uniform_n`: output shape = batch_shape + broadcast(low, high)."""
+    from ..numpy.random import uniform as _uniform
+    return _n_sampler(_uniform)(low, high, batch_shape, dtype, device, ctx)

@@ -1,3 +1,4 @@
+import pytest
 
 
 def test_container_adt_and_map():
@@ -25,3 +26,74 @@ def test_space_entities():
     assert e.val == 7
     s2 = OtherOptionSpace.from_tvm(s)
     assert len(s2) == 3 and s2.entities[2].val == 3
+
+
+def test_np_array_api_aliases_and_tail():
+    """Array-API alias + tail parity (reference numpy __all__ names that
+    were missing: acos/concat/pow/permute_dims/windows/indices-from/...)."""
+    import numpy as onp
+    import mxnet_tpu as mx
+
+    assert float(mx.np.acos(mx.np.array([1.0]))[0]) == 0.0
+    assert float(mx.np.atan2(mx.np.array([1.0]), mx.np.array([1.0]))[0]) \
+        == pytest.approx(onp.pi / 4)
+    assert mx.np.concat([mx.np.ones((2,)), mx.np.zeros((3,))]).shape == (5,)
+    assert mx.np.permute_dims(mx.np.ones((2, 3))).shape == (3, 2)
+    assert float(mx.np.pow(mx.np.array([2.0]), 3)[0]) == 8.0
+    assert int(mx.np.bitwise_invert(mx.np.array([0], dtype="int32"))[0]) == -1
+    assert int(mx.np.bitwise_left_shift(
+        mx.np.array([1], dtype="int32"), 3)[0]) == 8
+    assert mx.np.row_stack([mx.np.ones((2,)), mx.np.zeros((2,))]).shape \
+        == (2, 2)
+    for win in (mx.np.blackman, mx.np.hamming, mx.np.hanning):
+        w = win(16)
+        assert w.shape == (16,) and float(w.max()) <= 1.0 + 1e-6
+    r, c = mx.np.triu_indices_from(mx.np.ones((4, 4)), k=1)
+    onp.testing.assert_array_equal(
+        onp.asarray(r), onp.triu_indices(4, 1)[0])
+    i, j = mx.np.diag_indices_from(mx.np.ones((3, 3)))
+    onp.testing.assert_array_equal(onp.asarray(i), [0, 1, 2])
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError, match="2 dimensions"):
+        mx.np.diag_indices_from(mx.np.ones((4,)))
+    with pytest.raises(MXNetError, match="square"):
+        mx.np.diag_indices_from(mx.np.ones((3, 2)))
+    assert mx.np.from_dlpack(onp.arange(4.0)).shape == (4,)
+
+
+def test_npx_tail_ops():
+    """npx tail parity: batch_dot, *_n samplers, dlpack/numpy interop,
+    savez (reference numpy_extension __all__)."""
+    import numpy as onp
+    import mxnet_tpu as mx
+
+    a, b = mx.np.ones((2, 3, 4)), mx.np.ones((2, 4, 5))
+    assert mx.npx.batch_dot(a, b).shape == (2, 3, 5)
+    got = mx.npx.batch_dot(a, a, transpose_b=True)
+    assert got.shape == (2, 3, 3)
+
+    s = mx.npx.normal_n(mx.np.zeros((3,)), 1.0, batch_shape=(4, 2))
+    assert s.shape == (4, 2, 3)
+    assert mx.npx.uniform_n(batch_shape=5).shape == (5,)
+    assert mx.npx.bernoulli(prob=0.3, size=(8,)).dtype is not None
+
+    assert mx.npx.from_numpy(onp.eye(2)).shape == (2, 2)
+    # dtype preserved up to jax's x64 policy (f64 -> f32 when x64 off)
+    assert mx.npx.from_numpy(onp.arange(3, dtype=onp.int16)).dtype \
+        == mx.np.int16
+    assert mx.npx.from_numpy(onp.eye(2, dtype=onp.float16)).dtype \
+        == mx.np.float16
+    assert mx.npx.from_dlpack(onp.arange(3.0)).shape == (3,)
+    # full round trip through the protocol object
+    rt = mx.npx.from_dlpack(mx.npx.to_dlpack_for_read(mx.np.ones((2,))))
+    assert rt.shape == (2,) and float(rt.sum()) == 2.0
+
+
+def test_npx_savez_roundtrip(tmp_path):
+    import mxnet_tpu as mx
+    p = str(tmp_path / "z.npz")
+    mx.npx.savez(p, mx.np.ones((2,)), w=mx.np.zeros((3,)))
+    d = mx.npx.load(p)
+    assert set(d) == {"arr_0", "w"} and d["w"].shape == (3,)
+    with pytest.raises(ValueError, match="collision"):
+        mx.npx.savez(p, mx.np.ones((1,)), arr_0=mx.np.ones((1,)))
