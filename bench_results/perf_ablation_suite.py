@@ -179,6 +179,10 @@ results["H_masked_reference"] = timed(f)
 print("H masked (XLA reference):", results["H_masked_reference"], "ms")
 del _os2.environ["MXTPU_DISABLE_FLASH"]
 
+# NOTE: no block sweep here — the bench's seq 128 clamps both block
+# sizes to 128, so (block_q, block_k) only matters at long context;
+# see H2 next to the GPT-2k legs.
+
 # G. long-context GPT: seq 2048, flash attention + per-layer remat
 try:
     from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM
@@ -238,6 +242,52 @@ try:
           f"(vs G full attention above — the banded-kernel delta)")
 except Exception as e:
     print("G2 gpt2k window failed:", type(e).__name__, e)
+
+# H2. flash block-size sweep at LONG context (seq 2048, where blocks
+# genuinely vary): if the kernel is the limiter, the winning
+# (block_q, block_k) names the fix — exported env knobs, no code change
+try:
+    import os as _os3
+    from mxnet_tpu.models.gpt import GPTConfig as _C2, \
+        GPTForCausalLM as _M2
+
+    def _block_step_ms():
+        cfg = _C2(vocab_size=50257, hidden_size=768, num_layers=12,
+                  num_heads=12, intermediate_size=3072,
+                  max_position=2048, dtype="bfloat16", remat=True)
+        m = _M2(cfg)
+        m.initialize()
+        rng = onp.random.RandomState(0)
+        ids = mx.np.array(rng.randint(0, cfg.vocab_size, (4, 2048)),
+                          dtype="int32")
+        m(ids)
+
+        def lm_loss(out, i):
+            from mxnet_tpu.ops.pallas.softmax_xent import \
+                softmax_cross_entropy
+            return softmax_cross_entropy(out[:, :-1],
+                                         i[:, 1:].astype(jnp.int32)).mean()
+
+        mesh = make_mesh({"dp": 1}, jax.devices()[:1])
+        st = make_sharded_train_step(m, opt.Adam(learning_rate=1e-4),
+                                     lm_loss, mesh, num_model_args=1)
+        return timed(lambda: st(ids), n=10)
+
+    for bq, bk in ((128, 128), (256, 256), (512, 256), (256, 512),
+                   (512, 512)):
+        _os3.environ["MXTPU_FLASH_BLOCK_Q"] = str(bq)
+        _os3.environ["MXTPU_FLASH_BLOCK_K"] = str(bk)
+        try:
+            t = _block_step_ms()
+            results[f"H2_gpt2k_bq{bq}_bk{bk}"] = t
+            print(f"H2 gpt2k block_q={bq} block_k={bk}: {t:.1f} ms")
+        except Exception as e:   # a size can exceed VMEM — keep sweeping
+            print(f"H2 gpt2k bq={bq} bk={bk} failed:",
+                  type(e).__name__, e)
+    _os3.environ.pop("MXTPU_FLASH_BLOCK_Q", None)
+    _os3.environ.pop("MXTPU_FLASH_BLOCK_K", None)
+except Exception as e:
+    print("H2 block sweep failed:", type(e).__name__, e)
 
 # J. GQA kernel ablation (round 4). Three legs at gpt2k shapes:
 #   J1 num_kv_heads=3, grouped-KV folded kernel (the round-4 path)

@@ -92,6 +92,8 @@ from . import executor  # noqa: F401
 from . import registry  # noqa: F401
 from . import visualization  # noqa: F401
 from . import visualization as viz  # noqa: F401
+from . import container  # noqa: F401
+from . import space  # noqa: F401
 from .context import Context  # noqa: F401
 from . import runtime as libinfo  # noqa: F401  (feature discovery alias)
 from . import benchmark  # noqa: F401
