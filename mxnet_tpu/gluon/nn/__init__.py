@@ -1,7 +1,7 @@
 """`mx.gluon.nn` (parity: `python/mxnet/gluon/nn/`)."""
 from ..block import Block, HybridBlock, SymbolBlock
 from .basic_layers import (Sequential, HybridSequential, Dense, Dropout,
-                           Embedding, BatchNorm, SyncBatchNorm, LayerNorm,
+                           Embedding, BatchNorm, BatchNormReLU, SyncBatchNorm, LayerNorm,
                            GroupNorm, InstanceNorm, Flatten, Lambda,
                            HybridLambda, Concatenate, HybridConcatenate,
                            Identity, Activation)

@@ -14,7 +14,7 @@ from ..parameter import Parameter, Constant
 
 __all__ = [
     "Sequential", "HybridSequential", "Dense", "Dropout", "Embedding",
-    "BatchNorm", "SyncBatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm",
+    "BatchNorm", "BatchNormReLU", "SyncBatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm",
     "Flatten", "Lambda", "HybridLambda", "Concatenate", "HybridConcatenate",
     "Identity", "Activation",
 ]
@@ -207,6 +207,20 @@ class BatchNorm(HybridBlock):
 
     def __repr__(self):
         return f"BatchNorm(axis={self._axis}, momentum={self._momentum})"
+
+
+class BatchNormReLU(BatchNorm):
+    """BatchNorm with fused ReLU (parity: `gluon/nn/basic_layers.py`
+    BatchNormReLU — there a cuDNN fused kernel; here XLA fuses the relu
+    into the normalisation epilogue on its own, so this is the same
+    graph the separate pair produces, kept for API parity)."""
+
+    def forward(self, x):
+        return npx.relu(super().forward(x))
+
+    def __repr__(self):
+        return (f"BatchNormReLU(axis={self._axis}, "
+                f"momentum={self._momentum})")
 
 
 class SyncBatchNorm(BatchNorm):
