@@ -37,6 +37,7 @@ __all__ = [
     "ROIAlign", "roi_align", "fft", "ifft", "BilinearResize2D",
     "AdaptiveAvgPooling2D", "MultiBoxPrior", "gradient_multiplier",
     "dynamic_reshape", "batch_norm_with_relu", "DeformableConvolution",
+    "hawkesll", "round_ste", "sign_ste",
 ]
 
 
@@ -510,3 +511,106 @@ def DeformableConvolution(data, offset, weight, bias=None, kernel=(3, 3),
                kernel=kernel, stride=stride, dilate=dilate, pad=pad,
                num_filter=num_filter, num_group=num_group,
                num_deformable_group=num_deformable_group)
+
+
+def hawkesll(lda, alpha, beta, state, lags, marks, valid_length, max_time):
+    """Log likelihood of marked exponential-kernel Hawkes processes
+    (parity: `src/operator/contrib/hawkes_ll.cc` `_contrib_hawkesll`).
+
+    Conditional intensity per mark k:
+    lambda_k*(t) = lda_k + alpha_k * sum_{t_i<t, y_i=k} beta_k
+                   * exp(-beta_k (t - t_i)).
+
+    Inputs: `lda` (N, K) background intensities, `alpha`/`beta` (K,),
+    `state` (N, K) carried memory s_k(0), `lags` (N, T) interarrival
+    times, `marks` (N, T) int mark ids, `valid_length` (N,),
+    `max_time` (N,).  Returns (loglike (N,), out_state (N, K) =
+    s_k(max_time)).
+
+    TPU-native: one `lax.scan` over the T event slots with validity
+    masking — no ragged host loop — so it jits, differentiates (the
+    reference hand-writes its backward; autodiff matches), and batches.
+    """
+    def fn(mu, a, b, s0, lg, mk, vl, mt):
+        N, T = lg.shape
+        K = mu.shape[1]
+        mk = mk.astype(jnp.int32)
+        f32 = jnp.promote_types(mu.dtype, jnp.float32)
+        mu_, a_, b_ = (x.astype(f32) for x in (mu, a, b))
+        lgf = lg.astype(f32)
+
+        def step(carry, inp):
+            t, last, s, ll = carry
+            lag_j, mark_j, valid_j = inp            # each (N,)
+            t_new = t + lag_j
+            # clamp padded slots (e.g. -1 mark padding): an out-of-range
+            # id would one_hot to all-zeros -> inten 0 -> 0 * log(0) NaN
+            mark_j = jnp.clip(mark_j, 0, K - 1)
+            oh = jax.nn.one_hot(mark_j, K, dtype=f32)      # (N, K)
+            d = t_new - jnp.sum(last * oh, axis=1)          # (N,)
+            bc = jnp.sum(b_ * oh, axis=1)
+            ac = jnp.sum(a_ * oh, axis=1)
+            muc = jnp.sum(mu_ * oh, axis=1)
+            sc = jnp.sum(s * oh, axis=1)
+            ed = jnp.exp(-bc * d)
+            inten = muc + ac * bc * sc * ed
+            comp = muc * d + ac * sc * (1.0 - ed)
+            valid = valid_j.astype(f32)
+            # where() not multiply: padded rows must contribute EXACTLY
+            # zero even if log(inten) is non-finite for them
+            contrib_ll = jnp.where(valid > 0,
+                                   jnp.log(inten) - comp, 0.0)
+            ll = ll + contrib_ll
+            # s[mark] <- 1 + s[mark] * ed, other marks unchanged
+            s_new = jnp.where(oh > 0, 1.0 + s * ed[:, None], s)
+            s = jnp.where(valid[:, None] > 0, s_new, s)
+            last = jnp.where((oh > 0) & (valid[:, None] > 0),
+                             t_new[:, None], last)
+            t = jnp.where(valid > 0, t_new, t)
+            return (t, last, s, ll), None
+
+        t0 = jnp.zeros((N,), f32)
+        last0 = jnp.zeros((N, K), f32)
+        ll0 = jnp.zeros((N,), f32)
+        idx = jnp.arange(T)
+        valid_mask = idx[None, :] < vl.astype(jnp.int32)[:, None]
+        (tT, lastT, sT, ll), _ = lax.scan(
+            step, (t0, last0, s0.astype(f32), ll0),
+            (lgf.T, mk.T, valid_mask.T))
+        # remaining compensators over (last event, max_time] per mark
+        d = mt.astype(f32)[:, None] - lastT                 # (N, K)
+        ed = jnp.exp(-b_[None, :] * d)
+        rem = mu_ * d + a_[None, :] * sT * (1.0 - ed)
+        ll = ll - jnp.sum(rem, axis=1)
+        out_state = sT * ed
+        return ll.astype(mu.dtype), out_state.astype(state.dtype)
+
+    return apply_op(fn, (lda, alpha, beta, state, lags, marks,
+                         valid_length, max_time), {},
+                    name="hawkesll", n_out=2)
+
+
+def _ste(jfn, name):
+    """Straight-through estimator (parity: `src/operator/contrib/
+    stes_op.cc` `_contrib_round_ste`/`_contrib_sign_ste`): forward is the
+    non-differentiable quantizer, backward passes gradients through
+    unchanged (identity) — the QAT trick."""
+
+    def fn(x):
+        zero = x - lax.stop_gradient(x)   # 0 with identity gradient
+        return zero + lax.stop_gradient(jfn(x))
+
+    def op(data):
+        return apply_op(fn, (data,), {}, name=name)
+    op.__name__ = name
+    return op
+
+
+def _round_half_away(x):
+    # the reference rounds half AWAY from zero (std::round); jnp.round
+    # is banker's rounding and would send 0.5 -> 0 instead of 1
+    return jnp.trunc(x + jnp.where(x >= 0, 0.5, -0.5))
+
+
+round_ste = _ste(_round_half_away, "round_ste")
+sign_ste = _ste(jnp.sign, "sign_ste")

@@ -406,3 +406,123 @@ class TestGraphSampling:
             for j in range(n):
                 if dense[i, j]:
                     assert dense[i, j] == full[ids[i], ids[j]]
+
+
+def test_hawkesll_matches_python_reference():
+    """`contrib.hawkesll` (parity: `src/operator/contrib/hawkes_ll.cc`):
+    values checked against an independent pure-python implementation of
+    the intensity recurrence; state carries across calls; grads flow."""
+    import numpy as onp
+    import mxnet_tpu as mx
+
+    rs = onp.random.RandomState(0)
+    N, T, K = 3, 5, 2
+    lda = rs.rand(N, K).astype("float32") + 0.5
+    alpha = onp.asarray([0.2, 0.3], "float32")
+    beta = onp.asarray([1.0, 2.0], "float32")
+    state0 = rs.rand(N, K).astype("float32")
+    lags = (rs.rand(N, T).astype("float32") * 2.0 + 0.1)
+    marks = rs.randint(0, K, (N, T)).astype("int32")
+    vl = onp.asarray([5, 3, 0], "float32")
+    mt = onp.full((N,), 20.0, "float32")
+
+    def py_ref():
+        lls = onp.zeros(N)
+        out_s = onp.zeros((N, K))
+        for i in range(N):
+            t = 0.0
+            last = onp.zeros(K)
+            s = state0[i].astype(onp.float64).copy()
+            ll = 0.0
+            for j in range(int(vl[i])):
+                c = marks[i, j]
+                t += lags[i, j]
+                d = t - last[c]
+                ed = onp.exp(-beta[c] * d)
+                inten = lda[i, c] + alpha[c] * beta[c] * s[c] * ed
+                comp = lda[i, c] * d + alpha[c] * s[c] * (1 - ed)
+                ll += onp.log(inten) - comp
+                s[c] = 1 + s[c] * ed
+                last[c] = t
+            for k in range(K):
+                d = mt[i] - last[k]
+                ed = onp.exp(-beta[k] * d)
+                ll -= lda[i, k] * d + alpha[k] * s[k] * (1 - ed)
+                s[k] = s[k] * ed
+            lls[i] = ll
+            out_s[i] = s
+        return lls, out_s
+
+    want_ll, want_s = py_ref()
+    ll, out_s = mx.nd.contrib.hawkesll(
+        mx.np.array(lda), mx.np.array(alpha), mx.np.array(beta),
+        mx.np.array(state0), mx.np.array(lags), mx.np.array(marks),
+        mx.np.array(vl), mx.np.array(mt))
+    onp.testing.assert_allclose(onp.asarray(ll), want_ll, rtol=1e-4)
+    onp.testing.assert_allclose(onp.asarray(out_s), want_s,
+                                rtol=1e-4, atol=1e-6)
+
+    # gradients flow to the intensity parameters (maximum likelihood)
+    from mxnet_tpu import autograd
+    lda_nd = mx.np.array(lda)
+    lda_nd.attach_grad()
+    with autograd.record():
+        ll2, _ = mx.nd.contrib.hawkesll(
+            lda_nd, mx.np.array(alpha), mx.np.array(beta),
+            mx.np.array(state0), mx.np.array(lags), mx.np.array(marks),
+            mx.np.array(vl), mx.np.array(mt))
+        total = ll2.sum()
+    total.backward()
+    g = onp.asarray(lda_nd.grad)
+    assert onp.isfinite(g).all() and onp.abs(g).sum() > 0
+
+
+def test_ste_ops_forward_quantize_backward_identity():
+    """round_ste/sign_ste (parity: `src/operator/contrib/stes_op.cc`):
+    forward quantizes, backward is the straight-through identity."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+
+    x = mx.np.array([-1.6, -0.4, 0.4, 1.6])
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.contrib.round_ste(x)
+        loss = (y * mx.np.array([1.0, 2.0, 3.0, 4.0])).sum()
+    loss.backward()
+    onp.testing.assert_array_equal(onp.asarray(y), [-2, 0, 0, 2])
+    onp.testing.assert_array_equal(onp.asarray(x.grad), [1, 2, 3, 4])
+
+    x2 = mx.np.array([-0.3, 0.0, 2.5])
+    x2.attach_grad()
+    with autograd.record():
+        s = mx.nd.contrib.sign_ste(x2)
+        l2 = (s * s).sum()
+    l2.backward()
+    onp.testing.assert_array_equal(onp.asarray(s), [-1, 0, 1])
+    # straight-through: dl/dx == dl/ds == 2*s exactly (plain sign would
+    # give all-zero gradients)
+    onp.testing.assert_array_equal(onp.asarray(x2.grad), [-2, 0, 2])
+
+
+def test_round_ste_half_away_from_zero():
+    import numpy as onp
+    import mxnet_tpu as mx
+    y = mx.nd.contrib.round_ste(mx.np.array([0.5, 1.5, -0.5, -1.5]))
+    onp.testing.assert_array_equal(onp.asarray(y), [1, 2, -1, -2])
+
+
+def test_hawkesll_tolerates_padded_marks():
+    """-1 mark padding past valid_length (the standard ragged convention)
+    must not NaN the loglik or its gradient."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    lda = mx.np.ones((1, 2)) * 1.5
+    marks = mx.np.array([[0, -1, -1]], dtype="int32")
+    lags = mx.np.array([[1.0, 0.0, 0.0]])
+    ll, st = mx.nd.contrib.hawkesll(
+        lda, mx.np.array([0.2, 0.3]), mx.np.array([1.0, 2.0]),
+        mx.np.zeros((1, 2)), lags, marks, mx.np.array([1.0]),
+        mx.np.array([5.0]))
+    assert onp.isfinite(onp.asarray(ll)).all()
+    assert onp.isfinite(onp.asarray(st)).all()
