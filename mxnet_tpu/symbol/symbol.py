@@ -42,17 +42,79 @@ def register_sym_op(name, fn):
 
 def _resolve_op(name):
     """Find the eager implementation for an op name: explicit registry,
-    then `mx.npx`, `mx.np`, `mx.contrib`."""
+    then `mx.npx`, `mx.np`, `mx.contrib`, and finally the legacy `mx.nd`
+    op corpus — the last is what makes STOCK MXNet `model-symbol.json`
+    graphs executable here: their nodes carry the classic CamelCase op
+    names (`Convolution`, `BatchNorm`, `SoftmaxOutput`, ...) that live in
+    `ndarray/legacy_ops.py`."""
     if name in _SYM_OPS:
         return _SYM_OPS[name]
     from .. import numpy_extension as npx
     from .. import numpy as mnp
     from .. import contrib
-    for mod in (npx, mnp, contrib):
+    from ..ndarray import legacy_ops
+    for mod in (npx, mnp, contrib, legacy_ops):
         fn = getattr(mod, name, None)
         if callable(fn):
             return fn
     return None
+
+
+# attr keys the reference serializes for kernel/backend selection only —
+# no numerical meaning on this runtime; silently droppable
+_COSMETIC_ATTRS = {"workspace", "cudnn_tune", "cudnn_off", "ctx",
+                   "__storage_type__", "__dtype__", "__shape__",
+                   "__profiler_scope__"}
+_warned_dropped_attrs = set()
+
+
+def _coerce_attr(v):
+    """Stock symbol.json stores every attr as a STRING ("(3, 3)", "64",
+    "True"); parse literals back, leave enum strings ("relu") alone."""
+    if not isinstance(v, str):
+        return v
+    low = v.strip()
+    if low in ("True", "true"):
+        return True
+    if low in ("False", "false"):
+        return False
+    if low in ("None", "null"):
+        return None
+    import ast
+    try:
+        return ast.literal_eval(low)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def _call_op(fn, op_name, inputs, attrs):
+    """Invoke `fn(*inputs, **attrs)` with JSON-string attrs coerced and
+    keys the implementation doesn't accept handled: cosmetic ones are
+    dropped silently, anything else warns once per (op, key) — dropping
+    a semantic attr silently could change numerics."""
+    import inspect
+    import warnings
+    kwargs = {k: _coerce_attr(v) for k, v in attrs.items()}
+    try:
+        sig = inspect.signature(fn)
+        has_var_kw = any(p.kind == inspect.Parameter.VAR_KEYWORD
+                         for p in sig.parameters.values())
+        if not has_var_kw:
+            accepted = set(sig.parameters)
+            for k in list(kwargs):
+                if k in accepted:
+                    continue
+                kwargs.pop(k)
+                if k not in _COSMETIC_ATTRS and \
+                        (op_name, k) not in _warned_dropped_attrs:
+                    _warned_dropped_attrs.add((op_name, k))
+                    warnings.warn(
+                        f"symbol op {op_name!r}: dropping attr {k!r} the "
+                        "runtime implementation does not accept — verify "
+                        "it has no numerical effect for your graph")
+    except (TypeError, ValueError):
+        pass
+    return fn(*inputs, **kwargs)
 
 
 def _init_builtin_ops():
@@ -227,7 +289,7 @@ class Symbol:
                 if fn is None:
                     raise MXNetError(f"unknown op '{s.op}'")
                 ins = [run(i) for i in s.inputs]
-                val = fn(*ins, **s.attrs)
+                val = _call_op(fn, s.op, ins, s.attrs)
                 if isinstance(val, (tuple, list)) and s._out_index is None:
                     val = list(val)
             cache[key] = val
@@ -335,7 +397,8 @@ def fromjson(json_str: str) -> Symbol:
     built: List[Symbol] = []
     for node in g["nodes"]:
         ins = [built[i[0]] for i in node.get("inputs", [])]
-        attrs = node.get("attrs", {}) or {}
+        # stock files: "attrs" (>=1.2) or "param" (older nnvm exports)
+        attrs = node.get("attrs") or node.get("param") or {}
         if node["op"] == "null":
             built.append(Symbol(None, node["name"]))
         else:

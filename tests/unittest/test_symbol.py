@@ -150,3 +150,100 @@ def test_shared_subexpression_traversal_fast():
     assert len([n for n in s.get_internals()]) == 51
     out = s.eval(a=mx.np.array([1.0]))[0]
     assert float(out.asnumpy()[0]) == 2.0 ** 50
+
+
+def test_stock_mxnet_symbol_json_executes():
+    """A STOCK-format model-symbol.json (classic CamelCase layer ops,
+    every attr a string — exactly what the reference's Symbol.save
+    emits) must parse AND execute against binary .params weights: the
+    checkpoint-migration story end to end (symbol/symbol.py _resolve_op
+    legacy chain + _call_op attr coercion)."""
+    import json as _json
+
+    import numpy as onp
+
+    import mxnet_tpu as mx
+
+    nodes = [
+        {"op": "null", "name": "data", "inputs": []},
+        {"op": "null", "name": "conv0_weight", "inputs": []},
+        {"op": "null", "name": "conv0_bias", "inputs": []},
+        {"op": "Convolution", "name": "conv0",
+         "attrs": {"kernel": "(3, 3)", "num_filter": "4", "pad": "(1, 1)",
+                   "stride": "(1, 1)", "workspace": "1024",
+                   "cudnn_tune": "off"},
+         "inputs": [[0, 0, 0], [1, 0, 0], [2, 0, 0]]},
+        {"op": "Activation", "name": "relu0",
+         "attrs": {"act_type": "relu"}, "inputs": [[3, 0, 0]]},
+        {"op": "Pooling", "name": "pool0",
+         "attrs": {"kernel": "(2, 2)", "pool_type": "max",
+                   "stride": "(2, 2)"}, "inputs": [[4, 0, 0]]},
+        {"op": "Flatten", "name": "flat0", "inputs": [[5, 0, 0]]},
+        {"op": "null", "name": "fc0_weight", "inputs": []},
+        {"op": "null", "name": "fc0_bias", "inputs": []},
+        {"op": "FullyConnected", "name": "fc0",
+         "attrs": {"num_hidden": "3"},
+         "inputs": [[6, 0, 0], [7, 0, 0], [8, 0, 0]]},
+        {"op": "softmax", "name": "prob", "attrs": {"axis": "-1"},
+         "inputs": [[9, 0, 0]]},
+    ]
+    blob = _json.dumps({"nodes": nodes,
+                        "arg_nodes": [0, 1, 2, 7, 8],
+                        "heads": [[10, 0, 0]],
+                        "attrs": {"mxnet_version": ["int", 10700]}})
+    sym = mx.sym.load_json(blob)
+    assert sym.list_arguments() == ["data", "conv0_weight", "conv0_bias",
+                                    "fc0_weight", "fc0_bias"]
+
+    rs = onp.random.RandomState(0)
+    args = {
+        "data": mx.np.array(rs.randn(2, 3, 8, 8).astype("float32")),
+        "conv0_weight": mx.np.array(rs.randn(4, 3, 3, 3).astype("float32")
+                                    * 0.1),
+        "conv0_bias": mx.np.array(onp.zeros(4, "float32")),
+        "fc0_weight": mx.np.array(rs.randn(3, 64).astype("float32") * 0.1),
+        "fc0_bias": mx.np.array(onp.zeros(3, "float32")),
+    }
+    out = sym.eval(**args)[0]
+    assert out.shape == (2, 3)
+    onp.testing.assert_allclose(onp.asarray(out.sum(axis=1)), [1.0, 1.0],
+                                rtol=1e-5)
+
+    # independent numpy forward of the same weights
+    x = onp.asarray(args["data"].asnumpy())
+    w = onp.asarray(args["conv0_weight"].asnumpy())
+    # manual conv with pad 1 (small sizes)
+    xp = onp.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    conv = onp.zeros((2, 4, 8, 8), "float32")
+    for n in range(2):
+        for f in range(4):
+            for i in range(8):
+                for j in range(8):
+                    conv[n, f, i, j] = (xp[n, :, i:i+3, j:j+3] * w[f]).sum()
+    relu = onp.maximum(conv, 0)
+    pool = relu.reshape(2, 4, 4, 2, 4, 2).max(axis=(3, 5))
+    flat = pool.reshape(2, -1)
+    fc = flat @ onp.asarray(args["fc0_weight"].asnumpy()).T
+    e = onp.exp(fc - fc.max(axis=1, keepdims=True))
+    want = e / e.sum(axis=1, keepdims=True)
+    onp.testing.assert_allclose(onp.asarray(out), want, rtol=1e-4,
+                                atol=1e-5)
+
+
+def test_stock_checkpoint_roundtrip_via_model_api(tmp_path):
+    """mx.model.save_checkpoint writes symbol.json + binary .params;
+    load_checkpoint + Executor bind runs it — the reference's
+    Module-era artifact flow."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+
+    x = mx.sym.var("x")
+    w = mx.sym.var("w")
+    y = mx.sym.FullyConnected(x, w, num_hidden=2, no_bias=True)
+    prefix = str(tmp_path / "m")
+    arg = {"w": mx.np.array([[1.0, 0.0, 1.0], [0.0, 2.0, 0.0]])}
+    mx.model.save_checkpoint(prefix, 0, y, arg, {})
+    sym2, arg2, _ = mx.model.load_checkpoint(prefix, 0)
+    out = sym2.eval(x=mx.np.array([[1.0, 2.0, 3.0]]), w=arg2["w"])[0]
+    onp.testing.assert_allclose(onp.asarray(out), [[4.0, 4.0]], rtol=1e-6)
