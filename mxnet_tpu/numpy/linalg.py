@@ -23,3 +23,13 @@ for _name in _NAMES:
         _g[_name] = wrap_fn(_j, _name)
 
 __all__ = [n for n in _NAMES if n in _g]
+
+
+def matrix_transpose(a):
+    """Swap the last two axes (`np.linalg.matrix_transpose`, Array-API)."""
+    from .__init__ import swapaxes
+    return swapaxes(a, -1, -2)
+
+
+if "matrix_transpose" not in __all__:
+    __all__.append("matrix_transpose")

@@ -439,3 +439,18 @@ __all__ += [
     "geometric", "dirichlet", "triangular", "wald", "vonmises", "zipf",
     "hypergeometric", "logseries",
 ]
+
+
+def normal_n(loc=0.0, scale=1.0, batch_shape=None, dtype=None, device=None,
+             ctx=None):
+    """Leading-batch sampler (`npx.random.normal_n` parity): output shape
+    = batch_shape + broadcast(loc, scale)."""
+    from ..numpy_extension import normal_n as _n
+    return _n(loc, scale, batch_shape, dtype, device, ctx)
+
+
+def uniform_n(low=0.0, high=1.0, batch_shape=None, dtype=None, device=None,
+              ctx=None):
+    """Leading-batch sampler (`npx.random.uniform_n` parity)."""
+    from ..numpy_extension import uniform_n as _u
+    return _u(low, high, batch_shape, dtype, device, ctx)
