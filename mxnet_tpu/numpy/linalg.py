@@ -13,7 +13,7 @@ _NAMES = [
     "eigvals", "eigvalsh", "qr", "cholesky", "solve", "lstsq", "pinv",
     "matrix_rank", "matrix_power", "multi_dot", "tensorinv", "tensorsolve",
     "cond", "matrix_norm", "vector_norm", "cross", "diagonal", "outer",
-    "tensordot", "trace", "vecdot", "matmul",
+    "tensordot", "trace", "vecdot", "matmul", "matrix_transpose",
 ]
 
 _g = globals()
@@ -24,12 +24,3 @@ for _name in _NAMES:
 
 __all__ = [n for n in _NAMES if n in _g]
 
-
-def matrix_transpose(a):
-    """Swap the last two axes (`np.linalg.matrix_transpose`, Array-API)."""
-    from .__init__ import swapaxes
-    return swapaxes(a, -1, -2)
-
-
-if "matrix_transpose" not in __all__:
-    __all__.append("matrix_transpose")

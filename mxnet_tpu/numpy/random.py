@@ -441,16 +441,35 @@ __all__ += [
 ]
 
 
+def _n_size(arg0, arg1, batch_shape):
+    """batch_shape + broadcast(arg0, arg1) — the *_n leading-batch form."""
+    import jax.numpy as _jnp
+    from ..ndarray.ndarray import ndarray as _nd
+    if batch_shape is None:
+        bshape = ()
+    elif isinstance(batch_shape, (list, tuple)):
+        bshape = tuple(int(s) for s in batch_shape)
+    else:
+        bshape = (int(batch_shape),)
+    event = _jnp.broadcast_shapes(
+        _jnp.shape(arg0._data if isinstance(arg0, _nd) else arg0),
+        _jnp.shape(arg1._data if isinstance(arg1, _nd) else arg1))
+    return bshape + event
+
+
 def normal_n(loc=0.0, scale=1.0, batch_shape=None, dtype=None, device=None,
              ctx=None):
     """Leading-batch sampler (`npx.random.normal_n` parity): output shape
     = batch_shape + broadcast(loc, scale)."""
-    from ..numpy_extension import normal_n as _n
-    return _n(loc, scale, batch_shape, dtype, device, ctx)
+    return normal(loc, scale, size=_n_size(loc, scale, batch_shape),
+                  dtype=dtype, device=device, ctx=ctx)
 
 
 def uniform_n(low=0.0, high=1.0, batch_shape=None, dtype=None, device=None,
               ctx=None):
     """Leading-batch sampler (`npx.random.uniform_n` parity)."""
-    from ..numpy_extension import uniform_n as _u
-    return _u(low, high, batch_shape, dtype, device, ctx)
+    return uniform(low, high, size=_n_size(low, high, batch_shape),
+                   dtype=dtype, device=device, ctx=ctx)
+
+
+__all__ += ["normal_n", "uniform_n"]

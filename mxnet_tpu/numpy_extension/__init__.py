@@ -1257,35 +1257,6 @@ def savez(file, *args, **kwargs):
     save_arrays(file, data)
 
 
-def _n_sampler(sampler):
-    def fn(arg0=0.0, arg1=1.0, batch_shape=None, dtype=None, device=None,
-           ctx=None):
-        import jax.numpy as _jnp
-        from ..ndarray.ndarray import ndarray as _nd
-        if batch_shape is None:
-            bshape = ()
-        elif isinstance(batch_shape, (list, tuple)):
-            bshape = tuple(int(s) for s in batch_shape)
-        else:
-            bshape = (int(batch_shape),)
-        event = _jnp.broadcast_shapes(
-            _jnp.shape(arg0._data if isinstance(arg0, _nd) else arg0),
-            _jnp.shape(arg1._data if isinstance(arg1, _nd) else arg1))
-        return sampler(arg0, arg1, size=bshape + event, dtype=dtype,
-                       device=device, ctx=ctx)
-    return fn
-
-
-def normal_n(loc=0.0, scale=1.0, batch_shape=None, dtype=None, device=None,
-             ctx=None):
-    """`npx.normal_n`: output shape = batch_shape + broadcast(loc, scale)
-    — the leading-batch sampler form."""
-    from ..numpy.random import normal as _normal
-    return _n_sampler(_normal)(loc, scale, batch_shape, dtype, device, ctx)
-
-
-def uniform_n(low=0.0, high=1.0, batch_shape=None, dtype=None, device=None,
-              ctx=None):
-    """`npx.uniform_n`: output shape = batch_shape + broadcast(low, high)."""
-    from ..numpy.random import uniform as _uniform
-    return _n_sampler(_uniform)(low, high, batch_shape, dtype, device, ctx)
+# *_n leading-batch samplers live in numpy/random.py (npx.random IS that
+# module — numpy_extension re-exports it); top-level npx aliases:
+from ..numpy.random import normal_n, uniform_n  # noqa: E402,F401

@@ -134,3 +134,9 @@ def test_recommenders_mf_example():
 def test_probability_vi_example():
     out = _run("examples/probability_vi.py")
     assert "PROBABILITY VI EXAMPLE OK" in out
+
+
+@pytest.mark.slow
+def test_ssd_detection_example():
+    out = _run("examples/ssd_detection.py", timeout=560)
+    assert "SSD DETECTION EXAMPLE OK" in out
