@@ -140,3 +140,9 @@ def test_probability_vi_example():
 def test_ssd_detection_example():
     out = _run("examples/ssd_detection.py", timeout=560)
     assert "SSD DETECTION EXAMPLE OK" in out
+
+
+@pytest.mark.slow
+def test_gan_example():
+    out = _run("examples/gan_mlp.py", timeout=560)
+    assert "GAN EXAMPLE OK" in out
