@@ -146,3 +146,9 @@ def test_ssd_detection_example():
 def test_gan_example():
     out = _run("examples/gan_mlp.py", timeout=560)
     assert "GAN EXAMPLE OK" in out
+
+
+@pytest.mark.slow
+def test_sparse_wide_deep_example():
+    out = _run("examples/sparse_wide_deep.py", timeout=560)
+    assert "SPARSE WIDE-DEEP EXAMPLE OK" in out
