@@ -106,6 +106,7 @@ def built_train(tmp_path_factory, built):
     return _build_example(built.parent, "mlp_train.cpp", "mlp_train")
 
 
+@pytest.mark.slow
 def test_cpp_training_end_to_end(built_train):
     """C++ builds an MLP, trains it (loss falls), and round-trips params —
     the reference cpp-package's mlp.cpp capability, TPU-native."""

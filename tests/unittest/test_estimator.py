@@ -38,6 +38,7 @@ def _make_est(lr=1.0):
     return est
 
 
+@pytest.mark.slow
 def test_estimator_fit_improves_accuracy():
     data = _toy_data()
     est = _make_est()

@@ -209,6 +209,7 @@ def test_activations():
         assert layer(x).shape == x.shape
 
 
+@pytest.mark.slow
 def test_model_zoo_forward():
     from mxnet_tpu.gluon.model_zoo import vision
     for name in ["resnet18_v1", "mobilenet_v2_0_25", "squeezenet1_0"]:

@@ -4,6 +4,9 @@ kernels, `softmax_output.cc`, legacy layer/random/linalg names)."""
 import numpy as onp
 import pytest
 
+# comprehensive sweep battery: excluded from the fast default
+pytestmark = pytest.mark.slow
+
 import mxnet_tpu as mx
 from mxnet_tpu import autograd
 from mxnet_tpu.test_utils import assert_almost_equal

@@ -49,6 +49,7 @@ def test_gpt_tied_embeddings_and_generate():
     assert out2.shape == (1, 5)
 
 
+@pytest.mark.slow
 def test_gpt_trains():
     m = _tiny_gpt()
     m.hybridize()
@@ -102,6 +103,7 @@ def test_nmt_forward_masks_and_causality():
                                 atol=1e-5)
 
 
+@pytest.mark.slow
 def test_nmt_trains_and_translates():
     m = _tiny_nmt()
     m.hybridize()
@@ -288,6 +290,7 @@ def test_bert_masked_positions_trains():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_remat_matches_no_remat():
     """cfg.remat=True (jax.checkpoint per layer) must not change values or
     gradients under the jitted train step — only the memory/FLOPs trade."""
@@ -339,6 +342,7 @@ def test_remat_call_eager_passthrough():
     assert float(mx.np.abs(g).sum()) > 0  # params still got gradients
 
 
+@pytest.mark.slow
 def test_gpt_kv_cache_decode_matches_full_recompute():
     """The jitted KV-cache scan must reproduce the full-context recompute
     decode token-for-token (greedy)."""
@@ -357,6 +361,7 @@ def test_gpt_kv_cache_decode_matches_full_recompute():
     assert fast.shape == (3, 16)
 
 
+@pytest.mark.slow
 def test_gpt_kv_cache_decode_untied_and_sampled():
     from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM
     cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1, num_heads=4,
@@ -379,6 +384,7 @@ def test_gpt_kv_cache_decode_untied_and_sampled():
     assert ((arr >= 0) & (arr < 64)).all()
 
 
+@pytest.mark.slow
 def test_gpt_sliding_window_decode_consistent():
     """GPTConfig(window=w): the cached decode scan's windowed mask must
     agree with the full-recompute forward (whose attention masks to the
@@ -580,6 +586,7 @@ def test_bert_sliding_window_config():
         BertConfig(window=0, **kw)
 
 
+@pytest.mark.slow
 def test_gpt_rope_decode_consistent_and_trains():
     """GPTConfig(rope=True): rotary embeddings replace the learned
     position table (no position_embed parameter), causality holds, the
@@ -626,6 +633,7 @@ def test_gpt_rope_decode_consistent_and_trains():
     assert losses[-1] < losses[0], (losses[0], losses[-1])
 
 
+@pytest.mark.slow
 def test_gpt_gqa_decode_consistent_and_trains():
     """GPTConfig(num_kv_heads=2) with num_heads=4 (GQA): the fused qkv
     projection shrinks, the decode KV cache stores only 2 heads, cached

@@ -5,6 +5,9 @@ reference and, for the differentiable core, against finite differences."""
 import numpy as onp
 import pytest
 
+# comprehensive sweep battery: excluded from the fast default
+pytestmark = pytest.mark.slow
+
 import mxnet_tpu as mx
 from mxnet_tpu import autograd
 from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient

@@ -11,6 +11,9 @@ installed (`test_onnx.py` does that leg).
 import numpy as onp
 import pytest
 
+# comprehensive sweep battery: excluded from the fast default
+pytestmark = pytest.mark.slow
+
 import mxnet_tpu as mx
 from mxnet_tpu.gluon import nn
 from mxnet_tpu.gluon import rnn as rnn_mod

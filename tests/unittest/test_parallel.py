@@ -444,6 +444,7 @@ def test_ulysses_head_divisibility_error():
         ulysses_attention(q, q, q, mesh)
 
 
+@pytest.mark.slow
 def test_bert_masked_remat_dp_sp_tp_matches_single_device():
     """Full composition on the 8-device mesh: masked-position BERT with
     per-layer remat, sharded dp=2 sp=2 tp=2, must reproduce the

@@ -48,6 +48,7 @@ def test_pipeline_matches_sequential():
                                     rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.slow
 def test_pipeline_differentiable():
     params = _params(seed=2)
     rng = onp.random.RandomState(3)

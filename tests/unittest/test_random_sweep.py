@@ -6,6 +6,9 @@ pattern (`common.py:218`)."""
 import numpy as onp
 import pytest
 
+# comprehensive sweep battery: excluded from the fast default
+pytestmark = pytest.mark.slow
+
 import mxnet_tpu as mx
 from mxnet_tpu.test_utils import retry
 

@@ -150,6 +150,7 @@ def test_flash_gqa_grouped_kernel_lowers_for_tpu():
     assert f"tensor<{b * h}x{l}x{d}xbf16" not in txt
 
 
+@pytest.mark.slow
 def test_full_gpt_train_step_composition_lowers_for_tpu():
     """The bench-suite GPT leg composition — RoPE + sliding window + GQA
     + remat + fused softmax-CE inside ONE sharded train step — must pass
