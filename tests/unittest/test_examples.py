@@ -152,3 +152,9 @@ def test_gan_example():
 def test_sparse_wide_deep_example():
     out = _run("examples/sparse_wide_deep.py", timeout=560)
     assert "SPARSE WIDE-DEEP EXAMPLE OK" in out
+
+
+@pytest.mark.slow
+def test_cnn_text_classification_example():
+    out = _run("examples/cnn_text_classification.py", timeout=560)
+    assert "TEXT-CNN EXAMPLE OK" in out
