@@ -27,7 +27,7 @@ from mxnet_tpu import autograd
 from mxnet_tpu.gluon import Trainer, nn
 
 VOCAB, SEQ, EMBED = 200, 20, 24
-PATTERNS = [(7, 3, 11), (5, 5, 2), (13, 1, 9)]   # ordered trigrams
+PATTERNS = [(27, 23, 31), (25, 25, 22), (33, 21, 29)]   # ordered trigrams
 
 
 class TextCNN(nn.HybridBlock):
@@ -49,14 +49,23 @@ class TextCNN(nn.HybridBlock):
 
 
 def make_data(rs, n):
+    """Positives contain a pattern IN ORDER; negatives contain the SAME
+    tokens shuffled out of order — identical bags of words, so only an
+    order-sensitive model (the conv filters) can separate the classes."""
     x = rs.randint(20, VOCAB, (n, SEQ)).astype("int32")
     y = onp.zeros(n, "int32")
     pos = rs.rand(n) < 0.5
-    for i in onp.where(pos)[0]:
-        pat = PATTERNS[rs.randint(len(PATTERNS))]
+    for i in range(n):
+        pat = list(PATTERNS[rs.randint(len(PATTERNS))])
+        if pos[i]:
+            y[i] = 1
+        else:
+            while True:                      # derangement of the trigram
+                rs.shuffle(pat)
+                if tuple(pat) not in PATTERNS:
+                    break
         at = rs.randint(0, SEQ - 3)
         x[i, at:at + 3] = pat
-        y[i] = 1
     return x, y
 
 
