@@ -199,3 +199,25 @@ def test_full_gpt_train_step_composition_lowers_for_tpu():
         assert n >= 2 * 3 + 2, f"expected >= 8 kernel custom calls, got {n}"
     finally:
         _att._use_pallas = orig
+
+
+@pytest.mark.parametrize("bq,bk", [(512, 256), (256, 512), (512, 512)])
+def test_flash_block_size_variants_lower_for_tpu(bq, bk):
+    """The H2 ablation sweep's non-default (block_q, block_k) tilings must
+    pass Mosaic lowering (lane/sublane layout constraints bind at 512)."""
+    b, h, l, d = 1, 2, 1024, 64
+    q = jnp.ones((b, h, l, d), jnp.bfloat16)
+
+    def f(q, k, v):
+        return flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk)
+
+    txt = _lower_for_tpu(f, q, q, q)
+    assert txt.count("tpu_custom_call") == 1
+
+    def train(q, k, v):
+        def loss(q, k, v):
+            return jnp.sum(f(q, k, v).astype(jnp.float32) ** 2)
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    txt = _lower_for_tpu(train, q, q, q)
+    assert txt.count("tpu_custom_call") == 3
