@@ -20,7 +20,7 @@ from .lists import (CONDITIONAL_FP32_OPS, FP16_FP32_FUNCS, FP16_FUNCS,
                     WIDEST_TYPE_CASTS)
 
 __all__ = ["init", "init_trainer", "scale_loss", "unscale", "convert_hybrid_block",
-           "LossScaler", "mixed_precision_dtype"]
+           "convert_symbol", "LossScaler", "mixed_precision_dtype"]
 
 _state = {"enabled": False, "dtype": jnp.bfloat16, "scaler": None}
 
@@ -164,3 +164,72 @@ def convert_hybrid_block(block, target_dtype="bfloat16", target_dtype_ops=None,
     dt = jnp.bfloat16 if str(target_dtype) in ("bfloat16", "bf16") else jnp.float16
     block.cast(dt)
     return block
+
+
+def convert_symbol(sym, target_dtype="bfloat16", target_dtype_ops=None,
+                   fp32_ops=None, conditional_fp32_ops=None,
+                   excluded_sym_names=None, data_names=None,
+                   cast_optional_params=False):
+    """Rewrite a Symbol DAG for mixed precision (parity:
+    `python/mxnet/amp/amp.py:431` `convert_symbol` over the reference's
+    `src/nnvm/low_precision_pass.cc` graph pass).
+
+    Inserts `amp_cast` nodes so ops on the TARGET list consume
+    `target_dtype` inputs and ops on the FP32 list consume float32;
+    user `fp32_ops`/`conditional_fp32_ops` are DENY lists that take
+    precedence over the target list (same precedence as the live
+    `amp.init` hook).  Casts are shared per (producer, dtype) like the
+    reference pass, and `amp_cast` only converts float inputs — integer
+    and bool values pass through unchanged (reference `amp_cast.h`
+    semantics).  Variables are never retyped (`cast_optional_params` and
+    `data_names` are accepted for signature parity; parameter arrays
+    stay as bound — the runtime cast is free under XLA).  WIDEST-list
+    ops need no multicast here: the jnp-backed op corpus already
+    promotes to the widest input dtype.
+
+    `mx.model.save_checkpoint(..., remove_amp_cast=True)` strips the
+    inserted nodes again for full-precision checkpoints.
+    """
+    from ..symbol.symbol import Symbol, _auto_name
+
+    dt_name = "bfloat16" if str(target_dtype) in ("bfloat16", "bf16") \
+        else "float16"
+    target = set(target_dtype_ops) if target_dtype_ops is not None \
+        else _TARGET
+    fp32 = _FP32 | set(fp32_ops or ())
+    cond = {}
+    for op, attr, values in (conditional_fp32_ops or ()):
+        cond.setdefault(op, []).append((attr, set(values)))
+    excluded = set(excluded_sym_names or ())
+    memo = {}
+    casts = {}
+
+    def cast_to(node, dtype):
+        key = (id(node), dtype)
+        if key not in casts:
+            casts[key] = Symbol("amp_cast", _auto_name("amp_cast"),
+                                [node], {"dtype": dtype})
+        return casts[key]
+
+    def wants_fp32(s):
+        if s.op in fp32:           # built-in + user deny lists
+            return True
+        for attr, values in cond.get(s.op, ()):
+            if str(s.attrs.get(attr)) in values:
+                return True
+        return False
+
+    def rebuild(s):
+        if id(s) in memo:
+            return memo[id(s)]
+        ins = [rebuild(i) for i in s.inputs]
+        if s.op is not None and s.name not in excluded:
+            if wants_fp32(s):          # deny lists win over target
+                ins = [cast_to(i, "float32") for i in ins]
+            elif s.op in target:
+                ins = [cast_to(i, dt_name) for i in ins]
+        out = Symbol(s.op, s.name, ins, dict(s.attrs), s._out_index)
+        memo[id(s)] = out
+        return out
+
+    return rebuild(sym)

@@ -734,6 +734,12 @@ def cast(data, dtype):
 
 
 def amp_cast(data, dtype):
+    """Cast BETWEEN float dtypes only: integer/bool inputs pass through
+    unchanged (reference `src/operator/tensor/amp_cast.h` semantics —
+    the AMP pass must not change integer-op results)."""
+    if not jnp.issubdtype(jnp.asarray(data._data if isinstance(data, ndarray)
+                                      else data).dtype, jnp.floating):
+        return data
     return data.astype(dtype)
 
 

@@ -284,3 +284,87 @@ def test_fp16_trainer_overflow_drill():
         onp.testing.assert_allclose(w_after, w_before - 0.1, rtol=1e-3)
     finally:
         amp.disable()
+
+
+def test_convert_symbol_inserts_and_strips_amp_casts(tmp_path):
+    """amp.convert_symbol (parity: `python/mxnet/amp/amp.py:431`): TARGET
+    ops get target-dtype inputs via inserted amp_cast nodes (shared per
+    producer), excluded names stay untouched, eval produces the AMP
+    dtype, and save_checkpoint(remove_amp_cast=True) strips the nodes."""
+    import json
+
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import amp
+
+    x = mx.sym.var("x")
+    w1 = mx.sym.var("w1")
+    w2 = mx.sym.var("w2")
+    h = mx.sym.FullyConnected(x, w1, num_hidden=8, no_bias=True,
+                              name="fc1")
+    h = mx.sym.Activation(h, act_type="relu", name="act1")
+    y = mx.sym.FullyConnected(h, w2, num_hidden=4, no_bias=True,
+                              name="fc2")
+
+    conv = amp.convert_symbol(y, target_dtype="bf16")   # alias accepted
+    g = json.loads(conv.tojson())
+    ops = [n["op"] for n in g["nodes"]]
+    assert ops.count("amp_cast") >= 3          # x, w1 and fc2's inputs
+    assert all(n["attrs"]["dtype"] == "bfloat16"
+               for n in g["nodes"] if n["op"] == "amp_cast")
+
+    # shared-cast rule: ONE producer feeding TWO target ops is cast once
+    z = mx.sym.FullyConnected(h, w2, num_hidden=4, no_bias=True,
+                              name="fc3")
+    both = mx.sym.Group([y, z])
+    gs = json.loads(amp.convert_symbol(both).tojson())
+    h_casts = [n for n in gs["nodes"] if n["op"] == "amp_cast"
+               and gs["nodes"][n["inputs"][0][0]]["name"] == "act1"]
+    assert len(h_casts) == 1, gs["nodes"]
+
+    args = {"x": mx.np.array(onp.ones((2, 6), "float32")),
+            "w1": mx.np.array(onp.ones((8, 6), "float32") * 0.1),
+            "w2": mx.np.array(onp.ones((4, 8), "float32") * 0.1)}
+    out = conv.eval(**args)[0]
+    assert out.dtype == mx.np.bfloat16
+    ref = y.eval(**args)[0]
+    onp.testing.assert_allclose(onp.asarray(out.astype("float32")),
+                                onp.asarray(ref), rtol=2e-2)
+
+    # exclusion: fc2 keeps fp32 math (its inputs uncast)
+    conv2 = amp.convert_symbol(y, target_dtype="bfloat16",
+                               excluded_sym_names=["fc1", "fc2"])
+    g2 = json.loads(conv2.tojson())
+    assert all(n["op"] != "amp_cast" for n in g2["nodes"])
+
+    # deny lists beat the default target list
+    conv3 = amp.convert_symbol(y, fp32_ops=["FullyConnected"])
+    g4 = json.loads(conv3.tojson())
+    fc_in_ops = {g4["nodes"][i[0]]["op"]
+                 for n in g4["nodes"] if n["op"] == "FullyConnected"
+                 for i in n["inputs"]}
+    casts_dt = {n["attrs"]["dtype"] for n in g4["nodes"]
+                if n["op"] == "amp_cast"}
+    assert casts_dt == {"float32"}, casts_dt
+
+    # conditional fp32 routes key on node attrs
+    conv4 = amp.convert_symbol(
+        y, conditional_fp32_ops=[("Activation", "act_type", ["relu"])])
+    g5 = json.loads(conv4.tojson())
+    act = next(n for n in g5["nodes"] if n["op"] == "Activation")
+    act_in = g5["nodes"][act["inputs"][0][0]]
+    assert act_in["op"] == "amp_cast" and \
+        act_in["attrs"]["dtype"] == "float32"
+
+    # amp_cast passes integers through (reference amp_cast.h semantics)
+    iv = mx.npx.amp_cast(mx.np.array([1, 2], dtype="int32"), "bfloat16")
+    assert iv.dtype == mx.np.int32
+
+    # checkpoint save strips the casts (Module-era remove_amp_cast flow)
+    prefix = str(tmp_path / "m")
+    mx.model.save_checkpoint(prefix, 0, conv, {k: v for k, v in args.items()
+                                               if k != "x"}, {})
+    sym2, _, _ = mx.model.load_checkpoint(prefix, 0)
+    g3 = json.loads(sym2.tojson())
+    assert all(n["op"] != "amp_cast" for n in g3["nodes"])
