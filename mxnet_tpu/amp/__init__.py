@@ -20,7 +20,8 @@ from .lists import (CONDITIONAL_FP32_OPS, FP16_FP32_FUNCS, FP16_FUNCS,
                     WIDEST_TYPE_CASTS)
 
 __all__ = ["init", "init_trainer", "scale_loss", "unscale", "convert_hybrid_block",
-           "convert_symbol", "LossScaler", "mixed_precision_dtype"]
+           "convert_symbol", "convert_model", "LossScaler",
+           "mixed_precision_dtype"]
 
 _state = {"enabled": False, "dtype": jnp.bfloat16, "scaler": None}
 
@@ -233,3 +234,38 @@ def convert_symbol(sym, target_dtype="bfloat16", target_dtype_ops=None,
         return out
 
     return rebuild(sym)
+
+
+def convert_model(sym, arg_params, aux_params, input_dtypes=None,
+                  target_dtype="bfloat16", target_dtype_ops=None,
+                  fp32_ops=None, conditional_fp32_ops=None,
+                  excluded_sym_names=None, cast_params_offline=False):
+    """Module-era AMP conversion (parity: `python/mxnet/amp/amp.py:570`
+    `convert_model`): `convert_symbol` on the graph plus, with
+    `cast_params_offline=True`, an offline cast of float parameters to
+    the AMP dtype (params consumed only by TARGET-list ops can skip the
+    runtime cast).  Returns (symbol, arg_params, aux_params).
+    `input_dtypes` is accepted for signature parity; inputs keep their
+    bound dtypes (the inserted casts handle conversion at run time)."""
+    csym = convert_symbol(sym, target_dtype=target_dtype,
+                          target_dtype_ops=target_dtype_ops,
+                          fp32_ops=fp32_ops,
+                          conditional_fp32_ops=conditional_fp32_ops,
+                          excluded_sym_names=excluded_sym_names)
+    if cast_params_offline:
+        dt = "bfloat16" if str(target_dtype) in ("bfloat16", "bf16") \
+            else "float16"
+
+        def cast_dict(d):
+            out = {}
+            for k, v in (d or {}).items():
+                is_float = hasattr(v, "dtype") and \
+                    jnp.issubdtype(jnp.asarray(
+                        v._data if hasattr(v, "_data") else v).dtype,
+                        jnp.floating)
+                out[k] = v.astype(dt) if is_float else v
+            return out
+
+        arg_params = cast_dict(arg_params)
+        aux_params = cast_dict(aux_params)
+    return csym, arg_params, aux_params

@@ -368,3 +368,30 @@ def test_convert_symbol_inserts_and_strips_amp_casts(tmp_path):
     sym2, _, _ = mx.model.load_checkpoint(prefix, 0)
     g3 = json.loads(sym2.tojson())
     assert all(n["op"] != "amp_cast" for n in g3["nodes"])
+
+
+def test_convert_model_casts_params_offline():
+    """amp.convert_model (parity: amp.py:570): graph converted +
+    float params offline-cast when requested; int aux passes through."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import amp
+
+    x = mx.sym.var("x")
+    w = mx.sym.var("w")
+    y = mx.sym.FullyConnected(x, w, num_hidden=4, no_bias=True)
+    arg = {"w": mx.np.array(onp.ones((4, 6), "float32"))}
+    aux = {"step": mx.np.array([3], dtype="int32")}
+
+    csym, carg, caux = amp.convert_model(y, arg, aux,
+                                         cast_params_offline=True)
+    assert carg["w"].dtype == mx.np.bfloat16
+    assert caux["step"].dtype == mx.np.int32
+    out = csym.eval(x=mx.np.array(onp.ones((2, 6), "float32")),
+                    w=carg["w"])[0]
+    assert out.dtype == mx.np.bfloat16
+
+    # without offline casting params stay fp32 (runtime casts only)
+    _, carg2, _ = amp.convert_model(y, arg, aux)
+    assert carg2["w"].dtype == mx.np.float32
