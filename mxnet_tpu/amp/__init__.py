@@ -21,7 +21,10 @@ from .lists import (CONDITIONAL_FP32_OPS, FP16_FP32_FUNCS, FP16_FUNCS,
 
 __all__ = ["init", "init_trainer", "scale_loss", "unscale", "convert_hybrid_block",
            "convert_symbol", "convert_model", "LossScaler",
-           "mixed_precision_dtype"]
+           "mixed_precision_dtype", "list_lp16_ops", "list_fp32_ops",
+           "list_lp16_fp32_ops", "list_conditional_fp32_ops",
+           "list_widest_type_cast", "list_loss_output_functions",
+           "list_lp16_use_fp32_params"]
 
 _state = {"enabled": False, "dtype": jnp.bfloat16, "scaler": None}
 
@@ -269,3 +272,45 @@ def convert_model(sym, arg_params, aux_params, input_dtypes=None,
         arg_params = cast_dict(arg_params)
         aux_params = cast_dict(aux_params)
     return csym, arg_params, aux_params
+
+
+# --- list accessors (parity: `amp.py` list_lp16_ops & friends) -----------
+
+def list_lp16_ops(target_dtype="bfloat16"):
+    """Ops that run in the low-precision dtype (the TARGET list)."""
+    return list(TARGET_DTYPE_OPS)
+
+
+def list_fp32_ops(target_dtype="bfloat16"):
+    """Ops pinned to float32."""
+    return list(FP32_OPS)
+
+
+def list_lp16_fp32_ops(target_dtype="bfloat16"):
+    """Ops that can run in either dtype (no forced cast)."""
+    from .lists import FP16_FP32_OPS
+    return list(FP16_FP32_OPS)
+
+
+def list_conditional_fp32_ops(target_dtype="bfloat16"):
+    """[(op, attr, values)] routes forced to fp32 when the attr matches."""
+    return [(op, attr, list(values))
+            for op, (attr, values) in CONDITIONAL_FP32_OPS.items()]
+
+
+def list_widest_type_cast(target_dtype="bfloat16"):
+    """Multi-input ops cast to the widest input dtype."""
+    return list(WIDEST_TYPE_CASTS)
+
+
+def list_loss_output_functions(target_dtype="bfloat16"):
+    """Loss outputs kept in fp32 (here: every gluon loss — losses compute
+    in fp32 by design, `gluon/loss.py`)."""
+    from ..gluon import loss as _loss
+    return [n for n in _loss.__all__ if n.endswith("Loss")]
+
+
+def list_lp16_use_fp32_params(target_dtype="bfloat16"):
+    """Ops that take lp16 activations but keep fp32 master params (the
+    bf16-first design needs none — optimizer state is fp32 already)."""
+    return []

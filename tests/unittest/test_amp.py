@@ -395,3 +395,15 @@ def test_convert_model_casts_params_offline():
     # without offline casting params stay fp32 (runtime casts only)
     _, carg2, _ = amp.convert_model(y, arg, aux)
     assert carg2["w"].dtype == mx.np.float32
+
+
+def test_amp_list_accessors():
+    """Parity: amp.py list_* helpers expose the cast lists."""
+    from mxnet_tpu import amp
+    assert "FullyConnected" in amp.list_lp16_ops()
+    assert set(amp.list_fp32_ops()) & {"softmax", "log_softmax", "norm"}
+    assert amp.list_lp16_fp32_ops()
+    assert all(len(t) == 3 for t in amp.list_conditional_fp32_ops())
+    assert amp.list_widest_type_cast()
+    assert "SoftmaxCrossEntropyLoss" in amp.list_loss_output_functions()
+    assert amp.list_lp16_use_fp32_params() == []
