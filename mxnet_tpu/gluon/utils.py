@@ -8,7 +8,8 @@ from ..device import Device
 from ..ndarray.ndarray import ndarray
 
 __all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
-           "download"]
+           "download" "replace_file",
+]
 
 
 def split_data(data: ndarray, num_slice: int, batch_axis=0, even_split=True):
@@ -78,3 +79,10 @@ def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
              verify_ssl=True):
     raise MXNetError("network egress is unavailable in this environment; "
                      "place files locally and pass the path instead")
+
+
+def replace_file(src, dst):
+    """Atomic rename (parity: `gluon/utils.py:210` — there a fallback for
+    pre-3.3 Pythons; `os.replace` is atomic on every platform we run)."""
+    import os
+    os.replace(src, dst)

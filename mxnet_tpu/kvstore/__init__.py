@@ -7,4 +7,25 @@ from .horovod import Horovod
 from .byteps import BytePS
 
 __all__ = ["KVStoreBase", "KVStore", "create", "GradientCompression",
-           "Horovod", "BytePS"]
+           "Horovod", "BytePS" "KVStoreServer",
+]
+
+
+class KVStoreServer:
+    """Parity: `python/mxnet/kvstore/kvstore_server.py` `KVStoreServer`.
+
+    The reference runs dedicated ps-lite server processes that own the
+    aggregated parameters; in the GSPMD design there is no separate
+    server role — every process participates in the collective reduce
+    (SURVEY §5.8), so `run()` documents that and returns immediately
+    instead of blocking like a ps-lite event loop."""
+
+    def __init__(self, kvstore):
+        self.kvstore = kvstore
+
+    def run(self):
+        import logging
+        logging.getLogger(__name__).info(
+            "KVStoreServer.run(): no-op on the collective backend — "
+            "there is no server role; workers allreduce directly "
+            "(dist kvstore docs)")
