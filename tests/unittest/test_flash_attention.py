@@ -507,3 +507,38 @@ def test_dot_product_attention_gqa_fallback_validates_heads():
     k = _rand((1, 3, 16, 8), seed=45)
     with pytest.raises(ValueError, match="multiple of kv heads"):
         dot_product_attention(q, k, k, use_flash=False)
+
+
+def test_flash_gqa_dropout_backward_consistent():
+    """GQA folding keys the dropout hash on FOLDED row ids — the same ids
+    must reproduce in dq/dkv (finite-difference at fixed seed, grouped
+    K/V, for q AND k gradients)."""
+    b, h, g, l, d = 1, 4, 2, 32, 8
+    q = _rand((b, h, l, d), seed=50)
+    k = _rand((b, g, l, d), seed=51)
+    v = _rand((b, g, l, d), seed=52)
+
+    def f(q, k):
+        return jnp.sum(flash_attention(q, k, v, block_q=16, block_k=16,
+                                       dropout_rate=0.3,
+                                       dropout_seed=9) ** 2)
+
+    gq, gk = jax.grad(f, argnums=(0, 1))(q, k)
+    # eps large enough that float32 evaluation noise (~1e-5 relative on
+    # f ~ 50) doesn't swamp the quotient; f is smooth in the INPUTS at
+    # fixed dropout seed, so central-difference truncation stays small
+    eps = 1e-2
+    rng = onp.random.RandomState(0)
+    for arr, grad, which in ((q, gq, 0), (k, gk, 1)):
+        for _ in range(3):
+            i = tuple(rng.randint(0, s) for s in arr.shape)
+            dv = onp.zeros(arr.shape, onp.float32)
+            dv[i] = eps
+            if which == 0:
+                fd = (float(f(arr + dv, k)) - float(f(arr - dv, k))) \
+                    / (2 * eps)
+            else:
+                fd = (float(f(q, arr + dv)) - float(f(q, arr - dv))) \
+                    / (2 * eps)
+            onp.testing.assert_allclose(fd, float(grad[i]), rtol=2e-2,
+                                        atol=5e-3)
