@@ -324,3 +324,28 @@ def test_distributions_eager_autograd_bridge():
         lp = g.log_prob(mx.np.array([1.5])).sum()
     lp.backward()
     assert onp.isfinite(float(a.grad[0])) and float(a.grad[0]) != 0.0
+
+
+def test_kl_eager_bridge_other_families():
+    """The kl_divergence eager bridge works for every registered family,
+    not just Normal: Gamma and Beta gradients reach the parameters."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon.probability import Beta, Gamma, kl_divergence
+
+    a = mx.np.array([2.0])
+    a.attach_grad()
+    with autograd.record():
+        kl = kl_divergence(Gamma(a, 1.0), Gamma(3.0, 1.0)).sum()
+    kl.backward()
+    g = float(a.grad[0])
+    assert onp.isfinite(g) and g != 0.0
+
+    p = mx.np.array([2.0])
+    p.attach_grad()
+    with autograd.record():
+        kl2 = kl_divergence(Beta(p, 2.0), Beta(3.0, 3.0)).sum()
+    kl2.backward()
+    g2 = float(p.grad[0])
+    assert onp.isfinite(g2) and g2 != 0.0
