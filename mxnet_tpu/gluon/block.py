@@ -40,6 +40,17 @@ __all__ = ["Block", "HybridBlock", "SymbolBlock", "nn_block_doc"]
 _amp_dtype = [None]  # set by mxnet_tpu.amp.init()
 
 
+def _check_load_dtype(name, v, p):
+    """The reference's Parameter._load_init asserts dtype match unless
+    cast_dtype=True (`python/mxnet/gluon/parameter.py`) — a f64/f16
+    checkpoint must not silently degrade to the Parameter dtype."""
+    if jnp.dtype(v.dtype) != jnp.dtype(p.dtype):
+        raise MXNetError(
+            f"parameter {name}: file dtype {jnp.dtype(v.dtype).name} != "
+            f"parameter dtype {jnp.dtype(p.dtype).name}; pass "
+            "cast_dtype=True to cast on load")
+
+
 class _HookHandle:
     def __init__(self, hooks: "OrderedDict", key: int):
         self._hooks, self._key = hooks, key
@@ -200,8 +211,11 @@ class Block:
                     raise MXNetError(f"parameter {name} missing in {filename}")
                 continue
             v = loaded[name]
-            if cast_dtype and dtype_source == "saved":
-                p.cast(v.dtype)   # set_data then keeps the file's dtype
+            if cast_dtype:
+                if dtype_source == "saved":
+                    p.cast(v.dtype)   # set_data then keeps the file's dtype
+            else:
+                _check_load_dtype(name, v, p)
             p.set_data(v)         # set_data casts to the param dtype
         if not ignore_extra:
             extra = set(loaded) - set(params)
@@ -217,7 +231,11 @@ class Block:
         for name, p in params.items():
             if name in param_dict:
                 v = param_dict[name]
-                p.set_data(v.data() if isinstance(v, Parameter) else v)
+                if isinstance(v, Parameter):
+                    v = v.data()
+                if not cast_dtype:
+                    _check_load_dtype(name, v, p)
+                p.set_data(v)
             elif not allow_missing:
                 raise MXNetError(f"parameter {name} missing")
         self._invalidate_cache()

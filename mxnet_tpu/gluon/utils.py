@@ -8,7 +8,7 @@ from ..device import Device
 from ..ndarray.ndarray import ndarray
 
 __all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
-           "download" "replace_file",
+           "download", "replace_file",
 ]
 
 

@@ -7,7 +7,7 @@ from .horovod import Horovod
 from .byteps import BytePS
 
 __all__ = ["KVStoreBase", "KVStore", "create", "GradientCompression",
-           "Horovod", "BytePS" "KVStoreServer",
+           "Horovod", "BytePS", "KVStoreServer",
 ]
 
 

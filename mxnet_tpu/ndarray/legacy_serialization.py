@@ -119,7 +119,10 @@ def _read_array(r: _Reader) -> onp.ndarray:
     elif magic == _V1:
         stype, nad, sshape = 0, 0, None
         shape = _read_shape(r)
-        if shape is None:
+        if shape is None or shape == ():
+            # V1/legacy ndim==0 means "none" and the record ENDS after the
+            # shape (NDArray::LegacyLoad, ndarray.cc: shape_is_none) — no
+            # ctx/dtype/data follow, so reading on would misalign the stream
             return onp.zeros((0,), onp.float32)
     else:
         # oldest layout: the magic word IS ndim, dims are uint32
@@ -127,6 +130,8 @@ def _read_array(r: _Reader) -> onp.ndarray:
         if magic > 32:   # not a plausible rank
             raise MXNetError(f"invalid NDArray file format: bad magic "
                              f"0x{magic:x}")
+        if magic == 0:   # ndim==0 -> "none"; record ends here too
+            return onp.zeros((0,), onp.float32)
         shape = tuple(r.u32s(magic))
     r.i32()  # dev_type — always loaded to cpu
     r.i32()  # dev_id

@@ -292,3 +292,58 @@ def test_load_parameters_dtype_source_saved(tmp_path):
     assert net2.weight.data().dtype == mx.np.float32
     with pytest.raises(MXNetError, match="dtype_source"):
         net2.load_parameters(p, dtype_source="nope")
+
+
+def test_hand_encoded_v1_none_record_keeps_stream_aligned(tmp_path):
+    """V1/legacy ndim==0 records are 'none' arrays whose record ENDS after
+    the shape (NDArray::LegacyLoad: shape_is_none -> *this = NDArray());
+    the next array in the file must still parse correctly."""
+    V1 = 0xF993FAC8
+    follow = onp.asarray([3.0, 4.0], onp.float32)
+    none_rec = struct.pack("<I", V1) + struct.pack("<i", 0)  # ndim 0, ends
+    next_rec = struct.pack("<I", V1) + _shape(follow.shape)
+    next_rec += struct.pack("<ii", 1, 0) + struct.pack("<i", 0)
+    next_rec += follow.tobytes()
+    blob = struct.pack("<QQQ", LIST_MAGIC, 0, 2) + none_rec + next_rec
+    blob += struct.pack("<Q", 2)
+    for nm in (b"empty", b"full"):
+        blob += struct.pack("<Q", len(nm)) + nm
+    f = tmp_path / "v1none.params"
+    f.write_bytes(blob)
+    d = load_legacy_ndarray_dict(str(f))
+    assert d["empty"].size == 0
+    onp.testing.assert_array_equal(d["full"], follow)
+
+
+def test_hand_encoded_prev1_ndim0_none_record(tmp_path):
+    """Pre-V1 layout: magic IS ndim; magic==0 is a none record that ends
+    immediately, and the following record must stay aligned."""
+    follow = onp.asarray([7.0], onp.float32)
+    none_rec = struct.pack("<I", 0)                     # ndim 0: ends here
+    next_rec = struct.pack("<I", 1) + struct.pack("<I", 1)   # ndim 1, dim 1
+    next_rec += struct.pack("<ii", 1, 0) + struct.pack("<i", 0)
+    next_rec += follow.tobytes()
+    blob = struct.pack("<QQQ", LIST_MAGIC, 0, 2) + none_rec + next_rec
+    blob += struct.pack("<Q", 0)
+    f = tmp_path / "v0none.params"
+    f.write_bytes(blob)
+    out = load_legacy_ndarray_dict(str(f))
+    assert out[0].size == 0
+    onp.testing.assert_array_equal(out[1], follow)
+
+
+def test_load_parameters_cast_dtype_false_raises_on_mismatch(tmp_path):
+    """Parity: Parameter._load_init asserts dtype match unless
+    cast_dtype=True — a f16 checkpoint must not silently degrade into a
+    f32 net (`python/mxnet/gluon/parameter.py` _load_init)."""
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    p = str(tmp_path / "f16.params")
+    save_legacy_ndarray_dict(
+        p, {"weight": onp.ones((2, 2), onp.float16),
+            "bias": onp.zeros((2,), onp.float16)})
+    with pytest.raises(MXNetError, match="cast_dtype"):
+        net.load_parameters(p)                      # cast_dtype=False
+    net.load_parameters(p, cast_dtype=True)         # explicit cast is fine
+    assert net.weight.data().dtype == mx.np.float32
