@@ -13,6 +13,7 @@ in extras.
 """
 from __future__ import annotations
 
+import glob
 import json
 import math
 import os
@@ -233,11 +234,33 @@ def _remember_tpu_result(result: dict) -> None:
 
 
 def _last_known_tpu():
+    """Load the carried TPU record, stamped ``stale: true`` +
+    ``rounds_stale`` so a reader of the driver's BENCH_r{N}.json can never
+    mistake a carried number for a current measurement.  ``rounds_stale``
+    counts the committed BENCH_r*.json files that carried this same
+    ``measured_at`` (i.e. rounds whose driver bench run could not reach
+    the TPU) plus the current run."""
     try:
         with open(_LATEST_TPU) as f:
-            return json.load(f)
+            rec = json.load(f)
     except (OSError, json.JSONDecodeError):
         return None
+    measured = rec.get("measured_at")
+    rounds = 1
+    root = os.path.dirname(os.path.abspath(__file__))
+    for fn in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        try:
+            with open(fn) as f:
+                prev = json.load(f)
+            carried = ((prev.get("parsed") or {}).get("extras", {})
+                       .get("last_known_tpu") or {})
+            if measured and carried.get("measured_at") == measured:
+                rounds += 1
+        except (OSError, json.JSONDecodeError):
+            pass
+    rec["stale"] = True
+    rec["rounds_stale"] = rounds
+    return rec
 
 
 _CLAIM_LOCK = os.path.join(os.path.dirname(os.path.abspath(__file__)),
