@@ -34,6 +34,15 @@ if _os.environ.get("JAX_PLATFORMS"):
         del _jax
     except Exception:  # noqa: BLE001 — never block import on a config nicety
         pass
+
+# 64-bit float support (docs/env_vars.md "MXTPU_ENABLE_X64"): the reference
+# computes genuinely in f64 on CPU; here f64 rides jax_enable_x64. Without
+# it, explicit float64 requests raise loudly (base.check_x64_dtype) —
+# never a silent truncation. Scoped alternative: mx.util.x64_scope().
+if _os.environ.get("MXTPU_ENABLE_X64", "").lower() in ("1", "true", "on"):
+    import jax as _jax
+    _jax.config.update("jax_enable_x64", True)
+    del _jax
 del _os
 
 from .base import MXNetError  # noqa: F401
@@ -65,6 +74,11 @@ from . import profiler  # noqa: F401
 from . import amp  # noqa: F401
 from . import runtime  # noqa: F401
 from . import util  # noqa: F401
+from .util import (  # noqa: F401  (reference exposes these at top level)
+    np_shape, np_array, use_np, use_np_shape, use_np_array,
+    use_np_default_dtype, set_np, reset_np, set_np_shape,
+    is_np_shape, is_np_array,
+)
 from . import test_utils  # noqa: F401
 from . import recordio  # noqa: F401
 from . import io  # noqa: F401

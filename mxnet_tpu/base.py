@@ -10,11 +10,41 @@ from __future__ import annotations
 import os
 from typing import Any, Callable, Dict, Generic, Optional, Type, TypeVar
 
-__all__ = ["MXNetError", "Registry", "getenv_bool", "getenv_int", "classproperty"]
+__all__ = ["MXNetError", "Registry", "getenv_bool", "getenv_int",
+           "classproperty", "check_x64_dtype"]
 
 
 class MXNetError(RuntimeError):
     """Error raised by the framework (parity: dmlc::Error / MXNetError)."""
+
+
+def check_x64_dtype(dtype) -> None:
+    """Raise when a 64-bit float/complex dtype is explicitly requested
+    while x64 support is disabled.
+
+    The reference computes genuinely in float64 on CPU (mshadow dtype
+    dispatch; f64 cases throughout `tests/python/unittest/test_numpy_op.py`).
+    Under the default JAX config a float64 request silently truncates to
+    f32 — mis-executing user intent.  The one wrong option is silence, so
+    this raises with a pointer to the switch.  int64 is NOT checked here:
+    integer width adapts per `jax_enable_x64` at the documented
+    width-dependent sites instead of refusing."""
+    if dtype is None:
+        return
+    import numpy as _np
+    try:
+        dt = _np.dtype(dtype)
+    except TypeError:
+        return
+    if dt.name not in ("float64", "complex128"):
+        return
+    import jax
+    if not jax.config.jax_enable_x64:
+        raise MXNetError(
+            f"dtype {dt.name} requested but 64-bit float support is "
+            "disabled (it would silently truncate to float32). Enable it "
+            "with MXTPU_ENABLE_X64=1, mxnet_tpu.util.set_x64(True), or "
+            "scoped `with mxnet_tpu.util.x64_scope(): ...`")
 
 
 T = TypeVar("T")

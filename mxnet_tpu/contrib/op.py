@@ -37,7 +37,7 @@ __all__ = [
     "ROIAlign", "roi_align", "fft", "ifft", "BilinearResize2D",
     "AdaptiveAvgPooling2D", "MultiBoxPrior", "gradient_multiplier",
     "dynamic_reshape", "batch_norm_with_relu", "DeformableConvolution",
-    "hawkesll", "round_ste", "sign_ste",
+    "hawkesll", "round_ste", "sign_ste", "div_sqrt_dim",
 ]
 
 
@@ -79,10 +79,22 @@ def index_array(data, axes: Optional[Sequence[int]] = None):
     """Grid of element indices: output shape `data.shape + (len(axes),)`."""
     shape = data.shape
     ax = list(axes) if axes is not None else list(range(len(shape)))
+    idt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    if not ax:      # 0-d data (np-shape semantics): empty index grid
+        return from_jax(jnp.zeros(tuple(shape) + (0,), idt), data._device)
     grids = jnp.meshgrid(*[jnp.arange(s) for s in shape], indexing="ij")
-    out = jnp.stack([grids[a] for a in ax], axis=-1).astype(jnp.int64
-                    if jax.config.jax_enable_x64 else jnp.int32)
+    out = jnp.stack([grids[a] for a in ax], axis=-1).astype(idt)
     return from_jax(out, data._device)
+
+
+def div_sqrt_dim(data):
+    """data / sqrt(last dimension) — the transformer attention-logit
+    scaling helper (`contrib.div_sqrt_dim`,
+    `src/operator/contrib/transformer.cc`)."""
+    from ..ndarray.ndarray import apply_op
+    d = float(data.shape[-1])
+    return apply_op(lambda x: x / jnp.sqrt(jnp.asarray(d, x.dtype)),
+                    (data,), {}, name="div_sqrt_dim")
 
 
 def boolean_mask(data, index, axis=0):

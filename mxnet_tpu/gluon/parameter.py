@@ -182,6 +182,8 @@ class Parameter:
     reset_ctx = reset_device
 
     def cast(self, dtype):
+        from ..base import check_x64_dtype
+        check_x64_dtype(dtype)
         self.dtype = jnp.dtype(dtype)
         if self._data is not None:
             self._data._data = self._data._data.astype(dtype)

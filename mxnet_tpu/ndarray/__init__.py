@@ -84,6 +84,10 @@ from . import legacy_ops as op  # noqa: E402,F401  (mx.nd.op alias)
 # `nd.image` op namespace (parity: `python/mxnet/ndarray/image.py`)
 from ..image import _npx_image as image  # noqa: E402,F401
 
+# `nd.random` is the LEGACY sampler surface (shape= spelling, parity
+# `python/mxnet/ndarray/random.py`) — mx.np.random keeps size=
+from .. import random as random  # noqa: E402,F401
+
 
 def __getattr__(name):
     # `mx.nd.contrib` (reference spelling) — resolved lazily to avoid a

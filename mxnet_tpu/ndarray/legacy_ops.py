@@ -1540,3 +1540,105 @@ def IdentityAttachKLSparseReg(data, sparseness_target=0.1, penalty=0.001,
 
     f.defvjp(fwd, bwd)
     return _op(f, data, name="IdentityAttachKLSparseReg", out=out)
+
+
+# ---------------------------------------------------------------------------
+# legacy creation / index-arithmetic tail (parity: `nd.zeros`/`nd.ones`
+# refusing 0-d and zero-size shapes unless np shape semantics are on —
+# `src/operator/tensor/init_op.h` InitShape check — and the
+# ravel/unravel flat-index pair `src/operator/tensor/ravel.cc`)
+# ---------------------------------------------------------------------------
+
+def _check_legacy_shape(shape, opname):
+    from ..util import is_np_shape
+    if shape is None:
+        raise MXNetError(f"{opname}: shape is required")
+    if is_np_shape():
+        return
+    shp = (shape,) if isinstance(shape, int) else tuple(shape)
+    if len(shp) == 0 or any(int(s) == 0 for s in shp):
+        raise MXNetError(
+            f"{opname}: 0-d / zero-size shape {shp} needs numpy shape "
+            "semantics (scope with mx.np_shape() or call mx.npx.set_np())")
+
+
+def zeros(shape=None, ctx=None, dtype=None, out=None, **kwargs):
+    _check_legacy_shape(shape, "zeros")
+    from .. import numpy as _mnp
+    return _write_out(_mnp.zeros(shape, dtype=dtype or "float32", ctx=ctx),
+                      out)
+
+
+def ones(shape=None, ctx=None, dtype=None, out=None, **kwargs):
+    _check_legacy_shape(shape, "ones")
+    from .. import numpy as _mnp
+    return _write_out(_mnp.ones(shape, dtype=dtype or "float32", ctx=ctx),
+                      out)
+
+
+def empty(shape=None, ctx=None, dtype=None):
+    _check_legacy_shape(shape, "empty")
+    from .. import numpy as _mnp
+    return _mnp.zeros(shape, dtype=dtype or "float32", ctx=ctx)
+
+
+def full(shape=None, val=None, ctx=None, dtype=None, out=None, **kwargs):
+    _check_legacy_shape(shape, "full")
+    from .. import numpy as _mnp
+    return _write_out(_mnp.full(shape, val, dtype=dtype or "float32",
+                                ctx=ctx), out)
+
+
+def split_v2(ary, indices_or_sections, axis=0, squeeze_axis=False):
+    """2.x split taking counts OR split points (`nd.split_v2`,
+    `src/operator/tensor/matrix_op.cc` SplitV2)."""
+    sec = indices_or_sections
+    if isinstance(sec, (list, tuple)):
+        sec = tuple(int(s) for s in sec)
+
+    def fn(x):
+        parts = jnp.split(x, sec, axis=axis)
+        if squeeze_axis:
+            parts = [jnp.squeeze(p, axis=axis) for p in parts]
+        return tuple(parts) if len(parts) > 1 else parts[0]
+    return _op(fn, ary, name="split_v2")
+
+
+def _ravel_strides(shape):
+    dims = [int(d) for d in shape]
+    strides = [1] * len(dims)
+    for i in range(len(dims) - 2, -1, -1):
+        strides[i] = strides[i + 1] * dims[i + 1]
+    return dims, strides
+
+
+def ravel_multi_index(data, shape=None, out=None):
+    """(ndim, N) multi-indices -> flat indices; a -1 leading dim is
+    allowed (stride-only use, matching the reference's ravel.cc)."""
+    dims, strides = _ravel_strides(shape)
+
+    def fn(x):
+        s = jnp.asarray(strides, x.dtype).reshape(
+            (-1,) + (1,) * (x.ndim - 1))
+        return (x * s).sum(axis=0)
+    return _op(fn, data, name="ravel_multi_index", out=out)
+
+
+def unravel_index(data, shape=None, out=None):
+    """Flat indices -> (ndim, N) multi-indices; leading dim may be -1
+    (no modulo applied on it)."""
+    dims, strides = _ravel_strides(shape)
+
+    def fn(x):
+        coords = []
+        for i, (st, d) in enumerate(zip(strides, dims)):
+            q = x // st
+            if not (i == 0 and d == -1):
+                q = q % d
+            coords.append(q)
+        return jnp.stack(coords, axis=0)
+    return _op(fn, data, name="unravel_index", out=out)
+
+
+__all__ += ["zeros", "ones", "empty", "full", "split_v2",
+            "ravel_multi_index", "unravel_index"]

@@ -135,7 +135,13 @@ class ndarray:
         if is_tracer(self._data):
             raise MXNetError("cannot convert a traced (deferred-compute) "
                              "ndarray to numpy inside jit")
-        return _np.asarray(self._data)
+        # writable copy: the reference's asnumpy() copies device memory, so
+        # callers mutate the result freely; np.asarray over a jax array is
+        # a read-only view and would break them
+        out = _np.asarray(self._data)
+        if not out.flags.writeable:
+            out = out.copy()
+        return out
 
     def asscalar(self):
         return self.item()
@@ -224,6 +230,8 @@ class ndarray:
         raise TypeError(f"copyto does not support {type(other)}")
 
     def astype(self, dtype, copy=True) -> "ndarray":
+        from ..base import check_x64_dtype
+        check_x64_dtype(dtype)
         if not copy and self.dtype == _np.dtype(dtype):
             return self
         return apply_op(lambda x: x.astype(dtype), (self,), {}, name="astype")
@@ -649,7 +657,12 @@ def _apply_op(fn: Callable, array_args: Sequence[ndarray], kwargs: dict,
                 diff_idx.append(i)
 
     if not diff_idx:
-        out = fn(*vals, **kwargs) if kwargs else fn(*vals)
+        try:
+            out = fn(*vals, **kwargs) if kwargs else fn(*vals)
+        except (TypeError, ValueError) as e:
+            # invalid shapes/args surface as MXNetError, as the reference's
+            # InferShape/InferType failures do (imperative.cc Invoke)
+            raise MXNetError(f"{name}: {e}") from e
         return _wrap_outputs(out, device)
 
     # differentiable path: capture vjp w.r.t. the tracked float inputs
@@ -662,7 +675,10 @@ def _apply_op(fn: Callable, array_args: Sequence[ndarray], kwargs: dict,
         return fn(*v, **kwargs) if kwargs else fn(*v)
 
     diff_vals = [vals[i] for i in diff_idx]
-    out, vjp_fn = jax.vjp(fn_of_diff, *diff_vals)
+    try:
+        out, vjp_fn = jax.vjp(fn_of_diff, *diff_vals)
+    except (TypeError, ValueError) as e:
+        raise MXNetError(f"{name}: {e}") from e
 
     is_multi = isinstance(out, (tuple, list))
     outs = list(out) if is_multi else [out]

@@ -1,0 +1,11 @@
+"""`mx.nd.numpy` — numpy-semantics ops on the ndarray front end (parity:
+`python/mxnet/ndarray/numpy/`). The single-ndarray design means these are
+the same callables as `mx.np`; the module exists so reference code paths
+(`import mxnet.ndarray.numpy`) resolve."""
+from ... import numpy as _np_frontend
+
+from . import _internal  # noqa: F401
+
+
+def __getattr__(name):
+    return getattr(_np_frontend, name)

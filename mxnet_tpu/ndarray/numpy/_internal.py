@@ -1,0 +1,59 @@
+"""`_npi` — the reference's internal numpy-op namespace (parity:
+`python/mxnet/ndarray/numpy/_internal.py`, backed there by generated C
+stubs).  Reference tests reach a handful of not-yet-public ops through it
+(`tests/python/unittest/test_numpy_op.py` boolean_mask_assign_*).  The
+public front ends cover the rest, so this module implements only the
+internal-only names and forwards everything else to `mx.np`/`mx.npx`."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ndarray import ndarray, from_jax
+
+
+def _val(a):
+    return a._data if isinstance(a, ndarray) else a
+
+
+def boolean_mask_assign_scalar(data, mask, value, start_axis=0, out=None):
+    """data[mask] = scalar (mask broadcast from `start_axis`)."""
+    d, m = _val(data), _val(mask).astype(bool)
+    shape = m.shape + (1,) * (d.ndim - start_axis - m.ndim)
+    m = jnp.reshape(m, (1,) * start_axis + shape)
+    res = jnp.where(m, jnp.asarray(value, d.dtype), d)
+    if out is not None:
+        out._data = res
+        return out
+    return from_jax(res, data._device)
+
+
+def boolean_mask_assign_tensor(data, mask, value, start_axis=0, out=None):
+    """data[mask] = tensor of shape (mask.sum(), trailing...).
+
+    Data-dependent gather — eager-only, like every dynamic-shape op here
+    (`mxnet_tpu/numpy/__init__.py` boolean_mask stance)."""
+    import numpy as onp
+    d = onp.asarray(_val(data))
+    m = onp.asarray(_val(mask)).astype(bool)
+    v = onp.asarray(_val(value))
+    d = d.copy()
+    if start_axis == 0:
+        d[m] = v
+    else:
+        idx = (slice(None),) * start_axis
+        d[idx + (m,)] = v
+    res = jnp.asarray(d)
+    if out is not None:
+        out._data = res
+        return out
+    return from_jax(res, data._device)
+
+
+def __getattr__(name):
+    from ... import numpy as _np
+    from ... import numpy_extension as _npx
+    for mod in (_np, _npx, _np.random, _np.linalg):
+        fn = getattr(mod, name, None)
+        if fn is not None:
+            return fn
+    raise AttributeError(f"_npi has no op {name!r}")

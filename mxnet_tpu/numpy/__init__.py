@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as _onp
 
-from ..base import MXNetError
+from ..base import MXNetError, check_x64_dtype
 from ..device import Device, current_device
 from ..ndarray.ndarray import ndarray, apply_op, from_jax, _write_out
 from ._wrap import wrap_fn
@@ -45,7 +45,16 @@ uint16 = _onp.uint16
 uint32 = _onp.uint32
 uint64 = _onp.uint64
 bool_ = _onp.bool_
+bool = bool_  # noqa: A001 — reference exposes `np.bool` (numpy/utils.py:26)
+complex64 = _onp.complex64
+complex128 = _onp.complex128
 intp = _onp.intp
+
+# dtype families (parity: numpy/utils.py:177-201)
+integer_dtypes = [int8, int16, int32, int64, uint8, uint16, uint32, uint64]
+floating_dtypes = [float16, float32, float64]
+numeric_dtypes = [*integer_dtypes, *floating_dtypes]
+boolean_dtypes = [bool_]
 
 _default_float = [float32]
 
@@ -89,6 +98,7 @@ def array(object, dtype=None, device=None, ctx=None, copy=True):
         else:
             dtype = npv.dtype
     else:
+        check_x64_dtype(dtype)
         # signed int32/int64 targets convert THROUGH numpy with the
         # dtype: out-of-range Python ints raise numpy's OverflowError
         # (loud) instead of silently wrapping in a later jnp downcast —
@@ -116,6 +126,8 @@ def _creation(jfn):
     def fn(shape, dtype=None, order="C", device=None, ctx=None, **kw):
         if dtype is None:
             dtype = _default_float[0]
+        else:
+            check_x64_dtype(dtype)
         dev = _dev(device, ctx)
         if isinstance(shape, ndarray):
             shape = tuple(int(s) for s in shape.asnumpy())
@@ -131,6 +143,7 @@ empty = _creation(jnp.zeros)  # XLA has no uninitialised alloc
 
 
 def full(shape, fill_value, dtype=None, order="C", device=None, ctx=None, out=None):
+    check_x64_dtype(dtype)
     dev = _dev(device, ctx)
     if isinstance(fill_value, ndarray):
         fill_value = fill_value._data
@@ -142,14 +155,17 @@ def full(shape, fill_value, dtype=None, order="C", device=None, ctx=None, out=No
 
 
 def zeros_like(a, dtype=None, order="C", device=None, ctx=None):
+    check_x64_dtype(dtype)
     return apply_op(lambda x: jnp.zeros_like(x, dtype=dtype), (a,), {}, name="zeros_like")
 
 
 def ones_like(a, dtype=None, order="C", device=None, ctx=None):
+    check_x64_dtype(dtype)
     return apply_op(lambda x: jnp.ones_like(x, dtype=dtype), (a,), {}, name="ones_like")
 
 
 def full_like(a, fill_value, dtype=None, order="C", device=None, ctx=None):
+    check_x64_dtype(dtype)
     return apply_op(lambda x: jnp.full_like(x, fill_value, dtype=dtype), (a,), {},
                     name="full_like")
 
@@ -158,6 +174,7 @@ empty_like = zeros_like
 
 
 def arange(start, stop=None, step=1, dtype=None, device=None, ctx=None):
+    check_x64_dtype(dtype)
     dev = _dev(device, ctx)
     if dtype is None and (isinstance(start, float) or isinstance(stop, float)
                           or isinstance(step, float)):
@@ -168,6 +185,7 @@ def arange(start, stop=None, step=1, dtype=None, device=None, ctx=None):
 
 def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
              axis=0, device=None, ctx=None):
+    check_x64_dtype(dtype)
     dev = _dev(device, ctx)
     if dtype is None:
         dtype = _default_float[0]
@@ -180,6 +198,7 @@ def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
 
 def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
              axis=0, device=None, ctx=None):
+    check_x64_dtype(dtype)
     dev = _dev(device, ctx)
     if dtype is None:
         dtype = _default_float[0]
@@ -188,10 +207,16 @@ def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
 
 
 def eye(N, M=None, k=0, dtype=None, device=None, ctx=None):
+    check_x64_dtype(dtype)
     dev = _dev(device, ctx)
     if dtype is None:
         dtype = _default_float[0]
-    return from_jax(jnp.eye(N, M, k=k, dtype=dtype), dev)
+    try:
+        data = jnp.eye(N, M, k=k, dtype=dtype)
+    except (TypeError, ValueError) as e:
+        # negative/non-int dims are an MXNetError in the reference
+        raise MXNetError(f"eye: {e}") from e
+    return from_jax(data, dev)
 
 
 def identity(n, dtype=None, device=None, ctx=None):
@@ -199,6 +224,7 @@ def identity(n, dtype=None, device=None, ctx=None):
 
 
 def tri(N, M=None, k=0, dtype=None):
+    check_x64_dtype(dtype)
     return from_jax(jnp.tri(N, M, k, dtype or _default_float[0]), current_device())
 
 
@@ -214,6 +240,7 @@ def meshgrid(*xi, **kwargs):
 
 
 def fromfunction(function, shape, dtype=None, **kwargs):
+    check_x64_dtype(dtype)
     return array(_onp.fromfunction(function, shape, dtype=dtype or _default_float[0],
                                    **kwargs))
 

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 import numpy as _onp
 
 from .. import random as _rng
+from ..base import check_x64_dtype
 from ..device import Device, current_device
 from ..ndarray.ndarray import ndarray, from_jax
 
@@ -26,6 +27,12 @@ __all__ = [
 ]
 
 _DEFAULT_FLOAT = jnp.float32
+
+
+def _dt(dtype):
+    """Resolve a sampler dtype: loud on f64-while-x64-off, default f32."""
+    check_x64_dtype(dtype)
+    return dtype or _DEFAULT_FLOAT
 
 
 def seed(s):
@@ -60,7 +67,7 @@ def uniform(low=0.0, high=1.0, size=None, dtype=None, device=None, ctx=None, out
     low, high = _val(low), _val(high)
     shape = _shape(size) if size is not None else jnp.broadcast_shapes(
         jnp.shape(low), jnp.shape(high))
-    r = jax.random.uniform(k, shape, dtype or _DEFAULT_FLOAT)
+    r = jax.random.uniform(k, shape, _dt(dtype))
     r = r * (high - low) + low
     res = _wrap(r, device, ctx)
     if out is not None:
@@ -74,7 +81,7 @@ def normal(loc=0.0, scale=1.0, size=None, dtype=None, device=None, ctx=None, out
     loc, scale = _val(loc), _val(scale)
     shape = _shape(size) if size is not None else jnp.broadcast_shapes(
         jnp.shape(loc), jnp.shape(scale))
-    r = jax.random.normal(k, shape, dtype or _DEFAULT_FLOAT) * scale + loc
+    r = jax.random.normal(k, shape, _dt(dtype)) * scale + loc
     res = _wrap(r, device, ctx)
     if out is not None:
         out._rebind(res)
@@ -135,8 +142,8 @@ def gamma(shape, scale=1.0, size=None, dtype=None, device=None, ctx=None, out=No
     a, scale = _val(shape), _val(scale)
     sz = _shape(size) if size is not None else jnp.broadcast_shapes(
         jnp.shape(a), jnp.shape(scale))
-    r = jax.random.gamma(k, jnp.asarray(a, _DEFAULT_FLOAT), sz,
-                         dtype or _DEFAULT_FLOAT) * scale
+    r = jax.random.gamma(k, jnp.asarray(a, _dt(dtype)), sz,
+                         _dt(dtype)) * scale
     res = _wrap(r, device, ctx)
     if out is not None:
         out._rebind(res); return out
@@ -145,13 +152,13 @@ def gamma(shape, scale=1.0, size=None, dtype=None, device=None, ctx=None, out=No
 
 def beta(a, b, size=None, dtype=None, device=None, ctx=None):
     k = _rng.next_key()
-    r = jax.random.beta(k, _val(a), _val(b), _shape(size), dtype or _DEFAULT_FLOAT)
+    r = jax.random.beta(k, _val(a), _val(b), _shape(size), _dt(dtype))
     return _wrap(r, device, ctx)
 
 
 def exponential(scale=1.0, size=None, dtype=None, device=None, ctx=None, out=None):
     k = _rng.next_key()
-    r = jax.random.exponential(k, _shape(size), dtype or _DEFAULT_FLOAT) * _val(scale)
+    r = jax.random.exponential(k, _shape(size), _dt(dtype)) * _val(scale)
     res = _wrap(r, device, ctx)
     if out is not None:
         out._rebind(res); return out
@@ -182,58 +189,58 @@ def bernoulli(prob=None, logit=None, size=None, dtype=None, device=None, ctx=Non
         prob = jnp.asarray(_val(prob))
     sz = _shape(size) if size is not None else jnp.shape(prob)
     r = jax.random.bernoulli(k, prob, sz)
-    return _wrap(r.astype(dtype or _DEFAULT_FLOAT), device, ctx)
+    return _wrap(r.astype(_dt(dtype)), device, ctx)
 
 
 def lognormal(mean=0.0, sigma=1.0, size=None, dtype=None, device=None, ctx=None):
     return normal(0.0, 1.0, size, dtype, device, ctx)._method_exp(mean, sigma) \
         if False else _wrap(jnp.exp(jax.random.normal(_rng.next_key(), _shape(size),
-                            dtype or _DEFAULT_FLOAT) * _val(sigma) + _val(mean)),
+                            _dt(dtype)) * _val(sigma) + _val(mean)),
                             device, ctx)
 
 
 def logistic(loc=0.0, scale=1.0, size=None, dtype=None, device=None, ctx=None):
     k = _rng.next_key()
-    r = jax.random.logistic(k, _shape(size), dtype or _DEFAULT_FLOAT)
+    r = jax.random.logistic(k, _shape(size), _dt(dtype))
     return _wrap(r * _val(scale) + _val(loc), device, ctx)
 
 
 def gumbel(loc=0.0, scale=1.0, size=None, dtype=None, device=None, ctx=None):
     k = _rng.next_key()
-    r = jax.random.gumbel(k, _shape(size), dtype or _DEFAULT_FLOAT)
+    r = jax.random.gumbel(k, _shape(size), _dt(dtype))
     return _wrap(r * _val(scale) + _val(loc), device, ctx)
 
 
 def laplace(loc=0.0, scale=1.0, size=None, dtype=None, device=None, ctx=None):
     k = _rng.next_key()
-    r = jax.random.laplace(k, _shape(size), dtype or _DEFAULT_FLOAT)
+    r = jax.random.laplace(k, _shape(size), _dt(dtype))
     return _wrap(r * _val(scale) + _val(loc), device, ctx)
 
 
 def rayleigh(scale=1.0, size=None, dtype=None, device=None, ctx=None):
     k = _rng.next_key()
-    u = jax.random.uniform(k, _shape(size), dtype or _DEFAULT_FLOAT,
-                           minval=jnp.finfo(dtype or _DEFAULT_FLOAT).tiny)
+    u = jax.random.uniform(k, _shape(size), _dt(dtype),
+                           minval=jnp.finfo(_dt(dtype)).tiny)
     return _wrap(_val(scale) * jnp.sqrt(-2.0 * jnp.log(u)), device, ctx)
 
 
 def weibull(a, size=None, dtype=None, device=None, ctx=None):
     k = _rng.next_key()
-    u = jax.random.uniform(k, _shape(size), dtype or _DEFAULT_FLOAT,
-                           minval=jnp.finfo(dtype or _DEFAULT_FLOAT).tiny)
+    u = jax.random.uniform(k, _shape(size), _dt(dtype),
+                           minval=jnp.finfo(_dt(dtype)).tiny)
     return _wrap(jnp.power(-jnp.log(u), 1.0 / jnp.asarray(_val(a))), device, ctx)
 
 
 def pareto(a, size=None, dtype=None, device=None, ctx=None):
     k = _rng.next_key()
-    u = jax.random.uniform(k, _shape(size), dtype or _DEFAULT_FLOAT,
-                           minval=jnp.finfo(dtype or _DEFAULT_FLOAT).tiny)
+    u = jax.random.uniform(k, _shape(size), _dt(dtype),
+                           minval=jnp.finfo(_dt(dtype)).tiny)
     return _wrap(jnp.power(u, -1.0 / jnp.asarray(_val(a))) - 1.0, device, ctx)
 
 
 def power(a, size=None, dtype=None, device=None, ctx=None):
     k = _rng.next_key()
-    u = jax.random.uniform(k, _shape(size), dtype or _DEFAULT_FLOAT)
+    u = jax.random.uniform(k, _shape(size), _dt(dtype))
     return _wrap(jnp.power(u, 1.0 / jnp.asarray(_val(a))), device, ctx)
 
 
@@ -280,16 +287,16 @@ def standard_cauchy(size=None, dtype=None, device=None, ctx=None):
 
 def standard_t(df, size=None, dtype=None, device=None, ctx=None):
     k = _rng.next_key()
-    df_v = jnp.asarray(_val(df), _DEFAULT_FLOAT)
+    df_v = jnp.asarray(_val(df), _dt(dtype))
     sz = _shape(size) if size is not None else jnp.shape(df_v)
-    r = jax.random.t(k, df_v, sz, dtype or _DEFAULT_FLOAT)
+    r = jax.random.t(k, df_v, sz, _dt(dtype))
     return _wrap(r, device, ctx)
 
 
 def binomial(n, p, size=None, dtype=None, device=None, ctx=None):
     k = _rng.next_key()
-    n_v = jnp.asarray(_val(n), _DEFAULT_FLOAT)
-    p_v = jnp.asarray(_val(p), _DEFAULT_FLOAT)
+    n_v = jnp.asarray(_val(n), _dt(dtype))
+    p_v = jnp.asarray(_val(p), _dt(dtype))
     sz = _shape(size) if size is not None else jnp.broadcast_shapes(
         jnp.shape(n_v), jnp.shape(p_v))
     r = jax.random.binomial(k, n_v, p_v, sz)
@@ -305,7 +312,7 @@ def negative_binomial(n, p, size=None, dtype=None, device=None, ctx=None):
 
 def geometric(p, size=None, dtype=None, device=None, ctx=None):
     k = _rng.next_key()
-    p_v = jnp.asarray(_val(p), _DEFAULT_FLOAT)
+    p_v = jnp.asarray(_val(p), _dt(dtype))
     sz = _shape(size) if size is not None else jnp.shape(p_v)
     r = jax.random.geometric(k, p_v, sz)
     return _wrap(r.astype(dtype) if dtype else r, device, ctx)
@@ -313,17 +320,17 @@ def geometric(p, size=None, dtype=None, device=None, ctx=None):
 
 def dirichlet(alpha, size=None, dtype=None, device=None, ctx=None):
     k = _rng.next_key()
-    a = jnp.asarray(_val(alpha), _DEFAULT_FLOAT)
+    a = jnp.asarray(_val(alpha), _dt(dtype))
     # None lets jax default to alpha's batch shape (numpy semantics)
     shape = _shape(size) + jnp.shape(a)[:-1] if size is not None else None
-    r = jax.random.dirichlet(k, a, shape, dtype or _DEFAULT_FLOAT)
+    r = jax.random.dirichlet(k, a, shape, _dt(dtype))
     return _wrap(r, device, ctx)
 
 
 def triangular(left, mode, right, size=None, dtype=None, device=None,
                ctx=None):
     k = _rng.next_key()
-    l_, m_, r_ = (jnp.asarray(_val(x), _DEFAULT_FLOAT)
+    l_, m_, r_ = (jnp.asarray(_val(x), _dt(dtype))
                   for x in (left, mode, right))
     sz = _shape(size) if size is not None else jnp.broadcast_shapes(
         jnp.shape(l_), jnp.shape(m_), jnp.shape(r_))
@@ -333,8 +340,8 @@ def triangular(left, mode, right, size=None, dtype=None, device=None,
 
 def wald(mean, scale, size=None, dtype=None, device=None, ctx=None):
     k = _rng.next_key()
-    mu = jnp.asarray(_val(mean), _DEFAULT_FLOAT)
-    lam = jnp.asarray(_val(scale), _DEFAULT_FLOAT)
+    mu = jnp.asarray(_val(mean), _dt(dtype))
+    lam = jnp.asarray(_val(scale), _dt(dtype))
     sz = _shape(size) if size is not None else jnp.broadcast_shapes(
         jnp.shape(mu), jnp.shape(lam))
     r = jax.random.wald(k, mu / lam, sz) * lam  # standard wald scaled
@@ -347,8 +354,8 @@ def vonmises(mu, kappa, size=None, dtype=None, device=None, ctx=None):
     standard rejection scheme with a fixed expected-iteration bound
     vectorized over uniforms (acceptance prob >= 0.66 for all kappa)."""
     k = _rng.next_key()
-    kap = jnp.asarray(_val(kappa), _DEFAULT_FLOAT)
-    mu_v = jnp.asarray(_val(mu), _DEFAULT_FLOAT)
+    kap = jnp.asarray(_val(kappa), _dt(dtype))
+    mu_v = jnp.asarray(_val(mu), _dt(dtype))
     sz = _shape(size) if size is not None else jnp.broadcast_shapes(
         jnp.shape(mu_v), jnp.shape(kap))
     # 8 rejection rounds: P(all rejected) < 0.34^8 ~ 2e-4; fall back to
@@ -377,7 +384,7 @@ def zipf(a, size=None, dtype=None, device=None, ctx=None):
     kernel is host-side too; support truncated at 2^20 — P(tail) < 1e-6
     for a >= 1.5, and heavier tails saturate at the cap)."""
     k = _rng.next_key()
-    a_v = jnp.asarray(_val(a), _DEFAULT_FLOAT)
+    a_v = jnp.asarray(_val(a), _dt(dtype))
     sz = _shape(size) if size is not None else jnp.shape(a_v)
     support = jnp.arange(1, 1 << 20, dtype=_DEFAULT_FLOAT)
     w = support ** (-a_v) if jnp.ndim(a_v) == 0 else \
@@ -396,8 +403,8 @@ def hypergeometric(ngood, nbad, nsample, size=None, dtype=None,
                    device=None, ctx=None):
     """Sequential-draw formulation via lax.scan (exact, vectorized)."""
     k = _rng.next_key()
-    g = jnp.asarray(_val(ngood), _DEFAULT_FLOAT)
-    b = jnp.asarray(_val(nbad), _DEFAULT_FLOAT)
+    g = jnp.asarray(_val(ngood), _dt(dtype))
+    b = jnp.asarray(_val(nbad), _dt(dtype))
     ns = int(_onp.asarray(_val(nsample)))
     sz = _shape(size) if size is not None else jnp.broadcast_shapes(
         jnp.shape(g), jnp.shape(b))
@@ -411,7 +418,7 @@ def hypergeometric(ngood, nbad, nsample, size=None, dtype=None,
 
     carry, _ = jax.lax.scan(body, (jnp.broadcast_to(g, sz),
                                    jnp.broadcast_to(b, sz),
-                                   jnp.zeros(sz, _DEFAULT_FLOAT)), keys)
+                                   jnp.zeros(sz, _dt(dtype))), keys)
     got = carry[2]
     return _wrap(got.astype(dtype) if dtype else got, device, ctx)
 
@@ -419,7 +426,7 @@ def hypergeometric(ngood, nbad, nsample, size=None, dtype=None,
 def logseries(p, size=None, dtype=None, device=None, ctx=None):
     """Inverse-CDF over a truncated support (tail < 1e-7 for p <= 0.99)."""
     k = _rng.next_key()
-    p_v = jnp.asarray(_val(p), _DEFAULT_FLOAT)
+    p_v = jnp.asarray(_val(p), _dt(dtype))
     sz = _shape(size) if size is not None else jnp.shape(p_v)
     supp = jnp.arange(1, 1 << 12, dtype=_DEFAULT_FLOAT)
     w = (p_v[..., None] ** supp if jnp.ndim(p_v) else p_v ** supp) / supp

@@ -27,6 +27,7 @@ from .. import _tape
 
 __all__ = [
     "activation", "relu", "sigmoid", "tanh", "softrelu", "softsign", "gelu",
+    "log_sigmoid", "mish", "hard_sigmoid",
     "silu", "leaky_relu", "elu", "selu", "prelu", "softmax", "log_softmax",
     "masked_softmax", "masked_log_softmax", "fully_connected", "convolution",
     "deconvolution", "pooling", "batch_norm", "layer_norm", "group_norm",
@@ -72,6 +73,14 @@ erf = _unary(jax.scipy.special.erf, "erf")
 erfinv = _unary(jax.scipy.special.erfinv, "erfinv")
 gammaln = _unary(jax.scipy.special.gammaln, "gammaln")
 gamma = _unary(lambda x: jnp.exp(jax.scipy.special.gammaln(x)), "gamma")
+# standalone activation ops the reference registers alongside Activation's
+# act_type modes (src/operator/nn/activation.cc; log_sigmoid/mish landed
+# as first-class ops in 2.x)
+log_sigmoid = _unary(jax.nn.log_sigmoid, "log_sigmoid")
+mish = _unary(lambda x: x * jnp.tanh(jax.nn.softplus(x)), "mish")
+hard_sigmoid = _unary(
+    lambda x, alpha=0.2, beta=0.5: jnp.clip(alpha * x + beta, 0.0, 1.0),
+    "hard_sigmoid")
 
 
 def softrelu(data):
@@ -95,7 +104,9 @@ def leaky_relu(data, gamma_=None, act_type="leaky", slope=0.25,
     if act_type == "selu":
         return apply_op(jax.nn.selu, (data,), {}, name="selu")
     if act_type == "gelu":
-        return gelu(data)
+        # the reference's LeakyReLU gelu kernel is the tanh approximation
+        # (leaky_relu-inl.h; its unit test asserts the tanh formula)
+        return gelu(data, approximation="tanh")
     if act_type == "prelu":
         return apply_op(lambda x, g: jnp.where(x >= 0, x, g * x),
                         (data, gamma_), {}, name="prelu")
@@ -695,9 +706,25 @@ def shape_array(data):
                     data._device)
 
 
-def reshape_like(lhs, rhs):
-    return apply_op(lambda a, b: a.reshape(b.shape), (lhs, rhs), {},
-                    name="reshape_like")
+def reshape_like(lhs, rhs, lhs_begin=None, lhs_end=None, rhs_begin=None,
+                 rhs_end=None):
+    """Reshape `lhs` to `rhs`'s shape; the optional begin/end bounds
+    splice only a sub-range of axes (reference
+    `src/operator/tensor/elemwise_unary_op_basic.cc` ReshapeLike)."""
+    def _rng_(n, b, e):
+        b = 0 if b is None else (b + n if b < 0 else b)
+        e = n if e is None else (e + n if e < 0 else e)
+        return b, e
+
+    def fn(a, b):
+        if lhs_begin is None and lhs_end is None and rhs_begin is None \
+                and rhs_end is None:
+            return a.reshape(b.shape)
+        lb, le = _rng_(a.ndim, lhs_begin, lhs_end)
+        rb, re_ = _rng_(b.ndim, rhs_begin, rhs_end)
+        new_shape = a.shape[:lb] + b.shape[rb:re_] + a.shape[le:]
+        return a.reshape(new_shape)
+    return apply_op(fn, (lhs, rhs), {}, name="reshape_like")
 
 
 def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
@@ -1093,22 +1120,13 @@ def waitall():
     _w()
 
 
-_np_active = [True]
-
-
-def set_np(shape=True, array=True, dtype=False):
-    _np_active[0] = True
-
-
-def reset_np():
-    _np_active[0] = True  # numpy semantics are always on in this framework
+# shape semantics are real scoped state shared with mx.util (the legacy
+# `mx.nd` surface consults it); array semantics are always-on (one
+# ndarray type)
+from ..util import set_np, reset_np, is_np_shape, set_np_shape  # noqa: F401,E402
 
 
 def is_np_array():
-    return True
-
-
-def is_np_shape():
     return True
 
 
@@ -1238,10 +1256,15 @@ def bernoulli(prob=None, logit=None, size=None, dtype=None, device=None,
 
 def from_numpy(ndarray, zero_copy=True):
     """Host numpy -> device array (`npx.from_numpy`; dtype-preserving up
-    to jax's x64 policy — float64 narrows to float32 unless
-    JAX_ENABLE_X64 — and the device transfer copies regardless, XLA owns
-    its buffers)."""
+    to jax's x64 policy, and the device transfer copies regardless — XLA
+    owns its buffers).  A float64 HOST array converts like the implicit
+    default (f32) when x64 is off: it is the array's ambient dtype, not
+    an explicit user request, so the loud f64 check does not apply."""
     from ..numpy import array as _array
+    import numpy as _np
+    if _np.dtype(ndarray.dtype) in (_np.float64, _np.complex128) \
+            and not jax.config.jax_enable_x64:
+        return _array(ndarray)
     return _array(ndarray, dtype=ndarray.dtype)
 
 

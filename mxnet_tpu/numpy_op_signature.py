@@ -1,0 +1,31 @@
+"""Builtin-op lookup by registered name (parity:
+`python/mxnet/numpy_op_signature.py` `_get_builtin_op`).
+
+The reference maps registry names like ``_np_sum`` / ``_npx_relu`` (and
+submodule-prefixed ones like ``_np_random_uniform``) back to the live
+front-end callables so tests can drive ops through their registered
+identity.  Here the front ends ARE the registry, so the lookup is a
+prefix strip + attribute walk over `mx.np` / `mx.npx`.
+"""
+from __future__ import annotations
+
+__all__ = ["_get_builtin_op"]
+
+_SUBMODULES = ("random", "linalg", "fft")
+
+
+def _get_builtin_op(op_name: str):
+    from . import numpy as mx_np
+    from . import numpy_extension as mx_npx
+    if op_name.startswith("_np_"):
+        root, rest = mx_np, op_name[len("_np_"):]
+    elif op_name.startswith("_npx_"):
+        root, rest = mx_npx, op_name[len("_npx_"):]
+    else:
+        return None
+    for sub in _SUBMODULES:
+        if rest.startswith(sub + "_"):
+            root = getattr(root, sub, None)
+            rest = rest[len(sub) + 1:]
+            break
+    return getattr(root, rest, None) if root is not None else None

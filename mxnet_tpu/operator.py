@@ -171,3 +171,72 @@ def custom(*inputs, op_type: str, **kwargs):
 
     return apply_op(_fn, tuple(inputs), {}, name=f"custom[{op_type}]",
                     n_out=n_out)
+
+
+# ---------------------------------------------------------------------------
+# Op-registry introspection (parity: `python/mxnet/operator.py:1129-1211`
+# get_all_registered_operators / get_operator_arguments over the NNVM
+# registry).  Here the registry IS the front-end namespaces; argument
+# metadata comes from the Python signatures, with the enum-typed attrs of
+# the classic layer ops carried in `_OP_ARG_TYPES` (the reference stores
+# these strings in the C registry; they also feed tools/gen_op_docs.py).
+# ---------------------------------------------------------------------------
+
+import collections as _collections
+
+OperatorArguments = _collections.namedtuple(
+    "OperatorArguments", ["narg", "names", "types"])
+
+# classic layer ops whose attr types the reference's registry documents as
+# enum sets; kept for the ops whose signature alone can't express them
+_OP_ARG_TYPES = {
+    "Activation": (
+        ["data", "act_type"],
+        ["NDArray-or-Symbol",
+         "{'log_sigmoid', 'mish', 'relu', 'sigmoid', 'softrelu', "
+         "'softsign', 'tanh'}, required"]),
+}
+
+
+def get_all_registered_operators():
+    """All op names reachable on the legacy + numpy front ends."""
+    from .ndarray import legacy_ops
+    from . import numpy as _mnp
+    from . import numpy_extension as _npx
+    names = set(_registry)
+    for mod in (legacy_ops, _npx, _mnp):
+        for n in getattr(mod, "__all__", []) or dir(mod):
+            if not n.startswith("_") and callable(getattr(mod, n, None)):
+                names.add(n)
+    return sorted(names)
+
+
+def get_operator_arguments(op_name):
+    """Argument metadata for `op_name` as OperatorArguments(narg, names,
+    types)."""
+    if op_name in _OP_ARG_TYPES:
+        names, types = _OP_ARG_TYPES[op_name]
+        return OperatorArguments(len(names), list(names), list(types))
+    import inspect
+    from .ndarray import legacy_ops
+    from . import numpy as _mnp
+    from . import numpy_extension as _npx
+    fn = None
+    for mod in (legacy_ops, _npx, _mnp):
+        fn = getattr(mod, op_name, None)
+        if callable(fn):
+            break
+    if fn is None:
+        raise MXNetError(f"operator {op_name!r} is not registered")
+    sig = inspect.signature(fn)
+    names = [p for p in sig.parameters
+             if p not in ("out", "name", "kwargs") and
+             sig.parameters[p].kind not in (inspect.Parameter.VAR_KEYWORD,
+                                            inspect.Parameter.VAR_POSITIONAL)]
+    types = ["NDArray-or-Symbol" if i == 0 else "optional"
+             for i in range(len(names))]
+    return OperatorArguments(len(names), names, types)
+
+
+__all__ += ["OperatorArguments", "get_all_registered_operators",
+            "get_operator_arguments"]

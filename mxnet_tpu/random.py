@@ -88,3 +88,78 @@ def next_key():
 
 def key_scope(key):
     return generator.key_scope(key)
+
+
+# ---------------------------------------------------------------------------
+# Legacy top-level samplers (parity: `python/mxnet/random.py` — thin
+# forwarders over the nd.random kernels, `shape=` spelling).  Each
+# delegates to the numpy front-end sampler with shape -> size.
+# ---------------------------------------------------------------------------
+
+def _legacy_sampler(np_name):
+    def sampler(*args, shape=None, ctx=None, dtype=None, out=None, **kwargs):
+        from .numpy import random as _npr
+        fn = getattr(_npr, np_name)
+        if shape is not None:
+            kwargs["size"] = shape if not isinstance(shape, list) \
+                else tuple(shape)
+        if dtype is not None and dtype != "None":
+            kwargs["dtype"] = dtype
+        if ctx is not None:
+            kwargs["ctx"] = ctx
+        if out is not None:
+            kwargs["out"] = out
+        return fn(*args, **kwargs)
+    sampler.__name__ = np_name
+    sampler.__doc__ = (f"Legacy `mx.random.{np_name}` (shape= spelling); "
+                       f"see `mx.np.random.{np_name}`.")
+    return sampler
+
+
+uniform = _legacy_sampler("uniform")
+normal = _legacy_sampler("normal")
+randn = _legacy_sampler("randn")
+randint = _legacy_sampler("randint")
+poisson = _legacy_sampler("poisson")
+exponential = _legacy_sampler("exponential")
+gamma = _legacy_sampler("gamma")
+shuffle = _legacy_sampler("shuffle")
+
+
+def multinomial(data, shape=None, get_prob=False, dtype="int32", **kwargs):
+    """Legacy categorical sampler (`mx.random.multinomial`/`nd.sample_
+    multinomial`): `data` holds probability rows; draws `shape` index
+    samples per row.  With get_prob=True also returns the log-prob of
+    each draw (the REINFORCE helper).  NOT numpy's count-vector
+    multinomial — that is `mx.np.random.multinomial`."""
+    import jax
+    import jax.numpy as jnp
+    from .ndarray.ndarray import ndarray as _nd, from_jax
+    from .device import current_device
+    p = data._data if isinstance(data, _nd) else jnp.asarray(data)
+    k = next_key()
+    sshape = () if shape is None else (
+        (shape,) if isinstance(shape, int) else tuple(shape))
+    logits = jnp.log(jnp.maximum(p, 1e-38))
+    batch = p.shape[:-1]
+    if batch:
+        # per-row draws: output shape batch + sshape
+        expand = logits.reshape(batch + (1,) * max(len(sshape), 1)
+                                + (p.shape[-1],))
+        draws = jax.random.categorical(
+            k, expand, shape=batch + (sshape or (1,)))
+        if not sshape:
+            draws = draws[..., 0]
+    else:
+        draws = jax.random.categorical(k, logits, shape=sshape or None)
+    out = from_jax(jnp.asarray(draws, dtype), current_device())
+    if get_prob:
+        lp = jnp.take_along_axis(
+            jnp.broadcast_to(logits, draws.shape + (p.shape[-1],)),
+            draws[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        return out, from_jax(lp, current_device())
+    return out
+
+
+__all__ += ["uniform", "normal", "randn", "randint", "poisson",
+            "exponential", "gamma", "multinomial", "shuffle"]

@@ -19,6 +19,41 @@ from .symbol import (  # noqa: F401
 from . import symbol as _symbol_mod
 
 
+class _SymOpNamespace:
+    """`mx.sym.np` / `mx.sym.npx` — symbol-building flavors of the numpy
+    namespaces (parity: `python/mxnet/symbol/numpy/`,
+    `symbol/numpy_extension/`).  Attribute access yields an op that builds
+    a DAG node; evaluation resolves to the eager `mx.np`/`mx.npx`
+    implementation (one Symbol type — see module docstring)."""
+
+    def __init__(self, prefix: str):
+        self._prefix = prefix
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name in ("random", "linalg", "fft"):
+            ns = _SymOpNamespace(self._prefix + name + ".")
+            object.__setattr__(self, name, ns)
+            return ns
+        fn = _symbol_mod._make_op(self._prefix + name)
+        if fn is None:
+            raise AttributeError(
+                f"mx.sym namespace has no op '{self._prefix}{name}'")
+        object.__setattr__(self, name, fn)
+        return fn
+
+
+np = _SymOpNamespace("np.")
+npx = _SymOpNamespace("npx.")
+contrib = _SymOpNamespace("contrib.")
+image = _SymOpNamespace("image.")
+# plain mx.sym.random / mx.sym.linalg are the LEGACY flavors (shape=
+# spelling / gemm2-style names) — np flavors live under mx.sym.np.*
+random = _SymOpNamespace("legacy_random.")
+linalg = _SymOpNamespace("linalg.")
+
+
 def __getattr__(name):
     fn = _symbol_mod._make_op(name)
     if fn is None:

@@ -53,7 +53,26 @@ def _resolve_op(name):
     from .. import numpy as mnp
     from .. import contrib
     from ..ndarray import legacy_ops
-    for mod in (npx, mnp, contrib, legacy_ops):
+    if "." in name:     # namespaced ops: "np.dot", "npx.relu",
+        parts = name.split(".")     # "contrib.fft", "np.random.uniform"
+        from ..image import _npx_image
+        from .. import random as legacy_random
+        roots = {"np": mnp, "npx": npx, "contrib": contrib,
+                 "image": _npx_image, "legacy_random": legacy_random}
+        mod = roots.get(parts[0])
+        if mod is not None:
+            parts = parts[1:]
+        else:   # bare submodule spelling ("linalg.norm") from older graphs
+            mod = getattr(mnp, parts[0], None) or getattr(npx, parts[0], None)
+            parts = parts[1:]
+        for p in parts:
+            mod = getattr(mod, p, None)
+            if mod is None:
+                return None
+        return mod if callable(mod) else None
+    # plain names are the LEGACY op flavor — `mx.sym.<op>` in the
+    # reference is the classic nd op set (np flavor lives at mx.sym.np)
+    for mod in (legacy_ops, npx, contrib, mnp):
         fn = getattr(mod, name, None)
         if callable(fn):
             return fn
@@ -95,6 +114,12 @@ def _call_op(fn, op_name, inputs, attrs):
     import inspect
     import warnings
     kwargs = {k: _coerce_attr(v) for k, v in attrs.items()}
+    kw_names = kwargs.pop("_kw_input_names", None)
+    if kw_names:
+        # the trailing len(kw_names) inputs are named (kwarg) inputs
+        n = len(kw_names)
+        inputs, named = inputs[:-n], inputs[-n:]
+        kwargs.update(zip(kw_names, named))
     try:
         sig = inspect.signature(fn)
         has_var_kw = any(p.kind == inspect.Parameter.VAR_KEYWORD
@@ -123,6 +148,7 @@ def _init_builtin_ops():
     def binop(fn):
         return lambda a, b: fn(a, b)
 
+    register_sym_op("_scalar_literal", lambda value=0.0: value)
     register_sym_op("_plus", binop(lambda a, b: a + b))
     register_sym_op("_minus", binop(lambda a, b: a - b))
     register_sym_op("_mul", binop(lambda a, b: a * b))
@@ -174,6 +200,15 @@ class Symbol:
     def _node(op, inputs, attrs=None, name=None):
         return Symbol(op, name or _auto_name(op), inputs, attrs)
 
+    # -- flavor shims (reference keeps two symbol classes; here there is
+    # one DAG node type, so the conversions are identity:
+    # `python/mxnet/symbol/symbol.py` as_np_ndarray / numpy as_nd_ndarray)
+    def as_np_ndarray(self) -> "Symbol":
+        return self
+
+    def as_nd_ndarray(self) -> "Symbol":
+        return self
+
     # -- introspection ------------------------------------------------------
     def list_arguments(self) -> List[str]:
         seen, order, visited = set(), [], set()
@@ -218,6 +253,13 @@ class Symbol:
                 if f"{n.name}_output" == idx or n.name == idx:
                     return n
             raise KeyError(idx)
+        if isinstance(idx, int) and idx >= 0 and self.op is not None \
+                and self._out_index is None:
+            # output selection (moments[0], split[i], ...): arity is only
+            # known at eval time (the registry carries it in the
+            # reference); selection on a single-output op is the identity
+            return Symbol(self.op, self.name, self.inputs, self.attrs,
+                          out_index=idx)
         if idx == 0:
             return self
         raise IndexError(idx)
@@ -275,43 +317,78 @@ class Symbol:
         cache: Dict[int, object] = {}
 
         def run(s):
-            key = id(s)
+            # cache by NAME so output selections of one node (m[0], m[1])
+            # share a single execution — selections are distinct Python
+            # objects carrying the same name; re-running the base op would
+            # double the work and, for samplers, draw inconsistent values
+            key = s.name
             if key in cache:
-                return cache[key]
-            if s.op is None:
+                val = cache[key]
+            elif s.op is None:
                 if s.name not in bindings:
                     raise MXNetError(f"unbound variable '{s.name}'")
                 val = bindings[s.name]
+                cache[key] = val
             elif s.op == "_group":
                 val = [run(i) for i in s.inputs]
+                cache[key] = val
             else:
                 fn = _resolve_op(s.op)
                 if fn is None:
                     raise MXNetError(f"unknown op '{s.op}'")
                 ins = [run(i) for i in s.inputs]
                 val = _call_op(fn, s.op, ins, s.attrs)
-                if isinstance(val, (tuple, list)) and s._out_index is None:
+                if isinstance(val, tuple):
                     val = list(val)
-            cache[key] = val
+                cache[key] = val
+            if s._out_index is not None and isinstance(val, list) \
+                    and s.op != "_group":
+                return val[s._out_index]
             return val
 
         out = run(self)
-        if self._out_index is not None and isinstance(out, (tuple, list)):
-            out = out[self._out_index]
         return out if isinstance(out, list) else [out]
 
     def bind(self, device=None, args=None, ctx=None, args_grad=None,
              grad_req="write", **kwargs):
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(self.list_arguments(), args))
         return Executor(self, device or ctx, args or {}, args_grad, grad_req)
 
-    def simple_bind(self, device=None, ctx=None, grad_req="write", **shapes):
+    # private spellings the reference's own tests use
+    # (`python/mxnet/symbol/symbol.py` _bind/_simple_bind)
+    _bind = bind
+
+    def simple_bind(self, device=None, ctx=None, grad_req="write",
+                    type_dict=None, **shapes):
         from .. import numpy as mnp
-        args = {n: mnp.zeros(shapes[n]) for n in self.list_arguments()
-                if n in shapes}
+        from ..util import x64_scope
+        var_attrs = {}
+
+        def walk(s, seen):
+            if id(s) in seen:
+                return
+            seen.add(id(s))
+            if s.op is None and s.attrs:
+                var_attrs[s.name] = s.attrs
+            for i in s.inputs:
+                walk(i, seen)
+
+        walk(self, set())
+        args = {}
+        for n in self.list_arguments():
+            if n not in shapes:
+                continue
+            dt = (type_dict or {}).get(n) or var_attrs.get(n, {}).get(
+                "dtype", "float32")
+            with x64_scope():   # honor an explicit f64 placeholder dtype
+                args[n] = mnp.zeros(shapes[n], dtype=dt)
         missing = [n for n in self.list_arguments() if n not in args]
         if missing:
             raise MXNetError(f"simple_bind missing shapes for {missing}")
         return Executor(self, device or ctx, args, None, grad_req)
+
+    _simple_bind = simple_bind
 
     def infer_shape(self, **shapes):
         """Run a zero-filled evaluation to recover shapes (XLA would trace
@@ -330,23 +407,27 @@ class Symbol:
         nodes, index = [], {}
 
         def visit(s):
-            if id(s) in index:
-                return index[id(s)]
-            ins = [visit(i) for i in s.inputs]
+            # keyed by name so two output-selections of one node (m[0],
+            # m[1]) serialize a single op node; the selected output index
+            # rides the EDGE triple [node, out, version], as in the
+            # reference's nnvm json
+            if s.name in index:
+                return index[s.name]
+            ins = [[visit(i), i._out_index or 0, 0] for i in s.inputs]
             idx = len(nodes)
             nodes.append({
                 "op": "null" if s.op is None else s.op,
                 "name": s.name,
                 "attrs": _json_attrs(s.attrs),
-                "inputs": [[i, 0, 0] for i in ins],
+                "inputs": ins,
             })
-            index[id(s)] = idx
+            index[s.name] = idx
             return idx
 
         if self.op == "_group":
-            heads = [[visit(i), 0, 0] for i in self.inputs]
+            heads = [[visit(i), i._out_index or 0, 0] for i in self.inputs]
         else:
-            heads = [[visit(self), 0, 0]]
+            heads = [[visit(self), self._out_index or 0, 0]]
         arg_nodes = [i for i, n in enumerate(nodes) if n["op"] == "null"]
         return json.dumps({"nodes": nodes, "arg_nodes": arg_nodes,
                            "heads": heads,
@@ -371,7 +452,10 @@ def _json_attrs(attrs):
 # ---------------------------------------------------------------------------
 
 def Variable(name, **kwargs):
-    return Symbol(None, name)
+    # shape/dtype/init hints ride in attrs (used by simple_bind to type
+    # the placeholder arrays it allocates, as the reference does)
+    return Symbol(None, name, attrs={k: v for k, v in kwargs.items()
+                                     if v is not None})
 
 
 var = Variable
@@ -395,15 +479,24 @@ def ones(shape, dtype="float32", name=None):
 def fromjson(json_str: str) -> Symbol:
     g = json.loads(json_str)
     built: List[Symbol] = []
+
+    def _sel(edge):
+        node, oi = built[edge[0]], (edge[1] if len(edge) > 1 else 0)
+        if oi and node.op is not None:
+            return Symbol(node.op, node.name, node.inputs, node.attrs,
+                          out_index=oi)
+        return node
+
     for node in g["nodes"]:
-        ins = [built[i[0]] for i in node.get("inputs", [])]
+        ins = [_sel(i) for i in node.get("inputs", [])]
         # stock files: "attrs" (>=1.2) or "param" (older nnvm exports)
         attrs = node.get("attrs") or node.get("param") or {}
         if node["op"] == "null":
-            built.append(Symbol(None, node["name"]))
+            # keep variable attrs: dtype/shape hints feed simple_bind
+            built.append(Symbol(None, node["name"], attrs=attrs))
         else:
             built.append(Symbol(node["op"], node["name"], ins, attrs))
-    heads = [built[h[0]] for h in g["heads"]]
+    heads = [_sel(h) for h in g["heads"]]
     return heads[0] if len(heads) == 1 else Group(heads)
 
 
@@ -423,13 +516,18 @@ class Executor:
     def __init__(self, symbol, device, args, args_grad, grad_req):
         self._symbol = symbol
         self._device = device or current_device()
-        self.arg_dict = dict(args)
-        self.grad_dict = dict(args_grad or {})
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(symbol.list_arguments(), args))
+        self.arg_dict = {k: self._as_nd(v) for k, v in dict(args).items()}
+        if isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(symbol.list_arguments(), args_grad))
+        self.grad_dict = {k: self._as_nd(v)
+                          for k, v in dict(args_grad or {}).items()}
         self._grad_req = grad_req
         self.outputs: List[ndarray] = []
 
     def forward(self, is_train=False, **kwargs):
-        self.arg_dict.update(kwargs)
+        self.arg_dict.update({k: self._as_nd(v) for k, v in kwargs.items()})
         if is_train:
             from .. import autograd
             for name, arr in self.arg_dict.items():
@@ -444,14 +542,55 @@ class Executor:
                                              **self.arg_dict)
         return self.outputs
 
+    @staticmethod
+    def _as_nd(v):
+        if v is None or isinstance(v, ndarray):
+            return v
+        from ..numpy import array
+        from ..util import x64_scope
+        with x64_scope():   # preserve a caller's f64 numpy arrays
+            return array(_onp.asarray(v))
+
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._symbol.list_arguments()
+                if n in self.arg_dict]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n)
+                for n in self._symbol.list_arguments()]
+
+    @property
+    def aux_arrays(self):
+        return []
+
     def backward(self, out_grads=None):
         if not self.outputs:
-            raise MXNetError("call forward(is_train=True) first")
+            # the reference allows backward straight after bind (its
+            # executor owns the whole dataflow graph); run the forward
+            # training pass implicitly
+            self.forward(is_train=True)
         from .. import autograd
+        from ..numpy import array as _arr
+        if out_grads is not None:
+            if not isinstance(out_grads, (list, tuple)):
+                out_grads = [out_grads]
+            out_grads = [g if isinstance(g, ndarray)
+                         else _arr(_onp.asarray(g)) for g in out_grads]
         autograd.backward(self.outputs, head_grads=out_grads)
         for name, arr in self.arg_dict.items():
             if arr.grad is not None:
-                self.grad_dict[name] = arr.grad
+                dst = self.grad_dict.get(name)
+                if isinstance(dst, ndarray):
+                    # reference executors WRITE into the caller's
+                    # args_grad arrays — preserve that aliasing
+                    if self._grad_req == "add":
+                        dst._data = dst._data + arr.grad._data
+                    else:
+                        dst._data = arr.grad._data
+                else:
+                    self.grad_dict[name] = arr.grad
         return self.grad_dict
 
 
@@ -472,10 +611,42 @@ def _make_op(name):
         for a in args:
             if isinstance(a, Symbol):
                 sym_inputs.append(a)
+            elif isinstance(a, (bool, int, float)):
+                # scalar operand mixed into a symbolic expression
+                # (reference: scalar ops fold into the node's attrs; here
+                # a literal node keeps one eval path)
+                sym_inputs.append(Symbol._node("_scalar_literal", (),
+                                               {"value": a}))
             else:
                 raise MXNetError(
                     f"mx.sym.{op_name} positional args must be Symbols; "
                     f"got {type(a).__name__} (pass arrays via eval bindings)")
+        # keyword Symbol inputs (`mx.sym.LeakyReLU(data=x, ...)`) become
+        # named inputs: appended after the positionals, their parameter
+        # names recorded in the JSON-safe attr _kw_input_names
+        kw_names = []
+        for k in list(attrs):
+            if isinstance(attrs[k], Symbol):
+                sym_inputs.append(attrs.pop(k))
+                kw_names.append(k)
+        if kw_names:
+            attrs["_kw_input_names"] = kw_names
+        if not sym_inputs:
+            # attr-only construction (`mx.sym.softmin(axis=1)`): the
+            # reference auto-creates placeholder variables for the op's
+            # required array inputs; mirror via signature introspection
+            import inspect
+            try:
+                sig = inspect.signature(_resolve_op(op_name))
+                for p in sig.parameters.values():
+                    if p.default is inspect.Parameter.empty and p.kind in (
+                            inspect.Parameter.POSITIONAL_ONLY,
+                            inspect.Parameter.POSITIONAL_OR_KEYWORD) and \
+                            p.name not in attrs:
+                        sym_inputs.append(
+                            Symbol(None, _auto_name(f"{op_name}_{p.name}")))
+            except (TypeError, ValueError):
+                pass
         return Symbol._node(op_name, tuple(sym_inputs), attrs, name)
 
     sym_op.__name__ = op_name

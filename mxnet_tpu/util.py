@@ -17,7 +17,38 @@ __all__ = [
     "save_arrays", "load_arrays", "use_np", "use_np_shape", "use_np_array",
     "is_np_array", "is_np_shape", "set_np", "reset_np", "np_shape", "np_array",
     "getenv", "setenv", "default_array",
+    "x64_enabled", "set_x64", "x64_scope",
 ]
+
+
+# -----------------------------------------------------------------------
+# 64-bit float support (parity: the reference computes genuinely in f64 on
+# CPU via mshadow dtype dispatch; under XLA the equivalent switch is
+# `jax_enable_x64`).  Three ways in: the MXTPU_ENABLE_X64=1 env var at
+# import, the global set_x64(True), or the scoped x64_scope() context.
+# While x64 is DISABLED, explicit float64/complex128 requests raise
+# loudly (base.check_x64_dtype) instead of silently truncating to f32.
+# -----------------------------------------------------------------------
+
+def x64_enabled() -> bool:
+    """True when 64-bit floats are live (jax_enable_x64)."""
+    return bool(jax.config.jax_enable_x64)
+
+
+def set_x64(enabled: bool = True) -> None:
+    """Globally enable/disable 64-bit float support (process-wide)."""
+    jax.config.update("jax_enable_x64", bool(enabled))
+
+
+def x64_scope(enabled: bool = True):
+    """Scoped 64-bit float support::
+
+        with mx.util.x64_scope():
+            a = mx.np.array([1.0], dtype="float64")   # true f64
+
+    Wraps JAX's scoped `enable_x64` config state; compiled functions are
+    cached separately per setting, so toggling is jit-safe."""
+    return jax.enable_x64(bool(enabled))
 
 
 def npz_encode_entry(out: dict, key: str, arr) -> None:
@@ -61,29 +92,76 @@ def load_arrays(fname: str):
     return out
 
 
-# ---- numpy-semantics scopes: always-on in this framework (2.x behavior) ----
+# ---- numpy-semantics scopes (parity: `python/mxnet/util.py` np_shape /
+# set_np / use_np).  The np front end (`mx.np`) is unconditionally
+# np-semantics by design; the SHAPE flag below is real scoped state that
+# the LEGACY `mx.nd` surface consults — with it off (the reference's
+# import-time default) 0-d / zero-size creations raise, as 1.x did. ----
+
+_np_shape_global = [False]          # process-wide flag (set_np_shape)
+_np_shape_state = threading.local()  # per-thread scope override (np_shape)
+
 
 def is_np_array():
     return True
 
 
 def is_np_shape():
-    return True
+    override = getattr(_np_shape_state, "value", None)
+    return _np_shape_global[0] if override is None else override
+
+
+def set_np_shape(active):
+    """Turn numpy shape semantics on/off globally (process-wide, visible
+    to all threads); returns the previous state (parity: util.py
+    set_np_shape).  The scoped `np_shape` context overrides per-thread."""
+    prev = is_np_shape()
+    _np_shape_global[0] = bool(active)
+    return prev
 
 
 def set_np(shape=True, array=True, dtype=False):
-    pass
+    if not shape and array:
+        raise ValueError("NumPy-array semantics require NumPy-shape "
+                         "semantics (reference set_np constraint)")
+    set_np_shape(shape)
 
 
 def reset_np():
-    pass
+    set_np_shape(False)
 
 
-class _NoopScope:
-    def __call__(self, fn=None):
-        if fn is None:
-            return self
-        return fn
+class np_shape:
+    """Context manager / decorator scoping numpy shape semantics for the
+    CURRENT thread (parity: util.py np_shape)."""
+
+    def __init__(self, active=True):
+        self._active = bool(active)
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_np_shape_state, "value", None)
+        _np_shape_state.value = self._active
+        return self
+
+    def __exit__(self, *a):
+        _np_shape_state.value = self._prev
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            with np_shape(self._active):
+                return fn(*args, **kwargs)
+        return wrapped
+
+
+class np_array:
+    """Array-semantics scope: always-on here (single ndarray type), kept
+    as a context manager for API parity."""
+
+    def __init__(self, active=True):
+        pass
 
     def __enter__(self):
         return self
@@ -91,21 +169,20 @@ class _NoopScope:
     def __exit__(self, *a):
         return False
 
-
-np_shape = _NoopScope()
-np_array = _NoopScope()
-
-
-def use_np(fn):
-    return fn
+    def __call__(self, fn):
+        return fn
 
 
 def use_np_shape(fn):
-    return fn
+    return np_shape(True)(fn)
 
 
 def use_np_array(fn):
     return fn
+
+
+def use_np(fn):
+    return use_np_array(use_np_shape(fn))
 
 
 def use_np_default_dtype(fn):

@@ -1,0 +1,75 @@
+"""Conformance-tier fixtures: run the REFERENCE's own unit-test bodies
+against this framework (VERDICT r4 item 2 — turn name-level parity into
+behavior-level parity).
+
+The shim is an import alias: a meta-path finder maps every ``mxnet`` /
+``mxnet.*`` import onto the matching ``mxnet_tpu`` module, so ported test
+bodies keep their original ``import mxnet as mx`` / ``from mxnet import
+np, npx`` lines verbatim.  Deviations that are *documented design
+decisions* (sparse storage as a scoped subset, dynamic-shape-under-jit,
+TVM ops) are xfailed/skipped inline in the ported files with one-line
+reasons — an xfail here is an assertion about the design, not a TODO.
+"""
+import importlib
+import importlib.abc
+import importlib.util
+import sys
+
+# CPU + virtual 8-device mesh comes from tests/conftest.py (parent dir);
+# pytest loads parent conftests first, so JAX is already pinned to cpu.
+
+
+class _MxnetAliasFinder(importlib.abc.MetaPathFinder, importlib.abc.Loader):
+    """``mxnet[.sub]`` -> ``mxnet_tpu[.sub]`` import alias."""
+
+    def find_spec(self, name, path=None, target=None):
+        if name != "mxnet" and not name.startswith("mxnet."):
+            return None
+        real = "mxnet_tpu" + name[len("mxnet"):]
+        try:
+            if importlib.util.find_spec(real) is None:
+                return None
+        except (ImportError, ModuleNotFoundError):
+            return None
+        return importlib.util.spec_from_loader(name, self, origin=real)
+
+    def create_module(self, spec):
+        return importlib.import_module(spec.origin)
+
+    def exec_module(self, module):
+        pass
+
+
+if not any(isinstance(f, _MxnetAliasFinder) for f in sys.meta_path):
+    sys.meta_path.insert(0, _MxnetAliasFinder())
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _x64_parity_scope():
+    """The reference computes genuinely in f64 on CPU; ported f64
+    parametrizations run under scoped x64 so they behave identically."""
+    import mxnet_tpu as mx
+    with mx.util.x64_scope():
+        yield
+
+
+class _X64Module(pytest.Module):
+    """Ported modules create f64 arrays in parametrize args at import —
+    collection needs the x64 scope too (runtime gets it from the autouse
+    fixture above)."""
+
+    def collect(self):
+        import jax
+        old = bool(jax.config.jax_enable_x64)
+        jax.config.update("jax_enable_x64", True)
+        try:
+            return list(super().collect())
+        finally:
+            jax.config.update("jax_enable_x64", old)
+
+
+def pytest_pycollect_makemodule(module_path, parent):
+    return _X64Module.from_parent(parent, path=module_path)

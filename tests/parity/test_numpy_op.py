@@ -1,0 +1,6166 @@
+"""Reference unit-test bodies, run against mxnet_tpu (VERDICT r4 item 2).
+
+PROVENANCE: the test functions below are ported from the reference's
+`tests/python/unittest/test_numpy_op.py`
+(Apache-2.0) — intentionally faithful, because these bodies ARE the
+behavior-parity oracle: they encode the reference's op semantics
+(dtype promotion, degenerate shapes, error paths) independently of this
+repo's own builder-authored sweeps.  The `mxnet` import resolves to
+`mxnet_tpu` via the alias finder in `tests/parity/conftest.py`.
+Deviations that are documented design decisions are xfailed inline with
+one-line reasons (an xfail is an assertion about the design, not a TODO).
+"""
+import itertools
+import random
+
+import numpy as onp
+import pytest
+import scipy.stats as ss
+import scipy.special as scipy_special
+from numpy.testing import assert_allclose
+
+import mxnet as mx
+from mxnet import np, npx
+from mxnet.base import MXNetError
+from mxnet.gluon import HybridBlock
+from mxnet.gluon.parameter import Parameter
+from mxnet.test_utils import (
+    assert_almost_equal, check_numeric_gradient, collapse_sum_like,
+    effective_dtype, environment, gen_buckets_probs_with_ppf, is_op_runnable,
+    has_tvm_ops, new_matrix_with_real_eigvals_nd,
+    new_sym_matrix_with_real_eigvals_nd, rand_ndarray, rand_shape_2d,
+    rand_shape_nd, retry, same, use_np, verify_generator,
+)
+import mxnet.ndarray.numpy._internal as _npi
+from mxnet.numpy_op_signature import _get_builtin_op
+from common import (
+    assertRaises, assert_raises_cuda_not_satisfied,
+    xfail_when_nonstandard_decimal_separator, with_environment,
+)
+
+pytestmark = pytest.mark.parity
+
+@use_np
+@pytest.mark.parametrize('hybridize', [True, False])
+@pytest.mark.parametrize('dtype', [onp.float32, onp.float64])
+@pytest.mark.parametrize('a_shape,b_shape,axes', [
+    ((3, 5), (5, 4), 1),
+    ((3,), (3,), 1),
+    ((3, 4, 5, 3, 2), (5, 3, 2, 1, 2), 3),
+    ((3, 5, 4, 3, 2), (2, 3, 5, 1, 2), [[1, 3, 4], [2, 1, 0]]),
+    ((3, 5, 4), (5, 4, 3), [[1, 0, 2], [0, 2, 1]]),
+    ((3, 5, 4), (5, 3, 4), [[2, 0], [-1, -2]]),
+    ((2, 2), (2, 2), 2),
+    ((3, 5, 4), (5, ), [[-2], [0]]),
+    ((3, 5, 4), (5, ), [[1], [0]]),
+    ((2,), (2, 3), 1),
+    ((3,), (3,), 0),
+    ((2,), (2, 3), 0),
+    ((3, 5, 4), (5, ), 0),
+    ((2, 3, 4), (4, 3, 2), [[], []]),
+    ((3, 0), (0, 5), 1),
+    ((3, 0), (0, 4), [[1], [0]]),
+    ((0, 3), (3, 5), 1),
+    ((0, 3), (5, 0), [[0], [1]])
+])
+def test_np_tensordot(a_shape, b_shape, axes, hybridize, dtype):
+    class TestTensordot(HybridBlock):
+        def __init__(self, axes):
+            super(TestTensordot, self).__init__()
+            self._axes = axes
+
+        def forward(self, a, b):
+            return np.tensordot(a, b, self._axes)
+
+    def tensordot_backward(out_grad, a, b, axes=2):
+        if (a.ndim < 1) or (b.ndim < 1):
+            raise ValueError('An input is zero-dim')
+
+        if onp.isscalar(axes):
+            a_axes_summed = [i + a.ndim - axes for i in range(axes)]
+            b_axes_summed = [i for i in range(axes)]
+        else:
+            if len(axes) != 2:
+                raise ValueError('Axes must consist of two arrays.')
+            a_axes_summed, b_axes_summed = axes
+            if onp.isscalar(a_axes_summed):
+                a_axes_summed = a_axes_summed,
+            if onp.isscalar(b_axes_summed):
+                b_axes_summed = b_axes_summed,
+
+            for i in range(len(a_axes_summed)):
+                a_axes_summed[i] = (a_axes_summed[i] + a.ndim) % a.ndim
+
+            for i in range(len(b_axes_summed)):
+                b_axes_summed[i] = (b_axes_summed[i] + b.ndim) % b.ndim
+
+        if len(a_axes_summed) != len(b_axes_summed):
+            raise ValueError('Axes length mismatch')
+
+        a_axes_remained = []
+        for i in range(a.ndim):
+            if not (i in a_axes_summed):
+                a_axes_remained.append(i)
+        a_axes = a_axes_remained[:] + a_axes_summed[:]
+
+        b_axes_remained = []
+        for i in range(b.ndim):
+            if not (i in b_axes_summed):
+                b_axes_remained.append(i)
+        b_axes = b_axes_summed[:] + b_axes_remained[:]
+
+        ad1 = onp.prod([a.shape[i] for i in a_axes_remained]) if len(a_axes_remained) > 0 else 1
+        ad2 = onp.prod([a.shape[i] for i in a_axes_summed]) if len(a_axes_summed) > 0 else 1
+        bd1 = onp.prod([b.shape[i] for i in b_axes_summed]) if len(b_axes_summed) > 0 else 1
+        bd2 = onp.prod([b.shape[i] for i in b_axes_remained]) if len(b_axes_remained) > 0 else 1
+
+        out_grad = out_grad.reshape((ad1, bd2))
+
+        new_a = onp.transpose(a, a_axes)
+        new_a_shape = new_a.shape[:]
+        new_a = new_a.reshape((ad1, ad2))
+        new_b = onp.transpose(b, b_axes)
+        new_b_shape = new_b.shape[:]
+        new_b = new_b.reshape((bd1, bd2))
+
+        reverse_a_axes = [0 for i in a_axes]
+        for i in range(len(a_axes)):
+            reverse_a_axes[a_axes[i]] = i
+
+        reverse_b_axes = [0 for i in b_axes]
+        for i in range(len(b_axes)):
+            reverse_b_axes[b_axes[i]] = i
+
+        grad_b = onp.dot(new_a.T, out_grad).reshape(new_b_shape)
+        grad_b = onp.transpose(grad_b, reverse_b_axes)
+        grad_a = onp.dot(out_grad, new_b.T).reshape(new_a_shape)
+        grad_a = onp.transpose(grad_a, reverse_a_axes)
+
+        return [grad_a, grad_b]
+
+    test_tensordot = TestTensordot(axes)
+    if hybridize:
+        test_tensordot.hybridize()
+    a = rand_ndarray(shape = a_shape, dtype = dtype).as_np_ndarray()
+    b = rand_ndarray(shape = b_shape, dtype = dtype).as_np_ndarray()
+    a.attach_grad()
+    b.attach_grad()
+
+    np_out = onp.tensordot(a.asnumpy(), b.asnumpy(), axes)
+    with mx.autograd.record():
+        mx_out = test_tensordot(a, b)
+    assert mx_out.shape == np_out.shape
+    assert_almost_equal(mx_out.asnumpy(), np_out, rtol = 1e-3, atol = 1e-5)
+    mx_out.backward()
+    np_backward = tensordot_backward(onp.ones(np_out.shape), a.asnumpy(), b.asnumpy(), axes)
+    assert_almost_equal(a.grad.asnumpy(), np_backward[0], rtol = 1e-3, atol=1e-5)
+    assert_almost_equal(b.grad.asnumpy(), np_backward[1], rtol = 1e-3, atol=1e-5)
+
+    # Test imperative once again
+    mx_out = np.tensordot(a, b, axes)
+    np_out = onp.tensordot(a.asnumpy(), b.asnumpy(), axes)
+    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+
+    # test numeric gradient
+    if (onp.prod(a_shape) > 0 and onp.prod(b_shape) > 0):
+        a_sym = mx.sym.Variable("a").as_np_ndarray()
+        b_sym = mx.sym.Variable("b").as_np_ndarray()
+        mx_sym = mx.sym.np.tensordot(a_sym, b_sym, axes).as_nd_ndarray()
+        check_numeric_gradient(mx_sym, [a.as_nd_ndarray(), b.as_nd_ndarray()],
+          rtol=1e-1, atol=1e-1, dtype = dtype)
+
+    # General Gradient Test
+    for a_grad_status in ['add', 'write']:
+        for b_grad_status in ['add', 'write']:
+            a = mx.np.random.normal(0, 1, a_shape)
+            b = mx.np.random.normal(0, 1, b_shape)
+            a.attach_grad(a_grad_status)
+            b.attach_grad(b_grad_status)
+            if a_grad_status == 'add':
+                ori_a_grad = mx.np.random.normal(0, 1, a_shape)
+                if a.ndim == 0:
+                    a.grad[()] = ori_a_grad
+                else:
+                    a.grad[:] = ori_a_grad
+            if b_grad_status == 'add':
+                ori_b_grad = mx.np.random.normal(0, 1, b_shape)
+                if b.ndim == 0:
+                    b.grad[()] = ori_b_grad
+                else:
+                    b.grad[:] = ori_b_grad
+
+            with mx.autograd.record():
+                mx_out = mx.np.tensordot(a, b, axes)
+                out_grad = mx.np.random.normal(0, 1, mx_out.shape)
+                loss = (mx_out * out_grad).sum()
+                loss.backward()
+
+            gt_in_grad = tensordot_backward(out_grad.asnumpy(), a.asnumpy(), b.asnumpy(), axes)
+
+            if(a_grad_status == 'add'):
+                gt_in_grad[0] += ori_a_grad
+            if(b_grad_status == 'add'):
+                gt_in_grad[1] += ori_b_grad
+
+            assert_almost_equal(a.grad.asnumpy(), gt_in_grad[0], rtol=1e-2, atol=1e-2)
+            assert_almost_equal(b.grad.asnumpy(), gt_in_grad[1], rtol=1e-2, atol=1e-2)
+
+
+@use_np
+@pytest.mark.parametrize('shape_a,shape_b', [
+    ((3, 0), (0, 4)),
+    ((3,), (3,)),
+    ((3, 4), (4, 5)),
+    ((), ()),
+    ((3, 4, 5), ()),
+    ((), (3, 4, 5)),
+    ((3, 4, 5), (5, )),
+    ((3, 4, 5), (5, 2)),
+    ((5,), (5, 2)),
+    ((3, 5, 4), (5, 4, 3)),
+    ((3, 4), (5, 4, 3)),
+    ((4,), (5, 4, 3))
+])
+def test_np_dot(shape_a, shape_b):
+    eps = 1e-3
+
+    np_a = onp.random.uniform(-1.0, 1.0, shape_a)
+    np_a[abs(np_a) < eps] = 2 * eps
+    np_b = onp.random.uniform(-1.0, 1.0, shape_b)
+    np_b[abs(np_b) < eps] = 2 * eps
+    a = mx.nd.array(np_a)
+    b = mx.nd.array(np_b)
+    np_res = onp.dot(np_a, np_b)
+    mx_res = np.dot(a.as_np_ndarray(), b.as_np_ndarray())
+    assert mx_res.shape == np_res.shape
+    assert_almost_equal(np_res, mx_res.asnumpy(), rtol=1e-5, atol=1e-5)
+    mx_a = mx.sym.Variable("a")
+    mx_b = mx.sym.Variable("b")
+    mx_sym = mx.sym.np.dot(mx_a.as_np_ndarray(), mx_b.as_np_ndarray()).as_nd_ndarray()
+    if (len(shape_a) > 0 and len(shape_b) > 0 and onp.prod(shape_a) > 0 and onp.prod(shape_b) > 0):
+        check_numeric_gradient(mx_sym, {"a": a, "b": b}, numeric_eps=eps, rtol=1e-2, atol=1e-3)
+
+
+@use_np
+@pytest.mark.parametrize('shape_a,shape_b', [
+    ((4, 5), (2, 3)),
+    ((3, 4, 5), (6, ))
+])
+def test_np_dot_error(shape_a, shape_b):
+    a = mx.nd.array(random.random()) if len(shape_a) == 0 else rand_ndarray(shape_a)
+    b = mx.nd.array(random.random()) if len(shape_b) == 0 else rand_ndarray(shape_b)
+    with pytest.raises(mx.base.MXNetError):
+        mx_res = np.dot(a.as_np_ndarray(), b.as_np_ndarray())
+
+
+@use_np
+@pytest.mark.parametrize('shape', [(), (5,), (3, 3)])
+@pytest.mark.parametrize('hybridize', [True, False])
+@pytest.mark.parametrize('dtype', [onp.float32, onp.float64])
+def test_np_vdot(shape, dtype, hybridize):
+    class TestVdot(HybridBlock):
+        def __init__(self):
+            super(TestVdot, self).__init__()
+
+        def forward(self, a, b):
+            return np.vdot(a, b)
+
+    def vdot_backward(a, b):
+        return [b, a]
+
+    test_vdot = TestVdot()
+    if hybridize:
+        test_vdot.hybridize()
+    a = rand_ndarray(shape=shape, dtype=dtype).as_np_ndarray()
+    b = rand_ndarray(shape=shape, dtype=dtype).as_np_ndarray()
+    a.attach_grad()
+    b.attach_grad()
+
+    np_out = onp.vdot(a.asnumpy(), b.asnumpy())
+    with mx.autograd.record():
+        mx_out = test_vdot(a, b)
+    assert mx_out.shape == np_out.shape
+    assert_almost_equal(mx_out.asnumpy(), np_out, rtol = 1e-3, atol = 1e-5)
+    mx_out.backward()
+    np_backward = vdot_backward(a.asnumpy(), b.asnumpy())
+    assert_almost_equal(a.grad.asnumpy(), np_backward[0], rtol = 1e-2, atol=1e-2)
+    assert_almost_equal(b.grad.asnumpy(), np_backward[1], rtol = 1e-2, atol=1e-2)
+
+    # Test imperative once again
+    mx_out = np.vdot(a, b)
+    np_out = onp.vdot(a.asnumpy(), b.asnumpy())
+    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+
+    # test numeric gradient
+    if len(shape) > 0 and onp.prod(shape) > 0:
+        a_sym = mx.sym.Variable("a").as_np_ndarray()
+        b_sym = mx.sym.Variable("b").as_np_ndarray()
+        mx_sym = mx.sym.np.vdot(a_sym, b_sym).as_nd_ndarray()
+        check_numeric_gradient(mx_sym, [a.as_nd_ndarray(), b.as_nd_ndarray()],
+          rtol=1e-1, atol=1e-1, dtype=dtype)
+
+
+@use_np
+@pytest.mark.parametrize('a_shape,b_shape', [
+    ((3,), (3,)),
+    ((2, 3), (3,)),
+    ((3,), (2, 3))
+])
+@pytest.mark.parametrize('hybridize', [True, False])
+@pytest.mark.parametrize('dtype', [onp.float32, onp.float64])
+def test_np_inner(a_shape, b_shape, dtype, hybridize):
+    class TestInner(HybridBlock):
+        def __init__(self):
+            super(TestInner, self).__init__()
+
+        def forward(self, a, b):
+            return np.inner(a, b)
+
+    def inner_backward(a, b):
+        a_axes_summed = [a.ndim - 1]
+        b_axes_summed = [b.ndim - 1]
+
+        a_axes_remained = []
+        for i in range(a.ndim):
+            if not (i in a_axes_summed):
+                a_axes_remained.append(i)
+        a_axes = a_axes_remained[:] + a_axes_summed[:]
+
+        b_axes_remained = []
+        for i in range(b.ndim):
+            if not (i in b_axes_summed):
+                b_axes_remained.append(i)
+        b_axes = b_axes_summed[:] + b_axes_remained[:]
+
+        ad1 = onp.prod([a.shape[i] for i in a_axes_remained]) if len(a_axes_remained) > 0 else 1
+        ad2 = onp.prod([a.shape[i] for i in a_axes_summed]) if len(a_axes_summed) > 0 else 1
+        bd1 = onp.prod([b.shape[i] for i in b_axes_summed]) if len(b_axes_summed) > 0 else 1
+        bd2 = onp.prod([b.shape[i] for i in b_axes_remained]) if len(b_axes_remained) > 0 else 1
+
+        out_grad = onp.ones((ad1, bd2))
+
+        new_a = onp.transpose(a, a_axes)
+        new_a_shape = new_a.shape[:]
+        new_a = new_a.reshape((ad1, ad2))
+        new_b = onp.transpose(b, b_axes)
+        new_b_shape = new_b.shape[:]
+        new_b = new_b.reshape((bd1, bd2))
+
+        reverse_a_axes = [0 for i in a_axes]
+        for i in range(len(a_axes)):
+            reverse_a_axes[a_axes[i]] = i
+
+        reverse_b_axes = [0 for i in b_axes]
+        for i in range(len(b_axes)):
+            reverse_b_axes[b_axes[i]] = i
+
+        grad_b = onp.dot(new_a.T, out_grad).reshape(new_b_shape)
+        grad_b = onp.transpose(grad_b, reverse_b_axes)
+        grad_a = onp.dot(out_grad, new_b.T).reshape(new_a_shape)
+        grad_a = onp.transpose(grad_a, reverse_a_axes)
+
+        return [grad_a, grad_b]
+
+    test_inner = TestInner()
+    if hybridize:
+        test_inner.hybridize()
+    a = rand_ndarray(shape=a_shape, dtype=dtype).as_np_ndarray()
+    b = rand_ndarray(shape=b_shape, dtype=dtype).as_np_ndarray()
+    a.attach_grad()
+    b.attach_grad()
+
+    np_out = onp.inner(a.asnumpy(), b.asnumpy())
+    with mx.autograd.record():
+        mx_out = test_inner(a, b)
+    assert mx_out.shape == np_out.shape
+    assert_almost_equal(mx_out.asnumpy(), np_out, rtol = 1e-3, atol = 1e-5)
+    mx_out.backward()
+    np_backward = inner_backward(a.asnumpy(), b.asnumpy())
+    assert_almost_equal(a.grad.asnumpy(), np_backward[0], rtol = 1e-2, atol=1e-2)
+    assert_almost_equal(b.grad.asnumpy(), np_backward[1], rtol = 1e-2, atol=1e-2)
+
+    # Test imperative once again
+    mx_out = np.inner(a, b)
+    np_out = onp.inner(a.asnumpy(), b.asnumpy())
+    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+
+    # test numeric gradient
+    a_sym = mx.sym.Variable("a").as_np_ndarray()
+    b_sym = mx.sym.Variable("b").as_np_ndarray()
+    mx_sym = mx.sym.np.inner(a_sym, b_sym).as_nd_ndarray()
+    check_numeric_gradient(mx_sym, [a.as_nd_ndarray(), b.as_nd_ndarray()],
+      rtol=1e-1, atol=1e-1, dtype=dtype)
+
+
+@use_np
+@pytest.mark.parametrize('a_shape,b_shape', [
+    ((3,), (3,)),
+    ((2, 3), (6,)),
+    ((6,), (2, 3))
+])
+@pytest.mark.parametrize('hybridize', [True, False])
+@pytest.mark.parametrize('dtype', [onp.float32, onp.float64])
+def test_np_outer(a_shape, b_shape, dtype, hybridize):
+    class TestOuter(HybridBlock):
+        def __init__(self):
+            super(TestOuter, self).__init__()
+
+        def forward(self, a, b):
+            return np.outer(a, b)
+
+    test_outer = TestOuter()
+    if hybridize:
+        test_outer.hybridize()
+    a = rand_ndarray(shape=a_shape, dtype=dtype).as_np_ndarray()
+    b = rand_ndarray(shape=b_shape, dtype=dtype).as_np_ndarray()
+    a.attach_grad()
+    b.attach_grad()
+
+    np_out = onp.outer(a.asnumpy(), b.asnumpy())
+    with mx.autograd.record():
+        mx_out = test_outer(a, b)
+    assert mx_out.shape == np_out.shape
+    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+    mx_out.backward()
+
+    # Test imperative once again
+    mx_out = np.outer(a, b)
+    np_out = onp.outer(a.asnumpy(), b.asnumpy())
+    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+
+    # test numeric gradient
+    a_sym = mx.sym.Variable("a").as_np_ndarray()
+    b_sym = mx.sym.Variable("b").as_np_ndarray()
+    mx_sym = mx.sym.np.outer(a_sym, b_sym).as_nd_ndarray()
+    check_numeric_gradient(mx_sym, [a.as_nd_ndarray(), b.as_nd_ndarray()],
+                           rtol=1e-1, atol=1e-1, dtype=dtype)
+
+
+@use_np
+@pytest.mark.parametrize('shape_a,shape_b', [
+    ((3,), (3,)),
+    ((3, 4), (4, 5)),
+    ((3, 0), (0, 4)),
+    ((4, 5), (5,)),
+    ((3, 4, 5), (5,)),
+    ((5,), (5, 2)),
+    ((2,), (4, 2, 3)),
+    ((2, 1, 3, 4, 5), (5, 2)),
+    ((1, 3, 5, 4), (1, 4, 3)),
+    ((3, 5, 4), (2, 1, 4, 3)),
+    ((3, 4), (1, 5, 4, 3))
+])
+@pytest.mark.parametrize('grad_req_a', ['write', 'add', 'null'])
+@pytest.mark.parametrize('grad_req_b', ['write', 'add', 'null'])
+@pytest.mark.parametrize('hybridize', [True, False])
+@pytest.mark.parametrize('dtype', [onp.float32, onp.float64])
+def test_np_matmul(shape_a, shape_b, grad_req_a, grad_req_b,
+                   dtype, hybridize):
+    class TestMatmul(HybridBlock):
+        def __init__(self):
+            super(TestMatmul, self).__init__()
+
+        def forward(self, a, b):
+            return np.matmul(a, b)
+
+    def matmul_backward(a, b):
+        def ShapeInfer(mat_a, mat_b):
+            if mat_a.ndim == 1:
+                mat_a = mat_a.reshape((1, mat_a.size))
+            if mat_b.ndim == 1:
+                mat_b = mat_b.reshape((mat_b.size, 1))
+            ndim = max(mat_a.ndim, mat_b.ndim)
+            newshape_a = list(onp.array(mat_a, ndmin=ndim).shape)
+            newshape_b = list(onp.array(mat_b, ndmin=ndim).shape)
+            if ndim >= 3:
+                pre_shape = onp.fmax(newshape_a[ndim - 3::-1], newshape_b[ndim - 3::-1])
+                newshape_a[ndim - 3::-1] = pre_shape
+                newshape_b[ndim - 3::-1] = pre_shape
+            else:
+                pre_shape = onp.array([])
+            out_shape = onp.append(pre_shape[::-1].astype(onp.int64), [newshape_a[ndim - 2], newshape_b[ndim - 1]])
+            return [ndim, newshape_a, newshape_b, out_shape]
+
+        def ShapeReduce(mat, shape, is_b=False):
+            ndim = mat.ndim
+            if is_b and len(shape) == 1:
+                rng = onp.arange(ndim - 2)
+            else:
+                pre_len = ndim - len(shape)
+                in_pre = onp.array(mat.shape[pre_len : ndim - 2])
+                out_pre = onp.array(shape[:len(shape) - 2])
+                diff = onp.nonzero(in_pre != out_pre)[0] + pre_len
+                rng = onp.append(onp.arange(ndim - len(shape)), diff)
+            mat = onp.sum(mat, axis=tuple(rng))
+            return mat.reshape(shape)
+
+        a_shape = a.shape
+        b_shape = b.shape
+        [ndim, newshape_a, newshape_b, out_shape] = ShapeInfer(a, b)
+        new_a = onp.broadcast_to(a, newshape_a)
+        if len(b_shape) == 1:
+            new_b = onp.broadcast_to(b.reshape((b.size, 1)), newshape_b)
+        else:
+            new_b = onp.broadcast_to(b, newshape_b)
+
+        ad1 = new_a.shape[ndim - 2]
+        ad2 = new_a.shape[ndim - 1]
+        bd1 = new_b.shape[ndim - 2]
+        bd2 = new_b.shape[ndim - 1]
+        a_T = onp.moveaxis(new_a, [ndim - 2, ndim - 1], [ndim - 1, ndim - 2])
+        b_T = onp.moveaxis(new_b, [ndim - 2, ndim - 1], [ndim - 1, ndim - 2])
+        out_grad = onp.ones(out_shape)
+        grad_b = onp.matmul(a_T, out_grad)
+        grad_b = ShapeReduce(grad_b, b_shape, is_b=True)
+        grad_a = onp.matmul(out_grad, b_T)
+        grad_a = ShapeReduce(grad_a, a_shape)
+        return [grad_a, grad_b]
+
+    eps = 1E-4
+    test_matmul = TestMatmul()
+    if hybridize:
+        test_matmul.hybridize()
+    np_a = onp.random.uniform(-1.0, 1.0, shape_a).astype(dtype)
+    np_a[abs(np_a) < eps] = 2 * eps
+    np_b = onp.random.uniform(-1.0, 1.0, shape_b).astype(dtype)
+    np_b[abs(np_b) < eps] = 2 * eps
+    a = mx.np.array(np_a, dtype=dtype)
+    a.attach_grad(grad_req=grad_req_a)
+    b = mx.np.array(np_b, dtype=dtype)
+    b.attach_grad(grad_req=grad_req_b)
+
+    np_out = onp.matmul(np_a, np_b)
+    with mx.autograd.record():
+        mx_out = test_matmul(a, b)
+    assert mx_out.shape == np_out.shape
+    assert_almost_equal(np_out, mx_out.asnumpy(), rtol=eps, atol=eps)
+
+    if grad_req_a != 'null' or grad_req_b != 'null':
+        mx_out.backward()
+        np_backward = matmul_backward(np_a, np_b)
+        if grad_req_a == 'null':
+            assert a.grad is None
+        else:
+            assert_almost_equal(a.grad.asnumpy(), np_backward[0], rtol = eps, atol=eps)
+        if grad_req_b == 'null':
+            assert b.grad is None
+        else:
+            assert_almost_equal(b.grad.asnumpy(), np_backward[1], rtol = eps, atol=eps)
+
+    mx_out = np.matmul(a, b)
+    np_out = onp.matmul(np_a, np_b)
+    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=eps, atol=eps)
+
+
+@pytest.mark.parametrize('shape_a,shape_b', [
+    ((1,), (2,)),            # mismatched vector vector
+    ((2, 1,), (2,)),         # mismatched matrix vector
+    ((2,), (1, 2)),          # mismatched vector matrix
+    ((1, 2), (3, 1)),        # mismatched matrix matrix
+    ((1,), ()),              # vector scalar
+    ((), (1,)),              # scalar vector
+    ((1, 1), ()),            # matrix scalar
+    ((), (1, 1)),            # scalar matrix
+    ((2, 2, 1), (3, 1, 2)),  # cannot broadcast
+])
+def test_np_matmul_error(shape_a, shape_b):
+    a = np.random.uniform(size=shape_a)
+    b = np.random.uniform(size=shape_b)
+    with pytest.raises(MXNetError):
+        np.matmul(a, b)
+
+
+@use_np
+@pytest.mark.parametrize('a_shape,b_shape', [
+    ((3,), (3,)),
+    ((2, 3), (3,)),
+    ((2, 3, 4), (2,)),
+    ((3, 2), ())
+])
+@pytest.mark.parametrize('dtype', [onp.float32, onp.float64])
+@pytest.mark.parametrize('hybridize', [True, False])
+def test_np_kron(a_shape, b_shape, dtype, hybridize):
+    def np_kron_backward(ograd, a, b):
+        ndim = ograd.ndim
+        # Make ndim equal
+        if ndim > a.ndim:
+            a = a.reshape((1,)*(ndim - a.ndim) + a.shape)
+        else:
+            b = b.reshape((1,)*(ndim - b.ndim) + b.shape)
+        assert(a.ndim == b.ndim)
+
+        # Compute agrad
+        agrad = onp.zeros(a.shape)
+        for i in range(a.size):
+            ia = onp.asarray(onp.unravel_index(i, a.shape))
+            for j in range(b.size):
+                jb = onp.asarray(onp.unravel_index(j, b.shape))
+                k = ia * onp.asarray(b.shape) + jb
+                agrad[tuple(ia)] += ograd[tuple(k)] * b[tuple(jb)]
+        # Compute bgrad
+        bgrad = onp.zeros(b.shape)
+        for j in range(b.size):
+            jb = onp.asarray(onp.unravel_index(j, b.shape))
+            for i in range(a.size):
+                ia = onp.asarray(onp.unravel_index(i, a.shape))
+                k = ia * onp.asarray(b.shape) + jb
+                bgrad[tuple(jb)] += ograd[tuple(k)] * a[tuple(ia)]
+        return [agrad, bgrad]
+
+    class TestKron(HybridBlock):
+        def __init__(self):
+            super(TestKron, self).__init__()
+
+        def forward(self, a, b):
+            return np.kron(a, b)
+
+    test_kron = TestKron()
+    if hybridize:
+        test_kron.hybridize()
+    a = rand_ndarray(shape=a_shape, dtype=dtype).as_np_ndarray()
+    b = rand_ndarray(shape=b_shape, dtype=dtype).as_np_ndarray()
+    a.attach_grad()
+    b.attach_grad()
+
+    np_out = onp.kron(a.asnumpy(), b.asnumpy())
+    with mx.autograd.record():
+        mx_out = test_kron(a, b)
+    assert mx_out.shape == np_out.shape
+    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5, use_broadcast=False)
+    mx_out.backward()
+
+    # Test imperative once again
+    mx_out = np.kron(a, b)
+    np_out = onp.kron(a.asnumpy(), b.asnumpy())
+    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5, use_broadcast=False)
+
+    # test numeric gradient
+    a_sym = mx.sym.Variable("a").as_np_ndarray()
+    b_sym = mx.sym.Variable("b").as_np_ndarray()
+    mx_sym = mx.sym.np.kron(a_sym, b_sym).as_nd_ndarray()
+    check_numeric_gradient(mx_sym, [a.as_nd_ndarray(), b.as_nd_ndarray()],
+                           rtol=1e-2, atol=1e-2, dtype=dtype)
+
+    # test gradient via backward implemented by numpy
+    np_backward = np_kron_backward(onp.ones(np_out.shape, dtype = dtype), a.asnumpy(), b.asnumpy())
+    assert_almost_equal(a.grad.asnumpy(), np_backward[0], rtol=1e-2, atol=1e-2)
+    assert_almost_equal(b.grad.asnumpy(), np_backward[1], rtol=1e-2, atol=1e-2)
+
+
+@use_np
+@pytest.mark.parametrize('shape', [rand_shape_nd(4, dim=4), (4, 0, 4, 0)])
+@pytest.mark.parametrize('axis', [0, 1, 2, 3, (), None])
+@pytest.mark.parametrize('keepdims', [True, False])
+@pytest.mark.parametrize('dtype', ['float16', 'float32', 'float64', 'int8', 'int32', 'int64'])
+@pytest.mark.parametrize('itype,acc_type', [
+    ('float16', 'float32'),
+    ('float32', 'float64'),
+    ('float64', 'float64'),
+    ('int8', 'int32'),
+    ('int32', 'int64'),
+    ('int64', 'int64'),
+    ('bool', 'int64')
+])
+@pytest.mark.parametrize('hybridize', [True, False])
+def test_np_sum(shape, axis, keepdims, itype, acc_type, dtype, hybridize):
+    class TestSum(HybridBlock):
+        def __init__(self, axis=None, dtype=None, keepdims=False):
+            super(TestSum, self).__init__()
+            self._axis = axis
+            self._dtype = dtype
+            self._keepdims = keepdims
+
+        def forward(self, a, *args, **kwargs):
+            return np.sum(a, axis=self._axis, dtype=self._dtype, keepdims=self._keepdims)
+
+    class TestSumConv(HybridBlock):
+        def __init__(self, axis=None, dtype=None, keepdims=False):
+            super(TestSumConv, self).__init__()
+            self._axis = axis
+            self._dtype = dtype
+            self._keepdims = keepdims
+
+        def forward(self, a, *args, **kwargs):
+            return a.sum(axis=self._axis, dtype=self._dtype, keepdims=self._keepdims)
+
+    def is_int(dtype):
+        return 'int' in dtype
+
+    is_windows = sys.platform.startswith('win')
+    if (is_int(dtype) and not is_int(itype)) or (is_windows and is_int(itype))\
+            or (itype == 'bool' and\
+                (dtype not in ('float32', 'float64', 'int32', 'int64') or is_windows)):
+        return
+    # test gluon
+    test_sum = TestSum(axis=axis, dtype=dtype, keepdims=keepdims)
+    test_sum_conv = TestSumConv(axis=axis, dtype=dtype, keepdims=keepdims)
+    if hybridize:
+        test_sum.hybridize()
+        test_sum_conv.hybridize()
+    if is_int(itype):
+        x = onp.random.randint(-128, 128, shape, dtype=itype)
+        x = np.array(x)
+    elif itype == 'bool':
+        x = onp.random.randint(0, 2, shape) < 1
+        x = np.array(x, dtype='bool')
+    else:
+        x = np.random.uniform(-1.0, 1.0, size=shape, dtype=itype)
+    expected_ret = onp.sum(x.asnumpy(), axis=axis, dtype=acc_type, keepdims=keepdims)
+    expected_ret = expected_ret.astype(dtype)
+    if itype == 'bool':
+        if is_op_runnable() and (not is_windows):  # special handling of boolean ndarray
+            y = test_sum(x)
+            y_conv = test_sum_conv(x)
+            assert y.dtype == expected_ret.dtype
+            assert_almost_equal(y.asnumpy(), expected_ret, rtol=1e-4, atol=1e-5,
+                                use_broadcast=False)
+            assert y_conv.dtype == expected_ret.dtype
+            assert_almost_equal(y_conv.asnumpy(), expected_ret, rtol=1e-4, atol=1e-5,
+                                use_broadcast=False)
+        return
+
+    x.attach_grad()
+    with mx.autograd.record():
+        y = test_sum(x)
+        y_conv = test_sum_conv(x)
+    assert y.shape == expected_ret.shape
+    assert_almost_equal(y.asnumpy(), expected_ret, rtol=1e-3 if dtype == 'float16' else 1e-3,
+                        atol=1e-5 if dtype == 'float16' else 1e-5, use_broadcast=False)
+    assert y_conv.shape == expected_ret.shape
+    assert_almost_equal(y_conv.asnumpy(), expected_ret, rtol=1e-3 if dtype == 'float16' else 1e-3,
+                        atol=1e-5 if dtype == 'float16' else 1e-5, use_broadcast=False)
+    y.backward()
+    assert same(x.grad.asnumpy(), onp.ones(shape=x.shape, dtype=x.dtype))
+
+    # test numeric
+    if itype == 'float32' and dtype == 'float32' and shape != (4, 0, 4, 0):
+        x_sym = mx.sym.Variable("x").as_np_ndarray()
+        mx_sym = mx.sym.np.sum(x_sym, axis=axis, dtype=dtype, keepdims=keepdims).as_nd_ndarray()
+        check_numeric_gradient(mx_sym, [x.as_nd_ndarray()],
+                                numeric_eps=1e-3, rtol=1e-2, atol=1e-3, dtype=onp.float32)
+
+    # test imperative
+    mx_out = np.sum(x, axis=axis, dtype=dtype, keepdims=keepdims)
+    np_out = onp.sum(x.asnumpy(), axis=axis, dtype=acc_type, keepdims=keepdims).astype(dtype)
+    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5, use_broadcast=False)
+
+
+@use_np
+@pytest.mark.parametrize('bool_agg', ['all', 'any'])
+@pytest.mark.parametrize('shape', [
+    (), (5, ), (10, ), (2, 5), (5, 5), (10, 10),
+    (4, 4, 4), (4, 6, 9), (6, 6, 6), (6, 0, 5),
+    (7, 8, 9, 10), (7, 9, 11, 13), (0, 7, 7, 5)
+])
+@pytest.mark.parametrize('axis', [True, False])
+@pytest.mark.parametrize('hybridize', [True, False])
+@pytest.mark.parametrize('keepdim', [True, False])
+@pytest.mark.parametrize('dtype', [np.int8, np.uint8, np.int32, np.int64, np.float16, np.float32, np.float64, np.bool])
+def test_np_bool_agg(bool_agg, shape, axis, keepdim, dtype, hybridize):
+    class TestOp(HybridBlock):
+        def __init__(self, axis=None, keepdims=False) :
+            super(TestOp, self).__init__()
+            self._axis = axis
+            self._keepdims = keepdims
+
+        def forward(self, a):
+            return getattr(np, bool_agg)(a, axis=self._axis, keepdims=self._keepdims)
+
+    ndim = len(shape)
+    samples = random.randint(0, ndim)
+    axis = None if not axis else tuple(random.sample([i for i in range(0, ndim)], samples))
+    x = np.random.normal(0, 5.0, size=shape).astype(dtype)
+    test_op = TestOp(axis=axis, keepdims=keepdim)
+    if hybridize:
+        test_op.hybridize()
+    y = test_op(x)
+    expected_ret = getattr(onp, bool_agg)(x.asnumpy(), axis=axis, keepdims=keepdim)
+    assert_almost_equal(y.asnumpy(), expected_ret)
+
+    # test imperative
+    mx_outs = getattr(np, bool_agg)(x, axis=axis, keepdims=keepdim)
+    np_outs = getattr(onp, bool_agg)(x.asnumpy(), axis=axis, keepdims=keepdim)
+    assert_almost_equal(mx_outs.asnumpy(), np_outs)
+
+
+@use_np
+@pytest.mark.parametrize('func', ['max', 'min'])
+@pytest.mark.parametrize('in_data_dim', [2, 3, 4])
+@pytest.mark.parametrize('itype', ['float16', 'float32', 'float64', 'int'])
+@pytest.mark.parametrize('hybridize', [True, False])
+@pytest.mark.parametrize('keepdims', [True, False])
+def test_np_max_min(func, in_data_dim, itype, keepdims, hybridize):
+    class TestOp(HybridBlock):
+        def __init__(self, axis=None, keepdims=False):
+            super(TestOp, self).__init__()
+            self._axis = axis
+            self._keepdims = keepdims
+
+        def forward(self, a, *args, **kwargs):
+            return getattr(a, func)(axis=self._axis, keepdims=self._keepdims)
+
+    def is_int(dtype):
+        return 'int' == dtype
+
+    def get_grad(axis, func_name):
+        index = -1 if func_name == 'max' else 0
+        if axis == ():
+            return onp.ones((2,3,4,5))
+        else:
+            temp = onp.zeros((2,3,4,5))
+            if axis == 0:
+                temp[index,:,:,:] = 1
+                return temp
+            elif axis == 1:
+                temp[:,index,:,:] = 1
+                return temp
+            elif axis == 2:
+                temp[:,:,index,:] = 1
+                return temp
+            elif (axis == 3 or axis == -1):
+                temp[:,:,:,index] = 1
+                return temp
+            elif not axis:
+                temp[index,index,index,index] = 1
+                return temp
+            raise ValueError('axis should be int or None or ()')
+
+    shape = rand_shape_nd(in_data_dim, dim=3)
+    for axis in ([i for i in range(in_data_dim)] + [(), None] + [-1]):
+        test_gluon = TestOp(axis=axis, keepdims=keepdims)
+        if hybridize:
+            test_gluon.hybridize()
+        if is_int(itype):
+            x = np.arange(120).reshape((2, 3, 4, 5))
+        else:
+            x = np.random.uniform(-1.0, 1.0, size=shape, dtype=itype)
+        x.attach_grad()
+        ref_op = getattr(onp, 'a'+func)
+        expected_ret = ref_op(x.asnumpy(), axis=axis, keepdims=keepdims)
+        with mx.autograd.record():
+            y = test_gluon(x)
+        assert y.shape == expected_ret.shape
+        assert_almost_equal(y.asnumpy(), expected_ret, rtol=1e-3 if itype == 'float16' else 1e-3,
+                            atol=1e-5 if itype == 'float16' else 1e-5)
+        y.backward()
+        # only check the gradient with hardcoded input
+        if is_int(itype):
+            assert same(x.grad.asnumpy(), get_grad(axis, func)), \
+                'x={}\ny={}\nx.grad={}\nnumpy={}'.format(x.asnumpy(), y.asnumpy(), x.grad.asnumpy(), get_grad(axis))
+
+        # test imperative
+        mx_out = getattr(np, func)(x, axis=axis, keepdims=keepdims)
+        np_out = ref_op(x.asnumpy(), axis=axis, keepdims=keepdims)
+        assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+
+
+@use_np
+@pytest.mark.parametrize('func', ['max', 'min'])
+@pytest.mark.parametrize('shape,exception', [
+    ((), False),
+    ((0), True),
+    ((2, 0), True),
+    ((0, 2, 1), True)
+])
+def test_np_max_min_error(func, shape, exception):
+    # test zero and zero dim
+    def _test_np_exception(func, shape, dim):
+        x = np.random.uniform(-1.0, 1.0, shape)
+        out = getattr(x, func)()
+        assert out.ndim == dim, 'dimension mismatch, output.ndim={}, dim={}'.format(output.ndim, dim)
+    dim = 0
+    if exception:
+        assertRaises(MXNetError, _test_np_exception, func, shape, dim)
+    else:
+        _test_np_exception(func, shape, dim)
+
+
+@use_np
+@pytest.mark.parametrize('a_shape,w_shape,axes', [
+    ((3, 5), (3, 5), None),
+    ((4, 5, 6), (4, 5, 6), (0, 2)),
+    ((3,), (3,), 0),
+    ((2, 3), (3,), 1),
+    ((2, 3, 4), (2,), 0),
+    ((2, 3, 4), (3,), 1),
+    ((2, 3, 4), (4,), -1),
+    ((2, 3, 4, 5), (5,), 3)
+])
+@pytest.mark.parametrize('dtype', ['float32', 'float64'])
+@pytest.mark.parametrize('hybridize', [True, False])
+@pytest.mark.parametrize('is_weighted', [True, False])
+@pytest.mark.parametrize('returned', [True, False])
+@pytest.mark.parametrize('req_a', ['null', 'add', 'write'])
+@pytest.mark.flaky
+def test_np_average(a_shape, w_shape, axes, is_weighted, req_a,
+                    hybridize, returned, dtype):
+    class TestAverage(HybridBlock):
+        def __init__(self, axis=None, returned=False):
+            super(TestAverage, self).__init__()
+            # necessary initializations
+            self._axis = axis
+            self._returned = returned
+
+        def forward(self, a, weights):
+            return np.average(a, weights=weights, axis=self._axis, returned=self._returned)
+
+    def avg_backward(a, w, avg, axes, init_a_grad=None, init_w_grad=None):
+        # avg = sum(a * w) / sum(w)
+        if axes is not None and not isinstance(axes, tuple) and axes < 0:
+            axes += a.ndim
+        if w is None:
+            a_grad = onp.ones(shape=a.shape, dtype=a.dtype)/(a.size/avg.size)
+            if init_a_grad is not None:
+                a_grad += init_a_grad.asnumpy()
+            return [a_grad, None]
+        onedim = a.ndim != w.ndim
+        if onedim:
+            new_shape = [a.shape[i] if i == axes else 1 for i in range(a.ndim)]
+            w = w.reshape(new_shape)
+            w = onp.broadcast_to(w, a.shape)
+
+        # partial a = w / sum(w)
+        # partial w = (a*sum(w) - sum(a*w)) / (sum(w) * sum(w))
+        scl = onp.sum(w, axis=axes, keepdims=True)
+        a_grad = onp.divide(w, scl)
+        w_grad = onp.divide(a*scl-onp.sum(a*w, axis=axes, keepdims=True), scl*scl)
+
+        if onedim:
+            axis = list(range(a.ndim))
+            axis.remove(axes)
+            w_grad = onp.sum(w_grad, axis=tuple(axis))
+        if init_a_grad is not None:
+            a_grad += init_a_grad.asnumpy()
+        if init_w_grad is not None:
+            w_grad += init_w_grad.asnumpy()
+        return [a_grad, w_grad]
+
+    if req_a == 'null' and not is_weighted:
+        return
+    rtol, atol = 1e-3, 1e-4
+    test_average = TestAverage(axes, returned)
+    if hybridize:
+        test_average.hybridize()
+    a = np.random.uniform(-1.0, 1.0, size=a_shape, dtype=dtype)
+    a.attach_grad(req_a)
+    init_a_grad = np.random.uniform(-1.0, 1.0, size=a_shape, dtype=dtype) if req_a == 'add' else None
+    init_w_grad = None
+    req_w = req_a
+    w, np_w = None, None
+    if is_weighted:
+        w = np.random.uniform(-1.0, 1.0, size=w_shape, dtype=dtype)
+        if req_a == 'null':
+            req_w = random.choice(['add', 'write'])
+        w.attach_grad(req_w)
+        if req_w == 'add':
+            init_w_grad = np.random.uniform(-1.0, 1.0, size=w_shape, dtype=dtype)
+        np_w = w.asnumpy()
+    np_out = onp.average(a.asnumpy(), axis=axes, weights=np_w, returned=returned)
+    with mx.autograd.record():
+        mx_out = test_average(a, w)
+    if returned:
+        np_out, np_sum_of_weights = np_out
+        mx_out, mx_sum_of_weights = mx_out
+        assert_almost_equal(mx_sum_of_weights.asnumpy(), np_sum_of_weights, rtol=rtol, atol=atol)
+    assert mx_out.shape == np_out.shape
+    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=rtol, atol=atol)
+    if req_a == 'add':
+        a.grad[:] = init_a_grad
+    if is_weighted and req_w == 'add':
+        w.grad[:] = init_w_grad
+    mx_out.backward()
+    # Code to get reference backward value
+    a_grad, w_grad = avg_backward(a.asnumpy(), np_w, np_out, axes, init_a_grad, init_w_grad)
+    if is_weighted:
+        assert_almost_equal(w.grad.asnumpy(), w_grad, rtol=rtol*10, atol=atol*10)
+    if req_a == 'null':
+        assert a.grad is None
+    else:
+        assert_almost_equal(a.grad.asnumpy(), a_grad, rtol=rtol, atol=atol)
+
+    # Test imperative once again
+    np_out = onp.average(a.asnumpy(), weights=np_w, axis=axes, returned=returned)
+    mx_out = np.average(a, weights=w, axis=axes, returned=returned)
+    if returned:
+        np_out, np_sum_of_weights = np_out
+        mx_out, mx_sum_of_weights = mx_out
+        assert_almost_equal(mx_sum_of_weights.asnumpy(), np_sum_of_weights, rtol=rtol, atol=atol)
+    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=rtol, atol=atol)
+
+
+@use_np
+def test_np_mean():
+    class TestMean(HybridBlock):
+        def __init__(self, axis=None, dtype=None, keepdims=False):
+            super(TestMean, self).__init__()
+            self._axis = axis
+            self._dtype = dtype
+            self._keepdims = keepdims
+
+        def forward(self, a, *args, **kwargs):
+            return a.mean(axis=self._axis, dtype=self._dtype, keepdims=self._keepdims)
+
+    def is_int(dtype):
+        return 'int' in dtype
+
+    is_windows = sys.platform.startswith('win')
+    in_data_dim = random.choice([2, 3, 4])
+    shape = rand_shape_nd(in_data_dim, dim=3)
+    acc_type = {'float16': 'float32', 'float32': 'float64', 'float64': 'float64',
+                'bool': 'int64', 'int8': 'int32', 'int32': 'int64', 'int64': 'int64'}
+    ft_types = ['float16', 'float32', 'float64']
+    it_types = ['bool', 'int8', 'int32', 'int64']
+    for hybridize in [False, True]:
+        for keepdims in [True, False]:
+            for axis in ([i for i in range(in_data_dim)] + [(), None]):
+                for itype, dtype in itertools.product(ft_types, [None] + ft_types + it_types):
+                    if dtype == 'bool':
+                        continue
+                    # test gluon
+                    test_mean = TestMean(axis=axis, dtype=dtype, keepdims=keepdims)
+                    if hybridize:
+                        test_mean.hybridize()
+                    x = np.random.uniform(-1.0, 1.0, size=shape).astype(itype)
+                    x = x.as_np_ndarray()
+                    x.attach_grad()
+
+                    expected_ret = onp.mean(x.asnumpy(), axis=axis, dtype=acc_type[itype], keepdims=keepdims)
+                    expected_ret = expected_ret.astype(dtype)
+                    with mx.autograd.record():
+                        y = test_mean(x)
+                    assert y.shape == expected_ret.shape
+                    assert_almost_equal(y.asnumpy(), expected_ret, rtol=1e-3 if dtype == 'float16' else 1e-3,
+                                        atol=1e-5 if dtype == 'float16' else 1e-5)
+
+                    y.backward()
+                    N = x.size / y.size
+                    assert same(x.grad.asnumpy(), onp.ones(shape=x.shape, dtype=x.dtype) / N)
+
+                    # test numeric
+                    if itype == 'float32' and dtype == 'float32':
+                        x_sym = mx.sym.Variable("x").as_np_ndarray()
+                        mx_sym = mx.sym.np.mean(x_sym, axis=axis, dtype=dtype, keepdims=keepdims).as_nd_ndarray()
+                        check_numeric_gradient(mx_sym, [x.as_nd_ndarray()],
+                                               numeric_eps=1e-3, rtol=1e-3, atol=1e-4, dtype=onp.float32)
+
+                    # test imperative
+                    mx_out = np.mean(x, axis=axis, dtype=dtype, keepdims=keepdims)
+                    np_out = onp.mean(x.asnumpy(), axis=axis, dtype=acc_type[itype], keepdims=keepdims).astype(dtype)
+                    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+
+                for itype, dtype in itertools.product(it_types, [None] + ft_types + it_types):
+                    if dtype == 'bool':
+                        continue
+                    # test gluon
+                    test_mean = TestMean(axis=axis, dtype=dtype, keepdims=keepdims)
+                    if hybridize:
+                        test_mean.hybridize()
+
+                    if itype == 'bool':
+                        x = np.array(onp.random.uniform(size=shape) > 0.5)
+                    else:
+                        x = np.random.uniform(-128, 127, size=shape).astype(itype)
+
+                    expected_ret = onp.mean(x.asnumpy(), axis=axis, dtype=dtype, keepdims=keepdims)
+
+                    if itype == 'bool':
+                        if is_op_runnable() and (not is_windows) and dtype not in ['float16', 'int8']:  # special handling of boolean ndarray
+                            y = test_mean(x)
+                            assert y.shape == expected_ret.shape
+                            assert_almost_equal(y.asnumpy(), expected_ret, rtol=1e-3 if dtype == 'float16' else 1e-3,
+                                                atol=1e-5 if dtype == 'float16' else 1e-5)
+                        continue
+
+                    y = test_mean(x)
+                    assert y.shape == expected_ret.shape
+                    assert_almost_equal(y.asnumpy(), expected_ret, rtol=1e-3 if dtype == 'float16' else 1e-3,
+                                        atol=1e-5 if dtype == 'float16' else 1e-5)
+
+                    # test imperative
+                    mx_out = np.mean(x, axis=axis, dtype=dtype, keepdims=keepdims)
+                    np_out = onp.mean(x.asnumpy(), axis=axis, dtype=dtype, keepdims=keepdims).astype(dtype)
+                    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+
+
+@use_np
+def test_np_moment():
+    class TestMoment(HybridBlock):
+        def __init__(self, name, axis=None, dtype=None, keepdims=False, ddof=0):
+            super(TestMoment, self).__init__()
+            self._moment_name = name
+            self._axis = axis
+            self._dtype = dtype
+            self._keepdims = keepdims
+            self._ddof = ddof
+
+        def forward(self, a, *args, **kwargs):
+            return getattr(a, self._moment_name)(axis=self._axis, dtype=self._dtype,
+                                                 keepdims=self._keepdims, ddof=self._ddof)
+
+    def is_int(dtype):
+        return 'int' in dtype
+
+    def legalize_shape(shape):
+        shape_ = list(shape)
+        for i in range(len(shape_)):
+            shape_[i] += 1
+        return tuple(shape_)
+
+    in_data_dim = random.choice([2, 3, 4])
+    shape = rand_shape_nd(in_data_dim, dim=3)
+    shape = legalize_shape(shape)
+    acc_type = {'float16': 'float32', 'float32': 'float64', 'float64': 'float64',
+                'int8': 'float64', 'int32': 'float64', 'int64': 'float64'}
+
+    for name in ['var', 'std']:
+        for hybridize in [False, True]:
+            for ddof in [0, 1]:
+                for keepdims in [True, False]:
+                    for axis in ([i for i in range(in_data_dim)] + [(), None]):
+                        for itype in ['float16', 'float32', 'float64', 'int8', 'int32', 'int64']:
+                            for dtype in ['float16', 'float32', 'float64']:
+                                if is_int(dtype) and not is_int(itype) or is_int(itype) and is_int(dtype):
+                                    continue
+                                atol = 3e-4 if itype == 'float16' or dtype == 'float16' else 1e-5
+                                rtol = 1e-2 if itype == 'float16' or dtype == 'float16' else 1e-3
+                                # test gluon
+                                test_moment = TestMoment(name, axis=axis, dtype=dtype, keepdims=keepdims, ddof=ddof)
+                                if hybridize:
+                                    test_moment.hybridize()
+                                if is_int(itype):
+                                    x = onp.random.randint(-16, 16, shape, dtype=itype)
+                                    x = mx.nd.array(x)
+                                else:
+                                    x = mx.nd.random.uniform(-1.0, 1.0, shape=shape, dtype=itype)
+                                x = x.as_np_ndarray()
+                                x.attach_grad()
+                                expected_ret = getattr(onp, name)(x.asnumpy(), axis=axis, dtype=acc_type[itype], keepdims=keepdims, ddof=ddof)
+                                expected_ret = expected_ret.astype(dtype)
+                                y = test_moment(x)
+                                assert y.shape == expected_ret.shape
+                                assert_almost_equal(y.asnumpy(), expected_ret, rtol=rtol, atol=atol, use_broadcast=False, equal_nan=True)
+
+                                # test imperative
+                                mx_out = getattr(np, name)(x, axis=axis, dtype=dtype, keepdims=keepdims, ddof=ddof)
+                                np_out = getattr(onp, name)(x.asnumpy(), axis=axis, dtype=acc_type[itype], keepdims=keepdims, ddof=ddof).astype(dtype)
+                                assert_almost_equal(mx_out.asnumpy(), np_out, rtol=rtol, atol=atol, use_broadcast=False, equal_nan=True)
+
+
+@use_np
+def test_np_shape():
+    shapes = [
+        (),
+        (0, 1),
+        (2, 3),
+        (2, 3, 4),
+    ]
+
+    for shape in shapes:
+        mx_a = np.random.uniform(size=shape)
+        np_a = onp.random.uniform(size=shape)
+
+        mx_shape = np.shape(mx_a)
+        np_shape = onp.shape(np_a)
+
+        assert mx_shape == np_shape
+
+
+@use_np
+@pytest.mark.parametrize('config', [
+    (0.0, 1.0, 10),
+    (-2, 4, 30),
+    (5.234324, 8.98324, 324),
+    (2, 10, 100)
+])
+@pytest.mark.parametrize('dtype', ['int32', 'float16', 'float32', 'float64', None])
+@pytest.mark.parametrize('endpoint', [True, False])
+@pytest.mark.parametrize('retstep', [True, False])
+def test_np_linspace(config, dtype, endpoint, retstep):
+    if isinstance(config, tuple):
+        mx_ret = np.linspace(*config, endpoint=endpoint, retstep=retstep, dtype=dtype)
+        np_ret = onp.linspace(*config, endpoint=endpoint, retstep=retstep, dtype=dtype)
+    else:
+        mx_ret = np.linspace(config, endpoint=endpoint, retstep=retstep, dtype=dtype)
+        np_ret = onp.linspace(config, endpoint=endpoint, retstep=retstep, dtype=dtype)
+    if retstep:
+        assert_almost_equal(mx_ret[0].asnumpy(), np_ret[0], atol=1e-3, rtol=1e-5)
+        assert same(mx_ret[1], np_ret[1])
+    else:
+        assert_almost_equal(mx_ret.asnumpy(), np_ret, atol=1e-3, rtol=1e-5)
+
+
+@use_np
+@pytest.mark.parametrize('config', [
+    (0, 10, -1),
+    (0, 1, 2.5)
+])
+def test_np_linspace_error(config):
+    with pytest.raises(MXNetError):
+        np.linspace(*config)
+
+
+@use_np
+def test_np_linspace_arange():
+    # check linspace equivalent to arange
+    for test_index in range(1000):
+        assert_almost_equal(mx.np.linspace(0, test_index, test_index + 1).asnumpy(), onp.arange(test_index + 1))
+
+
+@use_np
+@pytest.mark.parametrize('config', [
+    (0.0, 1.0, 20),
+    (2, 8, 0),
+    (22, 11, 1),
+    (2.22, 9.99, 11),
+    (4.99999, 12.11111111, 111)
+])
+@pytest.mark.parametrize('dtype', ['float32', 'float64', None])
+@pytest.mark.parametrize('hybridize', [True, False])
+@pytest.mark.parametrize('endpoint', [True, False])
+@pytest.mark.parametrize('base', [0, 1, 5, 8, 10, 33])
+def test_np_logspace(config, dtype, endpoint, hybridize, base):
+    class TestLogspace(HybridBlock):
+        def __init__(self, start, stop, num=50, endpoint=None, base=50.0, dtype=None, axis=0):
+            super(TestLogspace, self).__init__()
+            self._start = start
+            self._stop = stop
+            self._num = num
+            self._endpoint = endpoint
+            self._base = base
+            self._dtype = dtype
+            self.axis = axis
+
+        def forward(self, x):
+            return x + np.logspace(self._start, self._stop, self._num, self._endpoint, self._base, self._dtype, self.axis)
+
+    x = np.zeros(shape=(), dtype=dtype)
+    net = TestLogspace(*config, endpoint=endpoint, base=base, dtype=dtype)
+    np_out = onp.logspace(*config, endpoint=endpoint, base=base, dtype=dtype)
+    if hybridize:
+        net.hybridize()
+    mx_out = net(x)
+    assert_almost_equal(mx_out.asnumpy(), np_out, atol=1e-3, rtol=1e-5)
+    if dtype is not None:
+        assert mx_out.dtype == np_out.dtype
+
+    # Test imperative once again
+    mx_ret = np.logspace(*config, endpoint=endpoint, base=base, dtype=dtype)
+    np_ret = onp.logspace(*config, endpoint=endpoint, base=base, dtype=dtype)
+    assert_almost_equal(mx_ret.asnumpy(), np_ret, atol=1e-3, rtol=1e-5)
+    if dtype is not None:
+        assert mx_out.dtype == np_out.dtype
+
+
+@use_np
+def test_np_reshape():
+    class TestReshape(HybridBlock):
+        def __init__(self, newshape):
+            super(TestReshape, self).__init__()
+            self._newshape = newshape
+
+        def forward(self, a):
+            return np.reshape(a, self._newshape)
+
+    shape_pairs = [((2, 6), (6, 2)), ((2, 6), (3, 4)), ((1, 0), (0,)), ((0, 0), (0,)), ((), (1, 1, 1))]
+    for hybridize in [True, False]:
+        for shape_pair in shape_pairs:
+            shape1, shape2 = shape_pair
+            test_reshape = TestReshape(shape2)
+            if hybridize:
+                test_reshape.hybridize()
+            x = rand_ndarray(shape1).as_np_ndarray()
+            x.attach_grad()
+            np_out = onp.reshape(x.asnumpy(), shape2)
+            with mx.autograd.record():
+                mx_out = test_reshape(x)
+            assert mx_out.shape == np_out.shape
+            assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5, use_broadcast=False)
+            mx_out.backward()
+            np_backward = onp.ones(shape1)
+            assert_almost_equal(x.grad.asnumpy(), np_backward, rtol=1e-3, atol=1e-5, use_broadcast=False)
+
+            mx_out = np.reshape(x, shape2)
+            np_out = onp.reshape(x.asnumpy(), shape2)
+            assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5, use_broadcast=False)
+
+
+@use_np
+@pytest.mark.parametrize('descending', [True, False])
+@pytest.mark.parametrize('shape', [
+    (),
+    (2, 3),
+    (1, 0, 2),
+])
+@pytest.mark.parametrize('hybrid', [False, True])
+def test_np_argsort(descending, shape, hybrid):
+    class TestArgsort(HybridBlock):
+        def __init__(self, axis, descending):
+            super(TestArgsort, self).__init__()
+            self._axis = axis
+            self._descending = descending
+
+        def forward(self, x):
+            return np.argsort(x, axis=self._axis, descending=self._descending)
+
+    data = np.random.uniform(size=shape)
+    np_data = data.asnumpy()
+    for axis in [None] + [i for i in range(-len(shape), len(shape))]:
+        if descending:
+            np_out = onp.argsort(-1 * np_data, axis)
+        else:
+            np_out = onp.argsort(np_data, axis)
+
+        test_argsort = TestArgsort(axis, descending)
+
+        if hybrid:
+            test_argsort.hybridize()
+        mx_out = test_argsort(data)
+        assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-5, atol=1e-6, use_broadcast=False)
+
+        mx_out = np.argsort(data, axis, descending)
+        assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-5, atol=1e-6, use_broadcast=False)
+
+
+@use_np
+@pytest.mark.parametrize('descending', [True, False])
+@pytest.mark.parametrize('shape', [
+    (),
+    (1,),
+    (5,),
+    (4, 3),
+    (3, 5),
+    (4, 4),
+    (4, 5),
+    (5, 5),
+    (5, 6),
+    (6, 6),
+    (0, 1),
+    (6, 5, 6),
+    (2, 3, 3, 4),
+    (4, 2, 1, 2),
+    (0, 5, 3, 3),
+    (5, 0, 3, 3),
+    (3, 3, 0, 0),
+])
+@pytest.mark.parametrize('dtype', [np.int8, np.uint8, np.int32, np.int64, np.float32, np.float64])
+@pytest.mark.parametrize('hybridize', [True, False])
+def test_np_sort(shape, dtype, hybridize, descending):
+    class TestSort(HybridBlock):
+        def __init__(self, axis, descending):
+            super(TestSort, self).__init__()
+            self._axis = axis
+            self._descending = descending
+
+        def forward(self, x):
+            return np.sort(x, self._axis, descending=self._descending)
+
+    a = np.random.uniform(low=0, high=100, size=shape, dtype='float64').astype(dtype)
+    axis_list = list(range(len(shape)))
+    axis_list.append(None)
+    axis_list.append(-1)
+    for axis in axis_list:
+        test = TestSort(axis, descending)
+        if hybridize:
+            test.hybridize()
+        if axis == -1 and len(shape)==0:
+            continue
+        ret = test(a)
+        if descending:
+            expected_ret = -onp.sort(-1 * a.asnumpy(), axis)
+        else:
+            expected_ret = onp.sort(a.asnumpy(), axis)
+        assert_almost_equal(ret.asnumpy(), expected_ret, atol=1e-5, rtol=1e-5, use_broadcast=False)
+
+        # check imperative again
+        ret = np.sort(a, axis=axis, descending=descending)
+        assert_almost_equal(ret.asnumpy(), expected_ret, atol=1e-5, rtol=1e-5, use_broadcast=False)
+
+
+@use_np
+def test_np_squeeze():
+    config = [((), None),
+              ((), -1),
+              ((), 0),
+              ((4, 1, 2), None),
+              ((1, 1, 1), None),
+              ((1, 0, 1, 5), 2),
+              ((1, 0, 1, 1), (-1, -4))]
+
+    class TestSqueeze(HybridBlock):
+        def __init__(self, axis):
+            super(TestSqueeze, self).__init__()
+            self._axis = axis
+
+        def forward(self, x):
+            return np.squeeze(x, self._axis)
+
+    for shape, axis in config:
+        data_np = onp.random.uniform(size=shape)
+        data_mx = np.array(data_np, dtype=data_np.dtype)
+        ret_np = onp.squeeze(data_np, axis)
+        ret_mx = np.squeeze(data_mx, axis)
+        assert_almost_equal(ret_mx.asnumpy(), ret_np, rtol=1e-5, atol=1e-6, use_broadcast=False)
+
+        net = TestSqueeze(axis)
+        for hybrid in [False, True]:
+            if hybrid:
+                net.hybridize()
+            data_mx.attach_grad()
+            with mx.autograd.record():
+                ret_mx = net(data_mx)
+            assert_almost_equal(ret_mx.asnumpy(), ret_np, rtol=1e-5, atol=1e-6, use_broadcast=False)
+            ret_mx.backward()
+            assert_almost_equal(data_mx.grad.asnumpy(), onp.ones_like(data_np),
+                                rtol=1e-5, atol=1e-6, use_broadcast=False)
+
+
+@xfail_when_nonstandard_decimal_separator
+@use_np
+def test_np_tri():
+    class TestTri(HybridBlock):
+        def __init__(self, N, M=None, k=0, dtype=None):
+            super(TestTri, self).__init__()
+            self._N = N
+            self._M = M
+            self._k = k
+            self._dtype = dtype
+
+        def forward(self, x):
+            return x + np.tri(self._N, self._M, self._k, self._dtype)
+
+    dtypes = ['float16', 'float32', 'float64', 'int32', 'int64', 'int8', 'uint8', None]
+    hybrids = [False, True]
+
+    for dtype, hybrid in itertools.product(dtypes, hybrids):
+        N = random.randint(2,6)
+        M = random.randint(2,6)
+        k = random.randint(-M*2, N*2)
+
+        test_tri = TestTri(N, M, k, dtype)
+        if hybrid:
+            test_tri.hybridize()
+        np_out = np.tri(N, M, k, dtype)
+        x = np.zeros(shape=(), dtype=dtype)
+        mx_out = test_tri(x)
+        assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-5, atol=1e-6, use_broadcast=False)
+
+        mx_out = np.tri(N, M, k, dtype)
+        assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-5, atol=1e-6, use_broadcast=False)
+
+
+@use_np
+def test_np_prod():
+    class TestProd(HybridBlock):
+        def __init__(self, axis=None, dtype=None, keepdims=False):
+            super(TestProd, self).__init__()
+            self._axis = axis
+            self._dtype = dtype
+            self._keepdims = keepdims
+
+        def forward(self, a, *args, **kwargs):
+            return np.prod(a, axis=self._axis, dtype=self._dtype, keepdims=self._keepdims)
+
+    in_data_dim = random.choice([3, 4])
+    shape = rand_shape_nd(in_data_dim, dim=3)
+    for hybridize in [False, True]:
+        for keepdims in [True, False]:
+            for axis in ([i for i in range(in_data_dim)] + [(), None]):
+                for itype in ['float32', 'float64']:
+                    for dtype in ['float32', 'float64']:
+                        # test gluon
+                        test_prod = TestProd(axis=axis, dtype=dtype, keepdims=keepdims)
+                        if hybridize:
+                            test_prod.hybridize()
+                        x = np.array(onp.random.uniform(-2.0, 2.0, size=shape), dtype=itype)
+                        x.attach_grad()
+                        expected_ret = onp.prod(x.asnumpy(), axis=axis, keepdims=keepdims)
+                        expected_ret = expected_ret.astype(dtype)
+                        with mx.autograd.record():
+                            y = test_prod(x)
+                        assert y.shape == expected_ret.shape
+                        assert_almost_equal(y.asnumpy(), expected_ret, rtol=1e-3, atol=1e-5, use_broadcast=False)
+                        y.backward()
+                        # use keepdims=True so that broadcast divide can be used to calculate
+                        # grad of input
+                        expected_ret = onp.prod(x.asnumpy(), axis=axis, keepdims=True)
+                        assert_almost_equal(x.grad.asnumpy(), expected_ret / x.asnumpy(), rtol=1e-3, atol=1e-3,
+                                            use_broadcast=False)
+
+                        # test numeric
+                        if itype == 'float32' and dtype == 'float32':
+                            x_sym = mx.sym.Variable("x").as_np_ndarray()
+                            mx_sym = mx.sym.np.prod(x_sym, axis=axis, dtype=dtype, keepdims=keepdims).as_nd_ndarray()
+                            check_numeric_gradient(mx_sym, [x.as_nd_ndarray()],
+                                                   numeric_eps=1e-3, rtol=1e-3, atol=1e-4, dtype=onp.float32)
+
+                        # test imperative
+                        mx_out = np.prod(x, axis=axis, dtype=dtype, keepdims=keepdims)
+                        np_out = onp.prod(x.asnumpy(), axis=axis, keepdims=keepdims).astype(dtype)
+                        assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5, use_broadcast=False)
+
+
+@use_np
+def test_np_flatten():
+    class TestFlatten(HybridBlock):
+        def forward(self, x):
+            return x.flatten()
+
+    shapes = [(), (2, 0, 1), (3, 4, 5), 6, (0,), (0, 0, 0)]
+    for shape in shapes:
+        for hybridize in [True, False]:
+            test_flatten = TestFlatten()
+            if hybridize:
+                test_flatten.hybridize()
+            a_np = onp.random.uniform(size=shape).astype('float32')
+            a_mx = np.array(a_np, dtype=a_np.dtype)
+            a_mx.attach_grad()
+            with mx.autograd.record():
+                ret = test_flatten(a_mx)
+            expected_ret = a_np.flatten()
+            assert_almost_equal(expected_ret, ret.asnumpy(), rtol=1e-5, atol=1e-6, use_broadcast=False)
+            # check gradient
+            ret.backward()
+            assert_almost_equal(a_mx.grad.asnumpy(), onp.ones_like(a_np), rtol=1e-5, atol=1e-6, use_broadcast=False)
+
+
+@use_np
+@pytest.mark.parametrize('src_shape,dst_shape', [
+    ((), (1, 2, 4, 5)),
+    ((1,), (4, 5, 6)),
+    ((1, 0), (2, 4, 0)),
+    ((1, 1), (2, 4, 0)),
+    ((4, 1), (1, 2, 3, 4, 5)),
+    ((4, 1), (1, 0, 3, 4, 5))
+])
+@pytest.mark.parametrize('hybridize', [True, False])
+def test_np_broadcast_to(src_shape, dst_shape, hybridize):
+    class TestBroadcastTo(HybridBlock):
+        def __init__(self, dst_shape):
+            super(TestBroadcastTo, self).__init__()
+            self._dst_shape = dst_shape
+
+        def forward(self, x):
+            return np.broadcast_to(x, self._dst_shape)
+
+    class TestScalarBroadcastTo(HybridBlock):
+        def __init__(self, scalar, dst_shape):
+            super(TestScalarBroadcastTo, self).__init__()
+            self._scalar = scalar
+            self._dst_shape = dst_shape
+
+        def forward(self, x):
+            return np.broadcast_to(self._scalar, self._dst_shape)
+
+    test_broadcast_to = TestBroadcastTo(dst_shape)
+    if hybridize:
+        test_broadcast_to.hybridize()
+
+    a = onp.random.uniform(size=src_shape).astype(np.float32)
+    expected_ret = onp.broadcast_to(a, dst_shape)
+    a_mx = np.array(a, dtype=a.dtype)
+    a_mx.attach_grad()
+    with mx.autograd.record():
+        ret = test_broadcast_to(a_mx)
+    assert_almost_equal(ret.asnumpy(), expected_ret, rtol=1e-5, atol=1e-6, use_broadcast=False)
+    ret.backward()
+    expected_grad = collapse_sum_like(onp.ones_like(expected_ret), src_shape)
+    assert_almost_equal(a_mx.grad.asnumpy(), expected_grad, rtol=1e-5, atol=1e-6, use_broadcast=False)
+
+    # Test scalar case
+    scalar = 1.0
+    test_scalar_broadcast_to = TestScalarBroadcastTo(scalar, dst_shape)
+    expected_ret = onp.broadcast_to(scalar, dst_shape)
+    with mx.autograd.record():
+        # `np.empty(())` serves as a dummpy input
+        ret = test_scalar_broadcast_to(np.empty(()))
+    assert_almost_equal(ret.asnumpy(), expected_ret, rtol=1e-5, atol=1e-6, use_broadcast=False)
+
+
+@use_np
+@pytest.mark.parametrize('hybridize', [True, False])
+@pytest.mark.parametrize('dtype', [onp.float32, onp.float16, onp.int32])
+@pytest.mark.parametrize('data_shape,axes_workload', [
+    [(), [(), None]],
+    [(2,), [(0,), None]],
+    [(0, 2), [(0, 1), (1, 0)]],
+    [(5, 10), [(0, 1), (1, 0), None]],
+    [(8, 2, 3), [(2, 0, 1), (0, 2, 1), (0, 1, 2), (2, 1, 0), (-1, 1, 0), None]],
+    [(8, 2, 16), [(0, 2, 1), (2, 0, 1), (0, 1, 2), (2, 1, 0), (-1, -2, -3)]],
+    [(8, 3, 4, 8), [(0, 2, 3, 1), (1, 2, 3, 0), (0, 3, 2, 1)]],
+    [(8, 3, 2, 3, 8), [(0, 1, 3, 2, 4), (0, 1, 2, 3, 4), (4, 0, 1, 2, 3)]],
+    [(3, 4, 3, 4, 3, 2), [(0, 1, 3, 2, 4, 5), (2, 3, 4, 1, 0, 5), None]],
+    [(3, 4, 3, 4, 3, 2, 2), [(0, 1, 3, 2, 4, 5, 6),
+     (2, 3, 4, 1, 0, 5, 6), None]],
+    [(3, 4, 3, 4, 3, 2, 3, 2), [(0, 1, 3, 2, 4, 5, 7, 6),
+     (2, 3, 4, 1, 0, 5, 7, 6), None]],
+])
+@pytest.mark.parametrize('grad_req', ['write', 'add'])
+def test_np_transpose(data_shape, axes_workload, hybridize, dtype, grad_req):
+    def np_transpose_grad(out_shape, dtype, axes=None):
+        ograd = onp.ones(out_shape, dtype=dtype)
+        if axes is None or axes == ():
+            return onp.transpose(ograd, axes)
+        np_axes = onp.array(list(axes))
+        transpose_axes = onp.zeros_like(np_axes)
+        transpose_axes[np_axes] = onp.arange(len(np_axes))
+        return onp.transpose(ograd, tuple(list(transpose_axes)))
+
+    class TestTranspose(HybridBlock):
+        def __init__(self, axes=None):
+            super(TestTranspose, self).__init__()
+            self.axes = axes
+
+        def forward(self, a):
+            return np.transpose(a, self.axes)
+
+    for axes in axes_workload:
+        test_trans = TestTranspose(axes)
+        if hybridize:
+            test_trans.hybridize()
+        x = np.random.normal(0, 1, data_shape).astype(dtype)
+        x = x.astype(dtype)
+        x.attach_grad(grad_req=grad_req)
+        if grad_req == 'add':
+            x.grad[()] = np.random.normal(0, 1, x.grad.shape).astype(x.grad.dtype)
+            x_grad_np = x.grad.asnumpy()
+        np_out = onp.transpose(x.asnumpy(), axes)
+        with mx.autograd.record():
+            mx_out = test_trans(x)
+        assert mx_out.shape == np_out.shape
+        assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5, use_broadcast=False)
+        mx_out.backward()
+        np_backward = np_transpose_grad(np_out.shape, dtype, axes)
+        if grad_req == 'add':
+            assert_almost_equal(x.grad.asnumpy(), np_backward + x_grad_np,
+                                rtol=1e-3, atol=1e-5, use_broadcast=False)
+        else:
+            assert_almost_equal(x.grad.asnumpy(), np_backward, rtol=1e-3, atol=1e-5, use_broadcast=False)
+
+        mx_out = x.transpose(axes)
+        np_out = x.asnumpy().transpose(axes)
+        assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5, use_broadcast=False)
+
+        if isinstance(axes, (list, tuple)):
+            mx_out = x.transpose(*axes)
+            np_out = x.asnumpy().transpose(*axes)
+            assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5, use_broadcast=False)
+
+
+@use_np
+def test_np_transpose_error():
+    # Test for error raising
+    dat = np.random.normal(0, 1, (3, 4, 5), dtype=np.float32)
+    pytest.raises(ValueError, lambda: dat.transpose((0, 0, 1)))
+    pytest.raises(MXNetError, lambda: dat.transpose((0, 1, 3)))
+
+
+@use_np
+@pytest.mark.parametrize('hybridize', [True, False])
+@pytest.mark.parametrize('dtype', [onp.float32, onp.float16, onp.int32])
+@pytest.mark.parametrize('data_shape,axes_workload', [
+    [(), [(), None]],
+    [(2,), [(0,), None]],
+    [(0, 2), [(0, 1), (1, 0)]],
+    [(5, 10), [(0, 1), (1, 0), None]],
+    [(8, 2, 3), [(2, 0, 1), (0, 2, 1), (0, 1, 2), (2, 1, 0), (-1, 1, 0), None]],
+    [(8, 2, 16), [(0, 2, 1), (2, 0, 1), (0, 1, 2), (2, 1, 0), (-1, -2, -3)]],
+    [(8, 3, 4, 8), [(0, 2, 3, 1), (1, 2, 3, 0), (0, 3, 2, 1)]],
+    [(8, 3, 2, 3, 8), [(0, 1, 3, 2, 4), (0, 1, 2, 3, 4), (4, 0, 1, 2, 3)]],
+    [(3, 4, 3, 4, 3, 2), [(0, 1, 3, 2, 4, 5), (2, 3, 4, 1, 0, 5), None]],
+    [(3, 4, 3, 4, 3, 2, 2), [(0, 1, 3, 2, 4, 5, 6),
+     (2, 3, 4, 1, 0, 5, 6), None]],
+    [(3, 4, 3, 4, 3, 2, 3, 2), [(0, 1, 3, 2, 4, 5, 7, 6),
+     (2, 3, 4, 1, 0, 5, 7, 6), None]],
+])
+@pytest.mark.parametrize('grad_req', ['write', 'add'])
+def test_np_permute_dims(data_shape, axes_workload, hybridize, dtype, grad_req):
+    def np_permute_dims_grad(out_shape, dtype, axes=None):
+        ograd = onp.ones(out_shape, dtype=dtype)
+        if axes is None or axes == ():
+            return onp.transpose(ograd, axes)
+        np_axes = onp.array(list(axes))
+        permute_dims_axes = onp.zeros_like(np_axes)
+        permute_dims_axes[np_axes] = onp.arange(len(np_axes))
+        return onp.transpose(ograd, tuple(list(permute_dims_axes)))
+
+    class TestPermuteDims(HybridBlock):
+        def __init__(self, axes=None):
+            super(TestPermuteDims, self).__init__()
+            self.axes = axes
+
+        def forward(self, a):
+            return np.permute_dims(a, self.axes)
+
+    for axes in axes_workload:
+        test_trans = TestPermuteDims(axes)
+        if hybridize:
+            test_trans.hybridize()
+        x = np.random.normal(0, 1, data_shape).astype(dtype)
+        x = x.astype(dtype)
+        x.attach_grad(grad_req=grad_req)
+        if grad_req == 'add':
+            x.grad[()] = np.random.normal(0, 1, x.grad.shape).astype(x.grad.dtype)
+            x_grad_np = x.grad.asnumpy()
+        np_out = onp.transpose(x.asnumpy(), axes)
+        with mx.autograd.record():
+            mx_out = test_trans(x)
+        assert mx_out.shape == np_out.shape
+        assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5, use_broadcast=False)
+        mx_out.backward()
+        np_backward = np_permute_dims_grad(np_out.shape, dtype, axes)
+        if grad_req == 'add':
+            assert_almost_equal(x.grad.asnumpy(), np_backward + x_grad_np,
+                                rtol=1e-3, atol=1e-5, use_broadcast=False)
+        else:
+            assert_almost_equal(x.grad.asnumpy(), np_backward, rtol=1e-3, atol=1e-5, use_broadcast=False)
+
+
+@use_np
+def test_np_meshgrid():
+    nx, ny = (4, 5)
+    x = np.array(onp.linspace(0, 1, nx), dtype=np.float32)
+    y = np.array(onp.linspace(0, 1, ny), dtype=np.float32)
+    z = np.ones(())
+    xv, yv, zv = np.meshgrid(x, y, z)
+    xv_expected, yv_expected, zv_expected = onp.meshgrid(x.asnumpy(), y.asnumpy(), z.asnumpy())
+    assert same(xv.asnumpy(), xv_expected)
+    assert same(yv.asnumpy(), yv_expected)
+    assert same(zv.asnumpy(), zv_expected)
+
+
+@use_np
+@pytest.mark.parametrize('shapes', [
+    [(), (2, 1), (1, 3), (4, 1, 1), (5, 4, 2, 3)],
+    [(0,), (), (2, 1), (1, 0), (3, 2, 1)]
+])
+def test_np_broadcast_arrays(shapes):
+    arrays_np = [onp.random.randint(low=0, high=1000, size=shape, dtype=onp.int32) for shape in shapes]
+    arrays_mx = [np.array(arr, dtype=arr.dtype) for arr in arrays_np]
+    expected_rets = onp.broadcast_arrays(*arrays_np)
+    rets = np.broadcast_arrays(*arrays_mx)
+    for expected_ret, ret in zip(expected_rets, rets):
+        assert same(expected_ret, ret.asnumpy())
+
+
+@use_np
+def test_np_tile():
+    config = [
+        ((), ()),
+        ((), 0),
+        ((), (2, 0)),
+        ((), (2, 3)),
+        ((4, 2), (2,)),
+        ((4, 2), (2, 3)),
+        ((4, 2), (2, 1, 4)),
+        ((4, 2), (2, 3, 4)),
+        ((4, 2), (2, 0)),
+        ((4, 2), (2, 0, 3)),
+        ((4, 2), (2, 0, 3)),
+        ((4, 0), (2, 0, 3)),
+    ]
+
+    class TestTile(HybridBlock):
+        def __init__(self, reps):
+            super(TestTile, self).__init__()
+            self._reps = reps
+
+        def forward(self, x):
+            return np.tile(x, reps=self._reps)
+
+    for shape, reps in config:
+        data_np = onp.random.randint(low=0, high=1000, size=shape)
+        data_mx = np.array(data_np, dtype=data_np.dtype)
+        ret_np = onp.tile(data_np, reps=reps)
+        ret_mx = np.tile(data_mx, reps=reps)
+        assert same(ret_mx.asnumpy(), ret_np)
+
+        net = TestTile(reps)
+        for hybrid in [False, True]:
+            if hybrid:
+                net.hybridize()
+            ret_mx = net(data_mx)
+            assert same(ret_mx.asnumpy(), ret_np)
+
+
+@use_np
+def test_np_tril():
+    # numpy tril does not support scalar array (zero-dim)
+    config = [
+        ((4, 2), 3),
+        ((4, 2), 9),
+        ((4, 2), 0),
+        ((4, 2), -1),
+        ((4, 5, 6), 0),
+        ((4, 5, 6), 5),
+        ((4, 5, 6), 2),
+        ((4, 5, 6), -2),
+        ((4, 5, 6), -5),
+        ((4, 0), 0),
+        ((4, 0), 2),
+        ((4, 0), 4),
+        ((4, 0), -3),
+        ((4, 0, 5), 0),
+        ((4, 0, 5), 1),
+        ((4, 0, 5), 5),
+        ((4, 0, 5), -3),
+        ((3, ), 0),
+        ((3, ), 2),
+        ((3, ), 5)
+    ]
+
+    class TestTril(HybridBlock):
+        def __init__(self, k):
+            super(TestTril, self).__init__()
+            self._k = k
+
+        def forward(self, x):
+            return np.tril(x, k=self._k)
+
+    for prefix in [1, -1]:
+        for shape, k in config:
+            data_np = onp.random.uniform(size=shape).astype(onp.float32)
+            data_mx = np.array(data_np, dtype=data_np.dtype)
+            data_mx.attach_grad()
+            ret_np = onp.tril(data_np, k*prefix)
+            with mx.autograd.record():
+                ret_mx = np.tril(data_mx, k*prefix)
+            assert same(ret_mx.asnumpy(), ret_np)
+            ret_mx.backward()
+            if len(shape) == 2:
+                grad_np = onp.tri(*shape, k=k*prefix)
+                assert same(data_mx.grad.asnumpy(), grad_np)
+            if len(shape) == 1:
+                grad_np = onp.tri(*shape, k=k*prefix)
+                grad_np = grad_np.sum(axis=0, keepdims=False)
+                assert same(data_mx.grad.asnumpy(), grad_np)
+
+            net = TestTril(k*prefix)
+            for hybrid in [False, True]:
+                if hybrid:
+                    net.hybridize()
+                ret_mx = net(data_mx)
+                assert same(ret_mx.asnumpy(), ret_np)
+
+
+@use_np
+def test_np_triu():
+    # numpy triu does not support scalar array (zero-dim)
+    config = [
+        ((4, 2), 3),
+        ((4, 2), 9),
+        ((4, 2), 0),
+        ((4, 2), -1),
+        ((4, 5, 6), 0),
+        ((4, 5, 6), 5),
+        ((4, 5, 6), 2),
+        ((4, 5, 6), -2),
+        ((4, 5, 6), -5),
+        ((4, 0), 0),
+        ((4, 0), 2),
+        ((4, 0), 4),
+        ((4, 0), -3),
+        ((4, 0, 5), 0),
+        ((4, 0, 5), 1),
+        ((4, 0, 5), 5),
+        ((4, 0, 5), -3),
+        ((3, ), 0),
+        ((3, ), 2),
+        ((3, ), 5)
+    ]
+
+    class TestTriu(HybridBlock):
+        def __init__(self, k):
+            super(TestTriu, self).__init__()
+            self._k = k
+
+        def forward(self, x):
+            return np.triu(x, k=self._k)
+
+    for prefix in [1, -1]:
+        for shape, k in config:
+            data_np = onp.random.uniform(size=shape).astype(onp.float32)
+            data_mx = np.array(data_np, dtype=data_np.dtype)
+            data_mx.attach_grad()
+            ret_np = onp.triu(data_np, k*prefix)
+            with mx.autograd.record():
+                ret_mx = np.triu(data_mx, k*prefix)
+            assert same(ret_mx.asnumpy(), ret_np)
+            ret_mx.backward()
+            if len(shape) == 2:
+                grad_np = onp.triu(onp.ones_like(data_np), k*prefix)
+                assert same(data_mx.grad.asnumpy(), grad_np)
+            if len(shape) == 1:
+                grad_np = onp.triu(onp.ones(shape), k*prefix)
+                grad_np = grad_np.sum(axis=0, keepdims=False)
+                assert same(data_mx.grad.asnumpy(), grad_np)
+
+            net = TestTriu(k*prefix)
+            for hybrid in [False, True]:
+                if hybrid:
+                    net.hybridize()
+                ret_mx = net(data_mx)
+                assert same(ret_mx.asnumpy(), ret_np)
+
+
+@use_np
+def test_np_unary_funcs():
+    def check_unary_func(func, ref_grad, shape, low, high):
+        class TestUnary(HybridBlock):
+            def __init__(self, func):
+                super(TestUnary, self).__init__()
+                self._func = func
+
+            def forward(self, a, *args, **kwargs):
+                return getattr(np, self._func)(a)
+
+        np_func = getattr(onp, func)
+        np_test_data = onp.random.uniform(low, high, shape).astype(onp.float32)
+        mx_test_data = mx.numpy.array(np_test_data)
+        for hybridize in [True, False]:
+            mx_func = TestUnary(func)
+            if hybridize:
+                mx_func.hybridize()
+            if ref_grad:
+                mx_test_data.attach_grad()
+            np_out = np_func(np_test_data)
+            with mx.autograd.record():
+                y = mx_func(mx_test_data)
+            assert y.shape == np_out.shape
+            assert_almost_equal(y.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+            if np_out.dtype == np.bool_:
+                assert y.dtype == np.bool_
+
+            if ref_grad:
+                y.backward()
+                assert_almost_equal(mx_test_data.grad.asnumpy(), ref_grad(np_test_data), rtol=1e-1, atol=1e-2, equal_nan=True)
+
+        np_out = getattr(onp, func)(np_test_data)
+        mx_out = getattr(mx.np, func)(mx_test_data)
+        assert mx_out.shape == np_out.shape
+        assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+
+
+        assertRaises(NotImplementedError, getattr(np, func), mx_test_data, where=False)
+        assertRaises(NotImplementedError, getattr(np, func), mx_test_data,  subok=False)
+        assertRaises(NotImplementedError, getattr(np, func), mx_test_data,  dtype=onp.int8)
+        assertRaises(TypeError, getattr(np, func), mx_test_data,  dtype="abcdefg")
+        assertRaises(NotImplementedError, getattr(np, func), mx_test_data,  casting='safe')
+        assertRaises(TypeError, getattr(np, func), mx_test_data,  casting='mxnet')
+        assertRaises(NotImplementedError, getattr(np, func), mx_test_data,  order='C')
+        assertRaises(NotImplementedError, getattr(np, func), mx_test_data,  order='mxnet')
+
+    funcs = {
+        'absolute' : (lambda x: -1. * (x < 0) + (x > 0), -1.0, 1.0),
+        'logical_not' : (None, -1.0, 1.0),
+        'negative' : (lambda x: -1. * onp.ones(x.shape), -1.0, 1.0),
+        'positive' : (lambda x: onp.ones(x.shape), -1.0, 1.0),
+        'reciprocal' : (lambda x: -1. / (x ** 2), 0.01, 1.0),
+        'sign' : (None, -1.0, 1.0),
+        'square' : (lambda x: 2.0 * x, -1.0, 1.0),
+    }
+    if has_tvm_ops():
+        funcs['rad2deg'] = (lambda x: 180. / onp.pi * onp.ones(x.shape), -1.0, 1.0)
+        funcs['deg2rad'] = (lambda x: onp.pi / 180. * onp.ones(x.shape), -1.0, 1.0)
+    ndim = random.choice([2, 3, 4])
+    for shape in [rand_shape_nd(ndim, dim=3), (1, 0, 2)]:
+        for func, func_data in funcs.items():
+            ref_grad, low, high = func_data
+            check_unary_func(func, ref_grad, shape, low, high)
+
+
+@use_np
+def test_negation():
+    class TestNegation(HybridBlock):
+        def forward(self, a):
+            return -a
+    mx_func = TestNegation()
+    for dtype in [onp.int8, onp.int32, onp.float16, onp.float32, onp.float64]:
+        np_test_data = onp.random.uniform(-1, 1, (5, 5)).astype(dtype)
+        for hybridize in [True, False]:
+            mx_test_data = mx.numpy.array(np_test_data, dtype=dtype)
+            if hybridize:
+                mx_func.hybridize()
+            y = mx_func(mx_test_data)
+            assert y.shape == (5, 5)
+            assert y.dtype == dtype
+            assert_almost_equal(y.asnumpy(), -np_test_data)
+
+
+@use_np
+def test_np_binary_scalar_funcs():
+    itypes = [np.int8, np.int32, np.int64]
+    def check_binary_scalar_func(func, low, high, lshape, lgrad, ltype, scalar_is_int, hybridize):
+        class TestBinaryScalar(HybridBlock):
+            def __init__(self, func, scalar):
+                super(TestBinaryScalar, self).__init__()
+                self._func = func
+                self._scalar = scalar
+
+            def forward(self, a, *args, **kwargs):
+                return getattr(np, self._func)(a, self._scalar)
+
+        np_test_x1 = onp.random.uniform(low, high, lshape).astype(ltype)
+        np_test_x2 = int(onp.random.uniform(low, high)) if scalar_is_int else onp.random.uniform(low, high)
+        mx_test_x1 = np.array(np_test_x1, dtype=ltype)
+        mx_test_x2 = np_test_x2
+        np_func = getattr(onp, func)
+        mx_func = TestBinaryScalar(func, mx_test_x2)
+        if hybridize:
+            mx_func.hybridize()
+        rtol = 1e-2 if ltype is np.float16 else 1e-3
+        atol = 1e-3 if ltype is np.float16 else 1e-5
+        if ltype not in itypes:
+            if lgrad:
+                mx_test_x1.attach_grad()
+            np_out = np_func(np_test_x1, np_test_x2)
+            with mx.autograd.record():
+                y = mx_func(mx_test_x1)
+            assert y.shape == np_out.shape
+            assert_almost_equal(y.asnumpy(), np_out.astype(y.dtype), rtol=rtol, atol=atol)
+            if lgrad:
+                y.backward()
+                assert_almost_equal(mx_test_x1.grad.asnumpy(),
+                                    collapse_sum_like(lgrad(y.asnumpy(), np_test_x1, np_test_x2), mx_test_x1.shape),
+                                    rtol=rtol, atol=atol, equal_nan=True, use_broadcast=False)
+
+        # Test imperative
+        np_out = getattr(onp, func)(np_test_x1, np_test_x2)
+        mx_out = getattr(mx.np, func)(mx_test_x1, mx_test_x2)
+        assert mx_out.shape == np_out.shape
+        assert mx_out.asnumpy().dtype == np_out.dtype
+        assert_almost_equal(mx_out.asnumpy(), np_out.astype(mx_out.dtype), rtol=rtol, atol=atol)
+
+    funcs = {
+        'add': (-1.0, 1.0, None),
+        'subtract': (-1.0, 1.0, None),
+        'multiply': (-1.0, 1.0, lambda y, x1, x2: onp.broadcast_to(x2, y.shape)),
+        'power': (1.0, 5.0, lambda y, x1, x2: onp.power(x1, x2 - 1.0) * x2),
+    }
+
+    shapes = [(3, 2), (3, 0), (3, 1), (0, 2), (2, 3, 4)]
+    ltypes = [np.int32, np.int64, np.float16, np.float32, np.float64]
+    flags = [True, False]
+    for func, func_data in funcs.items():
+        low, high, lgrad = func_data
+        for shape, ltype, is_int, hybridize in itertools.product(shapes, ltypes, flags, flags):
+                check_binary_scalar_func(func, low, high, shape, lgrad, ltype, is_int, hybridize)
+
+
+@use_np
+def test_np_boolean_binary_funcs():
+    def check_boolean_binary_func(func, mx_x1, mx_x2):
+        class TestBooleanBinary(HybridBlock):
+            def __init__(self, func):
+                super(TestBooleanBinary, self).__init__()
+                self._func = func
+
+            def forward(self, a, b, *args, **kwargs):
+                return getattr(np, self._func)(a, b)
+
+        np_x1 = mx_x1.asnumpy()
+        np_x2 = mx_x2.asnumpy()
+        np_func = getattr(onp, func)
+        mx_func = TestBooleanBinary(func)
+        for hybridize in [True, False]:
+            if hybridize:
+                mx_func.hybridize()
+            np_out = np_func(np_x1, np_x2)
+            with mx.autograd.record():
+                y = mx_func(mx_x1, mx_x2)
+            assert y.shape == np_out.shape
+            assert_almost_equal(y.asnumpy(), np_out.astype(y.dtype), rtol=1e-3, atol=1e-20,
+                                use_broadcast=False, equal_nan=True)
+
+        np_out = getattr(onp, func)(np_x1, np_x2)
+        mx_out = getattr(mx.np, func)(mx_x1, mx_x2)
+        assert mx_out.shape == np_out.shape
+        assert_almost_equal(mx_out.asnumpy(), np_out.astype(mx_out.dtype), rtol=1e-3, atol=1e-20,
+                            use_broadcast=False, equal_nan=True)
+
+
+    funcs = [
+        'add',
+        'multiply',
+        'true_divide',
+    ]
+
+    shape_pairs = [((3, 2), (3, 2)),
+                   ((3, 2), (3, 1)),
+                   ((3, 1), (3, 0)),
+                   ((0, 2), (1, 2)),
+                   ((2, 3, 4), (3, 1)),
+                   ((2, 3), ()),
+                   ((), (2, 3))]
+
+    for lshape, rshape in shape_pairs:
+        for func in funcs:
+            x1 = np.array(onp.random.uniform(size=lshape) > 0.5)
+            x2 = np.array(onp.random.uniform(size=rshape) > 0.5)
+            check_boolean_binary_func(func, x1, x2)
+
+
+@use_np
+def test_npx_relu():
+    def np_relu(x):
+        return onp.maximum(x, 0.0)
+    def np_relu_grad(x):
+        return 1.0 * (x > 0.0)
+
+    class TestReLU(HybridBlock):
+        def __init__(self):
+            super(TestReLU, self).__init__()
+
+        def forward(self, a):
+            return npx.relu(a)
+
+    shapes = [(), (2, 3, 4), (2, 0, 3), (1, 0, 0)]
+    for hybridize in [True, False]:
+        for shape in shapes:
+            test_relu = TestReLU()
+            if hybridize:
+                test_relu.hybridize()
+            x = rand_ndarray(shape).as_np_ndarray()
+            x.attach_grad()
+            np_out = np_relu(x.asnumpy())
+            with mx.autograd.record():
+                mx_out = test_relu(x)
+            assert mx_out.shape == np_out.shape
+            assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+            mx_out.backward()
+            np_backward = np_relu_grad(x.asnumpy())
+            assert_almost_equal(x.grad.asnumpy(), np_backward, rtol=1e-3, atol=1e-5)
+
+            mx_out = npx.relu(x)
+            np_out = np_relu(x.asnumpy())
+            assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+
+
+@use_np
+def test_npx_sigmoid():
+    def np_sigmoid(x):
+        return onp.divide(1.0, (1.0 + onp.exp(-x)))
+    def np_sigmoid_grad(ya):
+        return ya * (1 - ya)
+
+    class TestSigmoid(HybridBlock):
+        def __init__(self):
+            super(TestSigmoid, self).__init__()
+
+        def forward(self, a):
+            return npx.sigmoid(a)
+
+    shapes = [(), (2, 3, 4), (2, 0, 3), (1, 0, 0)]
+    for hybridize in [True, False]:
+        for shape in shapes:
+            test_sigmoid = TestSigmoid()
+            if hybridize:
+                test_sigmoid.hybridize()
+            x = rand_ndarray(shape).as_np_ndarray()
+            x.attach_grad()
+            np_out = np_sigmoid(x.asnumpy())
+            with mx.autograd.record():
+                mx_out = test_sigmoid(x)
+            assert mx_out.shape == np_out.shape
+            assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+            mx_out.backward()
+            np_backward = np_sigmoid_grad(np_out)
+            assert_almost_equal(x.grad.asnumpy(), np_backward, rtol=1e-3, atol=1e-5)
+
+            mx_out = npx.sigmoid(x)
+            np_out = np_sigmoid(x.asnumpy())
+            assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+
+
+@use_np
+def test_np_atleast_nd():
+    class TestAtleastND(HybridBlock):
+        def __init__(self, n):
+            super(TestAtleastND, self).__init__()
+            self._n = n
+
+        def forward(self, *arys):
+            if self._n == 1:
+                return np.atleast_1d(*arys)
+            elif self._n == 2:
+                return np.atleast_2d(*arys)
+            elif self._n == 3:
+                return np.atleast_3d(*arys)
+
+    tensor_shapes = [
+        ((), (2,), (3, 4, 5)),
+        ((2, 3, 4, 5), (), (2, 3))
+    ]
+    flags = [True, False]
+    ns = [1, 2, 3]
+    dtypes = ['int32', 'int64', 'float16', 'float32', 'float64']
+    funcs = {
+        "numpy": {1: lambda *ts: onp.atleast_1d(*ts),
+                  2: lambda *ts: onp.atleast_2d(*ts),
+                  3: lambda *ts: onp.atleast_3d(*ts)},
+        "mxnet": {1: lambda *ts: np.atleast_1d(*ts),
+                  2: lambda *ts: np.atleast_2d(*ts),
+                  3: lambda *ts: np.atleast_3d(*ts)}
+    }
+    for hybridize, n, tensor_shape, dtype in \
+        itertools.product(flags, ns, tensor_shapes, dtypes):
+        test_atleast_nd = TestAtleastND(n)
+        if hybridize:
+            test_atleast_nd.hybridize()
+        if dtype in ['int32', 'int64']:
+            tensors = list(map(lambda s: np.random.randint(-1, 1, size=s, dtype=dtype), tensor_shape))
+        else:
+            tensors = list(map(lambda s: np.random.uniform(-1.0, 1.0, size=s, dtype=dtype), tensor_shape))
+        tensors_np = [t.asnumpy() for t in tensors]
+        mx_out = test_atleast_nd(*tensors)
+        np_out = funcs["numpy"][n](*tensors_np)
+        for i in range(len(tensors)):
+            assert mx_out[i].shape == np_out[i].shape
+            assert same(mx_out[i].asnumpy(), np_out[i])
+
+        mx_out = funcs["mxnet"][n](*tensors)
+        np_out = funcs["numpy"][n](*tensors_np)
+        for i in range(len(tensors)):
+            assert mx_out[i].shape == np_out[i].shape
+            assert same(mx_out[i].asnumpy(), np_out[i])
+
+
+@use_np
+def test_np_arange():
+    configs = [
+        (1, 10, 2),
+        (1, 10, 4),
+        (1, -10, 4),
+        (1, -10, -2),
+        (1, -10, -4),
+        (2, 3),
+        (2, -3),
+        (-2, -3),
+        (-2, 3),
+        (4, 0, 5),
+        (-4, 0, 5),
+        (-4, 0, -5),
+        (0, 0),
+        (11, 11),
+        (0, 0, 2),
+        (0, 0, -2),
+        (0, 5, None),
+        (0, -5, None),
+        0,
+        6,
+    ]
+    dtypes = ['int32', 'float16', 'float32', 'float64', None]
+    for config in configs:
+        for dtype in dtypes:
+            if isinstance(config, tuple):
+                mx_ret = np.arange(*config, dtype=dtype)
+                np_ret = onp.arange(*config, dtype=dtype)
+            else:
+                mx_ret = np.arange(config, dtype=dtype)
+                np_ret = onp.arange(config, dtype=dtype)
+            assert same(mx_ret.asnumpy(), np_ret)
+
+    class TestRange(HybridBlock):
+        def __init__(self, start, stop=None, step=None, dtype=None):
+            super(TestRange, self).__init__()
+            self._start = start
+            self._stop = stop
+            self._step = step
+            self._dtype = dtype
+
+        def forward(self, x):
+            return x + np.arange(self._start, self._stop, self._step, dtype=self._dtype)
+
+    for dtype in dtypes:
+        x = np.zeros(shape=(), dtype=dtype)
+        for config in configs:
+            for hybridize in [False, True]:
+                if isinstance(config, tuple):
+                    net = TestRange(*config, dtype=dtype)
+                    np_out = onp.arange(*config, dtype=dtype)
+                else:
+                    net = TestRange(config, dtype=dtype)
+                    np_out = onp.arange(config, dtype=dtype)
+                if hybridize:
+                    net.hybridize()
+                mx_out = net(x)
+                assert same(mx_out.asnumpy(), np_out)
+
+
+@use_np
+def test_np_split():
+    class TestSplit(HybridBlock):
+        def __init__(self, indices_or_sections, axis=None):
+            super(TestSplit, self).__init__()
+            self._axis = axis
+            self._indices_or_sections = indices_or_sections
+
+        def forward(self, a, *args, **kwargs):
+            return np.split(a, indices_or_sections=self._indices_or_sections,
+                              axis=self._axis)
+
+    def get_indices(axis_size):
+        if axis_size is 0:
+            axis_size = random.randint(3, 6)
+        samples = random.randint(1, axis_size - 1)
+        indices = sorted(random.sample([i for i in range(1, axis_size)], samples))
+        indices = tuple(indices)
+        return indices
+
+    dim = random.randint(0, 3)
+    shape = [0] + [random.randint(2, 4) for i in range(dim)]
+    for hybridize in [True, False]:
+        for axis in range(-len(shape)+1, len(shape)):
+            indices = get_indices(shape[axis])
+            sections = 7 if shape[axis] is 0 else shape[axis]
+            for indices_or_sections in [indices, sections]:
+                # test gluon
+                test_split = TestSplit(axis=axis, indices_or_sections=indices_or_sections)
+                if hybridize:
+                    test_split.hybridize()
+
+                a = mx.nd.random.uniform(-1.0, 1.0, shape=shape).as_np_ndarray()
+                a.attach_grad()
+                expected_ret = onp.split(a.asnumpy(), indices_or_sections=indices_or_sections, axis=axis)
+                with mx.autograd.record():
+                    y = test_split(a)
+                assert len(y) == len(expected_ret)
+                for mx_out, np_out in zip(y, expected_ret):
+                    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+
+                mx.autograd.backward(y)
+
+                assert_almost_equal(a.grad.asnumpy(), onp.ones(a.shape), rtol=1e-3, atol=1e-5)
+
+                # test imperative
+                mx_outs = np.split(a, indices_or_sections=indices_or_sections, axis=axis)
+                np_outs = onp.split(a.asnumpy(), indices_or_sections=indices_or_sections, axis=axis)
+                for mx_out, np_out in zip(mx_outs, np_outs):
+                    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+
+
+@use_np
+def test_np_array_split():
+    class TestArray_split(HybridBlock):
+        def __init__(self, indices_or_sections, axis=None):
+            super(TestArray_split, self).__init__()
+            self._axis = axis
+            self._indices_or_sections = indices_or_sections
+
+        def forward(self, a, *args, **kwargs):
+            return np.array_split(a, indices_or_sections=self._indices_or_sections,
+                              axis=self._axis)
+
+    def get_indices(axis_size):
+        if axis_size is 0:
+            axis_size = random.randint(3, 6)
+        samples = random.randint(1, axis_size - 1)
+        indices = sorted(random.sample([i for i in range(0, axis_size + 1)], samples))
+        indices = tuple(indices)
+        return indices
+
+    shapes = [(), (5, ), (10, ),
+              (2, 5), (5, 5), (10, 10),
+              (4, 4, 4), (4, 6, 9), (6, 6, 6),
+              (7, 8, 9, 10)]
+    dtypes = [np.int8, np.uint8, np.int32, np.int64, np.float16, np.float32, np.float64]
+
+    combinations = itertools.product([False, True], shapes, dtypes)
+    for hybridize, shape, dtype in combinations:
+        rtol = 1e-2 if dtype == np.float16 else 1e-3
+        atol = 1e-4 if dtype == np.float16 else 1e-5
+        for axis in range(len(shape)):
+            x = np.random.uniform(-5.0, 5.0, size=shape).astype(dtype)
+            indices = get_indices(shape[axis])
+            sections = 7 if x.shape[axis] is 0 else random.randint(1,x.shape[axis])
+            for indices_or_sections in [indices, sections]:
+                # test gluon
+                test_array_split = TestArray_split(axis=axis, indices_or_sections=indices_or_sections)
+                if hybridize:
+                    test_array_split.hybridize()
+                x.attach_grad()
+                expected_ret = onp.array_split(x.asnumpy(), indices_or_sections=indices_or_sections, axis=axis)
+                with mx.autograd.record():
+                    y = test_array_split(x)
+                assert len(y) == len(expected_ret)
+                for mx_out, np_out in zip(y, expected_ret):
+                    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=rtol, atol=atol)
+                mx.autograd.backward(y)
+                assert_almost_equal(x.grad.asnumpy(), onp.ones(x.shape), rtol=rtol, atol=atol)
+
+                # test imperative
+                mx_outs = np.array_split(x, indices_or_sections=indices_or_sections, axis=axis)
+                np_outs = onp.array_split(x.asnumpy(), indices_or_sections=indices_or_sections, axis=axis)
+                for mx_out, np_out in zip(mx_outs, np_outs):
+                    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=rtol, atol=atol)
+
+
+@use_np
+def test_np_vsplit():
+    class TestVsplit(HybridBlock):
+        def __init__(self, indices_or_sections):
+            super(TestVsplit, self).__init__()
+            self._indices_or_sections = indices_or_sections
+
+        def forward(self, a, *args, **kwargs):
+            return np.vsplit(a, indices_or_sections=self._indices_or_sections)
+
+    def get_indices(axis_size):
+        if axis_size is 0:
+            axis_size = random.randint(3, 6)
+        samples = random.randint(1, axis_size - 1)
+        indices = sorted(random.sample([i for i in range(1, axis_size)], samples))
+        indices = tuple(indices)
+        return indices
+
+    shapes = [
+        (2, 1, 2, 9),
+        (4, 3, 3),
+        (4, 0, 2),  # zero-size shape
+        (0, 3), # first dim being zero
+    ]
+    for hybridize in [True, False]:
+        for shape in shapes:
+            axis_size = shape[0]
+            indices = get_indices(axis_size)
+            sections = 7 if axis_size is 0 else axis_size
+            for indices_or_sections in [indices, sections]:
+                # test gluon
+                test_vsplit = TestVsplit(indices_or_sections=indices_or_sections)
+                if hybridize:
+                    test_vsplit.hybridize()
+                a = rand_ndarray(shape).as_np_ndarray() # TODO: check type
+                a.attach_grad()
+                expected_ret = onp.vsplit(a.asnumpy(), indices_or_sections=indices_or_sections)
+                with mx.autograd.record():
+                    y = test_vsplit(a)
+                assert len(y) == len(expected_ret)
+                for mx_out, np_out in zip(y, expected_ret):
+                    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+
+                mx.autograd.backward(y)
+
+                assert_almost_equal(a.grad.asnumpy(), onp.ones(a.shape), rtol=1e-3, atol=1e-5)
+
+                # test imperative
+                mx_outs = np.vsplit(a, indices_or_sections=indices_or_sections)
+                np_outs = onp.vsplit(a.asnumpy(), indices_or_sections=indices_or_sections)
+                for mx_out, np_out in zip(mx_outs, np_outs):
+                    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+
+
+@use_np
+def test_np_concat():
+    class TestConcat(HybridBlock):
+        def __init__(self, axis=None):
+            super(TestConcat, self).__init__()
+            self._axis = axis
+
+        def forward(self, a, *args):
+            return np.concatenate([a] + list(args), axis=self._axis)
+
+    def get_new_shape(shape, axis):
+        shape_lst = list(shape)
+        if axis is not None:
+            shape_lst[axis] = random.randint(0, 3)
+        return tuple(shape_lst)
+
+    shapes = [(), (0, 0), (2, 3), (2, 1, 3)]
+    hybridizes = [True, False]
+    axes = [0, 1, -1, None]
+    grad_reqs = ['write', 'add', 'null']
+    dtypes = [np.float32, np.float64, np.bool]
+    combinations = itertools.product(shapes, hybridizes, axes, grad_reqs, dtypes)
+
+    for shape, hybridize, axis, grad_req, dtype in combinations:
+        # test gluon
+        if shape == () and axis != None:
+            continue
+        test_concat = TestConcat(axis=axis)
+        if hybridize:
+            test_concat.hybridize()
+
+        grad_req_c = grad_req
+        grad_req_d = grad_req
+        if grad_req == 'null':
+            ide = random.randint(0, 2)
+            grad_req_c = 'write' if ide == 0 else 'add'
+            grad_req_c = 'write' if ide == 1 else 'add'
+
+        a = np.random.uniform(-1.0, 1.0, size=get_new_shape(shape, axis)).astype(dtype)
+        a.attach_grad(grad_req)
+        b = np.random.uniform(-1.0, 1.0, size=get_new_shape(shape, axis)).astype(dtype)
+        b.attach_grad(grad_req)
+        c = np.random.uniform(-1.0, 1.0, size=get_new_shape(shape, axis)).astype(dtype)
+        c.attach_grad(grad_req_c)
+        d = np.random.uniform(-1.0, 1.0, size=get_new_shape(shape, axis)).astype(dtype)
+        d.attach_grad(grad_req_d)
+        expected_ret = onp.concatenate([a.asnumpy(), b.asnumpy(), c.asnumpy(), d.asnumpy()], axis=axis)
+
+        with mx.autograd.record():
+            y = test_concat(a, b, c, d)
+
+        assert y.shape == expected_ret.shape
+        assert_almost_equal(y.asnumpy(), expected_ret, rtol=1e-3, atol=1e-5)
+
+        y.backward()
+        if grad_req != 'null':
+            assert_almost_equal(a.grad.asnumpy(), onp.ones(a.shape), rtol=1e-3, atol=1e-5)
+        if grad_req != 'null':
+            assert_almost_equal(b.grad.asnumpy(), onp.ones(b.shape), rtol=1e-3, atol=1e-5)
+        if grad_req_c != 'null':
+            assert_almost_equal(c.grad.asnumpy(), onp.ones(c.shape), rtol=1e-3, atol=1e-5)
+        if grad_req_d != 'null':
+            assert_almost_equal(d.grad.asnumpy(), onp.ones(d.shape), rtol=1e-3, atol=1e-5)
+
+        # test imperative
+        mx_out = np.concatenate([a, b, c, d], axis=axis)
+        np_out = onp.concatenate([a.asnumpy(), b.asnumpy(), c.asnumpy(), d.asnumpy()], axis=axis)
+        assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+
+
+@use_np
+def test_np_append():
+    class TestAppend(HybridBlock):
+        def __init__(self, axis=None):
+            super(TestAppend, self).__init__()
+            self._axis = axis
+
+        def forward(self, a, b):
+            return np.append(a, b, axis=self._axis)
+
+    def get_new_shape(shape, axis):
+        shape_lst = list(shape)
+        if axis is not None:
+            shape_lst[axis] = random.randint(0, 3)
+        return tuple(shape_lst)
+
+    for shape in [(0, 0), (2, 3), (2, 1, 3)]:
+        for hybridize in [True, False]:
+            for axis in [0, 1, None]:
+                for grad_req_a in ['write', 'add', 'null']:
+                    if grad_req_a == 'null':
+                        continue
+                    #set grad_req
+                    grad_req_b = grad_req_a
+                    if grad_req_a == 'null':
+                        ide = random.randint(0, 2)
+                        grad_req_b = 'write' if ide == 0 else 'add'
+
+                    #test gluon
+                    test_append = TestAppend(axis=axis)
+                    if hybridize:
+                        test_append.hybridize()
+
+                    a = mx.nd.random.uniform(-1.0, 1.0, shape=get_new_shape(shape, axis)).as_np_ndarray()
+                    a.attach_grad(grad_req=grad_req_a)
+                    b = mx.nd.random.uniform(-1.0, 1.0, shape=get_new_shape(shape, axis)).as_np_ndarray()
+                    b.attach_grad(grad_req=grad_req_b)
+                    expected_ret = onp.append(a.asnumpy(), b.asnumpy(), axis=axis)
+
+                    with mx.autograd.record():
+                        y = test_append(a, b)
+
+                    assert y.shape == expected_ret.shape
+                    assert_almost_equal(y.asnumpy(), expected_ret, rtol=1e-3, atol=1e-5)
+                    y.backward()
+
+                    if grad_req_a != 'null':
+                        assert_almost_equal(a.grad.asnumpy(), onp.ones(a.shape), rtol=1e-3, atol=1e-5)
+                    assert_almost_equal(b.grad.asnumpy(), onp.ones(b.shape), rtol=1e-3, atol=1e-5)
+                    #test imperative
+                    mx_out = np.append(a, b, axis=axis)
+                    np_out = onp.append(a.asnumpy(), b.asnumpy(), axis=axis)
+                    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+
+
+@use_np
+def test_np_stack():
+    class TestStack(HybridBlock):
+        def __init__(self, axis=None):
+            super(TestStack, self).__init__()
+            self._axis = axis
+
+        def forward(self, a, *args):
+            return np.stack([a] + list(args), axis=self._axis)
+
+    a, b, c, d = mx.sym.Variable("a"), mx.sym.Variable("b"), mx.sym.Variable("c"), mx.sym.Variable("d")
+    ret = mx.sym.np.stack([a.as_np_ndarray(), b.as_np_ndarray(), c.as_np_ndarray(), d.as_np_ndarray()])
+    assert type(ret) == mx.sym.np._Symbol
+
+    for shape in [(0, 0), (2, 3)]:
+        for hybridize in [True, False]:
+            for axis in range(2):
+                test_stack = TestStack(axis=axis)
+                if hybridize:
+                    test_stack.hybridize()
+                np_a = onp.random.uniform(-1.0, 1.0, shape).astype(onp.float32)
+                np_b = onp.random.uniform(-1.0, 1.0, shape).astype(onp.float32)
+                np_c = onp.random.uniform(-1.0, 1.0, shape).astype(onp.float32)
+                np_d = onp.random.uniform(-1.0, 1.0, shape).astype(onp.float32)
+
+                mx_a = np.array(np_a)
+                mx_a.attach_grad()
+                mx_b = np.array(np_b)
+                mx_b.attach_grad()
+                mx_c = np.array(np_c)
+                mx_c.attach_grad()
+                mx_d = np.array(np_d)
+                mx_d.attach_grad()
+                expected_ret = onp.stack([np_a, np_b, np_c, np_d], axis=axis)
+                with mx.autograd.record():
+                    y = test_stack(mx_a, mx_b, mx_c, mx_d)
+
+                y.backward()
+
+                assert_almost_equal(mx_a.grad.asnumpy(), onp.ones(shape), rtol=1e-3, atol=1e-5)
+                assert_almost_equal(mx_b.grad.asnumpy(), onp.ones(shape), rtol=1e-3, atol=1e-5)
+                assert_almost_equal(mx_c.grad.asnumpy(), onp.ones(shape), rtol=1e-3, atol=1e-5)
+                assert_almost_equal(mx_d.grad.asnumpy(), onp.ones(shape), rtol=1e-3, atol=1e-5)
+
+                np_out = onp.stack([np_a, np_b, np_c, np_d], axis=axis)
+                mx_out = np.stack([mx_a, mx_b, mx_c, mx_d], axis=axis)
+                assert same(mx_out.asnumpy(), np_out)
+
+
+@use_np
+def test_np_hstack():
+    class TestHStack(HybridBlock):
+        def __init__(self):
+            super(TestHStack, self).__init__()
+
+        def forward(self, a, *args):
+            return np.hstack([a] + list(args))
+
+    def get_new_shape(shape):
+        if len(shape) == 0:
+            l = random.randint(0,3)
+            if l == 0:
+                return shape
+            else:
+                return (l,)
+        shape_lst = list(shape)
+        axis = 1 if len(shape) > 1 else 0
+        shape_lst[axis] = random.randint(0, 5)
+        return tuple(shape_lst)
+
+    shapes = [
+        (),
+        (1,),
+        (2,1),
+        (2,2,4),
+        (2,0,0),
+        (0,1,3),
+        (2,0,3),
+        (2,3,4,5)
+    ]
+    for hybridize in [True, False]:
+        for shape in shapes:
+            test_hstack = TestHStack()
+            if hybridize:
+                test_hstack.hybridize()
+            # test symbolic forward
+            a = np.random.uniform(size=get_new_shape(shape))
+            a.attach_grad()
+            b = np.random.uniform(size=get_new_shape(shape))
+            b.attach_grad()
+            c = np.random.uniform(size=get_new_shape(shape))
+            c.attach_grad()
+            d = np.random.uniform(size=get_new_shape(shape))
+            d.attach_grad()
+            with mx.autograd.record():
+                mx_out = test_hstack(a, b, c, d)
+            np_out = onp.hstack((a.asnumpy(), b.asnumpy(), c.asnumpy(), d.asnumpy()))
+            assert mx_out.shape == np_out.shape
+            assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+
+            # test symbolic backward
+            mx_out.backward()
+            assert_almost_equal(a.grad.asnumpy(), onp.ones(a.shape), rtol=1e-3, atol=1e-5)
+            assert_almost_equal(b.grad.asnumpy(), onp.ones(b.shape), rtol=1e-3, atol=1e-5)
+            assert_almost_equal(c.grad.asnumpy(), onp.ones(c.shape), rtol=1e-3, atol=1e-5)
+            assert_almost_equal(d.grad.asnumpy(), onp.ones(d.shape), rtol=1e-3, atol=1e-5)
+
+            mx_out = np.hstack((a, b, c, d))
+            np_out = onp.hstack((a.asnumpy(),b.asnumpy(), c.asnumpy(), d.asnumpy()))
+            assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+
+
+@use_np
+def test_np_dstack():
+    class TestDStack(HybridBlock):
+        def __init__(self):
+            super(TestDStack, self).__init__()
+
+        def forward(self, a, *args):
+            return np.dstack([a] + list(args))
+
+    def get_new_shape(shape):
+        if len(shape) < 3:
+            return shape
+        axis = 2
+        shape_lst = list(shape)
+        shape_lst[axis] = random.randint(0, 5)
+        return tuple(shape_lst)
+
+    shapes = [
+        (),
+        (1,),
+        (2,1),
+        (2,2,4),
+        (2,0,0),
+        (0,1,3),
+        (2,0,3),
+        (2,3,4,5)
+    ]
+    for hybridize in [True, False]:
+        for shape in shapes:
+            test_dstack = TestDStack()
+            if hybridize:
+                test_dstack.hybridize()
+            # test symbolic forward
+            a = mx.nd.random.uniform(shape=get_new_shape(shape)).as_np_ndarray()
+            a.attach_grad()
+            b = mx.nd.random.uniform(shape=get_new_shape(shape)).as_np_ndarray()
+            b.attach_grad()
+            c = mx.nd.random.uniform(shape=get_new_shape(shape)).as_np_ndarray()
+            c.attach_grad()
+            d = mx.nd.random.uniform(shape=get_new_shape(shape)).as_np_ndarray()
+            d.attach_grad()
+            with mx.autograd.record():
+                mx_out = test_dstack(a, b, c, d)
+            np_out = onp.dstack((a.asnumpy(), b.asnumpy(), c.asnumpy(), d.asnumpy()))
+            assert mx_out.shape == np_out.shape
+            assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+
+            # test symbolic backward
+            mx_out.backward()
+            assert_almost_equal(a.grad.asnumpy(), onp.ones(a.shape), rtol=1e-3, atol=1e-5)
+            assert_almost_equal(b.grad.asnumpy(), onp.ones(b.shape), rtol=1e-3, atol=1e-5)
+            assert_almost_equal(c.grad.asnumpy(), onp.ones(c.shape), rtol=1e-3, atol=1e-5)
+            assert_almost_equal(d.grad.asnumpy(), onp.ones(d.shape), rtol=1e-3, atol=1e-5)
+
+            # test imperative
+            mx_out = np.dstack((a, b, c, d))
+            np_out = onp.dstack((a.asnumpy(),b.asnumpy(), c.asnumpy(), d.asnumpy()))
+            assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+
+
+@use_np
+def test_np_ravel():
+    class TestRavel(HybridBlock):
+        def __init__(self):
+            super(TestRavel, self).__init__()
+
+        def forward(self, a):
+            return np.ravel(a)
+
+    types = ['float64', 'float32', 'float16', 'int64', 'int32', 'int8']
+    for oneType in types:
+        for hybridize in [True, False]:
+            for shape in [(), (2,), (2, 2), (1, 2, 3), (3, 0), (1, 0, 2)]:
+                test_ravel = TestRavel()
+                if hybridize:
+                    test_ravel.hybridize()
+                x = rand_ndarray(shape, dtype=oneType).as_np_ndarray()
+                x.attach_grad()
+                np_out = onp.ravel(x.asnumpy())
+                with mx.autograd.record():
+                    mx_out = test_ravel(x)
+                assert mx_out.shape == np_out.shape
+                assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+                mx_out.backward()
+                np_backward = onp.ones(shape)
+                assert_almost_equal(x.grad.asnumpy(), np_backward, rtol=1e-3, atol=1e-5)
+
+                mx_out = np.ravel(x)
+                np_out = onp.ravel(x.asnumpy())
+                assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+
+
+@use_np
+def test_np_swapaxes():
+    config = [((0, 1, 2), 0, 0),
+              ((0, 1, 2), 1, 2),
+              ((0, 1, 2), 1, -2),
+              ((4, 5, 6, 7), 1, 1),
+              ((4, 5, 6, 7), 2, -2),
+              ((4, 5, 6, 7), -2, -3)]
+
+    class TestSwapaxes(HybridBlock):
+        def __init__(self, axis1, axis2):
+            super(TestSwapaxes, self).__init__()
+            self._axis1 = axis1
+            self._axis2 = axis2
+
+        def forward(self, x):
+            return np.swapaxes(x, self._axis1, self._axis2)
+
+    for shape, axis1, axis2 in config:
+        data_np = onp.random.uniform(size=shape)
+        data_mx = np.array(data_np, dtype=data_np.dtype)
+        ret_np = onp.swapaxes(data_np, axis1=axis1, axis2=axis2)
+        ret_mx = np.swapaxes(data_mx, axis1=axis1, axis2=axis2)
+        assert same(ret_mx.asnumpy(), ret_np)
+
+        net = TestSwapaxes(axis1, axis2)
+        for hybrid in [False, True]:
+            if hybrid:
+                net.hybridize()
+            ret_mx = net(data_mx)
+            assert same(ret_mx.asnumpy(), ret_np)
+
+
+@use_np
+@pytest.mark.parametrize('shape,axis,throw_exception', [
+    ((), 0, False),
+    ((), -1, False),
+    ((), 1, True),
+    ((5, 3), None, False),
+    ((5, 3), -1, False),
+    ((5, 3), 1, False),
+    ((5, 3), 3, True),
+    ((5, 0, 3), 0, False),
+    ((5, 0, 3), -1, False),
+    ((5, 0, 3), None, True),
+    ((5, 0, 3), 1, True),
+    ((3, 5, 7), None, False),
+    ((3, 5, 7), 0, False),
+    ((3, 5, 7), 1, False),
+    ((3, 5, 7), 2, False),
+    ((3, 5, 7, 9, 11), -3, False),
+])
+@pytest.mark.parametrize('dtype', ['float16', 'float32', 'float64', 'bool', 'int32'])
+@pytest.mark.parametrize('op_name', ['argmin', 'argmax'])
+@pytest.mark.parametrize('keepdims', [True, False])
+@pytest.mark.parametrize('hybridize', [True, False])
+def test_np_argmin_argmax(shape, axis, throw_exception, dtype, op_name, keepdims, hybridize):
+    class TestArgExtreme(HybridBlock):
+        def __init__(self, op_name, axis=None, keepdims=False):
+            super(TestArgExtreme, self).__init__()
+            self._op_name = op_name
+            self._axis = axis
+            self.keepdims = keepdims
+
+        def forward(self, x):
+            return getattr(x, self._op_name)(self._axis, keepdims=self.keepdims)
+
+    a = np.random.uniform(low=0, high=100, size=shape).astype(dtype)
+    if throw_exception:
+        with pytest.raises(MXNetError):
+            getattr(np, op_name)(a, axis)
+            mx.npx.waitall()
+    else:
+        mx_ret = getattr(np, op_name)(a, axis=axis, keepdims=keepdims)
+        np_ret = getattr(onp, op_name)(a.asnumpy(), axis=axis)
+        assert mx_ret.dtype == np_ret.dtype
+        if keepdims:
+            assert same(np.squeeze(mx_ret, axis=axis).asnumpy(), np_ret)
+        else:
+            assert same(mx_ret.asnumpy(), np_ret)
+
+    net = TestArgExtreme(op_name, axis, keepdims)
+    if hybridize:
+        net.hybridize()
+    if throw_exception:
+        with pytest.raises(MXNetError):
+            getattr(np, op_name)(a, axis)
+            mx.npx.waitall()
+    else:
+        mx_ret = net(a)
+        assert mx_ret.dtype == np_ret.dtype
+        if keepdims:
+            assert same(np.squeeze(mx_ret, axis=axis).asnumpy(), np_ret)
+        else:
+            assert same(mx_ret.asnumpy(), np_ret)
+
+
+@use_np
+def test_np_clip():
+    workloads = [
+        ((), None, None, True),
+        ((), None, 1, False),
+        ((), -1, 1, False),
+        ((), -1, None, False),
+        ((5, 3), None, 0.1, False),
+        ((5, 3), -0.1, None, False),
+        ((5, 3), -0.1, 0.1, False),
+        ((5, 3), 0, 0, False),
+        ((5, 0, 3), 0, None, False),
+        ((5, 0, 3), None, -1, False),
+        ((5, 0, 3), -1, 0, False),
+    ]
+    dtypes = ['float32', 'float64']
+
+    class TestClip(HybridBlock):
+        def __init__(self, a_min=None, a_max=None):
+            super(TestClip, self).__init__()
+            self._a_min = a_min
+            self._a_max = a_max
+
+        def forward(self, x):
+            return x.clip(self._a_min, self._a_max)
+
+    # Test scalar case
+    for _, a_min, a_max, throw_exception in workloads:
+        a = onp.random.uniform() # A scalar
+        if throw_exception:
+            # No need to test the exception case here.
+            continue
+        mx_ret = np.clip(a, a_min, a_max)
+        np_ret = onp.clip(a, a_min, a_max)
+        assert_almost_equal(mx_ret, np_ret, atol=1e-4, rtol=1e-3, use_broadcast=False)
+
+    for shape, a_min, a_max, throw_exception in workloads:
+        for dtype in dtypes:
+            a = np.random.uniform(size=shape, dtype=dtype)
+            if throw_exception:
+                # Cannot use assert_exception because sometimes the main thread
+                # proceeds to `assert False` before the exception is thrown
+                # in the worker thread. Have to use mx.nd.waitall() here
+                # to block the main thread.
+                try:
+                    a.clip(min=a_min, max=a_max)
+                    mx.nd.waitall()
+                    assert False
+                except:
+                    pass
+            else:
+                mx_ret = a.clip(min=a_min, max=a_max)
+                np_ret = a.asnumpy().clip(min=a_min, max=a_max)
+                assert_almost_equal(mx_ret.asnumpy(), np_ret, atol=1e-4, rtol=1e-3, use_broadcast=False)
+
+            for hybridize in [False, True]:
+                net = TestClip(a_min, a_max)
+                if hybridize:
+                    net.hybridize()
+                if throw_exception:
+                    try:
+                        net(a)
+                        mx.nd.waitall()
+                        assert False
+                    except:
+                        pass
+                else:
+                    mx_ret = net(a)
+                    assert_almost_equal(mx_ret.asnumpy(), np_ret, atol=1e-4, rtol=1e-3, use_broadcast=False)
+
+
+@use_np
+def test_np_eye():
+    configs = [
+        4,
+        1000,
+        (4, 3),
+        (5, None),
+        (4, None, 1),
+        (2, 2, 1),
+        (4, 6, 1),
+        (7, 3, -3),
+        (3, 2, -2),
+        (4, 0),
+        (0, 0),
+        (0, 3),
+        (0, 0, -2)
+    ]
+    exception_configs = [
+        -1,
+        -1000,
+        (-2, None),
+        (1, -1)
+    ]
+    dtypes = ['int32', 'float16', 'float32', 'float64', None]
+    for config in configs:
+        for dtype in dtypes:
+            if isinstance(config, tuple):
+                mx_ret = np.eye(*config, dtype=dtype)
+                np_ret = onp.eye(*config, dtype=dtype)
+            else:
+                mx_ret = np.eye(config, dtype=dtype)
+                np_ret = onp.eye(config, dtype=dtype)
+            assert same(mx_ret.asnumpy(), np_ret)
+    # check for exception input
+    for config in exception_configs:
+        if isinstance(config, tuple):
+            assertRaises(MXNetError, np.eye, *config)
+        else:
+            assertRaises(MXNetError, np.eye, config)
+
+    class TestEye(HybridBlock):
+        def __init__(self, N, M=None, k=0, dtype=None):
+            super(TestEye, self).__init__()
+            self._N = N
+            self._M = M
+            self._k = k
+            self._dtype = dtype
+
+        def forward(self, x):
+            return x + np.eye(self._N, self._M, self._k, dtype=self._dtype)
+
+    for dtype in dtypes:
+        x = np.zeros(shape=(), dtype=dtype)
+        for config in configs:
+            for hybridize in [False, True]:
+                if isinstance(config, tuple):
+                    net = TestEye(*config, dtype=dtype)
+                    np_out = onp.eye(*config, dtype=dtype)
+                else:
+                    net = TestEye(config, dtype=dtype)
+                    np_out = onp.eye(config, dtype=dtype)
+                if hybridize:
+                    net.hybridize()
+                mx_out = net(x)
+                assert same(mx_out.asnumpy(), np_out)
+
+
+@use_np
+def test_np_indices():
+    dtypes = ['int32', 'int64', 'float16', 'float32', 'float64']
+    shapes = [
+        (0,),
+        (3,),
+        (2, 3, 4),
+        (2, 0, 4),
+        (1, 1, 1, 1),
+        (1, 0, 0, 1),
+        (2, 3, 4, 5, 6, 7)
+    ]
+    if platform.system() == 'Windows':
+        shapes = shapes[1:]  # beacuse in numpy windows version, indces not support dimensions is empty tuple.
+    for dtype in dtypes:
+        for shape in shapes:
+            np_out = onp.indices(dimensions=shape, dtype=dtype)
+            mx_out = np.indices(dimensions=shape, dtype=dtype)
+            assert same(mx_out.asnumpy(), np_out)
+            assert mx_out.shape == np_out.shape
+
+    @use_np
+    class TestIndices(HybridBlock):
+        def __init__(self, dimensions=None, dtype=None):
+            super(TestIndices, self).__init__()
+            self._dimensions = dimensions
+            self._dtype = dtype
+
+        def forward(self, x):
+            return x + np.indices(dimensions=self._dimensions, dtype=self._dtype)
+
+    for dtype in dtypes:
+        for shape in shapes:
+            x = np.zeros(shape=(), dtype=dtype)
+            for hybridize in [False, True]:
+                net = TestIndices(dimensions=shape, dtype=dtype)
+                np_out = onp.indices(dimensions=shape, dtype=dtype)
+                if hybridize:
+                    net.hybridize()
+                mx_out = net(x)
+                assert same(mx_out.asnumpy(), np_out)
+                assert mx_out.shape == np_out.shape
+
+
+@use_np
+def test_np_repeat():
+    config = [
+        ((), 2, None),
+        ((), 0, None),
+        ((4, 2), 2, None),
+        ((4, 2), 2, 0),
+        ((4, 2), 2, 1),
+        ((4, 2), 2, -1),
+        ((4, 2), [2,3] * 4, None),
+        ((4, 2), [1,2], 1),
+    ]
+
+    class TestRepeat(HybridBlock):
+        def __init__(self, repeats, axis=None):
+            super(TestRepeat, self).__init__()
+            self._repeats = repeats
+            self._axis = axis
+
+        def forward(self, x):
+            return x.repeat(self._repeats, self._axis)
+
+    for shape, repeats, axis in config:
+        data_np = onp.random.randint(low=0, high=1000, size=shape)
+        data_mx = np.array(data_np, dtype=data_np.dtype)
+        ret_np = data_np.repeat(repeats, axis)
+        ret_mx = data_mx.repeat(repeats, axis)
+        assert same(ret_mx.asnumpy(), ret_np)
+
+        net = TestRepeat(repeats, axis)
+        for hybrid in [False, True]:
+            if hybrid:
+                net.hybridize()
+            ret_mx = net(data_mx)
+            assert same(ret_mx.asnumpy(), ret_np)
+
+
+@use_np
+def test_np_cumsum():
+    def np_cumsum_backward(ograd, axis=None, dtype=None):
+        return onp.flip(onp.cumsum(onp.flip(ograd, axis=axis), axis=axis, dtype=dtype), axis=axis)
+
+    class TestCumsum(HybridBlock):
+        def __init__(self, axis=None, dtype=None):
+            super(TestCumsum, self).__init__()
+            self._axis = axis
+            self._dtype = dtype
+
+        def forward(self, a):
+            return a.cumsum(axis=self._axis, dtype=self._dtype)
+
+    shapes = [(2, 3, 4), (2, 0, 3), ()]
+    for hybridize in [True, False]:
+        for shape in shapes:
+            for axis in [None] + [i for i in range(0, len(shape))]:
+                for otype in [None, onp.float32, onp.float64]:
+                    test_cumsum = TestCumsum(axis=axis, dtype=otype)
+                    if hybridize:
+                        test_cumsum.hybridize()
+                    for itype in [onp.float16, onp.float32, onp.float64]:
+                        x = rand_ndarray(shape).astype(itype).as_np_ndarray()
+                        x.attach_grad()
+                        np_out = onp.cumsum(x.asnumpy(), axis=axis, dtype=otype)
+                        with mx.autograd.record():
+                            mx_out = test_cumsum(x)
+                        assert mx_out.shape == np_out.shape
+                        assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+                        mx_out.backward()
+                        np_backward = np_cumsum_backward(onp.ones(np_out.shape, dtype=otype),
+                                                         axis=axis, dtype=otype).reshape(x.shape)
+                        assert_almost_equal(x.grad.asnumpy(), np_backward, rtol=1e-3, atol=1e-5)
+
+                        mx_out = np.cumsum(x, axis=axis, dtype=otype)
+                        np_out = onp.cumsum(x.asnumpy(), axis=axis, dtype=otype)
+                        assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+
+    for shape in shapes:
+        for axis in [None] + [i for i in range(0, len(shape))]:
+            for otype in [None, onp.int32, onp.int64]:
+                for itype in [onp.bool, onp.int8, onp.int32, onp.int64]:
+                    x = rand_ndarray(shape).astype(itype).as_np_ndarray()
+                    np_out = onp.cumsum(x.asnumpy(), axis=axis, dtype=otype)
+                    mx_out = np.cumsum(x, axis=axis, dtype=otype)
+                    assert mx_out.shape == np_out.shape
+                    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+
+
+@use_np
+def test_np_where():
+    class TestWhere(HybridBlock):
+        def __init__(self):
+            super(TestWhere, self).__init__()
+
+        def forward(self, cond, x, y):
+            return np.where(cond, x, y)
+
+    dtypes = [np.int8, np.uint8, np.int32, np.int64, np.float16, np.float32, np.float64, np.bool]
+    shape_configs = [
+        [(), (2, 3), (4, 1, 3)],
+        [(), (4, 1, 3), (2, 3)],
+        [(2, 3), (4, 1, 3), ()],
+        [(4, 1, 3), (2, 3), ()],
+        [(2, 3), (), (4, 1, 3)],
+        [(2, 3), (2, 3), (2, 3)],
+        [(2, 3), (2, 1), (2, 3)],
+        [(2, 1), (2, 3), (2, 3)],
+        [(2, 3), (2, 3), (2, 1)]
+    ]
+    flags = [True, False]
+    for ctype, dtype, shape_pair, hybridize in itertools.product(dtypes, dtypes, shape_configs, flags):
+        cond = np.round(np.random.uniform(low=0, high=2, size=shape_pair[0], dtype='float64')).astype(ctype)
+        x = np.random.uniform(low=0, high=100, size=shape_pair[1], dtype='float64').astype(dtype)
+        y = np.random.uniform(low=0, high=100, size=shape_pair[2], dtype='float64').astype(dtype)
+        cond.attach_grad()
+        x.attach_grad()
+        y.attach_grad()
+        test_mod = TestWhere()
+        if hybridize:
+            test_mod.hybridize()
+        with mx.autograd.record():
+            ret = test_mod(cond, x, y)
+
+        assert same(ret.asnumpy(), onp.where(cond.asnumpy(), x.asnumpy(), y.asnumpy()))
+        if dtype in [np.float16, np.float32, np.float64]:
+            ret.backward()
+            assert same(cond.grad.asnumpy(), onp.zeros(shape_pair[0], dtype=ctype))
+
+            xgrad = x.grad.asnumpy()
+            npgrad = collapse_sum_like((onp.broadcast_to(cond.asnumpy(), ret.shape) != 0).astype(dtype), shape_pair[1])
+            npgrad = npgrad.astype(xgrad.dtype)
+            assert same(xgrad, npgrad)
+
+        # check imperative again
+        ret = np.where(cond, x, y)
+        assert same(ret.asnumpy(), onp.where(cond.asnumpy(), x.asnumpy(), y.asnumpy()))
+
+        # check scalar case
+        if dtype in [np.float16, np.float32, np.float64]:
+            # lscalar
+            with mx.autograd.record():
+                ret_lscalar = np.where(cond, 1, x)
+            assert same(ret_lscalar.asnumpy(), onp.where(cond.asnumpy(), 1, x.asnumpy()))
+            ret_lscalar.backward()
+
+            xgrad = x.grad.asnumpy()
+            npgrad = collapse_sum_like((onp.broadcast_to(cond.asnumpy(), ret_lscalar.shape) == 0).astype(dtype), shape_pair[1])
+            npgrad = npgrad.astype(xgrad.dtype)
+            assert same(xgrad, npgrad)
+            # rscalar
+            with mx.autograd.record():
+                ret_rscalar = np.where(cond, x, 1)
+            assert same(ret_rscalar.asnumpy(), onp.where(cond.asnumpy(), x.asnumpy(), 1))
+            ret_rscalar.backward()
+
+            xgrad = x.grad.asnumpy()
+            npgrad = collapse_sum_like((onp.broadcast_to(cond.asnumpy(), ret_rscalar.shape) != 0).astype(dtype), shape_pair[1])
+            npgrad = npgrad.astype(xgrad.dtype)
+            assert same(xgrad, npgrad)
+
+        # check both scalar case
+        x = onp.random.randint(0, 100)
+        y = onp.random.randint(0, 100)
+        mx_out = np.where(cond, x, y)
+        np_out = onp.where(cond, x, y)
+        assert same(mx_out, np_out)
+
+
+@use_np
+def test_np_expand_dims():
+    class TestExpandDims(HybridBlock):
+        def __init__(self, axis):
+            super(TestExpandDims, self).__init__()
+            self._axis = axis
+
+        def forward(self, x):
+            return np.expand_dims(x, self._axis)
+
+    dtypes = [np.int8, np.uint8, np.int32, np.int64, np.float16, np.float32, np.float64, np.bool]
+    shapes = [
+        (),
+        (0,),
+        (0, 1),
+        (3,),
+        (1, 2, 3),
+    ]
+    flags = [True, False]
+    for dtype, shape, hybridize in itertools.product(dtypes, shapes, flags):
+        ndim = len(shape)
+        for axis in range(-ndim-1, ndim+1):
+            x_np = onp.random.uniform(0, 100, size=shape).astype(dtype)
+            expected = onp.expand_dims(x_np, axis)
+            for req in ['write', 'add']:
+                test_expand_dims = TestExpandDims(axis)
+                if hybridize:
+                    test_expand_dims.hybridize()
+
+                x = np.array(x_np)
+                x.attach_grad(req)
+                initial_grad = np.random.uniform(0, 10, size=x.shape).astype(x.dtype)
+                x.grad[()] = initial_grad
+                with mx.autograd.record():
+                    y = test_expand_dims(x)
+                y.backward()
+
+                assert_almost_equal(y.asnumpy(), expected, use_broadcast=False)
+                if req == 'null':
+                    assert same(x.grad.asnumpy(), initial_grad.asnumpy())
+                elif req == 'write':
+                    assert same(x.grad.asnumpy(), onp.ones_like(x.asnumpy()))
+                else:
+                    assert_almost_equal(x.grad.asnumpy(), initial_grad.asnumpy() + onp.ones_like(initial_grad.asnumpy()),
+                                        atol=1e-2 if dtype is np.float16 else 1e-4,
+                                        rtol=1e-2 if dtype is np.float16 else 1e-4,
+                                        use_broadcast=False)
+
+                # check imperative again
+                y = np.expand_dims(x, axis)
+                assert_almost_equal(y.asnumpy(), expected, use_broadcast=False)
+
+
+@use_np
+def test_np_full():
+    class TestFull(HybridBlock):
+        def __init__(self, shape, dtype=None):
+            super(TestFull, self).__init__()
+            self._shape = shape
+            self._dtype = dtype
+
+        def forward(self, a):
+            return np.full(self._shape, a, dtype=self._dtype)
+
+    configs = [
+        ((3, 4), 2.0),
+        ((0, 3), 2.0),
+        ((2, 3), True),
+        ((3, 0), False),
+        ((3, 4), np.array(2.0)),
+        ((0, 3), np.array(2.0)),
+        ((2, 3), np.array([1, 2, 3], dtype=np.float32)),
+        ((2, 3), np.array([1, 2, 3], dtype=np.int64)),
+        ((0, 3), np.array([1, 2, 3], dtype=np.float32)),
+        ((0, 3), np.array([1, 2, 3], dtype=np.int64)),
+    ]
+
+    rtol, atol = 1e-3, 1e-5
+    dtypes = ['float16', 'float32', 'float64', 'int8', 'int32', 'int64', 'bool']
+    for shape, fill_value in configs:
+        for hybridize in [True, False]:
+            for dtype in dtypes:
+                if isinstance(fill_value, np.ndarray):
+                    test_full = TestFull(shape, dtype=dtype)
+                    if hybridize:
+                        test_full.hybridize()
+                    mx_out = test_full(fill_value)
+                    expected_np = onp.full(shape, fill_value.asnumpy(), dtype=dtype)
+                    assert mx_out.shape == expected_np.shape
+                    assert mx_out.dtype == expected_np.dtype
+                    assert_almost_equal(mx_out.asnumpy(), expected_np, rtol=rtol, atol=atol)
+
+                # Test imperative once again
+                mx_out = np.full(shape, fill_value, dtype=dtype)
+                if isinstance(fill_value, np.ndarray):
+                    expected_np = onp.full(shape, fill_value.asnumpy(), dtype=dtype)
+                else:
+                    expected_np = onp.full(shape, fill_value, dtype=dtype)
+                assert mx_out.shape == expected_np.shape
+                assert mx_out.dtype == expected_np.dtype
+                assert_almost_equal(mx_out.asnumpy(), expected_np, rtol=rtol, atol=atol)
+
+
+@use_np
+@pytest.mark.skip(reason='Skipped as the test is flaky and the feature causes curand error. Tracked in #18100')
+def test_np_full_like():
+    class TestFullLike(HybridBlock):
+        def __init__(self, fill_value, dtype, device):
+            super(TestFullLike, self).__init__()
+            self._fill_value = fill_value
+            self._dtype = dtype
+            self._device = device
+
+        def forward(self, x, *args, **kwargs):
+            return np.full_like(x, self._fill_value, dtype=self._dtype, device=self._device)
+
+    if StrictVersion(platform.python_version()) < StrictVersion('3.0.0'):
+        return
+
+    dtypes = ['float64', 'float32', 'float16', 'int64', 'int32', 'int8', 'bool']
+    shapes = [
+        (),
+        (1,),
+        (4, 3),
+        (4, 5),
+        (2, 1),
+        (6, 5, 6),
+        (4, 2, 1, 2),
+        (5, 1, 3, 3),
+        (3, 3, 1, 0),
+    ]
+    # numpy.full_like operator in py2 cannot handle shape like (5, 0, 3) properly
+    fill_values = [0, 1, 2, 3, 4, 5, 6, True, False]
+    flags = [True, False]
+    for fill_value, dtype, shape, hybridize in itertools.product(
+        fill_values, dtypes, shapes, flags):
+        param_dtype = onp.random.choice(dtypes)
+        a = np.random.uniform(low=0, high=100, size=shape, dtype='float64').astype(dtype)
+        test = TestFullLike(fill_value, param_dtype, npx.current_device())
+        expected_ret = onp.full_like(a.asnumpy(), fill_value=fill_value, dtype=param_dtype)
+        if hybridize:
+            test.hybridize()
+        ret = test(a)
+        assert_almost_equal(ret.asnumpy(), expected_ret, rtol=1e-3, atol=1e-5)
+
+        # check imperative again
+        ret = np.full_like(a, fill_value, param_dtype)
+        assert_almost_equal(ret.asnumpy(), expected_ret, rtol=1e-3, atol=1e-5)
+
+
+@use_np
+def test_np_roll():
+    class TestRoll(HybridBlock):
+        def __init__(self, shift=None, axis=None):
+            super(TestRoll, self).__init__()
+            self._shift = shift
+            self._axis = axis
+
+        def forward(self, x):
+            return np.roll(x, shift=self._shift, axis=self._axis)
+
+    dtypes = ['int32', 'int64', 'float16', 'float32', 'float64']
+    configs = [
+        ((), (3,), None),
+        ((1,), (-3,), None),
+        ((20,), (-3,), None),
+        ((3,), (2,), 0),
+        ((2, 3, 4), (12,), (1,)),
+        ((2, 3, 4), (10, -10), (0, 1)),
+        ((2, 3, 4, 5), (0, 1), (-1, 2)),
+        ((2, 3, 0, 1), (0, 1), (-1, 2)),
+        ((2, 3, 4, 5), 10, (0, 2)),
+    ]
+    i_dtype = {"float32" : onp.float32,
+               "float64" : onp.float64
+               }
+    for dtype in dtypes:
+        for config in configs:
+            for hybridize in [False, True]:
+                shape, shift, axis = config[0], config[1], config[2]
+                x = rand_ndarray(shape=shape, dtype=dtype).as_np_ndarray()
+                net = TestRoll(shift=shift, axis=axis)
+                np_out = onp.roll(x.asnumpy(), shift=shift, axis=axis)
+                if hybridize:
+                    net.hybridize()
+                x.attach_grad()
+                with mx.autograd.record():
+                    mx_out = net(x)
+                assert mx_out.shape == np_out.shape
+                mx_out.backward()
+                assert same(mx_out.asnumpy(), np_out)
+                assert same(x.grad.shape, x.shape)
+                assert same(x.grad.asnumpy(), onp.ones(shape))
+
+                # test imperativen
+                np_out = onp.roll(x.asnumpy(), shift=shift, axis=axis)
+                mx_out = np.roll(x, shift=shift, axis=axis)
+                assert same(mx_out.asnumpy(), np_out)
+
+                # test numeric
+                if dtype in ['float32', 'float64'] and len(shape)> 0 and  onp.prod(shape) > 0:
+                    x_sym = mx.sym.Variable("x").as_np_ndarray()
+                    mx_sym = mx.sym.np.roll(x_sym, shift=shift, axis=axis).as_nd_ndarray()
+                    check_numeric_gradient(mx_sym, [x.as_nd_ndarray()],
+                                           numeric_eps=1e-3, rtol=1e-3, atol=1e-5, dtype=i_dtype[dtype])
+
+
+@use_np
+def test_np_trace():
+    class TestTrace(HybridBlock):
+        def __init__(self, axis1, axis2, offset):
+            super(TestTrace, self).__init__()
+            self._axis1 = axis1
+            self._axis2 = axis2
+            self._offset = offset
+
+        def forward(self, data):
+            return np.trace(data, axis1=self._axis1, axis2=self._axis2, offset=self._offset)
+
+    def g(data, axis1, axis2, offset):
+        idx = onp.indices(data.shape)
+        ret = onp.zeros_like(data)
+        ret[idx[axis1] + offset == idx[axis2]] = 1.0
+        return ret
+
+    shapes = [
+        (3, 3),
+        (3, 4),
+        (0, 0),
+        (3, 3, 3),
+        (0, 0, 0),
+        (2, 2, 4, 3),
+        (2, 2, 4, 3),
+        (2, 0, 3, 0),
+        (2, 0, 2, 3)
+    ]
+    offsets = range(-5, 5)
+    dtypes = ['int32', 'float16', 'float32', 'float64']
+    for hybridize in [True, False]:
+        for shape in shapes:
+            ndim = len(shape)
+            for axis1 in range(-ndim, ndim):
+                for axis2 in range(-ndim, ndim):
+                    if (axis1 + ndim) % ndim != (axis2 + ndim) % ndim:
+                        for offset in offsets:
+                            for dtype in dtypes:
+                                if dtype == 'float16':
+                                    rtol = atol = 1e-2
+                                else:
+                                    rtol = atol = 1e-5
+                                test_trace = TestTrace(axis1, axis2, offset)
+                                if hybridize:
+                                    test_trace.hybridize()
+                                data_np = onp.random.uniform(-10.0, 10.0, shape)
+                                data = mx.nd.array(data_np, dtype=dtype)
+                                data_np = data.asnumpy()
+                                data.attach_grad()
+                                expected_np = onp.trace(data_np, axis1=axis1, axis2=axis2, offset=offset)
+                                with mx.autograd.record():
+                                    out_mx = test_trace(data.as_np_ndarray())
+                                assert out_mx.shape == expected_np.shape
+                                assert_almost_equal(out_mx.asnumpy(), expected_np, rtol=rtol, atol=atol)
+                                out_mx.backward()
+                                backward_expected = g(data_np, axis1=axis1, axis2=axis2, offset=offset)
+                                assert_almost_equal(data.grad.asnumpy(), backward_expected, rtol=rtol, atol=atol)
+
+                                # Test imperative once again
+                                data = mx.nd.array(data_np, dtype=dtype)
+                                out_mx = np.trace(data.as_np_ndarray(), axis1=axis1, axis2=axis2, offset=offset)
+                                assert_almost_equal(out_mx.asnumpy(), expected_np, rtol=rtol, atol=atol)
+
+    # bad params
+    params = [
+        ([], 0, 1, 0),
+        ([2], 0, 1, 0),
+        ([3, 2, 2], 1, 1, 1),
+        ([3, 2, 2], 0, -4, 1)
+    ]
+    for shape, axis1, axis2, offset in params:
+        data_np = onp.random.uniform(-1.0, 1.0, shape)
+        data_mx = mx.nd.array(data_np)
+        try:
+            output = np.trace(data_mx.as_np_ndarray(), axis1=axis1, axis2=axis2, offset=offset)
+        except mx.base.MXNetError:
+            continue
+        assert False
+
+
+@use_np
+def test_np_flip():
+    class TestFlip(HybridBlock):
+        def __init__(self, axis):
+            super(TestFlip, self).__init__()
+            self.axis = axis
+
+        def forward(self, x):
+            return np.flip(x, self.axis)
+
+    shapes = [(1, 2, 3), (1, 0), ()]
+    types = ['int32', 'int64', 'float16', 'float32', 'float64']
+    for hybridize in [True, False]:
+        for oneType in types:
+            rtol, atol=1e-3, 1e-5
+            for shape in shapes:
+                axis = random.randint(-len(shape), len(shape))
+                if axis == len(shape):
+                    axis = None
+                test_flip = TestFlip(axis)
+                if hybridize:
+                    test_flip.hybridize()
+                x = rand_ndarray(shape, dtype=oneType).as_np_ndarray()
+                x.attach_grad()
+                np_out = onp.flip(x.asnumpy(), axis)
+                with mx.autograd.record():
+                    mx_out = test_flip(x)
+                assert mx_out.shape == np_out.shape
+                assert_almost_equal(mx_out.asnumpy(), np_out, rtol=rtol, atol=atol)
+                mx_out.backward()
+                np_backward = onp.ones(np_out.shape)
+                assert_almost_equal(x.grad.asnumpy(), np_backward, rtol=rtol, atol=atol)
+
+                # Test imperative once again
+                mx_out = np.flip(x, axis)
+                np_out = onp.flip(x.asnumpy(), axis)
+                assert_almost_equal(mx_out.asnumpy(), np_out, rtol=rtol, atol=atol)
+
+
+@use_np
+def test_np_flipud_fliplr():
+    class TestFlipud(HybridBlock):
+        def __init__(self):
+            super(TestFlipud, self).__init__()
+
+        def forward(self, x):
+            return np.flipud(x)
+
+    class TestFliplr(HybridBlock):
+        def __init__(self):
+            super(TestFliplr, self).__init__()
+
+        def forward(self, x):
+            return np.fliplr(x)
+
+    shapes = [(1, 2, 3), (1, 0)]
+    types = ['int32', 'int64', 'float16', 'float32', 'float64']
+    for func in ['flipud', 'fliplr']:
+        for hybridize in [True, False]:
+            for oneType in types:
+                rtol, atol=1e-3, 1e-5
+                for shape in shapes:
+                    if func == 'flipud':
+                        test_flip = TestFlipud()
+                    else:
+                        test_flip = TestFliplr()
+                    if hybridize:
+                        test_flip.hybridize()
+                    x = rand_ndarray(shape, dtype=oneType).as_np_ndarray()
+                    x.attach_grad()
+                    if func == 'flipud':
+                        np_out = onp.flipud(x.asnumpy())
+                    else:
+                        np_out = onp.fliplr(x.asnumpy())
+                    with mx.autograd.record():
+                        mx_out = test_flip(x)
+                    assert mx_out.shape == np_out.shape
+                    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=rtol, atol=atol)
+                    mx_out.backward()
+                    np_backward = onp.ones(np_out.shape)
+                    assert_almost_equal(x.grad.asnumpy(), np_backward, rtol=rtol, atol=atol)
+
+                    # Test imperative once again
+                    if func == 'flipud':
+                        mx_out = np.flipud(x)
+                        np_out = onp.flipud(x.asnumpy())
+                    else:
+                        mx_out = np.fliplr(x)
+                        np_out = onp.fliplr(x.asnumpy())
+                    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=rtol, atol=atol)
+
+
+@use_np
+@pytest.mark.flaky
+def test_np_around():
+    class TestAround(HybridBlock):
+        def __init__(self, decimals):
+            super(TestAround, self).__init__()
+            self.decimals = decimals
+
+        def forward(self, x):
+            return np.around(x, self.decimals)
+
+    shapes = [(), (1, 2, 3), (1, 0)]
+    types = ['int32', 'int64', 'float32', 'float64']
+    for hybridize in [True, False]:
+        for oneType in types:
+            rtol, atol = 1e-3, 1e-5
+            for shape in shapes:
+                for d in range(-5, 6):
+                    test_around = TestAround(d)
+                    if hybridize:
+                        test_around.hybridize()
+                    x = rand_ndarray(shape, dtype=oneType).as_np_ndarray()
+                    np_out = onp.around(x.asnumpy(), d)
+                    mx_out = test_around(x)
+                    assert mx_out.shape == np_out.shape
+                    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=rtol, atol=atol)
+
+                    mx_out = np.around(x, d)
+                    np_out = onp.around(x.asnumpy(), d)
+                    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=rtol, atol=atol)
+
+
+@use_np
+def test_np_round():
+    class TestRound(HybridBlock):
+        def __init__(self, func, decimals):
+            super(TestRound, self).__init__()
+            self.func = func
+            self.decimals = decimals
+
+        def forward(self, x):
+            return getattr(np, self.func)(x, self.decimals)
+
+    shapes = [(), (1, 2, 3), (1, 0)]
+    types = ['int32', 'int64', 'float32', 'float64']
+    funcs = ['round', 'round_']
+    for hybridize, oneType, func in itertools.product([True, False], types, funcs):
+        rtol, atol = 1e-3, 1e-5
+        for shape in shapes:
+            for d in range(-5, 6):
+                test_round = TestRound(func, d)
+                if hybridize:
+                    test_round.hybridize()
+                x = rand_ndarray(shape, dtype=oneType).as_np_ndarray()
+                np_out = getattr(onp, func)(x.asnumpy(), d)
+                mx_out = test_round(x)
+                assert mx_out.shape == np_out.shape
+                assert_almost_equal(mx_out.asnumpy(), np_out, rtol=rtol, atol=atol)
+
+                mx_out = getattr(mx.np, func)(x, d)
+                np_out = getattr(onp, func)(x.asnumpy(), d)
+                assert_almost_equal(mx_out.asnumpy(), np_out, rtol=rtol, atol=atol)
+
+
+@use_np
+def test_np_flatnonzero():
+    class TestFlatnonzero(HybridBlock):
+        def __init__(self):
+            super(TestFlatnonzero, self).__init__()
+
+        def forward(self, a):
+            return np.flatnonzero(a)
+
+    shapes = [(1,), (4, 3), (4, 5), (2, 1), (6, 5, 6), (4, 2, 1, 2),
+              (5, 1, 3, 3), (3, 3, 1, 0),]
+    types = ['int32', 'int64', 'float32', 'float64']
+    hybridizes = [True, False]
+    for hybridize, oneType, shape in itertools.product(hybridizes, types, shapes):
+        rtol, atol = 1e-3, 1e-5
+        test_flatnonzero = TestFlatnonzero()
+        if hybridize:
+            test_flatnonzero.hybridize()
+        x = rand_ndarray(shape, dtype=oneType).as_np_ndarray()
+        np_out = onp.flatnonzero(x.asnumpy())
+        mx_out = test_flatnonzero(x)
+        assert mx_out.shape == np_out.shape
+        assert_almost_equal(mx_out.asnumpy(), np_out, rtol=rtol, atol=atol)
+
+        mx_out = np.flatnonzero(x)
+        np_out = onp.flatnonzero(x.asnumpy())
+        assert_almost_equal(mx_out.asnumpy(), np_out, rtol=rtol, atol=atol)
+
+
+@use_np
+def test_np_nonzero():
+    class TestNonzero(HybridBlock):
+        def __init__(self):
+            super(TestNonzero, self).__init__()
+
+        def forward(self, x):
+            return npx.nonzero(x)
+
+    types = ['int32', 'int64', 'float64', 'float32', 'float16']
+    for hybridize in [True, False]:
+        for shape in [(), (1, 2, 3), (1, 0)]:
+            for oneType in types:
+                rtol, atol = 1e-3, 1e-5
+                test_nonzero = TestNonzero()
+                if hybridize:
+                    test_nonzero.hybridize()
+                x = rand_ndarray(shape, dtype=oneType).as_np_ndarray()
+                np_out = onp.nonzero(x.asnumpy())
+                np_out = onp.transpose(np_out)
+                mx_out = test_nonzero(x)
+                assert mx_out.shape == np_out.shape
+                assert_almost_equal(mx_out.asnumpy(), np_out, rtol, atol)
+
+                # Test imperative once again
+                mx_out = npx.nonzero(x)
+                np_out = onp.nonzero(x.asnumpy())
+                np_out = onp.transpose(np_out)
+                assert_almost_equal(mx_out.asnumpy(), np_out, rtol, atol)
+
+
+@use_np
+def test_np_unique():
+    class TestUnique(HybridBlock):
+        def __init__(self, return_index=False, return_inverse=False, return_counts=False, axis=None):
+            super(TestUnique, self).__init__()
+            self._return_index = return_index
+            self._return_inverse = return_inverse
+            self._return_counts = return_counts
+            self._axis = axis
+
+        def forward(self, a):
+            return np.unique(a, self._return_index, self._return_inverse, self._return_counts, self._axis)
+
+    configs = [
+        ((), True, True, True, None),
+        ((1, ), True, True, True, -1),
+        ((5, ), False, False, False, 0),
+        ((5, ), True, False, False, 0),
+        ((5, ), True, True, False, 0),
+        ((5, ), True, True, True, 0),
+        ((5, ), True, True, True, None),
+        ((5, 4), True, True, True, None),
+        ((5, 4), True, True, True, -1),
+        ((5, 0, 4), True, True, True, None),
+        ((0, 0, 0), True, True, True, None),
+        # ((5, 3, 4), True, True, True, -1), # waiting for numpy 1.18, details in pr 14255
+        ((5, 3, 4), True, True, True, None),
+        ((5, 3, 4), True, True, True, 1),
+    ]
+    for dtype in ['float32', 'float64', 'int8', 'uint8', 'int32', 'int64']:
+        for hybridize in [False, True]:
+            for config in configs:
+                test_unique = TestUnique(*config[1:])
+                if hybridize:
+                    test_unique.hybridize()
+                x = onp.random.uniform(-8.0, 8.0, size=config[0])
+                x = np.array(x, dtype=dtype)
+                np_out = onp.unique(x.asnumpy(), *config[1:])
+                mx_out = test_unique(x)
+                if (len(mx_out)) == 1:
+                    assert mx_out.shape == np_out.shape
+                    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+                else:
+                    for i in range(len(mx_out)):
+                        assert mx_out[i].shape == np_out[i].shape
+                        assert_almost_equal(mx_out[i].asnumpy(), np_out[i], rtol=1e-3, atol=1e-5)
+
+                # Test imperative once again
+                mx_out = np.unique(x, *config[1:])
+                np_out = onp.unique(x.asnumpy(), *config[1:])
+                if (len(mx_out)) == 1:
+                    assert mx_out.shape == np_out.shape
+                    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+                else:
+                    for i in range(len(mx_out)):
+                        assert mx_out[i].shape == np_out[i].shape
+                        assert_almost_equal(mx_out[i].asnumpy(), np_out[i], rtol=1e-3, atol=1e-5)
+
+
+@use_np
+def test_np_take():
+    configs = [
+        ((4, 4), (4, 0), None),
+        ((4, 4), (4, 0), 0),
+        ((4, 4), (4, 0), 1),
+        ((), (4, 0), None),
+        ((), (5, ), None),
+        ((), (4, 5), None),
+        ((), (), None),
+        ((3, 4), (), None),
+        ((3, 4), (), 0),
+        ((3, 4), (), 1),
+        ((3, 4, 5), (), 2),
+        ((3, 4, 5), (), -3),
+    ]
+
+    class TestTake(HybridBlock):
+        def __init__(self, axis, mode):
+            super(TestTake, self).__init__()
+            self._axis = axis
+            self._mode = mode
+
+        def forward(self, a, indices):
+            return np.take(a, indices, axis=self._axis, mode=self._mode)
+
+    def grad_helper(grad_in, axis, idx, mode):
+        k = 1 if axis == None else grad_in.shape[axis]
+        if mode == 'clip':
+            idx = 0 if idx < 0 else idx
+            idx = k - 1 if idx >= k else idx
+        else:
+            idx = idx % k
+
+        if axis == None:
+            if grad_in.shape == ():
+                grad_in += 1.0
+            else:
+                grad_in[idx] += 1.0
+        elif axis == 0:
+            if axis == len(grad_in.shape) - 1:
+                grad_in[idx] += 1.0
+            else:
+                grad_in[idx, :] += 1.0
+        elif axis == 1:
+            if axis == len(grad_in.shape) - 1:
+                grad_in[:, idx] += 1.0
+            else:
+                grad_in[:, idx, :] += 1.0
+        elif axis == 2:
+            if axis == len(grad_in.shape) - 1:
+                grad_in[:, :, idx] += 1.0
+            else:
+                grad_in[:, :, idx, :] += 1.0
+        elif axis == 3:
+            if axis == len(grad_in.shape) - 1:
+                grad_in[:, :, :, idx] += 1.0
+            else:
+                grad_in[:, :, :, idx, :] += 1.0
+        elif axis == 4:
+            grad_in[:, :, :, :, idx] += 1.0
+        else:
+            raise ValueError("axis %d is not supported..." % axis)
+
+    def check_output_n_grad(data_shape, idx_shape, axis, mode):
+        data_real = onp.random.normal(size=data_shape).astype('float32')
+        idx_real = onp.random.randint(low=-100, high=100, size=idx_shape)
+
+        assert same(np.take(np.array(data_real), np.array(idx_real), axis=axis, mode=mode).asnumpy(),
+             onp.take(data_real, idx_real, axis=axis, mode=mode))
+
+        grad_in = onp.zeros(data_shape, dtype='float32')
+
+        test_take = TestTake(axis=axis, mode=mode)
+        if hybridize:
+            test_take.hybridize()
+        x = np.array(data_real)
+        x.attach_grad()
+        with mx.autograd.record():
+            mx_out = test_take(x, np.array(idx_real))
+        assert same(mx_out.asnumpy(), onp.take(data_real, idx_real, axis=axis, mode=mode))
+
+        if axis and axis < 0:
+            axis += len(data_shape)
+
+        if idx_real.size != 0:
+            for i in onp.nditer(idx_real):
+                grad_helper(grad_in, axis, i, mode)
+
+
+        mx_out.backward()
+        same(x.grad.asnumpy(), grad_in)
+
+    for hybridize in [True, False]:
+        for mode in ['clip', 'wrap']:
+            for data_ndim in range(1, 5):
+                for idx_ndim in range(1, 4):
+                    for axis in range(-data_ndim, data_ndim):
+                        data_shape = ()
+                        for _ in range(data_ndim):
+                            data_shape += (onp.random.randint(low=1, high=5), )
+                        idx_shape = ()
+                        for _ in range(idx_ndim):
+                            idx_shape += (onp.random.randint(low=1, high=5), )
+                        check_output_n_grad(data_shape, idx_shape, axis, mode)
+
+            for config in configs:
+                check_output_n_grad(config[0], config[1], config[2], mode)
+
+
+@use_np
+def test_np_moveaxis():
+    class TestMoveaxis(HybridBlock):
+        def __init__(self, source=None, destination=None):
+            super(TestMoveaxis, self).__init__()
+            self._source = source
+            self._destination= destination
+
+        def forward(self, x):
+            return np.moveaxis(x, source=self._source, destination=self._destination)
+
+    dtypes = ['int32', 'int64', 'float16', 'float32', 'float64']
+    for hybridize in [False, True]:
+        for dtype in dtypes:
+            for ndim in [0, 1, 2, 3, 4, 5, 6]:
+                shape = rand_shape_nd(ndim, dim=5, allow_zero_size=True)
+                np_data = onp.random.uniform(low=-100, high=100, size=shape).astype(dtype)
+                mx_data = np.array(np_data, dtype=dtype)
+                axis = [i for i in range(ndim)]
+                random.shuffle(axis)
+                for i in range(ndim):
+                    source = random.sample(axis, i)
+                    destination = random.sample(axis, i)
+
+                    # test gluon
+                    test_moveaxis = TestMoveaxis(source,destination)
+                    if hybridize:
+                        test_moveaxis.hybridize()
+                    np_out = onp.moveaxis(np_data, source=source, destination=destination)
+                    mx_data.attach_grad()
+                    with mx.autograd.record():
+                        mx_out = test_moveaxis(mx_data)
+                    assert mx_out.shape == np_out.shape
+                    mx_out.backward()
+                    assert same(mx_data.grad.shape, mx_data.shape)
+                    assert same(mx_data.grad.asnumpy(), onp.ones(shape))
+                    # test imperative
+                    np_out = onp.moveaxis(np_data, source=source, destination=destination)
+                    mx_out = np.moveaxis(mx_data, source=source, destination= destination)
+                    assert np_out.dtype == mx_out.dtype
+                    assert same(mx_out.asnumpy(), np_out)
+
+
+@use_np
+def test_np_rot90():
+    class TestTRot90(HybridBlock):
+        def __init__(self, k=1, axes=(0, 1)):
+            super(TestTRot90, self).__init__()
+            self._k = k
+            self._axes = axes
+
+        def forward(self, a, *args):
+            return np.rot90(a, self._k, self._axes)
+
+    configs = [
+        ((2, 3), 1, (0, 1)),
+        ((2, 3), 3, (0, 1)),
+        ((2, 3), 1, (1, 0)),
+        ((2, 3), 2, (1, 0)),
+        ((2, 3), 3, (1, 0)),
+        ((2, 3), 0, (1, 0)),
+        ((2, 3, 4, 5), 3, (1, 2)),
+        ((2, 3, 4, 5), -3, (2, 3)),
+        ((2, 3, 0, 5), -2, (2, 3)),
+        ((2, 0, 0, 5), -3, (2, 3)),
+        ((2, 3, 0, 5), 0, (2, 1)),
+    ]
+    dtypes = ['uint8', 'int8', 'int32', 'int64', 'float16', 'float32', 'float64']
+
+    for config in configs:
+        for dtype in dtypes:
+            for hybridize in [True, False]:
+                shape, k, axes = config[0], config[1], config[2]
+                x = rand_ndarray(shape=shape, dtype=dtype).as_np_ndarray()
+                net = TestTRot90(k=k, axes=axes)
+                if hybridize:
+                    net.hybridize()
+
+                x.attach_grad()
+                np_out = onp.rot90(x.asnumpy(), k=k, axes=axes)
+                with mx.autograd.record():
+                    mx_out = net(x)
+                assert mx_out.shape == np_out.shape
+                assert same(mx_out.asnumpy(), np_out)
+                mx_out.backward()
+                np_backward = onp.ones(shape, dtype)
+
+                assert same(x.grad.asnumpy().shape, np_backward.shape)
+                assert same(x.grad.asnumpy(), np_backward)
+
+                np_out = onp.rot90(x.asnumpy(), k=k, axes=axes)
+                mx_out = np.rot90(x, k=k, axes=axes)
+                assert same(mx_out.asnumpy(), np_out)
+
+
+@use_np
+def test_np_hsplit():
+    class TestHSplit(HybridBlock):
+        def __init__(self, indices_or_sections):
+            super(TestHSplit, self).__init__()
+            self._indices_or_sections = indices_or_sections
+
+        def forward(self, a, *args, **kwargs):
+            return np.hsplit(a, indices_or_sections=self._indices_or_sections)
+
+    shapes = [
+        (10,),
+        (3, 8, 5),
+        (3, 0, 5),
+        (3, 8, 5, 6),
+        (3, 0, 5, 6),
+    ]
+    indices_or_sections_num = [
+        (2, 4),
+        (3, 3),
+        (3,),
+        (1,),
+        2,
+    ]
+    for hybridize in [True, False]:
+        for shape in shapes:
+            for indices_or_sections in indices_or_sections_num:
+                # test gluon
+                test_hsplit = TestHSplit(indices_or_sections=indices_or_sections)
+                if hybridize:
+                    test_hsplit.hybridize()
+
+                a = mx.nd.random.uniform(-1.0, 1.0, shape=shape).as_np_ndarray()
+                a.attach_grad()
+                expected_ret = onp.hsplit(a.asnumpy(), indices_or_sections=indices_or_sections)
+                with mx.autograd.record():
+                    y = test_hsplit(a)
+                assert len(y) == len(expected_ret)
+                for mx_out, np_out in zip(y, expected_ret):
+                    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+                mx.autograd.backward(y)
+                assert_almost_equal(a.grad.asnumpy(), onp.ones(a.shape), rtol=1e-3, atol=1e-5)
+
+                # test imperative
+                mx_outs = np.hsplit(a, indices_or_sections=indices_or_sections)
+                np_outs = onp.hsplit(a.asnumpy(), indices_or_sections=indices_or_sections)
+                for mx_out, np_out in zip(mx_outs, np_outs):
+                    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+
+
+@use_np
+def test_np_dsplit():
+    class TestDSplit(HybridBlock):
+        def __init__(self, indices_or_sections):
+            super(TestDSplit, self).__init__()
+            self._indices_or_sections = indices_or_sections
+
+        def forward(self, a, *args, **kwargs):
+            return np.dsplit(a, indices_or_sections=self._indices_or_sections)
+
+    shapes = [
+        (2, 4, 6),
+        (3, 0, 6),
+        (2, 3, 0, 4),
+    ]
+    indices_or_sections_num = [
+        (2, 4),
+        (3, 3),
+        (3,),
+        (1,),
+        2,
+    ]
+    for hybridize in [True, False]:
+        for shape in shapes:
+            for indices_or_sections in indices_or_sections_num:
+                # test gluon
+                test_dsplit = TestDSplit(indices_or_sections=indices_or_sections)
+                if hybridize:
+                    test_dsplit.hybridize()
+
+                a = mx.nd.random.uniform(-1.0, 1.0, shape=shape).as_np_ndarray()
+                a.attach_grad()
+                expected_ret = onp.dsplit(a.asnumpy(), indices_or_sections=indices_or_sections)
+                with mx.autograd.record():
+                    y = test_dsplit(a)
+                assert len(y) == len(expected_ret)
+                for mx_out, np_out in zip(y, expected_ret):
+                    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+                mx.autograd.backward(y)
+                assert_almost_equal(a.grad.asnumpy(), onp.ones(a.shape), rtol=1e-3, atol=1e-5)
+
+                # test imperative
+                mx_outs = np.dsplit(a, indices_or_sections=indices_or_sections)
+                np_outs = onp.dsplit(a.asnumpy(), indices_or_sections=indices_or_sections)
+                for mx_out, np_out in zip(mx_outs, np_outs):
+                    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+
+
+@use_np
+def test_np_column_stack():
+    class TestColumnStack(HybridBlock):
+        def __init__(self):
+            super(TestColumnStack, self).__init__()
+
+        def forward(self, a, *args):
+            return np.column_stack([a] + list(args))
+
+    def g(data):
+        return onp.ones_like(data)
+
+    configs = [
+        ((), (), ()),
+        ((2), (2), (2)),
+        ((0), (0), (0)),
+        ((0, 3, 0), (0, 0, 0), (0, 1, 0)),
+        ((2, 2), (2, 1), (2, 3)),
+        ((4, 3), (4, 0), (4, 1)),
+        ((2, 2, 2), (2, 4, 2), (2, 2, 2)),
+        ((0, 1, 1), (0, 1, 1), (0, 1, 1))
+    ]
+    types = ['float16', 'float32', 'float64', 'int8', 'int32', 'int64']
+    for config, hybridize, dtype in itertools.product(configs, [True, False], types):
+        test_column_stack = TestColumnStack()
+        if hybridize:
+            test_column_stack.hybridize()
+        rtol = 1e-3
+        atol = 1e-5
+        v = []
+        v_np = []
+        for i in range(3):
+            v_np.append(onp.array(onp.random.uniform(-10.0, 10.0, config[i]), dtype=dtype))
+            v.append(mx.nd.array(v_np[i]).as_np_ndarray())
+            v[i].attach_grad()
+        expected_np = onp.column_stack(v_np)
+        with mx.autograd.record():
+            mx_out = test_column_stack(*v)
+        assert mx_out.shape == expected_np.shape
+        assert_almost_equal(mx_out.asnumpy(), expected_np, rtol=rtol, atol=atol)
+
+        # Test gradient
+        mx_out.backward()
+        for i in range(3):
+            expected_grad = g(v_np[i])
+            assert_almost_equal(v[i].grad.asnumpy(), expected_grad, rtol=rtol, atol=atol)
+
+        # Test imperative once again
+        mx_out = np.column_stack(v)
+        expected_np = onp.column_stack(v_np)
+        assert_almost_equal(mx_out.asnumpy(), expected_np, rtol=rtol, atol=atol)
+
+
+@use_np
+def test_np_vstack():
+    class TestVstack(HybridBlock):
+        def __init__(self):
+            super(TestVstack, self).__init__()
+
+        def forward(self, a, *args):
+            return np.vstack([a] + list(args))
+
+    def g(data):
+        return onp.ones_like(data)
+
+    configs = [
+        ((), (), ()),
+        ((2), (2), (2)),
+        ((0), (0), (0)),
+        ((2, 2), (3, 2), (0, 2)),
+        ((2, 3), (1, 3), (4, 3)),
+        ((2, 2, 2), (3, 2, 2), (1, 2, 2)),
+        ((0, 1, 1), (4, 1, 1), (5, 1, 1)),
+        ((2), (0, 2), (2, 2))
+    ]
+    types = ['float16', 'float32', 'float64', 'int8', 'int32', 'int64']
+    for config in configs:
+        for hybridize in [True, False]:
+            for dtype in types:
+                test_vstack = TestVstack()
+                if hybridize:
+                    test_vstack.hybridize()
+                rtol = 1e-3
+                atol = 1e-5
+                v = []
+                v_np = []
+                for i in range(3):
+                    v_np.append(onp.array(onp.random.uniform(-10.0, 10.0, config[i]), dtype=dtype))
+                    v.append(mx.nd.array(v_np[i]).as_np_ndarray())
+                    v[i].attach_grad()
+                expected_np = onp.vstack(v_np)
+                with mx.autograd.record():
+                    mx_out = test_vstack(*v)
+                assert mx_out.shape == expected_np.shape
+                assert_almost_equal(mx_out.asnumpy(), expected_np, rtol=rtol, atol=atol)
+
+                # Test gradient
+                mx_out.backward()
+                for i in range(3):
+                    expected_grad = g(v_np[i])
+                    assert_almost_equal(v[i].grad.asnumpy(), expected_grad, rtol=rtol, atol=atol)
+
+                # Test imperative once again
+                mx_out = np.vstack(v)
+                expected_np = onp.vstack(v_np)
+                assert_almost_equal(mx_out.asnumpy(), expected_np, rtol=rtol, atol=atol)
+
+
+@use_np
+def test_np_true_divide():
+    shapes = [
+        [()],
+        [(0,)],
+        [(2, 0, 3)],
+        [(0, 0, 0)],
+        [(10,)],
+        [(3, 4)],
+        [(2, 3, 4)],
+        [(2, 3, 4, 5)],
+        [(2, 3, 4, 5, 6)],
+        [(0,), (0,)],
+        [(0,), (1,)],
+        [(2, 0, 3), (1, 1)],
+        [(), (2, 3)],
+        [(2, 3), ()],
+        [(2, 3, 1), (1, 4)],
+        [(2, 1, 4, 1), (3, 1, 5)],
+    ]
+    dtypes = [np.bool, np.int8, np.uint8, np.int32, np.int64, np.float16, np.float32, np.float64]
+    itypes = [np.bool, np.int8, np.uint8, np.int32, np.int64]
+    ftypes = [np.float16, np.float32, np.float64]
+    for shape_pair, dtype in itertools.product(shapes, dtypes):
+        a = np.random.uniform(3, 50, size=shape_pair[0]).astype(dtype)
+        b = np.random.uniform(3, 50, size=shape_pair[-1]).astype(dtype)
+        out_mx = a / b
+        if onp.issubdtype(dtype, onp.integer) or (dtype is np.bool):
+            assert out_mx.dtype == np.float32
+        else:
+            assert out_mx.dtype == dtype
+        out_np = onp.true_divide(a.asnumpy(), b.asnumpy())
+        assert_almost_equal(out_mx.asnumpy(), out_np, rtol=1e-3, atol=1e-3, use_broadcast=False)
+
+        val = onp.random.randint(3, 50)
+        out_mx = a / val
+        out_np = onp.true_divide(a.asnumpy(), val)
+        assert_almost_equal(out_mx.asnumpy(), out_np, rtol=1e-3, atol=1e-3, use_broadcast=False)
+
+        out_mx = val / a
+        out_np = onp.true_divide(val, a.asnumpy())
+        assert_almost_equal(out_mx.asnumpy(), out_np, rtol=1e-3, atol=1e-3, use_broadcast=False)
+
+    for shape_pair, itype, ftype in itertools.product(shapes, itypes, ftypes):
+        i_ = np.random.uniform(3, 50, size=shape_pair[0]).astype(itype)
+        f_ = np.random.uniform(3, 50, size=shape_pair[-1]).astype(ftype)
+
+        out_mx = i_ / f_
+        assert out_mx.dtype == ftype
+        out_np = onp.true_divide(i_.asnumpy(), f_.asnumpy())
+        assert_almost_equal(out_mx.asnumpy(), out_np, rtol=1e-3, atol=1e-3, use_broadcast=False)
+
+        out_mx = f_ / i_
+        assert out_mx.dtype == ftype
+        out_np = onp.true_divide(f_.asnumpy(), i_.asnumpy())
+        assert_almost_equal(out_mx.asnumpy(), out_np, rtol=1e-3, atol=1e-3, use_broadcast=False)
+
+
+def test_np_median():
+    class TestMedian(HybridBlock):
+        def __init__(self, axis=None, keepdims=False):
+            super(TestMedian, self).__init__()
+            self._axis = axis
+            self._keepdims = keepdims
+
+        def forward(self, a):
+            return np.median(a, axis=self._axis, keepdims=self._keepdims)
+
+    flags = [True, False]
+    dtypes = ['float16', 'float32', 'float64']
+    qtypes = ['float32', 'float64']
+    tensor_shapes = [
+        ((2, 3), None),
+        ((2, 3, 4, 5), 3),
+        ((2, 3, 4), (0, 2)),
+        ((2, 3, 4), 1)
+    ]
+
+    for hybridize, keepdims, (a_shape, axis), dtype in \
+        itertools.product(flags, flags, tensor_shapes, dtypes):
+        atol = 3e-4 if dtype == 'float16' else 1e-4
+        rtol = 3e-2 if dtype == 'float16' else 1e-2
+        test_median = TestMedian(axis=axis, keepdims=keepdims)
+        if hybridize:
+            test_median.hybridize()
+        a = np.random.uniform(-1.0, 1.0, size=a_shape)
+        np_out = onp.median(a.asnumpy(), axis=axis, keepdims=keepdims)
+        mx_out = test_median(a)
+
+        assert mx_out.shape == np_out.shape
+        assert_almost_equal(mx_out.asnumpy(), np_out, atol=atol, rtol=rtol)
+
+        mx_out = np.median(a, axis=axis, keepdims=keepdims)
+        np_out = onp.median(a.asnumpy(), axis=axis, keepdims=keepdims)
+
+        assert_almost_equal(mx_out.asnumpy(), np_out, atol=atol, rtol=rtol)
+
+
+@use_np
+def test_np_quantile():
+    class TestQuantile(HybridBlock):
+        def __init__(self, axis=None, interpolation='linear', keepdims=False):
+            super(TestQuantile, self).__init__()
+            self._axis = axis
+            self._interpolation = interpolation
+            self._keepdims = keepdims
+
+        def forward(self, a, q):
+            return np.quantile(a, q, axis=self._axis, interpolation=self._interpolation, keepdims=self._keepdims)
+
+    class TestQuantileScalar(HybridBlock):
+        def __init__(self, q=None, axis=None, interpolation='linear', keepdims=False):
+            super(TestQuantileScalar, self).__init__()
+            self._q = q
+            self._axis = axis
+            self._interpolation = interpolation
+            self._keepdims = keepdims
+
+        def forward(self, a):
+            return np.quantile(a, self._q, axis=self._axis, interpolation=self._interpolation, keepdims=self._keepdims)
+
+    flags = [True, False]
+    interpolation_options = ['linear', 'lower', 'higher', 'nearest', 'midpoint']
+    dtypes = [np.int32, np.int64, np.float16, np.float32, np.float64]
+    qtypes = [np.float32, np.float64]
+    tensor_shapes = [
+        ((2, 3), (), None),
+        ((2, 3, 4, 5), (), 3),
+        ((2, 3, 4), (3,), (0, 2)),
+        ((2, 3, 4), (3,), 1)
+    ]
+    for hybridize, keepdims, q_scalar, (a_shape, q_shape, axis), interpolation, dtype in \
+        itertools.product(flags, flags, flags, tensor_shapes, interpolation_options, dtypes):
+        if dtype == np.float16 and interpolation == 'linear': continue
+        atol = 3e-4 if dtype == np.float16 else 1e-4
+        rtol = 3e-2 if dtype == np.float16 else 1e-2
+        a = np.random.uniform(-10.0, 10.0, size=a_shape).astype(dtype)
+        qtype = random.choice(qtypes)
+        q = np.random.uniform(0, 1.0, size=q_shape).astype(qtype)
+        np_q = q.asnumpy()
+        if q_scalar and q_shape == ():
+            q = q.item()
+            np_q = q
+            test_quantile = TestQuantileScalar(q=q, axis=axis, interpolation=interpolation, keepdims=keepdims)
+        else:
+            test_quantile = TestQuantile(axis=axis, interpolation=interpolation, keepdims=keepdims)
+        if hybridize:
+            test_quantile.hybridize()
+        mx_out = test_quantile(a) if (q_scalar and q_shape == ()) else test_quantile(a, q)
+        np_out = onp.quantile(a.asnumpy(), np_q, axis=axis, interpolation=interpolation, keepdims=keepdims)
+        assert mx_out.shape == np_out.shape
+        assert_almost_equal(mx_out.asnumpy(), np_out, atol=atol, rtol=rtol)
+
+        mx_out = np.quantile(a, q, axis=axis, interpolation=interpolation, keepdims=keepdims)
+        np_out = onp.quantile(a.asnumpy(), np_q, axis=axis, interpolation=interpolation, keepdims=keepdims)
+        assert_almost_equal(mx_out.asnumpy(), np_out, atol=atol, rtol=rtol)
+
+
+@use_np
+def test_np_percentile():
+    class TestPercentile(HybridBlock):
+        def __init__(self, axis=None, interpolation='linear', keepdims=False):
+            super(TestPercentile, self).__init__()
+            self._axis = axis
+            self._interpolation = interpolation
+            self._keepdims = keepdims
+
+        def forward(self, a, q):
+            return np.percentile(a, q, axis=self._axis, interpolation=self._interpolation, keepdims=self._keepdims)
+
+    class TestPercentileScalar(HybridBlock):
+        def __init__(self, q=None, axis=None, interpolation='linear', keepdims=False):
+            super(TestPercentileScalar, self).__init__()
+            self._q = q
+            self._axis = axis
+            self._interpolation = interpolation
+            self._keepdims = keepdims
+
+        def forward(self, a):
+            return np.percentile(a, self._q, axis=self._axis, interpolation=self._interpolation, keepdims=self._keepdims)
+
+    flags = [True, False]
+    interpolation_options = ['linear', 'lower', 'higher', 'nearest', 'midpoint']
+    dtypes = [np.int32, np.int64, np.float16, np.float32, np.float64]
+    qtypes = [np.float32, np.float64]
+    tensor_shapes = [
+        ((2, 3), (), None),
+        ((2, 3, 4, 5), (), 3),
+        ((2, 3, 4, 5), (), (0, 1, 2)),
+        ((2, 3, 4, 5), (), (-1, -2)),
+        ((2, 3, 4), (3,), (0, 2)),
+        ((2, 3, 4), (3,), 1)
+    ]
+    for hybridize, keepdims, q_scalar, (a_shape, q_shape, axis), interpolation, dtype in \
+        itertools.product(flags, flags, flags, tensor_shapes, interpolation_options, dtypes):
+        if dtype == np.float16 and interpolation == 'linear': continue
+        atol = 3e-4 if dtype == np.float16 else 1e-4
+        rtol = 3e-2 if dtype == np.float16 else 1e-2
+        a = np.random.uniform(-10.0, 10.0, size=a_shape).astype(dtype)
+        qtype = random.choice(qtypes)
+        q = np.random.uniform(0, 1.0, size=q_shape).astype(qtype)
+        np_q = q.asnumpy()
+        if q_scalar and q_shape == ():
+            q = q.item()
+            np_q = q
+            test_percentile = TestPercentileScalar(q=q, axis=axis, interpolation=interpolation, keepdims=keepdims)
+        else:
+            test_percentile = TestPercentile(axis=axis, interpolation=interpolation, keepdims=keepdims)
+        if hybridize:
+            test_percentile.hybridize()
+        mx_out = test_percentile(a) if (q_scalar and q_shape == ()) else test_percentile(a, q)
+        np_out = onp.percentile(a.asnumpy(), np_q, axis=axis, interpolation=interpolation, keepdims=keepdims)
+        assert mx_out.shape == np_out.shape
+        assert_almost_equal(mx_out.asnumpy(), np_out, atol=atol, rtol=rtol)
+
+        mx_out = np.percentile(a, q, axis=axis, interpolation=interpolation, keepdims=keepdims)
+        np_out = onp.percentile(a.asnumpy(), np_q, axis=axis, interpolation=interpolation, keepdims=keepdims)
+        assert_almost_equal(mx_out.asnumpy(), np_out, atol=atol, rtol=rtol)
+
+
+@use_np
+def test_np_diff():
+    def np_diff_backward(ograd, n, axis):
+        res = ograd
+        for _ in range(n):
+            res = onp.negative(onp.diff(res, n=1, axis=axis, prepend=0, append=0))
+        return res
+
+    class TestDiff(HybridBlock):
+        def __init__(self, n=1, axis=-1):
+            super(TestDiff, self).__init__()
+            self._n = n
+            self._axis = axis
+
+        def forward(self, a):
+            return np.diff(a, n=self._n, axis=self._axis)
+
+    shapes = [tuple(random.randrange(10) for i in range(random.randrange(6))) for j in range(5)]
+    for hybridize in [True, False]:
+        for shape in shapes:
+            for axis in [i for i in range(-len(shape), len(shape))]:
+                for n in [i for i in range(0, shape[axis]+1)]:
+                    test_np_diff = TestDiff(n=n, axis=axis)
+                    if hybridize:
+                        test_np_diff.hybridize()
+                    for itype in [onp.float16, onp.float32, onp.float64]:
+                        # note the tolerance shall be scaled by the input n
+                        if itype == onp.float16:
+                            rtol = atol = 1e-2*len(shape)*n
+                        else:
+                            rtol = atol = 1e-5*len(shape)*n
+                        x = rand_ndarray(shape).astype(itype).as_np_ndarray()
+                        x.attach_grad()
+                        np_out = onp.diff(x.asnumpy(), n=n, axis=axis)
+                        with mx.autograd.record():
+                            mx_out = test_np_diff(x)
+                        assert mx_out.shape == np_out.shape
+                        assert_almost_equal(mx_out.asnumpy(), np_out, rtol=rtol, atol=atol)
+                        mx_out.backward()
+                        if (np_out.size == 0):
+                            np_backward = onp.zeros(shape)
+                        else:
+                            np_backward = np_diff_backward(onp.ones(np_out.shape, dtype=itype), n=n, axis=axis)
+                        assert x.grad.shape == np_backward.shape
+                        assert_almost_equal(x.grad.asnumpy(), np_backward, rtol=rtol, atol=atol)
+
+                        mx_out = np.diff(x, n=n, axis=axis)
+                        np_out = onp.diff(x.asnumpy(), n=n, axis=axis)
+                        assert_almost_equal(mx_out.asnumpy(), np_out, rtol=rtol, atol=atol)
+
+
+@use_np
+def test_np_ediff1d():
+    def np_diff_backward(size, shape):
+        if size <= 1:
+            return onp.zeros(shape)
+        else:
+            ret = onp.ones(size - 1)
+            return onp.negative(onp.diff(ret, n=1, axis=-1, prepend=0, append=0)).reshape(shape)
+
+    # case 1: when both `to_begin` and `to_end` are arrays
+    class TestEDiff1DCASE1(HybridBlock):
+        def __init__(self):
+            super(TestEDiff1DCASE1, self).__init__()
+
+        def forward(self, a, b, c):
+            return np.ediff1d(a, to_end=b, to_begin=c)
+
+    # case 2: only `to_end` is array but `to_begin` is scalar/None
+    class TestEDiff1DCASE2(HybridBlock):
+        def __init__(self, to_begin=None):
+            super(TestEDiff1DCASE2, self).__init__()
+            self._to_begin = to_begin
+
+        def forward(self, a, b):
+            return np.ediff1d(a, to_end=b, to_begin=self._to_begin)
+
+    # case 3: only `to_begin` is array but `to_end` is scalar/None
+    class TestEDiff1DCASE3(HybridBlock):
+        def __init__(self, to_end=None):
+            super(TestEDiff1DCASE3, self).__init__()
+            self._to_end = to_end
+
+        def forward(self, a, b):
+            return np.ediff1d(a, to_end=self._to_end, to_begin=b)
+
+    # case 4: both `to_begin` and `to_end` are scalar/None
+    class TestEDiff1DCASE4(HybridBlock):
+        def __init__(self, to_end=None, to_begin=None):
+            super(TestEDiff1DCASE4, self).__init__()
+            self._to_begin = to_begin
+            self._to_end = to_end
+
+        def forward(self, a):
+            return np.ediff1d(a, to_end=self._to_end, to_begin=self._to_begin)
+
+    rtol = 1e-3
+    atol = 1e-5
+    mapper = {(True, True): TestEDiff1DCASE1,
+              (False, True): TestEDiff1DCASE2,
+              (True, False): TestEDiff1DCASE3,
+              (False, False): TestEDiff1DCASE4}
+    hybridize_list = [True, False]
+    shape_list = [(), (1,), (2, 3), 6, (7, 8), 10, (4, 0, 5)]
+    # dtype_list = [np.int32, np.int64, np.float16, np.float32, np.float64]
+    dtype_list = [np.float16, np.float32, np.float64]
+    append_list = [1, 2, None, (1, 2, 4), (4, 3), (), (5, 0), (6)]
+
+    for hybridize, dtype, shape, to_begin, to_end in itertools.product(hybridize_list, dtype_list,
+                shape_list, append_list, append_list):
+        mx_arr = np.random.randint(5, size=shape).astype(dtype)
+        np_arr = mx_arr.asnumpy()
+        kwargs = {}
+        mx_args = [mx_arr]
+        np_args = [np_arr]
+        mx_args_imperative = [mx_arr]
+
+        if isinstance(to_end, tuple):
+            to_end = np.random.randint(5, size=to_end).astype(dtype)
+            mx_args.append(to_end)
+            np_args.append(to_end.asnumpy())
+        else:
+            kwargs["to_end"] = to_end
+            np_args.append(to_end)
+        mx_args_imperative.append(to_end)
+
+        if isinstance(to_begin, tuple):
+            to_begin = np.random.randint(5, size=to_begin).astype(dtype)
+            mx_args.append(to_begin)
+            np_args.append(to_begin.asnumpy())
+        else:
+            kwargs["to_begin"] = to_begin
+            np_args.append(to_begin)
+        mx_args_imperative.append(to_begin)
+
+        from mxnet.numpy import ndarray as np_ndarray
+        input_type = (isinstance(to_begin, np_ndarray), isinstance(to_end, np_ndarray))
+        test_np_ediff1d = mapper[input_type](**kwargs)
+
+        if hybridize:
+            test_np_ediff1d.hybridize()
+
+        np_out = onp.ediff1d(*np_args)
+        for arg in mx_args:
+            arg.attach_grad()
+
+        with mx.autograd.record():
+            mx_out = test_np_ediff1d(*mx_args)
+        assert mx_out.shape == np_out.shape
+        assert_almost_equal(mx_out.asnumpy(), np_out, atol=atol, rtol=rtol)
+        # test imperative
+        mx_out_imperative = np.ediff1d(*mx_args_imperative)
+        assert mx_out_imperative.shape == np_out.shape
+        assert_almost_equal(mx_out_imperative.asnumpy(), np_out, atol=atol, rtol=rtol)
+
+        mx_out.backward()
+        if dtype in [np.float16, np.float32, np.float64]:
+            for idx, arg in enumerate(mx_args):
+                if idx == 0:
+                    assert_almost_equal(arg.grad.asnumpy(), np_diff_backward(arg.size, arg.shape), atol=atol, rtol=rtol)
+                else:
+                    assert_almost_equal(arg.grad.asnumpy(), np.ones_like(arg), atol=atol, rtol=rtol)
+
+
+@use_np
+@pytest.mark.skip(reason='Test hangs. Tracked in #18144')
+def test_np_resize():
+    class TestResize(HybridBlock):
+        def __init__(self, new_shape):
+            super(TestResize, self).__init__()
+            self._new_shape = new_shape
+
+        def forward(self, x, *args, **kwargs):
+            return np.resize(x, self._new_shape)
+
+    dtypes = [np.int8, np.uint8, np.int32, np.int64, np.float16, np.float32, np.float64, np.bool_]
+    shape_config = [
+        [(), (2, 3)],
+        [(2, 3), (2,)],
+        [(2, 3), 2],
+        [(2, 0, 1), (2, 2)],
+        [(2, 0, 1), (3, 4, 5)],
+        [((1,)), ()],
+    ]
+    flags = [True, False]
+    for dtype, shape_pair, hybridize in itertools.product(dtypes, shape_config, flags):
+        a = np.random.uniform(low=0, high=100, size=shape_pair[0], dtype='float64').astype(dtype)
+        test = TestResize(shape_pair[1])
+        if hybridize:
+            test.hybridize()
+        ret = test(a)
+        expected_ret = onp.resize(a.asnumpy(), shape_pair[1])
+        assert_almost_equal(ret.asnumpy(), expected_ret, atol=1e-5, rtol=1e-5, use_broadcast=False)
+
+        # check imperative again
+        ret = np.resize(a, shape_pair[1])
+        assert_almost_equal(ret.asnumpy(), expected_ret, atol=1e-5, rtol=1e-5, use_broadcast=False)
+
+
+@use_np
+def test_np_diag():
+    class TestDiag(HybridBlock):
+        def __init__(self, k=0):
+            super(TestDiag, self).__init__()
+            self._k = k
+
+        def forward(self, a):
+            return np.diag(a, k=self._k)
+
+    shapes = [(), (2,), (1, 5), (2, 2), (2, 5), (3, 3), (4, 3)]
+    dtypes = [np.int8, np.uint8, np.int32, np.int64, np.float16, np.float32, np.float64]
+    range_k = 6
+    combination = itertools.product([False, True], shapes, dtypes, list(range(-range_k, range_k)))
+    for hybridize, shape, dtype, k in combination:
+        rtol = 1e-2 if dtype == np.float16 else 1e-3
+        atol = 1e-4 if dtype == np.float16 else 1e-5
+        test_diag = TestDiag(k)
+        if hybridize:
+            test_diag.hybridize()
+        x = np.random.uniform(-2.0, 2.0, size=shape).astype(dtype) if len(shape) != 0 else np.array(())
+        x.attach_grad()
+        np_out = onp.diag(x.asnumpy(), k)
+        with mx.autograd.record():
+            mx_out = test_diag(x)
+        assert mx_out.shape == np_out.shape
+        assert_almost_equal(mx_out.asnumpy(), np_out, rtol=rtol, atol=atol)
+
+        # check backward function
+        mx_out.backward()
+        if len(shape) == 0:
+            np_backward = np.array(())
+        elif len(shape) == 1:
+            np_backward = np.ones(shape[0])
+        else:
+            np_backward = np.zeros(shape)
+            h = shape[0]
+            w = shape[1]
+            if k > 0:
+                w -= k
+            else:
+                h += k
+            s = min(w, h)
+            if s > 0:
+                if k >= 0:
+                    for i in range(s):
+                        np_backward[0+i][k+i] = 1
+                else:
+                    for i in range(s):
+                        np_backward[-k+i][0+i] = 1
+        assert_almost_equal(x.grad.asnumpy(), np_backward, rtol=rtol, atol=atol)
+
+        # Test imperative once again
+        mx_out = np.diag(x, k)
+        np_out = onp.diag(x.asnumpy(), k)
+        assert_almost_equal(mx_out.asnumpy(), np_out, rtol=rtol, atol=atol)
+
+
+@use_np
+@pytest.mark.parametrize('config', [
+    [(1, 5), (0, 1)], [(2, 2), (0, 1)],
+    [(2, 5), (0, 1)], [(5, 5), (0, 1)],
+    [(2, 2, 2), (0, 1)], [(2, 4, 4), (0, 2)],
+    [(3, 3, 3), (1, 2)], [(4, 8, 8), (1, 2)],
+    [(4, 4, 4, 4), (1, 2)], [(5, 6, 7, 8), (2, 3)],
+    [(6, 7, 8, 9, 10), (3, 4)]
+])
+@pytest.mark.parametrize('k', [0, 2, 4, 6])
+@pytest.mark.parametrize('dtype', [np.int8, np.uint8, np.int32, np.int64, np.float16, np.float32, np.float64])
+@pytest.mark.parametrize('hybridize', [True, False])
+@pytest.mark.parametrize('call_by_instance', [True, False])
+def test_np_diagonal(config, k, dtype, hybridize, call_by_instance):
+    class TestDiagonal(HybridBlock):
+        def __init__(self, k=0, axis1=0, axis2=1, call_by_instance=False):
+            super(TestDiagonal, self).__init__()
+            self._k = k
+            self._axis1 = axis1
+            self._axis2 = axis2
+            self._call_by_instance = call_by_instance
+
+        def forward(self, a):
+            if self._call_by_instance:
+                return a.diagonal(self._k, self._axis1, self._axis2)
+            else:
+                return np.diagonal(a, self._k, self._axis1, self._axis2)
+
+    rtol = 1e-2 if dtype == np.float16 else 1e-3
+    atol = 1e-4 if dtype == np.float16 else 1e-5
+    shape, (axis1, axis2) = config
+    x = np.random.uniform(-5.0, 5.0, size=shape).astype(dtype)
+    x.attach_grad()
+    test_diagonal = TestDiagonal(k, axis1, axis2, call_by_instance)
+    if hybridize:
+        test_diagonal.hybridize()
+
+    if call_by_instance:
+        np_out = x.asnumpy().diagonal(offset=k, axis1=axis1, axis2=axis2)
+    else:
+        np_out = onp.diagonal(x.asnumpy(), offset=k, axis1=axis1, axis2=axis2)
+    with mx.autograd.record():
+        mx_out = test_diagonal(x)
+    assert mx_out.shape == np_out.shape
+    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=rtol, atol=atol)
+
+    # check backward function
+    mx_out.backward()
+    size_out = np_out.size
+    shape_out = np_out.shape
+    ndim = len(shape)
+    h = shape[axis1]
+    w = shape[axis2]
+    np_backward_slice = onp.zeros((h, w))
+    np_backward = onp.zeros(shape)
+    if k > 0:
+        w -= k
+    else:
+        h += k
+    s = min(w, h)
+    if s > 0:
+        if k >= 0:
+            for i in range(s):
+                np_backward_slice[0+i][k+i] = 1
+        else:
+            for i in range(s):
+                np_backward_slice[-k+i][0+i] = 1
+        ileading = int(size_out/s)
+        array_temp = onp.array([np_backward_slice for i in range(ileading)])
+        array_temp = array_temp.reshape(shape_out[:-1] + (shape[axis1], shape[axis2]))
+        axis_idx = [i for i in range(ndim-2)]
+        axis_idx[axis1:axis1] = [ndim - 2]
+        axis_idx[axis2:axis2] = [ndim - 1]
+        np_backward = onp.transpose(array_temp, tuple(axis_idx))
+    assert_almost_equal(x.grad.asnumpy(), np_backward, rtol=rtol, atol=atol)
+
+    # Test imperative once again
+    mx_out = np.diagonal(x, k, axis1, axis2)
+    np_out = onp.diagonal(x.asnumpy(), offset=k, axis1=axis1, axis2=axis2)
+    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=rtol, atol=atol)
+
+
+@use_np
+def test_np_nan_to_num():
+    def take_ele_grad(ele):
+        if onp.isinf(ele) or onp.isnan(ele):
+            return 0
+        return 1
+    def np_nan_to_num_grad(data):
+        shape = data.shape
+        arr = list(map(take_ele_grad,data.flatten()))
+        return onp.array(arr).reshape(shape)
+
+    class TestNanToNum(HybridBlock):
+        def __init__(self, copy=True, nan=0.0, posinf=None, neginf=None):
+            super(TestNanToNum, self).__init__()
+            self.copy = copy
+            self.nan = nan
+            self.posinf = posinf
+            self.neginf = neginf
+            # necessary initializations
+
+        def forward(self, a):
+            return np.nan_to_num(a, self.copy, self.nan, self.posinf, self.neginf)
+
+    src_list = [
+        onp.nan,
+        onp.inf,
+        -onp.inf,
+        1,
+        [onp.nan],
+        [onp.inf],
+        [-onp.inf],
+        [1],
+        [1,2,3,4,-1,-2,-3,-4,0],
+        [onp.nan, onp.inf, -onp.inf],
+        [onp.nan, onp.inf, -onp.inf, -574, 0, 23425, 24234,-5],
+        [onp.nan, -1, 0, 1],
+        [[-433, 0, 456, onp.inf], [-1, -onp.inf, 0, 1]]
+    ]
+
+    dtype_list = ['float16', 'float32', 'float64']
+    # [nan, inf, -inf]
+    param_list = [[None, None, None], [0, 1000, -100], [0.0, 9999.9, -9999.9]]
+    # Inplace operations are not supported when recording in deferred compute mode
+    # copy_list = [True, False]
+    copy_list = [True]
+    hybridize_list = [True, False]
+    atol, rtol = 1e-5, 1e-3
+
+    src_dtype_comb = list(itertools.product(src_list,dtype_list))
+    # check the dtype = int case in both imperative and sympolic expression
+    src_dtype_comb.append((1,'int32'))
+    src_dtype_comb.append(([234, 0, -40],'int64'))
+
+    combinations = itertools.product(hybridize_list, src_dtype_comb, copy_list, param_list)
+
+    numpy_version = onp.version.version
+    for [hybridize, src_dtype, copy, param] in combinations:
+        src, dtype = src_dtype
+        # np.nan, np.inf, -np.int are float type
+        x1 = mx.nd.array(src, dtype=dtype).as_np_ndarray().asnumpy()
+        x2 = mx.nd.array(src, dtype=dtype).as_np_ndarray()
+        x3 = mx.nd.array(src, dtype=dtype).as_np_ndarray()
+
+        expected_grad = np_nan_to_num_grad(x1)
+        x2.attach_grad()
+        # with optional parameters or without
+        if param[0] !=None and numpy_version>="1.17":
+            test_np_nan_to_num = TestNanToNum(copy=copy, nan=param[0], posinf=param[1], neginf=param[2])
+            np_out = onp.nan_to_num(x1, copy=copy, nan=param[0], posinf=param[1], neginf=param[2])
+            mx_out = np.nan_to_num(x3, copy=copy, nan=param[0], posinf=param[1], neginf=param[2])
+        else:
+            test_np_nan_to_num = TestNanToNum(copy=copy)
+            np_out = onp.nan_to_num(x1, copy=copy)
+            mx_out = np.nan_to_num(x3, copy=copy)
+
+        assert_almost_equal(mx_out.asnumpy(), np_out, rtol, atol)
+        # check the inplace operation when copy = False
+        # if x1.shape = 0, onp.array will not actually execute copy logic
+        # only check x3 from np.nan_to_num instead of x2 from gluon
+        if copy == False and x1.shape!=():
+            assert x1.shape == x3.asnumpy().shape
+            assert x1.dtype == x3.asnumpy().dtype
+            assert_almost_equal(x1, x3.asnumpy(), rtol=rtol, atol=atol)
+        # gluon does not support nan_to_num when copy=False
+        # backward will check int type and if so, throw error
+        # if not this case, test gluon
+        if not (hybridize== False and copy == False) and ('float' in dtype):
+            if hybridize:
+                test_np_nan_to_num.hybridize()
+            with mx.autograd.record():
+                mx_out_gluon = test_np_nan_to_num(x2)
+            assert_almost_equal(mx_out_gluon.asnumpy(), np_out, rtol, atol)
+            mx_out_gluon.backward()
+            assert_almost_equal(x2.grad.asnumpy(), expected_grad, rtol=1e-3, atol=1e-5)
+
+        # Test imperative once again
+        # if copy = False, the value of x1 and x2 has changed
+        if copy == True:
+            np_out = onp.nan_to_num(x1)
+            mx_out = np.nan_to_num(x3)
+            assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5, use_broadcast=False)
+
+
+@use_np
+def test_np_polyval():
+    class TestPolyval(HybridBlock):
+        def __init__(self):
+            super(TestPolyval, self).__init__()
+
+        def forward(self, p, x, *args, **kwargs):
+            return np.polyval(p, x)
+
+    def polyval_grad(p, x):
+        x_shape = x.shape
+        x = x.reshape((x.size, 1))
+        x = onp.broadcast_to(x, (x.size, p.size))
+        exp = onp.arange(p.size-1, -1, -1)
+        p_grad = onp.power(x, exp)
+        coeff = exp-1
+        coeff[-1] = 0
+        x_grad = onp.power(x, coeff) * p * exp
+        p_grad = onp.sum(p_grad, axis=0)
+        x_grad = onp.sum(x_grad, axis=-1).reshape(x_shape)
+        return (p_grad, x_grad)
+
+    dtypes = ['float32', 'float64', 'int32', 'int64']
+    x_shapes = [
+        (5,),
+        (10),
+        (3, 3),
+        (3, 4),
+        (3, 3, 3),
+        (2, 2, 4, 3),
+        (2, 0, 2, 3)
+    ]
+    flags = [True, False]
+    for dtype, x_shape, hybridize in itertools.product(dtypes, x_shapes, flags):
+        p_shape = (random.randint(1, 8),)
+        test_polyval = TestPolyval()
+        if hybridize:
+            test_polyval.hybridize()
+        rtol = 1e-2
+        atol = 1e-4
+        if dtype in ['int32', 'int64']:
+            p = np.random.randint(-16, 16, p_shape, dtype=dtype)
+            x = np.random.randint(-5, 5, x_shape, dtype=dtype)
+        else:
+            p = np.random.uniform(-1.0, 1.0, size=p_shape, dtype=dtype)
+            x = np.random.uniform(-1.0, 1.0, size=x_shape, dtype=dtype)
+
+        p.attach_grad()
+        x.attach_grad()
+        np_out = onp.polyval(p.asnumpy(), x.asnumpy())
+        with mx.autograd.record():
+            mx_out = test_polyval(p, x)
+        assert mx_out.shape == np_out.shape
+        assert_almost_equal(mx_out.asnumpy(), np_out, atol=atol, rtol=rtol)
+
+        mx_out.backward()
+        if dtype in ['float16', 'float32', 'float64']:
+            p_grad, x_grad = polyval_grad(p.asnumpy(), x.asnumpy())
+            assert_almost_equal(p.grad.asnumpy(), p_grad, atol=atol, rtol=rtol)
+            assert_almost_equal(x.grad.asnumpy(), x_grad, atol=atol, rtol=rtol)
+
+        mx_out = np.polyval(p, x)
+        np_out = onp.polyval(p.asnumpy(), x.asnumpy())
+        assert_almost_equal(mx_out.asnumpy(), np_out, atol=atol, rtol=rtol)
+
+
+@use_np
+@pytest.mark.parametrize('ishape', [
+    2, 5,
+    (), (1,), (4,),
+    (2, 2), (2, 4), (3, 5),
+    (2, 2, 2), (2, 3, 2), (2, 3, 4),
+])
+@pytest.mark.parametrize('rshape', [
+    10, (15,),
+    (3, 4), (4, 5),
+    (2,3,4)
+])
+@pytest.mark.parametrize('dtype', [np.uint8, np.int8, np.int32, np.int64])
+@pytest.mark.parametrize('hybridize', [True, False])
+def test_np_unravel_index(ishape, rshape, dtype, hybridize):
+    class TestUnravel_index(HybridBlock):
+        def __init__(self, shape, order='C') :
+            super(TestUnravel_index, self).__init__()
+            self._shape = shape
+            self._order = order
+
+        def forward(self, a):
+            return np.unravel_index(a, self._shape, self._order)
+
+
+    rtol = 1e-2 if dtype == np.float16 else 1e-3
+    atol = 1e-4 if dtype == np.float16 else 1e-5
+    test_unravel_index = TestUnravel_index(rshape)
+    if hybridize:
+        test_unravel_index.hybridize()
+    if type(ishape) == int and hybridize:
+        x = np.array([ishape], dtype=dtype)
+        np_out = onp.unravel_index(x.asnumpy(), rshape)
+    else:
+        x = np.random.uniform(0, 8, size=ishape).astype(dtype)
+        np_out = onp.unravel_index(x.asnumpy(), rshape)
+    mx_out = test_unravel_index(x)
+    assert len(mx_out) == len(np_out)
+    for elem_mx, elem_np in zip(mx_out, np_out):
+        assert elem_mx.asnumpy().shape == elem_np.shape
+        assert_almost_equal(elem_mx.asnumpy(), elem_np, rtol=rtol, atol=atol)
+    # no backward function for unravel_index operator
+
+    # Test imperative once again
+    mx_out = np.unravel_index(x, rshape)
+    np_out = onp.unravel_index(x.asnumpy(), rshape)
+    print(np_out)
+    assert len(mx_out) == len(np_out)
+    for elem_mx, elem_np in zip(mx_out, np_out):
+        assert elem_mx.asnumpy().shape == elem_np.shape
+        assert_almost_equal(elem_mx.asnumpy(), elem_np, rtol=rtol, atol=atol)
+
+
+@use_np
+def test_np_diag_indices_from():
+    class TestDiag_indices_from(HybridBlock):
+        def __init__(self) :
+            super(TestDiag_indices_from, self).__init__()
+
+        def forward(self, a):
+            return np.diag_indices_from(a)
+
+    dtypes = [np.int8, np.uint8, np.int32, np.int64, np.float16, np.float32, np.float64]
+    shapes = [(2, 2), (4, 4), (5, 5, 5), (6, 6, 6, 6), (8, 8, 8, 8)]
+    combinations = itertools.product([False, True], dtypes, shapes)
+    for hybridize, dtype, shape in combinations:
+        rtol = 1e-2 if dtype == np.float16 else 1e-3
+        atol = 1e-4 if dtype == np.float16 else 1e-5
+        test_diag_indices_from = TestDiag_indices_from()
+        if hybridize:
+            test_diag_indices_from.hybridize()
+        x = np.random.uniform(-8, 8, size=shape).astype(dtype)
+        mx_out = test_diag_indices_from(x)
+        np_out = onp.diag_indices_from(x.asnumpy())
+        assert len(mx_out) == len(np_out)
+        for elem_mx, elem_np in zip(mx_out, np_out):
+            assert elem_mx.asnumpy().shape == elem_np.shape
+            assert_almost_equal(elem_mx.asnumpy(), elem_np, rtol=rtol, atol=atol)
+        # no backward function for diag_indices_from operator
+
+        # Test imperative once again
+        mx_out = np.diag_indices_from(x)
+        np_out = onp.diag_indices_from(x.asnumpy())
+        assert len(mx_out) == len(np_out)
+        for elem_mx, elem_np in zip(mx_out, np_out):
+            assert elem_mx.asnumpy().shape == elem_np.shape
+            assert_almost_equal(elem_mx.asnumpy(), elem_np, rtol=rtol, atol=atol)
+
+
+@use_np
+def test_np_interp():
+    class TestInterp(HybridBlock):
+        def __init__(self, left=None, right=None, period=None):
+            super(TestInterp, self).__init__()
+            self._left = left
+            self._right = right
+            self._period = period
+
+        def forward(self, x, xp, fp):
+            return np.interp(x, xp, fp, left=self._left, right=self._right, period=self._period)
+
+    class TestInterpScalar(HybridBlock):
+        def __init__(self, x=None, left=None, right=None, period=None):
+            super(TestInterpScalar, self).__init__()
+            self._x = x
+            self._left = left
+            self._right = right
+            self._period = period
+
+        def forward(self, xp, fp):
+            return np.interp(self._x, xp, fp, left=self._left, right=self._right, period=self._period)
+
+    xtypes = [np.int64, np.float32, np.float64]
+    dtypes = [np.int32, np.int64, np.float32, np.float64]
+    xshapes = [
+        (), (3,), (5,), (20,),
+        (2, 2), (4, 4), (8, 8),
+        (5, 5, 5), (8, 0, 8)
+    ]
+    dsizes = [10, 30]
+    periods = [None, 2*np.pi]
+    lefts = [None, -10, 0]
+    rights= [None, 20, 50]
+    flags = [True, False]
+    combinations = itertools.product(flags, flags, xshapes, dsizes, xtypes, dtypes, lefts, rights, periods)
+    for hybridize, x_scalar, xshape, dsize, xtype, dtype, left, right, period in combinations:
+        rtol = 1e-3
+        atol = 1e-5
+        if period is not None:
+            x = np.random.uniform(-np.pi, np.pi, size=xshape).astype(xtype)
+            xp = np.random.uniform(0, 2*np.pi, size=dsize)
+            fp = np.sin(xp)
+        else:
+            x = np.random.uniform(0, 100, size=xshape).astype(xtype)
+            xp = np.sort(np.random.choice(100, dsize, replace=False).astype(dtype))
+            fp = np.random.uniform(-50, 50, size=dsize).astype(dtype)
+        np_x = x.asnumpy()
+        if x_scalar and xshape == ():
+            x = x.item()
+            np_x = x
+            test_interp = TestInterpScalar(x=x, left=left, right=right, period=period)
+        else:
+            test_interp = TestInterp(left=left, right=right, period=period)
+        if hybridize:
+            test_interp.hybridize()
+        mx_out = test_interp(xp, fp) if (x_scalar and xshape == ()) else test_interp(x, xp, fp)
+        np_out = onp.interp(np_x, xp.asnumpy(), fp.asnumpy(), left=left, right=right, period=period)
+        assert mx_out.shape == np_out.shape
+        assert_almost_equal(mx_out.asnumpy(), np_out, atol=atol, rtol=rtol)
+
+        mx_out = np.interp(x, xp, fp, left=left, right=right, period=period)
+        np_out = onp.interp(np_x ,xp.asnumpy(), fp.asnumpy(), left=left, right=right, period=period)
+        assert_almost_equal(mx_out.asnumpy(), np_out, atol=atol, rtol=rtol)
+
+
+@use_np
+def test_np_bincount():
+    class TestBincount(HybridBlock):
+        def __init__(self, minlength=0):
+            super(TestBincount, self).__init__()
+            self._minlength = minlength
+
+        def forward(self, a):
+            return np.bincount(a, None, self._minlength)
+
+    class TestBincountWeights(HybridBlock):
+        def __init__(self, minlength=0):
+            super(TestBincountWeights, self).__init__()
+            self._minlength = minlength
+
+        def forward(self, a, weights):
+            return np.bincount(a, weights, self._minlength)
+
+    dtypes = [np.int8, np.uint8, np.int32, np.int64]
+    weight_types = [np.int32, np.int64, np.float16, np.float32, np.float64]
+    shapes = [(), (5,), (10,), (15,), (20,), (30,), (50,)]
+    min_lengths = [0, 5, 20, 50]
+    has_weights = [True, False]
+    combinations = itertools.product([True, False], shapes, dtypes, weight_types, has_weights, min_lengths)
+    for hybridize, shape, dtype, weight_type, has_weight, minlength in combinations:
+        rtol = 1e-2 if weight_type == np.float16 else 1e-3
+        atol = 1e-4 if weight_type == np.float16 else 1e-5
+        if shape != ():
+            data = np.random.uniform(0, 10, size=shape).astype(dtype)
+            weights = np.random.uniform(0, 10, size=shape).astype(weight_type) if has_weight else None
+        else:
+            data = np.array(()).astype(dtype)
+            weights = np.array(()).astype(weight_type) if has_weight else None
+        weights_np = weights.asnumpy() if has_weight else None
+        test_bincount = TestBincountWeights(minlength) if has_weight else TestBincount(minlength)
+        if hybridize:
+            test_bincount.hybridize()
+        mx_out = test_bincount(data, weights) if has_weight else test_bincount(data)
+        np_out = onp.bincount(data.asnumpy(), weights_np, minlength)
+        assert mx_out.shape == np_out.shape
+        assert_almost_equal(mx_out.asnumpy(), np_out, rtol=rtol, atol=atol)
+        # No backward operation for operator bincount at this moment
+
+        # Test imperative once again
+        mx_out = np.bincount(data, weights, minlength)
+        np_out = onp.bincount(data.asnumpy(), weights_np, minlength)
+        assert_almost_equal(mx_out.asnumpy(), np_out, rtol=rtol, atol=atol)
+
+
+@use_np
+@pytest.mark.skip(reason='Test hangs. Tracked in #18144')
+def test_np_empty_like():
+    class TestEmptyLike(HybridBlock):
+        def __init__(self, dtype, order, subok):
+            super(TestEmptyLike, self).__init__()
+            self._dtype = dtype
+            self._order = order
+            self._subok = subok
+
+        def forward(self, x, *args, **kwargs):
+            return np.empty_like(x, self._dtype, self._order, self._subok)
+
+    if StrictVersion(platform.python_version()) < StrictVersion('3.0.0'):
+        return
+
+    dtypes = [None, 'float16', 'float32', np.int8, np.uint8, np.int32, np.int64,
+              np.float16, np.float32, np.float64, np.bool_]
+    shapes = [
+        (),
+        (1,),
+        (5,),
+        (4, 3),
+        (3, 5),
+        (4, 4),
+        (4, 5),
+        (5, 5),
+        (5, 6),
+        (6, 6),
+        (0, 1),
+        (6, 5, 6),
+        (2, 3, 3, 4),
+        (4, 2, 1, 2),
+        (0, 5, 3, 3),
+        (5, 0, 3, 3),
+        (3, 3, 0, 0),
+    ]
+    orders = ["C"]
+    subok_list = [False]
+    flags = [False]
+    _np_version = onp.version.version
+    for dtype, shape, hybridize, order, subok in itertools.product(dtypes, shapes, flags, orders, subok_list):
+        prototype = np.random.uniform(low=0, high=100, size=shape, dtype='float64').astype(dtype)
+        test = TestEmptyLike(dtype, order, subok)
+        if StrictVersion(_np_version) >= StrictVersion('1.6.0'):
+            expected_ret = onp.empty_like(prototype, dtype=dtype, order=order, subok=subok)
+        else:
+            expected_ret = onp.empty_like(prototype)
+        if hybridize:
+            test.hybridize()
+        ret = test(prototype)
+        assert ret.asnumpy().shape == expected_ret.shape
+
+        # check imperative again
+        ret = np.empty_like(prototype, dtype, order, subok)
+        assert ret.asnumpy().shape == expected_ret.shape
+
+
+@use_np
+@pytest.mark.parametrize('hybridize', [True, False])
+@pytest.mark.parametrize('dtype', [np.float32, np.float64])
+@pytest.mark.parametrize('a_shape,b_shape,axes', [
+    # - 2 x 2
+    ((2,), (2,), (-1, -1, -1)),
+    ((1, 2), (1, 2), (-1, -1, -1)),
+    ((1, 2), (2, 2), (-1, -1, -1)),
+    ((2, 2), (1, 2), (-1, -1, -1)),
+    ((2, 2), (2, 2), (-1, -1, -1)),
+    ((1, 2), (2, 2), (-1, 0, -1)),
+    ((2, 2), (1, 2), (0, -1, -1)),
+    ((2, 2), (2, 2), (0, 0, -1)),
+    ((2, 2), (2, 2), (0, 0, 0)),
+    ((5, 4, 3, 2), (5, 4, 3, 2), (-1, -1, -1)),
+    ((1, 4, 3, 2), (5, 1, 3, 2), (-1, -1, -1)),
+    ((5, 4, 3, 2), (5, 4, 3, 2), (-1, -1, 0)),
+    ((2, 5, 4, 3), (5, 2, 4, 3), (0, 1, 2)),
+    ((2, 5, 1, 3), (1, 2, 4, 3), (0, 1, 2)),
+    # - 2 x 3
+    ((2,), (3,), (-1, -1, -1)),
+    ((1, 2,), (1, 3,), (-1, -1, -1)),
+    ((2, 2,), (2, 3,), (0, -1, 0)),
+    ((1, 2,), (2, 3,), (-1, -1, -1)),
+    ((2, 2,), (1, 3,), (-1, -1, -1)),
+    ((2, 1,), (3, 4,), (0, 0, 0)),
+    ((2, 1, 3), (4, 3, 1), (0, 1, 2)),
+    ((6, 5, 4, 2), (6, 5, 4, 3), (-1, -1, -1)),
+    ((2, 6, 5, 4), (6, 5, 4, 3), (0, -1, 2)),
+    ((2, 6, 5, 4), (6, 3, 5, 4), (0, 1, 2)),
+    ((6, 2, 5, 4), (6, 5, 3, 4), (1, 2, 0)),
+    ((6, 2, 1, 4), (1, 5, 3, 4), (1, 2, 0)),
+    # - 3 x 2
+    ((3,), (2,), (-1, -1, -1)),
+    ((1, 3,), (1, 2,), (-1, -1, -1)),
+    ((2, 3,), (2, 2,), (-1, 0, 0)),
+    ((2, 3,), (1, 2,), (-1, -1, -1)),
+    ((2, 3,), (1, 2,), (-1, -1, -1)),
+    ((3, 4, 4), (1, 1, 2,), (0, -1, 0)),
+    ((3, 4, 4), (1, 2, 1,), (0, 1, 2)),
+    ((6, 5, 4, 3), (6, 5, 4, 2), (-1, -1, -1)),
+    ((3, 6, 5, 4), (6, 5, 4, 2), (0, -1, 2)),
+    ((3, 6, 5, 4), (6, 2, 5, 4), (0, 1, 2)),
+    ((6, 3, 5, 4), (6, 5, 2, 4), (1, 2, 0)),
+    ((6, 3, 1, 4), (1, 5, 2, 4), (1, 2, 0)),
+    # - 3 x 3
+    ((3,), (3,), (-1, -1, -1)),
+    ((1, 3,), (1, 3,), (-1, -1, -1)),
+    ((2, 3,), (3, 2,), (-1, 0, 0)),
+    ((1, 3,), (3, 2,), (-1, 0, 0)),
+    ((1, 3,), (3, 4,), (-1, 0, 0)),
+    ((1, 1, 3,), (3, 2, 2), (-1, 0, 0)),
+    ((1, 1, 2, 3,), (3, 2, 2, 2), (-1, 0, 0)),
+    ((6, 5, 4, 3), (6, 5, 4, 3), (-1, -1, -1)),
+    ((3, 6, 5, 4), (6, 5, 4, 3), (0, -1, 2)),
+    ((3, 6, 5, 4), (6, 3, 5, 4), (0, 1, 2)),
+    ((6, 3, 5, 4), (6, 5, 3, 4), (1, 2, 0)),
+    ((6, 3, 1, 4), (1, 5, 3, 4), (1, 2, -1)),
+
+    # - (a_shape, b_shape, None)
+    ((2,), (2,), None),
+    ((2,), (3,), None),
+    ((3,), (2,), None),
+    ((3,), (3,), None),
+    ((5, 4, 3, 2), (5, 4, 3, 2), None),
+    ((6, 5, 4, 2), (6, 5, 4, 3), None),
+    ((6, 5, 4, 3), (6, 5, 4, 2), None),
+    ((6, 5, 4, 3), (6, 5, 4, 3), None),
+    ((1, 4, 3, 2), (5, 1, 3, 2), None),
+    ((6, 1, 4, 2), (6, 5, 1, 3), None),
+    ((6, 5, 1, 3), (1, 5, 4, 2), None),
+    ((1, 5, 4, 3), (6, 5, 1, 3), None),
+
+    # - (a_shape, b_shape, (a_axis, b_axis, c_axis, axis))
+    ((2, 5, 4, 3), (2, 5, 4, 3), (-1, -1, -1, 0,)),
+    ((6, 2, 5, 4), (6, 3, 5, 4), (-1, -1, -1, 1,)),
+    ((6, 5, 3, 4), (6, 5, 2, 4), (-1, -1, -1, 2,)),
+    ((6, 5, 4, 3), (6, 5, 4, 3), (-1, -1, -1, 3,)),
+])
+def test_np_cross(a_shape, b_shape, axes, dtype, hybridize):
+    class TestNumpyCross(HybridBlock):
+        def __init__(self, axisa=-1, axisb=-1, axisc=-1, axis=None):
+            super(TestNumpyCross, self).__init__()
+            self._axisa = axisa
+            self._axisb = axisb
+            self._axisc = axisc
+            self._axis = axis
+
+        def forward(self, a, b):
+            return np.cross(a, b, self._axisa, self._axisb, self._axisc, self._axis)
+
+    def check_np_cross(x, a_np, b_np, axises):
+        try:
+            if axises is None:
+                x_expected = onp.cross(a_np, b_np)
+            elif len(axises) == 4:
+                (a_axis, b_axis, c_axis, axis,) = axises
+                x_expected = onp.cross(a_np, b_np, axisa=a_axis, axisb=b_axis, axisc=c_axis, axis=axis)
+            else:
+                (a_axis, b_axis, c_axis,) = axises
+                x_expected = onp.cross(a_np, b_np, axisa=a_axis, axisb=b_axis, axisc=c_axis)
+        except Exception as e:
+            print("a:", a_np)
+            print("a shape:", a_np.shape)
+            print("b:", b_np)
+            print("b shape:", b_np.shape)
+            print(e)
+        else:
+            assert x.shape == x_expected.shape
+            assert_almost_equal(x.asnumpy(), x_expected, rtol=rtol, atol=atol)
+
+    def check_not_use_broadcast(a_np, b_np, axises):
+        a_shape = a_np.shape
+        b_shape = b_np.shape
+        if axises is None:
+            return a_shape[:-1] == b_shape[:-1]
+        elif len(axises) == 4:
+            axis = axises[3]
+            a_moveaxis_shape = onp.moveaxis(a_np, axis, -1).shape
+            b_moveaxis_shape = onp.moveaxis(b_np, axis, -1).shape
+            return a_moveaxis_shape[:-1] == b_moveaxis_shape[:-1]
+        else:
+            a_axis = axises[0]
+            b_axis = axises[1]
+            a_moveaxis_shape = onp.moveaxis(a_np, a_axis, -1).shape
+            b_moveaxis_shape = onp.moveaxis(b_np, b_axis, -1).shape
+            return a_moveaxis_shape[:-1] == b_moveaxis_shape[:-1]
+
+    # calculate dL = gradC * dC
+    def cal_dL(grad_c_move, dc_move):
+        num = int(onp.prod(dc_move.shape))
+        grad_c_move_1d = grad_c_move.reshape((num,))
+        dc_move_1d = dc_move.reshape((num,))
+        dL = onp.inner(grad_c_move_1d, dc_move_1d)
+        return dL
+
+    # get reduced axis index
+    def get_reduce_axis(shape, broad_shape):
+        axis = list()
+        length = len(broad_shape) if len(shape) == len(broad_shape) + 1 else len(broad_shape) - 1
+        for i in range(length):
+            if shape[i] != broad_shape[i]:
+                axis.append(i)
+        return tuple(axis) if len(axis) > 0 else None
+
+    # get grad_a and grad_b
+    def get_cross_backward(a, b, axises):
+        if axises == None:
+            a_axis, b_axis, c_axis = (-1,) * 3
+        elif len(axises) == 4:
+            a_axis, b_axis, c_axis = (axises[-1],) * 3
+        else:
+            (a_axis, b_axis, c_axis) = axises
+        c = onp.cross(a, b, axisa=a_axis, axisb=b_axis, axisc=c_axis)
+        c_move = onp.moveaxis(c, c_axis, -1) if a.shape[a_axis] == 3 or b.shape[b_axis] == 3 else c
+        grad_c_move = onp.ones(shape=c_move.shape, dtype=c_move.dtype)
+        a_move = onp.moveaxis(a, a_axis, -1)
+        b_move = onp.moveaxis(b, b_axis, -1)
+        da_move = onp.random.uniform(-1., 1., size=a_move.shape)
+        db_move = onp.random.uniform(-1., 1., size=b_move.shape)
+        # dC = dA x B + A x dB
+        dc_move = onp.cross(da_move, b_move) + onp.cross(a_move, db_move)
+        # dL1 = Tr(grad_C.T * dC) = dL/dCi * dCi
+        dL1 = cal_dL(grad_c_move, dc_move)
+        # check cross backward.
+        if a.shape[a_axis] == 2 and b.shape[b_axis] == 2:
+            # Case 1: a.shape[-1] == 2 and b.shape[-1] == 2, param.axisc is ignored.
+            shape = grad_c_move.shape if grad_c_move.ndim != 0 else (1,)
+            grad_a_move = onp.empty(shape, dtype=a_move.dtype)
+            grad_b_move = onp.empty(shape, dtype=b_move.dtype)
+            grad_a_move = onp.expand_dims(grad_a_move, -1).repeat(2, axis=-1)
+            grad_b_move = onp.expand_dims(grad_b_move, -1).repeat(2, axis=-1)
+            a_move_0 = a_move[..., 0]
+            a_move_1 = a_move[..., 1]
+            b_move_0 = b_move[..., 0]
+            b_move_1 = b_move[..., 1]
+            grad_a_move_0 = grad_c_move * b_move_1
+            grad_a_move_1 = grad_c_move * b_move_0
+            if grad_a_move_1.ndim == 0:
+                grad_a_move_1 = -grad_a_move_1
+            else:
+                onp.negative(grad_a_move_1, out=grad_a_move_1)
+            grad_b_move_0 = grad_c_move * a_move_1
+            grad_b_move_1 = grad_c_move * a_move_0
+            if grad_b_move_0.ndim == 0:
+                grad_b_move_0 = -grad_b_move_0
+            else:
+                onp.negative(grad_b_move_0, out=grad_b_move_0)
+            grad_a_move[..., 0] = grad_a_move_0
+            grad_a_move[..., 1] = grad_a_move_1
+            grad_b_move[..., 0] = grad_b_move_0
+            grad_b_move[..., 1] = grad_b_move_1
+        else:
+            # Case 4: a.shape[-1] == 3 and b.shape[-1] == 3, param.axisc is not ignored.
+            grad_a_move = onp.cross(b_move, grad_c_move)
+            grad_b_move = onp.cross(grad_c_move, a_move)
+            if a.shape[a_axis] == 2:
+                # Case 2: a.shape[-1] == 2 and b.shape[-1] == 3, param.axisc is not ignored.
+                grad_a_move = onp.delete(grad_a_move, obj=-1, axis=-1)
+            if b.shape[b_axis] == 2:
+                # Case 3: a.shape[-1] == 3 and b.shape[-1] == 2, param.axisc is not ignored.
+                grad_b_move = onp.delete(grad_b_move, obj=-1, axis=-1)
+
+        if not check_not_use_broadcast(a, b, axises):
+            a_broad_axis = get_reduce_axis(a_move.shape, c_move.shape)
+            b_broad_axis = get_reduce_axis(b_move.shape, c_move.shape)
+            if a_broad_axis is not None:
+                grad_a_move_reduce = onp.ones_like(a_move)
+                grad_a_move_reduce = onp.sum(grad_a_move, axis=a_broad_axis, out=grad_a_move_reduce, keepdims=True)
+                grad_a_move = grad_a_move_reduce
+            if b_broad_axis is not None:
+                grad_b_move_reduce = onp.ones_like(b_move)
+                grad_b_move_reduce = onp.sum(grad_b_move, axis=b_broad_axis, out=grad_b_move_reduce, keepdims=True)
+                grad_b_move = grad_b_move_reduce
+        # dL2 = dL/dAi * dAi + dL/dBi * dBi
+        dL2 = cal_dL(grad_a_move, da_move) + cal_dL(grad_b_move, db_move)
+        assert_almost_equal(dL1, dL2, rtol=rtol, atol=atol)
+        # move working axis
+        return onp.moveaxis(grad_a_move, -1, a_axis), onp.moveaxis(grad_b_move, -1, b_axis)
+
+    rtol = 1e-3
+    atol = 1e-5
+    if axes is None:
+        a_axis, b_axis, c_axis = (-1,) * 3
+        test_numpy_cross = TestNumpyCross()
+    elif len(axes) == 4:
+        (a_axis, b_axis, c_axis, axis,) = axes
+        test_numpy_cross = TestNumpyCross(axisa=a_axis, axisb=b_axis, axisc=c_axis, axis=axis)
+    else:
+        (a_axis, b_axis, c_axis,) = axes
+        test_numpy_cross = TestNumpyCross(axisa=a_axis, axisb=b_axis, axisc=c_axis)
+    if hybridize:
+        test_numpy_cross.hybridize()
+    a_np = onp.random.uniform(-10., 10., size=a_shape)
+    b_np = onp.random.uniform(-10., 10., size=b_shape)
+    a = np.array(a_np, dtype=dtype)
+    b = np.array(b_np, dtype=dtype)
+    a.attach_grad()
+    b.attach_grad()
+
+    # check cross validity
+    with mx.autograd.record():
+        mx_out = test_numpy_cross(a, b)
+    check_np_cross(mx_out, a.asnumpy(), b.asnumpy(), axes)
+
+    # check cross backward
+    mx.autograd.backward(mx_out)
+    grad_a_expected, grad_b_expected = get_cross_backward(a.asnumpy(), b.asnumpy(), axes)
+    assert_almost_equal(a.grad.asnumpy(), grad_a_expected, rtol=rtol, atol=atol)
+    assert_almost_equal(b.grad.asnumpy(), grad_b_expected, rtol=rtol, atol=atol)
+
+    # check imperative once again
+    mx_out = test_numpy_cross(a, b)
+    check_np_cross(mx_out, a.asnumpy(), b.asnumpy(), axes)
+
+
+@use_np
+def test_np_rollaxis():
+    class TestRollaxis(HybridBlock):
+        def __init__(self, axis=0, start=0):
+            super(TestRollaxis, self).__init__()
+            self._axis = axis
+            self._start = start
+
+        def forward(self, a, *args, **kwargs):
+            return np.rollaxis(a, axis=self._axis, start=self._start)
+
+    dtypes = ['int32', 'int64', 'float16', 'float32', 'float64']
+    for hybridize in [False, True]:
+        for dtype in dtypes:
+            for ndim in [0, 1, 2, 3, 4, 5, 6, 7, 8]:
+                shape = rand_shape_nd(ndim, dim=5, allow_zero_size=True)
+                np_data = onp.random.uniform(low=-100, high=100, size=shape).astype(dtype)
+                mx_data = np.array(np_data, dtype=dtype)
+                for axis in range(-ndim, ndim):
+                    for start in range(-ndim, ndim + 1):
+                        # test gluon
+                        test_rollaxis = TestRollaxis(axis, start)
+                        if hybridize:
+                            test_rollaxis.hybridize()
+                        np_out = onp.rollaxis(np_data, axis=axis, start=start)
+                        mx_data.attach_grad()
+                        with mx.autograd.record():
+                            mx_out = test_rollaxis(mx_data)
+                        assert mx_out.shape == np_out.shape
+                        mx_out.backward()
+                        assert same(mx_data.grad.shape, mx_data.shape)
+                        assert same(mx_data.grad.asnumpy(), onp.ones(shape))
+                        # test imperative
+                        np_out = onp.rollaxis(np_data, axis=axis, start=start)
+                        mx_out = np.rollaxis(mx_data, axis=axis, start=start)
+                        assert np_out.dtype == mx_out.dtype
+                        assert same(mx_out.asnumpy(), np_out)
+
+
+@use_np
+def test_npx_stop_gradient():
+    class TestStopGradient(HybridBlock):
+        def forward(self, a):
+            return npx.stop_gradient(a)
+    dtypes = ['float16', 'float32', 'float64']
+    for hybridize in [False, True]:
+        for dtype in dtypes:
+            for grad_req in ['write', 'add']:
+                dat = np.ones((10,), dtype=dtype)
+                dat.attach_grad(grad_req)
+                dat.grad[:] = 2
+                old_grad = dat.grad.asnumpy()
+                net = TestStopGradient()
+                if hybridize:
+                    net.hybridize()
+                with mx.autograd.record():
+                    out = net(dat)
+                    out = out + dat
+                    out.backward()
+                new_grad = dat.grad.asnumpy()
+                assert same(out.asnumpy(), dat.asnumpy() * 2)
+                if grad_req == 'write':
+                    assert_almost_equal(new_grad, onp.ones_like(dat, dtype=dtype))
+                elif grad_req == 'add':
+                    assert_almost_equal(new_grad, old_grad + 1)
+
+
+@use_np
+def test_add_n():
+    data_shape = (2, 2)
+    input_num = 5
+    data = [np.random.uniform(size=data_shape) for i in range(input_num)]
+    rslt = np.zeros(shape=data_shape)
+    for i in range(input_num):
+        rslt += data[i]
+    add_n_rslt = npx.add_n(*data, out=data[0])
+    assert_almost_equal(rslt.asnumpy(), add_n_rslt.asnumpy(), atol=1e-5)
+
+
+@use_np
+def test_slice_like():
+    for ndim in range(1, 6):
+        from_shape = onp.random.randint(1, 11, size=(ndim,))
+        shape = [s + onp.random.randint(0, 3) for s in from_shape]
+        for t in range(ndim):
+            if t > 0:
+                axes = onp.random.randint(0, ndim, size=t).tolist()
+            else:
+                axes = []
+            idx = []
+            for i in range(ndim):
+                idx.append(slice(0, shape[i]))
+                if i in axes or not axes:
+                    idx[i] = slice(0, from_shape[i])
+
+            if axes:
+                pos = onp.random.randint(0, t)
+                if axes[pos] > 0:
+                    axes[pos] -= ndim  # negative index
+            x = np.array(onp.random.normal(size=shape))
+            x1 = np.array(onp.random.normal(size=from_shape))
+            x.attach_grad()
+            x1.attach_grad()
+            with mx.autograd.record():
+                y = npx.slice_like(data=x, shape_like=x1, axes=axes)
+            y.backward()
+            assert_allclose(x.asnumpy()[idx], y.asnumpy())
+
+            xx = x.asnumpy()
+            xx[:] = 0.0
+            xx[idx] = x.asnumpy()[idx]
+            assert_allclose(x1.grad.asnumpy(), np.zeros_like(x1.grad).asnumpy())
+
+
+@use_np
+@pytest.mark.parametrize('dtype', np.floating_dtypes)
+def test_np_finfo(dtype):
+    mx_finfo_obj = np.finfo(dtype)
+    np_finfo = onp.finfo(dtype)
+    assert (mx_finfo_obj.bits, mx_finfo_obj.eps, mx_finfo_obj.max, mx_finfo_obj.min, mx_finfo_obj.smallest_normal) == \
+        (np_finfo.bits, np_finfo.eps, np_finfo.max, np_finfo.min, np_finfo.tiny)
+
+
+@use_np
+@pytest.mark.parametrize('dtype', np.integer_dtypes)
+def test_np_iinfo(dtype):
+    mx_iinfo_obj = np.iinfo(dtype)
+    np_iinfo = onp.iinfo(dtype)
+    assert (mx_iinfo_obj.bits, mx_iinfo_obj.max, mx_iinfo_obj.min) == \
+        (np_iinfo.bits, np_iinfo.max, np_iinfo.min)
+
+
+@use_np
+@pytest.mark.parametrize('input1', [d for d in np.numeric_dtypes + np.boolean_dtypes] + [np.ones((1,), dtype=d) for d in np.numeric_dtypes + np.boolean_dtypes])
+@pytest.mark.parametrize('input2', [d for d in np.numeric_dtypes + np.boolean_dtypes])
+def test_np_can_cast(input1, input2):
+    np_input1 = input1
+    np_input2 = input2
+    if isinstance(input1, np.ndarray):
+        np_input1 = input1.asnumpy()
+    assert np.can_cast(input1, input2) == onp.can_cast(np_input1, np_input2)
+
+
+@use_np
+@pytest.mark.parametrize('nums', [1, 2, 3, 4, 10, 100])
+def test_np_result_type(nums):
+    PICK_LIST = np.numeric_dtypes + np.boolean_dtypes + [np.ones((1,), dtype=d) for d in np.numeric_dtypes + np.boolean_dtypes]
+    import random
+    inputs = [random.choice(PICK_LIST) for _ in range(nums)]
+
+    try:
+        promoted = np.result_type(*inputs)
+    except Exception as e:
+        with pytest.raises(TypeError):
+            promoted = np.result_type(*inputs)
+
+
+@use_np
+@pytest.mark.parametrize('func,func2,dtypes,ref_grad,low,high', [
+    ('abs', 'abs', 'numeric', lambda x: -1. * (x < 0) + (x > 0), -1.0, 1.0),
+    ('acos', 'arccos', 'floating-point', lambda x: -1. / (1. - x ** 2.) ** (1. / 2.), -1.0, 1.0),
+    ('acosh', 'arccosh', 'floating-point', lambda x: 1./(x**2 - 1.)**(1./2.), 2.0, 5.0),
+    ('asin', 'arcsin', 'floating-point', lambda x: 1. / (1. - x ** 2) ** (1. / 2.), -1.0, 1.0),
+    ('asinh', 'arcsinh', 'floating-point', lambda x: 1./(x**2 + 1.)**(1./2.), -1.0, 1.0),
+    ('atan', 'arctan', 'floating-point', lambda x: 1. / (x ** 2. + 1.), -1.0, 1.0),
+    ('atanh', 'arctanh', 'floating-point', lambda x: -1./(x**2 - 1.), -0.99, 0.99),
+    ('bitwise_invert', 'invert', 'integer or boolean', None, -5, 5),
+    ('ceil', 'ceil', 'numeric', None, -10.0, 10.0),
+    ('cos', 'cos', 'floating-point', lambda x: -onp.sin(x), -1.0, 1.0),
+    ('cosh', 'cosh', 'floating-point', lambda x: onp.sinh(x), -1.0, 1.0),
+    ('exp', 'exp', 'floating-point', lambda x: onp.exp(x), -1.0, 1.0),
+    ('expm1', 'expm1', 'floating-point', lambda x: onp.exp(x), -1.0, 1.0),
+    ('floor', 'floor', 'numeric', None, -10.0, 10.0),
+    ('log', 'log', 'floating-point', lambda x: 1.0 / x, 0.1, 5.0),
+    ('log10', 'log10', 'floating-point', lambda x: 1.0 / (x * onp.log(10)), 0.1, 10.0),
+    ('log1p', 'log1p', 'floating-point', lambda x: 1.0 / (1.0 + x), -0.9, 5.0),
+    ('log2', 'log2', 'floating-point', lambda x: 1.0 / (x * onp.log(2)), 0.1, 2.0),
+    ('logical_not', 'logical_not', 'boolean', None,  -1.0, 1.0),
+    ('negative', 'negative', 'numeric', lambda x: -1. * onp.ones(x.shape), -1.0, 1.0),
+    ('positive', 'positive', 'numeric', lambda x: onp.ones(x.shape), -1.0, 1.0),
+    ('sign', 'sign', 'numeric', None, -1.0, 1.0),
+    ('sin', 'sin', 'floating-point', lambda x: onp.cos(x), -1.0, 1.0),
+    ('sinh', 'sinh', 'floating-point', lambda x: onp.cosh(x), -1.0, 1.0),
+    ('sqrt', 'sqrt', 'floating-point', lambda x: 0.5 / onp.sqrt(x), 0.001, 10.0),
+    ('square', 'square', 'numeric', lambda x: 2.0 * x, -1.0, 1.0),
+    ('tan', 'tan', 'floating-point', lambda x: onp.tan(x) ** 2 + 1.0, -1.0, 1.0),
+    ('tanh', 'tanh', 'floating-point', lambda x: 1. - onp.tanh(x) ** 2, -1.0, 1.0),
+    ('trunc', 'trunc', 'numeric', None, -5.0, 5.0),
+])
+@pytest.mark.parametrize('ndim', [2, 3, 4])
+def test_np_standard_unary_funcs(func, func2, dtypes, ref_grad, low, high, ndim):
+    class TestStandardUnary(HybridBlock):
+        def __init__(self, func):
+            super(TestStandardUnary, self).__init__()
+            self._func = func
+
+        def forward(self, a):
+            return getattr(np, self._func)(a)
+
+    type_mapping = {
+        'floating-point': np.floating_dtypes,
+        'numeric': np.numeric_dtypes,
+        'integer or boolean': np.integer_dtypes + np.boolean_dtypes,
+        'boolean': np.boolean_dtypes,
+    }
+
+    def array_values(low, high, shape):
+        for d in np.integer_dtypes + np.boolean_dtypes + np.floating_dtypes:
+            yield onp.random.uniform(low, high, shape).astype(d), d
+
+
+    shapes = [i for i in [rand_shape_nd(ndim, dim=3), (1, 0, 2)]]
+    for shape in shapes:
+        for (np_test_data, dtype) in array_values(low, high, shape):
+            if dtype in type_mapping[dtypes]:
+                rtol = 1e-2 if dtype == np.float16 else 1e-3
+                atol = 1e-4 if dtype == np.float16 else 1e-5
+                # get rid of warning: divide by zero
+                if((func=='log' or func=='log10' or func=='log2') and
+                    (dtype=='int8' or dtype=='uint8' or dtype=='int32' or
+                    dtype=='int64')):
+                    low = 1
+                if (func=='arctanh' and dtype=='bool'):
+                    continue
+                np_func = getattr(onp, func2)
+                mx_func = TestStandardUnary(func)
+                mx_test_data = np.array(np_test_data, dtype=dtype)
+                for hybridize in [True, False]:
+                    if hybridize:
+                        mx_func.hybridize()
+                    if ref_grad:
+                        mx_test_data.attach_grad()
+                    np_out = np_func(np_test_data)
+                    with mx.autograd.record():
+                        y = mx_func(mx_test_data)
+                    assert y.shape == np_out.shape
+                    assert_almost_equal(y.asnumpy(), np_out, rtol=1e-3, atol=atol)
+                    if np_out.dtype == np.bool_:
+                        assert y.dtype == np.bool_
+
+                    if ref_grad and (dtype == 'float16' or dtype == 'float32' or dtype == 'float64'):
+                        y.backward()
+                        assert_almost_equal(mx_test_data.grad.asnumpy(), ref_grad(np_test_data), rtol=1e-1, atol=1e-2, equal_nan=True)
+
+                np_func = getattr(onp, func2)
+                mx_out = getattr(mx.np, func)(mx_test_data)
+                assert mx_out.shape == np_out.shape
+                assert np.result_type(mx_out) == dtype
+                assert_almost_equal(mx_out.asnumpy(), np_out, rtol=rtol, atol=1e-5)
+
+                assertRaises(NotImplementedError, getattr(np, func), mx_test_data, where=False)
+                assertRaises(NotImplementedError, getattr(np, func), mx_test_data, subok=False)
+                assertRaises(NotImplementedError, getattr(np, func), mx_test_data, dtype=onp.int8)
+                assertRaises(TypeError, getattr(np, func), mx_test_data, dtype="abcdefg")
+                assertRaises(NotImplementedError, getattr(np, func), mx_test_data, casting='safe')
+                assertRaises(TypeError, getattr(np, func), mx_test_data, casting='mxnet')
+                assertRaises(NotImplementedError, getattr(np, func), mx_test_data, order='C')
+                assertRaises(NotImplementedError, getattr(np, func), mx_test_data, order='mxnet')
+
+
+@use_np
+@pytest.mark.flaky
+@pytest.mark.parametrize('func,func2,promoted,dtypes,ref_grad_a,ref_grad_b,low,high', [
+    ('add', 'add', True, 'numeric', lambda y, x1, x2: onp.ones(y.shape), None, -1.0, 1.0),
+    ('atan2', 'arctan2', True, 'floating-point', lambda y, x1, x2: x2 / (onp.square(x1) + onp.square(x2)),
+                                                 lambda y, x1, x2: -x1 / (onp.square(x1) + onp.square(x2)), -1, 1),
+    ('bitwise_and', 'bitwise_and', True, 'integer or boolean', None, None, -100, 100),
+    ('bitwise_or', 'bitwise_or', True, 'integer or boolean', None, None, -100, 100),
+    ('bitwise_xor', 'bitwise_xor', True, 'integer or boolean', None, None, -100, 100),
+    ('divide', 'divide', True, 'floating-point', lambda y, x1, x2: onp.ones(y.shape) / x2,
+                                                 lambda y, x1, x2: -x1 / (x2 * x2), 0.1, 1.0),
+    ('equal', 'equal', False, 'all', None, None, 0.0, 2.0),
+    ('floor_divide', 'floor_divide', True, 'numeric', lambda y, x1, x2: onp.zeros(y.shape),
+                                                      lambda y, x1, x2: onp.zeros(y.shape), 2.0, 10.0),
+    ('greater', 'greater', False, 'numeric', None, None, 0.0, 2.0),
+    ('greater_equal', 'greater_equal', False, 'numeric', None, None, 0.0, 2.0),
+    ('less', 'less', False, 'numeric', None, None, 0.0, 2.0),
+    ('less_equal', 'less_equal', False, 'numeric', None, None, 0.0, 2.0),
+    ('logaddexp', 'logaddexp', True, 'floating-point', lambda y, x1, x2: onp.exp(x1) / (onp.exp(x1) + onp.exp(x2)),
+                                                       lambda y, x1, x2: onp.exp(x2) / (onp.exp(x1) + onp.exp(x2)), -10, 10),
+    ('logical_and', 'logical_and', False, 'boolean', None, None, -100, 100),
+    ('logical_or', 'logical_or', False, 'boolean', None, None, -100, 100),
+    ('logical_xor', 'logical_xor', False, 'boolean', None, None, -100, 100),
+    ('multiply', 'multiply', True, 'numeric', lambda y, x1, x2: onp.broadcast_to(x2, y.shape),
+                                              lambda y, x1, x2: onp.broadcast_to(x1, y.shape), -1.0, 1.0),
+    ('not_equal', 'not_equal', False, 'all', None, None, 0.0, 2.0),
+    ('pow', 'power', True, 'floating-point', lambda y, x1, x2: onp.power(x1, x2 - 1.0) * x2,
+                                             lambda y, x1, x2: onp.power(x1, x2) * onp.log(x1), 1.0, 3.0),
+    ('subtract', 'subtract', True, 'numeric', lambda y, x1, x2: onp.ones(y.shape),
+                                              lambda y, x1, x2: -onp.ones(y.shape), -1.0, 1.0),
+])
+@pytest.mark.parametrize('lshape,rshape', [
+    ((3, 2), (3, 2)),
+    ((3, 2), (3, 1)),
+    ((3, 1), (3, 0)),
+    ((0, 2), (1, 2)),
+    ((2, 3, 4), (3, 1)),
+# MXNet numpy does not match original numpy behavior when broadcasting 0-dim arrays.
+# See https://github.com/apache/incubator-mxnet/issues/20898.
+#    ((2, 3), ()),
+#    ((), (2, 3))
+    ((2, 3), (1,)),
+    ((1,), (2, 3))
+])
+def test_np_standard_binary_funcs(func, func2, promoted, dtypes, ref_grad_a, ref_grad_b, low, high, lshape, rshape):
+    class TestStandardBinary(HybridBlock):
+        def __init__(self, func):
+            super(TestStandardBinary, self).__init__()
+            self._func = func
+
+        def forward(self, a, b,):
+            return getattr(np, self._func)(a, b)
+
+    type_mapping = {
+        'floating-point': np.floating_dtypes,
+        'numeric': np.numeric_dtypes,
+        'integer or boolean': np.integer_dtypes + np.boolean_dtypes,
+        'boolean': np.boolean_dtypes,
+        'all': np.numeric_dtypes + np.boolean_dtypes,
+    }
+
+    def array_values(low, high, shape):
+        for d in np.integer_dtypes + np.boolean_dtypes + np.floating_dtypes:
+            yield onp.random.uniform(low, high, shape).astype(d), d
+
+
+    for (left_value, ltype) in array_values(low, high, lshape):
+        for (right_value, rtype) in array_values(low, high, rshape):
+            if ltype in type_mapping[dtypes] and rtype in type_mapping[dtypes]:
+                try:
+                    promote_type = np.result_type(ltype, rtype)
+                except Exception as e:
+                    # Unkown type promotion between two types
+                    continue
+                rtol = 1e-2 if ltype == np.float16 or rtype == np.float16 else 1e-3
+                atol = 1e-4 if ltype == np.float16 or rtype == np.float16 else 1e-5
+                mx_left_value = np.array(left_value, dtype=ltype)
+                mx_right_value = np.array(right_value, dtype=rtype)
+                mx_func = TestStandardBinary(func)
+                np_func = getattr(onp, func2)
+                for hybridize in [True, False]:
+                    if hybridize:
+                        mx_func.hybridize()
+                    if ref_grad_a:
+                        mx_left_value.attach_grad()
+                        mx_right_value.attach_grad()
+                    np_out = np_func(left_value, right_value)
+                    with mx.autograd.record():
+                        y = mx_func(mx_left_value, mx_right_value)
+                    assert y.shape == np_out.shape
+                    assert_almost_equal(y.asnumpy(), np_out.astype(y.dtype), rtol=rtol, atol=atol,
+                                        use_broadcast=False, equal_nan=True)
+
+                    if ref_grad_a and ltype in np.floating_dtypes and rtype in np.floating_dtypes:
+                        y.backward()
+                        assert_almost_equal(mx_left_value.grad.asnumpy(),
+                                            collapse_sum_like(ref_grad_a(y.asnumpy(), left_value, right_value), mx_left_value.shape),
+                                            rtol=1e-1, atol=1e-2, equal_nan=True, use_broadcast=False)
+                        if ref_grad_b is None:
+                            assert_almost_equal(mx_right_value.grad.asnumpy(),
+                                                collapse_sum_like(ref_grad_a(y.asnumpy(), right_value, left_value), mx_right_value.shape),
+                                                rtol=1e-1, atol=1e-2, equal_nan=True, use_broadcast=False)
+                        else:
+                            assert_almost_equal(mx_right_value.grad.asnumpy(),
+                                                collapse_sum_like(ref_grad_b(y.asnumpy(), left_value, right_value), mx_right_value.shape),
+                                                rtol=1e-1, atol=1e-2, equal_nan=True, use_broadcast=False)
+
+                np_out = getattr(onp, func2)(left_value, right_value)
+                mx_out = getattr(np, func)(mx_left_value, mx_right_value)
+                assert mx_out.shape == np_out.shape
+                if promoted:
+                    assert np.result_type(ltype, rtype) == mx_out.dtype
+                else:
+                    assert mx_out.dtype == np.bool_
+                assert_almost_equal(mx_out.asnumpy(), np_out.astype(mx_out.dtype), rtol=rtol, atol=atol,
+                                    use_broadcast=False, equal_nan=True)
+
+
+@use_np
+def test_np_tril_indices():
+    class TestTrilindices(HybridBlock):
+        def __init__(self, n, k=0, m=None):
+            super(TestTrilindices, self).__init__()
+            self._n = n;
+            self._k = k;
+            if m is None:
+                m = n
+            self._m = m
+
+        def forward(self, x, *args, **kwargs):
+            return x, np.tril_indices(n=self._n, k=self._k, m=self._m)
+
+    for n in onp.random.random_integers(-10, 50, 2):
+        for k in onp.random.random_integers(-50, 50, 2):
+            for m in onp.random.random_integers(-10, 50, 2):
+                np_out = onp.tril_indices(n, k, m)
+                for hybridize in [True, False]:
+                    # dummy nparray for hybridize
+                    x = np.ones((1,1))
+                    test_trilindices = TestTrilindices(int(n), int(k), int(m))
+                    if hybridize:
+                        test_trilindices.hybridize()
+                    mx_out = test_trilindices(x)[1]
+                    assert len(mx_out) == 2
+                    assert same(mx_out[0], np_out[0])
+                    assert same(mx_out[1], np_out[1])
+                    if n > 0 and m > 0 and hybridize is False:
+                        np_data = onp.arange(n*m).reshape(n, m)
+                        mx_data = np.array(np_data)
+                        np_data[np_out] = -10
+                        mx_data[mx_out] = -10
+                        assert same(np_data, mx_data.asnumpy())
+
+
+@use_np
+def test_np_fill_diagonal():
+    class TestFillDiagonal(HybridBlock):
+        def __init__(self, val, wrap=False):
+            super(TestFillDiagonal, self).__init__()
+            self._val = val
+            self._wrap= wrap
+
+        def forward(self, x):
+            return np.fill_diagonal(x, val=self._val, wrap=self._wrap)
+
+    configs = [
+        ((10, 10), 2),
+        ((10, 10), -2),
+        ((4, 10), -2),
+        ((10, 4), 2),
+        ((10, 10), [-2, 2]),
+        ((10, 10), [-2, 2]),
+        ((10, 5), [-2, 2, -1, -3]),
+        ((100, 50), [-2, 2, -1, -3]),
+        ((1000, 500), [-2, 2, -1, -3]),
+        ((5, 10), [-2, 2, -1, -3]),
+        ((50, 100), [-2, 2, -1, -3]),
+        ((500, 1000), [-2, 2, -1, -3]),
+        ((4, 4, 4), 2),
+        ((4, 4, 4, 4), 2),
+        ((4, 4, 4, 4, 4), [-1, 2]),
+        ((4, 4, 4, 4, 4, 4, 4, 4), 2),
+        ((5, 5, 5, 5, 5, 5, 5, 5), [-1, 2, -2]),
+        ((6, 6, 6, 6, 6, 6, 6, 6), 2),
+        ((7, 7, 7, 7, 7, 7, 7, 7), [-1, 2, -2]),
+    ]
+    dtypes = ['int8', 'int32', 'int64', 'float16', 'float32', 'float64']
+    for dtype in dtypes:
+        for config in configs:
+            for wrap in [False, True]:
+                np_data = onp.ones(config[0]).astype(dtype)
+                mx_data = np.array(np_data, dtype=dtype)
+                test_filldiagonal = TestFillDiagonal(config[1], wrap)
+                test_filldiagonal(mx_data)
+                onp.fill_diagonal(np_data, config[1], wrap)
+                assert same(np_data, mx_data.asnumpy())
+
+
+@use_np
+@pytest.mark.skip(reason='Skipped as the test is flaky and the feature causes curand error. Tracked in #18100')
+def test_np_diagflat():
+    class TestDiagflat(HybridBlock):
+        def __init__(self, k=0):
+            super(TestDiagflat,self).__init__()
+            self._k = k
+        def forward(self, a):
+            return np.diagflat(a, k=self._k)
+    shapes = [(2,),5 , (1,5), (2,2), (2,5), (3,3), (4,3),(4,4,5)] # test_shapes, remember to include zero-dim shape and zero-size shapes
+    dtypes = [np.int8, np.uint8, np.int32, np.int64, np.float16, np.float32, np.float64] # remember to include all meaningful data types for the operator
+    range_k = 6
+    for hybridize,shape,dtype, in itertools.product([False,True],shapes,dtypes):
+        rtol = 1e-2 if dtype == np.float16 else 1e-3
+        atol = 1e-4 if dtype == np.float16 else 1e-5
+
+        for k in range(-range_k,range_k):
+            test_diagflat = TestDiagflat(k)
+            if hybridize:
+                test_diagflat.hybridize()
+
+            x = np.random.uniform(-1.0,1.0, size = shape).astype(dtype)
+            x.attach_grad()
+
+            np_out = onp.diagflat(x.asnumpy(), k)
+            with mx.autograd.record():
+                mx_out = test_diagflat(x)
+
+            assert mx_out.shape == np_out.shape
+            assert_almost_equal(mx_out.asnumpy(),np_out,rtol = rtol, atol = atol)
+
+            mx_out.backward()
+            # Code to get the reference backward value
+            np_backward = np.ones(shape)
+            assert_almost_equal(x.grad.asnumpy(), np_backward, rtol=rtol, atol=atol)
+
+            # Test imperative once again
+            mx_out = np.diagflat(x, k)
+            np_out = onp.diagflat(x.asnumpy(), k)
+            assert_almost_equal(mx_out.asnumpy(), np_out, rtol=rtol, atol=atol)
+
+
+@use_np
+def test_np_einsum():
+    class TestEinsum(HybridBlock):
+        def __init__(self, subscripts, optimize):
+            super(TestEinsum, self).__init__()
+            self.subscripts = subscripts
+            self.optimize = optimize
+
+        def forward(self, *operands):
+            return np.einsum(self.subscripts, *operands, optimize=self.optimize)
+
+    def dbg(name, data):
+        print('type of {} = {}'.format(name, type(data)))
+        print('shape of {} = {}'.format(name, data.shape))
+        print('{} = {}'.format(name, data))
+
+    configs = [
+        ('ii', [(5, 5)], lambda *args: (onp.eye(5),)),
+        ('ii->i', [(5, 5)], lambda *args: (onp.eye(5),)),
+        ('ij->i', [(5, 5)], lambda *args: (onp.ones((5, 5)),)),
+        ('...j->...', [(5, 5)], lambda *args: (onp.ones((5, 5)),)),
+        ('ji', [(2, 3)], lambda *args: (onp.ones((2, 3)),)),
+        ('ij->ji', [(2, 3)], lambda *args: (onp.ones((2, 3)),)),
+        ('i, i', [(5,), (5,)], lambda *args: (args[1], args[0])),
+        ('ij, j', [(5, 5), (5,)], lambda *args: (onp.tile(args[1][None, :], [5, 1]),
+                                                 args[0].sum(axis=0))),
+        ('...j, j', [(5, 5), (5,)], lambda *args: (onp.tile(args[1][None, :], [5, 1]),
+                                                   onp.sum(args[0], axis=0))),
+        ('..., ...', [(), (2, 3)], lambda *args: (onp.sum(args[1], axis=None),
+                                                  args[0] * onp.ones((2, 3)))),
+        (', ij', [(), (2, 3)], lambda *args: (onp.sum(args[1], axis=None),
+                                              args[0] * onp.ones((2, 3)))),
+        ('i, j', [(2,), (5, )], lambda *args: (onp.sum(args[1], axis=None) * onp.ones(2),
+                                               onp.sum(args[0], axis=None) * onp.ones(5))),
+        ('ijk, jil->kl', [(3, 4, 5), (4, 3, 2)], lambda *args: (onp.tile(onp.transpose(onp.sum(args[1],
+                                                                                               axis=-1))[:, :, None],
+                                                                         [1, 1, 5]),
+                                                                onp.tile(onp.transpose(onp.sum(args[0],
+                                                                                               axis=-1))[:, :, None],
+                                                                         [1, 1, 2]))),
+        ('ii->i', [(3, 3)], lambda *args: (onp.eye(3),)),
+        ('ki, jk->ij', [(3, 2), (4, 3)], lambda *args: (onp.tile(args[1].sum(axis=0)[:, None], [1, 2]),
+                                                        onp.tile(args[0].sum(axis=1)[None, :], [4, 1]))),
+        ('ki, ...k->i...', [(3, 2), (4, 3)], lambda *args: (onp.tile(args[1].sum(axis=0)[:, None], [1, 2]),
+                                                            onp.tile(args[0].sum(axis=1)[None, :], [4, 1]))),
+        ('k..., jk', [(3, 2), (4, 3)], lambda *args: (onp.tile(args[1].sum(axis=0)[:, None], [1, 2]),
+                                                      onp.tile(args[0].sum(axis=1)[None, :], [4, 1]))),
+        ('ij, jk', [(5, 0), (0, 4)], lambda *args: (onp.empty((5, 0)), onp.empty((0, 4)))),
+        (('ij,jk,kl->il'), [(2, 2), (2, 5), (5, 2)], lambda *args: (onp.dot(onp.ones((2, 2)), onp.dot(args[1], args[2]).T),
+                                                                    onp.dot(args[0].T, onp.dot(onp.ones((2, 2)), args[2].T)),
+                                                                    onp.dot(onp.dot(args[0], args[1]).T, onp.ones((2, 2))))),
+        # broadcast bug
+        ('ij, ij -> i', [(1, 4), (2, 4)], lambda *args: (onp.sum(args[1], axis=0)[None, :],
+                                                         onp.tile(args[0], [2, 1]))),
+        # one dimensim bug
+        ('...ij, ...jk -> ...ik', [(1, 4), (4, 2)], lambda *args: (args[1].sum(axis=1)[None, :],
+                                                                   onp.tile(args[0].sum(axis=0)[: ,None], [1, 2]))),
+        ('...ij, ...jk -> ...ik', [(2, 4), (4, 2)], lambda *args: (onp.tile(args[1].sum(axis=1)[None, :], [2, 1]),
+                                                                   onp.tile(args[0].sum(axis=0)[: ,None], [1, 2]))),
+        ('...ij, ...jk -> ...ik', [(3, 2, 1, 4), (3, 2, 4, 2)], lambda *args: (
+                                                            args[1].sum(axis=3)[:, :, None, :],
+                                                            onp.tile(args[0].sum(axis=2)[:, :, :, None], [1, 1, 1, 2]))),
+        ('...ij, ...ik -> ...jk', [(1, 1, 1, 4), (1, 1, 1, 3)], lambda *args: (
+                                                            onp.tile(args[1].sum(axis=3)[:, :, :, None], [1, 1, 1, 4]),
+                                                            onp.tile(args[0].sum(axis=3)[:, :, : ,None], [1, 1, 1, 3]))),
+        ('...ij, ...jc -> ...ic', [(1, 1, 5, 3), (1, 1, 3, 2)], lambda *args: (
+                                                            onp.tile(args[1].sum(axis=3)[:, :, None, :], [1, 1, 5, 1]),
+                                                            onp.tile(args[0].sum(axis=2)[:, :, : ,None], [1, 1, 1, 2]))),
+        ('...ij, ...jc -> ...ic', [(1, 2, 5, 4), (1, 2, 4, 2)], lambda *args: (
+                                                            onp.tile(args[1].sum(axis=3)[:, :, None, :], [1, 1, 5, 1]),
+                                                            onp.tile(args[0].sum(axis=2)[:, :, : ,None], [1, 1, 1, 2]))),
+        ('...ij, ...jc -> ...ic', [(2, 1, 5, 4), (2, 1, 4, 2)], lambda *args: (
+                                                            onp.tile(args[1].sum(axis=3)[:, :, None, :], [1, 1, 5, 1]),
+                                                             onp.tile(args[0].sum(axis=2)[:, :, : ,None], [1, 1, 1, 2]))),
+        # issue #16576
+        # commented due to long running time
+        # ('abiz,abjz->abij', [(64, 8, 128, 512), (64, 8, 128, 512)], lambda *args: (onp.matmul(onp.ones((64, 8, 128, 128)), args[1]),
+        #                                                                            onp.matmul(onp.ones((64, 8, 128, 128)), args[0]))),
+    ]
+    dtypes = ['float32', 'float64', 'int32']
+    acc_type = {'float16': 'float32', 'float32': 'float64', 'float64': 'float64',
+                'int32': 'int64'}
+    for hybridize in [False, True]:
+        for dtype in dtypes:
+            for config in configs:
+                for optimize in [False, True]:
+                    rtol = 1e-2 if dtype == 'float16' else 1e-3
+                    atol = 1e-4 if dtype == 'float16' else 1e-5
+                    (subscripts, operands, get_grad) = config
+                    test_einsum = TestEinsum(subscripts, optimize)
+                    if hybridize:
+                        test_einsum.hybridize()
+                    x = []
+                    x_np = []
+                    for shape in operands:
+                        tmp = onp.array(onp.random.uniform(-1.0, 1.0, shape), dtype=dtype)
+                        x_np.append(tmp.astype(acc_type[dtype]))
+                        x.append(np.array(tmp, dtype=dtype))
+                        x[-1].attach_grad()
+                    expected_np = onp.einsum(subscripts, *x_np, optimize=optimize).astype(dtype)
+                    with mx.autograd.record():
+                        out_mx = test_einsum(*x)
+                    assert out_mx.shape == expected_np.shape
+                    assert_almost_equal(out_mx.asnumpy(), expected_np, rtol=rtol, atol=atol)
+                    out_mx.backward()
+                    for (iop, op) in enumerate(x):
+                        assert_almost_equal(op.grad.asnumpy(), get_grad(*x_np)[iop], rtol=rtol, atol=atol)
+
+                    # Test imperative once again
+                    for op in x:
+                        op.attach_grad()
+                    with mx.autograd.record():
+                        out_mx = np.einsum(subscripts, *x, optimize=optimize)
+                    out_mx.backward()
+                    expected_np = onp.einsum(subscripts, *x_np, optimize=optimize)
+                    assert_almost_equal(out_mx.asnumpy(), expected_np, rtol=rtol, atol=atol)
+                    for (iop, op) in enumerate(x):
+                        assert_almost_equal(op.grad.asnumpy(), get_grad(*x_np)[iop].astype(dtype), rtol=rtol, atol=atol)
+    configs = [
+        (('ij,jk,kl->il'), [(2, 2), (2, 5), (5, 2)]),
+        (('ea,fb,abcd,gc,hd->efgh'), [(5, 5), (5, 5), (5, 5, 5, 5), (5, 5), (5, 5)]),
+    ]
+    dtypes = ['int32', 'float32', 'float64']
+    for hybridize in [False, True]:
+        for dtype in dtypes:
+            for config in configs:
+                (subscripts, operands) = config
+                rtol = 1e-2 if dtype == 'float16' else 1e-3
+                atol = 1e-3 if dtype == 'float16' else 1e-4
+                grad = []
+                x_np = []
+                for shape in operands:
+                    x_np.append(onp.array(onp.random.uniform(-2.0, 2.0, shape),
+                                          dtype=dtype))
+                for optimize in [False, True]:
+                    x = []
+                    for iop in range(len(operands)):
+                        x.append(np.array(x_np[iop], dtype=dtype))
+                        x[-1].attach_grad()
+                    test_einsum = TestEinsum(subscripts, optimize)
+                    if hybridize:
+                        test_einsum.hybridize()
+                    expected_np = onp.einsum(subscripts, *[op.astype(acc_type[dtype]) for op in x_np],
+                                             optimize=optimize).astype(dtype)
+                    with mx.autograd.record():
+                        out_mx = test_einsum(*x)
+                    assert out_mx.shape == expected_np.shape
+                    assert_almost_equal(out_mx.asnumpy(), expected_np, rtol=rtol, atol=atol)
+                    out_mx.backward()
+                    cur_grad = []
+                    for op in x:
+                        cur_grad.append(op.grad.asnumpy())
+                    grad.append(cur_grad)
+                for iop in range(len(grad[0])):
+                    assert_almost_equal(grad[0][iop], grad[1][iop], rtol=rtol, atol=atol)
+
+
+@use_np
+def test_np_pad():
+    class TestPad(HybridBlock):
+        def __init__(self, pad_width, mode='constant'):
+            super(TestPad,self).__init__()
+            self._pad_width = pad_width
+            self._mode = mode
+        def forward(self, A, **kwargs):
+            return np.pad(A, self._pad_width, mode=self._mode, **kwargs)
+
+    shapes = [6, (1,5), (2,2), (2,2), (3,3), (2,3), (3,4,5)]
+    dtypes = [np.int8, np.uint8, np.int32, np.int64, np.float16, np.float32, np.float64]
+    mode = ['constant', 'reflect', 'symmetric', 'edge', 'minimum', 'maximum']
+    for hybridize, shape, dtype, in itertools.product([False,True], shapes, dtypes):
+        rtol = 1e-2 if dtype == np.float16 else 1e-3
+        atol = 1e-4 if dtype == np.float16 else 1e-5
+
+        for m in mode:
+            x = np.random.uniform(-1.0, 1.0, size = shape).astype(dtype)
+            pw = ()
+            if (type(shape) == int):
+                pw += (2,3)
+            else:
+                for _ in range(len(shape)):
+                    pw += ((2,3),)
+            test_pad = TestPad(pw, m)
+            if hybridize:
+                test_pad.hybridize()
+            x.attach_grad()
+
+            if(m != 'constant'):
+                np_out = onp.pad(x.asnumpy(), pw, mode=m)
+            else:
+                np_out = onp.pad(x.asnumpy(), pw, mode=m, constant_values=0)
+            with mx.autograd.record():
+                mx_out = test_pad(x)
+
+            # code to get the reference value
+            assert mx_out.shape == np_out.shape
+            assert_almost_equal(mx_out.asnumpy(), np_out, rtol = rtol, atol = atol)
+
+            # test gradient
+            if m == "constant":
+                device = mx.device.current_device()
+                x = mx.np.random.uniform(-1.0, 1.0, size=shape)
+                x = mx.np.array(x, device=device)
+                for grad_req in ['write', 'add']:
+                    x.attach_grad(grad_req)
+                    if grad_req == 'add':
+                        init_grad = mx.np.random.uniform(-1.0, 1.0, size=shape, device=device)
+                        x.grad[:] = init_grad
+                    with mx.autograd.record():
+                        mx_out = mx.np.pad(x, pad_width=pw, mode="constant")
+                        out_grad = mx.np.random.normal(0, 1, mx_out.shape)
+                        out_grad = mx.np.array(out_grad, device=device)
+                        loss = mx_out * out_grad
+                        loss = loss.sum()
+                        loss.backward()
+                    gt_in_grad = mx.np.pad(mx.np.ones_like(x.grad), pad_width=pw, mode="constant") * mx.np.array(out_grad, device=device)
+                    mx_grad = x.grad
+                    if grad_req == 'add':
+                        assert_almost_equal(mx.np.pad(mx_grad - init_grad, pad_width=pw, mode="constant"), gt_in_grad.asnumpy(), rtol=rtol, atol=atol)
+                    else:
+                        assert_almost_equal(mx.np.pad(mx_grad, pad_width=pw, mode="constant"), gt_in_grad.asnumpy(), rtol=rtol, atol=atol)

