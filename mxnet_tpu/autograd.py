@@ -105,7 +105,12 @@ def _head_grads(heads, head_grads):
         if g is None:
             gs.append(jnp.ones(h.shape, h._data.dtype))
         else:
-            gs.append(g._data if isinstance(g, ndarray) else jnp.asarray(g))
+            gv = g._data if isinstance(g, ndarray) else jnp.asarray(g)
+            # the reference casts out_grads to the head dtype (an int
+            # cotangent against a float output is accepted there)
+            if gv.dtype != h._data.dtype:
+                gv = gv.astype(h._data.dtype)
+            gs.append(gv)
     return gs
 
 

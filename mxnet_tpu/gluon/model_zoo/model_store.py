@@ -1,52 +1,159 @@
-"""Local model-zoo weight store (parity:
-`python/mxnet/gluon/model_zoo/model_store.py`).
+"""Model-zoo weight store: url/sha1 tables, checksum-verified download,
+`MXTPU_HOME` cache (parity: `python/mxnet/gluon/model_zoo/model_store.py:31-87`).
 
-The reference downloads `{name}-{short_hash}.params` into
-`$MXNET_HOME/models`; this environment has zero egress, so the store is
-LOCAL-ONLY: `get_model_file` finds a weights file already placed in
-`root` (default `$MXNET_HOME/models` or `~/.mxnet/models`) and the
-`pretrained=True` factories load it.  Stock-MXNet zoo files load
-directly — the binary `.params` reader
-(`ndarray/legacy_serialization.py`) handles their format.
+Resolution order for `get_model_file(name)`:
 
-Accepted filenames for model `name`, in order: `{name}.params` (a user's
-own save — an explicit override wins), then the first sorted
-`{name}-{anything}.params` match (the reference's hash-stamped layout,
-e.g. `resnet50_v1-0aee57f9.params`).
+1. `{name}.params` in the cache root — a user's explicit local override
+   always wins (and needs no checksum).
+2. `{name}-{short_hash}.params` in the cache root with a VALID sha1 —
+   the reference's hash-stamped cache layout.
+3. Download `{repo_url}gluon/models/{name}-{short_hash}.zip`, verify the
+   zip contents' sha1 against the table, extract, and cache.  The repo
+   URL comes from `MXTPU_GLUON_REPO` (legacy `MXNET_GLUON_REPO` honored)
+   and may be a `file://` URL — which is also how the offline tests
+   drive the full download/verify/extract path on this zero-egress box.
+
+The sha1 table below lists the official published zoo artifacts — the
+checksums ARE the compatibility contract (the same bytes the reference
+distributes must verify here), like the `.params` magic numbers.  Models
+registered at runtime via `register_model_sha1` (tests, private zoos)
+extend the table.
 """
 from __future__ import annotations
 
-import glob
 import os
+import zipfile
 
 from ...base import MXNetError
+from ..utils import check_sha1, download
 
-__all__ = ["get_model_file", "load_pretrained"]
+__all__ = ["get_model_file", "load_pretrained", "purge", "short_hash",
+           "register_model_sha1", "data_dir"]
+
+# sha1 -> name pairs of the official zoo artifacts (model_store.py:31-66)
+_model_sha1 = {name: checksum for checksum, name in [
+    ("44335d1f0046b328243b32a26a4fbd62d9057b45", "alexnet"),
+    ("f27dbf2dbd5ce9a80b102d89c7483342cd33cb31", "densenet121"),
+    ("b6c8a95717e3e761bd88d145f4d0a214aaa515dc", "densenet161"),
+    ("2603f878403c6aa5a71a124c4a3307143d6820e9", "densenet169"),
+    ("1cdbc116bc3a1b65832b18cf53e1cb8e7da017eb", "densenet201"),
+    ("ed47ec45a937b656fcc94dabde85495bbef5ba1f", "inceptionv3"),
+    ("9f83e440996887baf91a6aff1cccc1c903a64274", "mobilenet0.25"),
+    ("8e9d539cc66aa5efa71c4b6af983b936ab8701c3", "mobilenet0.5"),
+    ("529b2c7f4934e6cb851155b22c96c9ab0a7c4dc2", "mobilenet0.75"),
+    ("6b8c5106c730e8750bcd82ceb75220a3351157cd", "mobilenet1.0"),
+    ("36da4ff1867abccd32b29592d79fc753bca5a215", "mobilenetv2_1.0"),
+    ("e2be7b72a79fe4a750d1dd415afedf01c3ea818d", "mobilenetv2_0.75"),
+    ("aabd26cd335379fcb72ae6c8fac45a70eab11785", "mobilenetv2_0.5"),
+    ("ae8f9392789b04822cbb1d98c27283fc5f8aa0a7", "mobilenetv2_0.25"),
+    ("a0666292f0a30ff61f857b0b66efc0228eb6a54b", "resnet18_v1"),
+    ("48216ba99a8b1005d75c0f3a0c422301a0473233", "resnet34_v1"),
+    ("0aee57f96768c0a2d5b23a6ec91eb08dfb0a45ce", "resnet50_v1"),
+    ("d988c13d6159779e907140a638c56f229634cb02", "resnet101_v1"),
+    ("671c637a14387ab9e2654eafd0d493d86b1c8579", "resnet152_v1"),
+    ("a81db45fd7b7a2d12ab97cd88ef0a5ac48b8f657", "resnet18_v2"),
+    ("9d6b80bbc35169de6b6edecffdd6047c56fdd322", "resnet34_v2"),
+    ("ecdde35339c1aadbec4f547857078e734a76fb49", "resnet50_v2"),
+    ("18e93e4f48947e002547f50eabbcc9c83e516aa6", "resnet101_v2"),
+    ("f2695542de38cf7e71ed58f02893d82bb409415e", "resnet152_v2"),
+    ("264ba4970a0cc87a4f15c96e25246a1307caf523", "squeezenet1.0"),
+    ("33ba0f93753c83d86e1eb397f38a667eaf2e9376", "squeezenet1.1"),
+    ("dd221b160977f36a53f464cb54648d227c707a05", "vgg11"),
+    ("ee79a8098a91fbe05b7a973fed2017a6117723a8", "vgg11_bn"),
+    ("6bc5de58a05a5e2e7f493e2d75a580d83efde38c", "vgg13"),
+    ("7d97a06c3c7a1aecc88b6e7385c2b373a249e95e", "vgg13_bn"),
+    ("e660d4569ccb679ec68f1fd3cce07a387252a90a", "vgg16"),
+    ("7f01cf050d357127a73826045c245041b0df7363", "vgg16_bn"),
+    ("ad2f660d101905472b83590b59708b71ea22b2e5", "vgg19"),
+    ("f360b758e856f1074a85abd5fd873ed1d98297c3", "vgg19_bn"),
+]}
+
+_DEFAULT_REPO = "https://apache-mxnet.s3-accelerate.dualstack.amazonaws.com/"
+_url_format = "{repo_url}gluon/models/{file_name}.zip"
 
 
-def _default_root() -> str:
-    home = os.environ.get("MXNET_HOME")
-    if home:
-        return os.path.join(home, "models")
-    return os.path.join(os.path.expanduser("~"), ".mxnet", "models")
+def data_dir() -> str:
+    """Cache root: `$MXTPU_HOME` (legacy `$MXNET_HOME` honored), default
+    `~/.mxnet` (the reference's spelling, so existing caches are found)."""
+    return os.environ.get("MXTPU_HOME") or os.environ.get("MXNET_HOME") \
+        or os.path.join(os.path.expanduser("~"), ".mxnet")
+
+
+def _repo_url() -> str:
+    url = os.environ.get("MXTPU_GLUON_REPO") \
+        or os.environ.get("MXNET_GLUON_REPO") or _DEFAULT_REPO
+    if not url.endswith("/"):
+        url += "/"
+    return url
+
+
+def register_model_sha1(name: str, sha1: str) -> None:
+    """Extend the zoo table at runtime (private zoos, tests)."""
+    _model_sha1[name] = sha1
+
+
+def short_hash(name: str) -> str:
+    if name not in _model_sha1:
+        raise ValueError(f"Pretrained model for {name} is not available.")
+    return _model_sha1[name][:8]
 
 
 def get_model_file(name: str, root: str | None = None) -> str:
-    """Path of the local weights file for `name`; raises with download
-    instructions when absent (no network egress here)."""
-    root = os.path.expanduser(root or _default_root())
-    exact = os.path.join(root, f"{name}.params")
-    if os.path.isfile(exact):
-        return exact
-    stamped = sorted(glob.glob(os.path.join(root, f"{name}-*.params")))
-    if stamped:
-        return stamped[0]
-    raise MXNetError(
-        f"no local weights for model {name!r}: looked for "
-        f"'{name}.params' or '{name}-*.params' under {root}. This "
-        "environment cannot download; place a stock-MXNet zoo file "
-        "(binary .params) or a save_parameters output there, or pass "
-        "root=<dir>.")
+    """Return the local path of the verified weights for `name`,
+    downloading (and sha1-checking) into the cache on a miss."""
+    root = os.path.expanduser(root or os.path.join(data_dir(), "models"))
+
+    override = os.path.join(root, f"{name}.params")
+    if os.path.isfile(override):
+        return override
+
+    if name not in _model_sha1:
+        # local-only fallback for names outside the official table: any
+        # hash-stamped file the user placed
+        import glob as _glob
+        stamped = sorted(_glob.glob(os.path.join(root,
+                                                 f"{name}-*.params")))
+        if stamped:
+            return stamped[0]
+        raise MXNetError(
+            f"Pretrained model for {name!r} is not available: not in the "
+            f"zoo table and no local '{name}.params'/'{name}-*.params' "
+            f"under {root} (register_model_sha1() extends the table)")
+
+    file_name = f"{name}-{short_hash(name)}"
+    file_path = os.path.join(root, file_name + ".params")
+    sha1 = _model_sha1[name]
+    if os.path.exists(file_path):
+        if check_sha1(file_path, sha1):
+            return file_path
+        # stale/corrupt cache entry: re-fetch below
+    os.makedirs(root, exist_ok=True)
+
+    zip_path = os.path.join(root, file_name + ".zip")
+    url = _url_format.format(repo_url=_repo_url(), file_name=file_name)
+    download(url, path=zip_path, overwrite=True)
+    with zipfile.ZipFile(zip_path) as zf:
+        zf.extractall(root)
+    os.remove(zip_path)
+    if not check_sha1(file_path, sha1):
+        try:
+            os.remove(file_path)
+        except OSError:
+            pass
+        raise MXNetError(
+            f"downloaded model {name} failed sha1 verification; the "
+            "corrupt copy was removed from the cache")
+    return file_path
+
+
+def purge(root: str | None = None) -> None:
+    """Remove all cached zoo files (parity: model_store.purge)."""
+    root = os.path.expanduser(root or os.path.join(data_dir(), "models"))
+    if not os.path.isdir(root):
+        return
+    for f in os.listdir(root):
+        if f.endswith(".params"):
+            os.remove(os.path.join(root, f))
 
 
 def load_pretrained(net, pretrained: bool, name: str, root=None):

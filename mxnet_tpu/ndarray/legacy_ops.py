@@ -329,7 +329,14 @@ flip = reverse
 
 
 def tile(data, reps, out=None):
-    return _op(lambda x: jnp.tile(x, tuple(reps)), data, name="tile", out=out)
+    reps = tuple(reps) if not isinstance(reps, int) else (reps,)
+    from ..util import is_np_shape
+    if any(int(r) < 0 for r in reps) or \
+            (not is_np_shape() and any(int(r) == 0 for r in reps)):
+        # the reference's InferShape rejects negative reps always and
+        # zero reps outside np-shape semantics
+        raise MXNetError(f"tile: invalid reps {reps}")
+    return _op(lambda x: jnp.tile(x, reps), data, name="tile", out=out)
 
 
 def repeat(data, repeats, axis=None, out=None):
@@ -383,8 +390,17 @@ def batch_take(a, indices, out=None):
 
 
 def where(condition, x, y, out=None):
-    return _op(lambda c, a, b: jnp.where(c.astype(bool), a, b),
-               condition, x, y, name="where", out=out)
+    def fn(c, a, b):
+        if c.shape != a.shape and c.shape != (a.shape[0],):
+            # reference: condition must match x's shape exactly or be the
+            # 1-D row selector (`src/operator/tensor/control_flow_op.h`)
+            raise MXNetError(f"where: condition shape {c.shape} must be "
+                             f"{a.shape} or ({a.shape[0]},)")
+        if c.ndim == 1 and a.ndim > 1:
+            # legacy row-selector form: a 1-D condition picks whole rows
+            c = c.reshape((c.shape[0],) + (1,) * (a.ndim - 1))
+        return jnp.where(c.astype(bool), a, b)
+    return _op(fn, condition, x, y, name="where", out=out)
 
 
 def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype="float32",
@@ -579,8 +595,10 @@ def clip(data, a_min, a_max, out=None):
 
 
 def cast(data, dtype, out=None):
-    return _op(lambda x: x.astype(jnp.dtype(dtype)), data, name="cast",
-               out=out)
+    # route through ndarray.astype: it carries the reference Cast's
+    # straight-through backward (cotangent cast to source dtype)
+    d = data if isinstance(data, ndarray) else ndarray(jnp.asarray(data))
+    return _write_out(d.astype(jnp.dtype(dtype)), out)
 
 
 Cast = cast
@@ -1462,6 +1480,9 @@ def depth_to_space(data, block_size, out=None):
 
     def fn(x):
         N, C, H, W = x.shape
+        if b <= 0 or C % (b * b) != 0 or 0 in (N, C, H, W):
+            raise MXNetError(f"depth_to_space: block {b} invalid for "
+                             f"shape {(N, C, H, W)}")
         y = x.reshape(N, b, b, C // (b * b), H, W)
         y = y.transpose(0, 3, 4, 1, 5, 2)
         return y.reshape(N, C // (b * b), H * b, W * b)
@@ -1474,6 +1495,9 @@ def space_to_depth(data, block_size, out=None):
 
     def fn(x):
         N, C, H, W = x.shape
+        if b <= 0 or H % b != 0 or W % b != 0 or 0 in (N, C, H, W):
+            raise MXNetError(f"space_to_depth: block {b} invalid for "
+                             f"shape {(N, C, H, W)}")
         y = x.reshape(N, C, H // b, b, W // b, b)
         y = y.transpose(0, 3, 5, 1, 2, 4)
         return y.reshape(N, C * b * b, H // b, W // b)
@@ -1642,3 +1666,23 @@ def unravel_index(data, shape=None, out=None):
 
 __all__ += ["zeros", "ones", "empty", "full", "split_v2",
             "ravel_multi_index", "unravel_index"]
+
+
+def diag(data, k=0, axis1=0, axis2=1, out=None):
+    """Legacy diag: 1-D -> diagonal matrix, >=2-D -> diagonal extraction
+    over (axis1, axis2); out-of-range k is an error, as the reference's
+    InferShape rejects empty diagonals (`src/operator/tensor/diag_op.cc`)."""
+    def fn(x):
+        if x.ndim >= 2:
+            h, w = x.shape[axis1], x.shape[axis2]
+            if (k >= 0 and k >= w) or (k < 0 and -k >= h):
+                raise MXNetError(f"diag: k={k} out of range for "
+                                 f"dims ({h}, {w})")
+            if x.ndim == 2:
+                return jnp.diag(x, k)
+            return jnp.diagonal(x, offset=k, axis1=axis1, axis2=axis2)
+        return jnp.diag(x, k)
+    return _op(fn, data, name="diag", out=out)
+
+
+__all__ += ["diag"]

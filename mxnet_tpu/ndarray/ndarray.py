@@ -234,6 +234,29 @@ class ndarray:
         check_x64_dtype(dtype)
         if not copy and self.dtype == _np.dtype(dtype):
             return self
+        if _tape.is_recording() and not is_tracer(self._data) and \
+                (self._ag_node is not None or self._grad_req != "null"):
+            # reference Cast semantics: backward casts the cotangent to
+            # the SOURCE dtype regardless of target — including integer
+            # targets, where a functional vjp would refuse/zero out
+            # (`src/operator/tensor/elemwise_unary_op.h` CastCompute pair)
+            src_dt = self._data.dtype
+            dt = jnp.dtype(dtype)
+            out = self._data.astype(dt)
+
+            def _cast_vjp(cot, _src=src_dt):
+                c = cot[0] if isinstance(cot, (tuple, list)) else cot
+                return (jnp.asarray(c).astype(_src),)
+
+            node = _tape.record_node(
+                _cast_vjp, [self], 1, name="astype",
+                out_avals=[(tuple(out.shape), out.dtype)],
+                fwd_fn=lambda x, _dt=dt: x.astype(_dt))
+            node.out_is_tuple = False
+            w = ndarray(out, self._device, _no_copy=True)
+            w._ag_node = node
+            w._ag_out_index = 0
+            return w
         return apply_op(lambda x: x.astype(dtype), (self,), {}, name="astype")
 
     def as_np_ndarray(self):
@@ -653,7 +676,7 @@ def _apply_op(fn: Callable, array_args: Sequence[ndarray], kwargs: dict,
         for i, a in enumerate(array_args):
             if isinstance(a, ndarray) and \
                     (a._ag_node is not None or a._grad_req != "null") \
-                    and _is_inexact(a._data):
+                    and (_is_inexact(a._data) or _is_int_diffable(a._data)):
                 diff_idx.append(i)
 
     if not diff_idx:
@@ -665,8 +688,15 @@ def _apply_op(fn: Callable, array_args: Sequence[ndarray], kwargs: dict,
             raise MXNetError(f"{name}: {e}") from e
         return _wrap_outputs(out, device)
 
-    # differentiable path: capture vjp w.r.t. the tracked float inputs
+    # differentiable path: capture vjp w.r.t. the tracked inputs.  JAX
+    # refuses to differentiate integer operands, but the reference's
+    # executor propagates gradients through int args (Cast, tile of int
+    # data, ...) — for those we linearize a FLOAT SHADOW of the op (int
+    # diff-args cast to f32) while keeping the real forward outputs, and
+    # cast cotangents back at the boundary.  Pure-float calls take the
+    # direct vjp path unchanged.
     const = list(vals)
+    shadow_idx = {i for i in diff_idx if not _is_inexact(vals[i])}
 
     def fn_of_diff(*diff_vals):
         v = list(const)
@@ -674,15 +704,32 @@ def _apply_op(fn: Callable, array_args: Sequence[ndarray], kwargs: dict,
             v[i] = dv
         return fn(*v, **kwargs) if kwargs else fn(*v)
 
-    diff_vals = [vals[i] for i in diff_idx]
     try:
-        out, vjp_fn = jax.vjp(fn_of_diff, *diff_vals)
+        if not shadow_idx:
+            diff_vals = [vals[i] for i in diff_idx]
+            out, vjp_fn = jax.vjp(fn_of_diff, *diff_vals)
+        else:
+            out = fn(*vals, **kwargs) if kwargs else fn(*vals)
+            shadow_vals = [vals[i].astype(jnp.float32)
+                           if i in shadow_idx else vals[i]
+                           for i in diff_idx]
+            shadow_out, raw_vjp = jax.vjp(fn_of_diff, *shadow_vals)
+            s_outs = list(shadow_out) if isinstance(
+                shadow_out, (tuple, list)) else [shadow_out]
+            s_dtypes = [o.dtype for o in s_outs]
+            arg_dtypes = [vals[i].dtype for i in diff_idx]
+
+            def vjp_fn(cot, _raw=raw_vjp, _sd=s_dtypes, _ad=arg_dtypes):
+                cs = list(cot) if isinstance(cot, (tuple, list)) else [cot]
+                cs = [c.astype(d) for c, d in zip(cs, _sd)]
+                cs = tuple(cs) if isinstance(cot, (tuple, list)) else cs[0]
+                gs = _raw(cs)
+                return tuple(g.astype(d) for g, d in zip(gs, _ad))
     except (TypeError, ValueError) as e:
         raise MXNetError(f"{name}: {e}") from e
 
     is_multi = isinstance(out, (tuple, list))
     outs = list(out) if is_multi else [out]
-    # only float outputs participate in the tape
     out_avals = [(tuple(o.shape), o.dtype) for o in outs]
     node = _tape.record_node(vjp_fn, [array_args[i] for i in diff_idx],
                              len(outs), name=name, out_avals=out_avals,
@@ -691,13 +738,21 @@ def _apply_op(fn: Callable, array_args: Sequence[ndarray], kwargs: dict,
     wrapped = []
     for i, o in enumerate(outs):
         w = ndarray(o, device, _no_copy=True)
-        if jnp.issubdtype(o.dtype, jnp.inexact):
+        # float outputs always join the tape; int outputs join only in
+        # shadow mode (reference: grads flow through int data)
+        if jnp.issubdtype(o.dtype, jnp.inexact) or shadow_idx:
             w._ag_node = node
             w._ag_out_index = i
         wrapped.append(w)
     if not is_multi:
         return wrapped[0]
     return tuple(wrapped)
+
+
+def _is_int_diffable(v):
+    """Integer (not bool) arrays are differentiable through the float
+    shadow; bool stays non-differentiable (conditions/masks)."""
+    return jnp.issubdtype(v.dtype, jnp.integer)
 
 
 def _wrap_outputs(out, device):

@@ -386,7 +386,14 @@ class Symbol:
         missing = [n for n in self.list_arguments() if n not in args]
         if missing:
             raise MXNetError(f"simple_bind missing shapes for {missing}")
-        return Executor(self, device or ctx, args, None, grad_req)
+        # the reference's simple_bind allocates gradient arrays alongside
+        # the args whenever grad_req != null — callers index grad_dict
+        # (and write into it for grad_req='add') before any backward
+        args_grad = None
+        if grad_req != "null":
+            args_grad = {n: mnp.zeros(a.shape, dtype=a.dtype)
+                         for n, a in args.items()}
+        return Executor(self, device or ctx, args, args_grad, grad_req)
 
     _simple_bind = simple_bind
 

@@ -78,7 +78,10 @@ def rand_ndarray(shape, stype="default", density=None, dtype="float32",
         data = data * keep
     if modifier_func is not None:
         data = _onp.vectorize(modifier_func)(data)
-    return array(data.astype(dtype), device=device or ctx)
+    # dtype passed explicitly: bare f64 host data would fall back to the
+    # default float; an explicit float64 request must be honored (x64 on)
+    # or raise loudly (x64 off)
+    return array(data, dtype=dtype, device=device or ctx)
 
 
 def rand_shape_2d(dim0=10, dim1=10):
@@ -157,6 +160,21 @@ def check_numeric_gradient(f: Callable, inputs: Sequence[ndarray],
     else:
         check_idx = None
 
+    # the reference casts the location to `dtype` (default f32) before
+    # differencing — finite differences on integer data would truncate
+    want = _onp.dtype(dtype) if dtype is not None else None
+    coerced = []
+    for x in inputs:
+        if not isinstance(x, ndarray):
+            x = array(_onp.asarray(x))
+        xd = _onp.dtype(x.dtype)
+        if want is not None and xd != want:
+            x = x.astype(want)
+        elif want is None and not _onp.issubdtype(xd, _onp.floating):
+            x = x.astype(_onp.float32)
+        coerced.append(x)
+    inputs = coerced
+
     if analytic_grads is None:
         for x in inputs:
             x.attach_grad()
@@ -165,6 +183,7 @@ def check_numeric_gradient(f: Callable, inputs: Sequence[ndarray],
         y.backward()
         analytic_grads = [x.grad.asnumpy() for x in inputs]
 
+    from .util import x64_scope
     for xi, (x, g_ana) in enumerate(zip(inputs, analytic_grads)):
         if check_idx is not None and xi not in check_idx:
             continue
@@ -176,20 +195,33 @@ def check_numeric_gradient(f: Callable, inputs: Sequence[ndarray],
                                               1.0 / 2 ** 9)
         else:
             eps_x = eps
+        # the finite differences EVALUATE in f64 (x64 scope) for f32/f64
+        # inputs: the projection sums thousands of terms and f32
+        # cancellation noise would swamp the eps-sized signal the check
+        # measures (the reference's executor runs its FD in the op dtype
+        # but with f64 accumulation for exactly this reason)
+        fd_dt = _onp.float64 if _onp.dtype(x.dtype) in (
+            _onp.dtype(_onp.float32), _onp.dtype(_onp.float64)) else x.dtype
         g_num = _onp.zeros_like(base)
         it = _onp.nditer(base, flags=["multi_index"])
-        while not it.finished:
-            idx = it.multi_index
-            xp = base.copy(); xp[idx] += eps_x
-            xm = base.copy(); xm[idx] -= eps_x
-            args_p = [array(xp.astype(x.dtype)) if j == xi else inputs[j]
-                      for j in range(len(inputs))]
-            args_m = [array(xm.astype(x.dtype)) if j == xi else inputs[j]
-                      for j in range(len(inputs))]
-            fp = float(f(*args_p).asnumpy())
-            fm = float(f(*args_m).asnumpy())
-            g_num[idx] = (fp - fm) / (2 * eps_x)
-            it.iternext()
+        with x64_scope(True):
+            others = [a.astype(_onp.float64)
+                      if _onp.dtype(a.dtype) == _onp.float32 else a
+                      for a in inputs]
+            while not it.finished:
+                idx = it.multi_index
+                xp = base.copy(); xp[idx] += eps_x
+                xm = base.copy(); xm[idx] -= eps_x
+                # dtype passed EXPLICITLY: array() treats bare f64 host
+                # data as default-float and would round back to f32
+                args_p = [array(xp, dtype=fd_dt) if j == xi else others[j]
+                          for j in range(len(inputs))]
+                args_m = [array(xm, dtype=fd_dt) if j == xi else others[j]
+                          for j in range(len(inputs))]
+                fp = float(f(*args_p).asnumpy())
+                fm = float(f(*args_m).asnumpy())
+                g_num[idx] = (fp - fm) / (2 * eps_x)
+                it.iternext()
         _onp.testing.assert_allclose(g_ana, g_num, rtol=rtol, atol=atol,
                                      err_msg=f"gradient mismatch on input {xi}")
 

@@ -1169,7 +1169,11 @@ def test_boolean_mask():
             c = mx.nd.contrib.boolean_mask(a, ci)
             su = b.sum() + c.sum()
             su.backward()
-    grad = (bi + ci).asnumpy().reshape((-1,) + (1,) * (len(shape)-1))
+    # PORT-NOTE: the reference's legacy nd comparisons return float32
+    # masks (pre-bool-dtype semantics); here comparisons are np-style
+    # bool, so widen explicitly before arithmetic
+    grad = (bi.astype('int32') + ci.astype('int32')).asnumpy().reshape(
+        (-1,) + (1,) * (len(shape)-1))
     grad = np.tile(grad, (1,) + shape[1:])
     # T times
     grad *= T
