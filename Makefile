@@ -2,7 +2,7 @@
 # to what a single-language-core framework needs).
 PY ?= python
 
-.PHONY: ci test test-all test-dist lint bench cpp docs clean
+.PHONY: ci test test-all test-dist test-parity lint bench cpp docs clean opperf-check
 
 # the one-command gate CI runs (VERDICT round-2 next-step #7): lint +
 # unit suite + 2-process dist tests + C++ package build/tests
@@ -21,7 +21,18 @@ test:
 	$(PY) -m pytest tests/unittest -q -m "not slow" $$($(PY) -c 'import xdist, os; print("-n auto" if (os.cpu_count() or 1) > 1 else "")' 2>/dev/null) --ignore=tests/unittest/test_dist_kvstore.py
 
 test-all:
-	$(PY) -m pytest tests/unittest -q --ignore=tests/unittest/test_dist_kvstore.py
+	$(PY) -m pytest tests/unittest tests/parity -q --ignore=tests/unittest/test_dist_kvstore.py
+
+# the reference-conformance tier alone (reference unit-test bodies run
+# against this framework; see tests/parity/conftest.py)
+test-parity:
+	$(PY) -m pytest tests/parity -q
+
+# op-microbenchmark regression gate (VERDICT r4 item 5): pinned subset
+# vs bench_results/opperf_cpu.md, median-normalized so only RELATIVE
+# single-kernel regressions trip it; refresh docs in tools/opperf_check.py
+opperf-check:
+	$(PY) tools/opperf_check.py
 
 test-dist:
 	$(PY) -m pytest tests/unittest/test_dist_kvstore.py -q

@@ -51,6 +51,26 @@ def _export_roundtrip(block, inputs, tmp_path, rtol=1e-4, atol=1e-5):
     for g, e in zip(got, expect):
         onp.testing.assert_allclose(g, onp.asarray(e.asnumpy()), rtol=rtol,
                                     atol=atol)
+    _ort_crosscheck(path, feeds, expect, rtol, atol)
+
+
+def _ort_crosscheck(path, feeds, expect, rtol, atol):
+    """When onnx/onnxruntime are installed (CI's onnx-validate job), every
+    sweep artifact additionally passes onnx.checker and matches
+    onnxruntime — the EXTERNAL oracle (VERDICT r4 item 4); silently a
+    no-op where they aren't available."""
+    try:
+        import onnx
+        import onnxruntime as ort
+    except ImportError:
+        return
+    onnx.checker.check_model(onnx.load(path))
+    sess = ort.InferenceSession(path, providers=["CPUExecutionProvider"])
+    got = sess.run(None, feeds)
+    assert len(got) == len(expect)
+    for g, e in zip(got, expect):
+        onp.testing.assert_allclose(g, onp.asarray(e.asnumpy()),
+                                    rtol=rtol, atol=atol)
 
 
 # one entry per family of front-end ops; each lowers to jaxpr primitives

@@ -1,0 +1,62 @@
+"""The opperf regression gate must catch a deliberate single-kernel
+slowdown (VERDICT r4 item 5: "a deliberate 5x slowdown in one kernel
+makes CI red") — and must NOT fire on a uniform machine-speed change.
+Gate runs are simulated by feeding synthetic latencies through the same
+normalization/flagging code the CI step uses.
+"""
+import importlib
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..",
+                                "tools"))
+opperf_check = importlib.import_module("opperf_check")
+sys.path.pop(0)
+
+
+def _run_gate(monkeypatch, slow_ops=(), machine_factor=1.0, factor=2.0):
+    baseline = opperf_check.load_baseline()
+
+    def fake_run(op, inputs=None, warmup=0, runs=0):
+        fwd, bwd = baseline[op]
+        mult = machine_factor * (5.0 if op in slow_ops else 1.0)
+        return [{"op": op,
+                 "avg_forward_time_ms": None if fwd is None else fwd * mult,
+                 "avg_backward_time_ms": None if bwd is None else bwd * mult}]
+
+    import mxnet_tpu.benchmark.opperf as opperf
+    monkeypatch.setattr(opperf, "run_performance_test", fake_run)
+    monkeypatch.setattr(sys, "argv", ["opperf_check.py",
+                                      "--factor", str(factor)])
+    return opperf_check.main()
+
+
+def test_clean_run_passes(monkeypatch, capsys):
+    assert _run_gate(monkeypatch) == 0
+
+
+def test_uniform_contention_does_not_fire(monkeypatch, capsys):
+    """A 3x-slower machine (CI contention) is not a regression."""
+    assert _run_gate(monkeypatch, machine_factor=3.0) == 0
+
+
+def test_single_kernel_5x_slowdown_fails(monkeypatch, capsys):
+    rc = _run_gate(monkeypatch, slow_ops=("gelu",))
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "gelu" in out and "REGRESSION" in out
+
+
+def test_single_kernel_slowdown_fails_even_on_slow_machine(monkeypatch,
+                                                           capsys):
+    rc = _run_gate(monkeypatch, slow_ops=("dot",), machine_factor=2.0)
+    assert rc == 1
+    assert "dot" in capsys.readouterr().out
+
+
+def test_baseline_has_all_pinned_ops():
+    baseline = opperf_check.load_baseline()
+    missing = [o for o in opperf_check.PINNED if o not in baseline]
+    assert not missing, missing

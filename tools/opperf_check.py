@@ -1,0 +1,125 @@
+"""Op-microbenchmark regression gate (VERDICT r4 item 5; SURVEY §4 —
+"op microbenchmarks double as perf regression tests").
+
+Re-runs a pinned subset of the ops in `bench_results/opperf_cpu.md` and
+FAILS (exit 1) when any op's forward or backward latency exceeds
+`--factor`× the committed baseline (default 2.0 plus a floor, to ride
+out the contended shared-core CI boxes).  Refresh procedure after an
+intentional perf change:
+
+    python -m mxnet_tpu.benchmark.opperf --output bench_results/opperf_cpu.md
+    git add bench_results/opperf_cpu.md   # review the delta!
+
+Usage: python tools/opperf_check.py [--factor 2.0] [--ops a,b,c]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+BASELINE = os.path.join(os.path.dirname(__file__), "..", "bench_results",
+                        "opperf_cpu.md")
+
+# pinned subset: cheap-but-representative ops across families (elemwise,
+# reduction, matmul, NN layers, attention); ~20 entries keeps the gate
+# under a couple of minutes on one core
+PINNED = [
+    "abs", "add", "clip", "cumsum", "divide", "dot", "exp",
+    "fully_connected", "gelu", "layer_norm", "log", "log_softmax",
+    "max", "mean", "multiply", "relu", "sigmoid", "softmax", "sum",
+    "tanh",
+]
+
+# latencies under this many ms are timer noise on a contended box; the
+# gate only engages above it
+ABS_FLOOR_MS = 0.25
+
+
+def load_baseline():
+    rows = {}
+    for line in open(BASELINE):
+        m = re.match(r"\| (\w+) \| `[^`]*` \| ([0-9.e+-]+|None) \| "
+                     r"([0-9.e+-]+|None) \|", line)
+        if m:
+            fwd = None if m.group(2) == "None" else float(m.group(2))
+            bwd = None if m.group(3) == "None" else float(m.group(3))
+            rows[m.group(1)] = (fwd, bwd)
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--factor", type=float, default=2.0)
+    ap.add_argument("--ops", type=str, default=None)
+    args = ap.parse_args()
+    ops = args.ops.split(",") if args.ops else PINNED
+
+    baseline = load_baseline()
+    missing = [o for o in ops if o not in baseline]
+    if missing:
+        print(f"FAIL: pinned ops missing from baseline: {missing}")
+        return 1
+
+    from mxnet_tpu.benchmark.opperf import DEFAULT_OPS, run_performance_test
+    suite = {name: specs for name, specs in DEFAULT_OPS}
+
+    measured, errors = [], []
+    for op in ops:
+        if op not in suite:
+            print(f"FAIL: op {op!r} not in DEFAULT_OPS")
+            return 1
+        res = run_performance_test(op, inputs=suite[op], warmup=3, runs=10)
+        for r in res:
+            if "error" in r:
+                errors.append(f"{op}: errored: {r['error']}")
+                continue
+            base_fwd, base_bwd = baseline[op]
+            for leg, got, base in (
+                    ("fwd", r.get("avg_forward_time_ms"), base_fwd),
+                    ("bwd", r.get("avg_backward_time_ms"), base_bwd)):
+                if got is None or base is None or base <= 0:
+                    continue
+                if got < ABS_FLOOR_MS and base < ABS_FLOOR_MS:
+                    continue    # both in timer-noise territory
+                measured.append((op, leg, got, base, got / base))
+
+    # the machine running this gate is rarely the one that produced the
+    # baseline (and CI cores are contended), so a UNIFORM slowdown is
+    # expected — the gate flags ops whose ratio-to-baseline exceeds
+    # `factor`x the MEDIAN ratio of the whole pinned set: a genuine
+    # single-kernel regression sticks out; global contention cancels
+    ratios = sorted(r for *_, r in measured)
+    med = ratios[len(ratios) // 2] if ratios else 1.0
+    norm = max(med, 1.0)
+    failures = list(errors)
+    for op, leg, got, base, ratio in measured:
+        limit = norm * args.factor
+        flag = " <-- REGRESSION" if ratio > limit else ""
+        print(f"{op:18s} {leg}: {got:8.3f} ms (baseline {base:8.3f}, "
+              f"ratio {ratio:5.2f}, limit {limit:5.2f}x){flag}")
+        if ratio > limit:
+            failures.append(
+                f"{op} {leg}: {ratio:.2f}x baseline vs median machine "
+                f"ratio {med:.2f} (limit {limit:.2f}x)")
+    print(f"\nchecked {len(measured)} latencies across {len(ops)} ops "
+          f"(median machine ratio {med:.2f})")
+    if failures:
+        print("\nREGRESSIONS:")
+        for f in failures:
+            print(" ", f)
+        print("\nIf intentional, refresh the baseline (see module "
+              "docstring).")
+        return 1
+    print("opperf-check: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
