@@ -1,0 +1,1 @@
+"""Package marker: keeps parity modules (same basenames as tests/unittest) under a distinct import name."""

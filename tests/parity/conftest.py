@@ -13,7 +13,14 @@ reasons — an xfail here is an assertion about the design, not a TODO.
 import importlib
 import importlib.abc
 import importlib.util
+import os
 import sys
+
+# The ported bodies say ``from common import ...`` verbatim (the reference
+# keeps common.py as a sibling module).  tests/parity is a package (its
+# basenames collide with tests/unittest), so put this dir on sys.path for
+# that one top-level name.
+sys.path.insert(0, os.path.dirname(__file__))
 
 # CPU + virtual 8-device mesh comes from tests/conftest.py (parent dir);
 # pytest loads parent conftests first, so JAX is already pinned to cpu.

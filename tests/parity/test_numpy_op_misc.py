@@ -1,0 +1,1287 @@
+"""Reference misc numpy-op test bodies, run against mxnet_tpu (VERDICT
+r4 item 2 tranche 5: binary/unary sweeps, mixed-precision promotion,
+histogram/delete/insert/unique, windows, signature introspection).
+
+PROVENANCE: ported from the reference's
+`tests/python/unittest/test_numpy_op.py` (Apache-2.0) — intentionally
+faithful: the behavior oracle for dtype-promotion rules, degenerate
+shapes, and kwarg semantics.  `mxnet` resolves to `mxnet_tpu` via the
+alias finder in `tests/parity/conftest.py`.
+"""
+import itertools
+import random
+
+import numpy as onp
+import pytest
+
+import mxnet as mx
+from mxnet import np, npx
+from mxnet.base import MXNetError
+from mxnet.gluon import HybridBlock
+from mxnet.test_utils import (
+    assert_almost_equal, check_numeric_gradient, collapse_sum_like,
+    effective_dtype, is_op_runnable, has_tvm_ops, rand_ndarray,
+    rand_shape_nd, retry, same, use_np,
+)
+from mxnet.numpy_op_signature import _get_builtin_op
+from common import assertRaises, xfail_when_nonstandard_decimal_separator
+
+
+@use_np
+def test_np_binary_funcs():
+    def check_binary_func(func, lshape, rshape, low, high, lgrads, rgrads=None, alltypes=None):
+        class TestBinary(HybridBlock):
+            def __init__(self, func):
+                super(TestBinary, self).__init__()
+                self._func = func
+
+            def forward(self, a, b, *args, **kwargs):
+                return getattr(np, self._func)(a, b)
+
+        np_func = getattr(onp, func)
+        mx_func = TestBinary(func)
+        alltypes = alltypes if alltypes else [[onp.float16, onp.float32, onp.float64]]
+        for dtypes, lgrad, rgrad in zip(alltypes, lgrads, rgrads if rgrads else lgrads):
+            for dtype in dtypes:
+                ldtype = rdtype = dtype
+                if isinstance(dtype, tuple):
+                    assert len(dtype) == 2
+                    ldtype, rdtype = dtype
+                npldtype = ldtype if dtype != onp.float16 else onp.float32
+                nprdtype = rdtype if dtype != onp.float16 else onp.float32
+                np_test_x1 = onp.random.uniform(low, high, lshape).astype(ldtype).astype(npldtype)
+                np_test_x2 = onp.random.uniform(low, high, rshape).astype(rdtype).astype(nprdtype)
+                mx_test_x1 = mx.numpy.array(np_test_x1, dtype=ldtype)
+                mx_test_x2 = mx.numpy.array(np_test_x2, dtype=rdtype)
+                for hybridize in [True, False]:
+                    if hybridize:
+                        mx_func.hybridize()
+                    if lgrad:
+                        mx_test_x1.attach_grad()
+                        mx_test_x2.attach_grad()
+                    np_out = np_func(np_test_x1, np_test_x2)
+                    with mx.autograd.record():
+                        y = mx_func(mx_test_x1, mx_test_x2)
+                    assert y.shape == np_out.shape
+                    assert_almost_equal(y.asnumpy(), np_out.astype(y.dtype), rtol=1e-3, atol=1e-5,
+                                        use_broadcast=False, equal_nan=True)
+
+                    if lgrad:
+                        y.backward()
+                        assert_almost_equal(mx_test_x1.grad.asnumpy(),
+                                            collapse_sum_like(lgrad(y.asnumpy(), np_test_x1, np_test_x2), mx_test_x1.shape),
+                                            rtol=1e-1, atol=1e-2, equal_nan=True, use_broadcast=False)
+                        if rgrads is None:
+                            assert_almost_equal(mx_test_x2.grad.asnumpy(),
+                                                collapse_sum_like(rgrad(y.asnumpy(), np_test_x2, np_test_x1), mx_test_x2.shape),
+                                                rtol=1e-1, atol=1e-2, equal_nan=True, use_broadcast=False)
+                        else:
+                            assert_almost_equal(mx_test_x2.grad.asnumpy(),
+                                                collapse_sum_like(rgrad(y.asnumpy(), np_test_x1, np_test_x2), mx_test_x2.shape),
+                                                rtol=1e-1, atol=1e-2, equal_nan=True, use_broadcast=False)
+
+                np_out = getattr(onp, func)(np_test_x1, np_test_x2)
+                mx_out = getattr(mx.np, func)(mx_test_x1, mx_test_x2)
+                assert mx_out.shape == np_out.shape
+                assert_almost_equal(mx_out.asnumpy(), np_out.astype(mx_out.dtype), rtol=1e-3, atol=1e-5,
+                                    use_broadcast=False, equal_nan=True)
+
+                assertRaises(NotImplementedError, getattr(np, func), mx_test_x1, mx_test_x2, where=False)
+                assertRaises(NotImplementedError, getattr(np, func), mx_test_x1, mx_test_x2,  subok=False)
+                assertRaises(NotImplementedError, getattr(np, func), mx_test_x1, mx_test_x2,  dtype=onp.int8)
+                assertRaises(TypeError, getattr(np, func), mx_test_x1, mx_test_x2,  dtype="abcdefg")
+                assertRaises(NotImplementedError, getattr(np, func), mx_test_x1, mx_test_x2,  casting='safe')
+                assertRaises(TypeError, getattr(np, func), mx_test_x1, mx_test_x2,  casting='mxnet')
+                assertRaises(NotImplementedError, getattr(np, func), mx_test_x1, mx_test_x2,  order='C')
+                assertRaises(NotImplementedError, getattr(np, func), mx_test_x1, mx_test_x2,  order='mxnet')
+
+    funcs = {
+        'add': (-1.0, 1.0, [lambda y, x1, x2: onp.ones(y.shape)], None),
+        'subtract':
+        (-1.0, 1.0, [lambda y, x1, x2: onp.ones(y.shape)],
+                    [lambda y, x1, x2: -onp.ones(y.shape)]),
+        'multiply': (-1.0, 1.0, [lambda y, x1, x2: onp.broadcast_to(x2, y.shape)],
+                                [lambda y, x1, x2: onp.broadcast_to(x1, y.shape)]),
+        'divide': (0.1, 1.0, [lambda y, x1, x2: onp.ones(y.shape) / x2],
+                   [lambda y, x1, x2: -x1 / (x2 * x2)]),
+        'floor_divide': (0.1, 1.0, [lambda y, x1, x2: onp.zeros(y.shape)],
+                 [lambda y, x1, x2: onp.zeros(y.shape)]),
+        'mod': (1.0, 10.0,
+                [lambda y, x1, x2: onp.ones(y.shape),
+                 lambda y, x1, x2: onp.zeros(y.shape)],
+                [lambda y, x1, x2: -onp.floor(x1 / x2),
+                 lambda y, x1, x2: onp.zeros(y.shape)],
+                [[onp.float16, onp.float32, onp.float64], [onp.int32]]),
+        'fmod': (1.0, 10.0,
+                [lambda y, x1, x2: onp.ones(y.shape),
+                 lambda y, x1, x2: onp.zeros(y.shape)],
+                [lambda y, x1, x2: -onp.floor(x1 / x2),
+                 lambda y, x1, x2: onp.zeros(y.shape)],
+                [[onp.float16, onp.float32, onp.float64], [onp.int32]]),
+        'remainder': (1.0, 10.0,
+                      [lambda y, x1, x2: onp.ones(y.shape),
+                       lambda y, x1, x2: onp.zeros(y.shape)],
+                      [lambda y, x1, x2: -onp.floor(x1 / x2),
+                       lambda y, x1, x2: onp.zeros(y.shape)],
+                      [[onp.float16, onp.float32, onp.float64], [onp.int32]]),
+        'power': (1.0, 3.0, [lambda y, x1, x2: onp.power(x1, x2 - 1.0) * x2],
+                             [lambda y, x1, x2: onp.power(x1, x2) * onp.log(x1)]),
+        'gcd': (-100, 100, [None], None, [[onp.int32]]),
+        'lcm': (-100, 100, [None], None, [[onp.int32]]),
+        'bitwise_and': (-100, 100, [None], None, [[onp.int32]]),
+        'bitwise_xor': (-100, 100, [None], None, [[onp.int32]]),
+        'bitwise_or': (-100, 100, [None], None, [[onp.int32]]),
+        'maximum': (-10, 10, [lambda y, x1, x2: onp.ones(y.shape) * (x1 >= x2)],
+                             [lambda y, x1, x2: onp.ones(y.shape) * (x1 < x2)],
+                             [[onp.int32, onp.float16, onp.float32, onp.float64]]),
+        'fmax': (-1, 1, [lambda y, x1, x2: onp.ones(y.shape) * (x1 >= x2)],
+                        [lambda y, x1, x2: onp.ones(y.shape) * (x1 < x2)]),
+        'minimum': (-10, 10, [lambda y, x1, x2: onp.ones(y.shape) * (x1 <= x2)],
+                             [lambda y, x1, x2: onp.ones(y.shape) * (x1 > x2)],
+                             [[onp.int32, onp.float16, onp.float32, onp.float64]]),
+        'fmin': (-1, 1, [lambda y, x1, x2: onp.ones(y.shape) * (x1 <= x2)],
+                        [lambda y, x1, x2: onp.ones(y.shape) * (x1 > x2)]),
+        'copysign': (-1, 1,
+                     [lambda y, x1, x2: onp.ones(y.shape) * (((x1 * x2) >= 0).astype(onp.float32) - ((x1 * x2) < 0).astype(onp.float32))],
+                     [lambda y, x1, x2: onp.zeros(y.shape)]),
+        'arctan2': (-1, 1, [lambda y, x1, x2: x2 / (onp.square(x1) + onp.square(x2))],
+                           [lambda y, x1, x2: -x1 / (onp.square(x1) + onp.square(x2))]),
+        'hypot': (-1, 1, [lambda y, x1, x2: x1 / y],
+                         [lambda y, x1, x2: x2 / y]),
+        'ldexp': (-3, 3, [None], None, [[onp.int32]]),
+        'logaddexp': (-10, 10, [lambda y, x1, x2: onp.exp(x1) / (onp.exp(x1) + onp.exp(x2))],
+                               [lambda y, x1, x2: onp.exp(x2) / (onp.exp(x1) + onp.exp(x2))])
+    }
+    if is_op_runnable():
+        funcs['logical_and'] = (-100, 100, [None], None, [[onp.float32, onp.float64]])
+        funcs['logical_or'] = (-100, 100, [None], None, [[onp.float32, onp.float64]])
+        funcs['logical_xor'] = (-100, 100, [None], None, [[onp.float32, onp.float64]])
+    shape_pairs = [((3, 2), (3, 2)),
+                   ((3, 2), (3, 1)),
+                   ((3, 1), (3, 0)),
+                   ((0, 2), (1, 2)),
+                   ((2, 3, 4), (3, 1)),
+                   ((2, 3), ()),
+                   ((), (2, 3))]
+    for lshape, rshape in shape_pairs:
+        for func, func_data in funcs.items():
+            dtypes = None
+            assert (len(func_data) == 4 or len(func_data) == 5)
+            if len(func_data) is 4:
+                low, high, lgrads, rgrads = func_data
+            else:
+                low, high, lgrads, rgrads, dtypes = func_data
+            check_binary_func(func, lshape, rshape, low, high, lgrads, rgrads, dtypes)
+
+
+@use_np
+@retry(3)
+@pytest.mark.parametrize('func,ref_grad,low,high', [
+    ('cbrt', lambda x: 1. / (3. * onp.cbrt(x) ** 2), -1.0, 1.0),
+    ('ceil', None, -10.0, 10.0),
+    ('exp', lambda x: onp.exp(x), -1.0, 1.0),
+    ('expm1', lambda x: onp.exp(x), -1.0, 1.0),
+    ('fix', None, -10.0, 10.0),
+    ('floor', None, -10.0, 10.0),
+    ('log', lambda x: 1.0 / x, 0.1, 5.0),
+    ('log10', lambda x: 1.0 / (x * onp.log(10)), 0.1, 10.0),
+    ('log1p', lambda x: 1.0 / (1.0 + x), -0.9, 5.0),
+    ('log2', lambda x: 1.0 / (x * onp.log(2)), 0.1, 2.0),
+    ('rint', None, -5.0, 5.0),
+    ('sqrt', lambda x: 0.5 / onp.sqrt(x), 0.001, 10.0),
+    ('trunc', None, -5.0, 5.0),
+    ('sin', lambda x: onp.cos(x), -1.0, 1.0),
+    ('cos', lambda x: -onp.sin(x), -1.0, 1.0),
+    ('tan', lambda x: onp.tan(x) ** 2 + 1.0, -1.0, 1.0),
+    ('arcsin', lambda x: 1. / (1. - x ** 2) ** (1. / 2.), -1.0, 1.0),
+    ('arccos', lambda x: -1. / (1. - x ** 2.) ** (1. / 2.), -1.0, 1.0),
+    ('arctan', lambda x: 1. / (x ** 2. + 1.), -1.0, 1.0),
+    ('degrees', lambda x: 180. / onp.pi * onp.ones(x.shape), -1.0, 1.0),
+    ('radians', lambda x: onp.pi / 180. * onp.ones(x.shape), -1.0, 1.0),
+    ('sinh', lambda x: onp.cosh(x), -1.0, 1.0),
+    ('cosh', lambda x: onp.sinh(x), -1.0, 1.0),
+    ('tanh', lambda x: 1. - onp.tanh(x) ** 2, -1.0, 1.0),
+    ('arcsinh', lambda x: 1./(x**2 + 1.)**(1./2.), -1.0, 1.0),
+    ('arccosh', lambda x: 1./(x**2 - 1.)**(1./2.), 2.0, 5.0),
+    ('arctanh', lambda x: -1./(x**2 - 1.), -0.99, 0.99)
+])
+@pytest.mark.parametrize('ndim', [2, 3, 4])
+@pytest.mark.parametrize('dtype', ['float16', 'float32', 'float64', 'int8', 'uint8', 'int32', 'int64', 'bool'])
+def test_np_mixedType_unary_funcs(func, ref_grad, low, high, ndim, dtype):
+    class TestMixedUnary(HybridBlock):
+        def __init__(self, func):
+            super(TestMixedUnary, self).__init__()
+            self._func = func
+
+        def forward(self, a, *args, **kwargs):
+            return getattr(np, self._func)(a)
+
+    import math
+
+    shapes = [i for i in [rand_shape_nd(ndim, dim=3), (1, 0, 2)]];
+    for shape in shapes:
+        print(func, dtype, shape)
+        rtol = 1e-2 if dtype == np.float16 else 1e-3
+        atol = 1e-4 if dtype == np.float16 else 1e-5
+        # get rid of warning: divide by zero
+        if((func=='log' or func=='log10' or func=='log2') and
+            (dtype=='int8' or dtype=='uint8' or dtype=='int32' or
+            dtype=='int64')):
+            low = 1
+        if (func=='arctanh' and dtype=='bool'):
+            continue
+        np_func = getattr(onp, func)
+        mx_func = TestMixedUnary(func)
+        np_test_data = onp.random.uniform(low, high, shape).astype(dtype)
+        mx_test_data = np.array(np_test_data)
+        for hybridize in [True, False]:
+            if hybridize:
+                mx_func.hybridize()
+            if ref_grad:
+                mx_test_data.attach_grad()
+            np_out = np_func(np_test_data)
+            with mx.autograd.record():
+                y = mx_func(mx_test_data)
+            assert y.shape == np_out.shape
+            assert_almost_equal(y.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+            if np_out.dtype == np.bool_:
+                assert y.dtype == np.bool_
+
+            if ref_grad and (dtype == 'float16' or dtype == 'float32' or dtype == 'float64'):
+                y.backward()
+                assert_almost_equal(mx_test_data.grad.asnumpy(), ref_grad(np_test_data), rtol=1e-1, atol=1e-2, equal_nan=True)
+
+        np_out = getattr(onp, func)(np_test_data)
+        mx_out = getattr(mx.np, func)(mx_test_data)
+        assert mx_out.shape == np_out.shape
+        assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+
+        assertRaises(NotImplementedError, getattr(np, func), mx_test_data, where=False)
+        assertRaises(NotImplementedError, getattr(np, func), mx_test_data, subok=False)
+        assertRaises(NotImplementedError, getattr(np, func), mx_test_data, dtype=onp.int8)
+        assertRaises(TypeError, getattr(np, func), mx_test_data, dtype="abcdefg")
+        assertRaises(NotImplementedError, getattr(np, func), mx_test_data, casting='safe')
+        assertRaises(TypeError, getattr(np, func), mx_test_data, casting='mxnet')
+        assertRaises(NotImplementedError, getattr(np, func), mx_test_data, order='C')
+        assertRaises(NotImplementedError, getattr(np, func), mx_test_data, order='mxnet')
+
+
+@use_np
+def test_np_mixed_precision_binary_funcs():
+    itypes = [np.bool, np.int8, np.int32, np.int64]
+    ftypes = [np.float16, np.float32, np.float64]
+    def check_mixed_precision_binary_func(func, low, high, lshape, rshape, lgrad, rgrad, ltype, rtype):
+        class TestMixedBinary(HybridBlock):
+            def __init__(self, func):
+                super(TestMixedBinary, self).__init__()
+                self._func = func
+
+            def forward(self, a, b, *args, **kwargs):
+                return getattr(np, self._func)(a, b)
+
+        if (func in ['multiply', 'mod', 'equal', 'not_equal', 'greater',
+                    'greater_equal', 'less', 'less_equal']) and \
+            (lshape == () or rshape == ()) :
+        # the behaviors of infer type in dealing with the input shape of '()' are different between np and onp
+        # for example,
+        # mx_test_x1 = np.random.uniform(-2, 2, (2,3)).astype(np.float32)
+        # mx_test_x2 = np.random.uniform(-2, 2, ()).astype(np.float16)
+        # np_out = onp.mod(mx_test_x1.asnumpy(), mx_test_x2.asnumpy()) # float16
+        # mx_out = np.mod(mx_test_x1, mx_test_x2) # float32
+
+        # logcial ops: when two numbers are only different in precision, NumPy also has a weird behavior
+        # for example,
+        # a = np.array([[1.441]], dtype = np.float16)
+        # b = np.array(1.4413278, dtype = np.float32)
+        # c = np.array([1.4413278], dtype = np.float32)
+        # np.greater(a,b), np.greater(a,c) # True True
+        # onp.greater(a.asnumpy(),b.asnumpy()), onp.greater(a.asnumpy(),c.asnumpy()) # False True
+
+        # thus, skip the tests
+            return
+
+        np_func = getattr(onp, func)
+        mx_func = TestMixedBinary(func)
+        np_test_x1 = onp.random.uniform(low, high, lshape).astype(ltype)
+        np_test_x2 = onp.random.uniform(low, high, rshape).astype(rtype)
+        mx_test_x1 = mx.numpy.array(np_test_x1, dtype=ltype)
+        mx_test_x2 = mx.numpy.array(np_test_x2, dtype=rtype)
+        rtol = 1e-2 if ltype is np.float16 or rtype is np.float16 else 1e-3
+        atol = 1e-3 if ltype is np.float16 or rtype is np.float16 else 1e-5
+        for hybridize in [True, False]:
+            if hybridize:
+                mx_func.hybridize()
+            if lgrad:
+                mx_test_x1.attach_grad()
+                mx_test_x2.attach_grad()
+            np_out = np_func(np_test_x1, np_test_x2)
+            with mx.autograd.record():
+                y = mx_func(mx_test_x1, mx_test_x2)
+            assert y.shape == np_out.shape
+            assert_almost_equal(y.asnumpy(), np_out.astype(y.dtype), rtol=rtol, atol=atol,
+                                use_broadcast=False, equal_nan=True)
+
+            if lgrad:
+                if (ltype in itypes) and (rtype in itypes):
+                    continue
+                y.backward()
+                if ltype not in itypes:
+                    assert_almost_equal(mx_test_x1.grad.asnumpy(),
+                                        collapse_sum_like(lgrad(y.asnumpy(), np_test_x1, np_test_x2), mx_test_x1.shape),
+                                        rtol=1e-1, atol=1e-2, equal_nan=True, use_broadcast=False)
+                if rtype not in itypes:
+                    if rgrad is None:
+                        assert_almost_equal(mx_test_x2.grad.asnumpy(),
+                                            collapse_sum_like(rgrad(y.asnumpy(), np_test_x2, np_test_x1), mx_test_x2.shape),
+                                            rtol=1e-1, atol=1e-2, equal_nan=True, use_broadcast=False)
+                    else:
+                        assert_almost_equal(mx_test_x2.grad.asnumpy(),
+                                            collapse_sum_like(rgrad(y.asnumpy(), np_test_x1, np_test_x2), mx_test_x2.shape),
+                                            rtol=1e-1, atol=1e-2, equal_nan=True, use_broadcast=False)
+
+
+        np_out = getattr(onp, func)(np_test_x1, np_test_x2)
+        mx_out = getattr(mx.np, func)(mx_test_x1, mx_test_x2)
+        assert mx_out.shape == np_out.shape
+        assert_almost_equal(mx_out.asnumpy(), np_out.astype(mx_out.dtype), rtol=rtol, atol=atol,
+                            use_broadcast=False, equal_nan=True)
+
+    funcs = {
+        'add': (-1.0, 1.0, lambda y, x1, x2: onp.ones(y.shape),
+                           lambda y, x1, x2: onp.ones(y.shape)),
+        'subtract': (-1.0, 1.0, lambda y, x1, x2: onp.ones(y.shape),
+                                lambda y, x1, x2: onp.ones(y.shape) * -1),
+        'multiply': (-1.0, 1.0, lambda y, x1, x2: onp.broadcast_to(x2, y.shape),
+                                lambda y, x1, x2: onp.broadcast_to(x1, y.shape)),
+        'mod': (1.0, 5.0, None, None),
+        'power': (1.0, 3.0, lambda y, x1, x2: onp.power(x1, x2 - 1.0) * x2,
+                            lambda y, x1, x2: onp.power(x1, x2) * onp.log(x1)),
+        'equal': (0.0, 2.0, None, None),
+        'not_equal': (0.0, 2.0, None, None),
+        'greater': (0.0, 2.0, None, None),
+        'less': (0.0, 2.0, None, None),
+        'greater_equal': (0.0, 2.0, None, None),
+        'less_equal': (0.0, 2.0, None, None),
+        'logical_and': (0.0, 2.0, None, None),
+        'logical_or': (0.0, 2.0, None, None),
+        'logical_xor': (0.0, 2.0, None, None),
+    }
+
+    shape_pairs = [((3, 2), (3, 2)),
+                   ((3, 2), (3, 1)),
+                   ((3, 0), (3, 0)),
+                   ((3, 1), (3, 0)),
+                   ((0, 2), (1, 2)),
+                   ((2, 3, 4), (3, 1)),
+                   ((2, 3), ()),
+                   ((), (2, 3))]
+
+    itypes = [np.bool, np.int8, np.int32, np.int64]
+    ftypes = [np.float16, np.float32, np.float64]
+    for func, func_data in funcs.items():
+        low, high, lgrad, rgrad = func_data
+        for lshape, rshape in shape_pairs:
+            for type1, type2 in itertools.product(itypes, ftypes):
+                check_mixed_precision_binary_func(func, low, high, lshape, rshape, lgrad, rgrad, type1, type2)
+                check_mixed_precision_binary_func(func, low, high, lshape, rshape, lgrad, rgrad, type2, type1)
+
+            for type1, type2 in itertools.product(ftypes, ftypes):
+                if type1 == type2:
+                    continue
+                check_mixed_precision_binary_func(func, low, high, lshape, rshape, lgrad, rgrad, type1, type2)
+
+            if func == 'subtract' or func == 'mod':
+                continue
+            for type1, type2 in itertools.product(itypes, itypes):
+                if type1 == type2:
+                    continue
+                check_mixed_precision_binary_func(func, low, high, lshape, rshape, lgrad, rgrad, type1, type2)
+
+
+@use_np
+def test_np_mixed_mxnp_op_funcs():
+    # generate onp & mx_np in same type
+    _np = onp.array([1,2,3,4,5]).astype("int64")
+    mx_np = mx.np.array([1,2,3,4,5]).astype("int64")
+    # inplace onp mx_np
+    _np += mx_np
+    assert isinstance(_np, onp.ndarray)
+    _np -= mx_np
+    assert isinstance(_np, onp.ndarray)
+    _np *= mx_np
+    assert isinstance(_np, onp.ndarray)
+    # inplace mx_np onp
+    mx_np ^= _np
+    assert isinstance(mx_np, mx.np.ndarray)
+    mx_np |= _np
+    assert isinstance(mx_np, mx.np.ndarray)
+    mx_np &= _np
+    assert isinstance(mx_np, mx.np.ndarray)
+    # mxnp onp
+    out = mx_np << _np
+    assert isinstance(out, mx.np.ndarray)
+    out = mx_np >> _np
+    assert isinstance(out, mx.np.ndarray)
+    out = mx_np != _np
+    assert isinstance(out, mx.np.ndarray)
+    # onp mxnp
+    out = _np == mx_np
+    assert isinstance(out, mx.np.ndarray)
+    out = _np >= mx_np
+    assert isinstance(out, mx.np.ndarray)
+    out = _np < mx_np
+    assert isinstance(out, mx.np.ndarray)
+    _np = onp.array([1,2,3,4,5]).astype("float32")
+    mx_np = mx.np.array([1,2,3,4,5]).astype("float32")
+    out = _np @ mx_np
+    assert isinstance(out, mx.np.ndarray)
+    out = _np / mx_np
+    assert isinstance(out, mx.np.ndarray)
+
+
+@use_np
+def test_np_unary_bool_funcs():
+    def check_unary_func(func):
+        class TestUnary(HybridBlock):
+            def __init__(self, func):
+                super(TestUnary, self).__init__()
+                self._func = func
+
+            def forward(self, a):
+                return getattr(np, self._func)(a)
+
+        src_list = [
+            onp.nan,
+            onp.inf,
+            -onp.inf,
+            float('inf'),
+            float('-inf'),
+            float("nan"),
+            onp.array(0)/0,  # nan
+            0.0 * onp.inf,  # nan
+            onp.inf/onp.inf,  # nan
+            onp.inf - onp.inf,  # nan
+            onp.array(1)/0,  # inf
+            0 + np.inf,  # inf
+            1,
+            [onp.nan],
+            [onp.inf],
+            [-onp.inf],
+            [onp.array(0)/0],
+            [-onp.array(0)/0],
+            [onp.inf - onp.inf],  # nan
+            [1],
+            [1,2,3,4,-1,-2,-3,-4,0],
+            [onp.nan, onp.inf, -onp.inf],
+            [onp.nan, onp.inf, -onp.inf, -574, 0, 23425, 24234,-5],
+            [onp.nan, -1, 0, 1, float('inf'), float('-inf'), float('nan')],
+            [[-433, 0, 456, onp.inf], [-1, -onp.inf, 0, 1]]
+        ]
+
+        np_func = getattr(onp, func)
+        mx_func = TestUnary(func)
+        dtype_list = ['float16', 'float32', 'float64']
+        hybridize_list = [True, False]
+        atol, rtol = 1e-5, 1e-3
+
+        for [hybridize, dtype, src] in itertools.product(hybridize_list, dtype_list, src_list):
+            mx_data = mx.np.array(src, dtype=dtype)
+            np_data = mx_data.asnumpy()
+
+            if hybridize:
+                mx_func.hybridize()
+            with mx.autograd.record():
+                mx_out= mx_func(mx_data)
+
+            assert mx_out.dtype == np.bool_
+
+            np_out = np_func(np_data)
+            assert_almost_equal(mx_out.asnumpy(), np_out, rtol, atol)
+            # test imperative
+            mx_out_imperative = getattr(mx.np, func)(mx_data)
+            assert_almost_equal(mx_out_imperative.asnumpy(), np_out, rtol, atol)
+            # if `out` is given and dtype == np.bool
+            mx_x = np.ones_like(mx_data).astype(np.bool)
+            np_x = mx_x.asnumpy()
+            getattr(mx.np, func)(mx_data, mx_x)
+            np_func(np_data, np_x)
+            assert_almost_equal(mx_out_imperative .asnumpy(), np_out, rtol, atol)
+            # if `out` is given but dtype mismatches
+            mx_y = np.ones_like(mx_data)
+            assertRaises(TypeError, getattr(np, func), mx_data, out=mx_y)
+
+            assertRaises(NotImplementedError, getattr(np, func), mx_data, where=False)
+            assertRaises(NotImplementedError, getattr(np, func), mx_data,  subok=False)
+            assertRaises(NotImplementedError, getattr(np, func), mx_data,  dtype=onp.int8)
+            assertRaises(TypeError, getattr(np, func), mx_data,  dtype="abcdefg")
+            assertRaises(NotImplementedError, getattr(np, func), mx_data,  casting='safe')
+            assertRaises(TypeError, getattr(np, func), mx_data,  casting='mxnet')
+            assertRaises(NotImplementedError, getattr(np, func), mx_data,  order='C')
+            assertRaises(NotImplementedError, getattr(np, func), mx_data,  order='mxnet')
+
+        # test special shape and dtype
+        shape_list = [(), (1,), (2, 3), (4, 0, 5), 6, (7, 8), None]
+        dtype_list = ['int32', 'int64', 'float16', 'float32', 'float64']
+        for [hybridize, dtype, shape] in itertools.product(hybridize_list, dtype_list, shape_list):
+            mx_data = mx.np.random.randint(low=-1, high=1, size=shape).astype(dtype)
+            np_data = mx_data.asnumpy()
+
+            if hybridize:
+                mx_func.hybridize()
+            with mx.autograd.record():
+                mx_out= mx_func(mx_data)
+
+            assert mx_out.dtype == np.bool_
+
+            np_out = np_func(np_data)
+            assert_almost_equal(mx_out.asnumpy(), np_out, rtol, atol)
+            mx_out_imperative = getattr(mx.np, func)(mx_data)
+            assert_almost_equal(mx_out_imperative .asnumpy(), np_out, rtol, atol)
+
+    check_unary_func("isnan")
+    check_unary_func("isinf")
+    check_unary_func("isposinf")
+    check_unary_func("isneginf")
+    check_unary_func("isfinite")
+
+
+@use_np
+@pytest.mark.skip(reason='Skipped as the test is flaky and the feature causes curand error. Tracked in #18100')
+def test_np_histogram():
+    shapes = [(), (3, 4), (3, 0)]
+
+    for shape in shapes:
+        mx_a = np.random.uniform(0.0, 10.0, size=shape)
+        np_a = mx_a.asnumpy()
+        mx_bins = np.array([0.0, 1.0, 2.0, 3.0, 4.0, 5., 6., 7., 8., 9., 10.])
+        np_bins = mx_bins.asnumpy()
+        for bins, _range in [(20, (0.0, 10.0)), (mx_bins, None)]:
+            mx_cnts, mx_bins = np.histogram(mx_a, bins=bins, range=_range)
+            np_cnts, np_bins = onp.histogram(np_a, bins=bins if isinstance(bins, mx.base.numeric_types) else bins.asnumpy(), range=_range)
+            assert_almost_equal(mx_cnts.asnumpy(), np_cnts, rtol=1e-3, atol=1e-5)
+            assert_almost_equal(mx_bins.asnumpy(), np_bins, rtol=1e-3, atol=1e-5)
+
+
+@use_np
+def test_np_delete():
+    class TestDelete(HybridBlock):
+        def __init__(self, obj, axis=None):
+            super(TestDelete, self).__init__()
+            self._obj = obj
+            self._axis = axis
+
+        def forward(self, a):
+            return np.delete(a, self._obj, axis=self._axis)
+
+    def GetSize(shp):
+        if len(shp) == 0:
+            return 0
+        else:
+            res = 1
+            shp_list = list(shp)
+            for x in shp:
+                res *= x
+            return res
+
+    def GetDimSize(shp, axis):
+        if axis is None:
+            return GetSize(shp)
+        shp_list = list(shp)
+        return shp_list[axis]
+
+    shape = [(), (0, ), (1, ), (2, 3), (2, 1, 4, 5)]
+    config = []
+    for shp in shape:
+        for ax in range(-1 * len(shp), len(shp), 2):
+            #test slice
+            for st in [-5, -2, 0, 2, 5, None]:
+                for ed in [-5, -2, 0, 2, 5, None]:
+                    for stp in [-5, -2, 2, 5, None]:
+                        config.append(tuple([shp, slice(st, ed, stp), None]))
+                        config.append(tuple([shp, slice(st, ed, stp), ax]))
+            #test iteger
+            for idx in range(-1 * GetDimSize(shp, ax), GetDimSize(shp, ax)):
+                config.append(tuple([shp, idx, ax]))
+            #test ndarray indices
+            idx =  onp.random.randint(-1 * shp[ax], shp[ax] + 1, size = (4)).tolist()
+            config.append(tuple([shp, idx, ax]))
+
+    for arr_shape, obj, axis in config:
+        for objtype in ['int32', 'int64']:
+            if type(obj) == list:
+                obj_mxnp = np.array(obj, dtype=objtype)
+                obj_onp = onp.array(obj, dtype=objtype)
+                # To match mxnet.numpy's behavior of ignoring out-of-bounds indices,
+                # we may need to filter out indices that this numpy would not ignore.
+                onp_ignores_oob_indices = parse(onp.version.version) < parse('1.19')
+                if not onp_ignores_oob_indices:
+                    dim_size = GetDimSize(arr_shape,axis)
+                    obj_onp = obj_onp[((obj_onp>=0) & (obj_onp<dim_size))]
+            elif type(obj) == slice:
+                obj_mxnp = obj
+                obj_onp = obj
+            else:
+                obj_mxnp = (onp.int32(obj) if objtype == 'int32' else onp.int64(obj))
+                obj_onp = (onp.int32(obj) if objtype == 'int32' else onp.int64(obj))
+            test_delete = TestDelete(obj=obj_mxnp, axis=axis)
+
+            a = mx.nd.random.uniform(-1.0, 1.0, shape=arr_shape).as_np_ndarray()
+            a.attach_grad()
+            expected_ret = onp.delete(a.asnumpy(), obj_onp, axis=axis)
+
+            with mx.autograd.record():
+                y = test_delete(a)
+
+            assert y.shape == expected_ret.shape
+            assert_almost_equal(y.asnumpy(), expected_ret, rtol=1e-3, atol=1e-5)
+
+            #test imperative
+            mx_out = np.delete(a, obj_mxnp, axis=axis)
+            np_out = onp.delete(a.asnumpy(), obj_onp, axis=axis)
+
+            assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+
+
+@use_np
+def test_np_insert():
+    class TestInsert(HybridBlock):
+        def __init__(self, obj, axis=None):
+            super(TestInsert, self).__init__()
+            self._obj = obj
+            self._axis = axis
+
+        def forward(self, a, b):
+            return np.insert(a, self._obj, b, axis=self._axis)
+
+    def GetSize(tp):
+        res = 1
+        for x in tp:
+            res = res * x
+        return res
+
+    def GetNdim(tp):
+        return len(tp)
+
+    A = (3, 2)
+    B = (2)
+    C = (2, 2)
+    D = (2, 3)
+    E = (1)
+    F = (3, 1)
+    G = (3, 2)
+    H = (2, 2, 3, 8)
+    config = []
+    # test scale index
+    for idx in range(-1 * GetSize(A), GetSize(A) + 1):
+        config.append(tuple([A, idx, B, None]))
+        config.append(tuple([A, idx, E, None]))
+        config.append(tuple([A, idx, 1, None]))
+    for idx in range(-1 * A[0], A[0] + 1):
+        config.append(tuple([A, idx, C, 0]))
+        config.append(tuple([A, idx, E, 0]))
+        config.append(tuple([A, idx, F, 0]))
+        config.append(tuple([A, idx, 1, 0]))
+    for idx in range(-1 * A[1], A[1] + 1):
+        config.append(tuple([A, idx, D, 1]))
+        config.append(tuple([A, idx, E, 1]))
+        config.append(tuple([A, idx, F, 1]))
+        config.append(tuple([A, idx, 1, 1]))
+    # test tuple of indices with size = 1
+    for idx in range(-1 * GetSize(A), GetSize(A) + 1):
+        config.append(tuple([A, [idx], B, None]))
+        config.append(tuple([A, [idx], E, None]))
+        config.append(tuple([A, [idx], 1, None]))
+    for idx in range(-1 * A[0], A[0] + 1):
+        config.append(tuple([A, [idx], C, 0]))
+        config.append(tuple([A, [idx], E, 0]))
+        config.append(tuple([A, [idx], F, 0]))
+        config.append(tuple([A, [idx], 1, 0]))
+    for idx in range(-1 * A[1], A[1] + 1):
+        config.append(tuple([A, [idx], G, 1]))
+        config.append(tuple([A, [idx], E, 1]))
+        config.append(tuple([A, [idx], F, 1]))
+        config.append(tuple([A, [idx], 1, 1]))
+    # test tuple of indices with size > 1
+    for ax in range(-1 * GetNdim(A), GetNdim(A)):
+        idx = onp.random.randint(-1 * A[ax], A[ax] + 1, size = (3)).tolist()
+        config.append(tuple([A, idx, F, ax]))
+        config.append(tuple([A, idx, 1, ax]))
+        config.append(tuple([A, slice(0, 3), F, ax]))
+        config.append(tuple([A, slice(0, 3), 1, ax]))
+    # test multidimensional array and unequal dimensions case
+    config.append(tuple([H, 0, D, 3]))
+    config.append(tuple([H, 0, 1, 3]))
+    config.append(tuple([H, [1], E, 2]))
+    config.append(tuple([H, [1], 1, 2]))
+    idx = onp.random.randint(-1 * H[3], H[3] + 1, size = (5)).tolist()
+    config.append(tuple([H, idx, E, 3]))
+    config.append(tuple([H, idx, 1, 3]))
+    # test slice
+    for st in [-5, -3, -1, 0, 1, 3, 5, None]:
+        for ed in [-5, -3, -1, 0, 1, 3, 5, None]:
+            for stp in [-1, 1, 2, None]:
+                config.append(tuple([A, slice(st, ed, stp), F, 1]))
+    dtypes = ['int32', 'float16', 'float32', 'float64', None]
+
+    for arr_shape, obj, val_shape, axis in config:
+        for atype, btype in itertools.product(dtypes, dtypes):
+            if type(obj) == list:
+                obj_mxnp = np.array(obj, dtype='int64')
+                obj_onp = onp.array(obj)
+            elif type(obj) == slice:
+                obj_mxnp = obj
+                obj_onp = obj
+            else:  # integer
+                obj_mxnp = obj
+                obj_onp = obj
+            test_insert = TestInsert(obj=obj_mxnp, axis=axis)
+
+            a = mx.nd.random.uniform(-10.0, 10.0, shape=arr_shape).as_np_ndarray().astype(atype)
+            a.attach_grad()
+            b = mx.nd.random.uniform(-10.0, 10.0, shape=val_shape).as_np_ndarray().astype(btype)
+            b.attach_grad()
+            expected_ret = onp.insert(a.asnumpy(), obj_onp, b.asnumpy(), axis=axis)
+            with mx.autograd.record():
+                y = test_insert(a, b)
+
+            assert y.shape == expected_ret.shape
+            assert_almost_equal(y.asnumpy(), expected_ret, rtol=1e-3, atol=1e-5)
+
+            #test imperative
+            mx_out = np.insert(a, obj_mxnp, b, axis=axis)
+            np_out = onp.insert(a.asnumpy(), obj_onp, b.asnumpy(), axis=axis)
+
+            assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+
+
+@use_np
+@pytest.mark.parametrize('shape,index,inverse,counts', [
+    ((), True, True, True),
+    ((1, ), True, True, True),
+    ((5, ), True, True, True),
+    ((5, ), True, True, True),
+    ((5, 4), True, True, True),
+    ((5, 0, 4), True, True, True),
+    ((0, 0, 0), True, True, True),
+    ((5, 3, 4), True, True, True),
+])
+@pytest.mark.parametrize('dtype', ['float32', 'float64', 'int8', 'uint8', 'int32', 'int64'])
+@pytest.mark.parametrize('hybridize', [False, True])
+def test_np_unique_all(shape, index, inverse, counts, dtype, hybridize):
+    class TestUniqueAll(HybridBlock):
+        def __init__(self):
+            super(TestUniqueAll, self).__init__()
+
+        def forward(self, a):
+            return np.unique_all(a)
+
+    test_unique = TestUniqueAll()
+    if hybridize:
+        test_unique.hybridize()
+    x = onp.random.uniform(-8.0, 8.0, size=shape)
+    x = np.array(x, dtype=dtype)
+    np_out = onp.unique(x.asnumpy(), return_index=index, return_inverse=inverse, return_counts=counts)
+    mx_out = test_unique(x)
+    for i in range(len(mx_out)):
+        assert mx_out[i].shape == np_out[i].shape
+        assert_almost_equal(mx_out[i].asnumpy(), np_out[i], rtol=1e-3, atol=1e-5)
+
+    # Test imperative once again
+    mx_out = np.unique_all(x)
+    np_out = onp.unique(x.asnumpy(), return_index=index, return_inverse=inverse, return_counts=counts)
+    assert mx_out.values.shape == np_out[0].shape
+    assert_almost_equal(mx_out.values.asnumpy(), np_out[0], rtol=1e-3, atol=1e-5)
+    assert mx_out.indices.shape == np_out[1].shape
+    assert_almost_equal(mx_out.indices.asnumpy(), np_out[1], rtol=1e-3, atol=1e-5)
+    assert mx_out.inverse_indices.shape == np_out[2].shape
+    assert_almost_equal(mx_out.inverse_indices.asnumpy(), np_out[2], rtol=1e-3, atol=1e-5)
+    assert mx_out.counts.shape == np_out[3].shape
+    assert_almost_equal(mx_out.counts.asnumpy(), np_out[3], rtol=1e-3, atol=1e-5)
+
+
+@use_np
+@pytest.mark.parametrize('shape,index,inverse,counts', [
+    ((), False, True, False),
+    ((1, ), False, True, False),
+    ((5, ), False, True, False),
+    ((5, ), False, True, False),
+    ((5, 4), False, True, False),
+    ((5, 0, 4), False, True, False),
+    ((0, 0, 0), False, True, False),
+    ((5, 3, 4), False, True, False),
+])
+@pytest.mark.parametrize('dtype', ['float32', 'float64', 'int8', 'uint8', 'int32', 'int64'])
+@pytest.mark.parametrize('hybridize', [False, True])
+def test_np_unique_inverse(shape, index, inverse, counts, dtype, hybridize):
+    class TestUniqueInverse(HybridBlock):
+        def __init__(self):
+            super(TestUniqueInverse, self).__init__()
+
+        def forward(self, a):
+            return np.unique_inverse(a)
+
+    test_unique = TestUniqueInverse()
+    if hybridize:
+        test_unique.hybridize()
+    x = onp.random.uniform(-8.0, 8.0, size=shape)
+    x = np.array(x, dtype=dtype)
+    np_out = onp.unique(x.asnumpy(), return_index=index, return_inverse=inverse, return_counts=counts)
+    mx_out = test_unique(x)
+    for i in range(len(mx_out)):
+        assert mx_out[i].shape == np_out[i].shape
+        assert_almost_equal(mx_out[i].asnumpy(), np_out[i], rtol=1e-3, atol=1e-5)
+
+    # Test imperative once again
+    mx_out = np.unique_inverse(x)
+    np_out = onp.unique(x.asnumpy(), return_index=index, return_inverse=inverse, return_counts=counts)
+    assert mx_out.values.shape == np_out[0].shape
+    assert_almost_equal(mx_out.values.asnumpy(), np_out[0], rtol=1e-3, atol=1e-5)
+    assert mx_out.inverse_indices.shape == np_out[1].shape
+    assert_almost_equal(mx_out.inverse_indices.asnumpy(), np_out[1], rtol=1e-3, atol=1e-5)
+
+
+@use_np
+@pytest.mark.parametrize('shape,index,inverse,counts', [
+    ((), False, False, False),
+    ((1, ), False, False, False),
+    ((5, ), False, False, False),
+    ((5, ), False, False, False),
+    ((5, 4), False, False, False),
+    ((5, 0, 4), False, False, False),
+    ((0, 0, 0), False, False, False),
+    ((5, 3, 4), False, False, False),
+])
+@pytest.mark.parametrize('dtype', ['float32', 'float64', 'int8', 'uint8', 'int32', 'int64'])
+@pytest.mark.parametrize('hybridize', [False, True])
+def test_np_unique_values(shape, index, inverse, counts, dtype, hybridize):
+    class TestUniqueValues(HybridBlock):
+        def __init__(self):
+            super(TestUniqueValues, self).__init__()
+
+        def forward(self, a):
+            return np.unique_values(a)
+
+    test_unique = TestUniqueValues()
+    if hybridize:
+        test_unique.hybridize()
+    x = onp.random.uniform(-8.0, 8.0, size=shape)
+    x = np.array(x, dtype=dtype)
+    np_out = onp.unique(x.asnumpy(), return_index=index, return_inverse=inverse, return_counts=counts)
+    mx_out = test_unique(x)
+    assert mx_out.shape == np_out.shape
+    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+
+    # Test imperative once again
+    mx_out = np.unique_values(x)
+    np_out = onp.unique(x.asnumpy(), return_index=index, return_inverse=inverse, return_counts=counts)
+    assert mx_out.shape == np_out.shape
+    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+
+
+@use_np
+def test_np_windows():
+    class TestWindows(HybridBlock):
+        def __init__(self, func, M):
+            super(TestWindows, self).__init__()
+            self._func = func
+            self._M = M
+
+        def forward(self, x, *args, **kwargs):
+            op = getattr(np, self._func)
+            assert op is not None
+            return x + op(M=self._M)
+
+    configs = [-10, -3, -1, 0, 1, 6, 10, 20]
+    dtypes = ['float32', 'float64']
+    funcs = ['hanning', 'hamming', 'blackman']
+    for config in configs:
+        for dtype in dtypes:
+            for func in funcs:
+                x = np.zeros(shape=(), dtype=dtype)
+                for hybridize in [False, True]:
+                    np_func = getattr(onp, func)
+                    mx_func = TestWindows(func, M=config)
+                    np_out = np_func(M=config).astype(dtype)
+                    if hybridize:
+                        mx_func.hybridize()
+                    mx_out = mx_func(x)
+                    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+                    # test imperative
+                    mx_out = getattr(np, func)(M=config)
+                    np_out = np_func(M=config).astype(dtype)
+                    assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+
+
+@use_np
+def test_np_share_memory():
+    ops = [np.shares_memory, np.may_share_memory]
+    # reshape not support boolean types
+    dtypes = [np.int8, np.uint8, np.int32, np.int64, np.float16, np.float32, np.float64]
+    for op in ops:
+        for dt in dtypes:
+            x = np.zeros([13, 21, 23, 22], dtype=dt)
+            assert not op(x[0,:,:,:], x[1,:,:,:])
+            assert not op(x[2,:,:,:], x[3,:,:,:])
+            assert not op(x[2:5,0,0,0], x[3:4,0,0,0])
+            assert not op(x[2:5,0,0,0], x[4:7,0,0,0])
+            assert op(x[0,0,0,2:5], x[0,0,0,3:4])
+            assert op(x[0,6,0,2:5], x[0,6,0,4:7])
+            assert not op(x[0,5,0,2:5], x[0,6,0,4:7])
+
+            for adt in dtypes:
+                assert not op(x, np.ones((5, 0), dtype=adt))
+                assert not op(np.ones((5, 0), dtype=adt), x)
+                assert not op(np.ones((5, 0), dtype=dt), np.ones((0, 3, 0), dtype=adt))
+
+
+@use_np
+@pytest.mark.parametrize('ndim', [2, 3, 4])
+@pytest.mark.parametrize('func,low,high', [
+    ('bitwise_not', -5, 5),
+    ('invert', -5, 5),
+])
+def test_np_bitwise_not(func, low, high, ndim):
+    def check_unary_func(func, shape, low, high):
+        class TestUnary(HybridBlock):
+            def __init__(self, func):
+                super(TestUnary, self).__init__()
+                self._func = func
+
+            def forward(self, a, *args, **kwargs):
+                return getattr(np, self._func)(a)
+
+        np_func = getattr(onp, func)
+        mx_func = TestUnary(func)
+        np_test_data = onp.random.uniform(low, high, shape).astype(onp.int32)
+        mx_test_data = mx.numpy.array(np_test_data).astype(onp.int32)
+        for hybridize in [True, False]:
+            if hybridize:
+                mx_func.hybridize()
+            np_out = np_func(np_test_data)
+            with mx.autograd.record():
+                y = mx_func(mx_test_data)
+            assert y.shape == np_out.shape
+            assert_almost_equal(y.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+            if np_out.dtype == np.bool_:
+                assert y.dtype == np.bool_
+
+        np_out = getattr(onp, func)(np_test_data)
+        mx_out = getattr(mx.np, func)(mx_test_data)
+        assert mx_out.shape == np_out.shape
+        assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+
+        assertRaises(NotImplementedError, getattr(np, func), mx_test_data, where=False)
+        assertRaises(NotImplementedError, getattr(np, func), mx_test_data,  subok=False)
+        assertRaises(NotImplementedError, getattr(np, func), mx_test_data,  dtype=onp.int8)
+        assertRaises(TypeError, getattr(np, func), mx_test_data,  dtype="abcdefg")
+        assertRaises(NotImplementedError, getattr(np, func), mx_test_data,  casting='safe')
+        assertRaises(TypeError, getattr(np, func), mx_test_data,  casting='mxnet')
+        assertRaises(NotImplementedError, getattr(np, func), mx_test_data,  order='C')
+        assertRaises(NotImplementedError, getattr(np, func), mx_test_data,  order='mxnet')
+
+    shape = random.choice([rand_shape_nd(ndim, dim=3), (1, 0, 2)])
+    for shape in [rand_shape_nd(ndim, dim=3), (1, 0, 2)]:
+        check_unary_func(func, shape, low, high)
+
+
+@use_np
+@pytest.mark.parametrize('ndim', [2, 3, 4])
+@pytest.mark.parametrize('func,low,high', [
+    ('left_shift', -5, 5),
+    ('right_shift', -5, 5),
+])
+def test_np_bitwise_shift(func, low, high, ndim):
+    def check_unary_func(func, shape, low, high):
+        class TestUnary(HybridBlock):
+            def __init__(self, func):
+                super(TestUnary, self).__init__()
+                self._func = func
+
+            def forward(self, a, b, *args, **kwargs):
+                return getattr(np, self._func)(a, b)
+
+        np_func = getattr(onp, func)
+        mx_func = TestUnary("bitwise_" + func)
+        np_test_data1 = onp.random.randint(low, high, shape).astype(onp.int64)
+        np_test_data2 = onp.random.randint(low + 5, high + 5, shape).astype(onp.int64)
+        mx_test_data1 = mx.numpy.array(np_test_data1).astype(onp.int64)
+        mx_test_data2 = mx.numpy.array(np_test_data2).astype(onp.int64)
+        for hybridize in [True, False]:
+            if hybridize:
+                mx_func.hybridize()
+            np_out = np_func(np_test_data1, np_test_data2)
+            with mx.autograd.record():
+                y = mx_func(mx_test_data1, mx_test_data2)
+            assert y.shape == np_out.shape
+            assert_almost_equal(y.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+            if np_out.dtype == np.bool_:
+                assert y.dtype == np.bool_
+
+        np_out = getattr(onp, func)(np_test_data1, np_test_data2)
+        mx_out = getattr(mx.np, "bitwise_" + func)(mx_test_data1, mx_test_data2)
+        assert mx_out.shape == np_out.shape
+        assert_almost_equal(mx_out.asnumpy(), np_out, rtol=1e-3, atol=1e-5)
+
+        assertRaises(TypeError, getattr(np, "bitwise_" + func), mx_test_data1, mx_test_data2, where=False)
+        assertRaises(TypeError, getattr(np, "bitwise_" + func), mx_test_data1, mx_test_data2, subok=False)
+        assertRaises(TypeError, getattr(np, "bitwise_" + func), mx_test_data1, mx_test_data2, dtype=onp.int8)
+        assertRaises(TypeError, getattr(np, "bitwise_" + func), mx_test_data1, mx_test_data2, dtype="abcdefg")
+        assertRaises(TypeError, getattr(np, "bitwise_" + func), mx_test_data1, mx_test_data2, casting='safe')
+        assertRaises(TypeError, getattr(np, "bitwise_" + func), mx_test_data1, mx_test_data2, casting='mxnet')
+        assertRaises(TypeError, getattr(np, "bitwise_" + func), mx_test_data1, mx_test_data2, order='C')
+        assertRaises(TypeError, getattr(np, "bitwise_" + func), mx_test_data1, mx_test_data2, order='mxnet')
+
+    shape = random.choice([rand_shape_nd(ndim, dim=3), (1, 0, 2)])
+    for shape in [rand_shape_nd(ndim, dim=3), (1, 0, 2)]:
+        check_unary_func(func, shape, low, high)
+
+
+@use_np
+@pytest.mark.parametrize('dtype', ['float16', 'float32', 'float64'])
+@pytest.mark.parametrize('lead_dim', [2, 3, 4, 6, 10])
+@pytest.mark.parametrize('both_ways', [False, True])
+def test_np_broadcast_ops_on_misaligned_input(dtype, lead_dim, both_ways):
+    shape = list(rand_shape_2d()) + [lead_dim]
+    small_shape = [shape[0], 1, lead_dim]
+    if both_ways:
+        # Broadcast in both ways [1, K, L] x [M, 1, L]
+        big_shape = [1, shape[1], lead_dim]
+    else:
+        big_shape = shape
+    size = onp.product(shape)
+    small_size = onp.product(small_shape)
+    big_size = onp.product(big_shape)
+    a = np.arange(5000)
+    b = np.arange(5000)
+    e = np.arange(5000)
+    c = a[1:big_size + 1].reshape(tuple(big_shape))
+    d = b[1:small_size + 1].reshape(tuple(small_shape))
+    f = e[1:size + 1].reshape(tuple(shape))
+    f[:] = c + d
+    expected = c.asnumpy() + d.asnumpy()
+    mx.nd.waitall()
+    assert_almost_equal(f, expected)
+
+
+@use_np
+@pytest.mark.parametrize('dtype', ['float16', 'float32', 'float64'])
+@pytest.mark.parametrize('lead_dim', [2, 3, 4, 6, 10])
+@pytest.mark.parametrize('both_ways', [False, True])
+def test_np_broadcast_ops_on_misaligned_input_oneside(dtype, lead_dim, both_ways):
+    shape = list(rand_shape_2d()) + [lead_dim]
+    small_shape = [shape[0], shape[1], 1]
+    if both_ways:
+        # Broadcast in both ways [1, K, L] x [M, 1, 1]
+        big_shape = [1, shape[1], lead_dim]
+    else:
+        big_shape = shape
+    size = onp.product(shape)
+    small_size = onp.product(small_shape)
+    big_size = onp.product(big_shape)
+    a = np.arange(5000)
+    b = np.arange(5000)
+    e = np.arange(5000)
+    c = a[1:big_size + 1].reshape(tuple(big_shape))
+    d = b[1:small_size + 1].reshape(tuple(small_shape))
+    f = e[1:size + 1].reshape(tuple(shape))
+    f[:] = c + d
+    expected = c.asnumpy() + d.asnumpy()
+    mx.nd.waitall()
+    assert_almost_equal(f, expected)
+
+
+@use_np
+def test_np_elementwise_ops_on_misaligned_input():
+    a = np.array([1,2,3,4], dtype='float16')
+    b = np.array([1,2,3,4], dtype='float16')
+
+    c = a[1:3]
+    d = b[1:3]
+    # Note: testing just elemwise_add since all elemwise_ops
+    #       share the implementation
+    c[:] = c + d
+    mx.nd.waitall()
+
+    a = np.array([1,2,3,4], dtype='float16')
+    b = np.array([1,2,3,4], dtype='float16')
+
+    c = a[0:3]
+    d = b[0:3]
+    c[:] = c + d
+    mx.nd.waitall()
+    assert a[3] == 4.0
+
+
+@use_np
+def test_np_apply_along_axis_fallback():
+    data = np.random.randint(-100, 100, (2, 3))
+    axis = 1
+    func1d = lambda x: x.mean()
+    np_y = onp.apply_along_axis(func1d, 1, data.asnumpy())
+    y1 = np.apply_along_axis(func1d, 1, data)
+    y2 = np.apply_along_axis(func1d, 1, arr=data)
+    assert_almost_equal(y1.asnumpy(), np_y)
+    assert y1.asnumpy().dtype == np_y.dtype
+    assert_almost_equal(y2.asnumpy(), np_y)
+    assert y2.asnumpy().dtype == np_y.dtype
+
+
+def test_np_builtin_op_signature():
+    import inspect
+    from mxnet import _numpy_op_doc
+    builtin_np_op_names = [name for name in get_all_registered_operators() if name.startswith('_np_')]
+    for op_name in builtin_np_op_names:
+        _op_from_doc = getattr(_numpy_op_doc, op_name, None)
+        assert _op_from_doc is not None, "Failed to find documentation for operator {}. " \
+                                         "Please add the documentation in _numpy_op_doc.py for this operator."\
+            .format(op_name)
+        op = _get_builtin_op(op_name)
+        assert op is not None
+        assert str(op.__signature__) == str(inspect.signature(_op_from_doc))
+
+
+@use_np
+def test_npi_boolean_assign():
+    class TestBooleanAssignScalar(HybridBlock):
+        def __init__(self, val, start_axis):
+            super(TestBooleanAssignScalar, self).__init__()
+            self._val = val
+            self._start_axis = start_axis
+
+        def forward(self, a, mask):
+            return _npi.boolean_mask_assign_scalar(a, mask, self._val, start_axis=self._start_axis, out=a)
+
+    class TestBooleanAssignTensor(HybridBlock):
+        def __init__(self, start_axis):
+            super(TestBooleanAssignTensor, self).__init__()
+            self._start_axis = start_axis
+
+        def forward(self, a, mask, value):
+            return _npi.boolean_mask_assign_tensor(a, mask, value, start_axis=self._start_axis, out=a)
+
+    configs = [
+        ((3, 4), (3, 4), 0),
+        ((3, 0), (3, 0), 0),
+        ((), (), 0),
+        ((2, 3, 4, 5), (2, 3), 0),
+        ((2, 3, 4, 5), (3, 4), 1),
+        ((2, 3, 4, 5), (4, 5), 2),
+    ]
+
+    for hybridize in [False]:
+        for config in configs:
+            dshape, mshape, start_axis = config
+            test_data = np.random.uniform(size=dshape)
+            valid_num = 0
+            while valid_num == 0:
+                mx_mask = np.random.choice(np.array([False, True], dtype=np.bool), size=mshape)
+                if test_data.size == 0:
+                    break
+                valid_num = int(mx_mask.asnumpy().sum())
+            np_mask = mx_mask.asnumpy().astype(onp.bool)
+            vshape = []
+            vshape_broadcast = []
+            for i in range(len(dshape)):
+                if i < start_axis:
+                    vshape.append(dshape[i])
+                    vshape_broadcast.append(dshape[i])
+                elif i == start_axis:
+                    vshape.append(valid_num)
+                    vshape_broadcast.append(1)
+                elif i >= start_axis + len(mshape):
+                    vshape.append(dshape[i])
+                    vshape_broadcast.append(dshape[i])
+            vshape_broadcast = tuple(vshape_broadcast)
+            for val in [42.0, onp.array(42.), onp.array([42.]), onp.random.uniform(size=vshape), onp.random.uniform(size=vshape_broadcast)]:
+                mx_val = val if isinstance(val, float) else np.array(val, dtype=np.float32)
+                test_block = TestBooleanAssignScalar(val, start_axis) if isinstance(val, float) else TestBooleanAssignTensor(start_axis)
+                if hybridize:
+                    test_block.hybridize()
+                np_data = test_data.asnumpy()
+                mx_data1 = test_data.copy()
+                mx_data2 = test_data.copy()
+                trailing_axis = len(np_data.shape) - len(np_mask.shape) - start_axis
+                if start_axis == 0:
+                    if trailing_axis == 0:
+                        np_data[np_mask] = val
+                        mx_data1[mx_mask] = mx_val
+                    elif trailing_axis == 1:
+                        np_data[np_mask, :] = val
+                        mx_data1[mx_mask, :] = mx_val
+                    elif trailing_axis == 2:
+                        np_data[np_mask, :, :] = val
+                        mx_data1[mx_mask, :, :] = mx_val
+                elif start_axis == 1:
+                    if trailing_axis == 0:
+                        np_data[:, np_mask] = val
+                        mx_data1[:, mx_mask] = mx_val
+                    elif trailing_axis == 1:
+                        np_data[:, np_mask, :] = val
+                        mx_data1[:, mx_mask, :] = mx_val
+                elif start_axis == 2:
+                    if trailing_axis == 0:
+                        np_data[:, :, np_mask] = val
+                        mx_data1[:, :, mx_mask] = mx_val
+                mx_data1 = test_block(mx_data2, mx_mask) if isinstance(val, float) else test_block(mx_data2, mx_mask, mx_val)
+                assert_almost_equal(mx_data1.asnumpy(), np_data, rtol=1e-3, atol=1e-5, use_broadcast=False)
+                assert_almost_equal(mx_data2.asnumpy(), np_data, rtol=1e-3, atol=1e-5, use_broadcast=False)
+
+
+@use_np
+@pytest.mark.parametrize('config', [
+    (0.0, 1.0, 10),
+    (-2, 4, 30),
+    (5.234324, 8.98324, 324),
+    (2, 10, 100)
+])
+@pytest.mark.parametrize('dtype', ['int32', 'float16', 'float32', 'float64', None])
+@pytest.mark.parametrize('hybridize', [True, False])
+@pytest.mark.parametrize('endpoint', [True, False])
+def test_np_linspace_gluon(config, dtype, endpoint, hybridize):
+    class TestLinspace(HybridBlock):
+        def __init__(self, start, stop, num=50, endpoint=None, retstep=False, dtype=None, axis=0):
+            super(TestLinspace, self).__init__()
+            self._start = start
+            self._stop = stop
+            self._num = num
+            self._endpoint = endpoint
+            self._retstep = retstep
+            self._dtype = dtype
+
+        def forward(self, x):
+            if self._retstep:
+                raise ValueError("linspace didn't support retstep = True inside HybridBlock")
+            else:
+                return x + np.linspace(self._start, self._stop, num=self._num, \
+                endpoint=self._endpoint, retstep=self._retstep, dtype=self._dtype)
+
+    x = np.zeros(shape=(), dtype=dtype)
+    if isinstance(config, tuple):
+        net = TestLinspace(*config, endpoint=endpoint, dtype=dtype)
+        np_out = onp.linspace(*config, endpoint=endpoint, dtype=dtype)
+    else:
+        net = TestLinspace(config, endpoint=endpoint, dtype=dtype)
+        np_out = onp.linspace(config, endpoint=endpoint, dtype=dtype)
+    if hybridize:
+        net.hybridize()
+    mx_out = net(x)
+    assert_almost_equal(mx_out.asnumpy(), np_out, atol=1e-3, rtol=1e-5)
+
+
+@use_np
+def test_np_argmin_argmax_large_tensor():
+    # compare inp[arg] with ext directly because along one axis there might 
+    # be multiple extrema
+    def single_run(op, dtype):
+        inp = np.random.normal(0, 10, size=(200, 30000), dtype=dtype)
+        arg = op[0](inp, 1)
+        ref = op[1](inp, 1)
+        for i, idx in enumerate(arg):
+            assert inp[i, idx] == ref[i]
+
+    dtypes = ['float16', 'float32', 'float64']
+    ops = [(np.argmin, np.amin), (np.argmax, np.amax)]
+    for o, d in zip(ops, dtypes):
+        single_run(o, d)
+
+

@@ -1,0 +1,953 @@
+"""Reference random/sampler test bodies, run against mxnet_tpu (VERDICT
+r4 item 2 tranche 3: `np.random` + `npx` samplers).
+
+PROVENANCE: ported from the reference's
+`tests/python/unittest/test_numpy_op.py` (Apache-2.0) — intentionally
+faithful: these bodies are the behavior oracle for sampler shapes,
+dtype handling, and distribution correctness (chi-square buckets via
+``verify_generator``).  `mxnet` resolves to `mxnet_tpu` via the alias
+finder in `tests/parity/conftest.py`.
+"""
+import itertools
+import sys
+import random
+
+import numpy as onp
+import pytest
+import scipy.stats as ss
+
+import mxnet as mx
+from mxnet import np, npx
+from mxnet.base import MXNetError
+from mxnet.gluon import HybridBlock
+from mxnet.test_utils import (
+    assert_almost_equal, effective_dtype, gen_buckets_probs_with_ppf,
+    rand_ndarray, retry, same, use_np, verify_generator,
+)
+from common import assertRaises, xfail_when_nonstandard_decimal_separator, with_environment
+
+
+@use_np
+def test_np_random():
+    shapes = [(), (1,), (2, 3), (4, 0, 5), 6, (7, 8), None]
+    dtypes = ['float16', 'float32', 'float64']
+    op_names = ['uniform', 'normal', 'gamma', 'laplace']
+    for shape in shapes:
+        for dtype in dtypes:
+            for op_name in op_names:
+                op = getattr(np.random, op_name, None)
+                assert op is not None
+                if op_name == 'gamma':
+                    out = op(1, size=shape, dtype=dtype)
+                else:
+                    out = op(size=shape, dtype=dtype)
+                expected_shape = shape
+                if not isinstance(shape, tuple):
+                    expected_shape = () if shape is None else (shape,)
+                assert out.shape == expected_shape
+
+    class TestRandom(HybridBlock):
+        def __init__(self, shape, op_name, param=None):
+            super(TestRandom, self).__init__()
+            self._shape = shape
+            self._op_name = op_name
+            # In case parameters are not optional
+            self._param = param
+
+        def forward(self, x):
+            op = getattr(np.random, self._op_name, None)
+            assert op is not None
+            if self._param is not None:
+                return x + op(self._param, size=self._shape)
+            return x + op(size=self._shape)
+
+    x = np.ones(())
+    for op_name in op_names:
+        for shape in shapes:
+            for hybridize in [False, True]:
+                if op_name == "gamma":
+                    net = TestRandom(shape, op_name, 1)
+                else:
+                    net = TestRandom(shape, op_name)
+                if hybridize:
+                    net.hybridize()
+                out = net(x)
+                expected_shape = shape
+                if not isinstance(shape, tuple):
+                    expected_shape = () if shape is None else (shape,)
+                assert out.shape == expected_shape
+
+
+@use_np
+def test_np_random_a():
+    op_names = ['pareto', 'power', 'weibull']
+    # these distributions have one required parameter a
+    shapes = [(1,), (2, 3), (4, 0, 5), 6, (7, 8), (), None]
+
+    def _test_random_x_range(output):
+        ge_zero = onp.all(output >= 0)
+        smaller_equal_one = onp.all(output <= 1)
+        return ge_zero and smaller_equal_one
+
+    # test imperative size shapes
+    for [shape, op_name] in itertools.product(shapes, op_names):
+        op = getattr(np.random, op_name, None)
+        assert op is not None
+        out = op(1.0, size=shape)
+        expected_shape = shape
+        if not isinstance(shape, tuple):
+            expected_shape = () if shape is None else (shape,)
+        assert out.shape == expected_shape
+        # test range of generated values for power distribution
+        if op_name == 'power':
+            assert _test_random_x_range(out.asnumpy()) == True
+
+    # test symbolic/hybridized size shapes
+    class TestRandomA(HybridBlock):
+        def __init__(self, shape, op_name):
+            super(TestRandomA, self).__init__()
+            self._shape = shape
+            self._op_name = op_name
+
+        def forward(self, a):
+            op = getattr(np.random, self._op_name, None)
+            assert op is not None
+            return op(a, size=self._shape)
+
+    hybridize = [False, True]
+    for [op_name, shape, hybridize] in itertools.product(op_names, shapes, hybridize):
+        test_op = TestRandomA(shape, op_name)
+        if hybridize:
+            test_op.hybridize()
+        mx_out = test_op(np.array(1.0))
+        expected_shape = shape
+        if not isinstance(shape, tuple):
+            expected_shape = () if shape is None else (shape,)
+        assert mx_out.shape == expected_shape
+
+    # test broadcasting of required parameter a shape when a is array-like
+    ashapes = [(1,), (2, 3), (4, 0, 5), 6, (7, 8)]
+    for shape in ashapes:
+        a = np.ones(shape)
+        for op_name in op_names:
+            op = getattr(np.random, op_name, None)
+            assert op is not None
+            mx_out = op(a, size=None)
+            expected_shape = a.shape
+            assert mx_out.shape == expected_shape
+
+    # test illegal parameter values
+    def _test_exception(a):
+        output = op(a=a).asnumpy()
+    for op in op_names:
+        op = getattr(np.random, op_name, None)
+        if op is not None:
+            assertRaises(ValueError, _test_exception, -1)
+            assertRaises(ValueError, _test_exception, 0)
+
+
+@use_np
+def test_np_random_beta():
+    class TestRandomBeta(HybridBlock):
+        def __init__(self, size=None, dtype=None, device=None):
+            super(TestRandomBeta, self).__init__()
+            self._size = size
+            self._dtype = dtype
+            self._device = device
+
+        def forward(self, a, b):
+            return np.random.beta(a, b, size=self._size, dtype=self._dtype, device=self._device)
+
+    def _test_random_beta_range(output):
+        bigger_than_zero = onp.all(output > 0)
+        smaller_than_one = onp.all(output < 1)
+        return bigger_than_zero and smaller_than_one
+
+    # Starting with numpy 1.19.0, output shape of () is no longer supported
+    shape_list = [(0,), (1,), (2, 3), (4, 0, 5), 6, (7, 8), None]
+    # since fp16 might incur precision issue, the corresponding test is skipped
+    dtype_list = [np.float32, np.float64]
+    hybridize_list = [False, True]
+    data = np.array([1])
+    for [param_shape, in_dtype, out_dtype, hybridize] in itertools.product(shape_list,
+            dtype_list, dtype_list, hybridize_list):
+        mx_data = data.astype(in_dtype)
+        np_data = mx_data.asnumpy()
+        test_random_beta = TestRandomBeta(size=param_shape, dtype=out_dtype)
+        if hybridize:
+            test_random_beta.hybridize()
+        np_out = onp.random.beta(np_data, np_data, size=param_shape)
+        mx_out = test_random_beta(mx_data, mx_data)
+        mx_out_imperative = mx.np.random.beta(mx_data, mx_data, size=param_shape, dtype=out_dtype)
+
+        assert np_out.shape == mx_out.shape
+        assert np_out.shape == mx_out_imperative.shape
+        assert _test_random_beta_range(mx_out.asnumpy()) == True
+        assert _test_random_beta_range(mx_out_imperative.asnumpy()) == True
+
+        # test scalar
+        mx_out_imperative = mx.np.random.beta(1, 1, size=param_shape, dtype=out_dtype)
+        assert _test_random_beta_range(mx_out_imperative.asnumpy()) == True
+
+
+@use_np
+def test_np_random_chisquare():
+    class TestRandomChisquare(HybridBlock):
+        def __init__(self, size=None, dtype=None, device=None):
+            super(TestRandomChisquare, self).__init__()
+            self._size = size
+            self._dtype = dtype
+            self._device = device
+
+        def forward(self, df):
+            return np.random.chisquare(df, size=self._size, dtype=self._dtype, device=self._device)
+
+    # Starting with numpy 1.19.0, output shape of () is no longer supported
+    shape_list = [(0,), (1,), (2, 3), (4, 0, 5), 6, (7, 8), None]
+
+    dtype_list = [np.float16, np.float32, np.float64]
+    hybridize_list = [False, True]
+    df = np.array([1])
+    for [param_shape, in_dtype, out_dtype, hybridize] in itertools.product(shape_list,
+            dtype_list, dtype_list, hybridize_list):
+        if sys.version_info.major < 3 and param_shape == ():
+            continue
+        mx_df = df.astype(in_dtype)
+        np_df = mx_df.asnumpy()
+        test_random_chisquare = TestRandomChisquare(size=param_shape, dtype=out_dtype)
+        if hybridize:
+            test_random_chisquare.hybridize()
+        np_out = onp.random.chisquare(np_df, size=param_shape)
+        mx_out = test_random_chisquare(mx_df)
+        mx_out_imperative = mx.np.random.chisquare(mx_df, size=param_shape, dtype=out_dtype)
+
+        assert np_out.shape == mx_out.shape
+        assert np_out.shape == mx_out_imperative.shape
+
+
+@use_np
+def test_np_random_f():
+    class TestRandomF(HybridBlock):
+        def __init__(self, size=None):
+            super(TestRandomF, self).__init__()
+            self._size = size
+
+        def forward(self, dfnum, dfden):
+            return np.random.f(dfnum, dfden, size=self._size)
+
+    # Starting with numpy 1.19.0, output shape of () is no longer supported
+    shape_list = [(0,), (1,), (2, 3), (4, 0, 5), 6, (7, 8), None]
+    hybridize_list = [False, True]
+    df = np.array([1])
+    for [param_shape, hybridize] in itertools.product(shape_list,
+         hybridize_list):
+        if sys.version_info.major < 3 and param_shape == ():
+            continue
+        mx_df = df
+        np_df = mx_df.asnumpy()
+        test_random_f = TestRandomF(size=param_shape)
+        if hybridize:
+            test_random_f.hybridize()
+        np_out = onp.random.f(np_df, np_df, size=param_shape)
+        mx_out = test_random_f(mx_df, mx_df)
+        mx_out_imperative = mx.np.random.f(mx_df, mx_df, size=param_shape)
+
+        assert np_out.shape == mx_out.shape
+        assert np_out.shape == mx_out_imperative.shape
+
+
+@xfail_when_nonstandard_decimal_separator
+@use_np
+def test_np_random_grad():
+    class TestRandomGrad(HybridBlock):
+        def __init__(self, shape, op_name):
+            super(TestRandomGrad, self).__init__()
+            self._shape = shape
+            self._dist_name = op_name
+        def forward(self, loc, scale):
+            op = getattr(np.random, self._dist_name, None)
+            assert op is not None
+            return op(loc=loc, scale=scale, size=self._shape)
+
+    param_shape = [
+        [(3, 2), (3, 2)],
+        [(3, 2, 2), (3, 2, 2)],
+        [(3, 4, 5), (4, 1)],
+    ]
+    output_shapes = [
+        (3, 2),
+        (4, 3, 2, 2),
+        (3, 4, 5)
+    ]
+    op_names = ["normal", "logistic", "gumbel"]
+    for op_name in op_names:
+        for hybridize in [False, True]:
+            for ((shape1, shape2), out_shape) in zip(param_shape, output_shapes):
+                test_random_grad = TestRandomGrad(out_shape, op_name)
+                if hybridize:
+                    test_random_grad.hybridize()
+                loc = np.zeros(shape1)
+                loc.attach_grad()
+                scale = np.ones(shape2)
+                scale.attach_grad()
+                with mx.autograd.record():
+                    samples = test_random_grad(loc, scale)
+                samples.backward()
+                assert loc.grad.shape == shape1
+                assert scale.grad.shape == shape2
+                assert_almost_equal(loc.grad.asnumpy().sum(), onp.ones(out_shape).sum(), rtol=1e-3, atol=1e-5)
+
+        for (loc, scale) in [(2, (2,3)), ((2,3), 2), ((2,3), (2,3))]:
+            if isinstance(loc, tuple):
+                loc = np.ones(loc)
+            if isinstance(scale, tuple):
+                scale = np.ones(scale)
+            mx_out = getattr(np.random, op_name)(loc, scale)
+            np_out = getattr(onp.random, op_name)(loc, scale)
+            assert mx_out.asnumpy().shape == np_out.shape
+
+
+@use_np
+def test_np_random_rayleigh():
+    class TestRayleigh(HybridBlock):
+        def __init__(self, shape):
+            super(TestRayleigh, self).__init__()
+            self._shape = shape
+
+        def forward(self, scale):
+            return np.random.rayleigh(scale, self._shape)
+
+    shapes = [(2, 3), (4, 0, 5), (7, 8)]
+    for hybridize in [False, True]:
+        for shape in shapes:
+            test_rayleigh = TestRayleigh(shape)
+            if hybridize:
+                test_rayleigh.hybridize()
+
+            scale = np.ones(shape)
+            scale.attach_grad()
+            with mx.autograd.record():
+                mx_out = test_rayleigh(scale)
+            np_out = onp.random.rayleigh(scale = scale.asnumpy(), size = shape)
+            assert np_out.shape == mx_out.shape
+            mx_out.backward()
+            assert scale.grad.shape == shape
+            assert_almost_equal(scale.grad.asnumpy().sum(), mx_out.asnumpy().sum(), rtol=1e-3, atol=1e-5)
+
+    for shape in shapes:
+        mx_out = np.random.rayleigh(np.array([1]), shape)
+        np_out = onp.random.rayleigh(np.array([1]).asnumpy(), shape)
+        assert mx_out.asnumpy().shape == np_out.shape
+
+    def _test_rayleigh_exception(scale):
+        output = np.random.rayleigh(scale=scale).asnumpy()
+    assertRaises(ValueError, _test_rayleigh_exception, -1)
+
+
+@use_np
+def test_np_rand():
+    # Test shapes.
+    shapes = [
+        (3, 3),
+        (3, 4),
+        (0, 0),
+        (3, 3, 3),
+        (0, 0, 0),
+        (2, 2, 4, 3),
+        (2, 2, 4, 3),
+        (2, 0, 3, 0),
+        (2, 0, 2, 3)
+    ]
+    dtypes = ['float16', 'float32', 'float64']
+    for dtype in dtypes:
+        for shape in shapes:
+            data_mx = np.random.rand(*shape, dtype=dtype)
+            assert data_mx.shape == shape
+
+    # Test random generator.
+    device = mx.device.current_device()
+    samples = 1000000
+    trials = 8
+    num_buckets = 10
+    lower = 0.0
+    upper = 1.0
+    for dtype in ['float16', 'float32', 'float64']:
+        buckets, probs = gen_buckets_probs_with_ppf(
+            lambda x: ss.uniform.ppf(x, lower, upper), num_buckets)
+        # Quantize bucket boundaries to reflect the actual dtype
+        # and adjust probs accordingly
+        buckets = np.array(buckets, dtype=dtype).tolist()
+        probs = [(ss.uniform.cdf(buckets[i][1], lower, upper) -
+                  ss.uniform.cdf(buckets[i][0], lower, upper))
+                 for i in range(num_buckets)]
+
+        def generator_mx(x): return np.random.rand(
+            samples, device=device, dtype=dtype).asnumpy()
+        verify_generator(generator=generator_mx, buckets=buckets,
+                         probs=probs, nsamples=samples, nrepeat=trials)
+        generator_mx_same_seed =\
+            lambda x: onp.concatenate(
+                [np.random.rand(x // 10, device=device, dtype=dtype).asnumpy()
+                    for _ in range(10)])
+        verify_generator(generator=generator_mx_same_seed, buckets=buckets,
+                         probs=probs, nsamples=samples, nrepeat=trials)
+
+
+@use_np
+def test_np_randn():
+    # Test shapes.
+    shapes = [
+        (3, 3),
+        (3, 4),
+        (0, 0),
+        (3, 3, 3),
+        (0, 0, 0),
+        (2, 2, 4, 3),
+        (2, 2, 4, 3),
+        (2, 0, 3, 0),
+        (2, 0, 2, 3)
+    ]
+    dtypes = ['float16', 'float32', 'float64']
+    for dtype in dtypes:
+        for shape in shapes:
+            data_mx = np.random.randn(*shape, dtype=dtype)
+            assert data_mx.shape == shape
+
+
+@use_np
+def test_np_randint():
+    device = mx.device.current_device()
+    # test shapes
+    params = [
+        (0, 10),
+        (5, None)
+    ]
+    shapes = [
+        None,
+        (),
+        (3, 3),
+        (3, 4),
+        (0, 0),
+        (3, 3, 3),
+        (0, 0, 0),
+        (2, 2, 4, 3),
+        (2, 2, 4, 3),
+        (2, 0, 3, 0),
+        (2, 0, 2, 3)
+    ]
+    for shape in shapes:
+        for (low, high) in params:
+            data_mx = np.random.randint(low, high, size=shape)
+            assert data_mx.shape == (shape if shape is not None else ())
+
+    # test generator
+    for dtype in ['int32', 'int64']:
+        for low, high in [(50000000, 50001000),(-50000100,-50000000),(-500,199)]:
+            scale = high - low
+            buckets, probs = gen_buckets_probs_with_ppf(lambda x: ss.uniform.ppf(x, loc=low, scale=scale), 5)
+            # Quantize bucket boundaries to reflect the actual dtype and adjust probs accordingly
+            buckets = onp.array(buckets, dtype=dtype).tolist()
+            probs = [(buckets[i][1] - buckets[i][0]) / float(scale) for i in range(5)]
+            generator_mx = lambda x: np.random.randint(low, high, size=x, dtype=dtype, device=device).asnumpy()
+            verify_generator(generator=generator_mx, buckets=buckets, probs=probs, nrepeat=100)
+            # Scipy uses alpha = 0.01 for testing discrete distribution generator but we are using default alpha=0.05 (higher threshold ensures robustness)
+            # Refer - https://github.com/scipy/scipy/blob/9f12af697763fb5f9767d5cb1280ce62456a3974/scipy/stats/tests/test_discrete_basic.py#L45
+            generator_mx_same_seed = \
+                lambda x: onp.concatenate(
+                    [np.random.randint(low, high, size=x // 10, dtype=dtype, device=device).asnumpy()
+                        for _ in range(10)])
+            verify_generator(generator=generator_mx_same_seed, buckets=buckets, probs=probs, nrepeat=100)
+
+
+@use_np
+@pytest.mark.skip(reason='Skipped as the test is flaky and the feature causes curand error. Tracked in #18100')
+def test_np_choice():
+    class TestUniformChoice(HybridBlock):
+        def __init__(self, sample_size, replace):
+            super(TestUniformChoice, self).__init__()
+            self.sample_size = sample_size
+            self.replace = replace
+
+        def forward(self, a):
+            return np.random.choice(a=a, size=self.sample_size, replace=self.replace, p=None)
+
+    class TestWeightedChoice(HybridBlock):
+        def __init__(self, sample_size, replace):
+            super(TestWeightedChoice, self).__init__()
+            self.sample_size = sample_size
+            self.replace = replace
+
+        def forward(self, a, p):
+            op = getattr(np.random, "choice", None)
+            return np.random.choice(a, self.sample_size, self.replace, p)
+
+    def test_sample_with_replacement(sampler, num_classes, shape, weight=None):
+        samples = sampler(num_classes, shape, replace=True, p=weight).asnumpy()
+        generated_density = onp.histogram(samples, onp.arange(num_classes + 1), density=True)[0]
+        expected_density = (weight.asnumpy() if weight is not None else
+                            onp.array([1 / num_classes] * num_classes))
+        # test almost equal
+        assert_almost_equal(generated_density, expected_density, rtol=1e-1, atol=1e-1)
+        # test shape
+        assert (samples.shape == shape)
+
+    def test_sample_without_replacement(sampler, num_classes, shape, num_trials, weight=None):
+        samples = sampler(num_classes, shape, replace=False, p=weight).asnumpy()
+        # Check shape and uniqueness
+        assert samples.shape == shape
+        assert len(onp.unique(samples)) == samples.size
+        # Check distribution
+        bins = onp.zeros((num_classes))
+        expected_freq = (weight.asnumpy() if weight is not None else
+                         onp.array([1 / num_classes] * num_classes))
+        for _ in range(num_trials):
+            out = sampler(num_classes, 1, replace=False, p=weight).item()
+            bins[out] += 1
+        bins /= num_trials
+        assert_almost_equal(bins, expected_freq, rtol=1e-1, atol=1e-1)
+
+    def test_indexing_mode(sampler, set_size, samples_size, replace, weight=None):
+        a = np.arange(set_size)
+        if weight is not None:
+            samples = sampler(a, weight)
+        else:
+            samples = sampler(a)
+        assert len(samples) == samples_size
+        if not replace:
+            assert len(onp.unique(samples.asnumpy())) == samples_size
+
+    num_classes = 10
+    num_samples = 10 ** 8
+    # Density tests are commented out due to their huge time comsumption.
+    # Tests passed locally.
+    # shape_list1 = [
+    #     (10 ** 8, 1),
+    #     (10 ** 5, 10 ** 3),
+    #     (10 ** 2, 10 ** 3, 10 ** 3)
+    # ]
+    # for shape in shape_list1:
+    #     test_sample_with_replacement(np.random.choice, num_classes, shape)
+    #     weight = np.array(onp.random.dirichlet([1.0] * num_classes))
+    #     test_sample_with_replacement(np.random.choice, num_classes, shape, weight)
+
+    # Tests passed locally,
+    # commented out for the same reason as above.
+    # shape_list2 = [
+    #     (6, 1),
+    #     (2, 3),
+    #     (1, 2, 3),
+    #     (2, 2),
+    # ]
+    # for shape in shape_list2:
+    #     test_sample_without_replacement(np.random.choice, num_classes, shape, 10 ** 5)
+    #     weight = np.array(onp.random.dirichlet([1.0] * num_classes))
+    #     test_sample_without_replacement(np.random.choice, num_classes, shape, 10 ** 5, weight)
+
+    # Test hypridize mode:
+    for wtype in ['float16', 'float32', 'float64']:
+        for hybridize in [True, False]:
+            for replace in [True, False]:
+                test_choice = TestUniformChoice(num_classes // 2, replace)
+                test_choice_weighted = TestWeightedChoice(num_classes // 2, replace)
+                if hybridize:
+                    test_choice.hybridize()
+                    test_choice_weighted.hybridize()
+                weight = np.array(onp.random.dirichlet([1.0] * num_classes)).astype(wtype)
+                test_indexing_mode(test_choice, num_classes, num_classes // 2, replace, None)
+                test_indexing_mode(test_choice_weighted, num_classes, num_classes // 2, replace, weight)
+
+
+@use_np
+def test_np_exponential():
+    class TestRandomExp(HybridBlock):
+        def __init__(self, shape):
+            super(TestRandomExp, self).__init__()
+            self._shape = shape
+
+        def forward(self, scale):
+            return np.random.exponential(scale, self._shape)
+
+    output_shapes = [
+        (3, 2),
+        (4, 3, 2, 2),
+        (3, 4, 5)
+    ]
+    for hybridize in [False, True]:
+        for out_shape in output_shapes:
+            test_exponential_grad = TestRandomExp(out_shape)
+            if hybridize:
+                test_exponential_grad.hybridize()
+            scale = np.ones(out_shape)
+            scale.attach_grad()
+            with mx.autograd.record():
+                mx_out = test_exponential_grad(scale)
+            np_out = onp.random.exponential(scale = scale.asnumpy(), size = out_shape)
+            assert np_out.shape == mx_out.shape
+            mx_out.backward()
+            assert scale.grad.shape == out_shape
+            assert_almost_equal(scale.grad.asnumpy().sum(), mx_out.asnumpy().sum(), rtol=1e-3, atol=1e-5)
+
+    def _test_exponential_exception(scale):
+        output = np.random.exponential(scale=scale).asnumpy()
+    assertRaises(ValueError, _test_exponential_exception, -1)
+
+
+@use_np
+@pytest.mark.skip(reason='Test hangs. Tracked in #18144')
+def test_np_multivariate_normal():
+    class TestMultivariateNormal(HybridBlock):
+        def __init__(self, size=None):
+            super(TestMultivariateNormal, self).__init__()
+            self.size = size
+
+        def forward(self, mean, cov):
+            return np.random.multivariate_normal(mean, cov, self.size)
+
+    hybridize_list = [True, False]
+    dtypes = ['float16', 'float32', 'float64']
+    size_list = [None, 1, (), (2, 3), (2, 0)]
+    # [mean_shape, cov_shape]: onp.broadcast(mean_shape, cov_shape[:-1]) should not raise error
+    batch_shape_list = [[(2,), (2, 2)], [(3, 2), (2, 2)], [(2,), (3, 2, 2)], [(3, 2), (4, 3, 2, 2)]]
+    # most basic case for mean and cov
+    mean = np.array([0.123456789, 10])
+    cov = np.array([[1, 0], [0, 10]])
+
+    for [hybridize, dtype, size, batch_shape] in itertools.product(hybridize_list,\
+                dtypes, size_list, batch_shape_list):
+        # simplest case: 1-d, 0 batch
+        # compared with official numpy
+        mean_shape = batch_shape[0]
+        cov_shape = batch_shape[1]
+        new_mean = np.broadcast_to(mean, mean_shape).astype(dtype)
+        new_cov = np.broadcast_to(cov, cov_shape).astype(dtype)
+
+        test_multivariate_normal = TestMultivariateNormal(size)
+        if hybridize:
+            test_multivariate_normal.hybridize()
+
+        test_shape = test_multivariate_normal(new_mean, new_cov).shape
+        actual_shape = np.random.multivariate_normal(new_mean, new_cov, size).shape
+
+        desired_shape = np.broadcast_arrays(np.empty(mean_shape), np.empty(cov_shape[:-1]))[0].shape
+
+        if size is not None:
+            size = [size] if isinstance(size, int) else list(size)
+            desired_shape = size + list(desired_shape)
+
+        assert list(desired_shape) == list(test_shape)
+        assert list(desired_shape) == list(actual_shape)
+
+
+@use_np
+def test_np_lognormal_grad():
+    class TestLognormalGrad(HybridBlock):
+        def __init__(self, shape):
+            super(TestLognormalGrad, self).__init__()
+            self._shape = shape
+
+        def forward(self, mean, sigma):
+            return np.random.lognormal(mean, sigma, self._shape)
+
+    param_shape = [
+        [(3, 2), (3, 2)],
+        [(3, 2, 2), (3, 2, 2)],
+        [(3, 4, 5), (4, 1)],
+    ]
+    output_shapes = [
+        (3, 2),
+        (4, 3, 2, 2),
+        (3, 4, 5)
+    ]
+    for hybridize in [False, True]:
+        for ((shape1, shape2), out_shape) in zip(param_shape, output_shapes):
+            test_lognormal_grad = TestLognormalGrad(out_shape)
+            if hybridize:
+                test_lognormal_grad.hybridize()
+            mean = np.zeros(shape1)
+            mean.attach_grad()
+            sigma = np.ones(shape2)
+            sigma.attach_grad()
+            with mx.autograd.record():
+                mx_out = test_lognormal_grad(mean, sigma)
+            np_out = onp.random.lognormal(mean = mean.asnumpy(),
+                                            sigma = sigma.asnumpy(), size = out_shape)
+            assert np_out.shape == mx_out.shape
+            mx_out.backward()
+            assert mean.grad.shape == shape1
+            assert sigma.grad.shape == shape2
+            assert_almost_equal(mean.grad.asnumpy().sum(), mx_out.asnumpy().sum(), rtol=1e-3, atol=1e-5)
+
+    for ((shape1, shape2), out_shape) in zip(param_shape, output_shapes):
+        mx_out = np.random.lognormal(np.zeros(shape1), np.ones(shape2), out_shape)
+        np_out = onp.random.lognormal(np.zeros(shape1).asnumpy(), np.ones(shape2).asnumpy(), out_shape)
+        assert mx_out.asnumpy().shape == np_out.shape
+
+    def _test_lognormal_exception(sigma):
+        output = np.random.lognormal(sigma=sigma).asnumpy()
+    assertRaises(ValueError, _test_lognormal_exception, -1)
+
+
+@use_np
+def test_np_pareto_grad():
+    class TestRandomP(HybridBlock):
+        def __init__(self, shape):
+            super(TestRandomP, self).__init__()
+            self._shape = shape
+
+        def forward(self, a):
+            return np.random.pareto(a, self._shape)
+
+    output_shapes = [
+        (3, 2),
+        (4, 3, 2, 2),
+        (3, 4, 5)
+    ]
+    for hybridize in [False, True]:
+        for out_shape in output_shapes:
+            test_w_grad = TestRandomP(out_shape)
+            if hybridize:
+                test_w_grad.hybridize()
+            a = np.ones(out_shape)
+            a.attach_grad()
+            with mx.autograd.record():
+                mx_out = test_w_grad(a)
+            mx_out.backward()
+
+            # gradient formula from calculus (a=1)
+            noise = np.log(mx_out + np.ones(mx_out.shape))
+            formula_grad = - (mx_out + np.ones(mx_out.shape)) * noise
+            assert a.grad.shape == out_shape
+            assert_almost_equal(a.grad.asnumpy().sum(), formula_grad.asnumpy().sum(), rtol=1e-3, atol=1e-5)
+
+
+@use_np
+def test_np_weibull_grad():
+    class TestRandomW(HybridBlock):
+        def __init__(self, shape):
+            super(TestRandomW, self).__init__()
+            self._shape = shape
+
+        def forward(self, a):
+            return np.random.weibull(a, self._shape)
+
+    output_shapes = [
+        (3, 2),
+        (4, 3, 2, 2),
+        (3, 4, 5)
+    ]
+    for hybridize in [False, True]:
+        for out_shape in output_shapes:
+            test_w_grad = TestRandomW(out_shape)
+            if hybridize:
+                test_w_grad.hybridize()
+            a = np.ones(out_shape)
+            a.attach_grad()
+            with mx.autograd.record():
+                mx_out = test_w_grad(a)
+            mx_out.backward()
+
+            # gradient formula calculus (a=1)
+            formula_grad = - mx_out * np.log(mx_out)
+            assert a.grad.shape == out_shape
+            assert_almost_equal(a.grad.asnumpy().sum(), formula_grad.asnumpy().sum(), rtol=1e-3, atol=1e-5)
+
+
+@use_np
+@pytest.mark.parametrize("shape", [(1,), (2, 2), (4, 2, 2)])
+@pytest.mark.parametrize("a", [2.0, 5.0, 10.0])
+@pytest.mark.parametrize("b", [0.5, 1.0, 1.5])
+def test_gamma_grad(shape, a, b):
+    class TestGammaGrad(HybridBlock):
+        def __init__(self, size, beta):
+            super(TestGammaGrad, self).__init__()
+            self._size = size
+            self._beta = beta
+
+        def forward(self, a):
+            return np.random.gamma(a, self._beta, size=self._size)
+
+    for hybridize in [True, False]:
+        param = np.ones(shape) * a
+        param.attach_grad()
+        net = TestGammaGrad(shape, b)
+        if hybridize:
+            net.hybridize()
+        with mx.autograd.record():
+            samples = net(param)
+        samples.backward()
+        # Check shape
+        assert param.grad.shape == param.shape
+        # Check correctness
+        cdf = ss.gamma.cdf
+        log_pdf = ss.gamma.logpdf
+        eps = (0.01 * param / (1.0 + param ** 0.5)).asnumpy()
+        x = samples.asnumpy().astype('float64') / b
+        # d(cdf(x;alpha,beta))/d(alpha)
+        cdf_alpha = (cdf(x, param.asnumpy() + eps) -
+                        cdf(x, param.asnumpy() - eps)) / (2 * eps)
+        # d(cdf(x;alpha,beta))/d(x)
+        log_cdf_x = log_pdf(x, param.asnumpy())
+        expected_grad = -b * cdf_alpha / onp.exp(log_cdf_x)
+        assert_almost_equal(expected_grad, param.grad.asnumpy(), rtol=1e-2, atol=1e-3)
+
+
+@use_np
+def test_gamma_exception():
+    def _test_gamma_exception(shape, scale):
+        return np.random.gamma(shape, scale).asnumpy()
+
+    shape_list = [
+        1,
+        np.array(1),
+        np.array(1),
+        0,
+        0,
+        np.array(0)
+    ]
+    scale_list = [
+        0,
+        0,
+        np.array(-1.0),
+        1,
+        np.array(1),
+        np.array(1)
+    ]
+    for (shape, scale) in zip(shape_list, scale_list):
+        assertRaises(ValueError, _test_gamma_exception, shape, scale)
+
+
+@use_np
+def test_random_seed():
+    for seed in [234, 594, 7240, 20394]:
+        ret = []
+        for _ in range(2):
+            npx.random.seed(seed=seed)
+            ret.append(np.random.uniform(size=(2, 3)))
+        assert_almost_equal(ret[0].asnumpy(), ret[1].asnumpy(), rtol=1e-4, atol=1e-5, use_broadcast=False)
+
+
+@use_np
+def test_npx_categorical():
+    class TestNumpyCategorical(HybridBlock):
+        def __init__(self, size=None):
+            super(TestNumpyCategorical, self).__init__()
+            self.size = size
+
+        def forward(self, prob):
+            if self.size is None:
+                return npx.random.categorical(prob)
+            return npx.random.categorical(prob, shape=self.size)
+
+    batch_sizes = [(2,), (2, 3)]
+    event_shapes = [None, (10,), (10, 12)]
+    num_event = [2, 4, 10]
+    for batch_size, num_event, event_shape in itertools.product(batch_sizes, num_event, event_shapes):
+        for hybridize in [True, False]:
+            prob = np.ones(batch_size + (num_event,)) / num_event
+            net = TestNumpyCategorical(event_shape)
+            if hybridize:
+                net.hybridize()
+            mx_out = net(prob)
+            desired_shape = batch_size + event_shape if event_shape is not None else batch_size
+            assert mx_out.shape == desired_shape
+
+
+@use_np
+def test_npx_multinomial():
+    class TestNumpyMultinomial(HybridBlock):
+        def __init__(self, size=None):
+            super(TestNumpyMultinomial, self).__init__()
+            self.size = size
+
+        def forward(self, n, prob):
+            if self.size is None:
+                return npx.random.multinomial(n, prob)
+            return npx.random.multinomial(n, prob, shape=self.size)
+
+    batch_sizes = [(2,), (2, 3)]
+    event_shapes = [None, (10,), (10, 12)]
+    num_event = [2, 4, 10]
+    for batch_size, num_event, event_shape in itertools.product(batch_sizes, num_event, event_shapes):
+        for hybridize in [True, False]:
+            n = np.ones(batch_size)
+            prob = np.ones(batch_size + (num_event,)) / num_event
+            net = TestNumpyMultinomial(event_shape)
+            if hybridize:
+                net.hybridize()
+            mx_out = net(n, prob)
+            desired_shape = batch_size + event_shape + (num_event,) if event_shape is not None else batch_size + (num_event,)
+            assert mx_out.shape == desired_shape
+
+
+@use_np
+def test_npx_random_bernoulli():
+    def _test_bernoulli_exception(prob, logit):
+        output = npx.random.bernoulli(prob=prob, logit=logit).asnumpy()
+
+    shapes = [(), (1,), (2, 3), (4, 0, 5), 6, (7, 8), None]
+    dtypes = ['float16', 'float32', 'float64', 'int32', 'bool']
+    for shape, dtype in itertools.product(shapes, dtypes):
+        prob = np.random.uniform(size=shape)
+        logit = np.log(prob) - np.log(1 - prob)
+        expected_shape = shape
+        if not isinstance(shape, tuple):
+            expected_shape = () if shape is None else (shape,)
+        out_prob = npx.random.bernoulli(prob=prob, size=shape, dtype=dtype)
+        assert out_prob.shape == expected_shape
+        assert int((out_prob.asnumpy() == 0).sum() + (out_prob.asnumpy() == 1).sum()) == out_prob.size
+        out_logit = npx.random.bernoulli(logit=logit, size=shape, dtype=dtype)
+        assert out_logit.shape == expected_shape
+        assert int((out_logit.asnumpy() == 0).sum() + (out_logit.asnumpy() == 1).sum()) == out_logit.size
+        # Test Exception.
+        assertRaises(ValueError, _test_bernoulli_exception, prob, logit)
+        if prob.size > 0:
+            # larger than 1
+            assertRaises(ValueError, _test_bernoulli_exception, prob + 2.0, None)
+            # smaller than 0
+            assertRaises(ValueError, _test_bernoulli_exception, prob - 2.0, None)
+            # mixed case
+            low, high = (-1.0, 2.0)
+            # uniform(-1, 2)
+            scaled_prob = low + (high - low) * prob
+            if not ((scaled_prob.asnumpy() >= 0).all() and (scaled_prob.asnumpy() <= 1).all()):
+                assertRaises(ValueError, _test_bernoulli_exception, scaled_prob, None)
+
+
+@use_np
+def test_npx_sample_n():
+    def shape_formatter(s):
+        if s is None:
+            return ()
+        if isinstance(s, tuple):
+            return s
+        # scalar case
+        return (s,)
+
+    class TestSampleN(HybridBlock):
+        def __init__(self, shape, op_name, dtype):
+            super(TestSampleN, self).__init__()
+            self._shape = shape
+            self._op_name = op_name
+            self._dtype = dtype
+
+        def forward(self, param1, param2):
+            op = getattr(npx.random, self._op_name, None)
+            assert op is not None
+            return op(param1, param2, batch_shape=self._shape, dtype=self._dtype)
+
+    batch_shapes = [(10,), (2, 3), 6, ()]
+    event_shapes = [(), (2,), (2,2)]
+    dtypes = ['float16', 'float32', 'float64']
+    op_names = ['uniform_n', 'normal_n']
+
+    for bshape, eshape, dtype, op in itertools.product(batch_shapes, event_shapes, dtypes, op_names):
+        for hybridize in [True, False]:
+            net = TestSampleN(bshape, op, dtype)
+            if hybridize:
+                net.hybridize()
+            expected_shape = (shape_formatter(bshape) +
+                              shape_formatter(eshape))
+            out = net(np.ones(shape=eshape), np.ones(shape=eshape))
+            assert out.shape == expected_shape
+
+
