@@ -111,6 +111,16 @@ class ndarray:
         return apply_op(jnp.transpose, (self,), {})
 
     @property
+    def mT(self) -> "ndarray":
+        """Matrix transpose (swap the last two axes; Array-API `.mT`)."""
+        if self.ndim < 2:
+            raise ValueError(
+                f"matrix transpose requires at least 2 dimensions; "
+                f"got {self.ndim}")
+        return apply_op(lambda v: jnp.swapaxes(v, -1, -2), (self,), {},
+                        name="mT")
+
+    @property
     def stype(self) -> str:
         return "default"  # dense only
 
@@ -702,7 +712,12 @@ def _apply_op(fn: Callable, array_args: Sequence[ndarray], kwargs: dict,
         v = list(const)
         for i, dv in zip(diff_idx, diff_vals):
             v[i] = dv
-        return fn(*v, **kwargs) if kwargs else fn(*v)
+        out = fn(*v, **kwargs) if kwargs else fn(*v)
+        # canonicalize multi-output structure to a plain tuple: jnp ops
+        # return registered-pytree NamedTuples (SVDResult, SlogdetResult,
+        # EighResult, ...) or lists, and the vjp captured here must accept
+        # the plain-tuple cotangents backward_on_heads feeds it
+        return tuple(out) if isinstance(out, (list, tuple)) else out
 
     try:
         if not shadow_idx:
