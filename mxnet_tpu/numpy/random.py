@@ -16,14 +16,14 @@ import numpy as _onp
 from .. import random as _rng
 from ..base import check_x64_dtype
 from ..device import Device, current_device
-from ..ndarray.ndarray import ndarray, from_jax
+from ..ndarray.ndarray import ndarray, apply_op, from_jax, is_tracer
 
 __all__ = [
     "seed", "uniform", "normal", "randn", "rand", "randint", "choice",
     "shuffle", "permutation", "gamma", "beta", "exponential", "poisson",
-    "multinomial", "bernoulli", "lognormal", "logistic", "gumbel", "laplace",
-    "rayleigh", "weibull", "pareto", "power", "chisquare", "f",
-    "multivariate_normal",
+    "multinomial", "categorical", "bernoulli", "lognormal", "logistic",
+    "gumbel", "laplace", "rayleigh", "weibull", "pareto", "power",
+    "chisquare", "f", "multivariate_normal",
 ]
 
 _DEFAULT_FLOAT = jnp.float32
@@ -35,8 +35,10 @@ def _dt(dtype):
     return dtype or _DEFAULT_FLOAT
 
 
-def seed(s):
-    _rng.seed(s)
+def seed(seed):
+    """Reseed the global generator (accepts ``seed=`` by keyword, the
+    reference `npx.random.seed` spelling)."""
+    _rng.seed(seed)
 
 
 def _shape(size):
@@ -62,31 +64,104 @@ def _wrap(data, device, ctx):
     return from_jax(data, _dev(device, ctx))
 
 
+def _param_shape(size, *params):
+    if size is not None:
+        return _shape(size)
+    return jnp.broadcast_shapes(*(jnp.shape(_val(p)) for p in params))
+
+
+def _check_param(name, v, positive=False):
+    """Eager support validation (reference: sampler kernels CHECK the
+    param range and fail the op, surfaced as ValueError from the numpy
+    front end).  Tracers skip the check — inside jit the reference
+    kernels are not running eagerly either."""
+    x = _val(v)
+    if is_tracer(x):
+        return
+    arr = _onp.asarray(x)
+    if arr.size == 0:
+        return
+    bad = (arr <= 0) if positive else (arr < 0)
+    if bad.any():
+        raise ValueError(
+            f"{name} must be {'positive' if positive else 'non-negative'}")
+
+
+def _cdt(dt):
+    """Compute dtype: f16 samplers draw and transform at f32 (the
+    reference kernels compute at float and Cast to storage dtype;
+    drawing natively in f16 lives on a 2^-10 lattice whose bucket masses
+    fail the ported chi-square generator tests)."""
+    return jnp.float32 if jnp.dtype(dt) == jnp.float16 else dt
+
+
+def _draw(fn, k, sz, dt, **kw):
+    return fn(k, sz, _cdt(dt), **kw)
+
+
+def _finish(r, dt):
+    return r if r.dtype == jnp.dtype(dt) else r.astype(dt)
+
+
+def _finish_floor_unit(r, dt):
+    """Cast a [0,1)-supported result DOWNWARD onto the dt grid:
+    round-to-nearest would both emit exactly 1.0 (outside the contract)
+    and systematically shift half-ulp mass across bucket edges, which
+    the ported chi-square generator tests detect at 1e6 samples."""
+    if r.dtype == jnp.dtype(dt):
+        return r
+    q = r.astype(dt)
+    return jnp.where(q.astype(r.dtype) > r,
+                     jnp.nextafter(q, jnp.asarray(-jnp.inf, dt)), q)
+
+
+def _sample_op(name, fn, params, out=None, device=None, ctx=None):
+    """Run a sampler transform through `apply_op` so the TAPE records it:
+    parameter gradients (reparameterized / implicit) flow to `loc`,
+    `scale`, `a`, ... exactly as the reference's sampler backward kernels
+    propagate them (`src/operator/numpy/random/*_op.h` backward).  The
+    raw draw uses a pre-split key captured in the closure — replay under
+    higher-order grad reuses the same noise, which is what pathwise
+    derivatives require."""
+    r = apply_op(fn, list(params), {}, name=name)
+    if device is not None or ctx is not None:
+        moved = r.to_device(_dev(device, ctx))
+        # keep the tape ref: to_device re-wraps the buffer and would
+        # otherwise silently detach sampler-parameter gradients
+        moved._ag_node = r._ag_node
+        moved._ag_out_index = r._ag_out_index
+        r = moved
+    if out is not None:
+        out._rebind(r)
+        return out
+    return r
+
+
 def uniform(low=0.0, high=1.0, size=None, dtype=None, device=None, ctx=None, out=None):
     k = _rng.next_key()
-    low, high = _val(low), _val(high)
-    shape = _shape(size) if size is not None else jnp.broadcast_shapes(
-        jnp.shape(low), jnp.shape(high))
-    r = jax.random.uniform(k, shape, _dt(dtype))
-    r = r * (high - low) + low
-    res = _wrap(r, device, ctx)
-    if out is not None:
-        out._rebind(res)
-        return out
-    return res
+    dt = _dt(dtype)
+    sz = _param_shape(size, low, high)
+
+    def _fn(lo, hi):
+        u = _draw(jax.random.uniform, k, sz, dt)
+        lo = jnp.asarray(lo, u.dtype)
+        return _finish_floor_unit(
+            u * (jnp.asarray(hi, u.dtype) - lo) + lo, dt)
+
+    return _sample_op("np.random.uniform", _fn, (low, high), out, device, ctx)
 
 
 def normal(loc=0.0, scale=1.0, size=None, dtype=None, device=None, ctx=None, out=None):
     k = _rng.next_key()
-    loc, scale = _val(loc), _val(scale)
-    shape = _shape(size) if size is not None else jnp.broadcast_shapes(
-        jnp.shape(loc), jnp.shape(scale))
-    r = jax.random.normal(k, shape, _dt(dtype)) * scale + loc
-    res = _wrap(r, device, ctx)
-    if out is not None:
-        out._rebind(res)
-        return out
-    return res
+    dt = _dt(dtype)
+    sz = _param_shape(size, loc, scale)
+
+    def _fn(lo, sc):
+        eps = _draw(jax.random.normal, k, sz, dt)
+        return _finish(eps * jnp.asarray(sc, eps.dtype)
+                       + jnp.asarray(lo, eps.dtype), dt)
+
+    return _sample_op("np.random.normal", _fn, (loc, scale), out, device, ctx)
 
 
 def randn(*shape, dtype=None, device=None, ctx=None):
@@ -138,31 +213,46 @@ def shuffle(x: ndarray):
 
 
 def gamma(shape, scale=1.0, size=None, dtype=None, device=None, ctx=None, out=None):
+    _check_param("shape", shape, positive=True)
+    _check_param("scale", scale, positive=True)
     k = _rng.next_key()
-    a, scale = _val(shape), _val(scale)
-    sz = _shape(size) if size is not None else jnp.broadcast_shapes(
-        jnp.shape(a), jnp.shape(scale))
-    r = jax.random.gamma(k, jnp.asarray(a, _dt(dtype)), sz,
-                         _dt(dtype)) * scale
-    res = _wrap(r, device, ctx)
-    if out is not None:
-        out._rebind(res); return out
-    return res
+    dt = _dt(dtype)
+    sz = _param_shape(size, shape, scale)
+
+    def _fn(a, sc):
+        a_b = jnp.broadcast_to(jnp.asarray(a, dt), sz)
+        # jax.random.gamma carries the IMPLICIT reparameterization
+        # gradient w.r.t. the shape parameter (Figurnov et al.), the same
+        # derivative the reference's gamma backward kernel computes
+        return jax.random.gamma(k, a_b, sz, dt) * jnp.asarray(sc, dt)
+
+    return _sample_op("np.random.gamma", _fn, (shape, scale), out, device, ctx)
 
 
 def beta(a, b, size=None, dtype=None, device=None, ctx=None):
     k = _rng.next_key()
-    r = jax.random.beta(k, _val(a), _val(b), _shape(size), _dt(dtype))
-    return _wrap(r, device, ctx)
+    dt = _dt(dtype)
+    sz = _param_shape(size, a, b)
+
+    def _fn(av, bv):
+        ab = jnp.broadcast_to(jnp.asarray(av, dt), sz)
+        bb = jnp.broadcast_to(jnp.asarray(bv, dt), sz)
+        return jax.random.beta(k, ab, bb, sz, dt)
+
+    return _sample_op("np.random.beta", _fn, (a, b), None, device, ctx)
 
 
 def exponential(scale=1.0, size=None, dtype=None, device=None, ctx=None, out=None):
+    _check_param("scale", scale)
     k = _rng.next_key()
-    r = jax.random.exponential(k, _shape(size), _dt(dtype)) * _val(scale)
-    res = _wrap(r, device, ctx)
-    if out is not None:
-        out._rebind(res); return out
-    return res
+    dt = _dt(dtype)
+    sz = _param_shape(size, scale)
+
+    def _fn(sc):
+        e = _draw(jax.random.exponential, k, sz, dt)
+        return _finish(e * jnp.asarray(sc, e.dtype), dt)
+
+    return _sample_op("np.random.exponential", _fn, (scale,), out, device, ctx)
 
 
 def poisson(lam=1.0, size=None, dtype=None, device=None, ctx=None):
@@ -171,87 +261,162 @@ def poisson(lam=1.0, size=None, dtype=None, device=None, ctx=None):
     return _wrap(r, device, ctx)
 
 
-def multinomial(n, pvals, size=None):
-    k = _rng.next_key()
+def multinomial(n, pvals, size=None, shape=None):
+    """Dual surface (the reference splits these across modules):
+
+    - `np.random.multinomial(n:int, pvals:1-D, size)` — numpy API,
+      count vectors over `size` independent experiments
+      (`python/mxnet/numpy/random.py` multinomial);
+    - `npx.random.multinomial(n:array, prob:(batch..,k), shape=ev)` —
+      batched counts, output `batch + ev + (k,)`
+      (`python/mxnet/ndarray/numpy_extension/random.py`)."""
+    sz = size if size is not None else shape
     pv = jnp.asarray(_val(pvals))
-    sz = _shape(size)
-    draws = jax.random.categorical(k, jnp.log(pv), shape=sz + (n,))
-    counts = jax.nn.one_hot(draws, pv.shape[-1], dtype=jnp.int64
-                            if False else jnp.int32).sum(axis=-2)
+    k = _rng.next_key()
+    if isinstance(n, ndarray) or pv.ndim > 1 or jnp.ndim(_val(n)) > 0:
+        nv = jnp.asarray(_val(n))
+        batch = pv.shape[:-1]
+        ncls = pv.shape[-1]
+        ev = _shape(sz)
+        trials = int(_onp.asarray(jnp.max(nv))) if nv.size else 0
+        g = jax.random.gumbel(k, batch + ev + (trials, ncls))
+        logits = jnp.log(pv).reshape(
+            batch + (1,) * (len(ev) + 1) + (ncls,))
+        draws = jnp.argmax(logits + g, axis=-1)          # batch+ev+(T,)
+        oh = jax.nn.one_hot(draws, ncls, dtype=jnp.int32)
+        # broadcast (not reshape): n may be scalar alongside batched prob
+        nvb = jnp.broadcast_to(nv, batch).reshape(
+            batch + (1,) * (len(ev) + 1))
+        mask = (jnp.arange(trials) < nvb)[..., None]
+        return _wrap((oh * mask).sum(axis=-2), None, None)
+    draws = jax.random.categorical(k, jnp.log(pv), shape=_shape(sz) + (n,))
+    counts = jax.nn.one_hot(draws, pv.shape[-1], dtype=jnp.int32).sum(axis=-2)
     return _wrap(counts, None, None)
 
 
-def bernoulli(prob=None, logit=None, size=None, dtype=None, device=None, ctx=None):
+def categorical(prob, shape=None, size=None, dtype=None, device=None,
+                ctx=None):
+    """`npx.random.categorical(prob, shape=ev)`: index draws over the
+    last axis of a batched probability tensor; output `batch + ev`
+    (parity: `npx.random.categorical`,
+    `python/mxnet/ndarray/numpy_extension/random.py`)."""
     k = _rng.next_key()
-    if prob is None:
-        prob = jax.nn.sigmoid(jnp.asarray(_val(logit)))
+    pv = jnp.asarray(_val(prob))
+    batch, ncls = pv.shape[:-1], pv.shape[-1]
+    ev = _shape(shape if shape is not None else size)
+    g = jax.random.gumbel(k, batch + ev + (ncls,))
+    logits = jnp.log(pv).reshape(batch + (1,) * len(ev) + (ncls,))
+    draws = jnp.argmax(logits + g, axis=-1)
+    return _wrap(draws.astype(dtype or jnp.int32), device, ctx)
+
+
+def bernoulli(prob=None, logit=None, size=None, dtype=None, device=None, ctx=None):
+    if (prob is None) == (logit is None):
+        raise ValueError(
+            "bernoulli requires exactly one of `prob` / `logit`")
+    k = _rng.next_key()
+    if prob is not None:
+        pv = jnp.asarray(_val(prob))
+        if not is_tracer(pv) and pv.size and bool(
+                jnp.any((pv < 0) | (pv > 1))):
+            # reference kernel validates the support eagerly
+            # (np_bernoulli_op.h CheckBroadcastable + prob range)
+            raise ValueError("bernoulli prob must lie in [0, 1]")
     else:
-        prob = jnp.asarray(_val(prob))
-    sz = _shape(size) if size is not None else jnp.shape(prob)
-    r = jax.random.bernoulli(k, prob, sz)
-    return _wrap(r.astype(_dt(dtype)), device, ctx)
+        pv = jax.nn.sigmoid(jnp.asarray(_val(logit)))
+    sz = _shape(size) if size is not None else jnp.shape(pv)
+    r = jax.random.bernoulli(k, pv, sz)
+    return _wrap(r.astype(dtype if dtype is not None else _DEFAULT_FLOAT),
+                 device, ctx)
 
 
 def lognormal(mean=0.0, sigma=1.0, size=None, dtype=None, device=None, ctx=None):
-    return normal(0.0, 1.0, size, dtype, device, ctx)._method_exp(mean, sigma) \
-        if False else _wrap(jnp.exp(jax.random.normal(_rng.next_key(), _shape(size),
-                            _dt(dtype)) * _val(sigma) + _val(mean)),
-                            device, ctx)
-
-
-def logistic(loc=0.0, scale=1.0, size=None, dtype=None, device=None, ctx=None):
+    _check_param("sigma", sigma)
     k = _rng.next_key()
-    r = jax.random.logistic(k, _shape(size), _dt(dtype))
-    return _wrap(r * _val(scale) + _val(loc), device, ctx)
+    dt = _dt(dtype)
+    sz = _param_shape(size, mean, sigma)
+
+    def _fn(mu, sg):
+        eps = _draw(jax.random.normal, k, sz, dt)
+        return _finish(jnp.exp(eps * jnp.asarray(sg, eps.dtype)
+                               + jnp.asarray(mu, eps.dtype)), dt)
+
+    return _sample_op("np.random.lognormal", _fn, (mean, sigma), None, device, ctx)
 
 
-def gumbel(loc=0.0, scale=1.0, size=None, dtype=None, device=None, ctx=None):
-    k = _rng.next_key()
-    r = jax.random.gumbel(k, _shape(size), _dt(dtype))
-    return _wrap(r * _val(scale) + _val(loc), device, ctx)
+def _loc_scale_sampler(name, std_sampler):
+    def sampler(loc=0.0, scale=1.0, size=None, dtype=None, device=None,
+                ctx=None):
+        k = _rng.next_key()
+        dt = _dt(dtype)
+        sz = _param_shape(size, loc, scale)
+
+        def _fn(lo, sc):
+            eps = _draw(std_sampler, k, sz, dt)
+            return _finish(eps * jnp.asarray(sc, eps.dtype)
+                           + jnp.asarray(lo, eps.dtype), dt)
+
+        return _sample_op(name, _fn, (loc, scale), None, device, ctx)
+    return sampler
 
 
-def laplace(loc=0.0, scale=1.0, size=None, dtype=None, device=None, ctx=None):
-    k = _rng.next_key()
-    r = jax.random.laplace(k, _shape(size), _dt(dtype))
-    return _wrap(r * _val(scale) + _val(loc), device, ctx)
+logistic = _loc_scale_sampler("np.random.logistic", jax.random.logistic)
+gumbel = _loc_scale_sampler("np.random.gumbel", jax.random.gumbel)
+laplace = _loc_scale_sampler("np.random.laplace", jax.random.laplace)
 
 
 def rayleigh(scale=1.0, size=None, dtype=None, device=None, ctx=None):
+    _check_param("scale", scale)
     k = _rng.next_key()
-    u = jax.random.uniform(k, _shape(size), _dt(dtype),
-                           minval=jnp.finfo(_dt(dtype)).tiny)
-    return _wrap(_val(scale) * jnp.sqrt(-2.0 * jnp.log(u)), device, ctx)
+    dt = _dt(dtype)
+    sz = _param_shape(size, scale)
+
+    def _fn(sc):
+        u = _draw(jax.random.uniform, k, sz, dt,
+                  minval=jnp.finfo(jnp.float32).tiny)
+        return _finish(jnp.asarray(sc, u.dtype)
+                       * jnp.sqrt(-2.0 * jnp.log(u)), dt)
+
+    return _sample_op("np.random.rayleigh", _fn, (scale,), None, device, ctx)
 
 
-def weibull(a, size=None, dtype=None, device=None, ctx=None):
-    k = _rng.next_key()
-    u = jax.random.uniform(k, _shape(size), _dt(dtype),
-                           minval=jnp.finfo(_dt(dtype)).tiny)
-    return _wrap(jnp.power(-jnp.log(u), 1.0 / jnp.asarray(_val(a))), device, ctx)
+def _shape_param_sampler(name, transform):
+    def sampler(a, size=None, dtype=None, device=None, ctx=None):
+        _check_param("a", a, positive=True)
+        k = _rng.next_key()
+        dt = _dt(dtype)
+        sz = _param_shape(size, a)
+
+        def _fn(av):
+            u = _draw(jax.random.uniform, k, sz, dt,
+                      minval=jnp.finfo(jnp.float32).tiny, maxval=1.0)
+            return _finish(transform(u, jnp.asarray(av, u.dtype)), dt)
+
+        return _sample_op(name, _fn, (a,), None, device, ctx)
+    return sampler
 
 
-def pareto(a, size=None, dtype=None, device=None, ctx=None):
-    k = _rng.next_key()
-    u = jax.random.uniform(k, _shape(size), _dt(dtype),
-                           minval=jnp.finfo(_dt(dtype)).tiny)
-    return _wrap(jnp.power(u, -1.0 / jnp.asarray(_val(a))) - 1.0, device, ctx)
-
-
-def power(a, size=None, dtype=None, device=None, ctx=None):
-    k = _rng.next_key()
-    u = jax.random.uniform(k, _shape(size), _dt(dtype))
-    return _wrap(jnp.power(u, 1.0 / jnp.asarray(_val(a))), device, ctx)
+weibull = _shape_param_sampler(
+    "np.random.weibull", lambda u, a: jnp.power(-jnp.log(u), 1.0 / a))
+pareto = _shape_param_sampler(
+    "np.random.pareto", lambda u, a: jnp.power(u, -1.0 / a) - 1.0)
+power = _shape_param_sampler(
+    "np.random.power", lambda u, a: jnp.power(u, 1.0 / a))
 
 
 def chisquare(df, size=None, dtype=None, device=None, ctx=None):
-    return gamma(jnp.asarray(_val(df)) / 2.0, 2.0, size, dtype, device, ctx)
+    # df stays an ndarray so the gamma implicit gradient reaches it
+    return gamma(df / 2.0 if isinstance(df, ndarray)
+                 else jnp.asarray(_val(df)) / 2.0,
+                 2.0, size, dtype, device, ctx)
 
 
 def f(dfnum, dfden, size=None, dtype=None, device=None, ctx=None):
     num = chisquare(dfnum, size, dtype, device, ctx)
     den = chisquare(dfden, size, dtype, device, ctx)
-    return (num / _val(dfnum)) / (den / _val(dfden))
+    dnum = dfnum if isinstance(dfnum, ndarray) else jnp.asarray(_val(dfnum))
+    dden = dfden if isinstance(dfden, ndarray) else jnp.asarray(_val(dfden))
+    return (num / dnum) / (den / dden)
 
 
 def multivariate_normal(mean, cov, size=None, check_valid="warn", tol=1e-8,

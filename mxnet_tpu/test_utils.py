@@ -49,8 +49,19 @@ def almost_equal(a, b, rtol=1e-5, atol=1e-8, equal_nan=False) -> bool:
 
 
 def assert_almost_equal(a, b, rtol=1e-5, atol=1e-8, names=("a", "b"),
-                        equal_nan=False):
+                        equal_nan=False, use_broadcast=True, mismatches=(10, 10)):
+    # use_broadcast/mismatches: reference-signature compatibility
+    # (tests/python/unittest pass them); assert_allclose broadcasts by
+    # numpy rules either way and prints its own mismatch summary
     a_np, b_np = _to_np(a), _to_np(b)
+    if not use_broadcast:
+        assert a_np.shape == b_np.shape, \
+            f"shape mismatch: {a_np.shape} vs {b_np.shape}"
+    elif a_np.shape != b_np.shape:
+        # the reference helper broadcasts both operands before comparing
+        # (test_utils.py assert_almost_equal use_broadcast=True);
+        # assert_allclose itself refuses shape-differing inputs
+        a_np, b_np = _onp.broadcast_arrays(a_np, b_np)
     if a_np.dtype == _onp.dtype("V2") or str(a_np.dtype) == "bfloat16":
         a_np = a_np.astype(_onp.float32)
     if str(b_np.dtype) == "bfloat16":
