@@ -102,13 +102,14 @@ class Block:
             out = OrderedDict((k, v) for k, v in out.items() if pat.search(k))
         return out
 
-    def _collect(self, out, prefix):
+    def _collect(self, out, prefix, mutate=True):
         for name, p in self._reg_params.items():
             key = prefix + name
-            p._structure_key = key
+            if mutate:
+                p._structure_key = key
             out[key] = p
         for cname, child in self._children.items():
-            child._collect(out, prefix + cname + ".")
+            child._collect(out, prefix + cname + ".", mutate)
 
     def initialize(self, init=None, device=None, ctx=None, verbose=False,
                    force_reinit=False):
@@ -415,7 +416,15 @@ class HybridBlock(Block):
 
     # -- jit machinery -------------------------------------------------------
     def _param_list(self) -> List[Tuple[str, Parameter]]:
-        return list(self.collect_params().items())
+        # NON-mutating collection: the jit cache runs on CHILD blocks
+        # (each hybridized leaf jits its own forward), and a mutating
+        # collect here would clobber every parameter's _structure_key
+        # with child-local names after warm-up — silently collapsing the
+        # Trainer's name-keyed update dicts (observed: 4 params -> 2
+        # colliding keys on the second step)
+        out: "OrderedDict[str, Parameter]" = OrderedDict()
+        self._collect(out, "", mutate=False)
+        return list(out.items())
 
     def _make_jit_fn(self, training: bool, struct, n_leaves: int,
                      param_names: List[str], params: Dict[str, Parameter]):

@@ -41,7 +41,11 @@ class Trainer:
         self._param_dict = param_dict
         self._params = [p for p in param_dict.values()
                         if p.grad_req != "null"]
-        self._param_names = [p.name for p in param_dict.values()
+        # keyed by the caller's dict keys (collect_params structure
+        # names): unique by construction and IMMUTABLE for this
+        # trainer's lifetime — p.name can be re-stamped by a later
+        # collect_params on a sub-block, which must not re-key updates
+        self._param_names = [k for k, p in param_dict.items()
                              if p.grad_req != "null"]
 
         optimizer_params = optimizer_params or {}
@@ -94,11 +98,11 @@ class Trainer:
         self._kv_initialized = True
 
     def _ensure_states(self):
-        for p in self._params:
-            if p.name not in self._states:
-                self._states[p.name] = \
+        for n, p in zip(self._param_names, self._params):
+            if n not in self._states:
+                self._states[n] = \
                     self._optimizer.create_state_multi_precision(
-                        p.name, p.data())
+                        n, p.data())
 
     # -- main API -------------------------------------------------------------
     def step(self, batch_size, ignore_stale_grad=False):
@@ -182,9 +186,9 @@ class Trainer:
                 self._uniform_mults():
             self._fused_update()
         else:
-            for p in self._params:
+            for n, p in zip(self._param_names, self._params):
                 self._optimizer.update_multi_precision(
-                    p.name, p.data(), p.grad, self._states[p.name])
+                    n, p.data(), p.grad, self._states[n])
 
     def _uniform_mults(self):
         o = self._optimizer
@@ -198,10 +202,10 @@ class Trainer:
         o = self._optimizer
         o.num_update += 1
         t = o.num_update
-        for p in self._params:
-            o._index_update_count[p.name] = t
+        names = self._param_names
+        for n in names:
+            o._index_update_count[n] = t
 
-        names = [p.name for p in self._params]
         params_tree = {n: p.data()._data for n, p in zip(names, self._params)}
         grads_tree = {n: p.grad._data for n, p in zip(names, self._params)}
 
