@@ -65,16 +65,23 @@ results = {}
 import os as _os
 import subprocess
 _repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
-try:
-    r = subprocess.run([sys.executable, _os.path.join(_repo, "bench.py"),
-                        "--measure", "default"], capture_output=True,
-                       text=True, timeout=600)
-    for line in reversed(r.stdout.strip().splitlines()):
-        if line.startswith("{"):
-            print("A0 bench(masked):", line)
-            break
-except subprocess.TimeoutExpired:
-    print("A0 bench(masked): timed out; continuing with A-G")
+if _os.environ.get("MXTPU_SKIP_A0"):
+    # r05_tpu_session.py already ran the bench in THIS process; a child
+    # bench here would open a second client session against the tunnel —
+    # the exact overlap that wedges it.
+    print("A0 bench(masked): skipped (in-session bench already captured)")
+else:
+    try:
+        r = subprocess.run([sys.executable,
+                            _os.path.join(_repo, "bench.py"),
+                            "--measure", "default"], capture_output=True,
+                           text=True, timeout=600)
+        for line in reversed(r.stdout.strip().splitlines()):
+            if line.startswith("{"):
+                print("A0 bench(masked):", line)
+                break
+    except subprocess.TimeoutExpired:
+        print("A0 bench(masked): timed out; continuing with A-G")
 
 # A. full-sequence head (= old bench config)
 f = build_step(BertConfig(dtype="bfloat16"))

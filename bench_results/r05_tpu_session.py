@@ -1,0 +1,98 @@
+"""Round-5 single-session TPU capture: probe -> matmul ceiling -> full
+bench -> ablation suite, ALL in one process / one PjRt client session.
+
+Why one process: the tunnel wedged at 08:28:16Z right after a successful
+probe whose client session overlapped the next client's connect
+(r05_watcher.log) — same blip-then-hang shape as rounds 3/4.  Serial
+child processes each pay a fresh connect against a server that may have a
+phantom half-open session; a single session pays it once and captures
+every stage it reaches before any wedge.  Stages print incrementally with
+timestamps, so a hang localizes itself in the log.
+
+Run (watcher does this automatically):
+    timeout -s INT 3000 python bench_results/r05_tpu_session.py
+"""
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+T0 = time.time()
+
+
+def stage(msg):
+    print(f"[{time.time() - T0:7.1f}s] {msg}", flush=True)
+
+
+stage("importing jax")
+import jax                                                     # noqa: E402
+import jax.numpy as jnp                                        # noqa: E402
+
+stage("jax.devices() ...")
+dev = jax.devices()[0]
+stage(f"devices ok: {dev.device_kind} platform={dev.platform}")
+if dev.platform.lower() == "cpu":
+    stage("ambient platform is cpu — nothing to capture; exiting")
+    sys.exit(3)
+
+# ---- leg 1: tiny matmul (probe-equivalent; proves execution) ----
+f = jax.jit(lambda a, b: (a @ b).sum())
+x = jnp.ones((256, 256), jnp.bfloat16)
+v = float(jax.device_get(f(x, x)))
+stage(f"tiny matmul ok: {v:.0f}")
+
+# ---- leg 2: matmul ceiling (cheap compile, real TF/s datum) ----
+try:
+    n, k = 4096, 8
+    a = jnp.ones((n, n), jnp.bfloat16)
+
+    def chain(a):
+        x = a
+        for _ in range(k):
+            x = x @ a
+        return x
+
+    g = jax.jit(chain)
+    jax.device_get(g(a))  # compile
+    t0 = time.perf_counter()
+    reps = 10
+    for _ in range(reps):
+        r = g(a)
+    jax.device_get(r)
+    dt = (time.perf_counter() - t0) / reps
+    tfs = (2 * n ** 3 * k) / dt / 1e12
+    stage(f"matmul ceiling: {dt*1e3:.2f} ms/chain -> {tfs:.1f} TF/s bf16")
+    with open(os.path.join(_REPO, "bench_results", "r05_matmul_ceiling.json"),
+              "w") as fh:
+        json.dump({"tflops_bf16": round(tfs, 1), "n": n, "chain": k,
+                   "device": dev.device_kind,
+                   "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                time.gmtime())}, fh)
+except Exception as e:  # keep going: the bench is the prize
+    stage(f"matmul ceiling failed: {type(e).__name__}: {e}")
+
+# ---- leg 3: the full bench, in-process ----
+stage("bench._measure(default) starting (BERT-base b64 s128 train step)")
+import bench  # noqa: E402  (repo-root bench.py)
+
+result = bench._measure("default")
+line = json.dumps(result)
+print(line, flush=True)
+stage(f"bench done: {result['metric']}={result['value']} {result['unit']}")
+bench._remember_tpu_result(result)
+with open(os.path.join(_REPO, "bench_results", "r05_bench_line.json"),
+          "w") as fh:
+    fh.write(line + "\n")
+
+# ---- leg 4: ablation suite (A0 child-bench skipped: we ARE the bench) ----
+stage("ablation suite starting (A-J, in this same session)")
+os.environ["MXTPU_SKIP_A0"] = "1"
+import runpy
+
+runpy.run_path(os.path.join(_REPO, "bench_results",
+                            "perf_ablation_suite.py"),
+               run_name="__main__")
+stage("session complete")

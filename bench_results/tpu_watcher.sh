@@ -1,21 +1,43 @@
 #!/bin/bash
-# TPU tunnel watcher (round 3): probe cleanly every ~7 min; when the tunnel
-# answers, immediately run bench.py and then the ablation suite, logging
-# everything. Discipline per docs/performance.md: probes and runs are fresh
-# processes that exit on their own; timeouts deliver SIGINT (Python-level
-# KeyboardInterrupt -> clean PjRt teardown), never SIGKILL.
+# TPU tunnel watcher (round 5): probe cleanly every ~7 min; when the
+# tunnel answers, run the SINGLE-SESSION capture (probe + matmul ceiling
+# + bench + ablation suite in ONE process / ONE client session —
+# r05_tpu_session.py).  Round-5 lesson: at 08:28Z the tunnel answered a
+# probe then wedged for every subsequent client; serial child processes
+# each pay a fresh connect, so one blip yielded nothing.  One session
+# captures every stage it reaches.  Discipline per docs/performance.md:
+# timeouts deliver SIGINT (clean PjRt teardown), never SIGKILL first.
 set -u
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
-LOG="$REPO/bench_results/r03_watcher.log"
-OUT="$REPO/bench_results/r03_tpu_run.log"
+LOG="$REPO/bench_results/r05_watcher.log"
+OUT="$REPO/bench_results/r05_tpu_run.log"
 cd "$REPO"
 
 log() { echo "$(date -u +%H:%M:%S) $*" >> "$LOG"; }
 
-log "watcher started"
+# gate: did the session capture a REAL tpu bench line (top-level
+# platform, not the carried last_known_tpu record)?
+tpu_line_captured() {
+    python - <<'EOF'
+import json, sys
+try:
+    with open("bench_results/r05_bench_line.json") as f:
+        d = json.loads(f.read().strip())
+    sys.exit(0 if d.get("extras", {}).get("platform") == "tpu" else 1)
+except Exception:
+    sys.exit(1)
+EOF
+}
+
+log "watcher (r05 single-session) started"
 while true; do
     # clean probe: devices + one tiny jitted matmul end-to-end
-    timeout -s INT 240 python - <<'EOF' >> "$LOG" 2>&1
+    # -k: a client hung at CONNECT ignores SIGINT (r05 observed: the
+    # wedge-mode hang is uninterruptible at the Python level); without a
+    # hard-kill fallback the watcher itself wedges on one probe.  A
+    # connect-hung client holds no live device session, so the SIGKILL
+    # taboo (mid-RPC teardown) does not apply to it.
+    timeout -s INT -k 45 240 python - <<'EOF' >> "$LOG" 2>&1
 import time, jax, jax.numpy as jnp
 t0 = time.time()
 d = jax.devices()
@@ -28,9 +50,7 @@ EOF
     if [ $rc -eq 0 ]; then
         # two-way protocol: claim the lock ATOMICALLY (noclobber), waiting
         # while a live driver holds it; stale locks (>90 min unrefreshed)
-        # are broken. A live holder always finishes or goes stale, so no
-        # overall cap — a cap shorter than the staleness window would
-        # steal a live claim.
+        # are broken.
         LOCK="$REPO/bench_results/.tpu_claim.lock"
         announced=0
         while ! ( set -o noclobber; echo "$$" > "$LOCK" ) 2>/dev/null; do
@@ -44,9 +64,10 @@ EOF
             announced=1
             sleep 30
         done
-        log "tunnel healthy -> running bench.py"
-        # traps cover signals too (an orphaned keepalive would refresh a
-        # phantom lock forever); only OUR lock ($$-stamped) is removed
+        # wait out any teardown of the probe's own client session before
+        # the session process connects (overlap is the wedge trigger)
+        sleep 10
+        log "tunnel healthy -> running r05_tpu_session.py (single session)"
         ( while true; do sleep 60; touch "$LOCK" 2>/dev/null || exit; done ) &
         KEEPALIVE=$!
         release() {
@@ -56,20 +77,20 @@ EOF
         trap 'release' EXIT
         trap 'release; exit 130' INT TERM HUP
         export MXTPU_CLAIM_HOLDER=1
-        timeout -s INT 2700 python bench.py > "$REPO/bench_results/r03_bench_line.json" 2>> "$OUT"
-        brc=$?
-        log "bench rc=$brc: $(cat "$REPO/bench_results/r03_bench_line.json" | head -c 400)"
-        if grep -q '"platform": "tpu"' "$REPO/bench_results/latest_tpu.json" 2>/dev/null \
-           && grep -q '"platform": "tpu"' "$REPO/bench_results/r03_bench_line.json" 2>/dev/null; then
-            log "TPU bench captured -> running ablation suite"
-            timeout -s INT 3600 python bench_results/perf_ablation_suite.py >> "$OUT" 2>&1
-            log "ablation suite rc=$? -- watcher done"
+        timeout -s INT -k 60 3000 python bench_results/r05_tpu_session.py >> "$OUT" 2>&1
+        src=$?
+        log "session rc=$src; tail: $(tail -c 300 "$OUT" | tr '\n' ' ')"
+        if tpu_line_captured; then
+            log "REAL TPU bench line captured -> watcher done"
+            log "line: $(cat "$REPO/bench_results/r05_bench_line.json" | head -c 400)"
+            release
+            trap - EXIT INT TERM HUP
             exit 0
         fi
         release
         trap - EXIT INT TERM HUP
         unset MXTPU_CLAIM_HOLDER
-        log "bench did not land a TPU line; continue probing"
+        log "no real TPU line yet; continue probing"
     else
         log "probe rc=$rc (hang/unavailable)"
     fi

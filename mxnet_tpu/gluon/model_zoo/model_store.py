@@ -131,7 +131,28 @@ def get_model_file(name: str, root: str | None = None) -> str:
 
     zip_path = os.path.join(root, file_name + ".zip")
     url = _url_format.format(repo_url=_repo_url(), file_name=file_name)
-    download(url, path=zip_path, overwrite=True)
+    try:
+        download(url, path=zip_path, overwrite=True)
+    except (MXNetError, OSError) as e:
+        # zero-egress fallback (round-3 contract, kept alongside the
+        # download layer): an explicitly-placed hash-stamped local file
+        # under `root` is an offline override — used unverified, loudly
+        import glob as _glob
+        import warnings as _warnings
+        stamped = sorted(_glob.glob(os.path.join(root,
+                                                 f"{name}-*.params")))
+        # never hand back the official-hash cache entry here: if it
+        # exists on this path it just FAILED check_sha1 above (corrupt
+        # cache), which is not a user-placed override
+        stamped = [p for p in stamped if p != file_path]
+        if stamped:
+            _warnings.warn(
+                f"model-store fetch failed ({e}); using local weights "
+                f"{stamped[0]} WITHOUT sha1 verification")
+            return stamped[0]
+        raise MXNetError(
+            f"fetch of pretrained {name!r} failed and no local weights "
+            f"'{name}-*.params' exist under {root}: {e}") from e
     with zipfile.ZipFile(zip_path) as zf:
         zf.extractall(root)
     os.remove(zip_path)
