@@ -26,7 +26,7 @@ class LossScaler:
         for p in params:
             if getattr(p, "_data", None) is None:
                 continue  # deferred/uninitialized: no gradient to check
-            g = p.grad  # ndarray or None (grad_req='null')
+            g = p.grad()  # ndarray or None (grad_req='null')
             if g is None:
                 continue
             checks.append(jnp.isfinite(g._data).all())

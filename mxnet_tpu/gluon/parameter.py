@@ -47,13 +47,15 @@ class Parameter:
         self.wd_mult = wd_mult
         self.init = init
         self.allow_deferred_init = allow_deferred_init
-        self.grad_req = grad_req if differentiable else "null"
+        self._grad_req = grad_req if differentiable else "null"
+        self._stype = stype
         self.grad_stype = grad_stype
         self._differentiable = differentiable
         self.sharding = sharding  # logical PartitionSpec-like annotation
         self._data: Optional[ndarray] = None
         self._deferred_init = None  # (init, device)
         self._structure_key = None  # full path name once attached to a block
+        self._devices = []   # replication list (initialize(device=[...]))
 
     # -- identity -----------------------------------------------------------
     @property
@@ -82,8 +84,37 @@ class Parameter:
         self._shape = tuple(new_shape)
 
     @property
+    def stype(self):
+        return self._stype
+
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        # reference semantics (parameter.py grad_req setter): switching
+        # to 'null' drops the allocated grad buffer; switching back
+        # re-allocates it — Block.setattr('grad_req', ...) relies on this
+        if req not in ("write", "add", "null"):
+            raise MXNetError(f"invalid grad_req {req!r}")
+        self._grad_req = req
+        if self._data is not None:
+            if req == "null":
+                self._data._grad = None
+                self._data._grad_req = "null"
+            elif self._data.grad is None or self._data._grad_req != req:
+                self._data.attach_grad(req, stype=self.grad_stype)
+
+    @property
+    def _grad(self):
+        """The allocated gradient buffer or None (reference tests poke
+        this directly after setattr('grad_req', ...))."""
+        return None if self._data is None else self._data.grad
+
+    @property
     def grad_req_(self):
-        return self.grad_req
+        return self._grad_req
 
     # -- init ---------------------------------------------------------------
     def initialize(self, init=None, device=None, ctx=None,
@@ -92,9 +123,13 @@ class Parameter:
             return
         device = device or ctx or current_device()
         if isinstance(device, (list, tuple)):
-            # reference API took a device list for replication; GSPMD needs
-            # only one logical placement
-            device = device[0]
+            # reference API: a device list means replication.  The compute
+            # design is GSPMD (one logical placement, the mesh shards it),
+            # so the primary copy lives on device[0] and the list is kept
+            # for the list_data/list_ctx read API
+            self._devices = [d if isinstance(d, Device) else Device(d)
+                             for d in device]
+            device = self._devices[0]
         if not _shape_known(self._shape):
             if not self.allow_deferred_init:
                 raise MXNetError(
@@ -127,6 +162,22 @@ class Parameter:
 
     # -- access -------------------------------------------------------------
     def data(self, device=None) -> ndarray:
+        if self._stype != "default":
+            raise MXNetError(
+                f"cannot return a dense handle of {self.name!r} with "
+                f"stype {self._stype!r}; use row_sparse_data(row_id)")
+        return self._dense_data(device)
+
+    def _dense_data(self, device=None) -> ndarray:
+        if device is not None:
+            d = Device(device) if not isinstance(device, Device) else device
+            base = self.data()
+            if base.device != d:
+                moved = base.to_device(d)
+                moved._ag_node = base._ag_node
+                moved._ag_out_index = base._ag_out_index
+                return moved
+            return base
         if self._data is None:
             if self._deferred_init is not None:
                 raise DeferredInitializationError(
@@ -137,17 +188,68 @@ class Parameter:
         return self._data
 
     def list_data(self):
-        return [self.data()]
+        if self._stype != "default":
+            raise MXNetError(
+                f"cannot list dense handles of {self.name!r} with stype "
+                f"{self._stype!r}; use list_row_sparse_data(row_id)")
+        if self._devices:
+            return [self._dense_data(d) for d in self._devices]
+        return [self._dense_data()]
 
-    @property
-    def grad(self) -> Optional[ndarray]:
-        return self.data().grad
+    def row_sparse_data(self, row_id):
+        """Rows of a row_sparse parameter selected by `row_id`
+        (parity: parameter.py row_sparse_data — the sharded-embedding
+        read path)."""
+        if self._stype != "row_sparse":
+            raise MXNetError(
+                f"cannot return row_sparse rows of {self.name!r} with "
+                f"stype {self._stype!r}; use data() instead")
+        from ..ndarray.sparse import RowSparseNDArray
+        base = self._dense_data()
+        ids = row_id._data if isinstance(row_id, ndarray) else jnp.asarray(row_id)
+        # unique (not just sorted): duplicate row ids in a
+        # RowSparseNDArray SUM on densify, double-counting rows
+        ids = jnp.unique(ids.astype(jnp.int32))
+        dev = row_id.device if isinstance(row_id, ndarray) else base.device
+        rs = RowSparseNDArray(ids, base._data[ids], base.shape)
+        rs._device = dev
+        return rs
+
+    def list_row_sparse_data(self, row_id):
+        if self._devices:
+            out = []
+            for d in self._devices:
+                rs = self.row_sparse_data(row_id)
+                rs._device = d
+                out.append(rs)
+            return out
+        return [self.row_sparse_data(row_id)]
+
+    def grad(self, device=None, ctx=None) -> Optional[ndarray]:
+        # a METHOD, as in the reference (parameter.py Parameter.grad):
+        # optional device selects the replica to read
+        if self._data is None:
+            if self._deferred_init is not None:
+                raise DeferredInitializationError(
+                    f"parameter {self.name} deferred; run a forward pass "
+                    "or call infer_shape first")
+            raise MXNetError(f"parameter {self.name} not initialized; "
+                             "call .initialize()")
+        g = self._data.grad
+        d = device or ctx
+        if g is not None and d is not None:
+            d = Device(d) if not isinstance(d, Device) else d
+            if d != g.device:
+                g = g.to_device(d)
+        return g
 
     def list_grad(self):
-        return [self.grad]
+        return [self.grad() for _ in self._devices] if self._devices \
+            else [self.grad()]
 
     def list_ctx(self):
-        return [self.data().device]
+        return list(self._devices) if self._devices \
+            else [self.data().device]
 
     list_device = list_ctx
 
@@ -172,6 +274,10 @@ class Parameter:
             self._data.zero_grad()
 
     def reset_device(self, device):
+        if isinstance(device, (list, tuple)):
+            self._devices = [d if isinstance(d, Device) else Device(d)
+                             for d in device]
+            device = self._devices[0]
         if self._data is not None:
             d = self._data.to_device(device)
             d._grad_req = self._data._grad_req

@@ -143,7 +143,7 @@ class Trainer:
             return
         idx, grads = [], []
         for i, p in enumerate(self._params):
-            if getattr(p.grad, "stype", "default") == "row_sparse":
+            if getattr(p.grad(), "stype", "default") == "row_sparse":
                 raise MXNetError(
                     f"parameter {p.name}: row_sparse gradients are only "
                     "supported with local updates (kvstore=None); the "
@@ -152,7 +152,7 @@ class Trainer:
                     "sparse_grad=False).")
             if p.grad_req != "null":
                 idx.append(i)
-                grads.append(p.grad)
+                grads.append(p.grad())
         if not idx:
             return
         if self._update_on_kvstore:
@@ -168,17 +168,17 @@ class Trainer:
         if self._update_on_kvstore and self._kvstore is not None:
             # server-side update: push grads, pull fresh weights
             for i, p in enumerate(self._params):
-                if getattr(p.grad, "stype", "default") == "row_sparse":
+                if getattr(p.grad(), "stype", "default") == "row_sparse":
                     raise MXNetError(
                         f"parameter {p.name}: row_sparse gradients are not "
                         "supported with update_on_kvstore; use local "
                         "updates (kvstore=None).")
-                self._kvstore.push(i, p.grad)
+                self._kvstore.push(i, p.grad())
                 self._kvstore.pull(i, out=p.data())
             return
         self._ensure_states()
         any_sparse = any(
-            getattr(p.grad, "stype", "default") == "row_sparse"
+            getattr(p.grad(), "stype", "default") == "row_sparse"
             for p in self._params)
         if getattr(self._optimizer, "fused_safe", True) and \
                 not self._optimizer.multi_precision and \
@@ -188,7 +188,7 @@ class Trainer:
         else:
             for n, p in zip(self._param_names, self._params):
                 self._optimizer.update_multi_precision(
-                    n, p.data(), p.grad, self._states[n])
+                    n, p.data(), p.grad(), self._states[n])
 
     def _uniform_mults(self):
         o = self._optimizer
@@ -207,7 +207,7 @@ class Trainer:
             o._index_update_count[n] = t
 
         params_tree = {n: p.data()._data for n, p in zip(names, self._params)}
-        grads_tree = {n: p.grad._data for n, p in zip(names, self._params)}
+        grads_tree = {n: p.grad()._data for n, p in zip(names, self._params)}
 
         from ..optimizer.optimizer import _state_values, _state_writeback
         states_tree = {n: _state_values(self._states[n]) for n in names}

@@ -477,7 +477,7 @@ def check_symbolic_backward(sym, location, out_grads, expected, rtol=None,
     for n, v in zip(names, vals):
         if reqs[n] == "null" or n not in exp or exp[n] is None:
             continue
-        grads[n] = v.grad.asnumpy()
+        grads[n] = (v.grad() if callable(v.grad) else v.grad).asnumpy()
         assert_almost_equal(grads[n], _onp.asarray(exp[n]), rtol=rtol,
                             atol=atol, equal_nan=equal_nan)
     return grads

@@ -43,10 +43,10 @@ def test_init_fp16_attaches_scaler_to_trainer():
             assert float(scaled) == pytest.approx(
                 float(loss) * tr._amp_loss_scaler.loss_scale, rel=1e-3)
             scaled.backward()
-    g_scaled = net.weight.grad.asnumpy().copy()
+    g_scaled = net.weight.grad().asnumpy().copy()
     amp.unscale(tr)
     onp.testing.assert_allclose(
-        net.weight.grad.asnumpy(),
+        net.weight.grad().asnumpy(),
         g_scaled / tr._amp_loss_scaler.loss_scale, rtol=1e-5)
 
 
@@ -71,7 +71,7 @@ def test_scaler_overflow_detection():
         ((net(x)) ** 2).mean().backward()
     s = LossScaler()
     assert not s.has_overflow(net.collect_params().values())
-    net.weight.grad._data = jnp.asarray([[onp.inf, 0.0]])
+    net.weight.grad()._data = jnp.asarray([[onp.inf, 0.0]])
     assert s.has_overflow(net.collect_params().values())
 
 

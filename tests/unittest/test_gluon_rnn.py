@@ -195,7 +195,7 @@ class TestConvAndProjectedCells:
         with mx.autograd.record():
             o, _ = cell.unroll(6, seq)
             (o ** 2).sum().backward()
-        assert float(mx.np.abs(cell.h2r_weight.grad).sum()) > 0
+        assert float(mx.np.abs(cell.h2r_weight.grad()).sum()) > 0
 
     def test_variational_dropout_locked_masks(self):
         base = mx.gluon.rnn.RNNCell(8, input_size=8)

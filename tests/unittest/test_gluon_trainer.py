@@ -22,7 +22,7 @@ def test_trainer_step_sgd():
     with mx.autograd.record():
         loss = (net(x) ** 2).sum()
     loss.backward()
-    g = onp.asarray(net.weight.grad)
+    g = onp.asarray(net.weight.grad())
     trainer.step(batch_size=4)
     w1 = onp.asarray(net.weight.data())
     assert_almost_equal(w1, w0 - 0.1 * g / 4, rtol=1e-5, atol=1e-6)
@@ -136,7 +136,7 @@ def test_trainer_with_kvstore():
     with mx.autograd.record():
         loss = (net(x) ** 2).sum()
     loss.backward()
-    g = onp.asarray(net.weight.grad)
+    g = onp.asarray(net.weight.grad())
     t.step(4)
     assert_almost_equal(net.weight.data(), w0 - 0.1 * g / 4,
                         rtol=1e-5, atol=1e-6)

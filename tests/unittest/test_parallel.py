@@ -103,7 +103,7 @@ def test_sharded_train_step_dp_matches_single_device():
         l = ((net1(x1) - y1) ** 2).mean()
     l.backward()
     w_ref = onp.asarray(net1.weight.data()) - \
-        0.1 * onp.asarray(net1.weight.grad)
+        0.1 * onp.asarray(net1.weight.grad())
 
     # 8-way dp sharded step
     net2 = build()

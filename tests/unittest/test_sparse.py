@@ -64,7 +64,7 @@ def test_embedding_sparse_grad_matches_dense():
             out = emb(ids)
             loss = (out * out).sum()
         loss.backward()
-        return emb.weight.grad
+        return emb.weight.grad()
 
     g_dense = run(False)
     g_sparse = run(True)
@@ -131,7 +131,7 @@ def test_trainer_embedding_sparse_end_to_end():
             out = emb(ids)
             loss = ((out - target) ** 2).mean()
         loss.backward()
-        assert emb.weight.grad.stype == "row_sparse"
+        assert emb.weight.grad().stype == "row_sparse"
         trainer.step(1)
         losses.append(float(loss.asnumpy()))
     assert losses[-1] < 0.5 * losses[0], losses
@@ -144,9 +144,9 @@ def test_zero_grad_on_sparse_grad():
     with autograd.record():
         loss = (emb(ids) ** 2).sum()
     loss.backward()
-    assert emb.weight.grad.stype == "row_sparse"
+    assert emb.weight.grad().stype == "row_sparse"
     emb.weight.zero_grad()
-    g = emb.weight.grad
+    g = emb.weight.grad()
     assert g.stype == "row_sparse" and g.indices.shape[0] == 0
     assert onp.all(g.asnumpy() == 0)
 
