@@ -239,7 +239,7 @@ def quantize_net(net, calib_data=None, calib_mode="naive",
     def walk(block, prefix):
         if not _is_sequential(block):
             return
-        for name, child in block._children.items():
+        for name, child in block._child_items():
             full = f"{prefix}.{name}" if prefix else str(name)
             if isinstance(child, _nn.Dense) and full not in exclude:
                 dense_sites.append((block, name, full, child))
@@ -286,7 +286,7 @@ def _forward_with_map(block, x, observer=None, sites=None, qmap=None,
     if not _is_sequential(block):
         return block(x)
     out = x
-    for name, child in block._children.items():
+    for name, child in block._child_items():
         full = f"{prefix}.{name}" if prefix else str(name)
         if sites is not None and full in sites:
             if observer is not None:

@@ -21,6 +21,8 @@ from __future__ import annotations
 
 import contextlib
 import re
+import warnings
+import weakref
 from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -72,8 +74,17 @@ class Block:
 
     # -- registration --------------------------------------------------------
     def __setattr__(self, name, value):
+        # reference semantics (block.py:245): an attribute that held a
+        # Parameter/Block cannot change category
+        if hasattr(self, name):
+            existing = getattr(self, name)
+            if isinstance(existing, (Parameter, Block)) and \
+                    not isinstance(value, type(existing)):
+                raise TypeError(
+                    f"Changing attribute type for {name} from "
+                    f"{type(existing)} to {type(value)} is not allowed.")
         if isinstance(value, Block):
-            self._children[name] = value
+            self._children[name] = weakref.ref(value)
         elif isinstance(value, Parameter):
             if value._name == "weight" and name != "weight":
                 value._name = name  # adopt the attribute name
@@ -82,8 +93,19 @@ class Block:
 
     def register_child(self, block: "Block", name: Optional[str] = None):
         name = name or str(len(self._children))
-        self._children[name] = block
+        # _children holds WEAKREFS (reference design: block.py:262 uses
+        # c() to deref); the strong ref is the attribute below
+        self._children[name] = weakref.ref(block)
         self.__dict__[name] = block
+
+    def _child_items(self):
+        for k, r in self._children.items():
+            c = r() if isinstance(r, weakref.ReferenceType) else r
+            if c is not None:
+                yield k, c
+
+    def _child_blocks(self):
+        return [c for _, c in self._child_items()]
 
     def register_block(self, name, block):
         self.register_child(block, name)
@@ -95,6 +117,7 @@ class Block:
 
     def collect_params(self, select: Optional[str] = None) -> Dict[str, Parameter]:
         """Structure-named parameter dict (parity: Block.collect_params)."""
+        self._check_container_with_block()
         out: "OrderedDict[str, Parameter]" = OrderedDict()
         self._collect(out, "")
         if select is not None:
@@ -102,13 +125,39 @@ class Block:
             out = OrderedDict((k, v) for k, v in out.items() if pat.search(k))
         return out
 
+    def _check_container_with_block(self):
+        """Warn about Blocks hidden inside plain list/dict attributes —
+        they are invisible to collect_params (reference block.py:262)."""
+        children = set(self._child_blocks())
+
+        def _find(data):
+            if isinstance(data, (list, tuple)):
+                return any(_find(e) for e in data)
+            if isinstance(data, dict):
+                return any(_find(v) for v in data.values())
+            return isinstance(data, Block) and data not in children
+
+        for k, v in self.__dict__.items():
+            if isinstance(v, (list, tuple, dict)) and not (
+                    k.startswith("_") or k == "_children"):
+                if _find(v):
+                    warnings.warn(
+                        f"'{type(self).__name__}.{k}' is a container with "
+                        "Blocks. Note that Blocks inside the list, tuple "
+                        "or dict will not be registered automatically. "
+                        "Make sure to register them using register_child()"
+                        " or switching to nn.Sequential/nn.HybridSequential"
+                        " instead.", stacklevel=3)
+        for c in self._child_blocks():
+            c._check_container_with_block()
+
     def _collect(self, out, prefix, mutate=True):
         for name, p in self._reg_params.items():
             key = prefix + name
             if mutate:
                 p._structure_key = key
             out[key] = p
-        for cname, child in self._children.items():
+        for cname, child in self._child_items():
             child._collect(out, prefix + cname + ".", mutate)
 
     def initialize(self, init=None, device=None, ctx=None, verbose=False,
@@ -125,13 +174,11 @@ class Block:
     def cast(self, dtype):
         for p in self.collect_params().values():
             p.cast(dtype)
-        for child in self._children.values():
-            pass  # collect_params already recursed
         self._on_cast(jnp.dtype(dtype))
         return self
 
     def _on_cast(self, dtype):
-        for c in self._children.values():
+        for c in self._child_blocks():
             c._on_cast(dtype)
 
     def zero_grad(self):
@@ -145,7 +192,7 @@ class Block:
     reset_ctx = reset_device
 
     def apply(self, fn: Callable[["Block"], Any]):
-        for c in self._children.values():
+        for c in self._child_blocks():
             c.apply(fn)
         fn(self)
         return self
@@ -155,13 +202,22 @@ class Block:
             setattr(p, name, value)
 
     def share_parameters(self, shared: Dict[str, Parameter]):
-        own = self.collect_params()
-        for k, v in shared.items():
-            if k in own:
-                tgt = own[k]
-                tgt._data = v._data
-                tgt._shape = v._shape
+        """Rebind structure-matched parameters to the SHARED objects
+        (reference gluon-2 semantics: the blocks then hold the SAME
+        Parameter, so save_parameters(deduplicate=True) writes one copy
+        and updates apply once)."""
+        self._share(shared, "")
         return self
+
+    def _share(self, shared, prefix):
+        for name in list(self._reg_params):
+            key = prefix + name
+            if key in shared:
+                p = shared[key]
+                self._reg_params[name] = p
+                object.__setattr__(self, name, p)
+        for cname, child in self._child_items():
+            child._share(shared, prefix + cname + ".")
 
     # -- persistence ---------------------------------------------------------
     def save_parameters(self, filename: str, deduplicate: bool = False,
@@ -171,9 +227,16 @@ class Block:
         reference's binary NDArray-dict (`src/ndarray/ndarray.cc`
         NDArray::Save) so checkpoints interchange with stock MXNet."""
         arrays = {}
+        seen = {}
         for name, p in self.collect_params().items():
-            if p._data is not None:
-                arrays[name] = p.data()
+            if p._data is None:
+                continue
+            if deduplicate and id(p) in seen:
+                # shared Parameter objects serialize ONCE (reference
+                # block.py save_parameters deduplicate=True)
+                continue
+            seen[id(p)] = name
+            arrays[name] = p.data()
         if format == "params":
             from ..ndarray import save as _nd_save
             _nd_save(filename, arrays)
@@ -206,8 +269,11 @@ class Block:
         loaded = {(k[4:] if k.startswith(("arg:", "aux:")) else k): v
                   for k, v in loaded.items()}
         params = self.collect_params()
+        loaded_objs = {id(params[n]) for n in loaded if n in params}
         for name, p in params.items():
             if name not in loaded:
+                if id(p) in loaded_objs:
+                    continue   # shared object, loaded under its alias
                 if not allow_missing:
                     raise MXNetError(f"parameter {name} missing in {filename}")
                 continue
@@ -243,7 +309,7 @@ class Block:
         return self
 
     def _invalidate_cache(self):
-        for c in self._children.values():
+        for c in self._child_blocks():
             c._invalidate_cache()
 
     # -- hooks ---------------------------------------------------------------
@@ -291,10 +357,13 @@ class Block:
 
     # -- misc ----------------------------------------------------------------
     def hybridize(self, active=True, **kwargs):
-        for c in self._children.values():
+        for c in self._child_blocks():
             c.hybridize(active, **kwargs)
 
     def summary(self, *inputs):
+        assert not getattr(self, "_active", False), \
+            "'summary' is not supported for a hybridized block: call it " \
+            "before hybridize()"
         lines = [f"{type(self).__name__}:"]
         for name, p in self.collect_params().items():
             lines.append(f"  {name}: {p.shape} {jnp.dtype(p.dtype).name}")
@@ -302,7 +371,7 @@ class Block:
 
     def __repr__(self):
         s = f"{type(self).__name__}("
-        for name, child in self._children.items():
+        for name, child in self._child_items():
             s += f"\n  ({name}): {child!r}".replace("\n", "\n  ")
         return s + ("\n)" if self._children else ")")
 
@@ -389,7 +458,7 @@ class HybridBlock(Block):
             from ..subgraph import get_subgraph_backend
             self.__dict__["_subgraph_backend"] = get_subgraph_backend(backend)
         self._invalidate_cache()
-        for c in self._children.values():
+        for c in self._child_blocks():
             if isinstance(c, HybridBlock):
                 # children run inside the parent's trace: deactivate their
                 # own caches (parity: inlined subgraphs)
@@ -412,6 +481,7 @@ class HybridBlock(Block):
     def _invalidate_cache(self):
         self.__dict__["_jit_cache"] = {}
         self.__dict__["_warmed_up"] = False
+        self.__dict__["_warm_skey"] = None
         super()._invalidate_cache()
 
     # -- jit machinery -------------------------------------------------------
@@ -557,19 +627,108 @@ class HybridBlock(Block):
         out = jax.tree_util.tree_unflatten(out_def, wrapped)
         return out
 
+    def _validate_hybrid_inputs(self, args, active=True):
+        # reference contract (block.py _build_cache input checks, pinned
+        # by test_hybrid_block_hybrid_no_hybrid): a hybridized call takes
+        # ndarrays (or nested lists of them) on ONE device — scalars
+        # raise ValueError, Symbols TypeError, mixed devices ValueError
+        from ..symbol.symbol import Symbol as _Symbol
+        flat = []
+
+        def _walk(a):
+            if isinstance(a, (list, tuple)):
+                for e in a:
+                    _walk(e)
+            else:
+                flat.append(a)
+
+        _walk(list(args))
+        devices = set()
+        for a in flat:
+            if isinstance(a, _Symbol):
+                raise TypeError(
+                    "HybridBlocks take ndarray inputs, not Symbols")
+            if not active:
+                continue
+            if isinstance(a, (int, float, bool)):
+                raise ValueError(
+                    "hybridized blocks only support ndarray inputs; got a "
+                    f"python scalar {a!r} — wrap it in mx.np.array or keep "
+                    "the block un-hybridized")
+            if isinstance(a, ndarray):
+                devices.add(a.device)
+        if len(devices) > 1:
+            raise ValueError(
+                f"hybridized blocks require all inputs on one device; got "
+                f"{sorted(str(d) for d in devices)}")
+
+    def _canonical_args(self, args, kwargs):
+        """Bind against forward's signature with defaults applied, so
+        foo(x) and foo(x, None) pin the SAME cached-op signature (the
+        reference's cached op treats explicit default values as the
+        default format).  Skipped entirely (hot path) when the forward
+        has no defaults and no kwargs were passed — binding could not
+        change anything then."""
+        import inspect
+        sig = self.__dict__.get("_fwd_sig")
+        if sig is None:
+            try:
+                sig = inspect.signature(self.forward)
+                has_defaults = any(
+                    p.default is not inspect.Parameter.empty
+                    for p in sig.parameters.values())
+            except (TypeError, ValueError):
+                sig, has_defaults = False, False
+            self.__dict__["_fwd_sig"] = sig
+            self.__dict__["_fwd_has_defaults"] = has_defaults
+        if not sig or (not kwargs and not self.__dict__["_fwd_has_defaults"]):
+            return args, kwargs
+        try:
+            bound = sig.bind(*args, **kwargs)
+            bound.apply_defaults()
+            return tuple(bound.args), dict(bound.kwargs)
+        except TypeError:
+            return args, kwargs
+
     def __call__(self, *args, **kwargs):
+        # validate the USER's args (before default-binding: materialized
+        # scalar defaults like epsilon=1e-8 are not user scalars and
+        # must not trip the scalar check)
+        self._validate_hybrid_inputs(args, active=self._active)
+        args, kwargs = self._canonical_args(args, kwargs)
         for hook in self._forward_pre_hooks.values():
             hook(self, args)
+        if args:
+            leaves, _ = _flatten_args(args, {})
+            if not leaves:
+                # reference HybridBlock contract: at least one NDArray
+                # input (hybridized or not) — block.py _get_graph
+                raise ValueError(
+                    "HybridBlock requires at least one ndarray input; "
+                    f"got only non-array args {args!r}")
         if args:
             self.__dict__["_example_input"] = args
         if self._active and not is_tracer(
                 args[0]._data if args and isinstance(args[0], ndarray) else None):
             if not self._warmed_up:
                 # first call: eager pass finishes deferred init (parity:
-                # _build_cache's deferred shape inference)
+                # _build_cache's deferred shape inference).  The input
+                # STRUCTURE (incl. the None pattern) is pinned here: the
+                # reference's cached op has a fixed signature and raises
+                # on a different format afterwards
                 out = self._eager_forward(*args, **kwargs)
                 self.__dict__["_warmed_up"] = True
+                _, _struct0 = _flatten_args(args, kwargs)
+                self.__dict__["_warm_skey"] = _struct_key(_struct0)
             else:
+                _, _struct1 = _flatten_args(args, kwargs)
+                pinned = self.__dict__.get("_warm_skey")
+                if pinned is not None and _struct_key(_struct1) != pinned:
+                    raise ValueError(
+                        f"{type(self).__name__} was hybridized and warmed "
+                        "up with a different input format (argument "
+                        "structure / None pattern); re-hybridize() to "
+                        "accept the new signature")
                 out = self._call_cached_op(*args, **kwargs)
         else:
             out = self._eager_forward(*args, **kwargs)

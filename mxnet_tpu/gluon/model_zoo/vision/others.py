@@ -400,7 +400,7 @@ class _InceptionConcat(HybridBlock):
             self.register_child(b, f"branch{i}")
 
     def forward(self, x):
-        return _np.concatenate([b(x) for b in self._children.values()],
+        return _np.concatenate([b(x) for b in self._child_blocks()],
                                axis=1)
 
 

@@ -21,8 +21,12 @@ class LeakyReLU(HybridBlock):
 
 
 class PReLU(HybridBlock):
-    def __init__(self, alpha_initializer="zeros", in_channels=1, **kwargs):
+    def __init__(self, alpha_initializer=None, in_channels=1, **kwargs):
         super().__init__(**kwargs)
+        if alpha_initializer is None:
+            # reference default: Constant(0.25) (activations.py:136)
+            from ...initializer import Constant
+            alpha_initializer = Constant(0.25)
         self.alpha = Parameter("alpha", shape=(in_channels,),
                                init=alpha_initializer)
 

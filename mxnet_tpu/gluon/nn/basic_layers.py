@@ -33,13 +33,13 @@ class _SequentialMixin:
         return self
 
     def forward(self, x, *args):
-        for b in self._children.values():
+        for b in self._child_blocks():
             x = b(x, *args)
             args = ()
         return x
 
     def __getitem__(self, key):
-        items = list(self._children.values())
+        items = list(self._child_blocks())
         if isinstance(key, slice):
             net = type(self)()
             for b in items[key]:
@@ -51,11 +51,24 @@ class _SequentialMixin:
         return len(self._children)
 
     def __iter__(self):
-        return iter(self._children.values())
+        return iter(self._child_blocks())
 
 
 class Sequential(_SequentialMixin, Block):
     """Stack of blocks (parity: basic_layers.py Sequential)."""
+
+    def hybridize(self, active=True, **kwargs):
+        # reference basic_layers.py:85 — an all-HybridBlock Sequential
+        # should have been a HybridSequential; warn before delegating
+        import warnings
+        if self._children and all(isinstance(c, HybridBlock)
+                                  for c in self._child_blocks()):
+            warnings.warn(
+                f"All children of this Sequential layer '{self!r}' are "
+                "HybridBlocks. Consider using HybridSequential for the "
+                "best performance.", stacklevel=2)
+        super().hybridize(active, **kwargs)
+
 
     def __init__(self, *blocks):
         super().__init__()
@@ -263,6 +276,12 @@ class LayerNorm(_SimpleNorm):
         self.beta.shape = (c,)
 
     def forward(self, x):
+        c = self.gamma.shape[0] if self.gamma.shape else 0
+        # the reference asserts the normalized-axis size against
+        # in_channels (pinned by test_layernorm's error path)
+        assert not c or x.shape[self._axis % x.ndim] == c, (
+            f"LayerNorm: input axis {self._axis} has size "
+            f"{x.shape[self._axis % x.ndim]}, expected {c}")
         return npx.layer_norm(x, self.gamma.data(), self.beta.data(),
                               axis=self._axis, eps=self._epsilon)
 
@@ -362,7 +381,7 @@ class HybridConcatenate(HybridBlock):
         return self
 
     def forward(self, x):
-        outs = [b(x) for b in self._children.values()]
+        outs = [b(x) for b in self._child_blocks()]
         return _np.concatenate(outs, axis=self.axis)
 
 

@@ -168,13 +168,23 @@ class _Pool(HybridBlock):
         self._pool_type = pool_type
         self._convention = "full" if ceil_mode else "valid"
         self._count_include_pad = count_include_pad
+        # channels-last layouts (NWC/NHWC/NDHWC) transpose around the
+        # NC*-kernel (the reference's pooling supports both layouts)
+        self._channels_last = bool(layout) and layout[-1] == "C"
 
     def forward(self, x):
-        return npx.pooling(x, kernel=self._kernel, stride=self._stride,
-                           pad=self._pad, pool_type=self._pool_type,
-                           global_pool=self._global,
-                           pooling_convention=self._convention,
-                           count_include_pad=self._count_include_pad)
+        if self._channels_last:
+            from ... import numpy as _mnp
+            x = _mnp.moveaxis(x, -1, 1)
+        out = npx.pooling(x, kernel=self._kernel, stride=self._stride,
+                          pad=self._pad, pool_type=self._pool_type,
+                          global_pool=self._global,
+                          pooling_convention=self._convention,
+                          count_include_pad=self._count_include_pad)
+        if self._channels_last:
+            from ... import numpy as _mnp
+            out = _mnp.moveaxis(out, 1, -1)
+        return out
 
     def __repr__(self):
         return (f"{type(self).__name__}(size={self._kernel}, "

@@ -33,7 +33,7 @@ class RecurrentCell(HybridBlock):
     def reset(self):
         self._init_counter = -1
         self._counter = -1
-        for c in self._children.values():
+        for c in self._child_blocks():
             if isinstance(c, RecurrentCell):
                 c.reset()
 
@@ -219,20 +219,20 @@ class SequentialRNNCell(RecurrentCell):
 
     def state_info(self, batch_size=0):
         out = []
-        for c in self._children.values():
+        for c in self._child_blocks():
             out.extend(c.state_info(batch_size))
         return out
 
     def begin_state(self, batch_size=0, **kwargs):
         out = []
-        for c in self._children.values():
+        for c in self._child_blocks():
             out.extend(c.begin_state(batch_size, **kwargs))
         return out
 
     def forward(self, inputs, states):
         next_states = []
         p = 0
-        for c in self._children.values():
+        for c in self._child_blocks():
             n = len(c.state_info())
             inputs, st = c(inputs, states[p:p + n])
             next_states.extend(st)
@@ -243,7 +243,7 @@ class SequentialRNNCell(RecurrentCell):
         return len(self._children)
 
     def __getitem__(self, i):
-        return list(self._children.values())[i]
+        return self._child_blocks()[i]
 
 
 # parity alias (`python/mxnet/gluon/rnn/rnn_cell.py:755`): every cell here

@@ -15,14 +15,19 @@ __all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
 def split_data(data: ndarray, num_slice: int, batch_axis=0, even_split=True):
     size = data.shape[batch_axis]
     if even_split and size % num_slice != 0:
-        raise MXNetError(f"cannot evenly split axis of size {size} into "
+        # ValueError, as the reference raises (gluon/utils.py:66)
+        raise ValueError(f"cannot evenly split axis of size {size} into "
                          f"{num_slice}")
-    step = size // num_slice
+    # uneven split follows numpy.array_split (the reference's contract,
+    # pinned by test_split_data): the first size % num_slice slices get
+    # one extra row — NOT a short tail slice
+    step, extra = divmod(size, num_slice)
     slices = []
+    begin = 0
     for i in range(num_slice):
-        begin = i * step
-        end = (i + 1) * step if i < num_slice - 1 else size
+        end = begin + step + (1 if i < extra else 0)
         slices.append(data.slice_axis(batch_axis, begin, end))
+        begin = end
     return slices
 
 

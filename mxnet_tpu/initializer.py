@@ -28,6 +28,35 @@ class Initializer:
     def __init__(self, **kwargs):
         self._kwargs = kwargs
 
+    def __eq__(self, other):
+        # the reference serializes to json for identity (dumps()); two
+        # initializers of the same class + config are interchangeable.
+        # Values may be arrays (Constant(ndarray)) — compare value-wise.
+        if type(self) is not type(other):
+            return NotImplemented
+        if self._kwargs.keys() != other._kwargs.keys():
+            return False
+        import numpy as _onp
+        for k, v in self._kwargs.items():
+            w = other._kwargs[k]
+            try:
+                if not bool(v == w):
+                    return False
+            except (TypeError, ValueError):
+                a = v.asnumpy() if hasattr(v, "asnumpy") else _onp.asarray(v)
+                b = w.asnumpy() if hasattr(w, "asnumpy") else _onp.asarray(w)
+                if not _onp.array_equal(a, b):
+                    return False
+        return True
+
+    def __hash__(self):
+        # array-valued kwargs are unhashable; class + sorted keys is a
+        # stable (if coarse) hash consistent with __eq__
+        return hash((type(self), tuple(sorted(self._kwargs))))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
     def __call__(self, name, arr: Optional[ndarray] = None):
         if arr is None:
             name, arr = "", name
