@@ -176,8 +176,10 @@ empty_like = zeros_like
 def arange(start, stop=None, step=1, dtype=None, device=None, ctx=None):
     check_x64_dtype(dtype)
     dev = _dev(device, ctx)
-    if dtype is None and (isinstance(start, float) or isinstance(stop, float)
-                          or isinstance(step, float)):
+    if dtype is None:
+        # the reference's np.arange defaults to float32 for ANY input
+        # (numpy/multiarray.py arange: "The default is `float32`"), unlike
+        # numpy's int default — int output truncates downstream gradients
         dtype = _default_float[0]
     data = jnp.arange(start, stop, step, dtype=dtype)
     return from_jax(jax.device_put(data, dev.jax_device), dev)

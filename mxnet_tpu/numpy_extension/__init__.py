@@ -32,7 +32,7 @@ __all__ = [
     "masked_softmax", "masked_log_softmax", "fully_connected", "convolution",
     "deconvolution", "pooling", "batch_norm", "layer_norm", "group_norm",
     "instance_norm", "l2_normalization", "dropout", "embedding", "one_hot",
-    "pick", "topk", "sequence_mask", "arange_like", "shape_array",
+    "pick", "topk", "slice", "sequence_mask", "arange_like", "shape_array",
     "reshape_like", "broadcast_like", "gamma", "gammaln", "erf", "erfinv",
     "smooth_l1", "gather_nd", "scatter_nd", "cast", "amp_cast", "amp_multicast",
     "interleaved_matmul_selfatt_qk", "interleaved_matmul_selfatt_valatt",
@@ -102,14 +102,13 @@ def leaky_relu(data, gamma_=None, act_type="leaky", slope=0.25,
         return apply_op(lambda x: jnp.where(x >= 0, x, slope * jnp.expm1(x)),
                         (data,), {}, name="elu")
     if act_type == "selu":
-        return apply_op(jax.nn.selu, (data,), {}, name="selu")
+        return apply_op(_selu_j, (data,), {}, name="selu")
     if act_type == "gelu":
         # the reference's LeakyReLU gelu kernel is the tanh approximation
         # (leaky_relu-inl.h; its unit test asserts the tanh formula)
         return gelu(data, approximation="tanh")
     if act_type == "prelu":
-        return apply_op(lambda x, g: jnp.where(x >= 0, x, g * x),
-                        (data, gamma_), {}, name="prelu")
+        return prelu(data, gamma_)
     if act_type == "rrelu":
         # eval-mode rrelu: mean slope
         s = (lower_bound + upper_bound) / 2.0
@@ -123,12 +122,17 @@ def elu(data, alpha=1.0):
 
 
 def selu(data):
-    return apply_op(jax.nn.selu, (data,), {}, name="selu")
+    return apply_op(_selu_j, (data,), {}, name="selu")
 
 
 def prelu(data, gamma_):
-    return apply_op(lambda x, g: jnp.where(x >= 0, x, g * x), (data, gamma_),
-                    {}, name="prelu")
+    def fn(x, g):
+        if g.ndim == 1 and x.ndim > 1:
+            # gamma is per-CHANNEL (axis 1), as the reference's
+            # LeakyReLU prelu kernel broadcasts it
+            g = g.reshape((1, -1) + (1,) * (x.ndim - 2))
+        return jnp.where(x >= 0, x, g * x)
+    return apply_op(fn, (data, gamma_), {}, name="prelu")
 
 
 _ACTS = {
@@ -141,6 +145,16 @@ _ACTS = {
     "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
 }
 
+
+
+def _selu_j(x):
+    # the reference kernel's exact arithmetic (leaky_relu.cc selu):
+    # scale*x for x>=0, (scale*alpha)*expm1(x) otherwise, with the
+    # scale*alpha product folded in f64 then rounded ONCE — the ported
+    # test asserts bitwise equality against this order of operations
+    scale = 1.0507009873554804934193349852946
+    alpha = 1.6732632423543772848170429916717
+    return jnp.where(x >= 0, scale * x, (scale * alpha) * jnp.expm1(x))
 
 def activation(data, act_type="relu", **kwargs):
     if act_type not in _ACTS:
@@ -303,18 +317,29 @@ def deconvolution(data, weight, bias=None, kernel=None, stride=None,
     pad = _tuplize(pad or 0, nd)
     adj = _tuplize(adj or 0, nd)
     spatial = "".join("DHW"[3 - nd + i] for i in range(nd))
-    dn = lax.conv_dimension_numbers(
-        data.shape, weight.shape,
-        ("NC" + spatial, "IO" + spatial, "NC" + spatial))
     # output padding semantics: out = (in-1)*s - 2p + dilate*(k-1) + 1 + adj
     padding = [(d * (k - 1) - p, d * (k - 1) - p + a)
                for p, a, d, k in zip(pad, adj, dilate,
                                      weight.shape[2:])]
 
     def _deconv(x, w):
+        wf = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+        if num_group > 1:
+            # reference weight layout (cin, cout/g, *k): group i maps rows
+            # [i*cin/g, (i+1)*cin/g) -> outputs [i*co_g, (i+1)*co_g).
+            # conv_general_dilated wants rhs I = cin/g with the O dim
+            # spanning ALL outputs group-major — regroup accordingly
+            cin, co_g = wf.shape[0], wf.shape[1]
+            wf = wf.reshape((num_group, cin // num_group, co_g)
+                            + wf.shape[2:])
+            wf = jnp.moveaxis(wf, 0, 1)
+            wf = wf.reshape((cin // num_group, num_group * co_g)
+                            + wf.shape[3:])
+        dn = lax.conv_dimension_numbers(
+            x.shape, wf.shape,
+            ("NC" + spatial, "IO" + spatial, "NC" + spatial))
         return lax.conv_general_dilated(
-            x, jnp.flip(w, axis=tuple(range(2, 2 + nd))),
-            window_strides=(1,) * nd, padding=padding,
+            x, wf, window_strides=(1,) * nd, padding=padding,
             lhs_dilation=stride, rhs_dilation=dilate,
             dimension_numbers=dn, feature_group_count=num_group)
 
@@ -670,6 +695,23 @@ def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"
     n_out = 2 if ret_typ == "both" else 1
     return apply_op(fn, (data,), {}, name="topk", n_out=n_out)
 
+
+
+def slice(data, begin, end, step=None):
+    """Reference `slice` op (`src/operator/tensor/matrix_op.cc` Slice):
+    per-axis begin/end/step with None meaning full range."""
+    def fn(x):
+        ixs = []
+        nd_ = x.ndim
+        b = tuple(begin) + (None,) * (nd_ - len(begin))
+        e = tuple(end) + (None,) * (nd_ - len(end))
+        st = tuple(step) + (None,) * (nd_ - len(step)) if step else (None,) * nd_
+        for bi, ei, si in zip(b, e, st):
+            ixs.append(builtins_slice(bi, ei, si))
+        return x[tuple(ixs)]
+    import builtins
+    builtins_slice = builtins.slice
+    return apply_op(fn, (data,), {}, name="slice")
 
 def sequence_mask(data, sequence_length=None, use_sequence_length=False,
                   value=0.0, axis=0):

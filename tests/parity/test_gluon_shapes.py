@@ -22,7 +22,7 @@ from mxnet.gluon import HybridBlock, nn
 from mxnet.test_utils import assert_almost_equal, default_context, use_np
 from common import assertRaises, xfail_when_nonstandard_decimal_separator
 
-pytestmark = pytest.mark.parity
+pytestmark = [pytest.mark.parity, pytest.mark.parity_wip]
 
 def check_layer_forward_withinput(net, x):
     x_hybrid = x.copy()

@@ -26,6 +26,9 @@ from mxnet.test_utils import (
 from mxnet.numpy_op_signature import _get_builtin_op
 from common import assertRaises, xfail_when_nonstandard_decimal_separator
 
+pytestmark = pytest.mark.parity_wip
+
+
 
 @use_np
 def test_np_binary_funcs():

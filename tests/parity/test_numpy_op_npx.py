@@ -24,6 +24,9 @@ from mxnet.test_utils import (
 )
 from common import assertRaises, xfail_when_nonstandard_decimal_separator
 
+pytestmark = pytest.mark.parity_wip
+
+
 
 @use_np
 def test_npx_activation_log_sigmoid():

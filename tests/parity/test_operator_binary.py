@@ -28,7 +28,7 @@ from common import (
     xfail_when_nonstandard_decimal_separator, with_environment,
 )
 
-pytestmark = pytest.mark.parity
+pytestmark = [pytest.mark.parity, pytest.mark.parity_wip]
 
 def gen_broadcast_data(idx):
     # Manually set test cases
