@@ -155,7 +155,7 @@ def unscale(trainer):
     for p in trainer._params:
         if p.grad_req == "null" or getattr(p, "_data", None) is None:
             continue
-        g = p.grad
+        g = p.grad()
         if g is not None:
             g._data = g._data * scale
 
