@@ -663,3 +663,33 @@ def genfromtxt(*args, **kwargs):
 def set_printoptions(*args, **kwargs):
     """Applies to the host repr (asnumpy()-backed printing)."""
     _onp.set_printoptions(*args, **kwargs)
+
+
+_broadcast_to_gen = broadcast_to  # generated jnp alias
+
+
+def broadcast_to(array, shape):
+    """`np.broadcast_to` with the reference's npx dialect: a -2 entry
+    copies the corresponding input dim (aligned from the RIGHT, like
+    broadcasting itself)."""
+    import builtins
+    if isinstance(shape, int):
+        shape = (shape,)
+    if builtins.any(d == -2 for d in shape):
+        in_shape = array.shape
+        off = len(shape) - len(in_shape)
+        resolved = []
+        for i, d in enumerate(shape):
+            if d == -2:
+                if i - off < 0:
+                    # reference NumpyBroadcastToShape: a -2 beyond the
+                    # input's rank cannot be resolved
+                    raise MXNetError(
+                        "broadcast_to: the objective shape for "
+                        "broadcasting array must be known; -2 at dim "
+                        f"{i} has no corresponding input dim")
+                resolved.append(in_shape[i - off])
+            else:
+                resolved.append(d)
+        shape = tuple(resolved)
+    return _broadcast_to_gen(array, shape)

@@ -32,7 +32,7 @@ __all__ = [
     "masked_softmax", "masked_log_softmax", "fully_connected", "convolution",
     "deconvolution", "pooling", "batch_norm", "layer_norm", "group_norm",
     "instance_norm", "l2_normalization", "dropout", "embedding", "one_hot",
-    "pick", "topk", "slice", "sequence_mask", "arange_like", "shape_array",
+    "pick", "topk", "slice", "reshape", "index_add", "index_update", "constraint_check", "sequence_mask", "arange_like", "shape_array",
     "reshape_like", "broadcast_like", "gamma", "gammaln", "erf", "erfinv",
     "smooth_l1", "gather_nd", "scatter_nd", "cast", "amp_cast", "amp_multicast",
     "interleaved_matmul_selfatt_qk", "interleaved_matmul_selfatt_valatt",
@@ -421,7 +421,7 @@ def pooling(data, kernel=None, stride=None, pad=None, pool_type="max",
 def batch_norm(x, gamma_, beta, running_mean, running_var, eps=1e-5,
                momentum=0.9, fix_gamma=False, use_global_stats=False,
                output_mean_var=False, axis=1, min_calib_range=None,
-               max_calib_range=None):
+               max_calib_range=None, cudnn_off=False):
     """BatchNorm (parity: `src/operator/nn/batch_norm.cc:582`).
 
     Training-mode selection follows autograd state like the reference
@@ -712,6 +712,105 @@ def slice(data, begin, end, step=None):
     import builtins
     builtins_slice = builtins.slice
     return apply_op(fn, (data,), {}, name="slice")
+
+
+def reshape(a, newshape, reverse=False, order="C"):
+    """`npx.reshape` with the reference's special codes
+    (`src/operator/numpy/np_matrix_op-inl.h` NumpyXReshapeInferShape):
+    -1 infer, -2 copy one input dim, -3 drop a size-1 dim, -4 splice all
+    remaining input dims, -5 merge two consecutive dims, -6 split one dim
+    into the next two spec values; reverse=True matches from the right."""
+    in_shape = tuple(a.shape)
+    spec = [newshape] if isinstance(newshape, int) else list(newshape)
+    if reverse:
+        in_shape = in_shape[::-1]
+        spec = spec[::-1]
+
+    out = []
+    i = 0
+    j = 0
+    while j < len(spec):
+        sv = spec[j]
+        if sv == -4:
+            out.extend(in_shape[i:])
+            i = len(in_shape)
+        elif sv == -2:
+            out.append(in_shape[i]); i += 1
+        elif sv == -3:
+            if in_shape[i] != 1:
+                raise MXNetError(
+                    f"npx.reshape -3: input dim {i} is {in_shape[i]}, not 1")
+            i += 1
+        elif sv == -5:
+            out.append(in_shape[i] * in_shape[i + 1]); i += 2
+        elif sv == -6:
+            d = in_shape[i]; i += 1
+            av, bv = spec[j + 1], spec[j + 2]
+            if av == -1:
+                av = d // bv
+            if bv == -1:
+                bv = d // av
+            if av * bv != d:
+                raise MXNetError(f"npx.reshape -6: {av}*{bv} != {d}")
+            out.extend([av, bv]); j += 2
+        elif sv == -1:
+            out.append(-1)
+            i += 1
+        else:
+            out.append(sv)
+            i += 1   # spec positions align 1:1 with input dims (the
+            # reference's NumpyXReshapeInferShape walks both in step)
+        j += 1
+    if reverse:
+        out = out[::-1]
+    if -1 in out:
+        if out.count(-1) > 1:
+            raise MXNetError(
+                "npx.reshape: one and only one dim can be inferred")
+        import math as _math
+        known = _math.prod(d for d in out if d != -1)
+        total = _math.prod(in_shape)
+        if known == 0 or total % known:
+            raise MXNetError(
+                f"npx.reshape: cannot infer -1 — {total} elements do "
+                f"not divide by the known dims product {known}")
+        out[out.index(-1)] = total // known
+    return apply_op(lambda x: jnp.reshape(x, tuple(out)), (a,), {},
+                    name="npx.reshape")
+
+
+def _index_scatter(name, method):
+    def op(a, ind, val):
+        def fn(av, iv, vv):
+            iv = jnp.atleast_1d(iv.astype(jnp.int32))
+            rows = (iv,) if iv.ndim == 1 else tuple(iv)
+            k = len(rows)
+            n = rows[0].shape[0]
+            tail = av.shape[k:]
+            vb = jnp.broadcast_to(vv, (n,) + tail)
+            return getattr(av.at[rows], method)(vb)
+        return apply_op(fn, (a, ind, val), {}, name=name)
+    op.__name__ = name
+    return op
+
+
+# `npx.index_add(a, ind, val)`: scatter-add `val` at the
+# (ind_ndim, ind_num) integer index matrix (parity:
+# `src/operator/contrib/index_add.cc`); index_update overwrites.
+index_add = _index_scatter("index_add", "add")
+index_update = _index_scatter("index_update", "set")
+
+
+def constraint_check(condition, msg="Constraint violated"):
+    """`npx.constraint_check`: eager validation of a boolean tensor —
+    raises ValueError when any element is False, else evaluates to True
+    (parity: `src/operator/numpy/np_constraint_check.cc`)."""
+    from ..ndarray.ndarray import is_tracer as _is_tracer
+    cv = condition._data if isinstance(condition, ndarray) else condition
+    if not _is_tracer(cv) and not bool(jnp.all(cv)):
+        raise ValueError(msg)
+    return apply_op(lambda c: jnp.all(c), (condition,), {},
+                    name="constraint_check")
 
 def sequence_mask(data, sequence_length=None, use_sequence_length=False,
                   value=0.0, axis=0):

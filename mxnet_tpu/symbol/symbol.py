@@ -181,6 +181,22 @@ def _fc(data, weight, bias=None, num_hidden=None, no_bias=False, **kw):
 # Symbol
 # ---------------------------------------------------------------------------
 
+
+def _is_static_config(a):
+    """Recursively scalar-only list/tuple (a static op config value)."""
+    if isinstance(a, (bool, int, float, str)):
+        return True
+    if isinstance(a, (list, tuple)):
+        return all(_is_static_config(x) for x in a)
+    return False
+
+
+def _freeze_config(a):
+    if isinstance(a, (list, tuple)):
+        return tuple(_freeze_config(x) for x in a)
+    return a
+
+
 class Symbol:
     """One node of the op DAG (≈ `nnvm::Node` + output selection)."""
 
@@ -624,6 +640,14 @@ def _make_op(name):
                 # a literal node keeps one eval path)
                 sym_inputs.append(Symbol._node("_scalar_literal", (),
                                                {"value": a}))
+            elif a is None or _is_static_config(a):
+                # static config positional arg (axes=, shape=, nested
+                # tuples, ...): folds into attrs exactly like the
+                # reference's per-op attr parsing of list-valued
+                # positional params
+                sym_inputs.append(Symbol._node(
+                    "_scalar_literal", (),
+                    {"value": _freeze_config(a)}))
             else:
                 raise MXNetError(
                     f"mx.sym.{op_name} positional args must be Symbols; "

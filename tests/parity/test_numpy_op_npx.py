@@ -13,19 +13,54 @@ import random
 
 import numpy as onp
 import pytest
+import scipy.special as scipy_special
 
 import mxnet as mx
 from mxnet import np, npx
 from mxnet.base import MXNetError
 from mxnet.gluon import HybridBlock
 from mxnet.test_utils import (
-    assert_almost_equal, check_numeric_gradient, effective_dtype,
-    rand_ndarray, rand_shape_nd, retry, same, use_np,
+    assert_almost_equal, check_numeric_gradient, collapse_sum_like,
+    effective_dtype, rand_ndarray, rand_shape_nd, retry, same, use_np,
 )
 from common import assertRaises, xfail_when_nonstandard_decimal_separator
 
 pytestmark = pytest.mark.parity_wip
 
+
+
+# --- module-level helpers (same provenance) ---
+
+def np_softmax(x, axis=-1):
+    if (x.shape[axis] == 0):
+        return onp.sum(x, axis=axis, keepdims=True)
+    x = x - onp.max(x, axis=axis, keepdims=True)
+    x = onp.exp(x)
+    x /= onp.sum(x, axis=axis, keepdims=True)
+    return x
+
+
+def np_masked_softmax(data, mask, axis=-1, temperature=1.0):
+    neg = -1e18
+    if data.dtype == onp.float16:
+        neg = -1e4
+    temp = onp.where(mask, data, neg)
+    result = (np_softmax(temp, axis=axis) / temperature) * mask
+    return result
+
+
+def np_masked_log_softmax(data, mask, axis=-1, temperature=1.0):
+    neg = -1e18
+    if data.dtype == onp.float16:
+        neg = -1e4
+    data = onp.where(mask, data, neg)
+    return onp.where(mask, np_log_softmax(data, axis=axis) / temperature, -onp.inf)
+
+
+
+
+def np_log_softmax(x, axis=-1):
+    return onp.log(np_softmax(x, axis))
 
 
 @use_np
