@@ -693,3 +693,50 @@ def broadcast_to(array, shape):
                 resolved.append(d)
         shape = tuple(resolved)
     return _broadcast_to_gen(array, shape)
+
+
+_sum_gen = sum   # generated jnp alias
+_mean_gen = mean
+
+
+def _acc_f16(jfn_name, x, axis, dtype, out, keepdims, where=None,
+             initial=None):
+    """f16 reductions ACCUMULATE at f32 then cast (mshadow's acc-type
+    rule, pinned by test_np_sum's acc_type expectations — run it with
+    MXTPU_RUN_PARITY_WIP=1); other dtypes pass through the generated
+    wrapper untouched (where=/initial= included)."""
+    want = dtype
+    if dtype is None and getattr(x, "dtype", None) is not None \
+            and jnp.dtype(x.dtype) == jnp.float16:
+        want = jnp.float16
+    if want is not None and jnp.dtype(want) == jnp.float16:
+        arrs = [x]
+        has_where = where is not None
+        if has_where:
+            arrs.append(where)
+
+        def fn(v, *maybe_w):
+            kw = {"axis": axis, "keepdims": keepdims}
+            if has_where:
+                kw["where"] = maybe_w[0]
+            r = getattr(jnp, jfn_name)(v.astype(jnp.float32), **kw)
+            if initial is not None and jfn_name == "sum":
+                r = r + jnp.asarray(initial, jnp.float32)
+            return r.astype(jnp.float16)
+        return _write_out(apply_op(fn, tuple(arrs), {}, name=jfn_name), out)
+    gen = _sum_gen if jfn_name == "sum" else _mean_gen
+    kw = {"axis": axis, "dtype": dtype, "out": out, "keepdims": keepdims}
+    if where is not None:
+        kw["where"] = where
+    if initial is not None and jfn_name == "sum":
+        kw["initial"] = initial
+    return gen(x, **kw)
+
+
+def sum(a, axis=None, dtype=None, out=None, keepdims=False, where=None,  # noqa: A001
+        initial=None):
+    return _acc_f16("sum", a, axis, dtype, out, keepdims, where, initial)
+
+
+def mean(a, axis=None, dtype=None, out=None, keepdims=False, where=None):
+    return _acc_f16("mean", a, axis, dtype, out, keepdims, where)

@@ -85,3 +85,10 @@ def with_seed(seed=None):
             return fn(*a, **kw)
         return wrapped
     return deco
+
+
+# env-gated quarantine for ported tranches not yet green-swept
+wip_gate = __import__("pytest").mark.skipif(
+    not os.environ.get("MXTPU_RUN_PARITY_WIP"),
+    reason=("parity_wip tranche not yet green-swept; "
+            "set MXTPU_RUN_PARITY_WIP=1 to triage"))

@@ -20,9 +20,9 @@ from mxnet import np, npx
 from mxnet.base import MXNetError
 from mxnet.gluon import HybridBlock, nn
 from mxnet.test_utils import assert_almost_equal, default_context, use_np
-from common import assertRaises, xfail_when_nonstandard_decimal_separator
+from common import assertRaises, xfail_when_nonstandard_decimal_separator, wip_gate
 
-pytestmark = [pytest.mark.parity, pytest.mark.parity_wip]
+pytestmark = [pytest.mark.parity, pytest.mark.parity_wip, wip_gate]
 
 def check_layer_forward_withinput(net, x):
     x_hybrid = x.copy()

@@ -24,9 +24,9 @@ from mxnet.test_utils import (
     rand_shape_nd, retry, same, use_np,
 )
 from mxnet.numpy_op_signature import _get_builtin_op
-from common import assertRaises, xfail_when_nonstandard_decimal_separator
+from common import assertRaises, xfail_when_nonstandard_decimal_separator, wip_gate
 
-pytestmark = pytest.mark.parity_wip
+pytestmark = [pytest.mark.parity, pytest.mark.parity_wip, wip_gate]
 
 
 

@@ -23,9 +23,9 @@ from mxnet.test_utils import (
     assert_almost_equal, check_numeric_gradient, collapse_sum_like,
     effective_dtype, rand_ndarray, rand_shape_nd, retry, same, use_np,
 )
-from common import assertRaises, xfail_when_nonstandard_decimal_separator
+from common import assertRaises, xfail_when_nonstandard_decimal_separator, wip_gate
 
-pytestmark = pytest.mark.parity_wip
+pytestmark = [pytest.mark.parity, pytest.mark.parity_wip, wip_gate]
 
 
 

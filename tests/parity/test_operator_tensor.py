@@ -22,13 +22,14 @@ import mxnet as mx
 from mxnet.base import MXNetError
 from mxnet.test_utils import *
 from mxnet.test_utils import default_context, environment
-from common import (
+from common import (  # noqa
+    wip_gate,
     assertRaises, assert_raises_cuda_not_satisfied,
     assert_raises_cudnn_not_satisfied,
     xfail_when_nonstandard_decimal_separator, with_environment,
 )
 
-pytestmark = [pytest.mark.parity, pytest.mark.parity_wip]
+pytestmark = [pytest.mark.parity, pytest.mark.parity_wip, wip_gate]
 
 @pytest.mark.serial
 def test_slice():
