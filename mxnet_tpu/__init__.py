@@ -93,6 +93,7 @@ from . import onnx  # noqa: F401
 from . import library  # noqa: F401
 from . import subgraph  # noqa: F401
 from . import elastic  # noqa: F401
+from . import resilience  # noqa: F401
 from . import context  # noqa: F401  (legacy 1.x spelling of device)
 from . import error  # noqa: F401
 from . import log  # noqa: F401

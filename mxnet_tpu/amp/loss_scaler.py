@@ -1,16 +1,30 @@
 """Dynamic loss scaler (parity: `python/mxnet/amp/loss_scaler.py`)."""
 from __future__ import annotations
 
-import numpy as _onp
-
 
 class LossScaler:
+    """Dynamic loss scaling with skip-ratio tolerance.
+
+    `tolerance` implements the reference's skip-ratio semantics: on an
+    overflow, the scale is only shrunk when the fraction of overflowed
+    steps since the last rescale is at least `tolerance` — an isolated
+    overflow in an otherwise healthy window just skips that step and
+    keeps the scale (shrinking on every blip would pin the scale at the
+    floor and lose gradient precision for the whole window). The scale
+    grows by `scale_factor` after `scale_window` consecutive
+    overflow-free steps.
+    """
+
     def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
                  scale_window=2000, tolerance=0.05):
         self.loss_scale = init_scale
         self._scale_factor = scale_factor
         self._scale_window = scale_window
-        self._unskipped = 0
+        self._tolerance = tolerance
+        self._iter = 0
+        self._last_overflow_iter = -1
+        self._last_rescale_iter = -1
+        self._overflows_since_rescale = 0
         # amp.disable()/re-init flips this so Trainers holding a stale
         # reference stop scaling instead of dividing unscaled grads
         self.active = True
@@ -36,10 +50,17 @@ class LossScaler:
 
     def update_scale(self, overflow: bool):
         if overflow:
-            self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)
-            self._unskipped = 0
-        else:
-            self._unskipped += 1
-            if self._unskipped >= self._scale_window:
-                self.loss_scale *= self._scale_factor
-                self._unskipped = 0
+            self._last_overflow_iter = self._iter
+            self._overflows_since_rescale += 1
+            since_rescale = self._iter - self._last_rescale_iter
+            ratio = self._overflows_since_rescale / max(since_rescale, 1)
+            if ratio >= self._tolerance:
+                self.loss_scale = max(self.loss_scale / self._scale_factor,
+                                      1.0)
+                self._last_rescale_iter = self._iter
+                self._overflows_since_rescale = 0
+        elif (self._iter - self._last_overflow_iter) % self._scale_window \
+                == 0:
+            self.loss_scale *= self._scale_factor
+            self._last_rescale_iter = self._iter
+        self._iter += 1

@@ -37,7 +37,10 @@ import threading
 import time
 from typing import Callable, Optional, Sequence
 
+import jax
+
 from .base import MXNetError
+from .resilience import fault_point, retry_with_backoff
 from .utils.checkpoint import CheckpointManager
 
 __all__ = ["PreemptionGuard", "Watchdog", "FailureInjector", "ElasticLoop",
@@ -155,7 +158,13 @@ class Watchdog:
 class FailureInjector:
     """Deterministic fault injection (SURVEY §5.3 names fault *injection*
     as part of the recovery test strategy). Raises `exc_type` the first
-    time each step in `at_steps` is reached."""
+    time each step in `at_steps` is reached.
+
+    Kept for programmatic use; the env-driven registry in
+    `mxnet_tpu.resilience` (``MXTPU_FAULT_SPEC=elastic_step@N,...``)
+    generalizes this to named points across the whole framework
+    (checkpoint write/read, DataLoader worker execution) and crosses the
+    spawn boundary into worker processes."""
 
     def __init__(self, at_steps: Sequence[int],
                  exc_type=RuntimeError):
@@ -170,17 +179,53 @@ class FailureInjector:
             raise self._exc_type(f"injected failure at step {step}")
 
 
+# sync_flag's allgather retry budget: a collective that fails 3 times over
+# ~1s of backoff is a down host, not a blip
+_SYNC_RETRIES = 2
+_SYNC_BASE_DELAY = 0.25
+
+
 def sync_flag(flag: bool) -> bool:
     """Agree on a boolean across all processes (logical OR), so e.g. a
     preemption notice on one host checkpoints every host at the same step.
-    Single-process: identity."""
-    import jax
+    Single-process: identity.
+
+    Failure mode (multi-host): a transient collective error (tunnel reset,
+    coordination-service hiccup) is retried with backoff
+    (`resilience.retry_with_backoff`); once the budget is exhausted the
+    hosts can no longer agree on a common step, so this raises
+    `MXNetError` — the right response is to let the job die and resume
+    every host from the newest checkpoint rather than checkpoint a
+    diverged state.
+
+    Caveat: the retry only helps for errors raised while *entering* the
+    collective (before any peer commits to it — the common shape of
+    coordination-service hiccups, which fail symmetrically). If one host
+    errors after the others completed, its retried allgather pairs with
+    the peers' NEXT collective (collectives match by program order) and
+    the program is already lost to a hang or garbage — exactly the case
+    the `MXNetError` path exists for: kill the job, restore all hosts
+    from the newest checkpoint."""
     if jax.process_count() == 1:
         return bool(flag)
     import jax.numpy as jnp
     from jax.experimental import multihost_utils
-    v = multihost_utils.process_allgather(jnp.asarray([1 if flag else 0]))
-    return bool(v.max())
+
+    def _gather():
+        v = multihost_utils.process_allgather(
+            jnp.asarray([1 if flag else 0]))
+        return bool(v.max())
+
+    try:
+        return retry_with_backoff(_gather, retries=_SYNC_RETRIES,
+                                  base_delay=_SYNC_BASE_DELAY,
+                                  retry_on=(RuntimeError, OSError))
+    except (RuntimeError, OSError) as e:
+        raise MXNetError(
+            f"elastic.sync_flag: multi-host allgather failed after "
+            f"{_SYNC_RETRIES} retries ({e}); hosts cannot agree on a "
+            f"common step — restart the job and resume from the newest "
+            f"checkpoint") from e
 
 
 class ElasticLoop:
@@ -189,7 +234,10 @@ class ElasticLoop:
     Composes `CheckpointManager` (periodic atomic saves + resume),
     `PreemptionGuard` (SIGTERM → save-and-exit), `Watchdog` (hang report)
     and restore-retry on transient step failures around a user step
-    function ``step_fn(i) -> loss``.
+    function ``step_fn(i) -> loss``. Restores go through the manager's
+    verified fallback chain: a corrupt latest checkpoint is quarantined
+    and the rollback lands on the newest intact one, so bit-rot costs one
+    (deeper) rollback instead of failing every restore-retry.
 
     The `target` must expose ``save(path)``/``load(path)``. Returns a dict
     with the exit status — ``"completed"``, ``"preempted"`` (checkpoint
@@ -268,6 +316,11 @@ class ElasticLoop:
                         return {"status": "preempted", "step": i,
                                 "checkpoint": path, "restores": restores}
                     try:
+                        # env-driven injection (MXTPU_FAULT_SPEC
+                        # elastic_step@N — Nth step ATTEMPT, replays
+                        # included, so a recovered run replays clean);
+                        # generalizes the programmatic FailureInjector
+                        fault_point("elastic_step")
                         if self.failure_injector is not None:
                             self.failure_injector.check(i)
                         last_loss = step_fn(i)

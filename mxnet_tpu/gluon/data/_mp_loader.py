@@ -20,18 +20,81 @@ worker processes and the trainer through POSIX shared memory
 
 The parent preserves batch order (a reorder buffer keyed on batch id) and
 bounds each wait with the loader timeout, like the thread-pool path.
+
+Supervision: each worker has its own task queue so the parent knows
+exactly which batch ids are in flight where. `ProcessPool.get` polls the
+result queue in short slices and checks worker liveness on each empty
+slice, so a worker killed by the OOM killer (or a segfaulting native
+transform) is detected immediately — not after the full timeout with a
+misleading "transform is stuck" error. Dead workers are respawned and
+their in-flight batches resubmitted, a bounded number of times
+(`max_respawns`), before a precise error naming the dead worker and its
+exit code is raised. Workers name their segments ``mxtpu-<pid>-<seq>`` so
+the parent can reclaim a killed worker's half-shipped segments from
+``/dev/shm`` instead of leaking them.
 """
 from __future__ import annotations
 
+import itertools
+import logging
 import os
 import pickle
 import queue as _queue_mod
-import struct
-from typing import Any, Callable
+import time
+from typing import Any, Callable, List
 
 import numpy as _onp
 
 __all__ = ["ProcessPool"]
+
+_log = logging.getLogger(__name__)
+
+# liveness poll granularity inside get(): bounds dead-worker detection
+# latency without busy-waiting
+_POLL = 0.1
+
+_SHM_PREFIX = "mxtpu-"
+_shm_seq = itertools.count()
+
+
+class _SegmentLost(Exception):
+    """A batch's shared-memory segment vanished before the parent mapped
+    it — its producer died mid-delivery and the cleanup reclaimed the
+    segment. The batch was resubmitted; this copy is droppable."""
+
+
+def _new_segment(nbytes: int):
+    """Create a segment named ``mxtpu-<pid>-<seq>`` (not the anonymous
+    psm_* default) so the parent can reclaim this process's in-flight
+    segments by pid if it dies."""
+    from multiprocessing import shared_memory
+    while True:
+        name = f"{_SHM_PREFIX}{os.getpid()}-{next(_shm_seq)}"
+        try:
+            return shared_memory.SharedMemory(name=name, create=True,
+                                              size=nbytes)
+        except FileExistsError:   # stale name from a previous incarnation
+            continue
+
+
+def _cleanup_worker_shm(pid) -> List[str]:
+    """Unlink every segment a (dead) worker pid left in /dev/shm. Only
+    touches our ``mxtpu-<pid>-*`` namespace; segments for batches the
+    parent already received were materialised + unlinked at receipt, so
+    whatever is left is orphaned by construction."""
+    base = "/dev/shm"
+    removed: List[str] = []
+    if pid is None or not os.path.isdir(base):
+        return removed
+    prefix = f"{_SHM_PREFIX}{pid}-"
+    for fn in os.listdir(base):
+        if fn.startswith(prefix):
+            try:
+                os.unlink(os.path.join(base, fn))
+                removed.append(fn)
+            except OSError:
+                pass
+    return removed
 
 
 # ---------------------------------------------------------------------------
@@ -40,7 +103,6 @@ __all__ = ["ProcessPool"]
 
 def _to_shm(obj, segments):
     """Replace array leaves with shared-memory descriptors (recursive)."""
-    from multiprocessing import shared_memory
     if isinstance(obj, (tuple, list)):
         return type(obj)(_to_shm(o, segments) for o in obj)
     if isinstance(obj, dict):
@@ -57,7 +119,7 @@ def _to_shm(obj, segments):
     arr = _onp.ascontiguousarray(arr)
     if arr.nbytes == 0:
         return ("npz", arr.shape, arr.dtype.str)
-    shm = shared_memory.SharedMemory(create=True, size=arr.nbytes)
+    shm = _new_segment(arr.nbytes)
     shm.buf[:arr.nbytes] = arr.tobytes()
     segments.append(shm)
     return ("shm", shm.name, arr.shape, arr.dtype.str)
@@ -73,7 +135,10 @@ def _from_shm(spec, to_array: Callable[[_onp.ndarray], Any]):
         return to_array(_onp.empty(shape, _onp.dtype(dtype)))
     if isinstance(spec, tuple) and spec and spec[0] == "shm":
         _, name, shape, dtype = spec
-        shm = shared_memory.SharedMemory(name=name)
+        try:
+            shm = shared_memory.SharedMemory(name=name)
+        except FileNotFoundError:
+            raise _SegmentLost(name)
         try:
             view = _onp.ndarray(shape, _onp.dtype(dtype), buffer=shm.buf)
             # one explicit host copy: the CPU backend's device_put may
@@ -93,6 +158,15 @@ def _from_shm(spec, to_array: Callable[[_onp.ndarray], Any]):
     return spec
 
 
+def _map_arrays(tree, fn):
+    """Apply `fn` to every numpy leaf of an already-materialised batch
+    (jax.tree_util handles the container walk; non-array leaves — the
+    "py" scalars — pass through untouched)."""
+    import jax
+    return jax.tree_util.tree_map(
+        lambda x: fn(x) if isinstance(x, _onp.ndarray) else x, tree)
+
+
 # ---------------------------------------------------------------------------
 # worker process
 # ---------------------------------------------------------------------------
@@ -106,6 +180,14 @@ def _worker_main(blob: bytes, task_q, data_q):
         jax.config.update("jax_platforms", "cpu")
     except Exception:
         pass
+    if os.environ.get("MXTPU_FAULT_SPEC"):
+        # only pay the full package import when injection is armed
+        from mxnet_tpu.resilience import EXIT_CODE, FaultExit, fault_point
+    else:
+        EXIT_CODE, FaultExit = 0, ()   # empty tuple: matches no exception
+
+        def fault_point(name):
+            return None
     dataset, batchify_fn = pickle.loads(blob)
     from multiprocessing import resource_tracker
     while True:
@@ -113,10 +195,11 @@ def _worker_main(blob: bytes, task_q, data_q):
         if task is None:
             return
         batch_id, indices = task
+        segments = []
         try:
+            fault_point("worker_exec")
             samples = [dataset[i] for i in indices]
             batch = batchify_fn(samples)
-            segments = []
             spec = _to_shm(batch, segments)
             for shm in segments:
                 shm.close()
@@ -128,67 +211,225 @@ def _worker_main(blob: bytes, task_q, data_q):
                 except Exception:
                     pass
             data_q.put((batch_id, spec, None))
+        except FaultExit:
+            # injected process death: flush results already delivered
+            # (join the feeder thread), then die like a killed process
+            data_q.close()
+            data_q.join_thread()
+            os._exit(EXIT_CODE)
         except Exception as e:  # ship the failure instead of dying silently
             import traceback
+            # a failure mid-_to_shm (e.g. /dev/shm full) leaves created
+            # segments linked; the parent never learns their names and
+            # this worker stays alive, so reclaim them here or they leak
+            # — compounding the very out-of-shm condition that failed us
+            for shm in segments:
+                try:        # unlink first: close() may raise if already
+                    shm.unlink()   # closed on the success path above
+                except Exception:
+                    pass
+                try:
+                    shm.close()
+                except Exception:
+                    pass
             data_q.put((batch_id, None,
                         f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))
 
 
-class ProcessPool:
-    """Order-preserving process pool: submit(indices) -> batches in order."""
+class _Worker:
+    """Parent-side handle: process + private task queue + in-flight ids."""
 
-    def __init__(self, dataset, batchify_fn, num_workers: int):
+    __slots__ = ("idx", "proc", "task_q", "assigned")
+
+    def __init__(self, idx, proc, task_q):
+        self.idx = idx
+        self.proc = proc
+        self.task_q = task_q
+        self.assigned = set()
+
+
+class ProcessPool:
+    """Order-preserving, supervised process pool:
+    submit(indices) -> batches in order, surviving worker death."""
+
+    def __init__(self, dataset, batchify_fn, num_workers: int,
+                 max_respawns: int = None):
         import multiprocessing as mp
-        ctx = mp.get_context("spawn")
-        self._task_q = ctx.Queue()
-        self._data_q = ctx.Queue()
-        blob = pickle.dumps((dataset, batchify_fn),
-                            protocol=pickle.HIGHEST_PROTOCOL)
-        self._procs = [
-            ctx.Process(target=_worker_main,
-                        args=(blob, self._task_q, self._data_q), daemon=True)
-            for _ in range(num_workers)]
-        for p in self._procs:
-            p.start()
+        self._ctx = mp.get_context("spawn")
+        self._data_q = self._ctx.Queue()
+        self._blob = pickle.dumps((dataset, batchify_fn),
+                                  protocol=pickle.HIGHEST_PROTOCOL)
+        self._workers = [self._spawn(i) for i in range(num_workers)]
+        self._max_respawns = (2 * num_workers if max_respawns is None
+                              else max_respawns)
+        self._respawns_left = self._max_respawns
         self._next_submit = 0
         self._next_yield = 0
-        self._reorder = {}
+        self._reorder = {}    # batch_id -> materialised numpy tree
+        self._pending = {}    # batch_id -> indices (for resubmission)
+        self._owner = {}      # batch_id -> _Worker
+        self._failed = set()  # errored out-of-order ids, already raised
         self._closed = False
 
+    def _spawn(self, idx: int) -> _Worker:
+        task_q = self._ctx.Queue()
+        proc = self._ctx.Process(
+            target=_worker_main, args=(self._blob, task_q, self._data_q),
+            daemon=True, name=f"mxtpu-dl-worker-{idx}")
+        proc.start()
+        return _Worker(idx, proc, task_q)
+
     def submit(self, indices) -> None:
-        self._task_q.put((self._next_submit, list(indices)))
+        indices = list(indices)
+        # least-loaded assignment; in-flight tracking is what makes the
+        # dead-worker resubmission exact
+        w = min(self._workers, key=lambda w: (len(w.assigned), w.idx))
+        bid = self._next_submit
         self._next_submit += 1
+        self._pending[bid] = indices
+        self._owner[bid] = w
+        w.assigned.add(bid)
+        w.task_q.put((bid, indices))
 
     @property
     def outstanding(self) -> int:
         return self._next_submit - self._next_yield
 
-    def get(self, to_array, timeout: float):
-        """Next batch in submission order (reorder buffer over the queue)."""
+    # -- supervision -----------------------------------------------------
+    def _check_workers(self, resubmit: bool = True):
+        """Detect dead workers (exitcode set); reclaim their segments and
+        respawn them. With `resubmit` (the get() path) their in-flight
+        batches are resubmitted and the respawn consumes budget — raising
+        once it is exhausted. Without (the reset() path) the batches are
+        being discarded anyway, so the replacement is free: an
+        epoch-boundary respawn is housekeeping, not failure recovery.
+        Returns (respawned, lost_ids)."""
+        respawned = False
+        abandoned = set()
+        for slot, w in enumerate(self._workers):
+            if w.proc.exitcode is None:
+                continue
+            code = w.proc.exitcode
+            lost = sorted(w.assigned)
+            leaked = _cleanup_worker_shm(w.proc.pid)
+            if leaked:
+                _log.warning("reclaimed %d shm segment(s) from dead "
+                             "worker %d: %s", len(leaked), w.idx, leaked)
+            if resubmit and self._respawns_left <= 0:
+                from ...base import MXNetError
+                raise MXNetError(
+                    f"DataLoader worker {w.idx} (pid {w.proc.pid}) died "
+                    f"with exit code {code} and the respawn budget "
+                    f"({self._max_respawns}) is exhausted; in-flight "
+                    f"batches {lost} are lost. Repeated worker deaths "
+                    f"usually mean the OOM killer (shrink the batch or "
+                    f"num_workers) or a crashing native transform.")
+            if resubmit:
+                self._respawns_left -= 1
+            _log.warning(
+                "DataLoader worker %d (pid %s) died with exit code %s; "
+                "respawning (%s batches %s; %d/%d respawns left)",
+                w.idx, w.proc.pid, code,
+                "resubmitting" if resubmit else "abandoning", lost,
+                self._respawns_left, self._max_respawns)
+            neww = self._spawn(w.idx)
+            self._workers[slot] = neww
+            for bid in lost:
+                if resubmit:
+                    self._owner[bid] = neww
+                    neww.assigned.add(bid)
+                    neww.task_q.put((bid, self._pending[bid]))
+                else:
+                    self._owner.pop(bid, None)
+                    self._pending.pop(bid, None)
+                    abandoned.add(bid)
+            respawned = True
+        return respawned, abandoned
+
+    def _receive(self, batch_id, spec, err, raise_errors: bool = True):
+        """Fold one result-queue item into the reorder buffer. Duplicates
+        (a worker delivered, died before we read it, and the batch was
+        recomputed) are discarded; lost segments mean the recomputed copy
+        is still coming, so bookkeeping is left intact for it."""
         from ...base import MXNetError
+        if batch_id < self._next_yield or batch_id in self._reorder:
+            if spec is not None:
+                self._discard(spec)
+            return
+        if err is not None:
+            w = self._owner.pop(batch_id, None)
+            if w is not None:
+                w.assigned.discard(batch_id)
+            self._pending.pop(batch_id, None)
+            # mark the failed batch consumed so a caller that catches the
+            # error (or a later epoch) doesn't wait on it forever — an
+            # OUT-OF-ORDER error is remembered and skipped when the yield
+            # pointer reaches it
+            if batch_id == self._next_yield:
+                self._next_yield += 1
+                self._skip_failed()
+            else:
+                self._failed.add(batch_id)
+            if raise_errors:
+                raise MXNetError(f"DataLoader worker failed: {err}")
+            return
+        try:
+            # materialise NOW (host copy + unlink): once a batch is in the
+            # reorder buffer it no longer depends on any shm segment, so a
+            # later producer death can't invalidate buffered batches
+            tree = _from_shm(spec, lambda a: a)
+        except _SegmentLost:
+            return
+        w = self._owner.pop(batch_id, None)
+        if w is not None:
+            w.assigned.discard(batch_id)
+        self._pending.pop(batch_id, None)
+        self._reorder[batch_id] = tree
+
+    def _skip_failed(self) -> None:
+        """Advance the yield pointer past ids whose error was already
+        delivered (they will never be produced)."""
+        while self._next_yield in self._failed:
+            self._failed.discard(self._next_yield)
+            self._next_yield += 1
+
+    # -- consumption -----------------------------------------------------
+    def get(self, to_array, timeout: float):
+        """Next batch in submission order (reorder buffer over the queue).
+        Polls in `_POLL` slices so a dead worker is detected (and its
+        batches resubmitted) immediately instead of after `timeout`."""
+        from ...base import MXNetError
+        self._skip_failed()
         want = self._next_yield
+        deadline = time.monotonic() + timeout
         while want not in self._reorder:
             try:
-                batch_id, spec, err = self._data_q.get(timeout=timeout)
+                item = self._data_q.get(timeout=min(_POLL, timeout))
             except _queue_mod.Empty:
-                raise MXNetError(
-                    f"DataLoader worker batch timed out after {timeout}s "
-                    f"(num_workers={len(self._procs)}); a dataset transform "
-                    "is stuck or too slow — raise `timeout=` or debug the "
-                    "transform")
-            if err is not None:
-                # mark the failed batch consumed so a caller that catches
-                # the error (or a later epoch) doesn't wait on it forever
-                if batch_id == want:
-                    self._next_yield += 1
-                raise MXNetError(f"DataLoader worker failed: {err}")
-            self._reorder[batch_id] = spec
-        spec = self._reorder.pop(want)
+                respawned, _ = self._check_workers()
+                if respawned:
+                    # recomputation gets a fresh budget
+                    deadline = time.monotonic() + timeout
+                    continue
+                if time.monotonic() >= deadline:
+                    raise MXNetError(
+                        f"DataLoader worker batch timed out after "
+                        f"{timeout}s (num_workers={len(self._workers)}, "
+                        f"all workers alive); a dataset transform is "
+                        f"stuck or too slow — raise `timeout=` or debug "
+                        f"the transform")
+                continue
+            self._receive(*item)
+            # timeout bounds the gap between ARRIVALS, not the total wait
+            # for this batch id: a slow batch must not time out while the
+            # other workers deliver steadily (the pipeline is healthy)
+            deadline = time.monotonic() + timeout
+        tree = self._reorder.pop(want)
         self._next_yield += 1
-        return _from_shm(spec, to_array)
+        return _map_arrays(tree, to_array)
 
     def _discard(self, spec) -> None:
-        """Unlink a batch's shared-memory segments without materialising."""
+        """Unlink a raw spec's shared-memory segments without keeping it."""
         try:
             _from_shm(spec, lambda a: None)
         except Exception:
@@ -199,47 +440,71 @@ class ProcessPool:
         segments) so a fresh epoch starts from a clean queue — an abandoned
         iterator (``for b in dl: break``) must not leak its prefetched
         batches into the next one."""
-        deadline = None
+        deadline = time.monotonic() + timeout
+        abandoned = set()
         while self._next_yield < self._next_submit:
+            self._skip_failed()
             if self._next_yield in self._reorder:
-                self._discard(self._reorder.pop(self._next_yield))
+                self._reorder.pop(self._next_yield)
                 self._next_yield += 1
                 continue
+            if self._next_yield in abandoned:
+                self._next_yield += 1   # died with its worker; not coming
+                continue
             try:
-                batch_id, spec, _err = self._data_q.get(timeout=timeout)
+                item = self._data_q.get(timeout=min(_POLL, timeout))
             except _queue_mod.Empty:
-                break   # worker wedged; shutdown() will clean up
-            if spec is not None:
-                self._reorder[batch_id] = spec
-            else:
-                if batch_id == self._next_yield:
-                    self._next_yield += 1
-        for spec in self._reorder.values():
-            self._discard(spec)
+                # dead workers are replaced for free here — their batches
+                # are being discarded, so this is epoch-boundary
+                # housekeeping, not failure recovery (no budget, no
+                # resubmission)
+                respawned, lost = self._check_workers(resubmit=False)
+                if respawned:
+                    abandoned |= lost
+                    deadline = time.monotonic() + timeout
+                    continue
+                if time.monotonic() >= deadline:
+                    break   # worker wedged; shutdown() will clean up
+                continue
+            self._receive(*item, raise_errors=False)
+            deadline = time.monotonic() + timeout
+        # a worker that died IDLE (nothing in flight) never forces an
+        # Empty poll above — sweep for corpses so the new epoch starts
+        # with a full complement instead of assigning batches to one
+        self._check_workers(resubmit=False)
         self._reorder.clear()
-        self._next_submit = self._next_yield = 0
+        self._pending.clear()
+        self._owner.clear()
+        self._failed.clear()
+        for w in self._workers:
+            w.assigned.clear()
+        # batch ids stay monotonic across epochs: a wedged worker's stale
+        # delivery then lands below _next_yield and is discarded instead
+        # of colliding with a same-numbered batch of the new epoch
+        self._next_yield = self._next_submit
 
     def shutdown(self) -> None:
         if self._closed:
             return
         self._closed = True
-        for _ in self._procs:
+        for w in self._workers:
             try:
-                self._task_q.put(None)
+                w.task_q.put(None)
             except Exception:
                 pass
-        for p in self._procs:
-            p.join(timeout=2)
-            if p.is_alive():
-                p.terminate()
+        for w in self._workers:
+            w.proc.join(timeout=2)
+            if w.proc.is_alive():
+                w.proc.terminate()
+                w.proc.join(timeout=1)
         # drain in-flight and buffered segments so nothing leaks /dev/shm
-        for spec in self._reorder.values():
-            self._discard(spec)
         self._reorder.clear()
         try:
             while True:
-                _, spec, _err = self._data_q.get_nowait()
+                _bid, spec, _err = self._data_q.get_nowait()
                 if spec is not None:
                     self._discard(spec)
         except Exception:
             pass
+        for w in self._workers:
+            _cleanup_worker_shm(w.proc.pid)

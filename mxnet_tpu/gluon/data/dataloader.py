@@ -15,6 +15,10 @@ because Python-side decode is GIL-bound. Two worker modes here:
   Spawn semantics: the dataset/transform must be picklable (module-level,
   not lambdas/closures), and user scripts must build the loader under
   ``if __name__ == "__main__":`` — the standard spawn-mode contract.
+  Workers are supervised: a worker process that dies (OOM killer, native
+  crash) is detected by exit code — not by timeout — respawned up to
+  `worker_respawns` times, and its in-flight batches are resubmitted with
+  order preserved (see `_mp_loader.ProcessPool` and docs/resilience.md).
 """
 from __future__ import annotations
 
@@ -53,7 +57,7 @@ class DataLoader:
                  batchify_fn: Optional[Callable] = None, num_workers=0,
                  pin_memory=False, pin_device_id=0, prefetch=None,
                  thread_pool=True, timeout=120, try_nopython=None,
-                 auto_reload=False):
+                 auto_reload=False, worker_respawns=None):
         self._dataset = dataset
         if batch_sampler is None:
             if batch_size is None:
@@ -83,8 +87,13 @@ class DataLoader:
                 self._pool = ThreadPoolExecutor(max_workers=num_workers)
             else:
                 from ._mp_loader import ProcessPool
+                # worker_respawns bounds how many dead worker processes
+                # (OOM kill, native crash) are transparently respawned
+                # with their in-flight batches resubmitted before the
+                # loader raises; default 2 * num_workers
                 self._proc_pool = ProcessPool(dataset, self._batchify_fn,
-                                              num_workers)
+                                              num_workers,
+                                              max_respawns=worker_respawns)
 
     def _make_batch(self, indices):
         samples = [self._dataset[i] for i in indices]

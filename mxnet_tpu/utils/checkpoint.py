@@ -1,4 +1,4 @@
-"""CheckpointManager — periodic checkpoint + auto-resume.
+"""CheckpointManager — periodic checkpoint + auto-resume + verified restore.
 
 The recovery story SURVEY.md §5.3 plans as a NEW capability (the reference
 has none: a dead ps-lite node kills the job). Works with any target
@@ -6,6 +6,16 @@ exposing ``save(path)`` / ``load(path)`` — `ShardedTrainStep` is the
 canonical one — and implements the usual manager contract (atomic writes,
 keep-last-K pruning, latest-step discovery) so a restarted job continues
 from the newest complete checkpoint.
+
+Integrity: every save writes a manifest sidecar
+(``<ckpt>.npz.manifest.json``: size + sha256 + step + wall time), and
+`restore()` verifies the newest checkpoint against it before loading. A
+checkpoint that fails verification — or whose ``target.load`` raises — is
+**quarantined** (renamed to ``*.corrupt``, manifest alongside) and restore
+falls back through the chain of older checkpoints instead of raising on
+the first, so a bit-rotted latest checkpoint costs one rollback, not the
+job. Checkpoints predating the manifest format load with a warning (no
+hash to check) but still fall back if the load itself fails.
 
 Usage::
 
@@ -17,16 +27,35 @@ Usage::
 """
 from __future__ import annotations
 
+import hashlib
+import json
+import logging
 import os
 import re
 import tempfile
+import time
 from typing import List, Optional, Tuple
 
 from ..base import MXNetError
+from ..resilience import fault_point, retry_with_backoff
 
 __all__ = ["CheckpointManager"]
 
+_log = logging.getLogger(__name__)
+
 _FNAME = re.compile(r"^(?P<prefix>.+)-(?P<step>\d+)\.npz$")
+_MANIFEST = ".manifest.json"
+
+
+def _sha256(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
 
 
 class CheckpointManager:
@@ -40,7 +69,9 @@ class CheckpointManager:
 
     # -- discovery -------------------------------------------------------
     def checkpoints(self) -> List[Tuple[int, str]]:
-        """Sorted [(step, path)] of complete checkpoints on disk."""
+        """Sorted [(step, path)] of complete checkpoints on disk
+        (quarantined ``*.corrupt`` files and manifests are excluded by the
+        name pattern)."""
         out = []
         for fn in os.listdir(self.directory):
             m = _FNAME.match(fn)
@@ -53,6 +84,65 @@ class CheckpointManager:
         cps = self.checkpoints()
         return cps[-1] if cps else None
 
+    # -- integrity -------------------------------------------------------
+    def _write_manifest(self, path: str, step: int) -> None:
+        """Manifest sidecar for `path` (atomic: tmp + rename). Written
+        AFTER the checkpoint rename: a crash in between leaves a valid
+        checkpoint that merely verifies as legacy/unmanifested."""
+        meta = {"step": step, "size": os.path.getsize(path),
+                "sha256": _sha256(path), "time": time.time(),
+                "prefix": self.prefix}
+        fd, tmp = tempfile.mkstemp(dir=self.directory,
+                                   prefix=f".{self.prefix}-man")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(meta, f)
+            os.replace(tmp, path + _MANIFEST)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
+    def _verify(self, path: str) -> Optional[str]:
+        """None if `path` matches its manifest, else the failure reason.
+        A missing manifest (pre-manifest checkpoint) verifies with a
+        warning — there is nothing to check against."""
+        man = path + _MANIFEST
+        if not os.path.exists(man):
+            _log.warning("checkpoint %s has no manifest (pre-manifest "
+                         "format?); loading unverified", path)
+            return None
+        try:
+            with open(man) as f:
+                meta = json.load(f)
+        except (OSError, ValueError) as e:
+            return f"unreadable manifest: {e}"
+        size = os.path.getsize(path)
+        if size != meta.get("size"):
+            return f"size mismatch (have {size}, manifest says " \
+                   f"{meta.get('size')})"
+        digest = _sha256(path)
+        if digest != meta.get("sha256"):
+            return "sha256 mismatch (checkpoint bytes changed on disk)"
+        return None
+
+    def _quarantine(self, path: str, reason: str) -> str:
+        """Rename a bad checkpoint (+ manifest) to ``*.corrupt`` so
+        discovery skips it but the evidence survives for forensics."""
+        corrupt = path + ".corrupt"
+        _log.error("checkpoint %s failed verification/load (%s); "
+                   "quarantining as %s", path, reason, corrupt)
+        try:
+            os.replace(path, corrupt)
+        except OSError:
+            pass
+        man = path + _MANIFEST
+        if os.path.exists(man):
+            try:
+                os.replace(man, corrupt + _MANIFEST)
+            except OSError:
+                pass
+        return corrupt
+
     # -- save/restore ----------------------------------------------------
     def _path(self, step: int) -> str:
         return os.path.join(self.directory, f"{self.prefix}-{step}.npz")
@@ -60,18 +150,20 @@ class CheckpointManager:
     def save(self, target, step: int) -> str:
         """Checkpoint `target` at `step`. The write is atomic (temp file +
         rename) so a crash mid-save never leaves a truncated checkpoint as
-        the latest."""
+        the latest; the manifest sidecar follows the rename."""
         self.wait_async()
         final = self._path(step)
         fd, tmp = tempfile.mkstemp(dir=self.directory,
                                    prefix=f".{self.prefix}-tmp")
         os.close(fd)
         try:
+            fault_point("ckpt_write")
             target.save(tmp)
             os.replace(tmp, final)
         finally:
             if os.path.exists(tmp):
                 os.unlink(tmp)
+        self._write_manifest(final, step)
         self._prune()
         return final
 
@@ -97,6 +189,7 @@ class CheckpointManager:
         # save_async writes in place must never leave a truncated file
         # at the final name (ShardedTrainStep is atomic on its own; the
         # extra same-directory rename is free)
+        fault_point("ckpt_write")
         fd, tmp = tempfile.mkstemp(dir=self.directory,
                                    prefix=f".{self.prefix}-atmp")
         os.close(fd)
@@ -108,6 +201,7 @@ class CheckpointManager:
             try:
                 f.result()
                 os.replace(tmp, final)
+                self._write_manifest(final, step)
                 self._prune()
                 out.set_result(final)
             except BaseException as e:  # surface writer errors to .result()
@@ -138,26 +232,81 @@ class CheckpointManager:
         return None
 
     def restore(self, target, step: Optional[int] = None) -> int:
-        """Load the checkpoint at `step` (default: latest) into `target`;
-        returns the restored step, or 0 if none exists."""
+        """Load the newest VERIFIED checkpoint into `target` and return
+        its step (0 when the directory has none).
+
+        With explicit `step`: verify + load exactly that checkpoint,
+        raising on corruption (the caller asked for that one — falling
+        back silently would be surprising).
+
+        Default (latest): walk the chain newest → oldest; a checkpoint
+        that fails verification or whose ``target.load`` raises is
+        quarantined and the next-older one is tried. Raises `MXNetError`
+        only when checkpoints exist but every one is corrupt. Note a
+        failed ``load`` may leave `target` partially mutated; the
+        fallback load overwrites the full state, so the target is
+        consistent whenever restore returns.
+        """
         self.wait_async()
         if step is not None:
             path = self._path(step)
             if not os.path.exists(path):
                 raise MXNetError(f"no checkpoint for step {step} in "
                                  f"{self.directory}")
+            reason = self._verify(path)
+            if reason is not None:
+                raise MXNetError(f"checkpoint {path} failed verification: "
+                                 f"{reason}")
+            fault_point("ckpt_read")
             target.load(path)
             return step
-        latest = self.latest()
-        if latest is None:
+        chain = self.checkpoints()
+        if not chain:
             return 0
-        target.load(latest[1])
-        return latest[0]
+        failures = []
+        for s, path in reversed(chain):
+            reason = self._verify(path)
+            if reason is None:
+                try:
+                    # transient I/O blips (flaky NFS) are retried before a
+                    # sha256-verified checkpoint is condemned — quarantine
+                    # is for corruption, not weather
+                    def _load():
+                        fault_point("ckpt_read")
+                        target.load(path)
+                    retry_with_backoff(_load, retries=2, base_delay=0.1,
+                                       retry_on=(OSError,))
+                except Exception as e:  # noqa: BLE001 — any load error
+                    # the bytes passed verification — if this repeats down
+                    # the whole chain it is a target/format incompatibility
+                    # (changed architecture?), not corruption; quarantine
+                    # is a rename, reversible by stripping the suffix
+                    reason = (f"load failed on a verification-passing "
+                              f"checkpoint ({type(e).__name__}: {e})")
+                else:
+                    if failures:
+                        _log.warning(
+                            "restore: fell back to checkpoint at step %d "
+                            "after quarantining %d newer corrupt "
+                            "checkpoint(s)", s, len(failures))
+                    return s
+            failures.append(self._quarantine(path, reason))
+        raise MXNetError(
+            f"all {len(failures)} checkpoint(s) in {self.directory} "
+            f"failed to restore (quarantined: {failures}); refusing to "
+            f"silently restart from scratch. If the files verified but "
+            f"failed to LOAD, the target is likely incompatible (changed "
+            f"architecture?) — quarantine is a rename; strip the "
+            f"'.corrupt' suffix to recover the files")
 
     def _prune(self):
         cps = self.checkpoints()
         for _, path in cps[:-self.keep]:
             try:
                 os.unlink(path)
+            except OSError:
+                pass
+            try:
+                os.unlink(path + _MANIFEST)
             except OSError:
                 pass

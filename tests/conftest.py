@@ -22,6 +22,9 @@ try:
 except Exception:
     pass
 
+import gc  # noqa: E402
+import time  # noqa: E402
+
 import numpy as _onp  # noqa: E402
 import pytest  # noqa: E402
 
@@ -41,3 +44,30 @@ def seed_rng(request):
         return f"test seed: {seed} (set MXTPU_TEST_SEED={seed} to reproduce)"
     request.node.user_properties.append(("seed", seed))
     yield seed
+
+
+def _mxtpu_shm_segments():
+    """Names of this framework's live /dev/shm segments (workers name
+    theirs ``mxtpu-<pid>-<seq>``; see gluon/data/_mp_loader.py)."""
+    base = "/dev/shm"
+    if not os.path.isdir(base):
+        return set()
+    return {f for f in os.listdir(base) if f.startswith("mxtpu-")}
+
+
+@pytest.fixture
+def shm_leak_check():
+    """Assert a test leaks no mxtpu shared-memory segments — the contract
+    the DataLoader worker-death recovery must uphold (a SIGKILLed worker's
+    in-flight segments are reclaimed by the parent, not orphaned)."""
+    before = _mxtpu_shm_segments()
+    yield
+    gc.collect()   # DataLoader cleanup is __del__-driven
+    leaked = _mxtpu_shm_segments() - before
+    deadline = time.monotonic() + 3.0
+    while leaked and time.monotonic() < deadline:
+        # grace for queue feeder threads / late worker teardown
+        time.sleep(0.05)
+        gc.collect()
+        leaked = _mxtpu_shm_segments() - before
+    assert not leaked, f"leaked /dev/shm segments: {sorted(leaked)}"

@@ -407,3 +407,45 @@ def test_amp_list_accessors():
     assert amp.list_widest_type_cast()
     assert "SoftmaxCrossEntropyLoss" in amp.list_loss_output_functions()
     assert amp.list_lp16_use_fp32_params() == []
+
+
+def test_loss_scaler_tolerance_skip_ratio():
+    """`tolerance` implements the reference's skip-ratio semantics: an
+    overflow only shrinks the scale when the overflow ratio since the
+    last rescale reaches `tolerance` — isolated blips in a healthy window
+    skip the step but keep the scale."""
+    s = LossScaler(init_scale=1024.0, scale_factor=2.0, scale_window=100,
+                   tolerance=0.4)
+    s.update_scale(overflow=True)          # ratio 1/1 >= 0.4: shrink
+    assert s.loss_scale == 512.0
+    s.update_scale(overflow=False)
+    s.update_scale(overflow=False)
+    s.update_scale(overflow=True)          # ratio 1/3 < 0.4: keep scale
+    assert s.loss_scale == 512.0
+    s.update_scale(overflow=True)          # ratio 2/4 >= 0.4: shrink
+    assert s.loss_scale == 256.0
+    # zero tolerance = legacy behavior: every overflow shrinks
+    legacy = LossScaler(init_scale=64.0, scale_factor=2.0, tolerance=0.0)
+    for expect in (32.0, 16.0, 8.0):
+        legacy.update_scale(overflow=True)
+        assert legacy.loss_scale == expect
+
+
+def test_loss_scaler_growth_survives_tolerated_overflow():
+    """A tolerated (non-shrinking) overflow still resets the growth
+    window: the scale must not grow right after an overflow."""
+    s = LossScaler(init_scale=256.0, scale_factor=2.0, scale_window=3,
+                   tolerance=0.9)
+    for _ in range(3):
+        s.update_scale(overflow=False)
+    assert s.loss_scale == 512.0           # grew after a clean window
+    s.update_scale(overflow=True)          # 1/1 >= 0.9 -> shrinks
+    assert s.loss_scale == 256.0
+    s.update_scale(overflow=False)
+    s.update_scale(overflow=True)          # 1/2 < 0.9 -> tolerated
+    assert s.loss_scale == 256.0
+    s.update_scale(overflow=False)
+    s.update_scale(overflow=False)
+    assert s.loss_scale == 256.0           # window restarted at overflow
+    s.update_scale(overflow=False)
+    assert s.loss_scale == 512.0           # 3 clean steps after overflow

@@ -254,3 +254,137 @@ def test_async_save_error_delivered_exactly_once(tmp_path, monkeypatch):
     with pytest.raises(OSError):               # backstop still fires
         step.save(str(tmp_path / "d.npz"))
     step.save(str(tmp_path / "e.npz"))         # and clears after delivery
+
+
+# ---------------------------------------------------------------------------
+# verified restore: manifests, quarantine, fallback chain
+# ---------------------------------------------------------------------------
+
+def _save_chain(tmp_path, steps=(2, 4, 6)):
+    """CounterTargets checkpointed at `steps`; returns (manager, states)."""
+    from mxnet_tpu.utils import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path))
+    t = CounterTarget()
+    states = {}
+    step_iter = iter(steps)
+    nxt = next(step_iter)
+    for i in range(max(steps)):
+        t.apply(i)
+        if i + 1 == nxt:
+            mgr.save(t, i + 1)
+            states[i + 1] = t.state.copy()
+            nxt = next(step_iter, None)
+    return mgr, states
+
+
+def test_manifest_written_and_verifies(tmp_path):
+    mgr, _ = _save_chain(tmp_path)
+    step, path = mgr.latest()
+    man = path + ".manifest.json"
+    assert os.path.exists(man)
+    import json
+    with open(man) as f:
+        meta = json.load(f)
+    assert meta["step"] == step
+    assert meta["size"] == os.path.getsize(path)
+    assert len(meta["sha256"]) == 64
+    assert mgr._verify(path) is None
+
+
+def test_restore_falls_back_on_truncated_latest(tmp_path):
+    mgr, states = _save_chain(tmp_path)
+    _, path = mgr.latest()
+    with open(path, "r+b") as f:          # truncate: size mismatch
+        f.truncate(os.path.getsize(path) // 2)
+    t = CounterTarget()
+    assert mgr.restore(t) == 4            # fell back one checkpoint
+    onp.testing.assert_array_equal(t.state, states[4])
+    assert os.path.exists(path + ".corrupt")
+    assert not os.path.exists(path)
+    assert mgr.latest()[0] == 4           # quarantined ckpt left discovery
+
+
+def test_restore_falls_back_on_bitflip(tmp_path):
+    mgr, states = _save_chain(tmp_path)
+    _, path = mgr.latest()
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:          # flip one byte: sha256 mismatch
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0xFF]))
+    assert os.path.getsize(path) == size
+    t = CounterTarget()
+    assert mgr.restore(t) == 4
+    onp.testing.assert_array_equal(t.state, states[4])
+    assert os.path.exists(path + ".corrupt")
+    assert os.path.exists(path + ".corrupt.manifest.json")
+
+
+def test_restore_falls_back_on_load_error_without_manifest(tmp_path):
+    """Pre-manifest checkpoint (no sidecar) whose bytes are garbage: the
+    load error itself must trigger quarantine + fallback."""
+    mgr, states = _save_chain(tmp_path)
+    _, path = mgr.latest()
+    os.unlink(path + ".manifest.json")
+    with open(path, "wb") as f:
+        f.write(b"not an npz")
+    t = CounterTarget()
+    assert mgr.restore(t) == 4
+    onp.testing.assert_array_equal(t.state, states[4])
+    assert os.path.exists(path + ".corrupt")
+
+
+def test_restore_raises_when_all_corrupt(tmp_path):
+    mgr, _ = _save_chain(tmp_path)
+    for _, path in mgr.checkpoints():
+        with open(path, "r+b") as f:
+            f.truncate(1)
+    with pytest.raises(mx.MXNetError, match="all 3 checkpoint"):
+        mgr.restore(CounterTarget())
+    # fresh directory still means "start from scratch", not an error
+    from mxnet_tpu.utils import CheckpointManager
+    assert CheckpointManager(str(tmp_path / "fresh")).restore(
+        CounterTarget()) == 0
+
+
+def test_restore_explicit_step_verifies(tmp_path):
+    mgr, states = _save_chain(tmp_path)
+    with open(mgr._path(4), "r+b") as f:
+        f.truncate(3)
+    t = CounterTarget()
+    with pytest.raises(mx.MXNetError, match="failed verification"):
+        mgr.restore(t, step=4)            # explicit step: no silent fallback
+    assert mgr.restore(t, step=6) == 6
+    onp.testing.assert_array_equal(t.state, states[6])
+
+
+def test_prune_removes_manifest_sidecars(tmp_path):
+    from mxnet_tpu.utils import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path), keep=2)
+    t = CounterTarget()
+    for s in (1, 2, 3, 4):
+        t.apply(s)
+        mgr.save(t, s)
+    files = os.listdir(tmp_path)
+    assert sorted(f for f in files if f.endswith(".npz")) == \
+        ["ckpt-3.npz", "ckpt-4.npz"]
+    assert sorted(f for f in files if f.endswith(".manifest.json")) == \
+        ["ckpt-3.npz.manifest.json", "ckpt-4.npz.manifest.json"]
+
+
+@pytest.mark.fault
+def test_elastic_bitexact_under_injected_ckpt_read_fault(tmp_path,
+                                                         monkeypatch):
+    """ElasticLoop completes bit-exact when the recovery restore's first
+    checkpoint read is corrupted: the quarantine + fallback chain costs
+    one deeper rollback, not the job."""
+    inj = FailureInjector(at_steps=[5])
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "ckpt_read@1")
+    t = CounterTarget()
+    loop = ElasticLoop(t, str(tmp_path), save_every=2, failure_injector=inj)
+    out = loop.run(lambda i: t.apply(i), total_steps=10)
+    assert out["status"] == "completed"
+    assert out["restores"] == 1
+    onp.testing.assert_allclose(t.state, _run_clean(10))
+    assert any(f.endswith(".corrupt") for f in os.listdir(tmp_path))

@@ -427,3 +427,124 @@ def test_mp_dataloader_abandoned_epoch_resets():
     # sequential sampler: epoch 2 must start again from sample 0
     onp.testing.assert_allclose(epoch2[0][:, 0], [0, 1, 2])
     onp.testing.assert_allclose(epoch2[-1][:, 0], [9, 10, 11])
+
+
+# ---------------------------------------------------------------------------
+# worker supervision: death detection, respawn + resubmit, shm reclamation
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fault
+def test_mp_dataloader_survives_sigkilled_worker(shm_leak_check):
+    """SIGKILL a worker mid-epoch: the pool must detect the death by exit
+    code (not timeout), respawn, resubmit the in-flight batches, preserve
+    order, and leak no /dev/shm segments (leak-check fixture)."""
+    import os
+    import signal
+    ds = _SlowPythonTransformDataset(n=16, work=2000)
+    dl = DataLoader(ds, batch_size=2, num_workers=2, thread_pool=False,
+                    timeout=60)
+    it = iter(dl)
+    first = next(it)
+    victim = dl._proc_pool._workers[0].proc
+    os.kill(victim.pid, signal.SIGKILL)
+    batches = [first] + list(it)
+    assert len(batches) == 8
+    got = onp.concatenate([onp.asarray(b[0].asnumpy())[:, 0]
+                           for b in batches])
+    onp.testing.assert_array_equal(got, onp.arange(16))  # order preserved
+    assert victim.exitcode == -signal.SIGKILL
+    dl._proc_pool.shutdown()
+
+
+@pytest.mark.fault
+def test_mp_dataloader_respawn_budget_names_dead_worker(monkeypatch,
+                                                        shm_leak_check):
+    """Every incarnation dies instantly (injected) and the budget is 0:
+    the error must name the worker and its exit code, precisely — not a
+    misleading 'transform is stuck' timeout."""
+    from mxnet_tpu.base import MXNetError
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "worker_exec@1:exit")
+    ds = _SlowPythonTransformDataset(n=8, work=10)
+    dl = DataLoader(ds, batch_size=2, num_workers=1, thread_pool=False,
+                    timeout=30, worker_respawns=0)
+    with pytest.raises(MXNetError,
+                       match=r"worker 0 .* exit code 86 .* respawn budget"):
+        list(dl)
+    dl._proc_pool.shutdown()
+
+
+@pytest.mark.fault
+def test_mp_dataloader_injected_worker_exception_propagates(monkeypatch):
+    """A fault-injected EXCEPTION (not death) in the worker ships across
+    the queue like any dataset error and keeps the worker alive."""
+    from mxnet_tpu.base import MXNetError
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "worker_exec@1:OSError")
+    ds = _SlowPythonTransformDataset(n=8, work=10)
+    dl = DataLoader(ds, batch_size=2, num_workers=1, thread_pool=False,
+                    timeout=30)
+    with pytest.raises(MXNetError,
+                       match=r"worker failed: OSError.*injected fault"):
+        list(dl)
+    # the worker survived the injected exception and serves a new epoch
+    monkeypatch.delenv("MXTPU_FAULT_SPEC")
+    pool = dl._proc_pool
+    assert all(w.proc.is_alive() for w in pool._workers)
+    epoch2 = [onp.asarray(b[0].asnumpy())[:, 0] for b in dl]
+    onp.testing.assert_array_equal(onp.concatenate(epoch2), onp.arange(8))
+    pool.shutdown()
+
+
+@pytest.mark.fault
+def test_mp_dataloader_reset_respawns_without_budget(shm_leak_check):
+    """A worker death noticed at an epoch boundary is housekeeping, not
+    failure recovery: reset() must replace the dead worker WITHOUT
+    consuming the respawn budget or resubmitting discarded batches —
+    worker_respawns=0 and an abandoned epoch must not kill the loader."""
+    import os
+    import signal
+    ds = _SlowPythonTransformDataset(n=12, work=10)
+    dl = DataLoader(ds, batch_size=2, num_workers=2, thread_pool=False,
+                    timeout=60, worker_respawns=0)
+    it = iter(dl)
+    next(it)                                   # epoch 1, then abandon
+    victim = dl._proc_pool._workers[1].proc
+    os.kill(victim.pid, signal.SIGKILL)
+    victim.join(5)
+    epoch2 = [onp.asarray(b[0].asnumpy())[:, 0] for b in dl]
+    onp.testing.assert_array_equal(onp.concatenate(epoch2), onp.arange(12))
+    assert dl._proc_pool._respawns_left == 0   # untouched budget
+    dl._proc_pool.shutdown()
+
+
+class _OutOfOrderErrorDataset:
+    """Batch 0 is slow, batch 1 errors instantly: with 2 workers the
+    error arrives out of order (before batch 0's data)."""
+
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        import time as _t
+        if i < 2:
+            _t.sleep(0.6)
+            return onp.zeros(3, onp.float32)
+        if i < 4:
+            raise ValueError(f"bad sample {i}")
+        return onp.zeros(3, onp.float32)
+
+
+def test_mp_dataloader_out_of_order_error_consumed():
+    """An error delivered for a FUTURE batch id must still mark that id
+    consumed: the next epoch's reset must not stall a full timeout
+    waiting for a batch that will never be produced."""
+    import time as _t
+    from mxnet_tpu.base import MXNetError
+    dl = DataLoader(_OutOfOrderErrorDataset(), batch_size=2, num_workers=2,
+                    thread_pool=False, timeout=8)
+    with pytest.raises(MXNetError, match="bad sample"):
+        list(dl)
+    t0 = _t.monotonic()
+    with pytest.raises(MXNetError, match="bad sample"):
+        list(dl)          # reset + epoch 2: errors again, but promptly
+    assert _t.monotonic() - t0 < 6, "reset stalled on a consumed error id"
+    dl._proc_pool.shutdown()
