@@ -146,7 +146,14 @@ class DataLoader:
                 break
         while queue:
             fut = queue.popleft()
-            submit()
+            # restore the window to FULL depth immediately after taking a
+            # batch out — before blocking on this batch's result — stated
+            # as an invariant (refill-to-depth) rather than one paired
+            # submit, so `prefetch` submissions always run behind a slow
+            # transform even if a future edit pops more than one future
+            # per iteration
+            while len(queue) < self._prefetch and submit():
+                pass
             try:
                 yield fut.result(timeout=self._timeout)
             except FuturesTimeoutError:

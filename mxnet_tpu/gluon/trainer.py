@@ -19,7 +19,7 @@ from ..base import MXNetError
 from ..ndarray.ndarray import ndarray, from_jax
 from .. import optimizer as opt
 from ..kvstore import KVStore
-from ..ops.fused_optim import tree_apply_update
+from ..ops.fused_optim import HpScalarCache, tree_apply_update
 from .parameter import Parameter
 
 __all__ = ["Trainer"]
@@ -212,19 +212,27 @@ class Trainer:
         from ..optimizer.optimizer import _state_values, _state_writeback
         states_tree = {n: _state_values(self._states[n]) for n in names}
 
-        hp = {
-            "lr": jnp.asarray(o.learning_rate, jnp.float32),
-            "wd": jnp.asarray(o.wd, jnp.float32),
-            "rescale_grad": jnp.asarray(o.rescale_grad, jnp.float32),
-            "clip_gradient": o.clip_gradient,
-            "t": jnp.asarray(t, jnp.float32),
-        }
+        hp = self._cached_hp(t)
 
         new_params, new_states = tree_apply_update(
             _RuleAdapter(o), params_tree, grads_tree, states_tree, hp)
         for n, p in zip(names, self._params):
             p.data()._data = new_params[n]
             _state_writeback(self._states[n], new_states[n])
+
+    _hp_cache = None
+
+    def _cached_hp(self, t):
+        """Device-resident hyperparameter scalars for the fused update,
+        re-uploaded only when the host values change (async-pipeline
+        satellite: lr/wd/rescale/clip are constant across steps, so the
+        steady-state step enqueues one `t` upload instead of four).
+        Shares `HpScalarCache` with `ShardedTrainStep._hp`."""
+        if self._hp_cache is None:
+            self._hp_cache = HpScalarCache()
+        hp = self._hp_cache.get(self._optimizer)
+        hp["t"] = jnp.asarray(t, jnp.float32)
+        return hp
 
     # -- checkpointing ---------------------------------------------------------
     def save_states(self, fname):

@@ -16,6 +16,34 @@ import jax
 import jax.numpy as jnp
 
 
+class HpScalarCache:
+    """Device-resident lr/wd/rescale_grad/clip_gradient scalars, rebuilt
+    only when the host-side optimizer values actually change — the async
+    pipeline's answer to re-`jnp.asarray`-ing four scalars every step.
+    `get(optimizer)` returns a fresh dict (caller adds the step counter
+    `t` itself).  Shared by `ShardedTrainStep._hp` and
+    `Trainer._fused_update` so the two paths cannot drift."""
+
+    def __init__(self):
+        self._key = None
+        self._dev = None
+
+    def get(self, optimizer) -> Dict[str, Any]:
+        cg = optimizer.clip_gradient
+        key = (float(optimizer.learning_rate), float(optimizer.wd),
+               float(optimizer.rescale_grad),
+               None if cg is None else float(cg))
+        if key != self._key:
+            self._dev = {
+                "lr": jnp.asarray(key[0], jnp.float32),
+                "wd": jnp.asarray(key[1], jnp.float32),
+                "rescale_grad": jnp.asarray(key[2], jnp.float32),
+                "clip_gradient": None if key[3] is None
+                else jnp.asarray(key[3], jnp.float32)}
+            self._key = key
+        return dict(self._dev)
+
+
 @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(1, 3))
 def tree_apply_update(update_fn, params, grads, states, hparams):
     """Apply `update_fn(param, grad, state, hparams) -> (new_param, new_state)`

@@ -22,7 +22,9 @@ from .ring_attention import ring_attention, ring_attention_sharded
 from .ulysses import ulysses_attention, ulysses_attention_sharded
 from .moe import MoEFeedForward, switch_moe
 from .pipeline import pipeline_apply, gpipe_sharded
-from .train import ShardedTrainStep, make_sharded_train_step
+from .train import ShardedTrainStep, StepHandle, make_sharded_train_step
+from .prefetch import (DevicePrefetcher, AsyncMetricBuffer,
+                       default_prefetch_depth)
 
 __all__ = [
     "make_mesh", "auto_mesh", "MeshConfig", "Mesh", "NamedSharding",
@@ -32,7 +34,8 @@ __all__ = [
     "ring_attention", "ring_attention_sharded", "ulysses_attention",
     "ulysses_attention_sharded", "MoEFeedForward", "switch_moe",
     "pipeline_apply", "gpipe_sharded",
-    "ShardedTrainStep",
+    "ShardedTrainStep", "StepHandle", "DevicePrefetcher",
+    "AsyncMetricBuffer", "default_prefetch_depth",
     "make_sharded_train_step", "initialize", "rank", "num_workers",
 ]
 

@@ -1,0 +1,276 @@
+"""Async input pipeline — the ThreadedEngine analogue for the JAX runtime.
+
+The reference overlaps H2D copies, compute, and host work through its
+dependency engine (`Engine::PushAsync`) plus the IO prefetcher
+(`src/io/iter_prefetcher.cc`: a background thread keeps a bounded buffer of
+decoded batches ahead of the consumer).  XLA already overlaps compute via
+async dispatch; what the host loop still serializes is (a) the H2D placement
+of every batch (`jax.device_put` / `make_array_from_callback` runs inline in
+the training loop) and (b) the D2H `float(loss)` fetch that blocks the host
+on the device every step.  Two pieces here remove both stalls:
+
+* :class:`DevicePrefetcher` — wraps any iterator/`DataLoader` and performs
+  device placement on a background thread with depth-N double buffering, so
+  batch *k+1* (and beyond) is already device-resident when the step for
+  batch *k* is dispatched.  Pair with ``ShardedTrainStep.place_batch`` to
+  land batches directly on their target `NamedSharding` (works on single-
+  and multi-process meshes — placement is addressable-shard-local).
+* :class:`AsyncMetricBuffer` — defers the per-step scalar fetch; losses
+  accumulate as async device scalars and are fetched in one batched
+  `device_get` every ``drain_every`` steps, keeping several steps in
+  flight between host syncs.
+
+Both are fault-aware: the prefetch thread passes through the
+``prefetch_next`` injection point (``MXTPU_FAULT_SPEC``, see
+`docs/resilience.md`), and any error — injected or real — tears the
+pipeline down cleanly and re-raises in the consumer (no hang, no batch
+buffers stranded in the queue).
+"""
+from __future__ import annotations
+
+import os
+import queue as _queue
+import threading
+import time
+from typing import Callable, Iterable, Optional
+
+import jax
+
+from ..base import MXNetError
+from ..resilience import fault_point
+
+__all__ = ["DevicePrefetcher", "AsyncMetricBuffer", "default_prefetch_depth"]
+
+ENV_DEPTH = "MXTPU_PREFETCH_DEPTH"
+
+
+def default_prefetch_depth() -> int:
+    """Depth-N double buffering default: ``MXTPU_PREFETCH_DEPTH`` (>= 1),
+    else 2 — one batch being consumed, one staged ahead."""
+    try:
+        depth = int(os.environ.get(ENV_DEPTH, "2"))
+    except ValueError:
+        depth = 2
+    return max(1, depth)
+
+
+class DevicePrefetcher:
+    """Iterate `source`, device-placing each batch on a background thread.
+
+    `place` maps one source item to its device-resident form; batches that
+    are tuples/lists are splatted (``place(*item)``), so
+    ``ShardedTrainStep.place_batch`` plugs in directly.  The default places
+    every leaf on the default device with `jax.device_put` (unwrapping
+    mx ndarrays).  The bounded queue (``depth``) gives backpressure: the
+    thread stays at most ``depth + 1`` batches ahead (``depth`` queued
+    plus the one it placed and is waiting to enqueue), so prefetch memory
+    is capped at ``(depth + 1) x batch_bytes`` on the device.
+
+    Iteration yields placed batches in source order.  An exception on the
+    prefetch thread (dataset bug, placement failure, injected fault) is
+    re-raised to the consumer on its next ``next()``; the thread and queue
+    are torn down first.  `close()` (also via context-manager exit) stops
+    the thread and drops buffered batches — safe to call mid-epoch.
+    """
+
+    def __init__(self, source: Iterable, place: Optional[Callable] = None,
+                 depth: Optional[int] = None, timeout: float = 120.0):
+        self._source = source
+        self._place = place if place is not None else self._default_place
+        self._depth = default_prefetch_depth() if depth is None else int(depth)
+        if self._depth < 1:
+            raise MXNetError(f"prefetch depth must be >= 1, got {self._depth}")
+        # timeout bounds each consumer wait: a wedged source raises instead
+        # of deadlocking the training loop (DataLoader timeout semantics)
+        self._timeout = timeout
+        self._q: _queue.Queue = _queue.Queue(maxsize=self._depth)
+        self._stop = threading.Event()
+        self._exhausted = False
+        # occupancy stats (read via stats()): how full the window was at
+        # each hand-out, and how long the consumer waited — the two numbers
+        # that say whether depth is too small (drained window, long waits)
+        self._occ_sum = 0
+        self._batches = 0
+        self._wait_s = 0.0
+        self._thread = threading.Thread(target=self._worker,
+                                        name="mxtpu-prefetch", daemon=True)
+        self._thread.start()
+
+    # -- producer (background thread) ----------------------------------
+    @staticmethod
+    def _default_place(*items):
+        placed = tuple(jax.device_put(getattr(b, "_data", b)) for b in items)
+        return placed if len(placed) != 1 else placed[0]
+
+    def _apply_place(self, item):
+        if isinstance(item, (tuple, list)):
+            return self._place(*item)
+        placed = self._place(item)
+        # a bare (non-tuple) source item comes back bare whatever the
+        # hook returns: ShardedTrainStep.place_batch always returns a
+        # tuple, and without the unwrap swapping it in would silently
+        # turn every yielded batch into a 1-tuple
+        if isinstance(placed, tuple) and len(placed) == 1:
+            return placed[0]
+        return placed
+
+    def _put(self, entry) -> bool:
+        """Stop-aware bounded put; False when closed mid-wait."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(entry, timeout=0.05)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def _worker(self):
+        try:
+            for item in self._source:
+                if self._stop.is_set():
+                    return
+                fault_point("prefetch_next")
+                # H2D overlap shows up in the XPlane trace under this span
+                with jax.profiler.TraceAnnotation("mxtpu.prefetch"):
+                    placed = self._apply_place(item)
+                if not self._put(("item", placed)):
+                    return
+            self._put(("end", None))
+        except BaseException as e:  # incl. FaultExit — consumer decides
+            self._put(("error", e))
+
+    # -- consumer -------------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._exhausted or self._stop.is_set():
+            raise StopIteration
+        t0 = time.perf_counter()
+        # wait in short slices so a close() from another thread (elastic
+        # shutdown, supervisor teardown) wakes this consumer promptly
+        # instead of stalling it for the full timeout
+        while True:
+            try:
+                kind, payload = self._q.get(timeout=0.05)
+                break
+            except _queue.Empty:
+                if self._stop.is_set():
+                    raise StopIteration
+                if time.perf_counter() - t0 > self._timeout:
+                    self.close()
+                    raise MXNetError(
+                        f"DevicePrefetcher: no batch arrived within "
+                        f"{self._timeout}s (source iterator or device "
+                        "placement is stuck); raise `timeout=` or debug "
+                        "the input pipeline")
+        self._wait_s += time.perf_counter() - t0
+        if kind == "item":
+            self._batches += 1
+            self._occ_sum += self._q.qsize()
+            return payload
+        self._exhausted = True
+        self.close()
+        if kind == "error":
+            raise payload
+        raise StopIteration
+
+    # -- lifecycle ------------------------------------------------------
+    def _drain_queue(self):
+        try:
+            while True:
+                self._q.get_nowait()
+        except _queue.Empty:
+            pass
+
+    def close(self, timeout: float = 5.0):
+        """Stop the prefetch thread and drop buffered batches. Idempotent;
+        never hangs: the producer's puts are stop-aware, and the queue is
+        drained so a blocked put wakes immediately.  Drained again AFTER
+        the join: a producer woken from its blocked put can deposit one
+        last batch after the first drain saw Empty — without the re-drain
+        that device buffer would stay pinned in the dead queue."""
+        self._stop.set()
+        self._drain_queue()
+        t = self._thread
+        if t is not threading.current_thread() and t.is_alive():
+            t.join(timeout)
+        self._drain_queue()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close(timeout=0.2)
+        except Exception:
+            pass
+
+    def stats(self) -> dict:
+        """Pipeline health: {'depth', 'batches', 'mean_occupancy',
+        'mean_wait_ms'}. mean_occupancy near 0 with long waits means the
+        source (not the consumer) is the bottleneck — raise depth or speed
+        up the loader; occupancy near depth means prefetch is ahead."""
+        n = max(1, self._batches)
+        return {
+            "depth": self._depth,
+            "batches": self._batches,
+            "mean_occupancy": round(self._occ_sum / n, 3),
+            "mean_wait_ms": round(self._wait_s * 1e3 / n, 3),
+        }
+
+
+class AsyncMetricBuffer:
+    """Deferred scalar-metric fetches: append async device scalars (or
+    ``StepHandle``s), fetch them in ONE batched `device_get` every
+    ``drain_every`` appends.  Between drains the host never blocks on the
+    device, so up to ``drain_every`` steps stay in flight — the reference's
+    ``metric.update`` every-k-batches idiom, made explicit.
+
+    ``values`` holds the fetched floats in append order; ``drain()`` forces
+    the fetch (call once after the loop).  ``max_in_flight`` records the
+    deepest the pipeline ran — the bench reports it as ``steps_in_flight``.
+    """
+
+    def __init__(self, drain_every: int = 8):
+        if drain_every < 1:
+            raise MXNetError(
+                f"drain_every must be >= 1, got {drain_every}")
+        self.drain_every = int(drain_every)
+        self._pending: list = []
+        self.values: list = []
+        self.max_in_flight = 0
+
+    def append(self, value):
+        self._pending.append(getattr(value, "loss", value))
+        if len(self._pending) > self.max_in_flight:
+            self.max_in_flight = len(self._pending)
+        if len(self._pending) >= self.drain_every:
+            self.drain()
+        return self
+
+    @property
+    def in_flight(self) -> int:
+        return len(self._pending)
+
+    def drain(self) -> list:
+        if self._pending:
+            fetched = jax.device_get(self._pending)
+            self.values.extend(float(v) for v in fetched)
+            self._pending.clear()
+        return self.values
+
+    def mean(self, last_n: Optional[int] = None) -> float:
+        vals = self.drain()
+        if last_n is not None:
+            vals = vals[-last_n:]
+        if not vals:
+            raise MXNetError("AsyncMetricBuffer.mean() on an empty buffer")
+        return sum(vals) / len(vals)
+
+    def __len__(self):
+        return len(self.values) + len(self._pending)

@@ -10,9 +10,11 @@ donated, so weights update in place — the `static_alloc` end-state.
 """
 from __future__ import annotations
 
+import collections
 import concurrent.futures as _cf
 import functools
 import threading
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -26,9 +28,11 @@ from ..base import MXNetError
 from ..gluon.block import Block, functional_call
 from ..gluon.parameter import Parameter
 from ..optimizer import Optimizer
+from ..ops.fused_optim import HpScalarCache
+from .. import profiler as _profiler
 from .sharding import ShardingRules, default_tp_rules
 
-__all__ = ["ShardedTrainStep", "make_sharded_train_step"]
+__all__ = ["ShardedTrainStep", "StepHandle", "make_sharded_train_step"]
 
 _log = logging.getLogger(__name__)
 
@@ -67,13 +71,22 @@ class ShardedTrainStep:
                  batch_specs: Optional[Tuple] = None,
                  num_model_args: Optional[int] = None,
                  grad_accum_dtype=jnp.float32, grad_accum: int = 1,
-                 zero: bool = False, fsdp: bool = False):
+                 zero: bool = False, fsdp: bool = False,
+                 donate: bool = True):
         # ZeRO stage 1: shard optimizer state over the 'dp' axis instead
         # of replicating it (params stay replicated; XLA inserts the
         # reduce-scatter/all-gather around the sharded update). Cuts
         # optimizer-state HBM by the dp degree — for Adam on bf16 weights
         # that's 4x the weight bytes saved per extra dp shard.
         self.zero = zero
+        # donate=True (default) updates weights in place — the
+        # static_alloc end-state, halving peak param+state HBM.  CPU
+        # caveat: the CPU runtime blocks a dispatch whose DONATED input is
+        # still the in-flight output of the previous step, serializing
+        # back-to-back dispatch()es; donate=False restores deep host-side
+        # pipelining there (at 2x transient param footprint) — the CPU
+        # overlap smoke uses it (docs/perf.md).
+        self.donate = donate
         # FSDP (ZeRO stage 3): ALSO shard the parameters themselves over
         # 'dp' (first free divisible dim); XLA all-gathers each weight
         # just-in-time at its use and keeps gradients reduce-scattered.
@@ -99,6 +112,19 @@ class ShardedTrainStep:
         self.batch_specs = batch_specs
         self._step_fn = None
         self._n_batch_args = None
+        self._build_lock = threading.Lock()
+        # async pipeline state: AOT-compiled executable (warmup()), trace
+        # counter + last-seen avals (retrace guard), device-resident
+        # hyperparameter cache, dispatch latencies, in-flight losses
+        self._exec = None
+        self._trace_count = 0
+        self._trace_avals = None
+        self._hp_cache = HpScalarCache()
+        self._t_dev = None
+        self._t_mirror = -1
+        self._dispatch_s = collections.deque(maxlen=1024)
+        self._inflight = collections.deque(maxlen=256)
+        self.compile_seconds = None
 
         params = {n: p for n, p in block.collect_params().items()
                   if p._data is not None}
@@ -245,7 +271,14 @@ class ShardedTrainStep:
         k = self.grad_accum
         accum_dtype = self.grad_accum_dtype
 
+        outer = self
+
         def step(pvals, opt_state, hp, key, *batch):
+            # this body runs once per TRACE of the jitted step — the hook
+            # counts compilations and warns (with the drifted avals) on a
+            # silent retrace, the dtype-drift failure mode noted below
+            outer._note_trace((pvals, opt_state, hp, key) + tuple(batch))
+
             def compute_loss(diff_vals, mkey, *mb):
                 pv = dict(pvals)
                 pv.update(diff_vals)
@@ -339,7 +372,7 @@ class ShardedTrainStep:
             step,
             in_shardings=(pspec, sspec, None, None) + batch_shardings,
             out_shardings=(pspec, sspec, repl),
-            donate_argnums=(0, 1))
+            donate_argnums=(0, 1) if self.donate else ())
 
     def _check_global_batch(self, batch_vals) -> None:
         """First-step guard: on a mesh spanning processes, assert every
@@ -362,37 +395,201 @@ class ShardedTrainStep:
                 "global batch first (or give every worker the same data "
                 "stream + global indices).")
 
-    # ------------------------------------------------------------------
+    # -- async step pipeline -------------------------------------------
+    # The reference hides per-step host latency behind its dependency
+    # engine (Engine::PushAsync).  Here the jitted step is already async
+    # on the device side; the pieces below remove the HOST serialization
+    # around it: batch placement moves to DevicePrefetcher threads
+    # (place_batch), hyperparameter scalars stay device-resident (_hp),
+    # dispatch() returns without fetching the loss, and warmup() AOT-
+    # compiles so step 1 (and, with MXTPU_COMPILE_CACHE, a restarted
+    # process) never trace-compiles inline.
+
+    def _note_trace(self, args) -> None:
+        """Runs at trace time (the step body is python-executed once per
+        jit compilation).  Counts traces; on any trace after the first,
+        warns with the argument avals that drifted — a silent retrace
+        re-pays compile AND breaks donation (see the dtype note in the
+        optimizer-update loop)."""
+        leaves = jax.tree_util.tree_flatten_with_path(args)[0]
+        avals = {
+            jax.tree_util.keystr(path): (
+                tuple(getattr(leaf, "shape", ())),
+                str(getattr(leaf, "dtype", type(leaf).__name__)))
+            for path, leaf in leaves}
+        prev, self._trace_avals = self._trace_avals, avals
+        self._trace_count += 1
+        if self._trace_count <= 1 or prev is None:
+            return
+        drift = [f"{k}: {prev[k][0]}/{prev[k][1]} -> {v[0]}/{v[1]}"
+                 for k, v in avals.items()
+                 if k in prev and prev[k] != v]
+        drift += [f"{k}: (new input)" for k in avals if k not in prev]
+        drift += [f"{k}: (dropped)" for k in prev if k not in avals]
+        _log.warning(
+            "ShardedTrainStep RETRACE #%d: the step function compiled "
+            "again (every retrace re-pays XLA compile and allocates a "
+            "second executable). Drifted avals (%d): %s",
+            self._trace_count, len(drift),
+            "; ".join(drift[:8]) + ("; ..." if len(drift) > 8 else "")
+            if drift else "<none — new static closure?>")
+
+    @property
+    def trace_count(self) -> int:
+        """How many times the step function has been traced/compiled.
+        Stays 1 for a healthy steady-state run (assert on it in tests)."""
+        return self._trace_count
+
+    def _prepare_batch(self, batch):
+        """Unwrap mx ndarrays, build the step on first use, and place every
+        batch arg on its target sharding — skipping the copy for args that
+        already sit there (a DevicePrefetcher hand-off)."""
+        batch_vals = [b._data if hasattr(b, "_data")
+                      else b if isinstance(b, jax.Array)
+                      else onp.asarray(b)
+                      for b in batch]
+        if self._step_fn is None:
+            with self._build_lock:
+                if self._step_fn is None:
+                    self._build(batch_vals, None)
+                    self._check_global_batch(batch_vals)
+        return [b if isinstance(b, jax.Array) and b.sharding == s
+                else _put_global(b, s)
+                for b, s in zip(batch_vals, self._batch_shardings)]
+
+    def place_batch(self, *batch):
+        """Device-place one batch onto the step's batch shardings (built
+        from this batch if needed).  This is the `place=` hook for
+        `DevicePrefetcher`: calling it on the prefetch thread moves the
+        H2D copy off the training loop; `dispatch`/`__call__` then detect
+        the placement and skip their own copy."""
+        return tuple(self._prepare_batch(batch))
+
+    def _hp(self):
+        """Device-resident hyperparameter scalars (shared `HpScalarCache`:
+        lr/wd/rescale/clip uploads happen only when the host-side values
+        actually change, instead of five H2D transfers per step); the
+        step counter `t` advances by a device-side add, so steady-state
+        dispatch enqueues zero transfers.  A checkpoint load (or external
+        _t rewrite) makes the mirror mismatch and forces a host rebuild."""
+        hp = self._hp_cache.get(self.optimizer)
+        if self._t_dev is not None and self._t_mirror == self._t:
+            pass  # same step (repeated warmup) — reuse
+        elif self._t_dev is not None and self._t_mirror + 1 == self._t \
+                and self._t % self._T_HOST_REFRESH:
+            # device-side increment; periodically re-seeded from the host
+            # counter because f32 `x + 1.0` saturates at 2**24 — a pure
+            # device chain would silently freeze t on very long runs
+            self._t_dev = self._t_dev + 1.0
+        else:
+            self._t_dev = jnp.asarray(self._t, jnp.float32)
+        self._t_mirror = self._t
+        hp["t"] = self._t_dev
+        return hp
+
+    # re-upload `t` from the host every this many steps (guards the f32
+    # device-add saturation at 2**24; one tiny H2D per window otherwise)
+    _T_HOST_REFRESH = 4096
+
+    def warmup(self, *batch, rng_key=None):
+        """AOT warm start: trace + compile the step for this batch's avals
+        WITHOUT executing it (`.lower().compile()`), so the first real
+        step runs at steady-state speed.  With ``MXTPU_COMPILE_CACHE`` set
+        (see `runtime.enable_compile_cache`) the XLA binary is served from
+        the persistent cache on a restart — the multi-minute BERT compile
+        happens once per cluster, not once per process.  Returns the
+        compile wall-time in seconds (also kept as `compile_seconds`).
+
+        Does not consume an RNG draw: the key is only used for its aval."""
+        batch_vals = self._prepare_batch(batch)
+        hp = self._hp()
+        key = rng_key if rng_key is not None else jax.random.PRNGKey(0)
+        args = (self.pvals, self.opt_state, hp, key) + tuple(batch_vals)
+        avals = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), args)
+        t0 = time.perf_counter()
+        self._exec = self._step_fn.lower(*avals).compile()
+        self.compile_seconds = time.perf_counter() - t0
+        return self.compile_seconds
+
+    def dispatch(self, *batch, rng_key=None) -> "StepHandle":
+        """Non-blocking step: enqueue forward+backward+update and return a
+        `StepHandle` whose `.loss` is the still-async device scalar —
+        `float()`/`.result()` blocks, `AsyncMetricBuffer` defers the fetch
+        so multiple steps stay in flight.  The step boundary is marked
+        with `jax.profiler.StepTraceAnnotation`, so Perfetto/TensorBoard
+        segment the XPlane trace per step and show prefetch overlap."""
+        from .. import random as _rng
+        t0 = time.perf_counter()
+        batch_vals = self._prepare_batch(batch)
+        self._t += 1
+        hp = self._hp()
+        key = rng_key if rng_key is not None else _rng.next_key()
+        with _profiler.step_annotation("mxtpu.train_step", step_num=self._t):
+            if self._exec is not None:
+                try:
+                    out = self._exec(self.pvals, self.opt_state, hp, key,
+                                     *batch_vals)
+                except TypeError as e:
+                    # aval drift vs the AOT executable: fall back to the
+                    # jit path (which retraces — _note_trace warns with
+                    # the diff). Input buffers are intact: the AOT call
+                    # validates avals before launching, so donation has
+                    # not consumed them yet.
+                    _log.warning(
+                        "AOT-compiled step rejected inputs (%s); falling "
+                        "back to jit", str(e).splitlines()[0])
+                    self._exec = None
+                    out = self._step_fn(self.pvals, self.opt_state, hp,
+                                        key, *batch_vals)
+            else:
+                out = self._step_fn(self.pvals, self.opt_state, hp, key,
+                                    *batch_vals)
+        self.pvals, self.opt_state, loss = out
+        # rebind block Parameters to the fresh (non-donated) buffers so
+        # eager reads (p.data()) stay valid — pointer update only
+        self.sync_params_to_block()
+        dt = time.perf_counter() - t0
+        self._dispatch_s.append(dt)
+        self._inflight.append(loss)
+        return StepHandle(loss, self._t, dt)
+
+    def steps_in_flight(self) -> int:
+        """Dispatched steps whose loss has not yet landed on the host —
+        non-blocking (`jax.Array.is_ready`), pruning finished entries."""
+        q = self._inflight
+        while q:
+            try:
+                ready = bool(q[0].is_ready())
+            except Exception:
+                ready = True
+            if not ready:
+                break
+            q.popleft()
+        return len(q)
+
+    def dispatch_stats(self) -> dict:
+        """Host-side dispatch latency over the last <=1024 steps: the time
+        the training loop spent per `dispatch()` call (NOT device step
+        time — overlap is working when this is far below step time)."""
+        d = list(self._dispatch_s)
+        if not d:
+            return {"dispatches": 0, "mean_ms": 0.0, "max_ms": 0.0}
+        return {"dispatches": len(d),
+                "mean_ms": round(sum(d) * 1e3 / len(d), 4),
+                "max_ms": round(max(d) * 1e3, 4)}
+
     def __call__(self, *batch, rng_key=None):
-        """Run one step; returns the (replicated) scalar loss as jax array.
+        """Run one step; returns the (replicated) scalar loss as jax array
+        (async — `float(loss)` blocks; prefer `dispatch()` +
+        `AsyncMetricBuffer` in throughput loops).
 
         Multi-process meshes: every process must pass the identical GLOBAL
         batch (each contributes its addressable shards — see `_put_global`);
         the first step cross-checks this so the per-host-shard habit from
         the reference's KVStore path fails loudly instead of training on a
         silent patchwork of half-dropped data."""
-        from .. import random as _rng
-        batch_vals = [b._data if hasattr(b, "_data") else jnp.asarray(b)
-                      for b in batch]
-        if self._step_fn is None:
-            self._build(batch_vals, rng_key)
-            self._check_global_batch(batch_vals)
-        self._t += 1
-        o = self.optimizer
-        hp = {"lr": jnp.asarray(o.learning_rate, jnp.float32),
-              "wd": jnp.asarray(o.wd, jnp.float32),
-              "rescale_grad": jnp.asarray(o.rescale_grad, jnp.float32),
-              "clip_gradient": o.clip_gradient,
-              "t": jnp.asarray(self._t, jnp.float32)}
-        key = rng_key if rng_key is not None else _rng.next_key()
-        batch_vals = [_put_global(b, s)
-                      for b, s in zip(batch_vals, self._batch_shardings)]
-        self.pvals, self.opt_state, loss = self._step_fn(
-            self.pvals, self.opt_state, hp, key, *batch_vals)
-        # rebind block Parameters to the fresh (non-donated) buffers so
-        # eager reads (p.data()) stay valid — pointer update only
-        self.sync_params_to_block()
-        return loss
+        return self.dispatch(*batch, rng_key=rng_key).loss
 
     def sync_params_to_block(self):
         """Write the (sharded) trained values back into the Parameters."""
@@ -568,6 +765,36 @@ class ShardedTrainStep:
         self.sync_params_to_block()
 
 
+class StepHandle:
+    """Async result of `ShardedTrainStep.dispatch`.
+
+    `loss` is the not-yet-fetched replicated device scalar; `step` the
+    1-based step index; `dispatch_s` the host time the dispatch call took.
+    `result()` blocks and returns the float; `is_ready()` polls without
+    blocking.  Feed handles straight into `AsyncMetricBuffer.append`.
+    """
+
+    __slots__ = ("loss", "step", "dispatch_s")
+
+    def __init__(self, loss, step: int, dispatch_s: float):
+        self.loss = loss
+        self.step = step
+        self.dispatch_s = dispatch_s
+
+    def is_ready(self) -> bool:
+        try:
+            return bool(self.loss.is_ready())
+        except AttributeError:
+            return True
+
+    def result(self) -> float:
+        return float(jax.device_get(self.loss))
+
+    def __repr__(self):
+        return (f"StepHandle(step={self.step}, "
+                f"dispatch_ms={self.dispatch_s * 1e3:.3f})")
+
+
 class _ObservedFuture(_cf.Future):
     """Future that records whether its exception was ever retrieved
     (`result()` raised it or `exception()` returned it).  Lets
@@ -686,7 +913,7 @@ def _like_sharding(param_sharding: NamedSharding, state_leaf, param):
 def make_sharded_train_step(block, optimizer, loss_fn, mesh, rules=None,
                             batch_specs=None, num_model_args=None,
                             zero=False, fsdp=False,
-                            grad_accum=1) -> ShardedTrainStep:
+                            grad_accum=1, donate=True) -> ShardedTrainStep:
     return ShardedTrainStep(block, optimizer, loss_fn, mesh, rules,
                             batch_specs, num_model_args, zero=zero,
-                            fsdp=fsdp, grad_accum=grad_accum)
+                            fsdp=fsdp, grad_accum=grad_accum, donate=donate)

@@ -25,7 +25,23 @@ import jax
 __all__ = [
     "set_config", "start", "stop", "pause", "resume", "dump", "dumps",
     "state", "scope", "Task", "Frame", "Event", "Counter", "Marker",
+    "step_annotation",
 ]
+
+
+def step_annotation(name: str = "train", step_num: Optional[int] = None):
+    """Step-boundary marker for the XPlane trace (the engine-profiler's
+    per-iteration spans, TPU-native): wraps
+    `jax.profiler.StepTraceAnnotation`, which TensorBoard/Perfetto use to
+    segment the timeline into steps and derive step time and input-
+    pipeline (prefetch) overlap.  `ShardedTrainStep.dispatch` wraps every
+    step in one; use directly around custom loops:
+
+        with mx.profiler.step_annotation("train", step_num=i):
+            loss = step.dispatch(*batch)
+
+    Cheap when no trace is active — safe to leave on every step."""
+    return jax.profiler.StepTraceAnnotation(name, step_num=step_num)
 
 _config = {"profile_all": False, "filename": "profile_output",
            "aggregate_stats": False, "running": False}

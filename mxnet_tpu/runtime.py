@@ -1,12 +1,18 @@
 """Runtime feature detection (parity: `python/mxnet/runtime.py` over
-`include/mxnet/libinfo.h:132-213`)."""
+`include/mxnet/libinfo.h:132-213`) plus compile-cache warm starts."""
 from __future__ import annotations
 
+import logging
+import os
 from collections import namedtuple
+from typing import Optional
 
 import jax
 
-__all__ = ["Features", "feature_list", "libinfo_features"]
+__all__ = ["Features", "feature_list", "libinfo_features",
+           "enable_compile_cache", "compile_cache_dir"]
+
+_log = logging.getLogger(__name__)
 
 Feature = namedtuple("Feature", ["name", "enabled"])
 
@@ -57,3 +63,60 @@ def feature_list():
 
 
 libinfo_features = feature_list
+
+
+# ---------------------------------------------------------------------------
+# Compile-cache warm starts
+# ---------------------------------------------------------------------------
+# XLA compiles of a full train step run minutes at BERT/GPT scale, and the
+# reference never pays them (its graphs are interpreted per-op).  JAX's
+# persistent compilation cache keys executables by HLO + compile options +
+# backend, so a restarted (or elastically rescheduled) process re-loads the
+# binary instead of recompiling — the warm-start half of the async pipeline
+# (`ShardedTrainStep.warmup` is the AOT half).  Activated automatically at
+# import when ``MXTPU_COMPILE_CACHE`` names a directory (docs/env_vars.md).
+
+_cache_dir: Optional[str] = None
+
+
+def enable_compile_cache(path: Optional[str] = None) -> Optional[str]:
+    """Point JAX's persistent compilation cache at `path` (default: the
+    ``MXTPU_COMPILE_CACHE`` env var).  Every entry is cached regardless of
+    size or compile time — a train step that took 0.3 s to compile still
+    costs a retrace-stall when it recompiles inline at step 1.  Returns
+    the resolved directory, or None when unset.  Safe to call repeatedly;
+    a shared filesystem path warms every host of a multi-process mesh."""
+    global _cache_dir
+    path = path or os.environ.get("MXTPU_COMPILE_CACHE")
+    if not path:
+        return None
+    path = os.path.abspath(os.path.expanduser(path))
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+    except Exception as e:  # unknown option on an older jax: degrade loudly
+        _log.warning("compile cache disabled (%s: %s)", type(e).__name__, e)
+        return None
+    # cache unconditionally: the defaults skip small/fast programs, which
+    # is exactly wrong for a step fn re-verified on every restart.  Tried
+    # SEPARATELY from the dir update above: once the dir is set the cache
+    # IS active, so a jax without these tunables must still report
+    # enabled (with its default thresholds), not pretend it is off.
+    for opt, val in (("jax_persistent_cache_min_entry_size_bytes", -1),
+                     ("jax_persistent_cache_min_compile_time_secs", 0.0)):
+        try:
+            jax.config.update(opt, val)
+        except Exception as e:
+            _log.warning("compile cache: %s unavailable (%s) — cache "
+                         "active with the jax default", opt, e)
+    _cache_dir = path
+    return path
+
+
+def compile_cache_dir() -> Optional[str]:
+    """The active persistent-compile-cache directory, or None."""
+    return _cache_dir
+
+
+if os.environ.get("MXTPU_COMPILE_CACHE"):
+    enable_compile_cache()

@@ -1,0 +1,199 @@
+"""DevicePrefetcher + AsyncMetricBuffer: async input pipeline semantics,
+fault-injected teardown (MXTPU_FAULT_SPEC reuse), and DataLoader interop."""
+import time
+
+import numpy as onp
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.parallel.prefetch import (AsyncMetricBuffer, DevicePrefetcher,
+                                         default_prefetch_depth)
+from mxnet_tpu.resilience import ENV_VAR, FaultInjected
+
+
+def _batches(n, dim=3):
+    for i in range(n):
+        yield (onp.full((2, dim), i, onp.float32),
+               onp.full((2,), i, onp.float32))
+
+
+def test_prefetcher_preserves_order_and_values():
+    got = list(DevicePrefetcher(_batches(6)))
+    assert len(got) == 6
+    for i, (x, y) in enumerate(got):
+        assert isinstance(x, jax.Array) and isinstance(y, jax.Array)
+        assert onp.all(onp.asarray(x) == i) and onp.all(onp.asarray(y) == i)
+
+
+def test_prefetcher_single_item_batches_and_ndarray_unwrap():
+    src = (mx.np.array(onp.full((2, 2), i, onp.float32)) for i in range(3))
+    got = list(DevicePrefetcher(src))
+    assert len(got) == 3
+    # single-element batches come back unwrapped, as device arrays
+    assert isinstance(got[1], jax.Array)
+    assert onp.all(onp.asarray(got[1]) == 1)
+
+
+def test_prefetcher_depth_backpressure():
+    """The producer stays at most depth batches ahead of the consumer."""
+    pulled = []
+
+    def src():
+        for i in range(50):
+            pulled.append(i)
+            yield onp.zeros((1,), onp.float32)
+
+    pf = DevicePrefetcher(src(), depth=2)
+    try:
+        next(pf)  # consume one
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline and len(pulled) < 4:
+            time.sleep(0.01)
+        time.sleep(0.1)  # would-be overshoot window
+        # 1 handed out + 2 buffered + at most 1 in the producer's hands
+        assert len(pulled) <= 4
+    finally:
+        pf.close()
+    assert not pf._thread.is_alive()
+
+
+def test_prefetcher_error_propagates_and_tears_down():
+    def src():
+        yield onp.zeros((1,), onp.float32)
+        raise ValueError("decode exploded")
+
+    pf = DevicePrefetcher(src())
+    next(pf)
+    with pytest.raises(ValueError, match="decode exploded"):
+        next(pf)
+    assert not pf._thread.is_alive()
+    # iterator stays closed, no hang
+    with pytest.raises(StopIteration):
+        next(pf)
+
+
+@pytest.mark.fault
+def test_prefetcher_fault_injection_kills_thread_cleanly(monkeypatch):
+    """PR-1 fault registry reuse: arm the prefetch thread's injection
+    point, assert error propagation + clean teardown (no hang, no batch
+    left in the queue)."""
+    monkeypatch.setenv(ENV_VAR, "prefetch_next@3")
+    pf = DevicePrefetcher(_batches(10), depth=2)
+    got = []
+    with pytest.raises(FaultInjected):
+        for b in pf:
+            got.append(b)
+    assert len(got) == 2  # batches 1-2 delivered, fault on the 3rd pull
+    pf._thread.join(timeout=5)
+    assert not pf._thread.is_alive()
+    assert pf._q.qsize() == 0  # no leaked batch buffers
+    with pytest.raises(StopIteration):
+        next(pf)
+
+
+def test_prefetcher_close_midway_unblocks_producer():
+    """close() mid-epoch must wake a producer blocked on the full queue."""
+    pf = DevicePrefetcher(_batches(100), depth=1)
+    next(pf)
+    pf.close()
+    pf._thread.join(timeout=5)
+    assert not pf._thread.is_alive()
+    with pytest.raises(StopIteration):
+        next(pf)
+
+
+def test_prefetcher_context_manager_and_stats():
+    with DevicePrefetcher(_batches(4), depth=3) as pf:
+        n = sum(1 for _ in pf)
+    assert n == 4
+    st = pf.stats()
+    assert st["depth"] == 3 and st["batches"] == 4
+    assert st["mean_occupancy"] >= 0.0 and st["mean_wait_ms"] >= 0.0
+    assert not pf._thread.is_alive()
+
+
+def test_prefetcher_close_from_other_thread_wakes_blocked_consumer():
+    """close() during a blocked next() (elastic shutdown) must stop the
+    consumer promptly with StopIteration — not stall out the timeout."""
+    import threading
+
+    def hung():
+        yield onp.zeros((1,), onp.float32)
+        time.sleep(60)
+
+    pf = DevicePrefetcher(hung(), timeout=30.0)
+    next(pf)
+    threading.Timer(0.3, pf.close).start()
+    t0 = time.monotonic()
+    with pytest.raises(StopIteration):
+        next(pf)
+    assert time.monotonic() - t0 < 5.0  # woke on close, not on timeout
+
+
+def test_prefetcher_consumer_timeout_raises():
+    def hung():
+        yield onp.zeros((1,), onp.float32)
+        time.sleep(60)
+
+    pf = DevicePrefetcher(hung(), timeout=0.3)
+    next(pf)
+    with pytest.raises(MXNetError, match="no batch arrived"):
+        next(pf)
+
+
+def test_default_depth_env(monkeypatch):
+    monkeypatch.delenv("MXTPU_PREFETCH_DEPTH", raising=False)
+    assert default_prefetch_depth() == 2
+    monkeypatch.setenv("MXTPU_PREFETCH_DEPTH", "5")
+    assert default_prefetch_depth() == 5
+    monkeypatch.setenv("MXTPU_PREFETCH_DEPTH", "0")
+    assert default_prefetch_depth() == 1  # floored
+    monkeypatch.setenv("MXTPU_PREFETCH_DEPTH", "junk")
+    assert default_prefetch_depth() == 2
+    pf = DevicePrefetcher(_batches(1))
+    assert pf._depth == 2
+    pf.close()
+
+
+def test_prefetcher_wraps_dataloader():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    xs = onp.arange(24, dtype=onp.float32).reshape(12, 2)
+    ys = onp.arange(12, dtype=onp.float32)
+    loader = DataLoader(ArrayDataset(xs, ys), batch_size=4, num_workers=2)
+    seen = 0
+    with DevicePrefetcher(iter(loader)) as pf:
+        for xb, yb in pf:
+            assert isinstance(xb, jax.Array)
+            assert xb.shape == (4, 2) and yb.shape == (4,)
+            seen += 1
+    assert seen == 3
+
+
+def test_async_metric_buffer_drains_every_k():
+    import jax.numpy as jnp
+    buf = AsyncMetricBuffer(drain_every=4)
+    for i in range(10):
+        buf.append(jnp.asarray(float(i)))
+    assert buf.max_in_flight == 4
+    assert len(buf.values) == 8  # two drains happened
+    assert buf.in_flight == 2
+    vals = buf.drain()
+    assert vals == [float(i) for i in range(10)]
+    assert buf.mean() == pytest.approx(4.5)
+    assert buf.mean(last_n=2) == pytest.approx(8.5)
+    with pytest.raises(MXNetError):
+        AsyncMetricBuffer(drain_every=0)
+
+
+def test_async_metric_buffer_accepts_step_handles():
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel import StepHandle
+    buf = AsyncMetricBuffer(drain_every=100)
+    h = StepHandle(jnp.asarray(2.5), step=1, dispatch_s=0.001)
+    buf.append(h)
+    assert h.result() == pytest.approx(2.5)
+    assert h.is_ready()
+    assert buf.drain() == [2.5]
