@@ -2,7 +2,7 @@
 # to what a single-language-core framework needs).
 PY ?= python
 
-.PHONY: ci test test-all test-dist test-parity lint bench cpp docs clean opperf-check
+.PHONY: ci test test-all test-dist test-parity lint bench cpp docs clean opperf-check telemetry-smoke
 
 # the one-command gate CI runs (VERDICT round-2 next-step #7): lint +
 # unit suite + 2-process dist tests + C++ package build/tests
@@ -44,6 +44,12 @@ lint:
 
 bench:
 	$(PY) bench.py
+
+# 5-step CPU training loop with the telemetry registry + run journal
+# enabled; asserts the Prometheus exposition parses (pure-stdlib check,
+# docs/observability.md)
+telemetry-smoke:
+	$(PY) tools/telemetry_smoke.py
 
 cpp:
 	cmake -S cpp-package -B cpp-package/build && \

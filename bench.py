@@ -54,6 +54,16 @@ def _measure(platform: str) -> dict:
     from mxnet_tpu.models.bert import BertConfig, BertForPretraining
     from mxnet_tpu.parallel import make_mesh, make_sharded_train_step
 
+    # --telemetry (env MXTPU_TELEMETRY): instrumentation was auto-enabled
+    # at import; attach a run journal BEFORE the measured loop so step/
+    # compile events land somewhere inspectable (docs/observability.md)
+    from mxnet_tpu import telemetry as _tele
+    telemetry_on = _tele.enabled()
+    if telemetry_on and _tele.journal() is None:
+        _tele.enable(journal_path=os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "bench_results",
+            f"telemetry_journal_{os.getpid()}.jsonl"))
+
     dev = jax.devices()[0]
     on_accel = dev.platform.lower() != "cpu"
 
@@ -189,6 +199,10 @@ def _measure(platform: str) -> dict:
         "compile_seconds": round(compile_s, 2),
         "prefetch": pipe["prefetch"],
     }
+    if telemetry_on:
+        extras["telemetry"] = {"journal": getattr(_tele.journal(), "path",
+                                                  None),
+                               "snapshot": _tele.snapshot()}
     if dev.platform.lower() != "tpu":
         # no MFU on the fallback: a CPU-throughput / TPU-peak ratio is a
         # meaningless number (VERDICT r3 weak #6) — report throughput only
@@ -372,6 +386,11 @@ class _ClaimLock:
 
 
 def main():
+    if "--telemetry" in sys.argv:
+        # flag travels to the measurement child through the environment
+        # (which also auto-enables instrumentation at mxnet_tpu import)
+        sys.argv.remove("--telemetry")
+        os.environ["MXTPU_TELEMETRY"] = "1"
     if len(sys.argv) >= 3 and sys.argv[1] == "--measure":
         print(json.dumps(_measure(sys.argv[2])))
         return

@@ -45,6 +45,8 @@ from typing import Any, Callable, List
 
 import numpy as _onp
 
+from ... import telemetry as _tele
+
 __all__ = ["ProcessPool"]
 
 _log = logging.getLogger(__name__)
@@ -326,6 +328,13 @@ class ProcessPool:
                     f"num_workers) or a crashing native transform.")
             if resubmit:
                 self._respawns_left -= 1
+            if _tele.enabled():
+                _tele.counter(
+                    "dataloader_worker_deaths",
+                    "DataLoader worker processes that died (OOM kill, "
+                    "crash, injected fault)").inc()
+                _tele.event("worker_death", worker=w.idx, pid=w.proc.pid,
+                            exit_code=code, lost_batches=lost)
             _log.warning(
                 "DataLoader worker %d (pid %s) died with exit code %s; "
                 "respawning (%s batches %s; %d/%d respawns left)",
@@ -334,6 +343,14 @@ class ProcessPool:
                 self._respawns_left, self._max_respawns)
             neww = self._spawn(w.idx)
             self._workers[slot] = neww
+            if _tele.enabled():
+                _tele.counter(
+                    "dataloader_respawns",
+                    "Dead DataLoader workers transparently respawned"
+                ).inc()
+                _tele.event("worker_respawn", worker=w.idx,
+                            pid=neww.proc.pid,
+                            resubmitted=lost if resubmit else [])
             for bid in lost:
                 if resubmit:
                     self._owner[bid] = neww
@@ -401,7 +418,8 @@ class ProcessPool:
         from ...base import MXNetError
         self._skip_failed()
         want = self._next_yield
-        deadline = time.monotonic() + timeout
+        t_start = time.monotonic()
+        deadline = t_start + timeout
         while want not in self._reorder:
             try:
                 item = self._data_q.get(timeout=min(_POLL, timeout))
@@ -426,6 +444,11 @@ class ProcessPool:
             deadline = time.monotonic() + timeout
         tree = self._reorder.pop(want)
         self._next_yield += 1
+        if _tele.enabled():
+            _tele.histogram(
+                "dataloader_batch_wait_ms",
+                "Host wait for the next in-order DataLoader batch (ms)"
+            ).observe((time.monotonic() - t_start) * 1e3)
         return _map_arrays(tree, to_array)
 
     def _discard(self, spec) -> None:

@@ -22,12 +22,14 @@ because Python-side decode is GIL-bound. Two worker modes here:
 """
 from __future__ import annotations
 
+import time as _time
 from concurrent.futures import ThreadPoolExecutor
 from concurrent.futures import TimeoutError as FuturesTimeoutError
 from typing import Callable, Optional
 
 import numpy as _onp
 
+from ... import telemetry as _tele
 from ...base import MXNetError
 from ...device import Device
 from ...ndarray.ndarray import ndarray
@@ -155,7 +157,15 @@ class DataLoader:
             while len(queue) < self._prefetch and submit():
                 pass
             try:
-                yield fut.result(timeout=self._timeout)
+                t0 = _time.perf_counter()
+                batch = fut.result(timeout=self._timeout)
+                if _tele.enabled():
+                    _tele.histogram(
+                        "dataloader_batch_wait_ms",
+                        "Host wait for the next in-order DataLoader "
+                        "batch (ms)"
+                    ).observe((_time.perf_counter() - t0) * 1e3)
+                yield batch
             except FuturesTimeoutError:
                 raise MXNetError(
                     f"DataLoader worker batch timed out after "

@@ -38,6 +38,7 @@ import jax
 
 from ..base import MXNetError
 from ..resilience import fault_point
+from .. import telemetry as _tele
 
 __all__ = ["DevicePrefetcher", "AsyncMetricBuffer", "default_prefetch_depth"]
 
@@ -164,10 +165,22 @@ class DevicePrefetcher:
                         f"{self._timeout}s (source iterator or device "
                         "placement is stuck); raise `timeout=` or debug "
                         "the input pipeline")
-        self._wait_s += time.perf_counter() - t0
+        wait = time.perf_counter() - t0
+        self._wait_s += wait
         if kind == "item":
             self._batches += 1
-            self._occ_sum += self._q.qsize()
+            occ = self._q.qsize()
+            self._occ_sum += occ
+            if _tele.enabled():
+                _tele.histogram(
+                    "prefetch_wait_ms",
+                    "Consumer wait per prefetched batch (ms); long waits "
+                    "with low occupancy mean the source is the bottleneck"
+                ).observe(wait * 1e3)
+                _tele.gauge(
+                    "prefetch_occupancy",
+                    "Prefetch queue depth at hand-out (near depth = "
+                    "prefetch is ahead)").set(occ)
             return payload
         self._exhausted = True
         self.close()

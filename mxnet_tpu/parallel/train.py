@@ -30,6 +30,7 @@ from ..gluon.parameter import Parameter
 from ..optimizer import Optimizer
 from ..ops.fused_optim import HpScalarCache
 from .. import profiler as _profiler
+from .. import telemetry as _tele
 from .sharding import ShardingRules, default_tp_rules
 
 __all__ = ["ShardedTrainStep", "StepHandle", "make_sharded_train_step"]
@@ -419,6 +420,13 @@ class ShardedTrainStep:
             for path, leaf in leaves}
         prev, self._trace_avals = self._trace_avals, avals
         self._trace_count += 1
+        if _tele.enabled():
+            _tele.counter(
+                "trace_count",
+                "Step-function traces/compilations (1 = healthy "
+                "steady state)").inc()
+            _tele.event("compile", step=self._t,
+                        trace_count=self._trace_count)
         if self._trace_count <= 1 or prev is None:
             return
         drift = [f"{k}: {prev[k][0]}/{prev[k][1]} -> {v[0]}/{v[1]}"
@@ -426,6 +434,10 @@ class ShardedTrainStep:
                  if k in prev and prev[k] != v]
         drift += [f"{k}: (new input)" for k in avals if k not in prev]
         drift += [f"{k}: (dropped)" for k in prev if k not in avals]
+        if _tele.enabled():
+            _tele.event("retrace", step=self._t,
+                        trace_count=self._trace_count,
+                        drift=drift[:8])
         _log.warning(
             "ShardedTrainStep RETRACE #%d: the step function compiled "
             "again (every retrace re-pays XLA compile and allocates a "
@@ -507,9 +519,14 @@ class ShardedTrainStep:
         args = (self.pvals, self.opt_state, hp, key) + tuple(batch_vals)
         avals = jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), args)
+        if _tele.enabled():
+            _tele.event("compile_start", step=self._t, kind="aot_warmup")
         t0 = time.perf_counter()
         self._exec = self._step_fn.lower(*avals).compile()
         self.compile_seconds = time.perf_counter() - t0
+        if _tele.enabled():
+            _tele.event("compile_end", step=self._t, kind="aot_warmup",
+                        seconds=round(self.compile_seconds, 4))
         return self.compile_seconds
 
     def dispatch(self, *batch, rng_key=None) -> "StepHandle":
@@ -551,7 +568,19 @@ class ShardedTrainStep:
         self.sync_params_to_block()
         dt = time.perf_counter() - t0
         self._dispatch_s.append(dt)
-        self._inflight.append(loss)
+        self._inflight.append((self._t, loss))
+        if _tele.enabled():
+            _tele.histogram(
+                "step_dispatch_ms",
+                "Host time per dispatch() call (not device step time; "
+                "overlap works when this sits far below step time)"
+            ).observe(dt * 1e3)
+            _tele.event("step_dispatched", step=self._t,
+                        dispatch_ms=round(dt * 1e3, 3))
+            _tele.gauge(
+                "steps_in_flight",
+                "Dispatched steps whose loss has not landed on the host"
+            ).set(self.steps_in_flight())
         return StepHandle(loss, self._t, dt)
 
     def steps_in_flight(self) -> int:
@@ -559,13 +588,16 @@ class ShardedTrainStep:
         non-blocking (`jax.Array.is_ready`), pruning finished entries."""
         q = self._inflight
         while q:
+            step_id, loss = q[0]
             try:
-                ready = bool(q[0].is_ready())
+                ready = bool(loss.is_ready())
             except Exception:
                 ready = True
             if not ready:
                 break
             q.popleft()
+            if _tele.enabled():
+                _tele.event("step_retired", step=step_id)
         return len(q)
 
     def dispatch_stats(self) -> dict:

@@ -121,8 +121,12 @@ def stop():
 
 
 def pause(profile_process="worker"):
-    """Temporarily stop collecting aggregate stats (trace keeps running)."""
-    _ndarray_module()._op_profile_hook = None
+    """Temporarily stop collecting aggregate stats (trace keeps running).
+    No-op when the profiler isn't running: a pause() before start() (a
+    worker pausing around its own setup, say) must not clobber the hook
+    state a later start() installs."""
+    if _config.get("running"):
+        _ndarray_module()._op_profile_hook = None
 
 
 def resume(profile_process="worker"):
@@ -263,8 +267,11 @@ class Counter:
         self.set_value(value)
 
     def set_value(self, value):
+        # recorded unconditionally (not gated on `running`): a counter set
+        # before start() would otherwise be silently dropped, and dumps()
+        # after a late start() would miss it. dumps(reset=True) clears.
         self.value = value
-        if _config.get("running"):
+        with _agg_lock:
             _counters[self.name] = value
 
     def increment(self, delta=1):

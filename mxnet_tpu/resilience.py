@@ -176,6 +176,21 @@ class FaultRegistry:
         action = self._plan.get(name, {}).pop(n, None)
         if action is None:
             return
+        # telemetry is imported lazily HERE (armed-and-firing is the rare
+        # path) so worker startup never pays for it; it is stdlib-only but
+        # spawned workers should import the bare minimum
+        try:
+            from . import telemetry as _tele
+            if _tele.enabled():
+                _tele.counter(
+                    "fault_triggers",
+                    "Armed fault-injection points that fired",
+                    labelnames=("point",)).inc(point=name)
+                _tele.event(
+                    "fault_trigger", point=name, hit=n,
+                    action="exit" if action == "exit" else action.__name__)
+        except Exception:  # telemetry must never mask the injected fault
+            pass
         if action == "exit":
             _log.error("fault injection: exit requested at point %r "
                        "(hit %d)", name, n)

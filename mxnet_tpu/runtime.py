@@ -109,6 +109,10 @@ def enable_compile_cache(path: Optional[str] = None) -> Optional[str]:
         except Exception as e:
             _log.warning("compile cache: %s unavailable (%s) — cache "
                          "active with the jax default", opt, e)
+    # hit/miss counters ride jax.monitoring events; the listener is a
+    # no-op until telemetry is enabled (docs/observability.md)
+    from . import telemetry as _telemetry
+    _telemetry.install_compile_cache_listener()
     _cache_dir = path
     return path
 

@@ -36,6 +36,7 @@ import tempfile
 import time
 from typing import List, Optional, Tuple
 
+from .. import telemetry as _tele
 from ..base import MXNetError
 from ..resilience import fault_point, retry_with_backoff
 
@@ -129,6 +130,12 @@ class CheckpointManager:
         """Rename a bad checkpoint (+ manifest) to ``*.corrupt`` so
         discovery skips it but the evidence survives for forensics."""
         corrupt = path + ".corrupt"
+        if _tele.enabled():
+            _tele.counter(
+                "checkpoint_quarantines",
+                "Checkpoints renamed *.corrupt after failing "
+                "verification or load").inc()
+            _tele.event("checkpoint_quarantine", path=path, reason=reason)
         _log.error("checkpoint %s failed verification/load (%s); "
                    "quarantining as %s", path, reason, corrupt)
         try:
@@ -153,6 +160,7 @@ class CheckpointManager:
         the latest; the manifest sidecar follows the rename."""
         self.wait_async()
         final = self._path(step)
+        t0 = time.perf_counter()
         fd, tmp = tempfile.mkstemp(dir=self.directory,
                                    prefix=f".{self.prefix}-tmp")
         os.close(fd)
@@ -165,7 +173,20 @@ class CheckpointManager:
                 os.unlink(tmp)
         self._write_manifest(final, step)
         self._prune()
+        self._note_write(final, step, time.perf_counter() - t0)
         return final
+
+    @staticmethod
+    def _note_write(path: str, step: int, elapsed_s: float,
+                    async_save: bool = False) -> None:
+        if _tele.enabled():
+            ms = elapsed_s * 1e3
+            _tele.histogram(
+                "checkpoint_write_ms",
+                "Checkpoint write duration incl. manifest (ms)"
+            ).observe(ms)
+            _tele.event("checkpoint_write", step=step, path=path,
+                        ms=round(ms, 3), async_save=async_save)
 
     _last_async = None
 
@@ -193,6 +214,7 @@ class CheckpointManager:
         fd, tmp = tempfile.mkstemp(dir=self.directory,
                                    prefix=f".{self.prefix}-atmp")
         os.close(fd)
+        t0 = time.perf_counter()
         inner = target.save_async(tmp)
 
         out: _fut.Future = _fut.Future()
@@ -203,6 +225,8 @@ class CheckpointManager:
                 os.replace(tmp, final)
                 self._write_manifest(final, step)
                 self._prune()
+                self._note_write(final, step, time.perf_counter() - t0,
+                                 async_save=True)
                 out.set_result(final)
             except BaseException as e:  # surface writer errors to .result()
                 try:
@@ -248,6 +272,7 @@ class CheckpointManager:
         consistent whenever restore returns.
         """
         self.wait_async()
+        t0 = time.perf_counter()
         if step is not None:
             path = self._path(step)
             if not os.path.exists(path):
@@ -259,6 +284,7 @@ class CheckpointManager:
                                  f"{reason}")
             fault_point("ckpt_read")
             target.load(path)
+            self._note_restore(path, step, time.perf_counter() - t0)
             return step
         chain = self.checkpoints()
         if not chain:
@@ -289,6 +315,8 @@ class CheckpointManager:
                             "restore: fell back to checkpoint at step %d "
                             "after quarantining %d newer corrupt "
                             "checkpoint(s)", s, len(failures))
+                    self._note_restore(path, s, time.perf_counter() - t0,
+                                       fallbacks=len(failures))
                     return s
             failures.append(self._quarantine(path, reason))
         raise MXNetError(
@@ -298,6 +326,17 @@ class CheckpointManager:
             f"failed to LOAD, the target is likely incompatible (changed "
             f"architecture?) — quarantine is a rename; strip the "
             f"'.corrupt' suffix to recover the files")
+
+    @staticmethod
+    def _note_restore(path: str, step: int, elapsed_s: float,
+                      fallbacks: int = 0) -> None:
+        if _tele.enabled():
+            ms = elapsed_s * 1e3
+            _tele.histogram(
+                "checkpoint_restore_ms",
+                "Checkpoint verify+load duration (ms)").observe(ms)
+            _tele.event("checkpoint_restore", step=step, path=path,
+                        ms=round(ms, 3), fallbacks=fallbacks)
 
     def _prune(self):
         cps = self.checkpoints()

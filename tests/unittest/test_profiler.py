@@ -81,3 +81,94 @@ def test_dump_writes_chrome_trace(tmp_path):
     assert any("add" in n for n in names), names
     for e in evs[:3]:
         assert e["ph"] == "X" and e["dur"] >= 0 and "ts" in e
+
+
+def test_event_cap_truncation_marker(tmp_path, monkeypatch):
+    """The chrome-trace buffer is bounded: past _MAX_EVENTS a single
+    truncation-marker event is appended (once) and dump() carries it."""
+    monkeypatch.setattr(profiler, "_MAX_EVENTS", 5)
+    profiler.set_config(aggregate_stats=True,
+                        filename=str(tmp_path / "cap"))
+    profiler.start()
+    for i in range(12):
+        with profiler.scope(f"op{i}"):
+            pass
+    path = profiler.dump()
+    with open(path) as f:
+        trace = json.load(f)
+    names = [e["name"] for e in trace["traceEvents"]]
+    markers = [n for n in names if "TRUNCATED" in n]
+    assert len(markers) == 1, names
+    # cap + exactly one marker, later events dropped
+    assert len(names) == 6
+    profiler.dumps(reset=True)
+
+
+def test_dump_unfinished_keeps_collecting(tmp_path):
+    """dump(finished=False) snapshots the trace without stopping or
+    clearing the event buffer."""
+    profiler.set_config(aggregate_stats=True,
+                        filename=str(tmp_path / "snap"))
+    profiler.start()
+    with profiler.scope("first"):
+        pass
+    profiler.dump(finished=False)
+    assert profiler.state() == "RUNNING"
+    with profiler.scope("second"):
+        pass
+    path = profiler.dump()  # finished: stops and flushes
+    with open(path) as f:
+        names = {e["name"] for e in json.load(f)["traceEvents"]}
+    assert {"first", "second"} <= names
+    assert profiler.state() == "STOPPED"
+    profiler.dumps(reset=True)
+
+
+def test_scope_records_into_trace_and_table(tmp_path):
+    """A user scope must land in BOTH sinks: the chrome-trace event list
+    (dump) and the aggregate-stats table (dumps)."""
+    profiler.set_config(aggregate_stats=True,
+                        filename=str(tmp_path / "both"))
+    profiler.start()
+    with profiler.scope("both_sinks"):
+        pass
+    path = profiler.dump()
+    with open(path) as f:
+        trace = json.load(f)
+    assert any(e["name"] == "both_sinks" for e in trace["traceEvents"])
+    stats = json.loads(profiler.dumps(format="json", reset=True))
+    assert "both_sinks" in stats["Time"]
+    assert stats["Time"]["both_sinks"]["Count"] == 1
+
+
+def test_counter_set_before_start_survives(tmp_path):
+    """Counter values set BEFORE start() must show up in dumps() after a
+    late start (they were silently dropped when set_value was gated on
+    `running`)."""
+    profiler.dumps(reset=True)
+    ctr = profiler.Counter("early_counter", value=7)
+    ctr.increment(3)
+    profiler.set_config(aggregate_stats=True,
+                        filename=str(tmp_path / "late"))
+    profiler.start()
+    profiler.stop()
+    stats = json.loads(profiler.dumps(format="json", reset=True))
+    assert stats["Counters"].get("early_counter") == 10
+
+
+def test_pause_noop_when_not_running(tmp_path):
+    """pause() while the profiler is stopped must not touch hook state:
+    a later start() still installs the aggregate-stats hook."""
+    import importlib
+    nd_mod = importlib.import_module("mxnet_tpu.ndarray.ndarray")
+    profiler.pause()          # stopped: must be a no-op
+    profiler.set_config(aggregate_stats=True,
+                        filename=str(tmp_path / "pause"))
+    profiler.start()
+    assert nd_mod._op_profile_hook is not None
+    profiler.pause()          # running: detaches the hook
+    assert nd_mod._op_profile_hook is None
+    profiler.resume()
+    assert nd_mod._op_profile_hook is not None
+    profiler.stop()
+    profiler.dumps(reset=True)

@@ -1,0 +1,120 @@
+"""Telemetry smoke check (`make telemetry-smoke`, docs/observability.md).
+
+Runs a 5-step CPU training loop with the metrics registry + run journal
+enabled, then validates the Prometheus text exposition with a pure-stdlib
+parser and cross-checks the journal. Exits non-zero (with a reason) on any
+failure — cheap enough for CI.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+import tempfile
+
+# must happen before any jax backend initialisation
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+STEPS = 5
+
+# Prometheus text exposition 0.0.4, the subset the registry emits:
+#   # HELP name text            # TYPE name kind
+#   name{label="v",...} value   (labels optional; value int/float)
+_COMMENT = re.compile(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* ?.*$")
+_SAMPLE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\""
+    r"(?:,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\")*)\})?"
+    r" (?P<value>[0-9.eE+-]+|NaN|\+Inf|-Inf)$")
+
+
+def parse_prometheus(text: str) -> dict:
+    """Parse an exposition into {metric_name: [(labels_dict, float)]}.
+    Raises ValueError on the first malformed line."""
+    out: dict = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            if not _COMMENT.match(line):
+                raise ValueError(f"line {lineno}: bad comment {line!r}")
+            continue
+        m = _SAMPLE.match(line)
+        if not m:
+            raise ValueError(f"line {lineno}: bad sample {line!r}")
+        labels = {}
+        if m.group("labels"):
+            for part in re.findall(
+                    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"',
+                    m.group("labels")):
+                labels[part[0]] = part[1]
+        out.setdefault(m.group("name"), []).append(
+            (labels, float(m.group("value"))))
+    return out
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+
+    import mxnet_tpu as mx  # noqa: F401 — registers the CPU pin
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import make_mesh, make_sharded_train_step
+
+    journal_path = os.path.join(tempfile.mkdtemp(prefix="mxtpu-tele-"),
+                                "smoke_journal.jsonl")
+    telemetry.enable(journal_path=journal_path)
+
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+    mesh = make_mesh({"dp": 1}, jax.devices()[:1])
+    step = make_sharded_train_step(
+        net, opt.SGD(learning_rate=1e-2),
+        lambda out, x, y: jnp.mean((out - y) ** 2), mesh, num_model_args=1)
+    rng = onp.random.RandomState(0)
+    xs = rng.uniform(-1, 1, (8, 8)).astype("float32")
+    ys = rng.uniform(-1, 1, (8, 4)).astype("float32")
+    step.warmup(xs, ys)
+    for _ in range(STEPS):
+        step.dispatch(*step.place_batch(xs, ys))
+    telemetry.memory_monitor() or telemetry.MemoryMonitor().sample_once()
+
+    text = telemetry.to_prometheus()
+    parsed = parse_prometheus(text)          # raises on malformed output
+    json.loads(telemetry.to_json())          # JSON export parses too
+
+    count = next((v for lb, v in parsed.get("step_dispatch_ms_count", [])
+                  if not lb), 0)
+    if count != STEPS:
+        print(f"FAIL: step_dispatch_ms_count == {count}, want {STEPS}",
+              file=sys.stderr)
+        return 1
+    if "steps_in_flight" not in parsed:
+        print("FAIL: steps_in_flight gauge missing", file=sys.stderr)
+        return 1
+
+    rows = telemetry.RunJournal.read(journal_path)
+    steps = [r["step"] for r in rows if r["event"] == "step_dispatched"]
+    if steps != sorted(set(steps)) or len(steps) != STEPS:
+        print(f"FAIL: journal step ids not strictly monotonic: {steps}",
+              file=sys.stderr)
+        return 1
+    if not any(r["event"].startswith("compile") for r in rows):
+        print("FAIL: journal has no compile event", file=sys.stderr)
+        return 1
+
+    telemetry.disable()
+    print(f"telemetry smoke OK: {len(text.splitlines())} exposition lines, "
+          f"{len(parsed)} series families, {len(rows)} journal rows "
+          f"({journal_path})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
