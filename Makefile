@@ -2,7 +2,7 @@
 # to what a single-language-core framework needs).
 PY ?= python
 
-.PHONY: ci test test-all test-dist test-parity lint bench cpp docs clean opperf-check telemetry-smoke
+.PHONY: ci test test-all test-dist test-parity lint bench cpp docs clean opperf-check telemetry-smoke health-smoke
 
 # the one-command gate CI runs (VERDICT round-2 next-step #7): lint +
 # unit suite + 2-process dist tests + C++ package build/tests
@@ -17,7 +17,7 @@ cpp-test:
 # `make test-all` runs everything.  -n auto parallelizes when xdist +
 # cores are available: ~13.5 min serial on the 1-core builder VM,
 # well under 10 min on any >=2-core box
-test:
+test: telemetry-smoke health-smoke
 	$(PY) -m pytest tests/unittest -q -m "not slow" $$($(PY) -c 'import xdist, os; print("-n auto" if (os.cpu_count() or 1) > 1 else "")' 2>/dev/null) --ignore=tests/unittest/test_dist_kvstore.py
 
 test-all:
@@ -50,6 +50,13 @@ bench:
 # docs/observability.md)
 telemetry-smoke:
 	$(PY) tools/telemetry_smoke.py
+
+# 12-step CPU run with a NaN injected through MXTPU_FAULT_SPEC, ending in
+# a forced crash; asserts the numerics probes counted it, the anomaly
+# journal event names the right step, and the crash flight-recorder
+# bundle landed in MXTPU_CRASH_DIR (docs/observability.md)
+health-smoke:
+	$(PY) tools/health_smoke.py
 
 cpp:
 	cmake -S cpp-package -B cpp-package/build && \

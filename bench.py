@@ -313,6 +313,26 @@ def _last_known_tpu():
     return rec
 
 
+def _emit_stale_telemetry(last: dict) -> None:
+    """Surface served-stale-TPU-results in telemetry, not only inside the
+    JSON blob: a ``bench_stale_rounds`` gauge (how many committed bench
+    rounds carried this same measurement) and a ``stale_bench`` journal
+    event.  Lazy + guarded: the orchestrator only reaches this on the
+    already-slow TPU-unreachable path, and a broken telemetry import must
+    not cost the driver its bench line."""
+    try:
+        from mxnet_tpu import telemetry as _tele
+        rounds = int(last.get("rounds_stale", 1))
+        _tele.gauge(
+            "bench_stale_rounds",
+            "Consecutive bench rounds serving the carried last-known-TPU "
+            "result instead of a fresh measurement").set(rounds)
+        _tele.event("stale_bench", rounds_stale=rounds,
+                    measured_at=last.get("measured_at"))
+    except Exception:
+        pass
+
+
 _CLAIM_LOCK = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "bench_results", ".tpu_claim.lock")
 
@@ -443,6 +463,7 @@ def _main_attempts():
         last = _last_known_tpu()
         if last is not None:
             out["extras"]["last_known_tpu"] = last
+            _emit_stale_telemetry(last)
         print(json.dumps(out))
         return
     result["extras"]["tpu_unavailable"] = "; ".join(e or "" for e in errors)
@@ -451,6 +472,7 @@ def _main_attempts():
         # the value above is the honest CPU fallback; this is the most
         # recent REAL TPU measurement for context (timestamped)
         result["extras"]["last_known_tpu"] = last
+        _emit_stale_telemetry(last)
     print(json.dumps(result))
 
 

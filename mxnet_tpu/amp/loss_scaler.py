@@ -64,3 +64,12 @@ class LossScaler:
             self.loss_scale *= self._scale_factor
             self._last_rescale_iter = self._iter
         self._iter += 1
+        # training-health hook: the monitor tracks the scale and flags a
+        # collapse episode (scale pinned at the floor = every window
+        # overflows — the silent-divergence signature). Lazy import +
+        # enabled() guard: a run without health pays one module lookup.
+        from .. import health as _health
+        if _health.enabled():
+            mon = _health.monitor()
+            if mon is not None:
+                mon.note_loss_scale(self.loss_scale)

@@ -39,6 +39,7 @@ from typing import Callable, Optional, Sequence
 
 import jax
 
+from . import health as _health
 from .base import MXNetError
 from .resilience import fault_point, retry_with_backoff
 from .utils.checkpoint import CheckpointManager
@@ -103,6 +104,14 @@ class Watchdog:
     collective leaves nowhere else), invokes `on_hang`, and — when
     `kill=True` — SIGABRTs the process so a supervisor can restart it. The
     default is detect-and-report only.
+
+    This is the LOOP-level detector (one ping per completed step).  The
+    process-wide generalization lives in `mx.health.HangWatchdog`: every
+    hot path (dispatch/retire, prefetch, DataLoader) touches a named
+    heartbeat and one monitor covers them all, with a flight-recorder
+    bundle on stall.  `ping` here also touches the ``elastic_step``
+    heartbeat so both detectors share one liveness signal, and a firing
+    expiry flushes a post-mortem bundle when the health subsystem is up.
     """
 
     def __init__(self, timeout: float, on_hang: Optional[Callable] = None,
@@ -113,21 +122,42 @@ class Watchdog:
         self.on_hang = on_hang
         self.kill = kill
         self.fired = False
+        self._bundle_dumped = False
         self._last = time.monotonic()
         self._stop = threading.Event()
         self._thread = None
 
     def ping(self) -> None:
         self._last = time.monotonic()
+        # progress since the last expiry: the next one is a NEW hang
+        # episode and deserves a fresh post-mortem bundle
+        self._bundle_dumped = False
+        _health.beat("elastic_step")
 
     def _watch(self):
         while not self._stop.wait(min(self.timeout / 4, 1.0)):
+            if _health.stalls_suppressed():
+                # an announced long block (cold-start XLA compile inside
+                # step_fn) produces no pings but is not a hang — mirror
+                # the process-wide watchdog and restart the clock
+                self._last = time.monotonic()
+                continue
             if time.monotonic() - self._last > self.timeout:
                 self.fired = True
                 _log.error("watchdog: no step completion in %.1fs — "
                            "dumping stacks", self.timeout)
                 try:
                     faulthandler.dump_traceback(file=sys.stderr)
+                except Exception:
+                    pass
+                try:
+                    # shared stall accounting (counter + journal event
+                    # with heartbeats/in-flight ids); one bundle per
+                    # hang episode (a persistent hang refires every
+                    # window; ping() resets the flag)
+                    _health.record_stall("elastic_watchdog", self.timeout,
+                                         dump=not self._bundle_dumped)
+                    self._bundle_dumped = True
                 except Exception:
                     pass
                 if self.on_hang is not None:
@@ -255,6 +285,10 @@ class ElasticLoop:
         self.manager = CheckpointManager(directory, keep=keep)
         self.save_every = save_every
         self.max_restores = max_restores
+        # MXTPU_STALL_TIMEOUT arms the loop-level watchdog too, so one
+        # env var covers both the per-step and process-wide detectors
+        if watchdog_timeout is None:
+            watchdog_timeout = _health.stall_timeout()
         self.watchdog_timeout = watchdog_timeout
         self.retry_on = tuple(retry_on)
         self.failure_injector = failure_injector

@@ -29,6 +29,7 @@ from typing import Callable, Optional
 
 import numpy as _onp
 
+from ... import health as _health
 from ... import telemetry as _tele
 from ...base import MXNetError
 from ...device import Device
@@ -124,11 +125,17 @@ class DataLoader:
                     self._proc_pool.submit(next(it))
                 except StopIteration:
                     pass
-                yield self._proc_pool.get(self._np_to_array, self._timeout)
+                batch = self._proc_pool.get(self._np_to_array, self._timeout)
+                # named heartbeat for the hang watchdog (mx.health): a
+                # loader that stops handing out batches shows up by name
+                _health.beat("dataloader")
+                yield batch
             return
         if self._pool is None:
             for indices in self._batch_sampler:
-                yield self._make_batch(indices)
+                batch = self._make_batch(indices)
+                _health.beat("dataloader")
+                yield batch
             return
         # windowed prefetch over the thread pool
         import collections
@@ -165,6 +172,7 @@ class DataLoader:
                         "Host wait for the next in-order DataLoader "
                         "batch (ms)"
                     ).observe((_time.perf_counter() - t0) * 1e3)
+                _health.beat("dataloader")
                 yield batch
             except FuturesTimeoutError:
                 raise MXNetError(

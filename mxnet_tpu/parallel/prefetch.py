@@ -38,6 +38,7 @@ import jax
 
 from ..base import MXNetError
 from ..resilience import fault_point
+from .. import health as _health
 from .. import telemetry as _tele
 
 __all__ = ["DevicePrefetcher", "AsyncMetricBuffer", "default_prefetch_depth"]
@@ -131,6 +132,10 @@ class DevicePrefetcher:
                 if self._stop.is_set():
                     return
                 fault_point("prefetch_next")
+                # named heartbeat for the hang watchdog (mx.health): a
+                # wedged placement/source stops touching it and shows up
+                # by name in the stall dump
+                _health.beat("prefetch")
                 # H2D overlap shows up in the XPlane trace under this span
                 with jax.profiler.TraceAnnotation("mxtpu.prefetch"):
                     placed = self._apply_place(item)

@@ -29,6 +29,7 @@ from ..gluon.block import Block, functional_call
 from ..gluon.parameter import Parameter
 from ..optimizer import Optimizer
 from ..ops.fused_optim import HpScalarCache
+from .. import health as _health
 from .. import profiler as _profiler
 from .. import telemetry as _tele
 from .sharding import ShardingRules, default_tp_rules
@@ -126,6 +127,20 @@ class ShardedTrainStep:
         self._dispatch_s = collections.deque(maxlen=1024)
         self._inflight = collections.deque(maxlen=256)
         self.compile_seconds = None
+        # numerics probes (MXTPU_HEALTH / health.enable): captured ONCE at
+        # construction so the probe branch is a fixed part of the traced
+        # program — with health off it is traced out entirely (zero extra
+        # device computations, trace_count unchanged); enabling health
+        # after construction requires a new step object
+        self._health_probes = _health.probes_enabled()
+        # stall-suppression guard entered at TRACE time (_note_trace) and
+        # released when the triggering call returns: any path that
+        # compiles — cold start, AOT fallback, mid-run aval-drift
+        # retrace — blocks for up to minutes, and the hang watchdog must
+        # not declare (or raise on) that expected silence
+        self._trace_guard = None
+        # stall dumps / crash bundles report this step's in-flight ids
+        _health.register_inflight_source(self)
 
         params = {n: p for n, p in block.collect_params().items()
                   if p._data is not None}
@@ -359,6 +374,26 @@ class ShardedTrainStep:
                 new_p[n] = w
                 new_s[n] = s
             new_p.update(aux)  # running-stat writebacks
+            if outer._health_probes:
+                # numerics probes (docs/observability.md): cheap fused
+                # reductions XLA folds into the step program — grad global
+                # L2 norm + non-finite element count over the whole grad
+                # tree.  Returned as async device scalars alongside the
+                # loss, so they ride dispatch() with no extra device sync.
+                leaves = jax.tree_util.tree_leaves(grads)
+                gnorm = jnp.sqrt(sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in leaves))
+                # count in f32, not i32: an all-NaN gradient tree on a
+                # >=2^31-element model would WRAP an int32 sum negative
+                # and poison the host-side counter; f32 loses exactness
+                # past 2^24 but stays positive, which is what the
+                # anomaly rule needs (int64 needs x64 mode)
+                nonfinite = sum(
+                    jnp.sum((~jnp.isfinite(g)).astype(jnp.float32))
+                    for g in leaves)
+                return new_p, new_s, loss, {"grad_norm": gnorm,
+                                            "nonfinite": nonfinite}
             return new_p, new_s, loss
 
         pspec = {n: self.param_shardings[n] for n in self.param_names}
@@ -369,10 +404,13 @@ class ShardedTrainStep:
                 self.opt_state[n])
             for n in self.diff_names}
         repl = NamedSharding(mesh, P())
+        out_shardings = (pspec, sspec, repl)
+        if self._health_probes:
+            out_shardings += ({"grad_norm": repl, "nonfinite": repl},)
         self._step_fn = jax.jit(
             step,
             in_shardings=(pspec, sspec, None, None) + batch_shardings,
-            out_shardings=(pspec, sspec, repl),
+            out_shardings=out_shardings,
             donate_argnums=(0, 1) if self.donate else ())
 
     def _check_global_batch(self, batch_vals) -> None:
@@ -412,6 +450,12 @@ class ShardedTrainStep:
         warns with the argument avals that drifted — a silent retrace
         re-pays compile AND breaks donation (see the dtype note in the
         optimizer-update loop)."""
+        # a trace is always followed by an XLA compile before the
+        # triggering call returns: suppress stall detection until then
+        # (released in dispatch/warmup's finally)
+        if self._trace_guard is None:
+            self._trace_guard = _health.suppress_stalls("trace_compile")
+            self._trace_guard.__enter__()
         leaves = jax.tree_util.tree_flatten_with_path(args)[0]
         avals = {
             jax.tree_util.keystr(path): (
@@ -445,6 +489,13 @@ class ShardedTrainStep:
             self._trace_count, len(drift),
             "; ".join(drift[:8]) + ("; ..." if len(drift) > 8 else "")
             if drift else "<none — new static closure?>")
+
+    def _release_trace_guard(self) -> None:
+        """Exit the stall-suppression window a trace opened (no-op when
+        no trace ran)."""
+        guard, self._trace_guard = self._trace_guard, None
+        if guard is not None:
+            guard.__exit__(None, None, None)
 
     @property
     def trace_count(self) -> int:
@@ -522,7 +573,15 @@ class ShardedTrainStep:
         if _tele.enabled():
             _tele.event("compile_start", step=self._t, kind="aot_warmup")
         t0 = time.perf_counter()
-        self._exec = self._step_fn.lower(*avals).compile()
+        # a multi-minute XLA compile is expected silence, not a hang —
+        # keep the stall watchdog quiet for its duration (explicitly:
+        # `.compile()` still runs even when `.lower()` skipped the trace
+        # that would have armed the _note_trace guard)
+        try:
+            with _health.suppress_stalls("aot_compile"):
+                self._exec = self._step_fn.lower(*avals).compile()
+        finally:
+            self._release_trace_guard()
         self.compile_seconds = time.perf_counter() - t0
         if _tele.enabled():
             _tele.event("compile_end", step=self._t, kind="aot_warmup",
@@ -537,38 +596,51 @@ class ShardedTrainStep:
         with `jax.profiler.StepTraceAnnotation`, so Perfetto/TensorBoard
         segment the XPlane trace per step and show prefetch overlap."""
         from .. import random as _rng
+        _health.beat("train_step.dispatch")
         t0 = time.perf_counter()
         batch_vals = self._prepare_batch(batch)
         self._t += 1
         hp = self._hp()
         key = rng_key if rng_key is not None else _rng.next_key()
-        with _profiler.step_annotation("mxtpu.train_step", step_num=self._t):
-            if self._exec is not None:
-                try:
-                    out = self._exec(self.pvals, self.opt_state, hp, key,
-                                     *batch_vals)
-                except TypeError as e:
-                    # aval drift vs the AOT executable: fall back to the
-                    # jit path (which retraces — _note_trace warns with
-                    # the diff). Input buffers are intact: the AOT call
-                    # validates avals before launching, so donation has
-                    # not consumed them yet.
-                    _log.warning(
-                        "AOT-compiled step rejected inputs (%s); falling "
-                        "back to jit", str(e).splitlines()[0])
-                    self._exec = None
+        # any (re)trace inside these calls enters the stall-suppression
+        # guard via _note_trace; the finally releases it once the
+        # triggering call (trace + XLA compile) has returned
+        try:
+            with _profiler.step_annotation("mxtpu.train_step",
+                                           step_num=self._t):
+                if self._exec is not None:
+                    try:
+                        out = self._exec(self.pvals, self.opt_state, hp,
+                                         key, *batch_vals)
+                    except TypeError as e:
+                        # aval drift vs the AOT executable: fall back to
+                        # the jit path (which retraces — _note_trace warns
+                        # with the diff). Input buffers are intact: the
+                        # AOT call validates avals before launching, so
+                        # donation has not consumed them yet.
+                        _log.warning(
+                            "AOT-compiled step rejected inputs (%s); "
+                            "falling back to jit",
+                            str(e).splitlines()[0])
+                        self._exec = None
+                        out = self._step_fn(self.pvals, self.opt_state,
+                                            hp, key, *batch_vals)
+                else:
                     out = self._step_fn(self.pvals, self.opt_state, hp,
                                         key, *batch_vals)
-            else:
-                out = self._step_fn(self.pvals, self.opt_state, hp, key,
-                                    *batch_vals)
-        self.pvals, self.opt_state, loss = out
+        finally:
+            self._release_trace_guard()
+        if self._health_probes:
+            self.pvals, self.opt_state, loss, probes = out
+        else:
+            self.pvals, self.opt_state, loss = out
+            probes = None
         # rebind block Parameters to the fresh (non-donated) buffers so
         # eager reads (p.data()) stay valid — pointer update only
         self.sync_params_to_block()
         dt = time.perf_counter() - t0
         self._dispatch_s.append(dt)
-        self._inflight.append((self._t, loss))
+        self._inflight.append((self._t, loss, probes))
         if _tele.enabled():
             _tele.histogram(
                 "step_dispatch_ms",
@@ -581,14 +653,18 @@ class ShardedTrainStep:
                 "steps_in_flight",
                 "Dispatched steps whose loss has not landed on the host"
             ).set(self.steps_in_flight())
-        return StepHandle(loss, self._t, dt)
+        elif self._health_probes:
+            self.steps_in_flight()   # retire → feed the health monitor
+        return StepHandle(loss, self._t, dt, probes=probes)
 
     def steps_in_flight(self) -> int:
         """Dispatched steps whose loss has not yet landed on the host —
-        non-blocking (`jax.Array.is_ready`), pruning finished entries."""
+        non-blocking (`jax.Array.is_ready`), pruning finished entries.
+        Retired steps feed their (now host-cheap) probe values to the
+        health monitor when numerics probes are on."""
         q = self._inflight
         while q:
-            step_id, loss = q[0]
+            step_id, loss, probes = q[0]
             try:
                 ready = bool(loss.is_ready())
             except Exception:
@@ -596,9 +672,28 @@ class ShardedTrainStep:
             if not ready:
                 break
             q.popleft()
+            _health.beat("train_step.retire")
+            if probes is not None:
+                self._observe_health(step_id, loss, probes)
             if _tele.enabled():
                 _tele.event("step_retired", step=step_id)
         return len(q)
+
+    @staticmethod
+    def _observe_health(step_id, loss, probes) -> None:
+        """Hand one retired step's probe scalars to the health monitor.
+        The arrays are ready (the retire check just passed), so the
+        device_get is a host copy, not a sync."""
+        mon = _health.monitor()
+        if mon is None:
+            return
+        try:
+            mon.observe(step_id,
+                        loss=float(jax.device_get(loss)),
+                        grad_norm=float(jax.device_get(probes["grad_norm"])),
+                        nonfinite=int(jax.device_get(probes["nonfinite"])))
+        except Exception:   # monitoring must never take the step down
+            _log.exception("health probe observation failed")
 
     def dispatch_stats(self) -> dict:
         """Host-side dispatch latency over the last <=1024 steps: the time
@@ -804,14 +899,18 @@ class StepHandle:
     1-based step index; `dispatch_s` the host time the dispatch call took.
     `result()` blocks and returns the float; `is_ready()` polls without
     blocking.  Feed handles straight into `AsyncMetricBuffer.append`.
+    `probes` carries the async numerics-probe scalars
+    (``{"grad_norm", "nonfinite"}``) when health probes are enabled,
+    else None (docs/observability.md).
     """
 
-    __slots__ = ("loss", "step", "dispatch_s")
+    __slots__ = ("loss", "step", "dispatch_s", "probes")
 
-    def __init__(self, loss, step: int, dispatch_s: float):
+    def __init__(self, loss, step: int, dispatch_s: float, probes=None):
         self.loss = loss
         self.step = step
         self.dispatch_s = dispatch_s
+        self.probes = probes
 
     def is_ready(self) -> bool:
         try:

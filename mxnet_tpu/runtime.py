@@ -32,6 +32,11 @@ _STATIC = {
     "BLAS_OPEN": False,
     "SIGNAL_HANDLER": True,
     "PROFILER": True,
+    # runtime-observability subsystems (PR 3/4): the metrics/journal
+    # substrate and the training-health monitor are always compiled in
+    # (both off by default at runtime; MXTPU_TELEMETRY / MXTPU_HEALTH)
+    "TELEMETRY": True,
+    "HEALTH_MONITOR": True,
 }
 
 

@@ -30,18 +30,21 @@ hot startup path for free.
 """
 from __future__ import annotations
 
+import atexit
 import json
 import logging
+import math
 import os
 import re
 import threading
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "MemoryMonitor",
     "RunJournal", "MetricsServer", "registry", "counter", "gauge",
     "histogram", "enabled", "enable", "disable", "event", "journal",
+    "add_event_tap", "remove_event_tap", "json_safe",
     "snapshot", "to_prometheus", "to_json", "serve_metrics",
     "install_compile_cache_listener", "DEFAULT_MS_BUCKETS",
     "ENV_ENABLE", "ENV_PORT", "ENV_MEMMON",
@@ -163,12 +166,7 @@ class Histogram(_Metric):
     def __init__(self, name, help="", labelnames=(),
                  buckets: Sequence[float] = DEFAULT_MS_BUCKETS):
         super().__init__(name, help, labelnames)
-        bs = sorted(float(b) for b in buckets)
-        if not bs:
-            raise ValueError(f"histogram {name} needs at least one bucket")
-        if bs[-1] != float("inf"):
-            bs.append(float("inf"))
-        self.buckets = tuple(bs)
+        self.buckets = _normalize_buckets(name, buckets)
         # key -> [per-bucket counts (non-cumulative), sum, count]
         self._values: Dict[Tuple[str, ...], list] = {}
 
@@ -208,6 +206,23 @@ class Histogram(_Metric):
                 out.append((dict(zip(self.labelnames, k)),
                             {"buckets": cum, "sum": total, "count": n}))
             return out
+
+
+def _normalize_buckets(name: str, buckets: Sequence[float]) -> tuple:
+    """Validate + canonicalize histogram buckets: strictly increasing
+    finite upper bounds (an unordered list is a caller bug that would
+    silently misroute samples, not something to quietly sort away), with
+    the implicit +Inf bucket appended."""
+    bs = [float(b) for b in buckets]
+    if not bs:
+        raise ValueError(f"histogram {name} needs at least one bucket")
+    if any(b2 <= b1 for b1, b2 in zip(bs, bs[1:])):
+        raise ValueError(
+            f"histogram {name}: buckets must be strictly increasing, "
+            f"got {tuple(buckets)}")
+    if bs[-1] != float("inf"):
+        bs.append(float("inf"))
+    return tuple(bs)
 
 
 def _fmt_le(ub: float) -> str:
@@ -263,9 +278,32 @@ class MetricsRegistry:
         return self._get_or_create(Gauge, name, help, labelnames)
 
     def histogram(self, name, help="", labelnames=(),
-                  buckets=DEFAULT_MS_BUCKETS) -> Histogram:
-        return self._get_or_create(Histogram, name, help, labelnames,
-                                   buckets=buckets)
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        """Get-or-create a histogram.  `buckets=None` (hot-path callers)
+        means "whatever the metric has" — defaults to
+        :data:`DEFAULT_MS_BUCKETS` on first creation.  An EXPLICIT
+        `buckets=` that conflicts with an already-registered histogram's
+        buckets raises: two sites silently disagreeing on bucket bounds
+        would make one of them misread every exposition."""
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, Histogram):
+                    raise ValueError(
+                        f"metric {name!r} already registered as {m.kind}, "
+                        f"requested histogram")
+                if buckets is not None and \
+                        _normalize_buckets(name, buckets) != m.buckets:
+                    raise ValueError(
+                        f"histogram {name!r} already registered with "
+                        f"buckets {m.buckets}, re-requested with "
+                        f"{tuple(buckets)}")
+                return m
+            m = Histogram(name, help=help, labelnames=labelnames,
+                          buckets=DEFAULT_MS_BUCKETS if buckets is None
+                          else buckets)
+            self._metrics[name] = m
+            return m
 
     def get(self, name) -> Optional[_Metric]:
         with self._lock:
@@ -336,6 +374,25 @@ def _fmt_val(v: float) -> str:
     return str(int(v)) if float(v).is_integer() and abs(v) < 1e15 else repr(v)
 
 
+def json_safe(obj):
+    """Replace non-finite floats with their string names so the output is
+    strict RFC 8259 JSON.  Python's json emits bare ``NaN``/``Infinity``
+    tokens by default — and the rows that carry them (NaN-loss probes,
+    anomaly events, crash bundles) are exactly the ones downstream jq /
+    JSON.parse / Go pipelines must be able to read."""
+    if isinstance(obj, float):
+        if math.isnan(obj):
+            return "NaN"
+        if math.isinf(obj):
+            return "Infinity" if obj > 0 else "-Infinity"
+        return obj
+    if isinstance(obj, dict):
+        return {k: json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [json_safe(v) for v in obj]
+    return obj
+
+
 # ---------------------------------------------------------------------------
 # run journal
 # ---------------------------------------------------------------------------
@@ -352,15 +409,29 @@ class RunJournal:
 
     def __init__(self, path: str):
         self.path = os.path.abspath(path)
-        d = os.path.dirname(self.path)
-        if d:
-            os.makedirs(d, exist_ok=True)
-        # line-buffered append: rows survive a crash up to the last line
-        self._f = open(self.path, "a", buffering=1)
         self._lock = threading.Lock()
         self._seq = 0
         self._last_step = 0
         self._closed = False
+        try:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            # line-buffered append: rows survive a crash up to the last line
+            self._f = open(self.path, "a", buffering=1)
+        except OSError as e:
+            # an unwritable journal path must degrade to a disabled journal,
+            # not abort the training run that asked for observability
+            self._f = None
+            self._closed = True
+            _log.warning("run journal disabled: cannot open %s (%s)",
+                         self.path, e)
+
+    @property
+    def disabled(self) -> bool:
+        """True when the journal could not open its file (or was closed);
+        `record` is a silent no-op in that state."""
+        return self._closed
 
     def record(self, event: str, step: Optional[int] = None,
                **fields) -> None:
@@ -374,8 +445,9 @@ class RunJournal:
                    "event": event, "step": self._last_step}
             row.update(fields)
             try:
-                self._f.write(json.dumps(row, default=str) + "\n")
-            except (OSError, ValueError):
+                self._f.write(json.dumps(json_safe(row), default=str,
+                                         allow_nan=False) + "\n")
+            except (OSError, ValueError, TypeError):
                 pass  # a full disk must not take the training loop down
 
     def close(self) -> None:
@@ -383,7 +455,8 @@ class RunJournal:
             if not self._closed:
                 self._closed = True
                 try:
-                    self._f.close()
+                    if self._f is not None:
+                        self._f.close()
                 except OSError:
                     pass
 
@@ -520,7 +593,10 @@ class MemoryMonitor:
 
 class MetricsServer:
     """Background ``http.server`` thread serving the registry:
-    ``/metrics`` (Prometheus text), ``/metrics.json`` (JSON snapshot).
+    ``/metrics`` (Prometheus text), ``/metrics.json`` (JSON snapshot),
+    ``/healthz`` (watchdog heartbeat ages + stall state as JSON — a
+    liveness probe that answers "is the training loop still moving"
+    without parsing the full exposition).
     Port 0 binds an ephemeral port (read it back from ``.port``).
     Binds loopback by default — exposing runtime internals on all
     interfaces is an explicit opt-in (``MXTPU_METRICS_HOST=0.0.0.0``)."""
@@ -541,6 +617,13 @@ class MetricsServer:
             def do_GET(self):  # noqa: N802 — stdlib API name
                 if self.path.split("?")[0] in ("/metrics.json", "/json"):
                     body = reg.to_json(indent=2).encode()
+                    ctype = "application/json"
+                elif self.path.split("?")[0] == "/healthz":
+                    # lazy import: health imports telemetry at module load,
+                    # so telemetry can only reach back at request time
+                    from . import health as _health
+                    body = json.dumps(
+                        _health.healthz(), indent=2).encode()
                     ctype = "application/json"
                 elif self.path.split("?")[0] in ("/", "/metrics"):
                     body = reg.to_prometheus().encode()
@@ -587,6 +670,8 @@ _journal: Optional[RunJournal] = None
 _server: Optional[MetricsServer] = None
 _memmon: Optional[MemoryMonitor] = None
 _state_lock = threading.Lock()
+_event_taps: List[Callable[[dict], None]] = []
+_atexit_registered = False
 
 
 def registry() -> MetricsRegistry:
@@ -602,8 +687,7 @@ def gauge(name, help="", labelnames=()) -> Gauge:
     return _registry.gauge(name, help, labelnames)
 
 
-def histogram(name, help="", labelnames=(),
-              buckets=DEFAULT_MS_BUCKETS) -> Histogram:
+def histogram(name, help="", labelnames=(), buckets=None) -> Histogram:
     return _registry.histogram(name, help, labelnames, buckets)
 
 
@@ -630,14 +714,40 @@ def journal() -> Optional[RunJournal]:
 
 
 def event(name: str, step: Optional[int] = None, **fields) -> None:
-    """Record a journal event; no-op when telemetry is disabled or no
-    journal is attached (instrumentation sites call this unconditionally
-    after their `enabled()` guard)."""
+    """Record a journal event; no-op when telemetry is disabled
+    (instrumentation sites call this unconditionally after their
+    `enabled()` guard).  The event goes to the run journal (when one is
+    attached) AND to any registered taps — the crash flight recorder
+    (`mx.health`) rides a tap so it sees every event even when no journal
+    file is open."""
     if not _enabled:
         return
     j = _journal
     if j is not None:
         j.record(name, step=step, **fields)
+    if _event_taps:
+        row = {"ts": round(time.time(), 6), "event": name, "step": step}
+        row.update(fields)
+        for tap in tuple(_event_taps):
+            try:
+                tap(row)
+            except Exception:  # a broken tap must not take training down
+                _log.debug("telemetry event tap failed", exc_info=True)
+
+
+def add_event_tap(tap: Callable[[dict], None]) -> None:
+    """Register a callable invoked with every `event()` row dict (after
+    the journal write).  Taps must be fast and never raise; used by the
+    `health` flight recorder."""
+    if tap not in _event_taps:
+        _event_taps.append(tap)
+
+
+def remove_event_tap(tap: Callable[[dict], None]) -> None:
+    try:
+        _event_taps.remove(tap)
+    except ValueError:
+        pass
 
 
 def enable(journal_path: Optional[str] = None,
@@ -686,6 +796,13 @@ def enable(journal_path: Optional[str] = None,
                 and _memmon is None:
             _memmon = MemoryMonitor(interval=memmon_interval).start()
         _enabled = True
+        global _atexit_registered
+        if not _atexit_registered:
+            # join the monitor/server threads (and flush the journal) at
+            # interpreter exit, so pytest/bench processes never tear down
+            # with a daemon thread mid-sample on a dying jax runtime
+            atexit.register(_atexit_shutdown)
+            _atexit_registered = True
 
 
 def disable() -> None:
@@ -703,6 +820,17 @@ def disable() -> None:
         if _journal is not None:
             _journal.close()
             _journal = None
+
+
+def _atexit_shutdown() -> None:
+    """Interpreter-exit hook (registered by the first `enable`): stop and
+    JOIN the memory-monitor and HTTP-server threads and close the journal.
+    Daemon threads otherwise die mid-sample when the interpreter tears
+    down — under pytest that shows up as leaked threads between runs."""
+    try:
+        disable()
+    except Exception:
+        pass
 
 
 def metrics_server() -> Optional[MetricsServer]:

@@ -1,0 +1,740 @@
+"""Training-health monitor: on-device numerics probes, host-side anomaly
+rules, process-wide hang watchdog, crash flight recorder, and the
+framework wiring (`ShardedTrainStep`, `amp.LossScaler`, `ElasticLoop`,
+`/healthz`).  Runs on the virtual 8-device CPU mesh; `health` marker
+(tier-1)."""
+import json
+import math
+import os
+import sys
+import time
+import urllib.request
+
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx  # noqa: F401
+from mxnet_tpu import health
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu import telemetry as tele
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel import make_mesh, make_sharded_train_step
+
+pytestmark = pytest.mark.health
+
+
+@pytest.fixture(autouse=True)
+def _clean_health():
+    """Each test starts with health + telemetry off, empty registry and
+    heartbeats — and leaves the process that way (state is process-wide)."""
+    health.disable()
+    tele.disable()
+    tele.registry().reset()
+    health._beats.clear()
+    yield
+    health.disable()
+    tele.disable()
+    tele.registry().reset()
+    health._beats.clear()
+
+
+def _loss_fn(out, x, y):
+    return jnp.mean((out - y) ** 2)
+
+
+def _make_step(**kw):
+    mx.random.seed(7)
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+    mesh = make_mesh({"dp": 2}, jax.devices("cpu")[:2])
+    return make_sharded_train_step(
+        net, opt.SGD(learning_rate=1e-2), _loss_fn, mesh,
+        num_model_args=1, **kw)
+
+
+def _data(n=8, seed=0):
+    rng = onp.random.RandomState(seed)
+    return (rng.uniform(-1, 1, (n, 8)).astype(onp.float32),
+            rng.uniform(-1, 1, (n, 4)).astype(onp.float32))
+
+
+# ---------------------------------------------------------------------------
+# heartbeats + healthz
+# ---------------------------------------------------------------------------
+
+def test_beat_and_ages():
+    health.beat("a")
+    time.sleep(0.02)
+    health.beat("b")
+    ages = health.heartbeat_ages()
+    assert set(ages) == {"a", "b"}
+    assert ages["a"] >= ages["b"] >= 0
+
+
+def test_healthz_payload_shape():
+    health.beat("x")
+    hz = health.healthz()
+    assert "x" in hz["heartbeats"]
+    assert hz["watchdog"] is None
+    assert hz["anomalies"] == 0
+
+
+def test_stall_timeout_env(monkeypatch):
+    monkeypatch.delenv("MXTPU_STALL_TIMEOUT", raising=False)
+    assert health.stall_timeout() is None
+    monkeypatch.setenv("MXTPU_STALL_TIMEOUT", "12.5")
+    assert health.stall_timeout() == 12.5
+    monkeypatch.setenv("MXTPU_STALL_TIMEOUT", "bogus")
+    assert health.stall_timeout() is None
+    monkeypatch.setenv("MXTPU_STALL_TIMEOUT", "-1")
+    assert health.stall_timeout() is None
+
+
+# ---------------------------------------------------------------------------
+# HealthMonitor rules (pure host)
+# ---------------------------------------------------------------------------
+
+def test_monitor_nonfinite_grads_rule():
+    tele.enable()
+    mon = health.HealthMonitor()
+    mon.observe(7, loss=1.0, grad_norm=0.5, nonfinite=3)
+    assert len(mon.anomalies) == 1
+    a = mon.anomalies[0]
+    assert a["rule"] == "nonfinite_grads" and a["step"] == 7
+    assert tele.counter("health_nonfinite_total").value() == 3
+    assert tele.registry().get("health_anomalies_total") \
+        .value(rule="nonfinite_grads") == 1
+
+
+def test_monitor_loss_nonfinite_rule():
+    tele.enable()
+    mon = health.HealthMonitor()
+    mon.observe(3, loss=float("nan"), grad_norm=1.0, nonfinite=0)
+    assert [a["rule"] for a in mon.anomalies] == ["loss_nonfinite"]
+    assert mon.anomalies[0]["step"] == 3
+
+
+def test_monitor_loss_spike_needs_history():
+    tele.enable()
+    mon = health.HealthMonitor(min_history=4, loss_spike_factor=10.0)
+    mon.observe(1, loss=50.0, grad_norm=1.0, nonfinite=0)
+    assert not mon.anomalies   # a would-be spike before min_history: quiet
+    mon = health.HealthMonitor(min_history=4, loss_spike_factor=10.0)
+    for i in range(1, 7):
+        mon.observe(i, loss=1.0, grad_norm=1.0, nonfinite=0)
+    mon.observe(7, loss=500.0, grad_norm=1.0, nonfinite=0)
+    spikes = [a for a in mon.anomalies if a["rule"] == "loss_spike"]
+    assert spikes and spikes[0]["step"] == 7
+
+
+def test_monitor_grad_explosion():
+    tele.enable()
+    mon = health.HealthMonitor(min_history=4, grad_norm_factor=25.0)
+    for i in range(1, 9):
+        mon.observe(i, loss=1.0, grad_norm=1.0, nonfinite=0)
+    mon.observe(9, loss=1.0, grad_norm=1e4, nonfinite=0)
+    rules = [a["rule"] for a in mon.anomalies]
+    assert "grad_explosion" in rules
+
+
+def test_monitor_inf_grad_norm_with_finite_elements():
+    """Finite f32 grads whose norm reduction overflowed to Inf: the most
+    extreme explosion must not be the one case the monitor is silent on
+    (nonfinite==0, so the nonfinite_grads rule cannot cover it)."""
+    tele.enable()
+    mon = health.HealthMonitor()
+    mon.observe(1, loss=1.0, grad_norm=float("inf"), nonfinite=0)
+    rules = [a["rule"] for a in mon.anomalies]
+    assert rules == ["grad_explosion"]
+    assert mon.anomalies[0]["overflow"] is True
+
+
+def test_monitor_anomalies_ring_bounded():
+    tele.enable()
+    mon = health.HealthMonitor(anomaly_capacity=4)
+    for i in range(10):
+        mon.observe(i, loss=float("nan"), grad_norm=1.0, nonfinite=0)
+    assert len(mon.anomalies) == 4        # bounded ring
+    assert mon.anomaly_count == 10        # true total preserved
+
+
+def test_monitor_callback_may_reenter():
+    """on_anomaly runs outside the monitor lock: a callback that calls
+    back into the monitor (the natural grab-context pattern) must not
+    deadlock."""
+    tele.enable()
+    seen = []
+    mon = health.HealthMonitor(
+        on_anomaly=lambda row: seen.append(len(mon.recent())))
+    mon.observe(1, loss=float("inf"), grad_norm=1.0, nonfinite=0)
+    assert seen == [1]   # ran, re-entered recent(), no deadlock
+
+
+def test_monitor_nan_does_not_poison_ema():
+    tele.enable()
+    mon = health.HealthMonitor(min_history=2)
+    for i in range(1, 6):
+        mon.observe(i, loss=2.0, grad_norm=1.0, nonfinite=0)
+    ema_before = mon._loss_ema
+    mon.observe(6, loss=float("nan"), grad_norm=float("nan"), nonfinite=4)
+    assert mon._loss_ema == ema_before          # NaN never entered the EMA
+    mon.observe(7, loss=2.0, grad_norm=1.0, nonfinite=0)
+    assert math.isfinite(mon._loss_ema)
+
+
+def test_monitor_loss_scale_collapse_once_per_episode():
+    tele.enable()
+    mon = health.HealthMonitor(scale_collapse_at=2.0)
+    mon.note_loss_scale(8.0)
+    assert not mon.anomalies
+    mon.note_loss_scale(2.0)
+    mon.note_loss_scale(1.0)     # still the same collapse episode
+    assert [a["rule"] for a in mon.anomalies] == ["loss_scale_collapse"]
+    mon.note_loss_scale(64.0)    # recovered
+    mon.note_loss_scale(1.0)     # new collapse
+    assert len(mon.anomalies) == 2
+
+
+def test_monitor_anomaly_journal_event(tmp_path):
+    tele.enable(journal_path=str(tmp_path / "j.jsonl"))
+    mon = health.HealthMonitor()
+    mon.observe(42, loss=1.0, grad_norm=1.0, nonfinite=5)
+    rows = tele.RunJournal.read(tele.journal().path)
+    anomalies = [r for r in rows if r["event"] == "anomaly"]
+    assert anomalies and anomalies[0]["step"] == 42
+    assert anomalies[0]["rule"] == "nonfinite_grads"
+
+
+def test_monitor_on_anomaly_callback():
+    tele.enable()
+    seen = []
+    mon = health.HealthMonitor(on_anomaly=seen.append)
+    mon.observe(1, loss=float("inf"), grad_norm=1.0, nonfinite=0)
+    assert seen and seen[0]["rule"] == "loss_nonfinite"
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def test_recorder_ring_bounded():
+    rec = health.FlightRecorder(capacity=8)
+    for i in range(20):
+        rec.record_event({"event": "e", "step": i, "ts": 0.0})
+    ev = rec.events()
+    assert len(ev) == 8
+    assert [r["step"] for r in ev] == list(range(12, 20))
+
+
+def test_recorder_step_carry_forward():
+    rec = health.FlightRecorder(capacity=8)
+    rec.record_event({"event": "a", "step": 5, "ts": 0.0})
+    rec.record_event({"event": "b", "step": None, "ts": 0.0})
+    assert rec.events()[1]["step"] == 5
+
+
+def test_recorder_flush_and_read(tmp_path):
+    tele.enable()
+    rec = health.FlightRecorder(crash_dir=str(tmp_path), capacity=16)
+    for i in range(5):
+        rec.record_event({"event": "e", "step": i, "ts": 0.0})
+    path = rec.flush("unit_test")
+    assert path and os.path.exists(path)
+    bundle = health.read_bundle(path)
+    assert bundle["reason"] == "unit_test"
+    assert len(bundle["events"]) == 5
+    assert "metrics" in bundle and "heartbeats" in bundle
+    assert "stacks" in bundle and "MainThread" in bundle["stacks"]
+
+
+def test_recorder_flush_without_dir_is_noop():
+    rec = health.FlightRecorder(crash_dir=None)
+    assert rec.flush("x") is None
+
+
+def test_recorder_bundle_carries_exception(tmp_path):
+    rec = health.FlightRecorder(crash_dir=str(tmp_path))
+    try:
+        raise ValueError("boom")
+    except ValueError:
+        path = rec.flush("exception", exc_info=sys.exc_info())
+    bundle = health.read_bundle(path)
+    assert bundle["exception"]["type"] == "ValueError"
+    assert "boom" in bundle["exception"]["message"]
+    assert "boom" in bundle["exception"]["traceback"]
+
+
+def test_event_tap_feeds_recorder(tmp_path):
+    health.enable(crash_dir=str(tmp_path))
+    tele.event("custom_event", step=9, detail="x")
+    rec = health.flight_recorder()
+    assert any(r["event"] == "custom_event" and r["step"] == 9
+               for r in rec.events())
+
+
+# ---------------------------------------------------------------------------
+# hang watchdog
+# ---------------------------------------------------------------------------
+
+def test_watchdog_rejects_bad_args():
+    with pytest.raises(ValueError, match="positive"):
+        health.HangWatchdog(0)
+    with pytest.raises(ValueError, match="action"):
+        health.HangWatchdog(1.0, action="explode")
+
+
+def test_watchdog_fires_on_silence(tmp_path):
+    tele.enable(journal_path=str(tmp_path / "w.jsonl"))
+    stalls = []
+    wd = health.HangWatchdog(0.25, poll=0.05, on_stall=stalls.append)
+    wd.start()
+    try:
+        # poll on the CALLBACK (the last thing _fire does before the
+        # action), so every earlier effect is visible once it lands
+        deadline = time.monotonic() + 10.0
+        while not stalls and time.monotonic() < deadline:
+            time.sleep(0.05)
+    finally:
+        wd.stop()
+    assert wd.stalls >= 1
+    assert stalls and "heartbeats" in stalls[0]
+    assert tele.counter("health_stalls_total").value() >= 1
+    rows = tele.RunJournal.read(tele.journal().path)
+    assert any(r["event"] == "stall" for r in rows)
+
+
+def test_watchdog_quiet_under_suppression():
+    """An announced long block (XLA compile) is expected silence: the
+    watchdog must not fire inside suppress_stalls, and the window's end
+    restarts the idle clock."""
+    tele.enable()
+    wd = health.HangWatchdog(0.2, poll=0.05)
+    wd.start()
+    try:
+        with health.suppress_stalls("compile"):
+            time.sleep(0.7)          # >> timeout, but suppressed
+        assert wd.stalls == 0
+        time.sleep(0.1)              # after the window: clock restarted
+        assert wd.stalls == 0
+    finally:
+        wd.stop()
+    assert not health.stalls_suppressed()
+
+
+def test_enable_degrades_bad_env_stall_action(tmp_path, monkeypatch):
+    """A miscased MXTPU_STALL_ACTION must degrade to 'record' with a
+    warning, not raise out of the module-level auto-enable and brick
+    `import mxnet_tpu`."""
+    monkeypatch.setenv("MXTPU_STALL_ACTION", "Raise")   # miscased: accepted
+    health.enable(crash_dir=str(tmp_path), stall_timeout_s=100.0)
+    assert health.watchdog().action == "raise"
+    health.disable()
+    monkeypatch.setenv("MXTPU_STALL_ACTION", "explode")  # unknown: degrade
+    health.enable(crash_dir=str(tmp_path), stall_timeout_s=100.0)
+    assert health.watchdog().action == "record"
+    health.disable()
+    # an explicit python-arg typo still raises (HangWatchdog validation)
+    with pytest.raises(ValueError, match="action"):
+        health.enable(crash_dir=str(tmp_path), stall_timeout_s=100.0,
+                      stall_action="explode")
+
+
+def test_dispatch_trace_suppresses_stalls():
+    """Every compile path — including a mid-run aval-drift retrace — must
+    enter the stall-suppression window at trace time and release it when
+    the triggering call returns."""
+    entered = []
+    orig = health.suppress_stalls
+
+    def spy(reason=""):
+        entered.append(reason)
+        return orig(reason)
+
+    health.enable()
+    try:
+        health.suppress_stalls, hooked = spy, None
+        import mxnet_tpu.parallel.train as _train
+        hooked = _train._health.suppress_stalls
+        _train._health.suppress_stalls = spy
+        try:
+            step = _make_step()
+            xs, ys = _data()
+            step.dispatch(xs, ys)                      # cold start traces
+            assert "trace_compile" in entered
+            assert not health.stalls_suppressed()      # released
+            entered.clear()
+            step.dispatch(xs, ys)                      # steady state
+            assert "trace_compile" not in entered
+            # mid-run retrace (drifted dtype) re-enters the guard
+            step.dispatch(xs.astype(onp.float64).astype(onp.float32),
+                          ys)                          # same avals: no
+            assert "trace_compile" not in entered
+        finally:
+            _train._health.suppress_stalls = hooked
+    finally:
+        health.suppress_stalls = orig
+        health.disable()
+
+
+def test_excepthook_uninstall_keeps_wrapped_chain(tmp_path):
+    """If another library wrapped sys.excepthook after health installed
+    its hook, disable() cannot restore — but it must KEEP the saved
+    original so the still-reachable _excepthook chains to it."""
+    orig_hook = sys.excepthook
+    health.enable(crash_dir=str(tmp_path))
+
+    def wrapper(tp, val, tb):       # another library wraps us
+        return health._excepthook(tp, val, tb)
+
+    sys.excepthook = wrapper
+    try:
+        health.disable()
+        assert sys.excepthook is wrapper          # untouched
+        assert health._prev_excepthook is orig_hook  # NOT dropped
+    finally:
+        sys.excepthook = orig_hook
+        health._prev_excepthook = None
+
+
+def test_enable_rearms_dead_raise_watchdog(tmp_path):
+    """A raise-mode watchdog's thread exits after its one interruption;
+    re-enabling must arm a fresh one instead of trusting the corpse."""
+    health.enable(crash_dir=str(tmp_path), stall_timeout_s=100.0,
+                  stall_action="raise")
+    wd = health.watchdog()
+    wd.stop()                        # simulate the post-fire dead thread
+    assert not wd.running
+    assert health.healthz()["watchdog"]["running"] is False
+    health.enable(crash_dir=str(tmp_path), stall_timeout_s=100.0)
+    wd2 = health.watchdog()
+    assert wd2 is not wd and wd2.running
+
+
+def test_enable_explicit_reconfig_replaces_running_watchdog(tmp_path):
+    """An explicit stall_timeout_s/stall_action on enable() must replace
+    a running watchdog, not silently keep the old configuration."""
+    health.enable(crash_dir=str(tmp_path), stall_timeout_s=300.0)
+    wd = health.watchdog()
+    assert wd.timeout == 300.0 and wd.action == "record"
+    health.enable(stall_timeout_s=30.0, stall_action="raise")
+    wd2 = health.watchdog()
+    assert wd2 is not wd
+    assert wd2.timeout == 30.0 and wd2.action == "raise" and wd2.running
+    assert not wd.running                       # old one stopped
+    health.enable()                             # env-less re-enable: no-op
+    assert health.watchdog() is wd2
+
+
+def test_watchdog_failed_fire_keeps_watching_in_raise_mode():
+    """A fire that dies before delivering its interrupt must not end
+    coverage: the thread only exits once the interrupt was delivered."""
+    tele.enable()
+    wd = health.HangWatchdog(0.2, action="raise", poll=0.05)
+    boom = {"n": 0}
+
+    def exploding_fire(idle):
+        boom["n"] += 1
+        raise RuntimeError("fire handler died")
+
+    wd._fire = exploding_fire
+    wd.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while boom["n"] < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert boom["n"] >= 2          # kept firing — thread survived
+        assert wd.running
+    finally:
+        wd.stop()
+
+
+def test_disable_from_non_main_thread_keeps_sigterm_restorable(tmp_path):
+    """disable() off the main thread cannot touch signal dispositions —
+    it must RETAIN the saved previous handler (so the installed hook
+    still chains and a later main-thread disable restores), not discard
+    it and leave SIGTERM swallowed forever."""
+    import signal as _signal
+    import threading as _threading
+    prev = _signal.getsignal(_signal.SIGTERM)
+    health.enable(crash_dir=str(tmp_path))
+    assert _signal.getsignal(_signal.SIGTERM) is health._on_sigterm
+    t = _threading.Thread(target=health.disable)
+    t.start()
+    t.join()
+    # handler still installed, but the original is still saved
+    assert _signal.getsignal(_signal.SIGTERM) is health._on_sigterm
+    assert health._prev_sigterm is prev
+    health.disable()                 # main thread: actually restores
+    assert _signal.getsignal(_signal.SIGTERM) is prev
+
+
+def test_watchdog_quiet_while_heartbeats_flow():
+    tele.enable()
+    wd = health.HangWatchdog(0.4, poll=0.05)
+    wd.start()
+    try:
+        for _ in range(12):
+            health.beat("train_step.dispatch")
+            time.sleep(0.05)
+    finally:
+        wd.stop()
+    assert wd.stalls == 0
+
+
+def test_watchdog_stall_flushes_bundle(tmp_path):
+    health.enable(crash_dir=str(tmp_path / "crash"), stall_timeout_s=0.25)
+    wd = health.watchdog()
+    assert wd is not None
+    # shorten the poll for the test
+    wd.stop()
+    wd._poll = 0.05
+    wd.start()
+    # poll for the BUNDLE, not the stall counter: stalls increments at
+    # the start of the handler, the flush lands at its end
+    rec = health.flight_recorder()
+    deadline = time.monotonic() + 10.0
+    while not rec.flushed and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert wd.stalls >= 1
+    bundles = os.listdir(tmp_path / "crash")
+    assert any(b.startswith("crash_") for b in bundles)
+    bundle = health.read_bundle(
+        str(tmp_path / "crash" / sorted(bundles)[0]))
+    assert bundle["reason"] == "stall"
+
+
+def test_watchdog_one_bundle_per_hang_episode(tmp_path):
+    """A persistent hang refires every window (counter/journal), but
+    writes exactly ONE bundle — re-dumping an identical multi-MB bundle
+    per window would fill the crash dir the post-mortem is meant for.
+    A heartbeat between fires starts a new episode → a second bundle."""
+    health.enable(crash_dir=str(tmp_path / "crash"), stall_timeout_s=0.2)
+    wd = health.watchdog()
+    wd.stop()
+    wd._poll = 0.05
+    wd.start()
+    deadline = time.monotonic() + 10.0
+    while wd.stalls < 3 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert wd.stalls >= 3
+    assert len(os.listdir(tmp_path / "crash")) == 1
+    health.beat("train_step.dispatch")      # progress → new episode
+    rec = health.flight_recorder()
+    deadline = time.monotonic() + 10.0
+    while len(rec.flushed) < 2 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert len(os.listdir(tmp_path / "crash")) == 2
+
+
+def test_journal_and_bundle_are_strict_json(tmp_path):
+    """NaN probe/anomaly rows — the rows the feature exists to deliver —
+    must serialize as strict RFC 8259 JSON (no bare NaN/Infinity tokens
+    that jq/JSON.parse/Go ingestion reject)."""
+    health.enable(crash_dir=str(tmp_path))
+    tele.enable(journal_path=str(tmp_path / "j.jsonl"))
+    mon = health.monitor()
+    mon.observe(3, loss=float("nan"), grad_norm=float("inf"), nonfinite=2)
+    path = health.dump_bundle("strict_json_check")
+
+    def strict(s):
+        return json.loads(s, parse_constant=lambda c: (_ for _ in ()).throw(
+            ValueError(f"non-strict token {c}")))
+
+    for line in open(tmp_path / "j.jsonl"):
+        row = strict(line)
+        if row["event"] == "health_probe":
+            assert row["loss"] == "NaN" and row["grad_norm"] == "Infinity"
+    bundle = strict(open(path).read())
+    assert bundle["anomalies"]          # NaN rows made it through, legibly
+
+
+def test_elastic_watchdog_honors_stall_suppression():
+    from mxnet_tpu.elastic import Watchdog
+    tele.enable()
+    wd = Watchdog(timeout=0.2)
+    with wd:
+        with health.suppress_stalls("compile"):
+            time.sleep(0.7)             # >> timeout, but suppressed
+        assert not wd.fired
+        time.sleep(0.1)                 # window end restarted the clock
+        assert not wd.fired
+
+
+def test_elastic_watchdog_one_bundle_per_episode(tmp_path):
+    from mxnet_tpu.elastic import Watchdog
+    health.enable(crash_dir=str(tmp_path / "crash"))
+    wd = Watchdog(timeout=0.2)
+    with wd:
+        deadline = time.monotonic() + 10.0
+        while not wd.fired and time.monotonic() < deadline:
+            time.sleep(0.05)
+        time.sleep(0.6)   # let it refire at least once more
+    assert wd.fired
+    bundles = [b for b in os.listdir(tmp_path / "crash")
+               if b.startswith("crash_")]
+    assert len(bundles) == 1   # refires share the first episode's bundle
+
+
+# ---------------------------------------------------------------------------
+# enable/disable + crash handlers
+# ---------------------------------------------------------------------------
+
+def test_enable_implies_telemetry_and_installs_hook(tmp_path):
+    assert not tele.enabled()
+    health.enable(crash_dir=str(tmp_path))
+    assert health.enabled() and tele.enabled()
+    assert health.probes_enabled()
+    assert sys.excepthook is health._excepthook
+    health.disable()
+    assert sys.excepthook is not health._excepthook
+    assert not health.probes_enabled()
+
+
+def test_atexit_flush_only_on_abnormal(tmp_path):
+    health.enable(crash_dir=str(tmp_path))
+    health._atexit_flush()           # clean run: nothing recorded
+    assert not os.listdir(tmp_path)
+    health.monitor().observe(1, loss=1.0, grad_norm=1.0, nonfinite=2)
+    health._atexit_flush()           # anomaly on record → bundle
+    assert any(f.startswith("crash_") for f in os.listdir(tmp_path))
+
+
+def test_dump_bundle_helper(tmp_path):
+    health.enable(crash_dir=str(tmp_path))
+    path = health.dump_bundle("manual")
+    assert path and health.read_bundle(path)["reason"] == "manual"
+
+
+# ---------------------------------------------------------------------------
+# ShardedTrainStep numerics probes (end-to-end)
+# ---------------------------------------------------------------------------
+
+def test_probes_off_by_default_and_no_retrace():
+    step = _make_step()
+    xs, ys = _data()
+    h = step.dispatch(xs, ys)
+    assert h.probes is None
+    float(jax.device_get(h.loss))
+    assert step.trace_count == 1
+
+
+def test_probes_ride_dispatch_and_feed_monitor(tmp_path):
+    health.enable(crash_dir=str(tmp_path))
+    step = _make_step()
+    xs, ys = _data()
+    handles = [step.dispatch(xs, ys) for _ in range(3)]
+    assert handles[-1].probes is not None
+    assert set(handles[-1].probes) == {"grad_norm", "nonfinite"}
+    # f32, not i32: an int32 count wraps negative on >=2^31 nonfinite
+    # elements (giant model, all-NaN grads) and poisons the counter
+    assert handles[-1].probes["nonfinite"].dtype == jnp.float32
+    float(jax.device_get(handles[-1].loss))
+    step.steps_in_flight()           # drain → monitor observes
+    assert step.trace_count == 1     # probe branch is part of THE trace
+    mon = health.monitor()
+    assert mon.observations == 3
+    assert not mon.anomalies         # clean data: no anomaly
+    snap = tele.snapshot()
+    assert snap["health_grad_norm"]["series"][0]["value"] > 0
+    assert "health_loss" in snap
+
+
+def test_nan_batch_triggers_nonfinite_anomaly(tmp_path):
+    """The acceptance loop: an injected NaN gradient produces the
+    counter increment and an anomaly journal event with the right step."""
+    health.enable(crash_dir=str(tmp_path))
+    tele.enable(journal_path=str(tmp_path / "j.jsonl"))
+    step = _make_step()
+    xs, ys = _data()
+    nan_xs = (xs * float("nan")).astype(onp.float32)
+    h = None
+    for i in range(4):
+        h = step.dispatch(nan_xs if i == 2 else xs, ys)  # NaN at step 3
+    float(jax.device_get(h.loss))
+    step.steps_in_flight()
+    assert step.trace_count == 1
+    assert tele.counter("health_nonfinite_total").value() >= 1
+    rows = tele.RunJournal.read(str(tmp_path / "j.jsonl"))
+    anomalies = [r for r in rows if r["event"] == "anomaly"
+                 and r["rule"] == "nonfinite_grads"]
+    assert anomalies and anomalies[0]["step"] == 3
+    # the flight recorder saw the same events (tap, not journal)
+    rec_events = [r["event"] for r in health.flight_recorder().events()]
+    assert "anomaly" in rec_events and "health_probe" in rec_events
+
+
+def test_inflight_source_registered():
+    step = _make_step()
+    xs, ys = _data()
+    step.dispatch(xs, ys)
+    sources = health._collect_inflight()
+    assert any(s["source"] == "ShardedTrainStep" for s in sources)
+
+
+# ---------------------------------------------------------------------------
+# amp.LossScaler wiring
+# ---------------------------------------------------------------------------
+
+def test_loss_scaler_feeds_health(tmp_path):
+    from mxnet_tpu.amp import LossScaler
+    health.enable(crash_dir=str(tmp_path))
+    scaler = LossScaler(init_scale=8.0, scale_factor=2.0, tolerance=0.0)
+    for _ in range(4):
+        scaler.update_scale(overflow=True)
+    assert scaler.loss_scale == 1.0
+    mon = health.monitor()
+    assert any(a["rule"] == "loss_scale_collapse" for a in mon.anomalies)
+    assert tele.registry().get("health_loss_scale").value() == 1.0
+
+
+def test_loss_scaler_noop_without_health():
+    from mxnet_tpu.amp import LossScaler
+    scaler = LossScaler(init_scale=8.0)
+    scaler.update_scale(overflow=False)      # must not touch the registry
+    assert "health_loss_scale" not in tele.registry()
+
+
+# ---------------------------------------------------------------------------
+# elastic + /healthz integration
+# ---------------------------------------------------------------------------
+
+def test_elastic_loop_defaults_watchdog_from_env(tmp_path, monkeypatch):
+    from mxnet_tpu.elastic import ElasticLoop
+
+    class _Target:
+        def save(self, p):
+            open(p, "wb").close()
+
+        def load(self, p):
+            pass
+
+    monkeypatch.setenv("MXTPU_STALL_TIMEOUT", "33")
+    loop = ElasticLoop(_Target(), directory=str(tmp_path))
+    assert loop.watchdog_timeout == 33.0
+    monkeypatch.delenv("MXTPU_STALL_TIMEOUT")
+    loop = ElasticLoop(_Target(), directory=str(tmp_path))
+    assert loop.watchdog_timeout is None
+
+
+def test_elastic_watchdog_pings_process_heartbeat():
+    from mxnet_tpu.elastic import Watchdog
+    wd = Watchdog(timeout=60)
+    wd.ping()
+    assert "elastic_step" in health.heartbeat_ages()
+
+
+def test_healthz_http_endpoint():
+    tele.enable()
+    srv = tele.serve_metrics(port=0)
+    try:
+        health.beat("train_step.dispatch")
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz", timeout=5) as r:
+            payload = json.loads(r.read())
+        assert "train_step.dispatch" in payload["heartbeats"]
+        assert "steps_in_flight" in payload
+    finally:
+        srv.stop()

@@ -541,3 +541,112 @@ def test_ten_step_loop_acceptance(tmp_path, monkeypatch, shm_leak_check):
     assert any(r["event"].startswith("compile") for r in rows)
     assert any(r["event"] == "checkpoint_write" for r in rows)
     assert any(r["event"] == "worker_death" for r in rows)
+
+
+# ---------------------------------------------------------------------------
+# PR 4 satellites: histogram bucket overrides, journal failure modes,
+# atexit thread shutdown
+# ---------------------------------------------------------------------------
+
+def test_histogram_custom_buckets_override():
+    h = tele.registry().histogram("gnorm", buckets=(0.1, 1.0, 10.0))
+    assert h.buckets == (0.1, 1.0, 10.0, float("inf"))
+    h.observe(0.5)
+    assert h.count() == 1
+
+
+def test_histogram_buckets_must_be_monotone():
+    with pytest.raises(ValueError, match="strictly increasing"):
+        tele.registry().histogram("bad_b", buckets=(1.0, 1.0, 2.0))
+    with pytest.raises(ValueError, match="strictly increasing"):
+        tele.registry().histogram("bad_b2", buckets=(5.0, 1.0))
+    with pytest.raises(ValueError, match="at least one"):
+        tele.registry().histogram("bad_b3", buckets=())
+
+
+def test_histogram_reregister_conflicting_buckets_raises():
+    tele.registry().histogram("h_conf", buckets=(1.0, 2.0))
+    with pytest.raises(ValueError, match="already registered with"):
+        tele.registry().histogram("h_conf", buckets=(1.0, 3.0))
+    # same explicit buckets or no buckets at all: fine, same object
+    h1 = tele.registry().histogram("h_conf", buckets=(1.0, 2.0))
+    h2 = tele.registry().histogram("h_conf")
+    assert h1 is h2
+    # hot-path callers that omit buckets never conflict with a custom one
+    assert tele.histogram("h_conf").buckets == (1.0, 2.0, float("inf"))
+
+
+def test_histogram_default_buckets_when_unspecified():
+    h = tele.histogram("h_default")
+    assert h.buckets[:-1] == tele.DEFAULT_MS_BUCKETS
+
+
+def test_journal_unwritable_path_degrades(tmp_path):
+    """An unwritable journal path must disable the journal, not abort the
+    training run that asked for observability (no raise mid-training)."""
+    blocker = tmp_path / "file"
+    blocker.write_text("x")          # a FILE where a directory is needed
+    j = tele.RunJournal(str(blocker / "sub" / "j.jsonl"))
+    assert j.disabled
+    j.record("event_after_degrade", step=1)   # silent no-op, no raise
+    j.close()
+
+
+def test_enable_with_unwritable_journal_keeps_training(tmp_path):
+    blocker = tmp_path / "f"
+    blocker.write_text("x")
+    tele.enable(journal_path=str(blocker / "nope" / "j.jsonl"))
+    assert tele.enabled()
+    assert tele.journal().disabled
+    tele.event("anything", step=1)   # must not raise
+    tele.counter("still_works").inc()
+    assert tele.counter("still_works").value() == 1
+
+
+def test_journal_no_rotation_unbounded_append(tmp_path):
+    """Cap-behavior contract, stated as a test: the journal does NOT
+    rotate — every row is retained in one append-only file (operators
+    size the filesystem; the bounded view is the health flight-recorder
+    ring).  If rotation is ever added this test must change with it."""
+    path = str(tmp_path / "big.jsonl")
+    j = tele.RunJournal(path)
+    for i in range(500):
+        j.record("e", step=i)
+    j.close()
+    rows = tele.RunJournal.read(path)
+    assert len(rows) == 500                    # nothing dropped
+    assert rows[0]["seq"] == 1 and rows[-1]["seq"] == 500
+    assert not os.path.exists(path + ".1")     # no rotation artifacts
+
+
+def test_journal_survives_write_error_midstream(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = tele.RunJournal(path)
+    j.record("ok", step=1)
+    j._f.close()                      # simulate the fd dying (full disk)
+    j.record("after_dead_fd", step=2)  # swallowed, no raise
+    j.close()
+    assert [r["event"] for r in tele.RunJournal.read(path)] == ["ok"]
+
+
+def test_atexit_shutdown_joins_threads():
+    tele.enable(memmon_interval=0.05, port=0)
+    mm = tele.memory_monitor()
+    srv = tele.metrics_server()
+    assert mm is not None and mm._thread.is_alive()
+    assert srv is not None and srv._thread.is_alive()
+    tele._atexit_shutdown()
+    assert mm._thread is None or not mm._thread.is_alive()
+    assert srv._thread is None
+    assert not tele.enabled()
+
+
+def test_enable_registers_atexit_once(monkeypatch):
+    calls = []
+    import atexit as _atexit
+    monkeypatch.setattr(tele, "_atexit_registered", False)
+    monkeypatch.setattr(_atexit, "register", lambda fn: calls.append(fn))
+    tele.enable()
+    tele.disable()
+    tele.enable()
+    assert calls == [tele._atexit_shutdown]

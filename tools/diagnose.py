@@ -1,8 +1,17 @@
 #!/usr/bin/env python
 """Environment diagnosis (parity: `tools/diagnose.py`): platform, python,
-framework features, device inventory, key environment variables."""
+framework features, device inventory, key environment variables.
+
+Also pretty-prints crash flight-recorder bundles (docs/observability.md,
+"Training health & post-mortems"):
+
+    python tools/diagnose.py --bundle <crash_*.json>
+    python tools/diagnose.py --crash-dir <dir>     # newest bundle in dir
+"""
 from __future__ import annotations
 
+import glob
+import json
 import os
 import platform
 import sys
@@ -10,7 +19,96 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
+def _fmt_ts(ts):
+    import time
+    try:
+        return time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime(ts))
+    except (TypeError, ValueError, OverflowError):
+        return str(ts)
+
+
+def print_bundle(path: str) -> int:
+    """Human-readable view of one flight-recorder bundle."""
+    try:
+        with open(path) as f:
+            b = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"cannot read bundle {path}: {e}", file=sys.stderr)
+        return 1
+    print(f"========== crash bundle: {path} ==========")
+    print(f"reason    : {b.get('reason')}")
+    print(f"time      : {_fmt_ts(b.get('time'))}")
+    print(f"pid       : {b.get('pid')}   last step: {b.get('last_step')}")
+    if b.get("argv"):
+        print(f"argv      : {' '.join(b['argv'])}")
+    exc = b.get("exception")
+    if exc:
+        print(f"exception : {exc.get('type')}: {exc.get('message')}")
+    hb = b.get("heartbeats") or {}
+    if hb:
+        print("---------- heartbeat ages (s) ----------")
+        for name, age in sorted(hb.items()):
+            print(f"  {name:<24} {age}")
+    for src in b.get("steps_in_flight") or []:
+        print(f"in flight : {src.get('count')} step(s) from "
+              f"{src.get('source')}: {src.get('ids')}")
+    anomalies = b.get("anomalies") or []
+    if anomalies:
+        print(f"---------- anomalies ({len(anomalies)}) ----------")
+        for a in anomalies[-20:]:
+            extra = {k: v for k, v in a.items()
+                     if k not in ("rule", "step", "time")}
+            print(f"  step {a.get('step')}: {a.get('rule')} {extra}")
+    events = b.get("events") or []
+    print(f"---------- last events ({len(events)} in ring) ----------")
+    for ev in events[-30:]:
+        extra = {k: v for k, v in ev.items()
+                 if k not in ("event", "step", "ts", "seq")}
+        print(f"  step {str(ev.get('step')):>6}  {ev.get('event'):<20} "
+              f"{extra if extra else ''}")
+    metrics = b.get("metrics") or {}
+    health_metrics = {k: v for k, v in metrics.items()
+                      if k.startswith(("health_", "steps_in_flight",
+                                       "trace_count"))}
+    if health_metrics:
+        print("---------- health metrics ----------")
+        for name, m in sorted(health_metrics.items()):
+            for s in m.get("series", []):
+                val = s.get("value", s.get("count"))
+                lbl = s.get("labels") or ""
+                print(f"  {name}{lbl} = {val}")
+    if exc and exc.get("traceback"):
+        print("---------- traceback ----------")
+        print(exc["traceback"].rstrip())
+    if b.get("stacks"):
+        print("---------- all-thread stacks (tail) ----------")
+        print(b["stacks"][-4000:].rstrip())
+    return 0
+
+
+def _newest_bundle(crash_dir: str):
+    paths = glob.glob(os.path.join(crash_dir, "crash_*.json"))
+    return max(paths, key=os.path.getmtime) if paths else None
+
+
+def _flag_operand(flag: str) -> str:
+    idx = sys.argv.index(flag)
+    if idx + 1 >= len(sys.argv):
+        print(f"usage: diagnose.py {flag} <path>", file=sys.stderr)
+        sys.exit(2)
+    return sys.argv[idx + 1]
+
+
 def main():
+    if "--bundle" in sys.argv:
+        return sys.exit(print_bundle(_flag_operand("--bundle")))
+    if "--crash-dir" in sys.argv:
+        d = _flag_operand("--crash-dir")
+        newest = _newest_bundle(d)
+        if newest is None:
+            print(f"no crash_*.json bundles in {d}", file=sys.stderr)
+            return sys.exit(1)
+        return sys.exit(print_bundle(newest))
     print("----------Platform Info----------")
     print(f"system  : {platform.system()} {platform.release()}")
     print(f"machine : {platform.machine()}")

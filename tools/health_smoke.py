@@ -1,0 +1,159 @@
+"""Training-health smoke check (`make health-smoke`, docs/observability.md).
+
+End-to-end proof of the health subsystem over the ENV wiring a production
+run would use: the parent process arms ``MXTPU_HEALTH=1``, a crash dir, a
+journal path, and a NaN injection via the existing ``MXTPU_FAULT_SPEC``
+registry, then runs a 12-step CPU training loop in a child process that
+ends in a forced crash.  It asserts:
+
+1. the numerics probes counted the injected non-finite gradients
+   (``health_nonfinite_total`` > 0 in the bundle's metrics snapshot),
+2. an ``anomaly`` journal event carries the exact step the NaN entered,
+3. the forced crash left a flight-recorder bundle in ``MXTPU_CRASH_DIR``
+   holding >= 32 journal events plus the telemetry snapshot,
+4. the probe branch kept the step at one trace (``trace_count == 1``).
+
+Pure stdlib on the parent side; exits non-zero with a reason on failure.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+STEPS = 12
+NAN_AT = 5          # fault hit N fires on loop iteration N-1 = step id N
+
+
+def _child() -> int:
+    """The instrumented training run. Everything is armed through the
+    environment (set by the parent) before mxnet_tpu imports."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+
+    import mxnet_tpu as mx  # noqa: F401 — auto-enables telemetry + health
+    from mxnet_tpu import health, optimizer as opt, telemetry
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import make_mesh, make_sharded_train_step
+    from mxnet_tpu.resilience import FaultInjected, fault_point
+
+    assert telemetry.enabled(), "MXTPU_TELEMETRY env wiring broken"
+    assert health.enabled(), "MXTPU_HEALTH env wiring broken"
+
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+    mesh = make_mesh({"dp": 1}, jax.devices()[:1])
+    step = make_sharded_train_step(
+        net, opt.SGD(learning_rate=1e-2),
+        lambda out, x, y: jnp.mean((out - y) ** 2), mesh, num_model_args=1)
+    rng = onp.random.RandomState(0)
+    xs = rng.uniform(-1, 1, (8, 8)).astype("float32")
+    ys = rng.uniform(-1, 1, (8, 4)).astype("float32")
+
+    handle = None
+    for _ in range(STEPS):
+        x = xs
+        try:
+            # the injection *timing* comes from the armed MXTPU_FAULT_SPEC
+            # registry (nan_batch@N); the payload is a poisoned batch —
+            # exactly how a bad record or a corrupt H2D shows up for real
+            fault_point("nan_batch")
+        except FaultInjected:
+            x = xs * float("nan")
+        handle = step.dispatch(x, ys)
+    jax.device_get(handle.loss)
+    step.steps_in_flight()   # retire stragglers → health monitor observes
+
+    assert step.trace_count == 1, \
+        f"probes caused retrace: trace_count={step.trace_count}"
+    mon = health.monitor()
+    assert mon is not None and mon.anomalies, "no anomalies recorded"
+    raise RuntimeError("health-smoke forced crash (expected)")
+
+
+def main() -> int:
+    if "--child" in sys.argv:
+        return _child()
+
+    workdir = tempfile.mkdtemp(prefix="mxtpu-health-smoke-")
+    crash_dir = os.path.join(workdir, "crash")
+    journal = os.path.join(workdir, "journal.jsonl")
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "MXTPU_TELEMETRY": journal,
+        "MXTPU_HEALTH": "1",
+        "MXTPU_CRASH_DIR": crash_dir,
+        "MXTPU_FAULT_SPEC": f"nan_batch@{NAN_AT}",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        env=env, capture_output=True, text=True, timeout=600,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    if proc.returncode == 0:
+        print("FAIL: child was expected to crash but exited 0",
+              file=sys.stderr)
+        print(proc.stdout + proc.stderr, file=sys.stderr)
+        return 1
+    if "health-smoke forced crash" not in proc.stderr:
+        print(f"FAIL: child died for the wrong reason (rc="
+              f"{proc.returncode}):\n{proc.stderr[-3000:]}", file=sys.stderr)
+        return 1
+
+    # (b) anomaly journal event with the exact offending step id
+    rows = []
+    with open(journal) as f:
+        for line in f:
+            if line.strip():
+                rows.append(json.loads(line))
+    anomalies = [r for r in rows if r["event"] == "anomaly"]
+    if not anomalies:
+        print("FAIL: no anomaly journal event", file=sys.stderr)
+        return 1
+    if anomalies[0]["step"] != NAN_AT or \
+            anomalies[0]["rule"] != "nonfinite_grads":
+        print(f"FAIL: first anomaly should be nonfinite_grads at step "
+              f"{NAN_AT}, got {anomalies[0]}", file=sys.stderr)
+        return 1
+
+    # (c) crash bundle with >= 32 events + metrics snapshot
+    bundles = [os.path.join(crash_dir, f) for f in os.listdir(crash_dir)
+               if f.startswith("crash_")] if os.path.isdir(crash_dir) else []
+    if not bundles:
+        print(f"FAIL: no crash bundle in {crash_dir}", file=sys.stderr)
+        return 1
+    with open(sorted(bundles)[0]) as f:
+        bundle = json.load(f)
+    if bundle.get("reason") != "exception":
+        print(f"FAIL: bundle reason {bundle.get('reason')!r} != 'exception'",
+              file=sys.stderr)
+        return 1
+    if len(bundle.get("events", [])) < 32:
+        print(f"FAIL: bundle holds {len(bundle.get('events', []))} events, "
+              f"want >= 32", file=sys.stderr)
+        return 1
+    if "metrics" not in bundle:
+        print("FAIL: bundle carries no telemetry snapshot", file=sys.stderr)
+        return 1
+
+    # (a) the nonfinite counter actually incremented
+    nonf = bundle["metrics"].get("health_nonfinite_total", {})
+    total = sum(s.get("value", 0) for s in nonf.get("series", []))
+    if total < 1:
+        print(f"FAIL: health_nonfinite_total == {total}, want >= 1",
+              file=sys.stderr)
+        return 1
+
+    print(f"health smoke OK: {len(anomalies)} anomalies (first at step "
+          f"{anomalies[0]['step']}), bundle {sorted(bundles)[0]} with "
+          f"{len(bundle['events'])} events, nonfinite={int(total)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
