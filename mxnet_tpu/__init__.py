@@ -73,6 +73,7 @@ from . import parallel  # noqa: F401
 from . import profiler  # noqa: F401
 from . import telemetry  # noqa: F401
 from . import health  # noqa: F401
+from . import recovery  # noqa: F401
 from . import amp  # noqa: F401
 from . import runtime  # noqa: F401
 from . import util  # noqa: F401

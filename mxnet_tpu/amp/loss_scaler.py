@@ -24,6 +24,11 @@ class LossScaler:
         self._iter = 0
         self._last_overflow_iter = -1
         self._last_rescale_iter = -1
+        # iter of the last shrink update_scale ITSELF performed — the
+        # recovery policy defers its backoff only when the loop's own
+        # AMP handling actually shrank (a tolerated overflow must still
+        # be backed off); backoff() deliberately does not touch this
+        self._last_loop_shrink_iter = -1
         self._overflows_since_rescale = 0
         # amp.disable()/re-init flips this so Trainers holding a stale
         # reference stop scaling instead of dividing unscaled grads
@@ -48,17 +53,48 @@ class LossScaler:
             return False
         return not bool(jnp.stack(checks).all())
 
+    def backoff(self, factor=None) -> float:
+        """Immediately shrink the scale (floored at 1.0) outside the
+        normal per-step `update_scale` cadence — the recovery policy's
+        tier-1 remediation: when a non-finite gradient forced a skipped
+        update, waiting for the tolerance window to shrink the scale
+        would keep producing overflow steps, so the policy backs it off
+        right away.  Resets the overflow-window accounting (a deliberate
+        rescale starts a fresh window) and returns the new scale."""
+        f = self._scale_factor if factor is None else factor
+        self.loss_scale = max(self.loss_scale / f, 1.0)
+        self._last_rescale_iter = self._iter
+        self._overflows_since_rescale = 0
+        from .. import health as _health
+        if _health.enabled():
+            mon = _health.monitor()
+            if mon is not None:
+                mon.note_loss_scale(self.loss_scale)
+        return self.loss_scale
+
     def update_scale(self, overflow: bool):
         if overflow:
             self._last_overflow_iter = self._iter
-            self._overflows_since_rescale += 1
-            since_rescale = self._iter - self._last_rescale_iter
-            ratio = self._overflows_since_rescale / max(since_rescale, 1)
-            if ratio >= self._tolerance:
-                self.loss_scale = max(self.loss_scale / self._scale_factor,
-                                      1.0)
-                self._last_rescale_iter = self._iter
-                self._overflows_since_rescale = 0
+            if self._iter == self._last_rescale_iter:
+                # this very step already rescaled — the recovery policy's
+                # backoff() reacted to the same overflow before the AMP
+                # loop's own update_scale reached it.  One penalty per
+                # step: shrinking again here would collapse the scale at
+                # factor^2 per NaN step.  (Unreachable from the normal
+                # path: a shrink below records this iter and then _iter
+                # advances before the next call.)
+                pass
+            else:
+                self._overflows_since_rescale += 1
+                since_rescale = self._iter - self._last_rescale_iter
+                ratio = self._overflows_since_rescale / \
+                    max(since_rescale, 1)
+                if ratio >= self._tolerance:
+                    self.loss_scale = max(
+                        self.loss_scale / self._scale_factor, 1.0)
+                    self._last_rescale_iter = self._iter
+                    self._last_loop_shrink_iter = self._iter
+                    self._overflows_since_rescale = 0
         elif (self._iter - self._last_overflow_iter) % self._scale_window \
                 == 0:
             self.loss_scale *= self._scale_factor

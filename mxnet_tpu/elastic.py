@@ -28,11 +28,9 @@ Usage::
 """
 from __future__ import annotations
 
-import faulthandler
 import logging
 import os
 import signal
-import sys
 import threading
 import time
 from typing import Callable, Optional, Sequence
@@ -40,6 +38,8 @@ from typing import Callable, Optional, Sequence
 import jax
 
 from . import health as _health
+from . import recovery as _recovery
+from . import telemetry as _tele
 from .base import MXNetError
 from .resilience import fault_point, retry_with_backoff
 from .utils.checkpoint import CheckpointManager
@@ -51,7 +51,8 @@ _log = logging.getLogger(__name__)
 
 
 class PreemptionGuard:
-    """Convert termination signals into a cooperative stop flag.
+    """Convert termination signals into a cooperative stop flag, with a
+    grace-deadline emergency-checkpoint path.
 
     Installs handlers for `signals` (default SIGTERM — what Cloud TPU
     preemption delivers) that set :attr:`preempted` instead of killing the
@@ -59,26 +60,166 @@ class PreemptionGuard:
     the previous handlers on exit. Signal handlers only work on the main
     thread; elsewhere the guard degrades to a manual flag
     (:meth:`request_stop`).
+
+    `grace` (default ``MXTPU_PREEMPT_GRACE``) is the seconds between the
+    signal and the scheduler's SIGKILL; when set, the signal arms a
+    deadline and :meth:`emergency_checkpoint` budgets its work against it:
+    cancel the prefetcher, drain in-flight steps (bounded), run a
+    deadline-bounded save, and — when even that cannot fit — fall back to
+    a partial-state resume marker naming the newest complete checkpoint,
+    so the restart resumes from durable state instead of whatever a
+    truncated write left behind.  With no grace configured the emergency
+    path degrades to the classic unbounded save-and-exit.
+
+    `manager`: a `CheckpointManager` whose in-flight async save the
+    guard's exit path waits out (:meth:`__exit__` calls ``wait_async()``)
+    — a background checkpoint write must never be truncated by process
+    teardown racing the writer thread.
     """
 
-    def __init__(self, signals: Sequence[int] = (signal.SIGTERM,)):
+    def __init__(self, signals: Sequence[int] = (signal.SIGTERM,),
+                 grace: Optional[float] = None, manager=None):
         self._signals = tuple(signals)
         self._prev = {}
         self._event = threading.Event()
         self._installed = False
+        self.grace = _recovery.preempt_grace() if grace is None else grace
+        self.manager = manager
+        self._deadline: Optional[float] = None
 
     @property
     def preempted(self) -> bool:
         return self._event.is_set()
 
     def request_stop(self) -> None:
-        """Manually trigger the stop flag (tests, custom schedulers)."""
+        """Manually trigger the stop flag (tests, custom schedulers).
+        Arms the grace deadline exactly like the signal path."""
+        self._arm()
+
+    def _arm(self) -> None:
+        if self.grace and self._deadline is None:
+            self._deadline = time.monotonic() + self.grace
         self._event.set()
 
     def _handler(self, signum, frame):
-        _log.warning("received signal %d: requesting checkpoint-and-exit",
-                     signum)
-        self._event.set()
+        _log.warning("received signal %d: requesting checkpoint-and-exit"
+                     "%s", signum,
+                     f" (grace {self.grace:g}s)" if self.grace else "")
+        self._arm()
+
+    def deadline_remaining(self) -> Optional[float]:
+        """Seconds left in the grace window; None when no grace is
+        configured or no signal has arrived yet (unbounded)."""
+        if self._deadline is None:
+            return None
+        return max(0.0, self._deadline - time.monotonic())
+
+    def emergency_checkpoint(self, manager=None, target=None,
+                             step: int = 0, prefetcher=None,
+                             drain_fraction: float = 0.5) -> dict:
+        """Best-possible durable state inside the grace window.
+        `manager` defaults to the guard's own (the one whose async saves
+        `__exit__` waits out) — passing a different one would drain one
+        manager while saving through another.
+
+        1. cancel the prefetcher (buffered batches are lost by design —
+           they will be re-read on resume; keeping H2D traffic alive
+           only steals deadline from the save),
+        2. drain in-flight dispatched steps, bounded to `drain_fraction`
+           of the remaining deadline (``target.drain(timeout=...)`` when
+           the target supports it),
+        3. wait out any background async save (never truncate one),
+        4. run ``manager.save`` on a worker thread with the remaining
+           deadline; on timeout or error, fall back to a partial-state
+           marker naming the newest *complete* checkpoint on disk,
+        5. write the resumable marker `ElasticLoop.run` honors on
+           restart.
+
+        Returns ``{"step", "checkpoint", "complete", "partial"}``.
+        """
+        if manager is None:
+            manager = self.manager
+        if manager is None or target is None:
+            raise MXNetError("emergency_checkpoint needs a manager "
+                             "(constructor or argument) and a target")
+        t0 = time.monotonic()
+        fault_point("preempt_save")
+        info = {"step": int(step), "checkpoint": None,
+                "complete": False, "partial": False}
+        if prefetcher is not None:
+            try:
+                prefetcher.close()
+            except Exception:
+                _log.exception("preemption: prefetcher cancel failed")
+        remaining = self.deadline_remaining()
+        drain = getattr(target, "drain", None)
+        if callable(drain):
+            try:
+                left = drain(None if remaining is None
+                             else max(0.1, remaining * drain_fraction))
+                if left:
+                    _log.warning("preemption: %d step(s) still in flight "
+                                 "at the drain deadline", left)
+            except Exception:
+                _log.exception("preemption: in-flight drain failed")
+        try:
+            manager.wait_async()
+        except Exception as e:
+            _log.warning("preemption: deferred async save failed (%s); "
+                         "the newest complete checkpoint stands", e)
+        remaining = self.deadline_remaining()
+        if remaining is None:
+            # no grace window: the classic unbounded save-and-exit — a
+            # failure here propagates (pre-deadline behavior), so a
+            # supervisor never mistakes a failed save for a clean preempt
+            info["checkpoint"] = manager.save(target, step)
+            info["complete"] = True
+        else:
+            done: dict = {}
+
+            def _save():
+                try:
+                    done["path"] = manager.save(target, step)
+                except BaseException as e:
+                    done["error"] = e
+
+            t = threading.Thread(target=_save, daemon=True,
+                                 name="mxtpu-preempt-save")
+            t.start()
+            t.join(max(0.1, remaining))
+            if "path" in done:
+                info["checkpoint"] = done["path"]
+                info["complete"] = True
+            else:
+                # deadline too tight (or the write failed): fall back to
+                # a partial-state manifest — the marker records the
+                # newest COMPLETE checkpoint so the restart restores
+                # durable state, and never a half-written file (the
+                # atomic tmp+rename means the aborted save left no
+                # visible checkpoint at all)
+                info["partial"] = True
+                newest = manager.latest()
+                if newest is not None:
+                    info["step"], info["checkpoint"] = newest
+                else:
+                    info["step"] = None
+                _log.error(
+                    "preemption: emergency save did not complete inside "
+                    "the %.1fs grace remainder (%s); resume marker points "
+                    "at the newest complete checkpoint (step %s)",
+                    remaining,
+                    done.get("error", "still writing"), info["step"])
+        _tele.counter(
+            "recovery_preempt_saves_total",
+            "Emergency preemption checkpoints attempted",
+            labelnames=("outcome",)).inc(
+                outcome="complete" if info["complete"] else "partial")
+        _tele.event("remediation", step=info["step"], kind="preempt_save",
+                    complete=info["complete"], partial=info["partial"],
+                    checkpoint=info["checkpoint"],
+                    elapsed_s=round(time.monotonic() - t0, 3))
+        _recovery.write_resume_marker(manager.directory, info)
+        return info
 
     def __enter__(self):
         if threading.current_thread() is threading.main_thread():
@@ -93,25 +234,37 @@ class PreemptionGuard:
                 signal.signal(s, h)
             self._prev.clear()
             self._installed = False
+        if self.manager is not None:
+            # a background save_async must finish before teardown can
+            # truncate it; errors were/will be surfaced by the manager's
+            # own drain paths — here completion is what matters
+            try:
+                self.manager.wait_async()
+            except Exception as e:
+                _log.warning("preemption guard: deferred async save "
+                             "failed during exit (%s)", e)
         return False
 
 
 class Watchdog:
-    """Hang detector: a daemon thread that fires if :meth:`ping` is not
-    called within `timeout` seconds.
+    """Loop-level hang detector: fires if :meth:`ping` is not called
+    within `timeout` seconds.
 
-    On expiry it dumps every thread's stack to stderr (the evidence a hung
-    collective leaves nowhere else), invokes `on_hang`, and — when
-    `kill=True` — SIGABRTs the process so a supervisor can restart it. The
-    default is detect-and-report only.
+    .. deprecated:: PR 5
+        This is now a thin shim over `mx.health.HangWatchdog`, scoped to
+        the shared ``elastic_step`` heartbeat — detection, stall
+        accounting (``health_stalls_total``, ``stall`` journal events,
+        one flight-recorder bundle per hang episode), stack dumps, and
+        stall suppression during compile windows all live in ONE place.
+        New code should arm ``MXTPU_STALL_TIMEOUT`` (or
+        `health.enable(stall_timeout_s=...)`) and let the process-wide
+        watchdog cover every hot path; this class remains for the
+        loop-scoped ``on_hang``/``kill`` contract.
 
-    This is the LOOP-level detector (one ping per completed step).  The
-    process-wide generalization lives in `mx.health.HangWatchdog`: every
-    hot path (dispatch/retire, prefetch, DataLoader) touches a named
-    heartbeat and one monitor covers them all, with a flight-recorder
-    bundle on stall.  `ping` here also touches the ``elastic_step``
-    heartbeat so both detectors share one liveness signal, and a firing
-    expiry flushes a post-mortem bundle when the health subsystem is up.
+    On expiry the underlying watchdog dumps every thread's stack to
+    stderr, records the stall, and this shim invokes `on_hang` and —
+    when `kill=True` — SIGABRTs the process so a supervisor can restart
+    it. The default is detect-and-report only.
     """
 
     def __init__(self, timeout: float, on_hang: Optional[Callable] = None,
@@ -122,66 +275,35 @@ class Watchdog:
         self.on_hang = on_hang
         self.kill = kill
         self.fired = False
-        self._bundle_dumped = False
-        self._last = time.monotonic()
-        self._stop = threading.Event()
-        self._thread = None
+        self._wd: Optional[_health.HangWatchdog] = None
 
     def ping(self) -> None:
-        self._last = time.monotonic()
-        # progress since the last expiry: the next one is a NEW hang
-        # episode and deserves a fresh post-mortem bundle
-        self._bundle_dumped = False
+        # the shared heartbeat IS the liveness state: the shim's private
+        # HangWatchdog watches only this name, and a fresh beat both
+        # resets its clock and starts a new bundle episode
         _health.beat("elastic_step")
 
-    def _watch(self):
-        while not self._stop.wait(min(self.timeout / 4, 1.0)):
-            if _health.stalls_suppressed():
-                # an announced long block (cold-start XLA compile inside
-                # step_fn) produces no pings but is not a hang — mirror
-                # the process-wide watchdog and restart the clock
-                self._last = time.monotonic()
-                continue
-            if time.monotonic() - self._last > self.timeout:
-                self.fired = True
-                _log.error("watchdog: no step completion in %.1fs — "
-                           "dumping stacks", self.timeout)
-                try:
-                    faulthandler.dump_traceback(file=sys.stderr)
-                except Exception:
-                    pass
-                try:
-                    # shared stall accounting (counter + journal event
-                    # with heartbeats/in-flight ids); one bundle per
-                    # hang episode (a persistent hang refires every
-                    # window; ping() resets the flag)
-                    _health.record_stall("elastic_watchdog", self.timeout,
-                                         dump=not self._bundle_dumped)
-                    self._bundle_dumped = True
-                except Exception:
-                    pass
-                if self.on_hang is not None:
-                    try:
-                        self.on_hang()
-                    except Exception:
-                        _log.exception("watchdog on_hang callback failed")
-                if self.kill:
-                    os.kill(os.getpid(), signal.SIGABRT)
-                self._last = time.monotonic()  # avoid refiring every poll
+    def _on_stall(self, info: dict) -> None:
+        self.fired = True
+        if self.on_hang is not None:
+            try:
+                self.on_hang()
+            except Exception:
+                _log.exception("watchdog on_hang callback failed")
+        if self.kill:
+            os.kill(os.getpid(), signal.SIGABRT)
 
     def __enter__(self):
         self.ping()
-        self._stop.clear()
-        self._thread = threading.Thread(target=self._watch, daemon=True,
-                                        name="mxtpu-watchdog")
-        self._thread.start()
+        self._wd = _health.HangWatchdog(
+            self.timeout, action="record", on_stall=self._on_stall,
+            names=("elastic_step",), source="elastic_watchdog").start()
         return self
 
     def __exit__(self, *exc):
-        self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
+        if self._wd is not None:
+            self._wd.stop()
+            self._wd = None
         return False
 
 
@@ -236,15 +358,28 @@ def sync_flag(flag: bool) -> bool:
     the program is already lost to a hang or garbage — exactly the case
     the `MXNetError` path exists for: kill the job, restore all hosts
     from the newest checkpoint."""
+    return sync_flags(flag)[0]
+
+
+def sync_flags(*flags: bool) -> tuple:
+    """OR-reduce several booleans across all processes in ONE allgather
+    (same collective, retry policy, and failure semantics as
+    `sync_flag`).  The recovery-enabled loop syncs its preemption, exit,
+    and rollback decisions per iteration — packing them keeps that at a
+    single host-coordination round-trip instead of three."""
     if jax.process_count() == 1:
-        return bool(flag)
+        return tuple(bool(f) for f in flags)
     import jax.numpy as jnp
     from jax.experimental import multihost_utils
 
     def _gather():
-        v = multihost_utils.process_allgather(
-            jnp.asarray([1 if flag else 0]))
-        return bool(v.max())
+        import numpy as onp
+        v = onp.asarray(multihost_utils.process_allgather(
+            jnp.asarray([1 if f else 0 for f in flags])))
+        # (nproc, k) from the real collective; a single host's (k,)
+        # (tests mock the gather) reshapes to one row
+        v = v.reshape(-1, len(flags))
+        return tuple(bool(x) for x in v.max(axis=0))
 
     try:
         return retry_with_backoff(_gather, retries=_SYNC_RETRIES,
@@ -271,8 +406,24 @@ class ElasticLoop:
 
     The `target` must expose ``save(path)``/``load(path)``. Returns a dict
     with the exit status — ``"completed"``, ``"preempted"`` (checkpoint
-    written; rerun to resume), or raises after `max_restores` failed
-    recoveries.
+    written; rerun to resume), ``"aborted"`` (the recovery policy's
+    tier-3 exit: rollback budget exhausted, crash bundle flushed) — or
+    raises after `max_restores` failed recoveries.
+
+    **Self-healing** (``MXTPU_RECOVERY`` / `recovery`): a
+    `recovery.RecoveryPolicy` subscribed to the health monitor turns
+    anomalies into remediation the loop executes between steps — in-graph
+    non-finite skips (tier 1, accounted by the policy), rollback to the
+    newest healthy-tagged checkpoint with the poison window fast-forwarded
+    (tier 2; on multi-host meshes the restore step is agreed via
+    `recovery.agree_step` so every host restores the same step or none
+    do), and a clean budgeted stop (tier 3).  `recovery=None` auto-builds
+    the default policy when the env var is set; pass ``recovery=False``
+    to opt out explicitly.
+
+    `prefetcher` (optional): a `DevicePrefetcher` the preemption path
+    cancels and the rollback path fast-forwards (`data_skip` overrides
+    the per-step fast-forward hook).
     """
 
     def __init__(self, target, directory: str, save_every: int = 100,
@@ -280,7 +431,10 @@ class ElasticLoop:
                  watchdog_timeout: Optional[float] = None,
                  retry_on=(RuntimeError, MXNetError),
                  failure_injector: Optional[FailureInjector] = None,
-                 async_save: bool = False):
+                 async_save: bool = False,
+                 recovery=None, prefetcher=None,
+                 preempt_grace: Optional[float] = None,
+                 data_skip: Optional[Callable[[int], None]] = None):
         self.target = target
         self.manager = CheckpointManager(directory, keep=keep)
         self.save_every = save_every
@@ -296,6 +450,20 @@ class ElasticLoop:
         # preemption/rollback/final saves stay synchronous — those must
         # be on disk before the process acts on them
         self.async_save = async_save
+        if recovery is None and _recovery.enabled():
+            recovery = _recovery.RecoveryPolicy()
+        self.recovery = recovery or None   # False -> None
+        self.prefetcher = prefetcher
+        self.preempt_grace = preempt_grace
+        if data_skip is None and prefetcher is not None:
+            data_skip = lambda _step: prefetcher.skip(1)  # noqa: E731
+        self.data_skip = data_skip
+        # step ids (1-based, = the monitor's/journal's step-id space) the
+        # post-rollback replay fast-forwards over.  The spaces stay
+        # aligned across rollbacks because the dispatch counter is
+        # checkpointed state: `ShardedTrainStep.load` resets `_t` to the
+        # restored step exactly when the loop resets `i` to it.
+        self._replay_skip: set = set()
 
     _deferred_failures = 0
 
@@ -322,78 +490,282 @@ class ElasticLoop:
                 "(%d/%d consecutive)", e, self._deferred_failures,
                 self.max_restores)
 
+    def _maybe_periodic_save(self, i: int) -> None:
+        """Periodic checkpoint when one is due at step `i`.  Drains only
+        then: draining every step would cap write/compute overlap at one
+        step."""
+        if self.save_every > 0 and i % self.save_every == 0:
+            self._drain_async_tolerant()
+            self.manager.maybe_save(self.target, i, every=self.save_every,
+                                    async_save=self.async_save)
+
+    def _resume_start(self) -> int:
+        """Initial restore, honoring a preemption resume marker when one
+        is present: a marker naming a complete emergency checkpoint pins
+        the resume to exactly that step (the marker is cleared either
+        way — it describes one preemption, not a standing instruction)."""
+        marker = _recovery.read_resume_marker(self.manager.directory)
+        if marker is not None:
+            _recovery.clear_resume_marker(self.manager.directory)
+            step = marker.get("step")
+            if marker.get("complete") and step is not None:
+                try:
+                    start = self.manager.restore(self.target,
+                                                 step=int(step))
+                    _tele.event("remediation", step=start,
+                                kind="preempt_resume",
+                                checkpoint=marker.get("checkpoint"))
+                    _log.info("elastic: resumed from emergency "
+                              "preemption checkpoint at step %d", start)
+                    return start
+                except Exception as e:
+                    _log.warning(
+                        "elastic: resume marker points at step %s but the "
+                        "restore failed (%s); falling back to the "
+                        "checkpoint chain", step, e)
+            else:
+                _log.warning(
+                    "elastic: preemption left a partial-state marker "
+                    "(grace window too tight for a full save); resuming "
+                    "from the newest complete checkpoint")
+        return self.manager.restore(self.target)
+
+    def _perform_rollback(self, action: dict, current: int,
+                          restores: int) -> int:
+        """Tier-2 remediation: restore the newest healthy-tagged
+        checkpoint (cluster-agreed on multi-host meshes) and arm the
+        poison-window fast-forward.  Returns the step to resume from."""
+        reason = action.get("reason", "?")
+        _log.warning("elastic: recovery rollback requested at step %d "
+                     "(%s)", current, reason)
+        # drain in-flight dispatched steps first: their retirements feed
+        # the monitor, and a rollback under outstanding donated buffers
+        # would race the restore's device placement
+        drain = getattr(self.target, "drain", None)
+        if callable(drain):
+            try:
+                drain(timeout=60.0)
+            except Exception:
+                _log.exception("elastic: in-flight drain before rollback "
+                               "failed")
+        self._drain_async_tolerant()
+        multi = jax.process_count() > 1
+        if multi:
+            cand = self.manager.newest_healthy()
+            agreed = _recovery.agree_step(cand[0] if cand is not None
+                                          else 0)
+            if agreed == 0:
+                # some host has NO healthy-tagged candidate (margin can
+                # disqualify every retained checkpoint after a long
+                # divergence).  Mirror the single-host fallback — a
+                # suspect restore beats resetting a long run to the
+                # step-0 anchor — by agreeing on the newest checkpoint
+                # regardless of tag.  Same collective program order on
+                # every host: all of them observed agreed == 0.
+                newest = self.manager.latest()
+                agreed = _recovery.agree_step(
+                    newest[0] if newest is not None else 0)
+                _log.error(
+                    "elastic: no cluster-wide healthy rollback "
+                    "candidate; agreed on newest checkpoint step %d "
+                    "regardless of health tag", agreed)
+            fault_point("rollback_restore")
+            # all hosts restore the agreed step or none do: an explicit
+            # restore raises on corruption (or on a missing agreed
+            # checkpoint) instead of silently falling back to a step the
+            # peers did not agree on; the raise kills the job and every
+            # host restarts from its verified chain
+            restored = self.manager.restore(self.target, step=agreed)
+        else:
+            fault_point("rollback_restore")
+            restored = self.manager.restore(self.target,
+                                            healthy_only=True)
+        poison = []
+        if self.recovery is not None:
+            self.recovery.note_rollback(restored)
+            poison = self.recovery.consume_poison(restored)
+        self._replay_skip.update(poison)
+        # checkpoints newer than the restore point belong to the
+        # abandoned (diverged) timeline: a crash before the next periodic
+        # save must not resume INTO the state we just rolled away from
+        discarded = self.manager.discard_newer(restored)
+        _tele.event("remediation", step=restored, tier=action.get("tier", 2),
+                    kind="rollback", reason=reason, from_step=current,
+                    restored_step=restored, poison=poison[:32],
+                    discarded=discarded[:32], restores=restores)
+        _log.warning(
+            "elastic: rolled back from step %d to healthy checkpoint at "
+            "step %d (%s); fast-forwarding %d poison step(s)%s",
+            current, restored, reason, len(poison),
+            f", discarded {len(discarded)} newer checkpoint(s)"
+            if discarded else "")
+        return restored
+
     def run(self, step_fn: Callable[[int], object], total_steps: int,
             on_step: Optional[Callable[[int, object], None]] = None) -> dict:
         restores = 0       # total, reported in the result
         consecutive = 0    # failed recoveries in a row, bounds the retry
-        start = self.manager.restore(self.target)
+        rollbacks = 0      # policy-driven (tier-2) rollbacks
+        start = self._resume_start()
         if start:
             _log.info("elastic: resumed from checkpoint at step %d", start)
         elif self.manager.latest() is None:
             # anchor checkpoint so a failure before the first periodic save
             # still has a consistent state to roll back to
             self.manager.save(self.target, 0)
-        guard = PreemptionGuard()
+        guard = PreemptionGuard(grace=self.preempt_grace,
+                                manager=self.manager)
         watchdog = (Watchdog(self.watchdog_timeout)
                     if self.watchdog_timeout else None)
+        if self.recovery is not None:
+            self.recovery.attach()
         last_loss = None
         i = start
-        with guard:
-            ctx = watchdog if watchdog is not None else _null_ctx()
-            with ctx:
-                while i < total_steps:
-                    if sync_flag(guard.preempted):
-                        self._drain_async_tolerant()
-                        path = self.manager.save(self.target, i)
-                        _log.warning("elastic: preempted at step %d; "
-                                     "checkpoint %s written", i, path)
-                        return {"status": "preempted", "step": i,
-                                "checkpoint": path, "restores": restores}
-                    try:
-                        # env-driven injection (MXTPU_FAULT_SPEC
-                        # elastic_step@N — Nth step ATTEMPT, replays
-                        # included, so a recovered run replays clean);
-                        # generalizes the programmatic FailureInjector
-                        fault_point("elastic_step")
-                        if self.failure_injector is not None:
-                            self.failure_injector.check(i)
-                        last_loss = step_fn(i)
-                        # a completed step proves the recovery worked;
-                        # max_restores bounds CONSECUTIVE failed recoveries,
-                        # not total hiccups over a long job's lifetime
-                        consecutive = 0
-                    except self.retry_on as e:
-                        restores += 1
-                        consecutive += 1
-                        if consecutive > self.max_restores:
-                            raise MXNetError(
-                                f"elastic: step {i} failed after "
-                                f"{self.max_restores} restores") from e
-                        self._drain_async_tolerant()
-                        rollback = self.manager.restore(self.target)
-                        _log.warning(
-                            "elastic: step %d failed (%s); restored "
-                            "checkpoint at step %d (restore %d/%d)",
-                            i, e, rollback, consecutive, self.max_restores)
-                        i = rollback
-                        continue
-                    i += 1
-                    if watchdog is not None:
-                        watchdog.ping()
-                    if on_step is not None:
-                        on_step(i, last_loss)
-                    # drain only when a save is DUE: draining every step
-                    # would cap write/compute overlap at one step
-                    if self.save_every > 0 and i % self.save_every == 0:
-                        self._drain_async_tolerant()
-                        self.manager.maybe_save(self.target, i,
-                                                every=self.save_every,
-                                                async_save=self.async_save)
+        try:
+            with guard:
+                ctx = watchdog if watchdog is not None else _null_ctx()
+                with ctx:
+                    while i < total_steps:
+                        # remediation decisions are host-local (anomalies
+                        # retire on host-local timing, budget windows are
+                        # host-local wall-clock), so on multi-host meshes
+                        # ALL of them — preemption, tier-3 exit, tier-2
+                        # rollback — are OR-reduced in one packed
+                        # collective before anyone acts: a host entering
+                        # agree_step (or returning) while a peer sits in
+                        # the next iteration's flag sync would mismatch
+                        # collective program order and wedge the fleet.
+                        action = (self.recovery.poll()
+                                  if self.recovery is not None else None)
+                        want_exit = (action is not None
+                                     and action["kind"] == "exit")
+                        want_rb = (action is not None
+                                   and action["kind"] == "rollback")
+                        preempted, want_exit, want_rb = sync_flags(
+                            guard.preempted, want_exit, want_rb)
+                        if preempted:
+                            self._drain_async_tolerant()
+                            info = guard.emergency_checkpoint(
+                                target=self.target, step=i,
+                                prefetcher=self.prefetcher)
+                            _log.warning(
+                                "elastic: preempted at step %d; %s "
+                                "checkpoint %s written", i,
+                                "emergency" if info["complete"]
+                                else "PARTIAL (marker only)",
+                                info.get("checkpoint"))
+                            return {"status": "preempted", "step": i,
+                                    "checkpoint": info.get("checkpoint"),
+                                    "restores": restores,
+                                    "emergency": info}
+                        if want_exit:
+                            if action is None or action["kind"] != "exit":
+                                action = {"kind": "exit",
+                                          "reason": "peer_request",
+                                          "tier": 3, "step": i}
+                            return self._tier3_exit(action, i, restores)
+                        if want_rb:
+                            if action is None \
+                                    or action["kind"] != "rollback":
+                                action = {"kind": "rollback",
+                                          "reason": "peer_request",
+                                          "tier": 2, "step": i}
+                            restores += 1
+                            rollbacks += 1
+                            i = self._perform_rollback(action, i,
+                                                       restores)
+                            continue
+                        if self._replay_skip and (i + 1) in \
+                                self._replay_skip:
+                            # fast-forward the poison window: this
+                            # attempt's data fed an anomaly on the
+                            # abandoned timeline — skip it rather than
+                            # re-train on it (index-based sources skip
+                            # the index; stream sources drop one batch
+                            # via the data_skip hook)
+                            self._replay_skip.discard(i + 1)
+                            if self.data_skip is not None:
+                                try:
+                                    self.data_skip(i + 1)
+                                except Exception:
+                                    _log.exception(
+                                        "elastic: data_skip hook failed")
+                            _tele.event("remediation", step=i + 1,
+                                        tier=2, kind="data_skip")
+                            _log.warning("elastic: skipping poison step "
+                                         "%d after rollback", i + 1)
+                            i += 1
+                            # a skipped step still honors a due periodic
+                            # save (the state — restored + clean replays —
+                            # is valid; silently missing the boundary
+                            # would double the next failure's rollback
+                            # distance).  on_step is NOT called: no step
+                            # ran, and reporting a phantom loss would be
+                            # worse than a gap in the step indices.
+                            self._maybe_periodic_save(i)
+                            continue
+                        try:
+                            # env-driven injection (MXTPU_FAULT_SPEC
+                            # elastic_step@N — Nth step ATTEMPT, replays
+                            # included, so a recovered run replays clean);
+                            # generalizes the programmatic FailureInjector
+                            fault_point("elastic_step")
+                            if self.failure_injector is not None:
+                                self.failure_injector.check(i)
+                            last_loss = step_fn(i)
+                            # a completed step proves the recovery worked;
+                            # max_restores bounds CONSECUTIVE failed
+                            # recoveries, not total hiccups over a long
+                            # job's lifetime
+                            consecutive = 0
+                        except self.retry_on as e:
+                            restores += 1
+                            consecutive += 1
+                            if consecutive > self.max_restores:
+                                raise MXNetError(
+                                    f"elastic: step {i} failed after "
+                                    f"{self.max_restores} restores") from e
+                            self._drain_async_tolerant()
+                            rollback = self.manager.restore(self.target)
+                            _log.warning(
+                                "elastic: step %d failed (%s); restored "
+                                "checkpoint at step %d (restore %d/%d)",
+                                i, e, rollback, consecutive,
+                                self.max_restores)
+                            i = rollback
+                            continue
+                        i += 1
+                        if watchdog is not None:
+                            watchdog.ping()
+                        if on_step is not None:
+                            on_step(i, last_loss)
+                        self._maybe_periodic_save(i)
+        finally:
+            if self.recovery is not None:
+                self.recovery.detach()
         self._drain_async_tolerant()
         final = self.manager.save(self.target, total_steps)
         return {"status": "completed", "step": total_steps,
                 "checkpoint": final, "restores": restores,
-                "loss": last_loss}
+                "rollbacks": rollbacks, "loss": last_loss}
+
+    def _tier3_exit(self, action: dict, step: int, restores: int) -> dict:
+        """Tier-3 remediation: the rollback budget is exhausted — flush a
+        post-mortem bundle and stop cleanly rather than burn the
+        reservation on a rollback loop."""
+        reason = action.get("reason", "rollback_budget_exhausted")
+        self._drain_async_tolerant()
+        bundle = _health.dump_bundle(f"recovery_exit:{reason}")
+        _tele.counter(
+            "recovery_exits_total",
+            "Tier-3 clean stops (rollback budget exhausted)").inc()
+        _tele.event("remediation", step=step, tier=3, kind="exit",
+                    reason=reason, bundle=bundle)
+        _log.error(
+            "elastic: recovery policy requested a tier-3 exit at step %d "
+            "(%s); post-mortem bundle: %s", step, reason, bundle)
+        return {"status": "aborted", "step": step, "reason": reason,
+                "restores": restores, "bundle": bundle}
 
 
 class _null_ctx:

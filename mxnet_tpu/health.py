@@ -54,7 +54,7 @@ import time
 import traceback
 import weakref
 from collections import deque
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Sequence
 
 from . import telemetry as _tele
 
@@ -270,6 +270,7 @@ class HealthMonitor:
         self.min_history = int(min_history)
         self.scale_collapse_at = float(scale_collapse_at)
         self.on_anomaly = on_anomaly
+        self._listeners: List[Callable[[dict], None]] = []
         self.anomalies: deque = deque(maxlen=int(anomaly_capacity))
         self.anomaly_count = 0
         self.observations = 0
@@ -408,14 +409,36 @@ class HealthMonitor:
         _log.warning("health anomaly [%s] at step %s: %s", rule, step,
                      details)
 
-    def _notify(self, fired: List[dict]) -> None:
-        if self.on_anomaly is None:
-            return
-        for row in fired:
+    # -- anomaly listeners ----------------------------------------------
+    def add_anomaly_listener(self, fn: Callable[[dict], None]) -> None:
+        """Subscribe `fn(anomaly_dict)` alongside `on_anomaly`.  The
+        listener list exists so subsystems (the recovery policy engine,
+        the manifest health tracker) can subscribe without clobbering a
+        user's `on_anomaly` callback.  Idempotent per function."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def remove_anomaly_listener(self, fn: Callable[[dict], None]) -> None:
+        with self._lock:
             try:
-                self.on_anomaly(row)
-            except Exception:
-                _log.exception("health on_anomaly callback failed")
+                self._listeners.remove(fn)
+            except ValueError:
+                pass
+
+    def _notify(self, fired: List[dict]) -> None:
+        if not fired:
+            return
+        with self._lock:
+            sinks = list(self._listeners)
+        if self.on_anomaly is not None:
+            sinks.insert(0, self.on_anomaly)
+        for row in fired:
+            for cb in sinks:
+                try:
+                    cb(row)
+                except Exception:
+                    _log.exception("health anomaly callback failed")
 
     def recent(self) -> List[dict]:
         """The last <=`window` probe observations (for bundles/tools)."""
@@ -562,7 +585,9 @@ class HangWatchdog:
 
     def __init__(self, timeout: float, action: str = "record",
                  poll: Optional[float] = None,
-                 on_stall: Optional[Callable[[dict], None]] = None):
+                 on_stall: Optional[Callable[[dict], None]] = None,
+                 names: Optional[Sequence[str]] = None,
+                 source: str = "health_watchdog"):
         if timeout <= 0:
             raise ValueError("watchdog timeout must be positive")
         if action not in ("record", "raise"):
@@ -571,6 +596,13 @@ class HangWatchdog:
         self.timeout = float(timeout)
         self.action = action
         self.on_stall = on_stall
+        # restrict liveness to these heartbeat names (None = any beat is
+        # progress).  `elastic.Watchdog` scopes its shim instance to the
+        # 'elastic_step' beat so its contract — "no completed step within
+        # timeout" — survives a busy prefetcher; stall *reporting* still
+        # goes through the one shared record_stall path, labeled `source`.
+        self.names = None if names is None else frozenset(names)
+        self.source = source
         self.stalls = 0
         self._poll = poll if poll is not None else min(timeout / 4.0, 1.0)
         self._stop = threading.Event()
@@ -607,8 +639,14 @@ class HangWatchdog:
             t.join(timeout=5)
         self._thread = None
 
-    def _last_activity(self) -> float:
+    def _beats(self) -> Dict[str, float]:
         beats = _beats_snapshot()
+        if self.names is not None:
+            beats = {n: t for n, t in beats.items() if n in self.names}
+        return beats
+
+    def _last_activity(self) -> float:
+        beats = self._beats()
         last = self._baseline
         if beats:
             last = max(last, max(beats.values()))
@@ -656,13 +694,13 @@ class HangWatchdog:
         # _last_activity(), which moves with the post-fire rebaseline):
         # it only changes when some component actually made progress
         # between fires, i.e. a genuinely new hang.
-        beats = _beats_snapshot()
+        beats = self._beats()
         newest_beat = max(beats.values()) if beats else None
         new_episode = (not self._fired_once
                        or newest_beat != self._last_fired_beat)
         self._fired_once = True
         self._last_fired_beat = newest_beat
-        record_stall("health_watchdog", self.timeout, idle=idle,
+        record_stall(self.source, self.timeout, idle=idle,
                      dump=new_episode)
         if self.on_stall is not None:
             try:

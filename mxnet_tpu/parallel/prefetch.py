@@ -193,6 +193,31 @@ class DevicePrefetcher:
             raise payload
         raise StopIteration
 
+    def skip(self, n: int = 1) -> int:
+        """Fast-forward: consume and drop up to `n` batches (device
+        buffers are released immediately).  The recovery rollback path
+        uses this to step a forward-only stream past the poison window —
+        the batches that fed anomalies on the abandoned timeline — so the
+        replay does not re-train on them.  Returns the number actually
+        dropped (short when the source ends first)."""
+        dropped = 0
+        for _ in range(int(n)):
+            try:
+                next(self)
+            except StopIteration:
+                # only a genuinely exhausted source ends the skip; a
+                # pipeline error (worker failure, placement fault, wait
+                # timeout) propagates — swallowing it here would leave a
+                # dead prefetcher whose root cause surfaces nowhere
+                break
+            dropped += 1
+        if dropped and _tele.enabled():
+            _tele.counter(
+                "prefetch_skipped_batches",
+                "Prefetched batches dropped by recovery fast-forward"
+            ).inc(dropped)
+        return dropped
+
     # -- lifecycle ------------------------------------------------------
     def _drain_queue(self):
         try:

@@ -31,6 +31,7 @@ from ..optimizer import Optimizer
 from ..ops.fused_optim import HpScalarCache
 from .. import health as _health
 from .. import profiler as _profiler
+from .. import recovery as _recovery
 from .. import telemetry as _tele
 from .sharding import ShardingRules, default_tp_rules
 
@@ -133,6 +134,16 @@ class ShardedTrainStep:
         # device computations, trace_count unchanged); enabling health
         # after construction requires a new step object
         self._health_probes = _health.probes_enabled()
+        # tier-1 remediation (MXTPU_RECOVERY / recovery.enable): guard the
+        # optimizer update with the non-finite probe INSIDE the jitted
+        # step — a NaN/Inf gradient (or loss) applies the identity update
+        # instead of poisoning the weights, and the host-side
+        # RecoveryPolicy accounts the skip from the anomaly the probes
+        # raise.  Captured once at construction like the probes: the
+        # guard is a fixed part of the traced program (zero retraces,
+        # and with recovery off it is traced out entirely).
+        self._skip_nonfinite = (self._health_probes
+                                and _recovery.skip_enabled())
         # stall-suppression guard entered at TRACE time (_note_trace) and
         # released when the triggering call returns: any path that
         # compiles — cold start, AOT fallback, mid-run aval-drift
@@ -356,24 +367,7 @@ class ShardedTrainStep:
                 loss = (lsum / k).astype(jnp.float32)
                 # running-stat writebacks: keep the final microbatch's
                 aux = jax.tree_util.tree_map(lambda x: x[-1], auxes)
-            new_p = dict(pvals)
-            new_s = {}
-            for n in diff_names:
-                w, s = optimizer._rule(pvals[n], grads[n], opt_state[n], hp)
-                # low-precision training: fp32 hyperparameter scalars
-                # promote the update math (desired — that's the implicit
-                # master-weight path; state was created fp32 above), but
-                # the stored weight/state dtypes must stay EXACTLY as
-                # declared or donation breaks and every step retraces
-                if w.dtype != pvals[n].dtype:
-                    w = w.astype(pvals[n].dtype)
-                s = jax.tree_util.tree_map(
-                    lambda new, old: new.astype(old.dtype)
-                    if hasattr(new, "dtype") and new.dtype != old.dtype
-                    else new, s, opt_state[n])
-                new_p[n] = w
-                new_s[n] = s
-            new_p.update(aux)  # running-stat writebacks
+            probes = None
             if outer._health_probes:
                 # numerics probes (docs/observability.md): cheap fused
                 # reductions XLA folds into the step program — grad global
@@ -392,8 +386,45 @@ class ShardedTrainStep:
                 nonfinite = sum(
                     jnp.sum((~jnp.isfinite(g)).astype(jnp.float32))
                     for g in leaves)
-                return new_p, new_s, loss, {"grad_norm": gnorm,
-                                            "nonfinite": nonfinite}
+                probes = {"grad_norm": gnorm, "nonfinite": nonfinite}
+            skip = None
+            if outer._skip_nonfinite:
+                # tier-1 recovery: a non-finite gradient tree (or loss)
+                # turns the whole update into the identity — weights,
+                # optimizer state, and running stats all keep their
+                # pre-step values.  jnp.where on a traced scalar, so the
+                # skip costs one select per leaf and never a retrace.
+                skip = jnp.logical_or(
+                    probes["nonfinite"] > 0,
+                    ~jnp.isfinite(loss.astype(jnp.float32)))
+            new_p = dict(pvals)
+            new_s = {}
+            for n in diff_names:
+                w, s = optimizer._rule(pvals[n], grads[n], opt_state[n], hp)
+                # low-precision training: fp32 hyperparameter scalars
+                # promote the update math (desired — that's the implicit
+                # master-weight path; state was created fp32 above), but
+                # the stored weight/state dtypes must stay EXACTLY as
+                # declared or donation breaks and every step retraces
+                if w.dtype != pvals[n].dtype:
+                    w = w.astype(pvals[n].dtype)
+                s = jax.tree_util.tree_map(
+                    lambda new, old: new.astype(old.dtype)
+                    if hasattr(new, "dtype") and new.dtype != old.dtype
+                    else new, s, opt_state[n])
+                if skip is not None:
+                    w = jnp.where(skip, pvals[n], w)
+                    s = jax.tree_util.tree_map(
+                        lambda new, old: jnp.where(skip, old, new),
+                        s, opt_state[n])
+                new_p[n] = w
+                new_s[n] = s
+            if skip is not None:
+                aux = {k: jnp.where(skip, pvals[k], v) if k in pvals else v
+                       for k, v in aux.items()}
+            new_p.update(aux)  # running-stat writebacks
+            if probes is not None:
+                return new_p, new_s, loss, probes
             return new_p, new_s, loss
 
         pspec = {n: self.param_shardings[n] for n in self.param_names}
@@ -678,6 +709,42 @@ class ShardedTrainStep:
             if _tele.enabled():
                 _tele.event("step_retired", step=step_id)
         return len(q)
+
+    def drain(self, timeout: Optional[float] = None) -> int:
+        """Block until every dispatched step has retired (its loss landed
+        on the host and, with health probes on, fed the monitor), or the
+        `timeout` deadline passes.  Returns the number of steps still in
+        flight (0 = fully drained).
+
+        The recovery paths call this before acting on training state: a
+        rollback restore or an emergency preemption save under
+        outstanding donated buffers would race the in-flight steps, and
+        the retirements carry the probe values the health monitor (and
+        the anomaly→remediation policy behind it) still needs to see."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self._inflight:
+            loss = self._inflight[0][1]
+            if deadline is None:
+                try:
+                    jax.block_until_ready(loss)
+                except Exception:
+                    pass
+            else:
+                while True:
+                    try:
+                        ready = bool(loss.is_ready())
+                    except Exception:
+                        ready = True
+                    if ready:
+                        break
+                    if time.monotonic() >= deadline:
+                        return self.steps_in_flight()
+                    time.sleep(0.002)
+            before = len(self._inflight)
+            self.steps_in_flight()   # retires the ready head(s)
+            if len(self._inflight) >= before:
+                break  # no progress — avoid spinning on a wedged entry
+        return self.steps_in_flight()
 
     @staticmethod
     def _observe_health(step_id, loss, probes) -> None:

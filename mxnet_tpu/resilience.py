@@ -84,31 +84,59 @@ class FaultExit(BaseException):
 
 def retry_with_backoff(fn: Callable[[], object], *, retries: int = 3,
                        base_delay: float = 0.05, max_delay: float = 2.0,
-                       jitter: float = 0.5,
+                       jitter: float = 0.5, full_jitter: bool = False,
+                       max_elapsed: Optional[float] = None,
                        retry_on: Sequence[type] = (OSError,),
                        on_retry: Optional[Callable] = None,
-                       sleep: Callable[[float], None] = time.sleep):
+                       sleep: Callable[[float], None] = time.sleep,
+                       clock: Callable[[], float] = time.monotonic):
     """Call ``fn()`` retrying listed exceptions with exponential backoff.
 
     Only exceptions in `retry_on` are retried — anything else propagates
     immediately (a typo'd path must not be retried like a network blip).
+    Even when `retry_on` names a broad base class, non-``Exception``
+    ``BaseException``\\ s (`FaultExit`, ``KeyboardInterrupt``,
+    ``SystemExit``) are NEVER retried: a fault-injected process exit or a
+    user's Ctrl-C swallowed by a retry wrapper would defeat the very
+    teardown it requested.
+
     Delay for attempt *k* is ``base_delay * 2**(k-1)`` capped at
     `max_delay`, plus up to ``jitter`` fraction of itself (decorrelates
-    retry storms across hosts). After `retries` failed retries the last
-    exception propagates unchanged. `on_retry(attempt, exc, delay)` is
-    invoked before each sleep; `sleep` is injectable for tests.
+    retry storms across hosts); ``full_jitter=True`` draws the whole
+    delay uniformly from ``[0, capped)`` instead (the AWS "full jitter"
+    policy — better decorrelation when many hosts retry the same shared
+    service).  `max_elapsed` is an overall deadline in seconds: once the
+    elapsed time plus the upcoming delay would exceed it, the last
+    exception propagates instead of starting another sleep — a retry
+    loop inside a preemption grace window must not outlive the window.
+    After `retries` failed retries the last exception propagates
+    unchanged. `on_retry(attempt, exc, delay)` is invoked before each
+    sleep; `sleep`/`clock` are injectable for tests.
     """
     retry_on = tuple(retry_on)
     attempt = 0
+    start = clock()
     while True:
         try:
             return fn()
         except retry_on as e:
+            if not isinstance(e, Exception):
+                raise  # BaseException-only (FaultExit, KeyboardInterrupt)
             attempt += 1
             if attempt > retries:
                 raise
             delay = min(base_delay * (2.0 ** (attempt - 1)), max_delay)
-            delay += random.uniform(0.0, jitter * delay)
+            if full_jitter:
+                delay = random.uniform(0.0, delay)
+            else:
+                delay += random.uniform(0.0, jitter * delay)
+            if max_elapsed is not None and \
+                    clock() - start + delay > max_elapsed:
+                _log.warning(
+                    "retry budget exhausted after %.3fs (max_elapsed "
+                    "%.3fs); raising %s", clock() - start, max_elapsed,
+                    type(e).__name__)
+                raise
             if on_retry is not None:
                 on_retry(attempt, e, delay)
             _log.warning("retry %d/%d after %s: %s (sleeping %.3fs)",

@@ -66,6 +66,9 @@ class CheckpointManager:
         self.directory = directory
         self.keep = keep
         self.prefix = prefix
+        # final paths owned by an in-flight save_async: _prune must not
+        # reap them mid-write (they get reaped by a later prune instead)
+        self._pending_async: set = set()
         os.makedirs(directory, exist_ok=True)
 
     # -- discovery -------------------------------------------------------
@@ -85,7 +88,74 @@ class CheckpointManager:
         cps = self.checkpoints()
         return cps[-1] if cps else None
 
+    def _manifest_meta(self, path: str) -> Optional[dict]:
+        try:
+            with open(path + _MANIFEST) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _manifest_healthy(self, path: str) -> bool:
+        """Whether the manifest's health tag permits a rollback to this
+        checkpoint.  Untagged (legacy / health-off) checkpoints count as
+        healthy — they predate the recovery subsystem, and excluding them
+        would leave rollback with no candidates at all."""
+        meta = self._manifest_meta(path)
+        if not meta or "health" not in meta:
+            return True
+        return bool(meta["health"].get("healthy", True))
+
+    def newest_healthy(self) -> Optional[Tuple[int, str]]:
+        """Newest checkpoint whose manifest health tag says the run was
+        healthy at save time — the rollback candidate."""
+        for s, path in reversed(self.checkpoints()):
+            if self._manifest_healthy(path):
+                return (s, path)
+        return None
+
+    def discard_newer(self, step: int) -> List[int]:
+        """Sideline every checkpoint NEWER than `step` (renamed to
+        ``*.rolledback``, manifest alongside) so discovery skips them:
+        after a rollback they belong to the abandoned diverged timeline,
+        and a crash before the next periodic save must not resume into
+        the state the rollback just rejected.  The rename keeps the
+        evidence (`tools/diagnose.py --journal` shows the lineage).
+        Returns the discarded steps."""
+        dropped = []
+        for s, path in self.checkpoints():
+            if s <= step:
+                continue
+            stale = path + ".rolledback"
+            try:
+                os.replace(path, stale)
+            except OSError:
+                continue
+            man = path + _MANIFEST
+            if os.path.exists(man):
+                try:
+                    os.replace(man, stale + _MANIFEST)
+                except OSError:
+                    pass
+            dropped.append(s)
+            if _tele.enabled():
+                _tele.event("checkpoint_discard", step=s, path=path,
+                            rolled_back_to=step)
+        return dropped
+
     # -- integrity -------------------------------------------------------
+    @staticmethod
+    def _health_tag(step: int) -> Optional[dict]:
+        """Health snapshot stamped into the manifest at save time (None
+        when the health subsystem is off — legacy manifests stay
+        byte-identical).  Rollback only considers checkpoints whose tag
+        says ``healthy`` — restoring a checkpoint written mid-divergence
+        would roll back INTO the anomaly (docs/resilience.md)."""
+        try:
+            from .. import recovery
+            return recovery.health_snapshot(step)
+        except Exception:
+            return None
+
     def _write_manifest(self, path: str, step: int) -> None:
         """Manifest sidecar for `path` (atomic: tmp + rename). Written
         AFTER the checkpoint rename: a crash in between leaves a valid
@@ -93,6 +163,9 @@ class CheckpointManager:
         meta = {"step": step, "size": os.path.getsize(path),
                 "sha256": _sha256(path), "time": time.time(),
                 "prefix": self.prefix}
+        health = self._health_tag(step)
+        if health is not None:
+            meta["health"] = health
         fd, tmp = tempfile.mkstemp(dir=self.directory,
                                    prefix=f".{self.prefix}-man")
         try:
@@ -215,6 +288,7 @@ class CheckpointManager:
                                    prefix=f".{self.prefix}-atmp")
         os.close(fd)
         t0 = time.perf_counter()
+        self._pending_async.add(final)
         inner = target.save_async(tmp)
 
         out: _fut.Future = _fut.Future()
@@ -224,11 +298,13 @@ class CheckpointManager:
                 f.result()
                 os.replace(tmp, final)
                 self._write_manifest(final, step)
+                self._pending_async.discard(final)
                 self._prune()
                 self._note_write(final, step, time.perf_counter() - t0,
                                  async_save=True)
                 out.set_result(final)
             except BaseException as e:  # surface writer errors to .result()
+                self._pending_async.discard(final)
                 try:
                     os.unlink(tmp)
                 except OSError:
@@ -255,7 +331,8 @@ class CheckpointManager:
             return self.save(target, step)
         return None
 
-    def restore(self, target, step: Optional[int] = None) -> int:
+    def restore(self, target, step: Optional[int] = None,
+                healthy_only: bool = False) -> int:
         """Load the newest VERIFIED checkpoint into `target` and return
         its step (0 when the directory has none).
 
@@ -270,7 +347,13 @@ class CheckpointManager:
         failed ``load`` may leave `target` partially mutated; the
         fallback load overwrites the full state, so the target is
         consistent whenever restore returns.
-        """
+
+        `healthy_only` (the recovery rollback path): checkpoints whose
+        manifest health tag says they were written in an anomalous window
+        are SKIPPED (not quarantined — the bytes are fine, the state is
+        suspect).  Should every healthy candidate fail, the skipped
+        unhealthy ones are tried after all — a suspect restore beats no
+        restore."""
         self.wait_async()
         t0 = time.perf_counter()
         if step is not None:
@@ -289,7 +372,42 @@ class CheckpointManager:
         chain = self.checkpoints()
         if not chain:
             return 0
-        failures = []
+        failures: List[str] = []
+        if healthy_only:
+            healthy = [c for c in chain if self._manifest_healthy(c[1])]
+            if len(healthy) < len(chain):
+                _log.warning(
+                    "restore: skipping %d checkpoint(s) tagged unhealthy; "
+                    "%d rollback candidate(s) remain",
+                    len(chain) - len(healthy), len(healthy))
+            got = self._restore_chain(target, healthy, t0, failures)
+            if got is not None:
+                return got
+            rest = [c for c in chain if c not in healthy
+                    and os.path.exists(c[1])]
+            if rest:
+                _log.error(
+                    "restore: every healthy-tagged checkpoint failed; "
+                    "falling back to %d unhealthy-tagged one(s)", len(rest))
+                got = self._restore_chain(target, rest, t0, failures)
+                if got is not None:
+                    return got
+        else:
+            got = self._restore_chain(target, chain, t0, failures)
+            if got is not None:
+                return got
+        raise MXNetError(
+            f"all {len(failures)} checkpoint(s) in {self.directory} "
+            f"failed to restore (quarantined: {failures}); refusing to "
+            f"silently restart from scratch. If the files verified but "
+            f"failed to LOAD, the target is likely incompatible (changed "
+            f"architecture?) — quarantine is a rename; strip the "
+            f"'.corrupt' suffix to recover the files")
+
+    def _restore_chain(self, target, chain: List[Tuple[int, str]],
+                       t0: float, failures: List[str]) -> Optional[int]:
+        """Walk `chain` newest → oldest quarantining failures; the step
+        restored, or None when every entry failed."""
         for s, path in reversed(chain):
             reason = self._verify(path)
             if reason is None:
@@ -319,13 +437,7 @@ class CheckpointManager:
                                        fallbacks=len(failures))
                     return s
             failures.append(self._quarantine(path, reason))
-        raise MXNetError(
-            f"all {len(failures)} checkpoint(s) in {self.directory} "
-            f"failed to restore (quarantined: {failures}); refusing to "
-            f"silently restart from scratch. If the files verified but "
-            f"failed to LOAD, the target is likely incompatible (changed "
-            f"architecture?) — quarantine is a rename; strip the "
-            f"'.corrupt' suffix to recover the files")
+        return None
 
     @staticmethod
     def _note_restore(path: str, step: int, elapsed_s: float,
@@ -341,6 +453,12 @@ class CheckpointManager:
     def _prune(self):
         cps = self.checkpoints()
         for _, path in cps[:-self.keep]:
+            if path in self._pending_async:
+                # a background save_async still owns this path (possible
+                # after a rollback reordered the step sequence): deleting
+                # under the writer would truncate it — leave it for the
+                # next prune, after the future settles
+                continue
             try:
                 os.unlink(path)
             except OSError:

@@ -1,0 +1,598 @@
+"""Self-healing training: the anomaly→remediation policy engine
+(`mx.recovery`), in-graph tier-1 skip, healthy-tagged checkpoints +
+rollback, preemption-grace emergency checkpoints, and the satellite
+hardening (retry deadlines, prune-vs-async, watchdog shim).  `fault`
+marker (fast, CPU-only, tier-1).  docs/resilience.md."""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import health, recovery
+from mxnet_tpu import telemetry as tele
+from mxnet_tpu.amp.loss_scaler import LossScaler
+from mxnet_tpu.elastic import ElasticLoop, PreemptionGuard
+from mxnet_tpu.resilience import FaultExit, retry_with_backoff
+from mxnet_tpu.utils.checkpoint import CheckpointManager
+
+pytestmark = pytest.mark.fault
+
+
+@pytest.fixture(autouse=True)
+def _clean_recovery():
+    """Recovery/health/telemetry state is process-wide: start and leave
+    each test with everything off and the registry empty."""
+    recovery.disable()
+    health.disable()
+    tele.disable()
+    tele.registry().reset()
+    yield
+    recovery.disable()
+    health.disable()
+    tele.disable()
+    tele.registry().reset()
+
+
+def _anomaly(rule, step, **extra):
+    return {"rule": rule, "step": step, **extra}
+
+
+# ---------------------------------------------------------------------------
+# RecoveryPolicy ladder logic (no jax)
+# ---------------------------------------------------------------------------
+
+def test_tier1_skip_accounting_and_scaler_backoff():
+    scaler = LossScaler(init_scale=2.0 ** 16)
+    pol = recovery.RecoveryPolicy(skip_budget=8, scaler=scaler)
+    pol.on_anomaly(_anomaly("nonfinite_grads", 5))
+    # loss_nonfinite on the SAME step is the same bad batch, not a
+    # second skip
+    pol.on_anomaly(_anomaly("loss_nonfinite", 5))
+    assert pol.skips == 1
+    assert scaler.loss_scale == 2.0 ** 15
+    assert pol.poll() is None           # under budget: no remediation
+    pol.on_anomaly(_anomaly("loss_nonfinite", 9))
+    assert pol.skips == 2
+    assert scaler.loss_scale == 2.0 ** 14
+
+
+def test_skip_budget_escalates_to_rollback():
+    pol = recovery.RecoveryPolicy(skip_budget=3)
+    for s in range(1, 4):
+        pol.on_anomaly(_anomaly("nonfinite_grads", s))
+    assert pol.poll() is None
+    pol.on_anomaly(_anomaly("nonfinite_grads", 4))   # budget exceeded
+    act = pol.poll()
+    assert act is not None and act["kind"] == "rollback"
+    assert act["reason"] == "skip_budget"
+    assert pol.poll() is None                        # consumed
+
+
+def test_divergence_needs_consecutive_steps():
+    pol = recovery.RecoveryPolicy(divergence_patience=3)
+    pol.on_anomaly(_anomaly("loss_spike", 10))
+    pol.on_anomaly(_anomaly("grad_explosion", 11))
+    pol.on_anomaly(_anomaly("loss_spike", 15))       # gap: run resets
+    assert pol.poll() is None
+    pol.on_anomaly(_anomaly("loss_spike", 16))
+    # spike AND explosion on one step count once
+    pol.on_anomaly(_anomaly("grad_explosion", 16))
+    assert pol.poll() is None
+    pol.on_anomaly(_anomaly("grad_explosion", 17))   # 15,16,17 consecutive
+    act = pol.poll()
+    assert act is not None and act["kind"] == "rollback"
+    assert act["reason"] == "divergence"
+
+
+def test_rollback_budget_escalates_to_exit():
+    pol = recovery.RecoveryPolicy(divergence_patience=1, rollback_budget=1)
+    pol.on_anomaly(_anomaly("loss_spike", 3))
+    assert pol.poll()["kind"] == "rollback"
+    pol.note_rollback(2)
+    pol.on_anomaly(_anomaly("loss_spike", 4))
+    act = pol.poll()
+    assert act["kind"] == "exit" and act["tier"] == 3
+    assert "rollback_budget_exhausted" in act["reason"]
+
+
+def test_note_rollback_resets_state_and_poison():
+    pol = recovery.RecoveryPolicy(divergence_patience=2)
+    pol.on_anomaly(_anomaly("nonfinite_grads", 19))
+    pol.on_anomaly(_anomaly("loss_spike", 20))
+    pol.on_anomaly(_anomaly("loss_spike", 21))
+    assert pol.poll()["kind"] == "rollback"
+    # an anomaly observed while the rollback drains queues a stale
+    # request; note_rollback clears it (double-roll protection)
+    pol.on_anomaly(_anomaly("loss_spike", 22))
+    pol.note_rollback(18)
+    assert pol.poll() is None
+    assert pol.consume_poison(18) == [19, 20, 21, 22]
+    assert pol.consume_poison(18) == []              # cleared
+    # the divergence run restarts from scratch after the rollback
+    pol.on_anomaly(_anomaly("loss_spike", 19))
+    assert pol.poll() is None
+
+
+def test_policy_attach_preserves_user_callback():
+    recovery.enable()
+    seen = []
+    mon = health.monitor()
+    mon.on_anomaly = seen.append
+    pol = recovery.RecoveryPolicy(divergence_patience=1).attach()
+    mon.observe(3, loss=1.0, grad_norm=float("inf"))
+    assert seen and seen[0]["rule"] == "grad_explosion"
+    assert pol.poll()["kind"] == "rollback"
+    pol.detach()
+    mon.observe(4, loss=1.0, grad_norm=float("inf"))
+    assert pol.poll() is None                        # detached
+
+
+# ---------------------------------------------------------------------------
+# satellite: retry_with_backoff hardening
+# ---------------------------------------------------------------------------
+
+def test_retry_never_retries_base_exceptions():
+    calls = {"n": 0}
+
+    def boom():
+        calls["n"] += 1
+        raise FaultExit("injected exit")
+
+    # even an (over-broad) BaseException allowlist must not swallow a
+    # fault-injected process exit
+    with pytest.raises(FaultExit):
+        retry_with_backoff(boom, retries=5, retry_on=(BaseException,),
+                           sleep=lambda _s: None)
+    assert calls["n"] == 1
+
+    def interrupt():
+        calls["n"] += 1
+        raise KeyboardInterrupt
+
+    calls["n"] = 0
+    with pytest.raises(KeyboardInterrupt):
+        retry_with_backoff(interrupt, retries=5, retry_on=(BaseException,),
+                           sleep=lambda _s: None)
+    assert calls["n"] == 1
+
+
+def test_retry_max_elapsed_deadline():
+    clock = {"t": 0.0}
+    sleeps = []
+
+    def fake_sleep(s):
+        sleeps.append(s)
+        clock["t"] += s
+
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        clock["t"] += 1.0
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        retry_with_backoff(flaky, retries=100, base_delay=0.5,
+                           max_delay=0.5, jitter=0.0, max_elapsed=3.0,
+                           sleep=fake_sleep, clock=lambda: clock["t"])
+    # each attempt costs 1s + 0.5s sleep; the deadline stops the loop
+    # instead of burning 100 retries
+    assert calls["n"] <= 3
+
+
+def test_retry_full_jitter_bounds():
+    delays = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 8:
+            raise OSError("down")
+        return "ok"
+
+    assert retry_with_backoff(flaky, retries=8, base_delay=0.4,
+                              max_delay=0.4, full_jitter=True,
+                              sleep=delays.append) == "ok"
+    assert len(delays) == 8
+    assert all(0.0 <= d < 0.4 for d in delays)
+
+
+# ---------------------------------------------------------------------------
+# satellite: LossScaler.backoff
+# ---------------------------------------------------------------------------
+
+def test_loss_scaler_backoff_floors_and_resets_window():
+    s = LossScaler(init_scale=4.0, scale_factor=2.0)
+    assert s.backoff() == 2.0
+    assert s.backoff() == 1.0
+    assert s.backoff() == 1.0          # floored
+    assert s._overflows_since_rescale == 0
+
+
+def test_policy_defers_backoff_to_amp_loop():
+    # a loop that runs its own overflow-driven update_scale already
+    # penalized the NaN step; the anomaly retires a beat later and the
+    # policy must not shrink a second time
+    s = LossScaler(init_scale=2.0 ** 10, scale_factor=2.0, tolerance=0.0)
+    pol = recovery.RecoveryPolicy(scaler=s)
+    s.update_scale(True)
+    assert s.loss_scale == 2.0 ** 9
+    pol.on_anomaly(_anomaly("nonfinite_grads", 3))
+    assert s.loss_scale == 2.0 ** 9          # deferred, no double shrink
+    assert pol.skips == 1                    # but the skip IS accounted
+
+
+def test_policy_backs_off_when_loop_merely_tolerated_overflow():
+    # the loop's update_scale SAW the overflow but the tolerance window
+    # kept the scale — the immediate backoff is the policy's whole
+    # point, so it must still apply
+    s = LossScaler(init_scale=2.0 ** 10, scale_factor=2.0, tolerance=0.5)
+    pol = recovery.RecoveryPolicy(scaler=s)
+    for _ in range(20):
+        s.update_scale(False)            # long clean window
+    s.update_scale(True)                 # tolerated: no shrink
+    assert s.loss_scale == 2.0 ** 10
+    pol.on_anomaly(_anomaly("nonfinite_grads", 21))
+    assert s.loss_scale == 2.0 ** 9      # backoff applied
+
+
+def test_loss_scaler_one_penalty_per_step():
+    # the policy's backoff() and the AMP loop's own update_scale(True)
+    # react to the SAME overflow step: one shrink, not factor^2
+    s = LossScaler(init_scale=2.0 ** 10, scale_factor=2.0, tolerance=0.0)
+    s.backoff()
+    assert s.loss_scale == 2.0 ** 9
+    s.update_scale(True)               # same step: no second shrink
+    assert s.loss_scale == 2.0 ** 9
+    s.update_scale(True)               # NEXT step overflows on its own
+    assert s.loss_scale == 2.0 ** 8
+
+
+# ---------------------------------------------------------------------------
+# healthy-tagged checkpoints + rollback restore
+# ---------------------------------------------------------------------------
+
+class CounterTarget:
+    def __init__(self):
+        self.state = onp.zeros(4)
+
+    def apply(self, i):
+        self.state = self.state * 0.9 + i
+
+    def save(self, path):
+        with open(path, "wb") as f:
+            onp.savez(f, state=self.state)
+
+    def load(self, path):
+        with onp.load(path) as z:
+            self.state = z["state"]
+
+
+def test_manifest_health_tag_and_newest_healthy(tmp_path):
+    recovery.enable()
+    mgr = CheckpointManager(str(tmp_path), keep=10)
+    t = CounterTarget()
+    mgr.save(t, 10)                                  # healthy so far
+    health.monitor().observe(18, loss=1.0, grad_norm=float("inf"))
+    mgr.save(t, 20)                                  # 20-18 <= margin
+    man = json.load(open(mgr._path(20) + ".manifest.json"))
+    assert man["health"]["healthy"] is False
+    assert man["health"]["last_anomaly_step"] == 18
+    man10 = json.load(open(mgr._path(10) + ".manifest.json"))
+    assert man10["health"]["healthy"] is True
+    assert mgr.newest_healthy() == (10, mgr._path(10))
+    # default restore still prefers the newest; healthy_only rolls past it
+    assert mgr.restore(CounterTarget()) == 20
+    assert mgr.restore(CounterTarget(), healthy_only=True) == 10
+
+
+def test_restore_healthy_only_falls_back_when_no_healthy(tmp_path):
+    recovery.enable()
+    mgr = CheckpointManager(str(tmp_path), keep=10)
+    t = CounterTarget()
+    health.monitor().observe(9, loss=1.0, grad_norm=float("inf"))
+    mgr.save(t, 10)                                  # tagged unhealthy
+    # an unhealthy restore beats no restore at all
+    assert mgr.restore(CounterTarget(), healthy_only=True) == 10
+
+
+def test_discard_newer_sidelines_diverged_checkpoints(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep=10)
+    t = CounterTarget()
+    for s in (5, 10, 15):
+        mgr.save(t, s)
+    assert mgr.discard_newer(10) == [15]
+    assert [s for s, _ in mgr.checkpoints()] == [5, 10]
+    assert os.path.exists(mgr._path(15) + ".rolledback")
+    assert os.path.exists(mgr._path(15) + ".rolledback.manifest.json")
+
+
+def test_prune_skips_paths_with_inflight_async_save(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep=1)
+    t = CounterTarget()
+    for s in (1, 2, 3):
+        mgr.save(t, s)
+    assert [s for s, _ in mgr.checkpoints()] == [3]
+    # simulate an async save still owning an old path: prune must leave
+    # it for a later prune instead of truncating it under the writer
+    mgr.save(t, 4)
+    protected = mgr._path(4)
+    mgr._pending_async.add(protected)
+    mgr.save(t, 5)
+    assert os.path.exists(protected)
+    mgr._pending_async.discard(protected)
+    mgr.save(t, 6)                                   # reaped now
+    assert not os.path.exists(protected)
+
+
+# ---------------------------------------------------------------------------
+# ElasticLoop integration: rollback, tier-3 exit, poison fast-forward
+# ---------------------------------------------------------------------------
+
+def _divergent_loop(tmp_path, rollback_budget=2, total=30, bad=(20, 21, 22)):
+    """CounterTarget loop whose step_fn feeds the monitor a divergence at
+    the `bad` steps (1-based, = the journal step-id space)."""
+    recovery.enable()
+    t = CounterTarget()
+    pol = recovery.RecoveryPolicy(divergence_patience=3,
+                                  rollback_budget=rollback_budget)
+    loop = ElasticLoop(t, str(tmp_path), save_every=6, keep=10,
+                       recovery=pol)
+    mon = health.monitor()
+    seen = []
+
+    def step_fn(i):
+        t.apply(i)
+        seen.append(i)
+        step_id = i + 1
+        # divergences GROW (like real ones): a flat spike would be
+        # absorbed by the EMA after one observation
+        loss = 1e9 * (1e3 ** bad.index(step_id)) if step_id in bad else 1.0
+        mon.observe(step_id, loss=loss, grad_norm=1.0)
+        return loss
+
+    return t, pol, loop, step_fn, seen
+
+
+def test_elastic_rollback_to_healthy_and_poison_skip(tmp_path):
+    t, pol, loop, step_fn, seen = _divergent_loop(tmp_path)
+    skipped = []
+    loop.data_skip = skipped.append
+    out = loop.run(step_fn, total_steps=30)
+    assert out["status"] == "completed"
+    assert out["rollbacks"] == 1
+    assert pol.rollbacks == 1
+    # rolled back to the step-18 checkpoint and replayed from there; the
+    # poison attempts (loop indices 19..21 = step ids 20..22) were
+    # fast-forwarded, not re-run
+    assert seen.count(18) == 2
+    replayed = seen[len(seen) - 1 - seen[::-1].index(18):]
+    assert replayed[0] == 18 and replayed[1] == 22
+    assert 19 not in replayed and 20 not in replayed and 21 not in replayed
+    assert skipped == [20, 21, 22]
+    # the replay completed and re-saved on the clean timeline
+    assert loop.manager.latest()[0] == 30
+
+
+def test_elastic_tier3_exit_after_budget(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_CRASH_DIR", str(tmp_path / "crash"))
+    # budget 0: the FIRST rollback request escalates straight to exit
+    t, pol, loop, step_fn, _ = _divergent_loop(tmp_path / "ck",
+                                               rollback_budget=0)
+    out = loop.run(step_fn, total_steps=30)
+    assert out["status"] == "aborted"
+    assert "rollback_budget_exhausted" in out["reason"]
+    assert out["bundle"] and os.path.exists(out["bundle"])
+    with open(out["bundle"]) as f:
+        assert json.load(f)["reason"].startswith("recovery_exit")
+
+
+def test_elastic_journal_remediation_events(tmp_path):
+    tele.enable(journal_path=str(tmp_path / "j.jsonl"))
+    t, pol, loop, step_fn, _ = _divergent_loop(tmp_path / "ck")
+    loop.run(step_fn, total_steps=30)
+    tele.journal().close()
+    rows = [r for r in tele.RunJournal.read(str(tmp_path / "j.jsonl"))
+            if r["event"] == "remediation"]
+    kinds = [r["kind"] for r in rows]
+    assert "rollback" in kinds and "data_skip" in kinds
+    rb = next(r for r in rows if r["kind"] == "rollback")
+    assert rb["restored_step"] == 18
+    assert rb["poison"] == [20, 21, 22]
+
+
+# ---------------------------------------------------------------------------
+# preemption: grace deadline, emergency checkpoint, resume marker
+# ---------------------------------------------------------------------------
+
+def test_preemption_guard_grace_deadline(monkeypatch):
+    monkeypatch.setenv("MXTPU_PREEMPT_GRACE", "25")
+    g = PreemptionGuard()
+    assert g.grace == 25.0
+    assert g.deadline_remaining() is None            # not signalled yet
+    g.request_stop()
+    rem = g.deadline_remaining()
+    assert rem is not None and 0 < rem <= 25.0
+
+
+def test_emergency_checkpoint_complete_and_marker(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep=5)
+    t = CounterTarget()
+    for i in range(7):
+        t.apply(i)
+    g = PreemptionGuard(grace=30.0)
+    g.request_stop()
+    info = g.emergency_checkpoint(mgr, t, 7)
+    assert info["complete"] and not info["partial"]
+    assert os.path.exists(info["checkpoint"])
+    marker = recovery.read_resume_marker(str(tmp_path))
+    assert marker["step"] == 7 and marker["complete"]
+    # the saved state restores bit-exact
+    t2 = CounterTarget()
+    assert mgr.restore(t2, step=7) == 7
+    onp.testing.assert_allclose(t2.state, t.state)
+
+
+def test_emergency_checkpoint_partial_fallback(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep=5)
+    t = CounterTarget()
+    mgr.save(t, 4)                                   # durable state
+
+    class SlowTarget(CounterTarget):
+        def save(self, path):
+            time.sleep(3.0)                          # >> grace remainder
+            super().save(path)
+
+    slow = SlowTarget()
+    g = PreemptionGuard(grace=0.3)
+    g.request_stop()
+    info = g.emergency_checkpoint(mgr, slow, 9)
+    assert info["partial"] and not info["complete"]
+    # the marker names the newest COMPLETE checkpoint, not the aborted one
+    assert info["step"] == 4
+    marker = recovery.read_resume_marker(str(tmp_path))
+    assert marker["partial"] and marker["step"] == 4
+
+
+def test_elastic_honors_resume_marker(tmp_path):
+    import signal
+    t = CounterTarget()
+    loop = ElasticLoop(t, str(tmp_path), save_every=100,
+                       preempt_grace=30.0)
+
+    def step(i):
+        t.apply(i)
+        if i == 4:
+            os.kill(os.getpid(), signal.SIGTERM)  # simulated preemption
+
+    out = loop.run(step, total_steps=100)
+    assert out["status"] == "preempted" and out["step"] == 5
+    assert out["emergency"]["complete"]
+    assert recovery.read_resume_marker(str(tmp_path))["step"] == 5
+
+    # restart: the marker pins the resume to exactly step 5, then clears
+    t2 = CounterTarget()
+    loop2 = ElasticLoop(t2, str(tmp_path), save_every=100)
+    out2 = loop2.run(lambda i: t2.apply(i), total_steps=10)
+    assert out2["status"] == "completed"
+    ref = CounterTarget()
+    for i in range(10):
+        ref.apply(i)
+    onp.testing.assert_allclose(t2.state, ref.state)
+    assert recovery.read_resume_marker(str(tmp_path)) is None
+
+
+# ---------------------------------------------------------------------------
+# in-graph tier-1 skip + drain (real ShardedTrainStep)
+# ---------------------------------------------------------------------------
+
+def _sharded_step():
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import make_mesh, make_sharded_train_step
+
+    mx.random.seed(11)
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+    mesh = make_mesh({"dp": 1}, jax.devices("cpu")[:1])
+    return make_sharded_train_step(
+        net, opt.SGD(learning_rate=1e-2),
+        lambda out, x, y: jnp.mean((out - y) ** 2), mesh, num_model_args=1)
+
+
+def _batch(nan=False):
+    rng = onp.random.RandomState(0)
+    x = rng.uniform(-1, 1, (8, 8)).astype(onp.float32)
+    y = rng.uniform(-1, 1, (8, 4)).astype(onp.float32)
+    if nan:
+        x = x * onp.float32("nan")
+    return x, y
+
+
+def test_ingraph_skip_preserves_weights_no_retrace():
+    import jax
+    recovery.enable()
+    step = _sharded_step()
+    assert step._skip_nonfinite
+    x, y = _batch()
+    step.dispatch(x, y)
+    step.drain()
+    before = {n: onp.asarray(jax.device_get(v))
+              for n, v in step.pvals.items()}
+    xn, yn = _batch(nan=True)
+    step.dispatch(xn, yn)                            # NaN batch: skipped
+    step.drain()
+    for n, v in step.pvals.items():
+        onp.testing.assert_array_equal(onp.asarray(jax.device_get(v)),
+                                       before[n])
+    step.dispatch(x, y)                              # clean batch applies
+    step.drain()
+    changed = any(
+        not onp.array_equal(onp.asarray(jax.device_get(v)), before[n])
+        for n, v in step.pvals.items())
+    assert changed
+    assert step.trace_count == 1
+    mon = health.monitor()
+    assert any(a["rule"] == "nonfinite_grads" for a in mon.anomalies)
+
+
+def test_without_recovery_nan_batch_poisons_weights():
+    import jax
+    health.enable()                                  # probes, no guard
+    step = _sharded_step()
+    assert not step._skip_nonfinite
+    xn, yn = _batch(nan=True)
+    step.dispatch(xn, yn)
+    step.drain()
+    vals = onp.asarray(jax.device_get(step.pvals[step.diff_names[0]]))
+    assert not onp.isfinite(vals).all()
+
+
+def test_drain_retires_all_inflight():
+    step = _sharded_step()
+    x, y = _batch()
+    for _ in range(4):
+        step.dispatch(x, y)
+    assert step.drain() == 0
+    assert step.steps_in_flight() == 0
+    assert step.drain(timeout=0.5) == 0              # idempotent
+
+
+def test_agree_step_single_process():
+    assert recovery.agree_step(17) == 17
+
+
+def test_prefetcher_skip_fast_forwards():
+    from mxnet_tpu.parallel.prefetch import DevicePrefetcher
+    src = [(onp.full((2,), i, onp.float32),) for i in range(6)]
+    with DevicePrefetcher(iter(src), depth=2) as pf:
+        first = pf.skip(2)
+        assert first == 2
+        nxt = next(pf)   # 1-tuples come back unwrapped to the bare batch
+        assert float(onp.asarray(nxt)[0]) == 2.0
+        assert pf.skip(10) == 3                      # 3 left, then ends
+
+
+# ---------------------------------------------------------------------------
+# end-to-end chaos (subprocess): NaN skip + worker death + divergence
+# rollback + SIGTERM grace save + resume — the acceptance criterion
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_chaos_smoke_end_to_end():
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "..", "..", "tools",
+                      "chaos_smoke.py")],
+        capture_output=True, text=True, timeout=540,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "chaos smoke OK" in proc.stdout

@@ -1,0 +1,277 @@
+#!/usr/bin/env python
+"""Self-healing chaos smoke (`make chaos-smoke`, docs/resilience.md).
+
+End-to-end proof of the anomaly→remediation ladder over the ENV wiring a
+production run would use (``MXTPU_RECOVERY=1`` + ``MXTPU_FAULT_SPEC``),
+pure CPU, well under 60 s.  Phase A runs a 40-step `ElasticLoop` +
+`ShardedTrainStep` child that takes three injected hits:
+
+1. **NaN batch** (``nan_batch@7``) → the in-graph tier-1 guard drops the
+   update, the policy backs off the attached AMP loss scale, and a
+   ``remediation kind=skip`` journal event lands at step 7;
+2. **worker death** (``worker_exec@2:exit``) → the batches ride a
+   supervised process-pool DataLoader whose worker is repeatedly
+   hard-killed; supervision respawns + resubmits, order preserved;
+3. **sustained divergence** (``diverge_batch@20,21,22``) → three
+   consecutive grad-explosion/loss-spike steps trigger exactly ONE
+   tier-2 rollback to the newest healthy-tagged checkpoint (step 18,
+   since the step-24 save never happens / is tagged unhealthy), with the
+   poison window fast-forwarded on replay;
+4. a **mid-run SIGTERM** at step 30 → grace-deadline emergency
+   checkpoint + resumable marker, exit status ``preempted``.
+
+Phase B reruns the child with no faults armed: `ElasticLoop.run` honors
+the resume marker, restores the verified emergency checkpoint at step 30,
+and completes to 40.  Both phases assert ``trace_count == 1`` — the whole
+recovery machinery adds zero retraces when idle.
+
+Pure stdlib on the parent side; exits non-zero with a reason on failure.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+STEPS = 40
+SAVE_EVERY = 6
+NAN_AT = 7            # fault hit N fires on loop attempt N-1 = step id N
+DIVERGE_AT = (20, 21, 22)
+SIGTERM_AT = 30
+HEALTHY_CKPT = 18     # newest healthy-tagged save before the divergence
+INIT_SCALE = 2.0 ** 16
+
+
+class _ChaosDataset:
+    """Deterministic picklable dataset for the spawn workers."""
+
+    def __init__(self, n):
+        self._n = n
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, i):
+        import numpy as onp
+        return onp.full((8,), float(i), onp.float32)
+
+
+def _pull_epoch_through_loader():
+    """The worker-death leg: one epoch through the supervised process
+    pool while ``worker_exec@2:exit`` hard-kills every worker incarnation
+    on its 2nd batch — supervision must respawn, resubmit, and hand the
+    epoch out complete and in order."""
+    import numpy as onp
+    from mxnet_tpu.gluon.data import DataLoader
+
+    dl = DataLoader(_ChaosDataset(16), batch_size=4, num_workers=1,
+                    thread_pool=False, timeout=120, worker_respawns=16)
+    batches = [onp.asarray(b.asnumpy()) for b in dl]
+    dl._proc_pool.shutdown()
+    assert len(batches) == 4, f"epoch short: {len(batches)} batches"
+    flat = onp.concatenate(batches)[:, 0]
+    assert list(flat) == [float(i) for i in range(16)], \
+        "worker respawn broke batch order"
+    return batches
+
+
+def _child(phase: str, ckpt_dir: str) -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+
+    import mxnet_tpu as mx  # noqa: F401 — env auto-enables the subsystems
+    from mxnet_tpu import health, optimizer as opt, recovery, telemetry
+    from mxnet_tpu.amp.loss_scaler import LossScaler
+    from mxnet_tpu.elastic import ElasticLoop
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import make_mesh, make_sharded_train_step
+    from mxnet_tpu.resilience import FaultInjected, fault_point
+
+    assert telemetry.enabled(), "MXTPU_TELEMETRY env wiring broken"
+    assert health.enabled(), "MXTPU_HEALTH implied by recovery"
+    assert recovery.enabled(), "MXTPU_RECOVERY env wiring broken"
+
+    if phase == "A":
+        _pull_epoch_through_loader()
+
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+    mesh = make_mesh({"dp": 1}, jax.devices()[:1])
+    step = make_sharded_train_step(
+        net, opt.SGD(learning_rate=1e-2),
+        lambda out, x, y: jnp.mean((out - y) ** 2), mesh, num_model_args=1)
+    assert step._skip_nonfinite, "in-graph skip guard not armed"
+    rng = onp.random.RandomState(0)
+    xs = rng.uniform(-1, 1, (8, 8)).astype("float32")
+    ys = rng.uniform(-1, 1, (8, 4)).astype("float32")
+
+    scaler = LossScaler(init_scale=INIT_SCALE)
+    policy = recovery.RecoveryPolicy(scaler=scaler)
+    loop = ElasticLoop(step, ckpt_dir, save_every=SAVE_EVERY, keep=4,
+                       recovery=policy, preempt_grace=60.0)
+
+    def step_fn(i):
+        x = xs
+        try:
+            # timing from the armed registry, payload a poisoned batch —
+            # how a bad record or corrupt H2D shows up for real
+            fault_point("nan_batch")
+        except FaultInjected:
+            x = xs * float("nan")
+        try:
+            fault_point("diverge_batch")
+        except FaultInjected:
+            x = xs * 1e4   # grads explode ~1e8: spike + explosion rules
+        return step.dispatch(x, ys)
+
+    def on_step(i, _loss):
+        if phase == "A" and i == SIGTERM_AT:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    out = loop.run(step_fn, total_steps=STEPS, on_step=on_step)
+    step.drain()
+    print(json.dumps({
+        "phase": phase, "status": out["status"], "step": out["step"],
+        "trace_count": step.trace_count, "skips": policy.skips,
+        "rollbacks": policy.rollbacks, "loss_scale": scaler.loss_scale,
+        "emergency": out.get("emergency"),
+    }))
+    return 0
+
+
+def _read_journal(path):
+    rows = []
+    with open(path) as f:
+        for line in f:
+            if line.strip():
+                rows.append(json.loads(line))
+    return rows
+
+
+def _fail(msg, extra=""):
+    print(f"FAIL: {msg}", file=sys.stderr)
+    if extra:
+        print(extra[-4000:], file=sys.stderr)
+    return 1
+
+
+def main() -> int:
+    if "--child" in sys.argv:
+        return _child(sys.argv[sys.argv.index("--child") + 1],
+                      sys.argv[sys.argv.index("--child") + 2])
+
+    workdir = tempfile.mkdtemp(prefix="mxtpu-chaos-smoke-")
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    here = os.path.abspath(__file__)
+    base_env = {
+        "JAX_PLATFORMS": "cpu",
+        "MXTPU_RECOVERY": "1",
+        "MXTPU_SKIP_BUDGET": "8",
+        "MXTPU_ROLLBACK_BUDGET": "2",
+        "MXTPU_PREEMPT_GRACE": "60",
+        "MXTPU_CRASH_DIR": os.path.join(workdir, "crash"),
+    }
+
+    # ---- phase A: NaN skip, worker death, divergence rollback, SIGTERM
+    journal_a = os.path.join(workdir, "journal_a.jsonl")
+    env = dict(os.environ)
+    env.update(base_env)
+    env["MXTPU_TELEMETRY"] = journal_a
+    env["MXTPU_FAULT_SPEC"] = (
+        f"nan_batch@{NAN_AT},worker_exec@2:exit,"
+        + ",".join(f"diverge_batch@{s}" for s in DIVERGE_AT))
+    proc = subprocess.run(
+        [sys.executable, here, "--child", "A", ckpt_dir],
+        env=env, capture_output=True, text=True, timeout=600,
+        cwd=os.path.dirname(os.path.dirname(here)))
+    if proc.returncode != 0:
+        return _fail(f"phase A child exited {proc.returncode}",
+                     proc.stdout + proc.stderr)
+    try:
+        result_a = json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return _fail("phase A child produced no result json",
+                     proc.stdout + proc.stderr)
+
+    if result_a["status"] != "preempted":
+        return _fail(f"phase A status {result_a['status']!r} != 'preempted'",
+                     proc.stderr)
+    if result_a["trace_count"] != 1:
+        return _fail(f"recovery machinery caused retraces: "
+                     f"trace_count={result_a['trace_count']}")
+    if result_a["skips"] < 1:
+        return _fail("tier-1 skip never fired")
+    if result_a["rollbacks"] != 1:
+        return _fail(f"expected exactly 1 rollback, got "
+                     f"{result_a['rollbacks']}", proc.stderr)
+    if not result_a["loss_scale"] < INIT_SCALE:
+        return _fail(f"loss scale not backed off "
+                     f"(still {result_a['loss_scale']})")
+    emergency = result_a.get("emergency") or {}
+    if not emergency.get("complete"):
+        return _fail(f"emergency checkpoint incomplete: {emergency}")
+
+    rows = _read_journal(journal_a)
+    rem = [r for r in rows if r["event"] == "remediation"]
+    skips = [r for r in rem if r.get("kind") == "skip"]
+    if not skips or skips[0]["step"] != NAN_AT:
+        return _fail(f"remediation skip event missing/misplaced: {skips}")
+    if skips[0].get("loss_scale") is None or \
+            not skips[0]["loss_scale"] < INIT_SCALE:
+        return _fail(f"skip event carries no backed-off scale: {skips[0]}")
+    rollbacks = [r for r in rem if r.get("kind") == "rollback"]
+    if len(rollbacks) != 1:
+        return _fail(f"expected 1 remediation rollback event, got "
+                     f"{len(rollbacks)}")
+    if rollbacks[0].get("restored_step") != HEALTHY_CKPT:
+        return _fail(f"rollback restored step "
+                     f"{rollbacks[0].get('restored_step')} != "
+                     f"{HEALTHY_CKPT} (newest healthy checkpoint)")
+    preempts = [r for r in rem if r.get("kind") == "preempt_save"]
+    if not preempts or not preempts[-1].get("complete") \
+            or preempts[-1]["step"] != SIGTERM_AT:
+        return _fail(f"preempt_save event wrong: {preempts}")
+    if not any(r.get("kind") == "data_skip" for r in rem):
+        return _fail("poison window was not fast-forwarded on replay")
+
+    # ---- phase B: no faults; resume from the emergency checkpoint
+    journal_b = os.path.join(workdir, "journal_b.jsonl")
+    env = dict(os.environ)
+    env.update(base_env)
+    env["MXTPU_TELEMETRY"] = journal_b
+    env.pop("MXTPU_FAULT_SPEC", None)
+    proc = subprocess.run(
+        [sys.executable, here, "--child", "B", ckpt_dir],
+        env=env, capture_output=True, text=True, timeout=600,
+        cwd=os.path.dirname(os.path.dirname(here)))
+    if proc.returncode != 0:
+        return _fail(f"phase B child exited {proc.returncode}",
+                     proc.stdout + proc.stderr)
+    result_b = json.loads(proc.stdout.strip().splitlines()[-1])
+    if result_b["status"] != "completed" or result_b["step"] != STEPS:
+        return _fail(f"phase B did not complete: {result_b}")
+    if result_b["trace_count"] != 1:
+        return _fail(f"phase B retraced: {result_b['trace_count']}")
+    rem_b = [r for r in _read_journal(journal_b)
+             if r["event"] == "remediation"]
+    resumes = [r for r in rem_b if r.get("kind") == "preempt_resume"]
+    if not resumes or resumes[0]["step"] != SIGTERM_AT:
+        return _fail(f"phase B did not resume from the emergency "
+                     f"checkpoint at step {SIGTERM_AT}: {resumes}")
+
+    print(f"chaos smoke OK: skip@{skips[0]['step']} (scale "
+          f"{skips[0]['loss_scale']:g}), 1 rollback -> step "
+          f"{rollbacks[0]['restored_step']}, preempt@{SIGTERM_AT} "
+          f"(complete), resumed@{resumes[0]['step']} -> {STEPS} "
+          f"[trace_count=1 in both phases]")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

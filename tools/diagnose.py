@@ -3,10 +3,13 @@
 framework features, device inventory, key environment variables.
 
 Also pretty-prints crash flight-recorder bundles (docs/observability.md,
-"Training health & post-mortems"):
+"Training health & post-mortems") and recovery timelines
+(docs/resilience.md, "Recovery policies & preemption"):
 
     python tools/diagnose.py --bundle <crash_*.json>
     python tools/diagnose.py --crash-dir <dir>     # newest bundle in dir
+    python tools/diagnose.py --journal <run.jsonl> # remediation timeline
+                                                   # + rollback lineage
 """
 from __future__ import annotations
 
@@ -59,6 +62,13 @@ def print_bundle(path: str) -> int:
             extra = {k: v for k, v in a.items()
                      if k not in ("rule", "step", "time")}
             print(f"  step {a.get('step')}: {a.get('rule')} {extra}")
+    remediations = [ev for ev in b.get("events") or []
+                    if ev.get("event") == "remediation"]
+    if remediations:
+        print(f"---------- remediation ladder ({len(remediations)}) "
+              f"----------")
+        for ev in remediations[-20:]:
+            print("  " + _fmt_remediation(ev))
     events = b.get("events") or []
     print(f"---------- last events ({len(events)} in ring) ----------")
     for ev in events[-30:]:
@@ -86,6 +96,80 @@ def print_bundle(path: str) -> int:
     return 0
 
 
+def _fmt_remediation(ev: dict) -> str:
+    """One remediation journal event as a human-readable ladder line."""
+    kind = ev.get("kind")
+    step = str(ev.get("step"))  # None-safe: a partial preempt_save from a
+    #                             checkpoint-less run carries step=null
+    if kind == "skip":
+        scale = ev.get("loss_scale")
+        return (f"step {step:>6}  tier-1 SKIP     update dropped "
+                f"({ev.get('rule')})"
+                + (f", loss scale -> {scale:g}" if scale else ""))
+    if kind == "rollback":
+        return (f"step {step:>6}  tier-2 ROLLBACK {ev.get('from_step')} -> "
+                f"{ev.get('restored_step')} ({ev.get('reason')}); poison "
+                f"steps {ev.get('poison')}, discarded ckpts "
+                f"{ev.get('discarded')}")
+    if kind == "data_skip":
+        return f"step {step:>6}  tier-2 replay   poison batch skipped"
+    if kind == "exit":
+        return (f"step {step:>6}  tier-3 EXIT     {ev.get('reason')}; "
+                f"bundle {ev.get('bundle')}")
+    if kind == "preempt_save":
+        state = "complete" if ev.get("complete") else \
+            "PARTIAL (marker only)"
+        return (f"step {step:>6}  preemption      emergency save {state} "
+                f"-> {ev.get('checkpoint')} in {ev.get('elapsed_s')}s")
+    if kind == "preempt_resume":
+        return (f"step {step:>6}  preemption      resumed from emergency "
+                f"checkpoint {ev.get('checkpoint')}")
+    extra = {k: v for k, v in ev.items()
+             if k not in ("event", "kind", "step", "ts", "seq")}
+    return f"step {step:>6}  {kind:<15} {extra}"
+
+
+def print_journal(path: str) -> int:
+    """Remediation timeline + rollback lineage from a run journal."""
+    rows = []
+    try:
+        with open(path) as f:
+            for line in f:
+                if line.strip():
+                    rows.append(json.loads(line))
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"cannot read journal {path}: {e}", file=sys.stderr)
+        return 1
+    rem = [r for r in rows if r.get("event") == "remediation"]
+    anomalies = [r for r in rows if r.get("event") == "anomaly"]
+    print(f"========== run journal: {path} ==========")
+    print(f"events    : {len(rows)} total, {len(anomalies)} anomalies, "
+          f"{len(rem)} remediation")
+    if rem:
+        print("---------- remediation timeline ----------")
+        for ev in rem:
+            print("  " + _fmt_remediation(ev))
+    rollbacks = [r for r in rem if r.get("kind") == "rollback"]
+    if rollbacks:
+        print("---------- rollback lineage ----------")
+        # each rollback forks the run: show the abandoned span and what
+        # the replay continued from
+        for i, rb in enumerate(rollbacks):
+            print(f"  [{i}] timeline abandoned at step "
+                  f"{rb.get('from_step')} ({rb.get('reason')}): resumed "
+                  f"from healthy checkpoint step {rb.get('restored_step')}"
+                  + (f"; discarded diverged checkpoint(s) at steps "
+                     f"{rb.get('discarded')}" if rb.get("discarded")
+                     else ""))
+    discards = [r for r in rows if r.get("event") == "checkpoint_discard"]
+    for d in discards:
+        print(f"  checkpoint step {d.get('step')} sidelined "
+              f"(*.rolledback) after rollback to {d.get('rolled_back_to')}")
+    if not rem and not anomalies:
+        print("no anomalies or remediation recorded — a healthy run")
+    return 0
+
+
 def _newest_bundle(crash_dir: str):
     paths = glob.glob(os.path.join(crash_dir, "crash_*.json"))
     return max(paths, key=os.path.getmtime) if paths else None
@@ -102,6 +186,8 @@ def _flag_operand(flag: str) -> str:
 def main():
     if "--bundle" in sys.argv:
         return sys.exit(print_bundle(_flag_operand("--bundle")))
+    if "--journal" in sys.argv:
+        return sys.exit(print_journal(_flag_operand("--journal")))
     if "--crash-dir" in sys.argv:
         d = _flag_operand("--crash-dir")
         newest = _newest_bundle(d)
