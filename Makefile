@@ -2,7 +2,7 @@
 # to what a single-language-core framework needs).
 PY ?= python
 
-.PHONY: ci test test-all test-dist test-parity lint bench cpp docs clean opperf-check telemetry-smoke health-smoke chaos-smoke
+.PHONY: ci test test-all test-dist test-parity lint bench cpp docs clean opperf-check telemetry-smoke health-smoke chaos-smoke serve-smoke perf-gate
 
 # the one-command gate CI runs (VERDICT round-2 next-step #7): lint +
 # unit suite + 2-process dist tests + C++ package build/tests
@@ -17,7 +17,7 @@ cpp-test:
 # `make test-all` runs everything.  -n auto parallelizes when xdist +
 # cores are available: ~13.5 min serial on the 1-core builder VM,
 # well under 10 min on any >=2-core box
-test: telemetry-smoke health-smoke chaos-smoke
+test: telemetry-smoke health-smoke chaos-smoke serve-smoke
 	$(PY) -m pytest tests/unittest -q -m "not slow" $$($(PY) -c 'import xdist, os; print("-n auto" if (os.cpu_count() or 1) > 1 else "")' 2>/dev/null) --ignore=tests/unittest/test_dist_kvstore.py
 
 test-all:
@@ -66,6 +66,22 @@ health-smoke:
 # (docs/resilience.md, "Recovery policies & preemption")
 chaos-smoke:
 	$(PY) tools/chaos_smoke.py
+
+# serving-stack end-to-end: 8 staggered concurrent requests through the
+# continuous-batching scheduler over a deliberately undersized paged KV
+# pool (forced mid-stream eviction + re-admit); asserts streamed tokens
+# are bit-identical to unbatched generate() and the per-request TTFT
+# histograms / page-occupancy gauges landed in telemetry
+# (docs/serving.md)
+serve-smoke:
+	$(PY) tools/serve_smoke.py
+
+# CPU-bench regression tripwire (ROADMAP item 5): median-of-3
+# `bench.py --measure cpu` runs must stay within 15% of the checked-in
+# budget (bench_results/cpu_budget.json); re-baseline deliberately with
+# `python tools/perf_gate.py --rebaseline`
+perf-gate:
+	$(PY) tools/perf_gate.py
 
 cpp:
 	cmake -S cpp-package -B cpp-package/build && \

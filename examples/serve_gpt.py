@@ -1,0 +1,100 @@
+"""Serving a GPT with continuous batching: the `mx.serve` surface.
+
+A tiny GPT (untrained weights — serving mechanics, not text quality)
+handles a burst of concurrent requests with mixed prompt lengths through
+the continuous-batching engine:
+
+- paged KV cache: all requests share one preallocated page pool, sized
+  deliberately small here so a mid-stream eviction + re-admission
+  (recompute preemption) actually happens;
+- ONE compiled device step serves mixed prefill + decode (ragged paged
+  attention) with the pool buffers donated through it;
+- tokens stream through `on_token` callbacks the moment they land;
+- the output of every request is checked bit-identical to an unbatched
+  `model.generate` run — batching, paging, and eviction are invisible;
+- the telemetry snapshot shows the per-request TTFT/latency histograms
+  and page-occupancy gauges a production deployment would scrape.
+
+Run:
+    python examples/serve_gpt.py [--cpu]
+Prints "serving example OK".
+"""
+import argparse
+import os
+import sys
+
+import numpy as onp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend")
+    args = ap.parse_args()
+    if args.cpu:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry as tele
+    from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from mxnet_tpu.serve import InferenceEngine, ServeConfig
+
+    tele.enable()
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=4, intermediate_size=64, max_position=64,
+                    dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.initialize()
+    model(mx.np.array([[1, 2]], dtype="int32"))     # build params
+
+    rng = onp.random.RandomState(11)
+    prompts = [rng.randint(0, cfg.vocab_size, n).tolist()
+               for n in (3, 9, 5, 12, 2, 7)]
+    max_new = 8
+
+    # unbatched oracle: one generate() per prompt
+    refs = [onp.asarray(model.generate(mx.np.array([p], dtype="int32"),
+                                       max_new_tokens=max_new)
+                        .asnumpy())[0].tolist() for p in prompts]
+
+    # pool sized for pressure: the 5 allocatable pages hold exactly ONE
+    # full-length (20-token) sequence, so any two overlapping decodes
+    # must collide and evict, while every request still fits alone
+    # (re-admission always succeeds)
+    eng = InferenceEngine(model, ServeConfig(
+        max_slots=2, page_size=4, num_pages=6, prefill_chunk=4,
+        max_len=20))
+    print(f"warmup: compiled both step programs in "
+          f"{eng.warmup():.2f}s")
+
+    streams = {i: [] for i in range(len(prompts))}
+    handles = [eng.submit(p, max_new_tokens=max_new,
+                          on_token=lambda t, r, i=i: streams[i].append(t))
+               for i, p in enumerate(prompts)]
+    steps = eng.run_until_idle()
+
+    for i, (h, ref) in enumerate(zip(handles, refs)):
+        assert h.result(timeout=0) == ref, f"request {i} diverged"
+        assert streams[i] == ref[len(prompts[i]):], \
+            f"request {i} streamed tokens diverged"
+    evictions = sum(h.evictions for h in handles)
+    assert evictions >= 1, "expected page pressure to force an eviction"
+
+    snap = tele.snapshot()
+    ttft = snap["serve_ttft_ms"]["series"][0]
+    occ = snap["serve_page_occupancy_ratio"]["series"][0]["value"]
+    print(f"served {len(prompts)} requests in {steps} steps "
+          f"({evictions} eviction(s); every output identical to "
+          f"unbatched generate)")
+    print(f"ttft: count={ttft['count']} sum_ms={ttft['sum']:.1f}; "
+          f"final page occupancy={occ:.2f}")
+    tele.disable()
+    print("serving example OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -22,7 +22,7 @@ from ..ndarray.ndarray import ndarray, apply_op, from_jax
 __all__ = [
     "quantize", "dequantize", "requantize", "quantized_fully_connected",
     "calib_minmax", "calib_entropy", "LayerCalibrator", "quantize_net",
-    "QuantizedDense",
+    "QuantizedDense", "quantize_kv", "dequantize_kv",
 ]
 
 INT8_MAX = 127.0
@@ -68,6 +68,30 @@ def requantize(data, min_range, max_range, out_min, out_max):
         return q.astype(jnp.int8)
     return apply_op(fn, (data, min_range, max_range, out_min, out_max), {},
                     name="requantize")
+
+
+def quantize_kv(x, axis=-1):
+    """Symmetric per-vector int8 quantization for the serving KV cache.
+
+    Pure jax (jit/scan-safe — the serving engine calls this INSIDE its
+    compiled step, unlike the `apply_op`-wrapped eager ops above): each
+    vector along `axis` gets one scale ``amax/127``.  Returns
+    ``(q int8, scale f32)`` with `scale` shaped like `x` minus `axis`.
+    A zero vector quantizes to zeros with scale 0 (dequantizes to 0, no
+    division-by-zero)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=axis)
+    scale = amax / INT8_MAX
+    inv = jnp.where(scale > 0.0, 1.0 / jnp.maximum(scale, 1e-30), 0.0)
+    q = jnp.clip(jnp.round(xf * jnp.expand_dims(inv, axis)),
+                 -INT8_MAX, INT8_MAX)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_kv(q, scale, axis=-1, dtype=jnp.float32):
+    """Inverse of :func:`quantize_kv`."""
+    return (q.astype(jnp.float32)
+            * jnp.expand_dims(scale, axis)).astype(dtype)
 
 
 def _q8(x, amax):

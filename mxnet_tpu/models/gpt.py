@@ -249,111 +249,26 @@ class GPTForCausalLM(HybridBlock):
             ids = np.concatenate([ids, nxt.reshape(-1, 1)], axis=1)
         return ids
 
-    def _decode_weights(self):
-        """Pure jax view of the decoder weights for the cached scan."""
-        import jax.numpy as jnp
-        t = self.transformer
-        def w(p):
-            return p.data()._data
-        layers = []
-        for blk in t.layers:
-            layers.append(dict(
-                ln1_g=w(blk.attn_norm.gamma), ln1_b=w(blk.attn_norm.beta),
-                wqkv=w(blk.attention.attn_qkv.weight),
-                bqkv=w(blk.attention.attn_qkv.bias),
-                wo=w(blk.attention.attn_proj.weight),
-                bo=w(blk.attention.attn_proj.bias),
-                ln2_g=w(blk.ffn_norm.gamma), ln2_b=w(blk.ffn_norm.beta),
-                w1=w(blk.ffn.ffn_intermediate.weight),
-                b1=w(blk.ffn.ffn_intermediate.bias),
-                w2=w(blk.ffn.ffn_output.weight),
-                b2=w(blk.ffn.ffn_output.bias)))
-        head = (None if self.cfg.tie_embeddings
-                else w(self.lm_head.weight))
-        pos = (None if getattr(self.cfg, "rope", False)
-               else w(t.position_embed.weight))
-        return dict(embed=w(t.word_embed.weight), pos=pos,
-                    lnf_g=w(t.final_norm.gamma), lnf_b=w(t.final_norm.beta),
-                    head=head, layers=layers)
-
     def _token_step(self, P, tok, t, kcache, vcache, T):
         """One cached decoder step: token ids (N,) at position t against
         (n_layers, N, H_kv, T, D) caches -> (logits (N, V), new caches).
-        Under GQA (num_kv_heads < num_heads) the caches store only the kv
-        heads — the memory saving — and repeat per query-head group at
-        use."""
-        import jax
+
+        Thin adapter over the SHARED decode core (`serve/decode.py`) —
+        the same `transformer_step` the serving engine compiles over its
+        paged KV pool, here with dense per-request caches.  Under GQA the
+        caches store only the kv heads and the shared `_dense_attend`
+        scores per query-head group without expanding them."""
         import jax.numpy as jnp
-        from jax import lax
-        from ..ops.attention import rope_rotate
+        from ..serve.decode import (transformer_step, lm_logits,
+                                    dense_kv_fn)
 
-        cfg = self.cfg
-        H, E = cfg.num_heads, cfg.hidden_size
-        D = E // H
-        Hkv = getattr(cfg, "num_kv_heads", None) or H
-        kvw = Hkv * D
-        eps = cfg.layer_norm_eps
         N = tok.shape[0]
-
-        def ln(x, g, b):
-            m = x.mean(-1, keepdims=True)
-            v = ((x - m) ** 2).mean(-1, keepdims=True)
-            return (x - m) / jnp.sqrt(v + eps) * g + b
-
-        use_rope = getattr(cfg, "rope", False)
-        h = P["embed"][tok]
-        if not use_rope:
-            h = h + P["pos"][t]
-        new_k, new_v = [], []
-        for li, L in enumerate(P["layers"]):
-            a = ln(h, L["ln1_g"], L["ln1_b"])
-            qkv = a @ L["wqkv"].T + L["bqkv"]
-            q = qkv[..., :E]
-            k = qkv[..., E:E + kvw]
-            v = qkv[..., E + kvw:]
-            qh = q.reshape(N, H, D)
-            kh_new = k.reshape(N, Hkv, D)
-            if use_rope:
-                # the SAME rotation helper as the full forward, at this
-                # step's absolute position (cached keys are pre-rotated)
-                qh = rope_rotate(qh, t, cfg.rope_theta)
-                kh_new = rope_rotate(kh_new, t, cfg.rope_theta)
-            kc = lax.dynamic_update_slice_in_dim(
-                kcache[li], kh_new[:, :, None], t, axis=2)
-            vc = lax.dynamic_update_slice_in_dim(
-                vcache[li], v.reshape(N, Hkv, D)[:, :, None], t, axis=2)
-            new_k.append(kc)
-            new_v.append(vc)
-            # GQA: the cache stores Hkv heads (the memory saving); score
-            # each query-head GROUP against its kv head directly — a
-            # jnp.repeat of the cache would rematerialize exactly the
-            # bandwidth GQA saves, every step
-            scale = 1.0 / jnp.sqrt(jnp.float32(D)).astype(h.dtype)
-            if Hkv == H:
-                s = jnp.einsum("bhd,bhtd->bht", qh, kc) * scale
-            else:
-                qg = qh.reshape(N, Hkv, H // Hkv, D)
-                s = (jnp.einsum("bgrd,bgtd->bgrt", qg, kc)
-                     .reshape(N, H, T) * scale)
-            mask = jnp.arange(T) <= t
-            if getattr(cfg, "window", None):
-                # sliding-window decode: only the last `window` positions
-                mask &= jnp.arange(T) >= t - cfg.window
-            s = jnp.where(mask[None, None], s, -1e30)
-            p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(
-                h.dtype)
-            if Hkv == H:
-                ctx = jnp.einsum("bht,bhtd->bhd", p, vc).reshape(N, E)
-            else:
-                pg = p.reshape(N, Hkv, H // Hkv, T)
-                ctx = jnp.einsum("bgrt,bgtd->bgrd", pg, vc).reshape(N, E)
-            h = h + ctx @ L["wo"].T + L["bo"]
-            f = ln(h, L["ln2_g"], L["ln2_b"])
-            h = h + jax.nn.gelu(f @ L["w1"].T + L["b1"]) @ L["w2"].T \
-                + L["b2"]
-        h = ln(h, P["lnf_g"], P["lnf_b"])
-        logits = h @ (P["embed"].T if P["head"] is None else P["head"].T)
-        return logits, jnp.stack(new_k), jnp.stack(new_v)
+        pos = jnp.broadcast_to(jnp.reshape(t, (1, 1)), (N, 1))
+        kv_fn, new_caches = dense_kv_fn(
+            kcache, vcache, pos, window=getattr(self.cfg, "window", None))
+        h = transformer_step(P, self.cfg, tok[:, None], pos, kv_fn)
+        kc, vc = new_caches()
+        return lm_logits(P, h[:, 0]), kc, vc
 
     def _generate_beam(self, input_ids, max_new_tokens, num_beams,
                        eos_token_id, length_penalty=1.0):
@@ -376,7 +291,8 @@ class GPTForCausalLM(HybridBlock):
         D = E // H
         H_kv = getattr(cfg, "num_kv_heads", None) or H   # cache head count
         K = int(num_beams)
-        P = self._decode_weights()
+        from ..serve.decode import extract_decode_weights
+        P = extract_decode_weights(self)
         prompt = input_ids._data if hasattr(input_ids, "_data") \
             else jnp.asarray(input_ids)
         B, plen = prompt.shape
@@ -472,7 +388,8 @@ class GPTForCausalLM(HybridBlock):
         D = E // H
         H_kv = getattr(cfg, "num_kv_heads", None) or H   # cache head count
         eps = cfg.layer_norm_eps
-        P = self._decode_weights()
+        from ..serve.decode import extract_decode_weights
+        P = extract_decode_weights(self)
         prompt = input_ids._data if hasattr(input_ids, "_data") \
             else jnp.asarray(input_ids)
         B, plen = prompt.shape

@@ -1,0 +1,358 @@
+"""Ragged paged attention for the serving stack (Pallas TPU + reference).
+
+TPU-native serving kernel in the *Ragged Paged Attention* shape (PAPERS.md,
+arxiv 2604.15464): ONE launch handles a mixed continuous-batching step —
+some slots mid-prefill (a chunk of C query tokens), others decoding (one
+query token) — attending over a **paged KV pool**.  The pool stores keys and
+values as fixed-size pages `(num_pages, page_size, Hkv, D)` in HBM; each
+slot's logical context is the concatenation of the pages its page table
+names.  The kernel walks a slot's pages sequentially (online softmax, flash
+style), fetching the physical page via scalar-prefetched page-table indices
+— no (B, L_max, ...) contiguous gather is ever materialised on the TPU
+path.
+
+Grouped-query attention uses the same folding trick as
+`flash_attention.py`: the `rep = H // Hkv` query heads sharing a kv head
+stack along the row axis, so K/V pages stream once per kv head.
+
+The **reference path** (`paged_attention_reference`) gathers the page table
+into a contiguous `(B, L, Hkv, D)` context and runs masked dense attention
+with `_dense_attend` — the CPU tier-1 path, and the numerical baseline the
+kernel is tested against (interpret mode runs the exact kernel code on
+CPU).  `_dense_attend` is also what `GPTForCausalLM.generate`'s dense-cache
+scan uses, so the serving engine and single-model generate can never
+disagree on attention semantics.
+
+Masking uses exact arithmetic on purpose: hard-masked scores become
+``MASK_VALUE`` whose exp underflows to exactly 0.0, so a longer padded
+context contributes exact zero terms and stays bit-identical to the
+unpadded computation (the serve smoke asserts streamed tokens equal
+unbatched `generate`).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+MASK_VALUE = -1e30
+LANES = 128
+_WARNED_FALLBACK = False
+
+__all__ = ["ragged_paged_attention", "paged_attention_reference",
+           "gather_pages", "MASK_VALUE"]
+
+
+def _interpret() -> bool:
+    from ...base import getenv_bool
+    return getenv_bool("MXTPU_PALLAS_INTERPRET", False)
+
+
+def _force_reference() -> bool:
+    import os
+    return os.environ.get("MXTPU_PAGED_ATTENTION", "").strip().lower() \
+        == "reference"
+
+
+# ---------------------------------------------------------------------------
+# dense attention over a contiguous cached context (shared semantics)
+# ---------------------------------------------------------------------------
+
+def _dense_attend(q, kc, vc, q_pos, ctx_len=None, window=None, scale=None):
+    """Masked attention of chunk queries against a contiguous KV context.
+
+    q: (B, H, C, D); kc/vc: (B, Hkv, T, D) (Hkv divides H — GQA);
+    q_pos: (B, C) absolute position of each query row; ctx_len: (B,)
+    valid context length (None = causal mask alone suffices, the
+    dense-cache decode case where unwritten slots are masked by q_pos).
+
+    Exactly the decode attention semantics of the pre-refactor
+    `GPTForCausalLM._token_step`, generalised to C query rows: scores in
+    the activation dtype scaled by 1/sqrt(D), softmax in fp32, GQA scored
+    per kv-head group without expanding the cache.
+    """
+    B, H, C, D = q.shape
+    Hkv, T = kc.shape[1], kc.shape[2]
+    if scale is None:
+        scale = 1.0 / jnp.sqrt(jnp.float32(D)).astype(q.dtype)
+    if Hkv == H:
+        s = jnp.einsum("bhcd,bhtd->bhct", q, kc) * scale
+    else:
+        rep = H // Hkv
+        qg = q.reshape(B, Hkv, rep, C, D).reshape(B, Hkv, rep * C, D)
+        s = jnp.einsum("bgrd,bgtd->bgrt", qg, kc).reshape(
+            B, Hkv, rep, C, T).reshape(B, H, C, T) * scale
+    t_idx = jnp.arange(T)[None, None, None, :]
+    pos = q_pos[:, None, :, None]
+    mask = t_idx <= pos
+    if ctx_len is not None:
+        mask &= t_idx < ctx_len[:, None, None, None]
+    if window is not None:
+        mask &= t_idx >= pos - window
+    s = jnp.where(mask, s, MASK_VALUE)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if Hkv == H:
+        return jnp.einsum("bhct,bhtd->bhcd", p, vc)
+    rep = H // Hkv
+    pg = p.reshape(B, Hkv, rep, C, T).reshape(B, Hkv, rep * C, T)
+    ctx = jnp.einsum("bgrt,bgtd->bgrd", pg, vc)
+    return ctx.reshape(B, Hkv, rep, C, D).reshape(B, H, C, D)
+
+
+# ---------------------------------------------------------------------------
+# page gathering (reference path + int8 dequant epilogue)
+# ---------------------------------------------------------------------------
+
+def gather_pages(pool, page_tables, scales=None):
+    """Materialise each slot's logical context from the paged pool.
+
+    pool: (num_pages, page_size, Hkv, D); page_tables: (B, max_pages)
+    int32 (unallocated entries may point anywhere — callers mask by
+    ctx_len).  Returns (B, max_pages * page_size, Hkv, D).
+
+    `scales` (num_pages, page_size, Hkv) dequantizes an int8 pool on the
+    fly — only the gathered context is dequantized, never the whole pool.
+    """
+    g = pool[page_tables]                       # (B, maxp, ps, Hkv, D)
+    B, maxp, ps, Hkv, D = g.shape
+    g = g.reshape(B, maxp * ps, Hkv, D)
+    if scales is not None:
+        sc = scales[page_tables].reshape(B, maxp * ps, Hkv, 1)
+        g = g.astype(jnp.float32) * sc
+    return g
+
+
+def paged_attention_reference(q, kpool, vpool, page_tables, ctx_lens,
+                              start_pos, window=None, scale=None,
+                              k_scales=None, v_scales=None, out_dtype=None):
+    """Dense reference: gather the page table to a contiguous context and
+    run `_dense_attend`.  CPU tier-1 path and the kernel's test oracle."""
+    B, H, C, D = q.shape
+    q_pos = start_pos[:, None] + jnp.arange(C)[None, :]
+    kc = gather_pages(kpool, page_tables, k_scales)
+    vc = gather_pages(vpool, page_tables, v_scales)
+    dt = out_dtype or q.dtype
+    kc = kc.astype(dt)
+    vc = vc.astype(dt)
+    # (B, L, Hkv, D) -> (B, Hkv, L, D)
+    kc = kc.transpose(0, 2, 1, 3)
+    vc = vc.transpose(0, 2, 1, 3)
+    return _dense_attend(q.astype(dt), kc, vc, q_pos, ctx_len=ctx_lens,
+                         window=window, scale=scale)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel
+# ---------------------------------------------------------------------------
+
+def _make_rpa_kernel(n_kv_heads, scale, chunk, page_size, window):
+    """Build the kernel body with static head-count/shape parameters.
+
+    One (slot·kv-head, page) grid step: rows are the GQA fold — row r =
+    (query-head-in-group r // chunk, chunk token r % chunk), so every
+    row's query position is ``start + r % chunk``.  Pages walk
+    sequentially (innermost grid dim) with flash-style online softmax in
+    VMEM scratch."""
+    from jax.experimental import pallas as pl
+
+    def kernel(pt_ref, ctx_ref, start_ref, q_ref, k_ref, v_ref,
+               o_ref, m_scr, l_scr, acc_scr):
+        bh = pl.program_id(0)
+        pi = pl.program_id(1)
+        n_pages = pl.num_programs(1)
+        b = bh // n_kv_heads
+
+        rows, d = q_ref.shape
+        ps = k_ref.shape[0]
+
+        @pl.when(pi == 0)
+        def _init():
+            m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+            l_scr[...] = jnp.zeros_like(l_scr)
+            acc_scr[...] = jnp.zeros_like(acc_scr)
+
+        ctx = ctx_ref[b]
+        start = start_ref[b]
+
+        def _step():
+            qb = q_ref[...]
+            kb = k_ref[...]
+            s = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            # row r -> query position start + r % chunk; col j -> key
+            # position pi * ps + j
+            r = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            c = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            qpos = start + r % chunk
+            kpos = pi * ps + c
+            keep = (kpos < ctx) & (kpos <= qpos)
+            if window is not None:
+                keep &= kpos >= qpos - window
+            s = jnp.where(keep, s, MASK_VALUE)
+            m_prev = m_scr[...]
+            l_prev = l_scr[...]
+            m_cur = jnp.max(s, axis=1)[:, None]
+            m_next = jnp.maximum(m_prev, m_cur)
+            p = jnp.exp(s - _lanes(m_next, ps))
+            # fully-masked rows: exp(MASK - m) must be exactly 0, not 1
+            p = jnp.where(s > 0.5 * MASK_VALUE, p, 0.0)
+            alpha = jnp.exp(m_prev - m_next)
+            l_scr[...] = alpha * l_prev + jnp.sum(p, axis=1)[:, None]
+            m_scr[...] = m_next
+            vb = v_ref[...]
+            acc_scr[...] = acc_scr[...] * _lanes(alpha, d) + jax.lax.dot(
+                p.astype(vb.dtype), vb, preferred_element_type=jnp.float32)
+
+        # skip pages entirely past the slot's context (the ragged win:
+        # a decode slot with 40 tokens touches 3 pages, not max_pages)
+        pl.when(pi * ps < ctx)(_step)
+
+        @pl.when(pi == n_pages - 1)
+        def _store():
+            l = l_scr[...]
+            l_safe = jnp.where(l == 0.0, 1.0, l)
+            o_ref[...] = (acc_scr[...] / _lanes(l_safe, d)).astype(
+                o_ref.dtype)
+
+    return kernel
+
+
+def _lanes(x, n):
+    """Expand a lane-replicated [rows, LANES] stat to n lanes."""
+    if n == LANES:
+        return x
+    if n < LANES:
+        return x[:, :n]
+    assert n % LANES == 0
+    return jnp.tile(x, (1, n // LANES))
+
+
+def _compiler_params(pltpu, **kw):
+    """jax renamed TPUCompilerParams -> CompilerParams across versions;
+    accept either so the kernel runs on both sides of the rename."""
+    cls = getattr(pltpu, "CompilerParams", None) or \
+        getattr(pltpu, "TPUCompilerParams")
+    return cls(**kw)
+
+
+def _rpa_pallas(q, kpool, vpool, page_tables, ctx_lens, start_pos,
+                window, scale):
+    """Launch the Pallas kernel (shapes pre-validated by the wrapper)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, C, D = q.shape
+    n_pages_pool, ps, Hkv, _ = kpool.shape
+    maxp = page_tables.shape[1]
+    rep = H // Hkv
+    rows = rep * C
+
+    # fold query heads onto rows: (B, H, C, D) -> (B, Hkv, rep*C, D)
+    qf = q.reshape(B, Hkv, rep, C, D).reshape(B, Hkv, rows, D)
+    # pad rows to the sublane minimum so tiny decode batches still tile
+    min_rows = 8
+    pad = (-rows) % min_rows
+    if pad:
+        qf = jnp.pad(qf, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    rows_p = rows + pad
+    qf = qf.reshape(B * Hkv, rows_p, D)
+
+    kernel = _make_rpa_kernel(Hkv, scale, C, ps, window)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B * Hkv, maxp),
+        in_specs=[
+            pl.BlockSpec((None, rows_p, D),
+                         lambda bh, pi, pt, ctx, st: (bh, 0, 0)),
+            pl.BlockSpec((None, ps, None, D),
+                         lambda bh, pi, pt, ctx, st:
+                         (pt[bh // Hkv, pi], 0, bh % Hkv, 0)),
+            pl.BlockSpec((None, ps, None, D),
+                         lambda bh, pi, pt, ctx, st:
+                         (pt[bh // Hkv, pi], 0, bh % Hkv, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, rows_p, D),
+                               lambda bh, pi, pt, ctx, st: (bh, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rows_p, LANES), jnp.float32),
+            pltpu.VMEM((rows_p, LANES), jnp.float32),
+            pltpu.VMEM((rows_p, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B * Hkv, rows_p, D), q.dtype),
+        compiler_params=_compiler_params(
+            pltpu, dimension_semantics=("parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(page_tables.astype(jnp.int32), ctx_lens.astype(jnp.int32),
+      start_pos.astype(jnp.int32), qf, kpool, vpool)
+    out = out.reshape(B, Hkv, rows_p, D)[:, :, :rows]
+    return out.reshape(B, Hkv, rep, C, D).reshape(B, H, C, D)
+
+
+def ragged_paged_attention(q, kpool, vpool, page_tables, ctx_lens,
+                           start_pos, window=None, scale=None,
+                           k_scales=None, v_scales=None, use_kernel=None):
+    """Mixed prefill/decode attention over a paged KV pool — one launch.
+
+    q: (B, H, C, D) chunk queries (C = 1 for a pure-decode step);
+    kpool/vpool: (num_pages, page_size, Hkv, D); page_tables:
+    (B, max_pages) int32 physical-page ids per logical page; ctx_lens:
+    (B,) valid context length INCLUDING this chunk's tokens (already
+    written to the pool); start_pos: (B,) absolute position of each
+    slot's first chunk token.  Rows past a slot's real token count
+    produce causally-valid garbage the caller must ignore.
+
+    Dispatches to the Pallas kernel on TPU (or under
+    ``MXTPU_PALLAS_INTERPRET=1``) when the shapes tile; otherwise — and
+    for int8 pools (``k_scales``/``v_scales``) — runs the gather-based
+    reference path.  ``MXTPU_PAGED_ATTENTION=reference`` forces the
+    reference path everywhere.
+    """
+    B, H, C, D = q.shape
+    ps = kpool.shape[1]
+    Hkv = kpool.shape[2]
+    if H % Hkv:
+        raise ValueError(f"query heads ({H}) must be a multiple of pool "
+                         f"kv heads ({Hkv})")
+    quantized = k_scales is not None or v_scales is not None
+    if use_kernel is None:
+        interpret = _interpret()
+        on_tpu = jax.default_backend() == "tpu"
+        min_ps = 8 if interpret else LANES
+        d_ok = D <= LANES or D % LANES == 0
+        # _lanes slices (<= LANES) or tiles (multiple of LANES) the
+        # lane-replicated softmax stats — anything else can't tile
+        ps_ok = ps >= min_ps and (ps <= LANES or ps % LANES == 0)
+        use_kernel = ((on_tpu or interpret) and not quantized
+                      and not _force_reference()
+                      and ps_ok and d_ok)
+        if on_tpu and not use_kernel and not quantized \
+                and not _force_reference():
+            global _WARNED_FALLBACK
+            if not _WARNED_FALLBACK:
+                _WARNED_FALLBACK = True
+                import logging
+                logging.getLogger(__name__).warning(
+                    "ragged_paged_attention: falling back to the dense "
+                    "gather reference on TPU (page_size=%d or head_dim=%d "
+                    "untileable) — every step materialises the full "
+                    "padded context; set MXTPU_SERVE_PAGE_SIZE to %d (or "
+                    "a multiple of it) to use the Pallas kernel",
+                    ps, D, LANES)
+    if use_kernel:
+        if quantized:
+            raise ValueError("the Pallas paged-attention kernel takes an "
+                             "fp pool; int8 pools use the reference path")
+        return _rpa_pallas(q, kpool, vpool, page_tables, ctx_lens,
+                           start_pos, window,
+                           scale if scale is not None
+                           else 1.0 / math.sqrt(D))
+    return paged_attention_reference(
+        q, kpool, vpool, page_tables, ctx_lens, start_pos, window=window,
+        scale=scale, k_scales=k_scales, v_scales=v_scales)

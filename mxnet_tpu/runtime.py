@@ -37,6 +37,9 @@ _STATIC = {
     # (both off by default at runtime; MXTPU_TELEMETRY / MXTPU_HEALTH)
     "TELEMETRY": True,
     "HEALTH_MONITOR": True,
+    # inference serving stack (PR 6): paged KV cache + ragged paged
+    # attention + continuous batching (`mx.serve`, MXTPU_SERVE_*)
+    "SERVING": True,
 }
 
 

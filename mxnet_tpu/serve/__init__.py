@@ -1,0 +1,27 @@
+"""Inference serving subsystem (`mx.serve`): paged KV cache, ragged
+paged-attention decode, continuous batching.
+
+The production-traffic half of the north star: `models/` can train a GPT,
+this package serves it — a preallocated paged KV pool with a free-list
+page-table allocator (`kv_cache`), ONE compiled mixed prefill+decode step
+with donated pool buffers (`engine`), a continuous-batching scheduler with
+admission backpressure, recompute-preemption eviction, and per-token
+streaming (`scheduler`), all instrumented through the telemetry/health
+stack.  The attention primitive lives in
+`ops/pallas/paged_attention.py` (Pallas TPU kernel + dense reference), and
+the transformer decode math (`decode`) is shared with
+`GPTForCausalLM.generate` so serving and single-model generation can never
+diverge.  See docs/serving.md.
+"""
+from .decode import (  # noqa: F401
+    extract_decode_weights, transformer_step, lm_logits,
+)
+from .kv_cache import KVPools, PageAllocator  # noqa: F401
+from .scheduler import ContinuousBatchingScheduler, ServeRequest  # noqa: F401
+from .engine import InferenceEngine, ServeConfig  # noqa: F401
+
+__all__ = [
+    "InferenceEngine", "ServeConfig", "ContinuousBatchingScheduler",
+    "ServeRequest", "KVPools", "PageAllocator", "extract_decode_weights",
+    "transformer_step", "lm_logits",
+]

@@ -1,0 +1,159 @@
+"""Shared incremental-decode transformer core.
+
+ONE implementation of the cached pre-LN decoder step, used by BOTH
+surfaces that decode token-by-token:
+
+- ``GPTForCausalLM.generate`` (models/gpt.py) — dense per-request caches
+  carried through a ``lax.scan``;
+- the serving engine (``serve/engine.py``) — a shared paged KV pool with
+  per-slot page tables, mixed prefill/decode chunks.
+
+Before this module the decode math lived in ``GPTForCausalLM._token_step``
+(single token, dense cache only) and would have been duplicated a third
+time by the serving engine.  Here the transformer arithmetic (layernorms,
+fused-QKV projection, RoPE, residuals, FFN, LM head) is written once over a
+chunk of C tokens; what differs between callers — where the new K/V go and
+how attention reads the cached context — is injected as a single
+``kv_fn(layer_idx, q, k_new, v_new) -> context`` callback.  C = 1
+reproduces the old per-token step bit-for-bit; C > 1 is chunked prefill
+(every row's output depends only on rows at earlier positions, so chunked
+and token-at-a-time prefill agree).
+
+Weights travel as a plain dict-of-jax-arrays pytree
+(:func:`extract_decode_weights`) so the whole step stays jit/scan-friendly
+and the serving engine can compile one fused program over it.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["extract_decode_weights", "transformer_step", "lm_logits",
+           "layer_norm"]
+
+
+def extract_decode_weights(model) -> dict:
+    """Pure-jax view of a GPT-style causal LM's decoder weights.
+
+    `model` is a ``GPTForCausalLM`` (or anything structurally matching:
+    ``.transformer`` with word_embed / optional position_embed / layers of
+    (attn_norm, attention.attn_qkv/attn_proj, ffn_norm,
+    ffn.ffn_intermediate/ffn_output) / final_norm, plus an optional
+    ``.lm_head``).  Returns the dict pytree `transformer_step` consumes.
+    """
+    t = model.transformer
+
+    def w(p):
+        return p.data()._data
+
+    layers = []
+    for blk in t.layers:
+        layers.append(dict(
+            ln1_g=w(blk.attn_norm.gamma), ln1_b=w(blk.attn_norm.beta),
+            wqkv=w(blk.attention.attn_qkv.weight),
+            bqkv=w(blk.attention.attn_qkv.bias),
+            wo=w(blk.attention.attn_proj.weight),
+            bo=w(blk.attention.attn_proj.bias),
+            ln2_g=w(blk.ffn_norm.gamma), ln2_b=w(blk.ffn_norm.beta),
+            w1=w(blk.ffn.ffn_intermediate.weight),
+            b1=w(blk.ffn.ffn_intermediate.bias),
+            w2=w(blk.ffn.ffn_output.weight),
+            b2=w(blk.ffn.ffn_output.bias)))
+    cfg = model.cfg
+    head = (None if cfg.tie_embeddings else w(model.lm_head.weight))
+    pos = (None if getattr(cfg, "rope", False)
+           else w(t.position_embed.weight))
+    return dict(embed=w(t.word_embed.weight), pos=pos,
+                lnf_g=w(t.final_norm.gamma), lnf_b=w(t.final_norm.beta),
+                head=head, layers=layers)
+
+
+def layer_norm(x, g, b, eps):
+    m = x.mean(-1, keepdims=True)
+    v = ((x - m) ** 2).mean(-1, keepdims=True)
+    return (x - m) / jnp.sqrt(v + eps) * g + b
+
+
+def transformer_step(P: dict, cfg, tok, pos,
+                     kv_fn: Callable[[int, jax.Array, jax.Array,
+                                      jax.Array], jax.Array]):
+    """Run C cached decoder tokens per batch row through the transformer.
+
+    P: weights from :func:`extract_decode_weights`; cfg: the model's
+    ``GPTConfig`` (static fields only are read); tok: (B, C) int32 token
+    ids; pos: (B, C) int32 absolute positions; kv_fn(li, q, k_new, v_new)
+    receives the layer index, rotated queries (B, H, C, D) and new
+    keys/values (B, Hkv, C, D), must make the new K/V visible to its
+    cache, and returns the attention context (B, H, C, D).
+
+    Returns the final-layernormed hidden states (B, C, E) — feed them to
+    :func:`lm_logits` (callers usually slice to the rows they need
+    first: one LM-head matmul per kept row, not per padded row).
+    """
+    H, E = cfg.num_heads, cfg.hidden_size
+    D = E // H
+    Hkv = getattr(cfg, "num_kv_heads", None) or H
+    kvw = Hkv * D
+    eps = cfg.layer_norm_eps
+    use_rope = getattr(cfg, "rope", False)
+    B, C = tok.shape
+
+    h = P["embed"][tok]                                  # (B, C, E)
+    if not use_rope:
+        h = h + P["pos"][pos]
+    for li, L in enumerate(P["layers"]):
+        a = layer_norm(h, L["ln1_g"], L["ln1_b"], eps)
+        qkv = a @ L["wqkv"].T + L["bqkv"]
+        q = qkv[..., :E].reshape(B, C, H, D).transpose(0, 2, 1, 3)
+        k = qkv[..., E:E + kvw].reshape(B, C, Hkv, D).transpose(0, 2, 1, 3)
+        v = qkv[..., E + kvw:].reshape(B, C, Hkv, D).transpose(0, 2, 1, 3)
+        if use_rope:
+            from ..ops.attention import rope_rotate
+            # same rotation helper as the full forward; cached keys are
+            # stored pre-rotated
+            q = rope_rotate(q, pos[:, None, :], cfg.rope_theta)
+            k = rope_rotate(k, pos[:, None, :], cfg.rope_theta)
+        ctx = kv_fn(li, q, k, v)                          # (B, H, C, D)
+        h = h + ctx.transpose(0, 2, 1, 3).reshape(B, C, E) @ L["wo"].T \
+            + L["bo"]
+        f = layer_norm(h, L["ln2_g"], L["ln2_b"], eps)
+        h = h + jax.nn.gelu(f @ L["w1"].T + L["b1"]) @ L["w2"].T + L["b2"]
+    return layer_norm(h, P["lnf_g"], P["lnf_b"], eps)
+
+
+def lm_logits(P: dict, h):
+    """LM-head logits for hidden states `h` (..., E) -> (..., V)."""
+    return h @ (P["embed"].T if P["head"] is None else P["head"].T)
+
+
+def dense_kv_fn(kcache, vcache, pos, window: Optional[int] = None):
+    """Build a `kv_fn` over dense per-request caches — the `generate`
+    scan path.  kcache/vcache: (n_layers, B, Hkv, T, D); `pos`: (B, C)
+    absolute positions of this step's tokens (the scan passes C = 1).
+    Returns (kv_fn, new_caches_accumulator): after `transformer_step`,
+    ``new_caches()`` yields the updated (kc, vc) stacks for the carry.
+
+    Writes use ``dynamic_update_slice`` at the chunk's start position —
+    chunk positions are contiguous by construction (generate feeds
+    consecutive tokens), which the serving engine's paged writes do NOT
+    assume (it scatters per token).
+    """
+    from jax import lax
+
+    new_k, new_v = [], []
+    t0 = pos[0, 0]   # chunk start (identical across rows in generate)
+
+    def kv_fn(li, q, k_new, v_new):
+        from ..ops.pallas.paged_attention import _dense_attend
+        kc = lax.dynamic_update_slice_in_dim(kcache[li], k_new, t0, axis=2)
+        vc = lax.dynamic_update_slice_in_dim(vcache[li], v_new, t0, axis=2)
+        new_k.append(kc)
+        new_v.append(vc)
+        return _dense_attend(q, kc, vc, pos, window=window)
+
+    def new_caches():
+        return jnp.stack(new_k), jnp.stack(new_v)
+
+    return kv_fn, new_caches

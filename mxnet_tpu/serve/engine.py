@@ -1,0 +1,268 @@
+"""Inference serving engine: ONE compiled step over a paged KV pool.
+
+Wraps a causal-LM ``HybridBlock`` (``GPTForCausalLM``) the way
+`ShardedTrainStep` wraps training: the whole serving iteration — embed a
+ragged chunk of tokens for every slot, scatter new K/V into the paged pool,
+ragged paged attention, LM head, sample — is ONE jitted program with the
+pool buffers **donated** (in-place page updates, zero per-step device
+allocation).  Two variants compile at `warmup()`: the mixed
+prefill+decode step at the prefill-chunk width and the steady-state
+pure-decode step at C=1; with ``MXTPU_COMPILE_CACHE`` set both come back
+from the persistent compile cache on restart (the TVM-flavored "serving
+path as a compiled, cached artifact" — the AOT-export layer of ROADMAP
+item 3 will load these same programs from disk).
+
+Instrumented from day one: compile/journal events, per-step histograms,
+page-occupancy gauges (via the scheduler), and a ``serve.step`` heartbeat
+the hang watchdog monitors like any training loop.
+
+Typical use::
+
+    eng = mx.serve.InferenceEngine(model)
+    eng.warmup()
+    h = eng.submit([1, 2, 3], max_new_tokens=16,
+                   on_token=lambda t, r: print(t))
+    eng.run_until_idle()
+    full = h.result()
+
+or one-shot: ``eng.generate([1, 2, 3], max_new_tokens=16)``.
+"""
+from __future__ import annotations
+
+import math
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from .. import health as _health
+from .. import telemetry as _tele
+from .decode import extract_decode_weights, transformer_step, lm_logits
+from .kv_cache import KVPools, PageAllocator, make_paged_kv_fn
+from .scheduler import ContinuousBatchingScheduler, ServeRequest
+
+__all__ = ["ServeConfig", "InferenceEngine"]
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+@dataclass
+class ServeConfig:
+    """Serving knobs; every field defaults from its ``MXTPU_SERVE_*``
+    environment variable (docs/env_vars.md)."""
+
+    max_slots: int = field(
+        default_factory=lambda: _env_int("MXTPU_SERVE_SLOTS", 8))
+    page_size: int = field(
+        default_factory=lambda: _env_int("MXTPU_SERVE_PAGE_SIZE", 16))
+    num_pages: int = field(
+        default_factory=lambda: _env_int("MXTPU_SERVE_PAGES", 0))
+    prefill_chunk: int = field(
+        default_factory=lambda: _env_int("MXTPU_SERVE_PREFILL_CHUNK", 16))
+    max_len: int = field(
+        default_factory=lambda: _env_int("MXTPU_SERVE_MAX_LEN", 0))
+    kv_dtype: str = field(
+        default_factory=lambda: os.environ.get("MXTPU_SERVE_KV_DTYPE", ""))
+    # engine-wide sampling filter (static: part of the compiled step)
+    top_k: int = 0
+    top_p: float = 1.0
+
+    def __post_init__(self):
+        if self.max_slots < 1:
+            raise MXNetError("max_slots must be >= 1")
+        if self.page_size < 1:
+            raise MXNetError("page_size must be >= 1")
+        if self.prefill_chunk < 1:
+            raise MXNetError("prefill_chunk must be >= 1")
+
+
+class InferenceEngine:
+    """Continuous-batching inference over a GPT-style causal LM."""
+
+    def __init__(self, model, config: Optional[ServeConfig] = None,
+                 seed: int = 0):
+        self.model = model
+        self.cfg = model.cfg
+        self.serve_config = config or ServeConfig()
+        sc = self.serve_config
+
+        cfg = self.cfg
+        H = cfg.num_heads
+        self.n_kv_heads = getattr(cfg, "num_kv_heads", None) or H
+        self.head_dim = cfg.hidden_size // H
+        self.max_len = sc.max_len or cfg.max_position
+        if self.max_len > cfg.max_position:
+            raise MXNetError(
+                f"MXTPU_SERVE_MAX_LEN={self.max_len} exceeds the model's "
+                f"max_position={cfg.max_position}")
+        self.max_pages_per_seq = max(
+            1, math.ceil(self.max_len / sc.page_size))
+        # auto pool size: every slot can hold a full-length sequence,
+        # plus the reserved null page
+        num_pages = sc.num_pages or \
+            sc.max_slots * self.max_pages_per_seq + 1
+        kv_dtype = sc.kv_dtype or cfg.dtype
+        self.quantized = str(kv_dtype) == "int8"
+
+        self.P = extract_decode_weights(model)
+        self.pools = KVPools.create(
+            cfg.num_layers, num_pages, sc.page_size, self.n_kv_heads,
+            self.head_dim, dtype=kv_dtype)
+        self.allocator = PageAllocator(num_pages, sc.page_size)
+        self.scheduler = ContinuousBatchingScheduler(self)
+        self._key = jax.random.PRNGKey(seed)
+        self._step_fns = {}       # chunk width C -> jitted step
+        self._execs = {}          # chunk width C -> AOT executable
+        self.compile_seconds = None
+        self._steps_executed = 0
+        _health.beat("serve.step")   # announce the heartbeat name early
+
+    # ------------------------------------------------------------------
+    # compiled step
+    # ------------------------------------------------------------------
+    def _step_fn(self, C: int):
+        fn = self._step_fns.get(C)
+        if fn is not None:
+            return fn
+        cfg = self.cfg
+        sc = self.serve_config
+        ps = sc.page_size
+        window = getattr(cfg, "window", None)
+        quantized = self.quantized
+        pool_names = self.pools.names
+        top_k, top_p = sc.top_k, sc.top_p
+        max_pos = cfg.max_position
+
+        def step(P, pools_t, tok, num_tokens, start_pos, page_tables,
+                 ctx_lens, temps, greedy_mask, key):
+            from ..models.gpt import _filter_logits
+            pools = dict(zip(pool_names, pools_t))
+            kv_fn = make_paged_kv_fn(pools, page_tables, start_pos,
+                                     num_tokens, ctx_lens, ps, quantized,
+                                     window=window)
+            # padded rows may run past the table; clamp for the embedding
+            # gather only (writes are masked, attention rows are ignored)
+            pos = jnp.minimum(start_pos[:, None] + jnp.arange(C)[None, :],
+                              max_pos - 1)
+            h = transformer_step(P, cfg, tok, pos, kv_fn)
+            B = tok.shape[0]
+            last = h[jnp.arange(B), jnp.maximum(num_tokens - 1, 0)]
+            logits = lm_logits(P, last)                       # (B, V)
+            greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            filtered = _filter_logits(
+                logits.astype(jnp.float32) / temps[:, None], top_k, top_p)
+            sampled = jax.random.categorical(
+                key, filtered, axis=-1).astype(jnp.int32)
+            nxt = jnp.where(greedy_mask, greedy_tok, sampled)
+            return tuple(pools[n] for n in pool_names), nxt
+
+        fn = jax.jit(step, donate_argnums=(1,))
+        self._step_fns[C] = fn
+        return fn
+
+    def warmup(self) -> float:
+        """AOT-compile the mixed prefill step and the C=1 decode step
+        (``.lower().compile()`` — no step executed, the
+        `ShardedTrainStep.warmup` idiom).  Returns total compile seconds;
+        with ``MXTPU_COMPILE_CACHE`` set the binaries come back from the
+        persistent cache on a warm start."""
+        t0 = time.perf_counter()
+        for C in {self.serve_config.prefill_chunk, 1}:
+            self._compile(C)
+        self.compile_seconds = time.perf_counter() - t0
+        return self.compile_seconds
+
+    def _compile(self, C: int):
+        ex = self._execs.get(C)
+        if ex is not None:
+            return ex
+        fn = self._step_fn(C)
+        B = self.serve_config.max_slots
+        sd = jax.ShapeDtypeStruct
+        i32 = jnp.int32
+        avals = (
+            jax.tree_util.tree_map(
+                lambda x: sd(x.shape, x.dtype), self.P),
+            tuple(sd(a.shape, a.dtype)
+                  for a in self.pools.as_tuple()),
+            sd((B, C), i32), sd((B,), i32), sd((B,), i32),
+            sd((B, self.max_pages_per_seq), i32), sd((B,), i32),
+            sd((B,), jnp.float32), sd((B,), jnp.bool_),
+            sd(self._key.shape, self._key.dtype),
+        )
+        if _tele.enabled():
+            _tele.event("compile_start", kind="serve_step", chunk=C)
+        t0 = time.perf_counter()
+        with _health.suppress_stalls("serve_compile"):
+            ex = fn.lower(*avals).compile()
+        if _tele.enabled():
+            _tele.event("compile_end", kind="serve_step", chunk=C,
+                        seconds=round(time.perf_counter() - t0, 4))
+        self._execs[C] = ex
+        return ex
+
+    # ------------------------------------------------------------------
+    def _execute(self, tok, num_tokens, start_pos, tables, ctx_lens,
+                 temps, greedy_mask, C: int):
+        """Run one fused step (called by the scheduler); returns the
+        sampled next token per slot as host numpy."""
+        ex = self._execs.get(C)
+        if ex is None:
+            ex = self._compile(C)
+        self._steps_executed += 1
+        self._key, sub = jax.random.split(self._key)
+        out_pools, nxt = ex(
+            self.P, self.pools.as_tuple(), jnp.asarray(tok),
+            jnp.asarray(num_tokens), jnp.asarray(start_pos),
+            jnp.asarray(tables), jnp.asarray(ctx_lens),
+            jnp.asarray(temps), jnp.asarray(greedy_mask), sub)
+        # rebind the donated pool buffers to the step's outputs
+        self.pools = self.pools.replace(out_pools)
+        return onp.asarray(jax.device_get(nxt))
+
+    # ------------------------------------------------------------------
+    # public API (delegates to the scheduler)
+    # ------------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 20, greedy: bool = True,
+               temperature: float = 1.0, eos_token_id=None,
+               on_token=None) -> ServeRequest:
+        return self.scheduler.submit(prompt, max_new_tokens,
+                                     greedy=greedy, temperature=temperature,
+                                     eos_token_id=eos_token_id,
+                                     on_token=on_token)
+
+    def step(self) -> bool:
+        return self.scheduler.step()
+
+    def run_until_idle(self, max_steps: int = 100000) -> int:
+        return self.scheduler.run_until_idle(max_steps)
+
+    def generate(self, prompt, max_new_tokens: int = 20, greedy: bool = True,
+                 temperature: float = 1.0, eos_token_id=None):
+        """One-shot convenience: submit a single request, drive the loop
+        to completion, return prompt + generated token ids (list)."""
+        h = self.submit(prompt, max_new_tokens, greedy=greedy,
+                        temperature=temperature, eos_token_id=eos_token_id)
+        self.run_until_idle()
+        return h.result(timeout=0)
+
+    def stats(self) -> dict:
+        return {
+            "steps_executed": self._steps_executed,
+            "queue_depth": self.scheduler.queue_depth,
+            "active_slots": self.scheduler.active_count,
+            "free_pages": self.allocator.free_pages,
+            "page_occupancy": round(self.allocator.occupancy(), 4),
+            "pool_bytes": self.pools.nbytes(),
+            "compile_seconds": self.compile_seconds,
+        }

@@ -1,0 +1,211 @@
+"""Paged KV cache: host-side page-table allocator + device page pools.
+
+The serving analogue of the reference's memory pool (`src/storage/`): all
+KV memory for all concurrent requests lives in ONE preallocated device pool
+of fixed-size pages, `(n_layers, num_pages, page_size, Hkv, D)` per tensor.
+A sequence owns an ordered list of physical pages (its *page table*);
+logical token position ``p`` lives in page ``table[p // page_size]`` at
+offset ``p % page_size``.  Admission, growth, and eviction are pure
+host-side free-list operations — the device arrays never reallocate, which
+is what lets the engine compile ONE step program and donate the pool
+buffers through it (in-place updates, zero per-step allocation).
+
+Page 0 is reserved as the **null page**: masked writes (padded chunk rows,
+inactive slots) are scattered there and no allocation ever returns it, so
+the jitted step needs no host-side branching on raggedness.
+
+``kv_dtype="int8"`` stores the pool quantized (symmetric per-token-per-head
+int8 via `contrib/quantization.quantize_kv`) at ~4x less HBM per token;
+attention dequantizes only the gathered context.
+"""
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+
+__all__ = ["PageAllocator", "KVPools", "make_paged_kv_fn", "NULL_PAGE"]
+
+NULL_PAGE = 0
+
+
+class PageAllocator:
+    """Free-list allocator over the physical pages of a pool.
+
+    Thread-safe (the scheduler may admit from a submit thread while the
+    step loop extends sequences).  Pages are recycled LIFO — a just-freed
+    page is the next handed out, keeping the hot working set of physical
+    pages small and cache-friendly.
+    """
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages < 2:
+            raise MXNetError(
+                f"KV pool needs >= 2 pages (page 0 is the reserved null "
+                f"page), got {num_pages}")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        # LIFO free list; page 0 (null) is never allocatable
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self._lock = threading.Lock()
+
+    @property
+    def free_pages(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def total_pages(self) -> int:
+        """Allocatable pages (the null page is not)."""
+        return self.num_pages - 1
+
+    def occupancy(self) -> float:
+        """Fraction of allocatable pages currently owned by sequences."""
+        return 1.0 - self.free_pages / max(1, self.total_pages)
+
+    def pages_for(self, tokens: int) -> int:
+        return max(1, math.ceil(tokens / self.page_size))
+
+    def can_alloc(self, n: int) -> bool:
+        with self._lock:
+            return len(self._free) >= n
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Take `n` pages, or None (backpressure — caller defers/evicts).
+        All-or-nothing: a partial grab under contention is never held."""
+        with self._lock:
+            if len(self._free) < n:
+                return None
+            taken = [self._free.pop() for _ in range(n)]
+        return taken
+
+    def free(self, pages: List[int]) -> None:
+        with self._lock:
+            for p in pages:
+                if p == NULL_PAGE:
+                    raise MXNetError("attempt to free the null page")
+                if p in self._free:
+                    raise MXNetError(f"double free of page {p}")
+                self._free.append(p)
+
+
+class KVPools:
+    """Device-side paged K/V storage for every layer.
+
+    Arrays (one K + one V, plus scale planes when quantized):
+
+    - ``k``/``v``: (n_layers, num_pages, page_size, Hkv, D) `dtype`
+    - ``k_scale``/``v_scale``: (n_layers, num_pages, page_size, Hkv)
+      float32 (int8 pools only; one symmetric scale per stored vector)
+
+    The arrays are exposed as a flat tuple (`as_tuple`) so the engine can
+    pass them through a jitted step with ``donate_argnums`` and rebind the
+    donated outputs (`replace`).
+    """
+
+    def __init__(self, arrays: Dict[str, jax.Array], n_layers: int,
+                 num_pages: int, page_size: int, n_kv_heads: int,
+                 head_dim: int, quantized: bool):
+        self.arrays = arrays
+        self.n_layers = n_layers
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.n_kv_heads = n_kv_heads
+        self.head_dim = head_dim
+        self.quantized = quantized
+
+    @classmethod
+    def create(cls, n_layers: int, num_pages: int, page_size: int,
+               n_kv_heads: int, head_dim: int, dtype="float32") -> "KVPools":
+        quantized = str(dtype) == "int8"
+        shape = (n_layers, num_pages, page_size, n_kv_heads, head_dim)
+        store_dt = jnp.int8 if quantized else jnp.dtype(dtype)
+        arrays = {"k": jnp.zeros(shape, store_dt),
+                  "v": jnp.zeros(shape, store_dt)}
+        if quantized:
+            sshape = shape[:-1]
+            arrays["k_scale"] = jnp.zeros(sshape, jnp.float32)
+            arrays["v_scale"] = jnp.zeros(sshape, jnp.float32)
+        return cls(arrays, n_layers, num_pages, page_size, n_kv_heads,
+                   head_dim, quantized)
+
+    @property
+    def names(self):
+        return tuple(sorted(self.arrays))
+
+    def as_tuple(self):
+        return tuple(self.arrays[n] for n in self.names)
+
+    def replace(self, values) -> "KVPools":
+        """Rebind to the donated step outputs (same metadata)."""
+        return KVPools(dict(zip(self.names, values)), self.n_layers,
+                       self.num_pages, self.page_size, self.n_kv_heads,
+                       self.head_dim, self.quantized)
+
+    def nbytes(self) -> int:
+        return sum(int(a.size) * a.dtype.itemsize
+                   for a in self.arrays.values())
+
+
+def make_paged_kv_fn(pools: Dict[str, jax.Array], page_tables, start_pos,
+                     num_tokens, ctx_lens, page_size: int, quantized: bool,
+                     window=None):
+    """Build the `kv_fn` closure `transformer_step` calls per layer inside
+    the jitted serving step: scatter the chunk's new K/V into the paged
+    pool, then attend over each slot's pages via
+    `ragged_paged_attention`.
+
+    `pools` is a MUTABLE dict of the pool arrays (functional updates are
+    written back per layer); after `transformer_step` returns it holds the
+    step's updated pools — the engine returns them as donated outputs.
+
+    page_tables: (B, max_pages) int32; start_pos/num_tokens/ctx_lens:
+    (B,) int32.  Chunk token c of slot b sits at absolute position
+    ``start_pos[b] + c`` and is real iff ``c < num_tokens[b]`` — padded
+    rows scatter to the null page.
+    """
+    from ..ops.pallas.paged_attention import ragged_paged_attention
+
+    ps = page_size
+
+    def kv_fn(li, q, k_new, v_new):
+        B, Hkv, C, D = k_new.shape
+        pos = start_pos[:, None] + jnp.arange(C)[None, :]      # (B, C)
+        logical = jnp.minimum(pos // ps, page_tables.shape[1] - 1)
+        phys = jnp.take_along_axis(page_tables, logical, axis=1)
+        flat = phys * ps + pos % ps                            # (B, C)
+        active = jnp.arange(C)[None, :] < num_tokens[:, None]
+        flat = jnp.where(active, flat, NULL_PAGE * ps)
+        idx = flat.reshape(B * C)
+
+        def scatter(name, new):
+            # (B, Hkv, C, D) -> per-token rows (B*C, Hkv, D)
+            rows = new.transpose(0, 2, 1, 3).reshape(B * C, Hkv, D)
+            pool = pools[name][li]
+            flat_pool = pool.reshape(pool.shape[0] * ps, Hkv, D)
+            if quantized:
+                from ..contrib.quantization import quantize_kv
+                rows, scales = quantize_kv(rows)
+                sp = pools[name + "_scale"][li]
+                flat_sp = sp.reshape(sp.shape[0] * ps, Hkv)
+                flat_sp = flat_sp.at[idx].set(scales)
+                pools[name + "_scale"] = pools[name + "_scale"].at[li].set(
+                    flat_sp.reshape(sp.shape))
+            flat_pool = flat_pool.at[idx].set(rows.astype(flat_pool.dtype))
+            pools[name] = pools[name].at[li].set(
+                flat_pool.reshape(pool.shape))
+
+        scatter("k", k_new)
+        scatter("v", v_new)
+        return ragged_paged_attention(
+            q, pools["k"][li], pools["v"][li], page_tables, ctx_lens,
+            start_pos, window=window,
+            k_scales=pools["k_scale"][li] if quantized else None,
+            v_scales=pools["v_scale"][li] if quantized else None)
+
+    return kv_fn

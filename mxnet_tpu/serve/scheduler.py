@@ -1,0 +1,447 @@
+"""Continuous-batching scheduler: admit/evict per step, slot packing,
+token streaming.
+
+The serving control loop the reference never had (its `module.predict` is
+batch-synchronous): requests arrive at any time, are admitted into a fixed
+set of **slots** as soon as a slot AND enough KV pages are free, prefill in
+chunks alongside other slots' single-token decodes (one fused device step
+per iteration — the ragged mixed launch), stream each generated token
+through a callback the moment it lands, and leave the moment they finish —
+no head-of-line blocking on the longest sequence in the batch.
+
+Eviction (vLLM-style *recompute preemption*): when a growing sequence
+needs a page and the pool is exhausted, the youngest-admitted OTHER active
+sequence is evicted — its pages return to the free list and the request
+re-queues at the FRONT with its prompt extended by everything it already
+generated.  On re-admission it re-prefills that prefix (compute traded for
+memory) and continues decoding; already-streamed tokens are never
+re-emitted.  Greedy decoding makes the continuation deterministic, so an
+evicted request's final output is identical to an uninterrupted run.
+
+Everything host-side here is plain Python bookkeeping (lists, a free-list
+allocator); the device work happens in the engine's compiled step.
+Telemetry (`serve_*` metrics + `request` journal events) is emitted at
+every lifecycle edge — this subsystem is instrumented from day one.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional
+
+import numpy as onp
+
+from ..base import MXNetError
+from .. import telemetry as _tele
+
+__all__ = ["ServeRequest", "ContinuousBatchingScheduler"]
+
+_rid = itertools.count(1)
+
+
+class ServeRequest:
+    """One in-flight generation request (also the caller's handle).
+
+    `on_token(token_id, request)` fires synchronously as each token is
+    generated (streaming); `result()` blocks until completion and returns
+    the full sequence (prompt + generated)."""
+
+    def __init__(self, prompt, max_new_tokens: int, greedy: bool = True,
+                 temperature: float = 1.0, eos_token_id: Optional[int] = None,
+                 on_token: Optional[Callable] = None):
+        self.id = next(_rid)
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.greedy = bool(greedy)
+        self.temperature = float(temperature)
+        self.eos_token_id = eos_token_id
+        self.on_token = on_token
+        self.tokens: List[int] = []          # generated so far (streamed)
+        self.state = "queued"                # queued|running|finished|failed
+        self.evictions = 0
+        self.submitted_ts = time.perf_counter()
+        self.first_token_ts: Optional[float] = None
+        self.finished_ts: Optional[float] = None
+        self.error: Optional[str] = None
+        self._done = threading.Event()
+
+    # -- caller-side API -------------------------------------------------
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_ts is None:
+            return None
+        return self.first_token_ts - self.submitted_ts
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        if self.finished_ts is None:
+            return None
+        return self.finished_ts - self.submitted_ts
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"request {self.id} not finished")
+        if self.state == "failed":
+            raise MXNetError(f"request {self.id} failed: {self.error}")
+        return list(self.prompt) + list(self.tokens)
+
+    # -- scheduler-side helpers ------------------------------------------
+    def _sequence(self) -> List[int]:
+        """Tokens that must be in the KV cache: prompt + generated."""
+        return self.prompt + self.tokens
+
+    def __repr__(self):
+        return (f"ServeRequest(id={self.id}, state={self.state}, "
+                f"prompt={len(self.prompt)}t, generated="
+                f"{len(self.tokens)}/{self.max_new_tokens})")
+
+
+class _Slot:
+    """One occupied batch slot: the request plus its KV page table."""
+
+    def __init__(self, req: ServeRequest, slot_idx: int, max_pages: int,
+                 admit_seq: int):
+        self.req = req
+        self.slot_idx = slot_idx
+        self.pages: List[int] = []
+        self.table = onp.zeros(max_pages, onp.int32)   # NULL_PAGE fill
+        self.ctx = 0          # tokens already written to the pool
+        self.admit_seq = admit_seq    # admission order (eviction priority)
+
+
+class ContinuousBatchingScheduler:
+    """Drives admission, per-step batch packing, eviction, streaming.
+
+    Owned by an `InferenceEngine`; `step()` runs one fused device step
+    over the current actives (call it in a loop, or `run_until_idle`).
+    `submit` is thread-safe; stepping is single-threaded by design (one
+    device stream)."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        cfg = engine.serve_config
+        self.max_slots = cfg.max_slots
+        self.page_size = cfg.page_size
+        self.prefill_chunk = cfg.prefill_chunk
+        self.max_len = engine.max_len
+        self.max_pages_per_seq = engine.max_pages_per_seq
+        self.allocator = engine.allocator
+        self._queue: deque = deque()
+        self._slots: List[Optional[_Slot]] = [None] * self.max_slots
+        self._lock = threading.Lock()
+        self._admit_seq = itertools.count()
+        self._steps = 0
+
+    # ------------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 20, greedy: bool = True,
+               temperature: float = 1.0, eos_token_id=None,
+               on_token=None) -> ServeRequest:
+        prompt = [int(t) for t in onp.asarray(prompt).reshape(-1)]
+        if not prompt:
+            raise MXNetError("empty prompt")
+        if int(max_new_tokens) < 1:
+            raise MXNetError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        total = len(prompt) + int(max_new_tokens)
+        if total > self.max_len:
+            raise MXNetError(
+                f"request needs {total} tokens but the serving context "
+                f"cap is {self.max_len} (MXTPU_SERVE_MAX_LEN / model "
+                f"max_position)")
+        need = self.allocator.pages_for(total)
+        if need > self.allocator.total_pages:
+            raise MXNetError(
+                f"request needs {need} KV pages but the pool only has "
+                f"{self.allocator.total_pages} — raise MXTPU_SERVE_PAGES")
+        req = ServeRequest(prompt, max_new_tokens, greedy=greedy,
+                           temperature=temperature,
+                           eos_token_id=eos_token_id, on_token=on_token)
+        with self._lock:
+            self._queue.append(req)
+        self._telemetry_request(req, "submitted", queued=len(self._queue))
+        self._update_gauges()
+        return req
+
+    # ------------------------------------------------------------------
+    def _free_slot_idx(self) -> Optional[int]:
+        for i, s in enumerate(self._slots):
+            if s is None:
+                return i
+        return None
+
+    def _admit(self) -> None:
+        """FIFO admission under memory backpressure: a request enters a
+        slot only when its CURRENT sequence (prompt + already-generated,
+        for re-admits) plus one decode page fits the free list — partial
+        admission would deadlock against other growing sequences."""
+        while True:
+            with self._lock:
+                if not self._queue:
+                    return
+                idx = self._free_slot_idx()
+                if idx is None:
+                    return
+                req = self._queue[0]
+                need = self.allocator.pages_for(len(req._sequence()) + 1)
+                pages = self.allocator.alloc(need)
+                if pages is None:
+                    return          # OOM backpressure: wait for frees
+                self._queue.popleft()
+                slot = _Slot(req, idx, self.max_pages_per_seq,
+                             next(self._admit_seq))
+                slot.pages = pages
+                slot.table[:len(pages)] = pages
+                self._slots[idx] = slot
+            req.state = "running"
+            self._telemetry_request(
+                req, "readmitted" if req.evictions else "admitted",
+                slot=idx, pages=len(pages))
+
+    def _evict(self, slot: _Slot, reason: str) -> None:
+        """Recompute-preemption: free the slot's pages, re-queue the
+        request at the FRONT with its generated tokens folded into the
+        prefix it will re-prefill."""
+        req = slot.req
+        self.allocator.free(slot.pages)
+        self._slots[slot.slot_idx] = None
+        req.state = "queued"
+        req.evictions += 1
+        with self._lock:
+            self._queue.appendleft(req)
+        if _tele.enabled():
+            _tele.counter("serve_evictions_total",
+                          "Sequences evicted (pages recycled, request "
+                          "re-queued for recompute)").inc()
+        self._telemetry_request(req, "evicted", reason=reason,
+                                generated=len(req.tokens))
+
+    def _ensure_capacity(self, slot: _Slot, upto_tokens: int) -> bool:
+        """Grow `slot`'s page table to hold `upto_tokens`, evicting
+        younger actives when the free list runs dry.  Returns False when
+        even eviction cannot help (the slot itself must yield)."""
+        need_total = self.allocator.pages_for(upto_tokens)
+        while len(slot.pages) < need_total:
+            got = self.allocator.alloc(1)
+            if got is not None:
+                slot.table[len(slot.pages)] = got[0]
+                slot.pages.extend(got)
+                continue
+            victims = [s for s in self._slots
+                       if s is not None and s is not slot]
+            if not victims:
+                return False
+            victims.sort(key=lambda s: s.admit_seq)
+            self._evict(victims[-1], reason="page_pressure")
+        return True
+
+    # ------------------------------------------------------------------
+    def step(self) -> bool:
+        """Run one fused serving step over the active slots.  Returns
+        False when there was nothing to do (no actives, empty queue)."""
+        self._admit()
+        actives = [s for s in self._slots if s is not None]
+        if not actives:
+            self._update_gauges()
+            return False
+
+        # plan the chunk width: any slot with >1 pending token prefills,
+        # so the step runs at the prefill chunk width; a pure-decode
+        # round runs the C=1 program (no padded-lane compute)
+        pending = {s.slot_idx: len(s.req._sequence()) - s.ctx
+                   for s in actives}
+        C = self.prefill_chunk if any(p > 1 for p in pending.values()) \
+            else 1
+
+        # capacity: every slot must hold its chunk's tokens; slots that
+        # cannot (even after evicting younger actives) are evicted
+        # themselves this round
+        for s in sorted(actives, key=lambda s: s.admit_seq):
+            if self._slots[s.slot_idx] is not s:
+                continue          # already evicted by a victim search
+            nt = min(pending[s.slot_idx], C)
+            if not self._ensure_capacity(s, s.ctx + nt):
+                self._evict(s, reason="no_capacity")
+        actives = [s for s in self._slots if s is not None]
+        if not actives:
+            self._update_gauges()
+            return False
+
+        B = self.max_slots
+        tok = onp.zeros((B, C), onp.int32)
+        num_tokens = onp.zeros(B, onp.int32)
+        start_pos = onp.zeros(B, onp.int32)
+        tables = onp.zeros((B, self.max_pages_per_seq), onp.int32)
+        ctx_lens = onp.zeros(B, onp.int32)
+        temps = onp.ones(B, onp.float32)
+        greedy = onp.ones(B, bool)
+        consume = {}
+        for s in actives:
+            seq = s.req._sequence()
+            feed = seq[s.ctx:s.ctx + C]
+            nt = len(feed)
+            i = s.slot_idx
+            tok[i, :nt] = feed
+            num_tokens[i] = nt
+            start_pos[i] = s.ctx
+            tables[i] = s.table
+            ctx_lens[i] = s.ctx + nt
+            temps[i] = s.req.temperature
+            greedy[i] = s.req.greedy
+            consume[i] = (s.ctx + nt == len(seq))
+            s.ctx += nt
+
+        t0 = time.perf_counter()
+        try:
+            next_tokens = self.engine._execute(
+                tok, num_tokens, start_pos, tables, ctx_lens, temps,
+                greedy, C)
+        except Exception as exc:
+            # a failed device step is unrecoverable for every in-flight
+            # sequence: slot.ctx already advanced past tokens that never
+            # landed and the donated pool buffers may be invalidated —
+            # fail ALL requests (waiters in result() unblock with the
+            # error) instead of leaving them stuck forever, then re-raise
+            self._fail_all(exc)
+            raise
+        step_ms = (time.perf_counter() - t0) * 1e3
+        self._steps += 1
+        from .. import health as _health
+        _health.beat("serve.step")
+        if _tele.enabled():
+            _tele.histogram(
+                "serve_step_ms",
+                "Wall time per fused serving step (prefill or decode)"
+            ).observe(step_ms)
+            _tele.counter("serve_steps_total",
+                          "Fused serving steps executed").inc()
+
+        # distribute tokens in admission order (stable streaming order)
+        for s in sorted(actives, key=lambda s: s.admit_seq):
+            if not consume[s.slot_idx]:
+                continue          # mid-prefill: logits discarded
+            self._emit(s, int(next_tokens[s.slot_idx]))
+        self._update_gauges()
+        return True
+
+    def _emit(self, slot: _Slot, token: int) -> None:
+        req = slot.req
+        req.tokens.append(token)
+        if req.first_token_ts is None:
+            req.first_token_ts = time.perf_counter()
+            if _tele.enabled():
+                _tele.histogram(
+                    "serve_ttft_ms",
+                    "Time to first token per request (submit -> first "
+                    "streamed token)").observe(req.ttft_s * 1e3)
+            self._telemetry_request(req, "first_token",
+                                    ttft_ms=round(req.ttft_s * 1e3, 3))
+        if _tele.enabled():
+            _tele.counter("serve_tokens_generated_total",
+                          "Tokens generated across all requests").inc()
+        if req.on_token is not None:
+            try:
+                req.on_token(token, req)
+            except Exception:
+                import logging
+                logging.getLogger(__name__).exception(
+                    "serve: on_token callback failed (request %d)", req.id)
+        done = len(req.tokens) >= req.max_new_tokens or (
+            req.eos_token_id is not None and token == req.eos_token_id)
+        if done:
+            self._finish(slot)
+
+    def _fail_all(self, exc: BaseException) -> None:
+        """Terminal cleanup after a failed device step: every active AND
+        queued request fails (the pool state is suspect and a stuck
+        `result()` waiter is worse than an error)."""
+        err = f"{type(exc).__name__}: {exc}"
+        for slot in list(self._slots):
+            if slot is None:
+                continue
+            self.allocator.free(slot.pages)
+            self._slots[slot.slot_idx] = None
+            self._fail_req(slot.req, err)
+        with self._lock:
+            queued, self._queue = list(self._queue), deque()
+        for req in queued:
+            self._fail_req(req, err)
+        self._update_gauges()
+
+    def _fail_req(self, req: ServeRequest, err: str) -> None:
+        req.state = "failed"
+        req.error = err
+        req.finished_ts = time.perf_counter()
+        if _tele.enabled():
+            _tele.counter("serve_requests_total",
+                          "Requests by terminal state",
+                          labelnames=("state",)).inc(state="failed")
+        self._telemetry_request(req, "failed", error=err)
+        req._done.set()
+
+    def _finish(self, slot: _Slot) -> None:
+        req = slot.req
+        self.allocator.free(slot.pages)
+        self._slots[slot.slot_idx] = None
+        req.state = "finished"
+        req.finished_ts = time.perf_counter()
+        if _tele.enabled():
+            _tele.counter("serve_requests_total",
+                          "Requests by terminal state",
+                          labelnames=("state",)).inc(state="finished")
+            _tele.histogram(
+                "serve_request_latency_ms",
+                "End-to-end request latency (submit -> last token)"
+            ).observe(req.latency_s * 1e3)
+        self._telemetry_request(req, "finished",
+                                generated=len(req.tokens),
+                                latency_ms=round(req.latency_s * 1e3, 3))
+        req._done.set()
+
+    # ------------------------------------------------------------------
+    def run_until_idle(self, max_steps: int = 100000) -> int:
+        """Pump `step()` until queue and slots drain; returns steps run."""
+        n = 0
+        while n < max_steps:
+            if not self.step():
+                with self._lock:
+                    if not self._queue:
+                        break
+            n += 1
+        return n
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    @property
+    def active_count(self) -> int:
+        return sum(1 for s in self._slots if s is not None)
+
+    # ------------------------------------------------------------------
+    def _update_gauges(self) -> None:
+        if not _tele.enabled():
+            return
+        _tele.gauge("serve_queue_depth",
+                    "Requests waiting for a slot/pages").set(
+                        self.queue_depth)
+        _tele.gauge("serve_active_slots",
+                    "Slots currently decoding/prefilling").set(
+                        self.active_count)
+        _tele.gauge("serve_page_occupancy_ratio",
+                    "Fraction of allocatable KV pages in use").set(
+                        self.allocator.occupancy())
+        _tele.gauge("serve_free_pages",
+                    "KV pages on the free list").set(
+                        self.allocator.free_pages)
+
+    def _telemetry_request(self, req: ServeRequest, phase: str,
+                           **fields) -> None:
+        if _tele.enabled():
+            _tele.event("request", request_id=req.id, phase=phase,
+                        **fields)

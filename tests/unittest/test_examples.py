@@ -90,6 +90,14 @@ def test_gpt_generation_example():
 
 
 @pytest.mark.slow
+def test_serve_gpt_example():
+    """Continuous-batching serving over an eviction-pressured paged KV
+    pool; asserts batched outputs identical to unbatched generate."""
+    out = _run("examples/serve_gpt.py", "--cpu", timeout=600)
+    assert "serving example OK" in out
+
+
+@pytest.mark.slow
 def test_long_context_sp_example():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"

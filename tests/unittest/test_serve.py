@@ -1,0 +1,435 @@
+"""Inference-serving tests: paged KV cache allocator, ragged paged
+attention (dense-reference and Pallas-interpret parity), the
+continuous-batching scheduler, int8 KV quantization, and the per-request
+telemetry contract (docs/serving.md)."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+pytestmark = pytest.mark.serve
+
+
+def _tiny_model(**kw):
+    from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    cfg = dict(vocab_size=96, hidden_size=32, num_layers=2, num_heads=4,
+               intermediate_size=64, max_position=64, dropout=0.0)
+    cfg.update(kw)
+    m = GPTForCausalLM(GPTConfig(**cfg))
+    m.initialize()
+    m(mx.np.array([[1, 2]], dtype="int32"))
+    return m
+
+
+def _ref_generate(m, prompt, n):
+    ids = mx.np.array([prompt], dtype="int32")
+    return onp.asarray(m.generate(ids, max_new_tokens=n)
+                       .asnumpy())[0].tolist()
+
+
+# ---------------------------------------------------------------------------
+# page allocator
+# ---------------------------------------------------------------------------
+
+def test_page_allocator_alloc_free_recycle():
+    from mxnet_tpu.serve import PageAllocator
+    a = PageAllocator(num_pages=6, page_size=4)
+    assert a.total_pages == 5          # page 0 reserved (null)
+    p1 = a.alloc(2)
+    p2 = a.alloc(3)
+    assert sorted(p1 + p2) == [1, 2, 3, 4, 5]
+    assert 0 not in p1 + p2
+    assert a.alloc(1) is None          # exhausted -> backpressure, not raise
+    a.free(p1)
+    assert a.free_pages == 2
+    # LIFO recycle: the just-freed pages come back first
+    p3 = a.alloc(2)
+    assert sorted(p3) == sorted(p1)
+    a.free(p3)
+    a.free(p2)
+    assert a.free_pages == 5
+    assert a.occupancy() == 0.0
+
+
+def test_page_allocator_guards():
+    from mxnet_tpu.serve import PageAllocator
+    a = PageAllocator(num_pages=4, page_size=2)
+    p = a.alloc(1)
+    a.free(p)
+    with pytest.raises(MXNetError, match="double free"):
+        a.free(p)
+    with pytest.raises(MXNetError, match="null page"):
+        a.free([0])
+    with pytest.raises(MXNetError, match=">= 2 pages"):
+        PageAllocator(num_pages=1, page_size=2)
+    assert a.pages_for(1) == 1 and a.pages_for(2) == 1 \
+        and a.pages_for(3) == 2
+
+
+# ---------------------------------------------------------------------------
+# ragged paged attention: paged-vs-dense numerical parity
+# ---------------------------------------------------------------------------
+
+def _paged_setup(rng, B, H, Hkv, C, D, ps, npages, maxp):
+    import jax.numpy as jnp
+    q = jnp.asarray(rng.randn(B, H, C, D), jnp.float32)
+    kp = jnp.asarray(rng.randn(npages, ps, Hkv, D), jnp.float32)
+    vp = jnp.asarray(rng.randn(npages, ps, Hkv, D), jnp.float32)
+    # distinct physical pages per slot, shuffled (non-contiguous layout)
+    perm = rng.permutation(npages - 1)[:B * maxp] + 1
+    pt = jnp.asarray(perm.reshape(B, maxp), jnp.int32)
+    return q, kp, vp, pt
+
+
+def _dense_oracle(q, kp, vp, pt, ctx, start, window=None):
+    """Straight-line numpy-style oracle: gather pages, mask, softmax."""
+    import jax
+    import jax.numpy as jnp
+    B, H, C, D = q.shape
+    ps, Hkv = kp.shape[1], kp.shape[2]
+    maxp = pt.shape[1]
+    L = maxp * ps
+    kc = kp[pt].reshape(B, L, Hkv, D)
+    vc = vp[pt].reshape(B, L, Hkv, D)
+    rep = H // Hkv
+    kfull = jnp.repeat(kc, rep, axis=2).transpose(0, 2, 1, 3)
+    vfull = jnp.repeat(vc, rep, axis=2).transpose(0, 2, 1, 3)
+    s = jnp.einsum("bhcd,bhtd->bhct", q, kfull) / onp.sqrt(D)
+    t_idx = jnp.arange(L)[None, None, None, :]
+    pos = (start[:, None] + jnp.arange(C))[:, None, :, None]
+    mask = (t_idx <= pos) & (t_idx < ctx[:, None, None, None])
+    if window is not None:
+        mask = mask & (t_idx >= pos - window)
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhct,bhtd->bhcd", p, vfull)
+
+
+@pytest.mark.parametrize("C,Hkv", [(4, 4), (4, 2), (1, 4), (1, 1)])
+def test_paged_reference_matches_dense_oracle(C, Hkv):
+    """Reference paged attention == dense full-gather attention for mixed
+    ragged lengths (prefill C=4 and decode C=1, MHA and GQA)."""
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas.paged_attention import \
+        paged_attention_reference
+    rng = onp.random.RandomState(0)
+    B, H, D, ps, npages, maxp = 3, 4, 16, 4, 16, 4
+    q, kp, vp, pt = _paged_setup(rng, B, H, Hkv, C, D, ps, npages, maxp)
+    start = jnp.asarray([0, 7, 12], jnp.int32)
+    nt = jnp.asarray([C, max(1, C - 2), 1], jnp.int32)
+    ctx = start + nt
+    out = paged_attention_reference(q, kp, vp, pt, ctx, start)
+    ref = _dense_oracle(q, kp, vp, pt, ctx, start)
+    for b in range(B):
+        n = int(nt[b])
+        onp.testing.assert_allclose(out[b, :, :n], ref[b, :, :n],
+                                    rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("window", [None, 3])
+def test_paged_kernel_matches_reference_interpret(window, monkeypatch):
+    """The Pallas kernel (interpret mode: exact kernel code on CPU) must
+    match the reference path — mixed prefill+decode in one launch, GQA
+    folding, page-table indirection, causal + sliding-window masks."""
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas import paged_attention as pa
+    monkeypatch.setenv("MXTPU_PALLAS_INTERPRET", "1")
+    rng = onp.random.RandomState(1)
+    B, H, Hkv, C, D, ps, npages, maxp = 3, 4, 2, 4, 16, 8, 16, 4
+    q, kp, vp, pt = _paged_setup(rng, B, H, Hkv, C, D, ps, npages, maxp)
+    start = jnp.asarray([0, 5, 17], jnp.int32)
+    nt = jnp.asarray([4, 4, 1], jnp.int32)
+    ctx = start + nt
+    ref = pa.paged_attention_reference(q, kp, vp, pt, ctx, start,
+                                       window=window)
+    out = pa.ragged_paged_attention(q, kp, vp, pt, ctx, start,
+                                    window=window, use_kernel=True)
+    for b in range(B):
+        n = int(nt[b])
+        onp.testing.assert_allclose(out[b, :, :n], ref[b, :, :n],
+                                    rtol=2e-5, atol=2e-5)
+
+
+def test_untileable_page_size_falls_back_to_reference(monkeypatch):
+    """page_size > 128 but not a multiple of 128 cannot tile the kernel's
+    lane-replicated stats — the auto gate must take the reference path
+    instead of crashing at trace time."""
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas import paged_attention as pa
+    monkeypatch.setenv("MXTPU_PALLAS_INTERPRET", "1")
+    rng = onp.random.RandomState(4)
+    q, kp, vp, pt = _paged_setup(rng, 2, 2, 2, 1, 8, 192, 5, 2)
+    start = jnp.asarray([0, 3], jnp.int32)
+    ctx = start + 1
+    out = pa.ragged_paged_attention(q, kp, vp, pt, ctx, start)  # auto gate
+    ref = pa.paged_attention_reference(q, kp, vp, pt, ctx, start)
+    onp.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+def test_paged_attention_env_forces_reference(monkeypatch):
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas import paged_attention as pa
+    monkeypatch.setenv("MXTPU_PALLAS_INTERPRET", "1")
+    monkeypatch.setenv("MXTPU_PAGED_ATTENTION", "reference")
+    rng = onp.random.RandomState(2)
+    q, kp, vp, pt = _paged_setup(rng, 2, 2, 2, 1, 8, 8, 8, 2)
+    start = jnp.asarray([0, 3], jnp.int32)
+    ctx = start + 1
+    out = pa.ragged_paged_attention(q, kp, vp, pt, ctx, start)
+    ref = pa.paged_attention_reference(q, kp, vp, pt, ctx, start)
+    onp.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# int8 KV quantization
+# ---------------------------------------------------------------------------
+
+def test_int8_kv_roundtrip_tolerance():
+    import jax.numpy as jnp
+    from mxnet_tpu.contrib.quantization import quantize_kv, dequantize_kv
+    rng = onp.random.RandomState(0)
+    x = jnp.asarray(rng.randn(12, 3, 16) * 4.0, jnp.float32)
+    q, s = quantize_kv(x)
+    assert q.dtype == jnp.int8 and s.shape == (12, 3)
+    rt = dequantize_kv(q, s)
+    # symmetric per-vector int8: worst-case error is half an LSB of the
+    # per-vector scale
+    amax = onp.abs(onp.asarray(x)).max(axis=-1, keepdims=True)
+    assert float(jnp.max(jnp.abs(rt - x) / amax)) <= 0.5 / 127 + 1e-6
+    # zero vectors round-trip to zero (no div-by-zero scale)
+    zq, zs = quantize_kv(jnp.zeros((3, 4)))
+    assert float(jnp.max(jnp.abs(dequantize_kv(zq, zs)))) == 0.0
+
+
+def test_int8_engine_decodes_closely():
+    from mxnet_tpu.serve import InferenceEngine, ServeConfig
+    m = _tiny_model(num_layers=1)
+    prompt = [3, 9, 1, 7, 2]
+    ref = _ref_generate(m, prompt, 6)
+    eng = InferenceEngine(m, ServeConfig(max_slots=2, page_size=8,
+                                         prefill_chunk=4, max_len=32,
+                                         kv_dtype="int8"))
+    import jax.numpy as jnp
+    assert eng.quantized
+    assert eng.pools.arrays["k"].dtype == jnp.int8
+    assert "k_scale" in eng.pools.arrays
+    out = eng.generate(prompt, max_new_tokens=6)
+    # int8 KV is lossy: require the prompt intact, in-vocab tokens, and
+    # strong-but-not-exact agreement with fp32 decode
+    assert out[:len(prompt)] == prompt
+    assert all(0 <= t < 96 for t in out)
+    agree = sum(a == b for a, b in zip(out, ref)) / len(ref)
+    assert agree >= 0.75, (out, ref)
+
+
+# ---------------------------------------------------------------------------
+# engine + scheduler
+# ---------------------------------------------------------------------------
+
+def test_engine_single_request_matches_generate():
+    from mxnet_tpu.serve import InferenceEngine, ServeConfig
+    m = _tiny_model()
+    eng = InferenceEngine(m, ServeConfig(max_slots=2, page_size=8,
+                                         prefill_chunk=4, max_len=32))
+    for prompt in ([5], [3, 9, 1, 7, 2], list(range(10))):
+        ref = _ref_generate(m, prompt, 7)
+        assert eng.generate(prompt, max_new_tokens=7) == ref
+
+
+def test_engine_concurrent_streaming_order_and_parity():
+    """Mixed prompt lengths decode concurrently; each request's streamed
+    tokens arrive in generation order and match its unbatched run."""
+    from mxnet_tpu.serve import InferenceEngine, ServeConfig
+    m = _tiny_model()
+    rng = onp.random.RandomState(3)
+    prompts = [rng.randint(0, 96, n).tolist() for n in (2, 7, 11, 4)]
+    refs = [_ref_generate(m, p, 5) for p in prompts]
+    eng = InferenceEngine(m, ServeConfig(max_slots=4, page_size=4,
+                                         prefill_chunk=4, max_len=32))
+    streams = {i: [] for i in range(len(prompts))}
+    handles = [eng.submit(p, max_new_tokens=5,
+                          on_token=lambda t, r, i=i: streams[i].append(t))
+               for i, p in enumerate(prompts)]
+    eng.run_until_idle()
+    for i, (h, ref) in enumerate(zip(handles, refs)):
+        assert h.result(timeout=0) == ref
+        assert streams[i] == ref[len(prompts[i]):]
+        assert h.state == "finished" and h.done()
+
+
+def test_scheduler_admit_fifo_and_evict_youngest():
+    """Admission is FIFO; page pressure evicts the YOUNGEST-admitted
+    active (recompute preemption), which re-queues at the front and
+    still completes correctly."""
+    from mxnet_tpu.serve import InferenceEngine, ServeConfig
+    m = _tiny_model(num_layers=1)
+    p1, p2, p3 = [3, 9, 1, 7], [5, 2, 8], [4, 4]
+    refs = [_ref_generate(m, p, 10) for p in (p1, p2, p3)]
+    # one full-length sequence (14 tokens / ps 2 = 7 pages) nearly fills
+    # the 8 allocatable pages: overlapping decodes must evict
+    eng = InferenceEngine(m, ServeConfig(max_slots=2, page_size=2,
+                                         num_pages=9, prefill_chunk=4,
+                                         max_len=16))
+    h1 = eng.submit(p1, max_new_tokens=10)
+    h2 = eng.submit(p2, max_new_tokens=10)
+    h3 = eng.submit(p3, max_new_tokens=10)
+    eng.step()
+    # FIFO: the first two submissions hold the two slots
+    assert h1.state == "running" and h2.state == "running"
+    assert h3.state == "queued"
+    eng.run_until_idle()
+    # eviction hit the younger of the colliding actives, never the oldest
+    assert h1.evictions == 0
+    assert h2.evictions + h3.evictions >= 1
+    for h, ref in zip((h1, h2, h3), refs):
+        assert h.result(timeout=0) == ref
+
+
+def test_oom_admission_backpressure_and_validation():
+    from mxnet_tpu.serve import InferenceEngine, ServeConfig
+    m = _tiny_model(num_layers=1)
+    eng = InferenceEngine(m, ServeConfig(max_slots=2, page_size=4,
+                                         num_pages=4, prefill_chunk=4,
+                                         max_len=16))
+    # 3 allocatable pages = 12 tokens of KV; a request that cannot EVER
+    # fit fails fast at submit
+    with pytest.raises(MXNetError, match="KV pages"):
+        eng.submit(list(range(8)), max_new_tokens=6)    # 14 tok -> 4 pages
+    with pytest.raises(MXNetError, match="context cap"):
+        eng.submit(list(range(12)), max_new_tokens=10)  # > max_len
+    with pytest.raises(MXNetError, match="empty prompt"):
+        eng.submit([], max_new_tokens=1)
+    with pytest.raises(MXNetError, match="max_new_tokens"):
+        eng.submit([1, 2], max_new_tokens=0)
+    # a request that fits alone but not beside the running one waits in
+    # the queue (admission backpressure), then runs after the first frees
+    h1 = eng.submit(list(range(6)), max_new_tokens=4)   # 10 tok -> 3 pages
+    h2 = eng.submit(list(range(4)), max_new_tokens=4)   # 8 tok -> 2 pages
+    eng.step()
+    assert h1.state == "running" and h2.state == "queued"
+    eng.run_until_idle()
+    assert h1.state == "finished" and h2.state == "finished"
+    assert len(h1.tokens) == 4 and len(h2.tokens) == 4
+
+
+def test_eos_token_stops_decode():
+    from mxnet_tpu.serve import InferenceEngine, ServeConfig
+    m = _tiny_model(num_layers=1)
+    prompt = [3, 9, 1]
+    ref = _ref_generate(m, prompt, 12)
+    gen = ref[len(prompt):]
+    eng = InferenceEngine(m, ServeConfig(max_slots=2, page_size=8,
+                                         prefill_chunk=4, max_len=32))
+    # eos never generated -> runs to max_new_tokens
+    never = next(t for t in range(96) if t not in gen)
+    h = eng.submit(prompt, max_new_tokens=12, eos_token_id=never)
+    eng.run_until_idle()
+    assert h.tokens == gen
+    # eos == the first generated token -> stops immediately after it
+    h2 = eng.submit(prompt, max_new_tokens=12, eos_token_id=gen[0])
+    eng.run_until_idle()
+    assert h2.tokens == gen[:1]
+
+
+def test_failed_step_fails_all_requests(monkeypatch):
+    """A device-step exception must not strand waiters: every active and
+    queued request flips to 'failed', result() raises, pages return to
+    the free list, and the exception still propagates to the caller."""
+    from mxnet_tpu.serve import InferenceEngine, ServeConfig
+    m = _tiny_model(num_layers=1)
+    eng = InferenceEngine(m, ServeConfig(max_slots=1, page_size=8,
+                                         prefill_chunk=4, max_len=32))
+    h1 = eng.submit([3, 9, 1], max_new_tokens=4)
+    h2 = eng.submit([5, 2], max_new_tokens=4)   # waits in the queue
+
+    def boom(*a, **kw):
+        raise RuntimeError("device exploded")
+
+    monkeypatch.setattr(eng, "_execute", boom)
+    with pytest.raises(RuntimeError, match="device exploded"):
+        eng.step()
+    for h in (h1, h2):
+        assert h.state == "failed" and h.done()
+        with pytest.raises(MXNetError, match="device exploded"):
+            h.result(timeout=0)
+    assert eng.allocator.free_pages == eng.allocator.total_pages
+
+
+def test_serve_config_env_knobs(monkeypatch):
+    from mxnet_tpu.serve import ServeConfig
+    monkeypatch.setenv("MXTPU_SERVE_SLOTS", "3")
+    monkeypatch.setenv("MXTPU_SERVE_PAGE_SIZE", "32")
+    monkeypatch.setenv("MXTPU_SERVE_PREFILL_CHUNK", "8")
+    monkeypatch.setenv("MXTPU_SERVE_MAX_LEN", "48")
+    monkeypatch.setenv("MXTPU_SERVE_KV_DTYPE", "int8")
+    sc = ServeConfig()
+    assert (sc.max_slots, sc.page_size, sc.prefill_chunk, sc.max_len,
+            sc.kv_dtype) == (3, 32, 8, 48, "int8")
+    with pytest.raises(MXNetError):
+        ServeConfig(max_slots=0)
+
+
+# ---------------------------------------------------------------------------
+# telemetry contract
+# ---------------------------------------------------------------------------
+
+def test_telemetry_emitted_per_request(tmp_path):
+    from mxnet_tpu import telemetry as tele
+    from mxnet_tpu.serve import InferenceEngine, ServeConfig
+    m = _tiny_model(num_layers=1)
+    journal = str(tmp_path / "serve.jsonl")
+    tele.enable(journal_path=journal)
+    try:
+        reg = tele.registry()
+        ttft0 = (reg.get("serve_ttft_ms").count()
+                 if "serve_ttft_ms" in reg else 0)
+        fin0 = (reg.get("serve_requests_total").value(state="finished")
+                if "serve_requests_total" in reg else 0)
+        eng = InferenceEngine(m, ServeConfig(max_slots=2, page_size=8,
+                                             prefill_chunk=4, max_len=32))
+        h1 = eng.submit([3, 9, 1], max_new_tokens=4)
+        h2 = eng.submit([5, 2], max_new_tokens=4)
+        eng.run_until_idle()
+        assert h1.done() and h2.done()
+        snap = tele.snapshot()
+        assert reg.get("serve_ttft_ms").count() == ttft0 + 2
+        assert reg.get("serve_request_latency_ms").count() >= 2
+        assert reg.get("serve_requests_total").value(
+            state="finished") == fin0 + 2
+        assert reg.get("serve_tokens_generated_total").value() >= 8
+        assert "serve_page_occupancy_ratio" in snap
+        assert "serve_step_ms" in snap
+        rows = tele.RunJournal.read(journal)
+        req_rows = [r for r in rows if r.get("event") == "request"]
+        by_id = {}
+        for r in req_rows:
+            by_id.setdefault(r["request_id"], []).append(r["phase"])
+        assert set(by_id) == {h1.id, h2.id}
+        for phases in by_id.values():
+            for needed in ("submitted", "admitted", "first_token",
+                           "finished"):
+                assert needed in phases
+        # the serving loop feeds the hang watchdog's heartbeat table
+        from mxnet_tpu import health
+        assert "serve.step" in health.heartbeat_ages()
+    finally:
+        tele.disable()
+
+
+def test_kv_pools_donation_rebind():
+    """The engine rebinds donated pool buffers each step — after a full
+    request the pools object must still be usable (no deleted-buffer
+    errors) and pages fully recycled."""
+    from mxnet_tpu.serve import InferenceEngine, ServeConfig
+    m = _tiny_model(num_layers=1)
+    eng = InferenceEngine(m, ServeConfig(max_slots=2, page_size=4,
+                                         prefill_chunk=4, max_len=16))
+    eng.generate([1, 2, 3], max_new_tokens=4)
+    eng.generate([4, 5], max_new_tokens=4)
+    assert eng.allocator.free_pages == eng.allocator.total_pages
+    # pool arrays are live (donation rebound correctly)
+    assert eng.pools.arrays["k"].shape[0] == eng.cfg.num_layers
+    float(eng.pools.arrays["k"].sum())   # would raise on a deleted buffer

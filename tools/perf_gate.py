@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""CPU-bench perf gate (`make perf-gate`, ROADMAP item 5).
+
+Runs the CPU proxy bench (`bench.py --measure cpu`) three times, takes the
+MEDIAN samples/sec, and fails (exit 1) when it is more than `tolerance`
+(default 15%) below the checked-in budget in
+`bench_results/cpu_budget.json` — so a hot-path regression like the one
+suspected in round 5 can never land silently again.  A median above budget
+prints a note suggesting a re-baseline (ratchet upward, never down).
+
+    python tools/perf_gate.py               # gate against the budget
+    python tools/perf_gate.py --rebaseline  # measure + rewrite the budget
+
+Background (ROADMAP item 5): the r05 203->82 samples/s "regression"
+bisected to measurement noise — every commit PR2..PR5 measures within the
+same 37-52 ms/step band on this box, and the pre-r06 single-6-step-slope
+timing swings +/-30% run to run.  bench.py now uses best-of-three 12-step
+slopes; this gate adds the regression tripwire on top.
+"""
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUDGET = os.path.join(ROOT, "bench_results", "cpu_budget.json")
+RUNS = 3
+TIMEOUT = 600
+
+
+def measure_once() -> float:
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"),
+         "--measure", "cpu"],
+        capture_output=True, text=True, timeout=TIMEOUT, cwd=ROOT)
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout).strip().splitlines()[-6:]
+        raise RuntimeError("bench failed rc=%d: %s"
+                           % (proc.returncode, " | ".join(tail)))
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            d = json.loads(line)
+            return float(d["extras"]["samples_per_sec_per_chip"])
+    raise RuntimeError("no JSON line in bench output")
+
+
+def main() -> int:
+    vals = []
+    for i in range(RUNS):
+        v = measure_once()
+        vals.append(v)
+        print(f"perf-gate: run {i + 1}/{RUNS}: {v:.2f} samples/s/chip")
+    med = statistics.median(vals)
+
+    if "--rebaseline" in sys.argv:
+        budget = {
+            "metric": "bert_base_pretrain_samples_per_sec",
+            "samples_per_sec_per_chip": round(med, 1),
+            "tolerance": 0.15,
+            "measured_at": time.strftime("%Y-%m-%d"),
+            "note": "re-baselined by tools/perf_gate.py --rebaseline "
+                    "(median of %d runs: %s)" % (RUNS, vals),
+        }
+        with open(BUDGET, "w") as f:
+            json.dump(budget, f, indent=2)
+            f.write("\n")
+        print(f"perf-gate: budget re-baselined to {med:.1f} -> {BUDGET}")
+        return 0
+
+    with open(BUDGET) as f:
+        budget = json.load(f)
+    target = float(budget["samples_per_sec_per_chip"])
+    tol = float(budget.get("tolerance", 0.15))
+    floor = target * (1.0 - tol)
+    verdict = "PASS" if med >= floor else "FAIL"
+    print(f"perf-gate: median {med:.2f} vs budget {target:.2f} "
+          f"(floor {floor:.2f}, tolerance {tol:.0%}) -> {verdict}")
+    if med < floor:
+        print("perf-gate: CPU bench regressed beyond the budget — find "
+              "the hot-path change (git bisect running THIS gate per "
+              "commit) before merging; do NOT re-baseline downward.",
+              file=sys.stderr)
+        return 1
+    if med > target * (1.0 + tol):
+        print("perf-gate: median is >15% ABOVE budget — if a deliberate "
+              "optimization landed, ratchet the budget up: "
+              "python tools/perf_gate.py --rebaseline")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
