@@ -334,6 +334,173 @@ def _measure_serve() -> dict:
     }
 
 
+def _measure_ops() -> dict:
+    """`bench.py --ops`: per-kernel microbenchmarks for the fused Pallas
+    set (docs/perf.md "Fused kernels & autotuning").
+
+    Times each kernel's ACTIVE path (Pallas on TPU, jnp reference on
+    CPU — `ops.pallas.kernel_active`) against its forced-reference
+    path, plus the pre-fusion legacy formulation where one exists (the
+    dense MoE einsum pair, the unfused norm+residual chain), all
+    through `opperf.time_callable` (median-of-k, synchronized).  The
+    emitted JSON rides next to the standard bench fields so BENCH
+    rounds can track kernel-level wins, not just end-to-end slope.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    import mxnet_tpu  # noqa: F401  (backend + telemetry init)
+    from mxnet_tpu.benchmark.opperf import time_callable
+    from mxnet_tpu.ops import pallas as _pallas
+    from mxnet_tpu.ops.pallas import fused_norm as _fnorm
+    from mxnet_tpu.ops.pallas import moe_dispatch as _moed
+
+    dev = jax.devices()[0]
+    on_kernel_path = _pallas.kernel_active()
+    rng = _onp.random.RandomState(0)
+    f32 = jnp.float32
+    ops: dict = {}
+
+    def timed(fn, *args):
+        jfn = jax.jit(fn)
+        return time_callable(lambda: jfn(*args), warmup=2, runs=5)
+
+    # --- fused LayerNorm + residual ------------------------------------
+    rows, h = 2048, 1024
+    x = jnp.asarray(rng.randn(rows, h), f32)
+    res = jnp.asarray(rng.randn(rows, h), f32)
+    gam = jnp.ones((h,), f32)
+    bet = jnp.zeros((h,), f32)
+
+    def _ln_legacy(xv, rv, g, b):
+        # pre-fusion chain: separate add, then the plain-op norm
+        s = rv + xv
+        mean = jnp.mean(s, axis=-1, keepdims=True)
+        var = jnp.var(s, axis=-1, keepdims=True)
+        return (s - mean) * jax.lax.rsqrt(var + 1e-5) * g + b, s
+
+    ops["fused_norm"] = {
+        "shape": [rows, h],
+        "fused": timed(lambda a, r, g, b: _fnorm.layer_norm_residual(
+            a, r, g, b, use_kernel=on_kernel_path), x, res, gam, bet),
+        "reference": timed(lambda a, r, g, b: _fnorm.layer_norm_residual(
+            a, r, g, b, use_kernel=False), x, res, gam, bet),
+        "legacy": timed(_ln_legacy, x, res, gam, bet),
+    }
+
+    # --- blockwise MoE dispatch/combine --------------------------------
+    t, e, cap, hm = 1024, 8, 192, 512
+    xt = jnp.asarray(rng.randn(t, hm), f32)
+    expert = jnp.asarray(rng.randint(0, e, t), jnp.int32)
+    pos = jnp.asarray(rng.randint(0, cap, t), jnp.int32)
+    kept = jnp.asarray(rng.rand(t) < 0.9)
+    gate = jnp.asarray(rng.rand(t), f32)
+    down = jnp.asarray(rng.randn(e, cap, hm), f32)
+
+    # routing tensors ride as jit ARGUMENTS, never closure constants:
+    # XLA would constant-fold the dispatch-tensor build (the very cost
+    # the blockwise path removes) right out of the timed program
+    def _moe_pair(use_kernel):
+        def fn(xv, dn, ex, ps, kp, gt):
+            buf = _moed.moe_dispatch(xv, ex, ps, kp, e, cap,
+                                     use_kernel=use_kernel)
+            out = _moed.moe_combine(dn, ex, ps, kp, gt,
+                                    use_kernel=use_kernel)
+            return buf, out
+        return fn
+
+    def _moe_dense(xv, dn, ex, ps, kp, gt):
+        onehot = jax.nn.one_hot(ex, e, dtype=xv.dtype)
+        disp = (onehot * kp[:, None].astype(xv.dtype))[:, :, None] * \
+            jax.nn.one_hot(ps, cap, dtype=xv.dtype)[:, None, :]
+        buf = jnp.einsum("tec,th->ech", disp, xv)
+        out = jnp.einsum("tec,ech->th",
+                         disp * gt[:, None, None].astype(xv.dtype), dn)
+        return buf, out
+
+    moe_args = (xt, down, expert, pos, kept, gate)
+    ops["moe_dispatch"] = {
+        "shape": [t, e, cap, hm],
+        "fused": timed(_moe_pair(on_kernel_path), *moe_args),
+        "reference": timed(_moe_pair(False), *moe_args),
+        "legacy": timed(_moe_dense, *moe_args),
+    }
+
+    # --- fused multi-tensor optimizer ----------------------------------
+    from mxnet_tpu.ops.pallas import fused_optimizer as _fopt
+    from mxnet_tpu.optimizer import Adam
+    opt = Adam(learning_rate=1e-3)
+    # a transformer-ish leaf zoo: a few big matrices + a bias/scale tail
+    sizes = [1 << 18] * 3 + [1 << 10] * 24
+    params = {f"p{i}": jnp.asarray(rng.randn(n), f32)
+              for i, n in enumerate(sizes)}
+    grads = {k: jnp.asarray(rng.randn(v.size), f32)
+             for k, v in params.items()}
+    states = {k: (jnp.zeros_like(v), jnp.zeros_like(v))
+              for k, v in params.items()}
+    hp = {"lr": jnp.float32(1e-3), "wd": jnp.float32(0.0),
+          "rescale_grad": jnp.float32(1.0), "clip_gradient": None,
+          "t": jnp.float32(1.0)}
+    skip = jnp.asarray(False)
+
+    # hp and the skip flag are traced args like in the real step — a
+    # closed-over concrete False would let XLA fold the skip selects away
+    def _opt_fn(use_kernel):
+        def fn(p, g, s, hpv, sk):
+            return _fopt.apply_updates(opt, p, g, s, hpv, sk,
+                                       use_kernel=use_kernel)
+        return fn
+
+    ops["fused_optimizer"] = {
+        "shape": [int(sum(sizes)), len(sizes)],
+        "fused": timed(_opt_fn(on_kernel_path and
+                               _fopt.kernel_supported(opt)),
+                       params, grads, states, hp, skip),
+        "reference": timed(_opt_fn(False), params, grads, states, hp,
+                           skip),
+    }
+
+    # --- flash attention (Pallas kernel only on the TPU backend) -------
+    from mxnet_tpu.ops.attention import reference_attention
+    b, nh, l, d = 4, 8, 512, 64
+    q = jnp.asarray(rng.randn(b, nh, l, d), f32)
+    k = jnp.asarray(rng.randn(b, nh, l, d), f32)
+    v = jnp.asarray(rng.randn(b, nh, l, d), f32)
+    ops["flash_attention"] = {
+        "shape": [b, nh, l, d],
+        "reference": timed(lambda a1, a2, a3: reference_attention(
+            a1, a2, a3, causal=True), q, k, v),
+    }
+    if on_kernel_path:
+        from mxnet_tpu.ops.pallas.flash_attention import flash_attention
+        ops["flash_attention"]["fused"] = timed(
+            lambda a1, a2, a3: flash_attention(a1, a2, a3, causal=True),
+            q, k, v)
+
+    for entry in ops.values():
+        f = entry.get("fused", {}).get("median_ms")
+        r = entry.get("reference", {}).get("median_ms")
+        if f and r:
+            entry["speedup_vs_reference"] = round(r / f, 3)
+        lg = entry.get("legacy", {}).get("median_ms")
+        if f and lg:
+            entry["speedup_vs_legacy"] = round(lg / f, 3)
+
+    return {
+        "metric": "kernel_microbench",
+        "value": round(ops["fused_norm"]["fused"]["median_ms"], 4),
+        "unit": "ms_fused_norm_median",
+        "vs_baseline": 0.0,   # north-star baseline is MFU-on-TPU
+        "extras": {
+            "ops": ops,
+            "kernel_path": "pallas" if on_kernel_path else "reference",
+            "pallas_mode": _pallas.pallas_mode(),
+            "device": getattr(dev, "device_kind", str(dev)),
+            "platform": dev.platform,
+        },
+    }
+
+
 def _run_child(platform: str, timeout: float):
     """Run `bench.py --measure <platform>` in a child; return (dict|None, err).
 
@@ -531,6 +698,13 @@ def main():
         os.environ["MXTPU_TELEMETRY"] = "1"
     if len(sys.argv) >= 3 and sys.argv[1] == "--measure":
         print(json.dumps(_measure(sys.argv[2])))
+        return
+    if "--ops" in sys.argv:
+        # per-kernel microbenchmarks (fused vs reference vs legacy) —
+        # claim-locked like --serve: the measurement may run on the TPU
+        _wait_for_claim_lock()
+        with _ClaimLock():
+            print(json.dumps(_measure_ops()))
         return
     if "--serve" in sys.argv:
         # a direct user entry point that may claim the TPU — go through

@@ -10,6 +10,7 @@ framework's actual serving path). Backward timing jits value+grad.
 """
 from __future__ import annotations
 
+import statistics
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Union
 
@@ -19,23 +20,57 @@ import numpy as _onp
 
 from ..base import MXNetError
 
-__all__ = ["run_performance_test", "run_op_benchmarks", "DEFAULT_OPS"]
+__all__ = ["run_performance_test", "run_op_benchmarks", "time_callable",
+           "DEFAULT_OPS"]
+
+
+def _sync(out) -> None:
+    jax.tree_util.tree_map(
+        lambda x: x.block_until_ready() if hasattr(x, "block_until_ready")
+        else x, out)
+
+
+def time_callable(fn: Callable[[], object], warmup: int = 1,
+                  runs: int = 5) -> Dict[str, float]:
+    """Time a zero-arg thunk: per-run wall times, fully synchronized.
+
+    The measurement contract the autotuner (`ops/pallas/autotune.tune`)
+    and `bench.py --ops` consume:
+
+    - every warmup iteration runs AND synchronizes before the first
+      timed run (compile time and lazy initialisation never leak into
+      the samples);
+    - each timed run is bracketed by `jax.block_until_ready` on its own
+      outputs, so a sample is one dispatch+execute, not an async enqueue;
+    - the headline number is the MEDIAN of the k runs — robust against
+      the scheduler hiccups that make single-sample CPU timings swing
+      ±30% (the BENCH r05 lesson).
+
+    Returns a stable schema: ``{"median_ms", "mean_ms", "min_ms",
+    "max_ms", "runs", "warmup"}``.
+    """
+    runs = max(1, int(runs))
+    for _ in range(max(0, int(warmup))):
+        _sync(fn())
+    samples = []
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        _sync(fn())
+        samples.append((time.perf_counter() - t0) * 1e3)
+    return {
+        "median_ms": statistics.median(samples),
+        "mean_ms": sum(samples) / len(samples),
+        "min_ms": min(samples),
+        "max_ms": max(samples),
+        "runs": runs,
+        "warmup": max(0, int(warmup)),
+    }
 
 
 def _time_it(fn, args, warmup: int, runs: int) -> float:
-    out = None
-    for _ in range(warmup):
-        out = fn(*args)
-    jax.tree_util.tree_map(
-        lambda x: x.block_until_ready() if hasattr(x, "block_until_ready")
-        else x, out)
-    t0 = time.perf_counter()
-    for _ in range(runs):
-        out = fn(*args)
-    jax.tree_util.tree_map(
-        lambda x: x.block_until_ready() if hasattr(x, "block_until_ready")
-        else x, out)
-    return (time.perf_counter() - t0) / runs
+    # median-of-k through the shared harness (seconds, legacy contract)
+    return time_callable(lambda: fn(*args), warmup=warmup,
+                         runs=runs)["median_ms"] / 1e3
 
 
 def run_performance_test(ops, inputs: Optional[Sequence[dict]] = None,

@@ -2,7 +2,7 @@
 from ..block import Block, HybridBlock, SymbolBlock
 from .basic_layers import (Sequential, HybridSequential, Dense, Dropout,
                            Embedding, BatchNorm, BatchNormReLU, SyncBatchNorm, LayerNorm,
-                           GroupNorm, InstanceNorm, Flatten, Lambda,
+                           RMSNorm, GroupNorm, InstanceNorm, Flatten, Lambda,
                            HybridLambda, Concatenate, HybridConcatenate,
                            Identity, Activation)
 from .conv_layers import (Conv1D, Conv2D, Conv3D, Conv1DTranspose,

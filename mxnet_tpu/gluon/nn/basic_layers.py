@@ -14,7 +14,8 @@ from ..parameter import Parameter, Constant
 
 __all__ = [
     "Sequential", "HybridSequential", "Dense", "Dropout", "Embedding",
-    "BatchNorm", "BatchNormReLU", "SyncBatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm",
+    "BatchNorm", "BatchNormReLU", "SyncBatchNorm", "LayerNorm", "RMSNorm",
+    "GroupNorm", "InstanceNorm",
     "Flatten", "Lambda", "HybridLambda", "Concatenate", "HybridConcatenate",
     "Identity", "Activation",
 ]
@@ -287,6 +288,36 @@ class LayerNorm(_SimpleNorm):
 
     def __repr__(self):
         return f"LayerNorm(axis={self._axis}, eps={self._epsilon})"
+
+
+class RMSNorm(HybridBlock):
+    """Root-mean-square norm over the last axis (no centering, no
+    shift): ``y = x * rsqrt(mean(x^2) + eps) * gamma``.  New capability
+    beyond the reference layer zoo — the pre-norm transformer default
+    (LLaMA-family); backed by the fused Pallas row kernel on TPU
+    (`ops/pallas/fused_norm.py`, docs/perf.md)."""
+
+    def __init__(self, epsilon=1e-6, gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._epsilon = epsilon
+        self.gamma = Parameter("gamma", shape=(in_channels,)
+                               if in_channels else (0,),
+                               init=gamma_initializer,
+                               allow_deferred_init=not in_channels)
+
+    def infer_shape(self, x, *args):
+        self.gamma.shape = (x.shape[-1],)
+
+    def forward(self, x):
+        c = self.gamma.shape[0] if self.gamma.shape else 0
+        assert not c or x.shape[-1] == c, (
+            f"RMSNorm: input last axis has size {x.shape[-1]}, "
+            f"expected {c}")
+        return npx.rms_norm(x, self.gamma.data(), eps=self._epsilon)
+
+    def __repr__(self):
+        return f"RMSNorm(eps={self._epsilon})"
 
 
 class GroupNorm(_SimpleNorm):

@@ -214,8 +214,16 @@ class Trainer:
 
         hp = self._cached_hp(t)
 
-        new_params, new_states = tree_apply_update(
-            _RuleAdapter(o), params_tree, grads_tree, states_tree, hp)
+        # fused multi-tensor kernel route (MXTPU_PALLAS, ops/pallas/
+        # fused_optimizer): same-dtype parameter chunks, one Pallas
+        # launch each; otherwise the jitted whole-tree XLA update
+        from ..ops.pallas import fused_optimizer as _fopt
+        if _fopt.kernel_route(o):
+            new_params, new_states = _fopt.tree_update(
+                o, params_tree, grads_tree, states_tree, hp)
+        else:
+            new_params, new_states = tree_apply_update(
+                _RuleAdapter(o), params_tree, grads_tree, states_tree, hp)
         for n, p in zip(names, self._params):
             p.data()._data = new_params[n]
             _state_writeback(self._states[n], new_states[n])

@@ -96,8 +96,16 @@ class GPTBlock(HybridBlock):
                                dtype=cfg.dtype)
 
     def forward(self, x):
-        x = x + self.attention(self.attn_norm(x))
-        return x + self.ffn(self.ffn_norm(x))
+        # pre-LN with the residual add fused into the second norm
+        # (ops/pallas/fused_norm): s = x + attn_out and ffn_norm(s)
+        # happen in one kernel pass, so the residual stream makes one
+        # HBM round-trip instead of three.  attn_norm/final_norm ride
+        # the same kernel through nn.LayerNorm -> npx.layer_norm.
+        att = self.attention(self.attn_norm(x))
+        normed, h = npx.layer_norm_residual(
+            att, x, self.ffn_norm.gamma.data(), self.ffn_norm.beta.data(),
+            eps=self.ffn_norm._epsilon)
+        return h + self.ffn(normed)
 
 
 class GPTModel(HybridBlock):

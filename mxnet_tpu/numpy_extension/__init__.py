@@ -464,8 +464,15 @@ def batch_norm(x, gamma_, beta, running_mean, running_var, eps=1e-5,
 
 
 def layer_norm(x, gamma_, beta, axis=-1, eps=1e-5):
-    """LayerNorm (parity: `src/operator/nn/layer_norm.cc`)."""
+    """LayerNorm (parity: `src/operator/nn/layer_norm.cc`).
+
+    Last-axis normalisation dispatches the fused Pallas row kernel when
+    the kernel path is active (`MXTPU_PALLAS`, docs/perf.md); the jnp
+    math below is the reference everywhere else."""
     def fn(xv, g, b):
+        from ..ops.pallas import fused_norm as _fnorm
+        if _fnorm.kernel_eligible(xv, axis):
+            return _fnorm.fused_layer_norm(xv, g, b, eps=eps)
         mean = jnp.mean(xv, axis=axis, keepdims=True)
         var = jnp.var(xv, axis=axis, keepdims=True)
         y = (xv - mean) * jax.lax.rsqrt(var + eps)
@@ -473,6 +480,58 @@ def layer_norm(x, gamma_, beta, axis=-1, eps=1e-5):
         shape[axis % xv.ndim] = xv.shape[axis % xv.ndim]
         return y * g.reshape(shape) + b.reshape(shape)
     return apply_op(fn, (x, gamma_, beta), {}, name="layer_norm")
+
+
+def layer_norm_residual(x, residual, gamma_, beta, axis=-1, eps=1e-5):
+    """Fused pre-LN transformer step: ``s = residual + x; y = LN(s)``.
+
+    Returns ``(y, s)`` — the normalised output AND the new residual
+    stream, so the add never makes a separate HBM round-trip (one
+    Pallas row kernel when active, jnp reference otherwise).  Only the
+    last axis is supported (that is the transformer case; plain
+    `layer_norm` covers the rest)."""
+    if axis not in (-1, getattr(x, "ndim", 0) - 1):
+        raise ValueError("layer_norm_residual normalises the last axis "
+                         f"only, got axis={axis}")
+
+    def fn(xv, rv, g, b):
+        from ..ops.pallas import fused_norm as _fnorm
+        if _fnorm.kernel_eligible(xv, -1):
+            return _fnorm.layer_norm_residual(xv, rv, g, b, eps=eps)
+        return _fnorm.layer_norm_reference(xv, g, b, eps=eps,
+                                           residual=rv)
+    return apply_op(fn, (x, residual, gamma_, beta), {},
+                    name="layer_norm_residual", n_out=2)
+
+
+def rms_norm(x, gamma_, axis=-1, eps=1e-6):
+    """RMSNorm over the last axis: ``y = x * rsqrt(mean(x^2)+eps) * g``
+    (fused Pallas row kernel when active)."""
+    if axis not in (-1, getattr(x, "ndim", 0) - 1):
+        raise ValueError(f"rms_norm normalises the last axis only, got "
+                         f"axis={axis}")
+
+    def fn(xv, g):
+        from ..ops.pallas import fused_norm as _fnorm
+        if _fnorm.kernel_eligible(xv, -1):
+            return _fnorm.fused_rms_norm(xv, g, eps=eps)
+        return _fnorm.rms_norm_reference(xv, g, eps=eps)
+    return apply_op(fn, (x, gamma_), {}, name="rms_norm")
+
+
+def rms_norm_residual(x, residual, gamma_, axis=-1, eps=1e-6):
+    """Fused ``s = residual + x; y = RMSNorm(s)``; returns ``(y, s)``."""
+    if axis not in (-1, getattr(x, "ndim", 0) - 1):
+        raise ValueError("rms_norm_residual normalises the last axis "
+                         f"only, got axis={axis}")
+
+    def fn(xv, rv, g):
+        from ..ops.pallas import fused_norm as _fnorm
+        if _fnorm.kernel_eligible(xv, -1):
+            return _fnorm.rms_norm_residual(xv, rv, g, eps=eps)
+        return _fnorm.rms_norm_reference(xv, g, eps=eps, residual=rv)
+    return apply_op(fn, (x, residual, gamma_), {},
+                    name="rms_norm_residual", n_out=2)
 
 
 def group_norm(x, gamma_, beta, num_groups=1, eps=1e-5):

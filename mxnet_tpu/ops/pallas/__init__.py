@@ -1,0 +1,93 @@
+"""Pallas TPU kernel set (flash attention, fused cross-entropy, paged
+attention, fused norms, fused multi-tensor optimizer, blockwise MoE
+dispatch) plus the block-size autotuner.
+
+Dispatch policy — one env var, `MXTPU_PALLAS`, governs every kernel in
+this package (docs/perf.md "Fused kernels & autotuning"):
+
+- ``auto`` (default): Pallas kernels on a TPU backend, jnp reference
+  implementations everywhere else.  Interpret mode alone does NOT flip
+  `auto` to kernels: several test modules enable
+  ``MXTPU_PALLAS_INTERPRET`` process-wide, and silently re-routing every
+  later layer-norm/optimizer through the interpreter would turn the CPU
+  suite into a Pallas-interpreter suite.
+- ``kernel``: force the Pallas path (on CPU this requires
+  ``MXTPU_PALLAS_INTERPRET=1`` — the interpret-mode parity harness).
+- ``reference``: force the jnp reference path everywhere, even on TPU.
+- ``off``: unfused legacy paths (dense MoE einsums, per-leaf optimizer
+  updates, plain layer_norm) — the escape hatch when a fused rewrite is
+  suspected of a regression.
+
+Every kernel module ships a jnp reference implementation that is both
+the CPU tier-1 path and the interpret-mode parity oracle (the
+`paged_attention.py` pattern).
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["pallas_mode", "kernel_active", "interpret_mode",
+           "note_fused_launch", "tpu_compiler_params"]
+
+
+def tpu_compiler_params(*dimension_semantics: str):
+    """Build TPU compiler params across the jax rename
+    (``TPUCompilerParams`` -> ``CompilerParams``) — every kernel in this
+    package goes through here so one jax bump can't strand half the
+    kernel set on the dead name."""
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams", None) or \
+        getattr(pltpu, "TPUCompilerParams")
+    return cls(dimension_semantics=tuple(dimension_semantics))
+
+
+def interpret_mode() -> bool:
+    """True when ``MXTPU_PALLAS_INTERPRET=1`` (kernels run through the
+    Pallas interpreter — CPU testing of the exact kernel code)."""
+    from ...base import getenv_bool
+    return getenv_bool("MXTPU_PALLAS_INTERPRET", False)
+
+
+def pallas_mode() -> str:
+    """Resolve ``MXTPU_PALLAS`` to one of auto|kernel|reference|off."""
+    v = os.environ.get("MXTPU_PALLAS", "auto").strip().lower()
+    if v in ("off", "0", "false", "no"):
+        return "off"
+    if v in ("reference", "ref"):
+        return "reference"
+    if v in ("kernel", "force", "pallas"):
+        return "kernel"
+    return "auto"
+
+
+def kernel_active() -> bool:
+    """Should a fused op dispatch its Pallas kernel right now?
+
+    ``kernel`` forces it; ``auto`` requires an actual TPU backend (see
+    the module docstring for why interpret mode deliberately does not
+    count); ``reference``/``off`` never."""
+    mode = pallas_mode()
+    if mode == "kernel":
+        return True
+    if mode in ("reference", "off"):
+        return False
+    import jax
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def note_fused_launch(op: str) -> None:
+    """Count a fused-kernel instantiation in telemetry.
+
+    Called where the kernel wrapper chooses the Pallas path — under jit
+    that is trace time, so the counter reads "fused launches compiled
+    into programs", not per-step executions (zero hot-path cost)."""
+    from ... import telemetry as _tele
+    if not _tele.enabled():
+        return
+    _tele.counter(
+        "kernel_fused",
+        "Fused Pallas kernel instantiations by op (counted at trace "
+        "time)", labelnames=("op",)).inc(op=op)

@@ -52,6 +52,11 @@ MASK_VALUE = -1e30
 LANES = 128
 
 
+def _compiler_params(*dims):
+    from . import tpu_compiler_params
+    return tpu_compiler_params(*dims)
+
+
 def _interpret() -> bool:
     from ...base import getenv_bool
     return getenv_bool("MXTPU_PALLAS_INTERPRET", False)
@@ -295,8 +300,8 @@ def _flash_fwd(q, k, v, bias, seed, scale, causal, block_q, block_k,
             pltpu.VMEM((bq, LANES), jnp.float32),
             pltpu.VMEM((bq, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        compiler_params=_compiler_params("parallel", "parallel",
+                                         "arbitrary"),
         interpret=_interpret(),
     )(*args)
     return out.reshape(b, h, lq, d), lse
@@ -490,8 +495,8 @@ def _flash_bwd(q, k, v, bias, seed, o, lse, g, scale, causal,
         out_specs=pl.BlockSpec((None, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        compiler_params=_compiler_params("parallel", "parallel",
+                                         "arbitrary"),
         interpret=interpret,
     )(*args)
 
@@ -525,8 +530,8 @@ def _flash_bwd(q, k, v, bias, seed, o, lse, g, scale, causal,
         ],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        compiler_params=_compiler_params("parallel", "parallel",
+                                         "arbitrary"),
         interpret=interpret,
     )(*args2)
 
@@ -622,6 +627,33 @@ def _env_int(name, default):
         return default
 
 
+def resolve_blocks(b, h, lq, lk, d, dtype, block_q=None, block_k=None):
+    """Pick (block_q, block_k) for one call: explicit args win, then an
+    explicitly-set MXTPU_FLASH_BLOCK_* env override, then the
+    autotuner's persisted config for this shape bucket
+    (`tune("flash_attention", (b, h, lq, lk, d), ...)` — docs/perf.md),
+    then the static 256 default.  Pure lookup: trace-safe."""
+    import os
+    cfg = None
+    if block_q is None or block_k is None:
+        if "MXTPU_FLASH_BLOCK_Q" not in os.environ or \
+                "MXTPU_FLASH_BLOCK_K" not in os.environ:
+            from . import autotune as _at
+            cfg = _at.cached_config("flash_attention", (b, h, lq, lk, d),
+                                    str(dtype))
+    if block_q is None:
+        if "MXTPU_FLASH_BLOCK_Q" in os.environ:
+            block_q = _env_int("MXTPU_FLASH_BLOCK_Q", 256)
+        else:
+            block_q = cfg.block_q if cfg is not None else 256
+    if block_k is None:
+        if "MXTPU_FLASH_BLOCK_K" in os.environ:
+            block_k = _env_int("MXTPU_FLASH_BLOCK_K", 256)
+        else:
+            block_k = cfg.block_k if cfg is not None else 256
+    return block_q, block_k
+
+
 def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
                     block_k=None, bias=None, dropout_rate=0.0,
                     dropout_seed=None, window=None, window_symmetric=True):
@@ -658,13 +690,11 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
     tiled to MXU-friendly blocks (compiled mode needs >=128-lane k blocks;
     interpret mode accepts >=8).
     """
-    if block_q is None:
-        block_q = _env_int("MXTPU_FLASH_BLOCK_Q", 256)
-    if block_k is None:
-        block_k = _env_int("MXTPU_FLASH_BLOCK_K", 256)
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / math.sqrt(d)
     b, h, lq, lk = q.shape[0], q.shape[1], q.shape[2], k.shape[2]
+    block_q, block_k = resolve_blocks(b, h, lq, lk, d, q.dtype,
+                                      block_q, block_k)
     g = k.shape[1]
     if v.shape[1] != g:
         raise ValueError(f"k has {g} heads but v has {v.shape[1]}")
@@ -729,3 +759,63 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
     out = _flash(qf, k, v, bias3, seed, s, causal, bq, bk, rate,
                  per_head, per_row, win, bool(window_symmetric), n_seg)
     return out.reshape(b, h, lq, d)
+
+
+# ---------------------------------------------------------------------------
+# autotune registration (docs/perf.md "Fused kernels & autotuning")
+# ---------------------------------------------------------------------------
+
+def _at_candidates(shapes, dtype):
+    from . import autotune as _at
+    _, _, lq, lk, d = (list(shapes) + [1, 1, 256, 256, 64])[:5]
+    out = []
+    for bq in (128, 256, 512):
+        if lq % bq and bq > lq:
+            continue
+        for bk in (128, 256, 512):
+            if lk % bk and bk > lk:
+                continue
+            # VMEM footprint: q/k/v blocks + the score tile + stats
+            vmem = 4 * (bq * d + 2 * bk * d + bq * bk + 3 * bq * LANES)
+            if vmem > 12 * 1024 * 1024:
+                continue
+            out.append(_at.BlockConfig(block_q=bq, block_k=bk))
+    return out or [_at.BlockConfig(block_q=128, block_k=128)]
+
+
+def _at_roofline(config, shapes, dtype):
+    b, h, lq, lk, d = (list(shapes) + [1, 1, 256, 256, 64])[:5]
+    itemsize = 2 if "16" in str(dtype) else 4
+    bq, bk = config.block_q, config.block_k
+    n_q = max(1, lq // max(1, bq))
+    # K/V stream once per q-block (the re-fetch cost small q blocks pay)
+    return {"flops": 4.0 * b * h * lq * lk * d,
+            "bytes": b * h * itemsize * (2.0 * lq * d
+                                         + n_q * 2.0 * lk * d),
+            "steps": float(b * h * n_q * max(1, lk // max(1, bk)))}
+
+
+def _at_build(config, shapes, dtype):
+    import numpy as _np
+    b, h, lq, lk, d = (list(shapes) + [1, 1, 256, 256, 64])[:5]
+    rng = _np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(b, h, lq, d), dtype)
+    k = jnp.asarray(rng.randn(b, h, lk, d), dtype)
+    v = jnp.asarray(rng.randn(b, h, lk, d), dtype)
+    fn = jax.jit(functools.partial(flash_attention, causal=True,
+                                   block_q=config.block_q,
+                                   block_k=config.block_k))
+
+    def thunk():
+        return fn(q, k, v)
+
+    return thunk
+
+
+def _at_register():
+    from . import autotune as _at
+    _at.register_tunable("flash_attention", _at_candidates, _at_build,
+                         _at_roofline)
+
+
+_at_register()

@@ -356,3 +356,70 @@ def ragged_paged_attention(q, kpool, vpool, page_tables, ctx_lens,
     return paged_attention_reference(
         q, kpool, vpool, page_tables, ctx_lens, start_pos, window=window,
         scale=scale, k_scales=k_scales, v_scales=v_scales)
+
+
+# ---------------------------------------------------------------------------
+# autotune registration: the launch itself has no free block parameter
+# (pages walk one at a time), so the tunable knob is the POOL's page
+# size — `tune("paged_attention", (slots, heads, kv_heads, head_dim,
+# ctx))` times a serving-shaped decode step per candidate and
+# `serve.ServeConfig` picks the persisted winner up when
+# MXTPU_SERVE_PAGE_SIZE is unset (docs/perf.md).
+# ---------------------------------------------------------------------------
+
+def recommended_page_size(default: int = 16) -> int:
+    """The tuned page size for this device (or `default`).  The page
+    size is a per-DEVICE knob: any persisted `tune("paged_attention",
+    ...)` result for this device kind applies, whatever serving shape
+    it was searched under."""
+    from . import autotune as _at
+    cfg = _at.lookup_any("paged_attention")
+    return int(cfg.page_size) if cfg is not None else default
+
+
+def _at_candidates(shapes, dtype):
+    from . import autotune as _at
+    return [_at.BlockConfig(page_size=ps) for ps in (16, 32, 64, 128)]
+
+
+def _at_roofline(config, shapes, dtype):
+    b, h, hkv, d, ctx = (list(shapes) + [8, 8, 8, 64, 512])[:5]
+    ps = config.page_size
+    pages = max(1, -(-ctx // ps))
+    # each slot streams ceil(ctx/ps) pages of K and V; bigger pages
+    # waste tail bandwidth but cost fewer grid steps
+    return {"flops": 4.0 * b * h * ctx * d,
+            "bytes": b * hkv * pages * ps * d * 2.0 * 4,
+            "steps": float(b * hkv * pages)}
+
+
+def _at_build(config, shapes, dtype):
+    import numpy as _np
+    b, h, hkv, d, ctx = (list(shapes) + [8, 8, 8, 64, 512])[:5]
+    ps = config.page_size
+    maxp = max(1, -(-ctx // ps))
+    n_pages = b * maxp + 1
+    rng = _np.random.RandomState(0)
+    dt = jnp.bfloat16 if "16" in str(dtype) else jnp.float32
+    q = jnp.asarray(rng.randn(b, h, 1, d), dt)
+    kpool = jnp.asarray(rng.randn(n_pages, ps, hkv, d), dt)
+    vpool = jnp.asarray(rng.randn(n_pages, ps, hkv, d), dt)
+    pt = jnp.asarray(
+        1 + _np.arange(b * maxp).reshape(b, maxp), jnp.int32)
+    ctx_lens = jnp.full((b,), ctx, jnp.int32)
+    start = ctx_lens - 1
+    fn = jax.jit(functools.partial(ragged_paged_attention))
+
+    def thunk():
+        return fn(q, kpool, vpool, pt, ctx_lens, start)
+
+    return thunk
+
+
+def _at_register():
+    from . import autotune as _at
+    _at.register_tunable("paged_attention", _at_candidates, _at_build,
+                         _at_roofline)
+
+
+_at_register()

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .flash_attention import LANES, _interpret, _lanes
+from .flash_attention import (LANES, _compiler_params, _interpret, _lanes)
 
 __all__ = ["softmax_cross_entropy"]
 
@@ -112,8 +112,7 @@ def _xent_fwd(x, labels, block_n, block_v):
             pltpu.VMEM((block_n, LANES), jnp.float32),
             pltpu.VMEM((block_n, LANES), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")),
+        compiler_params=_compiler_params("parallel", "arbitrary"),
         interpret=_interpret(),
     )(x, lab)
     return loss[:, 0], lse[:, 0]
@@ -136,8 +135,7 @@ def _xent_bwd(x, labels, lse, g, block_n, block_v):
         ],
         out_specs=pl.BlockSpec((block_n, block_v), lambda ni, vi: (ni, vi)),
         out_shape=jax.ShapeDtypeStruct((n, v), x.dtype),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")),
+        compiler_params=_compiler_params("parallel", "arbitrary"),
         interpret=_interpret(),
     )(x, lab, lse2, g2)
 

@@ -9,6 +9,7 @@ from .optimizer import Optimizer, register
 
 @register
 class Adam(Optimizer):
+    fused_elementwise = True  # pure jnp elementwise rule: chunkable by ops/pallas/fused_optimizer
     sparse_safe = True
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, lazy_update=False, **kwargs):
@@ -32,6 +33,7 @@ class Adam(Optimizer):
 @register
 class AdamW(Optimizer):
     """Decoupled weight decay (parity: `python/mxnet/optimizer/adamw.py`)."""
+    fused_elementwise = True  # pure jnp elementwise rule: chunkable by ops/pallas/fused_optimizer
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, correct_bias=True, **kwargs):
@@ -58,6 +60,7 @@ class AdamW(Optimizer):
 
 @register
 class AdaBelief(Optimizer):
+    fused_elementwise = True  # pure jnp elementwise rule: chunkable by ops/pallas/fused_optimizer
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-16, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -78,6 +81,7 @@ class AdaBelief(Optimizer):
 
 @register
 class Adamax(Optimizer):
+    fused_elementwise = True  # pure jnp elementwise rule: chunkable by ops/pallas/fused_optimizer
     def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2 = beta1, beta2
@@ -129,6 +133,7 @@ class Nadam(Optimizer):
 
 @register
 class AdaDelta(Optimizer):
+    fused_elementwise = True  # pure jnp elementwise rule: chunkable by ops/pallas/fused_optimizer
     def __init__(self, learning_rate=1.0, rho=0.90, epsilon=1e-5, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.rho, self.epsilon = rho, epsilon
@@ -148,6 +153,7 @@ class AdaDelta(Optimizer):
 
 @register
 class FTML(Optimizer):
+    fused_elementwise = True  # pure jnp elementwise rule: chunkable by ops/pallas/fused_optimizer
     def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
                  epsilon=1e-8, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)

@@ -12,6 +12,7 @@ from .optimizer import Optimizer, register
 @register
 class SGD(Optimizer):
     """SGD with momentum and weight decay (grad += wd*w like the reference)."""
+    fused_elementwise = True  # pure jnp elementwise rule: chunkable by ops/pallas/fused_optimizer
 
     sparse_safe = True
 
@@ -37,6 +38,7 @@ class SGD(Optimizer):
 @register
 class NAG(SGD):
     """Nesterov accelerated gradient."""
+    fused_elementwise = True  # pure jnp elementwise rule: chunkable by ops/pallas/fused_optimizer
 
     def __init__(self, learning_rate=0.01, momentum=0.9, **kwargs):
         super().__init__(learning_rate=learning_rate, momentum=momentum,
@@ -52,6 +54,7 @@ class NAG(SGD):
 @register
 class Signum(Optimizer):
     """signSGD with momentum (parity: signum.py)."""
+    fused_elementwise = True  # pure jnp elementwise rule: chunkable by ops/pallas/fused_optimizer
 
     def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)

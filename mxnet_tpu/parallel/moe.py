@@ -58,11 +58,20 @@ def switch_moe(x, router_w, w_up, w_down, capacity_factor=1.25,
     pos = jnp.sum(pos * in_cap, axis=-1).astype(jnp.int32)  # (T,)
     kept = jnp.any(in_cap, axis=-1)
 
-    # dispatch tensor (T, E, C): one-hot over expert x slot
-    disp = (onehot * kept[:, None])[:, :, None] * jax.nn.one_hot(
-        pos, capacity, dtype=x.dtype)[:, None, :]
-    disp = disp.astype(x.dtype)
-    buf = jnp.einsum("tec,th->ech", disp, xt)               # (E, C, H)
+    from ..ops.pallas import pallas_mode
+    if pallas_mode() == "off":
+        # legacy dense formulation: a one-hot (T, E, C) dispatch tensor
+        # contracted twice — O(T·E·C·H) for what is a permutation.
+        # Kept as the escape hatch and the overflow-semantics oracle.
+        disp = (onehot * kept[:, None])[:, :, None] * jax.nn.one_hot(
+            pos, capacity, dtype=x.dtype)[:, None, :]
+        disp = disp.astype(x.dtype)
+        buf = jnp.einsum("tec,th->ech", disp, xt)           # (E, C, H)
+    else:
+        # blockwise path (ops/pallas/moe_dispatch): scatter tokens to
+        # their capacity cells — cost scales with T·H, not T·E·C·H
+        from ..ops.pallas import moe_dispatch as _moed
+        buf = _moed.moe_dispatch(xt, expert, pos, kept, e, capacity)
 
     # expert FFN (batched over E; sharded on 'ep' when annotated)
     up = jnp.einsum("ech,eih->eci", buf, w_up.astype(buf.dtype))
@@ -73,8 +82,12 @@ def switch_moe(x, router_w, w_up, w_down, capacity_factor=1.25,
     down = jnp.einsum("eci,ehi->ech", up, w_down.astype(up.dtype))
 
     # combine weighted by the gate
-    out = jnp.einsum("tec,ech->th", disp * gate[:, None, None].astype(
-        x.dtype), down)
+    if pallas_mode() == "off":
+        out = jnp.einsum("tec,ech->th", disp * gate[:, None, None].astype(
+            x.dtype), down)
+    else:
+        from ..ops.pallas import moe_dispatch as _moed
+        out = _moed.moe_combine(down, expert, pos, kept, gate)
     return out.reshape(b, l, h), aux_loss
 
 

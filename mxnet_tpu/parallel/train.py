@@ -29,6 +29,7 @@ from ..gluon.block import Block, functional_call
 from ..gluon.parameter import Parameter
 from ..optimizer import Optimizer
 from ..ops.fused_optim import HpScalarCache
+from ..ops.pallas import fused_optimizer as _fused_opt
 from .. import health as _health
 from .. import profiler as _profiler
 from .. import recovery as _recovery
@@ -180,6 +181,26 @@ class ShardedTrainStep:
                 optimizer.create_state_jax(_master_dtype(self.pvals[n])))
             for n in self.diff_names}
         self._t = 0
+        # fused-optimizer route (captured ONCE, like the probes: the
+        # choice is baked into the traced program, so flipping
+        # MXTPU_PALLAS mid-run can never retrace a live step)
+        self._fused_opt_kernel = self._resolve_fused_kernel()
+
+    def _resolve_fused_kernel(self) -> bool:
+        """Use the Pallas fused-optimizer kernels inside the jitted
+        step?  Requires kernel mode + a kernel-eligible optimizer, and
+        nothing sharded: the chunk pack concatenates leaves, which on a
+        sharded layout would make GSPMD all-gather the tree every step
+        (TODO(tpu): a segment-aware sharded pack, ROADMAP §5)."""
+        if not _fused_opt.kernel_route(self.optimizer):
+            return False
+        if self.mesh.size == 1:
+            return True
+        if self.zero or self.fsdp:
+            return False
+        from jax.sharding import PartitionSpec as _P
+        return all(s.spec == _P()
+                   for s in self.param_shardings.values())
 
     # parameters below this size stay replicated under fsdp (per-use
     # all-gathers of tiny biases cost more than they save)
@@ -397,28 +418,20 @@ class ShardedTrainStep:
                 skip = jnp.logical_or(
                     probes["nonfinite"] > 0,
                     ~jnp.isfinite(loss.astype(jnp.float32)))
+            # fused multi-tensor optimizer update (ops/pallas/
+            # fused_optimizer, MXTPU_PALLAS): same-dtype leaves pack
+            # into contiguous chunks with ONE kernel launch each (skip
+            # guard applied in-register) when the kernel path is
+            # active; otherwise the per-leaf reference applies
+            # `optimizer._rule` + the identity-on-skip select with the
+            # exact semantics the former inline ladder had (dtype
+            # cast-backs included — donation still never retraces)
             new_p = dict(pvals)
-            new_s = {}
-            for n in diff_names:
-                w, s = optimizer._rule(pvals[n], grads[n], opt_state[n], hp)
-                # low-precision training: fp32 hyperparameter scalars
-                # promote the update math (desired — that's the implicit
-                # master-weight path; state was created fp32 above), but
-                # the stored weight/state dtypes must stay EXACTLY as
-                # declared or donation breaks and every step retraces
-                if w.dtype != pvals[n].dtype:
-                    w = w.astype(pvals[n].dtype)
-                s = jax.tree_util.tree_map(
-                    lambda new, old: new.astype(old.dtype)
-                    if hasattr(new, "dtype") and new.dtype != old.dtype
-                    else new, s, opt_state[n])
-                if skip is not None:
-                    w = jnp.where(skip, pvals[n], w)
-                    s = jax.tree_util.tree_map(
-                        lambda new, old: jnp.where(skip, old, new),
-                        s, opt_state[n])
-                new_p[n] = w
-                new_s[n] = s
+            upd_p, new_s = _fused_opt.apply_updates(
+                optimizer, {n: pvals[n] for n in diff_names}, grads,
+                {n: opt_state[n] for n in diff_names}, hp, skip,
+                use_kernel=outer._fused_opt_kernel)
+            new_p.update(upd_p)
             if skip is not None:
                 aux = {k: jnp.where(skip, pvals[k], v) if k in pvals else v
                        for k, v in aux.items()}

@@ -56,6 +56,20 @@ def _env_int(name, default):
         return default
 
 
+def _default_page_size() -> int:
+    """MXTPU_SERVE_PAGE_SIZE wins; otherwise the paged-attention
+    autotuner's persisted recommendation for this device, else 16
+    (`tune("paged_attention", ...)` — docs/perf.md)."""
+    explicit = _env_int("MXTPU_SERVE_PAGE_SIZE", 0)
+    if explicit:
+        return explicit
+    try:
+        from ..ops.pallas.paged_attention import recommended_page_size
+        return recommended_page_size(16)
+    except Exception:
+        return 16
+
+
 @dataclass
 class ServeConfig:
     """Serving knobs; every field defaults from its ``MXTPU_SERVE_*``
@@ -64,7 +78,7 @@ class ServeConfig:
     max_slots: int = field(
         default_factory=lambda: _env_int("MXTPU_SERVE_SLOTS", 8))
     page_size: int = field(
-        default_factory=lambda: _env_int("MXTPU_SERVE_PAGE_SIZE", 16))
+        default_factory=lambda: _default_page_size())
     num_pages: int = field(
         default_factory=lambda: _env_int("MXTPU_SERVE_PAGES", 0))
     prefill_chunk: int = field(

@@ -1,0 +1,583 @@
+"""Fused Pallas kernel-set parity tests (interpret mode on CPU).
+
+The EXACT kernel code in `ops/pallas/{fused_norm,moe_dispatch,
+fused_optimizer}.py` runs through the Pallas interpreter against each
+module's jnp reference (and, for MoE, the pre-fusion dense-einsum
+formulation) over odd/padded shapes — plus the `MXTPU_PALLAS` dispatch
+contract, the autotuner's search-then-persist loop, and the fused
+train-step acceptance criteria (one trace over 10 steps, NaN-skip
+bit-identity).  `pallas` marker (fast, CPU-only, tier-1);
+docs/perf.md "Fused kernels & autotuning".
+"""
+import os
+
+import numpy as onp
+import pytest
+
+os.environ["MXTPU_PALLAS_INTERPRET"] = "1"
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import health, recovery  # noqa: E402
+from mxnet_tpu import numpy_extension as npx  # noqa: E402
+from mxnet_tpu import telemetry as tele  # noqa: E402
+from mxnet_tpu.ops import pallas as pallas_pkg  # noqa: E402
+from mxnet_tpu.ops.pallas import autotune  # noqa: E402
+from mxnet_tpu.ops.pallas import fused_norm  # noqa: E402
+from mxnet_tpu.ops.pallas import fused_optimizer  # noqa: E402
+from mxnet_tpu.ops.pallas import moe_dispatch  # noqa: E402
+from mxnet_tpu.optimizer import LAMB, SGD, Adam  # noqa: E402
+
+pytestmark = pytest.mark.pallas
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """Health/recovery/telemetry are process-wide; the autotune memory
+    cache would leak tuned configs between tests."""
+    recovery.disable()
+    health.disable()
+    tele.disable()
+    tele.registry().reset()
+    autotune.clear_memory_cache()
+    yield
+    recovery.disable()
+    health.disable()
+    tele.disable()
+    tele.registry().reset()
+    autotune.clear_memory_cache()
+
+
+def _rand(shape, dtype=jnp.float32, seed=0):
+    rng = onp.random.RandomState(seed)
+    return jnp.asarray(rng.standard_normal(shape), dtype)
+
+
+# ---------------------------------------------------------------------------
+# fused_norm: kernel vs jnp reference (f32/bf16, ragged/odd last dims)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("rows,h", [(5, 37), (9, 200), (64, 256)])
+@pytest.mark.parametrize("dtype,atol", [(jnp.float32, 1e-5),
+                                        (jnp.bfloat16, 3e-2)])
+def test_norm_kernel_matches_reference(rows, h, dtype, atol):
+    x = _rand((rows, h), dtype, seed=1)
+    res = _rand((rows, h), dtype, seed=2)
+    g = jnp.asarray(onp.random.RandomState(3).rand(h) + 0.5, dtype)
+    b = _rand((h,), dtype, seed=4)
+    # oracle in f32: the kernel computes statistics in f32, so a
+    # low-precision reference would be the LESS accurate side
+    xf, rf = x.astype(jnp.float32), res.astype(jnp.float32)
+    gf, bf = g.astype(jnp.float32), b.astype(jnp.float32)
+
+    y = fused_norm.fused_layer_norm(x, g, b, use_kernel=True)
+    onp.testing.assert_allclose(
+        onp.asarray(y, onp.float32),
+        onp.asarray(fused_norm.layer_norm_reference(xf, gf, bf)),
+        atol=atol)
+
+    y = fused_norm.fused_rms_norm(x, g, use_kernel=True)
+    onp.testing.assert_allclose(
+        onp.asarray(y, onp.float32),
+        onp.asarray(fused_norm.rms_norm_reference(xf, gf)), atol=atol)
+
+    y, s = fused_norm.layer_norm_residual(x, res, g, b, use_kernel=True)
+    yr, sr = fused_norm.layer_norm_reference(xf, gf, bf, residual=rf)
+    onp.testing.assert_allclose(onp.asarray(y, onp.float32),
+                                onp.asarray(yr), atol=atol)
+    onp.testing.assert_allclose(onp.asarray(s, onp.float32),
+                                onp.asarray(sr), atol=atol)
+
+    y, s = fused_norm.rms_norm_residual(x, res, g, use_kernel=True)
+    yr, sr = fused_norm.rms_norm_reference(xf, gf, residual=rf)
+    onp.testing.assert_allclose(onp.asarray(y, onp.float32),
+                                onp.asarray(yr), atol=atol)
+
+
+def test_norm_gradients_match_reference():
+    """custom_vjp: Pallas forward, jnp backward — both residual outputs
+    carry cotangents."""
+    x = _rand((6, 40), seed=5)
+    res = _rand((6, 40), seed=6)
+    g = jnp.asarray(onp.random.RandomState(7).rand(40) + 0.5, jnp.float32)
+    b = jnp.zeros((40,), jnp.float32)
+
+    def loss(fn):
+        def inner(xv, rv, gv, bv):
+            y, s = fn(xv, rv, gv, bv)
+            return jnp.sum(y ** 2) + jnp.sum(s * 0.3)
+        return inner
+
+    k = loss(lambda *a: fused_norm.layer_norm_residual(
+        *a, use_kernel=True))
+    r = loss(lambda xv, rv, gv, bv: fused_norm.layer_norm_reference(
+        xv, gv, bv, residual=rv))
+    gk = jax.grad(k, argnums=(0, 1, 2, 3))(x, res, g, b)
+    gr = jax.grad(r, argnums=(0, 1, 2, 3))(x, res, g, b)
+    for a, want in zip(gk, gr):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(want),
+                                    atol=1e-4)
+
+
+def test_npx_norm_entry_points_agree():
+    """The npx ops (what gluon/GPT call) equal the module references on
+    the CPU tier-1 path, and RMSNorm is exposed as an nn block."""
+    from mxnet_tpu.gluon import nn
+    x = _rand((4, 6, 32), seed=8)
+    res = _rand((4, 6, 32), seed=9)
+    g = jnp.asarray(onp.random.RandomState(1).rand(32) + 0.5, jnp.float32)
+    b = _rand((32,), seed=2)
+
+    y, s = npx.layer_norm_residual(x, res, g, b)
+    yr, sr = fused_norm.layer_norm_reference(x, g, b, residual=res)
+    onp.testing.assert_allclose(onp.asarray(y), onp.asarray(yr),
+                                atol=1e-6)
+    onp.testing.assert_allclose(onp.asarray(s), onp.asarray(sr),
+                                atol=1e-6)
+    onp.testing.assert_allclose(
+        onp.asarray(npx.rms_norm(x, g)),
+        onp.asarray(fused_norm.rms_norm_reference(x, g)), atol=1e-6)
+
+    blk = nn.RMSNorm(in_channels=32)
+    blk.initialize()
+    out = blk(mx.np.array(onp.asarray(x)))
+    onp.testing.assert_allclose(
+        onp.asarray(out.asnumpy()),
+        onp.asarray(fused_norm.rms_norm_reference(
+            x, jnp.ones((32,), jnp.float32))), atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# moe_dispatch: kernel vs reference vs the pre-fusion dense einsums
+# ---------------------------------------------------------------------------
+
+def _routing(t, e, c, seed=0):
+    """Router-shaped assignments: pos is the token's arrival rank within
+    its expert (unique per (expert, slot)); rank >= capacity drops."""
+    rng = onp.random.RandomState(seed)
+    expert_np = rng.randint(0, e, t)
+    pos_np = onp.zeros(t, onp.int64)
+    seen = onp.zeros(e, onp.int64)
+    for i, ex in enumerate(expert_np):
+        pos_np[i] = seen[ex]
+        seen[ex] += 1
+    kept = jnp.asarray(pos_np < c)
+    pos = jnp.asarray(onp.where(pos_np < c, pos_np, 0), jnp.int32)
+    return jnp.asarray(expert_np, jnp.int32), pos, kept
+
+
+def _dense_dispatch_combine(x, expert, pos, kept, gate, down, e, c):
+    """The legacy (T, E, C) one-hot formulation — the overflow-semantics
+    oracle the blockwise kernels must match exactly."""
+    onehot = jax.nn.one_hot(expert, e, dtype=x.dtype)
+    disp = (onehot * kept[:, None].astype(x.dtype))[:, :, None] * \
+        jax.nn.one_hot(pos, c, dtype=x.dtype)[:, None, :]
+    buf = jnp.einsum("tec,th->ech", disp, x)
+    out = jnp.einsum("tec,ech->th",
+                     disp * gate[:, None, None].astype(x.dtype), down)
+    return buf, out
+
+
+@pytest.mark.parametrize("t,e,c,h", [(53, 4, 6, 128), (31, 3, 5, 64)])
+def test_moe_kernel_matches_dense_einsum_with_overflow(t, e, c, h):
+    x = _rand((t, h), seed=10)
+    down = _rand((e, c, h), seed=11)
+    gate = jnp.asarray(onp.random.RandomState(12).rand(t), jnp.float32)
+    expert, pos, kept = _routing(t, e, c, seed=13)
+    assert not bool(jnp.all(kept)), "want capacity overflow in this test"
+
+    buf_d, out_d = _dense_dispatch_combine(x, expert, pos, kept, gate,
+                                           down, e, c)
+    for use_kernel in (True, False):
+        buf = moe_dispatch.moe_dispatch(x, expert, pos, kept, e, c,
+                                        use_kernel=use_kernel)
+        out = moe_dispatch.moe_combine(down, expert, pos, kept, gate,
+                                       use_kernel=use_kernel)
+        onp.testing.assert_allclose(onp.asarray(buf), onp.asarray(buf_d),
+                                    atol=1e-5)
+        onp.testing.assert_allclose(onp.asarray(out), onp.asarray(out_d),
+                                    atol=1e-5)
+        # dropped tokens must be EXACT zero rows (the einsum contract)
+        dropped = ~onp.asarray(kept)
+        assert not onp.any(onp.asarray(out)[dropped])
+
+
+def test_moe_kernel_gradients_match_dense():
+    t, e, c, h = 24, 3, 4, 128
+    x = _rand((t, h), seed=14)
+    down_w = _rand((e, c, h), seed=15)
+    gate = jnp.asarray(onp.random.RandomState(16).rand(t), jnp.float32)
+    expert, pos, kept = _routing(t, e, c, seed=17)
+
+    def f_kernel(xv, gv):
+        buf = moe_dispatch.moe_dispatch(xv, expert, pos, kept, e, c,
+                                        use_kernel=True)
+        out = moe_dispatch.moe_combine(buf * 0.5 + down_w, expert, pos,
+                                       kept, gv, use_kernel=True)
+        return jnp.sum(out ** 2)
+
+    def f_dense(xv, gv):
+        buf, _ = _dense_dispatch_combine(xv, expert, pos, kept, gv,
+                                         down_w, e, c)
+        _, out = _dense_dispatch_combine(xv, expert, pos, kept, gv,
+                                         buf * 0.5 + down_w, e, c)
+        return jnp.sum(out ** 2)
+
+    gk = jax.grad(f_kernel, argnums=(0, 1))(x, gate)
+    gd = jax.grad(f_dense, argnums=(0, 1))(x, gate)
+    for a, want in zip(gk, gd):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(want),
+                                    atol=1e-4)
+
+
+def test_switch_moe_blockwise_equals_legacy_dense(monkeypatch):
+    """End-to-end: MXTPU_PALLAS=off (dense einsums) and the default
+    blockwise path produce the same layer output, overflow included."""
+    from mxnet_tpu.parallel import switch_moe
+    rng = onp.random.RandomState(18)
+    b, l, h, i, e = 2, 16, 32, 48, 4
+    x = jnp.asarray(rng.standard_normal((b, l, h)), jnp.float32)
+    rw = jnp.asarray(rng.standard_normal((e, h)), jnp.float32)
+    wu = jnp.asarray(rng.standard_normal((e, i, h)) * 0.1, jnp.float32)
+    wd = jnp.asarray(rng.standard_normal((e, h, i)) * 0.1, jnp.float32)
+
+    # capacity_factor 0.5 forces drops: overflow semantics must agree
+    monkeypatch.setenv("MXTPU_PALLAS", "off")
+    out_legacy, aux_legacy = switch_moe(x, rw, wu, wd,
+                                        capacity_factor=0.5)
+    monkeypatch.delenv("MXTPU_PALLAS")
+    out_block, aux_block = switch_moe(x, rw, wu, wd, capacity_factor=0.5)
+    onp.testing.assert_allclose(onp.asarray(out_block),
+                                onp.asarray(out_legacy), atol=1e-5)
+    onp.testing.assert_allclose(float(aux_block), float(aux_legacy),
+                                rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# fused_optimizer: chunk kernel vs per-leaf reference + skip bit-identity
+# ---------------------------------------------------------------------------
+
+def _hp(clip=None):
+    return {"lr": jnp.float32(0.01), "wd": jnp.float32(0.01),
+            "rescale_grad": jnp.float32(1.0),
+            "clip_gradient": None if clip is None else jnp.float32(clip),
+            "t": jnp.float32(3.0)}
+
+
+def _leaf_zoo(opt, dtype=jnp.float32, seed=0):
+    """Odd leaf sizes force tile padding inside the packed chunk."""
+    rng = onp.random.RandomState(seed)
+    params = {n: jnp.asarray(rng.standard_normal(sz), dtype)
+              for n, sz in (("w", 1000), ("b", 37), ("s", 8))}
+    grads = {n: jnp.asarray(rng.standard_normal(v.size), dtype)
+             for n, v in params.items()}
+    states = {n: opt.create_state_jax(v.astype(jnp.float32))
+              for n, v in params.items()}
+    return params, grads, states
+
+
+@pytest.mark.parametrize("make_opt", [
+    lambda: Adam(learning_rate=0.01),
+    lambda: SGD(learning_rate=0.01, momentum=0.9),
+    lambda: LAMB(learning_rate=0.01)])
+@pytest.mark.parametrize("clip", [None, 1.0])
+def test_optimizer_kernel_matches_reference(make_opt, clip):
+    opt = make_opt()
+    params, grads, states = _leaf_zoo(opt)
+    hp = _hp(clip)
+    kp, ks = fused_optimizer.apply_updates(opt, params, grads, states,
+                                           hp, skip=None,
+                                           use_kernel=True)
+    rp, rs = fused_optimizer.apply_updates(opt, params, grads, states,
+                                           hp, skip=None,
+                                           use_kernel=False)
+    for n in params:
+        onp.testing.assert_allclose(onp.asarray(kp[n]),
+                                    onp.asarray(rp[n]), atol=2e-6)
+    for a, want in zip(jax.tree_util.tree_leaves(ks),
+                       jax.tree_util.tree_leaves(rs)):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(want),
+                                    atol=2e-6)
+
+
+@pytest.mark.parametrize("make_opt", [
+    lambda: Adam(learning_rate=0.01),
+    lambda: SGD(learning_rate=0.01, momentum=0.9),
+    lambda: LAMB(learning_rate=0.01)])
+@pytest.mark.parametrize("use_kernel", [True, False])
+def test_optimizer_skip_guard_is_bit_identical(make_opt, use_kernel):
+    """The non-finite skip turns the whole update into the identity —
+    params AND optimizer state keep their pre-step values bit-exactly,
+    on both the in-register kernel guard and the reference select."""
+    opt = make_opt()
+    params, grads, states = _leaf_zoo(opt, seed=1)
+    sp, ss = fused_optimizer.apply_updates(
+        opt, params, grads, states, _hp(), skip=jnp.asarray(True),
+        use_kernel=use_kernel)
+    for n in params:
+        onp.testing.assert_array_equal(onp.asarray(sp[n]),
+                                       onp.asarray(params[n]))
+    for a, want in zip(jax.tree_util.tree_leaves(ss),
+                       jax.tree_util.tree_leaves(states)):
+        onp.testing.assert_array_equal(onp.asarray(a), onp.asarray(want))
+    # skip=False must be a real (changed) update, not identity
+    up, _ = fused_optimizer.apply_updates(
+        opt, params, grads, states, _hp(), skip=jnp.asarray(False),
+        use_kernel=use_kernel)
+    assert any(not onp.array_equal(onp.asarray(up[n]),
+                                   onp.asarray(params[n]))
+               for n in params)
+
+
+def test_optimizer_mixed_dtype_chunks():
+    """bf16 weights with fp32 Adam moments form their own chunk; output
+    dtypes stay exactly as declared (the donation contract)."""
+    opt = Adam(learning_rate=0.01)
+    rng = onp.random.RandomState(2)
+    params = {"wlo": jnp.asarray(rng.standard_normal(300), jnp.bfloat16),
+              "whi": jnp.asarray(rng.standard_normal(200), jnp.float32),
+              "blo": jnp.asarray(rng.standard_normal(9), jnp.bfloat16)}
+    grads = {n: jnp.asarray(rng.standard_normal(v.size), v.dtype)
+             for n, v in params.items()}
+    states = {n: opt.create_state_jax(v.astype(jnp.float32))
+              for n, v in params.items()}
+    kp, ks = fused_optimizer.apply_updates(opt, params, grads, states,
+                                           _hp(), skip=None,
+                                           use_kernel=True)
+    rp, rs = fused_optimizer.apply_updates(opt, params, grads, states,
+                                           _hp(), skip=None,
+                                           use_kernel=False)
+    for n in params:
+        assert kp[n].dtype == params[n].dtype
+        onp.testing.assert_allclose(
+            onp.asarray(kp[n], onp.float32),
+            onp.asarray(rp[n], onp.float32), atol=5e-2)
+    for a, want in zip(jax.tree_util.tree_leaves(ks),
+                       jax.tree_util.tree_leaves(rs)):
+        assert a.dtype == want.dtype
+
+
+# ---------------------------------------------------------------------------
+# MXTPU_PALLAS dispatch contract
+# ---------------------------------------------------------------------------
+
+def test_reference_mode_forces_fallback_everywhere(monkeypatch):
+    monkeypatch.setenv("MXTPU_PALLAS", "reference")
+    assert pallas_pkg.pallas_mode() == "reference"
+    assert not pallas_pkg.kernel_active()
+    assert not fused_norm.kernel_eligible(jnp.zeros((4, 8)))
+    assert not fused_optimizer.kernel_route(Adam())
+    # moe wrappers resolve use_kernel=None to the reference path
+    x = _rand((6, 128), seed=3)
+    expert, pos, kept = _routing(6, 2, 4, seed=4)
+    out = moe_dispatch.moe_dispatch(x, expert, pos, kept, 2, 4)
+    onp.testing.assert_array_equal(
+        onp.asarray(out),
+        onp.asarray(moe_dispatch.moe_dispatch_reference(
+            x, expert, pos, kept, 2, 4)))
+
+
+def test_pallas_mode_spellings(monkeypatch):
+    for raw, want in (("off", "off"), ("0", "off"), ("REF", "reference"),
+                      ("kernel", "kernel"), ("auto", "auto"),
+                      ("bogus", "auto")):
+        monkeypatch.setenv("MXTPU_PALLAS", raw)
+        assert pallas_pkg.pallas_mode() == want
+    monkeypatch.delenv("MXTPU_PALLAS")
+    # auto on the CPU backend: reference path (interpret mode alone
+    # must NOT flip auto to kernels — see ops/pallas/__init__)
+    assert pallas_pkg.pallas_mode() == "auto"
+    assert not pallas_pkg.kernel_active()
+
+
+# ---------------------------------------------------------------------------
+# autotuner: analytic prune + search-then-persist + warm starts
+# ---------------------------------------------------------------------------
+
+def test_autotune_search_persists_and_warm_starts(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXTPU_AUTOTUNE_CACHE", str(tmp_path))
+    tele.enable()
+    shapes, dtype = (64, 128), "float32"
+
+    cold = autotune.tune("fused_norm", shapes, dtype, warmup=1, runs=2,
+                         top_k=2)
+    assert not cold.cache_hit and cold.source == "search"
+    assert cold.trials >= 1
+    path = tmp_path / "autotune_fused_norm.json"
+    assert path.exists()
+    entry = next(iter(__import__("json").loads(path.read_text()).values()))
+    assert "config" in entry and "block_rows" in entry["config"]
+
+    h0 = tele.counter("autotune_hits").value()
+    warm = autotune.tune("fused_norm", shapes, dtype)
+    assert warm.cache_hit and warm.trials == 0
+    assert tele.counter("autotune_hits").value() == h0 + 1
+    assert warm.config == cold.config
+
+    # fresh memory cache: the DISK entry alone serves the key
+    autotune.clear_memory_cache()
+    disk = autotune.tune("fused_norm", shapes, dtype)
+    assert disk.cache_hit and disk.trials == 0
+    assert autotune.cached_config("fused_norm", shapes, dtype) is not None
+
+    # ragged tails share the tuned bucket (shape_bucket rounds up)
+    assert autotune.cached_config("fused_norm", (63, 127),
+                                  dtype) == cold.config
+
+
+def test_autotune_disabled_env(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXTPU_AUTOTUNE_CACHE", str(tmp_path))
+    cfg = autotune.BlockConfig(block_rows=64)
+    key = autotune._key("fused_norm", (8, 128), "float32",
+                        autotune.device_kind())
+    autotune._disk_store("fused_norm", key, cfg)
+    assert autotune.cached_config("fused_norm", (8, 128)) == cfg
+    monkeypatch.setenv("MXTPU_AUTOTUNE", "0")
+    assert autotune.cached_config("fused_norm", (8, 128)) is None
+
+
+def test_autotune_all_failed_search_is_not_persisted(monkeypatch,
+                                                     tmp_path):
+    """When every survivor fails to build/run, the key must stay cold
+    (no memory/disk pin of a config that never even compiled)."""
+    monkeypatch.setenv("MXTPU_AUTOTUNE_CACHE", str(tmp_path))
+
+    def boom(config, shapes, dtype):
+        raise RuntimeError("backend exploded")
+
+    tun = autotune._REGISTRY["fused_norm"]
+    monkeypatch.setattr(tun, "build", boom)
+    res = autotune.tune("fused_norm", (16, 128), "float32", runs=1)
+    assert not res.cache_hit and res.trials == 0
+    assert autotune.cached_config("fused_norm", (16, 128)) is None
+    assert not (tmp_path / "autotune_fused_norm.json").exists()
+
+
+def test_recommended_page_size_picks_up_any_tuned_shape(monkeypatch,
+                                                        tmp_path):
+    """The serve page size is per-device: a config tuned under ANY
+    serving shape must reach ServeConfig's default."""
+    from mxnet_tpu.ops.pallas.paged_attention import recommended_page_size
+    monkeypatch.setenv("MXTPU_AUTOTUNE_CACHE", str(tmp_path))
+    assert recommended_page_size(16) == 16
+    key = autotune._key("paged_attention", (8, 8, 8, 64, 512),
+                        "float32", autotune.device_kind())
+    autotune._disk_store("paged_attention", key,
+                         autotune.BlockConfig(page_size=64))
+    assert recommended_page_size(16) == 64
+    monkeypatch.setenv("MXTPU_AUTOTUNE", "0")
+    assert recommended_page_size(16) == 16
+
+
+def test_autotune_miss_is_negative_cached_until_tune(monkeypatch,
+                                                     tmp_path):
+    """A miss is remembered in-process (no disk re-read per norm call);
+    a tune() for the key clears it, clear_memory_cache resets."""
+    monkeypatch.setenv("MXTPU_AUTOTUNE_CACHE", str(tmp_path))
+    key = autotune._key("fused_norm", (16, 128), "float32",
+                        autotune.device_kind())
+    assert autotune.cached_config("fused_norm", (16, 128)) is None
+    assert key in autotune._MEM_MISS
+    # another process writing the file is invisible until a reset —
+    # the documented per-process semantics
+    autotune._disk_store("fused_norm", key,
+                         autotune.BlockConfig(block_rows=64))
+    assert autotune.cached_config("fused_norm", (16, 128)) is None
+    autotune.clear_memory_cache()
+    assert autotune.cached_config("fused_norm", (16, 128)) == \
+        autotune.BlockConfig(block_rows=64)
+
+
+def test_autotune_unknown_op_raises():
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError, match="unknown tunable"):
+        autotune.tune("not_an_op", (8,))
+
+
+def test_autotune_roofline_ranks_candidates():
+    """The analytic model must prefer fewer grid steps for a
+    bandwidth-bound kernel (the pruning signal that shrinks searches)."""
+    assert set(autotune.tunables()) >= {
+        "fused_norm", "fused_optimizer", "moe_dispatch",
+        "flash_attention", "paged_attention"}
+    tun = autotune._REGISTRY["fused_norm"]
+    small = autotune.predict_s(tun, autotune.BlockConfig(block_rows=8),
+                               (4096, 1024), "float32", kind="cpu")
+    large = autotune.predict_s(tun, autotune.BlockConfig(block_rows=512),
+                               (4096, 1024), "float32", kind="cpu")
+    assert large < small
+
+
+# ---------------------------------------------------------------------------
+# fused train step: one trace over 10 steps + NaN-skip unchanged
+# ---------------------------------------------------------------------------
+
+def _make_step(optimizer):
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import make_mesh, make_sharded_train_step
+    mx.random.seed(7)
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+    mesh = make_mesh({"dp": 1}, jax.devices("cpu")[:1])
+    return make_sharded_train_step(
+        net, optimizer, lambda out, x, y: jnp.mean((out - y) ** 2),
+        mesh, num_model_args=1)
+
+
+def _batch(nan=False, seed=0):
+    rng = onp.random.RandomState(seed)
+    x = rng.uniform(-1, 1, (8, 8)).astype(onp.float32)
+    y = rng.uniform(-1, 1, (8, 4)).astype(onp.float32)
+    if nan:
+        x = x * onp.float32("nan")
+    return x, y
+
+
+def test_fused_step_traces_once_and_matches_reference(monkeypatch):
+    """The kernel-route step compiles ONE program over 10 steps and its
+    weights track the reference-route step to float tolerance."""
+    monkeypatch.setenv("MXTPU_PALLAS", "kernel")
+    kstep = _make_step(Adam(learning_rate=1e-2))
+    assert kstep._fused_opt_kernel
+    monkeypatch.setenv("MXTPU_PALLAS", "reference")
+    rstep = _make_step(Adam(learning_rate=1e-2))
+    assert not rstep._fused_opt_kernel
+
+    for i in range(10):
+        x, y = _batch(seed=i)
+        lk = float(kstep(x, y))
+        lr = float(rstep(x, y))
+        assert onp.isfinite(lk)
+        onp.testing.assert_allclose(lk, lr, rtol=1e-4, atol=1e-5)
+    assert kstep.trace_count == 1
+    assert rstep.trace_count == 1
+    for n in kstep.pvals:
+        onp.testing.assert_allclose(
+            onp.asarray(jax.device_get(kstep.pvals[n])),
+            onp.asarray(jax.device_get(rstep.pvals[n])),
+            rtol=1e-4, atol=1e-5)
+
+
+def test_fused_step_nan_skip_preserves_weights(monkeypatch):
+    """PR 5 semantics through the in-register kernel guard: a NaN batch
+    leaves params bit-identical, the next clean batch applies, and the
+    guard never costs a retrace."""
+    monkeypatch.setenv("MXTPU_PALLAS", "kernel")
+    recovery.enable()
+    step = _make_step(SGD(learning_rate=1e-2, momentum=0.9))
+    assert step._fused_opt_kernel and step._skip_nonfinite
+    x, y = _batch()
+    step(x, y)
+    before = {n: onp.asarray(jax.device_get(v))
+              for n, v in step.pvals.items()}
+    step(*_batch(nan=True))
+    for n, v in step.pvals.items():
+        onp.testing.assert_array_equal(
+            onp.asarray(jax.device_get(v)), before[n])
+    step(x, y)
+    assert any(not onp.array_equal(onp.asarray(jax.device_get(v)),
+                                   before[n])
+               for n, v in step.pvals.items())
+    assert step.trace_count == 1

@@ -1,0 +1,223 @@
+"""Fused-kernel smoke check (`make kernels-smoke`, docs/perf.md).
+
+CPU interpret-mode parity sweep for the Pallas kernel set — the exact
+kernel code runs through the Pallas interpreter against each module's
+jnp reference over odd/padded shapes (ragged rows, non-128 last dims,
+capacity overflow) — followed by one autotune round asserting the
+search-then-persist loop: a cold `tune()` times candidates and writes
+the config JSON; a warm `tune()` (same key, fresh process-memory cache)
+reloads it from disk with ZERO timed trials and increments the
+`autotune_hits` counter.  Exits non-zero with a reason on any failure;
+cheap enough for CI (<60s CPU).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+# must happen before any jax backend initialisation: CPU backend, the
+# Pallas interpreter, and the forced-kernel mode the sweep exercises
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["MXTPU_PALLAS_INTERPRET"] = "1"
+os.environ["MXTPU_PALLAS"] = "kernel"
+os.environ["MXTPU_TELEMETRY"] = "1"
+_CACHE = tempfile.mkdtemp(prefix="mxtpu_autotune_smoke_")
+os.environ["MXTPU_AUTOTUNE_CACHE"] = _CACHE
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def fail(msg: str) -> None:
+    print(f"kernels-smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def check_close(name, got, want, atol):
+    got = np.asarray(got, np.float32)
+    want = np.asarray(want, np.float32)
+    if got.shape != want.shape:
+        fail(f"{name}: shape {got.shape} != {want.shape}")
+    err = float(np.max(np.abs(got - want))) if got.size else 0.0
+    if not np.isfinite(err) or err > atol:
+        fail(f"{name}: max|err| {err:.3e} > atol {atol:.1e}")
+    print(f"  {name}: max|err| {err:.3e} (atol {atol:.1e})")
+
+
+def norm_sweep():
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas import fused_norm as fn
+
+    rng = np.random.RandomState(0)
+    # odd/padded shapes: ragged rows, last dims off the 128-lane granule
+    for rows, h in ((5, 37), (17, 128), (9, 200), (64, 1024)):
+        for dt, atol in ((jnp.float32, 1e-5), (jnp.bfloat16, 3e-2)):
+            x = jnp.asarray(rng.randn(rows, h), dt)
+            res = jnp.asarray(rng.randn(rows, h), dt)
+            g = jnp.asarray(rng.rand(h) + 0.5, dt)
+            b = jnp.asarray(rng.randn(h), dt)
+            # oracle in f32 (the kernel computes statistics in f32; a
+            # low-precision reference would be the LESS accurate side)
+            xf, rf = x.astype(jnp.float32), res.astype(jnp.float32)
+            gf, bf = g.astype(jnp.float32), b.astype(jnp.float32)
+
+            y = fn.fused_layer_norm(x, g, b, use_kernel=True)
+            check_close(f"layer_norm {rows}x{h} {jnp.dtype(dt).name}",
+                        y, fn.layer_norm_reference(xf, gf, bf), atol)
+            y = fn.fused_rms_norm(x, g, use_kernel=True)
+            check_close(f"rms_norm {rows}x{h} {jnp.dtype(dt).name}",
+                        y, fn.rms_norm_reference(xf, gf), atol)
+            y, s = fn.layer_norm_residual(x, res, g, b, use_kernel=True)
+            yr, sr = fn.layer_norm_reference(xf, gf, bf, residual=rf)
+            check_close(f"ln+res y {rows}x{h} {jnp.dtype(dt).name}",
+                        y, yr, atol)
+            check_close(f"ln+res s {rows}x{h} {jnp.dtype(dt).name}",
+                        s, sr, atol)
+
+    # gradients flow through the custom_vjp (Pallas fwd, jnp bwd)
+    x = jnp.asarray(rng.randn(6, 40), jnp.float32)
+    g = jnp.asarray(rng.rand(40) + 0.5, jnp.float32)
+    b = jnp.zeros((40,), jnp.float32)
+
+    def loss_k(xv):
+        return jnp.sum(fn.fused_layer_norm(xv, g, b, use_kernel=True))
+
+    def loss_r(xv):
+        return jnp.sum(fn.layer_norm_reference(xv, g, b))
+
+    check_close("layer_norm grad", jax.grad(loss_k)(x),
+                jax.grad(loss_r)(x), 1e-4)
+
+
+def moe_sweep():
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas import moe_dispatch as md
+
+    rng = np.random.RandomState(1)
+    # odd T, capacity overflow: E*C slots < kept tokens -> forced drops
+    t, e, c, h = 53, 4, 6, 128
+    x = jnp.asarray(rng.randn(t, h), jnp.float32)
+    expert_np = rng.randint(0, e, t)
+    # router invariant: pos is the token's arrival rank within its
+    # expert (unique per (expert, slot)); rank >= capacity is dropped
+    pos_np = np.zeros(t, np.int64)
+    seen = np.zeros(e, np.int64)
+    for i, ex in enumerate(expert_np):
+        pos_np[i] = seen[ex]
+        seen[ex] += 1
+    expert = jnp.asarray(expert_np, jnp.int32)
+    kept = jnp.asarray(pos_np < c)
+    pos = jnp.asarray(np.where(pos_np < c, pos_np, 0), jnp.int32)
+    gate = jnp.asarray(rng.rand(t), jnp.float32)
+
+    buf_k = md.moe_dispatch(x, expert, pos, kept, e, c, use_kernel=True)
+    buf_r = md.moe_dispatch_reference(x, expert, pos, kept, e, c)
+    check_close(f"moe_dispatch T={t} E={e} C={c}", buf_k, buf_r, 1e-6)
+
+    down = jnp.asarray(rng.randn(e, c, h), jnp.float32)
+    out_k = md.moe_combine(down, expert, pos, kept, gate, use_kernel=True)
+    out_r = md.moe_combine_reference(down, expert, pos, kept, gate)
+    check_close("moe_combine (overflow drops)", out_k, out_r, 1e-6)
+    # dropped tokens must be EXACT zero rows (the dense-einsum contract)
+    dropped = ~np.asarray(kept)
+    if np.any(np.asarray(out_k)[dropped] != 0.0):
+        fail("moe_combine: dropped tokens produced non-zero rows")
+
+
+def optimizer_sweep():
+    import jax.numpy as jnp
+    from mxnet_tpu.optimizer import SGD, Adam
+    from mxnet_tpu.ops.pallas import fused_optimizer as fo
+
+    rng = np.random.RandomState(2)
+    hp = {"lr": jnp.float32(0.01), "wd": jnp.float32(0.01),
+          "rescale_grad": jnp.float32(1.0),
+          "clip_gradient": jnp.float32(1.0), "t": jnp.float32(3.0)}
+    for opt, atol in ((Adam(learning_rate=0.01), 1e-6),
+                      (SGD(learning_rate=0.01, momentum=0.9), 1e-6)):
+        # odd leaf sizes force tile padding inside the packed chunk
+        params = {n: jnp.asarray(rng.randn(sz), jnp.float32)
+                  for n, sz in (("w", 1000), ("b", 37), ("s", 8))}
+        grads = {n: jnp.asarray(rng.randn(v.size), jnp.float32)
+                 for n, v in params.items()}
+        states = {n: opt.create_state_jax(v) for n, v in params.items()}
+        name = type(opt).__name__
+
+        kp, ks = fo.apply_updates(opt, params, grads, states, hp,
+                                  skip=None, use_kernel=True)
+        rp, rs = fo.apply_updates(opt, params, grads, states, hp,
+                                  skip=None, use_kernel=False)
+        for n in params:
+            check_close(f"{name} {n} (kernel vs reference)",
+                        kp[n], rp[n], atol)
+        # skip semantics: params AND state bit-identical to their
+        # pre-step values when the non-finite probe fired
+        sp, ss = fo.apply_updates(opt, params, grads, states, hp,
+                                  skip=jnp.asarray(True),
+                                  use_kernel=True)
+        for n in params:
+            if not np.array_equal(np.asarray(sp[n]),
+                                  np.asarray(params[n])):
+                fail(f"{name} {n}: skip=True changed params")
+        import jax
+        for a, b in zip(jax.tree_util.tree_leaves(ss),
+                        jax.tree_util.tree_leaves(states)):
+            if not np.array_equal(np.asarray(a), np.asarray(b)):
+                fail(f"{name}: skip=True changed optimizer state")
+        print(f"  {name}: skip guard bit-identical")
+
+
+def autotune_round():
+    from mxnet_tpu import telemetry as tele
+    from mxnet_tpu.ops.pallas import autotune as at
+
+    shapes, dtype = (64, 128), "float32"
+
+    def hits():
+        return tele.counter("autotune_hits").value()
+
+    cold = at.tune("fused_norm", shapes, dtype, warmup=1, runs=2, top_k=2)
+    if cold.cache_hit or cold.trials < 1:
+        fail(f"cold tune was not a search: {cold}")
+    path = os.path.join(_CACHE, "autotune_fused_norm.json")
+    if not os.path.exists(path):
+        fail(f"no persisted config at {path}")
+
+    h0 = hits()
+    warm = at.tune("fused_norm", shapes, dtype)
+    if not warm.cache_hit or warm.trials != 0:
+        fail(f"warm tune re-searched: {warm}")
+    if hits() != h0 + 1:
+        fail(f"autotune_hits did not increment ({h0} -> {hits()})")
+
+    # fresh memory cache -> the DISK entry alone must serve the key
+    at.clear_memory_cache()
+    disk = at.tune("fused_norm", shapes, dtype)
+    if not disk.cache_hit or disk.trials != 0:
+        fail(f"disk warm start re-searched: {disk}")
+    if at.cached_config("fused_norm", shapes, dtype) is None:
+        fail("cached_config lookup missed after disk reload")
+    print(f"  autotune: cold search {cold.trials} trials "
+          f"({cold.search_ms:.0f}ms), warm + disk hits with 0 trials, "
+          f"config at {path}")
+
+
+def main():
+    print("kernels-smoke: parity sweep (Pallas interpreter vs jnp "
+          "references)")
+    print("fused_norm:")
+    norm_sweep()
+    print("moe_dispatch:")
+    moe_sweep()
+    print("fused_optimizer:")
+    optimizer_sweep()
+    print("autotune:")
+    autotune_round()
+    print("kernels-smoke: OK")
+
+
+if __name__ == "__main__":
+    main()
