@@ -45,7 +45,7 @@ if _os.environ.get("MXTPU_ENABLE_X64", "").lower() in ("1", "true", "on"):
     del _jax
 del _os
 
-from .base import MXNetError  # noqa: F401
+from .base import MXNetError, SuspectedHostLoss  # noqa: F401
 from . import device  # noqa: F401
 from .device import (  # noqa: F401
     Device, Context, cpu, gpu, tpu, cpu_pinned,

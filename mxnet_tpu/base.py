@@ -10,12 +10,22 @@ from __future__ import annotations
 import os
 from typing import Any, Callable, Dict, Generic, Optional, Type, TypeVar
 
-__all__ = ["MXNetError", "Registry", "getenv_bool", "getenv_int",
-           "classproperty", "check_x64_dtype"]
+__all__ = ["MXNetError", "SuspectedHostLoss", "Registry", "getenv_bool",
+           "getenv_int", "classproperty", "check_x64_dtype"]
 
 
 class MXNetError(RuntimeError):
     """Error raised by the framework (parity: dmlc::Error / MXNetError)."""
+
+
+class SuspectedHostLoss(MXNetError):
+    """A bounded multi-host coordination round (flag sync, step consensus,
+    membership) timed out: the most likely cause is a peer host that died
+    or was preempted mid-collective.  Subclasses `MXNetError` so existing
+    die-and-restart handling still applies, but carries the *diagnosis* —
+    the elastic mesh-reformation layer (`parallel.elastic_mesh`) catches
+    this to re-form the mesh at the surviving size instead of restarting
+    the whole job."""
 
 
 def check_x64_dtype(dtype) -> None:

@@ -40,12 +40,12 @@ import jax
 from . import health as _health
 from . import recovery as _recovery
 from . import telemetry as _tele
-from .base import MXNetError
-from .resilience import fault_point, retry_with_backoff
+from .base import MXNetError, SuspectedHostLoss
+from .resilience import fault_point
 from .utils.checkpoint import CheckpointManager
 
 __all__ = ["PreemptionGuard", "Watchdog", "FailureInjector", "ElasticLoop",
-           "sync_flag"]
+           "sync_flag", "sync_flags"]
 
 _log = logging.getLogger(__name__)
 
@@ -361,12 +361,20 @@ def sync_flag(flag: bool) -> bool:
     return sync_flags(flag)[0]
 
 
-def sync_flags(*flags: bool) -> tuple:
+def sync_flags(*flags: bool, timeout: Optional[float] = None) -> tuple:
     """OR-reduce several booleans across all processes in ONE allgather
     (same collective, retry policy, and failure semantics as
     `sync_flag`).  The recovery-enabled loop syncs its preemption, exit,
     and rollback decisions per iteration — packing them keeps that at a
-    single host-coordination round-trip instead of three."""
+    single host-coordination round-trip instead of three.
+
+    The collective is **timeout-bounded** (default
+    ``MXTPU_ELASTIC_SYNC_TIMEOUT``, 120 s; 0 disables): a peer that died
+    before entering the round used to stall every surviving host until
+    the hang watchdog noticed — now the stall surfaces as
+    `SuspectedHostLoss`, the signal the elastic mesh-reformation layer
+    (`parallel.elastic_mesh`) consumes to re-form the mesh at the
+    surviving size instead of restarting the job."""
     if jax.process_count() == 1:
         return tuple(bool(f) for f in flags)
     import jax.numpy as jnp
@@ -381,11 +389,25 @@ def sync_flags(*flags: bool) -> tuple:
         v = v.reshape(-1, len(flags))
         return tuple(bool(x) for x in v.max(axis=0))
 
+    if timeout is None:
+        timeout = _recovery.sync_timeout()
     try:
-        return retry_with_backoff(_gather, retries=_SYNC_RETRIES,
-                                  base_delay=_SYNC_BASE_DELAY,
-                                  retry_on=(RuntimeError, OSError))
+        # each retry attempt runs on its own bounded worker thread
+        # (recovery.coordinated_round): a dead peer never ANSWERS the
+        # collective, so the bound has to come from outside it
+        return _recovery.coordinated_round(
+            _gather, timeout=timeout, name="mxtpu-flag-sync",
+            retries=_SYNC_RETRIES, base_delay=_SYNC_BASE_DELAY,
+            timeout_msg=
+            f"elastic.sync_flags: multi-host flag sync did not "
+            f"complete within {timeout or 0:g}s — a peer host is "
+            f"suspected lost.  Attach an ElasticMeshController "
+            f"(parallel.elastic_mesh) to re-form the mesh at the "
+            f"surviving size, or restart the job and resume from the "
+            f"newest checkpoint")
     except (RuntimeError, OSError) as e:
+        if isinstance(e, SuspectedHostLoss):
+            raise
         raise MXNetError(
             f"elastic.sync_flag: multi-host allgather failed after "
             f"{_SYNC_RETRIES} retries ({e}); hosts cannot agree on a "
@@ -424,6 +446,15 @@ class ElasticLoop:
     `prefetcher` (optional): a `DevicePrefetcher` the preemption path
     cancels and the rollback path fast-forwards (`data_skip` overrides
     the per-step fast-forward hook).
+
+    `mesh_controller` (optional): a
+    `parallel.elastic_mesh.ElasticMeshController` — topology changes
+    (host loss, host join, planned drains) are consumed between steps:
+    the mesh re-forms at the new device count, live state is re-sharded
+    (or, after a host loss, restored from the multi-host agreed
+    checkpoint step), and the loop continues WITHOUT a process restart.
+    A `SuspectedHostLoss` raised by the per-iteration flag sync feeds
+    the same path.
     """
 
     def __init__(self, target, directory: str, save_every: int = 100,
@@ -434,7 +465,8 @@ class ElasticLoop:
                  async_save: bool = False,
                  recovery=None, prefetcher=None,
                  preempt_grace: Optional[float] = None,
-                 data_skip: Optional[Callable[[int], None]] = None):
+                 data_skip: Optional[Callable[[int], None]] = None,
+                 mesh_controller=None):
         self.target = target
         self.manager = CheckpointManager(directory, keep=keep)
         self.save_every = save_every
@@ -458,6 +490,13 @@ class ElasticLoop:
         if data_skip is None and prefetcher is not None:
             data_skip = lambda _step: prefetcher.skip(1)  # noqa: E731
         self.data_skip = data_skip
+        # elastic mesh reformation (parallel.elastic_mesh): topology
+        # changes are consumed between steps like recovery remediations;
+        # the controller's host-loss restore path rides this loop's own
+        # checkpoint manager unless the caller wired a different one
+        self.mesh_controller = mesh_controller
+        if mesh_controller is not None and mesh_controller.manager is None:
+            mesh_controller.manager = self.manager
         # step ids (1-based, = the monitor's/journal's step-id space) the
         # post-rollback replay fast-forwards over.  The spaces stay
         # aligned across rollbacks because the dispatch counter is
@@ -601,6 +640,32 @@ class ElasticLoop:
             if discarded else "")
         return restored
 
+    def _perform_reform(self, change, current: int) -> int:
+        """Execute one topology change (host loss / join / planned
+        drain) via the attached `ElasticMeshController` and return the
+        step to resume from — live reshards resume where they left off,
+        loss reforms at the multi-host agreed checkpoint step."""
+        self._drain_async_tolerant()
+        resume = self.mesh_controller.reform(change, current)
+        _tele.event("remediation", step=resume, kind="mesh_reform",
+                    reason=change.reason, tier=0, from_step=current)
+        return resume
+
+    def _on_suspected_loss(self, exc: SuspectedHostLoss,
+                           current: int) -> int:
+        """A bounded coordination round timed out mid-loop.  With a mesh
+        controller attached the suspicion becomes a topology change
+        (stale-heartbeat hosts are declared lost) and the loop re-forms;
+        without one — or when no host can be blamed — the exception
+        propagates and the job dies for a classic full restart."""
+        if self.mesh_controller is None:
+            raise exc
+        self.mesh_controller.note_suspected_loss(exc=exc)
+        change = self.mesh_controller.poll()
+        if change is None:
+            raise exc
+        return self._perform_reform(change, current)
+
     def run(self, step_fn: Callable[[int], object], total_steps: int,
             on_step: Optional[Callable[[int, object], None]] = None) -> dict:
         restores = 0       # total, reported in the result
@@ -630,19 +695,32 @@ class ElasticLoop:
                         # retire on host-local timing, budget windows are
                         # host-local wall-clock), so on multi-host meshes
                         # ALL of them — preemption, tier-3 exit, tier-2
-                        # rollback — are OR-reduced in one packed
-                        # collective before anyone acts: a host entering
-                        # agree_step (or returning) while a peer sits in
-                        # the next iteration's flag sync would mismatch
-                        # collective program order and wedge the fleet.
+                        # rollback, AND a pending topology change — are
+                        # OR-reduced in one packed collective before
+                        # anyone acts: a host entering reform()'s
+                        # membership round (or agree_step, or returning)
+                        # while a peer sits in this iteration's flag sync
+                        # would mismatch collective program order and
+                        # wedge the fleet.  A dead peer never enters the
+                        # sync at all — that surfaces as the bounded
+                        # round's SuspectedHostLoss below, the already-
+                        # coordinated-by-failure path into a reform
                         action = (self.recovery.poll()
                                   if self.recovery is not None else None)
                         want_exit = (action is not None
                                      and action["kind"] == "exit")
                         want_rb = (action is not None
                                    and action["kind"] == "rollback")
-                        preempted, want_exit, want_rb = sync_flags(
-                            guard.preempted, want_exit, want_rb)
+                        want_reform = (self.mesh_controller is not None
+                                       and self.mesh_controller
+                                       .has_pending())
+                        try:
+                            preempted, want_exit, want_rb, want_reform = \
+                                sync_flags(guard.preempted, want_exit,
+                                           want_rb, want_reform)
+                        except SuspectedHostLoss as e:
+                            i = self._on_suspected_loss(e, i)
+                            continue
                         if preempted:
                             self._drain_async_tolerant()
                             info = guard.emergency_checkpoint(
@@ -672,8 +750,32 @@ class ElasticLoop:
                                           "tier": 2, "step": i}
                             restores += 1
                             rollbacks += 1
-                            i = self._perform_rollback(action, i,
-                                                       restores)
+                            try:
+                                i = self._perform_rollback(action, i,
+                                                           restores)
+                            except SuspectedHostLoss as e:
+                                # a peer died mid-rollback-consensus:
+                                # same conversion as the flag sync —
+                                # reform at the surviving size when a
+                                # stale heartbeat names the culprit
+                                i = self._on_suspected_loss(e, i)
+                            continue
+                        if want_reform:
+                            change = (self.mesh_controller.poll()
+                                      if self.mesh_controller is not None
+                                      else None)
+                            if change is not None:
+                                i = self._perform_reform(change, i)
+                            else:
+                                # a PEER reported the pending change;
+                                # its reform()'s membership round is the
+                                # coordination point (and, on a real
+                                # cross-process loss, the documented
+                                # fast-fail into a restart)
+                                _log.warning(
+                                    "elastic: peer host reported a "
+                                    "pending topology change; no local "
+                                    "change to apply")
                             continue
                         if self._replay_skip and (i + 1) in \
                                 self._replay_skip:
@@ -747,7 +849,9 @@ class ElasticLoop:
         final = self.manager.save(self.target, total_steps)
         return {"status": "completed", "step": total_steps,
                 "checkpoint": final, "restores": restores,
-                "rollbacks": rollbacks, "loss": last_loss}
+                "rollbacks": rollbacks, "loss": last_loss,
+                "reforms": (self.mesh_controller.reforms
+                            if self.mesh_controller is not None else 0)}
 
     def _tier3_exit(self, action: dict, step: int, restores: int) -> dict:
         """Tier-3 remediation: the rollback budget is exhausted — flush a

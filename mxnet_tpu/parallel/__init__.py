@@ -12,9 +12,12 @@ import os
 
 import jax
 
-from .mesh import make_mesh, auto_mesh, MeshConfig, Mesh, NamedSharding, PartitionSpec
+from .mesh import (make_mesh, auto_mesh, fit_axes, MeshConfig, Mesh,
+                   NamedSharding, PartitionSpec)
 from .sharding import (ShardingRules, default_tp_rules, param_sharding,
-                       shard_parameter_tree, replicated)
+                       shard_parameter_tree, replicated, retarget_spec)
+from .elastic_mesh import (ElasticMeshController, TopologyChange,
+                           member_sync)
 from . import collectives
 from .collectives import (allreduce, allgather, reduce_scatter, broadcast,
                           ppermute_shift, all_to_all)
@@ -27,9 +30,12 @@ from .prefetch import (DevicePrefetcher, AsyncMetricBuffer,
                        default_prefetch_depth)
 
 __all__ = [
-    "make_mesh", "auto_mesh", "MeshConfig", "Mesh", "NamedSharding",
+    "make_mesh", "auto_mesh", "fit_axes", "MeshConfig", "Mesh",
+    "NamedSharding",
     "PartitionSpec", "ShardingRules", "default_tp_rules", "param_sharding",
-    "shard_parameter_tree", "replicated", "collectives", "allreduce",
+    "shard_parameter_tree", "replicated", "retarget_spec",
+    "ElasticMeshController", "TopologyChange", "member_sync",
+    "collectives", "allreduce",
     "allgather", "reduce_scatter", "broadcast", "ppermute_shift", "all_to_all",
     "ring_attention", "ring_attention_sharded", "ulysses_attention",
     "ulysses_attention_sharded", "MoEFeedForward", "switch_moe",

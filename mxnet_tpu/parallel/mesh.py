@@ -21,10 +21,32 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from ..base import MXNetError
 
 __all__ = ["make_mesh", "auto_mesh", "MeshConfig", "Mesh", "NamedSharding",
-           "shard_map_nocheck",
+           "shard_map_nocheck", "fit_axes",
            "PartitionSpec"]
 
 AXES = ("dp", "sp", "tp", "pp", "ep")
+
+
+def fit_axes(n_devices: int, tp: int = 1, sp: int = 1, pp: int = 1,
+             ep: int = 1) -> Dict[str, int]:
+    """Clamp a model-axis plan to a (possibly changed) device count —
+    the elastic-reform companion to `auto_mesh`: each requested model
+    axis is reduced to its largest divisor compatible with the devices
+    that remain (gcd), claimed in tp → sp → pp → ep order, and dp
+    absorbs whatever is left.  ``fit_axes(4, tp=2)`` keeps tp=2 with
+    dp=2; ``fit_axes(3, tp=2)`` degrades to tp=1, dp=3 — the mesh
+    re-forms at ANY surviving device count instead of refusing."""
+    import math
+    out: Dict[str, int] = {}
+    rem = int(n_devices)
+    if rem < 1:
+        raise MXNetError(f"fit_axes needs >= 1 device, got {n_devices}")
+    for name, want in (("tp", tp), ("sp", sp), ("pp", pp), ("ep", ep)):
+        got = math.gcd(max(int(want), 1), rem)
+        out[name] = got
+        rem //= got
+    out["dp"] = rem
+    return out
 
 
 class MeshConfig:

@@ -16,11 +16,28 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["ShardingRules", "default_tp_rules", "param_sharding",
-           "shard_parameter_tree", "replicated"]
+           "shard_parameter_tree", "replicated", "retarget_spec"]
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
+
+
+def retarget_spec(spec: PartitionSpec, mesh: Mesh) -> PartitionSpec:
+    """Re-target a `PartitionSpec` at a (possibly differently-shaped)
+    mesh: axes the new mesh doesn't carry are dropped element-wise, so
+    the same logical spec degrades gracefully when the mesh shrinks
+    (e.g. ``P('dp', 'sp')`` on a dp-only mesh becomes ``P('dp', None)``).
+    The elastic reshard path uses this for caller-supplied batch specs —
+    rule-derived shardings re-run `ShardingRules.sharding_for` instead."""
+    names = set(mesh.axis_names)
+    clean = []
+    for a in spec:
+        axes = (a,) if isinstance(a, str) else tuple(a or ())
+        kept = tuple(ax for ax in axes if ax in names)
+        clean.append(kept[0] if len(kept) == 1
+                     else (kept if kept else None))
+    return PartitionSpec(*clean)
 
 
 class ShardingRules:
@@ -43,17 +60,7 @@ class ShardingRules:
         spec = self.spec_for(name, shape)
         # drop axes not present in the mesh (tuple entries element-wise:
         # a partial match keeps only the mesh's axes)
-        names = set(mesh.axis_names)
-        clean = []
-        for a in spec:
-            if a is None or (isinstance(a, str) and a in names):
-                clean.append(a)
-            elif isinstance(a, str):
-                clean.append(None)
-            else:  # tuple of axes
-                kept = tuple(ax for ax in a if ax in names)
-                clean.append(kept if len(kept) > 1 else
-                             (kept[0] if kept else None))
+        clean = list(retarget_spec(spec, mesh))
         # a dim the mesh axes don't divide evenly falls back to replicated
         # (e.g. an odd vocab over tp=2) instead of crashing at device_put
         if shape is not None:

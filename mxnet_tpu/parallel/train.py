@@ -98,7 +98,6 @@ class ShardedTrainStep:
         self.fsdp = fsdp
         if fsdp:
             self.zero = True
-        self._zero_warned = set()
         # accumulate gradients over this many microbatches per step (the
         # global batch splits on its leading dim; must divide it)
         if grad_accum < 1:
@@ -114,6 +113,10 @@ class ShardedTrainStep:
         self.mesh = mesh
         self.rules = rules or default_tp_rules()
         self.batch_specs = batch_specs
+        # the caller's ORIGINAL specs: reshard re-targets from these, so
+        # a shrink that drops an axis doesn't ratchet the spec toward
+        # replicated when the mesh later grows the axis back
+        self._orig_batch_specs = batch_specs
         self._step_fn = None
         self._n_batch_args = None
         self._build_lock = threading.Lock()
@@ -174,13 +177,21 @@ class ShardedTrainStep:
         self.pvals = {n: _put_global(params[n]._data._data,
                                      self.param_shardings[n])
                       for n in self.param_names}
+        # optimizer state: each leaf shards like its parameter, ZeRO adds
+        # a 'dp' axis where a dim allows it, and leaves with NO free
+        # divisible dim (bias/scale vectors whose only dim is already
+        # tp-sharded) are stored as a flattened dp-sharded BUCKET instead
+        # of silently replicating (see _state_placement)
+        self._state_buckets: Dict[str, Dict[int, Tuple[Tuple[int, ...],
+                                                       int]]] = {}
         self.opt_state = {
-            n: jax.tree_util.tree_map(
-                lambda s, _n=n: _put_global(s, self._state_sharding(
-                    self.param_shardings[_n], s, params[_n])),
-                optimizer.create_state_jax(_master_dtype(self.pvals[n])))
+            n: self._place_state_tree(
+                n, optimizer.create_state_jax(_master_dtype(self.pvals[n])))
             for n in self.diff_names}
         self._t = 0
+        # True when batch specs are derived (and re-derived on reshard)
+        # from the mesh axes rather than caller-supplied
+        self._auto_batch_specs = batch_specs is None
         # fused-optimizer route (captured ONCE, like the probes: the
         # choice is baked into the traced program, so flipping
         # MXTPU_PALLAS mid-run can never retrace a live step)
@@ -213,29 +224,87 @@ class ShardedTrainStep:
         ns = _with_dp_axis(self.mesh, sharding.spec, param.shape)
         return ns if ns is not None else sharding
 
-    def _state_sharding(self, param_sharding, state_leaf, param):
-        """Placement for one optimizer-state leaf: like the parameter —
-        plus, under ZeRO, the first unsharded divisible dim spread over
-        'dp' (the reduce-scatter/all-gather pattern XLA then emits is
-        exactly ZeRO stage 1)."""
+    def _state_placement(self, name, state_leaf):
+        """``(sharding, bucket)`` for one optimizer-state leaf: like the
+        parameter — plus, under ZeRO, the first unsharded divisible dim
+        spread over 'dp' (the reduce-scatter/all-gather pattern XLA then
+        emits is exactly ZeRO stage 1).
+
+        When no dim can take the 'dp' axis (the 1-D gap the MULTICHIP
+        logs showed: bias/scale vectors whose only dim is already
+        tp-sharded, or dims dp doesn't divide), the leaf is stored as a
+        **flattened concatenation bucket**: raveled, zero-padded to a
+        multiple of dp, and sharded ``P('dp')``.  ``bucket`` is then
+        ``(logical_shape, padded_size)``; the jitted step unpacks the
+        logical view before the optimizer rule and repacks after, and
+        checkpoints always store the logical (unpadded) value so the
+        format stays topology-agnostic.  Scalars stay replicated (nothing
+        to shard)."""
+        param_sharding = self.param_shardings[name]
+        param = self.params[name]
         base = _like_sharding(param_sharding, state_leaf, param)
         if not self.zero or "dp" not in self.mesh.axis_names:
-            return base
-        shape = getattr(state_leaf, "shape", ())
+            return base, None
+        shape = tuple(getattr(state_leaf, "shape", ()))
         ns = _with_dp_axis(self.mesh, base.spec, shape)
         if ns is not None:
-            return ns
-        key = (tuple(param.shape), tuple(shape))
-        if "dp" not in _spec_axes(base.spec) and shape \
-                and self.mesh.shape["dp"] > 1 \
-                and key not in self._zero_warned:
-            self._zero_warned.add(key)
-            _log.warning(
-                "zero=True: optimizer-state leaf %s for parameter of "
-                "shape %s cannot shard over dp=%d (no free divisible "
-                "dim); it stays replicated", tuple(shape),
-                tuple(param.shape), self.mesh.shape["dp"])
-        return base
+            return ns, None
+        dp = dict(self.mesh.shape).get("dp", 1)
+        if dp > 1 and shape and "dp" not in _spec_axes(base.spec):
+            size = int(onp.prod(shape))
+            padded = -(-size // dp) * dp
+            return (NamedSharding(self.mesh, P("dp")),
+                    (tuple(int(d) for d in shape), padded))
+        return base, None
+
+    def _place_state_tree(self, name, tree):
+        """Device-place one parameter's optimizer-state tree (logical
+        leaves), recording bucket metadata and packing bucketed leaves."""
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        buckets: Dict[int, Tuple[Tuple[int, ...], int]] = {}
+        placed = []
+        for i, leaf in enumerate(leaves):
+            sharding, bucket = self._state_placement(name, leaf)
+            if bucket is not None:
+                buckets[i] = bucket
+                leaf = _pack_bucket(leaf, bucket)
+            placed.append(_put_global(leaf, sharding))
+        self._state_buckets[name] = buckets
+        return jax.tree_util.tree_unflatten(treedef, placed)
+
+    def _unpack_state_tree(self, name, tree):
+        """Bucketed (packed) leaves -> logical shapes.  jit-safe: slices
+        and reshapes trace into the step program."""
+        buckets = self._state_buckets.get(name)
+        if not buckets:
+            return tree
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        for i, (shape, _padded) in buckets.items():
+            size = int(onp.prod(shape)) if shape else 1
+            leaves[i] = leaves[i][:size].reshape(shape)
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def _pack_state_tree(self, name, tree, constrain=False):
+        """Logical leaves -> packed dp-sharded buckets (inverse of
+        `_unpack_state_tree`).  `constrain=True` adds a sharding
+        constraint inside jit so GSPMD keeps the bucket on 'dp'."""
+        buckets = self._state_buckets.get(name)
+        if not buckets:
+            return tree
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        for i, bucket in buckets.items():
+            leaf = _pack_bucket(leaves[i], bucket)
+            if constrain:
+                leaf = jax.lax.with_sharding_constraint(
+                    leaf, NamedSharding(self.mesh, P("dp")))
+            leaves[i] = leaf
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def _logical_state_leaves(self, name):
+        """The flat leaf list of `opt_state[name]` with bucketed leaves
+        unpacked to their logical shapes — what checkpoints store."""
+        return jax.tree_util.tree_leaves(
+            self._unpack_state_tree(name, self.opt_state[name]))
 
     def _resolve_sharding(self, name: str, param) -> NamedSharding:
         mesh = self.mesh
@@ -253,27 +322,19 @@ class ShardedTrainStep:
                     f"{len(param.shape)} (shape {tuple(param.shape)})")
             names = set(mesh.axis_names)
             from .mesh import AXES as _KNOWN_AXES
-            cleaned = []
+            from .sharding import retarget_spec
             for a in spec:
-                axes = (a,) if isinstance(a, str) else tuple(a or ())
-                kept = []
-                for ax in axes:
-                    if ax in names:
-                        kept.append(ax)
-                    elif ax in _KNOWN_AXES:
-                        # a standard parallelism axis this mesh runs at
-                        # size 1 (make_mesh drops those): the annotation
-                        # degrades to replicated on that axis, so the same
-                        # model code works when the mesh shrinks
-                        continue
-                    else:
+                for ax in ((a,) if isinstance(a, str) else tuple(a or ())):
+                    # a standard parallelism axis this mesh runs at size 1
+                    # (make_mesh drops those) degrades to replicated via
+                    # retarget_spec, so the same model code works when the
+                    # mesh shrinks; anything else is a typo
+                    if ax not in names and ax not in _KNOWN_AXES:
                         raise MXNetError(
                             f"parameter {name}: sharding annotation names "
                             f"mesh axis {ax!r} but this mesh has axes "
                             f"{sorted(names)}")
-                cleaned.append(kept[0] if len(kept) == 1
-                               else (tuple(kept) if kept else None))
-            spec = P(*cleaned)
+            spec = retarget_spec(spec, mesh)
             return self._maybe_fsdp(NamedSharding(mesh, spec), param)
         sharding = self.rules.sharding_for(mesh, name, param.shape)
         # 'dp' replicates params by design; 'sp' shards activations, never
@@ -427,10 +488,16 @@ class ShardedTrainStep:
             # exact semantics the former inline ladder had (dtype
             # cast-backs included — donation still never retraces)
             new_p = dict(pvals)
+            # ZeRO 1-D buckets: the rule sees logical shapes; the packed
+            # dp-sharded representation is storage-only
+            states = {n: outer._unpack_state_tree(n, opt_state[n])
+                      for n in diff_names}
             upd_p, new_s = _fused_opt.apply_updates(
                 optimizer, {n: pvals[n] for n in diff_names}, grads,
-                {n: opt_state[n] for n in diff_names}, hp, skip,
+                states, hp, skip,
                 use_kernel=outer._fused_opt_kernel)
+            new_s = {n: outer._pack_state_tree(n, new_s[n], constrain=True)
+                     for n in new_s}
             new_p.update(upd_p)
             if skip is not None:
                 aux = {k: jnp.where(skip, pvals[k], v) if k in pvals else v
@@ -441,11 +508,12 @@ class ShardedTrainStep:
             return new_p, new_s, loss
 
         pspec = {n: self.param_shardings[n] for n in self.param_names}
+        # state shardings come straight off the placed arrays — the
+        # single source of truth `_place_state_tree` established (bucket
+        # leaves carry their packed P('dp') sharding)
         sspec = {
-            n: jax.tree_util.tree_map(
-                lambda s, _n=n: self._state_sharding(
-                    self.param_shardings[_n], s, self.params[_n]),
-                self.opt_state[n])
+            n: jax.tree_util.tree_map(lambda x: x.sharding,
+                                      self.opt_state[n])
             for n in self.diff_names}
         repl = NamedSharding(mesh, P())
         out_shardings = (pspec, sspec, repl)
@@ -886,10 +954,13 @@ class ShardedTrainStep:
         from .. import random as _rng
         g = _rng.generator
         dup = (lambda x: jnp.copy(x)) if copy else (lambda x: x)
+        # bucketed ZeRO leaves are snapshotted at their LOGICAL (unpadded)
+        # shape, so the checkpoint format is topology-agnostic: the same
+        # file restores under any mesh/dp (load re-packs for its layout)
         return {
             "pvals": {n: dup(v) for n, v in self.pvals.items()},
             "opt_state": {n: [dup(leaf) for leaf in
-                              jax.tree_util.tree_leaves(self.opt_state[n])]
+                              self._logical_state_leaves(n)]
                           for n in self.diff_names},
             "t": self._t,
             "rng_seed": g._seed,
@@ -942,6 +1013,7 @@ class ShardedTrainStep:
                                              self.param_shardings[n])
         for n in self.diff_names:
             leaves, treedef = jax.tree_util.tree_flatten(self.opt_state[n])
+            buckets = self._state_buckets.get(n, {})
             new_leaves = []
             for i, old in enumerate(leaves):
                 key = f"s:{n}:{i}"
@@ -955,8 +1027,16 @@ class ShardedTrainStep:
                 # bf16 m/v back onto a step compiled for fp32 state
                 if hasattr(old, "dtype") and val.dtype != old.dtype:
                     val = val.astype(old.dtype)
-                sharding = self._state_sharding(self.param_shardings[n],
-                                                val, self.params[n])
+                # checkpoints store the LOGICAL value; this step's layout
+                # decides the on-device representation — so a file written
+                # under any topology restores under this one
+                bucket = buckets.get(i)
+                if bucket is not None:
+                    val = onp.asarray(_pack_bucket(onp.asarray(val),
+                                                   bucket))
+                    sharding = NamedSharding(self.mesh, P("dp"))
+                else:
+                    sharding, _ = self._state_placement(n, val)
                 new_leaves.append(_shard_from_host(val, sharding))
             self.opt_state[n] = jax.tree_util.tree_unflatten(
                 treedef, new_leaves)
@@ -970,6 +1050,116 @@ class ShardedTrainStep:
             # (possibly advanced) key so draws restart from PRNGKey(seed)
             g._key = None
         self.sync_params_to_block()
+
+    # -- elastic mesh reformation ----------------------------------------
+
+    def topology(self) -> dict:
+        """Topology descriptor stamped into checkpoint manifests (and
+        compared by `CheckpointManager.restore` to announce a
+        topology-agnostic restore): device count + named axis sizes."""
+        return {"devices": int(self.mesh.size),
+                "axes": {str(k): int(v)
+                         for k, v in dict(self.mesh.shape).items()},
+                "processes": int(jax.process_count())}
+
+    def reshard(self, new_mesh: Mesh, rules=None,
+                gather: bool = True) -> None:
+        """Re-form this step onto `new_mesh` IN PLACE — the elastic
+        mesh-reformation primitive (`parallel.elastic_mesh`): the
+        Trainer / model / optimizer objects survive, only the device
+        layout and the compiled executable change.
+
+        1. drains in-flight dispatched steps and any async checkpoint
+           write (donated buffers must settle before re-placement),
+        2. with ``gather=True`` gathers the FULL param + optimizer-state
+           tree to host (fault point ``reshard_gather``; bucketed ZeRO
+           leaves are unpacked to their logical shapes first),
+        3. swaps the mesh, re-runs `ShardingRules`/annotations against
+           the new axes (`auto_mesh` dp absorption happened in the
+           caller's mesh build; ZeRO dp-axis augments and 1-D buckets
+           are re-planned for the new dp), re-derives auto batch specs,
+           and re-places the state,
+        4. resets the compiled-step state — ``trace_count`` restarts at 0
+           so the first dispatch on the new topology traces exactly
+           once; the AOT executable, aval guard, and device-resident hp
+           cache are dropped (they referenced the old devices).
+
+        ``gather=False`` is the **host-loss** path: a dead host's shards
+        cannot be gathered, so placements/buckets are re-planned but the
+        live values are left stale — the caller MUST restore a
+        checkpoint into the step before dispatching (the
+        topology-agnostic `load` re-places every array)."""
+        from ..resilience import fault_point
+        fault_point("mesh_reform")
+        self.drain()
+        self._drain_async_save()
+        host_p = host_s = None
+        if gather:
+            fault_point("reshard_gather")
+            host_p = {n: onp.asarray(_gather_to_host(v))
+                      for n, v in self.pvals.items()}
+            host_s = {n: [onp.asarray(_gather_to_host(leaf))
+                          for leaf in self._logical_state_leaves(n)]
+                      for n in self.diff_names}
+        old_axes = {k: int(v) for k, v in dict(self.mesh.shape).items()}
+        self.mesh = new_mesh
+        if rules is not None:
+            self.rules = rules
+        if self._auto_batch_specs:
+            self.batch_specs = None      # re-derived for the new axes
+        elif self._orig_batch_specs is not None:
+            from .sharding import retarget_spec
+            self.batch_specs = tuple(
+                retarget_spec(s, new_mesh)
+                for s in self._orig_batch_specs)
+        self.param_shardings = {
+            n: self._resolve_sharding(n, self.params[n])
+            for n in self.param_names}
+        if gather:
+            self.pvals = {
+                n: _shard_from_host(host_p[n], self.param_shardings[n])
+                for n in self.param_names}
+            new_state = {}
+            for n in self.diff_names:
+                _, treedef = jax.tree_util.tree_flatten(self.opt_state[n])
+                new_state[n] = self._place_state_tree(
+                    n, jax.tree_util.tree_unflatten(treedef, host_s[n]))
+            self.opt_state = new_state
+        else:
+            self._replan_state_buckets()
+        # compiled-step reset: everything tied to the old topology
+        self._step_fn = None
+        self._exec = None
+        self._trace_count = 0
+        self._trace_avals = None
+        self._hp_cache = HpScalarCache()
+        self._t_dev = None
+        self._t_mirror = -1
+        self.compile_seconds = None
+        self._fused_opt_kernel = self._resolve_fused_kernel()
+        if gather:
+            self.sync_params_to_block()
+        if _tele.enabled():
+            _tele.event("mesh_reshard", step=self._t, gather=gather,
+                        old_axes=old_axes,
+                        new_axes=self.topology()["axes"])
+
+    def _replan_state_buckets(self) -> None:
+        """Recompute bucket metadata for the current mesh WITHOUT moving
+        data (the gather=False reshard): logical shapes come from the
+        old bucket records / leaf shapes, so the following `load` places
+        every leaf correctly for the new dp."""
+        for n in self.diff_names:
+            leaves, _ = jax.tree_util.tree_flatten(self.opt_state[n])
+            old = self._state_buckets.get(n, {})
+            new: Dict[int, Tuple[Tuple[int, ...], int]] = {}
+            for i, leaf in enumerate(leaves):
+                shape = old[i][0] if i in old else tuple(leaf.shape)
+                aval = jax.ShapeDtypeStruct(shape, leaf.dtype)
+                _, bucket = self._state_placement(n, aval)
+                if bucket is not None:
+                    new[i] = bucket
+            self._state_buckets[n] = new
 
 
 class StepHandle:
@@ -1083,6 +1273,24 @@ def _shard_from_host(arr, sharding):
     arr = onp.asarray(arr)
     return jax.make_array_from_callback(arr.shape, sharding,
                                         lambda idx: arr[idx])
+
+
+def _pack_bucket(leaf, bucket):
+    """Flatten + zero-pad a logical optimizer-state leaf into its
+    dp-bucket representation ``(padded_size,)``.  Works on host numpy
+    (checkpoint load) and on traced jax values (inside the step)."""
+    shape, padded = bucket
+    size = int(onp.prod(shape)) if shape else 1
+    if isinstance(leaf, onp.ndarray):
+        flat = leaf.reshape(-1)
+        if padded == size:
+            return flat
+        return onp.concatenate(
+            [flat, onp.zeros(padded - size, leaf.dtype)])
+    flat = jnp.ravel(leaf)
+    if padded == size:
+        return flat
+    return jnp.pad(flat, (0, padded - size))
 
 
 def _with_dp_axis(mesh: Mesh, spec, shape):

@@ -66,10 +66,11 @@ from .resilience import fault_point, retry_with_backoff
 
 __all__ = [
     "RecoveryPolicy", "enabled", "enable", "disable", "skip_enabled",
-    "health_snapshot", "agree_step", "preempt_grace",
+    "health_snapshot", "agree_step", "preempt_grace", "sync_timeout",
+    "bounded_round", "coordinated_round",
     "write_resume_marker", "read_resume_marker", "clear_resume_marker",
     "ENV_ENABLE", "ENV_SKIP_BUDGET", "ENV_ROLLBACK_BUDGET",
-    "ENV_PREEMPT_GRACE", "MARKER_NAME",
+    "ENV_PREEMPT_GRACE", "ENV_SYNC_TIMEOUT", "MARKER_NAME",
 ]
 
 _log = logging.getLogger(__name__)
@@ -78,9 +79,13 @@ ENV_ENABLE = "MXTPU_RECOVERY"
 ENV_SKIP_BUDGET = "MXTPU_SKIP_BUDGET"
 ENV_ROLLBACK_BUDGET = "MXTPU_ROLLBACK_BUDGET"
 ENV_PREEMPT_GRACE = "MXTPU_PREEMPT_GRACE"
+ENV_SYNC_TIMEOUT = "MXTPU_ELASTIC_SYNC_TIMEOUT"
 
 DEFAULT_SKIP_BUDGET = 8
 DEFAULT_ROLLBACK_BUDGET = 2
+#: bound on every multi-host coordination round (flag sync, step
+#: consensus, membership) before a peer is declared suspect
+DEFAULT_SYNC_TIMEOUT = 120.0
 
 #: resumable marker a preemption leaves in the checkpoint directory;
 #: ElasticLoop.run honors (and clears) it on the next start
@@ -112,6 +117,99 @@ def preempt_grace() -> Optional[float]:
         _log.warning("ignoring non-numeric %s=%r", ENV_PREEMPT_GRACE, raw)
         return None
     return val if val > 0 else None
+
+
+def sync_timeout() -> Optional[float]:
+    """``MXTPU_ELASTIC_SYNC_TIMEOUT`` parsed to seconds (default 120):
+    the bound every multi-host coordination round — `elastic.sync_flags`,
+    :func:`agree_step`, `parallel.elastic_mesh.member_sync` — waits
+    before raising `SuspectedHostLoss` instead of stalling forever on a
+    dead peer.  ``0`` (or negative) disables the bound → None (the
+    pre-elastic unbounded behavior)."""
+    raw = os.environ.get(ENV_SYNC_TIMEOUT, "").strip()
+    if not raw:
+        return DEFAULT_SYNC_TIMEOUT
+    try:
+        val = float(raw)
+    except ValueError:
+        _log.warning("ignoring non-numeric %s=%r", ENV_SYNC_TIMEOUT, raw)
+        return DEFAULT_SYNC_TIMEOUT
+    return val if val > 0 else None
+
+
+def bounded_round(fn, timeout: Optional[float], name: str,
+                  timeout_msg: str):
+    """Run one multi-host coordination round with a wall-clock bound:
+    ``fn`` executes on a daemon worker thread and a round still running
+    after ``timeout`` seconds raises `SuspectedHostLoss` with
+    ``timeout_msg`` (``timeout=None`` → run inline, unbounded).  The one
+    shared implementation behind `elastic.sync_flags`, :func:`agree_step`
+    and `parallel.elastic_mesh.member_sync`.
+
+    A FRESH thread per round is deliberate: a dead peer never answers
+    the collective, so after a timeout the stranded worker is still
+    blocked inside it — a reused single-worker executor would queue
+    every later round behind that corpse.  For the same reason ``fn``
+    must be a SINGLE collective attempt, with any retry policy wrapped
+    *around* this call: a stranded worker that kept issuing fresh
+    retried collectives would race the survivor's next round and pair
+    against the wrong collective on the peers.  Exceptions from ``fn``
+    propagate unwrapped so each caller keeps its own error contract."""
+    if timeout is None or timeout <= 0:   # 0 disables, as documented
+        return fn()
+    from .base import SuspectedHostLoss
+    result: dict = {}
+
+    def _run():
+        try:
+            result["value"] = fn()
+        except BaseException as e:  # delivered to the caller below
+            result["error"] = e
+
+    t = threading.Thread(target=_run, daemon=True, name=name)
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        raise SuspectedHostLoss(timeout_msg)
+    if "error" in result:
+        raise result["error"]
+    return result["value"]
+
+
+class _RoundTimeout(BaseException):
+    """Internal carrier lifting a round timeout past retry_with_backoff
+    (which never retries non-Exception BaseExceptions) — a suspected-dead
+    peer must not strand one worker thread per retry attempt."""
+
+    def __init__(self, cause):
+        super().__init__(str(cause))
+        self.cause = cause
+
+
+def coordinated_round(attempt, *, timeout: Optional[float], name: str,
+                      timeout_msg: str, retries: int = 2,
+                      base_delay: float = 0.25):
+    """One retried, timeout-bounded coordination round.  ``attempt`` is
+    a SINGLE collective call: transient failures (RuntimeError/OSError)
+    retry with backoff, each try on its own bounded worker thread
+    (:func:`bounded_round`), while a timeout raises `SuspectedHostLoss`
+    immediately — never retried, and the one stranded attempt issues no
+    further collectives to race the survivor's next round."""
+    def _once():
+        try:
+            return bounded_round(attempt, timeout, name, timeout_msg)
+        except Exception as e:
+            from .base import SuspectedHostLoss as _SHL
+            if isinstance(e, _SHL):
+                raise _RoundTimeout(e) from None
+            raise
+
+    try:
+        return retry_with_backoff(_once, retries=retries,
+                                  base_delay=base_delay,
+                                  retry_on=(RuntimeError, OSError))
+    except _RoundTimeout as t:
+        raise t.cause
 
 
 # ---------------------------------------------------------------------------
@@ -234,60 +332,54 @@ def health_snapshot(step: Optional[int] = None,
 # multi-host rollback consensus
 # ---------------------------------------------------------------------------
 
-def agree_step(step: int, timeout: float = 60.0) -> int:
-    """Agree on a rollback step across all hosts: a timeout-guarded
-    min-reduce over each host's newest-healthy-checkpoint step (built on
-    the same `process_allgather` collective — and the same retry policy —
-    as `elastic.sync_flag`).  The *min* is the safe choice: every host
-    can restore a step it has a checkpoint for, so all hosts restore the
-    same step — or the consensus fails loudly and none do.
+def agree_step(step: int, timeout: Optional[float] = None) -> int:
+    """Agree on a rollback/resume step across all hosts: a
+    timeout-guarded min-reduce over each host's newest-checkpoint step
+    (built on the same `process_allgather` collective — and the same
+    retry policy — as `elastic.sync_flag`).  The *min* is the safe
+    choice: every host can restore a step it has a checkpoint for, so
+    all hosts restore the same step — or the consensus fails loudly and
+    none do.
 
     Single-process: identity.  The collective runs on a worker thread so
-    a peer that died mid-rollback cannot hang the caller forever; on
-    timeout (or exhausted retries) this raises `MXNetError` — the job
-    must die and restart from checkpoints rather than let hosts restore
+    a peer that died mid-rollback cannot hang the caller forever; the
+    default `timeout` is :func:`sync_timeout`
+    (``MXTPU_ELASTIC_SYNC_TIMEOUT``).  On timeout this raises
+    `SuspectedHostLoss` — the elastic mesh-reformation layer catches it
+    to re-form at the surviving size; without that layer the job must
+    die and restart from checkpoints rather than let hosts restore
     different steps and train on silently-diverged replicas."""
     fault_point("consensus_gather")
-    from .base import MXNetError
+    from .base import MXNetError, SuspectedHostLoss
     import jax
     if jax.process_count() == 1:
         return int(step)
+    if timeout is None:
+        timeout = sync_timeout()  # None (env 0) → unbounded, as documented
 
-    result: dict = {}
+    def _reduce():
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+        v = multihost_utils.process_allgather(jnp.asarray([int(step)]))
+        return int(v.min())
 
-    def _gather():
-        try:
-            import jax.numpy as jnp
-            from jax.experimental import multihost_utils
-
-            def _reduce():
-                v = multihost_utils.process_allgather(
-                    jnp.asarray([int(step)]))
-                return int(v.min())
-
-            result["step"] = retry_with_backoff(
-                _reduce, retries=2, base_delay=0.25,
-                retry_on=(RuntimeError, OSError))
-        except BaseException as e:  # delivered to the caller below
-            result["error"] = e
-
-    t = threading.Thread(target=_gather, daemon=True,
-                         name="mxtpu-rollback-consensus")
-    t.start()
-    t.join(timeout)
-    if t.is_alive():
-        raise MXNetError(
+    try:
+        return coordinated_round(
+            _reduce, timeout=timeout, name="mxtpu-rollback-consensus",
+            timeout_msg=
             f"recovery.agree_step: rollback consensus did not complete "
             f"within {timeout}s (a peer is likely down); aborting the "
-            f"rollback — restart the job so every host restores from its "
-            f"newest checkpoint")
-    if "error" in result:
+            f"rollback — re-form the mesh at the surviving size "
+            f"(parallel.elastic_mesh) or restart the job so every host "
+            f"restores from its newest checkpoint")
+    except SuspectedHostLoss:
+        raise
+    except Exception as e:
         raise MXNetError(
             f"recovery.agree_step: rollback consensus failed "
-            f"({result['error']}); hosts cannot agree on a common restore "
+            f"({e}); hosts cannot agree on a common restore "
             f"step — restart the job and resume from the newest "
-            f"checkpoint") from result["error"]
-    return result["step"]
+            f"checkpoint") from e
 
 
 # ---------------------------------------------------------------------------

@@ -87,6 +87,11 @@ class ServeConfig:
         default_factory=lambda: _env_int("MXTPU_SERVE_MAX_LEN", 0))
     kv_dtype: str = field(
         default_factory=lambda: os.environ.get("MXTPU_SERVE_KV_DTYPE", ""))
+    # per-request wall-clock deadline in ms (0 = none): queued/active
+    # requests past it are expired by the scheduler so one stuck or
+    # abandoned client can never pin KV pages forever
+    deadline_ms: int = field(
+        default_factory=lambda: _env_int("MXTPU_SERVE_DEADLINE_MS", 0))
     # engine-wide sampling filter (static: part of the compiled step)
     top_k: int = 0
     top_p: float = 1.0
@@ -249,11 +254,12 @@ class InferenceEngine:
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 20, greedy: bool = True,
                temperature: float = 1.0, eos_token_id=None,
-               on_token=None) -> ServeRequest:
+               on_token=None, deadline_ms=None) -> ServeRequest:
         return self.scheduler.submit(prompt, max_new_tokens,
                                      greedy=greedy, temperature=temperature,
                                      eos_token_id=eos_token_id,
-                                     on_token=on_token)
+                                     on_token=on_token,
+                                     deadline_ms=deadline_ms)
 
     def step(self) -> bool:
         return self.scheduler.step()

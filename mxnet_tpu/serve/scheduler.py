@@ -50,7 +50,8 @@ class ServeRequest:
 
     def __init__(self, prompt, max_new_tokens: int, greedy: bool = True,
                  temperature: float = 1.0, eos_token_id: Optional[int] = None,
-                 on_token: Optional[Callable] = None):
+                 on_token: Optional[Callable] = None,
+                 deadline_ms: float = 0.0):
         self.id = next(_rid)
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
@@ -58,6 +59,8 @@ class ServeRequest:
         self.temperature = float(temperature)
         self.eos_token_id = eos_token_id
         self.on_token = on_token
+        #: wall-clock budget from submit (ms); 0 = unbounded
+        self.deadline_ms = float(deadline_ms or 0.0)
         self.tokens: List[int] = []          # generated so far (streamed)
         self.state = "queued"                # queued|running|finished|failed
         self.evictions = 0
@@ -128,6 +131,7 @@ class ContinuousBatchingScheduler:
         self.max_slots = cfg.max_slots
         self.page_size = cfg.page_size
         self.prefill_chunk = cfg.prefill_chunk
+        self.deadline_ms = float(getattr(cfg, "deadline_ms", 0) or 0)
         self.max_len = engine.max_len
         self.max_pages_per_seq = engine.max_pages_per_seq
         self.allocator = engine.allocator
@@ -140,7 +144,8 @@ class ContinuousBatchingScheduler:
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 20, greedy: bool = True,
                temperature: float = 1.0, eos_token_id=None,
-               on_token=None) -> ServeRequest:
+               on_token=None, deadline_ms: Optional[float] = None
+               ) -> ServeRequest:
         prompt = [int(t) for t in onp.asarray(prompt).reshape(-1)]
         if not prompt:
             raise MXNetError("empty prompt")
@@ -160,7 +165,10 @@ class ContinuousBatchingScheduler:
                 f"{self.allocator.total_pages} — raise MXTPU_SERVE_PAGES")
         req = ServeRequest(prompt, max_new_tokens, greedy=greedy,
                            temperature=temperature,
-                           eos_token_id=eos_token_id, on_token=on_token)
+                           eos_token_id=eos_token_id, on_token=on_token,
+                           deadline_ms=(self.deadline_ms
+                                        if deadline_ms is None
+                                        else deadline_ms))
         with self._lock:
             self._queue.append(req)
         self._telemetry_request(req, "submitted", queued=len(self._queue))
@@ -202,13 +210,18 @@ class ContinuousBatchingScheduler:
                 req, "readmitted" if req.evictions else "admitted",
                 slot=idx, pages=len(pages))
 
+    def _release_slot(self, slot: _Slot) -> None:
+        """Recycle a slot's KV pages and vacate it — the one way any
+        request leaves the active set."""
+        self.allocator.free(slot.pages)
+        self._slots[slot.slot_idx] = None
+
     def _evict(self, slot: _Slot, reason: str) -> None:
         """Recompute-preemption: free the slot's pages, re-queue the
         request at the FRONT with its generated tokens folded into the
         prefix it will re-prefill."""
         req = slot.req
-        self.allocator.free(slot.pages)
-        self._slots[slot.slot_idx] = None
+        self._release_slot(slot)
         req.state = "queued"
         req.evictions += 1
         with self._lock:
@@ -240,9 +253,51 @@ class ContinuousBatchingScheduler:
         return True
 
     # ------------------------------------------------------------------
+    def _expire_deadlines(self) -> None:
+        """Fail every queued/active request past its per-request
+        deadline (``MXTPU_SERVE_DEADLINE_MS`` / ``submit(deadline_ms=)``)
+        and recycle its pages — one stuck or abandoned client must never
+        pin KV pages (or a queue position) forever."""
+        now = time.perf_counter()
+
+        def _expired(req):
+            return req.deadline_ms > 0 and \
+                (now - req.submitted_ts) * 1e3 > req.deadline_ms
+
+        with self._lock:
+            dead = [r for r in self._queue if _expired(r)]
+            if dead:
+                gone = set(id(r) for r in dead)
+                self._queue = deque(r for r in self._queue
+                                    if id(r) not in gone)
+        for req in dead:
+            self._expire_req(req, "queued")
+        expired_active = False
+        for slot in list(self._slots):
+            if slot is not None and _expired(slot.req):
+                self._release_slot(slot)
+                self._expire_req(slot.req, "active")
+                expired_active = True
+        if dead or expired_active:
+            self._update_gauges()
+
+    def _expire_req(self, req: ServeRequest, where: str) -> None:
+        if _tele.enabled():
+            _tele.counter(
+                "serve_deadline_expired_total",
+                "Requests expired past their per-request deadline",
+                labelnames=("where",)).inc(where=where)
+        self._terminate_req(
+            req, f"deadline exceeded ({req.deadline_ms:g} ms) "
+                 f"while {where}",
+            state="expired", phase="deadline_expired", where=where,
+            generated=len(req.tokens), deadline_ms=req.deadline_ms)
+
+    # ------------------------------------------------------------------
     def step(self) -> bool:
         """Run one fused serving step over the active slots.  Returns
         False when there was nothing to do (no actives, empty queue)."""
+        self._expire_deadlines()
         self._admit()
         actives = [s for s in self._slots if s is not None]
         if not actives:
@@ -363,8 +418,7 @@ class ContinuousBatchingScheduler:
         for slot in list(self._slots):
             if slot is None:
                 continue
-            self.allocator.free(slot.pages)
-            self._slots[slot.slot_idx] = None
+            self._release_slot(slot)
             self._fail_req(slot.req, err)
         with self._lock:
             queued, self._queue = list(self._queue), deque()
@@ -373,20 +427,27 @@ class ContinuousBatchingScheduler:
         self._update_gauges()
 
     def _fail_req(self, req: ServeRequest, err: str) -> None:
+        self._terminate_req(req, err, state="failed", phase="failed",
+                            error=err)
+
+    def _terminate_req(self, req: ServeRequest, err: str, *, state: str,
+                       phase: str, **extras) -> None:
+        """Shared terminal path for every non-finished outcome: mark the
+        request failed, count it under its terminal-state label, journal
+        the phase, and unblock the waiter."""
         req.state = "failed"
         req.error = err
         req.finished_ts = time.perf_counter()
         if _tele.enabled():
             _tele.counter("serve_requests_total",
                           "Requests by terminal state",
-                          labelnames=("state",)).inc(state="failed")
-        self._telemetry_request(req, "failed", error=err)
+                          labelnames=("state",)).inc(state=state)
+        self._telemetry_request(req, phase, **extras)
         req._done.set()
 
     def _finish(self, slot: _Slot) -> None:
         req = slot.req
-        self.allocator.free(slot.pages)
-        self._slots[slot.slot_idx] = None
+        self._release_slot(slot)
         req.state = "finished"
         req.finished_ts = time.perf_counter()
         if _tele.enabled():

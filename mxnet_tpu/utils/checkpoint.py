@@ -156,7 +156,8 @@ class CheckpointManager:
         except Exception:
             return None
 
-    def _write_manifest(self, path: str, step: int) -> None:
+    def _write_manifest(self, path: str, step: int,
+                        target=None) -> None:
         """Manifest sidecar for `path` (atomic: tmp + rename). Written
         AFTER the checkpoint rename: a crash in between leaves a valid
         checkpoint that merely verifies as legacy/unmanifested."""
@@ -166,6 +167,17 @@ class CheckpointManager:
         health = self._health_tag(step)
         if health is not None:
             meta["health"] = health
+        # topology descriptor (mesh axis sizes at save time): purely
+        # informational — the restore path is topology-AGNOSTIC because
+        # checkpoints store logical values, but recording the save-time
+        # layout lets restore announce a cross-topology load and lets
+        # tools/diagnose.py show the mesh lineage across elastic reforms
+        topo = getattr(target, "topology", None)
+        if callable(topo):
+            try:
+                meta["topology"] = topo()
+            except Exception:
+                pass
         fd, tmp = tempfile.mkstemp(dir=self.directory,
                                    prefix=f".{self.prefix}-man")
         try:
@@ -244,7 +256,7 @@ class CheckpointManager:
         finally:
             if os.path.exists(tmp):
                 os.unlink(tmp)
-        self._write_manifest(final, step)
+        self._write_manifest(final, step, target)
         self._prune()
         self._note_write(final, step, time.perf_counter() - t0)
         return final
@@ -297,7 +309,7 @@ class CheckpointManager:
             try:
                 f.result()
                 os.replace(tmp, final)
-                self._write_manifest(final, step)
+                self._write_manifest(final, step, target)
                 self._pending_async.discard(final)
                 self._prune()
                 self._note_write(final, step, time.perf_counter() - t0,
@@ -367,6 +379,7 @@ class CheckpointManager:
                                  f"{reason}")
             fault_point("ckpt_read")
             target.load(path)
+            self._note_topology_change(path, target)
             self._note_restore(path, step, time.perf_counter() - t0)
             return step
         chain = self.checkpoints()
@@ -433,11 +446,37 @@ class CheckpointManager:
                             "restore: fell back to checkpoint at step %d "
                             "after quarantining %d newer corrupt "
                             "checkpoint(s)", s, len(failures))
+                    self._note_topology_change(path, target)
                     self._note_restore(path, s, time.perf_counter() - t0,
                                        fallbacks=len(failures))
                     return s
             failures.append(self._quarantine(path, reason))
         return None
+
+    def _note_topology_change(self, path: str, target) -> None:
+        """Announce a topology-agnostic restore: the checkpoint's
+        manifest recorded a different mesh than the target runs now —
+        expected after an elastic reform (host loss/join), worth a log
+        line + journal event either way."""
+        topo = getattr(target, "topology", None)
+        if not callable(topo):
+            return
+        saved = (self._manifest_meta(path) or {}).get("topology")
+        if not saved:
+            return
+        try:
+            now = topo()
+        except Exception:
+            return
+        if saved.get("axes") != now.get("axes"):
+            _log.warning(
+                "checkpoint %s was written under mesh %s; restored "
+                "topology-agnostically onto %s", path,
+                saved.get("axes"), now.get("axes"))
+            if _tele.enabled():
+                _tele.event("checkpoint_cross_topology", path=path,
+                            saved_axes=saved.get("axes"),
+                            restored_axes=now.get("axes"))
 
     @staticmethod
     def _note_restore(path: str, step: int, elapsed_s: float,

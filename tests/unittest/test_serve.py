@@ -433,3 +433,86 @@ def test_kv_pools_donation_rebind():
     # pool arrays are live (donation rebound correctly)
     assert eng.pools.arrays["k"].shape[0] == eng.cfg.num_layers
     float(eng.pools.arrays["k"].sum())   # would raise on a deleted buffer
+
+
+# ---------------------------------------------------------------------------
+# per-request deadlines (MXTPU_SERVE_DEADLINE_MS)
+# ---------------------------------------------------------------------------
+
+def test_deadline_expires_queued_and_active_requests():
+    """A request past its deadline is expired whether it is still queued
+    or already holds a slot — its pages return to the pool, waiters
+    unblock with an error, and later requests are unaffected."""
+    from mxnet_tpu.serve import InferenceEngine, ServeConfig
+    m = _tiny_model(num_layers=1)
+    eng = InferenceEngine(m, ServeConfig(max_slots=1, page_size=4,
+                                         prefill_chunk=4, max_len=32,
+                                         deadline_ms=10_000))
+    h1 = eng.submit([1, 2, 3], max_new_tokens=8)
+    h2 = eng.submit([4, 5], max_new_tokens=8)
+    eng.step()
+    assert h1.state == "running" and h2.state == "queued"
+    # jump both requests past their 10s deadline (simulated stuck client)
+    h1.submitted_ts -= 11.0
+    h2.submitted_ts -= 11.0
+    eng.step()
+    assert h1.state == "failed" and h1.done()
+    assert h2.state == "failed" and h2.done()
+    with pytest.raises(MXNetError, match="deadline exceeded"):
+        h1.result(timeout=0)
+    with pytest.raises(MXNetError, match="deadline exceeded"):
+        h2.result(timeout=0)
+    # the expired active's pages were recycled -> a fresh request runs
+    assert eng.allocator.free_pages == eng.allocator.total_pages
+    h3 = eng.submit([6, 7], max_new_tokens=2)
+    eng.run_until_idle()
+    assert h3.state == "finished" and len(h3.tokens) == 2
+
+
+def test_deadline_off_by_default_and_per_request_override():
+    from mxnet_tpu.serve import InferenceEngine, ServeConfig
+    m = _tiny_model(num_layers=1)
+    eng = InferenceEngine(m, ServeConfig(max_slots=2, page_size=4,
+                                         prefill_chunk=4, max_len=32))
+    # config default 0 = unbounded: an ancient request still completes
+    h1 = eng.submit([1, 2], max_new_tokens=2)
+    h1.submitted_ts -= 3600.0
+    # per-request override expires independently of the config default
+    h2 = eng.submit([3, 4], max_new_tokens=2, deadline_ms=5_000)
+    h2.submitted_ts -= 6.0
+    eng.run_until_idle()
+    assert h1.state == "finished"
+    assert h2.state == "failed"
+
+
+def test_deadline_env_knob_and_telemetry(monkeypatch, tmp_path):
+    from mxnet_tpu import telemetry as tele
+    from mxnet_tpu.serve import InferenceEngine, ServeConfig
+    monkeypatch.setenv("MXTPU_SERVE_DEADLINE_MS", "7000")
+    sc = ServeConfig()
+    assert sc.deadline_ms == 7000
+    m = _tiny_model(num_layers=1)
+    journal = str(tmp_path / "deadline.jsonl")
+    tele.enable(journal_path=journal)
+    try:
+        reg = tele.registry()
+        base = (reg.get("serve_deadline_expired_total").value(where="queued")
+                if "serve_deadline_expired_total" in reg else 0)
+        eng = InferenceEngine(m, ServeConfig(max_slots=1, page_size=4,
+                                             prefill_chunk=4, max_len=32,
+                                             deadline_ms=7000))
+        h1 = eng.submit([1, 2, 3], max_new_tokens=2)
+        h2 = eng.submit([4, 5], max_new_tokens=2)
+        h2.submitted_ts -= 8.0          # queued request goes stale
+        eng.run_until_idle()
+        assert h1.state == "finished" and h2.state == "failed"
+        assert reg.get("serve_deadline_expired_total").value(
+            where="queued") == base + 1
+        import json
+        rows = [json.loads(ln) for ln in open(journal) if ln.strip()]
+        expired = [r for r in rows if r.get("event") == "request"
+                   and r.get("phase") == "deadline_expired"]
+        assert expired and expired[0]["request_id"] == h2.id
+        assert expired[0]["where"] == "queued"
+    finally:
+        tele.disable()
