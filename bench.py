@@ -501,6 +501,146 @@ def _measure_ops() -> dict:
     }
 
 
+def _coldstart_child(role: str, art_dir: str) -> dict:
+    """One cold-start measurement in THIS (fresh) process.
+
+    ``live``: build a small GPT train step + serving engine, measure
+    time-to-first-step/-token through trace+compile, then capture the
+    export artifacts for the ``load`` child.  ``load``: same models,
+    but warm-start from the artifacts — measure the same
+    time-to-first-step with ZERO Python-level retraces (asserted)."""
+    import jax
+
+    ambient = os.environ.get("JAX_PLATFORMS", "").lower()
+    if not any(t in ambient for t in ("tpu", "axon")):
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu import telemetry as _tele
+    from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from mxnet_tpu.parallel import make_mesh, make_sharded_train_step
+    from mxnet_tpu.serve import InferenceEngine, ServeConfig
+    import jax.numpy as jnp
+
+    telemetry_on = _tele.enabled()
+    cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                    num_heads=4, intermediate_size=128, max_position=128,
+                    dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    # deterministic init: live and load children must hold the same
+    # weights for the loss/logit parity cross-check in extras
+    from mxnet_tpu import random as _mxrng
+    _mxrng.seed(0)
+    model.initialize()
+    rng = _onp.random.RandomState(0)
+    ids = mx.np.array(rng.randint(0, 512, (8, 32)), dtype="int32")
+    labels = mx.np.array(rng.randint(0, 512, (8, 32)), dtype="int32")
+    model(ids)   # deferred init (outside the timed window for both roles)
+
+    def loss_fn(out, input_ids, labels):
+        from mxnet_tpu.ops.pallas.softmax_xent import softmax_cross_entropy
+        o = out._data if hasattr(out, "_data") else out
+        return jnp.mean(softmax_cross_entropy(o, labels.astype(jnp.int32)))
+
+    mesh = make_mesh({"dp": 1}, jax.devices()[:1])
+    step = make_sharded_train_step(model, opt.Adam(learning_rate=1e-3),
+                                   loss_fn, mesh, num_model_args=1)
+    train_art = os.path.join(art_dir, "train")
+    serve_art = os.path.join(art_dir, "serve")
+
+    # --- train: time to first retired step ----------------------------
+    t0 = time.perf_counter()
+    if role == "load":
+        step.load_export(train_art, ids, labels)
+    else:
+        step.warmup(ids, labels)
+    loss = float(jax.device_get(step.dispatch(ids, labels).loss))
+    train_ttfs = time.perf_counter() - t0
+
+    # --- serve: time to first token -----------------------------------
+    eng = InferenceEngine(model, ServeConfig(max_len=64, max_slots=4))
+    t0 = time.perf_counter()
+    if role == "load":
+        eng.warmup(artifact=serve_art)
+    else:
+        eng.warmup()
+    first = {}
+    h = eng.submit(list(range(1, 9)), max_new_tokens=4,
+                   on_token=lambda t, r: first.setdefault(
+                       "t", time.perf_counter()))
+    eng.run_until_idle()
+    serve_ttft = first.get("t", time.perf_counter()) - t0
+    tokens = h.result(timeout=0)
+
+    if role == "live":
+        step.export(train_art, ids, labels)
+        eng.export(serve_art)
+
+    out = {
+        "role": role,
+        "train_ttfs_s": round(train_ttfs, 3),
+        "serve_ttft_s": round(serve_ttft, 3),
+        "loss": loss,
+        "tokens": tokens,
+        "trace_count": step.trace_count,
+        "compile_seconds": round(step.compile_seconds or 0.0, 3),
+    }
+    if telemetry_on:
+        out["telemetry"] = {"snapshot": _tele.snapshot()}
+    return out
+
+
+def _measure_coldstart() -> dict:
+    """`bench.py --coldstart`: time-to-first-step (train) and
+    time-to-first-token (serve) for the live-trace path vs the
+    export-artifact load path, each measured in a FRESH child process
+    (docs/export.md).  The headline value is the train cold-start
+    speedup; extras carry both raw timings plus the loaded path's
+    ``trace_count`` (must be 0 — the zero-retrace contract)."""
+    import tempfile
+    with tempfile.TemporaryDirectory(prefix="mxtpu_coldstart_") as art:
+        results = {}
+        for role in ("live", "load"):
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--coldstart-child", role, art],
+                capture_output=True, text=True, timeout=900,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+            if proc.returncode != 0:
+                tail = (proc.stderr or proc.stdout or "").strip()
+                raise RuntimeError(
+                    f"coldstart {role} child failed: {tail[-800:]}")
+            for line in reversed(proc.stdout.strip().splitlines()):
+                if line.startswith("{"):
+                    results[role] = json.loads(line)
+                    break
+    live, load = results["live"], results["load"]
+    parity = (live["loss"] == load["loss"]
+              and live["tokens"] == load["tokens"])
+    speedup = (live["train_ttfs_s"] / load["train_ttfs_s"]
+               if load["train_ttfs_s"] > 0 else 0.0)
+    return {
+        "metric": "coldstart_train_speedup",
+        "value": round(speedup, 3),
+        "unit": "live_ttfs_over_artifact_ttfs",
+        "vs_baseline": 0.0,   # north-star baseline is MFU-on-TPU
+        "extras": {
+            "train_ttfs_live_s": live["train_ttfs_s"],
+            "train_ttfs_load_s": load["train_ttfs_s"],
+            "serve_ttft_live_s": live["serve_ttft_s"],
+            "serve_ttft_load_s": load["serve_ttft_s"],
+            "serve_ttft_speedup": round(
+                live["serve_ttft_s"] / load["serve_ttft_s"], 3)
+            if load["serve_ttft_s"] > 0 else 0.0,
+            "loaded_trace_count": load["trace_count"],
+            "parity": parity,
+            "loss": load["loss"],
+        },
+    }
+
+
 def _run_child(platform: str, timeout: float):
     """Run `bench.py --measure <platform>` in a child; return (dict|None, err).
 
@@ -698,6 +838,16 @@ def main():
         os.environ["MXTPU_TELEMETRY"] = "1"
     if len(sys.argv) >= 3 and sys.argv[1] == "--measure":
         print(json.dumps(_measure(sys.argv[2])))
+        return
+    if len(sys.argv) >= 4 and sys.argv[1] == "--coldstart-child":
+        print(json.dumps(_coldstart_child(sys.argv[2], sys.argv[3])))
+        return
+    if "--coldstart" in sys.argv:
+        # live-trace vs artifact-load time-to-first-step, fresh child
+        # process per role (docs/export.md); may claim the TPU
+        _wait_for_claim_lock()
+        with _ClaimLock():
+            print(json.dumps(_measure_coldstart()))
         return
     if "--ops" in sys.argv:
         # per-kernel microbenchmarks (fused vs reference vs legacy) —

@@ -76,6 +76,7 @@ from . import health  # noqa: F401
 from . import recovery  # noqa: F401
 from . import amp  # noqa: F401
 from . import serve  # noqa: F401
+from . import export  # noqa: F401
 from . import runtime  # noqa: F401
 from . import util  # noqa: F401
 from .util import (  # noqa: F401  (reference exposes these at top level)

@@ -1,0 +1,29 @@
+"""Ahead-of-time export & graph-rewrite pipeline (ROADMAP item 3).
+
+The reference's NNVM `export`/`SymbolBlock` stage mapped onto
+StableHLO: `capture` / `ShardedTrainStep.export` lower whole programs
+to versioned artifacts, `export.passes` rewrites them offline (remat
+policy search, sharding retarget, Pallas substitution), and
+`load` / `load_block` / `ShardedTrainStep.load_export` /
+`InferenceEngine.warmup(artifact=...)` run them in a fresh process with
+ZERO Python-level retraces.  See docs/export.md.
+"""
+from .artifact import (FORMAT_VERSION, ExportArtifact, export_dir,
+                       auto_capture_enabled, topology_key)
+from .capture import (capture, capture_train_step, capture_serve, load,
+                      load_block, signature, spec_from_json,
+                      TrainStepCapture, BlockCapture, ServeCapture,
+                      LoadedArtifact, LoadedBlock)
+from .passes import (PassManager, RematSearchPass, ShardingRetargetPass,
+                     PallasSubstitutionPass, resolve_hbm_budget)
+
+__all__ = [
+    "FORMAT_VERSION", "ExportArtifact", "export_dir",
+    "auto_capture_enabled", "topology_key",
+    "capture", "capture_train_step", "capture_serve", "load",
+    "load_block", "signature", "spec_from_json",
+    "TrainStepCapture", "BlockCapture", "ServeCapture",
+    "LoadedArtifact", "LoadedBlock",
+    "PassManager", "RematSearchPass", "ShardingRetargetPass",
+    "PallasSubstitutionPass", "resolve_hbm_budget",
+]

@@ -1,0 +1,533 @@
+"""Capture (trace → StableHLO) and zero-retrace load.
+
+`capture()` lowers a hybridized block's forward — and
+`capture_train_step()` the FULL jitted train step, grad-accum scan,
+skip-guard and fused-optimizer route included — through
+``jax.export``, recording module bytes + in/out sharding specs + batch
+avals + mesh topology + autotune configs in a versioned
+`ExportArtifact`.  `load()` / `load_block()` deserialize and
+``jax.jit(exported.call)`` WITHOUT running any model Python: the only
+thing traced in the loading process is the export calling-convention
+wrapper, so ``ShardedTrainStep.trace_count`` stays 0 and the persistent
+compile cache (keyed by the identical HLO) serves the XLA binary.
+
+The offline rewrite passes (`export.passes`) work on the live
+`TrainStepCapture`: every pass that needs a different program (remat
+policy, retargeted mesh, Pallas substitution) REBUILDS through the
+same `ShardedTrainStep._build` path the live step uses — offline
+compile time is free, and there is exactly one lowering rule to trust.
+"""
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as onp
+
+from ..base import MXNetError
+from .artifact import ExportArtifact, topology_key
+
+__all__ = ["capture", "capture_train_step", "capture_serve", "load",
+           "load_block", "TrainStepCapture", "BlockCapture",
+           "ServeCapture", "LoadedArtifact", "LoadedBlock"]
+
+
+def _jax():
+    import jax
+    return jax
+
+
+def _sds(x, sharding=None):
+    import jax
+    return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype,
+                                sharding=sharding)
+
+
+def _sharded_avals(tree):
+    """avals carrying each committed array's sharding (export needs the
+    shardings to bake them into the module)."""
+    import jax
+
+    def one(x):
+        sh = getattr(x, "sharding", None)
+        from jax.sharding import NamedSharding
+        return _sds(x, sh if isinstance(sh, NamedSharding) else None)
+    return jax.tree_util.tree_map(one, tree)
+
+
+def _find_cfg(block):
+    """Best-effort model-config discovery (GPTConfig/BertConfig-style
+    objects with hidden_size/num_layers): the block itself, then
+    children, depth-first (`Block._children` holds weakrefs)."""
+    import weakref
+    seen = set()
+
+    def walk(b, depth=0):
+        if b is None or id(b) in seen or depth > 4:
+            return None
+        seen.add(id(b))
+        cfg = getattr(b, "cfg", None)
+        if cfg is not None and hasattr(cfg, "hidden_size") and \
+                hasattr(cfg, "num_layers"):
+            return cfg
+        for c in getattr(b, "_children", {}).values():
+            if isinstance(c, weakref.ref):
+                c = c()
+            got = walk(c, depth + 1)
+            if got is not None:
+                return got
+        return None
+    return walk(block)
+
+
+def _cfg_meta(cfg) -> dict:
+    if cfg is None:
+        return {}
+    out = {}
+    for k, v in vars(cfg).items():
+        if isinstance(v, (int, float, str, bool, type(None))):
+            out[k] = v
+    return {"class": type(cfg).__name__, "config": out}
+
+
+# ---------------------------------------------------------------------------
+# train-step capture
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def _scratch_build(step, batch_vals):
+    """Run a FRESH `_build` (new jit closure → the body re-reads model
+    knobs like ``cfg.remat``) and restore every piece of compiled-step
+    state afterwards, so capture never perturbs a live training loop:
+    the original jit/AOT executable, batch specs, and the trace
+    counter all come back exactly as they were."""
+    saved = (step._step_fn, getattr(step, "_batch_shardings", None),
+             step.batch_specs, step._trace_count, step._trace_avals)
+    step._step_fn = None
+    # the scratch trace is not a live retrace: zero the counter/avals so
+    # _note_trace doesn't warn RETRACE at a user who just called export()
+    step._trace_count = 0
+    step._trace_avals = None
+    try:
+        step._build(batch_vals, None)
+        yield step._step_fn
+    finally:
+        step._release_trace_guard()
+        (step._step_fn, step._batch_shardings,
+         step.batch_specs, step._trace_count, step._trace_avals) = saved
+        if step._batch_shardings is None:
+            del step._batch_shardings
+
+
+def _train_avals(step, batch_vals):
+    """The (pvals, opt_state, hp, key, *batch) aval tuple the step's jit
+    signature takes, shardings attached."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    repl = NamedSharding(step.mesh, P())
+    hp = step._hp()
+    hp_avals = jax.tree_util.tree_map(lambda x: _sds(x, repl), hp)
+    key_aval = _sds(jax.random.PRNGKey(0), repl)
+    batch_avals = tuple(
+        _sds(b, s) for b, s in zip(batch_vals, step._batch_shardings))
+    return (_sharded_avals(step.pvals), _sharded_avals(step.opt_state),
+            hp_avals, key_aval) + batch_avals
+
+
+def _resolved_remat(step) -> str:
+    """The remat policy a trace of this step's model would actually run
+    (env override included) as a stable string — part of the program's
+    identity: a no-remat artifact loaded into a remat="full" replica
+    would OOM exactly where the knob was set to prevent it."""
+    cfg = _find_cfg(step.block)
+    val = getattr(cfg, "remat", False) if cfg is not None else False
+    from ..numpy_extension import resolve_remat_policy
+    on, pol = resolve_remat_policy(val)
+    if not on:
+        return "none"
+    if pol is None:
+        return "full"
+    return getattr(pol, "__name__", str(pol))
+
+
+def _step_flags(step) -> dict:
+    """Program-shaping step attributes: a loaded artifact must have been
+    captured under the SAME flags or its output tree won't match."""
+    return {"health_probes": bool(step._health_probes),
+            "skip_nonfinite": bool(step._skip_nonfinite),
+            "donate": bool(step.donate),
+            "grad_accum": int(step.grad_accum),
+            "zero": bool(step.zero), "fsdp": bool(step.fsdp),
+            "fused_opt_kernel": bool(step._fused_opt_kernel),
+            "optimizer": type(step.optimizer).__name__,
+            "remat_policy": _resolved_remat(step)}
+
+
+class TrainStepCapture:
+    """Live capture of one `ShardedTrainStep` — the pass pipeline's
+    working object.  Holds the step (so passes can rebuild/retarget)
+    plus the growing `ExportArtifact`."""
+
+    kind = "train_step"
+
+    def __init__(self, step, batch_vals: Sequence, artifact: ExportArtifact):
+        self.step = step
+        self.batch_vals = [onp.asarray(b) for b in batch_vals]
+        self.artifact = artifact
+
+    # -- lowering --------------------------------------------------------
+    def _exported(self, step=None):
+        """jax.export the (freshly built) step program for `step`'s mesh
+        and the current model knobs.  Returns (exported, avals,
+        batch_specs) — the specs are read INSIDE the scratch build
+        (they are restored to the caller's state on exit)."""
+        from jax import export as jexport
+        step = step or self.step
+        batch = self.batch_vals
+        with _scratch_build(step, batch) as step_fn:
+            avals = _train_avals(step, batch)
+            specs = tuple(step.batch_specs)
+            exp = jexport.export(step_fn)(*avals)
+        return exp, avals, specs
+
+    def compile_stats(self, step=None) -> dict:
+        """Lower + compile (fresh build, current knobs) and return the
+        measured stats the remat search ranks on: XLA cost-analysis
+        flops, memory-analysis peak bytes, compile wall seconds."""
+        import jax
+        step = step or self.step
+        batch = self.batch_vals
+        t0 = time.perf_counter()
+        with _scratch_build(step, batch) as step_fn:
+            avals = _train_avals(step, batch)
+            compiled = step_fn.lower(*avals).compile()
+        secs = time.perf_counter() - t0
+        out = {"compile_seconds": round(secs, 4), "flops": None,
+               "temp_bytes": None, "argument_bytes": None,
+               "output_bytes": None}
+        try:
+            ca = compiled.cost_analysis()
+            ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+            out["flops"] = float(ca.get("flops", 0.0))
+        except Exception:
+            pass
+        try:
+            ma = compiled.memory_analysis()
+            if ma is not None:
+                out["temp_bytes"] = int(ma.temp_size_in_bytes)
+                out["argument_bytes"] = int(ma.argument_size_in_bytes)
+                out["output_bytes"] = int(ma.output_size_in_bytes)
+        except Exception:
+            pass
+        return out
+
+    # -- module management ----------------------------------------------
+    def add_current(self, step=None, meta: Optional[dict] = None) -> str:
+        """Capture `step`'s program (its mesh, the model's current remat
+        policy, the active Pallas dispatch) into the artifact."""
+        step = step or self.step
+        exp, avals, specs = self._exported(step)
+        m = dict(meta or {})
+        m.update(_step_flags(step))
+        m["custom_calls"] = exp.mlir_module().count("stablehlo.custom_call")
+        return self.artifact.add_module(
+            exp.serialize(), step.topology(), avals,
+            batch_avals=list(avals[4:]),
+            batch_specs=[_spec_json(s) for s in specs],
+            platforms=exp.platforms, meta=m)
+
+    def recapture(self, meta: Optional[dict] = None) -> str:
+        """Re-export the PRIMARY topology's module (after a pass changed
+        a model knob, e.g. the remat winner)."""
+        return self.add_current(self.step, meta=meta)
+
+    def clone_for_mesh(self, new_mesh):
+        """A parallel `ShardedTrainStep` over `new_mesh` sharing block/
+        optimizer/loss — the retarget pass's rebuild vehicle.  Batch
+        specs degrade through `sharding.retarget_spec` (one rule)."""
+        from ..parallel.sharding import retarget_spec
+        step = self.step
+        specs = step._orig_batch_specs
+        if specs is not None:
+            specs = tuple(retarget_spec(s, new_mesh) for s in specs)
+        return type(step)(
+            step.block, step.optimizer, step.loss_fn, new_mesh,
+            rules=step.rules, batch_specs=specs,
+            num_model_args=step.num_model_args,
+            grad_accum_dtype=step.grad_accum_dtype,
+            grad_accum=step.grad_accum, zero=step.zero, fsdp=step.fsdp,
+            donate=step.donate)
+
+    def save(self, path: str) -> str:
+        return self.artifact.save(path)
+
+
+def capture_train_step(step, *batch, rng_key=None) -> TrainStepCapture:
+    """Capture a `ShardedTrainStep`'s full jitted program.  `batch`:
+    one example batch (mx ndarrays / numpy); omitted, the step's last
+    dispatched batch avals are reused (requires a prior step/warmup)."""
+    if batch:
+        batch_vals = [b._data if hasattr(b, "_data") else onp.asarray(b)
+                      for b in batch]
+    else:
+        last = getattr(step, "_last_batch_avals", None)
+        if last is None:
+            raise MXNetError(
+                "capture_train_step needs an example batch (none "
+                "dispatched yet): step.export(path, *batch)")
+        batch_vals = [onp.zeros(s, d) for s, d in last]
+    cfg = _find_cfg(step.block)
+    art = ExportArtifact.new("train_step", _cfg_meta(cfg))
+    art.manifest["meta"]["step_flags"] = _step_flags(step)
+    rp = getattr(cfg, "remat", None) if cfg is not None else None
+    art.manifest["remat_policy"] = rp if isinstance(rp, str) else None
+    cap = TrainStepCapture(step, batch_vals, art)
+    cap.add_current()
+    return cap
+
+
+def _spec_json(spec) -> list:
+    """PartitionSpec -> JSON-able list (tuple entries become lists)."""
+    out = []
+    for a in spec:
+        if a is None or isinstance(a, str):
+            out.append(a)
+        else:
+            out.append(list(a))
+    return out
+
+
+def spec_from_json(entries) -> "Any":
+    from jax.sharding import PartitionSpec as P
+    fixed = [tuple(a) if isinstance(a, list) else a for a in entries]
+    return P(*fixed)
+
+
+# ---------------------------------------------------------------------------
+# block capture (SymbolBlock parity, artifact-native)
+# ---------------------------------------------------------------------------
+
+class BlockCapture:
+    """Capture of a block's forward as a pure fn(params, *inputs) —
+    params ride IN the artifact, so `load_block()` runs inference from
+    the artifact alone (the `SymbolBlock` capability, one directory)."""
+
+    kind = "block"
+
+    def __init__(self, block, example_vals, artifact: ExportArtifact):
+        self.block = block
+        self.example_vals = example_vals
+        self.artifact = artifact
+
+    def save(self, path: str) -> str:
+        return self.artifact.save(path)
+
+
+def capture(block, *example, rng_key=None) -> BlockCapture:
+    """Lower `block`'s (hybridized) forward to a StableHLO artifact.
+
+    `example`: one example input set (mx ndarrays / numpy / jax).  The
+    capture runs `functional_call` — inference mode, parameters as
+    explicit inputs — so the artifact's params.npz + module fully
+    determine the outputs."""
+    import jax
+    from jax import export as jexport
+    from ..gluon.block import functional_call
+
+    params = {n: p for n, p in block.collect_params().items()
+              if p._data is not None}
+    if not params:
+        raise MXNetError("export.capture: block has no initialized "
+                         "parameters; call initialize() (and one forward "
+                         "for deferred shapes) first")
+    pvals = {n: p._data._data for n, p in params.items()}
+    ex_vals = [e._data if hasattr(e, "_data") else onp.asarray(e)
+               for e in example]
+    if not ex_vals:
+        raise MXNetError("export.capture needs at least one example input")
+
+    def fn(pv, *inputs):
+        out, _aux = functional_call(block, pv, *inputs, training=False,
+                                    rng_key=rng_key)
+        leaves = jax.tree_util.tree_leaves(out)
+        return tuple(leaves)
+
+    jf = jax.jit(fn)
+    avals = (jax.tree_util.tree_map(_sds, pvals),) + \
+        tuple(_sds(jax.numpy.asarray(v)) for v in ex_vals)
+    exp = jexport.export(jf)(*avals)
+    cfg = _find_cfg(block)
+    art = ExportArtifact.new("block", _cfg_meta(cfg))
+    art.params = {n: onp.asarray(_gather(v)) for n, v in pvals.items()}
+    topo = {"devices": exp.nr_devices, "axes": {}}
+    art.add_module(exp.serialize(), topo, avals,
+                   batch_avals=list(avals[1:]), platforms=exp.platforms,
+                   meta={"block": type(block).__name__,
+                         "custom_calls": exp.mlir_module().count(
+                             "stablehlo.custom_call")})
+    return BlockCapture(block, ex_vals, art)
+
+
+def _gather(x):
+    import jax
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        from jax.experimental import multihost_utils
+        return multihost_utils.process_allgather(x, tiled=True)
+    return jax.device_get(x)
+
+
+# ---------------------------------------------------------------------------
+# serve capture
+# ---------------------------------------------------------------------------
+
+class ServeCapture:
+    """Both compiled serving step widths (prefill chunk C and decode
+    C=1) of an `InferenceEngine`, one artifact."""
+
+    kind = "serve_step"
+
+    def __init__(self, engine, artifact: ExportArtifact):
+        self.engine = engine
+        self.artifact = artifact
+
+    def save(self, path: str) -> str:
+        return self.artifact.save(path)
+
+
+def capture_serve(engine) -> ServeCapture:
+    """Capture an engine's fused serving step at both chunk widths.
+    Modules are tagged ``c<width>`` under the (single-device today)
+    topology; `InferenceEngine.warmup(artifact=...)` loads them back
+    without re-tracing the transformer."""
+    from jax import export as jexport
+    cfg_meta = _cfg_meta(engine.cfg)
+    art = ExportArtifact.new("serve_step", cfg_meta)
+    sc = engine.serve_config
+    # the engine's own identity dict — load_export compares against the
+    # same method, so the two sides cannot drift
+    art.manifest["meta"]["serve_config"] = engine._export_config()
+    for C in sorted({sc.prefill_chunk, 1}):
+        fn = engine._step_fn(C)
+        avals = engine._step_avals(C)
+        exp = jexport.export(fn)(*avals)
+        topo = {"devices": exp.nr_devices, "axes": {}}
+        art.add_module(exp.serialize(), topo, avals,
+                       platforms=exp.platforms, tag=f"c{C}",
+                       meta={"chunk": C,
+                             "custom_calls": exp.mlir_module().count(
+                                 "stablehlo.custom_call")})
+    return ServeCapture(engine, art)
+
+
+# ---------------------------------------------------------------------------
+# load
+# ---------------------------------------------------------------------------
+
+class LoadedArtifact:
+    """A read artifact plus deserialization cache — `exported_for` gives
+    the `jax.export.Exported` for one topology/tag without re-reading."""
+
+    def __init__(self, artifact: ExportArtifact):
+        self.artifact = artifact
+        self._cache: Dict[str, Any] = {}
+
+    @property
+    def manifest(self) -> dict:
+        return self.artifact.manifest
+
+    @property
+    def kind(self) -> str:
+        return self.artifact.kind
+
+    def exported_for(self, topology: Dict[str, Any], tag: str = ""):
+        from jax import export as jexport
+        mkey = topology_key(topology, tag)
+        exp = self._cache.get(mkey)
+        if exp is None:
+            blob = self.artifact.module_bytes(topology, tag)
+            try:
+                exp = jexport.deserialize(blob)
+            except Exception as e:
+                raise MXNetError(
+                    f"export artifact {self.artifact.path} module {mkey} "
+                    f"failed to deserialize under jax "
+                    f"{_jax().__version__} (captured under "
+                    f"{self.manifest.get('jax_version')}): {e}. "
+                    "Re-capture with the current toolchain.")
+            self._cache[mkey] = exp
+        return exp
+
+
+def load(path: str) -> LoadedArtifact:
+    """Read + validate an artifact directory (any kind).  Emits
+    ``export_load_ms`` + an ``export`` journal event."""
+    from .. import telemetry as _tele
+    t0 = time.perf_counter()
+    art = ExportArtifact.read(path)
+    loaded = LoadedArtifact(art)
+    if _tele.enabled():
+        _tele.histogram(
+            "export_load_ms",
+            "Wall time of one artifact read+validate (module "
+            "deserialize/compile accounted by the caller's "
+            "compile events)").observe((time.perf_counter() - t0) * 1e3)
+        _tele.event("export", phase="load", path=path, kind=art.kind,
+                    modules=art.module_keys,
+                    hash=str(art.manifest.get("hash", ""))[:16])
+    return loaded
+
+
+class LoadedBlock:
+    """Inference-from-artifact callable (`SymbolBlock` parity): holds
+    the deserialized module + the artifact's parameter values; calling
+    it never touches model Python (`jax.jit` of the export wrapper
+    only)."""
+
+    def __init__(self, exported, params: Dict[str, Any], manifest: dict):
+        import jax
+        self.manifest = manifest
+        self._params = {n: jax.numpy.asarray(v) for n, v in params.items()}
+        self._call = jax.jit(exported.call)
+
+    def __call__(self, *inputs):
+        import jax
+        vals = [i._data if hasattr(i, "_data") else jax.numpy.asarray(i)
+                for i in inputs]
+        out = self._call(self._params, *vals)
+        from ..numpy import from_jax
+        outs = [from_jax(o) for o in out]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def load_block(path: str) -> LoadedBlock:
+    """Load a `capture()` artifact for inference from the artifact
+    alone — weights + program, no model class needed."""
+    la = load(path)
+    if la.kind != "block":
+        raise MXNetError(
+            f"export.load_block: artifact at {path} is kind="
+            f"{la.kind!r}, not a block capture (use export.load / "
+            "ShardedTrainStep.load_export for train_step artifacts)")
+    if la.artifact.params is None:
+        raise MXNetError(
+            f"export artifact {path} has no params.npz — it cannot run "
+            "standalone inference (was it captured with "
+            "export.capture(block, ...)?)")
+    keys = la.artifact.module_keys
+    if not keys:
+        raise MXNetError(f"export artifact {path} holds no modules")
+    rec = la.manifest["modules"][keys[0]]
+    exp = la.exported_for(rec["topology"])
+    return LoadedBlock(exp, la.artifact.params, la.manifest)
+
+
+def signature(parts: Sequence[Any]) -> str:
+    """Deterministic 16-hex signature for auto-capture artifact names
+    (param/batch avals + topology + knobs -> one directory name)."""
+    h = hashlib.sha256()
+    for p in parts:
+        h.update(repr(p).encode())
+        h.update(b"\0")
+    return h.hexdigest()[:16]

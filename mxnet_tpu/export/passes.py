@@ -1,0 +1,334 @@
+"""Offline graph-rewrite passes over a capture (Relay-shaped pipeline).
+
+A pass is a callable ``pass_(capture) -> capture`` run by `PassManager`
+— the NNVM/Relay pass-pipeline idea (arxiv 1810.00952) at the capture
+layer: because offline optimization time is free, every pass that needs
+a *different program* simply rebuilds through the SAME
+`ShardedTrainStep._build` lowering the live step uses, with one model
+knob changed.  Three passes ship:
+
+- `RematSearchPass` — evaluates named `jax.checkpoint` policies per
+  transformer block (the ``GPTConfig.remat`` knob) against the PR 7
+  roofline constants + measured XLA compile stats, and picks the
+  FASTEST policy whose peak live bytes fit the device HBM budget
+  (``MXTPU_HBM_BUDGET``); the winner is written back through
+  ``cfg.remat`` and re-captured.
+- `ShardingRetargetPass` — adds a module for a different ``fit_axes``
+  topology; batch specs degrade through `sharding.retarget_spec` (the
+  one degrade rule the elastic reshard path already uses).
+- `PallasSubstitutionPass` — re-lowers with the ``MXTPU_PALLAS``
+  dispatch forced so matched norm/attention/optimizer subgraphs swap to
+  their Pallas custom-calls when the target platform supports them
+  (recorded as the module's ``custom_calls`` count delta).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..base import MXNetError
+from .capture import TrainStepCapture, _find_cfg
+
+__all__ = ["PassManager", "RematSearchPass", "ShardingRetargetPass",
+           "PallasSubstitutionPass", "resolve_hbm_budget"]
+
+
+class PassManager:
+    """Run passes in order over a capture; each records provenance in
+    the artifact manifest and an ``export`` journal event."""
+
+    def __init__(self, passes: Sequence[Any]):
+        self.passes = list(passes)
+
+    def run(self, cap):
+        from .. import telemetry as _tele
+        for p in self.passes:
+            name = type(p).__name__
+            t0 = time.perf_counter()
+            cap = p(cap) or cap
+            if _tele.enabled():
+                _tele.event("export", phase="pass", name=name,
+                            ms=round((time.perf_counter() - t0) * 1e3, 2))
+        return cap
+
+
+@contextlib.contextmanager
+def _env_override(name: str, value: Optional[str]):
+    old = os.environ.get(name)
+    if value is None:
+        os.environ.pop(name, None)
+    else:
+        os.environ[name] = value
+    try:
+        yield
+    finally:
+        if old is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = old
+
+
+# ---------------------------------------------------------------------------
+# remat policy search
+# ---------------------------------------------------------------------------
+
+# per-device-kind HBM bytes when memory_stats() is unavailable
+_HBM_BYTES = (
+    ("v6", 32e9), ("trillium", 32e9), ("v5 lite", 16e9), ("v5e", 16e9),
+    ("v5", 95e9), ("v4", 32e9),
+)
+
+
+def resolve_hbm_budget() -> Optional[float]:
+    """Per-device HBM budget in bytes: ``MXTPU_HBM_BUDGET`` (float,
+    bytes) wins; else the device's reported ``bytes_limit``; else a
+    per-kind table; CPU has no budget (None — every policy fits)."""
+    env = os.environ.get("MXTPU_HBM_BUDGET")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            raise MXNetError(
+                f"MXTPU_HBM_BUDGET={env!r} is not a number (bytes)")
+    import jax
+    try:
+        dev = jax.devices()[0]
+        if dev.platform.lower() != "tpu":
+            return None
+        stats = dev.memory_stats() or {}
+        if stats.get("bytes_limit"):
+            return float(stats["bytes_limit"])
+        kind = getattr(dev, "device_kind", "").lower()
+        for sub, hbm in _HBM_BYTES:
+            if sub in kind:
+                return hbm
+    except Exception:
+        pass
+    return None
+
+
+def _policy_cfg_value(name: str):
+    """Map a search-policy name to the `GPTConfig.remat` knob value."""
+    if name in ("none", "off"):
+        return False
+    if name == "full":
+        return "full"
+    return name
+
+
+def _dtype_size(dtype) -> int:
+    s = str(dtype)
+    return 2 if ("16" in s) else (8 if "64" in s else 4)
+
+
+def _analytic_saved_bytes(cfg, batch_avals, policy: str) -> float:
+    """Residual bytes held live across the backward per policy — the
+    CPU-rankable skeleton of the remat trade (XLA:CPU's scheduler does
+    not exploit remat, so `memory_analysis` cannot rank policies there;
+    this model only needs the ordering none > dots_saveable > full).
+    Per layer per token: no remat saves the attention+FFN intermediate
+    set (~6h + 2i values), dots_saveable only matmul outputs (~3h + i),
+    full remat only the block boundary (h)."""
+    shape = tuple(batch_avals[0][0] if isinstance(batch_avals[0],
+                                                  (list, tuple))
+                  else batch_avals[0].shape)
+    tokens = 1
+    for d in shape[:2]:
+        tokens *= int(d)
+    h = int(cfg.hidden_size)
+    i = int(getattr(cfg, "intermediate_size", 4 * h))
+    n = int(cfg.num_layers)
+    isize = _dtype_size(getattr(cfg, "dtype", "float32"))
+    per_token = {"none": 6 * h + 2 * i,
+                 "dots_saveable": 3 * h + i,
+                 "dots_with_no_batch_dims_saveable": 3 * h + i}
+    per = per_token.get(policy, h)   # full/nothing_saveable/named-other
+    return float(tokens) * per * isize * n
+
+
+class RematSearchPass:
+    """Search `jax.checkpoint` policies for the captured train step and
+    bake the winner into the artifact (and, via ``cfg.remat``, into the
+    live model so later live traces agree with the artifact)."""
+
+    def __init__(self, policies: Sequence[str] = ("none", "dots_saveable",
+                                                  "full"),
+                 hbm_budget: Optional[float] = None,
+                 write_back: bool = True):
+        self.policies = tuple(policies)
+        self.hbm_budget = hbm_budget
+        self.write_back = write_back
+
+    def __call__(self, cap):
+        import jax
+        if not isinstance(cap, TrainStepCapture):
+            raise MXNetError("RematSearchPass applies to train_step "
+                             f"captures, got {type(cap).__name__}")
+        cfg = _find_cfg(cap.step.block)
+        if cfg is None or not hasattr(cfg, "remat"):
+            cap.artifact.record_pass("remat_search", skipped=True,
+                                     reason="no remat-capable model "
+                                            "config found")
+            return cap
+        budget = self.hbm_budget if self.hbm_budget is not None \
+            else resolve_hbm_budget()
+        on_tpu = False
+        try:
+            on_tpu = jax.default_backend() == "tpu"
+        except Exception:
+            pass
+        rec = cap.artifact.module_record(cap.step.topology())
+        batch_avals = rec["batch_avals"]
+        baseline = getattr(cfg, "remat", False)
+        table: List[Dict[str, Any]] = []
+        from ..ops.pallas.autotune import _model_for, device_kind
+        peak_flops, bw, _ovh = _model_for(device_kind())
+        # the search OWNS the knob for its duration: with the operator
+        # env override live, every candidate would lower the identical
+        # (env-forced) program and the manifest would record a "winner"
+        # the serialized module doesn't actually run
+        env_was_set = bool(os.environ.get("MXTPU_REMAT_POLICY",
+                                          "").strip())
+        with _env_override("MXTPU_REMAT_POLICY", None):
+            return self._search(cap, cfg, budget, on_tpu, batch_avals,
+                                baseline, table, peak_flops, bw,
+                                env_was_set)
+
+    def _search(self, cap, cfg, budget, on_tpu, batch_avals, baseline,
+                table, peak_flops, bw, env_was_set):
+        for name in self.policies:
+            old = cfg.remat
+            cfg.remat = _policy_cfg_value(name)
+            try:
+                stats = cap.compile_stats()
+            finally:
+                cfg.remat = old
+            static = (stats.get("argument_bytes") or 0)
+            measured = stats.get("temp_bytes")
+            if on_tpu and measured:
+                peak = float(static + measured)
+                peak_src = "memory_analysis"
+            else:
+                peak = float(static) + _analytic_saved_bytes(
+                    cfg, batch_avals, name)
+                peak_src = "analytic"
+            flops = stats.get("flops") or 0.0
+            est_s = flops / peak_flops + peak / bw
+            table.append({"policy": name, "peak_bytes": int(peak),
+                          "peak_source": peak_src,
+                          "flops": flops,
+                          "est_step_s": est_s,
+                          "compile_seconds": stats["compile_seconds"],
+                          "fits": budget is None or peak <= budget})
+        feasible = [t for t in table if t["fits"]]
+        pool = feasible or sorted(table, key=lambda t: t["peak_bytes"])[:1]
+        winner = min(pool, key=lambda t: t["est_step_s"])
+        cap.artifact.record_pass(
+            "remat_search", winner=winner["policy"],
+            hbm_budget=budget, over_budget=not feasible,
+            env_override_suspended=env_was_set,
+            candidates=table)
+        cap.artifact.manifest["remat_policy"] = winner["policy"]
+        if self.write_back:
+            cfg.remat = _policy_cfg_value(winner["policy"])
+            cap.recapture(meta={"remat_policy": winner["policy"]})
+        elif _policy_cfg_value(winner["policy"]) != baseline:
+            # artifact must match its recorded policy even un-written
+            old = cfg.remat
+            cfg.remat = _policy_cfg_value(winner["policy"])
+            try:
+                cap.recapture(meta={"remat_policy": winner["policy"]})
+            finally:
+                cfg.remat = old
+        return cap
+
+
+# ---------------------------------------------------------------------------
+# sharding retarget
+# ---------------------------------------------------------------------------
+
+class ShardingRetargetPass:
+    """Add a module lowered for a different topology, so replicas on
+    that mesh shape cold-start from this same artifact.  ``axes`` like
+    ``{"dp": 2, "tp": 2}``; the device list defaults to the first
+    ``prod(axes)`` local devices (offline rewrite box)."""
+
+    def __init__(self, axes: Dict[str, int], devices=None):
+        self.axes = dict(axes)
+        self.devices = devices
+
+    def __call__(self, cap):
+        import jax
+        if not isinstance(cap, TrainStepCapture):
+            raise MXNetError("ShardingRetargetPass applies to train_step "
+                             f"captures, got {type(cap).__name__}")
+        from ..parallel.mesh import make_mesh
+        n = 1
+        for v in self.axes.values():
+            n *= max(int(v), 1)
+        devices = self.devices
+        if devices is None:
+            local = jax.devices()
+            if n > len(local):
+                raise MXNetError(
+                    f"ShardingRetargetPass axes {self.axes} need {n} "
+                    f"devices; this process has {len(local)} — pass "
+                    "devices= or retarget on a larger offline box")
+            devices = local[:n]
+        new_mesh = make_mesh(self.axes, devices)
+        clone = cap.clone_for_mesh(new_mesh)
+        from .artifact import topology_key
+        mkey = cap.add_current(
+            clone, meta={"retargeted_from":
+                         topology_key(cap.step.topology())})
+        cap.artifact.record_pass("sharding_retarget", axes=self.axes,
+                                 module=mkey)
+        return cap
+
+
+# ---------------------------------------------------------------------------
+# Pallas subgraph substitution
+# ---------------------------------------------------------------------------
+
+class PallasSubstitutionPass:
+    """Re-lower the primary module with the fused-kernel dispatch forced
+    (``MXTPU_PALLAS=kernel``) so matched norm/attention/optimizer
+    subgraphs become their Pallas custom-calls.  No-op (recorded) when
+    the running platform cannot execute the kernels — `auto` mode on
+    CPU deliberately lowers the jnp reference graphs."""
+
+    def __init__(self, mode: Optional[str] = None):
+        # None = force kernels only where the platform supports them
+        self.mode = mode
+
+    def __call__(self, cap):
+        import jax
+        if not isinstance(cap, TrainStepCapture):
+            raise MXNetError("PallasSubstitutionPass applies to "
+                             "train_step captures, got "
+                             f"{type(cap).__name__}")
+        mode = self.mode
+        if mode is None:
+            try:
+                mode = "kernel" if jax.default_backend() == "tpu" \
+                    else None
+            except Exception:
+                mode = None
+        rec = cap.artifact.module_record(cap.step.topology())
+        before = rec["meta"].get("custom_calls", 0)
+        if mode is None:
+            cap.artifact.record_pass(
+                "pallas_substitution", skipped=True,
+                reason="target platform runs the reference graphs "
+                       "(MXTPU_PALLAS auto on a non-TPU backend)")
+            return cap
+        with _env_override("MXTPU_PALLAS", mode):
+            mkey = cap.recapture(meta={"pallas_mode": mode})
+        after = cap.artifact.manifest["modules"][mkey]["meta"].get(
+            "custom_calls", 0)
+        cap.artifact.record_pass("pallas_substitution", mode=mode,
+                                 custom_calls_before=before,
+                                 custom_calls_after=after)
+        return cap

@@ -142,10 +142,16 @@ class BertModel(HybridBlock):
                     valid_length.reshape(b, 1, 1)).astype("float32")
             mask = mask.reshape(b, 1, 1, l)
 
+        # remat knob: False/True or a named jax.checkpoint policy
+        # string ("dots_saveable", ...); MXTPU_REMAT_POLICY overrides —
+        # the export-time remat search writes its winner through here
+        remat_on, remat_pol = npx.resolve_remat_policy(
+            getattr(self.cfg, "remat", False))
         for layer in self.layers:
-            if getattr(self.cfg, "remat", False):
+            if remat_on:
                 x = npx.remat_call(
-                    lambda t, _l=layer, _m=mask: _l(t, _m), x)
+                    lambda t, _l=layer, _m=mask: _l(t, _m), x,
+                    policy=remat_pol)
             else:
                 x = layer(x, mask)
         pooled = self.pooler(x[:, 0])

@@ -36,7 +36,11 @@ class GPTConfig:
         self.layer_norm_eps = layer_norm_eps
         self.tie_embeddings = tie_embeddings
         self.dtype = dtype
-        # recompute each layer's activations in backward (jax.checkpoint)
+        # recompute each layer's activations in backward (jax.checkpoint):
+        # False/True, OR a named jax.checkpoint policy string like
+        # "dots_saveable" (npx.resolve_remat_policy; MXTPU_REMAT_POLICY
+        # overrides, and the export-time remat-policy search writes its
+        # winner back through this knob — docs/export.md)
         self.remat = remat
         # Mistral-style sliding-window attention: each position attends the
         # last `window` tokens only — O(L·window) in the fused flash kernel
@@ -134,9 +138,16 @@ class GPTModel(HybridBlock):
             pos = npx.arange_like(input_ids, axis=1).astype("int32")
             x = x + self.position_embed(pos.reshape(1, l))
         x = self.embed_dropout(x)
+        # remat knob: False/True or a named jax.checkpoint policy
+        # string ("dots_saveable", ...); MXTPU_REMAT_POLICY overrides —
+        # the export-time remat search writes its winner through here
+        # (resolved per trace: docs/export.md)
+        remat_on, remat_pol = npx.resolve_remat_policy(
+            getattr(self.cfg, "remat", False))
         for layer in self.layers:
-            if getattr(self.cfg, "remat", False):
-                x = npx.remat_call(lambda t, _l=layer: _l(t), x)
+            if remat_on:
+                x = npx.remat_call(lambda t, _l=layer: _l(t), x,
+                                   policy=remat_pol)
             else:
                 x = layer(x)
         return self.final_norm(x)

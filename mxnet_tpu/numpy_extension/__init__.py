@@ -12,6 +12,7 @@ the systolic array.
 from __future__ import annotations
 
 import math
+import os
 from typing import Optional, Sequence, Tuple, Union
 
 import jax
@@ -40,6 +41,7 @@ __all__ = [
     "sldwin_atten_mask_like", "sldwin_atten_score", "sldwin_atten_context",
     "multi_head_attention", "ctc_loss", "foreach", "while_loop", "cond",
     "remat_call",
+    "resolve_remat_policy",
     "grid_generator", "bilinear_sampler", "spatial_transformer",
     "correlation", "im2col", "col2im", "deformable_convolution",
     "softmax_cross_entropy",
@@ -594,6 +596,45 @@ def dropout(data, p=0.5, mode="training", axes=(), cudnn_off=False):
     return apply_op(fn, (data,), {}, name="dropout")
 
 
+def resolve_remat_policy(value, env_override: bool = True):
+    """Resolve a remat knob (``GPTConfig.remat``-style) to ``(enabled,
+    jax_policy)``.
+
+    Accepted values: ``False``/``None``/``"none"``/``"off"`` (remat
+    off), ``True``/``"full"`` (checkpoint everything — recompute the
+    whole block in backward), or a named `jax.checkpoint_policies`
+    entry (``"dots_saveable"``, ``"nothing_saveable"``,
+    ``"dots_with_no_batch_dims_saveable"``, ``"everything_saveable"``,
+    ...).  With ``env_override`` (the model-knob path),
+    ``MXTPU_REMAT_POLICY`` wins over `value` — the hook the offline
+    export remat-policy search and operators share (docs/export.md).
+    Resolution happens at trace time: flipping the env mid-run does not
+    retrace a live step.  Unknown names raise `MXNetError` (a typo must
+    not silently train without remat)."""
+    from ..base import MXNetError
+    if env_override:
+        env = os.environ.get("MXTPU_REMAT_POLICY", "").strip()
+        if env:
+            value = env
+    if value is None or value is False:
+        return False, None
+    if value is True:
+        return True, None
+    name = str(value).strip().lower()
+    if name in ("0", "off", "none", "false", "no"):
+        return False, None
+    if name in ("1", "true", "full"):
+        return True, None       # jax.checkpoint default: save nothing
+    pol = getattr(jax.checkpoint_policies, name, None)
+    if pol is None or not callable(pol):
+        known = sorted(n for n in dir(jax.checkpoint_policies)
+                       if not n.startswith("_"))
+        raise MXNetError(
+            f"unknown remat policy {value!r}; expected 'none'/'full' or "
+            f"a named jax.checkpoint_policies entry: {known}")
+    return True, pol
+
+
 def remat_call(fn, *args, policy=None):
     """Run `fn(*args)` under `jax.checkpoint`: its activations are
     recomputed during the backward pass instead of stored — the
@@ -606,7 +647,17 @@ def remat_call(fn, *args, policy=None):
     the parameters `fn` closes over. Under eager tape recording this calls
     `fn` directly — remat would detach closed-over parameters from the
     tape, and eager execution materializes per-op residuals anyway.
+
+    `policy` selects WHAT the checkpoint saves: a
+    `jax.checkpoint_policies` object, or its NAME as a string
+    (``"dots_saveable"``, ...; see `resolve_remat_policy` — an explicit
+    string here is taken literally, the env override applies to the
+    model-config knob, not this argument).
     """
+    if isinstance(policy, str):
+        enabled, policy = resolve_remat_policy(policy, env_override=False)
+        if not enabled:
+            return fn(*args)
     if _tape.is_recording():
         return fn(*args)
 

@@ -38,14 +38,14 @@ def reduce_scatter(x, axis_name: str, axis: int = 0):
 
 def broadcast(x, axis_name: str, src: int = 0):
     idx = lax.axis_index(axis_name)
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     perm = [(src, i) for i in range(n)]
     return lax.ppermute(x, axis_name, perm)
 
 
 def ppermute_shift(x, axis_name: str, shift: int = 1):
     """Ring shift: device i sends to (i+shift) mod n (ring-attention hop)."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis_name, perm)
 
@@ -60,4 +60,12 @@ def axis_index(axis_name: str):
 
 
 def axis_size(axis_name: str):
-    return lax.axis_size(axis_name)
+    """Static size of a named mesh axis, trace-safe inside shard_map.
+
+    jax 0.4.x has no ``lax.axis_size``; ``lax.psum(1, axis)`` of a
+    Python literal folds to a concrete int (usable in ``range()`` for
+    ppermute permutations), which is the classic idiom the newer API
+    replaced.  One compat point for every SP/PP collective."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)

@@ -38,7 +38,8 @@ def gpipe_sharded(stage_fn: Callable, stage_params, x_mb,
     x_mb: (M, ...) microbatched input, replicated over `axis_name`.
     Returns (M, ...) outputs of the LAST stage, replicated (psum-gathered).
     """
-    s = lax.axis_size(axis_name)
+    from .collectives import axis_size
+    s = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     m = x_mb.shape[0]
     params_local = jax.tree_util.tree_map(lambda p: p[0], stage_params)

@@ -78,7 +78,8 @@ def ring_attention_sharded(q, k, v, kv_mask=None, axis_name: str = "sp",
     long-context batches stay O(L/n · L/n) per device. Returns the local
     output shard (B, H, L_local, D).
     """
-    n = lax.axis_size(axis_name)
+    from .collectives import axis_size
+    n = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / (d ** 0.5)

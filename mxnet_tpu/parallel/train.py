@@ -174,7 +174,16 @@ class ShardedTrainStep:
         # silently replicating (round-1 verdict: silent fall-through).
         self.param_shardings = {
             n: self._resolve_sharding(n, params[n]) for n in self.param_names}
-        self.pvals = {n: _put_global(params[n]._data._data,
+        # donation safety: device_put may ALIAS a same-device source
+        # buffer (the CPU replicated-placement path does) — donating an
+        # alias at step 1 would delete the caller's own param array out
+        # from under every other holder (an InferenceEngine's extracted
+        # weights, user references).  The step must OWN what it donates,
+        # so the initial placement goes through an explicit copy.
+        def _owned(x):
+            return jnp.copy(x) if self.donate and isinstance(x, jax.Array) \
+                else x
+        self.pvals = {n: _put_global(_owned(params[n]._data._data),
                                      self.param_shardings[n])
                       for n in self.param_names}
         # optimizer state: each leaf shards like its parameter, ZeRO adds
@@ -623,11 +632,14 @@ class ShardedTrainStep:
                       else b if isinstance(b, jax.Array)
                       else onp.asarray(b)
                       for b in batch]
-        if self._step_fn is None:
+        if self._step_fn is None and self._exec is None:
             with self._build_lock:
-                if self._step_fn is None:
+                if self._step_fn is None and self._exec is None:
                     self._build(batch_vals, None)
                     self._check_global_batch(batch_vals)
+        # remembered for batch-less `export()` calls (avals only)
+        self._last_batch_avals = [
+            (tuple(b.shape), onp.dtype(b.dtype)) for b in batch_vals]
         return [b if isinstance(b, jax.Array) and b.sharding == s
                 else _put_global(b, s)
                 for b, s in zip(batch_vals, self._batch_shardings)]
@@ -666,7 +678,7 @@ class ShardedTrainStep:
     # device-add saturation at 2**24; one tiny H2D per window otherwise)
     _T_HOST_REFRESH = 4096
 
-    def warmup(self, *batch, rng_key=None):
+    def warmup(self, *batch, rng_key=None, artifact=None):
         """AOT warm start: trace + compile the step for this batch's avals
         WITHOUT executing it (`.lower().compile()`), so the first real
         step runs at steady-state speed.  With ``MXTPU_COMPILE_CACHE`` set
@@ -675,8 +687,45 @@ class ShardedTrainStep:
         happens once per cluster, not once per process.  Returns the
         compile wall-time in seconds (also kept as `compile_seconds`).
 
+        ``artifact=<path>`` skips tracing entirely: the step loads the
+        export artifact (`load_export`), so ``trace_count`` stays 0.
+        With ``MXTPU_EXPORT=1`` and an export dir configured
+        (docs/export.md) the lookup is automatic — a matching artifact
+        is loaded, a missing one is captured+saved after the live
+        compile, so replica N>1 of a fleet never traces.
+
         Does not consume an RNG draw: the key is only used for its aval."""
+        if artifact is not None:
+            return self.load_export(artifact, *batch)
+        auto_path = self._auto_artifact_path(batch)
+        if auto_path is not None:
+            import os as _os
+            if _os.path.isfile(_os.path.join(auto_path, "manifest.json")):
+                try:
+                    return self.load_export(auto_path, *batch)
+                except MXNetError as e:
+                    _log.warning(
+                        "export artifact %s unusable (%s); tracing live",
+                        auto_path, str(e).splitlines()[0])
+        secs = self._warmup_live(batch, rng_key)
+        if auto_path is not None:
+            try:
+                self.export(auto_path, *batch)
+            except Exception:
+                _log.exception("auto-capture to %s failed (training "
+                               "continues uncaptured)", auto_path)
+        return secs
+
+    def _warmup_live(self, batch, rng_key=None):
         batch_vals = self._prepare_batch(batch)
+        if self._step_fn is None:
+            # artifact-loaded step being re-warmed live (new batch
+            # shape, or the export flag dropped): _prepare_batch skipped
+            # its build because _exec was set — build the jit now
+            with self._build_lock:
+                if self._step_fn is None:
+                    self._build([onp.asarray(b) for b in batch_vals],
+                                None)
         hp = self._hp()
         key = rng_key if rng_key is not None else jax.random.PRNGKey(0)
         args = (self.pvals, self.opt_state, hp, key) + tuple(batch_vals)
@@ -735,6 +784,12 @@ class ShardedTrainStep:
                             "falling back to jit",
                             str(e).splitlines()[0])
                         self._exec = None
+                        if self._step_fn is None:
+                            # artifact-loaded step (load_export): there
+                            # is no jit to fall back to yet — build one
+                            # (a LIVE trace; loud, since the zero-
+                            # retrace contract just broke on aval drift)
+                            self._build(batch_vals, None)
                         out = self._step_fn(self.pvals, self.opt_state,
                                             hp, key, *batch_vals)
                 else:
@@ -1050,6 +1105,177 @@ class ShardedTrainStep:
             # (possibly advanced) key so draws restart from PRNGKey(seed)
             g._key = None
         self.sync_params_to_block()
+
+    # -- ahead-of-time export (docs/export.md) ---------------------------
+
+    def export(self, path: str, *batch, passes=None) -> str:
+        """Capture this step's FULL jitted program (forward + backward +
+        optimizer update, grad-accum scan and skip-guard included) to a
+        versioned StableHLO artifact at `path`, optionally running an
+        offline rewrite pipeline (`export.passes`) first.  `batch`: an
+        example batch; omitted, the last dispatched batch's avals are
+        reused.  The live step is untouched (capture builds scratch
+        programs and restores every piece of compiled-step state)."""
+        from ..export import capture_train_step, PassManager
+        cap = capture_train_step(self, *batch)
+        if passes:
+            cap = PassManager(passes).run(cap)
+        return cap.save(path)
+
+    def load_export(self, path: str, *batch) -> float:
+        """Warm-start from an export artifact WITHOUT tracing: the
+        module for this step's current topology is deserialized and
+        AOT-compiled (the persistent compile cache serves the binary
+        when warm), so ``trace_count`` stays 0.  Fails fast with a
+        clear `MXNetError` on version / topology / aval / step-flag
+        mismatches (docs/export.md failure matrix).  Returns the
+        compile wall seconds (also kept as `compile_seconds`)."""
+        import os as _os
+        from ..export import load as _load, spec_from_json
+        from ..export.capture import _train_avals, _step_flags
+        la = _load(path)
+        if la.kind != "train_step":
+            raise MXNetError(
+                f"load_export: artifact at {path} is kind={la.kind!r}, "
+                "not a train_step capture")
+        topo = self.topology()
+        rec = la.artifact.module_record(topo)
+        flags = _step_flags(self)
+        for k, want in rec["meta"].items():
+            # remat is NOT an equality gate: the artifact's baked policy
+            # is authoritative (replicas can't know an offline search's
+            # winner up front) — it is warned about and adopted below.
+            # It IS part of export_signature, so the auto-capture path
+            # never silently matches across differing local knobs.
+            if k == "remat_policy":
+                continue
+            if k in flags and flags[k] != want:
+                raise MXNetError(
+                    f"export artifact {path} was captured with {k}="
+                    f"{want!r} but this step runs {k}={flags[k]!r}; the "
+                    "compiled program would not match — re-capture or "
+                    "construct the step with matching settings")
+        art_remat = rec["meta"].get("remat_policy")
+        # batch specs/shardings come from the manifest (no _build runs).
+        # Everything below validates into LOCALS first: a failed load
+        # must leave the step untouched, or warmup()'s live-trace
+        # fallback would build against the artifact's stale specs.
+        if rec.get("batch_specs") is not None:
+            specs = tuple(spec_from_json(s) for s in rec["batch_specs"])
+        else:
+            specs = self.batch_specs
+        if specs is None:
+            raise MXNetError(
+                f"export artifact {path} predates batch_specs recording; "
+                "re-capture it")
+        shardings = tuple(NamedSharding(self.mesh, s) for s in specs)
+        if batch:
+            batch_vals = [b._data if hasattr(b, "_data")
+                          else b if isinstance(b, jax.Array)
+                          else onp.asarray(b) for b in batch]
+        else:
+            batch_vals = [onp.zeros(tuple(s), onp.dtype(d))
+                          for s, d in rec["batch_avals"]]
+        live = (self.pvals, self.opt_state, self._hp(),
+                jax.random.PRNGKey(0)) + tuple(
+                    jax.ShapeDtypeStruct(tuple(b.shape), b.dtype)
+                    for b in batch_vals)
+        la.artifact.check_avals(topo, live)
+        exported = la.exported_for(topo)   # deserialize failure raises
+        # aval/flag validation passed.  The remaining steps (global-
+        # batch cross-check, AOT compile of the deserialized module)
+        # need the loaded specs installed, but can still fail — e.g. a
+        # module captured for another platform raising from lower() —
+        # so roll the step back to its prior state on ANY failure:
+        # warmup()'s live-trace fallback must never build against a
+        # half-loaded artifact's specs.
+        saved = (self.batch_specs,
+                 getattr(self, "_batch_shardings", None),
+                 getattr(self, "_last_batch_avals", None))
+        self.batch_specs = specs
+        self._batch_shardings = shardings
+        self._last_batch_avals = [
+            (tuple(b.shape), onp.dtype(b.dtype)) for b in batch_vals]
+        try:
+            # the live path's first _build runs the identical-global-
+            # batch cross-check; the artifact path must too (a fleet
+            # cold-starting from artifacts is exactly where a per-host-
+            # shard data bug would otherwise train on a patchwork)
+            if batch:
+                self._check_global_batch(batch_vals)
+            avals = _train_avals(self, batch_vals)
+            if _tele.enabled():
+                _tele.event("compile_start", step=self._t,
+                            kind="export_load")
+            t0 = time.perf_counter()
+            with _health.suppress_stalls("export_load_compile"):
+                compiled = jax.jit(
+                    exported.call,
+                    donate_argnums=(0, 1) if self.donate else ()
+                ).lower(*avals).compile()
+        except BaseException:
+            (self.batch_specs, self._batch_shardings,
+             self._last_batch_avals) = saved
+            raise
+        self.compile_seconds = time.perf_counter() - t0
+        self._exec = compiled
+        self._step_fn = None     # no live jit: the artifact IS the program
+        # adopt the artifact's baked remat policy into the model knob so
+        # any LATER live retrace (aval drift, reshard) lowers the same
+        # program — and warn when it differs from the local setting
+        # (e.g. an artifact captured without remat loaded into a step
+        # whose operator set remat to fit HBM: the loaded program wins)
+        if art_remat is not None:
+            from ..export.capture import _find_cfg, _resolved_remat
+            local = _resolved_remat(self)
+            if local != art_remat:
+                _log.warning(
+                    "export artifact %s bakes remat policy %r but this "
+                    "model is configured %r; the artifact's program "
+                    "wins (cfg.remat updated to match — watch HBM if "
+                    "you relied on the local setting)",
+                    path, art_remat, local)
+            cfg = _find_cfg(self.block)
+            if cfg is not None and hasattr(cfg, "remat"):
+                cfg.remat = False if art_remat == "none" else art_remat
+        if _tele.enabled():
+            _tele.event("compile_end", step=self._t, kind="export_load",
+                        seconds=round(self.compile_seconds, 4),
+                        artifact=_os.path.basename(_os.path.abspath(path)))
+        return self.compile_seconds
+
+    def export_signature(self, batch=()) -> str:
+        """Deterministic identity for auto-capture artifact names: the
+        program is a function of param/state avals, batch avals, mesh
+        topology, optimizer, step flags, backend, and jax version."""
+        from ..export import signature
+        from ..export.capture import _step_flags
+        import jax as _jax
+        pav = [(n, tuple(v.shape), str(v.dtype))
+               for n, v in sorted(self.pvals.items())]
+        if batch:
+            bav = [(tuple(b.shape), str(onp.asarray(
+                        b._data if hasattr(b, "_data") else b).dtype))
+                   for b in batch]
+        else:
+            bav = [(tuple(s), str(d))
+                   for s, d in getattr(self, "_last_batch_avals", ())]
+        return signature([
+            pav, bav, sorted(self.topology()["axes"].items()),
+            self.topology()["devices"], _step_flags(self),
+            _jax.__version__, _jax.default_backend()])
+
+    def _auto_artifact_path(self, batch):
+        """MXTPU_EXPORT=1 + an export dir -> this step's auto artifact
+        directory; None when auto capture is off."""
+        import os as _os
+        from ..export import auto_capture_enabled, export_dir
+        if not auto_capture_enabled():
+            return None
+        d = export_dir()
+        if not d:
+            return None
+        return _os.path.join(d, f"train-{self.export_signature(batch)}")
 
     # -- elastic mesh reformation ----------------------------------------
 

@@ -42,7 +42,8 @@ def ulysses_attention_sharded(q, k, v, kv_mask=None, axis_name: str = "sp",
     full-sequence, head-sharded blocks; defaults to the flash/reference
     dispatcher (masks stay on the Pallas kernel as its bias input).
     """
-    n = lax.axis_size(axis_name)
+    from .collectives import axis_size
+    n = axis_size(axis_name)
     b, h, l_loc, d = q.shape
     if h % n != 0:
         raise MXNetError(

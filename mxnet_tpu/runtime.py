@@ -40,6 +40,13 @@ _STATIC = {
     # inference serving stack (PR 6): paged KV cache + ragged paged
     # attention + continuous batching (`mx.serve`, MXTPU_SERVE_*)
     "SERVING": True,
+    # ahead-of-time export + offline graph-rewrite pipeline (PR 9):
+    # StableHLO artifacts, remat-policy search, zero-retrace loads
+    # (`mx.export`, MXTPU_EXPORT_DIR / MXTPU_EXPORT; docs/export.md).
+    # Artifacts store their module hash: a load compiles the identical
+    # HLO, so the persistent compile cache (MXTPU_COMPILE_CACHE) serves
+    # the XLA binary once per cluster.
+    "EXPORT": True,
 }
 
 

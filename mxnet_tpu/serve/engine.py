@@ -189,27 +189,131 @@ class InferenceEngine:
         self._step_fns[C] = fn
         return fn
 
-    def warmup(self) -> float:
+    def warmup(self, artifact: Optional[str] = None) -> float:
         """AOT-compile the mixed prefill step and the C=1 decode step
         (``.lower().compile()`` — no step executed, the
         `ShardedTrainStep.warmup` idiom).  Returns total compile seconds;
         with ``MXTPU_COMPILE_CACHE`` set the binaries come back from the
-        persistent cache on a warm start."""
+        persistent cache on a warm start.
+
+        ``artifact=<path>`` (or an auto-matched artifact under the
+        export dir — docs/export.md) skips the AOT lower entirely: both
+        widths deserialize from the StableHLO capture, so NO transformer
+        Python is traced in this process.  With ``MXTPU_EXPORT=1`` a
+        missing artifact is captured+saved after the live compile —
+        replica N>1 of a fleet cold-starts from the artifact."""
         t0 = time.perf_counter()
+        if artifact is not None:
+            # an EXPLICIT artifact is a contract: a missing or
+            # mismatched one raises (docs/export.md "never a silent
+            # retrace") — only the auto-discovered path degrades
+            self.load_export(artifact)
+            self.compile_seconds = time.perf_counter() - t0
+            return self.compile_seconds
+        path = self._auto_artifact_path()
+        if path is not None and \
+                os.path.isfile(os.path.join(path, "manifest.json")):
+            try:
+                self.load_export(path)
+                self.compile_seconds = time.perf_counter() - t0
+                return self.compile_seconds
+            except MXNetError as e:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "serve export artifact %s unusable (%s); compiling "
+                    "live", path, str(e).splitlines()[0])
         for C in {self.serve_config.prefill_chunk, 1}:
             self._compile(C)
         self.compile_seconds = time.perf_counter() - t0
+        if artifact is None and path is not None:
+            try:
+                self.export(path)
+            except Exception:
+                import logging
+                logging.getLogger(__name__).exception(
+                    "serve auto-capture to %s failed", path)
         return self.compile_seconds
 
-    def _compile(self, C: int):
-        ex = self._execs.get(C)
-        if ex is not None:
-            return ex
-        fn = self._step_fn(C)
+    # -- ahead-of-time export (docs/export.md) -------------------------
+    def export(self, path: str) -> str:
+        """Capture both compiled step widths to an export artifact."""
+        from ..export import capture_serve
+        return capture_serve(self).save(path)
+
+    def load_export(self, path: str) -> None:
+        """Install both step widths from an artifact — zero model
+        traces in this process.  Fails fast on kind/config/aval
+        mismatch (docs/export.md failure matrix)."""
+        from ..export import load as _load
+        la = _load(path)
+        if la.kind != "serve_step":
+            raise MXNetError(
+                f"engine.load_export: artifact at {path} is kind="
+                f"{la.kind!r}, not a serve_step capture")
+        want = self._export_config()
+        got = la.manifest.get("meta", {}).get("serve_config", {})
+        if got != want:
+            raise MXNetError(
+                f"serve export artifact {path} was captured for config "
+                f"{got} but this engine runs {want}; re-capture")
+        # stage into a local dict: a failure on the SECOND width must
+        # not leave a half-artifact engine (live fallback would keep
+        # the already-installed exec via _compile's early return)
+        staged = {}
+        for C in sorted({self.serve_config.prefill_chunk, 1}):
+            avals = self._step_avals(C)
+            topo = {"devices": 1, "axes": {}}
+            la.artifact.check_avals(topo, avals, tag=f"c{C}")
+            exp = la.exported_for(topo, tag=f"c{C}")
+            if _tele.enabled():
+                _tele.event("compile_start", kind="serve_export_load",
+                            chunk=C)
+            t0 = time.perf_counter()
+            with _health.suppress_stalls("serve_export_compile"):
+                staged[C] = jax.jit(
+                    exp.call, donate_argnums=(1,)
+                ).lower(*avals).compile()
+            if _tele.enabled():
+                _tele.event("compile_end", kind="serve_export_load",
+                            chunk=C,
+                            seconds=round(time.perf_counter() - t0, 4))
+        self._execs.update(staged)
+
+    def _export_config(self) -> dict:
+        sc = self.serve_config
+        return {"max_slots": sc.max_slots, "page_size": sc.page_size,
+                "prefill_chunk": sc.prefill_chunk,
+                "max_len": self.max_len,
+                "kv_dtype": sc.kv_dtype or self.cfg.dtype,
+                "top_k": sc.top_k, "top_p": sc.top_p}
+
+    def _auto_artifact_path(self) -> Optional[str]:
+        # MXTPU_EXPORT=1 gates BOTH auto-load and auto-capture (the
+        # train-side rule): the signature hashes avals/config/backend,
+        # not code, so an un-opted-in engine must never silently serve
+        # a stale artifact left in the store by an earlier run
+        from ..export import auto_capture_enabled, export_dir, signature
+        if not auto_capture_enabled():
+            return None
+        d = export_dir()
+        if not d:
+            return None
+        import jax as _jax
+        leaves = jax.tree_util.tree_flatten_with_path(self.P)[0]
+        pav = sorted((str(p), tuple(v.shape), str(v.dtype))
+                     for p, v in leaves)
+        sig = signature([pav, sorted(self._export_config().items()),
+                         self.quantized, _jax.__version__,
+                         _jax.default_backend()])
+        return os.path.join(d, f"serve-{sig}")
+
+    def _step_avals(self, C: int):
+        """The aval tuple one fused step takes at chunk width C (shared
+        by AOT compile and export capture)."""
         B = self.serve_config.max_slots
         sd = jax.ShapeDtypeStruct
         i32 = jnp.int32
-        avals = (
+        return (
             jax.tree_util.tree_map(
                 lambda x: sd(x.shape, x.dtype), self.P),
             tuple(sd(a.shape, a.dtype)
@@ -219,6 +323,13 @@ class InferenceEngine:
             sd((B,), jnp.float32), sd((B,), jnp.bool_),
             sd(self._key.shape, self._key.dtype),
         )
+
+    def _compile(self, C: int):
+        ex = self._execs.get(C)
+        if ex is not None:
+            return ex
+        fn = self._step_fn(C)
+        avals = self._step_avals(C)
         if _tele.enabled():
             _tele.event("compile_start", kind="serve_step", chunk=C)
         t0 = time.perf_counter()

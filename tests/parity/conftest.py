@@ -80,3 +80,22 @@ class _X64Module(pytest.Module):
 
 def pytest_pycollect_makemodule(module_path, parent):
     return _X64Module.from_parent(parent, path=module_path)
+
+
+def pytest_collection_modifyitems(config, items):
+    """The whole conformance tier is `slow`: before PR 9's x64_scope fix
+    every one of these ~900 tests ERRORED at setup in seconds (the
+    tier-1 log's long-carried `921 errors`); actually EXECUTING the
+    ported reference bodies takes 15+ minutes — far past the tier-1
+    wall-clock budget, and alphabetical collection order would let a
+    slow parity tier starve the unittest dots behind it.  `make
+    test-parity` (and any explicit `-m parity` / `-m parity_wip` run)
+    still executes everything (only `-m 'not slow'` deselects).
+
+    NOTE: this hook is session-scoped even in a directory conftest —
+    it receives EVERY collected item, so filter to this tier's path."""
+    here = os.path.dirname(os.path.abspath(__file__)) + os.sep
+    slow = pytest.mark.slow
+    for item in items:
+        if str(item.fspath).startswith(here):
+            item.add_marker(slow)

@@ -16,6 +16,18 @@ import pytest
 
 ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
 
+# The two-process jobs need cross-process collectives on the CPU
+# backend, which this container's jaxlib does not implement
+# ("Multiprocess computations aren't implemented on the CPU backend").
+# Tier-1 triage (docs/migration.md "Known environment limits"): xfail
+# until a jaxlib with CPU multi-process collectives (or a real
+# multi-host TPU run, where the code path is the production one) is
+# available; strict=False so a capable environment reports them green.
+pytestmark = pytest.mark.xfail(
+    reason="jaxlib CPU backend lacks multi-process collectives in this "
+           "container (pre-existing since seed; see docs/migration.md)",
+    strict=False)
+
 
 def _free_port():
     s = socket.socket()

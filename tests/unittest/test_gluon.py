@@ -164,7 +164,7 @@ def test_grad_through_block():
     with mx.autograd.record():
         y = net(x).sum()
     y.backward()
-    g = net.weight.grad
+    g = net.weight.grad()   # Parameter.grad is a method (reference API)
     assert_almost_equal(g, onp.asarray(x).sum(axis=0, keepdims=True),
                         rtol=1e-5, atol=1e-5)
 

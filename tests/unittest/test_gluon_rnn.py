@@ -170,7 +170,7 @@ class TestConvAndProjectedCells:
             out, _ = cell.unroll(3, x)
             loss = (out ** 2).sum()
         loss.backward()
-        g = cell.i2h_weight.grad
+        g = cell.i2h_weight.grad()   # Parameter.grad is a method
         assert float(mx.np.abs(g).sum()) > 0
 
     def test_even_h2h_kernel_rejected(self):

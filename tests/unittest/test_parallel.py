@@ -64,7 +64,10 @@ def test_ring_attention_causal():
 
 def test_collectives_shard_map():
     from jax.sharding import Mesh
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:   # jax 0.4.x: experimental only
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
     mesh = make_mesh({"dp": 8}, _cpu_devices(8))
     x = jnp.arange(8.0)

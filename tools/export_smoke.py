@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""Export/AOT smoke (docs/export.md; wired into `make test`).
+
+Under 60 s on CPU: capture a small GPT train step + serving step
+through the offline pass pipeline (remat-policy search under a
+synthetic tight HBM budget + a sharding no-op retarget), then reload
+BOTH in a fresh process and assert:
+
+- the loaded train step's losses are bit-identical to the capturing
+  process's live-traced losses (3 steps), with ``trace_count == 0``
+  on the loaded path (zero Python-level retraces),
+- the loaded serving engine streams bit-identical tokens,
+- the remat search picked a NON-default policy (the tight budget
+  excludes the no-remat program) and recorded its candidate table,
+- stale-version and wrong-topology artifacts fail fast with clear
+  errors.
+
+Usage: ``python tools/export_smoke.py`` (parent), or with ``--role
+capture|load <dir>`` as one of the two child processes.
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _child_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = flags + \
+            " --xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("MXTPU_REMAT_POLICY", None)   # the search must own the knob
+    return env
+
+
+def _build(seed=0):
+    import mxnet_tpu as mx
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu import random as mxrng
+    from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from mxnet_tpu.parallel import make_mesh, make_sharded_train_step
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+
+    mxrng.seed(seed)
+    cfg = GPTConfig(vocab_size=256, hidden_size=32, num_layers=2,
+                    num_heads=4, intermediate_size=64, max_position=64,
+                    dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.initialize()
+    rng = onp.random.RandomState(0)
+    ids = mx.np.array(rng.randint(0, 256, (8, 16)), dtype="int32")
+    labels = mx.np.array(rng.randint(0, 256, (8, 16)), dtype="int32")
+    model(ids)
+
+    def loss_fn(out, input_ids, labels):
+        from mxnet_tpu.ops.pallas.softmax_xent import softmax_cross_entropy
+        o = out._data if hasattr(out, "_data") else out
+        return jnp.mean(softmax_cross_entropy(o, labels.astype(jnp.int32)))
+
+    mesh = make_mesh({"dp": 4, "tp": 2}, jax.devices())
+    step = make_sharded_train_step(model, opt.Adam(learning_rate=1e-3),
+                                   loss_fn, mesh, num_model_args=1)
+    return model, step, ids, labels
+
+
+def _serve_engine(model):
+    from mxnet_tpu.serve import InferenceEngine, ServeConfig
+    return InferenceEngine(model, ServeConfig(max_len=64, max_slots=4))
+
+
+def role_capture(art_dir):
+    import jax
+    from mxnet_tpu.export import (PassManager, RematSearchPass,
+                                  ShardingRetargetPass, capture_train_step)
+
+    model, step, ids, labels = _build()
+    # synthetic tight budget: params/state/args fit, the no-remat
+    # activation set does not — the search MUST land off-default
+    cap = capture_train_step(step, ids, labels)
+    rec = cap.artifact.module_record(step.topology())
+    stats = cap.compile_stats()
+    arg_bytes = stats["argument_bytes"] or 0
+    from mxnet_tpu.export.passes import _analytic_saved_bytes
+    cfg = model.cfg
+    tight = arg_bytes + int(
+        (_analytic_saved_bytes(cfg, rec["batch_avals"], "none") +
+         _analytic_saved_bytes(cfg, rec["batch_avals"], "dots_saveable"))
+        / 2)
+    cap = PassManager([
+        RematSearchPass(hbm_budget=float(tight)),
+        ShardingRetargetPass({"dp": 2, "tp": 2}),
+    ]).run(cap)
+    cap.save(os.path.join(art_dir, "train"))
+
+    eng = _serve_engine(model)
+    eng.warmup()
+    eng.export(os.path.join(art_dir, "serve"))
+
+    # live reference numbers AFTER capture (cfg.remat now = winner)
+    losses = [float(jax.device_get(step.dispatch(ids, labels).loss))
+              for _ in range(3)]
+    tokens = eng.generate(list(range(1, 9)), max_new_tokens=6)
+    man = json.load(open(os.path.join(art_dir, "train",
+                                      "manifest.json")))
+    return {"losses": losses, "tokens": tokens,
+            "remat_policy": man["remat_policy"],
+            "live_trace_count": step.trace_count,
+            "passes": [p["name"] for p in man["passes"]]}
+
+
+def role_load(art_dir):
+    import jax
+    from mxnet_tpu.base import MXNetError
+
+    model, step, ids, labels = _build()
+    # serve first: the engine extracts the block's (initial) weights —
+    # after train dispatches they are mesh-sharded trained values, which
+    # would neither match the capture child's reference tokens nor the
+    # single-device serve executable's avals
+    eng = _serve_engine(model)
+    eng.warmup(artifact=os.path.join(art_dir, "serve"))
+    tokens = eng.generate(list(range(1, 9)), max_new_tokens=6)
+
+    step.load_export(os.path.join(art_dir, "train"), ids, labels)
+    losses = [float(jax.device_get(step.dispatch(ids, labels).loss))
+              for _ in range(3)]
+    assert step.trace_count == 0, \
+        f"loaded path traced {step.trace_count}x (contract: 0)"
+
+    # failure matrix: stale version + wrong topology fail FAST
+    man_path = os.path.join(art_dir, "train", "manifest.json")
+    man = json.load(open(man_path))
+    man["format_version"] = 999
+    stale_dir = os.path.join(art_dir, "stale")
+    import shutil
+    shutil.copytree(os.path.join(art_dir, "train"), stale_dir)
+    with open(os.path.join(stale_dir, "manifest.json"), "w") as f:
+        json.dump(man, f)
+    try:
+        step2 = _build()[1]
+        step2.load_export(stale_dir, ids, labels)
+        raise AssertionError("stale-version artifact loaded silently")
+    except MXNetError as e:
+        assert "format_version" in str(e), e
+    from mxnet_tpu.parallel import make_mesh, make_sharded_train_step
+    try:
+        from mxnet_tpu.export import load
+        la = load(os.path.join(art_dir, "train"))
+        la.artifact.module_bytes({"devices": 3, "axes": {"dp": 3}})
+        raise AssertionError("wrong-topology lookup did not raise")
+    except MXNetError as e:
+        assert "topology" in str(e), e
+
+    return {"losses": losses, "tokens": tokens,
+            "trace_count": step.trace_count}
+
+
+def main():
+    if "--role" in sys.argv:
+        i = sys.argv.index("--role")
+        role, art_dir = sys.argv[i + 1], sys.argv[i + 2]
+        out = role_capture(art_dir) if role == "capture" \
+            else role_load(art_dir)
+        print("SMOKE_JSON:" + json.dumps(out))
+        return
+
+    with tempfile.TemporaryDirectory(prefix="mxtpu_export_smoke_") as art:
+        results = {}
+        for role in ("capture", "load"):
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--role", role, art],
+                capture_output=True, text=True, timeout=540,
+                env=_child_env(), cwd=REPO)
+            if proc.returncode != 0:
+                sys.stderr.write(proc.stdout[-2000:])
+                sys.stderr.write(proc.stderr[-4000:])
+                raise SystemExit(f"export smoke: {role} child failed "
+                                 f"(rc={proc.returncode})")
+            for line in proc.stdout.splitlines():
+                if line.startswith("SMOKE_JSON:"):
+                    results[role] = json.loads(line[len("SMOKE_JSON:"):])
+    capt, load = results["capture"], results["load"]
+    assert load["trace_count"] == 0, load
+    assert load["losses"] == capt["losses"], \
+        f"loss drift: live {capt['losses']} vs loaded {load['losses']}"
+    assert load["tokens"] == capt["tokens"], \
+        f"token drift: live {capt['tokens']} vs loaded {load['tokens']}"
+    assert capt["remat_policy"] not in (None, "none"), \
+        f"remat search stayed on the default: {capt['remat_policy']!r}"
+    assert "remat_search" in capt["passes"] and \
+        "sharding_retarget" in capt["passes"], capt["passes"]
+    print("export smoke OK: 3-step loss parity "
+          f"{load['losses'][0]:.6f}.., tokens {load['tokens'][:6]}.., "
+          f"loaded trace_count=0, remat winner {capt['remat_policy']!r}")
+
+
+if __name__ == "__main__":
+    main()
