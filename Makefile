@@ -2,7 +2,7 @@
 # to what a single-language-core framework needs).
 PY ?= python
 
-.PHONY: ci test test-all test-dist test-parity lint bench cpp docs clean opperf-check telemetry-smoke health-smoke chaos-smoke serve-smoke kernels-smoke elastic-smoke export-smoke perf-gate
+.PHONY: ci test test-all test-dist test-parity lint bench cpp docs clean opperf-check telemetry-smoke health-smoke chaos-smoke serve-smoke kernels-smoke elastic-smoke export-smoke data-smoke perf-gate
 
 # the one-command gate CI runs (VERDICT round-2 next-step #7): lint +
 # unit suite + 2-process dist tests + C++ package build/tests
@@ -17,7 +17,7 @@ cpp-test:
 # `make test-all` runs everything.  -n auto parallelizes when xdist +
 # cores are available: ~13.5 min serial on the 1-core builder VM,
 # well under 10 min on any >=2-core box
-test: telemetry-smoke health-smoke chaos-smoke serve-smoke kernels-smoke elastic-smoke export-smoke
+test: telemetry-smoke health-smoke chaos-smoke serve-smoke kernels-smoke elastic-smoke export-smoke data-smoke
 	$(PY) -m pytest tests/unittest -q -m "not slow" $$($(PY) -c 'import xdist, os; print("-n auto" if (os.cpu_count() or 1) > 1 else "")' 2>/dev/null) --ignore=tests/unittest/test_dist_kvstore.py
 
 test-all:
@@ -77,6 +77,17 @@ chaos-smoke:
 # (docs/resilience.md, "Elastic scale-out")
 elastic-smoke:
 	$(PY) tools/elastic_smoke.py
+
+# deterministic data pipeline end-to-end (docs/data.md): a training
+# child over mixture+packed RecordIO shards dies mid-epoch after 12
+# batches; a FRESH process restores from the pipeline-attached
+# CheckpointManager (O(1) manifest seek, no replay) and its stream must
+# be bit-identical to an uninterrupted reference run.  Also proves a
+# 1->2->1 host shrink/grow reform delivers every sample exactly once,
+# and that the packed data path causes zero retraces (trace_count==1
+# over 8 prefetched batches)
+data-smoke:
+	$(PY) tools/data_smoke.py
 
 # serving-stack end-to-end: 8 staggered concurrent requests through the
 # continuous-batching scheduler over a deliberately undersized paged KV

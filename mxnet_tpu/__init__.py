@@ -87,6 +87,7 @@ from .util import (  # noqa: F401  (reference exposes these at top level)
 from . import test_utils  # noqa: F401
 from . import recordio  # noqa: F401
 from . import io  # noqa: F401
+from . import data  # noqa: F401
 from . import image  # noqa: F401
 from . import ops  # noqa: F401
 from . import models  # noqa: F401

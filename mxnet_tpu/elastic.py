@@ -443,6 +443,17 @@ class ElasticLoop:
     the default policy when the env var is set; pass ``recovery=False``
     to opt out explicitly.
 
+    `pipeline` (optional): a `data.DataPipeline` — attaching it couples
+    the input stream to the checkpoint manager (`attach_pipeline`): every
+    manifest carries the stream position and every restore — initial
+    resume, preemption marker, tier-2 rollback, step-failure retry, and
+    the mesh controller's host-loss path (it rides this loop's manager) —
+    O(1)-seeks the stream instead of replaying it.  Pair with
+    `data_reset`, called after every restore/reform with the resumed
+    step: rebuild whatever wraps the (already re-seeked) pipeline — the
+    loop closes the old `prefetcher` first, and adopts the hook's return
+    value as the new one when it returns a prefetcher.
+
     `prefetcher` (optional): a `DevicePrefetcher` the preemption path
     cancels and the rollback path fast-forwards (`data_skip` overrides
     the per-step fast-forward hook).
@@ -466,7 +477,8 @@ class ElasticLoop:
                  recovery=None, prefetcher=None,
                  preempt_grace: Optional[float] = None,
                  data_skip: Optional[Callable[[int], None]] = None,
-                 mesh_controller=None):
+                 mesh_controller=None, pipeline=None,
+                 data_reset: Optional[Callable[[int], object]] = None):
         self.target = target
         self.manager = CheckpointManager(directory, keep=keep)
         self.save_every = save_every
@@ -487,8 +499,29 @@ class ElasticLoop:
         self.recovery = recovery or None   # False -> None
         self.prefetcher = prefetcher
         self.preempt_grace = preempt_grace
-        if data_skip is None and prefetcher is not None:
-            data_skip = lambda _step: prefetcher.skip(1)  # noqa: E731
+        self.pipeline = pipeline
+        self.data_reset = data_reset
+        if pipeline is not None:
+            # checkpoints now carry the stream position; every restore
+            # below seeks instead of replaying (docs/data.md)
+            self.manager.attach_pipeline(pipeline)
+            if prefetcher is not None and data_reset is None:
+                # every restore quiesces the prefetcher before the seek;
+                # without a rebuild hook the loop would run on from a
+                # permanently dead window — refuse up front, not at the
+                # first post-restore next()
+                raise MXNetError(
+                    "ElasticLoop(pipeline=..., prefetcher=...) needs "
+                    "data_reset= too: restores close the prefetch window "
+                    "around the pipeline seek, and the hook rebuilds it "
+                    "(return the new DevicePrefetcher) — docs/data.md")
+        if data_skip is None and (prefetcher is not None
+                                  or pipeline is not None):
+            # reads self.prefetcher/self.pipeline at CALL time: after a
+            # restore the data_reset hook may have swapped the
+            # prefetcher, and skipping on the closed old one would drop
+            # nothing while reporting the poison batch skipped
+            data_skip = self._default_data_skip
         self.data_skip = data_skip
         # elastic mesh reformation (parallel.elastic_mesh): topology
         # changes are consumed between steps like recovery remediations;
@@ -505,6 +538,42 @@ class ElasticLoop:
         self._replay_skip: set = set()
 
     _deferred_failures = 0
+
+    def _default_data_skip(self, _step: int) -> None:
+        """Poison fast-forward: drop one batch from the CURRENT
+        prefetcher (it may have been rebuilt since construction), else
+        advance the pipeline directly."""
+        if self.prefetcher is not None:
+            self.prefetcher.skip(1)
+        elif self.pipeline is not None:
+            self.pipeline.skip_batches(1)
+
+    def _quiesce_data(self) -> None:
+        """Stop the prefetch thread before a restore re-seeks the
+        attached pipeline: a producer pulling batches concurrently with
+        `load_state` would interleave pre- and post-seek reads.  Buffered
+        batches are dropped by design — the seek makes them reachable
+        again in O(1), which is the whole point."""
+        if self.pipeline is not None and self.prefetcher is not None:
+            try:
+                self.prefetcher.close()
+            except Exception:
+                _log.exception("elastic: prefetcher quiesce failed")
+
+    def _reset_data(self, step: int) -> None:
+        """After a restore/reform landed on `step`: let the owner rebuild
+        whatever wraps the (already re-seeked) pipeline.  A hook that
+        returns a `DevicePrefetcher` becomes the loop's new one (the old
+        window was dropped by `_quiesce_data`)."""
+        if self.data_reset is None:
+            return
+        try:
+            pf = self.data_reset(step)
+            if pf is not None:
+                self.prefetcher = pf
+        except Exception:
+            _log.exception("elastic: data_reset hook failed at step %d "
+                           "(continuing with the current data path)", step)
 
     def _drain_async_tolerant(self):
         """Surface-but-survive a deferred async-write failure: the loop's
@@ -543,6 +612,7 @@ class ElasticLoop:
         is present: a marker naming a complete emergency checkpoint pins
         the resume to exactly that step (the marker is cleared either
         way — it describes one preemption, not a standing instruction)."""
+        self._quiesce_data()
         marker = _recovery.read_resume_marker(self.manager.directory)
         if marker is not None:
             _recovery.clear_resume_marker(self.manager.directory)
@@ -588,6 +658,7 @@ class ElasticLoop:
                 _log.exception("elastic: in-flight drain before rollback "
                                "failed")
         self._drain_async_tolerant()
+        self._quiesce_data()
         multi = jax.process_count() > 1
         if multi:
             cand = self.manager.newest_healthy()
@@ -638,6 +709,7 @@ class ElasticLoop:
             current, restored, reason, len(poison),
             f", discarded {len(discarded)} newer checkpoint(s)"
             if discarded else "")
+        self._reset_data(restored)
         return restored
 
     def _perform_reform(self, change, current: int) -> int:
@@ -646,7 +718,14 @@ class ElasticLoop:
         step to resume from — live reshards resume where they left off,
         loss reforms at the multi-host agreed checkpoint step."""
         self._drain_async_tolerant()
+        self._quiesce_data()
         resume = self.mesh_controller.reform(change, current)
+        # the reform may have restored a checkpoint through this loop's
+        # manager (host-loss path) — with a pipeline attached, that
+        # restore already re-seeked the stream; the hook re-derives the
+        # host view (`pipeline.set_hosts`) for the new topology and
+        # rebuilds the prefetch window
+        self._reset_data(resume)
         _tele.event("remediation", step=resume, kind="mesh_reform",
                     reason=change.reason, tier=0, from_step=current)
         return resume
@@ -672,6 +751,7 @@ class ElasticLoop:
         consecutive = 0    # failed recoveries in a row, bounds the retry
         rollbacks = 0      # policy-driven (tier-2) rollbacks
         start = self._resume_start()
+        self._reset_data(start)
         if start:
             _log.info("elastic: resumed from checkpoint at step %d", start)
         elif self.manager.latest() is None:
@@ -828,7 +908,9 @@ class ElasticLoop:
                                     f"elastic: step {i} failed after "
                                     f"{self.max_restores} restores") from e
                             self._drain_async_tolerant()
+                            self._quiesce_data()
                             rollback = self.manager.restore(self.target)
+                            self._reset_data(rollback)
                             _log.warning(
                                 "elastic: step %d failed (%s); restored "
                                 "checkpoint at step %d (restore %d/%d)",

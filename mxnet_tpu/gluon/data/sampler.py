@@ -28,12 +28,40 @@ class SequentialSampler(Sampler):
 
 
 class RandomSampler(Sampler):
-    def __init__(self, length):
+    """Shuffled indices, reproducibly.
+
+    The reference implementation called the GLOBAL `np.random.permutation`:
+    it silently mutated process-wide RNG state (perturbing every other
+    consumer of `np.random`), and on a multi-host job each host shuffled
+    DIFFERENTLY — the same "batch" trained on different data per host.
+    This one draws from a local `numpy.random.Generator` keyed by
+    ``(seed, epoch)``: identical on every host, zero global state
+    touched, and each epoch reshuffles (the epoch advances automatically
+    per full iteration; `set_epoch` pins it — call it with the restored
+    epoch to resume a run deterministically).
+
+    `seed` default: ``MXTPU_DATA_SEED``, else 0.
+    """
+
+    def __init__(self, length, seed=None):
         self._length = length
+        if seed is None:
+            from ...data.pipeline import default_data_seed
+            seed = default_data_seed()
+        self._seed = int(seed)
+        self._epoch = 0
+
+    def set_epoch(self, epoch):
+        """Pin the epoch the next iteration shuffles for (resume,
+        explicit epoch-keyed loops). Auto-advance continues from it."""
+        self._epoch = int(epoch)
 
     def __iter__(self):
-        indices = _onp.random.permutation(self._length)
-        return iter(indices.tolist())
+        from ...data.order import mix64
+        epoch, self._epoch = self._epoch, self._epoch + 1
+        gen = _onp.random.Generator(
+            _onp.random.PCG64(mix64(self._seed) ^ mix64(0xE9 + epoch)))
+        return iter(gen.permutation(self._length).tolist())
 
     def __len__(self):
         return self._length

@@ -94,6 +94,13 @@ class DevicePrefetcher:
         self._occ_sum = 0
         self._batches = 0
         self._wait_s = 0.0
+        # checkpoint-lag accounting (data.DataPipeline): how far this
+        # prefetcher has pulled the source AHEAD of the consumer — the
+        # number of batches a naive "current source state" checkpoint
+        # would skip on resume (the pipeline's state ring exists to make
+        # that lag harmless; pending() makes it observable)
+        self._pulled = 0
+        self._delivered = 0
         self._thread = threading.Thread(target=self._worker,
                                         name="mxtpu-prefetch", daemon=True)
         self._thread.start()
@@ -132,6 +139,7 @@ class DevicePrefetcher:
                 if self._stop.is_set():
                     return
                 fault_point("prefetch_next")
+                self._pulled += 1
                 # named heartbeat for the hang watchdog (mx.health): a
                 # wedged placement/source stops touching it and shows up
                 # by name in the stall dump
@@ -174,6 +182,7 @@ class DevicePrefetcher:
         self._wait_s += wait
         if kind == "item":
             self._batches += 1
+            self._delivered += 1
             occ = self._q.qsize()
             self._occ_sum += occ
             if _tele.enabled():
@@ -253,17 +262,27 @@ class DevicePrefetcher:
         except Exception:
             pass
 
+    def pending(self) -> int:
+        """Batches pulled from the source but not yet delivered to the
+        consumer (buffered + in placement).  This is the gap between
+        "where the source is" and "where training is" — exactly the
+        number of batches `data.DataPipeline.state_at` rewinds when a
+        checkpoint lands while the window is full (docs/data.md)."""
+        return max(0, self._pulled - self._delivered)
+
     def stats(self) -> dict:
         """Pipeline health: {'depth', 'batches', 'mean_occupancy',
-        'mean_wait_ms'}. mean_occupancy near 0 with long waits means the
-        source (not the consumer) is the bottleneck — raise depth or speed
-        up the loader; occupancy near depth means prefetch is ahead."""
+        'mean_wait_ms', 'pending'}. mean_occupancy near 0 with long waits
+        means the source (not the consumer) is the bottleneck — raise
+        depth or speed up the loader; occupancy near depth means prefetch
+        is ahead."""
         n = max(1, self._batches)
         return {
             "depth": self._depth,
             "batches": self._batches,
             "mean_occupancy": round(self._occ_sum / n, 3),
             "mean_wait_ms": round(self._wait_s * 1e3 / n, 3),
+            "pending": self.pending(),
         }
 
 

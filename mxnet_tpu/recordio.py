@@ -208,14 +208,36 @@ class MXPrefetchedRecordIO:
             self._queue = _q.Queue(maxsize=capacity)
             self._reader = MXRecordIO(uri, "r")
             self._exhausted = False
+            self._stop = _t.Event()
+
+            # worker errors (corrupt record, I/O failure) travel through
+            # the queue as tagged entries and re-raise in the consumer —
+            # a bare `self._reader.read()` raise used to kill the thread
+            # silently and leave the consumer blocked on get() forever.
+            # Every put is stop-aware so close() can always reclaim a
+            # worker blocked on a full queue (the old thread leaked).
+            def _put(entry) -> bool:
+                while not self._stop.is_set():
+                    try:
+                        self._queue.put(entry, timeout=0.05)
+                        return True
+                    except _q.Full:
+                        continue
+                return False
 
             def worker():
-                while True:
-                    rec = self._reader.read()
-                    self._queue.put(rec)
-                    if rec is None:
-                        return
-            self._thread = _t.Thread(target=worker, daemon=True)
+                try:
+                    while not self._stop.is_set():
+                        rec = self._reader.read()
+                        if rec is None:
+                            _put(("end", None))
+                            return
+                        if not _put(("item", rec)):
+                            return
+                except BaseException as e:  # noqa: BLE001 — consumer's
+                    _put(("error", e))      # to re-raise, not ours
+            self._thread = _t.Thread(target=worker, daemon=True,
+                                     name="mxtpu-recordio-prefetch")
             self._thread.start()
 
     def __iter__(self):
@@ -224,18 +246,45 @@ class MXPrefetchedRecordIO:
     def __next__(self):
         if self._impl is not None:
             return next(self._impl)
-        if self._exhausted:
+        if self._exhausted or self._stop.is_set():
             raise StopIteration
-        rec = self._queue.get()
-        if rec is None:
-            self._exhausted = True
-            raise StopIteration
-        return rec
+        import queue as _q
+        while True:
+            try:
+                kind, payload = self._queue.get(timeout=0.05)
+                break
+            except _q.Empty:
+                # close() from another thread wakes this consumer
+                # instead of deadlocking it on a dead producer
+                if self._stop.is_set():
+                    raise StopIteration from None
+        if kind == "item":
+            return payload
+        self._exhausted = True
+        self.close()
+        if kind == "error":
+            raise payload
+        raise StopIteration
 
-    def close(self):
+    def close(self, timeout: float = 5.0):
         if self._impl is not None:
             self._impl.close()
         elif self._queue is not None:
+            # stop -> drain (wakes a put blocked on a full queue) ->
+            # join -> re-drain (the woken producer may deposit one last
+            # record between the first drain and its exit)
+            import queue as _q
+            import threading as _t
+            self._stop.set()
+            for _ in range(2):
+                try:
+                    while True:
+                        self._queue.get_nowait()
+                except _q.Empty:
+                    pass
+                t = self._thread
+                if t is not _t.current_thread() and t.is_alive():
+                    t.join(timeout)
             self._reader.close()
 
 

@@ -1,0 +1,546 @@
+"""Deterministic data pipeline (`mxnet_tpu/data/` — docs/data.md)."""
+import json
+import os
+import time
+
+import numpy as onp
+import pytest
+
+from mxnet_tpu import recordio
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.data import (DataPipeline, EpochOrder, MixtureDataset,
+                            PipelineState, SequencePacker,
+                            ShardedRecordDataset, host_range)
+from mxnet_tpu.data.order import _FeistelPerm, _derive
+from mxnet_tpu.utils.checkpoint import CheckpointManager
+
+
+# ---------------------------------------------------------------------------
+# order: the pure permutation function
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n", [1, 2, 5, 16, 17, 257])
+def test_feistel_bijective_and_invertible(n):
+    p = _FeistelPerm(n, _derive(42, n))
+    out = [p(i) for i in range(n)]
+    assert sorted(out) == list(range(n))
+    assert all(p.inv(p(i)) == i for i in range(n))
+
+
+@pytest.mark.parametrize("n,w", [(10, 4), (10, 10), (10, 100), (1, 1),
+                                 (1000, 64), (999, 100), (100, 1),
+                                 (4097, 4096)])
+def test_epoch_order_bijective_every_epoch(n, w):
+    o = EpochOrder(n, seed=7, window=w)
+    for e in (0, 1, 5):
+        out = [o.index(e, i) for i in range(n)]
+        assert sorted(out) == list(range(n)), (n, w, e)
+
+
+def test_epoch_order_pure_and_epoch_keyed():
+    o = EpochOrder(500, seed=3, window=64)
+    a = [o.index(0, i) for i in range(500)]
+    # random-access queries out of order give the same answers
+    assert [o.index(0, i) for i in reversed(range(500))] == a[::-1]
+    # a fresh instance agrees (pure function of (seed, epoch, offset))
+    o2 = EpochOrder(500, seed=3, window=64)
+    assert [o2.index(0, i) for i in range(500)] == a
+    # epochs and seeds both change the order
+    assert [o.index(1, i) for i in range(500)] != a
+    assert [EpochOrder(500, seed=4, window=64).index(0, i)
+            for i in range(500)] != a
+
+
+def test_epoch_order_window_locality():
+    # consecutive offsets stay inside one window-sized disk region
+    n, w = 1024, 64
+    o = EpochOrder(n, seed=1, window=w)
+    for start in (0, 64, 512):
+        idxs = [o.index(0, start + j) for j in range(w)]
+        assert max(idxs) - min(idxs) < w, "window shuffle leaked"
+
+
+# ---------------------------------------------------------------------------
+# sharded recordio dataset
+# ---------------------------------------------------------------------------
+
+def _write_shard(path, docs):
+    idx = os.path.splitext(path)[0] + ".idx"
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    for k, doc in enumerate(docs):
+        w.write_idx(k, onp.asarray(doc, dtype=onp.int32).tobytes())
+    w.close()
+    return idx, path
+
+
+def _corpus(tmp_path, name, docs_per_shard):
+    shards = []
+    base = 0
+    for s, count in enumerate(docs_per_shard):
+        docs = [[base + i] * (1 + (base + i) % 5) for i in range(count)]
+        shards.append(_write_shard(str(tmp_path / f"{name}-{s}.rec"), docs))
+        base += count
+    return shards
+
+
+def test_sharded_record_dataset_flat_access(tmp_path):
+    shards = _corpus(tmp_path, "a", [5, 7, 3])
+    ds = ShardedRecordDataset(shards)
+    assert len(ds) == 15
+    for i in range(15):
+        doc = ds[i]
+        assert doc[0] == i and len(doc) == 1 + i % 5
+    assert ds.shard_of(0) == 0 and ds.shard_of(5) == 1 and \
+        ds.shard_of(12) == 2
+    assert sum(ds.read_counts) == 15
+    ds.close()
+
+
+def test_sharded_record_dataset_glob(tmp_path):
+    _corpus(tmp_path, "g", [4, 4])
+    ds = ShardedRecordDataset(str(tmp_path / "g-*.rec"))
+    assert len(ds) == 8 and ds.num_shards == 2
+    ds.close()
+
+
+def test_host_range_partition_and_validation():
+    lo0, hi0 = host_range(8, 2, 0)
+    lo1, hi1 = host_range(8, 2, 1)
+    assert (lo0, hi0, lo1, hi1) == (0, 4, 4, 8)
+    with pytest.raises(MXNetError):
+        host_range(8, 3, 0)          # not divisible
+    with pytest.raises(MXNetError):
+        host_range(8, 2, 2)          # host out of range
+
+
+# ---------------------------------------------------------------------------
+# mixture
+# ---------------------------------------------------------------------------
+
+def test_mixture_ratio_and_counter_resume():
+    kids = [list(range(100)), list(range(50)), list(range(200))]
+    m = MixtureDataset(kids, weights=[0.5, 0.2, 0.3], seed=3)
+    served = m.init_counters()
+    picks = []
+    for p in range(1000):
+        c = m.select(p, served)
+        picks.append(c)
+        served[c] += 1
+    # least-served keeps every prefix within 1 sample of the target ratio
+    run = [0, 0, 0]
+    for p, c in enumerate(picks):
+        run[c] += 1
+        for k, w in enumerate(m.weights):
+            assert abs(run[k] - w * (p + 1)) <= 1.0
+    # resuming from mid-stream counters reproduces the tail exactly
+    served2 = m.init_counters()
+    for p in range(400):
+        served2[m.select(p, served2)] += 1
+    tail = []
+    for p in range(400, 1000):
+        c = m.select(p, served2)
+        tail.append(c)
+        served2[c] += 1
+    assert tail == picks[400:]
+
+
+def test_mixture_children_epoch_independently():
+    kids = [list(range(4)), list(range(100))]
+    m = MixtureDataset(kids, weights=[0.5, 0.5], seed=1)
+    # child 0 wraps epochs long before child 1; locate stays in range
+    for count in (0, 3, 4, 9, 17):
+        epoch, idx = m.locate(0, count)
+        assert epoch == count // 4 and 0 <= idx < 4
+
+
+# ---------------------------------------------------------------------------
+# packing
+# ---------------------------------------------------------------------------
+
+def test_packer_shapes_and_no_token_loss():
+    docs = [list(range(i % 37 + 1)) for i in range(200)]
+    pk = SequencePacker(32)
+    for d in docs:
+        pk.add(d)
+    total_masked = 0
+    while pk.rows_ready >= 4:
+        b = pk.pop_batch(4)
+        assert b["tokens"].shape == (4, 32)
+        assert b["tokens"].dtype == onp.int32
+        assert b["loss_mask"].dtype == onp.float32
+        # mask marks exactly the non-padding tokens
+        assert (b["loss_mask"] == (b["segment_ids"] > 0)).all()
+        # positions restart at every segment boundary within a row
+        for row in range(4):
+            segs, poss = b["segment_ids"][row], b["positions"][row]
+            for t in range(1, 32):
+                if segs[t] > 0 and segs[t] == segs[t - 1]:
+                    assert poss[t] == poss[t - 1] + 1
+        total_masked += int(b["loss_mask"].sum())
+    carry = pk.state()
+    left = len(carry["cur"]["tokens"]) + \
+        sum(sum(r["mask"]) for r in carry["ready"])
+    assert total_masked + left == sum(len(d) for d in docs)
+
+
+def test_packer_carry_roundtrip_bit_identical():
+    docs = [list(range(i % 23 + 1)) for i in range(150)]
+    pk1 = SequencePacker(16)
+    for d in docs[:77]:
+        pk1.add(d)
+    carry = json.loads(json.dumps(pk1.state()))   # through JSON
+    pk2 = SequencePacker(16)
+    pk2.load_state(carry)
+    for d in docs[77:]:
+        pk1.add(d)
+        pk2.add(d)
+    while pk1.rows_ready >= 2:
+        b1, b2 = pk1.pop_batch(2), pk2.pop_batch(2)
+        for k in b1:
+            assert (b1[k] == b2[k]).all()
+
+
+def test_packer_state_snapshot_does_not_alias_live_rows():
+    """state() must deep-copy the partial row: ring snapshots are taken
+    while the row keeps filling, and an aliased list would mutate every
+    past checkpoint retroactively."""
+    pk = SequencePacker(16)
+    pk.add([1, 2, 3])
+    snap = pk.state()
+    pk.add([4, 5, 6, 7])
+    assert snap["cur"]["tokens"] == [1, 2, 3]
+    pk2 = SequencePacker(16)
+    pk2.load_state(snap)
+    pk2.add([4, 5, 6, 7])
+    assert pk.state() == pk2.state()
+
+
+def test_packer_no_split_truncates_and_counts():
+    pk = SequencePacker(8, split_docs=False)
+    pk.add(list(range(20)))           # longer than a row
+    assert pk.truncated_docs == 1
+    pk.add([1, 2, 3])
+    pk.flush()
+    rows = pk.pop_batch(2)
+    assert (rows["segment_ids"] >= 0).all()
+    # no document crosses a row boundary
+    assert rows["positions"][1][0] == 0
+
+
+# ---------------------------------------------------------------------------
+# pipeline: resume, elastic, checkpoint coupling
+# ---------------------------------------------------------------------------
+
+def _plain_src(n=64):
+    return [onp.array([i], dtype=onp.int32) for i in range(n)]
+
+
+def test_pipeline_resume_bit_identical():
+    src = _plain_src()
+    ref_pipe = DataPipeline(src, batch_size=8, seed=5, num_hosts=1,
+                            host_id=0)
+    ref = [next(ref_pipe) for _ in range(24)]      # crosses epoch ends
+    probe = DataPipeline(src, batch_size=8, seed=5)
+    for _ in range(10):
+        next(probe)
+    state = json.loads(json.dumps(probe.state_at(10)))
+    resumed = DataPipeline(src, batch_size=8, seed=5)
+    resumed.load_state(state)
+    for k in range(10, 24):
+        assert (ref[k] == next(resumed)).all(), k
+
+
+def test_pipeline_state_ring_covers_prefetch_lag():
+    src = _plain_src()
+    pipe = DataPipeline(src, batch_size=8, seed=5)
+    for _ in range(9):
+        next(pipe)                     # "prefetcher" pulled to batch 9
+    st = pipe.state_at(6)              # consumer is at step 6
+    assert st is not None and st["batch"] == 6
+    assert pipe.state()["batch"] == 9
+    assert pipe.state_at(0)["batch"] == 0
+
+
+def test_pipeline_seed_mismatch_refuses():
+    src = _plain_src()
+    pipe = DataPipeline(src, batch_size=8, seed=5)
+    other = DataPipeline(src, batch_size=8, seed=6)
+    with pytest.raises(MXNetError):
+        other.load_state(pipe.state())
+
+
+def test_pipeline_shape_mismatch_refuses():
+    src = [onp.arange(1 + i % 5, dtype=onp.int32) for i in range(64)]
+    packed = DataPipeline(src, batch_size=8, seed=5, seq_len=16)
+    with pytest.raises(MXNetError, match="batch_size"):
+        DataPipeline(src, batch_size=4, seed=5,
+                     seq_len=16).load_state(packed.state())
+    with pytest.raises(MXNetError, match="seq_len"):
+        DataPipeline(src, batch_size=8, seed=5,
+                     seq_len=32).load_state(packed.state())
+    with pytest.raises(MXNetError, match="packing"):
+        DataPipeline(src, batch_size=8, seed=5).load_state(packed.state())
+
+
+def test_elastic_loop_prefetcher_without_reset_hook_refuses(tmp_path):
+    """pipeline= plus prefetcher= without data_reset= would leave the
+    loop running on a closed prefetch window after the first restore —
+    the constructor refuses up front."""
+    from mxnet_tpu.elastic import ElasticLoop
+    from mxnet_tpu.parallel.prefetch import DevicePrefetcher
+
+    src = _plain_src()
+    pipe = DataPipeline(src, batch_size=8, seed=5)
+    pf = DevicePrefetcher(iter([]), depth=1)
+    with pytest.raises(MXNetError, match="data_reset"):
+        ElasticLoop(_Target(), str(tmp_path), pipeline=pipe,
+                    prefetcher=pf)
+    pf.close()
+
+
+def test_pipeline_elastic_reform_exactly_once():
+    src = _plain_src()
+    state = DataPipeline(src, batch_size=8, seed=5).state()
+    delivered = []
+
+    def run_hosts(num_hosts, state, nbatches):
+        pipes = []
+        for h in range(num_hosts):
+            p = DataPipeline(src, batch_size=8, seed=5,
+                             num_hosts=num_hosts, host_id=h)
+            p.load_state(state)
+            pipes.append(p)
+        for _ in range(nbatches):
+            for p in pipes:
+                delivered.extend(onp.asarray(next(p)).ravel().tolist())
+        return pipes[0].state()
+
+    state = run_hosts(1, state, 4)     # 1 host
+    state = run_hosts(2, state, 4)     # grow to 2
+    state = run_hosts(4, state, 2)     # grow to 4
+    state = run_hosts(1, state, 2)     # shrink back
+    # reference: uninterrupted single-host run over the same 12 batches
+    ref_pipe = DataPipeline(src, batch_size=8, seed=5)
+    expect = []
+    for _ in range(12):
+        expect.extend(onp.asarray(next(ref_pipe)).ravel().tolist())
+    assert sorted(delivered) == sorted(expect)
+    assert len(delivered) == len(expect)          # zero dup, zero loss
+
+
+def test_pipeline_set_hosts_midstream_is_view_only():
+    src = _plain_src()
+    pipe = DataPipeline(src, batch_size=8, seed=5, num_hosts=2, host_id=0)
+    next(pipe)
+    before = pipe.state()
+    pipe.set_hosts(4, 1)
+    assert pipe.state() == before      # global state untouched
+    assert pipe.host_rows == (2, 4)
+
+
+def test_pipeline_mixture_packed_resume(tmp_path):
+    a = _corpus(tmp_path, "ma", [20, 20])
+    b = _corpus(tmp_path, "mb", [15])
+
+    def mk():
+        mix = MixtureDataset([ShardedRecordDataset(a),
+                              ShardedRecordDataset(b)],
+                             weights=[0.7, 0.3], seed=9)
+        return DataPipeline(mix, batch_size=4, seed=9, seq_len=16)
+
+    ref_pipe = mk()
+    ref = [next(ref_pipe) for _ in range(20)]
+    probe = mk()
+    for _ in range(7):
+        next(probe)
+    st = json.loads(json.dumps(probe.state_at(7)))
+    resumed = mk()
+    resumed.load_state(st)
+    for k in range(7, 20):
+        got = next(resumed)
+        for key in ref[k]:
+            assert (ref[k][key] == got[key]).all(), (k, key)
+
+
+class _Target:
+    """Minimal save/load checkpoint target."""
+
+    def __init__(self):
+        self.v = 0
+
+    def save(self, path):
+        with open(path, "wb") as f:
+            onp.savez(f, v=self.v)
+
+    def load(self, path):
+        self.v = int(onp.load(path)["v"])
+
+
+def test_checkpoint_manifest_carries_and_restores_pipeline(tmp_path):
+    src = _plain_src(40)
+    pipe = DataPipeline(src, batch_size=4, seed=11)
+    mgr = CheckpointManager(str(tmp_path), keep=3)
+    mgr.attach_pipeline(pipe)
+    tgt = _Target()
+    ref = []
+    for i in range(1, 13):
+        ref.append(next(pipe))
+        tgt.v = i
+        if i % 5 == 0:
+            mgr.save(tgt, i)
+    # fresh manager/pipeline/target (a "new process")
+    pipe2 = DataPipeline(src, batch_size=4, seed=11)
+    mgr2 = CheckpointManager(str(tmp_path), keep=3)
+    mgr2.attach_pipeline(pipe2)
+    tgt2 = _Target()
+    step = mgr2.restore(tgt2)
+    assert step == 10 and tgt2.v == 10
+    for k in range(10, 12):
+        assert (ref[k] == next(pipe2)).all()
+    # the manifest state is aligned with the SAVED step even though the
+    # pipeline had been pulled ahead (prefetch lag)
+    assert mgr2.pipeline_state(str(tmp_path / "ckpt-10.npz"))["batch"] == 10
+
+
+def test_checkpoint_async_save_snapshots_state_at_call_time(tmp_path):
+    import concurrent.futures as fut
+
+    class SlowAsyncTarget(_Target):
+        pool = fut.ThreadPoolExecutor(1)
+
+        def save_async(self, path):
+            def work():
+                time.sleep(0.15)
+                self.save(path)
+            return self.pool.submit(work)
+
+    src = _plain_src(40)
+    pipe = DataPipeline(src, batch_size=4, seed=11)
+    mgr = CheckpointManager(str(tmp_path), keep=3)
+    mgr.attach_pipeline(pipe)
+    tgt = SlowAsyncTarget()
+    for _ in range(5):
+        next(pipe)
+    f = mgr.save_async(tgt, 5)
+    next(pipe)                        # stream advances during the write
+    next(pipe)
+    f.result()
+    assert mgr.pipeline_state(str(tmp_path / "ckpt-5.npz"))["batch"] == 5
+
+
+def test_pipeline_skip_batches_matches_consumed():
+    src = _plain_src()
+    a = DataPipeline(src, batch_size=8, seed=5)
+    b = DataPipeline(src, batch_size=8, seed=5)
+    for _ in range(3):
+        next(a)
+    b.skip_batches(3)
+    assert a.state() == b.state()
+    assert (next(a) == next(b)).all()
+
+
+def test_pipeline_state_dataclass_roundtrip():
+    st = PipelineState(seed=4, position=37, epoch=2, offset=5, batch=9,
+                       mixture=[10, 27], packer={"ready": [], "cur": {
+                           "tokens": [], "segments": [], "positions": [],
+                           "mask": []}, "cur_seg": 0})
+    d = json.loads(json.dumps(st.to_dict()))
+    st2 = PipelineState.from_dict(d)
+    assert st2.to_dict() == st.to_dict()
+    with pytest.raises(MXNetError):
+        PipelineState.from_dict({"version": 99, "seed": 0})
+
+
+def test_elastic_loop_restore_seeks_pipeline(tmp_path):
+    """A failed step's restore must re-seek the attached pipeline: the
+    replayed steps train on exactly the batches the abandoned attempt
+    consumed (the old behavior re-read a forward-only stream, silently
+    training the replay on DIFFERENT data)."""
+    from mxnet_tpu.elastic import ElasticLoop
+
+    src = _plain_src()
+    ref_pipe = DataPipeline(src, batch_size=8, seed=21)
+    ref = [onp.asarray(next(ref_pipe)).ravel().tolist() for _ in range(20)]
+
+    pipe = DataPipeline(src, batch_size=8, seed=21)
+    tgt = _Target()
+    consumed = {}
+    fail_once = {"armed": True}
+
+    def step_fn(i):
+        b = onp.asarray(next(pipe)).ravel().tolist()
+        if i == 7 and fail_once["armed"]:
+            fail_once["armed"] = False
+            raise RuntimeError("injected step failure")
+        tgt.v = i + 1
+        consumed[i + 1] = b
+        return 0.0
+
+    loop = ElasticLoop(tgt, str(tmp_path), save_every=5, pipeline=pipe)
+    out = loop.run(step_fn, total_steps=20)
+    assert out["status"] == "completed" and out["restores"] == 1
+    for s in range(1, 21):
+        assert consumed[s] == ref[s - 1], s
+
+
+# ---------------------------------------------------------------------------
+# satellites: RandomSampler + MXPrefetchedRecordIO
+# ---------------------------------------------------------------------------
+
+def test_random_sampler_seeded_and_rng_clean():
+    before = onp.random.get_state()[1].copy()
+    order = list(__import__("mxnet_tpu").gluon.data.RandomSampler(100,
+                                                                  seed=3))
+    after = onp.random.get_state()[1].copy()
+    assert (before == after).all(), "global RNG state mutated"
+    assert sorted(order) == list(range(100))
+    # identical on every "host" with the same seed
+    from mxnet_tpu.gluon.data import RandomSampler
+    assert list(RandomSampler(100, seed=3)) == order
+    # epochs reshuffle, set_epoch pins
+    s = RandomSampler(64, seed=7)
+    e0, e1 = list(s), list(s)
+    assert e0 != e1
+    s.set_epoch(1)
+    assert list(s) == e1
+
+
+def test_prefetched_recordio_error_propagates(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_NO_NATIVE", "1")
+    from mxnet_tpu import _native
+    monkeypatch.setattr(_native, "available", lambda: False)
+    p = str(tmp_path / "a.rec")
+    w = recordio.MXRecordIO(p, "w")
+    recs = [os.urandom(50) for _ in range(10)]
+    for r in recs:
+        w.write(r)
+    w.close()
+    # clobber record 2's magic: rec0 occupies 8 hdr + 50 data + 2 pad
+    data = bytearray(open(p, "rb").read())
+    data[60:64] = b"\xde\xad\xbe\xef"
+    bad = str(tmp_path / "bad.rec")
+    open(bad, "wb").write(bytes(data))
+    pf = recordio.MXPrefetchedRecordIO(bad, capacity=2)
+    with pytest.raises(MXNetError):
+        list(pf)
+    assert not pf._thread.is_alive()   # worker reclaimed, not leaked
+
+
+def test_prefetched_recordio_close_reclaims_blocked_worker(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_NO_NATIVE", "1")
+    from mxnet_tpu import _native
+    monkeypatch.setattr(_native, "available", lambda: False)
+    p = str(tmp_path / "a.rec")
+    w = recordio.MXRecordIO(p, "w")
+    for _ in range(50):
+        w.write(os.urandom(64))
+    w.close()
+    pf = recordio.MXPrefetchedRecordIO(p, capacity=2)
+    deadline = time.time() + 2.0      # let the worker fill + block
+    while pf._queue.qsize() < 2 and time.time() < deadline:
+        time.sleep(0.01)
+    pf.close()
+    assert not pf._thread.is_alive(), "worker leaked on close"
+    with pytest.raises(StopIteration):
+        next(pf)
