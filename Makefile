@@ -2,7 +2,7 @@
 # to what a single-language-core framework needs).
 PY ?= python
 
-.PHONY: ci test test-all test-dist test-parity lint bench cpp docs clean opperf-check telemetry-smoke health-smoke chaos-smoke serve-smoke kernels-smoke elastic-smoke export-smoke data-smoke perf-gate
+.PHONY: ci test test-all test-dist test-parity lint bench cpp docs clean opperf-check telemetry-smoke health-smoke chaos-smoke serve-smoke kernels-smoke elastic-smoke export-smoke data-smoke trace-smoke perf-gate
 
 # the one-command gate CI runs (VERDICT round-2 next-step #7): lint +
 # unit suite + 2-process dist tests + C++ package build/tests
@@ -17,7 +17,7 @@ cpp-test:
 # `make test-all` runs everything.  -n auto parallelizes when xdist +
 # cores are available: ~13.5 min serial on the 1-core builder VM,
 # well under 10 min on any >=2-core box
-test: telemetry-smoke health-smoke chaos-smoke serve-smoke kernels-smoke elastic-smoke export-smoke data-smoke
+test: telemetry-smoke health-smoke chaos-smoke serve-smoke kernels-smoke elastic-smoke export-smoke data-smoke trace-smoke
 	$(PY) -m pytest tests/unittest -q -m "not slow" $$($(PY) -c 'import xdist, os; print("-n auto" if (os.cpu_count() or 1) > 1 else "")' 2>/dev/null) --ignore=tests/unittest/test_dist_kvstore.py
 
 test-all:
@@ -114,6 +114,16 @@ kernels-smoke:
 # winner
 export-smoke:
 	$(PY) tools/export_smoke.py
+
+# distributed tracing + FLOP attribution end-to-end
+# (docs/observability.md, "Tracing & performance attribution"): 3 serve
+# requests + 5 train steps in one process under MXTPU_TRACE; asserts a
+# loadable Perfetto JSON with a complete nested request span tree
+# (queue -> prefill -> decode -> stream), a decomposed TTFT, train spans
+# correlated to journal step ids, distinct serve/train trace-id spaces,
+# and a NONZERO mfu_estimate gauge from XLA cost_analysis flops on CPU
+trace-smoke:
+	$(PY) tools/trace_smoke.py
 
 # CPU-bench regression tripwire (ROADMAP item 5): median-of-3
 # `bench.py --measure cpu` runs must stay within 15% of the checked-in

@@ -28,16 +28,13 @@ CPU_TIMEOUT = 600
 
 
 def _peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "").lower()
-    if "v5 lite" in kind or "v5e" in kind:
-        return 197e12  # bf16
-    if "v5p" in kind or "v5" in kind:
-        return 459e12
-    if "v4" in kind:
-        return 275e12
-    if "v6" in kind or "trillium" in kind:
-        return 918e12
-    return 197e12  # conservative default
+    # single source of truth for the peak table: mxnet_tpu.tracing
+    # (the MFU gauge and this bench must agree on the denominator)
+    try:
+        from mxnet_tpu import tracing as _tracing
+        return _tracing.peak_flops(getattr(device, "device_kind", ""))
+    except Exception:
+        return 197e12  # conservative default (import failure only)
 
 
 def _measure(platform: str) -> dict:
@@ -209,6 +206,27 @@ def _measure(platform: str) -> dict:
         "compile_seconds": round(compile_s, 2),
         "prefetch": pipe["prefetch"],
     }
+    # per-executable cost attribution (mx.tracing, captured at warmup):
+    # XLA-counted flops/bytes + the always-on MFU estimate.  On CPU the
+    # flop count is exact and the peak is the PROJECTED peak of the
+    # configured device kind (MXTPU_MFU_DEVICE_KIND) — a defensible
+    # trajectory proxy until the TPU tunnel reopens, marked projected.
+    from mxnet_tpu import tracing as _tracing
+    cost_feats = step.cost_features()
+    if cost_feats:
+        mfu_est = step.mfu_estimate(step_time)
+        extras["cost"] = {
+            "flops": cost_feats.get("flops"),
+            "bytes_accessed": cost_feats.get("bytes_accessed"),
+            "hbm_bytes_est": cost_feats.get("hbm_bytes_est"),
+            "flops_analytic": flops_per_step,
+            "mfu_estimate": (mfu_est["mfu_estimate"]
+                             if mfu_est else None),
+            "mfu_projected": (mfu_est["projected"]
+                              if mfu_est else None),
+            "peak_device_kind": (mfu_est["device_kind"]
+                                 if mfu_est else None),
+        }
     if telemetry_on:
         extras["telemetry"] = {"journal": getattr(_tele.journal(), "path",
                                                   None),
@@ -309,6 +327,7 @@ def _measure_serve() -> dict:
                                int(p * (len(ttfts) - 1)))], 2)
 
     from mxnet_tpu import telemetry as _tele
+    from mxnet_tpu import tracing as _tracing
     extras = {
         "requests": n_req,
         "generated_tokens": toks,
@@ -323,6 +342,22 @@ def _measure_serve() -> dict:
         "device": getattr(dev, "device_kind", str(dev)),
         "platform": dev.platform,
     }
+    # per-width serving-step cost (mx.tracing): XLA flops/bytes of both
+    # compiled widths + an MFU estimate at the run's mean step cadence
+    cost_by_width = eng.cost_features()
+    if cost_by_width:
+        mean_step_s = wall / max(1, steps)
+        cost = {}
+        for C, feats in sorted(cost_by_width.items()):
+            entry = {"flops": feats.get("flops"),
+                     "bytes_accessed": feats.get("bytes_accessed"),
+                     "hbm_bytes_est": feats.get("hbm_bytes_est")}
+            mfu = _tracing.estimate_mfu(feats.get("flops"), mean_step_s)
+            if mfu is not None:
+                entry["mfu_estimate"] = mfu["mfu_estimate"]
+                entry["mfu_projected"] = mfu["projected"]
+            cost[f"c{C}"] = entry
+        extras["cost"] = cost
     if _tele.enabled():
         extras["telemetry"] = {"snapshot": _tele.snapshot()}
     return {
@@ -443,9 +478,27 @@ def _measure_ops() -> dict:
     f32 = jnp.float32
     ops: dict = {}
 
+    from mxnet_tpu import tracing as _tracing
+
     def timed(fn, *args):
         jfn = jax.jit(fn)
-        return time_callable(lambda: jfn(*args), warmup=2, runs=5)
+        res = time_callable(lambda: jfn(*args), warmup=2, runs=5)
+        # per-kernel cost attribution: the AOT lower/compile is served
+        # from jit's cache (time_callable already compiled it), so this
+        # costs one cost_analysis walk, not a second XLA compile
+        try:
+            feats = _tracing.cost_features_of(jfn.lower(*args).compile())
+        except Exception:
+            feats = None
+        if feats:
+            res["cost"] = {"flops": feats.get("flops"),
+                           "bytes_accessed": feats.get("bytes_accessed")}
+            mfu = _tracing.estimate_mfu(feats.get("flops"),
+                                        res["median_ms"] / 1e3)
+            if mfu is not None:
+                res["cost"]["mfu_estimate"] = mfu["mfu_estimate"]
+                res["cost"]["mfu_projected"] = mfu["projected"]
+        return res
 
     # --- fused LayerNorm + residual ------------------------------------
     rows, h = 2048, 1024

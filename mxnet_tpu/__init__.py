@@ -72,6 +72,7 @@ from . import kvstore as kv  # noqa: F401
 from . import parallel  # noqa: F401
 from . import profiler  # noqa: F401
 from . import telemetry  # noqa: F401
+from . import tracing  # noqa: F401
 from . import health  # noqa: F401
 from . import recovery  # noqa: F401
 from . import amp  # noqa: F401

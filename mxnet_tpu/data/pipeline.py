@@ -44,6 +44,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as _onp
 
 from .. import telemetry as _tele
+from .. import tracing as _trace
 from ..base import MXNetError
 from .mixture import MixtureDataset
 from .order import EpochOrder, default_window, mix64
@@ -287,6 +288,11 @@ class DataPipeline:
         self._batch_seq += 1
         self._ring.append((self._batch_seq, self._snapshot()))
         wait = time.perf_counter() - t0
+        if _trace.enabled():
+            _trace.get_tracer("data").record_span(
+                "data.batch", t0, time.perf_counter(),
+                track="data pipeline", batch=self._batch_seq,
+                position=self._position)
         self._wait_s += wait
         rows = self._row_hi - self._row_lo
         self._host_samples += rows

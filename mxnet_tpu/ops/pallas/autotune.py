@@ -373,6 +373,24 @@ def tune(op: str, shapes: Sequence[int], dtype: str = "float32",
     _disk_store(op, key, best, extra={
         "dtype": dtype, "device_kind": kind,
         "median_ms": None if best_ms is math.inf else round(best_ms, 4)})
+    # performance-attribution corpus (mx.tracing): pair the winner's
+    # analytic cost features with its measured time — one labeled row
+    # per tuned key for the learned performance model (ROADMAP item 3).
+    # The trial thunks are opaque (they own their jit), so the roofline
+    # stands in for XLA's cost_analysis here.
+    try:
+        from ... import tracing as _trace
+        rf = tunable.roofline(best, shapes, dtype)
+        _trace.account().record_features(
+            f"autotune/{op}/{key}",
+            {"flops": float(rf.get("flops", 0.0)),
+             "bytes_accessed": float(rf.get("bytes", 0.0))},
+            kind="autotune_trial", op=op, config=dict(best),
+            measured_ms=(None if best_ms is math.inf
+                         else round(best_ms, 4)),
+            source="roofline")
+    except Exception:   # attribution must never fail a search
+        pass
     if _tele.enabled():
         _tele.counter(
             "autotune_misses",

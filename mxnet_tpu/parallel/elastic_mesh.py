@@ -54,6 +54,7 @@ from __future__ import annotations
 
 import logging
 import os
+import sys
 import threading
 import time
 from typing import Dict, List, Optional, Sequence
@@ -62,6 +63,7 @@ from ..base import MXNetError, SuspectedHostLoss
 from ..resilience import fault_point
 from .. import recovery as _recovery
 from .. import telemetry as _tele
+from .. import tracing as _trace
 from .mesh import Mesh, fit_axes, make_mesh
 
 __all__ = ["ElasticMeshController", "TopologyChange", "MemberView",
@@ -430,14 +432,60 @@ class ElasticMeshController:
         new_mesh = self.plan_mesh(change.devices)
         old = self.step.topology()
         live = change.live
+        # reform phase spans (mx.tracing): the lexical "elastic.reform"
+        # root nests member_sync/restore here plus the drain/gather
+        # spans ShardedTrainStep.reshard opens on the same tracer+thread
+        r_span = _trace.get_tracer("elastic").span(
+            "elastic.reform", track="elastic", kind=change.kind,
+            reason=change.reason, step=int(current_step)) \
+            if _trace.enabled() else None
+        try:
+            resume, live = self._reform_body(change, current_step,
+                                             new_mesh, live)
+        except BaseException:
+            if r_span is not None:
+                r_span.__exit__(*sys.exc_info())
+            raise
+        if r_span is not None:
+            r_span.set_tag("resume_step", resume)
+            r_span.__exit__(None, None, None)
+        elapsed = time.monotonic() - t0
+        if _tele.enabled():
+            _tele.counter(
+                "elastic_reforms_total",
+                "Mesh reformations executed (shrink/grow)",
+                labelnames=("kind",)).inc(kind=change.kind)
+            _tele.event("mesh_reform", step=resume, kind=change.kind,
+                        reason=change.reason, hosts=list(change.hosts),
+                        old_axes=old["axes"],
+                        new_axes=self.step.topology()["axes"],
+                        live=live, from_step=int(current_step),
+                        elapsed_s=round(elapsed, 3))
+        _log.warning(
+            "elastic_mesh: %s reform (%s) %s -> %s in %.2fs; resuming at "
+            "step %d%s", change.kind, change.reason, old["axes"],
+            self.step.topology()["axes"], elapsed, resume,
+            "" if live else " (restored from checkpoint)")
+        return resume
+
+    def _reform_body(self, change: TopologyChange, current_step: int,
+                     new_mesh: Mesh, live: bool) -> tuple:
+        """The phases of one reform; returns ``(resume_step, live)``
+        (`live` can degrade to a live gather below)."""
+        tr = _trace.get_tracer("elastic") if _trace.enabled() else None
         # membership barrier: every process must enter the reform
         # together (single-process: identity).  A peer that never shows
         # up here means the runtime cannot collectivize at all — surface
         # that as the restart case below rather than deadlocking in the
         # reshard collectives
         try:
-            member_sync(join=change.kind == "grow",
-                        leave=change.kind == "shrink")
+            if tr is not None:
+                with tr.span("elastic.member_sync", kind=change.kind):
+                    member_sync(join=change.kind == "grow",
+                                leave=change.kind == "shrink")
+            else:
+                member_sync(join=change.kind == "grow",
+                            leave=change.kind == "shrink")
         except SuspectedHostLoss as e:
             raise MXNetError(
                 f"elastic_mesh: the {change.kind} reform's membership "
@@ -477,7 +525,11 @@ class ElasticMeshController:
                     f"every host resumes from its newest checkpoint") \
                     from e
             fault_point("rollback_restore")
-            resume = self.manager.restore(self.step, step=agreed)
+            if tr is not None:
+                with tr.span("elastic.restore", step=agreed):
+                    resume = self.manager.restore(self.step, step=agreed)
+            else:
+                resume = self.manager.restore(self.step, step=agreed)
             # checkpoints newer than the agreed step belong to the
             # pre-loss timeline (old mesh, possibly ahead of peers): a
             # crash before the next periodic save must not resume INTO
@@ -494,21 +546,4 @@ class ElasticMeshController:
             for h in self._hosts.values():
                 if h.alive:
                     h.last_beat = now
-        elapsed = time.monotonic() - t0
-        if _tele.enabled():
-            _tele.counter(
-                "elastic_reforms_total",
-                "Mesh reformations executed (shrink/grow)",
-                labelnames=("kind",)).inc(kind=change.kind)
-            _tele.event("mesh_reform", step=resume, kind=change.kind,
-                        reason=change.reason, hosts=list(change.hosts),
-                        old_axes=old["axes"],
-                        new_axes=self.step.topology()["axes"],
-                        live=live, from_step=int(current_step),
-                        elapsed_s=round(elapsed, 3))
-        _log.warning(
-            "elastic_mesh: %s reform (%s) %s -> %s in %.2fs; resuming at "
-            "step %d%s", change.kind, change.reason, old["axes"],
-            self.step.topology()["axes"], elapsed, resume,
-            "" if live else " (restored from checkpoint)")
-        return resume
+        return resume, live

@@ -40,6 +40,7 @@ from ..base import MXNetError
 from ..resilience import fault_point
 from .. import health as _health
 from .. import telemetry as _tele
+from .. import tracing as _trace
 
 __all__ = ["DevicePrefetcher", "AsyncMetricBuffer", "default_prefetch_depth"]
 
@@ -101,6 +102,13 @@ class DevicePrefetcher:
         # that lag harmless; pending() makes it observable)
         self._pulled = 0
         self._delivered = 0
+        # cross-thread span handoff (mx.tracing): the prefetch worker's
+        # placement spans parent under whatever span was open on the
+        # CONSTRUCTING (consumer) thread — e.g. a training loop's outer
+        # span — so the H2D work nests in the consumer's trace instead
+        # of starting orphan traces on the worker thread
+        self._trace_ctx = (_trace.get_tracer("data").current_context()
+                           if _trace.enabled() else None)
         self._thread = threading.Thread(target=self._worker,
                                         name="mxtpu-prefetch", daemon=True)
         self._thread.start()
@@ -145,8 +153,14 @@ class DevicePrefetcher:
                 # by name in the stall dump
                 _health.beat("prefetch")
                 # H2D overlap shows up in the XPlane trace under this span
+                p_span = _trace.get_tracer("data").start_span(
+                    "prefetch.place", parent=self._trace_ctx,
+                    track="prefetch", batch=self._pulled) \
+                    if _trace.enabled() else None
                 with jax.profiler.TraceAnnotation("mxtpu.prefetch"):
                     placed = self._apply_place(item)
+                if p_span is not None:
+                    p_span.finish()
                 if not self._put(("item", placed)):
                     return
             self._put(("end", None))
@@ -195,6 +209,17 @@ class DevicePrefetcher:
                     "prefetch_occupancy",
                     "Prefetch queue depth at hand-out (near depth = "
                     "prefetch is ahead)").set(occ)
+                _tele.gauge(
+                    "prefetch_pending",
+                    "Batches pulled from the source but not yet "
+                    "delivered to the consumer — the checkpoint-lag "
+                    "window DataPipeline.state_at rewinds (docs/data.md)"
+                ).set(self.pending())
+            if _trace.enabled():
+                _trace.get_tracer("data").record_span(
+                    "prefetch.wait", t0, time.perf_counter(),
+                    track="prefetch consumer", batch=self._delivered,
+                    occupancy=occ)
             return payload
         self._exhausted = True
         self.close()

@@ -34,6 +34,7 @@ from .. import health as _health
 from .. import profiler as _profiler
 from .. import recovery as _recovery
 from .. import telemetry as _tele
+from .. import tracing as _trace
 from .sharding import ShardingRules, default_tp_rules
 
 __all__ = ["ShardedTrainStep", "StepHandle", "make_sharded_train_step"]
@@ -132,6 +133,12 @@ class ShardedTrainStep:
         self._dispatch_s = collections.deque(maxlen=1024)
         self._inflight = collections.deque(maxlen=256)
         self.compile_seconds = None
+        # performance attribution (mx.tracing): cost features are
+        # recorded under this key at every compile site (AOT warmup,
+        # export load) and combined with measured wall time at retire
+        # into the mfu_estimate/step_flops/hbm_bytes_est gauges
+        self._cost_key = f"train_step@{id(self):x}"
+        self._last_retire_t: Optional[float] = None
         # numerics probes (MXTPU_HEALTH / health.enable): captured ONCE at
         # construction so the probe branch is a fixed part of the traced
         # program — with health off it is traced out entirely (zero extra
@@ -624,6 +631,28 @@ class ShardedTrainStep:
         Stays 1 for a healthy steady-state run (assert on it in tests)."""
         return self._trace_count
 
+    # -- performance attribution (mx.tracing) ---------------------------
+    def _record_cost(self, compiled, source: str) -> None:
+        """Capture `compiled`'s XLA cost/memory analysis into the
+        process cost registry (once per compile; never on the hot
+        path)."""
+        _trace.record_executable(
+            self._cost_key, compiled, kind="train_step", source=source,
+            axes=self.topology()["axes"])
+
+    def cost_features(self) -> Optional[dict]:
+        """The step executable's XLA cost-feature vector (flops, bytes
+        accessed, argument/output/temp bytes, hbm_bytes_est), or None
+        before any AOT compile/export load recorded one (the live-jit
+        path exposes no compiled object to analyze — run `warmup()`)."""
+        return _trace.account().features(self._cost_key)
+
+    def mfu_estimate(self, measured_step_s: float) -> Optional[dict]:
+        """MFU of one step taking `measured_step_s` wall seconds, from
+        the recorded cost features (projected peak on non-TPU backends;
+        docs/observability.md)."""
+        return _trace.account().mfu(self._cost_key, measured_step_s)
+
     def _prepare_batch(self, batch):
         """Unwrap mx ndarrays, build the step on first use, and place every
         batch arg on its target sharding — skipping the copy for args that
@@ -738,12 +767,18 @@ class ShardedTrainStep:
         # keep the stall watchdog quiet for its duration (explicitly:
         # `.compile()` still runs even when `.lower()` skipped the trace
         # that would have armed the _note_trace guard)
+        c_span = _trace.get_tracer("train").span(
+            "train.compile", step=self._t, kind="aot_warmup") \
+            if _trace.enabled() else None
         try:
             with _health.suppress_stalls("aot_compile"):
                 self._exec = self._step_fn.lower(*avals).compile()
         finally:
             self._release_trace_guard()
+            if c_span is not None:
+                c_span.__exit__(None, None, None)
         self.compile_seconds = time.perf_counter() - t0
+        self._record_cost(self._exec, source="aot_warmup")
         if _tele.enabled():
             _tele.event("compile_end", step=self._t, kind="aot_warmup",
                         seconds=round(self.compile_seconds, 4))
@@ -759,6 +794,14 @@ class ShardedTrainStep:
         from .. import random as _rng
         _health.beat("train_step.dispatch")
         t0 = time.perf_counter()
+        # span pair (mx.tracing): "train.dispatch" covers the host-side
+        # enqueue, "train.device" the dispatch -> retire window (finished
+        # in steps_in_flight).  Both tagged with the journal step id.
+        # manual span (not the thread-local stack): an exception mid-
+        # dispatch must not strand an open span under later dispatches
+        d_span = _trace.get_tracer("train").start_span(
+            "train.dispatch", track="train host", step=self._t + 1) \
+            if _trace.enabled() else None
         batch_vals = self._prepare_batch(batch)
         self._t += 1
         hp = self._hp()
@@ -807,7 +850,14 @@ class ShardedTrainStep:
         self.sync_params_to_block()
         dt = time.perf_counter() - t0
         self._dispatch_s.append(dt)
-        self._inflight.append((self._t, loss, probes))
+        x_span = None
+        if d_span is not None:
+            x_span = _trace.get_tracer("train").start_span(
+                "train.device", parent=d_span.context(),
+                track="train device", step=self._t)
+            d_span.finish(dispatch_ms=round(dt * 1e3, 3))
+        self._inflight.append((self._t, loss, probes,
+                               time.perf_counter(), x_span))
         if _tele.enabled():
             _tele.histogram(
                 "step_dispatch_ms",
@@ -830,20 +880,48 @@ class ShardedTrainStep:
         Retired steps feed their (now host-cheap) probe values to the
         health monitor when numerics probes are on."""
         q = self._inflight
+        batch = []
         while q:
-            step_id, loss, probes = q[0]
+            entry = q[0]
             try:
-                ready = bool(loss.is_ready())
+                ready = bool(entry[1].is_ready())
             except Exception:
                 ready = True
             if not ready:
                 break
             q.popleft()
-            _health.beat("train_step.retire")
-            if probes is not None:
-                self._observe_health(step_id, loss, probes)
-            if _tele.enabled():
-                _tele.event("step_retired", step=step_id)
+            batch.append(entry)
+        if batch:
+            now = time.perf_counter()
+            # measured step wall: retire-to-retire cadence in a
+            # pipelined steady state (first-ever retire falls back to
+            # dispatch->retire).  Steps retiring in the SAME poll share
+            # the interval since the previous retire — a per-entry
+            # timestamp would divide full step flops by microseconds
+            # and write garbage MFU rows into the corpus.
+            prev, self._last_retire_t = self._last_retire_t, now
+            base = prev if prev is not None else batch[0][3]
+            measured_s = max(0.0, now - base) / len(batch)
+            for step_id, loss, probes, _t_disp, x_span in batch:
+                _health.beat("train_step.retire")
+                if x_span is not None:
+                    x_span.finish(t1=now)
+                if probes is not None:
+                    self._observe_health(step_id, loss, probes)
+                if _tele.enabled():
+                    # each step record carries the executable's cost-
+                    # feature vector + the measured wall time — the
+                    # (features, ms) corpus a learned performance model
+                    # trains on — and updates the always-on
+                    # mfu_estimate/step_flops/hbm_bytes_est gauges
+                    cost = _trace.note_step_cost(
+                        self._cost_key, measured_s) \
+                        if measured_s > 0 else None
+                    if cost is not None:
+                        _tele.event("step_retired", step=step_id,
+                                    cost=cost)
+                    else:
+                        _tele.event("step_retired", step=step_id)
         return len(q)
 
     def drain(self, timeout: Optional[float] = None) -> int:
@@ -1219,6 +1297,7 @@ class ShardedTrainStep:
             raise
         self.compile_seconds = time.perf_counter() - t0
         self._exec = compiled
+        self._record_cost(compiled, source="export_load")
         self._step_fn = None     # no live jit: the artifact IS the program
         # adopt the artifact's baked remat policy into the model knob so
         # any LATER live retrace (aval drift, reshard) lowers the same
@@ -1317,16 +1396,34 @@ class ShardedTrainStep:
         topology-agnostic `load` re-places every array)."""
         from ..resilience import fault_point
         fault_point("mesh_reform")
-        self.drain()
-        self._drain_async_save()
+        tr = _trace.get_tracer("elastic") if _trace.enabled() else None
+        if tr is not None:
+            with tr.span("elastic.drain", step=self._t):
+                self.drain()
+                self._drain_async_save()
+        else:
+            self.drain()
+            self._drain_async_save()
         host_p = host_s = None
         if gather:
             fault_point("reshard_gather")
-            host_p = {n: onp.asarray(_gather_to_host(v))
+
+            def _gather_all():
+                hp = {n: onp.asarray(_gather_to_host(v))
                       for n, v in self.pvals.items()}
-            host_s = {n: [onp.asarray(_gather_to_host(leaf))
+                hs = {n: [onp.asarray(_gather_to_host(leaf))
                           for leaf in self._logical_state_leaves(n)]
                       for n in self.diff_names}
+                return hp, hs
+
+            if tr is not None:
+                # with-block, not a bare __exit__: a SuspectedHostLoss
+                # mid-gather must not strand an open span on the stack
+                # (every later span would parent under the corpse)
+                with tr.span("elastic.gather", step=self._t):
+                    host_p, host_s = _gather_all()
+            else:
+                host_p, host_s = _gather_all()
         old_axes = {k: int(v) for k, v in dict(self.mesh.shape).items()}
         self.mesh = new_mesh
         if rules is not None:
@@ -1362,6 +1459,11 @@ class ShardedTrainStep:
         self._t_dev = None
         self._t_mirror = -1
         self.compile_seconds = None
+        # attribution state from the old topology: the cost features
+        # describe the OLD program (re-recorded at the next warmup/
+        # compile), and retire-to-retire cadence restarts
+        _trace.account().discard(self._cost_key)
+        self._last_retire_t = None
         self._fused_opt_kernel = self._resolve_fused_kernel()
         if gather:
             self.sync_params_to_block()

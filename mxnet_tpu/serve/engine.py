@@ -42,6 +42,7 @@ import numpy as onp
 from ..base import MXNetError
 from .. import health as _health
 from .. import telemetry as _tele
+from .. import tracing as _trace
 from .decode import extract_decode_weights, transformer_step, lm_logits
 from .kv_cache import KVPools, PageAllocator, make_paged_kv_fn
 from .scheduler import ContinuousBatchingScheduler, ServeRequest
@@ -273,6 +274,7 @@ class InferenceEngine:
                 staged[C] = jax.jit(
                     exp.call, donate_argnums=(1,)
                 ).lower(*avals).compile()
+            self._record_cost(C, staged[C], source="export_load")
             if _tele.enabled():
                 _tele.event("compile_end", kind="serve_export_load",
                             chunk=C,
@@ -333,13 +335,41 @@ class InferenceEngine:
         if _tele.enabled():
             _tele.event("compile_start", kind="serve_step", chunk=C)
         t0 = time.perf_counter()
-        with _health.suppress_stalls("serve_compile"):
-            ex = fn.lower(*avals).compile()
+        c_span = _trace.get_tracer("serve").span(
+            "serve.compile", chunk=C) if _trace.enabled() else None
+        try:
+            with _health.suppress_stalls("serve_compile"):
+                ex = fn.lower(*avals).compile()
+        finally:
+            if c_span is not None:
+                c_span.__exit__(None, None, None)
+        self._record_cost(C, ex, source="live_compile")
         if _tele.enabled():
             _tele.event("compile_end", kind="serve_step", chunk=C,
                         seconds=round(time.perf_counter() - t0, 4))
         self._execs[C] = ex
         return ex
+
+    # -- performance attribution (mx.tracing) --------------------------
+    def _record_cost(self, C: int, compiled, source: str) -> None:
+        """Register one chunk width's executable in the process cost
+        registry (``serve_step_c<C>@...``); the scheduler's per-step
+        wall times then carry FLOP attribution."""
+        _trace.record_executable(
+            f"serve_step_c{C}@{id(self):x}", compiled, kind="serve_step",
+            chunk=C, source=source,
+            quantized=self.quantized)
+
+    def cost_features(self) -> dict:
+        """{chunk_width: XLA cost-feature vector} for every compiled
+        step width (empty before warmup)."""
+        out = {}
+        for C in self._execs:
+            feats = _trace.account().features(
+                f"serve_step_c{C}@{id(self):x}")
+            if feats is not None:
+                out[C] = feats
+        return out
 
     # ------------------------------------------------------------------
     def _execute(self, tok, num_tokens, start_pos, tables, ctx_lens,

@@ -35,6 +35,7 @@ import numpy as onp
 
 from ..base import MXNetError
 from .. import telemetry as _tele
+from .. import tracing as _trace
 
 __all__ = ["ServeRequest", "ContinuousBatchingScheduler"]
 
@@ -69,6 +70,10 @@ class ServeRequest:
         self.finished_ts: Optional[float] = None
         self.error: Optional[str] = None
         self._done = threading.Event()
+        # tracing (mx.tracing, MXTPU_TRACE): the request's root span +
+        # the currently-open queue-phase span; None when tracing is off
+        self._span = None
+        self._queue_span = None
 
     # -- caller-side API -------------------------------------------------
     @property
@@ -169,11 +174,59 @@ class ContinuousBatchingScheduler:
                            deadline_ms=(self.deadline_ms
                                         if deadline_ms is None
                                         else deadline_ms))
+        self._trace_submit(req)
         with self._lock:
             self._queue.append(req)
         self._telemetry_request(req, "submitted", queued=len(self._queue))
         self._update_gauges()
         return req
+
+    # -- request-lifecycle spans (mx.tracing) --------------------------
+    # Every request gets a root "serve.request" span on its own track
+    # (one Perfetto row per request) whose children decompose TTFT:
+    # "serve.queue" (submit -> admit, re-opened on eviction), one
+    # "serve.prefill_chunk"/"serve.decode"/"serve.first_decode" span per
+    # fused step the request took part in (tagged with slot and page
+    # ids), and a "serve.stream" span per emitted token.  All sites
+    # guard on _trace.enabled(): tracing off costs two None attributes
+    # per request.
+
+    def _trace_submit(self, req: ServeRequest) -> None:
+        if not _trace.enabled():
+            return
+        tr = _trace.get_tracer("serve")
+        track = f"serve req {req.id}"
+        req._span = tr.start_span(
+            "serve.request", track=track, request_id=req.id,
+            prompt_tokens=len(req.prompt),
+            max_new_tokens=req.max_new_tokens)
+        req._queue_span = tr.start_span(
+            "serve.queue", parent=req._span.context(), track=track,
+            request_id=req.id)
+
+    def _trace_admit(self, req: ServeRequest, slot: int,
+                     pages: int) -> None:
+        if req._queue_span is not None:
+            req._queue_span.finish(slot=slot, pages=pages,
+                                   readmit=bool(req.evictions))
+            req._queue_span = None
+
+    def _trace_requeue(self, req: ServeRequest, reason: str) -> None:
+        if req._span is not None:
+            req._queue_span = _trace.get_tracer("serve").start_span(
+                "serve.queue", parent=req._span.context(),
+                track=f"serve req {req.id}", request_id=req.id,
+                evicted=True, reason=reason)
+
+    def _trace_close(self, req: ServeRequest, state: str,
+                     **tags) -> None:
+        if req._queue_span is not None:
+            req._queue_span.finish(state=state)
+            req._queue_span = None
+        if req._span is not None:
+            req._span.finish(state=state, generated=len(req.tokens),
+                             evictions=req.evictions, **tags)
+            req._span = None
 
     # ------------------------------------------------------------------
     def _free_slot_idx(self) -> Optional[int]:
@@ -206,6 +259,7 @@ class ContinuousBatchingScheduler:
                 slot.table[:len(pages)] = pages
                 self._slots[idx] = slot
             req.state = "running"
+            self._trace_admit(req, idx, len(pages))
             self._telemetry_request(
                 req, "readmitted" if req.evictions else "admitted",
                 slot=idx, pages=len(pages))
@@ -224,6 +278,7 @@ class ContinuousBatchingScheduler:
         self._release_slot(slot)
         req.state = "queued"
         req.evictions += 1
+        self._trace_requeue(req, reason)
         with self._lock:
             self._queue.appendleft(req)
         if _tele.enabled():
@@ -363,8 +418,12 @@ class ContinuousBatchingScheduler:
             # error) instead of leaving them stuck forever, then re-raise
             self._fail_all(exc)
             raise
-        step_ms = (time.perf_counter() - t0) * 1e3
+        t1 = time.perf_counter()
+        step_ms = (t1 - t0) * 1e3
         self._steps += 1
+        if _trace.enabled():
+            self._trace_step(actives, consume, num_tokens, ctx_lens,
+                             t0, t1, C)
         from .. import health as _health
         _health.beat("serve.step")
         if _tele.enabled():
@@ -374,6 +433,10 @@ class ContinuousBatchingScheduler:
             ).observe(step_ms)
             _tele.counter("serve_steps_total",
                           "Fused serving steps executed").inc()
+            # FLOP attribution: this width's executable cost + measured
+            # wall -> mfu_estimate{program="serve_step"} et al.
+            _trace.note_step_cost(
+                f"serve_step_c{C}@{id(self.engine):x}", step_ms / 1e3)
 
         # distribute tokens in admission order (stable streaming order)
         for s in sorted(actives, key=lambda s: s.admit_seq):
@@ -382,6 +445,41 @@ class ContinuousBatchingScheduler:
             self._emit(s, int(next_tokens[s.slot_idx]))
         self._update_gauges()
         return True
+
+    def _trace_step(self, actives, consume, num_tokens, ctx_lens,
+                    t0: float, t1: float, C: int) -> None:
+        """Post-hoc spans for one fused step: a scheduler-level
+        "serve.step" span plus one per-request phase span (all slots
+        share the device step's wall window — the spans decompose each
+        request's OWN timeline, not the device's)."""
+        tr = _trace.get_tracer("serve")
+        tr.record_span("serve.step", t0, t1, track="serve steps",
+                       step=self._steps, chunk=C, active=len(actives))
+        for s in actives:
+            req = s.req
+            if req._span is None:
+                continue
+            i = s.slot_idx
+            nt = int(num_tokens[i])
+            if not consume[i]:
+                name = "serve.prefill_chunk"
+                first = False
+            elif not req.tokens:
+                # this step's logits produce the request's FIRST token:
+                # a multi-token feed is the last prefill chunk, a
+                # single-token feed is the first decode step
+                first = True
+                name = ("serve.prefill_chunk" if nt > 1
+                        else "serve.first_decode")
+            else:
+                first = False
+                name = "serve.decode"
+            tr.record_span(
+                name, t0, t1, parent=req._span.context(),
+                track=f"serve req {req.id}", request_id=req.id,
+                slot=i, pages=len(s.pages), ctx=int(ctx_lens[i]),
+                tokens_fed=nt,
+                **({"first_token": True} if first else {}))
 
     def _emit(self, slot: _Slot, token: int) -> None:
         req = slot.req
@@ -398,6 +496,7 @@ class ContinuousBatchingScheduler:
         if _tele.enabled():
             _tele.counter("serve_tokens_generated_total",
                           "Tokens generated across all requests").inc()
+        ts0 = time.perf_counter() if req._span is not None else 0.0
         if req.on_token is not None:
             try:
                 req.on_token(token, req)
@@ -405,6 +504,11 @@ class ContinuousBatchingScheduler:
                 import logging
                 logging.getLogger(__name__).exception(
                     "serve: on_token callback failed (request %d)", req.id)
+        if req._span is not None:
+            _trace.get_tracer("serve").record_span(
+                "serve.stream", ts0, time.perf_counter(),
+                parent=req._span.context(), track=f"serve req {req.id}",
+                request_id=req.id, token_index=len(req.tokens) - 1)
         done = len(req.tokens) >= req.max_new_tokens or (
             req.eos_token_id is not None and token == req.eos_token_id)
         if done:
@@ -438,6 +542,7 @@ class ContinuousBatchingScheduler:
         req.state = "failed"
         req.error = err
         req.finished_ts = time.perf_counter()
+        self._trace_close(req, state, error=err)
         if _tele.enabled():
             _tele.counter("serve_requests_total",
                           "Requests by terminal state",
@@ -450,6 +555,10 @@ class ContinuousBatchingScheduler:
         self._release_slot(slot)
         req.state = "finished"
         req.finished_ts = time.perf_counter()
+        self._trace_close(
+            req, "finished",
+            ttft_ms=(round(req.ttft_s * 1e3, 3)
+                     if req.ttft_s is not None else None))
         if _tele.enabled():
             _tele.counter("serve_requests_total",
                           "Requests by terminal state",

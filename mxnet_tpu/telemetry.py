@@ -232,7 +232,16 @@ def _fmt_le(ub: float) -> str:
 
 
 def _escape_label(v: str) -> str:
+    """Label-value escaping per the text exposition format: backslash,
+    double-quote, and newline (in that order — escaping the escapes
+    first)."""
     return v.replace("\\", r"\\").replace('"', r'\"').replace("\n", r"\n")
+
+
+def _escape_help(v: str) -> str:
+    """HELP-text escaping: only backslash and newline (quotes are legal
+    verbatim in help text, unlike label values)."""
+    return v.replace("\\", r"\\").replace("\n", r"\n")
 
 
 def _labels_str(labels: Dict[str, str], extra: str = "") -> str:
@@ -352,7 +361,7 @@ class MetricsRegistry:
         lines = []
         for m in metrics:
             if m.help:
-                lines.append(f"# HELP {m.name} {m.help}")
+                lines.append(f"# HELP {m.name} {_escape_help(m.help)}")
             lines.append(f"# TYPE {m.name} {m.kind}")
             for labels, val in m._series():
                 if m.kind == "histogram":
@@ -371,7 +380,12 @@ class MetricsRegistry:
 
 
 def _fmt_val(v: float) -> str:
-    return str(int(v)) if float(v).is_integer() and abs(v) < 1e15 else repr(v)
+    v = float(v)
+    if math.isnan(v):
+        return "NaN"             # repr() would emit 'nan'/'inf', which
+    if math.isinf(v):            # no Prometheus parser accepts
+        return "+Inf" if v > 0 else "-Inf"
+    return str(int(v)) if v.is_integer() and abs(v) < 1e15 else repr(v)
 
 
 def json_safe(obj):

@@ -37,6 +37,7 @@ import time
 from typing import List, Optional, Tuple
 
 from .. import telemetry as _tele
+from .. import tracing as _trace
 from ..base import MXNetError
 from ..resilience import fault_point, retry_with_backoff
 
@@ -343,6 +344,12 @@ class CheckpointManager:
     @staticmethod
     def _note_write(path: str, step: int, elapsed_s: float,
                     async_save: bool = False) -> None:
+        if _trace.enabled():
+            t1 = time.perf_counter()
+            _trace.get_tracer("checkpoint").record_span(
+                "checkpoint.save", t1 - elapsed_s, t1,
+                track="checkpoint", step=step, async_save=async_save,
+                path=os.path.basename(path))
         if _tele.enabled():
             ms = elapsed_s * 1e3
             _tele.histogram(
@@ -567,6 +574,12 @@ class CheckpointManager:
     @staticmethod
     def _note_restore(path: str, step: int, elapsed_s: float,
                       fallbacks: int = 0) -> None:
+        if _trace.enabled():
+            t1 = time.perf_counter()
+            _trace.get_tracer("checkpoint").record_span(
+                "checkpoint.restore", t1 - elapsed_s, t1,
+                track="checkpoint", step=step, fallbacks=fallbacks,
+                path=os.path.basename(path))
         if _tele.enabled():
             ms = elapsed_s * 1e3
             _tele.histogram(

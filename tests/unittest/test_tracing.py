@@ -1,0 +1,449 @@
+"""Distributed tracing + FLOP-accounted performance attribution
+(`mxnet_tpu.tracing`): span primitives, cross-thread handoff, Chrome
+export, the per-executable cost registry, the MFU gauges, and the
+two-subsystem (serve + train in one process) correlation contract.
+`tracing` marker (tier-1, CPU)."""
+import json
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx  # noqa: F401
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu import telemetry as tele
+from mxnet_tpu import tracing
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel import (DevicePrefetcher, make_mesh,
+                                make_sharded_train_step)
+
+pytestmark = pytest.mark.tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    """Each test starts with tracing+telemetry off and empty state, and
+    leaves the process that way (both are process-wide)."""
+    tele.disable()
+    tele.registry().reset()
+    tracing.disable()
+    tracing.reset()
+    tracing.account().clear()
+    yield
+    tele.disable()
+    tele.registry().reset()
+    tracing.disable()
+    tracing.reset()
+    tracing.account().clear()
+
+
+def _tiny_step():
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+    mesh = make_mesh({"dp": 1}, jax.devices()[:1])
+    step = make_sharded_train_step(
+        net, opt.SGD(learning_rate=1e-2),
+        lambda out, x, y: jnp.mean((out - y) ** 2), mesh,
+        num_model_args=1)
+    rng = onp.random.RandomState(0)
+    xs = rng.uniform(-1, 1, (8, 8)).astype("float32")
+    ys = rng.uniform(-1, 1, (8, 4)).astype("float32")
+    return step, xs, ys
+
+
+# ---------------------------------------------------------------------------
+# span primitives
+# ---------------------------------------------------------------------------
+
+def test_lexical_nesting_parents_and_trace_ids():
+    tracing.enable()
+    tr = tracing.get_tracer("t")
+    with tr.span("outer") as outer:
+        with tr.span("inner") as inner:
+            assert inner.parent_id == outer.span_id
+            assert inner.trace_id == outer.trace_id
+    with tr.span("second_root") as root2:
+        pass
+    # a fresh root span opens a fresh trace id
+    assert root2.trace_id != outer.trace_id
+    assert root2.parent_id is None
+    names = [s.name for s in tr.spans()]
+    assert names == ["inner", "outer", "second_root"]  # finish order
+    assert all(s.duration_ms >= 0 for s in tr.spans())
+
+
+def test_manual_span_does_not_touch_stack():
+    tracing.enable()
+    tr = tracing.get_tracer("t")
+    s = tr.start_span("req")
+    assert tr.current() is None          # not pushed
+    with tr.span("unrelated") as u:
+        assert u.parent_id is None       # manual span is no parent
+    child = tr.start_span("phase", parent=s.context())
+    child.finish()
+    s.finish()
+    assert child.parent_id == s.span_id
+    assert child.trace_id == s.trace_id
+
+
+def test_cross_thread_handoff():
+    tracing.enable()
+    tr = tracing.get_tracer("t")
+    got = {}
+
+    with tr.span("consumer") as outer:
+        ctx = tr.current_context()
+
+        def worker():
+            # worker thread has its OWN empty stack; the handoff context
+            # is the only way to parent under the consumer
+            assert tr.current() is None
+            with tr.span("work", parent=ctx) as w:
+                got["parent"] = w.parent_id
+                got["trace"] = w.trace_id
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert got["parent"] == outer.span_id
+    assert got["trace"] == outer.trace_id
+
+
+def test_two_tracers_isolated_id_spaces():
+    tracing.enable()
+    a, b = tracing.get_tracer("alpha"), tracing.get_tracer("beta")
+    with a.span("x") as sa:
+        # beta sees no current span from alpha's stack
+        assert b.current() is None
+        with b.span("y") as sb:
+            assert sb.parent_id is None
+            assert sa.trace_id != sb.trace_id
+    assert sa.trace_id.startswith("alpha-")
+    assert sb.trace_id.startswith("beta-")
+
+
+def test_span_cap_bounds_memory():
+    tracing.enable()
+    tr = tracing.Tracer("capped", span_cap=10)
+    for i in range(25):
+        tr.record_span(f"s{i}", 0.0, 1e-6)
+    assert len(tr.spans()) == 10
+    assert tr.dropped == 15
+    assert tr.spans()[-1].name == "s24"   # newest kept
+
+
+def test_exception_tags_error_and_pops_stack():
+    tracing.enable()
+    tr = tracing.get_tracer("t")
+    with pytest.raises(ValueError):
+        with tr.span("boom"):
+            raise ValueError("x")
+    assert tr.current() is None
+    (s,) = tr.spans()
+    assert s.tags["error"] == "ValueError"
+
+
+def test_disabled_fast_path_records_nothing():
+    assert not tracing.enabled()
+    step, xs, ys = _tiny_step()
+    step.warmup(xs, ys)
+    for _ in range(3):
+        step.dispatch(xs, ys)
+    step.drain()
+    assert step.trace_count == 1
+    assert tracing.get_tracer("train").spans() == []
+
+
+# ---------------------------------------------------------------------------
+# chrome export
+# ---------------------------------------------------------------------------
+
+def test_chrome_export_structure(tmp_path):
+    tracing.enable(dir=str(tmp_path))
+    tr = tracing.get_tracer("t")
+    with tr.span("parent", foo="bar"):
+        with tr.span("child"):
+            pass
+    tr.record_span("tracked", 0.0, 0.001, track="my track")
+    path = tracing.export_chrome()
+    doc = json.load(open(path))
+    evs = doc["traceEvents"]
+    metas = [e for e in evs if e["ph"] == "M"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert {m["args"]["name"] for m in metas} == {"t", "my track"}
+    assert len(xs) == 3
+    by_name = {e["name"]: e for e in xs}
+    assert by_name["child"]["args"]["parent_id"] == \
+        by_name["parent"]["args"]["span_id"]
+    assert by_name["parent"]["args"]["foo"] == "bar"
+    # explicit track -> its own synthetic tid
+    assert by_name["tracked"]["tid"] != by_name["parent"]["tid"]
+    for e in xs:
+        assert e["dur"] >= 0 and e["ts"] > 0
+
+
+# ---------------------------------------------------------------------------
+# cost accountant + MFU
+# ---------------------------------------------------------------------------
+
+def test_cost_accountant_records_and_estimates():
+    compiled = jax.jit(lambda x: x @ x).lower(
+        jnp.ones((64, 64), jnp.float32)).compile()
+    e = tracing.record_executable("k", compiled, kind="test_step")
+    assert e["features"]["flops"] > 0
+    assert e["features"]["bytes_accessed"] > 0
+    assert e["features"]["hbm_bytes_est"] > 0
+    mfu = tracing.account().mfu("k", 1e-3)
+    assert 0 < mfu["mfu_estimate"] < 1
+    assert mfu["projected"] is True      # CPU backend -> projected peak
+    assert tracing.account().mfu("missing", 1e-3) is None
+    assert tracing.account().mfu("k", 0.0) is None
+
+
+def test_peak_flops_table_and_env_override(monkeypatch):
+    assert tracing.peak_flops("TPU v4") == 275e12
+    assert tracing.peak_flops("TPU v5 lite") == 197e12
+    assert tracing.peak_flops("unknown accelerator") == 197e12
+    monkeypatch.setenv("MXTPU_PEAK_TFLOPS", "100")
+    assert tracing.peak_flops("TPU v4") == 100e12
+    monkeypatch.delenv("MXTPU_PEAK_TFLOPS")
+    monkeypatch.setenv("MXTPU_MFU_DEVICE_KIND", "v4")
+    peak, kind = tracing.projected_peak_flops()
+    assert peak == 275e12 and kind == "v4"
+
+
+def test_note_step_cost_sets_labeled_gauges():
+    tele.enable()
+    compiled = jax.jit(lambda x: x @ x).lower(
+        jnp.ones((32, 32), jnp.float32)).compile()
+    tracing.record_executable("k2", compiled, kind="train_step")
+    row = tracing.note_step_cost("k2", 5e-4)
+    assert row["flops"] > 0
+    assert row["mfu_estimate"] > 0
+    assert row["measured_ms"] == pytest.approx(0.5)
+    g = tele.registry().get("mfu_estimate")
+    assert g.value(program="train_step") == pytest.approx(
+        row["mfu_estimate"])
+    assert tele.registry().get("step_flops") \
+        .value(program="train_step") == row["flops"]
+    # unknown key: no row, no gauge churn
+    assert tracing.note_step_cost("nope", 1e-3) is None
+
+
+def test_train_step_cost_capture_and_journal_corpus(tmp_path):
+    journal = str(tmp_path / "j.jsonl")
+    tele.enable(journal_path=journal)
+    step, xs, ys = _tiny_step()
+    step.warmup(xs, ys)
+    feats = step.cost_features()
+    assert feats["flops"] > 0
+    for _ in range(4):
+        step.dispatch(*step.place_batch(xs, ys))
+    step.drain()
+    rows = tele.RunJournal.read(journal)
+    retired = [r for r in rows if r["event"] == "step_retired"]
+    assert [r["step"] for r in retired] == [1, 2, 3, 4]
+    for r in retired:
+        assert r["cost"]["flops"] == feats["flops"]
+        assert r["cost"]["measured_ms"] > 0
+        assert r["cost"]["mfu_estimate"] > 0
+        assert r["cost"]["mfu_projected"] is True
+    mfu = step.mfu_estimate(1e-3)
+    assert mfu["mfu_estimate"] > 0
+
+
+# ---------------------------------------------------------------------------
+# prefetcher handoff + pending gauge (satellites)
+# ---------------------------------------------------------------------------
+
+def test_prefetch_spans_nest_across_thread_handoff():
+    tracing.enable()
+    tr = tracing.get_tracer("data")
+    src = [(onp.ones((2, 2)),) for _ in range(4)]
+    with tr.span("epoch") as outer:
+        with DevicePrefetcher(iter(src), depth=2) as pf:
+            for _ in pf:
+                pass
+    places = [s for s in tr.spans() if s.name == "prefetch.place"]
+    assert len(places) == 4
+    # the worker thread's placement spans parent under the consumer
+    # thread's open span, captured at construction (cross-thread handoff)
+    assert all(s.parent_id == outer.span_id for s in places)
+    assert all(s.trace_id == outer.trace_id for s in places)
+    waits = [s for s in tr.spans() if s.name == "prefetch.wait"]
+    assert len(waits) == 4
+
+
+def test_prefetch_pending_gauge_exported():
+    tele.enable()
+    src = [(onp.ones((2,)),) for _ in range(6)]
+    pf = DevicePrefetcher(iter(src), depth=2)
+    try:
+        it = iter(pf)
+        next(it)
+        g = tele.registry().get("prefetch_pending")
+        assert g is not None
+        assert g.value() >= 0
+        # the gauge rides the standard exposition
+        assert "prefetch_pending" in tele.to_prometheus()
+    finally:
+        pf.close()
+
+
+# ---------------------------------------------------------------------------
+# the concurrency contract: serve + train in ONE process
+# ---------------------------------------------------------------------------
+
+def test_concurrent_serve_and_train_no_cross_contamination(tmp_path):
+    """Satellite: two tracers in one process — concurrent serve + train
+    keep distinct trace ids, journal step ids stay correlated, and the
+    request span trees stay complete."""
+    from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from mxnet_tpu.serve import InferenceEngine, ServeConfig
+
+    journal = str(tmp_path / "j.jsonl")
+    tele.enable(journal_path=journal)
+    tracing.enable(dir=str(tmp_path))
+
+    cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=1,
+                    num_heads=2, intermediate_size=32, max_position=32,
+                    dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.initialize()
+    model(mx.np.array([[1, 2]], dtype="int32"))
+    eng = InferenceEngine(model, ServeConfig(
+        max_len=24, max_slots=2, num_pages=9, page_size=4,
+        prefill_chunk=4))
+    eng.warmup()
+
+    step, xs, ys = _tiny_step()
+    step.warmup(xs, ys)
+
+    errs = []
+
+    def serve_loop():
+        try:
+            hs = [eng.submit([1, 2, 3], max_new_tokens=3)
+                  for _ in range(2)]
+            eng.run_until_idle()
+            for h in hs:
+                h.result(timeout=10)
+        except Exception as e:   # pragma: no cover - failure reporting
+            errs.append(e)
+
+    def train_loop():
+        try:
+            for _ in range(4):
+                step.dispatch(xs, ys)
+                time.sleep(0.002)
+            step.drain()
+        except Exception as e:   # pragma: no cover - failure reporting
+            errs.append(e)
+
+    ts = [threading.Thread(target=serve_loop),
+          threading.Thread(target=train_loop)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    assert not errs, errs
+
+    serve_spans = tracing.get_tracer("serve").spans()
+    train_spans = tracing.get_tracer("train").spans()
+    serve_tids = {s.trace_id for s in serve_spans}
+    train_tids = {s.trace_id for s in train_spans}
+    assert serve_tids and train_tids
+    assert not serve_tids & train_tids
+    assert all(t.startswith("serve-") for t in serve_tids)
+    assert all(t.startswith("train-") for t in train_tids)
+
+    # request trees complete despite the concurrent train traffic
+    reqs = [s for s in serve_spans if s.name == "serve.request"]
+    assert len(reqs) == 2
+    for root in reqs:
+        children = [s for s in serve_spans
+                    if s.parent_id == root.span_id]
+        kinds = {s.name for s in children}
+        assert "serve.queue" in kinds
+        assert kinds & {"serve.prefill_chunk", "serve.first_decode"}
+        assert all(s.trace_id == root.trace_id for s in children)
+
+    # journal correlation: train span step tags == journal retired ids
+    rows = tele.RunJournal.read(journal)
+    retired = sorted(r["step"] for r in rows
+                     if r["event"] == "step_retired")
+    span_steps = sorted(s.tags["step"] for s in train_spans
+                        if s.name == "train.device")
+    assert retired == span_steps == [1, 2, 3, 4]
+
+
+# ---------------------------------------------------------------------------
+# prometheus exposition hardening (satellite)
+# ---------------------------------------------------------------------------
+
+def _parse_prometheus(text):
+    """Strict round-trip parser (the telemetry_smoke grammar)."""
+    import re
+    comment = re.compile(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* ?.*$")
+    sample = re.compile(
+        r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+        r"(?:\{(?P<labels>[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\""
+        r"(?:,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\")*)\})?"
+        r" (?P<value>[0-9.eE+-]+|NaN|\+Inf|-Inf)$")
+    out = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            assert comment.match(line), f"line {lineno}: {line!r}"
+            continue
+        m = sample.match(line)
+        assert m, f"line {lineno}: {line!r}"
+        labels = {}
+        if m.group("labels"):
+            for k, v in re.findall(
+                    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"',
+                    m.group("labels")):
+                labels[k] = (v.replace(r"\n", "\n").replace(r"\"", '"')
+                             .replace(r"\\", "\\"))
+        val = m.group("value")
+        out.setdefault(m.group("name"), []).append(
+            (labels, float("nan") if val == "NaN" else float(val)))
+    return out
+
+
+def test_prometheus_roundtrip_with_hostile_values():
+    nasty = 'a\\b"c\nd'
+    c = tele.counter("hard_total", 'help with "quotes"\nand\\slashes',
+                     labelnames=("k",))
+    c.inc(3, k=nasty)
+    g = tele.gauge("weird_vals")
+    g.set(float("inf"))
+    h = tele.histogram("hard_ms", "hist help", buckets=(1.0, 10.0))
+    h.observe(5)
+    parsed = _parse_prometheus(tele.to_prometheus())
+    # label value survives the round trip byte-for-byte
+    (labels, val), = parsed["hard_total"]
+    assert labels == {"k": nasty}
+    assert val == 3
+    # non-finite values use the spec spellings (repr() would emit 'inf')
+    (_, gv), = parsed["weird_vals"]
+    assert gv == float("inf")
+    assert parsed["hard_ms_count"][0][1] == 1
+    # TYPE/HELP emitted per family
+    text = tele.to_prometheus()
+    assert "# TYPE hard_total counter" in text
+    assert "# TYPE hard_ms histogram" in text
+    assert '# HELP hard_total help with "quotes"\\nand\\\\slashes' \
+        in text
+
+
+def test_prometheus_nan_gauge_spelling():
+    tele.gauge("nan_g").set(float("nan"))
+    text = tele.to_prometheus()
+    assert "nan_g NaN" in text
+    _parse_prometheus(text)   # grammar accepts it

@@ -10,6 +10,8 @@ Also pretty-prints crash flight-recorder bundles (docs/observability.md,
     python tools/diagnose.py --crash-dir <dir>     # newest bundle in dir
     python tools/diagnose.py --journal <run.jsonl> # remediation timeline
                                                    # + rollback lineage
+    python tools/diagnose.py --trace <trace.json>  # span timeline +
+                                                   # critical-path summary
 """
 from __future__ import annotations
 
@@ -170,6 +172,121 @@ def print_journal(path: str) -> int:
     return 0
 
 
+def _pctl(sorted_vals, p):
+    if not sorted_vals:
+        return None
+    import math
+    # ceiling nearest-rank: p99 of a small population is its max, not
+    # the second-to-last sample
+    return sorted_vals[min(len(sorted_vals) - 1,
+                           math.ceil(p * (len(sorted_vals) - 1)))]
+
+
+def print_trace(path: str) -> int:
+    """Per-request / per-step timeline + critical-path summary from a
+    Chrome trace exported by `mx.tracing.export_chrome` (docs/
+    observability.md, "Tracing & performance attribution")."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"cannot read trace {path}: {e}", file=sys.stderr)
+        return 1
+    spans = [e for e in doc.get("traceEvents", doc if
+             isinstance(doc, list) else []) if e.get("ph") == "X"]
+    print(f"========== trace: {path} ==========")
+    print(f"spans     : {len(spans)}")
+    if not spans:
+        return 0
+
+    # ---- serve: one line per request, TTFT decomposed ---------------
+    by_req: dict = {}
+    for s in spans:
+        rid = (s.get("args") or {}).get("request_id")
+        if rid is not None:
+            by_req.setdefault(rid, []).append(s)
+    reqs = {rid: ss for rid, ss in by_req.items()
+            if any(s["name"] == "serve.request" for s in ss)}
+    if reqs:
+        print(f"---------- serve requests ({len(reqs)}) ----------")
+        print(f"  {'req':>5} {'state':<10} {'queue':>9} {'prefill':>9} "
+              f"{'1st dec':>9} {'decode':>9} {'ttft':>9} {'total':>9}  "
+              f"(ms)")
+        rows = []
+        for rid in sorted(reqs):
+            ss = reqs[rid]
+
+            def total(name):
+                return sum(s["dur"] for s in ss
+                           if s["name"] == name) / 1e3
+
+            root = next(s for s in ss if s["name"] == "serve.request")
+            args = root.get("args") or {}
+            q, pf, fd = (total("serve.queue"),
+                         total("serve.prefill_chunk"),
+                         total("serve.first_decode"))
+            ttft = args.get("ttft_ms")
+            if ttft is None:
+                ttft = q + pf + fd
+            rows.append({"rid": rid, "queue": q, "prefill": pf,
+                         "first_decode": fd, "ttft": float(ttft)})
+            print(f"  {rid:>5} {str(args.get('state')):<10} {q:>9.2f} "
+                  f"{pf:>9.2f} {fd:>9.2f} "
+                  f"{total('serve.decode'):>9.2f} {float(ttft):>9.2f} "
+                  f"{root['dur'] / 1e3:>9.2f}")
+        # critical path at the tail: which phase owns the p99 TTFT
+        ordered = sorted(rows, key=lambda r: r["ttft"])
+        ttfts = [r["ttft"] for r in ordered]
+        p50, p99 = _pctl(ttfts, 0.50), _pctl(ttfts, 0.99)
+        import math as _math
+        worst = ordered[min(len(ordered) - 1,
+                            _math.ceil(0.99 * (len(ordered) - 1)))]
+        denom = max(worst["ttft"], 1e-9)
+        print(f"  TTFT p50 = {p50:.2f} ms, p99 = {p99:.2f} ms")
+        print(f"  critical path @p99 (req {worst['rid']}): "
+              f"{100 * worst['queue'] / denom:.0f}% queue wait, "
+              f"{100 * worst['prefill'] / denom:.0f}% prefill, "
+              f"{100 * worst['first_decode'] / denom:.0f}% first decode")
+
+    # ---- train: step cadence + per-phase wall -----------------------
+    t_disp = [s for s in spans if s["name"] == "train.dispatch"]
+    t_dev = [s for s in spans if s["name"] == "train.device"]
+    if t_disp or t_dev:
+        print(f"---------- train steps ({max(len(t_disp), len(t_dev))}) "
+              f"----------")
+        for name, group in (("dispatch (host)", t_disp),
+                            ("device (dispatch->retire)", t_dev)):
+            if not group:
+                continue
+            durs = sorted(s["dur"] / 1e3 for s in group)
+            steps_seen = [s.get("args", {}).get("step") for s in group]
+            mean = sum(durs) / len(durs)
+            print(f"  {name:<26} n={len(durs):<5} mean={mean:>8.2f} ms  "
+                  f"p99={_pctl(durs, 0.99):>8.2f} ms  steps "
+                  f"{min(x for x in steps_seen if x is not None)}-"
+                  f"{max(x for x in steps_seen if x is not None)}")
+        compiles = [s for s in spans
+                    if s["name"] in ("train.compile", "serve.compile")]
+        for s in compiles:
+            print(f"  compile: {s['name']} {s['dur'] / 1e3:.0f} ms "
+                  f"{s.get('args', {})}")
+
+    # ---- everything else: count + total wall per span name ----------
+    other = {}
+    for s in spans:
+        if s["name"].startswith(("serve.", "train.")):
+            continue
+        agg = other.setdefault(s["name"], [0, 0.0])
+        agg[0] += 1
+        agg[1] += s["dur"] / 1e3
+    if other:
+        print("---------- other spans ----------")
+        for name in sorted(other):
+            n, tot = other[name]
+            print(f"  {name:<28} n={n:<6} total={tot:>10.2f} ms")
+    return 0
+
+
 def _newest_bundle(crash_dir: str):
     paths = glob.glob(os.path.join(crash_dir, "crash_*.json"))
     return max(paths, key=os.path.getmtime) if paths else None
@@ -188,6 +305,8 @@ def main():
         return sys.exit(print_bundle(_flag_operand("--bundle")))
     if "--journal" in sys.argv:
         return sys.exit(print_journal(_flag_operand("--journal")))
+    if "--trace" in sys.argv:
+        return sys.exit(print_trace(_flag_operand("--trace")))
     if "--crash-dir" in sys.argv:
         d = _flag_operand("--crash-dir")
         newest = _newest_bundle(d)

@@ -32,7 +32,7 @@ RUNS = 3
 TIMEOUT = 600
 
 
-def measure_once() -> float:
+def measure_once() -> tuple:
     proc = subprocess.run(
         [sys.executable, os.path.join(ROOT, "bench.py"),
          "--measure", "cpu"],
@@ -45,17 +45,30 @@ def measure_once() -> float:
         line = line.strip()
         if line.startswith("{"):
             d = json.loads(line)
-            return float(d["extras"]["samples_per_sec_per_chip"])
+            mfu = (d["extras"].get("cost") or {}).get("mfu_estimate")
+            return float(d["extras"]["samples_per_sec_per_chip"]), mfu
     raise RuntimeError("no JSON line in bench output")
 
 
 def main() -> int:
-    vals = []
+    vals, mfus = [], []
     for i in range(RUNS):
-        v = measure_once()
+        v, mfu = measure_once()
         vals.append(v)
-        print(f"perf-gate: run {i + 1}/{RUNS}: {v:.2f} samples/s/chip")
+        if mfu is not None:
+            mfus.append(float(mfu))
+        print(f"perf-gate: run {i + 1}/{RUNS}: {v:.2f} samples/s/chip"
+              + (f"  (mfu_estimate {mfu:.3g}, projected peak)"
+                 if mfu is not None else ""))
     med = statistics.median(vals)
+    # RECORDED, never gated: the projected-MFU trajectory belongs in
+    # BENCH_*.json / the gate transcript so the number is visible every
+    # round while the TPU tunnel is down — it is a cost-model proxy,
+    # not a CPU regression signal (docs/observability.md)
+    med_mfu = statistics.median(mfus) if mfus else None
+    if med_mfu is not None:
+        print(f"perf-gate: mfu_estimate median {med_mfu:.4g} "
+              f"(informational; from XLA cost_analysis flops)")
 
     if "--rebaseline" in sys.argv:
         budget = {
@@ -63,6 +76,8 @@ def main() -> int:
             "samples_per_sec_per_chip": round(med, 1),
             "tolerance": 0.15,
             "measured_at": time.strftime("%Y-%m-%d"),
+            # informational only — the gate never fails on it
+            "mfu_estimate": med_mfu,
             "note": "re-baselined by tools/perf_gate.py --rebaseline "
                     "(median of %d runs: %s)" % (RUNS, vals),
         }
