@@ -369,6 +369,128 @@ def _measure_serve() -> dict:
     }
 
 
+def _measure_serve_fleet(replicas: int, kill_at: float) -> dict:
+    """`bench.py --serve --replicas N [--kill-at S]`: aggregate fleet
+    throughput + tail-TTFT UNDER REPLICA LOSS (the ROADMAP item 1
+    metric).  One replica is killed `kill_at` seconds into the load
+    window; its in-flight streams fail over to survivors, and the run
+    must still report nonzero aggregate tokens/s and a finite p99 TTFT
+    measured across the whole population — loss window included."""
+    import jax
+    ambient = os.environ.get("JAX_PLATFORMS", "").lower()
+    if not any(t in ambient for t in ("tpu", "axon")):
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from mxnet_tpu.serve import ServeConfig, ServeFleet, ShedError
+
+    dev = jax.devices()[0]
+    on_accel = dev.platform.lower() == "tpu"
+    if on_accel:
+        cfg = GPTConfig(vocab_size=32000, hidden_size=1024, num_layers=8,
+                        num_heads=16, intermediate_size=4096,
+                        max_position=1024, dropout=0.0, dtype="bfloat16")
+        n_req, max_new, max_len = 64, 64, 512
+    else:
+        cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                        num_heads=4, intermediate_size=128,
+                        max_position=256, dropout=0.0)
+        n_req, max_new, max_len = 24, 16, 128
+    model = GPTForCausalLM(cfg)
+    model.initialize()
+    model(mx.np.array([[1, 2]], dtype="int32"))
+
+    fleet = ServeFleet(model, replicas=replicas,
+                       config=ServeConfig(max_len=max_len))
+    compile_s = fleet.warmup()
+
+    rng = _onp.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size,
+                           rng.randint(4, 48)).tolist()
+               for _ in range(n_req)]
+    handles = []
+    killed = None
+    # pace arrivals so the load window straddles the kill: with
+    # --kill-at S the last request arrives around 2S, guaranteeing the
+    # loss lands mid-load however fast the backend decodes
+    pace = (2.0 * kill_at / n_req) if kill_at else 0.0
+    next_arrival = 0.0
+    t0 = time.perf_counter()
+    with fleet:
+        arrivals = list(prompts)
+        # burst, then staggered arrivals — the queue stays non-empty
+        # while slots churn across all replicas
+        while arrivals or not all(h.done() for h in handles):
+            if killed is None and kill_at is not None and \
+                    time.perf_counter() - t0 >= kill_at:
+                # kill a loaded replica mid-window (prefer one holding
+                # active streams so the failover path is exercised)
+                victim = max(
+                    (r for r in fleet.replicas if r.state == "running"),
+                    key=lambda r: r.engine.scheduler.active_count,
+                    default=None)
+                if victim is not None:
+                    killed = victim.name
+                    fleet.kill(victim.name,
+                               error="bench --kill-at replica loss")
+            now = time.perf_counter() - t0
+            if arrivals and now >= next_arrival:
+                try:
+                    handles.append(fleet.submit(
+                        arrivals[0], max_new_tokens=max_new))
+                    arrivals.pop(0)
+                    next_arrival = now + pace
+                except ShedError as e:
+                    time.sleep(min(e.retry_after_ms, 100.0) / 1e3)
+            else:
+                time.sleep(0.002)
+            if time.perf_counter() - t0 > 600:
+                break
+        for h in handles:
+            h.result(timeout=120)
+    wall = time.perf_counter() - t0
+    toks = sum(len(h.tokens) for h in handles)
+    ttfts = sorted(h.ttft_s * 1e3 for h in handles
+                   if h.ttft_s is not None)
+
+    def pct(p):
+        if not ttfts:
+            return None
+        return round(ttfts[min(len(ttfts) - 1,
+                               int(p * (len(ttfts) - 1)))], 2)
+
+    stats = fleet.stats()
+    extras = {
+        "requests": n_req,
+        "generated_tokens": toks,
+        "ttft_p50_ms": pct(0.50),
+        "ttft_p99_ms": pct(0.99),
+        "wall_s": round(wall, 3),
+        "compile_seconds": round(compile_s, 2),
+        "replicas": replicas,
+        "kill_at_s": kill_at,
+        "killed_replica": killed,
+        "deaths": fleet.deaths,
+        "failovers": sum(h.failovers for h in handles),
+        "evictions": sum(h.evictions for h in handles),
+        "sheds": stats["router"]["sheds"],
+        "routed": stats["router"]["routed"],
+        "replica_states": {n: r["state"]
+                           for n, r in stats["replicas"].items()},
+        "device": getattr(dev, "device_kind", str(dev)),
+        "platform": dev.platform,
+    }
+    return {
+        "metric": "serve_fleet_tokens_per_sec",
+        "value": round(toks / wall, 2),
+        "unit": "tokens_per_sec",
+        "vs_baseline": 0.0,   # north-star baseline is MFU-on-TPU
+        "extras": extras,
+    }
+
+
 def _measure_data() -> dict:
     """`bench.py --data`: throughput of the deterministic input pipeline
     (docs/data.md) — indexed RecordIO shards through the mixture
@@ -965,6 +1087,16 @@ class _ClaimLock:
         return False
 
 
+def _flag_operand(flag: str, default: str) -> str:
+    """Value following `flag` in argv (or `default` when absent/bare)."""
+    if flag not in sys.argv:
+        return default
+    idx = sys.argv.index(flag)
+    if idx + 1 >= len(sys.argv) or sys.argv[idx + 1].startswith("--"):
+        return default
+    return sys.argv[idx + 1]
+
+
 def main():
     if "--telemetry" in sys.argv:
         # flag travels to the measurement child through the environment
@@ -1007,7 +1139,17 @@ def main():
         # harmless extra serialization when the backend resolves to CPU
         _wait_for_claim_lock()
         with _ClaimLock():
-            print(json.dumps(_measure_serve()))
+            if "--replicas" in sys.argv:
+                # fleet mode: aggregate tokens/s + tail TTFT under
+                # replica loss (docs/serving.md "Fleet, failover &
+                # overload"); --kill-at S kills a loaded replica S
+                # seconds into the load window
+                print(json.dumps(_measure_serve_fleet(
+                    int(_flag_operand("--replicas", "2")),
+                    (float(_flag_operand("--kill-at", "0"))
+                     if "--kill-at" in sys.argv else None))))
+            else:
+                print(json.dumps(_measure_serve()))
         return
 
     _wait_for_claim_lock()

@@ -61,7 +61,7 @@ from . import telemetry as _tele
 __all__ = [
     "HealthMonitor", "FlightRecorder", "HangWatchdog",
     "enabled", "enable", "disable", "probes_enabled",
-    "beat", "heartbeat_ages", "healthz", "stall_timeout",
+    "beat", "clear_beat", "heartbeat_ages", "healthz", "stall_timeout",
     "suppress_stalls", "stalls_suppressed",
     "monitor", "flight_recorder", "watchdog", "dump_bundle",
     "record_stall",
@@ -98,6 +98,15 @@ def beat(name: str) -> None:
     changed size during iteration'."""
     with _beats_lock:
         _beats[name] = time.monotonic()
+
+
+def clear_beat(name: str) -> bool:
+    """Retire a named heartbeat (True if it existed).  For per-entity
+    beats whose entity is gone — a serving fleet names one heartbeat per
+    replica (``serve.replica.<name>``), and a dead replica's frozen
+    timestamp must not haunt /healthz or a supervisor's stall sweep."""
+    with _beats_lock:
+        return _beats.pop(name, None) is not None
 
 
 def _beats_snapshot() -> Dict[str, float]:

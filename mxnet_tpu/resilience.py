@@ -23,7 +23,9 @@ Spec grammar (comma-separated entries)::
     MXTPU_FAULT_SPEC = entry[,entry...]
     entry            = point@hit[:action]
     point            = injection point name (ckpt_write, ckpt_read,
-                       worker_exec, elastic_step, ...)
+                       worker_exec, elastic_step, replica_step,
+                       router_dispatch, ... — full table in
+                       docs/resilience.md)
     hit              = 1-based occurrence count, per process: the fault
                        fires the hit-th time the point is reached
     action           = builtin exception name (OSError, ValueError, ...)

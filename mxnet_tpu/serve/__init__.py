@@ -7,7 +7,11 @@ page-table allocator (`kv_cache`), ONE compiled mixed prefill+decode step
 with donated pool buffers (`engine`), a continuous-batching scheduler with
 admission backpressure, recompute-preemption eviction, and per-token
 streaming (`scheduler`), all instrumented through the telemetry/health
-stack.  The attention primitive lives in
+stack.  `fleet`/`router` stack the robustness tier on top: a supervised
+fleet of N engine replicas behind a load-aware `RequestRouter` with
+mid-stream failover (a dead replica's streams resume bit-identical on a
+survivor), graceful draining, and overload shedding — see docs/serving.md
+"Fleet, failover & overload".  The attention primitive lives in
 `ops/pallas/paged_attention.py` (Pallas TPU kernel + dense reference), and
 the transformer decode math (`decode`) is shared with
 `GPTForCausalLM.generate` so serving and single-model generation can never
@@ -19,9 +23,12 @@ from .decode import (  # noqa: F401
 from .kv_cache import KVPools, PageAllocator  # noqa: F401
 from .scheduler import ContinuousBatchingScheduler, ServeRequest  # noqa: F401
 from .engine import InferenceEngine, ServeConfig  # noqa: F401
+from .router import RequestRouter, ShedError  # noqa: F401
+from .fleet import Replica, ServeFleet  # noqa: F401
 
 __all__ = [
     "InferenceEngine", "ServeConfig", "ContinuousBatchingScheduler",
     "ServeRequest", "KVPools", "PageAllocator", "extract_decode_weights",
     "transformer_step", "lm_logits",
+    "ServeFleet", "Replica", "RequestRouter", "ShedError",
 ]

@@ -408,6 +408,45 @@ class InferenceEngine:
     def run_until_idle(self, max_steps: int = 100000) -> int:
         return self.scheduler.run_until_idle(max_steps)
 
+    def drain(self, max_steps: int = 100000):
+        """Gracefully retire this engine: stop admitting new work, run
+        every already-accepted stream to completion, and return the
+        requests that were still QUEUED (they hold no pages and no
+        progress worth keeping here — a fleet re-dispatches them to a
+        surviving replica; a standalone caller can resubmit them).
+
+        Evicted actives re-queue internally and still re-admit — drain
+        finishes every stream that ever held a slot.  After drain the
+        active set is empty and `submit`/`enqueue` raise."""
+        sched = self.scheduler
+        sched.draining = True
+        handed_back = sched.detach_queued()
+        steps = 0
+        while (sched.active_count or sched.queue_depth) \
+                and steps < max_steps:
+            sched.step()
+            steps += 1
+        return handed_back
+
+    def adopt_executables(self, other: "InferenceEngine") -> None:
+        """Install another engine's compiled step executables instead of
+        lowering our own — replica N>1 of a fleet warms from replica 0's
+        AOT compile (the executables are pure programs over (weights,
+        pools, batch); each engine still passes its OWN pool buffers).
+        Requires an identical serving configuration."""
+        if other._export_config() != self._export_config():
+            raise MXNetError(
+                f"adopt_executables: config mismatch "
+                f"({other._export_config()} vs {self._export_config()})")
+        if not other._execs:
+            raise MXNetError(
+                "adopt_executables: source engine has no compiled steps "
+                "(call warmup() on it first)")
+        self._execs.update(other._execs)
+        for C, ex in other._execs.items():
+            self._record_cost(C, ex, source="adopted")
+        self.compile_seconds = 0.0
+
     def generate(self, prompt, max_new_tokens: int = 20, greedy: bool = True,
                  temperature: float = 1.0, eos_token_id=None):
         """One-shot convenience: submit a single request, drive the loop

@@ -1,0 +1,436 @@
+"""Supervised serving fleet: N `InferenceEngine` replicas behind one
+router, with replica supervision, mid-stream failover, graceful drain.
+
+The robustness tier the training side already has (fault registry →
+recovery ladder → elastic reform) applied to serving: a replica is an
+in-process driver thread pumping its own engine — the SAME simulation
+pattern `parallel/elastic_mesh.py` uses for hosts (partitions of one
+process stand in for real processes; the control path is identical, so
+moving a replica behind an RPC boundary later changes the transport,
+not the protocol).
+
+Supervision protocol (docs/serving.md "Fleet, failover & overload"):
+
+- every driver touches a per-replica heartbeat
+  (``serve.replica.<name>`` via `health.beat`) once per loop;
+- a **supervisor thread** declares a replica dead on (a) an escaped
+  exception from its step loop (device failure, injected
+  ``replica_step`` fault), (b) a driver thread that exited without
+  reporting, or (c) a heartbeat older than ``stall_timeout`` while the
+  replica holds work — the wedged-in-device-call case;
+- a dead replica is retired WHOLE (engine, pool, allocator — nothing is
+  scavenged from a suspect pool) and its in-flight requests are
+  **salvaged**: collected un-terminated and re-dispatched through the
+  router with their generated tokens folded into the re-prefill prefix,
+  exactly the eviction rule — greedy streams resume **bit-identical**
+  on the survivor and never re-emit a token;
+- `drain()` is the graceful inverse: the router stops selecting the
+  replica, its queued (no-progress) requests are handed back, its
+  active streams run to completion, and the driver exits with an empty
+  active set — shrink and rolling restarts without a dropped request.
+
+Failure matrix: see docs/serving.md.  Chaos: arm
+``MXTPU_FAULT_SPEC=replica_step@N`` (die mid-step) and
+``router_dispatch@N`` (dispatch edge fault) — `make fleet-smoke` does
+both and asserts zero dropped requests and bit-identical streams.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from ..base import MXNetError
+from .. import health as _health
+from .. import telemetry as _tele
+from .. import tracing as _trace
+from .engine import InferenceEngine, ServeConfig, _env_int
+from .router import RequestRouter
+from .scheduler import ServeRequest, terminate_request
+
+__all__ = ["ServeFleet", "Replica"]
+
+
+class Replica:
+    """One supervised serving replica: an engine plus its driver thread.
+
+    ``state`` lifecycle: ``starting`` (accepts work, driver not yet
+    running) → ``running`` → ``draining`` → ``drained``, or → ``dead``
+    (exception/stall/kill), or → ``stopped`` (fleet closed).  Dead,
+    drained and stopped are terminal."""
+
+    def __init__(self, name: str, engine: InferenceEngine):
+        self.name = name
+        self.engine = engine
+        self.state = "starting"
+        self.thread: Optional[threading.Thread] = None
+        self.wake = threading.Event()
+        self.drained_event = threading.Event()
+        self.error: Optional[str] = None
+
+    @property
+    def heartbeat_name(self) -> str:
+        return f"serve.replica.{self.name}"
+
+    def notify(self) -> None:
+        self.wake.set()
+
+    def __repr__(self):
+        s = self.engine.scheduler
+        return (f"Replica({self.name}, {self.state}, active="
+                f"{s.active_count}, queued={s.queue_depth})")
+
+
+class ServeFleet:
+    """A supervised fleet of `InferenceEngine` replicas over one model.
+
+    Typical use::
+
+        fleet = mx.serve.ServeFleet(model, replicas=3)
+        with fleet:                        # start() ... close()
+            h = fleet.submit([1, 2, 3], max_new_tokens=32)
+            out = h.result(timeout=30)
+
+    `submit` routes through the fleet's `RequestRouter` (load-aware
+    dispatch, bounded global queue, load shedding — `ShedError`).  All
+    replicas share the model weights and, after `warmup()`, the SAME
+    compiled step executables (replica 0 lowers, the rest adopt).
+    """
+
+    def __init__(self, model, replicas: Optional[int] = None,
+                 config: Optional[ServeConfig] = None, seed: int = 0,
+                 router_queue: Optional[int] = None,
+                 shed_deadline_ms: Optional[float] = None,
+                 stall_timeout: float = 10.0,
+                 poll_interval: float = 0.02,
+                 supervise_interval: Optional[float] = None):
+        n = replicas if replicas is not None \
+            else _env_int("MXTPU_SERVE_REPLICAS", 2)
+        if n < 1:
+            raise MXNetError(f"fleet needs >= 1 replica, got {n}")
+        self.model = model
+        self.config = config or ServeConfig()
+        self.stall_timeout = float(stall_timeout)
+        self.poll_interval = float(poll_interval)
+        self.supervise_interval = float(
+            supervise_interval if supervise_interval is not None
+            else max(0.01, min(0.25, self.stall_timeout / 4)))
+        self.replicas: List[Replica] = []
+        for i in range(n):
+            eng = InferenceEngine(model, self.config, seed=seed + i)
+            rep = Replica(f"r{i}", eng)
+            eng.scheduler.name = rep.name
+            # fleet mode: a failed device step leaves requests for
+            # salvage instead of terminally failing them
+            eng.scheduler.salvage_on_error = True
+            self.replicas.append(rep)
+        self.router = RequestRouter(
+            lambda: list(self.replicas), queue_bound=router_queue,
+            shed_deadline_ms=shed_deadline_ms,
+            default_deadline_ms=self.config.deadline_ms)
+        self.deaths = 0
+        self._stop = threading.Event()
+        self._lock = threading.RLock()
+        self._supervisor: Optional[threading.Thread] = None
+        self._warmed = False
+        self._started = False
+        self._closed = False
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def warmup(self) -> float:
+        """Compile the step programs ONCE (replica 0 — live AOT lower or
+        an export-artifact load, docs/export.md) and share the
+        executables with every other replica.  Returns replica 0's
+        compile seconds."""
+        first = self.replicas[0].engine
+        secs = first.warmup()
+        for rep in self.replicas[1:]:
+            rep.engine.adopt_executables(first)
+        self._warmed = True
+        return secs
+
+    def start(self) -> "ServeFleet":
+        if self._started:
+            return self
+        if self._closed:
+            raise MXNetError(
+                "this ServeFleet was closed — its replicas are retired; "
+                "create a new fleet instead of restarting")
+        if not self._warmed:
+            self.warmup()
+        self._started = True
+        for rep in self.replicas:
+            if rep.state != "starting":
+                continue
+            rep.state = "running"
+            _health.beat(rep.heartbeat_name)
+            rep.thread = threading.Thread(
+                target=self._drive, args=(rep,), daemon=True,
+                name=f"serve-replica-{rep.name}")
+            rep.thread.start()
+            self._journal_replica(rep, "started")
+        self._supervisor = threading.Thread(
+            target=self._supervise, daemon=True, name="serve-supervisor")
+        self._supervisor.start()
+        self._update_fleet_gauges()
+        return self
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop every driver and the supervisor; the fleet is terminal
+        afterwards (submit sheds `no_replicas`, start() raises).  Does
+        NOT drain — call `drain()` per replica first for a graceful
+        rolling stop."""
+        self._stop.set()
+        for rep in self.replicas:
+            rep.notify()
+        for rep in self.replicas:
+            if rep.thread is not None:
+                rep.thread.join(timeout)
+        if self._supervisor is not None:
+            self._supervisor.join(timeout)
+        with self._lock:
+            # non-terminal replicas have no driver anymore: a "running"
+            # label would let submit() enqueue work nobody will ever
+            # pump, and a restarted supervisor would misread the dead
+            # threads as replica deaths
+            stopped = [rep for rep in self.replicas
+                       if rep.state in ("starting", "running",
+                                        "draining")]
+            for rep in stopped:
+                rep.state = "stopped"
+        self._closed = True
+        self._started = False
+        # every waiter unblocks: requests still queued or active on a
+        # stopped replica are as undeliverable as router-parked ones —
+        # a stuck result() waiter is worse than an error
+        for rep in stopped:
+            for req in rep.engine.scheduler.salvage():
+                terminate_request(
+                    req, "fleet closed with the request in flight",
+                    state="failed", phase="failover_failed",
+                    replica=rep.name, generated=len(req.tokens))
+        self.router.fail_all_parked("fleet closed")
+        self._update_fleet_gauges()
+
+    def __enter__(self) -> "ServeFleet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------------
+    # public request API
+    # ------------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 20, greedy: bool = True,
+               temperature: float = 1.0, eos_token_id=None, on_token=None,
+               deadline_ms: Optional[float] = None) -> ServeRequest:
+        """Route one request into the fleet (may raise `ShedError` under
+        overload — callers retry after `.retry_after_ms`)."""
+        return self.router.submit(
+            prompt, max_new_tokens, greedy=greedy, temperature=temperature,
+            eos_token_id=eos_token_id, on_token=on_token,
+            deadline_ms=deadline_ms)
+
+    def quiesce(self, timeout: float = 120.0) -> bool:
+        """Block until no request is parked, queued, or active anywhere
+        in the fleet (or `timeout` elapses — returns False)."""
+        deadline = time.perf_counter() + timeout
+        while time.perf_counter() < deadline:
+            busy = self.router.queue_depth > 0 or any(
+                r.engine.scheduler.active_count
+                or r.engine.scheduler.queue_depth
+                for r in self.replicas if r.state in
+                ("starting", "running", "draining"))
+            if not busy:
+                return True
+            time.sleep(0.005)
+        return False
+
+    # ------------------------------------------------------------------
+    # failure handling
+    # ------------------------------------------------------------------
+    def kill(self, name: str, error: str = "killed by fleet.kill()"):
+        """Abruptly retire a replica (bench/chaos hook): its in-flight
+        requests fail over exactly as if its step loop had died."""
+        self._replica_died(self._rep(name), MXNetError(error))
+
+    def _rep(self, name: str) -> Replica:
+        for rep in self.replicas:
+            if rep.name == name:
+                return rep
+        raise MXNetError(f"no replica named {name!r} "
+                         f"({[r.name for r in self.replicas]})")
+
+    def _replica_died(self, rep: Replica, exc: BaseException) -> None:
+        with self._lock:
+            if rep.state in ("dead", "drained"):
+                return          # double-fire guard (driver + supervisor)
+            rep.state = "dead"
+            rep.error = f"{type(exc).__name__}: {exc}"
+            self.deaths += 1
+        t0 = time.perf_counter()
+        salvaged = rep.engine.scheduler.salvage()
+        if _tele.enabled():
+            _tele.counter("serve_replica_deaths_total",
+                          "Replicas retired by the supervisor",
+                          labelnames=("replica",)).inc(replica=rep.name)
+            self._journal_replica(rep, "dead", error=rep.error,
+                                  salvaged=len(salvaged))
+        self.router.redispatch(salvaged, source=rep.name,
+                               reason="failover")
+        if not self.router._running():
+            self.router.fail_all_parked(
+                f"no surviving replica after {rep.name} died")
+        if _trace.enabled():
+            _trace.get_tracer("serve").record_span(
+                "serve.failover", t0, time.perf_counter(),
+                track="serve router", replica=rep.name,
+                requests=len(salvaged), error=rep.error)
+        self._retire_series(rep)
+        for other in self.replicas:
+            other.notify()
+        self._update_fleet_gauges()
+
+    def _retire_series(self, rep: Replica) -> None:
+        """Drop the dead/drained replica's per-replica gauge series and
+        heartbeat — stale last-values must not outlive the replica."""
+        _health.clear_beat(rep.heartbeat_name)
+        if not _tele.enabled():
+            return
+        reg = _tele.registry()
+        for gname in ("serve_replica_queue_depth",
+                      "serve_replica_active_slots",
+                      "serve_replica_free_pages"):
+            g = reg.get(gname)
+            if g is not None:
+                g.remove(replica=rep.name)
+
+    # ------------------------------------------------------------------
+    # graceful drain
+    # ------------------------------------------------------------------
+    def drain(self, name: str, timeout: float = 60.0) -> bool:
+        """Gracefully retire one replica: stop routing to it, hand its
+        queued requests back to the router, let its active streams
+        finish, then the driver exits with an EMPTY active set.  Blocks
+        up to `timeout`; True when fully drained."""
+        rep = self._rep(name)
+        with self._lock:
+            if rep.state != "running":
+                raise MXNetError(
+                    f"cannot drain replica {name} in state {rep.state}")
+            rep.state = "draining"
+        sched = rep.engine.scheduler
+        sched.draining = True
+        handed = sched.detach_queued()
+        self._journal_replica(rep, "draining", handed_back=len(handed))
+        self.router.redispatch(handed, source=rep.name, reason="drain")
+        if not self.router._running():
+            # draining the LAST accepting replica: its active streams
+            # still finish, but un-started work has nowhere to go
+            self.router.fail_all_parked(
+                f"no accepting replica after draining {rep.name}")
+        rep.notify()
+        return rep.drained_event.wait(timeout)
+
+    def _finish_drain(self, rep: Replica) -> None:
+        with self._lock:
+            if rep.state != "draining":
+                return
+            rep.state = "drained"
+        self._journal_replica(
+            rep, "drained",
+            active=rep.engine.scheduler.active_count)
+        self._retire_series(rep)
+        rep.drained_event.set()
+        self._update_fleet_gauges()
+
+    # ------------------------------------------------------------------
+    # driver + supervisor threads
+    # ------------------------------------------------------------------
+    def _drive(self, rep: Replica) -> None:
+        sched = rep.engine.scheduler
+        while not self._stop.is_set():
+            if rep.state not in ("running", "draining") \
+                    or sched._abandoned:
+                return
+            _health.beat(rep.heartbeat_name)
+            try:
+                progressed = rep.engine.step()
+            except BaseException as exc:  # noqa: B036 — FaultExit et al.
+                # in-process replicas: ANY escape (device failure,
+                # injected fault, even a FaultExit "process kill") is a
+                # replica death, never a fleet death
+                self._replica_died(rep, exc)
+                return
+            pulled = self.router.feed(rep)
+            if rep.state == "draining" and not sched.active_count \
+                    and not sched.queue_depth:
+                self._finish_drain(rep)
+                return
+            if not progressed and not pulled:
+                rep.wake.wait(self.poll_interval)
+                rep.wake.clear()
+
+    def _supervise(self) -> None:
+        while not self._stop.wait(self.supervise_interval):
+            ages = _health.heartbeat_ages()
+            for rep in list(self.replicas):
+                if self._stop.is_set():
+                    # close() in progress: drivers exit deliberately —
+                    # a cleanly-stopped thread is not a dead replica
+                    return
+                if rep.state not in ("running", "draining"):
+                    continue
+                sched = rep.engine.scheduler
+                busy = sched.active_count or sched.queue_depth
+                if rep.thread is not None and not rep.thread.is_alive():
+                    # backstop: the driver died without reporting
+                    self._replica_died(
+                        rep, MXNetError("driver thread exited"))
+                    continue
+                age = ages.get(rep.heartbeat_name)
+                if age is not None and age > self.stall_timeout and busy:
+                    self._replica_died(rep, MXNetError(
+                        f"replica stalled: no heartbeat for "
+                        f"{age:.1f}s (> {self.stall_timeout:.1f}s) "
+                        f"with work in flight"))
+            self.router.sweep_expired()
+            self._update_fleet_gauges()
+
+    # ------------------------------------------------------------------
+    # observability
+    # ------------------------------------------------------------------
+    def _journal_replica(self, rep: Replica, phase: str, **fields):
+        if _tele.enabled():
+            _tele.event("replica", replica=rep.name, phase=phase,
+                        **fields)
+
+    def _update_fleet_gauges(self) -> None:
+        if not _tele.enabled():
+            return
+        counts = {"starting": 0, "running": 0, "draining": 0,
+                  "drained": 0, "dead": 0, "stopped": 0}
+        for rep in self.replicas:
+            counts[rep.state] = counts.get(rep.state, 0) + 1
+        g = _tele.gauge("serve_fleet_replicas",
+                        "Replicas by lifecycle state",
+                        labelnames=("state",))
+        for state, n in counts.items():
+            g.set(n, state=state)
+
+    def stats(self) -> dict:
+        return {
+            "replicas": {
+                rep.name: {
+                    "state": rep.state,
+                    "active": rep.engine.scheduler.active_count,
+                    "queued": rep.engine.scheduler.queue_depth,
+                    "free_pages": rep.engine.allocator.free_pages,
+                    "steps": rep.engine._steps_executed,
+                    "error": rep.error,
+                } for rep in self.replicas},
+            "router": self.router.stats(),
+            "deaths": self.deaths,
+        }

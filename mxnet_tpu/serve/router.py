@@ -1,0 +1,466 @@
+"""Request router for the serving fleet: continuous-batching-aware load
+balancing, bounded global admission, deadline-aware load shedding.
+
+The KVStore push/pull tier of the MXNet survey (layer 8) is the
+capability frame: many workers behind one coordination point.  Here the
+workers are `InferenceEngine` replicas and the coordination point is
+this router — every request enters the fleet through `submit()`, which
+either **dispatches** it straight to the least-loaded running replica,
+**parks** it in a bounded global queue when every replica is saturated,
+or **sheds** it (`ShedError`, with a retry-after hint) when accepting it
+could only make every caller slower.  Overload therefore degrades
+predictably — bounded queueing, early rejection — instead of collapsing
+into unbounded latency.
+
+Load balancing reads the SAME values the per-replica gauges export
+(`serve_replica_queue_depth` / `serve_replica_active_slots` /
+`serve_replica_free_pages`): a replica's score is its backlog plus busy
+slots minus free-page headroom, so a replica mid-eviction-storm (no free
+pages) stops receiving work before it starts thrashing.
+
+Shedding policy (docs/serving.md "Fleet, failover & overload"):
+
+- ``queue_full`` — the global parked queue is at its bound
+  (``MXTPU_ROUTER_QUEUE``).
+- ``deadline`` — the request carries a deadline (or
+  ``MXTPU_SHED_DEADLINE_MS`` supplies a default one) smaller than the
+  router's current estimate of its queue wait; rejecting at submit costs
+  the caller one RTT instead of a guaranteed-late answer.
+- ``no_replicas`` — no running replica exists to ever serve it.
+
+Every shed raises :class:`ShedError` carrying ``reason`` and
+``retry_after_ms``, increments ``serve_shed_total{reason=}``, and lands
+as a ``shed`` journal event + ``serve.shed`` span.  Failover and drain
+re-dispatch (`redispatch`) NEVER sheds: that work was already admitted,
+and dropping admitted work is the failure mode this tier exists to
+prevent.
+
+The ``router_dispatch`` fault point (``MXTPU_FAULT_SPEC``) fires on the
+dispatch edge: an injected fault parks the request back in the global
+queue instead of losing it — chaos tests assert a dispatch failure is
+never a dropped request.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional
+
+from ..base import MXNetError
+from ..resilience import fault_point
+from .. import telemetry as _tele
+from .. import tracing as _trace
+from .engine import _env_int
+from .scheduler import (ServeRequest, _open_queue_span, expire_request,
+                        terminate_request)
+
+__all__ = ["ShedError", "RequestRouter"]
+
+
+class _DispatchFault(Exception):
+    """Internal wrapper: whatever exception the `router_dispatch` fault
+    point was armed with, re-shaped so the dispatch edge handles every
+    action uniformly (park, never drop)."""
+
+
+class ShedError(MXNetError):
+    """Raised by `RequestRouter.submit` when the fleet refuses a request
+    under overload.  ``reason`` is one of ``queue_full`` / ``deadline`` /
+    ``no_replicas``; ``retry_after_ms`` is the router's hint for when a
+    retry is likely to be admitted."""
+
+    def __init__(self, reason: str, retry_after_ms: float, detail: str):
+        super().__init__(
+            f"request shed ({reason}): {detail} "
+            f"[retry after ~{retry_after_ms:.0f} ms]")
+        self.reason = reason
+        self.retry_after_ms = float(retry_after_ms)
+
+
+class RequestRouter:
+    """Admission control + dispatch for a set of serving replicas.
+
+    `replicas` is a zero-arg callable returning the CURRENT replica
+    handles (the fleet's live view — membership changes between calls).
+    Each handle exposes ``name``, ``state`` (``"running"`` accepts
+    work), ``engine`` and ``notify()`` (wake its driver).
+    """
+
+    def __init__(self, replicas: Callable[[], List],
+                 queue_bound: Optional[int] = None,
+                 shed_deadline_ms: Optional[float] = None,
+                 default_deadline_ms: float = 0.0):
+        self._replicas = replicas
+        #: global parked-queue bound (MXTPU_ROUTER_QUEUE)
+        self.queue_bound = queue_bound if queue_bound is not None \
+            else _env_int("MXTPU_ROUTER_QUEUE", 64)
+        #: implied deadline for shedding decisions when a request has
+        #: none of its own (MXTPU_SHED_DEADLINE_MS; 0 = never imply)
+        self.shed_deadline_ms = float(
+            shed_deadline_ms if shed_deadline_ms is not None
+            else _env_int("MXTPU_SHED_DEADLINE_MS", 0))
+        #: deadline applied to every request without an explicit one
+        #: (mirrors ServeConfig.deadline_ms for the single-engine path)
+        self.default_deadline_ms = float(default_deadline_ms or 0.0)
+        self._queue: deque = deque()       # parked ServeRequests
+        self._lock = threading.Lock()
+        # EMA of the parked wait observed at dispatch — the wait
+        # estimator behind deadline shedding and retry-after hints
+        self._wait_ema_ms = 0.0
+        self.sheds = 0
+        self.routed = 0
+
+    # ------------------------------------------------------------------
+    # replica selection
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _score(rep) -> float:
+        """Lower = better target.  Backlog and busy slots count against
+        a replica; free KV pages (normalized) count for it — the
+        continuous-batching-aware part: a full pool means imminent
+        evictions, so new work goes elsewhere first."""
+        sched = rep.engine.scheduler
+        alloc = rep.engine.allocator
+        free_frac = alloc.free_pages / max(1, alloc.total_pages)
+        return (sched.queue_depth + sched.active_count) - free_frac
+
+    def _running(self) -> List:
+        # "starting" replicas accept work too: a fleet can be loaded
+        # before its drivers spin up (the work waits in their local
+        # queues); only draining/drained/dead replicas are off-limits
+        return [r for r in self._replicas()
+                if r.state in ("starting", "running")]
+
+    def _pick(self, running: List, headroom: bool = True):
+        """Best running replica; with ``headroom`` only replicas whose
+        local queue is below their slot count qualify (beyond that, the
+        global queue is the fairer place to wait)."""
+        if headroom:
+            running = [r for r in running
+                       if r.engine.scheduler.queue_depth
+                       < r.engine.serve_config.max_slots]
+        if not running:
+            return None
+        return min(running, key=self._score)
+
+    # ------------------------------------------------------------------
+    # admission (sheds) — the fleet's public submit path
+    # ------------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 20, greedy: bool = True,
+               temperature: float = 1.0, eos_token_id=None, on_token=None,
+               deadline_ms: Optional[float] = None) -> ServeRequest:
+        running = self._running()
+        if not running:
+            self._shed("no_replicas", "no running replica in the fleet")
+        # validate against the (shared) replica config before creating
+        # anything — a never-fits request fails fast like engine.submit
+        template = running[0].engine.scheduler
+        prompt = template.validate_request(prompt, max_new_tokens)
+        deadline = self.default_deadline_ms if deadline_ms is None \
+            else float(deadline_ms or 0.0)
+
+        req = ServeRequest(prompt, max_new_tokens, greedy=greedy,
+                           temperature=temperature,
+                           eos_token_id=eos_token_id, on_token=on_token,
+                           deadline_ms=deadline)
+        target = self._pick(running)
+        if target is None:
+            # every replica saturated: park (bounded) or shed — the
+            # bound/deadline checks and the append are ONE locked
+            # section, so concurrent submits can never overshoot the
+            # configured bound (spans/journal open only after the
+            # request is actually admitted, so a shed leaves no trace
+            # state behind)
+            with self._lock:
+                depth = len(self._queue)
+                if depth >= self.queue_bound:
+                    self._shed(
+                        "queue_full",
+                        f"global queue at bound {self.queue_bound}",
+                        depth=depth)
+                eff_deadline = deadline or self.shed_deadline_ms
+                est = self._estimated_wait_ms(depth, len(running))
+                if eff_deadline > 0 and est > eff_deadline:
+                    self._shed(
+                        "deadline",
+                        f"estimated queue wait {est:.0f} ms exceeds "
+                        f"the request deadline {eff_deadline:g} ms",
+                        depth=depth)
+                self._queue.append(req)
+                req._parked_ts = time.perf_counter()
+            self._admitted(req)
+            self._note_parked(req)
+            return req
+        self._admitted(req)
+        if not self._dispatch(req, target, "submit"):
+            # the dispatch edge faulted AFTER this request passed
+            # admission (a target existed) — the never-drop rule wins
+            # over the bound, so this park is deliberately bound-exempt
+            self._park(req)
+        return req
+
+    def _admitted(self, req: ServeRequest) -> None:
+        """Open the request's spans and journal its submission — only
+        once it is actually IN the fleet (dispatched or parked)."""
+        self._trace_submit(req)
+        if _tele.enabled():
+            _tele.event("request", request_id=req.id, phase="submitted",
+                        fleet=True)
+
+    def _shed(self, reason: str, detail: str,
+              depth: Optional[int] = None) -> None:
+        if depth is None:
+            with self._lock:
+                depth = len(self._queue)
+        # NOTE: callers already holding self._lock MUST pass depth
+        running = len(self._running())
+        hint = max(50.0, self._estimated_wait_ms(depth, running) or
+                   self._wait_ema_ms or 250.0)
+        self.sheds += 1
+        if _tele.enabled():
+            _tele.counter(
+                "serve_shed_total",
+                "Requests rejected by fleet admission control",
+                labelnames=("reason",)).inc(reason=reason)
+            _tele.event("shed", reason=reason,
+                        retry_after_ms=round(hint, 1), detail=detail)
+        if _trace.enabled():
+            now = time.perf_counter()
+            _trace.get_tracer("serve").record_span(
+                "serve.shed", now, now, track="serve router",
+                reason=reason, retry_after_ms=round(hint, 1))
+        raise ShedError(reason, hint, detail)
+
+    def _estimated_wait_ms(self, queue_len: int, running: int) -> float:
+        """Expected parked wait for the NEXT arrival: the observed
+        per-request dispatch cadence (EMA) scaled by the queue ahead of
+        it.  Zero until the first dispatch is observed — the router never
+        deadline-sheds on no data."""
+        if self._wait_ema_ms <= 0.0:
+            return 0.0
+        return self._wait_ema_ms * (queue_len + 1) / max(1, running)
+
+    # ------------------------------------------------------------------
+    # dispatch mechanics
+    # ------------------------------------------------------------------
+    def _dispatch(self, req: ServeRequest, rep, source: str,
+                  front: bool = False) -> bool:
+        """Hand one request to one replica; False when the dispatch edge
+        faulted (caller parks the request — never dropped)."""
+        t0 = time.perf_counter()
+        try:
+            try:
+                fault_point("router_dispatch")
+            except BaseException as exc:  # noqa: B036 — ANY armed
+                # action (builtin exceptions, FaultExit) IS the injected
+                # dispatch-edge fault; none may escape and strand the
+                # redispatch loop
+                raise _DispatchFault(exc) from exc
+            rep.engine.scheduler.enqueue(req, front=front)
+        except (_DispatchFault, MXNetError) as exc:
+            # injected chaos or the replica flipped to draining/retired
+            # between selection and enqueue: the request survives — the
+            # caller parks it and a later feed() delivers it
+            cause = exc.args[0] if isinstance(exc, _DispatchFault) \
+                else exc
+            if _tele.enabled():
+                _tele.event("request", request_id=req.id,
+                            phase="dispatch_failed", replica=rep.name,
+                            error=f"{type(cause).__name__}: {cause}")
+            return False
+        self.routed += 1
+        if _tele.enabled():
+            _tele.counter("serve_requests_routed_total",
+                          "Requests dispatched to a replica",
+                          labelnames=("replica",)).inc(replica=rep.name)
+            _tele.event("request", request_id=req.id, phase="routed",
+                        replica=rep.name, source=source,
+                        failovers=req.failovers)
+        if _trace.enabled():
+            kw = {"parent": req._span.context(),
+                  "track": f"serve req {req.id}"} \
+                if req._span is not None else {"track": "serve router"}
+            _trace.get_tracer("serve").record_span(
+                "serve.route", t0, time.perf_counter(),
+                request_id=req.id, replica=rep.name, source=source,
+                failover=source == "failover", **kw)
+        rep.notify()
+        return True
+
+    def _park(self, req: ServeRequest, front: bool = False) -> None:
+        with self._lock:
+            if front:
+                self._queue.appendleft(req)
+            else:
+                self._queue.append(req)
+        req._parked_ts = time.perf_counter()
+        self._note_parked(req)
+
+    def _note_parked(self, req: ServeRequest) -> None:
+        if _tele.enabled():
+            _tele.event("request", request_id=req.id, phase="parked",
+                        queued=self.queue_depth)
+        self._update_gauge()
+        # liveness re-check: the last accepting replica may have died
+        # BETWEEN our replica snapshot and this park — its death sweep
+        # already ran fail_all_parked over an empty queue, so nothing
+        # would ever terminate this request
+        if not self._running():
+            self.fail_all_parked("no accepting replica in the fleet")
+
+    def redispatch(self, reqs: List[ServeRequest], source: str,
+                   reason: str) -> int:
+        """Failover / drain path: re-dispatch already-admitted requests.
+        NEVER sheds — headroom bounds are ignored (this work was already
+        accepted; the global queue absorbs any overflow unbounded).
+        Requests with generated tokens jump their target's local queue
+        (the eviction re-admission rule).  Returns how many were
+        dispatched immediately (the rest are parked)."""
+        dispatched = 0
+        park_front: List[ServeRequest] = []
+        for req in reqs:
+            if req.done():
+                continue          # terminated while being salvaged
+            if not self._running():
+                # total fleet loss: nothing will ever serve this —
+                # unblock the waiter with a loud error instead of
+                # parking it forever
+                terminate_request(
+                    req, f"no surviving replica after {reason} from "
+                         f"{source}",
+                    state="failed", phase="failover_failed",
+                    generated=len(req.tokens))
+                continue
+            req.failovers += reason == "failover"
+            _open_queue_span(req, reason)
+            if _tele.enabled() and reason == "failover":
+                _tele.counter(
+                    "serve_failover_requests_total",
+                    "Requests moved between replicas by failover",
+                    labelnames=("direction", "replica")).inc(
+                        direction="out", replica=source)
+            target = self._pick(self._running(), headroom=False)
+            if target is not None and self._dispatch(
+                    req, target, source="failover"
+                    if reason == "failover" else reason,
+                    front=bool(req.tokens)):
+                dispatched += 1
+                if _tele.enabled() and reason == "failover":
+                    _tele.counter(
+                        "serve_failover_requests_total",
+                        "Requests moved between replicas by failover",
+                        labelnames=("direction", "replica")).inc(
+                            direction="in", replica=target.name)
+            else:
+                # no target right now (or the dispatch edge faulted):
+                # this is the oldest work, destined for the queue FRONT
+                park_front.append(req)
+        # front-park in REVERSE so the parked block preserves salvage
+        # order (oldest first) instead of inverting it
+        for req in reversed(park_front):
+            self._park(req, front=True)
+        self._update_gauge()
+        return dispatched
+
+    # ------------------------------------------------------------------
+    # pull path (replica drivers) + parked-queue hygiene
+    # ------------------------------------------------------------------
+    def feed(self, rep) -> bool:
+        """Move parked requests onto `rep` while it has headroom — the
+        driver-side pull that keeps the fleet self-balancing.  Parked
+        requests past their deadline are expired here (and in
+        `sweep_expired`) — exactly once, pages-free by construction
+        (parked requests never hold pages)."""
+        if rep.state != "running":
+            return False
+        moved = False
+        sched = rep.engine.scheduler
+        while sched.queue_depth < rep.engine.serve_config.max_slots:
+            with self._lock:
+                if not self._queue:
+                    break
+                req = self._queue.popleft()
+            if self._expire_if_due(req):
+                continue
+            waited_ms = (time.perf_counter()
+                         - getattr(req, "_parked_ts",
+                                   req.submitted_ts)) * 1e3
+            if not self._dispatch(req, rep, "feed",
+                                  front=bool(req.tokens)):
+                self._park(req, front=True)
+                break
+            # the wait estimator learns from every successful unpark
+            self._wait_ema_ms = waited_ms if self._wait_ema_ms == 0.0 \
+                else 0.7 * self._wait_ema_ms + 0.3 * waited_ms
+            moved = True
+        if moved:
+            self._update_gauge()
+        return moved
+
+    def sweep_expired(self) -> int:
+        """Expire every parked request past its deadline (supervisor
+        sweep — runs even when every driver is too busy to `feed`)."""
+        with self._lock:
+            parked = list(self._queue)
+        expired = [r for r in parked if r.deadline_due()]
+        if not expired:
+            return 0
+        gone = {id(r) for r in expired}
+        with self._lock:
+            self._queue = deque(r for r in self._queue
+                                if id(r) not in gone)
+        for req in expired:
+            expire_request(req, "router", detail="parked at the router")
+        self._update_gauge()
+        return len(expired)
+
+    def _expire_if_due(self, req: ServeRequest) -> bool:
+        if not req.deadline_due():
+            return False
+        expire_request(req, "router", detail="parked at the router")
+        return True
+
+    def fail_all_parked(self, err: str) -> int:
+        """Terminal sweep when NO replica can ever accept work again
+        (total fleet loss / full drain): unblock every parked waiter
+        with `err` instead of leaving them parked forever."""
+        with self._lock:
+            parked, self._queue = list(self._queue), deque()
+        for req in parked:
+            terminate_request(req, err, state="failed",
+                              phase="failover_failed",
+                              generated=len(req.tokens))
+        self._update_gauge()
+        return len(parked)
+
+    # ------------------------------------------------------------------
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def _update_gauge(self) -> None:
+        if _tele.enabled():
+            _tele.gauge("serve_router_queue_depth",
+                        "Requests parked in the fleet's global queue"
+                        ).set(self.queue_depth)
+
+    def _trace_submit(self, req: ServeRequest) -> None:
+        if not _trace.enabled():
+            return
+        tr = _trace.get_tracer("serve")
+        track = f"serve req {req.id}"
+        req._span = tr.start_span(
+            "serve.request", track=track, request_id=req.id,
+            prompt_tokens=len(req.prompt),
+            max_new_tokens=req.max_new_tokens, fleet=True)
+        req._queue_span = tr.start_span(
+            "serve.queue", parent=req._span.context(), track=track,
+            request_id=req.id)
+
+    def stats(self) -> dict:
+        return {"queue_depth": self.queue_depth,
+                "queue_bound": self.queue_bound,
+                "routed": self.routed, "sheds": self.sheds,
+                "wait_ema_ms": round(self._wait_ema_ms, 3)}

@@ -22,6 +22,17 @@ Everything host-side here is plain Python bookkeeping (lists, a free-list
 allocator); the device work happens in the engine's compiled step.
 Telemetry (`serve_*` metrics + `request` journal events) is emitted at
 every lifecycle edge — this subsystem is instrumented from day one.
+
+**Fleet mode** (`mx.serve.ServeFleet`, docs/serving.md "Fleet, failover &
+overload"): when this scheduler is one replica of a supervised fleet it
+carries a ``name``, runs with ``salvage_on_error=True`` (a failed device
+step hands the in-flight requests back to the fleet instead of failing
+them — the whole replica retires, pool and all), and its in-flight set
+can be :meth:`salvage`\\ d by the supervisor after a death or stall.  A
+salvaged/evicted/failed-over request always resumes by re-prefilling
+``prompt + generated`` on the next scheduler — the ONE recovery rule
+shared by eviction and failover, which is why greedy streams survive a
+replica death bit-identical and never re-emit a token.
 """
 from __future__ import annotations
 
@@ -34,10 +45,12 @@ from typing import Callable, List, Optional
 import numpy as onp
 
 from ..base import MXNetError
+from ..resilience import fault_point
 from .. import telemetry as _tele
 from .. import tracing as _trace
 
-__all__ = ["ServeRequest", "ContinuousBatchingScheduler"]
+__all__ = ["ServeRequest", "ContinuousBatchingScheduler",
+           "terminate_request"]
 
 _rid = itertools.count(1)
 
@@ -65,6 +78,13 @@ class ServeRequest:
         self.tokens: List[int] = []          # generated so far (streamed)
         self.state = "queued"                # queued|running|finished|failed
         self.evictions = 0
+        self.failovers = 0                   # replica deaths survived
+        # ownership epoch: salvage() bumps it when the request moves to
+        # another replica, so a wedged old driver's late emit is ignored
+        self._epoch = 0
+        # serializes terminal transitions across threads (a dying
+        # replica's sweep vs the router's deadline sweep)
+        self._terminate_lock = threading.Lock()
         self.submitted_ts = time.perf_counter()
         self.first_token_ts: Optional[float] = None
         self.finished_ts: Optional[float] = None
@@ -91,6 +111,14 @@ class ServeRequest:
     def done(self) -> bool:
         return self._done.is_set()
 
+    def deadline_due(self, now: Optional[float] = None) -> bool:
+        """True when this request's wall-clock budget has lapsed (the
+        ONE deadline predicate — scheduler and router both use it)."""
+        if self.deadline_ms <= 0:
+            return False
+        now = time.perf_counter() if now is None else now
+        return (now - self.submitted_ts) * 1e3 > self.deadline_ms
+
     def result(self, timeout: Optional[float] = None) -> List[int]:
         if not self._done.wait(timeout):
             raise TimeoutError(f"request {self.id} not finished")
@@ -109,6 +137,83 @@ class ServeRequest:
                 f"{len(self.tokens)}/{self.max_new_tokens})")
 
 
+def _close_request_spans(req: ServeRequest, state: str, **tags) -> None:
+    """Finish a request's open tracing spans (queue phase + root)."""
+    if req._queue_span is not None:
+        req._queue_span.finish(state=state)
+        req._queue_span = None
+    if req._span is not None:
+        req._span.finish(state=state, generated=len(req.tokens),
+                         evictions=req.evictions, **tags)
+        req._span = None
+
+
+def _open_queue_span(req: ServeRequest, reason: str) -> None:
+    """(Re-)open a request's "serve.queue" span — eviction re-queue and
+    failover re-dispatch park the request again; its timeline should show
+    the second (third, ...) wait.  No-op when one is already open."""
+    if req._span is not None and req._queue_span is None:
+        req._queue_span = _trace.get_tracer("serve").start_span(
+            "serve.queue", parent=req._span.context(),
+            track=f"serve req {req.id}", request_id=req.id,
+            evicted=True, reason=reason)
+
+
+def terminate_request(req: ServeRequest, err: str, *, state: str = "failed",
+                      phase: str = "failed", replica: Optional[str] = None,
+                      **extras) -> bool:
+    """Shared terminal path for every non-finished outcome — scheduler
+    expiry/failure AND router-side shedding/expiry use this ONE function,
+    so a request can only ever be terminated once: the first caller wins
+    (marks the request failed, counts it under its terminal-state label,
+    journals the phase, closes spans, unblocks the waiter) and every
+    later attempt is a no-op returning False.  The exactly-once guarantee
+    matters in fleet mode, where a dying replica's failure sweep and the
+    router's deadline sweep can race over the same request — the
+    per-request lock makes the check-then-terminate atomic."""
+    with req._terminate_lock:
+        if req._done.is_set():
+            return False
+        req.state = "failed"
+        req.error = err
+        req.finished_ts = time.perf_counter()
+        _close_request_spans(req, state, error=err)
+        if _tele.enabled():
+            _tele.counter("serve_requests_total",
+                          "Requests by terminal state",
+                          labelnames=("state",)).inc(state=state)
+            fields = dict(extras)
+            if replica is not None:
+                fields.setdefault("replica", replica)
+            _tele.event("request", request_id=req.id, phase=phase,
+                        **fields)
+        req._done.set()
+    return True
+
+
+def expire_request(req: ServeRequest, where: str,
+                   replica: Optional[str] = None,
+                   detail: Optional[str] = None) -> bool:
+    """The ONE deadline-expiry terminal: counter + terminate, shared by
+    the scheduler (queued/active) and the router (parked) so the two
+    tiers can never disagree on what expiry means.  `where` is the
+    counter label (queued/active/router); `detail` overrides it in the
+    human-facing error.  The counter only moves when this call actually
+    won the terminate race."""
+    won = terminate_request(
+        req, f"deadline exceeded ({req.deadline_ms:g} ms) while "
+             f"{detail or where}",
+        state="expired", phase="deadline_expired", where=where,
+        replica=replica, generated=len(req.tokens),
+        deadline_ms=req.deadline_ms)
+    if won and _tele.enabled():
+        _tele.counter(
+            "serve_deadline_expired_total",
+            "Requests expired past their per-request deadline",
+            labelnames=("where",)).inc(where=where)
+    return won
+
+
 class _Slot:
     """One occupied batch slot: the request plus its KV page table."""
 
@@ -120,6 +225,10 @@ class _Slot:
         self.table = onp.zeros(max_pages, onp.int32)   # NULL_PAGE fill
         self.ctx = 0          # tokens already written to the pool
         self.admit_seq = admit_seq    # admission order (eviction priority)
+        # ownership epoch at admission: salvage() bumps the request's
+        # epoch when it moves to another replica, so this slot's emits
+        # become no-ops if its driver was wedged past the salvage
+        self.epoch = req._epoch
 
 
 class ContinuousBatchingScheduler:
@@ -145,12 +254,30 @@ class ContinuousBatchingScheduler:
         self._lock = threading.Lock()
         self._admit_seq = itertools.count()
         self._steps = 0
+        #: replica identity in a fleet (None outside one): tags request
+        #: journal events, step spans, and the per-replica gauges
+        self.name: Optional[str] = None
+        #: fleet mode: a failed device step leaves the in-flight requests
+        #: untouched for `salvage()` instead of failing them terminally
+        self.salvage_on_error = False
+        #: drain mode: submit/enqueue refuse new work; evicted actives
+        #: still re-admit so every active stream runs to completion
+        self.draining = False
+        # set once by `salvage()` — this scheduler (and its replica) is
+        # retired; a driver thread mid-step discards its results
+        self._abandoned = False
+        # serializes the host-side halves of step() against a
+        # supervisor-thread salvage(); deliberately NOT held across the
+        # device call, so salvaging a replica stuck in `_execute` never
+        # blocks on the stuck step
+        self._step_lock = threading.Lock()
 
     # ------------------------------------------------------------------
-    def submit(self, prompt, max_new_tokens: int = 20, greedy: bool = True,
-               temperature: float = 1.0, eos_token_id=None,
-               on_token=None, deadline_ms: Optional[float] = None
-               ) -> ServeRequest:
+    def validate_request(self, prompt, max_new_tokens: int) -> List[int]:
+        """Normalize + validate a prompt against this scheduler's caps
+        (context length, whole-pool fit).  Raises for a request that could
+        NEVER be served — shared by `submit` and the fleet router's
+        admission check.  Returns the normalized token list."""
         prompt = [int(t) for t in onp.asarray(prompt).reshape(-1)]
         if not prompt:
             raise MXNetError("empty prompt")
@@ -168,6 +295,13 @@ class ContinuousBatchingScheduler:
             raise MXNetError(
                 f"request needs {need} KV pages but the pool only has "
                 f"{self.allocator.total_pages} — raise MXTPU_SERVE_PAGES")
+        return prompt
+
+    def submit(self, prompt, max_new_tokens: int = 20, greedy: bool = True,
+               temperature: float = 1.0, eos_token_id=None,
+               on_token=None, deadline_ms: Optional[float] = None
+               ) -> ServeRequest:
+        prompt = self.validate_request(prompt, max_new_tokens)
         req = ServeRequest(prompt, max_new_tokens, greedy=greedy,
                            temperature=temperature,
                            eos_token_id=eos_token_id, on_token=on_token,
@@ -175,11 +309,40 @@ class ContinuousBatchingScheduler:
                                         if deadline_ms is None
                                         else deadline_ms))
         self._trace_submit(req)
-        with self._lock:
-            self._queue.append(req)
+        try:
+            self.enqueue(req)
+        except MXNetError:
+            # draining/retired: close the just-opened spans — a refused
+            # request must not leave a dangling open track in the trace
+            _close_request_spans(req, "rejected")
+            raise
         self._telemetry_request(req, "submitted", queued=len(self._queue))
         self._update_gauges()
         return req
+
+    def enqueue(self, req: ServeRequest, front: bool = False) -> None:
+        """Admit an EXISTING request into this scheduler's queue — the
+        router's dispatch path, failover re-dispatch, and drain hand-back
+        all land here.  A request that already generated tokens re-enters
+        exactly like an evicted one: `_sequence()` folds them into the
+        prefix the next prefill recomputes, so greedy streams continue
+        bit-identical and never re-emit."""
+        req.state = "queued"
+        with self._lock:
+            # flag check and append are ONE atomic section: salvage()
+            # and drain's detach_queued() set their flag BEFORE draining
+            # the queue under this same lock, so an enqueue that lands
+            # after the drain must see the flag and raise — a request
+            # can never slip into a retired scheduler's queue and strand
+            if self.draining or self._abandoned:
+                raise MXNetError(
+                    f"replica {self.name or '<unnamed>'} is "
+                    f"{'draining' if self.draining else 'retired'} and "
+                    f"not accepting requests")
+            if front:
+                self._queue.appendleft(req)
+            else:
+                self._queue.append(req)
 
     # -- request-lifecycle spans (mx.tracing) --------------------------
     # Every request gets a root "serve.request" span on its own track
@@ -212,21 +375,11 @@ class ContinuousBatchingScheduler:
             req._queue_span = None
 
     def _trace_requeue(self, req: ServeRequest, reason: str) -> None:
-        if req._span is not None:
-            req._queue_span = _trace.get_tracer("serve").start_span(
-                "serve.queue", parent=req._span.context(),
-                track=f"serve req {req.id}", request_id=req.id,
-                evicted=True, reason=reason)
+        _open_queue_span(req, reason)
 
     def _trace_close(self, req: ServeRequest, state: str,
                      **tags) -> None:
-        if req._queue_span is not None:
-            req._queue_span.finish(state=state)
-            req._queue_span = None
-        if req._span is not None:
-            req._span.finish(state=state, generated=len(req.tokens),
-                             evictions=req.evictions, **tags)
-            req._span = None
+        _close_request_spans(req, state, **tags)
 
     # ------------------------------------------------------------------
     def _free_slot_idx(self) -> Optional[int]:
@@ -316,8 +469,7 @@ class ContinuousBatchingScheduler:
         now = time.perf_counter()
 
         def _expired(req):
-            return req.deadline_ms > 0 and \
-                (now - req.submitted_ts) * 1e3 > req.deadline_ms
+            return req.deadline_due(now)
 
         with self._lock:
             dead = [r for r in self._queue if _expired(r)]
@@ -337,113 +489,131 @@ class ContinuousBatchingScheduler:
             self._update_gauges()
 
     def _expire_req(self, req: ServeRequest, where: str) -> None:
-        if _tele.enabled():
-            _tele.counter(
-                "serve_deadline_expired_total",
-                "Requests expired past their per-request deadline",
-                labelnames=("where",)).inc(where=where)
-        self._terminate_req(
-            req, f"deadline exceeded ({req.deadline_ms:g} ms) "
-                 f"while {where}",
-            state="expired", phase="deadline_expired", where=where,
-            generated=len(req.tokens), deadline_ms=req.deadline_ms)
+        expire_request(req, where, replica=self.name)
 
     # ------------------------------------------------------------------
     def step(self) -> bool:
         """Run one fused serving step over the active slots.  Returns
-        False when there was nothing to do (no actives, empty queue)."""
-        self._expire_deadlines()
-        self._admit()
-        actives = [s for s in self._slots if s is not None]
-        if not actives:
-            self._update_gauges()
-            return False
+        False when there was nothing to do (no actives, empty queue).
 
-        # plan the chunk width: any slot with >1 pending token prefills,
-        # so the step runs at the prefill chunk width; a pure-decode
-        # round runs the C=1 program (no padded-lane compute)
-        pending = {s.slot_idx: len(s.req._sequence()) - s.ctx
-                   for s in actives}
-        C = self.prefill_chunk if any(p > 1 for p in pending.values()) \
-            else 1
+        The host-side halves (plan/admit before, emit after) hold
+        ``_step_lock``; the device call runs outside it so a fleet
+        supervisor can `salvage()` a replica whose step has wedged."""
+        with self._step_lock:
+            if self._abandoned:
+                return False
+            self._expire_deadlines()
+            self._admit()
+            actives = [s for s in self._slots if s is not None]
+            if not actives:
+                self._update_gauges()
+                return False
 
-        # capacity: every slot must hold its chunk's tokens; slots that
-        # cannot (even after evicting younger actives) are evicted
-        # themselves this round
-        for s in sorted(actives, key=lambda s: s.admit_seq):
-            if self._slots[s.slot_idx] is not s:
-                continue          # already evicted by a victim search
-            nt = min(pending[s.slot_idx], C)
-            if not self._ensure_capacity(s, s.ctx + nt):
-                self._evict(s, reason="no_capacity")
-        actives = [s for s in self._slots if s is not None]
-        if not actives:
-            self._update_gauges()
-            return False
+            # plan the chunk width: any slot with >1 pending token
+            # prefills, so the step runs at the prefill chunk width; a
+            # pure-decode round runs the C=1 program (no padded-lane
+            # compute)
+            pending = {s.slot_idx: len(s.req._sequence()) - s.ctx
+                       for s in actives}
+            C = self.prefill_chunk \
+                if any(p > 1 for p in pending.values()) else 1
 
-        B = self.max_slots
-        tok = onp.zeros((B, C), onp.int32)
-        num_tokens = onp.zeros(B, onp.int32)
-        start_pos = onp.zeros(B, onp.int32)
-        tables = onp.zeros((B, self.max_pages_per_seq), onp.int32)
-        ctx_lens = onp.zeros(B, onp.int32)
-        temps = onp.ones(B, onp.float32)
-        greedy = onp.ones(B, bool)
-        consume = {}
-        for s in actives:
-            seq = s.req._sequence()
-            feed = seq[s.ctx:s.ctx + C]
-            nt = len(feed)
-            i = s.slot_idx
-            tok[i, :nt] = feed
-            num_tokens[i] = nt
-            start_pos[i] = s.ctx
-            tables[i] = s.table
-            ctx_lens[i] = s.ctx + nt
-            temps[i] = s.req.temperature
-            greedy[i] = s.req.greedy
-            consume[i] = (s.ctx + nt == len(seq))
-            s.ctx += nt
+            # capacity: every slot must hold its chunk's tokens; slots
+            # that cannot (even after evicting younger actives) are
+            # evicted themselves this round
+            for s in sorted(actives, key=lambda s: s.admit_seq):
+                if self._slots[s.slot_idx] is not s:
+                    continue      # already evicted by a victim search
+                nt = min(pending[s.slot_idx], C)
+                if not self._ensure_capacity(s, s.ctx + nt):
+                    self._evict(s, reason="no_capacity")
+            actives = [s for s in self._slots if s is not None]
+            if not actives:
+                self._update_gauges()
+                return False
+
+            B = self.max_slots
+            tok = onp.zeros((B, C), onp.int32)
+            num_tokens = onp.zeros(B, onp.int32)
+            start_pos = onp.zeros(B, onp.int32)
+            tables = onp.zeros((B, self.max_pages_per_seq), onp.int32)
+            ctx_lens = onp.zeros(B, onp.int32)
+            temps = onp.ones(B, onp.float32)
+            greedy = onp.ones(B, bool)
+            consume = {}
+            for s in actives:
+                seq = s.req._sequence()
+                feed = seq[s.ctx:s.ctx + C]
+                nt = len(feed)
+                i = s.slot_idx
+                tok[i, :nt] = feed
+                num_tokens[i] = nt
+                start_pos[i] = s.ctx
+                tables[i] = s.table
+                ctx_lens[i] = s.ctx + nt
+                temps[i] = s.req.temperature
+                greedy[i] = s.req.greedy
+                consume[i] = (s.ctx + nt == len(seq))
+                s.ctx += nt
 
         t0 = time.perf_counter()
         try:
+            # chaos point (docs/resilience.md): MXTPU_FAULT_SPEC
+            # `replica_step` simulates a replica dying mid-step on live
+            # traffic — slot.ctx has already advanced past tokens that
+            # will never land, the hardest failover shape
+            fault_point("replica_step")
             next_tokens = self.engine._execute(
                 tok, num_tokens, start_pos, tables, ctx_lens, temps,
                 greedy, C)
         except Exception as exc:
-            # a failed device step is unrecoverable for every in-flight
-            # sequence: slot.ctx already advanced past tokens that never
-            # landed and the donated pool buffers may be invalidated —
-            # fail ALL requests (waiters in result() unblock with the
-            # error) instead of leaving them stuck forever, then re-raise
-            self._fail_all(exc)
+            with self._step_lock:
+                if self._abandoned:
+                    return False
+                if not self.salvage_on_error:
+                    # single-engine mode: a failed device step is
+                    # unrecoverable for every in-flight sequence (the
+                    # donated pool buffers may be invalidated) — fail ALL
+                    # requests (waiters in result() unblock with the
+                    # error) instead of leaving them stuck forever
+                    self._fail_all(exc)
+                # fleet mode (salvage_on_error): leave every request
+                # untouched — the driver catches this raise and the fleet
+                # salvages them onto a surviving replica
             raise
         t1 = time.perf_counter()
-        step_ms = (t1 - t0) * 1e3
-        self._steps += 1
-        if _trace.enabled():
-            self._trace_step(actives, consume, num_tokens, ctx_lens,
-                             t0, t1, C)
-        from .. import health as _health
-        _health.beat("serve.step")
-        if _tele.enabled():
-            _tele.histogram(
-                "serve_step_ms",
-                "Wall time per fused serving step (prefill or decode)"
-            ).observe(step_ms)
-            _tele.counter("serve_steps_total",
-                          "Fused serving steps executed").inc()
-            # FLOP attribution: this width's executable cost + measured
-            # wall -> mfu_estimate{program="serve_step"} et al.
-            _trace.note_step_cost(
-                f"serve_step_c{C}@{id(self.engine):x}", step_ms / 1e3)
+        with self._step_lock:
+            if self._abandoned:
+                # salvaged mid-execute: the requests now live on another
+                # replica — emitting here would double-stream tokens
+                return False
+            step_ms = (t1 - t0) * 1e3
+            self._steps += 1
+            if _trace.enabled():
+                self._trace_step(actives, consume, num_tokens, ctx_lens,
+                                 t0, t1, C)
+            from .. import health as _health
+            _health.beat("serve.step")
+            if _tele.enabled():
+                _tele.histogram(
+                    "serve_step_ms",
+                    "Wall time per fused serving step (prefill or decode)"
+                ).observe(step_ms)
+                _tele.counter("serve_steps_total",
+                              "Fused serving steps executed").inc()
+                # FLOP attribution: this width's executable cost +
+                # measured wall -> mfu_estimate{program="serve_step"}
+                _trace.note_step_cost(
+                    f"serve_step_c{C}@{id(self.engine):x}", step_ms / 1e3)
 
-        # distribute tokens in admission order (stable streaming order)
-        for s in sorted(actives, key=lambda s: s.admit_seq):
-            if not consume[s.slot_idx]:
-                continue          # mid-prefill: logits discarded
-            self._emit(s, int(next_tokens[s.slot_idx]))
-        self._update_gauges()
+            # distribute tokens in admission order (stable streaming)
+            for s in sorted(actives, key=lambda s: s.admit_seq):
+                if not consume[s.slot_idx]:
+                    continue      # mid-prefill: logits discarded
+                if self._slots[s.slot_idx] is not s:
+                    continue      # expired/terminated while executing
+                self._emit(s, int(next_tokens[s.slot_idx]))
+            self._update_gauges()
         return True
 
     def _trace_step(self, actives, consume, num_tokens, ctx_lens,
@@ -453,8 +623,12 @@ class ContinuousBatchingScheduler:
         share the device step's wall window — the spans decompose each
         request's OWN timeline, not the device's)."""
         tr = _trace.get_tracer("serve")
-        tr.record_span("serve.step", t0, t1, track="serve steps",
-                       step=self._steps, chunk=C, active=len(actives))
+        rep = {} if self.name is None else {"replica": self.name}
+        track = "serve steps" if self.name is None \
+            else f"serve steps {self.name}"
+        tr.record_span("serve.step", t0, t1, track=track,
+                       step=self._steps, chunk=C, active=len(actives),
+                       **rep)
         for s in actives:
             req = s.req
             if req._span is None:
@@ -478,11 +652,17 @@ class ContinuousBatchingScheduler:
                 name, t0, t1, parent=req._span.context(),
                 track=f"serve req {req.id}", request_id=req.id,
                 slot=i, pages=len(s.pages), ctx=int(ctx_lens[i]),
-                tokens_fed=nt,
+                tokens_fed=nt, **rep,
                 **({"first_token": True} if first else {}))
 
     def _emit(self, slot: _Slot, token: int) -> None:
         req = slot.req
+        if self._abandoned or req._epoch != slot.epoch:
+            # this scheduler was retired (or the request was salvaged
+            # onto another replica) while the step was in flight —
+            # emitting now would double-stream tokens the survivor is
+            # regenerating
+            return
         req.tokens.append(token)
         if req.first_token_ts is None:
             req.first_token_ts = time.perf_counter()
@@ -536,41 +716,90 @@ class ContinuousBatchingScheduler:
 
     def _terminate_req(self, req: ServeRequest, err: str, *, state: str,
                        phase: str, **extras) -> None:
-        """Shared terminal path for every non-finished outcome: mark the
-        request failed, count it under its terminal-state label, journal
-        the phase, and unblock the waiter."""
-        req.state = "failed"
-        req.error = err
-        req.finished_ts = time.perf_counter()
-        self._trace_close(req, state, error=err)
-        if _tele.enabled():
-            _tele.counter("serve_requests_total",
-                          "Requests by terminal state",
-                          labelnames=("state",)).inc(state=state)
-        self._telemetry_request(req, phase, **extras)
-        req._done.set()
+        terminate_request(req, err, state=state, phase=phase,
+                          replica=self.name, **extras)
+
+    # ------------------------------------------------------------------
+    # fleet hooks (mx.serve.ServeFleet — docs/serving.md)
+    # ------------------------------------------------------------------
+    def detach_queued(self) -> List[ServeRequest]:
+        """Remove and return every QUEUED request (none hold pages) —
+        the drain path hands them back to the router for re-dispatch
+        while this replica's actives run to completion."""
+        with self._lock:
+            out = list(self._queue)
+            self._queue.clear()
+        self._update_gauges()
+        return out
+
+    def salvage(self, lock_timeout: float = 5.0) -> List[ServeRequest]:
+        """Retire this scheduler (replica death/stall) and collect every
+        in-flight request WITHOUT terminating them: actives in admission
+        order first (they hold streaming progress), then the queue.  KV
+        pages are deliberately NOT freed — the whole replica (pool,
+        allocator, executor) is being discarded, and a wedged driver
+        thread may still hold internal references.
+
+        Safe to call from the supervisor thread while the driver is
+        stuck inside the device call: `_abandoned` is set under
+        ``_step_lock`` (released around `_execute`), so the stuck step
+        discards its results on wake instead of double-streaming."""
+        got_lock = self._step_lock.acquire(timeout=lock_timeout)
+        if not got_lock:
+            # the replica wedged in HOST code (e.g. an on_token
+            # callback) — proceed anyway: the epoch bump below turns the
+            # wedged driver's remaining emits into no-ops, so the
+            # survivor owns the request's stream exclusively
+            import logging
+            logging.getLogger(__name__).error(
+                "salvage: replica %s step lock not released in %.1fs; "
+                "salvaging without it", self.name, lock_timeout)
+        try:
+            self._abandoned = True
+            actives = [s for s in self._slots if s is not None]
+            actives.sort(key=lambda s: s.admit_seq)
+            for s in actives:
+                self._slots[s.slot_idx] = None
+            with self._lock:
+                queued = list(self._queue)
+                self._queue.clear()
+            reqs = [s.req for s in actives] + queued
+            for r in reqs:
+                # transfer stream ownership: any emit this replica still
+                # has in flight for an old-epoch slot is discarded
+                r._epoch += 1
+                r.state = "queued"
+            return reqs
+        finally:
+            if got_lock:
+                self._step_lock.release()
 
     def _finish(self, slot: _Slot) -> None:
         req = slot.req
         self._release_slot(slot)
-        req.state = "finished"
-        req.finished_ts = time.perf_counter()
-        self._trace_close(
-            req, "finished",
-            ttft_ms=(round(req.ttft_s * 1e3, 3)
-                     if req.ttft_s is not None else None))
-        if _tele.enabled():
-            _tele.counter("serve_requests_total",
-                          "Requests by terminal state",
-                          labelnames=("state",)).inc(state="finished")
-            _tele.histogram(
-                "serve_request_latency_ms",
-                "End-to-end request latency (submit -> last token)"
-            ).observe(req.latency_s * 1e3)
-        self._telemetry_request(req, "finished",
-                                generated=len(req.tokens),
-                                latency_ms=round(req.latency_s * 1e3, 3))
-        req._done.set()
+        if self._abandoned or req._epoch != slot.epoch:
+            return          # salvaged mid-step: the survivor finishes it
+        with req._terminate_lock:
+            if req._done.is_set():
+                return      # already terminated by a concurrent sweep
+            req.state = "finished"
+            req.finished_ts = time.perf_counter()
+            self._trace_close(
+                req, "finished",
+                ttft_ms=(round(req.ttft_s * 1e3, 3)
+                         if req.ttft_s is not None else None))
+            if _tele.enabled():
+                _tele.counter("serve_requests_total",
+                              "Requests by terminal state",
+                              labelnames=("state",)).inc(state="finished")
+                _tele.histogram(
+                    "serve_request_latency_ms",
+                    "End-to-end request latency (submit -> last token)"
+                ).observe(req.latency_s * 1e3)
+            self._telemetry_request(
+                req, "finished", generated=len(req.tokens),
+                latency_ms=round(req.latency_s * 1e3, 3))
+            req._done.set()
 
     # ------------------------------------------------------------------
     def run_until_idle(self, max_steps: int = 100000) -> int:
@@ -597,6 +826,23 @@ class ContinuousBatchingScheduler:
     def _update_gauges(self) -> None:
         if not _tele.enabled():
             return
+        if self.name is not None:
+            # fleet replica: per-replica labeled series (N schedulers in
+            # one process must not fight over the global gauges; the
+            # fleet supervisor owns the aggregates)
+            _tele.gauge("serve_replica_queue_depth",
+                        "Per-replica requests waiting for a slot/pages",
+                        labelnames=("replica",)).set(
+                            self.queue_depth, replica=self.name)
+            _tele.gauge("serve_replica_active_slots",
+                        "Per-replica slots decoding/prefilling",
+                        labelnames=("replica",)).set(
+                            self.active_count, replica=self.name)
+            _tele.gauge("serve_replica_free_pages",
+                        "Per-replica KV pages on the free list",
+                        labelnames=("replica",)).set(
+                            self.allocator.free_pages, replica=self.name)
+            return
         _tele.gauge("serve_queue_depth",
                     "Requests waiting for a slot/pages").set(
                         self.queue_depth)
@@ -613,5 +859,7 @@ class ContinuousBatchingScheduler:
     def _telemetry_request(self, req: ServeRequest, phase: str,
                            **fields) -> None:
         if _tele.enabled():
+            if self.name is not None:
+                fields.setdefault("replica", self.name)
             _tele.event("request", request_id=req.id, phase=phase,
                         **fields)

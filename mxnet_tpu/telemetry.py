@@ -96,6 +96,15 @@ class _Metric:
                 f"got {tuple(sorted(labels))}")
         return tuple(str(labels[ln]) for ln in self.labelnames)
 
+    def remove(self, **labels) -> bool:
+        """Drop one labeled series (True if it existed).  For gauges
+        describing a RETIRED entity — a dead fleet replica's queue-depth
+        series must not report its last value on /metrics forever.
+        Counters are cumulative history and should normally be kept."""
+        key = self._key(labels)
+        with self._lock:
+            return self._values.pop(key, None) is not None
+
 
 class Counter(_Metric):
     """Monotonically increasing count (events, retries, cache hits)."""

@@ -12,6 +12,10 @@ performance attribution"):
   file in https://ui.perfetto.dev or chrome://tracing).  Instrumentation
   sites live in the serve scheduler (the full request lifecycle:
   queue → admit → prefill chunks → decode steps → stream → finish),
+  the serve fleet/router (``serve.route`` per dispatch,
+  ``serve.failover`` per replica death, ``serve.shed`` per rejection —
+  phase spans carry a ``replica`` tag so `tools/diagnose.py --trace`
+  can roll a fleet up per replica),
   `ShardedTrainStep` (dispatch → compile → device execute → retire,
   correlated with journal step ids), `DevicePrefetcher` /
   `data.DataPipeline`, `CheckpointManager`, and the elastic reform path.

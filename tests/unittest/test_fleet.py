@@ -1,0 +1,463 @@
+"""Serving-fleet tests: router scoring + shedding, replica supervision,
+mid-stream failover bit-identity, graceful drain, salvage semantics, and
+the replica_step / router_dispatch chaos points (docs/serving.md "Fleet,
+failover & overload")."""
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+pytestmark = pytest.mark.serve
+
+
+def _tiny_model(**kw):
+    from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    cfg = dict(vocab_size=96, hidden_size=32, num_layers=1, num_heads=4,
+               intermediate_size=64, max_position=64, dropout=0.0)
+    cfg.update(kw)
+    m = GPTForCausalLM(GPTConfig(**cfg))
+    m.initialize()
+    m(mx.np.array([[1, 2]], dtype="int32"))
+    return m
+
+
+def _ref_generate(m, prompt, n):
+    ids = mx.np.array([prompt], dtype="int32")
+    return onp.asarray(m.generate(ids, max_new_tokens=n)
+                       .asnumpy())[0].tolist()
+
+
+def _fleet(m, n=2, **kw):
+    from mxnet_tpu.serve import ServeConfig, ServeFleet
+    kw.setdefault("config", ServeConfig(max_slots=2, page_size=4,
+                                        num_pages=0, prefill_chunk=4,
+                                        max_len=32))
+    kw.setdefault("stall_timeout", 5.0)
+    return ServeFleet(m, replicas=n, **kw)
+
+
+# ---------------------------------------------------------------------------
+# router: scoring, shedding, parked-deadline expiry
+# ---------------------------------------------------------------------------
+
+class _FakeSched:
+    def __init__(self, queued=0, active=0):
+        self.queue_depth = queued
+        self.active_count = active
+        self.enqueued = []
+
+    def enqueue(self, req, front=False):
+        self.enqueued.append(req)
+        self.queue_depth += 1
+
+    def validate_request(self, prompt, max_new_tokens):
+        return [int(t) for t in prompt]
+
+
+class _FakeAlloc:
+    def __init__(self, free=8, total=8):
+        self.free_pages, self.total_pages = free, total
+
+
+class _FakeEngine:
+    def __init__(self, queued=0, active=0, free=8, slots=2):
+        self.scheduler = _FakeSched(queued, active)
+        self.allocator = _FakeAlloc(free)
+
+        class _SC:
+            max_slots = slots
+        self.serve_config = _SC()
+
+
+class _FakeReplica:
+    def __init__(self, name, state="running", **kw):
+        self.name, self.state = name, state
+        self.engine = _FakeEngine(**kw)
+        self.notified = 0
+
+    def notify(self):
+        self.notified += 1
+
+
+def test_router_picks_least_loaded_replica_page_aware():
+    from mxnet_tpu.serve import RequestRouter
+    idle = _FakeReplica("idle", queued=0, active=0, free=8)
+    busy = _FakeReplica("busy", queued=1, active=2, free=8)
+    starved = _FakeReplica("starved", queued=0, active=0, free=0)
+    r = RequestRouter(lambda: [busy, idle, starved], queue_bound=4)
+    # same backlog as `starved` but with page headroom -> idle wins
+    assert r._pick([busy, idle, starved]) is idle
+    # draining/dead replicas are never considered
+    idle.state = "dead"
+    assert r._pick(r._running()) is starved
+
+
+def test_router_sheds_queue_full_with_retry_hint():
+    from mxnet_tpu.serve import RequestRouter, ShedError
+    # one replica with zero headroom: everything parks, bound 2
+    rep = _FakeReplica("r0", queued=2, active=2, free=0, slots=2)
+    r = RequestRouter(lambda: [rep], queue_bound=2)
+    r.submit([1, 2], max_new_tokens=2)
+    r.submit([3, 4], max_new_tokens=2)
+    assert r.queue_depth == 2
+    with pytest.raises(ShedError) as ei:
+        r.submit([5, 6], max_new_tokens=2)
+    assert ei.value.reason == "queue_full"
+    assert ei.value.retry_after_ms > 0
+    assert r.sheds == 1
+
+
+def test_router_sheds_no_replicas():
+    from mxnet_tpu.serve import RequestRouter, ShedError
+    dead = _FakeReplica("r0", "dead")
+    r = RequestRouter(lambda: [dead], queue_bound=4)
+    with pytest.raises(ShedError) as ei:
+        r.submit([1], max_new_tokens=2)
+    assert ei.value.reason == "no_replicas"
+
+
+def test_router_deadline_shed_uses_wait_estimate():
+    from mxnet_tpu.serve import RequestRouter, ShedError
+    rep = _FakeReplica("r0", queued=2, active=2, free=0, slots=2)
+    r = RequestRouter(lambda: [rep], queue_bound=10)
+    # no observed dispatch cadence yet -> never deadline-sheds
+    r.submit([1, 2], max_new_tokens=2, deadline_ms=1.0)
+    assert r.queue_depth == 1
+    # teach the estimator a 500ms cadence: a 100ms deadline cannot make
+    # it through a queue, a 10s one can
+    r._wait_ema_ms = 500.0
+    with pytest.raises(ShedError) as ei:
+        r.submit([3, 4], max_new_tokens=2, deadline_ms=100.0)
+    assert ei.value.reason == "deadline"
+    r.submit([5, 6], max_new_tokens=2, deadline_ms=10_000.0)
+    assert r.queue_depth == 2
+
+
+def test_router_shed_deadline_env_default(monkeypatch):
+    from mxnet_tpu.serve import RequestRouter, ShedError
+    monkeypatch.setenv("MXTPU_SHED_DEADLINE_MS", "100")
+    monkeypatch.setenv("MXTPU_ROUTER_QUEUE", "7")
+    rep = _FakeReplica("r0", queued=2, active=2, free=0, slots=2)
+    r = RequestRouter(lambda: [rep])
+    assert r.queue_bound == 7
+    assert r.shed_deadline_ms == 100.0
+    r._wait_ema_ms = 500.0
+    # request with NO deadline of its own inherits the shed deadline
+    with pytest.raises(ShedError) as ei:
+        r.submit([1, 2], max_new_tokens=2)
+    assert ei.value.reason == "deadline"
+
+
+def test_router_parked_deadline_expires_exactly_once():
+    from mxnet_tpu.serve import RequestRouter
+    rep = _FakeReplica("r0", queued=2, active=2, free=0, slots=2)
+    r = RequestRouter(lambda: [rep], queue_bound=4)
+    h = r.submit([1, 2], max_new_tokens=4, deadline_ms=50_000.0)
+    calls = []
+    orig = h._done.set
+    h._done.set = lambda: (calls.append(1), orig())
+    h.submitted_ts -= 51.0
+    assert r.sweep_expired() == 1
+    assert r.sweep_expired() == 0          # second sweep: nothing left
+    assert h.state == "failed" and len(calls) == 1
+    with pytest.raises(MXNetError, match="parked at the router"):
+        h.result(timeout=0)
+    assert r.queue_depth == 0
+
+
+@pytest.mark.parametrize("action", ["", ":OSError", ":exit"])
+def test_router_dispatch_fault_parks_instead_of_dropping(monkeypatch,
+                                                         action):
+    """EVERY armed action on the dispatch edge — the default
+    FaultInjected, a builtin exception, even the BaseException `exit` —
+    parks the request instead of dropping it or killing the caller."""
+    from mxnet_tpu.serve import RequestRouter
+    rep = _FakeReplica("r0", queued=0, active=0, free=8, slots=2)
+    r = RequestRouter(lambda: [rep], queue_bound=4)
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", f"router_dispatch@1{action}")
+    h = r.submit([1, 2], max_new_tokens=2)
+    # the dispatch edge faulted: the request is PARKED, never dropped
+    assert not h.done()
+    assert r.queue_depth == 1
+    assert rep.engine.scheduler.enqueued == []
+    # the fault fired once; feed() now delivers it
+    assert r.feed(rep) is True
+    assert rep.engine.scheduler.enqueued == [h]
+
+
+def test_redispatch_never_sheds_and_fails_on_total_loss():
+    from mxnet_tpu.serve import RequestRouter
+    from mxnet_tpu.serve.scheduler import ServeRequest
+    rep = _FakeReplica("r0", queued=5, active=2, free=0, slots=2)
+    r = RequestRouter(lambda: [rep], queue_bound=0)  # bound irrelevant
+    reqs = [ServeRequest([1, 2], 4) for _ in range(3)]
+    # headroom is ignored on redispatch: all land on the busy replica
+    assert r.redispatch(reqs, source="rX", reason="failover") == 3
+    assert all(req.failovers == 1 for req in reqs)
+    # total fleet loss: redispatch terminates instead of parking forever
+    rep.state = "dead"
+    lost = ServeRequest([3, 4], 4)
+    r.redispatch([lost], source="rX", reason="failover")
+    assert lost.done() and lost.state == "failed"
+    with pytest.raises(MXNetError, match="no surviving replica"):
+        lost.result(timeout=0)
+
+
+# ---------------------------------------------------------------------------
+# scheduler fleet hooks: salvage, detach, drain, enqueue guards
+# ---------------------------------------------------------------------------
+
+def test_salvage_collects_actives_then_queue_without_terminating():
+    from mxnet_tpu.serve import InferenceEngine, ServeConfig
+    m = _tiny_model()
+    eng = InferenceEngine(m, ServeConfig(max_slots=2, page_size=4,
+                                         prefill_chunk=4, max_len=32))
+    eng.warmup()
+    a = eng.submit([1, 2, 3], max_new_tokens=8)
+    b = eng.submit([4, 5], max_new_tokens=8)
+    c = eng.submit([6, 7], max_new_tokens=8)     # overflows the 2 slots
+    for _ in range(3):
+        eng.step()
+    assert a.tokens, "a should hold streamed progress before salvage"
+    salvaged = eng.scheduler.salvage()
+    # actives (admission order) first, then the queue; nobody terminated
+    assert salvaged == [a, b, c]
+    assert all(r.state == "queued" and not r.done() for r in salvaged)
+    # the scheduler is retired: steps no-op, enqueue refuses
+    assert eng.step() is False
+    with pytest.raises(MXNetError, match="retired"):
+        eng.scheduler.enqueue(a)
+
+
+def test_salvaged_request_resumes_bit_identical_on_second_engine():
+    """The failover core invariant, without threads: kill engine 1
+    mid-stream, re-enqueue the salvaged request on engine 2, and the
+    stream must complete bit-identical with no re-emission."""
+    from mxnet_tpu.serve import InferenceEngine, ServeConfig
+    m = _tiny_model()
+    sc = ServeConfig(max_slots=2, page_size=4, prefill_chunk=4,
+                     max_len=32)
+    e1, e2 = InferenceEngine(m, sc), InferenceEngine(m, sc)
+    e1.warmup()
+    e2.adopt_executables(e1)
+    ref = _ref_generate(m, [1, 2, 3], 10)
+    stream = []
+    h = e1.submit([1, 2, 3], max_new_tokens=10,
+                  on_token=lambda t, r: stream.append(t))
+    for _ in range(4):
+        e1.step()
+    assert 0 < len(h.tokens) < 10, "kill must land mid-stream"
+    salvaged = e1.scheduler.salvage()
+    assert salvaged == [h]
+    e2.scheduler.enqueue(h, front=True)
+    e2.run_until_idle()
+    assert h.result(timeout=0) == ref
+    assert stream == ref[3:], "re-emission or token loss across failover"
+
+
+def test_engine_drain_finishes_actives_hands_back_queued():
+    from mxnet_tpu.serve import InferenceEngine, ServeConfig
+    m = _tiny_model()
+    eng = InferenceEngine(m, ServeConfig(max_slots=2, page_size=4,
+                                         prefill_chunk=4, max_len=32))
+    eng.warmup()
+    a = eng.submit([1, 2, 3], max_new_tokens=6)
+    b = eng.submit([4, 5], max_new_tokens=6)
+    c = eng.submit([6, 7], max_new_tokens=6)
+    eng.step()                  # a and b take the two slots; c waits
+    assert a.state == "running" and c.state == "queued"
+    handed = eng.drain()
+    assert handed == [c]
+    assert a.state == "finished" and b.state == "finished"
+    assert c.state == "queued" and not c.done()
+    assert eng.scheduler.active_count == 0
+    with pytest.raises(MXNetError, match="draining"):
+        eng.submit([8, 9], max_new_tokens=2)
+
+
+def test_abandoned_scheduler_discards_in_flight_step_results():
+    """A step that was mid-execute when the supervisor salvaged must not
+    emit its tokens afterwards (double-streaming guard)."""
+    from mxnet_tpu.serve import InferenceEngine, ServeConfig
+    m = _tiny_model()
+    eng = InferenceEngine(m, ServeConfig(max_slots=1, page_size=4,
+                                         prefill_chunk=4, max_len=32))
+    eng.warmup()
+    h = eng.submit([1, 2, 3], max_new_tokens=6)
+    eng.step()
+    n_before = len(h.tokens)
+    salvage_done = threading.Event()
+    orig_execute = eng._execute
+
+    def stalled_execute(*a, **kw):
+        # salvage happens while the "device" is busy
+        eng.scheduler.salvage()
+        salvage_done.set()
+        return orig_execute(*a, **kw)
+
+    eng._execute = stalled_execute
+    assert eng.step() is False          # results discarded
+    assert salvage_done.is_set()
+    assert len(h.tokens) == n_before, "abandoned step still emitted"
+
+
+# ---------------------------------------------------------------------------
+# fleet end-to-end (threads)
+# ---------------------------------------------------------------------------
+
+def test_fleet_failover_mid_stream_bit_identical(monkeypatch):
+    """Kill a loaded replica via the replica_step fault point: every
+    stream (including the failed-over ones) completes bit-identical to
+    unbatched generate, with zero drops and no re-emission."""
+    m = _tiny_model()
+    rng = onp.random.RandomState(5)
+    prompts = [rng.randint(0, 96, rng.randint(2, 8)).tolist()
+               for _ in range(6)]
+    refs = [_ref_generate(m, p, 10) for p in prompts]
+    fleet = _fleet(m, n=2)
+    fleet.warmup()
+    streams = {i: [] for i in range(len(prompts))}
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "replica_step@3")
+    with fleet:
+        handles = [
+            fleet.submit(p, max_new_tokens=10,
+                         on_token=lambda t, r, i=i: streams[i].append(t))
+            for i, p in enumerate(prompts)]
+        for i, (h, ref) in enumerate(zip(handles, refs)):
+            assert h.result(timeout=60) == ref, i
+            assert streams[i] == ref[len(prompts[i]):], i
+        assert fleet.deaths == 1
+        assert sum(h.failovers for h in handles) >= 1
+        states = sorted(r.state for r in fleet.replicas)
+        assert states == ["dead", "running"], states
+
+
+def test_fleet_stall_detection_salvages_wedged_replica():
+    """A replica wedged inside the device call (heartbeat goes stale
+    with work in flight) is declared dead by the supervisor and its
+    requests fail over."""
+    m = _tiny_model()
+    ref = _ref_generate(m, [1, 2, 3], 8)
+    fleet = _fleet(m, n=2, stall_timeout=0.4, poll_interval=0.01)
+    fleet.warmup()
+    victim = fleet.replicas[0].engine
+    orig_execute = victim._execute
+    wedge = threading.Event()
+
+    def wedged_execute(*a, **kw):
+        wedge.set()
+        time.sleep(3.0)                 # longer than stall_timeout
+        return orig_execute(*a, **kw)
+
+    victim._execute = wedged_execute
+    with fleet:
+        # force-route to the wedged replica so the stall holds real work
+        h = mx.serve.ServeRequest([1, 2, 3], 8)
+        fleet.router._dispatch(h, fleet.replicas[0], "submit")
+        assert wedge.wait(10), "request never reached the wedged replica"
+        assert h.result(timeout=30) == ref
+        assert fleet.replicas[0].state == "dead"
+        assert "stalled" in fleet.replicas[0].error
+
+
+def test_fleet_drain_graceful_and_last_replica_guard():
+    m = _tiny_model()
+    refs = [_ref_generate(m, [1, 2, 3], 8), _ref_generate(m, [4, 5], 8)]
+    fleet = _fleet(m, n=2)
+    fleet.warmup()
+    with fleet:
+        h1 = fleet.submit([1, 2, 3], max_new_tokens=8)
+        h2 = fleet.submit([4, 5], max_new_tokens=8)
+        assert fleet.drain("r0", timeout=30)
+        assert fleet.replicas[0].state == "drained"
+        assert fleet.replicas[0].engine.scheduler.active_count == 0
+        assert h1.result(timeout=30) == refs[0]
+        assert h2.result(timeout=30) == refs[1]
+        with pytest.raises(MXNetError, match="cannot drain"):
+            fleet.drain("r0")
+        # draining the LAST replica still completes its actives
+        assert fleet.drain("r1", timeout=30)
+        from mxnet_tpu.serve import ShedError
+        with pytest.raises(ShedError) as ei:
+            fleet.submit([6], max_new_tokens=2)
+        assert ei.value.reason == "no_replicas"
+
+
+def test_fleet_replica_gauges_and_heartbeats_retire_with_replica():
+    from mxnet_tpu import health, telemetry as tele
+    m = _tiny_model()
+    fleet = _fleet(m, n=2)
+    fleet.warmup()
+    tele.enable()
+    try:
+        with fleet:
+            h = fleet.submit([1, 2, 3], max_new_tokens=4)
+            h.result(timeout=30)
+            reg = tele.registry()
+            for _ in range(200):
+                if "serve_replica_queue_depth" in reg:
+                    break
+                time.sleep(0.01)
+            g = reg.get("serve_replica_queue_depth")
+            series = {s[0]["replica"] for s in g._series()}
+            assert series == {"r0", "r1"}
+            assert "serve.replica.r0" in health.heartbeat_ages()
+            fleet.kill("r0")
+            series = {s[0]["replica"] for s in g._series()}
+            assert series == {"r1"}, "dead replica's gauge series linger"
+            assert "serve.replica.r0" not in health.heartbeat_ages()
+            assert reg.get("serve_fleet_replicas").value(state="dead") == 1
+    finally:
+        tele.disable()
+
+
+def test_fleet_close_is_terminal():
+    """close() retires every replica: submit sheds, start() refuses —
+    a closed fleet can never silently swallow work."""
+    from mxnet_tpu.serve import ShedError
+    m = _tiny_model()
+    fleet = _fleet(m, n=2)
+    fleet.warmup()
+    with fleet:
+        h = fleet.submit([1, 2, 3], max_new_tokens=4)
+        h.result(timeout=30)
+    assert all(r.state == "stopped" for r in fleet.replicas)
+    with pytest.raises(ShedError) as ei:
+        fleet.submit([4, 5], max_new_tokens=2)
+    assert ei.value.reason == "no_replicas"
+    with pytest.raises(MXNetError, match="closed"):
+        fleet.start()
+
+
+def test_fleet_env_replica_count(monkeypatch):
+    from mxnet_tpu.serve import ServeConfig, ServeFleet
+    monkeypatch.setenv("MXTPU_SERVE_REPLICAS", "3")
+    m = _tiny_model()
+    fleet = ServeFleet(m, config=ServeConfig(max_slots=2, page_size=4,
+                                             prefill_chunk=4, max_len=32))
+    assert len(fleet.replicas) == 3
+    with pytest.raises(MXNetError, match=">= 1 replica"):
+        ServeFleet(m, replicas=0)
+
+
+def test_adopt_executables_guards_and_shares():
+    from mxnet_tpu.serve import InferenceEngine, ServeConfig
+    m = _tiny_model()
+    sc = ServeConfig(max_slots=2, page_size=4, prefill_chunk=4,
+                     max_len=32)
+    e1 = InferenceEngine(m, sc)
+    e2 = InferenceEngine(m, sc)
+    with pytest.raises(MXNetError, match="no compiled steps"):
+        e2.adopt_executables(e1)
+    e1.warmup()
+    e2.adopt_executables(e1)
+    assert set(e2._execs) == set(e1._execs)
+    assert e2.compile_seconds == 0.0
+    e3 = InferenceEngine(m, ServeConfig(max_slots=4, page_size=4,
+                                        prefill_chunk=4, max_len=32))
+    with pytest.raises(MXNetError, match="config mismatch"):
+        e3.adopt_executables(e1)

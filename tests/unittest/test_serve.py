@@ -485,6 +485,129 @@ def test_deadline_off_by_default_and_per_request_override():
     assert h2.state == "failed"
 
 
+def _count_done_sets(req):
+    """Instrument a request's completion event: every `set()` call is
+    counted — the exactly-once contract says the total must be 1."""
+    calls = []
+    orig = req._done.set
+
+    def counting():
+        calls.append(1)
+        orig()
+    req._done.set = counting
+    return calls
+
+
+def _evict_mid_stream(eng, long_h, victim_h, max_steps=80):
+    """Drive the engine until `victim_h` has been evicted and parked in
+    the re-admission queue with streamed progress."""
+    for _ in range(max_steps):
+        eng.step()
+        if victim_h.evictions >= 1 and victim_h.state == "queued":
+            return
+    raise AssertionError(
+        f"victim was never evicted (evictions={victim_h.evictions}, "
+        f"state={victim_h.state}) — pool sizing no longer forces "
+        f"page pressure")
+
+
+def _pressure_engine(m):
+    """2 slots over a pool sized so two overlapping decodes MUST collide
+    (the serve-smoke pressure recipe, shrunk)."""
+    from mxnet_tpu.serve import InferenceEngine, ServeConfig
+    eng = InferenceEngine(m, ServeConfig(max_slots=2, page_size=2,
+                                         num_pages=9, prefill_chunk=4,
+                                         max_len=16))
+    eng.warmup()
+    return eng
+
+
+def test_deadline_expiry_evicted_requeued_exactly_once():
+    """The deadline × eviction interplay (regression): a request whose
+    deadline expires while PARKED in the re-admission queue after an
+    eviction must release its pages (they went back at eviction — the
+    pool must be whole afterwards, no double free) and unblock its
+    waiter EXACTLY once, while the surviving stream is untouched."""
+    m = _tiny_model(num_layers=1)
+    eng = _pressure_engine(m)
+    a = eng.submit([1, 2, 3], max_new_tokens=12)
+    b = eng.submit([4, 5], max_new_tokens=12, deadline_ms=100_000)
+    calls = _count_done_sets(b)
+    _evict_mid_stream(eng, a, b)
+    assert b.tokens, "victim should have streamed progress pre-eviction"
+    # deadline lapses while parked in the re-admission queue
+    b.submitted_ts -= 101.0
+    eng.run_until_idle()
+    assert b.state == "failed" and b.done()
+    assert len(calls) == 1, f"waiter unblocked {len(calls)} times"
+    with pytest.raises(MXNetError, match="deadline exceeded"):
+        b.result(timeout=0)
+    # the survivor finished normally; the pool is whole (eviction freed
+    # b's pages once; expiry must not have freed anything again — the
+    # allocator raises on double free, so reaching here proves it)
+    assert a.state == "finished"
+    assert len(a.tokens) == 12
+    assert eng.allocator.free_pages == eng.allocator.total_pages
+
+
+@pytest.mark.parametrize("where", ["queued", "active", "evicted"])
+def test_deadline_expiry_exactly_once_in_every_state(where):
+    """Expiry in queued / active / evicted-requeued states: one
+    termination, one waiter unblock, one counter increment, pool whole."""
+    from mxnet_tpu import telemetry as tele
+    m = _tiny_model(num_layers=1)
+    tele.enable()
+    try:
+        reg = tele.registry()
+
+        def expired_count():
+            c = reg.get("serve_deadline_expired_total")
+            if c is None:
+                return 0
+            return sum(v for _, v in c._series())
+
+        base = expired_count()
+        eng = _pressure_engine(m)
+        a = eng.submit([1, 2, 3], max_new_tokens=12)
+        b = eng.submit([4, 5], max_new_tokens=12, deadline_ms=100_000)
+        calls = _count_done_sets(b)
+        if where == "queued":
+            # b never admitted: slot pressure keeps it queued
+            pass
+        elif where == "active":
+            for _ in range(30):
+                eng.step()
+                if b.state == "running":
+                    break
+            assert b.state == "running"
+        else:
+            _evict_mid_stream(eng, a, b)
+        b.submitted_ts -= 101.0
+        eng.run_until_idle()
+        assert b.state == "failed" and b.done()
+        assert len(calls) == 1, (where, len(calls))
+        assert expired_count() == base + 1
+        assert a.state == "finished"
+        assert eng.allocator.free_pages == eng.allocator.total_pages
+    finally:
+        tele.disable()
+
+
+def test_terminate_request_is_idempotent():
+    """`terminate_request` is the ONE terminal path for non-finished
+    outcomes; the first caller wins and every later call is a no-op —
+    the guard that makes a scheduler sweep racing a router sweep safe."""
+    from mxnet_tpu.serve.scheduler import ServeRequest, terminate_request
+    req = ServeRequest([1, 2], max_new_tokens=4)
+    calls = _count_done_sets(req)
+    assert terminate_request(req, "first error", state="expired",
+                             phase="deadline_expired") is True
+    assert terminate_request(req, "second error", state="failed",
+                             phase="failed") is False
+    assert req.error == "first error"
+    assert req.state == "failed" and len(calls) == 1
+
+
 def test_deadline_env_knob_and_telemetry(monkeypatch, tmp_path):
     from mxnet_tpu import telemetry as tele
     from mxnet_tpu.serve import InferenceEngine, ServeConfig

@@ -12,6 +12,10 @@ Also pretty-prints crash flight-recorder bundles (docs/observability.md,
                                                    # + rollback lineage
     python tools/diagnose.py --trace <trace.json>  # span timeline +
                                                    # critical-path summary
+                                                   # + per-replica fleet
+                                                   # rollup (served /
+                                                   # failovers / shed /
+                                                   # p99 TTFT)
 """
 from __future__ import annotations
 
@@ -247,6 +251,80 @@ def print_trace(path: str) -> int:
               f"{100 * worst['queue'] / denom:.0f}% queue wait, "
               f"{100 * worst['prefill'] / denom:.0f}% prefill, "
               f"{100 * worst['first_decode'] / denom:.0f}% first decode")
+
+    # ---- fleet: per-replica rollup (docs/serving.md) ----------------
+    # spans carry a `replica` tag when the scheduler belongs to a
+    # ServeFleet; serve.route / serve.failover / serve.shed come from
+    # the router
+    replica_spans = [s for s in spans
+                     if (s.get("args") or {}).get("replica") is not None]
+    fleet_sheds = [s for s in spans if s["name"] == "serve.shed"]
+    if replica_spans or fleet_sheds:
+        rollup: dict = {}
+
+        def rep_row(name):
+            return rollup.setdefault(name, {
+                "served": set(), "fo_in": 0, "fo_out": 0, "ttfts": []})
+
+        for s in spans:
+            args = s.get("args") or {}
+            rep = args.get("replica")
+            if s["name"] == "serve.route" and rep is not None:
+                if args.get("failover"):
+                    rep_row(rep)["fo_in"] += 1
+            elif s["name"] == "serve.failover" and rep is not None:
+                rep_row(rep)["fo_out"] += args.get("requests", 0)
+        # a request is SERVED BY the replica that ran its last
+        # prefill/decode span; its TTFT belongs to the replica that
+        # produced the first token
+        for rid, ss in by_req.items():
+            root = next((s for s in ss
+                         if s["name"] == "serve.request"), None)
+            if root is None or \
+                    (root.get("args") or {}).get("state") != "finished":
+                continue
+            phases = [s for s in ss
+                      if s["name"] in ("serve.prefill_chunk",
+                                       "serve.decode",
+                                       "serve.first_decode")
+                      and (s.get("args") or {}).get("replica")]
+            if phases:
+                last = max(phases, key=lambda s: s["ts"] + s["dur"])
+                rep_row(last["args"]["replica"])["served"].add(rid)
+                first = next((s for s in phases
+                              if (s.get("args") or {}).get(
+                                  "first_token")), None)
+                ttft = (root.get("args") or {}).get("ttft_ms")
+                if first is not None and ttft is not None:
+                    rep_row(first["args"]["replica"])["ttfts"].append(
+                        float(ttft))
+        print(f"---------- fleet replicas ({len(rollup)}) ----------")
+        if rollup:
+            print(f"  {'replica':<10} {'served':>7} {'fo in':>6} "
+                  f"{'fo out':>7} {'p99 ttft':>10}  (ms)")
+            for name in sorted(rollup):
+                row = rollup[name]
+                ttfts = sorted(row["ttfts"])
+                p99 = _pctl(ttfts, 0.99)
+                p99_s = "-" if p99 is None else f"{p99:.2f}"
+                print(f"  {name:<10} {len(row['served']):>7} "
+                      f"{row['fo_in']:>6} {row['fo_out']:>7} "
+                      f"{p99_s:>10}")
+        by_reason: dict = {}
+        for s in fleet_sheds:
+            reason = (s.get("args") or {}).get("reason", "?")
+            by_reason[reason] = by_reason.get(reason, 0) + 1
+        if by_reason:
+            detail = ", ".join(f"{r}={n}"
+                               for r, n in sorted(by_reason.items()))
+            print(f"  shed: {len(fleet_sheds)} requests ({detail})")
+        failovers = [s for s in spans if s["name"] == "serve.failover"]
+        for s in failovers:
+            args = s.get("args") or {}
+            print(f"  failover: replica {args.get('replica')} -> "
+                  f"{args.get('requests', '?')} request(s) "
+                  f"re-dispatched in {s['dur'] / 1e3:.1f} ms "
+                  f"({args.get('error', '')})")
 
     # ---- train: step cadence + per-phase wall -----------------------
     t_disp = [s for s in spans if s["name"] == "train.dispatch"]

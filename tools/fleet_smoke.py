@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""Serving-fleet smoke (`make fleet-smoke`, wired into `make test`).
+
+CPU-only, <60 s end-to-end check of the fleet robustness tier
+(docs/serving.md "Fleet, failover & overload"):
+
+- **3 replicas** behind the `RequestRouter`, staggered mixed-length
+  load streaming through all of them;
+- **overload shedding is deterministic**: before the drivers start, a
+  submit burst fills every replica's headroom and the bounded global
+  queue — the shed counter must be ZERO until the bound is hit and the
+  overflow submissions must raise `ShedError` (reason `queue_full`,
+  with a retry-after hint);
+- **one replica is killed mid-stream** via the `replica_step` fault
+  point (``MXTPU_FAULT_SPEC``) — its in-flight requests fail over and
+  must finish on survivors;
+- **one replica is drained gracefully** while streams are active — it
+  must exit with an EMPTY active set and hand queued work back;
+- **zero dropped requests**: every request completes, and every
+  streamed token sequence is **bit-identical** to an unbatched
+  single-request `GPTForCausalLM.generate` run — eviction, failover,
+  draining and shedding backpressure are all invisible to the output,
+  and no token is ever re-emitted (streams are compared exactly, not
+  as sets).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    t_start = time.time()
+    journal_path = os.path.join(
+        tempfile.mkdtemp(prefix="mxtpu_fleet_smoke_"), "journal.jsonl")
+
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry as tele
+    from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from mxnet_tpu.serve import ServeConfig, ServeFleet, ShedError
+
+    tele.enable(journal_path=journal_path)
+
+    cfg = GPTConfig(vocab_size=96, hidden_size=32, num_layers=2,
+                    num_heads=4, intermediate_size=64, max_position=64,
+                    dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.initialize()
+    model(mx.np.array([[1, 2]], dtype="int32"))
+
+    rng = onp.random.RandomState(11)
+    max_new = 12
+    n_req = 14
+    prompts = [rng.randint(0, 96, rng.randint(2, 13)).tolist()
+               for _ in range(n_req)]
+
+    # unbatched references (the oracle): one generate() per request
+    refs = []
+    for p in prompts:
+        ids = mx.np.array([p], dtype="int32")
+        refs.append(onp.asarray(
+            model.generate(ids, max_new_tokens=max_new)
+            .asnumpy())[0].tolist())
+
+    sc = ServeConfig(max_slots=2, page_size=4, num_pages=0,
+                     prefill_chunk=4, max_len=32)
+    # tiny global queue bound so the overload phase can hit it with a
+    # handful of requests
+    queue_bound = 3
+    fleet = ServeFleet(model, replicas=3, config=sc,
+                       router_queue=queue_bound, stall_timeout=8.0)
+    fleet.warmup()
+
+    streams = {i: [] for i in range(n_req)}
+
+    def tok_cb(i):
+        return lambda t, r: streams[i].append(t)
+
+    # ---- phase A: deterministic overload shedding --------------------
+    # drivers are NOT running yet, so dispatch/parking is synchronous:
+    # capacity before shedding = 3 replicas x max_slots(2) headroom in
+    # local queues + the global bound.  Everything beyond that MUST shed
+    # with reason queue_full — and nothing before it may.
+    capacity = 3 * sc.max_slots + queue_bound          # 9
+    handles, shed_errors = [], []
+    for i, p in enumerate(prompts):
+        try:
+            handles.append(
+                fleet.submit(p, max_new_tokens=max_new,
+                             on_token=tok_cb(i)))
+        except ShedError as e:
+            handles.append(None)
+            shed_errors.append((i, e))
+            assert e.reason == "queue_full", e.reason
+            assert e.retry_after_ms > 0, e.retry_after_ms
+            assert len([h for h in handles if h is not None]) >= capacity, (
+                f"shed fired at admission {i} BEFORE the fleet was at "
+                f"capacity {capacity}")
+    assert len(shed_errors) == n_req - capacity, (
+        f"expected exactly {n_req - capacity} sheds past the bound, got "
+        f"{len(shed_errors)}")
+    snap = tele.snapshot()
+    shed_metric = snap["serve_shed_total"]["series"]
+    assert sum(s["value"] for s in shed_metric) == len(shed_errors)
+    assert all(s["labels"]["reason"] == "queue_full"
+               for s in shed_metric), shed_metric
+
+    # ---- phase B: chaos — kill one replica mid-stream, drain another -
+    # arm the fault AFTER phase A so hit counts are deterministic: the
+    # 6th executed fused step across the fleet dies mid-stream (every
+    # replica starts loaded, so whichever driver hits it holds active
+    # streams — the hardest failover shape: ctx advanced past tokens
+    # that never landed)
+    os.environ["MXTPU_FAULT_SPEC"] = "replica_step@6"
+    try:
+        fleet.start()
+        # resubmit the shed overflow as capacity frees up (the caller
+        # retry loop the ShedError contract implies)
+        pending = [(i, prompts[i]) for i, h in enumerate(handles)
+                   if h is None]
+        deadline = time.time() + 60
+        while pending and time.time() < deadline:
+            i, p = pending[0]
+            try:
+                handles[i] = fleet.submit(p, max_new_tokens=max_new,
+                                          on_token=tok_cb(i))
+                pending.pop(0)
+            except ShedError as e:
+                time.sleep(min(e.retry_after_ms, 50.0) / 1e3)
+        assert not pending, f"overflow requests never admitted: {pending}"
+
+        # wait for the injected death to be handled
+        deadline = time.time() + 30
+        while fleet.deaths == 0 and time.time() < deadline:
+            time.sleep(0.005)
+        assert fleet.deaths >= 1, "replica_step fault never killed a replica"
+        dead = [r for r in fleet.replicas if r.state == "dead"]
+        assert dead, [r.state for r in fleet.replicas]
+
+        # drain one SURVIVING replica gracefully while work is live
+        survivor = next(r for r in fleet.replicas if r.state == "running")
+        drained_ok = fleet.drain(survivor.name, timeout=45)
+        assert drained_ok, f"drain of {survivor.name} timed out"
+        assert survivor.state == "drained", survivor.state
+        assert survivor.engine.scheduler.active_count == 0, (
+            "drained replica exited with a non-empty active set")
+
+        # ---- zero dropped requests, bit-identical streams ------------
+        for i, (h, ref) in enumerate(zip(handles, refs)):
+            got = h.result(timeout=60)
+            assert got == ref, (
+                f"request {i}: fleet output diverged from single-request "
+                f"generate\n  got {got}\n  ref {ref}")
+            assert streams[i] == ref[len(prompts[i]):], (
+                f"request {i}: streamed tokens diverged (re-emission or "
+                f"loss): {streams[i]} vs {ref[len(prompts[i]):]}")
+    finally:
+        os.environ.pop("MXTPU_FAULT_SPEC", None)
+        fleet.close()
+
+    failovers = sum(h.failovers for h in handles)
+    assert failovers >= 1, (
+        "the killed replica was expected to fail over >= 1 in-flight "
+        "request")
+
+    # ---- telemetry / journal contract --------------------------------
+    snap = tele.snapshot()
+    deaths = snap["serve_replica_deaths_total"]["series"]
+    assert sum(s["value"] for s in deaths) == fleet.deaths
+    finished = [s for s in snap["serve_requests_total"]["series"]
+                if s["labels"]["state"] == "finished"]
+    assert finished and finished[0]["value"] == n_req, finished
+    rows = tele.RunJournal.read(journal_path)
+    rphases = {r.get("phase") for r in rows if r.get("event") == "replica"}
+    for needed in ("started", "dead", "draining", "drained"):
+        assert needed in rphases, f"journal missing replica phase {needed}"
+    qphases = {r.get("phase") for r in rows if r.get("event") == "request"}
+    for needed in ("submitted", "routed", "finished"):
+        assert needed in qphases, f"journal missing request phase {needed}"
+    assert any(r.get("event") == "shed" for r in rows)
+
+    elapsed = time.time() - t_start
+    print(json.dumps({
+        "fleet_smoke": "ok", "requests": n_req,
+        "sheds": len(shed_errors), "deaths": fleet.deaths,
+        "failovers": failovers,
+        "drained": survivor.name,
+        "elapsed_s": round(elapsed, 1)}))
+    assert elapsed < 60, f"smoke took {elapsed:.0f}s (budget 60s)"
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
