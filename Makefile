@@ -2,7 +2,7 @@
 # to what a single-language-core framework needs).
 PY ?= python
 
-.PHONY: ci test test-all test-dist test-parity lint bench cpp docs clean opperf-check telemetry-smoke health-smoke chaos-smoke serve-smoke fleet-smoke kernels-smoke elastic-smoke export-smoke data-smoke trace-smoke perf-gate
+.PHONY: ci test test-all test-dist test-parity lint bench cpp docs clean opperf-check telemetry-smoke health-smoke chaos-smoke serve-smoke fleet-smoke kernels-smoke elastic-smoke export-smoke data-smoke trace-smoke quant-smoke perf-gate
 
 # the one-command gate CI runs (VERDICT round-2 next-step #7): lint +
 # unit suite + 2-process dist tests + C++ package build/tests
@@ -17,7 +17,7 @@ cpp-test:
 # `make test-all` runs everything.  -n auto parallelizes when xdist +
 # cores are available: ~13.5 min serial on the 1-core builder VM,
 # well under 10 min on any >=2-core box
-test: telemetry-smoke health-smoke chaos-smoke serve-smoke fleet-smoke kernels-smoke elastic-smoke export-smoke data-smoke trace-smoke
+test: telemetry-smoke health-smoke chaos-smoke serve-smoke fleet-smoke kernels-smoke elastic-smoke export-smoke data-smoke trace-smoke quant-smoke
 	$(PY) -m pytest tests/unittest -q -m "not slow" $$($(PY) -c 'import xdist, os; print("-n auto" if (os.cpu_count() or 1) > 1 else "")' 2>/dev/null) --ignore=tests/unittest/test_dist_kvstore.py
 
 test-all:
@@ -135,6 +135,16 @@ export-smoke:
 # and a NONZERO mfu_estimate gauge from XLA cost_analysis flops on CPU
 trace-smoke:
 	$(PY) tools/trace_smoke.py
+
+# quantization end-to-end (docs/quantization.md): f32 reference streams,
+# then QuantizePass(int8) + QuantizePass(int4) serve exports reloaded in
+# fresh processes — engine weight bytes shrink >=1.9x / >=3.5x, the
+# freed bytes buy KV pages, loaded streams run ZERO transformer Python
+# and stay within the pinned top-1 agreement of f32; plus interpret-mode
+# fused dequant-matmul parity vs the jnp oracle and a 12-step
+# int8-compressed-gradient convergence dryrun vs f32 all-reduce
+quant-smoke:
+	$(PY) tools/quant_smoke.py
 
 # CPU-bench regression tripwire (ROADMAP item 5): median-of-3
 # `bench.py --measure cpu` runs must stay within 15% of the checked-in

@@ -342,6 +342,28 @@ def _measure_serve() -> dict:
         "device": getattr(dev, "device_kind", str(dev)),
         "platform": dev.platform,
     }
+    # quantized capacity table (ROADMAP item 2): weight bytes + MEASURED
+    # max-concurrent-pages at each weight precision — engines are cheap
+    # to construct (no warmup), and the auto pool sizing converts the
+    # freed weight bytes into extra pages, so the capacity win is a
+    # number, not a claim (docs/quantization.md)
+    cap_table = {}
+    for label, bits in (("f32", 0), ("int8", 8), ("int4", 4)):
+        # quant_bits explicit per row: an ambient MXTPU_QUANT_BITS (the
+        # ServeConfig default) must not quantize the f32 baseline row
+        e = eng if eng.quant_bits == bits else InferenceEngine(
+            model, ServeConfig(max_len=max_len, quant_bits=bits))
+        st = e.stats()
+        cap_table[label] = {
+            "weight_bytes": st["weight_bytes"],
+            "total_pages": e.allocator.total_pages,
+            "bonus_pages": st["bonus_pages"],
+        }
+        if bits:
+            cap_table[label]["weight_reduction"] = round(
+                cap_table["f32"]["weight_bytes"]
+                / max(1, st["weight_bytes"]), 3)
+    extras["quant_capacity"] = cap_table
     # per-width serving-step cost (mx.tracing): XLA flops/bytes of both
     # compiled widths + an MFU estimate at the run's mean step cadence
     cost_by_width = eng.cost_features()
@@ -734,6 +756,53 @@ def _measure_ops() -> dict:
             lambda a1, a2, a3: flash_attention(a1, a2, a3, causal=True),
             q, k, v)
 
+    # --- fused dequant-matmul (int8/int4 weight-only) ------------------
+    from mxnet_tpu.ops.pallas import autotune as _at
+    from mxnet_tpu.ops.pallas import quantized_matmul as _qmm
+    qm, qn, qk = 256, 512, 512
+    xq = jnp.asarray(rng.randn(qm, qk), f32)
+    wq = jnp.asarray(rng.randn(qn, qk), f32)
+    for bits in (8, 4):
+        qt = _qmm.quantize_weight(wq, bits)
+        # tuned block sizes: a warm second run must be a cache hit —
+        # set MXTPU_AUTOTUNE_CACHE to persist across bench runs
+        try:
+            tr = _at.tune("quantized_matmul", (qm, qn, qk),
+                          f"int{bits}", runs=2, top_k=2)
+            tune_info = {"source": tr.source, "cache_hit": tr.cache_hit,
+                         "trials": tr.trials}
+        except Exception as e:   # tuning must never fail the bench
+            tune_info = {"error": str(e).splitlines()[0]}
+
+        # quantized planes ride as jit ARGUMENTS (the MoE rule): a
+        # closed-over weight would let XLA constant-fold the dequant —
+        # the very traffic the fused kernel deletes — out of the timing
+        def _fused(a, qp, sp, b=bits, kk=qk):
+            t = _qmm.QuantizedTensor(qp, sp, b, kk)
+            return _qmm.quantized_matmul(a, t,
+                                         use_kernel=on_kernel_path)
+
+        def _deq_then_mm(a, qp, sp, b=bits, kk=qk):
+            t = _qmm.QuantizedTensor(qp, sp, b, kk)
+            return a @ _qmm.dequantize_weight(t).T
+
+        rf = _qmm._roofline(
+            _at.BlockConfig(block_m=128, block_n=128, block_k=512),
+            (qm, qn, qk), f"int{bits}")
+        ops[f"quantized_matmul_int{bits}"] = {
+            "shape": [qm, qn, qk],
+            "fused": timed(_fused, xq, qt.q, qt.scale),
+            "reference": timed(_deq_then_mm, xq, qt.q, qt.scale),
+            "f32": timed(lambda a, w: a @ w.T, xq, wq),
+            "weight_bytes": qt.nbytes(),
+            "weight_bytes_f32": int(wq.size) * 4,
+            "weight_reduction": round(int(wq.size) * 4 / qt.nbytes(), 3),
+            "bytes_moved_fused": int(rf["bytes"]),
+            "bytes_moved_f32": int(qm * qk * 4 + qn * qk * 4
+                                   + qm * qn * 4),
+            "autotune": tune_info,
+        }
+
     for entry in ops.values():
         f = entry.get("fused", {}).get("median_ms")
         r = entry.get("reference", {}).get("median_ms")
@@ -742,6 +811,9 @@ def _measure_ops() -> dict:
         lg = entry.get("legacy", {}).get("median_ms")
         if f and lg:
             entry["speedup_vs_legacy"] = round(lg / f, 3)
+        d = entry.get("f32", {}).get("median_ms")
+        if f and d:
+            entry["speedup_vs_f32"] = round(d / f, 3)
 
     return {
         "metric": "kernel_microbench",

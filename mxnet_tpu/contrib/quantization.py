@@ -99,18 +99,32 @@ def _q8(x, amax):
     return jnp.clip(jnp.round(x * scale), -INT8_MAX, INT8_MAX).astype(jnp.int8)
 
 
-def quantized_fully_connected(x, weight, bias, x_amax, w_amax):
+def quantized_fully_connected(x, weight, bias, x_amax, w_amax=None):
     """int8×int8→int32 dense with fp32 dequant epilogue. `x` fp32 in, fp32
     out — quantization is internal, as in the reference's quantized FC with
-    enabled calibration."""
+    enabled calibration.
+
+    ``w_amax=None`` (the default since the quantization-end-to-end PR)
+    quantizes the weight with **per-channel** symmetric scales through
+    the shared `ops.pallas.quantized_matmul` path — one scale per output
+    row instead of one per tensor, which is what keeps wide layers with
+    mixed-magnitude channels accurate.  An explicit ``w_amax`` keeps the
+    legacy per-tensor behavior bit-for-bit."""
+    from ..ops.pallas.quantized_matmul import (int8_act_matmul,
+                                               quantize_weight)
+
     def fn(xv, wv, bv):
-        xq = _q8(xv, x_amax)
-        wq = _q8(wv, w_amax)
-        acc = jax.lax.dot_general(
-            xq, wq, (((xv.ndim - 1,), (1,)), ((), ())),
-            preferred_element_type=jnp.int32)
-        scale = (x_amax / INT8_MAX) * (w_amax / INT8_MAX)
-        out = acc.astype(jnp.float32) * scale
+        if w_amax is None:
+            out = int8_act_matmul(xv, quantize_weight(wv, 8),
+                                  act_amax=x_amax)
+        else:
+            xq = _q8(xv, x_amax)
+            wq = _q8(wv, w_amax)
+            acc = jax.lax.dot_general(
+                xq, wq, (((xv.ndim - 1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            scale = (x_amax / INT8_MAX) * (w_amax / INT8_MAX)
+            out = acc.astype(jnp.float32) * scale
         if bv is not None:
             out = out + bv
         return out
@@ -223,20 +237,39 @@ class LayerCalibrator:
 
 
 class QuantizedDense:
-    """Inference-only int8 replacement for a Gluon `Dense` block."""
+    """Inference-only int8 replacement for a Gluon `Dense` block.
+
+    The weight is quantized ONCE at construction with per-channel
+    symmetric scales (`ops.pallas.quantized_matmul.quantize_weight`)
+    and every forward routes through the same fused dequant-matmul
+    dispatch the serving engine compiles — the MXNet-parity API and the
+    serve path share one kernel.  The calibrated ``x_amax`` rides on
+    the quantized weight as its activation threshold, so
+    ``MXTPU_QUANT_ACT=1`` flips this layer (and the serve matmuls) to
+    the int8-activation MXU path with no further plumbing."""
 
     def __init__(self, dense, x_amax: float):
+        from ..ops.pallas.quantized_matmul import quantize_weight
         self._dense = dense
         w = dense.weight._data
-        self.w_amax = float(jnp.max(jnp.abs(w._data)))
         self.x_amax = float(x_amax)
+        self.qt = quantize_weight(w._data, 8, act_amax=self.x_amax)
+        self.w_amax = float(jnp.max(jnp.abs(w._data)))  # back-compat
 
     def __call__(self, x):
+        from ..ops.pallas.quantized_matmul import quantized_matmul
         if getattr(self._dense, "_flatten", False) and x.ndim > 2:
             x = x.reshape((x.shape[0], -1))
         bias = self._dense.bias._data if self._dense.bias is not None else None
-        out = quantized_fully_connected(x, self._dense.weight._data, bias,
-                                        self.x_amax, self.w_amax)
+        qt = self.qt
+
+        def fn(xv, bv=None):
+            out = quantized_matmul(xv, qt, act_amax=self.x_amax)
+            return out if bv is None else out + bv
+        if bias is None:
+            out = apply_op(fn, (x,), {}, name="quantized_dense")
+        else:
+            out = apply_op(fn, (x, bias), {}, name="quantized_dense")
         act = getattr(self._dense, "act", None)
         return act(out) if act is not None else out
 

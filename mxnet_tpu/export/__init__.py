@@ -15,7 +15,8 @@ from .capture import (capture, capture_train_step, capture_serve, load,
                       TrainStepCapture, BlockCapture, ServeCapture,
                       LoadedArtifact, LoadedBlock)
 from .passes import (PassManager, RematSearchPass, ShardingRetargetPass,
-                     PallasSubstitutionPass, resolve_hbm_budget)
+                     PallasSubstitutionPass, QuantizePass,
+                     resolve_hbm_budget)
 
 __all__ = [
     "FORMAT_VERSION", "ExportArtifact", "export_dir",
@@ -25,5 +26,5 @@ __all__ = [
     "TrainStepCapture", "BlockCapture", "ServeCapture",
     "LoadedArtifact", "LoadedBlock",
     "PassManager", "RematSearchPass", "ShardingRetargetPass",
-    "PallasSubstitutionPass", "resolve_hbm_budget",
+    "PallasSubstitutionPass", "QuantizePass", "resolve_hbm_budget",
 ]

@@ -162,6 +162,7 @@ def _step_flags(step) -> dict:
             "zero": bool(step.zero), "fsdp": bool(step.fsdp),
             "fused_opt_kernel": bool(step._fused_opt_kernel),
             "optimizer": type(step.optimizer).__name__,
+            "grad_compress": getattr(step, "_grad_compress", "none"),
             "remat_policy": _resolved_remat(step)}
 
 
@@ -258,7 +259,7 @@ class TrainStepCapture:
             num_model_args=step.num_model_args,
             grad_accum_dtype=step.grad_accum_dtype,
             grad_accum=step.grad_accum, zero=step.zero, fsdp=step.fsdp,
-            donate=step.donate)
+            donate=step.donate, grad_compress=step._grad_compress)
 
     def save(self, path: str) -> str:
         return self.artifact.save(path)
@@ -392,22 +393,37 @@ class ServeCapture:
         self.engine = engine
         self.artifact = artifact
 
+    def recapture(self) -> None:
+        """Re-export both widths after a pass changed the engine's
+        program (e.g. `QuantizePass` rewrote the weight avals) — module
+        keys are per-(topology, chunk) so the rewrite replaces them,
+        and the manifest's serve_config/quant records follow the
+        engine's current state."""
+        _capture_serve_modules(self.engine, self.artifact)
+
+    def ship_weights(self) -> None:
+        """Embed the engine's weight leaves (flatten order, named
+        ``w<i>``) in the artifact's params.npz, so a loading engine
+        adopts byte-identical planes instead of requantizing."""
+        import jax
+        leaves = jax.tree_util.tree_leaves(self.engine.P)
+        self.artifact.params = {
+            f"w{i:05d}": onp.asarray(v) for i, v in enumerate(leaves)}
+
     def save(self, path: str) -> str:
         return self.artifact.save(path)
 
 
-def capture_serve(engine) -> ServeCapture:
-    """Capture an engine's fused serving step at both chunk widths.
-    Modules are tagged ``c<width>`` under the (single-device today)
-    topology; `InferenceEngine.warmup(artifact=...)` loads them back
-    without re-tracing the transformer."""
+def _capture_serve_modules(engine, art: ExportArtifact) -> None:
+    """(Re-)export an engine's fused step at both chunk widths into
+    `art`, refreshing the manifest's engine-identity records."""
     from jax import export as jexport
-    cfg_meta = _cfg_meta(engine.cfg)
-    art = ExportArtifact.new("serve_step", cfg_meta)
     sc = engine.serve_config
     # the engine's own identity dict — load_export compares against the
     # same method, so the two sides cannot drift
     art.manifest["meta"]["serve_config"] = engine._export_config()
+    if engine.quant_info is not None:
+        art.manifest["quant"] = dict(engine.quant_info)
     for C in sorted({sc.prefill_chunk, 1}):
         fn = engine._step_fn(C)
         avals = engine._step_avals(C)
@@ -418,6 +434,15 @@ def capture_serve(engine) -> ServeCapture:
                        meta={"chunk": C,
                              "custom_calls": exp.mlir_module().count(
                                  "stablehlo.custom_call")})
+
+
+def capture_serve(engine) -> ServeCapture:
+    """Capture an engine's fused serving step at both chunk widths.
+    Modules are tagged ``c<width>`` under the (single-device today)
+    topology; `InferenceEngine.warmup(artifact=...)` loads them back
+    without re-tracing the transformer."""
+    art = ExportArtifact.new("serve_step", _cfg_meta(engine.cfg))
+    _capture_serve_modules(engine, art)
     return ServeCapture(engine, art)
 
 

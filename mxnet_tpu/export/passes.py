@@ -20,6 +20,13 @@ knob changed.  Three passes ship:
   dispatch forced so matched norm/attention/optimizer subgraphs swap to
   their Pallas custom-calls when the target platform supports them
   (recorded as the module's ``custom_calls`` count delta).
+- `QuantizePass` — rewrites a SERVE capture to ship pre-quantized
+  int8/int4 weights (per-channel symmetric, int4 packed two-per-byte):
+  the engine's decode weights are quantized in place, both step widths
+  re-export over the quantized avals, the planes ride in params.npz,
+  and the manifest records a ``quant`` field `load_export` validates —
+  scheme mismatch fails fast, zero-retrace load still holds
+  (docs/quantization.md).
 """
 from __future__ import annotations
 
@@ -29,10 +36,10 @@ import time
 from typing import Any, Dict, List, Optional, Sequence
 
 from ..base import MXNetError
-from .capture import TrainStepCapture, _find_cfg
+from .capture import ServeCapture, TrainStepCapture, _find_cfg
 
 __all__ = ["PassManager", "RematSearchPass", "ShardingRetargetPass",
-           "PallasSubstitutionPass", "resolve_hbm_budget"]
+           "PallasSubstitutionPass", "QuantizePass", "resolve_hbm_budget"]
 
 
 class PassManager:
@@ -285,6 +292,62 @@ class ShardingRetargetPass:
                          topology_key(cap.step.topology())})
         cap.artifact.record_pass("sharding_retarget", axes=self.axes,
                                  module=mkey)
+        return cap
+
+
+# ---------------------------------------------------------------------------
+# export-time weight quantization
+# ---------------------------------------------------------------------------
+
+class QuantizePass:
+    """Quantize a serve capture's weights at export time (ROADMAP
+    item 2): the artifact ships int8/int4 planes + per-channel scales,
+    so every replica that loads it serves quantized WITHOUT re-deriving
+    anything — the capacity win (2-4x weight bytes) is decided offline,
+    recorded in the manifest, and validated at load.
+
+    ``bits``: 8 or 4.  ``include``: extra weight names to quantize
+    beyond the FFN/attention projections + LM head (e.g. ``"embed"``).
+    ``thresholds``: a `LayerCalibrator.thresholds()` dict attached for
+    the ``MXTPU_QUANT_ACT=1`` int8-activation path.
+
+    Mutates the capture's live engine (the `RematSearchPass`
+    write-back idiom): after the pass the capturing engine itself
+    serves quantized, so the reference stream it produces matches the
+    artifact.  The engine must still run dense weights — quantizing a
+    quantized engine compounds rounding and raises."""
+
+    def __init__(self, bits: int = 8, include: Sequence[str] = (),
+                 thresholds: Optional[Dict[str, float]] = None,
+                 ship_weights: bool = True):
+        if bits not in (4, 8):
+            raise MXNetError(f"QuantizePass bits must be 4 or 8, "
+                             f"got {bits}")
+        self.bits = int(bits)
+        self.include = tuple(include)
+        self.thresholds = dict(thresholds or {})
+        self.ship_weights = ship_weights
+
+    def __call__(self, cap):
+        if not isinstance(cap, ServeCapture):
+            raise MXNetError("QuantizePass applies to serve_step "
+                             f"captures, got {type(cap).__name__} "
+                             "(train-side quantization is the gradient "
+                             "compressor — parallel/compress.py)")
+        info = cap.engine.quantize_weights(self.bits,
+                                           include=self.include,
+                                           thresholds=self.thresholds)
+        cap.recapture()
+        if self.ship_weights:
+            cap.ship_weights()
+        cap.artifact.record_pass(
+            "quantize", bits=self.bits, scheme=info["scheme"],
+            quantized=len(info["quantized"]), skipped=info["skipped"],
+            f32_bytes=info["f32_bytes"],
+            quantized_bytes=info["quantized_bytes"],
+            reduction=round(info["f32_bytes"]
+                            / max(1, info["quantized_bytes"]), 3),
+            shipped=self.ship_weights)
         return cap
 
 

@@ -110,6 +110,7 @@ def _ensure_builtin() -> None:
     """Import the kernel modules that self-register tunables."""
     from . import flash_attention, fused_norm, fused_optimizer  # noqa: F401
     from . import moe_dispatch, paged_attention  # noqa: F401
+    from . import quantized_matmul  # noqa: F401
 
 
 # ---------------------------------------------------------------------------

@@ -19,6 +19,7 @@ from .sharding import (ShardingRules, default_tp_rules, param_sharding,
 from .elastic_mesh import (ElasticMeshController, TopologyChange,
                            member_sync)
 from . import collectives
+from . import compress
 from .collectives import (allreduce, allgather, reduce_scatter, broadcast,
                           ppermute_shift, all_to_all)
 from .ring_attention import ring_attention, ring_attention_sharded
@@ -35,7 +36,7 @@ __all__ = [
     "PartitionSpec", "ShardingRules", "default_tp_rules", "param_sharding",
     "shard_parameter_tree", "replicated", "retarget_spec",
     "ElasticMeshController", "TopologyChange", "member_sync",
-    "collectives", "allreduce",
+    "collectives", "compress", "allreduce",
     "allgather", "reduce_scatter", "broadcast", "ppermute_shift", "all_to_all",
     "ring_attention", "ring_attention_sharded", "ulysses_attention",
     "ulysses_attention_sharded", "MoEFeedForward", "switch_moe",

@@ -1,0 +1,114 @@
+"""int8 gradient compression for the dp-axis all-reduce.
+
+MXNet survey layer-8 parity (KVStore ``gradient_compression``): the
+data-parallel gradient reduction carries int8 payloads instead of f32 —
+on a real fabric that is 4x fewer wire bytes per step, the classic
+bandwidth lever for large-dp training.
+
+Scheme (the 1-bit/terngrad family's well-conditioned member):
+
+- **per-bucket symmetric scale** — each gradient leaf is flattened and
+  cut into fixed-size buckets (default 2048 elements); every bucket
+  gets one f32 scale ``amax/127``, so a single outlier only damages its
+  own bucket, not the whole tensor;
+- **stochastic rounding** — ``q = floor(g/scale + u)``, u ~ U[0,1) from
+  the step's PRNG key: quantization noise is zero-mean, so compressed
+  SGD stays an unbiased estimator and converges at the f32 rate in
+  expectation (the convergence dryrun in ``make quant-smoke`` checks
+  exactly this);
+- **f32 master accumulate** — dequantization and every optimizer-side
+  use happen in f32; only the wire format narrows.
+
+Placement note (docs/quantization.md): inside the GSPMD step the
+gradient tree this module sees is already dp-reduced — XLA fuses the
+cross-replica psum into the backward.  The compressor therefore models
+the *numerics* of quantize → integer-accumulate → dequantize exactly
+(per-bucket scale, stochastic rounding, f32 master), while the
+wire-level int8 collective itself needs the explicit-collective step
+variant — a TPU-validation item (ROADMAP §5): XLA:CPU would simulate,
+not measure, the bandwidth win.  The knob is **off by default** because
+it deliberately breaks bit-exactness with f32 training
+(``MXTPU_GRAD_COMPRESS=int8`` / ``ShardedTrainStep(grad_compress=...)``).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+
+__all__ = ["DEFAULT_BUCKET", "resolve_grad_compress",
+           "quantize_bucketed", "dequantize_bucketed", "compress_tree"]
+
+DEFAULT_BUCKET = 2048
+_INT8_MAX = 127.0
+
+
+def resolve_grad_compress(value=None) -> str:
+    """Resolve the compression knob: explicit ``value`` wins, else the
+    ``MXTPU_GRAD_COMPRESS`` env, else ``"none"``.  Only ``"none"`` and
+    ``"int8"`` exist today; unknown spellings raise (a typo must not
+    silently train uncompressed)."""
+    v = value if value is not None else \
+        os.environ.get("MXTPU_GRAD_COMPRESS", "")
+    v = str(v).strip().lower()
+    if v in ("", "0", "none", "off", "false", "no"):
+        return "none"
+    if v == "int8":
+        return "int8"
+    raise MXNetError(
+        f"unknown gradient compression {v!r}; supported: none, int8 "
+        "(MXTPU_GRAD_COMPRESS / ShardedTrainStep(grad_compress=...))")
+
+
+def quantize_bucketed(g, key, bucket: int = DEFAULT_BUCKET):
+    """One leaf -> (q int8 (nb, bucket), scale f32 (nb,), meta).
+
+    jit-safe; `meta` is the (static) original shape + element count for
+    :func:`dequantize_bucketed`.  An all-zero (or non-finite-scaled)
+    bucket quantizes to zeros with scale 0."""
+    shape = tuple(g.shape)
+    size = int(onp.prod(shape)) if shape else 1
+    gf = g.astype(jnp.float32).reshape(-1)
+    nb = -(-size // bucket)
+    pad = nb * bucket - size
+    if pad:
+        gf = jnp.pad(gf, (0, pad))
+    gb = gf.reshape(nb, bucket)
+    amax = jnp.max(jnp.abs(gb), axis=1)
+    # a non-finite bucket keeps scale 0 -> dequantizes to zeros; the
+    # step's own non-finite probes/skip-guard own that failure mode
+    amax = jnp.where(jnp.isfinite(amax), amax, 0.0)
+    scale = amax / _INT8_MAX
+    inv = jnp.where(scale > 0.0, 1.0 / jnp.maximum(scale, 1e-30), 0.0)
+    u = jax.random.uniform(key, gb.shape, jnp.float32)
+    q = jnp.clip(jnp.floor(gb * inv[:, None] + u),
+                 -_INT8_MAX, _INT8_MAX).astype(jnp.int8)
+    return q, scale, (shape, size)
+
+
+def dequantize_bucketed(q, scale, meta, dtype=jnp.float32):
+    """Inverse of :func:`quantize_bucketed` (f32 master values)."""
+    shape, size = meta
+    flat = (q.astype(jnp.float32) * scale[:, None]).reshape(-1)[:size]
+    return flat.reshape(shape).astype(dtype)
+
+
+def compress_tree(grads, key, bucket: int = DEFAULT_BUCKET):
+    """Quantize-dequantize round over a whole gradient pytree — what
+    the jitted train step applies between backward and the optimizer
+    when ``grad_compress="int8"``.  Each leaf folds its index into the
+    step key, so no two leaves (or steps) share rounding noise."""
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    out = []
+    for i, g in enumerate(leaves):
+        if not jnp.issubdtype(g.dtype, jnp.floating):
+            out.append(g)
+            continue
+        lk = jax.random.fold_in(key, i)
+        q, scale, meta = quantize_bucketed(g, lk, bucket)
+        out.append(dequantize_bucketed(q, scale, meta, g.dtype))
+    return jax.tree_util.tree_unflatten(treedef, out)

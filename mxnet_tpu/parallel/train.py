@@ -77,7 +77,7 @@ class ShardedTrainStep:
                  num_model_args: Optional[int] = None,
                  grad_accum_dtype=jnp.float32, grad_accum: int = 1,
                  zero: bool = False, fsdp: bool = False,
-                 donate: bool = True):
+                 donate: bool = True, grad_compress: Optional[str] = None):
         # ZeRO stage 1: shard optimizer state over the 'dp' axis instead
         # of replicating it (params stay replicated; XLA inserts the
         # reduce-scatter/all-gather around the sharded update). Cuts
@@ -105,6 +105,16 @@ class ShardedTrainStep:
             raise MXNetError(f"grad_accum must be >= 1, got {grad_accum}")
         self.grad_accum = int(grad_accum)
         self.grad_accum_dtype = grad_accum_dtype
+        # int8 gradient compression on the dp-axis reduction
+        # (parallel/compress.py; MXNet survey layer-8 gradient-
+        # compression parity).  Resolved ONCE at construction like the
+        # probes — the quantize-dequantize round is traced into the
+        # step, so flipping MXTPU_GRAD_COMPRESS mid-run never retraces.
+        # Off by default: it deliberately trades bit-exactness with f32
+        # training for 4x less gradient wire traffic.
+        from . import compress as _compress
+        self._grad_compress = _compress.resolve_grad_compress(
+            grad_compress)
         self.block = block
         # how many leading batch args feed block.forward; the rest (labels
         # etc.) only reach loss_fn. None = all.
@@ -485,6 +495,17 @@ class ShardedTrainStep:
                     jnp.sum((~jnp.isfinite(g)).astype(jnp.float32))
                     for g in leaves)
                 probes = {"grad_norm": gnorm, "nonfinite": nonfinite}
+            if outer._grad_compress == "int8":
+                # int8 grad compression (parallel/compress.py): per-
+                # bucket symmetric scale + stochastic rounding, f32
+                # master accumulate.  AFTER the probes (they must see
+                # the raw gradients) and BEFORE the skip guard reads
+                # them for the update.  The rounding key folds off the
+                # step key, so replicas stay deterministic and no two
+                # steps share noise.
+                from .compress import compress_tree
+                grads = compress_tree(
+                    grads, jax.random.fold_in(key, 0x67c8))
             skip = None
             if outer._skip_nonfinite:
                 # tier-1 recovery: a non-finite gradient tree (or loss)
@@ -1233,6 +1254,18 @@ class ShardedTrainStep:
                     f"{want!r} but this step runs {k}={flags[k]!r}; the "
                     "compiled program would not match — re-capture or "
                     "construct the step with matching settings")
+        # flags the artifact's meta may simply not RECORD (captured by
+        # an older build): absence means the capture ran the default,
+        # so a step running non-default must still refuse — the loop
+        # above only sees the artifact's keys, and silence here would
+        # e.g. train uncompressed under grad_compress="int8"
+        for k, default in (("grad_compress", "none"),):
+            if k not in rec["meta"] and flags.get(k, default) != default:
+                raise MXNetError(
+                    f"export artifact {path} predates the {k} step flag "
+                    f"(captured running the default {default!r}) but "
+                    f"this step runs {k}={flags[k]!r}; the compiled "
+                    "program would not match — re-capture")
         art_remat = rec["meta"].get("remat_policy")
         # batch specs/shardings come from the manifest (no _build runs).
         # Everything below validates into LOCALS first: a failed load
@@ -1660,7 +1693,9 @@ def _like_sharding(param_sharding: NamedSharding, state_leaf, param):
 def make_sharded_train_step(block, optimizer, loss_fn, mesh, rules=None,
                             batch_specs=None, num_model_args=None,
                             zero=False, fsdp=False,
-                            grad_accum=1, donate=True) -> ShardedTrainStep:
+                            grad_accum=1, donate=True,
+                            grad_compress=None) -> ShardedTrainStep:
     return ShardedTrainStep(block, optimizer, loss_fn, mesh, rules,
                             batch_specs, num_model_args, zero=zero,
-                            fsdp=fsdp, grad_accum=grad_accum, donate=donate)
+                            fsdp=fsdp, grad_accum=grad_accum, donate=donate,
+                            grad_compress=grad_compress)

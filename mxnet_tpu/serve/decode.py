@@ -22,16 +22,29 @@ and token-at-a-time prefill agree).
 Weights travel as a plain dict-of-jax-arrays pytree
 (:func:`extract_decode_weights`) so the whole step stays jit/scan-friendly
 and the serving engine can compile one fused program over it.
+
+Weight-only quantization (docs/quantization.md): any matmul weight in
+the dict may be a `QuantizedTensor` (int8/int4 planes + per-channel
+scales) instead of a dense array — :func:`quantize_decode_weights`
+rewrites the pytree, and every projection routes through
+`ops.pallas.quantized_matmul.matmul_nt`, which fuses the dequantize
+into the matmul.  Embeddings, positions, norms, and biases stay f32 by
+default (an opt-in ``include`` allowlist covers the embedding table).
 """
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
 
+from ..ops.pallas.quantized_matmul import (QuantizedTensor,  # noqa: F401
+                                           gather_rows, matmul_nt,
+                                           quantize_weight)
+
 __all__ = ["extract_decode_weights", "transformer_step", "lm_logits",
-           "layer_norm"]
+           "layer_norm", "quantize_decode_weights", "decode_weight_bytes",
+           "QUANT_DEFAULT_TARGETS"]
 
 
 def extract_decode_weights(model) -> dict:
@@ -70,6 +83,75 @@ def extract_decode_weights(model) -> dict:
                 head=head, layers=layers)
 
 
+# the matmul weights quantization targets by default: every FFN /
+# attention projection plus the (untied) LM head.  Embeddings stay f32
+# unless allowlisted ("embed"); norms/biases are never quantized (sub-
+# percent of the bytes, all of the numerics risk).
+QUANT_DEFAULT_TARGETS = ("wqkv", "wo", "w1", "w2", "head")
+
+
+def quantize_decode_weights(P: dict, bits: int = 8, include=(),
+                            thresholds: Optional[Dict[str, float]] = None):
+    """Rewrite an `extract_decode_weights` pytree to int8/int4 planes.
+
+    Quantizes the 2-D matmul weights (`QUANT_DEFAULT_TARGETS`) with
+    per-channel symmetric scales; ``include`` opts additional leaves in
+    (``"embed"`` — the table is then dequantized per gathered row and
+    the tied LM head runs the fused kernel).  ``thresholds`` maps
+    ``"layers.<i>.<name>"`` / top-level names to calibrated activation
+    amax values (a `LayerCalibrator.thresholds()` dict) attached for
+    the ``MXTPU_QUANT_ACT=1`` int8-activation path.
+
+    Returns ``(newP, info)`` — info records bits, per-leaf byte
+    deltas, and the skipped module names (the artifact manifest's
+    ``quant`` field).
+    """
+    targets = set(QUANT_DEFAULT_TARGETS) | set(include)
+    thresholds = thresholds or {}
+    skipped, quantized = [], []
+    f32_bytes = q_bytes = 0
+
+    def one(name, key, w):
+        nonlocal f32_bytes, q_bytes
+        if w is None:
+            return None
+        dense_ok = hasattr(w, "ndim") and w.ndim == 2
+        if key not in targets or not dense_ok:
+            skipped.append(name)
+            return w
+        qt = quantize_weight(w, bits,
+                             act_amax=thresholds.get(name,
+                                                     thresholds.get(key)))
+        f32_bytes += int(w.size) * jnp.dtype(w.dtype).itemsize
+        q_bytes += qt.nbytes()
+        quantized.append(name)
+        return qt
+
+    newP = dict(P)
+    for key in ("embed", "pos", "head"):
+        newP[key] = one(key, key, P.get(key))
+    layers = []
+    for li, L in enumerate(P["layers"]):
+        NL = dict(L)
+        for key in ("wqkv", "wo", "w1", "w2"):
+            NL[key] = one(f"layers.{li}.{key}", key, L[key])
+        layers.append(NL)
+    newP["layers"] = layers
+    info = {"bits": int(bits), "scheme": "symmetric-per-channel",
+            "quantized": quantized, "skipped": sorted(set(skipped)),
+            "f32_bytes": int(f32_bytes), "quantized_bytes": int(q_bytes),
+            "saved_bytes": int(f32_bytes - q_bytes)}
+    return newP, info
+
+
+def decode_weight_bytes(P: dict) -> int:
+    """Stored bytes of a decode-weight pytree (dense or quantized)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(P):
+        total += int(leaf.size) * jnp.dtype(leaf.dtype).itemsize
+    return total
+
+
 def layer_norm(x, g, b, eps):
     m = x.mean(-1, keepdims=True)
     v = ((x - m) ** 2).mean(-1, keepdims=True)
@@ -100,12 +182,12 @@ def transformer_step(P: dict, cfg, tok, pos,
     use_rope = getattr(cfg, "rope", False)
     B, C = tok.shape
 
-    h = P["embed"][tok]                                  # (B, C, E)
+    h = gather_rows(P["embed"], tok)                     # (B, C, E)
     if not use_rope:
         h = h + P["pos"][pos]
     for li, L in enumerate(P["layers"]):
         a = layer_norm(h, L["ln1_g"], L["ln1_b"], eps)
-        qkv = a @ L["wqkv"].T + L["bqkv"]
+        qkv = matmul_nt(a, L["wqkv"]) + L["bqkv"]
         q = qkv[..., :E].reshape(B, C, H, D).transpose(0, 2, 1, 3)
         k = qkv[..., E:E + kvw].reshape(B, C, Hkv, D).transpose(0, 2, 1, 3)
         v = qkv[..., E + kvw:].reshape(B, C, Hkv, D).transpose(0, 2, 1, 3)
@@ -116,16 +198,17 @@ def transformer_step(P: dict, cfg, tok, pos,
             q = rope_rotate(q, pos[:, None, :], cfg.rope_theta)
             k = rope_rotate(k, pos[:, None, :], cfg.rope_theta)
         ctx = kv_fn(li, q, k, v)                          # (B, H, C, D)
-        h = h + ctx.transpose(0, 2, 1, 3).reshape(B, C, E) @ L["wo"].T \
-            + L["bo"]
+        h = h + matmul_nt(ctx.transpose(0, 2, 1, 3).reshape(B, C, E),
+                          L["wo"]) + L["bo"]
         f = layer_norm(h, L["ln2_g"], L["ln2_b"], eps)
-        h = h + jax.nn.gelu(f @ L["w1"].T + L["b1"]) @ L["w2"].T + L["b2"]
+        h = h + matmul_nt(jax.nn.gelu(matmul_nt(f, L["w1"]) + L["b1"]),
+                          L["w2"]) + L["b2"]
     return layer_norm(h, P["lnf_g"], P["lnf_b"], eps)
 
 
 def lm_logits(P: dict, h):
     """LM-head logits for hidden states `h` (..., E) -> (..., V)."""
-    return h @ (P["embed"].T if P["head"] is None else P["head"].T)
+    return matmul_nt(h, P["embed"] if P["head"] is None else P["head"])
 
 
 def dense_kv_fn(kcache, vcache, pos, window: Optional[int] = None):

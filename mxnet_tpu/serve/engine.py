@@ -43,7 +43,8 @@ from ..base import MXNetError
 from .. import health as _health
 from .. import telemetry as _tele
 from .. import tracing as _trace
-from .decode import extract_decode_weights, transformer_step, lm_logits
+from .decode import (extract_decode_weights, transformer_step, lm_logits,
+                     quantize_decode_weights, decode_weight_bytes)
 from .kv_cache import KVPools, PageAllocator, make_paged_kv_fn
 from .scheduler import ContinuousBatchingScheduler, ServeRequest
 
@@ -93,6 +94,12 @@ class ServeConfig:
     # abandoned client can never pin KV pages forever
     deadline_ms: int = field(
         default_factory=lambda: _env_int("MXTPU_SERVE_DEADLINE_MS", 0))
+    # weight-only quantization: 8 or 4 rewrites the decode weights to
+    # int8/int4 planes at engine construction and routes the FFN/
+    # attention projections + LM head through the fused dequant-matmul
+    # kernel (docs/quantization.md).  0 = dense f32 weights.
+    quant_bits: int = field(
+        default_factory=lambda: _env_int("MXTPU_QUANT_BITS", 0))
     # engine-wide sampling filter (static: part of the compiled step)
     top_k: int = 0
     top_p: float = 1.0
@@ -104,13 +111,17 @@ class ServeConfig:
             raise MXNetError("page_size must be >= 1")
         if self.prefill_chunk < 1:
             raise MXNetError("prefill_chunk must be >= 1")
+        if self.quant_bits not in (0, 4, 8):
+            raise MXNetError(
+                f"quant_bits must be 0 (dense), 8, or 4; got "
+                f"{self.quant_bits} (MXTPU_QUANT_BITS)")
 
 
 class InferenceEngine:
     """Continuous-batching inference over a GPT-style causal LM."""
 
     def __init__(self, model, config: Optional[ServeConfig] = None,
-                 seed: int = 0):
+                 seed: int = 0, act_thresholds=None):
         self.model = model
         self.cfg = model.cfg
         self.serve_config = config or ServeConfig()
@@ -127,25 +138,123 @@ class InferenceEngine:
                 f"max_position={cfg.max_position}")
         self.max_pages_per_seq = max(
             1, math.ceil(self.max_len / sc.page_size))
-        # auto pool size: every slot can hold a full-length sequence,
-        # plus the reserved null page
-        num_pages = sc.num_pages or \
-            sc.max_slots * self.max_pages_per_seq + 1
         kv_dtype = sc.kv_dtype or cfg.dtype
         self.quantized = str(kv_dtype) == "int8"
+        self._kv_dtype = kv_dtype
 
         self.P = extract_decode_weights(model)
+        self.quant_bits = 0
+        self.quant_info = None
+        self._step_fns = {}       # chunk width C -> jitted step
+        self._execs = {}          # chunk width C -> AOT executable
+        if sc.quant_bits:
+            self.quantize_weights(sc.quant_bits,
+                                  thresholds=act_thresholds)
+        # auto pool size: every slot can hold a full-length sequence,
+        # plus the reserved null page — PLUS the pages the quantized
+        # weights just paid for: the capacity freed by smaller weights
+        # lands in the free-page gauges, not in unaccounted HBM slack
+        # (ROADMAP item 2's whole premise).  An explicit num_pages wins.
+        bonus = 0
+        if sc.num_pages == 0 and self.quant_info is not None:
+            bonus = self.quant_info["saved_bytes"] // max(
+                1, self._page_nbytes(kv_dtype))
+        num_pages = sc.num_pages or \
+            sc.max_slots * self.max_pages_per_seq + 1 + bonus
+        self.bonus_pages = bonus
         self.pools = KVPools.create(
             cfg.num_layers, num_pages, sc.page_size, self.n_kv_heads,
             self.head_dim, dtype=kv_dtype)
         self.allocator = PageAllocator(num_pages, sc.page_size)
         self.scheduler = ContinuousBatchingScheduler(self)
         self._key = jax.random.PRNGKey(seed)
-        self._step_fns = {}       # chunk width C -> jitted step
-        self._execs = {}          # chunk width C -> AOT executable
         self.compile_seconds = None
         self._steps_executed = 0
+        self._note_weight_bytes()
         _health.beat("serve.step")   # announce the heartbeat name early
+
+    # ------------------------------------------------------------------
+    # weight-only quantization (docs/quantization.md)
+    # ------------------------------------------------------------------
+    def _page_nbytes(self, kv_dtype) -> int:
+        """HBM bytes of ONE physical KV page across all layers (K + V,
+        plus scale planes for the int8 pool)."""
+        cfg = self.cfg
+        sc = self.serve_config
+        per_vec = self.head_dim * (1 if self.quantized
+                                   else jnp.dtype(kv_dtype).itemsize)
+        if self.quantized:
+            per_vec += 4        # one f32 scale per stored vector
+        return 2 * cfg.num_layers * sc.page_size * self.n_kv_heads \
+            * per_vec
+
+    def quantize_weights(self, bits: int, include=(),
+                         thresholds=None) -> dict:
+        """Rewrite the decode weights to int8/int4 planes (per-channel
+        symmetric — `serve.decode.quantize_decode_weights`).  Drops any
+        compiled step executables (their avals changed).  Called at
+        construction for ``ServeConfig.quant_bits`` / the
+        ``MXTPU_QUANT_BITS`` env; the export-time `QuantizePass` calls
+        it on a live capture.  Returns the quantization info dict (the
+        manifest ``quant`` field)."""
+        if self.quant_bits:
+            raise MXNetError(
+                f"engine weights are already int{self.quant_bits}-"
+                "quantized; re-quantizing quantized planes would "
+                "compound the rounding — build a fresh engine")
+        # the weight swap invalidates every compiled step AND the KV
+        # context already computed with the dense weights — a live call
+        # (QuantizePass, explicit pool size or not) requires idleness
+        sched = getattr(self, "scheduler", None)
+        if sched is not None and (sched.active_count
+                                  or sched.queue_depth):
+            raise MXNetError(
+                "quantize_weights needs an idle engine (in-flight "
+                "streams hold dense-weight KV state, and the paged "
+                "pool may be rebuilt to claim the freed weight "
+                "bytes); drain() first")
+        self.P, info = quantize_decode_weights(self.P, bits,
+                                               include=include,
+                                               thresholds=thresholds)
+        self.quant_bits = int(bits)
+        self.quant_info = info
+        self._step_fns.clear()
+        self._execs.clear()
+        # live-engine call (QuantizePass): grow the auto-sized pool by
+        # the pages the freed weight bytes pay for — the SAME formula
+        # construction uses, so an artifact captured here installs into
+        # a ``quant_bits``-constructed engine with identical pool avals
+        if getattr(self, "pools", None) is not None and \
+                self.serve_config.num_pages == 0:
+            bonus = info["saved_bytes"] // max(
+                1, self._page_nbytes(self._kv_dtype))
+            if bonus > 0:
+                sc = self.serve_config
+                num_pages = self.pools.num_pages + bonus
+                self.pools = KVPools.create(
+                    self.cfg.num_layers, num_pages, sc.page_size,
+                    self.n_kv_heads, self.head_dim,
+                    dtype=self._kv_dtype)
+                self.allocator = PageAllocator(num_pages, sc.page_size)
+                self.bonus_pages = bonus
+                if sched is not None:
+                    sched.allocator = self.allocator
+        self._note_weight_bytes()
+        return info
+
+    def weight_bytes(self) -> int:
+        """Stored bytes of the decode weights (planes + scales when
+        quantized)."""
+        return decode_weight_bytes(self.P)
+
+    def _note_weight_bytes(self) -> None:
+        if not _tele.enabled():
+            return
+        _tele.gauge(
+            "serve_weight_bytes",
+            "Stored bytes of the engine's decode weights (quantized "
+            "planes + scales when MXTPU_QUANT_BITS is set)"
+        ).set(self.weight_bytes())
 
     # ------------------------------------------------------------------
     # compiled step
@@ -236,10 +345,15 @@ class InferenceEngine:
         return self.compile_seconds
 
     # -- ahead-of-time export (docs/export.md) -------------------------
-    def export(self, path: str) -> str:
-        """Capture both compiled step widths to an export artifact."""
-        from ..export import capture_serve
-        return capture_serve(self).save(path)
+    def export(self, path: str, passes=None) -> str:
+        """Capture both compiled step widths to an export artifact,
+        optionally through an offline pass pipeline first (e.g.
+        ``passes=[QuantizePass(bits=8)]`` — docs/quantization.md)."""
+        from ..export import capture_serve, PassManager
+        cap = capture_serve(self)
+        if passes:
+            cap = PassManager(passes).run(cap)
+        return cap.save(path)
 
     def load_export(self, path: str) -> None:
         """Install both step widths from an artifact — zero model
@@ -257,6 +371,18 @@ class InferenceEngine:
             raise MXNetError(
                 f"serve export artifact {path} was captured for config "
                 f"{got} but this engine runs {want}; re-capture")
+        quant = la.manifest.get("quant")
+        if (quant or {}).get("bits", 0) != self.quant_bits or \
+                (quant or {}).get("scheme",
+                                  "symmetric-per-channel") != \
+                "symmetric-per-channel":
+            raise MXNetError(
+                f"serve export artifact {path} quant scheme "
+                f"{quant!r} does not match this engine "
+                f"(quant_bits={self.quant_bits}); construct the engine "
+                "with the matching MXTPU_QUANT_BITS / "
+                "ServeConfig.quant_bits (docs/quantization.md failure "
+                "matrix)")
         # stage into a local dict: a failure on the SECOND width must
         # not leave a half-artifact engine (live fallback would keep
         # the already-installed exec via _compile's early return)
@@ -279,15 +405,59 @@ class InferenceEngine:
                 _tele.event("compile_end", kind="serve_export_load",
                             chunk=C,
                             seconds=round(time.perf_counter() - t0, 4))
+        # a QuantizePass artifact SHIPS its pre-quantized planes: adopt
+        # them so the served weights are byte-identical to the capture
+        # (requantizing locally agrees for f32 sources, but the shipped
+        # planes make the artifact the single source of truth).  LAST,
+        # after every width staged/validated: a refused load must leave
+        # the engine untouched — weights included (the planes carry the
+        # same avals as self.P, per-leaf-validated, so the staged
+        # executables compiled above accept them)
+        if quant and la.artifact.params is not None:
+            self._install_weights(la.artifact.params, path)
         self._execs.update(staged)
 
     def _export_config(self) -> dict:
+        from ..ops.pallas.quantized_matmul import act_quant_enabled
         sc = self.serve_config
         return {"max_slots": sc.max_slots, "page_size": sc.page_size,
                 "prefill_chunk": sc.prefill_chunk,
                 "max_len": self.max_len,
                 "kv_dtype": sc.kv_dtype or self.cfg.dtype,
+                # program-shaping quantization knobs: an int8 artifact
+                # must never install into a dense (or int4, or int8-
+                # activation) engine — scheme mismatch fails fast
+                "quant_bits": self.quant_bits,
+                "quant_act": act_quant_enabled(),
                 "top_k": sc.top_k, "top_p": sc.top_p}
+
+    def _install_weights(self, params: dict, path: str) -> None:
+        """Adopt an artifact's shipped weight leaves (flatten-order
+        named ``w<i>``; the engine's own quantized tree defines the
+        structure — `_export_config`/aval checks already proved the
+        trees agree)."""
+        leaves, treedef = jax.tree_util.tree_flatten(self.P)
+        if len(params) != len(leaves):
+            raise MXNetError(
+                f"serve export artifact {path} ships {len(params)} "
+                f"weight leaves but this engine's tree has "
+                f"{len(leaves)}; re-capture")
+        new = []
+        for i, old in enumerate(leaves):
+            v = params.get(f"w{i:05d}")
+            if v is None:
+                raise MXNetError(
+                    f"serve export artifact {path} is missing weight "
+                    f"leaf w{i:05d}; re-capture")
+            if tuple(v.shape) != tuple(old.shape) or \
+                    jnp.dtype(v.dtype) != jnp.dtype(old.dtype):
+                raise MXNetError(
+                    f"serve export artifact {path} weight leaf "
+                    f"w{i:05d} is {tuple(v.shape)}/{v.dtype}, engine "
+                    f"expects {tuple(old.shape)}/{old.dtype}")
+            new.append(jnp.asarray(v))
+        self.P = jax.tree_util.tree_unflatten(treedef, new)
+        self._note_weight_bytes()
 
     def _auto_artifact_path(self) -> Optional[str]:
         # MXTPU_EXPORT=1 gates BOTH auto-load and auto-capture (the
@@ -464,5 +634,8 @@ class InferenceEngine:
             "free_pages": self.allocator.free_pages,
             "page_occupancy": round(self.allocator.occupancy(), 4),
             "pool_bytes": self.pools.nbytes(),
+            "weight_bytes": self.weight_bytes(),
+            "quant_bits": self.quant_bits,
+            "bonus_pages": getattr(self, "bonus_pages", 0),
             "compile_seconds": self.compile_seconds,
         }

@@ -1,0 +1,425 @@
+"""Quantization end-to-end: fused dequant-matmul kernel, int4 packing,
+QuantizePass serve artifacts, and int8 gradient compression
+(docs/quantization.md)."""
+import json
+import os
+
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.ops.pallas import quantized_matmul as qm
+
+pytestmark = pytest.mark.pallas
+
+
+# ---------------------------------------------------------------------------
+# int4 packing
+# ---------------------------------------------------------------------------
+
+def test_pack_unpack_roundtrip_odd_k():
+    rng = onp.random.RandomState(0)
+    for k in (1, 2, 7, 8, 33):
+        q = rng.randint(-8, 8, (5, k)).astype(onp.int8)
+        packed = qm.pack_int4(jnp.asarray(q))
+        assert packed.shape == (5, (k + 1) // 2)
+        assert str(packed.dtype) == "int8"
+        back = onp.asarray(qm.unpack_int4(packed, k))
+        assert (back == q).all(), (k, q, back)
+
+
+def test_pack_unpack_negative_saturation_at_minus8():
+    # the full two's-complement nibble range must round-trip,
+    # INCLUDING -8 (0b1000), the value a naive abs-based pack corrupts
+    q = onp.array([[-8, -8, -8], [7, -8, 7]], onp.int8)
+    back = onp.asarray(qm.unpack_int4(qm.pack_int4(jnp.asarray(q)), 3))
+    assert (back == q).all(), back
+
+
+def test_quantizer_never_emits_minus8():
+    # symmetric scheme: scale = amax/7, values clip to [-7, 7] — -8 is
+    # representable by the packers but never produced by the quantizer
+    w = jnp.asarray([[-1.0, 1.0, -0.5, 0.25]])
+    qt = qm.quantize_weight(w, 4)
+    vals = onp.asarray(qm.unpack_int4(qt.q, 4))
+    assert vals.min() >= -7 and vals.max() <= 7, vals
+
+
+# ---------------------------------------------------------------------------
+# per-channel scales
+# ---------------------------------------------------------------------------
+
+def test_per_channel_scale_broadcasting():
+    # channels with wildly different magnitudes: a per-TENSOR scheme
+    # would crush the small channel into zero; per-channel keeps each
+    # within its own LSB
+    rng = onp.random.RandomState(1)
+    w = onp.stack([rng.randn(16) * 1e-3, rng.randn(16) * 1.0,
+                   rng.randn(16) * 1e3]).astype(onp.float32)
+    qt = qm.quantize_weight(jnp.asarray(w), 8)
+    assert qt.scale.shape == (3,)
+    deq = onp.asarray(qm.dequantize_weight(qt))
+    for c in range(3):
+        amax = onp.abs(w[c]).max()
+        assert onp.abs(deq[c] - w[c]).max() <= amax / 127.0 + 1e-9, c
+
+
+def test_zero_channel_quantizes_to_zero():
+    w = jnp.asarray(onp.stack([onp.zeros(8), onp.ones(8)]), jnp.float32)
+    for bits in (8, 4):
+        qt = qm.quantize_weight(w, bits)
+        deq = onp.asarray(qm.dequantize_weight(qt))
+        assert (deq[0] == 0.0).all()
+        assert onp.allclose(deq[1], 1.0)
+
+
+def test_quantize_weight_validates():
+    with pytest.raises(MXNetError):
+        qm.quantize_weight(jnp.ones((2, 3)), bits=2)
+    with pytest.raises(MXNetError):
+        qm.quantize_weight(jnp.ones((2, 3, 4)), bits=8)
+
+
+# ---------------------------------------------------------------------------
+# fused dequant-matmul dispatch + grads
+# ---------------------------------------------------------------------------
+
+def test_quantized_matmul_matches_oracle():
+    rng = onp.random.RandomState(2)
+    x = jnp.asarray(rng.randn(4, 3, 33), jnp.float32)   # leading dims
+    w = jnp.asarray(rng.randn(17, 33), jnp.float32)
+    for bits in (8, 4):
+        qt = qm.quantize_weight(w, bits)
+        out = qm.quantized_matmul(x, qt)
+        ref = qm.quantized_matmul_reference(
+            x.reshape(-1, 33), qt).reshape(4, 3, 17)
+        assert out.shape == (4, 3, 17)
+        assert float(jnp.max(jnp.abs(out - ref))) == 0.0
+        # quantization error itself is bounded by the per-channel LSB
+        dense = x @ w.T
+        lsb = onp.abs(onp.asarray(w)).max(axis=1) / (127.0 if bits == 8
+                                                     else 7.0)
+        bound = 33 * onp.abs(onp.asarray(x)).max() * lsb.max()
+        assert float(jnp.max(jnp.abs(out - dense))) <= bound
+
+
+def test_quantized_matmul_shape_mismatch_raises():
+    qt = qm.quantize_weight(jnp.ones((4, 8)), 8)
+    with pytest.raises(MXNetError):
+        qm.quantized_matmul(jnp.ones((2, 9)), qt)
+    with pytest.raises(MXNetError):
+        qm.quantized_matmul(jnp.ones((2, 8)), jnp.ones((4, 8)))
+
+
+def test_quantized_matmul_grad_dx_only():
+    rng = onp.random.RandomState(3)
+    x = jnp.asarray(rng.randn(6, 16), jnp.float32)
+    qt = qm.quantize_weight(jnp.asarray(rng.randn(5, 16), jnp.float32), 8)
+    g = jax.grad(lambda xv: jnp.sum(qm.quantized_matmul(xv, qt) ** 2))(x)
+    w = qm.dequantize_weight(qt)
+    gref = jax.grad(lambda xv: jnp.sum((xv @ w.T) ** 2))(x)
+    assert float(jnp.max(jnp.abs(g - gref))) < 1e-5
+
+
+def test_quantized_matmul_under_jit_and_pytree():
+    # QuantizedTensor is a pytree node: it crosses jit boundaries as an
+    # argument (the serve step's calling convention)
+    rng = onp.random.RandomState(4)
+    x = jnp.asarray(rng.randn(3, 8), jnp.float32)
+    qt = qm.quantize_weight(jnp.asarray(rng.randn(7, 8), jnp.float32), 4)
+
+    @jax.jit
+    def f(xv, w):
+        return qm.quantized_matmul(xv, w)
+
+    out = f(x, qt)
+    assert float(jnp.max(jnp.abs(
+        out - qm.quantized_matmul_reference(x, qt)))) == 0.0
+    leaves = jax.tree_util.tree_leaves(qt)
+    assert len(leaves) == 2 and str(leaves[0].dtype) == "int8"
+
+
+def test_kernel_interpret_parity(monkeypatch):
+    monkeypatch.setenv("MXTPU_PALLAS", "kernel")
+    monkeypatch.setenv("MXTPU_PALLAS_INTERPRET", "1")
+    rng = onp.random.RandomState(5)
+    x = jnp.asarray(rng.randn(9, 45), jnp.float32)       # odd everything
+    w = jnp.asarray(rng.randn(21, 45), jnp.float32)
+    for bits in (8, 4):
+        qt = qm.quantize_weight(w, bits)
+        kern = qm.quantized_matmul(x, qt, use_kernel=True)
+        oracle = qm.quantized_matmul_reference(x, qt)
+        err = float(jnp.max(jnp.abs(kern - oracle)))
+        assert err <= 1e-4, (bits, err)
+
+
+def test_int8_act_matmul_dynamic_and_calibrated():
+    rng = onp.random.RandomState(6)
+    x = jnp.asarray(rng.randn(8, 24), jnp.float32)
+    w = jnp.asarray(rng.randn(12, 24), jnp.float32)
+    qt = qm.quantize_weight(w, 8)
+    ref = x @ w.T
+    dyn = qm.int8_act_matmul(x, qt)
+    rel = float(jnp.max(jnp.abs(dyn - ref)) / jnp.max(jnp.abs(ref)))
+    assert rel < 0.1, rel
+    # a calibrated threshold rides on the weight (LayerCalibrator path)
+    qt_cal = qm.quantize_weight(w, 8,
+                                act_amax=float(jnp.max(jnp.abs(x))))
+    cal = qm.int8_act_matmul(x, qt_cal)
+    assert float(jnp.max(jnp.abs(cal - dyn))) < 1e-5
+
+
+def test_act_quant_env_routes(monkeypatch):
+    monkeypatch.setenv("MXTPU_QUANT_ACT", "1")
+    rng = onp.random.RandomState(7)
+    x = jnp.asarray(rng.randn(4, 16), jnp.float32)
+    qt = qm.quantize_weight(jnp.asarray(rng.randn(6, 16), jnp.float32), 8)
+    env_routed = qm.quantized_matmul(x, qt)
+    explicit = qm.quantized_matmul(x, qt, act_quant=True)
+    assert float(jnp.max(jnp.abs(env_routed - explicit))) == 0.0
+    weight_only = qm.quantized_matmul(x, qt, act_quant=False)
+    assert float(jnp.max(jnp.abs(
+        weight_only - qm.quantized_matmul_reference(x, qt)))) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# decode-weight quantization
+# ---------------------------------------------------------------------------
+
+def _tiny_model():
+    from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from mxnet_tpu import random as mxrng
+    mxrng.seed(11)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=4, intermediate_size=64, max_position=32,
+                    dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.initialize()
+    model(mx.np.array([[1, 2]], dtype="int32"))
+    return model
+
+
+def test_quantize_decode_weights_targets_and_bytes():
+    from mxnet_tpu.serve.decode import (extract_decode_weights,
+                                        quantize_decode_weights,
+                                        decode_weight_bytes)
+    P = extract_decode_weights(_tiny_model())
+    f32 = decode_weight_bytes(P)
+    newP, info = quantize_decode_weights(P, 8)
+    assert info["bits"] == 8
+    assert info["scheme"] == "symmetric-per-channel"
+    # embeddings/norms stay f32 by default
+    assert "embed" in info["skipped"] and "pos" in info["skipped"]
+    assert not isinstance(newP["embed"], qm.QuantizedTensor)
+    for L in newP["layers"]:
+        for k in ("wqkv", "wo", "w1", "w2"):
+            assert isinstance(L[k], qm.QuantizedTensor), k
+        for k in ("ln1_g", "bqkv", "bo"):
+            assert not isinstance(L[k], qm.QuantizedTensor), k
+    assert decode_weight_bytes(newP) < f32
+    assert info["saved_bytes"] == info["f32_bytes"] - \
+        info["quantized_bytes"]
+    # opt-in embedding allowlist
+    inc, info2 = quantize_decode_weights(P, 8, include=("embed",))
+    assert isinstance(inc["embed"], qm.QuantizedTensor)
+    assert "embed" in info2["quantized"]
+
+
+def test_engine_quantized_agreement_and_gauges():
+    from mxnet_tpu.serve import InferenceEngine, ServeConfig
+    model = _tiny_model()
+    dense = InferenceEngine(model, ServeConfig(max_len=32, max_slots=2))
+    ref = dense.generate([1, 2, 3, 4], max_new_tokens=6)
+    e8 = InferenceEngine(model, ServeConfig(max_len=32, max_slots=2,
+                                            quant_bits=8))
+    toks = e8.generate([1, 2, 3, 4], max_new_tokens=6)
+    agree = sum(a == b for a, b in zip(toks, ref)) / len(ref)
+    assert agree >= 0.7, (toks, ref)
+    st = e8.stats()
+    assert st["quant_bits"] == 8
+    assert st["weight_bytes"] < dense.stats()["weight_bytes"]
+    # the freed weight bytes bought pages: capacity is visible in the
+    # allocator, not just a manifest claim
+    assert e8.allocator.total_pages > dense.allocator.total_pages
+    assert st["bonus_pages"] > 0
+    with pytest.raises(MXNetError):
+        e8.quantize_weights(8)      # double-quantize refused
+    with pytest.raises(MXNetError):
+        InferenceEngine(model, ServeConfig(max_len=32, quant_bits=5))
+
+
+@pytest.mark.export
+@pytest.mark.slow
+def test_quantize_pass_roundtrip_fresh_engine(tmp_path):
+    from mxnet_tpu.export import QuantizePass
+    from mxnet_tpu.serve import InferenceEngine, ServeConfig
+    model = _tiny_model()
+    art = str(tmp_path / "q8")
+    eng = InferenceEngine(model, ServeConfig(max_len=32, max_slots=2))
+    eng.warmup()
+    eng.export(art, passes=[QuantizePass(bits=8)])
+    man = json.load(open(os.path.join(art, "manifest.json")))
+    assert man["quant"]["bits"] == 8
+    assert man["quant"]["scheme"] == "symmetric-per-channel"
+    assert man["quant"]["skipped"]
+    captured = eng.generate([5, 6, 7], max_new_tokens=6)
+
+    loaded = InferenceEngine(model, ServeConfig(max_len=32, max_slots=2,
+                                                quant_bits=8))
+    loaded.warmup(artifact=art)
+    assert loaded.generate([5, 6, 7], max_new_tokens=6) == captured
+    # scheme mismatch fails fast in BOTH directions
+    dense = InferenceEngine(model, ServeConfig(max_len=32, max_slots=2))
+    with pytest.raises(MXNetError, match="quant"):
+        dense.load_export(art)
+    e4 = InferenceEngine(model, ServeConfig(max_len=32, max_slots=2,
+                                            quant_bits=4))
+    with pytest.raises(MXNetError):
+        e4.load_export(art)
+
+
+def test_quantize_pass_rejects_train_capture():
+    from mxnet_tpu.export import QuantizePass
+    with pytest.raises(MXNetError):
+        QuantizePass(bits=8)(object())
+    with pytest.raises(MXNetError):
+        QuantizePass(bits=2)
+
+
+# ---------------------------------------------------------------------------
+# int8 gradient compression
+# ---------------------------------------------------------------------------
+
+def test_resolve_grad_compress():
+    from mxnet_tpu.parallel import compress
+    assert compress.resolve_grad_compress(None) == "none"
+    assert compress.resolve_grad_compress("int8") == "int8"
+    assert compress.resolve_grad_compress("off") == "none"
+    with pytest.raises(MXNetError):
+        compress.resolve_grad_compress("int4")
+
+
+def test_bucketed_quantization_error_bound():
+    from mxnet_tpu.parallel import compress
+    rng = onp.random.RandomState(8)
+    g = jnp.asarray(rng.randn(5, 1000) * 10.0, jnp.float32)
+    key = jax.random.PRNGKey(0)
+    q, scale, meta = compress.quantize_bucketed(g, key, bucket=256)
+    assert str(q.dtype) == "int8"
+    back = compress.dequantize_bucketed(q, scale, meta)
+    assert back.shape == g.shape
+    # stochastic rounding is within one LSB of the true value per
+    # element (scale is per 256-element bucket)
+    per_elem_scale = onp.repeat(onp.asarray(scale),
+                                256)[:g.size].reshape(5, 1000)
+    err = onp.abs(onp.asarray(back - g))
+    assert (err <= per_elem_scale + 1e-6).all()
+
+
+def test_bucketed_rounding_is_unbiased():
+    from mxnet_tpu.parallel import compress
+    # a constant value exactly between two int8 codes must round up
+    # about half the time — the unbiasedness stochastic rounding buys
+    g = jnp.full((4096,), 0.5 * 127.0 / 127.0, jnp.float32)
+    g = g.at[0].set(1.0)   # pins amax -> scale = 1/127
+    q, scale, meta = compress.quantize_bucketed(
+        g, jax.random.PRNGKey(1), bucket=4096)
+    back = onp.asarray(compress.dequantize_bucketed(q, scale, meta))
+    mean = back[1:].mean()
+    assert abs(mean - 0.5) < 0.02, mean
+
+
+def test_compress_tree_preserves_structure_and_zero():
+    from mxnet_tpu.parallel import compress
+    tree = {"a": jnp.zeros((7,), jnp.float32),
+            "b": {"c": jnp.asarray([1.0, -2.0, 3.0], jnp.float32)},
+            "i": jnp.asarray([1, 2], jnp.int32)}
+    out = compress.compress_tree(tree, jax.random.PRNGKey(2))
+    assert (onp.asarray(out["a"]) == 0.0).all()
+    assert out["i"] is tree["i"]            # non-float leaves untouched
+    assert out["b"]["c"].dtype == jnp.float32
+    rel = onp.abs(onp.asarray(out["b"]["c"]) -
+                  onp.asarray(tree["b"]["c"])).max() / 3.0
+    assert rel <= 1.0 / 127.0 + 1e-6
+
+
+@pytest.mark.export
+@pytest.mark.slow
+def test_old_artifact_without_grad_compress_flag_refused(tmp_path):
+    # a pre-PR-13 train artifact records NO grad_compress key in its
+    # module meta; loading it into a compressed step must refuse (not
+    # silently train uncompressed)
+    from mxnet_tpu import optimizer as opt, random as mxrng
+    from mxnet_tpu.parallel import make_mesh, make_sharded_train_step
+    from mxnet_tpu.gluon import nn
+
+    def build(compress):
+        mxrng.seed(5)
+        net = nn.Dense(2)
+        net.initialize()
+        x = mx.np.array(onp.ones((4, 3), "float32"))
+        y = mx.np.array(onp.zeros((4, 2), "float32"))
+        net(x)
+
+        def loss_fn(out, xv, yv):
+            o = out._data if hasattr(out, "_data") else out
+            t = yv._data if hasattr(yv, "_data") else yv
+            return jnp.mean((o - t) ** 2)
+
+        mesh = make_mesh({"dp": 1}, jax.devices()[:1])
+        return make_sharded_train_step(
+            net, opt.SGD(learning_rate=0.1), loss_fn, mesh,
+            num_model_args=1, grad_compress=compress), x, y
+
+    step, x, y = build(None)
+    art = str(tmp_path / "old")
+    step.export(art, x, y)
+    man_path = os.path.join(art, "manifest.json")
+    man = json.load(open(man_path))
+    for rec in man["modules"].values():        # simulate a pre-PR file
+        rec["meta"].pop("grad_compress", None)
+    with open(man_path, "w") as f:
+        json.dump(man, f)
+    step8, x, y = build("int8")
+    with pytest.raises(MXNetError, match="grad_compress"):
+        step8.load_export(art, x, y)
+
+
+@pytest.mark.slow
+def test_grad_compress_step_converges():
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu.parallel import make_mesh, make_sharded_train_step
+    from mxnet_tpu.gluon import nn
+
+    def run(compress):
+        from mxnet_tpu import random as mxrng
+        mxrng.seed(3)
+        net = nn.Dense(1)
+        net.initialize()
+        rng = onp.random.RandomState(3)
+        x = mx.np.array(rng.randn(32, 8).astype("float32"))
+        y = mx.np.array(rng.randn(32, 1).astype("float32"))
+        net(x)
+
+        def loss_fn(out, xv, yv):
+            o = out._data if hasattr(out, "_data") else out
+            t = yv._data if hasattr(yv, "_data") else yv
+            return jnp.mean((o - t) ** 2)
+
+        mesh = make_mesh({"dp": 1}, jax.devices()[:1])
+        step = make_sharded_train_step(net, opt.SGD(learning_rate=0.05),
+                                       loss_fn, mesh, num_model_args=1,
+                                       grad_compress=compress)
+        losses = [float(jax.device_get(step.dispatch(x, y).loss))
+                  for _ in range(10)]
+        assert step.trace_count == 1
+        return losses
+
+    f32 = run(None)
+    q = run("int8")
+    assert q[-1] < q[0]
+    assert abs(q[-1] - f32[-1]) / max(1e-9, f32[-1]) < 0.25, (f32, q)
