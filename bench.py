@@ -251,12 +251,54 @@ def _measure(platform: str) -> dict:
     }
 
 
-def _measure_serve() -> dict:
-    """`bench.py --serve`: throughput + tail-TTFT of the serving stack
-    under simulated concurrent-request load (CPU-sized model unless a
-    TPU is attached).  Reports tokens/sec across the whole run and
-    p50/p99 time-to-first-token over the request population — the two
-    numbers the "millions of users" north star is graded on."""
+def _decode_rate_pcts(handles) -> dict:
+    """Per-request DECODE tokens/sec (first token -> last token; the
+    number speculation moves, reported per stream so a tail win is
+    visible even when aggregate tokens/s is flat)."""
+    rates = sorted(
+        (len(h.tokens) - 1) / (h.finished_ts - h.first_token_ts)
+        for h in handles
+        if h.first_token_ts is not None and h.finished_ts is not None
+        and len(h.tokens) > 1 and h.finished_ts > h.first_token_ts)
+
+    def pct(p):
+        if not rates:
+            return None
+        return round(rates[min(len(rates) - 1,
+                               int(p * (len(rates) - 1)))], 2)
+
+    return {"decode_tok_s_p50": pct(0.50), "decode_tok_s_p99": pct(0.99)}
+
+
+def _spec_prompts(rng, cfg, n_req: int):
+    """Shared-prefix workload mix: 3 prompt families sharing a long
+    common prefix (the prefix-cache target) + unique tails, plus a few
+    fully random prompts — the realistic many-users-one-template
+    shape."""
+    fams = [rng.randint(0, cfg.vocab_size, 24).tolist() for _ in range(3)]
+    prompts = []
+    for i in range(n_req):
+        if i % 4 == 3:
+            prompts.append(rng.randint(0, cfg.vocab_size,
+                                       rng.randint(4, 32)).tolist())
+        else:
+            prompts.append(fams[i % 3]
+                           + rng.randint(0, cfg.vocab_size,
+                                         rng.randint(2, 8)).tolist())
+    return prompts
+
+
+def _measure_serve(spec: int = 0) -> dict:
+    """`bench.py --serve [--spec k]`: throughput + tail-TTFT of the
+    serving stack under simulated concurrent-request load (CPU-sized
+    model unless a TPU is attached).  Reports tokens/sec across the
+    whole run and p50/p99 time-to-first-token over the request
+    population — the two numbers the "millions of users" north star is
+    graded on — plus per-request decode tokens/s percentiles.  With
+    ``--spec k`` the engine runs k-token speculative decoding AND the
+    cross-request prefix cache over a shared-prefix workload mix,
+    reporting accept-rate / steps-per-token / prefix-hit extras
+    (docs/serving.md "Speculative decoding & prefix caching")."""
     import jax
     # pin the backend BEFORE jax initializes (touching jax.devices()
     # first would lock in whatever default exists — e.g. a GPU — and a
@@ -287,13 +329,17 @@ def _measure_serve() -> dict:
     model.initialize()
     model(mx.np.array([[1, 2]], dtype="int32"))
 
-    eng = InferenceEngine(model, ServeConfig(max_len=max_len))
+    eng = InferenceEngine(model, ServeConfig(
+        max_len=max_len, spec_tokens=spec, prefix_cache=spec > 0))
     compile_s = eng.warmup()
 
     rng = _onp.random.RandomState(0)
-    prompts = [rng.randint(0, cfg.vocab_size,
-                           rng.randint(4, 48)).tolist()
-               for _ in range(n_req)]
+    if spec > 0:
+        prompts = _spec_prompts(rng, cfg, n_req)
+    else:
+        prompts = [rng.randint(0, cfg.vocab_size,
+                               rng.randint(4, 48)).tolist()
+                   for _ in range(n_req)]
     # staggered arrival: a burst up front, then one request every other
     # step — the queue stays non-empty while slots churn (the
     # continuous-batching regime, not a static batch)
@@ -341,7 +387,16 @@ def _measure_serve() -> dict:
         "slots": eng.serve_config.max_slots,
         "device": getattr(dev, "device_kind", str(dev)),
         "platform": dev.platform,
+        # actual fused launches per emitted token (the loop's `steps`
+        # count includes idle polls during staggered arrivals)
+        "steps_per_token": round(eng.scheduler._steps / max(1, toks), 4),
+        **_decode_rate_pcts(handles),
     }
+    if spec > 0:
+        extras["spec"] = eng.scheduler.spec_stats()
+        extras["spec"]["spec_tokens"] = spec
+        if eng.prefix_index is not None:
+            extras["prefix_cache"] = eng.prefix_index.stats()
     # quantized capacity table (ROADMAP item 2): weight bytes + MEASURED
     # max-concurrent-pages at each weight precision — engines are cheap
     # to construct (no warmup), and the auto pool sizing converts the
@@ -391,13 +446,17 @@ def _measure_serve() -> dict:
     }
 
 
-def _measure_serve_fleet(replicas: int, kill_at: float) -> dict:
-    """`bench.py --serve --replicas N [--kill-at S]`: aggregate fleet
-    throughput + tail-TTFT UNDER REPLICA LOSS (the ROADMAP item 1
-    metric).  One replica is killed `kill_at` seconds into the load
-    window; its in-flight streams fail over to survivors, and the run
-    must still report nonzero aggregate tokens/s and a finite p99 TTFT
-    measured across the whole population — loss window included."""
+def _measure_serve_fleet(replicas: int, kill_at: float,
+                         spec: int = 0) -> dict:
+    """`bench.py --serve --replicas N [--kill-at S] [--spec k]`:
+    aggregate fleet throughput + tail-TTFT UNDER REPLICA LOSS (the
+    ROADMAP item 1 metric).  One replica is killed `kill_at` seconds
+    into the load window; its in-flight streams fail over to survivors,
+    and the run must still report nonzero aggregate tokens/s and a
+    finite p99 TTFT measured across the whole population — loss window
+    included.  ``--spec k`` turns on per-replica speculative decoding +
+    prefix caching (with router prefix affinity) over the shared-prefix
+    mix and reports the fleet-aggregate accept rate."""
     import jax
     ambient = os.environ.get("JAX_PLATFORMS", "").lower()
     if not any(t in ambient for t in ("tpu", "axon")):
@@ -425,13 +484,18 @@ def _measure_serve_fleet(replicas: int, kill_at: float) -> dict:
     model(mx.np.array([[1, 2]], dtype="int32"))
 
     fleet = ServeFleet(model, replicas=replicas,
-                       config=ServeConfig(max_len=max_len))
+                       config=ServeConfig(max_len=max_len,
+                                          spec_tokens=spec,
+                                          prefix_cache=spec > 0))
     compile_s = fleet.warmup()
 
     rng = _onp.random.RandomState(0)
-    prompts = [rng.randint(0, cfg.vocab_size,
-                           rng.randint(4, 48)).tolist()
-               for _ in range(n_req)]
+    if spec > 0:
+        prompts = _spec_prompts(rng, cfg, n_req)
+    else:
+        prompts = [rng.randint(0, cfg.vocab_size,
+                               rng.randint(4, 48)).tolist()
+                   for _ in range(n_req)]
     handles = []
     killed = None
     # pace arrivals so the load window straddles the kill: with
@@ -503,7 +567,24 @@ def _measure_serve_fleet(replicas: int, kill_at: float) -> dict:
                            for n, r in stats["replicas"].items()},
         "device": getattr(dev, "device_kind", str(dev)),
         "platform": dev.platform,
+        **_decode_rate_pcts(handles),
     }
+    if spec > 0:
+        # fleet-aggregate speculation outcome (dead replicas included —
+        # their accepted tokens were streamed before the loss)
+        agg = {"proposed": 0, "accepted": 0, "steps": 0, "tokens": 0,
+               "prefix_hit_tokens": 0, "cow_forks": 0}
+        for rep in fleet.replicas:
+            ss = rep.engine.scheduler.spec_stats()
+            for k in agg:
+                agg[k] += ss[k] or 0
+        agg["accept_rate"] = (round(agg["accepted"] / agg["proposed"], 4)
+                              if agg["proposed"] else None)
+        agg["steps_per_token"] = (round(agg["steps"]
+                                        / agg["tokens"], 4)
+                                  if agg["tokens"] else None)
+        agg["spec_tokens"] = spec
+        extras["spec"] = agg
     return {
         "metric": "serve_fleet_tokens_per_sec",
         "value": round(toks / wall, 2),
@@ -1211,6 +1292,11 @@ def main():
         # harmless extra serialization when the backend resolves to CPU
         _wait_for_claim_lock()
         with _ClaimLock():
+            # --spec k: k-token speculative decoding + cross-request
+            # prefix caching over a shared-prefix workload mix
+            # (docs/serving.md "Speculative decoding & prefix caching")
+            spec = int(_flag_operand("--spec", "0")) \
+                if "--spec" in sys.argv else 0
             if "--replicas" in sys.argv:
                 # fleet mode: aggregate tokens/s + tail TTFT under
                 # replica loss (docs/serving.md "Fleet, failover &
@@ -1219,9 +1305,10 @@ def main():
                 print(json.dumps(_measure_serve_fleet(
                     int(_flag_operand("--replicas", "2")),
                     (float(_flag_operand("--kill-at", "0"))
-                     if "--kill-at" in sys.argv else None))))
+                     if "--kill-at" in sys.argv else None),
+                    spec=spec)))
             else:
-                print(json.dumps(_measure_serve()))
+                print(json.dumps(_measure_serve(spec=spec)))
         return
 
     _wait_for_claim_lock()

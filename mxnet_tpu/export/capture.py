@@ -415,16 +415,17 @@ class ServeCapture:
 
 
 def _capture_serve_modules(engine, art: ExportArtifact) -> None:
-    """(Re-)export an engine's fused step at both chunk widths into
-    `art`, refreshing the manifest's engine-identity records."""
+    """(Re-)export an engine's fused step at every compiled chunk width
+    (prefill chunk, decode C=1, and the speculative verify width when
+    ``spec_tokens`` is set) into `art`, refreshing the manifest's
+    engine-identity records."""
     from jax import export as jexport
-    sc = engine.serve_config
     # the engine's own identity dict — load_export compares against the
     # same method, so the two sides cannot drift
     art.manifest["meta"]["serve_config"] = engine._export_config()
     if engine.quant_info is not None:
         art.manifest["quant"] = dict(engine.quant_info)
-    for C in sorted({sc.prefill_chunk, 1}):
+    for C in engine._step_widths():
         fn = engine._step_fn(C)
         avals = engine._step_avals(C)
         exp = jexport.export(fn)(*avals)

@@ -21,17 +21,29 @@ worker processes and the trainer through POSIX shared memory
 The parent preserves batch order (a reorder buffer keyed on batch id) and
 bounds each wait with the loader timeout, like the thread-pool path.
 
-Supervision: each worker has its own task queue so the parent knows
-exactly which batch ids are in flight where. `ProcessPool.get` polls the
-result queue in short slices and checks worker liveness on each empty
-slice, so a worker killed by the OOM killer (or a segfaulting native
-transform) is detected immediately — not after the full timeout with a
-misleading "transform is stuck" error. Dead workers are respawned and
-their in-flight batches resubmitted, a bounded number of times
-(`max_respawns`), before a precise error naming the dead worker and its
-exit code is raised. Workers name their segments ``mxtpu-<pid>-<seq>`` so
-the parent can reclaim a killed worker's half-shipped segments from
-``/dev/shm`` instead of leaking them.
+Supervision: each worker has its own task queue AND its own result
+queue, so the parent knows exactly which batch ids are in flight where.
+`ProcessPool.get` polls every worker's result queue in short slices and
+checks worker liveness on each empty round, so a worker killed by the
+OOM killer (or a segfaulting native transform) is detected immediately —
+not after the full timeout with a misleading "transform is stuck" error.
+Dead workers are respawned and their in-flight batches resubmitted, a
+bounded number of times (`max_respawns`), before a precise error naming
+the dead worker and its exit code is raised. Workers name their segments
+``mxtpu-<pid>-<seq>`` so the parent can reclaim a killed worker's
+half-shipped segments from ``/dev/shm`` instead of leaking them.
+
+Why per-worker RESULT queues (not one shared queue): a
+``multiprocessing.Queue`` serializes writers through a cross-process
+write lock, and SIGKILL can land while the victim's feeder thread HOLDS
+it — the lock is then held forever, every surviving worker blocks in
+``put``, and the parent times out with "all workers alive" while their
+finished segments pile up in /dev/shm (the exact flake
+``test_mp_dataloader_survives_sigkilled_worker`` showed when its file
+ran whole).  With one queue per worker a killed writer can only wedge
+its OWN queue, which is discarded with it; its in-flight batches are
+resubmitted to the respawn's fresh queue and everyone else keeps
+delivering.
 """
 from __future__ import annotations
 
@@ -239,14 +251,16 @@ def _worker_main(blob: bytes, task_q, data_q):
 
 
 class _Worker:
-    """Parent-side handle: process + private task queue + in-flight ids."""
+    """Parent-side handle: process + private task/result queues +
+    in-flight ids."""
 
-    __slots__ = ("idx", "proc", "task_q", "assigned")
+    __slots__ = ("idx", "proc", "task_q", "data_q", "assigned")
 
-    def __init__(self, idx, proc, task_q):
+    def __init__(self, idx, proc, task_q, data_q):
         self.idx = idx
         self.proc = proc
         self.task_q = task_q
+        self.data_q = data_q
         self.assigned = set()
 
 
@@ -258,7 +272,6 @@ class ProcessPool:
                  max_respawns: int = None):
         import multiprocessing as mp
         self._ctx = mp.get_context("spawn")
-        self._data_q = self._ctx.Queue()
         self._blob = pickle.dumps((dataset, batchify_fn),
                                   protocol=pickle.HIGHEST_PROTOCOL)
         self._workers = [self._spawn(i) for i in range(num_workers)]
@@ -275,11 +288,15 @@ class ProcessPool:
 
     def _spawn(self, idx: int) -> _Worker:
         task_q = self._ctx.Queue()
+        # private result queue: a SIGKILL mid-put can strand this
+        # queue's write lock, but only THIS worker writes to it — the
+        # queue dies with the worker and nobody else wedges
+        data_q = self._ctx.Queue()
         proc = self._ctx.Process(
-            target=_worker_main, args=(self._blob, task_q, self._data_q),
+            target=_worker_main, args=(self._blob, task_q, data_q),
             daemon=True, name=f"mxtpu-dl-worker-{idx}")
         proc.start()
-        return _Worker(idx, proc, task_q)
+        return _Worker(idx, proc, task_q, data_q)
 
     def submit(self, indices) -> None:
         indices = list(indices)
@@ -341,6 +358,15 @@ class ProcessPool:
                 w.idx, w.proc.pid, code,
                 "resubmitting" if resubmit else "abandoning", lost,
                 self._respawns_left, self._max_respawns)
+            # the dead worker's result queue goes with it: a SIGKILL
+            # mid-put may have corrupted its stream (or stranded its
+            # write lock), and every batch it still owed is resubmitted
+            # below — duplicates from a drained queue would be discarded
+            # anyway, so nothing of value is lost with it
+            try:
+                w.data_q.close()
+            except Exception:
+                pass
             neww = self._spawn(w.idx)
             self._workers[slot] = neww
             if _tele.enabled():
@@ -410,38 +436,70 @@ class ProcessPool:
             self._failed.discard(self._next_yield)
             self._next_yield += 1
 
+    def _poll_queues(self, raise_errors: bool = True) -> bool:
+        """Drain whatever every live worker has delivered (non-blocking
+        round over the per-worker result queues).  Returns True when at
+        least one item was folded in."""
+        got = False
+        for w in list(self._workers):
+            while True:
+                try:
+                    item = w.data_q.get_nowait()
+                except _queue_mod.Empty:
+                    break
+                except (OSError, ValueError):
+                    break      # queue torn down under us (worker died)
+                self._receive(*item, raise_errors=raise_errors)
+                got = True
+        return got
+
+    def _wait_any(self, timeout: float) -> None:
+        """Block until ANY worker's result queue has data (or `timeout`
+        lapses) — arrival-triggered wakeup, so a batch landing 5 ms
+        into the wait is consumed at 5 ms, not at the next fixed poll
+        tick.  Falls back to a short sleep if the queues' reader
+        connections are unavailable (non-CPython Queue internals)."""
+        try:
+            from multiprocessing.connection import wait as _conn_wait
+            readers = [w.data_q._reader for w in self._workers]
+            _conn_wait(readers, timeout=timeout)
+        except (AttributeError, OSError, ValueError):
+            time.sleep(min(0.02, timeout))
+
     # -- consumption -----------------------------------------------------
     def get(self, to_array, timeout: float):
-        """Next batch in submission order (reorder buffer over the queue).
-        Polls in `_POLL` slices so a dead worker is detected (and its
-        batches resubmitted) immediately instead of after `timeout`."""
+        """Next batch in submission order (reorder buffer over the
+        per-worker result queues).  Polls in `_POLL` slices so a dead
+        worker is detected (and its batches resubmitted) immediately
+        instead of after `timeout`."""
         from ...base import MXNetError
         self._skip_failed()
         want = self._next_yield
         t_start = time.monotonic()
         deadline = t_start + timeout
         while want not in self._reorder:
-            try:
-                item = self._data_q.get(timeout=min(_POLL, timeout))
-            except _queue_mod.Empty:
-                respawned, _ = self._check_workers()
-                if respawned:
-                    # recomputation gets a fresh budget
-                    deadline = time.monotonic() + timeout
-                    continue
-                if time.monotonic() >= deadline:
-                    raise MXNetError(
-                        f"DataLoader worker batch timed out after "
-                        f"{timeout}s (num_workers={len(self._workers)}, "
-                        f"all workers alive); a dataset transform is "
-                        f"stuck or too slow — raise `timeout=` or debug "
-                        f"the transform")
+            if self._poll_queues():
+                # timeout bounds the gap between ARRIVALS, not the total
+                # wait for this batch id: a slow batch must not time out
+                # while the other workers deliver steadily (the pipeline
+                # is healthy)
+                deadline = time.monotonic() + timeout
                 continue
-            self._receive(*item)
-            # timeout bounds the gap between ARRIVALS, not the total wait
-            # for this batch id: a slow batch must not time out while the
-            # other workers deliver steadily (the pipeline is healthy)
-            deadline = time.monotonic() + timeout
+            respawned, _ = self._check_workers()
+            if respawned:
+                # recomputation gets a fresh budget
+                deadline = time.monotonic() + timeout
+                continue
+            if time.monotonic() >= deadline:
+                raise MXNetError(
+                    f"DataLoader worker batch timed out after "
+                    f"{timeout}s (num_workers={len(self._workers)}, "
+                    f"all workers alive); a dataset transform is "
+                    f"stuck or too slow — raise `timeout=` or debug "
+                    f"the transform")
+            # bounded by _POLL so dead-worker detection stays prompt,
+            # but wakes immediately on any arrival
+            self._wait_any(min(_POLL, timeout))
         tree = self._reorder.pop(want)
         self._next_yield += 1
         if _tele.enabled():
@@ -474,23 +532,21 @@ class ProcessPool:
             if self._next_yield in abandoned:
                 self._next_yield += 1   # died with its worker; not coming
                 continue
-            try:
-                item = self._data_q.get(timeout=min(_POLL, timeout))
-            except _queue_mod.Empty:
-                # dead workers are replaced for free here — their batches
-                # are being discarded, so this is epoch-boundary
-                # housekeeping, not failure recovery (no budget, no
-                # resubmission)
-                respawned, lost = self._check_workers(resubmit=False)
-                if respawned:
-                    abandoned |= lost
-                    deadline = time.monotonic() + timeout
-                    continue
-                if time.monotonic() >= deadline:
-                    break   # worker wedged; shutdown() will clean up
+            if self._poll_queues(raise_errors=False):
+                deadline = time.monotonic() + timeout
                 continue
-            self._receive(*item, raise_errors=False)
-            deadline = time.monotonic() + timeout
+            # dead workers are replaced for free here — their batches
+            # are being discarded, so this is epoch-boundary
+            # housekeeping, not failure recovery (no budget, no
+            # resubmission)
+            respawned, lost = self._check_workers(resubmit=False)
+            if respawned:
+                abandoned |= lost
+                deadline = time.monotonic() + timeout
+                continue
+            if time.monotonic() >= deadline:
+                break   # worker wedged; shutdown() will clean up
+            self._wait_any(min(_POLL, timeout))
         # a worker that died IDLE (nothing in flight) never forces an
         # Empty poll above — sweep for corpses so the new epoch starts
         # with a full complement instead of assigning batches to one
@@ -522,12 +578,13 @@ class ProcessPool:
                 w.proc.join(timeout=1)
         # drain in-flight and buffered segments so nothing leaks /dev/shm
         self._reorder.clear()
-        try:
-            while True:
-                _bid, spec, _err = self._data_q.get_nowait()
-                if spec is not None:
-                    self._discard(spec)
-        except Exception:
-            pass
+        for w in self._workers:
+            try:
+                while True:
+                    _bid, spec, _err = w.data_q.get_nowait()
+                    if spec is not None:
+                        self._discard(spec)
+            except Exception:
+                pass
         for w in self._workers:
             _cleanup_worker_shm(w.proc.pid)

@@ -20,15 +20,17 @@ diverge.  See docs/serving.md.
 from .decode import (  # noqa: F401
     extract_decode_weights, transformer_step, lm_logits,
 )
-from .kv_cache import KVPools, PageAllocator  # noqa: F401
+from .kv_cache import KVPools, PageAllocator, PrefixIndex  # noqa: F401
 from .scheduler import ContinuousBatchingScheduler, ServeRequest  # noqa: F401
+from .spec import Drafter, NGramDrafter  # noqa: F401
 from .engine import InferenceEngine, ServeConfig  # noqa: F401
 from .router import RequestRouter, ShedError  # noqa: F401
 from .fleet import Replica, ServeFleet  # noqa: F401
 
 __all__ = [
     "InferenceEngine", "ServeConfig", "ContinuousBatchingScheduler",
-    "ServeRequest", "KVPools", "PageAllocator", "extract_decode_weights",
+    "ServeRequest", "KVPools", "PageAllocator", "PrefixIndex",
+    "Drafter", "NGramDrafter", "extract_decode_weights",
     "transformer_step", "lm_logits",
     "ServeFleet", "Replica", "RequestRouter", "ShedError",
 ]

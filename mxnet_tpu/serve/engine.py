@@ -45,8 +45,10 @@ from .. import telemetry as _tele
 from .. import tracing as _trace
 from .decode import (extract_decode_weights, transformer_step, lm_logits,
                      quantize_decode_weights, decode_weight_bytes)
-from .kv_cache import KVPools, PageAllocator, make_paged_kv_fn
+from .kv_cache import (KVPools, PageAllocator, PrefixIndex,
+                       make_paged_kv_fn)
 from .scheduler import ContinuousBatchingScheduler, ServeRequest
+from .spec import Drafter, NGramDrafter
 
 __all__ = ["ServeConfig", "InferenceEngine"]
 
@@ -100,6 +102,19 @@ class ServeConfig:
     # kernel (docs/quantization.md).  0 = dense f32 weights.
     quant_bits: int = field(
         default_factory=lambda: _env_int("MXTPU_QUANT_BITS", 0))
+    # speculative decoding: k > 0 lets a drafter propose k tokens per
+    # decode slot, verified by ONE fused launch at width k+1 (greedy
+    # streams stay bit-identical — docs/serving.md).  Program-shaping:
+    # part of the compiled-width set and the export identity.
+    spec_tokens: int = field(
+        default_factory=lambda: _env_int("MXTPU_SPEC_TOKENS", 0))
+    # cross-request prefix caching: finished prompt prefills register
+    # their full KV blocks in a PrefixIndex; a new request whose prompt
+    # shares a cached prefix attaches those pages by reference (COW on
+    # first write) and skips the matching prefill chunks entirely.
+    # Host-side policy only — the compiled program is unchanged.
+    prefix_cache: bool = field(
+        default_factory=lambda: _env_int("MXTPU_PREFIX_CACHE", 0) > 0)
     # engine-wide sampling filter (static: part of the compiled step)
     top_k: int = 0
     top_p: float = 1.0
@@ -115,13 +130,25 @@ class ServeConfig:
             raise MXNetError(
                 f"quant_bits must be 0 (dense), 8, or 4; got "
                 f"{self.quant_bits} (MXTPU_QUANT_BITS)")
+        if self.spec_tokens < 0:
+            raise MXNetError(
+                f"spec_tokens must be >= 0, got {self.spec_tokens} "
+                f"(MXTPU_SPEC_TOKENS)")
 
 
 class InferenceEngine:
-    """Continuous-batching inference over a GPT-style causal LM."""
+    """Continuous-batching inference over a GPT-style causal LM.
+
+    ``drafter`` (docs/serving.md "Speculative decoding & prefix
+    caching"): the token-proposal hook used when
+    ``ServeConfig.spec_tokens`` > 0; defaults to the model-free
+    :class:`~mxnet_tpu.serve.spec.NGramDrafter` over each request's own
+    context.  A learned draft model plugs in through the same
+    `Drafter` interface."""
 
     def __init__(self, model, config: Optional[ServeConfig] = None,
-                 seed: int = 0, act_thresholds=None):
+                 seed: int = 0, act_thresholds=None,
+                 drafter: Optional[Drafter] = None):
         self.model = model
         self.cfg = model.cfg
         self.serve_config = config or ServeConfig()
@@ -166,6 +193,14 @@ class InferenceEngine:
             cfg.num_layers, num_pages, sc.page_size, self.n_kv_heads,
             self.head_dim, dtype=kv_dtype)
         self.allocator = PageAllocator(num_pages, sc.page_size)
+        #: cross-request prompt-prefix cache (MXTPU_PREFIX_CACHE):
+        #: shared read-only page runs with COW forks; None when off
+        self.prefix_index = (PrefixIndex(self.allocator, sc.page_size)
+                             if sc.prefix_cache else None)
+        #: speculative-decoding proposal hook (MXTPU_SPEC_TOKENS)
+        self.drafter = drafter if drafter is not None else (
+            NGramDrafter() if sc.spec_tokens > 0 else None)
+        self._cow_fn = None        # lazy jitted page-copy (COW forks)
         self.scheduler = ContinuousBatchingScheduler(self)
         self._key = jax.random.PRNGKey(seed)
         self.compile_seconds = None
@@ -237,6 +272,12 @@ class InferenceEngine:
                     dtype=self._kv_dtype)
                 self.allocator = PageAllocator(num_pages, sc.page_size)
                 self.bonus_pages = bonus
+                if getattr(self, "prefix_index", None) is not None:
+                    # the old index references the replaced allocator
+                    # and pool; start empty over the new ones (idle
+                    # engine — nothing was attached)
+                    self.prefix_index = PrefixIndex(self.allocator,
+                                                    sc.page_size)
                 if sched is not None:
                     sched.allocator = self.allocator
         self._note_weight_bytes()
@@ -271,6 +312,7 @@ class InferenceEngine:
         pool_names = self.pools.names
         top_k, top_p = sc.top_k, sc.top_p
         max_pos = cfg.max_position
+        spec_k = sc.spec_tokens
 
         def step(P, pools_t, tok, num_tokens, start_pos, page_tables,
                  ctx_lens, temps, greedy_mask, key):
@@ -293,11 +335,45 @@ class InferenceEngine:
             sampled = jax.random.categorical(
                 key, filtered, axis=-1).astype(jnp.int32)
             nxt = jnp.where(greedy_mask, greedy_tok, sampled)
+            if spec_k > 0:
+                # speculative verification: the greedy argmax at the
+                # TAIL fed positions (B, T), T = min(C, k+1) — the emit
+                # loop only ever reads a slot's last 1 + draft_len fed
+                # positions (the fed sequence token + its drafts), so
+                # computing the vocab-sized LM head at every prefill
+                # position would multiply discarded work by ~C/k.
+                # Column t is fed position num_tokens - T + t (t = T-1
+                # is the `last` row).  Tail position t's argmax is the
+                # true greedy continuation of the fed prefix before it
+                # (causal attention makes it independent of fed tokens
+                # after it), so the scheduler can accept a run of
+                # matching drafts and stay bit-identical to one-token
+                # decode.  Each row goes through the SAME (B, E) 2-D
+                # LM-head matmul shape as `last` — a 3-D (B, C, E)
+                # matmul could tile differently and flip a near-tie
+                # argmax.
+                T = min(C, spec_k + 1)
+                all_tok = jnp.stack(
+                    [jnp.argmax(lm_logits(
+                        P, h[jnp.arange(B),
+                             jnp.maximum(num_tokens - T + j, 0)]),
+                        axis=-1)
+                     for j in range(T)], axis=1).astype(jnp.int32)
+                return tuple(pools[n] for n in pool_names), nxt, all_tok
             return tuple(pools[n] for n in pool_names), nxt
 
         fn = jax.jit(step, donate_argnums=(1,))
         self._step_fns[C] = fn
         return fn
+
+    def _step_widths(self):
+        """Chunk widths the engine compiles: the prefill chunk, the
+        pure-decode C=1 step, and (speculation on) the k+1-wide
+        verification row — part of the export identity."""
+        ws = {self.serve_config.prefill_chunk, 1}
+        if self.serve_config.spec_tokens > 0:
+            ws.add(self.serve_config.spec_tokens + 1)
+        return sorted(ws)
 
     def warmup(self, artifact: Optional[str] = None) -> float:
         """AOT-compile the mixed prefill step and the C=1 decode step
@@ -332,7 +408,7 @@ class InferenceEngine:
                 logging.getLogger(__name__).warning(
                     "serve export artifact %s unusable (%s); compiling "
                     "live", path, str(e).splitlines()[0])
-        for C in {self.serve_config.prefill_chunk, 1}:
+        for C in self._step_widths():
             self._compile(C)
         self.compile_seconds = time.perf_counter() - t0
         if artifact is None and path is not None:
@@ -387,7 +463,7 @@ class InferenceEngine:
         # not leave a half-artifact engine (live fallback would keep
         # the already-installed exec via _compile's early return)
         staged = {}
-        for C in sorted({self.serve_config.prefill_chunk, 1}):
+        for C in self._step_widths():
             avals = self._step_avals(C)
             topo = {"devices": 1, "axes": {}}
             la.artifact.check_avals(topo, avals, tag=f"c{C}")
@@ -429,6 +505,12 @@ class InferenceEngine:
                 # activation) engine — scheme mismatch fails fast
                 "quant_bits": self.quant_bits,
                 "quant_act": act_quant_enabled(),
+                # speculation width shapes the program (extra compiled
+                # width + per-position verify outputs): artifacts refuse
+                # to load across differing values (docs/serving.md
+                # failure matrix).  prefix_cache is deliberately absent
+                # — host-side policy, same compiled program.
+                "spec_tokens": sc.spec_tokens,
                 "top_k": sc.top_k, "top_p": sc.top_p}
 
     def _install_weights(self, params: dict, path: str) -> None:
@@ -544,21 +626,46 @@ class InferenceEngine:
     # ------------------------------------------------------------------
     def _execute(self, tok, num_tokens, start_pos, tables, ctx_lens,
                  temps, greedy_mask, C: int):
-        """Run one fused step (called by the scheduler); returns the
-        sampled next token per slot as host numpy."""
+        """Run one fused step (called by the scheduler); returns
+        ``(next_token[B], all_tok)`` as host numpy — `all_tok` is the
+        (B, C) per-position greedy argmax when speculation is enabled,
+        else None."""
         ex = self._execs.get(C)
         if ex is None:
             ex = self._compile(C)
         self._steps_executed += 1
         self._key, sub = jax.random.split(self._key)
-        out_pools, nxt = ex(
+        out = ex(
             self.P, self.pools.as_tuple(), jnp.asarray(tok),
             jnp.asarray(num_tokens), jnp.asarray(start_pos),
             jnp.asarray(tables), jnp.asarray(ctx_lens),
             jnp.asarray(temps), jnp.asarray(greedy_mask), sub)
+        if self.serve_config.spec_tokens > 0:
+            out_pools, nxt, all_tok = out
+        else:
+            (out_pools, nxt), all_tok = out, None
         # rebind the donated pool buffers to the step's outputs
         self.pools = self.pools.replace(out_pools)
-        return onp.asarray(jax.device_get(nxt))
+        return (onp.asarray(jax.device_get(nxt)),
+                None if all_tok is None
+                else onp.asarray(jax.device_get(all_tok)))
+
+    def copy_page(self, src: int, dst: int) -> None:
+        """Device-copy ONE physical page (every layer, K + V + scale
+        planes) — the data half of a copy-on-write fork, after
+        `PageAllocator.fork` moved a reference onto the fresh page.
+        Jitted with the pool donated so the copy updates in place; page
+        ids are traced scalars, so one compile per pool-array aval
+        covers every fork."""
+        if self._cow_fn is None:
+            self._cow_fn = jax.jit(
+                lambda a, s, d: a.at[:, d].set(a[:, s]),
+                donate_argnums=(0,))
+        arrs = self.pools.arrays
+        s = jnp.int32(src)
+        d = jnp.int32(dst)
+        for name in self.pools.names:
+            arrs[name] = self._cow_fn(arrs[name], s, d)
 
     # ------------------------------------------------------------------
     # public API (delegates to the scheduler)
@@ -638,4 +745,8 @@ class InferenceEngine:
             "quant_bits": self.quant_bits,
             "bonus_pages": getattr(self, "bonus_pages", 0),
             "compile_seconds": self.compile_seconds,
+            "spec_tokens": self.serve_config.spec_tokens,
+            "spec": self.scheduler.spec_stats(),
+            "prefix_cache": (None if self.prefix_index is None
+                             else self.prefix_index.stats()),
         }

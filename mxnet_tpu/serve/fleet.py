@@ -302,7 +302,9 @@ class ServeFleet:
         reg = _tele.registry()
         for gname in ("serve_replica_queue_depth",
                       "serve_replica_active_slots",
-                      "serve_replica_free_pages"):
+                      "serve_replica_free_pages",
+                      "serve_replica_kv_pages_shared",
+                      "serve_replica_spec_accept_rate"):
             g = reg.get(gname)
             if g is not None:
                 g.remove(replica=rep.name)

@@ -17,25 +17,41 @@ the jitted step needs no host-side branching on raggedness.
 ``kv_dtype="int8"`` stores the pool quantized (symmetric per-token-per-head
 int8 via `contrib/quantization.quantize_kv`) at ~4x less HBM per token;
 attention dequantizes only the gathered context.
+
+**Shared pages & copy-on-write** (docs/serving.md "Speculative decoding &
+prefix caching"): every allocated page carries a reference count.  A page
+with refcount > 1 is read-only — `PageAllocator.share` adds owners (the
+cross-request prefix cache attaching cached prompt blocks to a new
+sequence), and a writer must `fork` first: the fork moves one reference
+onto a fresh physical page, the caller device-copies the contents, and
+only then scatters into it.  `free` is a decref; the physical page
+returns to the free list only when its last owner lets go — which is what
+lets N concurrent requests attend over ONE copy of a shared prompt prefix
+while each still owns its divergent suffix exclusively.  `PrefixIndex`
+maps token-block prefixes to those shared read-only page runs, with LRU
+eviction of refcount-1 entries under pool pressure.
 """
 from __future__ import annotations
 
+import itertools
 import math
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from ..base import MXNetError
 
-__all__ = ["PageAllocator", "KVPools", "make_paged_kv_fn", "NULL_PAGE"]
+__all__ = ["PageAllocator", "PrefixIndex", "KVPools", "make_paged_kv_fn",
+           "NULL_PAGE"]
 
 NULL_PAGE = 0
 
 
 class PageAllocator:
-    """Free-list allocator over the physical pages of a pool.
+    """Free-list allocator over the physical pages of a pool, with
+    per-page reference counts for cross-request sharing.
 
     Thread-safe (the scheduler may admit from a submit thread while the
     step loop extends sequences).  Pages are recycled LIFO — a just-freed
@@ -52,6 +68,9 @@ class PageAllocator:
         self.page_size = int(page_size)
         # LIFO free list; page 0 (null) is never allocatable
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        # page id -> owner count for every allocated page (alloc = 1;
+        # share increfs; free decrefs and recycles at zero)
+        self._ref: Dict[int, int] = {}
         self._lock = threading.Lock()
 
     @property
@@ -82,16 +101,295 @@ class PageAllocator:
             if len(self._free) < n:
                 return None
             taken = [self._free.pop() for _ in range(n)]
+            for p in taken:
+                self._ref[p] = 1
         return taken
 
     def free(self, pages: List[int]) -> None:
+        """Release one reference per page; a page returns to the free
+        list only when its LAST owner lets go (shared prefix pages stay
+        resident for their other owners)."""
         with self._lock:
             for p in pages:
                 if p == NULL_PAGE:
                     raise MXNetError("attempt to free the null page")
-                if p in self._free:
+                ref = self._ref.get(p)
+                if ref is None:
                     raise MXNetError(f"double free of page {p}")
-                self._free.append(p)
+                if ref > 1:
+                    self._ref[p] = ref - 1
+                else:
+                    del self._ref[p]
+                    self._free.append(p)
+
+    # -- sharing / copy-on-write (docs/serving.md) ---------------------
+    def refcount(self, page: int) -> int:
+        """Current owner count of `page` (0 = free/never allocated)."""
+        with self._lock:
+            return self._ref.get(page, 0)
+
+    def shared_pages(self) -> int:
+        """Physical pages with more than one owner (the
+        ``serve_kv_pages_shared`` gauge)."""
+        with self._lock:
+            return sum(1 for r in self._ref.values() if r > 1)
+
+    def share(self, pages: Sequence[int]) -> None:
+        """Add one owner to each page — attaching cached prefix pages to
+        a new sequence (or registering them in a `PrefixIndex`).  Only
+        allocated pages can be shared."""
+        with self._lock:
+            for p in pages:
+                ref = self._ref.get(p)
+                if ref is None:
+                    raise MXNetError(
+                        f"share of unallocated page {p} (free or never "
+                        f"handed out)")
+                self._ref[p] = ref + 1
+
+    def fork(self, page: int) -> Optional[Tuple[int, bool]]:
+        """Copy-on-write: make `page` exclusively writable for ONE of
+        its owners.  Exclusive already (refcount 1) returns ``(page,
+        False)`` — write in place.  Shared returns ``(new_page, True)``
+        after moving one reference onto a fresh page: the CALLER must
+        device-copy the contents ``page -> new_page`` before writing
+        (the allocator is host-side bookkeeping only).  Returns None
+        when the pool has no free page for the fork — the caller applies
+        its pressure policy (prefix-cache eviction, slot preemption) and
+        retries."""
+        with self._lock:
+            ref = self._ref.get(page)
+            if ref is None:
+                raise MXNetError(f"fork of unallocated page {page}")
+            if ref == 1:
+                return page, False
+            if not self._free:
+                return None
+            new = self._free.pop()
+            self._ref[new] = 1
+            self._ref[page] = ref - 1
+        return new, True
+
+
+class _PrefixEntry:
+    """One cached token block: a single shared read-only page holding
+    ``n_tokens`` (< page_size for a terminal partial block) of KV."""
+
+    __slots__ = ("key", "page", "tokens", "n_tokens", "parent", "stamp")
+
+    def __init__(self, key, page: int, tokens: tuple, n_tokens: int,
+                 parent, stamp: int):
+        self.key = key
+        self.page = page
+        self.tokens = tokens
+        self.n_tokens = n_tokens
+        self.parent = parent
+        self.stamp = stamp
+
+
+class PrefixIndex:
+    """Cross-request prompt-prefix cache: token-block prefixes -> shared
+    read-only KV page runs (docs/serving.md "Speculative decoding &
+    prefix caching").
+
+    Entries are chained per page-sized block and keyed by EXACT token
+    content — ``key = (parent_key, block_tokens)`` — so a hit guarantees
+    the cached KV was computed from the same tokens (no hash-collision
+    risk).  Each entry owns one allocator reference on its page;
+    `lookup` walks the chain for a new prompt and adds a reference per
+    matched page for the requesting sequence (the scheduler then skips
+    those prefill chunks entirely).  A prompt's trailing partial block
+    is cached too (at most one per parent): attaching it means the new
+    sequence's first write lands INSIDE a shared page, which is exactly
+    the copy-on-write fork case.
+
+    Under pool pressure `evict_pages` drops least-recently-used entries
+    whose page has refcount 1 (sole owner = this index) — a page any
+    live sequence still reads is never reclaimed.  Thread-safe: the
+    router probes `longest_match` from submit threads while the step
+    loop inserts/attaches."""
+
+    _ROOT = ()
+
+    def __init__(self, allocator: PageAllocator, page_size: int):
+        self.allocator = allocator
+        self.page_size = int(page_size)
+        self._entries: Dict[tuple, _PrefixEntry] = {}
+        # parent key -> the single terminal partial-block entry
+        self._partials: Dict[tuple, _PrefixEntry] = {}
+        # parent key -> number of child entries (full blocks + partial);
+        # only childless entries are evictable (an orphaned child would
+        # be unreachable but still pin its page)
+        self._children: Dict[tuple, int] = {}
+        self._stamp = itertools.count()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.hit_tokens = 0
+        self.insertions = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries) + len(self._partials)
+
+    # ------------------------------------------------------------------
+    def _walk(self, tokens: Sequence[int]):
+        """Longest cached chain for `tokens`: yields matched entries in
+        order (full blocks, then at most one terminal partial).  Caller
+        holds the lock."""
+        ps = self.page_size
+        parent = self._ROOT
+        n = 0
+        out = []
+        while n + ps <= len(tokens):
+            block = tuple(int(t) for t in tokens[n:n + ps])
+            e = self._entries.get((parent, block))
+            if e is None:
+                break
+            out.append(e)
+            parent = e.key
+            n += ps
+        part = self._partials.get(parent)
+        if part is not None and part.n_tokens <= len(tokens) - n and \
+                tuple(int(t) for t in tokens[n:n + part.n_tokens]) \
+                == part.tokens:
+            out.append(part)
+        return out
+
+    def lookup(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
+        """Longest cached prefix of `tokens`: returns ``(pages,
+        n_tokens)`` with one allocator reference added per returned page
+        FOR THE CALLER (released through the normal `free` path when the
+        sequence lets go).  ``([], 0)`` on miss."""
+        with self._lock:
+            matched = self._walk(tokens)
+            if not matched:
+                return [], 0
+            pages = [e.page for e in matched]
+            n = sum(e.n_tokens for e in matched)
+            self.allocator.share(pages)
+            for e in matched:
+                e.stamp = next(self._stamp)
+            self.hits += 1
+            self.hit_tokens += n
+        return pages, n
+
+    def longest_match(self, tokens: Sequence[int]) -> int:
+        """Tokens a `lookup` would attach — read-only (no references
+        taken, no LRU refresh).  The router's prefix-affinity score."""
+        with self._lock:
+            return sum(e.n_tokens for e in self._walk(tokens))
+
+    # ------------------------------------------------------------------
+    def insert(self, tokens: Sequence[int], pages: Sequence[int]) -> int:
+        """Register a just-prefilled prompt: ``pages[i]`` holds tokens
+        ``[i*ps, (i+1)*ps)`` of `tokens` (the owning slot's page table
+        prefix).  Creates entries for blocks not yet cached (one shared
+        reference each); existing entries are LRU-refreshed, never
+        replaced (first writer wins — both pages hold identical KV by
+        construction).  Returns the number of NEW entries."""
+        tokens = [int(t) for t in tokens]
+        ps = self.page_size
+        need = math.ceil(len(tokens) / ps) if tokens else 0
+        if len(pages) < need:
+            raise MXNetError(
+                f"prefix insert: {len(tokens)} tokens span {need} pages "
+                f"but only {len(pages)} supplied")
+        created = 0
+        with self._lock:
+            parent = self._ROOT
+            for bi in range(len(tokens) // ps):
+                block = tuple(tokens[bi * ps:(bi + 1) * ps])
+                key = (parent, block)
+                e = self._entries.get(key)
+                if e is None:
+                    self.allocator.share([pages[bi]])
+                    e = _PrefixEntry(key, pages[bi], block, ps, parent,
+                                     next(self._stamp))
+                    self._entries[key] = e
+                    self._children[parent] = \
+                        self._children.get(parent, 0) + 1
+                    self.insertions += 1
+                    created += 1
+                else:
+                    e.stamp = next(self._stamp)
+                parent = key
+            r = len(tokens) % ps
+            if r:
+                blk = tuple(tokens[-r:])
+                part = self._partials.get(parent)
+                if part is not None and part.tokens == blk:
+                    part.stamp = next(self._stamp)
+                elif part is None or (len(part.tokens) < r
+                                      and blk[:len(part.tokens)]
+                                      == part.tokens):
+                    # no partial yet, or the new one strictly extends it
+                    if part is not None:
+                        self._drop(part)
+                    self.allocator.share([pages[len(tokens) // ps]])
+                    self._partials[parent] = _PrefixEntry(
+                        ("partial", parent), pages[len(tokens) // ps],
+                        blk, r, parent, next(self._stamp))
+                    self._children[parent] = \
+                        self._children.get(parent, 0) + 1
+                    self.insertions += 1
+                    created += 1
+        return created
+
+    # ------------------------------------------------------------------
+    def _drop(self, e: _PrefixEntry) -> None:
+        """Remove one entry and release its page reference (lock held)."""
+        if e.key[0] == "partial":
+            self._partials.pop(e.parent, None)
+        else:
+            self._entries.pop(e.key, None)
+        left = self._children.get(e.parent, 0) - 1
+        if left > 0:
+            self._children[e.parent] = left
+        else:
+            self._children.pop(e.parent, None)
+        self.allocator.free([e.page])
+        self.evictions += 1
+
+    def evict_pages(self, n: int) -> int:
+        """Pool pressure: reclaim up to `n` pages by dropping LRU
+        childless entries whose page refcount is 1 (sole owner = this
+        index).  A page a live sequence still shares is NEVER evicted.
+        Returns pages actually freed."""
+        freed = 0
+        with self._lock:
+            while freed < n:
+                cands = [
+                    e for e in list(self._entries.values())
+                    + list(self._partials.values())
+                    if self._children.get(e.key, 0) == 0
+                    and self.allocator.refcount(e.page) == 1]
+                if not cands:
+                    break
+                victim = min(cands, key=lambda e: e.stamp)
+                self._drop(victim)
+                freed += 1
+        return freed
+
+    def clear(self) -> int:
+        """Drop every entry (engine teardown / tests); returns entries
+        released.  Shared pages simply lose the index's reference."""
+        with self._lock:
+            all_e = list(self._entries.values()) \
+                + list(self._partials.values())
+            for e in all_e:
+                self.allocator.free([e.page])
+            self._entries.clear()
+            self._partials.clear()
+            self._children.clear()
+            return len(all_e)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries) + len(self._partials),
+                    "hits": self.hits, "hit_tokens": self.hit_tokens,
+                    "insertions": self.insertions,
+                    "evictions": self.evictions}
 
 
 class KVPools:

@@ -132,16 +132,35 @@ class RequestRouter:
         return [r for r in self._replicas()
                 if r.state in ("starting", "running")]
 
-    def _pick(self, running: List, headroom: bool = True):
+    def _pick(self, running: List, headroom: bool = True, prompt=None):
         """Best running replica; with ``headroom`` only replicas whose
         local queue is below their slot count qualify (beyond that, the
-        global queue is the fairer place to wait)."""
+        global queue is the fairer place to wait).
+
+        Prefix affinity (docs/serving.md "Speculative decoding & prefix
+        caching"): when replicas run a prefix cache and a `prompt` is
+        supplied, the replica whose index holds the LONGEST cached
+        match gets a score bonus proportional to the fraction of the
+        prompt it can skip — routing near-duplicate prompts to the
+        replica that already holds their KV.  Affinity only reorders
+        the eligible replicas; it never overrides the shed/deadline
+        policy or the headroom bound (an overloaded cache-holder still
+        loses to an idle peer: the bonus is at most 1.0, the same
+        magnitude as the free-page term)."""
         if headroom:
             running = [r for r in running
                        if r.engine.scheduler.queue_depth
                        < r.engine.serve_config.max_slots]
         if not running:
             return None
+        if prompt:
+            def score(rep):
+                base = self._score(rep)
+                index = getattr(rep.engine, "prefix_index", None)
+                if index is not None:
+                    base -= index.longest_match(prompt) / len(prompt)
+                return base
+            return min(running, key=score)
         return min(running, key=self._score)
 
     # ------------------------------------------------------------------
@@ -164,7 +183,7 @@ class RequestRouter:
                            temperature=temperature,
                            eos_token_id=eos_token_id, on_token=on_token,
                            deadline_ms=deadline)
-        target = self._pick(running)
+        target = self._pick(running, prompt=prompt)
         if target is None:
             # every replica saturated: park (bounded) or shed — the
             # bound/deadline checks and the append are ONE locked
@@ -340,7 +359,8 @@ class RequestRouter:
                     "Requests moved between replicas by failover",
                     labelnames=("direction", "replica")).inc(
                         direction="out", replica=source)
-            target = self._pick(self._running(), headroom=False)
+            target = self._pick(self._running(), headroom=False,
+                                prompt=req.prompt)
             if target is not None and self._dispatch(
                     req, target, source="failover"
                     if reason == "failover" else reason,

@@ -48,6 +48,7 @@ from ..base import MXNetError
 from ..resilience import fault_point
 from .. import telemetry as _tele
 from .. import tracing as _trace
+from .kv_cache import NULL_PAGE
 
 __all__ = ["ServeRequest", "ContinuousBatchingScheduler",
            "terminate_request"]
@@ -79,6 +80,9 @@ class ServeRequest:
         self.state = "queued"                # queued|running|finished|failed
         self.evictions = 0
         self.failovers = 0                   # replica deaths survived
+        self.prefix_hits = 0                 # prompt tokens served from
+        #                                      the prefix cache (summed
+        #                                      across re-admissions)
         # ownership epoch: salvage() bumps it when the request moves to
         # another replica, so a wedged old driver's late emit is ignored
         self._epoch = 0
@@ -144,7 +148,8 @@ def _close_request_spans(req: ServeRequest, state: str, **tags) -> None:
         req._queue_span = None
     if req._span is not None:
         req._span.finish(state=state, generated=len(req.tokens),
-                         evictions=req.evictions, **tags)
+                         evictions=req.evictions,
+                         prefix_hit=req.prefix_hits, **tags)
         req._span = None
 
 
@@ -225,6 +230,9 @@ class _Slot:
         self.table = onp.zeros(max_pages, onp.int32)   # NULL_PAGE fill
         self.ctx = 0          # tokens already written to the pool
         self.admit_seq = admit_seq    # admission order (eviction priority)
+        # prompt blocks registered in the engine's PrefixIndex (once,
+        # when the prompt's prefill completes)
+        self.prefix_inserted = False
         # ownership epoch at admission: salvage() bumps the request's
         # epoch when it moves to another replica, so this slot's emits
         # become no-ops if its driver was wedged past the salvage
@@ -254,6 +262,14 @@ class ContinuousBatchingScheduler:
         self._lock = threading.Lock()
         self._admit_seq = itertools.count()
         self._steps = 0
+        # decode-fast-path accounting (docs/serving.md "Speculative
+        # decoding & prefix caching")
+        self.spec_proposed = 0       # draft tokens fed for verification
+        self.spec_accepted = 0       # draft tokens that matched greedy
+        self.tokens_emitted = 0      # tokens streamed (all requests)
+        self.prefix_hit_tokens = 0   # prompt tokens attached from cache
+        self.cow_forks = 0           # shared pages forked before a write
+        self._span_prefix_hit = 0    # admitted since the last step span
         #: replica identity in a fleet (None outside one): tags request
         #: journal events, step spans, and the per-replica gauges
         self.name: Optional[str] = None
@@ -388,11 +404,35 @@ class ContinuousBatchingScheduler:
                 return i
         return None
 
+    def _alloc_pages(self, n: int) -> Optional[List[int]]:
+        """`PageAllocator.alloc` with prefix-cache pressure relief: on a
+        shortfall, LRU-evict unreferenced prefix-cache entries to cover
+        it, then retry once.  Cached-but-unused prefixes always yield to
+        live sequences."""
+        if n <= 0:
+            return []
+        pages = self.allocator.alloc(n)
+        if pages is not None:
+            return pages
+        index = self.engine.prefix_index
+        if index is None:
+            return None
+        index.evict_pages(n - self.allocator.free_pages)
+        return self.allocator.alloc(n)
+
     def _admit(self) -> None:
         """FIFO admission under memory backpressure: a request enters a
         slot only when its CURRENT sequence (prompt + already-generated,
         for re-admits) plus one decode page fits the free list — partial
-        admission would deadlock against other growing sequences."""
+        admission would deadlock against other growing sequences.
+
+        With the prefix cache enabled, admission first consults the
+        `PrefixIndex`: cached prompt-prefix pages are ATTACHED by
+        reference (share, not copy) and the matching prefill chunks are
+        skipped entirely — the slot's write cursor starts past them.
+        The match is capped at ``len(sequence) - 1`` so the last token
+        is always re-fed (its forward pass produces the next token's
+        logits)."""
         while True:
             with self._lock:
                 if not self._queue:
@@ -401,21 +441,41 @@ class ContinuousBatchingScheduler:
                 if idx is None:
                     return
                 req = self._queue[0]
-                need = self.allocator.pages_for(len(req._sequence()) + 1)
-                pages = self.allocator.alloc(need)
+                seq = req._sequence()
+                index = self.engine.prefix_index
+                attached, hit = ([], 0)
+                if index is not None:
+                    attached, hit = index.lookup(seq[:-1])
+                need = self.allocator.pages_for(len(seq) + 1)
+                pages = self._alloc_pages(need - len(attached))
                 if pages is None:
-                    return          # OOM backpressure: wait for frees
+                    # OOM backpressure: wait for frees (the attached
+                    # pages go back — the index still holds its own
+                    # reference, so the next attempt re-attaches)
+                    if attached:
+                        self.allocator.free(attached)
+                    return
                 self._queue.popleft()
                 slot = _Slot(req, idx, self.max_pages_per_seq,
                              next(self._admit_seq))
-                slot.pages = pages
-                slot.table[:len(pages)] = pages
+                slot.pages = attached + pages
+                slot.table[:len(slot.pages)] = slot.pages
+                slot.ctx = hit
                 self._slots[idx] = slot
             req.state = "running"
-            self._trace_admit(req, idx, len(pages))
+            if hit:
+                req.prefix_hits += hit
+                self.prefix_hit_tokens += hit
+                self._span_prefix_hit += hit
+                if _tele.enabled():
+                    _tele.counter(
+                        "serve_prefix_hit_tokens_total",
+                        "Prompt tokens served from the cross-request "
+                        "prefix cache (prefill skipped)").inc(hit)
+            self._trace_admit(req, idx, len(slot.pages))
             self._telemetry_request(
                 req, "readmitted" if req.evictions else "admitted",
-                slot=idx, pages=len(pages))
+                slot=idx, pages=len(slot.pages), prefix_hit=hit)
 
     def _release_slot(self, slot: _Slot) -> None:
         """Recycle a slot's KV pages and vacate it — the one way any
@@ -447,7 +507,7 @@ class ContinuousBatchingScheduler:
         even eviction cannot help (the slot itself must yield)."""
         need_total = self.allocator.pages_for(upto_tokens)
         while len(slot.pages) < need_total:
-            got = self.allocator.alloc(1)
+            got = self._alloc_pages(1)
             if got is not None:
                 slot.table[len(slot.pages)] = got[0]
                 slot.pages.extend(got)
@@ -459,6 +519,55 @@ class ContinuousBatchingScheduler:
             victims.sort(key=lambda s: s.admit_seq)
             self._evict(victims[-1], reason="page_pressure")
         return True
+
+    def _cow_guard(self, slot: _Slot, first: int, last: int) -> bool:
+        """Copy-on-write before the fused step scatters into token
+        positions ``[first, last]``: any page in that range still SHARED
+        (attached from the prefix cache, or registered in it by this
+        slot's own prompt) is forked — a fresh page allocated, device
+        contents copied, the table repointed, and the shared original
+        released to its remaining owners — so a write can never corrupt
+        KV another sequence (or the cache) is reading.  False when the
+        pool cannot supply a fork page even after prefix-cache eviction
+        (the caller evicts this slot)."""
+        ps = self.page_size
+        for pg in range(first // ps, last // ps + 1):
+            page = int(slot.table[pg])
+            if self.allocator.refcount(page) <= 1:
+                continue
+            got = self.allocator.fork(page)
+            if got is None:
+                index = self.engine.prefix_index
+                if index is not None and index.evict_pages(1):
+                    got = self.allocator.fork(page)
+                if got is None:
+                    return False
+            new, copied = got
+            if copied:
+                self.engine.copy_page(page, new)
+                slot.table[pg] = new
+                slot.pages[pg] = new
+                self.cow_forks += 1
+                if _tele.enabled():
+                    _tele.counter(
+                        "serve_kv_cow_forks_total",
+                        "Shared KV pages forked (copied to a fresh "
+                        "page) before a write").inc()
+        return True
+
+    def _trim_pages(self, slot: _Slot) -> None:
+        """Roll back pages past the slot's (possibly rejected-draft-
+        rolled-back) write cursor — keeping the page the next decode
+        token lands in.  Freshly-allocated by construction (attached
+        prefix pages always sit below the cursor), so they go straight
+        back to the free list."""
+        keep = max(1, self.allocator.pages_for(slot.ctx + 1))
+        if len(slot.pages) <= keep:
+            return
+        extra = slot.pages[keep:]
+        del slot.pages[keep:]
+        slot.table[keep:keep + len(extra)] = NULL_PAGE
+        self.allocator.free(extra)
 
     # ------------------------------------------------------------------
     def _expire_deadlines(self) -> None:
@@ -511,21 +620,57 @@ class ContinuousBatchingScheduler:
 
             # plan the chunk width: any slot with >1 pending token
             # prefills, so the step runs at the prefill chunk width; a
-            # pure-decode round runs the C=1 program (no padded-lane
-            # compute)
+            # pure-decode round runs the C=1 program — unless the
+            # drafter proposed tokens, in which case it runs the k+1
+            # verification width (no padded-lane compute otherwise).
             pending = {s.slot_idx: len(s.req._sequence()) - s.ctx
                        for s in actives}
-            C = self.prefill_chunk \
-                if any(p > 1 for p in pending.values()) else 1
+            any_prefill = any(p > 1 for p in pending.values())
 
-            # capacity: every slot must hold its chunk's tokens; slots
-            # that cannot (even after evicting younger actives) are
-            # evicted themselves this round
+            # speculative drafts: any GREEDY slot whose feed reaches the
+            # end of its sequence this round (pure decode, or the last
+            # prefill chunk with spare width) carries up to k proposed
+            # tokens after its real feed — verified by the same launch
+            spec_k = self.engine.serve_config.spec_tokens
+            drafter = self.engine.drafter
+            proposals = {}
+            if spec_k > 0 and drafter is not None:
+                cmax = self.prefill_chunk if any_prefill else spec_k + 1
+                for s in actives:
+                    req = s.req
+                    p = pending[s.slot_idx]
+                    if not req.greedy or not 1 <= p <= cmax - 1:
+                        continue
+                    seq = req._sequence()
+                    k_eff = min(spec_k, cmax - p,
+                                req.max_new_tokens - len(req.tokens) - 1,
+                                self.max_len - len(seq))
+                    if k_eff <= 0:
+                        continue
+                    d = drafter.propose(seq, k_eff)
+                    if d:
+                        proposals[s.slot_idx] = \
+                            [int(t) for t in d[:k_eff]]
+            if any_prefill:
+                C = self.prefill_chunk
+            elif proposals:
+                C = spec_k + 1
+            else:
+                C = 1
+
+            # capacity: every slot must hold its chunk's tokens (drafts
+            # included — rejected ones roll back through the free list
+            # after verification); slots that cannot (even after
+            # evicting younger actives) are evicted themselves this
+            # round.  The COW guard then forks any still-shared page in
+            # the write range before the step scatters into it.
             for s in sorted(actives, key=lambda s: s.admit_seq):
                 if self._slots[s.slot_idx] is not s:
                     continue      # already evicted by a victim search
-                nt = min(pending[s.slot_idx], C)
-                if not self._ensure_capacity(s, s.ctx + nt):
+                nt = min(pending[s.slot_idx], C) \
+                    + len(proposals.get(s.slot_idx, ()))
+                if not self._ensure_capacity(s, s.ctx + nt) or \
+                        not self._cow_guard(s, s.ctx, s.ctx + nt - 1):
                     self._evict(s, reason="no_capacity")
             actives = [s for s in self._slots if s is not None]
             if not actives:
@@ -540,10 +685,13 @@ class ContinuousBatchingScheduler:
             ctx_lens = onp.zeros(B, onp.int32)
             temps = onp.ones(B, onp.float32)
             greedy = onp.ones(B, bool)
-            consume = {}
+            plan = {}
             for s in actives:
                 seq = s.req._sequence()
-                feed = seq[s.ctx:s.ctx + C]
+                nt_seq = min(len(seq) - s.ctx, C)
+                draft = proposals.get(s.slot_idx, []) \
+                    if s.ctx + nt_seq == len(seq) else []
+                feed = seq[s.ctx:s.ctx + nt_seq] + draft
                 nt = len(feed)
                 i = s.slot_idx
                 tok[i, :nt] = feed
@@ -553,7 +701,10 @@ class ContinuousBatchingScheduler:
                 ctx_lens[i] = s.ctx + nt
                 temps[i] = s.req.temperature
                 greedy[i] = s.req.greedy
-                consume[i] = (s.ctx + nt == len(seq))
+                plan[i] = {"slot": s, "feed": feed, "nt": nt,
+                           "nt_seq": nt_seq, "ctx0": s.ctx,
+                           "draft": len(draft), "emitted": 0,
+                           "consume": s.ctx + nt_seq == len(seq)}
                 s.ctx += nt
 
         t0 = time.perf_counter()
@@ -563,7 +714,7 @@ class ContinuousBatchingScheduler:
             # traffic — slot.ctx has already advanced past tokens that
             # will never land, the hardest failover shape
             fault_point("replica_step")
-            next_tokens = self.engine._execute(
+            next_tokens, all_tok = self.engine._execute(
                 tok, num_tokens, start_pos, tables, ctx_lens, temps,
                 greedy, C)
         except Exception as exc:
@@ -589,9 +740,6 @@ class ContinuousBatchingScheduler:
                 return False
             step_ms = (t1 - t0) * 1e3
             self._steps += 1
-            if _trace.enabled():
-                self._trace_step(actives, consume, num_tokens, ctx_lens,
-                                 t0, t1, C)
             from .. import health as _health
             _health.beat("serve.step")
             if _tele.enabled():
@@ -606,54 +754,143 @@ class ContinuousBatchingScheduler:
                 _trace.note_step_cost(
                     f"serve_step_c{C}@{id(self.engine):x}", step_ms / 1e3)
 
-            # distribute tokens in admission order (stable streaming)
+            # register just-prefilled prompts in the prefix cache BEFORE
+            # emitting (emits can finish a request and release its
+            # pages): the slot's pages hold the complete prompt KV once
+            # the write cursor passed the prompt
+            index = self.engine.prefix_index
+            if index is not None:
+                for s in actives:
+                    if s.prefix_inserted or \
+                            self._slots[s.slot_idx] is not s:
+                        continue
+                    if s.ctx >= len(s.req.prompt):
+                        index.insert(s.req.prompt, s.pages)
+                        s.prefix_inserted = True
+
+            # snapshot span parents before emitting: finishing a request
+            # closes its root span, but the post-hoc phase spans below
+            # still decompose its timeline
+            parents = {}
+            if _trace.enabled():
+                for i, pl in plan.items():
+                    req = pl["slot"].req
+                    parents[i] = (None if req._span is None
+                                  else req._span.context(),
+                                  bool(req.tokens))
+
+            # distribute tokens in admission order (stable streaming).
+            # A speculating slot emits its whole accepted run — the fed
+            # position's greedy token, then each draft that matched it —
+            # and rolls its write cursor back past the rejected rest.
+            drafted_step = accepted_step = emitted_total = 0
             for s in sorted(actives, key=lambda s: s.admit_seq):
-                if not consume[s.slot_idx]:
+                i = s.slot_idx
+                pl = plan[i]
+                if not pl["consume"]:
                     continue      # mid-prefill: logits discarded
-                if self._slots[s.slot_idx] is not s:
+                if self._slots[i] is not s:
                     continue      # expired/terminated while executing
-                self._emit(s, int(next_tokens[s.slot_idx]))
+                if all_tok is not None and s.req.greedy:
+                    feed, nt = pl["feed"], pl["nt"]
+                    # all_tok column t holds fed position nt - T + t
+                    # (the engine computes the verify argmax only for
+                    # the tail T = min(C, k+1) positions — all the emit
+                    # loop can ever read)
+                    T = all_tok.shape[1]
+                    emitted = 0
+                    for j in range(pl["nt_seq"] - 1, nt):
+                        tokj = int(all_tok[i, j - nt + T])
+                        self._emit(s, tokj)
+                        emitted += 1
+                        if self._slots[i] is not s or s.req.done():
+                            break      # finished (max_new / eos)
+                        if j + 1 < nt and feed[j + 1] != tokj:
+                            break      # draft rejected: stop the run
+                    pl["emitted"] = emitted
+                    drafted_step += pl["draft"]
+                    accepted_step += emitted - 1
+                    if pl["draft"] and drafter is not None:
+                        drafter.note_result(pl["draft"], emitted - 1)
+                    if self._slots[i] is s:
+                        # roll back past rejected drafts: the cursor
+                        # returns to the last ACCEPTED token's position
+                        # and the pages holding only rejected KV go
+                        # back to the free list
+                        s.ctx = pl["ctx0"] + pl["nt_seq"] + emitted - 1
+                        self._trim_pages(s)
+                else:
+                    self._emit(s, int(next_tokens[i]))
+                    pl["emitted"] = 1
+                emitted_total += pl["emitted"]
+            self.tokens_emitted += emitted_total
+            self.spec_proposed += drafted_step
+            self.spec_accepted += accepted_step
+            if _tele.enabled() and drafted_step:
+                _tele.counter(
+                    "serve_spec_proposed_total",
+                    "Draft tokens fed for verification").inc(drafted_step)
+                if accepted_step > 0:
+                    _tele.counter(
+                        "serve_spec_accepted_total",
+                        "Draft tokens accepted (matched the greedy "
+                        "continuation)").inc(accepted_step)
+            if _trace.enabled():
+                self._trace_step(plan, parents, t0, t1, C,
+                                 drafted_step, accepted_step,
+                                 emitted_total)
             self._update_gauges()
         return True
 
-    def _trace_step(self, actives, consume, num_tokens, ctx_lens,
-                    t0: float, t1: float, C: int) -> None:
+    def _trace_step(self, plan, parents, t0: float, t1: float, C: int,
+                    drafted: int, accepted: int, emitted: int) -> None:
         """Post-hoc spans for one fused step: a scheduler-level
-        "serve.step" span plus one per-request phase span (all slots
-        share the device step's wall window — the spans decompose each
-        request's OWN timeline, not the device's)."""
+        "serve.step" span (tagged with the step's speculation and
+        prefix-cache outcomes — the `diagnose --trace` rollup columns)
+        plus one per-request phase span (all slots share the device
+        step's wall window — the spans decompose each request's OWN
+        timeline, not the device's).  Runs AFTER emission, so the
+        parent span contexts and pre-emit token counts come from the
+        `parents` snapshot."""
         tr = _trace.get_tracer("serve")
         rep = {} if self.name is None else {"replica": self.name}
         track = "serve steps" if self.name is None \
             else f"serve steps {self.name}"
+        prefix_hit, self._span_prefix_hit = self._span_prefix_hit, 0
         tr.record_span("serve.step", t0, t1, track=track,
-                       step=self._steps, chunk=C, active=len(actives),
-                       **rep)
-        for s in actives:
+                       step=self._steps, chunk=C, active=len(plan),
+                       emitted=emitted, drafted=drafted,
+                       accepted=accepted, prefix_hit=prefix_hit, **rep)
+        for i, pl in plan.items():
+            s = pl["slot"]
             req = s.req
-            if req._span is None:
+            parent, had_tokens = parents.get(i, (None, True))
+            if parent is None:
                 continue
-            i = s.slot_idx
-            nt = int(num_tokens[i])
-            if not consume[i]:
+            nt = pl["nt"]
+            if not pl["consume"]:
                 name = "serve.prefill_chunk"
                 first = False
-            elif not req.tokens:
-                # this step's logits produce the request's FIRST token:
-                # a multi-token feed is the last prefill chunk, a
-                # single-token feed is the first decode step
-                first = True
-                name = ("serve.prefill_chunk" if nt > 1
+            elif not had_tokens:
+                # this step's logits produced the request's FIRST
+                # token: a multi-token real feed is the last prefill
+                # chunk, a single-token feed is the first decode step
+                first = pl["emitted"] > 0
+                name = ("serve.prefill_chunk" if pl["nt_seq"] > 1
                         else "serve.first_decode")
             else:
                 first = False
                 name = "serve.decode"
+            spec_tags = {}
+            if pl["draft"] or pl["emitted"] > 1:
+                spec_tags = {"drafted": pl["draft"],
+                             "accepted": max(0, pl["emitted"] - 1)}
             tr.record_span(
-                name, t0, t1, parent=req._span.context(),
+                name, t0, t1, parent=parent,
                 track=f"serve req {req.id}", request_id=req.id,
-                slot=i, pages=len(s.pages), ctx=int(ctx_lens[i]),
-                tokens_fed=nt, **rep,
-                **({"first_token": True} if first else {}))
+                slot=i, pages=len(s.pages), ctx=pl["ctx0"] + nt,
+                tokens_fed=nt, emitted=pl["emitted"], **spec_tags,
+                **rep, **({"first_token": True} if first else {}))
 
     def _emit(self, slot: _Slot, token: int) -> None:
         req = slot.req
@@ -822,10 +1059,33 @@ class ContinuousBatchingScheduler:
     def active_count(self) -> int:
         return sum(1 for s in self._slots if s is not None)
 
+    def spec_stats(self) -> dict:
+        """Decode-fast-path accounting: speculation accept rate, tokens
+        per fused step, prefix-cache hits, COW forks (docs/serving.md;
+        `bench.py --serve --spec` and `make spec-smoke` read this)."""
+        steps = max(1, self._steps)
+        return {
+            "proposed": self.spec_proposed,
+            "accepted": self.spec_accepted,
+            "accept_rate": (round(self.spec_accepted
+                                  / self.spec_proposed, 4)
+                            if self.spec_proposed else None),
+            "steps": self._steps,
+            "tokens": self.tokens_emitted,
+            "tokens_per_step": round(self.tokens_emitted / steps, 4),
+            "steps_per_token": (round(self._steps
+                                      / self.tokens_emitted, 4)
+                                if self.tokens_emitted else None),
+            "prefix_hit_tokens": self.prefix_hit_tokens,
+            "cow_forks": self.cow_forks,
+            "kv_pages_shared": self.allocator.shared_pages(),
+        }
+
     # ------------------------------------------------------------------
     def _update_gauges(self) -> None:
         if not _tele.enabled():
             return
+        spec_on = self.engine.serve_config.spec_tokens > 0
         if self.name is not None:
             # fleet replica: per-replica labeled series (N schedulers in
             # one process must not fight over the global gauges; the
@@ -842,6 +1102,20 @@ class ContinuousBatchingScheduler:
                         "Per-replica KV pages on the free list",
                         labelnames=("replica",)).set(
                             self.allocator.free_pages, replica=self.name)
+            if self.engine.prefix_index is not None:
+                _tele.gauge(
+                    "serve_replica_kv_pages_shared",
+                    "Per-replica KV pages with more than one owner",
+                    labelnames=("replica",)).set(
+                        self.allocator.shared_pages(),
+                        replica=self.name)
+            if spec_on and self.spec_proposed:
+                _tele.gauge(
+                    "serve_replica_spec_accept_rate",
+                    "Per-replica fraction of drafted tokens accepted",
+                    labelnames=("replica",)).set(
+                        self.spec_accepted / self.spec_proposed,
+                        replica=self.name)
             return
         _tele.gauge("serve_queue_depth",
                     "Requests waiting for a slot/pages").set(
@@ -855,6 +1129,24 @@ class ContinuousBatchingScheduler:
         _tele.gauge("serve_free_pages",
                     "KV pages on the free list").set(
                         self.allocator.free_pages)
+        if self.engine.prefix_index is not None:
+            _tele.gauge(
+                "serve_kv_pages_shared",
+                "KV pages with more than one owner (prefix cache + "
+                "attached sequences)").set(self.allocator.shared_pages())
+        if spec_on:
+            if self.spec_proposed:
+                _tele.gauge(
+                    "serve_spec_accept_rate",
+                    "Fraction of drafted tokens accepted by "
+                    "verification (cumulative)").set(
+                        self.spec_accepted / self.spec_proposed)
+            if self._steps:
+                _tele.gauge(
+                    "serve_tokens_per_step",
+                    "Tokens emitted per fused step (cumulative; > 1 "
+                    "means speculation is paying)").set(
+                        self.tokens_emitted / self._steps)
 
     def _telemetry_request(self, req: ServeRequest, phase: str,
                            **fields) -> None:

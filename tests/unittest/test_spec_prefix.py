@@ -1,0 +1,353 @@
+"""Decode fast path: speculative multi-token decoding + cross-request
+prefix caching with copy-on-write KV pages (docs/serving.md
+"Speculative decoding & prefix caching").
+
+Property tests pin the refcount/COW invariants of the page allocator
+and `PrefixIndex` (fork-then-write isolates the writer, double-free
+refused, LRU eviction never reclaims a shared page, pool accounting
+exact across share/fork/release cycles); engine tests pin the hard
+output contract — greedy streams under speculation + prefix reuse are
+BIT-IDENTICAL to unbatched `generate()` — plus the export identity
+(`spec_tokens` mismatch refuses at load).
+"""
+import os
+
+import numpy as onp
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.base import MXNetError  # noqa: E402
+from mxnet_tpu.serve import (InferenceEngine, NGramDrafter,  # noqa: E402
+                             PageAllocator, PrefixIndex, ServeConfig)
+
+pytestmark = pytest.mark.serve
+
+
+# ---------------------------------------------------------------------------
+# PageAllocator refcounts / copy-on-write
+# ---------------------------------------------------------------------------
+
+def test_alloc_free_share_cycle_accounting_exact():
+    a = PageAllocator(num_pages=9, page_size=4)
+    assert a.total_pages == 8
+    pages = a.alloc(3)
+    assert a.free_pages == 5
+    a.share(pages)                       # second owner on all three
+    assert a.shared_pages() == 3
+    a.free(pages)                        # first owner lets go
+    assert a.free_pages == 5             # still held by the second
+    assert a.shared_pages() == 0
+    a.free(pages)                        # last owner
+    assert a.free_pages == 8
+    for p in pages:
+        assert a.refcount(p) == 0
+
+
+def test_double_free_refused_and_share_of_free_refused():
+    a = PageAllocator(num_pages=4, page_size=2)
+    (p,) = a.alloc(1)
+    a.free([p])
+    with pytest.raises(MXNetError, match="double free"):
+        a.free([p])
+    with pytest.raises(MXNetError, match="share of unallocated"):
+        a.share([p])
+    with pytest.raises(MXNetError, match="fork of unallocated"):
+        a.fork(p)
+
+
+def test_fork_exclusive_is_in_place():
+    a = PageAllocator(num_pages=4, page_size=2)
+    (p,) = a.alloc(1)
+    assert a.fork(p) == (p, False)       # sole owner writes in place
+    assert a.refcount(p) == 1
+
+
+def test_fork_shared_moves_one_reference():
+    a = PageAllocator(num_pages=5, page_size=2)
+    (p,) = a.alloc(1)
+    a.share([p])
+    new, copied = a.fork(p)
+    assert copied and new != p
+    assert a.refcount(p) == 1            # the other owner keeps it
+    assert a.refcount(new) == 1          # the writer owns the fork
+    assert a.free_pages == 2
+    a.free([p])
+    a.free([new])
+    assert a.free_pages == 4
+
+
+def test_fork_pool_exhausted_returns_none():
+    a = PageAllocator(num_pages=3, page_size=2)
+    pages = a.alloc(2)                   # pool dry
+    a.share([pages[0]])
+    assert a.fork(pages[0]) is None      # no free page for the copy
+    a.free([pages[1]])
+    new, copied = a.fork(pages[0])       # now it can
+    assert copied and new == pages[1]    # LIFO recycle
+
+
+def test_pool_accounting_random_ops_vs_model():
+    rng = onp.random.RandomState(3)
+    a = PageAllocator(num_pages=17, page_size=4)
+    model = {}                           # page -> refcount oracle
+    for _ in range(600):
+        op = rng.randint(4)
+        if op == 0:
+            got = a.alloc(int(rng.randint(1, 4)))
+            if got is not None:
+                for p in got:
+                    model[p] = 1
+        elif op == 1 and model:
+            p = int(rng.choice(list(model)))
+            a.share([p])
+            model[p] += 1
+        elif op == 2 and model:
+            p = int(rng.choice(list(model)))
+            a.free([p])
+            model[p] -= 1
+            if model[p] == 0:
+                del model[p]
+        elif op == 3 and model:
+            p = int(rng.choice(list(model)))
+            got = a.fork(p)
+            if got is None:
+                continue
+            new, copied = got
+            if copied:
+                model[p] -= 1
+                model[new] = 1
+            else:
+                assert new == p and model[p] == 1
+        # invariants after every op
+        assert a.free_pages + len(model) == a.total_pages
+        for p, r in model.items():
+            assert a.refcount(p) == r
+    a.free(list(model))                  # everyone lets go once...
+    left = {p: r - 1 for p, r in model.items() if r > 1}
+    while left:                          # ...and the remaining owners
+        a.free(list(left))
+        left = {p: r - 1 for p, r in left.items() if r > 1}
+    assert a.free_pages == a.total_pages
+
+
+# ---------------------------------------------------------------------------
+# PrefixIndex
+# ---------------------------------------------------------------------------
+
+def _index(num_pages=17, ps=4):
+    a = PageAllocator(num_pages=num_pages, page_size=ps)
+    return a, PrefixIndex(a, ps)
+
+
+def test_prefix_insert_lookup_roundtrip_with_partial():
+    a, idx = _index()
+    toks = list(range(10))               # 2 full blocks + partial of 2
+    pages = a.alloc(3)
+    assert idx.insert(toks, pages) == 3
+    # index holds one reference per entry page
+    assert all(a.refcount(p) == 2 for p in pages)
+    got, n = idx.lookup(toks + [99])     # extends the cached prompt
+    assert got == pages and n == 10
+    assert all(a.refcount(p) == 3 for p in pages)   # caller attached
+    a.free(got)
+    # partial only matches when its tokens are a prefix of the rest
+    got2, n2 = idx.lookup(toks[:8] + [77, 78])
+    assert got2 == pages[:2] and n2 == 8
+    a.free(got2)
+    assert idx.longest_match(toks) == 10
+    assert idx.longest_match([42]) == 0
+
+
+def test_prefix_insert_existing_entries_refresh_not_duplicate():
+    a, idx = _index()
+    toks = list(range(8))
+    p1 = a.alloc(2)
+    assert idx.insert(toks, p1) == 2
+    p2 = a.alloc(2)
+    assert idx.insert(toks, p2) == 0     # first writer wins
+    assert idx.longest_match(toks) == 8
+    got, _ = idx.lookup(toks)
+    assert got == p1                     # the original pages serve
+    a.free(got)
+
+
+def test_lru_eviction_never_reclaims_shared_pages():
+    a, idx = _index(num_pages=9, ps=4)   # 8 allocatable
+    old = a.alloc(2)
+    idx.insert(list(range(8)), old)      # 2 entries (LRU-oldest)
+    new = a.alloc(2)
+    idx.insert(list(range(100, 108)), new)
+    a.free(old)                          # only the index owns `old` now
+    # `new` is still owned by its sequence (refcount 2): not evictable
+    assert a.free_pages == 4
+    freed = idx.evict_pages(8)
+    assert freed == 2                    # both `old` entries, LRU first
+    assert a.free_pages == 6
+    assert all(a.refcount(p) == 2 for p in new)
+    assert idx.longest_match(list(range(8))) == 0
+    assert idx.longest_match(list(range(100, 108))) == 8
+    # chain order: a parent with a child is never evicted before it —
+    # the walk stays consistent after partial eviction
+    a.free(new)
+    assert idx.evict_pages(8) == 2
+    assert a.free_pages == 8
+
+
+def test_eviction_respects_chain_parents():
+    a, idx = _index(num_pages=9, ps=2)
+    pages = a.alloc(3)
+    idx.insert([1, 2, 3, 4, 5, 6], pages)    # chain of 3 entries
+    a.free(pages)
+    assert idx.evict_pages(1) == 1           # must take the LEAF
+    # the remaining 2-block chain still matches
+    assert idx.longest_match([1, 2, 3, 4, 5, 6]) == 4
+
+
+# ---------------------------------------------------------------------------
+# engine-level: COW isolation + speculative bit-identity
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def small_model():
+    from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    cfg = GPTConfig(vocab_size=96, hidden_size=32, num_layers=2,
+                    num_heads=4, intermediate_size=64, max_position=64,
+                    dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.initialize()
+    model(mx.np.array([[1, 2]], dtype="int32"))
+    return model
+
+
+def _ref(model, prompt, max_new, eos=None):
+    ids = mx.np.array([prompt], dtype="int32")
+    return onp.asarray(model.generate(
+        ids, max_new_tokens=max_new,
+        eos_token_id=eos).asnumpy())[0].tolist()
+
+
+def test_cow_fork_isolates_writer_and_cache_survives(small_model):
+    """fork-then-write isolates the writer: request B attaches A's
+    cached prompt pages (incl. the partial block), writes past them —
+    and neither B's output nor the cache's later hits are corrupted."""
+    rng = onp.random.RandomState(5)
+    base = rng.randint(0, 96, 10).tolist()   # 2.5 pages at ps=4
+    max_new = 8
+    eng = InferenceEngine(small_model, ServeConfig(
+        max_slots=2, page_size=4, prefill_chunk=4, max_len=32,
+        prefix_cache=True))
+    eng.warmup()
+    # A populates the cache
+    assert eng.generate(base, max_new_tokens=max_new) == \
+        _ref(small_model, base, max_new)
+    assert len(eng.prefix_index) >= 3
+    # B attaches + COW-forks the partial block
+    tail = base + [7, 9]
+    forks0 = eng.scheduler.cow_forks
+    assert eng.generate(tail, max_new_tokens=max_new) == \
+        _ref(small_model, tail, max_new)
+    assert eng.scheduler.prefix_hit_tokens >= 8
+    assert eng.scheduler.cow_forks > forks0
+    # C re-reads the cache AFTER B wrote next to it: still pristine
+    assert eng.generate(base, max_new_tokens=max_new) == \
+        _ref(small_model, base, max_new)
+    # every request released its references: only the index holds pages
+    assert eng.allocator.shared_pages() == 0
+
+
+def test_speculative_streams_bit_identical_with_eos(small_model):
+    rng = onp.random.RandomState(9)
+    max_new = 10
+    prompts = [rng.randint(0, 96, rng.randint(3, 12)).tolist()
+               for _ in range(5)]
+    # pick an eos that actually appears in one reference stream so the
+    # early-stop path is exercised under speculation; the serving
+    # contract truncates at eos (generate()'s fixed-length scan pads
+    # past it instead, so the oracle is the truncated greedy stream)
+    plain = [_ref(small_model, p, max_new) for p in prompts]
+    eos = plain[0][len(prompts[0]) + min(4, max_new - 1)]
+
+    def truncated(p, full):
+        gen = full[len(p):]
+        if eos in gen:
+            gen = gen[:gen.index(eos) + 1]
+        return list(p) + gen
+
+    refs = [truncated(p, full) for p, full in zip(prompts, plain)]
+    eng = InferenceEngine(small_model, ServeConfig(
+        max_slots=3, page_size=4, prefill_chunk=5, max_len=40,
+        spec_tokens=3))
+    eng.warmup()
+    assert sorted(eng._execs) == [1, 4, 5]
+    handles = [eng.submit(p, max_new_tokens=max_new, eos_token_id=eos)
+               for p in prompts]
+    eng.run_until_idle()
+    for h, ref in zip(handles, refs):
+        assert h.result(timeout=0) == ref
+    stats = eng.scheduler.spec_stats()
+    assert stats["tokens"] == sum(len(r) - len(p)
+                                  for r, p in zip(refs, prompts))
+
+
+def test_speculation_skips_non_greedy_slots(small_model):
+    eng = InferenceEngine(small_model, ServeConfig(
+        max_slots=2, page_size=4, prefill_chunk=4, max_len=40,
+        spec_tokens=3))
+    eng.warmup()
+    g = eng.submit([3, 1, 4, 1, 5], max_new_tokens=6)
+    s = eng.submit([2, 7, 1, 8], max_new_tokens=6, greedy=False,
+                   temperature=0.9)
+    eng.run_until_idle()
+    assert g.result(timeout=0) == _ref(small_model, [3, 1, 4, 1, 5], 6)
+    out = s.result(timeout=0)             # sampled: completes, in-vocab
+    assert len(out) == 4 + 6 and all(0 <= t < 96 for t in out)
+
+
+def test_spec_export_roundtrip_and_mismatch_refusal(small_model,
+                                                    tmp_path):
+    sc = ServeConfig(max_slots=2, page_size=4, prefill_chunk=4,
+                     max_len=32, spec_tokens=4)
+    eng = InferenceEngine(small_model, sc)
+    eng.warmup()
+    assert sorted(eng._execs) == [1, 4, 5]   # chunk, decode, verify
+    ref = eng.generate([5, 4, 3, 2, 1], max_new_tokens=6)
+    path = eng.export(str(tmp_path / "spec_art"))
+
+    fresh = InferenceEngine(small_model, ServeConfig(
+        max_slots=2, page_size=4, prefill_chunk=4, max_len=32,
+        spec_tokens=4))
+    fresh.load_export(path)
+    assert sorted(fresh._execs) == [1, 4, 5]
+    assert fresh.generate([5, 4, 3, 2, 1], max_new_tokens=6) == ref
+
+    dense = InferenceEngine(small_model, ServeConfig(
+        max_slots=2, page_size=4, prefill_chunk=4, max_len=32))
+    with pytest.raises(MXNetError, match="spec_tokens"):
+        dense.load_export(path)
+
+
+# ---------------------------------------------------------------------------
+# NGramDrafter
+# ---------------------------------------------------------------------------
+
+def test_ngram_drafter_prefers_longest_recent_suffix():
+    d = NGramDrafter(max_ngram=3)
+    #      0  1  2  3  4  5  6  7
+    seq = [1, 2, 3, 9, 1, 2, 3, 9]
+    # suffix (3, 9) last occurred at 2..3 -> continuation [1, 2, 3]
+    assert d.propose(seq, 3) == [1, 2, 3]
+    assert d.propose(seq, 1) == [1]
+    # degenerate repetition extrapolates the cycle to the full k
+    assert d.propose([7, 7, 7, 7], 4) == [7, 7, 7, 7]
+    assert d.propose([5, 6, 5, 6], 4) == [5, 6, 5, 6]
+
+
+def test_ngram_drafter_misses_cleanly():
+    d = NGramDrafter(max_ngram=4)
+    assert d.propose([1, 2, 3, 4, 5], 4) == []     # no repeat anywhere
+    assert d.propose([1], 4) == []                 # too short
+    assert d.propose([1, 2, 1, 9], 0) == []        # k = 0
+    with pytest.raises(ValueError):
+        NGramDrafter(max_ngram=2, min_ngram=3)

@@ -251,6 +251,26 @@ def print_trace(path: str) -> int:
               f"{100 * worst['queue'] / denom:.0f}% queue wait, "
               f"{100 * worst['prefill'] / denom:.0f}% prefill, "
               f"{100 * worst['first_decode'] / denom:.0f}% first decode")
+        # decode fast path (docs/serving.md "Speculative decoding &
+        # prefix caching"): serve.step spans carry per-step draft/
+        # accept/prefix-hit tags
+        step_spans = [s for s in spans if s["name"] == "serve.step"]
+        drafted = sum((s.get("args") or {}).get("drafted") or 0
+                      for s in step_spans)
+        emitted = sum((s.get("args") or {}).get("emitted") or 0
+                      for s in step_spans)
+        pfx = sum((s.get("args") or {}).get("prefix_hit") or 0
+                  for s in step_spans)
+        if drafted or pfx:
+            accepted = sum((s.get("args") or {}).get("accepted") or 0
+                           for s in step_spans)
+            rate = f"{accepted / drafted:.2f}" if drafted else "-"
+            tps = (f"{emitted / len(step_spans):.2f}"
+                   if step_spans else "-")
+            print(f"  speculation: accept rate {rate} "
+                  f"({accepted}/{drafted} drafts), "
+                  f"{tps} tokens/step; prefix cache: {pfx} prompt "
+                  f"tokens served without prefill")
 
     # ---- fleet: per-replica rollup (docs/serving.md) ----------------
     # spans carry a `replica` tag when the scheduler belongs to a
@@ -264,7 +284,8 @@ def print_trace(path: str) -> int:
 
         def rep_row(name):
             return rollup.setdefault(name, {
-                "served": set(), "fo_in": 0, "fo_out": 0, "ttfts": []})
+                "served": set(), "fo_in": 0, "fo_out": 0, "ttfts": [],
+                "drafted": 0, "accepted": 0, "prefix_hit": 0})
 
         for s in spans:
             args = s.get("args") or {}
@@ -274,6 +295,11 @@ def print_trace(path: str) -> int:
                     rep_row(rep)["fo_in"] += 1
             elif s["name"] == "serve.failover" and rep is not None:
                 rep_row(rep)["fo_out"] += args.get("requests", 0)
+            elif s["name"] == "serve.step" and rep is not None:
+                row = rep_row(rep)
+                row["drafted"] += args.get("drafted") or 0
+                row["accepted"] += args.get("accepted") or 0
+                row["prefix_hit"] += args.get("prefix_hit") or 0
         # a request is SERVED BY the replica that ran its last
         # prefill/decode span; its TTFT belongs to the replica that
         # produced the first token
@@ -301,15 +327,18 @@ def print_trace(path: str) -> int:
         print(f"---------- fleet replicas ({len(rollup)}) ----------")
         if rollup:
             print(f"  {'replica':<10} {'served':>7} {'fo in':>6} "
-                  f"{'fo out':>7} {'p99 ttft':>10}  (ms)")
+                  f"{'fo out':>7} {'p99 ttft':>10} {'accept':>7} "
+                  f"{'pfx tok':>8}  (ms)")
             for name in sorted(rollup):
                 row = rollup[name]
                 ttfts = sorted(row["ttfts"])
                 p99 = _pctl(ttfts, 0.99)
                 p99_s = "-" if p99 is None else f"{p99:.2f}"
+                acc = ("-" if not row["drafted"]
+                       else f"{row['accepted'] / row['drafted']:.2f}")
                 print(f"  {name:<10} {len(row['served']):>7} "
                       f"{row['fo_in']:>6} {row['fo_out']:>7} "
-                      f"{p99_s:>10}")
+                      f"{p99_s:>10} {acc:>7} {row['prefix_hit']:>8}")
         by_reason: dict = {}
         for s in fleet_sheds:
             reason = (s.get("args") or {}).get("reason", "?")

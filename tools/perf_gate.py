@@ -50,6 +50,46 @@ def measure_once() -> tuple:
     raise RuntimeError("no JSON line in bench output")
 
 
+def record_serve_extras() -> None:
+    """RECORDED, never gated (like mfu_estimate): one `bench.py --serve
+    --spec 4` round so the per-request decode tokens/s percentiles and
+    the speculation accept rate ride every gate transcript — a decode
+    fast-path regression is then visible in the round logs even though
+    only the CPU train bench gates.  Skipped with --no-serve; any
+    failure here is a warning, never a gate verdict."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "bench.py"),
+             "--serve", "--spec", "4"],
+            capture_output=True, text=True, timeout=TIMEOUT, cwd=ROOT)
+        line = next(ln for ln in reversed(
+            proc.stdout.strip().splitlines()) if ln.startswith("{"))
+        d = json.loads(line)
+        ex = d["extras"]
+        rec = {
+            "serve_tokens_per_sec": d["value"],
+            "steps_per_token": ex.get("steps_per_token"),
+            "decode_tok_s_p50": ex.get("decode_tok_s_p50"),
+            "decode_tok_s_p99": ex.get("decode_tok_s_p99"),
+            "spec_accept_rate": (ex.get("spec") or {}).get("accept_rate"),
+            "prefix_hit_tokens": (ex.get("spec")
+                                  or {}).get("prefix_hit_tokens"),
+            "measured_at": time.strftime("%Y-%m-%d"),
+        }
+        out = os.path.join(ROOT, "bench_results", "perf_gate_serve.json")
+        with open(out, "w") as f:
+            json.dump(rec, f, indent=2)
+            f.write("\n")
+        print(f"perf-gate: serve extras (informational): "
+              f"{rec['serve_tokens_per_sec']} tok/s, decode p50/p99 "
+              f"{rec['decode_tok_s_p50']}/{rec['decode_tok_s_p99']} "
+              f"tok/s, accept {rec['spec_accept_rate']}, "
+              f"steps/token {rec['steps_per_token']} -> {out}")
+    except Exception as e:   # noqa: BLE001 — never gate on this round
+        print(f"perf-gate: serve extras round skipped ({e})",
+              file=sys.stderr)
+
+
 def main() -> int:
     vals, mfus = [], []
     for i in range(RUNS):
@@ -105,6 +145,8 @@ def main() -> int:
         print("perf-gate: median is >15% ABOVE budget — if a deliberate "
               "optimization landed, ratchet the budget up: "
               "python tools/perf_gate.py --rebaseline")
+    if "--no-serve" not in sys.argv:
+        record_serve_extras()
     return 0
 
 

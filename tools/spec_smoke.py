@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Decode-fast-path smoke (`make spec-smoke`, wired into `make test`).
+
+CPU-only, <60 s end-to-end check of speculative multi-token decoding +
+cross-request prefix caching (docs/serving.md "Speculative decoding &
+prefix caching"):
+
+- a primer request warms the prefix cache, then 6 requests whose
+  prompts share its prefix run under k=4 speculation through the
+  continuous-batching scheduler;
+- every stream must be BIT-IDENTICAL to an unbatched single-request
+  `GPTForCausalLM.generate` — speculation and prefix reuse only change
+  how many fused launches the output costs, never the output;
+- measured fused-step launches per emitted token must be < 1.0 (the
+  whole point of the fast path), with `prefix_hit_tokens > 0` (prefill
+  chunks actually skipped) and at least one copy-on-write fork
+  exercised (a write landed in a shared page and was isolated);
+- the compiled-program count must be stable: exactly one compile per
+  step width at warmup (prefill chunk, spec verify width, decode C=1)
+  and ZERO additional compiles during the run.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    t_start = time.time()
+    journal_path = os.path.join(
+        tempfile.mkdtemp(prefix="mxtpu_spec_smoke_"), "journal.jsonl")
+
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry as tele
+    from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from mxnet_tpu.serve import InferenceEngine, ServeConfig
+
+    tele.enable(journal_path=journal_path)
+
+    cfg = GPTConfig(vocab_size=96, hidden_size=32, num_layers=2,
+                    num_heads=4, intermediate_size=64, max_position=64,
+                    dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.initialize()
+    model(mx.np.array([[1, 2]], dtype="int32"))
+
+    rng = onp.random.RandomState(11)
+    max_new = 12
+    # primer + 6 requests sharing its 14-token prefix (NOT page-aligned
+    # at page_size 4, so the cached partial block forces a COW fork the
+    # moment any attacher — or the primer itself — writes past it)
+    base = rng.randint(0, 96, 14).tolist()
+    prompts = [base] + [base + rng.randint(0, 96,
+                                           rng.randint(1, 4)).tolist()
+                        for _ in range(6)]
+
+    refs = []
+    for p in prompts:
+        ids = mx.np.array([p], dtype="int32")
+        refs.append(onp.asarray(
+            model.generate(ids, max_new_tokens=max_new)
+            .asnumpy())[0].tolist())
+
+    sc = ServeConfig(max_slots=3, page_size=4, prefill_chunk=6,
+                     max_len=40, spec_tokens=4, prefix_cache=True)
+    eng = InferenceEngine(model, sc)
+    eng.warmup()
+
+    def compile_count():
+        rows = tele.RunJournal.read(journal_path)
+        return sum(1 for r in rows if r.get("event") == "compile_end"
+                   and r.get("kind") == "serve_step")
+
+    widths = eng._step_widths()
+    compiles_warm = compile_count()
+    assert compiles_warm == len(widths) == 3, (
+        f"expected one warmup compile per width {widths}, journal shows "
+        f"{compiles_warm}")
+
+    # primer runs alone: its prompt prefill populates the prefix index
+    h0 = eng.submit(prompts[0], max_new_tokens=max_new)
+    eng.run_until_idle()
+    assert h0.result(timeout=0) == refs[0], "primer stream diverged"
+
+    streams = {i: [] for i in range(1, 7)}
+    handles = []
+    for i, p in enumerate(prompts[1:], start=1):
+        handles.append(eng.submit(
+            p, max_new_tokens=max_new,
+            on_token=lambda t, r, i=i: streams[i].append(t)))
+    steps0 = eng.scheduler._steps
+    eng.run_until_idle()
+
+    for i, (h, ref) in enumerate(zip(handles, refs[1:]), start=1):
+        got = h.result(timeout=0)
+        assert got == ref, (
+            f"request {i}: speculative output diverged from generate\n"
+            f"  got {got}\n  ref {ref}")
+        assert streams[i] == ref[len(prompts[i]):], (
+            f"request {i}: streamed tokens diverged")
+
+    stats = eng.scheduler.spec_stats()
+    steps_shared = eng.scheduler._steps - steps0
+    toks_shared = 6 * max_new
+    spt = steps_shared / toks_shared
+    assert spt < 1.0, (
+        f"steps-per-token {spt:.3f} >= 1.0 over the shared-prefix phase "
+        f"({steps_shared} steps / {toks_shared} tokens) — speculation/"
+        f"prefix reuse bought nothing: {stats}")
+    assert stats["prefix_hit_tokens"] > 0, (
+        f"no prefill tokens served from the prefix cache: {stats}")
+    assert stats["cow_forks"] >= 1, (
+        f"no copy-on-write fork exercised: {stats}")
+    assert stats["proposed"] > 0 and stats["accepted"] > 0, stats
+
+    assert compile_count() == compiles_warm, (
+        f"serve step recompiled mid-run: {compile_count()} vs "
+        f"{compiles_warm} at warmup")
+
+    snap = tele.snapshot()
+    for metric in ("serve_spec_accept_rate", "serve_tokens_per_step",
+                   "serve_prefix_hit_tokens_total",
+                   "serve_kv_cow_forks_total"):
+        assert metric in snap, f"missing {metric} in telemetry snapshot"
+
+    elapsed = time.time() - t_start
+    print(json.dumps({
+        "spec_smoke": "ok", "requests": len(prompts),
+        "steps_per_token_shared_phase": round(spt, 4),
+        "accept_rate": stats["accept_rate"],
+        "prefix_hit_tokens": stats["prefix_hit_tokens"],
+        "cow_forks": stats["cow_forks"],
+        "compiled_widths": widths,
+        "elapsed_s": round(elapsed, 1)}))
+    assert elapsed < 60, f"smoke took {elapsed:.0f}s (budget 60s)"
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
