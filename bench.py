@@ -17,6 +17,7 @@ import glob
 import json
 import math
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -447,16 +448,22 @@ def _measure_serve(spec: int = 0) -> dict:
 
 
 def _measure_serve_fleet(replicas: int, kill_at: float,
-                         spec: int = 0) -> dict:
-    """`bench.py --serve --replicas N [--kill-at S] [--spec k]`:
-    aggregate fleet throughput + tail-TTFT UNDER REPLICA LOSS (the
-    ROADMAP item 1 metric).  One replica is killed `kill_at` seconds
-    into the load window; its in-flight streams fail over to survivors,
-    and the run must still report nonzero aggregate tokens/s and a
-    finite p99 TTFT measured across the whole population — loss window
-    included.  ``--spec k`` turns on per-replica speculative decoding +
-    prefix caching (with router prefix affinity) over the shared-prefix
-    mix and reports the fleet-aggregate accept rate."""
+                         spec: int = 0,
+                         kill_mode: str = "thread") -> dict:
+    """`bench.py --serve --replicas N [--kill-at S] [--spec k]
+    [--kill-mode thread|process]`: aggregate fleet throughput +
+    tail-TTFT UNDER REPLICA LOSS (the ROADMAP item 1 metric).  One
+    replica is killed `kill_at` seconds into the load window; its
+    in-flight streams fail over to survivors, and the run must still
+    report nonzero aggregate tokens/s and a finite p99 TTFT measured
+    across the whole population — loss window included.  ``--spec k``
+    turns on per-replica speculative decoding + prefix caching (with
+    router prefix affinity) over the shared-prefix mix and reports the
+    fleet-aggregate accept rate.  ``--kill-mode process`` runs the
+    fleet on the process transport and SIGKILLs a worker instead —
+    ledger failover + respawn; extras gain the failover loss window
+    (ms between the kill and the next token streamed anywhere) and the
+    respawn count."""
     import jax
     ambient = os.environ.get("JAX_PLATFORMS", "").lower()
     if not any(t in ambient for t in ("tpu", "axon")):
@@ -486,7 +493,8 @@ def _measure_serve_fleet(replicas: int, kill_at: float,
     fleet = ServeFleet(model, replicas=replicas,
                        config=ServeConfig(max_len=max_len,
                                           spec_tokens=spec,
-                                          prefix_cache=spec > 0))
+                                          prefix_cache=spec > 0),
+                       transport=kill_mode)
     compile_s = fleet.warmup()
 
     rng = _onp.random.RandomState(0)
@@ -498,6 +506,14 @@ def _measure_serve_fleet(replicas: int, kill_at: float,
                    for _ in range(n_req)]
     handles = []
     killed = None
+    kill_ts = None
+    # per-token wall timestamps: the failover loss window is the gap
+    # between the kill and the next token streamed ANYWHERE in the fleet
+    tok_times = []
+
+    def _on_token(tok, req):
+        tok_times.append(time.perf_counter())
+
     # pace arrivals so the load window straddles the kill: with
     # --kill-at S the last request arrives around 2S, guaranteeing the
     # loss lands mid-load however fast the backend decodes
@@ -513,19 +529,29 @@ def _measure_serve_fleet(replicas: int, kill_at: float,
                     time.perf_counter() - t0 >= kill_at:
                 # kill a loaded replica mid-window (prefer one holding
                 # active streams so the failover path is exercised)
+                busy = (lambda r: getattr(r.engine.scheduler, "inflight",
+                                          None)
+                        or r.engine.scheduler.active_count)
                 victim = max(
                     (r for r in fleet.replicas if r.state == "running"),
-                    key=lambda r: r.engine.scheduler.active_count,
-                    default=None)
+                    key=busy, default=None)
                 if victim is not None:
                     killed = victim.name
-                    fleet.kill(victim.name,
-                               error="bench --kill-at replica loss")
+                    kill_ts = time.perf_counter()
+                    if kill_mode == "process":
+                        # the real thing: SIGKILL the worker — no
+                        # scheduler survives, failover comes from the
+                        # router's stream ledger and the worker respawns
+                        os.kill(victim.pid, signal.SIGKILL)
+                    else:
+                        fleet.kill(victim.name,
+                                   error="bench --kill-at replica loss")
             now = time.perf_counter() - t0
             if arrivals and now >= next_arrival:
                 try:
                     handles.append(fleet.submit(
-                        arrivals[0], max_new_tokens=max_new))
+                        arrivals[0], max_new_tokens=max_new,
+                        on_token=_on_token))
                     arrivals.pop(0)
                     next_arrival = now + pace
                 except ShedError as e:
@@ -557,14 +583,24 @@ def _measure_serve_fleet(replicas: int, kill_at: float,
         "compile_seconds": round(compile_s, 2),
         "replicas": replicas,
         "kill_at_s": kill_at,
+        "kill_mode": kill_mode,
         "killed_replica": killed,
         "deaths": fleet.deaths,
+        "respawns": fleet.respawns,
         "failovers": sum(h.failovers for h in handles),
         "evictions": sum(h.evictions for h in handles),
         "sheds": stats["router"]["sheds"],
         "routed": stats["router"]["routed"],
         "replica_states": {n: r["state"]
                            for n, r in stats["replicas"].items()},
+        # ms from the kill to the next token streamed anywhere in the
+        # fleet — the user-visible failover stall (None: no kill, or no
+        # token landed after it)
+        "failover_loss_window_ms": (round(
+            (min(ts for ts in tok_times if ts > kill_ts) - kill_ts)
+            * 1e3, 1)
+            if kill_ts is not None
+            and any(ts > kill_ts for ts in tok_times) else None),
         "device": getattr(dev, "device_kind", str(dev)),
         "platform": dev.platform,
         **_decode_rate_pcts(handles),
@@ -575,6 +611,10 @@ def _measure_serve_fleet(replicas: int, kill_at: float,
         agg = {"proposed": 0, "accepted": 0, "steps": 0, "tokens": 0,
                "prefix_hit_tokens": 0, "cow_forks": 0}
         for rep in fleet.replicas:
+            # process replicas run speculation inside the worker; their
+            # proxy scheduler has no spec counters to aggregate
+            if not hasattr(rep.engine.scheduler, "spec_stats"):
+                continue
             ss = rep.engine.scheduler.spec_stats()
             for k in agg:
                 agg[k] += ss[k] or 0
@@ -1302,11 +1342,20 @@ def main():
                 # replica loss (docs/serving.md "Fleet, failover &
                 # overload"); --kill-at S kills a loaded replica S
                 # seconds into the load window
+                # --kill-mode process: process-transport fleet, the
+                # kill is a real SIGKILL on a worker (ledger failover
+                # + respawn instead of in-process salvage)
+                kill_mode = _flag_operand("--kill-mode", "thread") \
+                    if "--kill-mode" in sys.argv else "thread"
+                if kill_mode not in ("thread", "process"):
+                    raise SystemExit(
+                        f"--kill-mode must be thread|process, "
+                        f"got {kill_mode!r}")
                 print(json.dumps(_measure_serve_fleet(
                     int(_flag_operand("--replicas", "2")),
                     (float(_flag_operand("--kill-at", "0"))
                      if "--kill-at" in sys.argv else None),
-                    spec=spec)))
+                    spec=spec, kill_mode=kill_mode)))
             else:
                 print(json.dumps(_measure_serve(spec=spec)))
         return

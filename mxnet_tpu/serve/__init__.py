@@ -25,12 +25,14 @@ from .scheduler import ContinuousBatchingScheduler, ServeRequest  # noqa: F401
 from .spec import Drafter, NGramDrafter  # noqa: F401
 from .engine import InferenceEngine, ServeConfig  # noqa: F401
 from .router import RequestRouter, ShedError  # noqa: F401
-from .fleet import Replica, ServeFleet  # noqa: F401
+from .fleet import ProcessReplica, Replica, ServeFleet  # noqa: F401
+from .wire import WireClient, WireError, WireTimeout  # noqa: F401
 
 __all__ = [
     "InferenceEngine", "ServeConfig", "ContinuousBatchingScheduler",
     "ServeRequest", "KVPools", "PageAllocator", "PrefixIndex",
     "Drafter", "NGramDrafter", "extract_decode_weights",
     "transformer_step", "lm_logits",
-    "ServeFleet", "Replica", "RequestRouter", "ShedError",
+    "ServeFleet", "Replica", "ProcessReplica", "RequestRouter",
+    "ShedError", "WireClient", "WireError", "WireTimeout",
 ]

@@ -55,7 +55,15 @@ def extract_decode_weights(model) -> dict:
     (attn_norm, attention.attn_qkv/attn_proj, ffn_norm,
     ffn.ffn_intermediate/ffn_output) / final_norm, plus an optional
     ``.lm_head``).  Returns the dict pytree `transformer_step` consumes.
+
+    A model carrying a prebuilt ``_decode_weights`` pytree short-circuits
+    the extraction — the process-fleet worker (`serve.worker`) rebuilds
+    an engine from spec-dir serialized weights without materializing the
+    full ``HybridBlock`` parameter tree.
     """
+    pre = getattr(model, "_decode_weights", None)
+    if pre is not None:
+        return pre
     t = model.transformer
 
     def w(p):

@@ -1,54 +1,79 @@
 """Supervised serving fleet: N `InferenceEngine` replicas behind one
-router, with replica supervision, mid-stream failover, graceful drain.
+router, with replica supervision, mid-stream failover, graceful drain,
+and (since the process transport) worker respawn.
 
-The robustness tier the training side already has (fault registry →
-recovery ladder → elastic reform) applied to serving: a replica is an
-in-process driver thread pumping its own engine — the SAME simulation
-pattern `parallel/elastic_mesh.py` uses for hosts (partitions of one
-process stand in for real processes; the control path is identical, so
-moving a replica behind an RPC boundary later changes the transport,
-not the protocol).
+Two replica transports share ONE supervision/state machine
+(``MXTPU_FLEET_TRANSPORT``, docs/serving.md "Process fleet"):
+
+- ``thread`` — a replica is an in-process driver thread pumping its own
+  engine (the `parallel/elastic_mesh.py` host-simulation pattern);
+- ``process`` — a replica is a real OS process (`serve.worker`) spawned
+  via ``subprocess`` and reached over the `serve.wire` RPC protocol.
+  The router keeps a per-request **stream ledger** (the local
+  `ServeRequest` objects, fed token-by-token by the stream RPC), so a
+  ``kill -9``'d worker — which has no scheduler left to `salvage()` —
+  still fails over from the parent's copy of each stream: the emitted
+  tokens fold into the re-prefill prefix (the eviction rule) and greedy
+  streams resume bit-identical on a survivor, never re-emitting.
 
 Supervision protocol (docs/serving.md "Fleet, failover & overload"):
 
-- every driver touches a per-replica heartbeat
-  (``serve.replica.<name>`` via `health.beat`) once per loop;
+- every replica touches a per-replica heartbeat
+  (``serve.replica.<name>`` via `health.beat`) — thread drivers once
+  per loop, process workers via ~5 Hz heartbeat events;
 - a **supervisor thread** declares a replica dead on (a) an escaped
-  exception from its step loop (device failure, injected
-  ``replica_step`` fault), (b) a driver thread that exited without
-  reporting, or (c) a heartbeat older than ``stall_timeout`` while the
-  replica holds work — the wedged-in-device-call case;
-- a dead replica is retired WHOLE (engine, pool, allocator — nothing is
-  scavenged from a suspect pool) and its in-flight requests are
-  **salvaged**: collected un-terminated and re-dispatched through the
-  router with their generated tokens folded into the re-prefill prefix,
-  exactly the eviction rule — greedy streams resume **bit-identical**
-  on the survivor and never re-emit a token;
-- `drain()` is the graceful inverse: the router stops selecting the
-  replica, its queued (no-progress) requests are handed back, its
-  active streams run to completion, and the driver exits with an empty
-  active set — shrink and rolling restarts without a dropped request.
+  exception from its step loop, (b) a driver thread / worker process /
+  event stream that exited without reporting, or (c) a heartbeat older
+  than ``stall_timeout`` while the replica holds work — the
+  wedged-in-device-call (or ``SIGSTOP``-wedged-socket) case;
+- a dead replica is retired WHOLE and its in-flight requests are
+  **salvaged** (from its scheduler, or from the stream ledger when the
+  process is simply gone) and re-dispatched through the router;
+- a dead replica **respawns** under a fleet-wide budget
+  (``MXTPU_REPLICA_RESPAWNS`` — the dataloader-worker pattern): a fresh
+  engine/worker replaces it under the same name, journalled as a
+  ``replica_respawn`` event.  An exhausted budget degrades to the old
+  permanently-shrinking behavior with a loud log;
+- `drain()` is the graceful inverse — for process replicas it travels
+  over the wire: the worker detaches its queued work (handed back to
+  the router), finishes its active streams, reports ``drained`` and
+  exits cleanly.
 
-Failure matrix: see docs/serving.md.  Chaos: arm
-``MXTPU_FAULT_SPEC=replica_step@N`` (die mid-step) and
-``router_dispatch@N`` (dispatch edge fault) — `make fleet-smoke` does
-both and asserts zero dropped requests and bit-identical streams.
+Failure matrix: see docs/serving.md.  Chaos: ``replica_step`` (die
+mid-step), ``router_dispatch`` (dispatch edge), ``rpc_send`` /
+``rpc_recv`` (dropped control frames), ``worker_spawn`` (spawn
+failure) — `make fleet-smoke` and `make procfleet-smoke` arm them and
+assert zero dropped requests and bit-identical streams.
 """
 from __future__ import annotations
 
+import logging
+import math
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
 import threading
 import time
+from collections import OrderedDict
 from typing import List, Optional
 
 from ..base import MXNetError
+from ..resilience import fault_point
 from .. import health as _health
 from .. import telemetry as _tele
 from .. import tracing as _trace
 from .engine import InferenceEngine, ServeConfig, _env_int
 from .router import RequestRouter
-from .scheduler import ServeRequest, terminate_request
+from .scheduler import (ContinuousBatchingScheduler, ServeRequest,
+                        deliver_token, expire_request, finish_request,
+                        terminate_request)
+from . import wire
 
-__all__ = ["ServeFleet", "Replica"]
+__all__ = ["ServeFleet", "Replica", "ProcessReplica"]
+
+_log = logging.getLogger(__name__)
 
 
 class Replica:
@@ -57,9 +82,13 @@ class Replica:
     ``state`` lifecycle: ``starting`` (accepts work, driver not yet
     running) → ``running`` → ``draining`` → ``drained``, or → ``dead``
     (exception/stall/kill), or → ``stopped`` (fleet closed).  Dead,
-    drained and stopped are terminal."""
+    drained and stopped are terminal — but a dead replica may be
+    REPLACED by a respawned one under the same name
+    (``MXTPU_REPLICA_RESPAWNS``)."""
 
-    def __init__(self, name: str, engine: InferenceEngine):
+    transport = "thread"
+
+    def __init__(self, name: str, engine):
         self.name = name
         self.engine = engine
         self.state = "starting"
@@ -67,6 +96,9 @@ class Replica:
         self.wake = threading.Event()
         self.drained_event = threading.Event()
         self.error: Optional[str] = None
+        self.pid: Optional[int] = os.getpid()
+        #: respawn lineage: 0 = original, +1 per respawn under this name
+        self.generation = 0
 
     @property
     def heartbeat_name(self) -> str:
@@ -75,10 +107,450 @@ class Replica:
     def notify(self) -> None:
         self.wake.set()
 
+    def start_driver(self, fleet: "ServeFleet") -> None:
+        self.thread = threading.Thread(
+            target=fleet._drive, args=(self,), daemon=True,
+            name=f"serve-replica-{self.name}")
+        self.thread.start()
+
+    def probe(self, ages: dict, stall_timeout: float) -> Optional[str]:
+        """Supervisor liveness check; an error string means dead."""
+        sched = self.engine.scheduler
+        busy = sched.active_count or sched.queue_depth
+        if self.thread is not None and not self.thread.is_alive():
+            # backstop: the driver died without reporting
+            return "driver thread exited"
+        age = ages.get(self.heartbeat_name)
+        if age is not None and age > stall_timeout and busy:
+            return (f"replica stalled: no heartbeat for "
+                    f"{age:.1f}s (> {stall_timeout:.1f}s) "
+                    f"with work in flight")
+        return None
+
+    def terminate(self, force: bool = False) -> None:
+        """Tear down transport resources (no-op for thread replicas —
+        the driver exits on the state check)."""
+
     def __repr__(self):
         s = self.engine.scheduler
         return (f"Replica({self.name}, {self.state}, active="
                 f"{s.active_count}, queued={s.queue_depth})")
+
+
+# ---------------------------------------------------------------------------
+# process transport: remote engine/scheduler proxies + the worker handle
+# ---------------------------------------------------------------------------
+
+class _RemoteAllocator:
+    """Stats-only stand-in for `PageAllocator`: the router's load scores
+    and `validate_request` read page counts; the REAL allocator lives in
+    the worker.  ``free_pages`` mirrors the worker's heartbeats."""
+
+    def __init__(self, page_size: int, num_pages: int):
+        self.page_size = int(page_size)
+        self.num_pages = int(num_pages)
+        self.free_pages = self.total_pages
+
+    @property
+    def total_pages(self) -> int:
+        return self.num_pages - 1          # page 0 is the null page
+
+    def pages_for(self, tokens: int) -> int:
+        return max(1, math.ceil(tokens / self.page_size))
+
+    def shared_pages(self) -> int:
+        return 0
+
+
+class _Ledger:
+    """Stream-ledger entry: the caller-side request plus the token
+    offset its current dispatch started from (re-dispatch folds emitted
+    tokens into the prompt, so the worker's token indices restart at 0)
+    and a stash for any out-of-order arrival."""
+
+    __slots__ = ("req", "base", "stash")
+
+    def __init__(self, req: ServeRequest):
+        self.req = req
+        self.base = len(req.tokens)
+        self.stash = {}
+
+
+class _RemoteScheduler:
+    """Parent-side proxy for a worker's scheduler: dispatch goes over
+    the wire, stream events mirror back onto the ledgered
+    `ServeRequest` objects through the SAME `deliver_token` /
+    `finish_request` / `terminate_request` paths the in-process
+    scheduler uses.  `salvage()` — the whole point — needs no worker at
+    all: the ledger IS the in-flight set."""
+
+    def __init__(self, engine: "_RemoteEngine", name: str):
+        self.engine = engine
+        sc = engine.serve_config
+        self.max_slots = sc.max_slots
+        self.page_size = sc.page_size
+        self.max_len = engine.max_len
+        self.allocator = engine.allocator
+        self.name = name
+        self.draining = False
+        self.salvage_on_error = True
+        self._abandoned = False
+        # reentrant: an on_token callback delivered under this lock may
+        # re-enter (e.g. submit a follow-up request through the router)
+        self._lock = threading.RLock()
+        self._ledger: "OrderedDict[int, _Ledger]" = OrderedDict()
+        self._stats = {"queued": 0, "active": 0}
+        self._submitted_since_hb = 0
+        self.replica: Optional["ProcessReplica"] = None
+
+    # the one validation authority — shared with the in-process
+    # scheduler by calling its method on this duck-typed proxy (it only
+    # reads ``max_len`` and ``allocator``)
+    validate_request = ContinuousBatchingScheduler.validate_request
+
+    # -- router-facing surface -----------------------------------------
+    @property
+    def queue_depth(self) -> int:
+        return self._stats["queued"] + self._submitted_since_hb
+
+    @property
+    def active_count(self) -> int:
+        return self._stats["active"]
+
+    @property
+    def inflight(self) -> int:
+        """Ledgered (dispatched, unfinished) requests — the busy signal
+        for quiesce/stall checks; heartbeat stats may lag."""
+        with self._lock:
+            return len(self._ledger)
+
+    def enqueue(self, req: ServeRequest, front: bool = False) -> None:
+        """Dispatch one request to the worker (the router's edge).  Any
+        wire failure raises `MXNetError` — the router parks the request
+        instead of dropping it.  Retried frames are safe: the worker
+        dedupes by the router-assigned rid."""
+        with self._lock:
+            if self.draining or self._abandoned:
+                raise MXNetError(
+                    f"replica {self.name} is "
+                    f"{'draining' if self.draining else 'retired'} and "
+                    f"not accepting requests")
+        rep = self.replica
+        if rep is None or not rep.ready.is_set():
+            raise MXNetError(
+                f"replica {self.name} is not connected yet "
+                f"(worker warming up)")
+        remaining = 0.0
+        if req.deadline_ms > 0:
+            remaining = max(1.0, req.deadline_ms - (
+                time.perf_counter() - req.submitted_ts) * 1e3)
+        rep.call(
+            "submit", rid=req.id, prompt=req._sequence(),
+            max_new=req.max_new_tokens - len(req.tokens),
+            greedy=req.greedy, temperature=req.temperature,
+            eos=req.eos_token_id, front=bool(front),
+            deadline_ms=remaining,
+            _span_parent=(req._span.context()
+                          if req._span is not None else None),
+            _track=f"serve req {req.id}")
+        with self._lock:
+            if self._abandoned:
+                # the replica died between the accepted RPC and this
+                # insert; its salvage already ran — re-park via the
+                # router (the worker that accepted the frame is gone)
+                raise MXNetError(
+                    f"replica {self.name} retired during dispatch")
+            self._ledger[req.id] = _Ledger(req)
+            self._submitted_since_hb += 1
+        req.state = "queued"
+
+    # -- event mirror (the ProcessReplica reader thread) ---------------
+    def on_hb(self, ev: dict) -> None:
+        with self._lock:
+            self._stats["queued"] = int(ev.get("queued", 0))
+            self._stats["active"] = int(ev.get("active", 0))
+            self._submitted_since_hb = 0
+        fp = ev.get("free_pages")
+        if fp is not None:
+            self.allocator.free_pages = int(fp)
+
+    def on_token(self, rid: int, i: int, tok: int) -> None:
+        """Apply one streamed token to the ledger: contiguous tokens
+        deliver, duplicates drop, gaps stash until filled — the stream
+        can never re-emit or skip."""
+        with self._lock:
+            if self._abandoned:
+                return
+            e = self._ledger.get(rid)
+            if e is None:
+                return               # finished/salvaged: late event
+            req = e.req
+            if i < len(req.tokens) - e.base:
+                return               # duplicate
+            e.stash[i] = int(tok)
+            while True:
+                t = e.stash.pop(len(req.tokens) - e.base, None)
+                if t is None:
+                    return
+                if deliver_token(req, t, replica=self.name):
+                    self._ledger.pop(rid, None)
+                    finish_request(req, replica=self.name)
+                    return
+
+    def on_done(self, rid: int, state: str, tokens: List[int],
+                error: Optional[str], expired: bool) -> None:
+        """Terminal record from the worker (carries the FULL token
+        list): reconcile any tokens whose ``tok`` frames raced the
+        close, then finish/fail through the shared terminal paths."""
+        with self._lock:
+            if self._abandoned:
+                return
+            e = self._ledger.pop(rid, None)
+            if e is None:
+                return
+            req = e.req
+            if state == "finished":
+                for t in tokens[len(req.tokens) - e.base:]:
+                    if deliver_token(req, int(t), replica=self.name):
+                        break
+                finish_request(req, replica=self.name)
+            elif expired:
+                expire_request(req, "active", replica=self.name)
+            else:
+                terminate_request(
+                    req, error or "worker reported failure",
+                    state="failed", phase="failed", replica=self.name,
+                    generated=len(req.tokens))
+
+    # -- fleet hooks -----------------------------------------------------
+    def detach_queued(self) -> List[ServeRequest]:
+        """Drain-over-the-wire: the worker detaches its queued requests
+        and returns their rids; the matching ledger entries hand back to
+        the router while the worker's actives run to completion."""
+        rep = self.replica
+        if rep is None:
+            return []
+        try:
+            resp = rep.call("drain")
+        except MXNetError:
+            # worker unreachable mid-drain: the supervisor will declare
+            # it dead and salvage the whole ledger instead
+            return []
+        out: List[ServeRequest] = []
+        with self._lock:
+            for rid in resp.get("queued", []):
+                e = self._ledger.pop(rid, None)
+                if e is not None and not e.req.done():
+                    out.append(e.req)
+        for r in out:
+            r.state = "queued"
+        return out
+
+    def salvage(self, lock_timeout: float = 5.0) -> List[ServeRequest]:
+        """Retire this proxy and return every ledgered request
+        un-terminated — requests with streamed progress first, each with
+        its epoch bumped so any late wire event is discarded.  The
+        SIGKILL path: no worker participates."""
+        with self._lock:
+            self._abandoned = True
+            entries = list(self._ledger.values())
+            self._ledger.clear()
+            self._submitted_since_hb = 0
+            self._stats["queued"] = self._stats["active"] = 0
+        progressed = [e.req for e in entries if e.req.tokens]
+        fresh = [e.req for e in entries if not e.req.tokens]
+        reqs = [r for r in progressed + fresh if not r.done()]
+        for r in reqs:
+            r._epoch += 1
+            r.state = "queued"
+        return reqs
+
+
+class _RemoteEngine:
+    """Engine-shaped proxy for a worker process: carries the config /
+    capacity math the router and validation need; the compiled step and
+    the KV pool live in the worker."""
+
+    def __init__(self, model_cfg, serve_config: ServeConfig, name: str):
+        self.cfg = model_cfg
+        self.serve_config = serve_config
+        self.max_len = serve_config.max_len or model_cfg.max_position
+        self.max_pages_per_seq = max(
+            1, math.ceil(self.max_len / serve_config.page_size))
+        num_pages = serve_config.num_pages or \
+            serve_config.max_slots * self.max_pages_per_seq + 1
+        self.allocator = _RemoteAllocator(serve_config.page_size,
+                                          num_pages)
+        self.prefix_index = None
+        self._steps_executed = 0           # mirrored from heartbeats
+        self.scheduler = _RemoteScheduler(self, name)
+
+
+class ProcessReplica(Replica):
+    """A replica hosted in a spawned `serve.worker` process, reached
+    over the wire protocol.  Same lifecycle/supervision surface as the
+    thread replica; `engine` is a `_RemoteEngine` proxy whose scheduler
+    keeps the stream ledger."""
+
+    transport = "process"
+
+    def __init__(self, name: str, fleet: "ServeFleet", idx: int):
+        super().__init__(name,
+                         _RemoteEngine(fleet.model.cfg, fleet.config,
+                                       name))
+        self.engine.scheduler.replica = self
+        self._fleet = fleet
+        self._idx = idx
+        self.proc: Optional[subprocess.Popen] = None
+        self.pid = None
+        self.ready = threading.Event()
+        self.compile_seconds: Optional[float] = None
+        self._control: Optional[wire.WireClient] = None
+        self._events = None
+        self._reader: Optional[threading.Thread] = None
+
+    def call(self, verb: str, **kw) -> dict:
+        c = self._control
+        if c is None:
+            raise wire.WireError(
+                f"replica {self.name} has no control channel")
+        return c.call(verb, **kw)
+
+    def spawn(self, timeout: float = 120.0) -> None:
+        """`worker_spawn` fault point, then ``python -m
+        mxnet_tpu.serve.worker`` against the fleet's spec dir; blocks
+        until the worker connected both channels AND reported ready
+        (engine rebuilt + warmed)."""
+        fault_point("worker_spawn")
+        fleet = self._fleet
+        listener = fleet._ensure_listener()
+        listener.expect(self.name)
+        t0 = time.perf_counter()
+        cmd = [sys.executable, "-m", "mxnet_tpu.serve.worker",
+               "--name", self.name, "--host", listener.host,
+               "--port", str(listener.port),
+               "--spec", fleet._write_spec(),
+               "--seed", str(fleet._seed + self._idx)]
+        self.proc = subprocess.Popen(cmd)
+        try:
+            control, events, hello = listener.wait(
+                self.name, timeout=timeout,
+                alive=lambda: self.proc.poll() is None)
+            self.pid = hello.get("pid") or self.proc.pid
+            self._control = wire.WireClient(control, replica=self.name)
+            self._events = events
+            self._reader = threading.Thread(
+                target=self._read_events, daemon=True,
+                name=f"serve-wire-{self.name}")
+            self._reader.start()
+            deadline = time.monotonic() + timeout
+            while not self.ready.wait(0.1):
+                if self.proc.poll() is not None:
+                    raise MXNetError(
+                        f"worker {self.name} exited "
+                        f"(rc={self.proc.returncode}) during warmup")
+                if time.monotonic() > deadline:
+                    raise MXNetError(
+                        f"worker {self.name} never became ready "
+                        f"within {timeout:.0f}s")
+        except BaseException:
+            self.terminate(force=True)
+            raise
+        _health.beat(self.heartbeat_name)
+        if _trace.enabled():
+            _trace.get_tracer("serve").record_span(
+                "serve.replica", t0, time.perf_counter(),
+                track="serve fleet", replica=self.name,
+                transport=self.transport, pid=self.pid,
+                generation=self.generation,
+                compile_seconds=self.compile_seconds)
+
+    def start_driver(self, fleet: "ServeFleet") -> None:
+        pass      # no driver thread: the reader + supervisor own liveness
+
+    def _read_events(self) -> None:
+        """Drain the worker's event stream.  EOF (or a wire error) with
+        the replica still non-terminal means the worker died — the
+        fast-path death report (the supervisor's poll is the backstop)."""
+        sched = self.engine.scheduler
+        fatal = None
+        try:
+            while True:
+                ev = wire.recv_frame(self._events)
+                if ev is None:
+                    break
+                kind = ev.get("ev")
+                if kind == "tok":
+                    sched.on_token(ev["rid"], ev["i"], ev["t"])
+                elif kind == "hb":
+                    _health.beat(self.heartbeat_name)
+                    sched.on_hb(ev)
+                    self.engine._steps_executed = int(
+                        ev.get("steps", self.engine._steps_executed))
+                elif kind == "done":
+                    _health.beat(self.heartbeat_name)
+                    sched.on_done(ev["rid"], ev.get("state", "failed"),
+                                  ev.get("tokens") or [],
+                                  ev.get("error"),
+                                  bool(ev.get("expired")))
+                elif kind == "ready":
+                    self.compile_seconds = ev.get("compile_seconds")
+                    _health.beat(self.heartbeat_name)
+                    self.ready.set()
+                elif kind == "drained":
+                    self._fleet._finish_drain(self)
+                elif kind == "fatal":
+                    fatal = ev.get("error")
+        except wire.WireError:
+            pass
+        if self._fleet._stop.is_set():
+            return
+        if self.state in ("starting", "running", "draining"):
+            self._fleet._replica_died(self, MXNetError(
+                fatal or f"worker {self.name} connection lost"))
+
+    def probe(self, ages: dict, stall_timeout: float) -> Optional[str]:
+        if self.proc is not None and self.proc.poll() is not None:
+            return (f"worker process exited "
+                    f"(rc={self.proc.returncode})")
+        if self._reader is not None and not self._reader.is_alive():
+            return "worker event stream closed"
+        busy = self.engine.scheduler.inflight
+        age = ages.get(self.heartbeat_name)
+        if age is not None and age > stall_timeout and busy:
+            return (f"replica stalled: no heartbeat for "
+                    f"{age:.1f}s (> {stall_timeout:.1f}s) "
+                    f"with work in flight")
+        return None
+
+    def terminate(self, force: bool = False) -> None:
+        """Stop the worker: graceful shutdown RPC first (unless
+        `force`), then SIGKILL; closes both channels (which unblocks
+        any in-flight RPC with a wire error and ends the reader)."""
+        if not force and self.proc is not None \
+                and self.proc.poll() is None and self._control is not None:
+            try:
+                self._control.call("shutdown", _timeout_ms=1000)
+            except MXNetError:
+                pass
+        if self.proc is not None and self.proc.poll() is None:
+            try:
+                self.proc.kill()
+            except OSError:
+                pass
+        if self._control is not None:
+            self._control.close()
+        if self._events is not None:
+            try:
+                self._events.close()
+            except OSError:
+                pass
+
+    def __repr__(self):
+        s = self.engine.scheduler
+        return (f"ProcessReplica({self.name}, {self.state}, "
+                f"pid={self.pid}, gen={self.generation}, "
+                f"inflight={s.inflight})")
 
 
 class ServeFleet:
@@ -92,9 +564,13 @@ class ServeFleet:
             out = h.result(timeout=30)
 
     `submit` routes through the fleet's `RequestRouter` (load-aware
-    dispatch, bounded global queue, load shedding — `ShedError`).  All
-    replicas share the model weights and, after `warmup()`, the SAME
-    compiled step executables (replica 0 lowers, the rest adopt).
+    dispatch, bounded global queue, load shedding — `ShedError`).
+    Thread transport: all replicas share the model weights and, after
+    `warmup()`, the SAME compiled step executables (replica 0 lowers,
+    the rest adopt).  Process transport
+    (``MXTPU_FLEET_TRANSPORT=process`` or ``transport="process"``):
+    `warmup()` serializes a spec dir and spawns one `serve.worker` per
+    replica; each worker compiles its own engine.
     """
 
     def __init__(self, model, replicas: Optional[int] = None,
@@ -103,7 +579,10 @@ class ServeFleet:
                  shed_deadline_ms: Optional[float] = None,
                  stall_timeout: float = 10.0,
                  poll_interval: float = 0.02,
-                 supervise_interval: Optional[float] = None):
+                 supervise_interval: Optional[float] = None,
+                 transport: Optional[str] = None,
+                 respawn_budget: Optional[int] = None,
+                 spawn_timeout: float = 120.0):
         n = replicas if replicas is not None \
             else _env_int("MXTPU_SERVE_REPLICAS", 2)
         if n < 1:
@@ -115,15 +594,34 @@ class ServeFleet:
         self.supervise_interval = float(
             supervise_interval if supervise_interval is not None
             else max(0.01, min(0.25, self.stall_timeout / 4)))
+        self.transport = (transport
+                          or os.environ.get("MXTPU_FLEET_TRANSPORT", "")
+                          or "thread").strip().lower()
+        if self.transport not in ("thread", "process"):
+            raise MXNetError(
+                f"MXTPU_FLEET_TRANSPORT must be 'thread' or 'process', "
+                f"got {self.transport!r}")
+        self.spawn_timeout = float(spawn_timeout)
+        # respawn budget (MXTPU_REPLICA_RESPAWNS): fleet-wide count of
+        # replica deaths healed in place.  Defaults to 2 for the process
+        # transport (workers are disposable by design) and 0 for the
+        # thread transport (a dead in-process replica keeps today's
+        # permanent-retire semantics unless opted in).
+        if respawn_budget is None:
+            respawn_budget = _env_int(
+                "MXTPU_REPLICA_RESPAWNS",
+                2 if self.transport == "process" else 0)
+        self.respawn_budget = max(0, int(respawn_budget))
+        self.respawns = 0
+        self.retired: List[Replica] = []
+        self._seed = seed
+        self._listener: Optional[wire.Listener] = None
+        self._spec_path: Optional[str] = None
+        self._exec_source: Optional[InferenceEngine] = None
+        self._respawn_threads: List[threading.Thread] = []
         self.replicas: List[Replica] = []
         for i in range(n):
-            eng = InferenceEngine(model, self.config, seed=seed + i)
-            rep = Replica(f"r{i}", eng)
-            eng.scheduler.name = rep.name
-            # fleet mode: a failed device step leaves requests for
-            # salvage instead of terminally failing them
-            eng.scheduler.salvage_on_error = True
-            self.replicas.append(rep)
+            self.replicas.append(self._make_replica(i))
         self.router = RequestRouter(
             lambda: list(self.replicas), queue_bound=router_queue,
             shed_deadline_ms=shed_deadline_ms,
@@ -136,18 +634,78 @@ class ServeFleet:
         self._started = False
         self._closed = False
 
+    def _make_replica(self, idx: int, generation: int = 0) -> Replica:
+        name = f"r{idx}"
+        if self.transport == "process":
+            rep = ProcessReplica(name, self, idx)
+        else:
+            eng = InferenceEngine(self.model, self.config,
+                                  seed=self._seed + idx)
+            rep = Replica(name, eng)
+            eng.scheduler.name = name
+            # fleet mode: a failed device step leaves requests for
+            # salvage instead of terminally failing them
+            eng.scheduler.salvage_on_error = True
+        rep.generation = generation
+        return rep
+
     # ------------------------------------------------------------------
     # lifecycle
     # ------------------------------------------------------------------
+    def _write_spec(self) -> str:
+        """Serialize the model + serving config once per fleet — the
+        worker-spawn recipe (`serve.worker.write_spec`)."""
+        if self._spec_path is None:
+            from .worker import write_spec
+            self._spec_path = write_spec(
+                tempfile.mkdtemp(prefix="mxtpu_fleet_spec_"),
+                self.model, self.config)
+        return self._spec_path
+
+    def _ensure_listener(self) -> wire.Listener:
+        with self._lock:
+            if self._listener is None:
+                self._listener = wire.Listener()
+            return self._listener
+
     def warmup(self) -> float:
-        """Compile the step programs ONCE (replica 0 — live AOT lower or
-        an export-artifact load, docs/export.md) and share the
-        executables with every other replica.  Returns replica 0's
-        compile seconds."""
+        """Thread transport: compile the step programs ONCE (replica 0 —
+        live AOT lower or an export-artifact load, docs/export.md) and
+        share the executables with every other replica.  Process
+        transport: write the spec dir and spawn every worker in
+        parallel, waiting until each reports ready.  Returns the
+        longest compile seconds observed."""
+        if self._warmed:
+            return 0.0
+        if self.transport == "process":
+            errors: List[BaseException] = []
+
+            def _spawn(rep):
+                try:
+                    rep.spawn(self.spawn_timeout)
+                except BaseException as e:  # noqa: B036 — reported below
+                    errors.append(e)
+
+            threads = [threading.Thread(target=_spawn, args=(rep,),
+                                        daemon=True,
+                                        name=f"serve-spawn-{rep.name}")
+                       for rep in self.replicas]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(self.spawn_timeout + 10)
+            if errors:
+                for rep in self.replicas:
+                    rep.terminate(force=True)
+                raise errors[0]
+            self._warmed = True
+            return max((rep.compile_seconds or 0.0)
+                       for rep in self.replicas)
         first = self.replicas[0].engine
         secs = first.warmup()
         for rep in self.replicas[1:]:
             rep.engine.adopt_executables(first)
+        self._exec_source = first
         self._warmed = True
         return secs
 
@@ -156,8 +714,10 @@ class ServeFleet:
             return self
         if self._closed:
             raise MXNetError(
-                "this ServeFleet was closed — its replicas are retired; "
-                "create a new fleet instead of restarting")
+                "this ServeFleet was closed — close() is terminal and "
+                "its replicas are retired; create a new fleet.  (A "
+                "replica DEATH, by contrast, heals in place via the "
+                "MXTPU_REPLICA_RESPAWNS respawn budget.)")
         if not self._warmed:
             self.warmup()
         self._started = True
@@ -166,11 +726,9 @@ class ServeFleet:
                 continue
             rep.state = "running"
             _health.beat(rep.heartbeat_name)
-            rep.thread = threading.Thread(
-                target=self._drive, args=(rep,), daemon=True,
-                name=f"serve-replica-{rep.name}")
-            rep.thread.start()
+            rep.start_driver(self)
             self._journal_replica(rep, "started")
+            self._trace_replica(rep)
         self._supervisor = threading.Thread(
             target=self._supervise, daemon=True, name="serve-supervisor")
         self._supervisor.start()
@@ -178,18 +736,22 @@ class ServeFleet:
         return self
 
     def close(self, timeout: float = 10.0) -> None:
-        """Stop every driver and the supervisor; the fleet is terminal
-        afterwards (submit sheds `no_replicas`, start() raises).  Does
-        NOT drain — call `drain()` per replica first for a graceful
-        rolling stop."""
+        """Stop every driver/worker and the supervisor; the fleet is
+        terminal afterwards (submit sheds `no_replicas`, start()
+        raises).  Does NOT drain — call `drain()` per replica first for
+        a graceful rolling stop."""
         self._stop.set()
         for rep in self.replicas:
             rep.notify()
+        for rep in self.replicas:
+            rep.terminate()
         for rep in self.replicas:
             if rep.thread is not None:
                 rep.thread.join(timeout)
         if self._supervisor is not None:
             self._supervisor.join(timeout)
+        for t in self._respawn_threads:
+            t.join(timeout)
         with self._lock:
             # non-terminal replicas have no driver anymore: a "running"
             # label would let submit() enqueue work nobody will ever
@@ -212,6 +774,10 @@ class ServeFleet:
                     state="failed", phase="failover_failed",
                     replica=rep.name, generated=len(req.tokens))
         self.router.fail_all_parked("fleet closed")
+        if self._listener is not None:
+            self._listener.close()
+        if self._spec_path is not None:
+            shutil.rmtree(self._spec_path, ignore_errors=True)
         self._update_fleet_gauges()
 
     def __enter__(self) -> "ServeFleet":
@@ -241,6 +807,7 @@ class ServeFleet:
             busy = self.router.queue_depth > 0 or any(
                 r.engine.scheduler.active_count
                 or r.engine.scheduler.queue_depth
+                or getattr(r.engine.scheduler, "inflight", 0)
                 for r in self.replicas if r.state in
                 ("starting", "running", "draining"))
             if not busy:
@@ -253,7 +820,8 @@ class ServeFleet:
     # ------------------------------------------------------------------
     def kill(self, name: str, error: str = "killed by fleet.kill()"):
         """Abruptly retire a replica (bench/chaos hook): its in-flight
-        requests fail over exactly as if its step loop had died."""
+        requests fail over exactly as if its step loop had died.  For a
+        process replica this also SIGKILLs the worker."""
         self._replica_died(self._rep(name), MXNetError(error))
 
     def _rep(self, name: str) -> Replica:
@@ -265,11 +833,12 @@ class ServeFleet:
 
     def _replica_died(self, rep: Replica, exc: BaseException) -> None:
         with self._lock:
-            if rep.state in ("dead", "drained"):
+            if rep.state in ("dead", "drained", "stopped"):
                 return          # double-fire guard (driver + supervisor)
             rep.state = "dead"
             rep.error = f"{type(exc).__name__}: {exc}"
             self.deaths += 1
+        rep.terminate(force=True)
         t0 = time.perf_counter()
         salvaged = rep.engine.scheduler.salvage()
         if _tele.enabled():
@@ -292,6 +861,101 @@ class ServeFleet:
         for other in self.replicas:
             other.notify()
         self._update_fleet_gauges()
+        self._maybe_respawn(rep)
+
+    # ------------------------------------------------------------------
+    # respawn (MXTPU_REPLICA_RESPAWNS — the dataloader-worker pattern)
+    # ------------------------------------------------------------------
+    def _maybe_respawn(self, rep: Replica) -> None:
+        with self._lock:
+            if self._stop.is_set() or self._closed or not self._started:
+                return
+            try:
+                idx = self.replicas.index(rep)
+            except ValueError:
+                return              # already replaced / never installed
+            if self.respawns >= self.respawn_budget:
+                if self.respawn_budget:
+                    _log.error(
+                        "fleet: replica %s died with the respawn budget "
+                        "exhausted (%d/%d used) — retiring it "
+                        "permanently; the fleet shrinks.  Raise "
+                        "MXTPU_REPLICA_RESPAWNS or create a new fleet "
+                        "to restore capacity.", rep.name, self.respawns,
+                        self.respawn_budget)
+                    self._journal_replica(rep, "respawn_exhausted",
+                                          used=self.respawns,
+                                          budget=self.respawn_budget)
+                return
+            self.respawns += 1
+            used = self.respawns
+        t = threading.Thread(target=self._respawn, args=(rep, idx, used),
+                             daemon=True,
+                             name=f"serve-respawn-{rep.name}")
+        self._respawn_threads.append(t)
+        t.start()
+
+    def _respawn(self, dead: Replica, idx: int, used: int) -> None:
+        """Build and install the replacement replica (same name, next
+        generation).  Runs off the supervisor thread — a process spawn
+        takes seconds and supervision must keep sweeping meanwhile."""
+        t0 = time.perf_counter()
+        gen = dead.generation + 1
+        try:
+            new = self._make_replica(idx, generation=gen)
+            if isinstance(new, ProcessReplica):
+                new.spawn(self.spawn_timeout)
+            else:
+                src = self._exec_source
+                if src is not None:
+                    new.engine.adopt_executables(src)
+                else:
+                    new.engine.warmup()
+            with self._lock:
+                if self._stop.is_set() or self._closed \
+                        or self.replicas[idx] is not dead:
+                    new.terminate(force=True)
+                    return
+                self.replicas[idx] = new
+                self.retired.append(dead)
+                new.state = "running"
+            _health.beat(new.heartbeat_name)
+            new.start_driver(self)
+            if _tele.enabled():
+                _tele.counter(
+                    "serve_replica_respawns_total",
+                    "Workers respawned in place after a replica death",
+                    labelnames=("replica",)).inc(replica=new.name)
+                _tele.event("replica_respawn", replica=new.name,
+                            generation=gen, used=used,
+                            budget=self.respawn_budget,
+                            transport=new.transport, pid=new.pid,
+                            spawn_s=round(time.perf_counter() - t0, 3))
+            self._journal_replica(new, "respawned", generation=gen)
+            self._trace_replica(new, t0=t0)
+            # the reborn replica pulls parked work immediately — the
+            # loss window ends here, not at the next supervisor tick
+            self.router.feed(new)
+            self._update_fleet_gauges()
+        except Exception as exc:
+            _log.error("fleet: respawn of replica %s failed: %s",
+                       dead.name, exc)
+            self._journal_replica(dead, "respawn_failed",
+                                  error=f"{type(exc).__name__}: {exc}")
+            # a transient spawn fault (worker_spawn injection, OOM
+            # blip) may clear: burn another budget slot if one remains
+            self._maybe_respawn(dead)
+
+    def _trace_replica(self, rep: Replica,
+                       t0: Optional[float] = None) -> None:
+        if not _trace.enabled():
+            return
+        now = time.perf_counter()
+        _trace.get_tracer("serve").record_span(
+            "serve.replica", t0 if t0 is not None else now, now,
+            track="serve fleet", replica=rep.name,
+            transport=rep.transport, pid=rep.pid,
+            generation=rep.generation)
 
     def _retire_series(self, rep: Replica) -> None:
         """Drop the dead/drained replica's per-replica gauge series and
@@ -315,8 +979,8 @@ class ServeFleet:
     def drain(self, name: str, timeout: float = 60.0) -> bool:
         """Gracefully retire one replica: stop routing to it, hand its
         queued requests back to the router, let its active streams
-        finish, then the driver exits with an EMPTY active set.  Blocks
-        up to `timeout`; True when fully drained."""
+        finish, then the driver (or worker process) exits with an EMPTY
+        active set.  Blocks up to `timeout`; True when fully drained."""
         rep = self._rep(name)
         with self._lock:
             if rep.state != "running":
@@ -385,19 +1049,14 @@ class ServeFleet:
                     return
                 if rep.state not in ("running", "draining"):
                     continue
-                sched = rep.engine.scheduler
-                busy = sched.active_count or sched.queue_depth
-                if rep.thread is not None and not rep.thread.is_alive():
-                    # backstop: the driver died without reporting
-                    self._replica_died(
-                        rep, MXNetError("driver thread exited"))
+                err = rep.probe(ages, self.stall_timeout)
+                if err is not None:
+                    self._replica_died(rep, MXNetError(err))
                     continue
-                age = ages.get(rep.heartbeat_name)
-                if age is not None and age > self.stall_timeout and busy:
-                    self._replica_died(rep, MXNetError(
-                        f"replica stalled: no heartbeat for "
-                        f"{age:.1f}s (> {self.stall_timeout:.1f}s) "
-                        f"with work in flight"))
+                if rep.transport == "process" and rep.state == "running":
+                    # process replicas have no driver thread — the
+                    # supervisor pulls parked work for them
+                    self.router.feed(rep)
             self.router.sweep_expired()
             self._update_fleet_gauges()
 
@@ -427,6 +1086,9 @@ class ServeFleet:
             "replicas": {
                 rep.name: {
                     "state": rep.state,
+                    "transport": rep.transport,
+                    "pid": rep.pid,
+                    "generation": rep.generation,
                     "active": rep.engine.scheduler.active_count,
                     "queued": rep.engine.scheduler.queue_depth,
                     "free_pages": rep.engine.allocator.free_pages,
@@ -435,4 +1097,7 @@ class ServeFleet:
                 } for rep in self.replicas},
             "router": self.router.stats(),
             "deaths": self.deaths,
+            "respawns": self.respawns,
+            "respawn_budget": self.respawn_budget,
+            "retired": [r.name for r in self.retired],
         }

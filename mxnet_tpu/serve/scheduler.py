@@ -51,7 +51,7 @@ from .. import tracing as _trace
 from .kv_cache import NULL_PAGE
 
 __all__ = ["ServeRequest", "ContinuousBatchingScheduler",
-           "terminate_request"]
+           "terminate_request", "finish_request", "deliver_token"]
 
 _rid = itertools.count(1)
 
@@ -217,6 +217,80 @@ def expire_request(req: ServeRequest, where: str,
             "Requests expired past their per-request deadline",
             labelnames=("where",)).inc(where=where)
     return won
+
+
+def deliver_token(req: ServeRequest, token: int,
+                  replica: Optional[str] = None) -> bool:
+    """Mirror ONE streamed token onto a request handle: append, TTFT
+    bookkeeping, telemetry, the `on_token` callback, and the
+    ``serve.stream`` span.  Returns True when this token completed the
+    request (``max_new_tokens`` reached or EOS) — the caller owns the
+    finish.  Shared by the in-process scheduler's emit path and the
+    process fleet's parent-side stream ledger (`ProcessReplica`), so a
+    token delivered over the wire is indistinguishable from one emitted
+    by a local slot."""
+    req.tokens.append(token)
+    if req.first_token_ts is None:
+        req.first_token_ts = time.perf_counter()
+        if _tele.enabled():
+            _tele.histogram(
+                "serve_ttft_ms",
+                "Time to first token per request (submit -> first "
+                "streamed token)").observe(req.ttft_s * 1e3)
+            fields = {"replica": replica} if replica is not None else {}
+            _tele.event("request", request_id=req.id, phase="first_token",
+                        ttft_ms=round(req.ttft_s * 1e3, 3), **fields)
+    if _tele.enabled():
+        _tele.counter("serve_tokens_generated_total",
+                      "Tokens generated across all requests").inc()
+    ts0 = time.perf_counter() if req._span is not None else 0.0
+    if req.on_token is not None:
+        try:
+            req.on_token(token, req)
+        except Exception:
+            import logging
+            logging.getLogger(__name__).exception(
+                "serve: on_token callback failed (request %d)", req.id)
+    if req._span is not None:
+        _trace.get_tracer("serve").record_span(
+            "serve.stream", ts0, time.perf_counter(),
+            parent=req._span.context(), track=f"serve req {req.id}",
+            request_id=req.id, token_index=len(req.tokens) - 1)
+    return len(req.tokens) >= req.max_new_tokens or (
+        req.eos_token_id is not None and token == req.eos_token_id)
+
+
+def finish_request(req: ServeRequest,
+                   replica: Optional[str] = None) -> bool:
+    """The ONE successful-completion terminal: state, latency metrics,
+    journal, spans, waiter unblock.  First caller wins (False if the
+    request already terminated) — shared by the in-process scheduler's
+    slot-finish and the process fleet's remote done/reconcile path, so
+    the two transports can never disagree on what "finished" means."""
+    with req._terminate_lock:
+        if req._done.is_set():
+            return False
+        req.state = "finished"
+        req.finished_ts = time.perf_counter()
+        _close_request_spans(
+            req, "finished",
+            ttft_ms=(round(req.ttft_s * 1e3, 3)
+                     if req.ttft_s is not None else None))
+        if _tele.enabled():
+            _tele.counter("serve_requests_total",
+                          "Requests by terminal state",
+                          labelnames=("state",)).inc(state="finished")
+            _tele.histogram(
+                "serve_request_latency_ms",
+                "End-to-end request latency (submit -> last token)"
+            ).observe(req.latency_s * 1e3)
+            fields = {"replica": replica} if replica is not None else {}
+            _tele.event("request", request_id=req.id, phase="finished",
+                        generated=len(req.tokens),
+                        latency_ms=round(req.latency_s * 1e3, 3),
+                        **fields)
+        req._done.set()
+    return True
 
 
 class _Slot:
@@ -900,35 +974,7 @@ class ContinuousBatchingScheduler:
             # emitting now would double-stream tokens the survivor is
             # regenerating
             return
-        req.tokens.append(token)
-        if req.first_token_ts is None:
-            req.first_token_ts = time.perf_counter()
-            if _tele.enabled():
-                _tele.histogram(
-                    "serve_ttft_ms",
-                    "Time to first token per request (submit -> first "
-                    "streamed token)").observe(req.ttft_s * 1e3)
-            self._telemetry_request(req, "first_token",
-                                    ttft_ms=round(req.ttft_s * 1e3, 3))
-        if _tele.enabled():
-            _tele.counter("serve_tokens_generated_total",
-                          "Tokens generated across all requests").inc()
-        ts0 = time.perf_counter() if req._span is not None else 0.0
-        if req.on_token is not None:
-            try:
-                req.on_token(token, req)
-            except Exception:
-                import logging
-                logging.getLogger(__name__).exception(
-                    "serve: on_token callback failed (request %d)", req.id)
-        if req._span is not None:
-            _trace.get_tracer("serve").record_span(
-                "serve.stream", ts0, time.perf_counter(),
-                parent=req._span.context(), track=f"serve req {req.id}",
-                request_id=req.id, token_index=len(req.tokens) - 1)
-        done = len(req.tokens) >= req.max_new_tokens or (
-            req.eos_token_id is not None and token == req.eos_token_id)
-        if done:
+        if deliver_token(req, token, replica=self.name):
             self._finish(slot)
 
     def _fail_all(self, exc: BaseException) -> None:
@@ -1016,27 +1062,7 @@ class ContinuousBatchingScheduler:
         self._release_slot(slot)
         if self._abandoned or req._epoch != slot.epoch:
             return          # salvaged mid-step: the survivor finishes it
-        with req._terminate_lock:
-            if req._done.is_set():
-                return      # already terminated by a concurrent sweep
-            req.state = "finished"
-            req.finished_ts = time.perf_counter()
-            self._trace_close(
-                req, "finished",
-                ttft_ms=(round(req.ttft_s * 1e3, 3)
-                         if req.ttft_s is not None else None))
-            if _tele.enabled():
-                _tele.counter("serve_requests_total",
-                              "Requests by terminal state",
-                              labelnames=("state",)).inc(state="finished")
-                _tele.histogram(
-                    "serve_request_latency_ms",
-                    "End-to-end request latency (submit -> last token)"
-                ).observe(req.latency_s * 1e3)
-            self._telemetry_request(
-                req, "finished", generated=len(req.tokens),
-                latency_ms=round(req.latency_s * 1e3, 3))
-            req._done.set()
+        finish_request(req, replica=self.name)
 
     # ------------------------------------------------------------------
     def run_until_idle(self, max_steps: int = 100000) -> int:

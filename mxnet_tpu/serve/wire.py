@@ -1,0 +1,354 @@
+"""Wire protocol for the cross-process serving fleet (docs/serving.md
+"Process fleet").
+
+Length-prefixed JSON frames over stdlib TCP sockets — no external RPC
+dependency, matching the ps-lite role in the MXNet survey's layer-8
+(scheduler/server processes coordinating over a thin message layer).
+Every frame is ``>I`` big-endian byte length + a JSON object.  A worker
+dials the fleet's :class:`Listener` TWICE and identifies each connection
+with a ``hello`` frame:
+
+- the **control** channel carries synchronous RPCs *parent -> worker*
+  (``submit`` / ``cancel`` / ``drain`` / ``health`` / ``shutdown``),
+  each ``{"verb", "id", ...}`` answered by ``{"id", "ok", ...}``;
+- the **events** channel carries the *worker -> parent* stream: ``tok``
+  (one streamed token, with its index), ``done`` (terminal state + the
+  full generated token list, the stream-ledger reconciliation record),
+  ``hb`` (heartbeat + scheduler stats), ``ready`` and ``drained``.
+
+Fault tolerance (docs/resilience.md): :class:`WireClient` wraps each
+call in `resilience.retry_with_backoff` with a per-call timeout
+(``MXTPU_RPC_TIMEOUT_MS``).  Responses echo the call id, so a retry
+after a timed-out or fault-dropped frame discards any stale response
+instead of mismatching it.  The ``rpc_send`` / ``rpc_recv`` fault
+points (``MXTPU_FAULT_SPEC``) simulate a dropped request/response frame
+on the control channel; ``worker_spawn`` fires in the fleet's spawn
+path.  Retried verbs must therefore be idempotent — the worker dedupes
+``submit`` by router-assigned request id.
+
+Observability: every call lands as a ``serve.rpc`` span tagged with
+verb / bytes / retries (and parented to the request's root span when
+one is supplied), so `tools/diagnose.py --trace` can attribute wire
+time inside TTFT.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Callable, Optional
+
+from ..base import MXNetError
+from ..resilience import fault_point, retry_with_backoff
+from .. import tracing as _trace
+
+__all__ = ["WireError", "WireTimeout", "WireRemoteError", "WireClient",
+           "Listener", "connect", "send_frame", "recv_frame",
+           "rpc_timeout_ms"]
+
+_HDR = struct.Struct(">I")
+#: hard frame-size cap — a corrupt length prefix must not allocate GBs
+MAX_FRAME = 64 << 20
+
+
+class WireError(MXNetError):
+    """Transport-level failure (connection lost, frame dropped/corrupt).
+    Transient by contract: `WireClient.call` retries these."""
+
+
+class WireTimeout(WireError):
+    """Per-call timeout (``MXTPU_RPC_TIMEOUT_MS``) elapsed."""
+
+
+class WireRemoteError(MXNetError):
+    """The worker processed the call and answered ``ok: false`` — an
+    application error, never retried (the call already happened)."""
+
+
+def rpc_timeout_ms() -> float:
+    """Per-call RPC timeout (``MXTPU_RPC_TIMEOUT_MS``, default 5000)."""
+    try:
+        return float(os.environ.get("MXTPU_RPC_TIMEOUT_MS", "") or 5000)
+    except ValueError:
+        return 5000.0
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+def send_frame(sock: socket.socket, obj: dict) -> int:
+    """Serialize `obj` and write one frame; returns bytes on the wire."""
+    data = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    if len(data) > MAX_FRAME:
+        raise WireError(f"frame of {len(data)} bytes exceeds the "
+                        f"{MAX_FRAME}-byte cap")
+    try:
+        sock.sendall(_HDR.pack(len(data)) + data)
+    except OSError as e:
+        raise WireError(f"wire send failed: {e}") from e
+    return len(data) + _HDR.size
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except socket.timeout as e:
+            raise WireTimeout("wire recv timed out") from e
+        except OSError as e:
+            raise WireError(f"wire recv failed: {e}") from e
+        if not chunk:
+            if buf:
+                raise WireError("connection closed mid-frame")
+            return None          # clean EOF on a frame boundary
+        buf += chunk
+    return bytes(buf)
+
+
+def recv_frame(sock: socket.socket,
+               timeout: Optional[float] = None) -> Optional[dict]:
+    """Read one frame; None on clean EOF.  `timeout` is seconds for the
+    WHOLE frame (None blocks forever)."""
+    try:
+        sock.settimeout(timeout)
+    except OSError as e:
+        # a socket closed out from under us (peer torn down mid-read)
+        # is a wire failure like any other, not a caller bug
+        raise WireError(f"wire recv failed: {e}") from e
+    hdr = _recv_exact(sock, _HDR.size)
+    if hdr is None:
+        return None
+    (n,) = _HDR.unpack(hdr)
+    if n > MAX_FRAME:
+        raise WireError(f"frame length {n} exceeds the {MAX_FRAME}-byte "
+                        f"cap (corrupt stream?)")
+    body = _recv_exact(sock, n)
+    if body is None:
+        raise WireError("connection closed mid-frame")
+    try:
+        return json.loads(body.decode("utf-8"))
+    except (UnicodeDecodeError, ValueError) as e:
+        raise WireError(f"frame is not valid JSON: {e}") from e
+
+
+def _fault(point: str) -> None:
+    """Fire a wire fault point; any armed *Exception* becomes a
+    `WireError` (a simulated dropped frame the retry loop absorbs).
+    BaseException actions (``FaultExit``) propagate — an injected
+    process kill must never be downgraded to a retry."""
+    try:
+        fault_point(point)
+    except Exception as e:
+        raise WireError(f"injected frame drop at {point}: {e}") from e
+
+
+# ---------------------------------------------------------------------------
+# client (parent -> worker control channel)
+# ---------------------------------------------------------------------------
+
+class WireClient:
+    """Synchronous RPC over one control socket, callable from multiple
+    parent threads (per-call lock).  Each call: ``rpc_send`` fault point
+    -> send ``{"verb", "id", ...}`` -> read frames until the response
+    echoing ``id`` arrives (stale responses from timed-out attempts are
+    discarded) -> ``rpc_recv`` fault point.  Transient `WireError`\\ s
+    retry with backoff; an ``ok: false`` answer raises
+    `WireRemoteError` immediately."""
+
+    def __init__(self, sock: socket.socket, replica: Optional[str] = None,
+                 retries: int = 2, timeout_ms: Optional[float] = None):
+        self._sock = sock
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self.replica = replica
+        self.retries = int(retries)
+        self.timeout_ms = timeout_ms
+        self.calls = 0
+        self.retried = 0          # extra attempts beyond the first
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def call(self, verb: str, _timeout_ms: Optional[float] = None,
+             _span_parent=None, _track: Optional[str] = None,
+             **payload) -> dict:
+        timeout_s = float(_timeout_ms or self.timeout_ms
+                          or rpc_timeout_ms()) / 1e3
+        call_id = next(self._ids)
+        frame = {"verb": verb, "id": call_id, **payload}
+        stats = {"attempts": 0, "bytes": 0}
+        t0 = time.perf_counter()
+
+        def once() -> dict:
+            stats["attempts"] += 1
+            with self._lock:
+                _fault("rpc_send")
+                stats["bytes"] += send_frame(self._sock, frame)
+                deadline = time.monotonic() + timeout_s
+                while True:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        raise WireTimeout(
+                            f"rpc {verb!r} timed out after "
+                            f"{timeout_s * 1e3:.0f} ms "
+                            f"(MXTPU_RPC_TIMEOUT_MS)")
+                    resp = recv_frame(self._sock, timeout=left)
+                    if resp is None:
+                        raise WireError(
+                            f"connection closed during rpc {verb!r}")
+                    _fault("rpc_recv")
+                    if resp.get("id") == call_id:
+                        return resp
+                    # a stale response to an earlier timed-out or
+                    # fault-dropped attempt: discard and keep reading
+
+        try:
+            resp = retry_with_backoff(
+                once, retries=self.retries, base_delay=0.02,
+                max_delay=0.25, retry_on=(WireError,))
+        finally:
+            self.calls += 1
+            if stats["attempts"] > 1:
+                self.retried += stats["attempts"] - 1
+            if _trace.enabled():
+                kw = {}
+                if _span_parent is not None:
+                    kw["parent"] = _span_parent
+                if self.replica is not None:
+                    kw["replica"] = self.replica
+                if "rid" in payload:
+                    # submit/cancel carry the router rid — tagging the
+                    # span with it lets the TTFT decomposition
+                    # (tools/diagnose.py --trace) attribute wire time
+                    # to the request
+                    kw["request_id"] = payload["rid"]
+                _trace.get_tracer("serve").record_span(
+                    "serve.rpc", t0, time.perf_counter(),
+                    track=_track or "serve wire", verb=verb,
+                    bytes=stats["bytes"],
+                    retries=stats["attempts"] - 1, **kw)
+        if not resp.get("ok", False):
+            raise WireRemoteError(
+                f"rpc {verb!r} failed on "
+                f"{self.replica or 'worker'}: {resp.get('error')}")
+        return resp
+
+
+# ---------------------------------------------------------------------------
+# parent-side listener + worker-side dial
+# ---------------------------------------------------------------------------
+
+class Listener:
+    """Fleet-side accept loop on an ephemeral localhost port.  Workers
+    dial in and identify with a hello frame; `expect` registers a
+    worker name before its spawn, `wait` blocks until BOTH channels of
+    that worker are connected and returns them with the hello payload
+    (which carries the worker pid)."""
+
+    def __init__(self, host: str = "127.0.0.1"):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, 0))
+        self._sock.listen(64)
+        self.host, self.port = self._sock.getsockname()[:2]
+        self._lock = threading.Lock()
+        self._pending: dict = {}
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name="serve-wire-accept")
+        self._thread.start()
+
+    def expect(self, worker: str) -> None:
+        with self._lock:
+            self._pending[worker] = {"control": None, "events": None,
+                                     "ready": threading.Event()}
+
+    def wait(self, worker: str, timeout: float = 120.0,
+             alive: Optional[Callable[[], bool]] = None):
+        """Block until `worker` has connected both channels.  `alive`
+        (e.g. ``proc.poll() is None``) fails fast when the worker dies
+        before dialing in.  Returns ``(control_sock, events_sock,
+        hello)``."""
+        with self._lock:
+            slot = self._pending.get(worker)
+        if slot is None:
+            raise WireError(f"worker {worker!r} was never expect()ed")
+        deadline = time.monotonic() + timeout
+        while not slot["ready"].wait(0.05):
+            if alive is not None and not alive():
+                raise WireError(
+                    f"worker {worker!r} exited before connecting")
+            if time.monotonic() > deadline:
+                raise WireTimeout(
+                    f"worker {worker!r} did not connect within "
+                    f"{timeout:.0f}s")
+        with self._lock:
+            self._pending.pop(worker, None)
+        control, hello = slot["control"]
+        events, _ = slot["events"]
+        return control, events, hello
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handshake, args=(conn,),
+                             daemon=True).start()
+
+    def _handshake(self, conn: socket.socket) -> None:
+        try:
+            hello = recv_frame(conn, timeout=10.0)
+        except WireError:
+            conn.close()
+            return
+        if not hello or hello.get("verb") != "hello" \
+                or hello.get("channel") not in ("control", "events"):
+            conn.close()
+            return
+        conn.settimeout(None)
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        with self._lock:
+            slot = self._pending.get(hello.get("worker"))
+            if slot is None or slot[hello["channel"]] is not None:
+                conn.close()        # unknown worker / duplicate channel
+                return
+            slot[hello["channel"]] = (conn, hello)
+            if slot["control"] is not None and slot["events"] is not None:
+                slot["ready"].set()
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def connect(host: str, port: int, channel: str, worker: str,
+            timeout: float = 20.0, **meta) -> socket.socket:
+    """Worker-side dial: connect to the fleet listener and identify
+    with a hello frame (retries connection refusal briefly — the
+    listener may still be binding)."""
+
+    def dial() -> socket.socket:
+        return socket.create_connection((host, port), timeout=timeout)
+
+    sock = retry_with_backoff(dial, retries=4, base_delay=0.05,
+                              retry_on=(OSError,))
+    sock.settimeout(None)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    send_frame(sock, {"verb": "hello", "channel": channel,
+                      "worker": worker, "pid": os.getpid(), **meta})
+    return sock

@@ -1,0 +1,331 @@
+"""Serving worker process: one `InferenceEngine` behind the wire
+protocol (docs/serving.md "Process fleet").
+
+Spawned by `ServeFleet` as ``python -m mxnet_tpu.serve.worker`` with a
+**spec dir** (``config.json`` + ``weights.npz`` — enough to rebuild the
+engine without the parent's live ``HybridBlock``), the worker dials the
+fleet's `wire.Listener` twice (control + events channels), rebuilds and
+warms its engine, then pumps the scheduler in its main loop:
+
+- **control** RPCs (handled on a dedicated thread): ``submit`` (deduped
+  by router-assigned rid — retried frames are idempotent), ``cancel``,
+  ``drain`` (detach queued work, hand the rids back, finish actives,
+  then exit), ``health``, ``shutdown``;
+- **events** pushed from the main loop: ``tok`` per streamed token
+  (with its index — the parent's stream ledger applies them
+  contiguously), ``done`` with the FULL generated token list (the
+  reconciliation record), ``hb`` heartbeats (~5 Hz) carrying scheduler
+  stats the parent mirrors into the router's load scores, ``ready``
+  after warmup, ``drained`` on graceful exit.
+
+Failure contract: a worker is DISPOSABLE (the dataloader-worker
+pattern).  Any escaped step error, a lost parent connection, or an
+injected ``FaultExit`` ends the process; the parent salvages the stream
+ledger, fails the streams over, and respawns within
+``MXTPU_REPLICA_RESPAWNS``.  Nothing here tries to recover in place.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import inspect
+import json
+import os
+import sys
+import threading
+import time
+from typing import Optional
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..resilience import EXIT_CODE, FaultExit
+from .decode import extract_decode_weights
+from .engine import InferenceEngine, ServeConfig
+from .scheduler import ServeRequest, terminate_request
+from . import wire
+
+__all__ = ["write_spec", "load_spec", "main"]
+
+_SPEC_CONFIG = "config.json"
+_SPEC_WEIGHTS = "weights.npz"
+_TOP_KEYS = ("embed", "pos", "lnf_g", "lnf_b", "head")
+
+
+# ---------------------------------------------------------------------------
+# spec dir: everything a worker needs to rebuild the engine
+# ---------------------------------------------------------------------------
+
+def write_spec(spec_dir: str, model, serve_config: ServeConfig) -> str:
+    """Serialize `model`'s config + DENSE decode weights and the serving
+    config into `spec_dir` (quantization re-applies in the worker from
+    ``ServeConfig.quant_bits`` — planes are never shipped)."""
+    from ..models.gpt import GPTConfig
+    os.makedirs(spec_dir, exist_ok=True)
+    params = inspect.signature(GPTConfig.__init__).parameters
+    cfg_d = {k: v for k, v in vars(model.cfg).items() if k in params}
+    with open(os.path.join(spec_dir, _SPEC_CONFIG), "w") as f:
+        json.dump({"model": cfg_d,
+                   "serve": dataclasses.asdict(serve_config)}, f)
+    P = extract_decode_weights(model)
+    arrs = {}
+    for k in _TOP_KEYS:
+        if P.get(k) is not None:
+            arrs[k] = onp.asarray(P[k])
+    for i, layer in enumerate(P["layers"]):
+        for k, v in layer.items():
+            if v is not None:
+                arrs[f"layers.{i}.{k}"] = onp.asarray(v)
+    onp.savez(os.path.join(spec_dir, _SPEC_WEIGHTS), **arrs)
+    return spec_dir
+
+
+class _SpecModel:
+    """Engine-facing stand-in for the parent's model: `InferenceEngine`
+    only reads ``.cfg`` and `extract_decode_weights` (which returns the
+    prebuilt ``_decode_weights`` pytree directly)."""
+
+    def __init__(self, cfg, P: dict):
+        self.cfg = cfg
+        self._decode_weights = P
+
+
+def load_spec(spec_dir: str):
+    """Rebuild ``(model_shim, serve_config)`` from a `write_spec` dir."""
+    from ..models.gpt import GPTConfig
+    with open(os.path.join(spec_dir, _SPEC_CONFIG)) as f:
+        d = json.load(f)
+    cfg = GPTConfig(**d["model"])
+    sc = ServeConfig(**d["serve"])
+    data = onp.load(os.path.join(spec_dir, _SPEC_WEIGHTS))
+    P = {k: (data[k] if k in data.files else None) for k in _TOP_KEYS}
+    layers = [dict() for _ in range(cfg.num_layers)]
+    for k in data.files:
+        if k.startswith("layers."):
+            _, i, name = k.split(".", 2)
+            layers[int(i)][name] = data[k]
+    P["layers"] = layers
+    return _SpecModel(cfg, P), sc
+
+
+# ---------------------------------------------------------------------------
+# the worker process
+# ---------------------------------------------------------------------------
+
+class Worker:
+    """One serving worker: engine + scheduler + the two wire channels."""
+
+    HB_INTERVAL = 0.2
+
+    def __init__(self, name: str, host: str, port: int, spec_dir: str,
+                 seed: int = 0):
+        self.name = name
+        self.spec_dir = spec_dir
+        self.seed = seed
+        self.engine: Optional[InferenceEngine] = None
+        self._control = wire.connect(host, port, "control", name)
+        self._events = wire.connect(host, port, "events", name)
+        self._send_lock = threading.Lock()
+        self._wake = threading.Event()
+        self._shutdown = threading.Event()
+        self._lost_parent = threading.Event()
+        self._live = {}           # router rid -> local ServeRequest
+        self._seen = set()        # every rid ever submitted (dedupe)
+        self._lock = threading.Lock()
+        self._last_hb = 0.0
+
+    # -- events channel (main thread + on_token, serialized) -----------
+    def _send(self, ev: dict) -> None:
+        with self._send_lock:
+            try:
+                wire.send_frame(self._events, ev)
+            except wire.WireError:
+                # the parent is gone: a worker with no fleet has no
+                # reason to live (dataloader-worker semantics)
+                self._lost_parent.set()
+                self._shutdown.set()
+
+    def _on_token(self, rid: int):
+        def cb(tok, req):
+            self._send({"ev": "tok", "rid": rid,
+                        "i": len(req.tokens) - 1, "t": int(tok)})
+        return cb
+
+    def _heartbeat(self, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._last_hb < self.HB_INTERVAL:
+            return
+        self._last_hb = now
+        sched = self.engine.scheduler
+        self._send({"ev": "hb", "queued": sched.queue_depth,
+                    "active": sched.active_count,
+                    "free_pages": self.engine.allocator.free_pages,
+                    "steps": self.engine._steps_executed,
+                    "pid": os.getpid()})
+
+    def _scan_done(self) -> None:
+        with self._lock:
+            finished = [(rid, req) for rid, req in self._live.items()
+                        if req.done()]
+            for rid, _ in finished:
+                del self._live[rid]
+        for rid, req in finished:
+            ev = {"ev": "done", "rid": rid, "state": req.state,
+                  "tokens": [int(t) for t in req.tokens]}
+            if req.state != "finished":
+                ev["error"] = req.error
+                ev["expired"] = bool(
+                    req.error and req.error.startswith("deadline exceeded"))
+            self._send(ev)
+
+    # -- control channel (dedicated thread) ----------------------------
+    def _control_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                frame = wire.recv_frame(self._control)
+            except wire.WireError:
+                frame = None
+            if frame is None:                 # parent closed the channel
+                self._lost_parent.set()
+                self._shutdown.set()
+                self._wake.set()
+                return
+            verb, call_id = frame.get("verb"), frame.get("id")
+            try:
+                resp = self._handle(verb, frame)
+                resp.update(id=call_id, ok=True)
+            except Exception as e:
+                resp = {"id": call_id, "ok": False,
+                        "error": f"{type(e).__name__}: {e}"}
+            try:
+                # control responses are written only by this thread; the
+                # events channel has its own lock
+                wire.send_frame(self._control, resp)
+            except wire.WireError:
+                self._lost_parent.set()
+                self._shutdown.set()
+                self._wake.set()
+                return
+
+    def _handle(self, verb: str, frame: dict) -> dict:
+        if verb == "health":
+            eng = self.engine
+            if eng is None:
+                return {"ready": False}
+            return {"ready": True, "queued": eng.scheduler.queue_depth,
+                    "active": eng.scheduler.active_count,
+                    "free_pages": eng.allocator.free_pages,
+                    "steps": eng._steps_executed, "pid": os.getpid()}
+        if verb == "shutdown":
+            self._shutdown.set()
+            self._wake.set()
+            return {}
+        if self.engine is None:
+            raise MXNetError(f"worker {self.name} is still warming up")
+        sched = self.engine.scheduler
+        if verb == "submit":
+            rid = int(frame["rid"])
+            with self._lock:
+                if rid in self._seen:
+                    return {"dup": True}   # retried frame: idempotent
+            req = ServeRequest(
+                frame["prompt"], frame["max_new"],
+                greedy=bool(frame.get("greedy", True)),
+                temperature=float(frame.get("temperature", 1.0)),
+                eos_token_id=frame.get("eos"),
+                on_token=self._on_token(rid),
+                deadline_ms=float(frame.get("deadline_ms") or 0.0))
+            req.rid = rid
+            sched.enqueue(req, front=bool(frame.get("front")))
+            with self._lock:
+                self._live[rid] = req
+                self._seen.add(rid)
+            self._wake.set()
+            return {}
+        if verb == "cancel":
+            rid = int(frame["rid"])
+            with self._lock:
+                req = self._live.get(rid)
+            cancelled = False
+            if req is not None:
+                with sched._lock:
+                    if req in sched._queue:     # queued only: no pages
+                        sched._queue.remove(req)
+                        cancelled = True
+                if cancelled:
+                    terminate_request(req, "cancelled by the router",
+                                      state="failed", phase="cancelled",
+                                      replica=self.name)
+            return {"cancelled": cancelled}
+        if verb == "drain":
+            sched.draining = True
+            detached = sched.detach_queued()
+            rids = []
+            with self._lock:
+                for req in detached:
+                    rid = getattr(req, "rid", None)
+                    if rid is not None:
+                        self._live.pop(rid, None)
+                        rids.append(rid)
+            self._wake.set()
+            return {"queued": rids}
+        raise MXNetError(f"unknown wire verb {verb!r}")
+
+    # -- main loop ------------------------------------------------------
+    def run(self) -> int:
+        threading.Thread(target=self._control_loop, daemon=True,
+                         name="worker-control").start()
+        model, sc = load_spec(self.spec_dir)
+        eng = InferenceEngine(model, sc, seed=self.seed)
+        eng.scheduler.name = self.name
+        secs = eng.warmup()
+        self.engine = eng
+        self._send({"ev": "ready", "compile_seconds": secs,
+                    "pid": os.getpid()})
+        sched = eng.scheduler
+        while not self._shutdown.is_set():
+            try:
+                progressed = eng.step()
+            except FaultExit:
+                # injected process kill: die hard, like the real thing
+                os._exit(EXIT_CODE)
+            except Exception as e:
+                self._send({"ev": "fatal",
+                            "error": f"{type(e).__name__}: {e}"})
+                raise
+            self._scan_done()
+            self._heartbeat()
+            if sched.draining and not sched.active_count \
+                    and not sched.queue_depth:
+                self._send({"ev": "drained"})
+                break
+            if not progressed:
+                self._wake.wait(0.01)
+                self._wake.clear()
+        for sock in (self._events, self._control):
+            try:
+                sock.close()
+            except OSError:
+                pass
+        return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.serve.worker",
+        description="serving-fleet worker (spawned by ServeFleet)")
+    ap.add_argument("--name", required=True)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--spec", required=True, help="spec dir (write_spec)")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    worker = Worker(args.name, args.host, args.port, args.spec,
+                    seed=args.seed)
+    rc = worker.run()
+    # a worker that lost its parent exits quietly — the stack is noise
+    return 0 if worker._lost_parent.is_set() else rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -214,8 +214,8 @@ def print_trace(path: str) -> int:
     if reqs:
         print(f"---------- serve requests ({len(reqs)}) ----------")
         print(f"  {'req':>5} {'state':<10} {'queue':>9} {'prefill':>9} "
-              f"{'1st dec':>9} {'decode':>9} {'ttft':>9} {'total':>9}  "
-              f"(ms)")
+              f"{'1st dec':>9} {'decode':>9} {'wire':>9} {'ttft':>9} "
+              f"{'total':>9}  (ms)")
         rows = []
         for rid in sorted(reqs):
             ss = reqs[rid]
@@ -229,14 +229,20 @@ def print_trace(path: str) -> int:
             q, pf, fd = (total("serve.queue"),
                          total("serve.prefill_chunk"),
                          total("serve.first_decode"))
+            # process transport: submit/cancel RPC wall (serve.rpc
+            # spans tagged with the rid) — TTFT spent on the wire, not
+            # in the worker
+            wire = total("serve.rpc")
             ttft = args.get("ttft_ms")
             if ttft is None:
                 ttft = q + pf + fd
             rows.append({"rid": rid, "queue": q, "prefill": pf,
-                         "first_decode": fd, "ttft": float(ttft)})
+                         "first_decode": fd, "wire": wire,
+                         "ttft": float(ttft)})
             print(f"  {rid:>5} {str(args.get('state')):<10} {q:>9.2f} "
                   f"{pf:>9.2f} {fd:>9.2f} "
-                  f"{total('serve.decode'):>9.2f} {float(ttft):>9.2f} "
+                  f"{total('serve.decode'):>9.2f} {wire:>9.2f} "
+                  f"{float(ttft):>9.2f} "
                   f"{root['dur'] / 1e3:>9.2f}")
         # critical path at the tail: which phase owns the p99 TTFT
         ordered = sorted(rows, key=lambda r: r["ttft"])
@@ -247,10 +253,13 @@ def print_trace(path: str) -> int:
                             _math.ceil(0.99 * (len(ordered) - 1)))]
         denom = max(worst["ttft"], 1e-9)
         print(f"  TTFT p50 = {p50:.2f} ms, p99 = {p99:.2f} ms")
+        wire_pct = (f", {100 * worst['wire'] / denom:.0f}% wire"
+                    if worst.get("wire") else "")
         print(f"  critical path @p99 (req {worst['rid']}): "
               f"{100 * worst['queue'] / denom:.0f}% queue wait, "
               f"{100 * worst['prefill'] / denom:.0f}% prefill, "
-              f"{100 * worst['first_decode'] / denom:.0f}% first decode")
+              f"{100 * worst['first_decode'] / denom:.0f}% first decode"
+              f"{wire_pct}")
         # decode fast path (docs/serving.md "Speculative decoding &
         # prefix caching"): serve.step spans carry per-step draft/
         # accept/prefix-hit tags
@@ -285,7 +294,9 @@ def print_trace(path: str) -> int:
         def rep_row(name):
             return rollup.setdefault(name, {
                 "served": set(), "fo_in": 0, "fo_out": 0, "ttfts": [],
-                "drafted": 0, "accepted": 0, "prefix_hit": 0})
+                "drafted": 0, "accepted": 0, "prefix_hit": 0,
+                "transport": None, "pid": None, "gen": 0,
+                "rpc": 0, "rpc_retries": 0, "rpc_bytes": 0})
 
         for s in spans:
             args = s.get("args") or {}
@@ -300,6 +311,20 @@ def print_trace(path: str) -> int:
                 row["drafted"] += args.get("drafted") or 0
                 row["accepted"] += args.get("accepted") or 0
                 row["prefix_hit"] += args.get("prefix_hit") or 0
+            elif s["name"] == "serve.replica" and rep is not None:
+                # lifecycle span per spawn/respawn: the highest
+                # generation seen IS the respawn count for that name
+                row = rep_row(rep)
+                row["transport"] = args.get("transport",
+                                            row["transport"])
+                row["pid"] = args.get("pid", row["pid"])
+                row["gen"] = max(row["gen"],
+                                 args.get("generation") or 0)
+            elif s["name"] == "serve.rpc" and rep is not None:
+                row = rep_row(rep)
+                row["rpc"] += 1
+                row["rpc_retries"] += args.get("retries") or 0
+                row["rpc_bytes"] += args.get("bytes") or 0
         # a request is SERVED BY the replica that ran its last
         # prefill/decode span; its TTFT belongs to the replica that
         # produced the first token
@@ -326,9 +351,10 @@ def print_trace(path: str) -> int:
                         float(ttft))
         print(f"---------- fleet replicas ({len(rollup)}) ----------")
         if rollup:
-            print(f"  {'replica':<10} {'served':>7} {'fo in':>6} "
+            print(f"  {'replica':<10} {'trans':<8} {'pid':>7} "
+                  f"{'resp':>5} {'served':>7} {'fo in':>6} "
                   f"{'fo out':>7} {'p99 ttft':>10} {'accept':>7} "
-                  f"{'pfx tok':>8}  (ms)")
+                  f"{'pfx tok':>8} {'rpc(retry)':>11}  (ms)")
             for name in sorted(rollup):
                 row = rollup[name]
                 ttfts = sorted(row["ttfts"])
@@ -336,9 +362,14 @@ def print_trace(path: str) -> int:
                 p99_s = "-" if p99 is None else f"{p99:.2f}"
                 acc = ("-" if not row["drafted"]
                        else f"{row['accepted'] / row['drafted']:.2f}")
-                print(f"  {name:<10} {len(row['served']):>7} "
+                rpc = ("-" if not row["rpc"]
+                       else f"{row['rpc']}({row['rpc_retries']})")
+                print(f"  {name:<10} {row['transport'] or 'thread':<8} "
+                      f"{str(row['pid'] or '-'):>7} "
+                      f"{row['gen']:>5} {len(row['served']):>7} "
                       f"{row['fo_in']:>6} {row['fo_out']:>7} "
-                      f"{p99_s:>10} {acc:>7} {row['prefix_hit']:>8}")
+                      f"{p99_s:>10} {acc:>7} {row['prefix_hit']:>8} "
+                      f"{rpc:>11}")
         by_reason: dict = {}
         for s in fleet_sheds:
             reason = (s.get("args") or {}).get("reason", "?")

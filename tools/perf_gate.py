@@ -90,6 +90,46 @@ def record_serve_extras() -> None:
               file=sys.stderr)
 
 
+def record_procfleet_extras() -> None:
+    """RECORDED, never gated: one process-transport fleet round with a
+    mid-window worker SIGKILL (`bench.py --serve --replicas 2 --kill-at
+    2 --kill-mode process`), so the failover loss window and respawn
+    count ride every gate transcript — a ledger-failover or respawn
+    regression shows up in the round logs without gating the merge."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "bench.py"),
+             "--serve", "--replicas", "2", "--kill-at", "2",
+             "--kill-mode", "process"],
+            capture_output=True, text=True, timeout=TIMEOUT, cwd=ROOT)
+        line = next(ln for ln in reversed(
+            proc.stdout.strip().splitlines()) if ln.startswith("{"))
+        d = json.loads(line)
+        ex = d["extras"]
+        rec = {
+            "fleet_tokens_per_sec": d["value"],
+            "kill_mode": ex.get("kill_mode"),
+            "failover_loss_window_ms": ex.get("failover_loss_window_ms"),
+            "deaths": ex.get("deaths"),
+            "respawns": ex.get("respawns"),
+            "failovers": ex.get("failovers"),
+            "ttft_p99_ms": ex.get("ttft_p99_ms"),
+            "measured_at": time.strftime("%Y-%m-%d"),
+        }
+        out = os.path.join(ROOT, "bench_results",
+                           "perf_gate_procfleet.json")
+        with open(out, "w") as f:
+            json.dump(rec, f, indent=2)
+            f.write("\n")
+        print(f"perf-gate: procfleet extras (informational): "
+              f"{rec['fleet_tokens_per_sec']} tok/s under SIGKILL, "
+              f"loss window {rec['failover_loss_window_ms']} ms, "
+              f"respawns {rec['respawns']} -> {out}")
+    except Exception as e:   # noqa: BLE001 — never gate on this round
+        print(f"perf-gate: procfleet extras round skipped ({e})",
+              file=sys.stderr)
+
+
 def main() -> int:
     vals, mfus = [], []
     for i in range(RUNS):
@@ -147,6 +187,7 @@ def main() -> int:
               "python tools/perf_gate.py --rebaseline")
     if "--no-serve" not in sys.argv:
         record_serve_extras()
+        record_procfleet_extras()
     return 0
 
 

@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""Process-fleet smoke (`make procfleet-smoke`, wired into `make test`).
+
+CPU-only, <60 s end-to-end check of the PROCESS transport
+(docs/serving.md "Process fleet"): real `serve.worker` OS processes
+behind the wire RPC protocol, under chaos:
+
+- **2 process replicas** spawned from a spec dir, serving staggered
+  mixed-length streaming load over length-prefixed JSON frames;
+- **dropped control frames**: ``rpc_send`` / ``rpc_recv`` fault points
+  armed mid-run (``MXTPU_FAULT_SPEC``) — the wire client's
+  retry-with-backoff plus worker-side rid dedupe must absorb them with
+  zero dropped requests and zero double-submissions;
+- **one worker is SIGKILLed mid-stream** — no scheduler survives to
+  salvage, so failover MUST come from the router's stream ledger: the
+  emitted tokens fold into the re-prefill prefix and every greedy
+  stream resumes **bit-identical** on the survivor, never re-emitting
+  a token (streams are compared exactly, not as sets);
+- the killed replica **respawns** under ``MXTPU_REPLICA_RESPAWNS`` (a
+  ``replica_respawn`` journal event, same name, generation + 1);
+- the OTHER replica is then **drained over the wire** (queued work
+  handed back, actives finished, clean worker exit) — leaving only the
+  respawned worker, which must serve a fresh batch alone: proof the
+  reborn replica takes traffic again.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    t_start = time.time()
+    journal_path = os.path.join(
+        tempfile.mkdtemp(prefix="mxtpu_procfleet_smoke_"), "journal.jsonl")
+
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry as tele
+    from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from mxnet_tpu.serve import ServeConfig, ServeFleet
+
+    tele.enable(journal_path=journal_path)
+
+    cfg = GPTConfig(vocab_size=96, hidden_size=32, num_layers=2,
+                    num_heads=4, intermediate_size=64, max_position=64,
+                    dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.initialize()
+    model(mx.np.array([[1, 2]], dtype="int32"))
+
+    rng = onp.random.RandomState(23)
+    max_new = 12
+    n_req = 8       # phase A (chaos) load
+    n_post = 4      # phase C (respawned-replica-alone) load
+    prompts = [rng.randint(0, 96, rng.randint(2, 13)).tolist()
+               for _ in range(n_req + n_post)]
+
+    # unbatched references (the oracle): one generate() per request
+    refs = []
+    for p in prompts:
+        ids = mx.np.array([p], dtype="int32")
+        refs.append(onp.asarray(
+            model.generate(ids, max_new_tokens=max_new)
+            .asnumpy())[0].tolist())
+
+    sc = ServeConfig(max_slots=2, page_size=4, num_pages=0,
+                     prefill_chunk=4, max_len=32)
+    fleet = ServeFleet(model, replicas=2, config=sc, transport="process",
+                       respawn_budget=2, stall_timeout=15.0)
+    assert all(r.transport == "process" for r in fleet.replicas)
+    fleet.warmup()
+    assert all(r.pid is not None and r.pid != os.getpid()
+               for r in fleet.replicas), "workers must be real processes"
+
+    streams = {i: [] for i in range(len(prompts))}
+
+    def tok_cb(i):
+        return lambda t, r: streams[i].append(t)
+
+    # ---- phase A: chaos load — dropped frames + SIGKILL mid-stream ----
+    # arm AFTER warmup so spawn RPCs keep deterministic hit counts: the
+    # 3rd control send and 5th control receive are dropped mid-load; the
+    # wire client must retry and the worker must dedupe the re-sent rid
+    os.environ["MXTPU_FAULT_SPEC"] = "rpc_send@3,rpc_recv@5"
+    try:
+        fleet.start()
+        handles = {}
+        for i in range(n_req):
+            handles[i] = fleet.submit(prompts[i], max_new_tokens=max_new,
+                                      on_token=tok_cb(i))
+
+        # wait until the target worker holds a request WITH streamed
+        # progress — the hardest failover shape: the ledger must fold
+        # those tokens into the re-prefill prefix, not replay them
+        victim = fleet.replicas[0]
+        victim_pid = victim.pid
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            sched = victim.engine.scheduler
+            with sched._lock:
+                progressed = any(e.req.tokens for e in
+                                 sched._ledger.values())
+            if progressed:
+                break
+            time.sleep(0.002)
+        assert progressed, "victim never held a progressed stream"
+        os.kill(victim_pid, signal.SIGKILL)
+
+        # the supervisor/reader must declare it dead, fail the streams
+        # over from the ledger, and respawn within the budget
+        deadline = time.time() + 30
+        while fleet.respawns == 0 and time.time() < deadline:
+            time.sleep(0.005)
+        assert fleet.deaths >= 1, "SIGKILL never detected"
+        assert fleet.respawns >= 1, "killed worker never respawned"
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            reborn = fleet._rep(victim.name)
+            if reborn is not victim and reborn.state == "running":
+                break
+            time.sleep(0.005)
+        assert reborn.generation == victim.generation + 1
+        assert reborn.pid not in (victim_pid, None, os.getpid())
+
+        # ---- zero dropped requests, bit-identical streams ------------
+        for i in range(n_req):
+            got = handles[i].result(timeout=60)
+            assert got == refs[i], (
+                f"request {i}: fleet output diverged from single-request "
+                f"generate\n  got {got}\n  ref {refs[i]}")
+            assert streams[i] == refs[i][len(prompts[i]):], (
+                f"request {i}: streamed tokens diverged (re-emission or "
+                f"loss): {streams[i]} vs {refs[i][len(prompts[i]):]}")
+        failovers = sum(handles[i].failovers for i in range(n_req))
+        assert failovers >= 1, (
+            "the SIGKILLed worker was expected to fail over >= 1 "
+            "in-flight request")
+
+        # ---- phase B: drain the surviving ORIGINAL over the wire -----
+        other = next(r for r in fleet.replicas if r is not reborn)
+        assert fleet.drain(other.name, timeout=45), "wire drain timed out"
+        assert other.state == "drained", other.state
+        assert other.proc.wait(timeout=15) == 0, (
+            "drained worker should exit cleanly")
+
+        # ---- phase C: the respawned worker serves ALONE --------------
+        for i in range(n_req, n_req + n_post):
+            handles[i] = fleet.submit(prompts[i], max_new_tokens=max_new,
+                                      on_token=tok_cb(i))
+        for i in range(n_req, n_req + n_post):
+            got = handles[i].result(timeout=60)
+            assert got == refs[i], (
+                f"post-respawn request {i} diverged:\n  got {got}\n  "
+                f"ref {refs[i]}")
+            assert streams[i] == refs[i][len(prompts[i]):], i
+        assert reborn.engine.scheduler.inflight == 0
+
+        # the armed drops must actually have fired (otherwise this smoke
+        # proved nothing about frame loss) and the wire client must have
+        # healed them by retrying
+        from mxnet_tpu.resilience import fault_registry
+        assert fault_registry().hits("rpc_send") >= 3, (
+            "rpc_send fault point never reached its armed hit")
+        assert fault_registry().hits("rpc_recv") >= 5, (
+            "rpc_recv fault point never reached its armed hit")
+        wire_retries = sum(
+            r._control.retried for r in (reborn, other)
+            if r._control is not None) + (
+            victim._control.retried if victim._control else 0)
+        assert wire_retries >= 1, "dropped frames were never retried"
+    finally:
+        os.environ.pop("MXTPU_FAULT_SPEC", None)
+        fleet.close()
+
+    # ---- telemetry / journal contract --------------------------------
+    snap = tele.snapshot()
+    deaths = snap["serve_replica_deaths_total"]["series"]
+    assert sum(s["value"] for s in deaths) == fleet.deaths
+    respawn_metric = snap["serve_replica_respawns_total"]["series"]
+    assert sum(s["value"] for s in respawn_metric) == fleet.respawns
+    finished = [s for s in snap["serve_requests_total"]["series"]
+                if s["labels"]["state"] == "finished"]
+    assert finished and finished[0]["value"] == n_req + n_post, finished
+    rows = tele.RunJournal.read(journal_path)
+    rphases = {r.get("phase") for r in rows if r.get("event") == "replica"}
+    for needed in ("started", "dead", "respawned", "draining", "drained"):
+        assert needed in rphases, f"journal missing replica phase {needed}"
+    respawn_rows = [r for r in rows if r.get("event") == "replica_respawn"]
+    assert respawn_rows, "journal missing replica_respawn event"
+    assert respawn_rows[0].get("transport") == "process", respawn_rows
+    assert respawn_rows[0].get("generation") == 1, respawn_rows
+
+    elapsed = time.time() - t_start
+    print(json.dumps({
+        "procfleet_smoke": "ok", "requests": n_req + n_post,
+        "deaths": fleet.deaths, "respawns": fleet.respawns,
+        "failovers": failovers, "drained": other.name,
+        "elapsed_s": round(elapsed, 1)}))
+    assert elapsed < 60, f"smoke took {elapsed:.0f}s (budget 60s)"
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
