@@ -634,6 +634,180 @@ def _measure_serve_fleet(replicas: int, kill_at: float,
     }
 
 
+def _pct_of(vals, p):
+    vals = sorted(vals)
+    if not vals:
+        return None
+    return round(vals[min(len(vals) - 1, int(p * (len(vals) - 1)))], 2)
+
+
+def _run_disagg_phase(fleet, prompts, max_new: int) -> dict:
+    """Submit one workload phase and drain it; returns the phase's
+    aggregate tokens/s plus the handoff count it generated (counters on
+    the fleet are cumulative, so the caller snapshots around us)."""
+    from mxnet_tpu.serve import ShedError
+    h0 = fleet.handoffs
+    handles = []
+    t0 = time.perf_counter()
+    for p in prompts:
+        while True:
+            try:
+                handles.append(fleet.submit(p, max_new_tokens=max_new))
+                break
+            except ShedError as e:
+                time.sleep(min(e.retry_after_ms, 50.0) / 1e3)
+    for h in handles:
+        h.result(timeout=300)
+    wall = time.perf_counter() - t0
+    toks = sum(len(h.tokens) for h in handles)
+    ttfts = [h.ttft_s * 1e3 for h in handles if h.ttft_s is not None]
+    return {
+        "requests": len(prompts),
+        "generated_tokens": toks,
+        "tokens_per_sec": round(toks / wall, 2),
+        "wall_s": round(wall, 3),
+        "ttft_p50_ms": _pct_of(ttfts, 0.50),
+        "ttft_p99_ms": _pct_of(ttfts, 0.99),
+        "handoffs": fleet.handoffs - h0,
+    }
+
+
+def _role_steps(fleet) -> dict:
+    out = {}
+    for rep in fleet.replicas:
+        role = getattr(rep.engine, "role", "both")
+        out[role] = out.get(role, 0) + getattr(
+            rep.engine.scheduler, "_steps", 0)
+    return out
+
+
+def _measure_serve_disagg(disagg: str, tp: int) -> dict:
+    """`bench.py --serve --disagg PxD [--tp N]`: prefill/decode
+    disaggregation throughput (docs/serving.md "Disaggregated
+    serving").  Runs a P-prefill/D-decode fleet (thread transport —
+    the handoff semantics are identical to the process wire, without
+    process-spawn noise in the numbers) through two workload phases:
+
+    - **prefill-bound**: long prompts, tiny completions — the phase
+      that saturates the prefill tier;
+    - **decode-bound**: short prompts, long completions — the phase
+      the tensor-parallel fused decode step is for.
+
+    Reports per-phase aggregate tokens/s, handoff latency p50/p99,
+    per-role step-share utilization, and the INDEPENDENT-SCALING
+    check: the prefill-bound phase re-run with one extra prefill
+    replica (decode tier untouched) — aggregate tokens/s should
+    improve, the whole point of splitting the tiers."""
+    # tp decode shards need devices to shard over: give the CPU
+    # backend 8 virtual devices BEFORE jax initializes
+    if "--xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8")
+    import jax
+    ambient = os.environ.get("JAX_PLATFORMS", "").lower()
+    if not any(t in ambient for t in ("tpu", "axon")):
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from mxnet_tpu.serve import ServeConfig, ServeFleet
+
+    try:
+        p_reps, d_reps = (int(x) for x in disagg.lower().split("x"))
+    except ValueError:
+        raise SystemExit(f"--disagg must be PxD (e.g. 1x2), "
+                         f"got {disagg!r}")
+
+    dev = jax.devices()[0]
+    cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                    num_heads=4, intermediate_size=128,
+                    max_position=256, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.initialize()
+    model(mx.np.array([[1, 2]], dtype="int32"))
+
+    rng = _onp.random.RandomState(0)
+    n_req = 12
+    # prefill-bound: 48..64-token prompts, 4 new tokens each
+    pre_prompts = [rng.randint(0, cfg.vocab_size,
+                               rng.randint(48, 65)).tolist()
+                   for _ in range(n_req)]
+    # decode-bound: 4..8-token prompts, 32 new tokens each
+    dec_prompts = [rng.randint(0, cfg.vocab_size,
+                               rng.randint(4, 9)).tolist()
+                   for _ in range(n_req)]
+
+    sc = ServeConfig(max_slots=4, page_size=8, max_len=128,
+                     prefill_chunk=16, tp=tp)
+
+    def run_fleet(p, d):
+        fleet = ServeFleet(model, config=sc, transport="thread",
+                           disagg=(p, d))
+        compile_s = fleet.warmup()
+        with fleet:
+            s0 = _role_steps(fleet)
+            pre = _run_disagg_phase(fleet, pre_prompts, max_new=4)
+            s1 = _role_steps(fleet)
+            dec = _run_disagg_phase(fleet, dec_prompts, max_new=32)
+            s2 = _role_steps(fleet)
+            fleet.quiesce(30)
+            stats = fleet.stats()
+            hand_ms = list(fleet.handoff_ms)
+        roles = sorted(s0)
+
+        def share(a, b):
+            tot = max(1, sum(b[r] - a.get(r, 0) for r in roles))
+            return {r: round((b[r] - a.get(r, 0)) / tot, 3)
+                    for r in roles}
+        pre["role_step_share"] = share(s0, s1)
+        dec["role_step_share"] = share(s1, s2)
+        return {
+            "phases": {"prefill_bound": pre, "decode_bound": dec},
+            "compile_seconds": round(compile_s, 2),
+            "handoffs": stats["handoffs"],
+            "handoff_failures": stats["handoff_failures"],
+            "handoff_ms_p50": _pct_of(hand_ms, 0.50),
+            "handoff_ms_p99": _pct_of(hand_ms, 0.99),
+            "tp_resolved": {n: r["tp"]
+                            for n, r in stats["replicas"].items()},
+        }
+
+    base = run_fleet(p_reps, d_reps)
+    # independent scaling: +1 PREFILL replica, decode tier untouched —
+    # the prefill-bound phase is the one that should speed up
+    scaled = run_fleet(p_reps + 1, d_reps)
+    base_pre = base["phases"]["prefill_bound"]["tokens_per_sec"]
+    scaled_pre = scaled["phases"]["prefill_bound"]["tokens_per_sec"]
+
+    total_toks = sum(ph["generated_tokens"]
+                     for ph in base["phases"].values())
+    total_wall = sum(ph["wall_s"] for ph in base["phases"].values())
+    extras = {
+        "disagg": [p_reps, d_reps],
+        "tp": tp,
+        **base,
+        "prefill_scaling": {
+            "disagg": [p_reps + 1, d_reps],
+            "prefill_bound_tokens_per_sec": scaled_pre,
+            "base_tokens_per_sec": base_pre,
+            "improvement": (round(scaled_pre / base_pre, 3)
+                            if base_pre else None),
+        },
+        "device": getattr(dev, "device_kind", str(dev)),
+        "platform": dev.platform,
+    }
+    return {
+        "metric": "serve_disagg_tokens_per_sec",
+        "value": round(total_toks / total_wall, 2) if total_wall else 0.0,
+        "unit": "tokens_per_sec",
+        "vs_baseline": 0.0,   # north-star baseline is MFU-on-TPU
+        "extras": extras,
+    }
+
+
 def _measure_data() -> dict:
     """`bench.py --data`: throughput of the deterministic input pipeline
     (docs/data.md) — indexed RecordIO shards through the mixture
@@ -1337,7 +1511,15 @@ def main():
             # (docs/serving.md "Speculative decoding & prefix caching")
             spec = int(_flag_operand("--spec", "0")) \
                 if "--spec" in sys.argv else 0
-            if "--replicas" in sys.argv:
+            if "--disagg" in sys.argv:
+                # prefill/decode disaggregation: P prefill + D decode
+                # replicas, tp-sharded decode (docs/serving.md
+                # "Disaggregated serving"); --tp defaults to 2 so the
+                # tensor-parallel fused step is on the measured path
+                print(json.dumps(_measure_serve_disagg(
+                    _flag_operand("--disagg", "1x2"),
+                    int(_flag_operand("--tp", "2")))))
+            elif "--replicas" in sys.argv:
                 # fleet mode: aggregate tokens/s + tail TTFT under
                 # replica loss (docs/serving.md "Fleet, failover &
                 # overload"); --kill-at S kills a loaded replica S

@@ -44,7 +44,7 @@ from ..ops.pallas.quantized_matmul import (QuantizedTensor,  # noqa: F401
 
 __all__ = ["extract_decode_weights", "transformer_step", "lm_logits",
            "layer_norm", "quantize_decode_weights", "decode_weight_bytes",
-           "QUANT_DEFAULT_TARGETS"]
+           "QUANT_DEFAULT_TARGETS", "tp_qkv_row_perm"]
 
 
 def extract_decode_weights(model) -> dict:
@@ -166,9 +166,30 @@ def layer_norm(x, g, b, eps):
     return (x - m) / jnp.sqrt(v + eps) * g + b
 
 
+def tp_qkv_row_perm(H: int, Hkv: int, D: int, tp: int):
+    """Row permutation that reorders a packed ``wqkv`` weight from
+    ``[q_all | k_all | v_all]`` to ``[q_0 k_0 v_0 | q_1 k_1 v_1 | ...]``
+    so a plain contiguous dim-0 'tp' shard hands shard *i* exactly its
+    head-aligned ``[q_i, k_i, v_i]`` block (heads stay in original
+    order within each shard, so an all-gather over the head axis after
+    attention restores the exact tp=1 head order).  Applied host-side
+    once at engine construction, BEFORE quantization — per-out-channel
+    scales permute with their rows for free."""
+    E, kvw = H * D, Hkv * D
+    Hl, Hkvl = H // tp, Hkv // tp
+    idx = []
+    for i in range(tp):
+        idx.extend(range(i * Hl * D, (i + 1) * Hl * D))
+        idx.extend(range(E + i * Hkvl * D, E + (i + 1) * Hkvl * D))
+        idx.extend(range(E + kvw + i * Hkvl * D,
+                         E + kvw + (i + 1) * Hkvl * D))
+    return idx
+
+
 def transformer_step(P: dict, cfg, tok, pos,
                      kv_fn: Callable[[int, jax.Array, jax.Array,
-                                      jax.Array], jax.Array]):
+                                      jax.Array], jax.Array],
+                     tp: int = 1, tp_axis: Optional[str] = None):
     """Run C cached decoder tokens per batch row through the transformer.
 
     P: weights from :func:`extract_decode_weights`; cfg: the model's
@@ -178,6 +199,17 @@ def transformer_step(P: dict, cfg, tok, pos,
     keys/values (B, Hkv, C, D), must make the new K/V visible to its
     cache, and returns the attention context (B, H, C, D).
 
+    ``tp > 1`` (with ``tp_axis`` naming the mesh axis — the body then
+    runs inside a `shard_map` over that axis): wqkv/wo/w1/w2 arrive as
+    OUTPUT-dim shards (wqkv rows pre-permuted head-aligned by
+    :func:`tp_qkv_row_perm`), attention runs on the local H/tp heads,
+    and each sharded matmul keeps its FULL contraction length — partial
+    outputs are all-gathered, never psum-reduced.  Every f32 dot
+    product therefore accumulates in exactly the tp=1 order, which is
+    what keeps greedy streams bit-identical across tp (the PR 6/14
+    invariant; a psum row-parallel split would reassociate the sum and
+    flip near-tie argmaxes).
+
     Returns the final-layernormed hidden states (B, C, E) — feed them to
     :func:`lm_logits` (callers usually slice to the rows they need
     first: one LM-head matmul per kept row, not per padded row).
@@ -185,10 +217,18 @@ def transformer_step(P: dict, cfg, tok, pos,
     H, E = cfg.num_heads, cfg.hidden_size
     D = E // H
     Hkv = getattr(cfg, "num_kv_heads", None) or H
-    kvw = Hkv * D
     eps = cfg.layer_norm_eps
     use_rope = getattr(cfg, "rope", False)
     B, C = tok.shape
+    # local head counts (tp=1: globals); the per-shard qkv slab keeps
+    # the [q | k | v] layout with local widths thanks to the row perm
+    Hl, Hkvl = H // tp, Hkv // tp
+    El, kvwl = Hl * D, Hkvl * D
+
+    def gather(x, axis):
+        if tp == 1:
+            return x
+        return jax.lax.all_gather(x, tp_axis, axis=axis, tiled=True)
 
     h = gather_rows(P["embed"], tok)                     # (B, C, E)
     if not use_rope:
@@ -196,27 +236,46 @@ def transformer_step(P: dict, cfg, tok, pos,
     for li, L in enumerate(P["layers"]):
         a = layer_norm(h, L["ln1_g"], L["ln1_b"], eps)
         qkv = matmul_nt(a, L["wqkv"]) + L["bqkv"]
-        q = qkv[..., :E].reshape(B, C, H, D).transpose(0, 2, 1, 3)
-        k = qkv[..., E:E + kvw].reshape(B, C, Hkv, D).transpose(0, 2, 1, 3)
-        v = qkv[..., E + kvw:].reshape(B, C, Hkv, D).transpose(0, 2, 1, 3)
+        q = qkv[..., :El].reshape(B, C, Hl, D).transpose(0, 2, 1, 3)
+        k = qkv[..., El:El + kvwl].reshape(
+            B, C, Hkvl, D).transpose(0, 2, 1, 3)
+        v = qkv[..., El + kvwl:].reshape(
+            B, C, Hkvl, D).transpose(0, 2, 1, 3)
         if use_rope:
             from ..ops.attention import rope_rotate
             # same rotation helper as the full forward; cached keys are
-            # stored pre-rotated
+            # stored pre-rotated.  Rotation is per-head-dim, identical
+            # for every head — shard-local heads rotate exactly as the
+            # same heads do at tp=1.
             q = rope_rotate(q, pos[:, None, :], cfg.rope_theta)
             k = rope_rotate(k, pos[:, None, :], cfg.rope_theta)
-        ctx = kv_fn(li, q, k, v)                          # (B, H, C, D)
-        h = h + matmul_nt(ctx.transpose(0, 2, 1, 3).reshape(B, C, E),
-                          L["wo"]) + L["bo"]
+        ctx = kv_fn(li, q, k, v)                          # (B, Hl, C, D)
+        # all-gather the head axis (contiguous head blocks -> original
+        # order), then the out-proj runs its full contraction against
+        # the local OUT-dim rows of wo; gather the partial out columns
+        ctx = gather(ctx, 1)
+        attn = matmul_nt(ctx.transpose(0, 2, 1, 3).reshape(B, C, E),
+                         L["wo"])
+        h = h + gather(attn, -1) + L["bo"]
         f = layer_norm(h, L["ln2_g"], L["ln2_b"], eps)
-        h = h + matmul_nt(jax.nn.gelu(matmul_nt(f, L["w1"]) + L["b1"]),
-                          L["w2"]) + L["b2"]
+        inter = jax.nn.gelu(matmul_nt(f, L["w1"]) + L["b1"])
+        h = h + gather(matmul_nt(gather(inter, -1), L["w2"]), -1) \
+            + L["b2"]
     return layer_norm(h, P["lnf_g"], P["lnf_b"], eps)
 
 
-def lm_logits(P: dict, h):
-    """LM-head logits for hidden states `h` (..., E) -> (..., V)."""
-    return matmul_nt(h, P["embed"] if P["head"] is None else P["head"])
+def lm_logits(P: dict, h, tp: int = 1, tp_axis: Optional[str] = None):
+    """LM-head logits for hidden states `h` (..., E) -> (..., V).
+
+    Under tp the UNTIED head is an output(vocab)-dim shard — gather the
+    logit columns; the tied path reads the replicated embedding table,
+    so every shard computes identical full logits with no collective."""
+    if P["head"] is None:
+        return matmul_nt(h, P["embed"])
+    out = matmul_nt(h, P["head"])
+    if tp > 1:
+        out = jax.lax.all_gather(out, tp_axis, axis=-1, tiled=True)
+    return out
 
 
 def dense_kv_fn(kcache, vcache, pos, window: Optional[int] = None):

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import math
 import os
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -44,7 +45,8 @@ from .. import health as _health
 from .. import telemetry as _tele
 from .. import tracing as _trace
 from .decode import (extract_decode_weights, transformer_step, lm_logits,
-                     quantize_decode_weights, decode_weight_bytes)
+                     quantize_decode_weights, decode_weight_bytes,
+                     tp_qkv_row_perm)
 from .kv_cache import (KVPools, PageAllocator, PrefixIndex,
                        make_paged_kv_fn)
 from .scheduler import ContinuousBatchingScheduler, ServeRequest
@@ -115,6 +117,20 @@ class ServeConfig:
     # Host-side policy only — the compiled program is unchanged.
     prefix_cache: bool = field(
         default_factory=lambda: _env_int("MXTPU_PREFIX_CACHE", 0) > 0)
+    # tensor parallelism: shard the decode weights + paged KV pool over
+    # a 'tp' mesh axis; the fused step runs under shard_map with
+    # all-gather collectives (docs/serving.md "Disaggregated serving").
+    # Degrades (gcd) to what the device count / head counts allow —
+    # never refuses.  Part of the export identity.
+    tp: int = field(
+        default_factory=lambda: _env_int("MXTPU_SERVE_TP", 1))
+    # disaggregated serving role: 'prefill' engines run chunked prefill
+    # then hand the request + its KV pages off; 'decode' engines adopt
+    # prefilled requests; 'both' (default) is the classic combined
+    # engine.  Host-side policy — the compiled program is unchanged.
+    role: str = field(
+        default_factory=lambda: os.environ.get(
+            "MXTPU_SERVE_ROLE", "") or "both")
     # engine-wide sampling filter (static: part of the compiled step)
     top_k: int = 0
     top_p: float = 1.0
@@ -126,6 +142,13 @@ class ServeConfig:
             raise MXNetError("page_size must be >= 1")
         if self.prefill_chunk < 1:
             raise MXNetError("prefill_chunk must be >= 1")
+        if self.tp < 1:
+            raise MXNetError(
+                f"tp must be >= 1, got {self.tp} (MXTPU_SERVE_TP)")
+        if self.role not in ("prefill", "decode", "both"):
+            raise MXNetError(
+                f"role must be 'prefill', 'decode', or 'both'; got "
+                f"{self.role!r} (MXTPU_SERVE_ROLE)")
         if self.quant_bits not in (0, 4, 8):
             raise MXNetError(
                 f"quant_bits must be 0 (dense), 8, or 4; got "
@@ -174,9 +197,17 @@ class InferenceEngine:
         self.quant_info = None
         self._step_fns = {}       # chunk width C -> jitted step
         self._execs = {}          # chunk width C -> AOT executable
+        #: disaggregation role ('prefill' | 'decode' | 'both') — read by
+        #: the scheduler (handoff detach) and the fleet router
+        self.role = sc.role
+        self._resolve_tp()
+        if self.tp > 1:
+            self._permute_qkv_rows()
         if sc.quant_bits:
             self.quantize_weights(sc.quant_bits,
                                   thresholds=act_thresholds)
+        if self.tp > 1:
+            self._tp_shard_weights()
         # auto pool size: every slot can hold a full-length sequence,
         # plus the reserved null page — PLUS the pages the quantized
         # weights just paid for: the capacity freed by smaller weights
@@ -192,6 +223,8 @@ class InferenceEngine:
         self.pools = KVPools.create(
             cfg.num_layers, num_pages, sc.page_size, self.n_kv_heads,
             self.head_dim, dtype=kv_dtype)
+        if self.tp > 1:
+            self._tp_shard_pools()
         self.allocator = PageAllocator(num_pages, sc.page_size)
         #: cross-request prompt-prefix cache (MXTPU_PREFIX_CACHE):
         #: shared read-only page runs with COW forks; None when off
@@ -201,6 +234,12 @@ class InferenceEngine:
         self.drafter = drafter if drafter is not None else (
             NGramDrafter() if sc.spec_tokens > 0 else None)
         self._cow_fn = None        # lazy jitted page-copy (COW forks)
+        # serializes every device op that donates or reads the pool
+        # buffers (the fused step, COW copies, handoff page
+        # export/install): a worker's control thread lands kv_import
+        # while the main loop is mid-step, and racing two donations of
+        # the same buffer is use-after-free
+        self._device_lock = threading.RLock()
         self.scheduler = ContinuousBatchingScheduler(self)
         self._key = jax.random.PRNGKey(seed)
         self.compile_seconds = None
@@ -280,6 +319,10 @@ class InferenceEngine:
                                                     sc.page_size)
                 if sched is not None:
                     sched.allocator = self.allocator
+        if self.tp > 1:
+            self._tp_shard_weights()
+            if getattr(self, "pools", None) is not None:
+                self._tp_shard_pools()
         self._note_weight_bytes()
         return info
 
@@ -298,6 +341,105 @@ class InferenceEngine:
         ).set(self.weight_bytes())
 
     # ------------------------------------------------------------------
+    # tensor parallelism (ServeConfig.tp / MXTPU_SERVE_TP)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _outdim(w) -> int:
+        q = getattr(w, "q", None)    # QuantizedTensor plane
+        return int((q if q is not None else w).shape[0])
+
+    def _resolve_tp(self) -> None:
+        """Clamp the requested tp to what the device count and the
+        model's shapes allow — the `fit_axes` degrade contract: tp=2 on
+        1 device (or odd head counts) becomes tp=1 with a LOUD log,
+        never a crash.  tp must divide the kv-head count (contiguous
+        head blocks keep every GQA query head with its kv head), the
+        FFN intermediate width, the hidden size, and the untied vocab."""
+        from ..parallel.mesh import fit_axes, make_mesh
+        sc = self.serve_config
+        want = max(1, int(sc.tp))
+        tp = fit_axes(len(jax.devices()), tp=want)["tp"]
+        dims = [self.n_kv_heads, self.cfg.num_heads,
+                self.cfg.hidden_size]
+        if self.P["layers"]:
+            dims.append(self._outdim(self.P["layers"][0]["w1"]))
+        if self.P.get("head") is not None:
+            dims.append(self._outdim(self.P["head"]))
+        for d in dims:
+            tp = math.gcd(tp, int(d))
+        if tp != want:
+            import logging
+            logging.getLogger(__name__).warning(
+                "serve tp degraded %d -> %d (%d visible device(s), "
+                "kv_heads=%d, hidden=%d): the serve mesh re-forms at "
+                "what the topology supports instead of refusing "
+                "(docs/serving.md)", want, tp, len(jax.devices()),
+                self.n_kv_heads, self.cfg.hidden_size)
+        self.tp = tp
+        self._mesh = (make_mesh({"tp": tp}, jax.devices()[:tp])
+                      if tp > 1 else None)
+
+    def _permute_qkv_rows(self) -> None:
+        """Host-side head-aligned row permutation of every packed qkv
+        projection (weights AND biases) so a contiguous dim-0 'tp'
+        shard carries ``[q_i, k_i, v_i]`` — see `tp_qkv_row_perm`.
+        Runs BEFORE quantization (per-out-channel scales then permute
+        with their rows) and never mutates a model-shared pytree."""
+        H = self.cfg.num_heads
+        perm = onp.asarray(tp_qkv_row_perm(H, self.n_kv_heads,
+                                           self.head_dim, self.tp))
+        layers = []
+        for L in self.P["layers"]:
+            NL = dict(L)
+            NL["wqkv"] = jnp.asarray(L["wqkv"])[perm]
+            NL["bqkv"] = jnp.asarray(L["bqkv"])[perm]
+            layers.append(NL)
+        self.P = dict(self.P, layers=layers)
+
+    # weight leaves sharded on their OUTPUT dim under tp (all-gather
+    # scheme — full-length contractions keep greedy streams bit-
+    # identical to tp=1); everything else replicated
+    _TP_SHARDED_KEYS = frozenset(
+        {"wqkv", "bqkv", "wo", "w1", "b1", "w2", "head"})
+
+    def _tp_weight_specs(self):
+        """Pytree of `PartitionSpec`s matching ``self.P`` (QuantizedTensor
+        planes and their per-channel scales both shard dim 0)."""
+        from jax.sharding import PartitionSpec as PS
+        tu = jax.tree_util
+
+        def spec(path, v):
+            names = {p.key for p in path if isinstance(p, tu.DictKey)}
+            if names & self._TP_SHARDED_KEYS:
+                return PS("tp", *([None] * (v.ndim - 1)))
+            return PS()
+        return tu.tree_map_with_path(spec, self.P)
+
+    def _pool_specs(self):
+        """PartitionSpecs for the pool arrays: K/V pages shard the
+        kv-head dim (axis 3); the int8 per-vector scale planes shard
+        their trailing kv-head dim."""
+        from jax.sharding import PartitionSpec as PS
+        return tuple(
+            PS(None, None, None, "tp", None) if a.ndim == 5
+            else PS(None, None, None, "tp")
+            for a in self.pools.as_tuple())
+
+    def _tp_shard_weights(self) -> None:
+        from jax.sharding import NamedSharding
+        mesh = self._mesh
+        self.P = jax.tree_util.tree_map(
+            lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
+            self.P, self._tp_weight_specs())
+
+    def _tp_shard_pools(self) -> None:
+        from jax.sharding import NamedSharding
+        mesh = self._mesh
+        self.pools = self.pools.replace(tuple(
+            jax.device_put(a, NamedSharding(mesh, s))
+            for a, s in zip(self.pools.as_tuple(), self._pool_specs())))
+
+    # ------------------------------------------------------------------
     # compiled step
     # ------------------------------------------------------------------
     def _step_fn(self, C: int):
@@ -313,6 +455,8 @@ class InferenceEngine:
         top_k, top_p = sc.top_k, sc.top_p
         max_pos = cfg.max_position
         spec_k = sc.spec_tokens
+        tp = self.tp
+        tp_axis = "tp" if tp > 1 else None
 
         def step(P, pools_t, tok, num_tokens, start_pos, page_tables,
                  ctx_lens, temps, greedy_mask, key):
@@ -325,10 +469,11 @@ class InferenceEngine:
             # gather only (writes are masked, attention rows are ignored)
             pos = jnp.minimum(start_pos[:, None] + jnp.arange(C)[None, :],
                               max_pos - 1)
-            h = transformer_step(P, cfg, tok, pos, kv_fn)
+            h = transformer_step(P, cfg, tok, pos, kv_fn,
+                                 tp=tp, tp_axis=tp_axis)
             B = tok.shape[0]
             last = h[jnp.arange(B), jnp.maximum(num_tokens - 1, 0)]
-            logits = lm_logits(P, last)                       # (B, V)
+            logits = lm_logits(P, last, tp, tp_axis)          # (B, V)
             greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             filtered = _filter_logits(
                 logits.astype(jnp.float32) / temps[:, None], top_k, top_p)
@@ -356,12 +501,30 @@ class InferenceEngine:
                 all_tok = jnp.stack(
                     [jnp.argmax(lm_logits(
                         P, h[jnp.arange(B),
-                             jnp.maximum(num_tokens - T + j, 0)]),
+                             jnp.maximum(num_tokens - T + j, 0)],
+                        tp, tp_axis),
                         axis=-1)
                      for j in range(T)], axis=1).astype(jnp.int32)
                 return tuple(pools[n] for n in pool_names), nxt, all_tok
             return tuple(pools[n] for n in pool_names), nxt
 
+        if tp > 1:
+            # the body runs per-shard: weights/pools arrive as their
+            # local OUT-dim / kv-head shards, batch inputs replicated;
+            # every cross-shard combine inside is an all-gather, so the
+            # sampled/greedy outputs are computed identically on every
+            # shard (replicated out_specs, checker off — the numeric
+            # pin is the tp bit-identity test)
+            from jax.sharding import PartitionSpec as PS
+            from ..parallel.mesh import shard_map_nocheck
+            rep = PS()
+            pool_specs = self._pool_specs()
+            in_specs = (self._tp_weight_specs(), pool_specs,
+                        rep, rep, rep, rep, rep, rep, rep, rep)
+            out_specs = ((pool_specs, rep, rep) if spec_k > 0
+                         else (pool_specs, rep))
+            step = shard_map_nocheck(step, self._mesh, in_specs,
+                                     out_specs)
         fn = jax.jit(step, donate_argnums=(1,))
         self._step_fns[C] = fn
         return fn
@@ -426,6 +589,12 @@ class InferenceEngine:
         optionally through an offline pass pipeline first (e.g.
         ``passes=[QuantizePass(bits=8)]`` — docs/quantization.md)."""
         from ..export import capture_serve, PassManager
+        if self.tp > 1:
+            raise MXNetError(
+                "serve export capture is single-device today: a tp>1 "
+                "engine compiles live (its executables embed the tp "
+                "mesh; `_export_config()['tp']` refuses cross-topology "
+                "installs) — capture at tp=1 or drop MXTPU_SERVE_TP")
         cap = capture_serve(self)
         if passes:
             cap = PassManager(passes).run(cap)
@@ -511,6 +680,11 @@ class InferenceEngine:
                 # failure matrix).  prefix_cache is deliberately absent
                 # — host-side policy, same compiled program.
                 "spec_tokens": sc.spec_tokens,
+                # tp topology is part of the artifact identity: a tp=2
+                # capture must never install into a tp=1 engine (the
+                # weight shards/collectives differ) — mismatch refuses
+                # at load, the zero-retrace contract stays intact
+                "tp": self.tp,
                 "top_k": sc.top_k, "top_p": sc.top_p}
 
     def _install_weights(self, params: dict, path: str) -> None:
@@ -547,7 +721,7 @@ class InferenceEngine:
         # not code, so an un-opted-in engine must never silently serve
         # a stale artifact left in the store by an earlier run
         from ..export import auto_capture_enabled, export_dir, signature
-        if not auto_capture_enabled():
+        if not auto_capture_enabled() or self.tp > 1:
             return None
         d = export_dir()
         if not d:
@@ -633,19 +807,25 @@ class InferenceEngine:
         ex = self._execs.get(C)
         if ex is None:
             ex = self._compile(C)
+        if self.tp > 1:
+            # fault-injection point for the tp collective path: a shard
+            # lost mid-step surfaces here (docs/resilience.md)
+            from ..resilience import fault_point
+            fault_point("tp_collective")
         self._steps_executed += 1
         self._key, sub = jax.random.split(self._key)
-        out = ex(
-            self.P, self.pools.as_tuple(), jnp.asarray(tok),
-            jnp.asarray(num_tokens), jnp.asarray(start_pos),
-            jnp.asarray(tables), jnp.asarray(ctx_lens),
-            jnp.asarray(temps), jnp.asarray(greedy_mask), sub)
-        if self.serve_config.spec_tokens > 0:
-            out_pools, nxt, all_tok = out
-        else:
-            (out_pools, nxt), all_tok = out, None
-        # rebind the donated pool buffers to the step's outputs
-        self.pools = self.pools.replace(out_pools)
+        with self._device_lock:
+            out = ex(
+                self.P, self.pools.as_tuple(), jnp.asarray(tok),
+                jnp.asarray(num_tokens), jnp.asarray(start_pos),
+                jnp.asarray(tables), jnp.asarray(ctx_lens),
+                jnp.asarray(temps), jnp.asarray(greedy_mask), sub)
+            if self.serve_config.spec_tokens > 0:
+                out_pools, nxt, all_tok = out
+            else:
+                (out_pools, nxt), all_tok = out, None
+            # rebind the donated pool buffers to the step's outputs
+            self.pools = self.pools.replace(out_pools)
         return (onp.asarray(jax.device_get(nxt)),
                 None if all_tok is None
                 else onp.asarray(jax.device_get(all_tok)))
@@ -661,11 +841,44 @@ class InferenceEngine:
             self._cow_fn = jax.jit(
                 lambda a, s, d: a.at[:, d].set(a[:, s]),
                 donate_argnums=(0,))
-        arrs = self.pools.arrays
         s = jnp.int32(src)
         d = jnp.int32(dst)
-        for name in self.pools.names:
-            arrs[name] = self._cow_fn(arrs[name], s, d)
+        with self._device_lock:
+            arrs = self.pools.arrays
+            for name in self.pools.names:
+                arrs[name] = self._cow_fn(arrs[name], s, d)
+
+    # ------------------------------------------------------------------
+    # KV page transfer (prefill -> decode handoff, docs/serving.md)
+    # ------------------------------------------------------------------
+    def export_pages(self, page_ids) -> dict:
+        """Host copies of the listed physical pages, every pool array
+        (K + V + scale planes): ``{name: ndarray[..., n_pages, ...]}``
+        with the page dim at axis 1.  The prefill side of a cross-
+        process handoff — the fleet ships these as binary wire blobs."""
+        ids = onp.asarray(page_ids, onp.int32)
+        with self._device_lock:
+            return {name: onp.asarray(
+                        jax.device_get(self.pools.arrays[name][:, ids]))
+                    for name in self.pools.names}
+
+    def install_pages(self, page_ids, arrays: dict) -> None:
+        """Scatter `export_pages`-shaped contents into this engine's
+        pool at (already-allocated) `page_ids` — the decode side of a
+        cross-process handoff.  Jitted with the pool donated (in-place
+        on device); page ids are traced, so one compile per
+        (pool aval, page count) covers repeated handoffs."""
+        if getattr(self, "_install_fn", None) is None:
+            self._install_fn = jax.jit(
+                lambda a, ids, vals: a.at[:, ids].set(vals),
+                donate_argnums=(0,))
+        ids = jnp.asarray(page_ids, jnp.int32)
+        with self._device_lock:
+            arrs = self.pools.arrays
+            for name in self.pools.names:
+                arrs[name] = self._install_fn(
+                    arrs[name], ids,
+                    jnp.asarray(arrays[name], arrs[name].dtype))
 
     # ------------------------------------------------------------------
     # public API (delegates to the scheduler)
@@ -745,6 +958,11 @@ class InferenceEngine:
             "quant_bits": self.quant_bits,
             "bonus_pages": getattr(self, "bonus_pages", 0),
             "compile_seconds": self.compile_seconds,
+            "tp": self.tp,
+            "role": self.role,
+            "handoff_pending": self.scheduler.handoff_depth,
+            "handoffs_out": self.scheduler.handoffs_out,
+            "handoffs_in": self.scheduler.handoffs_in,
             "spec_tokens": self.serve_config.spec_tokens,
             "spec": self.scheduler.spec_stats(),
             "prefix_cache": (None if self.prefix_index is None

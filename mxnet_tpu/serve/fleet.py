@@ -47,6 +47,7 @@ assert zero dropped requests and bit-identical streams.
 """
 from __future__ import annotations
 
+import dataclasses
 import logging
 import math
 import os
@@ -56,8 +57,8 @@ import sys
 import tempfile
 import threading
 import time
-from collections import OrderedDict
-from typing import List, Optional
+from collections import OrderedDict, deque
+from typing import List, Optional, Tuple
 
 from ..base import MXNetError
 from ..resilience import fault_point
@@ -246,6 +247,7 @@ class _RemoteScheduler:
                 time.perf_counter() - req.submitted_ts) * 1e3)
         rep.call(
             "submit", rid=req.id, prompt=req._sequence(),
+            attempt=req._epoch,
             max_new=req.max_new_tokens - len(req.tokens),
             greedy=req.greedy, temperature=req.temperature,
             eos=req.eos_token_id, front=bool(front),
@@ -322,6 +324,49 @@ class _RemoteScheduler:
                     state="failed", phase="failed", replica=self.name,
                     generated=len(req.tokens))
 
+    # -- disaggregation: ledger custody moves with the KV handoff ------
+    def handoff_out(self, rid: int, tokens: List[int]
+                    ) -> Optional[_Ledger]:
+        """Take custody of a ledgered request at prefill-complete time:
+        reconcile the worker's token list (``tok`` frames may race the
+        ``prefilled`` event), then pop the entry — the fleet's handoff
+        pump owns the stream until the decode replica adopts it.
+        Returns None when there is nothing to hand off (request already
+        finished/salvaged)."""
+        with self._lock:
+            if self._abandoned:
+                return None
+            e = self._ledger.pop(rid, None)
+            if e is None:
+                return None
+            req = e.req
+            for t in tokens[len(req.tokens) - e.base:]:
+                if deliver_token(req, int(t), replica=self.name):
+                    finish_request(req, replica=self.name)
+                    return None
+        return None if req.done() else e
+
+    def adopt_ledger(self, rid: int, entry: _Ledger) -> None:
+        """Install a ledger entry moved in from the prefill replica.
+        The decode worker pre-seeds the FULL parent token list, so its
+        token indices are absolute — reset ``base`` to 0 (a folded
+        re-dispatch left it at the fold offset) and drop any stash
+        keyed in the old worker's numbering."""
+        with self._lock:
+            if self.draining or self._abandoned:
+                raise MXNetError(
+                    f"replica {self.name} is "
+                    f"{'draining' if self.draining else 'retired'} and "
+                    f"not adopting handoffs")
+            entry.base = 0
+            entry.stash.clear()
+            self._ledger[rid] = entry
+            self._submitted_since_hb += 1
+
+    def drop_ledger(self, rid: int) -> Optional[_Ledger]:
+        with self._lock:
+            return self._ledger.pop(rid, None)
+
     # -- fleet hooks -----------------------------------------------------
     def detach_queued(self) -> List[ServeRequest]:
         """Drain-over-the-wire: the worker detaches its queued requests
@@ -371,9 +416,15 @@ class _RemoteEngine:
     capacity math the router and validation need; the compiled step and
     the KV pool live in the worker."""
 
-    def __init__(self, model_cfg, serve_config: ServeConfig, name: str):
+    def __init__(self, model_cfg, serve_config: ServeConfig, name: str,
+                 role: Optional[str] = None, tp: Optional[int] = None):
         self.cfg = model_cfg
         self.serve_config = serve_config
+        #: mirrored role/tp of the REMOTE engine (per-worker overrides
+        #: of the fleet-wide spec) — the router's role-aware dispatch
+        #: and the handoff pump read these
+        self.role = role or serve_config.role
+        self.tp = tp or serve_config.tp
         self.max_len = serve_config.max_len or model_cfg.max_position
         self.max_pages_per_seq = max(
             1, math.ceil(self.max_len / serve_config.page_size))
@@ -397,7 +448,8 @@ class ProcessReplica(Replica):
     def __init__(self, name: str, fleet: "ServeFleet", idx: int):
         super().__init__(name,
                          _RemoteEngine(fleet.model.cfg, fleet.config,
-                                       name))
+                                       name, role=fleet._role_for(idx),
+                                       tp=fleet._tp_for(idx)))
         self.engine.scheduler.replica = self
         self._fleet = fleet
         self._idx = idx
@@ -430,7 +482,11 @@ class ProcessReplica(Replica):
                "--name", self.name, "--host", listener.host,
                "--port", str(listener.port),
                "--spec", fleet._write_spec(),
-               "--seed", str(fleet._seed + self._idx)]
+               "--seed", str(fleet._seed + self._idx),
+               # the spec dir is fleet-wide; role/tp specialize it
+               # per worker (disaggregation)
+               "--role", self.engine.role,
+               "--tp", str(self.engine.tp)]
         self.proc = subprocess.Popen(cmd)
         try:
             control, events, hello = listener.wait(
@@ -493,6 +549,9 @@ class ProcessReplica(Replica):
                                   ev.get("tokens") or [],
                                   ev.get("error"),
                                   bool(ev.get("expired")))
+                elif kind == "prefilled":
+                    _health.beat(self.heartbeat_name)
+                    self._fleet._on_prefilled(self, ev)
                 elif kind == "ready":
                     self.compile_seconds = ev.get("compile_seconds")
                     _health.beat(self.heartbeat_name)
@@ -582,13 +641,36 @@ class ServeFleet:
                  supervise_interval: Optional[float] = None,
                  transport: Optional[str] = None,
                  respawn_budget: Optional[int] = None,
-                 spawn_timeout: float = 120.0):
-        n = replicas if replicas is not None \
-            else _env_int("MXTPU_SERVE_REPLICAS", 2)
-        if n < 1:
-            raise MXNetError(f"fleet needs >= 1 replica, got {n}")
+                 spawn_timeout: float = 120.0,
+                 disagg: Optional[Tuple[int, int]] = None):
         self.model = model
         self.config = config or ServeConfig()
+        # disaggregated serving (docs/serving.md "Disaggregated
+        # serving"): `disagg=(P, D)` — or MXTPU_SERVE_DISAGG="PxD" —
+        # splits the fleet into P prefill + D decode replicas joined by
+        # the KV handoff pump; replica count becomes P + D
+        if disagg is None:
+            spec = os.environ.get("MXTPU_SERVE_DISAGG", "").strip()
+            if spec:
+                try:
+                    p, d = spec.lower().split("x")
+                    disagg = (int(p), int(d))
+                except ValueError:
+                    raise MXNetError(
+                        f"MXTPU_SERVE_DISAGG must look like '1x2' "
+                        f"(prefill x decode), got {spec!r}")
+        if disagg is not None:
+            disagg = (int(disagg[0]), int(disagg[1]))
+            if disagg[0] < 1 or disagg[1] < 1:
+                raise MXNetError(
+                    f"disagg needs >= 1 prefill and >= 1 decode "
+                    f"replica, got {disagg}")
+        self.disagg = disagg
+        n = (disagg[0] + disagg[1]) if disagg is not None \
+            else (replicas if replicas is not None
+                  else _env_int("MXTPU_SERVE_REPLICAS", 2))
+        if n < 1:
+            raise MXNetError(f"fleet needs >= 1 replica, got {n}")
         self.stall_timeout = float(stall_timeout)
         self.poll_interval = float(poll_interval)
         self.supervise_interval = float(
@@ -627,6 +709,20 @@ class ServeFleet:
             shed_deadline_ms=shed_deadline_ms,
             default_deadline_ms=self.config.deadline_ms)
         self.deaths = 0
+        # KV handoff pump (prefill -> decode): items queue here from the
+        # replica drivers (thread transport) / event readers (process
+        # transport) and one pump thread executes the transfers
+        self._handoff_q: deque = deque()
+        self._handoff_evt = threading.Event()
+        self._handoff_thread: Optional[threading.Thread] = None
+        #: per-transfer RPC timeout (MXTPU_HANDOFF_TIMEOUT_MS; 0 = the
+        #: wire default) — bulk page frames can dwarf control frames
+        self.handoff_timeout_ms = \
+            _env_int("MXTPU_HANDOFF_TIMEOUT_MS", 0) or None
+        self.handoffs = 0
+        self.handoff_failures = 0
+        self._handoff_inflight = 0
+        self.handoff_ms: List[float] = []
         self._stop = threading.Event()
         self._lock = threading.RLock()
         self._supervisor: Optional[threading.Thread] = None
@@ -634,12 +730,31 @@ class ServeFleet:
         self._started = False
         self._closed = False
 
+    def _role_for(self, idx: int) -> str:
+        if self.disagg is not None:
+            return "prefill" if idx < self.disagg[0] else "decode"
+        return self.config.role
+
+    def _tp_for(self, idx: int) -> int:
+        # the prefill tier stays single-device in a disagg fleet: tp
+        # buys decode-latency, and prefill throughput scales by adding
+        # prefill replicas instead
+        if self.disagg is not None and self._role_for(idx) == "prefill":
+            return 1
+        return self.config.tp
+
     def _make_replica(self, idx: int, generation: int = 0) -> Replica:
-        name = f"r{idx}"
+        role = self._role_for(idx)
+        name = f"r{idx}" if self.disagg is None else \
+            (f"p{idx}" if role == "prefill" else f"d{idx}")
         if self.transport == "process":
             rep = ProcessReplica(name, self, idx)
         else:
-            eng = InferenceEngine(self.model, self.config,
+            cfg = self.config
+            if role != cfg.role or self._tp_for(idx) != cfg.tp:
+                cfg = dataclasses.replace(cfg, role=role,
+                                          tp=self._tp_for(idx))
+            eng = InferenceEngine(self.model, cfg,
                                   seed=self._seed + idx)
             rep = Replica(name, eng)
             eng.scheduler.name = name
@@ -703,8 +818,24 @@ class ServeFleet:
                        for rep in self.replicas)
         first = self.replicas[0].engine
         secs = first.warmup()
+        # getattr: duck-typed engines (tests, external drivers) without a
+        # tp attribute are single-device
+        _tp = lambda e: getattr(e, "tp", 1)  # noqa: E731
         for rep in self.replicas[1:]:
-            rep.engine.adopt_executables(first)
+            if _tp(rep.engine) == _tp(first):
+                rep.engine.adopt_executables(first)
+            else:
+                # a different tp is a different step program (disagg:
+                # tp=1 prefill tier, tp=N decode tier) — compile it once
+                # here and let same-tp peers adopt below
+                peer = next(
+                    (r.engine for r in self.replicas
+                     if r.engine is not rep.engine and r.engine._execs
+                     and _tp(r.engine) == _tp(rep.engine)), None)
+                if peer is not None:
+                    rep.engine.adopt_executables(peer)
+                else:
+                    secs = max(secs, rep.engine.warmup())
         self._exec_source = first
         self._warmed = True
         return secs
@@ -732,6 +863,12 @@ class ServeFleet:
         self._supervisor = threading.Thread(
             target=self._supervise, daemon=True, name="serve-supervisor")
         self._supervisor.start()
+        if any(getattr(r.engine, "role", "both") == "prefill"
+               for r in self.replicas):
+            self._handoff_thread = threading.Thread(
+                target=self._handoff_pump, daemon=True,
+                name="serve-handoff")
+            self._handoff_thread.start()
         self._update_fleet_gauges()
         return self
 
@@ -750,6 +887,9 @@ class ServeFleet:
                 rep.thread.join(timeout)
         if self._supervisor is not None:
             self._supervisor.join(timeout)
+        if self._handoff_thread is not None:
+            self._handoff_evt.set()
+            self._handoff_thread.join(timeout)
         for t in self._respawn_threads:
             t.join(timeout)
         with self._lock:
@@ -773,6 +913,18 @@ class ServeFleet:
                     req, "fleet closed with the request in flight",
                     state="failed", phase="failover_failed",
                     replica=rep.name, generated=len(req.tokens))
+        # requests caught between prefill and decode: the pump is gone,
+        # so unblock their waiters too
+        with self._lock:
+            pending_handoffs = list(self._handoff_q)
+            self._handoff_q.clear()
+        for item in pending_handoffs:
+            req = item.get("req")
+            if req is not None and not req.done():
+                terminate_request(
+                    req, "fleet closed with the request mid-handoff",
+                    state="failed", phase="failover_failed",
+                    generated=len(req.tokens))
         self.router.fail_all_parked("fleet closed")
         if self._listener is not None:
             self._listener.close()
@@ -804,7 +956,9 @@ class ServeFleet:
         in the fleet (or `timeout` elapses — returns False)."""
         deadline = time.perf_counter() + timeout
         while time.perf_counter() < deadline:
-            busy = self.router.queue_depth > 0 or any(
+            busy = self.router.queue_depth > 0 \
+                or len(self._handoff_q) > 0 \
+                or self._handoff_inflight > 0 or any(
                 r.engine.scheduler.active_count
                 or r.engine.scheduler.queue_depth
                 or getattr(r.engine.scheduler, "inflight", 0)
@@ -1013,6 +1167,187 @@ class ServeFleet:
         self._update_fleet_gauges()
 
     # ------------------------------------------------------------------
+    # KV handoff pump (prefill tier -> decode tier)
+    # ------------------------------------------------------------------
+    def _on_prefilled(self, rep: "ProcessReplica", ev: dict) -> None:
+        """Event-reader hook: a prefill worker detached a freshly
+        prefilled request.  Take ledger custody (reconciling any racing
+        ``tok`` frames) and queue the transfer for the pump thread."""
+        rid = int(ev["rid"])
+        entry = rep.engine.scheduler.handoff_out(
+            rid, [int(t) for t in ev.get("tokens") or []])
+        if entry is None:
+            # finished during prefill (or already salvaged): no decode
+            # leg — just release the worker-side pages
+            self._enqueue_handoff(rep, {"rid": rid, "req": None})
+            return
+        self._enqueue_handoff(rep, {
+            "rid": rid, "req": entry.req, "entry": entry,
+            "ctx": int(ev["ctx"]), "n_pages": int(ev.get("n_pages", 0))})
+
+    def _enqueue_handoff(self, rep: Replica, item: dict) -> None:
+        item["src"] = rep
+        item.setdefault("ts", time.perf_counter())
+        with self._lock:
+            self._handoff_q.append(item)
+        self._handoff_evt.set()
+        if _tele.enabled():
+            _tele.gauge("serve_handoff_queue_depth",
+                        "Handoffs waiting for the pump thread"
+                        ).set(len(self._handoff_q))
+
+    def _handoff_pump(self) -> None:
+        while not self._stop.is_set():
+            self._handoff_evt.wait(0.05)
+            self._handoff_evt.clear()
+            while not self._stop.is_set():
+                with self._lock:
+                    if not self._handoff_q:
+                        break
+                    item = self._handoff_q.popleft()
+                    self._handoff_inflight += 1
+                try:
+                    self._do_handoff(item)
+                finally:
+                    with self._lock:
+                        self._handoff_inflight -= 1
+
+    def _pick_decode(self) -> Optional[Replica]:
+        cands = [r for r in self.replicas
+                 if r.state in ("starting", "running")
+                 and getattr(r.engine, "role", "both")
+                 in ("decode", "both")]
+        if not cands:
+            return None
+        return min(cands, key=self.router._score)
+
+    def _do_handoff(self, item: dict) -> None:
+        """Execute ONE prefill->decode transfer.  Cross-process: page
+        contents travel as binary wire frames (kv_export -> kv_import ->
+        submit_prefilled -> kv_free); same-process (thread transport):
+        content copy between the two engines' pools.  ANY failure —
+        including an injected ``kv_handoff`` fault — re-queues the
+        request at the prefill tier with its pages freed on both sides:
+        admitted work is never dropped."""
+        src, req, rid = item["src"], item.get("req"), item.get("rid")
+        try:
+            fault_point("kv_handoff")
+            if req is None:      # no decode leg: free worker-side pages
+                if src.transport == "process":
+                    src.call("kv_free", rid=rid)
+                return
+            dst = self._pick_decode()
+            if dst is None:
+                raise MXNetError("no decode-capable replica to adopt "
+                                 "the prefilled request")
+            if src.transport == "process":
+                resp = src.call("kv_export", rid=rid,
+                                _timeout_ms=self.handoff_timeout_ms)
+                dst.call("kv_import", rid=rid, meta=resp["meta"],
+                         n_pages=int(resp["n_pages"]),
+                         _timeout_ms=self.handoff_timeout_ms,
+                         _blobs=tuple(resp.get("_blobs") or ()))
+                item["_dst"] = dst
+                dsched = dst.engine.scheduler
+                # ledger BEFORE submit: the decode worker may start
+                # streaming the moment the adopt seats
+                dsched.adopt_ledger(rid, item["entry"])
+                try:
+                    remaining = 0.0
+                    if req.deadline_ms > 0:
+                        remaining = max(1.0, req.deadline_ms - (
+                            time.perf_counter()
+                            - req.submitted_ts) * 1e3)
+                    dst.call(
+                        "submit_prefilled", rid=rid, prompt=req.prompt,
+                        tokens=[int(t) for t in req.tokens],
+                        attempt=req._epoch, ctx=int(item["ctx"]),
+                        max_new=req.max_new_tokens, greedy=req.greedy,
+                        temperature=req.temperature,
+                        eos=req.eos_token_id, deadline_ms=remaining,
+                        _timeout_ms=self.handoff_timeout_ms)
+                except BaseException:
+                    dsched.drop_ledger(rid)
+                    raise
+                src.call("kv_free", rid=rid)
+            else:
+                item["_dst"] = dst
+                pages = item["pages"]
+                new_pages = dst.engine.allocator.alloc(len(pages))
+                if new_pages is None:
+                    raise MXNetError(
+                        f"decode replica {dst.name} has no room for "
+                        f"{len(pages)} handoff pages")
+                try:
+                    dst.engine.install_pages(
+                        new_pages, src.engine.export_pages(pages))
+                    dst.engine.scheduler.adopt_prefilled(
+                        req, new_pages, int(item["ctx"]))
+                except BaseException:
+                    dst.engine.allocator.free(new_pages)
+                    raise
+                src.engine.allocator.free(pages)
+                item["pages"] = None         # consumed
+            dst.notify()
+            self.handoffs += 1
+            ms = (time.perf_counter() - item["ts"]) * 1e3
+            if len(self.handoff_ms) < 100000:
+                self.handoff_ms.append(ms)
+            if _tele.enabled():
+                _tele.histogram(
+                    "serve_handoff_ms",
+                    "Prefill->decode KV handoff latency").observe(ms)
+                _tele.counter(
+                    "serve_handoffs_total",
+                    "Prefill->decode KV handoffs completed",
+                    labelnames=("src", "dst")).inc(src=src.name,
+                                                   dst=dst.name)
+                _tele.event("handoff", request_id=req.id, src=src.name,
+                            dst=dst.name, ms=round(ms, 3),
+                            pages=item.get("n_pages") or 0)
+        except Exception as exc:
+            self._handoff_failed(item, exc)
+
+    def _handoff_failed(self, item: dict, exc: Exception) -> None:
+        """Free every copy of the pages (best-effort, both sides), then
+        re-queue the request at the PREFILL tier with its generated
+        tokens intact — re-dispatch re-prefills ``prompt + generated``
+        (the ONE recovery rule), so a failed handoff costs latency,
+        never a stream."""
+        src, req, rid = item["src"], item.get("req"), item.get("rid")
+        self.handoff_failures += 1
+        _log.warning(
+            "fleet: kv handoff of request %s from %s failed (%s: %s) — "
+            "re-queueing at the prefill tier",
+            getattr(req, "id", rid), src.name, type(exc).__name__, exc)
+        if src.transport == "process":
+            for rep in (src, item.get("_dst")):
+                if rep is None or rep.transport != "process":
+                    continue
+                try:
+                    rep.call("kv_free", rid=rid, _timeout_ms=2000)
+                except Exception:
+                    pass             # replica gone: pages died with it
+        elif item.get("pages"):
+            try:
+                src.engine.allocator.free(item["pages"])
+            except Exception:
+                pass
+        if _tele.enabled():
+            _tele.counter("serve_handoff_failures_total",
+                          "KV handoffs aborted and re-queued",
+                          labelnames=("src",)).inc(src=src.name)
+            if req is not None:
+                _tele.event("handoff_requeued", request_id=req.id,
+                            src=src.name,
+                            error=f"{type(exc).__name__}: {exc}")
+        if req is None or req.done():
+            return
+        req._epoch += 1              # discard any straggler wire events
+        req.state = "queued"
+        self.router.redispatch([req], source=src.name, reason="handoff")
+
+    # ------------------------------------------------------------------
     # driver + supervisor threads
     # ------------------------------------------------------------------
     def _drive(self, rep: Replica) -> None:
@@ -1031,6 +1366,12 @@ class ServeFleet:
                 self._replica_died(rep, exc)
                 return
             pulled = self.router.feed(rep)
+            if getattr(sched, "handoff", None):
+                # thread-transport prefill tier: detached prefills move
+                # to the fleet's handoff pump (content copy into a
+                # decode replica's pool)
+                for item in sched.take_handoffs():
+                    self._enqueue_handoff(rep, item)
             if rep.state == "draining" and not sched.active_count \
                     and not sched.queue_depth:
                 self._finish_drain(rep)
@@ -1080,6 +1421,21 @@ class ServeFleet:
                         labelnames=("state",))
         for state, n in counts.items():
             g.set(n, state=state)
+        # per-role backlog (disaggregation observability): how deep each
+        # tier's queues run — prefill-bound vs decode-bound at a glance
+        depth = {"prefill": 0, "decode": 0, "both": 0}
+        for rep in self.replicas:
+            if rep.state not in ("starting", "running", "draining"):
+                continue
+            s = rep.engine.scheduler
+            role = getattr(rep.engine, "role", "both")
+            depth[role] = depth.get(role, 0) \
+                + s.queue_depth + s.active_count
+        rg = _tele.gauge("serve_role_queue_depth",
+                         "Queued + active requests by replica role",
+                         labelnames=("role",))
+        for role, n in depth.items():
+            rg.set(n, role=role)
 
     def stats(self) -> dict:
         return {
@@ -1087,6 +1443,8 @@ class ServeFleet:
                 rep.name: {
                     "state": rep.state,
                     "transport": rep.transport,
+                    "role": getattr(rep.engine, "role", "both"),
+                    "tp": getattr(rep.engine, "tp", 1),
                     "pid": rep.pid,
                     "generation": rep.generation,
                     "active": rep.engine.scheduler.active_count,
@@ -1096,6 +1454,10 @@ class ServeFleet:
                     "error": rep.error,
                 } for rep in self.replicas},
             "router": self.router.stats(),
+            "disagg": self.disagg,
+            "handoffs": self.handoffs,
+            "handoff_failures": self.handoff_failures,
+            "handoff_pending": len(self._handoff_q),
             "deaths": self.deaths,
             "respawns": self.respawns,
             "respawn_budget": self.respawn_budget,

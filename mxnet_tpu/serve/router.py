@@ -132,6 +132,15 @@ class RequestRouter:
         return [r for r in self._replicas()
                 if r.state in ("starting", "running")]
 
+    @staticmethod
+    def _accepts_new(rep) -> bool:
+        """Role-aware dispatch (docs/serving.md "Disaggregated
+        serving"): every router dispatch needs a prefill — fresh
+        prompts AND re-queued work (whose pages are gone) — so
+        decode-role replicas never receive it.  They get work through
+        the fleet's KV handoff pump exclusively."""
+        return getattr(rep.engine, "role", "both") in ("prefill", "both")
+
     def _pick(self, running: List, headroom: bool = True, prompt=None):
         """Best running replica; with ``headroom`` only replicas whose
         local queue is below their slot count qualify (beyond that, the
@@ -147,6 +156,7 @@ class RequestRouter:
         policy or the headroom bound (an overloaded cache-holder still
         loses to an idle peer: the bonus is at most 1.0, the same
         magnitude as the free-page term)."""
+        running = [r for r in running if self._accepts_new(r)]
         if headroom:
             running = [r for r in running
                        if r.engine.scheduler.queue_depth
@@ -172,6 +182,10 @@ class RequestRouter:
         running = self._running()
         if not running:
             self._shed("no_replicas", "no running replica in the fleet")
+        if not any(self._accepts_new(r) for r in running):
+            self._shed("no_replicas",
+                       "no prefill-capable replica in the fleet "
+                       "(every running replica has role 'decode')")
         # validate against the (shared) replica config before creating
         # anything — a never-fits request fails fast like engine.submit
         template = running[0].engine.scheduler
@@ -392,7 +406,7 @@ class RequestRouter:
         requests past their deadline are expired here (and in
         `sweep_expired`) — exactly once, pages-free by construction
         (parked requests never hold pages)."""
-        if rep.state != "running":
+        if rep.state != "running" or not self._accepts_new(rep):
             return False
         moved = False
         sched = rep.engine.scheduler

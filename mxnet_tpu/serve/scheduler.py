@@ -336,6 +336,18 @@ class ContinuousBatchingScheduler:
         self._lock = threading.Lock()
         self._admit_seq = itertools.count()
         self._steps = 0
+        # disaggregated serving (docs/serving.md "Disaggregated
+        # serving"): on a role='prefill' engine, a slot that has
+        # finished its prompt prefill and streamed its first token(s)
+        # vacates WITHOUT freeing pages — the request + its page list
+        # park here until the fleet hands them to a decode engine.
+        # On a decode engine, `adopt_prefilled` parks (req, pages, ctx)
+        # triples whose pages are already owned by THIS allocator;
+        # `_admit` seats them ahead of the plain queue.
+        self.handoff: deque = deque()
+        self._adopt_q: deque = deque()
+        self.handoffs_out = 0    # slots detached for handoff
+        self.handoffs_in = 0     # prefilled requests adopted
         # decode-fast-path accounting (docs/serving.md "Speculative
         # decoding & prefix caching")
         self.spec_proposed = 0       # draft tokens fed for verification
@@ -509,6 +521,32 @@ class ContinuousBatchingScheduler:
         logits)."""
         while True:
             with self._lock:
+                # adopted prefilled requests seat FIRST: their pages are
+                # already allocated here (handed off from a prefill
+                # engine), so they only wait on a slot — the decode tier
+                # never re-runs a prefill it was handed
+                if self._adopt_q:
+                    idx = self._free_slot_idx()
+                    if idx is None:
+                        return
+                    req, pages, ctx_len = self._adopt_q.popleft()
+                    slot = _Slot(req, idx, self.max_pages_per_seq,
+                                 next(self._admit_seq))
+                    slot.pages = list(pages)
+                    slot.table[:len(slot.pages)] = slot.pages
+                    slot.ctx = int(ctx_len)
+                    # the handed-off pages carry the prompt KV; this
+                    # engine never prefilled them, so it must not
+                    # register them in ITS prefix index
+                    slot.prefix_inserted = True
+                    self._slots[idx] = slot
+                    req.state = "running"
+                    self.handoffs_in += 1
+                    self._trace_admit(req, idx, len(slot.pages))
+                    self._telemetry_request(req, "adopted", slot=idx,
+                                            pages=len(slot.pages),
+                                            ctx=slot.ctx)
+                    continue
                 if not self._queue:
                     return
                 idx = self._free_slot_idx()
@@ -668,6 +706,23 @@ class ContinuousBatchingScheduler:
                 self._release_slot(slot)
                 self._expire_req(slot.req, "active")
                 expired_active = True
+        # handoff-parked and adopt-parked requests hold pages too — an
+        # abandoned client must not pin them through the handoff tier
+        with self._lock:
+            dead_h = [h for h in self.handoff if _expired(h["req"])]
+            for h in dead_h:
+                self.handoff.remove(h)
+            dead_a = [t for t in self._adopt_q if _expired(t[0])]
+            for t in dead_a:
+                self._adopt_q.remove(t)
+        for h in dead_h:
+            self.allocator.free(h["pages"])
+            self._expire_req(h["req"], "handoff")
+            expired_active = True
+        for req_a, pages_a, _ctx in dead_a:
+            self.allocator.free(pages_a)
+            self._expire_req(req_a, "handoff")
+            expired_active = True
         if dead or expired_active:
             self._update_gauges()
 
@@ -909,12 +964,40 @@ class ContinuousBatchingScheduler:
                         "serve_spec_accepted_total",
                         "Draft tokens accepted (matched the greedy "
                         "continuation)").inc(accepted_step)
+            if self.engine.role == "prefill":
+                self._detach_prefilled(actives)
             if _trace.enabled():
                 self._trace_step(plan, parents, t0, t1, C,
                                  drafted_step, accepted_step,
                                  emitted_total)
             self._update_gauges()
         return True
+
+    def _detach_prefilled(self, actives) -> None:
+        """role='prefill' (disaggregation — docs/serving.md): every slot
+        whose prompt KV is complete and whose first token(s) streamed
+        vacates WITHOUT freeing its pages — request, page list, and
+        write cursor park on ``self.handoff`` for the fleet to move to
+        a decode engine.  The cursor sits at ``len(sequence) - 1``, so
+        the adopting engine's next feed is exactly the last emitted
+        token: greedy streams continue bit-identical (the PR 6/14
+        invariant)."""
+        for s in actives:
+            if self._slots[s.slot_idx] is not s:
+                continue                  # finished/evicted this step
+            req = s.req
+            if req.done() or s.ctx < len(req.prompt) or not req.tokens:
+                continue                  # still prefilling (or done)
+            self._slots[s.slot_idx] = None        # pages NOT freed
+            req.state = "handoff"
+            self.handoffs_out += 1
+            with self._lock:
+                self.handoff.append(
+                    {"req": req, "pages": list(s.pages),
+                     "ctx": int(s.ctx), "ts": time.perf_counter()})
+            self._telemetry_request(req, "handoff_ready",
+                                    pages=len(s.pages), ctx=int(s.ctx),
+                                    generated=len(req.tokens))
 
     def _trace_step(self, plan, parents, t0: float, t1: float, C: int,
                     drafted: int, accepted: int, emitted: int) -> None:
@@ -1005,6 +1088,57 @@ class ContinuousBatchingScheduler:
     # ------------------------------------------------------------------
     # fleet hooks (mx.serve.ServeFleet — docs/serving.md)
     # ------------------------------------------------------------------
+    def take_handoffs(self) -> List[dict]:
+        """Pop every parked prefill-complete handoff item
+        (``{"req", "pages", "ctx", "ts"}``).  The caller OWNS the pages
+        afterwards: it must either move them to a decode engine (by
+        reference when it shares this allocator, by content copy +
+        `requeue` otherwise) or free them — they are no longer reachable
+        from any slot."""
+        with self._lock:
+            out = list(self.handoff)
+            self.handoff.clear()
+        return out
+
+    def adopt_prefilled(self, req: ServeRequest, pages: List[int],
+                        ctx_len: int) -> None:
+        """Seat a prefilled request on THIS engine (decode tier of a
+        disaggregated fleet).  `pages` must already be owned by this
+        scheduler's allocator — adopted by reference (same process,
+        shared pool: the PR 14 refcount machinery) or freshly allocated
+        + `engine.install_pages`-filled (cross-process).  The request
+        is parked on the adopt queue and `_admit` seats it ahead of
+        plain queued work; on failure the caller still owns the pages."""
+        with self._lock:
+            if self.draining or self._abandoned:
+                raise MXNetError(
+                    f"replica {self.name or '<unnamed>'} is "
+                    f"{'draining' if self.draining else 'retired'} and "
+                    f"not adopting handoffs")
+            req.state = "queued"
+            self._adopt_q.append((req, list(pages), int(ctx_len)))
+
+    def requeue_handoff(self, item: dict, reason: str = "kv_handoff"
+                        ) -> ServeRequest:
+        """Abort ONE handoff item back to the queued tier: free its
+        pages here and return the request with its generated tokens
+        intact — `enqueue`/router re-dispatch then re-prefills
+        ``prompt + generated`` (the ONE recovery rule), so a failed
+        handoff re-queues at the prefill tier and the request is never
+        dropped."""
+        self.allocator.free(item["pages"])
+        req = item["req"]
+        req.state = "queued"
+        self._trace_requeue(req, reason)
+        self._telemetry_request(req, "handoff_requeued", reason=reason,
+                                generated=len(req.tokens))
+        return req
+
+    @property
+    def handoff_depth(self) -> int:
+        with self._lock:
+            return len(self.handoff)
+
     def detach_queued(self) -> List[ServeRequest]:
         """Remove and return every QUEUED request (none hold pages) —
         the drain path hands them back to the router for re-dispatch
@@ -1046,7 +1180,14 @@ class ContinuousBatchingScheduler:
             with self._lock:
                 queued = list(self._queue)
                 self._queue.clear()
-            reqs = [s.req for s in actives] + queued
+                # handoff/adopt-parked requests ride along (their pages
+                # die with the replica like every active's do); they
+                # carry generated tokens, so they sort with the actives
+                parked = [h["req"] for h in self.handoff] \
+                    + [t[0] for t in self._adopt_q]
+                self.handoff.clear()
+                self._adopt_q.clear()
+            reqs = [s.req for s in actives] + parked + queued
             for r in reqs:
                 # transfer stream ownership: any emit this replica still
                 # has in flight for an old-epoch slot is discarded
@@ -1142,6 +1283,13 @@ class ContinuousBatchingScheduler:
                     labelnames=("replica",)).set(
                         self.spec_accepted / self.spec_proposed,
                         replica=self.name)
+            if self.engine.role != "both":
+                _tele.gauge(
+                    "serve_replica_handoff_pending",
+                    "Per-replica prefilled requests parked awaiting "
+                    "handoff to the decode tier",
+                    labelnames=("replica",)).set(
+                        len(self.handoff), replica=self.name)
             return
         _tele.gauge("serve_queue_depth",
                     "Requests waiting for a slot/pages").set(

@@ -48,11 +48,19 @@ from .. import tracing as _trace
 
 __all__ = ["WireError", "WireTimeout", "WireRemoteError", "WireClient",
            "Listener", "connect", "send_frame", "recv_frame",
+           "recv_blob", "recv_message", "pack_arrays", "unpack_arrays",
            "rpc_timeout_ms"]
 
 _HDR = struct.Struct(">I")
 #: hard frame-size cap — a corrupt length prefix must not allocate GBs
 MAX_FRAME = 64 << 20
+#: high bit of the length prefix marks a RAW BINARY frame (bulk
+#: transfer: KV page contents ride as bytes, never JSON-encoded
+#: floats); the JSON frame announcing them carries ``"_nblobs": N``
+#: and the N blob frames follow back-to-back on the same socket
+_BLOB_FLAG = 0x80000000
+#: per-blob chunk size for `pack_arrays` (safely under MAX_FRAME)
+BLOB_CHUNK = 48 << 20
 
 
 class WireError(MXNetError):
@@ -81,17 +89,32 @@ def rpc_timeout_ms() -> float:
 # framing
 # ---------------------------------------------------------------------------
 
-def send_frame(sock: socket.socket, obj: dict) -> int:
-    """Serialize `obj` and write one frame; returns bytes on the wire."""
+def send_frame(sock: socket.socket, obj: dict, blobs=()) -> int:
+    """Serialize `obj` and write one frame; returns bytes on the wire.
+
+    ``blobs``: optional raw byte strings appended as binary frames
+    (length prefix with the high bit set) — the bulk-transfer verb the
+    KV handoff uses.  The JSON frame is annotated with ``_nblobs`` so
+    the receiver knows how many binary frames follow."""
+    if blobs:
+        obj = {**obj, "_nblobs": len(blobs)}
     data = json.dumps(obj, separators=(",", ":")).encode("utf-8")
     if len(data) > MAX_FRAME:
         raise WireError(f"frame of {len(data)} bytes exceeds the "
                         f"{MAX_FRAME}-byte cap")
+    sent = len(data) + _HDR.size
     try:
         sock.sendall(_HDR.pack(len(data)) + data)
+        for b in blobs:
+            if len(b) > MAX_FRAME:
+                raise WireError(
+                    f"blob of {len(b)} bytes exceeds the "
+                    f"{MAX_FRAME}-byte cap — chunk it (pack_arrays)")
+            sock.sendall(_HDR.pack(len(b) | _BLOB_FLAG) + bytes(b))
+            sent += len(b) + _HDR.size
     except OSError as e:
         raise WireError(f"wire send failed: {e}") from e
-    return len(data) + _HDR.size
+    return sent
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
@@ -125,6 +148,9 @@ def recv_frame(sock: socket.socket,
     if hdr is None:
         return None
     (n,) = _HDR.unpack(hdr)
+    if n & _BLOB_FLAG:
+        raise WireError("binary blob frame where a JSON frame was "
+                        "expected (desynced stream?)")
     if n > MAX_FRAME:
         raise WireError(f"frame length {n} exceeds the {MAX_FRAME}-byte "
                         f"cap (corrupt stream?)")
@@ -135,6 +161,76 @@ def recv_frame(sock: socket.socket,
         return json.loads(body.decode("utf-8"))
     except (UnicodeDecodeError, ValueError) as e:
         raise WireError(f"frame is not valid JSON: {e}") from e
+
+
+def recv_blob(sock: socket.socket,
+              timeout: Optional[float] = None) -> bytes:
+    """Read one BINARY frame (length prefix with the blob flag set) —
+    follows a JSON frame that announced ``_nblobs``."""
+    try:
+        sock.settimeout(timeout)
+    except OSError as e:
+        raise WireError(f"wire recv failed: {e}") from e
+    hdr = _recv_exact(sock, _HDR.size)
+    if hdr is None:
+        raise WireError("connection closed where a blob frame was due")
+    (n,) = _HDR.unpack(hdr)
+    if not n & _BLOB_FLAG:
+        raise WireError("JSON frame where a binary blob was expected "
+                        "(desynced stream?)")
+    n &= ~_BLOB_FLAG
+    if n > MAX_FRAME:
+        raise WireError(f"blob length {n} exceeds the {MAX_FRAME}-byte "
+                        f"cap (corrupt stream?)")
+    body = _recv_exact(sock, n)
+    if body is None:
+        raise WireError("connection closed mid-blob")
+    return body
+
+
+def recv_message(sock: socket.socket,
+                 timeout: Optional[float] = None) -> Optional[dict]:
+    """`recv_frame` plus any announced blob frames: a frame carrying
+    ``_nblobs`` has its binary payloads read off the socket and
+    attached as ``obj["_blobs"]`` (list of bytes)."""
+    obj = recv_frame(sock, timeout)
+    if obj is None:
+        return None
+    n = int(obj.get("_nblobs", 0) or 0)
+    if n:
+        obj["_blobs"] = [recv_blob(sock, timeout) for _ in range(n)]
+    return obj
+
+
+def pack_arrays(arrays: dict):
+    """Serialize ``{name: ndarray}`` for the wire: a JSON-safe manifest
+    (name/shape/dtype/chunk count, insertion-ordered) + raw byte blobs,
+    each at most `BLOB_CHUNK` so no single frame breaks the MAX_FRAME
+    cap.  The KV-handoff bulk path — page contents ride as binary
+    frames, never JSON-encoded floats."""
+    import numpy as onp
+    meta, blobs = [], []
+    for name, a in arrays.items():
+        a = onp.ascontiguousarray(a)
+        raw = a.tobytes()
+        nchunks = max(1, -(-len(raw) // BLOB_CHUNK))
+        meta.append({"name": name, "shape": list(a.shape),
+                     "dtype": str(a.dtype), "nchunks": nchunks})
+        for i in range(nchunks):
+            blobs.append(raw[i * BLOB_CHUNK:(i + 1) * BLOB_CHUNK])
+    return meta, blobs
+
+
+def unpack_arrays(meta, blobs) -> dict:
+    """Inverse of :func:`pack_arrays`."""
+    import numpy as onp
+    out, k = {}, 0
+    for m in meta:
+        raw = b"".join(blobs[k:k + int(m["nchunks"])])
+        k += int(m["nchunks"])
+        out[m["name"]] = onp.frombuffer(
+            raw, dtype=onp.dtype(m["dtype"])).reshape(m["shape"])
+    return out
 
 
 def _fault(point: str) -> None:
@@ -180,7 +276,7 @@ class WireClient:
 
     def call(self, verb: str, _timeout_ms: Optional[float] = None,
              _span_parent=None, _track: Optional[str] = None,
-             **payload) -> dict:
+             _blobs=(), **payload) -> dict:
         timeout_s = float(_timeout_ms or self.timeout_ms
                           or rpc_timeout_ms()) / 1e3
         call_id = next(self._ids)
@@ -192,7 +288,8 @@ class WireClient:
             stats["attempts"] += 1
             with self._lock:
                 _fault("rpc_send")
-                stats["bytes"] += send_frame(self._sock, frame)
+                stats["bytes"] += send_frame(self._sock, frame,
+                                             blobs=_blobs)
                 deadline = time.monotonic() + timeout_s
                 while True:
                     left = deadline - time.monotonic()
@@ -201,7 +298,10 @@ class WireClient:
                             f"rpc {verb!r} timed out after "
                             f"{timeout_s * 1e3:.0f} ms "
                             f"(MXTPU_RPC_TIMEOUT_MS)")
-                    resp = recv_frame(self._sock, timeout=left)
+                    # recv_message: a stale blob-carrying response must
+                    # have its binary frames drained too, or the stream
+                    # desyncs
+                    resp = recv_message(self._sock, timeout=left)
                     if resp is None:
                         raise WireError(
                             f"connection closed during rpc {verb!r}")

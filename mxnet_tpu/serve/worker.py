@@ -118,10 +118,15 @@ class Worker:
     HB_INTERVAL = 0.2
 
     def __init__(self, name: str, host: str, port: int, spec_dir: str,
-                 seed: int = 0):
+                 seed: int = 0, role: Optional[str] = None,
+                 tp: Optional[int] = None):
         self.name = name
         self.spec_dir = spec_dir
         self.seed = seed
+        #: per-worker overrides of the fleet-wide spec (disaggregation:
+        #: one spec dir serves every role; --role/--tp specialize it)
+        self.role_override = role or None
+        self.tp_override = tp if tp and tp > 0 else None
         self.engine: Optional[InferenceEngine] = None
         self._control = wire.connect(host, port, "control", name)
         self._events = wire.connect(host, port, "events", name)
@@ -130,7 +135,15 @@ class Worker:
         self._shutdown = threading.Event()
         self._lost_parent = threading.Event()
         self._live = {}           # router rid -> local ServeRequest
-        self._seen = set()        # every rid ever submitted (dedupe)
+        # rid -> highest dispatch attempt accepted.  A RETRIED frame
+        # (same attempt) is a duplicate; a HIGHER attempt is a
+        # legitimate re-submission (handoff failure / failover folds the
+        # stream back to the prefill tier, which may be this same
+        # worker again)
+        self._seen = {}
+        self._handoff = {}        # rid -> detached handoff item (pages
+        #                           stay allocated until kv_free)
+        self._pending = {}        # rid -> imported pages awaiting adopt
         self._lock = threading.Lock()
         self._last_hb = 0.0
 
@@ -178,11 +191,44 @@ class Worker:
                     req.error and req.error.startswith("deadline exceeded"))
             self._send(ev)
 
+    def _scan_handoffs(self, sched) -> None:
+        """Announce freshly prefilled requests to the parent (role
+        ``prefill`` only — other roles never detach).  Pages stay
+        allocated in our pool, registered under the rid, until the
+        parent's `kv_free` confirms the decode side owns a copy."""
+        if not sched.handoff:
+            return
+        for item in sched.take_handoffs():
+            rid = getattr(item["req"], "rid", None)
+            if rid is None:
+                # not a fleet-submitted request: nothing upstream can
+                # adopt it — put it back on the local queue, pages freed
+                sched.enqueue(sched.requeue_handoff(item,
+                                                    reason="no_router"),
+                              front=True)
+                self._wake.set()
+                continue
+            with self._lock:
+                # a re-prefill of the same rid (failed handoff folded
+                # back here) may land before the parent's kv_free for
+                # the previous attempt: release the stale pages first
+                stale = self._handoff.pop(rid, None)
+                self._handoff[rid] = item
+                self._live.pop(rid, None)   # the stream leaves this worker
+            if stale is not None:
+                self.engine.allocator.free(stale["pages"])
+            self._send({"ev": "prefilled", "rid": rid,
+                        "ctx": int(item["ctx"]),
+                        "n_pages": len(item["pages"]),
+                        "tokens": [int(t) for t in item["req"].tokens]})
+
     # -- control channel (dedicated thread) ----------------------------
     def _control_loop(self) -> None:
         while not self._shutdown.is_set():
             try:
-                frame = wire.recv_frame(self._control)
+                # recv_message: kv_import requests carry binary page
+                # frames after their JSON header
+                frame = wire.recv_message(self._control)
             except wire.WireError:
                 frame = None
             if frame is None:                 # parent closed the channel
@@ -197,10 +243,11 @@ class Worker:
             except Exception as e:
                 resp = {"id": call_id, "ok": False,
                         "error": f"{type(e).__name__}: {e}"}
+            blobs = resp.pop("_blobs", ())
             try:
                 # control responses are written only by this thread; the
                 # events channel has its own lock
-                wire.send_frame(self._control, resp)
+                wire.send_frame(self._control, resp, blobs=blobs)
             except wire.WireError:
                 self._lost_parent.set()
                 self._shutdown.set()
@@ -225,8 +272,9 @@ class Worker:
         sched = self.engine.scheduler
         if verb == "submit":
             rid = int(frame["rid"])
+            att = int(frame.get("attempt", 0))
             with self._lock:
-                if rid in self._seen:
+                if rid in self._live or att <= self._seen.get(rid, -1):
                     return {"dup": True}   # retried frame: idempotent
             req = ServeRequest(
                 frame["prompt"], frame["max_new"],
@@ -239,7 +287,7 @@ class Worker:
             sched.enqueue(req, front=bool(frame.get("front")))
             with self._lock:
                 self._live[rid] = req
-                self._seen.add(rid)
+                self._seen[rid] = att
             self._wake.set()
             return {}
         if verb == "cancel":
@@ -257,6 +305,86 @@ class Worker:
                                       state="failed", phase="cancelled",
                                       replica=self.name)
             return {"cancelled": cancelled}
+        if verb == "kv_export":
+            # handoff step 1: ship the detached request's KV pages to
+            # the parent as binary frames (pages stay allocated here
+            # until kv_free acknowledges the transfer landed)
+            rid = int(frame["rid"])
+            with self._lock:
+                item = self._handoff.get(rid)
+            if item is None:
+                raise MXNetError(
+                    f"no detached handoff state for rid {rid}")
+            meta, blobs = wire.pack_arrays(
+                self.engine.export_pages(item["pages"]))
+            return {"meta": meta, "ctx": int(item["ctx"]),
+                    "n_pages": len(item["pages"]), "_blobs": blobs}
+        if verb == "kv_import":
+            # handoff step 2 (decode side): land the shipped pages in
+            # our pool, parked until submit_prefilled adopts them
+            rid = int(frame["rid"])
+            arrays = wire.unpack_arrays(frame["meta"],
+                                        frame.get("_blobs") or [])
+            n = int(frame["n_pages"])
+            pages = self.engine.allocator.alloc(n)
+            if pages is None:
+                raise MXNetError(
+                    f"kv_import: no room for {n} pages "
+                    f"({self.engine.allocator.free_pages} free)")
+            self.engine.install_pages(pages, arrays)
+            with self._lock:
+                prev = self._pending.pop(rid, None)
+                self._pending[rid] = pages
+            if prev is not None:       # retried import: drop the stale copy
+                self.engine.allocator.free(prev)
+            return {"pages": len(pages)}
+        if verb == "submit_prefilled":
+            # handoff step 3: adopt the imported pages as a running
+            # decode slot (cursor invariant: next feed is the last
+            # emitted token at start_pos=ctx — bit-identical resume)
+            rid = int(frame["rid"])
+            att = int(frame.get("attempt", 0))
+            with self._lock:
+                dup = rid in self._live \
+                    or att <= self._seen.get(rid, -1)
+                pages = None if dup else self._pending.pop(rid, None)
+            if dup:
+                return {"dup": True}
+            if pages is None:
+                raise MXNetError(
+                    f"submit_prefilled: no imported pages for rid {rid}")
+            req = ServeRequest(
+                frame["prompt"], frame["max_new"],
+                greedy=bool(frame.get("greedy", True)),
+                temperature=float(frame.get("temperature", 1.0)),
+                eos_token_id=frame.get("eos"),
+                on_token=self._on_token(rid),
+                deadline_ms=float(frame.get("deadline_ms") or 0.0))
+            req.rid = rid
+            req.tokens = [int(t) for t in frame.get("tokens") or []]
+            try:
+                sched.adopt_prefilled(req, pages, int(frame["ctx"]))
+            except MXNetError:
+                self.engine.allocator.free(pages)
+                raise
+            with self._lock:
+                self._live[rid] = req
+                self._seen[rid] = att
+            self._wake.set()
+            return {}
+        if verb == "kv_free":
+            # handoff step 4 (prefill side) / abort cleanup (either
+            # side): release every page still parked under this rid
+            rid = int(frame["rid"])
+            freed = 0
+            with self._lock:
+                item = self._handoff.pop(rid, None)
+                pending = self._pending.pop(rid, None)
+            for pages in (item["pages"] if item else None, pending):
+                if pages:
+                    self.engine.allocator.free(pages)
+                    freed += len(pages)
+            return {"freed": freed}
         if verb == "drain":
             sched.draining = True
             detached = sched.detach_queued()
@@ -276,6 +404,10 @@ class Worker:
         threading.Thread(target=self._control_loop, daemon=True,
                          name="worker-control").start()
         model, sc = load_spec(self.spec_dir)
+        if self.role_override or self.tp_override:
+            sc = dataclasses.replace(
+                sc, role=self.role_override or sc.role,
+                tp=self.tp_override or sc.tp)
         eng = InferenceEngine(model, sc, seed=self.seed)
         eng.scheduler.name = self.name
         secs = eng.warmup()
@@ -294,6 +426,7 @@ class Worker:
                             "error": f"{type(e).__name__}: {e}"})
                 raise
             self._scan_done()
+            self._scan_handoffs(sched)
             self._heartbeat()
             if sched.draining and not sched.active_count \
                     and not sched.queue_depth:
@@ -319,9 +452,15 @@ def main(argv=None) -> int:
     ap.add_argument("--port", type=int, required=True)
     ap.add_argument("--spec", required=True, help="spec dir (write_spec)")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--role", default="",
+                    help="override ServeConfig.role from the spec "
+                         "(prefill | decode | both)")
+    ap.add_argument("--tp", type=int, default=0,
+                    help="override ServeConfig.tp from the spec")
     args = ap.parse_args(argv)
     worker = Worker(args.name, args.host, args.port, args.spec,
-                    seed=args.seed)
+                    seed=args.seed, role=args.role or None,
+                    tp=args.tp or None)
     rc = worker.run()
     # a worker that lost its parent exits quietly — the stack is noise
     return 0 if worker._lost_parent.is_set() else rc

@@ -1,0 +1,229 @@
+"""Disaggregated-serving tests: tensor-parallel fused-step bit-identity
+and serve-mesh degrade, binary KV wire frames, the kv_handoff chaos
+point (fail mid-handoff -> re-queue at the prefill tier, never drop),
+and role-aware routing (docs/serving.md "Disaggregated serving")."""
+import socket
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+pytestmark = pytest.mark.serve
+
+
+def _tiny_model(**kw):
+    from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    cfg = dict(vocab_size=96, hidden_size=32, num_layers=2, num_heads=4,
+               intermediate_size=64, max_position=64, dropout=0.0)
+    cfg.update(kw)
+    m = GPTForCausalLM(GPTConfig(**cfg))
+    m.initialize()
+    m(mx.np.array([[1, 2]], dtype="int32"))
+    return m
+
+
+def _ref_generate(m, prompt, n):
+    ids = mx.np.array([prompt], dtype="int32")
+    return onp.asarray(m.generate(ids, max_new_tokens=n)
+                       .asnumpy())[0].tolist()
+
+
+# ---------------------------------------------------------------------------
+# tensor-parallel fused step
+# ---------------------------------------------------------------------------
+
+def test_tp_sharded_engine_bit_identical_to_single_device():
+    """The all-gather tp scheme never changes float accumulation order:
+    a tp=2 engine's greedy stream must be BIT-identical to tp=1."""
+    from mxnet_tpu.serve import InferenceEngine, ServeConfig
+    m = _tiny_model()
+    prompts = [[1, 2, 3, 4, 5], [7, 8, 9], [10, 20, 30, 40]]
+    e1 = InferenceEngine(m, ServeConfig(max_slots=2, page_size=4,
+                                        max_len=32, tp=1), seed=0)
+    e2 = InferenceEngine(m, ServeConfig(max_slots=2, page_size=4,
+                                        max_len=32, tp=2), seed=0)
+    assert e2.tp == 2, "8 virtual devices (conftest) must support tp=2"
+    for p in prompts:
+        assert e2.generate(p, 10, greedy=True) == \
+            e1.generate(p, 10, greedy=True)
+
+
+def test_tp_degrades_to_topology_with_loud_log(caplog):
+    """fit_axes degrade contract on the serve mesh: an unsatisfiable tp
+    re-forms at what the device count / model shapes support, with a
+    loud warning — never a crash, never a silent ignore."""
+    from mxnet_tpu.serve import InferenceEngine, ServeConfig
+    m = _tiny_model()
+    with caplog.at_level("WARNING"):
+        e = InferenceEngine(m, ServeConfig(max_slots=2, page_size=4,
+                                           max_len=32, tp=16))
+    # 8 visible devices, 4 kv heads: 16 -> gcd chain lands on 4
+    assert e.tp == 4
+    assert "degraded" in caplog.text
+    caplog.clear()
+    with caplog.at_level("WARNING"):
+        e5 = InferenceEngine(m, ServeConfig(max_slots=2, page_size=4,
+                                            max_len=32, tp=5))
+    assert e5.tp == 1            # 5 shares no factor with 4 heads
+    assert "degraded" in caplog.text
+
+
+def test_adopt_executables_refuses_tp_mismatch():
+    """tp topology is part of the executable identity: a tp=1 engine
+    must never install a tp=2 engine's compiled steps (the mesh is
+    baked into the program)."""
+    from mxnet_tpu.serve import InferenceEngine, ServeConfig
+    m = _tiny_model()
+    e1 = InferenceEngine(m, ServeConfig(max_slots=2, page_size=4,
+                                        max_len=32, tp=1))
+    e2 = InferenceEngine(m, ServeConfig(max_slots=2, page_size=4,
+                                        max_len=32, tp=2))
+    e2.warmup()
+    with pytest.raises(MXNetError, match="config mismatch"):
+        e1.adopt_executables(e2)
+
+
+# ---------------------------------------------------------------------------
+# binary KV wire frames
+# ---------------------------------------------------------------------------
+
+def test_wire_blob_roundtrip():
+    """pack_arrays -> binary frames -> unpack_arrays over a real socket
+    pair: page contents travel as raw bytes (dtype/shape in JSON meta),
+    bit-exact, never as JSON floats."""
+    from mxnet_tpu.serve import wire
+    rng = onp.random.RandomState(0)
+    arrays = {
+        "k": rng.randn(2, 3, 4).astype(onp.float32),
+        "v": rng.randn(2, 3, 4).astype(onp.float32),
+        "scale": rng.randn(3).astype(onp.float16),
+        "q": rng.randint(-128, 127, (2, 3, 4)).astype(onp.int8),
+    }
+    meta, blobs = wire.pack_arrays(arrays)
+    a, b = socket.socketpair()
+    try:
+        wire.send_frame(a, {"verb": "kv_import", "meta": meta},
+                        blobs=blobs)
+        got = wire.recv_message(b, timeout=5.0)
+        out = wire.unpack_arrays(got["meta"], got.get("_blobs"))
+    finally:
+        a.close()
+        b.close()
+    assert set(out) == set(arrays)
+    for name, arr in arrays.items():
+        assert out[name].dtype == arr.dtype
+        assert out[name].shape == arr.shape
+        assert onp.array_equal(out[name], arr)
+
+
+def test_recv_frame_rejects_blob_header():
+    """A plain recv_frame that meets a blob frame must fail loudly —
+    silently JSON-decoding binary page bytes would corrupt the
+    control stream."""
+    from mxnet_tpu.serve import wire
+    a, b = socket.socketpair()
+    try:
+        wire.send_frame(a, {"x": 1}, blobs=(b"\x00" * 16,))
+        wire.recv_frame(b, timeout=5.0)          # the JSON frame
+        with pytest.raises(MXNetError):
+            wire.recv_frame(b, timeout=5.0)      # the binary frame
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# disaggregated fleet: handoff, chaos, role routing
+# ---------------------------------------------------------------------------
+
+def test_disagg_fleet_streams_bit_identical():
+    """1 prefill + 1 decode (thread transport): every stream crosses a
+    KV handoff and must match the unbatched generate() oracle."""
+    from mxnet_tpu.serve import ServeConfig, ServeFleet
+    m = _tiny_model()
+    prompts = [[1, 2, 3, 4, 5], [7, 8, 9], [1, 2, 3, 11, 12, 13]]
+    refs = [_ref_generate(m, p, 8) for p in prompts]
+    fleet = ServeFleet(m, config=ServeConfig(max_slots=2, page_size=4,
+                                             num_pages=0,
+                                             prefill_chunk=4,
+                                             max_len=32),
+                       transport="thread", disagg=(1, 1),
+                       stall_timeout=5.0)
+    with fleet:
+        hs = [fleet.submit(p, max_new_tokens=8) for p in prompts]
+        outs = [h.result(timeout=60) for h in hs]
+        assert fleet.quiesce(30)
+    assert outs == refs
+    assert fleet.handoffs >= len(prompts)
+    assert fleet.handoff_failures == 0
+
+
+def test_kv_handoff_fault_requeues_at_prefill_tier(monkeypatch):
+    """The kv_handoff chaos point: a mid-handoff failure frees the
+    pages and re-queues the request at the PREFILL tier — the stream
+    still finishes bit-identical to the oracle, never dropped, never
+    re-emitting a token."""
+    from mxnet_tpu.serve import ServeConfig, ServeFleet
+    m = _tiny_model()
+    prompts = [[1, 2, 3, 4, 5], [7, 8, 9]]
+    refs = [_ref_generate(m, p, 8) for p in prompts]
+    streams = {i: [] for i in range(len(prompts))}
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "kv_handoff@1")
+    fleet = ServeFleet(m, config=ServeConfig(max_slots=2, page_size=4,
+                                             num_pages=0,
+                                             prefill_chunk=4,
+                                             max_len=32),
+                       transport="thread", disagg=(1, 1),
+                       stall_timeout=5.0)
+    with fleet:
+        hs = [fleet.submit(p, max_new_tokens=8,
+                           on_token=lambda t, r, i=i:
+                           streams[i].append(t))
+              for i, p in enumerate(prompts)]
+        outs = [h.result(timeout=60) for h in hs]
+        assert fleet.quiesce(30)
+    assert outs == refs
+    for i, p in enumerate(prompts):
+        assert streams[i] == refs[i][len(p):]
+    assert fleet.handoff_failures == 1
+    assert fleet.handoffs >= len(prompts)
+    # the aborted transfer leaked nothing: every page returned
+    for rep in fleet.replicas:
+        a = rep.engine.allocator
+        assert a.free_pages == a.total_pages, (rep.name, a.free_pages)
+
+
+def test_router_refuses_decode_only_fleet():
+    """Role-aware dispatch: every NEW request needs a prefill-capable
+    replica; a fleet of only decode replicas sheds instead of
+    wedging."""
+    from mxnet_tpu.serve import ServeConfig, ServeFleet, ShedError
+    m = _tiny_model()
+    fleet = ServeFleet(m, replicas=1,
+                       config=ServeConfig(max_slots=2, page_size=4,
+                                          max_len=32, role="decode"),
+                       transport="thread", stall_timeout=5.0)
+    with fleet:
+        with pytest.raises(ShedError, match="prefill"):
+            fleet.submit([1, 2, 3], max_new_tokens=4)
+
+
+def test_serve_config_disagg_env(monkeypatch):
+    """MXTPU_SERVE_DISAGG=PxD builds the split fleet; malformed specs
+    refuse loudly."""
+    from mxnet_tpu.serve import ServeConfig, ServeFleet
+    m = _tiny_model()
+    monkeypatch.setenv("MXTPU_SERVE_DISAGG", "1x2")
+    fleet = ServeFleet(m, config=ServeConfig(max_slots=2, page_size=4,
+                                             max_len=32),
+                       transport="thread", stall_timeout=5.0)
+    roles = {r.name: r.engine.role for r in fleet.replicas}
+    assert roles == {"p0": "prefill", "d1": "decode", "d2": "decode"}
+    fleet.close()
+    monkeypatch.setenv("MXTPU_SERVE_DISAGG", "bogus")
+    with pytest.raises(MXNetError, match="MXTPU_SERVE_DISAGG"):
+        ServeFleet(m, config=ServeConfig(max_slots=2, page_size=4,
+                                         max_len=32),
+                   transport="thread")

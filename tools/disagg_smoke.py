@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""Disaggregated-serving smoke (`make disagg-smoke`, wired into
+`make test`).
+
+CPU-only, <60 s end-to-end check of prefill/decode disaggregation
+(docs/serving.md "Disaggregated serving") over 8 virtual devices:
+
+- **1 prefill + 2 decode process replicas** spawned from ONE spec dir
+  (per-worker ``--role`` / ``--tp`` overrides), the decode tier
+  tensor-parallel over 2 virtual devices each;
+- a **shared-prefix prompt mix**: half the prompts share a long prefix,
+  so the prefill tier exercises chunked prefill while EVERY request
+  crosses a real cross-process KV handoff — page contents shipped as
+  length-prefixed binary wire frames (kv_export → kv_import →
+  submit_prefilled → kv_free), never JSON floats;
+- **one decode worker is SIGKILLed mid-stream** — its adopted streams
+  fail over from the parent's stream ledger, re-queue at the PREFILL
+  tier (re-prefill of prompt + generated, the ONE recovery rule), hand
+  off AGAIN, and finish **bit-identical** to the unbatched
+  ``generate()`` oracle, never re-emitting a token;
+- the killed worker **respawns** under ``MXTPU_REPLICA_RESPAWNS``;
+- zero dropped requests and handoff count > 0, asserted from both the
+  fleet's counters and the telemetry journal.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    # 8 virtual devices — inherited by every spawned worker, so the
+    # decode tier can shard tp=2 while tiers coexist on one host
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    t_start = time.time()
+    journal_path = os.path.join(
+        tempfile.mkdtemp(prefix="mxtpu_disagg_smoke_"), "journal.jsonl")
+
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry as tele
+    from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from mxnet_tpu.serve import ServeConfig, ServeFleet
+
+    tele.enable(journal_path=journal_path)
+
+    cfg = GPTConfig(vocab_size=96, hidden_size=32, num_layers=2,
+                    num_heads=4, intermediate_size=64, max_position=64,
+                    dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.initialize()
+    model(mx.np.array([[1, 2]], dtype="int32"))
+
+    rng = onp.random.RandomState(31)
+    max_new = 12
+    n_req = 8
+    shared = rng.randint(0, 96, 8).tolist()   # the shared prefix
+    prompts = []
+    for i in range(n_req):
+        if i % 2 == 0:
+            prompts.append(shared + rng.randint(0, 96,
+                                                2 + i % 3).tolist())
+        else:
+            prompts.append(rng.randint(0, 96,
+                                       rng.randint(2, 11)).tolist())
+
+    # unbatched references (the oracle): one generate() per request
+    refs = []
+    for p in prompts:
+        ids = mx.np.array([p], dtype="int32")
+        refs.append(onp.asarray(
+            model.generate(ids, max_new_tokens=max_new)
+            .asnumpy())[0].tolist())
+
+    sc = ServeConfig(max_slots=2, page_size=4, num_pages=0,
+                     prefill_chunk=4, max_len=32, tp=2)
+    fleet = ServeFleet(model, config=sc, transport="process",
+                       disagg=(1, 2), respawn_budget=2,
+                       stall_timeout=15.0)
+    roles = {r.name: (r.engine.role, r.engine.tp) for r in fleet.replicas}
+    assert roles == {"p0": ("prefill", 1), "d1": ("decode", 2),
+                     "d2": ("decode", 2)}, roles
+    fleet.warmup()
+    assert all(r.pid is not None and r.pid != os.getpid()
+               for r in fleet.replicas), "workers must be real processes"
+
+    streams = {i: [] for i in range(n_req)}
+
+    def tok_cb(i):
+        return lambda t, r: streams[i].append(t)
+
+    try:
+        fleet.start()
+        handles = {}
+        for i in range(n_req):
+            handles[i] = fleet.submit(prompts[i], max_new_tokens=max_new,
+                                      on_token=tok_cb(i))
+
+        # wait until a DECODE worker holds an adopted stream with real
+        # progress (> the prefill-emitted token), then SIGKILL it — the
+        # hardest failover shape: ledger salvage must re-queue at the
+        # prefill tier and the stream must resume without re-emitting
+        decoders = [r for r in fleet.replicas
+                    if r.engine.role == "decode"]
+        victim = None
+        deadline = time.time() + 40
+        while victim is None and time.time() < deadline:
+            for rep in decoders:
+                sched = rep.engine.scheduler
+                with sched._lock:
+                    if any(len(e.req.tokens) >= 3
+                           for e in sched._ledger.values()):
+                        victim = rep
+                        break
+            time.sleep(0.002)
+        assert victim is not None, \
+            "no decode worker ever held a progressed adopted stream"
+        victim_pid = victim.pid
+        os.kill(victim_pid, signal.SIGKILL)
+
+        deadline = time.time() + 30
+        while fleet.respawns == 0 and time.time() < deadline:
+            time.sleep(0.005)
+        assert fleet.deaths >= 1, "SIGKILL never detected"
+        assert fleet.respawns >= 1, "killed decode worker never respawned"
+
+        # ---- zero dropped requests, bit-identical streams ------------
+        for i in range(n_req):
+            got = handles[i].result(timeout=90)
+            assert got == refs[i], (
+                f"request {i}: disagg output diverged from single-request"
+                f" generate\n  got {got}\n  ref {refs[i]}")
+            assert streams[i] == refs[i][len(prompts[i]):], (
+                f"request {i}: streamed tokens diverged (re-emission or "
+                f"loss): {streams[i]} vs {refs[i][len(prompts[i]):]}")
+        assert fleet.quiesce(30), "fleet never went idle"
+        assert fleet.handoffs >= n_req, (
+            f"every request must cross the prefill->decode handoff "
+            f"(handoffs={fleet.handoffs}, requests={n_req})")
+    finally:
+        st = fleet.stats()
+        fleet.close()
+
+    # ---- telemetry / journal contract --------------------------------
+    snap = tele.snapshot()
+    hand = snap.get("serve_handoffs_total", {}).get("series", [])
+    assert sum(s["value"] for s in hand) == fleet.handoffs, hand
+    assert "serve_handoff_ms" in snap, "handoff latency never observed"
+    finished = [s for s in snap["serve_requests_total"]["series"]
+                if s["labels"]["state"] == "finished"]
+    assert finished and finished[0]["value"] == n_req, finished
+    rows = tele.RunJournal.read(journal_path)
+    assert any(r.get("event") == "handoff" for r in rows), \
+        "journal missing handoff events"
+
+    elapsed = time.time() - t_start
+    print(json.dumps({
+        "disagg_smoke": "ok", "requests": n_req,
+        "handoffs": fleet.handoffs,
+        "handoff_failures": fleet.handoff_failures,
+        "deaths": fleet.deaths, "respawns": fleet.respawns,
+        "roles": {n: list(v) for n, v in roles.items()},
+        "router": st["router"]["routed"],
+        "elapsed_s": round(elapsed, 1)}))
+    assert elapsed < 60, f"smoke took {elapsed:.0f}s (budget 60s)"
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -130,6 +130,52 @@ def record_procfleet_extras() -> None:
               file=sys.stderr)
 
 
+def record_disagg_extras() -> None:
+    """RECORDED, never gated: one disaggregated-serving round
+    (`bench.py --serve --disagg 1x2 --tp 2`) so the handoff latency
+    percentiles, per-role utilization, and the independent-scaling
+    check (aggregate tokens/s with one extra PREFILL replica, decode
+    tier untouched) ride every gate transcript — a handoff or
+    tp-sharding regression shows up in the round logs without gating
+    the merge."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "bench.py"),
+             "--serve", "--disagg", "1x2", "--tp", "2"],
+            capture_output=True, text=True, timeout=TIMEOUT, cwd=ROOT)
+        line = next(ln for ln in reversed(
+            proc.stdout.strip().splitlines()) if ln.startswith("{"))
+        d = json.loads(line)
+        ex = d["extras"]
+        scal = ex.get("prefill_scaling") or {}
+        rec = {
+            "disagg_tokens_per_sec": d["value"],
+            "disagg": ex.get("disagg"),
+            "tp": ex.get("tp"),
+            "handoffs": ex.get("handoffs"),
+            "handoff_failures": ex.get("handoff_failures"),
+            "handoff_ms_p50": ex.get("handoff_ms_p50"),
+            "handoff_ms_p99": ex.get("handoff_ms_p99"),
+            "phases": ex.get("phases"),
+            "prefill_scaling_improvement": scal.get("improvement"),
+            "measured_at": time.strftime("%Y-%m-%d"),
+        }
+        out = os.path.join(ROOT, "bench_results",
+                           "perf_gate_disagg.json")
+        with open(out, "w") as f:
+            json.dump(rec, f, indent=2)
+            f.write("\n")
+        print(f"perf-gate: disagg extras (informational): "
+              f"{rec['disagg_tokens_per_sec']} tok/s at "
+              f"{rec['disagg']} tp={rec['tp']}, handoff p50/p99 "
+              f"{rec['handoff_ms_p50']}/{rec['handoff_ms_p99']} ms, "
+              f"+1-prefill scaling x"
+              f"{rec['prefill_scaling_improvement']} -> {out}")
+    except Exception as e:   # noqa: BLE001 — never gate on this round
+        print(f"perf-gate: disagg extras round skipped ({e})",
+              file=sys.stderr)
+
+
 def main() -> int:
     vals, mfus = [], []
     for i in range(RUNS):
@@ -188,6 +234,7 @@ def main() -> int:
     if "--no-serve" not in sys.argv:
         record_serve_extras()
         record_procfleet_extras()
+        record_disagg_extras()
     return 0
 
 
