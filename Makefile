@@ -2,7 +2,7 @@
 # to what a single-language-core framework needs).
 PY ?= python
 
-.PHONY: ci test test-all test-dist test-parity lint bench cpp docs clean opperf-check telemetry-smoke health-smoke chaos-smoke serve-smoke fleet-smoke procfleet-smoke kernels-smoke elastic-smoke export-smoke data-smoke trace-smoke quant-smoke spec-smoke disagg-smoke perf-gate
+.PHONY: ci test test-all test-dist test-parity lint bench cpp docs clean opperf-check telemetry-smoke health-smoke chaos-smoke serve-smoke fleet-smoke procfleet-smoke kernels-smoke elastic-smoke export-smoke data-smoke trace-smoke quant-smoke spec-smoke disagg-smoke obsplane-smoke perf-gate
 
 # the one-command gate CI runs (VERDICT round-2 next-step #7): lint +
 # unit suite + 2-process dist tests + C++ package build/tests
@@ -17,7 +17,7 @@ cpp-test:
 # `make test-all` runs everything.  -n auto parallelizes when xdist +
 # cores are available: ~13.5 min serial on the 1-core builder VM,
 # well under 10 min on any >=2-core box
-test: telemetry-smoke health-smoke chaos-smoke serve-smoke fleet-smoke procfleet-smoke kernels-smoke elastic-smoke export-smoke data-smoke trace-smoke quant-smoke spec-smoke disagg-smoke
+test: telemetry-smoke health-smoke chaos-smoke serve-smoke fleet-smoke procfleet-smoke kernels-smoke elastic-smoke export-smoke data-smoke trace-smoke quant-smoke spec-smoke disagg-smoke obsplane-smoke
 	$(PY) -m pytest tests/unittest -q -m "not slow" $$($(PY) -c 'import xdist, os; print("-n auto" if (os.cpu_count() or 1) > 1 else "")' 2>/dev/null) --ignore=tests/unittest/test_dist_kvstore.py
 
 test-all:
@@ -121,6 +121,15 @@ procfleet-smoke:
 # dropped requests, <60 s on CPU
 disagg-smoke:
 	$(PY) tools/disagg_smoke.py
+
+# fleet observability plane (docs/observability.md "Fleet
+# observability"): one trace id per request across router + prefill +
+# decode processes with clock-rebased worker spans, merged Perfetto
+# export via diagnose --trace, per-replica federated /metrics series
+# present then retired on drain, and an SLO burn alert fired by
+# SIGSTOP-induced failover latency (silent on the clean run)
+obsplane-smoke:
+	$(PY) tools/obsplane_smoke.py
 
 # fused Pallas kernel set: CPU interpret-mode parity sweep over
 # odd/padded shapes (norms, MoE dispatch/combine incl. overflow drops,

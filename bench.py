@@ -605,6 +605,14 @@ def _measure_serve_fleet(replicas: int, kill_at: float,
         "platform": dev.platform,
         **_decode_rate_pcts(handles),
     }
+    if stats.get("slo"):
+        # burn-rate posture at end of run (MXTPU_SLO_SPEC objectives):
+        # per-objective fast/slow burn + whether any alert fired
+        extras["slo"] = {
+            name: {"burn_fast": round(e["windows"]["fast"]["burn"], 3),
+                   "burn_slow": round(e["windows"]["slow"]["burn"], 3),
+                   "alerts": e["alerts"]}
+            for name, e in stats["slo"].items()}
     if spec > 0:
         # fleet-aggregate speculation outcome (dead replicas included —
         # their accepted tokens were streamed before the loss)

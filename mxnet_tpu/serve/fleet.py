@@ -63,6 +63,7 @@ from typing import List, Optional, Tuple
 from ..base import MXNetError
 from ..resilience import fault_point
 from .. import health as _health
+from .. import slo as _slo
 from .. import telemetry as _tele
 from .. import tracing as _trace
 from .engine import InferenceEngine, ServeConfig, _env_int
@@ -72,9 +73,43 @@ from .scheduler import (ContinuousBatchingScheduler, ServeRequest,
                         terminate_request)
 from . import wire
 
-__all__ = ["ServeFleet", "Replica", "ProcessReplica"]
+__all__ = ["ServeFleet", "Replica", "ProcessReplica", "worker_env"]
 
 _log = logging.getLogger(__name__)
+
+#: how often the supervisor refreshes each process replica's clock
+#: offset (seconds); the hello timestamp seeds a coarse estimate and
+#: the first post-ready `clock` RPC replaces it with an RTT-halved one
+ENV_CLOCK_SYNC = "MXTPU_CLOCK_SYNC_INTERVAL"
+
+#: observability env vars that must NOT leak from the parent into
+#: spawned workers: an inherited metrics port would collide on bind,
+#: an inherited journal/trace path would interleave worker rows into
+#: (or clobber) the parent's files, and an inherited SLO spec would
+#: run a second, conflicting burn evaluator per worker
+_SCOPED_ENV = ("MXTPU_METRICS_PORT", "MXTPU_TELEMETRY",
+               "MXTPU_TRACE", "MXTPU_TRACE_DIR", "MXTPU_SLO_SPEC")
+
+
+def worker_env(base: Optional[dict] = None) -> dict:
+    """The spawn environment for a `serve.worker` process: the parent's
+    env with the parent-only observability vars scoped out, plus
+    ``MXTPU_WORKER_OBS`` telling the worker which planes to run locally
+    (shipping rows/spans over the events channel instead of writing
+    files or binding ports)."""
+    env = dict(os.environ if base is None else base)
+    for key in _SCOPED_ENV:
+        env.pop(key, None)
+    obs = []
+    if _tele.enabled():
+        obs.append("telemetry")
+    if _trace.enabled():
+        obs.append("trace")
+    if obs:
+        env["MXTPU_WORKER_OBS"] = ",".join(obs)
+    else:
+        env.pop("MXTPU_WORKER_OBS", None)
+    return env
 
 
 class Replica:
@@ -460,6 +495,10 @@ class ProcessReplica(Replica):
         self._control: Optional[wire.WireClient] = None
         self._events = None
         self._reader: Optional[threading.Thread] = None
+        #: worker perf_counter offset vs ours — rebases shipped span
+        #: timestamps onto the parent timeline
+        self.clock = _trace.ClockSync()
+        self._last_clock_sync = 0.0
 
     def call(self, verb: str, **kw) -> dict:
         c = self._control
@@ -487,12 +526,21 @@ class ProcessReplica(Replica):
                # per worker (disaggregation)
                "--role", self.engine.role,
                "--tp", str(self.engine.tp)]
-        self.proc = subprocess.Popen(cmd)
+        self.proc = subprocess.Popen(cmd, env=worker_env())
         try:
             control, events, hello = listener.wait(
                 self.name, timeout=timeout,
                 alive=lambda: self.proc.poll() is None)
             self.pid = hello.get("pid") or self.proc.pid
+            if hello.get("ts") is not None:
+                # coarse one-way offset from the hello timestamp
+                # (handshake latency error); the first `clock` RPC
+                # below replaces it with an RTT-halved estimate
+                try:
+                    self.clock.seed(float(hello["ts"])
+                                    - time.perf_counter())
+                except (TypeError, ValueError):
+                    pass
             self._control = wire.WireClient(control, replica=self.name)
             self._events = events
             self._reader = threading.Thread(
@@ -513,13 +561,30 @@ class ProcessReplica(Replica):
             self.terminate(force=True)
             raise
         _health.beat(self.heartbeat_name)
+        self.sync_clock()
         if _trace.enabled():
+            _trace.note_remote_process(self.pid,
+                                       f"worker {self.name}")
             _trace.get_tracer("serve").record_span(
                 "serve.replica", t0, time.perf_counter(),
                 track="serve fleet", replica=self.name,
                 transport=self.transport, pid=self.pid,
                 generation=self.generation,
                 compile_seconds=self.compile_seconds)
+
+    def sync_clock(self) -> Optional[float]:
+        """One RTT-halving clock exchange (``clock`` RPC): feeds the
+        min-RTT offset estimator.  Best-effort — a wedged worker must
+        not take the supervisor down with it."""
+        try:
+            t_send = time.perf_counter()
+            resp = self.call("clock", _timeout_ms=2000)
+            off = self.clock.update(t_send, float(resp["ts"]),
+                                    time.perf_counter())
+        except Exception:
+            return None
+        self._last_clock_sync = time.monotonic()
+        return off
 
     def start_driver(self, fleet: "ServeFleet") -> None:
         pass      # no driver thread: the reader + supervisor own liveness
@@ -543,6 +608,10 @@ class ProcessReplica(Replica):
                     sched.on_hb(ev)
                     self.engine._steps_executed = int(
                         ev.get("steps", self.engine._steps_executed))
+                    if ev.get("metrics"):
+                        self._fleet._federate(self, ev["metrics"])
+                elif kind == "obs":
+                    self._ingest_obs(ev)
                 elif kind == "done":
                     _health.beat(self.heartbeat_name)
                     sched.on_done(ev["rid"], ev.get("state", "failed"),
@@ -567,6 +636,37 @@ class ProcessReplica(Replica):
         if self.state in ("starting", "running", "draining"):
             self._fleet._replica_died(self, MXNetError(
                 fatal or f"worker {self.name} connection lost"))
+
+    def _ingest_obs(self, ev: dict) -> None:
+        """Adopt one shipped observability batch: finished worker spans
+        (rebased by the clock offset) join the parent's serve tracer,
+        and worker journal rows re-emit into the parent's journal —
+        tagged with the replica and ``origin=worker`` so downstream
+        consumers (the SLO tap, dedup tooling) can tell them from the
+        parent's own rows.  Worker ``cost_analysis`` rows land here,
+        which is how worker compiles reach the learned-cost-model
+        corpus."""
+        spans = ev.get("spans") or ()
+        if spans and _trace.enabled():
+            _trace.note_remote_process(self.pid, f"worker {self.name}")
+            _trace.get_tracer("serve").ingest(
+                spans, offset=self.clock.offset, pid=self.pid,
+                replica=self.name)
+        rows = ev.get("rows") or ()
+        if rows and _tele.enabled():
+            for row in rows:
+                try:
+                    fields = dict(row)
+                    name = fields.pop("event", None)
+                    if not name:
+                        continue
+                    fields.pop("ts", None)
+                    step = fields.pop("step", None)
+                    fields.setdefault("replica", self.name)
+                    fields["origin"] = "worker"
+                    _tele.event(str(name), step=step, **fields)
+                except Exception:
+                    continue   # one bad row must not kill the reader
 
     def probe(self, ages: dict, stall_timeout: float) -> Optional[str]:
         if self.proc is not None and self.proc.poll() is not None:
@@ -729,6 +829,21 @@ class ServeFleet:
         self._warmed = False
         self._started = False
         self._closed = False
+        # metrics federation: latest registry snapshot per live process
+        # replica (riding heartbeats); re-exported per-replica-labeled
+        # through a registry collector while the fleet runs
+        self._fed_lock = threading.Lock()
+        self._federated: "OrderedDict[str, dict]" = OrderedDict()
+        try:
+            self.clock_sync_interval = float(
+                os.environ.get(ENV_CLOCK_SYNC, "") or 10.0)
+        except ValueError:
+            self.clock_sync_interval = 10.0
+        # SLO burn-rate engine (MXTPU_SLO_SPEC): samples the fleet's own
+        # telemetry events, evaluated every supervisor sweep
+        self.slo: Optional[_slo.SLOEngine] = _slo.SLOEngine.from_env()
+        if self.slo is not None:
+            self.slo.attach()
 
     def _role_for(self, idx: int) -> str:
         if self.disagg is not None:
@@ -860,6 +975,7 @@ class ServeFleet:
             rep.start_driver(self)
             self._journal_replica(rep, "started")
             self._trace_replica(rep)
+        _tele.registry().add_collector(self._federated_metrics)
         self._supervisor = threading.Thread(
             target=self._supervise, daemon=True, name="serve-supervisor")
         self._supervisor.start()
@@ -930,6 +1046,11 @@ class ServeFleet:
             self._listener.close()
         if self._spec_path is not None:
             shutil.rmtree(self._spec_path, ignore_errors=True)
+        _tele.registry().remove_collector(self._federated_metrics)
+        with self._fed_lock:
+            self._federated.clear()
+        if self.slo is not None:
+            self.slo.detach()
         self._update_fleet_gauges()
 
     def __enter__(self) -> "ServeFleet":
@@ -1111,10 +1232,52 @@ class ServeFleet:
             transport=rep.transport, pid=rep.pid,
             generation=rep.generation)
 
+    def _federate(self, rep: Replica, snap: dict) -> None:
+        """Store a worker's registry snapshot (heartbeat payload) for
+        re-export; only live replicas keep an entry."""
+        if not isinstance(snap, dict):
+            return
+        with self._fed_lock:
+            self._federated[rep.name] = snap
+
+    def _federated_metrics(self) -> dict:
+        """Registry collector (installed in `start`): every stored
+        worker snapshot re-labeled with ``replica=<name>`` and merged
+        into the parent's exports — one /metrics scrape point for the
+        whole fleet."""
+        with self._fed_lock:
+            snaps = list(self._federated.items())
+        out: dict = {}
+        for rep_name, snap in snaps:
+            for mname, metric in snap.items():
+                try:
+                    mtype = metric.get("type", "gauge")
+                    dst = out.get(mname)
+                    if dst is None:
+                        dst = out[mname] = {
+                            "type": mtype,
+                            "help": metric.get("help", ""),
+                            "series": []}
+                    elif dst["type"] != mtype:
+                        continue
+                    for s in metric.get("series", ()):
+                        entry = dict(s)
+                        labels = dict(entry.get("labels") or {})
+                        labels["replica"] = rep_name
+                        entry["labels"] = labels
+                        dst["series"].append(entry)
+                except Exception:
+                    continue   # a malformed snapshot must not kill scrape
+        return out
+
     def _retire_series(self, rep: Replica) -> None:
         """Drop the dead/drained replica's per-replica gauge series and
-        heartbeat — stale last-values must not outlive the replica."""
+        heartbeat — stale last-values must not outlive the replica.
+        The replica's federated worker snapshot retires with it, so its
+        series vanish from /metrics at the same moment."""
         _health.clear_beat(rep.heartbeat_name)
+        with self._fed_lock:
+            self._federated.pop(rep.name, None)
         if not _tele.enabled():
             return
         reg = _tele.registry()
@@ -1230,6 +1393,11 @@ class ServeFleet:
         request at the prefill tier with its pages freed on both sides:
         admitted work is never dropped."""
         src, req, rid = item["src"], item.get("req"), item.get("rid")
+        # trace context: handoff RPCs and the serve.handoff phase span
+        # parent under the request's root span (cross-process tree)
+        ctx = req._span.context() \
+            if (req is not None and req._span is not None) else None
+        track = f"serve req {req.id}" if req is not None else None
         try:
             fault_point("kv_handoff")
             if req is None:      # no decode leg: free worker-side pages
@@ -1242,10 +1410,12 @@ class ServeFleet:
                                  "the prefilled request")
             if src.transport == "process":
                 resp = src.call("kv_export", rid=rid,
-                                _timeout_ms=self.handoff_timeout_ms)
+                                _timeout_ms=self.handoff_timeout_ms,
+                                _span_parent=ctx, _track=track)
                 dst.call("kv_import", rid=rid, meta=resp["meta"],
                          n_pages=int(resp["n_pages"]),
                          _timeout_ms=self.handoff_timeout_ms,
+                         _span_parent=ctx, _track=track,
                          _blobs=tuple(resp.get("_blobs") or ()))
                 item["_dst"] = dst
                 dsched = dst.engine.scheduler
@@ -1265,11 +1435,13 @@ class ServeFleet:
                         max_new=req.max_new_tokens, greedy=req.greedy,
                         temperature=req.temperature,
                         eos=req.eos_token_id, deadline_ms=remaining,
-                        _timeout_ms=self.handoff_timeout_ms)
+                        _timeout_ms=self.handoff_timeout_ms,
+                        _span_parent=ctx, _track=track)
                 except BaseException:
                     dsched.drop_ledger(rid)
                     raise
-                src.call("kv_free", rid=rid)
+                src.call("kv_free", rid=rid,
+                         _span_parent=ctx, _track=track)
             else:
                 item["_dst"] = dst
                 pages = item["pages"]
@@ -1293,6 +1465,14 @@ class ServeFleet:
             ms = (time.perf_counter() - item["ts"]) * 1e3
             if len(self.handoff_ms) < 100000:
                 self.handoff_ms.append(ms)
+            if _trace.enabled() and ctx is not None:
+                # the handoff phase in the request's own tree: queued-
+                # for-pump wait + both transfer legs, start-to-adopt
+                _trace.get_tracer("serve").record_span(
+                    "serve.handoff", item["ts"], time.perf_counter(),
+                    parent=ctx, track=track, request_id=req.id,
+                    src=src.name, dst=dst.name,
+                    pages=item.get("n_pages") or 0)
             if _tele.enabled():
                 _tele.histogram(
                     "serve_handoff_ms",
@@ -1398,7 +1578,14 @@ class ServeFleet:
                     # process replicas have no driver thread — the
                     # supervisor pulls parked work for them
                     self.router.feed(rep)
+                    if isinstance(rep, ProcessReplica) and \
+                            time.monotonic() - rep._last_clock_sync \
+                            > self.clock_sync_interval:
+                        rep._last_clock_sync = time.monotonic()
+                        rep.sync_clock()
             self.router.sweep_expired()
+            if self.slo is not None:
+                self.slo.tick()
             self._update_fleet_gauges()
 
     # ------------------------------------------------------------------
@@ -1462,4 +1649,5 @@ class ServeFleet:
             "respawns": self.respawns,
             "respawn_budget": self.respawn_budget,
             "retired": [r.name for r in self.retired],
+            "slo": self.slo.evaluate() if self.slo is not None else None,
         }

@@ -281,6 +281,12 @@ class WireClient:
                           or rpc_timeout_ms()) / 1e3
         call_id = next(self._ids)
         frame = {"verb": verb, "id": call_id, **payload}
+        if _span_parent is not None and _trace.enabled():
+            # cross-process trace propagation: the worker joins its own
+            # spans under this (trace_id, span_id) so the request renders
+            # as ONE Perfetto tree across processes
+            frame["_trace"] = {"tid": _span_parent.trace_id,
+                               "sid": _span_parent.span_id}
         stats = {"attempts": 0, "bytes": 0}
         t0 = time.perf_counter()
 

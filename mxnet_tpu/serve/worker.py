@@ -27,6 +27,7 @@ ledger, fails the streams over, and respawns within
 from __future__ import annotations
 
 import argparse
+import collections
 import dataclasses
 import inspect
 import json
@@ -40,12 +41,27 @@ import numpy as onp
 
 from ..base import MXNetError
 from ..resilience import EXIT_CODE, FaultExit
+from .. import telemetry as _tele
+from .. import tracing as _trace
 from .decode import extract_decode_weights
 from .engine import InferenceEngine, ServeConfig
-from .scheduler import ServeRequest, terminate_request
+from .scheduler import ServeRequest, _close_request_spans, \
+    terminate_request
 from . import wire
 
-__all__ = ["write_spec", "load_spec", "main"]
+__all__ = ["write_spec", "load_spec", "main", "ENV_WORKER_OBS"]
+
+#: set by the parent in the scoped spawn env (fleet.worker_env): a
+#: comma list of "telemetry" / "trace".  The worker runs its OWN
+#: registry/tracer (no journal file, no /metrics port, no trace dir —
+#: those stay parent-only) and ships rows/spans over the events channel.
+ENV_WORKER_OBS = "MXTPU_WORKER_OBS"
+
+#: journal rows buffered between heartbeats before the oldest drop
+_OBS_ROW_CAP = 10_000
+#: every Nth heartbeat carries a full metrics-registry snapshot (the
+#: federation payload); at ~5 Hz heartbeats that is ~1 Hz freshness
+_HB_PER_SNAPSHOT = 5
 
 _SPEC_CONFIG = "config.json"
 _SPEC_WEIGHTS = "weights.npz"
@@ -128,7 +144,25 @@ class Worker:
         self.role_override = role or None
         self.tp_override = tp if tp and tp > 0 else None
         self.engine: Optional[InferenceEngine] = None
-        self._control = wire.connect(host, port, "control", name)
+        # worker-local observability (ENV_WORKER_OBS, set by the parent's
+        # scoped spawn env): enable BEFORE the engine builds so warmup
+        # compiles land in the cost corpus, and before the hello so the
+        # first heartbeat can already ship
+        obs = {t.strip() for t in
+               os.environ.get(ENV_WORKER_OBS, "").lower().split(",") if t}
+        self._obs_tele = "telemetry" in obs
+        self._obs_trace = "trace" in obs
+        self._obs_rows: "collections.deque[dict]" = collections.deque(
+            maxlen=_OBS_ROW_CAP)
+        if self._obs_tele:
+            _tele.enable()
+            _tele.add_event_tap(self._obs_tap)
+        if self._obs_trace:
+            _trace.enable()
+        # hello carries our perf_counter so the parent can seed a coarse
+        # clock offset before the first `clock` RPC round-trip
+        self._control = wire.connect(host, port, "control", name,
+                                     ts=time.perf_counter())
         self._events = wire.connect(host, port, "events", name)
         self._send_lock = threading.Lock()
         self._wake = threading.Event()
@@ -146,6 +180,51 @@ class Worker:
         self._pending = {}        # rid -> imported pages awaiting adopt
         self._lock = threading.Lock()
         self._last_hb = 0.0
+        self._hb_count = 0
+
+    # -- observability shipping ----------------------------------------
+    def _obs_tap(self, row: dict) -> None:
+        """Buffer every journal row for the next heartbeat's obs batch.
+        Finished spans already ship via the tracer rings — their journal
+        echo is skipped here, or the parent would journal each twice."""
+        if row.get("event") != "span":
+            self._obs_rows.append(row)
+
+    def _ship_obs(self) -> None:
+        """Drain buffered journal rows + finished spans into one
+        ``obs`` event frame (heartbeat cadence; also called once on the
+        way out so a graceful exit loses nothing)."""
+        rows = []
+        while self._obs_rows and len(rows) < 2000:
+            rows.append(self._obs_rows.popleft())
+        spans = []
+        if self._obs_trace:
+            for tr in _trace.tracers().values():
+                spans.extend(_trace.span_to_wire(s) for s in tr.drain())
+        if rows or spans:
+            self._send({"ev": "obs", "rows": rows, "spans": spans})
+
+    def _join_trace(self, req: ServeRequest, frame: dict) -> None:
+        """Adopt the propagated trace context from a submit frame: root
+        a ``serve.worker`` span under the parent's request span, and an
+        initial queue span under that, so every scheduler phase span on
+        this request lands in the SAME cross-process trace tree."""
+        tc = frame.get("_trace")
+        if not tc or not self._obs_trace or not _trace.enabled():
+            return
+        try:
+            parent = _trace.SpanContext(str(tc["tid"]), int(tc["sid"]))
+        except (KeyError, TypeError, ValueError):
+            return
+        tr = _trace.get_tracer("serve")
+        track = f"serve req {req.id}"
+        req._span = tr.start_span(
+            "serve.worker", parent=parent, track=track,
+            request_id=req.id, replica=self.name,
+            role=getattr(self.engine, "role", None))
+        req._queue_span = tr.start_span(
+            "serve.queue", parent=req._span.context(), track=track,
+            request_id=req.id)
 
     # -- events channel (main thread + on_token, serialized) -----------
     def _send(self, ev: dict) -> None:
@@ -170,11 +249,18 @@ class Worker:
             return
         self._last_hb = now
         sched = self.engine.scheduler
-        self._send({"ev": "hb", "queued": sched.queue_depth,
-                    "active": sched.active_count,
-                    "free_pages": self.engine.allocator.free_pages,
-                    "steps": self.engine._steps_executed,
-                    "pid": os.getpid()})
+        ev = {"ev": "hb", "queued": sched.queue_depth,
+              "active": sched.active_count,
+              "free_pages": self.engine.allocator.free_pages,
+              "steps": self.engine._steps_executed,
+              "pid": os.getpid(), "ts": time.perf_counter()}
+        if self._obs_tele and self._hb_count % _HB_PER_SNAPSHOT == 0:
+            # federation payload: the parent re-exports these series
+            # per-replica-labeled on its own /metrics
+            ev["metrics"] = _tele.registry().snapshot()
+        self._hb_count += 1
+        self._send(ev)
+        self._ship_obs()
 
     def _scan_done(self) -> None:
         with self._lock:
@@ -217,6 +303,11 @@ class Worker:
                 self._live.pop(rid, None)   # the stream leaves this worker
             if stale is not None:
                 self.engine.allocator.free(stale["pages"])
+            # close this side's spans now — the request never finishes
+            # HERE (the decode adopter opens its own), and only finished
+            # spans ship to the parent's merged trace
+            _close_request_spans(item["req"], "handoff",
+                                 replica=self.name)
             self._send({"ev": "prefilled", "rid": rid,
                         "ctx": int(item["ctx"]),
                         "n_pages": len(item["pages"]),
@@ -267,6 +358,11 @@ class Worker:
             self._shutdown.set()
             self._wake.set()
             return {}
+        if verb == "clock":
+            # one clock-sync round trip (works during warmup too): the
+            # parent RTT-halves (ClockSync.update) to estimate our
+            # perf_counter offset and rebase shipped span timestamps
+            return {"ts": time.perf_counter()}
         if self.engine is None:
             raise MXNetError(f"worker {self.name} is still warming up")
         sched = self.engine.scheduler
@@ -284,6 +380,10 @@ class Worker:
                 on_token=self._on_token(rid),
                 deadline_ms=float(frame.get("deadline_ms") or 0.0))
             req.rid = rid
+            # adopt the ROUTER's id: worker journal rows / span tags for
+            # this request then correlate with the parent's by one key
+            req.id = rid
+            self._join_trace(req, frame)
             sched.enqueue(req, front=bool(frame.get("front")))
             with self._lock:
                 self._live[rid] = req
@@ -361,6 +461,8 @@ class Worker:
                 on_token=self._on_token(rid),
                 deadline_ms=float(frame.get("deadline_ms") or 0.0))
             req.rid = rid
+            req.id = rid
+            self._join_trace(req, frame)
             req.tokens = [int(t) for t in frame.get("tokens") or []]
             try:
                 sched.adopt_prefilled(req, pages, int(frame["ctx"]))
@@ -435,6 +537,10 @@ class Worker:
             if not progressed:
                 self._wake.wait(0.01)
                 self._wake.clear()
+        try:
+            self._ship_obs()   # final batch: a graceful drain loses nothing
+        except Exception:
+            pass
         for sock in (self._events, self._control):
             try:
                 sock.close()

@@ -1,0 +1,359 @@
+"""SLO objectives + multi-window burn-rate alerting for the serving
+fleet (docs/observability.md, "Fleet observability").
+
+An :class:`Objective` declares what "good" means for one signal —
+TTFT under a threshold, per-request decode rate over a floor, request
+availability, admission (non-shed) rate — plus a target good-fraction
+and two rolling windows.  The :class:`SLOEngine` samples the fleet's
+own telemetry events (an `telemetry.add_event_tap` tap — zero new
+instrumentation sites), keeps per-objective rolling (ts, good) sample
+windows, and on every :meth:`tick` computes the **burn rate** per
+window:
+
+    burn = bad_fraction(window) / (1 - target)
+
+i.e. the multiple of the error budget being spent right now (burn 1.0
+= exactly on budget; Google SRE workbook chapter 5).  An alert fires
+only when BOTH windows exceed the objective's burn threshold — the
+fast window makes the alert responsive, the slow window keeps a brief
+blip from paging — and clears when either drops back under.  Alerts
+surface three ways, all consumed by the ROADMAP-item-5 autoscaler:
+
+* ``slo_burn_rate{slo,window}`` / ``slo_good_ratio{slo}`` /
+  ``slo_alert{slo}`` gauges + a ``slo_burn_alerts_total{slo}`` counter;
+* a ``slo_burn`` journal event on each alert transition (and
+  ``slo_clear`` when it resolves);
+* :meth:`evaluate` — the structured dict `ServeFleet.stats()` embeds.
+
+Spec format (``MXTPU_SLO_SPEC`` — inline JSON or a path to a JSON
+file)::
+
+    {"objectives": [
+       {"name": "ttft_p99", "signal": "ttft_ms", "threshold": 500,
+        "target": 0.99, "fast_s": 300, "slow_s": 3600, "burn": 2.0},
+       {"name": "availability", "signal": "availability",
+        "target": 0.999}]}
+
+Signals: ``ttft_ms`` / ``latency_ms`` (good = sample <= threshold),
+``decode_tok_s`` (good = generated/latency >= threshold),
+``availability`` (finished = good; failed / expired / failover-failed
+= bad), ``shed_rate`` (admitted = good; shed = bad).
+"""
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Tuple
+
+from .base import MXNetError
+from . import telemetry as _tele
+
+__all__ = ["Objective", "SLOEngine", "ENV_SLO_SPEC", "SIGNALS"]
+
+_log = logging.getLogger(__name__)
+
+ENV_SLO_SPEC = "MXTPU_SLO_SPEC"
+
+SIGNALS = ("ttft_ms", "latency_ms", "decode_tok_s", "availability",
+           "shed_rate")
+
+#: request phases that count against availability.  ``cancelled`` is
+#: excluded: a caller-initiated cancel is not a service failure.
+_BAD_PHASES = frozenset(("failed", "deadline_expired", "failover_failed"))
+
+#: samples kept per objective (oldest dropped) — bounds memory on a
+#: long-lived fleet regardless of window length
+_SAMPLE_CAP = 100_000
+
+
+@dataclass
+class Objective:
+    """One declarative objective.  ``target`` is the good-fraction goal
+    (its complement is the error budget); ``threshold`` cuts the signal
+    into good/bad where the signal is a measurement; ``burn`` is the
+    budget-spend multiple both windows must exceed to alert."""
+
+    name: str
+    signal: str
+    target: float = 0.99
+    threshold: Optional[float] = None
+    fast_s: float = 300.0
+    slow_s: float = 3600.0
+    burn: float = 2.0
+    min_events: int = 1
+
+    def __post_init__(self):
+        if self.signal not in SIGNALS:
+            raise MXNetError(
+                f"SLO {self.name!r}: unknown signal {self.signal!r} "
+                f"(one of {SIGNALS})")
+        if not 0.0 < self.target < 1.0:
+            raise MXNetError(
+                f"SLO {self.name!r}: target must be in (0, 1), "
+                f"got {self.target}")
+        if self.signal in ("ttft_ms", "latency_ms", "decode_tok_s") \
+                and self.threshold is None:
+            raise MXNetError(
+                f"SLO {self.name!r}: signal {self.signal!r} needs a "
+                f"threshold")
+        if self.fast_s <= 0 or self.slow_s <= 0 \
+                or self.fast_s > self.slow_s:
+            raise MXNetError(
+                f"SLO {self.name!r}: need 0 < fast_s <= slow_s, got "
+                f"fast_s={self.fast_s} slow_s={self.slow_s}")
+
+
+@dataclass
+class _State:
+    objective: Objective
+    samples: Deque[Tuple[float, bool]] = field(
+        default_factory=lambda: collections.deque(maxlen=_SAMPLE_CAP))
+    alerting: bool = False
+    alerts: int = 0
+
+
+class SLOEngine:
+    """Evaluates a set of objectives over the live telemetry event
+    stream.  `attach` installs the event tap; `tick` (called from the
+    fleet supervisor, or any periodic driver) prunes windows, updates
+    the ``slo_*`` gauges, and journals alert transitions."""
+
+    def __init__(self, objectives: List[Objective]):
+        self._lock = threading.Lock()
+        self._states: "Dict[str, _State]" = {}
+        for o in objectives:
+            self.add_objective(o)
+        self._attached = False
+
+    # -- construction ---------------------------------------------------
+    @classmethod
+    def from_env(cls) -> Optional["SLOEngine"]:
+        """Build from ``MXTPU_SLO_SPEC`` (inline JSON or a file path);
+        None when unset.  A malformed spec raises — a silently-ignored
+        SLO config is an outage you find out about during the outage."""
+        spec = os.environ.get(ENV_SLO_SPEC, "").strip()
+        if not spec:
+            return None
+        return cls.from_spec(spec)
+
+    @classmethod
+    def from_spec(cls, spec) -> "SLOEngine":
+        if isinstance(spec, str):
+            text = spec
+            if not text.lstrip().startswith(("{", "[")):
+                try:
+                    with open(text) as f:
+                        text = f.read()
+                except OSError as e:
+                    raise MXNetError(
+                        f"{ENV_SLO_SPEC}={spec!r}: not inline JSON and "
+                        f"not a readable file ({e})")
+            try:
+                spec = json.loads(text)
+            except ValueError as e:
+                raise MXNetError(f"{ENV_SLO_SPEC}: invalid JSON: {e}")
+        if isinstance(spec, dict):
+            spec = spec.get("objectives", [])
+        if not isinstance(spec, list):
+            raise MXNetError(
+                f"{ENV_SLO_SPEC}: expected a list of objectives or "
+                f'{{"objectives": [...]}}')
+        objectives = []
+        known = {f.name for f in Objective.__dataclass_fields__.values()}
+        for i, d in enumerate(spec):
+            if not isinstance(d, dict):
+                raise MXNetError(
+                    f"{ENV_SLO_SPEC}: objective #{i} is not an object")
+            unknown = set(d) - known
+            if unknown:
+                raise MXNetError(
+                    f"{ENV_SLO_SPEC}: objective "
+                    f"{d.get('name', f'#{i}')!r} has unknown keys "
+                    f"{sorted(unknown)} (known: {sorted(known)})")
+            objectives.append(Objective(**d))
+        return cls(objectives)
+
+    def add_objective(self, o: Objective) -> None:
+        with self._lock:
+            if o.name in self._states:
+                raise MXNetError(f"duplicate SLO name {o.name!r}")
+            self._states[o.name] = _State(o)
+
+    def objectives(self) -> List[Objective]:
+        with self._lock:
+            return [s.objective for s in self._states.values()]
+
+    # -- event sampling -------------------------------------------------
+    def attach(self) -> "SLOEngine":
+        if not self._attached:
+            _tele.add_event_tap(self._tap)
+            self._attached = True
+        return self
+
+    def detach(self) -> None:
+        if self._attached:
+            _tele.remove_event_tap(self._tap)
+            self._attached = False
+
+    def _tap(self, row: dict) -> None:
+        try:
+            self.observe_event(row)
+        except Exception:   # a tap must never take serving down
+            _log.debug("slo tap failed", exc_info=True)
+
+    def observe_event(self, row: dict) -> None:
+        """Map one journal row onto objective samples.  Rows re-emitted
+        from workers (``origin`` set) are skipped — the parent's stream
+        ledger already emits the canonical per-request events, and
+        counting both would double-weight every fleet request."""
+        if row.get("origin") is not None:
+            return
+        ev = row.get("event")
+        if ev == "request":
+            phase = row.get("phase")
+            if phase == "first_token" and row.get("ttft_ms") is not None:
+                self.observe("ttft_ms", float(row["ttft_ms"]))
+            elif phase == "finished":
+                self.observe("availability", good=True)
+                lat = row.get("latency_ms")
+                if lat is not None:
+                    self.observe("latency_ms", float(lat))
+                    gen = row.get("generated")
+                    if gen and float(lat) > 0:
+                        self.observe("decode_tok_s",
+                                     float(gen) / (float(lat) / 1e3))
+            elif phase in _BAD_PHASES:
+                self.observe("availability", good=False)
+            elif phase == "submitted":
+                self.observe("shed_rate", good=True)
+        elif ev == "shed":
+            self.observe("shed_rate", good=False)
+
+    def observe(self, signal: str, value: Optional[float] = None,
+                good: Optional[bool] = None,
+                ts: Optional[float] = None) -> None:
+        """Record one sample for every objective on `signal`.  Either a
+        measured `value` (cut by each objective's threshold) or an
+        explicit `good` verdict."""
+        now = time.monotonic() if ts is None else ts
+        with self._lock:
+            states = [s for s in self._states.values()
+                      if s.objective.signal == signal]
+        for st in states:
+            o = st.objective
+            if good is not None:
+                ok = bool(good)
+            elif value is None:
+                continue
+            elif signal == "decode_tok_s":
+                ok = value >= o.threshold     # rate: higher is better
+            else:
+                ok = value <= o.threshold     # latency: lower is better
+            st.samples.append((now, ok))
+
+    # -- evaluation -----------------------------------------------------
+    @staticmethod
+    def _window(samples, now: float, width: float) -> Tuple[int, int]:
+        """(events, bad) within the trailing `width` seconds."""
+        lo = now - width
+        events = bad = 0
+        for ts, ok in reversed(samples):
+            if ts < lo:
+                break
+            events += 1
+            if not ok:
+                bad += 1
+        return events, bad
+
+    def evaluate(self, now: Optional[float] = None) -> dict:
+        """Burn rates per objective per window (no side effects)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            states = list(self._states.values())
+        out = {}
+        for st in states:
+            o = st.objective
+            budget = 1.0 - o.target
+            entry = {"signal": o.signal, "target": o.target,
+                     "threshold": o.threshold, "burn_threshold": o.burn,
+                     "alerting": st.alerting, "alerts": st.alerts,
+                     "windows": {}}
+            for wname, width in (("fast", o.fast_s), ("slow", o.slow_s)):
+                events, bad = self._window(st.samples, now, width)
+                frac = bad / events if events else 0.0
+                entry["windows"][wname] = {
+                    "seconds": width, "events": events, "bad": bad,
+                    "burn": frac / budget}
+            out[o.name] = entry
+        return out
+
+    def tick(self, now: Optional[float] = None) -> dict:
+        """Evaluate + export: update the ``slo_*`` gauges, fire/clear
+        alerts, journal transitions.  Returns the `evaluate` dict."""
+        now = time.monotonic() if now is None else now
+        result = self.evaluate(now)
+        tele_on = _tele.enabled()
+        for name, entry in result.items():
+            with self._lock:
+                st = self._states.get(name)
+            if st is None:
+                continue
+            o = st.objective
+            fast, slow = entry["windows"]["fast"], entry["windows"]["slow"]
+            firing = (fast["events"] >= o.min_events
+                      and fast["burn"] >= o.burn
+                      and slow["burn"] >= o.burn)
+            if tele_on:
+                bg = _tele.gauge(
+                    "slo_burn_rate",
+                    "Error-budget burn multiple per objective window "
+                    "(1.0 = spending exactly the budget)",
+                    labelnames=("slo", "window"))
+                bg.set(fast["burn"], slo=name, window="fast")
+                bg.set(slow["burn"], slo=name, window="slow")
+                good = 1.0 - (slow["bad"] / slow["events"]) \
+                    if slow["events"] else 1.0
+                _tele.gauge(
+                    "slo_good_ratio",
+                    "Good-event fraction over the slow window",
+                    labelnames=("slo",)).set(good, slo=name)
+                _tele.gauge(
+                    "slo_alert",
+                    "1 while the objective's multi-window burn alert "
+                    "is firing", labelnames=("slo",)).set(
+                        1.0 if firing else 0.0, slo=name)
+            if firing and not st.alerting:
+                st.alerting = True
+                st.alerts += 1
+                entry["alerting"] = True
+                entry["alerts"] = st.alerts
+                if tele_on:
+                    _tele.counter(
+                        "slo_burn_alerts_total",
+                        "Multi-window burn-rate alerts fired",
+                        labelnames=("slo",)).inc(slo=name)
+                    _tele.event(
+                        "slo_burn", slo=name, signal=o.signal,
+                        target=o.target, burn_threshold=o.burn,
+                        burn_fast=round(fast["burn"], 4),
+                        burn_slow=round(slow["burn"], 4),
+                        fast_s=o.fast_s, slow_s=o.slow_s,
+                        events=slow["events"], bad=slow["bad"])
+                _log.warning(
+                    "SLO %s burning: fast %.2fx / slow %.2fx of error "
+                    "budget (threshold %.2fx)", name, fast["burn"],
+                    slow["burn"], o.burn)
+            elif not firing and st.alerting:
+                st.alerting = False
+                entry["alerting"] = False
+                if tele_on:
+                    _tele.event("slo_clear", slo=name,
+                                burn_fast=round(fast["burn"], 4),
+                                burn_slow=round(slow["burn"], 4))
+                _log.info("SLO %s burn alert cleared", name)
+        return result

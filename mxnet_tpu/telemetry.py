@@ -274,7 +274,24 @@ class MetricsRegistry:
 
     def __init__(self):
         self._metrics: Dict[str, _Metric] = {}
+        self._collectors: List[Callable[[], dict]] = []
         self._lock = threading.RLock()
+
+    # -- collectors -----------------------------------------------------
+    def add_collector(self, fn: Callable[[], dict]) -> None:
+        """Register a snapshot-shaped series source merged into every
+        export (`snapshot`/`to_prometheus`/`to_json`).  `fn` returns
+        ``{name: {type, help, series: [...]}}`` — the serve fleet uses
+        this to federate worker registries onto the parent's /metrics
+        as per-replica-labeled series."""
+        with self._lock:
+            if fn not in self._collectors:
+                self._collectors.append(fn)
+
+    def remove_collector(self, fn: Callable[[], dict]) -> None:
+        with self._lock:
+            if fn in self._collectors:
+                self._collectors.remove(fn)
 
     def _get_or_create(self, cls, name, help, labelnames, **kw):
         with self._lock:
@@ -339,13 +356,18 @@ class MetricsRegistry:
         registry for the run's lifetime)."""
         with self._lock:
             self._metrics.clear()
+            self._collectors.clear()
 
     # -- export ---------------------------------------------------------
     def snapshot(self) -> dict:
         """Plain-dict view: {name: {type, help, series: [...]}}; histogram
-        series carry cumulative bucket counts + sum + count."""
+        series carry cumulative bucket counts + sum + count.  Collector
+        series merge in after the local metrics (same name + same type
+        extends the series list; a kind clash drops the collector's
+        entry — never the local one)."""
         with self._lock:
             metrics = list(self._metrics.values())
+            collectors = list(self._collectors)
         out = {}
         for m in metrics:
             series = []
@@ -357,6 +379,22 @@ class MetricsRegistry:
                     entry["value"] = val
                 series.append(entry)
             out[m.name] = {"type": m.kind, "help": m.help, "series": series}
+        # collectors run OUTSIDE the registry lock (they take their own)
+        for fn in collectors:
+            try:
+                extra = fn() or {}
+            except Exception:
+                _log.debug("metrics collector failed", exc_info=True)
+                continue
+            for name, fm in extra.items():
+                series = [dict(s) for s in fm.get("series", ())]
+                dst = out.get(name)
+                if dst is None:
+                    out[name] = {"type": fm.get("type", "gauge"),
+                                 "help": fm.get("help", ""),
+                                 "series": series}
+                elif dst["type"] == fm.get("type"):
+                    dst["series"].extend(series)
         return out
 
     def to_json(self, indent: Optional[int] = None) -> str:
@@ -364,27 +402,30 @@ class MetricsRegistry:
                            "metrics": self.snapshot()}, indent=indent)
 
     def to_prometheus(self) -> str:
-        """Prometheus text exposition (version 0.0.4)."""
-        with self._lock:
-            metrics = list(self._metrics.values())
+        """Prometheus text exposition (version 0.0.4) — rendered from
+        :meth:`snapshot`, so federated collector series are included."""
         lines = []
-        for m in metrics:
-            if m.help:
-                lines.append(f"# HELP {m.name} {_escape_help(m.help)}")
-            lines.append(f"# TYPE {m.name} {m.kind}")
-            for labels, val in m._series():
-                if m.kind == "histogram":
-                    for le, c in val["buckets"].items():
+        for name, m in self.snapshot().items():
+            if m.get("help"):
+                lines.append(f"# HELP {name} {_escape_help(m['help'])}")
+            lines.append(f"# TYPE {name} {m['type']}")
+            for entry in m["series"]:
+                labels = entry.get("labels") or {}
+                if m["type"] == "histogram":
+                    for le, c in (entry.get("buckets") or {}).items():
                         lines.append(
-                            f"{m.name}_bucket"
+                            f"{name}_bucket"
                             f"{_labels_str(labels, f'le={json.dumps(le)}')}"
                             f" {c}")
                     ls = _labels_str(labels)
-                    lines.append(f"{m.name}_sum{ls} {_fmt_val(val['sum'])}")
-                    lines.append(f"{m.name}_count{ls} {val['count']}")
+                    lines.append(
+                        f"{name}_sum{ls} {_fmt_val(entry.get('sum', 0))}")
+                    lines.append(
+                        f"{name}_count{ls} {int(entry.get('count', 0))}")
                 else:
                     lines.append(
-                        f"{m.name}{_labels_str(labels)} {_fmt_val(val)}")
+                        f"{name}{_labels_str(labels)} "
+                        f"{_fmt_val(entry.get('value', 0.0))}")
         return "\n".join(lines) + "\n"
 
 

@@ -60,9 +60,10 @@ from typing import Dict, List, Optional, Tuple
 from . import telemetry as _tele
 
 __all__ = [
-    "Span", "SpanContext", "Tracer", "CostAccountant",
+    "Span", "SpanContext", "Tracer", "CostAccountant", "ClockSync",
     "enabled", "enable", "disable", "get_tracer", "tracers", "span",
     "trace_dir", "export_chrome", "chrome_events", "reset",
+    "span_to_wire", "note_remote_process", "remote_processes",
     "account", "record_executable", "cost_features_of", "estimate_mfu",
     "peak_flops", "projected_peak_flops", "note_step_cost",
     "ENV_TRACE", "ENV_TRACE_DIR", "ENV_MFU_KIND", "ENV_PEAK_TFLOPS",
@@ -83,6 +84,12 @@ DEFAULT_SPAN_CAP = 200_000
 # timing wants a monotonic clock — record the pair once and convert
 _EPOCH_WALL = time.time()
 _EPOCH_PERF = time.perf_counter()
+
+# span-id allocation is salted by pid so spans SHIPPED from a worker
+# process into the parent's trace tree (Tracer.ingest) can never
+# collide with the parent's own ids — parent_id links must stay
+# unambiguous within one trace
+_SPAN_ID_BASE = (os.getpid() & 0xFFFFF) << 32
 
 
 def _wall_us(t_perf: float) -> float:
@@ -114,7 +121,7 @@ class Span:
     any single call frame)."""
 
     __slots__ = ("tracer", "name", "trace_id", "span_id", "parent_id",
-                 "t0", "t1", "tags", "track", "_on_stack")
+                 "t0", "t1", "tags", "track", "pid", "_on_stack")
 
     def __init__(self, tracer: "Tracer", name: str, trace_id: str,
                  span_id: int, parent_id: Optional[int],
@@ -129,6 +136,7 @@ class Span:
         self.tags = tags
         self.t0 = time.perf_counter() if t0 is None else t0
         self.t1: Optional[float] = None
+        self.pid: Optional[int] = None  # None = this process; set on ingest
         self._on_stack = False
 
     def context(self) -> SpanContext:
@@ -184,7 +192,7 @@ class Tracer:
 
     def __init__(self, name: str, span_cap: int = DEFAULT_SPAN_CAP):
         self.name = name
-        self._span_ids = itertools.count(1)
+        self._span_ids = itertools.count(_SPAN_ID_BASE + 1)
         self._trace_ids = itertools.count(1)
         self._local = threading.local()
         self._lock = threading.Lock()
@@ -289,6 +297,103 @@ class Tracer:
             self._spans.clear()
         self.dropped = 0
 
+    def drain(self) -> List[Span]:
+        """Pop every finished span out of the ring (worker processes
+        drain on each heartbeat and ship the batch to the parent, so
+        the same span is never sent twice)."""
+        with self._lock:
+            out = list(self._spans)
+            self._spans.clear()
+        return out
+
+    def ingest(self, rows: List[dict], offset: float = 0.0,
+               pid: Optional[int] = None,
+               replica: Optional[str] = None) -> int:
+        """Adopt finished spans shipped from another process
+        (:func:`span_to_wire` dicts).  `offset` is the remote clock's
+        perf_counter offset relative to ours (``ClockSync.offset``):
+        remote timestamps are rebased by subtracting it, so the adopted
+        spans land on THIS process's timeline.  Keeps the remote
+        trace/span/parent ids verbatim — that is what stitches the
+        cross-process tree together."""
+        n = 0
+        for row in rows:
+            try:
+                tags = dict(row.get("tags") or {})
+                if replica is not None:
+                    tags.setdefault("replica", replica)
+                s = Span(self, str(row["name"]), str(row["trace_id"]),
+                         int(row["span_id"]),
+                         (int(row["parent_id"])
+                          if row.get("parent_id") is not None else None),
+                         row.get("track"), tags,
+                         t0=float(row["t0"]) - offset)
+                s.pid = int(pid) if pid is not None else None
+                s.finish(t1=float(row["t1"]) - offset)
+                n += 1
+            except (AttributeError, KeyError, TypeError, ValueError):
+                continue   # one malformed row must not drop the batch
+        return n
+
+
+def span_to_wire(s: Span) -> dict:
+    """One finished span as a JSON-safe dict for the events channel
+    (the inverse of :meth:`Tracer.ingest`).  Timestamps stay in the
+    SENDER's perf_counter domain — the receiver rebases with its
+    ClockSync offset for this peer."""
+    return {"name": s.name, "trace_id": s.trace_id,
+            "span_id": s.span_id, "parent_id": s.parent_id,
+            "track": s.track, "t0": s.t0, "t1": s.t1,
+            "tags": _tele.json_safe(s.tags)}
+
+
+class ClockSync:
+    """NTP-style offset estimator between this process's perf_counter
+    and a peer's (docs/observability.md, "Fleet observability").
+
+    Each :meth:`update` sample is one request/response round trip:
+    ``offset = remote_ts - (t_send + t_recv) / 2`` — the RTT-halving
+    assumption (symmetric paths).  The estimate served is the offset of
+    the MINIMUM-RTT sample in a sliding window: low-RTT exchanges bound
+    the asymmetry error tightest, and the window lets the estimate
+    track drift as old samples age out.  ``rebase`` maps a remote
+    timestamp onto the local timeline."""
+
+    __slots__ = ("_window", "offset", "rtt", "samples")
+
+    def __init__(self, window: int = 8):
+        self._window: "collections.deque[Tuple[float, float]]" = \
+            collections.deque(maxlen=int(window))
+        self.offset = 0.0
+        self.rtt: Optional[float] = None
+        self.samples = 0
+
+    def seed(self, offset: float) -> None:
+        """Coarse one-way estimate (the hello handshake timestamp,
+        unknown RTT).  Only used until the first real round-trip
+        sample — a one-way sample has no RTT bound, so it must never
+        outcompete measured ones in the min-RTT selection."""
+        if self.samples == 0:
+            self.offset = float(offset)
+
+    def update(self, t_send: float, remote_ts: float,
+               t_recv: float) -> float:
+        rtt = max(0.0, float(t_recv) - float(t_send))
+        off = float(remote_ts) - (float(t_send) + float(t_recv)) / 2.0
+        self._window.append((rtt, off))
+        self.samples += 1
+        self.rtt, self.offset = min(self._window, key=lambda s: s[0])
+        return self.offset
+
+    def rebase(self, remote_t: float) -> float:
+        """A remote perf_counter timestamp on the local timeline."""
+        return float(remote_t) - self.offset
+
+    def __repr__(self):
+        rtt = "?" if self.rtt is None else f"{self.rtt * 1e3:.3f}ms"
+        return (f"ClockSync(offset={self.offset * 1e3:.3f}ms, "
+                f"rtt={rtt}, samples={self.samples})")
+
 
 # ---------------------------------------------------------------------------
 # module-level tracer registry + enable gate
@@ -297,8 +402,23 @@ class Tracer:
 _enabled = False
 _trace_dir: Optional[str] = None
 _tracers: Dict[str, Tracer] = {}
+_remote_procs: Dict[int, str] = {}
 _reg_lock = threading.Lock()
 _atexit_registered = False
+
+
+def note_remote_process(pid: Optional[int], name: str) -> None:
+    """Name a remote pid whose spans this process ingests — becomes a
+    ``process_name`` metadata row in the Perfetto export, so worker
+    tracks render under "worker d1" instead of a bare pid."""
+    if pid is not None:
+        with _reg_lock:
+            _remote_procs[int(pid)] = str(name)
+
+
+def remote_processes() -> Dict[int, str]:
+    with _reg_lock:
+        return dict(_remote_procs)
 
 
 def enabled() -> bool:
@@ -359,6 +479,7 @@ def reset() -> None:
     global _trace_dir
     with _reg_lock:
         _tracers.clear()
+        _remote_procs.clear()
     _trace_dir = None
 
 
@@ -383,17 +504,21 @@ def chrome_events(include: Optional[List[str]] = None) -> List[dict]:
     carry either an explicit ``track`` (serve requests get one per
     request, so concurrent requests render as separate Perfetto rows
     instead of interleaving on one thread track) or the OS thread id
-    they ran on; each track gets a synthetic tid plus an ``"M"``
-    thread_name metadata event naming it."""
-    pid = os.getpid()
+    they ran on; each (process, track) pair gets a synthetic tid plus
+    an ``"M"`` thread_name metadata event naming it.  Spans ingested
+    from worker processes keep their origin pid, and every remote pid
+    named via :func:`note_remote_process` gets a ``process_name``
+    metadata row — one export, one Perfetto tree per request, one
+    process group per replica."""
+    local_pid = os.getpid()
     events: List[dict] = []
-    track_tids: Dict[str, int] = {}
+    track_tids: Dict[Tuple[int, str], int] = {}
     next_tid = itertools.count(1)
 
-    def tid_for(track: str) -> int:
-        t = track_tids.get(track)
+    def tid_for(pid: int, track: str) -> int:
+        t = track_tids.get((pid, track))
         if t is None:
-            t = track_tids[track] = next(next_tid)
+            t = track_tids[(pid, track)] = next(next_tid)
         return t
 
     names = include if include is not None else sorted(_tracers)
@@ -404,6 +529,7 @@ def chrome_events(include: Optional[List[str]] = None) -> List[dict]:
         for s in tracer.spans():
             if s.t1 is None:
                 continue
+            spid = s.pid if s.pid is not None else local_pid
             track = s.track if s.track is not None else f"{tname}"
             args = {"trace_id": s.trace_id, "span_id": s.span_id}
             if s.parent_id is not None:
@@ -413,12 +539,22 @@ def chrome_events(include: Optional[List[str]] = None) -> List[dict]:
                 "name": s.name, "ph": "X", "cat": tname,
                 "ts": round(_wall_us(s.t0), 3),
                 "dur": round((s.t1 - s.t0) * 1e6, 3),
-                "pid": pid, "tid": tid_for(track), "args": args,
+                "pid": spid, "tid": tid_for(spid, track), "args": args,
             })
     meta = [{"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
              "args": {"name": track}}
-            for track, tid in sorted(track_tids.items(),
-                                     key=lambda kv: kv[1])]
+            for (pid, track), tid in sorted(track_tids.items(),
+                                            key=lambda kv: kv[1])]
+    remote = remote_processes()
+    seen_pids = {pid for pid, _ in track_tids}
+    if remote and seen_pids - {local_pid}:
+        # merged multi-process export: name every process group
+        meta += [{"name": "process_name", "ph": "M", "pid": local_pid,
+                  "args": {"name": f"parent {local_pid}"}}]
+        meta += [{"name": "process_name", "ph": "M", "pid": pid,
+                  "args": {"name": pname}}
+                 for pid, pname in sorted(remote.items())
+                 if pid in seen_pids]
     # stable render order: metadata first, then spans by start time
     events.sort(key=lambda e: e["ts"])
     return meta + events

@@ -1,0 +1,477 @@
+"""Fleet observability plane (docs/observability.md, "Fleet
+observability"): clock-offset estimation, cross-process span shipping
+and ingestion, metrics federation via registry collectors, worker env
+scoping, the SLO burn-rate engine, and the diagnose trace merge.
+`serve` marker (tier-1, CPU) except the process-fleet e2e (slow)."""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+import mxnet_tpu as mx  # noqa: F401
+from mxnet_tpu import telemetry as tele
+from mxnet_tpu import tracing
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.serve import fleet as fleet_mod
+from mxnet_tpu.serve import wire
+from mxnet_tpu.slo import ENV_SLO_SPEC, Objective, SLOEngine
+
+pytestmark = pytest.mark.serve
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    tele.disable()
+    tele.registry().reset()
+    tracing.disable()
+    tracing.reset()
+    yield
+    tele.disable()
+    tele.registry().reset()
+    tracing.disable()
+    tracing.reset()
+
+
+# ---------------------------------------------------------------------------
+# clock-offset estimation
+# ---------------------------------------------------------------------------
+
+def test_clock_sync_rtt_halving_recovers_skew():
+    cs = tracing.ClockSync()
+    # peer clock runs 100 s ahead; symmetric 10 ms each way
+    t_send, skew = 50.0, 100.0
+    remote_ts = t_send + 0.010 + skew
+    off = cs.update(t_send, remote_ts, t_send + 0.020)
+    assert off == pytest.approx(skew, abs=1e-9)
+    assert cs.rtt == pytest.approx(0.020)
+    assert cs.samples == 1
+    # rebase maps the remote timestamp back onto the local timeline
+    assert cs.rebase(remote_ts) == pytest.approx(t_send + 0.010)
+
+
+def test_clock_sync_min_rtt_sample_wins():
+    cs = tracing.ClockSync()
+    cs.update(0.0, 10.0 + 0.5, 1.0)        # rtt 1.0, asymmetry-poisoned
+    cs.update(2.0, 12.0 + 0.001, 2.002)    # rtt 2 ms, tight bound
+    assert cs.rtt == pytest.approx(0.002)
+    assert cs.offset == pytest.approx(10.0, abs=1e-6)
+    # a later, WORSE sample must not displace the tight one
+    cs.update(4.0, 14.0 + 0.3, 4.6)
+    assert cs.offset == pytest.approx(10.0, abs=1e-6)
+    assert cs.samples == 3
+
+
+def test_clock_sync_window_ages_out_stale_minimum():
+    cs = tracing.ClockSync(window=2)
+    cs.update(0.0, 5.0, 0.002)             # offset ~5, rtt 2 ms
+    cs.update(1.0, 7.0, 1.010)             # drifted peer, rtt 10 ms
+    cs.update(2.0, 8.0, 2.010)             # window of 2: first sample gone
+    assert cs.offset != pytest.approx(5.0, abs=0.1)
+
+
+def test_clock_sync_seed_applies_only_before_first_round_trip():
+    cs = tracing.ClockSync()
+    cs.seed(42.0)
+    assert cs.offset == 42.0 and cs.samples == 0
+    cs.update(0.0, 10.0, 0.002)
+    assert cs.offset == pytest.approx(9.999, abs=1e-6)
+    cs.seed(99.0)                          # hello retry: must not regress
+    assert cs.offset == pytest.approx(9.999, abs=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# span shipping: wire round trip + ingestion
+# ---------------------------------------------------------------------------
+
+def test_span_round_trip_over_socketpair():
+    tracing.enable()
+    tr = tracing.get_tracer("serve")
+    s = tr.start_span("serve.worker", track="serve req 7",
+                      request_id=7, replica="d1")
+    child = tr.start_span("serve.queue", parent=s.context(),
+                          track="serve req 7", request_id=7)
+    child.finish()
+    s.finish()
+    rows = [tracing.span_to_wire(x) for x in tr.drain()]
+    assert len(rows) == 2
+    assert tr.drain() == []                # drain pops
+
+    a, b = socket.socketpair()
+    try:
+        wire.send_frame(a, {"ev": "obs", "spans": rows})
+        frame = wire.recv_frame(b, timeout=5.0)
+    finally:
+        a.close()
+        b.close()
+    got = frame["spans"]
+
+    offset = 100.0                         # worker clock 100 s ahead
+    tracing.note_remote_process(4242, "worker d1")
+    n = tr.ingest(got, offset=offset, pid=4242, replica="d1")
+    assert n == 2
+    ingested = {x.span_id: x for x in tr.spans()}
+    root = ingested[s.span_id]
+    kid = ingested[child.span_id]
+    assert root.trace_id == s.trace_id == kid.trace_id
+    assert kid.parent_id == root.span_id
+    assert root.pid == 4242 and kid.pid == 4242
+    assert root.tags["replica"] == "d1"
+    assert root.t0 == pytest.approx(s.t0 - offset, abs=1e-6)
+    assert root.t1 == pytest.approx(s.t1 - offset, abs=1e-6)
+
+    evs = tracing.chrome_events()
+    x = [e for e in evs if e.get("ph") == "X"]
+    assert {e["pid"] for e in x} == {4242}
+    procs = {e["pid"]: e["args"]["name"] for e in evs
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert procs[4242] == "worker d1"
+    assert procs[os.getpid()].startswith("parent")
+
+
+def test_ingest_skips_malformed_rows():
+    tracing.enable()
+    tr = tracing.get_tracer("serve")
+    good = {"name": "serve.worker", "trace_id": 9, "span_id": 10,
+            "parent_id": None, "track": "t", "t0": 1.0, "t1": 2.0,
+            "tags": {}}
+    assert tr.ingest([{"junk": True}, good, None]) == 1
+
+
+def test_span_ids_are_pid_salted():
+    tracing.enable()
+    s = tracing.get_tracer("serve").start_span("x")
+    s.finish()
+    assert s.span_id >> 32 == os.getpid() & 0xFFFFF
+    assert s.span_id < 2 ** 53              # JSON-safe
+
+
+# ---------------------------------------------------------------------------
+# metrics federation (registry collectors)
+# ---------------------------------------------------------------------------
+
+def _fed_snapshot():
+    return {"serve_replica_free_pages": {
+        "type": "gauge", "help": "h",
+        "series": [{"labels": {"replica": "d1"}, "value": 17.0}]}}
+
+
+def test_collector_series_render_and_retire():
+    tele.enable()
+    tele.counter("serve_requests_total", "h",
+                 labelnames=("state",)).inc(state="finished")
+    tele.registry().add_collector(_fed_snapshot)
+    text = tele.to_prometheus()
+    assert 'serve_replica_free_pages{replica="d1"} 17' in text
+    assert "serve_requests_total" in text
+    tele.registry().remove_collector(_fed_snapshot)
+    assert "serve_replica_free_pages" not in tele.to_prometheus()
+
+
+def test_collector_merges_into_existing_metric():
+    tele.enable()
+    tele.gauge("serve_replica_free_pages", "h",
+               labelnames=("replica",)).set(3.0, replica="local")
+    tele.registry().add_collector(_fed_snapshot)
+    text = tele.to_prometheus()
+    assert 'serve_replica_free_pages{replica="local"} 3' in text
+    assert 'serve_replica_free_pages{replica="d1"} 17' in text
+    # kind clash: the collector's copy is dropped, local survives
+    tele.registry().remove_collector(_fed_snapshot)
+
+    def clash():
+        return {"serve_replica_free_pages": {
+            "type": "counter",
+            "series": [{"labels": {}, "value": 1.0}]}}
+    tele.registry().add_collector(clash)
+    text = tele.to_prometheus()
+    assert 'serve_replica_free_pages{replica="local"} 3' in text
+    assert text.count("serve_replica_free_pages{") == 1
+
+
+def test_collector_failure_does_not_break_snapshot():
+    tele.enable()
+    tele.gauge("ok_gauge", "h").set(1.0)
+
+    def boom():
+        raise RuntimeError("collector died")
+    tele.registry().add_collector(boom)
+    assert "ok_gauge" in tele.registry().snapshot()
+
+
+# ---------------------------------------------------------------------------
+# worker env scoping (the port-collision / double-journal leak)
+# ---------------------------------------------------------------------------
+
+def test_worker_env_scopes_out_parent_observability(monkeypatch):
+    monkeypatch.setenv("MXTPU_METRICS_PORT", "9100")
+    monkeypatch.setenv("MXTPU_TELEMETRY", "1")
+    monkeypatch.setenv("MXTPU_TRACE_DIR", "/tmp/traces")
+    monkeypatch.setenv("MXTPU_SLO_SPEC", "[]")
+    monkeypatch.setenv("KEEP_ME", "1")
+    env = fleet_mod.worker_env()
+    for key in ("MXTPU_METRICS_PORT", "MXTPU_TELEMETRY",
+                "MXTPU_TRACE_DIR", "MXTPU_SLO_SPEC"):
+        assert key not in env, key
+    assert env["KEEP_ME"] == "1"
+    assert "MXTPU_WORKER_OBS" not in env    # nothing enabled here
+
+
+def test_worker_env_requests_worker_side_observability():
+    tele.enable()
+    assert fleet_mod.worker_env({})["MXTPU_WORKER_OBS"] == "telemetry"
+    tracing.enable()
+    assert fleet_mod.worker_env({})["MXTPU_WORKER_OBS"] == \
+        "telemetry,trace"
+    # stale value in the base env must not survive disablement
+    tele.disable()
+    tracing.disable()
+    assert "MXTPU_WORKER_OBS" not in \
+        fleet_mod.worker_env({"MXTPU_WORKER_OBS": "telemetry"})
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate engine
+# ---------------------------------------------------------------------------
+
+def _engine(**kw):
+    kw.setdefault("name", "lat")
+    kw.setdefault("signal", "latency_ms")
+    kw.setdefault("threshold", 100.0)
+    kw.setdefault("target", 0.9)
+    kw.setdefault("fast_s", 10.0)
+    kw.setdefault("slow_s", 100.0)
+    return SLOEngine([Objective(**kw)])
+
+
+def test_slo_spec_validation():
+    with pytest.raises(MXNetError):
+        Objective(name="x", signal="nope")
+    with pytest.raises(MXNetError):
+        Objective(name="x", signal="ttft_ms")          # no threshold
+    with pytest.raises(MXNetError):
+        Objective(name="x", signal="availability", target=1.5)
+    with pytest.raises(MXNetError):
+        Objective(name="x", signal="availability",
+                  fast_s=60, slow_s=10)                # fast > slow
+    with pytest.raises(MXNetError):
+        SLOEngine.from_spec('{"objectives": [{"name": "x", '
+                            '"signal": "availability", "bogus": 1}]}')
+    with pytest.raises(MXNetError):
+        SLOEngine.from_spec("not json, not a file")
+    eng = SLOEngine.from_spec(
+        '[{"name": "a", "signal": "availability"}]')
+    assert [o.name for o in eng.objectives()] == ["a"]
+
+
+def test_slo_from_env_and_file(monkeypatch, tmp_path):
+    monkeypatch.delenv(ENV_SLO_SPEC, raising=False)
+    assert SLOEngine.from_env() is None
+    spec = {"objectives": [{"name": "av", "signal": "availability",
+                            "target": 0.999}]}
+    p = tmp_path / "slo.json"
+    p.write_text(json.dumps(spec))
+    monkeypatch.setenv(ENV_SLO_SPEC, str(p))
+    eng = SLOEngine.from_env()
+    assert eng.objectives()[0].target == 0.999
+
+
+def test_slo_multi_window_burn_needs_both_windows():
+    eng = _engine(burn=2.0)
+    now = 1000.0
+    # old good traffic fills the slow window; one fresh bad sample
+    for i in range(9):
+        eng.observe("latency_ms", 10.0, ts=now - 50 - i)
+    eng.observe("latency_ms", 500.0, ts=now - 1)
+    r = eng.evaluate(now=now)["lat"]
+    # fast window: 1/1 bad -> burn 10x; slow: 1/10 -> burn exactly 1x
+    assert r["windows"]["fast"]["burn"] == pytest.approx(10.0)
+    assert r["windows"]["slow"]["burn"] == pytest.approx(1.0)
+    tele.enable()
+    eng.tick(now=now)
+    assert not eng.evaluate(now=now)["lat"]["alerting"]
+    # bad traffic saturating BOTH windows -> alert fires once
+    for i in range(5):
+        eng.observe("latency_ms", 500.0, ts=now - 2 - i)
+    eng.tick(now=now)
+    r = eng.evaluate(now=now)["lat"]
+    assert r["alerting"] and r["alerts"] == 1
+    snap = tele.snapshot()
+    assert any(s["labels"] == {"slo": "lat"} and s["value"] == 1.0
+               for s in snap["slo_burn_alerts_total"]["series"])
+    burn_series = snap["slo_burn_rate"]["series"]
+    assert {tuple(sorted(s["labels"].items()))
+            for s in burn_series} == {
+        (("slo", "lat"), ("window", "fast")),
+        (("slo", "lat"), ("window", "slow"))}
+    # recovery: windows drain -> alert clears, counter stays at 1
+    eng.tick(now=now + 500.0)
+    r = eng.evaluate(now=now + 500.0)["lat"]
+    assert not r["alerting"] and r["alerts"] == 1
+
+
+def test_slo_min_events_gates_thin_windows():
+    eng = _engine(min_events=3, burn=2.0)
+    eng.observe("latency_ms", 500.0, ts=100.0)
+    eng.tick(now=101.0)
+    assert not eng.evaluate(now=101.0)["lat"]["alerting"]
+
+
+def test_slo_event_mapping_and_origin_skip():
+    eng = SLOEngine([
+        Objective(name="av", signal="availability", target=0.9,
+                  fast_s=10, slow_s=100),
+        Objective(name="shed", signal="shed_rate", target=0.9,
+                  fast_s=10, slow_s=100),
+        Objective(name="rate", signal="decode_tok_s", threshold=100.0,
+                  target=0.9, fast_s=10, slow_s=100)])
+    eng.observe_event({"event": "request", "phase": "finished",
+                       "latency_ms": 50.0, "generated": 10})
+    eng.observe_event({"event": "request", "phase": "failed"})
+    eng.observe_event({"event": "request", "phase": "cancelled"})
+    eng.observe_event({"event": "request", "phase": "submitted"})
+    eng.observe_event({"event": "shed", "reason": "queue_full"})
+    # worker-re-emitted copies must not double-count
+    eng.observe_event({"event": "request", "phase": "failed",
+                       "origin": "worker"})
+    r = eng.evaluate()
+    av = r["av"]["windows"]["fast"]
+    assert av["events"] == 2 and av["bad"] == 1     # cancelled+origin skipped
+    sh = r["shed"]["windows"]["fast"]
+    assert sh["events"] == 2 and sh["bad"] == 1
+    rt = r["rate"]["windows"]["fast"]
+    # 10 tokens / 50 ms = 200 tok/s >= 100 -> good
+    assert rt["events"] == 1 and rt["bad"] == 0
+    eng.observe_event({"event": "request", "phase": "finished",
+                       "latency_ms": 1000.0, "generated": 10})
+    assert eng.evaluate()["rate"]["windows"]["fast"]["bad"] == 1
+
+
+def test_slo_tap_attach_detach():
+    tele.enable()
+    eng = _engine().attach()
+    try:
+        tele.event("request", phase="finished", latency_ms=50.0,
+                   generated=1)
+    finally:
+        eng.detach()
+    tele.event("request", phase="finished", latency_ms=50.0,
+               generated=1)
+    assert eng.evaluate()["lat"]["windows"]["slow"]["events"] == 1
+
+
+def test_slo_duplicate_objective_rejected():
+    eng = _engine()
+    with pytest.raises(MXNetError):
+        eng.add_objective(Objective(name="lat", signal="availability"))
+
+
+# ---------------------------------------------------------------------------
+# diagnose: multi-file trace merge
+# ---------------------------------------------------------------------------
+
+def test_diagnose_merges_per_process_traces(tmp_path):
+    parent = {"traceEvents": [
+        {"name": "serve.request", "ph": "X", "ts": 0, "dur": 5000,
+         "pid": 100, "tid": 1,
+         "args": {"request_id": 1, "state": "finished", "ttft_ms": 3.0}},
+        {"name": "serve.handoff", "ph": "X", "ts": 1000, "dur": 1000,
+         "pid": 100, "tid": 1, "args": {"request_id": 1}},
+        {"name": "process_name", "ph": "M", "pid": 100,
+         "args": {"name": "parent 100"}},
+        {"name": "process_name", "ph": "M", "pid": 200,
+         "args": {"name": "worker d1"}},
+        {"name": "serve.worker", "ph": "X", "ts": 500, "dur": 2000,
+         "pid": 200, "tid": 2, "args": {"request_id": 1}},
+    ], "otherData": {"pid": 100}}
+    orphan = {"traceEvents": [
+        {"name": "serve.queue", "ph": "X", "ts": 600, "dur": 100,
+         "pid": 300, "tid": 1, "args": {"request_id": 1}},
+        # the worker's OWN export of a span the parent also ingested:
+        # same (pid, tid) in two files, tracking different threads
+        {"name": "serve.queue", "ph": "X", "ts": 700, "dur": 100,
+         "pid": 200, "tid": 2, "args": {"request_id": 1}},
+    ], "otherData": {"pid": 300}}
+    (tmp_path / "trace_100.json").write_text(json.dumps(parent))
+    (tmp_path / "trace_300.json").write_text(json.dumps(orphan))
+    merged = tmp_path / "merged.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "diagnose.py"),
+         "--trace", str(tmp_path), "--merged-out", str(merged)],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    assert "handoff" in proc.stdout            # new TTFT column
+    doc = json.loads(merged.read_text())
+    names = {e["pid"]: e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert names == {100: "parent 100", 200: "worker d1",
+                     300: "trace_300"}
+    # tids remapped per source: the same (pid, tid) appearing in two
+    # files must not fold onto one merged thread row
+    w200 = {e["tid"] for e in doc["traceEvents"]
+            if e.get("ph") == "X" and e["pid"] == 200}
+    assert len(w200) == 2, w200
+
+
+# ---------------------------------------------------------------------------
+# e2e: one trace id across three processes (slow tier; `make
+# obsplane-smoke` is the tier-1 gate for the full plane)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_process_fleet_single_trace_id(tmp_path):
+    import numpy as onp
+    from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from mxnet_tpu.serve import ServeConfig, ServeFleet
+
+    journal = str(tmp_path / "journal.jsonl")
+    tele.enable(journal_path=journal)
+    tracing.enable(str(tmp_path))
+    cfg = GPTConfig(vocab_size=96, hidden_size=32, num_layers=2,
+                    num_heads=4, intermediate_size=64, max_position=64,
+                    dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.initialize()
+    model(mx.np.array([[1, 2]], dtype="int32"))
+    prompt = onp.random.RandomState(0).randint(0, 96, 5).tolist()
+    ref = onp.asarray(model.generate(
+        mx.np.array([prompt], dtype="int32"),
+        max_new_tokens=8).asnumpy())[0].tolist()
+
+    fleet = ServeFleet(model,
+                       config=ServeConfig(max_slots=2, page_size=4,
+                                          num_pages=0, prefill_chunk=4,
+                                          max_len=32),
+                       transport="process", disagg=(1, 1),
+                       stall_timeout=15.0)
+    try:
+        fleet.warmup()
+        fleet.start()
+        assert fleet.submit(prompt, max_new_tokens=8) \
+            .result(timeout=90) == ref
+        assert all(r.clock.samples >= 1 for r in fleet.replicas)
+        import time as _t
+        deadline = _t.time() + 15
+        pids = set()
+        while _t.time() < deadline:
+            evs = tracing.chrome_events()
+            xs = [e for e in evs if e.get("ph") == "X"]
+            roots = [e for e in xs if e["name"] == "serve.request"]
+            if roots:
+                tid_ = roots[0]["args"]["trace_id"]
+                pids = {e["pid"] for e in xs
+                        if e["args"].get("trace_id") == tid_}
+                if len(pids) >= 3:
+                    break
+            _t.sleep(0.5)
+        assert len(pids) >= 3, f"request tree spans only pids {pids}"
+    finally:
+        fleet.close()
+    rows = tele.RunJournal.read(journal)
+    assert any(r.get("event") == "cost_analysis"
+               and r.get("origin") == "worker" for r in rows)

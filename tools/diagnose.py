@@ -16,6 +16,15 @@ Also pretty-prints crash flight-recorder bundles (docs/observability.md,
                                                    # rollup (served /
                                                    # failovers / shed /
                                                    # p99 TTFT)
+    python tools/diagnose.py --trace <dir-or-files...> \
+        [--merged-out merged.json]  # merge per-process trace_<pid>.json
+                                    # exports into ONE Perfetto doc:
+                                    # tids are remapped per source file,
+                                    # every pid gets a process_name row
+                                    # (replica name when the parent
+                                    # registered one, else the source
+                                    # file), and the request table is
+                                    # computed over the union
 """
 from __future__ import annotations
 
@@ -186,19 +195,114 @@ def _pctl(sorted_vals, p):
                            math.ceil(p * (len(sorted_vals) - 1)))]
 
 
-def print_trace(path: str) -> int:
+def _load_trace(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, list):        # bare event array (older exports)
+        return {"traceEvents": doc, "otherData": {}}
+    return doc
+
+
+def merge_traces(paths, out=None) -> dict:
+    """Merge per-process `export_chrome` files into one Perfetto doc.
+
+    Each source keeps its events under its original pids, but tids are
+    remapped per (file, pid, tid) — two processes both counting tids
+    from 1 would otherwise fold distinct request tracks onto one
+    thread row.  Every pid ends up with exactly one `process_name`
+    metadata row: the name a source already carries (the parent's
+    export names replicas via `tracing.note_remote_process`) wins;
+    unnamed pids fall back to the source file's basename."""
+    import itertools
+    events = []
+    named: dict = {}                 # pid -> process name (first wins)
+    file_info = []                   # (path, pids_seen, otherData)
+    tid_map: dict = {}
+    next_tid = itertools.count(1)
+    for path in paths:
+        doc = _load_trace(path)
+        pids = set()
+        for e in doc.get("traceEvents", []):
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                name = (e.get("args") or {}).get("name")
+                if e.get("pid") is not None and name:
+                    named.setdefault(e["pid"], name)
+                continue             # re-emitted unified below
+            e = dict(e)
+            pid = e.get("pid")
+            if pid is not None:
+                pids.add(pid)
+            if e.get("tid") is not None:
+                key = (path, pid, e["tid"])
+                if key not in tid_map:
+                    tid_map[key] = next(next_tid)
+                e["tid"] = tid_map[key]
+            events.append(e)
+        file_info.append((path, pids, doc.get("otherData") or {}))
+    for path, pids, other in file_info:
+        label = os.path.splitext(os.path.basename(path))[0]
+        for pid in sorted(pids):
+            if pid not in named:
+                named[pid] = label if pid == other.get("pid") \
+                    else f"{label} pid {pid}"
+    events += [{"name": "process_name", "ph": "M", "pid": pid,
+                "args": {"name": name}}
+               for pid, name in sorted(named.items())]
+    doc = {"traceEvents": events, "displayTimeUnit": "ms",
+           "otherData": {"exporter": "tools/diagnose.py merge",
+                         "sources": [p for p, _, _ in file_info]}}
+    if out:
+        d = os.path.dirname(os.path.abspath(out))
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(out, "w") as f:
+            json.dump(doc, f)
+    return doc
+
+
+def print_trace(paths, merged_out=None) -> int:
     """Per-request / per-step timeline + critical-path summary from a
     Chrome trace exported by `mx.tracing.export_chrome` (docs/
-    observability.md, "Tracing & performance attribution")."""
+    observability.md, "Tracing & performance attribution").  `paths`
+    may be one file, several, or a directory of `trace_*.json` — more
+    than one source is merged (see `merge_traces`); `merged_out`
+    additionally writes the merged doc as a Perfetto-loadable file."""
+    if isinstance(paths, str):
+        paths = [paths]
+    expanded = []
+    for p in paths:
+        if os.path.isdir(p):
+            found = sorted(glob.glob(os.path.join(p, "trace_*.json")))
+            if not found:
+                print(f"no trace_*.json in {p}", file=sys.stderr)
+                return 1
+            expanded.extend(found)
+        else:
+            expanded.append(p)
     try:
-        with open(path) as f:
-            doc = json.load(f)
+        if len(expanded) == 1 and merged_out is None:
+            doc = _load_trace(expanded[0])
+        else:
+            doc = merge_traces(expanded, out=merged_out)
     except (OSError, json.JSONDecodeError) as e:
-        print(f"cannot read trace {path}: {e}", file=sys.stderr)
+        print(f"cannot read trace: {e}", file=sys.stderr)
         return 1
-    spans = [e for e in doc.get("traceEvents", doc if
-             isinstance(doc, list) else []) if e.get("ph") == "X"]
-    print(f"========== trace: {path} ==========")
+    label = expanded[0] if len(expanded) == 1 \
+        else f"{len(expanded)} files merged"
+    spans = [e for e in doc.get("traceEvents", [])
+             if e.get("ph") == "X"]
+    print(f"========== trace: {label} ==========")
+    if len(expanded) > 1:
+        for p in expanded:
+            print(f"  source  : {p}")
+        if merged_out:
+            print(f"  merged  : {merged_out}")
+        procs = {e["pid"]: (e.get("args") or {}).get("name")
+                 for e in doc.get("traceEvents", [])
+                 if e.get("ph") == "M"
+                 and e.get("name") == "process_name"}
+        for pid, name in sorted(procs.items()):
+            print(f"  process : {pid:>7}  {name}")
     print(f"spans     : {len(spans)}")
     if not spans:
         return 0
@@ -214,8 +318,8 @@ def print_trace(path: str) -> int:
     if reqs:
         print(f"---------- serve requests ({len(reqs)}) ----------")
         print(f"  {'req':>5} {'state':<10} {'queue':>9} {'prefill':>9} "
-              f"{'1st dec':>9} {'decode':>9} {'wire':>9} {'ttft':>9} "
-              f"{'total':>9}  (ms)")
+              f"{'1st dec':>9} {'decode':>9} {'wire':>9} {'handoff':>9} "
+              f"{'ttft':>9} {'total':>9}  (ms)")
         rows = []
         for rid in sorted(reqs):
             ss = reqs[rid]
@@ -231,18 +335,20 @@ def print_trace(path: str) -> int:
                          total("serve.first_decode"))
             # process transport: submit/cancel RPC wall (serve.rpc
             # spans tagged with the rid) — TTFT spent on the wire, not
-            # in the worker
+            # in the worker; disaggregation adds the KV-page handoff
+            # (export + import + submit_prefilled, one span per move)
             wire = total("serve.rpc")
+            handoff = total("serve.handoff")
             ttft = args.get("ttft_ms")
             if ttft is None:
                 ttft = q + pf + fd
             rows.append({"rid": rid, "queue": q, "prefill": pf,
                          "first_decode": fd, "wire": wire,
-                         "ttft": float(ttft)})
+                         "handoff": handoff, "ttft": float(ttft)})
             print(f"  {rid:>5} {str(args.get('state')):<10} {q:>9.2f} "
                   f"{pf:>9.2f} {fd:>9.2f} "
                   f"{total('serve.decode'):>9.2f} {wire:>9.2f} "
-                  f"{float(ttft):>9.2f} "
+                  f"{handoff:>9.2f} {float(ttft):>9.2f} "
                   f"{root['dur'] / 1e3:>9.2f}")
         # critical path at the tail: which phase owns the p99 TTFT
         ordered = sorted(rows, key=lambda r: r["ttft"])
@@ -255,11 +361,13 @@ def print_trace(path: str) -> int:
         print(f"  TTFT p50 = {p50:.2f} ms, p99 = {p99:.2f} ms")
         wire_pct = (f", {100 * worst['wire'] / denom:.0f}% wire"
                     if worst.get("wire") else "")
+        handoff_pct = (f", {100 * worst['handoff'] / denom:.0f}% "
+                       f"handoff" if worst.get("handoff") else "")
         print(f"  critical path @p99 (req {worst['rid']}): "
               f"{100 * worst['queue'] / denom:.0f}% queue wait, "
               f"{100 * worst['prefill'] / denom:.0f}% prefill, "
               f"{100 * worst['first_decode'] / denom:.0f}% first decode"
-              f"{wire_pct}")
+              f"{wire_pct}{handoff_pct}")
         # decode fast path (docs/serving.md "Speculative decoding &
         # prefix caching"): serve.step spans carry per-step draft/
         # accept/prefix-hit tags
@@ -444,7 +552,24 @@ def main():
     if "--journal" in sys.argv:
         return sys.exit(print_journal(_flag_operand("--journal")))
     if "--trace" in sys.argv:
-        return sys.exit(print_trace(_flag_operand("--trace")))
+        rest = sys.argv[sys.argv.index("--trace") + 1:]
+        paths, merged_out, i = [], None, 0
+        while i < len(rest):
+            if rest[i] == "--merged-out":
+                if i + 1 >= len(rest):
+                    print("usage: diagnose.py --trace <paths...> "
+                          "[--merged-out <file>]", file=sys.stderr)
+                    sys.exit(2)
+                merged_out = rest[i + 1]
+                i += 2
+            else:
+                paths.append(rest[i])
+                i += 1
+        if not paths:
+            print("usage: diagnose.py --trace <paths...> "
+                  "[--merged-out <file>]", file=sys.stderr)
+            sys.exit(2)
+        return sys.exit(print_trace(paths, merged_out=merged_out))
     if "--crash-dir" in sys.argv:
         d = _flag_operand("--crash-dir")
         newest = _newest_bundle(d)

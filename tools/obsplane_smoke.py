@@ -1,0 +1,319 @@
+#!/usr/bin/env python
+"""Fleet observability-plane smoke (`make obsplane-smoke`, wired into
+`make test`).
+
+CPU-only, <90 s end-to-end check of the cross-process observability
+plane (docs/observability.md, "Fleet observability") over a
+1-prefill + 2-decode process fleet:
+
+- **one trace id per request across three processes**: the router's
+  ``serve.request`` root (parent pid), the prefill worker's
+  ``serve.worker`` subtree, the parent-side ``serve.handoff`` span,
+  and the decode worker's adopted subtree all share the root's trace
+  id, every ``serve.worker`` span parents directly on the root, and
+  clock-rebased worker timestamps land inside the root's window;
+- ``tools/diagnose.py --trace <dir> --merged-out`` produces a loadable
+  merged Perfetto doc whose ``process_name`` metadata names the parent
+  and each worker pid;
+- **metrics federation**: worker registry snapshots ride heartbeats
+  and re-export on the parent's ``/metrics`` with a ``replica`` label
+  (asserted on worker-only ``serve_replica_free_pages`` series), and a
+  drained replica's series retire with it while survivors stay;
+- **SLO burn-rate engine**: a generous ``MXTPU_SLO_SPEC`` stays silent
+  through clean traffic, then an adaptive latency objective fires a
+  ``slo_burn`` journal event + ``slo_burn_alerts_total`` counter when
+  one decode worker is SIGSTOPped mid-stream (induced failover
+  latency) and then SIGKILLed — the victim request still finishes
+  bit-identical to the unbatched ``generate()`` oracle after respawn;
+- **cost-vector shipping**: ``cost_analysis`` rows from worker-process
+  compiles land in the parent's journal tagged ``origin=worker``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+# declarative objectives from the environment: generous thresholds
+# that a clean run must never trip (the burn assert below is two-sided)
+os.environ["MXTPU_SLO_SPEC"] = json.dumps({"objectives": [
+    {"name": "availability", "signal": "availability", "target": 0.99,
+     "fast_s": 30, "slow_s": 120},
+    {"name": "ttft_generous", "signal": "ttft_ms", "threshold": 120000,
+     "target": 0.99, "fast_s": 30, "slow_s": 120},
+]})
+os.environ["MXTPU_CLOCK_SYNC_INTERVAL"] = "2.0"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _metrics(port: int) -> str:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+        return r.read().decode()
+
+
+def main() -> int:
+    t_start = time.time()
+    tmp = tempfile.mkdtemp(prefix="mxtpu_obsplane_smoke_")
+    journal_path = os.path.join(tmp, "journal.jsonl")
+    trace_dir = os.path.join(tmp, "traces")
+
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry as tele
+    from mxnet_tpu import tracing as trace
+    from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from mxnet_tpu.serve import ServeConfig, ServeFleet
+    from mxnet_tpu.slo import Objective
+
+    tele.enable(journal_path=journal_path)
+    trace.enable(trace_dir)
+    srv = tele.serve_metrics(port=0)
+
+    cfg = GPTConfig(vocab_size=96, hidden_size=32, num_layers=2,
+                    num_heads=4, intermediate_size=64, max_position=64,
+                    dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.initialize()
+    model(mx.np.array([[1, 2]], dtype="int32"))
+
+    rng = onp.random.RandomState(47)
+    max_new = 10
+    n_req = 6
+    prompts = [rng.randint(0, 96, rng.randint(2, 10)).tolist()
+               for _ in range(n_req + 1)]        # [-1] is the victim
+    refs = []
+    for p in prompts:
+        ids = mx.np.array([p], dtype="int32")
+        refs.append(onp.asarray(
+            model.generate(ids, max_new_tokens=max_new)
+            .asnumpy())[0].tolist())
+
+    sc = ServeConfig(max_slots=2, page_size=4, num_pages=0,
+                     prefill_chunk=4, max_len=32)
+    fleet = ServeFleet(model, config=sc, transport="process",
+                       disagg=(1, 2), respawn_budget=2,
+                       stall_timeout=15.0)
+    assert fleet.slo is not None, "MXTPU_SLO_SPEC was not picked up"
+    assert {o.name for o in fleet.slo.objectives()} == \
+        {"availability", "ttft_generous"}
+    fleet.warmup()
+
+    streams = {i: [] for i in range(n_req + 1)}
+
+    def tok_cb(i):
+        return lambda t, r: streams[i].append(t)
+
+    try:
+        fleet.start()
+
+        # ---- phase A: clean traffic ----------------------------------
+        t0 = time.time()
+        handles = {i: fleet.submit(prompts[i], max_new_tokens=max_new,
+                                   on_token=tok_cb(i))
+                   for i in range(n_req)}
+        for i in range(n_req):
+            got = handles[i].result(timeout=90)
+            assert got == refs[i], \
+                f"request {i} diverged from the generate() oracle"
+        clean_max_ms = (time.time() - t0) * 1e3
+
+        for rep in fleet.replicas:
+            assert rep.clock.samples >= 1, \
+                f"{rep.name}: no round-trip clock sample ({rep.clock})"
+            assert abs(rep.clock.offset) < 60.0, rep.clock
+
+        # federation: worker-only series appear per replica on /metrics
+        want = [f'serve_replica_free_pages{{replica="{r.name}"}}'
+                for r in fleet.replicas]
+        deadline = time.time() + 20
+        text = ""
+        while time.time() < deadline:
+            text = _metrics(srv.port)
+            if all(w in text for w in want):
+                break
+            time.sleep(0.25)
+        missing = [w for w in want if w not in text]
+        assert not missing, f"federated series never appeared: {missing}"
+
+        # generous objectives stay silent through clean traffic
+        assert all(not e["alerting"]
+                   for e in fleet.slo.evaluate().values()), \
+            fleet.slo.evaluate()
+
+        # ---- trace: one id, three processes --------------------------
+        os.makedirs(trace_dir, exist_ok=True)
+        parent_export = os.path.join(trace_dir,
+                                     f"trace_{os.getpid()}.json")
+        deadline = time.time() + 15
+        trees = {}
+        while time.time() < deadline:
+            trace.export_chrome(parent_export)
+            with open(parent_export) as f:
+                evs = [e for e in json.load(f)["traceEvents"]
+                       if e.get("ph") == "X"]
+            roots = [e for e in evs if e["name"] == "serve.request"
+                     and (e.get("args") or {}).get("state") == "finished"]
+            trees = {}
+            for root in roots:
+                tid_ = root["args"]["trace_id"]
+                trees[tid_] = {"root": root,
+                               "events": [e for e in evs
+                                          if (e.get("args") or {})
+                                          .get("trace_id") == tid_]}
+            if len(trees) >= n_req and all(
+                    len({e["pid"] for e in t["events"]}) >= 3
+                    and any(e["name"] == "serve.handoff"
+                            for e in t["events"])
+                    for t in trees.values()):
+                break
+            time.sleep(0.5)
+        assert len(trees) >= n_req, \
+            f"only {len(trees)} finished request trees in the export"
+        for tid_, t in trees.items():
+            pids = {e["pid"] for e in t["events"]}
+            assert len(pids) >= 3, (
+                f"trace {tid_}: request tree spans pids {pids}, "
+                f"expected parent + prefill + decode")
+            root = t["root"]
+            workers = [e for e in t["events"]
+                       if e["name"] == "serve.worker"]
+            assert workers, f"trace {tid_}: no serve.worker spans"
+            for w in workers:
+                assert w["args"]["parent_id"] == \
+                    root["args"]["span_id"], (tid_, w)
+                assert w["pid"] != root["pid"], (tid_, w)
+            lo = root["ts"] - 250e3
+            hi = root["ts"] + root["dur"] + 250e3
+            for e in t["events"]:
+                assert lo <= e["ts"] <= hi, (
+                    f"trace {tid_}: span {e['name']} at {e['ts']} "
+                    f"outside rebased root window [{lo}, {hi}]")
+
+        merged = os.path.join(tmp, "merged.json")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "diagnose.py"),
+             "--trace", trace_dir, "--merged-out", merged],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, \
+            f"diagnose --trace failed: {proc.stderr[-2000:]}"
+        with open(merged) as f:
+            mdoc = json.load(f)
+        pnames = {e["pid"]: e["args"]["name"]
+                  for e in mdoc["traceEvents"]
+                  if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert len(pnames) >= 3, pnames
+        assert any("worker" in n for n in pnames.values()), pnames
+
+        # ---- phase B: induced failover latency -> burn ---------------
+        hold_s = min(clean_max_ms * 1.5 + 2000, 10000) / 1e3
+        fleet.slo.add_objective(Objective(
+            name="victim_latency", signal="latency_ms",
+            threshold=hold_s * 1e3 * 0.6, target=0.99,
+            fast_s=15.0, slow_s=60.0, burn=2.0, min_events=1))
+
+        vi = n_req
+        vh = fleet.submit(prompts[vi], max_new_tokens=max_new,
+                          on_token=tok_cb(vi))
+        decoders = [r for r in fleet.replicas
+                    if r.engine.role == "decode"]
+        victim = None
+        deadline = time.time() + 40
+        while victim is None and time.time() < deadline:
+            for rep in decoders:
+                sched = rep.engine.scheduler
+                with sched._lock:
+                    if any(len(e.req.tokens) >= 2
+                           for e in sched._ledger.values()):
+                        victim = rep
+                        break
+            time.sleep(0.002)
+        assert victim is not None, \
+            "no decode worker ever held the victim's stream"
+        victim_pid = victim.pid
+        os.kill(victim_pid, signal.SIGSTOP)   # stall: latency builds...
+        time.sleep(hold_s)
+        os.kill(victim_pid, signal.SIGKILL)   # ...then die mid-stream
+
+        deadline = time.time() + 30
+        while fleet.respawns == 0 and time.time() < deadline:
+            time.sleep(0.005)
+        assert fleet.deaths >= 1 and fleet.respawns >= 1
+
+        got = vh.result(timeout=90)
+        assert got == refs[vi], "victim diverged after failover"
+        assert streams[vi] == refs[vi][len(prompts[vi]):], \
+            "victim stream re-emitted or lost tokens across failover"
+
+        deadline = time.time() + 15
+        ev = {}
+        while time.time() < deadline:
+            ev = fleet.slo.evaluate().get("victim_latency", {})
+            if ev.get("alerts", 0) >= 1:
+                break
+            time.sleep(0.1)
+        assert ev.get("alerts", 0) >= 1, \
+            f"victim_latency burn alert never fired: {ev}"
+
+        # ---- retirement: drain a survivor, its series vanish ---------
+        survivor = next(r for r in decoders if r.name != victim.name)
+        keeper = next(r for r in fleet.replicas
+                      if r.name not in (survivor.name,))
+        assert fleet.drain(survivor.name, timeout=60), \
+            f"{survivor.name} never drained"
+        gone = f'serve_replica_free_pages{{replica="{survivor.name}"}}'
+        kept = f'serve_replica_free_pages{{replica="{keeper.name}"}}'
+        text = _metrics(srv.port)
+        assert gone not in text, \
+            f"drained {survivor.name} series still on /metrics"
+        assert kept in text, \
+            f"surviving {keeper.name} series retired with the drain"
+
+        time.sleep(1.0)   # let final heartbeat obs batches land
+    finally:
+        fleet.close()
+        srv.stop()
+
+    # ---- journal contract --------------------------------------------
+    rows = tele.RunJournal.read(journal_path)
+    burns = [r for r in rows if r.get("event") == "slo_burn"]
+    assert burns and all(r.get("slo") == "victim_latency"
+                         for r in burns), (
+        f"expected victim_latency burn rows only, got "
+        f"{[r.get('slo') for r in burns]}")
+    snap = tele.snapshot()
+    alerts = snap.get("slo_burn_alerts_total", {}).get("series", [])
+    assert any(s["labels"].get("slo") == "victim_latency"
+               and s["value"] >= 1 for s in alerts), alerts
+    costs = [r for r in rows if r.get("event") == "cost_analysis"
+             and r.get("origin") == "worker"]
+    assert costs, "no worker-process cost_analysis rows in the journal"
+    assert all(r.get("replica") for r in costs[:8]), costs[0]
+
+    elapsed = time.time() - t_start
+    print(json.dumps({
+        "obsplane_smoke": "ok", "requests": n_req + 1,
+        "trace_trees": len(trees),
+        "processes_in_merge": len(pnames),
+        "burn_alerts": int(sum(s["value"] for s in alerts)),
+        "worker_cost_rows": len(costs),
+        "deaths": fleet.deaths, "respawns": fleet.respawns,
+        "elapsed_s": round(elapsed, 1)}))
+    assert elapsed < 90, f"smoke took {elapsed:.0f}s (budget 90s)"
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
