@@ -642,6 +642,93 @@ def _measure_serve_fleet(replicas: int, kill_at: float,
     }
 
 
+def _measure_serve_replay(trace_path: str, replicas: int,
+                          speed: float = 0.0,
+                          kill_at: float = None,
+                          kill_mode: str = "thread") -> dict:
+    """`bench.py --serve --trace FILE [--speed X] [--kill-at S]
+    [--replicas N] [--kill-mode thread|process]`: re-drive a recorded
+    traffic journal or generated workload trace (docs/serving.md,
+    "Flight recorder & replay") through a fresh fleet and report the
+    divergence summary — matched vs divergent token-stream digests plus
+    recorded-vs-replayed TTFT/latency percentiles.  The trace is served
+    with the bench model, so digest verification only applies when the
+    trace was recorded against it (a re-recorded bench trace, or one
+    produced by ``--gen-trace`` + a previous ``--trace`` run)."""
+    import jax
+    ambient = os.environ.get("JAX_PLATFORMS", "").lower()
+    if not any(t in ambient for t in ("tpu", "axon")):
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from mxnet_tpu.serve import ServeConfig, ServeFleet
+    from mxnet_tpu.serve import traffic as _traffic
+    from mxnet_tpu.serve.replay import replay_trace
+
+    meta, arrivals, outcomes = _traffic.read_trace(trace_path)
+    if not arrivals:
+        raise SystemExit(f"--trace {trace_path}: no arrival rows")
+    dev = jax.devices()[0]
+    on_accel = dev.platform.lower() == "tpu"
+    if on_accel:
+        cfg = GPTConfig(vocab_size=32000, hidden_size=1024, num_layers=8,
+                        num_heads=16, intermediate_size=4096,
+                        max_position=1024, dropout=0.0, dtype="bfloat16")
+        max_len = 512
+    else:
+        cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                        num_heads=4, intermediate_size=128,
+                        max_position=256, dropout=0.0)
+        max_len = 128
+    top = max(max(a["prompt"], default=0) for a in arrivals)
+    if top >= cfg.vocab_size:
+        raise SystemExit(
+            f"--trace {trace_path}: prompt token {top} >= bench vocab "
+            f"{cfg.vocab_size} — this trace was not recorded against "
+            f"the bench model")
+    model = GPTForCausalLM(cfg)
+    model.initialize()
+    model(mx.np.array([[1, 2]], dtype="int32"))
+
+    fleet = ServeFleet(model, replicas=replicas,
+                       config=ServeConfig(max_len=max_len),
+                       transport=kill_mode)
+    compile_s = fleet.warmup()
+    with fleet:
+        report = replay_trace(fleet, (meta, arrivals, outcomes),
+                              speed=speed, kill_at=kill_at,
+                              timeout=600.0)
+    extras = {
+        "trace": os.path.abspath(trace_path),
+        "mode": report["mode"],
+        "requests": report["requests"],
+        "submitted": report["submitted"],
+        "digest_matched": len(report["matched"]),
+        "digest_divergent": len(report["divergent"]),
+        "unverified": len(report["unverified"]),
+        "replay_failed": len(report["replay_failed"]),
+        "shed_replay": len(report["shed_replay"]),
+        "kill": report["kill"],
+        "ttft_ms": report["ttft_ms"],
+        "latency_ms": report["latency_ms"],
+        "compile_seconds": round(compile_s, 2),
+        "replicas": replicas,
+        "kill_mode": kill_mode,
+        "ok": report["ok"],
+        "device": getattr(dev, "device_kind", str(dev)),
+        "platform": dev.platform,
+    }
+    return {
+        "metric": "serve_replay_wall_s",
+        "value": report["replay_wall_s"],
+        "unit": "seconds",
+        "vs_baseline": 0.0,
+        "extras": extras,
+    }
+
+
 def _pct_of(vals, p):
     vals = sorted(vals)
     if not vals:
@@ -1507,6 +1594,29 @@ def main():
         with _ClaimLock():
             print(json.dumps(_measure_data()))
         return
+    if "--gen-trace" in sys.argv:
+        # deterministic workload generation (docs/serving.md "Flight
+        # recorder & replay"): emit a journal-format trace as a pure
+        # function of --seed — no device work, no claim lock
+        from mxnet_tpu.serve import traffic as _traffic
+        overrides = {}
+        for flag, field, cast in (("--seed", "seed", int),
+                                  ("--requests", "requests", int),
+                                  ("--rps", "rate_rps", float),
+                                  ("--burst", "burst_factor", float),
+                                  ("--prefix-frac", "prefix_frac", float)):
+            if flag in sys.argv:
+                overrides[field] = cast(_flag_operand(flag, "0"))
+        wspec = _traffic.WorkloadSpec.from_env(**overrides)
+        path = _flag_operand("--gen-trace", "trace.jsonl")
+        rows = _traffic.generate_workload(wspec)
+        _traffic.write_trace(rows, path, wspec)
+        print(json.dumps({"trace": os.path.abspath(path),
+                          "requests": len(rows),
+                          "seed": wspec.seed,
+                          "span_s": round(rows[-1]["ts_mono"], 3)
+                          if rows else 0.0}))
+        return
     if "--serve" in sys.argv:
         # a direct user entry point that may claim the TPU — go through
         # the same exclusive claim lock as the orchestrated bench (two
@@ -1519,7 +1629,23 @@ def main():
             # (docs/serving.md "Speculative decoding & prefix caching")
             spec = int(_flag_operand("--spec", "0")) \
                 if "--spec" in sys.argv else 0
-            if "--disagg" in sys.argv:
+            if "--trace" in sys.argv:
+                # replay mode: re-drive a recorded/generated trace and
+                # report digest divergence (docs/serving.md "Flight
+                # recorder & replay")
+                kill_mode = _flag_operand("--kill-mode", "thread")
+                if kill_mode not in ("thread", "process"):
+                    raise SystemExit(
+                        f"--kill-mode must be thread|process, "
+                        f"got {kill_mode!r}")
+                print(json.dumps(_measure_serve_replay(
+                    _flag_operand("--trace", "trace.jsonl"),
+                    int(_flag_operand("--replicas", "2")),
+                    speed=float(_flag_operand("--speed", "0")),
+                    kill_at=(float(_flag_operand("--kill-at", "0"))
+                             if "--kill-at" in sys.argv else None),
+                    kill_mode=kill_mode)))
+            elif "--disagg" in sys.argv:
                 # prefill/decode disaggregation: P prefill + D decode
                 # replicas, tp-sharded decode (docs/serving.md
                 # "Disaggregated serving"); --tp defaults to 2 so the

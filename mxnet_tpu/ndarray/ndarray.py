@@ -444,13 +444,19 @@ class ndarray:
         return apply_op(lambda x: fn(x, *args, **kwargs), (self,), {},
                         name=getattr(fn, "__name__", "method"))
 
+    # sum/mean delegate to the module-level np reductions so BOTH
+    # surfaces share the f16 accumulate-at-f32 rule (a float16 array
+    # reduced via the method must not silently accumulate at half
+    # precision while np.sum of the same array upcasts)
     def sum(self, axis=None, dtype=None, out=None, keepdims=False):
-        r = self._method(jnp.sum, axis=axis, dtype=dtype, keepdims=keepdims)
-        return _write_out(r, out)
+        from ..numpy import sum as _np_sum
+        return _np_sum(self, axis=axis, dtype=dtype, out=out,
+                       keepdims=keepdims)
 
     def mean(self, axis=None, dtype=None, out=None, keepdims=False):
-        r = self._method(jnp.mean, axis=axis, dtype=dtype, keepdims=keepdims)
-        return _write_out(r, out)
+        from ..numpy import mean as _np_mean
+        return _np_mean(self, axis=axis, dtype=dtype, out=out,
+                        keepdims=keepdims)
 
     def max(self, axis=None, out=None, keepdims=False):
         return _write_out(self._method(jnp.max, axis=axis, keepdims=keepdims), out)

@@ -722,7 +722,12 @@ def _acc_f16(jfn_name, x, axis, dtype, out, keepdims, where=None,
             r = getattr(jnp, jfn_name)(v.astype(jnp.float32), **kw)
             if initial is not None and jfn_name == "sum":
                 r = r + jnp.asarray(initial, jnp.float32)
-            return r.astype(jnp.float16)
+            # dtype=None means "same as input" — and the input seen HERE
+            # may have been widened by the AMP cast hook (sum/mean sit on
+            # the fp32 deny list), in which case the result must stay
+            # wide; only an explicit dtype=float16 pins the output
+            out_dt = jnp.float16 if dtype is not None else v.dtype
+            return r.astype(out_dt)
         return _write_out(apply_op(fn, tuple(arrs), {}, name=jfn_name), out)
     gen = _sum_gen if jfn_name == "sum" else _mean_gen
     kw = {"axis": axis, "dtype": dtype, "out": out, "keepdims": keepdims}

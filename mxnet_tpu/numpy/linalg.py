@@ -41,6 +41,11 @@ for _name in _ALIAS_NAMES:
 
 
 _matrix_transpose_w = _g.get("matrix_transpose")
+if _matrix_transpose_w is None:
+    # older jax without jnp.linalg.matrix_transpose: same semantics as
+    # the array-API definition — swap the last two axes
+    _matrix_transpose_w = wrap_fn(lambda x: jnp.swapaxes(x, -1, -2),
+                                  "matrix_transpose")
 
 
 def matrix_transpose(x):

@@ -830,11 +830,21 @@ def reshape(a, newshape, reverse=False, order="C"):
     -1 infer, -2 copy one input dim, -3 drop a size-1 dim, -4 splice all
     remaining input dims, -5 merge two consecutive dims, -6 split one dim
     into the next two spec values; reverse=True matches from the right."""
-    in_shape = tuple(a.shape)
+    orig_shape = tuple(a.shape)
+    in_shape = orig_shape
     spec = [newshape] if isinstance(newshape, int) else list(newshape)
     if reverse:
         in_shape = in_shape[::-1]
         spec = spec[::-1]
+
+    def _need_dims(idx, code):
+        # reference-style error instead of a raw IndexError when a
+        # special code consumes more input dims than the array has
+        if idx >= len(in_shape):
+            raise MXNetError(
+                f"npx.reshape {code}: special code consumes input dim "
+                f"{idx} but input has only {len(in_shape)} dims "
+                f"(shape {orig_shape})")
 
     out = []
     i = 0
@@ -845,15 +855,23 @@ def reshape(a, newshape, reverse=False, order="C"):
             out.extend(in_shape[i:])
             i = len(in_shape)
         elif sv == -2:
+            _need_dims(i, -2)
             out.append(in_shape[i]); i += 1
         elif sv == -3:
+            _need_dims(i, -3)
             if in_shape[i] != 1:
                 raise MXNetError(
                     f"npx.reshape -3: input dim {i} is {in_shape[i]}, not 1")
             i += 1
         elif sv == -5:
+            _need_dims(i + 1, -5)
             out.append(in_shape[i] * in_shape[i + 1]); i += 2
         elif sv == -6:
+            _need_dims(i, -6)
+            if j + 2 >= len(spec):
+                raise MXNetError(
+                    f"npx.reshape -6: needs two following spec values, "
+                    f"got {spec[j + 1:]} (newshape {tuple(spec)})")
             d = in_shape[i]; i += 1
             av, bv = spec[j + 1], spec[j + 2]
             if av == -1:
@@ -885,6 +903,16 @@ def reshape(a, newshape, reverse=False, order="C"):
                 f"npx.reshape: cannot infer -1 — {total} elements do "
                 f"not divide by the known dims product {known}")
         out[out.index(-1)] = total // known
+    else:
+        # no inferred dim: the resolved output must cover the input
+        # exactly — raise the reference-style error here instead of
+        # letting jnp.reshape fail later inside the traced op
+        import math as _math
+        if _math.prod(out) != _math.prod(in_shape):
+            raise MXNetError(
+                f"npx.reshape: cannot reshape array of shape "
+                f"{orig_shape} ({_math.prod(in_shape)} elements) into "
+                f"shape {tuple(out)} ({_math.prod(out)} elements)")
     return apply_op(lambda x: jnp.reshape(x, tuple(out)), (a,), {},
                     name="npx.reshape")
 

@@ -11,7 +11,12 @@ stack.  `fleet`/`router` stack the robustness tier on top: a supervised
 fleet of N engine replicas behind a load-aware `RequestRouter` with
 mid-stream failover (a dead replica's streams resume bit-identical on a
 survivor), graceful draining, and overload shedding — see docs/serving.md
-"Fleet, failover & overload".  The attention primitive lives in
+"Fleet, failover & overload".  `traffic`/`replay` close the incident
+loop: an append-only traffic journal at the router boundary, a seeded
+workload generator emitting the same format, deterministic replay with
+divergence reports, and SLO-triggered incident capsules — see
+docs/serving.md "Flight recorder & replay".  The attention primitive
+lives in
 `ops/pallas/paged_attention.py` (Pallas TPU kernel + dense reference), and
 the transformer decode math (`decode`) is shared with
 `GPTForCausalLM.generate` so serving and single-model generation can never
@@ -27,6 +32,11 @@ from .engine import InferenceEngine, ServeConfig  # noqa: F401
 from .router import RequestRouter, ShedError  # noqa: F401
 from .fleet import ProcessReplica, Replica, ServeFleet  # noqa: F401
 from .wire import WireClient, WireError, WireTimeout  # noqa: F401
+from .traffic import (  # noqa: F401
+    TrafficJournal, WorkloadSpec, generate_workload, write_trace,
+    read_trace, stream_digest, read_capsule,
+)
+from .replay import replay_trace, replay_capsule  # noqa: F401
 
 __all__ = [
     "InferenceEngine", "ServeConfig", "ContinuousBatchingScheduler",
@@ -35,4 +45,7 @@ __all__ = [
     "transformer_step", "lm_logits",
     "ServeFleet", "Replica", "ProcessReplica", "RequestRouter",
     "ShedError", "WireClient", "WireError", "WireTimeout",
+    "TrafficJournal", "WorkloadSpec", "generate_workload",
+    "write_trace", "read_trace", "stream_digest", "read_capsule",
+    "replay_trace", "replay_capsule",
 ]

@@ -68,6 +68,7 @@ from .. import telemetry as _tele
 from .. import tracing as _trace
 from .engine import InferenceEngine, ServeConfig, _env_int
 from .router import RequestRouter
+from . import traffic as _traffic
 from .scheduler import (ContinuousBatchingScheduler, ServeRequest,
                         deliver_token, expire_request, finish_request,
                         terminate_request)
@@ -88,7 +89,8 @@ ENV_CLOCK_SYNC = "MXTPU_CLOCK_SYNC_INTERVAL"
 #: (or clobber) the parent's files, and an inherited SLO spec would
 #: run a second, conflicting burn evaluator per worker
 _SCOPED_ENV = ("MXTPU_METRICS_PORT", "MXTPU_TELEMETRY",
-               "MXTPU_TRACE", "MXTPU_TRACE_DIR", "MXTPU_SLO_SPEC")
+               "MXTPU_TRACE", "MXTPU_TRACE_DIR", "MXTPU_SLO_SPEC",
+               "MXTPU_TRAFFIC_JOURNAL", "MXTPU_CAPSULE_DIR")
 
 
 def worker_env(base: Optional[dict] = None) -> dict:
@@ -844,6 +846,16 @@ class ServeFleet:
         self.slo: Optional[_slo.SLOEngine] = _slo.SLOEngine.from_env()
         if self.slo is not None:
             self.slo.attach()
+        # incident capsules (MXTPU_CAPSULE_DIR): a burn alert snapshots
+        # a bounded, replayable capsule; the supervisor finalizes it
+        # once the post-alert window lapses so in-flight requests'
+        # outcomes (and digests) land in the traffic window
+        self.capsule_dir = \
+            os.environ.get(_traffic.ENV_CAPSULE_DIR, "").strip() or None
+        self.capsules: List[str] = []
+        self._pending_capsules: List[Tuple[str, float]] = []
+        if self.slo is not None and self.capsule_dir:
+            self.slo.add_alert_listener(self._on_slo_alert)
 
     def _role_for(self, idx: int) -> str:
         if self.disagg is not None:
@@ -1042,6 +1054,11 @@ class ServeFleet:
                     state="failed", phase="failover_failed",
                     generated=len(req.tokens))
         self.router.fail_all_parked("fleet closed")
+        # flush pending incident capsules now — a short-lived fleet must
+        # not lose the traffic window to an un-lapsed post-alert timer
+        self._finalize_due_capsules(force=True)
+        if self.slo is not None:
+            self.slo.remove_alert_listener(self._on_slo_alert)
         if self._listener is not None:
             self._listener.close()
         if self._spec_path is not None:
@@ -1064,13 +1081,14 @@ class ServeFleet:
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 20, greedy: bool = True,
                temperature: float = 1.0, eos_token_id=None, on_token=None,
-               deadline_ms: Optional[float] = None) -> ServeRequest:
+               deadline_ms: Optional[float] = None,
+               tenant: Optional[str] = None) -> ServeRequest:
         """Route one request into the fleet (may raise `ShedError` under
         overload — callers retry after `.retry_after_ms`)."""
         return self.router.submit(
             prompt, max_new_tokens, greedy=greedy, temperature=temperature,
             eos_token_id=eos_token_id, on_token=on_token,
-            deadline_ms=deadline_ms)
+            deadline_ms=deadline_ms, tenant=tenant)
 
     def quiesce(self, timeout: float = 120.0) -> bool:
         """Block until no request is parked, queued, or active anywhere
@@ -1586,11 +1604,67 @@ class ServeFleet:
             self.router.sweep_expired()
             if self.slo is not None:
                 self.slo.tick()
+            self._finalize_due_capsules()
             self._update_fleet_gauges()
 
     # ------------------------------------------------------------------
     # observability
     # ------------------------------------------------------------------
+    def _on_slo_alert(self, name: str, entry: dict) -> None:
+        """SLO burn-alert listener (runs on the supervisor's tick): snap
+        an incident capsule NOW — metrics/trace/topology at alert time —
+        and queue it for traffic-window finalization once the post-alert
+        window lapses."""
+        spec_dir = None
+        try:
+            spec_dir = self._write_spec()
+        except Exception:   # capsules degrade, never break the sweep
+            _log.warning("capsule: model spec snapshot failed",
+                         exc_info=True)
+        try:
+            topology = {
+                "replicas": len(self.replicas),
+                "transport": self.transport,
+                "disagg": self.disagg,
+                "tp": self.config.tp,
+                "serve_config": dataclasses.asdict(self.config),
+            }
+            slo_spec = {"objectives": [dataclasses.asdict(o)
+                                       for o in self.slo.objectives()]}
+            path = _traffic.begin_capsule(
+                self.capsule_dir, name, entry, self.stats(), topology,
+                slo_spec=slo_spec, spec_dir=spec_dir)
+        except Exception:
+            _log.warning("capsule: snapshot failed", exc_info=True)
+            return
+        _, post_s = _traffic._capsule_windows()
+        with self._lock:
+            self.capsules.append(path)
+            self._pending_capsules.append(
+                (path, time.perf_counter() + post_s))
+        if _tele.enabled():
+            _tele.counter("serve_capsules_total",
+                          "Incident capsules written").inc()
+            _tele.event("capsule", slo=name, path=path)
+        _log.warning("SLO %s: incident capsule begun at %s", name, path)
+
+    def _finalize_due_capsules(self, force: bool = False) -> None:
+        """Write the traffic window into capsules whose post-alert
+        window has lapsed (`force` flushes them all — fleet close)."""
+        now = time.perf_counter()
+        with self._lock:
+            due = [p for p, t in self._pending_capsules
+                   if force or now >= t]
+            self._pending_capsules = [
+                (p, t) for p, t in self._pending_capsules
+                if not (force or now >= t)]
+        for path in due:
+            try:
+                _traffic.finalize_capsule(path)
+            except Exception:
+                _log.warning("capsule: finalize failed for %s", path,
+                             exc_info=True)
+
     def _journal_replica(self, rep: Replica, phase: str, **fields):
         if _tele.enabled():
             _tele.event("replica", replica=rep.name, phase=phase,
@@ -1650,4 +1724,5 @@ class ServeFleet:
             "respawn_budget": self.respawn_budget,
             "retired": [r.name for r in self.retired],
             "slo": self.slo.evaluate() if self.slo is not None else None,
+            "capsules": list(self.capsules),
         }

@@ -1,0 +1,299 @@
+"""Deterministic replay: re-drive a `ServeFleet` from a traffic trace
+(docs/serving.md, "Flight recorder & replay").
+
+A trace is any file in the traffic-journal format — a live capture
+(``MXTPU_TRAFFIC_JOURNAL``), a generated workload
+(`traffic.generate_workload`), or the ``traffic.jsonl`` window inside
+an incident capsule.  `replay_trace` submits the recorded arrivals
+against a fresh fleet — timing-faithful (``speed > 0`` scales the
+recorded inter-arrival gaps by ``1/speed``) or as-fast-as-possible
+(``speed == 0``) — and returns a **divergence report**: every greedy
+stream with a recorded ``finished`` digest must reproduce it
+bit-for-bit (the eviction/failover invariant makes this hold across
+thread/process transports, disagg splits, and tensor-parallel decode),
+with recorded-vs-replayed TTFT/latency percentiles side by side.
+
+Chaos re-injection: ``kill_at=T`` kills a replica when the trace clock
+passes ``T`` (deterministically placed in the arrival sequence, so it
+reproduces a failover incident in either timing mode).
+
+`replay_capsule` is the incident loop's last mile: it rebuilds the
+fleet from the capsule's own model/serving spec, swaps in the
+capsule's SLO objectives, and replays the captured window — the
+original burn alert should re-fire from the traffic shape alone.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import time
+from typing import Dict, List, Optional
+
+from ..base import MXNetError
+from .. import telemetry as _tele
+from .. import slo as _slo
+from . import traffic as _traffic
+from .engine import ServeConfig
+from .router import ShedError
+
+__all__ = ["replay_trace", "replay_capsule"]
+
+#: give up on one replayed request after this many shed-retries
+_MAX_SHED_RETRIES = 50
+
+
+def _pctl(sorted_vals: List[float], p: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    k = max(0, min(len(sorted_vals) - 1,
+                   math.ceil(p / 100.0 * len(sorted_vals)) - 1))
+    return sorted_vals[k]
+
+
+def _dist(vals: List[float]) -> Optional[dict]:
+    vals = sorted(v for v in vals if v is not None)
+    if not vals:
+        return None
+    return {"n": len(vals),
+            "p50": round(_pctl(vals, 50), 3),
+            "p90": round(_pctl(vals, 90), 3),
+            "p99": round(_pctl(vals, 99), 3),
+            "max": round(vals[-1], 3)}
+
+
+def replay_trace(fleet, trace, *, speed: float = 0.0,
+                 kill_at: Optional[float] = None,
+                 kill_replica: Optional[str] = None,
+                 timeout: float = 120.0,
+                 wait_slo_s: float = 0.0) -> dict:
+    """Re-drive `fleet` through `trace` (a path, or the
+    ``(meta, arrivals, outcomes)`` tuple from `traffic.read_trace`) and
+    return the divergence report.
+
+    ``speed``: 0 = as fast as possible; X > 0 = timing-faithful at X×
+    recorded speed (recorded deadlines are rescaled by 1/X; in AFAP
+    mode deadlines are dropped — wall-clock budgets are meaningless
+    when the clock is compressed).
+    ``kill_at``: trace-relative seconds; fires `fleet.kill` on
+    ``kill_replica`` (default: first replica) when the trace clock
+    passes it.
+    ``wait_slo_s``: after draining, poll ``fleet.slo`` up to this long
+    for burn alerts before embedding its state in the report.
+    """
+    if isinstance(trace, (str, os.PathLike)):
+        meta, arrivals, outcomes = _traffic.read_trace(str(trace))
+    else:
+        meta, arrivals, outcomes = trace
+    if not arrivals:
+        raise MXNetError("replay_trace: trace has no arrival rows")
+    t0_trace = min(a["ts_mono"] or 0.0 for a in arrivals)
+
+    kill_done = kill_at is None
+    if kill_replica is None and fleet.replicas:
+        kill_replica = fleet.replicas[0].name
+
+    def _maybe_kill(trace_now: float) -> Optional[dict]:
+        nonlocal kill_done
+        if not kill_done and trace_now >= kill_at:
+            kill_done = True
+            fleet.kill(kill_replica,
+                       error=f"replay chaos kill at t={kill_at:g}s")
+            return {"replica": kill_replica, "at_s": kill_at}
+        return None
+
+    t0 = time.perf_counter()
+    handles: Dict[int, object] = {}      # original rid -> ServeRequest
+    shed_replay: List[dict] = []
+    retries = 0
+    kill_info = None
+    for a in arrivals:
+        offset = (a["ts_mono"] or 0.0) - t0_trace
+        kill_info = _maybe_kill(offset) or kill_info
+        if speed > 0:
+            due = t0 + offset / speed
+            while True:
+                now = time.perf_counter()
+                if now >= due:
+                    break
+                time.sleep(min(0.05, due - now))
+        deadline = None
+        if speed > 0 and a.get("deadline_ms"):
+            deadline = float(a["deadline_ms"]) / speed
+        req = None
+        for _ in range(_MAX_SHED_RETRIES):
+            try:
+                req = fleet.submit(
+                    a["prompt"], max_new_tokens=a.get("max_new", 20),
+                    greedy=bool(a.get("greedy", True)),
+                    temperature=float(a.get("temperature", 1.0)),
+                    eos_token_id=a.get("eos_token_id"),
+                    deadline_ms=deadline,
+                    tenant=a.get("tenant"))
+                break
+            except ShedError as e:
+                retries += 1
+                time.sleep(max(0.001, e.retry_after_ms / 1e3))
+        if req is None:
+            shed_replay.append({"rid": a["rid"],
+                                "reason": "shed_retries_exhausted"})
+        else:
+            handles[a["rid"]] = req
+    kill_info = _maybe_kill(float("inf")) or kill_info
+
+    deadline = time.perf_counter() + timeout
+    replay_failed: List[dict] = []
+    for rid, req in handles.items():
+        try:
+            req.result(timeout=max(0.1, deadline - time.perf_counter()))
+        except (MXNetError, TimeoutError) as e:
+            replay_failed.append({"rid": rid, "state": req.state,
+                                  "error": str(e)[:200]})
+
+    matched: List[int] = []
+    divergent: List[dict] = []
+    unverified: List[int] = []
+    for a in arrivals:
+        rid = a["rid"]
+        rec = outcomes.get(rid)
+        req = handles.get(rid)
+        verifiable = (a.get("greedy", True) and rec is not None
+                      and rec.get("state") == "finished"
+                      and rec.get("digest"))
+        if not verifiable:
+            unverified.append(rid)
+            continue
+        if req is None or req.state != "finished":
+            divergent.append({
+                "rid": rid, "recorded": rec["digest"],
+                "replayed": None,
+                "replay_state": req.state if req is not None else "shed"})
+            continue
+        got = _traffic.stream_digest(req.tokens)
+        if got == rec["digest"]:
+            matched.append(rid)
+        else:
+            divergent.append({
+                "rid": rid, "recorded": rec["digest"], "replayed": got,
+                "recorded_tokens": rec.get("generated"),
+                "replayed_tokens": len(req.tokens),
+                "replay_state": "finished"})
+
+    slo_state = None
+    slo_alerting = False
+    if getattr(fleet, "slo", None) is not None:
+        poll_until = time.perf_counter() + max(0.0, wait_slo_s)
+        while True:
+            fleet.slo.tick()
+            slo_state = fleet.slo.evaluate()
+            slo_alerting = any(e["alerts"] > 0
+                               for e in slo_state.values())
+            if slo_alerting or time.perf_counter() >= poll_until:
+                break
+            time.sleep(0.1)
+
+    report = {
+        "trace_meta": meta or None,
+        "mode": "afap" if speed <= 0 else f"{speed:g}x",
+        "requests": len(arrivals),
+        "submitted": len(handles),
+        "shed_replay": shed_replay,
+        "shed_retries": retries,
+        "kill": kill_info,
+        "matched": matched,
+        "divergent": divergent,
+        "unverified": unverified,
+        "replay_failed": replay_failed,
+        "replay_wall_s": round(time.perf_counter() - t0, 3),
+        "ttft_ms": {
+            "recorded": _dist([o.get("ttft_ms")
+                               for o in outcomes.values()]),
+            "replayed": _dist([r.ttft_s * 1e3 for r in handles.values()
+                               if r.ttft_s is not None]),
+        },
+        "latency_ms": {
+            "recorded": _dist([o.get("latency_ms")
+                               for o in outcomes.values()]),
+            "replayed": _dist([r.latency_s * 1e3
+                               for r in handles.values()
+                               if r.latency_s is not None]),
+        },
+        "slo_replay": slo_state,
+        "slo_alert_refired": slo_alerting,
+    }
+    report["ok"] = not divergent and not replay_failed
+    return report
+
+
+def replay_capsule(capsule_dir: str, *, model=None,
+                   transport: Optional[str] = None,
+                   replicas: Optional[int] = None,
+                   speed: float = 0.0,
+                   kill_at: Optional[float] = None,
+                   timeout: float = 180.0,
+                   wait_slo_s: float = 10.0) -> dict:
+    """Replay an incident capsule end to end: rebuild the fleet from
+    the capsule's own model/serving spec (``spec/``), install the
+    capsule's SLO objectives on it, and re-drive the captured traffic
+    window.  Returns the `replay_trace` report with the capsule path
+    and the re-fired alert state embedded."""
+    from .fleet import ServeFleet
+    from . import worker as _worker
+
+    cap = _traffic.read_capsule(capsule_dir)
+    if not cap["arrivals"]:
+        raise MXNetError(
+            f"capsule {capsule_dir} carries no traffic window "
+            f"(finalized={cap.get('finalized')})")
+    topo = cap.get("topology") or {}
+    if transport is None:
+        transport = topo.get("transport") or "thread"
+    if replicas is None:
+        replicas = int(topo.get("replicas") or 2)
+
+    config = None
+    if model is None:
+        spec_dir = os.path.join(capsule_dir, "spec")
+        if not os.path.isdir(spec_dir):
+            raise MXNetError(
+                f"capsule {capsule_dir} has no spec/ dir — pass model=")
+        model, config = _worker.load_spec(spec_dir)
+    if config is None and isinstance(topo.get("serve_config"), dict):
+        known = {f.name for f in dataclasses.fields(ServeConfig)}
+        config = ServeConfig(**{k: v
+                                for k, v in topo["serve_config"].items()
+                                if k in known})
+
+    # the replay fleet must not journal into the live capture, recurse
+    # into fresh capsules, or pick up the production SLO spec
+    scoped = {}
+    for var in (_traffic.ENV_TRAFFIC_JOURNAL, _traffic.ENV_CAPSULE_DIR,
+                _slo.ENV_SLO_SPEC):
+        if var in os.environ:
+            scoped[var] = os.environ.pop(var)
+    # SLO observes telemetry events; make sure they flow during replay
+    tele_was_on = _tele.enabled()
+    if not tele_was_on:
+        _tele.enable(journal_path=os.path.join(
+            capsule_dir, "replay_journal.jsonl"))
+    try:
+        fleet = ServeFleet(model, replicas=replicas, config=config,
+                           transport=transport)
+        fleet.start()
+        try:
+            spec = cap.get("slo_spec")
+            if spec:
+                fleet.slo = _slo.SLOEngine.from_spec(spec).attach()
+            report = replay_trace(
+                fleet, ({}, cap["arrivals"], cap["outcomes"]),
+                speed=speed, kill_at=kill_at, timeout=timeout,
+                wait_slo_s=wait_slo_s if spec else 0.0)
+        finally:
+            fleet.close()
+    finally:
+        os.environ.update(scoped)
+        if not tele_was_on:
+            _tele.disable()
+    report["capsule"] = os.path.abspath(capsule_dir)
+    report["slo_recorded"] = cap.get("slo")
+    return report

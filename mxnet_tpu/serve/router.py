@@ -51,6 +51,7 @@ from ..base import MXNetError
 from ..resilience import fault_point
 from .. import telemetry as _tele
 from .. import tracing as _trace
+from . import traffic as _traffic
 from .engine import _env_int
 from .scheduler import (ServeRequest, _open_queue_span, expire_request,
                         terminate_request)
@@ -178,7 +179,8 @@ class RequestRouter:
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 20, greedy: bool = True,
                temperature: float = 1.0, eos_token_id=None, on_token=None,
-               deadline_ms: Optional[float] = None) -> ServeRequest:
+               deadline_ms: Optional[float] = None,
+               tenant: Optional[str] = None) -> ServeRequest:
         running = self._running()
         if not running:
             self._shed("no_replicas", "no running replica in the fleet")
@@ -196,7 +198,7 @@ class RequestRouter:
         req = ServeRequest(prompt, max_new_tokens, greedy=greedy,
                            temperature=temperature,
                            eos_token_id=eos_token_id, on_token=on_token,
-                           deadline_ms=deadline)
+                           deadline_ms=deadline, tenant=tenant)
         target = self._pick(running, prompt=prompt)
         if target is None:
             # every replica saturated: park (bounded) or shed — the
@@ -240,6 +242,7 @@ class RequestRouter:
         if _tele.enabled():
             _tele.event("request", request_id=req.id, phase="submitted",
                         fleet=True)
+        _traffic.note_arrival(req)
 
     def _shed(self, reason: str, detail: str,
               depth: Optional[int] = None) -> None:
@@ -263,6 +266,7 @@ class RequestRouter:
             _trace.get_tracer("serve").record_span(
                 "serve.shed", now, now, track="serve router",
                 reason=reason, retry_after_ms=round(hint, 1))
+        _traffic.note_shed(reason, detail)
         raise ShedError(reason, hint, detail)
 
     def _estimated_wait_ms(self, queue_len: int, running: int) -> float:

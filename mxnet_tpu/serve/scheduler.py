@@ -48,6 +48,7 @@ from ..base import MXNetError
 from ..resilience import fault_point
 from .. import telemetry as _tele
 from .. import tracing as _trace
+from . import traffic as _traffic
 from .kv_cache import NULL_PAGE
 
 __all__ = ["ServeRequest", "ContinuousBatchingScheduler",
@@ -66,8 +67,11 @@ class ServeRequest:
     def __init__(self, prompt, max_new_tokens: int, greedy: bool = True,
                  temperature: float = 1.0, eos_token_id: Optional[int] = None,
                  on_token: Optional[Callable] = None,
-                 deadline_ms: float = 0.0):
+                 deadline_ms: float = 0.0,
+                 tenant: Optional[str] = None):
         self.id = next(_rid)
+        #: opaque caller tag carried into the traffic journal
+        self.tenant = tenant
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.greedy = bool(greedy)
@@ -192,6 +196,7 @@ def terminate_request(req: ServeRequest, err: str, *, state: str = "failed",
                 fields.setdefault("replica", replica)
             _tele.event("request", request_id=req.id, phase=phase,
                         **fields)
+        _traffic.note_outcome(req, state, error=err, replica=replica)
         req._done.set()
     return True
 
@@ -289,6 +294,7 @@ def finish_request(req: ServeRequest,
                         generated=len(req.tokens),
                         latency_ms=round(req.latency_s * 1e3, 3),
                         **fields)
+        _traffic.note_outcome(req, "finished", replica=replica)
         req._done.set()
     return True
 

@@ -1,0 +1,566 @@
+"""Traffic journal + deterministic workload generation for the serving
+fleet (docs/serving.md, "Flight recorder & replay").
+
+The **traffic journal** is the flight recorder's tape: an append-only
+JSONL file (``MXTPU_TRAFFIC_JOURNAL``) written at the router boundary.
+Every request admitted to the fleet lands as an ``arrival`` row (wall +
+monotonic timestamps, rid/tenant, the full prompt token list, sampling
+params) and leaves as an ``outcome`` row (terminal state, a sha256
+digest of the generated token stream, TTFT/latency, failover/eviction
+counts); sheds land as outcome rows with ``state="shed"``.  Together
+the two rows per request are sufficient to *re-drive* the fleet through
+the same traffic (`serve.replay`) and to *verify* the re-run: a greedy
+stream replayed on any topology must reproduce its recorded digest
+bit-for-bit (the eviction/failover bit-identity invariant).
+
+Row schema (one JSON object per line; ``kind`` discriminates)::
+
+    {"kind": "meta",    "created": wall_s, "generator": {...spec...}}
+    {"kind": "arrival", "rid": n, "ts_wall": s, "ts_mono": s,
+     "tenant": str|null, "prompt": [int...], "max_new": n,
+     "temperature": f, "greedy": bool, "seed": n|null,
+     "deadline_ms": f}
+    {"kind": "outcome", "rid": n, "ts_wall": s, "ts_mono": s,
+     "state": finished|failed|expired|cancelled|shed, "digest": hex|null,
+     "generated": n, "ttft_ms": f|null, "latency_ms": f|null,
+     "shed_reason": str|null, "error": str|null, "failovers": n,
+     "evictions": n, "prefix_hits": n, "replica": str|null}
+
+The **workload generator** emits the SAME arrival schema as a pure
+function of its seed (the data pipeline's pure-function-of-position
+rule, applied to traffic): burst/diurnal arrival curves, long-tail
+lognormal prompt/output lengths, shared-prefix prompt populations and
+tenant mixes — so bench traces and captured production traffic are
+interchangeable inputs to `serve.replay`.
+
+**Incident capsules** (``MXTPU_CAPSULE_DIR``): when an SLO burn alert
+fires, `ServeFleet` snapshots a bounded, self-contained directory —
+the journal window around the alert, a Perfetto trace export, a
+metrics snapshot, the SLO burn state, the fleet topology + ledger, and
+the model/serving spec — which ``tools/diagnose.py --capsule`` renders
+and ``--replay`` re-drives.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import math
+import os
+import shutil
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..base import MXNetError
+from .. import telemetry as _tele
+from .. import tracing as _trace
+
+__all__ = [
+    "ENV_TRAFFIC_JOURNAL", "ENV_CAPSULE_DIR", "TrafficJournal",
+    "WorkloadSpec", "generate_workload", "write_trace", "read_trace",
+    "stream_digest", "enable", "disable", "enabled", "journal",
+    "note_arrival", "note_outcome", "note_shed",
+    "begin_capsule", "finalize_capsule", "read_capsule",
+]
+
+ENV_TRAFFIC_JOURNAL = "MXTPU_TRAFFIC_JOURNAL"
+ENV_CAPSULE_DIR = "MXTPU_CAPSULE_DIR"
+#: journal window captured BEFORE the alert fired (seconds)
+ENV_CAPSULE_WINDOW = "MXTPU_CAPSULE_WINDOW_S"
+#: journal window captured AFTER the alert (in-flight requests finish
+#: inside it, so their digests make it into the capsule)
+ENV_CAPSULE_POST = "MXTPU_CAPSULE_POST_S"
+
+_MANIFEST = "manifest.json"
+_TRAFFIC = "traffic.jsonl"
+
+
+def stream_digest(tokens) -> str:
+    """sha256 over the generated token stream — the bit-identity check
+    replay divergence reports are built on.  Text form (comma-joined
+    decimal) so the digest is independent of integer width."""
+    payload = ",".join(str(int(t)) for t in tokens)
+    return hashlib.sha256(payload.encode("ascii")).hexdigest()
+
+
+class TrafficJournal:
+    """Append-only JSONL traffic journal (one per serving process).
+
+    Line-buffered like `telemetry.RunJournal`: rows survive a crash up
+    to the last complete line.  An unwritable path degrades to a
+    disabled journal instead of taking serving down."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+        self._lock = threading.Lock()
+        self._closed = False
+        try:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._f = open(self.path, "a", buffering=1)
+        except OSError:
+            self._f = None
+            self._closed = True
+
+    @property
+    def disabled(self) -> bool:
+        return self._closed
+
+    def _write(self, row: dict) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            try:
+                self._f.write(json.dumps(_tele.json_safe(row),
+                                         default=str) + "\n")
+            except (OSError, ValueError, TypeError):
+                pass   # a full disk must not take serving down
+
+    def arrival(self, req, tenant: Optional[str] = None) -> None:
+        """One admitted request, recorded at the router boundary."""
+        self._write({
+            "kind": "arrival", "rid": req.id,
+            "ts_wall": round(time.time(), 6),
+            "ts_mono": round(time.perf_counter(), 6),
+            "tenant": tenant if tenant is not None
+            else getattr(req, "tenant", None),
+            "prompt": list(req.prompt),
+            "max_new": req.max_new_tokens,
+            "temperature": req.temperature,
+            "greedy": req.greedy,
+            "eos_token_id": req.eos_token_id,
+            "seed": getattr(req, "seed", None),
+            "deadline_ms": req.deadline_ms,
+        })
+
+    def outcome(self, req, state: str, error: Optional[str] = None,
+                replica: Optional[str] = None) -> None:
+        """One terminal outcome (finished/failed/expired/cancelled)."""
+        self._write({
+            "kind": "outcome", "rid": req.id,
+            "ts_wall": round(time.time(), 6),
+            "ts_mono": round(time.perf_counter(), 6),
+            "state": state,
+            "digest": stream_digest(req.tokens) if req.tokens else None,
+            "generated": len(req.tokens),
+            "ttft_ms": (round(req.ttft_s * 1e3, 3)
+                        if req.ttft_s is not None else None),
+            "latency_ms": (round(req.latency_s * 1e3, 3)
+                           if req.latency_s is not None else None),
+            "shed_reason": None,
+            "error": error,
+            "failovers": req.failovers,
+            "evictions": req.evictions,
+            "prefix_hits": req.prefix_hits,
+            "replica": replica,
+        })
+
+    def shed(self, reason: str, detail: str = "",
+             rid: Optional[int] = None) -> None:
+        """A request the fleet refused — an outcome with no arrival."""
+        self._write({
+            "kind": "outcome", "rid": rid,
+            "ts_wall": round(time.time(), 6),
+            "ts_mono": round(time.perf_counter(), 6),
+            "state": "shed", "digest": None, "generated": 0,
+            "ttft_ms": None, "latency_ms": None,
+            "shed_reason": reason, "error": detail or None,
+            "failovers": 0, "evictions": 0, "prefix_hits": 0,
+            "replica": None,
+        })
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._closed:
+                self._closed = True
+                try:
+                    if self._f is not None:
+                        self._f.close()
+                except OSError:
+                    pass
+
+    @staticmethod
+    def read(path: str) -> List[dict]:
+        rows = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    rows.append(json.loads(line))
+        return rows
+
+
+# ---------------------------------------------------------------------------
+# module-level journal (mirrors the telemetry enable/disable idiom)
+# ---------------------------------------------------------------------------
+
+_journal: Optional[TrafficJournal] = None
+_env_checked = False
+_state_lock = threading.Lock()
+
+
+def enable(path: str) -> TrafficJournal:
+    """Open (or replace) the process-wide traffic journal at `path`."""
+    global _journal, _env_checked
+    with _state_lock:
+        if _journal is not None:
+            _journal.close()
+        _journal = TrafficJournal(path)
+        _env_checked = True
+        return _journal
+
+
+def disable() -> None:
+    global _journal, _env_checked
+    with _state_lock:
+        if _journal is not None:
+            _journal.close()
+        _journal = None
+        _env_checked = True
+
+
+def journal() -> Optional[TrafficJournal]:
+    """The active journal; lazily opened from ``MXTPU_TRAFFIC_JOURNAL``
+    on first use (None when unset)."""
+    global _journal, _env_checked
+    if _journal is None and not _env_checked:
+        with _state_lock:
+            if _journal is None and not _env_checked:
+                path = os.environ.get(ENV_TRAFFIC_JOURNAL, "").strip()
+                if path:
+                    _journal = TrafficJournal(path)
+                _env_checked = True
+    return _journal
+
+
+def enabled() -> bool:
+    j = journal()
+    return j is not None and not j.disabled
+
+
+def note_arrival(req, tenant: Optional[str] = None) -> None:
+    """Router-boundary hook: journal one admitted request and mark the
+    handle so its terminal outcome is journaled too (engine-level tests
+    that bypass the router never produce orphan outcome rows)."""
+    j = journal()
+    if j is not None:
+        req._journaled = True
+        j.arrival(req, tenant=tenant)
+
+
+def note_outcome(req, state: str, error: Optional[str] = None,
+                 replica: Optional[str] = None) -> None:
+    """Terminal-path hook (`finish_request` / `terminate_request`)."""
+    if getattr(req, "_journaled", False):
+        j = journal()
+        if j is not None:
+            j.outcome(req, state, error=error, replica=replica)
+
+
+def note_shed(reason: str, detail: str = "") -> None:
+    j = journal()
+    if j is not None:
+        j.shed(reason, detail)
+
+
+# ---------------------------------------------------------------------------
+# deterministic workload generation
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class WorkloadSpec:
+    """Declarative workload: every field defaults from a
+    ``MXTPU_TRAFFIC_*`` env knob; `generate_workload` is a pure function
+    of the spec (same spec => byte-identical trace)."""
+
+    seed: int = 0
+    requests: int = 32
+    #: base arrival rate (requests/s of trace time)
+    rate_rps: float = 8.0
+    #: multiplicative burst amplitude; bursts occupy `burst_duty` of
+    #: every `burst_period_s`
+    burst_factor: float = 4.0
+    burst_period_s: float = 10.0
+    burst_duty: float = 0.25
+    #: slow sinusoidal modulation on top of bursts (diurnal curve,
+    #: compressed to bench time); amplitude in [0, 1)
+    diurnal_period_s: float = 120.0
+    diurnal_amplitude: float = 0.3
+    #: lognormal prompt/output token lengths (long-tail), clipped
+    prompt_mu: float = 2.0
+    prompt_sigma: float = 0.8
+    prompt_min: int = 2
+    prompt_max: int = 48
+    output_mu: float = 2.2
+    output_sigma: float = 0.6
+    output_min: int = 2
+    output_max: int = 32
+    vocab: int = 512
+    #: shared-prefix population: `prefix_frac` of prompts start with
+    #: one of `prefix_families` common stems of `prefix_len` tokens
+    prefix_families: int = 3
+    prefix_len: int = 8
+    prefix_frac: float = 0.5
+    #: tenant mix: name -> weight
+    tenants: Dict[str, float] = dataclasses.field(
+        default_factory=lambda: {"tenant-a": 3.0, "tenant-b": 1.0})
+    #: fraction of sampled (non-greedy) requests; greedy ones carry the
+    #: digest bit-identity guarantee under replay
+    sampled_frac: float = 0.0
+    temperature: float = 0.8
+    deadline_ms: float = 0.0
+
+    @classmethod
+    def from_env(cls, **overrides) -> "WorkloadSpec":
+        kw = {}
+        for f in dataclasses.fields(cls):
+            env = os.environ.get(f"MXTPU_TRAFFIC_{f.name.upper()}")
+            if env is None:
+                continue
+            if f.name == "tenants":
+                kw[f.name] = {t.split(":")[0]: float(t.split(":")[1])
+                              for t in env.split(",") if ":" in t}
+            elif f.type == "int" or isinstance(f.default, int):
+                kw[f.name] = int(env)
+            else:
+                kw[f.name] = float(env)
+        kw.update(overrides)
+        return cls(**kw)
+
+
+def _rate_at(spec: WorkloadSpec, t: float) -> float:
+    rate = spec.rate_rps
+    if spec.diurnal_amplitude > 0:
+        rate *= 1.0 + spec.diurnal_amplitude * math.sin(
+            2.0 * math.pi * t / max(1e-9, spec.diurnal_period_s))
+    if spec.burst_factor > 1.0 and spec.burst_period_s > 0:
+        phase = (t % spec.burst_period_s) / spec.burst_period_s
+        if phase < spec.burst_duty:
+            rate *= spec.burst_factor
+    return max(1e-6, rate)
+
+
+def generate_workload(spec: WorkloadSpec) -> List[dict]:
+    """Arrival rows (the journal's ``arrival`` schema, ``ts_mono`` as a
+    trace-time offset from 0) as a pure function of ``spec``."""
+    import numpy as onp
+    rng = onp.random.RandomState(spec.seed)
+    tenants = sorted(spec.tenants) or [None]
+    weights = onp.asarray([spec.tenants[t] for t in tenants], float) \
+        if spec.tenants else onp.asarray([1.0])
+    weights = weights / weights.sum()
+    families = [rng.randint(0, spec.vocab, spec.prefix_len).tolist()
+                for _ in range(max(0, spec.prefix_families))]
+    rows: List[dict] = []
+    t = 0.0
+    for i in range(spec.requests):
+        # thinned non-homogeneous arrivals: exponential gap at the
+        # instantaneous rate — deterministic under the seeded RNG
+        t += float(rng.exponential(1.0 / _rate_at(spec, t)))
+        p_len = int(onp.clip(round(float(
+            rng.lognormal(spec.prompt_mu, spec.prompt_sigma))),
+            spec.prompt_min, spec.prompt_max))
+        o_len = int(onp.clip(round(float(
+            rng.lognormal(spec.output_mu, spec.output_sigma))),
+            spec.output_min, spec.output_max))
+        if families and float(rng.uniform()) < spec.prefix_frac:
+            fam = families[int(rng.randint(0, len(families)))]
+            suffix = max(1, p_len - spec.prefix_len)
+            prompt = fam + rng.randint(0, spec.vocab, suffix).tolist()
+        else:
+            prompt = rng.randint(0, spec.vocab, p_len).tolist()
+        sampled = float(rng.uniform()) < spec.sampled_frac
+        rows.append({
+            "kind": "arrival", "rid": i + 1,
+            "ts_wall": None, "ts_mono": round(t, 6),
+            "tenant": tenants[int(rng.choice(len(tenants), p=weights))],
+            "prompt": prompt, "max_new": o_len,
+            "temperature": spec.temperature if sampled else 1.0,
+            "greedy": not sampled, "eos_token_id": None,
+            "seed": int(rng.randint(0, 2**31 - 1)),
+            "deadline_ms": spec.deadline_ms,
+        })
+    return rows
+
+
+def write_trace(rows: List[dict], path: str,
+                spec: Optional[WorkloadSpec] = None) -> str:
+    """Write arrival rows as a journal-format JSONL trace (with a
+    ``meta`` header row carrying the generator spec when given)."""
+    d = os.path.dirname(os.path.abspath(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        header = {"kind": "meta", "created": round(time.time(), 3)}
+        if spec is not None:
+            header["generator"] = dataclasses.asdict(spec)
+        f.write(json.dumps(_tele.json_safe(header)) + "\n")
+        for row in rows:
+            f.write(json.dumps(_tele.json_safe(row)) + "\n")
+    return path
+
+
+def read_trace(path: str) -> Tuple[dict, List[dict], Dict[int, dict]]:
+    """Parse a trace/journal file into ``(meta, arrivals, outcomes)``;
+    ``outcomes`` maps rid -> its LAST outcome row.  Captured journals
+    and generated traces share the format, so both load here."""
+    meta: dict = {}
+    arrivals: List[dict] = []
+    outcomes: Dict[int, dict] = {}
+    for row in TrafficJournal.read(path):
+        kind = row.get("kind")
+        if kind == "meta":
+            meta = row
+        elif kind == "arrival":
+            arrivals.append(row)
+        elif kind == "outcome" and row.get("rid") is not None:
+            outcomes[row["rid"]] = row
+    arrivals.sort(key=lambda r: (r.get("ts_mono") or 0.0))
+    return meta, arrivals, outcomes
+
+
+# ---------------------------------------------------------------------------
+# incident capsules
+# ---------------------------------------------------------------------------
+
+def _capsule_windows() -> Tuple[float, float]:
+    def _f(env, default):
+        try:
+            return float(os.environ.get(env, "") or default)
+        except ValueError:
+            return default
+    return _f(ENV_CAPSULE_WINDOW, 120.0), _f(ENV_CAPSULE_POST, 30.0)
+
+
+def begin_capsule(out_dir: str, slo_name: str, entry: dict,
+                  fleet_stats: dict, topology: dict,
+                  slo_spec: Optional[dict] = None,
+                  spec_dir: Optional[str] = None) -> str:
+    """Snapshot everything available AT alert time into a new capsule
+    directory under `out_dir`; the traffic window is finalized later
+    (`finalize_capsule`) so in-flight requests' outcomes land too.
+    Returns the capsule path."""
+    pre_s, post_s = _capsule_windows()
+    os.makedirs(out_dir, exist_ok=True)
+    n = 0
+    while True:
+        path = os.path.join(
+            out_dir, f"capsule_{slo_name}_{os.getpid()}_{n}")
+        try:
+            os.makedirs(path)
+            break
+        except FileExistsError:
+            n += 1
+    files = {}
+    # metrics registry snapshot
+    try:
+        with open(os.path.join(path, "metrics.json"), "w") as f:
+            json.dump(_tele.json_safe(_tele.snapshot()), f)
+        files["metrics"] = "metrics.json"
+    except Exception:
+        pass
+    # Perfetto export, bounded to the incident window
+    if _trace.enabled():
+        try:
+            _trace.export_chrome(
+                os.path.join(path, "trace.json"),
+                since=time.perf_counter() - pre_s)
+            files["trace"] = "trace.json"
+        except Exception:
+            pass
+    # telemetry journal tail (slo_burn / request / replica rows)
+    tj = _tele.journal()
+    if tj is not None and not tj.disabled:
+        try:
+            tail = _tele.RunJournal.tail(tj.path, 500)
+            with open(os.path.join(path, "journal_tail.jsonl"),
+                      "w") as f:
+                for row in tail:
+                    f.write(json.dumps(_tele.json_safe(row)) + "\n")
+            files["journal_tail"] = "journal_tail.jsonl"
+        except Exception:
+            pass
+    # model + serving spec: makes the capsule replayable on its own
+    if spec_dir is not None:
+        try:
+            shutil.copytree(spec_dir, os.path.join(path, "spec"))
+            files["spec"] = "spec"
+        except Exception:
+            pass
+    j = journal()
+    manifest = {
+        "capsule_version": 1,
+        "slo": slo_name,
+        "entry": entry,
+        "fired_wall": round(time.time(), 6),
+        "fired_mono": round(time.perf_counter(), 6),
+        "window": {"pre_s": pre_s, "post_s": post_s},
+        "topology": topology,
+        "slo_spec": slo_spec,
+        "fleet": fleet_stats,
+        "traffic_source": j.path if j is not None else None,
+        "files": files,
+        "finalized": False,
+    }
+    with open(os.path.join(path, _MANIFEST), "w") as f:
+        json.dump(_tele.json_safe(manifest), f, indent=2)
+    return path
+
+
+def finalize_capsule(path: str) -> int:
+    """Copy the journal window around the alert into the capsule
+    (arrivals inside ``[fired - pre_s, fired + post_s]`` plus the
+    outcome of every such arrival, whenever it landed — a request
+    in flight at alert time keeps its digest).  Returns the row count
+    (0 when no journal is active)."""
+    with open(os.path.join(path, _MANIFEST)) as f:
+        manifest = json.load(f)
+    src = manifest.get("traffic_source")
+    rows: List[dict] = []
+    if src and os.path.exists(src):
+        fired = manifest["fired_mono"]
+        lo = fired - manifest["window"]["pre_s"]
+        hi = fired + manifest["window"]["post_s"]
+        all_rows = TrafficJournal.read(src)
+        keep_rids = set()
+        for r in all_rows:
+            ts = r.get("ts_mono")
+            in_window = ts is not None and lo <= ts <= hi
+            if r.get("kind") == "arrival" and in_window:
+                keep_rids.add(r.get("rid"))
+                rows.append(r)
+            elif r.get("kind") == "outcome" and (
+                    r.get("rid") in keep_rids
+                    or (r.get("rid") is None and in_window)):
+                rows.append(r)
+        with open(os.path.join(path, _TRAFFIC), "w") as f:
+            for r in rows:
+                f.write(json.dumps(_tele.json_safe(r)) + "\n")
+        manifest["files"]["traffic"] = _TRAFFIC
+    manifest["finalized"] = True
+    manifest["traffic_rows"] = len(rows)
+    with open(os.path.join(path, _MANIFEST), "w") as f:
+        json.dump(_tele.json_safe(manifest), f, indent=2)
+    return len(rows)
+
+
+def read_capsule(path: str) -> dict:
+    """Load a capsule: the manifest plus parsed traffic rows under
+    ``arrivals`` / ``outcomes`` (empty when the capsule carries no
+    traffic window)."""
+    mf = os.path.join(path, _MANIFEST)
+    if not os.path.exists(mf):
+        raise MXNetError(f"{path} is not a capsule (no {_MANIFEST})")
+    with open(mf) as f:
+        manifest = json.load(f)
+    manifest["path"] = path
+    traffic = os.path.join(path, _TRAFFIC)
+    if os.path.exists(traffic):
+        _, arrivals, outcomes = read_trace(traffic)
+    else:
+        arrivals, outcomes = [], {}
+    manifest["arrivals"] = arrivals
+    manifest["outcomes"] = outcomes
+    return manifest

@@ -129,6 +129,7 @@ class SLOEngine:
         for o in objectives:
             self.add_objective(o)
         self._attached = False
+        self._alert_listeners: List = []
 
     # -- construction ---------------------------------------------------
     @classmethod
@@ -199,6 +200,21 @@ class SLOEngine:
         if self._attached:
             _tele.remove_event_tap(self._tap)
             self._attached = False
+
+    # -- alert listeners ------------------------------------------------
+    def add_alert_listener(self, fn) -> None:
+        """Register ``fn(name, entry)`` to run on every FIRING
+        transition inside `tick` (the incident-capsule trigger seam).
+        Listeners run on the ticking thread; exceptions are swallowed —
+        a capsule writer must never take the supervisor down."""
+        with self._lock:
+            if fn not in self._alert_listeners:
+                self._alert_listeners.append(fn)
+
+    def remove_alert_listener(self, fn) -> None:
+        with self._lock:
+            if fn in self._alert_listeners:
+                self._alert_listeners.remove(fn)
 
     def _tap(self, row: dict) -> None:
         try:
@@ -348,6 +364,14 @@ class SLOEngine:
                     "SLO %s burning: fast %.2fx / slow %.2fx of error "
                     "budget (threshold %.2fx)", name, fast["burn"],
                     slow["burn"], o.burn)
+                with self._lock:
+                    listeners = list(self._alert_listeners)
+                for fn in listeners:
+                    try:
+                        fn(name, entry)
+                    except Exception:
+                        _log.warning("SLO alert listener failed",
+                                     exc_info=True)
             elif not firing and st.alerting:
                 st.alerting = False
                 entry["alerting"] = False

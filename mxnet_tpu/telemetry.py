@@ -535,6 +535,31 @@ class RunJournal:
                     rows.append(json.loads(line))
         return rows
 
+    @staticmethod
+    def tail(path: str, n: int = 500) -> List[dict]:
+        """The last `n` rows — bounded excerpts (incident capsules) from
+        journals that may have grown for hours.  Reads at most ~256 KiB
+        per requested row from the file's end, not the whole file."""
+        budget = max(4096, 256 * 1024)
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - budget))
+            chunk = f.read().decode("utf-8", errors="replace")
+        lines = chunk.splitlines()
+        if size > budget and lines:
+            lines = lines[1:]   # first line is likely truncated
+        rows = []
+        for line in lines[-n:]:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except ValueError:
+                continue
+        return rows
+
     def __enter__(self):
         return self
 

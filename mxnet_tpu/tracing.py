@@ -497,8 +497,12 @@ def _atexit_export() -> None:
 # Chrome/Perfetto trace_event export
 # ---------------------------------------------------------------------------
 
-def chrome_events(include: Optional[List[str]] = None) -> List[dict]:
+def chrome_events(include: Optional[List[str]] = None,
+                  since: Optional[float] = None) -> List[dict]:
     """All finished spans as Chrome ``trace_event`` dicts.
+    ``since`` (a ``time.perf_counter`` instant) keeps only spans that
+    were still open at or after it — bounded exports for incident
+    capsules.
 
     Every span becomes a complete ``"ph": "X"`` event.  Tracks: spans
     carry either an explicit ``track`` (serve requests get one per
@@ -528,6 +532,8 @@ def chrome_events(include: Optional[List[str]] = None) -> List[dict]:
             continue
         for s in tracer.spans():
             if s.t1 is None:
+                continue
+            if since is not None and s.t1 < since:
                 continue
             spid = s.pid if s.pid is not None else local_pid
             track = s.track if s.track is not None else f"{tname}"
@@ -560,10 +566,12 @@ def chrome_events(include: Optional[List[str]] = None) -> List[dict]:
     return meta + events
 
 
-def export_chrome(path: Optional[str] = None) -> str:
+def export_chrome(path: Optional[str] = None,
+                  since: Optional[float] = None) -> str:
     """Write the collected spans as a Chrome/Perfetto-loadable JSON
     trace; returns the path (default:
-    ``<trace_dir>/trace_<pid>.json``)."""
+    ``<trace_dir>/trace_<pid>.json``).  ``since`` bounds the export to
+    spans still open at/after that ``perf_counter`` instant (capsules)."""
     if path is None:
         d = _trace_dir or os.getcwd()
         os.makedirs(d, exist_ok=True)
@@ -572,7 +580,7 @@ def export_chrome(path: Optional[str] = None) -> str:
         d = os.path.dirname(os.path.abspath(path))
         if d:
             os.makedirs(d, exist_ok=True)
-    doc = {"traceEvents": chrome_events(),
+    doc = {"traceEvents": chrome_events(since=since),
            "displayTimeUnit": "ms",
            "otherData": {"exporter": "mxnet_tpu.tracing",
                          "pid": os.getpid()}}

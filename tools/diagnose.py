@@ -16,6 +16,14 @@ Also pretty-prints crash flight-recorder bundles (docs/observability.md,
                                                    # rollup (served /
                                                    # failovers / shed /
                                                    # p99 TTFT)
+    python tools/diagnose.py --capsule <dir>       # incident capsule:
+                                                   # burn state, topology,
+                                                   # traffic window
+    python tools/diagnose.py --capsule <dir> --replay \
+        [--speed X] [--kill-at T] [--transport thread|process] \
+        [--replicas N]              # re-drive the capsule window and
+                                    # print the divergence report
+                                    # (rc 0 iff bit-identical)
     python tools/diagnose.py --trace <dir-or-files...> \
         [--merged-out merged.json]  # merge per-process trace_<pid>.json
                                     # exports into ONE Perfetto doc:
@@ -538,6 +546,116 @@ def _newest_bundle(crash_dir: str):
     return max(paths, key=os.path.getmtime) if paths else None
 
 
+def print_capsule(path: str) -> int:
+    """Human-readable view of one incident capsule (docs/serving.md,
+    "Flight recorder & replay")."""
+    from mxnet_tpu.serve import traffic as _traffic
+    try:
+        cap = _traffic.read_capsule(path)
+    except Exception as e:
+        print(f"cannot read capsule {path}: {e}", file=sys.stderr)
+        return 1
+    print(f"========== incident capsule: {path} ==========")
+    print(f"slo       : {cap.get('slo')}")
+    print(f"fired     : {_fmt_ts(cap.get('fired_wall'))}")
+    w = cap.get("window") or {}
+    print(f"window    : -{w.get('pre_s')}s .. +{w.get('post_s')}s "
+          f"(finalized: {cap.get('finalized')})")
+    entry = cap.get("entry") or {}
+    win = entry.get("windows") or {}
+    fast, slow = win.get("fast") or {}, win.get("slow") or {}
+    print(f"burn      : fast {fast.get('burn')}x / slow {slow.get('burn')}x "
+          f"(threshold {entry.get('burn_threshold')}x, "
+          f"signal {entry.get('signal')}, target {entry.get('target')})")
+    topo = cap.get("topology") or {}
+    print(f"topology  : {topo.get('replicas')} x {topo.get('transport')}"
+          f" replica(s), tp={topo.get('tp')}, disagg={topo.get('disagg')}")
+    fl = cap.get("fleet") or {}
+    reps = fl.get("replicas") or {}
+    if reps:
+        states = ", ".join(f"{n}={r.get('state')}"
+                           for n, r in sorted(reps.items()))
+        print(f"fleet     : {states}  deaths={fl.get('deaths')} "
+              f"respawns={fl.get('respawns')} "
+              f"handoffs={fl.get('handoffs')}")
+    files = cap.get("files") or {}
+    print(f"files     : {', '.join(sorted(files.values())) or '(none)'}")
+    arrivals, outcomes = cap["arrivals"], cap["outcomes"]
+    print(f"---------- traffic window ({len(arrivals)} arrivals, "
+          f"{len(outcomes)} outcomes) ----------")
+    if arrivals:
+        by_state = {}
+        for o in outcomes.values():
+            by_state[o.get("state")] = by_state.get(o.get("state"), 0) + 1
+        print(f"  outcomes : " + ", ".join(
+            f"{s}={n}" for s, n in sorted(by_state.items())))
+        digests = sum(1 for o in outcomes.values() if o.get("digest"))
+        print(f"  digests  : {digests} recorded token-stream digest(s)")
+        for metric in ("ttft_ms", "latency_ms"):
+            vals = sorted(o[metric] for o in outcomes.values()
+                          if o.get(metric) is not None)
+            if vals:
+                print(f"  {metric:<9}: p50 {_pctl(vals, 50):.1f}  "
+                      f"p99 {_pctl(vals, 99):.1f}  max {vals[-1]:.1f}")
+        tenants = {}
+        for a in arrivals:
+            tenants[a.get("tenant")] = tenants.get(a.get("tenant"), 0) + 1
+        print(f"  tenants  : " + ", ".join(
+            f"{t}={n}" for t, n in sorted(tenants.items(),
+                                          key=lambda kv: -kv[1])))
+    if not cap.get("finalized"):
+        print("  (not finalized — traffic window incomplete)")
+    print(f"replay    : python tools/diagnose.py --capsule {path} --replay")
+    return 0
+
+
+def replay_capsule_cli(path: str) -> int:
+    """Re-drive a capsule's traffic window (`serve.replay`) and print
+    the divergence report.  rc 0 iff every verifiable greedy stream
+    reproduced its recorded digest bit-for-bit."""
+    import mxnet_tpu  # noqa: F401  (jax init before fleet construction)
+    from mxnet_tpu.serve import replay as _replay
+
+    def _opt(flag, cast, default):
+        if flag in sys.argv:
+            return cast(_flag_operand(flag))
+        return default
+
+    report = _replay.replay_capsule(
+        path,
+        speed=_opt("--speed", float, 0.0),
+        kill_at=_opt("--kill-at", float, None),
+        transport=_opt("--transport", str, None),
+        replicas=_opt("--replicas", int, None),
+        timeout=_opt("--timeout", float, 180.0))
+    print(f"========== capsule replay: {path} ==========")
+    print(f"mode      : {report['mode']}   wall: "
+          f"{report['replay_wall_s']}s")
+    print(f"requests  : {report['requests']} recorded, "
+          f"{report['submitted']} replayed, "
+          f"{len(report['shed_replay'])} shed in replay")
+    print(f"digests   : {len(report['matched'])} matched, "
+          f"{len(report['divergent'])} divergent, "
+          f"{len(report['unverified'])} unverifiable")
+    for d in report["divergent"][:10]:
+        print(f"  DIVERGED rid {d['rid']}: recorded "
+              f"{str(d['recorded'])[:16]}... got "
+              f"{str(d['replayed'])[:16]}... ({d['replay_state']})")
+    for f in report["replay_failed"][:10]:
+        print(f"  FAILED   rid {f['rid']}: {f['error']}")
+    for metric in ("ttft_ms", "latency_ms"):
+        rec, rep = report[metric]["recorded"], report[metric]["replayed"]
+        if rec and rep:
+            print(f"{metric:<10}: recorded p50 {rec['p50']} / p99 "
+                  f"{rec['p99']}  ->  replayed p50 {rep['p50']} / p99 "
+                  f"{rep['p99']}")
+    print(f"slo       : recorded alert on {report.get('slo_recorded')!r}; "
+          f"re-fired in replay: {report['slo_alert_refired']}")
+    print("verdict   : " + ("REPRODUCED — streams bit-identical"
+                            if report["ok"] else "DIVERGED"))
+    return 0 if report["ok"] else 1
+
+
 def _flag_operand(flag: str) -> str:
     idx = sys.argv.index(flag)
     if idx + 1 >= len(sys.argv):
@@ -547,6 +665,11 @@ def _flag_operand(flag: str) -> str:
 
 
 def main():
+    if "--capsule" in sys.argv:
+        path = _flag_operand("--capsule")
+        if "--replay" in sys.argv:
+            return sys.exit(replay_capsule_cli(path))
+        return sys.exit(print_capsule(path))
     if "--bundle" in sys.argv:
         return sys.exit(print_bundle(_flag_operand("--bundle")))
     if "--journal" in sys.argv:

@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Flight-recorder smoke (`make replay-smoke`, wired into `make test`):
+the end-to-end incident loop on CPU in under a minute.
+
+1. generate a seeded bursty shared-prefix workload trace,
+2. serve it on a 2-replica fleet with a traffic journal, a tight TTFT
+   SLO, and a mid-burst replica kill — the burn alert fires during the
+   live drive and auto-writes an incident capsule,
+3. replay the capsule window on a fresh fleet: every greedy stream
+   must reproduce its recorded token digest bit-for-bit AND the same
+   SLO objective must re-enter burn during replay,
+4. `tools/diagnose.py --capsule` renders the capsule with rc 0.
+
+Everything asserted here is the docs/serving.md "Flight recorder &
+replay" contract; a failure means an incident captured in production
+could not be reproduced from its own capsule.
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+t_start = time.time()
+workdir = tempfile.mkdtemp(prefix="mxtpu_replay_smoke_")
+
+# env BEFORE mxnet_tpu import: CPU backend, traffic journal, capsule
+# sink with a short post-alert window, and a TTFT objective tight
+# enough that the bursty drive (queue pileup on max_slots=2) plus the
+# replica kill always push it into burn on CPU
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["MXTPU_TRAFFIC_JOURNAL"] = os.path.join(workdir,
+                                                   "traffic.jsonl")
+os.environ["MXTPU_CAPSULE_DIR"] = os.path.join(workdir, "capsules")
+os.environ["MXTPU_CAPSULE_WINDOW_S"] = "120"
+os.environ["MXTPU_CAPSULE_POST_S"] = "1"
+SLO_SPEC = {"objectives": [
+    {"name": "ttft_burst", "signal": "ttft_ms", "threshold": 25.0,
+     "target": 0.9, "fast_s": 10, "slow_s": 20, "burn": 1.0,
+     "min_events": 3}]}
+os.environ["MXTPU_SLO_SPEC"] = json.dumps(SLO_SPEC)
+
+import mxnet_tpu as mx                                    # noqa: E402
+from mxnet_tpu import telemetry as tele                   # noqa: E402
+from mxnet_tpu import tracing                             # noqa: E402
+from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM  # noqa: E402
+from mxnet_tpu.serve import (                             # noqa: E402
+    ServeConfig, ServeFleet, WorkloadSpec, generate_workload,
+    read_capsule, replay_trace, write_trace)
+from mxnet_tpu.serve import traffic as traffic_mod        # noqa: E402
+from mxnet_tpu.serve.replay import replay_capsule         # noqa: E402
+
+tele.enable(journal_path=os.path.join(workdir, "telemetry.jsonl"))
+tracing.enable()
+
+# -- 1. deterministic bursty shared-prefix workload -------------------------
+spec = WorkloadSpec(seed=20260807, requests=20, rate_rps=80.0,
+                    burst_factor=4.0, burst_period_s=2.0, burst_duty=0.5,
+                    vocab=96, prompt_min=3, prompt_max=10,
+                    output_mu=1.6, output_sigma=0.4, output_min=3,
+                    output_max=8, prefix_families=2, prefix_len=4,
+                    prefix_frac=0.6)
+rows = generate_workload(spec)
+rows2 = generate_workload(spec)
+assert json.dumps(rows) == json.dumps(rows2), \
+    "generator is not a pure function of its seed"
+trace_path = write_trace(rows, os.path.join(workdir, "workload.jsonl"),
+                         spec)
+print(f"[1/4] generated {len(rows)} arrivals over "
+      f"{rows[-1]['ts_mono']:.2f}s of trace time -> {trace_path}")
+
+# -- 2. live incident: bursty drive + mid-burst kill ------------------------
+model = GPTForCausalLM(GPTConfig(
+    vocab_size=96, hidden_size=32, num_layers=1, num_heads=4,
+    intermediate_size=64, max_position=64, dropout=0.0))
+model.initialize()
+model(mx.np.array([[1, 2]], dtype="int32"))
+
+fleet = ServeFleet(model, replicas=2,
+                   config=ServeConfig(max_slots=2, page_size=4,
+                                      num_pages=0, prefill_chunk=4,
+                                      max_len=32),
+                   stall_timeout=5.0, supervise_interval=0.05)
+with fleet:
+    live = replay_trace(fleet, trace_path, speed=0.0, kill_at=0.02,
+                        timeout=120.0, wait_slo_s=15.0)
+    assert live["replay_failed"] == [], live["replay_failed"]
+    assert live["kill"] is not None, "chaos kill never fired"
+    assert fleet.deaths == 1, f"expected 1 replica death, {fleet.deaths}"
+    assert live["slo_alert_refired"], \
+        "SLO burn alert did not fire during the live incident"
+    t0 = time.perf_counter()
+    while not fleet.capsules and time.perf_counter() - t0 < 10.0:
+        time.sleep(0.05)
+    assert fleet.capsules, "burn alert did not auto-write a capsule"
+# fleet.close() force-finalized pending capsules
+capsule = fleet.capsules[0]
+cap = read_capsule(capsule)
+assert cap["finalized"], "capsule traffic window was not finalized"
+assert cap["slo"] == "ttft_burst"
+assert cap["arrivals"], "capsule captured no traffic"
+n_digests = sum(1 for o in cap["outcomes"].values() if o.get("digest"))
+assert n_digests > 0, "capsule has no recorded stream digests"
+for fname in ("metrics.json", "trace.json", "journal_tail.jsonl",
+              os.path.join("spec", "config.json")):
+    assert os.path.exists(os.path.join(capsule, fname)), \
+        f"capsule missing {fname}"
+print(f"[2/4] live incident captured: kill at {live['kill']['at_s']}s "
+      f"on {live['kill']['replica']}, alert fired, capsule {capsule} "
+      f"({len(cap['arrivals'])} arrivals, {n_digests} digests)")
+
+# -- 3. replay the capsule: digests bit-identical, alert re-fires -----------
+tele.disable()          # the replay enables its own telemetry plane
+tracing.disable()
+traffic_mod.disable()   # stop journaling live traffic into the capture
+report = replay_capsule(capsule, speed=0.0, timeout=120.0,
+                        wait_slo_s=15.0)
+assert report["ok"], {
+    "divergent": report["divergent"], "failed": report["replay_failed"]}
+assert report["matched"], "no digest was verifiable in replay"
+assert report["divergent"] == [], report["divergent"]
+assert report["slo_alert_refired"], \
+    "SLO objective did not re-enter burn during capsule replay"
+print(f"[3/4] capsule replayed: {len(report['matched'])} greedy "
+      f"streams bit-identical to the recording, 0 divergent, "
+      f"'{report['slo_recorded']}' re-fired in replay")
+
+# -- 4. diagnose renders the capsule --------------------------------------
+env = dict(os.environ)
+env.pop("MXTPU_SLO_SPEC", None)
+proc = subprocess.run(
+    [sys.executable,
+     os.path.join(os.path.dirname(__file__), "diagnose.py"),
+     "--capsule", capsule],
+    capture_output=True, text=True, timeout=120, env=env)
+assert proc.returncode == 0, proc.stderr
+assert "incident capsule" in proc.stdout
+assert "ttft_burst" in proc.stdout
+print("[4/4] diagnose --capsule rendered (rc 0)")
+
+elapsed = time.time() - t_start
+print(json.dumps({
+    "requests": len(rows),
+    "capsule": capsule,
+    "capsule_arrivals": len(cap["arrivals"]),
+    "digests_recorded": n_digests,
+    "replay_matched": len(report["matched"]),
+    "replay_divergent": len(report["divergent"]),
+    "alert_refired": report["slo_alert_refired"],
+    "elapsed_s": round(elapsed, 1),
+}))
+assert elapsed < 60, f"replay smoke exceeded budget: {elapsed:.1f}s"
+print("REPLAY SMOKE PASS")
