@@ -2,7 +2,7 @@
 # to what a single-language-core framework needs).
 PY ?= python
 
-.PHONY: ci test test-all test-dist test-parity lint bench cpp docs clean opperf-check telemetry-smoke health-smoke chaos-smoke serve-smoke fleet-smoke procfleet-smoke kernels-smoke elastic-smoke export-smoke data-smoke trace-smoke quant-smoke spec-smoke disagg-smoke obsplane-smoke replay-smoke perf-gate
+.PHONY: ci test test-all test-dist test-parity lint bench cpp docs clean opperf-check telemetry-smoke health-smoke chaos-smoke serve-smoke fleet-smoke procfleet-smoke kernels-smoke elastic-smoke export-smoke data-smoke trace-smoke quant-smoke spec-smoke disagg-smoke obsplane-smoke replay-smoke qos-smoke perf-gate
 
 # the one-command gate CI runs (VERDICT round-2 next-step #7): lint +
 # unit suite + 2-process dist tests + C++ package build/tests
@@ -17,7 +17,7 @@ cpp-test:
 # `make test-all` runs everything.  -n auto parallelizes when xdist +
 # cores are available: ~13.5 min serial on the 1-core builder VM,
 # well under 10 min on any >=2-core box
-test: telemetry-smoke health-smoke chaos-smoke serve-smoke fleet-smoke procfleet-smoke kernels-smoke elastic-smoke export-smoke data-smoke trace-smoke quant-smoke spec-smoke disagg-smoke obsplane-smoke replay-smoke
+test: telemetry-smoke health-smoke chaos-smoke serve-smoke fleet-smoke procfleet-smoke kernels-smoke elastic-smoke export-smoke data-smoke trace-smoke quant-smoke spec-smoke disagg-smoke obsplane-smoke replay-smoke qos-smoke
 	$(PY) -m pytest tests/unittest -q -m "not slow" $$($(PY) -c 'import xdist, os; print("-n auto" if (os.cpu_count() or 1) > 1 else "")' 2>/dev/null) --ignore=tests/unittest/test_dist_kvstore.py
 
 test-all:
@@ -140,6 +140,15 @@ obsplane-smoke:
 # re-entering burn, and diagnose --capsule renders it (rc 0); <60 s CPU
 replay-smoke:
 	$(PY) tools/replay_smoke.py
+
+# per-tenant QoS (docs/serving.md "Per-tenant QoS"): a 2-replica fleet
+# serves a protected tenant solo, then again while a noisy tenant
+# floods the router behind a request-rate quota + bulkhead — every
+# protected stream must stay bit-identical to its solo digest with a
+# 0 shed rate while the noisy tenant absorbs 100% of the sheds, and
+# shed journal rows must carry tenant + reason; <60 s CPU
+qos-smoke:
+	$(PY) tools/qos_smoke.py
 
 # fused Pallas kernel set: CPU interpret-mode parity sweep over
 # odd/padded shapes (norms, MoE dispatch/combine incl. overflow drops,

@@ -903,6 +903,236 @@ def _measure_serve_disagg(disagg: str, tp: int) -> dict:
     }
 
 
+def _measure_serve_tenants(replicas: int = 2, requests: int = 128,
+                           seed: int = 0, speed: float = 1.0) -> dict:
+    """`bench.py --serve --tenants [--requests N] [--replicas R]
+    [--seed S] [--speed X]`: the noisy-neighbor containment headline
+    (docs/serving.md "Per-tenant QoS").
+
+    One seeded `WorkloadSpec` tenant mix — a protected ``gold`` tenant,
+    an abusive ``abuser`` tenant (3x the arrival weight, every request
+    inflated to the max output length: a deliberate priority-inversion
+    attempt from the lowest class), and three short-lived ``churn-*``
+    tenants — is driven through three fleets on the SAME trace:
+
+    1. **solo**: only gold's arrivals, no contention — the reference
+       tail,
+    2. **qos on**: the full mix behind the QoS plane (gold
+       interactive/weight 8; abuser best_effort behind a request-rate
+       quota + 1-slot bulkhead),
+    3. **qos off**: the full mix with ``MXTPU_QOS=0`` — what the same
+       trace does to gold without the plane.
+
+    Headline: gold's p99 TTFT degradation vs solo with QoS on (the
+    contract is < 20% while the abuser absorbs >= 90% of the sheds);
+    the QoS-off arm is reported alongside so the containment is
+    attributable to the plane, not the trace."""
+    import jax
+    ambient = os.environ.get("JAX_PLATFORMS", "").lower()
+    if not any(t in ambient for t in ("tpu", "axon")):
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from mxnet_tpu.serve import ServeConfig, ServeFleet, ShedError
+    from mxnet_tpu.serve import traffic as _traffic
+    from mxnet_tpu.serve.qos import QoSConfig
+
+    # phases must not journal into an ambient capture or pick up an
+    # ambient QoS spec (the off arm sets its own)
+    scoped = {}
+    for var in ("MXTPU_TRAFFIC_JOURNAL", "MXTPU_QOS", "MXTPU_QOS_SPEC"):
+        if var in os.environ:
+            scoped[var] = os.environ.pop(var)
+
+    spec = _traffic.WorkloadSpec(
+        seed=seed, requests=requests, rate_rps=12.0, burst_factor=3.0,
+        burst_period_s=4.0, prompt_max=24, output_max=16,
+        deadline_ms=0.0,
+        tenants={"abuser": 6.0, "churn-a": 0.5,
+                 "churn-b": 0.5, "churn-c": 0.5})
+    rows = _traffic.generate_workload(spec)
+    abuse_new = 48                 # prompt_max 24 + 48 + 1 < max_len
+    gold_prompt = 112              # gold TTFT is prefill-dominated (one
+    #                                full chunk, several decode-steps
+    #                                deep), so the p99 ratio measures
+    #                                scheduling interference, not clock
+    #                                jitter or fixed dispatch overhead
+    for a in rows:
+        if a["tenant"] == "abuser":
+            # the abusive shape: every request demands 4x the mix's
+            # max output — slot time the other tenants never asked for
+            a["max_new"] = abuse_new
+    # gold is a deterministic PROBE TRAIN overlaid on the mix, evenly
+    # spaced so it never self-collides: its solo tail is then a stable
+    # reference and any p99 movement in the mixed arms is interference
+    # from the neighbors, not gold-on-gold burst luck
+    span = rows[-1]["ts_mono"] if rows else 8.0
+    n_gold = 16
+    gap = span / n_gold
+    for k in range(n_gold):
+        rows.append({
+            "kind": "arrival", "rid": requests + k + 1,
+            "ts_wall": None, "ts_mono": round((k + 0.5) * gap, 6),
+            "tenant": "gold",
+            "prompt": [(7 * (k + i) + 13) % spec.vocab
+                       for i in range(gold_prompt)],
+            "max_new": 8, "temperature": 1.0, "greedy": True,
+            "eos_token_id": None, "seed": k, "deadline_ms": 0.0})
+    rows.sort(key=lambda a: a["ts_mono"])
+
+    qos_cfg = QoSConfig.from_spec({
+        "default": {"priority": "batch"},
+        "tenants": {
+            "gold": {"priority": "interactive", "weight": 8.0},
+            "abuser": {"priority": "best_effort", "weight": 1.0,
+                       "rps": 1.0, "burst_s": 1.0, "max_slots": 1}},
+        "breaker": {"offenses": 0}})
+
+    dev = jax.devices()[0]
+    # heavier than the other CPU serve benches on purpose: a 64-token
+    # prefill must cost several decode steps, or the p99 ratio would
+    # measure fixed dispatch overhead instead of interference
+    cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=3,
+                    num_heads=4, intermediate_size=256,
+                    max_position=256, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.initialize()
+    model(mx.np.array([[1, 2]], dtype="int32"))
+    # prefill_chunk covers the longest prompt in ONE chunk: an
+    # interactive prefill then pays at most one step of queueing behind
+    # a seated neighbor instead of one per chunk
+    # 3 slots/replica with the abuser bulkheaded to 1: a protected
+    # arrival always finds a free slot, so its tail is interference,
+    # not slot starvation
+    sc = ServeConfig(max_slots=3, page_size=8, num_pages=0,
+                     prefill_chunk=112, max_len=176)
+
+    def drive(fleet, only=None):
+        """Timing-faithful drive with NO shed retries — a shed is the
+        datapoint here, not an obstacle."""
+        t0 = time.perf_counter()
+        handles, sheds = [], {}
+        for a in rows:
+            t = a["tenant"]
+            if only is not None and t not in only:
+                continue
+            due = t0 + a["ts_mono"] / max(speed, 1e-6)
+            while True:
+                now = time.perf_counter()
+                if now >= due:
+                    break
+                time.sleep(min(0.02, due - now))
+            try:
+                handles.append((t, fleet.submit(
+                    a["prompt"], max_new_tokens=a["max_new"],
+                    greedy=True, tenant=t)))
+            except ShedError as e:
+                by = sheds.setdefault(t, {})
+                by[e.reason] = by.get(e.reason, 0) + 1
+        drain_to = time.perf_counter() + 300.0
+        for t, h in handles:
+            try:
+                h.result(timeout=max(0.1,
+                                     drain_to - time.perf_counter()))
+            except Exception:
+                pass
+        per = {}
+        for t, h in handles:
+            row = per.setdefault(t, {"submitted": 0, "finished": 0,
+                                     "tokens": 0, "ttfts": []})
+            row["submitted"] += 1
+            if h.state == "finished":
+                row["finished"] += 1
+            row["tokens"] += len(h.tokens)
+            if h.ttft_s is not None:
+                row["ttfts"].append(h.ttft_s * 1e3)
+        out = {}
+        for t in sorted(set(per) | set(sheds)):
+            row = per.get(t, {"submitted": 0, "finished": 0,
+                              "tokens": 0, "ttfts": []})
+            out[t] = {
+                "submitted": row["submitted"],
+                "finished": row["finished"],
+                "shed": sum(sheds.get(t, {}).values()),
+                "shed_reasons": dict(sorted(
+                    sheds.get(t, {}).items())),
+                "generated_tokens": row["tokens"],
+                "ttft_p50_ms": _pct_of(row["ttfts"], 0.50),
+                "ttft_p99_ms": _pct_of(row["ttfts"], 0.99),
+            }
+        return out
+
+    def phase(label, qos, only=None, qos_off=False):
+        if qos_off:
+            os.environ["MXTPU_QOS"] = "0"
+        try:
+            fleet = ServeFleet(model, replicas=replicas, config=sc,
+                               qos_config=qos, stall_timeout=30.0)
+            fleet.warmup()
+            with fleet:
+                # prime the decode widths OUTSIDE the timed window so
+                # no phase's tail is first-compile cost in disguise
+                for p, n in ((list(range(2, 10)), 4),
+                             (list(range(2, 26)), abuse_new),
+                             (list(range(2, 2 + gold_prompt)), 8)):
+                    fleet.submit(p, max_new_tokens=n).result(timeout=60)
+                table = drive(fleet, only=only)
+                qstats = (fleet.stats() or {}).get("qos")
+        finally:
+            if qos_off:
+                os.environ.pop("MXTPU_QOS", None)
+        return {"tenants": table, "qos": qstats}
+
+    solo = phase("solo", qos_cfg, only={"gold"})
+    on = phase("qos_on", qos_cfg)
+    off = phase("qos_off", None, qos_off=True)
+
+    def p99(ph):
+        return (ph["tenants"].get("gold") or {}).get("ttft_p99_ms")
+
+    def degrade(ph):
+        base, got = p99(solo), p99(ph)
+        if not base or got is None:
+            return None
+        return round(100.0 * (got - base) / base, 1)
+
+    total_sheds = sum(t["shed"] for t in on["tenants"].values())
+    abuser_sheds = (on["tenants"].get("abuser") or {}).get("shed", 0)
+    abuser_share = (round(abuser_sheds / total_sheds, 3)
+                    if total_sheds else None)
+    deg_on, deg_off = degrade(on), degrade(off)
+    contained = (deg_on is not None and deg_on < 20.0
+                 and abuser_share is not None and abuser_share >= 0.9)
+    os.environ.update(scoped)
+    extras = {
+        "replicas": replicas,
+        "requests": requests,
+        "seed": seed,
+        "speed": speed,
+        "workload_tenants": spec.tenants,
+        "solo": solo["tenants"],
+        "qos_on": on["tenants"],
+        "qos_off": off["tenants"],
+        "qos_stats": on["qos"],
+        "gold_ttft_p99_ms": {"solo": p99(solo), "qos_on": p99(on),
+                             "qos_off": p99(off)},
+        "gold_degradation_pct": {"qos_on": deg_on, "qos_off": deg_off},
+        "abuser_shed_share_qos_on": abuser_share,
+        "contained": contained,
+        "device": getattr(dev, "device_kind", str(dev)),
+        "platform": dev.platform,
+    }
+    return {
+        "metric": "serve_tenant_gold_p99_degradation_pct",
+        "value": deg_on if deg_on is not None else -1.0,
+        "unit": "percent",
+        "vs_baseline": 0.0,
+        "extras": extras,
+    }
+
+
 def _measure_data() -> dict:
     """`bench.py --data`: throughput of the deterministic input pipeline
     (docs/data.md) — indexed RecordIO shards through the mixture
@@ -1653,6 +1883,16 @@ def main():
                 print(json.dumps(_measure_serve_disagg(
                     _flag_operand("--disagg", "1x2"),
                     int(_flag_operand("--tp", "2")))))
+            elif "--tenants" in sys.argv:
+                # multi-tenant QoS mode: solo / qos-on / qos-off arms
+                # over one seeded tenant mix with an abusive tenant
+                # (docs/serving.md "Per-tenant QoS"); headline is the
+                # protected tenant's p99 TTFT degradation vs solo
+                print(json.dumps(_measure_serve_tenants(
+                    replicas=int(_flag_operand("--replicas", "2")),
+                    requests=int(_flag_operand("--requests", "128")),
+                    seed=int(_flag_operand("--seed", "0")),
+                    speed=float(_flag_operand("--speed", "1.0")))))
             elif "--replicas" in sys.argv:
                 # fleet mode: aggregate tokens/s + tail TTFT under
                 # replica loss (docs/serving.md "Fleet, failover &

@@ -24,7 +24,8 @@ Spec grammar (comma-separated entries)::
     entry            = point@hit[:action]
     point            = injection point name (ckpt_write, ckpt_read,
                        worker_exec, elastic_step, replica_step,
-                       router_dispatch, ... — full table in
+                       router_dispatch, router_admit, tenant_quota,
+                       ... — full table in
                        docs/resilience.md)
     hit              = 1-based occurrence count, per process: the fault
                        fires the hit-th time the point is reached

@@ -68,6 +68,7 @@ from .. import telemetry as _tele
 from .. import tracing as _trace
 from .engine import InferenceEngine, ServeConfig, _env_int
 from .router import RequestRouter
+from . import qos as _qos
 from . import traffic as _traffic
 from .scheduler import (ContinuousBatchingScheduler, ServeRequest,
                         deliver_token, expire_request, finish_request,
@@ -288,7 +289,7 @@ class _RemoteScheduler:
             max_new=req.max_new_tokens - len(req.tokens),
             greedy=req.greedy, temperature=req.temperature,
             eos=req.eos_token_id, front=bool(front),
-            deadline_ms=remaining,
+            deadline_ms=remaining, tenant=req.tenant,
             _span_parent=(req._span.context()
                           if req._span is not None else None),
             _track=f"serve req {req.id}")
@@ -744,7 +745,8 @@ class ServeFleet:
                  transport: Optional[str] = None,
                  respawn_budget: Optional[int] = None,
                  spawn_timeout: float = 120.0,
-                 disagg: Optional[Tuple[int, int]] = None):
+                 disagg: Optional[Tuple[int, int]] = None,
+                 qos_config: Optional[_qos.QoSConfig] = None):
         self.model = model
         self.config = config or ServeConfig()
         # disaggregated serving (docs/serving.md "Disaggregated
@@ -806,10 +808,28 @@ class ServeFleet:
         self.replicas: List[Replica] = []
         for i in range(n):
             self.replicas.append(self._make_replica(i))
+        # per-tenant QoS plane (docs/serving.md "Per-tenant QoS"):
+        # admission quotas/priorities/breaker live PARENT-side in this
+        # controller (they survive worker deaths); WFQ + bulkheads live
+        # in each replica's scheduler — thread replicas get the config
+        # pushed here, process workers re-read MXTPU_QOS_SPEC (the env
+        # is deliberately NOT scoped out of worker_env)
+        cfg_qos = qos_config if qos_config is not None \
+            else _qos.QoSConfig.from_env()
+        self.qos: Optional[_qos.AdmissionController] = \
+            _qos.AdmissionController(cfg_qos) \
+            if cfg_qos is not None else None
+        if self.qos is not None:
+            _qos.install_controller(self.qos)
+            for rep in self.replicas:
+                sched = rep.engine.scheduler
+                if isinstance(sched, ContinuousBatchingScheduler):
+                    sched.set_qos(cfg_qos)
         self.router = RequestRouter(
             lambda: list(self.replicas), queue_bound=router_queue,
             shed_deadline_ms=shed_deadline_ms,
-            default_deadline_ms=self.config.deadline_ms)
+            default_deadline_ms=self.config.deadline_ms,
+            qos=self.qos)
         self.deaths = 0
         # KV handoff pump (prefill -> decode): items queue here from the
         # replica drivers (thread transport) / event readers (process
@@ -1068,6 +1088,8 @@ class ServeFleet:
             self._federated.clear()
         if self.slo is not None:
             self.slo.detach()
+        if self.qos is not None:
+            _qos.uninstall_controller(self.qos)
         self._update_fleet_gauges()
 
     def __enter__(self) -> "ServeFleet":
@@ -1453,6 +1475,7 @@ class ServeFleet:
                         max_new=req.max_new_tokens, greedy=req.greedy,
                         temperature=req.temperature,
                         eos=req.eos_token_id, deadline_ms=remaining,
+                        tenant=req.tenant,
                         _timeout_ms=self.handoff_timeout_ms,
                         _span_parent=ctx, _track=track)
                 except BaseException:
@@ -1602,6 +1625,10 @@ class ServeFleet:
                         rep._last_clock_sync = time.monotonic()
                         rep.sync_clock()
             self.router.sweep_expired()
+            if self.qos is not None:
+                # advance breaker cooldowns (open -> half_open) even
+                # when the quarantined tenant has gone quiet
+                self.qos.tick()
             if self.slo is not None:
                 self.slo.tick()
             self._finalize_due_capsules()
@@ -1724,5 +1751,6 @@ class ServeFleet:
             "respawn_budget": self.respawn_budget,
             "retired": [r.name for r in self.retired],
             "slo": self.slo.evaluate() if self.slo is not None else None,
+            "qos": self.qos.stats() if self.qos is not None else None,
             "capsules": list(self.capsules),
         }

@@ -1,0 +1,663 @@
+"""Per-tenant QoS plane: admission quotas, priority classes, weighted-
+fair scheduling state, and noisy-neighbor containment.
+
+One undifferentiated queue means one abusive caller degrades every
+caller.  This module gives the fleet a per-tenant contract instead
+(docs/serving.md "Per-tenant QoS"):
+
+- **Priority classes** — ``interactive`` > ``batch`` > ``best_effort``.
+  Under overload the lowest class sheds first; *within* a class the
+  deadline-aware shed policy is unchanged.
+- **Token buckets** — per-tenant request-rate (``rps``) and
+  token-throughput (``tps``) quotas, each with a burst window.  A tenant
+  over quota is shed with reason ``quota`` before it can occupy router
+  or scheduler state.
+- **Weighted-fair queueing** — `WeightedFairQueue` keeps virtual-time
+  state the continuous-batching scheduler consults when seating slots,
+  so a burst tenant cannot starve others of decode slots; per-tenant
+  bulkheads cap concurrent slots and projected KV pages.
+- **Circuit breaker** — repeated offenses (deadline blowouts, malformed
+  or fault-injected submits) quarantine a tenant (shed reason
+  ``quarantine``); after a cooldown the breaker goes half-open and
+  admits a bounded number of probes before closing again.
+
+Admission decisions are pluggable through the registry.py idiom:
+subclass :class:`AdmissionPolicy`, decorate with :func:`register`, and
+select via ``MXTPU_QOS_POLICY`` (default ``token_bucket``;
+``permissive`` meters but never sheds).
+
+Configuration comes from ``MXTPU_QOS_SPEC`` (inline JSON or a path to a
+JSON file) with the grammar::
+
+    {"policy": "token_bucket",
+     "default": {"priority": "batch", "weight": 1.0},
+     "tenants": {"gold":   {"priority": "interactive", "weight": 8.0},
+                 "abuser": {"priority": "best_effort", "rps": 5,
+                            "tps": 500, "max_slots": 1}},
+     "breaker": {"offenses": 3, "window_s": 30, "cooldown_s": 10,
+                 "probes": 1}}
+
+``MXTPU_QOS=0`` disables the plane even when a spec is present (the
+bench's "QoS off" arm); ``MXTPU_QOS=1`` enables it with pure defaults
+(fair weights, no quotas) when no spec is given.  Unknown keys are
+rejected eagerly, like ``MXTPU_SLO_SPEC``.
+
+Chaos points (``MXTPU_FAULT_SPEC``): ``router_admit`` fires on every
+admission check — an injected fault is counted as a tenant offense (the
+deterministic way to drive the breaker) and surfaces to the caller as an
+`MXNetError`; ``tenant_quota`` fires on the quota charge — an injected
+fault becomes a forced ``quota`` shed.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field, fields as _dc_fields
+from typing import Dict, Optional, Tuple
+
+from ..base import MXNetError
+from ..registry import get_create_func, get_register_func
+from ..resilience import fault_point
+from .. import telemetry as _tele
+
+__all__ = ["ENV_QOS", "ENV_QOS_SPEC", "ENV_QOS_POLICY",
+           "PRIORITY_CLASSES", "class_rank", "TenantPolicy",
+           "BreakerPolicy", "QoSConfig", "AdmissionPolicy", "register",
+           "create", "AdmissionController", "WeightedFairQueue",
+           "POLICY_SHED_REASONS", "OVERLOAD_SHED_REASONS"]
+
+ENV_QOS = "MXTPU_QOS"
+ENV_QOS_SPEC = "MXTPU_QOS_SPEC"
+ENV_QOS_POLICY = "MXTPU_QOS_POLICY"
+
+#: shed classes for capsule/replay triage: policy sheds are deliberate
+#: QoS verdicts; overload sheds mean the fleet itself ran out of room
+POLICY_SHED_REASONS = frozenset(("quota", "priority", "quarantine"))
+OVERLOAD_SHED_REASONS = frozenset(("queue_full", "deadline",
+                                   "no_replicas"))
+
+#: shed order under overload: later classes shed first
+PRIORITY_CLASSES = ("interactive", "batch", "best_effort")
+
+#: label used for requests submitted without a tenant
+DEFAULT_TENANT = "-"
+
+
+def class_rank(priority: str) -> int:
+    """Numeric rank of a priority class (0 = most protected)."""
+    return PRIORITY_CLASSES.index(priority)
+
+
+def _key(tenant: Optional[str]) -> str:
+    return tenant if tenant else DEFAULT_TENANT
+
+
+# ---------------------------------------------------------------------------
+# configuration
+# ---------------------------------------------------------------------------
+@dataclass
+class TenantPolicy:
+    """Quota/priority contract for one tenant (``0`` = unlimited)."""
+
+    priority: str = "batch"
+    weight: float = 1.0         # WFQ service weight
+    rps: float = 0.0            # request-rate quota (requests/s)
+    tps: float = 0.0            # token-throughput quota (tokens/s)
+    burst_s: float = 2.0        # bucket depth, in seconds of quota
+    max_slots: int = 0          # bulkhead: concurrent decode slots
+    max_pages: int = 0          # bulkhead: projected KV pages
+
+    def __post_init__(self):
+        if self.priority not in PRIORITY_CLASSES:
+            raise MXNetError(
+                f"unknown priority class {self.priority!r}; known: "
+                f"{list(PRIORITY_CLASSES)}")
+        if self.weight <= 0:
+            raise MXNetError("tenant weight must be > 0")
+        for name in ("rps", "tps", "burst_s"):
+            if getattr(self, name) < 0:
+                raise MXNetError(f"tenant {name} must be >= 0")
+        for name in ("max_slots", "max_pages"):
+            if getattr(self, name) < 0:
+                raise MXNetError(f"tenant {name} must be >= 0")
+
+    @property
+    def rank(self) -> int:
+        return class_rank(self.priority)
+
+
+@dataclass
+class BreakerPolicy:
+    """Tenant circuit-breaker contract (``offenses=0`` disables it)."""
+
+    offenses: int = 0           # offenses within window_s that trip it
+    window_s: float = 30.0
+    cooldown_s: float = 10.0    # open -> half_open delay
+    probes: int = 1             # admissions allowed while half-open
+
+    def __post_init__(self):
+        if self.offenses < 0:
+            raise MXNetError("breaker offenses must be >= 0")
+        if self.window_s <= 0 or self.cooldown_s <= 0:
+            raise MXNetError("breaker window_s/cooldown_s must be > 0")
+        if self.probes < 1:
+            raise MXNetError("breaker probes must be >= 1")
+
+
+def _policy_from(spec: dict, what: str) -> TenantPolicy:
+    known = {f.name for f in _dc_fields(TenantPolicy)}
+    unknown = set(spec) - known
+    if unknown:
+        raise MXNetError(
+            f"unknown key(s) {sorted(unknown)} in {what}; known: "
+            f"{sorted(known)}")
+    return TenantPolicy(**spec)
+
+
+@dataclass
+class QoSConfig:
+    """Parsed ``MXTPU_QOS_SPEC``: default policy, per-tenant overrides,
+    breaker contract, and the admission-policy name."""
+
+    default: TenantPolicy = field(default_factory=TenantPolicy)
+    tenants: Dict[str, TenantPolicy] = field(default_factory=dict)
+    breaker: BreakerPolicy = field(default_factory=BreakerPolicy)
+    policy: str = "token_bucket"
+
+    def policy_for(self, tenant: Optional[str]) -> TenantPolicy:
+        return self.tenants.get(_key(tenant), self.default)
+
+    @classmethod
+    def from_spec(cls, spec: dict) -> "QoSConfig":
+        if not isinstance(spec, dict):
+            raise MXNetError("QoS spec must be a JSON object")
+        known = ("default", "tenants", "breaker", "policy")
+        unknown = set(spec) - set(known)
+        if unknown:
+            raise MXNetError(
+                f"unknown key(s) {sorted(unknown)} in QoS spec; known: "
+                f"{list(known)}")
+        default = _policy_from(spec.get("default", {}), "QoS default")
+        tenants = {
+            str(name): _policy_from(tspec, f"QoS tenant {name!r}")
+            for name, tspec in (spec.get("tenants") or {}).items()}
+        bspec = spec.get("breaker", {})
+        bknown = {f.name for f in _dc_fields(BreakerPolicy)}
+        bunknown = set(bspec) - bknown
+        if bunknown:
+            raise MXNetError(
+                f"unknown key(s) {sorted(bunknown)} in QoS breaker; "
+                f"known: {sorted(bknown)}")
+        return cls(default=default, tenants=tenants,
+                   breaker=BreakerPolicy(**bspec),
+                   policy=str(spec.get("policy")
+                              or os.environ.get(ENV_QOS_POLICY)
+                              or "token_bucket"))
+
+    @classmethod
+    def from_env(cls) -> Optional["QoSConfig"]:
+        """The configured QoS plane, or None when disabled.  Parse
+        errors raise eagerly — a misconfigured QoS plane must fail the
+        fleet at startup, not silently admit everything."""
+        switch = os.environ.get(ENV_QOS, "").strip().lower()
+        if switch in ("0", "off", "false"):
+            return None
+        raw = os.environ.get(ENV_QOS_SPEC, "").strip()
+        if not raw:
+            if switch in ("1", "on", "true"):
+                return cls()        # defaults: fair weights, no quotas
+            return None
+        if not raw.lstrip().startswith("{"):
+            try:
+                with open(raw, "r", encoding="utf-8") as fh:
+                    raw = fh.read()
+            except OSError as exc:
+                raise MXNetError(
+                    f"cannot read {ENV_QOS_SPEC} file {raw!r}: {exc}"
+                ) from exc
+        try:
+            spec = json.loads(raw)
+        except ValueError as exc:
+            raise MXNetError(
+                f"{ENV_QOS_SPEC} is not valid JSON: {exc}") from exc
+        return cls.from_spec(spec)
+
+
+# ---------------------------------------------------------------------------
+# token bucket
+# ---------------------------------------------------------------------------
+class _Bucket:
+    """Leaky token bucket; ``rate <= 0`` means unlimited."""
+
+    def __init__(self, rate: float, burst: float, clock=time.monotonic):
+        self.rate = float(rate)
+        self.burst = max(float(burst), 1.0)
+        self._clock = clock
+        self._level = self.burst
+        self._last = clock()
+
+    def _refill(self, now: float) -> None:
+        self._level = min(self.burst,
+                          self._level + (now - self._last) * self.rate)
+        self._last = now
+
+    def take(self, n: float = 1.0) -> bool:
+        if self.rate <= 0:
+            return True
+        self._refill(self._clock())
+        if self._level < n:
+            return False
+        self._level -= n
+        return True
+
+    def fill(self) -> float:
+        """Current fill fraction (1.0 = full burst available)."""
+        if self.rate <= 0:
+            return 1.0
+        self._refill(self._clock())
+        return self._level / self.burst
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+_BREAKER_STATES = ("closed", "open", "half_open")
+
+
+class _Breaker:
+    """Per-tenant circuit breaker: ``closed`` -> (offenses) -> ``open``
+    -> (cooldown) -> ``half_open`` -> probe success -> ``closed`` /
+    probe offense -> ``open`` again."""
+
+    def __init__(self, policy: BreakerPolicy, clock=time.monotonic):
+        self.policy = policy
+        self._clock = clock
+        self.state = "closed"
+        self.trips = 0
+        self._offenses: deque = deque()
+        self._opened_at = 0.0
+        self._probes_left = 0
+
+    def _advance(self, now: float) -> None:
+        if self.state == "open" \
+                and now - self._opened_at >= self.policy.cooldown_s:
+            self.state = "half_open"
+            self._probes_left = self.policy.probes
+
+    def _open(self, now: float) -> None:
+        self.state = "open"
+        self.trips += 1
+        self._opened_at = now
+        self._offenses.clear()
+
+    def offense(self) -> bool:
+        """Record one offense; True when this offense tripped (or
+        re-tripped) the breaker."""
+        if self.policy.offenses <= 0:
+            return False
+        now = self._clock()
+        self._advance(now)
+        if self.state == "half_open":
+            self._open(now)     # a misbehaving probe re-quarantines
+            return True
+        if self.state == "open":
+            return False
+        self._offenses.append(now)
+        while self._offenses and \
+                now - self._offenses[0] > self.policy.window_s:
+            self._offenses.popleft()
+        if len(self._offenses) >= self.policy.offenses:
+            self._open(now)
+            return True
+        return False
+
+    def allow(self) -> bool:
+        """Admission verdict: False while quarantined (open, or
+        half-open with the probe budget spent)."""
+        if self.policy.offenses <= 0:
+            return True
+        now = self._clock()
+        self._advance(now)
+        if self.state == "closed":
+            return True
+        if self.state == "half_open" and self._probes_left > 0:
+            self._probes_left -= 1
+            return True
+        return False
+
+    def success(self) -> None:
+        """A half-open probe finished cleanly: close the breaker."""
+        if self.state == "half_open":
+            self.state = "closed"
+            self._offenses.clear()
+
+    def tick(self) -> None:
+        self._advance(self._clock())
+
+
+# ---------------------------------------------------------------------------
+# per-tenant runtime state
+# ---------------------------------------------------------------------------
+class _TenantState:
+    def __init__(self, tenant: str, policy: TenantPolicy,
+                 breaker: BreakerPolicy, clock=time.monotonic):
+        self.tenant = tenant
+        self.policy = policy
+        self.req_bucket = _Bucket(
+            policy.rps, policy.rps * policy.burst_s, clock)
+        self.tok_bucket = _Bucket(
+            policy.tps, policy.tps * policy.burst_s, clock)
+        self.breaker = _Breaker(breaker, clock)
+        self.admitted = 0
+        self.offenses = 0
+        self.sheds: Dict[str, int] = {}
+
+
+# ---------------------------------------------------------------------------
+# pluggable admission policies (registry.py idiom)
+# ---------------------------------------------------------------------------
+class AdmissionPolicy:
+    """Per-request admission verdict for one tenant.  Subclass,
+    decorate with :func:`register`, select via ``MXTPU_QOS_POLICY`` or
+    the spec's ``"policy"`` key.  Return ``None`` to admit, or a
+    ``(reason, detail)`` pair to shed (reason becomes the `ShedError`
+    reason and the ``serve_shed_total`` label)."""
+
+    def admit(self, state: _TenantState, tenant: Optional[str],
+              tokens: int) -> Optional[Tuple[str, str]]:
+        raise NotImplementedError
+
+
+register = get_register_func(AdmissionPolicy, "admission policy")
+create = get_create_func(AdmissionPolicy, "admission policy")
+
+
+@register
+class TokenBucketPolicy(AdmissionPolicy):
+    """Default policy: charge the tenant's request bucket (1 request)
+    and token bucket (prompt + max_new tokens); either empty sheds with
+    reason ``quota``."""
+
+    def admit(self, state, tenant, tokens):
+        if not state.req_bucket.take(1.0):
+            return ("quota",
+                    f"tenant {_key(tenant)!r} over request-rate quota "
+                    f"({state.policy.rps:g} req/s)")
+        if not state.tok_bucket.take(float(tokens)):
+            return ("quota",
+                    f"tenant {_key(tenant)!r} over token-throughput "
+                    f"quota ({state.policy.tps:g} tok/s)")
+        return None
+
+
+register(TokenBucketPolicy, "token_bucket")
+
+
+@register
+class PermissivePolicy(AdmissionPolicy):
+    """Meter-only policy: quotas and breakers are tracked for
+    observability but never shed (canary mode for a new spec)."""
+
+    def admit(self, state, tenant, tokens):
+        state.req_bucket.take(1.0)
+        state.tok_bucket.take(float(tokens))
+        return None
+
+
+register(PermissivePolicy, "permissive")
+
+
+# ---------------------------------------------------------------------------
+# admission controller (router-side)
+# ---------------------------------------------------------------------------
+class AdmissionController:
+    """The router's QoS brain: tenant lookup, quota charge, breaker
+    verdicts, and per-tenant telemetry.  One instance per fleet, living
+    in the PARENT process — breaker and quota state survive worker
+    crashes and respawns by construction."""
+
+    def __init__(self, config: QoSConfig, clock=time.monotonic):
+        self.config = config
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._tenants: Dict[str, _TenantState] = {}
+        self._policy = create(
+            os.environ.get(ENV_QOS_POLICY) or config.policy)
+        self.policy_name = type(self._policy).__name__
+
+    # -- state -----------------------------------------------------------
+    def _state(self, tenant: Optional[str]) -> _TenantState:
+        key = _key(tenant)
+        with self._lock:
+            st = self._tenants.get(key)
+            if st is None:
+                st = _TenantState(key, self.config.policy_for(tenant),
+                                  self.config.breaker, self._clock)
+                self._tenants[key] = st
+            return st
+
+    def class_rank(self, tenant: Optional[str]) -> int:
+        return self.config.policy_for(tenant).rank
+
+    # -- admission -------------------------------------------------------
+    def admit(self, tenant: Optional[str],
+              tokens: int) -> Optional[Tuple[str, str]]:
+        """None to admit; ``(reason, detail)`` to shed.  May raise the
+        injected ``router_admit`` fault (counted as a tenant offense)."""
+        st = self._state(tenant)
+        try:
+            fault_point("router_admit")
+        except Exception as exc:
+            # an injected admission fault is this tenant "misbehaving":
+            # it feeds the breaker exactly like a malformed submit, and
+            # the caller sees the failure (chaos drill for quarantine)
+            self.note_offense(tenant, "fault")
+            raise MXNetError(
+                f"admission check failed for tenant {_key(tenant)!r}: "
+                f"{type(exc).__name__}: {exc}") from exc
+        if not st.breaker.allow():
+            self._gauges(st)
+            return ("quarantine",
+                    f"tenant {_key(tenant)!r} quarantined by circuit "
+                    f"breaker ({st.breaker.state}, "
+                    f"{st.breaker.trips} trip(s))")
+        try:
+            fault_point("tenant_quota")
+        except Exception as exc:
+            return ("quota",
+                    f"injected quota denial for tenant "
+                    f"{_key(tenant)!r}: {exc}")
+        verdict = self._policy.admit(st, tenant, tokens)
+        if verdict is None:
+            st.admitted += 1
+            if _tele.enabled():
+                _tele.counter(
+                    "serve_tenant_admitted_total",
+                    "Requests admitted, by tenant",
+                    labelnames=("tenant",)).inc(tenant=st.tenant)
+        self._gauges(st)
+        return verdict
+
+    # -- offenses / outcomes --------------------------------------------
+    def note_offense(self, tenant: Optional[str], kind: str) -> None:
+        st = self._state(tenant)
+        st.offenses += 1
+        tripped = st.breaker.offense()
+        if _tele.enabled():
+            _tele.counter(
+                "serve_tenant_offenses_total",
+                "Breaker offenses (deadline blowouts, malformed or "
+                "fault-injected submits), by tenant",
+                labelnames=("tenant", "kind")).inc(
+                    tenant=st.tenant, kind=kind)
+            if tripped:
+                _tele.event("tenant_breaker", tenant=st.tenant,
+                            state=st.breaker.state, kind=kind,
+                            trips=st.breaker.trips)
+        self._gauges(st)
+
+    def note_malformed(self, tenant: Optional[str]) -> None:
+        self.note_offense(tenant, "malformed")
+
+    def note_terminal(self, req, state: str) -> None:
+        """Terminal-path hook (scheduler.terminate_request): deadline
+        blowouts are offenses; a clean finish closes a half-open
+        breaker."""
+        if state == "expired":
+            self.note_offense(req.tenant, "deadline")
+        elif state == "finished":
+            st = self._state(req.tenant)
+            if st.breaker.state == "half_open":
+                st.breaker.success()
+                if _tele.enabled():
+                    _tele.event("tenant_breaker", tenant=st.tenant,
+                                state="closed", kind="probe_success",
+                                trips=st.breaker.trips)
+                self._gauges(st)
+
+    def record_shed(self, tenant: Optional[str], reason: str) -> None:
+        st = self._state(tenant)
+        st.sheds[reason] = st.sheds.get(reason, 0) + 1
+        if _tele.enabled():
+            _tele.counter(
+                "serve_tenant_sheds_total",
+                "Requests shed, by tenant and reason",
+                labelnames=("tenant", "reason")).inc(
+                    tenant=st.tenant, reason=reason)
+
+    # -- maintenance -----------------------------------------------------
+    def tick(self) -> None:
+        """Supervisor sweep: advance breaker cooldowns and refresh
+        per-tenant gauges even when a quarantined tenant goes quiet."""
+        with self._lock:
+            states = list(self._tenants.values())
+        for st in states:
+            before = st.breaker.state
+            st.breaker.tick()
+            if st.breaker.state != before and _tele.enabled():
+                _tele.event("tenant_breaker", tenant=st.tenant,
+                            state=st.breaker.state, kind="cooldown",
+                            trips=st.breaker.trips)
+            self._gauges(st)
+
+    def _gauges(self, st: _TenantState) -> None:
+        if not _tele.enabled():
+            return
+        _tele.gauge(
+            "serve_tenant_quota_fill",
+            "Token-bucket fill fraction (1 = full burst available)",
+            labelnames=("tenant", "bucket")).set(
+                round(st.req_bucket.fill(), 4),
+                tenant=st.tenant, bucket="requests")
+        _tele.gauge(
+            "serve_tenant_quota_fill",
+            "Token-bucket fill fraction (1 = full burst available)",
+            labelnames=("tenant", "bucket")).set(
+                round(st.tok_bucket.fill(), 4),
+                tenant=st.tenant, bucket="tokens")
+        _tele.gauge(
+            "serve_tenant_breaker_state",
+            "Tenant circuit-breaker state "
+            "(0=closed, 1=half_open, 2=open)",
+            labelnames=("tenant",)).set(
+                {"closed": 0, "half_open": 1, "open": 2}[
+                    st.breaker.state], tenant=st.tenant)
+
+    def stats(self) -> dict:
+        with self._lock:
+            states = list(self._tenants.values())
+        return {
+            "policy": self.policy_name,
+            "tenants": {
+                st.tenant: {
+                    "priority": st.policy.priority,
+                    "weight": st.policy.weight,
+                    "admitted": st.admitted,
+                    "sheds": dict(st.sheds),
+                    "offenses": st.offenses,
+                    "breaker": st.breaker.state,
+                    "breaker_trips": st.breaker.trips,
+                    "quota_fill": {
+                        "requests": round(st.req_bucket.fill(), 4),
+                        "tokens": round(st.tok_bucket.fill(), 4)},
+                } for st in states}}
+
+
+# ---------------------------------------------------------------------------
+# weighted-fair queueing (scheduler-side)
+# ---------------------------------------------------------------------------
+class WeightedFairQueue:
+    """Virtual-time WFQ over tenants: each admission charges the
+    tenant's virtual finish time by ``cost / weight``; the scheduler
+    seats the head-of-line request of the tenant with the SMALLEST
+    start tag.  A burst tenant's finish time races ahead of the virtual
+    clock, so patient tenants keep winning slots in proportion to their
+    weights — starvation-free by construction."""
+
+    def __init__(self, config: QoSConfig):
+        self.config = config
+        self._vtime = 0.0
+        self._finish: Dict[str, float] = {}
+        self.serviced: Dict[str, float] = {}
+
+    def start_tag(self, tenant: Optional[str]) -> float:
+        return max(self._vtime, self._finish.get(_key(tenant), 0.0))
+
+    def charge(self, tenant: Optional[str], cost: float) -> None:
+        key = _key(tenant)
+        start = self.start_tag(tenant)
+        weight = max(self.config.policy_for(tenant).weight, 1e-9)
+        self._finish[key] = start + float(cost) / weight
+        self._vtime = start
+        self.serviced[key] = self.serviced.get(key, 0.0) + float(cost)
+        if _tele.enabled():
+            total = sum(self.serviced.values()) or 1.0
+            for t, v in self.serviced.items():
+                _tele.gauge(
+                    "serve_tenant_wfq_share",
+                    "Fraction of admitted decode cost, by tenant",
+                    labelnames=("tenant",)).set(
+                        round(v / total, 4), tenant=t)
+
+    def shares(self) -> Dict[str, float]:
+        total = sum(self.serviced.values())
+        if total <= 0:
+            return {}
+        return {t: v / total for t, v in self.serviced.items()}
+
+
+# ---------------------------------------------------------------------------
+# process-wide controller (terminal-path hook)
+# ---------------------------------------------------------------------------
+_active: Optional[AdmissionController] = None
+
+
+def install_controller(ctrl: Optional[AdmissionController]) -> None:
+    """Make `ctrl` the process-wide controller consulted by the
+    scheduler's terminal paths (one fleet per process in practice)."""
+    global _active
+    _active = ctrl
+
+
+def uninstall_controller(ctrl: AdmissionController) -> None:
+    global _active
+    if _active is ctrl:
+        _active = None
+
+
+def current_controller() -> Optional[AdmissionController]:
+    return _active
+
+
+def note_terminal(req, state: str) -> None:
+    """Called by scheduler.terminate_request for EVERY terminal request
+    in this process; no-op unless a controller is installed."""
+    ctrl = _active
+    if ctrl is not None:
+        try:
+            ctrl.note_terminal(req, state)
+        except Exception:
+            pass    # QoS accounting must never break a terminal path

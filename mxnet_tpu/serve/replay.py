@@ -35,6 +35,7 @@ from .. import telemetry as _tele
 from .. import slo as _slo
 from . import traffic as _traffic
 from .engine import ServeConfig
+from .qos import POLICY_SHED_REASONS
 from .router import ShedError
 
 __all__ = ["replay_trace", "replay_capsule"]
@@ -105,6 +106,7 @@ def replay_trace(fleet, trace, *, speed: float = 0.0,
     t0 = time.perf_counter()
     handles: Dict[int, object] = {}      # original rid -> ServeRequest
     shed_replay: List[dict] = []
+    shed_reasons_replay: Dict[str, int] = {}
     retries = 0
     kill_info = None
     for a in arrivals:
@@ -121,6 +123,7 @@ def replay_trace(fleet, trace, *, speed: float = 0.0,
         if speed > 0 and a.get("deadline_ms"):
             deadline = float(a["deadline_ms"]) / speed
         req = None
+        last_shed = None
         for _ in range(_MAX_SHED_RETRIES):
             try:
                 req = fleet.submit(
@@ -133,10 +136,23 @@ def replay_trace(fleet, trace, *, speed: float = 0.0,
                 break
             except ShedError as e:
                 retries += 1
+                last_shed = e.reason
+                shed_reasons_replay[e.reason] = \
+                    shed_reasons_replay.get(e.reason, 0) + 1
+                if e.reason in POLICY_SHED_REASONS:
+                    # a policy shed (quota/priority/quarantine) is a
+                    # deliberate per-tenant verdict: retrying a
+                    # quarantined or over-quota tenant in a tight loop
+                    # only re-proves the verdict — record and move on
+                    break
                 time.sleep(max(0.001, e.retry_after_ms / 1e3))
         if req is None:
             shed_replay.append({"rid": a["rid"],
-                                "reason": "shed_retries_exhausted"})
+                                "reason": ("policy_shed"
+                                           if last_shed in
+                                           POLICY_SHED_REASONS
+                                           else "shed_retries_exhausted"),
+                                "shed_reason": last_shed})
         else:
             handles[a["rid"]] = req
     kill_info = _maybe_kill(float("inf")) or kill_info
@@ -179,6 +195,30 @@ def replay_trace(fleet, trace, *, speed: float = 0.0,
                 "replayed_tokens": len(req.tokens),
                 "replay_state": "finished"})
 
+    # shed-reason breakdown (docs/serving.md "Per-tenant QoS"): recorded
+    # rows come from the trace's rid-tagged shed outcomes (priority
+    # preemptions etc.; admission-time sheds are rid-less and live only
+    # in the raw journal), replayed ones from the live ShedErrors above.
+    # The policy/overload split is what a capsule reader needs first: a
+    # policy shed (quota/priority/quarantine) is the QoS plane working
+    # as configured, an overload shed (queue_full/deadline/no_replicas)
+    # is genuine capacity exhaustion.
+    shed_reasons_recorded: Dict[str, int] = {}
+    for o in outcomes.values():
+        if o.get("state") == "shed":
+            r = o.get("shed_reason") or "unknown"
+            shed_reasons_recorded[r] = shed_reasons_recorded.get(r, 0) + 1
+
+    def _split(counts: Dict[str, int]) -> dict:
+        policy = sum(n for r, n in counts.items()
+                     if r in POLICY_SHED_REASONS)
+        return {"by_reason": dict(sorted(counts.items())),
+                "policy": policy,
+                "overload": sum(counts.values()) - policy}
+
+    shed_reasons = {"recorded": _split(shed_reasons_recorded),
+                    "replayed": _split(shed_reasons_replay)}
+
     slo_state = None
     slo_alerting = False
     if getattr(fleet, "slo", None) is not None:
@@ -199,6 +239,7 @@ def replay_trace(fleet, trace, *, speed: float = 0.0,
         "submitted": len(handles),
         "shed_replay": shed_replay,
         "shed_retries": retries,
+        "shed_reasons": shed_reasons,
         "kill": kill_info,
         "matched": matched,
         "divergent": divergent,

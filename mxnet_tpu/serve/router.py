@@ -28,6 +28,15 @@ Shedding policy (docs/serving.md "Fleet, failover & overload"):
   the caller one RTT instead of a guaranteed-late answer.
 - ``no_replicas`` — no running replica exists to ever serve it.
 
+With a QoS plane configured (serve/qos.py, docs/serving.md "Per-tenant
+QoS") three tenant-aware reasons join the list, checked BEFORE the
+overload ones: ``quota`` (token-bucket rate/throughput quota),
+``quarantine`` (tenant circuit breaker open), and ``priority`` — at the
+queue bound a new arrival of a HIGHER class preempts the youngest
+parked request of the lowest class instead of being shed itself, so
+under overload the lowest class sheds first while within a class the
+deadline policy above is unchanged.
+
 Every shed raises :class:`ShedError` carrying ``reason`` and
 ``retry_after_ms``, increments ``serve_shed_total{reason=}``, and lands
 as a ``shed`` journal event + ``serve.shed`` span.  Failover and drain
@@ -51,6 +60,7 @@ from ..base import MXNetError
 from ..resilience import fault_point
 from .. import telemetry as _tele
 from .. import tracing as _trace
+from . import qos as _qos
 from . import traffic as _traffic
 from .engine import _env_int
 from .scheduler import (ServeRequest, _open_queue_span, expire_request,
@@ -68,8 +78,9 @@ class _DispatchFault(Exception):
 class ShedError(MXNetError):
     """Raised by `RequestRouter.submit` when the fleet refuses a request
     under overload.  ``reason`` is one of ``queue_full`` / ``deadline`` /
-    ``no_replicas``; ``retry_after_ms`` is the router's hint for when a
-    retry is likely to be admitted."""
+    ``no_replicas`` — or, with a QoS plane configured, ``quota`` /
+    ``priority`` / ``quarantine``; ``retry_after_ms`` is the router's
+    hint for when a retry is likely to be admitted."""
 
     def __init__(self, reason: str, retry_after_ms: float, detail: str):
         super().__init__(
@@ -91,8 +102,11 @@ class RequestRouter:
     def __init__(self, replicas: Callable[[], List],
                  queue_bound: Optional[int] = None,
                  shed_deadline_ms: Optional[float] = None,
-                 default_deadline_ms: float = 0.0):
+                 default_deadline_ms: float = 0.0,
+                 qos: Optional["_qos.AdmissionController"] = None):
         self._replicas = replicas
+        #: per-tenant QoS plane (None = classless admission)
+        self.qos = qos
         #: global parked-queue bound (MXTPU_ROUTER_QUEUE)
         self.queue_bound = queue_bound if queue_bound is not None \
             else _env_int("MXTPU_ROUTER_QUEUE", 64)
@@ -190,8 +204,20 @@ class RequestRouter:
                        "(every running replica has role 'decode')")
         # validate against the (shared) replica config before creating
         # anything — a never-fits request fails fast like engine.submit
+        # (with QoS, a malformed submit is a breaker offense: a tenant
+        # spraying garbage earns quarantine, not just per-call errors)
         template = running[0].engine.scheduler
-        prompt = template.validate_request(prompt, max_new_tokens)
+        try:
+            prompt = template.validate_request(prompt, max_new_tokens)
+        except MXNetError:
+            if self.qos is not None:
+                self.qos.note_malformed(tenant)
+            raise
+        if self.qos is not None:
+            verdict = self.qos.admit(
+                tenant, len(prompt) + int(max_new_tokens))
+            if verdict is not None:
+                self._shed(verdict[0], verdict[1], tenant=tenant)
         deadline = self.default_deadline_ms if deadline_ms is None \
             else float(deadline_ms or 0.0)
 
@@ -207,23 +233,33 @@ class RequestRouter:
             # configured bound (spans/journal open only after the
             # request is actually admitted, so a shed leaves no trace
             # state behind)
+            victim = None
             with self._lock:
                 depth = len(self._queue)
                 if depth >= self.queue_bound:
-                    self._shed(
-                        "queue_full",
-                        f"global queue at bound {self.queue_bound}",
-                        depth=depth)
+                    victim = self._preempt_victim(tenant)
+                    if victim is None:
+                        self._shed(
+                            "queue_full",
+                            f"global queue at bound {self.queue_bound}",
+                            depth=depth, tenant=tenant)
+                    self._queue.remove(victim)
+                    depth -= 1
                 eff_deadline = deadline or self.shed_deadline_ms
                 est = self._estimated_wait_ms(depth, len(running))
                 if eff_deadline > 0 and est > eff_deadline:
+                    if victim is not None:
+                        self._queue.append(victim)   # arrival loses
+                        victim = None
                     self._shed(
                         "deadline",
                         f"estimated queue wait {est:.0f} ms exceeds "
                         f"the request deadline {eff_deadline:g} ms",
-                        depth=depth)
+                        depth=depth, tenant=tenant)
                 self._queue.append(req)
                 req._parked_ts = time.perf_counter()
+            if victim is not None:
+                self._shed_parked(victim)
             self._admitted(req)
             self._note_parked(req)
             return req
@@ -240,12 +276,54 @@ class RequestRouter:
         once it is actually IN the fleet (dispatched or parked)."""
         self._trace_submit(req)
         if _tele.enabled():
+            fields = {"tenant": req.tenant} \
+                if req.tenant is not None else {}
             _tele.event("request", request_id=req.id, phase="submitted",
-                        fleet=True)
+                        fleet=True, **fields)
         _traffic.note_arrival(req)
 
+    def _preempt_victim(self, tenant) -> Optional[ServeRequest]:
+        """Holding self._lock: the parked request a full queue evicts to
+        make room for a HIGHER-class arrival — the youngest parked
+        request of the lowest class strictly below the arrival's.
+        Requests with generated tokens are admitted work mid-stream and
+        are never preempted.  None -> the arrival itself sheds."""
+        if self.qos is None:
+            return None
+        new_rank = self.qos.class_rank(tenant)
+        victim, victim_rank = None, new_rank
+        for req in self._queue:        # last match = youngest parked
+            if req.tokens:
+                continue
+            rank = self.qos.class_rank(req.tenant)
+            if rank <= new_rank:
+                continue               # only STRICTLY lower classes
+            if victim is None or rank >= victim_rank:
+                victim, victim_rank = req, rank
+        return victim
+
+    def _shed_parked(self, req: ServeRequest) -> None:
+        """Terminate an already-parked request shed by priority
+        preemption: it HAS an arrival row, so its shed is journaled as
+        an outcome (state=shed, shed_reason=priority) — capsules can
+        tell this policy shed from an overload shed."""
+        self.sheds += 1
+        if _tele.enabled():
+            _tele.counter(
+                "serve_shed_total",
+                "Requests rejected by fleet admission control",
+                labelnames=("reason",)).inc(reason="priority")
+        if self.qos is not None:
+            self.qos.record_shed(req.tenant, "priority")
+        terminate_request(
+            req, "preempted from the full router queue by a "
+                 "higher-priority arrival",
+            state="shed", phase="shed", shed_reason="priority",
+            reason="priority", tenant=req.tenant)
+        self._update_gauge()
+
     def _shed(self, reason: str, detail: str,
-              depth: Optional[int] = None) -> None:
+              depth: Optional[int] = None, tenant=None) -> None:
         if depth is None:
             with self._lock:
                 depth = len(self._queue)
@@ -254,19 +332,21 @@ class RequestRouter:
         hint = max(50.0, self._estimated_wait_ms(depth, running) or
                    self._wait_ema_ms or 250.0)
         self.sheds += 1
+        if self.qos is not None:
+            self.qos.record_shed(tenant, reason)
         if _tele.enabled():
             _tele.counter(
                 "serve_shed_total",
                 "Requests rejected by fleet admission control",
                 labelnames=("reason",)).inc(reason=reason)
-            _tele.event("shed", reason=reason,
+            _tele.event("shed", reason=reason, tenant=tenant,
                         retry_after_ms=round(hint, 1), detail=detail)
         if _trace.enabled():
             now = time.perf_counter()
             _trace.get_tracer("serve").record_span(
                 "serve.shed", now, now, track="serve router",
                 reason=reason, retry_after_ms=round(hint, 1))
-        _traffic.note_shed(reason, detail)
+        _traffic.note_shed(reason, detail, tenant=tenant)
         raise ShedError(reason, hint, detail)
 
     def _estimated_wait_ms(self, queue_len: int, running: int) -> float:

@@ -48,6 +48,7 @@ from ..base import MXNetError
 from ..resilience import fault_point
 from .. import telemetry as _tele
 from .. import tracing as _trace
+from . import qos as _qos
 from . import traffic as _traffic
 from .kv_cache import NULL_PAGE
 
@@ -170,6 +171,7 @@ def _open_queue_span(req: ServeRequest, reason: str) -> None:
 
 def terminate_request(req: ServeRequest, err: str, *, state: str = "failed",
                       phase: str = "failed", replica: Optional[str] = None,
+                      shed_reason: Optional[str] = None,
                       **extras) -> bool:
     """Shared terminal path for every non-finished outcome — scheduler
     expiry/failure AND router-side shedding/expiry use this ONE function,
@@ -194,10 +196,14 @@ def terminate_request(req: ServeRequest, err: str, *, state: str = "failed",
             fields = dict(extras)
             if replica is not None:
                 fields.setdefault("replica", replica)
+            if req.tenant is not None:
+                fields.setdefault("tenant", req.tenant)
             _tele.event("request", request_id=req.id, phase=phase,
                         **fields)
-        _traffic.note_outcome(req, state, error=err, replica=replica)
+        _traffic.note_outcome(req, state, error=err, replica=replica,
+                              shed_reason=shed_reason)
         req._done.set()
+        _qos.note_terminal(req, state)
     return True
 
 
@@ -243,6 +249,8 @@ def deliver_token(req: ServeRequest, token: int,
                 "Time to first token per request (submit -> first "
                 "streamed token)").observe(req.ttft_s * 1e3)
             fields = {"replica": replica} if replica is not None else {}
+            if req.tenant is not None:
+                fields["tenant"] = req.tenant
             _tele.event("request", request_id=req.id, phase="first_token",
                         ttft_ms=round(req.ttft_s * 1e3, 3), **fields)
     if _tele.enabled():
@@ -290,12 +298,15 @@ def finish_request(req: ServeRequest,
                 "End-to-end request latency (submit -> last token)"
             ).observe(req.latency_s * 1e3)
             fields = {"replica": replica} if replica is not None else {}
+            if req.tenant is not None:
+                fields["tenant"] = req.tenant
             _tele.event("request", request_id=req.id, phase="finished",
                         generated=len(req.tokens),
                         latency_ms=round(req.latency_s * 1e3, 3),
                         **fields)
         _traffic.note_outcome(req, "finished", replica=replica)
         req._done.set()
+        _qos.note_terminal(req, "finished")
     return True
 
 
@@ -341,6 +352,13 @@ class ContinuousBatchingScheduler:
         self._slots: List[Optional[_Slot]] = [None] * self.max_slots
         self._lock = threading.Lock()
         self._admit_seq = itertools.count()
+        # per-tenant QoS (docs/serving.md "Per-tenant QoS"): when
+        # MXTPU_QOS/MXTPU_QOS_SPEC configure a plane, admission follows
+        # weighted-fair virtual time across tenants and per-tenant
+        # bulkheads cap slots/pages; unset -> plain FIFO, zero overhead
+        self.qos_config = _qos.QoSConfig.from_env()
+        self._wfq = (_qos.WeightedFairQueue(self.qos_config)
+                     if self.qos_config is not None else None)
         self._steps = 0
         # disaggregated serving (docs/serving.md "Disaggregated
         # serving"): on a role='prefill' engine, a slot that has
@@ -490,6 +508,65 @@ class ContinuousBatchingScheduler:
         _close_request_spans(req, state, **tags)
 
     # ------------------------------------------------------------------
+    def set_qos(self, config) -> None:
+        """Install (or clear) a QoS config programmatically — the fleet
+        uses this so a config passed to `ServeFleet(qos_config=...)`
+        reaches thread-transport replicas without the env var."""
+        self.qos_config = config
+        self._wfq = (_qos.WeightedFairQueue(config)
+                     if config is not None else None)
+
+    def _projected_pages(self, req: ServeRequest) -> int:
+        """A request's FULL KV footprint (prompt + every token it may
+        generate).  Bulkheads cap on this projection at admission, so a
+        growing sequence can never push its tenant past the cap later."""
+        return self.allocator.pages_for(
+            len(req.prompt) + req.max_new_tokens + 1)
+
+    def _tenant_at_cap(self, req: ServeRequest) -> bool:
+        """Bulkhead check (holding self._lock): would seating `req` put
+        its tenant over its max_slots / max_pages cap?"""
+        pol = self.qos_config.policy_for(req.tenant)
+        if pol.max_slots <= 0 and pol.max_pages <= 0:
+            return False
+        slots = pages = 0
+        for s in self._slots:
+            if s is not None and s.req.tenant == req.tenant:
+                slots += 1
+                pages += getattr(s, "qos_pages", len(s.pages))
+        if pol.max_slots > 0 and slots >= pol.max_slots:
+            return True
+        return pol.max_pages > 0 and \
+            pages + self._projected_pages(req) > pol.max_pages
+
+    def _pick_next(self) -> Optional[int]:
+        """Index of the next queued request to seat (holding
+        self._lock).  FIFO without QoS.  With QoS: re-queued work that
+        already generated tokens (eviction / failover re-admission)
+        keeps absolute front priority — dropping IT would violate the
+        never-drop rule; among fresh requests, the head-of-line request
+        of the tenant with the smallest WFQ start tag wins, skipping
+        tenants at a bulkhead cap.  None when nothing is seatable."""
+        if not self._queue:
+            return None
+        if self._wfq is None:
+            return 0
+        best, best_tag = None, None
+        seen = set()
+        for i, req in enumerate(self._queue):
+            if req.tokens or req.evictions:
+                return i       # in-progress work: seat before any fresh
+            key = req.tenant or _qos.DEFAULT_TENANT
+            if key in seen:
+                continue       # WFQ is per-tenant head-of-line
+            seen.add(key)
+            if self._tenant_at_cap(req):
+                continue
+            tag = self._wfq.start_tag(req.tenant)
+            if best_tag is None or tag < best_tag:
+                best, best_tag = i, tag
+        return best
+
     def _free_slot_idx(self) -> Optional[int]:
         for i, s in enumerate(self._slots):
             if s is None:
@@ -558,7 +635,10 @@ class ContinuousBatchingScheduler:
                 idx = self._free_slot_idx()
                 if idx is None:
                     return
-                req = self._queue[0]
+                pick = self._pick_next()
+                if pick is None:
+                    return     # every seatable tenant is at a bulkhead
+                req = self._queue[pick]
                 seq = req._sequence()
                 index = self.engine.prefix_index
                 attached, hit = ([], 0)
@@ -573,13 +653,20 @@ class ContinuousBatchingScheduler:
                     if attached:
                         self.allocator.free(attached)
                     return
-                self._queue.popleft()
+                del self._queue[pick]
                 slot = _Slot(req, idx, self.max_pages_per_seq,
                              next(self._admit_seq))
                 slot.pages = attached + pages
                 slot.table[:len(slot.pages)] = slot.pages
                 slot.ctx = hit
+                slot.qos_pages = self._projected_pages(req)
                 self._slots[idx] = slot
+                if self._wfq is not None:
+                    # WFQ charge = the work this admission buys: the
+                    # sequence to (re-)prefill plus remaining decode
+                    self._wfq.charge(
+                        req.tenant,
+                        len(seq) + req.max_new_tokens - len(req.tokens))
             req.state = "running"
             if hit:
                 req.prefix_hits += hit

@@ -136,20 +136,25 @@ class TrafficJournal:
         })
 
     def outcome(self, req, state: str, error: Optional[str] = None,
-                replica: Optional[str] = None) -> None:
-        """One terminal outcome (finished/failed/expired/cancelled)."""
+                replica: Optional[str] = None,
+                shed_reason: Optional[str] = None) -> None:
+        """One terminal outcome (finished/failed/expired/cancelled);
+        ``shed_reason`` is set when an already-parked request was shed
+        by policy (e.g. priority preemption) — capsules use it to tell
+        policy sheds from overload sheds."""
         self._write({
             "kind": "outcome", "rid": req.id,
             "ts_wall": round(time.time(), 6),
             "ts_mono": round(time.perf_counter(), 6),
             "state": state,
+            "tenant": getattr(req, "tenant", None),
             "digest": stream_digest(req.tokens) if req.tokens else None,
             "generated": len(req.tokens),
             "ttft_ms": (round(req.ttft_s * 1e3, 3)
                         if req.ttft_s is not None else None),
             "latency_ms": (round(req.latency_s * 1e3, 3)
                            if req.latency_s is not None else None),
-            "shed_reason": None,
+            "shed_reason": shed_reason,
             "error": error,
             "failovers": req.failovers,
             "evictions": req.evictions,
@@ -158,13 +163,15 @@ class TrafficJournal:
         })
 
     def shed(self, reason: str, detail: str = "",
-             rid: Optional[int] = None) -> None:
+             rid: Optional[int] = None,
+             tenant: Optional[str] = None) -> None:
         """A request the fleet refused — an outcome with no arrival."""
         self._write({
             "kind": "outcome", "rid": rid,
             "ts_wall": round(time.time(), 6),
             "ts_mono": round(time.perf_counter(), 6),
-            "state": "shed", "digest": None, "generated": 0,
+            "state": "shed", "tenant": tenant, "digest": None,
+            "generated": 0,
             "ttft_ms": None, "latency_ms": None,
             "shed_reason": reason, "error": detail or None,
             "failovers": 0, "evictions": 0, "prefix_hits": 0,
@@ -251,18 +258,21 @@ def note_arrival(req, tenant: Optional[str] = None) -> None:
 
 
 def note_outcome(req, state: str, error: Optional[str] = None,
-                 replica: Optional[str] = None) -> None:
+                 replica: Optional[str] = None,
+                 shed_reason: Optional[str] = None) -> None:
     """Terminal-path hook (`finish_request` / `terminate_request`)."""
     if getattr(req, "_journaled", False):
         j = journal()
         if j is not None:
-            j.outcome(req, state, error=error, replica=replica)
+            j.outcome(req, state, error=error, replica=replica,
+                      shed_reason=shed_reason)
 
 
-def note_shed(reason: str, detail: str = "") -> None:
+def note_shed(reason: str, detail: str = "",
+              tenant: Optional[str] = None) -> None:
     j = journal()
     if j is not None:
-        j.shed(reason, detail)
+        j.shed(reason, detail, tenant=tenant)
 
 
 # ---------------------------------------------------------------------------

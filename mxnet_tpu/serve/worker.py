@@ -378,7 +378,8 @@ class Worker:
                 temperature=float(frame.get("temperature", 1.0)),
                 eos_token_id=frame.get("eos"),
                 on_token=self._on_token(rid),
-                deadline_ms=float(frame.get("deadline_ms") or 0.0))
+                deadline_ms=float(frame.get("deadline_ms") or 0.0),
+                tenant=frame.get("tenant"))
             req.rid = rid
             # adopt the ROUTER's id: worker journal rows / span tags for
             # this request then correlate with the parent's by one key
@@ -459,7 +460,8 @@ class Worker:
                 temperature=float(frame.get("temperature", 1.0)),
                 eos_token_id=frame.get("eos"),
                 on_token=self._on_token(rid),
-                deadline_ms=float(frame.get("deadline_ms") or 0.0))
+                deadline_ms=float(frame.get("deadline_ms") or 0.0),
+                tenant=frame.get("tenant"))
             req.rid = rid
             req.id = rid
             self._join_trace(req, frame)

@@ -38,6 +38,11 @@ Signals: ``ttft_ms`` / ``latency_ms`` (good = sample <= threshold),
 ``decode_tok_s`` (good = generated/latency >= threshold),
 ``availability`` (finished = good; failed / expired / failover-failed
 = bad), ``shed_rate`` (admitted = good; shed = bad).
+
+An objective may carry ``"tenant": "gold"`` to sample only that
+tenant's events (the QoS plane threads ``tenant`` through every
+request/shed event) — per-tenant TTFT or shed-rate SLOs compose with
+the same burn-rate machinery.
 """
 from __future__ import annotations
 
@@ -86,6 +91,9 @@ class Objective:
     slow_s: float = 3600.0
     burn: float = 2.0
     min_events: int = 1
+    #: restrict the objective to one tenant's events (docs/serving.md
+    #: "Per-tenant QoS"); None samples every event regardless of tenant
+    tenant: Optional[str] = None
 
     def __post_init__(self):
         if self.signal not in SIGNALS:
@@ -230,36 +238,43 @@ class SLOEngine:
         if row.get("origin") is not None:
             return
         ev = row.get("event")
+        tenant = row.get("tenant")
         if ev == "request":
             phase = row.get("phase")
             if phase == "first_token" and row.get("ttft_ms") is not None:
-                self.observe("ttft_ms", float(row["ttft_ms"]))
+                self.observe("ttft_ms", float(row["ttft_ms"]),
+                             tenant=tenant)
             elif phase == "finished":
-                self.observe("availability", good=True)
+                self.observe("availability", good=True, tenant=tenant)
                 lat = row.get("latency_ms")
                 if lat is not None:
-                    self.observe("latency_ms", float(lat))
+                    self.observe("latency_ms", float(lat), tenant=tenant)
                     gen = row.get("generated")
                     if gen and float(lat) > 0:
                         self.observe("decode_tok_s",
-                                     float(gen) / (float(lat) / 1e3))
+                                     float(gen) / (float(lat) / 1e3),
+                                     tenant=tenant)
             elif phase in _BAD_PHASES:
-                self.observe("availability", good=False)
+                self.observe("availability", good=False, tenant=tenant)
             elif phase == "submitted":
-                self.observe("shed_rate", good=True)
+                self.observe("shed_rate", good=True, tenant=tenant)
         elif ev == "shed":
-            self.observe("shed_rate", good=False)
+            self.observe("shed_rate", good=False, tenant=tenant)
 
     def observe(self, signal: str, value: Optional[float] = None,
                 good: Optional[bool] = None,
-                ts: Optional[float] = None) -> None:
+                ts: Optional[float] = None,
+                tenant: Optional[str] = None) -> None:
         """Record one sample for every objective on `signal`.  Either a
         measured `value` (cut by each objective's threshold) or an
-        explicit `good` verdict."""
+        explicit `good` verdict.  Objectives pinned to a tenant only
+        sample that tenant's events."""
         now = time.monotonic() if ts is None else ts
         with self._lock:
             states = [s for s in self._states.values()
-                      if s.objective.signal == signal]
+                      if s.objective.signal == signal
+                      and (s.objective.tenant is None
+                           or s.objective.tenant == tenant)]
         for st in states:
             o = st.objective
             if good is not None:
@@ -297,6 +312,7 @@ class SLOEngine:
             budget = 1.0 - o.target
             entry = {"signal": o.signal, "target": o.target,
                      "threshold": o.threshold, "burn_threshold": o.burn,
+                     "tenant": o.tenant,
                      "alerting": st.alerting, "alerts": st.alerts,
                      "windows": {}}
             for wname, width in (("fast", o.fast_s), ("slow", o.slow_s)):
@@ -343,6 +359,24 @@ class SLOEngine:
                     "1 while the objective's multi-window burn alert "
                     "is firing", labelnames=("slo",)).set(
                         1.0 if firing else 0.0, slo=name)
+                if o.tenant is not None:
+                    # tenant-scoped objectives additionally export under
+                    # a tenant label, so `diagnose --tenants` can join
+                    # burn state onto the per-tenant QoS table from a
+                    # bare metrics snapshot (no spec needed)
+                    _tele.gauge(
+                        "slo_tenant_burn",
+                        "Fast-window burn multiple, tenant-scoped "
+                        "objectives only",
+                        labelnames=("slo", "tenant")).set(
+                            fast["burn"], slo=name, tenant=o.tenant)
+                    _tele.gauge(
+                        "slo_tenant_alert",
+                        "1 while a tenant-scoped objective's burn "
+                        "alert is firing",
+                        labelnames=("slo", "tenant")).set(
+                            1.0 if firing else 0.0, slo=name,
+                            tenant=o.tenant)
             if firing and not st.alerting:
                 st.alerting = True
                 st.alerts += 1

@@ -1,0 +1,555 @@
+"""Per-tenant QoS plane tests: token buckets, circuit breakers,
+weighted-fair queueing, spec parsing, the pluggable admission-policy
+registry, router priority preemption, and breaker quarantine /
+half-open recovery through a live fleet (docs/serving.md "Per-tenant
+QoS")."""
+import json
+import time
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.serve import qos as qos_mod
+from mxnet_tpu.serve.qos import (AdmissionController, AdmissionPolicy,
+                                 BreakerPolicy, QoSConfig, TenantPolicy,
+                                 WeightedFairQueue, class_rank, create,
+                                 register, OVERLOAD_SHED_REASONS,
+                                 POLICY_SHED_REASONS)
+
+pytestmark = pytest.mark.serve
+
+
+class _Clock:
+    """Injectable monotonic clock — quota/breaker tests never sleep."""
+
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# token bucket
+# ---------------------------------------------------------------------------
+
+def test_bucket_refill_math():
+    clk = _Clock()
+    b = qos_mod._Bucket(rate=2.0, burst=4.0, clock=clk)
+    assert b.fill() == 1.0                  # starts full
+    for _ in range(4):
+        assert b.take(1.0)
+    assert not b.take(1.0)                  # drained
+    clk.advance(0.5)                        # 2/s * 0.5s = +1 token
+    assert b.take(1.0)
+    assert not b.take(0.5)
+    clk.advance(10.0)                       # refill caps at burst
+    assert b.fill() == 1.0
+
+
+def test_bucket_zero_rate_is_unlimited():
+    b = qos_mod._Bucket(rate=0.0, burst=0.0, clock=_Clock())
+    assert b.take(1e9)
+    assert b.fill() == 1.0
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+def test_breaker_lifecycle_closed_open_half_open_closed():
+    clk = _Clock()
+    br = qos_mod._Breaker(
+        BreakerPolicy(offenses=2, window_s=10, cooldown_s=5, probes=1),
+        clock=clk)
+    assert br.state == "closed" and br.allow()
+    assert not br.offense()                 # 1 of 2
+    assert br.offense()                     # trips
+    assert br.state == "open" and br.trips == 1
+    assert not br.allow()                   # quarantined
+    clk.advance(5.0)                        # cooldown elapses
+    assert br.allow()                       # the single half-open probe
+    assert br.state == "half_open"
+    assert not br.allow()                   # probe budget spent
+    br.success()
+    assert br.state == "closed" and br.allow()
+    # a misbehaving probe re-quarantines instead of closing
+    assert not br.offense()
+    assert br.offense()
+    clk.advance(5.0)
+    assert br.allow()
+    assert br.offense()                     # half-open offense reopens
+    assert br.state == "open" and br.trips == 3
+
+
+def test_breaker_window_prunes_stale_offenses():
+    clk = _Clock()
+    br = qos_mod._Breaker(
+        BreakerPolicy(offenses=2, window_s=10, cooldown_s=5), clock=clk)
+    assert not br.offense()
+    clk.advance(11.0)                       # first offense ages out
+    assert not br.offense()
+    assert br.state == "closed"
+
+
+def test_breaker_disabled_when_offenses_zero():
+    br = qos_mod._Breaker(BreakerPolicy(offenses=0), clock=_Clock())
+    for _ in range(5):
+        assert not br.offense()
+    assert br.allow() and br.state == "closed"
+
+
+# ---------------------------------------------------------------------------
+# weighted-fair queue
+# ---------------------------------------------------------------------------
+
+def test_wfq_start_tags_favor_heavier_weights():
+    cfg = QoSConfig.from_spec(
+        {"tenants": {"a": {"weight": 3.0}, "b": {"weight": 1.0}}})
+    wfq = WeightedFairQueue(cfg)
+    wfq.charge("a", 3.0)
+    wfq.charge("b", 3.0)
+    # equal service so far, but b's virtual finish time is 3x further
+    # out — a wins the next seat
+    assert wfq.start_tag("a") == pytest.approx(1.0)
+    assert wfq.start_tag("b") == pytest.approx(3.0)
+    sh = wfq.shares()
+    assert sh["a"] == pytest.approx(0.5)
+    assert sh["b"] == pytest.approx(0.5)
+
+
+def test_wfq_unknown_tenant_uses_default_weight():
+    wfq = WeightedFairQueue(QoSConfig())
+    assert wfq.shares() == {}
+    wfq.charge(None, 2.0)                   # default tenant "-"
+    assert wfq.shares() == {qos_mod.DEFAULT_TENANT: 1.0}
+
+
+# ---------------------------------------------------------------------------
+# spec parsing / validation
+# ---------------------------------------------------------------------------
+
+def test_priority_classes_and_reason_sets():
+    assert class_rank("interactive") == 0
+    assert class_rank("batch") == 1
+    assert class_rank("best_effort") == 2
+    assert not (POLICY_SHED_REASONS & OVERLOAD_SHED_REASONS)
+
+
+def test_tenant_policy_validation():
+    with pytest.raises(MXNetError, match="unknown priority class"):
+        TenantPolicy(priority="gold")
+    with pytest.raises(MXNetError, match="weight must be > 0"):
+        TenantPolicy(weight=0.0)
+    with pytest.raises(MXNetError, match="rps must be >= 0"):
+        TenantPolicy(rps=-1.0)
+    with pytest.raises(MXNetError, match="max_slots must be >= 0"):
+        TenantPolicy(max_slots=-1)
+
+
+def test_breaker_policy_validation():
+    with pytest.raises(MXNetError, match="offenses must be >= 0"):
+        BreakerPolicy(offenses=-1)
+    with pytest.raises(MXNetError, match="must be > 0"):
+        BreakerPolicy(window_s=0)
+    with pytest.raises(MXNetError, match="probes must be >= 1"):
+        BreakerPolicy(probes=0)
+
+
+def test_from_spec_grammar_and_unknown_keys():
+    cfg = QoSConfig.from_spec(
+        {"policy": "token_bucket",
+         "default": {"priority": "batch", "weight": 1.0},
+         "tenants": {"gold": {"priority": "interactive", "weight": 8.0},
+                     "abuser": {"priority": "best_effort", "rps": 5,
+                                "tps": 500, "max_slots": 1}},
+         "breaker": {"offenses": 3, "window_s": 30, "cooldown_s": 10,
+                     "probes": 1}})
+    assert cfg.policy_for("gold").rank == 0
+    assert cfg.policy_for("abuser").max_slots == 1
+    assert cfg.policy_for("unlisted") is cfg.default
+    assert cfg.breaker.offenses == 3
+    with pytest.raises(MXNetError, match="unknown key"):
+        QoSConfig.from_spec({"tenant": {}})             # typo'd top key
+    with pytest.raises(MXNetError, match="unknown key"):
+        QoSConfig.from_spec({"tenants": {"a": {"rpz": 1}}})
+    with pytest.raises(MXNetError, match="unknown key"):
+        QoSConfig.from_spec({"breaker": {"offences": 3}})
+    with pytest.raises(MXNetError, match="JSON object"):
+        QoSConfig.from_spec([1, 2])
+
+
+def test_from_env_switch_spec_and_file(monkeypatch, tmp_path):
+    for var in (qos_mod.ENV_QOS, qos_mod.ENV_QOS_SPEC,
+                qos_mod.ENV_QOS_POLICY):
+        monkeypatch.delenv(var, raising=False)
+    assert QoSConfig.from_env() is None                 # unconfigured
+    monkeypatch.setenv(qos_mod.ENV_QOS, "1")
+    cfg = QoSConfig.from_env()                          # pure defaults
+    assert cfg is not None and cfg.policy == "token_bucket"
+    # the kill switch wins even when a spec is present
+    monkeypatch.setenv(qos_mod.ENV_QOS_SPEC,
+                       '{"tenants": {"a": {"rps": 1}}}')
+    monkeypatch.setenv(qos_mod.ENV_QOS, "0")
+    assert QoSConfig.from_env() is None
+    monkeypatch.delenv(qos_mod.ENV_QOS)
+    assert QoSConfig.from_env().tenants["a"].rps == 1.0
+    # a non-"{" value is a file path
+    p = tmp_path / "qos.json"
+    p.write_text(json.dumps({"default": {"priority": "interactive"}}))
+    monkeypatch.setenv(qos_mod.ENV_QOS_SPEC, str(p))
+    assert QoSConfig.from_env().default.priority == "interactive"
+    # parse errors raise eagerly instead of admitting everything
+    monkeypatch.setenv(qos_mod.ENV_QOS_SPEC, "{not json")
+    with pytest.raises(MXNetError, match="not valid JSON"):
+        QoSConfig.from_env()
+    monkeypatch.setenv(qos_mod.ENV_QOS_SPEC, str(tmp_path / "nope.json"))
+    with pytest.raises(MXNetError, match="cannot read"):
+        QoSConfig.from_env()
+
+
+# ---------------------------------------------------------------------------
+# pluggable admission policies
+# ---------------------------------------------------------------------------
+
+def test_admission_policy_registry():
+    assert isinstance(create("token_bucket"), qos_mod.TokenBucketPolicy)
+    assert isinstance(create("permissive"), qos_mod.PermissivePolicy)
+    with pytest.raises(MXNetError, match="not registered"):
+        create("no_such_policy")
+
+
+def test_custom_policy_selected_by_spec(monkeypatch):
+    monkeypatch.delenv(qos_mod.ENV_QOS_POLICY, raising=False)
+
+    @register
+    class DenyAllPolicy(AdmissionPolicy):
+        def admit(self, state, tenant, tokens):
+            return ("quota", "deny-all test policy")
+
+    ctrl = AdmissionController(
+        QoSConfig.from_spec({"policy": "denyallpolicy"}))
+    assert ctrl.policy_name == "DenyAllPolicy"
+    verdict = ctrl.admit("t", 4)
+    assert verdict == ("quota", "deny-all test policy")
+
+
+def test_env_policy_overrides_spec(monkeypatch):
+    monkeypatch.setenv(qos_mod.ENV_QOS_POLICY, "permissive")
+    ctrl = AdmissionController(
+        QoSConfig.from_spec({"policy": "token_bucket"}))
+    assert ctrl.policy_name == "PermissivePolicy"
+
+
+def test_permissive_policy_meters_but_never_sheds(monkeypatch):
+    monkeypatch.delenv(qos_mod.ENV_QOS_POLICY, raising=False)
+    clk = _Clock()
+    ctrl = AdmissionController(
+        QoSConfig.from_spec({"policy": "permissive",
+                             "tenants": {"t": {"rps": 1.0,
+                                               "burst_s": 1.0}}}),
+        clock=clk)
+    for _ in range(5):
+        assert ctrl.admit("t", 4) is None   # over quota, still admitted
+    st = ctrl.stats()["tenants"]["t"]
+    assert st["admitted"] == 5
+    assert st["quota_fill"]["requests"] < 1.0   # ...but metered
+
+
+# ---------------------------------------------------------------------------
+# admission controller: quotas, fault points, breaker
+# ---------------------------------------------------------------------------
+
+def test_controller_request_quota_shed_and_refill(monkeypatch):
+    monkeypatch.delenv(qos_mod.ENV_QOS_POLICY, raising=False)
+    clk = _Clock()
+    ctrl = AdmissionController(
+        QoSConfig.from_spec({"tenants": {"t": {"rps": 1.0,
+                                               "burst_s": 2.0}}}),
+        clock=clk)
+    assert ctrl.admit("t", 4) is None       # burst of 2 requests
+    assert ctrl.admit("t", 4) is None
+    reason, detail = ctrl.admit("t", 4)
+    assert reason == "quota" and "request-rate" in detail
+    clk.advance(1.0)                        # 1 req/s refills one
+    assert ctrl.admit("t", 4) is None
+    st = ctrl.stats()["tenants"]["t"]
+    assert st["admitted"] == 3
+    # an unquota'd tenant rides the default policy, keyed "-" for None
+    assert ctrl.admit(None, 4) is None
+    assert qos_mod.DEFAULT_TENANT in ctrl.stats()["tenants"]
+
+
+def test_controller_token_quota_shed(monkeypatch):
+    monkeypatch.delenv(qos_mod.ENV_QOS_POLICY, raising=False)
+    ctrl = AdmissionController(
+        QoSConfig.from_spec({"tenants": {"t": {"tps": 10.0,
+                                               "burst_s": 1.0}}}),
+        clock=_Clock())
+    assert ctrl.admit("t", 8) is None
+    reason, detail = ctrl.admit("t", 8)     # 8 + 8 > burst of 10
+    assert reason == "quota" and "token-throughput" in detail
+
+
+def test_tenant_quota_fault_forces_quota_shed(monkeypatch):
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "tenant_quota@1")
+    ctrl = AdmissionController(QoSConfig())
+    reason, detail = ctrl.admit("t", 4)
+    assert reason == "quota" and "injected" in detail
+    assert ctrl.admit("t", 4) is None       # only hit 1 was armed
+
+
+def test_router_admit_fault_is_an_offense_and_drives_breaker(monkeypatch):
+    clk = _Clock()
+    monkeypatch.setenv("MXTPU_FAULT_SPEC",
+                       "router_admit@1,router_admit@2")
+    ctrl = AdmissionController(
+        QoSConfig.from_spec({"breaker": {"offenses": 2, "window_s": 30,
+                                         "cooldown_s": 5, "probes": 1}}),
+        clock=clk)
+    for _ in range(2):
+        with pytest.raises(MXNetError, match="admission check failed"):
+            ctrl.admit("t", 4)
+    reason, detail = ctrl.admit("t", 4)     # breaker tripped
+    assert reason == "quarantine" and "circuit" in detail
+    st = ctrl.stats()["tenants"]["t"]
+    assert st["breaker"] == "open" and st["offenses"] == 2
+    assert st["breaker_trips"] == 1
+    clk.advance(5.0)                        # cooldown -> half-open
+    assert ctrl.admit("t", 4) is None       # the probe is admitted
+    assert ctrl.stats()["tenants"]["t"]["breaker"] == "half_open"
+
+    class _Req:
+        tenant = "t"
+    ctrl.note_terminal(_Req(), "finished")  # clean probe closes it
+    assert ctrl.stats()["tenants"]["t"]["breaker"] == "closed"
+
+
+def test_deadline_blowout_is_an_offense():
+    ctrl = AdmissionController(
+        QoSConfig.from_spec({"breaker": {"offenses": 5}}),
+        clock=_Clock())
+
+    class _Req:
+        tenant = "t"
+    ctrl.note_terminal(_Req(), "expired")
+    assert ctrl.stats()["tenants"]["t"]["offenses"] == 1
+
+
+# ---------------------------------------------------------------------------
+# router: priority preemption at the queue bound
+# ---------------------------------------------------------------------------
+
+class _FakeSched:
+    def __init__(self, queued=0, active=0):
+        self.queue_depth = queued
+        self.active_count = active
+
+    def enqueue(self, req, front=False):
+        self.queue_depth += 1
+
+    def validate_request(self, prompt, max_new_tokens):
+        return [int(t) for t in prompt]
+
+
+class _FakeAlloc:
+    free_pages, total_pages = 0, 8
+
+
+class _FakeEngine:
+    def __init__(self):
+        self.scheduler = _FakeSched(queued=2, active=2)
+        self.allocator = _FakeAlloc()
+
+        class _SC:
+            max_slots = 2
+        self.serve_config = _SC()
+
+
+class _FakeReplica:
+    def __init__(self, name):
+        self.name, self.state = name, "running"
+        self.engine = _FakeEngine()
+
+    def notify(self):
+        pass
+
+
+def _qos_router(queue_bound=1):
+    from mxnet_tpu.serve import RequestRouter
+    ctrl = AdmissionController(QoSConfig.from_spec(
+        {"tenants": {"gold": {"priority": "interactive"},
+                     "junk": {"priority": "best_effort"}}}))
+    # zero headroom: every submit parks, so the bound governs
+    rep = _FakeReplica("r0")
+    return RequestRouter(lambda: [rep], queue_bound=queue_bound,
+                         qos=ctrl), ctrl
+
+
+def test_router_priority_preempts_lower_class_at_bound():
+    r, ctrl = _qos_router(queue_bound=1)
+    junk = r.submit([1, 2], max_new_tokens=2, tenant="junk")
+    gold = r.submit([3, 4], max_new_tokens=2, tenant="gold")
+    # the victim is terminated (journaled as a state=shed outcome) and
+    # the higher-class arrival takes its place in the bounded queue
+    assert junk._done.is_set() and "preempted" in junk.error
+    assert not gold._done.is_set()
+    assert r.queue_depth == 1 and r.sheds == 1
+    assert ctrl.stats()["tenants"]["junk"]["sheds"] == {"priority": 1}
+
+
+def test_router_lower_class_arrival_sheds_itself():
+    from mxnet_tpu.serve import ShedError
+    r, _ = _qos_router(queue_bound=1)
+    gold = r.submit([1, 2], max_new_tokens=2, tenant="gold")
+    with pytest.raises(ShedError) as ei:    # no strictly-lower victim
+        r.submit([3, 4], max_new_tokens=2, tenant="junk")
+    assert ei.value.reason == "queue_full"
+    assert not gold._done.is_set()          # the parked gold survives
+
+
+def test_router_same_class_never_preempts():
+    from mxnet_tpu.serve import ShedError
+    r, _ = _qos_router(queue_bound=1)
+    r.submit([1, 2], max_new_tokens=2, tenant="junk")
+    with pytest.raises(ShedError) as ei:
+        r.submit([3, 4], max_new_tokens=2, tenant="junk")
+    assert ei.value.reason == "queue_full"
+
+
+def test_router_never_preempts_mid_stream_work():
+    from mxnet_tpu.serve import ShedError
+    r, _ = _qos_router(queue_bound=1)
+    junk = r.submit([1, 2], max_new_tokens=2, tenant="junk")
+    junk.tokens.append(7)                   # admitted work with progress
+    with pytest.raises(ShedError) as ei:
+        r.submit([3, 4], max_new_tokens=2, tenant="gold")
+    assert ei.value.reason == "queue_full"
+    assert not junk._done.is_set()          # mid-stream work is safe
+
+
+# ---------------------------------------------------------------------------
+# live fleet: quota sheds, breaker quarantine + half-open recovery
+# ---------------------------------------------------------------------------
+
+def _tiny_model():
+    from mxnet_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    m = GPTForCausalLM(GPTConfig(vocab_size=96, hidden_size=32,
+                                 num_layers=1, num_heads=4,
+                                 intermediate_size=64, max_position=64,
+                                 dropout=0.0))
+    m.initialize()
+    m(mx.np.array([[1, 2]], dtype="int32"))
+    return m
+
+
+def _fleet(m, n=2, **kw):
+    from mxnet_tpu.serve import ServeConfig, ServeFleet
+    kw.setdefault("config", ServeConfig(max_slots=2, page_size=4,
+                                        num_pages=0, prefill_chunk=4,
+                                        max_len=32))
+    kw.setdefault("stall_timeout", 5.0)
+    return ServeFleet(m, replicas=n, **kw)
+
+
+def test_fleet_quota_sheds_and_tenant_stats(monkeypatch):
+    monkeypatch.delenv(qos_mod.ENV_QOS_POLICY, raising=False)
+    from mxnet_tpu.serve import ShedError
+    spec = QoSConfig.from_spec(
+        {"tenants": {"abuser": {"priority": "best_effort", "rps": 1.0,
+                                "burst_s": 1.0}}})
+    m = _tiny_model()
+    with _fleet(m, qos_config=spec) as fleet:
+        admitted, sheds = [], 0
+        for _ in range(6):
+            try:
+                admitted.append(fleet.submit([1, 2, 3], max_new_tokens=2,
+                                             tenant="abuser"))
+            except ShedError as e:
+                assert e.reason == "quota"
+                sheds += 1
+        for req in admitted:
+            req.result(timeout=30)
+        assert admitted and sheds           # bucket of 1: both happen
+        st = fleet.stats()["qos"]["tenants"]["abuser"]
+        assert st["admitted"] == len(admitted)
+        assert st["sheds"].get("quota") == sheds
+        assert st["priority"] == "best_effort"
+
+
+def test_fleet_breaker_quarantine_and_half_open_recovery(monkeypatch):
+    from mxnet_tpu.serve import ShedError
+    spec = QoSConfig.from_spec(
+        {"breaker": {"offenses": 2, "window_s": 30, "cooldown_s": 0.5,
+                     "probes": 1}})
+    monkeypatch.setenv("MXTPU_FAULT_SPEC",
+                       "router_admit@1,router_admit@2")
+    m = _tiny_model()
+    with _fleet(m, qos_config=spec) as fleet:
+        # two injected admission faults = two offenses -> quarantine
+        for _ in range(2):
+            with pytest.raises(MXNetError, match="admission check"):
+                fleet.submit([1, 2], max_new_tokens=2, tenant="t")
+        with pytest.raises(ShedError) as ei:
+            fleet.submit([1, 2], max_new_tokens=2, tenant="t")
+        assert ei.value.reason == "quarantine"
+        time.sleep(0.6)                     # cooldown -> half-open
+        req = fleet.submit([1, 2, 3], max_new_tokens=3, tenant="t")
+        req.result(timeout=30)              # the probe finishes cleanly
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            st = fleet.stats()["qos"]["tenants"]["t"]
+            if st["breaker"] == "closed":
+                break
+            time.sleep(0.02)
+        assert st["breaker"] == "closed" and st["breaker_trips"] == 1
+        assert st["offenses"] == 2
+
+
+@pytest.mark.slow
+def test_breaker_survives_process_worker_kill_mid_quarantine(
+        monkeypatch, tmp_path):
+    """Acceptance drill: the breaker lives in the PARENT, so a tenant
+    quarantined on a process-transport fleet stays quarantined across a
+    worker SIGKILL + respawn, then recovers through a half-open probe."""
+    import os
+    import signal
+
+    from mxnet_tpu.serve import ShedError
+    spec = QoSConfig.from_spec(
+        {"breaker": {"offenses": 2, "window_s": 60, "cooldown_s": 2.0,
+                     "probes": 1}})
+    monkeypatch.setenv("MXTPU_FAULT_SPEC",
+                       "router_admit@1,router_admit@2")
+    m = _tiny_model()
+    with _fleet(m, transport="process", respawn_budget=2,
+                stall_timeout=30.0, qos_config=spec) as fleet:
+        for _ in range(2):
+            with pytest.raises(MXNetError, match="admission check"):
+                fleet.submit([1, 2], max_new_tokens=2, tenant="t")
+        with pytest.raises(ShedError) as ei:
+            fleet.submit([1, 2], max_new_tokens=2, tenant="t")
+        assert ei.value.reason == "quarantine"
+
+        os.kill(fleet.replicas[0].pid, signal.SIGKILL)
+        deadline = time.time() + 30
+        while fleet.respawns == 0 and time.time() < deadline:
+            time.sleep(0.01)
+        assert fleet.respawns >= 1, "killed worker never respawned"
+        # parent-side breaker state survived the worker death
+        assert fleet.stats()["qos"]["tenants"]["t"]["breaker"] == "open"
+
+        time.sleep(2.2)                     # cooldown -> half-open
+        req = fleet.submit([1, 2, 3], max_new_tokens=3, tenant="t")
+        req.result(timeout=60)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            st = fleet.stats()["qos"]["tenants"]["t"]
+            if st["breaker"] == "closed":
+                break
+            time.sleep(0.05)
+        assert st["breaker"] == "closed" and st["breaker_trips"] >= 1

@@ -97,6 +97,19 @@ def test_fault_spec_rejects_typos():
         FaultRegistry("ckpt_read@1:NoSuchError")
 
 
+def test_fault_spec_duplicate_entry_last_action_wins():
+    # duplicate point@hit entries overwrite silently — the LAST action
+    # is the one that fires (one plan slot per (point, hit))
+    reg = FaultRegistry("p@1:RuntimeError,p@1:OSError")
+    with pytest.raises(OSError):
+        reg.fire("p")
+
+
+def test_fault_spec_negative_hit_rejected():
+    with pytest.raises(ValueError, match="1-based"):
+        FaultRegistry("p@-3")
+
+
 def test_fault_point_tracks_env(monkeypatch):
     monkeypatch.delenv(ENV_VAR, raising=False)
     fault_point("p")                            # unarmed: no-op

@@ -24,6 +24,16 @@ Also pretty-prints crash flight-recorder bundles (docs/observability.md,
         [--replicas N]              # re-drive the capsule window and
                                     # print the divergence report
                                     # (rc 0 iff bit-identical)
+    python tools/diagnose.py --tenants <path>      # per-tenant QoS
+                                                   # table (admits, sheds
+                                                   # by reason, quota
+                                                   # fill, WFQ share,
+                                                   # breaker + SLO burn
+                                                   # state) from a
+                                                   # metrics-snapshot
+                                                   # JSON, a fleet
+                                                   # stats() dump, or an
+                                                   # incident capsule dir
     python tools/diagnose.py --trace <dir-or-files...> \
         [--merged-out merged.json]  # merge per-process trace_<pid>.json
                                     # exports into ONE Perfetto doc:
@@ -609,6 +619,160 @@ def print_capsule(path: str) -> int:
     return 0
 
 
+def print_tenants(path: str) -> int:
+    """Per-tenant QoS rollup (docs/serving.md, "Per-tenant QoS") from
+    any of the three places the plane leaves evidence:
+
+    * an incident capsule dir — joins the manifest's ``fleet.qos``
+      stats with the captured ``metrics.json`` snapshot,
+    * a metrics-snapshot JSON (``telemetry.snapshot()`` /
+      ``metrics.json``) — the ``serve_tenant_*`` and ``slo_tenant_*``
+      series,
+    * a ``fleet.stats()`` dump (or its bare ``qos`` sub-dict).
+    """
+    snap, qstats = None, None
+    if os.path.isdir(path):
+        from mxnet_tpu.serve import traffic as _traffic
+        try:
+            cap = _traffic.read_capsule(path)
+        except Exception as e:
+            print(f"cannot read capsule {path}: {e}", file=sys.stderr)
+            return 1
+        qstats = (cap.get("fleet") or {}).get("qos")
+        mpath = os.path.join(path, "metrics.json")
+        if os.path.exists(mpath):
+            try:
+                with open(mpath) as f:
+                    snap = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                pass
+    else:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"cannot read {path}: {e}", file=sys.stderr)
+            return 1
+        if "qos" in doc:                       # fleet.stats() dump
+            qstats = doc["qos"]
+        elif "tenants" in doc and "policy" in doc:   # bare qos stats
+            qstats = doc
+        else:                                  # metrics snapshot
+            snap = doc
+
+    def _series(name):
+        return ((snap or {}).get(name) or {}).get("series", [])
+
+    # fold every source into {tenant -> row}
+    tenants: dict = {}
+
+    def row(t):
+        return tenants.setdefault(t, {
+            "priority": None, "weight": None, "admitted": 0,
+            "sheds": {}, "offenses": {}, "breaker": None,
+            "breaker_trips": None, "quota": {}, "wfq": None,
+            "slo": None})
+
+    for t, st in ((qstats or {}).get("tenants") or {}).items():
+        r = row(t)
+        off = st.get("offenses") or {}
+        if not isinstance(off, dict):        # stats() carries a count
+            off = {"total": off} if off else {}
+        r.update(priority=st.get("priority"), weight=st.get("weight"),
+                 admitted=st.get("admitted", 0),
+                 sheds=dict(st.get("sheds") or {}),
+                 offenses=off,
+                 breaker=st.get("breaker"),
+                 breaker_trips=st.get("breaker_trips"),
+                 quota=dict(st.get("quota_fill") or {}))
+    if snap is not None:
+        for s in _series("serve_tenant_admitted_total"):
+            t = (s.get("labels") or {}).get("tenant")
+            r = row(t)
+            r["admitted"] = max(r["admitted"],
+                                int(s.get("value", s.get("count", 0))))
+        for s in _series("serve_tenant_sheds_total"):
+            lbl = s.get("labels") or {}
+            r = row(lbl.get("tenant"))
+            n = int(s.get("value", s.get("count", 0)))
+            reason = lbl.get("reason", "?")
+            r["sheds"][reason] = max(r["sheds"].get(reason, 0), n)
+        for s in _series("serve_tenant_quota_fill"):
+            lbl = s.get("labels") or {}
+            row(lbl.get("tenant")).setdefault(
+                "quota", {})[lbl.get("bucket", "?")] = s.get("value")
+        for s in _series("serve_tenant_wfq_share"):
+            row((s.get("labels") or {}).get("tenant"))["wfq"] = \
+                s.get("value")
+        breaker_names = {0: "closed", 1: "half_open", 2: "open"}
+        for s in _series("serve_tenant_breaker_state"):
+            r = row((s.get("labels") or {}).get("tenant"))
+            if r["breaker"] is None:
+                r["breaker"] = breaker_names.get(
+                    int(s.get("value", 0)), "?")
+        for s in _series("slo_tenant_burn"):
+            lbl = s.get("labels") or {}
+            r = row(lbl.get("tenant"))
+            r["slo"] = {"slo": lbl.get("slo"),
+                        "burn": s.get("value"),
+                        "alert": (r["slo"] or {}).get("alert", 0.0)}
+        for s in _series("slo_tenant_alert"):
+            lbl = s.get("labels") or {}
+            r = row(lbl.get("tenant"))
+            if r["slo"] is None:
+                r["slo"] = {"slo": lbl.get("slo"), "burn": None}
+            r["slo"]["alert"] = s.get("value")
+
+    if not tenants:
+        print(f"no per-tenant QoS data in {path} (QoS plane not "
+              f"configured, or snapshot predates it)", file=sys.stderr)
+        return 1
+
+    print(f"========== tenants: {path} ==========")
+    if qstats is not None:
+        print(f"policy    : {qstats.get('policy')}")
+    print(f"  {'tenant':<12} {'class':<12} {'wt':>5} {'admit':>7} "
+          f"{'shed':>6} {'quota r/t':>11} {'wfq':>6} {'breaker':<9} "
+          f"{'slo burn':<14}")
+    for t in sorted(tenants):
+        r = tenants[t]
+        shed_n = sum(r["sheds"].values())
+        q = r["quota"] or {}
+
+        def fq(k):
+            v = q.get(k)
+            return "-" if v is None else f"{v:.2f}"
+
+        wfq = "-" if r["wfq"] is None else f"{r['wfq']:.2f}"
+        slo = r["slo"]
+        if slo is None:
+            slo_s = "-"
+        else:
+            burn = slo.get("burn")
+            slo_s = ("ALERT" if slo.get("alert") else
+                     ("-" if burn is None else f"{burn:.2f}x"))
+            if slo.get("slo"):
+                slo_s += f" ({slo['slo']})"
+        brk = r["breaker"] or "-"
+        if r.get("breaker_trips"):
+            brk += f"({r['breaker_trips']})"
+        print(f"  {str(t):<12} {str(r['priority'] or '-'):<12} "
+              f"{r['weight'] if r['weight'] is not None else '-':>5} "
+              f"{r['admitted']:>7} {shed_n:>6} "
+              f"{fq('requests') + '/' + fq('tokens'):>11} {wfq:>6} "
+              f"{brk:<9} {slo_s:<14}")
+        details = []
+        if r["sheds"]:
+            details.append("sheds: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(r["sheds"].items())))
+        if r["offenses"]:
+            details.append("offenses: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(r["offenses"].items())))
+        for d in details:
+            print(f"  {'':<12} {d}")
+    return 0
+
+
 def replay_capsule_cli(path: str) -> int:
     """Re-drive a capsule's traffic window (`serve.replay`) and print
     the divergence report.  rc 0 iff every verifiable greedy stream
@@ -670,6 +834,8 @@ def main():
         if "--replay" in sys.argv:
             return sys.exit(replay_capsule_cli(path))
         return sys.exit(print_capsule(path))
+    if "--tenants" in sys.argv:
+        return sys.exit(print_tenants(_flag_operand("--tenants")))
     if "--bundle" in sys.argv:
         return sys.exit(print_bundle(_flag_operand("--bundle")))
     if "--journal" in sys.argv:
